@@ -1,0 +1,33 @@
+"""parquet-floor-tpu: a TPU-native (JAX/XLA/Pallas) Parquet framework.
+
+Brand-new implementation with the capability surface of the Java reference
+``Pablete1234/parquet-floor`` (see SURVEY.md): a declarative
+Hydrator/Dehydrator API over a from-scratch Parquet format engine, with the
+columnar decode hot path offloaded to TPU kernels.
+"""
+
+from .format.schema import (
+    ColumnDescriptor,
+    GroupType,
+    LogicalAnnotation,
+    MessageType,
+    PrimitiveType,
+    types,
+)
+from .format.parquet_thrift import CompressionCodec, Encoding, Type
+from .format.metadata import ParquetMetadata
+from .format.file_read import ParquetFileReader
+from .format.file_write import ColumnData, ParquetFileWriter, WriterOptions
+from .api.hydrate import Dehydrator, Hydrator, HydratorSupplier, ValueWriter
+from .api.reader import ParquetReader
+from .api.writer import ParquetWriter
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ColumnData", "ColumnDescriptor", "CompressionCodec", "Dehydrator",
+    "Encoding", "GroupType", "Hydrator", "HydratorSupplier",
+    "LogicalAnnotation", "MessageType", "ParquetFileReader",
+    "ParquetFileWriter", "ParquetMetadata", "ParquetReader", "ParquetWriter",
+    "PrimitiveType", "Type", "types", "ValueWriter", "WriterOptions",
+]

@@ -1,0 +1,133 @@
+"""Hydrator/Dehydrator plugin boundary — parity with the reference's
+``blue.strategic.parquet`` interfaces (``Hydrator.java:12-28``,
+``HydratorSupplier.java:10-19``, ``Dehydrator.java:13``,
+``ValueWriter.java:3-5``), expressed as Python protocols.
+
+Duck typing applies throughout: anything with matching methods works; the
+ABCs here are optional convenience bases.  ``HydratorSupplier.constantly``
+and function-based adapters are provided for ergonomic parity.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Generic, List, Sequence, TypeVar
+
+from ..format.schema import ColumnDescriptor
+
+U = TypeVar("U")  # mutable hydration target
+S = TypeVar("S")  # sealed record
+T = TypeVar("T")  # record being dehydrated
+
+
+class Hydrator(ABC, Generic[U, S]):
+    """Builds a domain object from one row's cells.
+
+    Contract (parity with ``Hydrator.java``): ``start()`` creates a mutable
+    target; ``add(target, heading, value)`` applies one cell (``value`` is
+    None for null cells) and returns the (possibly new) target; ``finish``
+    seals it.
+    """
+
+    @abstractmethod
+    def start(self) -> U: ...
+
+    @abstractmethod
+    def add(self, target: U, heading: str, value: Any) -> U: ...
+
+    @abstractmethod
+    def finish(self, target: U) -> S: ...
+
+
+class HydratorSupplier(ABC, Generic[U, S]):
+    """Factory receiving the projected columns.
+
+    Values will always be added to the hydrator in the same order as the
+    columns supplied here (``HydratorSupplier.java:10-15``).
+    """
+
+    @abstractmethod
+    def get(self, columns: List[ColumnDescriptor]) -> Hydrator[U, S]: ...
+
+    @staticmethod
+    def constantly(hydrator: Hydrator[U, S]) -> "HydratorSupplier[U, S]":
+        class _Const(HydratorSupplier):
+            def get(self, columns):
+                return hydrator
+
+        return _Const()
+
+
+class Dehydrator(ABC, Generic[T]):
+    """Writes one record's fields through a ValueWriter (``Dehydrator.java:13``)."""
+
+    @abstractmethod
+    def dehydrate(self, record: T, value_writer: "ValueWriter") -> None: ...
+
+
+class ValueWriter(ABC):
+    """Single-method callback (``ValueWriter.java:3-5``)."""
+
+    @abstractmethod
+    def write(self, name: str, value: Any) -> None: ...
+
+
+# ---------------------------------------------------------------------------
+# Function adapters (Python-idiomatic sugar; no reference counterpart needed)
+# ---------------------------------------------------------------------------
+
+class FnHydrator(Hydrator):
+    def __init__(self, start: Callable[[], Any], add: Callable[[Any, str, Any], Any],
+                 finish: Callable[[Any], Any]):
+        self._start, self._add, self._finish = start, add, finish
+
+    def start(self):
+        return self._start()
+
+    def add(self, target, heading, value):
+        return self._add(target, heading, value)
+
+    def finish(self, target):
+        return self._finish(target)
+
+
+class FnDehydrator(Dehydrator):
+    def __init__(self, fn: Callable[[Any, ValueWriter], None]):
+        self._fn = fn
+
+    def dehydrate(self, record, value_writer):
+        self._fn(record, value_writer)
+
+
+def dict_hydrator() -> Hydrator:
+    """Hydrate rows into plain dicts (common case; used by tests/benchmarks)."""
+    return FnHydrator(
+        start=dict,
+        add=lambda d, heading, value: (d.__setitem__(heading, value), d)[1],
+        finish=lambda d: d,
+    )
+
+
+def dict_dehydrator() -> Dehydrator:
+    """Dehydrate mapping records by writing every (key, value) pair."""
+
+    def fn(record, vw):
+        for k, v in record.items():
+            vw.write(k, v)
+
+    return FnDehydrator(fn)
+
+
+def supplier_of(obj) -> HydratorSupplier:
+    """Coerce a Hydrator / HydratorSupplier / callable into a supplier."""
+    if isinstance(obj, HydratorSupplier):
+        return obj
+    if isinstance(obj, Hydrator):
+        return HydratorSupplier.constantly(obj)
+    if callable(obj):
+        class _Fn(HydratorSupplier):
+            def get(self, columns):
+                return obj(columns)
+
+        return _Fn()
+    raise TypeError(f"cannot make a HydratorSupplier from {type(obj).__name__}")

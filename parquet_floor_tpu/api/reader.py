@@ -1,0 +1,256 @@
+"""Declarative streaming reader — L4 parity with the reference's
+``ParquetReader`` (``ParquetReader.java``), backed by the from-scratch
+columnar engine instead of parquet-mr.
+
+Parity surface (reference line cites):
+  * ``stream_content`` / ``iter_rows`` — ``streamContent`` (:47-61)
+  * iterator protocol + ``estimate_size`` — Spliterator (:176-227)
+  * ``read_metadata`` — (:109-117); ``metadata`` property — (:229-231)
+  * ``stream_content_to_strings`` debug reader — (:86-107)
+  * projection by top-level name only (:126-128); empty/None = all (:76)
+  * null iff def < max-def (:146,165-167); flat-only guard (:200-202)
+  * BINARY/FLBA/INT96 stringified via the type stringifier (:147-163)
+  * errors wrapped as RuntimeError("Failed to read parquet") (:209-211)
+
+The engine difference: rows here are served from decoded columnar batches
+(one row group at a time), not per-cell virtual dispatch — same laziness
+(a row group decodes only when iteration reaches it), TPU-shaped internals.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional, Sequence, Set
+
+import numpy as np
+
+from ..batch.columns import ColumnBatch, RowGroupBatch
+from ..format.encodings.plain import ByteArrayColumn
+from ..format.file_read import ParquetFileReader
+from ..format.metadata import ParquetMetadata
+from ..format.parquet_thrift import Type
+from ..format.schema import ColumnDescriptor
+from .hydrate import Hydrator, HydratorSupplier, supplier_of
+
+
+def read_metadata(source) -> ParquetMetadata:
+    """Footer-only read (``ParquetReader.readMetadata``, :109-117)."""
+    with ParquetFileReader(source) as r:
+        return r.metadata
+
+
+class _ColumnCursor:
+    """Per-column cursor over a decoded batch, serving API-typed cells."""
+
+    __slots__ = ("batch", "desc", "_stringify")
+
+    def __init__(self, batch: ColumnBatch):
+        self.batch = batch
+        self.desc = batch.descriptor
+        pt = self.desc.physical_type
+        self._stringify = pt in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY, Type.INT96)
+
+    def cell(self, i: int):
+        v = self.batch.cell(i)
+        if v is None:
+            return None
+        if self._stringify:
+            # Parity: BINARY/FLBA/INT96 stringified (ParquetReader.java:147-163)
+            if isinstance(v, np.ndarray):
+                v = v.tobytes()
+            return self.desc.primitive.stringify(v)
+        if isinstance(v, np.bool_):
+            return bool(v)
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, np.floating):
+            return float(v)
+        return v
+
+
+class ParquetReader:
+    """Streaming row reader; itself an iterator and a context manager."""
+
+    def __init__(self, source, hydrator_supplier, columns: Optional[Sequence[str]] = None):
+        self._reader = ParquetFileReader(source)
+        schema = self._reader.schema
+        selected: List[ColumnDescriptor] = [
+            c for c in schema.columns
+            if not columns or c.path[0] in set(columns)
+        ]
+        self.columns = selected
+        self._filter: Optional[Set[str]] = (
+            {c.path[0] for c in selected} if columns else None
+        )
+        self.hydrator: Hydrator = supplier_of(hydrator_supplier).get(selected)
+        self._rg_index = 0
+        self._row = 0
+        self._cursors: Optional[List[_ColumnCursor]] = None
+        self._rg_rows = 0
+        self._finished = False
+
+    # -- metadata ----------------------------------------------------------
+
+    @property
+    def metadata(self) -> ParquetMetadata:
+        """Open-reader footer access (``metaData()``, :229-231)."""
+        return self._reader.metadata
+
+    def estimate_size(self) -> int:
+        """Exact total row count from the footer (:219-222)."""
+        return self._reader.record_count
+
+    # -- iteration ---------------------------------------------------------
+
+    def _advance_row_group(self) -> bool:
+        while self._rg_index < len(self._reader.row_groups):
+            batch = self._reader.read_row_group(self._rg_index, self._filter)
+            self._rg_index += 1
+            ordered = []
+            by_name = {b.descriptor.path: b for b in batch.columns}
+            for desc in self.columns:
+                b = by_name.get(desc.path)
+                if b is None:
+                    raise ValueError(f"row group missing column {desc.path}")
+                ordered.append(_ColumnCursor(b))
+            for c in ordered:
+                # Flat-only guard, parity with IllegalStateException
+                # ("Unexpected repetition", ParquetReader.java:200-202).
+                if c.batch.rep_levels is not None and np.any(c.batch.rep_levels != 0):
+                    raise RuntimeError(
+                        "Failed to read parquet",
+                        ValueError("Unexpected repetition"),
+                    )
+            self._cursors = ordered
+            self._rg_rows = batch.num_rows
+            self._row = 0
+            if self._rg_rows > 0:
+                return True
+        self._finished = True
+        return False
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self):
+        try:
+            if self._finished:
+                raise StopIteration
+            if self._cursors is None or self._row >= self._rg_rows:
+                if not self._advance_row_group():
+                    raise StopIteration
+            h = self.hydrator
+            record = h.start()
+            i = self._row
+            for cursor in self._cursors:
+                record = h.add(record, cursor.desc.path[0], cursor.cell(i))
+            self._row += 1
+            return h.finish(record)
+        except StopIteration:
+            raise
+        except Exception as e:
+            # Parity: wrap iteration failures (ParquetReader.java:209-211).
+            raise RuntimeError("Failed to read parquet") from e
+
+    def close(self) -> None:
+        self._reader.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- batch access (native win; no reference counterpart) ---------------
+
+    def read_row_group_batch(self, index: int) -> RowGroupBatch:
+        return self._reader.read_row_group(index, self._filter)
+
+    # -- static factories (reference API verbs) ----------------------------
+
+    @staticmethod
+    def stream_content(source, hydrator_supplier, columns: Optional[Sequence[str]] = None):
+        """Stream hydrated records (``streamContent``, :47-61).
+
+        Returns an iterator that owns the file and closes it on exhaustion
+        or ``.close()`` (stream-close parity, :80-84).
+        """
+        reader = ParquetReader(source, hydrator_supplier, columns)
+        return _ClosingIterator(reader)
+
+    @staticmethod
+    def spliterator(source, hydrator_supplier, columns: Optional[Sequence[str]] = None
+                    ) -> "ParquetReader":
+        """The raw cursor object (``spliterator``, :63-78)."""
+        return ParquetReader(source, hydrator_supplier, columns)
+
+    @staticmethod
+    def read_metadata(source) -> ParquetMetadata:
+        return read_metadata(source)
+
+    @staticmethod
+    def stream_content_to_strings(source) -> Iterator[List[str]]:
+        """Debug reader: every row becomes ["name=value", ...] in column
+        order (``streamContentToStrings``, :86-107)."""
+
+        class _StringsHydrator(Hydrator):
+            def __init__(self, n):
+                self._n = n
+
+            def start(self):
+                return []
+
+            def add(self, target, heading, value):
+                target.append(f"{heading}={'null' if value is None else value}")
+                return target
+
+            def finish(self, target):
+                return target
+
+        def supplier(columns):
+            return _StringsHydrator(len(columns))
+
+        return ParquetReader.stream_content(source, supplier, None)
+
+
+class _ClosingIterator:
+    """Iterator wrapper that closes the reader when exhausted or closed.
+
+    Close failures during cleanup are suppressed (parity with
+    ``closeSilently``, :133-139) but real read errors propagate.
+    """
+
+    def __init__(self, reader: ParquetReader):
+        self._reader = reader
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._reader)
+        except StopIteration:
+            self.close()
+            raise
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            try:
+                self._reader.close()
+            except Exception:
+                pass
+
+    @property
+    def metadata(self) -> ParquetMetadata:
+        return self._reader.metadata
+
+    @property
+    def columns(self):
+        return self._reader.columns
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,142 @@
+"""Declarative writer — L4 parity with the reference's ``ParquetWriter``
+(``ParquetWriter.java``), buffering rows columnar and flushing row groups
+through the from-scratch engine.
+
+Parity surface:
+  * ``write_file`` static verb — ``writeFile`` (:26-55)
+  * instance ``write`` / ``close`` — (:70-77)
+  * pinned defaults SNAPPY + v2 pages — (:65-66)
+  * Dehydrator → ValueWriter(name, value) plumbing — (:108-135)
+  * per-field type switch accepting INT32/INT64/DOUBLE/BOOLEAN/FLOAT and
+    BINARY only when annotated as UTF-8 string; everything else rejected —
+    (:142-164).  The engine below supports more (bytes, FLBA, INT96,
+    nested), mirroring the reference's facade-strict/engine-capable split.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional
+
+import numpy as np
+
+from ..format.file_write import (
+    ColumnData,
+    ParquetFileWriter,
+    WriterOptions,
+    make_column_data,
+)
+from ..format.parquet_thrift import CompressionCodec, Type
+from ..format.schema import MessageType
+from .hydrate import Dehydrator, ValueWriter
+
+
+class _RowValueWriter(ValueWriter):
+    """Collects (name, value) pairs for the current row with the reference's
+    type-checking semantics (``writeField``, :142-164)."""
+
+    __slots__ = ("schema", "slots")
+
+    def __init__(self, schema: MessageType):
+        self.schema = schema
+        self.slots: Optional[list] = None
+
+    def write(self, name: str, value: Any) -> None:
+        idx = self.schema.field_index(name)  # name→index per call (parity :143)
+        field = self.schema.fields[idx]
+        pt = field.physical_type
+        if pt == Type.INT32 or pt == Type.INT64:
+            if isinstance(value, bool) or not isinstance(value, (int, np.integer)):
+                raise ValueError(self._type_error(field, value))
+        elif pt == Type.DOUBLE or pt == Type.FLOAT:
+            if not isinstance(value, (float, int, np.floating, np.integer)) or isinstance(value, bool):
+                raise ValueError(self._type_error(field, value))
+        elif pt == Type.BOOLEAN:
+            if not isinstance(value, (bool, np.bool_)):
+                raise ValueError(self._type_error(field, value))
+        elif pt == Type.BYTE_ARRAY:
+            lt = field.logical_type
+            if lt is None or lt.kind != "STRING" or not isinstance(value, str):
+                raise ValueError(self._type_error(field, value))
+        else:
+            raise ValueError(self._type_error(field, value))
+        self.slots[idx] = value
+
+    @staticmethod
+    def _type_error(field, value) -> str:
+        return (
+            f"Cannot write value of type {type(value).__name__} "
+            f"to field {field!r}"
+        )
+
+
+class ParquetWriter:
+    """Row-at-a-time writer over columnar row-group buffers."""
+
+    def __init__(self, schema: MessageType, dest, dehydrator: Dehydrator,
+                 options: Optional[WriterOptions] = None):
+        if not all(f.is_primitive for f in schema.fields):
+            raise ValueError("ParquetWriter facade supports flat schemas only")
+        # Pinned defaults: SNAPPY codec, v2 pages (parity :65-66).
+        self.options = options or WriterOptions(
+            codec=CompressionCodec.SNAPPY, page_version=2
+        )
+        self.schema = schema
+        self.dehydrator = dehydrator
+        self._writer = ParquetFileWriter(dest, schema, self.options)
+        self._vw = _RowValueWriter(schema)
+        self._buffer: List[list] = []
+        self._closed = False
+
+    def write(self, record: Any) -> None:
+        """Dehydrate and buffer one record (``write``, :70-72)."""
+        if self._closed:
+            raise ValueError("writer is closed")
+        self._vw.slots = [None] * len(self.schema.fields)
+        self.dehydrator.dehydrate(record, self._vw)
+        self._buffer.append(self._vw.slots)
+        self._vw.slots = None
+        if len(self._buffer) >= self.options.row_group_rows:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._buffer:
+            return
+        columns = []
+        rows = self._buffer
+        for i, desc in enumerate(self.schema.columns):
+            col = [row[i] for row in rows]
+            if desc.max_definition_level == 0 and any(v is None for v in col):
+                raise ValueError(
+                    f"required field {desc.path[0]!r} missing in some records"
+                )
+            columns.append(make_column_data(desc, col))
+        self._writer.write_row_group(columns)
+        self._buffer = []
+
+    def close(self) -> None:
+        if not self._closed:
+            self._flush()
+            self._writer.close()
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if exc[0] is None:
+            self.close()
+        else:
+            # don't finalize a footer over partial data, but release the file
+            self._closed = True
+            self._writer.abort()
+
+    # -- static verbs (reference API) --------------------------------------
+
+    @staticmethod
+    def write_file(schema: MessageType, dest, dehydrator: Dehydrator,
+                   records: Iterable[Any],
+                   options: Optional[WriterOptions] = None) -> None:
+        """Write all records and close (``writeFile``, :26-55)."""
+        with ParquetWriter(schema, dest, dehydrator, options) as w:
+            for r in records:
+                w.write(r)

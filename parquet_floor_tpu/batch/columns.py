@@ -1,0 +1,113 @@
+"""Columnar batch containers — the L3 materialization layer (SURVEY.md §1:
+"columnar batch materialization (arrays, not per-row events)").
+
+Where the reference surfaces one cell at a time through ``ColumnReader``
+getters (``ParquetReader.java:141-168``), this framework decodes whole row
+groups into arrays and serves both:
+  * per-row cursors for the Hydrator-parity API, and
+  * zero-copy columnar access for batch/TPU consumers (the native win).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Union
+
+import numpy as np
+
+from ..format.encodings.plain import ByteArrayColumn
+from ..format.parquet_thrift import Type
+from ..format.schema import ColumnDescriptor
+
+
+@dataclass
+class ColumnBatch:
+    """All values of one column across a row-group's pages.
+
+    ``values`` holds non-null leaf values only (length = count of
+    def_levels == max_def, or num_values for required columns).
+    """
+
+    descriptor: ColumnDescriptor
+    num_values: int  # total level count (rows for flat columns)
+    values: Union[np.ndarray, ByteArrayColumn]
+    def_levels: Optional[np.ndarray] = None
+    rep_levels: Optional[np.ndarray] = None
+
+    def __post_init__(self):
+        self._value_index = None
+
+    @property
+    def is_flat(self) -> bool:
+        return self.descriptor.max_repetition_level == 0
+
+    @property
+    def null_mask(self) -> Optional[np.ndarray]:
+        """True where the slot is null; None when column is required."""
+        if self.def_levels is None:
+            return None
+        return self.def_levels != self.descriptor.max_definition_level
+
+    def _ensure_value_index(self):
+        if self._value_index is None and self.def_levels is not None:
+            present = self.def_levels == self.descriptor.max_definition_level
+            self._value_index = np.cumsum(present) - 1
+        return self._value_index
+
+    def cell(self, i: int):
+        """Row-level access for flat columns; None when null.
+
+        Null semantics parity: a cell is null iff its definition level is
+        below the max (reference ``ParquetReader.java:146,165-167``).
+        """
+        if not self.is_flat:
+            raise ValueError("cell() requires a flat (non-repeated) column")
+        if self.def_levels is not None:
+            if self.def_levels[i] != self.descriptor.max_definition_level:
+                return None
+            vi = self._ensure_value_index()[i]
+        else:
+            vi = i
+        v = self.values[int(vi)]
+        return v
+
+    def dense(self, fill=None):
+        """Dense representation: (values_with_fill, null_mask) arrays.
+
+        Fixed-width types get a NumPy array with ``fill`` (or 0) in null
+        slots; BYTE_ARRAY gets a ByteArrayColumn with empty strings at null
+        slots.  This is the array that ships to the TPU.
+        """
+        mask = self.null_mask
+        if mask is None:
+            return self.values, None
+        n = self.num_values
+        if isinstance(self.values, ByteArrayColumn):
+            lengths = np.zeros(n, dtype=np.int64)
+            lengths[~mask] = self.values.lengths()
+            offsets = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum(lengths, out=offsets[1:])
+            return ByteArrayColumn(offsets, self.values.data.copy()), mask
+        if self.values.ndim == 2:  # FLBA / INT96 rows
+            out = np.zeros((n, self.values.shape[1]), dtype=self.values.dtype)
+            out[~mask] = self.values
+            return out, mask
+        out = np.zeros(n, dtype=self.values.dtype)
+        if fill is not None:
+            out[:] = fill
+        out[~mask] = self.values
+        return out, mask
+
+
+@dataclass
+class RowGroupBatch:
+    """Decoded columns of one row group, in schema (column) order."""
+
+    columns: List[ColumnBatch]
+    num_rows: int
+
+    def column(self, top_level_name: str) -> ColumnBatch:
+        for c in self.columns:
+            if c.descriptor.path[0] == top_level_name:
+                return c
+        raise KeyError(f"no column with top-level name {top_level_name!r}")

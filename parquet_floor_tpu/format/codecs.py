@@ -1,0 +1,189 @@
+"""Compression codec dispatch (read: any codec in the footer; write: any
+supported codec, SNAPPY pinned as the API default for parity with reference
+``ParquetWriter.java:65``).
+
+Replaces the reference's ``io.compress`` shim framework + JNI codec seam
+(SURVEY.md §2.2/§2.4): here codecs are plain functions ``bytes -> bytes``
+selected by the footer's codec id.  Snappy is first-party (C++ fast path via
+ctypes when built, pure-Python fallback — both from scratch); GZIP rides
+stdlib zlib; ZSTD is gated on the optional ``zstandard`` wheel.
+"""
+
+from __future__ import annotations
+
+import gzip as _gzip
+import io
+import zlib
+from typing import Callable, Dict, Optional, Tuple
+
+from . import snappy as _snappy_py
+from .parquet_thrift import CompressionCodec
+
+try:  # optional wheel; gated per environment policy
+    import zstandard as _zstd
+except ImportError:  # pragma: no cover
+    _zstd = None
+
+# C++ fast path (built from parquet_floor_tpu/native); optional.
+try:
+    from ..native import binding as _native
+except Exception:  # pragma: no cover - native lib is optional
+    _native = None
+
+
+class UnsupportedCodec(ValueError):
+    pass
+
+
+def _snappy_compress(data: bytes) -> bytes:
+    if _native is not None and _native.available():
+        return _native.snappy_compress(data)
+    return _snappy_py.compress(data)
+
+
+def _snappy_decompress(data: bytes, uncompressed_size: Optional[int] = None) -> bytes:
+    if _native is not None and _native.available():
+        return _native.snappy_decompress(data, uncompressed_size)
+    return _snappy_py.decompress(data)
+
+
+def _gzip_compress(data: bytes) -> bytes:
+    buf = io.BytesIO()
+    with _gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as f:
+        f.write(data)
+    return buf.getvalue()
+
+
+def _gzip_decompress(data: bytes, uncompressed_size=None) -> bytes:
+    # Accept both gzip-framed and raw zlib streams (readers must be liberal).
+    try:
+        return _gzip.decompress(data)
+    except OSError:
+        return zlib.decompress(data)
+
+
+def _zstd_compress(data: bytes) -> bytes:
+    if _zstd is None:
+        raise UnsupportedCodec("ZSTD codec requires the 'zstandard' package")
+    return _zstd.ZstdCompressor(level=3).compress(data)
+
+
+def _zstd_decompress(data: bytes, uncompressed_size=None) -> bytes:
+    if _zstd is None:
+        raise UnsupportedCodec("ZSTD codec requires the 'zstandard' package")
+    d = _zstd.ZstdDecompressor()
+    if uncompressed_size:
+        return d.decompress(data, max_output_size=uncompressed_size)
+    return d.decompress(data)
+
+
+def _lz4_raw_decompress(data: bytes, uncompressed_size=None) -> bytes:
+    """LZ4 raw block decode, implemented directly (no wheel available)."""
+    out = bytearray()
+    pos = 0
+    n = len(data)
+    while pos < n:
+        token = data[pos]
+        pos += 1
+        lit_len = token >> 4
+        if lit_len == 15:
+            while True:
+                b = data[pos]
+                pos += 1
+                lit_len += b
+                if b != 255:
+                    break
+        out += data[pos : pos + lit_len]
+        pos += lit_len
+        if pos >= n:
+            break  # last block ends with literals
+        offset = int.from_bytes(data[pos : pos + 2], "little")
+        pos += 2
+        if offset == 0:
+            raise ValueError("LZ4: zero offset")
+        mlen = token & 0xF
+        if mlen == 15:
+            while True:
+                b = data[pos]
+                pos += 1
+                mlen += b
+                if b != 255:
+                    break
+        mlen += 4
+        src = len(out) - offset
+        if src < 0:
+            raise ValueError("LZ4: offset out of range")
+        for _ in range(mlen):
+            out.append(out[src])
+            src += 1
+    return bytes(out)
+
+
+def _lz4_raw_compress(data: bytes) -> bytes:
+    """Valid LZ4 raw block: literals-only (correct, not space-optimal)."""
+    out = bytearray()
+    n = len(data)
+    lit_len = n
+    token_lit = 15 if lit_len >= 15 else lit_len
+    out.append(token_lit << 4)
+    if lit_len >= 15:
+        rem = lit_len - 15
+        while rem >= 255:
+            out.append(255)
+            rem -= 255
+        out.append(rem)
+    out += data
+    return bytes(out)
+
+
+_COMPRESSORS: Dict[int, Callable[[bytes], bytes]] = {
+    CompressionCodec.UNCOMPRESSED: lambda d: d,
+    CompressionCodec.SNAPPY: _snappy_compress,
+    CompressionCodec.GZIP: _gzip_compress,
+    CompressionCodec.ZSTD: _zstd_compress,
+    CompressionCodec.LZ4_RAW: _lz4_raw_compress,
+}
+
+_DECOMPRESSORS: Dict[int, Callable[..., bytes]] = {
+    CompressionCodec.UNCOMPRESSED: lambda d, s=None: bytes(d),
+    CompressionCodec.SNAPPY: _snappy_decompress,
+    CompressionCodec.GZIP: _gzip_decompress,
+    CompressionCodec.ZSTD: _zstd_decompress,
+    CompressionCodec.LZ4_RAW: _lz4_raw_decompress,
+}
+
+
+def compress(codec: int, data: bytes) -> bytes:
+    fn = _COMPRESSORS.get(codec)
+    if fn is None:
+        raise UnsupportedCodec(
+            f"no compressor for codec {CompressionCodec.name(codec)}"
+        )
+    return fn(bytes(data))
+
+
+def decompress(codec: int, data: bytes, uncompressed_size: Optional[int] = None) -> bytes:
+    fn = _DECOMPRESSORS.get(codec)
+    if fn is None:
+        raise UnsupportedCodec(
+            f"no decompressor for codec {CompressionCodec.name(codec)}"
+        )
+    out = fn(bytes(data), uncompressed_size)
+    if uncompressed_size is not None and len(out) != uncompressed_size:
+        raise ValueError(
+            f"{CompressionCodec.name(codec)}: decompressed {len(out)} bytes, "
+            f"footer said {uncompressed_size}"
+        )
+    return out
+
+
+def supported_codecs() -> Tuple[int, ...]:
+    base = [
+        CompressionCodec.UNCOMPRESSED,
+        CompressionCodec.SNAPPY,
+        CompressionCodec.GZIP,
+        CompressionCodec.LZ4_RAW,
+    ]
+    if _zstd is not None:
+        base.append(CompressionCodec.ZSTD)
+    return tuple(base)

@@ -1,0 +1,181 @@
+"""DELTA_BINARY_PACKED / DELTA_LENGTH_BYTE_ARRAY / DELTA_BYTE_ARRAY.
+
+The v2 integer/binary encodings that PARQUET_2_0 writers (the reference pins
+v2 at ``ParquetWriter.java:66``) may emit and every reader must handle.
+NumPy reference implementation; arithmetic is two's-complement wraparound in
+uint64 (matching parquet-mr's long arithmetic), so the full int64 delta range
+round-trips bit-exactly.
+
+Wire format (Parquet spec "Delta encoding")::
+
+    header  := block_size varint | miniblocks_per_block varint
+             | total_count varint | first_value zigzag
+    block   := min_delta zigzag | bit_width byte * miniblocks
+             | miniblock-packed deltas (delta - min_delta, LSB-first)
+
+Standard geometry (also what we write): block 128, 4 miniblocks × 32 values.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from .plain import ByteArrayColumn
+from .rle_hybrid import bit_pack, bit_unpack, _read_varint, _write_varint
+
+_BLOCK = 128
+_MINIBLOCKS = 4
+_PER_MINIBLOCK = _BLOCK // _MINIBLOCKS
+
+
+def _read_zigzag(buf, pos):
+    v, pos = _read_varint(buf, pos)
+    return (v >> 1) ^ -(v & 1), pos
+
+
+def _write_zigzag(out, n):
+    _write_varint(out, ((n << 1) ^ (n >> 63)) & 0xFFFFFFFFFFFFFFFF if n < 0 else n << 1)
+
+
+def decode_delta_binary_packed(data, pos: int = 0, out_dtype=np.int64):
+    """Decode one DELTA_BINARY_PACKED stream; returns (values, end_pos)."""
+    block_size, pos = _read_varint(data, pos)
+    n_mini, pos = _read_varint(data, pos)
+    total, pos = _read_varint(data, pos)
+    first, pos = _read_zigzag(data, pos)
+    if total == 0:
+        return np.zeros(0, dtype=out_dtype), pos
+    if n_mini == 0 or block_size % n_mini:
+        raise ValueError("bad DELTA_BINARY_PACKED geometry")
+    per_mini = block_size // n_mini
+
+    n_deltas = total - 1
+    deltas = np.empty(n_deltas, dtype=np.uint64)
+    got = 0
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    while got < n_deltas:
+        min_delta, pos = _read_zigzag(data, pos)
+        widths = bytes(data[pos : pos + n_mini])
+        pos += n_mini
+        md = np.uint64(min_delta & 0xFFFFFFFFFFFFFFFF)
+        for m in range(n_mini):
+            if got >= n_deltas:
+                break
+            bw = widths[m]
+            nbytes = per_mini * bw // 8
+            take = min(per_mini, n_deltas - got)
+            if bw == 0:
+                vals = np.zeros(take, dtype=np.uint64)
+            else:
+                vals = bit_unpack(buf[pos : pos + nbytes], bw, per_mini)[:take]
+            deltas[got : got + take] = vals + md  # wraps in uint64
+            got += take
+            pos += nbytes
+
+    acc = np.empty(total, dtype=np.uint64)
+    acc[0] = np.uint64(first & 0xFFFFFFFFFFFFFFFF)
+    if n_deltas:
+        np.cumsum(deltas, out=acc[1:])
+        acc[1:] += acc[0]
+    signed = acc.view(np.int64)
+    if out_dtype == np.int32:
+        return (acc & np.uint64(0xFFFFFFFF)).astype(np.uint32).view(np.int32), pos
+    return signed.copy(), pos
+
+
+def encode_delta_binary_packed(values: np.ndarray) -> bytes:
+    """Encode int32/int64 values with standard 128/4 geometry."""
+    v = np.asarray(values)
+    v64 = v.astype(np.int64, copy=False).view(np.uint64)
+    n = len(v64)
+    out = bytearray()
+    _write_varint(out, _BLOCK)
+    _write_varint(out, _MINIBLOCKS)
+    _write_varint(out, n)
+    _write_zigzag(out, int(v64[0].view(np.int64)) if n else 0)
+    if n <= 1:
+        return bytes(out)
+    deltas = (v64[1:] - v64[:-1])  # wraparound uint64
+    n_deltas = len(deltas)
+    for b0 in range(0, n_deltas, _BLOCK):
+        block = deltas[b0 : b0 + _BLOCK]
+        sblock = block.view(np.int64)
+        min_delta = int(sblock.min())
+        _write_zigzag(out, min_delta)
+        adj = block - np.uint64(min_delta & 0xFFFFFFFFFFFFFFFF)
+        widths = []
+        packed_parts = []
+        for m in range(_MINIBLOCKS):
+            mb = adj[m * _PER_MINIBLOCK : (m + 1) * _PER_MINIBLOCK]
+            if len(mb) == 0:
+                widths.append(0)
+                packed_parts.append(b"")
+                continue
+            maxv = int(mb.max())
+            bw = maxv.bit_length()
+            widths.append(bw)
+            if bw == 0:
+                packed_parts.append(b"")
+                continue
+            full = np.zeros(_PER_MINIBLOCK, dtype=np.uint64)
+            full[: len(mb)] = mb
+            packed_parts.append(bit_pack(full, bw))
+        out.extend(bytes(widths))
+        for p in packed_parts:
+            out.extend(p)
+    return bytes(out)
+
+
+def decode_delta_length_byte_array(data, pos: int = 0) -> Tuple[ByteArrayColumn, int]:
+    lengths, pos = decode_delta_binary_packed(data, pos)
+    lengths = lengths.astype(np.int64)
+    n = len(lengths)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lengths, out=offsets[1:])
+    total = int(offsets[-1])
+    pool = np.frombuffer(data, dtype=np.uint8, count=total, offset=pos).copy() if total else np.zeros(0, np.uint8)
+    return ByteArrayColumn(offsets, pool), pos + total
+
+
+def encode_delta_length_byte_array(col: ByteArrayColumn) -> bytes:
+    lengths = col.lengths().astype(np.int32)
+    return encode_delta_binary_packed(lengths) + col.data.tobytes()
+
+
+def decode_delta_byte_array(data, pos: int = 0) -> Tuple[ByteArrayColumn, int]:
+    """Incremental (front-coded) binary: shared prefix lengths + suffixes."""
+    prefix_lens, pos = decode_delta_binary_packed(data, pos)
+    suffixes, pos = decode_delta_length_byte_array(data, pos)
+    n = len(prefix_lens)
+    if n != len(suffixes):
+        raise ValueError("DELTA_BYTE_ARRAY prefix/suffix count mismatch")
+    values = []
+    prev = b""
+    sdata = suffixes.data.tobytes()
+    soff = suffixes.offsets
+    for i in range(n):
+        cur = prev[: prefix_lens[i]] + sdata[soff[i] : soff[i + 1]]
+        values.append(cur)
+        prev = cur
+    return ByteArrayColumn.from_list(values), pos
+
+
+def encode_delta_byte_array(col: ByteArrayColumn) -> bytes:
+    values = col.to_list()
+    n = len(values)
+    prefix_lens = np.zeros(n, dtype=np.int32)
+    suffixes = []
+    prev = b""
+    for i, cur in enumerate(values):
+        k = 0
+        m = min(len(prev), len(cur))
+        while k < m and prev[k] == cur[k]:
+            k += 1
+        prefix_lens[i] = k
+        suffixes.append(cur[k:])
+        prev = cur
+    return encode_delta_binary_packed(prefix_lens) + encode_delta_length_byte_array(
+        ByteArrayColumn.from_list(suffixes)
+    )

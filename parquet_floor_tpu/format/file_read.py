@@ -1,0 +1,191 @@
+"""ParquetFileReader: the from-scratch engine replacing parquet-mr's
+``ParquetFileReader.open/getFooter/readNextRowGroup/getRecordCount``
+(reference call sites ``ParquetReader.java:114-120,183,221``).
+
+Row-group streaming (one group materialized at a time — parity with the
+reference's lazy ``tryAdvance`` pull at ``ParquetReader.java:182-194``), but
+each group decodes **columnar**: all pages of a chunk decode into arrays in
+one pass instead of per-cell virtual dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Set
+
+import numpy as np
+
+from ..batch.columns import ColumnBatch, RowGroupBatch
+from ..io.source import FileSource
+from . import pages as pg
+from .encodings.plain import ByteArrayColumn
+from .metadata import MAGIC, ParquetMetadata, read_footer
+from .parquet_thrift import ColumnChunk, ColumnMetaData, PageType, RowGroup
+from .schema import ColumnDescriptor
+
+
+def _chunk_byte_range(meta: ColumnMetaData):
+    start = meta.data_page_offset
+    if meta.dictionary_page_offset is not None and meta.dictionary_page_offset > 0:
+        start = min(start, meta.dictionary_page_offset)
+    return start, meta.total_compressed_size
+
+
+def _empty_values(desc: ColumnDescriptor):
+    """Typed empty value container for a zero-value chunk."""
+    from .parquet_thrift import Type as _T
+
+    pt = desc.physical_type
+    if pt == _T.BYTE_ARRAY:
+        return ByteArrayColumn(np.zeros(1, np.int64), np.zeros(0, np.uint8))
+    if pt == _T.BOOLEAN:
+        return np.zeros(0, np.bool_)
+    if pt == _T.INT32:
+        return np.zeros(0, np.int32)
+    if pt == _T.INT64:
+        return np.zeros(0, np.int64)
+    if pt == _T.FLOAT:
+        return np.zeros(0, np.float32)
+    if pt == _T.DOUBLE:
+        return np.zeros(0, np.float64)
+    width = desc.type_length if pt == _T.FIXED_LEN_BYTE_ARRAY else 12
+    return np.zeros((0, width), np.uint8)
+
+
+def _concat_values(parts):
+    if not parts:
+        raise ValueError("no pages decoded")
+    if len(parts) == 1:
+        return parts[0]
+    if isinstance(parts[0], ByteArrayColumn):
+        pools = [p.data for p in parts]
+        offs = [parts[0].offsets]
+        base = parts[0].offsets[-1]
+        for p in parts[1:]:
+            offs.append(p.offsets[1:] + base)
+            base = base + p.offsets[-1]
+        return ByteArrayColumn(np.concatenate(offs), np.concatenate(pools))
+    return np.concatenate(parts)
+
+
+class ParquetFileReader:
+    """Open a parquet file, expose footer + per-row-group columnar decode."""
+
+    def __init__(self, source, verify_crc: bool = False):
+        self.source = source if isinstance(source, FileSource) else FileSource(source)
+        self.metadata: ParquetMetadata = read_footer(self.source)
+        self.schema = self.metadata.schema
+        self.verify_crc = verify_crc
+        self._closed = False
+
+    # -- parity surface ----------------------------------------------------
+
+    @property
+    def record_count(self) -> int:
+        """Total rows from the footer (``getRecordCount`` parity,
+        ``ParquetReader.java:219-222``)."""
+        return self.metadata.num_rows
+
+    @property
+    def row_groups(self) -> List[RowGroup]:
+        return self.metadata.row_groups
+
+    def close(self) -> None:
+        if not self._closed:
+            self.source.close()
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- decode ------------------------------------------------------------
+
+    def _descriptor_for(self, chunk: ColumnChunk) -> ColumnDescriptor:
+        path = tuple(chunk.meta_data.path_in_schema)
+        return self.schema.column(path)
+
+    def read_column_chunk(self, chunk: ColumnChunk) -> ColumnBatch:
+        meta = chunk.meta_data
+        if meta is None:
+            raise ValueError("column chunk without inline metadata")
+        if chunk.file_path:
+            raise ValueError("external column chunk files are not supported")
+        desc = self._descriptor_for(chunk)
+        start, length = _chunk_byte_range(meta)
+        raw = self.source.read_at(start, length)
+        raw_pages = pg.split_pages(raw, meta.num_values)
+        dictionary = None
+        decoded: List[pg.DecodedPage] = []
+        for page in raw_pages:
+            if page.page_type == PageType.DICTIONARY_PAGE:
+                if dictionary is not None:
+                    raise ValueError("multiple dictionary pages in one chunk")
+                dictionary = pg.decode_dictionary_page(
+                    page, desc, meta.codec, self.verify_crc
+                )
+            elif page.page_type in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+                decoded.append(
+                    pg.decode_data_page(page, desc, meta.codec, dictionary, self.verify_crc)
+                )
+            elif page.page_type == PageType.INDEX_PAGE:
+                continue
+            else:
+                raise ValueError(f"unknown page type {page.page_type}")
+        total = sum(d.num_values for d in decoded)
+        if total != meta.num_values:
+            raise ValueError(
+                f"chunk decoded {total} values, footer said {meta.num_values}"
+            )
+        if not decoded:  # zero-row row group: valid, just empty
+            empty_levels = (
+                np.zeros(0, np.uint32) if desc.max_definition_level > 0 else None
+            )
+            return ColumnBatch(
+                desc, 0, _empty_values(desc), empty_levels,
+                np.zeros(0, np.uint32) if desc.max_repetition_level > 0 else None,
+            )
+        values = _concat_values([d.values for d in decoded])
+        def_levels = (
+            np.concatenate([d.def_levels for d in decoded])
+            if decoded and decoded[0].def_levels is not None
+            else None
+        )
+        rep_levels = (
+            np.concatenate([d.rep_levels for d in decoded])
+            if decoded and decoded[0].rep_levels is not None
+            else None
+        )
+        return ColumnBatch(desc, meta.num_values, values, def_levels, rep_levels)
+
+    def read_row_group(
+        self, index: int, column_filter: Optional[Set[str]] = None
+    ) -> RowGroupBatch:
+        """Decode one row group into columnar batches.
+
+        ``column_filter`` projects by **top-level field name** — exactly the
+        reference's projection semantics (``ParquetReader.java:126-128``);
+        None or empty means all columns (``ParquetReader.java:76``).
+        """
+        rg = self.row_groups[index]
+        batches = []
+        for chunk in rg.columns or []:
+            path0 = chunk.meta_data.path_in_schema[0]
+            if column_filter and path0 not in column_filter:
+                continue
+            batches.append(self.read_column_chunk(chunk))
+        return RowGroupBatch(batches, rg.num_rows or 0)
+
+    def iter_row_groups(
+        self, column_filter: Optional[Set[str]] = None
+    ) -> Iterator[RowGroupBatch]:
+        for i in range(len(self.row_groups)):
+            yield self.read_row_group(i, column_filter)
+
+    def read_raw_column_chunk(self, chunk: ColumnChunk):
+        """Raw page payloads + headers for a chunk (TPU engine feedstock)."""
+        meta = chunk.meta_data
+        start, length = _chunk_byte_range(meta)
+        raw = self.source.read_at(start, length)
+        return pg.split_pages(raw, meta.num_values)

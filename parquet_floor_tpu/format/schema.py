@@ -1,0 +1,642 @@
+"""Schema tree: the TPU-native equivalent of parquet-mr's ``MessageType`` /
+``Types`` DSL / ``ColumnDescriptor`` surface that the reference leaks into its
+API (reference ``ParquetReader.java:59``, ``HydratorSupplier.java:3,15``,
+``ParquetWriter.java:26``, DSL use at ``ParquetReadWriteTest.java:32-35``).
+
+A schema is a tree of :class:`GroupType`/:class:`PrimitiveType` nodes rooted at
+a :class:`MessageType`.  Leaves flatten into :class:`ColumnDescriptor`s with
+Dremel max definition/repetition levels.  The ``types`` builder namespace
+mirrors the reference's fluent DSL (``Types.required(INT64).named("id")``)
+in idiomatic Python.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from .parquet_thrift import (
+    ConvertedType,
+    FieldRepetitionType,
+    LogicalType,
+    SchemaElement,
+    Type,
+)
+from . import parquet_thrift as pt
+
+REQUIRED = FieldRepetitionType.REQUIRED
+OPTIONAL = FieldRepetitionType.OPTIONAL
+REPEATED = FieldRepetitionType.REPEATED
+
+
+# ---------------------------------------------------------------------------
+# Logical type annotations (user-facing, mapped to thrift LogicalType +
+# legacy ConvertedType on serialization)
+# ---------------------------------------------------------------------------
+
+class LogicalAnnotation:
+    """User-facing logical type annotation.
+
+    ``kind`` is one of STRING/ENUM/JSON/BSON/UUID/DECIMAL/DATE/TIME/TIMESTAMP/
+    INTEGER/MAP/LIST/UNKNOWN/FLOAT16 with optional params.
+    """
+
+    __slots__ = ("kind", "params")
+
+    def __init__(self, kind: str, **params):
+        self.kind = kind
+        self.params = params
+
+    def __repr__(self):
+        if self.params:
+            inner = ", ".join(f"{k}={v}" for k, v in self.params.items())
+            return f"{self.kind}({inner})"
+        return self.kind
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, LogicalAnnotation)
+            and self.kind == other.kind
+            and self.params == other.params
+        )
+
+    def __hash__(self):
+        return hash((self.kind, tuple(sorted(self.params.items()))))
+
+    # --- thrift conversion -------------------------------------------------
+
+    def to_thrift(self) -> LogicalType:
+        lt = LogicalType()
+        k, p = self.kind, self.params
+        if k == "STRING":
+            lt.STRING = pt.StringType()
+        elif k == "MAP":
+            lt.MAP = pt.MapType()
+        elif k == "LIST":
+            lt.LIST = pt.ListType()
+        elif k == "ENUM":
+            lt.ENUM = pt.EnumType()
+        elif k == "DECIMAL":
+            lt.DECIMAL = pt.DecimalType(scale=p.get("scale", 0), precision=p["precision"])
+        elif k == "DATE":
+            lt.DATE = pt.DateType()
+        elif k == "TIME":
+            lt.TIME = pt.TimeType(
+                isAdjustedToUTC=p.get("utc", True), unit=_time_unit(p.get("unit", "MICROS"))
+            )
+        elif k == "TIMESTAMP":
+            lt.TIMESTAMP = pt.TimestampType(
+                isAdjustedToUTC=p.get("utc", True), unit=_time_unit(p.get("unit", "MICROS"))
+            )
+        elif k == "INTEGER":
+            lt.INTEGER = pt.IntType(
+                bitWidth=p.get("bit_width", 32), isSigned=p.get("signed", True)
+            )
+        elif k == "UNKNOWN":
+            lt.UNKNOWN = pt.NullType()
+        elif k == "JSON":
+            lt.JSON = pt.JsonType()
+        elif k == "BSON":
+            lt.BSON = pt.BsonType()
+        elif k == "UUID":
+            lt.UUID = pt.UUIDType()
+        elif k == "FLOAT16":
+            lt.FLOAT16 = pt.Float16Type()
+        else:
+            raise ValueError(f"unknown logical annotation {k}")
+        return lt
+
+    @classmethod
+    def from_thrift(cls, lt: Optional[LogicalType]) -> Optional["LogicalAnnotation"]:
+        if lt is None:
+            return None
+        name, v = lt.set_member()
+        if name is None:
+            return None
+        if name == "DECIMAL":
+            return cls("DECIMAL", scale=v.scale or 0, precision=v.precision)
+        if name in ("TIME", "TIMESTAMP"):
+            unit = "MICROS"
+            if v.unit is not None:
+                uname, _ = v.unit.set_member()
+                unit = uname or "MICROS"
+            return cls(name, utc=bool(v.isAdjustedToUTC), unit=unit)
+        if name == "INTEGER":
+            return cls("INTEGER", bit_width=v.bitWidth, signed=bool(v.isSigned))
+        return cls(name)
+
+    @classmethod
+    def from_converted(cls, ct: Optional[int], scale=None, precision=None):
+        """Map legacy ConvertedType to an annotation (for old files)."""
+        if ct is None:
+            return None
+        m = {
+            ConvertedType.UTF8: cls("STRING"),
+            ConvertedType.ENUM: cls("ENUM"),
+            ConvertedType.JSON: cls("JSON"),
+            ConvertedType.BSON: cls("BSON"),
+            ConvertedType.DATE: cls("DATE"),
+            ConvertedType.MAP: cls("MAP"),
+            ConvertedType.LIST: cls("LIST"),
+            ConvertedType.TIME_MILLIS: cls("TIME", utc=True, unit="MILLIS"),
+            ConvertedType.TIME_MICROS: cls("TIME", utc=True, unit="MICROS"),
+            ConvertedType.TIMESTAMP_MILLIS: cls("TIMESTAMP", utc=True, unit="MILLIS"),
+            ConvertedType.TIMESTAMP_MICROS: cls("TIMESTAMP", utc=True, unit="MICROS"),
+            ConvertedType.INT_8: cls("INTEGER", bit_width=8, signed=True),
+            ConvertedType.INT_16: cls("INTEGER", bit_width=16, signed=True),
+            ConvertedType.INT_32: cls("INTEGER", bit_width=32, signed=True),
+            ConvertedType.INT_64: cls("INTEGER", bit_width=64, signed=True),
+            ConvertedType.UINT_8: cls("INTEGER", bit_width=8, signed=False),
+            ConvertedType.UINT_16: cls("INTEGER", bit_width=16, signed=False),
+            ConvertedType.UINT_32: cls("INTEGER", bit_width=32, signed=False),
+            ConvertedType.UINT_64: cls("INTEGER", bit_width=64, signed=False),
+        }
+        if ct == ConvertedType.DECIMAL:
+            return cls("DECIMAL", scale=scale or 0, precision=precision or 0)
+        return m.get(ct)
+
+    def to_converted(self) -> Optional[int]:
+        k, p = self.kind, self.params
+        m = {
+            "STRING": ConvertedType.UTF8,
+            "ENUM": ConvertedType.ENUM,
+            "JSON": ConvertedType.JSON,
+            "BSON": ConvertedType.BSON,
+            "DATE": ConvertedType.DATE,
+            "MAP": ConvertedType.MAP,
+            "LIST": ConvertedType.LIST,
+            "DECIMAL": ConvertedType.DECIMAL,
+        }
+        if k in m:
+            return m[k]
+        if k == "TIME":
+            return (
+                ConvertedType.TIME_MILLIS
+                if p.get("unit") == "MILLIS"
+                else ConvertedType.TIME_MICROS if p.get("unit") == "MICROS" else None
+            )
+        if k == "TIMESTAMP":
+            return (
+                ConvertedType.TIMESTAMP_MILLIS
+                if p.get("unit") == "MILLIS"
+                else ConvertedType.TIMESTAMP_MICROS if p.get("unit") == "MICROS" else None
+            )
+        if k == "INTEGER":
+            signed = p.get("signed", True)
+            bw = p.get("bit_width", 32)
+            table = {
+                (8, True): ConvertedType.INT_8, (16, True): ConvertedType.INT_16,
+                (32, True): ConvertedType.INT_32, (64, True): ConvertedType.INT_64,
+                (8, False): ConvertedType.UINT_8, (16, False): ConvertedType.UINT_16,
+                (32, False): ConvertedType.UINT_32, (64, False): ConvertedType.UINT_64,
+            }
+            return table.get((bw, signed))
+        return None
+
+
+def _time_unit(unit: str) -> pt.TimeUnit:
+    tu = pt.TimeUnit()
+    if unit == "MILLIS":
+        tu.MILLIS = pt.MilliSeconds()
+    elif unit == "MICROS":
+        tu.MICROS = pt.MicroSeconds()
+    elif unit == "NANOS":
+        tu.NANOS = pt.NanoSeconds()
+    else:
+        raise ValueError(f"unknown time unit {unit}")
+    return tu
+
+
+string_type = lambda: LogicalAnnotation("STRING")  # noqa: E731  (DSL parity helper)
+
+
+# ---------------------------------------------------------------------------
+# Schema nodes
+# ---------------------------------------------------------------------------
+
+class SchemaNode:
+    __slots__ = ("name", "repetition", "logical_type", "field_id")
+
+    def __init__(self, name, repetition, logical_type=None, field_id=None):
+        self.name = name
+        self.repetition = repetition
+        self.logical_type = logical_type
+        self.field_id = field_id
+
+    @property
+    def is_primitive(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def is_optional(self):
+        return self.repetition == OPTIONAL
+
+    @property
+    def is_repeated(self):
+        return self.repetition == REPEATED
+
+
+class PrimitiveType(SchemaNode):
+    __slots__ = ("physical_type", "type_length")
+
+    def __init__(self, name, physical_type, repetition=REQUIRED, logical_type=None,
+                 type_length=None, field_id=None):
+        super().__init__(name, repetition, logical_type, field_id)
+        self.physical_type = physical_type
+        self.type_length = type_length
+        if physical_type == Type.FIXED_LEN_BYTE_ARRAY and not type_length:
+            raise ValueError("FIXED_LEN_BYTE_ARRAY requires type_length")
+
+    @property
+    def is_primitive(self):
+        return True
+
+    def __repr__(self):
+        lt = f" ({self.logical_type})" if self.logical_type else ""
+        return (
+            f"{FieldRepetitionType.name(self.repetition).lower()} "
+            f"{Type.name(self.physical_type).lower()} {self.name}{lt}"
+        )
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, PrimitiveType)
+            and self.name == other.name
+            and self.physical_type == other.physical_type
+            and self.repetition == other.repetition
+            and self.logical_type == other.logical_type
+            and self.type_length == other.type_length
+        )
+
+    def __hash__(self):
+        return hash((self.name, self.physical_type, self.repetition))
+
+    def stringify(self, value) -> str:
+        """Debug stringifier; parity with per-type ``stringifier()`` used at
+        reference ``ParquetReader.java:147-163``."""
+        if value is None:
+            return "null"
+        if self.physical_type in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
+            if isinstance(value, bytes):
+                lt = self.logical_type
+                if lt is not None and lt.kind in ("STRING", "ENUM", "JSON"):
+                    return value.decode("utf-8", errors="replace")
+                return "0x" + value.hex().upper()
+            return str(value)
+        if self.physical_type == Type.INT96:
+            if isinstance(value, bytes):
+                return "0x" + value.hex().upper()
+            return str(value)
+        if self.physical_type == Type.BOOLEAN:
+            return "true" if value else "false"
+        return str(value)
+
+
+class GroupType(SchemaNode):
+    __slots__ = ("fields", "_index")
+
+    def __init__(self, name, fields: Sequence[SchemaNode], repetition=REQUIRED,
+                 logical_type=None, field_id=None):
+        super().__init__(name, repetition, logical_type, field_id)
+        self.fields = list(fields)
+        names = [f.name for f in self.fields]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate field names in group {name!r}")
+        self._index = {f.name: i for i, f in enumerate(self.fields)}
+
+    @property
+    def is_primitive(self):
+        return False
+
+    def field_index(self, name: str) -> int:
+        """Name→index lookup (parity: ``schema.getFieldIndex`` used per write
+        at reference ``ParquetWriter.java:143``)."""
+        try:
+            return self._index[name]
+        except KeyError:
+            raise KeyError(f"field {name!r} not found in group {self.name!r}") from None
+
+    def field(self, name: str) -> SchemaNode:
+        return self.fields[self.field_index(name)]
+
+    def __contains__(self, name):
+        return name in self._index
+
+    def __repr__(self):
+        inner = "; ".join(repr(f) for f in self.fields)
+        return (
+            f"{FieldRepetitionType.name(self.repetition).lower()} group "
+            f"{self.name} {{ {inner} }}"
+        )
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, GroupType)
+            and self.name == other.name
+            and self.repetition == other.repetition
+            and self.logical_type == other.logical_type
+            and self.fields == other.fields
+        )
+
+    def __hash__(self):
+        return hash((self.name, self.repetition, len(self.fields)))
+
+
+class ColumnDescriptor:
+    """A flattened leaf: dotted path + Dremel levels + primitive type.
+
+    Parity with parquet-mr's ``ColumnDescriptor`` that the reference hands to
+    ``HydratorSupplier.get`` (reference ``HydratorSupplier.java:10-15``) and
+    uses for projection by ``path[0]`` (``ParquetReader.java:126-128``).
+    """
+
+    __slots__ = ("path", "primitive", "max_definition_level", "max_repetition_level")
+
+    def __init__(self, path: Tuple[str, ...], primitive: PrimitiveType,
+                 max_definition_level: int, max_repetition_level: int):
+        self.path = tuple(path)
+        self.primitive = primitive
+        self.max_definition_level = max_definition_level
+        self.max_repetition_level = max_repetition_level
+
+    @property
+    def physical_type(self):
+        return self.primitive.physical_type
+
+    @property
+    def type_length(self):
+        return self.primitive.type_length
+
+    @property
+    def logical_type(self):
+        return self.primitive.logical_type
+
+    def __repr__(self):
+        return (
+            f"ColumnDescriptor({'.'.join(self.path)}: "
+            f"{Type.name(self.primitive.physical_type)}, "
+            f"d={self.max_definition_level}, r={self.max_repetition_level})"
+        )
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ColumnDescriptor)
+            and self.path == other.path
+            and self.primitive == other.primitive
+            and self.max_definition_level == other.max_definition_level
+            and self.max_repetition_level == other.max_repetition_level
+        )
+
+    def __hash__(self):
+        return hash(self.path)
+
+
+class MessageType(GroupType):
+    """Root of a schema tree."""
+
+    __slots__ = ("_columns",)
+
+    def __init__(self, name: str, fields: Sequence[SchemaNode]):
+        super().__init__(name, fields, repetition=REQUIRED)
+        self._columns = None
+
+    @property
+    def columns(self) -> List[ColumnDescriptor]:
+        if self._columns is None:
+            cols = []
+
+            def walk(node: SchemaNode, path, max_def, max_rep):
+                if node.is_optional:
+                    max_def += 1
+                elif node.is_repeated:
+                    max_def += 1
+                    max_rep += 1
+                if node.is_primitive:
+                    cols.append(
+                        ColumnDescriptor(path + (node.name,), node, max_def, max_rep)
+                    )
+                else:
+                    for f in node.fields:
+                        walk(f, path + (node.name,), max_def, max_rep)
+
+            for f in self.fields:
+                walk(f, (), 0, 0)
+            self._columns = cols
+        return self._columns
+
+    def column(self, path) -> ColumnDescriptor:
+        if isinstance(path, str):
+            path = tuple(path.split("."))
+        for c in self.columns:
+            if c.path == tuple(path):
+                return c
+        raise KeyError(f"no column {path!r} in schema {self.name!r}")
+
+    @property
+    def is_flat(self) -> bool:
+        """True when all fields are non-repeated primitives (the only shape
+        the reference facade accepts — ``ParquetReader.java:200-202``)."""
+        return all(f.is_primitive and not f.is_repeated for f in self.fields)
+
+    def __repr__(self):
+        inner = "; ".join(repr(f) for f in self.fields)
+        return f"message {self.name} {{ {inner} }}"
+
+    # --- thrift (de)serialization -----------------------------------------
+
+    def to_thrift(self) -> List[SchemaElement]:
+        out = [SchemaElement(name=self.name, num_children=len(self.fields))]
+
+        def emit(node: SchemaNode):
+            el = SchemaElement(name=node.name, repetition_type=node.repetition)
+            if node.field_id is not None:
+                el.field_id = node.field_id
+            if node.logical_type is not None:
+                el.logicalType = node.logical_type.to_thrift()
+                el.converted_type = node.logical_type.to_converted()
+                if node.logical_type.kind == "DECIMAL":
+                    el.scale = node.logical_type.params.get("scale", 0)
+                    el.precision = node.logical_type.params.get("precision", 0)
+            if node.is_primitive:
+                el.type = node.physical_type
+                if node.type_length:
+                    el.type_length = node.type_length
+                out.append(el)
+            else:
+                el.num_children = len(node.fields)
+                out.append(el)
+                for f in node.fields:
+                    emit(f)
+
+        for f in self.fields:
+            emit(f)
+        return out
+
+    @classmethod
+    def from_thrift(cls, elements: Sequence[SchemaElement]) -> "MessageType":
+        if not elements:
+            raise ValueError("empty schema element list")
+        pos = [1]
+
+        def parse_node() -> SchemaNode:
+            el = elements[pos[0]]
+            pos[0] += 1
+            lt = LogicalAnnotation.from_thrift(el.logicalType)
+            if lt is None:
+                lt = LogicalAnnotation.from_converted(el.converted_type, el.scale, el.precision)
+            rep = el.repetition_type if el.repetition_type is not None else REQUIRED
+            if el.num_children:
+                children = [parse_node() for _ in range(el.num_children)]
+                return GroupType(el.name, children, repetition=rep, logical_type=lt,
+                                 field_id=el.field_id)
+            return PrimitiveType(
+                el.name, el.type, repetition=rep, logical_type=lt,
+                type_length=el.type_length, field_id=el.field_id,
+            )
+
+        root = elements[0]
+        fields = [parse_node() for _ in range(root.num_children or 0)]
+        if pos[0] != len(elements):
+            raise ValueError("trailing schema elements after root tree")
+        return cls(root.name or "schema", fields)
+
+
+# ---------------------------------------------------------------------------
+# Builder DSL — parity with parquet-mr's Types DSL used by the reference test
+# (reference ParquetReadWriteTest.java:32-35):
+#
+#   schema = types.message("msg",
+#       types.required(INT64).named("id"),
+#       types.required(BYTE_ARRAY).as_(types.string()).named("email"))
+# ---------------------------------------------------------------------------
+
+class _FieldBuilder:
+    __slots__ = ("_ptype", "_rep", "_lt", "_tl", "_fid")
+
+    def __init__(self, ptype, rep):
+        self._ptype = ptype
+        self._rep = rep
+        self._lt = None
+        self._tl = None
+        self._fid = None
+
+    def as_(self, annotation: LogicalAnnotation) -> "_FieldBuilder":
+        self._lt = annotation
+        return self
+
+    def length(self, n: int) -> "_FieldBuilder":
+        self._tl = n
+        return self
+
+    def id(self, fid: int) -> "_FieldBuilder":
+        self._fid = fid
+        return self
+
+    def named(self, name: str) -> PrimitiveType:
+        return PrimitiveType(
+            name, self._ptype, repetition=self._rep, logical_type=self._lt,
+            type_length=self._tl, field_id=self._fid,
+        )
+
+
+class _GroupBuilder:
+    __slots__ = ("_rep", "_fields", "_lt")
+
+    def __init__(self, rep, fields):
+        self._rep = rep
+        self._fields = fields
+        self._lt = None
+
+    def as_(self, annotation: LogicalAnnotation) -> "_GroupBuilder":
+        self._lt = annotation
+        return self
+
+    def named(self, name: str) -> GroupType:
+        return GroupType(name, self._fields, repetition=self._rep, logical_type=self._lt)
+
+
+class types:
+    """Fluent builder namespace (``types.required(...)`` etc.)."""
+
+    BOOLEAN = Type.BOOLEAN
+    INT32 = Type.INT32
+    INT64 = Type.INT64
+    INT96 = Type.INT96
+    FLOAT = Type.FLOAT
+    DOUBLE = Type.DOUBLE
+    BYTE_ARRAY = Type.BYTE_ARRAY
+    FIXED_LEN_BYTE_ARRAY = Type.FIXED_LEN_BYTE_ARRAY
+
+    @staticmethod
+    def required(ptype: int) -> _FieldBuilder:
+        return _FieldBuilder(ptype, REQUIRED)
+
+    @staticmethod
+    def optional(ptype: int) -> _FieldBuilder:
+        return _FieldBuilder(ptype, OPTIONAL)
+
+    @staticmethod
+    def repeated(ptype: int) -> _FieldBuilder:
+        return _FieldBuilder(ptype, REPEATED)
+
+    @staticmethod
+    def required_group(*fields: SchemaNode) -> _GroupBuilder:
+        return _GroupBuilder(REQUIRED, list(fields))
+
+    @staticmethod
+    def optional_group(*fields: SchemaNode) -> _GroupBuilder:
+        return _GroupBuilder(OPTIONAL, list(fields))
+
+    @staticmethod
+    def repeated_group(*fields: SchemaNode) -> _GroupBuilder:
+        return _GroupBuilder(REPEATED, list(fields))
+
+    @staticmethod
+    def list_of(element: SchemaNode, name: str, optional: bool = False) -> GroupType:
+        """Standard 3-level LIST structure."""
+        rep_group = GroupType("list", [element], repetition=REPEATED)
+        return GroupType(
+            name, [rep_group],
+            repetition=OPTIONAL if optional else REQUIRED,
+            logical_type=LogicalAnnotation("LIST"),
+        )
+
+    @staticmethod
+    def message(name: str, *fields: SchemaNode) -> MessageType:
+        return MessageType(name, list(fields))
+
+    @staticmethod
+    def string() -> LogicalAnnotation:
+        return LogicalAnnotation("STRING")
+
+    @staticmethod
+    def decimal(precision: int, scale: int = 0) -> LogicalAnnotation:
+        return LogicalAnnotation("DECIMAL", precision=precision, scale=scale)
+
+    @staticmethod
+    def date() -> LogicalAnnotation:
+        return LogicalAnnotation("DATE")
+
+    @staticmethod
+    def timestamp(unit: str = "MICROS", utc: bool = True) -> LogicalAnnotation:
+        return LogicalAnnotation("TIMESTAMP", unit=unit, utc=utc)
+
+    @staticmethod
+    def time(unit: str = "MICROS", utc: bool = True) -> LogicalAnnotation:
+        return LogicalAnnotation("TIME", unit=unit, utc=utc)
+
+    @staticmethod
+    def int_(bit_width: int, signed: bool = True) -> LogicalAnnotation:
+        return LogicalAnnotation("INTEGER", bit_width=bit_width, signed=signed)
+
+    @staticmethod
+    def uuid() -> LogicalAnnotation:
+        return LogicalAnnotation("UUID")
+
+    @staticmethod
+    def json() -> LogicalAnnotation:
+        return LogicalAnnotation("JSON")
+
+    @staticmethod
+    def enum() -> LogicalAnnotation:
+        return LogicalAnnotation("ENUM")

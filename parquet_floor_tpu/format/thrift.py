@@ -1,0 +1,403 @@
+"""Thrift compact-protocol reader/writer, implemented from scratch.
+
+Parquet serializes its footer and page headers with the Thrift *compact*
+protocol.  The reference library delegates this to parquet-mr's vendored
+thrift runtime (see SURVEY.md §2.3; exercised via
+``ParquetFileReader.open/getFooter`` at reference ``ParquetReader.java:114-120``).
+Here we implement the wire protocol directly: ULEB128 varints, zigzag
+integers, field-id delta encoding, struct/list/map containers, and the
+compact double representation.
+
+The protocol surface implemented is exactly what the Parquet format needs
+(plus maps/doubles for completeness).  Structures themselves are declared
+in :mod:`parquet_floor_tpu.format.parquet_thrift`.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Optional
+
+# Compact-protocol type ids (wire values).
+CT_STOP = 0x00
+CT_BOOLEAN_TRUE = 0x01
+CT_BOOLEAN_FALSE = 0x02
+CT_BYTE = 0x03
+CT_I16 = 0x04
+CT_I32 = 0x05
+CT_I64 = 0x06
+CT_DOUBLE = 0x07
+CT_BINARY = 0x08  # also STRING
+CT_LIST = 0x09
+CT_SET = 0x0A
+CT_MAP = 0x0B
+CT_STRUCT = 0x0C
+
+
+class ThriftDecodeError(ValueError):
+    """Raised when bytes do not parse as valid compact-protocol Thrift."""
+
+
+def zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63) if n < 0 else n << 1
+
+
+def zigzag_decode(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+class CompactReader:
+    """Cursor over a bytes-like object, decoding compact-protocol values."""
+
+    __slots__ = ("buf", "pos", "end")
+
+    def __init__(self, buf, pos: int = 0, end: Optional[int] = None):
+        self.buf = buf
+        self.pos = pos
+        self.end = len(buf) if end is None else end
+
+    def read_byte(self) -> int:
+        if self.pos >= self.end:
+            raise ThriftDecodeError("unexpected end of thrift data")
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b
+
+    def read_varint(self) -> int:
+        """ULEB128 unsigned varint."""
+        result = 0
+        shift = 0
+        while True:
+            b = self.read_byte()
+            result |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                return result
+            shift += 7
+            if shift > 70:
+                raise ThriftDecodeError("varint too long")
+
+    def read_zigzag(self) -> int:
+        return zigzag_decode(self.read_varint())
+
+    def read_bytes(self, n: int) -> bytes:
+        if self.pos + n > self.end:
+            raise ThriftDecodeError("unexpected end of thrift data")
+        out = bytes(self.buf[self.pos : self.pos + n])
+        self.pos += n
+        return out
+
+    def read_binary(self) -> bytes:
+        return self.read_bytes(self.read_varint())
+
+    def read_double(self) -> float:
+        # Compact protocol stores doubles little-endian.
+        return struct.unpack("<d", self.read_bytes(8))[0]
+
+    def skip(self, ctype: int, in_container: bool = False) -> None:
+        """Skip a value of the given compact type (for unknown fields).
+
+        Booleans are encoded in the field header at field position (zero
+        payload bytes) but occupy one byte as container elements.
+        """
+        if ctype in (CT_BOOLEAN_TRUE, CT_BOOLEAN_FALSE):
+            if in_container:
+                self.read_byte()
+            return
+        if ctype == CT_BYTE:
+            self.read_byte()
+        elif ctype in (CT_I16, CT_I32, CT_I64):
+            self.read_varint()
+        elif ctype == CT_DOUBLE:
+            self.read_bytes(8)
+        elif ctype == CT_BINARY:
+            self.read_bytes(self.read_varint())
+        elif ctype in (CT_LIST, CT_SET):
+            size, elem_type = self.read_list_header()
+            for _ in range(size):
+                self.skip(elem_type, in_container=True)
+        elif ctype == CT_MAP:
+            size, ktype, vtype = self.read_map_header()
+            for _ in range(size):
+                self.skip(ktype, in_container=True)
+                self.skip(vtype, in_container=True)
+        elif ctype == CT_STRUCT:
+            self.skip_struct()
+        else:
+            raise ThriftDecodeError(f"cannot skip unknown compact type {ctype}")
+
+    def skip_struct(self) -> None:
+        last_fid = 0
+        while True:
+            fid, ctype, last_fid = self.read_field_header(last_fid)
+            if ctype == CT_STOP:
+                return
+            self.skip(ctype)
+
+    def read_field_header(self, last_fid: int):
+        """Returns (field_id, compact_type, new_last_fid); type CT_STOP ends."""
+        b = self.read_byte()
+        if b == CT_STOP:
+            return 0, CT_STOP, last_fid
+        delta = (b & 0xF0) >> 4
+        ctype = b & 0x0F
+        if delta == 0:
+            fid = zigzag_decode(self.read_varint())
+        else:
+            fid = last_fid + delta
+        return fid, ctype, fid
+
+    def read_list_header(self):
+        b = self.read_byte()
+        size = (b & 0xF0) >> 4
+        elem_type = b & 0x0F
+        if size == 0x0F:
+            size = self.read_varint()
+        return size, elem_type
+
+    def read_map_header(self):
+        size = self.read_varint()
+        if size == 0:
+            return 0, 0, 0
+        b = self.read_byte()
+        return size, (b & 0xF0) >> 4, b & 0x0F
+
+
+class CompactWriter:
+    """Appends compact-protocol values to an internal bytearray."""
+
+    __slots__ = ("out",)
+
+    def __init__(self):
+        self.out = bytearray()
+
+    def getvalue(self) -> bytes:
+        return bytes(self.out)
+
+    def write_byte(self, b: int) -> None:
+        self.out.append(b & 0xFF)
+
+    def write_varint(self, n: int) -> None:
+        if n < 0:
+            raise ValueError("varint must be non-negative")
+        while True:
+            if n < 0x80:
+                self.out.append(n)
+                return
+            self.out.append((n & 0x7F) | 0x80)
+            n >>= 7
+
+    def write_zigzag(self, n: int) -> None:
+        self.write_varint(zigzag_encode(n))
+
+    def write_binary(self, data: bytes) -> None:
+        self.write_varint(len(data))
+        self.out += data
+
+    def write_double(self, value: float) -> None:
+        self.out += struct.pack("<d", value)
+
+    def write_field_header(self, fid: int, ctype: int, last_fid: int) -> int:
+        delta = fid - last_fid
+        if 0 < delta <= 15:
+            self.write_byte((delta << 4) | ctype)
+        else:
+            self.write_byte(ctype)
+            self.write_zigzag(fid)
+        return fid
+
+    def write_stop(self) -> None:
+        self.write_byte(CT_STOP)
+
+    def write_list_header(self, size: int, elem_type: int) -> None:
+        if size < 15:
+            self.write_byte((size << 4) | elem_type)
+        else:
+            self.write_byte(0xF0 | elem_type)
+            self.write_varint(size)
+
+    def write_map_header(self, size: int, ktype: int, vtype: int) -> None:
+        self.write_varint(size)
+        if size > 0:
+            self.write_byte((ktype << 4) | vtype)
+
+
+# ---------------------------------------------------------------------------
+# Declarative struct layer
+# ---------------------------------------------------------------------------
+#
+# Parquet's metadata structures are declared as ThriftStruct subclasses with a
+# FIELDS table: {field_id: (name, field_type)} where field_type is one of the
+# T_* singletons below, a ThriftStruct subclass, or a container wrapper.
+
+
+class TType:
+    """Scalar thrift field type descriptor."""
+
+    __slots__ = ("name", "compact_type")
+
+    def __init__(self, name: str, compact_type: int):
+        self.name = name
+        self.compact_type = compact_type
+
+    def __repr__(self):
+        return f"T_{self.name}"
+
+
+T_BOOL = TType("BOOL", CT_BOOLEAN_TRUE)  # compact type resolved at write time
+T_BYTE = TType("BYTE", CT_BYTE)
+T_I16 = TType("I16", CT_I16)
+T_I32 = TType("I32", CT_I32)
+T_I64 = TType("I64", CT_I64)
+T_DOUBLE = TType("DOUBLE", CT_DOUBLE)
+T_BINARY = TType("BINARY", CT_BINARY)
+T_STRING = TType("STRING", CT_BINARY)  # decoded as utf-8 str
+
+
+class TList:
+    __slots__ = ("elem",)
+
+    def __init__(self, elem):
+        self.elem = elem
+
+
+def _compact_type_of(ftype) -> int:
+    if isinstance(ftype, TType):
+        return ftype.compact_type
+    if isinstance(ftype, TList):
+        return CT_LIST
+    if isinstance(ftype, type) and issubclass(ftype, ThriftStruct):
+        return CT_STRUCT
+    raise TypeError(f"bad thrift field type {ftype!r}")
+
+
+def _read_value(reader: CompactReader, ftype, ctype: int):
+    if isinstance(ftype, TType):
+        if ftype is T_BOOL:
+            if ctype in (CT_BOOLEAN_TRUE, CT_BOOLEAN_FALSE):
+                return ctype == CT_BOOLEAN_TRUE
+            # bool inside a list is a full byte
+            return reader.read_byte() == CT_BOOLEAN_TRUE
+        if ftype is T_BYTE:
+            b = reader.read_byte()
+            return b - 256 if b >= 128 else b
+        if ftype in (T_I16, T_I32, T_I64):
+            return reader.read_zigzag()
+        if ftype is T_DOUBLE:
+            return reader.read_double()
+        if ftype is T_BINARY:
+            return reader.read_binary()
+        if ftype is T_STRING:
+            return reader.read_binary().decode("utf-8", errors="replace")
+        raise ThriftDecodeError(f"unhandled scalar type {ftype}")
+    if isinstance(ftype, TList):
+        size, elem_ctype = reader.read_list_header()
+        return [_read_value(reader, ftype.elem, elem_ctype) for _ in range(size)]
+    if isinstance(ftype, type) and issubclass(ftype, ThriftStruct):
+        return ftype.read(reader)
+    raise ThriftDecodeError(f"unhandled field type {ftype!r}")
+
+
+def _write_value(writer: CompactWriter, ftype, value) -> None:
+    if isinstance(ftype, TType):
+        if ftype is T_BOOL:
+            # Only reached inside containers; bools in fields are headers.
+            writer.write_byte(CT_BOOLEAN_TRUE if value else CT_BOOLEAN_FALSE)
+        elif ftype is T_BYTE:
+            writer.write_byte(value & 0xFF)
+        elif ftype in (T_I16, T_I32, T_I64):
+            writer.write_zigzag(int(value))
+        elif ftype is T_DOUBLE:
+            writer.write_double(value)
+        elif ftype is T_BINARY:
+            writer.write_binary(bytes(value))
+        elif ftype is T_STRING:
+            writer.write_binary(value.encode("utf-8") if isinstance(value, str) else bytes(value))
+        else:
+            raise TypeError(f"unhandled scalar type {ftype}")
+    elif isinstance(ftype, TList):
+        writer.write_list_header(len(value), _compact_type_of(ftype.elem))
+        for v in value:
+            _write_value(writer, ftype.elem, v)
+    elif isinstance(ftype, type) and issubclass(ftype, ThriftStruct):
+        value.write(writer)
+    else:
+        raise TypeError(f"unhandled field type {ftype!r}")
+
+
+class ThriftStruct:
+    """Base for declaratively-specified thrift structs.
+
+    Subclasses define ``FIELDS = {fid: (attr_name, field_type)}``.  Unknown
+    fields encountered while reading are skipped (forward compatibility, the
+    same stance parquet-mr's generated code takes).  Attributes default to
+    ``None`` and only non-None attributes are written.
+    """
+
+    FIELDS: dict = {}
+
+    def __init__(self, **kwargs):
+        for name, _ in self.FIELDS.values():
+            setattr(self, name, kwargs.pop(name, None))
+        if kwargs:
+            raise TypeError(f"unknown fields for {type(self).__name__}: {sorted(kwargs)}")
+
+    @classmethod
+    def read(cls, reader: CompactReader):
+        obj = cls()
+        last_fid = 0
+        fields = cls.FIELDS
+        while True:
+            fid, ctype, last_fid = reader.read_field_header(last_fid)
+            if ctype == CT_STOP:
+                return obj
+            spec = fields.get(fid)
+            if spec is None:
+                reader.skip(ctype)
+                continue
+            name, ftype = spec
+            setattr(obj, name, _read_value(reader, ftype, ctype))
+
+    @classmethod
+    def from_bytes(cls, data, pos: int = 0):
+        """Parse from a buffer; returns (obj, end_pos)."""
+        reader = CompactReader(data, pos)
+        obj = cls.read(reader)
+        return obj, reader.pos
+
+    def write(self, writer: CompactWriter) -> None:
+        last_fid = 0
+        for fid in sorted(self.FIELDS):
+            name, ftype = self.FIELDS[fid]
+            value = getattr(self, name)
+            if value is None:
+                continue
+            if ftype is T_BOOL:
+                ctype = CT_BOOLEAN_TRUE if value else CT_BOOLEAN_FALSE
+                last_fid = writer.write_field_header(fid, ctype, last_fid)
+                continue
+            last_fid = writer.write_field_header(fid, _compact_type_of(ftype), last_fid)
+            _write_value(writer, ftype, value)
+        writer.write_stop()
+
+    def to_bytes(self) -> bytes:
+        w = CompactWriter()
+        self.write(w)
+        return w.getvalue()
+
+    def __repr__(self):
+        parts = []
+        for name, _ in self.FIELDS.values():
+            v = getattr(self, name)
+            if v is not None:
+                parts.append(f"{name}={v!r}")
+        return f"{type(self).__name__}({', '.join(parts)})"
+
+    def __eq__(self, other):
+        if type(self) is not type(other):
+            return NotImplemented
+        return all(
+            getattr(self, name) == getattr(other, name) for name, _ in self.FIELDS.values()
+        )
+
+    def __hash__(self):
+        return object.__hash__(self)

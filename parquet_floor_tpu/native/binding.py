@@ -1,0 +1,136 @@
+"""ctypes binding to the C++ native runtime (libpftpu_native.so).
+
+The native library provides the host-side hot loops that a Python/NumPy
+implementation can't make fast: Snappy block compress/decompress and RLE
+run-table parsing.  Built from ``parquet_floor_tpu/native/src`` via
+``build.sh`` (g++, no external deps).  Everything degrades gracefully to the
+pure-Python implementations when the library isn't built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_LIB_NAME = "libpftpu_native.so"
+_lib = None
+_load_attempted = False
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), _LIB_NAME)
+
+
+def _try_build() -> bool:
+    """Best-effort one-shot build of the native lib (g++, no deps)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        return False
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        subprocess.run(
+            ["sh", os.path.join(here, "build.sh")],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load():
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    path = _lib_path()
+    if not os.path.exists(path) and os.environ.get("PFTPU_NO_NATIVE_BUILD") != "1":
+        _try_build()
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.pftpu_snappy_max_compressed_size.restype = ctypes.c_size_t
+        lib.pftpu_snappy_max_compressed_size.argtypes = [ctypes.c_size_t]
+        lib.pftpu_snappy_compress.restype = ctypes.c_ssize_t
+        lib.pftpu_snappy_compress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.pftpu_snappy_uncompressed_size.restype = ctypes.c_ssize_t
+        lib.pftpu_snappy_uncompressed_size.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.pftpu_snappy_decompress.restype = ctypes.c_ssize_t
+        lib.pftpu_snappy_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.pftpu_rle_parse_runs.restype = ctypes.c_ssize_t
+        lib.pftpu_rle_parse_runs.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,  # data
+            ctypes.c_longlong, ctypes.c_int,   # num_values, bit_width
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_size_t,  # out table, capacity rows
+            ctypes.POINTER(ctypes.c_longlong),  # end position out
+        ]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def snappy_compress(data: bytes) -> bytes:
+    lib = _load()
+    cap = lib.pftpu_snappy_max_compressed_size(len(data))
+    out = ctypes.create_string_buffer(cap)
+    n = lib.pftpu_snappy_compress(data, len(data), out, cap)
+    if n < 0:
+        raise ValueError("native snappy compression failed")
+    return out.raw[:n]
+
+
+def snappy_decompress(data: bytes, uncompressed_size: Optional[int] = None) -> bytes:
+    lib = _load()
+    if uncompressed_size is None:
+        uncompressed_size = lib.pftpu_snappy_uncompressed_size(data, len(data))
+        if uncompressed_size < 0:
+            raise ValueError("native snappy: bad stream header")
+    out = ctypes.create_string_buffer(max(uncompressed_size, 1))
+    n = lib.pftpu_snappy_decompress(data, len(data), out, uncompressed_size)
+    if n < 0:
+        raise ValueError("native snappy decompression failed")
+    return out.raw[:n]
+
+
+def rle_parse_runs(data: bytes, num_values: int, bit_width: int, pos: int = 0):
+    """Parse an RLE/bit-packed hybrid run table natively.
+
+    Returns (run_table int64 ndarray (n,4), end_pos) matching
+    ``format.encodings.rle_hybrid.parse_runs``.
+    """
+    import numpy as np
+
+    lib = _load()
+    view = data[pos:] if pos else data
+    cap = max(16, num_values)  # worst case: one run per 1 value? bounded below
+    while True:
+        table = np.zeros((cap, 4), dtype=np.int64)
+        end = ctypes.c_longlong(0)
+        n = lib.pftpu_rle_parse_runs(
+            bytes(view), len(view), num_values, bit_width,
+            table.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), cap,
+            ctypes.byref(end),
+        )
+        if n == -2:  # capacity exceeded
+            cap *= 2
+            continue
+        if n < 0:
+            raise ValueError("native RLE parse failed")
+        table = table[:n]
+        if pos:
+            table[table[:, 0] == 1, 2] += pos
+        return table, end.value + pos

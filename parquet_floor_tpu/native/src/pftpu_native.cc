@@ -1,0 +1,301 @@
+// pftpu_native: host-side hot loops for parquet-floor-tpu.
+//
+// TPU-native replacement for the JNI-wrapped codec natives the reference
+// consumes transitively (SURVEY.md §2.4: snappy-java/libsnappy behind the
+// io.compress shim seam).  Implemented from scratch against the public
+// Snappy block-format description and the Parquet RLE/bit-packed hybrid
+// spec.  Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+//
+// Build: parquet_floor_tpu/native/build.sh  (g++ -O3 -shared -fPIC)
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Snappy block format
+// ---------------------------------------------------------------------------
+
+static inline size_t varint_encode(size_t n, uint8_t* out) {
+  size_t i = 0;
+  while (n >= 0x80) {
+    out[i++] = static_cast<uint8_t>(n) | 0x80;
+    n >>= 7;
+  }
+  out[i++] = static_cast<uint8_t>(n);
+  return i;
+}
+
+static inline ptrdiff_t varint_decode(const uint8_t* p, const uint8_t* end,
+                                      uint64_t* out) {
+  uint64_t result = 0;
+  int shift = 0;
+  const uint8_t* start = p;
+  while (p < end && shift <= 35) {
+    uint8_t b = *p++;
+    result |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = result;
+      return p - start;
+    }
+    shift += 7;
+  }
+  return -1;
+}
+
+size_t pftpu_snappy_max_compressed_size(size_t n) {
+  // worst case: all literals + tag overhead + length varint
+  return 32 + n + n / 6;
+}
+
+ptrdiff_t pftpu_snappy_uncompressed_size(const uint8_t* src, size_t src_len) {
+  uint64_t n;
+  ptrdiff_t used = varint_decode(src, src + src_len, &n);
+  if (used < 0) return -1;
+  return static_cast<ptrdiff_t>(n);
+}
+
+// --- compression (greedy hash matcher, 14-bit table) -----------------------
+
+static const int kHashBits = 14;
+static const size_t kHashSize = 1u << kHashBits;
+
+static inline uint32_t load32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+static inline uint32_t hash32(uint32_t v) {
+  return (v * 0x1E35A7BDu) >> (32 - kHashBits);
+}
+
+static inline uint8_t* emit_literal(uint8_t* dst, const uint8_t* src,
+                                    size_t len) {
+  size_t n = len - 1;
+  if (n < 60) {
+    *dst++ = static_cast<uint8_t>(n << 2);
+  } else if (n < (1u << 8)) {
+    *dst++ = 60 << 2;
+    *dst++ = static_cast<uint8_t>(n);
+  } else if (n < (1u << 16)) {
+    *dst++ = 61 << 2;
+    *dst++ = static_cast<uint8_t>(n);
+    *dst++ = static_cast<uint8_t>(n >> 8);
+  } else if (n < (1u << 24)) {
+    *dst++ = 62 << 2;
+    *dst++ = static_cast<uint8_t>(n);
+    *dst++ = static_cast<uint8_t>(n >> 8);
+    *dst++ = static_cast<uint8_t>(n >> 16);
+  } else {
+    *dst++ = 63 << 2;
+    *dst++ = static_cast<uint8_t>(n);
+    *dst++ = static_cast<uint8_t>(n >> 8);
+    *dst++ = static_cast<uint8_t>(n >> 16);
+    *dst++ = static_cast<uint8_t>(n >> 24);
+  }
+  std::memcpy(dst, src, len);
+  return dst + len;
+}
+
+static inline uint8_t* emit_copy_upto64(uint8_t* dst, size_t offset,
+                                        size_t len) {
+  if (len >= 4 && len <= 11 && offset < 2048) {
+    *dst++ = static_cast<uint8_t>(1 | ((len - 4) << 2) | ((offset >> 8) << 5));
+    *dst++ = static_cast<uint8_t>(offset);
+  } else if (offset < (1u << 16)) {
+    *dst++ = static_cast<uint8_t>(2 | ((len - 1) << 2));
+    *dst++ = static_cast<uint8_t>(offset);
+    *dst++ = static_cast<uint8_t>(offset >> 8);
+  } else {
+    *dst++ = static_cast<uint8_t>(3 | ((len - 1) << 2));
+    *dst++ = static_cast<uint8_t>(offset);
+    *dst++ = static_cast<uint8_t>(offset >> 8);
+    *dst++ = static_cast<uint8_t>(offset >> 16);
+    *dst++ = static_cast<uint8_t>(offset >> 24);
+  }
+  return dst;
+}
+
+static inline uint8_t* emit_copy(uint8_t* dst, size_t offset, size_t len) {
+  while (len >= 68) {
+    dst = emit_copy_upto64(dst, offset, 64);
+    len -= 64;
+  }
+  if (len > 64) {
+    dst = emit_copy_upto64(dst, offset, len - 60);
+    len = 60;
+  }
+  return emit_copy_upto64(dst, offset, len);
+}
+
+ptrdiff_t pftpu_snappy_compress(const uint8_t* src, size_t src_len,
+                                uint8_t* dst, size_t dst_cap) {
+  if (dst_cap < pftpu_snappy_max_compressed_size(src_len)) return -1;
+  uint8_t* out = dst;
+  out += varint_encode(src_len, out);
+  if (src_len < 16) {
+    if (src_len) out = emit_literal(out, src, src_len);
+    return out - dst;
+  }
+  uint16_t table[kHashSize];
+  std::memset(table, 0, sizeof(table));
+  // table stores pos+1 within the current 64KB-ish window base
+  size_t pos = 0, lit_start = 0;
+  const size_t limit = src_len - 4;
+  size_t base = 0;  // window base so uint16 entries stay valid
+  while (pos <= limit) {
+    if (pos - base >= 60000) {  // rebase the window
+      base = pos;
+      std::memset(table, 0, sizeof(table));
+    }
+    uint32_t h = hash32(load32(src + pos));
+    size_t cand = base + table[h];
+    table[h] = static_cast<uint16_t>(pos - base + 1);
+    // cand==base means empty slot (stored value 0) unless a real match at
+    // base+? ; offset by one to disambiguate
+    if (cand == base) {
+      pos++;
+      continue;
+    }
+    cand -= 1;
+    size_t offset = pos - cand;
+    if (offset == 0 || offset >= (1u << 16) ||
+        load32(src + cand) != load32(src + pos)) {
+      pos++;
+      continue;
+    }
+    size_t mlen = 4;
+    const size_t maxm = src_len - pos;
+    while (mlen < maxm && src[cand + mlen] == src[pos + mlen]) mlen++;
+    if (lit_start < pos) out = emit_literal(out, src + lit_start, pos - lit_start);
+    out = emit_copy(out, offset, mlen);
+    pos += mlen;
+    lit_start = pos;
+  }
+  if (lit_start < src_len)
+    out = emit_literal(out, src + lit_start, src_len - lit_start);
+  return out - dst;
+}
+
+ptrdiff_t pftpu_snappy_decompress(const uint8_t* src, size_t src_len,
+                                  uint8_t* dst, size_t dst_cap) {
+  uint64_t expected;
+  ptrdiff_t used = varint_decode(src, src + src_len, &expected);
+  if (used < 0 || expected > dst_cap) return -1;
+  const uint8_t* p = src + used;
+  const uint8_t* end = src + src_len;
+  uint8_t* out = dst;
+  uint8_t* out_end = dst + expected;
+  while (p < end) {
+    const uint8_t tag = *p++;
+    const int kind = tag & 3;
+    if (kind == 0) {  // literal
+      size_t len = tag >> 2;
+      if (len >= 60) {
+        const size_t nb = len - 59;
+        if (p + nb > end) return -2;
+        len = 0;
+        for (size_t i = 0; i < nb; i++) len |= static_cast<size_t>(p[i]) << (8 * i);
+        p += nb;
+      }
+      len += 1;
+      if (p + len > end || out + len > out_end) return -2;
+      std::memcpy(out, p, len);
+      p += len;
+      out += len;
+      continue;
+    }
+    size_t len, offset;
+    if (kind == 1) {
+      if (p + 1 > end) return -2;
+      len = ((tag >> 2) & 0x7) + 4;
+      offset = (static_cast<size_t>(tag >> 5) << 8) | *p++;
+    } else if (kind == 2) {
+      if (p + 2 > end) return -2;
+      len = (tag >> 2) + 1;
+      offset = p[0] | (static_cast<size_t>(p[1]) << 8);
+      p += 2;
+    } else {
+      if (p + 4 > end) return -2;
+      len = (tag >> 2) + 1;
+      offset = p[0] | (static_cast<size_t>(p[1]) << 8) |
+               (static_cast<size_t>(p[2]) << 16) |
+               (static_cast<size_t>(p[3]) << 24);
+      p += 4;
+    }
+    if (offset == 0 || offset > static_cast<size_t>(out - dst)) return -2;
+    if (out + len > out_end) return -2;
+    const uint8_t* from = out - offset;
+    if (offset >= len) {
+      std::memcpy(out, from, len);
+      out += len;
+    } else {
+      for (size_t i = 0; i < len; i++) *out++ = *from++;
+    }
+  }
+  if (out != out_end) return -2;
+  return out - dst;
+}
+
+// ---------------------------------------------------------------------------
+// RLE/bit-packed hybrid run-table parse (phase 1 of the two-phase decode;
+// phase 2 — expansion — runs vectorized on TPU or in NumPy)
+// ---------------------------------------------------------------------------
+
+// Row layout matches format/encodings/rle_hybrid.py parse_runs:
+//   [kind(0=RLE,1=bitpacked), count, value_or_byte_offset, 0]
+ptrdiff_t pftpu_rle_parse_runs(const uint8_t* data, size_t data_len,
+                               long long num_values, int bit_width,
+                               long long* out_table, size_t cap_rows,
+                               long long* end_pos) {
+  if (bit_width == 0) {
+    *end_pos = 0;
+    return 0;
+  }
+  const uint8_t* p = data;
+  const uint8_t* end = data + data_len;
+  long long remaining = num_values;
+  const int value_bytes = (bit_width + 7) / 8;
+  size_t rows = 0;
+  while (remaining > 0) {
+    uint64_t header;
+    ptrdiff_t used = varint_decode(p, end, &header);
+    if (used < 0) return -1;
+    p += used;
+    if (header & 1) {
+      const long long groups = static_cast<long long>(header >> 1);
+      const long long n = groups * 8;
+      if (rows >= cap_rows) return -2;
+      out_table[rows * 4 + 0] = 1;
+      out_table[rows * 4 + 1] = n < remaining ? n : remaining;
+      out_table[rows * 4 + 2] = p - data;
+      out_table[rows * 4 + 3] = 0;
+      rows++;
+      const long long nbytes = groups * bit_width;
+      if (p + nbytes > end) return -1;
+      p += nbytes;
+      remaining -= n;
+    } else {
+      const long long n = static_cast<long long>(header >> 1);
+      if (p + value_bytes > end) return -1;
+      long long value = 0;
+      for (int i = 0; i < value_bytes; i++)
+        value |= static_cast<long long>(p[i]) << (8 * i);
+      p += value_bytes;
+      if (rows >= cap_rows) return -2;
+      out_table[rows * 4 + 0] = 0;
+      out_table[rows * 4 + 1] = n < remaining ? n : remaining;
+      out_table[rows * 4 + 2] = value;
+      out_table[rows * 4 + 3] = 0;
+      rows++;
+      remaining -= n;
+    }
+  }
+  *end_pos = p - data;
+  return static_cast<ptrdiff_t>(rows);
+}
+
+}  // extern "C"

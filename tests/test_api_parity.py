@@ -1,0 +1,226 @@
+"""Acceptance tests: the reference's round-trip test translated
+(``ParquetReadWriteTest.java:28-83``) plus the documented facade semantics
+(SURVEY.md §2.1 behavioral facts)."""
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    ParquetReader,
+    ParquetWriter,
+    types,
+)
+from parquet_floor_tpu.api.hydrate import (
+    FnDehydrator,
+    FnHydrator,
+    HydratorSupplier,
+    dict_hydrator,
+)
+
+
+def _schema():
+    # parity: required INT64 id + required BINARY-as-string email
+    # (ParquetReadWriteTest.java:32-35)
+    return types.message(
+        "import",
+        types.required(types.INT64).named("id"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("email"),
+    )
+
+
+def _write_two_rows(path):
+    dehydrator = FnDehydrator(
+        lambda record, vw: (vw.write("id", record[0]), vw.write("email", record[1]))
+    )
+    ParquetWriter.write_file(
+        _schema(), path, dehydrator, [(1, "hello1@example.com"), (2, "hello2@example.com")]
+    )
+
+
+def test_writes_and_reads_parquet(tmp_path):
+    """Direct translation of ``writes_and_reads_parquet``."""
+    path = tmp_path / "foo.parquet"
+    _write_two_rows(path)
+
+    records = list(ParquetReader.stream_content(path, HydratorSupplier.constantly(dict_hydrator())))
+    assert records == [
+        {"id": 1, "email": "hello1@example.com"},
+        {"id": 2, "email": "hello2@example.com"},
+    ]
+
+
+def test_column_projection(tmp_path):
+    """Projection keeps only the named top-level column (test part 4)."""
+    path = tmp_path / "foo.parquet"
+    _write_two_rows(path)
+    records = list(
+        ParquetReader.stream_content(
+            path, HydratorSupplier.constantly(dict_hydrator()), columns={"id"}
+        )
+    )
+    assert records == [{"id": 1}, {"id": 2}]
+
+
+def test_empty_projection_means_all(tmp_path):
+    # empty/None selection = all columns (ParquetReader.java:76)
+    path = tmp_path / "foo.parquet"
+    _write_two_rows(path)
+    for sel in (None, []):
+        records = list(
+            ParquetReader.stream_content(
+                path, HydratorSupplier.constantly(dict_hydrator()), columns=sel
+            )
+        )
+        assert len(records) == 2 and "email" in records[0]
+
+
+def test_hydrator_receives_columns_in_order(tmp_path):
+    path = tmp_path / "foo.parquet"
+    _write_two_rows(path)
+    seen_columns = []
+    order = []
+
+    def supplier(columns):
+        seen_columns.extend(columns)
+        return FnHydrator(
+            start=list,
+            add=lambda t, h, v: (order.append(h), t.append(v), t)[2],
+            finish=tuple,
+        )
+
+    records = list(ParquetReader.stream_content(path, supplier))
+    assert [c.path[0] for c in seen_columns] == ["id", "email"]
+    assert order[:2] == ["id", "email"]  # column order (HydratorSupplier.java:10-15)
+    assert records[0] == (1, "hello1@example.com")
+
+
+def test_stream_content_to_strings(tmp_path):
+    # debug reader: "name=value" strings (ParquetReader.java:86-107)
+    path = tmp_path / "foo.parquet"
+    _write_two_rows(path)
+    rows = list(ParquetReader.stream_content_to_strings(path))
+    assert rows == [
+        ["id=1", "email=hello1@example.com"],
+        ["id=2", "email=hello2@example.com"],
+    ]
+
+
+def test_read_metadata(tmp_path):
+    path = tmp_path / "foo.parquet"
+    _write_two_rows(path)
+    meta = ParquetReader.read_metadata(path)
+    assert meta.num_rows == 2
+    assert meta.schema.fields[0].name == "id"
+    # open-reader metadata access (ParquetReader.java:229-231)
+    r = ParquetReader.spliterator(path, HydratorSupplier.constantly(dict_hydrator()))
+    assert r.metadata.num_rows == 2
+    assert r.estimate_size() == 2
+    r.close()
+
+
+def test_null_values_hydrate_as_none(tmp_path):
+    schema = types.message(
+        "m",
+        types.required(types.INT64).named("id"),
+        types.optional(types.INT64).named("opt"),
+    )
+    path = tmp_path / "n.parquet"
+    dehydrator = FnDehydrator(
+        lambda rec, vw: (
+            vw.write("id", rec[0]),
+            vw.write("opt", rec[1]) if rec[1] is not None else None,
+        )
+    )
+    ParquetWriter.write_file(schema, path, dehydrator, [(1, 10), (2, None), (3, 30)])
+    records = list(
+        ParquetReader.stream_content(path, HydratorSupplier.constantly(dict_hydrator()))
+    )
+    assert records == [
+        {"id": 1, "opt": 10},
+        {"id": 2, "opt": None},
+        {"id": 3, "opt": 30},
+    ]
+
+
+def test_write_type_surface_rejections(tmp_path):
+    """Write facade rejects unsupported value types (ParquetWriter.java:147-161)."""
+    schema = types.message("m", types.required(types.INT64).named("x"))
+    path = tmp_path / "x.parquet"
+    bad = FnDehydrator(lambda rec, vw: vw.write("x", "not an int"))
+    with pytest.raises(ValueError, match="Cannot write value"):
+        ParquetWriter.write_file(schema, path, bad, [object()])
+
+    # BINARY without string annotation is rejected
+    schema2 = types.message("m", types.required(types.BYTE_ARRAY).named("raw"))
+    bad2 = FnDehydrator(lambda rec, vw: vw.write("raw", b"bytes"))
+    with pytest.raises(ValueError, match="Cannot write value"):
+        ParquetWriter.write_file(schema2, tmp_path / "y.parquet", bad2, [object()])
+
+
+def test_unknown_field_name_raises(tmp_path):
+    schema = types.message("m", types.required(types.INT64).named("x"))
+    bad = FnDehydrator(lambda rec, vw: vw.write("nope", 1))
+    with pytest.raises(KeyError):
+        ParquetWriter.write_file(schema, tmp_path / "z.parquet", bad, [object()])
+
+
+def test_repeated_field_raises_on_read(tmp_path):
+    """Flat-only guard parity (ParquetReader.java:200-202)."""
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+    table = pa.table({"xs": pa.array([[1, 2], [3]], type=pa.list_(pa.int64()))})
+    path = tmp_path / "rep.parquet"
+    pq.write_table(table, path)
+    with pytest.raises(RuntimeError, match="Failed to read parquet"):
+        list(
+            ParquetReader.stream_content(
+                path, HydratorSupplier.constantly(dict_hydrator())
+            )
+        )
+
+
+def test_read_errors_are_wrapped(tmp_path):
+    path = tmp_path / "foo.parquet"
+    _write_two_rows(path)
+
+    class Exploding(FnHydrator):
+        def __init__(self):
+            super().__init__(dict, self._boom, dict)
+
+        @staticmethod
+        def _boom(t, h, v):
+            raise KeyError("user plugin failure")
+
+    it = ParquetReader.stream_content(path, HydratorSupplier.constantly(Exploding()))
+    with pytest.raises(RuntimeError, match="Failed to read parquet"):
+        next(iter(it))
+
+
+def test_stringified_types(tmp_path):
+    """BINARY/FLBA read back stringified (ParquetReader.java:147-163)."""
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+    table = pa.table(
+        {
+            "raw": pa.array([b"\x01\x02", b"\xff"], type=pa.binary()),
+            "fx": pa.array([b"ABCD", b"WXYZ"], type=pa.binary(4)),
+        }
+    )
+    path = tmp_path / "bin.parquet"
+    pq.write_table(table, path)
+    records = list(
+        ParquetReader.stream_content(path, HydratorSupplier.constantly(dict_hydrator()))
+    )
+    assert records[0]["raw"] == "0x0102"
+    assert records[1]["raw"] == "0xFF"
+    assert records[0]["fx"] == "0x41424344"
+
+
+def test_reader_as_context_manager_and_iterator(tmp_path):
+    path = tmp_path / "foo.parquet"
+    _write_two_rows(path)
+    with ParquetReader.spliterator(
+        path, HydratorSupplier.constantly(dict_hydrator())
+    ) as r:
+        ids = [rec["id"] for rec in r]
+    assert ids == [1, 2]

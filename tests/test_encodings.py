@@ -1,0 +1,208 @@
+"""Encoding unit tests: round-trips + edge cases for every codec
+(SURVEY.md §4 "per-encoding unit tests (RLE hybrid, bit-pack, DELTA_*,
+dictionary)")."""
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu.format.encodings import plain as e_plain
+from parquet_floor_tpu.format.encodings import rle_hybrid as e_rle
+from parquet_floor_tpu.format.encodings import delta as e_delta
+from parquet_floor_tpu.format.encodings import byte_stream_split as e_bss
+from parquet_floor_tpu.format.encodings.dictionary import (
+    build_dictionary,
+    decode_dict_indices,
+    encode_dict_indices,
+    gather,
+)
+from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+from parquet_floor_tpu.format.parquet_thrift import Type
+
+rng = np.random.default_rng(42)
+
+
+# ---------------------------------------------------------------------- PLAIN
+
+@pytest.mark.parametrize(
+    "ptype,dtype",
+    [
+        (Type.INT32, np.int32),
+        (Type.INT64, np.int64),
+        (Type.FLOAT, np.float32),
+        (Type.DOUBLE, np.float64),
+    ],
+)
+def test_plain_fixed_roundtrip(ptype, dtype):
+    if np.issubdtype(dtype, np.integer):
+        values = rng.integers(np.iinfo(dtype).min, np.iinfo(dtype).max, 1000).astype(dtype)
+    else:
+        values = rng.standard_normal(1000).astype(dtype)
+    data = e_plain.encode_plain(values, ptype)
+    out, consumed = e_plain.decode_plain(data, len(values), ptype)
+    assert consumed == len(data)
+    np.testing.assert_array_equal(out, values)
+
+
+def test_plain_boolean_roundtrip():
+    for n in [0, 1, 7, 8, 9, 1000]:
+        values = rng.integers(0, 2, n).astype(bool)
+        data = e_plain.encode_plain(values, Type.BOOLEAN)
+        out, _ = e_plain.decode_plain(data, n, Type.BOOLEAN)
+        np.testing.assert_array_equal(out, values)
+
+
+def test_plain_byte_array_roundtrip():
+    values = [b"", b"a", b"hello world", bytes(range(256)), b"x" * 10000]
+    col = ByteArrayColumn.from_list(values)
+    data = e_plain.encode_plain(col, Type.BYTE_ARRAY)
+    out, consumed = e_plain.decode_plain(data, len(values), Type.BYTE_ARRAY)
+    assert consumed == len(data)
+    assert out.to_list() == values
+
+
+def test_plain_fixed_len_byte_array():
+    values = rng.integers(0, 256, (10, 16)).astype(np.uint8)
+    data = e_plain.encode_plain(values, Type.FIXED_LEN_BYTE_ARRAY, type_length=16)
+    out, _ = e_plain.decode_plain(data, 10, Type.FIXED_LEN_BYTE_ARRAY, type_length=16)
+    np.testing.assert_array_equal(out, values)
+
+
+def test_plain_int96():
+    values = rng.integers(0, 256, (5, 12)).astype(np.uint8)
+    data = e_plain.encode_plain(values, Type.INT96)
+    out, _ = e_plain.decode_plain(data, 5, Type.INT96)
+    np.testing.assert_array_equal(out, values)
+
+
+# ------------------------------------------------------------------ RLE hybrid
+
+@pytest.mark.parametrize("bit_width", [1, 2, 3, 5, 7, 8, 12, 17, 20, 24, 31, 32])
+def test_bit_pack_unpack(bit_width):
+    n = 64
+    maxv = (1 << bit_width) - 1
+    values = rng.integers(0, maxv + 1, n, dtype=np.uint64)
+    packed = np.frombuffer(e_rle.bit_pack(values, bit_width), dtype=np.uint8)
+    out = e_rle.bit_unpack(packed, bit_width, n)
+    np.testing.assert_array_equal(out, values)
+
+
+@pytest.mark.parametrize("bit_width", [1, 2, 4, 10, 20])
+def test_rle_hybrid_roundtrip_random(bit_width):
+    maxv = (1 << bit_width) - 1
+    for n in [1, 5, 8, 100, 1023]:
+        values = rng.integers(0, maxv + 1, n, dtype=np.uint32)
+        data = e_rle.encode_rle_hybrid(values, bit_width)
+        out, _ = e_rle.decode_rle_hybrid(data, n, bit_width)
+        np.testing.assert_array_equal(out, values)
+
+
+def test_rle_hybrid_runs():
+    # long runs → RLE encoding path
+    values = np.repeat(np.array([3, 1, 2, 0], dtype=np.uint32), [100, 8, 9, 50])
+    data = e_rle.encode_rle_hybrid(values, 2)
+    out, _ = e_rle.decode_rle_hybrid(data, len(values), 2)
+    np.testing.assert_array_equal(out, values)
+    # mixed short/long
+    values = np.concatenate([
+        np.array([1, 0, 1, 0, 1], dtype=np.uint32),
+        np.full(64, 1, dtype=np.uint32),
+        np.array([0, 1, 0], dtype=np.uint32),
+    ])
+    data = e_rle.encode_rle_hybrid(values, 1)
+    out, _ = e_rle.decode_rle_hybrid(data, len(values), 1)
+    np.testing.assert_array_equal(out, values)
+
+
+def test_rle_length_prefixed():
+    values = rng.integers(0, 2, 500, dtype=np.uint32)
+    data = e_rle.encode_length_prefixed(values, 1)
+    out, end = e_rle.decode_length_prefixed(data + b"trailing", len(values), 1)
+    assert end == len(data)
+    np.testing.assert_array_equal(out, values)
+
+
+def test_rle_bit_width_zero():
+    out, end = e_rle.decode_rle_hybrid(b"", 10, 0)
+    np.testing.assert_array_equal(out, np.zeros(10))
+
+
+# --------------------------------------------------------------------- DELTA_*
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64])
+def test_delta_binary_packed_roundtrip(dtype):
+    info = np.iinfo(dtype)
+    cases = [
+        np.array([], dtype=dtype),
+        np.array([42], dtype=dtype),
+        np.arange(1000, dtype=dtype),
+        rng.integers(info.min, info.max, 777).astype(dtype),
+        np.array([info.min, info.max, 0, -1, 1], dtype=dtype),
+        np.full(300, -7, dtype=dtype),
+    ]
+    for values in cases:
+        data = e_delta.encode_delta_binary_packed(values)
+        out, _ = e_delta.decode_delta_binary_packed(data, out_dtype=dtype)
+        np.testing.assert_array_equal(out.astype(dtype), values)
+
+
+def test_delta_extreme_deltas():
+    # deltas overflow int64 → wraparound arithmetic must be bit-exact
+    v = np.array([-(2**62), 2**62, -(2**62), 0, 2**63 - 1, -(2**63)], dtype=np.int64)
+    data = e_delta.encode_delta_binary_packed(v)
+    out, _ = e_delta.decode_delta_binary_packed(data)
+    np.testing.assert_array_equal(out, v)
+
+
+def test_delta_length_byte_array():
+    values = [b"alpha", b"", b"gamma" * 100, b"d"]
+    col = ByteArrayColumn.from_list(values)
+    data = e_delta.encode_delta_length_byte_array(col)
+    out, _ = e_delta.decode_delta_length_byte_array(data)
+    assert out.to_list() == values
+
+
+def test_delta_byte_array():
+    values = [b"apple", b"applesauce", b"application", b"banana", b"band", b""]
+    col = ByteArrayColumn.from_list(values)
+    data = e_delta.encode_delta_byte_array(col)
+    out, _ = e_delta.decode_delta_byte_array(data)
+    assert out.to_list() == values
+
+
+# ----------------------------------------------------------- BYTE_STREAM_SPLIT
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_byte_stream_split(dtype):
+    values = rng.standard_normal(257).astype(dtype)
+    data = e_bss.encode_byte_stream_split(values)
+    out = e_bss.decode_byte_stream_split(data, len(values), dtype)
+    np.testing.assert_array_equal(out, values)
+
+
+# ------------------------------------------------------------------ dictionary
+
+def test_dictionary_int():
+    values = rng.integers(0, 50, 1000).astype(np.int64)
+    d, idx = build_dictionary(values, Type.INT64)
+    np.testing.assert_array_equal(gather(d, idx), values)
+    # first-appearance order
+    seen = []
+    for v in values:
+        if v not in seen:
+            seen.append(v)
+    np.testing.assert_array_equal(d, np.array(seen, dtype=np.int64))
+
+
+def test_dictionary_byte_array():
+    words = [b"foo", b"bar", b"foo", b"baz", b"bar", b"foo"]
+    col = ByteArrayColumn.from_list(words)
+    d, idx = build_dictionary(col, Type.BYTE_ARRAY)
+    assert d.to_list() == [b"foo", b"bar", b"baz"]
+    assert gather(d, idx).to_list() == words
+
+
+def test_dict_indices_roundtrip():
+    idx = rng.integers(0, 1000, 5000).astype(np.uint32)
+    data = e_rle_dict = encode_dict_indices(idx, 1000)
+    out, _ = decode_dict_indices(data, len(idx))
+    np.testing.assert_array_equal(out, idx)

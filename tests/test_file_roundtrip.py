@@ -1,0 +1,211 @@
+"""End-to-end file round-trips through our own writer+reader across codecs,
+page versions, encodings, and null patterns."""
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    ColumnData,
+    CompressionCodec,
+    ParquetFileReader,
+    ParquetFileWriter,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+from parquet_floor_tpu.format.file_write import make_column_data
+
+rng = np.random.default_rng(11)
+
+
+def flat_schema():
+    return types.message(
+        "test",
+        types.required(types.INT64).named("id"),
+        types.optional(types.DOUBLE).named("score"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("name"),
+        types.optional(types.INT32).named("count"),
+        types.required(types.BOOLEAN).named("flag"),
+        types.required(types.FLOAT).named("ratio"),
+    )
+
+
+def sample_columns(n=1000):
+    return {
+        "id": np.arange(n, dtype=np.int64),
+        "score": [float(i) / 3 if i % 5 else None for i in range(n)],
+        "name": [f"user_{i % 100}" for i in range(n)],
+        "count": [i % 7 if i % 3 else None for i in range(n)],
+        "flag": (np.arange(n) % 2 == 0),
+        "ratio": rng.standard_normal(n).astype(np.float32),
+    }
+
+
+def roundtrip(tmp_path, options, n=1000, row_groups=1):
+    path = tmp_path / "t.parquet"
+    schema = flat_schema()
+    cols = sample_columns(n)
+    with ParquetFileWriter(path, schema, options) as w:
+        for _ in range(row_groups):
+            w.write_columns(cols)
+    with ParquetFileReader(path) as r:
+        assert r.record_count == n * row_groups
+        assert len(r.row_groups) == row_groups
+        for gi in range(row_groups):
+            batch = r.read_row_group(gi)
+            assert batch.num_rows == n
+            by_name = {b.descriptor.path[0]: b for b in batch.columns}
+            np.testing.assert_array_equal(by_name["id"].values, cols["id"])
+            np.testing.assert_array_equal(by_name["flag"].values, cols["flag"])
+            np.testing.assert_array_equal(by_name["ratio"].values, cols["ratio"])
+            # optional double with nulls
+            score = by_name["score"]
+            expected_vals = [v for v in cols["score"] if v is not None]
+            np.testing.assert_allclose(score.values, expected_vals)
+            mask = score.null_mask
+            assert mask is not None
+            np.testing.assert_array_equal(
+                mask, np.array([v is None for v in cols["score"]])
+            )
+            # strings
+            name = by_name["name"]
+            assert name.values.to_list() == [s.encode() for s in cols["name"]]
+        return r.metadata
+
+
+@pytest.mark.parametrize(
+    "codec",
+    [
+        CompressionCodec.UNCOMPRESSED,
+        CompressionCodec.SNAPPY,
+        CompressionCodec.GZIP,
+        CompressionCodec.ZSTD,
+        CompressionCodec.LZ4_RAW,
+    ],
+)
+def test_roundtrip_codecs(tmp_path, codec):
+    roundtrip(tmp_path, WriterOptions(codec=codec))
+
+
+@pytest.mark.parametrize("version", [1, 2])
+def test_roundtrip_page_versions(tmp_path, version):
+    roundtrip(tmp_path, WriterOptions(page_version=version))
+
+
+def test_roundtrip_no_dictionary(tmp_path):
+    roundtrip(tmp_path, WriterOptions(enable_dictionary=False))
+
+
+def test_roundtrip_delta_integers(tmp_path):
+    roundtrip(tmp_path, WriterOptions(enable_dictionary=False, delta_integers=True))
+
+
+def test_roundtrip_byte_stream_split(tmp_path):
+    roundtrip(
+        tmp_path,
+        WriterOptions(enable_dictionary=False, byte_stream_split_floats=True),
+    )
+
+
+def test_roundtrip_multiple_row_groups_and_pages(tmp_path):
+    roundtrip(tmp_path, WriterOptions(data_page_values=100), n=1000, row_groups=3)
+
+
+def test_roundtrip_crc_verification(tmp_path):
+    path = tmp_path / "t.parquet"
+    schema = flat_schema()
+    with ParquetFileWriter(path, schema, WriterOptions()) as w:
+        w.write_columns(sample_columns(100))
+    with ParquetFileReader(path, verify_crc=True) as r:
+        r.read_row_group(0)
+
+
+def test_metadata_surface(tmp_path):
+    meta = roundtrip(tmp_path, WriterOptions())
+    assert meta.created_by and "parquet-floor-tpu" in meta.created_by
+    assert meta.schema.is_flat
+    rg = meta.row_groups[0]
+    id_chunk = rg.columns[0]
+    assert id_chunk.meta_data.path_in_schema == ["id"]
+    st = id_chunk.meta_data.statistics
+    assert st.null_count == 0
+    assert int.from_bytes(st.min_value, "little") == 0
+    assert int.from_bytes(st.max_value, "little") == 999
+
+
+def test_key_value_metadata(tmp_path):
+    path = tmp_path / "kv.parquet"
+    schema = types.message("m", types.required(types.INT32).named("x"))
+    w = ParquetFileWriter(path, schema, key_value_metadata={"origin": "unit-test"})
+    w.write_columns({"x": np.array([1, 2, 3], dtype=np.int32)})
+    w.close()
+    with ParquetFileReader(path) as r:
+        assert r.metadata.key_value_metadata["origin"] == "unit-test"
+
+
+def test_all_null_column(tmp_path):
+    path = tmp_path / "nulls.parquet"
+    schema = types.message("m", types.optional(types.INT64).named("x"))
+    with ParquetFileWriter(path, schema) as w:
+        w.write_columns({"x": [None] * 50})
+    with ParquetFileReader(path) as r:
+        batch = r.read_row_group(0)
+        col = batch.columns[0]
+        assert col.num_values == 50
+        assert len(col.values) == 0
+        assert np.all(col.null_mask)
+
+
+def test_empty_strings_and_large_values(tmp_path):
+    path = tmp_path / "strs.parquet"
+    schema = types.message("m", types.required(types.BYTE_ARRAY).named("b"))
+    values = [b"", b"\x00" * 3, bytes(rng.integers(0, 256, 70000).astype(np.uint8)), b"end"]
+    with ParquetFileWriter(path, schema, WriterOptions(enable_dictionary=False)) as w:
+        w.write_columns({"b": ByteArrayColumn.from_list(values)})
+    with ParquetFileReader(path) as r:
+        col = r.read_row_group(0).columns[0]
+        assert col.values.to_list() == values
+
+
+def test_zero_row_row_group(tmp_path):
+    """Regression: empty row groups written by our writer must read back."""
+    path = tmp_path / "zero.parquet"
+    schema = types.message("m", types.required(types.INT64).named("a"))
+    with ParquetFileWriter(path, schema) as w:
+        w.write_columns({"a": np.array([], dtype=np.int64)})
+    with ParquetFileReader(path) as r:
+        batch = r.read_row_group(0)
+        assert batch.num_rows == 0
+        assert len(batch.columns[0].values) == 0
+
+
+def test_writer_exception_releases_file(tmp_path):
+    """Regression: an exception mid-write must close the sink (no fd leak,
+    no footer over partial data)."""
+    path = tmp_path / "partial.parquet"
+    schema = types.message("m", types.required(types.INT64).named("a"))
+    with pytest.raises(ValueError):
+        with ParquetFileWriter(path, schema) as w:
+            w.write_columns({"a": np.array([1, 2], dtype=np.int64)})
+            raise ValueError("boom")
+    assert w.sink._fh.closed if w.sink._own else True
+    # the truncated file must not parse as valid parquet
+    with pytest.raises(ValueError):
+        ParquetFileReader(path)
+
+
+def test_corrupt_rle_stream_raises_valueerror(tmp_path):
+    from parquet_floor_tpu.format.encodings import rle_hybrid as rle
+
+    # header promises more values than the stream carries
+    good = rle.encode_rle_hybrid(np.ones(100, dtype=np.uint32), 1)
+    with pytest.raises(ValueError):
+        rle.decode_rle_hybrid(good[: len(good) // 2], 1000, 1)
+
+
+def test_truncated_plain_page_raises(tmp_path):
+    from parquet_floor_tpu.format.encodings import plain as e_plain
+    from parquet_floor_tpu.format.parquet_thrift import Type as _T
+
+    with pytest.raises(ValueError, match="truncated"):
+        e_plain.decode_plain(b"\x01\x02", 100, _T.INT64)

@@ -1,0 +1,243 @@
+"""Interop golden tests: files written by pyarrow must read identically, and
+files we write must read back identically under pyarrow (SURVEY.md §4:
+"footer/Thrift golden tests against externally-generated files").
+"""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+pq = pytest.importorskip("pyarrow.parquet")
+
+from parquet_floor_tpu import (
+    CompressionCodec,
+    ParquetFileReader,
+    ParquetFileWriter,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+
+rng = np.random.default_rng(3)
+
+
+def _table(n=2000):
+    return pa.table(
+        {
+            "i64": pa.array(rng.integers(-(2**60), 2**60, n), type=pa.int64()),
+            "i32": pa.array(rng.integers(-(2**30), 2**30, n), type=pa.int32()),
+            "f64": pa.array(rng.standard_normal(n), type=pa.float64()),
+            "f32": pa.array(rng.standard_normal(n).astype(np.float32), type=pa.float32()),
+            "b": pa.array(rng.integers(0, 2, n).astype(bool)),
+            "s": pa.array([f"value_{i % 37}" for i in range(n)]),
+            "opt": pa.array(
+                [None if i % 7 == 0 else int(i) for i in range(n)], type=pa.int64()
+            ),
+            "optstr": pa.array(
+                [None if i % 11 == 0 else f"s{i % 5}" for i in range(n)], type=pa.string()
+            ),
+        }
+    )
+
+
+def _assert_matches_table(path, table):
+    with ParquetFileReader(path) as r:
+        assert r.record_count == table.num_rows
+        cols = {}
+        masks = {}
+        nrows = 0
+        for batch in r.iter_row_groups():
+            for cb in batch.columns:
+                name = cb.descriptor.path[0]
+                dense, mask = cb.dense()
+                cols.setdefault(name, []).append(dense)
+                masks.setdefault(name, []).append(
+                    mask if mask is not None else np.zeros(batch.num_rows, bool)
+                )
+            nrows += batch.num_rows
+        assert nrows == table.num_rows
+        for name in table.column_names:
+            expected = table.column(name)
+            mask = np.concatenate(masks[name])
+            exp_null = np.array([v is None for v in expected.to_pylist()])
+            np.testing.assert_array_equal(mask, exp_null, err_msg=f"null mask {name}")
+            parts = cols[name]
+            if isinstance(parts[0], ByteArrayColumn):
+                got = []
+                for p in parts:
+                    got.extend(p.to_list())
+                exp = [
+                    (v.encode() if isinstance(v, str) else v) or b""
+                    for v in expected.to_pylist()
+                ]
+                exp = [b"" if e is None else e for e in exp]
+                assert got == exp, f"column {name} mismatch"
+            else:
+                got = np.concatenate(parts)
+                exp_vals = expected.to_pandas().to_numpy()
+                valid = ~exp_null
+                np.testing.assert_array_equal(
+                    got[valid],
+                    exp_vals[valid].astype(got.dtype),
+                    err_msg=f"column {name} mismatch",
+                )
+
+
+@pytest.mark.parametrize("compression", ["NONE", "SNAPPY", "GZIP", "ZSTD"])
+@pytest.mark.parametrize("dictionary", [True, False])
+def test_read_pyarrow_file(tmp_path, compression, dictionary):
+    if compression != "NONE" and not pa.Codec.is_available(compression.lower()):
+        pytest.skip(f"{compression} not built into pyarrow")
+    table = _table()
+    path = tmp_path / "pa.parquet"
+    pq.write_table(
+        table, path, compression=compression, use_dictionary=dictionary,
+        row_group_size=700,
+    )
+    _assert_matches_table(path, table)
+
+
+@pytest.mark.parametrize("version", ["1.0", "2.4", "2.6"])
+def test_read_pyarrow_format_versions(tmp_path, version):
+    table = _table(500)
+    path = tmp_path / "pa.parquet"
+    pq.write_table(table, path, version=version)
+    _assert_matches_table(path, table)
+
+
+def test_read_pyarrow_v2_data_pages(tmp_path):
+    table = _table(800)
+    path = tmp_path / "pa.parquet"
+    pq.write_table(table, path, data_page_version="2.0", compression="SNAPPY")
+    _assert_matches_table(path, table)
+
+
+def test_read_pyarrow_delta_encodings(tmp_path):
+    n = 1000
+    table = pa.table(
+        {
+            "d32": pa.array(np.cumsum(rng.integers(-5, 100, n)).astype(np.int32)),
+            "d64": pa.array(np.cumsum(rng.integers(-5, 100, n)).astype(np.int64)),
+            "dl": pa.array([f"str{i}" for i in range(n)]),
+        }
+    )
+    path = tmp_path / "delta.parquet"
+    pq.write_table(
+        table, path, use_dictionary=False,
+        column_encoding={"d32": "DELTA_BINARY_PACKED", "d64": "DELTA_BINARY_PACKED",
+                         "dl": "DELTA_LENGTH_BYTE_ARRAY"},
+    )
+    _assert_matches_table(path, table)
+
+
+def test_read_pyarrow_delta_byte_array(tmp_path):
+    n = 500
+    table = pa.table({"s": pa.array([f"prefix_common_{i:06d}" for i in range(n)])})
+    path = tmp_path / "dba.parquet"
+    pq.write_table(table, path, use_dictionary=False,
+                   column_encoding={"s": "DELTA_BYTE_ARRAY"})
+    _assert_matches_table(path, table)
+
+
+def test_read_pyarrow_byte_stream_split(tmp_path):
+    n = 500
+    table = pa.table({"f": pa.array(rng.standard_normal(n), type=pa.float64())})
+    path = tmp_path / "bss.parquet"
+    pq.write_table(table, path, use_dictionary=False,
+                   column_encoding={"f": "BYTE_STREAM_SPLIT"})
+    _assert_matches_table(path, table)
+
+
+def test_read_pyarrow_fixed_len_byte_array(tmp_path):
+    n = 100
+    vals = [bytes(rng.integers(0, 256, 8).astype(np.uint8)) for _ in range(n)]
+    table = pa.table({"f": pa.array(vals, type=pa.binary(8))})
+    path = tmp_path / "flba.parquet"
+    pq.write_table(table, path)
+    with ParquetFileReader(path) as r:
+        col = r.read_row_group(0).columns[0]
+        got = [bytes(row) for row in np.asarray(col.values)]
+        assert got == vals
+
+
+# ---------------------------------------------------------------------------
+# our writer → pyarrow reader
+# ---------------------------------------------------------------------------
+
+def _our_file(tmp_path, options):
+    n = 1500
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("id"),
+        types.optional(types.DOUBLE).named("score"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("name"),
+        types.required(types.BOOLEAN).named("flag"),
+        types.optional(types.INT32).named("cnt"),
+        types.required(types.FLOAT).named("r"),
+    )
+    cols = {
+        "id": np.arange(n, dtype=np.int64) * 3 - 1000,
+        "score": [None if i % 6 == 0 else i * 0.5 for i in range(n)],
+        "name": [f"name_{i % 23}" for i in range(n)],
+        "flag": np.arange(n) % 3 == 0,
+        "cnt": [None if i % 9 == 0 else i % 1000 for i in range(n)],
+        "r": rng.standard_normal(n).astype(np.float32),
+    }
+    path = tmp_path / "ours.parquet"
+    with ParquetFileWriter(path, schema, options) as w:
+        w.write_columns(cols)
+    return path, cols, n
+
+
+@pytest.mark.parametrize(
+    "codec",
+    [CompressionCodec.UNCOMPRESSED, CompressionCodec.SNAPPY, CompressionCodec.GZIP,
+     CompressionCodec.ZSTD],
+)
+@pytest.mark.parametrize("version", [1, 2])
+def test_pyarrow_reads_our_file(tmp_path, codec, version):
+    path, cols, n = _our_file(
+        tmp_path, WriterOptions(codec=codec, page_version=version)
+    )
+    table = pq.read_table(path)
+    assert table.num_rows == n
+    np.testing.assert_array_equal(table.column("id").to_numpy(), cols["id"])
+    assert table.column("score").to_pylist() == cols["score"]
+    assert table.column("name").to_pylist() == cols["name"]
+    np.testing.assert_array_equal(
+        table.column("flag").to_numpy(), np.asarray(cols["flag"])
+    )
+    assert table.column("cnt").to_pylist() == cols["cnt"]
+    np.testing.assert_array_equal(table.column("r").to_numpy(), cols["r"])
+
+
+@pytest.mark.parametrize("version", [1, 2])
+def test_pyarrow_reads_our_encodings(tmp_path, version):
+    for opt in [
+        WriterOptions(enable_dictionary=False, page_version=version),
+        WriterOptions(enable_dictionary=False, delta_integers=True, page_version=version),
+        WriterOptions(enable_dictionary=False, byte_stream_split_floats=True,
+                      page_version=version),
+        WriterOptions(data_page_values=128, page_version=version),
+    ]:
+        path, cols, n = _our_file(tmp_path, opt)
+        table = pq.read_table(path)
+        assert table.num_rows == n
+        np.testing.assert_array_equal(table.column("id").to_numpy(), cols["id"])
+        assert table.column("score").to_pylist() == cols["score"]
+
+
+def test_pyarrow_sees_our_statistics(tmp_path):
+    path, cols, n = _our_file(tmp_path, WriterOptions())
+    meta = pq.read_metadata(path)
+    col0 = meta.row_group(0).column(0)  # id
+    assert col0.statistics.min == int(np.min(cols["id"]))
+    assert col0.statistics.max == int(np.max(cols["id"]))
+    assert col0.statistics.null_count == 0
+    assert meta.num_rows == n
+
+
+def test_pyarrow_roundtrip_metadata_created_by(tmp_path):
+    path, *_ = _our_file(tmp_path, WriterOptions())
+    meta = pq.read_metadata(path)
+    assert "parquet-floor-tpu" in meta.created_by

@@ -1,0 +1,67 @@
+"""Snappy codec tests: self-roundtrip + interop against pyarrow's canonical
+snappy implementation (the external oracle; SURVEY.md §4 interop stance)."""
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu.format import snappy
+
+try:
+    import pyarrow as pa
+
+    _SNAPPY_ORACLE = pa.Codec.is_available("snappy")
+except ImportError:
+    _SNAPPY_ORACLE = False
+
+rng = np.random.default_rng(7)
+
+CASES = [
+    b"",
+    b"a",
+    b"aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa",
+    b"abcabcabcabcabcabcabcabcabcabc",
+    bytes(rng.integers(0, 256, 10000).astype(np.uint8)),  # incompressible
+    bytes(np.repeat(rng.integers(0, 4, 1000), 17).astype(np.uint8)),  # runs
+    b"the quick brown fox jumps over the lazy dog " * 200,
+    bytes(20) + b"x" * 100 + bytes(20),
+]
+
+
+@pytest.mark.parametrize("i", range(len(CASES)))
+def test_roundtrip(i):
+    data = CASES[i]
+    comp = snappy.compress(data)
+    assert snappy.decompress(comp) == data
+
+
+def test_compression_actually_compresses():
+    data = b"hello world " * 1000
+    assert len(snappy.compress(data)) < len(data) // 4
+
+
+@pytest.mark.skipif(not _SNAPPY_ORACLE, reason="pyarrow snappy not available")
+@pytest.mark.parametrize("i", range(len(CASES)))
+def test_oracle_decodes_ours(i):
+    codec = pa.Codec("snappy")
+    data = CASES[i]
+    assert codec.decompress(snappy.compress(data), len(data)).to_pybytes() == data
+
+
+@pytest.mark.skipif(not _SNAPPY_ORACLE, reason="pyarrow snappy not available")
+@pytest.mark.parametrize("i", range(len(CASES)))
+def test_we_decode_oracle(i):
+    codec = pa.Codec("snappy")
+    data = CASES[i]
+    assert snappy.decompress(codec.compress(data).to_pybytes()) == data
+
+
+def test_overlapping_copy():
+    # pattern repetition exercises offset < length copies
+    data = b"ab" * 1000
+    comp = snappy.compress(data)
+    assert snappy.decompress(comp) == data
+
+
+def test_corrupt_stream_raises():
+    with pytest.raises(snappy.SnappyError):
+        snappy.decompress(b"\x20\x01")  # claims 32 bytes, provides garbage

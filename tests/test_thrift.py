@@ -1,0 +1,144 @@
+"""Thrift compact protocol unit tests (SURVEY.md §4: per-layer tests the
+reference skipped because parquet-mr owned the format)."""
+
+import pytest
+
+from parquet_floor_tpu.format.thrift import (
+    CompactReader,
+    CompactWriter,
+    T_BOOL,
+    T_BINARY,
+    T_I32,
+    T_I64,
+    T_STRING,
+    TList,
+    ThriftStruct,
+    zigzag_decode,
+    zigzag_encode,
+)
+from parquet_floor_tpu.format.parquet_thrift import (
+    FileMetaData,
+    LogicalType,
+    PageHeader,
+    SchemaElement,
+    Statistics,
+    StringType,
+)
+
+
+class Inner(ThriftStruct):
+    FIELDS = {1: ("a", T_I32), 2: ("name", T_STRING)}
+
+
+class Outer(ThriftStruct):
+    FIELDS = {
+        1: ("flag", T_BOOL),
+        2: ("big", T_I64),
+        3: ("inner", Inner),
+        4: ("items", TList(T_I32)),
+        5: ("blob", T_BINARY),
+        16: ("far_field", T_I32),  # forces long-form field header
+    }
+
+
+def test_zigzag_roundtrip():
+    for v in [0, 1, -1, 2, -2, 63, -64, 2**31 - 1, -(2**31), 2**62, -(2**62)]:
+        assert zigzag_decode(zigzag_encode(v)) == v
+
+
+def test_varint_roundtrip():
+    w = CompactWriter()
+    values = [0, 1, 127, 128, 300, 2**21, 2**35, 2**63 - 1]
+    for v in values:
+        w.write_varint(v)
+    r = CompactReader(w.getvalue())
+    assert [r.read_varint() for _ in values] == values
+
+
+def test_struct_roundtrip():
+    obj = Outer(
+        flag=True,
+        big=-(2**40),
+        inner=Inner(a=-5, name="héllo"),
+        items=[1, 2, 3, -4, 5000],
+        blob=b"\x00\xff\x10",
+        far_field=42,
+    )
+    data = obj.to_bytes()
+    back, end = Outer.from_bytes(data)
+    assert end == len(data)
+    assert back == obj
+
+
+def test_false_bool_and_none_fields():
+    obj = Outer(flag=False, items=[])
+    back, _ = Outer.from_bytes(obj.to_bytes())
+    assert back.flag is False
+    assert back.items == []
+    assert back.big is None and back.inner is None
+
+
+def test_unknown_field_skipped():
+    class V2(ThriftStruct):
+        FIELDS = {1: ("a", T_I32), 2: ("extra", Inner), 3: ("z", T_STRING)}
+
+    class V1(ThriftStruct):
+        FIELDS = {1: ("a", T_I32), 3: ("z", T_STRING)}
+
+    v2 = V2(a=7, extra=Inner(a=1, name="x"), z="keep")
+    v1, _ = V1.from_bytes(v2.to_bytes())
+    assert v1.a == 7 and v1.z == "keep"
+
+
+def test_long_list_header():
+    class L(ThriftStruct):
+        FIELDS = {1: ("xs", TList(T_I32))}
+
+    xs = list(range(100))
+    back, _ = L.from_bytes(L(xs=xs).to_bytes())
+    assert back.xs == xs
+
+
+def test_nested_parquet_structures():
+    ph = PageHeader(
+        type=0,
+        uncompressed_page_size=100,
+        compressed_page_size=50,
+        crc=-123456,
+    )
+    back, _ = PageHeader.from_bytes(ph.to_bytes())
+    assert back == ph
+
+    se = SchemaElement(name="col", type=2, repetition_type=1,
+                       logicalType=LogicalType(STRING=StringType()))
+    back, _ = SchemaElement.from_bytes(se.to_bytes())
+    assert back.logicalType.STRING is not None
+
+    st = Statistics(null_count=3, min_value=b"\x01", max_value=b"\x09",
+                    is_max_value_exact=True)
+    back, _ = Statistics.from_bytes(st.to_bytes())
+    assert back == st
+
+
+def test_empty_filemetadata_fields():
+    fm = FileMetaData(version=2, num_rows=0, schema=[SchemaElement(name="root", num_children=0)])
+    back, _ = FileMetaData.from_bytes(fm.to_bytes())
+    assert back.version == 2
+    assert back.num_rows == 0
+    assert len(back.schema) == 1
+
+
+def test_unknown_list_of_bool_field_skipped():
+    """Regression: bools occupy one byte as container elements; skipping an
+    unknown list<bool> field must consume them and stay in sync."""
+
+    class V2(ThriftStruct):
+        FIELDS = {1: ("bools", TList(T_BOOL)), 2: ("x", T_I32)}
+
+    class V1(ThriftStruct):
+        FIELDS = {2: ("x", T_I32)}
+
+    v2 = V2(bools=[True, False, True], x=42)
+    v1, end = V1.from_bytes(v2.to_bytes())
+    assert v1.x == 42
+    assert end == len(v2.to_bytes())

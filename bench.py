@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Headline benchmark: TPC-H lineitem decode throughput (BASELINE config #2).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "rows/s", "vs_baseline": N}
+
+* value        — rows/s decoding all 16 lineitem columns with the TPU engine
+                 (end to end: file read, Snappy decompress, run-table parse,
+                 host→HBM transfer, device expand+gather, block_until_ready)
+* vs_baseline  — ratio vs the single-thread CPU decode of the same file with
+                 the host NumPy engine (the reference-equivalent decoder;
+                 the reference publishes no numbers of its own — SURVEY.md §6)
+
+Env knobs: PFTPU_BENCH_ROWS (default 1_000_000), PFTPU_BENCH_REPS (default 3).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import numpy as np  # noqa: F401
+
+    n_rows = int(os.environ.get("PFTPU_BENCH_ROWS", 1_000_000))
+    reps = int(os.environ.get("PFTPU_BENCH_REPS", 3))
+    path = os.path.join("/tmp", f"pftpu_bench_lineitem_{n_rows}.parquet")
+
+    from benchmarks.workloads import write_lineitem
+
+    if not os.path.exists(path):
+        write_lineitem(path, n_rows)
+
+    from parquet_floor_tpu.format.file_read import ParquetFileReader
+
+    # --- CPU single-thread baseline (host NumPy engine) --------------------
+    def cpu_decode():
+        with ParquetFileReader(path) as r:
+            rows = 0
+            for batch in r.iter_row_groups():
+                for col in batch.columns:
+                    _ = col.values
+                rows += batch.num_rows
+            return rows
+
+    cpu_decode()  # warm page cache
+    t0 = time.perf_counter()
+    rows = cpu_decode()
+    cpu_dt = time.perf_counter() - t0
+    cpu_rps = rows / cpu_dt
+
+    # --- TPU engine --------------------------------------------------------
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # INT64/DOUBLE columns
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+
+    def tpu_decode():
+        with TpuRowGroupReader(path) as r:
+            rows = 0
+            outs = []
+            for gi in range(r.num_row_groups):
+                cols = r.read_row_group(gi)
+                outs.extend(c.values for c in cols.values())
+                rows += next(iter(cols.values())).values.shape[0]
+            for o in outs:
+                o.block_until_ready()
+            return rows
+
+    tpu_decode()  # compile warmup
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        rows_t = tpu_decode()
+        best = min(best, time.perf_counter() - t0)
+    assert rows_t == rows
+    tpu_rps = rows / best
+
+    result = {
+        "metric": "tpch_lineitem_snappy_dict_decode",
+        "value": round(tpu_rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(tpu_rps / cpu_rps, 3),
+        "detail": {
+            "rows": rows,
+            "cpu_rows_per_sec": round(cpu_rps, 1),
+            "tpu_rows_per_sec": round(tpu_rps, 1),
+            "backend": jax.devices()[0].platform,
+            "file_bytes": os.path.getsize(path),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

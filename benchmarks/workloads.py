@@ -1,0 +1,197 @@
+"""Benchmark workload generators for the five BASELINE.json configs.
+
+Config #2's TPC-H lineitem shape follows the public TPC-H spec's column
+domains (16 columns: 4 int keys, 4 decimals-as-double, 2 flag strings,
+3 dates, 2 instruction strings, 1 freeform comment).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from parquet_floor_tpu import ParquetFileWriter, WriterOptions, types
+from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+from parquet_floor_tpu.format.parquet_thrift import CompressionCodec
+
+
+def lineitem_schema():
+    t = types
+    s = lambda b: b.as_(t.string())  # noqa: E731
+    return t.message(
+        "lineitem",
+        t.required(t.INT64).named("l_orderkey"),
+        t.required(t.INT64).named("l_partkey"),
+        t.required(t.INT64).named("l_suppkey"),
+        t.required(t.INT32).named("l_linenumber"),
+        t.required(t.DOUBLE).named("l_quantity"),
+        t.required(t.DOUBLE).named("l_extendedprice"),
+        t.required(t.DOUBLE).named("l_discount"),
+        t.required(t.DOUBLE).named("l_tax"),
+        s(t.required(t.BYTE_ARRAY)).named("l_returnflag"),
+        s(t.required(t.BYTE_ARRAY)).named("l_linestatus"),
+        t.required(t.INT32).as_(t.date()).named("l_shipdate"),
+        t.required(t.INT32).as_(t.date()).named("l_commitdate"),
+        t.required(t.INT32).as_(t.date()).named("l_receiptdate"),
+        s(t.required(t.BYTE_ARRAY)).named("l_shipinstruct"),
+        s(t.required(t.BYTE_ARRAY)).named("l_shipmode"),
+        s(t.required(t.BYTE_ARRAY)).named("l_comment"),
+    )
+
+
+_WORDS = (
+    "carefully final deposits detect slyly regular accounts sleep furiously "
+    "ironic requests wake quickly blithely even packages cajole express "
+    "pending foxes among theodolites nag bold pinto beans above the"
+).split()
+
+
+def lineitem_columns(n: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    orderkey = np.sort(rng.integers(1, n, n)).astype(np.int64)
+    date_base = 8035  # ~1992-01-01 in days-since-epoch
+    comments = np.array(
+        [" ".join(rng.choice(_WORDS, rng.integers(4, 9))) for _ in range(2048)]
+    )
+    comment_col = ByteArrayColumn.from_list(
+        [c.encode() for c in comments[rng.integers(0, len(comments), n)]]
+    )
+    return {
+        "l_orderkey": orderkey,
+        "l_partkey": rng.integers(1, n // 4 + 2, n).astype(np.int64),
+        "l_suppkey": rng.integers(1, n // 200 + 2, n).astype(np.int64),
+        "l_linenumber": rng.integers(1, 8, n).astype(np.int32),
+        "l_quantity": rng.integers(1, 51, n).astype(np.float64),
+        "l_extendedprice": np.round(rng.uniform(900, 105000, n), 2),
+        "l_discount": np.round(rng.integers(0, 11, n) * 0.01, 2),
+        "l_tax": np.round(rng.integers(0, 9, n) * 0.01, 2),
+        "l_returnflag": [("A", "N", "R")[i] for i in rng.integers(0, 3, n)],
+        "l_linestatus": [("O", "F")[i] for i in rng.integers(0, 2, n)],
+        "l_shipdate": (date_base + rng.integers(0, 2526, n)).astype(np.int32),
+        "l_commitdate": (date_base + rng.integers(0, 2526, n)).astype(np.int32),
+        "l_receiptdate": (date_base + rng.integers(0, 2526, n)).astype(np.int32),
+        "l_shipinstruct": [
+            ("DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN")[i]
+            for i in rng.integers(0, 4, n)
+        ],
+        "l_shipmode": [
+            ("AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK")[i]
+            for i in rng.integers(0, 7, n)
+        ],
+        "l_comment": comment_col,
+    }
+
+
+def write_lineitem(path, n_rows: int, row_group_rows: int = 250_000, seed: int = 0):
+    """Write the config-#2 file: Snappy + dictionary, v2 pages."""
+    schema = lineitem_schema()
+    opts = WriterOptions(
+        codec=CompressionCodec.SNAPPY, page_version=2,
+        data_page_values=50_000,
+    )
+    with ParquetFileWriter(path, schema, opts) as w:
+        done = 0
+        chunk = 0
+        while done < n_rows:
+            take = min(row_group_rows, n_rows - done)
+            w.write_columns(
+                {k: _slice_col(v, 0, take) for k, v in lineitem_columns(take, seed + chunk).items()}
+            )
+            done += take
+            chunk += 1
+    return path
+
+
+def _slice_col(v, lo, hi):
+    if isinstance(v, ByteArrayColumn):
+        return v
+    return v[lo:hi] if not isinstance(v, list) else v[lo:hi]
+
+
+def write_int64_plain(path, n_rows: int = 1_000_000, seed: int = 0):
+    """Config #1: single INT64 PLAIN column, uncompressed."""
+    rng = np.random.default_rng(seed)
+    schema = types.message("t", types.required(types.INT64).named("v"))
+    opts = WriterOptions(
+        codec=CompressionCodec.UNCOMPRESSED, enable_dictionary=False,
+        page_version=2, data_page_values=100_000,
+    )
+    with ParquetFileWriter(path, schema, opts) as w:
+        w.write_columns({"v": rng.integers(-(2**62), 2**62, n_rows).astype(np.int64)})
+    return path
+
+
+def write_taxi_like(path, n_rows: int = 1_000_000, seed: int = 0):
+    """Config #3: NYC-taxi-like — mixed DOUBLE/BYTE_ARRAY, ZSTD, optional."""
+    rng = np.random.default_rng(seed)
+    t = types
+    schema = t.message(
+        "trips",
+        t.required(t.DOUBLE).named("fare"),
+        t.optional(t.DOUBLE).named("tip"),
+        t.required(t.DOUBLE).named("distance"),
+        t.optional(t.BYTE_ARRAY).as_(t.string()).named("payment_type"),
+        t.required(t.INT64).named("pickup_ts"),
+        t.optional(t.INT32).named("passengers"),
+    )
+    mask = rng.random(n_rows)
+    opts = WriterOptions(codec=CompressionCodec.ZSTD, page_version=2,
+                         data_page_values=50_000)
+    pay = ("CASH", "CREDIT", "DISPUTE", "NOCHARGE")
+    with ParquetFileWriter(path, schema, opts) as w:
+        w.write_columns(
+            {
+                "fare": np.round(rng.uniform(2.5, 200, n_rows), 2),
+                "tip": [None if m < 0.3 else round(f, 2)
+                        for m, f in zip(mask, rng.uniform(0, 40, n_rows))],
+                "distance": np.round(rng.uniform(0.1, 40, n_rows), 2),
+                "payment_type": [None if m < 0.05 else pay[i]
+                                 for m, i in zip(mask, rng.integers(0, 4, n_rows))],
+                "pickup_ts": (1_600_000_000 + np.sort(rng.integers(0, 30_000_000, n_rows))).astype(np.int64),
+                "passengers": [None if m < 0.1 else int(i)
+                               for m, i in zip(mask, rng.integers(1, 7, n_rows))],
+            }
+        )
+    return path
+
+
+def write_wide_delta(path, n_rows: int = 20_000, n_cols: int = 1000, seed: int = 0):
+    """Config #4: 1000 INT32 columns, DELTA_BINARY_PACKED."""
+    rng = np.random.default_rng(seed)
+    t = types
+    schema = t.message(
+        "wide", *[t.required(t.INT32).named(f"c{i}") for i in range(n_cols)]
+    )
+    opts = WriterOptions(
+        codec=CompressionCodec.UNCOMPRESSED, enable_dictionary=False,
+        delta_integers=True, page_version=2, data_page_values=n_rows,
+    )
+    base = np.cumsum(rng.integers(-3, 60, n_rows)).astype(np.int32)
+    with ParquetFileWriter(path, schema, opts) as w:
+        w.write_columns({f"c{i}": base + i for i in range(n_cols)})
+    return path
+
+
+def write_nested_list(path, n_rows: int = 100_000, seed: int = 0):
+    """Config #5: LIST<STRUCT> repeated groups (written via pyarrow; the
+    engine-level Dremel read path is exercised against it)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(seed)
+    lengths = rng.integers(0, 5, n_rows)
+    total = int(lengths.sum())
+    item_ids = rng.integers(0, 1000, total)
+    qtys = rng.integers(1, 50, total)
+    offsets = np.zeros(n_rows + 1, np.int32)
+    np.cumsum(lengths, out=offsets[1:])
+    structs = pa.StructArray.from_arrays(
+        [pa.array(item_ids, type=pa.int64()), pa.array(qtys, type=pa.int32())],
+        ["item", "qty"],
+    )
+    lists = pa.ListArray.from_arrays(pa.array(offsets), structs)
+    table = pa.table({"order_id": pa.array(np.arange(n_rows), type=pa.int64()),
+                      "items": lists})
+    pq.write_table(table, path, compression="SNAPPY")
+    return path

@@ -22,6 +22,11 @@ import numpy as np
 
 from ..parquet_thrift import Type
 
+try:  # native length-chain scanner (optional fast path)
+    from ...native import binding as _native
+except Exception:  # pragma: no cover
+    _native = None
+
 _FIXED_DTYPES = {
     Type.INT32: np.dtype("<i4"),
     Type.INT64: np.dtype("<i8"),
@@ -177,20 +182,34 @@ def _decode_plain_byte_array(buf: memoryview, num_values: int):
     """Vectorized split of the interleaved length/payload stream.
 
     Strategy: lengths are data-dependent, so walk the length chain first
-    (cheap: one u32 read per value), then gather payloads with one fancy
-    index — no per-value Python bytes objects.
+    (one u32 read per value — native C++ when built, Python otherwise),
+    then gather payloads with one fancy index — no per-value Python bytes.
     """
     raw = np.frombuffer(buf, dtype=np.uint8)
-    starts = np.empty(num_values, dtype=np.int64)
-    lengths = np.empty(num_values, dtype=np.int64)
-    pos = 0
-    b = buf
-    for i in range(num_values):
-        ln = int.from_bytes(b[pos : pos + 4], "little")
-        pos += 4
-        starts[i] = pos
-        lengths[i] = ln
-        pos += ln
+    if _native is not None and _native.available() and num_values > 64:
+        starts, lengths = _native.plain_ba_scan(buf, num_values)
+        if len(starts) != num_values:
+            raise ValueError(
+                f"PLAIN BYTE_ARRAY stream ended after {len(starts)} of "
+                f"{num_values} values"
+            )
+        pos = int(starts[-1] + lengths[-1]) if num_values else 0
+    else:
+        starts = np.empty(num_values, dtype=np.int64)
+        lengths = np.empty(num_values, dtype=np.int64)
+        pos = 0
+        b = buf
+        end = len(buf)
+        for i in range(num_values):
+            if pos + 4 > end:
+                raise ValueError("PLAIN BYTE_ARRAY stream truncated")
+            ln = int.from_bytes(b[pos : pos + 4], "little")
+            pos += 4
+            if pos + ln > end:
+                raise ValueError("PLAIN BYTE_ARRAY stream truncated")
+            starts[i] = pos
+            lengths[i] = ln
+            pos += ln
     offsets = np.zeros(num_values + 1, dtype=np.int64)
     np.cumsum(lengths, out=offsets[1:])
     total = int(offsets[-1])

@@ -66,6 +66,11 @@ def _load():
         lib.pftpu_snappy_decompress.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
         ]
+        lib.pftpu_plain_ba_scan.restype = ctypes.c_ssize_t
+        lib.pftpu_plain_ba_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
+        ]
         lib.pftpu_rle_parse_runs.restype = ctypes.c_ssize_t
         lib.pftpu_rle_parse_runs.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,  # data
@@ -104,6 +109,28 @@ def snappy_decompress(data: bytes, uncompressed_size: Optional[int] = None) -> b
     if n < 0:
         raise ValueError("native snappy decompression failed")
     return out.raw[:n]
+
+
+def plain_ba_scan(data, max_values: int):
+    """Walk a PLAIN BYTE_ARRAY length chain natively (zero-copy input).
+
+    Returns (starts, lengths) int64 arrays of the values found (may be
+    fewer than max_values when the buffer ends first).
+    """
+    import numpy as np
+
+    lib = _load()
+    starts = np.zeros(max_values, dtype=np.int64)
+    lengths = np.zeros(max_values, dtype=np.int64)
+    arr = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    n = lib.pftpu_plain_ba_scan(
+        ctypes.c_char_p(arr.ctypes.data), len(arr), max_values,
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+    )
+    if n < 0:
+        raise ValueError("malformed PLAIN BYTE_ARRAY stream")
+    return starts[:n], lengths[:n]
 
 
 def rle_parse_runs(data: bytes, num_values: int, bit_width: int, pos: int = 0):

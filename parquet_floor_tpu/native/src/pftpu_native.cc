@@ -298,4 +298,30 @@ ptrdiff_t pftpu_rle_parse_runs(const uint8_t* data, size_t data_len,
   return static_cast<ptrdiff_t>(rows);
 }
 
+// ---------------------------------------------------------------------------
+// PLAIN BYTE_ARRAY length-chain walk (the only sequential part of string
+// decode; payload gather stays vectorized in NumPy / on device)
+// ---------------------------------------------------------------------------
+
+// Writes value payload start offsets and lengths; returns the number of
+// values parsed (≤ max_values), or -1 on a malformed chain.
+ptrdiff_t pftpu_plain_ba_scan(const uint8_t* data, size_t data_len,
+                              long long max_values, long long* out_starts,
+                              long long* out_lengths) {
+  size_t pos = 0;
+  long long n = 0;
+  while (pos < data_len && n < max_values) {
+    if (pos + 4 > data_len) return -1;
+    uint32_t len;
+    std::memcpy(&len, data + pos, 4);
+    pos += 4;
+    if (pos + len > data_len) return -1;
+    out_starts[n] = static_cast<long long>(pos);
+    out_lengths[n] = static_cast<long long>(len);
+    pos += len;
+    n++;
+  }
+  return n;
+}
+
 }  // extern "C"

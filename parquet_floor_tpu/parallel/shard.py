@@ -1,0 +1,232 @@
+"""Sharded decode over a device mesh — the first-class parallel component
+the reference explicitly declines to provide (``trySplit()`` → null,
+``ParquetReader.java:214-217``; SURVEY.md §2.4 item 3 names this a new
+component with no reference counterpart).
+
+Three parallel axes, composable over one `jax.sharding.Mesh`:
+
+  * **"rg" (data parallel)** — row groups are independent by construction;
+    each device decodes its shard of row groups.
+  * **"seq" (sequence parallel)** — within a chunk, the run-table expansion
+    is an arbitrary-offset computation (`rle_expand` binary-searches each
+    output element independently), so the *output index space* shards
+    cleanly: each device expands a contiguous slice of the column.
+  * **"dict" (tensor parallel)** — the dictionary shards across devices;
+    each device gathers the indices that land in its shard and a `psum`
+    over the axis assembles full values (a masked-gather + reduce, the
+    classic TP embedding-lookup pattern).
+
+Multi-host: the same meshes span hosts via jax's global device set; row
+groups naturally shard across hosts over DCN (each host reads only its
+groups' byte ranges), while "seq"/"dict" collectives ride ICI inside a pod.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..tpu import bitops
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, rg: Optional[int] = None,
+    seq: int = 1, dict_: int = 1,
+) -> Mesh:
+    """Build a (rg, seq, dict) mesh over the first ``n_devices`` devices."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if rg is None:
+        rg = n // (seq * dict_)
+    if rg * seq * dict_ != n:
+        raise ValueError(f"mesh {rg}x{seq}x{dict_} != {n} devices")
+    arr = np.array(devices[:n]).reshape(rg, seq, dict_)
+    return Mesh(arr, ("rg", "seq", "dict"))
+
+
+# ---------------------------------------------------------------------------
+# The sharded decode step
+# ---------------------------------------------------------------------------
+
+def _expand_slice(buf, out_end, kind, value, bitbase, out_offset, per, bw):
+    """Expand ``per`` outputs of a run table starting at ``out_offset``
+    (the sequence-parallel unit: any output slice computes independently)."""
+    out_idx = jax.lax.broadcasted_iota(jnp.int32, (per, 1), 0).reshape(per) + out_offset
+    rid = jnp.searchsorted(out_end, out_idx, side="right").astype(jnp.int32)
+    rid = jnp.minimum(rid, out_end.shape[0] - 1)
+    run_start = jnp.where(rid == 0, 0, out_end[jnp.maximum(rid - 1, 0)])
+    within = out_idx - run_start
+    bitpos = bitbase[rid] + within * bw
+    packed = bitops.extract_bits(buf, bitpos, bw).astype(jnp.int32)
+    return jnp.where(kind[rid] == 0, value[rid], packed)
+
+
+def build_sharded_decode_step(mesh: Mesh, n_per_group: int, bw: int, dict_pad: int,
+                              dtype=jnp.float32):
+    """Compile a full sharded decode step over ``mesh``.
+
+    Inputs (global shapes):
+      * ``bufs``      (G, B) uint8   — per-row-group value streams, sharded over "rg"
+      * run tables    (G, R) int32   — sharded over "rg", replicated over "seq"/"dict"
+      * ``dictionary`` (dict_pad,)   — sharded over "dict" (tensor parallel)
+
+    Output: (G, n_per_group) decoded values, sharded over ("rg", "seq").
+
+    Each device expands its output slice of its row groups, gathers from its
+    dictionary shard, and a psum over "dict" assembles full values — dp, sp,
+    and tp composed in one jitted step.
+    """
+    seq_size = mesh.shape["seq"]
+    dict_size = mesh.shape["dict"]
+    if n_per_group % seq_size:
+        raise ValueError("n_per_group must divide evenly over the seq axis")
+    if dict_pad % dict_size:
+        raise ValueError("dict_pad must divide evenly over the dict axis")
+    per = n_per_group // seq_size
+    dict_shard = dict_pad // dict_size
+
+    def step(bufs, out_end, kind, value, bitbase, dictionary):
+        # local shapes: bufs (g, B); tables (g, R); dictionary (dict_shard,)
+        seq_i = jax.lax.axis_index("seq")
+        dict_i = jax.lax.axis_index("dict")
+        out_offset = seq_i * per
+
+        def one_group(buf, oe, kd, vl, bb):
+            idx = _expand_slice(buf, oe, kd, vl, bb, out_offset, per, bw)
+            # tensor-parallel gather: mask indices outside my dictionary
+            # shard, gather locally, psum assembles the full values
+            local = idx - dict_i * dict_shard
+            in_shard = (local >= 0) & (local < dict_shard)
+            safe = jnp.clip(local, 0, dict_shard - 1)
+            vals = jnp.take(dictionary, safe, axis=0)
+            return jnp.where(in_shard, vals, jnp.zeros((), dtype=dictionary.dtype))
+
+        partial_vals = jax.vmap(one_group)(bufs, out_end, kind, value, bitbase)
+        return jax.lax.psum(partial_vals, axis_name="dict")
+
+    spec_rg = P("rg", None)
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(spec_rg, spec_rg, spec_rg, spec_rg, spec_rg, P("dict")),
+            out_specs=P("rg", "seq"),
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sharded file reading (data-parallel row groups)
+# ---------------------------------------------------------------------------
+
+class ShardedColumn:
+    """A globally-sharded decoded column: dense values + optional null mask."""
+
+    __slots__ = ("values", "mask")
+
+    def __init__(self, values: jax.Array, mask: Optional[jax.Array]):
+        self.values = values
+        self.mask = mask
+
+    def __repr__(self):
+        return f"ShardedColumn({self.values.shape}, nullable={self.mask is not None})"
+
+
+def _assemble_global(parts, devices, mesh, axis):
+    """Blocked assembly: group i of n_groups goes to device i*n_dev//n_groups;
+    contiguous groups concatenate per device so the global array is sharded
+    over the mesh axis (requires n_groups % n_dev == 0)."""
+    n_dev = len(devices)
+    per_dev = len(parts) // n_dev
+    shards = []
+    for d in range(n_dev):
+        chunk = parts[d * per_dev : (d + 1) * per_dev]
+        local = chunk[0] if len(chunk) == 1 else jnp.concatenate(chunk)
+        shards.append(jax.device_put(local, devices[d]))
+    global_shape = (sum(p.shape[0] for p in parts),) + parts[0].shape[1:]
+    return jax.make_array_from_single_device_arrays(
+        global_shape, NamedSharding(mesh, P(axis)), shards
+    )
+
+
+def read_table_sharded(
+    source,
+    mesh: Mesh,
+    columns: Optional[Sequence[str]] = None,
+    axis: str = "rg",
+) -> Dict[str, ShardedColumn]:
+    """Decode a parquet file with row groups data-parallel over ``mesh``.
+
+    Each mesh slot along ``axis`` decodes a contiguous block of row groups
+    (device-placed jits), and per-group arrays assemble into one global
+    array per column via ``jax.make_array_from_single_device_arrays`` —
+    rows end up sharded over the mesh axis, ready for sharded compute
+    without reshuffling.
+
+    Requirements (violations raise, never silently degrade): uniform row
+    counts per group, group count divisible by the axis device count.
+    String columns are not yet assembled globally.
+    """
+    from ..tpu.engine import TpuRowGroupReader
+
+    devices = mesh.devices.reshape(-1)
+    n_dev = len(devices)
+    readers = {d: TpuRowGroupReader(source, device=d) for d in set(devices)}
+    try:
+        any_reader = next(iter(readers.values()))
+        n_groups = any_reader.num_row_groups
+        if n_groups % n_dev:
+            raise ValueError(
+                f"{n_groups} row groups do not shard evenly over {n_dev} "
+                f"devices; re-chunk the file or use a smaller mesh axis"
+            )
+        per_group: Optional[int] = None
+        vals: Dict[str, list] = {}
+        masks: Dict[str, list] = {}
+        per_dev = n_groups // n_dev
+        for gi in range(n_groups):
+            dev = devices[gi // per_dev]
+            cols = readers[dev].read_row_group(gi, columns)
+            for name, dc in cols.items():
+                if dc.is_strings:
+                    raise NotImplementedError(
+                        "sharded string assembly lands with the string kernel"
+                    )
+                rows = dc.values.shape[0]
+                if per_group is None:
+                    per_group = rows
+                elif rows != per_group:
+                    raise ValueError(
+                        f"row group {gi} has {rows} rows != {per_group}; "
+                        "uniform groups required for global assembly"
+                    )
+                vals.setdefault(name, []).append(dc.values)
+                masks.setdefault(name, []).append(dc.mask)
+        out: Dict[str, ShardedColumn] = {}
+        for name, parts in vals.items():
+            gv = _assemble_global(parts, devices, mesh, axis)
+            mparts = masks[name]
+            if any(m is not None for m in mparts):
+                mparts = [
+                    m if m is not None else jnp.zeros(per_group, jnp.bool_)
+                    for m in mparts
+                ]
+                gm = _assemble_global(mparts, devices, mesh, axis)
+            else:
+                gm = None
+            out[name] = ShardedColumn(gv, gm)
+        return out
+    finally:
+        for r in readers.values():
+            r.close()

@@ -1,13 +1,21 @@
-"""Test env: force CPU with an 8-device virtual mesh before jax imports,
-so multi-chip sharding tests run without TPU hardware (SURVEY.md §4)."""
+"""Test env: force the CPU backend with an 8-device virtual mesh so
+multi-chip sharding tests run without TPU hardware (SURVEY.md §4).
+
+jax is preimported at interpreter startup in this image and the shell env
+pins JAX_PLATFORMS to the TPU plugin, so plain env-var setting is too late —
+configure through jax.config before any backend initializes instead.
+"""
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-import sys
+import jax
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

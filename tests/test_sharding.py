@@ -1,0 +1,123 @@
+"""Multi-device sharded decode on the 8-device virtual CPU mesh (the
+SURVEY.md §4 analogue of testing multi-node without a cluster)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from parquet_floor_tpu import ParquetFileWriter, WriterOptions, types
+from parquet_floor_tpu.format.encodings import rle_hybrid as e_rle
+from parquet_floor_tpu.format.encodings.dictionary import encode_dict_indices
+from parquet_floor_tpu.parallel import shard as pshard
+from parquet_floor_tpu.tpu import bitops
+
+rng = np.random.default_rng(31)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+def _make_group(n, dict_size, bw):
+    idx = rng.integers(0, dict_size, n).astype(np.uint32)
+    stream = encode_dict_indices(idx, 1 << bw)  # force bit width
+    assert stream[0] == bw or dict_size <= (1 << stream[0])
+    bw_actual = stream[0]
+    table, _ = e_rle.parse_runs(stream, n, bw_actual, 1)
+    plan = bitops.run_table_to_device_plan(table, n, 64)
+    return idx, stream, plan, bw_actual
+
+
+def test_sharded_decode_step_matches_host():
+    n_per_group = 1024
+    dict_pad = 512
+    bw = 9  # indices up to 512
+    mesh = pshard.make_mesh(8, rg=2, seq=2, dict_=2)
+
+    G = 4  # two row groups per rg shard
+    bufs = []
+    plans = {"run_out_end": [], "run_kind": [], "run_value": [], "run_bitbase": []}
+    expected_idx = []
+    B = 4096
+    for _ in range(G):
+        idx, stream, plan, bwa = _make_group(n_per_group, dict_pad, bw)
+        assert bwa == bw
+        buf = np.zeros(B, np.uint8)
+        buf[: len(stream)] = np.frombuffer(stream, np.uint8)
+        bufs.append(buf)
+        expected_idx.append(idx)
+        for k in plans:
+            plans[k].append(plan[k])
+    dictionary = (rng.standard_normal(dict_pad) * 100).astype(np.float32)
+
+    step = pshard.build_sharded_decode_step(
+        mesh, n_per_group, bw, dict_pad, jnp.float32
+    )
+    out = step(
+        jnp.asarray(np.stack(bufs)),
+        jnp.asarray(np.stack(plans["run_out_end"]).astype(np.int32)),
+        jnp.asarray(np.stack(plans["run_kind"]).astype(np.int32)),
+        jnp.asarray(np.stack(plans["run_value"]).astype(np.int32)),
+        jnp.asarray(np.stack(plans["run_bitbase"]).astype(np.int32)),
+        jnp.asarray(dictionary),
+    )
+    assert out.shape == (G, n_per_group)
+    expect = dictionary[np.stack(expected_idx)]
+    np.testing.assert_array_equal(np.asarray(out), expect)
+    # output really is sharded over the mesh
+    assert len(out.sharding.device_set) == 8
+
+
+def test_read_table_sharded(tmp_path):
+    n, groups = 1000, 4
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("a"),
+        types.required(types.DOUBLE).named("b"),
+    )
+    path = tmp_path / "s.parquet"
+    cols = {
+        "a": rng.integers(0, 50, n * groups).astype(np.int64),
+        "b": rng.integers(0, 9, n * groups).astype(np.float64),
+    }
+    with ParquetFileWriter(path, schema, WriterOptions()) as w:
+        for g in range(groups):
+            w.write_columns({k: v[g * n : (g + 1) * n] for k, v in cols.items()})
+    mesh = pshard.make_mesh(4, rg=4, seq=1, dict_=1)
+    out = pshard.read_table_sharded(path, mesh)
+    np.testing.assert_array_equal(np.asarray(out["a"].values), cols["a"])
+    np.testing.assert_array_equal(np.asarray(out["b"].values), cols["b"])
+    assert len(out["a"].values.sharding.device_set) == 4
+
+
+def test_read_table_sharded_masks_and_errors(tmp_path):
+    """Regression: nullable columns keep their masks; uneven group counts
+    raise instead of silently degrading to one device."""
+    n, groups = 400, 4
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("a"),
+        types.optional(types.INT64).named("o"),
+    )
+    path = tmp_path / "m.parquet"
+    a = rng.integers(0, 50, n * groups).astype(np.int64)
+    o = [None if i % 3 == 0 else int(i % 100) for i in range(n * groups)]
+    with ParquetFileWriter(path, schema, WriterOptions()) as w:
+        for g in range(groups):
+            w.write_columns({"a": a[g * n : (g + 1) * n], "o": o[g * n : (g + 1) * n]})
+    mesh = pshard.make_mesh(4, rg=4, seq=1, dict_=1)
+    out = pshard.read_table_sharded(path, mesh)
+    np.testing.assert_array_equal(np.asarray(out["a"].values), a)
+    assert out["a"].mask is None
+    exp_mask = np.array([v is None for v in o])
+    np.testing.assert_array_equal(np.asarray(out["o"].mask), exp_mask)
+    got = np.asarray(out["o"].values)
+    valid = ~exp_mask
+    np.testing.assert_array_equal(got[valid], np.array([v for v in o if v is not None]))
+    assert len(out["a"].values.sharding.device_set) == 4
+
+    mesh3 = pshard.make_mesh(3, rg=3, seq=1, dict_=1)
+    with pytest.raises(ValueError, match="shard evenly"):
+        pshard.read_table_sharded(path, mesh3)

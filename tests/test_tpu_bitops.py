@@ -1,0 +1,112 @@
+"""Device decode primitives vs the NumPy reference codecs (SURVEY.md §4:
+"kernel-vs-NumPy-reference equivalence tests")."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from parquet_floor_tpu.format.encodings import rle_hybrid as rle
+from parquet_floor_tpu.format.encodings import delta as e_delta
+from parquet_floor_tpu.tpu import bitops
+
+rng = np.random.default_rng(13)
+
+
+def _pad8(b: bytes) -> jnp.ndarray:
+    return jnp.asarray(np.frombuffer(b + b"\x00" * 8, dtype=np.uint8))
+
+
+@pytest.mark.parametrize("bw", [1, 2, 3, 7, 8, 13, 17, 24, 31])
+def test_bit_unpack_matches_numpy(bw):
+    n = 1024
+    vals = rng.integers(0, 1 << bw, n, dtype=np.uint64)
+    packed = rle.bit_pack(vals, bw)
+    out = bitops.bit_unpack(_pad8(packed), bw, n)
+    np.testing.assert_array_equal(np.asarray(out), vals.astype(np.int32))
+
+
+@pytest.mark.parametrize("bw", [1, 5, 12, 20, 32])
+def test_extract_bits_matches_numpy(bw):
+    n = 777
+    vals = rng.integers(0, 1 << bw, n, dtype=np.uint64)
+    packed = rle.bit_pack(np.concatenate([vals, np.zeros((-n) % 8, np.uint64)]), bw)
+    bitpos = jnp.arange(n, dtype=jnp.int32) * bw
+    out = bitops.extract_bits(_pad8(packed), bitpos, bw)
+    np.testing.assert_array_equal(np.asarray(out), vals.astype(np.uint32))
+
+
+@pytest.mark.parametrize("bw", [1, 3, 9, 20])
+def test_rle_expand_matches_numpy(bw):
+    n = 4000
+    # mix of long runs and noise → both run kinds
+    vals = rng.integers(0, 1 << bw, n, dtype=np.uint32)
+    vals[500:2500] = 5 % (1 << bw)
+    data = rle.encode_rle_hybrid(vals, bw)
+    table, _ = rle.parse_runs(data, n, bw)
+    plan = bitops.run_table_to_device_plan(table, n, bitops.bucket_size(len(table), 16))
+    out = bitops.rle_expand(
+        _pad8(data),
+        jnp.asarray(plan["run_out_end"]),
+        jnp.asarray(plan["run_kind"]),
+        jnp.asarray(plan["run_value"]),
+        jnp.asarray(plan["run_bitbase"]),
+        n,
+        bw,
+    )
+    np.testing.assert_array_equal(np.asarray(out), vals.astype(np.int32))
+
+
+def test_dense_scatter():
+    present = np.array([1, 0, 1, 1, 0, 0, 1], dtype=bool)
+    values = np.array([10.0, 20.0, 30.0, 40.0])
+    out = bitops.dense_scatter(jnp.asarray(values), jnp.asarray(present))
+    np.testing.assert_array_equal(
+        np.asarray(out), [10.0, 0, 20.0, 30.0, 0, 0, 40.0]
+    )
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.float32, np.float64])
+def test_bitcast_bytes(dtype):
+    n = 256
+    if np.issubdtype(dtype, np.integer):
+        vals = rng.integers(np.iinfo(dtype).min, np.iinfo(dtype).max, n).astype(dtype)
+    else:
+        vals = rng.standard_normal(n).astype(dtype)
+    out = bitops.bitcast_bytes(
+        jnp.asarray(np.frombuffer(vals.tobytes(), np.uint8)), dtype, n
+    )
+    np.testing.assert_array_equal(np.asarray(out), vals)
+
+
+def test_unpack_bools():
+    n = 1003
+    vals = rng.integers(0, 2, n).astype(bool)
+    packed = np.packbits(vals, bitorder="little")
+    out = bitops.unpack_bools(jnp.asarray(packed), n)
+    np.testing.assert_array_equal(np.asarray(out), vals)
+
+
+def test_delta_expand_matches_numpy():
+    n = 1000
+    vals = np.cumsum(rng.integers(-50, 50, n)).astype(np.int32)
+    data = e_delta.encode_delta_binary_packed(vals)
+    ref, _ = e_delta.decode_delta_binary_packed(data, out_dtype=np.int32)
+    np.testing.assert_array_equal(ref, vals)
+
+    # host-side header parse mirrors the engine's plan builder
+    from parquet_floor_tpu.tpu.engine import parse_delta_plan
+
+    plan = parse_delta_plan(np.frombuffer(data, np.uint8), np.int32)
+    assert plan is not None
+    out = bitops.delta_expand(
+        _pad8(data),
+        jnp.asarray(plan["mb_bitbase"]),
+        jnp.asarray(plan["mb_bw"]),
+        jnp.asarray(plan["mb_min_delta"]),
+        plan["first_value"],
+        n,
+        plan["values_per_miniblock"],
+    )
+    np.testing.assert_array_equal(np.asarray(out), vals)

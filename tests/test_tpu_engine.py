@@ -1,0 +1,212 @@
+"""TPU decode engine vs the host format engine: every device path must match
+the NumPy decode bit-for-bit (run on CPU backend; same code runs on TPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from parquet_floor_tpu import (
+    CompressionCodec,
+    ParquetFileReader,
+    ParquetFileWriter,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+from parquet_floor_tpu.tpu.engine import TpuRowGroupReader, f64bits_to_f32
+
+rng = np.random.default_rng(21)
+
+
+def _write(tmp_path, cols_spec, options, n=3000):
+    fields = []
+    data = {}
+    for name, (ptype, values, optional, logical) in cols_spec.items():
+        b = types.optional(ptype) if optional else types.required(ptype)
+        if logical:
+            b = b.as_(logical)
+        fields.append(b.named(name))
+        data[name] = values
+    schema = types.message("t", *fields)
+    path = tmp_path / "t.parquet"
+    with ParquetFileWriter(path, schema, options) as w:
+        w.write_columns(data)
+    return path
+
+
+def _check_against_host(path, columns=None):
+    """Decode with both engines and compare dense arrays."""
+    tpu = TpuRowGroupReader(path)
+    host = ParquetFileReader(path)
+    try:
+        for gi in range(len(host.row_groups)):
+            dev_cols = tpu.read_row_group(gi, columns)
+            host_batch = host.read_row_group(gi, set(columns) if columns else None)
+            for cb in host_batch.columns:
+                name = cb.descriptor.path[0]
+                dc = dev_cols[name]
+                h_dense, h_mask = cb.dense()
+                if h_mask is None:
+                    assert dc.mask is None or not np.asarray(dc.mask).any()
+                else:
+                    np.testing.assert_array_equal(np.asarray(dc.mask), h_mask, err_msg=name)
+                if isinstance(h_dense, ByteArrayColumn):
+                    lens = np.asarray(dc.lengths)
+                    rows = np.asarray(dc.values)
+                    got = [rows[i, : lens[i]].tobytes() for i in range(len(lens))]
+                    exp = h_dense.to_list()
+                    assert got == exp, f"strings mismatch in {name}"
+                else:
+                    np.testing.assert_array_equal(
+                        np.asarray(dc.values), h_dense, err_msg=name
+                    )
+    finally:
+        tpu.close()
+        host.close()
+
+
+def _std_cols(n=3000, dict_friendly=True):
+    mod = 50 if dict_friendly else 100000
+    return {
+        "i64": (types.INT64, (rng.integers(0, mod, n) * 7 - 3).astype(np.int64), False, None),
+        "i32": (types.INT32, rng.integers(0, mod, n).astype(np.int32), False, None),
+        "f32": (types.FLOAT, rng.integers(0, mod, n).astype(np.float32), False, None),
+        "f64": (types.DOUBLE, rng.integers(0, mod, n).astype(np.float64) * 0.5, False, None),
+        "s": (types.BYTE_ARRAY, [f"word_{i % (mod // 2)}" for i in range(n)], False, types.string()),
+        "b": (types.BOOLEAN, rng.integers(0, 2, n).astype(bool), False, None),
+        "opt64": (types.INT64, [None if i % 7 == 0 else i % mod for i in range(n)], True, None),
+        "opts": (types.BYTE_ARRAY, [None if i % 5 == 0 else f"s{i % 9}" for i in range(n)], True, types.string()),
+    }
+
+
+@pytest.mark.parametrize("codec", [CompressionCodec.UNCOMPRESSED, CompressionCodec.SNAPPY])
+@pytest.mark.parametrize("version", [1, 2])
+def test_dict_path(tmp_path, codec, version):
+    path = _write(tmp_path, _std_cols(), WriterOptions(codec=codec, page_version=version))
+    _check_against_host(path)
+
+
+@pytest.mark.parametrize("version", [1, 2])
+def test_plain_path(tmp_path, version):
+    path = _write(
+        tmp_path,
+        _std_cols(dict_friendly=False),
+        WriterOptions(enable_dictionary=False, page_version=version,
+                      codec=CompressionCodec.SNAPPY),
+    )
+    _check_against_host(path)
+
+
+def test_multi_page_chunks(tmp_path):
+    path = _write(
+        tmp_path, _std_cols(), WriterOptions(data_page_values=257), n=3000
+    )
+    _check_against_host(path)
+
+
+def test_delta_path(tmp_path):
+    n = 2000
+    cols = {
+        "d32": (types.INT32, np.cumsum(rng.integers(-3, 90, n)).astype(np.int32), False, None),
+        "d64": (types.INT64, np.cumsum(rng.integers(-3, 90, n)).astype(np.int64), False, None),
+    }
+    path = _write(
+        tmp_path, cols,
+        WriterOptions(enable_dictionary=False, delta_integers=True),
+    )
+    _check_against_host(path)
+
+
+def test_projection(tmp_path):
+    path = _write(tmp_path, _std_cols(), WriterOptions())
+    tpu = TpuRowGroupReader(path)
+    cols = tpu.read_row_group(0, ["i64", "s"])
+    assert set(cols) == {"i64", "s"}
+    tpu.close()
+
+
+def test_pyarrow_files_through_tpu_engine(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+    n = 2500
+    table = pa.table(
+        {
+            "a": pa.array(rng.integers(0, 40, n), type=pa.int64()),
+            "b": pa.array([f"cat_{i % 11}" for i in range(n)]),
+            "c": pa.array(rng.standard_normal(n), type=pa.float64()),
+            "opt": pa.array([None if i % 3 == 0 else int(i) for i in range(n)], type=pa.int32()),
+        }
+    )
+    path = tmp_path / "pa.parquet"
+    pq.write_table(table, path, compression="SNAPPY", row_group_size=900)
+    _check_against_host(path)
+
+
+def test_f64bits_to_f32():
+    vals = np.array([1.5, -2.75e10, 3.14159, 0.0, np.inf, -np.inf, 1e38, -1e-30],
+                    dtype=np.float64)
+    import jax.numpy as jnp
+
+    bits = jnp.asarray(vals.view(np.int64))
+    out = np.asarray(f64bits_to_f32(bits))
+    np.testing.assert_allclose(out, vals.astype(np.float32), rtol=1e-6)
+    nan_out = np.asarray(f64bits_to_f32(jnp.asarray(np.array([np.nan]).view(np.int64))))
+    assert np.isnan(nan_out[0])
+
+
+def test_float64_policies(tmp_path):
+    n = 500
+    cols = {"f64": (types.DOUBLE, rng.standard_normal(n), False, None)}
+    path = _write(tmp_path, cols, WriterOptions(enable_dictionary=False))
+    expect = None
+    with ParquetFileReader(path) as r:
+        expect = np.asarray(r.read_row_group(0).columns[0].values)
+    for policy, dtype in [("float64", np.float64), ("float32", np.float32), ("bits", np.int64)]:
+        t = TpuRowGroupReader(path, float64_policy=policy)
+        got = np.asarray(t.read_row_group(0)["f64"].values)
+        assert got.dtype == dtype
+        if policy == "float64":
+            np.testing.assert_array_equal(got, expect)
+        elif policy == "float32":
+            np.testing.assert_allclose(got, expect.astype(np.float32), rtol=1e-6)
+        else:
+            np.testing.assert_array_equal(got.view(np.float64), expect)
+        t.close()
+
+
+def test_int64_delta_overflow_falls_back(tmp_path):
+    """Regression: INT64 delta columns whose running sum leaves int32 range
+    must take the host path, not silently wrap on device."""
+    n = 300_000
+    vals = (np.arange(n, dtype=np.int64) * 10_000)  # max 3e9 > int32
+    cols = {"big": (types.INT64, vals, False, None)}
+    path = _write(tmp_path, cols, WriterOptions(enable_dictionary=False, delta_integers=True))
+    t = TpuRowGroupReader(path)
+    got = np.asarray(t.read_row_group(0)["big"].values)
+    np.testing.assert_array_equal(got, vals)
+    t.close()
+
+
+def test_all_null_column_device_path(tmp_path):
+    """Regression: an entirely-null row group must decode (zeros + full
+    mask), not crash the device gather."""
+    for enable_dict in (False, True):
+        cols = {"x": (types.DOUBLE, [None] * 200, True, None)}
+        path = _write(tmp_path, cols, WriterOptions(enable_dictionary=enable_dict))
+        t = TpuRowGroupReader(path)
+        dc = t.read_row_group(0)["x"]
+        assert np.asarray(dc.mask).all()
+        assert dc.values.shape[0] == 200
+        t.close()
+
+
+def test_x64_requirement_error():
+    import jax
+
+    try:
+        jax.config.update("jax_enable_x64", False)
+        with pytest.raises(RuntimeError, match="jax_enable_x64"):
+            TpuRowGroupReader.__new__(TpuRowGroupReader).__init__("/nonexistent")
+    finally:
+        jax.config.update("jax_enable_x64", True)

@@ -21,6 +21,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# Persistent XLA compile cache: decode-shape compiles are expensive over
+# remote TPU links; cache them across bench invocations.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/pftpu_jax_cache")
+
 
 def main():
     import numpy as np  # noqa: F401

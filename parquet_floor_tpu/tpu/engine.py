@@ -146,6 +146,10 @@ class _NormPages:
     page_encoding: List[int]
     def_bw: int
     max_def: int
+    # level run tables parsed during normalization (V1 pages parse them for
+    # the non-null count anyway); byte offsets are relative to the page's
+    # level stream.  None → parse lazily in _merged_level_plan (V2 pages).
+    page_level_table: List[Optional[np.ndarray]] = None
 
 
 def _normalize_pages(
@@ -167,6 +171,7 @@ def _normalize_pages(
         values_buf=np.zeros(0, np.uint8),
         page_n=[], page_nn=[], page_level_base=[], page_value_base=[],
         page_encoding=[], def_bw=def_bw, max_def=max_def,
+        page_level_table=[],
     )
     dict_bytes: Optional[np.ndarray] = None
     lvl_pos = 0
@@ -184,6 +189,7 @@ def _normalize_pages(
             data = codecs.decompress(codec, page.payload, page.header.uncompressed_page_size)
             pos = 0
             n = h.num_values
+            lvl_table = None
             if max_def > 0:
                 if h.definition_level_encoding not in (Encoding.RLE, None):
                     raise _Fallback("non-RLE def levels")
@@ -194,12 +200,17 @@ def _normalize_pages(
                 # count non-nulls cheaply from the run table
                 table, _ = e_rle.parse_runs(data, n, def_bw, pos - ln)
                 nn = _count_non_null(data, table, n, def_bw, max_def)
+                # rebase bit-packed offsets to the level stream start so the
+                # merged plan can reuse this parse
+                lvl_table = table.copy()
+                lvl_table[lvl_table[:, 0] == 1, 2] -= pos - ln
             else:
                 level_base = 0
                 nn = n
             values_parts.append(data[pos:])
             value_base, val_pos = val_pos, val_pos + len(data) - pos
             enc = h.encoding
+            meta.page_level_table.append(lvl_table)
         elif page.page_type == PageType.DATA_PAGE_V2:
             h2 = page.header.data_page_header_v2
             n = h2.num_values
@@ -222,6 +233,7 @@ def _normalize_pages(
             values_parts.append(bytes(body))
             value_base, val_pos = val_pos, val_pos + len(body)
             enc = h2.encoding
+            meta.page_level_table.append(None)
         elif page.page_type == PageType.INDEX_PAGE:
             continue
         else:
@@ -238,7 +250,8 @@ def _normalize_pages(
 
 def _concat_padded(parts: List[bytes]) -> np.ndarray:
     total = sum(len(p) for p in parts)
-    out = np.zeros(total + 8, dtype=np.uint8)  # +8: extract_bits window pad
+    out = np.empty(total + 8, dtype=np.uint8)  # +8: extract_bits window pad
+    out[total:] = 0
     pos = 0
     for p in parts:
         out[pos : pos + len(p)] = np.frombuffer(p, dtype=np.uint8)
@@ -297,13 +310,21 @@ def _merged_level_plan(meta: _NormPages):
     concatenated buffer."""
     tables = []
     for i, n in enumerate(meta.page_n):
-        ln_end = (
-            meta.page_level_base[i + 1]
-            if i + 1 < len(meta.page_n)
-            else len(meta.levels_buf) - 8
+        cached = (
+            meta.page_level_table[i]
+            if meta.page_level_table and i < len(meta.page_level_table)
+            else None
         )
-        page_stream = meta.levels_buf[meta.page_level_base[i] : ln_end]
-        table, _ = e_rle.parse_runs(page_stream, n, meta.def_bw)
+        if cached is not None:
+            table = cached
+        else:
+            ln_end = (
+                meta.page_level_base[i + 1]
+                if i + 1 < len(meta.page_n)
+                else len(meta.levels_buf) - 8
+            )
+            page_stream = meta.levels_buf[meta.page_level_base[i] : ln_end]
+            table, _ = e_rle.parse_runs(page_stream, n, meta.def_bw)
         if len(table):
             t = table.copy()
             bp = t[:, 0] == 1
@@ -567,6 +588,10 @@ class TpuRowGroupReader:
             return jax.device_put(arr, self.device)
         return jnp.asarray(arr)
 
+    def _put_many(self, arrs):
+        """One batched host→device transfer for a whole chunk's buffers."""
+        return jax.device_put(list(arrs), self.device)
+
     def _decode_dict(self, desc, dict_bytes: np.ndarray, norm: _NormPages) -> DeviceColumn:
         n = sum(norm.page_n)
         idx_plan, bw, nn = _merged_index_plan(norm)
@@ -596,13 +621,11 @@ class TpuRowGroupReader:
         return -1  # strings: computed during pool parse
 
     def _finish_fixed_dict(self, desc, dictionary, idx_plan, bw, norm, n, nn):
-        vbuf = self._put(norm.values_buf)
-        dict_dev = self._put(dictionary)
-        ip = {k: self._put(v) for k, v in idx_plan.items()}
         if desc.max_definition_level > 0:
-            lbuf = self._put(norm.levels_buf)
             lvl_plan, _ = _merged_level_plan(norm)
-            lp = {k: self._put(v) for k, v in lvl_plan.items()}
+            vbuf, dict_dev, ip, lbuf, lp = self._put_many(
+                [norm.values_buf, dictionary, idx_plan, norm.levels_buf, lvl_plan]
+            )
             dense, mask = _dict_decode_opt(
                 vbuf, lbuf, dict_dev,
                 ip["run_out_end"], ip["run_kind"], ip["run_value"], ip["run_bitbase"],
@@ -611,6 +634,7 @@ class TpuRowGroupReader:
                 def_bw=norm.def_bw, nn=nn,
             )
             return DeviceColumn(desc, dense, mask)
+        vbuf, dict_dev, ip = self._put_many([norm.values_buf, dictionary, idx_plan])
         dense = _dict_decode_req(
             vbuf, dict_dev,
             ip["run_out_end"], ip["run_kind"], ip["run_value"], ip["run_bitbase"],
@@ -631,16 +655,19 @@ class TpuRowGroupReader:
             cached = (self._put(rows), self._put(lengths), max_len)
             self._string_dict_cache[key] = cached
         dict_rows, dict_lens, max_len = cached
-        vbuf = self._put(norm.values_buf)
-        ip = {k: self._put(v) for k, v in idx_plan.items()}
+        if desc.max_definition_level > 0:
+            lvl_plan, _ = _merged_level_plan(norm)
+            vbuf, ip, lbuf, lp = self._put_many(
+                [norm.values_buf, idx_plan, norm.levels_buf, lvl_plan]
+            )
+        else:
+            vbuf, ip = self._put_many([norm.values_buf, idx_plan])
+            lbuf = lp = None
         idx = _expand_runs_dev(
             vbuf, ip["run_out_end"], ip["run_kind"], ip["run_value"], ip["run_bitbase"],
             n=nn, bw=bw,
         )
         if desc.max_definition_level > 0:
-            lbuf = self._put(norm.levels_buf)
-            lvl_plan, _ = _merged_level_plan(norm)
-            lp = {k: self._put(v) for k, v in lvl_plan.items()}
             levels = _expand_runs_dev(
                 lbuf, lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
                 n=n, bw=norm.def_bw,
@@ -669,7 +696,6 @@ class TpuRowGroupReader:
             expected = norm.page_value_base[i - 1] + norm.page_nn[i - 1] * width
             if norm.page_value_base[i] != expected:
                 raise _Fallback("non-contiguous PLAIN pages")
-        vbuf = self._put(norm.values_buf)
         dtype = _JNP_DTYPE[pt]
         f64_as_f32 = False
         if pt == Type.DOUBLE:
@@ -678,9 +704,10 @@ class TpuRowGroupReader:
             elif self.float64_policy == "bits":
                 dtype = jnp.int64
         if desc.max_definition_level > 0:
-            lbuf = self._put(norm.levels_buf)
             lvl_plan, _ = _merged_level_plan(norm)
-            lp = {k: self._put(v) for k, v in lvl_plan.items()}
+            vbuf, lbuf, lp = self._put_many(
+                [norm.values_buf, norm.levels_buf, lvl_plan]
+            )
             dense, mask = _plain_decode_opt(
                 vbuf, lbuf,
                 lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
@@ -688,6 +715,7 @@ class TpuRowGroupReader:
                 def_bw=norm.def_bw, f64_as_f32=f64_as_f32,
             )
             return DeviceColumn(desc, dense, mask)
+        vbuf = self._put(norm.values_buf)
         dense = _plain_decode_req(vbuf, n=n, dtype=dtype, f64_as_f32=f64_as_f32)
         return DeviceColumn(desc, dense, None)
 
@@ -700,17 +728,20 @@ class TpuRowGroupReader:
         plan = bitops.run_table_to_device_plan(
             table, nn, bitops.bucket_size(len(table), 4)
         )
-        vbuf = self._put(norm.values_buf)
-        pp = {k: self._put(v) for k, v in plan.items()}
+        if desc.max_definition_level > 0:
+            lvl_plan, _ = _merged_level_plan(norm)
+            vbuf, pp, lbuf, lp = self._put_many(
+                [norm.values_buf, plan, norm.levels_buf, lvl_plan]
+            )
+        else:
+            vbuf, pp = self._put_many([norm.values_buf, plan])
+            lbuf = lp = None
         bits = _expand_runs_dev(
             vbuf, pp["run_out_end"], pp["run_kind"], pp["run_value"], pp["run_bitbase"],
             n=nn, bw=1,
         )
         vals = bits.astype(jnp.bool_)
         if desc.max_definition_level > 0:
-            lbuf = self._put(norm.levels_buf)
-            lvl_plan, _ = _merged_level_plan(norm)
-            lp = {k: self._put(v) for k, v in lvl_plan.items()}
             levels = _expand_runs_dev(
                 lbuf, lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
                 n=n, bw=norm.def_bw,

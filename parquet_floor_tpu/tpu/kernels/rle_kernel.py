@@ -1,0 +1,213 @@
+"""Pallas TPU kernel: fused RLE/bit-packed hybrid run expansion.
+
+The jnp reference (``tpu/bitops.py:rle_expand``) costs one
+``searchsorted`` (log R gathers per element) plus a 5-byte gather per
+element for bit-packed runs — all through HBM between HLO ops.  This kernel
+replaces the per-element gathers with run-local vectorized extraction:
+
+* grid over output tiles; a host-built *span table* tells each tile which
+  runs intersect it (``tile_lo``/``tile_hi``), so the kernel loop is
+  O(runs-in-tile), not O(R);
+* RLE runs broadcast their value into the masked tile range (VPU select);
+* bit-packed runs exploit the format's byte-aligned packed streams
+  (Parquet RLE spec: packed groups start on a byte boundary): the whole
+  values buffer stays in HBM, the per-run window is DMA'd into VMEM,
+  exploded to a bit matrix, dynamically shifted, regrouped to (TILE, bw)
+  and contracted with power-of-two weights — an int matmul the MXU eats.
+
+Replaces the reference's per-cell ValuesReader pull loop
+(``ParquetReader.java:141-168``, ``ParquetReader.java:196-203``) — the
+same seam SURVEY.md §2.4(2) maps to Pallas kernels.
+
+Correctness contract: identical output to ``bitops.rle_expand`` for every
+valid run table (property-tested in interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Output tile: (SUB, LANE) int32 = 2048 values per grid step.
+_SUB, _LANE = 16, 128
+TILE = _SUB * _LANE
+
+
+def _tile_window_bytes(bit_width: int) -> int:
+    """VMEM window per bit-packed run segment: one tile's worth of packed
+    bits plus slack for the byte-misaligned start and the trailing read."""
+    return TILE * bit_width // 8 + 16
+
+
+def _rle_expand_kernel(
+    # scalar prefetch (SMEM)
+    tile_lo_ref, tile_hi_ref, run_out_end_ref, run_kind_ref,
+    run_value_ref, run_byte_ref,
+    # tensor inputs
+    data_hbm,           # uint8[B] in ANY/HBM: the raw values buffer
+    # outputs
+    out_ref,            # int32[SUB, LANE] tile in VMEM
+    # scratch
+    win_ref,            # uint8[1, W] VMEM window for packed bytes
+    sem,                # DMA semaphore
+    *, bit_width: int,
+):
+    t = pl.program_id(0)
+    tile_start = t * TILE
+    lo = tile_lo_ref[t]
+    hi = tile_hi_ref[t]
+
+    # Element index within this tile (flattened (SUB, LANE) order).
+    flat = (
+        jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0) * _LANE
+        + jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
+    )
+    gidx = tile_start + flat  # global output index per element
+
+    W = _tile_window_bytes(bit_width)
+    bits_per_byte = 8
+    # Weights for the (TILE, bw) x (bw,) contraction.
+    weights = (
+        jnp.int32(1) << jax.lax.broadcasted_iota(jnp.int32, (bit_width, 1), 0)
+    )  # (bw, 1)
+
+    def body(r, acc):
+        r_end = run_out_end_ref[r]
+        r_start = jnp.where(r == 0, 0, run_out_end_ref[jnp.maximum(r - 1, 0)])
+        in_run = (gidx >= r_start) & (gidx < r_end)
+
+        kind = run_kind_ref[r]
+        rle_fill = jnp.where(in_run, run_value_ref[r], acc)
+
+        # --- bit-packed branch -------------------------------------------
+        # Within-run index of the tile's element 0 (may be negative when the
+        # run starts mid-tile; the buffer carries FRONT_PAD leading bytes so
+        # the DMA window can begin before the run base, and out-of-run
+        # elements decode garbage that ``in_run`` masks away).
+        w_base = tile_start - r_start
+        bit0 = w_base * bit_width                 # signed, rel. to packed base
+        byte_off = run_byte_ref[r] + (bit0 >> 3)  # arithmetic shift = floor
+        shift = bit0 & 7                          # floor-mod residual (0..7)
+
+        def packed_branch(acc_in):
+            copy = pltpu.make_async_copy(
+                data_hbm.at[pl.ds(byte_off, W)],
+                win_ref.at[0, :],
+                sem,
+            )
+            copy.start()
+            copy.wait()
+            # Explode window to bits: (W, 8) LSB-first -> flat (1, W*8).
+            wb = win_ref[0, :].reshape(W, 1)
+            bits = (
+                (wb >> jax.lax.broadcasted_iota(jnp.uint8, (W, bits_per_byte), 1))
+                & 1
+            ).astype(jnp.int32).reshape(1, W * bits_per_byte)
+            # Drop the residual shift, regroup to (TILE, bw).
+            usable = bits[:, :].reshape(W * bits_per_byte)
+            seg = jax.lax.dynamic_slice(usable, (shift,), (TILE * bit_width,))
+            fields = seg.reshape(TILE, bit_width)
+            vals_flat = jax.lax.dot_general(
+                fields, weights,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ).reshape(_SUB, _LANE)
+            # vals_flat[i] is the value for within-tile element i only when
+            # the element belongs to this run (its packed index = w0 + (its
+            # global index - tile_start)); elements before the run's start in
+            # this tile would need negative packed indices — they're masked.
+            return jnp.where(in_run, vals_flat, acc_in)
+
+        acc_out = jax.lax.cond(
+            kind == 1, packed_branch, lambda a: rle_fill, acc
+        )
+        return acc_out
+
+    result = jax.lax.fori_loop(lo, hi, body, jnp.zeros((_SUB, _LANE), jnp.int32))
+    out_ref[:, :] = result
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_values", "bit_width", "interpret"),
+)
+def rle_expand_pallas(
+    data_u8: jax.Array,
+    run_out_end: jax.Array,
+    run_kind: jax.Array,
+    run_value: jax.Array,
+    run_bitbase: jax.Array,
+    tile_lo: jax.Array,
+    tile_hi: jax.Array,
+    num_values: int,
+    bit_width: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas twin of ``bitops.rle_expand`` (+ host-built tile spans).
+
+    ``run_bitbase`` is in bits (byte-aligned by the format); converted to
+    bytes here.  Output is int32[num_values].
+    """
+    if bit_width == 0:
+        return jnp.zeros(num_values, dtype=jnp.int32)
+    n_tiles = pl.cdiv(num_values, TILE)
+    padded = n_tiles * TILE
+    W = _tile_window_bytes(bit_width)
+
+    # FRONT_PAD: a run starting mid-tile makes the window begin up to
+    # (TILE-1)*bw/8 bytes before the run base; pad the front so byte
+    # offsets never underflow.  Tail: every DMA starts at byte_off ≤
+    # run_byte + run_len*bw/8 ≤ len(buf) (parse guarantees packed data is
+    # in-bounds) and reads W bytes, so W+16 beyond the buffer suffices.
+    front = TILE * bit_width // 8 + 8
+    data_u8 = jnp.pad(data_u8, (front, W + 16))
+
+    run_byte = (run_bitbase // 8).astype(jnp.int32) + front
+
+    kernel = functools.partial(_rle_expand_kernel, bit_width=bit_width)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(
+            (_SUB, _LANE), lambda t, *_: (t, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((1, W), jnp.uint8),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_tiles * _SUB, _LANE), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(
+        tile_lo.astype(jnp.int32),
+        tile_hi.astype(jnp.int32),
+        run_out_end.astype(jnp.int32),
+        run_kind.astype(jnp.int32),
+        run_value.astype(jnp.int32),
+        run_byte,
+        data_u8,
+    )
+    return out.reshape(-1)[:num_values]
+
+
+def tile_spans(run_out_end: np.ndarray, num_values: int) -> tuple:
+    """Host-side: for each output tile, the [lo, hi) run-index span that
+    intersects it.  O(T log R) searchsorted — tiny."""
+    n_tiles = -(-num_values // TILE)
+    starts = np.arange(n_tiles, dtype=np.int64) * TILE
+    ends = np.minimum(starts + TILE, num_values)
+    # run r covers output [out_end[r-1], out_end[r])
+    lo = np.searchsorted(run_out_end, starts, side="right")
+    hi = np.searchsorted(run_out_end, ends - 1, side="right") + 1
+    hi = np.minimum(hi, len(run_out_end))
+    return lo.astype(np.int32), hi.astype(np.int32)

@@ -1,0 +1,110 @@
+"""Interpret-mode equivalence: Pallas rle_expand kernel vs jnp reference.
+
+The CI analogue of testing TPU kernels without a TPU (SURVEY.md §4 lesson):
+``interpret=True`` runs the kernel's semantics on CPU.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from parquet_floor_tpu.format.encodings import rle_hybrid as e_rle
+from parquet_floor_tpu.format.encodings.dictionary import encode_dict_indices
+from parquet_floor_tpu.tpu import bitops
+from parquet_floor_tpu.tpu.kernels.rle_kernel import (
+    TILE,
+    rle_expand_pallas,
+    tile_spans,
+)
+
+
+def _roundtrip_case(values: np.ndarray, bit_width: int):
+    """Encode values as an RLE/bit-packed hybrid stream, parse the run
+    table, and return everything both expanders need."""
+    stream = e_rle.encode_rle_hybrid(values, bit_width)
+    table, _ = e_rle.parse_runs(stream, len(values), bit_width)
+    pad = bitops.bucket_size(max(len(table), 1), 16)
+    plan = bitops.run_table_to_device_plan(table, len(values), pad)
+    buf = np.zeros(len(stream) + 8, np.uint8)
+    buf[: len(stream)] = np.frombuffer(stream, np.uint8)
+    return buf, plan
+
+
+def _expand_both(buf, plan, n, bw):
+    lo, hi = tile_spans(plan["run_out_end"], n)
+    got = rle_expand_pallas(
+        jnp.asarray(buf),
+        jnp.asarray(plan["run_out_end"]),
+        jnp.asarray(plan["run_kind"]),
+        jnp.asarray(plan["run_value"]),
+        jnp.asarray(plan["run_bitbase"]),
+        jnp.asarray(lo),
+        jnp.asarray(hi),
+        num_values=n,
+        bit_width=bw,
+        interpret=True,
+    )
+    want = bitops.rle_expand(
+        jnp.asarray(buf),
+        jnp.asarray(plan["run_out_end"]),
+        jnp.asarray(plan["run_kind"]),
+        jnp.asarray(plan["run_value"]),
+        jnp.asarray(plan["run_bitbase"]),
+        n,
+        bw,
+    )
+    return np.asarray(got), np.asarray(want)
+
+
+@pytest.mark.parametrize("bw", [1, 2, 3, 5, 8, 12, 17])
+def test_mixed_runs_match_reference(bw):
+    rng = np.random.default_rng(bw)
+    n = 3 * TILE + 517  # several tiles + ragged tail
+    vals = rng.integers(0, 1 << min(bw, 16), n).astype(np.uint32)
+    # carve long constant stretches so the stream mixes RLE and packed runs
+    vals[100:2200] = 3
+    vals[TILE : TILE + 900] = (1 << bw) - 1 if bw < 16 else 5
+    buf, plan = _roundtrip_case(vals, bw)
+    got, want = _expand_both(buf, plan, n, bw)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_run_boundary_mid_tile():
+    # run flips exactly inside a tile; packed run starts mid-tile
+    bw = 7
+    n = 2 * TILE
+    vals = np.full(n, 9, np.uint32)
+    vals[TILE + 37 :] = np.arange(n - TILE - 37, dtype=np.uint32) % 100
+    buf, plan = _roundtrip_case(vals, bw)
+    got, want = _expand_both(buf, plan, n, bw)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_single_short_tile():
+    bw = 4
+    n = 333
+    rng = np.random.default_rng(0)
+    vals = rng.integers(0, 16, n).astype(np.uint32)
+    buf, plan = _roundtrip_case(vals, bw)
+    got, want = _expand_both(buf, plan, n, bw)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_dictionary_stream_shape():
+    # end-to-end: a real dictionary-index stream as the writer emits it
+    rng = np.random.default_rng(1)
+    n = TILE + 777
+    idx = rng.integers(0, 200, n).astype(np.uint32)
+    idx[50:4000] = 11
+    stream = encode_dict_indices(idx, 200)
+    bw = stream[0]
+    table, _ = e_rle.parse_runs(stream, n, bw, 1)
+    pad = bitops.bucket_size(max(len(table), 1), 16)
+    plan = bitops.run_table_to_device_plan(table, n, pad)
+    buf = np.zeros(len(stream) + 8, np.uint8)
+    buf[: len(stream)] = np.frombuffer(stream, np.uint8)
+    got, want = _expand_both(buf, plan, n, bw)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(got, idx.astype(np.int32))

@@ -106,12 +106,7 @@ def parse_runs(data, num_values: int, bit_width: int, pos: int = 0):
         return np.zeros((0, 4), dtype=np.int64), pos
     if _native is not None and _native.available():
         try:
-            view = bytes(data[pos:]) if pos else bytes(data)
-            table, end = _native.rle_parse_runs(view, num_values, bit_width)
-            if pos:
-                table[table[:, 0] == 1, 2] += pos
-                end += pos
-            return table, end
+            return _native.rle_parse_runs(data, num_values, bit_width, pos)
         except ValueError:
             pass  # fall through to the pure-Python parser for its errors
     rows = []

@@ -14,6 +14,7 @@ from __future__ import annotations
 import io
 import mmap
 import os
+import threading
 from typing import BinaryIO, Optional, Union
 
 PathLike = Union[str, os.PathLike]
@@ -26,6 +27,7 @@ class FileSource:
         self._own = False
         self._mm: Optional[mmap.mmap] = None
         self._fh: Optional[BinaryIO] = None
+        self._lock = threading.Lock()
         if isinstance(source, (bytes, bytearray, memoryview)):
             self._buf = memoryview(source)
             self._size = len(self._buf)
@@ -51,15 +53,17 @@ class FileSource:
         return self._size
 
     def read_at(self, offset: int, length: int) -> memoryview:
-        """Positional read; returns exactly ``length`` bytes or raises."""
+        """Positional read (thread-safe); returns exactly ``length`` bytes or
+        raises."""
         if offset < 0 or offset + length > self._size:
             raise EOFError(
                 f"read [{offset}, {offset + length}) outside file of {self._size} bytes"
             )
         if self._buf is not None:
             return self._buf[offset : offset + length]
-        self._fh.seek(offset)
-        data = self._fh.read(length)
+        with self._lock:
+            self._fh.seek(offset)
+            data = self._fh.read(length)
         if len(data) != length:
             raise EOFError(f"short read: wanted {length}, got {len(data)}")
         return memoryview(data)

@@ -11,11 +11,13 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 from typing import Optional
 
 _LIB_NAME = "libpftpu_native.so"
 _lib = None
 _load_attempted = False
+_load_lock = threading.Lock()
 
 
 def _lib_path() -> str:
@@ -46,6 +48,14 @@ def _load():
     global _lib, _load_attempted
     if _load_attempted:
         return _lib
+    with _load_lock:
+        return _load_locked()
+
+
+def _load_locked():
+    global _lib, _load_attempted
+    if _load_attempted:  # lost the race: another thread finished the load
+        return _lib
     _load_attempted = True
     path = _lib_path()
     if not os.path.exists(path) and os.environ.get("PFTPU_NO_NATIVE_BUILD") != "1":
@@ -73,7 +83,7 @@ def _load():
         ]
         lib.pftpu_rle_parse_runs.restype = ctypes.c_ssize_t
         lib.pftpu_rle_parse_runs.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t,  # data
+            ctypes.c_void_p, ctypes.c_size_t,  # data
             ctypes.c_longlong, ctypes.c_int,   # num_values, bit_width
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_size_t,  # out table, capacity rows
             ctypes.POINTER(ctypes.c_longlong),  # end position out
@@ -120,8 +130,8 @@ def plain_ba_scan(data, max_values: int):
     import numpy as np
 
     lib = _load()
-    starts = np.zeros(max_values, dtype=np.int64)
-    lengths = np.zeros(max_values, dtype=np.int64)
+    starts = np.empty(max_values, dtype=np.int64)
+    lengths = np.empty(max_values, dtype=np.int64)
     arr = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
     n = lib.pftpu_plain_ba_scan(
         ctypes.c_char_p(arr.ctypes.data), len(arr), max_values,
@@ -142,13 +152,22 @@ def rle_parse_runs(data: bytes, num_values: int, bit_width: int, pos: int = 0):
     import numpy as np
 
     lib = _load()
-    view = data[pos:] if pos else data
+    if isinstance(data, np.ndarray):
+        arr = data if (data.dtype == np.uint8 and data.flags.c_contiguous) else (
+            np.ascontiguousarray(data).view(np.uint8)
+        )
+    else:
+        arr = np.frombuffer(data, dtype=np.uint8)
+    if pos < 0 or pos > len(arr):
+        raise ValueError(f"parse position {pos} outside buffer of {len(arr)} bytes")
+    base_ptr = arr.ctypes.data + pos
+    avail = len(arr) - pos
     cap = max(16, num_values)  # worst case: one run per 1 value? bounded below
     while True:
-        table = np.zeros((cap, 4), dtype=np.int64)
+        table = np.empty((cap, 4), dtype=np.int64)
         end = ctypes.c_longlong(0)
         n = lib.pftpu_rle_parse_runs(
-            bytes(view), len(view), num_values, bit_width,
+            base_ptr, avail, num_values, bit_width,
             table.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), cap,
             ctypes.byref(end),
         )

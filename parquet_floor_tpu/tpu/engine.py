@@ -19,6 +19,9 @@ Anything else falls back to the host NumPy engine and is shipped dense.
 
 from __future__ import annotations
 
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -280,6 +283,15 @@ class _Fallback(Exception):
     """Signal: this chunk takes the host NumPy path."""
 
 
+@dataclass
+class _Staged:
+    """Host-staged chunk: arrays awaiting transfer + the launch closure that
+    turns their device copies into a DeviceColumn (runs on the main thread)."""
+
+    arrays: list
+    launch: object  # Callable[[list], DeviceColumn]
+
+
 def _padded_rows(col: ByteArrayColumn):
     """Vectorized (n, max_len) uint8 matrix + lengths from a ByteArrayColumn
     (the device-friendly string layout)."""
@@ -513,11 +525,15 @@ class TpuRowGroupReader:
     """
 
     def __init__(self, source, device: Optional[jax.Device] = None,
-                 float64_policy: str = "auto"):
+                 float64_policy: str = "auto", host_threads: Optional[int] = None):
         """``float64_policy``: how DOUBLE columns materialize on device —
         "auto" (exact float64 on CPU; float32 on TPU, where f64 is emulated
         and lossy anyway), "float64", "float32", or "bits" (exact int64 bit
-        patterns)."""
+        patterns).
+
+        ``host_threads``: size of the host staging pool that decodes column
+        chunks concurrently (native decompress + run-table parse release the
+        GIL).  0/1 disables; None picks a default from the CPU count."""
         _require_x64()
         self.reader = source if isinstance(source, ParquetFileReader) else ParquetFileReader(source)
         self.device = device
@@ -526,7 +542,16 @@ class TpuRowGroupReader:
         if float64_policy == "auto":
             float64_policy = "float32" if _platform_is_tpu() else "float64"
         self.float64_policy = float64_policy
-        self._string_dict_cache: Dict[int, tuple] = {}
+        self._string_dict_cache: Dict[bytes, tuple] = {}   # host padded pools
+        self._string_dict_dev: Dict[bytes, tuple] = {}     # device copies (main thread)
+        if host_threads is None:
+            host_threads = min(8, os.cpu_count() or 1)
+        self._pool = (
+            ThreadPoolExecutor(max_workers=host_threads, thread_name_prefix="pftpu-stage")
+            if host_threads and host_threads > 1
+            else None
+        )
+        self._dict_lock = threading.Lock()
 
     @property
     def metadata(self):
@@ -537,6 +562,8 @@ class TpuRowGroupReader:
         return len(self.reader.row_groups)
 
     def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
         self.reader.close()
 
     def __enter__(self):
@@ -551,19 +578,32 @@ class TpuRowGroupReader:
         self, index: int, columns: Optional[Sequence[str]] = None
     ) -> Dict[str, DeviceColumn]:
         rg = self.reader.row_groups[index]
-        out: Dict[str, DeviceColumn] = {}
         want = set(columns) if columns else None
+        work = []
         for chunk in rg.columns or []:
             name = chunk.meta_data.path_in_schema[0]
             if want and name not in want:
                 continue
             desc = self.reader.schema.column(tuple(chunk.meta_data.path_in_schema))
-            out[name] = self._decode_chunk(chunk, desc)
+            work.append((name, chunk, desc))
+        # Phase 1 — host staging (parallel): decompress, parse run tables,
+        # build device plans.  Native codec + RLE parse release the GIL.
+        if self._pool is not None and len(work) > 1:
+            staged = list(self._pool.map(lambda w: self._stage_chunk(w[1], w[2]), work))
+        else:
+            staged = [self._stage_chunk(c, d) for _, c, d in work]
+        # Phase 2 — one batched host→device transfer for the whole row group.
+        dev = jax.device_put([s.arrays for s in staged], self.device)
+        # Phase 3 — launch the jitted decode functions from this one thread
+        # (JAX dispatch is async; concurrent dispatch just contends on locks).
+        out: Dict[str, DeviceColumn] = {}
+        for (name, _, _), s, d in zip(work, staged, dev):
+            out[name] = s.launch(d)
         return out
 
     # -- per-chunk ----------------------------------------------------------
 
-    def _decode_chunk(self, chunk, desc: ColumnDescriptor) -> DeviceColumn:
+    def _stage_chunk(self, chunk, desc: ColumnDescriptor) -> "_Staged":
         meta = chunk.meta_data
         try:
             raw_pages = self.reader.read_raw_column_chunk(chunk)
@@ -574,25 +614,16 @@ class TpuRowGroupReader:
             if encs <= {Encoding.RLE_DICTIONARY, Encoding.PLAIN_DICTIONARY}:
                 if dict_bytes is None:
                     raise _Fallback("dictionary pages missing")
-                return self._decode_dict(desc, dict_bytes, norm)
+                return self._stage_dict(desc, dict_bytes, norm)
             if encs == {Encoding.PLAIN}:
-                return self._decode_plain(desc, norm)
+                return self._stage_plain(desc, norm)
             if encs == {Encoding.DELTA_BINARY_PACKED} and len(norm.page_n) == 1:
-                return self._decode_delta(desc, norm)
+                return self._stage_delta(desc, norm)
             raise _Fallback(f"encodings {sorted(encs)}")
         except _Fallback:
-            return self._decode_host(chunk, desc)
+            return self._stage_host(chunk, desc)
 
-    def _put(self, arr) -> jax.Array:
-        if self.device is not None:
-            return jax.device_put(arr, self.device)
-        return jnp.asarray(arr)
-
-    def _put_many(self, arrs):
-        """One batched host→device transfer for a whole chunk's buffers."""
-        return jax.device_put(list(arrs), self.device)
-
-    def _decode_dict(self, desc, dict_bytes: np.ndarray, norm: _NormPages) -> DeviceColumn:
+    def _stage_dict(self, desc, dict_bytes: np.ndarray, norm: _NormPages) -> "_Staged":
         n = sum(norm.page_n)
         idx_plan, bw, nn = _merged_index_plan(norm)
         num_dict = self._dict_num_values(dict_bytes, desc)
@@ -608,9 +639,9 @@ class TpuRowGroupReader:
                     dictionary = dictionary.astype(np.float32)
                 elif self.float64_policy == "bits":
                     dictionary = dictionary.view(np.int64)
-            return self._finish_fixed_dict(desc, dictionary, idx_plan, bw, norm, n, nn)
+            return self._stage_fixed_dict(desc, dictionary, idx_plan, bw, norm, n, nn)
         if pt == Type.BYTE_ARRAY:
-            return self._finish_string_dict(desc, dict_bytes, num_dict, idx_plan, bw, norm, n, nn)
+            return self._stage_string_dict(desc, dict_bytes, idx_plan, bw, norm, n, nn)
         raise _Fallback(f"dict decode for type {Type.name(pt)}")
 
     def _dict_num_values(self, dict_bytes, desc) -> int:
@@ -620,73 +651,100 @@ class TpuRowGroupReader:
             return len(dict_bytes) // np.dtype(_NP_DTYPE[pt]).itemsize
         return -1  # strings: computed during pool parse
 
-    def _finish_fixed_dict(self, desc, dictionary, idx_plan, bw, norm, n, nn):
-        if desc.max_definition_level > 0:
+    def _stage_fixed_dict(self, desc, dictionary, idx_plan, bw, norm, n, nn) -> "_Staged":
+        max_def = desc.max_definition_level
+        def_bw = norm.def_bw
+        if max_def > 0:
             lvl_plan, _ = _merged_level_plan(norm)
-            vbuf, dict_dev, ip, lbuf, lp = self._put_many(
-                [norm.values_buf, dictionary, idx_plan, norm.levels_buf, lvl_plan]
-            )
-            dense, mask = _dict_decode_opt(
-                vbuf, lbuf, dict_dev,
-                ip["run_out_end"], ip["run_kind"], ip["run_value"], ip["run_bitbase"],
-                lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
-                n=n, bw=bw, max_def=desc.max_definition_level,
-                def_bw=norm.def_bw, nn=nn,
-            )
-            return DeviceColumn(desc, dense, mask)
-        vbuf, dict_dev, ip = self._put_many([norm.values_buf, dictionary, idx_plan])
-        dense = _dict_decode_req(
-            vbuf, dict_dev,
-            ip["run_out_end"], ip["run_kind"], ip["run_value"], ip["run_bitbase"],
-            n=n, bw=bw,
-        )
-        return DeviceColumn(desc, dense, None)
 
-    def _finish_string_dict(self, desc, dict_bytes, _nd, idx_plan, bw, norm, n, nn):
+            def launch(dev):
+                vbuf, dict_dev, ip, lbuf, lp = dev
+                dense, mask = _dict_decode_opt(
+                    vbuf, lbuf, dict_dev,
+                    ip["run_out_end"], ip["run_kind"], ip["run_value"], ip["run_bitbase"],
+                    lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
+                    n=n, bw=bw, max_def=max_def, def_bw=def_bw, nn=nn,
+                )
+                return DeviceColumn(desc, dense, mask)
+
+            return _Staged(
+                [norm.values_buf, dictionary, idx_plan, norm.levels_buf, lvl_plan],
+                launch,
+            )
+
+        def launch(dev):
+            vbuf, dict_dev, ip = dev
+            dense = _dict_decode_req(
+                vbuf, dict_dev,
+                ip["run_out_end"], ip["run_kind"], ip["run_value"], ip["run_bitbase"],
+                n=n, bw=bw,
+            )
+            return DeviceColumn(desc, dense, None)
+
+        return _Staged([norm.values_buf, dictionary, idx_plan], launch)
+
+    def _stage_string_dict(self, desc, dict_bytes, idx_plan, bw, norm, n, nn) -> "_Staged":
         # Parse the PLAIN dictionary pool into a padded row matrix once
         # (keyed by content — dict handles hash collisions by comparison).
         key = dict_bytes.tobytes()
-        cached = self._string_dict_cache.get(key)
+        with self._dict_lock:
+            cached = self._string_dict_cache.get(key)
         if cached is None:
-            col, _ = decode_plain(
-                dict_bytes.tobytes(), _count_plain_strings(dict_bytes), Type.BYTE_ARRAY
-            )
+            col, _ = decode_plain(key, _count_plain_strings(dict_bytes), Type.BYTE_ARRAY)
             rows, lengths, max_len = _padded_rows(col)
-            cached = (self._put(rows), self._put(lengths), max_len)
-            self._string_dict_cache[key] = cached
-        dict_rows, dict_lens, max_len = cached
-        if desc.max_definition_level > 0:
-            lvl_plan, _ = _merged_level_plan(norm)
-            vbuf, ip, lbuf, lp = self._put_many(
-                [norm.values_buf, idx_plan, norm.levels_buf, lvl_plan]
-            )
-        else:
-            vbuf, ip = self._put_many([norm.values_buf, idx_plan])
-            lbuf = lp = None
-        idx = _expand_runs_dev(
-            vbuf, ip["run_out_end"], ip["run_kind"], ip["run_value"], ip["run_bitbase"],
-            n=nn, bw=bw,
-        )
-        if desc.max_definition_level > 0:
-            levels = _expand_runs_dev(
-                lbuf, lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
-                n=n, bw=norm.def_bw,
-            )
-            present = levels == desc.max_definition_level
-            rows, lens = _dict_strings_opt_gather(
-                dict_rows, dict_lens, idx, present, n=n, max_len=max_len
-            )
-            return DeviceColumn(desc, rows, ~present, lens)
-        rows = jnp.take(dict_rows, idx, axis=0)
-        lens = jnp.take(dict_lens, idx)
-        return DeviceColumn(desc, rows, None, lens)
+            with self._dict_lock:
+                cached = self._string_dict_cache.setdefault(key, (rows, lengths, max_len))
+        host_rows, host_lens, max_len = cached
+        max_def = desc.max_definition_level
+        def_bw = norm.def_bw
+        lvl_plan = _merged_level_plan(norm)[0] if max_def > 0 else None
+        # Ship the padded pool only if no device copy exists yet.  (Racy read
+        # from a staging thread: worst case the pool ships once more and the
+        # launch-thread cache ignores it.)
+        ship_dict = key not in self._string_dict_dev
 
-    def _decode_plain(self, desc, norm: _NormPages) -> DeviceColumn:
+        def launch(dev):
+            # device-side dictionary cache is touched on the launch thread only
+            if ship_dict:
+                dcached = self._string_dict_dev.setdefault(key, (dev[0], dev[1]))
+                dev = dev[2:]
+            else:
+                dcached = self._string_dict_dev[key]
+            dict_rows, dict_lens = dcached
+            if max_def > 0:
+                vbuf, ip, lbuf, lp = dev
+            else:
+                vbuf, ip = dev
+                lbuf = lp = None
+            idx = _expand_runs_dev(
+                vbuf, ip["run_out_end"], ip["run_kind"], ip["run_value"], ip["run_bitbase"],
+                n=nn, bw=bw,
+            )
+            if max_def > 0:
+                levels = _expand_runs_dev(
+                    lbuf, lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
+                    n=n, bw=def_bw,
+                )
+                present = levels == max_def
+                rows, lens = _dict_strings_opt_gather(
+                    dict_rows, dict_lens, idx, present, n=n, max_len=max_len
+                )
+                return DeviceColumn(desc, rows, ~present, lens)
+            rows = jnp.take(dict_rows, idx, axis=0)
+            lens = jnp.take(dict_lens, idx)
+            return DeviceColumn(desc, rows, None, lens)
+
+        arrays = ([host_rows, host_lens] if ship_dict else []) + [norm.values_buf, idx_plan]
+        if max_def > 0:
+            arrays += [norm.levels_buf, lvl_plan]
+        return _Staged(arrays, launch)
+
+    def _stage_plain(self, desc, norm: _NormPages) -> "_Staged":
         n = sum(norm.page_n)
         nn = sum(norm.page_nn)
         pt = desc.physical_type
         if pt == Type.BOOLEAN:
-            return self._decode_plain_bool(desc, norm, n, nn)
+            return self._stage_plain_bool(desc, norm, n, nn)
         if pt not in _NP_DTYPE:
             raise _Fallback(f"PLAIN device decode for {Type.name(pt)}")
         width = np.dtype(_NP_DTYPE[pt]).itemsize
@@ -703,23 +761,31 @@ class TpuRowGroupReader:
                 f64_as_f32 = True
             elif self.float64_policy == "bits":
                 dtype = jnp.int64
-        if desc.max_definition_level > 0:
+        max_def = desc.max_definition_level
+        def_bw = norm.def_bw
+        if max_def > 0:
             lvl_plan, _ = _merged_level_plan(norm)
-            vbuf, lbuf, lp = self._put_many(
-                [norm.values_buf, norm.levels_buf, lvl_plan]
-            )
-            dense, mask = _plain_decode_opt(
-                vbuf, lbuf,
-                lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
-                n=n, nn=nn, dtype=dtype, max_def=desc.max_definition_level,
-                def_bw=norm.def_bw, f64_as_f32=f64_as_f32,
-            )
-            return DeviceColumn(desc, dense, mask)
-        vbuf = self._put(norm.values_buf)
-        dense = _plain_decode_req(vbuf, n=n, dtype=dtype, f64_as_f32=f64_as_f32)
-        return DeviceColumn(desc, dense, None)
 
-    def _decode_plain_bool(self, desc, norm: _NormPages, n, nn) -> DeviceColumn:
+            def launch(dev):
+                vbuf, lbuf, lp = dev
+                dense, mask = _plain_decode_opt(
+                    vbuf, lbuf,
+                    lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
+                    n=n, nn=nn, dtype=dtype, max_def=max_def,
+                    def_bw=def_bw, f64_as_f32=f64_as_f32,
+                )
+                return DeviceColumn(desc, dense, mask)
+
+            return _Staged([norm.values_buf, norm.levels_buf, lvl_plan], launch)
+
+        def launch(dev):
+            (vbuf,) = dev
+            dense = _plain_decode_req(vbuf, n=n, dtype=dtype, f64_as_f32=f64_as_f32)
+            return DeviceColumn(desc, dense, None)
+
+        return _Staged([norm.values_buf], launch)
+
+    def _stage_plain_bool(self, desc, norm: _NormPages, n, nn) -> "_Staged":
         # Each page's bools are byte-aligned bit-packed: model as one
         # bit-packed "run" per page and reuse the RLE expansion machinery.
         table = np.zeros((len(norm.page_n), 4), dtype=np.int64)
@@ -728,30 +794,37 @@ class TpuRowGroupReader:
         plan = bitops.run_table_to_device_plan(
             table, nn, bitops.bucket_size(len(table), 4)
         )
-        if desc.max_definition_level > 0:
-            lvl_plan, _ = _merged_level_plan(norm)
-            vbuf, pp, lbuf, lp = self._put_many(
-                [norm.values_buf, plan, norm.levels_buf, lvl_plan]
-            )
-        else:
-            vbuf, pp = self._put_many([norm.values_buf, plan])
-            lbuf = lp = None
-        bits = _expand_runs_dev(
-            vbuf, pp["run_out_end"], pp["run_kind"], pp["run_value"], pp["run_bitbase"],
-            n=nn, bw=1,
-        )
-        vals = bits.astype(jnp.bool_)
-        if desc.max_definition_level > 0:
-            levels = _expand_runs_dev(
-                lbuf, lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
-                n=n, bw=norm.def_bw,
-            )
-            present = levels == desc.max_definition_level
-            dense = bitops.dense_scatter(vals, present, fill=False)
-            return DeviceColumn(desc, dense, ~present)
-        return DeviceColumn(desc, vals, None)
+        max_def = desc.max_definition_level
+        def_bw = norm.def_bw
+        lvl_plan = _merged_level_plan(norm)[0] if max_def > 0 else None
 
-    def _decode_delta(self, desc, norm: _NormPages) -> DeviceColumn:
+        def launch(dev):
+            if max_def > 0:
+                vbuf, pp, lbuf, lp = dev
+            else:
+                vbuf, pp = dev
+                lbuf = lp = None
+            bits = _expand_runs_dev(
+                vbuf, pp["run_out_end"], pp["run_kind"], pp["run_value"], pp["run_bitbase"],
+                n=nn, bw=1,
+            )
+            vals = bits.astype(jnp.bool_)
+            if max_def > 0:
+                levels = _expand_runs_dev(
+                    lbuf, lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
+                    n=n, bw=def_bw,
+                )
+                present = levels == max_def
+                dense = bitops.dense_scatter(vals, present, fill=False)
+                return DeviceColumn(desc, dense, ~present)
+            return DeviceColumn(desc, vals, None)
+
+        arrays = [norm.values_buf, plan]
+        if max_def > 0:
+            arrays += [norm.levels_buf, lvl_plan]
+        return _Staged(arrays, launch)
+
+    def _stage_delta(self, desc, norm: _NormPages) -> "_Staged":
         if desc.max_definition_level > 0:
             raise _Fallback("optional delta column (host path)")
         pt = desc.physical_type
@@ -761,40 +834,53 @@ class TpuRowGroupReader:
         if plan is None:
             raise _Fallback("delta needs >32-bit arithmetic")
         n = sum(norm.page_n)
-        vbuf = self._put(norm.values_buf)
-        out = bitops.delta_expand(
-            vbuf,
-            self._put(plan["mb_bitbase"]),
-            self._put(plan["mb_bw"]),
-            self._put(plan["mb_min_delta"]),
-            plan["first_value"],
-            n,
-            plan["values_per_miniblock"],
-            out_dtype=_JNP_DTYPE[pt],
-        )
-        return DeviceColumn(desc, out, None)
+        out_dtype = _JNP_DTYPE[pt]
 
-    def _decode_host(self, chunk, desc) -> DeviceColumn:
+        def launch(dev):
+            vbuf, bitbase, bws, mins = dev
+            out = bitops.delta_expand(
+                vbuf, bitbase, bws, mins,
+                plan["first_value"], n, plan["values_per_miniblock"],
+                out_dtype=out_dtype,
+            )
+            return DeviceColumn(desc, out, None)
+
+        return _Staged(
+            [norm.values_buf, plan["mb_bitbase"], plan["mb_bw"], plan["mb_min_delta"]],
+            launch,
+        )
+
+    def _stage_host(self, chunk, desc) -> "_Staged":
         """Host NumPy decode, shipped dense to the device (correct for every
         chunk the format engine can read)."""
         batch = self.reader.read_column_chunk(chunk)
         dense, mask = batch.dense()
         if isinstance(dense, ByteArrayColumn):
-            rows, lengths, max_len = _padded_rows(dense)
-            return DeviceColumn(
-                desc,
-                self._put(rows),
-                None if mask is None else self._put(mask),
-                self._put(lengths),
-            )
+            rows, lengths, _ = _padded_rows(dense)
+
+            def launch(dev):
+                if mask is None:
+                    drows, dlens = dev
+                    return DeviceColumn(desc, drows, None, dlens)
+                drows, dlens, dmask = dev
+                return DeviceColumn(desc, drows, dmask, dlens)
+
+            arrays = [rows, lengths] + ([] if mask is None else [mask])
+            return _Staged(arrays, launch)
         if dense.dtype == np.float64:
             if self.float64_policy == "float32":
                 dense = dense.astype(np.float32)
             elif self.float64_policy == "bits":
                 dense = dense.view(np.int64)
-        return DeviceColumn(
-            desc, self._put(dense), None if mask is None else self._put(mask)
-        )
+
+        def launch(dev):
+            if mask is None:
+                (dd,) = dev
+                return DeviceColumn(desc, dd, None)
+            dd, dmask = dev
+            return DeviceColumn(desc, dd, dmask)
+
+        return _Staged([dense] + ([] if mask is None else [mask]), launch)
 
 
 def _count_plain_strings(data_u8: np.ndarray) -> int:

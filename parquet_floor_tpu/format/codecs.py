@@ -6,7 +6,9 @@ Replaces the reference's ``io.compress`` shim framework + JNI codec seam
 (SURVEY.md §2.2/§2.4): here codecs are plain functions ``bytes -> bytes``
 selected by the footer's codec id.  Snappy is first-party (C++ fast path via
 ctypes when built, pure-Python fallback — both from scratch); GZIP rides
-stdlib zlib; ZSTD is gated on the optional ``zstandard`` wheel.
+stdlib zlib; ZSTD is first-party too (from-scratch RFC 8878 decoder +
+store-mode encoder in native/src/pftpu_zstd.cc), with the optional
+``zstandard`` wheel preferred when installed.
 """
 
 from __future__ import annotations
@@ -63,18 +65,38 @@ def _gzip_decompress(data: bytes, uncompressed_size=None) -> bytes:
 
 
 def _zstd_compress(data: bytes) -> bytes:
-    if _zstd is None:
-        raise UnsupportedCodec("ZSTD codec requires the 'zstandard' package")
-    return _zstd.ZstdCompressor(level=3).compress(data)
+    # Prefer the optional wheel (real entropy coding); else the first-party
+    # native store-mode encoder (valid frames, raw blocks).
+    if _zstd is not None:
+        return _zstd.ZstdCompressor(level=3).compress(data)
+    if _native is not None and _native.available():
+        return _native.zstd_compress(data)
+    raise UnsupportedCodec("ZSTD write needs the native library or 'zstandard'")
 
 
 def _zstd_decompress(data: bytes, uncompressed_size=None) -> bytes:
-    if _zstd is None:
-        raise UnsupportedCodec("ZSTD codec requires the 'zstandard' package")
-    d = _zstd.ZstdDecompressor()
-    if uncompressed_size:
-        return d.decompress(data, max_output_size=uncompressed_size)
-    return d.decompress(data)
+    # Prefer the wheel (vectorized libzstd) when installed; else the
+    # first-party RFC 8878 decoder (native/src/pftpu_zstd.cc).
+    if _zstd is not None:
+        d = _zstd.ZstdDecompressor()
+        if uncompressed_size:
+            return d.decompress(data, max_output_size=uncompressed_size)
+        return d.decompress(data)
+    if _native is not None and _native.available() and uncompressed_size is not None:
+        return _native.zstd_decompress(data, uncompressed_size)
+    if _native is not None and _native.available():
+        # size unknown: grow until the frame fits (frames carry FCS usually,
+        # but the C ABI wants a caller buffer; double until it decodes)
+        cap = max(len(data) * 4, 1 << 16)
+        while cap <= 1 << 31:
+            try:
+                return _native.zstd_decompress_unsized(data, cap)
+            except ValueError as e:
+                if "grow" not in str(e):
+                    raise
+                cap *= 2
+        raise ValueError("zstd frame too large")
+    raise UnsupportedCodec("ZSTD read needs the native library or 'zstandard'")
 
 
 def _lz4_raw_decompress(data: bytes, uncompressed_size=None) -> bytes:
@@ -184,6 +206,6 @@ def supported_codecs() -> Tuple[int, ...]:
         CompressionCodec.GZIP,
         CompressionCodec.LZ4_RAW,
     ]
-    if _zstd is not None:
+    if _zstd is not None or (_native is not None and _native.available()):
         base.append(CompressionCodec.ZSTD)
     return tuple(base)

@@ -56,11 +56,11 @@ def _load_locked():
     global _lib, _load_attempted
     if _load_attempted:  # lost the race: another thread finished the load
         return _lib
-    _load_attempted = True
     path = _lib_path()
     if not os.path.exists(path) and os.environ.get("PFTPU_NO_NATIVE_BUILD") != "1":
         _try_build()
     if not os.path.exists(path):
+        _load_attempted = True  # set only once the outcome is final
         return None
     try:
         lib = ctypes.CDLL(path)
@@ -81,6 +81,16 @@ def _load_locked():
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_longlong,
             ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
         ]
+        lib.pftpu_zstd_decompress.restype = ctypes.c_ssize_t
+        lib.pftpu_zstd_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.pftpu_zstd_max_compressed_size.restype = ctypes.c_size_t
+        lib.pftpu_zstd_max_compressed_size.argtypes = [ctypes.c_size_t]
+        lib.pftpu_zstd_compress_store.restype = ctypes.c_ssize_t
+        lib.pftpu_zstd_compress_store.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+        ]
         lib.pftpu_rle_parse_runs.restype = ctypes.c_ssize_t
         lib.pftpu_rle_parse_runs.argtypes = [
             ctypes.c_void_p, ctypes.c_size_t,  # data
@@ -91,6 +101,7 @@ def _load_locked():
         _lib = lib
     except OSError:
         _lib = None
+    _load_attempted = True  # after _lib is final, so the lock-free path is safe
     return _lib
 
 
@@ -118,6 +129,46 @@ def snappy_decompress(data: bytes, uncompressed_size: Optional[int] = None) -> b
     n = lib.pftpu_snappy_decompress(data, len(data), out, uncompressed_size)
     if n < 0:
         raise ValueError("native snappy decompression failed")
+    return out.raw[:n]
+
+
+def zstd_decompress(data: bytes, uncompressed_size: int) -> bytes:
+    """First-party RFC 8878 decoder (see src/pftpu_zstd.cc)."""
+    lib = _load()
+    out = ctypes.create_string_buffer(max(uncompressed_size, 1))
+    n = lib.pftpu_zstd_decompress(data, len(data), out, uncompressed_size)
+    if n == -2:
+        raise ValueError("native zstd: output exceeds the declared size")
+    if n < 0:
+        raise ValueError("native zstd: malformed frame")
+    if n != uncompressed_size:
+        raise ValueError(
+            f"native zstd: decoded {n} bytes, expected {uncompressed_size}"
+        )
+    return out.raw[:n]
+
+
+def zstd_decompress_unsized(data: bytes, cap: int) -> bytes:
+    """Decode without a known output size into a ``cap``-byte buffer; raises
+    ``ValueError('... grow ...')`` when the buffer is too small."""
+    lib = _load()
+    out = ctypes.create_string_buffer(max(cap, 1))
+    n = lib.pftpu_zstd_decompress(data, len(data), out, cap)
+    if n == -2:
+        raise ValueError("native zstd: output buffer too small, grow and retry")
+    if n < 0:
+        raise ValueError("native zstd: malformed frame")
+    return out.raw[:n]
+
+
+def zstd_compress(data: bytes) -> bytes:
+    """Store-mode zstd frames (raw blocks): spec-compliant, uncompressed."""
+    lib = _load()
+    cap = lib.pftpu_zstd_max_compressed_size(len(data))
+    out = ctypes.create_string_buffer(cap)
+    n = lib.pftpu_zstd_compress_store(data, len(data), out, cap)
+    if n < 0:
+        raise ValueError("native zstd: store encode failed")
     return out.raw[:n]
 
 

@@ -3,5 +3,5 @@
 set -e
 cd "$(dirname "$0")"
 g++ -O3 -march=native -fPIC -shared -Wall -Wextra \
-    -o libpftpu_native.so src/pftpu_native.cc
+    -o libpftpu_native.so src/pftpu_native.cc src/pftpu_zstd.cc
 echo "built $(pwd)/libpftpu_native.so"

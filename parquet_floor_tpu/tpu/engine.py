@@ -687,27 +687,32 @@ class TpuRowGroupReader:
         # Parse the PLAIN dictionary pool into a padded row matrix once
         # (keyed by content — dict handles hash collisions by comparison).
         key = dict_bytes.tobytes()
-        with self._dict_lock:
-            cached = self._string_dict_cache.get(key)
-        if cached is None:
-            col, _ = decode_plain(key, _count_plain_strings(dict_bytes), Type.BYTE_ARRAY)
-            rows, lengths, max_len = _padded_rows(col)
-            with self._dict_lock:
-                cached = self._string_dict_cache.setdefault(key, (rows, lengths, max_len))
-        host_rows, host_lens, max_len = cached
-        max_def = desc.max_definition_level
-        def_bw = norm.def_bw
-        lvl_plan = _merged_level_plan(norm)[0] if max_def > 0 else None
         # Ship the padded pool only if no device copy exists yet.  (Racy read
         # from a staging thread: worst case the pool ships once more and the
         # launch-thread cache ignores it.)
         ship_dict = key not in self._string_dict_dev
+        with self._dict_lock:
+            cached = self._string_dict_cache.get(key)
+        if ship_dict and (cached is None or cached[0] is None):
+            col, _ = decode_plain(key, _count_plain_strings(dict_bytes), Type.BYTE_ARRAY)
+            rows, lengths, max_len = _padded_rows(col)
+            cached = (rows, lengths, max_len)
+            with self._dict_lock:
+                self._string_dict_cache[key] = cached
+        host_rows, host_lens, max_len = cached
+        max_def = desc.max_definition_level
+        def_bw = norm.def_bw
+        lvl_plan = _merged_level_plan(norm)[0] if max_def > 0 else None
 
         def launch(dev):
             # device-side dictionary cache is touched on the launch thread only
             if ship_dict:
                 dcached = self._string_dict_dev.setdefault(key, (dev[0], dev[1]))
                 dev = dev[2:]
+                with self._dict_lock:
+                    # device copy now authoritative: drop the host pool matrix,
+                    # keep max_len (still needed by later stages)
+                    self._string_dict_cache[key] = (None, None, max_len)
             else:
                 dcached = self._string_dict_dev[key]
             dict_rows, dict_lens = dcached

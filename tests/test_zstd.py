@@ -1,0 +1,91 @@
+"""First-party ZSTD codec tests: RFC 8878 decoder + store-mode encoder
+(native/src/pftpu_zstd.cc) validated against pyarrow's bundled libzstd.
+
+Parity context: the reference decodes arbitrary footer codecs through its
+shim seam + JNI natives (SURVEY.md §2.4); ZSTD here is implemented from
+scratch instead of linked.
+"""
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu.format import codecs
+from parquet_floor_tpu.format.parquet_thrift import CompressionCodec
+from parquet_floor_tpu.native import binding as native
+
+pa = pytest.importorskip("pyarrow")
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not built"
+)
+
+rng = np.random.default_rng(7)
+
+
+def _payloads():
+    return [
+        b"",
+        b"a",
+        b"hello zstd " * 400,
+        bytes(rng.integers(0, 256, 70_000, dtype=np.uint8)),      # incompressible
+        bytes(rng.integers(0, 3, 150_000, dtype=np.uint8)),       # low entropy
+        np.arange(40_000, dtype=np.int64).tobytes(),              # structured
+        b"\x00" * 200_000,                                        # RLE + 2 blocks
+        bytes(rng.choice(list(b"abcdefg "), 250_000)),            # text-like
+    ]
+
+
+@pytest.mark.parametrize("level", [1, 3, 19])
+def test_decode_pyarrow_streams(level):
+    codec = pa.Codec("zstd", compression_level=level)
+    for data in _payloads():
+        comp = bytes(codec.compress(data))
+        got = native.zstd_decompress(comp, len(data))
+        assert got == data
+
+
+def test_store_encoder_roundtrips_via_pyarrow():
+    codec = pa.Codec("zstd")
+    for data in _payloads():
+        frame = native.zstd_compress(data)
+        back = bytes(codec.decompress(frame, decompressed_size=len(data)))
+        assert back == data
+        # and through our own decoder
+        assert native.zstd_decompress(frame, len(data)) == data
+
+
+def test_multi_frame_concatenation():
+    a, b = b"frame one " * 100, bytes(rng.integers(0, 9, 5000, dtype=np.uint8))
+    comp = bytes(pa.Codec("zstd").compress(a)) + bytes(pa.Codec("zstd").compress(b))
+    assert native.zstd_decompress(comp, len(a) + len(b)) == a + b
+
+
+def test_truncation_and_garbage_fail_cleanly():
+    data = bytes(rng.integers(0, 64, 30_000, dtype=np.uint8))
+    comp = bytes(pa.Codec("zstd").compress(data))
+    for cut in (1, 5, len(comp) // 2, len(comp) - 1):
+        with pytest.raises(ValueError):
+            native.zstd_decompress(comp[:cut], len(data))
+    for _ in range(100):
+        junk = bytes(rng.integers(0, 256, int(rng.integers(1, 500)), dtype=np.uint8))
+        try:
+            native.zstd_decompress(junk, 4096)
+        except ValueError:
+            pass  # rejection is the expected outcome; no crash / no hang
+
+
+def test_wrong_declared_size_rejected():
+    data = b"x" * 1000
+    comp = bytes(pa.Codec("zstd").compress(data))
+    with pytest.raises(ValueError):
+        native.zstd_decompress(comp, 999)  # too small: capacity error
+    with pytest.raises(ValueError):
+        native.zstd_decompress(comp, 1001)  # too large: short decode
+
+
+def test_codecs_dispatch_uses_native_zstd():
+    data = bytes(rng.integers(0, 50, 10_000, dtype=np.uint8))
+    comp = bytes(pa.Codec("zstd").compress(data))
+    assert codecs.decompress(CompressionCodec.ZSTD, comp, len(data)) == data
+    frame = codecs.compress(CompressionCodec.ZSTD, data)
+    assert codecs.decompress(CompressionCodec.ZSTD, frame, len(data)) == data

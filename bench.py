@@ -66,8 +66,7 @@ def main():
         with TpuRowGroupReader(path) as r:
             rows = 0
             outs = []
-            for gi in range(r.num_row_groups):
-                cols = r.read_row_group(gi)
+            for cols in r.iter_row_groups():
                 outs.extend(c.values for c in cols.values())
                 rows += next(iter(cols.values())).values.shape[0]
             for o in outs:

@@ -199,6 +199,30 @@ def decompress(codec: int, data: bytes, uncompressed_size: Optional[int] = None)
     return out
 
 
+def decompress_into(
+    codec: int, data, out_arr, offset: int, out_size: int
+) -> None:
+    """Decompress ``data`` directly into ``out_arr[offset:offset+out_size]``
+    (C-contiguous uint8 ndarray).  Native codecs write in place; others
+    decompress to bytes and copy — one copy either way, never two."""
+    import numpy as np
+
+    if codec == CompressionCodec.UNCOMPRESSED:
+        out_arr[offset : offset + out_size] = np.frombuffer(
+            data, dtype=np.uint8, count=out_size
+        )
+        return
+    if _native is not None and _native.available():
+        if codec == CompressionCodec.SNAPPY:
+            _native.snappy_decompress_into(bytes(data), out_arr, offset, out_size)
+            return
+        if codec == CompressionCodec.ZSTD:
+            _native.zstd_decompress_into(bytes(data), out_arr, offset, out_size)
+            return
+    out = decompress(codec, data, out_size)
+    out_arr[offset : offset + out_size] = np.frombuffer(out, dtype=np.uint8)
+
+
 def supported_codecs() -> Tuple[int, ...]:
     base = [
         CompressionCodec.UNCOMPRESSED,

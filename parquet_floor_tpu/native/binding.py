@@ -132,6 +132,31 @@ def snappy_decompress(data: bytes, uncompressed_size: Optional[int] = None) -> b
     return out.raw[:n]
 
 
+def snappy_decompress_into(data, out_arr, offset: int, out_size: int) -> None:
+    """Decompress directly into ``out_arr[offset:offset+out_size]`` (a
+    C-contiguous uint8 ndarray) — the zero-extra-copy arena staging path."""
+    lib = _load()
+    ptr = ctypes.c_char_p(out_arr.ctypes.data + offset)
+    n = lib.pftpu_snappy_decompress(data, len(data), ptr, out_size)
+    if n < 0:
+        raise ValueError("native snappy decompression failed")
+    if n != out_size:
+        raise ValueError(f"snappy decoded {n} bytes, expected {out_size}")
+
+
+def zstd_decompress_into(data, out_arr, offset: int, out_size: int) -> None:
+    """RFC 8878 decode directly into ``out_arr[offset:offset+out_size]``."""
+    lib = _load()
+    ptr = ctypes.c_char_p(out_arr.ctypes.data + offset)
+    n = lib.pftpu_zstd_decompress(data, len(data), ptr, out_size)
+    if n == -2:
+        raise ValueError("native zstd: output exceeds the declared size")
+    if n < 0:
+        raise ValueError("native zstd: malformed frame")
+    if n != out_size:
+        raise ValueError(f"native zstd: decoded {n} bytes, expected {out_size}")
+
+
 def zstd_decompress(data: bytes, uncompressed_size: int) -> bytes:
     """First-party RFC 8878 decoder (see src/pftpu_zstd.cc)."""
     lib = _load()

@@ -92,6 +92,38 @@ def rle_expand(
     return jnp.where(run_kind[rid] == 0, run_value[rid], packed)
 
 
+def rle_expand_bw(
+    data_u8: jax.Array,
+    run_out_end: jax.Array,   # int32[R]: cumulative output count after run r
+    run_kind: jax.Array,      # int32[R]: 0 = RLE, 1 = bit-packed
+    run_value: jax.Array,     # int32[R]: repeated value (RLE runs)
+    run_bitbase: jax.Array,   # int32[R]: absolute bit offset of packed data
+    run_bw: jax.Array,        # int32[R]: bit width of packed data (may vary!)
+    num_values: int,
+) -> jax.Array:
+    """``rle_expand`` with *per-run* bit widths (all dynamic).
+
+    Writers grow the dictionary index width across pages of one chunk;
+    treating width as run data (extract a 32-bit window, mask to the run's
+    width) decodes mixed-width chunks in one pass with one compiled shape.
+    """
+    out_idx = jnp.arange(num_values, dtype=jnp.int32)
+    rid = jnp.searchsorted(run_out_end, out_idx, side="right").astype(jnp.int32)
+    rid = jnp.minimum(rid, run_out_end.shape[0] - 1)
+    run_start = jnp.where(rid == 0, 0, run_out_end[jnp.maximum(rid - 1, 0)])
+    within = out_idx - run_start
+    bw = run_bw[rid]
+    bitpos = run_bitbase[rid] + within * bw
+    raw = extract_bits(data_u8, bitpos, 32)
+    bwu = bw.astype(jnp.uint32)
+    mask = jnp.where(
+        bw >= 32, jnp.uint32(0xFFFFFFFF), (jnp.uint32(1) << bwu) - jnp.uint32(1)
+    )
+    mask = jnp.where(bw == 0, jnp.uint32(0), mask)
+    packed = (raw & mask).astype(jnp.int32)
+    return jnp.where(run_kind[rid] == 0, run_value[rid], packed)
+
+
 def dict_gather(dictionary: jax.Array, indices: jax.Array) -> jax.Array:
     """The dictionary gather — one ``take`` on device (north-star hot op)."""
     return jnp.take(dictionary, indices, axis=0)
@@ -201,6 +233,45 @@ def run_table_to_device_plan(run_table: np.ndarray, num_values: int, pad_runs: i
         "run_value": value,
         "run_bitbase": bitbase,
     }
+
+
+def tables_to_plan5(tables, total: int, pad_runs: int) -> np.ndarray:
+    """Merge ``parse_runs`` tables into one flat int32 plan of 5 rows ×
+    ``pad_runs``: out_end, kind, value, bitbase, bw.
+
+    ``tables`` is a sequence of (run_table, bit_width) pairs whose byte
+    offsets (column 2 of bit-packed rows) are already absolute in the target
+    buffer.  Pad runs own no output (out_end == total).
+    """
+    r = sum(len(t) for t, _ in tables)
+    if r > pad_runs:
+        raise ValueError(f"run tables ({r}) exceed padding ({pad_runs})")
+    plan = np.zeros((5, pad_runs), dtype=np.int32)
+    plan[0] = total
+    pos = 0
+    for table, bw in tables:
+        k = len(table)
+        if not k:
+            continue
+        sl = slice(pos, pos + k)
+        plan[1, sl] = table[:, 0]
+        is_bp = table[:, 0] == 1
+        plan[2, sl] = np.where(is_bp, 0, table[:, 2]).astype(np.int32)
+        bitbase = table[:, 2] * 8
+        if bitbase.size and bitbase.max(initial=0) >= 2**31:
+            raise ValueError("bit offsets exceed int32 (arena too large)")
+        plan[3, sl] = np.where(is_bp, bitbase, 0).astype(np.int32)
+        plan[4, sl] = bw
+        plan[0, pos : pos + k] = table[:, 1]  # counts for now
+        pos += k
+    if pos:
+        plan[0, :pos] = np.cumsum(plan[0, :pos])
+        if pos and plan[0, pos - 1] != total:
+            # trailing pad already holds `total`; runs must sum to it
+            raise ValueError(
+                f"run counts sum to {plan[0, pos - 1]}, expected {total}"
+            )
+    return plan.reshape(-1)
 
 
 def pad_to(arr: np.ndarray, size: int, fill=0) -> np.ndarray:

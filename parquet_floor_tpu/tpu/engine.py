@@ -1,38 +1,50 @@
-"""Batched TPU row-group decode engine.
+"""Batched TPU row-group decode engine — one fused compiled step per group.
 
 Replaces the reference's per-cell pull loop (``ParquetReader.java:176-212``)
-with the SURVEY.md §3.2 boundary note made real: the host reads raw column
-chunks, normalizes pages (decompress via the native codec, parse run tables
-— O(runs), tiny), ships flat byte buffers + plan arrays to HBM once, and a
-single jitted function per column expands, gathers, and scatters the whole
-row group on device.
+with the SURVEY.md §3.2 boundary made real, designed around the two costs
+that dominate a real TPU link: per-array transfer overhead and host copies.
 
-Decode paths on device (all static-shaped, jit-cached per
-(path, n, bit widths, dtype)):
-  * RLE_DICTIONARY fixed-width   — run expand → dictionary take → null scatter
-  * RLE_DICTIONARY BYTE_ARRAY    — run expand → padded-matrix take
-  * PLAIN fixed-width            — bitcast → null scatter
-  * PLAIN BOOLEAN                — per-page bit-packed runs → run expand
-  * DELTA_BINARY_PACKED (≤32-bit miniblocks, single page) — delta expand
-Anything else falls back to the host NumPy engine and is shipped dense.
+Staging (host) packs an entire row group into exactly three objects:
+
+  * ``arena``  — one uint8 buffer holding every decompressed page stream,
+    dictionary pool, and host-decoded fallback column.  Pages decompress
+    *directly into* the arena (native ``decompress_into``), so bytes are
+    touched once on the host.
+  * ``slab``   — one int32 buffer holding every run-table plan (absolute
+    byte offsets into the arena), page table, and dynamic scalar.
+  * ``program``— a static tuple of per-column specs (shapes, dtypes, slab
+    offsets).  It is the jit cache key: row groups with the same shape
+    signature share one compiled executable.
+
+One ``jax.device_put`` ships arena+slab; one jitted call decodes every
+column of the group on device (RLE/bit-packed expansion with per-run bit
+widths, dictionary gather, delta prefix-sum, null scatter).  All shape
+buckets grow monotonically (high-water marks) so recompiles converge.
+
+Decode paths on device:
+  * RLE_DICTIONARY fixed-width + BYTE_ARRAY (mixed per-page bit widths OK)
+  * PLAIN fixed-width (paged gather across non-contiguous page streams)
+  * PLAIN BOOLEAN (pages as bit-packed runs)
+  * DELTA_BINARY_PACKED (≤32-bit miniblocks, single page, required)
+Anything else decodes on the host NumPy engine and ships dense *inside the
+same arena* (no extra transfers).
 """
 
 from __future__ import annotations
 
-import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from functools import partial
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..format import codecs
-from ..format import pages as pg
 from ..format.encodings import rle_hybrid as e_rle
 from ..format.encodings.plain import ByteArrayColumn, decode_plain
 from ..format.file_read import ParquetFileReader
@@ -44,6 +56,7 @@ from ..format.parquet_thrift import (
 )
 from ..format.schema import ColumnDescriptor
 from . import bitops
+
 
 def _require_x64() -> None:
     """64-bit decode correctness requires x64 (int64 is exact on TPU via
@@ -59,18 +72,26 @@ def _require_x64() -> None:
             "TpuRowGroupReader"
         )
 
-_JNP_DTYPE = {
-    Type.INT32: jnp.int32,
-    Type.INT64: jnp.int64,
-    Type.FLOAT: jnp.float32,
-    Type.DOUBLE: jnp.float64,
-}
+
 _NP_DTYPE = {
     Type.INT32: np.int32,
     Type.INT64: np.int64,
     Type.FLOAT: np.float32,
     Type.DOUBLE: np.float64,
 }
+_VDTYPE_NAME = {
+    Type.INT32: "int32",
+    Type.INT64: "int64",
+    Type.FLOAT: "float32",
+    Type.DOUBLE: "float64",
+}
+_JNP_BY_NAME = {
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+}
+_WIDTH_BY_NAME = {"int32": 4, "int64": 8, "float32": 4, "float64": 8, "bool": 1}
 
 
 def _platform_is_tpu() -> bool:
@@ -130,143 +151,611 @@ class DeviceColumn:
         return np.asarray(self.values), (None if self.mask is None else np.asarray(self.mask))
 
 
+class _Fallback(Exception):
+    """Signal at layout time: this chunk takes the host NumPy path."""
+
+
+class _ForceHost(Exception):
+    """Signal after arena fill: restage the group with this column forced
+    onto the host path (rare — e.g. delta streams needing >32-bit math)."""
+
+    def __init__(self, key: str):
+        super().__init__(key)
+        self.key = key
+
+
 # ---------------------------------------------------------------------------
-# Host-side page normalization
+# Builders
+# ---------------------------------------------------------------------------
+
+class _ArenaBuilder:
+    """Reserve byte regions, then fill them all in one pass (decompressing
+    straight into the final buffer)."""
+
+    def __init__(self):
+        self.size = 0
+        self.jobs: List[tuple] = []  # ("d", codec, payload, off, size) | ("c", data, off)
+
+    def reserve(self, size: int) -> int:
+        off = self.size
+        self.size += int(size)
+        return off
+
+    def add_decompress(self, codec: int, payload, size: int) -> int:
+        off = self.reserve(size)
+        self.jobs.append(("d", codec, payload, off, size))
+        return off
+
+    def add_copy(self, data, size: int) -> int:
+        off = self.reserve(size)
+        self.jobs.append(("c", data, off, size))
+        return off
+
+    def fill(self, arena: np.ndarray, pool: Optional[ThreadPoolExecutor] = None) -> None:
+        def run(job):
+            if job[0] == "d":
+                _, codec, payload, off, size = job
+                codecs.decompress_into(codec, payload, arena, off, size)
+            else:
+                _, data, off, size = job
+                if size:
+                    arena[off : off + size] = np.frombuffer(
+                        data, dtype=np.uint8, count=size
+                    )
+
+        if pool is not None and len(self.jobs) > 1:
+            # jobs write disjoint arena regions; native codecs release the GIL
+            list(pool.map(run, self.jobs))
+        else:
+            for job in self.jobs:
+                run(job)
+
+
+class _I32Builder:
+    """Accumulate int32 vectors into one slab; returns element offsets."""
+
+    def __init__(self):
+        self.parts: List[np.ndarray] = []
+        self.n = 0
+
+    def add(self, arr) -> int:
+        a = np.ascontiguousarray(arr, dtype=np.int32).reshape(-1)
+        off = self.n
+        self.parts.append(a)
+        self.n += a.size
+        return off
+
+    def build(self, pad_to: int) -> np.ndarray:
+        out = np.zeros(max(pad_to, self.n, 1), dtype=np.int32)
+        pos = 0
+        for p in self.parts:
+            out[pos : pos + p.size] = p
+            pos += p.size
+        return out
+
+
+def _bucket15(n: int, minimum: int = 16) -> int:
+    """Round up to a power of two or 1.5× a power of two (≤ 33% waste, few
+    distinct buckets — jit-cache-friendly shapes)."""
+    if n <= minimum:
+        return minimum
+    p = 1 << (max(n - 1, 1)).bit_length()  # next pow2 ≥ n
+    if n <= (p // 2) + (p // 4):           # 1.5 × pow2/2 fits
+        return (p // 2) + (p // 4)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# The static per-column program
+# ---------------------------------------------------------------------------
+
+class _ColSpec(NamedTuple):
+    name: str
+    kind: str        # dict | dict_str | plain | bool | delta | host | host_rows | host_str
+    n: int           # rows in the group
+    nexp: int        # value-stream expansion count (n if required, bucketed nn if optional)
+    max_def: int
+    def_bw: int
+    lvl_off: int = -1
+    r_lvl: int = 0
+    idx_off: int = -1   # dict index plan / bool page plan (5 × r_idx)
+    r_idx: int = 0
+    sc_off: int = -1    # misc dynamic scalars
+    pg_off: int = -1    # plain page tables (2 × p_pad: abs base, nn cumsum)
+    p_pad: int = 0
+    width: int = 0
+    vdtype: str = ""
+    f64mode: str = ""   # '', 'f32', 'bits', 'f64'
+    dict_cap: int = 0
+    max_len: int = 0
+    extra_idx: int = -1
+    mb_off: int = -1
+    m_pad: int = 0
+    vpm: int = 0
+
+
+@dataclass
+class _StagedGroup:
+    """Host-staged row group: ship arena+slab, then run the fused program."""
+
+    program: tuple
+    arena: np.ndarray
+    slab: np.ndarray
+    descs: List[ColumnDescriptor]
+    extra_keys: List[tuple]            # cache keys, in extras order
+    new_extras: List[tuple]            # (key, rows_host, lens_host) to ship
+    num_rows: int
+
+
+# ---------------------------------------------------------------------------
+# Device-side fused decode (traced once per program)
+# ---------------------------------------------------------------------------
+
+def _plan5(slab, off: int, r: int):
+    p = lax.slice(slab, (off,), (off + 5 * r,)).reshape(5, r)
+    return p[0], p[1], p[2], p[3], p[4]
+
+
+def _expand(arena, slab, off: int, r: int, count: int):
+    oe, k, v, bb, bw = _plan5(slab, off, r)
+    return bitops.rle_expand_bw(arena, oe, k, v, bb, bw, count)
+
+
+def _typed(u8, count: int, width: int, vdtype: str, f64mode: str):
+    rows = u8.reshape(count, width)
+    if vdtype == "u8rows":
+        return rows
+    if vdtype == "bool":
+        return rows.reshape(count) != 0
+    if vdtype == "float64":
+        if f64mode == "f32":
+            bits = lax.bitcast_convert_type(rows, jnp.int64).reshape(count)
+            return f64bits_to_f32(bits)
+        if f64mode == "bits":
+            return lax.bitcast_convert_type(rows, jnp.int64).reshape(count)
+    return lax.bitcast_convert_type(rows, _JNP_BY_NAME[vdtype]).reshape(count)
+
+
+def _paged_gather(arena, slab, spec: _ColSpec):
+    """Gather value bytes across non-contiguous page streams: value id →
+    owning page (searchsorted over per-page non-null cumsum) → absolute
+    byte position → width-byte gather."""
+    base = lax.slice(slab, (spec.pg_off,), (spec.pg_off + spec.p_pad,))
+    cum = lax.slice(
+        slab, (spec.pg_off + spec.p_pad,), (spec.pg_off + 2 * spec.p_pad,)
+    )
+    vid = jnp.arange(spec.nexp, dtype=jnp.int32)
+    pgi = jnp.searchsorted(cum, vid, side="right").astype(jnp.int32)
+    pgi = jnp.minimum(pgi, spec.p_pad - 1)
+    start = jnp.where(pgi == 0, 0, cum[jnp.maximum(pgi - 1, 0)])
+    bytepos = base[pgi] + (vid - start) * spec.width
+    idx = bytepos[:, None] + jnp.arange(spec.width, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(idx, 0, arena.shape[0] - 1)
+    return jnp.take(arena, idx.reshape(-1)).reshape(spec.nexp * spec.width)
+
+
+def _levels_present(arena, slab, spec: _ColSpec):
+    levels = _expand(arena, slab, spec.lvl_off, spec.r_lvl, spec.n)
+    return levels == spec.max_def
+
+
+def _finish_optional(vals, present, lens=None):
+    dense = bitops.dense_scatter(vals, present)
+    mask = ~present
+    dlens = bitops.dense_scatter(lens, present) if lens is not None else None
+    return dense, mask, dlens
+
+
+def _decode_col(spec: _ColSpec, arena, slab, extras):
+    if spec.kind == "host":
+        u8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.n * spec.width,))
+        vals = _typed(u8, spec.n, spec.width, spec.vdtype, spec.f64mode)
+        mask = None
+        if spec.max_def > 0:
+            m = lax.dynamic_slice(arena, (slab[spec.sc_off + 1],), (spec.n,))
+            mask = m != 0
+        return vals, mask, None
+    if spec.kind == "host_rows":
+        u8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.n * spec.width,))
+        vals = u8.reshape(spec.n, spec.width)
+        mask = None
+        if spec.max_def > 0:
+            m = lax.dynamic_slice(arena, (slab[spec.sc_off + 1],), (spec.n,))
+            mask = m != 0
+        return vals, mask, None
+    if spec.kind == "host_str":
+        r8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.n * spec.max_len,))
+        rows = r8.reshape(spec.n, spec.max_len)
+        l8 = lax.dynamic_slice(arena, (slab[spec.sc_off + 1],), (spec.n * 4,))
+        lens = lax.bitcast_convert_type(l8.reshape(spec.n, 4), jnp.int32).reshape(spec.n)
+        mask = None
+        if spec.max_def > 0:
+            m = lax.dynamic_slice(arena, (slab[spec.sc_off + 2],), (spec.n,))
+            mask = m != 0
+        return rows, mask, lens
+    if spec.kind == "delta":
+        mb = lax.slice(slab, (spec.mb_off,), (spec.mb_off + 3 * spec.m_pad,)).reshape(
+            3, spec.m_pad
+        )
+        first = slab[spec.sc_off]
+        vals = bitops.delta_expand(
+            arena, mb[0], mb[1], mb[2], first, spec.n, spec.vpm,
+            out_dtype=_JNP_BY_NAME[spec.vdtype],
+        )
+        return vals, None, None
+
+    # --- expansion-based kinds: dict / dict_str / plain / bool ------------
+    if spec.kind == "dict":
+        idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp)
+        # clamped gather, not dynamic_slice: the bucketed capacity may
+        # overrun the arena tail (padding rows are garbage, never indexed)
+        dpos = slab[spec.sc_off] + jnp.arange(
+            spec.dict_cap * spec.width, dtype=jnp.int32
+        )
+        du8 = jnp.take(arena, jnp.clip(dpos, 0, arena.shape[0] - 1))
+        dvals = _typed(du8, spec.dict_cap, spec.width, spec.vdtype, spec.f64mode)
+        vals = jnp.take(dvals, idx, axis=0)
+        lens = None
+    elif spec.kind == "dict_str":
+        rows_d = extras[2 * spec.extra_idx]
+        lens_d = extras[2 * spec.extra_idx + 1]
+        idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp)
+        vals = jnp.take(rows_d, idx, axis=0)
+        lens = jnp.take(lens_d, idx)
+    elif spec.kind == "plain":
+        if spec.p_pad == 1:
+            u8 = lax.dynamic_slice(
+                arena, (slab[spec.pg_off],), (spec.nexp * spec.width,)
+            )
+        else:
+            u8 = _paged_gather(arena, slab, spec)
+        vals = _typed(u8, spec.nexp, spec.width, spec.vdtype, spec.f64mode)
+        lens = None
+    elif spec.kind == "bool":
+        bits = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp)
+        vals = bits.astype(jnp.bool_)
+        lens = None
+    else:  # pragma: no cover - program construction guards this
+        raise ValueError(f"unknown column kind {spec.kind!r}")
+
+    if spec.max_def > 0:
+        present = _levels_present(arena, slab, spec)
+        return _finish_optional(vals, present, lens)
+    return vals, None, lens
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _decode_fused(program: tuple, arena, slab, *extras):
+    """One compiled decode step for a whole row group."""
+    return tuple(_decode_col(spec, arena, slab, extras) for spec in program)
+
+
+# ---------------------------------------------------------------------------
+# Host staging
 # ---------------------------------------------------------------------------
 
 @dataclass
-class _NormPages:
-    """Uncompressed, concatenated page streams for one chunk."""
-
-    levels_buf: np.ndarray          # concat of def-level streams (unframed)
-    values_buf: np.ndarray          # concat of value streams
-    # per page: (n_values, n_non_null, level_byte_base, value_byte_base,
-    #            value_encoding)
-    page_n: List[int]
-    page_nn: List[int]
-    page_level_base: List[int]
-    page_value_base: List[int]
-    page_encoding: List[int]
-    def_bw: int
-    max_def: int
-    # level run tables parsed during normalization (V1 pages parse them for
-    # the non-null count anyway); byte offsets are relative to the page's
-    # level stream.  None → parse lazily in _merged_level_plan (V2 pages).
-    page_level_table: List[Optional[np.ndarray]] = None
+class _Pg:
+    v: int                      # 1 or 2
+    n: int                      # values (levels) in page
+    off: int                    # arena offset of the page region (v1) / values (v2)
+    size: int                   # region size
+    enc: int
+    nn: Optional[int] = None    # non-null count (v2 header; v1 computed later)
+    lvl_off: int = -1           # v2: arena offset of def-level stream
+    lvl_len: int = 0
 
 
-def _normalize_pages(
-    raw_pages: List[pg.RawPage], desc: ColumnDescriptor, codec: int
-) -> Tuple[Optional[np.ndarray], _NormPages]:
-    """Decompress + split every data page into (levels, values) streams.
+class _DevStage:
+    """A chunk headed for the device path.  Raises _Fallback during layout
+    when the chunk needs the host engine."""
 
-    Returns (dictionary_plain_bytes_or_None, _NormPages).  Rep levels are
-    rejected here (nested columns use the host Dremel path).
-    """
-    if desc.max_repetition_level > 0:
-        raise _Fallback("repeated column")
-    max_def = desc.max_definition_level
-    def_bw = e_rle.min_bit_width(max_def)
-    levels_parts: List[bytes] = []
-    values_parts: List[bytes] = []
-    meta = _NormPages(
-        levels_buf=np.zeros(0, np.uint8),
-        values_buf=np.zeros(0, np.uint8),
-        page_n=[], page_nn=[], page_level_base=[], page_value_base=[],
-        page_encoding=[], def_bw=def_bw, max_def=max_def,
-        page_level_table=[],
-    )
-    dict_bytes: Optional[np.ndarray] = None
-    lvl_pos = 0
-    val_pos = 0
-    for page in raw_pages:
-        if page.page_type == PageType.DICTIONARY_PAGE:
-            dh = page.header.dictionary_page_header
-            if dh.encoding not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
-                raise _Fallback("non-PLAIN dictionary page")
-            data = codecs.decompress(codec, page.payload, page.header.uncompressed_page_size)
-            dict_bytes = np.frombuffer(data, dtype=np.uint8)
-            continue
-        if page.page_type == PageType.DATA_PAGE:
-            h = page.header.data_page_header
-            data = codecs.decompress(codec, page.payload, page.header.uncompressed_page_size)
-            pos = 0
-            n = h.num_values
-            lvl_table = None
-            if max_def > 0:
-                if h.definition_level_encoding not in (Encoding.RLE, None):
+    def __init__(self, name, chunk, desc: ColumnDescriptor, reader, arena: _ArenaBuilder):
+        self.name = name
+        self.desc = desc
+        meta = chunk.meta_data
+        if desc.max_repetition_level > 0:
+            raise _Fallback("repeated column")
+        pt = desc.physical_type
+        codec = meta.codec
+        max_def = desc.max_definition_level
+        raw_pages = reader.read_raw_column_chunk(chunk)
+        pages: List[_Pg] = []
+        self.dict_off = -1
+        self.dict_size = 0
+        for page in raw_pages:
+            if page.page_type == PageType.DICTIONARY_PAGE:
+                dh = page.header.dictionary_page_header
+                if dh.encoding not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
+                    raise _Fallback("non-PLAIN dictionary page")
+                size = page.header.uncompressed_page_size
+                self.dict_off = arena.add_decompress(codec, page.payload, size)
+                self.dict_size = size
+            elif page.page_type == PageType.DATA_PAGE:
+                h = page.header.data_page_header
+                if max_def > 0 and h.definition_level_encoding not in (
+                    Encoding.RLE, None,
+                ):
                     raise _Fallback("non-RLE def levels")
-                ln = int.from_bytes(data[pos : pos + 4], "little")
-                levels_parts.append(data[pos + 4 : pos + 4 + ln])
-                level_base, lvl_pos = lvl_pos, lvl_pos + ln
-                pos += 4 + ln
-                # count non-nulls cheaply from the run table
-                table, _ = e_rle.parse_runs(data, n, def_bw, pos - ln)
-                nn = _count_non_null(data, table, n, def_bw, max_def)
-                # rebase bit-packed offsets to the level stream start so the
-                # merged plan can reuse this parse
-                lvl_table = table.copy()
-                lvl_table[lvl_table[:, 0] == 1, 2] -= pos - ln
+                size = page.header.uncompressed_page_size
+                off = arena.add_decompress(codec, page.payload, size)
+                pages.append(_Pg(1, h.num_values, off, size, h.encoding))
+            elif page.page_type == PageType.DATA_PAGE_V2:
+                h2 = page.header.data_page_header_v2
+                rl = h2.repetition_levels_byte_length or 0
+                dl = h2.definition_levels_byte_length or 0
+                if rl:
+                    raise _Fallback("repetition levels present")
+                payload = page.payload
+                lvl_off = -1
+                if dl:
+                    lvl_off = arena.add_copy(payload[rl : rl + dl], dl)
+                body = payload[rl + dl :]
+                vsize = page.header.uncompressed_page_size - rl - dl
+                compressed = (
+                    h2.is_compressed if h2.is_compressed is not None else True
+                )
+                if compressed and codec != CompressionCodec.UNCOMPRESSED:
+                    val_off = arena.add_decompress(codec, body, vsize)
+                else:
+                    val_off = arena.add_copy(body, vsize)
+                pages.append(
+                    _Pg(2, h2.num_values, val_off, vsize, h2.encoding,
+                        nn=h2.num_values - (h2.num_nulls or 0),
+                        lvl_off=lvl_off, lvl_len=dl)
+                )
+            elif page.page_type == PageType.INDEX_PAGE:
+                continue
             else:
-                level_base = 0
-                nn = n
-            values_parts.append(data[pos:])
-            value_base, val_pos = val_pos, val_pos + len(data) - pos
-            enc = h.encoding
-            meta.page_level_table.append(lvl_table)
-        elif page.page_type == PageType.DATA_PAGE_V2:
-            h2 = page.header.data_page_header_v2
-            n = h2.num_values
-            rl = h2.repetition_levels_byte_length or 0
-            dl = h2.definition_levels_byte_length or 0
-            payload = page.payload
-            if rl:
-                raise _Fallback("repetition levels present")
-            if max_def > 0:
-                levels_parts.append(bytes(payload[rl : rl + dl]))
-                level_base, lvl_pos = lvl_pos, lvl_pos + dl
+                raise _Fallback(f"page type {page.page_type}")
+        if not pages:
+            raise _Fallback("empty chunk")
+        self.pages = pages
+        encs = {p.enc for p in pages}
+        if encs <= {Encoding.RLE_DICTIONARY, Encoding.PLAIN_DICTIONARY}:
+            if self.dict_off < 0:
+                raise _Fallback("dictionary pages missing")
+            if pt in _NP_DTYPE:
+                self.kind = "dict"
+            elif pt == Type.BYTE_ARRAY:
+                self.kind = "dict_str"
             else:
-                level_base = 0
-            body = payload[rl + dl :]
-            compressed = h2.is_compressed if h2.is_compressed is not None else True
-            if compressed and codec != CompressionCodec.UNCOMPRESSED:
-                expected = page.header.uncompressed_page_size - rl - dl
-                body = codecs.decompress(codec, body, expected)
-            nn = n - (h2.num_nulls or 0)
-            values_parts.append(bytes(body))
-            value_base, val_pos = val_pos, val_pos + len(body)
-            enc = h2.encoding
-            meta.page_level_table.append(None)
-        elif page.page_type == PageType.INDEX_PAGE:
-            continue
+                raise _Fallback(f"dict decode for type {Type.name(pt)}")
+        elif encs == {Encoding.PLAIN}:
+            if pt == Type.BOOLEAN:
+                self.kind = "bool"
+            elif pt in _NP_DTYPE:
+                self.kind = "plain"
+            else:
+                raise _Fallback(f"PLAIN device decode for {Type.name(pt)}")
+        elif (
+            encs == {Encoding.DELTA_BINARY_PACKED}
+            and len(pages) == 1
+            and max_def == 0
+            and pt in (Type.INT32, Type.INT64)
+        ):
+            self.kind = "delta"
         else:
-            raise _Fallback(f"page type {page.page_type}")
-        meta.page_n.append(n)
-        meta.page_nn.append(nn)
-        meta.page_level_base.append(level_base)
-        meta.page_value_base.append(value_base)
-        meta.page_encoding.append(enc)
-    meta.levels_buf = _concat_padded(levels_parts)
-    meta.values_buf = _concat_padded(values_parts)
-    return dict_bytes, meta
+            raise _Fallback(f"encodings {sorted(encs)}")
+
+    # -- after arena fill ---------------------------------------------------
+
+    def finish(self, arena: np.ndarray, slabb: _I32Builder, eng) -> _ColSpec:
+        desc = self.desc
+        max_def = desc.max_definition_level
+        def_bw = e_rle.min_bit_width(max_def)
+        pt = desc.physical_type
+        n = sum(p.n for p in self.pages)
+        lvl_tables = []
+        val_offs: List[int] = []
+        nns: List[int] = []
+        for p in self.pages:
+            if p.v == 1:
+                if max_def > 0:
+                    ln = int.from_bytes(arena[p.off : p.off + 4].tobytes(), "little")
+                    table, _ = e_rle.parse_runs(arena, p.n, def_bw, pos=p.off + 4)
+                    nn = _count_non_null(arena, table, p.n, def_bw, max_def)
+                    lvl_tables.append((table, def_bw))
+                    val_offs.append(p.off + 4 + ln)
+                else:
+                    nn = p.n
+                    val_offs.append(p.off)
+            else:
+                if max_def > 0:
+                    table, _ = e_rle.parse_runs(arena, p.n, def_bw, pos=p.lvl_off)
+                    lvl_tables.append((table, def_bw))
+                nn = p.nn
+                val_offs.append(p.off)
+            nns.append(int(nn))
+        total_nn = sum(nns)
+
+        spec = dict(
+            name=self.name, kind=self.kind, n=n, max_def=max_def, def_bw=def_bw,
+            nexp=n,
+        )
+        if max_def > 0:
+            r_lvl = eng._hwm(("r_lvl", self.name), sum(len(t) for t, _ in lvl_tables))
+            spec["lvl_off"] = slabb.add(bitops.tables_to_plan5(lvl_tables, n, r_lvl))
+            spec["r_lvl"] = r_lvl
+            spec["nexp"] = eng._hwm(("nexp", self.name), total_nn)
+
+        if self.kind in ("dict", "dict_str"):
+            idx_tables = []
+            for p, val_off, nn in zip(self.pages, val_offs, nns):
+                page_bw = int(arena[val_off])
+                if page_bw > 32:
+                    raise _ForceHost(self.name)
+                if page_bw == 0 or nn == 0:
+                    # all values are index 0 (or page empty): empty table
+                    # rows expand to zeros via the plan's RLE padding
+                    if nn:
+                        idx_tables.append(
+                            (np.array([[0, nn, 0, 0]], dtype=np.int64), 1)
+                        )
+                    continue
+                table, _ = e_rle.parse_runs(arena, nn, page_bw, pos=val_off + 1)
+                idx_tables.append((table, page_bw))
+            r_idx = eng._hwm(
+                ("r_idx", self.name), sum(len(t) for t, _ in idx_tables)
+            )
+            spec["idx_off"] = slabb.add(
+                bitops.tables_to_plan5(idx_tables, total_nn, r_idx)
+            )
+            spec["r_idx"] = r_idx
+            if self.kind == "dict":
+                width = np.dtype(_NP_DTYPE[pt]).itemsize
+                num_dict = self.dict_size // width
+                spec["width"] = width
+                spec["vdtype"] = _VDTYPE_NAME[pt]
+                spec["f64mode"] = eng._f64mode if pt == Type.DOUBLE else ""
+                spec["dict_cap"] = eng._hwm(("dict", self.name), num_dict)
+                spec["sc_off"] = slabb.add([self.dict_off])
+            else:
+                key, cap, max_len = eng._string_dict_key(
+                    arena, self.dict_off, self.dict_size, self.name
+                )
+                spec["dict_cap"] = cap
+                spec["max_len"] = max_len
+                spec["sc_off"] = slabb.add([self.dict_off])
+                spec["extra_idx"] = -2  # patched by the engine (order of use)
+                spec["_extra_key"] = key
+        elif self.kind == "plain":
+            width = np.dtype(_NP_DTYPE[pt]).itemsize
+            spec["width"] = width
+            spec["vdtype"] = _VDTYPE_NAME[pt]
+            spec["f64mode"] = eng._f64mode if pt == Type.DOUBLE else ""
+            # collapse contiguous page streams into one (required v1 pages
+            # decompress back-to-back in the arena); only required columns
+            # may use the dynamic_slice fast path — optional columns pad
+            # nexp beyond nn, which must clamp per element (paged gather)
+            contiguous = max_def == 0 and all(
+                val_offs[i] == val_offs[i - 1] + nns[i - 1] * width
+                for i in range(1, len(val_offs))
+            )
+            if contiguous:
+                p_pad = 1
+                page_tbl = np.array([val_offs[0], total_nn], dtype=np.int64)
+            else:
+                p_pad = eng._hwm(("pages", self.name), len(self.pages), minimum=4)
+                base = bitops.pad_to(np.asarray(val_offs, np.int64), p_pad)
+                cum = bitops.pad_to(
+                    np.cumsum(np.asarray(nns, np.int64)), p_pad, fill=total_nn
+                )
+                page_tbl = np.concatenate([base, cum])
+            spec["pg_off"] = slabb.add(page_tbl)
+            spec["p_pad"] = p_pad
+        elif self.kind == "bool":
+            pg_tables = [
+                (np.array([[1, nn, val_off, 0]], dtype=np.int64), 1)
+                for val_off, nn in zip(val_offs, nns)
+                if nn
+            ]
+            r_idx = eng._hwm(("pages", self.name), max(len(pg_tables), 1), minimum=4)
+            spec["idx_off"] = slabb.add(
+                bitops.tables_to_plan5(pg_tables, total_nn, r_idx)
+            )
+            spec["r_idx"] = r_idx
+            spec["vdtype"] = "bool"
+        elif self.kind == "delta":
+            val_off = val_offs[0]
+            end = self.pages[0].off + self.pages[0].size
+            plan = parse_delta_plan(arena[val_off:end], _NP_DTYPE[pt])
+            if plan is None:
+                raise _ForceHost(self.name)
+            m_pad = eng._hwm(("mb", self.name), len(plan["mb_bw"]), minimum=4)
+            mb = np.zeros((3, m_pad), dtype=np.int64)
+            k = len(plan["mb_bitbase"])
+            mb[0, :k] = plan["mb_bitbase"] + val_off * 8
+            mb[1, :k] = plan["mb_bw"]
+            mb[2, :k] = plan["mb_min_delta"]
+            if mb[0].max(initial=0) >= 2**31:
+                raise _ForceHost(self.name)
+            spec["mb_off"] = slabb.add(mb)
+            spec["m_pad"] = m_pad
+            spec["vpm"] = plan["values_per_miniblock"]
+            spec["vdtype"] = _VDTYPE_NAME[pt]
+            spec["sc_off"] = slabb.add([plan["first_value"]])
+        return spec
 
 
-def _concat_padded(parts: List[bytes]) -> np.ndarray:
-    total = sum(len(p) for p in parts)
-    out = np.empty(total + 8, dtype=np.uint8)  # +8: extract_bits window pad
-    out[total:] = 0
-    pos = 0
-    for p in parts:
-        out[pos : pos + len(p)] = np.frombuffer(p, dtype=np.uint8)
-        pos += len(p)
-    return out
+class _HostStage:
+    """A chunk decoded by the host engine, packed dense into the arena."""
+
+    def __init__(self, name, chunk, desc, eng, arena: _ArenaBuilder):
+        self.name = name
+        self.desc = desc
+        batch = eng.reader.read_column_chunk(chunk)
+        if desc.max_repetition_level > 0:
+            raise ValueError(
+                "repeated (nested) columns are not yet supported by the TPU "
+                f"engine: column {'.'.join(desc.path)}"
+            )
+        dense, mask = batch.dense()
+        n = batch.num_values
+        self.n = n
+        self.max_def = 1 if mask is not None else 0
+        self.offs: Dict[str, int] = {}
+        if isinstance(dense, ByteArrayColumn):
+            max_len = eng._hwm(
+                ("hs_len", name), max((int(dense.lengths().max()) if n else 1), 1)
+            )
+            rows, lengths, _ = _padded_rows(dense, pad_len=max_len)
+            self.kind = "host_str"
+            self.max_len = max_len
+            self.offs["rows"] = arena.add_copy(rows, rows.size)
+            self.offs["lens"] = arena.add_copy(
+                lengths.astype(np.int32), n * 4
+            )
+        elif dense.ndim == 2:
+            self.kind = "host_rows"
+            self.width = dense.shape[1]
+            d = np.ascontiguousarray(dense, dtype=np.uint8)
+            self.offs["vals"] = arena.add_copy(d, d.size)
+        else:
+            if dense.dtype == np.float64:
+                if eng._f64mode == "f32":
+                    dense = dense.astype(np.float32)
+                elif eng._f64mode == "bits":
+                    dense = dense.view(np.int64)
+            self.kind = "host"
+            self.vdtype = {
+                "int32": "int32", "int64": "int64", "float32": "float32",
+                "float64": "float64", "bool": "bool", "uint8": "u8rows",
+            }[dense.dtype.name]
+            self.width = dense.dtype.itemsize
+            d = np.ascontiguousarray(dense)
+            self.offs["vals"] = arena.add_copy(d.view(np.uint8), d.nbytes)
+        if mask is not None:
+            self.offs["mask"] = arena.add_copy(
+                mask.astype(np.uint8), n
+            )
+
+    def finish(self, arena, slabb: _I32Builder, eng) -> dict:
+        spec = dict(
+            name=self.name, kind=self.kind, n=self.n, nexp=self.n,
+            max_def=self.max_def, def_bw=0,
+        )
+        if self.kind == "host_str":
+            sc = [self.offs["rows"], self.offs["lens"]]
+            if self.max_def:
+                sc.append(self.offs["mask"])
+            spec["sc_off"] = slabb.add(sc)
+            spec["max_len"] = self.max_len
+        else:
+            sc = [self.offs["vals"]]
+            if self.max_def:
+                sc.append(self.offs["mask"])
+            spec["sc_off"] = slabb.add(sc)
+            spec["width"] = self.width
+            spec["vdtype"] = self.vdtype if self.kind == "host" else "u8rows"
+        return spec
 
 
-def _count_non_null(data, table, n, def_bw, max_def) -> int:
+def _count_non_null(buf, table, n, def_bw, max_def) -> int:
     """Non-null count from the run table alone (no full expansion: RLE runs
     compare one value; only bit-packed runs unpack — levels are usually
     RLE-dominated)."""
-    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    buf = np.frombuffer(buf, dtype=np.uint8) if not isinstance(buf, np.ndarray) else buf
     nn = 0
     for kind, count, v, _ in table:
         if kind == 0:
@@ -279,106 +768,31 @@ def _count_non_null(data, table, n, def_bw, max_def) -> int:
     return nn
 
 
-class _Fallback(Exception):
-    """Signal: this chunk takes the host NumPy path."""
-
-
-@dataclass
-class _Staged:
-    """Host-staged chunk: arrays awaiting transfer + the launch closure that
-    turns their device copies into a DeviceColumn (runs on the main thread)."""
-
-    arrays: list
-    launch: object  # Callable[[list], DeviceColumn]
-
-
-def _padded_rows(col: ByteArrayColumn):
+def _padded_rows(col: ByteArrayColumn, pad_len: Optional[int] = None,
+                 pad_rows: Optional[int] = None):
     """Vectorized (n, max_len) uint8 matrix + lengths from a ByteArrayColumn
     (the device-friendly string layout)."""
     lengths = col.lengths().astype(np.int32)
     n = len(col)
     max_len = max(int(lengths.max()) if n else 1, 1)
-    if n == 0:
-        return np.zeros((0, max_len), np.uint8), lengths, max_len
+    if pad_len is not None:
+        if pad_len < max_len:
+            raise ValueError("pad_len shorter than longest string")
+        max_len = pad_len
+    n_rows = n if pad_rows is None else pad_rows
+    if n_rows < n:
+        raise ValueError("pad_rows smaller than row count")
+    out_rows = np.zeros((n_rows, max_len), np.uint8)
+    out_lens = np.zeros(n_rows, np.int32)
+    out_lens[:n] = lengths
     data = col.data
-    if len(data) == 0:
-        return np.zeros((n, max_len), np.uint8), lengths, max_len
-    idx = col.offsets[:-1, None] + np.arange(max_len)[None, :]
-    valid = np.arange(max_len)[None, :] < lengths[:, None]
-    rows = np.where(valid, data[np.minimum(idx, len(data) - 1)], np.uint8(0))
-    return rows.astype(np.uint8), lengths, max_len
-
-
-# ---------------------------------------------------------------------------
-# Plan building (host): run tables across pages → device arrays
-# ---------------------------------------------------------------------------
-
-def _merged_level_plan(meta: _NormPages):
-    """Concatenate per-page def-level run tables into one device plan.
-
-    Output offsets fall out of the concatenation itself (each page's table
-    covers exactly its value count, and ``run_table_to_device_plan`` cumsums
-    the counts); only bit-packed byte offsets need rebasing to the
-    concatenated buffer."""
-    tables = []
-    for i, n in enumerate(meta.page_n):
-        cached = (
-            meta.page_level_table[i]
-            if meta.page_level_table and i < len(meta.page_level_table)
-            else None
+    if n and len(data):
+        idx = col.offsets[:-1, None] + np.arange(max_len)[None, :]
+        valid = np.arange(max_len)[None, :] < lengths[:, None]
+        out_rows[:n] = np.where(
+            valid, data[np.minimum(idx, len(data) - 1)], np.uint8(0)
         )
-        if cached is not None:
-            table = cached
-        else:
-            ln_end = (
-                meta.page_level_base[i + 1]
-                if i + 1 < len(meta.page_n)
-                else len(meta.levels_buf) - 8
-            )
-            page_stream = meta.levels_buf[meta.page_level_base[i] : ln_end]
-            table, _ = e_rle.parse_runs(page_stream, n, meta.def_bw)
-        if len(table):
-            t = table.copy()
-            bp = t[:, 0] == 1
-            t[bp, 2] += meta.page_level_base[i]  # absolute byte offset
-            tables.append(t)
-    total_n = sum(meta.page_n)
-    merged = np.concatenate(tables) if tables else np.zeros((0, 4), np.int64)
-    pad = bitops.bucket_size(max(len(merged), 1), 16)
-    plan = bitops.run_table_to_device_plan(merged, total_n, pad)
-    return plan, total_n
-
-
-def _merged_index_plan(meta: _NormPages):
-    """Concatenate per-page dictionary-index run tables; returns plan + bw."""
-    tables = []
-    bw = None
-    total_nn = sum(meta.page_nn)
-    for i, nn in enumerate(meta.page_nn):
-        base = meta.page_value_base[i]
-        page_bw = int(meta.values_buf[base])
-        if bw is None:
-            bw = page_bw
-        elif page_bw != bw:
-            raise _Fallback("mixed index bit widths across pages")
-        if bw == 0:
-            tables.append(np.zeros((0, 4), np.int64))
-            continue
-        end = (
-            meta.page_value_base[i + 1]
-            if i + 1 < len(meta.page_n)
-            else len(meta.values_buf) - 8
-        )
-        stream = meta.values_buf[base + 1 : end]
-        table, _ = e_rle.parse_runs(stream, nn, bw)
-        t = table.copy()
-        bp = t[:, 0] == 1
-        t[bp, 2] += base + 1
-        tables.append(t)
-    merged = np.concatenate(tables) if tables else np.zeros((0, 4), np.int64)
-    pad = bitops.bucket_size(max(len(merged), 1), 16)
-    plan = bitops.run_table_to_device_plan(merged, total_nn, pad)
-    return plan, (bw or 1), total_nn
+    return out_rows, out_lens, max_len
 
 
 def parse_delta_plan(data_u8: np.ndarray, dtype) -> Optional[dict]:
@@ -433,12 +847,10 @@ def parse_delta_plan(data_u8: np.ndarray, dtype) -> Optional[dict]:
             mb_min.append(min_delta)
             got += count
             pos += per_mini * bwm // 8
-    m = max(len(mb_bw), 1)
-    pad = bitops.bucket_size(m, 4)
     return {
-        "mb_bitbase": bitops.pad_to(np.array(mb_bitbase or [0], np.int32), pad),
-        "mb_bw": bitops.pad_to(np.array(mb_bw or [0], np.int32), pad),
-        "mb_min_delta": bitops.pad_to(np.array(mb_min or [0], np.int32), pad),
+        "mb_bitbase": np.array(mb_bitbase or [0], np.int64),
+        "mb_bw": np.array(mb_bw or [0], np.int64),
+        "mb_min_delta": np.array(mb_min or [0], np.int64),
         "first_value": int(first),
         "values_per_miniblock": per_mini,
         "total": total,
@@ -451,66 +863,17 @@ def _read_zigzag(data, pos):
     return (v >> 1) ^ -(v & 1), pos
 
 
-# ---------------------------------------------------------------------------
-# Jitted device decode functions (static args define the jit cache key)
-# ---------------------------------------------------------------------------
-
-@partial(jax.jit, static_argnames=("n", "bw"))
-def _expand_runs_dev(buf, out_end, kind, value, bitbase, *, n, bw):
-    return bitops.rle_expand(buf, out_end, kind, value, bitbase, n, bw)
-
-
-@partial(jax.jit, static_argnames=("n", "bw", "max_def", "def_bw", "nn"))
-def _dict_decode_opt(
-    vbuf, lbuf, dictionary,
-    i_end, i_kind, i_val, i_base,
-    d_end, d_kind, d_val, d_base,
-    *, n, bw, max_def, def_bw, nn,
-):
-    levels = bitops.rle_expand(lbuf, d_end, d_kind, d_val, d_base, n, def_bw)
-    present = levels == max_def
-    idx = bitops.rle_expand(vbuf, i_end, i_kind, i_val, i_base, nn, bw)
-    vals = bitops.dict_gather(dictionary, idx)
-    dense = bitops.dense_scatter(vals, present)
-    return dense, ~present
-
-
-@partial(jax.jit, static_argnames=("n", "bw"))
-def _dict_decode_req(vbuf, dictionary, i_end, i_kind, i_val, i_base, *, n, bw):
-    idx = bitops.rle_expand(vbuf, i_end, i_kind, i_val, i_base, n, bw)
-    return bitops.dict_gather(dictionary, idx)
-
-
-def _bitcast_values(vbuf, n, dtype, f64_as_f32):
-    if f64_as_f32 and dtype == jnp.float64:
-        bits = bitops.bitcast_bytes(vbuf, jnp.int64, n)  # exact on TPU
-        return f64bits_to_f32(bits)
-    return bitops.bitcast_bytes(vbuf, dtype, n)
-
-
-@partial(jax.jit, static_argnames=("n", "dtype", "f64_as_f32"))
-def _plain_decode_req(vbuf, *, n, dtype, f64_as_f32=False):
-    return _bitcast_values(vbuf, n, dtype, f64_as_f32)
-
-
-@partial(jax.jit, static_argnames=("n", "nn", "dtype", "max_def", "def_bw", "f64_as_f32"))
-def _plain_decode_opt(
-    vbuf, lbuf, d_end, d_kind, d_val, d_base,
-    *, n, nn, dtype, max_def, def_bw, f64_as_f32=False,
-):
-    levels = bitops.rle_expand(lbuf, d_end, d_kind, d_val, d_base, n, def_bw)
-    present = levels == max_def
-    vals = _bitcast_values(vbuf, nn, dtype, f64_as_f32)
-    return bitops.dense_scatter(vals, present), ~present
-
-
-@partial(jax.jit, static_argnames=("n", "max_len"))
-def _dict_strings_opt_gather(dict_rows, dict_lens, idx, present, *, n, max_len):
-    rows = jnp.take(dict_rows, idx, axis=0)
-    lens = jnp.take(dict_lens, idx)
-    dense_rows = bitops.dense_scatter(rows, present)
-    dense_lens = bitops.dense_scatter(lens, present)
-    return dense_rows, dense_lens
+def _count_plain_strings(data_u8) -> int:
+    """Count values in a PLAIN BYTE_ARRAY stream (walk the length chain)."""
+    pos = 0
+    n = 0
+    total = len(data_u8)
+    b = data_u8 if isinstance(data_u8, bytes) else data_u8.tobytes()
+    while pos < total:
+        ln = int.from_bytes(b[pos : pos + 4], "little")
+        pos += 4 + ln
+        n += 1
+    return n
 
 
 # ---------------------------------------------------------------------------
@@ -521,7 +884,9 @@ class TpuRowGroupReader:
     """Decode row groups of a parquet file into device-resident columns.
 
     The batch-columnar sibling of the row-streaming API: same file, same
-    footer, but each column becomes one ``jax.Array`` per row group.
+    footer, but each column becomes one ``jax.Array`` per row group, and
+    each row group decodes in ONE fused compiled step fed by ONE packed
+    host→device transfer.
     """
 
     def __init__(self, source, device: Optional[jax.Device] = None,
@@ -531,9 +896,10 @@ class TpuRowGroupReader:
         and lossy anyway), "float64", "float32", or "bits" (exact int64 bit
         patterns).
 
-        ``host_threads``: size of the host staging pool that decodes column
-        chunks concurrently (native decompress + run-table parse release the
-        GIL).  0/1 disables; None picks a default from the CPU count."""
+        ``host_threads``: size of the pool that runs arena fill jobs
+        (decompression into disjoint regions) concurrently; 0/1 disables,
+        None picks a default from the CPU count.  Prefetch additionally
+        overlaps staging of group i+1 with device work of group i."""
         _require_x64()
         self.reader = source if isinstance(source, ParquetFileReader) else ParquetFileReader(source)
         self.device = device
@@ -542,16 +908,76 @@ class TpuRowGroupReader:
         if float64_policy == "auto":
             float64_policy = "float32" if _platform_is_tpu() else "float64"
         self.float64_policy = float64_policy
-        self._string_dict_cache: Dict[bytes, tuple] = {}   # host padded pools
-        self._string_dict_dev: Dict[bytes, tuple] = {}     # device copies (main thread)
+        self._f64mode = {"float32": "f32", "bits": "bits", "float64": "f64"}[
+            float64_policy
+        ]
+        import os as _os
+
         if host_threads is None:
-            host_threads = min(8, os.cpu_count() or 1)
-        self._pool = (
-            ThreadPoolExecutor(max_workers=host_threads, thread_name_prefix="pftpu-stage")
+            host_threads = min(8, _os.cpu_count() or 1)
+        self._fill_pool = (
+            ThreadPoolExecutor(max_workers=host_threads,
+                               thread_name_prefix="pftpu-fill")
             if host_threads and host_threads > 1
             else None
         )
-        self._dict_lock = threading.Lock()
+        self._forced: set = set()   # columns pinned to the host path (per file)
+        self._hwm_state: Dict[tuple, int] = {}
+        self._sdict_meta: Dict[bytes, tuple] = {}   # digest → (num, max_len)
+        self._sdict_host: Dict[tuple, tuple] = {}   # key → (rows, lens)
+        self._sdict_dev: Dict[tuple, tuple] = {}    # key → (rows_dev, lens_dev)
+        self._lock = threading.Lock()
+
+    # -- bucket bookkeeping -------------------------------------------------
+
+    def _hwm(self, key: tuple, n: int, minimum: int = 16) -> int:
+        """Monotone shape bucket: never shrinks, so later row groups reuse
+        earlier compiled programs."""
+        b = _bucket15(max(n, 1), minimum)
+        with self._lock:
+            prev = self._hwm_state.get(key, 0)
+            if b < prev:
+                b = prev
+            else:
+                self._hwm_state[key] = b
+        return b
+
+    def _string_dict_key(self, arena, off, size, name):
+        """Content-keyed string dictionary pool: build (or reuse) the padded
+        host matrices and return (cache_key, cap, max_len)."""
+        import hashlib
+
+        content = arena[off : off + size].tobytes()
+        digest = hashlib.sha1(content).digest()
+        with self._lock:
+            meta = self._sdict_meta.get(digest)
+        if meta is None:
+            col, _ = decode_plain(
+                content, _count_plain_strings(content), Type.BYTE_ARRAY
+            )
+            num = len(col)
+            max_len_raw = max(int(col.lengths().max()) if num else 1, 1)
+            with self._lock:
+                self._sdict_meta[digest] = (num, max_len_raw)
+        else:
+            col = None
+            num, max_len_raw = meta
+        cap = self._hwm(("sdict_cap", name), num)
+        max_len = self._hwm(("sdict_len", name), max_len_raw)
+        key = (digest, cap, max_len)
+        with self._lock:
+            have = key in self._sdict_host or key in self._sdict_dev
+        if not have:
+            if col is None:
+                col, _ = decode_plain(
+                    content, _count_plain_strings(content), Type.BYTE_ARRAY
+                )
+            rows, lens, _ = _padded_rows(col, pad_len=max_len, pad_rows=cap)
+            with self._lock:
+                self._sdict_host[key] = (rows, lens)
+        return key, cap, max_len
+
+    # -- public -------------------------------------------------------------
 
     @property
     def metadata(self):
@@ -562,8 +988,8 @@ class TpuRowGroupReader:
         return len(self.reader.row_groups)
 
     def close(self):
-        if self._pool is not None:
-            self._pool.shutdown(wait=False)
+        if self._fill_pool is not None:
+            self._fill_pool.shutdown(wait=False)
         self.reader.close()
 
     def __enter__(self):
@@ -572,11 +998,33 @@ class TpuRowGroupReader:
     def __exit__(self, *exc):
         self.close()
 
-    # -- public -------------------------------------------------------------
-
     def read_row_group(
         self, index: int, columns: Optional[Sequence[str]] = None
     ) -> Dict[str, DeviceColumn]:
+        sg = self._stage_row_group(index, columns)
+        return self._launch(sg)
+
+    def iter_row_groups(self, columns: Optional[Sequence[str]] = None,
+                        prefetch: bool = True):
+        """Decode every row group, overlapping host staging of group i+1
+        with device transfer/compute of group i."""
+        n = self.num_row_groups
+        if not prefetch or n <= 1:
+            for i in range(n):
+                yield self.read_row_group(i, columns)
+            return
+        with ThreadPoolExecutor(max_workers=1,
+                                thread_name_prefix="pftpu-stage") as ex:
+            fut = ex.submit(self._stage_row_group, 0, columns)
+            for i in range(n):
+                sg = fut.result()
+                if i + 1 < n:
+                    fut = ex.submit(self._stage_row_group, i + 1, columns)
+                yield self._launch(sg)
+
+    # -- staging ------------------------------------------------------------
+
+    def _stage_row_group(self, index: int, columns) -> _StagedGroup:
         rg = self.reader.row_groups[index]
         want = set(columns) if columns else None
         work = []
@@ -586,316 +1034,88 @@ class TpuRowGroupReader:
                 continue
             desc = self.reader.schema.column(tuple(chunk.meta_data.path_in_schema))
             work.append((name, chunk, desc))
-        # Phase 1 — host staging (parallel): decompress, parse run tables,
-        # build device plans.  Native codec + RLE parse release the GIL.
-        if self._pool is not None and len(work) > 1:
-            staged = list(self._pool.map(lambda w: self._stage_chunk(w[1], w[2]), work))
-        else:
-            staged = [self._stage_chunk(c, d) for _, c, d in work]
-        # Phase 2 — one batched host→device transfer for the whole row group.
-        dev = jax.device_put([s.arrays for s in staged], self.device)
-        # Phase 3 — launch the jitted decode functions from this one thread
-        # (JAX dispatch is async; concurrent dispatch just contends on locks).
-        out: Dict[str, DeviceColumn] = {}
-        for (name, _, _), s, d in zip(work, staged, dev):
-            out[name] = s.launch(d)
-        return out
+        while True:
+            try:
+                return self._try_stage(rg, work, self._forced)
+            except _ForceHost as e:
+                # sticky per file: a column that needed the host path once
+                # (e.g. >32-bit delta range) skips the device attempt in
+                # every later row group instead of staging the group twice
+                self._forced.add(e.key)
 
-    # -- per-chunk ----------------------------------------------------------
-
-    def _stage_chunk(self, chunk, desc: ColumnDescriptor) -> "_Staged":
-        meta = chunk.meta_data
-        try:
-            raw_pages = self.reader.read_raw_column_chunk(chunk)
-            dict_bytes, norm = _normalize_pages(raw_pages, desc, meta.codec)
-            encs = set(norm.page_encoding)
-            if not norm.page_n:
-                raise _Fallback("empty chunk")
-            if encs <= {Encoding.RLE_DICTIONARY, Encoding.PLAIN_DICTIONARY}:
-                if dict_bytes is None:
-                    raise _Fallback("dictionary pages missing")
-                return self._stage_dict(desc, dict_bytes, norm)
-            if encs == {Encoding.PLAIN}:
-                return self._stage_plain(desc, norm)
-            if encs == {Encoding.DELTA_BINARY_PACKED} and len(norm.page_n) == 1:
-                return self._stage_delta(desc, norm)
-            raise _Fallback(f"encodings {sorted(encs)}")
-        except _Fallback:
-            return self._stage_host(chunk, desc)
-
-    def _stage_dict(self, desc, dict_bytes: np.ndarray, norm: _NormPages) -> "_Staged":
-        n = sum(norm.page_n)
-        idx_plan, bw, nn = _merged_index_plan(norm)
-        num_dict = self._dict_num_values(dict_bytes, desc)
-        pt = desc.physical_type
-        if pt in _NP_DTYPE:
-            dictionary = np.frombuffer(
-                bytes(dict_bytes), dtype=_NP_DTYPE[pt], count=num_dict
+    def _try_stage(self, rg, work, forced) -> _StagedGroup:
+        arena_b = _ArenaBuilder()
+        stages = []
+        for name, chunk, desc in work:
+            if name in forced:
+                stages.append(_HostStage(name, chunk, desc, self, arena_b))
+                continue
+            try:
+                stages.append(_DevStage(name, chunk, desc, self.reader, arena_b))
+            except _Fallback:
+                stages.append(_HostStage(name, chunk, desc, self, arena_b))
+        if arena_b.size >= (1 << 28):
+            # plans store absolute *bit* offsets as int32 (and PLAIN page
+            # tables absolute byte offsets): 256 MiB per row group is the
+            # hard ceiling.  Parquet writers default to 128 MiB groups.
+            raise ValueError(
+                f"row group stages {arena_b.size} decompressed bytes; the "
+                "TPU engine supports row groups up to 256 MiB — rewrite the "
+                "file with smaller row groups or use the host ParquetFileReader"
             )
-            if pt == Type.DOUBLE:
-                # dictionary is tiny: convert on host per policy (correctly
-                # rounded), gather stays on device
-                if self.float64_policy == "float32":
-                    dictionary = dictionary.astype(np.float32)
-                elif self.float64_policy == "bits":
-                    dictionary = dictionary.view(np.int64)
-            return self._stage_fixed_dict(desc, dictionary, idx_plan, bw, norm, n, nn)
-        if pt == Type.BYTE_ARRAY:
-            return self._stage_string_dict(desc, dict_bytes, idx_plan, bw, norm, n, nn)
-        raise _Fallback(f"dict decode for type {Type.name(pt)}")
-
-    def _dict_num_values(self, dict_bytes, desc) -> int:
-        # dictionary page num_values is authoritative; recover it from size
-        pt = desc.physical_type
-        if pt in _NP_DTYPE:
-            return len(dict_bytes) // np.dtype(_NP_DTYPE[pt]).itemsize
-        return -1  # strings: computed during pool parse
-
-    def _stage_fixed_dict(self, desc, dictionary, idx_plan, bw, norm, n, nn) -> "_Staged":
-        max_def = desc.max_definition_level
-        def_bw = norm.def_bw
-        if max_def > 0:
-            lvl_plan, _ = _merged_level_plan(norm)
-
-            def launch(dev):
-                vbuf, dict_dev, ip, lbuf, lp = dev
-                dense, mask = _dict_decode_opt(
-                    vbuf, lbuf, dict_dev,
-                    ip["run_out_end"], ip["run_kind"], ip["run_value"], ip["run_bitbase"],
-                    lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
-                    n=n, bw=bw, max_def=max_def, def_bw=def_bw, nn=nn,
-                )
-                return DeviceColumn(desc, dense, mask)
-
-            return _Staged(
-                [norm.values_buf, dictionary, idx_plan, norm.levels_buf, lvl_plan],
-                launch,
-            )
-
-        def launch(dev):
-            vbuf, dict_dev, ip = dev
-            dense = _dict_decode_req(
-                vbuf, dict_dev,
-                ip["run_out_end"], ip["run_kind"], ip["run_value"], ip["run_bitbase"],
-                n=n, bw=bw,
-            )
-            return DeviceColumn(desc, dense, None)
-
-        return _Staged([norm.values_buf, dictionary, idx_plan], launch)
-
-    def _stage_string_dict(self, desc, dict_bytes, idx_plan, bw, norm, n, nn) -> "_Staged":
-        # Parse the PLAIN dictionary pool into a padded row matrix once
-        # (keyed by content — dict handles hash collisions by comparison).
-        key = dict_bytes.tobytes()
-        # Ship the padded pool only if no device copy exists yet.  (Racy read
-        # from a staging thread: worst case the pool ships once more and the
-        # launch-thread cache ignores it.)
-        ship_dict = key not in self._string_dict_dev
-        with self._dict_lock:
-            cached = self._string_dict_cache.get(key)
-        if ship_dict and (cached is None or cached[0] is None):
-            col, _ = decode_plain(key, _count_plain_strings(dict_bytes), Type.BYTE_ARRAY)
-            rows, lengths, max_len = _padded_rows(col)
-            cached = (rows, lengths, max_len)
-            with self._dict_lock:
-                self._string_dict_cache[key] = cached
-        host_rows, host_lens, max_len = cached
-        max_def = desc.max_definition_level
-        def_bw = norm.def_bw
-        lvl_plan = _merged_level_plan(norm)[0] if max_def > 0 else None
-
-        def launch(dev):
-            # device-side dictionary cache is touched on the launch thread only
-            if ship_dict:
-                dcached = self._string_dict_dev.setdefault(key, (dev[0], dev[1]))
-                dev = dev[2:]
-                with self._dict_lock:
-                    # device copy now authoritative: drop the host pool matrix,
-                    # keep max_len (still needed by later stages)
-                    self._string_dict_cache[key] = (None, None, max_len)
-            else:
-                dcached = self._string_dict_dev[key]
-            dict_rows, dict_lens = dcached
-            if max_def > 0:
-                vbuf, ip, lbuf, lp = dev
-            else:
-                vbuf, ip = dev
-                lbuf = lp = None
-            idx = _expand_runs_dev(
-                vbuf, ip["run_out_end"], ip["run_kind"], ip["run_value"], ip["run_bitbase"],
-                n=nn, bw=bw,
-            )
-            if max_def > 0:
-                levels = _expand_runs_dev(
-                    lbuf, lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
-                    n=n, bw=def_bw,
-                )
-                present = levels == max_def
-                rows, lens = _dict_strings_opt_gather(
-                    dict_rows, dict_lens, idx, present, n=n, max_len=max_len
-                )
-                return DeviceColumn(desc, rows, ~present, lens)
-            rows = jnp.take(dict_rows, idx, axis=0)
-            lens = jnp.take(dict_lens, idx)
-            return DeviceColumn(desc, rows, None, lens)
-
-        arrays = ([host_rows, host_lens] if ship_dict else []) + [norm.values_buf, idx_plan]
-        if max_def > 0:
-            arrays += [norm.levels_buf, lvl_plan]
-        return _Staged(arrays, launch)
-
-    def _stage_plain(self, desc, norm: _NormPages) -> "_Staged":
-        n = sum(norm.page_n)
-        nn = sum(norm.page_nn)
-        pt = desc.physical_type
-        if pt == Type.BOOLEAN:
-            return self._stage_plain_bool(desc, norm, n, nn)
-        if pt not in _NP_DTYPE:
-            raise _Fallback(f"PLAIN device decode for {Type.name(pt)}")
-        width = np.dtype(_NP_DTYPE[pt]).itemsize
-        # value streams are already contiguous per page; PLAIN is raw values
-        # so the concatenated buffer is contiguous values across pages.
-        for i in range(1, len(norm.page_value_base)):
-            expected = norm.page_value_base[i - 1] + norm.page_nn[i - 1] * width
-            if norm.page_value_base[i] != expected:
-                raise _Fallback("non-contiguous PLAIN pages")
-        dtype = _JNP_DTYPE[pt]
-        f64_as_f32 = False
-        if pt == Type.DOUBLE:
-            if self.float64_policy == "float32":
-                f64_as_f32 = True
-            elif self.float64_policy == "bits":
-                dtype = jnp.int64
-        max_def = desc.max_definition_level
-        def_bw = norm.def_bw
-        if max_def > 0:
-            lvl_plan, _ = _merged_level_plan(norm)
-
-            def launch(dev):
-                vbuf, lbuf, lp = dev
-                dense, mask = _plain_decode_opt(
-                    vbuf, lbuf,
-                    lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
-                    n=n, nn=nn, dtype=dtype, max_def=max_def,
-                    def_bw=def_bw, f64_as_f32=f64_as_f32,
-                )
-                return DeviceColumn(desc, dense, mask)
-
-            return _Staged([norm.values_buf, norm.levels_buf, lvl_plan], launch)
-
-        def launch(dev):
-            (vbuf,) = dev
-            dense = _plain_decode_req(vbuf, n=n, dtype=dtype, f64_as_f32=f64_as_f32)
-            return DeviceColumn(desc, dense, None)
-
-        return _Staged([norm.values_buf], launch)
-
-    def _stage_plain_bool(self, desc, norm: _NormPages, n, nn) -> "_Staged":
-        # Each page's bools are byte-aligned bit-packed: model as one
-        # bit-packed "run" per page and reuse the RLE expansion machinery.
-        table = np.zeros((len(norm.page_n), 4), dtype=np.int64)
-        for i in range(len(norm.page_n)):
-            table[i] = (1, norm.page_nn[i], norm.page_value_base[i], 0)
-        plan = bitops.run_table_to_device_plan(
-            table, nn, bitops.bucket_size(len(table), 4)
-        )
-        max_def = desc.max_definition_level
-        def_bw = norm.def_bw
-        lvl_plan = _merged_level_plan(norm)[0] if max_def > 0 else None
-
-        def launch(dev):
-            if max_def > 0:
-                vbuf, pp, lbuf, lp = dev
-            else:
-                vbuf, pp = dev
-                lbuf = lp = None
-            bits = _expand_runs_dev(
-                vbuf, pp["run_out_end"], pp["run_kind"], pp["run_value"], pp["run_bitbase"],
-                n=nn, bw=1,
-            )
-            vals = bits.astype(jnp.bool_)
-            if max_def > 0:
-                levels = _expand_runs_dev(
-                    lbuf, lp["run_out_end"], lp["run_kind"], lp["run_value"], lp["run_bitbase"],
-                    n=n, bw=def_bw,
-                )
-                present = levels == max_def
-                dense = bitops.dense_scatter(vals, present, fill=False)
-                return DeviceColumn(desc, dense, ~present)
-            return DeviceColumn(desc, vals, None)
-
-        arrays = [norm.values_buf, plan]
-        if max_def > 0:
-            arrays += [norm.levels_buf, lvl_plan]
-        return _Staged(arrays, launch)
-
-    def _stage_delta(self, desc, norm: _NormPages) -> "_Staged":
-        if desc.max_definition_level > 0:
-            raise _Fallback("optional delta column (host path)")
-        pt = desc.physical_type
-        if pt not in (Type.INT32, Type.INT64):
-            raise _Fallback("delta for non-int")
-        plan = parse_delta_plan(norm.values_buf, _NP_DTYPE[pt])
-        if plan is None:
-            raise _Fallback("delta needs >32-bit arithmetic")
-        n = sum(norm.page_n)
-        out_dtype = _JNP_DTYPE[pt]
-
-        def launch(dev):
-            vbuf, bitbase, bws, mins = dev
-            out = bitops.delta_expand(
-                vbuf, bitbase, bws, mins,
-                plan["first_value"], n, plan["values_per_miniblock"],
-                out_dtype=out_dtype,
-            )
-            return DeviceColumn(desc, out, None)
-
-        return _Staged(
-            [norm.values_buf, plan["mb_bitbase"], plan["mb_bw"], plan["mb_min_delta"]],
-            launch,
+        cap = self._hwm(("arena",), arena_b.size + 8, minimum=1 << 16)
+        arena = np.zeros(cap, dtype=np.uint8)
+        arena_b.fill(arena, self._fill_pool)
+        slabb = _I32Builder()
+        raw_specs = [st.finish(arena, slabb, self) for st in stages]
+        # assign extras (string dictionaries) in order of first use
+        extra_keys: List[tuple] = []
+        new_extras: List[tuple] = []
+        specs = []
+        for rs in raw_specs:
+            key = rs.pop("_extra_key", None)
+            if key is not None:
+                if key not in extra_keys:
+                    extra_keys.append(key)
+                    with self._lock:
+                        if key not in self._sdict_dev:
+                            rows, lens = self._sdict_host[key]
+                            new_extras.append((key, rows, lens))
+                rs["extra_idx"] = extra_keys.index(key)
+            specs.append(_ColSpec(**rs))
+        slab = slabb.build(self._hwm(("slab",), slabb.n, minimum=256))
+        return _StagedGroup(
+            program=tuple(specs),
+            arena=arena,
+            slab=slab,
+            descs=[d for _, _, d in work],
+            extra_keys=extra_keys,
+            new_extras=new_extras,
+            num_rows=rg.num_rows or 0,
         )
 
-    def _stage_host(self, chunk, desc) -> "_Staged":
-        """Host NumPy decode, shipped dense to the device (correct for every
-        chunk the format engine can read)."""
-        batch = self.reader.read_column_chunk(chunk)
-        dense, mask = batch.dense()
-        if isinstance(dense, ByteArrayColumn):
-            rows, lengths, _ = _padded_rows(dense)
+    # -- launch -------------------------------------------------------------
 
-            def launch(dev):
-                if mask is None:
-                    drows, dlens = dev
-                    return DeviceColumn(desc, drows, None, dlens)
-                drows, dlens, dmask = dev
-                return DeviceColumn(desc, drows, dmask, dlens)
-
-            arrays = [rows, lengths] + ([] if mask is None else [mask])
-            return _Staged(arrays, launch)
-        if dense.dtype == np.float64:
-            if self.float64_policy == "float32":
-                dense = dense.astype(np.float32)
-            elif self.float64_policy == "bits":
-                dense = dense.view(np.int64)
-
-        def launch(dev):
-            if mask is None:
-                (dd,) = dev
-                return DeviceColumn(desc, dd, None)
-            dd, dmask = dev
-            return DeviceColumn(desc, dd, dmask)
-
-        return _Staged([dense] + ([] if mask is None else [mask]), launch)
-
-
-def _count_plain_strings(data_u8: np.ndarray) -> int:
-    """Count values in a PLAIN BYTE_ARRAY stream (walk the length chain)."""
-    pos = 0
-    n = 0
-    total = len(data_u8)
-    b = data_u8.tobytes()
-    while pos < total:
-        ln = int.from_bytes(b[pos : pos + 4], "little")
-        pos += 4 + ln
-        n += 1
-    return n
+    def _launch(self, sg: _StagedGroup) -> Dict[str, DeviceColumn]:
+        ship = [sg.arena, sg.slab]
+        for _, rows, lens in sg.new_extras:
+            ship.append(rows)
+            ship.append(lens)
+        shipped = jax.device_put(ship, self.device)
+        arena_dev, slab_dev = shipped[0], shipped[1]
+        pos = 2
+        for key, _, _ in sg.new_extras:
+            with self._lock:
+                self._sdict_dev[key] = (shipped[pos], shipped[pos + 1])
+                self._sdict_host.pop(key, None)  # device copy is authoritative
+            pos += 2
+        extra_args = []
+        for key in sg.extra_keys:
+            rows_d, lens_d = self._sdict_dev[key]
+            extra_args.append(rows_d)
+            extra_args.append(lens_d)
+        outs = _decode_fused(sg.program, arena_dev, slab_dev, *extra_args)
+        result: Dict[str, DeviceColumn] = {}
+        for spec, desc, (vals, mask, lens) in zip(sg.program, sg.descs, outs):
+            result[spec.name] = DeviceColumn(desc, vals, mask, lens)
+        return result

@@ -31,14 +31,12 @@ def extract_bits(data_u8: jax.Array, bitpos: jax.Array, bit_width: int) -> jax.A
         raise ValueError(f"bit_width {bit_width} out of range [1, 32]")
     byte0 = (bitpos >> 3).astype(jnp.int32)
     shift = (bitpos & 7).astype(jnp.uint32)
-    d = data_u8.astype(jnp.uint32)
-    lo = (
-        d[byte0]
-        | (d[byte0 + 1] << 8)
-        | (d[byte0 + 2] << 16)
-        | (d[byte0 + 3] << 24)
-    )
-    hi = d[byte0 + 4]
+    # gather uint8 first, widen after: widening the whole buffer before the
+    # gather would materialize a 4× copy of it in HBM (gather operands do
+    # not fuse), which matters when data_u8 is a row-group arena
+    g = lambda off: data_u8[byte0 + off].astype(jnp.uint32)
+    lo = g(0) | (g(1) << 8) | (g(2) << 16) | (g(3) << 24)
+    hi = g(4)
     # (lo >> shift) | (hi << (32 - shift)); shift==0 must not shift hi by 32.
     hi_part = jnp.where(shift == 0, jnp.uint32(0), hi << ((32 - shift) & 31))
     v = (lo >> shift) | hi_part

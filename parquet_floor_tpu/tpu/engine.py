@@ -164,6 +164,11 @@ class _ForceHost(Exception):
         self.key = key
 
 
+class _ArenaOverflow(Exception):
+    """Signal at layout time: the group's decompressed bytes exceed the
+    device plan's int32 bit-offset range — restage everything host-side."""
+
+
 # ---------------------------------------------------------------------------
 # Builders
 # ---------------------------------------------------------------------------
@@ -582,16 +587,19 @@ class _DevStage:
         if self.kind in ("dict", "dict_str"):
             idx_tables = []
             for p, val_off, nn in zip(self.pages, val_offs, nns):
+                if nn == 0:
+                    # all-null page: no value section — don't even probe the
+                    # bit-width byte (it would read the next page's bytes)
+                    continue
                 page_bw = int(arena[val_off])
                 if page_bw > 32:
                     raise _ForceHost(self.name)
-                if page_bw == 0 or nn == 0:
-                    # all values are index 0 (or page empty): empty table
-                    # rows expand to zeros via the plan's RLE padding
-                    if nn:
-                        idx_tables.append(
-                            (np.array([[0, nn, 0, 0]], dtype=np.int64), 1)
-                        )
+                if page_bw == 0:
+                    # all values are index 0: empty table rows expand to
+                    # zeros via the plan's RLE padding
+                    idx_tables.append(
+                        (np.array([[0, nn, 0, 0]], dtype=np.int64), 1)
+                    )
                     continue
                 table, _ = e_rle.parse_runs(arena, nn, page_bw, pos=val_off + 1)
                 idx_tables.append((table, page_bw))
@@ -890,7 +898,8 @@ class TpuRowGroupReader:
     """
 
     def __init__(self, source, device: Optional[jax.Device] = None,
-                 float64_policy: str = "auto", host_threads: Optional[int] = None):
+                 float64_policy: str = "auto", host_threads: Optional[int] = None,
+                 sync_transfers: Optional[bool] = None):
         """``float64_policy``: how DOUBLE columns materialize on device —
         "auto" (exact float64 on CPU; float32 on TPU, where f64 is emulated
         and lossy anyway), "float64", "float32", or "bits" (exact int64 bit
@@ -899,7 +908,15 @@ class TpuRowGroupReader:
         ``host_threads``: size of the pool that runs arena fill jobs
         (decompression into disjoint regions) concurrently; 0/1 disables,
         None picks a default from the CPU count.  Prefetch additionally
-        overlaps staging of group i+1 with device work of group i."""
+        overlaps staging of group i+1 with device work of group i.
+
+        ``sync_transfers``: block until each group's arena transfer lands
+        before dispatching the decode.  Default on (None → env
+        ``PFTPU_SYNC_TRANSFERS``, default "1"): on tunnelled TPU links,
+        letting transfers queue asynchronously contends with the host
+        staging threads and *triples* staging latency — one outstanding
+        transfer at a time is the faster pipeline.  Set to False on
+        locally-attached devices to overlap transfer with staging."""
         _require_x64()
         self.reader = source if isinstance(source, ParquetFileReader) else ParquetFileReader(source)
         self.device = device
@@ -913,6 +930,9 @@ class TpuRowGroupReader:
         ]
         import os as _os
 
+        if sync_transfers is None:
+            sync_transfers = _os.environ.get("PFTPU_SYNC_TRANSFERS", "1") != "0"
+        self.sync_transfers = sync_transfers
         if host_threads is None:
             host_threads = min(8, _os.cpu_count() or 1)
         self._fill_pool = (
@@ -923,9 +943,13 @@ class TpuRowGroupReader:
         )
         self._forced: set = set()   # columns pinned to the host path (per file)
         self._hwm_state: Dict[tuple, int] = {}
-        self._sdict_meta: Dict[bytes, tuple] = {}   # digest → (num, max_len)
+        # string-dictionary pools are keyed by the full decompressed content
+        # bytes (exact equality, no hash-collision hazard); dict hashing
+        # caches the bytes' hash after the first lookup
+        self._sdict_meta: Dict[bytes, tuple] = {}   # content → (num, max_len)
         self._sdict_host: Dict[tuple, tuple] = {}   # key → (rows, lens)
         self._sdict_dev: Dict[tuple, tuple] = {}    # key → (rows_dev, lens_dev)
+        self._sdict_live: Dict[bytes, tuple] = {}   # content → newest key
         self._lock = threading.Lock()
 
     # -- bucket bookkeeping -------------------------------------------------
@@ -945,12 +969,9 @@ class TpuRowGroupReader:
     def _string_dict_key(self, arena, off, size, name):
         """Content-keyed string dictionary pool: build (or reuse) the padded
         host matrices and return (cache_key, cap, max_len)."""
-        import hashlib
-
         content = arena[off : off + size].tobytes()
-        digest = hashlib.sha1(content).digest()
         with self._lock:
-            meta = self._sdict_meta.get(digest)
+            meta = self._sdict_meta.get(content)
         if meta is None:
             col, _ = decode_plain(
                 content, _count_plain_strings(content), Type.BYTE_ARRAY
@@ -958,13 +979,13 @@ class TpuRowGroupReader:
             num = len(col)
             max_len_raw = max(int(col.lengths().max()) if num else 1, 1)
             with self._lock:
-                self._sdict_meta[digest] = (num, max_len_raw)
+                self._sdict_meta[content] = (num, max_len_raw)
         else:
             col = None
             num, max_len_raw = meta
         cap = self._hwm(("sdict_cap", name), num)
         max_len = self._hwm(("sdict_len", name), max_len_raw)
-        key = (digest, cap, max_len)
+        key = (content, cap, max_len)
         with self._lock:
             have = key in self._sdict_host or key in self._sdict_dev
         if not have:
@@ -1034,33 +1055,40 @@ class TpuRowGroupReader:
                 continue
             desc = self.reader.schema.column(tuple(chunk.meta_data.path_in_schema))
             work.append((name, chunk, desc))
+        all_host = False
         while True:
             try:
-                return self._try_stage(rg, work, self._forced)
+                return self._try_stage(rg, work, self._forced, all_host)
             except _ForceHost as e:
                 # sticky per file: a column that needed the host path once
                 # (e.g. >32-bit delta range) skips the device attempt in
                 # every later row group instead of staging the group twice
                 self._forced.add(e.key)
+            except _ArenaOverflow:
+                # device plans store absolute *bit* offsets as int32, so
+                # device-staged groups cap at 256 MiB decompressed; host
+                # stages use *byte* offsets (good to 2 GiB) — restage the
+                # whole group through the host engine instead of failing
+                all_host = True
 
-    def _try_stage(self, rg, work, forced) -> _StagedGroup:
+    def _try_stage(self, rg, work, forced, all_host=False) -> _StagedGroup:
         arena_b = _ArenaBuilder()
         stages = []
         for name, chunk, desc in work:
-            if name in forced:
+            if all_host or name in forced:
                 stages.append(_HostStage(name, chunk, desc, self, arena_b))
                 continue
             try:
                 stages.append(_DevStage(name, chunk, desc, self.reader, arena_b))
             except _Fallback:
                 stages.append(_HostStage(name, chunk, desc, self, arena_b))
-        if arena_b.size >= (1 << 28):
-            # plans store absolute *bit* offsets as int32 (and PLAIN page
-            # tables absolute byte offsets): 256 MiB per row group is the
-            # hard ceiling.  Parquet writers default to 128 MiB groups.
+        if arena_b.size >= (1 << 28) and not all_host:
+            if any(isinstance(st, _DevStage) for st in stages):
+                raise _ArenaOverflow()
+        if arena_b.size >= (1 << 31) - (1 << 20):
             raise ValueError(
                 f"row group stages {arena_b.size} decompressed bytes; the "
-                "TPU engine supports row groups up to 256 MiB — rewrite the "
+                "TPU engine supports row groups up to 2 GiB — rewrite the "
                 "file with smaller row groups or use the host ParquetFileReader"
             )
         cap = self._hwm(("arena",), arena_b.size + 8, minimum=1 << 16)
@@ -1102,12 +1130,21 @@ class TpuRowGroupReader:
             ship.append(rows)
             ship.append(lens)
         shipped = jax.device_put(ship, self.device)
+        if self.sync_transfers:
+            jax.block_until_ready(shipped)
         arena_dev, slab_dev = shipped[0], shipped[1]
         pos = 2
         for key, _, _ in sg.new_extras:
             with self._lock:
                 self._sdict_dev[key] = (shipped[pos], shipped[pos + 1])
                 self._sdict_host.pop(key, None)  # device copy is authoritative
+                # evict the copy this key supersedes (same content, smaller
+                # cap/max_len buckets) so stale pools don't pin HBM
+                old = self._sdict_live.get(key[0])
+                if old is not None and old != key:
+                    self._sdict_dev.pop(old, None)
+                    self._sdict_host.pop(old, None)
+                self._sdict_live[key[0]] = key
             pos += 2
         extra_args = []
         for key in sg.extra_keys:

@@ -210,3 +210,27 @@ def test_x64_requirement_error():
             TpuRowGroupReader.__new__(TpuRowGroupReader).__init__("/nonexistent")
     finally:
         jax.config.update("jax_enable_x64", True)
+
+
+def test_all_null_page_within_dict_column(tmp_path):
+    """Regression: a dict column whose *middle page* is entirely null has no
+    value section on that page — staging must not probe its (absent)
+    bit-width byte, which would read the next page's bytes and could
+    force-host the column (or mis-plan it)."""
+    for version in (1, 2):
+        vals = [float(i % 7) for i in range(100)] + [None] * 100 + [
+            float(i % 5) for i in range(100)
+        ]
+        cols = {"x": (types.DOUBLE, vals, True, None)}
+        path = _write(
+            tmp_path,
+            cols,
+            WriterOptions(data_page_values=100, page_version=version),
+            n=300,
+        )
+        _check_against_host(path)
+        # and it must have stayed on the device path (not sticky-forced)
+        t = TpuRowGroupReader(path)
+        t.read_row_group(0)
+        assert not t._forced, f"v{version}: column fell back to host"
+        t.close()

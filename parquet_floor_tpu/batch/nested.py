@@ -1,0 +1,285 @@
+"""Dremel record assembly: (values, def_levels, rep_levels) → nested columns.
+
+The reference *facade* refuses repeated columns outright
+(``ParquetReader.java:200-202`` throws "Unexpected repetition") while the
+parquet-mr engine underneath can decode them; this module supplies the
+engine-level capability (SURVEY.md §7 hard part 5, BASELINE config #5):
+assembling Parquet's flattened Dremel encoding back into nested lists.
+
+Two consumers:
+
+* ``assemble_nested`` — vectorized NumPy assembly into per-depth offset +
+  validity arrays (the Arrow-style columnar form; what batch/TPU callers
+  want).  All O(n) work is array ops: ``flatnonzero`` for slot starts,
+  ``add.reduceat`` for element counts.
+* ``NestedColumn.to_pylist`` — exact recursive rendering to Python lists
+  (``None`` for nulls), the oracle form interop tests compare against
+  pyarrow's ``to_pylist``.
+
+Level semantics implemented here (Dremel, per the format spec):
+
+* each **optional** node on a leaf's path adds 1 definition level;
+* each **repeated** node adds 1 definition level *and* 1 repetition level;
+* a value slot's definition level says how deep its path is defined:
+  ``def == d_node - 1`` at an optional node means *null here*, at a
+  repeated node means *empty list here*;
+* a position's repetition level says at which repeated depth the record
+  "restarts": ``rep == r`` begins a new element of the depth-``r`` list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..format.encodings.plain import ByteArrayColumn
+from ..format.schema import ColumnDescriptor, MessageType, SchemaNode
+
+
+@dataclass(frozen=True)
+class LevelNode:
+    """One definition-level-bearing node on a leaf's path."""
+
+    kind: str        # "optional" | "repeated"
+    def_level: int   # cumulative max_def INCLUDING this node
+    rep_level: int   # cumulative max_rep INCLUDING this node
+    name: str
+    is_leaf: bool
+
+
+def level_chain(schema: MessageType, path: Sequence[str]) -> List[LevelNode]:
+    """Walk the schema root→leaf along ``path`` collecting the nodes that
+    carry definition levels (optional/repeated); required nodes carry none.
+    """
+    chain: List[LevelNode] = []
+    node: SchemaNode = schema
+    d = r = 0
+    for depth, part in enumerate(path):
+        nxt = None
+        for f in node.fields:
+            if f.name == part:
+                nxt = f
+                break
+        if nxt is None:
+            raise KeyError(f"path {'.'.join(path)}: no field {part!r}")
+        node = nxt
+        is_leaf = depth == len(path) - 1
+        if node.is_optional:
+            d += 1
+            chain.append(LevelNode("optional", d, r, part, is_leaf))
+        elif node.is_repeated:
+            d += 1
+            r += 1
+            chain.append(LevelNode("repeated", d, r, part, is_leaf))
+        if is_leaf and not node.is_primitive:
+            raise ValueError(f"path {'.'.join(path)} is not a leaf")
+    return chain
+
+
+@dataclass
+class DepthInfo:
+    """Offsets+validity for one repeated depth (Arrow ListArray layout).
+
+    ``offsets[i]:offsets[i+1]`` indexes the next depth's slots (or the leaf
+    elements at the deepest depth).  ``valid[i]`` is False when the list
+    slot is null (an optional node at-or-above this repeated node, below
+    the previous one, was undefined); an empty-but-present list has
+    ``valid[i] == True`` and zero length.
+    """
+
+    offsets: np.ndarray   # int64[n_slots + 1]
+    valid: np.ndarray     # bool[n_slots]
+
+
+@dataclass
+class NestedColumn:
+    """One leaf column assembled into nested (list…) form."""
+
+    descriptor: ColumnDescriptor
+    chain: List[LevelNode]
+    depths: List[DepthInfo]            # one per repeated depth, outermost first
+    leaf_present: np.ndarray           # bool[n_leaf_slots]: value not null
+    values: Union[np.ndarray, ByteArrayColumn]  # dense non-null leaf values
+    def_levels: np.ndarray
+    rep_levels: np.ndarray
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.depths[0].offsets) - 1 if self.depths else len(self.leaf_present)
+
+    def to_pylist(self) -> list:
+        """Exact nested-Python rendering (the pyarrow-comparable oracle)."""
+        return _to_pylist(
+            self.chain, self.def_levels, self.rep_levels, self.values,
+            self.descriptor.max_definition_level,
+        )
+
+
+def assemble_nested(schema: MessageType, batch) -> NestedColumn:
+    """Assemble a decoded ``ColumnBatch`` (values + def/rep levels) into a
+    ``NestedColumn``.  ``batch.rep_levels`` must be present (repeated leaf).
+    """
+    desc: ColumnDescriptor = batch.descriptor
+    chain = level_chain(schema, desc.path)
+    defs = np.asarray(batch.def_levels, dtype=np.int32)
+    reps = np.asarray(batch.rep_levels, dtype=np.int32)
+    max_def = desc.max_definition_level
+    n = len(defs)
+
+    rep_nodes = [c for c in chain if c.kind == "repeated"]
+    depths: List[DepthInfo] = []
+    prev_d = 0  # def threshold at which a slot for the current depth exists
+    for node in rep_nodes:
+        r, d = node.rep_level, node.def_level
+        # slot starts: new instance of the parent context whose subtree is
+        # defined at least to the previous repeated node
+        start_mask = (reps < r) & (defs >= prev_d)
+        starts = np.flatnonzero(start_mask)
+        valid = defs[starts] >= d - 1  # below d-1 → an optional above is null
+        # element count per slot: the start position itself contributes one
+        # element when the list is non-empty, plus every rep==r continuation
+        elem_start = (reps == r) | (start_mask & (defs >= d))
+        if n:
+            csum = np.concatenate(
+                [[0], np.cumsum(elem_start.astype(np.int64))]
+            )
+            counts = csum[np.append(starts[1:], n)] - csum[starts]
+        else:
+            counts = np.zeros(0, dtype=np.int64)
+        offsets = np.zeros(len(starts) + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        depths.append(DepthInfo(offsets=offsets, valid=valid))
+        prev_d = d
+
+    if rep_nodes:
+        deepest = rep_nodes[-1]
+        elem_mask = (reps == deepest.rep_level) | (
+            (reps < deepest.rep_level) & (defs >= deepest.def_level)
+        )
+        leaf_present = defs[elem_mask] == max_def
+    else:
+        leaf_present = defs == max_def
+
+    return NestedColumn(
+        descriptor=desc,
+        chain=chain,
+        depths=depths,
+        leaf_present=leaf_present,
+        values=batch.values,
+        def_levels=defs,
+        rep_levels=reps,
+    )
+
+
+def _to_pylist(chain, defs, reps, values, max_def) -> list:
+    """Recursive reference rendering; exact but not vectorized."""
+    n = len(defs)
+    # map level position → dense value index
+    present = defs == max_def
+    vidx = np.cumsum(present) - 1
+
+    def value_at(pos: int):
+        v = values[int(vidx[pos])]
+        if isinstance(v, np.generic):
+            v = v.item()
+        return v
+
+    def build(ci: int, lo: int, hi: int):
+        if ci == len(chain):
+            return value_at(lo)
+        node = chain[ci]
+        if node.kind == "optional":
+            if defs[lo] < node.def_level:
+                return None
+            return build(ci + 1, lo, hi)
+        # repeated
+        if defs[lo] < node.def_level:
+            return []
+        r = node.rep_level
+        starts = [lo] + [p for p in range(lo + 1, hi) if reps[p] == r]
+        ends = starts[1:] + [hi]
+        out = []
+        for s, e in zip(starts, ends):
+            # deeper continuations (rep > r) stay inside [s, e)
+            out.append(build(ci + 1, s, e))
+        return out
+
+    rows = []
+    row_starts = [p for p in range(n) if reps[p] == 0]
+    row_ends = row_starts[1:] + [n]
+    for s, e in zip(row_starts, row_ends):
+        rows.append(build(0, s, e))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Write-side shredding: nested Python values → (values, def, rep)
+# ---------------------------------------------------------------------------
+
+def shred_nested(schema: MessageType, desc: ColumnDescriptor, rows: Sequence):
+    """Shred one leaf column's nested Python rows into Dremel form.
+
+    ``rows`` is one entry per record, shaped like the leaf's nesting:
+    scalars (or None) for flat leaves, lists (possibly empty/None) at each
+    repeated node.  Returns (leaf_values_list, def_levels, rep_levels).
+    """
+    chain = level_chain(schema, desc.path)
+    defs: List[int] = []
+    reps: List[int] = []
+    out_vals: List = []
+
+    def emit(d: int, r: int, val=None, have=False):
+        defs.append(d)
+        reps.append(r)
+        if have:
+            out_vals.append(val)
+
+    def walk(ci: int, val, cur_def: int, rep_in: int):
+        if ci == len(chain):
+            if val is None:
+                raise ValueError(
+                    f"required leaf {'.'.join(desc.path)} got None"
+                )
+            emit(cur_def, rep_in, val, True)
+            return
+        node = chain[ci]
+        if node.kind == "optional":
+            if val is None:
+                emit(node.def_level - 1, rep_in)
+                return
+            if ci == len(chain) - 1:  # optional leaf
+                emit(node.def_level, rep_in, val, True)
+                return
+            walk(ci + 1, val, node.def_level, rep_in)
+            return
+        # repeated node
+        if val is None or (hasattr(val, "__len__") and len(val) == 0):
+            # null handled by an optional ancestor; here None ≈ empty list
+            emit(node.def_level - 1, rep_in)
+            return
+        if not isinstance(val, (list, tuple, np.ndarray)):
+            raise TypeError(
+                f"repeated node {node.name!r} in {'.'.join(desc.path)} "
+                f"expects a list, got {type(val).__name__}"
+            )
+        r_next = rep_in
+        for item in val:
+            if ci == len(chain) - 1:  # repeated leaf primitive
+                if item is None:
+                    raise ValueError("repeated leaf element cannot be None")
+                emit(node.def_level, r_next, item, True)
+            else:
+                walk(ci + 1, item, node.def_level, r_next)
+            r_next = node.rep_level
+        return
+
+    for row in rows:
+        walk(0, row, 0, 0)
+
+    return (
+        out_vals,
+        np.asarray(defs, dtype=np.uint32),
+        np.asarray(reps, dtype=np.uint32),
+    )

@@ -373,12 +373,48 @@ class ParquetFileWriter:
         self._num_rows += num_rows or 0
 
     def write_columns(self, columns: Dict[str, object]) -> None:
-        """Convenience: dict of top-level-name → array/list (None = null)."""
+        """Convenience: dict of top-level-name → array/list (None = null).
+
+        Repeated (nested) leaves accept per-record nested lists and are
+        Dremel-shredded; a ``None`` inside maps to the *outermost* optional
+        node at that position — pass an explicit ``ColumnData`` with levels
+        for finer control.  Leaves under a group are keyed by dotted path.
+        """
+        from ..batch.nested import shred_nested
+
+        leaves_per_top: Dict[str, int] = {}
+        for d in self.schema.columns:
+            leaves_per_top[d.path[0]] = leaves_per_top.get(d.path[0], 0) + 1
         cds = []
         for desc in self.schema.columns:
-            if len(desc.path) != 1:
-                raise ValueError("write_columns supports flat schemas only")
-            cds.append(make_column_data(desc, columns[desc.path[0]]))
+            key = desc.path[0] if len(desc.path) == 1 else ".".join(desc.path)
+            if key not in columns:
+                # a bare top-level key can only stand in for a group with
+                # exactly one leaf — with several leaves the nested rows
+                # would be ambiguous per leaf
+                if desc.path[0] in columns and leaves_per_top[desc.path[0]] == 1:
+                    key = desc.path[0]
+                else:
+                    raise KeyError(
+                        f"write_columns: missing column {key!r} (leaves "
+                        "under multi-leaf groups must be keyed by dotted "
+                        "path)"
+                    )
+            data = columns[key]
+            if isinstance(data, ColumnData):
+                cds.append(data)
+            elif desc.max_repetition_level > 0 or len(desc.path) > 1:
+                vals, defs, reps = shred_nested(self.schema, desc, data)
+                cds.append(
+                    ColumnData(
+                        desc,
+                        _coerce_values(desc, vals),
+                        def_levels=defs if desc.max_definition_level else None,
+                        rep_levels=reps if desc.max_repetition_level else None,
+                    )
+                )
+            else:
+                cds.append(make_column_data(desc, data))
         self.write_row_group(cds)
 
     def close(self) -> FileMetaData:

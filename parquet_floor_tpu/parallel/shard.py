@@ -203,6 +203,14 @@ def read_table_sharded(
                     raise NotImplementedError(
                         "sharded string assembly lands with the string kernel"
                     )
+                if dc.is_repeated:
+                    # repeated columns yield a non-row-aligned value stream
+                    # + levels; global list assembly is not implemented —
+                    # decode per group and DeviceColumn.assemble() instead
+                    raise NotImplementedError(
+                        "sharded assembly of repeated (nested) columns is "
+                        "not supported; use TpuRowGroupReader per group"
+                    )
                 rows = dc.values.shape[0]
                 if per_group is None:
                     per_group = rows

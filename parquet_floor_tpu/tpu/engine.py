@@ -136,19 +136,59 @@ def f64bits_to_f32(bits: jax.Array) -> jax.Array:
 
 @dataclass
 class DeviceColumn:
-    """One decoded column living on device."""
+    """One decoded column living on device.
+
+    For repeated (nested) leaves, ``values`` is the dense *non-null value
+    stream* (padded past the true count) and ``def_levels``/``rep_levels``
+    are the device-decoded Dremel level arrays — record assembly happens
+    on host via :meth:`assemble` (SURVEY.md §7 hard part 5: decode levels
+    on TPU, assemble offsets on host).
+    """
 
     descriptor: ColumnDescriptor
     values: jax.Array               # dense (num_rows, ...) values; nulls filled
     mask: Optional[jax.Array]       # True where null; None if required
     lengths: Optional[jax.Array] = None  # for strings: per-row byte lengths
+    def_levels: Optional[jax.Array] = None  # repeated cols: int32[n]
+    rep_levels: Optional[jax.Array] = None  # repeated cols: int32[n]
 
     @property
     def is_strings(self) -> bool:
         return self.lengths is not None
 
+    @property
+    def is_repeated(self) -> bool:
+        return self.rep_levels is not None
+
     def to_numpy_dense(self):
         return np.asarray(self.values), (None if self.mask is None else np.asarray(self.mask))
+
+    def assemble(self, schema):
+        """Assemble a repeated column into a host ``NestedColumn``."""
+        from ..batch.columns import ColumnBatch
+        from ..batch.nested import assemble_nested
+
+        if self.rep_levels is None:
+            raise ValueError("assemble() requires a repeated column")
+        defs = np.asarray(self.def_levels).astype(np.uint32)
+        reps = np.asarray(self.rep_levels).astype(np.uint32)
+        nn = int(np.count_nonzero(defs == self.descriptor.max_definition_level))
+        if self.lengths is not None:
+            rows = np.asarray(self.values)[:nn]
+            lens = np.asarray(self.lengths)[:nn].astype(np.int64)
+            offsets = np.zeros(nn + 1, dtype=np.int64)
+            np.cumsum(lens, out=offsets[1:])
+            if nn:
+                width = rows.shape[1]
+                col_idx = np.arange(width)[None, :]
+                flat = rows[col_idx < lens[:, None]]
+            else:
+                flat = np.zeros(0, np.uint8)
+            vals = ByteArrayColumn(offsets, flat)
+        else:
+            vals = np.asarray(self.values)[:nn]
+        batch = ColumnBatch(self.descriptor, len(defs), vals, defs, reps)
+        return assemble_nested(schema, batch)
 
 
 class _Fallback(Exception):
@@ -256,13 +296,16 @@ def _bucket15(n: int, minimum: int = 16) -> int:
 
 class _ColSpec(NamedTuple):
     name: str
-    kind: str        # dict | dict_str | plain | bool | delta | host | host_rows | host_str
-    n: int           # rows in the group
+    kind: str        # dict | dict_str | plain | bool | delta | host | host_rows | host_str | hostr | hostr_str
+    n: int           # rows in the group (level positions for repeated cols)
     nexp: int        # value-stream expansion count (n if required, bucketed nn if optional)
     max_def: int
     def_bw: int
     lvl_off: int = -1
     r_lvl: int = 0
+    max_rep: int = 0
+    rep_off: int = -1   # repetition-level run-table plan (5 × r_rep)
+    r_rep: int = 0
     idx_off: int = -1   # dict index plan / bool page plan (5 × r_idx)
     r_idx: int = 0
     sc_off: int = -1    # misc dynamic scalars
@@ -351,6 +394,12 @@ def _finish_optional(vals, present, lens=None):
     return dense, mask, dlens
 
 
+def _levels_i32(arena, slab, off_slot: int, count: int):
+    """Read a host-staged int32 level array out of the arena."""
+    l8 = lax.dynamic_slice(arena, (slab[off_slot],), (count * 4,))
+    return lax.bitcast_convert_type(l8.reshape(count, 4), jnp.int32).reshape(count)
+
+
 def _decode_col(spec: _ColSpec, arena, slab, extras):
     if spec.kind == "host":
         u8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.n * spec.width,))
@@ -359,7 +408,7 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         if spec.max_def > 0:
             m = lax.dynamic_slice(arena, (slab[spec.sc_off + 1],), (spec.n,))
             mask = m != 0
-        return vals, mask, None
+        return vals, mask, None, None, None
     if spec.kind == "host_rows":
         u8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.n * spec.width,))
         vals = u8.reshape(spec.n, spec.width)
@@ -367,7 +416,7 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         if spec.max_def > 0:
             m = lax.dynamic_slice(arena, (slab[spec.sc_off + 1],), (spec.n,))
             mask = m != 0
-        return vals, mask, None
+        return vals, mask, None, None, None
     if spec.kind == "host_str":
         r8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.n * spec.max_len,))
         rows = r8.reshape(spec.n, spec.max_len)
@@ -377,7 +426,21 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         if spec.max_def > 0:
             m = lax.dynamic_slice(arena, (slab[spec.sc_off + 2],), (spec.n,))
             mask = m != 0
-        return rows, mask, lens
+        return rows, mask, lens, None, None
+    if spec.kind == "hostr":
+        # host-decoded repeated column: dense value stream + level arrays
+        u8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.nexp * spec.width,))
+        vals = _typed(u8, spec.nexp, spec.width, spec.vdtype, spec.f64mode)
+        defs = _levels_i32(arena, slab, spec.sc_off + 1, spec.n)
+        reps = _levels_i32(arena, slab, spec.sc_off + 2, spec.n)
+        return vals, None, None, defs, reps
+    if spec.kind == "hostr_str":
+        r8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.nexp * spec.max_len,))
+        rows = r8.reshape(spec.nexp, spec.max_len)
+        lens = _levels_i32(arena, slab, spec.sc_off + 1, spec.nexp)
+        defs = _levels_i32(arena, slab, spec.sc_off + 2, spec.n)
+        reps = _levels_i32(arena, slab, spec.sc_off + 3, spec.n)
+        return rows, None, lens, defs, reps
     if spec.kind == "delta":
         mb = lax.slice(slab, (spec.mb_off,), (spec.mb_off + 3 * spec.m_pad,)).reshape(
             3, spec.m_pad
@@ -387,7 +450,7 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
             arena, mb[0], mb[1], mb[2], first, spec.n, spec.vpm,
             out_dtype=_JNP_BY_NAME[spec.vdtype],
         )
-        return vals, None, None
+        return vals, None, None, None, None
 
     # --- expansion-based kinds: dict / dict_str / plain / bool ------------
     if spec.kind == "dict":
@@ -423,10 +486,17 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
     else:  # pragma: no cover - program construction guards this
         raise ValueError(f"unknown column kind {spec.kind!r}")
 
+    if spec.max_rep > 0:
+        # repeated leaf: levels decode on device; assembly happens on host
+        # (DeviceColumn.assemble) — return the dense value stream + levels
+        defs = _expand(arena, slab, spec.lvl_off, spec.r_lvl, spec.n)
+        reps = _expand(arena, slab, spec.rep_off, spec.r_rep, spec.n)
+        return vals, None, lens, defs, reps
     if spec.max_def > 0:
         present = _levels_present(arena, slab, spec)
-        return _finish_optional(vals, present, lens)
-    return vals, None, lens
+        dense, mask, dlens = _finish_optional(vals, present, lens)
+        return dense, mask, dlens, None, None
+    return vals, None, lens, None, None
 
 
 @partial(jax.jit, static_argnums=(0,))
@@ -449,6 +519,8 @@ class _Pg:
     nn: Optional[int] = None    # non-null count (v2 header; v1 computed later)
     lvl_off: int = -1           # v2: arena offset of def-level stream
     lvl_len: int = 0
+    rep_off: int = -1           # v2: arena offset of rep-level stream
+    rep_len: int = 0
 
 
 class _DevStage:
@@ -459,8 +531,6 @@ class _DevStage:
         self.name = name
         self.desc = desc
         meta = chunk.meta_data
-        if desc.max_repetition_level > 0:
-            raise _Fallback("repeated column")
         pt = desc.physical_type
         codec = meta.codec
         max_def = desc.max_definition_level
@@ -482,6 +552,10 @@ class _DevStage:
                     Encoding.RLE, None,
                 ):
                     raise _Fallback("non-RLE def levels")
+                if desc.max_repetition_level > 0 and (
+                    h.repetition_level_encoding not in (Encoding.RLE, None)
+                ):
+                    raise _Fallback("non-RLE rep levels")
                 size = page.header.uncompressed_page_size
                 off = arena.add_decompress(codec, page.payload, size)
                 pages.append(_Pg(1, h.num_values, off, size, h.encoding))
@@ -489,9 +563,10 @@ class _DevStage:
                 h2 = page.header.data_page_header_v2
                 rl = h2.repetition_levels_byte_length or 0
                 dl = h2.definition_levels_byte_length or 0
-                if rl:
-                    raise _Fallback("repetition levels present")
                 payload = page.payload
+                rep_off = -1
+                if rl:
+                    rep_off = arena.add_copy(payload[:rl], rl)
                 lvl_off = -1
                 if dl:
                     lvl_off = arena.add_copy(payload[rl : rl + dl], dl)
@@ -507,7 +582,8 @@ class _DevStage:
                 pages.append(
                     _Pg(2, h2.num_values, val_off, vsize, h2.encoding,
                         nn=h2.num_values - (h2.num_nulls or 0),
-                        lvl_off=lvl_off, lvl_len=dl)
+                        lvl_off=lvl_off, lvl_len=dl,
+                        rep_off=rep_off, rep_len=rl)
                 )
             elif page.page_type == PageType.INDEX_PAGE:
                 continue
@@ -548,24 +624,36 @@ class _DevStage:
     def finish(self, arena: np.ndarray, slabb: _I32Builder, eng) -> _ColSpec:
         desc = self.desc
         max_def = desc.max_definition_level
+        max_rep = desc.max_repetition_level
         def_bw = e_rle.min_bit_width(max_def)
+        rep_bw = e_rle.min_bit_width(max_rep)
         pt = desc.physical_type
         n = sum(p.n for p in self.pages)
         lvl_tables = []
+        rep_tables = []
         val_offs: List[int] = []
         nns: List[int] = []
         for p in self.pages:
             if p.v == 1:
+                pos = p.off
+                if max_rep > 0:
+                    ln = int.from_bytes(arena[pos : pos + 4].tobytes(), "little")
+                    table, _ = e_rle.parse_runs(arena, p.n, rep_bw, pos=pos + 4)
+                    rep_tables.append((table, rep_bw))
+                    pos += 4 + ln
                 if max_def > 0:
-                    ln = int.from_bytes(arena[p.off : p.off + 4].tobytes(), "little")
-                    table, _ = e_rle.parse_runs(arena, p.n, def_bw, pos=p.off + 4)
+                    ln = int.from_bytes(arena[pos : pos + 4].tobytes(), "little")
+                    table, _ = e_rle.parse_runs(arena, p.n, def_bw, pos=pos + 4)
                     nn = _count_non_null(arena, table, p.n, def_bw, max_def)
                     lvl_tables.append((table, def_bw))
-                    val_offs.append(p.off + 4 + ln)
+                    pos += 4 + ln
                 else:
                     nn = p.n
-                    val_offs.append(p.off)
+                val_offs.append(pos)
             else:
+                if max_rep > 0:
+                    table, _ = e_rle.parse_runs(arena, p.n, rep_bw, pos=p.rep_off)
+                    rep_tables.append((table, rep_bw))
                 if max_def > 0:
                     table, _ = e_rle.parse_runs(arena, p.n, def_bw, pos=p.lvl_off)
                     lvl_tables.append((table, def_bw))
@@ -576,13 +664,17 @@ class _DevStage:
 
         spec = dict(
             name=self.name, kind=self.kind, n=n, max_def=max_def, def_bw=def_bw,
-            nexp=n,
+            nexp=n, max_rep=max_rep,
         )
         if max_def > 0:
             r_lvl = eng._hwm(("r_lvl", self.name), sum(len(t) for t, _ in lvl_tables))
             spec["lvl_off"] = slabb.add(bitops.tables_to_plan5(lvl_tables, n, r_lvl))
             spec["r_lvl"] = r_lvl
             spec["nexp"] = eng._hwm(("nexp", self.name), total_nn)
+        if max_rep > 0:
+            r_rep = eng._hwm(("r_rep", self.name), sum(len(t) for t, _ in rep_tables))
+            spec["rep_off"] = slabb.add(bitops.tables_to_plan5(rep_tables, n, r_rep))
+            spec["r_rep"] = r_rep
 
         if self.kind in ("dict", "dict_str"):
             idx_tables = []
@@ -693,16 +785,56 @@ class _HostStage:
         self.name = name
         self.desc = desc
         batch = eng.reader.read_column_chunk(chunk)
-        if desc.max_repetition_level > 0:
-            raise ValueError(
-                "repeated (nested) columns are not yet supported by the TPU "
-                f"engine: column {'.'.join(desc.path)}"
-            )
-        dense, mask = batch.dense()
         n = batch.num_values
         self.n = n
-        self.max_def = 1 if mask is not None else 0
+        self.max_def = 0
+        self.max_rep = desc.max_repetition_level
         self.offs: Dict[str, int] = {}
+        if self.max_rep > 0:
+            # repeated column: ship the dense non-null value stream plus
+            # the int32 level arrays; assembly happens on host after decode
+            vals = batch.values
+            self.nn = len(vals)
+            if isinstance(vals, ByteArrayColumn):
+                max_len = eng._hwm(
+                    ("hs_len", name),
+                    max((int(vals.lengths().max()) if len(vals) else 1), 1),
+                )
+                rows, lengths, _ = _padded_rows(vals, pad_len=max_len)
+                self.kind = "hostr_str"
+                self.max_len = max_len
+                self.offs["rows"] = arena.add_copy(rows, rows.size)
+                self.offs["lens"] = arena.add_copy(
+                    lengths.astype(np.int32), self.nn * 4
+                )
+            else:
+                if vals.ndim == 2:
+                    raise ValueError(
+                        "repeated FLBA/INT96 columns are not supported by "
+                        f"the TPU engine: column {'.'.join(desc.path)}"
+                    )
+                if vals.dtype == np.bool_:
+                    vals = vals.astype(np.uint8)
+                    self.vdtype = "bool"
+                elif vals.dtype == np.float64 and eng._f64mode == "f32":
+                    vals = vals.astype(np.float32)
+                    self.vdtype = "float32"
+                elif vals.dtype == np.float64 and eng._f64mode == "bits":
+                    vals = vals.view(np.int64)
+                    self.vdtype = "int64"
+                else:
+                    self.vdtype = vals.dtype.name
+                self.kind = "hostr"
+                self.width = vals.dtype.itemsize
+                d = np.ascontiguousarray(vals)
+                self.offs["vals"] = arena.add_copy(d.view(np.uint8), d.nbytes)
+            defs = np.ascontiguousarray(batch.def_levels, dtype=np.int32)
+            reps = np.ascontiguousarray(batch.rep_levels, dtype=np.int32)
+            self.offs["defs"] = arena.add_copy(defs.view(np.uint8), n * 4)
+            self.offs["reps"] = arena.add_copy(reps.view(np.uint8), n * 4)
+            return
+        dense, mask = batch.dense()
+        self.max_def = 1 if mask is not None else 0
         if isinstance(dense, ByteArrayColumn):
             max_len = eng._hwm(
                 ("hs_len", name), max((int(dense.lengths().max()) if n else 1), 1)
@@ -743,6 +875,27 @@ class _HostStage:
             name=self.name, kind=self.kind, n=self.n, nexp=self.n,
             max_def=self.max_def, def_bw=0,
         )
+        if self.kind == "hostr":
+            spec["sc_off"] = slabb.add(
+                [self.offs["vals"], self.offs["defs"], self.offs["reps"]]
+            )
+            spec["nexp"] = self.nn
+            spec["max_rep"] = self.max_rep
+            spec["max_def"] = self.desc.max_definition_level
+            spec["width"] = self.width
+            spec["vdtype"] = self.vdtype
+            spec["f64mode"] = ""
+            return spec
+        if self.kind == "hostr_str":
+            spec["sc_off"] = slabb.add(
+                [self.offs["rows"], self.offs["lens"], self.offs["defs"],
+                 self.offs["reps"]]
+            )
+            spec["nexp"] = self.nn
+            spec["max_rep"] = self.max_rep
+            spec["max_def"] = self.desc.max_definition_level
+            spec["max_len"] = self.max_len
+            return spec
         if self.kind == "host_str":
             sc = [self.offs["rows"], self.offs["lens"]]
             if self.max_def:
@@ -942,14 +1095,16 @@ class TpuRowGroupReader:
             else None
         )
         self._forced: set = set()   # columns pinned to the host path (per file)
+        self._all_host = False      # sticky: group size forced full host staging
         self._hwm_state: Dict[tuple, int] = {}
-        # string-dictionary pools are keyed by the full decompressed content
-        # bytes (exact equality, no hash-collision hazard); dict hashing
-        # caches the bytes' hash after the first lookup
-        self._sdict_meta: Dict[bytes, tuple] = {}   # content → (num, max_len)
+        # string-dictionary pools are keyed by (sha256(content), cap, len).
+        # Staging reuses any already-shipped key whose buckets dominate the
+        # requested ones, so buckets growing across row groups do not pile
+        # up duplicate device pools (and no eviction is needed — an evicted
+        # key could still be referenced by a concurrently staged group).
+        self._sdict_meta: Dict[bytes, tuple] = {}   # digest → (num, max_len)
         self._sdict_host: Dict[tuple, tuple] = {}   # key → (rows, lens)
         self._sdict_dev: Dict[tuple, tuple] = {}    # key → (rows_dev, lens_dev)
-        self._sdict_live: Dict[bytes, tuple] = {}   # content → newest key
         self._lock = threading.Lock()
 
     # -- bucket bookkeeping -------------------------------------------------
@@ -969,9 +1124,12 @@ class TpuRowGroupReader:
     def _string_dict_key(self, arena, off, size, name):
         """Content-keyed string dictionary pool: build (or reuse) the padded
         host matrices and return (cache_key, cap, max_len)."""
+        import hashlib
+
         content = arena[off : off + size].tobytes()
+        digest = hashlib.sha256(content).digest()
         with self._lock:
-            meta = self._sdict_meta.get(content)
+            meta = self._sdict_meta.get(digest)
         if meta is None:
             col, _ = decode_plain(
                 content, _count_plain_strings(content), Type.BYTE_ARRAY
@@ -979,23 +1137,34 @@ class TpuRowGroupReader:
             num = len(col)
             max_len_raw = max(int(col.lengths().max()) if num else 1, 1)
             with self._lock:
-                self._sdict_meta[content] = (num, max_len_raw)
+                if len(self._sdict_meta) >= 256:  # bounded metadata cache
+                    self._sdict_meta.pop(next(iter(self._sdict_meta)))
+                self._sdict_meta[digest] = (num, max_len_raw)
         else:
             col = None
             num, max_len_raw = meta
         cap = self._hwm(("sdict_cap", name), num)
         max_len = self._hwm(("sdict_len", name), max_len_raw)
-        key = (content, cap, max_len)
         with self._lock:
-            have = key in self._sdict_host or key in self._sdict_dev
-        if not have:
-            if col is None:
-                col, _ = decode_plain(
-                    content, _count_plain_strings(content), Type.BYTE_ARRAY
-                )
-            rows, lens, _ = _padded_rows(col, pad_len=max_len, pad_rows=cap)
-            with self._lock:
-                self._sdict_host[key] = (rows, lens)
+            # reuse the smallest already-built pool that dominates the
+            # requested buckets (same content at a grown bucket otherwise
+            # duplicates the pool on device)
+            candidates = [
+                k
+                for k in list(self._sdict_dev) + list(self._sdict_host)
+                if k[0] == digest and k[1] >= cap and k[2] >= max_len
+            ]
+        if candidates:
+            key = min(candidates, key=lambda k: (k[1], k[2]))
+            return key, key[1], key[2]
+        key = (digest, cap, max_len)
+        if col is None:
+            col, _ = decode_plain(
+                content, _count_plain_strings(content), Type.BYTE_ARRAY
+            )
+        rows, lens, _ = _padded_rows(col, pad_len=max_len, pad_rows=cap)
+        with self._lock:
+            self._sdict_host[key] = (rows, lens)
         return key, cap, max_len
 
     # -- public -------------------------------------------------------------
@@ -1050,15 +1219,18 @@ class TpuRowGroupReader:
         want = set(columns) if columns else None
         work = []
         for chunk in rg.columns or []:
-            name = chunk.meta_data.path_in_schema[0]
-            if want and name not in want:
+            path = tuple(chunk.meta_data.path_in_schema)
+            # projection filters by top-level field name (reference
+            # ParquetReader.java:126-128); result keys use the full dotted
+            # path so sibling leaves under one group don't collide
+            if want and path[0] not in want:
                 continue
-            desc = self.reader.schema.column(tuple(chunk.meta_data.path_in_schema))
+            desc = self.reader.schema.column(path)
+            name = path[0] if len(path) == 1 else ".".join(path)
             work.append((name, chunk, desc))
-        all_host = False
         while True:
             try:
-                return self._try_stage(rg, work, self._forced, all_host)
+                return self._try_stage(rg, work, self._forced, self._all_host)
             except _ForceHost as e:
                 # sticky per file: a column that needed the host path once
                 # (e.g. >32-bit delta range) skips the device attempt in
@@ -1068,8 +1240,10 @@ class TpuRowGroupReader:
                 # device plans store absolute *bit* offsets as int32, so
                 # device-staged groups cap at 256 MiB decompressed; host
                 # stages use *byte* offsets (good to 2 GiB) — restage the
-                # whole group through the host engine instead of failing
-                all_host = True
+                # whole group through the host engine instead of failing.
+                # Sticky per file: sibling groups will be equally oversized,
+                # so don't repeat the doomed device attempt for each one.
+                self._all_host = True
 
     def _try_stage(self, rg, work, forced, all_host=False) -> _StagedGroup:
         arena_b = _ArenaBuilder()
@@ -1138,13 +1312,6 @@ class TpuRowGroupReader:
             with self._lock:
                 self._sdict_dev[key] = (shipped[pos], shipped[pos + 1])
                 self._sdict_host.pop(key, None)  # device copy is authoritative
-                # evict the copy this key supersedes (same content, smaller
-                # cap/max_len buckets) so stale pools don't pin HBM
-                old = self._sdict_live.get(key[0])
-                if old is not None and old != key:
-                    self._sdict_dev.pop(old, None)
-                    self._sdict_host.pop(old, None)
-                self._sdict_live[key[0]] = key
             pos += 2
         extra_args = []
         for key in sg.extra_keys:
@@ -1153,6 +1320,8 @@ class TpuRowGroupReader:
             extra_args.append(lens_d)
         outs = _decode_fused(sg.program, arena_dev, slab_dev, *extra_args)
         result: Dict[str, DeviceColumn] = {}
-        for spec, desc, (vals, mask, lens) in zip(sg.program, sg.descs, outs):
-            result[spec.name] = DeviceColumn(desc, vals, mask, lens)
+        for spec, desc, (vals, mask, lens, defs, reps) in zip(
+            sg.program, sg.descs, outs
+        ):
+            result[spec.name] = DeviceColumn(desc, vals, mask, lens, defs, reps)
         return result

@@ -234,3 +234,26 @@ def test_all_null_page_within_dict_column(tmp_path):
         t.read_row_group(0)
         assert not t._forced, f"v{version}: column fell back to host"
         t.close()
+
+
+def test_shared_dict_content_across_columns(tmp_path):
+    """Regression: two string columns whose dictionary *content* coincides
+    in a later row group (but whose shape buckets differ) must not evict
+    each other's device pools mid-flight."""
+    a_g0 = [f"word{i:02d}" for i in range(40)] * 3   # 40-entry dictionary
+    small = ["x", "y"] * 60                          # 2-entry dictionary
+    cols0 = {
+        "a": (types.BYTE_ARRAY, a_g0, False, types.string()),
+        "b": (types.BYTE_ARRAY, small, False, types.string()),
+    }
+    fields = [
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("a"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("b"),
+    ]
+    schema = types.message("t", *fields)
+    path = tmp_path / "sd.parquet"
+    with ParquetFileWriter(path, schema, WriterOptions(row_group_rows=120)) as w:
+        w.write_columns({"a": a_g0[:120], "b": small[:120]})
+        # group 1: both columns carry the identical 2-entry dictionary
+        w.write_columns({"a": small[:120], "b": small[:120]})
+    _check_against_host(path)

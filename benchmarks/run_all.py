@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Measure all five BASELINE.json configs: single-thread CPU host decode
+(the reference-equivalent engine; the reference itself publishes no
+numbers — SURVEY.md §6) vs the TPU decode engine.
+
+Usage: python benchmarks/run_all.py [--rows N] [--reps K] [--json OUT]
+
+Prints a markdown table and (with --json) a machine-readable report.
+bench.py remains the driver's single-line headline metric (config #2).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/pftpu_jax_cache")
+
+
+def _host_decode(path):
+    from parquet_floor_tpu.format.file_read import ParquetFileReader
+
+    with ParquetFileReader(path) as r:
+        rows = 0
+        for batch in r.iter_row_groups():
+            for col in batch.columns:
+                _ = col.values
+                _ = col.def_levels
+                _ = col.rep_levels
+            rows += batch.num_rows
+        return rows
+
+
+def _tpu_decode(reader):
+    import jax
+
+    for cols in reader.iter_row_groups():
+        arrs = [c.values for c in cols.values()]
+        arrs += [c.def_levels for c in cols.values() if c.def_levels is not None]
+        arrs += [c.rep_levels for c in cols.values() if c.rep_levels is not None]
+        jax.block_until_ready(arrs)
+
+
+def measure(name, path, reps, nested_rows=None):
+    import jax
+
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+
+    size = os.path.getsize(path)
+    _host_decode(path)  # warm page cache
+    t0 = time.perf_counter()
+    rows = _host_decode(path)
+    cpu_dt = time.perf_counter() - t0
+    n_rows = nested_rows if nested_rows is not None else rows
+
+    reader = TpuRowGroupReader(path)
+    best = float("inf")
+    try:
+        _tpu_decode(reader)  # compile warmup
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _tpu_decode(reader)
+            best = min(best, time.perf_counter() - t0)
+    finally:
+        reader.close()
+
+    return {
+        "config": name,
+        "rows": n_rows,
+        "file_mb": round(size / 1e6, 2),
+        "cpu_rows_per_s": round(n_rows / cpu_dt, 1),
+        "tpu_rows_per_s": round(n_rows / best, 1),
+        "speedup": round(cpu_dt / best, 2),
+        "cpu_s": round(cpu_dt, 4),
+        "tpu_s": round(best, 4),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from benchmarks import workloads as w
+
+    n = args.rows
+    cfgs = []
+
+    p = f"/tmp/pftpu_cfg1_{n}.parquet"
+    if not os.path.exists(p):
+        w.write_int64_plain(p, n)
+    cfgs.append(("1 INT64 PLAIN uncompressed", p, None))
+
+    p = f"/tmp/pftpu_bench_lineitem_{n}.parquet"
+    if not os.path.exists(p):
+        w.write_lineitem(p, n)
+    cfgs.append(("2 TPC-H lineitem Snappy+dict", p, None))
+
+    p = f"/tmp/pftpu_cfg3_{n}.parquet"
+    if not os.path.exists(p):
+        w.write_taxi_like(p, n)
+    cfgs.append(("3 taxi ZSTD mixed/optional", p, None))
+
+    p = "/tmp/pftpu_cfg4.parquet"
+    if not os.path.exists(p):
+        w.write_wide_delta(p)
+    cfgs.append(("4 wide 1000col DELTA", p, 20_000))
+
+    p = f"/tmp/pftpu_cfg5_{n // 10}.parquet"
+    if not os.path.exists(p):
+        w.write_nested_list(p, n // 10)
+    cfgs.append(("5 nested LIST<STRUCT> Snappy", p, n // 10))
+
+    results = []
+    for name, path, nested_rows in cfgs:
+        r = measure(name, path, args.reps, nested_rows)
+        results.append(r)
+        print(
+            f"| {r['config']:<30} | {r['rows']:>9} | {r['file_mb']:>7.2f} "
+            f"| {r['cpu_rows_per_s']:>12,.0f} | {r['tpu_rows_per_s']:>12,.0f} "
+            f"| {r['speedup']:>6.2f}x |",
+            flush=True,
+        )
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(
+                {"backend": jax.devices()[0].platform, "results": results}, f,
+                indent=2,
+            )
+    return results
+
+
+if __name__ == "__main__":
+    main()

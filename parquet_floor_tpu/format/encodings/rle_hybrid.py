@@ -135,6 +135,38 @@ def parse_runs(data, num_values: int, bit_width: int, pos: int = 0):
     return table, pos
 
 
+def count_equal(data, num_values: int, bit_width: int, target: int,
+                pos: int = 0, run_table=None):
+    """Count decoded values == target without materializing the expansion
+    (the staging hot loop for definition-level non-null counting).
+
+    Native single pass when the library is present; otherwise walks the
+    (supplied or freshly parsed) run table, unpacking only bit-packed runs.
+    """
+    if bit_width == 0:
+        return num_values if target == 0 else 0
+    if _native is not None and _native.available():
+        try:
+            c = _native.rle_count_equal(data, num_values, bit_width, target, pos)
+            if c is not None:
+                return c
+        except ValueError:
+            pass
+    if run_table is None:
+        run_table, _ = parse_runs(data, num_values, bit_width, pos)
+    buf = data if isinstance(data, np.ndarray) else np.frombuffer(data, np.uint8)
+    total = 0
+    for kind, count, v, _ in run_table:
+        if kind == 0:
+            if v == target:
+                total += int(count)
+        else:
+            nbytes = ((int(count) + 7) // 8) * bit_width
+            vals = bit_unpack(buf[v : v + nbytes], bit_width, int(count))
+            total += int(np.count_nonzero(vals == target))
+    return total
+
+
 def expand_runs(data, run_table: np.ndarray, num_values: int, bit_width: int) -> np.ndarray:
     """Phase 2: vectorized expansion of a run table to values (uint32)."""
     if bit_width == 0:

@@ -63,46 +63,68 @@ def _load_locked():
         _load_attempted = True  # set only once the outcome is final
         return None
     try:
-        lib = ctypes.CDLL(path)
-        lib.pftpu_snappy_max_compressed_size.restype = ctypes.c_size_t
-        lib.pftpu_snappy_max_compressed_size.argtypes = [ctypes.c_size_t]
-        lib.pftpu_snappy_compress.restype = ctypes.c_ssize_t
-        lib.pftpu_snappy_compress.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
-        ]
-        lib.pftpu_snappy_uncompressed_size.restype = ctypes.c_ssize_t
-        lib.pftpu_snappy_uncompressed_size.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
-        lib.pftpu_snappy_decompress.restype = ctypes.c_ssize_t
-        lib.pftpu_snappy_decompress.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
-        ]
-        lib.pftpu_plain_ba_scan.restype = ctypes.c_ssize_t
-        lib.pftpu_plain_ba_scan.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_longlong,
-            ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
-        ]
-        lib.pftpu_zstd_decompress.restype = ctypes.c_ssize_t
-        lib.pftpu_zstd_decompress.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
-        ]
-        lib.pftpu_zstd_max_compressed_size.restype = ctypes.c_size_t
-        lib.pftpu_zstd_max_compressed_size.argtypes = [ctypes.c_size_t]
-        lib.pftpu_zstd_compress_store.restype = ctypes.c_ssize_t
-        lib.pftpu_zstd_compress_store.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
-        ]
-        lib.pftpu_rle_parse_runs.restype = ctypes.c_ssize_t
-        lib.pftpu_rle_parse_runs.argtypes = [
-            ctypes.c_void_p, ctypes.c_size_t,  # data
-            ctypes.c_longlong, ctypes.c_int,   # num_values, bit_width
-            ctypes.POINTER(ctypes.c_longlong), ctypes.c_size_t,  # out table, capacity rows
-            ctypes.POINTER(ctypes.c_longlong),  # end position out
-        ]
+        lib = _register(ctypes.CDLL(path))
         _lib = lib
     except OSError:
         _lib = None
+    except AttributeError:
+        # stale .so from an older source revision (missing a symbol):
+        # rebuild once, retry; degrade to pure Python if that fails too
+        _lib = None
+        if os.environ.get("PFTPU_NO_NATIVE_BUILD") != "1" and _try_build():
+            try:
+                _lib = _register(ctypes.CDLL(path))
+            except (OSError, AttributeError):
+                _lib = None
     _load_attempted = True  # after _lib is final, so the lock-free path is safe
     return _lib
+
+
+def _register(lib):
+    """Declare every exported symbol's signature; raises AttributeError when
+    the loaded library predates a symbol (stale build)."""
+    lib.pftpu_snappy_max_compressed_size.restype = ctypes.c_size_t
+    lib.pftpu_snappy_max_compressed_size.argtypes = [ctypes.c_size_t]
+    lib.pftpu_snappy_compress.restype = ctypes.c_ssize_t
+    lib.pftpu_snappy_compress.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.pftpu_snappy_uncompressed_size.restype = ctypes.c_ssize_t
+    lib.pftpu_snappy_uncompressed_size.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.pftpu_snappy_decompress.restype = ctypes.c_ssize_t
+    lib.pftpu_snappy_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.pftpu_plain_ba_scan.restype = ctypes.c_ssize_t
+    lib.pftpu_plain_ba_scan.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
+    ]
+    lib.pftpu_zstd_decompress.restype = ctypes.c_ssize_t
+    lib.pftpu_zstd_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.pftpu_zstd_max_compressed_size.restype = ctypes.c_size_t
+    lib.pftpu_zstd_max_compressed_size.argtypes = [ctypes.c_size_t]
+    lib.pftpu_zstd_compress_store.restype = ctypes.c_ssize_t
+    lib.pftpu_zstd_compress_store.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.pftpu_rle_parse_runs.restype = ctypes.c_ssize_t
+    lib.pftpu_rle_parse_runs.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,  # data
+        ctypes.c_longlong, ctypes.c_int,   # num_values, bit_width
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_size_t,  # out table, capacity rows
+        ctypes.POINTER(ctypes.c_longlong),  # end position out
+    ]
+    lib.pftpu_rle_count_equal.restype = ctypes.c_ssize_t
+    lib.pftpu_rle_count_equal.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,   # data
+        ctypes.c_longlong, ctypes.c_int,    # num_values, bit_width
+        ctypes.c_longlong,                  # target
+        ctypes.POINTER(ctypes.c_longlong),  # count out
+    ]
+    return lib
 
 
 def available() -> bool:
@@ -217,6 +239,33 @@ def plain_ba_scan(data, max_values: int):
     if n < 0:
         raise ValueError("malformed PLAIN BYTE_ARRAY stream")
     return starts[:n], lengths[:n]
+
+
+def rle_count_equal(data, num_values: int, bit_width: int, target: int,
+                    pos: int = 0) -> Optional[int]:
+    """Count decoded values == target in an RLE/bit-packed hybrid stream
+    without expanding it (native).  Returns None when the lib is absent."""
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        return None
+    if isinstance(data, np.ndarray):
+        arr = data if (data.dtype == np.uint8 and data.flags.c_contiguous) else (
+            np.ascontiguousarray(data).view(np.uint8)
+        )
+    else:
+        arr = np.frombuffer(data, dtype=np.uint8)
+    if pos < 0 or pos > len(arr):
+        raise ValueError(f"parse position {pos} outside buffer of {len(arr)} bytes")
+    out = ctypes.c_longlong(0)
+    rc = lib.pftpu_rle_count_equal(
+        arr.ctypes.data + pos, len(arr) - pos, num_values, bit_width,
+        target, ctypes.byref(out),
+    )
+    if rc < 0:
+        raise ValueError("native RLE count failed (malformed stream)")
+    return out.value
 
 
 def rle_parse_runs(data: bytes, num_values: int, bit_width: int, pos: int = 0):

@@ -267,6 +267,9 @@ ptrdiff_t pftpu_rle_parse_runs(const uint8_t* data, size_t data_len,
     p += used;
     if (header & 1) {
       const long long groups = static_cast<long long>(header >> 1);
+      // hostile/corrupt headers: groups * bit_width must not overflow, and
+      // a run can never legitimately exceed the remaining byte budget
+      if (groups < 0 || groups > static_cast<long long>(data_len)) return -1;
       const long long n = groups * 8;
       if (rows >= cap_rows) return -2;
       out_table[rows * 4 + 0] = 1;
@@ -280,6 +283,7 @@ ptrdiff_t pftpu_rle_parse_runs(const uint8_t* data, size_t data_len,
       remaining -= n;
     } else {
       const long long n = static_cast<long long>(header >> 1);
+      if (n < 0) return -1;  // 64-bit varint overflow in a hostile header
       if (p + value_bytes > end) return -1;
       long long value = 0;
       for (int i = 0; i < value_bytes; i++)
@@ -322,6 +326,71 @@ ptrdiff_t pftpu_plain_ba_scan(const uint8_t* data, size_t data_len,
     n++;
   }
   return n;
+}
+
+// ---------------------------------------------------------------------------
+// RLE/bit-packed hybrid: count decoded values equal to `target` without
+// materializing the expansion (definition-level non-null counting — the
+// staging hot loop for optional/repeated columns)
+// ---------------------------------------------------------------------------
+
+ptrdiff_t pftpu_rle_count_equal(const uint8_t* data, size_t data_len,
+                                long long num_values, int bit_width,
+                                long long target, long long* out_count) {
+  if (bit_width == 0) {
+    *out_count = (target == 0) ? num_values : 0;
+    return 0;
+  }
+  const uint8_t* p = data;
+  const uint8_t* end = data + data_len;
+  long long remaining = num_values;
+  const int value_bytes = (bit_width + 7) / 8;
+  const uint64_t mask = (bit_width >= 64)
+                            ? ~0ULL
+                            : ((1ULL << bit_width) - 1);
+  long long count = 0;
+  while (remaining > 0) {
+    uint64_t header;
+    ptrdiff_t used = varint_decode(p, end, &header);
+    if (used < 0) return -1;
+    p += used;
+    if (header & 1) {
+      const long long groups = static_cast<long long>(header >> 1);
+      // hostile/corrupt headers: reject before groups * bit_width can
+      // overflow or move the cursor out of bounds
+      if (groups < 0 || groups > static_cast<long long>(data_len)) return -1;
+      long long n = groups * 8;
+      if (n > remaining) n = remaining;
+      const long long nbytes = groups * bit_width;
+      if (nbytes > end - p) return -1;
+      // unpack little-endian bit fields with a rolling 64-bit window
+      long long bitpos = 0;
+      for (long long i = 0; i < n; i++) {
+        const long long byte0 = bitpos >> 3;
+        uint64_t window = 0;
+        const long long avail = (nbytes - byte0) < 8 ? (nbytes - byte0) : 8;
+        std::memcpy(&window, p + byte0, static_cast<size_t>(avail));
+        const uint64_t v = (window >> (bitpos & 7)) & mask;
+        count += (static_cast<long long>(v) == target);
+        bitpos += bit_width;
+      }
+      p += nbytes;
+      remaining -= n;
+    } else {
+      long long n = static_cast<long long>(header >> 1);
+      if (n < 0) return -1;  // 64-bit varint overflow in a hostile header
+      if (p + value_bytes > end) return -1;
+      long long value = 0;
+      for (int i = 0; i < value_bytes; i++)
+        value |= static_cast<long long>(p[i]) << (8 * i);
+      p += value_bytes;
+      if (n > remaining) n = remaining;
+      if (value == target) count += n;
+      remaining -= n;
+    }
+  }
+  *out_count = count;
+  return 0;
 }
 
 }  // extern "C"

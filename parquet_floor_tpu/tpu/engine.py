@@ -644,7 +644,10 @@ class _DevStage:
                 if max_def > 0:
                     ln = int.from_bytes(arena[pos : pos + 4].tobytes(), "little")
                     table, _ = e_rle.parse_runs(arena, p.n, def_bw, pos=pos + 4)
-                    nn = _count_non_null(arena, table, p.n, def_bw, max_def)
+                    nn = e_rle.count_equal(
+                        arena, p.n, def_bw, max_def, pos=pos + 4,
+                        run_table=table,
+                    )
                     lvl_tables.append((table, def_bw))
                     pos += 4 + ln
                 else:
@@ -910,23 +913,6 @@ class _HostStage:
             spec["width"] = self.width
             spec["vdtype"] = self.vdtype if self.kind == "host" else "u8rows"
         return spec
-
-
-def _count_non_null(buf, table, n, def_bw, max_def) -> int:
-    """Non-null count from the run table alone (no full expansion: RLE runs
-    compare one value; only bit-packed runs unpack — levels are usually
-    RLE-dominated)."""
-    buf = np.frombuffer(buf, dtype=np.uint8) if not isinstance(buf, np.ndarray) else buf
-    nn = 0
-    for kind, count, v, _ in table:
-        if kind == 0:
-            if v == max_def:
-                nn += int(count)
-        else:
-            nbytes = ((int(count) + 7) // 8) * def_bw
-            vals = e_rle.bit_unpack(buf[v : v + nbytes], def_bw, int(count))
-            nn += int(np.count_nonzero(vals == max_def))
-    return nn
 
 
 def _padded_rows(col: ByteArrayColumn, pad_len: Optional[int] = None,

@@ -206,3 +206,51 @@ def test_dict_indices_roundtrip():
     data = e_rle_dict = encode_dict_indices(idx, 1000)
     out, _ = decode_dict_indices(data, len(idx))
     np.testing.assert_array_equal(out, idx)
+
+
+def test_count_equal_native_vs_python():
+    """count_equal (native + fallback) vs full expansion, across widths."""
+    import numpy as np
+    from parquet_floor_tpu.format.encodings import rle_hybrid as e
+    from parquet_floor_tpu.native import binding
+
+    rng = np.random.default_rng(7)
+    for bw in (1, 2, 3, 5, 7, 8, 12, 20):
+        hi = 1 << bw
+        vals = rng.integers(0, min(hi, 6), 5000).astype(np.uint64)
+        vals[100:900] = min(hi, 6) - 1  # a long RLE run
+        stream = e.encode_rle_hybrid(vals, bw)
+        buf = np.frombuffer(stream, np.uint8)
+        expanded, _ = e.decode_rle_hybrid(buf, len(vals), bw, 0)
+        for target in (0, min(hi, 6) - 1, hi - 1):
+            exp = int(np.count_nonzero(expanded == target))
+            got = e.count_equal(buf, len(vals), bw, target)
+            assert got == exp, (bw, target)
+            if binding.available():
+                nat = binding.rle_count_equal(buf, len(vals), bw, target)
+                assert nat == exp, (bw, target, "native")
+    # offset (pos) handling
+    pad = 3
+    vals = rng.integers(0, 4, 1000).astype(np.uint64)
+    stream = e.encode_rle_hybrid(vals, 2)
+    buf = np.frombuffer(b"\xff" * pad + stream, np.uint8)
+    expanded, _ = e.decode_rle_hybrid(buf[pad:], len(vals), 2, 0)
+    for target in (0, 3):
+        exp = int(np.count_nonzero(expanded == target))
+        assert e.count_equal(buf, len(vals), 2, target, pos=pad) == exp
+
+
+def test_native_rejects_hostile_run_headers():
+    """Corrupt varint headers (huge group counts) must error, not read OOB."""
+    import numpy as np
+    import pytest
+    from parquet_floor_tpu.native import binding
+
+    if not binding.available():
+        pytest.skip("native lib not built")
+    # bit-packed header claiming ~2^62 groups: varint 0xFF...0x7F, LSB set
+    hostile = bytes([0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0x7F]) + b"\x00" * 16
+    with pytest.raises(ValueError):
+        binding.rle_parse_runs(hostile, 1000, 4)
+    with pytest.raises(ValueError):
+        binding.rle_count_equal(hostile, 1000, 4, 1)

@@ -1,0 +1,220 @@
+"""Statistics-based row-group pushdown — a native win over the reference.
+
+The reference streams every row group unconditionally (its ``trySplit``
+declines even to parallelize — ``ParquetReader.java:214-217``) and exposes
+footer statistics only as raw metadata.  Here a small predicate DSL
+evaluates against each row group's column chunk min/max/null_count
+statistics, so scans skip groups that *cannot* contain a match before a
+single page is read or shipped:
+
+    from parquet_floor_tpu.batch.predicate import col
+    pred = (col("l_shipdate") >= 9000) & (col("l_quantity") < 10.0)
+    keep = pred.row_groups(reader)         # indices that MAY match
+    for i in keep:
+        batch = reader.read_row_group(i)   # or TpuRowGroupReader
+
+Semantics are conservative three-valued logic: a group is kept unless the
+statistics *prove* no row can match (absent/undecodable stats keep the
+group).  Float NaN never participates in min/max (writer skips NaNs), so
+ordered comparisons remain sound.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..format.parquet_thrift import Type
+
+_NUMPY_DTYPE = {
+    Type.INT32: np.int32,
+    Type.INT64: np.int64,
+    Type.FLOAT: np.float32,
+    Type.DOUBLE: np.float64,
+}
+
+
+def _decode_stat(pt: int, raw: Optional[bytes]):
+    """Decode a min/max statistics value per physical type; None = unknown."""
+    if raw is None:
+        return None
+    if pt in _NUMPY_DTYPE:
+        dt = np.dtype(_NUMPY_DTYPE[pt])
+        if len(raw) != dt.itemsize:
+            return None
+        return np.frombuffer(raw, dtype=dt)[0].item()
+    if pt == Type.BOOLEAN:
+        return bool(raw[0]) if len(raw) == 1 else None
+    if pt == Type.BYTE_ARRAY or pt == Type.FIXED_LEN_BYTE_ARRAY:
+        return bytes(raw)
+    return None  # INT96 etc: no usable order
+
+
+@dataclass(frozen=True)
+class _ChunkStats:
+    min: object          # decoded or None
+    max: object
+    null_count: Optional[int]
+    num_values: Optional[int]
+
+
+def _chunk_stats(rg, name: str) -> Optional[_ChunkStats]:
+    for chunk in rg.columns or []:
+        path = chunk.meta_data.path_in_schema
+        if path[0] != name and ".".join(path) != name:
+            continue
+        st = chunk.meta_data.statistics
+        if st is None:
+            return None
+        pt = chunk.meta_data.type
+        mn = _decode_stat(pt, st.min_value if st.min_value is not None else st.min)
+        mx = _decode_stat(pt, st.max_value if st.max_value is not None else st.max)
+        return _ChunkStats(mn, mx, st.null_count, chunk.meta_data.num_values)
+    return None
+
+
+def _coerce(value, other):
+    """Make a user literal comparable with a decoded stat (str → bytes)."""
+    if isinstance(value, str) and isinstance(other, bytes):
+        return value.encode("utf-8")
+    return value
+
+
+class Predicate:
+    """Base: ``may_match(rg) -> bool`` (True = cannot be ruled out)."""
+
+    def may_match(self, rg) -> bool:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def row_groups(self, reader) -> List[int]:
+        """Indices of row groups that may contain matching rows."""
+        return [
+            i for i, rg in enumerate(reader.row_groups) if self.may_match(rg)
+        ]
+
+    def __and__(self, other: "Predicate") -> "Predicate":
+        return _And(self, other)
+
+    def __or__(self, other: "Predicate") -> "Predicate":
+        return _Or(self, other)
+
+    def __invert__(self) -> "Predicate":
+        # NOT over three-valued logic cannot reuse may_match (both a
+        # predicate and its negation may be satisfiable in one group);
+        # each comparison supplies its own negation instead.
+        raise TypeError(
+            "use the negated comparison (e.g. col('x') != 3) rather than ~"
+        )
+
+
+@dataclass(frozen=True)
+class _And(Predicate):
+    a: Predicate
+    b: Predicate
+
+    def may_match(self, rg) -> bool:
+        return self.a.may_match(rg) and self.b.may_match(rg)
+
+
+@dataclass(frozen=True)
+class _Or(Predicate):
+    a: Predicate
+    b: Predicate
+
+    def may_match(self, rg) -> bool:
+        return self.a.may_match(rg) or self.b.may_match(rg)
+
+
+@dataclass(frozen=True)
+class _Cmp(Predicate):
+    name: str
+    op: str
+    value: object
+
+    def may_match(self, rg) -> bool:
+        st = _chunk_stats(rg, self.name)
+        if st is None:
+            return True
+        v = _coerce(self.value, st.min if st.min is not None else st.max)
+        mn, mx = st.min, st.max
+        try:
+            if self.op == "==":
+                if mn is not None and v < mn:
+                    return False
+                if mx is not None and v > mx:
+                    return False
+                return True
+            if self.op == "!=":
+                # ruled out only when every row equals v exactly
+                if (
+                    mn is not None and mx is not None and mn == mx == v
+                    and not st.null_count
+                ):
+                    return False
+                return True
+            if self.op == "<":
+                return mn is None or mn < v
+            if self.op == "<=":
+                return mn is None or mn <= v
+            if self.op == ">":
+                return mx is None or mx > v
+            if self.op == ">=":
+                return mx is None or mx >= v
+        except TypeError:
+            return True  # incomparable literal: keep the group
+        return True
+
+
+@dataclass(frozen=True)
+class _IsNull(Predicate):
+    name: str
+    want_null: bool
+
+    def may_match(self, rg) -> bool:
+        st = _chunk_stats(rg, self.name)
+        if st is None or st.null_count is None:
+            return True
+        if self.want_null:
+            return st.null_count > 0
+        if st.num_values is None:
+            return True
+        return st.null_count < st.num_values
+
+
+class Col:
+    """Column reference for building predicates: ``col("x") > 3``."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __eq__(self, v) -> Predicate:  # type: ignore[override]
+        return _Cmp(self._name, "==", v)
+
+    def __ne__(self, v) -> Predicate:  # type: ignore[override]
+        return _Cmp(self._name, "!=", v)
+
+    def __lt__(self, v) -> Predicate:
+        return _Cmp(self._name, "<", v)
+
+    def __le__(self, v) -> Predicate:
+        return _Cmp(self._name, "<=", v)
+
+    def __gt__(self, v) -> Predicate:
+        return _Cmp(self._name, ">", v)
+
+    def __ge__(self, v) -> Predicate:
+        return _Cmp(self._name, ">=", v)
+
+    def is_null(self) -> Predicate:
+        return _IsNull(self._name, True)
+
+    def is_not_null(self) -> Predicate:
+        return _IsNull(self._name, False)
+
+    __hash__ = None  # type: ignore[assignment]
+
+
+def col(name: str) -> Col:
+    return Col(name)

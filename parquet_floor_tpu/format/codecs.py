@@ -100,7 +100,13 @@ def _zstd_decompress(data: bytes, uncompressed_size=None) -> bytes:
 
 
 def _lz4_raw_decompress(data: bytes, uncompressed_size=None) -> bytes:
-    """LZ4 raw block decode, implemented directly (no wheel available)."""
+    """LZ4 raw block decode: native single pass when built, else Python."""
+    if (
+        _native is not None
+        and _native.available()
+        and uncompressed_size is not None
+    ):
+        return _native.lz4_decompress(bytes(data), uncompressed_size)
     out = bytearray()
     pos = 0
     n = len(data)
@@ -158,12 +164,54 @@ def _lz4_raw_compress(data: bytes) -> bytes:
     return bytes(out)
 
 
+def _lz4_hadoop_decompress(data: bytes, uncompressed_size=None) -> bytes:
+    """Parquet legacy LZ4: Hadoop framing — repeated
+    [uncompressed_len u32be][compressed_len u32be][raw LZ4 block] records
+    (each record may itself hold several inner blocks).  Some writers emit
+    a bare raw block instead; be liberal and fall back to raw decode.
+    """
+    n = len(data)
+    if n >= 8:
+        out = bytearray()
+        pos = 0
+        ok = True
+        while pos < n:
+            if pos + 8 > n:
+                ok = False
+                break
+            ulen = int.from_bytes(data[pos : pos + 4], "big")
+            clen = int.from_bytes(data[pos + 4 : pos + 8], "big")
+            pos += 8
+            if clen <= 0 or pos + clen > n or ulen > (1 << 31):
+                ok = False
+                break
+            try:
+                out += _lz4_raw_decompress(data[pos : pos + clen], ulen)
+            except (ValueError, IndexError):
+                # a bare raw block whose first bytes merely looked like a
+                # frame header: fall back to whole-buffer raw decode
+                ok = False
+                break
+            pos += clen
+        if ok and (uncompressed_size is None or len(out) == uncompressed_size):
+            return bytes(out)
+    return _lz4_raw_decompress(data, uncompressed_size)
+
+
+def _lz4_hadoop_compress(data: bytes) -> bytes:
+    block = _lz4_raw_compress(data)
+    return (
+        len(data).to_bytes(4, "big") + len(block).to_bytes(4, "big") + block
+    )
+
+
 _COMPRESSORS: Dict[int, Callable[[bytes], bytes]] = {
     CompressionCodec.UNCOMPRESSED: lambda d: d,
     CompressionCodec.SNAPPY: _snappy_compress,
     CompressionCodec.GZIP: _gzip_compress,
     CompressionCodec.ZSTD: _zstd_compress,
     CompressionCodec.LZ4_RAW: _lz4_raw_compress,
+    CompressionCodec.LZ4: _lz4_hadoop_compress,
 }
 
 _DECOMPRESSORS: Dict[int, Callable[..., bytes]] = {
@@ -172,6 +220,7 @@ _DECOMPRESSORS: Dict[int, Callable[..., bytes]] = {
     CompressionCodec.GZIP: _gzip_decompress,
     CompressionCodec.ZSTD: _zstd_decompress,
     CompressionCodec.LZ4_RAW: _lz4_raw_decompress,
+    CompressionCodec.LZ4: _lz4_hadoop_decompress,
 }
 
 
@@ -229,6 +278,7 @@ def supported_codecs() -> Tuple[int, ...]:
         CompressionCodec.SNAPPY,
         CompressionCodec.GZIP,
         CompressionCodec.LZ4_RAW,
+        CompressionCodec.LZ4,
     ]
     if _zstd is not None or (_native is not None and _native.available()):
         base.append(CompressionCodec.ZSTD)

@@ -69,11 +69,19 @@ def _load_locked():
         _lib = None
     except AttributeError:
         # stale .so from an older source revision (missing a symbol):
-        # rebuild once, retry; degrade to pure Python if that fails too
+        # rebuild once, retry; degrade to pure Python if that fails too.
+        # dlopen caches by pathname (the stale handle is never dlclosed),
+        # so the rebuilt library must load from a fresh path.
         _lib = None
         if os.environ.get("PFTPU_NO_NATIVE_BUILD") != "1" and _try_build():
+            import shutil
+            import tempfile
+
             try:
-                _lib = _register(ctypes.CDLL(path))
+                fd, fresh = tempfile.mkstemp(suffix=".so", prefix="pftpu_")
+                os.close(fd)
+                shutil.copy2(path, fresh)
+                _lib = _register(ctypes.CDLL(fresh))
             except (OSError, AttributeError):
                 _lib = None
     _load_attempted = True  # after _lib is final, so the lock-free path is safe
@@ -116,6 +124,10 @@ def _register(lib):
         ctypes.c_longlong, ctypes.c_int,   # num_values, bit_width
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_size_t,  # out table, capacity rows
         ctypes.POINTER(ctypes.c_longlong),  # end position out
+    ]
+    lib.pftpu_lz4_decompress.restype = ctypes.c_ssize_t
+    lib.pftpu_lz4_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
     ]
     lib.pftpu_rle_count_equal.restype = ctypes.c_ssize_t
     lib.pftpu_rle_count_equal.argtypes = [
@@ -241,6 +253,22 @@ def plain_ba_scan(data, max_values: int):
     return starts[:n], lengths[:n]
 
 
+def lz4_decompress(data: bytes, uncompressed_size: int) -> bytes:
+    """Decode one LZ4 raw block natively (exact output size required)."""
+    lib = _load()
+    out = ctypes.create_string_buffer(uncompressed_size)
+    n = lib.pftpu_lz4_decompress(data, len(data), out, uncompressed_size)
+    if n == -2:
+        raise ValueError("LZ4 output larger than expected size")
+    if n < 0:
+        raise ValueError("malformed LZ4 block")
+    if n != uncompressed_size:
+        raise ValueError(
+            f"LZ4 block decoded {n} bytes, expected {uncompressed_size}"
+        )
+    return out.raw[:n]
+
+
 def rle_count_equal(data, num_values: int, bit_width: int, target: int,
                     pos: int = 0) -> Optional[int]:
     """Count decoded values == target in an RLE/bit-packed hybrid stream
@@ -249,6 +277,10 @@ def rle_count_equal(data, num_values: int, bit_width: int, target: int,
 
     lib = _load()
     if lib is None:
+        return None
+    if bit_width > 57:
+        # the native rolling 64-bit window needs (bitpos&7)+bit_width ≤ 64;
+        # wider fields fall back to the exact Python path
         return None
     if isinstance(data, np.ndarray):
         arr = data if (data.dtype == np.uint8 and data.flags.c_contiguous) else (

@@ -241,6 +241,61 @@ ptrdiff_t pftpu_snappy_decompress(const uint8_t* src, size_t src_len,
 }
 
 // ---------------------------------------------------------------------------
+// LZ4 raw block decode (parquet LZ4_RAW, and the payload of Hadoop-framed
+// LZ4).  Sequence copies must go byte-by-byte when overlapping (RLE-style
+// offsets < length are the common case).
+// ---------------------------------------------------------------------------
+
+ptrdiff_t pftpu_lz4_decompress(const uint8_t* src, size_t src_len,
+                               uint8_t* dst, size_t dst_cap) {
+  const uint8_t* p = src;
+  const uint8_t* const end = src + src_len;
+  uint8_t* out = dst;
+  uint8_t* const out_end = dst + dst_cap;
+  while (p < end) {
+    const uint8_t token = *p++;
+    size_t lit = token >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do {
+        if (p >= end) return -1;
+        b = *p++;
+        lit += b;
+      } while (b == 255);
+    }
+    if (lit > static_cast<size_t>(end - p)) return -1;
+    if (lit > static_cast<size_t>(out_end - out)) return -2;
+    std::memcpy(out, p, lit);
+    p += lit;
+    out += lit;
+    if (p >= end) break;  // final sequence carries literals only
+    if (p + 2 > end) return -1;
+    const size_t offset = static_cast<size_t>(p[0]) | (static_cast<size_t>(p[1]) << 8);
+    p += 2;
+    if (offset == 0 || offset > static_cast<size_t>(out - dst)) return -1;
+    size_t mlen = token & 0xF;
+    if (mlen == 15) {
+      uint8_t b;
+      do {
+        if (p >= end) return -1;
+        b = *p++;
+        mlen += b;
+      } while (b == 255);
+    }
+    mlen += 4;
+    if (mlen > static_cast<size_t>(out_end - out)) return -2;
+    const uint8_t* from = out - offset;
+    if (offset >= mlen) {
+      std::memcpy(out, from, mlen);
+      out += mlen;
+    } else {
+      for (size_t i = 0; i < mlen; i++) *out++ = *from++;
+    }
+  }
+  return out - dst;
+}
+
+// ---------------------------------------------------------------------------
 // RLE/bit-packed hybrid run-table parse (phase 1 of the two-phase decode;
 // phase 2 — expansion — runs vectorized on TPU or in NumPy)
 // ---------------------------------------------------------------------------

@@ -55,6 +55,7 @@ from ..format.parquet_thrift import (
     Type,
 )
 from ..format.schema import ColumnDescriptor
+from ..utils import trace
 from . import bitops
 
 
@@ -1201,6 +1202,10 @@ class TpuRowGroupReader:
     # -- staging ------------------------------------------------------------
 
     def _stage_row_group(self, index: int, columns) -> _StagedGroup:
+        with trace.span("stage"):
+            return self._stage_row_group_untraced(index, columns)
+
+    def _stage_row_group_untraced(self, index: int, columns) -> _StagedGroup:
         rg = self.reader.row_groups[index]
         want = set(columns) if columns else None
         work = []
@@ -1289,9 +1294,10 @@ class TpuRowGroupReader:
         for _, rows, lens in sg.new_extras:
             ship.append(rows)
             ship.append(lens)
-        shipped = jax.device_put(ship, self.device)
-        if self.sync_transfers:
-            jax.block_until_ready(shipped)
+        with trace.span("ship", sum(int(a.nbytes) for a in ship)):
+            shipped = jax.device_put(ship, self.device)
+            if self.sync_transfers:
+                jax.block_until_ready(shipped)
         arena_dev, slab_dev = shipped[0], shipped[1]
         pos = 2
         for key, _, _ in sg.new_extras:
@@ -1304,7 +1310,8 @@ class TpuRowGroupReader:
             rows_d, lens_d = self._sdict_dev[key]
             extra_args.append(rows_d)
             extra_args.append(lens_d)
-        outs = _decode_fused(sg.program, arena_dev, slab_dev, *extra_args)
+        with trace.span("decode"):
+            outs = _decode_fused(sg.program, arena_dev, slab_dev, *extra_args)
         result: Dict[str, DeviceColumn] = {}
         for spec, desc, (vals, mask, lens, defs, reps) in zip(
             sg.program, sg.descs, outs

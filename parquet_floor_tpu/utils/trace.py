@@ -1,0 +1,116 @@
+"""Per-stage tracing/metrics — the observability subsystem SURVEY.md §5
+prescribes for the new framework (the reference has none: its only output
+is ``e.printStackTrace()`` in shims, ``FSDataInputStream.java:26,35,43``).
+
+Three layers, all zero-cost when disabled:
+
+* ``span(stage)`` — context manager accumulating wall time + byte counts
+  per stage name (read / stage / ship / decode / assemble).
+* ``stats()`` / ``report()`` — snapshot the counters (thread-safe).
+* ``device_trace(dir)`` — wraps ``jax.profiler.trace`` so the device side
+  of a decode shows up in TensorBoard/Perfetto alongside the host spans.
+
+Enable with ``PFTPU_TRACE=1`` or ``trace.enable()``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional
+
+_enabled = os.environ.get("PFTPU_TRACE", "0") == "1"
+_lock = threading.Lock()
+
+
+@dataclass
+class StageStat:
+    count: int = 0
+    seconds: float = 0.0
+    bytes: int = 0
+
+    def as_dict(self) -> dict:
+        mbps = (self.bytes / self.seconds / 1e6) if self.seconds else 0.0
+        return {
+            "count": self.count,
+            "seconds": round(self.seconds, 6),
+            "bytes": self.bytes,
+            "MB_per_s": round(mbps, 1),
+        }
+
+
+_stats: Dict[str, StageStat] = {}
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    with _lock:
+        _stats.clear()
+
+
+def add(stage: str, seconds: float, nbytes: int = 0) -> None:
+    if not _enabled:
+        return
+    with _lock:
+        st = _stats.get(stage)
+        if st is None:
+            st = _stats[stage] = StageStat()
+        st.count += 1
+        st.seconds += seconds
+        st.bytes += nbytes
+
+
+@contextlib.contextmanager
+def span(stage: str, nbytes: int = 0) -> Iterator[None]:
+    """Accumulate one timed span under ``stage`` (no-op when disabled)."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        add(stage, time.perf_counter() - t0, nbytes)
+
+
+def stats() -> Dict[str, dict]:
+    """Snapshot of all stage counters."""
+    with _lock:
+        return {k: v.as_dict() for k, v in sorted(_stats.items())}
+
+
+def report() -> str:
+    """Human-readable one-line-per-stage report."""
+    lines = []
+    for name, st in stats().items():
+        lines.append(
+            f"{name:<12} n={st['count']:<6} {st['seconds']*1e3:9.1f} ms"
+            + (f"  {st['MB_per_s']:8.1f} MB/s" if st["bytes"] else "")
+        )
+    return "\n".join(lines) or "(no spans recorded — is tracing enabled?)"
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Wrap a region in ``jax.profiler.trace`` so XLA device activity lands
+    in TensorBoard/Perfetto next to the host spans."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,91 @@
+"""Row-group statistics pushdown: conservative skipping, never false
+negatives (every group containing a matching row must be kept)."""
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import ParquetFileReader, ParquetFileWriter, WriterOptions, types
+from parquet_floor_tpu.batch.predicate import col
+
+
+@pytest.fixture(scope="module")
+def filt_file(tmp_path_factory):
+    """4 row groups: x in [0..99], [100..199], [200..299], [300..399];
+    s = 'g{group}'; y optional, all-null in group 2."""
+    path = tmp_path_factory.mktemp("pred") / "p.parquet"
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("x"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.optional(types.DOUBLE).named("y"),
+    )
+    with ParquetFileWriter(path, schema, WriterOptions()) as w:
+        for g in range(4):
+            xs = np.arange(g * 100, g * 100 + 100, dtype=np.int64)
+            ys = [None] * 100 if g == 2 else [float(v) for v in xs]
+            w.write_columns({"x": xs, "s": [f"g{g}"] * 100, "y": ys})
+    return str(path)
+
+
+def _groups(path, pred):
+    with ParquetFileReader(path) as r:
+        return pred.row_groups(r)
+
+
+def test_range_pushdown(filt_file):
+    assert _groups(filt_file, col("x") < 100) == [0]
+    assert _groups(filt_file, col("x") >= 300) == [3]
+    assert _groups(filt_file, col("x") == 150) == [1]
+    assert _groups(filt_file, (col("x") >= 150) & (col("x") < 250)) == [1, 2]
+    assert _groups(filt_file, (col("x") < 50) | (col("x") > 350)) == [0, 3]
+    assert _groups(filt_file, col("x") > 1000) == []
+    assert _groups(filt_file, col("x") <= 0) == [0]
+
+
+def test_string_pushdown(filt_file):
+    assert _groups(filt_file, col("s") == "g2") == [2]
+    assert _groups(filt_file, col("s") >= "g3") == [3]
+    # != on a constant-value group rules it out
+    assert _groups(filt_file, col("s") != "g1") == [0, 2, 3]
+
+
+def test_null_pushdown(filt_file):
+    assert _groups(filt_file, col("y").is_null()) == [2]
+    assert _groups(filt_file, col("y").is_not_null()) == [0, 1, 3]
+
+
+def test_unknown_column_keeps_all(filt_file):
+    assert _groups(filt_file, col("nope") > 1) == [0, 1, 2, 3]
+
+
+def test_no_false_negatives_random(filt_file):
+    """Property: every group that truly contains a match is kept."""
+    rng = np.random.default_rng(3)
+    with ParquetFileReader(filt_file) as r:
+        truth = []
+        for gi in range(4):
+            xs = r.read_row_group(gi).column("x").values
+            truth.append(np.asarray(xs))
+        for _ in range(50):
+            v = int(rng.integers(-50, 450))
+            for pred, fn in [
+                (col("x") > v, lambda a: (a > v).any()),
+                (col("x") <= v, lambda a: (a <= v).any()),
+                (col("x") == v, lambda a: (a == v).any()),
+            ]:
+                keep = set(pred.row_groups(r))
+                for gi, xs in enumerate(truth):
+                    if fn(xs):
+                        assert gi in keep, (v, pred)
+
+
+def test_pyarrow_written_stats(tmp_path):
+    """Stats written by pyarrow (truncated/exact) drive the same pushdown."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "pa.parquet")
+    t = pa.table({"a": list(range(1000))})
+    pq.write_table(t, path, row_group_size=250)
+    assert _groups(path, col("a") < 250) == [0]
+    assert _groups(path, col("a") >= 750) == [3]

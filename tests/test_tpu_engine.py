@@ -257,3 +257,25 @@ def test_shared_dict_content_across_columns(tmp_path):
         # group 1: both columns carry the identical 2-entry dictionary
         w.write_columns({"a": small[:120], "b": small[:120]})
     _check_against_host(path)
+
+
+def test_trace_spans(tmp_path):
+    """The tracing subsystem records stage/ship/decode spans per group."""
+    from parquet_floor_tpu.utils import trace
+
+    cols = {"x": (types.INT64, list(range(500)), False, None)}
+    path = _write(tmp_path, cols, WriterOptions(), n=500)
+    trace.reset()
+    trace.enable()
+    try:
+        t = TpuRowGroupReader(path)
+        t.read_row_group(0)
+        t.close()
+        st = trace.stats()
+        assert st["stage"]["count"] == 1
+        assert st["ship"]["count"] == 1 and st["ship"]["bytes"] > 0
+        assert st["decode"]["count"] == 1
+        assert "stage" in trace.report()
+    finally:
+        trace.disable()
+        trace.reset()

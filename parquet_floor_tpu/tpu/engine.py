@@ -57,6 +57,7 @@ from ..format.parquet_thrift import (
 from ..format.schema import ColumnDescriptor
 from ..utils import trace
 from . import bitops
+from .kernels import rle_kernel as plk
 
 
 def _require_x64() -> None:
@@ -216,10 +217,14 @@ class _ArenaOverflow(Exception):
 
 class _ArenaBuilder:
     """Reserve byte regions, then fill them all in one pass (decompressing
-    straight into the final buffer)."""
+    straight into the final buffer).
 
-    def __init__(self):
-        self.size = 0
+    ``lead`` bytes of zero slack precede the first region (and the cap
+    leaves tail slack) so Pallas DMA windows that start before a packed
+    run's base or read past its end stay inside the buffer."""
+
+    def __init__(self, lead: int = 0):
+        self.size = lead
         self.jobs: List[tuple] = []  # ("d", codec, payload, off, size) | ("c", data, off)
 
     def reserve(self, size: int) -> int:
@@ -307,6 +312,11 @@ class _ColSpec(NamedTuple):
     max_rep: int = 0
     rep_off: int = -1   # repetition-level run-table plan (5 × r_rep)
     r_rep: int = 0
+    # Pallas expansion plans: () = jnp path; (bw, span_off, n_tiles,
+    # interpret) = uniform-width stream expanded by the Pallas kernel
+    pl_lvl: tuple = ()
+    pl_rep: tuple = ()
+    pl_idx: tuple = ()
     idx_off: int = -1   # dict index plan / bool page plan (5 × r_idx)
     r_idx: int = 0
     sc_off: int = -1    # misc dynamic scalars
@@ -345,8 +355,17 @@ def _plan5(slab, off: int, r: int):
     return p[0], p[1], p[2], p[3], p[4]
 
 
-def _expand(arena, slab, off: int, r: int, count: int):
+def _expand(arena, slab, off: int, r: int, count: int, pl: tuple = ()):
     oe, k, v, bb, bw = _plan5(slab, off, r)
+    if pl:
+        # uniform-width stream: Pallas kernel (run-local DMA + bit-matrix
+        # contraction) instead of the per-element gather formulation
+        pbw, span_off, nt, interp = pl
+        tl = lax.slice(slab, (span_off,), (span_off + nt,))
+        th = lax.slice(slab, (span_off + nt,), (span_off + 2 * nt,))
+        return plk.rle_expand_pallas_inline(
+            arena, oe, k, v, bb, tl, th, count, pbw, interpret=interp
+        )
     return bitops.rle_expand_bw(arena, oe, k, v, bb, bw, count)
 
 
@@ -384,7 +403,7 @@ def _paged_gather(arena, slab, spec: _ColSpec):
 
 
 def _levels_present(arena, slab, spec: _ColSpec):
-    levels = _expand(arena, slab, spec.lvl_off, spec.r_lvl, spec.n)
+    levels = _expand(arena, slab, spec.lvl_off, spec.r_lvl, spec.n, spec.pl_lvl)
     return levels == spec.max_def
 
 
@@ -455,7 +474,7 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
 
     # --- expansion-based kinds: dict / dict_str / plain / bool ------------
     if spec.kind == "dict":
-        idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp)
+        idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp, spec.pl_idx)
         # clamped gather, not dynamic_slice: the bucketed capacity may
         # overrun the arena tail (padding rows are garbage, never indexed)
         dpos = slab[spec.sc_off] + jnp.arange(
@@ -468,7 +487,7 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
     elif spec.kind == "dict_str":
         rows_d = extras[2 * spec.extra_idx]
         lens_d = extras[2 * spec.extra_idx + 1]
-        idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp)
+        idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp, spec.pl_idx)
         vals = jnp.take(rows_d, idx, axis=0)
         lens = jnp.take(lens_d, idx)
     elif spec.kind == "plain":
@@ -490,8 +509,8 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
     if spec.max_rep > 0:
         # repeated leaf: levels decode on device; assembly happens on host
         # (DeviceColumn.assemble) — return the dense value stream + levels
-        defs = _expand(arena, slab, spec.lvl_off, spec.r_lvl, spec.n)
-        reps = _expand(arena, slab, spec.rep_off, spec.r_rep, spec.n)
+        defs = _expand(arena, slab, spec.lvl_off, spec.r_lvl, spec.n, spec.pl_lvl)
+        reps = _expand(arena, slab, spec.rep_off, spec.r_rep, spec.n, spec.pl_rep)
         return vals, None, lens, defs, reps
     if spec.max_def > 0:
         present = _levels_present(arena, slab, spec)
@@ -672,13 +691,17 @@ class _DevStage:
         )
         if max_def > 0:
             r_lvl = eng._hwm(("r_lvl", self.name), sum(len(t) for t, _ in lvl_tables))
-            spec["lvl_off"] = slabb.add(bitops.tables_to_plan5(lvl_tables, n, r_lvl))
+            plan = bitops.tables_to_plan5(lvl_tables, n, r_lvl)
+            spec["lvl_off"] = slabb.add(plan)
             spec["r_lvl"] = r_lvl
             spec["nexp"] = eng._hwm(("nexp", self.name), total_nn)
+            spec["pl_lvl"] = eng._pallas_plan(plan, r_lvl, n, def_bw, slabb)
         if max_rep > 0:
             r_rep = eng._hwm(("r_rep", self.name), sum(len(t) for t, _ in rep_tables))
-            spec["rep_off"] = slabb.add(bitops.tables_to_plan5(rep_tables, n, r_rep))
+            plan = bitops.tables_to_plan5(rep_tables, n, r_rep)
+            spec["rep_off"] = slabb.add(plan)
             spec["r_rep"] = r_rep
+            spec["pl_rep"] = eng._pallas_plan(plan, r_rep, n, rep_bw, slabb)
 
         if self.kind in ("dict", "dict_str"):
             idx_tables = []
@@ -702,10 +725,14 @@ class _DevStage:
             r_idx = eng._hwm(
                 ("r_idx", self.name), sum(len(t) for t, _ in idx_tables)
             )
-            spec["idx_off"] = slabb.add(
-                bitops.tables_to_plan5(idx_tables, total_nn, r_idx)
-            )
+            plan = bitops.tables_to_plan5(idx_tables, total_nn, r_idx)
+            spec["idx_off"] = slabb.add(plan)
             spec["r_idx"] = r_idx
+            idx_bws = {b for _, b in idx_tables}
+            if len(idx_bws) == 1:  # uniform width across the chunk's pages
+                spec["pl_idx"] = eng._pallas_plan(
+                    plan, r_idx, spec["nexp"], idx_bws.pop(), slabb
+                )
             if self.kind == "dict":
                 width = np.dtype(_NP_DTYPE[pt]).itemsize
                 num_dict = self.dict_size // width
@@ -1073,6 +1100,15 @@ class TpuRowGroupReader:
         if sync_transfers is None:
             sync_transfers = _os.environ.get("PFTPU_SYNC_TRANSFERS", "1") != "0"
         self.sync_transfers = sync_transfers
+        # Pallas expansion for uniform-bit-width streams (PFTPU_PALLAS=1).
+        # Opt-in and always in interpret mode for now: the kernel is exact
+        # (property-tested), but Mosaic's current op set can't lower the
+        # bit-matrix regroup (large uint8/irregular reshapes crash its
+        # compiler), so compiled mode would fail on the very platform the
+        # flag targets.  The jnp expansion path is nowhere near the
+        # pipeline bottleneck (~2 ms device decode vs ~250 ms host+ship).
+        self._pl_enabled = _os.environ.get("PFTPU_PALLAS", "") == "1"
+        self._pl_interp = self._pl_enabled
         if host_threads is None:
             host_threads = min(8, _os.cpu_count() or 1)
         self._fill_pool = (
@@ -1236,8 +1272,19 @@ class TpuRowGroupReader:
                 # so don't repeat the doomed device attempt for each one.
                 self._all_host = True
 
+    def _pallas_plan(self, plan: np.ndarray, n_runs: int, count: int,
+                     bw: int, slabb: _I32Builder):
+        """Build the (bw, span_off, n_tiles, interpret) Pallas plan for a
+        uniform-width stream, or () when gated off / not worthwhile."""
+        if not self._pl_enabled or bw == 0 or bw > 32 or count < plk.TILE:
+            return ()
+        out_end = plan.reshape(5, n_runs)[0]
+        tl, th = plk.tile_spans_padded(out_end, count)
+        span_off = slabb.add(np.concatenate([tl, th]))
+        return (bw, span_off, len(tl), self._pl_interp)
+
     def _try_stage(self, rg, work, forced, all_host=False) -> _StagedGroup:
-        arena_b = _ArenaBuilder()
+        arena_b = _ArenaBuilder(plk.ARENA_LEAD if self._pl_enabled else 0)
         stages = []
         for name, chunk, desc in work:
             if all_host or name in forced:
@@ -1256,7 +1303,8 @@ class TpuRowGroupReader:
                 "TPU engine supports row groups up to 2 GiB — rewrite the "
                 "file with smaller row groups or use the host ParquetFileReader"
             )
-        cap = self._hwm(("arena",), arena_b.size + 8, minimum=1 << 16)
+        tail = plk.ARENA_TAIL if self._pl_enabled else 8
+        cap = self._hwm(("arena",), arena_b.size + tail, minimum=1 << 16)
         arena = np.zeros(cap, dtype=np.uint8)
         arena_b.fill(arena, self._fill_pool)
         slabb = _I32Builder()

@@ -78,8 +78,14 @@ def _rle_expand_kernel(
     )  # (bw, 1)
 
     def body(r, acc):
+        # literals must be explicit int32: under jax_enable_x64 a weak
+        # Python int traces as an int64 constant, and Mosaic's lowering of
+        # the resulting int64→int32 convert recurses forever
+        zero = jnp.int32(0)
         r_end = run_out_end_ref[r]
-        r_start = jnp.where(r == 0, 0, run_out_end_ref[jnp.maximum(r - 1, 0)])
+        r_start = jnp.where(
+            r == zero, zero, run_out_end_ref[jnp.maximum(r - 1, zero)]
+        )
         in_run = (gidx >= r_start) & (gidx < r_end)
 
         kind = run_kind_ref[r]
@@ -92,8 +98,10 @@ def _rle_expand_kernel(
         # elements decode garbage that ``in_run`` masks away).
         w_base = tile_start - r_start
         bit0 = w_base * bit_width                 # signed, rel. to packed base
-        byte_off = run_byte_ref[r] + (bit0 >> 3)  # arithmetic shift = floor
-        shift = bit0 & 7                          # floor-mod residual (0..7)
+        # arithmetic shift = floor; force int32 — x64 mode otherwise
+        # promotes through weak literals to i64, which DMA indices reject
+        byte_off = (run_byte_ref[r] + (bit0 >> 3)).astype(jnp.int32)
+        shift = (bit0 & 7).astype(jnp.int32)      # floor-mod residual (0..7)
 
         def packed_branch(acc_in):
             copy = pltpu.make_async_copy(
@@ -103,15 +111,19 @@ def _rle_expand_kernel(
             )
             copy.start()
             copy.wait()
-            # Explode window to bits: (W, 8) LSB-first -> flat (1, W*8).
-            wb = win_ref[0, :].reshape(W, 1)
-            bits = (
-                (wb >> jax.lax.broadcasted_iota(jnp.uint8, (W, bits_per_byte), 1))
-                & 1
-            ).astype(jnp.int32).reshape(1, W * bits_per_byte)
-            # Drop the residual shift, regroup to (TILE, bw).
-            usable = bits[:, :].reshape(W * bits_per_byte)
-            seg = jax.lax.dynamic_slice(usable, (shift,), (TILE * bit_width,))
+            # Explode window to bits, int32 and 2-D throughout (Mosaic
+            # handles 32-bit vector ops; uint8 reshapes crash its compiler):
+            # widen (1, W) bytes, broadcast to (8, W), shift-and-mask per
+            # bit plane, transpose to byte-major (W, 8), flatten.
+            w32 = win_ref[0:1, :].astype(jnp.int32)        # (1, W)
+            kq = jax.lax.broadcasted_iota(jnp.int32, (bits_per_byte, W), 0)
+            planes = (jnp.broadcast_to(w32, (bits_per_byte, W)) >> kq) & 1
+            bits = planes.T.reshape(1, W * bits_per_byte)  # byte-major order
+            # Drop the residual shift (0..7) by rotating left, then regroup
+            # to (TILE, bw).  (dynamic_slice with a traced start doesn't
+            # lower in Mosaic; roll does.)
+            rolled = pltpu.roll(bits, -shift, axis=1)
+            seg = jax.lax.slice(rolled, (0, 0), (1, TILE * bit_width))
             fields = seg.reshape(TILE, bit_width)
             vals_flat = jax.lax.dot_general(
                 fields, weights,
@@ -151,25 +163,55 @@ def rle_expand_pallas(
 ) -> jax.Array:
     """Pallas twin of ``bitops.rle_expand`` (+ host-built tile spans).
 
-    ``run_bitbase`` is in bits (byte-aligned by the format); converted to
-    bytes here.  Output is int32[num_values].
+    Standalone convenience wrapper over :func:`rle_expand_pallas_inline`:
+    pads the buffer with the lead/tail slack the inline contract requires
+    and rebases the (byte-aligned) bit offsets.  Output is int32[n].
+    """
+    if bit_width == 0:
+        return jnp.zeros(num_values, dtype=jnp.int32)
+    front = TILE * bit_width // 8 + 8
+    W = _tile_window_bytes(bit_width)
+    data_u8 = jnp.pad(data_u8, (front, W + 16))
+    run_bitbase = run_bitbase + 8 * front
+    return rle_expand_pallas_inline(
+        data_u8, run_out_end, run_kind, run_value, run_bitbase,
+        tile_lo, tile_hi, num_values, bit_width, interpret=interpret,
+    )
+
+
+# Slack the arena must carry for the inline (no-copy) variant: a run
+# starting mid-tile makes the DMA window begin up to TILE*bw/8 bytes before
+# the run's packed base (lead), and the last window reads W bytes past the
+# stream end (tail).  Sized for the max bit width (32).
+ARENA_LEAD = TILE * 32 // 8 + 16    # 8208
+ARENA_TAIL = _tile_window_bytes(32) + 32  # 8240
+
+
+def rle_expand_pallas_inline(
+    arena_u8: jax.Array,
+    run_out_end: jax.Array,
+    run_kind: jax.Array,
+    run_value: jax.Array,
+    run_bitbase: jax.Array,
+    tile_lo: jax.Array,
+    tile_hi: jax.Array,
+    num_values: int,
+    bit_width: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """``rle_expand_pallas`` without the jit wrapper or defensive copy —
+    composable inside a larger jitted program (the fused row-group decode).
+
+    Contract: ``arena_u8`` already carries ≥ ``ARENA_LEAD`` bytes of slack
+    before any packed stream and ≥ ``ARENA_TAIL`` after (the engine's
+    arena builder reserves both), so DMA windows never leave the buffer.
+    ``run_bitbase`` holds absolute *bit* offsets into ``arena_u8``.
     """
     if bit_width == 0:
         return jnp.zeros(num_values, dtype=jnp.int32)
     n_tiles = pl.cdiv(num_values, TILE)
-    padded = n_tiles * TILE
     W = _tile_window_bytes(bit_width)
-
-    # FRONT_PAD: a run starting mid-tile makes the window begin up to
-    # (TILE-1)*bw/8 bytes before the run base; pad the front so byte
-    # offsets never underflow.  Tail: every DMA starts at byte_off ≤
-    # run_byte + run_len*bw/8 ≤ len(buf) (parse guarantees packed data is
-    # in-bounds) and reads W bytes, so W+16 beyond the buffer suffices.
-    front = TILE * bit_width // 8 + 8
-    data_u8 = jnp.pad(data_u8, (front, W + 16))
-
-    run_byte = (run_bitbase // 8).astype(jnp.int32) + front
-
+    run_byte = (run_bitbase // 8).astype(jnp.int32)
     kernel = functools.partial(_rle_expand_kernel, bit_width=bit_width)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=6,
@@ -183,31 +225,43 @@ def rle_expand_pallas(
             pltpu.SemaphoreType.DMA,
         ],
     )
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((n_tiles * _SUB, _LANE), jnp.int32),
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(
-        tile_lo.astype(jnp.int32),
-        tile_hi.astype(jnp.int32),
-        run_out_end.astype(jnp.int32),
-        run_kind.astype(jnp.int32),
-        run_value.astype(jnp.int32),
-        run_byte,
-        data_u8,
-    )
+    # Trace the kernel with x64 off: under jax_enable_x64 Mosaic emits
+    # 64-bit memref indices (tpu.memref_slice rejects i64) and weak-literal
+    # converts that recurse in lowering.  All operands are ≤32-bit anyway.
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n_tiles * _SUB, _LANE), jnp.int32),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(
+            tile_lo.astype(jnp.int32),
+            tile_hi.astype(jnp.int32),
+            run_out_end.astype(jnp.int32),
+            run_kind.astype(jnp.int32),
+            run_value.astype(jnp.int32),
+            run_byte,
+            arena_u8,
+        )
     return out.reshape(-1)[:num_values]
+
+
+def tile_spans_padded(out_end_padded: np.ndarray, num_values: int) -> tuple:
+    """Host-side tile spans over a *padded* plan (pad runs own no output:
+    out_end == total).  Tiles past the real total get empty spans."""
+    n_tiles = -(-num_values // TILE)
+    starts = np.arange(n_tiles, dtype=np.int64) * TILE
+    ends = np.minimum(starts + TILE, num_values)
+    lo = np.searchsorted(out_end_padded, starts, side="right")
+    hi = np.minimum(
+        np.searchsorted(out_end_padded, ends - 1, side="right") + 1,
+        len(out_end_padded),
+    )
+    hi = np.maximum(hi, lo)  # empty span for all-pad tiles
+    return lo.astype(np.int32), hi.astype(np.int32)
 
 
 def tile_spans(run_out_end: np.ndarray, num_values: int) -> tuple:
     """Host-side: for each output tile, the [lo, hi) run-index span that
     intersects it.  O(T log R) searchsorted — tiny."""
-    n_tiles = -(-num_values // TILE)
-    starts = np.arange(n_tiles, dtype=np.int64) * TILE
-    ends = np.minimum(starts + TILE, num_values)
-    # run r covers output [out_end[r-1], out_end[r])
-    lo = np.searchsorted(run_out_end, starts, side="right")
-    hi = np.searchsorted(run_out_end, ends - 1, side="right") + 1
-    hi = np.minimum(hi, len(run_out_end))
-    return lo.astype(np.int32), hi.astype(np.int32)
+    return tile_spans_padded(run_out_end, num_values)

@@ -499,6 +499,17 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
             u8 = _paged_gather(arena, slab, spec)
         vals = _typed(u8, spec.nexp, spec.width, spec.vdtype, spec.f64mode)
         lens = None
+    elif spec.kind == "plain_str":
+        # variable-length strings: host walked the length chains (native);
+        # the device gathers each value's bytes into padded rows
+        starts = lax.slice(slab, (spec.pg_off,), (spec.pg_off + spec.nexp,))
+        lens = lax.slice(slab, (spec.sc_off,), (spec.sc_off + spec.nexp,))
+        lane = jnp.arange(spec.max_len, dtype=jnp.int32)[None, :]
+        pos = starts[:, None] + lane
+        rows = jnp.take(
+            arena, jnp.clip(pos, 0, arena.shape[0] - 1).reshape(-1)
+        ).reshape(spec.nexp, spec.max_len)
+        vals = jnp.where(lane < lens[:, None], rows, jnp.uint8(0))
     elif spec.kind == "bool":
         bits = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp)
         vals = bits.astype(jnp.bool_)
@@ -627,6 +638,10 @@ class _DevStage:
                 self.kind = "bool"
             elif pt in _NP_DTYPE:
                 self.kind = "plain"
+            elif pt == Type.BYTE_ARRAY:
+                self.kind = "plain_str"
+            elif pt in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
+                self.kind = "plain_rows"
             else:
                 raise _Fallback(f"PLAIN device decode for {Type.name(pt)}")
         elif (
@@ -750,11 +765,49 @@ class _DevStage:
                 spec["sc_off"] = slabb.add([self.dict_off])
                 spec["extra_idx"] = -2  # patched by the engine (order of use)
                 spec["_extra_key"] = key
-        elif self.kind == "plain":
-            width = np.dtype(_NP_DTYPE[pt]).itemsize
+        elif self.kind == "plain_str":
+            starts_all = []
+            lens_all = []
+            for p, val_off, nn in zip(self.pages, val_offs, nns):
+                if not nn:
+                    continue
+                region = arena[val_off : p.off + p.size]
+                starts, lengths = _scan_plain_strings(region, nn)
+                if len(starts) != nn:
+                    raise ValueError(
+                        f"PLAIN BYTE_ARRAY page of {self.name}: found "
+                        f"{len(starts)} values, header said {nn}"
+                    )
+                starts_all.append(starts + val_off)
+                lens_all.append(lengths)
+            starts = (
+                np.concatenate(starts_all) if starts_all else np.zeros(0, np.int64)
+            )
+            lengths = (
+                np.concatenate(lens_all) if lens_all else np.zeros(0, np.int64)
+            )
+            if starts.size and starts.max() >= 2**31:
+                raise _ForceHost(self.name)
+            max_len = eng._hwm(
+                ("pstr_len", self.name),
+                max(int(lengths.max()) if lengths.size else 1, 1),
+            )
+            nexp = spec["nexp"]
+            spec["max_len"] = max_len
+            spec["pg_off"] = slabb.add(bitops.pad_to(starts.astype(np.int64), nexp))
+            spec["sc_off"] = slabb.add(bitops.pad_to(lengths.astype(np.int64), nexp))
+        elif self.kind in ("plain", "plain_rows"):
+            if self.kind == "plain_rows":
+                width = desc.type_length if pt == Type.FIXED_LEN_BYTE_ARRAY else 12
+                if not width:
+                    raise _ForceHost(self.name)
+                spec["kind"] = "plain"
+                spec["vdtype"] = "u8rows"
+            else:
+                width = np.dtype(_NP_DTYPE[pt]).itemsize
+                spec["vdtype"] = _VDTYPE_NAME[pt]
+                spec["f64mode"] = eng._f64mode if pt == Type.DOUBLE else ""
             spec["width"] = width
-            spec["vdtype"] = _VDTYPE_NAME[pt]
-            spec["f64mode"] = eng._f64mode if pt == Type.DOUBLE else ""
             # collapse contiguous page streams into one (required v1 pages
             # decompress back-to-back in the arena); only required columns
             # may use the dynamic_slice fast path — optional columns pad
@@ -1036,6 +1089,33 @@ def parse_delta_plan(data_u8: np.ndarray, dtype) -> Optional[dict]:
 def _read_zigzag(data, pos):
     v, pos = e_rle._read_varint(data, pos)
     return (v >> 1) ^ -(v & 1), pos
+
+
+def _scan_plain_strings(region: np.ndarray, count: int):
+    """Walk a PLAIN BYTE_ARRAY length chain → (starts, lengths) int64 arrays
+    (region-relative).  Native single pass when built; Python fallback.
+    Malformed chains raise (never silently mis-decode)."""
+    try:
+        from ..native import binding as _nb
+    except ImportError:
+        _nb = None
+    if _nb is not None and _nb.available():
+        return _nb.plain_ba_scan(region, count)
+    b = region.tobytes()
+    end = len(b)
+    starts = np.zeros(count, np.int64)
+    lengths = np.zeros(count, np.int64)
+    pos = 0
+    for i in range(count):
+        if pos + 4 > end:
+            raise ValueError("PLAIN BYTE_ARRAY stream truncated")
+        ln = int.from_bytes(b[pos : pos + 4], "little")
+        if pos + 4 + ln > end:
+            raise ValueError("PLAIN BYTE_ARRAY value overruns stream")
+        starts[i] = pos + 4
+        lengths[i] = ln
+        pos += 4 + ln
+    return starts, lengths
 
 
 def _count_plain_strings(data_u8) -> int:

@@ -319,3 +319,56 @@ def test_pallas_integrated_decode(tmp_path, monkeypatch):
             np.testing.assert_allclose(got, dense)
     finally:
         host.close()
+
+
+@pytest.mark.parametrize("version", [1, 2])
+def test_plain_strings_device_path(tmp_path, version):
+    """PLAIN (non-dict) BYTE_ARRAY decodes on device: host walks length
+    chains, device gathers padded rows."""
+    rng_l = np.random.default_rng(37)
+    n = 4000
+    words = ["", "a", "hello-world", "x" * 40, "mid"]
+    req = [words[int(i)] for i in rng_l.integers(0, len(words), n)]
+    opt = [None if rng_l.random() < 0.3 else words[int(i)]
+           for i in rng_l.integers(0, len(words), n)]
+    cols = {
+        "s": (types.BYTE_ARRAY, req, False, types.string()),
+        "o": (types.BYTE_ARRAY, opt, True, types.string()),
+    }
+    path = _write(
+        tmp_path, cols,
+        WriterOptions(enable_dictionary=False, page_version=version,
+                      data_page_values=700),
+        n=n,
+    )
+    _check_against_host(path)
+    # confirm the device path was used (no host fallback)
+    t = TpuRowGroupReader(path)
+    sg = t._stage_row_group(0, None)
+    assert all(s.kind == "plain_str" for s in sg.program), [s.kind for s in sg.program]
+    t.close()
+
+
+def test_plain_flba_int96_device_path(tmp_path):
+    """FIXED_LEN_BYTE_ARRAY and INT96 PLAIN decode on device as byte rows."""
+    rng_l = np.random.default_rng(39)
+    n = 1000
+    flba = rng_l.integers(0, 256, (n, 16)).astype(np.uint8)
+    i96 = rng_l.integers(0, 256, (n, 12)).astype(np.uint8)
+    fields = [
+        types.required(types.FIXED_LEN_BYTE_ARRAY).length(16).named("f"),
+        types.required(types.INT96).named("t96"),
+    ]
+    schema = types.message("t", *fields)
+    path = tmp_path / "fl.parquet"
+    with ParquetFileWriter(
+        path, schema, WriterOptions(enable_dictionary=False)
+    ) as w:
+        w.write_columns({"f": flba, "t96": i96})
+    t = TpuRowGroupReader(path)
+    sg = t._stage_row_group(0, None)
+    assert all(s.kind == "plain" and s.vdtype == "u8rows" for s in sg.program)
+    cols_d = t.read_row_group(0)
+    np.testing.assert_array_equal(np.asarray(cols_d["f"].values), flba)
+    np.testing.assert_array_equal(np.asarray(cols_d["t96"].values), i96)
+    t.close()

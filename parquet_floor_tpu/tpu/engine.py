@@ -384,19 +384,25 @@ def _typed(u8, count: int, width: int, vdtype: str, f64mode: str):
     return lax.bitcast_convert_type(rows, _JNP_BY_NAME[vdtype]).reshape(count)
 
 
+def _page_lookup(slab, pg_off: int, p_pad: int, nexp: int):
+    """Map each value id to its owning page via the staged 2-row page
+    table: returns (page base offsets, page index, within-page index,
+    page value count)."""
+    base = lax.slice(slab, (pg_off,), (pg_off + p_pad,))
+    cum = lax.slice(slab, (pg_off + p_pad,), (pg_off + 2 * p_pad,))
+    vid = jnp.arange(nexp, dtype=jnp.int32)
+    pgi = jnp.searchsorted(cum, vid, side="right").astype(jnp.int32)
+    pgi = jnp.minimum(pgi, p_pad - 1)
+    start = jnp.where(pgi == 0, 0, cum[jnp.maximum(pgi - 1, 0)])
+    cnt = jnp.maximum(cum[pgi] - start, 1)
+    return base, pgi, vid - start, cnt
+
+
 def _paged_gather(arena, slab, spec: _ColSpec):
     """Gather value bytes across non-contiguous page streams: value id →
-    owning page (searchsorted over per-page non-null cumsum) → absolute
-    byte position → width-byte gather."""
-    base = lax.slice(slab, (spec.pg_off,), (spec.pg_off + spec.p_pad,))
-    cum = lax.slice(
-        slab, (spec.pg_off + spec.p_pad,), (spec.pg_off + 2 * spec.p_pad,)
-    )
-    vid = jnp.arange(spec.nexp, dtype=jnp.int32)
-    pgi = jnp.searchsorted(cum, vid, side="right").astype(jnp.int32)
-    pgi = jnp.minimum(pgi, spec.p_pad - 1)
-    start = jnp.where(pgi == 0, 0, cum[jnp.maximum(pgi - 1, 0)])
-    bytepos = base[pgi] + (vid - start) * spec.width
+    owning page → absolute byte position → width-byte gather."""
+    base, pgi, within, _ = _page_lookup(slab, spec.pg_off, spec.p_pad, spec.nexp)
+    bytepos = base[pgi] + within * spec.width
     idx = bytepos[:, None] + jnp.arange(spec.width, dtype=jnp.int32)[None, :]
     idx = jnp.clip(idx, 0, arena.shape[0] - 1)
     return jnp.take(arena, idx.reshape(-1)).reshape(spec.nexp * spec.width)
@@ -461,18 +467,7 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         defs = _levels_i32(arena, slab, spec.sc_off + 2, spec.n)
         reps = _levels_i32(arena, slab, spec.sc_off + 3, spec.n)
         return rows, None, lens, defs, reps
-    if spec.kind == "delta":
-        mb = lax.slice(slab, (spec.mb_off,), (spec.mb_off + 3 * spec.m_pad,)).reshape(
-            3, spec.m_pad
-        )
-        first = slab[spec.sc_off]
-        vals = bitops.delta_expand(
-            arena, mb[0], mb[1], mb[2], first, spec.n, spec.vpm,
-            out_dtype=_JNP_BY_NAME[spec.vdtype],
-        )
-        return vals, None, None, None, None
-
-    # --- expansion-based kinds: dict / dict_str / plain / bool ------------
+    # --- expansion-based kinds: dict / dict_str / plain / bool / delta ----
     if spec.kind == "dict":
         idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp, spec.pl_idx)
         # clamped gather, not dynamic_slice: the bucketed capacity may
@@ -513,6 +508,42 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
     elif spec.kind == "bool":
         bits = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp)
         vals = bits.astype(jnp.bool_)
+        lens = None
+    elif spec.kind == "bss":
+        # byte-stream-split: page holds all byte-0s, then byte-1s, …;
+        # regather per element — a strided transpose expressed as a gather
+        base, pgi, within, cnt = _page_lookup(
+            slab, spec.pg_off, spec.p_pad, spec.nexp
+        )
+        k = jnp.arange(spec.width, dtype=jnp.int32)[None, :]
+        bytepos = base[pgi][:, None] + k * cnt[:, None] + within[:, None]
+        u8 = jnp.take(
+            arena, jnp.clip(bytepos, 0, arena.shape[0] - 1).reshape(-1)
+        )
+        vals = _typed(u8, spec.nexp, spec.width, spec.vdtype, spec.f64mode)
+        lens = None
+    elif spec.kind == "delta1":
+        mb = lax.slice(
+            slab, (spec.mb_off,), (spec.mb_off + 3 * spec.m_pad,)
+        ).reshape(3, spec.m_pad)
+        first = slab[spec.sc_off]
+        vals = bitops.delta_expand(
+            arena, mb[0], mb[1], mb[2], first, spec.nexp, spec.vpm,
+            out_dtype=_JNP_BY_NAME[spec.vdtype],
+        )
+        lens = None
+    elif spec.kind == "delta":
+        mb = lax.slice(
+            slab, (spec.mb_off,), (spec.mb_off + 4 * spec.m_pad,)
+        ).reshape(4, spec.m_pad)
+        pgt = lax.slice(
+            slab, (spec.pg_off,), (spec.pg_off + 3 * spec.p_pad,)
+        ).reshape(3, spec.p_pad)
+        v32 = bitops.delta_expand_paged(
+            arena, mb[0], mb[1], mb[2], mb[3], pgt[0], pgt[1], pgt[2],
+            spec.nexp,
+        )
+        vals = v32.astype(_JNP_BY_NAME[spec.vdtype])
         lens = None
     else:  # pragma: no cover - program construction guards this
         raise ValueError(f"unknown column kind {spec.kind!r}")
@@ -644,13 +675,15 @@ class _DevStage:
                 self.kind = "plain_rows"
             else:
                 raise _Fallback(f"PLAIN device decode for {Type.name(pt)}")
-        elif (
-            encs == {Encoding.DELTA_BINARY_PACKED}
-            and len(pages) == 1
-            and max_def == 0
-            and pt in (Type.INT32, Type.INT64)
+        elif encs == {Encoding.DELTA_BINARY_PACKED} and pt in (
+            Type.INT32, Type.INT64,
         ):
             self.kind = "delta"
+        elif encs == {Encoding.BYTE_STREAM_SPLIT} and (
+            pt in _NP_DTYPE
+            or (pt == Type.FIXED_LEN_BYTE_ARRAY and desc.type_length)
+        ):
+            self.kind = "bss"
         else:
             raise _Fallback(f"encodings {sorted(encs)}")
 
@@ -820,12 +853,21 @@ class _DevStage:
                 p_pad = 1
                 page_tbl = np.array([val_offs[0], total_nn], dtype=np.int64)
             else:
-                p_pad = eng._hwm(("pages", self.name), len(self.pages), minimum=4)
-                base = bitops.pad_to(np.asarray(val_offs, np.int64), p_pad)
-                cum = bitops.pad_to(
-                    np.cumsum(np.asarray(nns, np.int64)), p_pad, fill=total_nn
+                page_tbl, p_pad = _page_table(
+                    val_offs, nns, total_nn, eng, self.name
                 )
-                page_tbl = np.concatenate([base, cum])
+            spec["pg_off"] = slabb.add(page_tbl)
+            spec["p_pad"] = p_pad
+        elif self.kind == "bss":
+            if pt in _NP_DTYPE:
+                width = np.dtype(_NP_DTYPE[pt]).itemsize
+                spec["vdtype"] = _VDTYPE_NAME[pt]
+                spec["f64mode"] = eng._f64mode if pt == Type.DOUBLE else ""
+            else:
+                width = desc.type_length
+                spec["vdtype"] = "u8rows"
+            spec["width"] = width
+            page_tbl, p_pad = _page_table(val_offs, nns, total_nn, eng, self.name)
             spec["pg_off"] = slabb.add(page_tbl)
             spec["p_pad"] = p_pad
         elif self.kind == "bool":
@@ -840,12 +882,15 @@ class _DevStage:
             )
             spec["r_idx"] = r_idx
             spec["vdtype"] = "bool"
-        elif self.kind == "delta":
+        elif self.kind == "delta" and len(self.pages) == 1 and max_def == 0:
+            # single required page: the miniblock id is a plain division —
+            # cheaper on device than the segmented searchsorted form
             val_off = val_offs[0]
             end = self.pages[0].off + self.pages[0].size
             plan = parse_delta_plan(arena[val_off:end], _NP_DTYPE[pt])
             if plan is None:
                 raise _ForceHost(self.name)
+            spec["kind"] = "delta1"
             m_pad = eng._hwm(("mb", self.name), len(plan["mb_bw"]), minimum=4)
             mb = np.zeros((3, m_pad), dtype=np.int64)
             k = len(plan["mb_bitbase"])
@@ -859,6 +904,55 @@ class _DevStage:
             spec["vpm"] = plan["values_per_miniblock"]
             spec["vdtype"] = _VDTYPE_NAME[pt]
             spec["sc_off"] = slabb.add([plan["first_value"]])
+        elif self.kind == "delta":
+            mb_start: List[int] = []
+            mb_bitbase: List[int] = []
+            mb_bw: List[int] = []
+            mb_min: List[int] = []
+            pg_first: List[int] = []
+            pg_start: List[int] = []
+            running = 0
+            live_nns: List[int] = []
+            for p, val_off, nn in zip(self.pages, val_offs, nns):
+                if not nn:
+                    # all-null page: no value section to parse
+                    continue
+                end = p.off + p.size
+                plan = parse_delta_plan(arena[val_off:end], _NP_DTYPE[pt])
+                if plan is None or plan["total"] != nn:
+                    raise _ForceHost(self.name)
+                vpm = plan["values_per_miniblock"]
+                pg_first.append(plan["first_value"])
+                pg_start.append(running)
+                for m in range(len(plan["mb_bw"])):
+                    mb_start.append(running + 1 + m * vpm)
+                    mb_bitbase.append(int(plan["mb_bitbase"][m]) + val_off * 8)
+                    mb_bw.append(int(plan["mb_bw"][m]))
+                    mb_min.append(int(plan["mb_min_delta"][m]))
+                running += nn
+                live_nns.append(nn)
+            m_pad = eng._hwm(("mb", self.name), max(len(mb_bw), 1), minimum=4)
+            mb = np.zeros((4, m_pad), dtype=np.int64)
+            mb[0] = 2**31 - 1  # out-start sentinel for pad miniblocks
+            k = len(mb_bw)
+            if k:
+                mb[0, :k] = mb_start
+                mb[1, :k] = mb_bitbase
+                mb[2, :k] = mb_bw
+                mb[3, :k] = mb_min
+            if mb[1].max(initial=0) >= 2**31:
+                raise _ForceHost(self.name)
+            spec["mb_off"] = slabb.add(mb)
+            spec["m_pad"] = m_pad
+            p_pad = eng._hwm(("pages", self.name), len(self.pages), minimum=4)
+            pgt = np.zeros((3, p_pad), dtype=np.int64)
+            pgt[0, : len(pg_start)] = pg_start
+            pgt[1, : len(pg_first)] = pg_first
+            pgt[2] = total_nn
+            pgt[2, : len(live_nns)] = np.cumsum(live_nns)
+            spec["pg_off"] = slabb.add(pgt)
+            spec["p_pad"] = p_pad
+            spec["vdtype"] = _VDTYPE_NAME[pt]
         return spec
 
 
@@ -1089,6 +1183,17 @@ def parse_delta_plan(data_u8: np.ndarray, dtype) -> Optional[dict]:
 def _read_zigzag(data, pos):
     v, pos = e_rle._read_varint(data, pos)
     return (v >> 1) ^ -(v & 1), pos
+
+
+def _page_table(val_offs, nns, total_nn: int, eng, name: str):
+    """Staged 2-row page table (base offsets; value cumsum) padded to the
+    column's page-count bucket — the host half of ``_page_lookup``."""
+    p_pad = eng._hwm(("pages", name), len(val_offs), minimum=4)
+    base = bitops.pad_to(np.asarray(val_offs, np.int64), p_pad)
+    cum = bitops.pad_to(
+        np.cumsum(np.asarray(nns, np.int64)), p_pad, fill=total_nn
+    )
+    return np.concatenate([base, cum]), p_pad
 
 
 def _scan_plain_strings(region: np.ndarray, count: int):

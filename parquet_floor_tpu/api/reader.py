@@ -160,6 +160,45 @@ class ParquetReader:
     def __exit__(self, *exc):
         self.close()
 
+    # -- checkpoint / resume (SURVEY.md §5: the resumable row-group cursor
+    # the reference's streaming structure implies but never exposes) -------
+
+    def state(self) -> dict:
+        """Serializable scan position: resume a later reader here with
+        :meth:`restore`.  Valid between rows; cheap (two ints)."""
+        if self._cursors is None or self._row >= self._rg_rows:
+            # next row comes from the next group boundary
+            return {"row_group": self._rg_index, "row_in_group": 0}
+        return {"row_group": self._rg_index - 1, "row_in_group": self._row}
+
+    def restore(self, state: dict) -> "ParquetReader":
+        """Position this reader at a previously saved :meth:`state`.
+
+        The target row group is re-decoded (row groups are the atomic
+        decode unit); rows before ``row_in_group`` are skipped O(1).
+        """
+        rg = int(state["row_group"])
+        row = int(state["row_in_group"])
+        n_groups = len(self._reader.row_groups)
+        if rg < 0 or rg > n_groups:
+            raise ValueError(f"row_group {rg} outside file with {n_groups}")
+        if row < 0 or (rg == n_groups and row):
+            raise ValueError(f"bad row_in_group {row} for row_group {rg}")
+        self._rg_index = rg
+        self._cursors = None
+        self._rg_rows = 0
+        self._finished = False
+        self._row = 0
+        if rg < n_groups and row:
+            if not self._advance_row_group():
+                raise ValueError("saved state points past end of file")
+            if row > self._rg_rows:
+                raise ValueError(
+                    f"row_in_group {row} exceeds group of {self._rg_rows}"
+                )
+            self._row = row
+        return self
+
     # -- batch access (native win; no reference counterpart) ---------------
 
     def read_row_group_batch(self, index: int) -> RowGroupBatch:

@@ -684,6 +684,10 @@ class _DevStage:
             or (pt == Type.FIXED_LEN_BYTE_ARRAY and desc.type_length)
         ):
             self.kind = "bss"
+        elif encs == {Encoding.DELTA_LENGTH_BYTE_ARRAY} and pt == Type.BYTE_ARRAY:
+            # host decodes the (vectorized, tiny) delta length stream; the
+            # byte gather then rides the plain_str device machinery
+            self.kind = "dlba"
         else:
             raise _Fallback(f"encodings {sorted(encs)}")
 
@@ -798,19 +802,31 @@ class _DevStage:
                 spec["sc_off"] = slabb.add([self.dict_off])
                 spec["extra_idx"] = -2  # patched by the engine (order of use)
                 spec["_extra_key"] = key
-        elif self.kind == "plain_str":
+        elif self.kind in ("plain_str", "dlba"):
+            from ..format.encodings import delta as e_delta
+
             starts_all = []
             lens_all = []
             for p, val_off, nn in zip(self.pages, val_offs, nns):
                 if not nn:
                     continue
-                region = arena[val_off : p.off + p.size]
-                starts, lengths = _scan_plain_strings(region, nn)
-                if len(starts) != nn:
-                    raise ValueError(
-                        f"PLAIN BYTE_ARRAY page of {self.name}: found "
-                        f"{len(starts)} values, header said {nn}"
+                if self.kind == "dlba":
+                    lengths, data_pos = e_delta.decode_delta_binary_packed(
+                        arena[val_off : p.off + p.size].tobytes()
                     )
+                    if len(lengths) != nn:
+                        raise _ForceHost(self.name)
+                    starts = np.zeros(nn, np.int64)
+                    np.cumsum(lengths[:-1], out=starts[1:])
+                    starts += data_pos
+                else:
+                    region = arena[val_off : p.off + p.size]
+                    starts, lengths = _scan_plain_strings(region, nn)
+                    if len(starts) != nn:
+                        raise ValueError(
+                            f"PLAIN BYTE_ARRAY page of {self.name}: found "
+                            f"{len(starts)} values, header said {nn}"
+                        )
                 starts_all.append(starts + val_off)
                 lens_all.append(lengths)
             starts = (
@@ -826,6 +842,7 @@ class _DevStage:
                 max(int(lengths.max()) if lengths.size else 1, 1),
             )
             nexp = spec["nexp"]
+            spec["kind"] = "plain_str"  # dlba shares the device string path
             spec["max_len"] = max_len
             spec["pg_off"] = slabb.add(bitops.pad_to(starts.astype(np.int64), nexp))
             spec["sc_off"] = slabb.add(bitops.pad_to(lengths.astype(np.int64), nexp))
@@ -924,22 +941,28 @@ class _DevStage:
                 vpm = plan["values_per_miniblock"]
                 pg_first.append(plan["first_value"])
                 pg_start.append(running)
-                for m in range(len(plan["mb_bw"])):
-                    mb_start.append(running + 1 + m * vpm)
-                    mb_bitbase.append(int(plan["mb_bitbase"][m]) + val_off * 8)
-                    mb_bw.append(int(plan["mb_bw"][m]))
-                    mb_min.append(int(plan["mb_min_delta"][m]))
+                k_mb = len(plan["mb_bw"])
+                mb_start.append(
+                    running + 1 + np.arange(k_mb, dtype=np.int64) * vpm
+                )
+                mb_bitbase.append(plan["mb_bitbase"] + val_off * 8)
+                mb_bw.append(plan["mb_bw"])
+                mb_min.append(plan["mb_min_delta"])
                 running += nn
                 live_nns.append(nn)
-            m_pad = eng._hwm(("mb", self.name), max(len(mb_bw), 1), minimum=4)
+            c_start = np.concatenate(mb_start) if mb_start else np.zeros(0, np.int64)
+            c_bitbase = np.concatenate(mb_bitbase) if mb_bitbase else np.zeros(0, np.int64)
+            c_bw = np.concatenate(mb_bw) if mb_bw else np.zeros(0, np.int64)
+            c_min = np.concatenate(mb_min) if mb_min else np.zeros(0, np.int64)
+            m_pad = eng._hwm(("mb", self.name), max(len(c_bw), 1), minimum=4)
             mb = np.zeros((4, m_pad), dtype=np.int64)
             mb[0] = 2**31 - 1  # out-start sentinel for pad miniblocks
-            k = len(mb_bw)
+            k = len(c_bw)
             if k:
-                mb[0, :k] = mb_start
-                mb[1, :k] = mb_bitbase
-                mb[2, :k] = mb_bw
-                mb[3, :k] = mb_min
+                mb[0, :k] = c_start
+                mb[1, :k] = c_bitbase
+                mb[2, :k] = c_bw
+                mb[3, :k] = c_min
             if mb[1].max(initial=0) >= 2**31:
                 raise _ForceHost(self.name)
             spec["mb_off"] = slabb.add(mb)

@@ -439,3 +439,29 @@ def test_delta_all_null_page(tmp_path):
         n=300,
     )
     _check_against_host(path)
+
+
+def test_delta_length_byte_array_device(tmp_path):
+    """DELTA_LENGTH_BYTE_ARRAY strings: host decodes the length stream,
+    device gathers the bytes — verified against pyarrow-written files."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng_l = np.random.default_rng(53)
+    n = 3000
+    vals = ["w" * int(k) + str(int(k)) for k in rng_l.integers(0, 30, n)]
+    opt = [None if rng_l.random() < 0.3 else v for v in vals]
+    path = str(tmp_path / "dl.parquet")
+    pq.write_table(
+        pa.table({"s": vals, "o": opt}), path,
+        use_dictionary=False, column_encoding={"s": "DELTA_LENGTH_BYTE_ARRAY",
+                                               "o": "DELTA_LENGTH_BYTE_ARRAY"},
+        use_byte_stream_split=False, version="2.6",
+    )
+    t = TpuRowGroupReader(path)
+    sg = t._stage_row_group(0, None)
+    assert all(s.kind == "plain_str" for s in sg.program), [
+        s.kind for s in sg.program
+    ]
+    t.close()
+    _check_against_host(path)

@@ -61,18 +61,16 @@ class _ChunkStats:
 
 
 def _chunk_stats(rg, name: str) -> Optional[_ChunkStats]:
-    for chunk in rg.columns or []:
-        path = chunk.meta_data.path_in_schema
-        if path[0] != name and ".".join(path) != name:
-            continue
-        st = chunk.meta_data.statistics
-        if st is None:
-            return None
-        pt = chunk.meta_data.type
-        mn = _decode_stat(pt, st.min_value if st.min_value is not None else st.min)
-        mx = _decode_stat(pt, st.max_value if st.max_value is not None else st.max)
-        return _ChunkStats(mn, mx, st.null_count, chunk.meta_data.num_values)
-    return None
+    chunk = _find_chunk(rg, name)
+    if chunk is None:
+        return None
+    st = chunk.meta_data.statistics
+    if st is None:
+        return None
+    pt = chunk.meta_data.type
+    mn = _decode_stat(pt, st.min_value if st.min_value is not None else st.min)
+    mx = _decode_stat(pt, st.max_value if st.max_value is not None else st.max)
+    return _ChunkStats(mn, mx, st.null_count, chunk.meta_data.num_values)
 
 
 def _coerce(value, other):
@@ -94,6 +92,20 @@ class Predicate:
             i for i, rg in enumerate(reader.row_groups) if self.may_match(rg)
         ]
 
+    def row_ranges(self, reader, rg_index: int) -> List[tuple]:
+        """Half-open row ranges within a row group that may match, pruned
+        with the page indexes (ColumnIndex/OffsetIndex) when present.
+
+        Conservative like :meth:`row_groups`: rows are dropped only when
+        page statistics *prove* they cannot match; a column without page
+        indexes contributes the whole group."""
+        rg = reader.row_groups[rg_index]
+        n = int(rg.num_rows or 0)
+        return _normalize(self._ranges(reader, rg, n), n)
+
+    def _ranges(self, reader, rg, n: int) -> List[tuple]:
+        return [(0, n)]
+
     def __and__(self, other: "Predicate") -> "Predicate":
         return _And(self, other)
 
@@ -109,6 +121,37 @@ class Predicate:
         )
 
 
+def _normalize(ranges: List[tuple], n: int) -> List[tuple]:
+    """Clip to [0, n), sort, and merge overlapping/adjacent ranges."""
+    clipped = sorted(
+        (max(0, int(a)), min(n, int(b))) for a, b in ranges if b > a
+    )
+    out: List[tuple] = []
+    for a, b in clipped:
+        if a >= b:
+            continue
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _intersect(xs: List[tuple], ys: List[tuple]) -> List[tuple]:
+    out = []
+    i = j = 0
+    while i < len(xs) and j < len(ys):
+        a = max(xs[i][0], ys[j][0])
+        b = min(xs[i][1], ys[j][1])
+        if a < b:
+            out.append((a, b))
+        if xs[i][1] <= ys[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
 @dataclass(frozen=True)
 class _And(Predicate):
     a: Predicate
@@ -116,6 +159,12 @@ class _And(Predicate):
 
     def may_match(self, rg) -> bool:
         return self.a.may_match(rg) and self.b.may_match(rg)
+
+    def _ranges(self, reader, rg, n):
+        return _intersect(
+            _normalize(self.a._ranges(reader, rg, n), n),
+            _normalize(self.b._ranges(reader, rg, n), n),
+        )
 
 
 @dataclass(frozen=True)
@@ -125,6 +174,62 @@ class _Or(Predicate):
 
     def may_match(self, rg) -> bool:
         return self.a.may_match(rg) or self.b.may_match(rg)
+
+    def _ranges(self, reader, rg, n):
+        return self.a._ranges(reader, rg, n) + self.b._ranges(reader, rg, n)
+
+
+def _cmp_may_match(op: str, value, mn, mx, null_count) -> bool:
+    """Core three-valued comparison against [mn, mx] statistics."""
+    v = _coerce(value, mn if mn is not None else mx)
+    try:
+        if op == "==":
+            if mn is not None and v < mn:
+                return False
+            if mx is not None and v > mx:
+                return False
+            return True
+        if op == "!=":
+            # ruled out only when every row PROVABLY equals v: bounds pin
+            # a single value and the null count is known to be zero (an
+            # absent null count may hide matching nulls)
+            if mn is not None and mx is not None and mn == mx == v and null_count == 0:
+                return False
+            return True
+        if op == "<":
+            return mn is None or mn < v
+        if op == "<=":
+            return mn is None or mn <= v
+        if op == ">":
+            return mx is None or mx > v
+        if op == ">=":
+            return mx is None or mx >= v
+    except TypeError:
+        return True  # incomparable literal: keep
+    return True
+
+
+def _find_chunk(rg, name: str):
+    for chunk in rg.columns or []:
+        path = chunk.meta_data.path_in_schema
+        if path[0] == name or ".".join(path) == name:
+            return chunk
+    return None
+
+
+def _page_rows(reader, rg, n: int, name: str):
+    """(chunk, column_index, per-page (row_start, row_end)) or None when
+    the page indexes are unavailable."""
+    chunk = _find_chunk(rg, name)
+    if chunk is None:
+        return None
+    ci = reader.read_column_index(chunk)
+    oi = reader.read_offset_index(chunk)
+    if ci is None or oi is None or not oi.page_locations:
+        return None
+    firsts = [int(pl.first_row_index or 0) for pl in oi.page_locations]
+    ends = firsts[1:] + [n]
+    return chunk, ci, list(zip(firsts, ends))
 
 
 @dataclass(frozen=True)
@@ -137,34 +242,32 @@ class _Cmp(Predicate):
         st = _chunk_stats(rg, self.name)
         if st is None:
             return True
-        v = _coerce(self.value, st.min if st.min is not None else st.max)
-        mn, mx = st.min, st.max
-        try:
-            if self.op == "==":
-                if mn is not None and v < mn:
-                    return False
-                if mx is not None and v > mx:
-                    return False
-                return True
-            if self.op == "!=":
-                # ruled out only when every row equals v exactly
-                if (
-                    mn is not None and mx is not None and mn == mx == v
-                    and not st.null_count
-                ):
-                    return False
-                return True
-            if self.op == "<":
-                return mn is None or mn < v
-            if self.op == "<=":
-                return mn is None or mn <= v
-            if self.op == ">":
-                return mx is None or mx > v
-            if self.op == ">=":
-                return mx is None or mx >= v
-        except TypeError:
-            return True  # incomparable literal: keep the group
-        return True
+        return _cmp_may_match(self.op, self.value, st.min, st.max, st.null_count)
+
+    def _ranges(self, reader, rg, n):
+        pr = _page_rows(reader, rg, n, self.name)
+        if pr is None:
+            return [(0, n)]
+        chunk, ci, pages = pr
+        pt = chunk.meta_data.type
+        out = []
+        for i, (a, b) in enumerate(pages):
+            if ci.null_pages and i < len(ci.null_pages) and ci.null_pages[i]:
+                # page holds only nulls: no ordered comparison can match,
+                # but "!=" keeps null rows (chunk-level convention)
+                if self.op == "!=":
+                    out.append((a, b))
+                continue
+            mn = _decode_stat(pt, ci.min_values[i] or None) if ci.min_values else None
+            mx = _decode_stat(pt, ci.max_values[i] or None) if ci.max_values else None
+            nc = (
+                ci.null_counts[i]
+                if ci.null_counts and i < len(ci.null_counts)
+                else None
+            )
+            if _cmp_may_match(self.op, self.value, mn, mx, nc):
+                out.append((a, b))
+        return out
 
 
 @dataclass(frozen=True)
@@ -181,6 +284,29 @@ class _IsNull(Predicate):
         if st.num_values is None:
             return True
         return st.null_count < st.num_values
+
+    def _ranges(self, reader, rg, n):
+        pr = _page_rows(reader, rg, n, self.name)
+        if pr is None:
+            return [(0, n)]
+        _, ci, pages = pr
+        out = []
+        for i, (a, b) in enumerate(pages):
+            null_page = bool(
+                ci.null_pages and i < len(ci.null_pages) and ci.null_pages[i]
+            )
+            nc = (
+                ci.null_counts[i]
+                if ci.null_counts and i < len(ci.null_counts)
+                else None
+            )
+            if self.want_null:
+                keep = null_page or nc is None or nc > 0
+            else:
+                keep = not null_page
+            if keep:
+                out.append((a, b))
+        return out
 
 
 class Col:

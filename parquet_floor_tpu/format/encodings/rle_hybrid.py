@@ -204,6 +204,30 @@ def decode_length_prefixed(data, num_values: int, bit_width: int, pos: int = 0):
     return values, pos + 4 + ln
 
 
+def decode_bit_packed_legacy(data, num_values: int, bit_width: int, pos: int = 0):
+    """Deprecated BIT_PACKED level encoding (format spec: "bit-packed only",
+    packed **from the most significant bit**, no length prefix).
+
+    Only ever appears for def/rep levels in very old v1 files; size is
+    exactly ``ceil(num_values * bit_width / 8)`` bytes.
+    Returns ``(values: uint32 ndarray, end_pos)``.
+    """
+    if bit_width == 0:
+        return np.zeros(num_values, dtype=np.uint32), pos
+    nbytes = (num_values * bit_width + 7) // 8
+    buf = np.frombuffer(data, np.uint8) if not isinstance(data, np.ndarray) else data
+    chunk = np.asarray(buf[pos : pos + nbytes], dtype=np.uint8)
+    if len(chunk) < nbytes:
+        raise ValueError("BIT_PACKED level section truncated")
+    # MSB-first: explode each byte high bit first, regroup, weigh MSB-first
+    bits = (
+        (chunk[:, None] >> np.arange(7, -1, -1, dtype=np.uint8)) & np.uint8(1)
+    ).reshape(-1)
+    bits = bits[: num_values * bit_width].reshape(num_values, bit_width)
+    weights = (1 << np.arange(bit_width - 1, -1, -1)).astype(np.uint32)
+    return (bits.astype(np.uint32) * weights).sum(axis=1, dtype=np.uint32), pos + nbytes
+
+
 def encode_rle_hybrid(values: np.ndarray, bit_width: int) -> bytes:
     """Encode values as an unframed hybrid run stream.
 

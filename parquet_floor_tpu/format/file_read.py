@@ -189,3 +189,35 @@ class ParquetFileReader:
         start, length = _chunk_byte_range(meta)
         raw = self.source.read_at(start, length)
         return pg.split_pages(raw, meta.num_values)
+
+    # -- page indexes ------------------------------------------------------
+
+    def read_column_index(self, chunk: ColumnChunk):
+        """The chunk's ColumnIndex (per-page min/max/null stats), or None
+        when the writer emitted none.  Parsed once per chunk (cached)."""
+        from .parquet_thrift import ColumnIndex
+
+        return self._page_index(
+            chunk.column_index_offset, chunk.column_index_length, ColumnIndex
+        )
+
+    def read_offset_index(self, chunk: ColumnChunk):
+        """The chunk's OffsetIndex (per-page locations/first rows), or None
+        when the writer emitted none.  Parsed once per chunk (cached)."""
+        from .parquet_thrift import OffsetIndex
+
+        return self._page_index(
+            chunk.offset_index_offset, chunk.offset_index_length, OffsetIndex
+        )
+
+    def _page_index(self, offset, length, struct_cls):
+        if offset is None or not length:
+            return None
+        cache = getattr(self, "_pgidx_cache", None)
+        if cache is None:
+            cache = self._pgidx_cache = {}
+        key = (offset, length)
+        if key not in cache:
+            raw = self.source.read_at(offset, length)
+            cache[key], _ = struct_cls.from_bytes(raw)
+        return cache[key]

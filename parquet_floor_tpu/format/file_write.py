@@ -28,13 +28,16 @@ from .encodings.plain import ByteArrayColumn
 from .metadata import MAGIC, serialize_footer
 from .parquet_thrift import (
     ColumnChunk,
+    ColumnIndex,
     ColumnMetaData,
     ColumnOrder,
     CompressionCodec,
     Encoding,
     FileMetaData,
     KeyValue,
+    OffsetIndex,
     PageEncodingStats,
+    PageLocation,
     PageType,
     RowGroup,
     SortingColumn,
@@ -224,6 +227,13 @@ class _ColumnChunkWriter:
         # together by splitting only where rep_level == 0.
         positions = self._page_boundaries(data, per_page)
         vi = 0  # running non-null value index
+        row_cursor = 0
+        index_ok = True
+        idx_loc: List[PageLocation] = []
+        idx_null_pages: List[bool] = []
+        idx_mins: List[bytes] = []
+        idx_maxs: List[bytes] = []
+        idx_nulls: List[int] = []
         for (lo, hi) in positions:
             dl = data.def_levels[lo:hi] if data.def_levels is not None else None
             rl = data.rep_levels[lo:hi] if data.rep_levels is not None else None
@@ -265,12 +275,29 @@ class _ColumnChunkWriter:
                 )
             if data_page_offset is None:
                 data_page_offset = sink.pos
+            page_off = sink.pos
             hdr = ep.header.to_bytes()
             sink.write(hdr)
             sink.write(ep.body)
             total_uncompressed += len(hdr) + ep.header.uncompressed_page_size
             total_compressed += len(hdr) + len(ep.body)
             n_pages += 1
+            if opt.write_statistics:
+                idx_loc.append(PageLocation(
+                    offset=page_off,
+                    compressed_page_size=len(hdr) + len(ep.body),
+                    first_row_index=row_cursor,
+                ))
+                idx_null_pages.append(present == 0)
+                if present > 0 and mm is None:
+                    # e.g. an all-NaN float page: the spec requires valid
+                    # bounds on every non-null page, so this chunk cannot
+                    # carry a ColumnIndex at all
+                    index_ok = False
+                idx_mins.append(mm[0] if mm is not None else b"")
+                idx_maxs.append(mm[1] if mm is not None else b"")
+                idx_nulls.append((hi - lo) - present)
+            row_cursor += num_rows
 
         page_type = PageType.DATA_PAGE_V2 if opt.page_version == 2 else PageType.DATA_PAGE
         encoding_stats.append(
@@ -295,7 +322,26 @@ class _ColumnChunkWriter:
             if chunk_mm is not None:
                 st.min_value, st.max_value = chunk_mm
             meta.statistics = st
-        return ColumnChunk(file_offset=first_offset, meta_data=meta)
+        chunk = ColumnChunk(file_offset=first_offset, meta_data=meta)
+        if opt.write_statistics and idx_loc:
+            # stashed for ParquetFileWriter.close(), which serializes the
+            # page indexes between the last row group and the footer and
+            # patches the offsets into this chunk (parquet-mr layout).
+            # ColumnIndex is dropped when some non-null page has no valid
+            # bounds (all-NaN pages); the OffsetIndex alone remains valid.
+            ci = (
+                ColumnIndex(
+                    null_pages=idx_null_pages,
+                    min_values=idx_mins,
+                    max_values=idx_maxs,
+                    boundary_order=0,  # UNORDERED is always valid
+                    null_counts=idx_nulls,
+                )
+                if index_ok
+                else None
+            )
+            chunk._pftpu_page_index = (ci, OffsetIndex(page_locations=idx_loc))
+        return chunk
 
     def _page_boundaries(self, data: ColumnData, per_page: int):
         n = data.num_values
@@ -420,6 +466,30 @@ class ParquetFileWriter:
     def close(self) -> FileMetaData:
         if self._closed:
             return self._file_meta
+        # page indexes: all ColumnIndex structs, then all OffsetIndex
+        # structs, between the last row group and the footer (parquet-mr
+        # layout); offsets patch into each ColumnChunk
+        indexed = [
+            chunk
+            for rg in self._row_groups
+            for chunk in (rg.columns or [])
+            if getattr(chunk, "_pftpu_page_index", None) is not None
+        ]
+        for chunk in indexed:
+            ci, _ = chunk._pftpu_page_index
+            if ci is None:
+                continue
+            data = ci.to_bytes()
+            chunk.column_index_offset = self.sink.pos
+            chunk.column_index_length = len(data)
+            self.sink.write(data)
+        for chunk in indexed:
+            _, oi = chunk._pftpu_page_index
+            data = oi.to_bytes()
+            chunk.offset_index_offset = self.sink.pos
+            chunk.offset_index_length = len(data)
+            self.sink.write(data)
+            del chunk._pftpu_page_index
         fm = FileMetaData(
             version=2,
             schema=self.schema.to_thrift(),

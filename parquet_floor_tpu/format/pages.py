@@ -180,22 +180,29 @@ def decode_data_page_v1(
     pos = 0
     rep_levels = None
     def_levels = None
+    def _levels(enc, max_level, what):
+        nonlocal pos
+        bw = e_rle.min_bit_width(max_level)
+        if enc in (Encoding.RLE, None):
+            levels, pos = e_rle.decode_length_prefixed(data, n, bw, pos)
+        elif enc == Encoding.BIT_PACKED:  # deprecated legacy encoding
+            levels, pos = e_rle.decode_bit_packed_legacy(data, n, bw, pos)
+        else:
+            raise ValueError(
+                f"unsupported {what} level encoding {Encoding.name(enc)}"
+            )
+        return levels
+
     if column.max_repetition_level > 0:
-        if h.repetition_level_encoding not in (Encoding.RLE, None):
-            raise ValueError(
-                f"unsupported repetition level encoding "
-                f"{Encoding.name(h.repetition_level_encoding)}"
-            )
-        bw = e_rle.min_bit_width(column.max_repetition_level)
-        rep_levels, pos = e_rle.decode_length_prefixed(data, n, bw, pos)
+        rep_levels = _levels(
+            h.repetition_level_encoding, column.max_repetition_level,
+            "repetition",
+        )
     if column.max_definition_level > 0:
-        if h.definition_level_encoding not in (Encoding.RLE, None):
-            raise ValueError(
-                f"unsupported definition level encoding "
-                f"{Encoding.name(h.definition_level_encoding)}"
-            )
-        bw = e_rle.min_bit_width(column.max_definition_level)
-        def_levels, pos = e_rle.decode_length_prefixed(data, n, bw, pos)
+        def_levels = _levels(
+            h.definition_level_encoding, column.max_definition_level,
+            "definition",
+        )
         n_non_null = int(np.count_nonzero(def_levels == column.max_definition_level))
     else:
         n_non_null = n

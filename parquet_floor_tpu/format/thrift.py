@@ -270,12 +270,15 @@ def _compact_type_of(ftype) -> int:
     raise TypeError(f"bad thrift field type {ftype!r}")
 
 
-def _read_value(reader: CompactReader, ftype, ctype: int):
+def _read_value(reader: CompactReader, ftype, ctype: int,
+                in_container: bool = False):
     if isinstance(ftype, TType):
         if ftype is T_BOOL:
-            if ctype in (CT_BOOLEAN_TRUE, CT_BOOLEAN_FALSE):
+            # at field position the value lives in the header ctype; as a
+            # container element it occupies one payload byte (same split
+            # CompactReader.skip makes)
+            if not in_container and ctype in (CT_BOOLEAN_TRUE, CT_BOOLEAN_FALSE):
                 return ctype == CT_BOOLEAN_TRUE
-            # bool inside a list is a full byte
             return reader.read_byte() == CT_BOOLEAN_TRUE
         if ftype is T_BYTE:
             b = reader.read_byte()
@@ -291,7 +294,10 @@ def _read_value(reader: CompactReader, ftype, ctype: int):
         raise ThriftDecodeError(f"unhandled scalar type {ftype}")
     if isinstance(ftype, TList):
         size, elem_ctype = reader.read_list_header()
-        return [_read_value(reader, ftype.elem, elem_ctype) for _ in range(size)]
+        return [
+            _read_value(reader, ftype.elem, elem_ctype, in_container=True)
+            for _ in range(size)
+        ]
     if isinstance(ftype, type) and issubclass(ftype, ThriftStruct):
         return ftype.read(reader)
     raise ThriftDecodeError(f"unhandled field type {ftype!r}")

@@ -811,11 +811,21 @@ class _DevStage:
                 if not nn:
                     continue
                 if self.kind == "dlba":
+                    region_size = p.off + p.size - val_off
                     lengths, data_pos = e_delta.decode_delta_binary_packed(
                         arena[val_off : p.off + p.size].tobytes()
                     )
                     if len(lengths) != nn:
                         raise _ForceHost(self.name)
+                    total_bytes = int(lengths.sum())
+                    if (
+                        (nn and int(lengths.min()) < 0)
+                        or data_pos + total_bytes > region_size
+                    ):
+                        raise ValueError(
+                            f"DELTA_LENGTH_BYTE_ARRAY page of {self.name}: "
+                            "length stream overruns the page"
+                        )
                     starts = np.zeros(nn, np.int64)
                     np.cumsum(lengths[:-1], out=starts[1:])
                     starts += data_pos

@@ -254,3 +254,66 @@ def test_native_rejects_hostile_run_headers():
         binding.rle_parse_runs(hostile, 1000, 4)
     with pytest.raises(ValueError):
         binding.rle_count_equal(hostile, 1000, 4, 1)
+
+
+# --------------------------------------------------- legacy BIT_PACKED levels
+
+def test_bit_packed_legacy_levels():
+    """Deprecated MSB-first BIT_PACKED level decode (very old v1 files)."""
+    from parquet_floor_tpu.format.encodings.rle_hybrid import (
+        decode_bit_packed_legacy,
+    )
+
+    # spec example: levels 0..7 with bw=3 pack MSB-first as
+    # 000 001 010 011 100 101 110 111 -> bytes 0b00000101, 0b00111001, 0b01110111
+    data = bytes([0b00000101, 0b00111001, 0b01110111])
+    vals, end = decode_bit_packed_legacy(data, 8, 3)
+    assert vals.tolist() == [0, 1, 2, 3, 4, 5, 6, 7]
+    assert end == 3
+    # bw=1: bits MSB-first within each byte
+    vals, _ = decode_bit_packed_legacy(bytes([0b10110000]), 4, 1)
+    assert vals.tolist() == [1, 0, 1, 1]
+    # truncation raises
+    import pytest as _p
+    with _p.raises(ValueError):
+        decode_bit_packed_legacy(b"\x01", 8, 3)
+
+
+def test_bit_packed_legacy_page_roundtrip():
+    """A synthetic v1 page with BIT_PACKED def levels decodes via the host
+    page decoder (parity with parquet-mr's legacy-file support)."""
+    import numpy as np
+    from parquet_floor_tpu.format import pages as pg
+    from parquet_floor_tpu.format.encodings.plain import encode_plain
+    from parquet_floor_tpu.format.parquet_thrift import (
+        CompressionCodec,
+        DataPageHeader,
+        Encoding,
+        PageHeader,
+        PageType,
+    )
+    from parquet_floor_tpu.format.schema import types as t
+
+    schema = t.message("m", t.optional(t.INT32).named("x"))
+    desc = schema.columns[0]
+    # 8 slots: values at even positions, nulls at odd (def levels 1,0,...)
+    defs = np.array([1, 0, 1, 0, 1, 0, 1, 0], np.uint32)
+    present = np.array([10, 20, 30, 40], np.int32)
+    # MSB-first bw=1 packing of defs: 0b10101010
+    level_bytes = bytes([0b10101010])
+    payload = level_bytes + encode_plain(present, Type.INT32)
+    header = PageHeader(
+        type=PageType.DATA_PAGE,
+        uncompressed_page_size=len(payload),
+        compressed_page_size=len(payload),
+        data_page_header=DataPageHeader(
+            num_values=8,
+            encoding=Encoding.PLAIN,
+            definition_level_encoding=Encoding.BIT_PACKED,
+            repetition_level_encoding=Encoding.BIT_PACKED,
+        ),
+    )
+    page = pg.RawPage(header=header, payload=payload)
+    out = pg.decode_data_page(page, desc, CompressionCodec.UNCOMPRESSED, None)
+    assert out.def_levels.tolist() == defs.tolist()
+    np.testing.assert_array_equal(out.values, present)

@@ -89,3 +89,111 @@ def test_pyarrow_written_stats(tmp_path):
     pq.write_table(t, path, row_group_size=250)
     assert _groups(path, col("a") < 250) == [0]
     assert _groups(path, col("a") >= 750) == [3]
+
+
+# ------------------------------------------------------- page-level indexes
+
+def test_page_index_roundtrip(tmp_path):
+    """Writer emits ColumnIndex/OffsetIndex; reader parses them; pyarrow
+    sees the same page statistics."""
+    import pyarrow.parquet as pq
+
+    schema = types.message("t", types.required(types.INT64).named("x"))
+    path = str(tmp_path / "pi.parquet")
+    with ParquetFileWriter(
+        path, schema, WriterOptions(data_page_values=100)
+    ) as w:
+        w.write_columns({"x": np.arange(1000, dtype=np.int64)})
+    with ParquetFileReader(path) as r:
+        chunk = r.row_groups[0].columns[0]
+        ci = r.read_column_index(chunk)
+        oi = r.read_offset_index(chunk)
+    assert ci is not None and oi is not None
+    assert len(oi.page_locations) == 10
+    assert [pl.first_row_index for pl in oi.page_locations] == list(range(0, 1000, 100))
+    assert ci.null_pages == [False] * 10
+    assert ci.null_counts == [0] * 10
+    # pyarrow recognizes the indexes we wrote
+    md = pq.read_metadata(path)
+    pa_col = md.row_group(0).column(0)
+    assert pa_col.has_column_index and pa_col.has_offset_index
+
+
+def test_page_level_row_ranges(tmp_path):
+    """row_ranges prunes within a row group using the page index."""
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("x"),
+        types.optional(types.INT64).named("y"),
+    )
+    path = str(tmp_path / "rr.parquet")
+    ys = [None if (i // 100) == 3 else int(i) for i in range(1000)]
+    with ParquetFileWriter(
+        path, schema, WriterOptions(data_page_values=100)
+    ) as w:
+        w.write_columns({"x": np.arange(1000, dtype=np.int64), "y": ys})
+    with ParquetFileReader(path) as r:
+        # x in [250, 449] → pages 2,3,4 → rows [200, 500)
+        pred = (col("x") >= 250) & (col("x") < 450)
+        assert pred.row_ranges(r, 0) == [(200, 500)]
+        # equality in one page
+        assert (col("x") == 42).row_ranges(r, 0) == [(0, 100)]
+        # OR merges
+        assert ((col("x") < 50) | (col("x") >= 950)).row_ranges(r, 0) == [
+            (0, 100), (900, 1000),
+        ]
+        # no match → empty
+        assert (col("x") > 10_000).row_ranges(r, 0) == []
+        # all-null page excluded for comparisons, included for is_null
+        assert (col("y") == 310).row_ranges(r, 0) == []
+        assert (300, 400) in [
+            tuple(t_) for t_ in col("y").is_null().row_ranges(r, 0)
+        ]
+        # column without index (unknown) keeps whole group
+        assert (col("zz") > 1).row_ranges(r, 0) == [(0, 1000)]
+
+
+def test_pyarrow_reads_our_page_index(tmp_path):
+    """pyarrow successfully reads files carrying our page indexes (no
+    footer corruption) and its page-index API agrees on page count."""
+    import pyarrow.parquet as pq
+
+    schema = types.message("t", types.required(types.INT32).named("v"))
+    path = str(tmp_path / "pa.parquet")
+    with ParquetFileWriter(
+        path, schema, WriterOptions(data_page_values=50)
+    ) as w:
+        w.write_columns({"v": np.arange(200, dtype=np.int32)})
+    t = pq.read_table(path)
+    assert t.column("v").to_pylist() == list(range(200))
+
+
+def test_ne_keeps_null_pages(tmp_path):
+    """'!=' must keep all-null pages at page level (nulls count as
+    matching under the chunk-level convention)."""
+    schema = types.message("t", types.optional(types.INT64).named("y"))
+    path = str(tmp_path / "ne.parquet")
+    ys = [None if (i // 100) == 3 else int(i) for i in range(1000)]
+    with ParquetFileWriter(path, schema, WriterOptions(data_page_values=100)) as w:
+        w.write_columns({"y": ys})
+    with ParquetFileReader(path) as r:
+        ranges = (col("y") != 5).row_ranges(r, 0)
+        assert any(a <= 300 and 400 <= b for a, b in ranges), ranges
+
+
+def test_all_nan_page_drops_column_index(tmp_path):
+    """A non-null page with no valid bounds (all NaN) must suppress the
+    chunk's ColumnIndex (spec: non-null pages carry valid bounds); the
+    OffsetIndex survives."""
+    schema = types.message("t", types.required(types.DOUBLE).named("v"))
+    path = str(tmp_path / "nan.parquet")
+    vals = [1.0] * 100 + [float("nan")] * 100 + [2.0] * 100
+    with ParquetFileWriter(path, schema, WriterOptions(data_page_values=100)) as w:
+        w.write_columns({"v": vals})
+    with ParquetFileReader(path) as r:
+        chunk = r.row_groups[0].columns[0]
+        assert r.read_column_index(chunk) is None
+        oi = r.read_offset_index(chunk)
+        assert oi is not None and len(oi.page_locations) == 3
+        # pruning degrades to whole-group, never wrong
+        assert (col("v") >= 1.5).row_ranges(r, 0) == [(0, 300)]

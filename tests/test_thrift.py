@@ -142,3 +142,23 @@ def test_unknown_list_of_bool_field_skipped():
     v1, end = V1.from_bytes(v2.to_bytes())
     assert v1.x == 42
     assert end == len(v2.to_bytes())
+
+
+def test_bool_list_roundtrip():
+    """Regression: bools as container elements occupy one payload byte in
+    compact protocol (1=true, 2=false) — they are NOT header-encoded like
+    field-position bools.  Mis-reading desyncs every later field."""
+    from parquet_floor_tpu.format.parquet_thrift import ColumnIndex
+
+    ci = ColumnIndex(
+        null_pages=[False, True, False],
+        min_values=[b"a", b"", b"c"],
+        max_values=[b"z", b"", b"y"],
+        boundary_order=0,
+        null_counts=[0, 5, 1],
+    )
+    out, _ = ColumnIndex.from_bytes(ci.to_bytes())
+    assert out.null_pages == [False, True, False]
+    assert out.min_values == [b"a", b"", b"c"]
+    assert out.max_values == [b"z", b"", b"y"]
+    assert out.null_counts == [0, 5, 1]

@@ -21,13 +21,17 @@ from .format.file_write import ColumnData, ParquetFileWriter, WriterOptions
 from .api.hydrate import Dehydrator, Hydrator, HydratorSupplier, ValueWriter
 from .api.reader import ParquetReader
 from .api.writer import ParquetWriter
+from .batch.nested import NestedColumn, assemble_nested, shred_nested
+from .batch.predicate import Predicate, col
+from .utils import trace
 
 __version__ = "0.1.0"
 
 __all__ = [
     "ColumnData", "ColumnDescriptor", "CompressionCodec", "Dehydrator",
     "Encoding", "GroupType", "Hydrator", "HydratorSupplier",
-    "LogicalAnnotation", "MessageType", "ParquetFileReader",
+    "LogicalAnnotation", "MessageType", "NestedColumn", "ParquetFileReader",
     "ParquetFileWriter", "ParquetMetadata", "ParquetReader", "ParquetWriter",
-    "PrimitiveType", "Type", "types", "ValueWriter", "WriterOptions",
+    "Predicate", "PrimitiveType", "Type", "assemble_nested", "col",
+    "shred_nested", "trace", "types", "ValueWriter", "WriterOptions",
 ]

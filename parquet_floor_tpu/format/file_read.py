@@ -178,9 +178,17 @@ class ParquetFileReader:
         return RowGroupBatch(batches, rg.num_rows or 0)
 
     def iter_row_groups(
-        self, column_filter: Optional[Set[str]] = None
+        self, column_filter: Optional[Set[str]] = None, predicate=None
     ) -> Iterator[RowGroupBatch]:
-        for i in range(len(self.row_groups)):
+        """Decode row groups in order; with ``predicate`` (see
+        ``batch.predicate.col``) groups whose statistics prove no row can
+        match are skipped without reading a page."""
+        indices = (
+            predicate.row_groups(self)
+            if predicate is not None
+            else range(len(self.row_groups))
+        )
+        for i in indices:
             yield self.read_row_group(i, column_filter)
 
     def read_raw_column_chunk(self, chunk: ColumnChunk):

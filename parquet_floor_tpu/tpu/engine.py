@@ -1436,21 +1436,30 @@ class TpuRowGroupReader:
         return self._launch(sg)
 
     def iter_row_groups(self, columns: Optional[Sequence[str]] = None,
-                        prefetch: bool = True):
+                        prefetch: bool = True, predicate=None):
         """Decode every row group, overlapping host staging of group i+1
-        with device transfer/compute of group i."""
-        n = self.num_row_groups
-        if not prefetch or n <= 1:
-            for i in range(n):
+        with device transfer/compute of group i.
+
+        ``predicate`` (see ``batch.predicate.col``) skips row groups whose
+        footer statistics prove no row can match — before any page is
+        read, staged, or shipped."""
+        if predicate is not None:
+            indices = predicate.row_groups(self.reader)
+        else:
+            indices = list(range(self.num_row_groups))
+        if not prefetch or len(indices) <= 1:
+            for i in indices:
                 yield self.read_row_group(i, columns)
             return
         with ThreadPoolExecutor(max_workers=1,
                                 thread_name_prefix="pftpu-stage") as ex:
-            fut = ex.submit(self._stage_row_group, 0, columns)
-            for i in range(n):
+            fut = ex.submit(self._stage_row_group, indices[0], columns)
+            for k, i in enumerate(indices):
                 sg = fut.result()
-                if i + 1 < n:
-                    fut = ex.submit(self._stage_row_group, i + 1, columns)
+                if k + 1 < len(indices):
+                    fut = ex.submit(
+                        self._stage_row_group, indices[k + 1], columns
+                    )
                 yield self._launch(sg)
 
     # -- staging ------------------------------------------------------------

@@ -154,8 +154,8 @@ def test_page_level_row_ranges(tmp_path):
 
 
 def test_pyarrow_reads_our_page_index(tmp_path):
-    """pyarrow successfully reads files carrying our page indexes (no
-    footer corruption) and its page-index API agrees on page count."""
+    """pyarrow reads files carrying our page indexes (no corruption) and
+    its metadata reports both indexes present for the chunk."""
     import pyarrow.parquet as pq
 
     schema = types.message("t", types.required(types.INT32).named("v"))
@@ -166,6 +166,8 @@ def test_pyarrow_reads_our_page_index(tmp_path):
         w.write_columns({"v": np.arange(200, dtype=np.int32)})
     t = pq.read_table(path)
     assert t.column("v").to_pylist() == list(range(200))
+    pa_col = pq.read_metadata(path).row_group(0).column(0)
+    assert pa_col.has_column_index and pa_col.has_offset_index
 
 
 def test_ne_keeps_null_pages(tmp_path):

@@ -121,3 +121,48 @@ def test_read_table_sharded_masks_and_errors(tmp_path):
     mesh3 = pshard.make_mesh(3, rg=3, seq=1, dict_=1)
     with pytest.raises(ValueError, match="shard evenly"):
         pshard.read_table_sharded(path, mesh3)
+
+
+def test_read_sharded_global_single_process(tmp_path):
+    """Multi-host entry degrades correctly under one process: global
+    arrays come back sharded over the mesh axis with exact contents."""
+    import numpy as np
+    from parquet_floor_tpu import ParquetFileWriter, WriterOptions, types
+    from parquet_floor_tpu.parallel.multihost import read_sharded_global
+    from parquet_floor_tpu.parallel.shard import make_mesh
+
+    rng = np.random.default_rng(61)
+    n = 4096
+    vals = rng.integers(0, 1000, n).astype(np.int64)
+    schema = types.message("t", types.required(types.INT64).named("v"))
+    path = tmp_path / "mh.parquet"
+    with ParquetFileWriter(path, schema, WriterOptions(row_group_rows=512)) as w:
+        for lo in range(0, n, 512):
+            w.write_columns({"v": vals[lo : lo + 512]})
+
+    mesh = make_mesh(8, rg=8)
+    # axis name in make_mesh is "rg"
+    out = read_sharded_global(path, mesh, axis="rg")
+    got = np.asarray(out["v"].values)
+    np.testing.assert_array_equal(got, vals)
+    assert out["v"].mask is None
+    assert len(out["v"].values.sharding.device_set) == 8
+
+
+def test_tpu_iter_with_predicate(tmp_path):
+    """TpuRowGroupReader.iter_row_groups(predicate=...) skips groups
+    before any staging."""
+    import numpy as np
+    from parquet_floor_tpu import ParquetFileWriter, WriterOptions, col, types
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+
+    schema = types.message("t", types.required(types.INT64).named("v"))
+    path = tmp_path / "pred.parquet"
+    with ParquetFileWriter(path, schema, WriterOptions(row_group_rows=100)) as w:
+        for lo in range(0, 400, 100):
+            w.write_columns({"v": np.arange(lo, lo + 100, dtype=np.int64)})
+    with TpuRowGroupReader(path) as r:
+        groups = list(r.iter_row_groups(predicate=(col("v") >= 250)))
+        assert len(groups) == 2
+        first = np.asarray(next(iter(groups[0].values())).values)
+        assert first[0] == 200

@@ -101,7 +101,7 @@ class Predicate:
         indexes contributes the whole group."""
         rg = reader.row_groups[rg_index]
         n = int(rg.num_rows or 0)
-        return _normalize(self._ranges(reader, rg, n), n)
+        return normalize_ranges(self._ranges(reader, rg, n), n)
 
     def _ranges(self, reader, rg, n: int) -> List[tuple]:
         return [(0, n)]
@@ -121,8 +121,9 @@ class Predicate:
         )
 
 
-def _normalize(ranges: List[tuple], n: int) -> List[tuple]:
-    """Clip to [0, n), sort, and merge overlapping/adjacent ranges."""
+def normalize_ranges(ranges: List[tuple], n: int) -> List[tuple]:
+    """Clip to [0, n), sort, and merge overlapping/adjacent ranges (the
+    shared interval algebra for row-range pruning and selective reads)."""
     clipped = sorted(
         (max(0, int(a)), min(n, int(b))) for a, b in ranges if b > a
     )
@@ -162,8 +163,8 @@ class _And(Predicate):
 
     def _ranges(self, reader, rg, n):
         return _intersect(
-            _normalize(self.a._ranges(reader, rg, n), n),
-            _normalize(self.b._ranges(reader, rg, n), n),
+            normalize_ranges(self.a._ranges(reader, rg, n), n),
+            normalize_ranges(self.b._ranges(reader, rg, n), n),
         )
 
 

@@ -19,8 +19,9 @@ from ..io.source import FileSource
 from . import pages as pg
 from .encodings.plain import ByteArrayColumn
 from .metadata import MAGIC, ParquetMetadata, read_footer
-from .parquet_thrift import ColumnChunk, ColumnMetaData, PageType, RowGroup
+from .parquet_thrift import ColumnChunk, ColumnMetaData, PageHeader, PageType, RowGroup
 from .schema import ColumnDescriptor
+from .thrift import CompactReader
 
 
 def _chunk_byte_range(meta: ColumnMetaData):
@@ -158,6 +159,114 @@ class ParquetFileReader:
             else None
         )
         return ColumnBatch(desc, meta.num_values, values, def_levels, rep_levels)
+
+    def read_row_group_ranges(
+        self, index: int, row_ranges, column_filter: Optional[Set[str]] = None
+    ):
+        """Selective decode: only pages whose rows intersect ``row_ranges``
+        are **read from disk** and decoded, using each chunk's OffsetIndex
+        (I/O-level pruning — the payoff of the page indexes; pair with
+        ``Predicate.row_ranges``).
+
+        Returns ``(batch, covered)``: ``covered`` is the list of half-open
+        row ranges (page-aligned, a superset of the request) the batch's
+        rows actually correspond to, identical across columns.  Chunks
+        without an OffsetIndex decode fully; a whole-group request or a
+        zero-range request short-circuits.
+        """
+        from ..batch.predicate import normalize_ranges
+
+        rg = self.row_groups[index]
+        n = int(rg.num_rows or 0)
+        covered = normalize_ranges(row_ranges, n)
+        if not covered:
+            return RowGroupBatch([], 0), []
+        chunks = [
+            c for c in rg.columns or []
+            if not column_filter or c.meta_data.path_in_schema[0] in column_filter
+        ]
+        # page-aligned cover: every chunk decodes whole pages, so the cover
+        # must be a union of page spans of EVERY chunk — iterate to a
+        # fixpoint because expanding for one chunk's coarser pages can pull
+        # in more pages of another (page boundaries differ per column)
+        chunk_spans = []
+        for chunk in chunks:
+            oi = self.read_offset_index(chunk)
+            if oi is None or not oi.page_locations:
+                return self.read_row_group(index, column_filter), [(0, n)]
+            firsts = [int(pl.first_row_index or 0) for pl in oi.page_locations]
+            chunk_spans.append(list(zip(firsts, firsts[1:] + [n])))
+        while True:
+            spans = {
+                (a, b)
+                for cs in chunk_spans
+                for a, b in cs
+                if any(a < cb and ca < b for ca, cb in covered)
+            }
+            new = normalize_ranges(spans, n)
+            if new == covered:
+                break
+            covered = new
+        if covered == [(0, n)]:
+            return self.read_row_group(index, column_filter), covered
+        batches = []
+        for chunk in chunks:
+            batches.append(self._read_chunk_ranges(chunk, covered, n))
+        rows = sum(b - a for a, b in covered)
+        return RowGroupBatch(batches, rows), covered
+
+    def _read_chunk_ranges(self, chunk: ColumnChunk, covered, n: int) -> ColumnBatch:
+        """Decode only the chunk's pages whose rows fall inside ``covered``
+        (page spans of every selected chunk; reads page byte ranges)."""
+        meta = chunk.meta_data
+        desc = self._descriptor_for(chunk)
+        oi = self.read_offset_index(chunk)
+        firsts = [int(pl.first_row_index or 0) for pl in oi.page_locations]
+        ends = firsts[1:] + [n]
+        dictionary = None
+        if meta.dictionary_page_offset is not None and meta.dictionary_page_offset > 0:
+            # dictionary page sits before the first data page
+            dict_len = int(oi.page_locations[0].offset) - int(meta.dictionary_page_offset)
+            raw = self.source.read_at(meta.dictionary_page_offset, dict_len)
+            reader = CompactReader(raw)
+            header = PageHeader.read(reader)
+            if header.type != PageType.DICTIONARY_PAGE:
+                raise ValueError("expected dictionary page before data pages")
+            payload = bytes(raw[reader.pos : reader.pos + header.compressed_page_size])
+            dictionary = pg.decode_dictionary_page(
+                pg.RawPage(header, payload), desc, meta.codec, self.verify_crc
+            )
+        decoded = []
+        for pl, a, b in zip(oi.page_locations, firsts, ends):
+            if not any(a < cb and ca < b for ca, cb in covered):
+                continue
+            raw = self.source.read_at(int(pl.offset), int(pl.compressed_page_size))
+            reader = CompactReader(raw)
+            header = PageHeader.read(reader)
+            payload = raw[reader.pos : reader.pos + header.compressed_page_size]
+            page = pg.RawPage(header, bytes(payload))
+            decoded.append(
+                pg.decode_data_page(page, desc, meta.codec, dictionary, self.verify_crc)
+            )
+        total = sum(d.num_values for d in decoded)
+        if not decoded:
+            empty_levels = (
+                np.zeros(0, np.uint32) if desc.max_definition_level > 0 else None
+            )
+            return ColumnBatch(
+                desc, 0, _empty_values(desc), empty_levels,
+                np.zeros(0, np.uint32) if desc.max_repetition_level > 0 else None,
+            )
+        values = _concat_values([d.values for d in decoded])
+        def_levels = (
+            np.concatenate([d.def_levels for d in decoded])
+            if decoded[0].def_levels is not None else None
+        )
+        rep_levels = (
+            np.concatenate([d.rep_levels for d in decoded])
+            if decoded[0].rep_levels is not None else None
+        )
+        return ColumnBatch(desc, total, values, def_levels, rep_levels)
 
     def read_row_group(
         self, index: int, column_filter: Optional[Set[str]] = None

@@ -3,9 +3,11 @@ row groups' bytes, and the results assemble into global ``jax.Array``s.
 
 The single-host sibling (``parallel.shard``) shards row groups across the
 devices one process owns; this module scales the same axis across
-*processes* (hosts): group ``g`` belongs to process ``g % process_count``,
-each host decodes its share locally (never touching other hosts' byte
-ranges — the DCN input-sharding pattern SURVEY.md §5 prescribes), and
+*processes* (hosts): process ``p`` owns the contiguous block of row
+groups ``[p·k, (p+1)·k)`` (k = n_groups / process_count — contiguous so
+the global array preserves file row order), each host decodes its share
+locally (never touching other hosts' byte ranges — the DCN
+input-sharding pattern SURVEY.md §5 prescribes), and
 ``jax.make_array_from_process_local_data`` stitches the per-host shards
 into one globally-sharded array without any host ever holding the full
 column.
@@ -63,6 +65,17 @@ def read_sharded_global(
                 f"{n_groups} row groups do not shard evenly over "
                 f"{n_proc} processes"
             )
+        # fail fast from the schema, before any I/O or device work
+        from ..format.parquet_thrift import Type as _T
+
+        for desc in reader.reader.schema.columns:
+            if columns and desc.path[0] not in set(columns):
+                continue
+            if desc.physical_type == _T.BYTE_ARRAY or desc.max_repetition_level:
+                raise NotImplementedError(
+                    f"column {'.'.join(desc.path)}: strings/repeated "
+                    "columns are not supported by read_sharded_global"
+                )
         k = n_groups // n_proc
         mine = range(pid * k, (pid + 1) * k)
         parts: Dict[str, list] = {}

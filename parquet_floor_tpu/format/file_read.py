@@ -174,26 +174,51 @@ class ParquetFileReader:
         without an OffsetIndex decode fully; a whole-group request or a
         zero-range request short-circuits.
         """
+        rg = self.row_groups[index]
+        n = int(rg.num_rows or 0)
+        chunks = [
+            c for c in rg.columns or []
+            if not column_filter or c.meta_data.path_in_schema[0] in column_filter
+        ]
+        if not chunks:
+            # nothing selected (e.g. misspelled projection): mirror
+            # read_row_group's empty-batch-with-rows shape rather than
+            # looking like "predicate excluded every row"
+            return RowGroupBatch([], n), [(0, n)] if n else []
+        covered = self.page_cover(index, row_ranges, chunks)
+        if covered == []:
+            return RowGroupBatch([], 0), []
+        if covered is None or covered == [(0, n)]:
+            return (
+                self.read_row_group(index, column_filter),
+                [(0, n)] if n else [],
+            )
+        batches = []
+        for chunk in chunks:
+            batches.append(self._read_chunk_ranges(chunk, covered, n))
+        rows = sum(b - a for a, b in covered)
+        return RowGroupBatch(batches, rows), covered
+
+    def page_cover(self, index: int, row_ranges, chunks=None):
+        """Page-aligned cover of ``row_ranges`` for a row group: the
+        smallest union of page spans (over EVERY given chunk) containing
+        the request.  Iterated to a fixpoint because page boundaries
+        differ per column.  Returns None when any chunk lacks an
+        OffsetIndex (caller should decode the full group)."""
         from ..batch.predicate import normalize_ranges
 
         rg = self.row_groups[index]
         n = int(rg.num_rows or 0)
         covered = normalize_ranges(row_ranges, n)
         if not covered:
-            return RowGroupBatch([], 0), []
-        chunks = [
-            c for c in rg.columns or []
-            if not column_filter or c.meta_data.path_in_schema[0] in column_filter
-        ]
-        # page-aligned cover: every chunk decodes whole pages, so the cover
-        # must be a union of page spans of EVERY chunk — iterate to a
-        # fixpoint because expanding for one chunk's coarser pages can pull
-        # in more pages of another (page boundaries differ per column)
+            return []
+        if chunks is None:
+            chunks = list(rg.columns or [])
         chunk_spans = []
         for chunk in chunks:
             oi = self.read_offset_index(chunk)
             if oi is None or not oi.page_locations:
-                return self.read_row_group(index, column_filter), [(0, n)]
+                return None
             firsts = [int(pl.first_row_index or 0) for pl in oi.page_locations]
             chunk_spans.append(list(zip(firsts, firsts[1:] + [n])))
         while True:
@@ -205,46 +230,61 @@ class ParquetFileReader:
             }
             new = normalize_ranges(spans, n)
             if new == covered:
-                break
+                return covered
             covered = new
-        if covered == [(0, n)]:
-            return self.read_row_group(index, column_filter), covered
-        batches = []
-        for chunk in chunks:
-            batches.append(self._read_chunk_ranges(chunk, covered, n))
-        rows = sum(b - a for a, b in covered)
-        return RowGroupBatch(batches, rows), covered
 
-    def _read_chunk_ranges(self, chunk: ColumnChunk, covered, n: int) -> ColumnBatch:
-        """Decode only the chunk's pages whose rows fall inside ``covered``
-        (page spans of every selected chunk; reads page byte ranges)."""
+    def _read_raw_page(self, offset: int, max_len: int) -> "pg.RawPage":
+        """Parse one page (header + payload) from a bounded byte range."""
+        raw = self.source.read_at(int(offset), int(max_len))
+        reader = CompactReader(raw)
+        header = PageHeader.read(reader)
+        payload = bytes(raw[reader.pos : reader.pos + header.compressed_page_size])
+        if len(payload) != header.compressed_page_size:
+            raise ValueError("page payload truncated")
+        return pg.RawPage(header, payload)
+
+    def read_raw_column_chunk_ranges(self, chunk: ColumnChunk, covered, n: int):
+        """Raw pages (dictionary page first, then only the data pages whose
+        rows intersect ``covered``) — the ranged sibling of
+        ``read_raw_column_chunk``.  None when the chunk has no OffsetIndex.
+        """
         meta = chunk.meta_data
-        desc = self._descriptor_for(chunk)
         oi = self.read_offset_index(chunk)
+        if oi is None or not oi.page_locations:
+            return None
         firsts = [int(pl.first_row_index or 0) for pl in oi.page_locations]
         ends = firsts[1:] + [n]
-        dictionary = None
+        pages = []
         if meta.dictionary_page_offset is not None and meta.dictionary_page_offset > 0:
-            # dictionary page sits before the first data page
             dict_len = int(oi.page_locations[0].offset) - int(meta.dictionary_page_offset)
-            raw = self.source.read_at(meta.dictionary_page_offset, dict_len)
-            reader = CompactReader(raw)
-            header = PageHeader.read(reader)
-            if header.type != PageType.DICTIONARY_PAGE:
+            dpage = self._read_raw_page(meta.dictionary_page_offset, dict_len)
+            if dpage.page_type != PageType.DICTIONARY_PAGE:
                 raise ValueError("expected dictionary page before data pages")
-            payload = bytes(raw[reader.pos : reader.pos + header.compressed_page_size])
-            dictionary = pg.decode_dictionary_page(
-                pg.RawPage(header, payload), desc, meta.codec, self.verify_crc
-            )
-        decoded = []
+            pages.append(dpage)
         for pl, a, b in zip(oi.page_locations, firsts, ends):
-            if not any(a < cb and ca < b for ca, cb in covered):
+            if any(a < cb and ca < b for ca, cb in covered):
+                pages.append(
+                    self._read_raw_page(pl.offset, pl.compressed_page_size)
+                )
+        return pages
+
+    def _read_chunk_ranges(self, chunk: ColumnChunk, covered, n: int,
+                           raw_pages=None) -> ColumnBatch:
+        """Decode only the chunk's pages whose rows fall inside ``covered``
+        (page spans of every selected chunk; reads page byte ranges —
+        reused when the caller already fetched them)."""
+        meta = chunk.meta_data
+        desc = self._descriptor_for(chunk)
+        if raw_pages is None:
+            raw_pages = self.read_raw_column_chunk_ranges(chunk, covered, n)
+        dictionary = None
+        decoded = []
+        for page in raw_pages:
+            if page.page_type == PageType.DICTIONARY_PAGE:
+                dictionary = pg.decode_dictionary_page(
+                    page, desc, meta.codec, self.verify_crc
+                )
                 continue
-            raw = self.source.read_at(int(pl.offset), int(pl.compressed_page_size))
-            reader = CompactReader(raw)
-            header = PageHeader.read(reader)
-            payload = raw[reader.pos : reader.pos + header.compressed_page_size]
-            page = pg.RawPage(header, bytes(payload))
             decoded.append(
                 pg.decode_data_page(page, desc, meta.codec, dictionary, self.verify_crc)
             )

@@ -589,14 +589,16 @@ class _DevStage:
     """A chunk headed for the device path.  Raises _Fallback during layout
     when the chunk needs the host engine."""
 
-    def __init__(self, name, chunk, desc: ColumnDescriptor, reader, arena: _ArenaBuilder):
+    def __init__(self, name, chunk, desc: ColumnDescriptor, reader, arena: _ArenaBuilder,
+                 raw_pages=None):
         self.name = name
         self.desc = desc
         meta = chunk.meta_data
         pt = desc.physical_type
         codec = meta.codec
         max_def = desc.max_definition_level
-        raw_pages = reader.read_raw_column_chunk(chunk)
+        if raw_pages is None:
+            raw_pages = reader.read_raw_column_chunk(chunk)
         pages: List[_Pg] = []
         self.dict_off = -1
         self.dict_size = 0
@@ -992,10 +994,16 @@ class _DevStage:
 class _HostStage:
     """A chunk decoded by the host engine, packed dense into the arena."""
 
-    def __init__(self, name, chunk, desc, eng, arena: _ArenaBuilder):
+    def __init__(self, name, chunk, desc, eng, arena: _ArenaBuilder,
+                 covered=None, group_rows: int = 0, raw_pages=None):
         self.name = name
         self.desc = desc
-        batch = eng.reader.read_column_chunk(chunk)
+        if covered is not None:
+            batch = eng.reader._read_chunk_ranges(
+                chunk, covered, group_rows, raw_pages=raw_pages
+            )
+        else:
+            batch = eng.reader.read_column_chunk(chunk)
         n = batch.num_values
         self.n = n
         self.max_def = 0
@@ -1435,6 +1443,32 @@ class TpuRowGroupReader:
         sg = self._stage_row_group(index, columns)
         return self._launch(sg)
 
+    def read_row_group_ranges(
+        self, index: int, row_ranges, columns: Optional[Sequence[str]] = None
+    ):
+        """Selective device decode: only pages whose rows intersect
+        ``row_ranges`` are read from disk, staged, shipped, and decoded
+        (pair with ``Predicate.row_ranges``).  Returns
+        ``(columns_dict, covered)`` where ``covered`` lists the
+        page-aligned row ranges the decoded rows correspond to; falls
+        back to the whole group when any chunk lacks an OffsetIndex."""
+        rg = self.reader.row_groups[index]
+        n = int(rg.num_rows or 0)
+        chunk_filter = set(columns) if columns else None
+        chunks = [
+            c for c in rg.columns or []
+            if not chunk_filter or c.meta_data.path_in_schema[0] in chunk_filter
+        ]
+        if not chunks:
+            return self.read_row_group(index, columns), [(0, n)] if n else []
+        covered = self.reader.page_cover(index, row_ranges, chunks)
+        if covered == []:
+            return {}, []
+        if covered is None or covered == [(0, n)]:
+            return self.read_row_group(index, columns), [(0, n)] if n else []
+        sg = self._stage_row_group(index, columns, covered=covered, group_rows=n)
+        return self._launch(sg), covered
+
     def iter_row_groups(self, columns: Optional[Sequence[str]] = None,
                         prefetch: bool = True, predicate=None):
         """Decode every row group, overlapping host staging of group i+1
@@ -1464,11 +1498,15 @@ class TpuRowGroupReader:
 
     # -- staging ------------------------------------------------------------
 
-    def _stage_row_group(self, index: int, columns) -> _StagedGroup:
+    def _stage_row_group(self, index: int, columns, covered=None,
+                         group_rows: int = 0) -> _StagedGroup:
         with trace.span("stage"):
-            return self._stage_row_group_untraced(index, columns)
+            return self._stage_row_group_untraced(
+                index, columns, covered, group_rows
+            )
 
-    def _stage_row_group_untraced(self, index: int, columns) -> _StagedGroup:
+    def _stage_row_group_untraced(self, index: int, columns, covered=None,
+                                  group_rows: int = 0) -> _StagedGroup:
         rg = self.reader.row_groups[index]
         want = set(columns) if columns else None
         work = []
@@ -1484,7 +1522,10 @@ class TpuRowGroupReader:
             work.append((name, chunk, desc))
         while True:
             try:
-                return self._try_stage(rg, work, self._forced, self._all_host)
+                return self._try_stage(
+                    rg, work, self._forced, self._all_host,
+                    covered=covered, group_rows=group_rows,
+                )
             except _ForceHost as e:
                 # sticky per file: a column that needed the host path once
                 # (e.g. >32-bit delta range) skips the device attempt in
@@ -1510,17 +1551,37 @@ class TpuRowGroupReader:
         span_off = slabb.add(np.concatenate([tl, th]))
         return (bw, span_off, len(tl), self._pl_interp)
 
-    def _try_stage(self, rg, work, forced, all_host=False) -> _StagedGroup:
+    def _try_stage(self, rg, work, forced, all_host=False, covered=None,
+                   group_rows: int = 0) -> _StagedGroup:
         arena_b = _ArenaBuilder(plk.ARENA_LEAD if self._pl_enabled else 0)
         stages = []
         for name, chunk, desc in work:
+            raw_pages = (
+                self.reader.read_raw_column_chunk_ranges(
+                    chunk, covered, group_rows
+                )
+                if covered is not None
+                else None
+            )
             if all_host or name in forced:
-                stages.append(_HostStage(name, chunk, desc, self, arena_b))
+                stages.append(
+                    _HostStage(name, chunk, desc, self, arena_b,
+                               covered=covered, group_rows=group_rows,
+                               raw_pages=raw_pages)
+                )
                 continue
             try:
-                stages.append(_DevStage(name, chunk, desc, self.reader, arena_b))
+                stages.append(
+                    _DevStage(name, chunk, desc, self.reader, arena_b,
+                              raw_pages=raw_pages)
+                )
             except _Fallback:
-                stages.append(_HostStage(name, chunk, desc, self, arena_b))
+                # reuse the already-fetched pages — no second disk read
+                stages.append(
+                    _HostStage(name, chunk, desc, self, arena_b,
+                               covered=covered, group_rows=group_rows,
+                               raw_pages=raw_pages)
+                )
         if arena_b.size >= (1 << 28) and not all_host:
             if any(isinstance(st, _DevStage) for st in stages):
                 raise _ArenaOverflow()
@@ -1559,7 +1620,11 @@ class TpuRowGroupReader:
             descs=[d for _, _, d in work],
             extra_keys=extra_keys,
             new_extras=new_extras,
-            num_rows=rg.num_rows or 0,
+            num_rows=(
+                sum(b - a for a, b in covered)
+                if covered is not None
+                else rg.num_rows or 0
+            ),
         )
 
     # -- launch -------------------------------------------------------------

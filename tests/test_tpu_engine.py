@@ -465,3 +465,46 @@ def test_delta_length_byte_array_device(tmp_path):
     ]
     t.close()
     _check_against_host(path)
+
+
+def test_tpu_ranged_decode(tmp_path):
+    """TpuRowGroupReader.read_row_group_ranges stages only covered pages
+    and matches the host ranged decode exactly."""
+    from parquet_floor_tpu.batch.predicate import col
+
+    n = 2000
+    rng_l = np.random.default_rng(71)
+    vals = np.arange(n, dtype=np.int64)
+    ds = rng_l.standard_normal(n)
+    ss = [f"s{i % 113}" for i in range(n)]
+    cols = {
+        "x": (types.INT64, list(vals), False, None),
+        "d": (types.DOUBLE, ds, False, None),
+        "s": (types.BYTE_ARRAY, ss, False, types.string()),
+    }
+    path = _write(tmp_path, cols, WriterOptions(data_page_values=200), n=n)
+    with ParquetFileReader(path) as h:
+        pred = (col("x") >= 450) & (col("x") < 850)
+        ranges = pred.row_ranges(h, 0)
+        host_batch, host_cov = h.read_row_group_ranges(0, ranges)
+    t = TpuRowGroupReader(path)
+    try:
+        dev, cov = t.read_row_group_ranges(0, ranges)
+        assert cov == host_cov == [(400, 1000)]
+        np.testing.assert_array_equal(
+            np.asarray(dev["x"].values), host_batch.column("x").values
+        )
+        np.testing.assert_allclose(
+            np.asarray(dev["d"].values), host_batch.column("d").values
+        )
+        sc = dev["s"]
+        rows = np.asarray(sc.values); lens = np.asarray(sc.lengths)
+        got = [rows[i, : lens[i]].tobytes().decode() for i in range(cov[0][1] - cov[0][0])]
+        assert got == ss[400:1000]
+        # empty and full requests
+        empty, ecov = t.read_row_group_ranges(0, [])
+        assert empty == {} and ecov == []
+        full, fcov = t.read_row_group_ranges(0, [(0, n)])
+        assert fcov == [(0, n)] and np.asarray(full["x"].values).shape[0] == n
+    finally:
+        t.close()

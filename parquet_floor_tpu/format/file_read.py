@@ -174,8 +174,14 @@ class ParquetFileReader:
         without an OffsetIndex decode fully; a whole-group request or a
         zero-range request short-circuits.
         """
+        from ..batch.predicate import normalize_ranges
+
         rg = self.row_groups[index]
         n = int(rg.num_rows or 0)
+        if not normalize_ranges(row_ranges, n):
+            # predicate excluded every row — report that regardless of
+            # what (or whether anything) was projected
+            return RowGroupBatch([], 0), []
         chunks = [
             c for c in rg.columns or []
             if not column_filter or c.meta_data.path_in_schema[0] in column_filter

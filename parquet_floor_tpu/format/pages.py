@@ -37,6 +37,51 @@ from .parquet_thrift import (
 from .schema import ColumnDescriptor
 from .thrift import CompactReader
 
+try:
+    from ..native import binding as _native
+except Exception:  # pragma: no cover - native lib is optional
+    _native = None
+
+
+def _split_pages_native(chunk, num_values: int) -> "List[RawPage]":
+    """Build RawPage objects from the native header scan's slot table."""
+    tbl = _native.split_pages(chunk, num_values)
+    mv = memoryview(chunk)
+    pages: List[RawPage] = []
+    for row in tbl:
+        ptype = int(row[0])
+        header = PageHeader(
+            type=ptype,
+            uncompressed_page_size=int(row[3]),
+            compressed_page_size=int(row[2]),
+            crc=int(row[4]) if row[15] > 0 else None,
+        )
+        if ptype == PageType.DATA_PAGE:
+            header.data_page_header = DataPageHeader(
+                num_values=int(row[5]),
+                encoding=int(row[6]),
+                definition_level_encoding=int(row[7]) if row[7] >= 0 else None,
+                repetition_level_encoding=int(row[8]) if row[8] >= 0 else None,
+            )
+        elif ptype == PageType.DATA_PAGE_V2:
+            header.data_page_header_v2 = DataPageHeaderV2(
+                num_values=int(row[5]),
+                num_nulls=int(row[9]) if row[9] >= 0 else None,
+                num_rows=int(row[13]) if row[13] >= 0 else None,
+                encoding=int(row[6]),
+                definition_levels_byte_length=int(row[10]) if row[10] >= 0 else None,
+                repetition_levels_byte_length=int(row[11]) if row[11] >= 0 else None,
+                is_compressed=None if row[12] < 0 else bool(row[12]),
+            )
+        elif ptype == PageType.DICTIONARY_PAGE:
+            header.dictionary_page_header = DictionaryPageHeader(
+                num_values=int(row[13]) if row[13] >= 0 else None,
+                encoding=int(row[14]) if row[14] >= 0 else None,
+            )
+        off, size = int(row[1]), int(row[2])
+        pages.append(RawPage(header, bytes(mv[off : off + size])))
+    return pages
+
 _NUMPY_DTYPE = {
     Type.INT32: np.dtype("<i4"),
     Type.INT64: np.dtype("<i8"),
@@ -58,7 +103,16 @@ class RawPage:
 
 
 def split_pages(chunk: bytes, num_values: int) -> List[RawPage]:
-    """Scan a column chunk byte range into raw pages (header parse only)."""
+    """Scan a column chunk byte range into raw pages (header parse only).
+
+    Native single-pass scan when the library is built (the Thrift header
+    chain is the staging loop's hottest pure-Python cost); exact Python
+    fallback below."""
+    if _native is not None and _native.available():
+        try:
+            return _split_pages_native(chunk, num_values)
+        except ValueError:
+            pass  # malformed per the native parser: let Python diagnose
     pages: List[RawPage] = []
     reader = CompactReader(chunk)
     seen_values = 0

@@ -136,6 +136,12 @@ def _register(lib):
         ctypes.c_longlong,                  # target
         ctypes.POINTER(ctypes.c_longlong),  # count out
     ]
+    lib.pftpu_split_pages.restype = ctypes.c_ssize_t
+    lib.pftpu_split_pages.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,   # data
+        ctypes.c_longlong,                  # num_values
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_size_t,  # out, cap pages
+    ]
     return lib
 
 
@@ -267,6 +273,35 @@ def lz4_decompress(data: bytes, uncompressed_size: int) -> bytes:
             f"LZ4 block decoded {n} bytes, expected {uncompressed_size}"
         )
     return out.raw[:n]
+
+
+def split_pages(data, num_values: int):
+    """Scan a column chunk's Thrift page-header chain natively.
+
+    Returns an int64 ndarray of shape (n_pages, 16); see
+    pftpu_split_pages in pftpu_native.cc for the slot layout."""
+    import numpy as np
+
+    lib = _load()
+    if isinstance(data, np.ndarray):
+        arr = data if (data.dtype == np.uint8 and data.flags.c_contiguous) else (
+            np.ascontiguousarray(data).view(np.uint8)
+        )
+    else:
+        arr = np.frombuffer(data, dtype=np.uint8)
+    cap = 64
+    while True:
+        out = np.empty((cap, 16), dtype=np.int64)
+        n = lib.pftpu_split_pages(
+            arr.ctypes.data, len(arr), num_values,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), cap,
+        )
+        if n == -2:
+            cap *= 4
+            continue
+        if n < 0:
+            raise ValueError("malformed page header chain")
+        return out[:n]
 
 
 def rle_count_equal(data, num_values: int, bit_width: int, target: int,

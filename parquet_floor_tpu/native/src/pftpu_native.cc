@@ -448,4 +448,183 @@ ptrdiff_t pftpu_rle_count_equal(const uint8_t* data, size_t data_len,
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// Page-header scan: parse the Thrift compact PageHeader chain of a column
+// chunk (the host staging loop's hottest pure-Python cost).  Unknown fields
+// (statistics, bloom offsets, …) are skipped structurally.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct CReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+  int depth = 0;  // skip recursion bound (hostile nesting)
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+  long long zigzag() {
+    uint64_t v = varint();
+    return static_cast<long long>((v >> 1) ^ (~(v & 1) + 1));
+  }
+  void skip_bytes(size_t n) {
+    if (static_cast<size_t>(end - p) < n) { ok = false; return; }
+    p += n;
+  }
+  void skip_value(int ctype);
+  void skip_struct() {
+    if (++depth > 64) { ok = false; return; }  // hostile nesting: bail
+    while (ok) {
+      if (p >= end) { ok = false; break; }
+      uint8_t b = *p++;
+      if (b == 0) break;  // STOP
+      int ctype = b & 0x0F;
+      if (((b >> 4) & 0x0F) == 0) (void)zigzag();  // long-form field id
+      skip_value(ctype);
+    }
+    depth--;
+  }
+};
+
+void CReader::skip_value(int ctype) {
+  switch (ctype) {
+    case 1: case 2: return;                 // bool in header
+    case 3: skip_bytes(1); return;          // byte
+    case 4: case 5: case 6: (void)varint(); return;  // i16/i32/i64
+    case 7: skip_bytes(8); return;          // double
+    case 8: skip_bytes(varint()); return;   // binary
+    case 9: case 10: {                      // list/set
+      if (p >= end) { ok = false; return; }
+      uint8_t h = *p++;
+      size_t n = h >> 4;
+      int et = h & 0x0F;
+      if (n == 15) n = varint();
+      for (size_t i = 0; i < n && ok; i++) {
+        if (et == 1 || et == 2) skip_bytes(1);  // bool element = 1 byte
+        else skip_value(et);
+      }
+      return;
+    }
+    case 11: {                              // map
+      size_t n = varint();
+      if (n) {
+        if (p >= end) { ok = false; return; }
+        uint8_t kv = *p++;
+        for (size_t i = 0; i < n && ok; i++) {
+          skip_value(kv >> 4);
+          skip_value(kv & 0x0F);
+        }
+      }
+      return;
+    }
+    case 12: skip_struct(); return;         // struct
+    default: ok = false; return;
+  }
+}
+
+// Parse one struct, capturing i32/i64/bool fields into slots[fid] when
+// fid < cap (slots preinitialized by caller); nested structs are parsed
+// recursively only when sub_fid matches, else skipped.
+void parse_flat(CReader& r, long long* slots, int cap) {
+  int last_fid = 0;
+  while (r.ok) {
+    if (r.p >= r.end) { r.ok = false; return; }
+    uint8_t b = *r.p++;
+    if (b == 0) return;
+    int ctype = b & 0x0F;
+    int delta = (b >> 4) & 0x0F;
+    int fid = delta ? last_fid + delta
+                    : static_cast<int>(r.zigzag());
+    last_fid = fid;
+    if (ctype == 1 || ctype == 2) {
+      if (fid >= 0 && fid < cap) slots[fid] = (ctype == 1);
+      continue;
+    }
+    if ((ctype >= 4 && ctype <= 6) && fid >= 0 && fid < cap) {
+      slots[fid] = r.zigzag();
+      continue;
+    }
+    r.skip_value(ctype);
+  }
+}
+
+}  // namespace
+
+// Per page, 16 output slots:
+//  0 page_type, 1 payload_off, 2 compressed_size, 3 uncompressed_size,
+//  4 crc(-1 absent), 5 num_values, 6 encoding, 7 def_enc, 8 rep_enc,
+//  9 num_nulls(-1), 10 dl_len(-1), 11 rl_len(-1), 12 is_compressed(-1),
+// 13 dict_num_values(-1), 14 dict_encoding(-1), 15 reserved
+ptrdiff_t pftpu_split_pages(const uint8_t* data, size_t data_len,
+                            long long num_values, long long* out,
+                            size_t cap_pages) {
+  CReader r{data, data + data_len};
+  long long seen = 0;
+  size_t n_pages = 0;
+  while (seen < num_values && r.p < r.end) {
+    if (n_pages >= cap_pages) return -2;
+    long long* o = out + n_pages * 16;
+    for (int i = 0; i < 16; i++) o[i] = -1;
+    // PageHeader fields: 1 type, 2 uncompressed, 3 compressed, 4 crc,
+    // 5 data_page_header, 7 dictionary_page_header, 8 data_page_header_v2
+    int last_fid = 0;
+    bool stop = false;
+    while (r.ok && !stop) {
+      if (r.p >= r.end) { r.ok = false; break; }
+      uint8_t b = *r.p++;
+      if (b == 0) { stop = true; break; }
+      int ctype = b & 0x0F;
+      int delta = (b >> 4) & 0x0F;
+      int fid = delta ? last_fid + delta : static_cast<int>(r.zigzag());
+      last_fid = fid;
+      if (ctype >= 4 && ctype <= 6 && fid >= 1 && fid <= 4) {
+        long long v = r.zigzag();
+        if (fid == 1) o[0] = v;
+        else if (fid == 2) o[3] = v;
+        else if (fid == 3) o[2] = v;
+        else { o[4] = v; o[15] = 1; }  // crc may be negative: flag presence
+        continue;
+      }
+      if (ctype == 12 && (fid == 5 || fid == 7 || fid == 8)) {
+        long long slots[16];
+        for (int i = 0; i < 16; i++) slots[i] = -1;
+        parse_flat(r, slots, 16);
+        if (fid == 5) {           // DataPageHeader: v, enc, def, rep
+          o[5] = slots[1]; o[6] = slots[2]; o[7] = slots[3]; o[8] = slots[4];
+        } else if (fid == 7) {    // DictionaryPageHeader
+          o[13] = slots[1]; o[14] = slots[2];
+        } else {                  // DataPageHeaderV2
+          o[5] = slots[1]; o[9] = slots[2]; o[6] = slots[4];
+          o[10] = slots[5]; o[11] = slots[6]; o[12] = slots[7];
+          o[13] = slots[3];  // num_rows (slot shared with dict pages)
+        }
+        continue;
+      }
+      r.skip_value(ctype);
+    }
+    if (!r.ok || o[0] < 0 || o[2] < 0) return -1;
+    o[1] = r.p - data;  // payload offset
+    if (static_cast<size_t>(o[1]) + static_cast<size_t>(o[2]) > data_len)
+      return -1;
+    r.p += o[2];
+    if (o[0] == 0 || o[0] == 3) {  // DATA_PAGE or DATA_PAGE_V2
+      if (o[5] < 0) return -1;
+      seen += o[5];
+    }
+    n_pages++;
+  }
+  return static_cast<ptrdiff_t>(n_pages);
+}
+
 }  // extern "C"

@@ -1452,8 +1452,12 @@ class TpuRowGroupReader:
         ``(columns_dict, covered)`` where ``covered`` lists the
         page-aligned row ranges the decoded rows correspond to; falls
         back to the whole group when any chunk lacks an OffsetIndex."""
+        from ..batch.predicate import normalize_ranges
+
         rg = self.reader.row_groups[index]
         n = int(rg.num_rows or 0)
+        if not normalize_ranges(row_ranges, n):
+            return {}, []  # predicate excluded every row
         chunk_filter = set(columns) if columns else None
         chunks = [
             c for c in rg.columns or []

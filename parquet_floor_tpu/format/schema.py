@@ -602,6 +602,18 @@ class types:
         )
 
     @staticmethod
+    def map_of(key: SchemaNode, value: SchemaNode, name: str,
+               optional: bool = False) -> GroupType:
+        """Standard MAP structure: (optional) group MAP > repeated group
+        key_value > [required key, value]."""
+        kv = GroupType("key_value", [key, value], repetition=REPEATED)
+        return GroupType(
+            name, [kv],
+            repetition=OPTIONAL if optional else REQUIRED,
+            logical_type=LogicalAnnotation("MAP"),
+        )
+
+    @staticmethod
     def message(name: str, *fields: SchemaNode) -> MessageType:
         return MessageType(name, list(fields))
 

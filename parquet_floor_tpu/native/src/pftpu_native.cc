@@ -498,14 +498,17 @@ struct CReader {
 };
 
 void CReader::skip_value(int ctype) {
+  // every container path is depth-bounded: hostile nesting must return an
+  // error, never exhaust the C stack or spin without consuming input
+  if (++depth > 64) { ok = false; return; }
   switch (ctype) {
-    case 1: case 2: return;                 // bool in header
-    case 3: skip_bytes(1); return;          // byte
-    case 4: case 5: case 6: (void)varint(); return;  // i16/i32/i64
-    case 7: skip_bytes(8); return;          // double
-    case 8: skip_bytes(varint()); return;   // binary
+    case 1: case 2: break;                  // bool in header
+    case 3: skip_bytes(1); break;           // byte
+    case 4: case 5: case 6: (void)varint(); break;  // i16/i32/i64
+    case 7: skip_bytes(8); break;           // double
+    case 8: skip_bytes(varint()); break;    // binary
     case 9: case 10: {                      // list/set
-      if (p >= end) { ok = false; return; }
+      if (p >= end) { ok = false; break; }
       uint8_t h = *p++;
       size_t n = h >> 4;
       int et = h & 0x0F;
@@ -514,23 +517,29 @@ void CReader::skip_value(int ctype) {
         if (et == 1 || et == 2) skip_bytes(1);  // bool element = 1 byte
         else skip_value(et);
       }
-      return;
+      break;
     }
     case 11: {                              // map
       size_t n = varint();
       if (n) {
-        if (p >= end) { ok = false; return; }
+        if (p >= end) { ok = false; break; }
         uint8_t kv = *p++;
+        int kt = kv >> 4;
+        int vt = kv & 0x0F;
         for (size_t i = 0; i < n && ok; i++) {
-          skip_value(kv >> 4);
-          skip_value(kv & 0x0F);
+          // bool elements occupy one byte in containers (skip_value's
+          // header-bool path consumes nothing — that would spin forever
+          // on a hostile count)
+          if (kt == 1 || kt == 2) skip_bytes(1); else skip_value(kt);
+          if (vt == 1 || vt == 2) skip_bytes(1); else skip_value(vt);
         }
       }
-      return;
+      break;
     }
-    case 12: skip_struct(); return;         // struct
-    default: ok = false; return;
+    case 12: skip_struct(); break;          // struct
+    default: ok = false; break;
   }
+  depth--;
 }
 
 // Parse one struct, capturing i32/i64/bool fields into slots[fid] when

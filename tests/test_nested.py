@@ -312,3 +312,39 @@ def test_tpu_engine_nested_pyarrow_file(tmp_path):
     # sibling leaves under one top-level group get distinct dotted keys
     assert got["v.list.element.a"] == exp_a
     assert got["v.list.element.b"] == exp_b
+
+
+def test_map_type_read_and_write(tmp_path):
+    """Parquet MAP columns: pyarrow-written maps assemble as parallel
+    key/value leaves; our map_of schema round-trips through pyarrow."""
+    # read: pyarrow-written
+    t = pa.table({"m": pa.array(
+        [[("a", 1), ("b", 2)], [], None, [("c", 3)]],
+        type=pa.map_(pa.string(), pa.int64()),
+    )})
+    p1 = str(tmp_path / "pam.parquet")
+    pq.write_table(t, p1)
+    with ParquetFileReader(p1) as r:
+        got = {}
+        for cb in r.read_row_group(0).columns:
+            got[cb.descriptor.path[-1]] = assemble_nested(r.schema, cb).to_pylist()
+    assert got["key"] == [[b"a", b"b"], [], None, [b"c"]]
+    assert got["value"] == [[1, 2], [], None, [3]]
+
+    # write: our map_of schema, shredded per leaf, readable by pyarrow
+    schema = types.message(
+        "m",
+        types.map_of(
+            types.required(types.BYTE_ARRAY).as_(types.string()).named("key"),
+            types.optional(types.INT64).named("value"),
+            "tags", optional=True,
+        ),
+    )
+    keys = [["x", "y"], [], None, ["z"]]
+    vals = [[7, None], [], None, [9]]
+    p2 = str(tmp_path / "ourm.parquet")
+    with ParquetFileWriter(p2, schema, WriterOptions()) as w:
+        w.write_columns({"tags.key_value.key": keys,
+                         "tags.key_value.value": vals})
+    back = pq.read_table(p2).column("tags").to_pylist()
+    assert back == [[("x", 7), ("y", None)], [], None, [("z", 9)]]

@@ -202,9 +202,7 @@ def test_native_split_pages_matches_python(tmp_path):
                 # must not outlive the reader
                 raw = bytes(r.source.read_at(start, meta.total_compressed_size))
                 nat = pg._split_pages_native(raw, meta.num_values)
-                py = pg.split_pages.__wrapped__(raw, meta.num_values) if hasattr(
-                    pg.split_pages, "__wrapped__") else None
-                # force the python path by building pages manually
+                # force the python path
                 import parquet_floor_tpu.format.pages as pgm
                 saved = pgm._native
                 pgm._native = None
@@ -265,3 +263,23 @@ def test_native_split_pages_hostile_input():
         binding.split_pages(hostile, 10)
     except ValueError:
         pass  # clean rejection is fine; silent OOB write is what we fear
+
+
+def test_native_split_pages_hostile_containers():
+    """Nested lists and huge bool-element maps must be rejected bounded in
+    time and stack (the depth guard covers every container path)."""
+    import pytest
+    from parquet_floor_tpu.native import binding
+
+    if not binding.available():
+        pytest.skip("native lib not built")
+    # unbounded LIST nesting: each 0x19 byte = list header (size 1, list elem)
+    deep_lists = bytes([0x19]) * 200_000
+    with pytest.raises(ValueError):
+        binding.split_pages(deep_lists, 1000)
+    # map with an astronomical count of bool elements must not spin:
+    # field header ctype 11 (map), varint count 2^35, kv types bool/bool
+    import struct as _s
+    hostile = bytes([0x1B]) + bytes([0x80] * 4 + [0x02]) + bytes([0x11])
+    with pytest.raises(ValueError):
+        binding.split_pages(hostile + b"\x00" * 8, 1000)

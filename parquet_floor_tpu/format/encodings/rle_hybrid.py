@@ -43,8 +43,9 @@ def _read_varint(buf, pos: int) -> Tuple[int, int]:
     while True:
         if pos >= n:
             raise ValueError("truncated varint in RLE/bit-packed stream")
-        b = buf[pos]
-        pos += 1
+        b = int(buf[pos])  # plain int: np.uint8 scalars poison later
+        pos += 1           # arithmetic under NEP-50 promotion rules
+
         result |= (b & 0x7F) << shift
         if not (b & 0x80):
             return result, pos

@@ -610,6 +610,7 @@ class _DevStage:
                 size = page.header.uncompressed_page_size
                 self.dict_off = arena.add_decompress(codec, page.payload, size)
                 self.dict_size = size
+                self.dict_count = int(dh.num_values or 0)
             elif page.page_type == PageType.DATA_PAGE:
                 h = page.header.data_page_header
                 if max_def > 0 and h.definition_level_encoding not in (
@@ -677,6 +678,20 @@ class _DevStage:
                 self.kind = "plain_rows"
             else:
                 raise _Fallback(f"PLAIN device decode for {Type.name(pt)}")
+        elif (
+            pt == Type.BYTE_ARRAY
+            and self.dict_off >= 0
+            and encs <= {
+                Encoding.RLE_DICTIONARY, Encoding.PLAIN_DICTIONARY,
+                Encoding.PLAIN,
+            }
+        ):
+            # dictionary-overflow chunks (pyarrow writes PLAIN fallback
+            # pages once the dictionary page limit is hit): host maps every
+            # value to (start, len) — via the dict pool for dict pages,
+            # via the native chain scan for PLAIN pages — and the device
+            # byte gather rides the plain_str path
+            self.kind = "mixed_str"
         elif encs == {Encoding.DELTA_BINARY_PACKED} and pt in (
             Type.INT32, Type.INT64,
         ):
@@ -804,13 +819,45 @@ class _DevStage:
                 spec["sc_off"] = slabb.add([self.dict_off])
                 spec["extra_idx"] = -2  # patched by the engine (order of use)
                 spec["_extra_key"] = key
-        elif self.kind in ("plain_str", "dlba"):
+        elif self.kind in ("plain_str", "dlba", "mixed_str"):
             from ..format.encodings import delta as e_delta
 
+            dict_starts = dict_lens = None
+            if self.kind == "mixed_str":
+                region = arena[self.dict_off : self.dict_off + self.dict_size]
+                # exact count from the dictionary page header: the Python
+                # scan fallback decodes exactly `count` entries (an
+                # overestimate would read past the pool and raise)
+                dict_starts, dict_lens = _scan_plain_strings(
+                    region, self.dict_count
+                )
+                if len(dict_starts) != self.dict_count:
+                    raise _ForceHost(self.name)
+                dict_starts = dict_starts + self.dict_off
             starts_all = []
             lens_all = []
             for p, val_off, nn in zip(self.pages, val_offs, nns):
                 if not nn:
+                    continue
+                if self.kind == "mixed_str" and p.enc in (
+                    Encoding.RLE_DICTIONARY, Encoding.PLAIN_DICTIONARY,
+                ):
+                    page_bw = int(arena[val_off])
+                    if page_bw > 32:
+                        raise _ForceHost(self.name)
+                    if page_bw == 0:
+                        idx = np.zeros(nn, np.int64)
+                    else:
+                        idx, _ = e_rle.decode_rle_hybrid(
+                            arena, nn, page_bw, pos=val_off + 1
+                        )
+                        idx = idx.astype(np.int64)
+                    if idx.size and int(idx.max()) >= len(dict_starts):
+                        raise ValueError(
+                            f"dictionary index out of range in {self.name}"
+                        )
+                    starts_all.append(dict_starts[idx])
+                    lens_all.append(dict_lens[idx])
                     continue
                 if self.kind == "dlba":
                     region_size = p.off + p.size - val_off

@@ -508,3 +508,57 @@ def test_tpu_ranged_decode(tmp_path):
         assert fcov == [(0, n)] and np.asarray(full["x"].values).shape[0] == n
     finally:
         t.close()
+
+
+def test_mixed_dict_plain_string_chunk(tmp_path):
+    """pyarrow dictionary-overflow chunks (dict pages then PLAIN fallback
+    pages in one chunk) decode on the device string path."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = 30_000
+    vals = [f"unique-value-{i:07d}" for i in range(n)]
+    path = str(tmp_path / "mix.parquet")
+    pq.write_table(
+        pa.table({"s": vals}), path, use_dictionary=True,
+        dictionary_pagesize_limit=16 * 1024, compression="SNAPPY",
+    )
+    t = TpuRowGroupReader(path)
+    try:
+        sg = t._stage_row_group(0, None)
+        assert [s.kind for s in sg.program] == ["plain_str"], [
+            s.kind for s in sg.program
+        ]
+        dc = t.read_row_group(0)["s"]
+        rows = np.asarray(dc.values)
+        lens = np.asarray(dc.lengths)
+        got = [rows[i, : lens[i]].tobytes().decode() for i in range(0, n, 501)]
+        assert got == vals[0::501]
+    finally:
+        t.close()
+    _check_against_host(path)
+
+
+def test_mixed_chunk_python_fallback_scan(tmp_path, monkeypatch):
+    """Regression: the mixed_str dict-pool scan must work with the pure-
+    Python chain walker too (exact count from the dict page header)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import parquet_floor_tpu.native.binding as binding
+
+    vals = [f"unique-value-{i:07d}" for i in range(8000)]
+    path = str(tmp_path / "mixpy.parquet")
+    pq.write_table(
+        pa.table({"s": vals}), path, use_dictionary=True,
+        dictionary_pagesize_limit=8 * 1024, compression="SNAPPY",
+    )
+    monkeypatch.setattr(binding, "available", lambda: False)
+    t = TpuRowGroupReader(path)
+    try:
+        dc = t.read_row_group(0)["s"]
+        rows = np.asarray(dc.values)
+        lens = np.asarray(dc.lengths)
+        got = [rows[i, : lens[i]].tobytes().decode() for i in range(0, 8000, 497)]
+        assert got == vals[0::497]
+    finally:
+        t.close()

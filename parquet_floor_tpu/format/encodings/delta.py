@@ -2,9 +2,10 @@
 
 The v2 integer/binary encodings that PARQUET_2_0 writers (the reference pins
 v2 at ``ParquetWriter.java:66``) may emit and every reader must handle.
-NumPy reference implementation; arithmetic is two's-complement wraparound in
-uint64 (matching parquet-mr's long arithmetic), so the full int64 delta range
-round-trips bit-exactly.
+NumPy reference implementation.  Delta arithmetic wraps at the **column's
+physical width**: uint64 for INT64 columns (full int64 delta range
+round-trips bit-exactly) and uint32 for INT32 columns (miniblock widths
+must stay ≤32 — arrow's DeltaBitPackDecoder rejects wider).
 
 Wire format (Parquet spec "Delta encoding")::
 
@@ -85,26 +86,52 @@ def decode_delta_binary_packed(data, pos: int = 0, out_dtype=np.int64):
     return signed.copy(), pos
 
 
-def encode_delta_binary_packed(values: np.ndarray) -> bytes:
-    """Encode int32/int64 values with standard 128/4 geometry."""
+def encode_delta_binary_packed(values: np.ndarray, bit_width: int = 0) -> bytes:
+    """Encode int32/int64 values with standard 128/4 geometry.
+
+    ``bit_width`` is the column's physical width (32 or 64); delta
+    arithmetic wraps there (spec): 32-bit columns must produce ≤32-bit
+    miniblock widths — 64-bit deltas on an int32 column make widths >32
+    that other readers (arrow's DeltaBitPackDecoder) reject.  When 0,
+    inferred from the array dtype (callers with the column descriptor in
+    hand should pass it explicitly).
+    """
     v = np.asarray(values)
-    v64 = v.astype(np.int64, copy=False).view(np.uint64)
-    n = len(v64)
+    if bit_width not in (0, 32, 64):
+        raise ValueError(f"bit_width must be 32 or 64, got {bit_width}")
+    if bit_width:
+        narrow = bit_width == 32
+    else:
+        narrow = v.dtype.itemsize <= 4 and np.issubdtype(v.dtype, np.integer)
+    if narrow:
+        vu = v.astype(np.int32, copy=False).view(np.uint32)
+    else:
+        vu = v.astype(np.int64, copy=False).view(np.uint64)
+    n = len(vu)
     out = bytearray()
     _write_varint(out, _BLOCK)
     _write_varint(out, _MINIBLOCKS)
     _write_varint(out, n)
-    _write_zigzag(out, int(v64[0].view(np.int64)) if n else 0)
+    if narrow:
+        _write_zigzag(out, int(vu[0].view(np.int32)) if n else 0)
+    else:
+        _write_zigzag(out, int(vu[0].view(np.int64)) if n else 0)
     if n <= 1:
         return bytes(out)
-    deltas = (v64[1:] - v64[:-1])  # wraparound uint64
+    deltas = (vu[1:] - vu[:-1]).astype(np.uint64)  # wraparound at width
+    if narrow:
+        # reinterpret each 32-bit wrapped delta as signed, pick min there
+        sdeltas = deltas.astype(np.uint32).view(np.int32).astype(np.int64)
+    else:
+        sdeltas = deltas.view(np.int64)
     n_deltas = len(deltas)
+    mask = np.uint64(0xFFFFFFFF) if narrow else np.uint64(0xFFFFFFFFFFFFFFFF)
     for b0 in range(0, n_deltas, _BLOCK):
         block = deltas[b0 : b0 + _BLOCK]
-        sblock = block.view(np.int64)
+        sblock = sdeltas[b0 : b0 + _BLOCK]
         min_delta = int(sblock.min())
         _write_zigzag(out, min_delta)
-        adj = block - np.uint64(min_delta & 0xFFFFFFFFFFFFFFFF)
+        adj = (block - np.uint64(min_delta & int(mask))) & mask
         widths = []
         packed_parts = []
         for m in range(_MINIBLOCKS):

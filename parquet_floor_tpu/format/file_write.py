@@ -145,7 +145,9 @@ class _ColumnChunkWriter:
         if encoding == Encoding.PLAIN:
             return e_plain.encode_plain(values, pt, self.desc.type_length)
         if encoding == Encoding.DELTA_BINARY_PACKED:
-            return e_delta.encode_delta_binary_packed(np.asarray(values))
+            return e_delta.encode_delta_binary_packed(
+                np.asarray(values), bit_width=32 if pt == Type.INT32 else 64
+            )
         if encoding == Encoding.BYTE_STREAM_SPLIT:
             dt = _NUMPY_DTYPE[pt]
             return e_bss.encode_byte_stream_split(np.asarray(values, dtype=dt))

@@ -1,0 +1,149 @@
+"""Generative soak: random schemas × random data × random writer options,
+round-tripped through our writer, our host reader, the TPU engine, and
+the pyarrow oracle.  The closest thing to fuzzing the full stack."""
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_floor_tpu import (
+    CompressionCodec,
+    ParquetFileReader,
+    ParquetFileWriter,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+
+_CODECS = [
+    CompressionCodec.UNCOMPRESSED,
+    CompressionCodec.SNAPPY,
+    CompressionCodec.GZIP,
+    CompressionCodec.ZSTD,
+    CompressionCodec.LZ4_RAW,
+]
+
+
+def _random_column(rng, n, idx):
+    """(field_builder, data, pyarrow_comparator) for one random column."""
+    kind = rng.integers(0, 6)
+    optional = bool(rng.integers(0, 2))
+    name = f"c{idx}"
+    t = types
+
+    def opt(values):
+        if not optional:
+            return values
+        return [None if rng.random() < 0.25 else v for v in values]
+
+    if kind == 0:
+        b = (t.optional if optional else t.required)(t.INT64)
+        data = opt([int(v) for v in rng.integers(-(2**62), 2**62, n)])
+    elif kind == 1:
+        b = (t.optional if optional else t.required)(t.INT32)
+        data = opt([int(v) for v in rng.integers(-(2**31), 2**31, n)])
+    elif kind == 2:
+        b = (t.optional if optional else t.required)(t.DOUBLE)
+        data = opt([float(v) for v in rng.standard_normal(n)])
+    elif kind == 3:
+        b = (t.optional if optional else t.required)(t.FLOAT)
+        data = opt([float(np.float32(v)) for v in rng.standard_normal(n)])
+    elif kind == 4:
+        b = (t.optional if optional else t.required)(t.BOOLEAN)
+        data = opt([bool(v) for v in rng.integers(0, 2, n)])
+    else:
+        b = (t.optional if optional else t.required)(t.BYTE_ARRAY).as_(t.string())
+        card = int(rng.choice([3, 50, 100_000]))  # low → dict; high → fallback
+        data = opt([f"s{int(v)}" for v in rng.integers(0, card, n)])
+    return b.named(name), name, data
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_roundtrip(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 4000))
+    n_cols = int(rng.integers(1, 6))
+    fields, names, datas = [], [], []
+    for i in range(n_cols):
+        f, name, data = _random_column(rng, n, i)
+        fields.append(f)
+        names.append(name)
+        datas.append(data)
+    schema = types.message("t", *fields)
+    opts = WriterOptions(
+        codec=int(rng.choice(_CODECS)),
+        page_version=int(rng.choice([1, 2])),
+        data_page_values=int(rng.choice([97, 500, 20_000])),
+        enable_dictionary=bool(rng.integers(0, 2)),
+        delta_integers=bool(rng.integers(0, 2)),
+        byte_stream_split_floats=bool(rng.integers(0, 2)),
+        row_group_rows=int(rng.choice([n, max(1, n // 3)])),
+    )
+    path = str(tmp_path / f"soak{seed}.parquet")
+    with ParquetFileWriter(path, schema, opts) as w:
+        done = 0
+        while done < n:
+            take = min(opts.row_group_rows, n - done)
+            w.write_columns({nm: d[done : done + take] for nm, d in zip(names, datas)})
+            done += take
+
+    # oracle 1: pyarrow reads identical values
+    table = pq.read_table(path)
+    for nm, exp in zip(names, datas):
+        got = table.column(nm).to_pylist()
+        if exp and isinstance(next((v for v in exp if v is not None), None), float):
+            assert len(got) == len(exp)
+            for g, e in zip(got, exp):
+                assert (g is None) == (e is None)
+                if g is not None:
+                    assert g == pytest.approx(e, rel=0, abs=0) or (
+                        np.isnan(g) and np.isnan(e)
+                    )
+        else:
+            assert got == exp, f"seed {seed} col {nm}"
+
+    # oracle 2: host reader agrees
+    with ParquetFileReader(path) as r:
+        per_col = {nm: [] for nm in names}
+        for gi in range(len(r.row_groups)):
+            batch = r.read_row_group(gi)
+            for cb in batch.columns:
+                nm = cb.descriptor.path[0]
+                for i in range(batch.num_rows):
+                    v = cb.cell(i)
+                    if isinstance(v, bytes):
+                        v = v.decode()
+                    elif isinstance(v, np.generic):
+                        v = v.item()
+                    per_col[nm].append(v)
+        for nm, exp in zip(names, datas):
+            assert per_col[nm] == exp, f"seed {seed} host col {nm}"
+
+    # oracle 3: TPU engine matches the host dense forms
+    with TpuRowGroupReader(path, float64_policy="float64") as tr, \
+            ParquetFileReader(path) as hr:
+        for gi in range(tr.num_row_groups):
+            dev = tr.read_row_group(gi)
+            hb = hr.read_row_group(gi)
+            for cb in hb.columns:
+                nm = cb.descriptor.path[0]
+                dc = dev[nm]
+                dense, mask = cb.dense()
+                if mask is not None:
+                    np.testing.assert_array_equal(
+                        np.asarray(dc.mask), mask, err_msg=f"seed {seed} {nm}"
+                    )
+                if isinstance(dense, ByteArrayColumn):
+                    lens = np.asarray(dc.lengths)
+                    rows = np.asarray(dc.values)
+                    got = [rows[i, : lens[i]].tobytes() for i in range(len(lens))]
+                    assert got == dense.to_list(), f"seed {seed} {nm}"
+                else:
+                    got = np.asarray(dc.values)
+                    if mask is not None:
+                        got = np.where(mask, 0, got)
+                        dense = np.where(mask, 0, dense)
+                    np.testing.assert_array_equal(
+                        got, dense, err_msg=f"seed {seed} {nm}"
+                    )

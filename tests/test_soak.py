@@ -147,3 +147,85 @@ def test_random_roundtrip(tmp_path, seed):
                     np.testing.assert_array_equal(
                         got, dense, err_msg=f"seed {seed} {nm}"
                     )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_nested_roundtrip(tmp_path, seed):
+    """Random LIST columns (optional lists, optional elements, random
+    lengths incl. empties) through writer → pyarrow + host + TPU."""
+    rng = np.random.default_rng(100 + seed)
+    n = int(rng.integers(1, 1500))
+    elem_optional = bool(rng.integers(0, 2))
+    list_optional = bool(rng.integers(0, 2))
+    str_elems = bool(rng.integers(0, 2))
+
+    def elem():
+        if elem_optional and rng.random() < 0.2:
+            return None
+        if str_elems:
+            return f"e{int(rng.integers(0, 50))}"
+        return int(rng.integers(-1000, 1000))
+
+    rows = []
+    for _ in range(n):
+        if list_optional and rng.random() < 0.15:
+            rows.append(None)
+        else:
+            rows.append([elem() for _ in range(int(rng.integers(0, 6)))])
+
+    t = types
+    eb = (t.optional if elem_optional else t.required)(
+        t.BYTE_ARRAY if str_elems else t.INT64
+    )
+    if str_elems:
+        eb = eb.as_(t.string())
+    schema = t.message(
+        "m", t.list_of(eb.named("element"), "v", optional=list_optional)
+    )
+    opts = WriterOptions(
+        codec=int(rng.choice(_CODECS)),
+        page_version=int(rng.choice([1, 2])),
+        data_page_values=int(rng.choice([131, 5000])),
+        enable_dictionary=bool(rng.integers(0, 2)),
+    )
+    path = str(tmp_path / f"ns{seed}.parquet")
+    with ParquetFileWriter(path, schema, opts) as w:
+        w.write_columns({"v": rows})
+
+    # pyarrow oracle
+    got = pq.read_table(path).column("v").to_pylist()
+    assert got == rows, f"seed {seed}"
+
+    # host assembly
+    from parquet_floor_tpu.batch.nested import assemble_nested
+
+    with ParquetFileReader(path) as r:
+        out = []
+        for gi in range(len(r.row_groups)):
+            cb = r.read_row_group(gi).columns[0]
+            out.extend(assemble_nested(r.schema, cb).to_pylist())
+    if str_elems:
+        out = [
+            None if row is None else [
+                None if e is None else e.decode() for e in row
+            ]
+            for row in out
+        ]
+    assert out == rows, f"seed {seed} host"
+
+    # TPU engine assembly
+    with ParquetFileReader(path) as hr:
+        sch = hr.schema
+    with TpuRowGroupReader(path) as tr:
+        out2 = []
+        for gi in range(tr.num_row_groups):
+            (dc,) = tr.read_row_group(gi).values()
+            out2.extend(dc.assemble(sch).to_pylist())
+    if str_elems:
+        out2 = [
+            None if row is None else [
+                None if e is None else e.decode() for e in row
+            ]
+            for row in out2
+        ]
+    assert out2 == rows, f"seed {seed} tpu"

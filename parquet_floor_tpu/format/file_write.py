@@ -73,6 +73,7 @@ class WriterOptions:
     write_crc: bool = True
     delta_integers: bool = False  # use DELTA_BINARY_PACKED for int cols
     byte_stream_split_floats: bool = False
+    delta_strings: bool = False   # v2: DELTA_BYTE_ARRAY for non-dict strings
 
 
 @dataclass
@@ -138,6 +139,14 @@ class _ColumnChunkWriter:
             return Encoding.DELTA_BINARY_PACKED
         if opt.byte_stream_split_floats and pt in (Type.FLOAT, Type.DOUBLE):
             return Encoding.BYTE_STREAM_SPLIT
+        if (
+            opt.delta_strings
+            and opt.page_version == 2
+            and pt == Type.BYTE_ARRAY
+        ):
+            # parquet-mr's PARQUET_2_0 writer emits DELTA_BYTE_ARRAY for
+            # non-dictionary string columns (the reference pins v2)
+            return Encoding.DELTA_BYTE_ARRAY
         return Encoding.PLAIN
 
     def _encode_values(self, values, encoding: int) -> bytes:
@@ -151,6 +160,12 @@ class _ColumnChunkWriter:
         if encoding == Encoding.BYTE_STREAM_SPLIT:
             dt = _NUMPY_DTYPE[pt]
             return e_bss.encode_byte_stream_split(np.asarray(values, dtype=dt))
+        if encoding == Encoding.DELTA_BYTE_ARRAY:
+            col = (
+                values if isinstance(values, ByteArrayColumn)
+                else ByteArrayColumn.from_list([bytes(v) for v in values])
+            )
+            return e_delta.encode_delta_byte_array(col)
         raise ValueError(f"unsupported write encoding {Encoding.name(encoding)}")
 
     def _slice_values(self, values, lo: int, hi: int):

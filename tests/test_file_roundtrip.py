@@ -209,3 +209,45 @@ def test_truncated_plain_page_raises(tmp_path):
 
     with pytest.raises(ValueError, match="truncated"):
         e_plain.decode_plain(b"\x01\x02", 100, _T.INT64)
+
+
+def test_delta_byte_array_write(tmp_path):
+    """delta_strings option: v2 non-dict strings write as DELTA_BYTE_ARRAY
+    (parquet-mr's PARQUET_2_0 behavior); pyarrow and our readers agree."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    from parquet_floor_tpu import (
+        Encoding, ParquetFileReader, ParquetFileWriter, WriterOptions, types,
+    )
+
+    rng = np.random.default_rng(83)
+    vals = [f"prefix-common-{int(v):05d}-suffix" for v in rng.integers(0, 10_000, 4000)]
+    opt = [None if rng.random() < 0.2 else v for v in vals]
+    schema = types.message(
+        "t",
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("o"),
+    )
+    path = str(tmp_path / "dba.parquet")
+    with ParquetFileWriter(
+        path, schema,
+        WriterOptions(enable_dictionary=False, delta_strings=True,
+                      page_version=2, data_page_values=700),
+    ) as w:
+        w.write_columns({"s": vals, "o": opt})
+    t = pq.read_table(path)
+    assert t.column("s").to_pylist() == vals
+    assert t.column("o").to_pylist() == opt
+    with ParquetFileReader(path) as r:
+        meta = r.row_groups[0].columns[0].meta_data
+        assert Encoding.DELTA_BYTE_ARRAY in meta.encodings
+        b = r.read_row_group(0)
+        assert b.column("s").values.to_list() == [v.encode() for v in vals]
+    # TPU engine host-fallback path still decodes correctly
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+    with TpuRowGroupReader(path) as tr:
+        dc = tr.read_row_group(0)["s"]
+        rows = np.asarray(dc.values); lens = np.asarray(dc.lengths)
+        assert rows[0, : lens[0]].tobytes().decode() == vals[0]

@@ -78,6 +78,7 @@ def test_random_roundtrip(tmp_path, seed):
         enable_dictionary=bool(rng.integers(0, 2)),
         delta_integers=bool(rng.integers(0, 2)),
         byte_stream_split_floats=bool(rng.integers(0, 2)),
+        delta_strings=bool(rng.integers(0, 2)),
         row_group_rows=int(rng.choice([n, max(1, n // 3)])),
     )
     path = str(tmp_path / f"soak{seed}.parquet")

@@ -1373,15 +1373,23 @@ class TpuRowGroupReader:
         if sync_transfers is None:
             sync_transfers = _os.environ.get("PFTPU_SYNC_TRANSFERS", "1") != "0"
         self.sync_transfers = sync_transfers
-        # Pallas expansion for uniform-bit-width streams (PFTPU_PALLAS=1).
-        # Opt-in and always in interpret mode for now: the kernel is exact
-        # (property-tested), but Mosaic's current op set can't lower the
-        # bit-matrix regroup (large uint8/irregular reshapes crash its
-        # compiler), so compiled mode would fail on the very platform the
-        # flag targets.  The jnp expansion path is nowhere near the
-        # pipeline bottleneck (~2 ms device decode vs ~250 ms host+ship).
-        self._pl_enabled = _os.environ.get("PFTPU_PALLAS", "") == "1"
-        self._pl_interp = self._pl_enabled
+        # Pallas expansion for uniform-bit-width streams.  The lane-gather
+        # kernel formulation compiles under Mosaic for bit_width ≤ 7
+        # (covers def/rep levels and small dictionaries) and runs ~1.3×
+        # the jnp expansion — default ON for those on a real TPU.  Wider
+        # streams stay on the jnp path (Mosaic cannot lower the bit-matrix
+        # regroup the wide kernel needs).  PFTPU_PALLAS=0 disables;
+        # PFTPU_PALLAS=1 forces it everywhere via interpret mode (tests).
+        pl_env = _os.environ.get("PFTPU_PALLAS", "")
+        if pl_env == "1":
+            self._pl_enabled = True
+            self._pl_interp = True
+        elif pl_env == "0":
+            self._pl_enabled = False
+            self._pl_interp = False
+        else:
+            self._pl_enabled = _platform_is_tpu()
+            self._pl_interp = False
         if host_threads is None:
             host_threads = min(8, _os.cpu_count() or 1)
         self._fill_pool = (
@@ -1596,6 +1604,9 @@ class TpuRowGroupReader:
         """Build the (bw, span_off, n_tiles, interpret) Pallas plan for a
         uniform-width stream, or () when gated off / not worthwhile."""
         if not self._pl_enabled or bw == 0 or bw > 32 or count < plk.TILE:
+            return ()
+        if not self._pl_interp and bw > plk.LANE_KERNEL_MAX_BW:
+            # compiled Mosaic supports only the lane-gather kernel
             return ()
         out_end = plan.reshape(5, n_runs)[0]
         tl, th = plk.tile_spans_padded(out_end, count)

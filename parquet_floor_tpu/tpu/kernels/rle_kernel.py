@@ -37,6 +37,14 @@ from jax.experimental.pallas import tpu as pltpu
 # Output tile: (SUB, LANE) int32 = 2048 values per grid step.
 _SUB, _LANE = 16, 128
 TILE = _SUB * _LANE
+# Tile window of the lane-gather kernel: one 1024-aligned DMA covering the
+# whole tile's packed span at bit_width ≤ 7 (≤ 1023 alignment residual +
+# 1792 packed bytes + 113 row span).
+_WIN = 3072
+# Widest bit width the lane-gather kernel handles (a 128-value row's span
+# must fit the post-roll 128-byte gather operand); the engine's Pallas
+# gating and the kernel dispatch below must agree on this.
+LANE_KERNEL_MAX_BW = 7
 
 
 def _tile_window_bytes(bit_width: int) -> int:
@@ -169,9 +177,8 @@ def rle_expand_pallas(
     """
     if bit_width == 0:
         return jnp.zeros(num_values, dtype=jnp.int32)
-    front = TILE * bit_width // 8 + 8
-    W = _tile_window_bytes(bit_width)
-    data_u8 = jnp.pad(data_u8, (front, W + 16))
+    front = ARENA_LEAD
+    data_u8 = jnp.pad(data_u8, (front, ARENA_TAIL))
     run_bitbase = run_bitbase + 8 * front
     return rle_expand_pallas_inline(
         data_u8, run_out_end, run_kind, run_value, run_bitbase,
@@ -185,6 +192,101 @@ def rle_expand_pallas(
 # stream end (tail).  Sized for the max bit width (32).
 ARENA_LEAD = TILE * 32 // 8 + 16    # 8208
 ARENA_TAIL = _tile_window_bytes(32) + 32  # 8240
+
+
+def _rle_expand_kernel_lane(
+    # scalar prefetch (SMEM)
+    tile_lo_ref, tile_hi_ref, run_out_end_ref, run_kind_ref,
+    run_value_ref, run_byte_ref,
+    # tensor inputs
+    data_hbm,           # uint8[B] in ANY/HBM
+    # outputs
+    out_ref,            # int32[SUB, LANE]
+    # scratch
+    win_ref,            # uint8[_WIN] one aligned tile-span window
+    sem,                # DMA semaphore
+    *, bit_width: int,
+):
+    """Mosaic-compilable variant for bit_width ≤ LANE_KERNEL_MAX_BW.
+
+    One 1024-aligned ``_WIN``-byte DMA per packed run loads the whole
+    tile's span into a 1-D scratch; 16 per-row uniform rolls align each
+    row's window start to lane 0 (row offsets are exactly linear — a
+    128-value row advances 16·bw whole bytes); each element's field then
+    comes from a *lane-wise* two-byte gather (``take_along_axis`` along
+    lanes — one of the two gather forms Mosaic lowers natively) plus
+    shift/mask.  No irregular reshapes, no byte-granular dynamic slices,
+    no strided rolls: every vector op is (16, 128)/(16, _WIN) int32.
+    """
+    t = pl.program_id(0)
+    tile_start = t * TILE
+    lo = tile_lo_ref[t]
+    hi = tile_hi_ref[t]
+
+    row_i = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0)
+    lane_i = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
+    gidx = tile_start + row_i * _LANE + lane_i
+
+    def body(r, acc):
+        zero = jnp.int32(0)
+        r_end = run_out_end_ref[r]
+        r_start = jnp.where(
+            r == zero, zero, run_out_end_ref[jnp.maximum(r - 1, zero)]
+        )
+        in_run = (gidx >= r_start) & (gidx < r_end)
+        kind = run_kind_ref[r]
+        rle_fill = jnp.where(in_run, run_value_ref[r], acc)
+
+        # run-relative bit position of the tile's element 0 (may be < 0;
+        # ARENA_LEAD slack keeps every window in bounds)
+        bit0 = (tile_start - r_start) * bit_width
+
+        def packed_branch(acc_in):
+            # ONE aligned DMA covers the whole tile's packed span: HBM
+            # uint8 slice offsets must be provably 1024-divisible and
+            # sizes 1024-multiples, and the tile needs ≤ 1023 (residual)
+            # + 1792 (2048·7 bits) + 113 ≤ 3072 bytes.
+            byte_off0 = (run_byte_ref[r] + (bit0 >> 3)).astype(jnp.int32)
+            aligned = pl.multiple_of(byte_off0 & ~jnp.int32(1023), 1024)
+            copy = pltpu.make_async_copy(
+                data_hbm.at[pl.ds(aligned, _WIN)],
+                win_ref,
+                sem,
+            )
+            copy.start()
+            copy.wait()
+            w1 = win_ref[:].reshape(1, _WIN).astype(jnp.int32)
+            # Row r's window begins δ_r = δ_0 + r·16·bw bytes into the
+            # buffer (exactly linear: 128·bw bits is a whole byte count).
+            # One uniform roll per row left-rotates by δ_r; amounts are
+            # kept positive in (0, _WIN] because compiled Mosaic treats
+            # dynamic shifts as unsigned mod 2³² (negative breaks), and
+            # its *strided* roll cannot cross vreg boundaries at all.
+            delta0 = byte_off0 - aligned
+            row_step = _LANE * bit_width // 8              # 16·bw
+            rolled = jnp.concatenate(
+                [
+                    pltpu.roll(w1, _WIN - (delta0 + rr * row_step), axis=1)
+                    for rr in range(_SUB)
+                ],
+                axis=0,
+            )
+            w128 = jax.lax.slice(rolled, (0, 0), (_SUB, _LANE))
+            # local bit position: row windows start byte-exact, so only
+            # bit0's sub-byte residual (same every row) and the lane remain
+            lam = (bit0 & 7) + lane_i * bit_width          # ≤ 7 + 127·7
+            b0 = lam >> 3
+            lo8 = jnp.take_along_axis(w128, b0, axis=1, mode="promise_in_bounds")
+            hi8 = jnp.take_along_axis(
+                w128, b0 + 1, axis=1, mode="promise_in_bounds"
+            )
+            vals = ((lo8 | (hi8 << 8)) >> (lam & 7)) & ((1 << bit_width) - 1)
+            return jnp.where(in_run, vals, acc_in)
+
+        return jax.lax.cond(kind == 1, packed_branch, lambda a: rle_fill, acc)
+
+    result = jax.lax.fori_loop(lo, hi, body, jnp.zeros((_SUB, _LANE), jnp.int32))
+    out_ref[:, :] = result
 
 
 def rle_expand_pallas_inline(
@@ -210,9 +312,14 @@ def rle_expand_pallas_inline(
     if bit_width == 0:
         return jnp.zeros(num_values, dtype=jnp.int32)
     n_tiles = pl.cdiv(num_values, TILE)
-    W = _tile_window_bytes(bit_width)
     run_byte = (run_bitbase // 8).astype(jnp.int32)
-    kernel = functools.partial(_rle_expand_kernel, bit_width=bit_width)
+    if bit_width <= LANE_KERNEL_MAX_BW:
+        # lane-gather formulation: the only one Mosaic compiles today
+        kernel = functools.partial(_rle_expand_kernel_lane, bit_width=bit_width)
+        scratch = pltpu.VMEM((_WIN,), jnp.uint8)
+    else:
+        kernel = functools.partial(_rle_expand_kernel, bit_width=bit_width)
+        scratch = pltpu.VMEM((1, _tile_window_bytes(bit_width)), jnp.uint8)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=6,
         grid=(n_tiles,),
@@ -221,7 +328,7 @@ def rle_expand_pallas_inline(
             (_SUB, _LANE), lambda t, *_: (t, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((1, W), jnp.uint8),
+            scratch,
             pltpu.SemaphoreType.DMA,
         ],
     )

@@ -1608,6 +1608,13 @@ class TpuRowGroupReader:
         if not self._pl_interp and bw > plk.LANE_KERNEL_MAX_BW:
             # compiled Mosaic supports only the lane-gather kernel
             return ()
+        if n_runs > 2048 or count > (1 << 24):
+            # run plans AND tile spans ride scalar prefetch (SMEM, 1 MiB
+            # per program): gate on the padded run count (what actually
+            # ships — hwm-sticky by design, since the padded plan is
+            # shared with the jnp path) and on the tile count.  Oversize
+            # streams stay on the jnp expansion instead of OOMing SMEM.
+            return ()
         out_end = plan.reshape(5, n_runs)[0]
         tl, th = plk.tile_spans_padded(out_end, count)
         span_off = slabb.add(np.concatenate([tl, th]))

@@ -562,3 +562,23 @@ def test_mixed_chunk_python_fallback_scan(tmp_path, monkeypatch):
         assert got == vals[0::497]
     finally:
         t.close()
+
+
+def test_pallas_gate_on_run_table_size(tmp_path, monkeypatch):
+    """Streams with huge run tables must stay on the jnp path: their plans
+    ride scalar prefetch (SMEM, 1 MiB/program) and would OOM compiled."""
+    n = 60_000
+    # alternating 9-runs of null/value: each stretch becomes its own RLE
+    # run (~6.7k runs for 60k values)
+    vals = [None if (i // 9) % 2 else float(i) for i in range(n)]
+    cols = {"x": (types.DOUBLE, vals, True, None)}
+    path = _write(tmp_path, cols, WriterOptions(), n=n)
+    monkeypatch.setenv("PFTPU_PALLAS", "1")
+    t = TpuRowGroupReader(path)
+    try:
+        sg = t._stage_row_group(0, None)
+        (spec,) = sg.program
+        assert spec.r_lvl > 2048
+        assert spec.pl_lvl == (), "huge run table must not take Pallas"
+    finally:
+        t.close()

@@ -29,9 +29,35 @@ __version__ = "0.1.0"
 
 __all__ = [
     "ColumnData", "ColumnDescriptor", "CompressionCodec", "Dehydrator",
-    "Encoding", "GroupType", "Hydrator", "HydratorSupplier",
+    "DeviceColumn", "Encoding", "GroupType", "Hydrator", "HydratorSupplier",
     "LogicalAnnotation", "MessageType", "NestedColumn", "ParquetFileReader",
     "ParquetFileWriter", "ParquetMetadata", "ParquetReader", "ParquetWriter",
-    "Predicate", "PrimitiveType", "Type", "assemble_nested", "col",
-    "shred_nested", "trace", "types", "ValueWriter", "WriterOptions",
+    "Predicate", "PrimitiveType", "TpuRowGroupReader", "Type",
+    "assemble_nested", "col", "read_sharded_global", "shred_nested", "trace",
+    "types", "ValueWriter", "WriterOptions",
 ]
+
+_LAZY = {
+    # the TPU engine (and jax with it) loads only on first use, keeping
+    # plain format/API imports light
+    "TpuRowGroupReader": ("parquet_floor_tpu.tpu.engine", "TpuRowGroupReader"),
+    "DeviceColumn": ("parquet_floor_tpu.tpu.engine", "DeviceColumn"),
+    "read_sharded_global": (
+        "parquet_floor_tpu.parallel.multihost", "read_sharded_global",
+    ),
+}
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(target[0]), target[1])
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1608,7 +1608,7 @@ class TpuRowGroupReader:
         if not self._pl_interp and bw > plk.LANE_KERNEL_MAX_BW:
             # compiled Mosaic supports only the lane-gather kernel
             return ()
-        if n_runs > 2048 or count > (1 << 24):
+        if n_runs > plk.PL_MAX_RUNS or count > plk.PL_MAX_VALUES:
             # run plans AND tile spans ride scalar prefetch (SMEM, 1 MiB
             # per program): gate on the padded run count (what actually
             # ships — hwm-sticky by design, since the padded plan is

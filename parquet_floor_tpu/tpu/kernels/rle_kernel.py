@@ -45,6 +45,10 @@ _WIN = 3072
 # must fit the post-roll 128-byte gather operand); the engine's Pallas
 # gating and the kernel dispatch below must agree on this.
 LANE_KERNEL_MAX_BW = 7
+# Scalar-prefetch (SMEM, 1 MiB/program) budget the engine's gating must
+# respect: run plans are 5·PL_MAX_RUNS int32 and tile spans 2·count/TILE.
+PL_MAX_RUNS = 2048
+PL_MAX_VALUES = 1 << 24
 
 
 def _tile_window_bytes(bit_width: int) -> int:

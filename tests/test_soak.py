@@ -59,7 +59,7 @@ def _random_column(rng, n, idx):
     return b.named(name), name, data
 
 
-@pytest.mark.parametrize("seed", range(12))
+@pytest.mark.parametrize("seed", range(18))
 def test_random_roundtrip(tmp_path, seed):
     rng = np.random.default_rng(seed)
     n = int(rng.integers(1, 4000))
@@ -150,7 +150,7 @@ def test_random_roundtrip(tmp_path, seed):
                     )
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("seed", range(12))
 def test_random_nested_roundtrip(tmp_path, seed):
     """Random LIST columns (optional lists, optional elements, random
     lengths incl. empties) through writer → pyarrow + host + TPU."""
@@ -232,7 +232,7 @@ def test_random_nested_roundtrip(tmp_path, seed):
     assert out2 == rows, f"seed {seed} tpu"
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("seed", range(12))
 def test_random_selective_reads(tmp_path, seed):
     """Fuzz predicate pushdown + selective page reads: for random files
     and random predicates, pruning must never drop a matching row, and

@@ -38,13 +38,16 @@ from jax.experimental.pallas import tpu as pltpu
 _SUB, _LANE = 16, 128
 TILE = _SUB * _LANE
 # Tile window of the lane-gather kernel: one 1024-aligned DMA covering the
-# whole tile's packed span at bit_width ≤ 7 (≤ 1023 alignment residual +
-# 1792 packed bytes + 113 row span).
+# whole tile's packed span.  The binding case is bit_width = 8: 1023
+# alignment residual + 2048 packed bytes = 3071 ≤ 3072 — an exact fit
+# (bit_width ≤ 7 needs only 1023 + 1792 + 113).
 _WIN = 3072
-# Widest bit width the lane-gather kernel handles (a 128-value row's span
-# must fit the post-roll 128-byte gather operand); the engine's Pallas
-# gating and the kernel dispatch below must agree on this.
-LANE_KERNEL_MAX_BW = 7
+# Widest bit width the lane-gather kernel handles: a 128-value row's span
+# must fit the post-roll 128-byte gather operand — ≤113 bytes for bw ≤ 7,
+# and exactly 128 for bw = 8, where fields are whole bytes so the clamped
+# high-byte gather contributes nothing.  The engine's Pallas gating and
+# the kernel dispatch below must agree on this.
+LANE_KERNEL_MAX_BW = 8
 # Scalar-prefetch (SMEM, 1 MiB/program) budget the engine's gating must
 # respect: run plans are 5·PL_MAX_RUNS int32 and tile spans 2·count/TILE.
 PL_MAX_RUNS = 2048
@@ -278,13 +281,18 @@ def _rle_expand_kernel_lane(
             w128 = jax.lax.slice(rolled, (0, 0), (_SUB, _LANE))
             # local bit position: row windows start byte-exact, so only
             # bit0's sub-byte residual (same every row) and the lane remain
-            lam = (bit0 & 7) + lane_i * bit_width          # ≤ 7 + 127·7
+            lam = (bit0 & 7) + lane_i * bit_width          # ≤ 7 + 127·bw
             b0 = lam >> 3
             lo8 = jnp.take_along_axis(w128, b0, axis=1, mode="promise_in_bounds")
-            hi8 = jnp.take_along_axis(
-                w128, b0 + 1, axis=1, mode="promise_in_bounds"
-            )
-            vals = ((lo8 | (hi8 << 8)) >> (lam & 7)) & ((1 << bit_width) - 1)
+            if bit_width == 8:
+                # fields are whole bytes (bit0 ≡ 0 mod 8): lo8 IS the value,
+                # and b0+1 would read lane 128 at the last element
+                vals = lo8
+            else:
+                hi8 = jnp.take_along_axis(
+                    w128, b0 + 1, axis=1, mode="promise_in_bounds"
+                )
+                vals = ((lo8 | (hi8 << 8)) >> (lam & 7)) & ((1 << bit_width) - 1)
             return jnp.where(in_run, vals, acc_in)
 
         return jax.lax.cond(kind == 1, packed_branch, lambda a: rle_fill, acc)

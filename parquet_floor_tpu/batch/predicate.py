@@ -68,8 +68,16 @@ def _chunk_stats(rg, name: str) -> Optional[_ChunkStats]:
     if st is None:
         return None
     pt = chunk.meta_data.type
-    mn = _decode_stat(pt, st.min_value if st.min_value is not None else st.min)
-    mx = _decode_stat(pt, st.max_value if st.max_value is not None else st.max)
+    # Legacy Statistics.min/max were written with signed byte comparison
+    # (and PARQUET-251 made them outright wrong for binary), so for
+    # BYTE_ARRAY/FLBA only the new min_value/max_value fields are
+    # trustworthy; treat legacy-only binary stats as unknown (keep the
+    # group), matching parquet-mr's StatisticsFilter.
+    binary = pt in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY)
+    raw_mn = st.min_value if st.min_value is not None else (None if binary else st.min)
+    raw_mx = st.max_value if st.max_value is not None else (None if binary else st.max)
+    mn = _decode_stat(pt, raw_mn)
+    mx = _decode_stat(pt, raw_mx)
     return _ChunkStats(mn, mx, st.null_count, chunk.meta_data.num_values)
 
 
@@ -211,9 +219,12 @@ def _cmp_may_match(op: str, value, mn, mx, null_count) -> bool:
 
 
 def _find_chunk(rg, name: str):
+    # Exact dotted-path match only: a bare top-level-group name must NOT
+    # resolve to the group's first leaf (pruning on the wrong column's
+    # stats); unresolved names fall through to None = no stats = keep.
     for chunk in rg.columns or []:
         path = chunk.meta_data.path_in_schema
-        if path[0] == name or ".".join(path) == name:
+        if ".".join(path) == name:
             return chunk
     return None
 
@@ -259,8 +270,18 @@ class _Cmp(Predicate):
                 if self.op == "!=":
                     out.append((a, b))
                 continue
-            mn = _decode_stat(pt, ci.min_values[i] or None) if ci.min_values else None
-            mx = _decode_stat(pt, ci.max_values[i] or None) if ci.max_values else None
+            # a foreign/truncated ColumnIndex may carry fewer entries than
+            # the OffsetIndex has pages: missing entry = unknown = keep
+            mn = (
+                _decode_stat(pt, ci.min_values[i] or None)
+                if ci.min_values and i < len(ci.min_values)
+                else None
+            )
+            mx = (
+                _decode_stat(pt, ci.max_values[i] or None)
+                if ci.max_values and i < len(ci.max_values)
+                else None
+            )
             nc = (
                 ci.null_counts[i]
                 if ci.null_counts and i < len(ci.null_counts)

@@ -164,6 +164,16 @@ def _lz4_raw_compress(data: bytes) -> bytes:
     return bytes(out)
 
 
+def _lz4_block_capped(data: bytes, cap: int) -> bytes:
+    """Decode one inner LZ4 block of unknown size ≤ cap (single pass)."""
+    if _native is not None and _native.available():
+        return _native.lz4_decompress_capped(bytes(data), cap)
+    out = _lz4_raw_decompress(data, None)
+    if len(out) > cap:
+        raise ValueError("LZ4 block exceeds record length")
+    return out
+
+
 def _lz4_hadoop_decompress(data: bytes, uncompressed_size=None) -> bytes:
     """Parquet legacy LZ4: Hadoop framing — repeated
     [uncompressed_len u32be][compressed_len u32be][raw LZ4 block] records
@@ -175,24 +185,42 @@ def _lz4_hadoop_decompress(data: bytes, uncompressed_size=None) -> bytes:
         out = bytearray()
         pos = 0
         ok = True
-        while pos < n:
-            if pos + 8 > n:
+        while pos < n and ok:
+            if pos + 4 > n:
                 ok = False
                 break
             ulen = int.from_bytes(data[pos : pos + 4], "big")
-            clen = int.from_bytes(data[pos + 4 : pos + 8], "big")
-            pos += 8
-            if clen <= 0 or pos + clen > n or ulen > (1 << 31):
+            pos += 4
+            if ulen > (1 << 31):
                 ok = False
                 break
-            try:
-                out += _lz4_raw_decompress(data[pos : pos + clen], ulen)
-            except (ValueError, IndexError):
-                # a bare raw block whose first bytes merely looked like a
-                # frame header: fall back to whole-buffer raw decode
+            # a record holds one or more [clen][block] inner records (the
+            # Hadoop BlockCompressorStream splits input larger than its
+            # codec buffer) — keep reading blocks until ulen bytes emerge
+            produced = 0
+            while produced < ulen:
+                if pos + 4 > n:
+                    ok = False
+                    break
+                clen = int.from_bytes(data[pos : pos + 4], "big")
+                pos += 4
+                if clen <= 0 or pos + clen > n:
+                    ok = False
+                    break
+                try:
+                    block = _lz4_block_capped(
+                        data[pos : pos + clen], ulen - produced
+                    )
+                except (ValueError, IndexError):
+                    # a bare raw block whose first bytes merely looked
+                    # like a frame header: whole-buffer raw fallback
+                    ok = False
+                    break
+                pos += clen
+                produced += len(block)
+                out += block
+            if produced > ulen:
                 ok = False
-                break
-            pos += clen
         if ok and (uncompressed_size is None or len(out) == uncompressed_size):
             return bytes(out)
     return _lz4_raw_decompress(data, uncompressed_size)

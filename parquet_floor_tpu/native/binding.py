@@ -259,6 +259,20 @@ def plain_ba_scan(data, max_values: int):
     return starts[:n], lengths[:n]
 
 
+def lz4_decompress_capped(data: bytes, max_size: int) -> bytes:
+    """Decode one LZ4 raw block natively; output may be any size ≤ cap
+    (Hadoop-framed records hold codec-buffer-sized inner blocks whose
+    exact decoded length is unknown until decoded)."""
+    lib = _load()
+    out = ctypes.create_string_buffer(max_size)
+    n = lib.pftpu_lz4_decompress(data, len(data), out, max_size)
+    if n == -2:
+        raise ValueError("LZ4 output larger than cap")
+    if n < 0:
+        raise ValueError("malformed LZ4 block")
+    return out.raw[:n]
+
+
 def lz4_decompress(data: bytes, uncompressed_size: int) -> bytes:
     """Decode one LZ4 raw block natively (exact output size required)."""
     lib = _load()

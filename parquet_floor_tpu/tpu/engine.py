@@ -1374,12 +1374,13 @@ class TpuRowGroupReader:
             sync_transfers = _os.environ.get("PFTPU_SYNC_TRANSFERS", "1") != "0"
         self.sync_transfers = sync_transfers
         # Pallas expansion for uniform-bit-width streams.  The lane-gather
-        # kernel formulation compiles under Mosaic for bit_width ≤ 7
-        # (covers def/rep levels and small dictionaries) and runs ~1.3×
-        # the jnp expansion — default ON for those on a real TPU.  Wider
-        # streams stay on the jnp path (Mosaic cannot lower the bit-matrix
-        # regroup the wide kernel needs).  PFTPU_PALLAS=0 disables;
-        # PFTPU_PALLAS=1 forces it everywhere via interpret mode (tests).
+        # kernel formulation compiles under Mosaic for
+        # bit_width ≤ rle_kernel.LANE_KERNEL_MAX_BW (covers def/rep levels
+        # and small dictionaries) and runs ~1.3× the jnp expansion —
+        # default ON for those on a real TPU.  Wider streams stay on the
+        # jnp path (Mosaic cannot lower the bit-matrix regroup the wide
+        # kernel needs).  PFTPU_PALLAS=0 disables; PFTPU_PALLAS=1 forces
+        # it everywhere via interpret mode (tests).
         pl_env = _os.environ.get("PFTPU_PALLAS", "")
         if pl_env == "1":
             self._pl_enabled = True

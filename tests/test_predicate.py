@@ -279,3 +279,80 @@ def test_selective_read_mixed_page_boundaries(tmp_path):
         nc = assemble_nested(r.schema, lcol)
         assert nc.num_rows == rows
         assert nc.to_pylist() == [rows_l[i] for a, b in covered for i in range(a, b)]
+
+
+# ---------------------------------------------------- advisor regressions
+
+
+def test_legacy_binary_stats_not_trusted(filt_file):
+    """Legacy Statistics.min/max on BYTE_ARRAY came from signed-byte
+    comparison in old parquet-mr writers (PARQUET-251): when only the
+    legacy fields are present the group must be KEPT, not pruned."""
+    with ParquetFileReader(filt_file) as r:
+        pred = col("s") == "zzz-not-present"
+        # sanity: with trustworthy min_value/max_value the groups prune
+        assert pred.row_groups(r) == []
+        for rg in r.row_groups:
+            for ch in rg.columns:
+                st = ch.meta_data.statistics
+                if st is not None and st.min_value is not None:
+                    st.min = st.min_value
+                    st.max = st.max_value
+                    st.min_value = None
+                    st.max_value = None
+        # legacy-only binary stats are unknown -> every group kept
+        assert pred.row_groups(r) == [0, 1, 2, 3]
+        # numeric columns keep using legacy min/max (those are sound)
+        assert (col("x") < 100).row_groups(r) == [0]
+
+
+def test_group_name_does_not_prune(tmp_path):
+    """A predicate naming a top-level *group* must not silently evaluate
+    against the group's first leaf: keep everything (no stats)."""
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("x"),
+        types.list_of(types.required(types.INT32).named("element"), "l",
+                      optional=True),
+    )
+    path = str(tmp_path / "grp.parquet")
+    with ParquetFileWriter(path, schema, WriterOptions()) as w:
+        w.write_columns({"x": np.arange(10, dtype=np.int64),
+                         "l": [[i] for i in range(10)]})
+        w.write_columns({"x": np.arange(10, 20, dtype=np.int64),
+                         "l": [[i] for i in range(10, 20)]})
+    with ParquetFileReader(path) as r:
+        # "l" names the group, not the leaf "l.list.element": keep all
+        assert (col("l") > 100).row_groups(r) == [0, 1]
+        # the exact dotted leaf path still prunes
+        leaf = [".".join(c.meta_data.path_in_schema)
+                for c in r.row_groups[0].columns if
+                c.meta_data.path_in_schema[0] == "l"][0]
+        assert (col(leaf) < 5).row_groups(r) == [0]
+
+
+def test_short_column_index_keeps_pages(tmp_path):
+    """A ColumnIndex with fewer min/max entries than the OffsetIndex has
+    pages (foreign/truncated writer) must keep the uncovered pages, not
+    raise IndexError."""
+    schema = types.message("t", types.required(types.INT64).named("x"))
+    path = str(tmp_path / "short.parquet")
+    with ParquetFileWriter(
+        path, schema, WriterOptions(data_page_values=100)
+    ) as w:
+        w.write_columns({"x": np.arange(400, dtype=np.int64)})
+    with ParquetFileReader(path) as r:
+        pred = col("x") >= 1000
+        assert pred.row_ranges(r, 0) == []  # all four pages prune
+        real_read_ci = r.read_column_index
+
+        def truncated(chunk):
+            ci = real_read_ci(chunk)
+            if ci is not None:
+                ci.min_values = ci.min_values[:1]
+                ci.max_values = ci.max_values[:1]
+            return ci
+
+        r.read_column_index = truncated
+        # page 0 still prunes; pages 1..3 have no stats entries -> kept
+        assert pred.row_ranges(r, 0) == [(100, 400)]

@@ -123,3 +123,24 @@ def test_lz4_hostile_blocks():
     bad2 = bytes([0xF0, 0xFF])
     with pytest.raises(ValueError):
         binding.lz4_decompress(bad2, 64)
+
+
+def test_lz4_hadoop_multiblock_record():
+    """Hadoop BlockCompressorStream splits input larger than its codec
+    buffer into several [clen][block] inner records under one [ulen]
+    header — the decoder must loop until ulen bytes have been produced."""
+    from parquet_floor_tpu.format import codecs
+
+    part1 = bytes(range(256)) * 8   # 2048 bytes
+    part2 = b"tail-bytes" * 100     # 1000 bytes
+    payload = part1 + part2
+    rec = len(payload).to_bytes(4, "big")
+    for part in (part1, part2):
+        blk = codecs._lz4_raw_compress(part)
+        rec += len(blk).to_bytes(4, "big") + blk
+    assert codecs._lz4_hadoop_decompress(rec, len(payload)) == payload
+    assert codecs._lz4_hadoop_decompress(rec) == payload
+
+    # two records, the second itself multi-block
+    rec2 = codecs._lz4_hadoop_compress(b"solo") + rec
+    assert codecs._lz4_hadoop_decompress(rec2) == b"solo" + payload

@@ -24,7 +24,7 @@ groups' byte ranges), while "seq"/"dict" collectives ride ICI inside a pod.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -131,30 +131,152 @@ def build_sharded_decode_step(mesh: Mesh, n_per_group: int, bw: int, dict_pad: i
 # ---------------------------------------------------------------------------
 
 class ShardedColumn:
-    """A globally-sharded decoded column: dense values + optional null mask."""
+    """A globally-sharded decoded column.
 
-    __slots__ = ("values", "mask")
+    ``values``: dense rows sharded over the mesh axis.  For strings the
+    shape is ``(N, W)`` uint8 (right-padded bytes) with per-row byte
+    ``lengths``.  When the file is ragged (non-uniform row groups or a
+    group count that does not divide the device count) rows are laid out
+    on a fixed per-group stride and ``row_mask`` marks the real rows
+    (True = valid); ``num_rows`` is always the true total.  Uniform,
+    evenly-divisible files keep the exact flat layout (``row_mask`` None).
+    """
 
-    def __init__(self, values: jax.Array, mask: Optional[jax.Array]):
+    __slots__ = ("values", "mask", "lengths", "row_mask", "num_rows")
+
+    def __init__(self, values, mask, lengths=None, row_mask=None, num_rows=None):
         self.values = values
         self.mask = mask
+        self.lengths = lengths
+        self.row_mask = row_mask
+        self.num_rows = values.shape[0] if num_rows is None else num_rows
 
     def __repr__(self):
-        return f"ShardedColumn({self.values.shape}, nullable={self.mask is not None})"
+        return (
+            f"ShardedColumn({self.values.shape}, rows={self.num_rows}, "
+            f"nullable={self.mask is not None}, strings={self.lengths is not None})"
+        )
+
+    def to_list(self):
+        """Host materialization (tests/debugging): list of python values."""
+        vals = np.asarray(self.values)
+        mask = None if self.mask is None else np.asarray(self.mask)
+        valid = (
+            np.ones(vals.shape[0], bool)
+            if self.row_mask is None
+            else np.asarray(self.row_mask)
+        )
+        out = []
+        if self.lengths is not None:
+            lens = np.asarray(self.lengths)
+            for i in np.flatnonzero(valid):
+                if mask is not None and mask[i]:
+                    out.append(None)
+                else:
+                    out.append(vals[i, : lens[i]].tobytes())
+        else:
+            for i in np.flatnonzero(valid):
+                out.append(None if mask is not None and mask[i] else vals[i].item())
+        return out
 
 
-def _assemble_global(parts, devices, mesh, axis):
-    """Blocked assembly: group i of n_groups goes to device i*n_dev//n_groups;
-    contiguous groups concatenate per device so the global array is sharded
-    over the mesh axis (requires n_groups % n_dev == 0)."""
-    n_dev = len(devices)
-    per_dev = len(parts) // n_dev
-    shards = []
-    for d in range(n_dev):
-        chunk = parts[d * per_dev : (d + 1) * per_dev]
-        local = chunk[0] if len(chunk) == 1 else jnp.concatenate(chunk)
-        shards.append(jax.device_put(local, devices[d]))
-    global_shape = (sum(p.shape[0] for p in parts),) + parts[0].shape[1:]
+class ShardedNestedColumn:
+    """A repeated (nested) column sharded at the row-group grain.
+
+    TPUs want rectangles, and a repeated column's value stream is not
+    row-aligned — so the global layout keeps one padded slot per row
+    group, sharded over the mesh axis on the leading (group) axis:
+
+      * ``def_levels``/``rep_levels``: ``(G, L)`` int32, padded per group
+      * ``values``: ``(G, V)`` dense non-null values (``(G, V, W)`` uint8
+        for strings, with ``lengths`` ``(G, V)``)
+      * ``level_counts``: ``(G,)`` true level count per group
+      * ``group_rows``: ``(G,)`` true row count per group (0 = pad group)
+
+    Device compute can map over the group axis; host record assembly
+    (Dremel) is :meth:`to_pylist`.
+    """
+
+    __slots__ = (
+        "descriptor", "values", "lengths", "def_levels", "rep_levels",
+        "level_counts", "group_rows",
+    )
+
+    def __init__(self, descriptor, values, lengths, def_levels, rep_levels,
+                 level_counts, group_rows):
+        self.descriptor = descriptor
+        self.values = values
+        self.lengths = lengths
+        self.def_levels = def_levels
+        self.rep_levels = rep_levels
+        self.level_counts = level_counts
+        self.group_rows = group_rows
+
+    def __repr__(self):
+        return (
+            f"ShardedNestedColumn({'.'.join(self.descriptor.path)}, "
+            f"groups={self.def_levels.shape[0]}, values={self.values.shape})"
+        )
+
+    def to_pylist(self, schema):
+        """Assemble every group's records on host (Dremel), in file order."""
+        from ..batch.columns import ByteArrayColumn, ColumnBatch
+        from ..batch.nested import assemble_nested
+
+        defs_all = np.asarray(self.def_levels)
+        reps_all = np.asarray(self.rep_levels)
+        counts = np.asarray(self.level_counts)
+        rows = np.asarray(self.group_rows)
+        vals_all = np.asarray(self.values)
+        lens_all = None if self.lengths is None else np.asarray(self.lengths)
+        max_def = self.descriptor.max_definition_level
+        out = []
+        for g in range(defs_all.shape[0]):
+            if rows[g] == 0:
+                continue
+            ln = int(counts[g])
+            defs = defs_all[g, :ln].astype(np.uint32)
+            reps = reps_all[g, :ln].astype(np.uint32)
+            nn = int(np.count_nonzero(defs == max_def))
+            if lens_all is not None:
+                lens = lens_all[g, :nn].astype(np.int64)
+                offsets = np.zeros(nn + 1, dtype=np.int64)
+                np.cumsum(lens, out=offsets[1:])
+                rowsv = vals_all[g, :nn]
+                if nn:
+                    flat = rowsv[np.arange(rowsv.shape[1])[None, :] < lens[:, None]]
+                else:
+                    flat = np.zeros(0, np.uint8)
+                vals = ByteArrayColumn(offsets, flat)
+            else:
+                vals = vals_all[g, :nn]
+            batch = ColumnBatch(self.descriptor, ln, vals, defs, reps)
+            out.extend(assemble_nested(schema, batch).to_pylist())
+        return out
+
+
+def _pad_rows(arr, rows: int, cols: Optional[int] = None, xp=jnp):
+    """Zero-pad ``arr`` to ``rows`` on axis 0 (and ``cols`` on axis 1).
+
+    ``xp`` picks the array library (jnp here; multihost passes np for its
+    host-side staging) so both shard layers share one pad rule."""
+    widths = [(0, rows - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+    if cols is not None:
+        widths[1] = (0, cols - arr.shape[1])
+    if all(w == (0, 0) for w in widths):
+        return arr
+    return xp.pad(arr, widths)
+
+
+def _assemble_blocks(local_per_device, devices, mesh, axis):
+    """Stitch per-device local arrays (uniform shapes) into one global
+    array sharded over ``mesh[axis]``."""
+    shards = [
+        jax.device_put(local, d) for local, d in zip(local_per_device, devices)
+    ]
+    global_shape = (
+        sum(s.shape[0] for s in shards),
+    ) + tuple(shards[0].shape[1:])
     return jax.make_array_from_single_device_arrays(
         global_shape, NamedSharding(mesh, P(axis)), shards
     )
@@ -165,7 +287,7 @@ def read_table_sharded(
     mesh: Mesh,
     columns: Optional[Sequence[str]] = None,
     axis: str = "rg",
-) -> Dict[str, ShardedColumn]:
+) -> Dict[str, Union["ShardedColumn", "ShardedNestedColumn"]]:
     """Decode a parquet file with row groups data-parallel over ``mesh``.
 
     Each mesh slot along ``axis`` decodes a contiguous block of row groups
@@ -174,9 +296,17 @@ def read_table_sharded(
     rows end up sharded over the mesh axis, ready for sharded compute
     without reshuffling.
 
-    Requirements (violations raise, never silently degrade): uniform row
-    counts per group, group count divisible by the axis device count.
-    String columns are not yet assembled globally.
+    Handles every column kind and file shape:
+
+      * fixed-width columns → flat ``ShardedColumn`` (identical to the
+        host row order);
+      * strings → padded ``(N, W)`` bytes + ``lengths``;
+      * repeated (nested) columns → :class:`ShardedNestedColumn`, sharded
+        at the row-group grain;
+      * ragged files (non-uniform groups, group count not divisible by
+        the device count) → rows on a fixed per-group stride with
+        ``row_mask`` marking real rows (jax shards only evenly-divisible
+        dims, so raggedness becomes padding + mask, never an error).
     """
     from ..tpu.engine import TpuRowGroupReader
 
@@ -185,56 +315,163 @@ def read_table_sharded(
     readers = {d: TpuRowGroupReader(source, device=d) for d in set(devices)}
     try:
         any_reader = next(iter(readers.values()))
-        n_groups = any_reader.num_row_groups
-        if n_groups % n_dev:
-            raise ValueError(
-                f"{n_groups} row groups do not shard evenly over {n_dev} "
-                f"devices; re-chunk the file or use a smaller mesh axis"
-            )
-        per_group: Optional[int] = None
-        vals: Dict[str, list] = {}
-        masks: Dict[str, list] = {}
-        per_dev = n_groups // n_dev
+        rgs = any_reader.reader.row_groups
+        n_groups = len(rgs)
+        rows_per = [int(rg.num_rows or 0) for rg in rgs]
+        per_dev = max(1, -(-n_groups // n_dev))
+        g_pad = per_dev * n_dev
+        stride = max(rows_per) if rows_per else 0
+        uniform = g_pad == n_groups and len(set(rows_per)) <= 1
+
+        # decode: group gi belongs to device gi // per_dev
+        cols_by_group: List[Dict[str, object]] = []
         for gi in range(n_groups):
             dev = devices[gi // per_dev]
-            cols = readers[dev].read_row_group(gi, columns)
-            for name, dc in cols.items():
-                if dc.is_strings:
-                    raise NotImplementedError(
-                        "sharded string assembly lands with the string kernel"
-                    )
-                if dc.is_repeated:
-                    # repeated columns yield a non-row-aligned value stream
-                    # + levels; global list assembly is not implemented —
-                    # decode per group and DeviceColumn.assemble() instead
-                    raise NotImplementedError(
-                        "sharded assembly of repeated (nested) columns is "
-                        "not supported; use TpuRowGroupReader per group"
-                    )
-                rows = dc.values.shape[0]
-                if per_group is None:
-                    per_group = rows
-                elif rows != per_group:
-                    raise ValueError(
-                        f"row group {gi} has {rows} rows != {per_group}; "
-                        "uniform groups required for global assembly"
-                    )
-                vals.setdefault(name, []).append(dc.values)
-                masks.setdefault(name, []).append(dc.mask)
-        out: Dict[str, ShardedColumn] = {}
-        for name, parts in vals.items():
-            gv = _assemble_global(parts, devices, mesh, axis)
-            mparts = masks[name]
-            if any(m is not None for m in mparts):
-                mparts = [
-                    m if m is not None else jnp.zeros(per_group, jnp.bool_)
-                    for m in mparts
-                ]
-                gm = _assemble_global(mparts, devices, mesh, axis)
+            cols_by_group.append(readers[dev].read_row_group(gi, columns))
+
+        names = list(cols_by_group[0].keys()) if cols_by_group else []
+        out: Dict[str, object] = {}
+        for name in names:
+            parts = [cols_by_group[gi][name] for gi in range(n_groups)]
+            if parts[0].is_repeated:
+                out[name] = _assemble_nested_sharded(
+                    parts, rows_per, devices, per_dev, mesh, axis
+                )
             else:
-                gm = None
-            out[name] = ShardedColumn(gv, gm)
+                out[name] = _assemble_flat_sharded(
+                    parts, rows_per, devices, per_dev, stride, uniform,
+                    mesh, axis,
+                )
         return out
     finally:
         for r in readers.values():
             r.close()
+
+
+def _assemble_flat_sharded(parts, rows_per, devices, per_dev, stride,
+                           uniform, mesh, axis):
+    """Assemble per-group flat/string DeviceColumns into a ShardedColumn."""
+    n_dev = len(devices)
+    n_groups = len(parts)
+    strings = parts[0].is_strings
+    width = max(p.values.shape[1] for p in parts) if strings else None
+    any_mask = any(p.mask is not None for p in parts)
+    total_rows = sum(rows_per)
+
+    locals_v, locals_m, locals_l, locals_r = [], [], [], []
+    for d in range(n_dev):
+        vs, ms, ls, rs = [], [], [], []
+        for gi in range(d * per_dev, (d + 1) * per_dev):
+            if gi < n_groups:
+                p, rows = parts[gi], rows_per[gi]
+                v = _pad_rows(p.values, stride, width if strings else None)
+                m = (
+                    _pad_rows(
+                        p.mask if p.mask is not None
+                        else jnp.zeros(rows, jnp.bool_),
+                        stride,
+                    )
+                    if any_mask
+                    else None
+                )
+                ln = _pad_rows(p.lengths, stride) if strings else None
+                valid = jnp.arange(stride) < rows
+            else:  # ghost group: padding to make the axis divisible
+                shape = (stride, width) if strings else (stride,) + tuple(
+                    parts[0].values.shape[1:]
+                )
+                v = jnp.zeros(shape, parts[0].values.dtype)
+                m = jnp.zeros(stride, jnp.bool_) if any_mask else None
+                ln = jnp.zeros(stride, parts[0].lengths.dtype) if strings else None
+                valid = jnp.zeros(stride, jnp.bool_)
+            vs.append(v)
+            rs.append(valid)
+            if any_mask:
+                ms.append(m)
+            if strings:
+                ls.append(ln)
+        locals_v.append(jnp.concatenate(vs))
+        locals_r.append(jnp.concatenate(rs))
+        if any_mask:
+            locals_m.append(jnp.concatenate(ms))
+        if strings:
+            locals_l.append(jnp.concatenate(ls))
+
+    gv = _assemble_blocks(locals_v, devices, mesh, axis)
+    gm = _assemble_blocks(locals_m, devices, mesh, axis) if any_mask else None
+    gl = _assemble_blocks(locals_l, devices, mesh, axis) if strings else None
+    gr = None if uniform else _assemble_blocks(locals_r, devices, mesh, axis)
+    return ShardedColumn(gv, gm, lengths=gl, row_mask=gr, num_rows=total_rows)
+
+
+def _assemble_nested_sharded(parts, rows_per, devices, per_dev, mesh, axis):
+    """Assemble per-group repeated DeviceColumns into a ShardedNestedColumn
+    (one padded slot per row group, sharded on the group axis)."""
+    n_dev = len(devices)
+    n_groups = len(parts)
+    strings = parts[0].is_strings
+    lmax = max(p.def_levels.shape[0] for p in parts)
+    vmax = max(p.values.shape[0] for p in parts)
+    width = max(p.values.shape[1] for p in parts) if strings else None
+
+    def per_device(build_one, ghost):
+        locals_ = []
+        for d in range(n_dev):
+            rows = []
+            for gi in range(d * per_dev, (d + 1) * per_dev):
+                rows.append(build_one(parts[gi]) if gi < n_groups else ghost())
+            locals_.append(jnp.stack(rows))
+        return locals_
+
+    vdtype = parts[0].values.dtype
+    ldtype = parts[0].def_levels.dtype
+    gv = _assemble_blocks(
+        per_device(
+            lambda p: _pad_rows(p.values, vmax, width),
+            lambda: jnp.zeros(
+                (vmax, width) if strings else (vmax,) + tuple(parts[0].values.shape[1:]),
+                vdtype,
+            ),
+        ),
+        devices, mesh, axis,
+    )
+    gl = (
+        _assemble_blocks(
+            per_device(
+                lambda p: _pad_rows(p.lengths, vmax),
+                lambda: jnp.zeros(vmax, parts[0].lengths.dtype),
+            ),
+            devices, mesh, axis,
+        )
+        if strings
+        else None
+    )
+    gd = _assemble_blocks(
+        per_device(
+            lambda p: _pad_rows(p.def_levels, lmax),
+            lambda: jnp.zeros(lmax, ldtype),
+        ),
+        devices, mesh, axis,
+    )
+    gr = _assemble_blocks(
+        per_device(
+            lambda p: _pad_rows(p.rep_levels, lmax),
+            lambda: jnp.zeros(lmax, ldtype),
+        ),
+        devices, mesh, axis,
+    )
+    counts = np.zeros(n_dev * per_dev, np.int32)
+    counts[:n_groups] = [p.def_levels.shape[0] for p in parts]
+    grow = np.zeros(n_dev * per_dev, np.int32)
+    grow[:n_groups] = rows_per
+    gcounts = _assemble_blocks(
+        [jnp.asarray(counts[d * per_dev : (d + 1) * per_dev]) for d in range(n_dev)],
+        devices, mesh, axis,
+    )
+    ggrow = _assemble_blocks(
+        [jnp.asarray(grow[d * per_dev : (d + 1) * per_dev]) for d in range(n_dev)],
+        devices, mesh, axis,
+    )
+    return ShardedNestedColumn(
+        parts[0].descriptor, gv, gl, gd, gr, gcounts, ggrow
+    )

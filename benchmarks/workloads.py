@@ -7,7 +7,6 @@ domains (16 columns: 4 int keys, 4 decimals-as-double, 2 flag strings,
 
 from __future__ import annotations
 
-import os
 
 import numpy as np
 
@@ -148,7 +147,9 @@ def write_taxi_like(path, n_rows: int = 1_000_000, seed: int = 0):
                 "distance": np.round(rng.uniform(0.1, 40, n_rows), 2),
                 "payment_type": [None if m < 0.05 else pay[i]
                                  for m, i in zip(mask, rng.integers(0, 4, n_rows))],
-                "pickup_ts": (1_600_000_000 + np.sort(rng.integers(0, 30_000_000, n_rows))).astype(np.int64),
+                "pickup_ts": (
+                    1_600_000_000 + np.sort(rng.integers(0, 30_000_000, n_rows))
+                ).astype(np.int64),
                 "passengers": [None if m < 0.1 else int(i)
                                for m, i in zip(mask, rng.integers(1, 7, n_rows))],
             }

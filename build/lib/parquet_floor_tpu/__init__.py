@@ -1,0 +1,63 @@
+"""parquet-floor-tpu: a TPU-native (JAX/XLA/Pallas) Parquet framework.
+
+Brand-new implementation with the capability surface of the Java reference
+``Pablete1234/parquet-floor`` (see SURVEY.md): a declarative
+Hydrator/Dehydrator API over a from-scratch Parquet format engine, with the
+columnar decode hot path offloaded to TPU kernels.
+"""
+
+from .format.schema import (
+    ColumnDescriptor,
+    GroupType,
+    LogicalAnnotation,
+    MessageType,
+    PrimitiveType,
+    types,
+)
+from .format.parquet_thrift import CompressionCodec, Encoding, Type
+from .format.metadata import ParquetMetadata
+from .format.file_read import ParquetFileReader
+from .format.file_write import ColumnData, ParquetFileWriter, WriterOptions
+from .api.hydrate import Dehydrator, Hydrator, HydratorSupplier, ValueWriter
+from .api.reader import ParquetReader
+from .api.writer import ParquetWriter
+from .batch.nested import NestedColumn, assemble_nested, shred_nested
+from .batch.predicate import Predicate, col
+from .utils import trace
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ColumnData", "ColumnDescriptor", "CompressionCodec", "Dehydrator",
+    "DeviceColumn", "Encoding", "GroupType", "Hydrator", "HydratorSupplier",
+    "LogicalAnnotation", "MessageType", "NestedColumn", "ParquetFileReader",
+    "ParquetFileWriter", "ParquetMetadata", "ParquetReader", "ParquetWriter",
+    "Predicate", "PrimitiveType", "TpuRowGroupReader", "Type",
+    "assemble_nested", "col", "read_sharded_global", "shred_nested", "trace",
+    "types", "ValueWriter", "WriterOptions",
+]
+
+_LAZY = {
+    # the TPU engine (and jax with it) loads only on first use, keeping
+    # plain format/API imports light
+    "TpuRowGroupReader": ("parquet_floor_tpu.tpu.engine", "TpuRowGroupReader"),
+    "DeviceColumn": ("parquet_floor_tpu.tpu.engine", "DeviceColumn"),
+    "read_sharded_global": (
+        "parquet_floor_tpu.parallel.multihost", "read_sharded_global",
+    ),
+}
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(target[0]), target[1])
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,1 @@
+"""L4: declarative API with reference parity (SURVEY.md §7 `api/`)."""

@@ -1,0 +1,1 @@
+"""L3: columnar batch materialization (SURVEY.md §7 `batch/`)."""

@@ -1,0 +1,1 @@
+"""L2: from-scratch Parquet format engine (SURVEY.md §7 `format/`)."""

@@ -1,0 +1,1 @@
+"""NumPy reference codecs for all Parquet page encodings."""

@@ -1,0 +1,23 @@
+"""BYTE_STREAM_SPLIT encoding (Parquet spec; Encoding id 9).
+
+Transposes the bytes of fixed-width values into per-byte streams so that a
+downstream block compressor sees long runs of similar bytes.  Pure shape
+transform — NumPy transpose both ways, and on TPU a trivial relayout.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def encode_byte_stream_split(values: np.ndarray) -> bytes:
+    v = np.ascontiguousarray(values)
+    width = v.dtype.itemsize
+    return v.view(np.uint8).reshape(-1, width).T.copy().tobytes()
+
+
+def decode_byte_stream_split(data, num_values: int, dtype, pos: int = 0) -> np.ndarray:
+    dtype = np.dtype(dtype)
+    width = dtype.itemsize
+    raw = np.frombuffer(data, dtype=np.uint8, count=num_values * width, offset=pos)
+    return raw.reshape(width, num_values).T.copy().view(dtype).reshape(num_values)

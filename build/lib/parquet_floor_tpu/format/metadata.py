@@ -1,0 +1,80 @@
+"""File metadata: footer parse/serialize + user-facing ParquetMetadata.
+
+Parity with the metadata surface the reference exposes raw
+(``ParquetReader.readMetadata`` at ``ParquetReader.java:109-117`` and
+``metaData()`` at ``:229-231``): file-level schema, created_by, row groups,
+column-chunk stats.
+
+Layout (Parquet spec): ``PAR1 ... footer-thrift footer-len:u32le PAR1``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..io.source import FileSource
+from .parquet_thrift import FileMetaData, RowGroup
+from .schema import MessageType
+from .thrift import CompactReader, CompactWriter
+
+MAGIC = b"PAR1"
+MAGIC_ENCRYPTED = b"PARE"
+FOOTER_TAIL = 8  # u32 length + magic
+
+
+class ParquetMetadata:
+    """Parsed footer: raw thrift + derived schema tree."""
+
+    __slots__ = ("file_meta", "schema")
+
+    def __init__(self, file_meta: FileMetaData):
+        self.file_meta = file_meta
+        self.schema: MessageType = MessageType.from_thrift(file_meta.schema or [])
+
+    @property
+    def num_rows(self) -> int:
+        return self.file_meta.num_rows or 0
+
+    @property
+    def created_by(self) -> Optional[str]:
+        return self.file_meta.created_by
+
+    @property
+    def row_groups(self) -> List[RowGroup]:
+        return self.file_meta.row_groups or []
+
+    @property
+    def key_value_metadata(self) -> dict:
+        kvs = self.file_meta.key_value_metadata or []
+        return {kv.key: kv.value for kv in kvs}
+
+    def __repr__(self):
+        return (
+            f"ParquetMetadata(rows={self.num_rows}, "
+            f"row_groups={len(self.row_groups)}, created_by={self.created_by!r})"
+        )
+
+
+def read_footer(source: FileSource) -> ParquetMetadata:
+    size = source.size
+    if size < len(MAGIC) + FOOTER_TAIL:
+        raise ValueError(f"not a parquet file: only {size} bytes")
+    head = bytes(source.read_at(0, 4))
+    tail = bytes(source.read_at(size - FOOTER_TAIL, FOOTER_TAIL))
+    if tail[4:] == MAGIC_ENCRYPTED:
+        raise ValueError("encrypted parquet files are not supported")
+    if head != MAGIC or tail[4:] != MAGIC:
+        raise ValueError("not a parquet file: bad magic")
+    footer_len = int.from_bytes(tail[:4], "little")
+    if footer_len + FOOTER_TAIL + len(MAGIC) > size:
+        raise ValueError(f"corrupt footer length {footer_len}")
+    footer_bytes = source.read_at(size - FOOTER_TAIL - footer_len, footer_len)
+    fm = FileMetaData.read(CompactReader(footer_bytes))
+    return ParquetMetadata(fm)
+
+
+def serialize_footer(file_meta: FileMetaData) -> bytes:
+    w = CompactWriter()
+    file_meta.write(w)
+    body = w.getvalue()
+    return body + len(body).to_bytes(4, "little") + MAGIC

@@ -1,0 +1,462 @@
+"""Parquet metadata structures (parquet.thrift), declared over the compact
+protocol layer in :mod:`parquet_floor_tpu.format.thrift`.
+
+These mirror the Apache Parquet format specification's ``parquet.thrift``
+(the same structures parquet-mr 1.12.2 serializes for the reference — see
+SURVEY.md §2.3; footer write exercised at reference ``ParquetWriter.java:74-77``,
+footer read at ``ParquetReader.java:114-120``).  Field ids and enum values are
+fixed by the public format spec.
+"""
+
+from __future__ import annotations
+
+from .thrift import (
+    T_BOOL,
+    T_BYTE,
+    T_I16,
+    T_I32,
+    T_I64,
+    T_BINARY,
+    T_STRING,
+    TList,
+    ThriftStruct,
+)
+
+
+# ---------------------------------------------------------------------------
+# Enums (plain int namespaces; wire values fixed by the format spec)
+# ---------------------------------------------------------------------------
+
+class Type:
+    """Physical types."""
+
+    BOOLEAN = 0
+    INT32 = 1
+    INT64 = 2
+    INT96 = 3
+    FLOAT = 4
+    DOUBLE = 5
+    BYTE_ARRAY = 6
+    FIXED_LEN_BYTE_ARRAY = 7
+
+    _NAMES = {
+        0: "BOOLEAN", 1: "INT32", 2: "INT64", 3: "INT96",
+        4: "FLOAT", 5: "DOUBLE", 6: "BYTE_ARRAY", 7: "FIXED_LEN_BYTE_ARRAY",
+    }
+
+    @classmethod
+    def name(cls, v):
+        return cls._NAMES.get(v, f"UNKNOWN({v})")
+
+
+class ConvertedType:
+    UTF8 = 0
+    MAP = 1
+    MAP_KEY_VALUE = 2
+    LIST = 3
+    ENUM = 4
+    DECIMAL = 5
+    DATE = 6
+    TIME_MILLIS = 7
+    TIME_MICROS = 8
+    TIMESTAMP_MILLIS = 9
+    TIMESTAMP_MICROS = 10
+    UINT_8 = 11
+    UINT_16 = 12
+    UINT_32 = 13
+    UINT_64 = 14
+    INT_8 = 15
+    INT_16 = 16
+    INT_32 = 17
+    INT_64 = 18
+    JSON = 19
+    BSON = 20
+    INTERVAL = 21
+
+
+class FieldRepetitionType:
+    REQUIRED = 0
+    OPTIONAL = 1
+    REPEATED = 2
+
+    _NAMES = {0: "REQUIRED", 1: "OPTIONAL", 2: "REPEATED"}
+
+    @classmethod
+    def name(cls, v):
+        return cls._NAMES.get(v, f"UNKNOWN({v})")
+
+
+class Encoding:
+    PLAIN = 0
+    PLAIN_DICTIONARY = 2
+    RLE = 3
+    BIT_PACKED = 4
+    DELTA_BINARY_PACKED = 5
+    DELTA_LENGTH_BYTE_ARRAY = 6
+    DELTA_BYTE_ARRAY = 7
+    RLE_DICTIONARY = 8
+    BYTE_STREAM_SPLIT = 9
+
+    _NAMES = {
+        0: "PLAIN", 2: "PLAIN_DICTIONARY", 3: "RLE", 4: "BIT_PACKED",
+        5: "DELTA_BINARY_PACKED", 6: "DELTA_LENGTH_BYTE_ARRAY",
+        7: "DELTA_BYTE_ARRAY", 8: "RLE_DICTIONARY", 9: "BYTE_STREAM_SPLIT",
+    }
+
+    @classmethod
+    def name(cls, v):
+        return cls._NAMES.get(v, f"UNKNOWN({v})")
+
+
+class CompressionCodec:
+    UNCOMPRESSED = 0
+    SNAPPY = 1
+    GZIP = 2
+    LZO = 3
+    BROTLI = 4
+    LZ4 = 5
+    ZSTD = 6
+    LZ4_RAW = 7
+
+    _NAMES = {
+        0: "UNCOMPRESSED", 1: "SNAPPY", 2: "GZIP", 3: "LZO",
+        4: "BROTLI", 5: "LZ4", 6: "ZSTD", 7: "LZ4_RAW",
+    }
+
+    @classmethod
+    def name(cls, v):
+        return cls._NAMES.get(v, f"UNKNOWN({v})")
+
+
+class PageType:
+    DATA_PAGE = 0
+    INDEX_PAGE = 1
+    DICTIONARY_PAGE = 2
+    DATA_PAGE_V2 = 3
+
+
+class BoundaryOrder:
+    UNORDERED = 0
+    ASCENDING = 1
+    DESCENDING = 2
+
+
+# ---------------------------------------------------------------------------
+# Logical types (union of empty/parameter structs)
+# ---------------------------------------------------------------------------
+
+class StringType(ThriftStruct):
+    FIELDS = {}
+
+
+class UUIDType(ThriftStruct):
+    FIELDS = {}
+
+
+class MapType(ThriftStruct):
+    FIELDS = {}
+
+
+class ListType(ThriftStruct):
+    FIELDS = {}
+
+
+class EnumType(ThriftStruct):
+    FIELDS = {}
+
+
+class DateType(ThriftStruct):
+    FIELDS = {}
+
+
+class NullType(ThriftStruct):
+    FIELDS = {}
+
+
+class JsonType(ThriftStruct):
+    FIELDS = {}
+
+
+class BsonType(ThriftStruct):
+    FIELDS = {}
+
+
+class Float16Type(ThriftStruct):
+    FIELDS = {}
+
+
+class DecimalType(ThriftStruct):
+    FIELDS = {1: ("scale", T_I32), 2: ("precision", T_I32)}
+
+
+class MilliSeconds(ThriftStruct):
+    FIELDS = {}
+
+
+class MicroSeconds(ThriftStruct):
+    FIELDS = {}
+
+
+class NanoSeconds(ThriftStruct):
+    FIELDS = {}
+
+
+class TimeUnit(ThriftStruct):
+    """Union: exactly one of the members is set."""
+
+    FIELDS = {
+        1: ("MILLIS", MilliSeconds),
+        2: ("MICROS", MicroSeconds),
+        3: ("NANOS", NanoSeconds),
+    }
+
+
+class TimestampType(ThriftStruct):
+    FIELDS = {1: ("isAdjustedToUTC", T_BOOL), 2: ("unit", TimeUnit)}
+
+
+class TimeType(ThriftStruct):
+    FIELDS = {1: ("isAdjustedToUTC", T_BOOL), 2: ("unit", TimeUnit)}
+
+
+class IntType(ThriftStruct):
+    FIELDS = {1: ("bitWidth", T_BYTE), 2: ("isSigned", T_BOOL)}
+
+
+class LogicalType(ThriftStruct):
+    """Union: exactly one member set (parquet.thrift LogicalType)."""
+
+    FIELDS = {
+        1: ("STRING", StringType),
+        2: ("MAP", MapType),
+        3: ("LIST", ListType),
+        4: ("ENUM", EnumType),
+        5: ("DECIMAL", DecimalType),
+        6: ("DATE", DateType),
+        7: ("TIME", TimeType),
+        8: ("TIMESTAMP", TimestampType),
+        10: ("INTEGER", IntType),
+        11: ("UNKNOWN", NullType),
+        12: ("JSON", JsonType),
+        13: ("BSON", BsonType),
+        14: ("UUID", UUIDType),
+        15: ("FLOAT16", Float16Type),
+    }
+
+    def set_member(self):
+        """Return (name, value) of the set union member, or (None, None)."""
+        for name, _ in self.FIELDS.values():
+            v = getattr(self, name)
+            if v is not None:
+                return name, v
+        return None, None
+
+
+# ---------------------------------------------------------------------------
+# Schema / statistics / pages
+# ---------------------------------------------------------------------------
+
+class SchemaElement(ThriftStruct):
+    FIELDS = {
+        1: ("type", T_I32),
+        2: ("type_length", T_I32),
+        3: ("repetition_type", T_I32),
+        4: ("name", T_STRING),
+        5: ("num_children", T_I32),
+        6: ("converted_type", T_I32),
+        7: ("scale", T_I32),
+        8: ("precision", T_I32),
+        9: ("field_id", T_I32),
+        10: ("logicalType", LogicalType),
+    }
+
+
+class Statistics(ThriftStruct):
+    FIELDS = {
+        1: ("max", T_BINARY),
+        2: ("min", T_BINARY),
+        3: ("null_count", T_I64),
+        4: ("distinct_count", T_I64),
+        5: ("max_value", T_BINARY),
+        6: ("min_value", T_BINARY),
+        7: ("is_max_value_exact", T_BOOL),
+        8: ("is_min_value_exact", T_BOOL),
+    }
+
+
+class DataPageHeader(ThriftStruct):
+    FIELDS = {
+        1: ("num_values", T_I32),
+        2: ("encoding", T_I32),
+        3: ("definition_level_encoding", T_I32),
+        4: ("repetition_level_encoding", T_I32),
+        5: ("statistics", Statistics),
+    }
+
+
+class IndexPageHeader(ThriftStruct):
+    FIELDS = {}
+
+
+class DictionaryPageHeader(ThriftStruct):
+    FIELDS = {
+        1: ("num_values", T_I32),
+        2: ("encoding", T_I32),
+        3: ("is_sorted", T_BOOL),
+    }
+
+
+class DataPageHeaderV2(ThriftStruct):
+    FIELDS = {
+        1: ("num_values", T_I32),
+        2: ("num_nulls", T_I32),
+        3: ("num_rows", T_I32),
+        4: ("encoding", T_I32),
+        5: ("definition_levels_byte_length", T_I32),
+        6: ("repetition_levels_byte_length", T_I32),
+        7: ("is_compressed", T_BOOL),
+        8: ("statistics", Statistics),
+    }
+
+
+class PageHeader(ThriftStruct):
+    FIELDS = {
+        1: ("type", T_I32),
+        2: ("uncompressed_page_size", T_I32),
+        3: ("compressed_page_size", T_I32),
+        4: ("crc", T_I32),
+        5: ("data_page_header", DataPageHeader),
+        6: ("index_page_header", IndexPageHeader),
+        7: ("dictionary_page_header", DictionaryPageHeader),
+        8: ("data_page_header_v2", DataPageHeaderV2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Column chunks / row groups / file metadata
+# ---------------------------------------------------------------------------
+
+class KeyValue(ThriftStruct):
+    FIELDS = {1: ("key", T_STRING), 2: ("value", T_STRING)}
+
+
+class SortingColumn(ThriftStruct):
+    FIELDS = {
+        1: ("column_idx", T_I32),
+        2: ("descending", T_BOOL),
+        3: ("nulls_first", T_BOOL),
+    }
+
+
+class PageEncodingStats(ThriftStruct):
+    FIELDS = {
+        1: ("page_type", T_I32),
+        2: ("encoding", T_I32),
+        3: ("count", T_I32),
+    }
+
+
+class SizeStatistics(ThriftStruct):
+    FIELDS = {
+        1: ("unencoded_byte_array_data_bytes", T_I64),
+        2: ("repetition_level_histogram", TList(T_I64)),
+        3: ("definition_level_histogram", TList(T_I64)),
+    }
+
+
+class ColumnMetaData(ThriftStruct):
+    FIELDS = {
+        1: ("type", T_I32),
+        2: ("encodings", TList(T_I32)),
+        3: ("path_in_schema", TList(T_STRING)),
+        4: ("codec", T_I32),
+        5: ("num_values", T_I64),
+        6: ("total_uncompressed_size", T_I64),
+        7: ("total_compressed_size", T_I64),
+        8: ("key_value_metadata", TList(KeyValue)),
+        9: ("data_page_offset", T_I64),
+        10: ("index_page_offset", T_I64),
+        11: ("dictionary_page_offset", T_I64),
+        12: ("statistics", Statistics),
+        13: ("encoding_stats", TList(PageEncodingStats)),
+        14: ("bloom_filter_offset", T_I64),
+        15: ("bloom_filter_length", T_I32),
+        16: ("size_statistics", SizeStatistics),
+    }
+
+
+class ColumnChunk(ThriftStruct):
+    FIELDS = {
+        1: ("file_path", T_STRING),
+        2: ("file_offset", T_I64),
+        3: ("meta_data", ColumnMetaData),
+        4: ("offset_index_offset", T_I64),
+        5: ("offset_index_length", T_I32),
+        6: ("column_index_offset", T_I64),
+        7: ("column_index_length", T_I32),
+        9: ("encrypted_column_metadata", T_BINARY),
+    }
+
+
+class RowGroup(ThriftStruct):
+    FIELDS = {
+        1: ("columns", TList(ColumnChunk)),
+        2: ("total_byte_size", T_I64),
+        3: ("num_rows", T_I64),
+        4: ("sorting_columns", TList(SortingColumn)),
+        5: ("file_offset", T_I64),
+        6: ("total_compressed_size", T_I64),
+        7: ("ordinal", T_I16),
+    }
+
+
+class TypeDefinedOrder(ThriftStruct):
+    FIELDS = {}
+
+
+class ColumnOrder(ThriftStruct):
+    """Union."""
+
+    FIELDS = {1: ("TYPE_ORDER", TypeDefinedOrder)}
+
+
+class FileMetaData(ThriftStruct):
+    FIELDS = {
+        1: ("version", T_I32),
+        2: ("schema", TList(SchemaElement)),
+        3: ("num_rows", T_I64),
+        4: ("row_groups", TList(RowGroup)),
+        5: ("key_value_metadata", TList(KeyValue)),
+        6: ("created_by", T_STRING),
+        7: ("column_orders", TList(ColumnOrder)),
+    }
+
+
+# Offset/column index structures (page-level indexes; written by modern
+# writers, readable here for completeness of the metadata surface).
+
+class PageLocation(ThriftStruct):
+    FIELDS = {
+        1: ("offset", T_I64),
+        2: ("compressed_page_size", T_I32),
+        3: ("first_row_index", T_I64),
+    }
+
+
+class OffsetIndex(ThriftStruct):
+    FIELDS = {
+        1: ("page_locations", TList(PageLocation)),
+        2: ("unencoded_byte_array_data_bytes", TList(T_I64)),
+    }
+
+
+class ColumnIndex(ThriftStruct):
+    FIELDS = {
+        1: ("null_pages", TList(T_BOOL)),
+        2: ("min_values", TList(T_BINARY)),
+        3: ("max_values", TList(T_BINARY)),
+        4: ("boundary_order", T_I32),
+        5: ("null_counts", TList(T_I64)),
+        6: ("repetition_level_histograms", TList(T_I64)),
+        7: ("definition_level_histograms", TList(T_I64)),
+    }

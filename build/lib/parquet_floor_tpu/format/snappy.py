@@ -1,0 +1,201 @@
+"""Snappy block format, implemented from scratch (no third-party codec).
+
+The reference gets Snappy transitively via parquet-mr's JNI-wrapped
+snappy-java (SURVEY.md §2.4 item 1; the shim seam is
+``io/compress/CompressionCodec.java:6-11``).  Here the format itself is
+implemented: a pure-Python reference (this module) and a C++ fast path
+(``parquet_floor_tpu/native``) loaded via ctypes, selected automatically in
+:mod:`parquet_floor_tpu.format.codecs`.
+
+Block format (public Snappy format description):
+  * stream := uncompressed-length varint, then elements
+  * element tag low 2 bits: 0 literal / 1 copy-1B-offset / 2 copy-2B / 3 copy-4B
+  * literal: upper 6 bits = len-1, or 60..63 → len-1 in next 1..4 LE bytes
+  * copy1: len = ((tag>>2)&7)+4 (4..11), offset = ((tag>>5)<<8) | next byte
+  * copy2: len = (tag>>2)+1 (1..64), offset = next 2 LE bytes
+  * copy4: len = (tag>>2)+1, offset = next 4 LE bytes
+  * copies may overlap (offset < len repeats the pattern)
+"""
+
+from __future__ import annotations
+
+MAX_OFFSET_1B = 1 << 11  # 2048
+_HASH_BITS = 14
+_HASH_SIZE = 1 << _HASH_BITS
+
+
+class SnappyError(ValueError):
+    pass
+
+
+def _read_varint(data, pos):
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise SnappyError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+        if shift > 35:
+            raise SnappyError("varint too long")
+
+
+def decompress(data) -> bytes:
+    """Decompress one Snappy block."""
+    data = bytes(data)
+    expected, pos = _read_varint(data, 0)
+    out = bytearray(expected)
+    opos = 0
+    dlen = len(data)
+    while pos < dlen:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            ln = tag >> 2
+            if ln >= 60:
+                nbytes = ln - 59
+                ln = int.from_bytes(data[pos : pos + nbytes], "little")
+                pos += nbytes
+            ln += 1
+            if pos + ln > dlen or opos + ln > expected:
+                raise SnappyError("literal overruns buffer")
+            out[opos : opos + ln] = data[pos : pos + ln]
+            pos += ln
+            opos += ln
+            continue
+        nb = 1 if kind == 1 else 2 if kind == 2 else 4
+        if pos + nb > dlen:
+            raise SnappyError("truncated copy element")
+        if kind == 1:
+            ln = ((tag >> 2) & 0x7) + 4
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:
+            ln = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos : pos + 2], "little")
+            pos += 2
+        else:
+            ln = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos : pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > opos:
+            raise SnappyError("copy offset out of range")
+        if opos + ln > expected:
+            raise SnappyError("copy overruns output")
+        src = opos - offset
+        if offset >= ln:
+            out[opos : opos + ln] = out[src : src + ln]
+            opos += ln
+        else:
+            # overlapping copy: repeat pattern byte-run by byte-run
+            for _ in range(ln):
+                out[opos] = out[src]
+                opos += 1
+                src += 1
+    if opos != expected:
+        raise SnappyError(f"decompressed size {opos} != header {expected}")
+    return bytes(out)
+
+
+def _emit_literal(out: bytearray, data, start: int, end: int) -> None:
+    ln = end - start
+    while ln > 0:
+        chunk = min(ln, 0xFFFFFFFF)
+        n = chunk - 1
+        if n < 60:
+            out.append(n << 2)
+        elif n < (1 << 8):
+            out.append(60 << 2)
+            out.append(n)
+        elif n < (1 << 16):
+            out.append(61 << 2)
+            out += n.to_bytes(2, "little")
+        elif n < (1 << 24):
+            out.append(62 << 2)
+            out += n.to_bytes(3, "little")
+        else:
+            out.append(63 << 2)
+            out += n.to_bytes(4, "little")
+        out += data[start : start + chunk]
+        start += chunk
+        ln -= chunk
+
+
+def _emit_copy(out: bytearray, offset: int, ln: int) -> None:
+    # Long matches: emit 64-byte copy2/copy4 chunks, keep remainder >= 4.
+    while ln >= 68:
+        _emit_copy_upto64(out, offset, 64)
+        ln -= 64
+    if ln > 64:
+        _emit_copy_upto64(out, offset, ln - 60)
+        ln = 60
+    _emit_copy_upto64(out, offset, ln)
+
+
+def _emit_copy_upto64(out: bytearray, offset: int, ln: int) -> None:
+    if 4 <= ln <= 11 and offset < MAX_OFFSET_1B:
+        out.append(1 | ((ln - 4) << 2) | ((offset >> 8) << 5))
+        out.append(offset & 0xFF)
+    elif offset < (1 << 16):
+        out.append(2 | ((ln - 1) << 2))
+        out += offset.to_bytes(2, "little")
+    else:
+        out.append(3 | ((ln - 1) << 2))
+        out += offset.to_bytes(4, "little")
+
+
+def compress(data) -> bytes:
+    """Greedy hash-table Snappy compressor (valid, reasonably effective)."""
+    data = bytes(data)
+    n = len(data)
+    out = bytearray()
+    _write_varint(out, n)
+    if n < 16:
+        if n:
+            _emit_literal(out, data, 0, n)
+        return bytes(out)
+
+    table = [0] * _HASH_SIZE
+    pos = 0
+    lit_start = 0
+    limit = n - 4
+    while pos <= limit:
+        h = ((int.from_bytes(data[pos : pos + 4], "little") * 0x1E35A7BD) >> (32 - _HASH_BITS)) & (
+            _HASH_SIZE - 1
+        )
+        cand = table[h]
+        table[h] = pos
+        if (
+            cand < pos
+            and pos - cand < (1 << 16)
+            and data[cand : cand + 4] == data[pos : pos + 4]
+        ):
+            # extend match
+            mlen = 4
+            maxm = n - pos
+            while mlen < maxm and data[cand + mlen] == data[pos + mlen]:
+                mlen += 1
+            if lit_start < pos:
+                _emit_literal(out, data, lit_start, pos)
+            _emit_copy(out, pos - cand, mlen)
+            pos += mlen
+            lit_start = pos
+        else:
+            pos += 1
+    if lit_start < n:
+        _emit_literal(out, data, lit_start, n)
+    return bytes(out)
+
+
+def _write_varint(out: bytearray, v: int) -> None:
+    while True:
+        if v < 0x80:
+            out.append(v)
+            return
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7

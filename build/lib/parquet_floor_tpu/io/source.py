@@ -1,0 +1,122 @@
+"""Host I/O layer (L1): local file sources/sinks with positional reads.
+
+Replaces the reference's Hadoop ``fs`` shims + ``InputFile``/``OutputFile``
+adapters (``ParquetReader.java:233-259``, ``ParquetWriter.java:27-53``).
+Unlike the shim ``FSDataInputStream`` — which swallows IOExceptions and
+returns -1 (``FSDataInputStream.java:21-29``; SURVEY.md §5 says do NOT copy
+that) — errors here propagate loudly.
+
+``FileSource`` memory-maps when possible so column chunks slice zero-copy.
+"""
+
+from __future__ import annotations
+
+import io
+import mmap
+import os
+import threading
+from typing import BinaryIO, Optional, Union
+
+PathLike = Union[str, os.PathLike]
+
+
+class FileSource:
+    """Random-access input: local path (mmap) or seekable binary stream."""
+
+    def __init__(self, source: Union[PathLike, BinaryIO, bytes, bytearray, memoryview]):
+        self._own = False
+        self._mm: Optional[mmap.mmap] = None
+        self._fh: Optional[BinaryIO] = None
+        self._lock = threading.Lock()
+        if isinstance(source, (bytes, bytearray, memoryview)):
+            self._buf = memoryview(source)
+            self._size = len(self._buf)
+            self.name = "<bytes>"
+            return
+        if isinstance(source, (str, os.PathLike)):
+            self._fh = open(source, "rb")
+            self._own = True
+            self.name = os.fspath(source)
+        else:
+            self._fh = source
+            self.name = getattr(source, "name", "<stream>")
+        self._fh.seek(0, io.SEEK_END)
+        self._size = self._fh.tell()
+        try:
+            self._mm = mmap.mmap(self._fh.fileno(), 0, access=mmap.ACCESS_READ)
+            self._buf = memoryview(self._mm)
+        except (ValueError, OSError, io.UnsupportedOperation, AttributeError):
+            self._buf = None  # fall back to seek/read
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def read_at(self, offset: int, length: int) -> memoryview:
+        """Positional read (thread-safe); returns exactly ``length`` bytes or
+        raises."""
+        if offset < 0 or offset + length > self._size:
+            raise EOFError(
+                f"read [{offset}, {offset + length}) outside file of {self._size} bytes"
+            )
+        if self._buf is not None:
+            return self._buf[offset : offset + length]
+        with self._lock:
+            self._fh.seek(offset)
+            data = self._fh.read(length)
+        if len(data) != length:
+            raise EOFError(f"short read: wanted {length}, got {len(data)}")
+        return memoryview(data)
+
+    def close(self) -> None:
+        if self._mm is not None:
+            self._buf = None
+            self._mm.close()
+            self._mm = None
+        if self._own and self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class FileSink:
+    """Positioned append-only output over a local path or binary stream."""
+
+    def __init__(self, dest: Union[PathLike, BinaryIO]):
+        self._own = False
+        if isinstance(dest, (str, os.PathLike)):
+            self._fh = open(dest, "wb")
+            self._own = True
+            self.name = os.fspath(dest)
+        else:
+            self._fh = dest
+            self.name = getattr(dest, "name", "<stream>")
+        self._pos = 0
+
+    @property
+    def pos(self) -> int:
+        return self._pos
+
+    def write(self, data) -> int:
+        n = self._fh.write(data)
+        if n is None:
+            n = len(data)
+        self._pos += n
+        return n
+
+    def close(self) -> None:
+        if self._own:
+            self._fh.close()
+        else:
+            self._fh.flush()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

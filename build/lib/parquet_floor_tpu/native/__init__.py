@@ -1,0 +1,1 @@
+"""Native C++ runtime bindings (ctypes)."""

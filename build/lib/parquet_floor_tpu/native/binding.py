@@ -1,0 +1,388 @@
+"""ctypes binding to the C++ native runtime (libpftpu_native.so).
+
+The native library provides the host-side hot loops that a Python/NumPy
+implementation can't make fast: Snappy block compress/decompress and RLE
+run-table parsing.  Built from ``parquet_floor_tpu/native/src`` via
+``build.sh`` (g++, no external deps).  Everything degrades gracefully to the
+pure-Python implementations when the library isn't built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+_LIB_NAME = "libpftpu_native.so"
+_lib = None
+_load_attempted = False
+_load_lock = threading.Lock()
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), _LIB_NAME)
+
+
+def _try_build() -> bool:
+    """Best-effort one-shot build of the native lib (g++, no deps)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        return False
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        subprocess.run(
+            ["sh", os.path.join(here, "build.sh")],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load():
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    with _load_lock:
+        return _load_locked()
+
+
+def _load_locked():
+    global _lib, _load_attempted
+    if _load_attempted:  # lost the race: another thread finished the load
+        return _lib
+    path = _lib_path()
+    if not os.path.exists(path) and os.environ.get("PFTPU_NO_NATIVE_BUILD") != "1":
+        _try_build()
+    if not os.path.exists(path):
+        _load_attempted = True  # set only once the outcome is final
+        return None
+    try:
+        lib = _register(ctypes.CDLL(path))
+        _lib = lib
+    except OSError:
+        _lib = None
+    except AttributeError:
+        # stale .so from an older source revision (missing a symbol):
+        # rebuild once, retry; degrade to pure Python if that fails too.
+        # dlopen caches by pathname (the stale handle is never dlclosed),
+        # so the rebuilt library must load from a fresh path.
+        _lib = None
+        if os.environ.get("PFTPU_NO_NATIVE_BUILD") != "1" and _try_build():
+            import shutil
+            import tempfile
+
+            try:
+                fd, fresh = tempfile.mkstemp(suffix=".so", prefix="pftpu_")
+                os.close(fd)
+                shutil.copy2(path, fresh)
+                _lib = _register(ctypes.CDLL(fresh))
+            except (OSError, AttributeError):
+                _lib = None
+    _load_attempted = True  # after _lib is final, so the lock-free path is safe
+    return _lib
+
+
+def _register(lib):
+    """Declare every exported symbol's signature; raises AttributeError when
+    the loaded library predates a symbol (stale build)."""
+    lib.pftpu_snappy_max_compressed_size.restype = ctypes.c_size_t
+    lib.pftpu_snappy_max_compressed_size.argtypes = [ctypes.c_size_t]
+    lib.pftpu_snappy_compress.restype = ctypes.c_ssize_t
+    lib.pftpu_snappy_compress.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.pftpu_snappy_uncompressed_size.restype = ctypes.c_ssize_t
+    lib.pftpu_snappy_uncompressed_size.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.pftpu_snappy_decompress.restype = ctypes.c_ssize_t
+    lib.pftpu_snappy_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.pftpu_plain_ba_scan.restype = ctypes.c_ssize_t
+    lib.pftpu_plain_ba_scan.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
+    ]
+    lib.pftpu_zstd_decompress.restype = ctypes.c_ssize_t
+    lib.pftpu_zstd_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.pftpu_zstd_max_compressed_size.restype = ctypes.c_size_t
+    lib.pftpu_zstd_max_compressed_size.argtypes = [ctypes.c_size_t]
+    lib.pftpu_zstd_compress_store.restype = ctypes.c_ssize_t
+    lib.pftpu_zstd_compress_store.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.pftpu_rle_parse_runs.restype = ctypes.c_ssize_t
+    lib.pftpu_rle_parse_runs.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,  # data
+        ctypes.c_longlong, ctypes.c_int,   # num_values, bit_width
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_size_t,  # out table, capacity rows
+        ctypes.POINTER(ctypes.c_longlong),  # end position out
+    ]
+    lib.pftpu_lz4_decompress.restype = ctypes.c_ssize_t
+    lib.pftpu_lz4_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.pftpu_rle_count_equal.restype = ctypes.c_ssize_t
+    lib.pftpu_rle_count_equal.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,   # data
+        ctypes.c_longlong, ctypes.c_int,    # num_values, bit_width
+        ctypes.c_longlong,                  # target
+        ctypes.POINTER(ctypes.c_longlong),  # count out
+    ]
+    lib.pftpu_split_pages.restype = ctypes.c_ssize_t
+    lib.pftpu_split_pages.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,   # data
+        ctypes.c_longlong,                  # num_values
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_size_t,  # out, cap pages
+    ]
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def snappy_compress(data: bytes) -> bytes:
+    lib = _load()
+    cap = lib.pftpu_snappy_max_compressed_size(len(data))
+    out = ctypes.create_string_buffer(cap)
+    n = lib.pftpu_snappy_compress(data, len(data), out, cap)
+    if n < 0:
+        raise ValueError("native snappy compression failed")
+    return out.raw[:n]
+
+
+def snappy_decompress(data: bytes, uncompressed_size: Optional[int] = None) -> bytes:
+    lib = _load()
+    if uncompressed_size is None:
+        uncompressed_size = lib.pftpu_snappy_uncompressed_size(data, len(data))
+        if uncompressed_size < 0:
+            raise ValueError("native snappy: bad stream header")
+    out = ctypes.create_string_buffer(max(uncompressed_size, 1))
+    n = lib.pftpu_snappy_decompress(data, len(data), out, uncompressed_size)
+    if n < 0:
+        raise ValueError("native snappy decompression failed")
+    return out.raw[:n]
+
+
+def snappy_decompress_into(data, out_arr, offset: int, out_size: int) -> None:
+    """Decompress directly into ``out_arr[offset:offset+out_size]`` (a
+    C-contiguous uint8 ndarray) — the zero-extra-copy arena staging path."""
+    lib = _load()
+    ptr = ctypes.c_char_p(out_arr.ctypes.data + offset)
+    n = lib.pftpu_snappy_decompress(data, len(data), ptr, out_size)
+    if n < 0:
+        raise ValueError("native snappy decompression failed")
+    if n != out_size:
+        raise ValueError(f"snappy decoded {n} bytes, expected {out_size}")
+
+
+def zstd_decompress_into(data, out_arr, offset: int, out_size: int) -> None:
+    """RFC 8878 decode directly into ``out_arr[offset:offset+out_size]``."""
+    lib = _load()
+    ptr = ctypes.c_char_p(out_arr.ctypes.data + offset)
+    n = lib.pftpu_zstd_decompress(data, len(data), ptr, out_size)
+    if n == -2:
+        raise ValueError("native zstd: output exceeds the declared size")
+    if n < 0:
+        raise ValueError("native zstd: malformed frame")
+    if n != out_size:
+        raise ValueError(f"native zstd: decoded {n} bytes, expected {out_size}")
+
+
+def zstd_decompress(data: bytes, uncompressed_size: int) -> bytes:
+    """First-party RFC 8878 decoder (see src/pftpu_zstd.cc)."""
+    lib = _load()
+    out = ctypes.create_string_buffer(max(uncompressed_size, 1))
+    n = lib.pftpu_zstd_decompress(data, len(data), out, uncompressed_size)
+    if n == -2:
+        raise ValueError("native zstd: output exceeds the declared size")
+    if n < 0:
+        raise ValueError("native zstd: malformed frame")
+    if n != uncompressed_size:
+        raise ValueError(
+            f"native zstd: decoded {n} bytes, expected {uncompressed_size}"
+        )
+    return out.raw[:n]
+
+
+def zstd_decompress_unsized(data: bytes, cap: int) -> bytes:
+    """Decode without a known output size into a ``cap``-byte buffer; raises
+    ``ValueError('... grow ...')`` when the buffer is too small."""
+    lib = _load()
+    out = ctypes.create_string_buffer(max(cap, 1))
+    n = lib.pftpu_zstd_decompress(data, len(data), out, cap)
+    if n == -2:
+        raise ValueError("native zstd: output buffer too small, grow and retry")
+    if n < 0:
+        raise ValueError("native zstd: malformed frame")
+    return out.raw[:n]
+
+
+def zstd_compress(data: bytes) -> bytes:
+    """Store-mode zstd frames (raw blocks): spec-compliant, uncompressed."""
+    lib = _load()
+    cap = lib.pftpu_zstd_max_compressed_size(len(data))
+    out = ctypes.create_string_buffer(cap)
+    n = lib.pftpu_zstd_compress_store(data, len(data), out, cap)
+    if n < 0:
+        raise ValueError("native zstd: store encode failed")
+    return out.raw[:n]
+
+
+def plain_ba_scan(data, max_values: int):
+    """Walk a PLAIN BYTE_ARRAY length chain natively (zero-copy input).
+
+    Returns (starts, lengths) int64 arrays of the values found (may be
+    fewer than max_values when the buffer ends first).
+    """
+    import numpy as np
+
+    lib = _load()
+    starts = np.empty(max_values, dtype=np.int64)
+    lengths = np.empty(max_values, dtype=np.int64)
+    arr = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    n = lib.pftpu_plain_ba_scan(
+        ctypes.c_char_p(arr.ctypes.data), len(arr), max_values,
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+    )
+    if n < 0:
+        raise ValueError("malformed PLAIN BYTE_ARRAY stream")
+    return starts[:n], lengths[:n]
+
+
+def lz4_decompress_capped(data: bytes, max_size: int) -> bytes:
+    """Decode one LZ4 raw block natively; output may be any size ≤ cap
+    (Hadoop-framed records hold codec-buffer-sized inner blocks whose
+    exact decoded length is unknown until decoded)."""
+    lib = _load()
+    out = ctypes.create_string_buffer(max_size)
+    n = lib.pftpu_lz4_decompress(data, len(data), out, max_size)
+    if n == -2:
+        raise ValueError("LZ4 output larger than cap")
+    if n < 0:
+        raise ValueError("malformed LZ4 block")
+    return out.raw[:n]
+
+
+def lz4_decompress(data: bytes, uncompressed_size: int) -> bytes:
+    """Decode one LZ4 raw block natively (exact output size required)."""
+    lib = _load()
+    out = ctypes.create_string_buffer(uncompressed_size)
+    n = lib.pftpu_lz4_decompress(data, len(data), out, uncompressed_size)
+    if n == -2:
+        raise ValueError("LZ4 output larger than expected size")
+    if n < 0:
+        raise ValueError("malformed LZ4 block")
+    if n != uncompressed_size:
+        raise ValueError(
+            f"LZ4 block decoded {n} bytes, expected {uncompressed_size}"
+        )
+    return out.raw[:n]
+
+
+def split_pages(data, num_values: int):
+    """Scan a column chunk's Thrift page-header chain natively.
+
+    Returns an int64 ndarray of shape (n_pages, 16); see
+    pftpu_split_pages in pftpu_native.cc for the slot layout."""
+    import numpy as np
+
+    lib = _load()
+    if isinstance(data, np.ndarray):
+        arr = data if (data.dtype == np.uint8 and data.flags.c_contiguous) else (
+            np.ascontiguousarray(data).view(np.uint8)
+        )
+    else:
+        arr = np.frombuffer(data, dtype=np.uint8)
+    cap = 64
+    while True:
+        out = np.empty((cap, 16), dtype=np.int64)
+        n = lib.pftpu_split_pages(
+            arr.ctypes.data, len(arr), num_values,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), cap,
+        )
+        if n == -2:
+            cap *= 4
+            continue
+        if n < 0:
+            raise ValueError("malformed page header chain")
+        return out[:n]
+
+
+def rle_count_equal(data, num_values: int, bit_width: int, target: int,
+                    pos: int = 0) -> Optional[int]:
+    """Count decoded values == target in an RLE/bit-packed hybrid stream
+    without expanding it (native).  Returns None when the lib is absent."""
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        return None
+    if bit_width > 57:
+        # the native rolling 64-bit window needs (bitpos&7)+bit_width ≤ 64;
+        # wider fields fall back to the exact Python path
+        return None
+    if isinstance(data, np.ndarray):
+        arr = data if (data.dtype == np.uint8 and data.flags.c_contiguous) else (
+            np.ascontiguousarray(data).view(np.uint8)
+        )
+    else:
+        arr = np.frombuffer(data, dtype=np.uint8)
+    if pos < 0 or pos > len(arr):
+        raise ValueError(f"parse position {pos} outside buffer of {len(arr)} bytes")
+    out = ctypes.c_longlong(0)
+    rc = lib.pftpu_rle_count_equal(
+        arr.ctypes.data + pos, len(arr) - pos, num_values, bit_width,
+        target, ctypes.byref(out),
+    )
+    if rc < 0:
+        raise ValueError("native RLE count failed (malformed stream)")
+    return out.value
+
+
+def rle_parse_runs(data: bytes, num_values: int, bit_width: int, pos: int = 0):
+    """Parse an RLE/bit-packed hybrid run table natively.
+
+    Returns (run_table int64 ndarray (n,4), end_pos) matching
+    ``format.encodings.rle_hybrid.parse_runs``.
+    """
+    import numpy as np
+
+    lib = _load()
+    if isinstance(data, np.ndarray):
+        arr = data if (data.dtype == np.uint8 and data.flags.c_contiguous) else (
+            np.ascontiguousarray(data).view(np.uint8)
+        )
+    else:
+        arr = np.frombuffer(data, dtype=np.uint8)
+    if pos < 0 or pos > len(arr):
+        raise ValueError(f"parse position {pos} outside buffer of {len(arr)} bytes")
+    base_ptr = arr.ctypes.data + pos
+    avail = len(arr) - pos
+    cap = max(16, num_values)  # worst case: one run per 1 value? bounded below
+    while True:
+        table = np.empty((cap, 4), dtype=np.int64)
+        end = ctypes.c_longlong(0)
+        n = lib.pftpu_rle_parse_runs(
+            base_ptr, avail, num_values, bit_width,
+            table.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), cap,
+            ctypes.byref(end),
+        )
+        if n == -2:  # capacity exceeded
+            cap *= 2
+            continue
+        if n < 0:
+            raise ValueError("native RLE parse failed")
+        table = table[:n]
+        if pos:
+            table[table[:, 0] == 1, 2] += pos
+        return table, end.value + pos

@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build the native runtime: g++ only, no external deps.
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -march=native -fPIC -shared -Wall -Wextra \
+    -o libpftpu_native.so src/pftpu_native.cc src/pftpu_zstd.cc
+echo "built $(pwd)/libpftpu_native.so"

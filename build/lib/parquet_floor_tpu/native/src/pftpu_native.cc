@@ -1,0 +1,639 @@
+// pftpu_native: host-side hot loops for parquet-floor-tpu.
+//
+// TPU-native replacement for the JNI-wrapped codec natives the reference
+// consumes transitively (SURVEY.md §2.4: snappy-java/libsnappy behind the
+// io.compress shim seam).  Implemented from scratch against the public
+// Snappy block-format description and the Parquet RLE/bit-packed hybrid
+// spec.  Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+//
+// Build: parquet_floor_tpu/native/build.sh  (g++ -O3 -shared -fPIC)
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Snappy block format
+// ---------------------------------------------------------------------------
+
+static inline size_t varint_encode(size_t n, uint8_t* out) {
+  size_t i = 0;
+  while (n >= 0x80) {
+    out[i++] = static_cast<uint8_t>(n) | 0x80;
+    n >>= 7;
+  }
+  out[i++] = static_cast<uint8_t>(n);
+  return i;
+}
+
+static inline ptrdiff_t varint_decode(const uint8_t* p, const uint8_t* end,
+                                      uint64_t* out) {
+  uint64_t result = 0;
+  int shift = 0;
+  const uint8_t* start = p;
+  while (p < end && shift <= 35) {
+    uint8_t b = *p++;
+    result |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = result;
+      return p - start;
+    }
+    shift += 7;
+  }
+  return -1;
+}
+
+size_t pftpu_snappy_max_compressed_size(size_t n) {
+  // worst case: all literals + tag overhead + length varint
+  return 32 + n + n / 6;
+}
+
+ptrdiff_t pftpu_snappy_uncompressed_size(const uint8_t* src, size_t src_len) {
+  uint64_t n;
+  ptrdiff_t used = varint_decode(src, src + src_len, &n);
+  if (used < 0) return -1;
+  return static_cast<ptrdiff_t>(n);
+}
+
+// --- compression (greedy hash matcher, 14-bit table) -----------------------
+
+static const int kHashBits = 14;
+static const size_t kHashSize = 1u << kHashBits;
+
+static inline uint32_t load32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+static inline uint32_t hash32(uint32_t v) {
+  return (v * 0x1E35A7BDu) >> (32 - kHashBits);
+}
+
+static inline uint8_t* emit_literal(uint8_t* dst, const uint8_t* src,
+                                    size_t len) {
+  size_t n = len - 1;
+  if (n < 60) {
+    *dst++ = static_cast<uint8_t>(n << 2);
+  } else if (n < (1u << 8)) {
+    *dst++ = 60 << 2;
+    *dst++ = static_cast<uint8_t>(n);
+  } else if (n < (1u << 16)) {
+    *dst++ = 61 << 2;
+    *dst++ = static_cast<uint8_t>(n);
+    *dst++ = static_cast<uint8_t>(n >> 8);
+  } else if (n < (1u << 24)) {
+    *dst++ = 62 << 2;
+    *dst++ = static_cast<uint8_t>(n);
+    *dst++ = static_cast<uint8_t>(n >> 8);
+    *dst++ = static_cast<uint8_t>(n >> 16);
+  } else {
+    *dst++ = 63 << 2;
+    *dst++ = static_cast<uint8_t>(n);
+    *dst++ = static_cast<uint8_t>(n >> 8);
+    *dst++ = static_cast<uint8_t>(n >> 16);
+    *dst++ = static_cast<uint8_t>(n >> 24);
+  }
+  std::memcpy(dst, src, len);
+  return dst + len;
+}
+
+static inline uint8_t* emit_copy_upto64(uint8_t* dst, size_t offset,
+                                        size_t len) {
+  if (len >= 4 && len <= 11 && offset < 2048) {
+    *dst++ = static_cast<uint8_t>(1 | ((len - 4) << 2) | ((offset >> 8) << 5));
+    *dst++ = static_cast<uint8_t>(offset);
+  } else if (offset < (1u << 16)) {
+    *dst++ = static_cast<uint8_t>(2 | ((len - 1) << 2));
+    *dst++ = static_cast<uint8_t>(offset);
+    *dst++ = static_cast<uint8_t>(offset >> 8);
+  } else {
+    *dst++ = static_cast<uint8_t>(3 | ((len - 1) << 2));
+    *dst++ = static_cast<uint8_t>(offset);
+    *dst++ = static_cast<uint8_t>(offset >> 8);
+    *dst++ = static_cast<uint8_t>(offset >> 16);
+    *dst++ = static_cast<uint8_t>(offset >> 24);
+  }
+  return dst;
+}
+
+static inline uint8_t* emit_copy(uint8_t* dst, size_t offset, size_t len) {
+  while (len >= 68) {
+    dst = emit_copy_upto64(dst, offset, 64);
+    len -= 64;
+  }
+  if (len > 64) {
+    dst = emit_copy_upto64(dst, offset, len - 60);
+    len = 60;
+  }
+  return emit_copy_upto64(dst, offset, len);
+}
+
+ptrdiff_t pftpu_snappy_compress(const uint8_t* src, size_t src_len,
+                                uint8_t* dst, size_t dst_cap) {
+  if (dst_cap < pftpu_snappy_max_compressed_size(src_len)) return -1;
+  uint8_t* out = dst;
+  out += varint_encode(src_len, out);
+  if (src_len < 16) {
+    if (src_len) out = emit_literal(out, src, src_len);
+    return out - dst;
+  }
+  uint16_t table[kHashSize];
+  std::memset(table, 0, sizeof(table));
+  // table stores pos+1 within the current 64KB-ish window base
+  size_t pos = 0, lit_start = 0;
+  const size_t limit = src_len - 4;
+  size_t base = 0;  // window base so uint16 entries stay valid
+  while (pos <= limit) {
+    if (pos - base >= 60000) {  // rebase the window
+      base = pos;
+      std::memset(table, 0, sizeof(table));
+    }
+    uint32_t h = hash32(load32(src + pos));
+    size_t cand = base + table[h];
+    table[h] = static_cast<uint16_t>(pos - base + 1);
+    // cand==base means empty slot (stored value 0) unless a real match at
+    // base+? ; offset by one to disambiguate
+    if (cand == base) {
+      pos++;
+      continue;
+    }
+    cand -= 1;
+    size_t offset = pos - cand;
+    if (offset == 0 || offset >= (1u << 16) ||
+        load32(src + cand) != load32(src + pos)) {
+      pos++;
+      continue;
+    }
+    size_t mlen = 4;
+    const size_t maxm = src_len - pos;
+    while (mlen < maxm && src[cand + mlen] == src[pos + mlen]) mlen++;
+    if (lit_start < pos) out = emit_literal(out, src + lit_start, pos - lit_start);
+    out = emit_copy(out, offset, mlen);
+    pos += mlen;
+    lit_start = pos;
+  }
+  if (lit_start < src_len)
+    out = emit_literal(out, src + lit_start, src_len - lit_start);
+  return out - dst;
+}
+
+ptrdiff_t pftpu_snappy_decompress(const uint8_t* src, size_t src_len,
+                                  uint8_t* dst, size_t dst_cap) {
+  uint64_t expected;
+  ptrdiff_t used = varint_decode(src, src + src_len, &expected);
+  if (used < 0 || expected > dst_cap) return -1;
+  const uint8_t* p = src + used;
+  const uint8_t* end = src + src_len;
+  uint8_t* out = dst;
+  uint8_t* out_end = dst + expected;
+  while (p < end) {
+    const uint8_t tag = *p++;
+    const int kind = tag & 3;
+    if (kind == 0) {  // literal
+      size_t len = tag >> 2;
+      if (len >= 60) {
+        const size_t nb = len - 59;
+        if (p + nb > end) return -2;
+        len = 0;
+        for (size_t i = 0; i < nb; i++) len |= static_cast<size_t>(p[i]) << (8 * i);
+        p += nb;
+      }
+      len += 1;
+      if (p + len > end || out + len > out_end) return -2;
+      std::memcpy(out, p, len);
+      p += len;
+      out += len;
+      continue;
+    }
+    size_t len, offset;
+    if (kind == 1) {
+      if (p + 1 > end) return -2;
+      len = ((tag >> 2) & 0x7) + 4;
+      offset = (static_cast<size_t>(tag >> 5) << 8) | *p++;
+    } else if (kind == 2) {
+      if (p + 2 > end) return -2;
+      len = (tag >> 2) + 1;
+      offset = p[0] | (static_cast<size_t>(p[1]) << 8);
+      p += 2;
+    } else {
+      if (p + 4 > end) return -2;
+      len = (tag >> 2) + 1;
+      offset = p[0] | (static_cast<size_t>(p[1]) << 8) |
+               (static_cast<size_t>(p[2]) << 16) |
+               (static_cast<size_t>(p[3]) << 24);
+      p += 4;
+    }
+    if (offset == 0 || offset > static_cast<size_t>(out - dst)) return -2;
+    if (out + len > out_end) return -2;
+    const uint8_t* from = out - offset;
+    if (offset >= len) {
+      std::memcpy(out, from, len);
+      out += len;
+    } else {
+      for (size_t i = 0; i < len; i++) *out++ = *from++;
+    }
+  }
+  if (out != out_end) return -2;
+  return out - dst;
+}
+
+// ---------------------------------------------------------------------------
+// LZ4 raw block decode (parquet LZ4_RAW, and the payload of Hadoop-framed
+// LZ4).  Sequence copies must go byte-by-byte when overlapping (RLE-style
+// offsets < length are the common case).
+// ---------------------------------------------------------------------------
+
+ptrdiff_t pftpu_lz4_decompress(const uint8_t* src, size_t src_len,
+                               uint8_t* dst, size_t dst_cap) {
+  const uint8_t* p = src;
+  const uint8_t* const end = src + src_len;
+  uint8_t* out = dst;
+  uint8_t* const out_end = dst + dst_cap;
+  while (p < end) {
+    const uint8_t token = *p++;
+    size_t lit = token >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do {
+        if (p >= end) return -1;
+        b = *p++;
+        lit += b;
+      } while (b == 255);
+    }
+    if (lit > static_cast<size_t>(end - p)) return -1;
+    if (lit > static_cast<size_t>(out_end - out)) return -2;
+    std::memcpy(out, p, lit);
+    p += lit;
+    out += lit;
+    if (p >= end) break;  // final sequence carries literals only
+    if (p + 2 > end) return -1;
+    const size_t offset = static_cast<size_t>(p[0]) | (static_cast<size_t>(p[1]) << 8);
+    p += 2;
+    if (offset == 0 || offset > static_cast<size_t>(out - dst)) return -1;
+    size_t mlen = token & 0xF;
+    if (mlen == 15) {
+      uint8_t b;
+      do {
+        if (p >= end) return -1;
+        b = *p++;
+        mlen += b;
+      } while (b == 255);
+    }
+    mlen += 4;
+    if (mlen > static_cast<size_t>(out_end - out)) return -2;
+    const uint8_t* from = out - offset;
+    if (offset >= mlen) {
+      std::memcpy(out, from, mlen);
+      out += mlen;
+    } else {
+      for (size_t i = 0; i < mlen; i++) *out++ = *from++;
+    }
+  }
+  return out - dst;
+}
+
+// ---------------------------------------------------------------------------
+// RLE/bit-packed hybrid run-table parse (phase 1 of the two-phase decode;
+// phase 2 — expansion — runs vectorized on TPU or in NumPy)
+// ---------------------------------------------------------------------------
+
+// Row layout matches format/encodings/rle_hybrid.py parse_runs:
+//   [kind(0=RLE,1=bitpacked), count, value_or_byte_offset, 0]
+ptrdiff_t pftpu_rle_parse_runs(const uint8_t* data, size_t data_len,
+                               long long num_values, int bit_width,
+                               long long* out_table, size_t cap_rows,
+                               long long* end_pos) {
+  if (bit_width == 0) {
+    *end_pos = 0;
+    return 0;
+  }
+  const uint8_t* p = data;
+  const uint8_t* end = data + data_len;
+  long long remaining = num_values;
+  const int value_bytes = (bit_width + 7) / 8;
+  size_t rows = 0;
+  while (remaining > 0) {
+    uint64_t header;
+    ptrdiff_t used = varint_decode(p, end, &header);
+    if (used < 0) return -1;
+    p += used;
+    if (header & 1) {
+      const long long groups = static_cast<long long>(header >> 1);
+      // hostile/corrupt headers: groups * bit_width must not overflow, and
+      // a run can never legitimately exceed the remaining byte budget
+      if (groups < 0 || groups > static_cast<long long>(data_len)) return -1;
+      const long long n = groups * 8;
+      if (rows >= cap_rows) return -2;
+      out_table[rows * 4 + 0] = 1;
+      out_table[rows * 4 + 1] = n < remaining ? n : remaining;
+      out_table[rows * 4 + 2] = p - data;
+      out_table[rows * 4 + 3] = 0;
+      rows++;
+      const long long nbytes = groups * bit_width;
+      if (p + nbytes > end) return -1;
+      p += nbytes;
+      remaining -= n;
+    } else {
+      const long long n = static_cast<long long>(header >> 1);
+      if (n < 0) return -1;  // 64-bit varint overflow in a hostile header
+      if (p + value_bytes > end) return -1;
+      long long value = 0;
+      for (int i = 0; i < value_bytes; i++)
+        value |= static_cast<long long>(p[i]) << (8 * i);
+      p += value_bytes;
+      if (rows >= cap_rows) return -2;
+      out_table[rows * 4 + 0] = 0;
+      out_table[rows * 4 + 1] = n < remaining ? n : remaining;
+      out_table[rows * 4 + 2] = value;
+      out_table[rows * 4 + 3] = 0;
+      rows++;
+      remaining -= n;
+    }
+  }
+  *end_pos = p - data;
+  return static_cast<ptrdiff_t>(rows);
+}
+
+// ---------------------------------------------------------------------------
+// PLAIN BYTE_ARRAY length-chain walk (the only sequential part of string
+// decode; payload gather stays vectorized in NumPy / on device)
+// ---------------------------------------------------------------------------
+
+// Writes value payload start offsets and lengths; returns the number of
+// values parsed (≤ max_values), or -1 on a malformed chain.
+ptrdiff_t pftpu_plain_ba_scan(const uint8_t* data, size_t data_len,
+                              long long max_values, long long* out_starts,
+                              long long* out_lengths) {
+  size_t pos = 0;
+  long long n = 0;
+  while (pos < data_len && n < max_values) {
+    if (pos + 4 > data_len) return -1;
+    uint32_t len;
+    std::memcpy(&len, data + pos, 4);
+    pos += 4;
+    if (pos + len > data_len) return -1;
+    out_starts[n] = static_cast<long long>(pos);
+    out_lengths[n] = static_cast<long long>(len);
+    pos += len;
+    n++;
+  }
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// RLE/bit-packed hybrid: count decoded values equal to `target` without
+// materializing the expansion (definition-level non-null counting — the
+// staging hot loop for optional/repeated columns)
+// ---------------------------------------------------------------------------
+
+ptrdiff_t pftpu_rle_count_equal(const uint8_t* data, size_t data_len,
+                                long long num_values, int bit_width,
+                                long long target, long long* out_count) {
+  if (bit_width == 0) {
+    *out_count = (target == 0) ? num_values : 0;
+    return 0;
+  }
+  const uint8_t* p = data;
+  const uint8_t* end = data + data_len;
+  long long remaining = num_values;
+  const int value_bytes = (bit_width + 7) / 8;
+  const uint64_t mask = (bit_width >= 64)
+                            ? ~0ULL
+                            : ((1ULL << bit_width) - 1);
+  long long count = 0;
+  while (remaining > 0) {
+    uint64_t header;
+    ptrdiff_t used = varint_decode(p, end, &header);
+    if (used < 0) return -1;
+    p += used;
+    if (header & 1) {
+      const long long groups = static_cast<long long>(header >> 1);
+      // hostile/corrupt headers: reject before groups * bit_width can
+      // overflow or move the cursor out of bounds
+      if (groups < 0 || groups > static_cast<long long>(data_len)) return -1;
+      long long n = groups * 8;
+      if (n > remaining) n = remaining;
+      const long long nbytes = groups * bit_width;
+      if (nbytes > end - p) return -1;
+      // unpack little-endian bit fields with a rolling 64-bit window
+      long long bitpos = 0;
+      for (long long i = 0; i < n; i++) {
+        const long long byte0 = bitpos >> 3;
+        uint64_t window = 0;
+        const long long avail = (nbytes - byte0) < 8 ? (nbytes - byte0) : 8;
+        std::memcpy(&window, p + byte0, static_cast<size_t>(avail));
+        const uint64_t v = (window >> (bitpos & 7)) & mask;
+        count += (static_cast<long long>(v) == target);
+        bitpos += bit_width;
+      }
+      p += nbytes;
+      remaining -= n;
+    } else {
+      long long n = static_cast<long long>(header >> 1);
+      if (n < 0) return -1;  // 64-bit varint overflow in a hostile header
+      if (p + value_bytes > end) return -1;
+      long long value = 0;
+      for (int i = 0; i < value_bytes; i++)
+        value |= static_cast<long long>(p[i]) << (8 * i);
+      p += value_bytes;
+      if (n > remaining) n = remaining;
+      if (value == target) count += n;
+      remaining -= n;
+    }
+  }
+  *out_count = count;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Page-header scan: parse the Thrift compact PageHeader chain of a column
+// chunk (the host staging loop's hottest pure-Python cost).  Unknown fields
+// (statistics, bloom offsets, …) are skipped structurally.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct CReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+  int depth = 0;  // skip recursion bound (hostile nesting)
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+  long long zigzag() {
+    uint64_t v = varint();
+    return static_cast<long long>((v >> 1) ^ (~(v & 1) + 1));
+  }
+  void skip_bytes(size_t n) {
+    if (static_cast<size_t>(end - p) < n) { ok = false; return; }
+    p += n;
+  }
+  void skip_value(int ctype);
+  void skip_struct() {
+    if (++depth > 64) { ok = false; return; }  // hostile nesting: bail
+    while (ok) {
+      if (p >= end) { ok = false; break; }
+      uint8_t b = *p++;
+      if (b == 0) break;  // STOP
+      int ctype = b & 0x0F;
+      if (((b >> 4) & 0x0F) == 0) (void)zigzag();  // long-form field id
+      skip_value(ctype);
+    }
+    depth--;
+  }
+};
+
+void CReader::skip_value(int ctype) {
+  // every container path is depth-bounded: hostile nesting must return an
+  // error, never exhaust the C stack or spin without consuming input
+  if (++depth > 64) { ok = false; return; }
+  switch (ctype) {
+    case 1: case 2: break;                  // bool in header
+    case 3: skip_bytes(1); break;           // byte
+    case 4: case 5: case 6: (void)varint(); break;  // i16/i32/i64
+    case 7: skip_bytes(8); break;           // double
+    case 8: skip_bytes(varint()); break;    // binary
+    case 9: case 10: {                      // list/set
+      if (p >= end) { ok = false; break; }
+      uint8_t h = *p++;
+      size_t n = h >> 4;
+      int et = h & 0x0F;
+      if (n == 15) n = varint();
+      for (size_t i = 0; i < n && ok; i++) {
+        if (et == 1 || et == 2) skip_bytes(1);  // bool element = 1 byte
+        else skip_value(et);
+      }
+      break;
+    }
+    case 11: {                              // map
+      size_t n = varint();
+      if (n) {
+        if (p >= end) { ok = false; break; }
+        uint8_t kv = *p++;
+        int kt = kv >> 4;
+        int vt = kv & 0x0F;
+        for (size_t i = 0; i < n && ok; i++) {
+          // bool elements occupy one byte in containers (skip_value's
+          // header-bool path consumes nothing — that would spin forever
+          // on a hostile count)
+          if (kt == 1 || kt == 2) skip_bytes(1); else skip_value(kt);
+          if (vt == 1 || vt == 2) skip_bytes(1); else skip_value(vt);
+        }
+      }
+      break;
+    }
+    case 12: skip_struct(); break;          // struct
+    default: ok = false; break;
+  }
+  depth--;
+}
+
+// Parse one struct, capturing i32/i64/bool fields into slots[fid] when
+// fid < cap (slots preinitialized by caller); nested structs are parsed
+// recursively only when sub_fid matches, else skipped.
+void parse_flat(CReader& r, long long* slots, int cap) {
+  int last_fid = 0;
+  while (r.ok) {
+    if (r.p >= r.end) { r.ok = false; return; }
+    uint8_t b = *r.p++;
+    if (b == 0) return;
+    int ctype = b & 0x0F;
+    int delta = (b >> 4) & 0x0F;
+    int fid = delta ? last_fid + delta
+                    : static_cast<int>(r.zigzag());
+    last_fid = fid;
+    if (ctype == 1 || ctype == 2) {
+      if (fid >= 0 && fid < cap) slots[fid] = (ctype == 1);
+      continue;
+    }
+    if ((ctype >= 4 && ctype <= 6) && fid >= 0 && fid < cap) {
+      slots[fid] = r.zigzag();
+      continue;
+    }
+    r.skip_value(ctype);
+  }
+}
+
+}  // namespace
+
+// Per page, 16 output slots:
+//  0 page_type, 1 payload_off, 2 compressed_size, 3 uncompressed_size,
+//  4 crc(-1 absent), 5 num_values, 6 encoding, 7 def_enc, 8 rep_enc,
+//  9 num_nulls(-1), 10 dl_len(-1), 11 rl_len(-1), 12 is_compressed(-1),
+// 13 dict_num_values(-1), 14 dict_encoding(-1), 15 reserved
+ptrdiff_t pftpu_split_pages(const uint8_t* data, size_t data_len,
+                            long long num_values, long long* out,
+                            size_t cap_pages) {
+  CReader r{data, data + data_len};
+  long long seen = 0;
+  size_t n_pages = 0;
+  while (seen < num_values && r.p < r.end) {
+    if (n_pages >= cap_pages) return -2;
+    long long* o = out + n_pages * 16;
+    for (int i = 0; i < 16; i++) o[i] = -1;
+    // PageHeader fields: 1 type, 2 uncompressed, 3 compressed, 4 crc,
+    // 5 data_page_header, 7 dictionary_page_header, 8 data_page_header_v2
+    int last_fid = 0;
+    bool stop = false;
+    while (r.ok && !stop) {
+      if (r.p >= r.end) { r.ok = false; break; }
+      uint8_t b = *r.p++;
+      if (b == 0) { stop = true; break; }
+      int ctype = b & 0x0F;
+      int delta = (b >> 4) & 0x0F;
+      int fid = delta ? last_fid + delta : static_cast<int>(r.zigzag());
+      last_fid = fid;
+      if (ctype >= 4 && ctype <= 6 && fid >= 1 && fid <= 4) {
+        long long v = r.zigzag();
+        if (fid == 1) o[0] = v;
+        else if (fid == 2) o[3] = v;
+        else if (fid == 3) o[2] = v;
+        else { o[4] = v; o[15] = 1; }  // crc may be negative: flag presence
+        continue;
+      }
+      if (ctype == 12 && (fid == 5 || fid == 7 || fid == 8)) {
+        long long slots[16];
+        for (int i = 0; i < 16; i++) slots[i] = -1;
+        parse_flat(r, slots, 16);
+        if (fid == 5) {           // DataPageHeader: v, enc, def, rep
+          o[5] = slots[1]; o[6] = slots[2]; o[7] = slots[3]; o[8] = slots[4];
+        } else if (fid == 7) {    // DictionaryPageHeader
+          o[13] = slots[1]; o[14] = slots[2];
+        } else {                  // DataPageHeaderV2
+          o[5] = slots[1]; o[9] = slots[2]; o[6] = slots[4];
+          o[10] = slots[5]; o[11] = slots[6]; o[12] = slots[7];
+          o[13] = slots[3];  // num_rows (slot shared with dict pages)
+        }
+        continue;
+      }
+      r.skip_value(ctype);
+    }
+    if (!r.ok || o[0] < 0 || o[2] < 0) return -1;
+    o[1] = r.p - data;  // payload offset
+    if (static_cast<size_t>(o[1]) + static_cast<size_t>(o[2]) > data_len)
+      return -1;
+    r.p += o[2];
+    if (o[0] == 0 || o[0] == 3) {  // DATA_PAGE or DATA_PAGE_V2
+      if (o[5] < 0) return -1;
+      seen += o[5];
+    }
+    n_pages++;
+  }
+  return static_cast<ptrdiff_t>(n_pages);
+}
+
+}  // extern "C"

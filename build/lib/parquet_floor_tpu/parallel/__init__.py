@@ -1,0 +1,1 @@
+"""Device-mesh sharding: row-group/column parallel decode via jax.sharding."""

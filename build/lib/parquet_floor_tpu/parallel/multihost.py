@@ -1,0 +1,288 @@
+"""Multi-host sharded decode over DCN — each process reads only its own
+row groups' bytes, and the results assemble into global ``jax.Array``s.
+
+The single-host sibling (``parallel.shard``) shards row groups across the
+devices one process owns; this module scales the same axis across
+*processes* (hosts): process ``p`` owns the contiguous block of row
+groups ``[p·k, (p+1)·k)`` (k = G_pad / process_count — contiguous so
+the global array preserves file row order), each host decodes its share
+locally (never touching other hosts' byte ranges — the DCN
+input-sharding pattern SURVEY.md §5 prescribes), and
+``jax.make_array_from_process_local_data`` stitches the per-host shards
+into one globally-sharded array without any host ever holding the full
+column.
+
+Layout mirrors ``parallel.shard``: ragged files (non-uniform groups,
+group counts that don't divide the axis) pad rows onto a fixed per-group
+stride with a ``row_mask``; strings are padded ``(N, W)`` bytes +
+``lengths``; repeated columns shard at the row-group grain.  Dimensions
+that only decode can reveal (string width, non-null value counts) are
+agreed across hosts with one tiny ``process_allgather`` — row counts and
+level counts come from the footer, which every host reads.
+
+Under a single process (tests, the driver's virtual CPU mesh) this
+degrades to a plain sharded decode — same code path, one shard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from functools import partial
+
+from .shard import ShardedNestedColumn, _pad_rows
+
+_pad_np = partial(_pad_rows, xp=np)
+
+
+def _agree_max(matrix: np.ndarray) -> np.ndarray:
+    """Global elementwise max of one small per-host integer matrix —
+    the SINGLE DCN collective of a read (identity under one process)."""
+    arr = np.asarray(matrix, np.int64)
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(arr)
+    return np.max(gathered, axis=0)
+
+
+def _dtype_code(dt) -> Tuple[int, int]:
+    """Encode a numpy dtype as (kind ordinal, itemsize) integers so it can
+    ride the allgather; (0, 0) = this host has no sample (ghost-only)."""
+    dt = np.dtype(dt)
+    return ord(dt.kind), dt.itemsize
+
+
+def _dtype_from_code(kind: int, size: int):
+    if kind == 0:
+        return np.int64  # no host decoded this column anywhere (0 groups)
+    if chr(kind) == "b":
+        return np.bool_
+    return np.dtype(f"{chr(kind)}{size}")
+
+
+@dataclass
+class GlobalColumn:
+    """A globally-sharded decoded column: dense values + null mask.
+
+    ``row_mask`` (True = real row) appears only for ragged files, where
+    rows sit on a fixed per-group stride; ``num_rows`` is the true total.
+    Strings carry padded ``(N, W)`` byte matrices plus ``lengths``.
+    """
+
+    values: jax.Array
+    mask: Optional[jax.Array]  # True where null; None when required
+    lengths: Optional[jax.Array] = None
+    row_mask: Optional[jax.Array] = None
+    num_rows: Optional[int] = None
+
+
+def read_sharded_global(
+    source,
+    mesh: Mesh,
+    axis: str = "rg",
+    columns: Optional[Sequence[str]] = None,
+    float64_policy: str = "auto",
+) -> Dict[str, object]:
+    """Decode a parquet file into global arrays sharded over ``mesh[axis]``.
+
+    Each process decodes a contiguous block of row groups, so the
+    assembled global array preserves file row order.  All column kinds
+    are supported: fixed-width (flat), strings (padded bytes + lengths),
+    and repeated columns (:class:`~parquet_floor_tpu.parallel.shard.
+    ShardedNestedColumn`, sharded at the row-group grain).  Ragged files
+    pad to a per-group stride with a ``row_mask`` instead of raising.
+    """
+    from ..tpu.engine import TpuRowGroupReader
+
+    n_proc = jax.process_count()
+    pid = jax.process_index()
+    n_axis = int(mesh.shape[axis])
+    sharding = NamedSharding(mesh, P(axis))
+
+    with TpuRowGroupReader(source, float64_policy=float64_policy) as reader:
+        rgs = reader.reader.row_groups
+        n_groups = len(rgs)
+        rows_per = [int(rg.num_rows or 0) for rg in rgs]
+        per_axis = max(1, -(-n_groups // n_axis))
+        g_pad = per_axis * n_axis
+        if g_pad % n_proc:
+            raise ValueError(
+                f"axis of {n_axis} devices is not spread evenly over "
+                f"{n_proc} processes"
+            )
+        stride = max(rows_per) if rows_per else 0
+        uniform = g_pad == n_groups and len(set(rows_per)) <= 1
+        k = g_pad // n_proc
+        mine = [g for g in range(pid * k, (pid + 1) * k)]
+
+        decoded: Dict[int, Dict[str, object]] = {
+            g: reader.read_row_group(g, columns)
+            for g in mine
+            if g < n_groups
+        }
+        # column names must agree across hosts even when a host owns only
+        # ghost groups: derive them from the schema, mirroring the engine's
+        # naming rule (dotted path for any nested leaf, else the bare name)
+        want = set(columns) if columns else None
+        names, descs = [], []
+        for desc in reader.reader.schema.columns:
+            if want and desc.path[0] not in want:
+                continue
+            names.append(".".join(desc.path) if len(desc.path) > 1 else desc.path[0])
+            descs.append(desc)
+
+        # ONE allgather agrees every decode-revealed fact for the whole
+        # file: per column [repeated, strings, any_mask, width, vmax,
+        # lmax, trailing dim, dtype kind, dtype size]
+        meta_local = np.zeros((len(names), 9), np.int64)
+        for ci, name in enumerate(names):
+            parts = {g: decoded[g][name] for g in decoded}
+            if not parts:
+                continue
+            sample = next(iter(parts.values()))
+            repeated = sample.is_repeated
+            strings = sample.is_strings
+            trail = (
+                sample.values.shape[-1]
+                if (not strings and sample.values.ndim > 1)
+                else 0
+            )
+            kind, size = _dtype_code(sample.values.dtype)
+            meta_local[ci] = [
+                int(repeated),
+                int(strings),
+                int(any(p.mask is not None for p in parts.values())),
+                max(p.values.shape[1] for p in parts.values()) if strings else 0,
+                max(p.values.shape[0] for p in parts.values()) if repeated else 0,
+                max(p.def_levels.shape[0] for p in parts.values()) if repeated else 0,
+                trail,
+                kind,
+                size,
+            ]
+        meta = _agree_max(meta_local)
+
+        out: Dict[str, object] = {}
+        for ci, name in enumerate(names):
+            parts = {g: decoded[g][name] for g in decoded}
+            rep_flag, str_flag, any_mask, width, vmax, lmax, trail, kind, size = (
+                int(v) for v in meta[ci]
+            )
+            vdtype = np.uint8 if str_flag else _dtype_from_code(kind, size)
+            if rep_flag:
+                out[name] = _nested_global(
+                    parts, mine, rows_per, sharding,
+                    bool(str_flag), width, vmax, lmax, vdtype, descs[ci],
+                )
+            else:
+                out[name] = _flat_global(
+                    parts, mine, rows_per, stride, uniform, sharding,
+                    bool(str_flag), bool(any_mask), width, trail, vdtype,
+                )
+        return out
+
+
+def _flat_global(parts, mine, rows_per, stride, uniform,
+                 sharding, strings, any_mask, width, trail, vdtype):
+    vals, masks, lens, valids = [], [], [], []
+    for g in mine:
+        if g in parts:
+            p, rows = parts[g], rows_per[g]
+            v = np.asarray(p.values)
+            if strings:
+                v = _pad_np(v, stride, width)
+            else:
+                v = _pad_np(v, stride)
+            m = np.zeros(stride, bool)
+            if p.mask is not None:
+                m[: rows] = np.asarray(p.mask)[:rows]
+                v = v.copy()
+                v[np.flatnonzero(m[: v.shape[0]])] = 0
+            valid = np.arange(stride) < rows
+            ln = (
+                _pad_np(np.asarray(p.lengths), stride) if strings else None
+            )
+        else:  # ghost group: all metadata comes from the agreed vector
+            shape = (
+                (stride, width)
+                if strings
+                else ((stride, trail) if trail else (stride,))
+            )
+            v = np.zeros(shape, vdtype)
+            m = np.zeros(stride, bool)
+            valid = np.zeros(stride, bool)
+            ln = np.zeros(stride, np.int32) if strings else None
+        vals.append(v)
+        masks.append(m)
+        valids.append(valid)
+        if strings:
+            lens.append(ln)
+
+    local_v = np.concatenate(vals) if vals else np.zeros(0, vdtype)
+    values = jax.make_array_from_process_local_data(sharding, local_v)
+    mask = (
+        jax.make_array_from_process_local_data(sharding, np.concatenate(masks))
+        if any_mask
+        else None
+    )
+    lengths = (
+        jax.make_array_from_process_local_data(
+            sharding, np.concatenate([l.astype(np.int32) for l in lens])
+        )
+        if strings
+        else None
+    )
+    row_mask = (
+        None
+        if uniform
+        else jax.make_array_from_process_local_data(
+            sharding, np.concatenate(valids)
+        )
+    )
+    return GlobalColumn(
+        values, mask, lengths=lengths, row_mask=row_mask,
+        num_rows=sum(rows_per),
+    )
+
+
+def _nested_global(parts, mine, rows_per, sharding,
+                   strings, width, vmax, lmax, vdtype, desc):
+    vs, ls, ds, rs, counts, grows = [], [], [], [], [], []
+    for g in mine:
+        if g in parts:
+            p = parts[g]
+            v = np.asarray(p.values)
+            v = _pad_np(v, vmax, width if strings else None)
+            d = _pad_np(np.asarray(p.def_levels), lmax)
+            r = _pad_np(np.asarray(p.rep_levels), lmax)
+            ln = _pad_np(np.asarray(p.lengths), vmax) if strings else None
+            counts.append(np.asarray(p.def_levels).shape[0])
+            grows.append(rows_per[g])
+        else:  # ghost group: all metadata comes from the agreed vector
+            v = np.zeros((vmax, width) if strings else (vmax,), vdtype)
+            d = np.zeros(lmax, np.int32)
+            r = np.zeros(lmax, np.int32)
+            ln = np.zeros(vmax, np.int32) if strings else None
+            counts.append(0)
+            grows.append(0)
+        vs.append(v)
+        ds.append(d.astype(np.int32))
+        rs.append(r.astype(np.int32))
+        if strings:
+            ls.append(ln.astype(np.int32))
+
+    mk = jax.make_array_from_process_local_data
+    gv = mk(sharding, np.stack(vs))
+    gl = mk(sharding, np.stack(ls)) if strings else None
+    gd = mk(sharding, np.stack(ds))
+    gr = mk(sharding, np.stack(rs))
+    gc = mk(sharding, np.asarray(counts, np.int32))
+    gg = mk(sharding, np.asarray(grows, np.int32))
+    return ShardedNestedColumn(desc, gv, gl, gd, gr, gc, gg)

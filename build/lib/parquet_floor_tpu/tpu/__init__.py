@@ -1,0 +1,1 @@
+"""TPU decode engine: Pallas kernels + batched row-group reader."""

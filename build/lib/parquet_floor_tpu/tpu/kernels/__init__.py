@@ -1,0 +1,1 @@
+"""Pallas TPU kernels for the columnar decode hot path."""

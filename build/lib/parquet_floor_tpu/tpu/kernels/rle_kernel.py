@@ -1,0 +1,386 @@
+"""Pallas TPU kernel: fused RLE/bit-packed hybrid run expansion.
+
+The jnp reference (``tpu/bitops.py:rle_expand``) costs one
+``searchsorted`` (log R gathers per element) plus a 5-byte gather per
+element for bit-packed runs — all through HBM between HLO ops.  This kernel
+replaces the per-element gathers with run-local vectorized extraction:
+
+* grid over output tiles; a host-built *span table* tells each tile which
+  runs intersect it (``tile_lo``/``tile_hi``), so the kernel loop is
+  O(runs-in-tile), not O(R);
+* RLE runs broadcast their value into the masked tile range (VPU select);
+* bit-packed runs exploit the format's byte-aligned packed streams
+  (Parquet RLE spec: packed groups start on a byte boundary): the whole
+  values buffer stays in HBM, the per-run window is DMA'd into VMEM,
+  exploded to a bit matrix, dynamically shifted, regrouped to (TILE, bw)
+  and contracted with power-of-two weights — an int matmul the MXU eats.
+
+Replaces the reference's per-cell ValuesReader pull loop
+(``ParquetReader.java:141-168``, ``ParquetReader.java:196-203``) — the
+same seam SURVEY.md §2.4(2) maps to Pallas kernels.
+
+Correctness contract: identical output to ``bitops.rle_expand`` for every
+valid run table (property-tested in interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Output tile: (SUB, LANE) int32 = 2048 values per grid step.
+_SUB, _LANE = 16, 128
+TILE = _SUB * _LANE
+# Tile window of the lane-gather kernel: one 1024-aligned DMA covering the
+# whole tile's packed span.  The binding case is bit_width = 8: 1023
+# alignment residual + 2048 packed bytes = 3071 ≤ 3072 — an exact fit
+# (bit_width ≤ 7 needs only 1023 + 1792 + 113).
+_WIN = 3072
+# Widest bit width the lane-gather kernel handles: a 128-value row's span
+# must fit the post-roll 128-byte gather operand — ≤113 bytes for bw ≤ 7,
+# and exactly 128 for bw = 8, where fields are whole bytes so the clamped
+# high-byte gather contributes nothing.  The engine's Pallas gating and
+# the kernel dispatch below must agree on this.
+LANE_KERNEL_MAX_BW = 8
+# Scalar-prefetch (SMEM, 1 MiB/program) budget the engine's gating must
+# respect: run plans are 5·PL_MAX_RUNS int32 and tile spans 2·count/TILE.
+PL_MAX_RUNS = 2048
+PL_MAX_VALUES = 1 << 24
+
+
+def _tile_window_bytes(bit_width: int) -> int:
+    """VMEM window per bit-packed run segment: one tile's worth of packed
+    bits plus slack for the byte-misaligned start and the trailing read."""
+    return TILE * bit_width // 8 + 16
+
+
+def _rle_expand_kernel(
+    # scalar prefetch (SMEM)
+    tile_lo_ref, tile_hi_ref, run_out_end_ref, run_kind_ref,
+    run_value_ref, run_byte_ref,
+    # tensor inputs
+    data_hbm,           # uint8[B] in ANY/HBM: the raw values buffer
+    # outputs
+    out_ref,            # int32[SUB, LANE] tile in VMEM
+    # scratch
+    win_ref,            # uint8[1, W] VMEM window for packed bytes
+    sem,                # DMA semaphore
+    *, bit_width: int,
+):
+    t = pl.program_id(0)
+    tile_start = t * TILE
+    lo = tile_lo_ref[t]
+    hi = tile_hi_ref[t]
+
+    # Element index within this tile (flattened (SUB, LANE) order).
+    flat = (
+        jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0) * _LANE
+        + jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
+    )
+    gidx = tile_start + flat  # global output index per element
+
+    W = _tile_window_bytes(bit_width)
+    bits_per_byte = 8
+    # Weights for the (TILE, bw) x (bw,) contraction.
+    weights = (
+        jnp.int32(1) << jax.lax.broadcasted_iota(jnp.int32, (bit_width, 1), 0)
+    )  # (bw, 1)
+
+    def body(r, acc):
+        # literals must be explicit int32: under jax_enable_x64 a weak
+        # Python int traces as an int64 constant, and Mosaic's lowering of
+        # the resulting int64→int32 convert recurses forever
+        zero = jnp.int32(0)
+        r_end = run_out_end_ref[r]
+        r_start = jnp.where(
+            r == zero, zero, run_out_end_ref[jnp.maximum(r - 1, zero)]
+        )
+        in_run = (gidx >= r_start) & (gidx < r_end)
+
+        kind = run_kind_ref[r]
+        rle_fill = jnp.where(in_run, run_value_ref[r], acc)
+
+        # --- bit-packed branch -------------------------------------------
+        # Within-run index of the tile's element 0 (may be negative when the
+        # run starts mid-tile; the buffer carries FRONT_PAD leading bytes so
+        # the DMA window can begin before the run base, and out-of-run
+        # elements decode garbage that ``in_run`` masks away).
+        w_base = tile_start - r_start
+        bit0 = w_base * bit_width                 # signed, rel. to packed base
+        # arithmetic shift = floor; force int32 — x64 mode otherwise
+        # promotes through weak literals to i64, which DMA indices reject
+        byte_off = (run_byte_ref[r] + (bit0 >> 3)).astype(jnp.int32)
+        shift = (bit0 & 7).astype(jnp.int32)      # floor-mod residual (0..7)
+
+        def packed_branch(acc_in):
+            copy = pltpu.make_async_copy(
+                data_hbm.at[pl.ds(byte_off, W)],
+                win_ref.at[0, :],
+                sem,
+            )
+            copy.start()
+            copy.wait()
+            # Explode window to bits, int32 and 2-D throughout (Mosaic
+            # handles 32-bit vector ops; uint8 reshapes crash its compiler):
+            # widen (1, W) bytes, broadcast to (8, W), shift-and-mask per
+            # bit plane, transpose to byte-major (W, 8), flatten.
+            w32 = win_ref[0:1, :].astype(jnp.int32)        # (1, W)
+            kq = jax.lax.broadcasted_iota(jnp.int32, (bits_per_byte, W), 0)
+            planes = (jnp.broadcast_to(w32, (bits_per_byte, W)) >> kq) & 1
+            bits = planes.T.reshape(1, W * bits_per_byte)  # byte-major order
+            # Drop the residual shift (0..7) by rotating left, then regroup
+            # to (TILE, bw).  (dynamic_slice with a traced start doesn't
+            # lower in Mosaic; roll does.)
+            rolled = pltpu.roll(bits, -shift, axis=1)
+            seg = jax.lax.slice(rolled, (0, 0), (1, TILE * bit_width))
+            fields = seg.reshape(TILE, bit_width)
+            vals_flat = jax.lax.dot_general(
+                fields, weights,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ).reshape(_SUB, _LANE)
+            # vals_flat[i] is the value for within-tile element i only when
+            # the element belongs to this run (its packed index = w0 + (its
+            # global index - tile_start)); elements before the run's start in
+            # this tile would need negative packed indices — they're masked.
+            return jnp.where(in_run, vals_flat, acc_in)
+
+        acc_out = jax.lax.cond(
+            kind == 1, packed_branch, lambda a: rle_fill, acc
+        )
+        return acc_out
+
+    result = jax.lax.fori_loop(lo, hi, body, jnp.zeros((_SUB, _LANE), jnp.int32))
+    out_ref[:, :] = result
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_values", "bit_width", "interpret"),
+)
+def rle_expand_pallas(
+    data_u8: jax.Array,
+    run_out_end: jax.Array,
+    run_kind: jax.Array,
+    run_value: jax.Array,
+    run_bitbase: jax.Array,
+    tile_lo: jax.Array,
+    tile_hi: jax.Array,
+    num_values: int,
+    bit_width: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas twin of ``bitops.rle_expand`` (+ host-built tile spans).
+
+    Standalone convenience wrapper over :func:`rle_expand_pallas_inline`:
+    pads the buffer with the lead/tail slack the inline contract requires
+    and rebases the (byte-aligned) bit offsets.  Output is int32[n].
+    """
+    if bit_width == 0:
+        return jnp.zeros(num_values, dtype=jnp.int32)
+    front = ARENA_LEAD
+    data_u8 = jnp.pad(data_u8, (front, ARENA_TAIL))
+    run_bitbase = run_bitbase + 8 * front
+    return rle_expand_pallas_inline(
+        data_u8, run_out_end, run_kind, run_value, run_bitbase,
+        tile_lo, tile_hi, num_values, bit_width, interpret=interpret,
+    )
+
+
+# Slack the arena must carry for the inline (no-copy) variant: a run
+# starting mid-tile makes the DMA window begin up to TILE*bw/8 bytes before
+# the run's packed base (lead), and the last window reads W bytes past the
+# stream end (tail).  Sized for the max bit width (32).
+ARENA_LEAD = TILE * 32 // 8 + 16    # 8208
+ARENA_TAIL = _tile_window_bytes(32) + 32  # 8240
+
+
+def _rle_expand_kernel_lane(
+    # scalar prefetch (SMEM)
+    tile_lo_ref, tile_hi_ref, run_out_end_ref, run_kind_ref,
+    run_value_ref, run_byte_ref,
+    # tensor inputs
+    data_hbm,           # uint8[B] in ANY/HBM
+    # outputs
+    out_ref,            # int32[SUB, LANE]
+    # scratch
+    win_ref,            # uint8[_WIN] one aligned tile-span window
+    sem,                # DMA semaphore
+    *, bit_width: int,
+):
+    """Mosaic-compilable variant for bit_width ≤ LANE_KERNEL_MAX_BW.
+
+    One 1024-aligned ``_WIN``-byte DMA per packed run loads the whole
+    tile's span into a 1-D scratch; 16 per-row uniform rolls align each
+    row's window start to lane 0 (row offsets are exactly linear — a
+    128-value row advances 16·bw whole bytes); each element's field then
+    comes from a *lane-wise* two-byte gather (``take_along_axis`` along
+    lanes — one of the two gather forms Mosaic lowers natively) plus
+    shift/mask.  No irregular reshapes, no byte-granular dynamic slices,
+    no strided rolls: every vector op is (16, 128)/(16, _WIN) int32.
+    """
+    t = pl.program_id(0)
+    tile_start = t * TILE
+    lo = tile_lo_ref[t]
+    hi = tile_hi_ref[t]
+
+    row_i = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0)
+    lane_i = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
+    gidx = tile_start + row_i * _LANE + lane_i
+
+    def body(r, acc):
+        zero = jnp.int32(0)
+        r_end = run_out_end_ref[r]
+        r_start = jnp.where(
+            r == zero, zero, run_out_end_ref[jnp.maximum(r - 1, zero)]
+        )
+        in_run = (gidx >= r_start) & (gidx < r_end)
+        kind = run_kind_ref[r]
+        rle_fill = jnp.where(in_run, run_value_ref[r], acc)
+
+        # run-relative bit position of the tile's element 0 (may be < 0;
+        # ARENA_LEAD slack keeps every window in bounds)
+        bit0 = (tile_start - r_start) * bit_width
+
+        def packed_branch(acc_in):
+            # ONE aligned DMA covers the whole tile's packed span: HBM
+            # uint8 slice offsets must be provably 1024-divisible and
+            # sizes 1024-multiples, and the tile needs ≤ 1023 (residual)
+            # + 1792 (2048·7 bits) + 113 ≤ 3072 bytes.
+            byte_off0 = (run_byte_ref[r] + (bit0 >> 3)).astype(jnp.int32)
+            aligned = pl.multiple_of(byte_off0 & ~jnp.int32(1023), 1024)
+            copy = pltpu.make_async_copy(
+                data_hbm.at[pl.ds(aligned, _WIN)],
+                win_ref,
+                sem,
+            )
+            copy.start()
+            copy.wait()
+            w1 = win_ref[:].reshape(1, _WIN).astype(jnp.int32)
+            # Row r's window begins δ_r = δ_0 + r·16·bw bytes into the
+            # buffer (exactly linear: 128·bw bits is a whole byte count).
+            # One uniform roll per row left-rotates by δ_r; amounts are
+            # kept positive in (0, _WIN] because compiled Mosaic treats
+            # dynamic shifts as unsigned mod 2³² (negative breaks), and
+            # its *strided* roll cannot cross vreg boundaries at all.
+            delta0 = byte_off0 - aligned
+            row_step = _LANE * bit_width // 8              # 16·bw
+            rolled = jnp.concatenate(
+                [
+                    pltpu.roll(w1, _WIN - (delta0 + rr * row_step), axis=1)
+                    for rr in range(_SUB)
+                ],
+                axis=0,
+            )
+            w128 = jax.lax.slice(rolled, (0, 0), (_SUB, _LANE))
+            # local bit position: row windows start byte-exact, so only
+            # bit0's sub-byte residual (same every row) and the lane remain
+            lam = (bit0 & 7) + lane_i * bit_width          # ≤ 7 + 127·bw
+            b0 = lam >> 3
+            lo8 = jnp.take_along_axis(w128, b0, axis=1, mode="promise_in_bounds")
+            if bit_width == 8:
+                # fields are whole bytes (bit0 ≡ 0 mod 8): lo8 IS the value,
+                # and b0+1 would read lane 128 at the last element
+                vals = lo8
+            else:
+                hi8 = jnp.take_along_axis(
+                    w128, b0 + 1, axis=1, mode="promise_in_bounds"
+                )
+                vals = ((lo8 | (hi8 << 8)) >> (lam & 7)) & ((1 << bit_width) - 1)
+            return jnp.where(in_run, vals, acc_in)
+
+        return jax.lax.cond(kind == 1, packed_branch, lambda a: rle_fill, acc)
+
+    result = jax.lax.fori_loop(lo, hi, body, jnp.zeros((_SUB, _LANE), jnp.int32))
+    out_ref[:, :] = result
+
+
+def rle_expand_pallas_inline(
+    arena_u8: jax.Array,
+    run_out_end: jax.Array,
+    run_kind: jax.Array,
+    run_value: jax.Array,
+    run_bitbase: jax.Array,
+    tile_lo: jax.Array,
+    tile_hi: jax.Array,
+    num_values: int,
+    bit_width: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """``rle_expand_pallas`` without the jit wrapper or defensive copy —
+    composable inside a larger jitted program (the fused row-group decode).
+
+    Contract: ``arena_u8`` already carries ≥ ``ARENA_LEAD`` bytes of slack
+    before any packed stream and ≥ ``ARENA_TAIL`` after (the engine's
+    arena builder reserves both), so DMA windows never leave the buffer.
+    ``run_bitbase`` holds absolute *bit* offsets into ``arena_u8``.
+    """
+    if bit_width == 0:
+        return jnp.zeros(num_values, dtype=jnp.int32)
+    n_tiles = pl.cdiv(num_values, TILE)
+    run_byte = (run_bitbase // 8).astype(jnp.int32)
+    if bit_width <= LANE_KERNEL_MAX_BW:
+        # lane-gather formulation: the only one Mosaic compiles today
+        kernel = functools.partial(_rle_expand_kernel_lane, bit_width=bit_width)
+        scratch = pltpu.VMEM((_WIN,), jnp.uint8)
+    else:
+        kernel = functools.partial(_rle_expand_kernel, bit_width=bit_width)
+        scratch = pltpu.VMEM((1, _tile_window_bytes(bit_width)), jnp.uint8)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(
+            (_SUB, _LANE), lambda t, *_: (t, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            scratch,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    # Trace the kernel with x64 off: under jax_enable_x64 Mosaic emits
+    # 64-bit memref indices (tpu.memref_slice rejects i64) and weak-literal
+    # converts that recurse in lowering.  All operands are ≤32-bit anyway.
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n_tiles * _SUB, _LANE), jnp.int32),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(
+            tile_lo.astype(jnp.int32),
+            tile_hi.astype(jnp.int32),
+            run_out_end.astype(jnp.int32),
+            run_kind.astype(jnp.int32),
+            run_value.astype(jnp.int32),
+            run_byte,
+            arena_u8,
+        )
+    return out.reshape(-1)[:num_values]
+
+
+def tile_spans_padded(out_end_padded: np.ndarray, num_values: int) -> tuple:
+    """Host-side tile spans over a *padded* plan (pad runs own no output:
+    out_end == total).  Tiles past the real total get empty spans."""
+    n_tiles = -(-num_values // TILE)
+    starts = np.arange(n_tiles, dtype=np.int64) * TILE
+    ends = np.minimum(starts + TILE, num_values)
+    lo = np.searchsorted(out_end_padded, starts, side="right")
+    hi = np.minimum(
+        np.searchsorted(out_end_padded, ends - 1, side="right") + 1,
+        len(out_end_padded),
+    )
+    hi = np.maximum(hi, lo)  # empty span for all-pad tiles
+    return lo.astype(np.int32), hi.astype(np.int32)
+
+
+def tile_spans(run_out_end: np.ndarray, num_values: int) -> tuple:
+    """Host-side: for each output tile, the [lo, hi) run-index span that
+    intersects it.  O(T log R) searchsorted — tiny."""
+    return tile_spans_padded(run_out_end, num_values)

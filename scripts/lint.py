@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Self-contained lint gate — the checkstyle analogue the reference runs
+in CI (/root/reference/pipeline.yml:33-63, checkstyle.xml:8-16).
+
+Prefers ruff when installed (config in pyproject.toml).  Otherwise runs a
+built-in subset that needs only the standard library, so the gate works
+in hermetic images: syntax (compile), tabs, trailing whitespace, long
+lines, and AST-level unused-import detection.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import shutil
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+TARGETS = ["parquet_floor_tpu", "tests", "benchmarks", "bench.py", "__graft_entry__.py"]
+MAX_LINE = 100
+
+
+def python_files():
+    for t in TARGETS:
+        p = ROOT / t
+        if p.is_file():
+            yield p
+        else:
+            yield from sorted(p.rglob("*.py"))
+
+
+def run_ruff() -> int:
+    return subprocess.call(
+        ["ruff", "check", *TARGETS], cwd=ROOT
+    )
+
+
+def _unused_imports(tree: ast.AST, src: str):
+    """Module-level imports never referenced anywhere in the file."""
+    imported = {}  # name -> lineno
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                imported[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imported[a.asname or a.name] = node.lineno
+    used = {
+        n.id for n in ast.walk(tree) if isinstance(n, ast.Name)
+    } | {
+        n.attr for n in ast.walk(tree) if isinstance(n, ast.Attribute)
+    }
+    # names echoed in __all__ or doctests count as used (cheap heuristic);
+    # "# noqa" on the import line suppresses, as ruff would
+    src_lines = src.splitlines()
+    for name, lineno in sorted(imported.items()):
+        if "# noqa" in src_lines[lineno - 1]:
+            continue
+        if name not in used and f'"{name}"' not in src and f"'{name}'" not in src:
+            yield lineno, f"unused import: {name}"
+
+
+def run_builtin() -> int:
+    problems = []
+    for path in python_files():
+        rel = path.relative_to(ROOT)
+        src = path.read_text()
+        try:
+            tree = ast.parse(src, filename=str(rel))
+        except SyntaxError as e:
+            problems.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        for i, line in enumerate(src.splitlines(), 1):
+            if "\t" in line:
+                problems.append(f"{rel}:{i}: tab character")
+            if line != line.rstrip():
+                problems.append(f"{rel}:{i}: trailing whitespace")
+            if len(line) > MAX_LINE and "http" not in line:
+                problems.append(f"{rel}:{i}: line too long ({len(line)} > {MAX_LINE})")
+        for lineno, msg in _unused_imports(tree, src):
+            problems.append(f"{rel}:{lineno}: {msg}")
+    for p in problems:
+        print(p)
+    print(f"lint: {len(problems)} problem(s) in {sum(1 for _ in python_files())} files")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    if shutil.which("ruff"):
+        sys.exit(run_ruff())
+    sys.exit(run_builtin())

@@ -2,7 +2,6 @@
 (``ParquetReadWriteTest.java:28-83``) plus the documented facade semantics
 (SURVEY.md §2.1 behavioral facts)."""
 
-import numpy as np
 import pytest
 
 from parquet_floor_tpu import (

@@ -5,7 +5,6 @@ import numpy as np
 import pytest
 
 from parquet_floor_tpu import (
-    ColumnData,
     CompressionCodec,
     ParquetFileReader,
     ParquetFileWriter,
@@ -13,7 +12,6 @@ from parquet_floor_tpu import (
     types,
 )
 from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
-from parquet_floor_tpu.format.file_write import make_column_data
 
 rng = np.random.default_rng(11)
 

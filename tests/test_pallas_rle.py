@@ -7,7 +7,6 @@ The CI analogue of testing TPU kernels without a TPU (SURVEY.md §4 lesson):
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from parquet_floor_tpu.format.encodings import rle_hybrid as e_rle

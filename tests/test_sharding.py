@@ -207,7 +207,6 @@ def test_read_table_sharded_strings_nested_ragged(tmp_path):
     optionals, and ragged files (non-uniform groups, non-divisible group
     count) — verified bit-exact against the host reader."""
     from parquet_floor_tpu import ParquetFileReader
-    from parquet_floor_tpu.batch.nested import assemble_nested
 
     path, schema, truth = _ragged_file(tmp_path)
     mesh = pshard.make_mesh(8, rg=8)

@@ -279,7 +279,6 @@ def test_native_split_pages_hostile_containers():
         binding.split_pages(deep_lists, 1000)
     # map with an astronomical count of bool elements must not spin:
     # field header ctype 11 (map), varint count 2^35, kv types bool/bool
-    import struct as _s
     hostile = bytes([0x1B]) + bytes([0x80] * 4 + [0x02]) + bytes([0x11])
     with pytest.raises(ValueError):
         binding.split_pages(hostile + b"\x00" * 8, 1000)

@@ -4,7 +4,6 @@
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from parquet_floor_tpu.format.encodings import rle_hybrid as rle

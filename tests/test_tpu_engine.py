@@ -73,10 +73,13 @@ def _std_cols(n=3000, dict_friendly=True):
         "i32": (types.INT32, rng.integers(0, mod, n).astype(np.int32), False, None),
         "f32": (types.FLOAT, rng.integers(0, mod, n).astype(np.float32), False, None),
         "f64": (types.DOUBLE, rng.integers(0, mod, n).astype(np.float64) * 0.5, False, None),
-        "s": (types.BYTE_ARRAY, [f"word_{i % (mod // 2)}" for i in range(n)], False, types.string()),
+        "s": (types.BYTE_ARRAY, [f"word_{i % (mod // 2)}" for i in range(n)],
+              False, types.string()),
         "b": (types.BOOLEAN, rng.integers(0, 2, n).astype(bool), False, None),
         "opt64": (types.INT64, [None if i % 7 == 0 else i % mod for i in range(n)], True, None),
-        "opts": (types.BYTE_ARRAY, [None if i % 5 == 0 else f"s{i % 9}" for i in range(n)], True, types.string()),
+        "opts": (types.BYTE_ARRAY,
+                 [None if i % 5 == 0 else f"s{i % 9}" for i in range(n)],
+                 True, types.string()),
     }
 
 

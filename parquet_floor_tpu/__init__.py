@@ -15,6 +15,7 @@ from .format.schema import (
     types,
 )
 from .format.parquet_thrift import CompressionCodec, Encoding, Type
+from .format.codecs import UnsupportedCodec, register_codec
 from .format.metadata import ParquetMetadata
 from .format.file_read import ParquetFileReader
 from .format.file_write import ColumnData, ParquetFileWriter, WriterOptions
@@ -25,7 +26,7 @@ from .batch.nested import NestedColumn, assemble_nested, shred_nested
 from .batch.predicate import Predicate, col
 from .utils import trace
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "ColumnData", "ColumnDescriptor", "CompressionCodec", "Dehydrator",
@@ -33,8 +34,9 @@ __all__ = [
     "LogicalAnnotation", "MessageType", "NestedColumn", "ParquetFileReader",
     "ParquetFileWriter", "ParquetMetadata", "ParquetReader", "ParquetWriter",
     "Predicate", "PrimitiveType", "TpuRowGroupReader", "Type",
-    "assemble_nested", "col", "read_sharded_global", "shred_nested", "trace",
-    "types", "ValueWriter", "WriterOptions",
+    "UnsupportedCodec", "assemble_nested", "col", "read_sharded_global",
+    "register_codec", "shred_nested", "trace", "types", "ValueWriter",
+    "WriterOptions",
 ]
 
 _LAZY = {

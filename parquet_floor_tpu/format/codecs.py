@@ -252,21 +252,62 @@ _DECOMPRESSORS: Dict[int, Callable[..., bytes]] = {
 }
 
 
+def register_codec(
+    codec: int,
+    compressor: Optional[Callable[[bytes], bytes]] = None,
+    decompressor: Optional[Callable[[bytes, Optional[int]], bytes]] = None,
+) -> None:
+    """User-pluggable codec seam — the open dispatch the reference gets
+    from ``ReflectionUtils.newInstance`` instantiating any codec class the
+    footer names (``ReflectionUtils.java:10-21``).  Register either side:
+
+        register_codec(CompressionCodec.BROTLI,
+                       compressor=brotli.compress,
+                       decompressor=lambda d, n: brotli.decompress(d))
+
+    ``decompressor`` receives ``(data, uncompressed_size_or_None)`` and
+    must return exactly ``uncompressed_size`` bytes when given one (the
+    footer's page header size is enforced after the call).  Registration
+    overrides a built-in codec; pass None to leave a side unchanged.
+    """
+    if compressor is not None:
+        _COMPRESSORS[codec] = compressor
+    if decompressor is not None:
+        _DECOMPRESSORS[codec] = decompressor
+
+
+def _codec_guidance(codec: int) -> str:
+    name = CompressionCodec.name(codec)
+    if codec == CompressionCodec.BROTLI:
+        return (
+            f"{name} has no built-in implementation: install the "
+            "'brotli' (or 'brotlicffi') package and plug it in with "
+            "register_codec(CompressionCodec.BROTLI, brotli.compress, "
+            "lambda d, n: brotli.decompress(d))"
+        )
+    if codec == CompressionCodec.LZO:
+        return (
+            f"{name} has no built-in implementation (GPL-licensed "
+            "upstream): provide one with register_codec("
+            "CompressionCodec.LZO, ...)"
+        )
+    return (
+        f"codec {name} is not supported; third-party codecs can be "
+        "plugged in with register_codec()"
+    )
+
+
 def compress(codec: int, data: bytes) -> bytes:
     fn = _COMPRESSORS.get(codec)
     if fn is None:
-        raise UnsupportedCodec(
-            f"no compressor for codec {CompressionCodec.name(codec)}"
-        )
+        raise UnsupportedCodec(_codec_guidance(codec))
     return fn(bytes(data))
 
 
 def decompress(codec: int, data: bytes, uncompressed_size: Optional[int] = None) -> bytes:
     fn = _DECOMPRESSORS.get(codec)
     if fn is None:
-        raise UnsupportedCodec(
-            f"no decompressor for codec {CompressionCodec.name(codec)}"
-        )
+        raise UnsupportedCodec(_codec_guidance(codec))
     out = fn(bytes(data), uncompressed_size)
     if uncompressed_size is not None and len(out) != uncompressed_size:
         raise ValueError(
@@ -308,6 +349,14 @@ def supported_codecs() -> Tuple[int, ...]:
         CompressionCodec.LZ4_RAW,
         CompressionCodec.LZ4,
     ]
-    if _zstd is not None or (_native is not None and _native.available()):
+    zstd_builtin = _DECOMPRESSORS.get(CompressionCodec.ZSTD) is _zstd_decompress
+    if (
+        not zstd_builtin  # user-registered implementation
+        or _zstd is not None
+        or (_native is not None and _native.available())
+    ):
         base.append(CompressionCodec.ZSTD)
+    for codec in list(_DECOMPRESSORS) + list(_COMPRESSORS):
+        if codec not in base and codec != CompressionCodec.ZSTD:
+            base.append(codec)  # user-registered via register_codec
     return tuple(base)

@@ -1,7 +1,9 @@
 #!/bin/sh
 # Build the native runtime: g++ only, no external deps.
+# PFTPU_MARCH defaults to native for local self-builds; CI/distribution
+# builds must set a baseline (e.g. x86-64-v2) so the artifact is portable.
 set -e
 cd "$(dirname "$0")"
-g++ -O3 -march=native -fPIC -shared -Wall -Wextra \
+g++ -O3 -march="${PFTPU_MARCH:-native}" -fPIC -shared -Wall -Wextra \
     -o libpftpu_native.so src/pftpu_native.cc src/pftpu_zstd.cc
 echo "built $(pwd)/libpftpu_native.so"

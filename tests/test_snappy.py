@@ -144,3 +144,62 @@ def test_lz4_hadoop_multiblock_record():
     # two records, the second itself multi-block
     rec2 = codecs._lz4_hadoop_compress(b"solo") + rec
     assert codecs._lz4_hadoop_decompress(rec2) == b"solo" + payload
+
+
+def test_register_codec_roundtrip_and_guidance(tmp_path):
+    """The open codec seam (reference: ReflectionUtils instantiates any
+    codec class the footer names): an unregistered BROTLI footer raises
+    actionable guidance; a user-registered implementation round-trips a
+    whole file."""
+    import zlib
+
+    import pytest
+
+    from parquet_floor_tpu import (
+        CompressionCodec,
+        ParquetFileReader,
+        ParquetFileWriter,
+        UnsupportedCodec,
+        WriterOptions,
+        register_codec,
+        types,
+    )
+    from parquet_floor_tpu.format import codecs as C
+
+    with pytest.raises(UnsupportedCodec, match="register_codec"):
+        C.decompress(CompressionCodec.BROTLI, b"xx", 4)
+    with pytest.raises(UnsupportedCodec, match="register_codec"):
+        C.compress(CompressionCodec.LZO, b"xx")
+
+    schema = types.message("t", types.required(types.INT64).named("v"))
+    path = str(tmp_path / "brotli_like.parquet")
+    data = np.arange(5000, dtype=np.int64)
+    saved_c = dict(C._COMPRESSORS)
+    saved_d = dict(C._DECOMPRESSORS)
+    try:
+        # stand-in implementation: zlib under the BROTLI id — exercises
+        # exactly the registration seam a real brotli wheel would use
+        register_codec(
+            CompressionCodec.BROTLI,
+            compressor=zlib.compress,
+            decompressor=lambda d, n: zlib.decompress(d),
+        )
+        assert CompressionCodec.BROTLI in C.supported_codecs()
+        with ParquetFileWriter(
+            path, schema, WriterOptions(codec=CompressionCodec.BROTLI)
+        ) as w:
+            w.write_columns({"v": data})
+        with ParquetFileReader(path) as r:
+            assert r.row_groups[0].columns[0].meta_data.codec == CompressionCodec.BROTLI
+            np.testing.assert_array_equal(
+                r.read_row_group(0).column("v").values, data
+            )
+    finally:
+        C._COMPRESSORS.clear()
+        C._COMPRESSORS.update(saved_c)
+        C._DECOMPRESSORS.clear()
+        C._DECOMPRESSORS.update(saved_d)
+    # with the registration rolled back the same file refuses helpfully
+    with ParquetFileReader(path) as r:
+        with pytest.raises(UnsupportedCodec, match="brotli"):
+            r.read_row_group(0)

@@ -331,10 +331,18 @@ def decompress_into(
         )
         return
     if _native is not None and _native.available():
-        if codec == CompressionCodec.SNAPPY:
+        # the in-place native shortcuts apply only while the built-in
+        # decoder is live — a register_codec override must win here too
+        if (
+            codec == CompressionCodec.SNAPPY
+            and _DECOMPRESSORS.get(codec) is _snappy_decompress
+        ):
             _native.snappy_decompress_into(bytes(data), out_arr, offset, out_size)
             return
-        if codec == CompressionCodec.ZSTD:
+        if (
+            codec == CompressionCodec.ZSTD
+            and _DECOMPRESSORS.get(codec) is _zstd_decompress
+        ):
             _native.zstd_decompress_into(bytes(data), out_arr, offset, out_size)
             return
     out = decompress(codec, data, out_size)
@@ -356,7 +364,10 @@ def supported_codecs() -> Tuple[int, ...]:
         or (_native is not None and _native.available())
     ):
         base.append(CompressionCodec.ZSTD)
-    for codec in list(_DECOMPRESSORS) + list(_COMPRESSORS):
+    # user-registered codecs: the list means "readable" (decompressor
+    # present), matching the ZSTD backend gate above — a compressor-only
+    # registration does not make a footer naming that codec readable
+    for codec in _DECOMPRESSORS:
         if codec not in base and codec != CompressionCodec.ZSTD:
-            base.append(codec)  # user-registered via register_codec
+            base.append(codec)
     return tuple(base)

@@ -162,6 +162,93 @@ def unpack_bools(data_u8: jax.Array, count: int) -> jax.Array:
     return bits.reshape(-1)[:count].astype(jnp.bool_)
 
 
+def _combine64(lo: jax.Array, hi: jax.Array) -> jax.Array:
+    """Recombine an int64 split into (low, high) int32 rows (the int32
+    plan slab cannot carry 64-bit constants directly)."""
+    return lo.astype(jnp.uint32).astype(jnp.int64) | (hi.astype(jnp.int64) << 32)
+
+
+def extract_bits64(data_u8: jax.Array, bitpos: jax.Array, bw: jax.Array) -> jax.Array:
+    """Gather variable-width fields up to 64 bits (two 32-bit windows).
+
+    ``bw`` is a per-element int32 array in [0, 64]; returns int64 with the
+    packed value zero-extended (bits ≥ bw masked off)."""
+    lo = extract_bits(data_u8, bitpos, 32).astype(jnp.int64)
+    hi = extract_bits(data_u8, bitpos + 32, 32).astype(jnp.int64)
+    v = lo | (hi << 32)
+    bw64 = bw.astype(jnp.uint64)
+    mask = jnp.where(
+        bw >= 64,
+        jnp.uint64(0xFFFFFFFFFFFFFFFF),
+        (jnp.uint64(1) << jnp.clip(bw64, 0, 63)) - jnp.uint64(1),
+    )
+    mask = jnp.where(bw <= 0, jnp.uint64(0), mask)
+    return (v.astype(jnp.uint64) & mask).astype(jnp.int64)
+
+
+def delta_expand_wide(
+    data_u8: jax.Array,
+    mb_bitbase: jax.Array,    # int32[M]
+    mb_bw: jax.Array,         # int32[M] (≤ 64)
+    mb_min_lo: jax.Array,     # int32[M]: min_delta low word
+    mb_min_hi: jax.Array,     # int32[M]: min_delta high word
+    first_lo, first_hi,       # scalars (int32 words)
+    num_values: int,
+    values_per_miniblock: int,
+) -> jax.Array:
+    """DELTA_BINARY_PACKED expansion in full int64 arithmetic: miniblock
+    widths up to 64 bits and prefix sums beyond int32 range (timestamps,
+    row ids).  Wraparound at 64 bits is the spec's own semantics, so no
+    range bound exists to enforce."""
+    first = _combine64(jnp.asarray(first_lo, jnp.int32), jnp.asarray(first_hi, jnp.int32))
+    n_deltas = num_values - 1
+    if n_deltas <= 0:
+        return jnp.full((max(num_values, 1),), 0, jnp.int64)[:num_values] + first
+    idx = jnp.arange(n_deltas, dtype=jnp.int32)
+    mb = idx // values_per_miniblock
+    within = idx % values_per_miniblock
+    bw = mb_bw[mb]
+    bitpos = mb_bitbase[mb] + within * bw
+    packed = extract_bits64(data_u8, bitpos, bw)
+    deltas = packed + _combine64(mb_min_lo, mb_min_hi)[mb]
+    acc = jnp.cumsum(deltas) + first
+    return jnp.concatenate([first[None], acc])
+
+
+def delta_expand_paged_wide(
+    data_u8: jax.Array,
+    mb_out_start: jax.Array,  # int32[M]
+    mb_bitbase: jax.Array,    # int32[M]
+    mb_bw: jax.Array,         # int32[M] (≤ 64)
+    mb_min_lo: jax.Array,     # int32[M]
+    mb_min_hi: jax.Array,     # int32[M]
+    page_start: jax.Array,    # int32[P]
+    page_first_lo: jax.Array,  # int32[P]
+    page_first_hi: jax.Array,  # int32[P]
+    page_cum: jax.Array,      # int32[P]
+    num_values: int,
+) -> jax.Array:
+    """The segmented (multi-page / optional) form of
+    :func:`delta_expand_wide` — same int64 reconstruction as
+    :func:`delta_expand_paged`'s int32 one."""
+    i = jnp.arange(num_values, dtype=jnp.int32)
+    pgi = jnp.searchsorted(page_cum, i, side="right").astype(jnp.int32)
+    pgi = jnp.minimum(pgi, page_cum.shape[0] - 1)
+    s = page_start[pgi]
+    mb = jnp.searchsorted(mb_out_start, i, side="right").astype(jnp.int32) - 1
+    mb = jnp.clip(mb, 0, mb_out_start.shape[0] - 1)
+    within = i - mb_out_start[mb]
+    bw = mb_bw[mb]
+    bitpos = mb_bitbase[mb] + within * bw
+    packed = extract_bits64(data_u8, jnp.maximum(bitpos, 0), bw)
+    delta = packed + _combine64(mb_min_lo, mb_min_hi)[mb]
+    d0 = jnp.where(i == s, jnp.int64(0), delta)
+    c0 = jnp.cumsum(d0)
+    c0_at_start = jnp.take(c0, jnp.clip(s, 0, num_values - 1))
+    first = _combine64(page_first_lo, page_first_hi)[pgi]
+    return first + c0 - c0_at_start
+
+
 def delta_expand(
     data_u8: jax.Array,
     mb_bitbase: jax.Array,    # int32[M]: absolute bit offset of each miniblock

@@ -25,7 +25,8 @@ Decode paths on device:
   * RLE_DICTIONARY fixed-width + BYTE_ARRAY (mixed per-page bit widths OK)
   * PLAIN fixed-width (paged gather across non-contiguous page streams)
   * PLAIN BOOLEAN (pages as bit-packed runs)
-  * DELTA_BINARY_PACKED (≤32-bit miniblocks, single page, required)
+  * DELTA_BINARY_PACKED (multi-page, optional, full int64 via the wide
+    reconstruction when the int32 fast path can't prove exactness)
 Anything else decodes on the host NumPy engine and ships dense *inside the
 same arena* (no extra transfers).
 """
@@ -302,8 +303,8 @@ def _bucket15(n: int, minimum: int = 16) -> int:
 
 class _ColSpec(NamedTuple):
     name: str
-    # dict | dict_str | plain | bool | delta | host | host_rows |
-    # host_str | hostr | hostr_str
+    # dict | dict_str | plain | bool | delta | delta1 | delta1w | deltaw |
+    # host | host_rows | host_str | hostr | hostr_str
     kind: str
     n: int           # rows in the group (level positions for repeated cols)
     nexp: int        # value-stream expansion count (n if required, bucketed nn if optional)
@@ -534,6 +535,16 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
             out_dtype=_JNP_BY_NAME[spec.vdtype],
         )
         lens = None
+    elif spec.kind == "delta1w":
+        mb = lax.slice(
+            slab, (spec.mb_off,), (spec.mb_off + 4 * spec.m_pad,)
+        ).reshape(4, spec.m_pad)
+        vals = bitops.delta_expand_wide(
+            arena, mb[0], mb[1], mb[2], mb[3],
+            slab[spec.sc_off], slab[spec.sc_off + 1],
+            spec.nexp, spec.vpm,
+        ).astype(_JNP_BY_NAME[spec.vdtype])
+        lens = None
     elif spec.kind == "delta":
         mb = lax.slice(
             slab, (spec.mb_off,), (spec.mb_off + 4 * spec.m_pad,)
@@ -546,6 +557,18 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
             spec.nexp,
         )
         vals = v32.astype(_JNP_BY_NAME[spec.vdtype])
+        lens = None
+    elif spec.kind == "deltaw":
+        mb = lax.slice(
+            slab, (spec.mb_off,), (spec.mb_off + 5 * spec.m_pad,)
+        ).reshape(5, spec.m_pad)
+        pgt = lax.slice(
+            slab, (spec.pg_off,), (spec.pg_off + 4 * spec.p_pad,)
+        ).reshape(4, spec.p_pad)
+        vals = bitops.delta_expand_paged_wide(
+            arena, mb[0], mb[1], mb[2], mb[3], mb[4],
+            pgt[0], pgt[1], pgt[2], pgt[3], spec.nexp,
+        ).astype(_JNP_BY_NAME[spec.vdtype])
         lens = None
     else:  # pragma: no cover - program construction guards this
         raise ValueError(f"unknown column kind {spec.kind!r}")
@@ -965,23 +988,43 @@ class _DevStage:
             # cheaper on device than the segmented searchsorted form
             val_off = val_offs[0]
             end = self.pages[0].off + self.pages[0].size
-            plan = parse_delta_plan(arena[val_off:end], _NP_DTYPE[pt])
+            wide_ok = np.dtype(_NP_DTYPE[pt]).itemsize > 4
+            plan = parse_delta_plan(
+                arena[val_off:end], _NP_DTYPE[pt], allow_wide=wide_ok
+            )
             if plan is None:
                 raise _ForceHost(self.name)
-            spec["kind"] = "delta1"
             m_pad = eng._hwm(("mb", self.name), len(plan["mb_bw"]), minimum=4)
-            mb = np.zeros((3, m_pad), dtype=np.int64)
             k = len(plan["mb_bitbase"])
-            mb[0, :k] = plan["mb_bitbase"] + val_off * 8
-            mb[1, :k] = plan["mb_bw"]
-            mb[2, :k] = plan["mb_min_delta"]
-            if mb[0].max(initial=0) >= 2**31:
+            bitbase = plan["mb_bitbase"] + val_off * 8
+            if bitbase.max(initial=0) >= 2**31:
                 raise _ForceHost(self.name)
+            if plan["wide"]:
+                # int64 reconstruction: 64-bit constants ride the int32
+                # slab as (low, high) word rows
+                spec["kind"] = "delta1w"
+                mb = np.zeros((4, m_pad), dtype=np.int64)
+                mb[0, :k] = bitbase
+                mb[1, :k] = plan["mb_bw"]
+                mb[2, :k] = plan["mb_min_delta"] & 0xFFFFFFFF
+                mb[3, :k] = plan["mb_min_delta"] >> 32
+                first = plan["first_value"]
+                # int64 array first: numpy wraps array casts to int32 but
+                # range-checks bare python ints
+                spec["sc_off"] = slabb.add(
+                    np.array([first & 0xFFFFFFFF, first >> 32], np.int64)
+                )
+            else:
+                spec["kind"] = "delta1"
+                mb = np.zeros((3, m_pad), dtype=np.int64)
+                mb[0, :k] = bitbase
+                mb[1, :k] = plan["mb_bw"]
+                mb[2, :k] = plan["mb_min_delta"]
+                spec["sc_off"] = slabb.add([plan["first_value"]])
             spec["mb_off"] = slabb.add(mb)
             spec["m_pad"] = m_pad
             spec["vpm"] = plan["values_per_miniblock"]
             spec["vdtype"] = _VDTYPE_NAME[pt]
-            spec["sc_off"] = slabb.add([plan["first_value"]])
         elif self.kind == "delta":
             mb_start: List[int] = []
             mb_bitbase: List[int] = []
@@ -991,14 +1034,19 @@ class _DevStage:
             pg_start: List[int] = []
             running = 0
             live_nns: List[int] = []
+            wide_ok = np.dtype(_NP_DTYPE[pt]).itemsize > 4
+            wide = False
             for p, val_off, nn in zip(self.pages, val_offs, nns):
                 if not nn:
                     # all-null page: no value section to parse
                     continue
                 end = p.off + p.size
-                plan = parse_delta_plan(arena[val_off:end], _NP_DTYPE[pt])
+                plan = parse_delta_plan(
+                    arena[val_off:end], _NP_DTYPE[pt], allow_wide=wide_ok
+                )
                 if plan is None or plan["total"] != nn:
                     raise _ForceHost(self.name)
+                wide = wide or plan["wide"]
                 vpm = plan["values_per_miniblock"]
                 pg_first.append(plan["first_value"])
                 pg_start.append(running)
@@ -1016,24 +1064,39 @@ class _DevStage:
             c_bw = np.concatenate(mb_bw) if mb_bw else np.zeros(0, np.int64)
             c_min = np.concatenate(mb_min) if mb_min else np.zeros(0, np.int64)
             m_pad = eng._hwm(("mb", self.name), max(len(c_bw), 1), minimum=4)
-            mb = np.zeros((4, m_pad), dtype=np.int64)
+            rows = 5 if wide else 4
+            mb = np.zeros((rows, m_pad), dtype=np.int64)
             mb[0] = 2**31 - 1  # out-start sentinel for pad miniblocks
             k = len(c_bw)
             if k:
                 mb[0, :k] = c_start
                 mb[1, :k] = c_bitbase
                 mb[2, :k] = c_bw
-                mb[3, :k] = c_min
+                if wide:
+                    mb[3, :k] = c_min & 0xFFFFFFFF
+                    mb[4, :k] = c_min >> 32
+                else:
+                    mb[3, :k] = c_min
             if mb[1].max(initial=0) >= 2**31:
                 raise _ForceHost(self.name)
             spec["mb_off"] = slabb.add(mb)
             spec["m_pad"] = m_pad
             p_pad = eng._hwm(("pages", self.name), len(self.pages), minimum=4)
-            pgt = np.zeros((3, p_pad), dtype=np.int64)
-            pgt[0, : len(pg_start)] = pg_start
-            pgt[1, : len(pg_first)] = pg_first
-            pgt[2] = total_nn
-            pgt[2, : len(live_nns)] = np.cumsum(live_nns)
+            firsts = np.asarray(pg_first, np.int64)
+            if wide:
+                spec["kind"] = "deltaw"
+                pgt = np.zeros((4, p_pad), dtype=np.int64)
+                pgt[0, : len(pg_start)] = pg_start
+                pgt[1, : len(pg_first)] = firsts & 0xFFFFFFFF
+                pgt[2, : len(pg_first)] = firsts >> 32
+                pgt[3] = total_nn
+                pgt[3, : len(live_nns)] = np.cumsum(live_nns)
+            else:
+                pgt = np.zeros((3, p_pad), dtype=np.int64)
+                pgt[0, : len(pg_start)] = pg_start
+                pgt[1, : len(pg_first)] = firsts
+                pgt[2] = total_nn
+                pgt[2, : len(live_nns)] = np.cumsum(live_nns)
             spec["pg_off"] = slabb.add(pgt)
             spec["p_pad"] = p_pad
             spec["vdtype"] = _VDTYPE_NAME[pt]
@@ -1207,24 +1270,35 @@ def _padded_rows(col: ByteArrayColumn, pad_len: Optional[int] = None,
     return out_rows, out_lens, max_len
 
 
-def parse_delta_plan(data_u8: np.ndarray, dtype) -> Optional[dict]:
+def _wrap64(v: int) -> int:
+    """Clamp a decoded zigzag varint to int64 wraparound semantics."""
+    return ((v + (1 << 63)) & ((1 << 64) - 1)) - (1 << 63)
+
+
+def parse_delta_plan(data_u8: np.ndarray, dtype, allow_wide=False) -> Optional[dict]:
     """Host parse of a DELTA_BINARY_PACKED stream into a device miniblock
-    plan.  Returns None (→ host fallback) when the stream needs >32-bit
-    arithmetic — including when any reachable *prefix sum* can leave int32
-    range, tracked by interval arithmetic over the miniblock bounds (for
-    int32 output, wraparound is the spec semantics, so no range check)."""
+    plan.  Returns None (→ host fallback) only for malformed streams.
+
+    The plan's ``"wide"`` flag selects the device arithmetic: False = the
+    int32 fast path (always exact for int32 output, where wraparound is
+    the spec semantics; for int64 output, proven exact by interval
+    arithmetic over every reachable *prefix sum*); True = full int64
+    reconstruction (miniblock widths ≤ 64, any first/min_delta).  Without
+    ``allow_wide`` the wide cases return None instead."""
     data = bytes(data_u8)
     pos = 0
     block_size, pos = e_rle._read_varint(data, pos)
     n_mini, pos = e_rle._read_varint(data, pos)
     total, pos = e_rle._read_varint(data, pos)
     first, pos = _read_zigzag(data, pos)
+    first = _wrap64(first)
     if n_mini == 0 or block_size % n_mini:
         return None
     per_mini = block_size // n_mini
     check_range = np.dtype(dtype).itemsize > 4
     i32 = (-(2**31), 2**31 - 1)
-    if not (-(2**31) <= first < 2**31):
+    wide = not (-(2**31) <= first < 2**31)
+    if wide and not allow_wide:
         return None
     lo = hi = first  # reachable value interval across all prefix sums
     mb_bitbase, mb_bw, mb_min = [], [], []
@@ -1232,18 +1306,25 @@ def parse_delta_plan(data_u8: np.ndarray, dtype) -> Optional[dict]:
     n_deltas = total - 1
     while got < n_deltas:
         min_delta, pos = _read_zigzag(data, pos)
+        min_delta = _wrap64(min_delta)
         if not (-(2**31) <= min_delta < 2**31):
-            return None
+            if not allow_wide:
+                return None
+            wide = True
         widths = data[pos : pos + n_mini]
         pos += n_mini
         for m in range(n_mini):
             if got >= n_deltas:
                 break
             bwm = widths[m]
+            if bwm > 64:
+                return None  # malformed: the spec caps deltas at 64 bits
             if bwm > 32:
-                return None
+                if not allow_wide:
+                    return None
+                wide = True
             count = min(per_mini, n_deltas - got)
-            if check_range:
+            if check_range and not wide:
                 # Every delta in this miniblock lies in [d_lo, d_hi]; the
                 # lowest reachable prefix adds count*d_lo when d_lo < 0
                 # (monotone dip), else never dips below the entry value —
@@ -1253,7 +1334,9 @@ def parse_delta_plan(data_u8: np.ndarray, dtype) -> Optional[dict]:
                 lo += count * d_lo if d_lo < 0 else 0
                 hi += count * d_hi if d_hi > 0 else 0
                 if lo < i32[0] or hi > i32[1]:
-                    return None
+                    if not allow_wide:
+                        return None
+                    wide = True
             mb_bitbase.append(pos * 8)
             mb_bw.append(bwm)
             mb_min.append(min_delta)
@@ -1267,6 +1350,7 @@ def parse_delta_plan(data_u8: np.ndarray, dtype) -> Optional[dict]:
         "values_per_miniblock": per_mini,
         "total": total,
         "end_pos": pos,
+        "wide": wide,
     }
 
 

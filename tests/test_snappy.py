@@ -203,3 +203,28 @@ def test_register_codec_roundtrip_and_guidance(tmp_path):
     with ParquetFileReader(path) as r:
         with pytest.raises(UnsupportedCodec, match="brotli"):
             r.read_row_group(0)
+
+
+def test_register_codec_override_wins_in_decompress_into():
+    """A register_codec override must be honored on the arena-fill hot
+    path (decompress_into), not just the bytes path."""
+    from parquet_floor_tpu.format import codecs as C
+    from parquet_floor_tpu.format.parquet_thrift import CompressionCodec
+
+    payload = b"abc" * 10
+    saved = dict(C._DECOMPRESSORS)
+    calls = []
+
+    def fake(data, n=None):
+        calls.append(len(data))
+        return payload
+
+    try:
+        C.register_codec(CompressionCodec.SNAPPY, decompressor=fake)
+        out = np.zeros(64, np.uint8)
+        C.decompress_into(CompressionCodec.SNAPPY, b"whatever", out, 4, len(payload))
+        assert calls, "override was bypassed"
+        assert out[4 : 4 + len(payload)].tobytes() == payload
+    finally:
+        C._DECOMPRESSORS.clear()
+        C._DECOMPRESSORS.update(saved)

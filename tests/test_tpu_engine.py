@@ -178,9 +178,10 @@ def test_float64_policies(tmp_path):
         t.close()
 
 
-def test_int64_delta_overflow_falls_back(tmp_path):
+def test_int64_delta_overflow_stays_exact(tmp_path):
     """Regression: INT64 delta columns whose running sum leaves int32 range
-    must take the host path, not silently wrap on device."""
+    must decode exactly (round 1: host fallback; now the wide device
+    reconstruction), never silently wrap in int32."""
     n = 300_000
     vals = (np.arange(n, dtype=np.int64) * 10_000)  # max 3e9 > int32
     cols = {"big": (types.INT64, vals, False, None)}
@@ -583,5 +584,110 @@ def test_pallas_gate_on_run_table_size(tmp_path, monkeypatch):
         (spec,) = sg.program
         assert spec.r_lvl > 2048
         assert spec.pl_lvl == (), "huge run table must not take Pallas"
+    finally:
+        t.close()
+
+
+def test_int64_delta_wide_single_page_device(tmp_path):
+    """VERDICT r1 item 6: wide-range INT64 delta columns decode ON DEVICE
+    (delta1w: int64 reconstruction, hi/lo split constants), bit-exact vs
+    host — including miniblock widths over 32 bits and a negative base."""
+    rng_l = np.random.default_rng(5)
+    n = 5000
+    # huge jumps force >32-bit miniblock widths; base far outside int32
+    vals = (
+        np.cumsum(rng_l.integers(-(2**40), 2**40, n)) - 2**55
+    ).astype(np.int64)
+    cols = {"big": (types.INT64, vals, False, None)}
+    path = _write(
+        tmp_path, cols, WriterOptions(enable_dictionary=False, delta_integers=True)
+    )
+    t = TpuRowGroupReader(path)
+    try:
+        sg = t._stage_row_group(0, None)
+        assert [s.kind for s in sg.program] == ["delta1w"], [
+            s.kind for s in sg.program
+        ]
+        got = np.asarray(t.read_row_group(0)["big"].values)
+        np.testing.assert_array_equal(got, vals)
+    finally:
+        t.close()
+    _check_against_host(path)
+
+
+def test_int64_delta_wide_multipage_optional_device(tmp_path):
+    """Wide delta across several pages with nulls: the segmented deltaw
+    kind (int64 page firsts as hi/lo rows) stays on device."""
+    rng_l = np.random.default_rng(6)
+    n = 4000
+    dense = (np.cumsum(rng_l.integers(-(2**38), 2**38, n))
+             + 2**52).astype(np.int64)
+    vals = [None if i % 11 == 0 else int(dense[i]) for i in range(n)]
+    cols = {"o": (types.INT64, vals, True, None)}
+    path = _write(
+        tmp_path, cols,
+        WriterOptions(enable_dictionary=False, delta_integers=True,
+                      data_page_values=512),
+    )
+    t = TpuRowGroupReader(path)
+    try:
+        sg = t._stage_row_group(0, None)
+        assert [s.kind for s in sg.program] == ["deltaw"], [
+            s.kind for s in sg.program
+        ]
+        dc = t.read_row_group(0)["o"]
+        mask = np.asarray(dc.mask)
+        got = np.asarray(dc.values)
+        exp_mask = np.array([v is None for v in vals])
+        np.testing.assert_array_equal(mask, exp_mask)
+        np.testing.assert_array_equal(
+            got[~mask], np.array([v for v in vals if v is not None])
+        )
+    finally:
+        t.close()
+    _check_against_host(path)
+
+
+def test_int64_delta_narrow_stays_fast(tmp_path):
+    """Counterpart: when interval arithmetic proves int32 exactness the
+    narrow kinds keep serving (no blanket widening)."""
+    vals = np.arange(10_000, dtype=np.int64) * 3 + 100
+    cols = {"x": (types.INT64, vals, False, None)}
+    path = _write(
+        tmp_path, cols, WriterOptions(enable_dictionary=False, delta_integers=True)
+    )
+    t = TpuRowGroupReader(path)
+    try:
+        sg = t._stage_row_group(0, None)
+        assert [s.kind for s in sg.program] == ["delta1"]
+        np.testing.assert_array_equal(
+            np.asarray(t.read_row_group(0)["x"].values), vals
+        )
+    finally:
+        t.close()
+
+
+def test_int64_delta_wide_pyarrow_interop(tmp_path):
+    """Nanosecond-scale timestamps from pyarrow's DELTA_BINARY_PACKED
+    writer (the classic wide-delta workload) decode on device."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng_l = np.random.default_rng(7)
+    n = 20_000
+    ts = (1_600_000_000_000_000_000
+          + np.cumsum(rng_l.integers(0, 10**12, n))).astype(np.int64)
+    path = str(tmp_path / "ts.parquet")
+    pq.write_table(
+        pa.table({"ts": ts}), path, use_dictionary=False,
+        column_encoding={"ts": "DELTA_BINARY_PACKED"},
+    )
+    t = TpuRowGroupReader(path)
+    try:
+        sg = t._stage_row_group(0, None)
+        assert sg.program[0].kind in ("delta1w", "deltaw"), sg.program[0].kind
+        np.testing.assert_array_equal(
+            np.asarray(t.read_row_group(0)["ts"].values), ts
+        )
     finally:
         t.close()

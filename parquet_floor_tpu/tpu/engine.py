@@ -1460,13 +1460,12 @@ class TpuRowGroupReader:
             sync_transfers = _os.environ.get("PFTPU_SYNC_TRANSFERS", "1") != "0"
         self.sync_transfers = sync_transfers
         # Pallas expansion for uniform-bit-width streams.  The lane-gather
-        # kernel formulation compiles under Mosaic for
-        # bit_width ≤ rle_kernel.LANE_KERNEL_MAX_BW (covers def/rep levels
-        # and small dictionaries) and runs ~1.3× the jnp expansion —
-        # default ON for those on a real TPU.  Wider streams stay on the
-        # jnp path (Mosaic cannot lower the bit-matrix regroup the wide
-        # kernel needs).  PFTPU_PALLAS=0 disables; PFTPU_PALLAS=1 forces
-        # it everywhere via interpret mode (tests).
+        # kernel formulation compiles under Mosaic for every
+        # ``rle_kernel.lane_compiled`` width (bw ≤ 24 and 32 — def/rep
+        # levels, dictionaries to 16M entries, and whole-word streams) —
+        # default ON for those on a real TPU.  The leftover 25–31 widths
+        # stay on the jnp path.  PFTPU_PALLAS=0 disables; PFTPU_PALLAS=1
+        # forces it everywhere via interpret mode (tests).
         pl_env = _os.environ.get("PFTPU_PALLAS", "")
         if pl_env == "1":
             self._pl_enabled = True
@@ -1692,7 +1691,7 @@ class TpuRowGroupReader:
         uniform-width stream, or () when gated off / not worthwhile."""
         if not self._pl_enabled or bw == 0 or bw > 32 or count < plk.TILE:
             return ()
-        if not self._pl_interp and bw > plk.LANE_KERNEL_MAX_BW:
+        if not self._pl_interp and not plk.lane_compiled(bw):
             # compiled Mosaic supports only the lane-gather kernel
             return ()
         if n_runs > plk.PL_MAX_RUNS or count > plk.PL_MAX_VALUES:

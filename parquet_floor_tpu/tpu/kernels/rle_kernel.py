@@ -37,21 +37,45 @@ from jax.experimental.pallas import tpu as pltpu
 # Output tile: (SUB, LANE) int32 = 2048 values per grid step.
 _SUB, _LANE = 16, 128
 TILE = _SUB * _LANE
-# Tile window of the lane-gather kernel: one 1024-aligned DMA covering the
-# whole tile's packed span.  The binding case is bit_width = 8: 1023
-# alignment residual + 2048 packed bytes = 3071 ≤ 3072 — an exact fit
-# (bit_width ≤ 7 needs only 1023 + 1792 + 113).
-_WIN = 3072
-# Widest bit width the lane-gather kernel handles: a 128-value row's span
-# must fit the post-roll 128-byte gather operand — ≤113 bytes for bw ≤ 7,
-# and exactly 128 for bw = 8, where fields are whole bytes so the clamped
-# high-byte gather contributes nothing.  The engine's Pallas gating and
-# the kernel dispatch below must agree on this.
-LANE_KERNEL_MAX_BW = 8
+# Widest bit width the lane-gather kernel compiles for.  Mosaic's native
+# lane gather reads one 128-lane chunk at a time; wider fields are served
+# by gathering from several static 128-byte chunks of the rolled window
+# and selecting by chunk index (see ``_lane_chunks``).  9 ≤ bw ≤ 24 needs
+# ≤ 3 chunks; bw = 32 is byte-aligned and needs 4; 25–31 would need a
+# 5-byte combine crossing the 32-bit word (rare: dictionaries > 16M
+# entries) and stay on the fallback expansion.  The engine's Pallas
+# gating and the kernel dispatch below must agree via ``lane_compiled``.
+LANE_KERNEL_MAX_BW = 24
 # Scalar-prefetch (SMEM, 1 MiB/program) budget the engine's gating must
 # respect: run plans are 5·PL_MAX_RUNS int32 and tile spans 2·count/TILE.
 PL_MAX_RUNS = 2048
 PL_MAX_VALUES = 1 << 24
+
+
+def lane_compiled(bit_width: int) -> bool:
+    """True when the Mosaic-compilable lane-gather kernel covers this
+    width (the engine's compiled-path gate)."""
+    return 1 <= bit_width <= LANE_KERNEL_MAX_BW or bit_width == 32
+
+
+def _lane_chunks(bit_width: int) -> int:
+    """128-byte gather chunks a row's packed span needs: the farthest byte
+    an element touches is ((7 + 127·bw) >> 3) + nbytes − 1 (sub-byte
+    residual only when bw ∤ 8)."""
+    if bit_width % 8 == 0:
+        far = (127 * bit_width) // 8 + bit_width // 8 - 1
+    else:
+        far = (7 + 127 * bit_width) >> 3
+        far += (bit_width + 14) // 8 - 1
+    return far // 128 + 1
+
+
+def _lane_win(bit_width: int) -> int:
+    """Lane-kernel DMA window: 1024-aligned start residual + the last
+    row's packed offset + its gather chunks, rounded to a 1024-multiple
+    (DMA sizes must be 1024-multiples)."""
+    need = 1023 + (_SUB - 1) * _LANE * bit_width // 8 + 128 * _lane_chunks(bit_width)
+    return -(-need // 1024) * 1024
 
 
 def _tile_window_bytes(bit_width: int) -> int:
@@ -194,11 +218,12 @@ def rle_expand_pallas(
 
 
 # Slack the arena must carry for the inline (no-copy) variant: a run
-# starting mid-tile makes the DMA window begin up to TILE*bw/8 bytes before
-# the run's packed base (lead), and the last window reads W bytes past the
-# stream end (tail).  Sized for the max bit width (32).
-ARENA_LEAD = TILE * 32 // 8 + 16    # 8208
-ARENA_TAIL = _tile_window_bytes(32) + 32  # 8240
+# starting mid-tile makes the DMA window begin up to (TILE−1)·bw/8 bytes
+# before the run's packed base, and the lane kernel's 1024-alignment can
+# pull it back up to 1023 more (lead); a window that starts at the stream
+# end still reads its full span past it (tail).  Sized for bit width 32.
+ARENA_LEAD = TILE * 32 // 8 + 1024 + 16   # 9232
+ARENA_TAIL = max(_tile_window_bytes(32) + 32, _lane_win(32) + 32)  # 9248
 
 
 def _rle_expand_kernel_lane(
@@ -210,20 +235,24 @@ def _rle_expand_kernel_lane(
     # outputs
     out_ref,            # int32[SUB, LANE]
     # scratch
-    win_ref,            # uint8[_WIN] one aligned tile-span window
+    win_ref,            # uint8[_lane_win(bw)] one aligned tile-span window
     sem,                # DMA semaphore
     *, bit_width: int,
 ):
-    """Mosaic-compilable variant for bit_width ≤ LANE_KERNEL_MAX_BW.
+    """Mosaic-compilable variant for ``lane_compiled`` bit widths.
 
-    One 1024-aligned ``_WIN``-byte DMA per packed run loads the whole
-    tile's span into a 1-D scratch; 16 per-row uniform rolls align each
-    row's window start to lane 0 (row offsets are exactly linear — a
+    One 1024-aligned ``_lane_win(bw)``-byte DMA per packed run loads the
+    whole tile's span into a 1-D scratch; 16 per-row uniform rolls align
+    each row's window start to lane 0 (row offsets are exactly linear — a
     128-value row advances 16·bw whole bytes); each element's field then
-    comes from a *lane-wise* two-byte gather (``take_along_axis`` along
-    lanes — one of the two gather forms Mosaic lowers natively) plus
-    shift/mask.  No irregular reshapes, no byte-granular dynamic slices,
-    no strided rolls: every vector op is (16, 128)/(16, _WIN) int32.
+    comes from *lane-wise* byte gathers (``take_along_axis`` along lanes —
+    one of the two gather forms Mosaic lowers natively) plus shift/mask.
+    A row at bw > 8 spans more than 128 bytes, so each of the field's
+    ceil bytes is gathered from every static 128-byte chunk of the rolled
+    window and selected by chunk index — all chunk/byte loops unroll at
+    trace time (bit_width is static).  No irregular reshapes, no
+    byte-granular dynamic slices, no strided rolls: every vector op is
+    (16, 128)/(16, WIN) int32.
     """
     t = pl.program_id(0)
     tile_start = t * TILE
@@ -233,6 +262,13 @@ def _rle_expand_kernel_lane(
     row_i = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 0)
     lane_i = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANE), 1)
     gidx = tile_start + row_i * _LANE + lane_i
+
+    win = _lane_win(bit_width)
+    n_chunks = _lane_chunks(bit_width)
+    aligned_fields = bit_width % 8 == 0
+    # bytes each field's bits can touch (sub-byte residual only when the
+    # field is not byte-aligned)
+    nbytes = bit_width // 8 if aligned_fields else (bit_width + 14) // 8
 
     def body(r, acc):
         zero = jnp.int32(0)
@@ -251,48 +287,67 @@ def _rle_expand_kernel_lane(
         def packed_branch(acc_in):
             # ONE aligned DMA covers the whole tile's packed span: HBM
             # uint8 slice offsets must be provably 1024-divisible and
-            # sizes 1024-multiples, and the tile needs ≤ 1023 (residual)
-            # + 1792 (2048·7 bits) + 113 ≤ 3072 bytes.
+            # sizes 1024-multiples (``_lane_win`` sizes the window so the
+            # residual + last row's span + its gather chunks all fit).
             byte_off0 = (run_byte_ref[r] + (bit0 >> 3)).astype(jnp.int32)
             aligned = pl.multiple_of(byte_off0 & ~jnp.int32(1023), 1024)
             copy = pltpu.make_async_copy(
-                data_hbm.at[pl.ds(aligned, _WIN)],
+                data_hbm.at[pl.ds(aligned, win)],
                 win_ref,
                 sem,
             )
             copy.start()
             copy.wait()
-            w1 = win_ref[:].reshape(1, _WIN).astype(jnp.int32)
+            w1 = win_ref[:].reshape(1, win).astype(jnp.int32)
             # Row r's window begins δ_r = δ_0 + r·16·bw bytes into the
             # buffer (exactly linear: 128·bw bits is a whole byte count).
             # One uniform roll per row left-rotates by δ_r; amounts are
-            # kept positive in (0, _WIN] because compiled Mosaic treats
+            # kept positive in (0, WIN] because compiled Mosaic treats
             # dynamic shifts as unsigned mod 2³² (negative breaks), and
             # its *strided* roll cannot cross vreg boundaries at all.
             delta0 = byte_off0 - aligned
             row_step = _LANE * bit_width // 8              # 16·bw
             rolled = jnp.concatenate(
                 [
-                    pltpu.roll(w1, _WIN - (delta0 + rr * row_step), axis=1)
+                    pltpu.roll(w1, win - (delta0 + rr * row_step), axis=1)
                     for rr in range(_SUB)
                 ],
                 axis=0,
             )
-            w128 = jax.lax.slice(rolled, (0, 0), (_SUB, _LANE))
+            chunks = [
+                jax.lax.slice(rolled, (0, _LANE * c), (_SUB, _LANE * (c + 1)))
+                for c in range(n_chunks)
+            ]
             # local bit position: row windows start byte-exact, so only
             # bit0's sub-byte residual (same every row) and the lane remain
             lam = (bit0 & 7) + lane_i * bit_width          # ≤ 7 + 127·bw
             b0 = lam >> 3
-            lo8 = jnp.take_along_axis(w128, b0, axis=1, mode="promise_in_bounds")
-            if bit_width == 8:
-                # fields are whole bytes (bit0 ≡ 0 mod 8): lo8 IS the value,
-                # and b0+1 would read lane 128 at the last element
-                vals = lo8
+            word = jnp.zeros((_SUB, _LANE), jnp.int32)
+            for j in range(nbytes):
+                p = b0 + jnp.int32(j)
+                if n_chunks == 1:
+                    # bw = 8's last element has b0 = 127 and nbytes = 1;
+                    # bw ≤ 7's p ≤ 113+1 — both in bounds unclamped
+                    bj = jnp.take_along_axis(
+                        chunks[0], p, axis=1, mode="promise_in_bounds"
+                    )
+                else:
+                    bj = jnp.zeros((_SUB, _LANE), jnp.int32)
+                    for c in range(n_chunks):
+                        q = jnp.clip(p - _LANE * c, 0, _LANE - 1)
+                        g = jnp.take_along_axis(
+                            chunks[c], q, axis=1, mode="promise_in_bounds"
+                        )
+                        bj = jnp.where((p >> 7) == c, g, bj)
+                word = word | (bj << (8 * j))
+            if bit_width == 32:
+                vals = word   # the int32 bit pattern IS the value
+            elif aligned_fields:
+                vals = word & ((1 << bit_width) - 1)       # residual is 0
             else:
-                hi8 = jnp.take_along_axis(
-                    w128, b0 + 1, axis=1, mode="promise_in_bounds"
-                )
-                vals = ((lo8 | (hi8 << 8)) >> (lam & 7)) & ((1 << bit_width) - 1)
+                # arithmetic >> is safe: sign-filled bits live at positions
+                # ≥ 32−sh ≥ 25, above the ≤ 24-bit mask
+                vals = (word >> (lam & 7)) & ((1 << bit_width) - 1)
             return jnp.where(in_run, vals, acc_in)
 
         return jax.lax.cond(kind == 1, packed_branch, lambda a: rle_fill, acc)
@@ -325,10 +380,10 @@ def rle_expand_pallas_inline(
         return jnp.zeros(num_values, dtype=jnp.int32)
     n_tiles = pl.cdiv(num_values, TILE)
     run_byte = (run_bitbase // 8).astype(jnp.int32)
-    if bit_width <= LANE_KERNEL_MAX_BW:
+    if lane_compiled(bit_width):
         # lane-gather formulation: the only one Mosaic compiles today
         kernel = functools.partial(_rle_expand_kernel_lane, bit_width=bit_width)
-        scratch = pltpu.VMEM((_WIN,), jnp.uint8)
+        scratch = pltpu.VMEM((_lane_win(bit_width),), jnp.uint8)
     else:
         kernel = functools.partial(_rle_expand_kernel, bit_width=bit_width)
         scratch = pltpu.VMEM((1, _tile_window_bytes(bit_width)), jnp.uint8)

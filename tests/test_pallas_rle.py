@@ -57,14 +57,19 @@ def _expand_both(buf, plan, n, bw):
     return np.asarray(got), np.asarray(want)
 
 
-@pytest.mark.parametrize("bw", [1, 2, 3, 5, 8, 12, 17])
+@pytest.mark.parametrize(
+    "bw", [1, 2, 3, 5, 8, 9, 12, 15, 16, 17, 20, 23, 24, 27, 32]
+)
 def test_mixed_runs_match_reference(bw):
     rng = np.random.default_rng(bw)
     n = 3 * TILE + 517  # several tiles + ragged tail
-    vals = rng.integers(0, 1 << min(bw, 16), n).astype(np.uint32)
+    # full-range values so every byte of wide fields is exercised
+    vals = (
+        rng.integers(0, 1 << 32, n, dtype=np.uint64) & ((1 << bw) - 1)
+    ).astype(np.uint32)
     # carve long constant stretches so the stream mixes RLE and packed runs
     vals[100:2200] = 3
-    vals[TILE : TILE + 900] = (1 << bw) - 1 if bw < 16 else 5
+    vals[TILE : TILE + 900] = np.uint32((1 << bw) - 1)
     buf, plan = _roundtrip_case(vals, bw)
     got, want = _expand_both(buf, plan, n, bw)
     np.testing.assert_array_equal(got, want)

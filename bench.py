@@ -51,9 +51,11 @@ def main():
             return rows
 
     cpu_decode()  # warm page cache
-    t0 = time.perf_counter()
-    rows = cpu_decode()
-    cpu_dt = time.perf_counter() - t0
+    cpu_dt = float("inf")
+    for _ in range(2):  # best-of: the shared host's CPU clock is noisy
+        t0 = time.perf_counter()
+        rows = cpu_decode()
+        cpu_dt = min(cpu_dt, time.perf_counter() - t0)
     cpu_rps = rows / cpu_dt
 
     # --- TPU engine --------------------------------------------------------

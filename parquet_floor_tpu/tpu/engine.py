@@ -34,6 +34,7 @@ same arena* (no extra transfers).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from functools import partial
@@ -243,24 +244,60 @@ class _ArenaBuilder:
         self.jobs.append(("c", data, off, size))
         return off
 
-    def fill(self, arena: np.ndarray, pool: Optional[ThreadPoolExecutor] = None) -> None:
-        def run(job):
-            if job[0] == "d":
-                _, codec, payload, off, size = job
-                codecs.decompress_into(codec, payload, arena, off, size)
-            else:
-                _, data, off, size = job
-                if size:
-                    arena[off : off + size] = np.frombuffer(
-                        data, dtype=np.uint8, count=size
-                    )
+    @staticmethod
+    def _run_job(arena: np.ndarray, job: tuple) -> None:
+        if job[0] == "d":
+            _, codec, payload, off, size = job
+            codecs.decompress_into(codec, payload, arena, off, size)
+        else:
+            _, data, off, size = job
+            if size:
+                arena[off : off + size] = np.frombuffer(
+                    data, dtype=np.uint8, count=size
+                )
 
+    def fill(self, arena: np.ndarray, pool: Optional[ThreadPoolExecutor] = None) -> None:
         if pool is not None and len(self.jobs) > 1:
             # jobs write disjoint arena regions; native codecs release the GIL
-            list(pool.map(run, self.jobs))
+            list(pool.map(lambda j: self._run_job(arena, j), self.jobs))
         else:
             for job in self.jobs:
-                run(job)
+                self._run_job(arena, job)
+
+    def fill_chunks(self, arena: np.ndarray, chunk: int,
+                    pool: Optional[ThreadPoolExecutor] = None):
+        """Fill like :meth:`fill` but yield ``(start, end)`` byte ranges
+        as fixed-size chunks of the arena become final, so the caller can
+        overlap the device transfer of chunk c with the fill of c+1.
+        Jobs are stored in ascending offset order (``reserve`` is
+        monotonic), so chunk ``[k·chunk, (k+1)·chunk)`` is final once
+        every job starting before its end has run; each chunk's job batch
+        runs through ``pool`` (same parallelism as :meth:`fill`)."""
+        cap = len(arena)
+        done = 0          # start of the first unshipped chunk
+        batch: List[tuple] = []
+
+        def flush():
+            if pool is not None and len(batch) > 1:
+                list(pool.map(lambda j: self._run_job(arena, j), batch))
+            else:
+                for j in batch:
+                    self._run_job(arena, j)
+            batch.clear()
+
+        for job in self.jobs:
+            start = job[3] if job[0] == "d" else job[2]
+            if start >= done + chunk and done + chunk <= cap:
+                flush()
+                while start >= done + chunk and done + chunk <= cap:
+                    yield done, done + chunk
+                    done += chunk
+            batch.append(job)
+        flush()
+        while done < cap:
+            end = min(done + chunk, cap)
+            yield done, end
+            done = end
 
 
 class _I32Builder:
@@ -336,6 +373,11 @@ class _ColSpec(NamedTuple):
     vpm: int = 0
 
 
+# Fixed arena-transfer chunk: big enough that per-put overhead is noise,
+# small enough that the first DMA starts while most of the fill remains.
+_SHIP_CHUNK = 4 << 20
+
+
 @dataclass
 class _StagedGroup:
     """Host-staged row group: ship arena+slab, then run the fused program."""
@@ -347,6 +389,7 @@ class _StagedGroup:
     extra_keys: List[tuple]            # cache keys, in extras order
     new_extras: List[tuple]            # (key, rows_host, lens_host) to ship
     num_rows: int
+    parts: Optional[tuple] = None      # arena chunks already on device
 
 
 # ---------------------------------------------------------------------------
@@ -586,9 +629,16 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
     return vals, None, lens, None, None
 
 
-@partial(jax.jit, static_argnums=(0,))
-def _decode_fused(program: tuple, arena, slab, *extras):
-    """One compiled decode step for a whole row group."""
+@partial(jax.jit, static_argnums=(0, 1))
+def _decode_fused(program: tuple, n_parts: int, *arrays):
+    """One compiled decode step for a whole row group.
+
+    ``arrays`` is ``n_parts`` arena chunks (shipped piecewise so the
+    transfer overlaps the host fill), then the slab, then the extras;
+    the chunks are glued back into one arena on device (a single HBM
+    copy — negligible next to the host→device transfer it overlaps)."""
+    parts, slab, extras = arrays[:n_parts], arrays[n_parts], arrays[n_parts + 1:]
+    arena = parts[0] if n_parts == 1 else jnp.concatenate(parts)
     return tuple(_decode_col(spec, arena, slab, extras) for spec in program)
 
 
@@ -1459,6 +1509,15 @@ class TpuRowGroupReader:
         if sync_transfers is None:
             sync_transfers = _os.environ.get("PFTPU_SYNC_TRANSFERS", "1") != "0"
         self.sync_transfers = sync_transfers
+        # Chunked arena shipping: overlap fill (host CPU) with transfer
+        # (DMA) inside a single row group — the only overlap available to
+        # single-group files, where cross-group pipelining has nothing to
+        # hide behind.  PFTPU_CHUNKED_SHIP=0/1 overrides the TPU default.
+        ch_env = _os.environ.get("PFTPU_CHUNKED_SHIP", "")
+        if ch_env in ("0", "1"):
+            self._chunked_ship = ch_env == "1"
+        else:
+            self._chunked_ship = _platform_is_tpu()
         # Pallas expansion for uniform-bit-width streams.  The lane-gather
         # kernel formulation compiles under Mosaic for every
         # ``rle_kernel.lane_compiled`` width (bw ≤ 24 and 32 — def/rep
@@ -1616,8 +1675,12 @@ class TpuRowGroupReader:
 
     def iter_row_groups(self, columns: Optional[Sequence[str]] = None,
                         prefetch: bool = True, predicate=None):
-        """Decode every row group, overlapping host staging of group i+1
-        with device transfer/compute of group i.
+        """Decode every row group, pipelining the three stages: host
+        staging (read + decompress + plan) of group i+1 AND its device
+        transfer both run in the background while the device computes the
+        fused decode of group i and the caller consumes it.  One transfer
+        is in flight at a time (``sync_transfers`` semantics preserved —
+        the background task stages, then ships, sequentially).
 
         ``predicate`` (see ``batch.predicate.col``) skips row groups whose
         footer statistics prove no row can match — before any page is
@@ -1630,28 +1693,60 @@ class TpuRowGroupReader:
             for i in indices:
                 yield self.read_row_group(i, columns)
             return
+
+        def ship_task(stage_fut):
+            sg = stage_fut.result()
+            return sg, self._ship(sg)
+
+        # Two dedicated single-worker pools make a true 3-stage pipeline:
+        # the stage worker runs up to DEPTH groups ahead (bounded: each
+        # staged group pins a host arena), the ship worker transfers each
+        # group as soon as it is staged AND the previous transfer is done
+        # (one in flight — sync_transfers semantics), and the main thread
+        # dispatches the fused decode while the consumer materializes.
+        # Steady-state throughput → max(stage, ship, decode+consume)
+        # instead of their sum.  Each level of depth pins one more host
+        # arena (and its shipped device copy): PFTPU_PREFETCH_DEPTH=1
+        # restores the old single-group lookahead if memory is tight.
+        import os as _os
+
+        DEPTH = max(1, int(_os.environ.get("PFTPU_PREFETCH_DEPTH", "2")))
+        n = len(indices)
         with ThreadPoolExecutor(max_workers=1,
-                                thread_name_prefix="pftpu-stage") as ex:
-            fut = ex.submit(self._stage_row_group, indices[0], columns)
-            for k, i in enumerate(indices):
-                sg = fut.result()
-                if k + 1 < len(indices):
-                    fut = ex.submit(
-                        self._stage_row_group, indices[k + 1], columns
+                                thread_name_prefix="pftpu-stage") as sp, \
+                ThreadPoolExecutor(max_workers=1,
+                                   thread_name_prefix="pftpu-ship") as shp:
+            # chunked=False: intra-group chunked shipping would issue
+            # transfers from the stage worker concurrently with the ship
+            # worker's — two streams contend on tunnelled links; the
+            # cross-group pipeline already provides the overlap here
+            ship_q = deque()
+            for j in range(min(DEPTH, n)):
+                f = sp.submit(self._stage_row_group, indices[j], columns,
+                              chunked=False)
+                ship_q.append(shp.submit(ship_task, f))
+            for k in range(n):
+                if DEPTH + k < n:
+                    f = sp.submit(
+                        self._stage_row_group, indices[DEPTH + k], columns,
+                        chunked=False,
                     )
-                yield self._launch(sg)
+                    ship_q.append(shp.submit(ship_task, f))
+                sg, shipped = ship_q.popleft().result()
+                yield self._decode_shipped(sg, shipped)
 
     # -- staging ------------------------------------------------------------
 
     def _stage_row_group(self, index: int, columns, covered=None,
-                         group_rows: int = 0) -> _StagedGroup:
+                         group_rows: int = 0, chunked=None) -> _StagedGroup:
         with trace.span("stage"):
             return self._stage_row_group_untraced(
-                index, columns, covered, group_rows
+                index, columns, covered, group_rows, chunked=chunked
             )
 
     def _stage_row_group_untraced(self, index: int, columns, covered=None,
-                                  group_rows: int = 0) -> _StagedGroup:
+                                  group_rows: int = 0, chunked=None
+                                  ) -> _StagedGroup:
         rg = self.reader.row_groups[index]
         want = set(columns) if columns else None
         work = []
@@ -1669,7 +1764,7 @@ class TpuRowGroupReader:
             try:
                 return self._try_stage(
                     rg, work, self._forced, self._all_host,
-                    covered=covered, group_rows=group_rows,
+                    covered=covered, group_rows=group_rows, chunked=chunked,
                 )
             except _ForceHost as e:
                 # sticky per file: a column that needed the host path once
@@ -1707,7 +1802,7 @@ class TpuRowGroupReader:
         return (bw, span_off, len(tl), self._pl_interp)
 
     def _try_stage(self, rg, work, forced, all_host=False, covered=None,
-                   group_rows: int = 0) -> _StagedGroup:
+                   group_rows: int = 0, chunked=None) -> _StagedGroup:
         arena_b = _ArenaBuilder(plk.ARENA_LEAD if self._pl_enabled else 0)
         stages = []
         for name, chunk, desc in work:
@@ -1749,7 +1844,32 @@ class TpuRowGroupReader:
         tail = plk.ARENA_TAIL if self._pl_enabled else 8
         cap = self._hwm(("arena",), arena_b.size + tail, minimum=1 << 16)
         arena = np.zeros(cap, dtype=np.uint8)
-        arena_b.fill(arena, self._fill_pool)
+        parts = None
+        if chunked is None:
+            chunked = self._chunked_ship
+        if chunked and cap > _SHIP_CHUNK:
+            # pipeline the arena fill with its own transfer: each fixed
+            # chunk is device_put (async) the moment its fill jobs are
+            # done, so decompress/copy of chunk c+1 overlaps the DMA of
+            # chunk c.  Chunk boundaries depend only on the bucketed cap,
+            # keeping the fused-program shape cache warm.
+            with trace.span("ship", cap):
+                plist = []
+                for s, e in arena_b.fill_chunks(
+                    arena, _SHIP_CHUNK, self._fill_pool
+                ):
+                    if plist and self.sync_transfers:
+                        # sliding window of ONE outstanding transfer: the
+                        # fill of this chunk already overlapped the DMA of
+                        # the previous one, and a deeper async queue
+                        # trips the tunnel's burst throttle
+                        jax.block_until_ready(plist[-1])
+                    plist.append(jax.device_put(arena[s:e], self.device))
+                if self.sync_transfers:
+                    jax.block_until_ready(plist)
+                parts = tuple(plist)
+        else:
+            arena_b.fill(arena, self._fill_pool)
         slabb = _I32Builder()
         raw_specs = [st.finish(arena, slabb, self) for st in stages]
         # assign extras (string dictionaries) in order of first use
@@ -1780,36 +1900,60 @@ class TpuRowGroupReader:
                 if covered is not None
                 else rg.num_rows or 0
             ),
+            parts=parts,
         )
 
     # -- launch -------------------------------------------------------------
 
-    def _launch(self, sg: _StagedGroup) -> Dict[str, DeviceColumn]:
-        ship = [sg.arena, sg.slab]
-        for _, rows, lens in sg.new_extras:
+    def _ship(self, sg: _StagedGroup) -> list:
+        """Transfer a staged group's arrays to the device (one transfer
+        in flight at a time when ``sync_transfers``).  Arena chunks
+        already shipped during staging (``sg.parts``) are not re-sent."""
+        # several prefetched groups can stage the same dictionary before
+        # the first of them ships it — re-check at ship time (ships are
+        # serialized) so it crosses the link once
+        with self._lock:
+            extras = [e for e in sg.new_extras if e[0] not in self._sdict_dev]
+        ship = [] if sg.parts is not None else [sg.arena]
+        ship.append(sg.slab)
+        for _, rows, lens in extras:
             ship.append(rows)
             ship.append(lens)
         with trace.span("ship", sum(int(a.nbytes) for a in ship)):
             shipped = jax.device_put(ship, self.device)
             if self.sync_transfers:
                 jax.block_until_ready(shipped)
-        arena_dev, slab_dev = shipped[0], shipped[1]
+        if sg.parts is not None:
+            shipped = [sg.parts, *shipped]
         pos = 2
-        for key, _, _ in sg.new_extras:
+        for key, _, _ in extras:
             with self._lock:
                 self._sdict_dev[key] = (shipped[pos], shipped[pos + 1])
                 self._sdict_host.pop(key, None)  # device copy is authoritative
             pos += 2
+        return shipped
+
+    def _decode_shipped(self, sg: _StagedGroup, shipped: list
+                        ) -> Dict[str, DeviceColumn]:
+        """Dispatch the fused decode over already-shipped device buffers
+        (asynchronous: returned arrays are futures until materialized)."""
+        first, slab_dev = shipped[0], shipped[1]
+        parts = first if isinstance(first, tuple) else (first,)
         extra_args = []
         for key in sg.extra_keys:
             rows_d, lens_d = self._sdict_dev[key]
             extra_args.append(rows_d)
             extra_args.append(lens_d)
         with trace.span("decode"):
-            outs = _decode_fused(sg.program, arena_dev, slab_dev, *extra_args)
+            outs = _decode_fused(
+                sg.program, len(parts), *parts, slab_dev, *extra_args
+            )
         result: Dict[str, DeviceColumn] = {}
         for spec, desc, (vals, mask, lens, defs, reps) in zip(
             sg.program, sg.descs, outs
         ):
             result[spec.name] = DeviceColumn(desc, vals, mask, lens, defs, reps)
         return result
+
+    def _launch(self, sg: _StagedGroup) -> Dict[str, DeviceColumn]:
+        return self._decode_shipped(sg, self._ship(sg))

@@ -691,3 +691,49 @@ def test_int64_delta_wide_pyarrow_interop(tmp_path):
         )
     finally:
         t.close()
+
+
+def test_chunked_ship_matches_host(tmp_path, monkeypatch):
+    """Intra-group chunked arena shipping (fill↔transfer overlap) must be
+    bit-identical to the bulk path: force a tiny chunk so a multi-column
+    mixed group crosses many chunk boundaries mid-stream."""
+    import parquet_floor_tpu.tpu.engine as eng
+
+    monkeypatch.setenv("PFTPU_CHUNKED_SHIP", "1")
+    monkeypatch.setattr(eng, "_SHIP_CHUNK", 1 << 14)  # 16 KiB chunks
+    n = 20_000
+    svals = np.array(
+        [f"name_{i % 700:04d}".encode() for i in range(n)], dtype=object
+    )
+    cols = {
+        "a": (types.INT64, rng.integers(-(2**55), 2**55, n), False, None),
+        "b": (types.DOUBLE, rng.normal(size=n), True, None),
+        "s": (types.BYTE_ARRAY, svals, False, types.string()),
+    }
+    path = _write(tmp_path, cols, WriterOptions(data_page_values=4096), n=n)
+    _check_against_host(path)
+
+
+def test_fill_chunks_covers_every_job(tmp_path):
+    """fill_chunks yields each fixed chunk exactly once, in order, only
+    after every job overlapping it ran; the filled arena equals fill()."""
+    import parquet_floor_tpu.tpu.engine as eng
+
+    b = eng._ArenaBuilder(lead=100)
+    payloads = []
+    r = np.random.default_rng(3)
+    for sz in (5000, 1, 70000, 123, 4096, 999):
+        data = r.integers(0, 256, sz).astype(np.uint8).tobytes()
+        payloads.append(data)
+        b.add_copy(data, sz)
+    cap = b.size + 64
+    a1 = np.zeros(cap, np.uint8)
+    b.fill(a1)
+    a2 = np.zeros(cap, np.uint8)
+    spans = list(b.fill_chunks(a2, 4096))
+    np.testing.assert_array_equal(a1, a2)
+    # spans tile [0, cap) exactly
+    assert spans[0][0] == 0 and spans[-1][1] == cap
+    for (s0, e0), (s1, e1) in zip(spans, spans[1:]):
+        assert e0 == s1
+    assert all(e - s == 4096 for s, e in spans[:-1])

@@ -58,7 +58,7 @@ def make_mesh(
 # The sharded decode step
 # ---------------------------------------------------------------------------
 
-def _expand_slice(buf, out_end, kind, value, bitbase, out_offset, per, bw):
+def _expand_slice(buf, out_end, kind, value, bytebase, out_offset, per, bw):
     """Expand ``per`` outputs of a run table starting at ``out_offset``
     (the sequence-parallel unit: any output slice computes independently)."""
     out_idx = jax.lax.broadcasted_iota(jnp.int32, (per, 1), 0).reshape(per) + out_offset
@@ -66,8 +66,9 @@ def _expand_slice(buf, out_end, kind, value, bitbase, out_offset, per, bw):
     rid = jnp.minimum(rid, out_end.shape[0] - 1)
     run_start = jnp.where(rid == 0, 0, out_end[jnp.maximum(rid - 1, 0)])
     within = out_idx - run_start
-    bitpos = bitbase[rid] + within * bw
-    packed = bitops.extract_bits(buf, bitpos, bw).astype(jnp.int32)
+    packed = bitops.extract_bits_at(
+        buf, bytebase[rid], within * bw, bw
+    ).astype(jnp.int32)
     return jnp.where(kind[rid] == 0, value[rid], packed)
 
 

@@ -21,16 +21,10 @@ import jax
 import jax.numpy as jnp
 
 
-def extract_bits(data_u8: jax.Array, bitpos: jax.Array, bit_width: int) -> jax.Array:
-    """Gather ``bit_width``-bit little-endian fields at arbitrary bit offsets.
-
-    ``data_u8`` must be padded with ≥8 trailing bytes so the 5-byte window
-    never reads out of bounds.  Supports bit_width 1..32; returns uint32.
-    """
-    if not (1 <= bit_width <= 32):
-        raise ValueError(f"bit_width {bit_width} out of range [1, 32]")
-    byte0 = (bitpos >> 3).astype(jnp.int32)
-    shift = (bitpos & 7).astype(jnp.uint32)
+def _extract_window(data_u8: jax.Array, byte0: jax.Array, shift: jax.Array,
+                    bit_width: int) -> jax.Array:
+    """5-byte little-endian window at ``byte0`` shifted right by ``shift``
+    (0..7), masked to ``bit_width`` bits.  Returns uint32."""
     # gather uint8 first, widen after: widening the whole buffer before the
     # gather would materialize a 4× copy of it in HBM (gather operands do
     # not fuse), which matters when data_u8 is a row-group arena
@@ -42,6 +36,34 @@ def extract_bits(data_u8: jax.Array, bitpos: jax.Array, bit_width: int) -> jax.A
     v = (lo >> shift) | hi_part
     mask = jnp.uint32(0xFFFFFFFF) if bit_width == 32 else jnp.uint32((1 << bit_width) - 1)
     return v & mask
+
+
+def extract_bits(data_u8: jax.Array, bitpos: jax.Array, bit_width: int) -> jax.Array:
+    """Gather ``bit_width``-bit little-endian fields at arbitrary bit offsets.
+
+    ``data_u8`` must be padded with ≥8 trailing bytes so the 5-byte window
+    never reads out of bounds.  Supports bit_width 1..32; returns uint32.
+    """
+    if not (1 <= bit_width <= 32):
+        raise ValueError(f"bit_width {bit_width} out of range [1, 32]")
+    byte0 = (bitpos >> 3).astype(jnp.int32)
+    shift = (bitpos & 7).astype(jnp.uint32)
+    return _extract_window(data_u8, byte0, shift, bit_width)
+
+
+def extract_bits_at(data_u8: jax.Array, bytebase: jax.Array, bitoff: jax.Array,
+                    bit_width: int) -> jax.Array:
+    """:func:`extract_bits` addressed as byte base + *local* bit offset.
+
+    Splitting the address keeps every quantity inside int32 for buffers up
+    to 2 GiB: ``bytebase`` is a byte offset (streams start byte-aligned in
+    every Parquet encoding) and ``bitoff`` is the within-stream bit
+    position, which never approaches 2³¹."""
+    if not (1 <= bit_width <= 32):
+        raise ValueError(f"bit_width {bit_width} out of range [1, 32]")
+    byte0 = (bytebase + (bitoff >> 3)).astype(jnp.int32)
+    shift = (bitoff & 7).astype(jnp.uint32)
+    return _extract_window(data_u8, byte0, shift, bit_width)
 
 
 def bit_unpack(data_u8: jax.Array, bit_width: int, count: int) -> jax.Array:
@@ -67,7 +89,9 @@ def rle_expand(
     run_out_end: jax.Array,   # int32[R]: cumulative output count after run r
     run_kind: jax.Array,      # int32[R]: 0 = RLE, 1 = bit-packed
     run_value: jax.Array,     # int32[R]: repeated value (RLE runs)
-    run_bitbase: jax.Array,   # int32[R]: absolute bit offset of packed data
+    run_bytebase: jax.Array,  # int32[R]: byte offset of packed data (runs
+                              # start byte-aligned per the RLE spec, so a
+                              # byte base reaches 2 GiB arenas in int32)
     num_values: int,
     bit_width: int,
 ) -> jax.Array:
@@ -85,8 +109,9 @@ def rle_expand(
     within = out_idx - run_start
     if bit_width == 0:
         return jnp.zeros(num_values, dtype=jnp.int32)
-    bitpos = run_bitbase[rid] + within * bit_width
-    packed = extract_bits(data_u8, bitpos, bit_width).astype(jnp.int32)
+    packed = extract_bits_at(
+        data_u8, run_bytebase[rid], within * bit_width, bit_width
+    ).astype(jnp.int32)
     return jnp.where(run_kind[rid] == 0, run_value[rid], packed)
 
 
@@ -95,7 +120,7 @@ def rle_expand_bw(
     run_out_end: jax.Array,   # int32[R]: cumulative output count after run r
     run_kind: jax.Array,      # int32[R]: 0 = RLE, 1 = bit-packed
     run_value: jax.Array,     # int32[R]: repeated value (RLE runs)
-    run_bitbase: jax.Array,   # int32[R]: absolute bit offset of packed data
+    run_bytebase: jax.Array,  # int32[R]: byte offset of packed data
     run_bw: jax.Array,        # int32[R]: bit width of packed data (may vary!)
     num_values: int,
 ) -> jax.Array:
@@ -111,8 +136,7 @@ def rle_expand_bw(
     run_start = jnp.where(rid == 0, 0, run_out_end[jnp.maximum(rid - 1, 0)])
     within = out_idx - run_start
     bw = run_bw[rid]
-    bitpos = run_bitbase[rid] + within * bw
-    raw = extract_bits(data_u8, bitpos, 32)
+    raw = extract_bits_at(data_u8, run_bytebase[rid], within * bw, 32)
     bwu = bw.astype(jnp.uint32)
     mask = jnp.where(
         bw >= 32, jnp.uint32(0xFFFFFFFF), (jnp.uint32(1) << bwu) - jnp.uint32(1)
@@ -168,13 +192,16 @@ def _combine64(lo: jax.Array, hi: jax.Array) -> jax.Array:
     return lo.astype(jnp.uint32).astype(jnp.int64) | (hi.astype(jnp.int64) << 32)
 
 
-def extract_bits64(data_u8: jax.Array, bitpos: jax.Array, bw: jax.Array) -> jax.Array:
-    """Gather variable-width fields up to 64 bits (two 32-bit windows).
+def extract_bits64(data_u8: jax.Array, bytebase: jax.Array, bitoff: jax.Array,
+                   bw: jax.Array) -> jax.Array:
+    """Gather variable-width fields up to 64 bits (two 32-bit windows) at
+    byte base + local bit offset (int32-safe to 2 GiB, as
+    :func:`extract_bits_at`).
 
     ``bw`` is a per-element int32 array in [0, 64]; returns int64 with the
     packed value zero-extended (bits ≥ bw masked off)."""
-    lo = extract_bits(data_u8, bitpos, 32).astype(jnp.int64)
-    hi = extract_bits(data_u8, bitpos + 32, 32).astype(jnp.int64)
+    lo = extract_bits_at(data_u8, bytebase, bitoff, 32).astype(jnp.int64)
+    hi = extract_bits_at(data_u8, bytebase, bitoff + 32, 32).astype(jnp.int64)
     v = lo | (hi << 32)
     bw64 = bw.astype(jnp.uint64)
     mask = jnp.where(
@@ -188,7 +215,8 @@ def extract_bits64(data_u8: jax.Array, bitpos: jax.Array, bw: jax.Array) -> jax.
 
 def delta_expand_wide(
     data_u8: jax.Array,
-    mb_bitbase: jax.Array,    # int32[M]
+    mb_bytebase: jax.Array,   # int32[M]: byte offset of each miniblock
+                              # (miniblocks hold 32·k values → whole bytes)
     mb_bw: jax.Array,         # int32[M] (≤ 64)
     mb_min_lo: jax.Array,     # int32[M]: min_delta low word
     mb_min_hi: jax.Array,     # int32[M]: min_delta high word
@@ -208,8 +236,7 @@ def delta_expand_wide(
     mb = idx // values_per_miniblock
     within = idx % values_per_miniblock
     bw = mb_bw[mb]
-    bitpos = mb_bitbase[mb] + within * bw
-    packed = extract_bits64(data_u8, bitpos, bw)
+    packed = extract_bits64(data_u8, mb_bytebase[mb], within * bw, bw)
     deltas = packed + _combine64(mb_min_lo, mb_min_hi)[mb]
     acc = jnp.cumsum(deltas) + first
     return jnp.concatenate([first[None], acc])
@@ -218,7 +245,7 @@ def delta_expand_wide(
 def delta_expand_paged_wide(
     data_u8: jax.Array,
     mb_out_start: jax.Array,  # int32[M]
-    mb_bitbase: jax.Array,    # int32[M]
+    mb_bytebase: jax.Array,   # int32[M]: byte offset of each miniblock
     mb_bw: jax.Array,         # int32[M] (≤ 64)
     mb_min_lo: jax.Array,     # int32[M]
     mb_min_hi: jax.Array,     # int32[M]
@@ -239,8 +266,9 @@ def delta_expand_paged_wide(
     mb = jnp.clip(mb, 0, mb_out_start.shape[0] - 1)
     within = i - mb_out_start[mb]
     bw = mb_bw[mb]
-    bitpos = mb_bitbase[mb] + within * bw
-    packed = extract_bits64(data_u8, jnp.maximum(bitpos, 0), bw)
+    packed = extract_bits64(
+        data_u8, mb_bytebase[mb], jnp.maximum(within * bw, 0), bw
+    )
     delta = packed + _combine64(mb_min_lo, mb_min_hi)[mb]
     d0 = jnp.where(i == s, jnp.int64(0), delta)
     c0 = jnp.cumsum(d0)
@@ -251,7 +279,7 @@ def delta_expand_paged_wide(
 
 def delta_expand(
     data_u8: jax.Array,
-    mb_bitbase: jax.Array,    # int32[M]: absolute bit offset of each miniblock
+    mb_bytebase: jax.Array,   # int32[M]: byte offset of each miniblock
     mb_bw: jax.Array,         # int32[M]: bit width of each miniblock
     mb_min_delta: jax.Array,  # int32[M]: min_delta of the owning block
     first_value,              # scalar
@@ -273,8 +301,7 @@ def delta_expand(
     mb = idx // values_per_miniblock
     within = idx % values_per_miniblock
     bw = mb_bw[mb]
-    bitpos = mb_bitbase[mb] + within * bw
-    raw = extract_bits(data_u8, bitpos, 32)
+    raw = extract_bits_at(data_u8, mb_bytebase[mb], within * bw, 32)
     mask = jnp.where(
         bw >= 32,
         jnp.uint32(0xFFFFFFFF),
@@ -291,7 +318,7 @@ def delta_expand(
 def delta_expand_paged(
     data_u8: jax.Array,
     mb_out_start: jax.Array,  # int32[M]: global value index of each miniblock's first delta
-    mb_bitbase: jax.Array,    # int32[M]: absolute bit offset of each miniblock
+    mb_bytebase: jax.Array,   # int32[M]: byte offset of each miniblock
     mb_bw: jax.Array,         # int32[M]: bit width of each miniblock
     mb_min_delta: jax.Array,  # int32[M]: min_delta of the owning block
     page_start: jax.Array,    # int32[P]: global value index of each page's first value
@@ -318,8 +345,9 @@ def delta_expand_paged(
     mb = jnp.clip(mb, 0, mb_out_start.shape[0] - 1)
     within = i - mb_out_start[mb]
     bw = mb_bw[mb]
-    bitpos = mb_bitbase[mb] + within * bw
-    raw = extract_bits(data_u8, jnp.maximum(bitpos, 0), 32)
+    raw = extract_bits_at(
+        data_u8, mb_bytebase[mb], jnp.maximum(within * bw, 0), 32
+    )
     mask = jnp.where(
         bw >= 32,
         jnp.uint32(0xFFFFFFFF),
@@ -337,11 +365,17 @@ def delta_expand_paged(
 # Host-side plan builders (NumPy; produce the arrays the device ops consume)
 # ---------------------------------------------------------------------------
 
+class PlanOverflow(ValueError):
+    """A run table cannot be expressed in int32 device plans (offsets past
+    2 GiB or a single bit-packed run past 2³¹ bits) — callers with a host
+    decode path should fall back instead of failing."""
+
+
 def run_table_to_device_plan(run_table: np.ndarray, num_values: int, pad_runs: int):
     """Convert a ``parse_runs`` table into padded device-ready arrays.
 
     Returns dict of numpy arrays: run_out_end, run_kind, run_value,
-    run_bitbase — each padded to ``pad_runs`` entries.
+    run_bytebase — each padded to ``pad_runs`` entries.
     """
     r = len(run_table)
     if r > pad_runs:
@@ -349,25 +383,30 @@ def run_table_to_device_plan(run_table: np.ndarray, num_values: int, pad_runs: i
     out_end = np.full(pad_runs, num_values, dtype=np.int32)
     kind = np.zeros(pad_runs, dtype=np.int32)
     value = np.zeros(pad_runs, dtype=np.int32)
-    bitbase = np.zeros(pad_runs, dtype=np.int32)
+    bytebase = np.zeros(pad_runs, dtype=np.int32)
     if r:
         counts = run_table[:, 1]
         out_end[:r] = np.cumsum(counts)
         kind[:r] = run_table[:, 0]
         is_bp = run_table[:, 0] == 1
         value[:r] = np.where(is_bp, 0, run_table[:, 2]).astype(np.int32)
-        bitbase[:r] = np.where(is_bp, run_table[:, 2] * 8, 0).astype(np.int32)
+        if run_table[is_bp, 2].max(initial=0) >= 2**31:
+            raise PlanOverflow("byte offsets exceed int32 (arena too large)")
+        if int(run_table[is_bp, 1].max(initial=0)) * 32 >= 2**31:
+            # within-run bit positions (within * bit_width) must stay int32
+            raise PlanOverflow("bit-packed run too long for device decode")
+        bytebase[:r] = np.where(is_bp, run_table[:, 2], 0).astype(np.int32)
     return {
         "run_out_end": out_end,
         "run_kind": kind,
         "run_value": value,
-        "run_bitbase": bitbase,
+        "run_bytebase": bytebase,
     }
 
 
 def tables_to_plan5(tables, total: int, pad_runs: int) -> np.ndarray:
     """Merge ``parse_runs`` tables into one flat int32 plan of 5 rows ×
-    ``pad_runs``: out_end, kind, value, bitbase, bw.
+    ``pad_runs``: out_end, kind, value, bytebase, bw.
 
     ``tables`` is a sequence of (run_table, bit_width) pairs whose byte
     offsets (column 2 of bit-packed rows) are already absolute in the target
@@ -387,10 +426,12 @@ def tables_to_plan5(tables, total: int, pad_runs: int) -> np.ndarray:
         plan[1, sl] = table[:, 0]
         is_bp = table[:, 0] == 1
         plan[2, sl] = np.where(is_bp, 0, table[:, 2]).astype(np.int32)
-        bitbase = table[:, 2] * 8
-        if bitbase.size and bitbase.max(initial=0) >= 2**31:
-            raise ValueError("bit offsets exceed int32 (arena too large)")
-        plan[3, sl] = np.where(is_bp, bitbase, 0).astype(np.int32)
+        if table[is_bp, 2].max(initial=0) >= 2**31:
+            raise PlanOverflow("byte offsets exceed int32 (arena too large)")
+        if bw and int(table[is_bp, 1].max(initial=0)) * bw >= 2**31:
+            # within-run bit positions must also stay int32
+            raise PlanOverflow("bit-packed run too long for device decode")
+        plan[3, sl] = np.where(is_bp, table[:, 2], 0).astype(np.int32)
         plan[4, sl] = bw
         plan[0, pos : pos + k] = table[:, 1]  # counts for now
         pos += k

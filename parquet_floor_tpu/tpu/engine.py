@@ -208,11 +208,6 @@ class _ForceHost(Exception):
         self.key = key
 
 
-class _ArenaOverflow(Exception):
-    """Signal at layout time: the group's decompressed bytes exceed the
-    device plan's int32 bit-offset range — restage everything host-side."""
-
-
 # ---------------------------------------------------------------------------
 # Builders
 # ---------------------------------------------------------------------------
@@ -1045,16 +1040,16 @@ class _DevStage:
             if plan is None:
                 raise _ForceHost(self.name)
             m_pad = eng._hwm(("mb", self.name), len(plan["mb_bw"]), minimum=4)
-            k = len(plan["mb_bitbase"])
-            bitbase = plan["mb_bitbase"] + val_off * 8
-            if bitbase.max(initial=0) >= 2**31:
+            k = len(plan["mb_bytebase"])
+            bytebase = plan["mb_bytebase"] + val_off
+            if bytebase.max(initial=0) >= 2**31:
                 raise _ForceHost(self.name)
             if plan["wide"]:
                 # int64 reconstruction: 64-bit constants ride the int32
                 # slab as (low, high) word rows
                 spec["kind"] = "delta1w"
                 mb = np.zeros((4, m_pad), dtype=np.int64)
-                mb[0, :k] = bitbase
+                mb[0, :k] = bytebase
                 mb[1, :k] = plan["mb_bw"]
                 mb[2, :k] = plan["mb_min_delta"] & 0xFFFFFFFF
                 mb[3, :k] = plan["mb_min_delta"] >> 32
@@ -1067,7 +1062,7 @@ class _DevStage:
             else:
                 spec["kind"] = "delta1"
                 mb = np.zeros((3, m_pad), dtype=np.int64)
-                mb[0, :k] = bitbase
+                mb[0, :k] = bytebase
                 mb[1, :k] = plan["mb_bw"]
                 mb[2, :k] = plan["mb_min_delta"]
                 spec["sc_off"] = slabb.add([plan["first_value"]])
@@ -1077,7 +1072,7 @@ class _DevStage:
             spec["vdtype"] = _VDTYPE_NAME[pt]
         elif self.kind == "delta":
             mb_start: List[int] = []
-            mb_bitbase: List[int] = []
+            mb_bytebase: List[int] = []
             mb_bw: List[int] = []
             mb_min: List[int] = []
             pg_first: List[int] = []
@@ -1104,13 +1099,13 @@ class _DevStage:
                 mb_start.append(
                     running + 1 + np.arange(k_mb, dtype=np.int64) * vpm
                 )
-                mb_bitbase.append(plan["mb_bitbase"] + val_off * 8)
+                mb_bytebase.append(plan["mb_bytebase"] + val_off)
                 mb_bw.append(plan["mb_bw"])
                 mb_min.append(plan["mb_min_delta"])
                 running += nn
                 live_nns.append(nn)
             c_start = np.concatenate(mb_start) if mb_start else np.zeros(0, np.int64)
-            c_bitbase = np.concatenate(mb_bitbase) if mb_bitbase else np.zeros(0, np.int64)
+            c_bytebase = np.concatenate(mb_bytebase) if mb_bytebase else np.zeros(0, np.int64)
             c_bw = np.concatenate(mb_bw) if mb_bw else np.zeros(0, np.int64)
             c_min = np.concatenate(mb_min) if mb_min else np.zeros(0, np.int64)
             m_pad = eng._hwm(("mb", self.name), max(len(c_bw), 1), minimum=4)
@@ -1120,7 +1115,7 @@ class _DevStage:
             k = len(c_bw)
             if k:
                 mb[0, :k] = c_start
-                mb[1, :k] = c_bitbase
+                mb[1, :k] = c_bytebase
                 mb[2, :k] = c_bw
                 if wide:
                     mb[3, :k] = c_min & 0xFFFFFFFF
@@ -1351,7 +1346,7 @@ def parse_delta_plan(data_u8: np.ndarray, dtype, allow_wide=False) -> Optional[d
     if wide and not allow_wide:
         return None
     lo = hi = first  # reachable value interval across all prefix sums
-    mb_bitbase, mb_bw, mb_min = [], [], []
+    mb_bytebase, mb_bw, mb_min = [], [], []
     got = 0
     n_deltas = total - 1
     while got < n_deltas:
@@ -1387,13 +1382,13 @@ def parse_delta_plan(data_u8: np.ndarray, dtype, allow_wide=False) -> Optional[d
                     if not allow_wide:
                         return None
                     wide = True
-            mb_bitbase.append(pos * 8)
+            mb_bytebase.append(pos)
             mb_bw.append(bwm)
             mb_min.append(min_delta)
             got += count
             pos += per_mini * bwm // 8
     return {
-        "mb_bitbase": np.array(mb_bitbase or [0], np.int64),
+        "mb_bytebase": np.array(mb_bytebase or [0], np.int64),
         "mb_bw": np.array(mb_bw or [0], np.int64),
         "mb_min_delta": np.array(mb_min or [0], np.int64),
         "first_value": int(first),
@@ -1499,7 +1494,24 @@ class TpuRowGroupReader:
         if float64_policy not in ("auto", "float64", "float32", "bits"):
             raise ValueError(f"bad float64_policy {float64_policy!r}")
         if float64_policy == "auto":
-            float64_policy = "float32" if _platform_is_tpu() else "float64"
+            if _platform_is_tpu():
+                if any(
+                    d.physical_type == Type.DOUBLE
+                    for d in self.reader.schema.columns
+                ):
+                    import warnings
+
+                    warnings.warn(
+                        "float64_policy='auto' decodes DOUBLE columns as "
+                        "float32 on TPU (the reference returns exact "
+                        "doubles); pass float64_policy='bits' for "
+                        "bit-exact int64 bit patterns or 'float64' for "
+                        "x64 doubles",
+                        stacklevel=2,
+                    )
+                float64_policy = "float32"
+            else:
+                float64_policy = "float64"
         self.float64_policy = float64_policy
         self._f64mode = {"float32": "f32", "bits": "bits", "float64": "f64"}[
             float64_policy
@@ -1544,7 +1556,6 @@ class TpuRowGroupReader:
             else None
         )
         self._forced: set = set()   # columns pinned to the host path (per file)
-        self._all_host = False      # sticky: group size forced full host staging
         self._hwm_state: Dict[tuple, int] = {}
         # string-dictionary pools are keyed by (sha256(content), cap, len).
         # Staging reuses any already-shipped key whose buckets dominate the
@@ -1763,7 +1774,7 @@ class TpuRowGroupReader:
         while True:
             try:
                 return self._try_stage(
-                    rg, work, self._forced, self._all_host,
+                    rg, work, self._forced,
                     covered=covered, group_rows=group_rows, chunked=chunked,
                 )
             except _ForceHost as e:
@@ -1771,14 +1782,6 @@ class TpuRowGroupReader:
                 # (e.g. >32-bit delta range) skips the device attempt in
                 # every later row group instead of staging the group twice
                 self._forced.add(e.key)
-            except _ArenaOverflow:
-                # device plans store absolute *bit* offsets as int32, so
-                # device-staged groups cap at 256 MiB decompressed; host
-                # stages use *byte* offsets (good to 2 GiB) — restage the
-                # whole group through the host engine instead of failing.
-                # Sticky per file: sibling groups will be equally oversized,
-                # so don't repeat the doomed device attempt for each one.
-                self._all_host = True
 
     def _pallas_plan(self, plan: np.ndarray, n_runs: int, count: int,
                      bw: int, slabb: _I32Builder):
@@ -1801,7 +1804,7 @@ class TpuRowGroupReader:
         span_off = slabb.add(np.concatenate([tl, th]))
         return (bw, span_off, len(tl), self._pl_interp)
 
-    def _try_stage(self, rg, work, forced, all_host=False, covered=None,
+    def _try_stage(self, rg, work, forced, covered=None,
                    group_rows: int = 0, chunked=None) -> _StagedGroup:
         arena_b = _ArenaBuilder(plk.ARENA_LEAD if self._pl_enabled else 0)
         stages = []
@@ -1813,7 +1816,7 @@ class TpuRowGroupReader:
                 if covered is not None
                 else None
             )
-            if all_host or name in forced:
+            if name in forced:
                 stages.append(
                     _HostStage(name, chunk, desc, self, arena_b,
                                covered=covered, group_rows=group_rows,
@@ -1832,9 +1835,6 @@ class TpuRowGroupReader:
                                covered=covered, group_rows=group_rows,
                                raw_pages=raw_pages)
                 )
-        if arena_b.size >= (1 << 28) and not all_host:
-            if any(isinstance(st, _DevStage) for st in stages):
-                raise _ArenaOverflow()
         if arena_b.size >= (1 << 31) - (1 << 20):
             raise ValueError(
                 f"row group stages {arena_b.size} decompressed bytes; the "
@@ -1852,7 +1852,9 @@ class TpuRowGroupReader:
             # chunk is device_put (async) the moment its fill jobs are
             # done, so decompress/copy of chunk c+1 overlaps the DMA of
             # chunk c.  Chunk boundaries depend only on the bucketed cap,
-            # keeping the fused-program shape cache warm.
+            # keeping the fused-program shape cache warm.  (If a finish()
+            # below raises _ForceHost the shipped chunks are wasted — a
+            # one-time cost per file, since forcing is sticky per column.)
             with trace.span("ship", cap):
                 plist = []
                 for s, e in arena_b.fill_chunks(
@@ -1871,7 +1873,14 @@ class TpuRowGroupReader:
         else:
             arena_b.fill(arena, self._fill_pool)
         slabb = _I32Builder()
-        raw_specs = [st.finish(arena, slabb, self) for st in stages]
+        raw_specs = []
+        for st in stages:
+            try:
+                raw_specs.append(st.finish(arena, slabb, self))
+            except bitops.PlanOverflow:
+                # the column's run tables cannot ride int32 device plans
+                # (e.g. one bit-packed run past 2³¹ bits) — host path
+                raise _ForceHost(st.name)
         # assign extras (string dictionaries) in order of first use
         extra_keys: List[tuple] = []
         new_extras: List[tuple] = []

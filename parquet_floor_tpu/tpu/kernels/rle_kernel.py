@@ -193,7 +193,7 @@ def rle_expand_pallas(
     run_out_end: jax.Array,
     run_kind: jax.Array,
     run_value: jax.Array,
-    run_bitbase: jax.Array,
+    run_bytebase: jax.Array,
     tile_lo: jax.Array,
     tile_hi: jax.Array,
     num_values: int,
@@ -204,15 +204,15 @@ def rle_expand_pallas(
 
     Standalone convenience wrapper over :func:`rle_expand_pallas_inline`:
     pads the buffer with the lead/tail slack the inline contract requires
-    and rebases the (byte-aligned) bit offsets.  Output is int32[n].
+    and rebases the byte offsets.  Output is int32[n].
     """
     if bit_width == 0:
         return jnp.zeros(num_values, dtype=jnp.int32)
     front = ARENA_LEAD
     data_u8 = jnp.pad(data_u8, (front, ARENA_TAIL))
-    run_bitbase = run_bitbase + 8 * front
+    run_bytebase = run_bytebase + front
     return rle_expand_pallas_inline(
-        data_u8, run_out_end, run_kind, run_value, run_bitbase,
+        data_u8, run_out_end, run_kind, run_value, run_bytebase,
         tile_lo, tile_hi, num_values, bit_width, interpret=interpret,
     )
 
@@ -361,7 +361,7 @@ def rle_expand_pallas_inline(
     run_out_end: jax.Array,
     run_kind: jax.Array,
     run_value: jax.Array,
-    run_bitbase: jax.Array,
+    run_bytebase: jax.Array,
     tile_lo: jax.Array,
     tile_hi: jax.Array,
     num_values: int,
@@ -374,12 +374,14 @@ def rle_expand_pallas_inline(
     Contract: ``arena_u8`` already carries ≥ ``ARENA_LEAD`` bytes of slack
     before any packed stream and ≥ ``ARENA_TAIL`` after (the engine's
     arena builder reserves both), so DMA windows never leave the buffer.
-    ``run_bitbase`` holds absolute *bit* offsets into ``arena_u8``.
+    ``run_bytebase`` holds absolute *byte* offsets into ``arena_u8``
+    (packed runs start byte-aligned per the RLE spec; int32 byte offsets
+    reach 2 GiB arenas).
     """
     if bit_width == 0:
         return jnp.zeros(num_values, dtype=jnp.int32)
     n_tiles = pl.cdiv(num_values, TILE)
-    run_byte = (run_bitbase // 8).astype(jnp.int32)
+    run_byte = run_bytebase.astype(jnp.int32)
     if lane_compiled(bit_width):
         # lane-gather formulation: the only one Mosaic compiles today
         kernel = functools.partial(_rle_expand_kernel_lane, bit_width=bit_width)

@@ -38,7 +38,7 @@ def check(bw: int) -> float:
         jnp.asarray(plan["run_out_end"]),
         jnp.asarray(plan["run_kind"]),
         jnp.asarray(plan["run_value"]),
-        jnp.asarray(plan["run_bitbase"]),
+        jnp.asarray(plan["run_bytebase"]),
         jnp.asarray(lo),
         jnp.asarray(hi),
     )

@@ -38,7 +38,7 @@ def _expand_both(buf, plan, n, bw):
         jnp.asarray(plan["run_out_end"]),
         jnp.asarray(plan["run_kind"]),
         jnp.asarray(plan["run_value"]),
-        jnp.asarray(plan["run_bitbase"]),
+        jnp.asarray(plan["run_bytebase"]),
         jnp.asarray(lo),
         jnp.asarray(hi),
         num_values=n,
@@ -50,7 +50,7 @@ def _expand_both(buf, plan, n, bw):
         jnp.asarray(plan["run_out_end"]),
         jnp.asarray(plan["run_kind"]),
         jnp.asarray(plan["run_value"]),
-        jnp.asarray(plan["run_bitbase"]),
+        jnp.asarray(plan["run_bytebase"]),
         n,
         bw,
     )

@@ -38,7 +38,7 @@ def test_sharded_decode_step_matches_host():
 
     G = 4  # two row groups per rg shard
     bufs = []
-    plans = {"run_out_end": [], "run_kind": [], "run_value": [], "run_bitbase": []}
+    plans = {"run_out_end": [], "run_kind": [], "run_value": [], "run_bytebase": []}
     expected_idx = []
     B = 4096
     for _ in range(G):
@@ -60,7 +60,7 @@ def test_sharded_decode_step_matches_host():
         jnp.asarray(np.stack(plans["run_out_end"]).astype(np.int32)),
         jnp.asarray(np.stack(plans["run_kind"]).astype(np.int32)),
         jnp.asarray(np.stack(plans["run_value"]).astype(np.int32)),
-        jnp.asarray(np.stack(plans["run_bitbase"]).astype(np.int32)),
+        jnp.asarray(np.stack(plans["run_bytebase"]).astype(np.int32)),
         jnp.asarray(dictionary),
     )
     assert out.shape == (G, n_per_group)

@@ -50,7 +50,7 @@ def test_rle_expand_matches_numpy(bw):
         jnp.asarray(plan["run_out_end"]),
         jnp.asarray(plan["run_kind"]),
         jnp.asarray(plan["run_value"]),
-        jnp.asarray(plan["run_bitbase"]),
+        jnp.asarray(plan["run_bytebase"]),
         n,
         bw,
     )
@@ -101,7 +101,7 @@ def test_delta_expand_matches_numpy():
     assert plan is not None
     out = bitops.delta_expand(
         _pad8(data),
-        jnp.asarray(plan["mb_bitbase"]),
+        jnp.asarray(plan["mb_bytebase"]),
         jnp.asarray(plan["mb_bw"]),
         jnp.asarray(plan["mb_min_delta"]),
         plan["first_value"],
@@ -109,3 +109,14 @@ def test_delta_expand_matches_numpy():
         plan["values_per_miniblock"],
     )
     np.testing.assert_array_equal(np.asarray(out), vals)
+
+
+def test_plan_offsets_beyond_256mib():
+    """Plans carry byte offsets (int32 to 2 GiB): a run based past the old
+    256 MiB bit-offset ceiling must survive both plan builders intact."""
+    off = 1_500_000_000  # ~1.4 GiB: *8 would overflow int32
+    table = np.array([[1, 64, off]], dtype=np.int64)  # bit-packed, 64 values
+    plan = bitops.run_table_to_device_plan(table, 64, 4)
+    assert plan["run_bytebase"][0] == off
+    flat = bitops.tables_to_plan5([(table, 7)], 64, 4)
+    assert flat.reshape(5, 4)[3, 0] == off

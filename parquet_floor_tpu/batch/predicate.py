@@ -94,10 +94,17 @@ class Predicate:
     def may_match(self, rg) -> bool:  # pragma: no cover - abstract
         raise NotImplementedError
 
+    def may_match_with(self, reader, rg) -> bool:
+        """Like :meth:`may_match` but with file access: equality
+        predicates additionally consult the chunk's Bloom filter when
+        the min/max statistics cannot rule the group out."""
+        return self.may_match(rg)
+
     def row_groups(self, reader) -> List[int]:
         """Indices of row groups that may contain matching rows."""
         return [
-            i for i, rg in enumerate(reader.row_groups) if self.may_match(rg)
+            i for i, rg in enumerate(reader.row_groups)
+            if self.may_match_with(reader, rg)
         ]
 
     def row_ranges(self, reader, rg_index: int) -> List[tuple]:
@@ -169,6 +176,11 @@ class _And(Predicate):
     def may_match(self, rg) -> bool:
         return self.a.may_match(rg) and self.b.may_match(rg)
 
+    def may_match_with(self, reader, rg) -> bool:
+        return self.a.may_match_with(reader, rg) and self.b.may_match_with(
+            reader, rg
+        )
+
     def _ranges(self, reader, rg, n):
         return _intersect(
             normalize_ranges(self.a._ranges(reader, rg, n), n),
@@ -183,6 +195,11 @@ class _Or(Predicate):
 
     def may_match(self, rg) -> bool:
         return self.a.may_match(rg) or self.b.may_match(rg)
+
+    def may_match_with(self, reader, rg) -> bool:
+        return self.a.may_match_with(reader, rg) or self.b.may_match_with(
+            reader, rg
+        )
 
     def _ranges(self, reader, rg, n):
         return self.a._ranges(reader, rg, n) + self.b._ranges(reader, rg, n)
@@ -216,6 +233,21 @@ def _cmp_may_match(op: str, value, mn, mx, null_count) -> bool:
     except TypeError:
         return True  # incomparable literal: keep
     return True
+
+
+def _plain_value(pt: int, value):
+    """A user literal as the one-element sequence ``hash_values`` hashes
+    with the column's plain encoding."""
+    if pt in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
+        b = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+        return [b]
+    np_t = {
+        Type.INT32: np.int32, Type.INT64: np.int64,
+        Type.FLOAT: np.float32, Type.DOUBLE: np.float64,
+    }.get(pt)
+    if np_t is None:
+        raise TypeError(f"no bloom hash for physical type {pt}")
+    return np.array([value], dtype=np_t)
 
 
 def _find_chunk(rg, name: str):
@@ -255,6 +287,32 @@ class _Cmp(Predicate):
         if st is None:
             return True
         return _cmp_may_match(self.op, self.value, st.min, st.max, st.null_count)
+
+    def may_match_with(self, reader, rg) -> bool:
+        if not self.may_match(rg):
+            return False
+        if self.op != "==":
+            return True
+        # stats could not rule the group out — the Bloom filter can still
+        # prove the exact value absent (no false negatives by contract)
+        chunk = _find_chunk(rg, self.name)
+        if chunk is None:
+            return True
+        try:
+            bf = reader.read_bloom_filter(chunk)
+        except Exception:
+            return True  # malformed/foreign filter: stay conservative
+        if bf is None:
+            return True
+        from ..format.bloom import hash_values
+
+        md = chunk.meta_data
+        try:
+            h = hash_values(md.type, _plain_value(md.type, self.value))
+        except (TypeError, ValueError, OverflowError):
+            # unhashable / out-of-range literal: stay conservative
+            return True
+        return bool(bf.check_hashes(h)[0])
 
     def _ranges(self, reader, rg, n):
         pr = _page_rows(reader, rg, n, self.name)

@@ -384,3 +384,34 @@ class ParquetFileReader:
             raw = self.source.read_at(offset, length)
             cache[key], _ = struct_cls.from_bytes(raw)
         return cache[key]
+
+    # -- bloom filters -----------------------------------------------------
+
+    def read_bloom_filter(self, chunk: ColumnChunk):
+        """The chunk's split-block Bloom filter, or None when the writer
+        emitted none.  Parsed once per chunk (cached).  Writers that
+        predate ``bloom_filter_length`` (field 15) get a two-step read:
+        header first, then exactly ``numBytes`` of bitset."""
+        from .bloom import BloomFilterHeader, SplitBlockBloomFilter
+        from .thrift import CompactReader
+
+        md = chunk.meta_data
+        offset = md.bloom_filter_offset
+        if offset is None:
+            return None
+        cache = getattr(self, "_bloom_cache", None)
+        if cache is None:
+            cache = self._bloom_cache = {}
+        if offset not in cache:
+            length = md.bloom_filter_length
+            if length:
+                raw = self.source.read_at(int(offset), int(length))
+                cache[offset] = SplitBlockBloomFilter.from_bytes(raw)
+            else:
+                head = self.source.read_at(int(offset), 64)
+                reader = CompactReader(head)
+                header = BloomFilterHeader.read(reader)
+                total = reader.pos + int(header.numBytes or 0)
+                raw = self.source.read_at(int(offset), total)
+                cache[offset] = SplitBlockBloomFilter.from_bytes(raw)
+        return cache[offset]

@@ -72,6 +72,10 @@ class WriterOptions:
     delta_integers: bool = False  # use DELTA_BINARY_PACKED for int cols
     byte_stream_split_floats: bool = False
     delta_strings: bool = False   # v2: DELTA_BYTE_ARRAY for non-dict strings
+    # Split-block Bloom filters per top-level column name: True sizes from
+    # the chunk's distinct count at fpp 1%, or pass {"ndv": N, "fpp": p}.
+    # parquet-mr 1.12 surface (ColumnMetaData fields 14/15).
+    bloom_filter_columns: Optional[Dict[str, object]] = None
 
 
 @dataclass
@@ -422,6 +426,7 @@ class ParquetFileWriter:
             elif rows != num_rows:
                 raise ValueError(f"column {desc.path}: {rows} rows != {num_rows}")
             chunk = _ColumnChunkWriter(self.options, desc).write(self.sink, cd)
+            self._maybe_build_bloom(chunk, desc, cd)
             total_bytes += chunk.meta_data.total_uncompressed_size
             total_comp += chunk.meta_data.total_compressed_size
             chunks.append(chunk)
@@ -482,9 +487,43 @@ class ParquetFileWriter:
                 cds.append(make_column_data(desc, data))
         self.write_row_group(cds)
 
+    def _maybe_build_bloom(self, chunk, desc, cd: ColumnData) -> None:
+        """Hash the chunk's non-null values into a split-block Bloom
+        filter when the column is selected; serialized at close()."""
+        sel = (self.options.bloom_filter_columns or {}).get(desc.path[0])
+        if not sel:
+            return
+        from .bloom import (
+            SplitBlockBloomFilter, hash_values, optimal_num_bytes,
+        )
+
+        hashes = hash_values(desc.physical_type, cd.values)
+        if isinstance(sel, dict):
+            ndv = int(sel.get("ndv", 0)) or len(np.unique(hashes))
+            fpp = float(sel.get("fpp", 0.01))
+        else:
+            ndv = len(np.unique(hashes))
+            fpp = 0.01
+        bf = SplitBlockBloomFilter(optimal_num_bytes(ndv, fpp))
+        bf.insert_hashes(hashes)
+        chunk._pftpu_bloom = bf
+
     def close(self) -> FileMetaData:
         if self._closed:
             return self._file_meta
+        # bloom filters first, then page indexes — all between the last
+        # row group and the footer (parquet-mr layout); offsets patch
+        # into each ColumnChunk's metadata
+        for rg in self._row_groups:
+            for chunk in rg.columns or []:
+                bf = getattr(chunk, "_pftpu_bloom", None)
+                if bf is None:
+                    continue
+                data = bf.to_bytes()
+                chunk.meta_data.bloom_filter_offset = self.sink.pos
+                chunk.meta_data.bloom_filter_length = len(data)
+                self.sink.write(data)
+                del chunk._pftpu_bloom
         # page indexes: all ColumnIndex structs, then all OffsetIndex
         # structs, between the last row group and the footer (parquet-mr
         # layout); offsets patch into each ColumnChunk

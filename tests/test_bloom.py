@@ -1,0 +1,212 @@
+"""Split-block Bloom filters: XXH64 exactness, SBBF round-trip, pyarrow
+interop (both directions), and predicate row-group pruning.
+
+Capability parity: parquet-mr 1.12's bloom filter surface
+(ColumnMetaData fields 14/15), which the reference links against.
+"""
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    ParquetFileReader,
+    ParquetFileWriter,
+    WriterOptions,
+    col,
+    types,
+)
+from parquet_floor_tpu.format.bloom import (
+    SplitBlockBloomFilter,
+    hash_values,
+    optimal_num_bytes,
+    xxh64,
+    xxh64_fixed,
+)
+from parquet_floor_tpu.format.parquet_thrift import Type
+
+rng = np.random.default_rng(11)
+
+
+# -- XXH64 ------------------------------------------------------------------
+
+def test_xxh64_known_vectors():
+    # public xxHash reference vectors, seed 0
+    assert xxh64(b"") == 0xEF46DB3751D8E999
+    assert xxh64(b"a") == 0xD24EC4F1A98C6E5B
+    assert xxh64(b"abc") == 0x44BC2CF5AD770999
+    assert xxh64(b"Nobody inspects the spammish repetition") == 0xFBCEA83C8A378BF1
+    # ≥ 32 bytes exercises the stripe loop
+    assert xxh64(bytes(range(64))) == xxh64(bytes(range(64)))
+
+
+@pytest.mark.parametrize("width", list(range(1, 9)))
+def test_xxh64_fixed_matches_scalar(width):
+    rows = rng.integers(0, 256, (500, width)).astype(np.uint8)
+    got = xxh64_fixed(rows)
+    want = np.array([xxh64(r.tobytes()) for r in rows], np.uint64)
+    np.testing.assert_array_equal(got, want)
+
+
+# -- SBBF -------------------------------------------------------------------
+
+def test_sbbf_no_false_negatives_and_wire_roundtrip():
+    vals = rng.integers(-(2**62), 2**62, 5000)
+    h = hash_values(Type.INT64, vals)
+    bf = SplitBlockBloomFilter(optimal_num_bytes(5000, 0.01))
+    bf.insert_hashes(h)
+    assert bf.check_hashes(h).all()
+    # absent values: false-positive rate near the configured fpp
+    absent = hash_values(Type.INT64, rng.integers(-(2**62), 2**62, 4000) | 1)
+    fp = bf.check_hashes(absent).mean()
+    assert fp < 0.05
+    # wire round-trip preserves every bit
+    back = SplitBlockBloomFilter.from_bytes(bf.to_bytes())
+    np.testing.assert_array_equal(back.bitset, bf.bitset)
+    assert back.check_hashes(h).all()
+
+
+def test_optimal_num_bytes_monotone():
+    a = optimal_num_bytes(100, 0.01)
+    b = optimal_num_bytes(100_000, 0.01)
+    c = optimal_num_bytes(100_000, 0.0001)
+    assert 32 <= a < b < c
+    for v in (a, b, c):
+        assert v & (v - 1) == 0  # power of two
+
+
+# -- file round-trip + predicate pruning -----------------------------------
+
+def _write_two_groups(tmp_path, with_bloom=True):
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    opts = WriterOptions(
+        bloom_filter_columns={"k": True, "s": {"fpp": 0.005}} if with_bloom else None,
+        row_group_rows=1000,
+    )
+    path = tmp_path / "bf.parquet"
+    with ParquetFileWriter(path, schema, opts) as w:
+        # both groups share the SAME min/max envelope so min/max stats
+        # cannot prune an equality probe — only the bloom filter can
+        w.write_columns({"k": np.r_[0, np.arange(2, 1998, 2), 10_000],
+                         "s": [f"even_{i}" for i in range(1000)]})
+        w.write_columns({"k": np.r_[0, np.arange(1, 1997, 2), 10_000],
+                         "s": [f"odd_{i}" for i in range(1000)]})
+    return path
+
+
+def test_bloom_roundtrip_and_pruning(tmp_path):
+    path = _write_two_groups(tmp_path)
+    with ParquetFileReader(path) as r:
+        for rg in r.row_groups:
+            for chunk in rg.columns:
+                bf = r.read_bloom_filter(chunk)
+                assert bf is not None and bf.num_bytes >= 32
+        # value 222 is even: lives in group 0 only; stats can't tell
+        assert (col("k") == 222).row_groups(r) == [0]
+        assert (col("k") == 333).row_groups(r) == [1]
+        # absent everywhere (within [0, 10000] so stats keep both)
+        assert (col("k") == 5555).row_groups(r) == []
+        # string bloom
+        assert (col("s") == "even_7").row_groups(r) == [0]
+        assert (col("s") == "odd_7").row_groups(r) == [1]
+        assert (col("s") == "missing").row_groups(r) == []
+        # non-equality ops never consult the bloom (and still work)
+        assert (col("k") > 9_000).row_groups(r) == [0, 1]
+
+
+def test_bloom_absent_without_option(tmp_path):
+    path = _write_two_groups(tmp_path, with_bloom=False)
+    with ParquetFileReader(path) as r:
+        for rg in r.row_groups:
+            for chunk in rg.columns:
+                assert r.read_bloom_filter(chunk) is None
+        # equality stays conservative without a bloom
+        assert (col("k") == 5555).row_groups(r) == [0, 1]
+
+
+def test_pyarrow_reads_nothing_dropped(tmp_path):
+    """pyarrow must still read files that carry our bloom filters."""
+    import pyarrow.parquet as pq
+
+    path = _write_two_groups(tmp_path)
+    t = pq.read_table(path)
+    assert t.num_rows == 2000
+    assert t.column("s").to_pylist()[0] == "even_0"
+
+
+def test_pyarrow_written_bloom_interop(tmp_path):
+    """Read pyarrow-written blooms: no false negatives, equality pruning."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "pa_bf.parquet")
+    k = np.arange(0, 3000, 3, dtype=np.int64)        # multiples of 3
+    s = [f"cat_{i:04d}" for i in range(1000)]
+    pq.write_table(
+        pa.table({"k": k, "s": s}), path,
+        bloom_filter_options={"k": {"ndv": 1000, "fpp": 0.01},
+                              "s": {"ndv": 1000, "fpp": 0.01}},
+        use_dictionary=False,
+    )
+    with ParquetFileReader(path) as r:
+        chunk_k = r.row_groups[0].columns[0]
+        bf = r.read_bloom_filter(chunk_k)
+        assert bf is not None
+        assert bf.check_hashes(hash_values(Type.INT64, k)).all()
+        assert (col("k") == 333).row_groups(r) == [0]
+        assert (col("k") == 334).row_groups(r) == []   # not a multiple of 3
+        assert (col("s") == "cat_0042").row_groups(r) == [0]
+        assert (col("s") == "dog_0042").row_groups(r) == []
+
+
+def test_bloom_optional_column_hashes_nonnull_only(tmp_path):
+    schema = types.message(
+        "t", types.optional(types.INT32).named("v"),
+    )
+    path = tmp_path / "opt.parquet"
+    with ParquetFileWriter(
+        path, schema, WriterOptions(bloom_filter_columns={"v": True})
+    ) as w:
+        w.write_columns({"v": [1, None, 3, None, 5]})
+    with ParquetFileReader(path) as r:
+        assert (col("v") == 3).row_groups(r) == [0]
+        assert (col("v") == 4).row_groups(r) == []
+
+
+def test_negative_zero_and_overflow_probes(tmp_path):
+    schema = types.message(
+        "t",
+        types.required(types.DOUBLE).named("f"),
+        types.required(types.INT32).named("k"),
+    )
+    path = tmp_path / "z.parquet"
+    with ParquetFileWriter(
+        path, schema,
+        WriterOptions(bloom_filter_columns={"f": True, "k": True}),
+    ) as w:
+        w.write_columns({"f": np.array([0.0, 1.5, -2.5]),
+                         "k": np.array([1, 2, 3], np.int32)})
+    with ParquetFileReader(path) as r:
+        # -0.0 == 0.0 numerically: the bloom must not prune it
+        assert (col("f") == -0.0).row_groups(r) == [0]
+        assert (col("f") == 0.0).row_groups(r) == [0]
+        # in-range stats: an out-of-int32 literal prunes via min/max
+        assert (col("k") == 2**40).row_groups(r) == []
+
+    # stats-less file: the bloom path sees the overflowing literal and
+    # must stay conservative instead of crashing
+    path2 = tmp_path / "z2.parquet"
+    with ParquetFileWriter(
+        path2, schema,
+        WriterOptions(bloom_filter_columns={"f": True, "k": True},
+                      write_statistics=False),
+    ) as w:
+        w.write_columns({"f": np.array([0.0, 1.5, -2.5]),
+                         "k": np.array([1, 2, 3], np.int32)})
+    with ParquetFileReader(path2) as r:
+        assert (col("k") == 2**40).row_groups(r) == [0]
+        assert (col("k") == 2).row_groups(r) == [0]
+        assert (col("k") == 7).row_groups(r) == []  # bloom prunes

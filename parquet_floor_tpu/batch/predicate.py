@@ -304,15 +304,17 @@ class _Cmp(Predicate):
             return True  # malformed/foreign filter: stay conservative
         if bf is None:
             return True
-        from ..format.bloom import hash_values
+        from ..format.bloom import probe_hashes
 
         md = chunk.meta_data
         try:
-            h = hash_values(md.type, _plain_value(md.type, self.value))
+            # probe_hashes covers both ±0.0 encodings for float zeros
+            # (foreign writers insert only the stored bit pattern)
+            h = probe_hashes(md.type, _plain_value(md.type, self.value))
         except (TypeError, ValueError, OverflowError):
             # unhashable / out-of-range literal: stay conservative
             return True
-        return bool(bf.check_hashes(h)[0])
+        return bool(bf.check_hashes(h).any())
 
     def _ranges(self, reader, rg, n):
         pr = _page_rows(reader, rg, n, self.name)

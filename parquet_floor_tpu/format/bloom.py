@@ -190,16 +190,20 @@ def hash_values(physical_type: int, values) -> np.ndarray:
     """XXH64 of each value's plain-encoded bytes → uint64[N].
 
     BYTE_ARRAY hashes the raw bytes (no length prefix); fixed types hash
-    their little-endian plain encoding, with −0.0 normalized to +0.0 so
-    numerically-equal floats hash identically.  BOOLEAN is rejected (a
+    their little-endian plain encoding exactly as stored (spec behavior —
+    ±0.0 are distinct encodings; writers insert both and equality probes
+    check both, see ``zero_variant_hashes``).  BOOLEAN is rejected (a
     1-bit domain never benefits — parquet-mr refuses it too)."""
     from .encodings.plain import ByteArrayColumn
 
     if physical_type == Type.BOOLEAN:
         raise ValueError("bloom filters are not supported for BOOLEAN")
     if isinstance(values, ByteArrayColumn) or (
-        isinstance(values, np.ndarray) and values.dtype == object
+        isinstance(values, np.ndarray) and values.dtype.kind in "OSU"
     ) or isinstance(values, (list, tuple)):
+        # numpy 'S' items iterate as padding-stripped bytes and 'U' items
+        # as str — both take the same per-item encoding as lists, never a
+        # raw fixed-width buffer view (which would hash the padding)
         if isinstance(values, ByteArrayColumn):
             items = values.to_list()
         else:
@@ -218,10 +222,33 @@ def hash_values(physical_type: int, values) -> np.ndarray:
         return np.array([xxh64(r.tobytes()) for r in arr], np.uint64)
     if arr.dtype == np.bool_:
         raise ValueError("bloom filters are not supported for BOOLEAN")
-    if arr.dtype.kind == "f":
-        arr = arr + arr.dtype.type(0.0)  # −0.0 + 0.0 → +0.0
     rows = np.ascontiguousarray(arr).view(np.uint8).reshape(len(arr), arr.dtype.itemsize)
     return xxh64_fixed(rows)
+
+
+def probe_hashes(physical_type: int, values) -> np.ndarray:
+    """Hashes to test when PROBING a filter for equality: the values'
+    own hashes, plus both zero encodings for any float zero (a foreign
+    writer inserted only the stored bit pattern — matching either is
+    "maybe present").  Keeps the ±0.0 encoding rules in this module,
+    mirroring :func:`zero_variant_hashes` on the insert side."""
+    h = hash_values(physical_type, values)
+    zv = zero_variant_hashes(physical_type, values)
+    return h if zv is None else np.concatenate([h, zv])
+
+
+def zero_variant_hashes(physical_type: int, values) -> Optional[np.ndarray]:
+    """Hashes of the *other* zero encoding for any ±0.0 present in a float
+    column, or None.  −0.0 == +0.0 numerically but their plain encodings
+    differ; a filter must contain both so a spec-following reader probing
+    either bit pattern never gets a false negative."""
+    arr = np.asarray(values) if not isinstance(values, np.ndarray) else values
+    if getattr(arr, "dtype", None) is None or arr.dtype.kind != "f":
+        return None
+    if not (arr == 0.0).any():
+        return None
+    both = np.array([0.0, -0.0], dtype=arr.dtype)
+    return hash_values(physical_type, both)
 
 
 # -- the split-block filter -------------------------------------------------
@@ -310,6 +337,13 @@ class SplitBlockBloomFilter:
         header = BloomFilterHeader.read(reader)
         if header.numBytes is None or header.numBytes <= 0:
             raise ValueError("bloom filter header missing numBytes")
+        if header.numBytes % 32 or header.numBytes < MIN_BYTES:
+            raise ValueError(
+                f"invalid bloom filter size {header.numBytes} "
+                "(must be a multiple of 32 ≥ 32)"
+            )
+        if header.algorithm is not None and header.algorithm.BLOCK is None:
+            raise ValueError("unsupported bloom filter algorithm")
         if header.compression is not None and header.compression.UNCOMPRESSED is None:
             raise ValueError("unsupported bloom filter compression")
         if header.hash is not None and header.hash.XXHASH is None:

@@ -136,6 +136,26 @@ def parse_runs(data, num_values: int, bit_width: int, pos: int = 0):
     return table, pos
 
 
+def parse_runs_batch(data, streams):
+    """Parse several independent run streams of one buffer.
+
+    ``streams`` is a sequence of ``(pos, num_values, bit_width)``; returns
+    a list of run tables (absolute byte offsets), one per stream.  One
+    native call when available; exact per-stream fallback otherwise."""
+    if not streams:
+        return []
+    if _native is not None and _native.available():
+        try:
+            pos, counts, bws = (list(x) for x in zip(*streams))
+            table, runs = _native.rle_parse_runs_batch(data, pos, counts, bws)
+            return np.split(table, np.cumsum(runs)[:-1])
+        except ValueError:
+            pass  # let the per-stream parser produce its exact errors
+    return [
+        parse_runs(data, n, bw, pos=p)[0] for p, n, bw in streams
+    ]
+
+
 def count_equal(data, num_values: int, bit_width: int, target: int,
                 pos: int = 0, run_table=None):
     """Count decoded values == target without materializing the expansion

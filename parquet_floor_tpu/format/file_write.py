@@ -495,9 +495,30 @@ class ParquetFileWriter:
             return
         from .bloom import (
             SplitBlockBloomFilter, hash_values, optimal_num_bytes,
+            zero_variant_hashes,
         )
+        from .encodings.plain import ByteArrayColumn
 
-        hashes = hash_values(desc.physical_type, cd.values)
+        values = cd.values
+        if isinstance(values, ByteArrayColumn) or (
+            isinstance(values, np.ndarray) and values.dtype.kind in "OSU"
+        ) or isinstance(values, (list, tuple)):
+            # duplicate inserts add nothing: hash each DISTINCT byte
+            # string once instead of per row (the per-item Python XXH64
+            # is the write path's only scalar loop)
+            items = (
+                values.to_list()
+                if isinstance(values, ByteArrayColumn)
+                else list(values)
+            )
+            values = list({
+                v.encode("utf-8") if isinstance(v, str) else bytes(v)
+                for v in items
+            })
+        hashes = hash_values(desc.physical_type, values)
+        zv = zero_variant_hashes(desc.physical_type, values)
+        if zv is not None:
+            hashes = np.concatenate([hashes, zv])
         if isinstance(sel, dict):
             ndv = int(sel.get("ndv", 0)) or len(np.unique(hashes))
             fpp = float(sel.get("fpp", 0.01))

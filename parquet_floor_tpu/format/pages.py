@@ -79,7 +79,10 @@ def _split_pages_native(chunk, num_values: int) -> "List[RawPage]":
                 encoding=int(row[14]) if row[14] >= 0 else None,
             )
         off, size = int(row[1]), int(row[2])
-        pages.append(RawPage(header, bytes(mv[off : off + size])))
+        # zero-copy: a view into the chunk buffer (kept alive by the
+        # page's reference; staging consumes pages while the source is
+        # open, and every consumer takes buffers, not bytes)
+        pages.append(RawPage(header, mv[off : off + size]))
     return pages
 
 _NUMPY_DTYPE = {
@@ -92,10 +95,13 @@ _NUMPY_DTYPE = {
 
 @dataclass
 class RawPage:
-    """A parsed page header + its (still compressed) payload bytes."""
+    """A parsed page header + its (still compressed) payload bytes.
+
+    ``payload`` may be a zero-copy memoryview into the column-chunk
+    buffer — consume it while the source is open (mmap-backed)."""
 
     header: PageHeader
-    payload: bytes  # compressed_page_size bytes
+    payload: Union[bytes, memoryview]  # compressed_page_size bytes
 
     @property
     def page_type(self) -> int:

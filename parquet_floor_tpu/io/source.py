@@ -71,7 +71,15 @@ class FileSource:
     def close(self) -> None:
         if self._mm is not None:
             self._buf = None
-            self._mm.close()
+            try:
+                self._mm.close()
+            except BufferError:
+                # a caller still holds a view into the map (read_at result
+                # or a zero-copy page payload): drop our reference and let
+                # the map close when the last view dies, instead of
+                # raising here — which would also mask the original error
+                # when unwinding out of a `with ParquetFileReader(...)`
+                pass
             self._mm = None
         if self._own and self._fh is not None:
             self._fh.close()

@@ -357,6 +357,44 @@ ptrdiff_t pftpu_rle_parse_runs(const uint8_t* data, size_t data_len,
   return static_cast<ptrdiff_t>(rows);
 }
 
+// Parse many independent RLE/bit-packed streams of ONE buffer in a single
+// call (staging parses one stream per page per level/index category — the
+// per-call overhead of crossing the C boundary dominated the work).  For
+// stream s: counts[s] values at bws[s] bits starting at data+pos[s].  Run
+// rows land contiguously in out_table with byte offsets rebased to be
+// absolute in `data`; out_runs[s] = rows of stream s.  Returns total rows,
+// -1 on malformed input, -2 when cap_rows is too small.
+ptrdiff_t pftpu_rle_parse_runs_batch(const uint8_t* data, size_t data_len,
+                                     long long n_streams,
+                                     const long long* pos,
+                                     const long long* counts,
+                                     const long long* bws,
+                                     long long* out_table, size_t cap_rows,
+                                     long long* out_runs) {
+  size_t used = 0;
+  for (long long s = 0; s < n_streams; s++) {
+    if (pos[s] < 0 || static_cast<size_t>(pos[s]) > data_len) return -1;
+    if (bws[s] == 0) {  // mirrors parse_runs: empty table for bw 0
+      out_runs[s] = 0;
+      continue;
+    }
+    if (bws[s] < 0 || bws[s] > 64) return -1;
+    long long end_pos = 0;
+    ptrdiff_t r = pftpu_rle_parse_runs(
+        data + pos[s], data_len - static_cast<size_t>(pos[s]), counts[s],
+        static_cast<int>(bws[s]), out_table + used * 4, cap_rows - used,
+        &end_pos);
+    if (r < 0) return r;
+    for (ptrdiff_t i = 0; i < r; i++) {
+      if (out_table[(used + i) * 4 + 0] == 1)
+        out_table[(used + i) * 4 + 2] += pos[s];
+    }
+    out_runs[s] = r;
+    used += static_cast<size_t>(r);
+  }
+  return static_cast<ptrdiff_t>(used);
+}
+
 // ---------------------------------------------------------------------------
 // PLAIN BYTE_ARRAY length-chain walk (the only sequential part of string
 // decode; payload gather stays vectorized in NumPy / on device)

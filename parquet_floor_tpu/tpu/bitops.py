@@ -412,36 +412,37 @@ def tables_to_plan5(tables, total: int, pad_runs: int) -> np.ndarray:
     offsets (column 2 of bit-packed rows) are already absolute in the target
     buffer.  Pad runs own no output (out_end == total).
     """
-    r = sum(len(t) for t, _ in tables)
+    live = [(t, bw) for t, bw in tables if len(t)]
+    r = sum(len(t) for t, _ in live)
     if r > pad_runs:
         raise ValueError(f"run tables ({r}) exceed padding ({pad_runs})")
     plan = np.zeros((5, pad_runs), dtype=np.int32)
     plan[0] = total
-    pos = 0
-    for table, bw in tables:
-        k = len(table)
-        if not k:
-            continue
-        sl = slice(pos, pos + k)
-        plan[1, sl] = table[:, 0]
-        is_bp = table[:, 0] == 1
-        plan[2, sl] = np.where(is_bp, 0, table[:, 2]).astype(np.int32)
-        if table[is_bp, 2].max(initial=0) >= 2**31:
+    if live:
+        # one pass over the concatenation instead of per-table slices —
+        # a chunk has one table per page, and staging builds thousands
+        cat = np.concatenate([t for t, _ in live], axis=0)
+        bws = np.repeat(
+            np.fromiter((bw for _, bw in live), np.int64, len(live)),
+            np.fromiter((len(t) for t, _ in live), np.int64, len(live)),
+        )
+        is_bp = cat[:, 0] == 1
+        if cat[is_bp, 2].max(initial=0) >= 2**31:
             raise PlanOverflow("byte offsets exceed int32 (arena too large)")
-        if bw and int(table[is_bp, 1].max(initial=0)) * bw >= 2**31:
+        if (cat[is_bp, 1] * bws[is_bp]).max(initial=0) >= 2**31:
             # within-run bit positions must also stay int32
             raise PlanOverflow("bit-packed run too long for device decode")
-        plan[3, sl] = np.where(is_bp, table[:, 2], 0).astype(np.int32)
-        plan[4, sl] = bw
-        plan[0, pos : pos + k] = table[:, 1]  # counts for now
-        pos += k
-    if pos:
-        plan[0, :pos] = np.cumsum(plan[0, :pos])
-        if pos and plan[0, pos - 1] != total:
+        plan[1, :r] = cat[:, 0]
+        plan[2, :r] = np.where(is_bp, 0, cat[:, 2]).astype(np.int32)
+        plan[3, :r] = np.where(is_bp, cat[:, 2], 0).astype(np.int32)
+        plan[4, :r] = bws
+        out_end = np.cumsum(cat[:, 1])
+        if out_end[-1] != total:
             # trailing pad already holds `total`; runs must sum to it
             raise ValueError(
-                f"run counts sum to {plan[0, pos - 1]}, expected {total}"
+                f"run counts sum to {out_end[-1]}, expected {total}"
             )
+        plan[0, :r] = out_end
     return plan.reshape(-1)
 
 

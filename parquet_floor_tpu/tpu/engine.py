@@ -788,39 +788,53 @@ class _DevStage:
         rep_bw = e_rle.min_bit_width(max_rep)
         pt = desc.physical_type
         n = sum(p.n for p in self.pages)
-        lvl_tables = []
-        rep_tables = []
+        # Two passes: locate every level stream first (prefix reads only),
+        # then parse them ALL in one native batch call — the staging loop
+        # used to cross the C boundary once per page per category.
+        rep_streams: List[tuple] = []
+        def_streams: List[tuple] = []
+        def_at: List[int] = []     # index into def_streams per page, or -1
         val_offs: List[int] = []
-        nns: List[int] = []
         for p in self.pages:
             if p.v == 1:
                 pos = p.off
                 if max_rep > 0:
                     ln = int.from_bytes(arena[pos : pos + 4].tobytes(), "little")
-                    table, _ = e_rle.parse_runs(arena, p.n, rep_bw, pos=pos + 4)
-                    rep_tables.append((table, rep_bw))
+                    rep_streams.append((pos + 4, p.n, rep_bw))
                     pos += 4 + ln
                 if max_def > 0:
                     ln = int.from_bytes(arena[pos : pos + 4].tobytes(), "little")
-                    table, _ = e_rle.parse_runs(arena, p.n, def_bw, pos=pos + 4)
-                    nn = e_rle.count_equal(
-                        arena, p.n, def_bw, max_def, pos=pos + 4,
-                        run_table=table,
-                    )
-                    lvl_tables.append((table, def_bw))
+                    def_at.append(len(def_streams))
+                    def_streams.append((pos + 4, p.n, def_bw))
                     pos += 4 + ln
                 else:
-                    nn = p.n
+                    def_at.append(-1)
                 val_offs.append(pos)
             else:
                 if max_rep > 0:
-                    table, _ = e_rle.parse_runs(arena, p.n, rep_bw, pos=p.rep_off)
-                    rep_tables.append((table, rep_bw))
+                    rep_streams.append((p.rep_off, p.n, rep_bw))
                 if max_def > 0:
-                    table, _ = e_rle.parse_runs(arena, p.n, def_bw, pos=p.lvl_off)
-                    lvl_tables.append((table, def_bw))
-                nn = p.nn
+                    def_at.append(len(def_streams))
+                    def_streams.append((p.lvl_off, p.n, def_bw))
+                else:
+                    def_at.append(-1)
                 val_offs.append(p.off)
+        rep_tabs = e_rle.parse_runs_batch(arena, rep_streams)
+        def_tabs = e_rle.parse_runs_batch(arena, def_streams)
+        rep_tables = [(t, rep_bw) for t in rep_tabs]
+        lvl_tables = [(t, def_bw) for t in def_tabs]
+        nns: List[int] = []
+        for p, da in zip(self.pages, def_at):
+            if max_def <= 0:
+                nn = p.n
+            elif p.v == 1:
+                pos_s, _, _ = def_streams[da]
+                nn = e_rle.count_equal(
+                    arena, p.n, def_bw, max_def, pos=pos_s,
+                    run_table=def_tabs[da],
+                )
+            else:
+                nn = p.nn
             nns.append(int(nn))
         total_nn = sum(nns)
 
@@ -843,11 +857,14 @@ class _DevStage:
             spec["pl_rep"] = eng._pallas_plan(plan, r_rep, n, rep_bw, slabb)
 
         if self.kind in ("dict", "dict_str"):
-            idx_tables = []
+            # collect every page's index stream, parse in one batch call
+            idx_streams: List[tuple] = []
+            idx_slot: List = []    # stream index | ("zero", nn) | None
             for p, val_off, nn in zip(self.pages, val_offs, nns):
                 if nn == 0:
-                    # all-null page: no value section — don't even probe the
-                    # bit-width byte (it would read the next page's bytes)
+                    # all-null page: no value section — don't even probe
+                    # the bit-width byte (it would read the next page)
+                    idx_slot.append(None)
                     continue
                 page_bw = int(arena[val_off])
                 if page_bw > 32:
@@ -855,12 +872,21 @@ class _DevStage:
                 if page_bw == 0:
                     # all values are index 0: empty table rows expand to
                     # zeros via the plan's RLE padding
-                    idx_tables.append(
-                        (np.array([[0, nn, 0, 0]], dtype=np.int64), 1)
-                    )
+                    idx_slot.append(("zero", nn))
                     continue
-                table, _ = e_rle.parse_runs(arena, nn, page_bw, pos=val_off + 1)
-                idx_tables.append((table, page_bw))
+                idx_slot.append((len(idx_streams), page_bw))
+                idx_streams.append((val_off + 1, nn, page_bw))
+            idx_tabs = e_rle.parse_runs_batch(arena, idx_streams)
+            idx_tables = []
+            for slot in idx_slot:
+                if slot is None:
+                    continue
+                if slot[0] == "zero":
+                    idx_tables.append(
+                        (np.array([[0, slot[1], 0, 0]], dtype=np.int64), 1)
+                    )
+                else:
+                    idx_tables.append((idx_tabs[slot[0]], slot[1]))
             r_idx = eng._hwm(
                 ("r_idx", self.name), sum(len(t) for t, _ in idx_tables)
             )

@@ -210,3 +210,64 @@ def test_negative_zero_and_overflow_probes(tmp_path):
         assert (col("k") == 2**40).row_groups(r) == [0]
         assert (col("k") == 2).row_groups(r) == [0]
         assert (col("k") == 7).row_groups(r) == []  # bloom prunes
+
+
+def test_foreign_negative_zero_not_pruned(tmp_path):
+    """A spec-following writer inserts only the stored zero's bit pattern;
+    probing either sign of zero must still match (never a false negative)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "nz.parquet")
+    pq.write_table(
+        pa.table({"f": np.array([-0.0, 7.25])}), path,
+        bloom_filter_options={"f": {"ndv": 10, "fpp": 0.01}},
+        use_dictionary=False,
+    )
+    with ParquetFileReader(path) as r:
+        assert (col("f") == 0.0).row_groups(r) == [0]
+        assert (col("f") == -0.0).row_groups(r) == [0]
+        assert (col("f") == 1.0).row_groups(r) == []
+
+
+def test_close_with_live_page_views(tmp_path):
+    """Zero-copy page payloads must not turn close() into a BufferError
+    (and must not mask the original exception when a with-block unwinds)."""
+    path = _write_two_groups(tmp_path)
+    with ParquetFileReader(path) as r:
+        pages = r.read_raw_column_chunk(r.row_groups[0].columns[0])
+    # reader closed while `pages` still holds views: no BufferError,
+    # and the payload bytes stay readable until the views die
+    assert len(pages) > 0 and len(bytes(pages[0].payload)) > 0
+
+
+def test_numpy_string_arrays_hash_like_lists():
+    """'S' and '<U' arrays must hash per item (padding-stripped / UTF-8),
+    never as raw fixed-width buffers."""
+    want = hash_values(Type.BYTE_ARRAY, [b"a", b"ab"])
+    got_s = hash_values(Type.BYTE_ARRAY, np.array([b"a", b"ab"], dtype="S2"))
+    got_u = hash_values(Type.BYTE_ARRAY, np.array(["a", "ab"], dtype="<U2"))
+    np.testing.assert_array_equal(got_s, want)
+    np.testing.assert_array_equal(got_u, want)
+
+
+def test_from_bytes_rejects_malformed_headers():
+    bf = SplitBlockBloomFilter(64)
+    raw = bytearray(bf.to_bytes())
+    good = SplitBlockBloomFilter.from_bytes(bytes(raw))
+    assert good.num_bytes == 64
+    # corrupt numBytes to a non-multiple-of-32 value (field 1, varint)
+    from parquet_floor_tpu.format.thrift import CompactWriter
+    from parquet_floor_tpu.format.bloom import (
+        BloomFilterHeader, BloomFilterAlgorithm, BloomFilterHash,
+        BloomFilterCompression, SplitBlockAlgorithm, XxHash, Uncompressed,
+    )
+    w = CompactWriter()
+    BloomFilterHeader(
+        numBytes=40,
+        algorithm=BloomFilterAlgorithm(BLOCK=SplitBlockAlgorithm()),
+        hash=BloomFilterHash(XXHASH=XxHash()),
+        compression=BloomFilterCompression(UNCOMPRESSED=Uncompressed()),
+    ).write(w)
+    with pytest.raises(ValueError, match="invalid bloom filter size"):
+        SplitBlockBloomFilter.from_bytes(w.getvalue() + b"\0" * 40)

@@ -393,11 +393,12 @@ class ColumnDescriptor:
 class MessageType(GroupType):
     """Root of a schema tree."""
 
-    __slots__ = ("_columns",)
+    __slots__ = ("_columns", "_by_path")
 
     def __init__(self, name: str, fields: Sequence[SchemaNode]):
         super().__init__(name, fields, repetition=REQUIRED)
         self._columns = None
+        self._by_path = None
 
     @property
     def columns(self) -> List[ColumnDescriptor]:
@@ -426,10 +427,14 @@ class MessageType(GroupType):
     def column(self, path) -> ColumnDescriptor:
         if isinstance(path, str):
             path = tuple(path.split("."))
-        for c in self.columns:
-            if c.path == tuple(path):
-                return c
-        raise KeyError(f"no column {path!r} in schema {self.name!r}")
+        if self._by_path is None:
+            self._by_path = {c.path: c for c in self.columns}
+        try:
+            return self._by_path[tuple(path)]
+        except KeyError:
+            raise KeyError(
+                f"no column {path!r} in schema {self.name!r}"
+            ) from None
 
     @property
     def is_flat(self) -> bool:

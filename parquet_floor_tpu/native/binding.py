@@ -135,6 +135,16 @@ def _register(lib):
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_size_t,  # out table, cap
         ctypes.POINTER(ctypes.c_longlong),  # out_runs[]
     ]
+    lib.pftpu_delta_parse_plan.restype = ctypes.c_ssize_t
+    lib.pftpu_delta_parse_plan.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,   # data
+        ctypes.c_int, ctypes.c_int,         # value_bytes, allow_wide
+        ctypes.POINTER(ctypes.c_longlong),  # mb_byte[]
+        ctypes.POINTER(ctypes.c_longlong),  # mb_bw[]
+        ctypes.POINTER(ctypes.c_longlong),  # mb_min[]
+        ctypes.c_size_t,                    # cap_rows
+        ctypes.POINTER(ctypes.c_longlong),  # out_scalars[5]
+    ]
     lib.pftpu_lz4_decompress.restype = ctypes.c_ssize_t
     lib.pftpu_lz4_decompress.argtypes = [
         ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
@@ -439,3 +449,49 @@ def rle_parse_runs_batch(data, pos, counts, bws):
         if n < 0:
             raise ValueError("native RLE batch parse failed")
         return table[:n], runs
+
+
+def delta_parse_plan(data, value_bytes: int, allow_wide: bool):
+    """Native DELTA_BINARY_PACKED plan parse (tpu/engine.py twin).
+
+    Returns the plan dict, or None for malformed/unsupported streams
+    (the caller's host-fallback signal)."""
+    import numpy as np
+
+    lib = _load()
+    if isinstance(data, np.ndarray):
+        arr = data if (data.dtype == np.uint8 and data.flags.c_contiguous) else (
+            np.ascontiguousarray(data).view(np.uint8)
+        )
+    else:
+        arr = np.frombuffer(data, dtype=np.uint8)
+    ll = ctypes.POINTER(ctypes.c_longlong)
+    cap = 4096
+    while True:
+        mb_byte = np.empty(cap, np.int64)
+        mb_bw = np.empty(cap, np.int64)
+        mb_min = np.empty(cap, np.int64)
+        scalars = np.zeros(5, np.int64)
+        n = lib.pftpu_delta_parse_plan(
+            arr.ctypes.data, len(arr), value_bytes, int(allow_wide),
+            mb_byte.ctypes.data_as(ll), mb_bw.ctypes.data_as(ll),
+            mb_min.ctypes.data_as(ll), cap, scalars.ctypes.data_as(ll),
+        )
+        if n == -2:
+            cap *= 4
+            continue
+        if n < 0:
+            return None
+        k = max(int(n), 1)
+        if n == 0:
+            mb_byte[0] = mb_bw[0] = mb_min[0] = 0
+        return {
+            "mb_bytebase": mb_byte[:k].copy(),
+            "mb_bw": mb_bw[:k].copy(),
+            "mb_min_delta": mb_min[:k].copy(),
+            "first_value": int(scalars[0]),
+            "values_per_miniblock": int(scalars[1]),
+            "total": int(scalars[2]),
+            "end_pos": int(scalars[3]),
+            "wide": bool(scalars[4]),
+        }

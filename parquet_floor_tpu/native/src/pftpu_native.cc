@@ -45,6 +45,30 @@ static inline ptrdiff_t varint_decode(const uint8_t* p, const uint8_t* end,
   return -1;
 }
 
+// Full-width variant for DELTA_BINARY_PACKED headers (first_value and
+// min_delta are 64-bit zigzags, up to 10 bytes).  Varints carrying bits
+// past 2^63 are nonconforming; reporting them malformed (-1) routes the
+// column to the host decoder, whose unbounded-precision walk defines the
+// semantics — identical behavior with or without the native library.
+static inline ptrdiff_t varint_decode64(const uint8_t* p, const uint8_t* end,
+                                        uint64_t* out) {
+  uint64_t result = 0;
+  int shift = 0;
+  const uint8_t* start = p;
+  while (p < end && shift <= 63) {
+    const uint8_t b = *p++;
+    const uint64_t payload = b & 0x7F;
+    if (shift == 63 && (payload >> 1)) return -1;  // bits past 2^63
+    result |= payload << shift;
+    if (!(b & 0x80)) {
+      *out = result;
+      return p - start;
+    }
+    shift += 7;
+  }
+  return -1;
+}
+
 size_t pftpu_snappy_max_compressed_size(size_t n) {
   // worst case: all literals + tag overhead + length varint
   return 32 + n + n / 6;
@@ -393,6 +417,105 @@ ptrdiff_t pftpu_rle_parse_runs_batch(const uint8_t* data, size_t data_len,
     used += static_cast<size_t>(r);
   }
   return static_cast<ptrdiff_t>(used);
+}
+
+// ---------------------------------------------------------------------------
+// DELTA_BINARY_PACKED plan parse (device staging phase 1): the varint/
+// miniblock walk that was staging's hottest pure-Python loop on wide
+// tables.  Mirrors tpu/engine.py parse_delta_plan exactly, including the
+// interval-arithmetic proof that the int32 device fast path is exact.
+// ---------------------------------------------------------------------------
+
+// out_scalars: [first_value, values_per_miniblock, total, end_pos, wide].
+// Returns the miniblock count, -1 for malformed-or-unsupported (caller
+// falls back to the host decoder), -2 when cap_rows is too small.
+ptrdiff_t pftpu_delta_parse_plan(const uint8_t* data, size_t data_len,
+                                 int value_bytes, int allow_wide,
+                                 long long* mb_byte, long long* mb_bw,
+                                 long long* mb_min, size_t cap_rows,
+                                 long long* out_scalars) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + data_len;
+  uint64_t block_size, n_mini, total_u, first_u;
+  ptrdiff_t u;
+  if ((u = varint_decode64(p, end, &block_size)) < 0) return -1;
+  p += u;
+  if ((u = varint_decode64(p, end, &n_mini)) < 0) return -1;
+  p += u;
+  if ((u = varint_decode64(p, end, &total_u)) < 0) return -1;
+  p += u;
+  if ((u = varint_decode64(p, end, &first_u)) < 0) return -1;
+  p += u;
+  const long long first =
+      static_cast<long long>((first_u >> 1) ^ (0ULL - (first_u & 1)));
+  if (n_mini == 0 || n_mini > (1u << 16) || block_size % n_mini) return -1;
+  const uint64_t per_mini = block_size / n_mini;
+  if (per_mini == 0 || per_mini > (1u << 24)) return -1;  // hostile header
+  const long long I32MIN = -(1LL << 31), I32MAX = (1LL << 31) - 1;
+  const int check_range = value_bytes > 4;
+  int wide = (first < I32MIN || first > I32MAX) ? 1 : 0;
+  if (wide && !allow_wide) return -1;
+  __int128 lo = first, hi = first;  // reachable prefix-sum interval
+  const long long total = static_cast<long long>(total_u);
+  if (total < 0) return -1;
+  const long long n_deltas = total - 1;
+  long long got = 0;
+  size_t rows = 0;
+  while (got < n_deltas) {
+    uint64_t md_u;
+    if ((u = varint_decode64(p, end, &md_u)) < 0) return -1;
+    p += u;
+    const long long min_delta =
+        static_cast<long long>((md_u >> 1) ^ (0ULL - (md_u & 1)));
+    if (min_delta < I32MIN || min_delta > I32MAX) {
+      if (!allow_wide) return -1;
+      wide = 1;
+    }
+    if (static_cast<size_t>(end - p) < n_mini) return -1;
+    const uint8_t* widths = p;
+    p += n_mini;
+    for (uint64_t m = 0; m < n_mini && got < n_deltas; m++) {
+      const int bwm = widths[m];
+      if (bwm > 64) return -1;  // malformed: spec caps deltas at 64 bits
+      if (bwm > 32) {
+        if (!allow_wide) return -1;
+        wide = 1;
+      }
+      const long long left = n_deltas - got;
+      const long long count =
+          left < static_cast<long long>(per_mini)
+              ? left
+              : static_cast<long long>(per_mini);
+      if (check_range && !wide) {
+        const __int128 d_lo = min_delta;
+        const __int128 d_hi =
+            static_cast<__int128>(min_delta) +
+            ((static_cast<__int128>(1) << bwm) - 1);
+        if (d_lo < 0) lo += static_cast<__int128>(count) * d_lo;
+        if (d_hi > 0) hi += static_cast<__int128>(count) * d_hi;
+        if (lo < I32MIN || hi > I32MAX) {
+          if (!allow_wide) return -1;
+          wide = 1;
+        }
+      }
+      if (rows >= cap_rows) return -2;
+      mb_byte[rows] = p - data;
+      mb_bw[rows] = bwm;
+      mb_min[rows] = min_delta;
+      rows++;
+      got += count;
+      const long long nbytes =
+          static_cast<long long>(per_mini) * bwm / 8;
+      if (static_cast<long long>(end - p) < nbytes) return -1;
+      p += nbytes;
+    }
+  }
+  out_scalars[0] = first;
+  out_scalars[1] = static_cast<long long>(per_mini);
+  out_scalars[2] = total;
+  out_scalars[3] = p - data;
+  out_scalars[4] = wide;
+  return static_cast<ptrdiff_t>(rows);
 }
 
 // ---------------------------------------------------------------------------

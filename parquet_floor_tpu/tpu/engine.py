@@ -1356,6 +1356,16 @@ def parse_delta_plan(data_u8: np.ndarray, dtype, allow_wide=False) -> Optional[d
     arithmetic over every reachable *prefix sum*); True = full int64
     reconstruction (miniblock widths ≤ 64, any first/min_delta).  Without
     ``allow_wide`` the wide cases return None instead."""
+    try:
+        from ..native import binding as _nb
+    except ImportError:  # pragma: no cover - native lib is optional
+        _nb = None
+    if _nb is not None and _nb.available():
+        # native twin of the walk below (the varint/miniblock scan was
+        # staging's hottest pure-Python loop on 1000-column tables)
+        return _nb.delta_parse_plan(
+            data_u8, np.dtype(dtype).itemsize, allow_wide
+        )
     data = bytes(data_u8)
     pos = 0
     block_size, pos = e_rle._read_varint(data, pos)

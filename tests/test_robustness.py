@@ -99,3 +99,33 @@ def test_crc_verification_catches_payload_flip(valid_file, tmp_path):
             for batch in r.iter_row_groups():
                 for c in batch.columns:
                     _ = c.values
+
+
+def test_native_delta_plan_survives_hostile_bytes():
+    """The native DELTA plan parser must reject garbage/truncations with
+    None (host fallback), never crash or loop."""
+    from parquet_floor_tpu.native import binding as nb
+
+    if not nb.available():
+        pytest.skip("native library not built")
+    from parquet_floor_tpu.format.encodings import delta as e_delta
+
+    r = np.random.default_rng(13)
+    # pure garbage
+    for n in (0, 1, 7, 64, 1000):
+        buf = r.integers(0, 256, n).astype(np.uint8)
+        nb.delta_parse_plan(buf, 8, True)  # any result ok; no crash
+    # truncations and bit flips of a real stream
+    vals = r.integers(-(2**40), 2**40, 5000)
+    stream = np.frombuffer(e_delta.encode_delta_binary_packed(vals), np.uint8)
+    for cut in (1, 5, len(stream) // 2, len(stream) - 1):
+        nb.delta_parse_plan(stream[:cut], 8, True)
+    for _ in range(50):
+        bad = stream.copy()
+        i = int(r.integers(0, len(bad)))
+        bad[i] ^= np.uint8(1 << int(r.integers(0, 8)))
+        got = nb.delta_parse_plan(bad, 8, True)
+        if got is not None:
+            # parse succeeded: plan fields must at least be self-consistent
+            assert got["values_per_miniblock"] > 0
+            assert len(got["mb_bw"]) >= 1

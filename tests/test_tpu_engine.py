@@ -737,3 +737,42 @@ def test_fill_chunks_covers_every_job(tmp_path):
     for (s0, e0), (s1, e1) in zip(spans, spans[1:]):
         assert e0 == s1
     assert all(e - s == 4096 for s, e in spans[:-1])
+
+
+def test_native_delta_plan_matches_python():
+    """The native DELTA plan parser must agree with the Python walk on
+    every field, including the wide/int32-exactness decision."""
+    from parquet_floor_tpu.format.encodings import delta as e_delta
+    from parquet_floor_tpu.native import binding as nb
+    import parquet_floor_tpu.tpu.engine as eng
+
+    if not nb.available():
+        pytest.skip("native library not built")
+    r = np.random.default_rng(5)
+    cases = []
+    for dt, lohi in [
+        (np.int32, (-(2**31), 2**31 - 1)),
+        (np.int64, (-(2**31), 2**31 - 1)),       # narrow int64 -> fast path
+        (np.int64, (-(2**62), 2**62)),           # wide int64
+    ]:
+        for n in (1, 2, 100, 5000):
+            vals = r.integers(lohi[0], lohi[1], n).astype(dt)
+            cases.append((vals, dt))
+    cases.append((np.arange(3, dtype=np.int64) + 2**40, np.int64))
+    for vals, dt in cases:
+        stream = e_delta.encode_delta_binary_packed(vals)
+        buf = np.frombuffer(stream, np.uint8)
+        wide_ok = np.dtype(dt).itemsize > 4
+        got = nb.delta_parse_plan(buf, np.dtype(dt).itemsize, wide_ok)
+        # force the Python walk for the reference result
+        import unittest.mock as mock
+        with mock.patch.object(nb, "available", lambda: False):
+            want = eng.parse_delta_plan(buf, dt, allow_wide=wide_ok)
+        assert (got is None) == (want is None), (dt, len(vals))
+        if got is None:
+            continue
+        for key in ("first_value", "values_per_miniblock", "total",
+                    "end_pos", "wide"):
+            assert got[key] == want[key], (key, dt, len(vals))
+        for key in ("mb_bytebase", "mb_bw", "mb_min_delta"):
+            np.testing.assert_array_equal(got[key], want[key], err_msg=key)

@@ -135,6 +135,17 @@ def _register(lib):
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_size_t,  # out table, cap
         ctypes.POINTER(ctypes.c_longlong),  # out_runs[]
     ]
+    lib.pftpu_rle_plan5_batch.restype = ctypes.c_ssize_t
+    lib.pftpu_rle_plan5_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,   # data
+        ctypes.c_longlong,                  # n_streams
+        ctypes.POINTER(ctypes.c_longlong),  # pos[]
+        ctypes.POINTER(ctypes.c_longlong),  # counts[]
+        ctypes.POINTER(ctypes.c_longlong),  # bws[]
+        ctypes.c_longlong,                  # total
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_longlong,  # plan, pad
+        ctypes.POINTER(ctypes.c_longlong),  # rows_needed out
+    ]
     lib.pftpu_delta_parse_plan.restype = ctypes.c_ssize_t
     lib.pftpu_delta_parse_plan.argtypes = [
         ctypes.c_void_p, ctypes.c_size_t,   # data
@@ -449,6 +460,56 @@ def rle_parse_runs_batch(data, pos, counts, bws):
         if n < 0:
             raise ValueError("native RLE batch parse failed")
         return table[:n], runs
+
+
+class PlanOverflowNative(ValueError):
+    """Native plan build hit an int32 limit (byte offset or run length);
+    translated by callers into bitops.PlanOverflow."""
+
+
+class PlanPadExceeded(ValueError):
+    """The plan needs more rows than ``pad_runs``; ``needed`` carries the
+    exact count so the caller can re-size in a single retry."""
+
+    def __init__(self, needed: int, pad_runs: int):
+        super().__init__(f"run tables ({needed}) exceed padding ({pad_runs})")
+        self.needed = needed
+
+
+def rle_plan5_batch(data, pos, counts, bws, total: int, pad_runs: int):
+    """Build the flat 5×pad int32 device plan for many streams in one
+    native pass.  Returns (plan int32[5*pad], rows_used)."""
+    import numpy as np
+
+    lib = _load()
+    if isinstance(data, np.ndarray):
+        arr = data if (data.dtype == np.uint8 and data.flags.c_contiguous) else (
+            np.ascontiguousarray(data).view(np.uint8)
+        )
+    else:
+        arr = np.frombuffer(data, dtype=np.uint8)
+    pos = np.ascontiguousarray(pos, dtype=np.int64)
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    bws = np.ascontiguousarray(bws, dtype=np.int64)
+    ll = ctypes.POINTER(ctypes.c_longlong)
+    plan = np.empty(5 * pad_runs, dtype=np.int32)
+    needed = ctypes.c_longlong(0)
+    n = lib.pftpu_rle_plan5_batch(
+        arr.ctypes.data, len(arr), len(pos),
+        pos.ctypes.data_as(ll), counts.ctypes.data_as(ll),
+        bws.ctypes.data_as(ll), total,
+        plan.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), pad_runs,
+        ctypes.byref(needed),
+    )
+    if n == -4:
+        raise PlanOverflowNative("int32 plan overflow")
+    if n == -2:
+        raise PlanPadExceeded(int(needed.value), pad_runs)
+    if n == -3:
+        raise ValueError(f"run counts do not sum to {total}")
+    if n < 0:
+        raise ValueError("native plan build failed (malformed stream)")
+    return plan, int(n)
 
 
 def delta_parse_plan(data, value_bytes: int, allow_wide: bool):

@@ -419,6 +419,120 @@ ptrdiff_t pftpu_rle_parse_runs_batch(const uint8_t* data, size_t data_len,
   return static_cast<ptrdiff_t>(used);
 }
 
+// Parse many streams straight into the flat 5×pad int32 device plan
+// (out_end, kind, value, bytebase, bw) — the fused-decode operand — in
+// one pass, skipping the intermediate per-stream run tables and the
+// NumPy concat/cumsum/masked-write passes over them.  bws[s] == 0 emits
+// one synthetic RLE run of counts[s] zeros (the dictionary zero-width
+// page case).  Returns rows used; -1 malformed; -2 pad_runs too small
+// (parsing continues without writing so *rows_needed reports the exact
+// row count — the caller re-sizes in one retry); -3 run counts don't
+// sum to total; -4 int32 overflow (byte offset past 2 GiB or a single
+// run past 2^31 within-run bits — PlanOverflow).
+ptrdiff_t pftpu_rle_plan5_batch(const uint8_t* data, size_t data_len,
+                                long long n_streams,
+                                const long long* pos,
+                                const long long* counts,
+                                const long long* bws,
+                                long long total,
+                                int32_t* plan, long long pad_runs,
+                                long long* rows_needed) {
+  int32_t* out_end = plan;
+  int32_t* kind = plan + pad_runs;
+  int32_t* value = plan + 2 * pad_runs;
+  int32_t* bytebase = plan + 3 * pad_runs;
+  int32_t* bwrow = plan + 4 * pad_runs;
+  long long rows = 0;
+  long long cum = 0;
+  int overflowed = 0;  // keep counting so *rows_needed is exact
+  for (long long s = 0; s < n_streams; s++) {
+    if (bws[s] == 0) {
+      cum += counts[s];
+      if (cum > total) return -3;
+      if (rows < pad_runs) {
+        kind[rows] = 0;
+        value[rows] = 0;
+        bytebase[rows] = 0;
+        bwrow[rows] = 0;
+        out_end[rows] = static_cast<int32_t>(cum);
+      } else {
+        overflowed = 1;
+      }
+      rows++;
+      continue;
+    }
+    if (pos[s] < 0 || static_cast<size_t>(pos[s]) > data_len) return -1;
+    const uint8_t* p = data + pos[s];
+    const uint8_t* end = data + data_len;
+    long long remaining = counts[s];
+    const int bw = static_cast<int>(bws[s]);
+    if (bw < 0 || bw > 64) return -1;
+    const int value_bytes = (bw + 7) / 8;
+    while (remaining > 0) {
+      uint64_t header;
+      ptrdiff_t used = varint_decode(p, end, &header);
+      if (used < 0) return -1;
+      p += used;
+      if (header & 1) {
+        const long long groups = static_cast<long long>(header >> 1);
+        if (groups < 0 || groups > static_cast<long long>(data_len)) return -1;
+        const long long n = groups * 8;
+        const long long cnt = n < remaining ? n : remaining;
+        const long long off = p - data;
+        if (off >= (1LL << 31)) return -4;
+        if (cnt * bw >= (1LL << 31)) return -4;
+        cum += cnt;
+        if (cum > total) return -3;
+        if (rows < pad_runs) {
+          kind[rows] = 1;
+          value[rows] = 0;
+          bytebase[rows] = static_cast<int32_t>(off);
+          bwrow[rows] = bw;
+          out_end[rows] = static_cast<int32_t>(cum);
+        } else {
+          overflowed = 1;
+        }
+        rows++;
+        const long long nbytes = groups * bw;
+        if (end - p < nbytes) return -1;
+        p += nbytes;
+        remaining -= n;
+      } else {
+        const long long n = static_cast<long long>(header >> 1);
+        if (n < 0) return -1;
+        if (end - p < value_bytes) return -1;
+        long long v = 0;
+        for (int i = 0; i < value_bytes; i++)
+          v |= static_cast<long long>(p[i]) << (8 * i);
+        p += value_bytes;
+        const long long cnt = n < remaining ? n : remaining;
+        cum += cnt;
+        if (cum > total) return -3;
+        if (rows < pad_runs) {
+          kind[rows] = 0;
+          value[rows] = static_cast<int32_t>(v);  // int32 wrap, as astype
+          bytebase[rows] = 0;
+          bwrow[rows] = bw;
+          out_end[rows] = static_cast<int32_t>(cum);
+        } else {
+          overflowed = 1;
+        }
+        rows++;
+        remaining -= n;
+      }
+    }
+  }
+  if (n_streams > 0 && cum != total) return -3;
+  *rows_needed = rows;
+  if (overflowed) return -2;
+  // pad rows: out_end = total (they own no output), everything else 0
+  for (long long r = rows; r < pad_runs; r++) {
+    out_end[r] = static_cast<int32_t>(total);
+    kind[r] = value[r] = bytebase[r] = bwrow[r] = 0;
+  }
+  return static_cast<ptrdiff_t>(rows);
+}
+
 // ---------------------------------------------------------------------------
 // DELTA_BINARY_PACKED plan parse (device staging phase 1): the varint/
 // miniblock walk that was staging's hottest pure-Python loop on wide

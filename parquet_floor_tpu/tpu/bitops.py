@@ -371,6 +371,15 @@ class PlanOverflow(ValueError):
     decode path should fall back instead of failing."""
 
 
+class PlanPadExceeded(ValueError):
+    """A plan needs more rows than the padded capacity offered; ``needed``
+    carries the exact row count so callers re-size in one retry."""
+
+    def __init__(self, needed: int, pad_runs: int):
+        super().__init__(f"run tables ({needed}) exceed padding ({pad_runs})")
+        self.needed = needed
+
+
 def run_table_to_device_plan(run_table: np.ndarray, num_values: int, pad_runs: int):
     """Convert a ``parse_runs`` table into padded device-ready arrays.
 
@@ -444,6 +453,47 @@ def tables_to_plan5(tables, total: int, pad_runs: int) -> np.ndarray:
             )
         plan[0, :r] = out_end
     return plan.reshape(-1)
+
+
+def plan5_from_streams(data, streams, total: int, pad_runs: int):
+    """Build the flat 5×pad int32 plan for many (pos, count, bw) streams
+    of one buffer — the fast form of ``parse_runs_batch`` +
+    :func:`tables_to_plan5` (one native pass, no intermediate tables).
+
+    A stream with bw == 0 contributes one synthetic RLE run of zeros (the
+    dictionary zero-width page; plan bw row 0, matching the native path).
+    Returns (plan, rows_used); raises :class:`PlanOverflow` when int32
+    limits are exceeded and :class:`PlanPadExceeded` (carrying the exact
+    row count) when ``pad_runs`` is too small."""
+    try:
+        from ..native import binding as _nb
+    except ImportError:  # pragma: no cover - native lib is optional
+        _nb = None
+    if _nb is not None and _nb.available():
+        try:
+            return _nb.rle_plan5_batch(
+                data,
+                [p for p, _, _ in streams],
+                [c for _, c, _ in streams],
+                [b for _, _, b in streams],
+                total, pad_runs,
+            )
+        except _nb.PlanOverflowNative as e:
+            raise PlanOverflow(str(e)) from None
+        except _nb.PlanPadExceeded as e:
+            raise PlanPadExceeded(e.needed, pad_runs) from None
+    from ..format.encodings import rle_hybrid as e_rle
+
+    tables = []
+    for p, c, b in streams:
+        if b == 0:
+            tables.append((np.array([[0, c, 0, 0]], dtype=np.int64), 0))
+        else:
+            tables.append((e_rle.parse_runs(data, c, b, pos=p)[0], b))
+    r = sum(len(t) for t, _ in tables)
+    if r > pad_runs:
+        raise PlanPadExceeded(r, pad_runs)
+    return tables_to_plan5(tables, total, pad_runs), r
 
 
 def pad_to(arr: np.ndarray, size: int, fill=0) -> np.ndarray:

@@ -819,19 +819,17 @@ class _DevStage:
                 else:
                     def_at.append(-1)
                 val_offs.append(p.off)
-        rep_tabs = e_rle.parse_runs_batch(arena, rep_streams)
-        def_tabs = e_rle.parse_runs_batch(arena, def_streams)
-        rep_tables = [(t, rep_bw) for t in rep_tabs]
-        lvl_tables = [(t, def_bw) for t in def_tabs]
         nns: List[int] = []
         for p, da in zip(self.pages, def_at):
             if max_def <= 0:
                 nn = p.n
             elif p.v == 1:
+                # native count_equal scans the stream directly; only the
+                # no-native fallback re-parses runs here (v1 pages are
+                # the legacy minority — acceptable there)
                 pos_s, _, _ = def_streams[da]
                 nn = e_rle.count_equal(
                     arena, p.n, def_bw, max_def, pos=pos_s,
-                    run_table=def_tabs[da],
                 )
             else:
                 nn = p.nn
@@ -843,57 +841,43 @@ class _DevStage:
             nexp=n, max_rep=max_rep,
         )
         if max_def > 0:
-            r_lvl = eng._hwm(("r_lvl", self.name), sum(len(t) for t, _ in lvl_tables))
-            plan = bitops.tables_to_plan5(lvl_tables, n, r_lvl)
+            plan, r_lvl = eng._build_plan5(
+                ("r_lvl", self.name), arena, def_streams, n
+            )
             spec["lvl_off"] = slabb.add(plan)
             spec["r_lvl"] = r_lvl
             spec["nexp"] = eng._hwm(("nexp", self.name), total_nn)
             spec["pl_lvl"] = eng._pallas_plan(plan, r_lvl, n, def_bw, slabb)
         if max_rep > 0:
-            r_rep = eng._hwm(("r_rep", self.name), sum(len(t) for t, _ in rep_tables))
-            plan = bitops.tables_to_plan5(rep_tables, n, r_rep)
+            plan, r_rep = eng._build_plan5(
+                ("r_rep", self.name), arena, rep_streams, n
+            )
             spec["rep_off"] = slabb.add(plan)
             spec["r_rep"] = r_rep
             spec["pl_rep"] = eng._pallas_plan(plan, r_rep, n, rep_bw, slabb)
 
         if self.kind in ("dict", "dict_str"):
-            # collect every page's index stream, parse in one batch call
+            # collect every page's index stream; the plan builds in one
+            # native pass (a bw-0 stream = the all-index-0 page case)
             idx_streams: List[tuple] = []
-            idx_slot: List = []    # stream index | ("zero", nn) | None
+            idx_bws = set()
             for p, val_off, nn in zip(self.pages, val_offs, nns):
                 if nn == 0:
                     # all-null page: no value section — don't even probe
                     # the bit-width byte (it would read the next page)
-                    idx_slot.append(None)
                     continue
                 page_bw = int(arena[val_off])
                 if page_bw > 32:
                     raise _ForceHost(self.name)
-                if page_bw == 0:
-                    # all values are index 0: empty table rows expand to
-                    # zeros via the plan's RLE padding
-                    idx_slot.append(("zero", nn))
-                    continue
-                idx_slot.append((len(idx_streams), page_bw))
                 idx_streams.append((val_off + 1, nn, page_bw))
-            idx_tabs = e_rle.parse_runs_batch(arena, idx_streams)
-            idx_tables = []
-            for slot in idx_slot:
-                if slot is None:
-                    continue
-                if slot[0] == "zero":
-                    idx_tables.append(
-                        (np.array([[0, slot[1], 0, 0]], dtype=np.int64), 1)
-                    )
-                else:
-                    idx_tables.append((idx_tabs[slot[0]], slot[1]))
-            r_idx = eng._hwm(
-                ("r_idx", self.name), sum(len(t) for t, _ in idx_tables)
+                # zero-width pages count as width-1 for the uniformity
+                # check (their runs are pure RLE; any kernel width fits)
+                idx_bws.add(page_bw or 1)
+            plan, r_idx = eng._build_plan5(
+                ("r_idx", self.name), arena, idx_streams, total_nn
             )
-            plan = bitops.tables_to_plan5(idx_tables, total_nn, r_idx)
             spec["idx_off"] = slabb.add(plan)
             spec["r_idx"] = r_idx
-            idx_bws = {b for _, b in idx_tables}
             if len(idx_bws) == 1:  # uniform width across the chunk's pages
                 spec["pl_idx"] = eng._pallas_plan(
                     plan, r_idx, spec["nexp"], idx_bws.pop(), slabb
@@ -1818,6 +1802,22 @@ class TpuRowGroupReader:
                 # (e.g. >32-bit delta range) skips the device attempt in
                 # every later row group instead of staging the group twice
                 self._forced.add(e.key)
+
+    def _build_plan5(self, key: tuple, arena, streams, total: int):
+        """``bitops.plan5_from_streams`` padded to the column's sticky
+        HWM bucket, growing the bucket when the run count exceeds it
+        (the overflow carries the exact count — at most one retry).
+        Returns ``(flat int32 plan, pad_runs)``."""
+        need = 16
+        while True:
+            pad = self._hwm(key, need)
+            try:
+                plan, _used = bitops.plan5_from_streams(
+                    arena, streams, total, pad
+                )
+                return plan, pad
+            except bitops.PlanPadExceeded as e:
+                need = e.needed
 
     def _pallas_plan(self, plan: np.ndarray, n_runs: int, count: int,
                      bw: int, slabb: _I32Builder):

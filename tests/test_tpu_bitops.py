@@ -120,3 +120,62 @@ def test_plan_offsets_beyond_256mib():
     assert plan["run_bytebase"][0] == off
     flat = bitops.tables_to_plan5([(table, 7)], 64, 4)
     assert flat.reshape(5, 4)[3, 0] == off
+
+
+def test_plan5_native_matches_fallback():
+    """plan5_from_streams: native one-pass plan must be byte-identical to
+    the table-based Python fallback, including synthetic bw-0 streams."""
+    import unittest.mock as mock
+
+    from parquet_floor_tpu.format.encodings import rle_hybrid as e_rle
+    from parquet_floor_tpu.native import binding as nb
+
+    if not nb.available():
+        pytest.skip("native library not built")
+    r = np.random.default_rng(9)
+    buf = bytearray()
+    streams = []
+    total = 0
+    for bw, n in [(3, 700), (13, 2048), (1, 50), (0, 33), (24, 999)]:
+        if bw == 0:
+            streams.append((0, n, 0))
+            total += n
+            continue
+        vals = r.integers(0, 1 << bw, n).astype(np.uint32)
+        vals[5:40] = 2  # carve an RLE run
+        enc = e_rle.encode_rle_hybrid(vals, bw)
+        streams.append((len(buf), n, bw))
+        buf.extend(enc)
+        total += n
+    data = np.frombuffer(bytes(buf) + b"\0" * 8, np.uint8)
+    pad = 4096
+    got, gr = bitops.plan5_from_streams(data, streams, total, pad)
+    with mock.patch.object(nb, "available", lambda: False):
+        want, wr = bitops.plan5_from_streams(data, streams, total, pad)
+    assert gr == wr
+    np.testing.assert_array_equal(got, want)
+
+
+def test_plan5_errors():
+    from parquet_floor_tpu.format.encodings import rle_hybrid as e_rle
+    from parquet_floor_tpu.native import binding as nb
+
+    if not nb.available():
+        pytest.skip("native library not built")
+    vals = (np.arange(5000) % 97).astype(np.uint32)
+    enc = e_rle.encode_rle_hybrid(vals, 7)
+    data = np.frombuffer(bytes(enc) + b"\0" * 8, np.uint8)
+    # pad too small: exact needed count reported, one retry suffices
+    with pytest.raises(bitops.PlanPadExceeded) as ei:
+        bitops.plan5_from_streams(data, [(0, 5000, 7)], 5000, 4)
+    needed = ei.value.needed
+    plan, r = bitops.plan5_from_streams(data, [(0, 5000, 7)], 5000, needed)
+    assert r == needed
+    # counts that don't sum to total
+    with pytest.raises(ValueError, match="sum"):
+        bitops.plan5_from_streams(data, [(0, 5000, 7)], 4999, needed)
+    # malformed stream
+    with pytest.raises(ValueError):
+        bitops.plan5_from_streams(
+            np.frombuffer(b"\xff" * 4, np.uint8), [(0, 100, 7)], 100, 64
+        )

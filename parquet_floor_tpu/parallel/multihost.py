@@ -68,6 +68,39 @@ def _dtype_from_code(kind: int, size: int):
     return np.dtype(f"{chr(kind)}{size}")
 
 
+def _schema_meta(desc, float64_policy: str):
+    """Column shape facts derived from the schema alone — used when no
+    host decoded the column (every group pruned or a 0-group file), so
+    typed ghost columns still carry the right kind/dtype.  Mirrors the
+    engine's output types: (rep, strings, width, vmax, lmax, trail,
+    vdtype)."""
+    from ..format.parquet_thrift import Type
+
+    pt = desc.physical_type
+    rep = int(desc.max_repetition_level > 0)
+    strings = int(pt == Type.BYTE_ARRAY)
+    trail = 0
+    if pt == Type.BOOLEAN:
+        vdtype = np.bool_
+    elif pt == Type.INT32:
+        vdtype = np.int32
+    elif pt == Type.INT64:
+        vdtype = np.int64
+    elif pt == Type.FLOAT:
+        vdtype = np.float32
+    elif pt == Type.DOUBLE:
+        vdtype = np.float32 if float64_policy == "float32" else (
+            np.int64 if float64_policy == "bits" else np.float64
+        )
+    elif pt in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
+        vdtype = np.uint8
+        trail = desc.type_length or (12 if pt == Type.INT96 else 1)
+    else:
+        vdtype = np.uint8
+    # minimum-1 pads keep zero-decoded nested/string shapes well-formed
+    return rep, strings, 1, 1, 1, trail, vdtype
+
+
 @dataclass
 class GlobalColumn:
     """A globally-sharded decoded column: dense values + null mask.
@@ -90,6 +123,7 @@ def read_sharded_global(
     axis: str = "rg",
     columns: Optional[Sequence[str]] = None,
     float64_policy: str = "auto",
+    predicate=None,
 ) -> Dict[str, object]:
     """Decode a parquet file into global arrays sharded over ``mesh[axis]``.
 
@@ -99,6 +133,12 @@ def read_sharded_global(
     and repeated columns (:class:`~parquet_floor_tpu.parallel.shard.
     ShardedNestedColumn`, sharded at the row-group grain).  Ragged files
     pad to a per-group stride with a ``row_mask`` instead of raising.
+
+    ``predicate`` (see ``batch.predicate.col``) prunes row groups whose
+    statistics/Bloom filters prove no row can match — before any page is
+    read or shipped.  Pruned groups stay in the global layout as ghost
+    slots (``row_mask`` False), so shardings are identical on every
+    process regardless of which groups its predicate dropped.
     """
     from ..tpu.engine import TpuRowGroupReader
 
@@ -111,6 +151,26 @@ def read_sharded_global(
         rgs = reader.reader.row_groups
         n_groups = len(rgs)
         rows_per = [int(rg.num_rows or 0) for rg in rgs]
+        keep = (
+            set(predicate.row_groups(reader.reader))
+            if predicate is not None
+            else None
+        )
+        if keep is not None and n_groups:
+            # agree the keep set over DCN (union = elementwise max): a
+            # transient I/O failure during a Bloom probe makes one host
+            # conservatively keep a group — every host must then decode
+            # it, or shard shapes/num_rows diverge across processes
+            vec = np.zeros(n_groups, np.int64)
+            vec[sorted(keep)] = 1
+            agreed = _agree_max(vec)
+            keep = {g for g in range(n_groups) if agreed[g]}
+        if keep is not None:
+            # pruned rows leave the result: zero their counts so num_rows
+            # and the ghost row_mask reflect only surviving groups
+            rows_per = [
+                r if g in keep else 0 for g, r in enumerate(rows_per)
+            ]
         per_axis = max(1, -(-n_groups // n_axis))
         g_pad = per_axis * n_axis
         if g_pad % n_proc:
@@ -119,14 +179,18 @@ def read_sharded_global(
                 f"{n_proc} processes"
             )
         stride = max(rows_per) if rows_per else 0
-        uniform = g_pad == n_groups and len(set(rows_per)) <= 1
+        uniform = (
+            g_pad == n_groups
+            and len(set(rows_per)) <= 1
+            and (keep is None or len(keep) == n_groups)
+        )
         k = g_pad // n_proc
         mine = [g for g in range(pid * k, (pid + 1) * k)]
 
         decoded: Dict[int, Dict[str, object]] = {
             g: reader.read_row_group(g, columns)
             for g in mine
-            if g < n_groups
+            if g < n_groups and (keep is None or g in keep)
         }
         # column names must agree across hosts even when a host owns only
         # ghost groups: derive them from the schema, mirroring the engine's
@@ -175,7 +239,16 @@ def read_sharded_global(
             rep_flag, str_flag, any_mask, width, vmax, lmax, trail, kind, size = (
                 int(v) for v in meta[ci]
             )
-            vdtype = np.uint8 if str_flag else _dtype_from_code(kind, size)
+            if kind == 0:
+                # NO host decoded this column anywhere (e.g. the predicate
+                # pruned every row group): derive shape facts from the
+                # schema instead of the zeroed agreement vector, so typed
+                # ghosts still come back as the right column kind
+                rep_flag, str_flag, width, vmax, lmax, trail, vdtype = (
+                    _schema_meta(descs[ci], reader.float64_policy)
+                )
+            else:
+                vdtype = np.uint8 if str_flag else _dtype_from_code(kind, size)
             if rep_flag:
                 out[name] = _nested_global(
                     parts, mine, rows_per, sharding,

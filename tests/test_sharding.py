@@ -280,3 +280,67 @@ def test_read_sharded_global_nested_group_leaf(tmp_path):
         else np.ones(128, bool)
     )
     np.testing.assert_array_equal(np.asarray(c.values)[rm], np.arange(128))
+
+
+def test_read_sharded_global_with_predicate(tmp_path):
+    """Predicate-pruned groups become masked ghost slots: identical global
+    layout on every process, surviving rows intact, num_rows adjusted."""
+    from parquet_floor_tpu import ParquetFileWriter, WriterOptions, col, types
+    from parquet_floor_tpu.parallel.multihost import read_sharded_global
+    from parquet_floor_tpu.parallel.shard import make_mesh
+
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    path = tmp_path / "g.parquet"
+    with ParquetFileWriter(path, schema, WriterOptions()) as w:
+        for g in range(4):
+            base = g * 1000
+            w.write_columns({
+                "k": np.arange(base, base + 500, dtype=np.int64),
+                "s": [f"g{g}_{i}" for i in range(500)],
+            })
+    mesh = make_mesh(8, rg=8, seq=1, dict_=1)
+    out = read_sharded_global(path, mesh, predicate=col("k") >= 2000)
+    kcol = out["k"]
+    assert kcol.num_rows == 1000  # groups 2 and 3 survive
+    rm = np.asarray(kcol.row_mask)
+    vals = np.asarray(kcol.values)
+    assert rm.sum() == 1000
+    np.testing.assert_array_equal(
+        np.sort(vals[rm]), np.arange(2000, 2500).tolist() + np.arange(3000, 3500).tolist()
+    )
+    # strings survive too, and pruned slots are fully masked
+    scol = out["s"]
+    srm = np.asarray(scol.row_mask)
+    assert srm.sum() == 1000
+    lens = np.asarray(scol.lengths)
+    assert (lens[~srm] == 0).all()
+
+
+def test_read_sharded_global_all_pruned(tmp_path):
+    """A predicate excluding every group still yields correctly-typed
+    ghost columns (schema-derived metadata, all rows masked)."""
+    from parquet_floor_tpu import ParquetFileWriter, WriterOptions, col, types
+    from parquet_floor_tpu.parallel.multihost import read_sharded_global
+    from parquet_floor_tpu.parallel.shard import make_mesh
+
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    path = tmp_path / "ap.parquet"
+    with ParquetFileWriter(path, schema, WriterOptions()) as w:
+        for g in range(2):
+            w.write_columns({"k": np.arange(100, dtype=np.int64),
+                             "s": [f"x{i}" for i in range(100)]})
+    mesh = make_mesh(8, rg=8, seq=1, dict_=1)
+    out = read_sharded_global(path, mesh, predicate=col("k") == 10_000)
+    kcol, scol = out["k"], out["s"]
+    assert kcol.num_rows == 0 and not np.asarray(kcol.row_mask).any()
+    assert np.asarray(kcol.values).dtype == np.int64
+    assert scol.lengths is not None  # still a string column
+    assert not np.asarray(scol.row_mask).any()

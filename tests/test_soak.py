@@ -60,7 +60,7 @@ def _random_column(rng, n, idx):
 
 
 @pytest.mark.parametrize("seed", range(18))
-def test_random_roundtrip(tmp_path, seed):
+def test_random_roundtrip(tmp_path, seed, monkeypatch):
     rng = np.random.default_rng(seed)
     n = int(rng.integers(1, 4000))
     n_cols = int(rng.integers(1, 6))
@@ -71,6 +71,23 @@ def test_random_roundtrip(tmp_path, seed):
         names.append(name)
         datas.append(data)
     schema = types.message("t", *fields)
+    # randomly bloom-filter the non-boolean columns (write + read below)
+    bloom_cols = None
+    if rng.integers(0, 2):
+        bloom_cols = {
+            nm: True
+            for nm, d in zip(names, datas)
+            if not any(isinstance(v, bool) for v in d if v is not None)
+        } or None
+    # randomly exercise the chunked fill-and-ship staging path (only
+    # meaningful via read_row_group — the pipelined iterator disables
+    # intra-group chunking by design, so force the direct path below)
+    chunked = bool(rng.integers(0, 2))
+    if chunked:
+        import parquet_floor_tpu.tpu.engine as _eng
+
+        monkeypatch.setenv("PFTPU_CHUNKED_SHIP", "1")
+        monkeypatch.setattr(_eng, "_SHIP_CHUNK", 1 << 14)
     opts = WriterOptions(
         codec=int(rng.choice(_CODECS)),
         page_version=int(rng.choice([1, 2])),
@@ -80,6 +97,7 @@ def test_random_roundtrip(tmp_path, seed):
         byte_stream_split_floats=bool(rng.integers(0, 2)),
         delta_strings=bool(rng.integers(0, 2)),
         row_group_rows=int(rng.choice([n, max(1, n // 3)])),
+        bloom_filter_columns=bloom_cols,
     )
     path = str(tmp_path / f"soak{seed}.parquet")
     with ParquetFileWriter(path, schema, opts) as w:
@@ -121,11 +139,19 @@ def test_random_roundtrip(tmp_path, seed):
         for nm, exp in zip(names, datas):
             assert per_col[nm] == exp, f"seed {seed} host col {nm}"
 
-    # oracle 3: TPU engine matches the host dense forms
+    # oracle 3: TPU engine matches the host dense forms — alternating
+    # between direct group reads and the pipelined iterator (stage ‖
+    # ship ‖ decode workers) so both decode paths stay covered
     with TpuRowGroupReader(path, float64_policy="float64") as tr, \
             ParquetFileReader(path) as hr:
+        if seed % 2 and not chunked:
+            dev_groups = list(tr.iter_row_groups())
+        else:
+            dev_groups = [
+                tr.read_row_group(gi) for gi in range(tr.num_row_groups)
+            ]
         for gi in range(tr.num_row_groups):
-            dev = tr.read_row_group(gi)
+            dev = dev_groups[gi]
             hb = hr.read_row_group(gi)
             for cb in hb.columns:
                 nm = cb.descriptor.path[0]
@@ -148,6 +174,24 @@ def test_random_roundtrip(tmp_path, seed):
                     np.testing.assert_array_equal(
                         got, dense, err_msg=f"seed {seed} {nm}"
                     )
+
+    # oracle 4: bloom filters never produce a false negative on any
+    # value actually present
+    if bloom_cols:
+        from parquet_floor_tpu import col
+
+        with ParquetFileReader(path) as r:
+            for nm, exp in zip(names, datas):
+                if nm not in bloom_cols:
+                    continue
+                present = [v for v in exp if v is not None]
+                if not present:
+                    continue
+                probe = present[int(rng.integers(0, len(present)))]
+                if isinstance(probe, float) and np.isnan(probe):
+                    continue
+                groups = (col(nm) == probe).row_groups(r)
+                assert groups, f"seed {seed} bloom false negative on {nm}"
 
 
 @pytest.mark.parametrize("seed", range(12))

@@ -42,8 +42,9 @@ _pad_np = partial(_pad_rows, xp=np)
 
 
 def _agree_max(matrix: np.ndarray) -> np.ndarray:
-    """Global elementwise max of one small per-host integer matrix —
-    the SINGLE DCN collective of a read (identity under one process)."""
+    """Global elementwise max of one small per-host integer matrix
+    (identity under one process).  A plain read uses exactly one of
+    these; a predicate read adds a second for the keep-set union."""
     arr = np.asarray(matrix, np.int64)
     if jax.process_count() == 1:
         return arr
@@ -61,8 +62,9 @@ def _dtype_code(dt) -> Tuple[int, int]:
 
 
 def _dtype_from_code(kind: int, size: int):
-    if kind == 0:
-        return np.int64  # no host decoded this column anywhere (0 groups)
+    # kind 0 (no host decoded the column) is intercepted by the
+    # _schema_meta path before this is ever consulted
+    assert kind != 0, "ghost columns resolve dtypes via _schema_meta"
     if chr(kind) == "b":
         return np.bool_
     return np.dtype(f"{chr(kind)}{size}")
@@ -75,6 +77,7 @@ def _schema_meta(desc, float64_policy: str):
     engine's output types: (rep, strings, width, vmax, lmax, trail,
     vdtype)."""
     from ..format.parquet_thrift import Type
+    from ..tpu.engine import _NP_DTYPE  # the authoritative decode dtypes
 
     pt = desc.physical_type
     rep = int(desc.max_repetition_level > 0)
@@ -82,16 +85,13 @@ def _schema_meta(desc, float64_policy: str):
     trail = 0
     if pt == Type.BOOLEAN:
         vdtype = np.bool_
-    elif pt == Type.INT32:
-        vdtype = np.int32
-    elif pt == Type.INT64:
-        vdtype = np.int64
-    elif pt == Type.FLOAT:
-        vdtype = np.float32
     elif pt == Type.DOUBLE:
+        # the engine's f64mode applied to its _NP_DTYPE entry
         vdtype = np.float32 if float64_policy == "float32" else (
             np.int64 if float64_policy == "bits" else np.float64
         )
+    elif pt in _NP_DTYPE:
+        vdtype = np.dtype(_NP_DTYPE[pt])
     elif pt in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
         vdtype = np.uint8
         trail = desc.type_length or (12 if pt == Type.INT96 else 1)
@@ -151,26 +151,6 @@ def read_sharded_global(
         rgs = reader.reader.row_groups
         n_groups = len(rgs)
         rows_per = [int(rg.num_rows or 0) for rg in rgs]
-        keep = (
-            set(predicate.row_groups(reader.reader))
-            if predicate is not None
-            else None
-        )
-        if keep is not None and n_groups:
-            # agree the keep set over DCN (union = elementwise max): a
-            # transient I/O failure during a Bloom probe makes one host
-            # conservatively keep a group — every host must then decode
-            # it, or shard shapes/num_rows diverge across processes
-            vec = np.zeros(n_groups, np.int64)
-            vec[sorted(keep)] = 1
-            agreed = _agree_max(vec)
-            keep = {g for g in range(n_groups) if agreed[g]}
-        if keep is not None:
-            # pruned rows leave the result: zero their counts so num_rows
-            # and the ghost row_mask reflect only surviving groups
-            rows_per = [
-                r if g in keep else 0 for g, r in enumerate(rows_per)
-            ]
         per_axis = max(1, -(-n_groups // n_axis))
         g_pad = per_axis * n_axis
         if g_pad % n_proc:
@@ -178,14 +158,35 @@ def read_sharded_global(
                 f"axis of {n_axis} devices is not spread evenly over "
                 f"{n_proc} processes"
             )
+        k = g_pad // n_proc
+        mine = [g for g in range(pid * k, (pid + 1) * k)]
+
+        keep = None
+        if predicate is not None and n_groups:
+            # each host evaluates only ITS block (Bloom probes read from
+            # the file; non-owned verdicts are irrelevant once agreed),
+            # then one union collective reconciles — a transient probe
+            # failure keeps the group conservatively on EVERY host, so
+            # shard shapes/num_rows never diverge across processes
+            vec = np.zeros(n_groups, np.int64)
+            for g in mine:
+                if g < n_groups and predicate.may_match_with(
+                    reader.reader, rgs[g]
+                ):
+                    vec[g] = 1
+            agreed = _agree_max(vec)
+            keep = {g for g in range(n_groups) if agreed[g]}
+            # pruned rows leave the result: zero their counts so num_rows
+            # and the ghost row_mask reflect only surviving groups
+            rows_per = [
+                r if g in keep else 0 for g, r in enumerate(rows_per)
+            ]
         stride = max(rows_per) if rows_per else 0
         uniform = (
             g_pad == n_groups
             and len(set(rows_per)) <= 1
             and (keep is None or len(keep) == n_groups)
         )
-        k = g_pad // n_proc
-        mine = [g for g in range(pid * k, (pid + 1) * k)]
 
         decoded: Dict[int, Dict[str, object]] = {
             g: reader.read_row_group(g, columns)

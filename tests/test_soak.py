@@ -56,7 +56,7 @@ def _random_column(rng, n, idx):
         b = (t.optional if optional else t.required)(t.BYTE_ARRAY).as_(t.string())
         card = int(rng.choice([3, 50, 100_000]))  # low → dict; high → fallback
         data = opt([f"s{int(v)}" for v in rng.integers(0, card, n)])
-    return b.named(name), name, data
+    return b.named(name), name, data, kind == 4  # kind 4 = BOOLEAN
 
 
 @pytest.mark.parametrize("seed", range(18))
@@ -64,20 +64,20 @@ def test_random_roundtrip(tmp_path, seed, monkeypatch):
     rng = np.random.default_rng(seed)
     n = int(rng.integers(1, 4000))
     n_cols = int(rng.integers(1, 6))
-    fields, names, datas = [], [], []
+    fields, names, datas, bools = [], [], [], []
     for i in range(n_cols):
-        f, name, data = _random_column(rng, n, i)
+        f, name, data, is_bool = _random_column(rng, n, i)
         fields.append(f)
         names.append(name)
         datas.append(data)
+        bools.append(is_bool)
     schema = types.message("t", *fields)
-    # randomly bloom-filter the non-boolean columns (write + read below)
+    # randomly bloom-filter the non-boolean columns (write + read below;
+    # selection by column KIND — BOOLEAN rejects blooms by design)
     bloom_cols = None
     if rng.integers(0, 2):
         bloom_cols = {
-            nm: True
-            for nm, d in zip(names, datas)
-            if not any(isinstance(v, bool) for v in d if v is not None)
+            nm: True for nm, is_bool in zip(names, bools) if not is_bool
         } or None
     # randomly exercise the chunked fill-and-ship staging path (only
     # meaningful via read_row_group — the pipelined iterator disables

@@ -1749,8 +1749,11 @@ class TpuRowGroupReader:
                                    thread_name_prefix="pftpu-ship") as shp:
             # chunked=False: intra-group chunked shipping would issue
             # transfers from the stage worker concurrently with the ship
-            # worker's — two streams contend on tunnelled links; the
-            # cross-group pipeline already provides the overlap here
+            # worker's — two streams contend on tunnelled links, and a
+            # chunked group 0 would only delay group 1's staging in the
+            # single stage worker; the cross-group pipeline provides the
+            # overlap here (single-group reads take read_row_group's
+            # chunked path instead)
             ship_q = deque()
             for j in range(min(DEPTH, n)):
                 f = sp.submit(self._stage_row_group, indices[j], columns,

@@ -6,10 +6,18 @@ Prints ONE JSON line:
 
 * value        — rows/s decoding all 16 lineitem columns with the TPU engine
                  (end to end: file read, Snappy decompress, run-table parse,
-                 host→HBM transfer, device expand+gather, block_until_ready)
+                 host→HBM transfer, device expand+gather, block_until_ready),
+                 under the bit-exact float64 policy ('bits': DOUBLE decodes
+                 as exact IEEE-754 bit patterns — nothing is lost vs the
+                 CPU baseline's exact doubles)
 * vs_baseline  — ratio vs the single-thread CPU decode of the same file with
                  the host NumPy engine (the reference-equivalent decoder;
                  the reference publishes no numbers of its own — SURVEY.md §6)
+* detail       — the full north-star metric set (BASELINE.json): GB/s decoded
+                 (decompressed bytes / wall time), GB/s shipped over the
+                 host→device link, and p50/p99 page-decode latency (the fused
+                 device decode step of one row group, measured dispatch→ready
+                 over pre-shipped bytes, divided across its data pages).
 
 Env knobs: PFTPU_BENCH_ROWS (default 1_000_000), PFTPU_BENCH_REPS (default 3).
 """
@@ -24,6 +32,61 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # Persistent XLA compile cache: decode-shape compiles are expensive over
 # remote TPU links; cache them across bench invocations.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/pftpu_jax_cache")
+
+
+def _decoded_bytes(reader) -> int:
+    """Total decompressed bytes in the file (footer metadata: the sum of
+    every column chunk's total_uncompressed_size — pages + headers)."""
+    return sum(
+        int(c.meta_data.total_uncompressed_size or 0)
+        for rg in reader.row_groups
+        for c in (rg.columns or [])
+    )
+
+
+def _count_pages(reader, rg_index: int) -> int:
+    """Data pages in one row group (OffsetIndex page locations; falls back
+    to 1 page/chunk when the writer emitted no index)."""
+    pages = 0
+    for chunk in reader.row_groups[rg_index].columns or []:
+        oi = reader.read_offset_index(chunk)
+        pages += len(oi.page_locations) if oi and oi.page_locations else 1
+    return pages
+
+
+def page_decode_latency(tpu_reader, reps: int = 30):
+    """p50/p99 of the fused device decode step: one row group's pages,
+    staged and shipped once, decode dispatched repeatedly and timed
+    dispatch→block_until_ready.  Per-page latency divides the fused step
+    across the pages it decodes (the engine decodes all of a group's pages
+    in one launch — that IS the page-decode path)."""
+    import jax
+
+    sg = tpu_reader._stage_row_group(0, None)
+    shipped = tpu_reader._ship(sg)
+    pages = _count_pages(tpu_reader.reader, 0)
+    # warm the compile
+    jax.block_until_ready(
+        [c.values for c in tpu_reader._decode_shipped(sg, shipped).values()]
+    )
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        cols = tpu_reader._decode_shipped(sg, shipped)
+        jax.block_until_ready([c.values for c in cols.values()])
+        samples.append(time.perf_counter() - t0)
+    import math
+
+    samples.sort()
+    p50 = samples[len(samples) // 2]
+    p99 = samples[max(0, math.ceil(0.99 * len(samples)) - 1)]
+    return {
+        "group_decode_p50_ms": round(p50 * 1e3, 3),
+        "group_decode_p99_ms": round(p99 * 1e3, 3),
+        "pages_per_group": pages,
+        "page_decode_p50_us": round(p50 / max(pages, 1) * 1e6, 2),
+        "page_decode_p99_us": round(p99 / max(pages, 1) * 1e6, 2),
+    }
 
 
 def main():
@@ -58,13 +121,15 @@ def main():
         cpu_dt = min(cpu_dt, time.perf_counter() - t0)
     cpu_rps = rows / cpu_dt
 
-    # --- TPU engine --------------------------------------------------------
+    # --- TPU engine (bit-exact DOUBLE decode: float64_policy='bits') -------
     import jax
 
     jax.config.update("jax_enable_x64", True)  # INT64/DOUBLE columns
     from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+    from parquet_floor_tpu.utils import trace
 
-    reader = TpuRowGroupReader(path)
+    reader = TpuRowGroupReader(path, float64_policy="bits")
+    decoded_bytes = _decoded_bytes(reader.reader)
 
     def tpu_decode():
         # streaming scan: every column of each group fully decoded on
@@ -79,12 +144,20 @@ def main():
 
     tpu_decode()  # compile warmup
     best = float("inf")
+    trace.enable()
+    trace.reset()
     for _ in range(reps):
         t0 = time.perf_counter()
         rows_t = tpu_decode()
         best = min(best, time.perf_counter() - t0)
+    stages = trace.stats()
+    trace.disable()
     assert rows_t == rows
     tpu_rps = rows / best
+    shipped_bytes = stages.get("ship", {}).get("bytes", 0) // max(reps, 1)
+    ship_seconds = stages.get("ship", {}).get("seconds", 0.0) / max(reps, 1)
+
+    latency = page_decode_latency(reader)
     reader.close()
 
     result = {
@@ -98,6 +171,15 @@ def main():
             "tpu_rows_per_sec": round(tpu_rps, 1),
             "backend": jax.devices()[0].platform,
             "file_bytes": os.path.getsize(path),
+            "float64_policy": "bits",
+            "decoded_bytes": decoded_bytes,
+            "decoded_GB_per_s": round(decoded_bytes / best / 1e9, 3),
+            "cpu_decoded_GB_per_s": round(decoded_bytes / cpu_dt / 1e9, 3),
+            "shipped_bytes_per_pass": shipped_bytes,
+            "ship_GB_per_s": round(
+                shipped_bytes / ship_seconds / 1e9, 3
+            ) if ship_seconds else None,
+            **latency,
         },
     }
     print(json.dumps(result))

@@ -15,6 +15,16 @@ Parity surface (reference line cites):
 The engine difference: rows here are served from decoded columnar batches
 (one row group at a time), not per-cell virtual dispatch — same laziness
 (a row group decodes only when iteration reaches it), TPU-shaped internals.
+
+One front door, two engines: ``engine="host"`` decodes row groups with the
+NumPy engine; ``engine="tpu"`` routes the SAME declarative API through the
+fused device engine (``tpu.engine.TpuRowGroupReader`` — one packed
+transfer + one compiled decode per row group, 3-stage stage‖ship‖decode
+pipeline across groups), then hydrates rows from the decoded device
+columns.  Cell values, null semantics, stringification, column order,
+projection, and error behavior are identical between engines; DOUBLE
+columns ride the bit-exact ``float64_policy='bits'`` path so TPU decode
+loses nothing vs the reference's exact doubles.
 """
 
 from __future__ import annotations
@@ -66,10 +76,68 @@ class _ColumnCursor:
         return v
 
 
-class ParquetReader:
-    """Streaming row reader; itself an iterator and a context manager."""
+class _ListCursor:
+    """Cursor over fully materialized API-typed cells (the device path:
+    one vectorized conversion per column per row group, then O(1) cells)."""
 
-    def __init__(self, source, hydrator_supplier, columns: Optional[Sequence[str]] = None):
+    __slots__ = ("desc", "cells")
+
+    def __init__(self, desc: ColumnDescriptor, cells: list):
+        self.desc = desc
+        self.cells = cells
+
+    def cell(self, i: int):
+        return self.cells[i]
+
+
+def _device_column_cells(desc, vals, mask, lens) -> list:
+    """Convert one decoded device column (already fetched to host NumPy)
+    into the exact cell values the host cursor serves: Python scalars,
+    stringified BINARY/FLBA/INT96, None at nulls.  DOUBLE decoded under
+    ``float64_policy='bits'`` (int64 bit patterns) is bit-cast back —
+    bit-exact parity with the host engine."""
+    if lens is not None:  # BYTE_ARRAY: padded rows + lengths
+        ml = vals.shape[1] if vals.ndim == 2 else 0
+        buf = vals.tobytes()
+        stringify = desc.primitive.stringify
+        cells = [
+            stringify(buf[i * ml : i * ml + ln])
+            for i, ln in enumerate(lens.tolist())
+        ]
+    elif vals.ndim == 2:  # FLBA / INT96 raw byte rows
+        w = vals.shape[1]
+        buf = vals.tobytes()
+        stringify = desc.primitive.stringify
+        cells = [
+            stringify(buf[i * w : (i + 1) * w]) for i in range(vals.shape[0])
+        ]
+    else:
+        if desc.physical_type == Type.DOUBLE and vals.dtype == np.int64:
+            vals = vals.view(np.float64)  # 'bits' policy round-trip
+        cells = vals.tolist()
+    if mask is not None:
+        for i in np.flatnonzero(mask).tolist():
+            cells[i] = None
+    return cells
+
+
+class ParquetReader:
+    """Streaming row reader; itself an iterator and a context manager.
+
+    ``engine`` selects the decode engine behind the same API surface:
+    ``"host"`` (NumPy, the default), ``"tpu"`` (the fused device engine),
+    or ``"auto"`` (device engine when the default JAX backend is a TPU).
+    """
+
+    def __init__(self, source, hydrator_supplier, columns: Optional[Sequence[str]] = None,
+                 engine: str = "host"):
+        if engine not in ("host", "tpu", "auto"):
+            raise ValueError(f"bad engine {engine!r}: expected host|tpu|auto")
+        if engine == "auto":
+            from ..tpu.engine import _platform_is_tpu
+
+            engine = "tpu" if _platform_is_tpu() else "host"
+        self.engine = engine
         self._reader = ParquetFileReader(source)
         schema = self._reader.schema
         selected: List[ColumnDescriptor] = [
@@ -86,6 +154,29 @@ class ParquetReader:
         self._cursors: Optional[List[_ColumnCursor]] = None
         self._rg_rows = 0
         self._finished = False
+        self._tpu = None
+        self._tpu_gen = None
+        if engine == "tpu" and selected:
+            from ..tpu.engine import TpuRowGroupReader
+
+            try:
+                # 'bits' decodes DOUBLE as exact int64 bit patterns on any
+                # backend; _device_column_cells casts back to float64 on
+                # host.
+                self._tpu = TpuRowGroupReader(
+                    self._reader, float64_policy="bits"
+                )
+            except BaseException as e:
+                self._reader.close()  # engine never took ownership
+                if isinstance(e, RuntimeError) and "64-bit" in str(e):
+                    raise RuntimeError(
+                        'ParquetReader(engine="tpu") needs 64-bit JAX '
+                        "types: call "
+                        'jax.config.update("jax_enable_x64", True) first '
+                        "(not flipped automatically — it changes dtype "
+                        "promotion for all JAX code in the process)"
+                    ) from None
+                raise
 
     # -- metadata ----------------------------------------------------------
 
@@ -100,7 +191,64 @@ class ParquetReader:
 
     # -- iteration ---------------------------------------------------------
 
+    def _advance_row_group_tpu(self) -> bool:
+        """Device-engine group advance: pull the next fused-decoded group
+        from the pipelined iterator and materialize API cells (same cells,
+        same order, same errors as the host cursor path)."""
+        import jax
+
+        n_groups = len(self._reader.row_groups)
+        while self._rg_index < n_groups:
+            if self._tpu_gen is None:
+                names = [c.path[0] for c in self.columns]
+                self._tpu_gen = self._tpu.iter_row_groups(
+                    columns=names, indices=range(self._rg_index, n_groups)
+                )
+            try:
+                group = next(self._tpu_gen)
+            except StopIteration:  # pragma: no cover - indices cover the tail
+                raise RuntimeError(
+                    "device engine ended before the last row group"
+                ) from None
+            rg_rows = int(self._reader.row_groups[self._rg_index].num_rows or 0)
+            self._rg_index += 1
+            ordered = []
+            for desc in self.columns:
+                dc = group.get(".".join(desc.path))
+                if dc is None:
+                    raise ValueError(f"row group missing column {desc.path}")
+                if dc.rep_levels is not None:
+                    # Flat-only guard, parity with the host engine (and the
+                    # reference's IllegalStateException "Unexpected
+                    # repetition", ParquetReader.java:200-202).
+                    if np.any(np.asarray(dc.rep_levels) != 0):
+                        raise RuntimeError(
+                            "Failed to read parquet",
+                            ValueError("Unexpected repetition"),
+                        )
+                    raise ValueError(
+                        "cell() requires a flat (non-repeated) column"
+                    )
+                ordered.append(dc)
+            # one bulk device→host transfer for the whole group
+            host = jax.device_get(
+                [(dc.values, dc.mask, dc.lengths) for dc in ordered]
+            )
+            self._cursors = [
+                _ListCursor(dc.descriptor,
+                            _device_column_cells(dc.descriptor, v, m, ln))
+                for dc, (v, m, ln) in zip(ordered, host)
+            ]
+            self._rg_rows = rg_rows
+            self._row = 0
+            if self._rg_rows > 0:
+                return True
+        self._finished = True
+        return False
+
     def _advance_row_group(self) -> bool:
+        if self._tpu is not None:
+            return self._advance_row_group_tpu()
         while self._rg_index < len(self._reader.row_groups):
             batch = self._reader.read_row_group(self._rg_index, self._filter)
             self._rg_index += 1
@@ -151,7 +299,13 @@ class ParquetReader:
             raise RuntimeError("Failed to read parquet") from e
 
     def close(self) -> None:
-        self._reader.close()
+        if self._tpu_gen is not None:
+            self._tpu_gen.close()
+            self._tpu_gen = None
+        if self._tpu is not None:
+            self._tpu.close()  # owns (and closes) the shared file reader
+        else:
+            self._reader.close()
 
     def __enter__(self):
         return self
@@ -188,6 +342,10 @@ class ParquetReader:
         self._rg_rows = 0
         self._finished = False
         self._row = 0
+        if self._tpu_gen is not None:
+            # device pipeline is positional: restart it at the new group
+            self._tpu_gen.close()
+            self._tpu_gen = None
         if rg < n_groups and row:
             if not self._advance_row_group():
                 raise ValueError("saved state points past end of file")
@@ -206,20 +364,22 @@ class ParquetReader:
     # -- static factories (reference API verbs) ----------------------------
 
     @staticmethod
-    def stream_content(source, hydrator_supplier, columns: Optional[Sequence[str]] = None):
+    def stream_content(source, hydrator_supplier, columns: Optional[Sequence[str]] = None,
+                       engine: str = "host"):
         """Stream hydrated records (``streamContent``, :47-61).
 
         Returns an iterator that owns the file and closes it on exhaustion
-        or ``.close()`` (stream-close parity, :80-84).
+        or ``.close()`` (stream-close parity, :80-84).  ``engine="tpu"``
+        hydrates the same rows from fused device-decoded column batches.
         """
-        reader = ParquetReader(source, hydrator_supplier, columns)
+        reader = ParquetReader(source, hydrator_supplier, columns, engine=engine)
         return _ClosingIterator(reader)
 
     @staticmethod
-    def spliterator(source, hydrator_supplier, columns: Optional[Sequence[str]] = None
-                    ) -> "ParquetReader":
+    def spliterator(source, hydrator_supplier, columns: Optional[Sequence[str]] = None,
+                    engine: str = "host") -> "ParquetReader":
         """The raw cursor object (``spliterator``, :63-78)."""
-        return ParquetReader(source, hydrator_supplier, columns)
+        return ParquetReader(source, hydrator_supplier, columns, engine=engine)
 
     @staticmethod
     def read_metadata(source) -> ParquetMetadata:

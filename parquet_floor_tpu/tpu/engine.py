@@ -1705,7 +1705,8 @@ class TpuRowGroupReader:
         return self._launch(sg), covered
 
     def iter_row_groups(self, columns: Optional[Sequence[str]] = None,
-                        prefetch: bool = True, predicate=None):
+                        prefetch: bool = True, predicate=None,
+                        indices: Optional[Sequence[int]] = None):
         """Decode every row group, pipelining the three stages: host
         staging (read + decompress + plan) of group i+1 AND its device
         transfer both run in the background while the device computes the
@@ -1715,9 +1716,15 @@ class TpuRowGroupReader:
 
         ``predicate`` (see ``batch.predicate.col``) skips row groups whose
         footer statistics prove no row can match — before any page is
-        read, staged, or shipped."""
+        read, staged, or shipped.  ``indices`` restricts/reorders the
+        groups visited (e.g. resuming a row cursor mid-file); it composes
+        with ``predicate`` by intersection, preserving ``indices`` order."""
         if predicate is not None:
-            indices = predicate.row_groups(self.reader)
+            keep = set(predicate.row_groups(self.reader))
+            base = indices if indices is not None else range(self.num_row_groups)
+            indices = [i for i in base if i in keep]
+        elif indices is not None:
+            indices = list(indices)
         else:
             indices = list(range(self.num_row_groups))
         if not prefetch or len(indices) <= 1:

@@ -1,0 +1,209 @@
+"""One front door, two engines: the declarative row API driven through the
+TPU engine (``engine="tpu"``) must return byte-identical rows — values,
+nulls, stringified BINARY/FLBA/INT96, column order, projection, flat-guard
+errors — vs the host engine, on every type the API serves.
+
+This is the round-3 north-star integration: the parity API of the
+reference (``ParquetReader.java:47-61,141-168``) served from fused
+device-decoded columnar batches instead of per-cell virtual dispatch.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    CompressionCodec,
+    ParquetFileWriter,
+    ParquetReader,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.api.hydrate import Hydrator
+
+
+class _RowHydrator(Hydrator):
+    def start(self):
+        return []
+
+    def add(self, target, heading, value):
+        target.append((heading, value))
+        return target
+
+    def finish(self, target):
+        return tuple(target)
+
+
+def _rows(path, columns=None, engine="host"):
+    return list(
+        ParquetReader.stream_content(
+            path, lambda cols: _RowHydrator(), columns, engine=engine
+        )
+    )
+
+
+def _bits(v):
+    """Bit-exact comparison key (floats compared by IEEE bit pattern)."""
+    if isinstance(v, float):
+        return struct.pack("<d", v)
+    return v
+
+
+def _assert_rows_equal(got, want):
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert len(g) == len(w)
+        for (gh, gv), (wh, wv) in zip(g, w):
+            assert gh == wh
+            assert type(gv) is type(wv), (gh, gv, wv)
+            assert _bits(gv) == _bits(wv), (gh, gv, wv)
+
+
+def _write_wide(tmp_path, opts=None, n=700, groups=2):
+    """A file touching every API-served physical type, with nulls, NaN,
+    negative zero, empty and non-ASCII strings, raw binary, FLBA, INT96."""
+    rng = np.random.default_rng(7)
+    t = types
+    schema = t.message(
+        "t",
+        t.required(t.INT64).named("i64"),
+        t.optional(t.INT32).named("i32"),
+        t.optional(t.DOUBLE).named("d"),
+        t.required(t.FLOAT).named("f"),
+        t.optional(t.BOOLEAN).named("b"),
+        t.optional(t.BYTE_ARRAY).as_(t.string()).named("s"),
+        t.required(t.BYTE_ARRAY).named("raw"),
+        t.required(t.FIXED_LEN_BYTE_ARRAY).length(5).named("flba"),
+        t.required(t.INT96).named("t96"),
+    )
+    specials = [float("nan"), float("inf"), -0.0, 2.0**-1074, 1e308]
+    data = {
+        "i64": [int(v) for v in rng.integers(-(2**62), 2**62, n)],
+        "i32": [None if rng.random() < 0.2 else int(v)
+                for v in rng.integers(-(2**31), 2**31, n)],
+        "d": [None if rng.random() < 0.2
+              else (specials[i % 5] if rng.random() < 0.1 else float(v))
+              for i, v in enumerate(rng.standard_normal(n))],
+        "f": [float(np.float32(v)) for v in rng.standard_normal(n)],
+        "b": [None if rng.random() < 0.2 else bool(v)
+              for v in rng.integers(0, 2, n)],
+        "s": [None if rng.random() < 0.2
+              else ["", "héllo", "x" * 40, f"s{i % 37}"][i % 4]
+              for i in range(n)],
+        "raw": [bytes([i % 256, (i * 7) % 256]) for i in range(n)],
+        "flba": rng.integers(0, 256, (n, 5)).astype(np.uint8),
+        "t96": rng.integers(0, 256, (n, 12)).astype(np.uint8),
+    }
+    path = str(tmp_path / "wide.parquet")
+    opts = opts or WriterOptions(codec=CompressionCodec.SNAPPY)
+    per = (n + groups - 1) // groups
+    with ParquetFileWriter(path, schema, opts) as w:
+        done = 0
+        while done < n:
+            take = min(per, n - done)
+            w.write_columns({
+                k: (v[done : done + take] if isinstance(v, list)
+                    else v[done : done + take])
+                for k, v in data.items()
+            })
+            done += take
+    return path
+
+
+@pytest.mark.parametrize("opts", [
+    WriterOptions(codec=CompressionCodec.SNAPPY),
+    WriterOptions(codec=CompressionCodec.ZSTD, page_version=1,
+                  enable_dictionary=False),
+    WriterOptions(codec=CompressionCodec.UNCOMPRESSED, delta_integers=True,
+                  byte_stream_split_floats=True),
+])
+def test_row_parity_all_types(tmp_path, opts):
+    path = _write_wide(tmp_path, opts)
+    host = _rows(path)
+    tpu = _rows(path, engine="tpu")
+    _assert_rows_equal(tpu, host)
+
+
+def test_row_parity_projection(tmp_path):
+    path = _write_wide(tmp_path)
+    for cols in (["i64"], ["s", "d"], ["flba", "t96", "b"], [], None,
+                 ["does_not_exist"]):
+        host = _rows(path, cols)
+        tpu = _rows(path, cols, engine="tpu")
+        _assert_rows_equal(tpu, host)
+        if cols:
+            want = [c for c in
+                    ["i64", "i32", "d", "f", "b", "s", "raw", "flba", "t96"]
+                    if c in cols]
+            for row in tpu:
+                assert [h for h, _ in row] == want
+
+
+def test_flat_guard_parity(tmp_path):
+    """Nested (repeated) files raise the same wrapped flat-guard error
+    through both engines (reference ParquetReader.java:200-202)."""
+    t = types
+    schema = t.message(
+        "t",
+        t.required(t.INT64).named("id"),
+        t.list_of(t.required(t.INT32).named("element"), "xs"),
+    )
+    path = str(tmp_path / "nested.parquet")
+    with ParquetFileWriter(path, schema) as w:
+        w.write_columns({"id": [1, 2, 3], "xs": [[1], [2, 3], []]})
+    for engine in ("host", "tpu"):
+        with pytest.raises(RuntimeError, match="Failed to read parquet") as ei:
+            _rows(path, engine=engine)
+        assert "Unexpected repetition" in repr(ei.value.__cause__ or ei.value)
+
+
+def test_stream_closes_and_estimate(tmp_path):
+    path = _write_wide(tmp_path, n=100, groups=1)
+    r = ParquetReader.spliterator(path, lambda cols: _RowHydrator(),
+                                  engine="tpu")
+    assert r.estimate_size() == 100
+    assert len(list(r)) == 100
+    r.close()
+
+
+def test_state_restore_tpu(tmp_path):
+    path = _write_wide(tmp_path, n=300, groups=3)
+    with ParquetReader.spliterator(path, lambda cols: _RowHydrator(),
+                                   engine="tpu") as r:
+        rows = []
+        for _ in range(150):
+            rows.append(next(r))
+        st = r.state()
+        rest = [*r]
+    with ParquetReader.spliterator(path, lambda cols: _RowHydrator(),
+                                   engine="tpu") as r2:
+        r2.restore(st)
+        resumed = [*r2]
+    _assert_rows_equal(resumed, rest)
+    host = _rows(path)
+    _assert_rows_equal(rows + rest, host)
+
+
+def test_auto_engine_on_cpu(tmp_path):
+    """engine='auto' on the CPU test backend resolves to host and works."""
+    path = _write_wide(tmp_path, n=50, groups=1)
+    rows = _rows(path, engine="auto")
+    _assert_rows_equal(rows, _rows(path))
+
+
+def test_bad_engine_rejected(tmp_path):
+    path = _write_wide(tmp_path, n=10, groups=1)
+    with pytest.raises(ValueError, match="bad engine"):
+        ParquetReader.spliterator(path, lambda cols: _RowHydrator(),
+                                  engine="gpu")
+
+
+def test_stream_content_to_strings_matches_tpu_rows(tmp_path):
+    """The debug strings reader (host) agrees with stringified TPU rows."""
+    path = _write_wide(tmp_path, n=60, groups=1)
+    host_strs = list(ParquetReader.stream_content_to_strings(path))
+    tpu = _rows(path, engine="tpu")
+    for hs, row in zip(host_strs, tpu):
+        got = [f"{h}={'null' if v is None else v}" for h, v in row]
+        assert got == hs

@@ -3,7 +3,18 @@
 (the reference-equivalent engine; the reference itself publishes no
 numbers — SURVEY.md §6) vs the TPU decode engine.
 
+Per config this reports the full north-star metric set: rows/s, GB/s
+decoded (decompressed bytes / wall time), and p50/p99 page-decode latency
+(fused device decode of one staged+shipped row group, divided across its
+data pages).  A raw link-bandwidth probe (device_put of a 64 MB buffer)
+anchors the transfer-floor analysis for config #1.
+
 Usage: python benchmarks/run_all.py [--rows N] [--reps K] [--json OUT]
+       [--rows-api]
+
+--rows-api additionally times the declarative row API (stream_content with
+a tuple-building hydrator) through both engines — the one-front-door
+comparison: same rows, host cursor vs device decode.
 
 Prints a markdown table and (with --json) a machine-readable report.
 bench.py remains the driver's single-line headline metric (config #2).
@@ -45,10 +56,29 @@ def _tpu_decode(reader):
         jax.block_until_ready(arrs)
 
 
-def measure(name, path, reps, nested_rows=None):
+def link_bandwidth_gbps(mb: int = 64, reps: int = 5) -> float:
+    """Raw host→device link throughput: device_put of one contiguous
+    buffer, best of ``reps`` (the transfer floor any shipped-bytes
+    pipeline is bounded by)."""
     import jax
+    import numpy as np
 
+    buf = np.random.default_rng(0).integers(
+        0, 255, mb << 20, dtype=np.uint8
+    )
+    jax.block_until_ready(jax.device_put(buf))  # warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(buf))
+        best = min(best, time.perf_counter() - t0)
+    return buf.nbytes / best / 1e9
+
+
+def measure(name, path, reps, nested_rows=None):
+    import bench as headline
     from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+    from parquet_floor_tpu.utils import trace
 
     size = os.path.getsize(path)
     _host_decode(path)  # warm page cache
@@ -57,17 +87,27 @@ def measure(name, path, reps, nested_rows=None):
     cpu_dt = time.perf_counter() - t0
     n_rows = nested_rows if nested_rows is not None else rows
 
-    reader = TpuRowGroupReader(path)
+    reader = TpuRowGroupReader(path, float64_policy="bits")
+    decoded_bytes = headline._decoded_bytes(reader.reader)
     best = float("inf")
     try:
         _tpu_decode(reader)  # compile warmup
+        trace.enable()
+        trace.reset()
         for _ in range(reps):
             t0 = time.perf_counter()
             _tpu_decode(reader)
             best = min(best, time.perf_counter() - t0)
+        stages = trace.stats()
+        trace.disable()
+        latency = headline.page_decode_latency(reader, reps=15)
     finally:
         reader.close()
 
+    ship = stages.get("ship", {})
+    ship_gbps = (
+        ship["bytes"] / ship["seconds"] / 1e9 if ship.get("seconds") else None
+    )
     return {
         "config": name,
         "rows": n_rows,
@@ -77,7 +117,50 @@ def measure(name, path, reps, nested_rows=None):
         "speedup": round(cpu_dt / best, 2),
         "cpu_s": round(cpu_dt, 4),
         "tpu_s": round(best, 4),
+        "decoded_bytes": decoded_bytes,
+        "decoded_GB_per_s": round(decoded_bytes / best / 1e9, 3),
+        "cpu_decoded_GB_per_s": round(decoded_bytes / cpu_dt / 1e9, 3),
+        "shipped_bytes_per_pass": ship.get("bytes", 0) // max(reps, 1),
+        "ship_GB_per_s": round(ship_gbps, 3) if ship_gbps else None,
+        **latency,
     }
+
+
+def measure_rows_api(path, reps=3):
+    """The one-front-door comparison: hydrated row stream through the host
+    cursor vs the device engine (identical rows; decode is the variable)."""
+    from parquet_floor_tpu import ParquetReader
+
+    class _Rows:
+        def start(self):
+            return []
+
+        def add(self, t, h, v):
+            t.append(v)
+            return t
+
+        def finish(self, t):
+            return tuple(t)
+
+    out = {}
+    for engine in ("host", "tpu"):
+        n = 0
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            n = sum(
+                1
+                for _ in ParquetReader.stream_content(
+                    path, lambda c: _Rows(), engine=engine
+                )
+            )
+            best = min(best, time.perf_counter() - t0)
+        out[engine] = {"rows": n, "s": round(best, 4),
+                       "rows_per_s": round(n / best, 1)}
+    out["speedup"] = round(
+        out["host"]["s"] / out["tpu"]["s"], 2
+    )
+    return out
 
 
 def main():
@@ -85,6 +168,7 @@ def main():
     ap.add_argument("--rows", type=int, default=1_000_000)
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--json", default=None)
+    ap.add_argument("--rows-api", action="store_true")
     args = ap.parse_args()
 
     import jax
@@ -104,6 +188,7 @@ def main():
     p = f"/tmp/pftpu_bench_lineitem_{n}.parquet"
     if not os.path.exists(p):
         w.write_lineitem(p, n)
+    lineitem_path = p
     cfgs.append(("2 TPC-H lineitem Snappy+dict", p, None))
 
     p = f"/tmp/pftpu_cfg3_{n}.parquet"
@@ -121,20 +206,44 @@ def main():
         w.write_nested_list(p, n // 10)
     cfgs.append(("5 nested LIST<STRUCT> Snappy", p, n // 10))
 
+    link = link_bandwidth_gbps()
+    print(f"link bandwidth (64 MB device_put, best of 5): {link:.3f} GB/s",
+          flush=True)
+
     results = []
     for name, path, nested_rows in cfgs:
         r = measure(name, path, args.reps, nested_rows)
+        r["link_GB_per_s"] = round(link, 3)
         results.append(r)
         print(
             f"| {r['config']:<30} | {r['rows']:>9} | {r['file_mb']:>7.2f} "
             f"| {r['cpu_rows_per_s']:>12,.0f} | {r['tpu_rows_per_s']:>12,.0f} "
-            f"| {r['speedup']:>6.2f}x |",
+            f"| {r['speedup']:>6.2f}x | {r['decoded_GB_per_s']:>6.3f} GB/s "
+            f"| p50 {r['page_decode_p50_us']:>7.2f} us/page |",
             flush=True,
         )
+
+    rows_api = None
+    if args.rows_api:
+        rows_api = measure_rows_api(lineitem_path, reps=args.reps)
+        print(
+            f"rows-api (lineitem, hydrated rows): host "
+            f"{rows_api['host']['rows_per_s']:,.0f} rows/s vs tpu "
+            f"{rows_api['tpu']['rows_per_s']:,.0f} rows/s "
+            f"({rows_api['speedup']}x)",
+            flush=True,
+        )
+
     if args.json:
         with open(args.json, "w") as f:
             json.dump(
-                {"backend": jax.devices()[0].platform, "results": results}, f,
+                {
+                    "backend": jax.devices()[0].platform,
+                    "link_GB_per_s": round(link, 3),
+                    "results": results,
+                    "rows_api": rows_api,
+                },
+                f,
                 indent=2,
             )
     return results

@@ -408,7 +408,15 @@ class ParquetFileReader:
                 raw = self.source.read_at(int(offset), int(length))
                 cache[offset] = SplitBlockBloomFilter.from_bytes(raw)
             else:
-                head = self.source.read_at(int(offset), 64)
+                # header probe clamped to the file tail: a small foreign
+                # file may place the filter within the last 64 bytes
+                probe = min(64, self.source.size - int(offset))
+                if probe <= 0:
+                    raise EOFError(
+                        f"bloom filter offset {offset} outside file of "
+                        f"{self.source.size} bytes"
+                    )
+                head = self.source.read_at(int(offset), probe)
                 reader = CompactReader(head)
                 header = BloomFilterHeader.read(reader)
                 total = reader.pos + int(header.numBytes or 0)

@@ -391,6 +391,24 @@ class ParquetFileWriter:
         self.sink = dest if isinstance(dest, FileSink) else FileSink(dest)
         self.schema = schema
         self.options = options or WriterOptions()
+        # Validate Bloom selections up front: _maybe_build_bloom runs after
+        # the chunk bytes hit the sink, so a bad selection discovered there
+        # would abort write_row_group mid-group with a partial file.
+        for name, sel in (self.options.bloom_filter_columns or {}).items():
+            if not sel:
+                continue
+            descs = [c for c in schema.columns if c.path[0] == name]
+            if not descs:
+                raise ValueError(
+                    f"bloom_filter_columns: no column named {name!r}"
+                )
+            for d in descs:
+                if d.physical_type == Type.BOOLEAN:
+                    raise ValueError(
+                        "bloom_filter_columns: BOOLEAN column "
+                        f"{name!r} is not supported (1-bit domain; "
+                        "parquet-mr refuses it too)"
+                    )
         self._row_groups: List[RowGroup] = []
         self._num_rows = 0
         self._kv = key_value_metadata or {}

@@ -78,8 +78,24 @@ class FileSource:
                 # or a zero-copy page payload): drop our reference and let
                 # the map close when the last view dies, instead of
                 # raising here — which would also mask the original error
-                # when unwinding out of a `with ParquetFileReader(...)`
-                pass
+                # when unwinding out of a `with ParquetFileReader(...)`.
+                # Surface the leak so it stays diagnosable: close() no
+                # longer guarantees release of the file mapping.  Stay
+                # silent while an exception is unwinding, though — under
+                # -W error a warning raised here would replace the
+                # in-flight error (the hazard the bare pass guarded).
+                import sys as _sys
+
+                if _sys.exc_info()[0] is None:
+                    import warnings
+
+                    warnings.warn(
+                        f"{self!r}.close(): a memoryview into the mmap is "
+                        "still alive; the file mapping stays open until "
+                        "the last view is garbage-collected",
+                        ResourceWarning,
+                        stacklevel=2,
+                    )
             self._mm = None
         if self._own and self._fh is not None:
             self._fh.close()

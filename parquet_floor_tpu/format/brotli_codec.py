@@ -1,0 +1,141 @@
+"""BROTLI codec via ctypes over the system Brotli shared libraries.
+
+The reference reads any footer-named codec by instantiating its class
+through the reflection seam (``ReflectionUtils.java:10-21``), and those
+codec classes are thin JNI wrappers over native libraries (snappy-java →
+libsnappy, zstd-jni → libzstd).  This module is the same architecture for
+Brotli: a direct binding to ``libbrotlidec``/``libbrotlienc`` (RFC 7932
+reference implementation, present on any dpkg/rpm system with the
+``brotli`` runtime), loaded lazily and degrading to the
+``register_codec`` guidance when absent.
+
+One-shot API only: Parquet page headers carry the exact uncompressed
+size, so streaming decode buys nothing here.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import threading
+from typing import Optional
+
+_dec = None
+_enc = None
+_tried = False
+_load_lock = threading.Lock()
+
+# BrotliDecoderResult
+_DECODER_SUCCESS = 1
+
+
+def _load() -> None:
+    global _dec, _enc, _tried
+    if _tried:
+        return
+    with _load_lock:
+        if _tried:
+            return
+        _load_locked()
+        _tried = True  # set last: concurrent fast-path readers must not
+        #                observe _tried before _dec/_enc are assigned
+
+
+def _load_locked() -> None:
+    global _dec, _enc
+    for name in (
+        "brotlidec",            # ctypes.util resolution
+        "libbrotlidec.so.1",    # common soname (no -dev package needed)
+        "libbrotlidec.so",
+    ):
+        path = ctypes.util.find_library(name) if "." not in name else name
+        if not path:
+            continue
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            continue
+        try:
+            fn = lib.BrotliDecoderDecompress
+        except AttributeError:
+            continue
+        fn.restype = ctypes.c_int
+        fn.argtypes = [
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_char_p,
+        ]
+        _dec = lib
+        break
+    for name in ("brotlienc", "libbrotlienc.so.1", "libbrotlienc.so"):
+        path = ctypes.util.find_library(name) if "." not in name else name
+        if not path:
+            continue
+        try:
+            lib = ctypes.CDLL(path)
+            cfn = lib.BrotliEncoderCompress
+        except (OSError, AttributeError):
+            continue
+        cfn.restype = ctypes.c_int
+        cfn.argtypes = [
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_char_p,
+        ]
+        mx = lib.BrotliEncoderMaxCompressedSize
+        mx.restype = ctypes.c_size_t
+        mx.argtypes = [ctypes.c_size_t]
+        _enc = lib
+        break
+
+
+def available() -> bool:
+    """True when the system decode library loaded (read-side support)."""
+    _load()
+    return _dec is not None
+
+
+def encoder_available() -> bool:
+    _load()
+    return _enc is not None
+
+
+def decompress(data: bytes, uncompressed_size: Optional[int] = None) -> bytes:
+    """One-shot Brotli decode.  With ``uncompressed_size`` (the Parquet
+    page header's value) the output buffer is exact; without it the
+    buffer doubles until the stream fits."""
+    _load()
+    if _dec is None:
+        raise RuntimeError("libbrotlidec not found")
+    data = bytes(data)
+    cap = uncompressed_size if uncompressed_size else max(4 * len(data), 1 << 14)
+    while True:
+        out = ctypes.create_string_buffer(cap or 1)
+        n = ctypes.c_size_t(cap)
+        rc = _dec.BrotliDecoderDecompress(len(data), data, ctypes.byref(n), out)
+        if rc == _DECODER_SUCCESS:
+            return out.raw[: n.value]
+        if uncompressed_size is not None or cap >= 1 << 31:
+            raise ValueError("invalid brotli stream (or wrong size hint)")
+        cap *= 2
+
+
+def compress(data: bytes, quality: int = 5, lgwin: int = 22) -> bytes:
+    _load()
+    if _enc is None:
+        raise RuntimeError("libbrotlienc not found")
+    data = bytes(data)
+    cap = int(_enc.BrotliEncoderMaxCompressedSize(len(data))) or len(data) + 1024
+    out = ctypes.create_string_buffer(cap)
+    n = ctypes.c_size_t(cap)
+    rc = _enc.BrotliEncoderCompress(
+        quality, lgwin, 0, len(data), data, ctypes.byref(n), out
+    )
+    if rc != 1:
+        raise ValueError("brotli compression failed")
+    return out.raw[: n.value]

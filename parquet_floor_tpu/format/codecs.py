@@ -233,6 +233,24 @@ def _lz4_hadoop_compress(data: bytes) -> bytes:
     )
 
 
+def _brotli_decompress(data: bytes, uncompressed_size=None) -> bytes:
+    """BROTLI via the system library (format/brotli_codec.py) — the same
+    native-library codec seam the reference's JNI codecs use."""
+    from . import brotli_codec
+
+    if not brotli_codec.available():
+        raise UnsupportedCodec(_codec_guidance(CompressionCodec.BROTLI))
+    return brotli_codec.decompress(data, uncompressed_size)
+
+
+def _brotli_compress(data: bytes) -> bytes:
+    from . import brotli_codec
+
+    if not brotli_codec.encoder_available():
+        raise UnsupportedCodec(_codec_guidance(CompressionCodec.BROTLI))
+    return brotli_codec.compress(data)
+
+
 _COMPRESSORS: Dict[int, Callable[[bytes], bytes]] = {
     CompressionCodec.UNCOMPRESSED: lambda d: d,
     CompressionCodec.SNAPPY: _snappy_compress,
@@ -240,6 +258,7 @@ _COMPRESSORS: Dict[int, Callable[[bytes], bytes]] = {
     CompressionCodec.ZSTD: _zstd_compress,
     CompressionCodec.LZ4_RAW: _lz4_raw_compress,
     CompressionCodec.LZ4: _lz4_hadoop_compress,
+    CompressionCodec.BROTLI: _brotli_compress,
 }
 
 _DECOMPRESSORS: Dict[int, Callable[..., bytes]] = {
@@ -249,6 +268,7 @@ _DECOMPRESSORS: Dict[int, Callable[..., bytes]] = {
     CompressionCodec.ZSTD: _zstd_decompress,
     CompressionCodec.LZ4_RAW: _lz4_raw_decompress,
     CompressionCodec.LZ4: _lz4_hadoop_decompress,
+    CompressionCodec.BROTLI: _brotli_decompress,
 }
 
 
@@ -280,8 +300,9 @@ def _codec_guidance(codec: int) -> str:
     name = CompressionCodec.name(codec)
     if codec == CompressionCodec.BROTLI:
         return (
-            f"{name} has no built-in implementation: install the "
-            "'brotli' (or 'brotlicffi') package and plug it in with "
+            f"{name}: the system Brotli library (libbrotlidec/"
+            "libbrotlienc) was not found; install the 'brotli' runtime "
+            "package, or plug a Python implementation in with "
             "register_codec(CompressionCodec.BROTLI, brotli.compress, "
             "lambda d, n: brotli.decompress(d))"
         )
@@ -368,10 +389,22 @@ def supported_codecs() -> Tuple[int, ...]:
         or (_native is not None and _native.available())
     ):
         base.append(CompressionCodec.ZSTD)
+    brotli_builtin = (
+        _DECOMPRESSORS.get(CompressionCodec.BROTLI) is _brotli_decompress
+    )
+    if not brotli_builtin:
+        base.append(CompressionCodec.BROTLI)
+    else:
+        from . import brotli_codec
+
+        if brotli_codec.available():
+            base.append(CompressionCodec.BROTLI)
     # user-registered codecs: the list means "readable" (decompressor
-    # present), matching the ZSTD backend gate above — a compressor-only
+    # present), matching the backend gates above — a compressor-only
     # registration does not make a footer naming that codec readable
     for codec in _DECOMPRESSORS:
-        if codec not in base and codec != CompressionCodec.ZSTD:
+        if codec not in base and codec not in (
+            CompressionCodec.ZSTD, CompressionCodec.BROTLI
+        ):
             base.append(codec)
     return tuple(base)

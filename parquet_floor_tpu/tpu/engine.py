@@ -1561,11 +1561,9 @@ class TpuRowGroupReader:
         else:
             self._chunked_ship = _platform_is_tpu()
         # Pallas expansion for uniform-bit-width streams.  The lane-gather
-        # kernel formulation compiles under Mosaic for every
-        # ``rle_kernel.lane_compiled`` width (bw ≤ 24 and 32 — def/rep
-        # levels, dictionaries to 16M entries, and whole-word streams) —
-        # default ON for those on a real TPU.  The leftover 25–31 widths
-        # stay on the jnp path.  PFTPU_PALLAS=0 disables; PFTPU_PALLAS=1
+        # kernel formulation compiles under Mosaic for every width 1..32
+        # (``rle_kernel.lane_compiled`` is total since round 3) — default
+        # ON on a real TPU.  PFTPU_PALLAS=0 disables; PFTPU_PALLAS=1
         # forces it everywhere via interpret mode (tests).
         pl_env = _os.environ.get("PFTPU_PALLAS", "")
         if pl_env == "1":

@@ -41,11 +41,12 @@ TILE = _SUB * _LANE
 # lane gather reads one 128-lane chunk at a time; wider fields are served
 # by gathering from several static 128-byte chunks of the rolled window
 # and selecting by chunk index (see ``_lane_chunks``).  9 ≤ bw ≤ 24 needs
-# ≤ 3 chunks; bw = 32 is byte-aligned and needs 4; 25–31 would need a
-# 5-byte combine crossing the 32-bit word (rare: dictionaries > 16M
-# entries) and stay on the fallback expansion.  The engine's Pallas
-# gating and the kernel dispatch below must agree via ``lane_compiled``.
-LANE_KERNEL_MAX_BW = 24
+# ≤ 3 chunks; bw = 32 is byte-aligned and needs 4; 26–31 gather 5 bytes
+# and combine across the 32-bit word (logical shift + byte-4 splice in
+# ``_lane_expand_tile``), making ``lane_compiled`` total over 1..32.
+# The engine's Pallas gating and the kernel dispatch below must agree
+# via ``lane_compiled``.
+LANE_KERNEL_MAX_BW = 32
 # Scalar-prefetch (SMEM, 1 MiB/program) budget the engine's gating must
 # respect: run plans are 5·PL_MAX_RUNS int32 and tile spans 2·count/TILE.
 PL_MAX_RUNS = 2048
@@ -62,8 +63,9 @@ PL_MAX_RUNS_HBM = 1 << 22
 
 def lane_compiled(bit_width: int) -> bool:
     """True when the Mosaic-compilable lane-gather kernel covers this
-    width (the engine's compiled-path gate)."""
-    return 1 <= bit_width <= LANE_KERNEL_MAX_BW or bit_width == 32
+    width (the engine's compiled-path gate).  Total over 1..32 since
+    round 3 (26–31 via the 5-byte combine)."""
+    return 1 <= bit_width <= LANE_KERNEL_MAX_BW
 
 
 def _lane_chunks(bit_width: int) -> int:
@@ -323,6 +325,7 @@ def _lane_expand_tile(
             lam = (bit0 & 7) + lane_i * bit_width          # ≤ 7 + 127·bw
             b0 = lam >> 3
             word = jnp.zeros((_SUB, _LANE), jnp.int32)
+            byte4 = jnp.zeros((_SUB, _LANE), jnp.int32)
             for j in range(nbytes):
                 p = b0 + jnp.int32(j)
                 if n_chunks == 1:
@@ -339,15 +342,35 @@ def _lane_expand_tile(
                             chunks[c], q, axis=1, mode="promise_in_bounds"
                         )
                         bj = jnp.where((p >> 7) == c, g, bj)
-                word = word | (bj << (8 * j))
+                if j < 4:
+                    word = word | (bj << (8 * j))
+                else:
+                    # 5th byte (bw 26–31, misaligned): kept separate — a
+                    # << 32 would overflow the int32 accumulator
+                    byte4 = bj
             if bit_width == 32:
                 vals = word   # the int32 bit pattern IS the value
             elif aligned_fields:
                 vals = word & ((1 << bit_width) - 1)       # residual is 0
-            else:
+            elif bit_width <= 25:
                 # arithmetic >> is safe: sign-filled bits live at positions
-                # ≥ 32−sh ≥ 25, above the ≤ 24-bit mask
+                # ≥ 32−sh ≥ 25, at or above the ≤ 25-bit mask's top
                 vals = (word >> (lam & 7)) & ((1 << bit_width) - 1)
+            else:
+                # bw 26–31: 5-byte combine across the 32-bit word — the
+                # low 32−sh bits come from the word (LOGICAL shift: sign
+                # fill would pollute positions inside the mask), the rest
+                # from byte 4 shifted up.  sh == 0 needs no byte 4 (field
+                # fits the word); mask the shift amount below 32 and
+                # select, so no shift op sees an amount ≥ 32.
+                sh = lam & 7
+                lo_part = jax.lax.shift_right_logical(word, sh)
+                hi_part = jnp.where(
+                    sh == 0,
+                    jnp.int32(0),
+                    byte4 << ((jnp.int32(32) - sh) & jnp.int32(31)),
+                )
+                vals = (lo_part | hi_part) & ((1 << bit_width) - 1)
             return jnp.where(in_run, vals, acc_in)
 
         return jax.lax.cond(kind == 1, packed_branch, lambda a: rle_fill, acc)

@@ -62,7 +62,8 @@ def _expand_both(buf, plan, n, bw):
 
 
 @pytest.mark.parametrize(
-    "bw", [1, 2, 3, 5, 8, 9, 12, 15, 16, 17, 20, 23, 24, 27, 32]
+    "bw", [1, 2, 3, 5, 8, 9, 12, 15, 16, 17, 20, 23, 24, 25, 26, 27, 28,
+           29, 30, 31, 32]
 )
 def test_mixed_runs_match_reference(bw):
     rng = np.random.default_rng(bw)
@@ -125,7 +126,7 @@ def _expand_hbm(buf, plan, n, bw):
     return np.asarray(got), np.asarray(want)
 
 
-@pytest.mark.parametrize("bw", [1, 3, 8, 12, 17, 24, 32])
+@pytest.mark.parametrize("bw", [1, 3, 8, 12, 17, 24, 26, 29, 31, 32])
 def test_hbm_plan_run_heavy(bw):
     """Run counts far past the scalar-prefetch gate decode via the
     HBM-plan kernel (VERDICT round-2 weak #1: ~125k-run streams)."""

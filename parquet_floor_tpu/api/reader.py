@@ -121,6 +121,49 @@ def _device_column_cells(desc, vals, mask, lens) -> list:
     return cells
 
 
+_PACK_CACHE: dict = {}
+
+
+def _fetch_packed(leaves: list) -> list:
+    """One device→host transfer for a heterogeneous list of jax arrays:
+    a tiny jitted program bitcasts everything to uint8 and concatenates,
+    so the host pays ONE transfer's fixed cost instead of one per array
+    (per-transfer overhead dominates on tunnelled links).  Shapes are
+    HWM-bucketed by the engine, so the pack program caches well."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    sig = tuple((tuple(a.shape), str(a.dtype)) for a in leaves)
+    fn = _PACK_CACHE.get(sig)
+    if fn is None:
+        def pack(*xs):
+            parts = []
+            for x in xs:
+                if x.dtype == jnp.bool_:
+                    x = x.astype(jnp.uint8)
+                if x.dtype != jnp.uint8:
+                    x = lax.bitcast_convert_type(x, jnp.uint8)
+                parts.append(x.reshape(-1))
+            return jnp.concatenate(parts)
+        fn = jax.jit(pack)
+        if len(_PACK_CACHE) > 256:
+            _PACK_CACHE.clear()
+        _PACK_CACHE[sig] = fn
+    buf = np.asarray(fn(*leaves))
+    out, off = [], 0
+    for a in leaves:
+        dt = np.dtype(str(a.dtype))
+        nb = int(np.prod(a.shape)) * dt.itemsize
+        seg = buf[off : off + nb]
+        arr = (
+            seg.view(np.bool_) if dt == np.bool_ else seg.view(dt)
+        ).reshape(a.shape)
+        out.append(arr)
+        off += nb
+    return out
+
+
 class ParquetReader:
     """Streaming row reader; itself an iterator and a context manager.
 
@@ -156,16 +199,22 @@ class ParquetReader:
         self._finished = False
         self._tpu = None
         self._tpu_gen = None
+        self._conv_fut = None
+        self._conv_pool = None
         if engine == "tpu" and selected:
             from ..tpu.engine import TpuRowGroupReader
 
             try:
                 # 'bits' decodes DOUBLE as exact int64 bit patterns on any
                 # backend; _device_column_cells casts back to float64 on
-                # host.
+                # host.  Index-form dictionaries: fetch the packed index
+                # stream + one small pool (cached) instead of gathered
+                # values — and convert once per distinct value, not per
+                # cell.
                 self._tpu = TpuRowGroupReader(
-                    self._reader, float64_policy="bits"
+                    self._reader, float64_policy="bits", dict_form="index"
                 )
+                self._pool_cells: dict = {}
             except BaseException as e:
                 self._reader.close()  # engine never took ownership
                 if isinstance(e, RuntimeError) and "64-bit" in str(e):
@@ -191,12 +240,111 @@ class ParquetReader:
 
     # -- iteration ---------------------------------------------------------
 
-    def _advance_row_group_tpu(self) -> bool:
-        """Device-engine group advance: pull the next fused-decoded group
-        from the pipelined iterator and materialize API cells (same cells,
-        same order, same errors as the host cursor path)."""
+    def _dict_form_cells(self, dc, idx_np, mask_np) -> list:
+        """Cells for an index-form dictionary column: one conversion per
+        distinct pool value (cached per pool), then a list gather by the
+        packed index stream."""
         import jax
 
+        kind, ckey, *arrs = dc.dict_ref
+        # strings cache by the engine's CONTENT key (stable across the
+        # file); never by id() — ids are recycled after GC, which would
+        # alias a freed pool with a new one (wrong cells, not just a
+        # crash).  The key also carries the column's stringify semantics:
+        # two columns can share byte-identical pools but different
+        # logical types (str vs hex rendering).  Numeric pools are
+        # per-group and tiny: convert fresh.
+        desc = dc.descriptor
+        lt = desc.primitive.logical_type
+        key = (
+            (ckey, desc.physical_type, getattr(lt, "kind", None))
+            if ckey is not None
+            else None
+        )
+        pool = self._pool_cells.get(key) if key is not None else None
+        if pool is None:
+            if kind in ("dev", "host_str"):  # string pool
+                rows, lens = (
+                    jax.device_get(tuple(arrs))
+                    if kind == "dev"
+                    else (np.asarray(arrs[0]), np.asarray(arrs[1]))
+                )
+                ml = rows.shape[1] if rows.ndim == 2 else 0
+                buf = rows.tobytes()
+                stringify = dc.descriptor.primitive.stringify
+                pool = [
+                    stringify(buf[i * ml : i * ml + ln])
+                    for i, ln in enumerate(lens.tolist())
+                ]
+            else:  # typed numeric pool, already host-side
+                vals = arrs[0]
+                if (
+                    dc.descriptor.physical_type == Type.DOUBLE
+                    and vals.dtype == np.int64
+                ):
+                    vals = vals.view(np.float64)  # 'bits' round-trip
+                pool = vals.tolist()
+            if key is not None:
+                self._pool_cells[key] = pool
+        cells = [pool[i] for i in idx_np.tolist()]
+        if mask_np is not None:
+            for i in np.flatnonzero(mask_np).tolist():
+                cells[i] = None
+        return cells
+
+    def _convert_group_tpu(self, group) -> list:
+        """Fused-decoded device group → per-column API cell cursors (same
+        cells, same order, same errors as the host cursor path)."""
+        import jax
+
+        ordered = []
+        for desc in self.columns:
+            dc = group.get(".".join(desc.path))
+            if dc is None:
+                raise ValueError(f"row group missing column {desc.path}")
+            if dc.rep_levels is not None:
+                # Flat-only guard, parity with the host engine (and the
+                # reference's IllegalStateException "Unexpected
+                # repetition", ParquetReader.java:200-202).
+                if np.any(np.asarray(dc.rep_levels) != 0):
+                    raise RuntimeError(
+                        "Failed to read parquet",
+                        ValueError("Unexpected repetition"),
+                    )
+                raise ValueError(
+                    "cell() requires a flat (non-repeated) column"
+                )
+            ordered.append(dc)
+        # ONE device→host transfer for the whole group (see
+        # _fetch_packed: per-transfer overhead dominates on tunnelled
+        # links, so the group's arrays are packed on device first)
+        tree = [(dc.values, dc.mask, dc.lengths) for dc in ordered]
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        host = jax.tree_util.tree_unflatten(
+            treedef, _fetch_packed(leaves) if leaves else []
+        )
+        return [
+            _ListCursor(
+                dc.descriptor,
+                self._dict_form_cells(dc, v, m)
+                if dc.dict_ref is not None
+                else _device_column_cells(dc.descriptor, v, m, ln),
+            )
+            for dc, (v, m, ln) in zip(ordered, host)
+        ]
+
+    def _pull_convert_tpu(self) -> list:
+        """next(engine generator) + cell conversion (runs on the main
+        thread or the one-deep prefetch worker, never both at once)."""
+        try:
+            group = next(self._tpu_gen)
+        except StopIteration:  # pragma: no cover - indices cover the tail
+            raise RuntimeError(
+                "device engine ended before the last row group"
+            ) from None
+        return self._convert_group_tpu(group)
+
+    def _advance_row_group_tpu(self) -> bool:
         n_groups = len(self._reader.row_groups)
         while self._rg_index < n_groups:
             if self._tpu_gen is None:
@@ -204,41 +352,26 @@ class ParquetReader:
                 self._tpu_gen = self._tpu.iter_row_groups(
                     columns=names, indices=range(self._rg_index, n_groups)
                 )
-            try:
-                group = next(self._tpu_gen)
-            except StopIteration:  # pragma: no cover - indices cover the tail
-                raise RuntimeError(
-                    "device engine ended before the last row group"
-                ) from None
+            if self._conv_fut is not None:
+                cursors = self._conv_fut.result()
+                self._conv_fut = None
+            else:
+                cursors = self._pull_convert_tpu()
             rg_rows = int(self._reader.row_groups[self._rg_index].num_rows or 0)
             self._rg_index += 1
-            ordered = []
-            for desc in self.columns:
-                dc = group.get(".".join(desc.path))
-                if dc is None:
-                    raise ValueError(f"row group missing column {desc.path}")
-                if dc.rep_levels is not None:
-                    # Flat-only guard, parity with the host engine (and the
-                    # reference's IllegalStateException "Unexpected
-                    # repetition", ParquetReader.java:200-202).
-                    if np.any(np.asarray(dc.rep_levels) != 0):
-                        raise RuntimeError(
-                            "Failed to read parquet",
-                            ValueError("Unexpected repetition"),
-                        )
-                    raise ValueError(
-                        "cell() requires a flat (non-repeated) column"
+            if self._rg_index < n_groups:
+                # convert the NEXT group in the background while the
+                # caller hydrates this one: the device→host transfer
+                # releases the GIL, so the fetch cost hides under the
+                # Python row loop
+                if self._conv_pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    self._conv_pool = ThreadPoolExecutor(
+                        max_workers=1, thread_name_prefix="pftpu-rowconv"
                     )
-                ordered.append(dc)
-            # one bulk device→host transfer for the whole group
-            host = jax.device_get(
-                [(dc.values, dc.mask, dc.lengths) for dc in ordered]
-            )
-            self._cursors = [
-                _ListCursor(dc.descriptor,
-                            _device_column_cells(dc.descriptor, v, m, ln))
-                for dc, (v, m, ln) in zip(ordered, host)
-            ]
+                self._conv_fut = self._conv_pool.submit(self._pull_convert_tpu)
+            self._cursors = cursors
             self._rg_rows = rg_rows
             self._row = 0
             if self._rg_rows > 0:
@@ -298,7 +431,19 @@ class ParquetReader:
             # Parity: wrap iteration failures (ParquetReader.java:209-211).
             raise RuntimeError("Failed to read parquet") from e
 
+    def _drain_prefetch(self) -> None:
+        if self._conv_fut is not None:
+            try:
+                self._conv_fut.result()
+            except Exception:
+                pass  # discarded lookahead; real errors resurface on read
+            self._conv_fut = None
+
     def close(self) -> None:
+        self._drain_prefetch()
+        if self._conv_pool is not None:
+            self._conv_pool.shutdown(wait=False)
+            self._conv_pool = None
         if self._tpu_gen is not None:
             self._tpu_gen.close()
             self._tpu_gen = None
@@ -344,6 +489,7 @@ class ParquetReader:
         self._row = 0
         if self._tpu_gen is not None:
             # device pipeline is positional: restart it at the new group
+            self._drain_prefetch()
             self._tpu_gen.close()
             self._tpu_gen = None
         if rg < n_groups and row:

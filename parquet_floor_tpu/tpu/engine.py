@@ -155,6 +155,12 @@ class DeviceColumn:
     lengths: Optional[jax.Array] = None  # for strings: per-row byte lengths
     def_levels: Optional[jax.Array] = None  # repeated cols: int32[n]
     rep_levels: Optional[jax.Array] = None  # repeated cols: int32[n]
+    dict_ref: Optional[tuple] = None
+    # ``dict_form="index"`` columns: values is the (narrowest-dtype) index
+    # stream and dict_ref carries the dictionary pool — ("dev", rows_dev,
+    # lens_dev) for strings (shared device pool, content-cached per file)
+    # or ("host", typed_numpy_pool) for numerics.  Consumers fetch n×1..4
+    # bytes instead of gathered values/byte matrices
 
     @property
     def is_strings(self) -> bool:
@@ -388,6 +394,8 @@ class _StagedGroup:
     new_extras: List[tuple]            # (key, rows_host, lens_host) to ship
     num_rows: int
     parts: Optional[tuple] = None      # arena chunks already on device
+    host_pools: Optional[dict] = None  # spec name → typed numpy pool
+    #                                    (index-form numeric dictionaries)
 
 
 # ---------------------------------------------------------------------------
@@ -536,6 +544,19 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp, spec.pl_idx)
         vals = jnp.take(rows_d, idx, axis=0)
         lens = jnp.take(lens_d, idx)
+    elif spec.kind in ("dict_idx", "dict_idx_num"):
+        # index-form dictionary column: the index stream IS the output,
+        # packed to the narrowest dtype the pool size allows (consumers
+        # fetch n×1..4 bytes instead of gathered values; the pool rides
+        # extras (strings) or host memory (numerics) untouched)
+        idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp, spec.pl_idx)
+        if spec.dict_cap <= (1 << 8):
+            vals = idx.astype(jnp.uint8)
+        elif spec.dict_cap <= (1 << 16):
+            vals = idx.astype(jnp.uint16)
+        else:
+            vals = idx
+        lens = None
     elif spec.kind == "plain":
         if spec.p_pad == 1:
             u8 = lax.dynamic_slice(
@@ -900,6 +921,22 @@ class _DevStage:
                 spec["f64mode"] = eng._f64mode if pt == Type.DOUBLE else ""
                 spec["dict_cap"] = eng._hwm(("dict", self.name), num_dict)
                 spec["sc_off"] = slabb.add([self.dict_off])
+                if (
+                    eng._dict_form == "index"
+                    and self.desc.max_repetition_level == 0
+                    and not (pt == Type.DOUBLE and eng._f64mode == "f32")
+                ):
+                    # index-form numerics: decode stops at the (packed)
+                    # index stream; the typed pool goes to the consumer
+                    # host-side (the arena bytes are transient)
+                    spec["kind"] = "dict_idx_num"
+                    pool = np.frombuffer(
+                        bytes(arena[self.dict_off : self.dict_off + self.dict_size]),
+                        dtype=_NP_DTYPE[pt],
+                    )
+                    if pt == Type.DOUBLE and eng._f64mode == "bits":
+                        pool = pool.view(np.int64)
+                    spec["_host_pool"] = pool
             else:
                 key, cap, max_len = eng._string_dict_key(
                     arena, self.dict_off, self.dict_size, self.name
@@ -909,6 +946,10 @@ class _DevStage:
                 spec["sc_off"] = slabb.add([self.dict_off])
                 spec["extra_idx"] = -2  # patched by the engine (order of use)
                 spec["_extra_key"] = key
+                if eng._dict_form == "index" and self.desc.max_repetition_level == 0:
+                    # dict-form output: decode stops at the index stream;
+                    # the pool still ships (extras) for the consumer
+                    spec["kind"] = "dict_idx"
         elif self.kind in ("plain_str", "dlba", "mixed_str"):
             from ..format.encodings import delta as e_delta
 
@@ -1500,7 +1541,8 @@ class TpuRowGroupReader:
 
     def __init__(self, source, device: Optional[jax.Device] = None,
                  float64_policy: str = "auto", host_threads: Optional[int] = None,
-                 sync_transfers: Optional[bool] = None):
+                 sync_transfers: Optional[bool] = None,
+                 dict_form: str = "gather"):
         """``float64_policy``: how DOUBLE columns materialize on device —
         "auto" (exact float64 on CPU; float32 on TPU, where f64 is emulated
         and lossy anyway), "float64", "float32", or "bits" (exact int64 bit
@@ -1517,8 +1559,22 @@ class TpuRowGroupReader:
         letting transfers queue asynchronously contends with the host
         staging threads and *triples* staging latency — one outstanding
         transfer at a time is the faster pipeline.  Set to False on
-        locally-attached devices to overlap transfer with staging."""
+        locally-attached devices to overlap transfer with staging.
+
+        ``dict_form``: how flat dictionary-encoded columns materialize —
+        "gather" (dense decoded values; strings as (n, max_len) byte
+        matrices) or "index" (the index stream as ``values``, packed to
+        the narrowest dtype the pool size allows, plus the pool itself in
+        ``DeviceColumn.dict_ref`` — what host row cursors want: fetches
+        shrink 2-8x and values convert once per distinct, not per cell).
+        Plain/mixed string chunks and repeated leaves always gather;
+        DOUBLE under a lossy float policy gathers too (the device
+        conversion semantics cannot be reproduced from the host pool).
+        """
         _require_x64()
+        if dict_form not in ("gather", "index"):
+            raise ValueError(f"bad dict_form {dict_form!r}")
+        self._dict_form = dict_form
         self.reader = source if isinstance(source, ParquetFileReader) else ParquetFileReader(source)
         self.device = device
         if float64_policy not in ("auto", "float64", "float32", "bits"):
@@ -1957,9 +2013,13 @@ class TpuRowGroupReader:
         # assign extras (string dictionaries) in order of first use
         extra_keys: List[tuple] = []
         new_extras: List[tuple] = []
+        host_pools: dict = {}
         specs = []
         for rs in raw_specs:
             key = rs.pop("_extra_key", None)
+            pool = rs.pop("_host_pool", None)
+            if pool is not None:
+                host_pools[rs["name"]] = pool
             if key is not None:
                 if key not in extra_keys:
                     extra_keys.append(key)
@@ -1983,6 +2043,7 @@ class TpuRowGroupReader:
                 else rg.num_rows or 0
             ),
             parts=parts,
+            host_pools=host_pools or None,
         )
 
     # -- launch -------------------------------------------------------------
@@ -2011,7 +2072,10 @@ class TpuRowGroupReader:
         for key, _, _ in extras:
             with self._lock:
                 self._sdict_dev[key] = (shipped[pos], shipped[pos + 1])
-                self._sdict_host.pop(key, None)  # device copy is authoritative
+                if self._dict_form != "index":
+                    # device copy is authoritative; index-form keeps the
+                    # host copy so consumers read pools without a D2H trip
+                    self._sdict_host.pop(key, None)
             pos += 2
         return shipped
 
@@ -2034,7 +2098,22 @@ class TpuRowGroupReader:
         for spec, desc, (vals, mask, lens, defs, reps) in zip(
             sg.program, sg.descs, outs
         ):
-            result[spec.name] = DeviceColumn(desc, vals, mask, lens, defs, reps)
+            dc = DeviceColumn(desc, vals, mask, lens, defs, reps)
+            if spec.kind == "dict_idx":
+                # the engine's content key (digest, cap, max_len) rides
+                # along as the STABLE cache identity — consumers must not
+                # key pool caches by id() (ids are reused after GC)
+                key = sg.extra_keys[spec.extra_idx]
+                with self._lock:
+                    host_pool = self._sdict_host.get(key)
+                dc.dict_ref = (
+                    ("host_str", key, *host_pool)
+                    if host_pool is not None
+                    else ("dev", key, *self._sdict_dev[key])
+                )
+            elif spec.kind == "dict_idx_num":
+                dc.dict_ref = ("host", None, sg.host_pools[spec.name])
+            result[spec.name] = dc
         return result
 
     def _launch(self, sg: _StagedGroup) -> Dict[str, DeviceColumn]:

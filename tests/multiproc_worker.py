@@ -1,0 +1,122 @@
+"""Worker for the real multi-process sharded-read test (not a test file).
+
+Launched by ``test_multiprocess.py`` as 2 OS processes, each owning 4
+virtual CPU devices, joined through ``jax.distributed.initialize``.  Runs
+``read_sharded_global`` (strings + predicate + all-pruned ghost case),
+reshards every global column to fully-replicated so THIS process holds
+the complete global value, and writes a digest the parent compares
+across processes and against a single-process expectation.
+
+Usage: python multiproc_worker.py <coord_addr> <pid> <nproc> <parquet> <out.json>
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+def _digest(*arrays) -> str:
+    h = hashlib.sha256()
+    for a in arrays:
+        if a is None:
+            h.update(b"<none>")
+            continue
+        a = np.asarray(a)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def main() -> None:
+    coord, pid, nproc, path, out_path = sys.argv[1:6]
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(nproc),
+        process_id=int(pid),
+    )
+    assert jax.process_count() == int(nproc), jax.process_count()
+    assert len(jax.devices()) == 4 * int(nproc)
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from parquet_floor_tpu import col
+    from parquet_floor_tpu.parallel.multihost import read_sharded_global
+
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("rg",))
+
+    def replicated(x):
+        """Fetch the FULL global value onto this host (resharding
+        collective — exercises the cross-process layout agreement)."""
+        if x is None:
+            return None
+        full = jax.jit(
+            lambda a: a, out_shardings=NamedSharding(mesh, P())
+        )(x)
+        return np.asarray(full)
+
+    report = {"pid": int(pid)}
+
+    # 1) plain read: strings + optional + int columns, ragged groups
+    out = read_sharded_global(path, mesh, float64_policy="float64")
+    dig = []
+    for name in sorted(out):
+        c = out[name]
+        dig.append(_digest(
+            replicated(c.values), replicated(c.mask),
+            replicated(c.lengths), replicated(c.row_mask),
+        ))
+        report.setdefault("num_rows", {})[name] = c.num_rows
+    report["plain"] = _digest(*[d.encode() for d in dig])
+
+    # 2) predicate read: prunes some groups on statistics
+    out_p = read_sharded_global(
+        path, mesh, predicate=(col("id") >= 2600), float64_policy="float64"
+    )
+    dig_p = []
+    for name in sorted(out_p):
+        c = out_p[name]
+        dig_p.append(_digest(
+            replicated(c.values), replicated(c.mask),
+            replicated(c.lengths), replicated(c.row_mask),
+        ))
+        report.setdefault("num_rows_pred", {})[name] = c.num_rows
+    report["pred"] = _digest(*[d.encode() for d in dig_p])
+
+    # 3) ghost case: a predicate no row can satisfy prunes EVERY group;
+    # typed ghosts must come back via the schema-meta path, identically
+    out_g = read_sharded_global(
+        path, mesh, predicate=(col("id") < -1), float64_policy="float64"
+    )
+    report["ghost"] = _digest(*[
+        _digest(replicated(out_g[n].values)).encode() for n in sorted(out_g)
+    ])
+    report["ghost_rows"] = {n: out_g[n].num_rows for n in sorted(out_g)}
+    report["ghost_dtypes"] = {
+        n: str(np.asarray(out_g[n].values.addressable_shards[0].data).dtype)
+        for n in sorted(out_g)
+    }
+
+    with open(out_path, "w") as f:
+        json.dump(report, f)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -207,3 +207,25 @@ def test_stream_content_to_strings_matches_tpu_rows(tmp_path):
     for hs, row in zip(host_strs, tpu):
         got = [f"{h}={'null' if v is None else v}" for h, v in row]
         assert got == hs
+
+
+def test_shared_pool_distinct_logical_types(tmp_path):
+    """Two dict columns with byte-identical pools but different logical
+    types (STRING vs raw BYTE_ARRAY) must render differently (utf-8 str
+    vs hex) — the pool-cell cache must key on stringify semantics, not
+    pool content alone."""
+    t = types
+    schema = t.message(
+        "t",
+        t.required(t.BYTE_ARRAY).as_(t.string()).named("s"),
+        t.required(t.BYTE_ARRAY).named("raw"),
+    )
+    vals = [f"v{i % 5}" for i in range(500)]
+    path = str(tmp_path / "twin.parquet")
+    with ParquetFileWriter(path, schema) as w:
+        w.write_columns({"s": vals, "raw": [v.encode() for v in vals]})
+    host = _rows(path)
+    tpu = _rows(path, engine="tpu")
+    _assert_rows_equal(tpu, host)
+    assert tpu[0][0][1] == "v0"                    # STRING → utf-8
+    assert tpu[0][1][1] == "0x" + b"v0".hex().upper()  # raw → hex

@@ -784,3 +784,52 @@ def test_native_delta_plan_matches_python():
             assert got[key] == want[key], (key, dt, len(vals))
         for key in ("mb_bytebase", "mb_bw", "mb_min_delta"):
             np.testing.assert_array_equal(got[key], want[key], err_msg=key)
+
+
+def test_dict_form_index_output_and_stable_pool_keys(tmp_path):
+    """dict_form="index": dictionary columns come back as packed index
+    streams; string pools carry the engine's STABLE content key (never
+    id()-keyed — ids recycle after GC and would alias pools) and numeric
+    pools carry key None so consumers convert them fresh per group."""
+    n = 3000
+    rng_l = np.random.default_rng(11)
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("v"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    path = str(tmp_path / "df.parquet")
+    with ParquetFileWriter(
+        path, schema, WriterOptions(row_group_rows=1000)
+    ) as w:
+        for g in range(3):
+            # per-group distinct pools (the aliasing hazard scenario)
+            vals = rng_l.integers(g * 100, g * 100 + 40, 1000) * 1000
+            strs = [f"g{g}-{i % 30}" for i in range(1000)]
+            w.write_columns({"v": [int(x) for x in vals], "s": strs})
+    with TpuRowGroupReader(path, dict_form="index") as t:
+        with ParquetFileReader(path) as hr:
+            for g in range(3):
+                cols = t.read_row_group(g)
+                sv, vv = cols["s"], cols["v"]
+                assert sv.dict_ref is not None and vv.dict_ref is not None
+                skind, skey, srows, slens = sv.dict_ref
+                assert skind in ("host_str", "dev") and skey is not None
+                vkind, vkey, vpool = vv.dict_ref
+                assert vkind == "host" and vkey is None
+                # packed index dtypes: pools are small here
+                assert np.asarray(sv.values).dtype == np.uint8
+                assert np.asarray(vv.values).dtype == np.uint8
+                # exact reconstruction vs the host engine
+                hb = hr.read_row_group(g)
+                want_v = hb.column("v").values
+                got_v = np.asarray(vpool)[np.asarray(vv.values)]
+                np.testing.assert_array_equal(got_v, want_v)
+                srows_np, slens_np = np.asarray(srows), np.asarray(slens)
+                idx = np.asarray(sv.values)
+                got_s = [
+                    srows_np[i, : slens_np[i]].tobytes().decode()
+                    for i in idx[:50]
+                ]
+                want_s = [hb.column("s").cell(i).decode() for i in range(50)]
+                assert got_s == want_s, f"group {g}"

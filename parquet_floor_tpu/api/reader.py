@@ -255,11 +255,12 @@ class ParquetReader:
         # logical types (str vs hex rendering).  Numeric pools are
         # per-group and tiny: convert fresh.
         desc = dc.descriptor
+        # LogicalAnnotation is hashable and captures kind AND params
+        # (e.g. DECIMAL scale — two columns can share a byte-identical
+        # pool yet render at different scales)
         lt = desc.primitive.logical_type
         key = (
-            (ckey, desc.physical_type, getattr(lt, "kind", None))
-            if ckey is not None
-            else None
+            (ckey, desc.physical_type, lt) if ckey is not None else None
         )
         pool = self._pool_cells.get(key) if key is not None else None
         if pool is None:

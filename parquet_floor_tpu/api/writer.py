@@ -85,7 +85,22 @@ class ParquetWriter:
         self._writer = ParquetFileWriter(dest, schema, self.options)
         self._vw = _RowValueWriter(schema)
         self._buffer: List[list] = []
+        self._buffer_bytes = 0
         self._closed = False
+
+    @staticmethod
+    def _row_bytes(slots) -> int:
+        """Rough in-memory size of one buffered row (the row_group_bytes
+        flush estimate — mirrors parquet-mr's memory-size block check)."""
+        total = 0
+        for v in slots:
+            if v is None:
+                total += 1
+            elif isinstance(v, (bytes, str)):
+                total += len(v) + 4
+            else:
+                total += 8
+        return total
 
     def write(self, record: Any) -> None:
         """Dehydrate and buffer one record (``write``, :70-72)."""
@@ -94,8 +109,13 @@ class ParquetWriter:
         self._vw.slots = [None] * len(self.schema.fields)
         self.dehydrator.dehydrate(record, self._vw)
         self._buffer.append(self._vw.slots)
+        gb = self.options.row_group_bytes
+        if gb:
+            self._buffer_bytes += self._row_bytes(self._vw.slots)
         self._vw.slots = None
-        if len(self._buffer) >= self.options.row_group_rows:
+        if len(self._buffer) >= self.options.row_group_rows or (
+            gb and self._buffer_bytes >= gb
+        ):
             self._flush()
 
     def _flush(self) -> None:
@@ -112,6 +132,7 @@ class ParquetWriter:
             columns.append(make_column_data(desc, col))
         self._writer.write_row_group(columns)
         self._buffer = []
+        self._buffer_bytes = 0
 
     def close(self) -> None:
         if not self._closed:

@@ -64,6 +64,14 @@ class WriterOptions:
     page_version: int = 2                         # parity: PARQUET_2_0, :66
     data_page_values: int = 20_000
     row_group_rows: int = 1 << 20
+    # Byte-based thresholds, mirroring parquet-mr's size tunables (its
+    # 1 MiB page / 128 MiB block defaults are what the reference's inert
+    # Configuration pins).  When set they compose with the count limits:
+    # a page closes at whichever bound is hit first (from a per-chunk
+    # average-value-size estimate); the row-at-a-time API writer flushes
+    # a row group when its buffered estimate reaches row_group_bytes.
+    data_page_bytes: Optional[int] = None
+    row_group_bytes: Optional[int] = None
     enable_dictionary: bool = True
     dictionary_max_fraction: float = 0.67  # fall back to PLAIN past this
     dictionary_max_bytes: int = 1 << 20
@@ -240,6 +248,25 @@ class _ColumnChunkWriter:
         chunk_mm = _min_max_bytes(desc, values) if opt.write_statistics else None
         n_pages = 0
         per_page = max(1, opt.data_page_values)
+        if opt.data_page_bytes:
+            # compose the byte bound with the count bound: estimate this
+            # chunk's bytes per level slot and close pages at whichever
+            # limit is hit first (parquet-mr keeps both tunables too)
+            n_slots = max(data.num_values, 1)
+            if dictionary is not None:
+                per_val = max(len(dictionary).bit_length(), 1) / 8
+            elif isinstance(values, ByteArrayColumn):
+                per_val = (values.data.nbytes + 4 * max(len(values), 1)) / max(
+                    len(values), 1
+                )
+            elif isinstance(values, np.ndarray):
+                per_val = values.nbytes / max(values.shape[0], 1)
+            else:
+                per_val = 8
+            per_slot = per_val * (len(values) / n_slots) + (
+                0.25 if desc.max_definition_level else 0
+            )
+            per_page = max(1, min(per_page, int(opt.data_page_bytes / max(per_slot, 0.125))))
         max_def, max_rep = desc.max_definition_level, desc.max_repetition_level
 
         # Page boundaries are in *level* positions; for rep>0 keep whole rows

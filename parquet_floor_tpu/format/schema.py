@@ -271,14 +271,71 @@ class PrimitiveType(SchemaNode):
         return hash((self.name, self.physical_type, self.repetition))
 
     def stringify(self, value) -> str:
-        """Debug stringifier; parity with per-type ``stringifier()`` used at
-        reference ``ParquetReader.java:147-163``."""
+        """Debug stringifier; parity with the per-type ``stringifier()``
+        used at reference ``ParquetReader.java:147-163``.  Like
+        parquet-mr's ``PrimitiveStringifier`` family, rendering is
+        logical-type aware: DECIMAL scales the unscaled integer, DATE and
+        TIME/TIMESTAMP render ISO forms at their annotated unit, UUID is
+        canonical 8-4-4-4-12, INTERVAL decomposes its (months, days,
+        millis) triple; annotated strings decode UTF-8 and raw binary
+        renders ``0x`` hex."""
         if value is None:
             return "null"
+        lt = self.logical_type
+        k = lt.kind if lt is not None else None
+        if k == "DECIMAL":
+            from decimal import Decimal
+
+            unscaled = (
+                int.from_bytes(value, "big", signed=True)
+                if isinstance(value, bytes)
+                else int(value)
+            )
+            # exact construction from (sign, digits, exponent): context
+            # arithmetic (scaleb/division) would round past 28 digits
+            digits = tuple(int(c) for c in str(abs(unscaled)))
+            return str(Decimal((
+                int(unscaled < 0), digits, -int(lt.params.get("scale", 0))
+            )))
+        if k == "DATE" and not isinstance(value, bytes):
+            from datetime import date, timedelta
+
+            return (date(1970, 1, 1) + timedelta(days=int(value))).isoformat()
+        if k == "TIME" and not isinstance(value, bytes):
+            v = int(value)
+            unit = lt.params.get("unit", "MICROS")
+            per_s = {"MILLIS": 10**3, "MICROS": 10**6, "NANOS": 10**9}[unit]
+            digits = {"MILLIS": 3, "MICROS": 6, "NANOS": 9}[unit]
+            s, frac = divmod(v, per_s)
+            h, s = divmod(s, 3600)
+            m, s = divmod(s, 60)
+            return f"{h:02d}:{m:02d}:{s:02d}.{frac:0{digits}d}"
+        if k == "TIMESTAMP" and not isinstance(value, bytes):
+            from datetime import datetime, timedelta
+
+            v = int(value)
+            unit = lt.params.get("unit", "MICROS")
+            if unit == "NANOS":
+                micro, nano_rem = divmod(v, 1000)
+                dt = datetime(1970, 1, 1) + timedelta(microseconds=micro)
+                return dt.isoformat(timespec="microseconds") + f"{nano_rem:03d}"
+            micros = v * 1000 if unit == "MILLIS" else v
+            dt = datetime(1970, 1, 1) + timedelta(microseconds=micros)
+            return dt.isoformat(
+                timespec="milliseconds" if unit == "MILLIS" else "microseconds"
+            )
+        if k == "UUID" and isinstance(value, bytes) and len(value) == 16:
+            import uuid as _uuid
+
+            return str(_uuid.UUID(bytes=value))
+        if k == "INTERVAL" and isinstance(value, bytes) and len(value) == 12:
+            months, days, millis = (
+                int.from_bytes(value[i : i + 4], "little") for i in (0, 4, 8)
+            )
+            return f"interval({months} months, {days} days, {millis} millis)"
         if self.physical_type in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
             if isinstance(value, bytes):
-                lt = self.logical_type
-                if lt is not None and lt.kind in ("STRING", "ENUM", "JSON"):
+                if k in ("STRING", "ENUM", "JSON"):
                     return value.decode("utf-8", errors="replace")
                 return "0x" + value.hex().upper()
             return str(value)

@@ -249,3 +249,50 @@ def test_delta_byte_array_write(tmp_path):
         dc = tr.read_row_group(0)["s"]
         rows = np.asarray(dc.values); lens = np.asarray(dc.lengths)
         assert rows[0, : lens[0]].tobytes().decode() == vals[0]
+
+
+def test_byte_based_page_and_group_thresholds(tmp_path):
+    """parquet-mr-style size tunables: data_page_bytes closes pages by
+    estimated size (composed with the count bound) and row_group_bytes
+    flushes the row-at-a-time writer by buffered estimate."""
+    from parquet_floor_tpu import ParquetWriter
+    from parquet_floor_tpu.api.hydrate import FnDehydrator
+
+    t = types
+    schema = t.message(
+        "t",
+        t.required(t.INT64).named("i"),
+        t.required(t.BYTE_ARRAY).as_(t.string()).named("s"),
+    )
+    n = 4000
+    # ~102 B/row estimate → groups of ~1000 rows at 100 KiB, pages of
+    # ~40 rows at 4 KiB
+    path = str(tmp_path / "bytes.parquet")
+    opts = WriterOptions(
+        enable_dictionary=False,
+        data_page_bytes=1 << 12,
+        row_group_bytes=100 << 10,
+    )
+    rows = [(i, "x" * 90) for i in range(n)]
+    ParquetWriter.write_file(
+        schema, path,
+        FnDehydrator(lambda r, w: (w.write("i", r[0]), w.write("s", r[1]))),
+        rows, options=opts,
+    )
+    with ParquetFileReader(path) as r:
+        groups = r.row_groups
+        assert len(groups) > 1, "row_group_bytes must split groups"
+        # every group's total uncompressed size respects the ballpark
+        for rg in groups[:-1]:
+            assert (rg.num_rows or 0) < n
+        # pages: OffsetIndex shows multiple pages per chunk
+        oi = r.read_offset_index(groups[0].columns[1])
+        assert oi is not None and len(oi.page_locations) > 1
+        batch = r.read_row_group(0)
+        assert batch.column("s").cell(0) == b"x" * 90
+    # full-content check via the host reader
+    total = 0
+    with ParquetFileReader(path) as r:
+        for gi in range(len(r.row_groups)):
+            total += r.read_row_group(gi).num_rows
+    assert total == n

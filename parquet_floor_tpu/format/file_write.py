@@ -256,7 +256,10 @@ class _ColumnChunkWriter:
             if dictionary is not None:
                 per_val = max(len(dictionary).bit_length(), 1) / 8
             elif isinstance(values, ByteArrayColumn):
-                per_val = (values.data.nbytes + 4 * max(len(values), 1)) / max(
+                # content size from offsets, not the backing pool: the
+                # column may reference a subrange of a larger shared pool
+                content = int(values.offsets[-1] - values.offsets[0])
+                per_val = (content + 4 * max(len(values), 1)) / max(
                     len(values), 1
                 )
             elif isinstance(values, np.ndarray):

@@ -101,6 +101,10 @@ class LogicalAnnotation:
             lt.UUID = pt.UUIDType()
         elif k == "FLOAT16":
             lt.FLOAT16 = pt.Float16Type()
+        elif k == "INTERVAL":
+            # legacy-only annotation: the thrift LogicalType union never
+            # gained INTERVAL — it rides ConvertedType alone
+            return None
         else:
             raise ValueError(f"unknown logical annotation {k}")
         return lt
@@ -137,6 +141,10 @@ class LogicalAnnotation:
             ConvertedType.DATE: cls("DATE"),
             ConvertedType.MAP: cls("MAP"),
             ConvertedType.LIST: cls("LIST"),
+            # INTERVAL exists only as a legacy ConvertedType (the thrift
+            # LogicalType union never gained it) — parquet-mr files carry
+            # it on FLBA(12) columns
+            ConvertedType.INTERVAL: cls("INTERVAL"),
             ConvertedType.TIME_MILLIS: cls("TIME", utc=True, unit="MILLIS"),
             ConvertedType.TIME_MICROS: cls("TIME", utc=True, unit="MICROS"),
             ConvertedType.TIMESTAMP_MILLIS: cls("TIMESTAMP", utc=True, unit="MILLIS"),
@@ -165,6 +173,7 @@ class LogicalAnnotation:
             "MAP": ConvertedType.MAP,
             "LIST": ConvertedType.LIST,
             "DECIMAL": ConvertedType.DECIMAL,
+            "INTERVAL": ConvertedType.INTERVAL,
         }
         if k in m:
             return m[k]

@@ -365,3 +365,46 @@ def test_logical_stringifiers_through_strings_reader(tmp_path):
         path, lambda c: _RowHydrator(), engine="tpu"
     ))
     assert [f"{h}={v}" for h, v in tpu[0]] == rows[0]
+
+
+def test_interval_roundtrip_and_stringify(tmp_path):
+    """INTERVAL rides the legacy ConvertedType alone (the thrift
+    LogicalType union never gained it): a written FLBA(12) INTERVAL
+    column reads back with the annotation intact and stringifies to the
+    decomposed (months, days, millis) form."""
+    import numpy as np
+
+    from parquet_floor_tpu import (
+        ParquetFileReader, ParquetFileWriter, ParquetReader, types as t,
+    )
+    from parquet_floor_tpu.format.schema import (
+        LogicalAnnotation, MessageType, PrimitiveType,
+    )
+    from parquet_floor_tpu.format.parquet_thrift import (
+        ConvertedType, Type as PT,
+    )
+
+    schema = MessageType("t", [
+        PrimitiveType("iv", PT.FIXED_LEN_BYTE_ARRAY, type_length=12,
+                      logical_type=LogicalAnnotation("INTERVAL")),
+    ])
+    iv = (
+        (14).to_bytes(4, "little") + (3).to_bytes(4, "little")
+        + (500).to_bytes(4, "little")
+    )
+    rows = np.frombuffer(iv + iv, np.uint8).reshape(2, 12)
+    path = str(tmp_path / "iv.parquet")
+    with ParquetFileWriter(path, schema) as w:
+        w.write_columns({"iv": rows})
+    with ParquetFileReader(path) as r:
+        prim = r.schema.columns[0].primitive
+        assert prim.logical_type is not None
+        assert prim.logical_type.kind == "INTERVAL"
+    # footer carries converted_type INTERVAL and no logicalType
+    with ParquetFileReader(path) as r:
+        els = r.metadata.file_meta.schema
+        el = [e for e in els if e.name == "iv"][0]
+        assert el.converted_type == ConvertedType.INTERVAL
+        assert el.logicalType is None
+    strs = list(ParquetReader.stream_content_to_strings(path))
+    assert strs[0] == ["iv=interval(14 months, 3 days, 500 millis)"]

@@ -25,6 +25,13 @@ _CODECS = [
     CompressionCodec.ZSTD,
     CompressionCodec.LZ4_RAW,
 ]
+try:  # system-library codec joins the soak where present
+    from parquet_floor_tpu.format import brotli_codec as _bc
+
+    if _bc.available() and _bc.encoder_available():
+        _CODECS.append(CompressionCodec.BROTLI)
+except Exception:  # pragma: no cover
+    pass
 
 
 def _random_column(rng, n, idx):
@@ -94,6 +101,9 @@ def test_random_roundtrip(tmp_path, seed, monkeypatch):
         codec=int(rng.choice(_CODECS)),
         page_version=int(rng.choice([1, 2])),
         data_page_values=int(rng.choice([97, 500, 20_000])),
+        data_page_bytes=(
+            int(rng.choice([1 << 10, 1 << 14])) if rng.integers(0, 2) else None
+        ),
         enable_dictionary=bool(rng.integers(0, 2)),
         delta_integers=bool(rng.integers(0, 2)),
         byte_stream_split_floats=bool(rng.integers(0, 2)),

@@ -64,7 +64,11 @@ class LogicalAnnotation:
 
     # --- thrift conversion -------------------------------------------------
 
-    def to_thrift(self) -> LogicalType:
+    def to_thrift(self) -> Optional[LogicalType]:
+        """Thrift LogicalType for this annotation — or None for
+        INTERVAL, which exists only as a legacy ConvertedType (callers
+        must treat the logicalType field as absent and rely on
+        ``to_converted``)."""
         lt = LogicalType()
         k, p = self.kind, self.params
         if k == "STRING":

@@ -129,3 +129,75 @@ def test_native_delta_plan_survives_hostile_bytes():
             # parse succeeded: plan fields must at least be self-consistent
             assert got["values_per_miniblock"] > 0
             assert len(got["mb_bw"]) >= 1
+
+
+def test_brotli_corruption_raises_cleanly(tmp_path):
+    """Bit-flipped BROTLI pages must never hang or crash the process;
+    clean raises are expected for most flips.  (Silent wrongness on the
+    rare surviving flip isn't asserted here — same stance as
+    test_bit_flips_never_hang_or_crash.)"""
+    from parquet_floor_tpu.format import brotli_codec
+    from parquet_floor_tpu.format.parquet_thrift import CompressionCodec
+
+    if not (brotli_codec.available() and brotli_codec.encoder_available()):
+        pytest.skip("system brotli library not present")
+    schema = types.message("t", types.required(types.INT64).named("a"))
+    path = tmp_path / "b.parquet"
+    rng = np.random.default_rng(4)
+    with ParquetFileWriter(
+        path, schema, WriterOptions(codec=CompressionCodec.BROTLI)
+    ) as w:
+        w.write_columns({"a": rng.integers(0, 1 << 40, 4000).astype(np.int64)})
+    data = bytearray(path.read_bytes())
+    # flip bytes inside the data region (past magic, before footer)
+    for _ in range(40):
+        bad = bytearray(data)
+        i = int(rng.integers(8, len(bad) - 2000))
+        bad[i] ^= 1 << int(rng.integers(0, 8))
+        try:
+            _full_decode(bytes(bad), tmp_path)
+        except Exception:
+            pass  # any clean raise is acceptable
+    # exact roundtrip of the unflipped file still holds
+    with ParquetFileReader(str(path)) as r:
+        assert r.read_row_group(0).num_rows == 4000
+
+
+def test_tpu_row_api_on_corrupt_file_raises_wrapped(tmp_path, monkeypatch):
+    """engine='tpu' wraps hostile-file failures in the same
+    'Failed to read parquet' RuntimeError as the host engine.  The
+    corruption trashes the first Snappy page body wholesale, so decode
+    MUST fail — the parity assertion always executes."""
+    from parquet_floor_tpu import CompressionCodec, ParquetReader
+
+    monkeypatch.setenv("PFTPU_PALLAS", "0")
+    schema = types.message("t", types.required(types.INT64).named("a"))
+    path = tmp_path / "c.parquet"
+    with ParquetFileWriter(
+        path, schema, WriterOptions(codec=CompressionCodec.SNAPPY)
+    ) as w:
+        w.write_columns({"a": np.arange(2000, dtype=np.int64)})
+    data = bytearray(path.read_bytes())
+    # obliterate 64 bytes of the first page's compressed payload (well
+    # past the ~20-byte page header, far before the footer)
+    for i in range(40, 104):
+        data[i] = 0xA5
+    bad = tmp_path / "cbad.parquet"
+    bad.write_bytes(bytes(data))
+
+    class _H:
+        def start(self):
+            return []
+
+        def add(self, t_, h, v):
+            t_.append(v)
+            return t_
+
+        def finish(self, t_):
+            return tuple(t_)
+
+    for engine in ("host", "tpu"):
+        with pytest.raises(RuntimeError, match="Failed to read parquet"):
+            list(ParquetReader.stream_content(
+                str(bad), lambda c: _H(), engine=engine
+            ))

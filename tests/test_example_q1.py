@@ -1,0 +1,32 @@
+"""The device-resident analytics example (examples/tpch_q1.py) must stay
+exact: fused decode feeding jnp segment aggregation, verified against
+the host NumPy engine on the CPU mesh."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.workloads import write_lineitem  # noqa: E402
+from examples.tpch_q1 import q1_device, q1_host_reference  # noqa: E402
+from parquet_floor_tpu.tpu.engine import TpuRowGroupReader  # noqa: E402
+
+
+def test_q1_device_matches_host(tmp_path):
+    path = str(tmp_path / "li.parquet")
+    write_lineitem(path, 20_000)
+    want = [
+        "l_quantity", "l_extendedprice", "l_discount", "l_tax",
+        "l_shipdate", "l_returnflag", "l_linestatus",
+    ]
+    total = None
+    with TpuRowGroupReader(path, float64_policy="bits") as r:
+        for cols in r.iter_row_groups(columns=want):
+            part = q1_device(cols)
+            total = part if total is None else total + part
+    acc = np.asarray(total)
+    ref = q1_host_reference(path)
+    np.testing.assert_allclose(acc[:, :6], ref[:, :6], rtol=1e-9)
+    assert acc[:, 5].sum() > 0  # rows survived the date filter

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """TPC-H Q1 ("pricing summary report") computed ENTIRELY on device from a
 Parquet file: fused decode → jnp segment aggregation, no decoded bytes
-ever crossing back to the host until the 4-group result table.
+ever crossing back to the host until the 6-group result table.
 
 This is the end-to-end shape the framework exists for: the reference's
 row loop would box 1M rows through per-cell virtual dispatch

@@ -173,7 +173,7 @@ class ParquetReader:
     """
 
     def __init__(self, source, hydrator_supplier, columns: Optional[Sequence[str]] = None,
-                 engine: str = "host"):
+                 engine: str = "host", predicate=None):
         if engine not in ("host", "tpu", "auto"):
             raise ValueError(f"bad engine {engine!r}: expected host|tpu|auto")
         if engine == "auto":
@@ -192,6 +192,18 @@ class ParquetReader:
             {c.path[0] for c in selected} if columns else None
         )
         self.hydrator: Hydrator = supplier_of(hydrator_supplier).get(selected)
+        # predicate pushdown (native win, no reference counterpart): row
+        # groups whose statistics/Bloom filters prove no row can match
+        # are skipped before any page is read, on either engine
+        try:
+            self._keep: Optional[Set[int]] = (
+                set(predicate.row_groups(self._reader))
+                if predicate is not None
+                else None
+            )
+        except BaseException:
+            self._reader.close()  # don't leak the open file
+            raise
         self._rg_index = 0
         self._row = 0
         self._cursors: Optional[List[_ColumnCursor]] = None
@@ -199,6 +211,7 @@ class ParquetReader:
         self._finished = False
         self._tpu = None
         self._tpu_gen = None
+        self._tpu_pending: list = []
         self._conv_fut = None
         self._conv_pool = None
         if engine == "tpu" and selected:
@@ -235,8 +248,15 @@ class ParquetReader:
         return self._reader.metadata
 
     def estimate_size(self) -> int:
-        """Exact total row count from the footer (:219-222)."""
-        return self._reader.record_count
+        """Exact total row count from the footer (:219-222); with a
+        predicate, the rows of the surviving row groups."""
+        if self._keep is None:
+            return self._reader.record_count
+        return sum(
+            int(rg.num_rows or 0)
+            for i, rg in enumerate(self._reader.row_groups)
+            if i in self._keep
+        )
 
     # -- iteration ---------------------------------------------------------
 
@@ -347,20 +367,37 @@ class ParquetReader:
 
     def _advance_row_group_tpu(self) -> bool:
         n_groups = len(self._reader.row_groups)
-        while self._rg_index < n_groups:
+        while True:
             if self._tpu_gen is None:
+                # ONE ordered kept-index list drives the generator, the
+                # pairing of decoded groups with footer rows, and the
+                # prefetch decision — _rg_index keeps the host path's
+                # meaning (the group just consumed is _rg_index - 1), so
+                # state()/restore() agree across engines and predicates
+                pending = [
+                    i for i in range(self._rg_index, n_groups)
+                    if self._keep is None or i in self._keep
+                ]
+                if not pending:
+                    self._finished = True
+                    return False
                 names = [c.path[0] for c in self.columns]
+                self._tpu_pending = pending
                 self._tpu_gen = self._tpu.iter_row_groups(
-                    columns=names, indices=range(self._rg_index, n_groups)
+                    columns=names, indices=list(pending)
                 )
+            if not self._tpu_pending:
+                self._finished = True
+                return False
             if self._conv_fut is not None:
                 cursors = self._conv_fut.result()
                 self._conv_fut = None
             else:
                 cursors = self._pull_convert_tpu()
-            rg_rows = int(self._reader.row_groups[self._rg_index].num_rows or 0)
-            self._rg_index += 1
-            if self._rg_index < n_groups:
+            idx = self._tpu_pending.pop(0)
+            rg_rows = int(self._reader.row_groups[idx].num_rows or 0)
+            self._rg_index = idx + 1
+            if self._tpu_pending:
                 # convert the NEXT group in the background while the
                 # caller hydrates this one: the device→host transfer
                 # releases the GIL, so the fetch cost hides under the
@@ -377,13 +414,14 @@ class ParquetReader:
             self._row = 0
             if self._rg_rows > 0:
                 return True
-        self._finished = True
-        return False
 
     def _advance_row_group(self) -> bool:
         if self._tpu is not None:
             return self._advance_row_group_tpu()
         while self._rg_index < len(self._reader.row_groups):
+            if self._keep is not None and self._rg_index not in self._keep:
+                self._rg_index += 1  # predicate-pruned group
+                continue
             batch = self._reader.read_row_group(self._rg_index, self._filter)
             self._rg_index += 1
             ordered = []
@@ -512,21 +550,27 @@ class ParquetReader:
 
     @staticmethod
     def stream_content(source, hydrator_supplier, columns: Optional[Sequence[str]] = None,
-                       engine: str = "host"):
+                       engine: str = "host", predicate=None):
         """Stream hydrated records (``streamContent``, :47-61).
 
         Returns an iterator that owns the file and closes it on exhaustion
         or ``.close()`` (stream-close parity, :80-84).  ``engine="tpu"``
-        hydrates the same rows from fused device-decoded column batches.
+        hydrates the same rows from fused device-decoded column batches;
+        ``predicate`` (see ``parquet_floor_tpu.col``) skips row groups
+        whose statistics/Bloom filters prove no row can match.  This is
+        GROUP-level pushdown, not row filtering: a surviving group
+        streams in full, including its rows that do not match.
         """
-        reader = ParquetReader(source, hydrator_supplier, columns, engine=engine)
+        reader = ParquetReader(source, hydrator_supplier, columns,
+                               engine=engine, predicate=predicate)
         return _ClosingIterator(reader)
 
     @staticmethod
     def spliterator(source, hydrator_supplier, columns: Optional[Sequence[str]] = None,
-                    engine: str = "host") -> "ParquetReader":
+                    engine: str = "host", predicate=None) -> "ParquetReader":
         """The raw cursor object (``spliterator``, :63-78)."""
-        return ParquetReader(source, hydrator_supplier, columns, engine=engine)
+        return ParquetReader(source, hydrator_supplier, columns,
+                             engine=engine, predicate=predicate)
 
     @staticmethod
     def read_metadata(source) -> ParquetMetadata:

@@ -229,3 +229,84 @@ def test_shared_pool_distinct_logical_types(tmp_path):
     _assert_rows_equal(tpu, host)
     assert tpu[0][0][1] == "v0"                    # STRING → utf-8
     assert tpu[0][1][1] == "0x" + b"v0".hex().upper()  # raw → hex
+
+
+def test_row_api_predicate_pushdown(tmp_path):
+    """stream_content(predicate=...) skips statistics-pruned row groups
+    before any page is read, identically on both engines; estimate_size
+    reports the surviving rows."""
+    from parquet_floor_tpu import col
+
+    t = types
+    schema = t.message("t", t.required(t.INT64).named("k"),
+                       t.optional(t.BYTE_ARRAY).as_(t.string()).named("s"))
+    path = str(tmp_path / "pred.parquet")
+    with ParquetFileWriter(
+        path, schema, WriterOptions(row_group_rows=100)
+    ) as w:
+        for g in range(5):
+            w.write_columns({
+                "k": list(range(g * 1000, g * 1000 + 100)),
+                "s": [None if i % 9 == 0 else f"g{g}s{i}" for i in range(100)],
+            })
+    pred = col("k") >= 3000  # keeps groups 3, 4
+    for engine in ("host", "tpu"):
+        rows = list(ParquetReader.stream_content(
+            path, lambda c: _RowHydrator(), engine=engine, predicate=pred
+        ))
+        assert len(rows) == 200, (engine, len(rows))
+        assert rows[0][0] == ("k", 3000)
+        assert rows[-1][0] == ("k", 4099)
+    # both engines byte-identical under the predicate
+    host = list(ParquetReader.stream_content(
+        path, lambda c: _RowHydrator(), predicate=pred))
+    tpu = list(ParquetReader.stream_content(
+        path, lambda c: _RowHydrator(), engine="tpu", predicate=pred))
+    _assert_rows_equal(tpu, host)
+    with ParquetReader.spliterator(
+        path, lambda c: _RowHydrator(), predicate=pred
+    ) as r:
+        assert r.estimate_size() == 200
+    # a predicate nothing satisfies yields an empty stream, no error
+    none = list(ParquetReader.stream_content(
+        path, lambda c: _RowHydrator(), engine="tpu",
+        predicate=col("k") < -5,
+    ))
+    assert none == []
+
+
+def test_row_api_predicate_straddling_group_and_state(tmp_path):
+    """Group-level semantics: a surviving group streams in full
+    (including non-matching rows), and state()/restore() stay coherent
+    under a predicate on both engines."""
+    from parquet_floor_tpu import col
+
+    t = types
+    schema = t.message("t", t.required(t.INT64).named("k"))
+    path = str(tmp_path / "strad.parquet")
+    with ParquetFileWriter(
+        path, schema, WriterOptions(row_group_rows=100)
+    ) as w:
+        for g in range(4):
+            w.write_columns({"k": list(range(g * 100, g * 100 + 100))})
+    pred = col("k") >= 150  # group 1 straddles: kept whole
+    for engine in ("host", "tpu"):
+        rows = [v for ((_, v),) in ParquetReader.stream_content(
+            path, lambda c: _RowHydrator(), engine=engine, predicate=pred
+        )]
+        # groups 1..3 survive IN FULL (group-level pushdown, not rows)
+        assert rows == list(range(100, 400)), (engine, rows[:3], len(rows))
+    # checkpoint mid-first-surviving-group, restore into a fresh reader
+    with ParquetReader.spliterator(
+        path, lambda c: _RowHydrator(), engine="tpu", predicate=pred
+    ) as r:
+        first = [next(r) for _ in range(30)]
+        st = r.state()
+        rest = [*r]
+    assert st["row_group"] == 1 and st["row_in_group"] == 30, st
+    with ParquetReader.spliterator(
+        path, lambda c: _RowHydrator(), engine="tpu", predicate=pred
+    ) as r2:
+        resumed = [*r2.restore(st)]
+    assert resumed == rest
+    assert [v for ((_, v),) in first + rest] == list(range(100, 400))

@@ -833,3 +833,45 @@ def test_dict_form_index_output_and_stable_pool_keys(tmp_path):
                 ]
                 want_s = [hb.column("s").cell(i).decode() for i in range(50)]
                 assert got_s == want_s, f"group {g}"
+
+
+def test_dict_form_index_selective_ranges(tmp_path):
+    """read_row_group_ranges composes with dict_form="index": only
+    intersecting pages stage, and the index stream + pool reconstruct
+    the covered rows exactly."""
+    from parquet_floor_tpu import col
+
+    n = 6000
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    path = str(tmp_path / "sel.parquet")
+    with ParquetFileWriter(
+        path, schema, WriterOptions(data_page_values=500)
+    ) as w:
+        w.write_columns({
+            "k": list(range(n)),
+            "s": [f"v{i % 40}" for i in range(n)],
+        })
+    with TpuRowGroupReader(path, dict_form="index") as t:
+        ranges = (col("k") >= 4200).row_ranges(t.reader, 0)
+        cols, covered = t.read_row_group_ranges(0, ranges)
+        assert covered and covered[0][0] <= 4200
+        total = sum(b - a for a, b in covered)
+        sv = cols["s"]
+        assert sv.dict_ref is not None
+        kind, key, rows_p, lens_p = sv.dict_ref
+        idx = np.asarray(sv.values)
+        assert len(idx) == total
+        rows_np, lens_np = np.asarray(rows_p), np.asarray(lens_p)
+        start = covered[0][0]
+        for off in (0, total // 2, total - 1):
+            i = int(idx[off])
+            got = rows_np[i, : lens_np[i]].tobytes().decode()
+            assert got == f"v{(start + off) % 40}", (off, got)
+        kv = np.asarray(cols["k"].values)
+        np.testing.assert_array_equal(
+            kv, np.arange(start, start + total)
+        )

@@ -38,39 +38,33 @@ _STATUS = [b"O", b"F"]
 _CUTOFF_DAYS = 10471  # 1998-09-02 as days since epoch
 
 
-def q1_device(cols, cutoff=_CUTOFF_DAYS):
-    """One row group's Q1 partial aggregates, fully on device.
+def q1_agg(qty, price, disc, tax, ship, rf_b, ls_b, row_mask=None,
+           cutoff=_CUTOFF_DAYS):
+    """The Q1 segment aggregation over raw device arrays — shared by the
+    single-chip and mesh-sharded examples (jit-compatible; reducing over
+    a sharded row axis makes XLA insert the cross-device combine).
 
-    ``cols`` is the TpuRowGroupReader output dict.  Returns a (6, 7)
-    array: per (returnflag×linestatus) segment — sum_qty, sum_base,
+    DOUBLE columns decoded under ``float64_policy='bits'`` arrive as
+    int64 bit patterns and are bitcast back here.  Returns a (6, 7)
+    array: per (returnflag × linestatus) segment — sum_qty, sum_base,
     sum_disc_price, sum_charge, sum_disc, count, (spare 0).
     """
+    import jax
     import jax.numpy as jnp
 
-    qty = cols["l_quantity"].values
-    price = cols["l_extendedprice"].values
-    disc = cols["l_discount"].values
-    tax = cols["l_tax"].values
-    ship = cols["l_shipdate"].values
     if qty.dtype == jnp.int64:  # float64_policy='bits'
-        qty = jnp.asarray(qty).view(jnp.float64)
-        price = jnp.asarray(price).view(jnp.float64)
-        disc = jnp.asarray(disc).view(jnp.float64)
-        tax = jnp.asarray(tax).view(jnp.float64)
-
-    # group key from the two 1-byte dictionary strings: first byte of
-    # each padded row (both columns are single-char)
-    rf = cols["l_returnflag"]
-    ls = cols["l_linestatus"]
-    rf_b = rf.values[:, 0].astype(jnp.int32)
-    ls_b = ls.values[:, 0].astype(jnp.int32)
+        qty = jax.lax.bitcast_convert_type(qty, jnp.float64)
+        price = jax.lax.bitcast_convert_type(price, jnp.float64)
+        disc = jax.lax.bitcast_convert_type(disc, jnp.float64)
+        tax = jax.lax.bitcast_convert_type(tax, jnp.float64)
     flag_ids = jnp.zeros_like(rf_b)
     for i, f in enumerate(_FLAGS):
         flag_ids = jnp.where(rf_b == f[0], i, flag_ids)
-    status_ids = jnp.where(ls_b == _STATUS[0][0], 0, 1)
-    seg = flag_ids * 2 + status_ids
+    seg = flag_ids * 2 + jnp.where(ls_b == _STATUS[0][0], 0, 1)
 
     keep = ship <= cutoff
+    if row_mask is not None:
+        keep = keep & row_mask
     w = keep.astype(qty.dtype)
     disc_price = price * (1.0 - disc)
     charge = disc_price * (1.0 + tax)
@@ -87,6 +81,26 @@ def q1_device(cols, cutoff=_CUTOFF_DAYS):
         seg_sum(jnp.ones_like(qty)),
         jnp.zeros(6, qty.dtype),
     ], axis=1)
+
+
+def q1_device(cols, cutoff=_CUTOFF_DAYS):
+    """One row group's Q1 partial aggregates, fully on device.
+
+    ``cols`` is the TpuRowGroupReader output dict; the group key comes
+    from the first byte of each padded single-char string row.
+    """
+    import jax.numpy as jnp
+
+    return q1_agg(
+        cols["l_quantity"].values,
+        cols["l_extendedprice"].values,
+        cols["l_discount"].values,
+        cols["l_tax"].values,
+        cols["l_shipdate"].values,
+        cols["l_returnflag"].values[:, 0].astype(jnp.int32),
+        cols["l_linestatus"].values[:, 0].astype(jnp.int32),
+        cutoff=cutoff,
+    )
 
 
 def q1_host_reference(path, cutoff=_CUTOFF_DAYS):

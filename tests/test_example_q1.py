@@ -30,3 +30,34 @@ def test_q1_device_matches_host(tmp_path):
     ref = q1_host_reference(path)
     np.testing.assert_allclose(acc[:, :6], ref[:, :6], rtol=1e-9)
     assert acc[:, 5].sum() > 0  # rows survived the date filter
+
+
+def test_q1_sharded_matches_host(tmp_path):
+    """The mesh-parallel Q1 (sharded read + XLA-inserted reduction) is
+    exact on the 8-device CPU mesh and replicates its result."""
+    import jax
+    from jax.sharding import Mesh
+
+    from examples.tpch_q1_sharded import q1_sharded
+
+    path = str(tmp_path / "li8.parquet")
+    # 8 REAL row groups: every device holds real rows, so the
+    # cross-device combine sums non-trivial partials (plus ragged last
+    # group -> row_mask path)
+    write_lineitem(path, 15_500, row_group_rows=2_000)
+    from parquet_floor_tpu.parallel.multihost import read_sharded_global
+
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("rg",))
+    want = [
+        "l_quantity", "l_extendedprice", "l_discount", "l_tax",
+        "l_shipdate", "l_returnflag", "l_linestatus",
+    ]
+    # 'bits' exercises the int64-bitcast branch main() uses on real TPU
+    out = read_sharded_global(path, mesh, columns=want,
+                              float64_policy="bits")
+    acc = q1_sharded(out)
+    ref = q1_host_reference(path)
+    np.testing.assert_allclose(np.asarray(acc)[:, :6], ref[:, :6], rtol=1e-9)
+    # the reduction's output is replicated across the whole mesh
+    assert len(acc.sharding.device_set) == len(jax.devices())
+    assert np.asarray(acc)[:, 5].sum() > 0

@@ -310,3 +310,30 @@ def test_row_api_predicate_straddling_group_and_state(tmp_path):
         resumed = [*r2.restore(st)]
     assert resumed == rest
     assert [v for ((_, v),) in first + rest] == list(range(100, 400))
+
+
+def test_bench_config_row_parity(tmp_path):
+    """The five BASELINE configs' own workload generators, driven through
+    both engines of the declarative row API: configs 1-4 must produce
+    byte-identical rows; config 5 (nested) must refuse identically
+    through both (the facade's flat guard)."""
+    from benchmarks import workloads as w
+
+    gens = [
+        ("cfg1", lambda p: w.write_int64_plain(p, 3000)),
+        ("cfg2", lambda p: w.write_lineitem(p, 2500, row_group_rows=800)),
+        ("cfg3", lambda p: w.write_taxi_like(p, 2500)),
+        ("cfg4", lambda p: w.write_wide_delta(p, n_rows=200, n_cols=40)),
+    ]
+    for name, gen in gens:
+        path = str(tmp_path / f"{name}.parquet")
+        gen(path)
+        host = _rows(path)
+        tpu = _rows(path, engine="tpu")
+        _assert_rows_equal(tpu, host)
+        assert len(host) > 0, name
+    path5 = str(tmp_path / "cfg5.parquet")
+    w.write_nested_list(path5, 500)
+    for engine in ("host", "tpu"):
+        with pytest.raises(RuntimeError, match="Failed to read parquet"):
+            _rows(path5, engine=engine)

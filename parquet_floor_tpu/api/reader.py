@@ -560,7 +560,15 @@ class ParquetReader:
         whose statistics/Bloom filters prove no row can match.  This is
         GROUP-level pushdown, not row filtering: a surviving group
         streams in full, including its rows that do not match.
+
+        ``source`` may be a LIST/TUPLE of sources (a dataset): rows
+        stream file after file in order, with one file open at a time;
+        every file must carry the same schema as the first.
         """
+        if isinstance(source, (list, tuple)):
+            return _DatasetIterator(
+                list(source), hydrator_supplier, columns, engine, predicate
+            )
         reader = ParquetReader(source, hydrator_supplier, columns,
                                engine=engine, predicate=predicate)
         return _ClosingIterator(reader)
@@ -599,6 +607,95 @@ class ParquetReader:
             return _StringsHydrator(len(columns))
 
         return ParquetReader.stream_content(source, supplier, None)
+
+
+class _DatasetIterator:
+    """Row stream over a list of files, one open file at a time.
+
+    The first file's schema is the dataset contract: every later file
+    must present identical column paths and physical types (checked at
+    the file boundary, before any of its rows are yielded).
+    """
+
+    def __init__(self, sources, hydrator_supplier, columns, engine, predicate):
+        if not sources:
+            raise ValueError("dataset stream needs at least one source")
+        self._sources = sources
+        self._supplier = hydrator_supplier
+        self._columns = columns
+        self._engine = engine
+        self._predicate = predicate
+        self._i = 0
+        self._schema_key = None
+        self._current: Optional[_ClosingIterator] = None
+        self._closed = False
+
+    def _open_next(self) -> bool:
+        if self._i >= len(self._sources):
+            return False
+        from ..format.schema import dataset_schema_key
+
+        reader = ParquetReader(
+            self._sources[self._i], self._supplier, self._columns,
+            engine=self._engine, predicate=self._predicate,
+        )
+        key = dataset_schema_key(reader._reader.schema.columns)
+        if self._schema_key is None:
+            self._schema_key = key
+        elif key != self._schema_key:
+            reader.close()
+            raise ValueError(
+                f"dataset file {self._i} disagrees with the first file's "
+                "schema"
+            )
+        self._current = _ClosingIterator(reader)
+        self._i += 1
+        return True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if self._closed:
+                raise StopIteration
+            if self._current is None and not self._open_next():
+                self._closed = True
+                raise StopIteration
+            try:
+                return next(self._current)
+            except StopIteration:
+                self._current = None  # advance to the next file
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            if self._current is not None:
+                self._current.close()
+                self._current = None
+
+    # surface parity with _ClosingIterator: delegate to the open file
+    @property
+    def metadata(self) -> ParquetMetadata:
+        if self._current is None and not self._closed:
+            self._open_next()
+        if self._current is None:
+            raise ValueError("dataset stream is closed")
+        return self._current.metadata
+
+    @property
+    def columns(self):
+        if self._current is None and not self._closed:
+            self._open_next()
+        if self._current is None:
+            raise ValueError("dataset stream is closed")
+        return self._current.columns
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class _ClosingIterator:

@@ -361,6 +361,21 @@ class PrimitiveType(SchemaNode):
         return str(value)
 
 
+def dataset_schema_key(columns) -> list:
+    """The schema facts a multi-file dataset must agree on, per column:
+    path, physical type, type length, Dremel levels, and the logical
+    annotation (which drives stringify/decimal-scale semantics).  Used
+    by every dataset entry point so the contract is one definition."""
+    return [
+        (
+            c.path, c.physical_type, c.type_length or 0,
+            c.max_definition_level, c.max_repetition_level,
+            c.primitive.logical_type,
+        )
+        for c in columns
+    ]
+
+
 class GroupType(SchemaNode):
     __slots__ = ("fields", "_index")
 

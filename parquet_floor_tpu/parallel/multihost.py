@@ -140,17 +140,71 @@ def read_sharded_global(
     slots (``row_mask`` False), so shardings are identical on every
     process regardless of which groups its predicate dropped.
     """
+    return read_dataset_sharded(
+        [source], mesh, axis=axis, columns=columns,
+        float64_policy=float64_policy, predicate=predicate,
+    )
+
+
+def _check_dataset_schemas(readers) -> None:
+    """All files of a dataset must agree on the shared schema contract
+    (``format.schema.dataset_schema_key``)."""
+    from ..format.schema import dataset_schema_key
+
+    first = dataset_schema_key(readers[0].reader.schema.columns)
+    for i, r in enumerate(readers[1:], 1):
+        if dataset_schema_key(r.reader.schema.columns) != first:
+            raise ValueError(
+                f"dataset files disagree on schema: file 0 vs file {i}"
+            )
+
+
+def read_dataset_sharded(
+    sources: Sequence,
+    mesh: Mesh,
+    axis: str = "rg",
+    columns: Optional[Sequence[str]] = None,
+    float64_policy: str = "auto",
+    predicate=None,
+) -> Dict[str, object]:
+    """:func:`read_sharded_global` over the CONCATENATION of many files'
+    row groups — the dataset-directory form.  Global arrays preserve
+    (file order, then row-group order); every process reads every
+    footer (cheap) but only its own groups' pages.  Schemas must agree
+    across files (:func:`_check_dataset_schemas`)."""
+    import os
+    from contextlib import ExitStack
+
     from ..tpu.engine import TpuRowGroupReader
 
+    if isinstance(sources, (str, bytes, os.PathLike)):
+        raise TypeError(
+            "read_dataset_sharded takes a LIST of sources; for a single "
+            "file use read_sharded_global (or pass [source])"
+        )
+    if not sources:
+        raise ValueError("read_dataset_sharded needs at least one source")
     n_proc = jax.process_count()
     pid = jax.process_index()
     n_axis = int(mesh.shape[axis])
     sharding = NamedSharding(mesh, P(axis))
 
-    with TpuRowGroupReader(source, float64_policy=float64_policy) as reader:
-        rgs = reader.reader.row_groups
-        n_groups = len(rgs)
-        rows_per = [int(rg.num_rows or 0) for rg in rgs]
+    with ExitStack() as stack:
+        readers = [
+            stack.enter_context(
+                TpuRowGroupReader(s, float64_policy=float64_policy)
+            )
+            for s in sources
+        ]
+        _check_dataset_schemas(readers)
+        reader = readers[0]  # schema/meta authority
+        pairs = [
+            (fi, gi, rg)
+            for fi, r in enumerate(readers)
+            for gi, rg in enumerate(r.reader.row_groups)
+        ]
+        n_groups = len(pairs)
+        rows_per = [int(rg.num_rows or 0) for _, _, rg in pairs]
         per_axis = max(1, -(-n_groups // n_axis))
         g_pad = per_axis * n_axis
         if g_pad % n_proc:
@@ -171,7 +225,7 @@ def read_sharded_global(
             vec = np.zeros(n_groups, np.int64)
             for g in mine:
                 if g < n_groups and predicate.may_match_with(
-                    reader.reader, rgs[g]
+                    readers[pairs[g][0]].reader, pairs[g][2]
                 ):
                     vec[g] = 1
             agreed = _agree_max(vec)
@@ -189,7 +243,7 @@ def read_sharded_global(
         )
 
         decoded: Dict[int, Dict[str, object]] = {
-            g: reader.read_row_group(g, columns)
+            g: readers[pairs[g][0]].read_row_group(pairs[g][1], columns)
             for g in mine
             if g < n_groups and (keep is None or g in keep)
         }

@@ -415,3 +415,10 @@ def test_dataset_row_stream_and_sharded(tmp_path):
     ln = lens[np.flatnonzero(rm)[1]]
     assert got_first[:ln].tobytes().decode() == "f0v1"
     assert bool(mask[np.flatnonzero(rm)[0]])  # k=0 row: s is null (0 % 7)
+    # predicate prunes groups across FILES: only file 2's rows survive
+    from parquet_floor_tpu import col
+    out_p = read_dataset_sharded(paths, mesh, predicate=col("k") >= 2000)
+    kp = np.asarray(out_p["k"].values)
+    rmp = np.asarray(out_p["k"].row_mask)
+    assert out_p["k"].num_rows == 120
+    np.testing.assert_array_equal(kp[rmp], list(range(2000, 2120)))

@@ -390,8 +390,13 @@ class ParquetReader:
                 self._finished = True
                 return False
             if self._conv_fut is not None:
-                cursors = self._conv_fut.result()
-                self._conv_fut = None
+                try:
+                    cursors = self._conv_fut.result()
+                finally:
+                    # clear even when result() raises: the error is being
+                    # DELIVERED here, and close() must not re-report it
+                    # as a discarded prefetch error
+                    self._conv_fut = None
             else:
                 cursors = self._pull_convert_tpu()
             idx = self._tpu_pending.pop(0)
@@ -470,16 +475,20 @@ class ParquetReader:
             # Parity: wrap iteration failures (ParquetReader.java:209-211).
             raise RuntimeError("Failed to read parquet") from e
 
-    def _drain_prefetch(self) -> None:
+    def _drain_prefetch(self) -> Optional[Exception]:
+        """Retire the one-deep prefetch future, returning (not raising)
+        its error: discarded lookahead must never abort a close/restore."""
+        err = None
         if self._conv_fut is not None:
             try:
                 self._conv_fut.result()
-            except Exception:
-                pass  # discarded lookahead; real errors resurface on read
+            except Exception as e:
+                err = e
             self._conv_fut = None
+        return err
 
     def close(self) -> None:
-        self._drain_prefetch()
+        err = self._drain_prefetch()
         if self._conv_pool is not None:
             self._conv_pool.shutdown(wait=False)
             self._conv_pool = None
@@ -490,6 +499,18 @@ class ParquetReader:
             self._tpu.close()  # owns (and closes) the shared file reader
         else:
             self._reader.close()
+        if err is not None:
+            # a background conversion failed and no read surfaced it —
+            # don't let it vanish.  Warn AFTER every resource is released
+            # (warnings-as-errors must not leak the pool/engine/file).
+            import warnings
+
+            warnings.warn(
+                "ParquetReader.close() discarded a background prefetch "
+                f"error: {err!r}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
 
     def __enter__(self):
         return self
@@ -629,6 +650,8 @@ class _DatasetIterator:
         self._schema_key = None
         self._current: Optional[_ClosingIterator] = None
         self._closed = False
+        self._last_meta: Optional[ParquetMetadata] = None
+        self._last_columns = None
 
     def _open_next(self) -> bool:
         if self._i >= len(self._sources):
@@ -649,6 +672,10 @@ class _DatasetIterator:
                 "schema"
             )
         self._current = _ClosingIterator(reader)
+        # retained past close/exhaustion so metadata/columns keep working,
+        # matching the single-file iterator (whose footer stays cached)
+        self._last_meta = reader.metadata
+        self._last_columns = reader.columns
         self._i += 1
         return True
 
@@ -674,22 +701,29 @@ class _DatasetIterator:
                 self._current.close()
                 self._current = None
 
-    # surface parity with _ClosingIterator: delegate to the open file
+    # surface parity with _ClosingIterator: delegate to the open file;
+    # after exhaustion/close, the most recently opened file's footer is
+    # retained (the single-file iterator likewise serves its cached
+    # footer after close)
     @property
     def metadata(self) -> ParquetMetadata:
         if self._current is None and not self._closed:
             self._open_next()
-        if self._current is None:
-            raise ValueError("dataset stream is closed")
-        return self._current.metadata
+        if self._current is not None:
+            return self._current.metadata
+        if self._last_meta is not None:
+            return self._last_meta
+        raise ValueError("dataset stream is closed")
 
     @property
     def columns(self):
         if self._current is None and not self._closed:
             self._open_next()
-        if self._current is None:
-            raise ValueError("dataset stream is closed")
-        return self._current.columns
+        if self._current is not None:
+            return self._current.columns
+        if self._last_columns is not None:
+            return self._last_columns
+        raise ValueError("dataset stream is closed")
 
     def __enter__(self):
         return self

@@ -96,7 +96,13 @@ class ParquetWriter:
         for v in slots:
             if v is None:
                 total += 1
-            elif isinstance(v, (bytes, str)):
+            elif isinstance(v, str):
+                # byte estimate, not character count: non-ASCII text would
+                # otherwise systematically under-count and flush late
+                total += (
+                    len(v) if v.isascii() else len(v.encode("utf-8"))
+                ) + 4
+            elif isinstance(v, bytes):
                 total += len(v) + 4
             else:
                 total += 8

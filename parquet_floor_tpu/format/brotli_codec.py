@@ -105,24 +105,44 @@ def encoder_available() -> bool:
     return _enc is not None
 
 
-def decompress(data: bytes, uncompressed_size: Optional[int] = None) -> bytes:
+def decompress(data: bytes, uncompressed_size: Optional[int] = None,
+               max_output: int = 1 << 28) -> bytes:
     """One-shot Brotli decode.  With ``uncompressed_size`` (the Parquet
     page header's value) the output buffer is exact; without it the
-    buffer doubles until the stream fits."""
+    buffer doubles until the stream fits, up to ``max_output``.
+
+    The no-hint ladder is capped (default 256 MiB) because the one-shot
+    decoder cannot distinguish "buffer too small" from "corrupt", so a
+    hostile stream would otherwise cost allocations up to the full 2 GiB.
+    The page-read path always passes the header's exact size; direct
+    callers with legitimately larger hint-less streams raise
+    ``max_output``."""
     _load()
     if _dec is None:
         raise RuntimeError("libbrotlidec not found")
     data = bytes(data)
-    cap = uncompressed_size if uncompressed_size else max(4 * len(data), 1 << 14)
+    cap = (
+        uncompressed_size
+        if uncompressed_size
+        # the cap bounds the FIRST allocation too: a huge hostile input
+        # must not force 4*len(data) bytes before the ladder even starts
+        else min(max(4 * len(data), 1 << 14), max_output)
+    )
     while True:
         out = ctypes.create_string_buffer(cap or 1)
         n = ctypes.c_size_t(cap)
         rc = _dec.BrotliDecoderDecompress(len(data), data, ctypes.byref(n), out)
         if rc == _DECODER_SUCCESS:
             return out.raw[: n.value]
-        if uncompressed_size is not None or cap >= 1 << 31:
-            raise ValueError("invalid brotli stream (or wrong size hint)")
-        cap *= 2
+        if uncompressed_size is not None or cap >= max_output:
+            raise ValueError(
+                "invalid brotli stream (or wrong size hint)"
+                if uncompressed_size is not None
+                else "invalid brotli stream (or output larger than "
+                f"max_output={max_output} — pass uncompressed_size or "
+                "raise max_output)"
+            )
+        cap = min(cap * 2, max_output)
 
 
 def compress(data: bytes, quality: int = 5, lgwin: int = 22) -> bytes:

@@ -233,14 +233,18 @@ def _lz4_hadoop_compress(data: bytes) -> bytes:
     )
 
 
-def _brotli_decompress(data: bytes, uncompressed_size=None) -> bytes:
+def _brotli_decompress(data: bytes, uncompressed_size=None,
+                       max_output: int = 1 << 28) -> bytes:
     """BROTLI via the system library (format/brotli_codec.py) — the same
-    native-library codec seam the reference's JNI codecs use."""
+    native-library codec seam the reference's JNI codecs use.  The page
+    path always passes the header's exact ``uncompressed_size``;
+    ``max_output`` bounds the no-hint growth ladder for direct callers
+    (forwarded so the registry path can raise it too)."""
     from . import brotli_codec
 
     if not brotli_codec.available():
         raise UnsupportedCodec(_codec_guidance(CompressionCodec.BROTLI))
-    return brotli_codec.decompress(data, uncompressed_size)
+    return brotli_codec.decompress(data, uncompressed_size, max_output)
 
 
 def _brotli_compress(data: bytes) -> bytes:

@@ -156,6 +156,17 @@ def test_write_type_surface_rejections(tmp_path):
         ParquetWriter.write_file(schema2, tmp_path / "y.parquet", bad2, [object()])
 
 
+def test_row_bytes_counts_utf8_bytes():
+    """The row_group_bytes flush estimate counts str values in UTF-8
+    bytes, not characters (non-ASCII text must not flush late)."""
+    from parquet_floor_tpu.api.writer import ParquetWriter as PW
+
+    ascii_cost = PW._row_bytes(["abcd"])
+    multibyte_cost = PW._row_bytes(["äöüß"])  # 4 chars, 8 UTF-8 bytes
+    assert ascii_cost == 4 + 4
+    assert multibyte_cost == 8 + 4
+
+
 def test_unknown_field_name_raises(tmp_path):
     schema = types.message("m", types.required(types.INT64).named("x"))
     bad = FnDehydrator(lambda rec, vw: vw.write("nope", 1))

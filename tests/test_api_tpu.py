@@ -422,3 +422,11 @@ def test_dataset_row_stream_and_sharded(tmp_path):
     rmp = np.asarray(out_p["k"].row_mask)
     assert out_p["k"].num_rows == 120
     np.testing.assert_array_equal(kp[rmp], list(range(2000, 2120)))
+    # metadata/columns keep serving after exhaustion (the single-file
+    # iterator serves its cached footer after close; datasets retain the
+    # most recently opened file's)
+    it2 = ParquetReader.stream_content(paths, lambda c: _RowHydrator())
+    n_rows = sum(1 for _ in it2)
+    assert n_rows == len(expected_k)
+    assert it2.metadata.row_groups  # last file's footer, retained
+    assert [c.path[0] for c in it2.columns] == ["k", "s"]

@@ -345,7 +345,7 @@ def _bucket15(n: int, minimum: int = 16) -> int:
 class _ColSpec(NamedTuple):
     name: str
     # dict | dict_str | plain | bool | delta | delta1 | delta1w | deltaw |
-    # host | host_rows | host_str | hostr | hostr_str
+    # host | host_rows | host_str | hostr | hostr_str | hostr_rows
     kind: str
     n: int           # rows in the group (level positions for repeated cols)
     nexp: int        # value-stream expansion count (n if required, bucketed nn if optional)
@@ -526,6 +526,13 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         defs = _levels_i32(arena, slab, spec.sc_off + 2, spec.n)
         reps = _levels_i32(arena, slab, spec.sc_off + 3, spec.n)
         return rows, None, lens, defs, reps
+    if spec.kind == "hostr_rows":
+        # host-decoded repeated FLBA/INT96: dense 2-D byte rows + levels
+        u8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.nexp * spec.width,))
+        rows = u8.reshape(spec.nexp, spec.width)
+        defs = _levels_i32(arena, slab, spec.sc_off + 1, spec.n)
+        reps = _levels_i32(arena, slab, spec.sc_off + 2, spec.n)
+        return rows, None, None, defs, reps
     # --- expansion-based kinds: dict / dict_str / plain / bool / delta ----
     if spec.kind == "dict":
         idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp, spec.pl_idx)
@@ -1244,12 +1251,19 @@ class _HostStage:
                 self.offs["lens"] = arena.add_copy(
                     lengths.astype(np.int32), self.nn * 4
                 )
+            elif vals.ndim == 2:
+                # repeated FLBA/INT96 byte rows (e.g. dict-encoded
+                # fixed-width leaves whose chunk fell back to host
+                # decode): ship the dense 2-D u8 stream as-is — the
+                # reference's engine decodes any physical type at any
+                # repetition level (ParquetReader.java:147-163), so the
+                # device engine must never refuse a file shape the host
+                # engine handles
+                self.kind = "hostr_rows"
+                self.width = vals.shape[1]
+                d = np.ascontiguousarray(vals, dtype=np.uint8)
+                self.offs["vals"] = arena.add_copy(d, d.size)
             else:
-                if vals.ndim == 2:
-                    raise ValueError(
-                        "repeated FLBA/INT96 columns are not supported by "
-                        f"the TPU engine: column {'.'.join(desc.path)}"
-                    )
                 if vals.dtype == np.bool_:
                     vals = vals.astype(np.uint8)
                     self.vdtype = "bool"
@@ -1332,6 +1346,16 @@ class _HostStage:
             spec["max_rep"] = self.max_rep
             spec["max_def"] = self.desc.max_definition_level
             spec["max_len"] = self.max_len
+            return spec
+        if self.kind == "hostr_rows":
+            spec["sc_off"] = slabb.add(
+                [self.offs["vals"], self.offs["defs"], self.offs["reps"]]
+            )
+            spec["nexp"] = self.nn
+            spec["max_rep"] = self.max_rep
+            spec["max_def"] = self.desc.max_definition_level
+            spec["width"] = self.width
+            spec["vdtype"] = "u8rows"
             return spec
         if self.kind == "host_str":
             sc = [self.offs["rows"], self.offs["lens"]]

@@ -321,6 +321,74 @@ def test_random_nested_roundtrip(tmp_path, seed):
     assert out2 == rows, f"seed {seed} tpu"
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_random_repeated_flba_int96(tmp_path, seed):
+    """Repeated FLBA and INT96 leaves through the device engine (the
+    reference's engine decodes any physical type at any repetition level,
+    ParquetReader.java:147-163).  pyarrow dict-encodes FLBA/INT96 by
+    default, so these chunks fall back to host decode and ship as dense
+    2-D byte rows (engine ``hostr_rows``) — value parity vs the host
+    assembly and the pyarrow oracle, zero user-visible errors."""
+    import pyarrow as pa
+
+    from parquet_floor_tpu.batch.nested import assemble_nested
+
+    rng = np.random.default_rng(300 + seed)
+    n = int(rng.integers(1, 800))
+    width = int(rng.choice([4, 16]))
+    use_int96 = bool(seed % 2)
+
+    rows = []
+    for _ in range(n):
+        r = rng.random()
+        if r < 0.15:
+            rows.append(None)
+        else:
+            ln = int(rng.integers(0, 5))
+            if use_int96:
+                rows.append([int(rng.integers(0, 2**48)) for _ in range(ln)])
+            else:
+                # low cardinality → dictionary encoding kicks in
+                rows.append([
+                    bytes([int(rng.integers(0, 8))]) * width
+                    for _ in range(ln)
+                ])
+    path = str(tmp_path / f"rep{seed}.parquet")
+    if use_int96:
+        arr = pa.array(rows, type=pa.list_(pa.timestamp("ns")))
+        pq.write_table(
+            pa.table({"v": arr}), path, use_deprecated_int96_timestamps=True
+        )
+    else:
+        arr = pa.array(rows, type=pa.list_(pa.binary(width)))
+        pq.write_table(pa.table({"v": arr}), path)
+
+    def render(nested_rows):
+        # byte-row leaves render as uint8 arrays; normalize to bytes
+        return [
+            None if row is None
+            else [None if e is None else np.asarray(e).tobytes() for e in row]
+            for row in nested_rows
+        ]
+
+    with ParquetFileReader(path) as r:
+        host_out = []
+        for gi in range(len(r.row_groups)):
+            cb = r.read_row_group(gi).columns[0]
+            host_out.extend(assemble_nested(r.schema, cb).to_pylist())
+        sch = r.schema
+    with TpuRowGroupReader(path) as tr:
+        dev_out = []
+        for gi in range(tr.num_row_groups):
+            (dc,) = tr.read_row_group(gi).values()
+            dev_out.extend(dc.assemble(sch).to_pylist())
+    assert render(dev_out) == render(host_out), f"seed {seed}"
+    if not use_int96:
+        # FLBA: the raw bytes match the pyarrow oracle exactly
+        got = pq.read_table(path).column("v").to_pylist()
+        assert render(dev_out) == got, f"seed {seed}"
+
+
 @pytest.mark.parametrize("seed", range(12))
 def test_random_selective_reads(tmp_path, seed):
     """Fuzz predicate pushdown + selective page reads: for random files

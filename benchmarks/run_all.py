@@ -108,7 +108,21 @@ def measure(name, path, reps, nested_rows=None):
     ship_gbps = (
         ship["bytes"] / ship["seconds"] / 1e9 if ship.get("seconds") else None
     )
+    # engine="auto" routing for this file: what the cost model picks, and
+    # the measured rows/s of the engine it picked (auto matches-or-beats
+    # host everywhere iff every row here is >= 1.0x vs host)
+    from parquet_floor_tpu.format.file_read import ParquetFileReader
+    from parquet_floor_tpu.tpu import cost as tcost
+
+    with ParquetFileReader(path) as fr:
+        choice = tcost.choose_engine(fr, purpose="batch")
+    auto_rows_per_s = (
+        n_rows / best if choice.engine == "tpu" else n_rows / cpu_dt
+    )
     return {
+        "auto_engine": choice.engine,
+        "auto_rows_per_s": round(auto_rows_per_s, 1),
+        "auto_vs_host": round(auto_rows_per_s / (n_rows / cpu_dt), 2),
         "config": name,
         "rows": n_rows,
         "file_mb": round(size / 1e6, 2),
@@ -126,10 +140,12 @@ def measure(name, path, reps, nested_rows=None):
     }
 
 
-def measure_rows_api(path, reps=3):
+def measure_rows_api(path, reps=3, engines=("host", "tpu", "auto")):
     """The one-front-door comparison: hydrated row stream through the host
-    cursor vs the device engine (identical rows; decode is the variable)."""
+    cursor vs the device engine vs cost-model routing (identical rows;
+    engine selection is the variable)."""
     from parquet_floor_tpu import ParquetReader
+    from parquet_floor_tpu.utils import trace
 
     class _Rows:
         def start(self):
@@ -143,9 +159,11 @@ def measure_rows_api(path, reps=3):
             return tuple(t)
 
     out = {}
-    for engine in ("host", "tpu"):
+    for engine in engines:
         n = 0
         best = float("inf")
+        trace.enable()
+        trace.reset()
         for _ in range(reps):
             t0 = time.perf_counter()
             n = sum(
@@ -155,11 +173,19 @@ def measure_rows_api(path, reps=3):
                 )
             )
             best = min(best, time.perf_counter() - t0)
+        routed = [
+            d for d in trace.decisions() if d["decision"] == "engine_auto"
+        ]
+        trace.disable()
         out[engine] = {"rows": n, "s": round(best, 4),
                        "rows_per_s": round(n / best, 1)}
-    out["speedup"] = round(
-        out["host"]["s"] / out["tpu"]["s"], 2
-    )
+        if engine == "auto" and routed:
+            out[engine]["routed_to"] = routed[-1]["engine"]
+            out[engine]["route_reason"] = routed[-1]["reason"]
+    if "host" in out and "tpu" in out:
+        out["speedup"] = round(out["host"]["s"] / out["tpu"]["s"], 2)
+    if "host" in out and "auto" in out:
+        out["auto_vs_host"] = round(out["host"]["s"] / out["auto"]["s"], 2)
     return out
 
 
@@ -169,7 +195,14 @@ def main():
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--json", default=None)
     ap.add_argument("--rows-api", action="store_true")
+    ap.add_argument(
+        "--engine", dest="engines", action="append",
+        choices=["host", "tpu", "auto"],
+        help="rows-api engines to time (repeatable; default: all three)",
+    )
     args = ap.parse_args()
+    if not args.engines:
+        args.engines = ["host", "tpu", "auto"]
 
     import jax
 
@@ -219,20 +252,31 @@ def main():
             f"| {r['config']:<30} | {r['rows']:>9} | {r['file_mb']:>7.2f} "
             f"| {r['cpu_rows_per_s']:>12,.0f} | {r['tpu_rows_per_s']:>12,.0f} "
             f"| {r['speedup']:>6.2f}x | {r['decoded_GB_per_s']:>6.3f} GB/s "
-            f"| p50 {r['page_decode_p50_us']:>7.2f} us/page |",
+            f"| p50 {r['page_decode_p50_us']:>7.2f} us/page "
+            f"| auto->{r['auto_engine']} {r['auto_vs_host']:>5.2f}x vs host |",
             flush=True,
         )
 
     rows_api = None
     if args.rows_api:
-        rows_api = measure_rows_api(lineitem_path, reps=args.reps)
-        print(
-            f"rows-api (lineitem, hydrated rows): host "
-            f"{rows_api['host']['rows_per_s']:,.0f} rows/s vs tpu "
-            f"{rows_api['tpu']['rows_per_s']:,.0f} rows/s "
-            f"({rows_api['speedup']}x)",
-            flush=True,
+        rows_api = measure_rows_api(
+            lineitem_path, reps=args.reps, engines=args.engines
         )
+        host = rows_api.get("host")
+        parts = [
+            f"{e} {rows_api[e]['rows_per_s']:,.0f} rows/s"
+            + (
+                f" (routed {rows_api[e].get('routed_to', '?')})"
+                if e == "auto"
+                else ""
+            )
+            for e in args.engines
+            if e in rows_api
+        ]
+        print("rows-api (lineitem, hydrated rows): " + " vs ".join(parts),
+              flush=True)
+        if host and "auto" in rows_api:
+            print(f"  auto vs host: {rows_api['auto_vs_host']}x", flush=True)
 
     if args.json:
         with open(args.json, "w") as f:

@@ -169,19 +169,34 @@ class ParquetReader:
 
     ``engine`` selects the decode engine behind the same API surface:
     ``"host"`` (NumPy, the default), ``"tpu"`` (the fused device engine),
-    or ``"auto"`` (device engine when the default JAX backend is a TPU).
+    or ``"auto"`` — on a TPU backend, a per-file footer cost model
+    (``tpu.cost``) routes each file to whichever engine the model says
+    wins (memcpy-class files stay host; per-value-decode files go
+    device); on any other backend, host.
     """
 
     def __init__(self, source, hydrator_supplier, columns: Optional[Sequence[str]] = None,
                  engine: str = "host", predicate=None):
         if engine not in ("host", "tpu", "auto"):
             raise ValueError(f"bad engine {engine!r}: expected host|tpu|auto")
-        if engine == "auto":
-            from ..tpu.engine import _platform_is_tpu
-
-            engine = "tpu" if _platform_is_tpu() else "host"
-        self.engine = engine
         self._reader = ParquetFileReader(source)
+        if engine == "auto":
+            # per-FILE cost-model routing, not per-platform: the footer
+            # (bytes, codecs, encodings, optionality) + a cached link
+            # probe predict which engine wins this file (tpu/cost.py);
+            # decision visible via trace.decisions()
+            from ..tpu.cost import choose_engine
+
+            try:
+                engine = choose_engine(
+                    self._reader,
+                    purpose="rows",
+                    columns=set(columns) if columns else None,
+                ).engine
+            except BaseException:
+                self._reader.close()
+                raise
+        self.engine = engine
         schema = self._reader.schema
         selected: List[ColumnDescriptor] = [
             c for c in schema.columns

@@ -1,0 +1,251 @@
+"""Cost-model routing for ``engine="auto"`` — pick the WINNING engine per
+file, not per platform.
+
+The reference exposes one API whose engine is invisible to the caller
+(``ParquetReader.java:47-61``); the TPU build's single front door earns
+that only if "auto" never routes a file through the losing engine.  Both
+engines share the host read+decompress stage, so the differential is:
+
+  host engine:   post-decompress host decode of every chunk
+  device engine: ship the arena over the link + fused device decode
+                 (+ for the row API: fetch decoded cells back to host)
+
+Those costs are predictable from the footer alone (bytes, codecs,
+encodings, optionality) plus a one-time cached link-bandwidth probe:
+
+  * "view"-class chunks (PLAIN, fixed-width, required, flat) host-decode
+    at memcpy speed — the device path can only lose the ship time
+    (BASELINE.md config #1: 0.73x, the one sub-1x row).
+  * "levels"-class chunks (PLAIN fixed-width, optional) pay native level
+    decode + scatter on host.
+  * "value"-class chunks (dictionary / delta / strings / boolean) pay
+    per-value host work — the measured ~0.03-0.05 GB/s that the fused
+    device decode beats by 15-50x (BASELINE.md configs #2-5).
+
+Rates are differential calibration constants taken from the measured
+round-3 stage tables (docs/DESIGN_DECOMPRESSION.md, BASELINE.md); they
+only need to rank the two engines, not predict absolute walls.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..format.parquet_thrift import Encoding, Type
+from ..utils import trace
+
+# Differential host post-decompress decode rates, GB/s of decoded bytes.
+HOST_VIEW_GBPS = 4.0     # PLAIN fixed-width required: frombuffer view/copy
+HOST_LEVELS_GBPS = 0.4   # PLAIN fixed-width optional: level decode + scatter
+HOST_VALUE_GBPS = 0.05   # dict/delta/strings/bool: per-value host decode
+
+# Device-side differential rates/overheads.
+DEV_DECODE_GBPS = 8.0    # fused decode, HBM-bandwidth-class
+GROUP_OVERHEAD_S = 8e-4  # plan build + dispatch per row group
+
+# Row-API cell materialization (the host cursor boxes each cell through
+# per-cell numpy→Python dispatch; the device path converts vectorized —
+# tolist once per column + pool-once-per-distinct for dictionaries).
+# Calibrated from BASELINE.md's measured 76k vs 187k rows/s on 16-column
+# lineitem (1.2M vs ~3M cells/s plus the fetch the device side pays).
+HOST_CELL_S = 0.4e-6
+DEV_CELL_S = 0.1e-6
+
+_LEVEL_ENCODINGS = {Encoding.RLE, Encoding.BIT_PACKED}
+_FIXED_TYPES = {
+    Type.INT32, Type.INT64, Type.FLOAT, Type.DOUBLE,
+    Type.FIXED_LEN_BYTE_ARRAY, Type.INT96,
+}
+_DICT_ENCODINGS = {Encoding.RLE_DICTIONARY, Encoding.PLAIN_DICTIONARY}
+
+_lock = threading.Lock()
+_h2d_gbps: Optional[float] = None
+_d2h_model: Optional[tuple] = None  # (fixed_s, gbps)
+
+
+def _probe_h2d_gbps() -> float:
+    """One-time host→device bandwidth probe (8 MiB device_put, best of
+    2 after a warm put), cached for the process.  ~20 ms on the
+    tunnelled link; the number any shipped-bytes plan is bounded by."""
+    global _h2d_gbps
+    with _lock:
+        if _h2d_gbps is not None:
+            return _h2d_gbps
+    import jax
+    import numpy as np
+
+    buf = np.zeros(8 << 20, dtype=np.uint8)
+    jax.block_until_ready(jax.device_put(buf))  # warm
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(buf))
+        best = min(best, time.perf_counter() - t0)
+    with _lock:
+        _h2d_gbps = max(buf.nbytes / best / 1e9, 1e-3)
+        return _h2d_gbps
+
+
+def _probe_d2h_model() -> tuple:
+    """One-time device→host cost model ``(fixed_s, gbps)`` from two
+    transfer sizes (64 KiB and 1 MiB).  Tunnelled links have a large
+    fixed cost (~35 ms) and a slow return path (~11 MB/s — see
+    BASELINE.md link characterization); locally-attached devices are
+    symmetric.  Probed lazily: only the row API's device path fetches."""
+    global _d2h_model
+    with _lock:
+        if _d2h_model is not None:
+            return _d2h_model
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    times = []
+    sizes = [64 << 10, 1 << 20]
+    dev_big = jax.device_put(np.zeros(sizes[-1], dtype=np.uint8))
+    jax.block_until_ready(dev_big)
+    np.asarray(dev_big[: 1 << 10])  # warm the fetch path
+    for s in sizes:
+        t0 = time.perf_counter()
+        np.asarray(jnp.asarray(dev_big[:s]))
+        times.append(time.perf_counter() - t0)
+    dt = times[1] - times[0]
+    gbps = (sizes[1] - sizes[0]) / max(dt, 1e-9) / 1e9
+    fixed = max(times[0] - sizes[0] / (gbps * 1e9), 0.0)
+    with _lock:
+        _d2h_model = (fixed, max(min(gbps, 1e3), 1e-4))
+        return _d2h_model
+
+
+@dataclass
+class EngineChoice:
+    """The routing decision plus the estimate that produced it."""
+
+    engine: str
+    host_s: float = 0.0
+    tpu_s: float = 0.0
+    reason: str = ""
+    bytes_by_class: Dict[str, int] = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {
+            "engine": self.engine,
+            "est_host_s": round(self.host_s, 6),
+            "est_tpu_s": round(self.tpu_s, 6),
+            "reason": self.reason,
+            **{f"{k}_bytes": v for k, v in self.bytes_by_class.items()},
+        }
+
+
+def classify_chunk(desc, meta) -> str:
+    """Map one column chunk to its host-decode cost class from footer
+    metadata alone: "view" | "levels" | "value"."""
+    value_encs = set(meta.encodings or []) - _LEVEL_ENCODINGS
+    pt = desc.physical_type
+    if value_encs <= {Encoding.PLAIN} and pt in _FIXED_TYPES:
+        if desc.max_repetition_level == 0 and desc.max_definition_level == 0:
+            return "view"
+        if desc.max_repetition_level == 0:
+            return "levels"
+    return "value"
+
+
+def estimate(reader, purpose: str = "rows", columns=None) -> EngineChoice:
+    """Estimate host-vs-device wall for every row group of ``reader``
+    (a ``ParquetFileReader``) and return the routed choice.
+
+    ``purpose``: "rows" adds the device path's decoded-cell fetch cost
+    (device→host), which the host engine never pays; "batch" models
+    decode-to-device-arrays only (consumers keep arrays on device).
+    ``columns``: optional set of top-level field names — only projected
+    chunks cost anything, on either engine.
+    """
+    by_class: Dict[str, int] = {"view": 0, "levels": 0, "value": 0}
+    fetch_bytes = 0
+    n_groups = 0
+    n_cells = 0
+    for rg in reader.row_groups:
+        n_groups += 1
+        for chunk in rg.columns or []:
+            meta = chunk.meta_data
+            if columns is not None and meta.path_in_schema[0] not in columns:
+                continue
+            desc = reader.schema.column(tuple(meta.path_in_schema))
+            nbytes = int(meta.total_uncompressed_size or 0)
+            n_cells += int(meta.num_values or 0)
+            cls = classify_chunk(desc, meta)
+            by_class[cls] += nbytes
+            if set(meta.encodings or []) & _DICT_ENCODINGS:
+                # index-form dictionary columns fetch the packed index
+                # stream + one pool per file — far fewer bytes than the
+                # gathered values (BASELINE.md "index-form dictionaries")
+                fetch_bytes += nbytes // 3
+            else:
+                fetch_bytes += nbytes
+    total = sum(by_class.values())
+    host_s = (
+        by_class["view"] / (HOST_VIEW_GBPS * 1e9)
+        + by_class["levels"] / (HOST_LEVELS_GBPS * 1e9)
+        + by_class["value"] / (HOST_VALUE_GBPS * 1e9)
+    )
+    h2d = _probe_h2d_gbps()
+    tpu_s = (
+        total / (h2d * 1e9)
+        + total / (DEV_DECODE_GBPS * 1e9)
+        + n_groups * GROUP_OVERHEAD_S
+    )
+    if purpose == "rows":
+        # cell materialization differs per engine (see HOST_CELL_S note)
+        host_s += n_cells * HOST_CELL_S
+        tpu_s += n_cells * DEV_CELL_S
+    choice = EngineChoice(
+        engine="tpu" if tpu_s < host_s else "host",
+        host_s=host_s,
+        tpu_s=tpu_s,
+        bytes_by_class=by_class,
+    )
+    if purpose == "rows" and choice.engine == "tpu":
+        # the fetch term can only make the device path worse, and the
+        # D2H probe is not free — only pay it when it could flip the
+        # decision
+        fixed, d2h_gbps = _probe_d2h_model()
+        choice.tpu_s += n_groups * fixed + fetch_bytes / (d2h_gbps * 1e9)
+        if choice.tpu_s >= host_s:
+            choice.engine = "host"
+    choice.reason = (
+        f"est host {choice.host_s * 1e3:.1f} ms vs device "
+        f"{choice.tpu_s * 1e3:.1f} ms over {total} decoded bytes "
+        f"(link {h2d:.2f} GB/s)"
+    )
+    return choice
+
+
+def choose_engine(reader, purpose: str = "rows", columns=None) -> EngineChoice:
+    """Route ``engine="auto"`` for an open ``ParquetFileReader``.
+
+    Platform gate first (a non-TPU default backend always routes host —
+    the device engine exists to use the TPU); then the x64 environment
+    gate (the device engine requires ``jax_enable_x64``; "auto" must
+    degrade to host, never error); then the footer cost model.  The
+    decision lands in ``utils.trace`` (``trace.decisions()``) when
+    tracing is enabled."""
+    from .engine import _platform_is_tpu
+
+    if not _platform_is_tpu():
+        choice = EngineChoice(engine="host", reason="default backend is not a TPU")
+    else:
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            choice = EngineChoice(
+                engine="host",
+                reason="jax_enable_x64 is off (device engine needs 64-bit "
+                "types; auto degrades to host rather than erroring)",
+            )
+        else:
+            choice = estimate(reader, purpose=purpose, columns=columns)
+    trace.decision("engine_auto", choice.as_dict())
+    return choice

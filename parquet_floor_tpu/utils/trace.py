@@ -43,6 +43,7 @@ class StageStat:
 
 
 _stats: Dict[str, StageStat] = {}
+_decisions: list = []  # bounded log of routing/policy decisions
 
 
 def enable() -> None:
@@ -62,6 +63,24 @@ def enabled() -> bool:
 def reset() -> None:
     with _lock:
         _stats.clear()
+        _decisions.clear()
+
+
+def decision(name: str, detail: dict) -> None:
+    """Record a policy decision (e.g. engine="auto" routing) so consumers
+    can see WHY a path was taken.  No-op when disabled; bounded."""
+    if not _enabled:
+        return
+    with _lock:
+        if len(_decisions) >= 64:
+            _decisions.pop(0)
+        _decisions.append({"decision": name, **detail})
+
+
+def decisions() -> list:
+    """Snapshot of recorded policy decisions (most recent last)."""
+    with _lock:
+        return list(_decisions)
 
 
 def add(stage: str, seconds: float, nbytes: int = 0) -> None:
@@ -96,13 +115,16 @@ def stats() -> Dict[str, dict]:
 
 
 def report() -> str:
-    """Human-readable one-line-per-stage report."""
+    """Human-readable one-line-per-stage report (+ recorded decisions)."""
     lines = []
     for name, st in stats().items():
         lines.append(
             f"{name:<12} n={st['count']:<6} {st['seconds']*1e3:9.1f} ms"
             + (f"  {st['MB_per_s']:8.1f} MB/s" if st["bytes"] else "")
         )
+    for d in decisions():
+        kv = " ".join(f"{k}={v}" for k, v in d.items() if k != "decision")
+        lines.append(f"[{d['decision']}] {kv}")
     return "\n".join(lines) or "(no spans recorded — is tracing enabled?)"
 
 

@@ -1,0 +1,180 @@
+"""engine="auto" cost-model routing (tpu/cost.py): the one front door
+must pick the WINNING engine per file, not per platform — the reference
+exposes one API whose engine is invisible (ParquetReader.java:47-61)."""
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    CompressionCodec,
+    ParquetFileReader,
+    ParquetFileWriter,
+    ParquetReader,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.tpu import cost
+from parquet_floor_tpu.utils import trace
+
+
+def _write_plain_int64(path, n=20_000):
+    """Config-#1-shaped: PLAIN uncompressed required INT64 (view-class:
+    the host engine serves it at memcpy speed, the device path can only
+    lose the ship time — BASELINE.md's one sub-1x row)."""
+    schema = types.message("t", types.required(types.INT64).named("v"))
+    opts = WriterOptions(
+        codec=CompressionCodec.UNCOMPRESSED, enable_dictionary=False,
+        page_version=2, data_page_values=100_000,
+    )
+    with ParquetFileWriter(path, schema, opts) as w:
+        w.write_columns({"v": np.arange(n, dtype=np.int64)})
+    return str(path)
+
+
+def _write_dict_strings(path, n=20_000):
+    """Config-#2-shaped: Snappy + RLE_DICTIONARY strings and numerics
+    (value-class: per-value host decode, the device engine's 15x win)."""
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    opts = WriterOptions(
+        codec=CompressionCodec.SNAPPY, enable_dictionary=True,
+    )
+    with ParquetFileWriter(path, schema, opts) as w:
+        w.write_columns({
+            "k": (np.arange(n, dtype=np.int64) % 50),
+            "s": [f"val{i % 40}" for i in range(n)],
+        })
+    return str(path)
+
+
+@pytest.fixture
+def tunnel_probes(monkeypatch):
+    """Pin the link probes to the measured axon-tunnel numbers so the
+    routing decision is deterministic under test (BASELINE.md link
+    characterization: H2D 1.25 GB/s; D2H ~35 ms fixed + 11 MB/s)."""
+    monkeypatch.setattr(cost, "_probe_h2d_gbps", lambda: 1.25)
+    monkeypatch.setattr(cost, "_probe_d2h_model", lambda: (0.035, 0.011))
+
+
+def test_classify_chunk(tmp_path):
+    p1 = _write_plain_int64(tmp_path / "plain.parquet")
+    with ParquetFileReader(p1) as r:
+        chunk = r.row_groups[0].columns[0]
+        desc = r.schema.column(tuple(chunk.meta_data.path_in_schema))
+        assert cost.classify_chunk(desc, chunk.meta_data) == "view"
+    p2 = _write_dict_strings(tmp_path / "dict.parquet")
+    with ParquetFileReader(p2) as r:
+        for chunk in r.row_groups[0].columns:
+            desc = r.schema.column(tuple(chunk.meta_data.path_in_schema))
+            assert cost.classify_chunk(desc, chunk.meta_data) == "value"
+    # optional PLAIN fixed-width → levels class
+    schema = types.message("t", types.optional(types.DOUBLE).named("d"))
+    p3 = str(tmp_path / "opt.parquet")
+    opts = WriterOptions(
+        codec=CompressionCodec.UNCOMPRESSED, enable_dictionary=False,
+    )
+    with ParquetFileWriter(p3, schema, opts) as w:
+        w.write_columns({"d": [None if i % 5 == 0 else float(i) for i in range(500)]})
+    with ParquetFileReader(p3) as r:
+        chunk = r.row_groups[0].columns[0]
+        desc = r.schema.column(tuple(chunk.meta_data.path_in_schema))
+        assert cost.classify_chunk(desc, chunk.meta_data) == "levels"
+
+
+def test_estimate_routes_by_file_shape(tmp_path, tunnel_probes):
+    """Under the measured tunnel link numbers, the model sends the
+    memcpy-class file host and the per-value-class file device — for
+    both the batch and the rows purposes."""
+    p1 = _write_plain_int64(tmp_path / "plain.parquet", n=1_000_000)
+    p2 = _write_dict_strings(tmp_path / "dict.parquet", n=1_000_000)
+    with ParquetFileReader(p1) as r:
+        assert cost.estimate(r, purpose="batch").engine == "host"
+        assert cost.estimate(r, purpose="rows").engine == "host"
+    with ParquetFileReader(p2) as r:
+        est_b = cost.estimate(r, purpose="batch")
+        est_r = cost.estimate(r, purpose="rows")
+    assert est_b.engine == "tpu"
+    assert est_r.engine == "tpu"
+    # the estimate carries its accounting for the trace
+    assert est_b.bytes_by_class["value"] > 0
+    assert "est" in str(est_b.reason) or est_b.reason
+
+
+def test_choose_engine_platform_gate(tmp_path):
+    """On a non-TPU backend auto is host, and the decision is traced."""
+    p = _write_dict_strings(tmp_path / "d.parquet")
+    trace.enable()
+    trace.reset()
+    try:
+        with ParquetFileReader(p) as r:
+            choice = cost.choose_engine(r)
+        assert choice.engine == "host"
+        assert "not a TPU" in choice.reason
+        ds = trace.decisions()
+        assert ds and ds[-1]["decision"] == "engine_auto"
+        assert ds[-1]["engine"] == "host"
+    finally:
+        trace.disable()
+
+
+def test_front_door_auto_routing(tmp_path, tunnel_probes, monkeypatch):
+    """With the platform gate forced open, ParquetReader(engine="auto")
+    routes per file: view-class → host cursors, value-class → the device
+    engine — same rows either way."""
+    from parquet_floor_tpu.tpu import engine as eng
+
+    monkeypatch.setattr(eng, "_platform_is_tpu", lambda: True)
+    # the forced platform gate must not also force compiled Pallas
+    # kernels (CPU backend only supports interpret mode)
+    monkeypatch.setenv("PFTPU_PALLAS", "0")
+    p1 = _write_plain_int64(tmp_path / "plain.parquet", n=1_000_000)
+    p2 = _write_dict_strings(tmp_path / "dict.parquet", n=1_000_000)
+
+    class _Rows:
+        def start(self):
+            return []
+
+        def add(self, t, h, v):
+            t.append(v)
+            return t
+
+        def finish(self, t):
+            return tuple(t)
+
+    r1 = ParquetReader.spliterator(p1, lambda c: _Rows(), engine="auto")
+    try:
+        assert r1.engine == "host"
+    finally:
+        r1.close()
+    r2 = ParquetReader.spliterator(p2, lambda c: _Rows(), engine="auto")
+    try:
+        assert r2.engine == "tpu"
+        rows_auto = [next(r2) for _ in range(5)]
+    finally:
+        r2.close()
+    rows_host = list(
+        ParquetReader.stream_content(p2, lambda c: _Rows(), engine="host")
+    )[:5]
+    assert rows_auto == rows_host
+
+
+def test_auto_degrades_to_host_without_x64(tmp_path, tunnel_probes, monkeypatch):
+    """auto must never error for environment reasons: with x64 off the
+    device engine cannot construct, so auto picks host."""
+    import jax
+
+    from parquet_floor_tpu.tpu import engine as eng
+
+    monkeypatch.setattr(eng, "_platform_is_tpu", lambda: True)
+    p = _write_dict_strings(tmp_path / "d.parquet")
+    jax.config.update("jax_enable_x64", False)
+    try:
+        with ParquetFileReader(p) as r:
+            choice = cost.choose_engine(r)
+        assert choice.engine == "host"
+        assert "x64" in choice.reason
+    finally:
+        jax.config.update("jax_enable_x64", True)

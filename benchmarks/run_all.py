@@ -189,12 +189,48 @@ def measure_rows_api(path, reps=3, engines=("host", "tpu", "auto")):
     return out
 
 
+def measure_batch_api(path, reps=3):
+    """The batch face vs the raw engine: stream_batches(engine="tpu")
+    must stay within ~2x of TpuRowGroupReader.iter_row_groups (it wraps
+    the same fused decode — arrays stay on device, no cell loop)."""
+    import jax
+
+    from parquet_floor_tpu import ParquetReader
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+
+    def raw_once():
+        r = TpuRowGroupReader(path, float64_policy="bits", dict_form="gather")
+        try:
+            t0 = time.perf_counter()
+            for cols in r.iter_row_groups():
+                jax.block_until_ready([c.values for c in cols.values()])
+            return time.perf_counter() - t0
+        finally:
+            r.close()
+
+    def batch_once():
+        t0 = time.perf_counter()
+        for cols in ParquetReader.stream_batches(path, engine="tpu"):
+            jax.block_until_ready([c.values for c in cols])
+        return time.perf_counter() - t0
+
+    raw_once(), batch_once()  # warm
+    raw = min(raw_once() for _ in range(reps))
+    batch = min(batch_once() for _ in range(reps))
+    return {
+        "raw_s": round(raw, 4),
+        "batch_s": round(batch, 4),
+        "batch_vs_raw": round(batch / raw, 2),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--json", default=None)
     ap.add_argument("--rows-api", action="store_true")
+    ap.add_argument("--batch-api", action="store_true")
     ap.add_argument(
         "--engine", dest="engines", action="append",
         choices=["host", "tpu", "auto"],
@@ -257,6 +293,16 @@ def main():
             flush=True,
         )
 
+    batch_api = None
+    if args.batch_api:
+        batch_api = measure_batch_api(lineitem_path, reps=args.reps)
+        print(
+            f"batch-api (lineitem): raw {batch_api['raw_s'] * 1e3:.1f} ms vs "
+            f"stream_batches {batch_api['batch_s'] * 1e3:.1f} ms "
+            f"({batch_api['batch_vs_raw']}x)",
+            flush=True,
+        )
+
     rows_api = None
     if args.rows_api:
         rows_api = measure_rows_api(
@@ -286,6 +332,7 @@ def main():
                     "link_GB_per_s": round(link, 3),
                     "results": results,
                     "rows_api": rows_api,
+                    "batch_api": batch_api,
                 },
                 f,
                 indent=2,

@@ -19,9 +19,17 @@ from .format.codecs import UnsupportedCodec, register_codec
 from .format.metadata import ParquetMetadata
 from .format.file_read import ParquetFileReader
 from .format.file_write import ColumnData, ParquetFileWriter, WriterOptions
-from .api.hydrate import Dehydrator, Hydrator, HydratorSupplier, ValueWriter
+from .api.hydrate import (
+    BatchHydrator,
+    BatchHydratorSupplier,
+    Dehydrator,
+    Hydrator,
+    HydratorSupplier,
+    ValueWriter,
+)
 from .api.reader import ParquetReader
 from .api.writer import ParquetWriter
+from .batch.columns import BatchColumn, batch_to_arrow
 from .batch.nested import NestedColumn, assemble_nested, shred_nested
 from .batch.predicate import Predicate, col
 from .utils import trace
@@ -29,14 +37,15 @@ from .utils import trace
 __version__ = "0.3.0"
 
 __all__ = [
-    "ColumnData", "ColumnDescriptor", "CompressionCodec", "Dehydrator",
+    "BatchColumn", "BatchHydrator", "BatchHydratorSupplier", "ColumnData",
+    "ColumnDescriptor", "CompressionCodec", "Dehydrator",
     "DeviceColumn", "Encoding", "GroupType", "Hydrator", "HydratorSupplier",
     "LogicalAnnotation", "MessageType", "NestedColumn", "ParquetFileReader",
     "ParquetFileWriter", "ParquetMetadata", "ParquetReader", "ParquetWriter",
     "Predicate", "PrimitiveType", "TpuRowGroupReader", "Type",
-    "UnsupportedCodec", "assemble_nested", "col", "read_sharded_global",
-    "register_codec", "shred_nested", "trace", "types", "ValueWriter",
-    "WriterOptions",
+    "UnsupportedCodec", "assemble_nested", "batch_to_arrow", "col",
+    "read_sharded_global", "register_codec", "shred_nested", "trace",
+    "types", "ValueWriter", "WriterOptions",
 ]
 
 _LAZY = {

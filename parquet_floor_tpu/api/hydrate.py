@@ -58,6 +58,71 @@ class HydratorSupplier(ABC, Generic[U, S]):
         return _Const()
 
 
+class BatchHydrator(ABC, Generic[S]):
+    """The BATCH face of the Hydrator boundary (no reference
+    counterpart at this granularity — the native win SURVEY.md §7 L3
+    names): instead of one ``add`` call per cell, the plugin receives
+    one call per ROW GROUP with every projected column as an array.
+
+    Ordering contract (parity with ``HydratorSupplier.java:10-15``):
+    columns arrive in the same order as the descriptors supplied to
+    ``BatchHydratorSupplier.get``.  Arrays are ``batch.columns
+    .BatchColumn`` — NumPy from the host engine, device-resident
+    ``jax.Array`` from the TPU engine (no device→host copy unless the
+    plugin asks for one).
+    """
+
+    @abstractmethod
+    def batch(self, group_index: int, columns: List[Any]) -> S:
+        """Consume one row group; returns the hydrated batch."""
+
+
+class BatchHydratorSupplier(ABC, Generic[S]):
+    """Factory receiving the projected columns (ordering contract as
+    ``HydratorSupplier``)."""
+
+    @abstractmethod
+    def get(self, columns: List[ColumnDescriptor]) -> BatchHydrator[S]: ...
+
+    @staticmethod
+    def constantly(hydrator: BatchHydrator[S]) -> "BatchHydratorSupplier[S]":
+        class _Const(BatchHydratorSupplier):
+            def get(self, columns):
+                return hydrator
+
+        return _Const()
+
+
+class FnBatchHydrator(BatchHydrator):
+    def __init__(self, fn: Callable[[int, List[Any]], Any]):
+        self._fn = fn
+
+    def batch(self, group_index, columns):
+        return self._fn(group_index, columns)
+
+
+def batch_supplier_of(obj) -> BatchHydratorSupplier:
+    """Coerce a BatchHydrator / supplier / callable / None into a
+    supplier.  ``None`` → identity (yield the ``BatchColumn`` lists)."""
+    if obj is None:
+        return BatchHydratorSupplier.constantly(
+            FnBatchHydrator(lambda gi, cols: cols)
+        )
+    if isinstance(obj, BatchHydratorSupplier):
+        return obj
+    if isinstance(obj, BatchHydrator):
+        return BatchHydratorSupplier.constantly(obj)
+    if callable(obj):
+        class _Fn(BatchHydratorSupplier):
+            def get(self, columns):
+                return obj(columns)
+
+        return _Fn()
+    raise TypeError(
+        f"cannot make a BatchHydratorSupplier from {type(obj).__name__}"
+    )
+
+
 class Dehydrator(ABC, Generic[T]):
     """Writes one record's fields through a ValueWriter (``Dehydrator.java:13``)."""
 

@@ -582,6 +582,126 @@ class ParquetReader:
     def read_row_group_batch(self, index: int) -> RowGroupBatch:
         return self._reader.read_row_group(index, self._filter)
 
+    @staticmethod
+    def stream_batches(source, batch_hydrator=None,
+                       columns: Optional[Sequence[str]] = None,
+                       engine: str = "host", predicate=None):
+        """The BATCH face of the Hydrator boundary: one plugin call per
+        ROW GROUP, columns as arrays in column order (the
+        ``HydratorSupplier.java:10-15`` ordering contract lifted to
+        batch granularity — SURVEY.md §7 L3's "zero-copy batch/Arrow-
+        style access").
+
+        ``batch_hydrator`` is a ``BatchHydrator`` / supplier / callable
+        (``columns -> BatchHydrator``); ``None`` yields the raw
+        ``BatchColumn`` lists.  ``engine`` as in ``stream_content``:
+        "host" serves NumPy arrays, "tpu" serves device-resident
+        ``jax.Array``s from the fused engine (no device→host copy
+        unless the plugin takes one — export via DLPack /
+        ``BatchColumn.to_arrow()`` / ``batch_to_arrow``), "auto" routes
+        by the footer cost model.  ``predicate`` skips row groups whose
+        statistics prove no match; the yielded ``group_index`` values
+        stay the file's real group indices.
+
+        Returns a generator; closing it (or exhausting it) closes the
+        file.
+        """
+        from ..batch.columns import BatchColumn
+        from ..format.parquet_thrift import Type as _T
+        from .hydrate import batch_supplier_of
+
+        if engine not in ("host", "tpu", "auto"):
+            raise ValueError(f"bad engine {engine!r}: expected host|tpu|auto")
+        reader = ParquetFileReader(source)
+        try:
+            if engine == "auto":
+                from ..tpu.cost import choose_engine
+
+                engine = choose_engine(
+                    reader, purpose="batch",
+                    columns=set(columns) if columns else None,
+                ).engine
+            schema = reader.schema
+            selected = [
+                c for c in schema.columns
+                if not columns or c.path[0] in set(columns)
+            ]
+            flt = {c.path[0] for c in selected} if columns else None
+            hyd = batch_supplier_of(batch_hydrator).get(selected)
+            keep = (
+                set(predicate.row_groups(reader))
+                if predicate is not None
+                else None
+            )
+        except BaseException:
+            reader.close()
+            raise
+
+        def host_gen():
+            try:
+                for gi in range(len(reader.row_groups)):
+                    if keep is not None and gi not in keep:
+                        continue
+                    batch = reader.read_row_group(gi, flt)
+                    by_path = {b.descriptor.path: b for b in batch.columns}
+                    cols = []
+                    for desc in selected:
+                        cb = by_path[desc.path]
+                        if cb.rep_levels is not None:
+                            cols.append(BatchColumn(
+                                desc, cb.values,
+                                lengths=(
+                                    cb.values.lengths()
+                                    if hasattr(cb.values, "lengths")
+                                    else None
+                                ),
+                                def_levels=cb.def_levels,
+                                rep_levels=cb.rep_levels,
+                            ))
+                            continue
+                        dense, mask = cb.dense()
+                        lens = (
+                            dense.lengths()
+                            if hasattr(dense, "lengths")
+                            else None
+                        )
+                        cols.append(BatchColumn(desc, dense, mask, lens))
+                    yield hyd.batch(gi, cols)
+            finally:
+                reader.close()
+
+        def tpu_gen():
+            from ..tpu.engine import TpuRowGroupReader
+
+            try:
+                tpu = TpuRowGroupReader(
+                    reader, float64_policy="bits", dict_form="gather"
+                )
+            except BaseException:
+                reader.close()
+                raise
+            try:
+                names = [c.path[0] for c in selected]
+                indices = [
+                    i for i in range(len(reader.row_groups))
+                    if keep is None or i in keep
+                ]
+                gen = tpu.iter_row_groups(columns=names, indices=indices)
+                for gi, group in zip(indices, gen):
+                    cols = []
+                    for desc in selected:
+                        dc = group[".".join(desc.path)]
+                        cols.append(BatchColumn(
+                            desc, dc.values, dc.mask, dc.lengths,
+                            dc.def_levels, dc.rep_levels,
+                            f64_bits=desc.physical_type == _T.DOUBLE,
+                        ))
+                    yield hyd.batch(gi, cols)
+            finally:
+                tpu.close()  # owns (and closes) the file reader
+
+        return tpu_gen() if engine == "tpu" else host_gen()
+
     # -- static factories (reference API verbs) ----------------------------
 
     @staticmethod

@@ -110,3 +110,141 @@ class RowGroupBatch:
             if c.descriptor.path[0] == top_level_name:
                 return c
         raise KeyError(f"no column with top-level name {top_level_name!r}")
+
+
+@dataclass
+class BatchColumn:
+    """One decoded column of one row group, as the batch-hydration
+    protocol serves it (``ParquetReader.stream_batches``) — the batch
+    face of the Hydrator boundary (SURVEY.md §7 L3: "zero-copy
+    batch/Arrow-style access (native win)").
+
+    Engine-neutral contract:
+      * fixed-width columns: ``values`` is a typed ``(n,)`` array —
+        NumPy from the host engine, ``jax.Array`` living on device from
+        the TPU engine (zero-copy on each side; DOUBLE is real float64
+        either way).  FLBA/INT96 arrive as ``(n, width)`` uint8 rows.
+      * strings: engine-native layout — host: a ``ByteArrayColumn``
+        (int64 offsets + contiguous data, ``lengths`` = per-row bytes);
+        device: ``(n, max_len)`` uint8 rows on device plus ``lengths``.
+        ``bytes_list()`` / ``to_arrow()`` consume both uniformly.
+      * ``mask`` is True at nulls (None for required columns).
+      * repeated leaves: ``values`` is the dense non-null value stream
+        and ``def_levels``/``rep_levels`` carry the Dremel levels
+        (assemble via ``batch.nested.assemble_nested`` or
+        ``DeviceColumn.assemble``).
+
+    Device arrays export zero-copy through the standard DLPack protocol
+    (``__dlpack__`` delegates to ``values``); ``to_arrow()`` builds a
+    ``pyarrow`` array (zero-copy for host primitives and large_binary —
+    device arrays cross device→host first, which is a copy by nature).
+    """
+
+    descriptor: ColumnDescriptor
+    values: object
+    mask: Optional[object] = None
+    lengths: Optional[object] = None
+    def_levels: Optional[object] = None
+    rep_levels: Optional[object] = None
+    # DOUBLE through the TPU engine: exact int64 bit patterns (TPU f64
+    # storage is emulated and cannot hold arbitrary doubles losslessly).
+    # ``to_numpy()``/``to_arrow()`` view them back to float64 on host;
+    # on-device consumers get the raw bits via ``values``/DLPack.
+    f64_bits: bool = False
+
+    @property
+    def is_strings(self) -> bool:
+        return self.lengths is not None
+
+    def __dlpack__(self, **kw):
+        return self.values.__dlpack__(**kw)
+
+    def __dlpack_device__(self):
+        return self.values.__dlpack_device__()
+
+    def _host(self, arr):
+        return np.asarray(arr) if arr is not None else None
+
+    def to_numpy(self) -> np.ndarray:
+        """``values`` on host as NumPy (bit-form DOUBLE → float64)."""
+        v = np.asarray(self.values)
+        if self.f64_bits and v.dtype == np.int64:
+            v = v.view(np.float64)
+        return v
+
+    def bytes_list(self) -> list:
+        """Strings as a list of ``bytes`` (both engine layouts)."""
+        if not self.is_strings:
+            raise ValueError("bytes_list() is for string columns")
+        if isinstance(self.values, ByteArrayColumn):
+            return self.values.to_list()
+        rows = self._host(self.values)
+        lens = self._host(self.lengths)
+        buf = rows.tobytes()
+        ml = rows.shape[1] if rows.ndim == 2 else 0
+        return [
+            buf[i * ml : i * ml + int(ln)] for i, ln in enumerate(lens)
+        ]
+
+    def to_arrow(self):
+        """This column as a ``pyarrow`` array.
+
+        Host primitives wrap the NumPy buffer zero-copy (the validity
+        bitmap, when present, is built); host strings become
+        ``large_binary`` over the existing offsets+data buffers
+        (zero-copy); device arrays are fetched to host first; FLBA/INT96
+        byte rows become ``fixed_size_binary``.
+        """
+        import pyarrow as pa
+
+        if self.rep_levels is not None:
+            raise ValueError(
+                "to_arrow() serves flat columns; assemble repeated "
+                "leaves via assemble_nested()/DeviceColumn.assemble()"
+            )
+        mask = self._host(self.mask)
+        validity = (
+            None
+            if mask is None
+            else pa.py_buffer(np.packbits(~mask, bitorder="little"))
+        )
+        null_count = int(mask.sum()) if mask is not None else 0
+        if self.is_strings:
+            if isinstance(self.values, ByteArrayColumn):
+                offsets, data = self.values.offsets, self.values.data
+            else:
+                rows = self._host(self.values)
+                lens = self._host(self.lengths).astype(np.int64)
+                offsets = np.zeros(len(lens) + 1, dtype=np.int64)
+                np.cumsum(lens, out=offsets[1:])
+                if len(lens) and rows.size:
+                    ml = rows.shape[1]
+                    lane = np.arange(ml)[None, :]
+                    data = rows[lane < lens[:, None]]
+                else:
+                    data = np.zeros(0, np.uint8)
+            return pa.LargeBinaryArray.from_buffers(
+                pa.large_binary(), len(offsets) - 1,
+                [validity, pa.py_buffer(offsets), pa.py_buffer(data)],
+                null_count=null_count,
+            )
+        vals = self.to_numpy()
+        if vals.ndim == 2:  # FLBA / INT96 byte rows
+            width = vals.shape[1]
+            flat = np.ascontiguousarray(vals, dtype=np.uint8)
+            return pa.FixedSizeBinaryArray.from_buffers(
+                pa.binary(width), len(vals),
+                [validity, pa.py_buffer(flat)], null_count=null_count,
+            )
+        return pa.array(vals, mask=mask)
+
+
+def batch_to_arrow(columns: List["BatchColumn"]):
+    """A list of flat ``BatchColumn``s (one row group) as a
+    ``pyarrow.RecordBatch`` in the given column order."""
+    import pyarrow as pa
+
+    return pa.RecordBatch.from_arrays(
+        [c.to_arrow() for c in columns],
+        names=[".".join(c.descriptor.path) for c in columns],
+    )

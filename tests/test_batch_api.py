@@ -1,0 +1,204 @@
+"""The batch face of the Hydrator boundary: ``stream_batches`` +
+``BatchColumn`` export (DLPack / Arrow) must agree cell-for-cell with the
+row face on both engines (VERDICT r3 #2; SURVEY.md §7 L3 "zero-copy
+batch/Arrow-style access")."""
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_floor_tpu import (
+    CompressionCodec,
+    ParquetFileWriter,
+    ParquetReader,
+    WriterOptions,
+    batch_to_arrow,
+    col,
+    types,
+)
+from parquet_floor_tpu.api.hydrate import FnBatchHydrator
+
+ENGINES = ("host", "tpu")
+
+
+def _write_mixed(path, n=5000, groups=3):
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.optional(types.DOUBLE).named("d"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.BOOLEAN).named("b"),
+    )
+    opts = WriterOptions(
+        codec=CompressionCodec.SNAPPY,
+        row_group_rows=(n + groups - 1) // groups,
+        enable_dictionary=True,
+    )
+    rng = np.random.default_rng(7)
+    data = {
+        "k": np.arange(n, dtype=np.int64),
+        "d": [None if i % 11 == 0 else float(v)
+              for i, v in enumerate(rng.standard_normal(n))],
+        "s": [None if i % 7 == 0 else f"v{i % 30}" for i in range(n)],
+        "b": [bool(i % 3 == 0) for i in range(n)],
+    }
+    per = (n + groups - 1) // groups
+    with ParquetFileWriter(path, schema, opts) as w:
+        for lo in range(0, n, per):
+            hi = min(lo + per, n)
+            w.write_columns({
+                k: (v[lo:hi] if isinstance(v, list) else v[lo:hi])
+                for k, v in data.items()
+            })
+    return str(path), data
+
+
+class _RowTuples:
+    def start(self):
+        return []
+
+    def add(self, t, h, v):
+        t.append(v)
+        return t
+
+    def finish(self, t):
+        return tuple(t)
+
+
+def _rows_from_batch(cols):
+    """Rebuild API-equivalent row tuples from one group's BatchColumns."""
+    out_cols = []
+    for c in cols:
+        if c.is_strings:
+            cells = c.bytes_list()
+            stringify = c.descriptor.primitive.stringify
+            cells = [stringify(b) for b in cells]
+        else:
+            v = c.to_numpy()
+            if v.ndim == 2:
+                stringify = c.descriptor.primitive.stringify
+                cells = [stringify(v[i].tobytes()) for i in range(len(v))]
+            else:
+                cells = v.tolist()
+        if c.mask is not None:
+            m = np.asarray(c.mask)
+            cells = [None if m[i] else cells[i] for i in range(len(cells))]
+        out_cols.append(cells)
+    return list(zip(*out_cols))
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_batch_face_agrees_with_row_face(tmp_path, engine):
+    path, _ = _write_mixed(tmp_path / "m.parquet")
+    rows = list(ParquetReader.stream_content(
+        path, lambda c: _RowTuples(), engine=engine
+    ))
+    batch_rows = []
+    for cols in ParquetReader.stream_batches(path, engine=engine):
+        batch_rows.extend(_rows_from_batch(cols))
+    assert batch_rows == rows
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_batch_to_arrow_matches_pyarrow(tmp_path, engine):
+    path, data = _write_mixed(tmp_path / "a.parquet")
+    oracle = pq.read_table(path)
+    got = {"k": [], "d": [], "s": [], "b": []}
+    for cols in ParquetReader.stream_batches(path, engine=engine):
+        rb = batch_to_arrow(cols)
+        assert rb.schema.names == ["k", "d", "s", "b"]
+        for nm in got:
+            got[nm].extend(rb.column(nm).to_pylist())
+    assert got["k"] == oracle.column("k").to_pylist()
+    assert got["b"] == oracle.column("b").to_pylist()
+    assert got["d"] == oracle.column("d").to_pylist()
+    exp_s = [
+        None if v is None else v.encode()
+        for v in oracle.column("s").to_pylist()
+    ]
+    assert got["s"] == exp_s
+
+
+def test_ordering_contract_and_projection(tmp_path):
+    """Columns arrive in the order the supplier saw (the
+    HydratorSupplier.java:10-15 contract at batch granularity), under
+    projection."""
+    path, data = _write_mixed(tmp_path / "p.parquet")
+    seen = {}
+
+    def supplier(columns):
+        seen["paths"] = [c.path[0] for c in columns]
+        return FnBatchHydrator(
+            lambda gi, cols: [c.descriptor.path[0] for c in cols]
+        )
+
+    orders = list(ParquetReader.stream_batches(
+        path, supplier, columns=["s", "k"]
+    ))
+    assert seen["paths"] == ["k", "s"]  # schema order, projected
+    assert all(o == ["k", "s"] for o in orders)
+
+
+def test_predicate_keeps_real_group_indices(tmp_path):
+    path, data = _write_mixed(tmp_path / "q.parquet")
+    idx = []
+    gen = ParquetReader.stream_batches(
+        path, FnBatchHydrator(lambda gi, cols: gi),
+        predicate=col("k") >= 2000,
+    )
+    idx = list(gen)
+    assert idx and 0 not in idx  # first group (k < 1667) pruned
+
+
+def test_dlpack_and_f64_bits(tmp_path):
+    path, data = _write_mixed(tmp_path / "z.parquet")
+    host_d = []
+    for cols in ParquetReader.stream_batches(path, engine="host"):
+        k = cols[0]
+        arr = np.from_dlpack(k)  # zero-copy DLPack export
+        np.testing.assert_array_equal(arr, np.asarray(k.values))
+        host_d.append(cols[1].to_numpy())
+    tpu_d = []
+    for cols in ParquetReader.stream_batches(path, engine="tpu"):
+        d = cols[1]
+        assert d.f64_bits and np.asarray(d.values).dtype == np.int64
+        tpu_d.append(d.to_numpy())  # bit-form views back to float64
+        assert d.to_numpy().dtype == np.float64
+    for h, t in zip(host_d, tpu_d):
+        np.testing.assert_array_equal(
+            h[~np.isnan(h)], t[~np.isnan(t)]
+        )
+
+
+def test_repeated_leaf_through_batches(tmp_path):
+    """Repeated leaves surface the dense value stream + Dremel levels."""
+    from parquet_floor_tpu import ParquetFileReader, assemble_nested
+    from parquet_floor_tpu.batch.columns import ColumnBatch
+
+    t = types
+    schema = t.message(
+        "m", t.list_of(t.required(t.INT64).named("element"), "v")
+    )
+    path = str(tmp_path / "n.parquet")
+    rows = [[1, 2], [], [3], [4, 5, 6]]
+    with ParquetFileWriter(path, schema) as w:
+        w.write_columns({"v": rows})
+    with ParquetFileReader(path) as r:
+        sch = r.schema
+    for engine in ENGINES:
+        for cols in ParquetReader.stream_batches(path, engine=engine):
+            (c,) = cols
+            assert c.rep_levels is not None
+            defs = np.asarray(c.def_levels).astype(np.uint32)
+            reps = np.asarray(c.rep_levels).astype(np.uint32)
+            nn = int(np.count_nonzero(defs == c.descriptor.max_definition_level))
+            vals = np.asarray(c.values)[:nn]
+            cb = ColumnBatch(c.descriptor, len(defs), vals, defs, reps)
+            assert assemble_nested(sch, cb).to_pylist() == rows, engine
+
+
+def test_batch_stream_closes_on_generator_close(tmp_path):
+    path, _ = _write_mixed(tmp_path / "c.parquet")
+    gen = ParquetReader.stream_batches(path)
+    next(gen)
+    gen.close()  # must not leak the file (ResourceWarning would fire)

@@ -201,6 +201,44 @@ class DeviceColumn:
         return assemble_nested(schema, batch)
 
 
+def _concat_device_columns(parts: List["DeviceColumn"]) -> "DeviceColumn":
+    """Concatenate row-split segments of one FLAT column on device.
+
+    Segment outputs are exact (num_rows,)-shaped (dense scatter trims
+    bucket padding), so concatenation reassembles the group losslessly;
+    string byte matrices pad to the widest segment first.  The dict_ref
+    of the last segment wins (content-keyed pools only grow)."""
+    if len(parts) == 1:
+        return parts[0]
+    first = parts[0]
+    lens = None
+    if first.lengths is not None:
+        ml = max(int(p.values.shape[1]) for p in parts)
+        vals = jnp.concatenate([
+            p.values if int(p.values.shape[1]) == ml
+            else jnp.pad(p.values, ((0, 0), (0, ml - int(p.values.shape[1]))))
+            for p in parts
+        ])
+        lens = jnp.concatenate([p.lengths for p in parts])
+    else:
+        dts = {str(p.values.dtype) for p in parts}
+        if len(dts) > 1:
+            # index-form dictionary streams can widen between segments
+            # when the pool bucket crosses a dtype boundary
+            dt = np.result_type(*sorted(dts))
+            vals = jnp.concatenate([p.values.astype(dt) for p in parts])
+        else:
+            vals = jnp.concatenate([p.values for p in parts])
+    mask = (
+        jnp.concatenate([p.mask for p in parts])
+        if first.mask is not None
+        else None
+    )
+    out = DeviceColumn(first.descriptor, vals, mask, lens)
+    out.dict_ref = parts[-1].dict_ref
+    return out
+
+
 class _Fallback(Exception):
     """Signal at layout time: this chunk takes the host NumPy path."""
 
@@ -1663,6 +1701,21 @@ class TpuRowGroupReader:
             if host_threads and host_threads > 1
             else None
         )
+        # Arena byte budget per decode launch.  Groups whose footer
+        # estimate exceeds it split into multiple launches
+        # (read_row_group chunking) instead of erroring.  The default is
+        # an HBM WORKING-SET budget, not the int32 plan ceiling:
+        # byte-granular decode on TPU pads narrow (n, width) reshapes to
+        # (8,128) tiles, so a launch transiently needs up to ~64x its
+        # arena bytes (measured: a 64-bit PLAIN column costs ~512 B per
+        # value through the u8→u32→i64 bitcast chain).  64 MiB bounds
+        # that at ~4 GB of HBM while keeping every bench config a single
+        # launch.  PFTPU_ARENA_CAP (bytes) overrides either way; the
+        # absolute int32 ceiling stays as the per-launch safety net.
+        self._arena_cap = min(
+            int(_os.environ.get("PFTPU_ARENA_CAP", str(1 << 26))),
+            (1 << 31) - (1 << 24),
+        )
         self._forced: set = set()   # columns pinned to the host path (per file)
         self._hwm_state: Dict[tuple, int] = {}
         # string-dictionary pools are keyed by (sha256(content), cap, len).
@@ -1756,11 +1809,130 @@ class TpuRowGroupReader:
     def __exit__(self, *exc):
         self.close()
 
+    def _group_byte_estimate(self, rg, want=None) -> int:
+        """Footer estimate of a group's arena demand: total decompressed
+        bytes of its (selected) chunks."""
+        return sum(
+            int(c.meta_data.total_uncompressed_size or 0)
+            for c in rg.columns or []
+            if not want or c.meta_data.path_in_schema[0] in want
+        )
+
     def read_row_group(
         self, index: int, columns: Optional[Sequence[str]] = None
     ) -> Dict[str, DeviceColumn]:
+        rg = self.reader.row_groups[index]
+        want = set(columns) if columns else None
+        if self._group_byte_estimate(rg, want) > self._arena_cap:
+            # oversized group: split into multiple launches instead of
+            # erroring (the reference streams page-at-a-time with no
+            # group-size ceiling at all, ParquetReader.java:182-194)
+            return self._read_row_group_chunked(rg, index, want)
         sg = self._stage_row_group(index, columns)
         return self._launch(sg)
+
+    def _read_row_group_chunked(self, rg, index: int, want) -> Dict[str, DeviceColumn]:
+        """Decode one oversized row group in several launches: greedy
+        COLUMN bins under the cap first; a single field whose chunks
+        alone exceed the cap row-splits on the common page grid."""
+        fields: List[str] = []
+        field_bytes: Dict[str, int] = {}
+        for c in rg.columns or []:
+            top = c.meta_data.path_in_schema[0]
+            if want and top not in want:
+                continue
+            if top not in field_bytes:
+                fields.append(top)
+                field_bytes[top] = 0
+            field_bytes[top] += int(c.meta_data.total_uncompressed_size or 0)
+        out: Dict[str, DeviceColumn] = {}
+        bin_names: List[str] = []
+        bin_total = 0
+
+        def flush_bin():
+            nonlocal bin_names, bin_total
+            if bin_names:
+                sg = self._stage_row_group(index, list(bin_names))
+                out.update(self._launch(sg))
+                bin_names = []
+                bin_total = 0
+
+        for f in fields:
+            fb = field_bytes[f]
+            if fb > self._arena_cap:
+                flush_bin()
+                out.update(self._read_field_row_split(rg, index, f, fb))
+                continue
+            if bin_total + fb > self._arena_cap:
+                flush_bin()
+            bin_names.append(f)
+            bin_total += fb
+        flush_bin()
+        return out
+
+    def _read_field_row_split(self, rg, index: int, field: str,
+                              field_bytes: int) -> Dict[str, DeviceColumn]:
+        """One field bigger than the arena cap: decode page-aligned row
+        segments in successive launches and concatenate on device.
+        Needs the OffsetIndex (to find page-aligned split points shared
+        by the field's leaves) and flat leaves (repeated value streams
+        are padded per launch and cannot be concatenated blindly)."""
+        n = int(rg.num_rows or 0)
+        chunks = [
+            c for c in rg.columns or []
+            if c.meta_data.path_in_schema[0] == field
+        ]
+        grids = []
+        for c in chunks:
+            desc = self.reader.schema.column(tuple(c.meta_data.path_in_schema))
+            if desc.max_repetition_level > 0:
+                raise ValueError(
+                    f"row group {index} stages ~{field_bytes} decompressed "
+                    f"bytes in repeated column {field!r}, above the "
+                    f"{self._arena_cap}-byte launch cap, and repeated "
+                    "columns cannot row-split — rewrite the file with "
+                    "smaller row groups or use the host ParquetFileReader"
+                )
+            oi = self.reader.read_offset_index(c)
+            if oi is None or not oi.page_locations:
+                raise ValueError(
+                    f"row group {index} stages ~{field_bytes} decompressed "
+                    f"bytes in column {field!r}, above the "
+                    f"{self._arena_cap}-byte launch cap, and the file has "
+                    "no OffsetIndex to row-split on — rewrite with smaller "
+                    "row groups (or write_page_index) or use the host "
+                    "ParquetFileReader"
+                )
+            grids.append({int(pl.first_row_index or 0) for pl in oi.page_locations})
+        common = sorted(set.intersection(*grids) | {0})
+        per_row = field_bytes / max(n, 1)
+        cap_rows = max(int(self._arena_cap / max(per_row, 1e-9)), 1)
+        segs = []
+        start = 0
+        prev = None
+        for p in [q for q in common if q > 0] + [n]:
+            if p - start > cap_rows and prev is not None and prev > start:
+                segs.append((start, prev))
+                start = prev
+            prev = p
+        if start < n:
+            segs.append((start, n))
+        if len(segs) <= 1:
+            raise ValueError(
+                f"row group {index} column {field!r} has no page boundary "
+                f"to split its ~{field_bytes} decompressed bytes under the "
+                f"{self._arena_cap}-byte launch cap — rewrite the file "
+                "with smaller pages/row groups or use the host "
+                "ParquetFileReader"
+            )
+        parts: Dict[str, List[DeviceColumn]] = {}
+        for a, b in segs:
+            sg = self._stage_row_group(
+                index, [field], covered=[(a, b)], group_rows=n
+            )
+            for k, v in self._launch(sg).items():
+                parts.setdefault(k, []).append(v)
+        return {k: _concat_device_columns(v) for k, v in parts.items()}
 
     def read_row_group_ranges(
         self, index: int, row_ranges, columns: Optional[Sequence[str]] = None
@@ -1815,6 +1987,30 @@ class TpuRowGroupReader:
             indices = list(indices)
         else:
             indices = list(range(self.num_row_groups))
+        want = set(columns) if columns else None
+        big = {
+            i for i in indices
+            if self._group_byte_estimate(self.reader.row_groups[i], want)
+            > self._arena_cap
+        }
+        if big:
+            # oversized groups decode via the multi-launch chunk path,
+            # outside the pipeline; the normal runs between them keep
+            # the 3-stage pipeline
+            run: List[int] = []
+            for i in indices:
+                if i in big:
+                    if run:
+                        yield from self.iter_row_groups(
+                            columns, prefetch, indices=run
+                        )
+                        run = []
+                    yield self.read_row_group(i, columns)
+                else:
+                    run.append(i)
+            if run:
+                yield from self.iter_row_groups(columns, prefetch, indices=run)
+            return
         if not prefetch or len(indices) <= 1:
             for i in indices:
                 yield self.read_row_group(i, columns)
@@ -1981,10 +2177,15 @@ class TpuRowGroupReader:
                                raw_pages=raw_pages)
                 )
         if arena_b.size >= (1 << 31) - (1 << 20):
+            # per-LAUNCH safety net (int32 device plans), normally never
+            # hit: oversized groups split into multiple launches first
+            # (read_row_group chunking).  Reachable only when padding
+            # inflates one launch far past its footer estimate.
             raise ValueError(
-                f"row group stages {arena_b.size} decompressed bytes; the "
-                "TPU engine supports row groups up to 2 GiB — rewrite the "
-                "file with smaller row groups or use the host ParquetFileReader"
+                f"one decode launch stages {arena_b.size} bytes, past the "
+                "2 GiB int32 plan ceiling — lower PFTPU_ARENA_CAP so the "
+                "group splits into more launches, or use the host "
+                "ParquetFileReader"
             )
         tail = plk.ARENA_TAIL if self._pl_enabled else 8
         cap = self._hwm(("arena",), arena_b.size + tail, minimum=1 << 16)

@@ -1,0 +1,151 @@
+"""Oversized-row-group chunking (VERDICT r3 #4): groups past the arena
+cap split into multiple decode launches — column bins, then page-aligned
+row segments — instead of erroring.  PFTPU_ARENA_CAP lowers the cap so
+the chunk path proves bit-exact at test sizes; the reference streams
+page-at-a-time with no group ceiling at all (ParquetReader.java:182-194).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_floor_tpu import (
+    CompressionCodec,
+    ParquetFileReader,
+    ParquetFileWriter,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+
+
+def _assert_group_parity(path, dev_group, host_reader, gi):
+    hb = host_reader.read_row_group(gi)
+    for cb in hb.columns:
+        nm = cb.descriptor.path[0]
+        dc = dev_group[nm]
+        dense, mask = cb.dense()
+        if mask is not None:
+            np.testing.assert_array_equal(np.asarray(dc.mask), mask, err_msg=nm)
+        if isinstance(dense, ByteArrayColumn):
+            lens = np.asarray(dc.lengths)
+            rows = np.asarray(dc.values)
+            got = [rows[i, : lens[i]].tobytes() for i in range(len(lens))]
+            assert got == dense.to_list(), nm
+        else:
+            got = np.asarray(dc.values)
+            if mask is not None:
+                got = np.where(mask, 0, got)
+                dense = np.where(mask, 0, dense)
+            np.testing.assert_array_equal(got, dense, err_msg=nm)
+
+
+def _write_mixed(path, n=6000, groups=2):
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("a"),
+        types.optional(types.DOUBLE).named("b"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.INT32).named("c"),
+    )
+    rng = np.random.default_rng(11)
+    opts = WriterOptions(
+        codec=CompressionCodec.SNAPPY, data_page_values=500,
+        enable_dictionary=True,
+    )
+    per = (n + groups - 1) // groups
+    with ParquetFileWriter(path, schema, opts) as w:
+        for lo in range(0, n, per):
+            hi = min(lo + per, n)
+            m = hi - lo
+            w.write_columns({
+                "a": rng.integers(-(2**62), 2**62, m).astype(np.int64),
+                "b": [None if i % 9 == 0 else float(v)
+                      for i, v in enumerate(rng.standard_normal(m))],
+                "s": [None if i % 6 == 0 else f"str{i % 97}" for i in range(m)],
+                "c": rng.integers(-(2**31), 2**31, m).astype(np.int32),
+            })
+    return str(path)
+
+
+def test_column_bin_splitting(tmp_path, monkeypatch):
+    """Cap far below the group size: every field decodes in its own
+    launch; results merge bit-exact."""
+    path = _write_mixed(tmp_path / "m.parquet")
+    monkeypatch.setenv("PFTPU_ARENA_CAP", str(24 << 10))
+    with TpuRowGroupReader(path, float64_policy="float64") as tr, \
+            ParquetFileReader(path) as hr:
+        assert tr._arena_cap == 24 << 10
+        for gi in range(tr.num_row_groups):
+            est = tr._group_byte_estimate(tr.reader.row_groups[gi])
+            assert est > tr._arena_cap  # the cap actually binds
+            _assert_group_parity(path, tr.read_row_group(gi), hr, gi)
+
+
+def test_row_split_single_big_column(tmp_path, monkeypatch):
+    """One field alone exceeds the cap: it row-splits on the page grid
+    and the segments concatenate bit-exact (required + optional +
+    strings)."""
+    path = _write_mixed(tmp_path / "r.parquet", n=8000, groups=1)
+    # cap below every single field's bytes → every field row-splits
+    monkeypatch.setenv("PFTPU_ARENA_CAP", str(12 << 10))
+    with TpuRowGroupReader(path, float64_policy="float64") as tr, \
+            ParquetFileReader(path) as hr:
+        _assert_group_parity(path, tr.read_row_group(0), hr, 0)
+
+
+def test_iter_row_groups_mixes_chunked_and_pipelined(tmp_path, monkeypatch):
+    path = _write_mixed(tmp_path / "i.parquet", n=9000, groups=3)
+    monkeypatch.setenv("PFTPU_ARENA_CAP", str(48 << 10))
+    with TpuRowGroupReader(path, float64_policy="float64") as tr, \
+            ParquetFileReader(path) as hr:
+        groups = list(tr.iter_row_groups())
+        assert len(groups) == tr.num_row_groups
+        for gi, g in enumerate(groups):
+            _assert_group_parity(path, g, hr, gi)
+
+
+def test_projection_composes_with_chunking(tmp_path, monkeypatch):
+    path = _write_mixed(tmp_path / "p.parquet")
+    monkeypatch.setenv("PFTPU_ARENA_CAP", str(24 << 10))
+    with TpuRowGroupReader(path, float64_policy="float64") as tr, \
+            ParquetFileReader(path) as hr:
+        g = tr.read_row_group(0, columns=["a", "s"])
+        assert set(g) == {"a", "s"}
+        hb = hr.read_row_group(0)
+        np.testing.assert_array_equal(
+            np.asarray(g["a"].values), hb.column("a").values
+        )
+
+
+def test_no_offset_index_fails_loudly(tmp_path, monkeypatch):
+    """A single over-cap column in a file WITHOUT an OffsetIndex cannot
+    row-split: the error says so (and suggests the host reader)."""
+    path = str(tmp_path / "noidx.parquet")
+    pq.write_table(
+        pa.table({"v": np.arange(50_000, dtype=np.int64)}),
+        path, write_statistics=False, store_schema=False,
+        use_dictionary=False, data_page_size=4 << 10,
+        write_page_index=False, compression="NONE",
+    )
+    monkeypatch.setenv("PFTPU_ARENA_CAP", str(16 << 10))
+    with TpuRowGroupReader(path) as tr:
+        with pytest.raises(ValueError, match="OffsetIndex"):
+            tr.read_row_group(0)
+
+
+def test_oversized_repeated_column_fails_loudly(tmp_path, monkeypatch):
+    t = types
+    schema = t.message(
+        "m", t.list_of(t.required(t.INT64).named("element"), "v")
+    )
+    path = str(tmp_path / "rep.parquet")
+    rows = [[int(i), int(i) + 1] for i in range(20_000)]
+    with ParquetFileWriter(path, schema) as w:
+        w.write_columns({"v": rows})
+    monkeypatch.setenv("PFTPU_ARENA_CAP", str(16 << 10))
+    with TpuRowGroupReader(path) as tr:
+        with pytest.raises(ValueError, match="repeated"):
+            tr.read_row_group(0)

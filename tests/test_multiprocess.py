@@ -79,9 +79,36 @@ def _write_file(path: str) -> None:
             base += sz
 
 
+def _write_dataset(dir_path: str) -> list:
+    """3 files with UNEVEN groups-per-file (2, 1, 3) and ragged sizes —
+    the cross-file global assembly of read_dataset_sharded."""
+    t = types
+    schema = t.message(
+        "t",
+        t.required(t.INT64).named("id"),
+        t.optional(t.BYTE_ARRAY).as_(t.string()).named("s"),
+    )
+    os.makedirs(dir_path, exist_ok=True)
+    paths = []
+    base = 0
+    for f, sizes in enumerate([[300, 250], [420], [150, 310, 200]]):
+        p = os.path.join(dir_path, f"part{f}.parquet")
+        with ParquetFileWriter(
+            p, schema, WriterOptions(row_group_rows=max(sizes))
+        ) as w:
+            for sz in sizes:
+                ids = list(range(base, base + sz))
+                ss = [None if i % 9 == 0 else f"d{i % 23}" for i in ids]
+                w.write_columns({"id": ids, "s": ss})
+                base += sz
+        paths.append(p)
+    return paths
+
+
 def test_two_process_sharded_read(tmp_path):
     path = str(tmp_path / "mp.parquet")
     _write_file(path)
+    _write_dataset(str(tmp_path / "dataset"))
     port = _free_port()
     coord = f"127.0.0.1:{port}"
     worker = os.path.join(os.path.dirname(__file__), "multiproc_worker.py")
@@ -122,6 +149,14 @@ def test_two_process_sharded_read(tmp_path):
     assert r0["ghost"] == r1["ghost"]
     assert r0["num_rows"] == r1["num_rows"]
     assert r0["num_rows_pred"] == r1["num_rows_pred"]
+    # dataset (multi-file, uneven groups-per-file) assembly agrees too
+    assert r0["dataset"] == r1["dataset"]
+    assert r0["ds_rows"] == r1["ds_rows"]
+    assert set(r0["ds_rows"].values()) == {300 + 250 + 420 + 150 + 310 + 200}
+    # the engine="tpu" row stream ran under process_count()>1 and both
+    # processes hydrated identical rows
+    assert r0["tpu_rows"] == r1["tpu_rows"]
+    assert r0["tpu_rows_n"] == r1["tpu_rows_n"] == 4000
 
     # and they match a single-process read of the same file on THIS
     # process's 8-device mesh (identical global layout by construction).
@@ -144,6 +179,50 @@ def test_two_process_sharded_read(tmp_path):
             None if c.row_mask is None else np.asarray(c.row_mask),
         ))
     assert _digest(*[d.encode() for d in dig]) == r0["plain"]
+
+    # single-process dataset assembly matches the 2-process digest
+    from parquet_floor_tpu.parallel.multihost import read_dataset_sharded
+
+    ds_paths = sorted(
+        str(tmp_path / "dataset" / f)
+        for f in os.listdir(tmp_path / "dataset")
+        if f.endswith(".parquet")
+    )
+    out_d = read_dataset_sharded(ds_paths, mesh, float64_policy="float64")
+    dig_d = []
+    for name in sorted(out_d):
+        c = out_d[name]
+        dig_d.append(_digest(
+            None if c.values is None else np.asarray(c.values),
+            None if c.mask is None else np.asarray(c.mask),
+            None if c.lengths is None else np.asarray(c.lengths),
+            None if c.row_mask is None else np.asarray(c.row_mask),
+        ))
+    assert _digest(*[d.encode() for d in dig_d]) == r0["dataset"]
+
+    # single-process engine="tpu" row stream matches the workers'
+    from parquet_floor_tpu import ParquetReader
+
+    class _Rows:
+        def start(self):
+            return []
+
+        def add(self, t, h, v):
+            t.append(v)
+            return t
+
+        def finish(self, t):
+            return tuple(t)
+
+    h = hashlib.sha256()
+    n_stream = 0
+    for row in ParquetReader.stream_content(
+        path, lambda c: _Rows(), engine="tpu"
+    ):
+        h.update(repr(row).encode())
+        n_stream += 1
+    assert h.hexdigest() == r0["tpu_rows"]
+    assert n_stream == r0["tpu_rows_n"]
 
     # totals: plain = all rows; predicate id >= 2600 keeps groups 4, 5
     # (ids 2750.. start in group 4 at row 2750; group boundaries are the

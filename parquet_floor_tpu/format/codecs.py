@@ -255,6 +255,26 @@ def _brotli_compress(data: bytes) -> bytes:
     return brotli_codec.compress(data)
 
 
+def _lzo_decompress(data: bytes, uncompressed_size=None) -> bytes:
+    """LZO via the system liblzo2 (format/lzo_codec.py) when present —
+    the reference's reflective-codec-class architecture: without an LZO
+    implementation on the "classpath" the footer codec fails at runtime
+    there too (``ReflectionUtils.java:10-21``)."""
+    from . import lzo_codec
+
+    if not lzo_codec.available():
+        raise UnsupportedCodec(_codec_guidance(CompressionCodec.LZO))
+    return lzo_codec.hadoop_decompress(data, uncompressed_size)
+
+
+def _lzo_compress(data: bytes) -> bytes:
+    from . import lzo_codec
+
+    if not lzo_codec.available():
+        raise UnsupportedCodec(_codec_guidance(CompressionCodec.LZO))
+    return lzo_codec.hadoop_compress(data)
+
+
 _COMPRESSORS: Dict[int, Callable[[bytes], bytes]] = {
     CompressionCodec.UNCOMPRESSED: lambda d: d,
     CompressionCodec.SNAPPY: _snappy_compress,
@@ -263,6 +283,7 @@ _COMPRESSORS: Dict[int, Callable[[bytes], bytes]] = {
     CompressionCodec.LZ4_RAW: _lz4_raw_compress,
     CompressionCodec.LZ4: _lz4_hadoop_compress,
     CompressionCodec.BROTLI: _brotli_compress,
+    CompressionCodec.LZO: _lzo_compress,
 }
 
 _DECOMPRESSORS: Dict[int, Callable[..., bytes]] = {
@@ -273,6 +294,7 @@ _DECOMPRESSORS: Dict[int, Callable[..., bytes]] = {
     CompressionCodec.LZ4_RAW: _lz4_raw_decompress,
     CompressionCodec.LZ4: _lz4_hadoop_decompress,
     CompressionCodec.BROTLI: _brotli_decompress,
+    CompressionCodec.LZO: _lzo_decompress,
 }
 
 
@@ -312,8 +334,9 @@ def _codec_guidance(codec: int) -> str:
         )
     if codec == CompressionCodec.LZO:
         return (
-            f"{name} has no built-in implementation (GPL-licensed "
-            "upstream): provide one with register_codec("
+            f"{name}: the system LZO library (liblzo2) was not found "
+            "and none is vendored (GPL-licensed upstream); install "
+            "liblzo2, or provide an implementation with register_codec("
             "CompressionCodec.LZO, ...)"
         )
     return (

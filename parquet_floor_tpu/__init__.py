@@ -34,7 +34,7 @@ from .batch.nested import NestedColumn, assemble_nested, shred_nested
 from .batch.predicate import Predicate, col
 from .utils import trace
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "BatchColumn", "BatchHydrator", "BatchHydratorSupplier", "ColumnData",

@@ -603,8 +603,9 @@ class ParquetReader:
         statistics prove no match; the yielded ``group_index`` values
         stay the file's real group indices.
 
-        Returns a generator; closing it (or exhausting it) closes the
-        file.
+        Returns a generator.  The file opens on FIRST iteration (so a
+        generator closed before any ``next()`` never opens it) and
+        closes when the generator is exhausted or closed.
         """
         from ..batch.columns import BatchColumn
         from ..format.parquet_thrift import Type as _T
@@ -612,33 +613,57 @@ class ParquetReader:
 
         if engine not in ("host", "tpu", "auto"):
             raise ValueError(f"bad engine {engine!r}: expected host|tpu|auto")
-        reader = ParquetFileReader(source)
-        try:
-            if engine == "auto":
-                from ..tpu.cost import choose_engine
 
-                engine = choose_engine(
-                    reader, purpose="batch",
-                    columns=set(columns) if columns else None,
-                ).engine
-            schema = reader.schema
-            selected = [
-                c for c in schema.columns
-                if not columns or c.path[0] in set(columns)
-            ]
-            flt = {c.path[0] for c in selected} if columns else None
-            hyd = batch_supplier_of(batch_hydrator).get(selected)
-            keep = (
-                set(predicate.row_groups(reader))
-                if predicate is not None
-                else None
-            )
-        except BaseException:
-            reader.close()
-            raise
-
-        def host_gen():
+        def gen():
+            reader = ParquetFileReader(source)
+            closer = reader  # replaced by the engine once it takes ownership
             try:
+                eng = engine
+                if eng == "auto":
+                    from ..tpu.cost import choose_engine
+
+                    eng = choose_engine(
+                        reader, purpose="batch",
+                        columns=set(columns) if columns else None,
+                    ).engine
+                schema = reader.schema
+                selected = [
+                    c for c in schema.columns
+                    if not columns or c.path[0] in set(columns)
+                ]
+                flt = {c.path[0] for c in selected} if columns else None
+                hyd = batch_supplier_of(batch_hydrator).get(selected)
+                keep = (
+                    set(predicate.row_groups(reader))
+                    if predicate is not None
+                    else None
+                )
+                if eng == "tpu":
+                    from ..tpu.engine import TpuRowGroupReader
+
+                    tpu = TpuRowGroupReader(
+                        reader, float64_policy="bits", dict_form="gather"
+                    )
+                    closer = tpu  # owns (and closes) the file reader
+                    names = [c.path[0] for c in selected]
+                    indices = [
+                        i for i in range(len(reader.row_groups))
+                        if keep is None or i in keep
+                    ]
+                    groups = tpu.iter_row_groups(
+                        columns=names, indices=indices
+                    )
+                    for gi, group in zip(indices, groups):
+                        cols = []
+                        for desc in selected:
+                            dc = group[".".join(desc.path)]
+                            cols.append(BatchColumn(
+                                desc, dc.values, dc.mask, dc.lengths,
+                                dc.def_levels, dc.rep_levels,
+                                f64_bits=desc.physical_type == _T.DOUBLE,
+                            ))
+                        yield hyd.batch(gi, cols)
+                    return
                 for gi in range(len(reader.row_groups)):
                     if keep is not None and gi not in keep:
                         continue
@@ -668,39 +693,9 @@ class ParquetReader:
                         cols.append(BatchColumn(desc, dense, mask, lens))
                     yield hyd.batch(gi, cols)
             finally:
-                reader.close()
+                closer.close()
 
-        def tpu_gen():
-            from ..tpu.engine import TpuRowGroupReader
-
-            try:
-                tpu = TpuRowGroupReader(
-                    reader, float64_policy="bits", dict_form="gather"
-                )
-            except BaseException:
-                reader.close()
-                raise
-            try:
-                names = [c.path[0] for c in selected]
-                indices = [
-                    i for i in range(len(reader.row_groups))
-                    if keep is None or i in keep
-                ]
-                gen = tpu.iter_row_groups(columns=names, indices=indices)
-                for gi, group in zip(indices, gen):
-                    cols = []
-                    for desc in selected:
-                        dc = group[".".join(desc.path)]
-                        cols.append(BatchColumn(
-                            desc, dc.values, dc.mask, dc.lengths,
-                            dc.def_levels, dc.rep_levels,
-                            f64_bits=desc.physical_type == _T.DOUBLE,
-                        ))
-                    yield hyd.batch(gi, cols)
-            finally:
-                tpu.close()  # owns (and closes) the file reader
-
-        return tpu_gen() if engine == "tpu" else host_gen()
+        return gen()
 
     # -- static factories (reference API verbs) ----------------------------
 

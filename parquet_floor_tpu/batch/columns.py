@@ -203,13 +203,19 @@ class BatchColumn:
                 "leaves via assemble_nested()/DeviceColumn.assemble()"
             )
         mask = self._host(self.mask)
-        validity = (
-            None
-            if mask is None
-            else pa.py_buffer(np.packbits(~mask, bitorder="little"))
-        )
-        null_count = int(mask.sum()) if mask is not None else 0
+
+        def validity_and_nulls():
+            # built only for the from_buffers branches; pa.array(mask=)
+            # builds its own bitmap on the common primitive path
+            if mask is None:
+                return None, 0
+            return (
+                pa.py_buffer(np.packbits(~mask, bitorder="little")),
+                int(mask.sum()),
+            )
+
         if self.is_strings:
+            validity, null_count = validity_and_nulls()
             if isinstance(self.values, ByteArrayColumn):
                 offsets, data = self.values.offsets, self.values.data
             else:
@@ -230,6 +236,7 @@ class BatchColumn:
             )
         vals = self.to_numpy()
         if vals.ndim == 2:  # FLBA / INT96 byte rows
+            validity, null_count = validity_and_nulls()
             width = vals.shape[1]
             flat = np.ascontiguousarray(vals, dtype=np.uint8)
             return pa.FixedSizeBinaryArray.from_buffers(

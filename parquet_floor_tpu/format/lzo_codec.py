@@ -144,7 +144,12 @@ def hadoop_decompress(
 
 def hadoop_compress(data: bytes) -> bytes:
     """One Hadoop record: [ulen][clen][block] (write-side convenience,
-    mirroring the LZ4 legacy framing's single-record form)."""
+    mirroring the LZ4 legacy framing's single-record form).  Empty input
+    is a bare zero-length record — no inner block, matching the
+    decoder's ulen==0 handling (an inner block would be re-read as the
+    next record's header)."""
+    if not data:
+        return (0).to_bytes(4, "big")
     block = _block_compress(data)
     return (
         len(data).to_bytes(4, "big")

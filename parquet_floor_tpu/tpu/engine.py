@@ -1961,8 +1961,80 @@ class TpuRowGroupReader:
             return {}, []
         if covered is None or covered == [(0, n)]:
             return self.read_row_group(index, columns), [(0, n)] if n else []
+        # the arena cap binds ranged reads too (HBM working-set bound,
+        # same as read_row_group): oversized covers decode in several
+        # launches and concatenate — FLAT leaves only (repeated value
+        # streams are padded per launch; those keep the single launch
+        # and, past the int32 net, the loud error)
+        est = self._group_byte_estimate(rg, chunk_filter)
+        cov_rows = sum(b - a for a, b in covered)
+        per_row = est / max(n, 1)
+        flat = all(
+            self.reader.schema.column(
+                tuple(c.meta_data.path_in_schema)
+            ).max_repetition_level == 0
+            for c in chunks
+        )
+        if flat and cov_rows * per_row > self._arena_cap:
+            parts: Dict[str, List[DeviceColumn]] = {}
+            for sub in self._split_covered(covered, per_row, chunks):
+                sg = self._stage_row_group(
+                    index, columns, covered=sub, group_rows=n
+                )
+                for k, v in self._launch(sg).items():
+                    parts.setdefault(k, []).append(v)
+            return (
+                {k: _concat_device_columns(v) for k, v in parts.items()},
+                covered,
+            )
         sg = self._stage_row_group(index, columns, covered=covered, group_rows=n)
         return self._launch(sg), covered
+
+    def _split_covered(self, covered, per_row: float, chunks):
+        """Partition page-aligned covered ranges into consecutive
+        sublists each estimated under the arena cap; a single range too
+        big on its own splits further on the page-start grid shared by
+        the selected chunks (the OffsetIndexes exist — ``page_cover``
+        returned non-None)."""
+        cap_rows = max(int(self._arena_cap / max(per_row, 1e-9)), 1)
+        grid = None
+        ranges: List[tuple] = []
+        for a, b in covered:
+            if b - a <= cap_rows:
+                ranges.append((a, b))
+                continue
+            if grid is None:
+                sets = []
+                for c in chunks:
+                    oi = self.reader.read_offset_index(c)
+                    sets.append({
+                        int(pl.first_row_index or 0)
+                        for pl in (oi.page_locations if oi else [])
+                    })
+                grid = sorted(set.intersection(*sets)) if sets else []
+            cuts = [p for p in grid if a < p < b]
+            start = a
+            prev = None
+            for p in cuts + [b]:
+                if p - start > cap_rows and prev is not None and prev > start:
+                    ranges.append((start, prev))
+                    start = prev
+                prev = p
+            if start < b:
+                ranges.append((start, b))
+        subs: List[list] = []
+        acc: list = []
+        acc_rows = 0
+        for a, b in ranges:
+            if acc and acc_rows + (b - a) > cap_rows:
+                subs.append(acc)
+                acc = []
+                acc_rows = 0
+            acc.append((a, b))
+            acc_rows += b - a
+        if acc:
+            subs.append(acc)
+        return subs
 
     def iter_row_groups(self, columns: Optional[Sequence[str]] = None,
                         prefetch: bool = True, predicate=None,

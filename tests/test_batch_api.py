@@ -202,3 +202,10 @@ def test_batch_stream_closes_on_generator_close(tmp_path):
     gen = ParquetReader.stream_batches(path)
     next(gen)
     gen.close()  # must not leak the file (ResourceWarning would fire)
+    # closing BEFORE first iteration never opens the file (lazy open)
+    gen2 = ParquetReader.stream_batches(path)
+    gen2.close()
+    # errors surface at first next(), not at call time
+    gen3 = ParquetReader.stream_batches(str(tmp_path / "missing.parquet"))
+    with pytest.raises(FileNotFoundError):
+        next(gen3)

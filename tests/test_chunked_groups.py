@@ -120,6 +120,42 @@ def test_projection_composes_with_chunking(tmp_path, monkeypatch):
         )
 
 
+def test_ranged_read_respects_cap(tmp_path, monkeypatch):
+    """read_row_group_ranges splits oversized covers into multiple
+    launches too (the cap is an HBM bound — selective reads must not
+    bypass it) and stays bit-exact vs the host ranged decode."""
+    path = _write_mixed(tmp_path / "rr.parquet", n=8000, groups=1)
+    ranges = [(100, 2600), (3100, 7400)]
+    monkeypatch.setenv("PFTPU_ARENA_CAP", str(12 << 10))
+    with TpuRowGroupReader(path, float64_policy="float64") as tr, \
+            ParquetFileReader(path) as hr:
+        dev, covered = tr.read_row_group_ranges(0, ranges)
+        assert covered and covered != [(0, 8000)]
+        hb, hcov = hr.read_row_group_ranges(0, ranges)
+        assert hcov == covered
+        for cb in hb.columns:
+            nm = cb.descriptor.path[0]
+            dc = dev[nm]
+            dense, mask = cb.dense()
+            if mask is not None:
+                np.testing.assert_array_equal(
+                    np.asarray(dc.mask), mask, err_msg=nm
+                )
+            if isinstance(dense, ByteArrayColumn):
+                lens = np.asarray(dc.lengths)
+                rows = np.asarray(dc.values)
+                got = [
+                    rows[i, : lens[i]].tobytes() for i in range(len(lens))
+                ]
+                assert got == dense.to_list(), nm
+            else:
+                got = np.asarray(dc.values)
+                if mask is not None:
+                    got = np.where(mask, 0, got)
+                    dense = np.where(mask, 0, dense)
+                np.testing.assert_array_equal(got, dense, err_msg=nm)
+
+
 def test_no_offset_index_fails_loudly(tmp_path, monkeypatch):
     """A single over-cap column in a file WITHOUT an OffsetIndex cannot
     row-split: the error says so (and suggests the host reader)."""

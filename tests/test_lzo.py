@@ -53,6 +53,18 @@ def test_hadoop_framing_single_and_multi_block():
         )
 
 
+def test_hadoop_framing_empty_record():
+    """ulen==0 records carry no inner block; an empty payload
+    round-trips (and decodes to b'' mid-stream too)."""
+    assert lzo_codec.hadoop_decompress(
+        (0).to_bytes(4, "big"), block_decompress=_fake_block_decompress
+    ) == b""
+    mixed = (0).to_bytes(4, "big") + _frame([(b"xy" * 40,)])
+    assert lzo_codec.hadoop_decompress(
+        mixed, block_decompress=_fake_block_decompress
+    ) == b"xy" * 40
+
+
 def test_hadoop_framing_truncation_raises():
     data = _frame([(b"x" * 50,)])
     with pytest.raises(ValueError):

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""TPC-H Q1 through the BATCH face of the declarative API: a
+``BatchHydrator`` plugin receives each row group's columns as
+device-resident arrays from ``ParquetReader.stream_batches`` and folds
+them into the Q1 partial aggregates on device — the analytics consumer's
+idiomatic shape (no engine internals touched, unlike
+``examples/tpch_q1.py`` which drives ``TpuRowGroupReader`` directly).
+
+The plugin boundary is the reference's Hydrator contract lifted to row
+groups (``HydratorSupplier.java:10-15`` ordering): the supplier sees the
+projected column descriptors once; every ``batch`` call then delivers
+arrays in exactly that order.
+
+Usage: python examples/tpch_q1_batches.py [--rows N] [--engine tpu|host|auto]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/pftpu_jax_cache")
+
+WANT = [
+    "l_quantity", "l_extendedprice", "l_discount", "l_tax",
+    "l_shipdate", "l_returnflag", "l_linestatus",
+]
+
+
+_fold_cache = {}
+
+
+def _jitted_fold():
+    """ONE compiled fold step per group, cached at module level so every
+    run (and every hydrator) reuses the same executable.  Shapes are
+    HWM-bucketed by the engine, so this compiles once per file shape.
+    Eager per-op dispatch over a tunnelled link costs ~ms per op — never
+    fold eagerly."""
+    fn = _fold_cache.get("fold")
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        from examples.tpch_q1 import q1_agg
+
+        def fold(total, qty, price, disc, tax, ship, rf_rows, ls_rows):
+            rf = rf_rows[:, 0].astype(jnp.int32)
+            ls = ls_rows[:, 0].astype(jnp.int32)
+            return total + q1_agg(
+                jnp.asarray(qty), jnp.asarray(price),
+                jnp.asarray(disc), jnp.asarray(tax),
+                jnp.asarray(ship), rf, ls,
+            )
+
+        fn = _fold_cache["fold"] = jax.jit(fold)
+    return fn
+
+
+class Q1BatchHydrator:
+    """Folds each group's arrays into the running (6, 7) aggregate.
+
+    Works on either engine: device arrays (engine="tpu", DOUBLE as bit
+    patterns — ``q1_agg`` bitcasts) or NumPy (engine="host", real
+    float64 — jnp.asarray lifts them; the same jitted fold serves both).
+    """
+
+    def __init__(self, columns):
+        self.order = [c.path[0] for c in columns]
+        self.total = None
+
+    def batch(self, group_index, cols):
+        by = dict(zip(self.order, cols))
+        if self.total is None:
+            import jax.numpy as jnp
+
+            self.total = jnp.zeros((6, 7), jnp.float64)
+        self.total = _jitted_fold()(
+            self.total,
+            by["l_quantity"].values, by["l_extendedprice"].values,
+            by["l_discount"].values, by["l_tax"].values,
+            by["l_shipdate"].values, by["l_returnflag"].values,
+            by["l_linestatus"].values,
+        )
+        return group_index
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--engine", default="tpu",
+                    choices=["host", "tpu", "auto"])
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    from benchmarks.workloads import write_lineitem
+    from examples.tpch_q1 import q1_host_reference
+    from parquet_floor_tpu import ParquetReader
+
+    path = f"/tmp/pftpu_bench_lineitem_{args.rows}.parquet"
+    if not os.path.exists(path):
+        write_lineitem(path, args.rows)
+
+    def run():
+        hyd = {}
+
+        def supplier(columns):
+            hyd["h"] = Q1BatchHydrator(columns)
+            return hyd["h"]
+
+        for _ in ParquetReader.stream_batches(
+            path, supplier, columns=WANT, engine=args.engine
+        ):
+            pass
+        return jax.block_until_ready(hyd["h"].total)
+
+    run()
+    run()  # two warm passes: compile, then executable/runtime load
+    best = float("inf")
+    dev_total = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        dev_total = run()
+        best = min(best, time.perf_counter() - t0)
+    # fetch the 6x7 result ONCE, after all timing: on tunnelled links
+    # the first device->host fetch costs seconds of fixed latency and
+    # degrades subsequent transfers — keep it out of the decode wall
+    # (a locally-attached host pays ~nothing here)
+    table = np.asarray(dev_total)
+    print(f"engine={args.engine}: Q1 over {args.rows:,} rows in "
+          f"{best * 1e3:.1f} ms (warm, best of 3; decode+aggregate on "
+          f"device, result table fetched once after timing)")
+
+    ref = q1_host_reference(path)
+    rel = np.abs(table[:, :6] - ref[:, :6]) / np.maximum(
+        np.abs(ref[:, :6]), 1e-12
+    )
+    print(f"max relative delta vs host reference: {rel.max():.2e}")
+    assert rel.max() < 1e-9
+    hdr = ["sum_qty", "sum_base", "sum_disc_price", "sum_charge",
+           "sum_disc", "count"]
+    print(" seg  " + "  ".join(f"{h:>14s}" for h in hdr))
+    for s in range(6):
+        print(f"  {s}   " + "  ".join(f"{table[s, i]:14.2f}" for i in range(6)))
+
+
+if __name__ == "__main__":
+    main()

@@ -201,3 +201,26 @@ def test_tpu_row_api_on_corrupt_file_raises_wrapped(tmp_path, monkeypatch):
             list(ParquetReader.stream_content(
                 str(bad), lambda c: _H(), engine=engine
             ))
+    # engine="auto" must surface the same wrapped error, not a cost-model
+    # artifact (the footer itself is intact here, so routing succeeds and
+    # the decode failure propagates through whichever engine it picked)
+    with pytest.raises(RuntimeError, match="Failed to read parquet"):
+        list(ParquetReader.stream_content(
+            str(bad), lambda c: _H(), engine="auto"
+        ))
+    # a corrupt FOOTER fails loudly through auto as well (the cost model
+    # never runs — the open fails first, unwrapped like the host engine's
+    # constructor-time errors)
+    trash = bytearray(path.read_bytes())
+    trash[-6] = 0xFF  # flip a byte of the footer-length word
+    fbad = tmp_path / "fbad.parquet"
+    fbad.write_bytes(bytes(trash))
+    with pytest.raises((ValueError, RuntimeError)):
+        ParquetReader.stream_content(str(fbad), lambda c: _H(), engine="auto")
+
+    # the batch face wraps nothing extra: hostile page bytes raise from
+    # the generator on either engine
+    for engine in ("host", "tpu", "auto"):
+        with pytest.raises(Exception):
+            for _ in ParquetReader.stream_batches(str(bad), engine=engine):
+                pass

@@ -603,16 +603,44 @@ class ParquetReader:
         statistics prove no match; the yielded ``group_index`` values
         stay the file's real group indices.
 
+        ``source`` may be a LIST/TUPLE of sources (a dataset, as in
+        ``stream_content``): batches stream file after file in order,
+        one open file at a time, every file schema-checked against the
+        first; the supplier is called ONCE (first file's columns) and
+        ``group_index`` stays each file's real group index.  With
+        ``engine="auto"`` each file routes independently.
+
         Returns a generator.  The file opens on FIRST iteration (so a
         generator closed before any ``next()`` never opens it) and
         closes when the generator is exhausted or closed.
         """
+        if engine not in ("host", "tpu", "auto"):
+            raise ValueError(f"bad engine {engine!r}: expected host|tpu|auto")
+        if isinstance(source, (list, tuple)):
+            if not source:
+                raise ValueError("dataset stream needs at least one source")
+
+            def dgen():
+                state: dict = {}
+                for i, src in enumerate(source):
+                    yield from ParquetReader._stream_batches_one(
+                        src, batch_hydrator, columns, engine, predicate,
+                        state, i,
+                    )
+
+            return dgen()
+        return ParquetReader._stream_batches_one(
+            source, batch_hydrator, columns, engine, predicate, {}, 0
+        )
+
+    @staticmethod
+    def _stream_batches_one(source, batch_hydrator, columns, engine,
+                            predicate, state: dict, file_index: int):
+        """One file's batch stream; ``state`` carries the dataset-wide
+        hydrator and schema key across files."""
         from ..batch.columns import BatchColumn
         from ..format.parquet_thrift import Type as _T
         from .hydrate import batch_supplier_of
-
-        if engine not in ("host", "tpu", "auto"):
-            raise ValueError(f"bad engine {engine!r}: expected host|tpu|auto")
 
         def gen():
             reader = ParquetFileReader(source)
@@ -627,12 +655,26 @@ class ParquetReader:
                         columns=set(columns) if columns else None,
                     ).engine
                 schema = reader.schema
+                from ..format.schema import dataset_schema_key
+
+                key = dataset_schema_key(schema.columns)
+                if "schema_key" not in state:
+                    state["schema_key"] = key
+                elif key != state["schema_key"]:
+                    raise ValueError(
+                        f"dataset file {file_index} disagrees with the "
+                        "first file's schema"
+                    )
                 selected = [
                     c for c in schema.columns
                     if not columns or c.path[0] in set(columns)
                 ]
                 flt = {c.path[0] for c in selected} if columns else None
-                hyd = batch_supplier_of(batch_hydrator).get(selected)
+                hyd = state.get("hyd")
+                if hyd is None:
+                    hyd = state["hyd"] = (
+                        batch_supplier_of(batch_hydrator).get(selected)
+                    )
                 keep = (
                     set(predicate.row_groups(reader))
                     if predicate is not None

@@ -197,6 +197,34 @@ def test_repeated_leaf_through_batches(tmp_path):
             assert assemble_nested(sch, cb).to_pylist() == rows, engine
 
 
+def test_dataset_batches(tmp_path):
+    """A list of sources streams batches file after file (supplier
+    called once, per-file real group indices, schema enforcement)."""
+    p1, d1 = _write_mixed(tmp_path / "d1.parquet", n=2000, groups=2)
+    p2, d2 = _write_mixed(tmp_path / "d2.parquet", n=1500, groups=2)
+    calls = []
+
+    def supplier(columns):
+        calls.append([c.path[0] for c in columns])
+        return FnBatchHydrator(
+            lambda gi, cols: (gi, int(np.asarray(cols[0].values).shape[0]))
+        )
+
+    out = list(ParquetReader.stream_batches([p1, p2], supplier))
+    assert len(calls) == 1  # ONE hydrator for the whole dataset
+    assert [gi for gi, _ in out] == [0, 1, 0, 1]  # per-file indices
+    assert sum(n for _, n in out) == 3500
+    # schema drift at a file boundary fails loudly
+    other = str(tmp_path / "odd.parquet")
+    schema = types.message("t", types.required(types.INT32).named("k"))
+    with ParquetFileWriter(other, schema) as w:
+        w.write_columns({"k": [1, 2]})
+    with pytest.raises(ValueError, match="disagrees"):
+        list(ParquetReader.stream_batches([p1, other]))
+    with pytest.raises(ValueError, match="at least one source"):
+        ParquetReader.stream_batches([])
+
+
 def test_batch_stream_closes_on_generator_close(tmp_path):
     path, _ = _write_mixed(tmp_path / "c.parquet")
     gen = ParquetReader.stream_batches(path)

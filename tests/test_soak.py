@@ -220,6 +220,45 @@ def test_random_roundtrip(tmp_path, seed, monkeypatch):
         for hr_, tr_ in zip(host_rows, tpu_rows):
             assert _key(hr_) == _key(tr_), f"seed {seed}"
 
+    # oracle 6 (every fourth seed): the BATCH face agrees between
+    # engines across the random encoding/codec/page matrix — values,
+    # masks, and string bytes per group (stream_batches contract)
+    if seed % 4 == 0:
+        from parquet_floor_tpu import ParquetReader
+
+        def _batch_cells(engine):
+            out = []
+            for cols in ParquetReader.stream_batches(path, engine=engine):
+                for c in cols:
+                    if c.is_strings:
+                        cells = c.bytes_list()
+                    else:
+                        v = c.to_numpy()
+                        cells = (
+                            [v[i].tobytes() for i in range(len(v))]
+                            if v.ndim == 2
+                            else [
+                                struct.pack("<d", x)
+                                if isinstance(x, float)
+                                else x
+                                for x in v.tolist()
+                            ]
+                        )
+                    if c.mask is not None:
+                        m = np.asarray(c.mask)
+                        cells = [
+                            None if m[i] else cells[i]
+                            for i in range(len(cells))
+                        ]
+                    out.append((c.descriptor.path[0], cells))
+            return out
+
+        hb_ = _batch_cells("host")
+        tb_ = _batch_cells("tpu")
+        assert len(hb_) == len(tb_)
+        for (hn, hc), (tn, tc) in zip(hb_, tb_):
+            assert hn == tn and hc == tc, f"seed {seed} batch col {hn}"
+
     # oracle 4: bloom filters never produce a false negative on any
     # value actually present
     if bloom_cols:

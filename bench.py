@@ -162,6 +162,11 @@ def main():
     ship_seconds = stages.get("ship", {}).get("seconds", 0.0) / max(reps, 1)
 
     latency = page_decode_latency(reader)
+    # the front door's routing for this file (must be "tpu" here: the
+    # cost model exists to route per-value-decode files to the device)
+    from parquet_floor_tpu.tpu import cost as _cost
+
+    auto_choice = _cost.choose_engine(reader.reader, purpose="batch")
     reader.close()
 
     result = {
@@ -191,6 +196,7 @@ def main():
             "ship_GB_per_s": round(
                 shipped_bytes / ship_seconds / 1e9, 3
             ) if ship_seconds else None,
+            "auto_routes_to": auto_choice.engine,
             **latency,
         },
     }

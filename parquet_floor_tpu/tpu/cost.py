@@ -246,6 +246,14 @@ def choose_engine(reader, purpose: str = "rows", columns=None) -> EngineChoice:
                 "types; auto degrades to host rather than erroring)",
             )
         else:
-            choice = estimate(reader, purpose=purpose, columns=columns)
+            try:
+                choice = estimate(reader, purpose=purpose, columns=columns)
+            except Exception as e:
+                # auto must never fail for routing reasons (probe or
+                # footer-shape surprises): the host engine always works
+                choice = EngineChoice(
+                    engine="host",
+                    reason=f"cost estimate failed ({e!r}); host fallback",
+                )
     trace.decision("engine_auto", choice.as_dict())
     return choice

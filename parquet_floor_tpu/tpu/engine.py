@@ -1831,6 +1831,33 @@ class TpuRowGroupReader:
         sg = self._stage_row_group(index, columns)
         return self._launch(sg)
 
+    def _launch_pipelined(self, stage_calls):
+        """Run several (args, kwargs) ``_stage_row_group`` calls as a
+        2-stage pipeline: stage i+1 on a worker while launch i ships and
+        decodes on this thread (the chunk paths' sibling of the group
+        iterator's stage‖ship‖decode).  Staging is forced unchunked so
+        only one thread issues transfers at a time.  Yields each
+        launch's column dict in order."""
+        if len(stage_calls) == 1:
+            args, kwargs = stage_calls[0]
+            yield self._launch(
+                self._stage_row_group(*args, chunked=False, **kwargs)
+            )
+            return
+        with ThreadPoolExecutor(max_workers=1,
+                                thread_name_prefix="pftpu-chunkstage") as sp:
+            pending = deque()
+            for args, kwargs in stage_calls:
+                pending.append(sp.submit(
+                    self._stage_row_group, *args, chunked=False, **kwargs
+                ))
+                # keep at most one staged group in flight beyond the one
+                # being launched (each pins a host arena)
+                while len(pending) > 1:
+                    yield self._launch(pending.popleft().result())
+            while pending:
+                yield self._launch(pending.popleft().result())
+
     def _read_row_group_chunked(self, rg, index: int, want) -> Dict[str, DeviceColumn]:
         """Decode one oversized row group in several launches: greedy
         COLUMN bins under the cap first; a single field whose chunks
@@ -1846,28 +1873,31 @@ class TpuRowGroupReader:
                 field_bytes[top] = 0
             field_bytes[top] += int(c.meta_data.total_uncompressed_size or 0)
         out: Dict[str, DeviceColumn] = {}
+        bins: List[List[str]] = []
+        splits: List[str] = []  # fields that row-split (decoded after bins)
         bin_names: List[str] = []
         bin_total = 0
-
-        def flush_bin():
-            nonlocal bin_names, bin_total
-            if bin_names:
-                sg = self._stage_row_group(index, list(bin_names))
-                out.update(self._launch(sg))
-                bin_names = []
-                bin_total = 0
-
         for f in fields:
             fb = field_bytes[f]
             if fb > self._arena_cap:
-                flush_bin()
-                out.update(self._read_field_row_split(rg, index, f, fb))
+                splits.append(f)
                 continue
-            if bin_total + fb > self._arena_cap:
-                flush_bin()
+            if bin_total + fb > self._arena_cap and bin_names:
+                bins.append(bin_names)
+                bin_names = []
+                bin_total = 0
             bin_names.append(f)
             bin_total += fb
-        flush_bin()
+        if bin_names:
+            bins.append(bin_names)
+        for res in self._launch_pipelined(
+            [((index, list(b)), {}) for b in bins]
+        ):
+            out.update(res)
+        for f in splits:
+            out.update(
+                self._read_field_row_split(rg, index, f, field_bytes[f])
+            )
         return out
 
     def _read_field_row_split(self, rg, index: int, field: str,
@@ -1926,11 +1956,12 @@ class TpuRowGroupReader:
                 "ParquetFileReader"
             )
         parts: Dict[str, List[DeviceColumn]] = {}
-        for a, b in segs:
-            sg = self._stage_row_group(
-                index, [field], covered=[(a, b)], group_rows=n
-            )
-            for k, v in self._launch(sg).items():
+        calls = [
+            ((index, [field]), {"covered": [(a, b)], "group_rows": n})
+            for a, b in segs
+        ]
+        for res in self._launch_pipelined(calls):
+            for k, v in res.items():
                 parts.setdefault(k, []).append(v)
         return {k: _concat_device_columns(v) for k, v in parts.items()}
 
@@ -1977,11 +2008,12 @@ class TpuRowGroupReader:
         )
         if flat and cov_rows * per_row > self._arena_cap:
             parts: Dict[str, List[DeviceColumn]] = {}
-            for sub in self._split_covered(covered, per_row, chunks):
-                sg = self._stage_row_group(
-                    index, columns, covered=sub, group_rows=n
-                )
-                for k, v in self._launch(sg).items():
+            calls = [
+                ((index, columns), {"covered": sub, "group_rows": n})
+                for sub in self._split_covered(covered, per_row, chunks)
+            ]
+            for res in self._launch_pipelined(calls):
+                for k, v in res.items():
                     parts.setdefault(k, []).append(v)
             return (
                 {k: _concat_device_columns(v) for k, v in parts.items()},

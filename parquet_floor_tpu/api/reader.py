@@ -47,6 +47,22 @@ def read_metadata(source) -> ParquetMetadata:
         return r.metadata
 
 
+def _check_dataset_schema(state: dict, schema, file_index: int) -> None:
+    """Dataset contract shared by the row and batch streams: every file
+    must match the first file's schema key (paths, physical and logical
+    types).  ``state`` holds the key across files."""
+    from ..format.schema import dataset_schema_key
+
+    key = dataset_schema_key(schema.columns)
+    if "schema_key" not in state:
+        state["schema_key"] = key
+    elif key != state["schema_key"]:
+        raise ValueError(
+            f"dataset file {file_index} disagrees with the first file's "
+            "schema"
+        )
+
+
 class _ColumnCursor:
     """Per-column cursor over a decoded batch, serving API-typed cells."""
 
@@ -198,9 +214,10 @@ class ParquetReader:
                 raise
         self.engine = engine
         schema = self._reader.schema
+        want = set(columns) if columns else None
         selected: List[ColumnDescriptor] = [
             c for c in schema.columns
-            if not columns or c.path[0] in set(columns)
+            if want is None or c.path[0] in want
         ]
         self.columns = selected
         self._filter: Optional[Set[str]] = (
@@ -655,19 +672,11 @@ class ParquetReader:
                         columns=set(columns) if columns else None,
                     ).engine
                 schema = reader.schema
-                from ..format.schema import dataset_schema_key
-
-                key = dataset_schema_key(schema.columns)
-                if "schema_key" not in state:
-                    state["schema_key"] = key
-                elif key != state["schema_key"]:
-                    raise ValueError(
-                        f"dataset file {file_index} disagrees with the "
-                        "first file's schema"
-                    )
+                _check_dataset_schema(state, schema, file_index)
+                want = set(columns) if columns else None
                 selected = [
                     c for c in schema.columns
-                    if not columns or c.path[0] in set(columns)
+                    if want is None or c.path[0] in want
                 ]
                 flt = {c.path[0] for c in selected} if columns else None
                 hyd = state.get("hyd")
@@ -819,7 +828,7 @@ class _DatasetIterator:
         self._engine = engine
         self._predicate = predicate
         self._i = 0
-        self._schema_key = None
+        self._schema_state: dict = {}
         self._current: Optional[_ClosingIterator] = None
         self._closed = False
         self._last_meta: Optional[ParquetMetadata] = None
@@ -828,21 +837,17 @@ class _DatasetIterator:
     def _open_next(self) -> bool:
         if self._i >= len(self._sources):
             return False
-        from ..format.schema import dataset_schema_key
-
         reader = ParquetReader(
             self._sources[self._i], self._supplier, self._columns,
             engine=self._engine, predicate=self._predicate,
         )
-        key = dataset_schema_key(reader._reader.schema.columns)
-        if self._schema_key is None:
-            self._schema_key = key
-        elif key != self._schema_key:
-            reader.close()
-            raise ValueError(
-                f"dataset file {self._i} disagrees with the first file's "
-                "schema"
+        try:
+            _check_dataset_schema(
+                self._schema_state, reader._reader.schema, self._i
             )
+        except ValueError:
+            reader.close()
+            raise
         self._current = _ClosingIterator(reader)
         # retained past close/exhaustion so metadata/columns keep working,
         # matching the single-file iterator (whose footer stays cached)

@@ -1934,20 +1934,10 @@ class TpuRowGroupReader:
                     "ParquetFileReader"
                 )
             grids.append({int(pl.first_row_index or 0) for pl in oi.page_locations})
-        common = sorted(set.intersection(*grids) | {0})
+        del grids  # presence checked above; _split_covered re-reads them
         per_row = field_bytes / max(n, 1)
-        cap_rows = max(int(self._arena_cap / max(per_row, 1e-9)), 1)
-        segs = []
-        start = 0
-        prev = None
-        for p in [q for q in common if q > 0] + [n]:
-            if p - start > cap_rows and prev is not None and prev > start:
-                segs.append((start, prev))
-                start = prev
-            prev = p
-        if start < n:
-            segs.append((start, n))
-        if len(segs) <= 1:
+        subs = self._split_covered([(0, n)], per_row, chunks)
+        if len(subs) <= 1:
             raise ValueError(
                 f"row group {index} column {field!r} has no page boundary "
                 f"to split its ~{field_bytes} decompressed bytes under the "
@@ -1957,8 +1947,8 @@ class TpuRowGroupReader:
             )
         parts: Dict[str, List[DeviceColumn]] = {}
         calls = [
-            ((index, [field]), {"covered": [(a, b)], "group_rows": n})
-            for a, b in segs
+            ((index, [field]), {"covered": sub, "group_rows": n})
+            for sub in subs
         ]
         for res in self._launch_pipelined(calls):
             for k, v in res.items():

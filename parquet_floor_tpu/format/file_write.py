@@ -84,6 +84,15 @@ class WriterOptions:
     # the chunk's distinct count at fpp 1%, or pass {"ndv": N, "fpp": p}.
     # parquet-mr 1.12 surface (ColumnMetaData fields 14/15).
     bloom_filter_columns: Optional[Dict[str, object]] = None
+    # Per-column value-encoding overrides by top-level name (parquet-mr's
+    # withByteStreamSplitEncoding/builder per-path config; pyarrow's
+    # column_encoding): "PLAIN" | "DELTA_BINARY_PACKED" |
+    # "BYTE_STREAM_SPLIT" | "DELTA_BYTE_ARRAY" (or the Encoding int).
+    # Naming a column here disables its dictionary attempt, like pyarrow.
+    column_encodings: Optional[Dict[str, object]] = None
+    # Per-column dictionary enable, overriding enable_dictionary
+    # (parquet-mr's withDictionaryEncoding(path, bool)).
+    column_dictionary: Optional[Dict[str, bool]] = None
 
 
 @dataclass
@@ -136,6 +145,38 @@ def _min_max_bytes(descriptor: ColumnDescriptor, values) -> Optional[tuple]:
     return None  # INT96: no defined order
 
 
+# Per-column override surface: name → Encoding, with the physical types
+# each override legally applies to (spec §Encodings; BOOLEAN only PLAIN).
+_OVERRIDE_ENCODINGS = {
+    "PLAIN": Encoding.PLAIN,
+    "DELTA_BINARY_PACKED": Encoding.DELTA_BINARY_PACKED,
+    "BYTE_STREAM_SPLIT": Encoding.BYTE_STREAM_SPLIT,
+    "DELTA_BYTE_ARRAY": Encoding.DELTA_BYTE_ARRAY,
+}
+_OVERRIDE_TYPES = {
+    Encoding.DELTA_BINARY_PACKED: {Type.INT32, Type.INT64},
+    Encoding.BYTE_STREAM_SPLIT: {
+        Type.FLOAT, Type.DOUBLE, Type.INT32, Type.INT64,
+    },
+    Encoding.DELTA_BYTE_ARRAY: {Type.BYTE_ARRAY},
+}
+
+
+def _normalize_encoding(sel) -> int:
+    """A column_encodings value (name string or Encoding int) → int."""
+    if isinstance(sel, str):
+        enc = _OVERRIDE_ENCODINGS.get(sel.upper())
+        if enc is None:
+            raise ValueError(
+                f"column_encodings: unknown encoding {sel!r} (expected one "
+                f"of {sorted(_OVERRIDE_ENCODINGS)})"
+            )
+        return enc
+    if sel in _OVERRIDE_ENCODINGS.values():
+        return int(sel)
+    raise ValueError(f"column_encodings: unsupported encoding {sel!r}")
+
+
 class _ColumnChunkWriter:
     """Encodes one column's pages for one row group and tracks metadata."""
 
@@ -145,6 +186,9 @@ class _ColumnChunkWriter:
 
     def _choose_value_encoding(self, values) -> int:
         opt, pt = self.options, self.desc.physical_type
+        override = (opt.column_encodings or {}).get(self.desc.path[0])
+        if override is not None:
+            return _normalize_encoding(override)
         if opt.delta_integers and pt in (Type.INT32, Type.INT64):
             return Encoding.DELTA_BINARY_PACKED
         if opt.byte_stream_split_floats and pt in (Type.FLOAT, Type.DOUBLE):
@@ -198,8 +242,15 @@ class _ColumnChunkWriter:
         # --- choose encoding: try dictionary first -------------------------
         dictionary = None
         indices = None
+        dict_enable = opt.enable_dictionary
+        if opt.column_dictionary is not None:
+            dict_enable = opt.column_dictionary.get(desc.path[0], dict_enable)
+        if opt.column_encodings and desc.path[0] in opt.column_encodings:
+            # an explicit per-column encoding bypasses the dictionary
+            # attempt entirely (pyarrow column_encoding semantics)
+            dict_enable = False
         use_dict = (
-            opt.enable_dictionary
+            dict_enable
             and desc.physical_type != Type.BOOLEAN
             and n_leaf > 0
         )
@@ -438,6 +489,32 @@ class ParquetFileWriter:
                         "bloom_filter_columns: BOOLEAN column "
                         f"{name!r} is not supported (1-bit domain; "
                         "parquet-mr refuses it too)"
+                    )
+        # Per-column encoding/dictionary overrides validate up front too
+        # (fail before any bytes hit the sink, same as blooms).
+        for sel_map, label in (
+            (self.options.column_encodings, "column_encodings"),
+            (self.options.column_dictionary, "column_dictionary"),
+        ):
+            for name in (sel_map or {}):
+                if not any(c.path[0] == name for c in schema.columns):
+                    raise ValueError(f"{label}: no column named {name!r}")
+        for name, sel in (self.options.column_encodings or {}).items():
+            enc = _normalize_encoding(sel)
+            for d in schema.columns:
+                if d.path[0] != name:
+                    continue
+                allowed = _OVERRIDE_TYPES.get(enc)
+                if allowed is not None and d.physical_type not in allowed:
+                    raise ValueError(
+                        f"column_encodings: {Encoding.name(enc)} does not "
+                        f"apply to {Type.name(d.physical_type)} column "
+                        f"{name!r}"
+                    )
+                if d.physical_type == Type.BOOLEAN and enc != Encoding.PLAIN:
+                    raise ValueError(
+                        f"column_encodings: BOOLEAN column {name!r} "
+                        "supports only PLAIN"
                     )
         self._row_groups: List[RowGroup] = []
         self._num_rows = 0

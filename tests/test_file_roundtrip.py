@@ -251,6 +251,102 @@ def test_delta_byte_array_write(tmp_path):
         assert rows[0, : lens[0]].tobytes().decode() == vals[0]
 
 
+def test_per_column_encoding_overrides(tmp_path):
+    """WriterOptions.column_encodings / column_dictionary: per-column
+    control (parquet-mr's per-path builder config; pyarrow's
+    column_encoding).  Naming a column in column_encodings disables its
+    dictionary attempt; pyarrow and both engines read the result."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    import pytest
+    from parquet_floor_tpu import (
+        Encoding, ParquetFileReader, ParquetFileWriter, WriterOptions, types,
+    )
+
+    rng = np.random.default_rng(97)
+    n = 3000
+    data = {
+        "a": rng.integers(-1000, 1000, n).astype(np.int64),
+        "b": rng.standard_normal(n).astype(np.float32),
+        "s": [f"v{int(x) % 10}" for x in rng.integers(0, 10, n)],
+        "c": (np.arange(n) % 7).astype(np.int32),
+    }
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("a"),
+        types.required(types.FLOAT).named("b"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.INT32).named("c"),
+    )
+    path = str(tmp_path / "enc.parquet")
+    with ParquetFileWriter(
+        path, schema,
+        WriterOptions(
+            page_version=2,
+            column_encodings={
+                "a": "DELTA_BINARY_PACKED",
+                "b": Encoding.BYTE_STREAM_SPLIT,
+                "s": "DELTA_BYTE_ARRAY",
+            },
+            # low-cardinality c stays dictionary; s would dictionary-
+            # encode but its explicit encoding turns that off
+            column_dictionary={"a": True},  # ignored: encoding named
+        ),
+    ) as w:
+        w.write_columns(data)
+
+    with ParquetFileReader(path) as r:
+        by = {
+            tuple(c.meta_data.path_in_schema)[0]: c.meta_data
+            for c in r.row_groups[0].columns
+        }
+        assert Encoding.DELTA_BINARY_PACKED in by["a"].encodings
+        assert Encoding.BYTE_STREAM_SPLIT in by["b"].encodings
+        assert Encoding.DELTA_BYTE_ARRAY in by["s"].encodings
+        assert Encoding.RLE_DICTIONARY in by["c"].encodings
+    t = pq.read_table(path)
+    assert t.column("a").to_pylist() == data["a"].tolist()
+    assert t.column("s").to_pylist() == data["s"]
+    # per-column dictionary disable without an explicit encoding
+    path2 = str(tmp_path / "nodict.parquet")
+    with ParquetFileWriter(
+        path2, schema, WriterOptions(column_dictionary={"c": False})
+    ) as w:
+        w.write_columns(data)
+    with ParquetFileReader(path2) as r:
+        by = {
+            tuple(c.meta_data.path_in_schema)[0]: c.meta_data
+            for c in r.row_groups[0].columns
+        }
+        assert Encoding.RLE_DICTIONARY not in by["c"].encodings
+        assert Encoding.RLE_DICTIONARY in by["s"].encodings  # others keep it
+    # validation fails fast, before any bytes hit the sink
+    with pytest.raises(ValueError, match="no column named"):
+        ParquetFileWriter(
+            str(tmp_path / "x1.parquet"), schema,
+            WriterOptions(column_encodings={"zz": "PLAIN"}),
+        )
+    with pytest.raises(ValueError, match="does not apply"):
+        ParquetFileWriter(
+            str(tmp_path / "x2.parquet"), schema,
+            WriterOptions(column_encodings={"s": "DELTA_BINARY_PACKED"}),
+        )
+    with pytest.raises(ValueError, match="unknown encoding"):
+        ParquetFileWriter(
+            str(tmp_path / "x3.parquet"), schema,
+            WriterOptions(column_encodings={"a": "RLE_HYBRID"}),
+        )
+    # TPU engine reads the override file bit-exact
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+    with TpuRowGroupReader(path, float64_policy="float64") as tr:
+        g = tr.read_row_group(0)
+        np.testing.assert_array_equal(np.asarray(g["a"].values), data["a"])
+        np.testing.assert_array_equal(np.asarray(g["b"].values), data["b"])
+        np.testing.assert_array_equal(np.asarray(g["c"].values), data["c"])
+
+
 def test_byte_based_page_and_group_thresholds(tmp_path):
     """parquet-mr-style size tunables: data_page_bytes closes pages by
     estimated size (composed with the count bound) and row_group_bytes

@@ -84,6 +84,16 @@ class WriterOptions:
     # the chunk's distinct count at fpp 1%, or pass {"ndv": N, "fpp": p}.
     # parquet-mr 1.12 surface (ColumnMetaData fields 14/15).
     bloom_filter_columns: Optional[Dict[str, object]] = None
+    # Binary min/max truncation for long BYTE_ARRAY values, parquet-mr
+    # semantics: min truncates to a prefix (still a lower bound); max
+    # truncates-and-increments the last non-0xFF byte (still an upper
+    # bound) or stays whole when every byte is 0xFF.  The ColumnIndex
+    # truncates at 64 by default (parquet-mr's
+    # DEFAULT_COLUMN_INDEX_TRUNCATE_LENGTH); chunk Statistics are
+    # untruncated by default (1.12 behavior) — set
+    # statistics_truncate_length to bound them too.
+    column_index_truncate_length: int = 64
+    statistics_truncate_length: Optional[int] = None
     # Per-column value-encoding overrides by top-level name (parquet-mr's
     # withByteStreamSplitEncoding/builder per-path config; pyarrow's
     # column_encoding): "PLAIN" | "DELTA_BINARY_PACKED" |
@@ -175,6 +185,31 @@ def _normalize_encoding(sel) -> int:
     if sel in _OVERRIDE_ENCODINGS.values():
         return int(sel)
     raise ValueError(f"column_encodings: unsupported encoding {sel!r}")
+
+
+def _truncate_min_max(desc, mm, limit: Optional[int]):
+    """Bound long BYTE_ARRAY min/max at ``limit`` bytes, keeping them
+    valid bounds (parquet-mr BinaryTruncator): min → prefix; max →
+    prefix with its last non-0xFF byte incremented (an all-0xFF prefix
+    cannot be incremented, so the full value stays)."""
+    if (
+        mm is None
+        or not limit
+        or desc.physical_type != Type.BYTE_ARRAY
+    ):
+        return mm
+    mn, mx = mm
+    if len(mn) > limit:
+        mn = mn[:limit]
+    if len(mx) > limit:
+        t = bytearray(mx[:limit])
+        for i in range(len(t) - 1, -1, -1):
+            if t[i] != 0xFF:
+                t[i] += 1
+                mx = bytes(t[: i + 1])
+                break
+        # else: every prefix byte is 0xFF — keep the full value
+    return mn, mx
 
 
 class _ColumnChunkWriter:
@@ -360,8 +395,11 @@ class _ColumnChunkWriter:
                 null_count_total += nulls
                 mm = _min_max_bytes(desc, page_vals)
                 stats = Statistics(null_count=nulls)
-                if mm is not None:
-                    stats.min_value, stats.max_value = mm
+                page_mm = _truncate_min_max(
+                    desc, mm, opt.statistics_truncate_length
+                )
+                if page_mm is not None:
+                    stats.min_value, stats.max_value = page_mm
 
             if opt.page_version == 2:
                 ep = pg.encode_data_page_v2(
@@ -394,8 +432,11 @@ class _ColumnChunkWriter:
                     # bounds on every non-null page, so this chunk cannot
                     # carry a ColumnIndex at all
                     index_ok = False
-                idx_mins.append(mm[0] if mm is not None else b"")
-                idx_maxs.append(mm[1] if mm is not None else b"")
+                idx_mm = _truncate_min_max(
+                    desc, mm, opt.column_index_truncate_length
+                )
+                idx_mins.append(idx_mm[0] if idx_mm is not None else b"")
+                idx_maxs.append(idx_mm[1] if idx_mm is not None else b"")
                 idx_nulls.append((hi - lo) - present)
             row_cursor += num_rows
 
@@ -423,8 +464,11 @@ class _ColumnChunkWriter:
         )
         if opt.write_statistics:
             st = Statistics(null_count=null_count_total)
-            if chunk_mm is not None:
-                st.min_value, st.max_value = chunk_mm
+            chunk_mm_t = _truncate_min_max(
+                desc, chunk_mm, opt.statistics_truncate_length
+            )
+            if chunk_mm_t is not None:
+                st.min_value, st.max_value = chunk_mm_t
             meta.statistics = st
         chunk = ColumnChunk(file_offset=first_offset, meta_data=meta)
         if opt.write_statistics and idx_loc:

@@ -251,6 +251,72 @@ def test_delta_byte_array_write(tmp_path):
         assert rows[0, : lens[0]].tobytes().decode() == vals[0]
 
 
+def test_binary_stats_truncation(tmp_path):
+    """Long BYTE_ARRAY min/max truncate with parquet-mr semantics: the
+    ColumnIndex bounds cap at column_index_truncate_length (64 default)
+    with min a prefix and max prefix+increment — still valid bounds, so
+    predicate pruning stays correct; chunk stats truncate only when
+    statistics_truncate_length is set."""
+    import pytest
+    from parquet_floor_tpu import (
+        ParquetFileReader, ParquetFileWriter, WriterOptions, col, types,
+    )
+    from parquet_floor_tpu.format.file_write import _truncate_min_max
+
+    long_lo = "a" * 200
+    long_hi = "z" * 200
+    vals = [long_lo + f"{i:04d}" for i in range(100)] + [long_hi]
+    schema = types.message(
+        "t", types.required(types.BYTE_ARRAY).as_(types.string()).named("s")
+    )
+    path = str(tmp_path / "trunc.parquet")
+    with ParquetFileWriter(path, schema) as w:
+        w.write_columns({"s": vals})
+    with ParquetFileReader(path) as r:
+        chunk = r.row_groups[0].columns[0]
+        ci = r.read_column_index(chunk)
+        assert all(len(m) <= 64 for m in ci.min_values)
+        assert all(len(m) <= 65 for m in ci.max_values)
+        assert ci.min_values[0] == long_lo.encode()[:64]
+        # max: prefix with last byte incremented → still an upper bound
+        assert ci.max_values[-1] > long_hi.encode()[:64]
+        # chunk stats stay whole by default (parquet-mr 1.12)
+        st = chunk.meta_data.statistics
+        assert st.min_value == vals[0].encode()
+        # truncated bounds still bound: pruning keeps the group for a
+        # present value and drops it for an impossible one
+        keep = (col("s") == vals[5]).row_groups(r)
+        assert 0 in set(keep)
+        none = (col("s") == "~~~~").row_groups(r)  # above every max
+        assert 0 not in set(none)
+    # statistics_truncate_length bounds chunk stats too
+    path2 = str(tmp_path / "trunc2.parquet")
+    with ParquetFileWriter(
+        path2, schema, WriterOptions(statistics_truncate_length=16)
+    ) as w:
+        w.write_columns({"s": vals})
+    with ParquetFileReader(path2) as r:
+        st = r.row_groups[0].columns[0].meta_data.statistics
+        assert len(st.min_value) <= 16 and len(st.max_value) <= 17
+        assert st.min_value <= vals[0].encode()
+        assert st.max_value >= vals[-1].encode()
+    # all-0xFF prefixes cannot increment: the full max survives
+    schema_b = types.message(
+        "t", types.required(types.BYTE_ARRAY).named("b")
+    )
+    desc = None
+    with ParquetFileWriter(str(tmp_path / "ff.parquet"), schema_b) as w:
+        desc = w.schema.columns[0]
+    mm = _truncate_min_max(desc, (b"\x01" * 100, b"\xff" * 100), 8)
+    assert mm[0] == b"\x01" * 8
+    assert mm[1] == b"\xff" * 100  # kept whole
+    # None limit / None mm pass through untouched
+    assert _truncate_min_max(desc, (b"a" * 99, b"b" * 99), None) == (
+        b"a" * 99, b"b" * 99
+    )
+    assert _truncate_min_max(desc, None, 8) is None
+
+
 def test_per_column_encoding_overrides(tmp_path):
     """WriterOptions.column_encodings / column_dictionary: per-column
     control (parquet-mr's per-path builder config; pyarrow's

@@ -46,13 +46,12 @@ def _jitted_fold():
 
         from examples.tpch_q1 import q1_agg
 
-        def fold(total, qty, price, disc, tax, ship, rf_rows, ls_rows):
-            rf = rf_rows[:, 0].astype(jnp.int32)
-            ls = ls_rows[:, 0].astype(jnp.int32)
+        def fold(total, qty, price, disc, tax, ship, rf, ls):
             return total + q1_agg(
                 jnp.asarray(qty), jnp.asarray(price),
                 jnp.asarray(disc), jnp.asarray(tax),
-                jnp.asarray(ship), rf, ls,
+                jnp.asarray(ship), rf.astype(jnp.int32),
+                ls.astype(jnp.int32),
             )
 
         fn = _fold_cache["fold"] = jax.jit(fold)
@@ -71,6 +70,16 @@ class Q1BatchHydrator:
         self.order = [c.path[0] for c in columns]
         self.total = None
 
+    @staticmethod
+    def _first_bytes(col):
+        """First byte of each string value as a (n,) array — handles
+        both engine layouts (host: ByteArrayColumn offsets+data;
+        device: (n, max_len) byte rows, sliced eagerly on device)."""
+        v = col.values
+        if hasattr(v, "offsets"):  # host ByteArrayColumn
+            return v.data[v.offsets[:-1]]
+        return v[:, 0]
+
     def batch(self, group_index, cols):
         by = dict(zip(self.order, cols))
         if self.total is None:
@@ -81,8 +90,9 @@ class Q1BatchHydrator:
             self.total,
             by["l_quantity"].values, by["l_extendedprice"].values,
             by["l_discount"].values, by["l_tax"].values,
-            by["l_shipdate"].values, by["l_returnflag"].values,
-            by["l_linestatus"].values,
+            by["l_shipdate"].values,
+            self._first_bytes(by["l_returnflag"]),
+            self._first_bytes(by["l_linestatus"]),
         )
         return group_index
 

@@ -65,7 +65,7 @@ def _random_column(rng, n, idx):
         b = (t.optional if optional else t.required)(t.BYTE_ARRAY).as_(t.string())
         card = int(rng.choice([3, 50, 100_000]))  # low → dict; high → fallback
         data = opt([f"s{int(v)}" for v in rng.integers(0, card, n)])
-    return b.named(name), name, data, kind == 4  # kind 4 = BOOLEAN
+    return b.named(name), name, data, int(kind)
 
 
 @pytest.mark.parametrize("seed", range(18))
@@ -73,13 +73,14 @@ def test_random_roundtrip(tmp_path, seed, monkeypatch):
     rng = np.random.default_rng(seed)
     n = int(rng.integers(1, 4000))
     n_cols = int(rng.integers(1, 6))
-    fields, names, datas, bools = [], [], [], []
+    fields, names, datas, bools, kinds = [], [], [], [], []
     for i in range(n_cols):
-        f, name, data, is_bool = _random_column(rng, n, i)
+        f, name, data, kind = _random_column(rng, n, i)
         fields.append(f)
         names.append(name)
         datas.append(data)
-        bools.append(is_bool)
+        bools.append(kind == 4)  # kind 4 = BOOLEAN
+        kinds.append(kind)
     schema = types.message("t", *fields)
     # randomly bloom-filter the non-boolean columns (write + read below;
     # selection by column KIND — BOOLEAN rejects blooms by design)
@@ -97,6 +98,22 @@ def test_random_roundtrip(tmp_path, seed, monkeypatch):
 
         monkeypatch.setenv("PFTPU_CHUNKED_SHIP", "1")
         monkeypatch.setattr(_eng, "_SHIP_CHUNK", 1 << 14)
+    # random per-column overrides (round 4): an explicit encoding where
+    # the column's kind allows one, and random dictionary disables
+    col_encs = {}
+    col_dict = {}
+    _KIND_ENCS = {
+        0: ["DELTA_BINARY_PACKED"],                       # INT64
+        1: ["DELTA_BINARY_PACKED", "BYTE_STREAM_SPLIT"],  # INT32
+        2: ["BYTE_STREAM_SPLIT"],                         # DOUBLE
+        3: ["BYTE_STREAM_SPLIT"],                         # FLOAT
+        5: ["DELTA_BYTE_ARRAY"],                          # strings
+    }
+    for nm, k in zip(names, kinds):
+        if rng.random() < 0.15:
+            col_dict[nm] = bool(rng.integers(0, 2))
+        if k in _KIND_ENCS and rng.random() < 0.2:
+            col_encs[nm] = str(rng.choice(_KIND_ENCS[k]))
     opts = WriterOptions(
         codec=int(rng.choice(_CODECS)),
         page_version=int(rng.choice([1, 2])),
@@ -110,6 +127,8 @@ def test_random_roundtrip(tmp_path, seed, monkeypatch):
         delta_strings=bool(rng.integers(0, 2)),
         row_group_rows=int(rng.choice([n, max(1, n // 3)])),
         bloom_filter_columns=bloom_cols,
+        column_encodings=col_encs or None,
+        column_dictionary=col_dict or None,
     )
     path = str(tmp_path / f"soak{seed}.parquet")
     with ParquetFileWriter(path, schema, opts) as w:

@@ -345,11 +345,32 @@ def _codec_guidance(codec: int) -> str:
     )
 
 
-def compress(codec: int, data: bytes) -> bytes:
+def compress(codec: int, data: bytes, level: Optional[int] = None) -> bytes:
+    """Compress ``data`` with ``codec``.  ``level`` is the optional
+    compression-level knob (parquet-mr's per-codec level config):
+    honored by ZSTD (1..22), GZIP (1..9), and BROTLI (quality 0..11);
+    silently ignored by level-less codecs (Snappy, LZ4) and by
+    ``register_codec`` plugins."""
+    data = bytes(data)
+    if level is not None:
+        if codec == CompressionCodec.ZSTD and _zstd is not None:
+            return _zstd.ZstdCompressor(level=level).compress(data)
+        if codec == CompressionCodec.GZIP:
+            buf = io.BytesIO()
+            with _gzip.GzipFile(
+                fileobj=buf, mode="wb", mtime=0, compresslevel=level
+            ) as f:
+                f.write(data)
+            return buf.getvalue()
+        if codec == CompressionCodec.BROTLI:
+            from . import brotli_codec
+
+            if brotli_codec.encoder_available():
+                return brotli_codec.compress(data, quality=level)
     fn = _COMPRESSORS.get(codec)
     if fn is None:
         raise UnsupportedCodec(_codec_guidance(codec))
-    return fn(bytes(data))
+    return fn(data)
 
 
 def decompress(codec: int, data: bytes, uncompressed_size: Optional[int] = None) -> bytes:

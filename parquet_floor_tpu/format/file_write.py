@@ -84,6 +84,10 @@ class WriterOptions:
     # the chunk's distinct count at fpp 1%, or pass {"ndv": N, "fpp": p}.
     # parquet-mr 1.12 surface (ColumnMetaData fields 14/15).
     bloom_filter_columns: Optional[Dict[str, object]] = None
+    # Compression level for level-aware codecs (parquet-mr's
+    # compression-level config): ZSTD 1..22, GZIP 1..9, BROTLI quality
+    # 0..11; None = each codec's default.  Level-less codecs ignore it.
+    codec_level: Optional[int] = None
     # Binary min/max truncation for long BYTE_ARRAY values, parquet-mr
     # semantics: min truncates to a prefix (still a lower bound); max
     # truncates-and-increments the last non-0xFF byte (still an upper
@@ -313,7 +317,9 @@ class _ColumnChunkWriter:
         total_compressed = 0
 
         if dictionary is not None:
-            ep = pg.encode_dictionary_page(dictionary, desc, codec, opt.write_crc)
+            ep = pg.encode_dictionary_page(
+                dictionary, desc, codec, opt.write_crc, opt.codec_level
+            )
             dict_page_offset = sink.pos
             hdr = ep.header.to_bytes()
             sink.write(hdr)
@@ -404,12 +410,13 @@ class _ColumnChunkWriter:
             if opt.page_version == 2:
                 ep = pg.encode_data_page_v2(
                     desc, codec, num_rows, value_encoding, encoded, dl, rl,
-                    stats, opt.write_crc,
+                    stats, opt.write_crc, opt.codec_level,
                 )
             else:
                 ep = pg.encode_data_page_v1(
                     desc, codec, value_encoding, encoded, dl, rl, stats,
                     opt.write_crc, num_values=hi - lo,
+                    codec_level=opt.codec_level,
                 )
             if data_page_offset is None:
                 data_page_offset = sink.pos

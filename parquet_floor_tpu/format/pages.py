@@ -332,10 +332,11 @@ class EncodedPage:
 
 
 def encode_dictionary_page(
-    dictionary, column: ColumnDescriptor, codec: int, with_crc: bool = True
+    dictionary, column: ColumnDescriptor, codec: int, with_crc: bool = True,
+    codec_level: "int | None" = None,
 ) -> EncodedPage:
     raw = e_plain.encode_plain(dictionary, column.physical_type, column.type_length)
-    body = codecs.compress(codec, raw)
+    body = codecs.compress(codec, raw, codec_level)
     header = PageHeader(
         type=PageType.DICTIONARY_PAGE,
         uncompressed_page_size=len(raw),
@@ -364,6 +365,7 @@ def encode_data_page_v2(
     rep_levels: Optional[np.ndarray],
     statistics: Optional[Statistics] = None,
     with_crc: bool = True,
+    codec_level: Optional[int] = None,
 ) -> EncodedPage:
     """Encode one v2 data page.  Levels stay uncompressed (spec)."""
     if rep_levels is not None and column.max_repetition_level > 0:
@@ -382,7 +384,7 @@ def encode_data_page_v2(
     else:
         dl = b""
         num_nulls = 0
-    body_comp = codecs.compress(codec, encoded_values)
+    body_comp = codecs.compress(codec, encoded_values, codec_level)
     if len(body_comp) >= len(encoded_values):
         body_comp = encoded_values
         is_compressed = False
@@ -419,6 +421,7 @@ def encode_data_page_v1(
     statistics: Optional[Statistics] = None,
     with_crc: bool = True,
     num_values: Optional[int] = None,
+    codec_level: Optional[int] = None,
 ) -> EncodedPage:
     parts = []
     n = num_values
@@ -441,7 +444,7 @@ def encode_data_page_v1(
     raw = b"".join(parts)
     if n is None:
         raise ValueError("v1 page needs num_values via levels or caller")
-    body = codecs.compress(codec, raw)
+    body = codecs.compress(codec, raw, codec_level)
     header = PageHeader(
         type=PageType.DATA_PAGE,
         uncompressed_page_size=len(raw),

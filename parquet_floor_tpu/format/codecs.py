@@ -49,9 +49,12 @@ def _snappy_decompress(data: bytes, uncompressed_size: Optional[int] = None) -> 
     return _snappy_py.decompress(data)
 
 
-def _gzip_compress(data: bytes) -> bytes:
+def _gzip_compress(data: bytes, level: Optional[int] = None) -> bytes:
     buf = io.BytesIO()
-    with _gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as f:
+    with _gzip.GzipFile(
+        fileobj=buf, mode="wb", mtime=0,
+        compresslevel=9 if level is None else level,
+    ) as f:
         f.write(data)
     return buf.getvalue()
 
@@ -64,11 +67,21 @@ def _gzip_decompress(data: bytes, uncompressed_size=None) -> bytes:
         return zlib.decompress(data)
 
 
-def _zstd_compress(data: bytes) -> bytes:
+def _zstd_compress(data: bytes, level: Optional[int] = None) -> bytes:
     # Prefer the optional wheel (real entropy coding); else the first-party
     # native store-mode encoder (valid frames, raw blocks).
     if _zstd is not None:
-        return _zstd.ZstdCompressor(level=3).compress(data)
+        return _zstd.ZstdCompressor(
+            level=3 if level is None else level
+        ).compress(data)
+    if level is not None:
+        # the store-mode fallback has no levels: writing essentially
+        # uncompressed frames after an explicit level request would be
+        # a silent lie — refuse loudly
+        raise UnsupportedCodec(
+            "ZSTD codec_level needs the 'zstandard' wheel (the built-in "
+            "native encoder is store-mode and has no levels)"
+        )
     if _native is not None and _native.available():
         return _native.zstd_compress(data)
     raise UnsupportedCodec("ZSTD write needs the native library or 'zstandard'")
@@ -247,12 +260,14 @@ def _brotli_decompress(data: bytes, uncompressed_size=None,
     return brotli_codec.decompress(data, uncompressed_size, max_output)
 
 
-def _brotli_compress(data: bytes) -> bytes:
+def _brotli_compress(data: bytes, level: Optional[int] = None) -> bytes:
     from . import brotli_codec
 
     if not brotli_codec.encoder_available():
         raise UnsupportedCodec(_codec_guidance(CompressionCodec.BROTLI))
-    return brotli_codec.compress(data)
+    return brotli_codec.compress(
+        data, quality=5 if level is None else level
+    )
 
 
 def _lzo_decompress(data: bytes, uncompressed_size=None) -> bytes:
@@ -345,31 +360,63 @@ def _codec_guidance(codec: int) -> str:
     )
 
 
+# Builtin compressors that honor a level argument; a register_codec
+# override replaces the _COMPRESSORS entry and therefore wins (its
+# plugin signature has no level — levels are ignored for plugins).
+_LEVEL_RANGES = {
+    CompressionCodec.ZSTD: (1, 22),
+    CompressionCodec.GZIP: (0, 9),
+    CompressionCodec.BROTLI: (0, 11),
+}
+
+
+def _builtin_level_fn(codec: int):
+    """The builtin level-aware compressor for ``codec`` IF it is still
+    the registered one (an override must win, as in decompress_into)."""
+    builtin = {
+        CompressionCodec.ZSTD: _zstd_compress,
+        CompressionCodec.GZIP: _gzip_compress,
+        CompressionCodec.BROTLI: _brotli_compress,
+    }.get(codec)
+    return builtin if _COMPRESSORS.get(codec) is builtin else None
+
+
+def validate_level(codec: int, level: Optional[int]) -> None:
+    """Fail-fast check for a requested compression level (the writer
+    calls this before any bytes hit the sink).  Level-less codecs and
+    register_codec plugins accept (and ignore) any level."""
+    if level is None:
+        return
+    fn = _builtin_level_fn(codec)
+    if fn is None:
+        return  # level-less builtin or plugin override: level is ignored
+    lo, hi = _LEVEL_RANGES[codec]
+    if not (lo <= int(level) <= hi):
+        raise ValueError(
+            f"codec_level {level} out of range for "
+            f"{CompressionCodec.name(codec)} (expected {lo}..{hi})"
+        )
+    if codec == CompressionCodec.ZSTD and _zstd is None:
+        raise UnsupportedCodec(
+            "ZSTD codec_level needs the 'zstandard' wheel (the built-in "
+            "native encoder is store-mode and has no levels)"
+        )
+
+
 def compress(codec: int, data: bytes, level: Optional[int] = None) -> bytes:
     """Compress ``data`` with ``codec``.  ``level`` is the optional
     compression-level knob (parquet-mr's per-codec level config):
-    honored by ZSTD (1..22), GZIP (1..9), and BROTLI (quality 0..11);
-    silently ignored by level-less codecs (Snappy, LZ4) and by
-    ``register_codec`` plugins."""
+    honored by the BUILT-IN ZSTD (1..22, needs the zstandard wheel —
+    the store-mode fallback refuses an explicit level), GZIP (0..9),
+    and BROTLI (quality 0..11); silently ignored by level-less codecs
+    (Snappy, LZ4) and by ``register_codec`` plugins (an override always
+    wins over the level fast path)."""
     data = bytes(data)
-    if level is not None:
-        if codec == CompressionCodec.ZSTD and _zstd is not None:
-            return _zstd.ZstdCompressor(level=level).compress(data)
-        if codec == CompressionCodec.GZIP:
-            buf = io.BytesIO()
-            with _gzip.GzipFile(
-                fileobj=buf, mode="wb", mtime=0, compresslevel=level
-            ) as f:
-                f.write(data)
-            return buf.getvalue()
-        if codec == CompressionCodec.BROTLI:
-            from . import brotli_codec
-
-            if brotli_codec.encoder_available():
-                return brotli_codec.compress(data, quality=level)
     fn = _COMPRESSORS.get(codec)
     if fn is None:
         raise UnsupportedCodec(_codec_guidance(codec))
+    if level is not None and _builtin_level_fn(codec) is fn:
+        return fn(data, level)
     return fn(data)
 
 

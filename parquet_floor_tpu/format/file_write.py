@@ -541,6 +541,11 @@ class ParquetFileWriter:
                         f"{name!r} is not supported (1-bit domain; "
                         "parquet-mr refuses it too)"
                     )
+        # Codec level validates up front too (an out-of-range level
+        # would otherwise raise mid-write, leaving a partial file).
+        from . import codecs as _codecs
+
+        _codecs.validate_level(self.options.codec, self.options.codec_level)
         # Per-column encoding/dictionary overrides validate up front too
         # (fail before any bytes hit the sink, same as blooms).
         for sel_map, label in (

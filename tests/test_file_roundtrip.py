@@ -296,6 +296,29 @@ def test_codec_level_knob(tmp_path):
     assert g_slow < g_fast  # deflate IS monotonic here
     # level-less codec: level is ignored, not an error
     write(CompressionCodec.SNAPPY, 9)
+    # out-of-range levels fail at CONSTRUCTION, before bytes hit the sink
+    import pytest
+    from parquet_floor_tpu import ParquetFileWriter as PFW
+    with pytest.raises(ValueError, match="out of range"):
+        PFW(str(tmp_path / "bad.parquet"), schema,
+            WriterOptions(codec=CompressionCodec.GZIP, codec_level=12))
+    # a register_codec override wins over the level fast path
+    from parquet_floor_tpu.format import codecs as _codecs
+    calls = []
+
+    def plugin(data):
+        calls.append(len(data))
+        return _codecs._gzip_compress(data)
+
+    orig = _codecs._COMPRESSORS[CompressionCodec.GZIP]
+    try:
+        _codecs.register_codec(CompressionCodec.GZIP, compressor=plugin)
+        out = _codecs.compress(CompressionCodec.GZIP, b"x" * 100, level=5)
+        assert calls and _codecs.decompress(
+            CompressionCodec.GZIP, out, 100
+        ) == b"x" * 100
+    finally:
+        _codecs.register_codec(CompressionCodec.GZIP, compressor=orig)
 
 
 def test_binary_stats_truncation(tmp_path):

@@ -304,6 +304,17 @@ class ParquetReader:
             if i in self._keep
         )
 
+    def try_split(self):
+        """Always None — the reference's spliterator declines to split
+        (``trySplit``, :214-217).  Parallel reading lives in
+        ``parallel.shard``/``parallel.multihost`` instead."""
+        return None
+
+    def characteristics(self) -> frozenset:
+        """The reference's spliterator characteristics
+        (ORDERED | NONNULL | DISTINCT, :224-227), as flag names."""
+        return frozenset({"ORDERED", "NONNULL", "DISTINCT"})
+
     # -- iteration ---------------------------------------------------------
 
     def _dict_form_cells(self, dc, idx_np, mask_np) -> list:

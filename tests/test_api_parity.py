@@ -156,6 +156,22 @@ def test_write_type_surface_rejections(tmp_path):
         ParquetWriter.write_file(schema2, tmp_path / "y.parquet", bad2, [object()])
 
 
+def test_spliterator_surface(tmp_path):
+    """try_split declines (ParquetReader.java:214-217); characteristics
+    report ORDERED|NONNULL|DISTINCT (:224-227); estimate_size is the
+    footer's exact row count (:219-222)."""
+    schema = types.message("m", types.required(types.INT64).named("x"))
+    path = tmp_path / "sp.parquet"
+    ParquetWriter.write_file(
+        schema, path,
+        FnDehydrator(lambda rec, vw: vw.write("x", rec)), list(range(7)),
+    )
+    with ParquetReader.spliterator(path, lambda c: dict_hydrator()) as r:
+        assert r.try_split() is None
+        assert r.characteristics() == {"ORDERED", "NONNULL", "DISTINCT"}
+        assert r.estimate_size() == 7
+
+
 def test_row_bytes_counts_utf8_bytes():
     """The row_group_bytes flush estimate counts str values in UTF-8
     bytes, not characters (non-ASCII text must not flush late)."""

@@ -34,7 +34,7 @@ from .batch.nested import NestedColumn, assemble_nested, shred_nested
 from .batch.predicate import Predicate, col
 from .utils import trace
 
-__version__ = "0.4.0"
+from ._version import __version__  # noqa: F401  (re-export)
 
 __all__ = [
     "BatchColumn", "BatchHydrator", "BatchHydratorSupplier", "ColumnData",

@@ -45,7 +45,9 @@ from .parquet_thrift import (
 )
 from .schema import ColumnDescriptor, MessageType
 
-CREATED_BY = "parquet-floor-tpu version 0.1.0"
+from .._version import __version__ as _pkg_version
+
+CREATED_BY = f"parquet-floor-tpu version {_pkg_version}"
 
 _NUMPY_DTYPE = {
     Type.INT32: np.dtype("<i4"),

@@ -39,6 +39,7 @@ from .parquet_thrift import (
     PageLocation,
     PageType,
     RowGroup,
+    SortingColumn,
     Statistics,
     Type,
     TypeDefinedOrder,
@@ -109,6 +110,11 @@ class WriterOptions:
     # Per-column dictionary enable, overriding enable_dictionary
     # (parquet-mr's withDictionaryEncoding(path, bool)).
     column_dictionary: Optional[Dict[str, bool]] = None
+    # Declared sort order of the data, recorded in every row group's
+    # metadata (parquet-mr's withSortingColumns — the writer does NOT
+    # sort; the caller asserts the order).  Entries are a column name
+    # or (name, descending, nulls_first).
+    sorting_columns: Optional[List[object]] = None
 
 
 @dataclass
@@ -191,6 +197,45 @@ def _normalize_encoding(sel) -> int:
     if sel in _OVERRIDE_ENCODINGS.values():
         return int(sel)
     raise ValueError(f"column_encodings: unsupported encoding {sel!r}")
+
+
+def _boundary_order(desc, null_pages, mins, maxs) -> int:
+    """ColumnIndex boundary_order (parquet-mr computes it so readers can
+    binary-search the page bounds): 1 = ASCENDING when every non-null
+    page's [min, max] is ordered against the next, 2 = DESCENDING
+    symmetric, else 0 = UNORDERED (always valid).  Comparison is by the
+    column's SORT ORDER, not the raw stat bytes (little-endian numeric
+    encodings do not byte-compare); types without a usable order here
+    report UNORDERED."""
+    pt = desc.physical_type
+    if pt in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY, Type.BOOLEAN):
+        def key(b):
+            return b  # unsigned-lex == stats byte order
+    elif pt in _NUMPY_DTYPE:
+        dt = _NUMPY_DTYPE[pt]
+
+        def key(b):
+            return np.frombuffer(b, dtype=dt)[0]
+    else:
+        return 0  # INT96 etc.: no defined order
+    live = [
+        (key(mins[i]), key(maxs[i]))
+        for i in range(len(mins))
+        if not null_pages[i]
+    ]
+    if len(live) < 2:
+        return 1  # trivially ascending (parquet-mr reports ASCENDING)
+    asc = all(
+        live[i][0] <= live[i + 1][0] and live[i][1] <= live[i + 1][1]
+        for i in range(len(live) - 1)
+    )
+    if asc:
+        return 1
+    desc_ = all(
+        live[i][0] >= live[i + 1][0] and live[i][1] >= live[i + 1][1]
+        for i in range(len(live) - 1)
+    )
+    return 2 if desc_ else 0
 
 
 def _truncate_min_max(desc, mm, limit: Optional[int]):
@@ -491,7 +536,9 @@ class _ColumnChunkWriter:
                     null_pages=idx_null_pages,
                     min_values=idx_mins,
                     max_values=idx_maxs,
-                    boundary_order=0,  # UNORDERED is always valid
+                    boundary_order=_boundary_order(
+                        desc, idx_null_pages, idx_mins, idx_maxs
+                    ),
                     null_counts=idx_nulls,
                 )
                 if index_ok
@@ -548,6 +595,31 @@ class ParquetFileWriter:
         from . import codecs as _codecs
 
         _codecs.validate_level(self.options.codec, self.options.codec_level)
+        # Declared sort order resolves to leaf column indexes once.
+        self._sorting: Optional[List[SortingColumn]] = None
+        if self.options.sorting_columns:
+            by_name = {
+                ".".join(c.path): i for i, c in enumerate(schema.columns)
+            }
+            by_name.update({
+                c.path[0]: i
+                for i, c in enumerate(schema.columns)
+                if len(c.path) == 1
+            })
+            self._sorting = []
+            for sel in self.options.sorting_columns:
+                name, descending, nulls_first = (
+                    (sel, False, False) if isinstance(sel, str) else sel
+                )
+                if name not in by_name:
+                    raise ValueError(
+                        f"sorting_columns: no column named {name!r}"
+                    )
+                self._sorting.append(SortingColumn(
+                    column_idx=by_name[name],
+                    descending=bool(descending),
+                    nulls_first=bool(nulls_first),
+                ))
         # Per-column encoding/dictionary overrides validate up front too
         # (fail before any bytes hit the sink, same as blooms).
         for sel_map, label in (
@@ -618,6 +690,7 @@ class ParquetFileWriter:
                 columns=chunks,
                 total_byte_size=total_bytes,
                 num_rows=num_rows or 0,
+                sorting_columns=self._sorting,
                 file_offset=rg_start,
                 total_compressed_size=total_comp,
                 ordinal=len(self._row_groups),

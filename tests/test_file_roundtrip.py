@@ -251,6 +251,69 @@ def test_delta_byte_array_write(tmp_path):
         assert rows[0, : lens[0]].tobytes().decode() == vals[0]
 
 
+def test_boundary_order_and_sorting_columns(tmp_path):
+    """ColumnIndex boundary_order is computed by the column's SORT order
+    (readers can binary-search); WriterOptions.sorting_columns records
+    the declared order in every row group (parquet-mr's
+    withSortingColumns — pyarrow surfaces it back)."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    import pytest
+    from parquet_floor_tpu import (
+        ParquetFileReader, ParquetFileWriter, WriterOptions, types,
+    )
+
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("asc"),
+        types.required(types.INT64).named("desc_"),
+        types.required(types.INT64).named("mixed"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    n = 4000
+    path = str(tmp_path / "bo.parquet")
+    rng = np.random.default_rng(3)
+    with ParquetFileWriter(
+        path, schema,
+        WriterOptions(
+            data_page_values=500, enable_dictionary=False,
+            sorting_columns=["asc", ("desc_", True, False)],
+        ),
+    ) as w:
+        w.write_columns({
+            # asc crosses a sign boundary: byte-lex would call the LE
+            # encodings unordered/misordered; value order is ascending
+            "asc": np.arange(-n // 2, n // 2, dtype=np.int64),
+            "desc_": np.arange(n, 0, -1, dtype=np.int64),
+            "mixed": rng.integers(-1000, 1000, n).astype(np.int64),
+            "s": [f"k{i:06d}" for i in range(n)],
+        })
+    with ParquetFileReader(path) as r:
+        rg = r.row_groups[0]
+        by = {
+            tuple(c.meta_data.path_in_schema)[0]: r.read_column_index(c)
+            for c in rg.columns
+        }
+        assert by["asc"].boundary_order == 1      # value-order ascending
+        assert by["desc_"].boundary_order == 2
+        assert by["mixed"].boundary_order == 0
+        assert by["s"].boundary_order == 1        # lex ascending
+        sc = rg.sorting_columns
+        assert [s.column_idx for s in sc] == [0, 1]
+        assert [bool(s.descending) for s in sc] == [False, True]
+    # pyarrow surfaces the declared order
+    md = pq.read_metadata(path)
+    srt = md.row_group(0).sorting_columns
+    assert [s.column_index for s in srt] == [0, 1]
+    assert [s.descending for s in srt] == [False, True]
+    # unknown sort column fails fast
+    with pytest.raises(ValueError, match="no column named"):
+        ParquetFileWriter(
+            str(tmp_path / "bad.parquet"), schema,
+            WriterOptions(sorting_columns=["zz"]),
+        )
+
+
 def test_codec_level_knob(tmp_path):
     """WriterOptions.codec_level: level-aware codecs honor it (higher
     ZSTD/GZIP levels compress more), level-less codecs ignore it, and

@@ -205,9 +205,18 @@ def _boundary_order(desc, null_pages, mins, maxs) -> int:
     page's [min, max] is ordered against the next, 2 = DESCENDING
     symmetric, else 0 = UNORDERED (always valid).  Comparison is by the
     column's SORT ORDER, not the raw stat bytes (little-endian numeric
-    encodings do not byte-compare); types without a usable order here
-    report UNORDERED."""
+    encodings do not byte-compare).  Logical types that CHANGE the sort
+    order away from the physical default — unsigned INTEGER (unsigned
+    compare over a signed physical int), DECIMAL (signed compare over
+    unsigned-lex binary), FLOAT16 — report UNORDERED, which is always
+    valid; so do types with no defined order (INT96)."""
     pt = desc.physical_type
+    lt = desc.primitive.logical_type
+    if lt is not None:
+        if lt.kind in ("DECIMAL", "FLOAT16", "UNKNOWN", "INTERVAL"):
+            return 0
+        if lt.kind == "INTEGER" and not lt.params.get("signed", True):
+            return 0
     if pt in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY, Type.BOOLEAN):
         def key(b):
             return b  # unsigned-lex == stats byte order
@@ -601,11 +610,6 @@ class ParquetFileWriter:
             by_name = {
                 ".".join(c.path): i for i, c in enumerate(schema.columns)
             }
-            by_name.update({
-                c.path[0]: i
-                for i, c in enumerate(schema.columns)
-                if len(c.path) == 1
-            })
             self._sorting = []
             for sel in self.options.sorting_columns:
                 name, descending, nulls_first = (

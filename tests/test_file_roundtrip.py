@@ -298,6 +298,29 @@ def test_boundary_order_and_sorting_columns(tmp_path):
         assert by["desc_"].boundary_order == 2
         assert by["mixed"].boundary_order == 0
         assert by["s"].boundary_order == 1        # lex ascending
+    # order-altering logical types always report UNORDERED (an unsigned
+    # column ascending by BYTE pattern may be unordered by VALUE)
+    schema_u = types.message(
+        "t",
+        types.required(types.INT64).as_(
+            types.int_(64, signed=False)
+        ).named("u"),
+    )
+    pu = str(tmp_path / "uns.parquet")
+    with ParquetFileWriter(
+        pu, schema_u,
+        WriterOptions(data_page_values=500, enable_dictionary=False),
+    ) as w:
+        w.write_columns({
+            "u": np.concatenate([
+                (np.arange(500, dtype=np.uint64) + np.uint64(1 << 63))
+                .view(np.int64),
+                np.arange(1, 501, dtype=np.int64),
+            ])
+        })
+    with ParquetFileReader(pu) as r:
+        ci_u = r.read_column_index(r.row_groups[0].columns[0])
+        assert ci_u.boundary_order == 0
         sc = rg.sorting_columns
         assert [s.column_idx for s in sc] == [0, 1]
         assert [bool(s.descending) for s in sc] == [False, True]

@@ -201,16 +201,68 @@ class DeviceColumn:
         return assemble_nested(schema, batch)
 
 
-def _concat_device_columns(parts: List["DeviceColumn"]) -> "DeviceColumn":
-    """Concatenate row-split segments of one FLAT column on device.
+def _concat_repeated_parts(parts: List["DeviceColumn"]) -> "DeviceColumn":
+    """Concatenate row-split segments of one REPEATED leaf on device.
 
-    Segment outputs are exact (num_rows,)-shaped (dense scatter trims
-    bucket padding), so concatenation reassembles the group losslessly;
-    string byte matrices pad to the widest segment first.  The dict_ref
-    of the last segment wins (content-keyed pools only grow)."""
+    Levels concatenate directly (page-aligned segments never split a
+    record when an OffsetIndex exists — pages start at record
+    boundaries).  Value streams are dense non-null runs padded past
+    each segment's true count, so they pack by scatter: each segment's
+    first ``nn`` values land consecutively (``nn`` stays a traced
+    device scalar — no device→host sync), the padding scatters out of
+    bounds and drops.  The result keeps the engine's repeated-column
+    contract (dense stream padded past the true total count)."""
+    first = parts[0]
+    md = first.descriptor.max_definition_level
+    vals = [p.values for p in parts]
+    lens = (
+        [p.lengths for p in parts] if first.lengths is not None else None
+    )
+    if lens is not None:
+        ml = max(int(v.shape[1]) for v in vals)
+        vals = [
+            v if int(v.shape[1]) == ml
+            else jnp.pad(v, ((0, 0), (0, ml - int(v.shape[1]))))
+            for v in vals
+        ]
+    out_cap = sum(int(v.shape[0]) for v in vals)
+    out_vals = jnp.zeros((out_cap,) + tuple(vals[0].shape[1:]),
+                         vals[0].dtype)
+    out_lens = (
+        jnp.zeros((out_cap,), parts[0].lengths.dtype)
+        if lens is not None
+        else None
+    )
+    start = jnp.zeros((), jnp.int32)
+    for i, v in enumerate(vals):
+        d = parts[i].def_levels
+        nn = jnp.count_nonzero(d == md).astype(jnp.int32)
+        idx = jnp.arange(int(v.shape[0]), dtype=jnp.int32)
+        dest = jnp.where(idx < nn, start + idx, out_cap)
+        out_vals = out_vals.at[dest].set(v, mode="drop")
+        if out_lens is not None:
+            out_lens = out_lens.at[dest].set(lens[i], mode="drop")
+        start = start + nn
+    return DeviceColumn(
+        first.descriptor, out_vals, None, out_lens,
+        jnp.concatenate([p.def_levels for p in parts]),
+        jnp.concatenate([p.rep_levels for p in parts]),
+    )
+
+
+def _concat_device_columns(parts: List["DeviceColumn"]) -> "DeviceColumn":
+    """Concatenate row-split segments of one column on device.
+
+    FLAT segment outputs are exact (num_rows,)-shaped (dense scatter
+    trims bucket padding), so concatenation reassembles the group
+    losslessly; string byte matrices pad to the widest segment first.
+    REPEATED leaves pack via :func:`_concat_repeated_parts`.  The
+    dict_ref of the last segment wins (content-keyed pools only grow)."""
     if len(parts) == 1:
         return parts[0]
     first = parts[0]
+    if first.rep_levels is not None:
+        return _concat_repeated_parts(parts)
     lens = None
     if first.lengths is not None:
         ml = max(int(p.values.shape[1]) for p in parts)
@@ -1903,10 +1955,11 @@ class TpuRowGroupReader:
     def _read_field_row_split(self, rg, index: int, field: str,
                               field_bytes: int) -> Dict[str, DeviceColumn]:
         """One field bigger than the arena cap: decode page-aligned row
-        segments in successive launches and concatenate on device.
-        Needs the OffsetIndex (to find page-aligned split points shared
-        by the field's leaves) and flat leaves (repeated value streams
-        are padded per launch and cannot be concatenated blindly)."""
+        segments in successive launches and concatenate on device (flat
+        columns directly; repeated leaves pack their dense value streams
+        by traced-count scatter).  Needs the OffsetIndex to find
+        page-aligned split points shared by the field's leaves — which
+        also guarantees segments never split a record."""
         n = int(rg.num_rows or 0)
         chunks = [
             c for c in rg.columns or []
@@ -1914,15 +1967,6 @@ class TpuRowGroupReader:
         ]
         grids = []
         for c in chunks:
-            desc = self.reader.schema.column(tuple(c.meta_data.path_in_schema))
-            if desc.max_repetition_level > 0:
-                raise ValueError(
-                    f"row group {index} stages ~{field_bytes} decompressed "
-                    f"bytes in repeated column {field!r}, above the "
-                    f"{self._arena_cap}-byte launch cap, and repeated "
-                    "columns cannot row-split — rewrite the file with "
-                    "smaller row groups or use the host ParquetFileReader"
-                )
             oi = self.reader.read_offset_index(c)
             if oi is None or not oi.page_locations:
                 raise ValueError(
@@ -1984,19 +2028,12 @@ class TpuRowGroupReader:
             return self.read_row_group(index, columns), [(0, n)] if n else []
         # the arena cap binds ranged reads too (HBM working-set bound,
         # same as read_row_group): oversized covers decode in several
-        # launches and concatenate — FLAT leaves only (repeated value
-        # streams are padded per launch; those keep the single launch
-        # and, past the int32 net, the loud error)
+        # launches and concatenate (repeated leaves pack by
+        # traced-count scatter, see _concat_repeated_parts)
         est = self._group_byte_estimate(rg, chunk_filter)
         cov_rows = sum(b - a for a, b in covered)
         per_row = est / max(n, 1)
-        flat = all(
-            self.reader.schema.column(
-                tuple(c.meta_data.path_in_schema)
-            ).max_repetition_level == 0
-            for c in chunks
-        )
-        if flat and cov_rows * per_row > self._arena_cap:
+        if cov_rows * per_row > self._arena_cap:
             parts: Dict[str, List[DeviceColumn]] = {}
             calls = [
                 ((index, columns), {"covered": sub, "group_rows": n})

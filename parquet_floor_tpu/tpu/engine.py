@@ -226,23 +226,26 @@ def _concat_repeated_parts(parts: List["DeviceColumn"]) -> "DeviceColumn":
             for v in vals
         ]
     out_cap = sum(int(v.shape[0]) for v in vals)
-    out_vals = jnp.zeros((out_cap,) + tuple(vals[0].shape[1:]),
-                         vals[0].dtype)
+    # ONE combined destination index, then one scatter per array (the
+    # output is by definition large here — per-segment scatters would
+    # copy it k times)
+    dest_parts = []
+    start = jnp.zeros((), jnp.int32)
+    for i, v in enumerate(vals):
+        nn = jnp.count_nonzero(parts[i].def_levels == md).astype(jnp.int32)
+        idx = jnp.arange(int(v.shape[0]), dtype=jnp.int32)
+        dest_parts.append(jnp.where(idx < nn, start + idx, out_cap))
+        start = start + nn
+    dest = jnp.concatenate(dest_parts)
+    out_vals = jnp.zeros(
+        (out_cap,) + tuple(vals[0].shape[1:]), vals[0].dtype
+    ).at[dest].set(jnp.concatenate(vals), mode="drop")
     out_lens = (
         jnp.zeros((out_cap,), parts[0].lengths.dtype)
+        .at[dest].set(jnp.concatenate(lens), mode="drop")
         if lens is not None
         else None
     )
-    start = jnp.zeros((), jnp.int32)
-    for i, v in enumerate(vals):
-        d = parts[i].def_levels
-        nn = jnp.count_nonzero(d == md).astype(jnp.int32)
-        idx = jnp.arange(int(v.shape[0]), dtype=jnp.int32)
-        dest = jnp.where(idx < nn, start + idx, out_cap)
-        out_vals = out_vals.at[dest].set(v, mode="drop")
-        if out_lens is not None:
-            out_lens = out_lens.at[dest].set(lens[i], mode="drop")
-        start = start + nn
     return DeviceColumn(
         first.descriptor, out_vals, None, out_lens,
         jnp.concatenate([p.def_levels for p in parts]),

@@ -230,3 +230,26 @@ def test_oversized_repeated_column_row_splits(tmp_path, monkeypatch):
                 for r in dev_out
             ]
         assert dev_out == host_out, f"use_str={use_str}"
+        # the RANGED read splits oversized repeated covers too
+        with TpuRowGroupReader(path) as tr, ParquetFileReader(path) as hr:
+            n0 = int(hr.row_groups[0].num_rows or 0)
+            # interior range: whole pages fall outside, so the cover is
+            # a strict subset and the ranged (not full-group) path runs
+            ranges = [(2000, 4000), (7000, 9000)]
+            dev, covered = tr.read_row_group_ranges(0, ranges)
+            hb, hcov = hr.read_row_group_ranges(0, ranges)
+            assert hcov == covered and covered != [(0, n0)]
+            (dc,) = dev.values()
+            got = dc.assemble(sch).to_pylist()
+            want = assemble_nested(sch, hb.columns[0]).to_pylist()
+
+            def norm(rows_):
+                if not use_str:
+                    return rows_
+                return [
+                    None if r is None
+                    else [None if e is None else bytes(e) for e in r]
+                    for r in rows_
+                ]
+
+            assert norm(got) == norm(want), f"ranged use_str={use_str}"

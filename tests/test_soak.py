@@ -298,9 +298,13 @@ def test_random_roundtrip(tmp_path, seed, monkeypatch):
 
 
 @pytest.mark.parametrize("seed", range(12))
-def test_random_nested_roundtrip(tmp_path, seed):
+def test_random_nested_roundtrip(tmp_path, seed, monkeypatch):
     """Random LIST columns (optional lists, optional elements, random
-    lengths incl. empties) through writer → pyarrow + host + TPU."""
+    lengths incl. empties) through writer → pyarrow + host + TPU.
+    Small-page seeds lower the arena cap so the repeated-leaf chunk
+    path (multi-launch split + traced-count packing) soaks too
+    (single-page chunks have no boundary to split on — those keep the
+    default cap)."""
     rng = np.random.default_rng(100 + seed)
     n = int(rng.integers(1, 1500))
     elem_optional = bool(rng.integers(0, 2))
@@ -336,6 +340,10 @@ def test_random_nested_roundtrip(tmp_path, seed):
         data_page_values=int(rng.choice([131, 5000])),
         enable_dictionary=bool(rng.integers(0, 2)),
     )
+    if opts.data_page_values < n:
+        # multiple pages exist → page boundaries exist → the chunk path
+        # can split; force it to run
+        monkeypatch.setenv("PFTPU_ARENA_CAP", str(4 << 10))
     path = str(tmp_path / f"ns{seed}.parquet")
     with ParquetFileWriter(path, schema, opts) as w:
         w.write_columns({"v": rows})

@@ -94,7 +94,12 @@ def _probe_d2h_model() -> tuple:
     transfer sizes (64 KiB and 1 MiB).  Tunnelled links have a large
     fixed cost (~35 ms) and a slow return path (~11 MB/s — see
     BASELINE.md link characterization); locally-attached devices are
-    symmetric.  Probed lazily: only the row API's device path fetches."""
+    symmetric.  Probed lazily: ONLY the rows purpose reaches here, and
+    only when the pre-fetch estimate already favors the device.  That
+    matters because the first D2H can shift a tunnelled link into its
+    degraded mode (BASELINE.md) — acceptable here since the row path
+    fetches continuously anyway (that mode IS its steady state), while
+    the batch purpose never probes D2H and so never triggers it."""
     global _d2h_model
     with _lock:
         if _d2h_model is not None:

@@ -54,6 +54,12 @@ GROUP_OVERHEAD_S = 8e-4  # plan build + dispatch per row group
 HOST_CELL_S = 0.4e-6
 DEV_CELL_S = 0.1e-6
 
+_CLASS_GBPS = {
+    "view": HOST_VIEW_GBPS,
+    "levels": HOST_LEVELS_GBPS,
+    "value": HOST_VALUE_GBPS,
+}
+
 _LEVEL_ENCODINGS = {Encoding.RLE, Encoding.BIT_PACKED}
 _FIXED_TYPES = {
     Type.INT32, Type.INT64, Type.FLOAT, Type.DOUBLE,
@@ -64,6 +70,20 @@ _DICT_ENCODINGS = {Encoding.RLE_DICTIONARY, Encoding.PLAIN_DICTIONARY}
 _lock = threading.Lock()
 _h2d_gbps: Optional[float] = None
 _d2h_model: Optional[tuple] = None  # (fixed_s, gbps)
+
+
+def arena_cap() -> int:
+    """The per-launch arena byte budget (PFTPU_ARENA_CAP, default
+    64 MiB, ceilinged below the int32 plan limit).  Single source of
+    truth: ``TpuRowGroupReader`` sizes its launches with this, and
+    ``estimate`` uses it to predict which fields must row-split — and
+    therefore host-fall-back when the file has nothing to split on."""
+    import os
+
+    return min(
+        int(os.environ.get("PFTPU_ARENA_CAP", str(1 << 26))),
+        (1 << 31) - (1 << 24),
+    )
 
 
 def _probe_h2d_gbps() -> float:
@@ -145,6 +165,25 @@ class EngineChoice:
         }
 
 
+def _field_splittable(reader, rg, chunks) -> bool:
+    """Footer-cheap mirror of the engine's row-split precondition
+    (``engine._read_field_row_split``): every chunk of the field has an
+    OffsetIndex AND the chunks share at least one interior page
+    boundary to cut on.  Only consulted for over-cap fields, so the
+    (tiny) OffsetIndex reads are rare."""
+    n = int(rg.num_rows or 0)
+    grid = None
+    for chunk in chunks:
+        if chunk.offset_index_offset is None:
+            return False
+        oi = reader.read_offset_index(chunk)
+        if oi is None or not oi.page_locations:
+            return False
+        starts = {int(pl.first_row_index or 0) for pl in oi.page_locations}
+        grid = starts if grid is None else (grid & starts)
+    return bool(grid) and any(0 < p < n for p in grid)
+
+
 def classify_chunk(desc, meta) -> str:
     """Map one column chunk to its host-decode cost class from footer
     metadata alone: "view" | "levels" | "value"."""
@@ -172,17 +211,45 @@ def estimate(reader, purpose: str = "rows", columns=None) -> EngineChoice:
     fetch_bytes = 0
     n_groups = 0
     n_cells = 0
+    cap = arena_cap()
+    unsplit_host_s = 0.0   # device-path host fallback decode (see below)
+    unsplit_bytes = 0
     for rg in reader.row_groups:
         n_groups += 1
+        # per-field decompressed totals + splittability: a field whose
+        # chunks alone exceed the arena cap must row-split to decode on
+        # device, which needs an OffsetIndex with an interior page
+        # boundary shared by the field's leaves.  Without one the
+        # device engine host-falls-back for that field
+        # (engine._read_field_host_fallback) — charge those bytes at
+        # HOST decode rates on the device side so "auto" ranks the real
+        # work, not the fused decode the device never runs.
+        field_bytes: Dict[str, int] = {}
+        field_chunks: Dict[str, list] = {}
+        chunk_rows = []
         for chunk in rg.columns or []:
             meta = chunk.meta_data
-            if columns is not None and meta.path_in_schema[0] not in columns:
+            f = meta.path_in_schema[0]
+            if columns is not None and f not in columns:
                 continue
             desc = reader.schema.column(tuple(meta.path_in_schema))
             nbytes = int(meta.total_uncompressed_size or 0)
-            n_cells += int(meta.num_values or 0)
             cls = classify_chunk(desc, meta)
-            by_class[cls] += nbytes
+            field_bytes[f] = field_bytes.get(f, 0) + nbytes
+            field_chunks.setdefault(f, []).append(chunk)
+            chunk_rows.append((meta, f, nbytes, cls))
+        unsplit_fields = {
+            f for f, fb in field_bytes.items()
+            if fb > cap
+            and not _field_splittable(reader, rg, field_chunks[f])
+        }
+        for meta, f, nbytes, cls in chunk_rows:
+            n_cells += int(meta.num_values or 0)
+            if f in unsplit_fields:
+                unsplit_host_s += nbytes / (_CLASS_GBPS[cls] * 1e9)
+                unsplit_bytes += nbytes
+            else:
+                by_class[cls] += nbytes
             if set(meta.encodings or []) & _DICT_ENCODINGS:
                 # index-form dictionary columns fetch the packed index
                 # stream + one pool per file — far fewer bytes than the
@@ -195,17 +262,24 @@ def estimate(reader, purpose: str = "rows", columns=None) -> EngineChoice:
         by_class["view"] / (HOST_VIEW_GBPS * 1e9)
         + by_class["levels"] / (HOST_LEVELS_GBPS * 1e9)
         + by_class["value"] / (HOST_VALUE_GBPS * 1e9)
+        + unsplit_host_s
     )
     h2d = _probe_h2d_gbps()
     tpu_s = (
         total / (h2d * 1e9)
         + total / (DEV_DECODE_GBPS * 1e9)
         + n_groups * GROUP_OVERHEAD_S
+        # unsplittable fields host-decode inside the device engine and
+        # ship the decoded bytes — no fused-decode term for them
+        + unsplit_host_s
+        + unsplit_bytes / (h2d * 1e9)
     )
     if purpose == "rows":
         # cell materialization differs per engine (see HOST_CELL_S note)
         host_s += n_cells * HOST_CELL_S
         tpu_s += n_cells * DEV_CELL_S
+    if unsplit_bytes:
+        by_class["unsplit"] = unsplit_bytes
     choice = EngineChoice(
         engine="tpu" if tpu_s < host_s else "host",
         host_s=host_s,

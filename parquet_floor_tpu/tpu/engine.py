@@ -1767,10 +1767,12 @@ class TpuRowGroupReader:
         # that at ~4 GB of HBM while keeping every bench config a single
         # launch.  PFTPU_ARENA_CAP (bytes) overrides either way; the
         # absolute int32 ceiling stays as the per-launch safety net.
-        self._arena_cap = min(
-            int(_os.environ.get("PFTPU_ARENA_CAP", str(1 << 26))),
-            (1 << 31) - (1 << 24),
-        )
+        # One definition shared with the cost model (cost.arena_cap), so
+        # "auto"'s splittability prediction can never drift from the cap
+        # the launches actually use.
+        from .cost import arena_cap
+
+        self._arena_cap = arena_cap()
         self._forced: set = set()   # columns pinned to the host path (per file)
         self._hwm_state: Dict[tuple, int] = {}
         # string-dictionary pools are keyed by (sha256(content), cap, len).
@@ -1968,29 +1970,29 @@ class TpuRowGroupReader:
             c for c in rg.columns or []
             if c.meta_data.path_in_schema[0] == field
         ]
-        grids = []
-        for c in chunks:
-            oi = self.reader.read_offset_index(c)
-            if oi is None or not oi.page_locations:
-                raise ValueError(
-                    f"row group {index} stages ~{field_bytes} decompressed "
-                    f"bytes in column {field!r}, above the "
-                    f"{self._arena_cap}-byte launch cap, and the file has "
-                    "no OffsetIndex to row-split on — rewrite with smaller "
-                    "row groups (or write_page_index) or use the host "
-                    "ParquetFileReader"
-                )
-            grids.append({int(pl.first_row_index or 0) for pl in oi.page_locations})
-        del grids  # presence checked above; _split_covered re-reads them
-        per_row = field_bytes / max(n, 1)
-        subs = self._split_covered([(0, n)], per_row, chunks)
-        if len(subs) <= 1:
-            raise ValueError(
-                f"row group {index} column {field!r} has no page boundary "
-                f"to split its ~{field_bytes} decompressed bytes under the "
-                f"{self._arena_cap}-byte launch cap — rewrite the file "
-                "with smaller pages/row groups or use the host "
-                "ParquetFileReader"
+        missing_oi = any(
+            (oi := self.reader.read_offset_index(c)) is None
+            or not oi.page_locations
+            for c in chunks
+        )
+        subs = []
+        if not missing_oi:
+            per_row = field_bytes / max(n, 1)
+            subs = self._split_covered([(0, n)], per_row, chunks)
+        if missing_oi or len(subs) <= 1:
+            # unsplittable over-cap field (no OffsetIndex, or no page
+            # boundary lands under the cap): decode the whole column on
+            # the HOST path in one launch instead of refusing.  The
+            # reference streams page-at-a-time with no size ceiling
+            # (ParquetReader.java:182-194) — the device engine must
+            # never refuse a file shape the host engine reads fine.
+            # Host-decoded columns ship dense (no (8,128)-tile padding
+            # blowup), so the arena cap does not apply; only the 2 GiB
+            # int32 plan ceiling still guards the launch.
+            return self._read_field_host_fallback(
+                index, field, field_bytes,
+                "no OffsetIndex" if missing_oi
+                else "no page boundary under the cap",
             )
         parts: Dict[str, List[DeviceColumn]] = {}
         calls = [
@@ -2001,6 +2003,32 @@ class TpuRowGroupReader:
             for k, v in res.items():
                 parts.setdefault(k, []).append(v)
         return {k: _concat_device_columns(v) for k, v in parts.items()}
+
+    def _read_field_host_fallback(self, index: int, field: str,
+                                  field_bytes: int, why: str
+                                  ) -> Dict[str, DeviceColumn]:
+        """Graceful path for an over-cap field that cannot row-split:
+        pin every leaf of the field to the host decode path (sticky per
+        file, like every other ``_forced`` entry — the shape repeats in
+        later row groups) and decode it in a single launch."""
+        rg = self.reader.row_groups[index]
+        names = set()
+        for c in rg.columns or []:
+            path = tuple(c.meta_data.path_in_schema)
+            if path[0] == field:
+                names.add(path[0] if len(path) == 1 else ".".join(path))
+        self._forced.update(names)
+        trace.decision("chunk_fallback", {
+            "row_group": index,
+            "field": field,
+            "decompressed_bytes": int(field_bytes),
+            "arena_cap": int(self._arena_cap),
+            "why": why,
+            "action": "whole-column host decode (raise PFTPU_ARENA_CAP "
+                      "to decode on device in one launch)",
+        })
+        sg = self._stage_row_group(index, [field])
+        return self._launch(sg)
 
     def read_row_group_ranges(
         self, index: int, row_ranges, columns: Optional[Sequence[str]] = None

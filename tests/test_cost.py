@@ -161,6 +161,52 @@ def test_front_door_auto_routing(tmp_path, tunnel_probes, monkeypatch):
     assert rows_auto == rows_host
 
 
+def test_estimate_accounts_for_unsplittable_fields(tmp_path, tunnel_probes,
+                                                   monkeypatch):
+    """Splittability is part of the routing input (VERDICT r4 #1): an
+    over-cap value-class field with no OffsetIndex host-decodes inside
+    the device engine (chunk fallback), so the model must charge it
+    host rates + ship on the device side — flipping a file that fused
+    decode alone would have routed to the device."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = 200_000
+    table = pa.table({"s": [f"val{i % 40}" for i in range(n)]})
+    p_no = str(tmp_path / "no_oi.parquet")
+    p_oi = str(tmp_path / "oi.parquet")
+    pq.write_table(table, p_no, write_page_index=False,
+                   data_page_size=16 << 10)
+    pq.write_table(table, p_oi, write_page_index=True,
+                   data_page_size=16 << 10)
+    monkeypatch.setenv("PFTPU_ARENA_CAP", str(64 << 10))
+    with ParquetFileReader(p_no) as r:
+        est_no = cost.estimate(r, purpose="batch")
+    with ParquetFileReader(p_oi) as r:
+        est_oi = cost.estimate(r, purpose="batch")
+    # with the OffsetIndex the field row-splits: fused decode wins
+    assert est_oi.engine == "tpu"
+    assert "unsplit" not in est_oi.bytes_by_class
+    # without it the device path does the same host decode PLUS the
+    # ship — it can only lose, so auto must route host
+    assert est_no.engine == "host"
+    assert est_no.bytes_by_class["unsplit"] > 0
+    assert est_no.tpu_s > est_no.host_s
+    # an OffsetIndex with no interior boundary (single huge page) is
+    # just as unsplittable — the model must treat it like the engine
+    p_1p = str(tmp_path / "onepage.parquet")
+    schema = types.message(
+        "t", types.required(types.BYTE_ARRAY).as_(types.string()).named("s")
+    )
+    with ParquetFileWriter(p_1p, schema,
+                           WriterOptions(data_page_values=10**9)) as w:
+        w.write_columns({"s": [f"val{i % 40}" for i in range(n)]})
+    with ParquetFileReader(p_1p) as r:
+        est_1p = cost.estimate(r, purpose="batch")
+    assert est_1p.engine == "host"
+    assert est_1p.bytes_by_class["unsplit"] > 0
+
+
 def test_auto_degrades_to_host_without_x64(tmp_path, tunnel_probes, monkeypatch):
     """auto must never error for environment reasons: with x64 off the
     device engine cannot construct, so auto picks host."""

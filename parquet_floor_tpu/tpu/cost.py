@@ -165,6 +165,31 @@ class EngineChoice:
         }
 
 
+_FIXED_WIDTHS = {
+    Type.INT32: 4, Type.INT64: 8, Type.FLOAT: 4, Type.DOUBLE: 8,
+    Type.INT96: 12, Type.BOOLEAN: 1,
+}
+
+
+def _dense_byte_estimate(reader, meta, nbytes: int) -> int:
+    """Bytes the host fallback actually SHIPS for one chunk: the
+    decoded dense stream, not the encoded pages.  Fixed-width types are
+    exact from the footer (num_values x width); PLAIN byte arrays are
+    ~their page bytes; dictionary-encoded byte arrays expand from
+    index stream + pool to gathered values — mirror the 3x ratio the
+    fetch estimate uses in the other direction."""
+    desc = reader.schema.column(tuple(meta.path_in_schema))
+    pt = desc.physical_type
+    width = _FIXED_WIDTHS.get(pt)
+    if pt == Type.FIXED_LEN_BYTE_ARRAY and desc.type_length:
+        width = int(desc.type_length)
+    if width is not None:
+        return int(meta.num_values or 0) * width
+    if set(meta.encodings or []) & _DICT_ENCODINGS:
+        return nbytes * 3
+    return nbytes
+
+
 def _field_splittable(reader, rg, chunks) -> bool:
     """Footer-cheap mirror of the engine's row-split precondition
     (``engine._read_field_row_split``): every chunk of the field has an
@@ -247,7 +272,9 @@ def estimate(reader, purpose: str = "rows", columns=None) -> EngineChoice:
             n_cells += int(meta.num_values or 0)
             if f in unsplit_fields:
                 unsplit_host_s += nbytes / (_CLASS_GBPS[cls] * 1e9)
-                unsplit_bytes += nbytes
+                unsplit_bytes += _dense_byte_estimate(
+                    reader, meta, nbytes
+                )
             else:
                 by_class[cls] += nbytes
             if set(meta.encodings or []) & _DICT_ENCODINGS:
@@ -259,9 +286,7 @@ def estimate(reader, purpose: str = "rows", columns=None) -> EngineChoice:
                 fetch_bytes += nbytes
     total = sum(by_class.values())
     host_s = (
-        by_class["view"] / (HOST_VIEW_GBPS * 1e9)
-        + by_class["levels"] / (HOST_LEVELS_GBPS * 1e9)
-        + by_class["value"] / (HOST_VALUE_GBPS * 1e9)
+        sum(by_class[c] / (_CLASS_GBPS[c] * 1e9) for c in _CLASS_GBPS)
         + unsplit_host_s
     )
     h2d = _probe_h2d_gbps()
@@ -270,7 +295,8 @@ def estimate(reader, purpose: str = "rows", columns=None) -> EngineChoice:
         + total / (DEV_DECODE_GBPS * 1e9)
         + n_groups * GROUP_OVERHEAD_S
         # unsplittable fields host-decode inside the device engine and
-        # ship the decoded bytes — no fused-decode term for them
+        # ship the DECODED dense bytes (not the encoded pages) — no
+        # fused-decode term for them
         + unsplit_host_s
         + unsplit_bytes / (h2d * 1e9)
     )
@@ -296,8 +322,10 @@ def estimate(reader, purpose: str = "rows", columns=None) -> EngineChoice:
             choice.engine = "host"
     choice.reason = (
         f"est host {choice.host_s * 1e3:.1f} ms vs device "
-        f"{choice.tpu_s * 1e3:.1f} ms over {total} decoded bytes "
-        f"(link {h2d:.2f} GB/s)"
+        f"{choice.tpu_s * 1e3:.1f} ms over {total + unsplit_bytes} "
+        f"decoded bytes"
+        + (f" ({unsplit_bytes} via host fallback)" if unsplit_bytes else "")
+        + f" (link {h2d:.2f} GB/s)"
     )
     return choice
 

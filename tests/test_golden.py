@@ -1,0 +1,228 @@
+"""Golden compatibility corpus (VERDICT r4 #2): third-party and
+parquet-mr-convention binaries in ``tests/data/golden/`` must decode
+cell-identically on BOTH engines.
+
+Two provenance classes (see tests/data/golden/README.md):
+* ``parquet-cpp/v0.7.1.*`` — genuine 2017 parquet-cpp writer output
+  (Apache-licensed, shipped with the pyarrow wheel); oracled by pyarrow.
+* ``mr_*`` — parquet-mr 1.12.2 output conventions this repo's writer
+  never produces (legacy 2-level lists, MSB-first BIT_PACKED levels,
+  PLAIN_DICTIONARY stamps, INT96, the reference's pinned
+  SNAPPY+PARQUET_2_0 v2 shape — reference ParquetWriter.java:65-66),
+  pinned in ``expected.json`` and (where arrow agrees with the spec)
+  cross-checked against pyarrow.
+"""
+
+import datetime
+import glob
+import json
+import os
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_floor_tpu import ParquetFileReader, assemble_nested
+from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "golden")
+
+CPP_FILES = sorted(
+    os.path.basename(p)
+    for p in glob.glob(os.path.join(GOLDEN, "parquet-cpp", "*.parquet"))
+)
+MR_FILES = sorted(
+    os.path.basename(p)
+    for p in glob.glob(os.path.join(GOLDEN, "*.parquet"))
+)
+
+
+def _host_cells(path):
+    """Decode every column with the host engine into plain pylists:
+    numbers (None for nulls), ``bytes`` for binary-ish leaves, nested
+    lists for repeated fields."""
+    out = {}
+    with ParquetFileReader(path) as r:
+        for gi in range(len(r.row_groups)):
+            for cb in r.read_row_group(gi).columns:
+                top = cb.descriptor.path[0]
+                if cb.descriptor.max_repetition_level > 0:
+                    vals = assemble_nested(r.schema, cb).to_pylist()
+                    vals = [
+                        None if row is None
+                        else [
+                            None if e is None else _as_bytes_or_num(e)
+                            for e in row
+                        ]
+                        for row in vals
+                    ]
+                else:
+                    dense, mask = cb.dense()
+                    if isinstance(dense, ByteArrayColumn):
+                        raw = dense.to_list()
+                        vals = [
+                            None if (mask is not None and mask[i])
+                            else bytes(raw[i])
+                            for i in range(len(raw))
+                        ]
+                    elif getattr(dense, "ndim", 1) == 2:
+                        vals = [
+                            None if (mask is not None and mask[i])
+                            else dense[i].tobytes()
+                            for i in range(dense.shape[0])
+                        ]
+                    else:
+                        vals = [
+                            None if (mask is not None and mask[i])
+                            else dense[i].item()
+                            for i in range(len(dense))
+                        ]
+                out.setdefault(top, []).extend(vals)
+    return out
+
+
+def _as_bytes_or_num(e):
+    a = np.asarray(e)
+    if a.dtype == np.uint8 and a.ndim >= 1:
+        return a.tobytes()
+    return a.item()
+
+
+def _device_cells(path):
+    """Same rendering through the device engine."""
+    out = {}
+    with TpuRowGroupReader(path, float64_policy="float64") as tr:
+        sch = tr.reader.schema
+        for gi in range(tr.num_row_groups):
+            for name, dc in tr.read_row_group(gi).items():
+                top = name.split(".")[0]
+                if dc.descriptor.max_repetition_level > 0:
+                    vals = dc.assemble(sch).to_pylist()
+                    vals = [
+                        None if row is None
+                        else [
+                            None if e is None else _as_bytes_or_num(e)
+                            for e in row
+                        ]
+                        for row in vals
+                    ]
+                else:
+                    mask = (
+                        np.asarray(dc.mask) if dc.mask is not None else None
+                    )
+                    if dc.lengths is not None:
+                        lens = np.asarray(dc.lengths)
+                        rows = np.asarray(dc.values)
+                        vals = [
+                            None if (mask is not None and mask[i])
+                            else rows[i, : lens[i]].tobytes()
+                            for i in range(len(lens))
+                        ]
+                    else:
+                        arr = np.asarray(dc.values)
+                        if arr.ndim == 2:
+                            vals = [
+                                None if (mask is not None and mask[i])
+                                else arr[i].tobytes()
+                                for i in range(arr.shape[0])
+                            ]
+                        else:
+                            vals = [
+                                None if (mask is not None and mask[i])
+                                else arr[i].item()
+                                for i in range(len(arr))
+                            ]
+                out.setdefault(top, []).extend(vals)
+    return out
+
+
+def _normalize_oracle(values):
+    """pyarrow pylist → the same plain form ``_host_cells`` renders."""
+    out = []
+    for v in values:
+        if isinstance(v, str):
+            out.append(v.encode())
+        elif isinstance(v, datetime.datetime):
+            # ConvertedType TIMESTAMP_MICROS columns come back as tz-aware
+            # datetimes; our engines surface the raw int64 micros.
+            # timedelta floor-division stays exact for pre-epoch values
+            # (int(timestamp()) would truncate toward zero)
+            epoch = datetime.datetime(1970, 1, 1,
+                                      tzinfo=datetime.timezone.utc)
+            out.append(
+                (v.replace(tzinfo=datetime.timezone.utc) - epoch)
+                // datetime.timedelta(microseconds=1)
+            )
+        elif isinstance(v, list):
+            out.append(_normalize_oracle(v))
+        else:
+            out.append(v)
+    return out
+
+
+def _assert_same(got, want, label):
+    assert len(got) == len(want), label
+    for i, (g, w) in enumerate(zip(got, want)):
+        if isinstance(w, float) and isinstance(g, float):
+            assert g == w or abs(g - w) < 1e-12, f"{label}[{i}]: {g} != {w}"
+        else:
+            assert g == w, f"{label}[{i}]: {g!r} != {w!r}"
+
+
+@pytest.mark.parametrize("fname", CPP_FILES)
+def test_parquet_cpp_files_both_engines(fname):
+    """2017 parquet-cpp binaries: host engine == pyarrow oracle, device
+    engine == host, every column cell-identical."""
+    path = os.path.join(GOLDEN, "parquet-cpp", fname)
+    host = _host_cells(path)
+    oracle = pq.read_table(path)
+    assert set(host) == set(oracle.column_names)
+    for col in oracle.column_names:
+        want = _normalize_oracle(oracle.column(col).to_pylist())
+        _assert_same(host[col], want, f"{fname}:{col}")
+    dev = _device_cells(path)
+    assert set(dev) == set(host)
+    for col in host:
+        _assert_same(dev[col], host[col], f"{fname}:{col} (device)")
+
+
+@pytest.mark.parametrize("fname", MR_FILES)
+def test_mr_convention_files_both_engines(fname):
+    """parquet-mr-convention binaries: both engines == the pinned
+    expected cells (bytes hex-encoded in expected.json)."""
+    with open(os.path.join(GOLDEN, "expected.json")) as f:
+        expected_all = json.load(f)
+    assert fname in expected_all, f"{fname} missing from expected.json"
+    path = os.path.join(GOLDEN, fname)
+
+    # expected.json stores raw-binary cells hex-encoded ("ts") and text
+    # cells as strings ("name"); our engines render both as bytes
+    decode = {"ts": bytes.fromhex, "name": str.encode}
+    expected = {}
+    for col, vals in expected_all[fname].items():
+        fn = decode.get(col)
+        expected[col] = (
+            [None if v is None else fn(v) for v in vals] if fn else vals
+        )
+    host = _host_cells(path)
+    assert set(host) == set(expected)
+    for col, want in expected.items():
+        _assert_same(host[col], want, f"{fname}:{col}")
+    dev = _device_cells(path)
+    assert set(dev) == set(expected)
+    for col, want in expected.items():
+        _assert_same(dev[col], want, f"{fname}:{col} (device)")
+
+
+def test_created_by_surfaces():
+    """The third-party created_by stamps parse and surface through the
+    metadata API (readers must not choke on foreign writer strings)."""
+    with ParquetFileReader(
+        os.path.join(GOLDEN, "mr_v2_delta_snappy.parquet")
+    ) as r:
+        assert "parquet-mr version 1.12.2" in (r.metadata.created_by or "")
+    with ParquetFileReader(
+        os.path.join(GOLDEN, "parquet-cpp", "v0.7.1.parquet")
+    ) as r:
+        assert "parquet-cpp" in (r.metadata.created_by or "")

@@ -92,6 +92,108 @@ def page_decode_latency(tpu_reader, reps: int = 30):
     }
 
 
+def batch_face_leg(path, reps: int, raw_engine_best: float) -> dict:
+    """Batch-protocol throughput (VERDICT r4 #4): rows/s through the
+    flagship ``ParquetReader.stream_batches`` face on the device engine,
+    arrays kept on device (no D2H — the protocol's intended shape,
+    examples/tpch_q1_batches.py), plus the protocol's overhead vs the
+    raw engine scan timed by the caller."""
+    import jax
+
+    from parquet_floor_tpu import ParquetReader
+
+    def run():
+        rows = 0
+        for cols in ParquetReader.stream_batches(path, engine="tpu"):
+            jax.block_until_ready([c.values for c in cols])
+            rows += int(cols[0].values.shape[0])
+        return rows
+
+    rows = run()  # warm (compile shapes are shared with the raw scan)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "batch_rows_per_sec": round(rows / best, 1),
+        # protocol overhead: batch-face wall over the raw engine scan of
+        # the same file (1.0 = free; round-4 builder measurement: ~1.11)
+        "batch_vs_raw_engine_x": round(best / raw_engine_best, 3),
+    }
+
+
+def chunked_leg(path, single_cols) -> dict:
+    """Lowered-cap chunked decode (VERDICT r4 #4): group 0 again under
+    a cap that forces >=3 launches, checked bit-exact against the
+    single-launch decode.  Runs AFTER all timing legs — the bit-exact
+    check fetches device arrays, and the first D2H degrades tunnelled
+    links process-wide (BASELINE.md link characterization)."""
+    import numpy as np
+
+    from parquet_floor_tpu.format.file_read import ParquetFileReader
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+    from parquet_floor_tpu.utils import trace
+
+    with ParquetFileReader(path) as r:
+        est = sum(
+            int(c.meta_data.total_uncompressed_size or 0)
+            for c in (r.row_groups[0].columns or [])
+        )
+    cap = max(est // 4, 1 << 16)
+    prev = os.environ.get("PFTPU_ARENA_CAP")
+    os.environ["PFTPU_ARENA_CAP"] = str(cap)
+    try:
+        trace.enable()
+        trace.reset()
+        t0 = time.perf_counter()
+        with TpuRowGroupReader(path, float64_policy="bits") as tr:
+            assert tr._arena_cap == cap
+            chunk_cols = tr.read_row_group(0)
+            wall = time.perf_counter() - t0
+            launches = trace.stats().get("stage", {}).get("count", 0)
+            trace.disable()
+            bit_exact = True
+            for name, sc in single_cols.items():
+                cc = chunk_cols[name]
+                if sc.lengths is not None:
+                    sl = np.asarray(sc.lengths)
+                    cl = np.asarray(cc.lengths)
+                    if not np.array_equal(sl, cl):
+                        bit_exact = False
+                        continue
+                    sv, cv = np.asarray(sc.values), np.asarray(cc.values)
+                    w = min(sv.shape[1], cv.shape[1])
+                    # beyond each row's length is padding; trim to the
+                    # common bucket width and zero the slack
+                    col_ix = np.arange(w)[None, :]
+                    sm = col_ix < sl[:, None]
+                    if not np.array_equal(
+                        np.where(sm, sv[:, :w], 0),
+                        np.where(sm, cv[:, :w], 0),
+                    ):
+                        bit_exact = False
+                elif not np.array_equal(
+                    np.asarray(sc.values), np.asarray(cc.values)
+                ):
+                    bit_exact = False
+                if sc.mask is not None and not np.array_equal(
+                    np.asarray(sc.mask), np.asarray(cc.mask)
+                ):
+                    bit_exact = False
+    finally:
+        if prev is None:
+            os.environ.pop("PFTPU_ARENA_CAP", None)
+        else:
+            os.environ["PFTPU_ARENA_CAP"] = prev
+    return {
+        "chunked_launches": launches,
+        "chunked_bit_exact": bool(bit_exact),
+        "chunked_group0_wall_ms": round(wall * 1e3, 1),
+        "chunked_cap_bytes": cap,
+    }
+
+
 def main():
     import numpy as np  # noqa: F401
 
@@ -167,7 +269,14 @@ def main():
     from parquet_floor_tpu.tpu import cost as _cost
 
     auto_choice = _cost.choose_engine(reader.reader, purpose="batch")
+    # the two flagship-path legs (VERDICT r4 #4).  Order matters: the
+    # batch leg TIMES first (no D2H anywhere yet); the chunked leg's
+    # bit-exact check then fetches arrays — after every timed section,
+    # because the first D2H degrades a tunnelled link process-wide
+    batch = batch_face_leg(path, reps, best)
+    single_cols = reader.read_row_group(0)
     reader.close()
+    chunked = chunked_leg(path, single_cols)
 
     result = {
         "metric": "tpch_lineitem_snappy_dict_decode",
@@ -198,6 +307,8 @@ def main():
             ) if ship_seconds else None,
             "auto_routes_to": auto_choice.engine,
             **latency,
+            **batch,
+            **chunked,
         },
     }
     print(json.dumps(result))

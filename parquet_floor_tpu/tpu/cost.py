@@ -22,9 +22,12 @@ encodings, optionality) plus a one-time cached link-bandwidth probe:
     per-value host work — the measured ~0.03-0.05 GB/s that the fused
     device decode beats by 15-50x (BASELINE.md configs #2-5).
 
-Rates are differential calibration constants taken from the measured
-round-3 stage tables (docs/DESIGN_DECOMPRESSION.md, BASELINE.md); they
-only need to rank the two engines, not predict absolute walls.
+Host decode rates are MEASURED per process at first use
+(``_probe_host_rates``: ~1 MiB synthetic pages through the real host
+page-decode path, cached like the link probes); the module constants
+below are the shipped fallback, calibrated from the round-3 stage
+tables (docs/DESIGN_DECOMPRESSION.md, BASELINE.md).  Either way the
+rates only need to rank the two engines, not predict absolute walls.
 """
 
 from __future__ import annotations
@@ -37,7 +40,8 @@ from typing import Dict, Optional
 from ..format.parquet_thrift import Encoding, Type
 from ..utils import trace
 
-# Differential host post-decompress decode rates, GB/s of decoded bytes.
+# Differential host post-decompress decode rates, GB/s of page bytes —
+# the FALLBACK when the per-process probe cannot run (_probe_host_rates).
 HOST_VIEW_GBPS = 4.0     # PLAIN fixed-width required: frombuffer view/copy
 HOST_LEVELS_GBPS = 0.4   # PLAIN fixed-width optional: level decode + scatter
 HOST_VALUE_GBPS = 0.05   # dict/delta/strings/bool: per-value host decode
@@ -49,9 +53,15 @@ GROUP_OVERHEAD_S = 8e-4  # plan build + dispatch per row group
 # Row-API cell materialization (the host cursor boxes each cell through
 # per-cell numpy→Python dispatch; the device path converts vectorized —
 # tolist once per column + pool-once-per-distinct for dictionaries).
-# Calibrated from BASELINE.md's measured 76k vs 187k rows/s on 16-column
-# lineitem (1.2M vs ~3M cells/s plus the fetch the device side pays).
-HOST_CELL_S = 0.4e-6
+# Host boxing costs differ sharply by column class: a fixed-width
+# numeric .item() is cheap; strings/decimals/dict cells pay conversion.
+# Calibrated against BASELINE.md's measured 76k rows/s on 16-column
+# lineitem (13.2 s wall - 0.6 s host value decode over 2M view-class +
+# 14M value-class cells).  The device side's 187k rows/s wall is
+# dominated by the D2H fetch, modeled separately (overlapped with
+# DEV_CELL_S conversion by the cursor's one-group prefetch).
+HOST_CELL_VIEW_S = 0.25e-6   # fixed-width numeric boxing
+HOST_CELL_VALUE_S = 0.86e-6  # string/decimal/dict conversion
 DEV_CELL_S = 0.1e-6
 
 _CLASS_GBPS = {
@@ -70,6 +80,121 @@ _DICT_ENCODINGS = {Encoding.RLE_DICTIONARY, Encoding.PLAIN_DICTIONARY}
 _lock = threading.Lock()
 _h2d_gbps: Optional[float] = None
 _d2h_model: Optional[tuple] = None  # (fixed_s, gbps)
+_host_rates: Optional[Dict[str, float]] = None
+
+
+def _probe_host_rates() -> Dict[str, float]:
+    """One-time host decode-rate calibration, cached per process like
+    the link probes.  Times the REAL host page-decode path
+    (``pages.decode_data_page`` + ``dense()``) on ~1 MiB synthetic
+    pages, one per cost class, so the ranking stands on this machine's
+    measured rates instead of the shipped calibration constants
+    (VERDICT r4 #3: on a fast-CPU host with a local link, hardcoded
+    rates could silently invert the ranking).  The constants remain the
+    fallback if the probe fails; rates are floored/capped to keep a
+    pathological measurement from producing a nonsense ranking."""
+    global _host_rates
+    with _lock:
+        if _host_rates is not None:
+            return _host_rates
+    fallback = dict(_CLASS_GBPS)
+    try:
+        rates = _measure_host_rates()
+    except Exception:
+        rates = fallback
+    rates = {
+        k: min(max(v, 1e-4), 100.0) for k, v in rates.items()
+    }
+    with _lock:
+        _host_rates = rates
+        return rates
+
+
+def _measure_host_rates() -> Dict[str, float]:
+    import numpy as np
+
+    from ..format import pages as pg
+    from ..format.encodings.dictionary import (
+        decode_dictionary_page,
+        encode_dict_indices,
+        encode_dictionary_page,
+    )
+    from ..format.encodings.plain import ByteArrayColumn, encode_plain
+    from ..format.encodings.rle_hybrid import encode_length_prefixed
+    from ..format.parquet_thrift import (
+        CompressionCodec,
+        DataPageHeader,
+        PageHeader,
+        PageType,
+    )
+    from ..format.schema import types as t
+
+    def page_of(payload, n):
+        return pg.RawPage(
+            header=PageHeader(
+                type=PageType.DATA_PAGE,
+                uncompressed_page_size=len(payload),
+                compressed_page_size=len(payload),
+                data_page_header=DataPageHeader(
+                    num_values=n,
+                    encoding=Encoding.PLAIN,
+                    definition_level_encoding=Encoding.RLE,
+                    repetition_level_encoding=Encoding.RLE,
+                ),
+            ),
+            payload=payload,
+        )
+
+    rng = np.random.default_rng(7)
+    jobs = {}
+    # view: PLAIN fixed-width required — frombuffer-speed
+    n = 1 << 17  # 1 MiB of int64
+    vals = rng.integers(-(2**40), 2**40, n).astype(np.int64)
+    sch_v = t.message("c", t.required(t.INT64).named("x"))
+    jobs["view"] = (page_of(encode_plain(vals, Type.INT64), n),
+                    sch_v.columns[0], None)
+    # levels: PLAIN fixed-width optional — level decode + scatter
+    defs = (rng.random(n) > 0.1).astype(np.uint32)
+    present = vals[: int(defs.sum())]
+    payload = (encode_length_prefixed(defs, 1)
+               + encode_plain(present, Type.INT64))
+    sch_l = t.message("c", t.optional(t.INT64).named("x"))
+    jobs["levels"] = (page_of(payload, n), sch_l.columns[0], None)
+    # value: dictionary strings — per-value host work
+    pool_strs = [f"value-{i:04d}" for i in range(64)]
+    joined = "".join(pool_strs).encode()
+    pool = ByteArrayColumn(
+        np.cumsum([0] + [len(s) for s in pool_strs]).astype(np.int64),
+        np.frombuffer(joined, np.uint8),
+    )
+    nv = 1 << 17
+    idx = rng.integers(0, 64, nv).astype(np.uint32)
+    dict_payload = encode_dictionary_page(pool, Type.BYTE_ARRAY)
+    dictionary = decode_dictionary_page(dict_payload, 64, Type.BYTE_ARRAY)
+    vp = page_of(encode_dict_indices(idx, 64), nv)
+    vp.header.data_page_header.encoding = Encoding.RLE_DICTIONARY
+    sch_s = t.message(
+        "c", t.required(t.BYTE_ARRAY).as_(t.string()).named("x")
+    )
+    jobs["value"] = (vp, sch_s.columns[0], dictionary)
+
+    rates = {}
+    for cls, (page, desc, dictionary) in jobs.items():
+        nbytes = len(page.payload)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = pg.decode_data_page(
+                page, desc, CompressionCodec.UNCOMPRESSED, dictionary
+            )
+            if out.def_levels is not None:
+                # the host path's null scatter is part of the class cost
+                mask = out.def_levels == desc.max_definition_level
+                dense = np.zeros(len(mask), dtype=np.int64)
+                dense[mask] = out.values
+            best = min(best, time.perf_counter() - t0)
+        rates[cls] = nbytes / best / 1e9
+    return rates
 
 
 def arena_cap() -> int:
@@ -190,6 +315,28 @@ def _dense_byte_estimate(reader, meta, nbytes: int) -> int:
     return nbytes
 
 
+def _dict_pool_estimate(reader, meta, nbytes: int) -> int:
+    """Uncompressed dictionary-pool bytes for one chunk.  The footer
+    locates the dict page (dictionary_page_offset); its header carries
+    the EXACT uncompressed size, so read those ~30 bytes rather than
+    guessing — the chunk-wide compression ratio is dominated by the
+    repetitive index stream and badly overestimates the pool of unique
+    values.  Falls back to a third of the chunk when anything about the
+    shape surprises (auto must never fail for routing reasons)."""
+    do = meta.dictionary_page_offset
+    dp = meta.data_page_offset
+    if do is not None and dp is not None and dp > do:
+        try:
+            from ..format.parquet_thrift import PageHeader
+
+            raw = reader.source.read_at(int(do), min(int(dp - do), 256))
+            ph, _ = PageHeader.from_bytes(raw)
+            return int(ph.uncompressed_page_size or 0)
+        except Exception:
+            pass
+    return nbytes // 3
+
+
 def _field_splittable(reader, rg, chunks) -> bool:
     """Footer-cheap mirror of the engine's row-split precondition
     (``engine._read_field_row_split``): every chunk of the field has an
@@ -236,7 +383,10 @@ def estimate(reader, purpose: str = "rows", columns=None) -> EngineChoice:
     fetch_bytes = 0
     n_groups = 0
     n_cells = 0
+    n_value_cells = 0
+    pool_metas: list = []
     cap = arena_cap()
+    rates = _probe_host_rates()
     unsplit_host_s = 0.0   # device-path host fallback decode (see below)
     unsplit_bytes = 0
     for rg in reader.row_groups:
@@ -270,23 +420,37 @@ def estimate(reader, purpose: str = "rows", columns=None) -> EngineChoice:
         }
         for meta, f, nbytes, cls in chunk_rows:
             n_cells += int(meta.num_values or 0)
+            if cls == "value":
+                n_value_cells += int(meta.num_values or 0)
             if f in unsplit_fields:
-                unsplit_host_s += nbytes / (_CLASS_GBPS[cls] * 1e9)
+                unsplit_host_s += nbytes / (rates[cls] * 1e9)
                 unsplit_bytes += _dense_byte_estimate(
                     reader, meta, nbytes
                 )
             else:
                 by_class[cls] += nbytes
             if set(meta.encodings or []) & _DICT_ENCODINGS:
-                # index-form dictionary columns fetch the packed index
-                # stream + one pool per file — far fewer bytes than the
-                # gathered values (BASELINE.md "index-form dictionaries")
-                fetch_bytes += nbytes // 3
+                # index-form dictionary columns fetch one int32 index
+                # per value plus each GROUP's pool — derived from footer
+                # facts (num_values + the dict page's header size)
+                # instead of a ratio guess.  The runtime cache is
+                # content-keyed (api/reader._dict_form_cells), so
+                # repeated pools fetch once — but the footer cannot
+                # prove repetition, and a sorted/partitioned column
+                # carries a DISTINCT pool per group; charging each group
+                # keeps the estimate scaling with the real worst case
+                # while the common small-pool case stays dominated by
+                # the index term anyway
+                fetch_bytes += int(meta.num_values or 0) * 4
+                # the pool sizes need a (tiny) header read per chunk —
+                # deferred to the rows-purpose branch below, the only
+                # consumer of fetch_bytes
+                pool_metas.append((meta, nbytes))
             else:
                 fetch_bytes += nbytes
     total = sum(by_class.values())
     host_s = (
-        sum(by_class[c] / (_CLASS_GBPS[c] * 1e9) for c in _CLASS_GBPS)
+        sum(by_class[c] / (rates[c] * 1e9) for c in rates)
         + unsplit_host_s
     )
     h2d = _probe_h2d_gbps()
@@ -301,8 +465,12 @@ def estimate(reader, purpose: str = "rows", columns=None) -> EngineChoice:
         + unsplit_bytes / (h2d * 1e9)
     )
     if purpose == "rows":
-        # cell materialization differs per engine (see HOST_CELL_S note)
-        host_s += n_cells * HOST_CELL_S
+        # cell materialization differs per engine AND per column class
+        # (see the HOST_CELL_* calibration note)
+        host_s += (
+            (n_cells - n_value_cells) * HOST_CELL_VIEW_S
+            + n_value_cells * HOST_CELL_VALUE_S
+        )
         tpu_s += n_cells * DEV_CELL_S
     if unsplit_bytes:
         by_class["unsplit"] = unsplit_bytes
@@ -314,10 +482,24 @@ def estimate(reader, purpose: str = "rows", columns=None) -> EngineChoice:
     )
     if purpose == "rows" and choice.engine == "tpu":
         # the fetch term can only make the device path worse, and the
-        # D2H probe is not free — only pay it when it could flip the
-        # decision
+        # D2H probe (and the per-chunk dict-pool header reads) are not
+        # free — only pay them when they could flip the decision.  The
+        # row cursor prefetches one group ahead (api/reader._conv_fut),
+        # so the packed fetch of group i+1 overlaps the cell conversion
+        # of group i: charge only the fetch time the conversion cannot
+        # hide (this matches BASELINE.md's measured lineitem rows
+        # walls; a sum-model would misroute the headline file to host).
+        # No overlap exists for the FIRST group — scale the hideable
+        # conversion by (n_groups-1)/n_groups, so a one-group file pays
+        # the full sum
+        for meta, nbytes in pool_metas:
+            fetch_bytes += _dict_pool_estimate(reader, meta, nbytes)
         fixed, d2h_gbps = _probe_d2h_model()
-        choice.tpu_s += n_groups * fixed + fetch_bytes / (d2h_gbps * 1e9)
+        fetch_s = n_groups * fixed + fetch_bytes / (d2h_gbps * 1e9)
+        hideable = (
+            n_cells * DEV_CELL_S * (n_groups - 1) / max(n_groups, 1)
+        )
+        choice.tpu_s += max(fetch_s - hideable, 0.0)
         if choice.tpu_s >= host_s:
             choice.engine = "host"
     choice.reason = (

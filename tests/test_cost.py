@@ -52,11 +52,15 @@ def _write_dict_strings(path, n=20_000):
 
 @pytest.fixture
 def tunnel_probes(monkeypatch):
-    """Pin the link probes to the measured axon-tunnel numbers so the
-    routing decision is deterministic under test (BASELINE.md link
-    characterization: H2D 1.25 GB/s; D2H ~35 ms fixed + 11 MB/s)."""
+    """Pin the link probes to the measured axon-tunnel numbers AND the
+    host decode rates to the shipped fallback constants, so routing
+    decisions are deterministic under test (BASELINE.md link
+    characterization: H2D 1.25 GB/s; D2H ~35 ms fixed + 11 MB/s).
+    ``test_calibrated_rates_preserve_headline_routing`` covers the
+    live-calibration path separately."""
     monkeypatch.setattr(cost, "_probe_h2d_gbps", lambda: 1.25)
     monkeypatch.setattr(cost, "_probe_d2h_model", lambda: (0.035, 0.011))
+    monkeypatch.setattr(cost, "_probe_host_rates", lambda: dict(cost._CLASS_GBPS))
 
 
 def test_classify_chunk(tmp_path):
@@ -205,6 +209,81 @@ def test_estimate_accounts_for_unsplittable_fields(tmp_path, tunnel_probes,
         est_1p = cost.estimate(r, purpose="batch")
     assert est_1p.engine == "host"
     assert est_1p.bytes_by_class["unsplit"] > 0
+
+
+def test_host_rate_calibration(monkeypatch):
+    """VERDICT r4 #3: the host decode rates are measured per process
+    (real page-decode path on ~1 MiB synthetic pages), cached, ordered
+    view > levels > value, and fall back to the shipped constants when
+    the probe cannot run."""
+    monkeypatch.setattr(cost, "_host_rates", None)
+    rates = cost._probe_host_rates()
+    assert set(rates) == {"view", "levels", "value"}
+    for v in rates.values():
+        assert 1e-4 <= v <= 100.0
+    # the class ordering the whole model rests on must hold as measured
+    # (guarded like test_calibrated_rates_preserve_headline_routing: a
+    # descheduled probe rep on a loaded host is noise, not a defect)
+    if rates["view"] < 2.0:
+        pytest.skip(f"host too noisy for a meaningful probe: {rates}")
+    assert rates["view"] > rates["levels"] > rates["value"]
+    assert cost._probe_host_rates() is rates  # cached per process
+    # probe failure → shipped constants, never an error
+    monkeypatch.setattr(cost, "_host_rates", None)
+    monkeypatch.setattr(
+        cost, "_measure_host_rates",
+        lambda: (_ for _ in ()).throw(RuntimeError("no numpy")),
+    )
+    fallback = cost._probe_host_rates()
+    assert fallback == cost._CLASS_GBPS
+
+
+def test_calibrated_rates_preserve_headline_routing(tmp_path, monkeypatch):
+    """VERDICT r4 #3 done-criterion: with LIVE per-process calibration
+    (only the link probes pinned), the model still routes config #1 →
+    host and config #2 → tpu.  Skipped when the machine is too noisy to
+    measure a memcpy-class view rate (the assertion would test the
+    neighbor's load, not the model)."""
+    monkeypatch.setattr(cost, "_probe_h2d_gbps", lambda: 1.25)
+    monkeypatch.setattr(cost, "_probe_d2h_model", lambda: (0.035, 0.011))
+    monkeypatch.setattr(cost, "_host_rates", None)
+    rates = cost._probe_host_rates()
+    if rates["view"] < 2.0:
+        pytest.skip(f"host too noisy for a meaningful probe: {rates}")
+    p1 = _write_plain_int64(tmp_path / "plain.parquet", n=1_000_000)
+    p2 = _write_dict_strings(tmp_path / "dict.parquet", n=1_000_000)
+    with ParquetFileReader(p1) as r:
+        assert cost.estimate(r, purpose="rows").engine == "host"
+    with ParquetFileReader(p2) as r:
+        assert cost.estimate(r, purpose="rows").engine == "tpu"
+
+
+def test_dict_pool_estimate_from_footer(tmp_path):
+    """The dictionary fetch estimate reads the dict page header's exact
+    uncompressed size (located by the footer's offsets), not the old
+    //3 ratio guess."""
+    n = 100_000
+    p = _write_dict_strings(tmp_path / "d.parquet", n=n)
+    with ParquetFileReader(p) as r:
+        chunk = next(
+            c for c in r.row_groups[0].columns
+            if c.meta_data.path_in_schema[0] == "s"
+        )
+        meta = chunk.meta_data
+        est = cost._dict_pool_estimate(
+            r, meta, int(meta.total_uncompressed_size)
+        )
+        # real pool: 40 distinct "valNN" strings, PLAIN-encoded
+        # (4-byte length prefix + chars) — the header size is exact
+        real = sum(4 + len(f"val{i}") for i in range(40))
+        assert est == real, (est, real)
+        # offsets absent → the conservative fallback ratio
+        meta2 = type(meta)(
+            total_compressed_size=meta.total_compressed_size,
+            total_uncompressed_size=meta.total_uncompressed_size,
+            data_page_offset=meta.data_page_offset,
+        )
+        assert cost._dict_pool_estimate(r, meta2, 9000) == 3000
 
 
 def test_auto_degrades_to_host_without_x64(tmp_path, tunnel_probes, monkeypatch):

@@ -144,12 +144,19 @@ def chunked_leg(path, single_cols) -> dict:
     prev = os.environ.get("PFTPU_ARENA_CAP")
     os.environ["PFTPU_ARENA_CAP"] = str(cap)
     try:
+        import jax
+
         trace.enable()
         trace.reset()
         t0 = time.perf_counter()
         with TpuRowGroupReader(path, float64_policy="bits") as tr:
             assert tr._arena_cap == cap
             chunk_cols = tr.read_row_group(0)
+            # decode dispatches async — block before stopping the clock
+            # (the wall still includes first-use XLA compiles for the
+            # fresh chunk shapes; it is a health indicator, not a
+            # steady-state rate like the timed legs above)
+            jax.block_until_ready([c.values for c in chunk_cols.values()])
             wall = time.perf_counter() - t0
             launches = trace.stats().get("stage", {}).get("count", 0)
             trace.disable()

@@ -224,6 +224,104 @@ def measure_batch_api(path, reps=3):
     }
 
 
+def measure_write(n: int, reps: int = 3) -> dict:
+    """Write-path walls (VERDICT r4 #5): configs #1 and #2 shapes
+    through this repo's writer, single thread, against pyarrow writing
+    the SAME data with equivalent settings.  The reference publishes no
+    write numbers (its writer rides parquet-mr, reference
+    ParquetWriter.java:26-77), so pyarrow single-thread is the stated
+    proxy baseline (BASELINE.md).  Data is generated once outside the
+    timers; each wall covers encode + compress + file I/O to /tmp."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from benchmarks import workloads as w
+    from parquet_floor_tpu import ParquetFileWriter, WriterOptions, types
+    from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+    from parquet_floor_tpu.format.parquet_thrift import CompressionCodec
+
+    out = {}
+
+    def best_of(fn):
+        b = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    # --- config #1 shape: one INT64 PLAIN column, uncompressed ----------
+    rng = np.random.default_rng(0)
+    vals = rng.integers(-(2**62), 2**62, n).astype(np.int64)
+    p_ours = "/tmp/pftpu_write_cfg1.parquet"
+    p_pa = "/tmp/pftpu_write_cfg1_pa.parquet"
+    schema1 = types.message("t", types.required(types.INT64).named("v"))
+    opts1 = WriterOptions(
+        codec=CompressionCodec.UNCOMPRESSED, enable_dictionary=False,
+        page_version=2, data_page_values=100_000,
+    )
+
+    def ours1():
+        with ParquetFileWriter(p_ours, schema1, opts1) as wr:
+            wr.write_columns({"v": vals})
+
+    def pa1():
+        pq.write_table(
+            pa.table({"v": vals}), p_pa, use_dictionary=False,
+            compression="NONE", write_statistics=True,
+        )
+
+    t_ours, t_pa = best_of(ours1), best_of(pa1)
+    out["cfg1_int64_plain"] = {
+        "rows": n,
+        "pftpu_rows_per_s": round(n / t_ours, 1),
+        "pftpu_MB_per_s": round(os.path.getsize(p_ours) / t_ours / 1e6, 1),
+        "pyarrow_rows_per_s": round(n / t_pa, 1),
+        "vs_pyarrow": round(t_pa / t_ours, 3),
+        "file_mb": round(os.path.getsize(p_ours) / 1e6, 2),
+    }
+
+    # --- config #2 shape: 16-column lineitem, Snappy + dictionary -------
+    cols = w.lineitem_columns(n, seed=0)
+    p_ours = "/tmp/pftpu_write_cfg2.parquet"
+    p_pa = "/tmp/pftpu_write_cfg2_pa.parquet"
+    opts2 = WriterOptions(
+        codec=CompressionCodec.SNAPPY, page_version=2,
+        data_page_values=50_000,
+    )
+    schema2 = w.lineitem_schema()
+
+    def ours2():
+        with ParquetFileWriter(p_ours, schema2, opts2) as wr:
+            wr.write_columns(cols)
+
+    pa_cols = {
+        k: (
+            v.to_list() if isinstance(v, ByteArrayColumn)
+            else v
+        )
+        for k, v in cols.items()
+    }
+    pa_table = pa.table(pa_cols)
+
+    def pa2():
+        pq.write_table(
+            pa_table, p_pa, use_dictionary=True, compression="SNAPPY",
+        )
+
+    t_ours, t_pa = best_of(ours2), best_of(pa2)
+    out["cfg2_lineitem_snappy_dict"] = {
+        "rows": n,
+        "pftpu_rows_per_s": round(n / t_ours, 1),
+        "pftpu_MB_per_s": round(os.path.getsize(p_ours) / t_ours / 1e6, 1),
+        "pyarrow_rows_per_s": round(n / t_pa, 1),
+        "vs_pyarrow": round(t_pa / t_ours, 3),
+        "file_mb": round(os.path.getsize(p_ours) / 1e6, 2),
+    }
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
@@ -231,6 +329,9 @@ def main():
     ap.add_argument("--json", default=None)
     ap.add_argument("--rows-api", action="store_true")
     ap.add_argument("--batch-api", action="store_true")
+    ap.add_argument("--write", action="store_true",
+                    help="also time the write path (configs #1/#2 shapes "
+                         "vs pyarrow single-thread)")
     ap.add_argument(
         "--engine", dest="engines", action="append",
         choices=["host", "tpu", "auto"],
@@ -303,6 +404,18 @@ def main():
             flush=True,
         )
 
+    write_bench = None
+    if args.write:
+        write_bench = measure_write(args.rows, reps=min(args.reps, 3))
+        for cfg, r in write_bench.items():
+            print(
+                f"write {cfg}: {r['pftpu_rows_per_s']:,.0f} rows/s "
+                f"({r['pftpu_MB_per_s']:.1f} MB/s to disk) vs pyarrow "
+                f"{r['pyarrow_rows_per_s']:,.0f} rows/s "
+                f"({r['vs_pyarrow']}x)",
+                flush=True,
+            )
+
     rows_api = None
     if args.rows_api:
         rows_api = measure_rows_api(
@@ -333,6 +446,7 @@ def main():
                     "results": results,
                     "rows_api": rows_api,
                     "batch_api": batch_api,
+                    "write": write_bench,
                 },
                 f,
                 indent=2,

@@ -253,8 +253,15 @@ def encode_rle_hybrid(values: np.ndarray, bit_width: int) -> bytes:
     """Encode values as an unframed hybrid run stream.
 
     Strategy mirrors parquet-mr's writer: emit an RLE run for ≥8-long
-    repeats, otherwise accumulate bit-packed groups of 8 (padding the tail
-    group with zeros).
+    repeats, otherwise accumulate bit-packed groups of 8 (padding the
+    tail group with zeros; ≤63 groups per bit-packed header, like
+    parquet-mr's 504-value bound).
+
+    The Python loop below runs per LONG run only — spans of short runs
+    between them (the whole stream, for high-entropy dictionary
+    indices) are appended as array slices and bit-packed vectorized,
+    which is what makes the write path's index encoding O(runs) Python
+    work instead of O(values).
     """
     v = np.asarray(values, dtype=np.uint64)
     n = len(v)
@@ -268,47 +275,63 @@ def encode_rle_hybrid(values: np.ndarray, bit_width: int) -> bytes:
     starts = np.concatenate(([0], change))
     ends = np.concatenate((change, [n]))
 
-    bp_buffer = []  # values pending bit-packed emission
+    pending: list = []  # array segments queued for bit-packed emission
+    pend_n = 0
 
     def flush_bitpacked(allow_pad: bool):
-        """Emit buffered values as bit-packed groups.
-
-        Mid-stream the group count must cover *real* values only (the decoder
-        materializes groups*8 values), so padding is legal only for the final
-        run of the stream where the decoder truncates to num_values.
-        """
-        if not bp_buffer:
+        """Emit queued segments as bit-packed groups, ≤504 values per
+        header.  Mid-stream the group count must cover *real* values
+        only (the decoder materializes groups*8 values), so a non-group
+        tail stays queued unless this is the stream's final flush."""
+        nonlocal pend_n
+        if not pend_n:
             return
-        if len(bp_buffer) % 8 and not allow_pad:
-            raise AssertionError("bit-packed flush not at group boundary")
-        arr = np.array(bp_buffer, dtype=np.uint64)
-        pad = (-len(arr)) % 8
-        if pad:
-            arr = np.concatenate([arr, np.zeros(pad, dtype=np.uint64)])
-        groups = len(arr) // 8
-        _write_varint(out, (groups << 1) | 1)
-        out.extend(bit_pack(arr, bit_width))
-        bp_buffer.clear()
+        arr = (
+            np.concatenate(pending) if len(pending) > 1 else pending[0]
+        )
+        pending.clear()
+        emit_n = len(arr) if allow_pad else (len(arr) // 8) * 8
+        pos = 0
+        while pos < emit_n:
+            chunk = arr[pos : pos + min(504, emit_n - pos)]
+            pos += len(chunk)
+            pad = (-len(chunk)) % 8
+            if pad:
+                chunk = np.concatenate(
+                    [chunk, np.zeros(pad, dtype=np.uint64)]
+                )
+            _write_varint(out, (len(chunk) // 8 << 1) | 1)
+            out.extend(bit_pack(chunk, bit_width))
+        leftover = arr[emit_n:]
+        pend_n = len(leftover)
+        if pend_n:
+            pending.append(leftover)
 
-    for s, e in zip(starts, ends):
-        run_len = int(e - s)
+    long_runs = np.nonzero(ends - starts >= 8)[0]
+    prev_end = 0
+    for li in long_runs:
+        s, e = int(starts[li]), int(ends[li])
+        if s > prev_end:
+            pending.append(v[prev_end:s])
+            pend_n += s - prev_end
+        run_len = e - s
+        # Top up the pending group to an 8-boundary with this run's head.
+        fill = (-pend_n) % 8
+        if fill:
+            pending.append(np.full(fill, v[s], dtype=np.uint64))
+            pend_n += fill
+            run_len -= fill
+        flush_bitpacked(allow_pad=False)
         if run_len >= 8:
-            # Top up the pending group to an 8-boundary with this run's head.
-            fill = (-len(bp_buffer)) % 8
-            if fill:
-                bp_buffer.extend([int(v[s])] * fill)
-                run_len -= fill
-            flush_bitpacked(allow_pad=False)
-            if run_len >= 8:
-                _write_varint(out, run_len << 1)
-                out.extend(int(v[s]).to_bytes(value_bytes, "little"))
-            elif run_len:
-                bp_buffer.extend([int(v[s])] * run_len)
-        else:
-            bp_buffer.extend(int(x) for x in v[s:e])
-        # keep bit-packed run headers bounded
-        if len(bp_buffer) >= 504 and len(bp_buffer) % 8 == 0:
-            flush_bitpacked(allow_pad=False)
+            _write_varint(out, run_len << 1)
+            out.extend(int(v[s]).to_bytes(value_bytes, "little"))
+        elif run_len:
+            pending.append(np.full(run_len, v[s], dtype=np.uint64))
+            pend_n += run_len
+        prev_end = e
+    if prev_end < n:
+        pending.append(v[prev_end:])
+        pend_n += n - prev_end
     flush_bitpacked(allow_pad=True)
     return bytes(out)
 

@@ -115,7 +115,25 @@ def batch_supplier_of(obj) -> BatchHydratorSupplier:
     if callable(obj):
         class _Fn(BatchHydratorSupplier):
             def get(self, columns):
-                return obj(columns)
+                made = obj(columns)
+                # duck typing first (module contract: anything with a
+                # .batch method IS a hydrator, ABC or not — and it wins
+                # over __call__ for objects that are both)
+                if hasattr(made, "batch"):
+                    return made
+                if callable(made):
+                    # a supplier returning a per-batch FUNCTION: the
+                    # natural "factory of callables" shape — wrap it
+                    # rather than failing later with an opaque
+                    # AttributeError on .batch
+                    return FnBatchHydrator(made)
+                raise TypeError(
+                    "batch hydrator factory returned "
+                    f"{type(made).__name__}; expected a BatchHydrator "
+                    "or a (group_index, columns) callable.  Accepted "
+                    "callable shapes: columns -> BatchHydrator, or "
+                    "columns -> ((group_index, columns) -> Any)"
+                )
 
         return _Fn()
     raise TypeError(
@@ -192,7 +210,22 @@ def supplier_of(obj) -> HydratorSupplier:
     if callable(obj):
         class _Fn(HydratorSupplier):
             def get(self, columns):
-                return obj(columns)
+                made = obj(columns)
+                # same diagnostic as batch_supplier_of: fail HERE with
+                # the accepted shape, not later with an opaque
+                # AttributeError on .start deep in the read loop.
+                # Duck typing: start/add/finish is the contract, the
+                # ABC is optional
+                if all(
+                    hasattr(made, m) for m in ("start", "add", "finish")
+                ):
+                    return made
+                raise TypeError(
+                    f"hydrator factory returned {type(made).__name__}; "
+                    "expected an object with start()/add()/finish() "
+                    "(Hydrator protocol) — the factory shape is "
+                    "columns -> Hydrator"
+                )
 
         return _Fn()
     raise TypeError(f"cannot make a HydratorSupplier from {type(obj).__name__}")

@@ -365,7 +365,9 @@ def _codec_guidance(codec: int) -> str:
 # plugin signature has no level — levels are ignored for plugins).
 _LEVEL_RANGES = {
     CompressionCodec.ZSTD: (1, 22),
-    CompressionCodec.GZIP: (0, 9),
+    # 1..9 like parquet-mr: level 0 is stored-mode deflate, which would
+    # silently write uncompressed bytes under CompressionCodec.GZIP
+    CompressionCodec.GZIP: (1, 9),
     CompressionCodec.BROTLI: (0, 11),
 }
 
@@ -407,7 +409,7 @@ def compress(codec: int, data: bytes, level: Optional[int] = None) -> bytes:
     """Compress ``data`` with ``codec``.  ``level`` is the optional
     compression-level knob (parquet-mr's per-codec level config):
     honored by the BUILT-IN ZSTD (1..22, needs the zstandard wheel —
-    the store-mode fallback refuses an explicit level), GZIP (0..9),
+    the store-mode fallback refuses an explicit level), GZIP (1..9),
     and BROTLI (quality 0..11); silently ignored by level-less codecs
     (Snappy, LZ4) and by ``register_codec`` plugins (an override always
     wins over the level fast path)."""

@@ -116,6 +116,17 @@ def hadoop_decompress(
         pos += 4
         if ulen > (1 << 31):
             raise ValueError("LZO record claims > 2 GiB")
+        # bound the CUMULATIVE output before decoding the record, not
+        # just each record's claim: a hostile multi-record page must not
+        # allocate past the declared page size before the final length
+        # check fires (same amplification bound as the brotli ladder)
+        if uncompressed_size is not None and len(out) + ulen > uncompressed_size:
+            raise ValueError(
+                f"LZO records claim more than the declared "
+                f"{uncompressed_size}-byte page"
+            )
+        if uncompressed_size is None and len(out) + ulen > (1 << 31):
+            raise ValueError("LZO stream total claims > 2 GiB")
         produced = 0
         while produced < ulen:
             if pos + 4 > n:

@@ -237,3 +237,69 @@ def test_batch_stream_closes_on_generator_close(tmp_path):
     gen3 = ParquetReader.stream_batches(str(tmp_path / "missing.parquet"))
     with pytest.raises(FileNotFoundError):
         next(gen3)
+
+
+def test_batch_supplier_of_wraps_plain_callable_factory():
+    """ADVICE r4: a factory returning a per-batch FUNCTION (the exact
+    shape FnBatchHydrator exists for) is wrapped, not surfaced later as
+    an opaque AttributeError; a factory returning junk fails with a
+    TypeError naming both accepted callable shapes."""
+    import pytest
+
+    from parquet_floor_tpu.api.hydrate import (
+        BatchHydrator,
+        batch_supplier_of,
+    )
+
+    seen = []
+
+    def factory(columns):
+        def per_batch(gi, cols):
+            seen.append((gi, len(cols)))
+            return gi
+        return per_batch
+
+    sup = batch_supplier_of(factory)
+    hyd = sup.get([])
+    assert isinstance(hyd, BatchHydrator)
+    assert hyd.batch(3, ["a", "b"]) == 3
+    assert seen == [(3, 2)]
+
+    bad = batch_supplier_of(lambda columns: 42)
+    with pytest.raises(TypeError, match="BatchHydrator"):
+        bad.get([])
+
+
+def test_supplier_of_duck_typing_and_validation():
+    """Duck-typed hydrators (no ABC) pass through BOTH faces; a
+    duck-typed .batch object that is ALSO callable is used via .batch,
+    not mis-wrapped; a row-face factory returning junk fails at get()
+    with the accepted shape named."""
+    import pytest
+
+    from parquet_floor_tpu.api.hydrate import batch_supplier_of, supplier_of
+
+    class DuckBatch:  # has .batch AND __call__ — .batch must win
+        def __call__(self, *a):
+            raise AssertionError("__call__ must not be used")
+
+        def batch(self, gi, cols):
+            return ("batch", gi)
+
+    duck = DuckBatch()
+    assert batch_supplier_of(lambda cols: duck).get([]) is duck
+
+    class DuckRow:  # start/add/finish, no ABC
+        def start(self):
+            return []
+
+        def add(self, t, h, v):
+            return t
+
+        def finish(self, t):
+            return tuple(t)
+
+    row = DuckRow()
+    assert supplier_of(lambda cols: row).get([]) is row
+    with pytest.raises(TypeError, match="start\\(\\)/add\\(\\)/finish\\(\\)"):
+        supplier_of(lambda cols: 42).get([])

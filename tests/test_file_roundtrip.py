@@ -388,6 +388,12 @@ def test_codec_level_knob(tmp_path):
     with pytest.raises(ValueError, match="out of range"):
         PFW(str(tmp_path / "bad.parquet"), schema,
             WriterOptions(codec=CompressionCodec.GZIP, codec_level=12))
+    # GZIP level 0 is stored-mode deflate (no compression) — rejected
+    # like parquet-mr's 1..9 range, so nothing silently writes
+    # uncompressed bytes under CompressionCodec.GZIP (ADVICE r4)
+    with pytest.raises(ValueError, match="out of range"):
+        PFW(str(tmp_path / "bad0.parquet"), schema,
+            WriterOptions(codec=CompressionCodec.GZIP, codec_level=0))
     # a register_codec override wins over the level fast path
     from parquet_floor_tpu.format import codecs as _codecs
     calls = []

@@ -45,10 +45,37 @@ def test_hadoop_framing_single_and_multi_block():
         data, block_decompress=_fake_block_decompress
     )
     assert got == b"".join(b"".join(r) for r in payload)
-    # size check enforced
-    with pytest.raises(ValueError, match="footer said"):
+    # size bound enforced BEFORE decoding — a hostile multi-record page
+    # must not allocate past the declared page size (ADVICE r4: the
+    # same amplification bound the brotli ladder applies)
+    with pytest.raises(ValueError, match="declared"):
         lzo_codec.hadoop_decompress(
             data, uncompressed_size=1,
+            block_decompress=_fake_block_decompress,
+        )
+    # cumulative bound: record 1 alone fits the declared size, records
+    # 1+2 exceed it — the walk must stop before decoding record 2
+    first_len = sum(len(c) for c in payload[0])
+    calls = []
+
+    def counting_dec(block, hint):
+        calls.append(len(block))
+        return _fake_block_decompress(block, hint)
+
+    with pytest.raises(ValueError, match="declared"):
+        lzo_codec.hadoop_decompress(
+            data, uncompressed_size=first_len + 1,
+            block_decompress=counting_dec,
+        )
+    assert len(calls) == len(payload[0])  # record 2 never decoded
+    # a short decode that never trips the pre-bound still fails the
+    # final exact-length check
+    with pytest.raises(ValueError, match="footer said"):
+        lzo_codec.hadoop_decompress(
+            data,
+            uncompressed_size=sum(
+                len(c) for r in payload for c in r
+            ) + 5,
             block_decompress=_fake_block_decompress,
         )
 

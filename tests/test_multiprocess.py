@@ -4,12 +4,14 @@ sharded arrays (VERDICT round-2 weak #6 / next-round #5: the
 ``process_count() > 1`` branches of ``_agree_max`` and the layout
 agreement must execute, not just pass review).
 
-Each worker reshards every global column to fully-replicated and digests
-it; the test asserts the two processes report byte-identical global
-content — for a plain read (strings + nulls + ragged), a predicate read
-(partial pruning), and an all-pruned ghost read — and that the digests
-match a single-process read of the same file on this process's own
-8-device mesh (same global layout by construction).
+One spawned worker pair serves three separately-named tests (VERDICT r4
+#7: a failure pinpoints the broken path without re-paying the 2-process
+spawn): single-file sharded read, dataset assembly, and the
+``engine="tpu"`` row stream.  Each worker reshards every global column
+to fully-replicated and digests it; the tests assert the two processes
+report byte-identical global content and that the digests match a
+single-process read on this process's own 8-device mesh (same global
+layout by construction).
 """
 
 import hashlib
@@ -65,7 +67,6 @@ def _write_file(path: str) -> None:
         t.optional(t.DOUBLE).named("x"),
         t.optional(t.BYTE_ARRAY).as_(t.string()).named("s"),
     )
-    rng = np.random.default_rng(0)
     sizes = [700, 700, 650, 700, 700, 550]
     base = 0
     with ParquetFileWriter(
@@ -105,10 +106,15 @@ def _write_dataset(dir_path: str) -> list:
     return paths
 
 
-def test_two_process_sharded_read(tmp_path):
+@pytest.fixture(scope="module")
+def worker_pair(tmp_path_factory):
+    """Spawn the 2-process pair ONCE for the whole module and return
+    (report0, report1, file_path, dataset_dir)."""
+    tmp_path = tmp_path_factory.mktemp("mp")
     path = str(tmp_path / "mp.parquet")
     _write_file(path)
-    _write_dataset(str(tmp_path / "dataset"))
+    ds_dir = str(tmp_path / "dataset")
+    _write_dataset(ds_dir)
     port = _free_port()
     coord = f"127.0.0.1:{port}"
     worker = os.path.join(os.path.dirname(__file__), "multiproc_worker.py")
@@ -141,34 +147,17 @@ def test_two_process_sharded_read(tmp_path):
                 p.communicate()
     for p, log in zip(procs, logs):
         assert p.returncode == 0, f"worker failed:\n{log[-4000:]}"
-
     r0, r1 = (json.load(open(o)) for o in outs)
-    # the two processes computed byte-identical GLOBAL arrays
-    assert r0["plain"] == r1["plain"]
-    assert r0["pred"] == r1["pred"]
-    assert r0["ghost"] == r1["ghost"]
-    assert r0["num_rows"] == r1["num_rows"]
-    assert r0["num_rows_pred"] == r1["num_rows_pred"]
-    # dataset (multi-file, uneven groups-per-file) assembly agrees too
-    assert r0["dataset"] == r1["dataset"]
-    assert r0["ds_rows"] == r1["ds_rows"]
-    assert set(r0["ds_rows"].values()) == {300 + 250 + 420 + 150 + 310 + 200}
-    # the engine="tpu" row stream ran under process_count()>1 and both
-    # processes hydrated identical rows
-    assert r0["tpu_rows"] == r1["tpu_rows"]
-    assert r0["tpu_rows_n"] == r1["tpu_rows_n"] == 4000
+    return r0, r1, path, ds_dir
 
-    # and they match a single-process read of the same file on THIS
-    # process's 8-device mesh (identical global layout by construction).
-    # (_digest is duplicated here rather than imported: importing the
-    # worker module would run its env/jax.config side effects in the
-    # pytest process.)
+
+def _mesh():
     from jax.sharding import Mesh
 
-    from parquet_floor_tpu.parallel.multihost import read_sharded_global
+    return Mesh(np.array(jax.devices()).reshape(-1), ("rg",))
 
-    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("rg",))
-    out = read_sharded_global(path, mesh, float64_policy="float64")
+
+def _column_digests(out) -> str:
     dig = []
     for name in sorted(out):
         c = out[name]
@@ -178,29 +167,68 @@ def test_two_process_sharded_read(tmp_path):
             None if c.lengths is None else np.asarray(c.lengths),
             None if c.row_mask is None else np.asarray(c.row_mask),
         ))
-    assert _digest(*[d.encode() for d in dig]) == r0["plain"]
+    return _digest(*[d.encode() for d in dig])
 
-    # single-process dataset assembly matches the 2-process digest
+
+def test_two_process_single_file(worker_pair):
+    """Plain / predicate / ghost reads of ONE file: both processes
+    byte-identical, and equal to a single-process 8-device read."""
+    r0, r1, path, _ = worker_pair
+    assert r0["plain"] == r1["plain"]
+    assert r0["pred"] == r1["pred"]
+    assert r0["ghost"] == r1["ghost"]
+    assert r0["num_rows"] == r1["num_rows"]
+    assert r0["num_rows_pred"] == r1["num_rows_pred"]
+
+    # single-process read on THIS process's 8-device mesh (identical
+    # global layout by construction).  (_digest is duplicated here
+    # rather than imported: importing the worker module would run its
+    # env/jax.config side effects in the pytest process.)
+    from parquet_floor_tpu.parallel.multihost import read_sharded_global
+
+    out = read_sharded_global(path, _mesh(), float64_policy="float64")
+    assert _column_digests(out) == r0["plain"]
+
+    # totals: plain = all rows; the predicate keeps a strict non-empty
+    # subset; ghost read = every group pruned, zero rows, dtypes via
+    # schema metadata
+    total = 700 + 700 + 650 + 700 + 700 + 550
+    assert set(r0["num_rows"].values()) == {total}
+    kept = set(r0["num_rows_pred"].values())
+    assert len(kept) == 1
+    assert 0 < next(iter(kept)) < total
+    assert set(r0["ghost_rows"].values()) == {0}
+    assert r0["ghost_dtypes"]["id"] == "int64"
+    assert r0["ghost_dtypes"]["x"] == "float64"
+    assert r0["ghost_dtypes"]["s"] == "uint8"
+
+
+def test_two_process_dataset(worker_pair):
+    """Multi-file dataset assembly (uneven 2/1/3 groups per file):
+    processes agree with each other and with the single-process read."""
+    r0, r1, _, ds_dir = worker_pair
+    assert r0["dataset"] == r1["dataset"]
+    assert r0["ds_rows"] == r1["ds_rows"]
+    assert set(r0["ds_rows"].values()) == {300 + 250 + 420 + 150 + 310 + 200}
+
     from parquet_floor_tpu.parallel.multihost import read_dataset_sharded
 
     ds_paths = sorted(
-        str(tmp_path / "dataset" / f)
-        for f in os.listdir(tmp_path / "dataset")
+        os.path.join(ds_dir, f)
+        for f in os.listdir(ds_dir)
         if f.endswith(".parquet")
     )
-    out_d = read_dataset_sharded(ds_paths, mesh, float64_policy="float64")
-    dig_d = []
-    for name in sorted(out_d):
-        c = out_d[name]
-        dig_d.append(_digest(
-            None if c.values is None else np.asarray(c.values),
-            None if c.mask is None else np.asarray(c.mask),
-            None if c.lengths is None else np.asarray(c.lengths),
-            None if c.row_mask is None else np.asarray(c.row_mask),
-        ))
-    assert _digest(*[d.encode() for d in dig_d]) == r0["dataset"]
+    out_d = read_dataset_sharded(ds_paths, _mesh(), float64_policy="float64")
+    assert _column_digests(out_d) == r0["dataset"]
 
-    # single-process engine="tpu" row stream matches the workers'
+
+def test_two_process_device_row_stream(worker_pair):
+    """The engine="tpu" row stream ran under process_count()>1: both
+    processes hydrated identical rows, matching this process's stream."""
+    r0, r1, path, _ = worker_pair
+    assert r0["tpu_rows"] == r1["tpu_rows"]
+    assert r0["tpu_rows_n"] == r1["tpu_rows_n"] == 4000
+
     from parquet_floor_tpu import ParquetReader
 
     class _Rows:
@@ -223,18 +251,3 @@ def test_two_process_sharded_read(tmp_path):
         n_stream += 1
     assert h.hexdigest() == r0["tpu_rows"]
     assert n_stream == r0["tpu_rows_n"]
-
-    # totals: plain = all rows; predicate id >= 2600 keeps groups 4, 5
-    # (ids 2750.. start in group 4 at row 2750; group boundaries are the
-    # running sums of sizes: check against the footer instead of
-    # hand-counting)
-    total = 700 + 700 + 650 + 700 + 700 + 550
-    assert set(r0["num_rows"].values()) == {total}
-    kept = set(r0["num_rows_pred"].values())
-    assert len(kept) == 1
-    assert 0 < next(iter(kept)) < total
-    # ghost read: every group pruned, zero rows, dtypes via schema meta
-    assert set(r0["ghost_rows"].values()) == {0}
-    assert r0["ghost_dtypes"]["id"] == "int64"
-    assert r0["ghost_dtypes"]["x"] == "float64"
-    assert r0["ghost_dtypes"]["s"] == "uint8"

@@ -123,12 +123,27 @@ def batch_face_leg(path, reps: int, raw_engine_best: float) -> dict:
     }
 
 
-def chunked_leg(path, single_cols) -> dict:
-    """Lowered-cap chunked decode (VERDICT r4 #4): group 0 again under
-    a cap that forces >=3 launches, checked bit-exact against the
-    single-launch decode.  Runs AFTER all timing legs — the bit-exact
-    check fetches device arrays, and the first D2H degrades tunnelled
-    links process-wide (BASELINE.md link characterization)."""
+def chunked_columns(path) -> list:
+    """The chunked leg's column subset: 4 fields (mixed types) keeps
+    the forced-chunking proof while compiling 4x fewer fresh shapes
+    (each new shape costs ~seconds of XLA compile on the tunnel)."""
+    from parquet_floor_tpu.format.file_read import ParquetFileReader
+
+    with ParquetFileReader(path) as r:
+        names = []
+        for c in r.row_groups[0].columns or []:
+            f = c.meta_data.path_in_schema[0]
+            if f not in names:
+                names.append(f)
+        return names[:4]
+
+
+def chunked_leg(path, single_cols, columns) -> dict:
+    """Lowered-cap chunked decode (VERDICT r4 #4): group 0's subset
+    again under a cap that forces >=3 launches, checked bit-exact
+    against the single-launch decode.  Runs AFTER all timing legs — the
+    bit-exact check fetches device arrays, and the first D2H degrades
+    tunnelled links process-wide (BASELINE.md link characterization)."""
     import numpy as np
 
     from parquet_floor_tpu.format.file_read import ParquetFileReader
@@ -139,6 +154,7 @@ def chunked_leg(path, single_cols) -> dict:
         est = sum(
             int(c.meta_data.total_uncompressed_size or 0)
             for c in (r.row_groups[0].columns or [])
+            if c.meta_data.path_in_schema[0] in columns
         )
     cap = max(est // 4, 1 << 16)
     prev = os.environ.get("PFTPU_ARENA_CAP")
@@ -151,7 +167,7 @@ def chunked_leg(path, single_cols) -> dict:
         t0 = time.perf_counter()
         with TpuRowGroupReader(path, float64_policy="bits") as tr:
             assert tr._arena_cap == cap
-            chunk_cols = tr.read_row_group(0)
+            chunk_cols = tr.read_row_group(0, columns=columns)
             # decode dispatches async — block before stopping the clock
             # (the wall still includes first-use XLA compiles for the
             # fresh chunk shapes; it is a health indicator, not a
@@ -281,9 +297,10 @@ def main():
     # bit-exact check then fetches arrays — after every timed section,
     # because the first D2H degrades a tunnelled link process-wide
     batch = batch_face_leg(path, reps, best)
-    single_cols = reader.read_row_group(0)
+    chunk_cols_subset = chunked_columns(path)
+    single_cols = reader.read_row_group(0, columns=chunk_cols_subset)
     reader.close()
-    chunked = chunked_leg(path, single_cols)
+    chunked = chunked_leg(path, single_cols, chunk_cols_subset)
 
     result = {
         "metric": "tpch_lineitem_snappy_dict_decode",

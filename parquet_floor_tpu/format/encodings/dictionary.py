@@ -28,11 +28,49 @@ def build_dictionary(values, physical_type: int):
     encodings deterministic.
     """
     if physical_type == Type.BYTE_ARRAY or isinstance(values, ByteArrayColumn):
-        vals = (
-            values.to_list()
-            if isinstance(values, ByteArrayColumn)
-            else [bytes(v) for v in values]
-        )
+        if isinstance(values, ByteArrayColumn):
+            col, vals = values, None
+            n = len(col)
+            max_len = int(col.lengths().max()) if n else 0
+        else:
+            vals = [bytes(v) for v in values]
+            col = None
+            n = len(vals)
+            max_len = max(map(len, vals), default=0)
+        if n and max_len <= 64:
+            # vectorized dedup: each value becomes a fixed-width key of
+            # (length LE32 ‖ zero-padded content) — the explicit length
+            # disambiguates zero-padding ("a" vs "a\x00") — then one
+            # np.unique over the void view.  Bounded to short values so
+            # the (n, 4+max_len) key matrix cannot blow up on one huge
+            # outlier; dictionary-worthy columns are short-string ones
+            if col is None:
+                col = ByteArrayColumn.from_list(vals)
+            lengths = col.lengths()
+            keys = np.zeros((n, 4 + max_len), dtype=np.uint8)
+            keys[:, :4] = lengths.astype(np.uint32)[:, None].view(np.uint8).reshape(n, 4)
+            keys[:, 4:] = col.padded_matrix()
+            void = np.ascontiguousarray(keys).view(
+                np.dtype((np.void, keys.shape[1]))
+            ).reshape(-1)
+            _, idx_first, inverse = np.unique(
+                void, return_index=True, return_inverse=True
+            )
+            order = np.argsort(idx_first, kind="stable")
+            rank = np.empty_like(order)
+            rank[order] = np.arange(len(order))
+            indices = rank[inverse.reshape(-1)].astype(np.uint32)
+            uniq_rows = keys[np.sort(idx_first)]
+            uniq_lens = (
+                uniq_rows[:, :4].copy().view(np.uint32).reshape(-1)
+            )
+            uniq = [
+                uniq_rows[i, 4 : 4 + int(uniq_lens[i])].tobytes()
+                for i in range(len(uniq_rows))
+            ]
+            return ByteArrayColumn.from_list(uniq), indices
+        if vals is None:
+            vals = col.to_list()
         seen = {}
         indices = np.empty(len(vals), dtype=np.uint32)
         uniq = []

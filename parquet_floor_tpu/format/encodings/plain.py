@@ -64,6 +64,23 @@ class ByteArrayColumn:
     def lengths(self) -> np.ndarray:
         return np.diff(self.offsets)
 
+    def padded_matrix(self) -> np.ndarray:
+        """``(n, max_len)`` uint8 matrix, each row the value zero-padded
+        on the right.  Built by a ragged scatter over only the real
+        content bytes — O(total bytes) work and memory, no dense
+        (n, max_len) index intermediates (callers bound max_len, so the
+        OUTPUT matrix is small; the inputs may not be)."""
+        n = len(self)
+        lengths = self.lengths()
+        max_len = int(lengths.max()) if n else 0
+        out = np.zeros((n, max_len), dtype=np.uint8)
+        total = int(self.offsets[-1]) if n else 0
+        if total:
+            rows = np.repeat(np.arange(n), lengths)
+            pos = np.arange(total) - np.repeat(self.offsets[:-1], lengths)
+            out[rows, pos] = self.data[:total]
+        return out
+
     @classmethod
     def from_list(cls, values) -> "ByteArrayColumn":
         lengths = np.fromiter((len(v) for v in values), dtype=np.int64, count=len(values))

@@ -135,6 +135,33 @@ class ColumnData:
         return len(self.values)
 
 
+def _lex_min_max_bytearray(col: ByteArrayColumn) -> tuple:
+    """Lexicographic (min, max) of a ByteArrayColumn without
+    materializing n Python bytes objects: narrow the candidate set one
+    byte position at a time over a zero-padded content matrix (~width
+    numpy ops), breaking padded ties by length (among padded-equal
+    values the shorter is a strict prefix, hence the smaller)."""
+    n = len(col)
+    lengths = col.lengths()
+    max_len = int(lengths.max()) if n else 0
+    if max_len == 0:
+        return b"", b""
+    keys = col.padded_matrix()
+
+    def pick(reduce_fn, tie_fn):
+        cand = np.arange(n)
+        for j in range(max_len):
+            colj = keys[cand, j]
+            t = reduce_fn(colj)
+            cand = cand[colj == t]
+            if len(cand) == 1:
+                break
+        i = int(cand[tie_fn(lengths[cand])])
+        return col.data[col.offsets[i] : col.offsets[i + 1]].tobytes()
+
+    return pick(np.min, np.argmin), pick(np.max, np.argmax)
+
+
 def _min_max_bytes(descriptor: ColumnDescriptor, values) -> Optional[tuple]:
     """(min_bytes, max_bytes) per the column's sort order, or None."""
     pt = descriptor.physical_type
@@ -142,6 +169,11 @@ def _min_max_bytes(descriptor: ColumnDescriptor, values) -> Optional[tuple]:
     if n == 0:
         return None
     if isinstance(values, ByteArrayColumn):
+        lengths = values.lengths()
+        if n and int(lengths.max()) <= 256:
+            # short values (the common string-column case): vectorized
+            # — the padded matrix stays small
+            return _lex_min_max_bytearray(values)
         lst = values.to_list()
         return min(lst), max(lst)
     if pt in _NUMPY_DTYPE:

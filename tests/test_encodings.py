@@ -317,3 +317,72 @@ def test_bit_packed_legacy_page_roundtrip():
     out = pg.decode_data_page(page, desc, CompressionCodec.UNCOMPRESSED, None)
     assert out.def_levels.tolist() == defs.tolist()
     np.testing.assert_array_equal(out.values, present)
+
+
+# ------------------------------------------- vectorized dedup / stats bounds
+
+def test_build_dictionary_nul_and_size_boundaries():
+    """The vectorized string dedup's tricky cases (ADVICE/review r5):
+    embedded-NUL distinctness (b"a" vs b"a\\x00"), the 64/65-byte
+    fast-vs-fallback boundary, and list-input parity with the packed
+    column input."""
+    import numpy as np
+
+    from parquet_floor_tpu.format.encodings.dictionary import build_dictionary
+    from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+    from parquet_floor_tpu.format.parquet_thrift import Type as T
+
+    def ref(vals):
+        seen, uniq, idx = {}, [], []
+        for v in vals:
+            if v not in seen:
+                seen[v] = len(uniq)
+                uniq.append(v)
+            idx.append(seen[v])
+        return uniq, idx
+
+    nul_cases = [
+        [b"a", b"a\x00", b"a", b"a\x00\x00", b""],
+        [b"a\x00", b"a", b"\x00", b"", b"\x00\x00"],
+    ]
+    # 64 = last fast-path width; 65 = first fallback width — both must
+    # agree with the reference dedup and with each other's semantics
+    for w in (63, 64, 65):
+        nul_cases.append([b"x" * w, b"y" * w, b"x" * w, b"x" * (w - 1)])
+    for vals in nul_cases:
+        for form in (vals, ByteArrayColumn.from_list(vals)):
+            d, idx = build_dictionary(form, T.BYTE_ARRAY)
+            ru, ri = ref(vals)
+            assert d.to_list() == ru, vals
+            assert idx.tolist() == ri, vals
+    rng = np.random.default_rng(11)
+    fuzz = [
+        bytes(rng.integers(0, 3, int(rng.integers(0, 6))).astype(np.uint8))
+        for _ in range(3000)
+    ]
+    d, idx = build_dictionary(ByteArrayColumn.from_list(fuzz), T.BYTE_ARRAY)
+    ru, ri = ref(fuzz)
+    assert d.to_list() == ru and idx.tolist() == ri
+
+
+def test_string_stats_nul_tiebreak_and_gate():
+    """_lex_min_max_bytearray: padded ties break by length (b"a" <
+    b"a\\x00"), and the 256/257 vectorized-vs-fallback gate returns
+    identical stats."""
+    from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+    from parquet_floor_tpu.format.file_write import (
+        _lex_min_max_bytearray,
+        _min_max_bytes,
+    )
+    from parquet_floor_tpu.format.schema import types as t
+
+    desc = t.message(
+        "m", t.required(t.BYTE_ARRAY).as_(t.string()).named("s")
+    ).columns[0]
+    vals = [b"a\x00", b"a", b"a\x00\x01", b"b"]
+    col = ByteArrayColumn.from_list(vals)
+    assert _lex_min_max_bytearray(col) == (min(vals), max(vals))
+    for w in (255, 256, 257):  # gate straddles 256
+        vs = [b"m" * w, b"a", b"z", b"m" * (w - 1)]
+        got = _min_max_bytes(desc, ByteArrayColumn.from_list(vs))
+        assert got == (min(vs), max(vs)), w

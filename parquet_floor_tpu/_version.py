@@ -1,4 +1,4 @@
 """Single source of the package version (imported by __init__ and by the
 writer's created_by stamp without a circular import)."""
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"

@@ -56,6 +56,7 @@ from parquet_floor_tpu.format.encodings.rle_hybrid import (
 from parquet_floor_tpu.format.metadata import MAGIC, serialize_footer
 from parquet_floor_tpu.format.parquet_thrift import (
     ColumnChunk,
+    ColumnIndex,
     ColumnMetaData,
     CompressionCodec,
     ConvertedType,
@@ -65,7 +66,9 @@ from parquet_floor_tpu.format.parquet_thrift import (
     Encoding,
     FieldRepetitionType,
     FileMetaData,
+    OffsetIndex,
     PageHeader,
+    PageLocation,
     PageType,
     RowGroup,
     SchemaElement,
@@ -151,43 +154,82 @@ def _dict_page(payload: bytes, num_values: int, codec: int,
 def _write_file(path, schema_elements, chunks, num_rows):
     """Assemble one single-row-group file parquet-mr style: no page
     index, no CRCs, no column statistics, created_by stamped 1.12.2."""
+    _write_file_multi(path, schema_elements, [chunks], num_rows)
+
+
+def _write_file_multi(path, schema_elements, groups, rows_per_group):
+    """Multi-row-group assembly.  Chunks with a ``column_index``
+    attribute also get their ColumnIndex/OffsetIndex appended between
+    the data and the footer in parquet-mr's layout (all ColumnIndexes,
+    then all OffsetIndexes, offsets recorded in each ColumnChunk)."""
     buf = bytearray(MAGIC)
-    cols = []
-    total = 0
-    for ch in chunks:
-        first_off = len(buf)
-        dict_off = first_off if ch.has_dict else None
-        comp_total = 0
-        unc_total = 0
-        for hdr, payload in ch.pages:
-            buf += hdr + payload
-            comp_total += len(hdr) + len(payload)
-            # header bytes count in both totals, payloads at their
-            # uncompressed size (parquet-mr convention)
-            ph, _ = PageHeader.from_bytes(hdr)
-            unc_total += len(hdr) + ph.uncompressed_page_size
-        meta = ColumnMetaData(
-            type=ch.ptype,
-            encodings=ch.encodings,
-            path_in_schema=ch.path,
-            codec=ch.codec,
-            num_values=ch.num_values,
-            total_uncompressed_size=unc_total,
-            total_compressed_size=comp_total,
-            data_page_offset=(
-                first_off + len(ch.pages[0][0]) + len(ch.pages[0][1])
-                if ch.has_dict else first_off
-            ),
-            dictionary_page_offset=dict_off,
-        )
-        cols.append(ColumnChunk(file_offset=first_off, meta_data=meta))
-        total += comp_total
+    rgs = []
+    index_jobs = []  # (chunk_struct, ColumnIndex, [(off, size, first_row)])
+    for chunks in groups:
+        cols = []
+        total = 0
+        for ch in chunks:
+            first_off = len(buf)
+            dict_off = first_off if ch.has_dict else None
+            comp_total = 0
+            unc_total = 0
+            locs = []
+            first_rows = getattr(ch, "page_first_rows", None)
+            for pi, (hdr, payload) in enumerate(ch.pages):
+                # dict page (always pages[0] when present) never lands
+                # in the OffsetIndex — it locates DATA pages only
+                di = pi - (1 if ch.has_dict else 0)
+                if first_rows is not None and di >= 0:
+                    locs.append(
+                        (len(buf), len(hdr) + len(payload), first_rows[di])
+                    )
+                buf += hdr + payload
+                comp_total += len(hdr) + len(payload)
+                # header bytes count in both totals, payloads at their
+                # uncompressed size (parquet-mr convention)
+                ph, _ = PageHeader.from_bytes(hdr)
+                unc_total += len(hdr) + ph.uncompressed_page_size
+            meta = ColumnMetaData(
+                type=ch.ptype,
+                encodings=ch.encodings,
+                path_in_schema=ch.path,
+                codec=ch.codec,
+                num_values=ch.num_values,
+                total_uncompressed_size=unc_total,
+                total_compressed_size=comp_total,
+                data_page_offset=(
+                    first_off + len(ch.pages[0][0]) + len(ch.pages[0][1])
+                    if ch.has_dict else first_off
+                ),
+                dictionary_page_offset=dict_off,
+            )
+            cc = ColumnChunk(file_offset=first_off, meta_data=meta)
+            cols.append(cc)
+            total += comp_total
+            if getattr(ch, "column_index", None) is not None:
+                index_jobs.append((cc, ch.column_index, locs))
+        rgs.append(RowGroup(columns=cols, total_byte_size=total,
+                            num_rows=rows_per_group))
+    # parquet-mr order: ColumnIndex structs first, then OffsetIndexes
+    for cc, ci, _ in index_jobs:
+        cc.column_index_offset = len(buf)
+        blob = ci.to_bytes()
+        cc.column_index_length = len(blob)
+        buf += blob
+    for cc, _, locs in index_jobs:
+        cc.offset_index_offset = len(buf)
+        blob = OffsetIndex(page_locations=[
+            PageLocation(offset=o, compressed_page_size=s,
+                         first_row_index=fr)
+            for o, s, fr in locs
+        ]).to_bytes()
+        cc.offset_index_length = len(blob)
+        buf += blob
     fmd = FileMetaData(
         version=1,
         schema=schema_elements,
-        num_rows=num_rows,
-        row_groups=[RowGroup(columns=cols, total_byte_size=total,
-                             num_rows=num_rows)],
+        num_rows=rows_per_group * len(groups),
+        row_groups=rgs,
         created_by=CREATED_BY,
     )
     buf += serialize_footer(fmd)
@@ -355,11 +397,91 @@ def make_v2_delta_snappy(path):
     return {"id": ids.tolist(), "name": names}
 
 
+def make_pageindex_bss_lz4(path):
+    """parquet-mr 1.12 writes the page index BY DEFAULT — this file has
+    ColumnIndex + OffsetIndex (the only corpus entry that does), two
+    row groups, BYTE_STREAM_SPLIT floats and an optional PLAIN INT32,
+    all under parquet's legacy Hadoop-framed LZ4.  The float pages are
+    VALUE-DISJOINT (page p of group g spans [g*10000+p*1000,
+    +100) plus fraction) so ColumnIndex min/max page pruning is
+    testable against them."""
+    from parquet_floor_tpu.format.encodings.byte_stream_split import (
+        encode_byte_stream_split,
+    )
+
+    rng = np.random.default_rng(17)
+    groups = []
+    expected_f: list = []
+    expected_o: list = []
+    for g in range(2):
+        f_vals = (
+            g * 10_000
+            + np.repeat(np.arange(3), 100) * 1000
+            + np.tile(np.arange(100), 3)
+            + np.round(rng.random(300), 3)
+        ).astype(np.float32)
+        o_vals = [
+            None if i % 5 == g else int(i + 1000 * g) for i in range(300)
+        ]
+        expected_f.extend(float(v) for v in f_vals)
+        expected_o.extend(o_vals)
+        # f: 3 pages of 100 values, BYTE_STREAM_SPLIT + LZ4(hadoop)
+        f_pages, f_locs, f_mins, f_maxs = [], [], [], []
+        for p in range(3):
+            chunk_vals = f_vals[p * 100 : (p + 1) * 100]
+            payload = encode_byte_stream_split(chunk_vals)
+            hdr, comp = _v1_page(payload, 100, Encoding.BYTE_STREAM_SPLIT,
+                                 CompressionCodec.LZ4)
+            f_pages.append((hdr, comp))
+            f_locs.append(p * 100)
+            f_mins.append(np.float32(chunk_vals.min()).tobytes())
+            f_maxs.append(np.float32(chunk_vals.max()).tobytes())
+        fc = _Chunk(["f"], Type.FLOAT, f_pages,
+                    [Encoding.BYTE_STREAM_SPLIT, Encoding.RLE],
+                    CompressionCodec.LZ4, 300)
+        fc.page_first_rows = f_locs
+        fc.column_index = ColumnIndex(
+            null_pages=[False] * 3, min_values=f_mins, max_values=f_maxs,
+            boundary_order=0, null_counts=[0, 0, 0],
+        )
+        # o: optional INT32, single page, RLE def levels
+        defs = np.array([0 if v is None else 1 for v in o_vals], np.uint32)
+        present = np.array([v for v in o_vals if v is not None], np.int32)
+        payload = (
+            encode_length_prefixed(defs, 1)
+            + encode_plain(present, Type.INT32)
+        )
+        hdr, comp = _v1_page(payload, 300, Encoding.PLAIN,
+                             CompressionCodec.LZ4)
+        oc = _Chunk(["o"], Type.INT32, [(hdr, comp)],
+                    [Encoding.PLAIN, Encoding.RLE],
+                    CompressionCodec.LZ4, 300)
+        oc.page_first_rows = [0]
+        oc.column_index = ColumnIndex(
+            null_pages=[False],
+            min_values=[np.int32(present.min()).tobytes()],
+            max_values=[np.int32(present.max()).tobytes()],
+            boundary_order=0,
+            null_counts=[int((defs == 0).sum())],
+        )
+        groups.append([fc, oc])
+    schema = [
+        SchemaElement(name="m", num_children=2),
+        SchemaElement(name="f", type=Type.FLOAT,
+                      repetition_type=FieldRepetitionType.REQUIRED),
+        SchemaElement(name="o", type=Type.INT32,
+                      repetition_type=FieldRepetitionType.OPTIONAL),
+    ]
+    _write_file_multi(path, schema, groups, rows_per_group=300)
+    return {"f": expected_f, "o": expected_o}
+
+
 BUILDERS = {
     "mr_legacy_2level_list.parquet": make_legacy_2level_list,
     "mr_bitpacked_levels.parquet": make_bitpacked_levels,
     "mr_int96_dict_gzip.parquet": make_int96_dict_gzip,
     "mr_v2_delta_snappy.parquet": make_v2_delta_snappy,
+    "mr_pageindex_bss_lz4.parquet": make_pageindex_bss_lz4,
 }
 
 # Files pyarrow cannot oracle (see the builder's comment for why); they

@@ -271,4 +271,4 @@ def test_foreign_column_index_prunes_pages():
         assert rr == [(200, 300)], rr
         # group 1 spans [10000..12100): every page matches
         rr1 = pred.row_ranges(r, 1)
-        assert rr1 is None or rr1 == [(0, 300)], rr1
+        assert rr1 == [(0, 300)], rr1

@@ -84,13 +84,19 @@ class ByteArrayColumn:
     @classmethod
     def from_list(cls, values) -> "ByteArrayColumn":
         lengths = np.fromiter((len(v) for v in values), dtype=np.int64, count=len(values))
-        offsets = np.zeros(len(values) + 1, dtype=np.int64)
-        np.cumsum(lengths, out=offsets[1:])
         pool = (
             np.frombuffer(b"".join(values), dtype=np.uint8)
             if len(values)
             else np.zeros(0, np.uint8)
         )
+        return cls.from_pool(lengths, pool)
+
+    @classmethod
+    def from_pool(cls, lengths: np.ndarray, pool: np.ndarray) -> "ByteArrayColumn":
+        """Build from per-value byte lengths + the already-concatenated
+        pool (offsets derived here, the one place that owns them)."""
+        offsets = np.zeros(len(lengths) + 1, dtype=np.int64)
+        np.cumsum(lengths, out=offsets[1:])
         return cls(offsets, pool)
 
     def __eq__(self, other):

@@ -934,6 +934,25 @@ def _coerce_values(desc: ColumnDescriptor, items):
     if pt == Type.BYTE_ARRAY:
         if isinstance(items, ByteArrayColumn):
             return items
+        if items and type(items) is list and type(items[0]) is str:
+            # all-str fast path: one C-level join+encode instead of n
+            # encode calls.  Pure-ASCII pools have per-value byte
+            # lengths equal to the str lengths (one cheap len() each);
+            # a multibyte pool (isascii scan, no wasted encode) or a
+            # mixed str/bytes list (join raises) falls through to the
+            # loop
+            try:
+                joined = "".join(items)
+            except TypeError:
+                joined = None
+            if joined is not None and joined.isascii():
+                lengths = np.fromiter(
+                    map(len, items), dtype=np.int64, count=len(items)
+                )
+                return ByteArrayColumn.from_pool(
+                    lengths,
+                    np.frombuffer(joined.encode(), dtype=np.uint8),
+                )
         enc = [
             v.encode("utf-8") if isinstance(v, str) else bytes(v) for v in items
         ]

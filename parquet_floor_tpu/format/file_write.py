@@ -934,7 +934,7 @@ def _coerce_values(desc: ColumnDescriptor, items):
     if pt == Type.BYTE_ARRAY:
         if isinstance(items, ByteArrayColumn):
             return items
-        if items and type(items) is list and type(items[0]) is str:
+        if type(items) is list and items and type(items[0]) is str:
             # all-str fast path: one C-level join+encode instead of n
             # encode calls.  Pure-ASCII pools have per-value byte
             # lengths equal to the str lengths (one cheap len() each);

@@ -620,3 +620,23 @@ def test_byte_based_page_and_group_thresholds(tmp_path):
         for gi in range(len(r.row_groups)):
             total += r.read_row_group(gi).num_rows
     assert total == n
+
+
+def test_write_numpy_string_array_column(tmp_path):
+    """Regression (round 5): a numpy array of strings through the
+    BYTE_ARRAY coercion path — the fast-path guard must type-check
+    BEFORE truthiness ('if items' on an ndarray raises the ambiguous
+    truth-value error)."""
+    import numpy as np
+    import pyarrow.parquet as pq
+
+    from parquet_floor_tpu import ParquetFileWriter, WriterOptions, types
+
+    vals = np.array(["alpha", "beta", "gamma", "delta"] * 50)
+    schema = types.message(
+        "m", types.required(types.BYTE_ARRAY).as_(types.string()).named("s")
+    )
+    p = str(tmp_path / "npstr.parquet")
+    with ParquetFileWriter(p, schema, WriterOptions()) as w:
+        w.write_columns({"s": vals})
+    assert pq.read_table(p).column("s").to_pylist() == vals.tolist()

@@ -38,6 +38,16 @@ MR_FILES = sorted(
 )
 
 
+def corpus_paths() -> list:
+    """Every golden binary, all provenance classes — the ONE corpus
+    enumeration (other test modules reuse it, e.g. the corruption
+    fuzz in test_robustness)."""
+    return (
+        [os.path.join(GOLDEN, "parquet-cpp", f) for f in CPP_FILES]
+        + [os.path.join(GOLDEN, f) for f in MR_FILES]
+    )
+
+
 def _host_cells(path):
     """Decode every column with the host engine into plain pylists:
     numbers (None for nulls), ``bytes`` for binary-ish leaves, nested

@@ -224,3 +224,29 @@ def test_tpu_row_api_on_corrupt_file_raises_wrapped(tmp_path, monkeypatch):
         with pytest.raises(Exception):
             for _ in ParquetReader.stream_batches(str(bad), engine=engine):
                 pass
+
+
+def test_golden_corpus_corruption_never_hangs(tmp_path):
+    """Bit-flip fuzz over the THIRD-PARTY golden binaries (foreign
+    writer conventions: PLAIN_DICTIONARY stamps, legacy lists,
+    BIT_PACKED levels, foreign page indexes): decode must either
+    succeed or raise a Python exception — never deadlock or kill the
+    process.  Same stance as test_bit_flips_never_hang_or_crash, on
+    bytes this repo's writer never produced."""
+    from test_golden import corpus_paths
+
+    paths = corpus_paths()
+    assert paths, "golden corpus missing"
+    rng = np.random.default_rng(23)
+    for path in paths:
+        data = bytearray(open(path, "rb").read())
+        for _ in range(15):
+            pos = int(rng.integers(0, len(data)))
+            old = data[pos]
+            data[pos] ^= 0xFF
+            try:
+                _full_decode(bytes(data), tmp_path)
+            except Exception:
+                pass  # clean failure is the acceptable outcome
+            finally:
+                data[pos] = old

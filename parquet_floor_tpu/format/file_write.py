@@ -137,23 +137,33 @@ class ColumnData:
 
 def _lex_min_max_bytearray(col: ByteArrayColumn) -> tuple:
     """Lexicographic (min, max) of a ByteArrayColumn without
-    materializing n Python bytes objects: narrow the candidate set one
-    byte position at a time over a zero-padded content matrix (~width
-    numpy ops), breaking padded ties by length (among padded-equal
-    values the shorter is a strict prefix, hence the smaller)."""
+    materializing n Python bytes objects OR a padded matrix: narrow
+    the candidate set one byte position at a time, gathering only the
+    candidates' byte at that position (values past their length read
+    as 0 — same zero-pad semantics as ``padded_matrix``), breaking
+    padded ties by length (among padded-equal values the shorter is a
+    strict prefix, hence the smaller).  Typically the candidate set
+    collapses to a handful after 2-3 positions (~O(n) total); a low-
+    cardinality column whose candidates never shrink degrades to
+    O(n * max_len) gathers — which is why the caller gates this path
+    to short values."""
     n = len(col)
     lengths = col.lengths()
     max_len = int(lengths.max()) if n else 0
     if max_len == 0:
         return b"", b""
-    keys = col.padded_matrix()
 
     def pick(reduce_fn, tie_fn):
         cand = np.arange(n)
         for j in range(max_len):
-            colj = keys[cand, j]
-            t = reduce_fn(colj)
-            cand = cand[colj == t]
+            lens_c = lengths[cand]
+            vals_j = np.zeros(len(cand), dtype=np.uint8)
+            alive = lens_c > j
+            if not alive.any():
+                break
+            vals_j[alive] = col.data[col.offsets[cand[alive]] + j]
+            t = reduce_fn(vals_j)
+            cand = cand[vals_j == t]
             if len(cand) == 1:
                 break
         i = int(cand[tie_fn(lengths[cand])])
@@ -171,9 +181,11 @@ def _min_max_bytes(descriptor: ColumnDescriptor, values) -> Optional[tuple]:
     if isinstance(values, ByteArrayColumn):
         lengths = values.lengths()
         if n and int(lengths.max()) <= 256:
-            # short values (the common string-column case): vectorized
-            # — the padded matrix stays small
+            # short values (the common string-column case): the lazy
+            # narrowing scan's O(n * max_len) WORST case (constant
+            # columns never shrink the candidate set) stays bounded
             return _lex_min_max_bytearray(values)
+        # long values: per-value Python cost amortizes over the bytes
         lst = values.to_list()
         return min(lst), max(lst)
     if pt in _NUMPY_DTYPE:

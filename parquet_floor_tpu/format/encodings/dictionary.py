@@ -31,11 +31,28 @@ def build_dictionary(values, physical_type: int):
         if isinstance(values, ByteArrayColumn):
             col, vals = values, None
             n = len(col)
-            max_len = int(col.lengths().max()) if n else 0
         else:
             vals = [bytes(v) for v in values]
             col = None
             n = len(vals)
+        if n:
+            # native O(n) hash dedup when the C++ runtime is loaded —
+            # any value length, no padded keys, no sort
+            from ...native import binding as _nat
+
+            if _nat.available():
+                if col is None:
+                    col = ByteArrayColumn.from_list(vals)
+                indices, uniq_ids = _nat.dedup_bytes(col.offsets, col.data)
+                uniq = [
+                    col.data[col.offsets[i] : col.offsets[i + 1]].tobytes()
+                    for i in uniq_ids
+                ]
+                return ByteArrayColumn.from_list(uniq), indices
+        # numpy fallback (no native runtime); max_len only matters here
+        if col is not None:
+            max_len = int(col.lengths().max()) if n else 0
+        else:
             max_len = max(map(len, vals), default=0)
         if n and max_len <= 64:
             # vectorized dedup: each value becomes a fixed-width key of

@@ -173,6 +173,12 @@ def _register(lib):
         ctypes.c_longlong,                  # num_values
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_size_t,  # out, cap pages
     ]
+    lib.pftpu_dedup_bytes.restype = ctypes.c_ssize_t
+    lib.pftpu_dedup_bytes.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,   # offsets, n
+        ctypes.c_void_p,                    # pool
+        ctypes.c_void_p, ctypes.c_void_p,   # indices out, uniq_ids out
+    ]
     return lib
 
 
@@ -556,3 +562,27 @@ def delta_parse_plan(data, value_bytes: int, allow_wide: bool):
             "end_pos": int(scalars[3]),
             "wide": bool(scalars[4]),
         }
+
+
+def dedup_bytes(offsets, pool):
+    """First-appearance dedup of byte slices (the writer's dictionary
+    build): ``offsets`` int64[n+1] delimits value i in the uint8
+    ``pool``.  Returns ``(indices uint32[n], uniq_ids int64[k])`` —
+    per-value first-appearance rank and the value index of each
+    distinct slice in first-appearance order.  O(n) hash table in C vs
+    the NumPy fallback's padded-key sort."""
+    import numpy as np
+
+    lib = _load()
+    n = len(offsets) - 1
+    indices = np.empty(n, dtype=np.uint32)
+    uniq_ids = np.empty(max(n, 1), dtype=np.int64)
+    off = np.ascontiguousarray(offsets, dtype=np.int64)
+    pl = np.ascontiguousarray(pool, dtype=np.uint8)
+    k = lib.pftpu_dedup_bytes(
+        off.ctypes.data, n, pl.ctypes.data,
+        indices.ctypes.data, uniq_ids.ctypes.data,
+    )
+    if k < 0:
+        raise MemoryError("native dedup_bytes: allocation failed")
+    return indices, uniq_ids[:k].copy()

@@ -10,6 +10,7 @@
 
 #include <cstddef>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 
 extern "C" {
@@ -664,6 +665,63 @@ ptrdiff_t pftpu_plain_ba_scan(const uint8_t* data, size_t data_len,
     n++;
   }
   return n;
+}
+
+// ---------------------------------------------------------------------------
+// First-appearance dedup of byte slices (the writer's dictionary build):
+// offsets[n+1] delimit value i as pool[offsets[i]..offsets[i+1]).  Open-
+// addressing FNV-1a hash table keyed by slice content; O(n) expected vs
+// the NumPy path's padded-key sort.  Writes indices[n] (first-appearance
+// rank per value) and uniq_ids (value index of each distinct slice, in
+// first-appearance order).  Returns the distinct count, or -1 on
+// allocation failure.
+// ---------------------------------------------------------------------------
+
+static inline uint64_t pftpu_fnv1a(const uint8_t* p, size_t len) {
+  uint64_t h = 1469598103934665603ull;
+  for (size_t i = 0; i < len; i++) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+ptrdiff_t pftpu_dedup_bytes(const long long* offsets, size_t n,
+                            const uint8_t* pool, uint32_t* indices,
+                            long long* uniq_ids) {
+  if (n == 0) return 0;
+  size_t cap = 16;
+  while (cap < n * 2) cap <<= 1;
+  long long* table = static_cast<long long*>(
+      std::malloc(cap * sizeof(long long)));
+  if (table == nullptr) return -1;
+  for (size_t i = 0; i < cap; i++) table[i] = -1;
+  long long n_uniq = 0;
+  const size_t mask = cap - 1;
+  for (size_t i = 0; i < n; i++) {
+    const uint8_t* p = pool + offsets[i];
+    const size_t len = static_cast<size_t>(offsets[i + 1] - offsets[i]);
+    size_t slot = static_cast<size_t>(pftpu_fnv1a(p, len)) & mask;
+    for (;;) {
+      long long j = table[slot];
+      if (j < 0) {
+        table[slot] = static_cast<long long>(i);
+        uniq_ids[n_uniq] = static_cast<long long>(i);
+        indices[i] = static_cast<uint32_t>(n_uniq);
+        n_uniq++;
+        break;
+      }
+      const size_t jlen =
+          static_cast<size_t>(offsets[j + 1] - offsets[j]);
+      if (jlen == len && std::memcmp(pool + offsets[j], p, len) == 0) {
+        indices[i] = indices[j];
+        break;
+      }
+      slot = (slot + 1) & mask;
+    }
+  }
+  std::free(table);
+  return n_uniq;
 }
 
 // ---------------------------------------------------------------------------

@@ -321,16 +321,25 @@ def test_bit_packed_legacy_page_roundtrip():
 
 # ------------------------------------------- vectorized dedup / stats bounds
 
-def test_build_dictionary_nul_and_size_boundaries():
-    """The vectorized string dedup's tricky cases (ADVICE/review r5):
-    embedded-NUL distinctness (b"a" vs b"a\\x00"), the 64/65-byte
-    fast-vs-fallback boundary, and list-input parity with the packed
-    column input."""
+@pytest.mark.parametrize("native", [True, False])
+def test_build_dictionary_nul_and_size_boundaries(native, monkeypatch):
+    """The string dedup's tricky cases (ADVICE/review r5) on BOTH
+    implementations — the native O(n) hash table and the numpy padded-
+    key fallback (which must stay correct for environments without the
+    C++ runtime): embedded-NUL distinctness (b"a" vs b"a\\x00"), the
+    numpy path's 64/65-byte fast-vs-fallback boundary, and list-input
+    parity with the packed column input."""
     import numpy as np
 
     from parquet_floor_tpu.format.encodings.dictionary import build_dictionary
     from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
     from parquet_floor_tpu.format.parquet_thrift import Type as T
+    from parquet_floor_tpu.native import binding
+
+    if native and not binding.available():
+        pytest.skip("native runtime not built")
+    if not native:
+        monkeypatch.setattr(binding, "available", lambda: False)
 
     def ref(vals):
         seen, uniq, idx = {}, [], []

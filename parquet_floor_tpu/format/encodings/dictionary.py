@@ -100,6 +100,24 @@ def build_dictionary(values, physical_type: int):
             indices[i] = j
         return ByteArrayColumn.from_list(uniq), indices
     arr = np.asarray(values)
+    from ...native import binding as _nat
+
+    if _nat.available() and len(arr):
+        # the byte-slice hash dedup handles fixed-width values too:
+        # synthetic offsets stride the flattened little-endian bytes
+        flat = np.ascontiguousarray(arr)
+        width = flat.itemsize * (
+            flat.shape[1] if flat.ndim == 2 else 1
+        )
+        offsets = np.arange(len(arr) + 1, dtype=np.int64) * width
+        indices, uniq_ids = _nat.dedup_bytes(
+            offsets, flat.view(np.uint8).reshape(-1)
+        )
+        return arr[uniq_ids], indices
+    # Both paths dedup fixed-width values by their raw BITS — floats
+    # keep -0.0 distinct from 0.0 and distinct NaN payloads apart, so
+    # the decoded column is bit-exact and the file does not depend on
+    # whether the native runtime was present at write time.
     if physical_type == Type.FIXED_LEN_BYTE_ARRAY or physical_type == Type.INT96:
         # (n, width) uint8 rows
         uniq, inverse = np.unique(arr, axis=0, return_inverse=True)
@@ -110,11 +128,16 @@ def build_dictionary(values, physical_type: int):
         rank = np.empty_like(order)
         rank[order] = np.arange(len(order))
         return uniq[order], rank[inverse].astype(np.uint32)
-    uniq, idx_first, inverse = np.unique(arr, return_index=True, return_inverse=True)
+    key = (
+        arr.view(f"u{arr.itemsize}") if arr.dtype.kind == "f" else arr
+    )
+    _, idx_first, inverse = np.unique(
+        key, return_index=True, return_inverse=True
+    )
     order = np.argsort(idx_first, kind="stable")
     rank = np.empty_like(order)
     rank[order] = np.arange(len(order))
-    return uniq[order], rank[inverse.reshape(-1)].astype(np.uint32)
+    return arr[idx_first[order]], rank[inverse.reshape(-1)].astype(np.uint32)
 
 
 def encode_dictionary_page(dictionary, physical_type: int, type_length=None) -> bytes:

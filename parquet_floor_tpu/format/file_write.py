@@ -919,7 +919,6 @@ def make_column_data(desc: ColumnDescriptor, data) -> ColumnData:
     if isinstance(data, ByteArrayColumn):
         return ColumnData(desc, data)
     items = list(data) if not isinstance(data, np.ndarray) else data
-    has_none = any(v is None for v in items) if not isinstance(items, np.ndarray) else False
     if desc.max_definition_level > 0:
         if isinstance(items, np.ndarray):
             mask = np.zeros(len(items), dtype=bool)
@@ -932,8 +931,18 @@ def make_column_data(desc: ColumnDescriptor, data) -> ColumnData:
         ).astype(np.uint32)
         values = _coerce_values(desc, present)
         return ColumnData(desc, values, def_levels=def_levels)
-    if has_none:
-        raise ValueError(f"required column {desc.path} contains None")
+    # required column: the None check is only needed on THIS branch
+    # (nullable columns derive it from the mask above).  C-speed
+    # membership scan (identity shortcut per element); an exotic
+    # element whose __eq__ raises falls back to the identity-only
+    # generator
+    if not isinstance(items, np.ndarray):
+        try:
+            has_none = None in items
+        except Exception:
+            has_none = any(v is None for v in items)
+        if has_none:
+            raise ValueError(f"required column {desc.path} contains None")
     return ColumnData(desc, _coerce_values(desc, items))
 
 

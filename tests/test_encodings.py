@@ -395,3 +395,30 @@ def test_string_stats_nul_tiebreak_and_gate():
         vs = [b"m" * w, b"a", b"z", b"m" * (w - 1)]
         got = _min_max_bytes(desc, ByteArrayColumn.from_list(vs))
         assert got == (min(vs), max(vs)), w
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_build_dictionary_numeric_bits_dedup(native, monkeypatch):
+    """Fixed-width dictionary builds dedup by raw BITS on both
+    implementations: -0.0 stays distinct from 0.0 and distinct NaN
+    payloads stay apart, so the file bytes do not depend on whether
+    the native runtime was present at write time."""
+    from parquet_floor_tpu.native import binding
+
+    if native and not binding.available():
+        pytest.skip("native runtime not built")
+    if not native:
+        monkeypatch.setattr(binding, "available", lambda: False)
+    nan2 = np.frombuffer(
+        np.uint64(0x7FF8000000000001).tobytes(), dtype=np.float64
+    )[0]
+    arr = np.array([0.0, -0.0, 1.5, np.nan, 1.5, -0.0, nan2], np.float64)
+    d, idx = build_dictionary(arr, Type.DOUBLE)
+    assert len(d) == 5  # 0.0, -0.0, 1.5, nan, nan2 all distinct
+    np.testing.assert_array_equal(
+        np.asarray(d).view(np.uint64)[idx], arr.view(np.uint64)
+    )
+    iv = np.array([5, 3, 5, 7, 3], np.int64)
+    d2, idx2 = build_dictionary(iv, Type.INT64)
+    assert d2.tolist() == [5, 3, 7]
+    np.testing.assert_array_equal(np.asarray(d2)[idx2], iv)

@@ -282,7 +282,11 @@ def encode_rle_hybrid(values: np.ndarray, bit_width: int) -> bytes:
         """Emit queued segments as bit-packed groups, ≤504 values per
         header.  Mid-stream the group count must cover *real* values
         only (the decoder materializes groups*8 values), so a non-group
-        tail stays queued unless this is the stream's final flush."""
+        tail stays queued unless this is the stream's final flush.
+        Each group of 8 packs to exactly ``bit_width`` bytes, so the
+        whole buffer packs in ONE bit_pack call and the ≤63-group
+        chunks are byte-aligned slices of it — identical bytes to
+        per-chunk packing without the per-chunk call overhead."""
         nonlocal pend_n
         if not pend_n:
             return
@@ -291,17 +295,31 @@ def encode_rle_hybrid(values: np.ndarray, bit_width: int) -> bytes:
         )
         pending.clear()
         emit_n = len(arr) if allow_pad else (len(arr) // 8) * 8
-        pos = 0
-        while pos < emit_n:
-            chunk = arr[pos : pos + min(504, emit_n - pos)]
-            pos += len(chunk)
-            pad = (-len(chunk)) % 8
+        # pack in macro-blocks (a multiple of 504 AND 8) so the win
+        # over per-chunk packing keeps, while bit_pack's (block, bw)
+        # uint64 intermediates stay a few MB instead of scaling with
+        # the whole span
+        BLOCK = 504 * 128
+        base = 0
+        while base < emit_n:
+            block_n = min(BLOCK, emit_n - base)
+            padded = arr[base : base + block_n]
+            pad = (-block_n) % 8
             if pad:
-                chunk = np.concatenate(
-                    [chunk, np.zeros(pad, dtype=np.uint64)]
+                padded = np.concatenate(
+                    [padded, np.zeros(pad, dtype=np.uint64)]
                 )
-            _write_varint(out, (len(chunk) // 8 << 1) | 1)
-            out.extend(bit_pack(chunk, bit_width))
+            packed = bit_pack(padded, bit_width)
+            pos = 0
+            byte_pos = 0
+            while pos < block_n:
+                take = min(504, block_n - pos)
+                groups = (take + 7) // 8
+                _write_varint(out, (groups << 1) | 1)
+                out.extend(packed[byte_pos : byte_pos + groups * bit_width])
+                pos += take
+                byte_pos += groups * bit_width
+            base += block_n
         leftover = arr[emit_n:]
         pend_n = len(leftover)
         if pend_n:

@@ -6,6 +6,15 @@ Hydrator/Dehydrator API over a from-scratch Parquet format engine, with the
 columnar decode hot path offloaded to TPU kernels.
 """
 
+from .errors import (
+    ChecksumMismatchError,
+    CorruptFooterError,
+    CorruptPageError,
+    IoRetryExhaustedError,
+    ParquetError,
+    TruncatedFileError,
+    UnsupportedFeatureError,
+)
 from .format.schema import (
     ColumnDescriptor,
     GroupType,
@@ -17,7 +26,12 @@ from .format.schema import (
 from .format.parquet_thrift import CompressionCodec, Encoding, Type
 from .format.codecs import UnsupportedCodec, register_codec
 from .format.metadata import ParquetMetadata
-from .format.file_read import ParquetFileReader
+from .format.file_read import (
+    ParquetFileReader,
+    ReaderOptions,
+    SalvageReport,
+    SalvageSkip,
+)
 from .format.file_write import ColumnData, ParquetFileWriter, WriterOptions
 from .api.hydrate import (
     BatchHydrator,
@@ -37,25 +51,33 @@ from .utils import trace
 from ._version import __version__  # noqa: F401  (re-export)
 
 __all__ = [
-    "BatchColumn", "BatchHydrator", "BatchHydratorSupplier", "ColumnData",
-    "ColumnDescriptor", "CompressionCodec", "Dehydrator",
+    "BatchColumn", "BatchHydrator", "BatchHydratorSupplier",
+    "ChecksumMismatchError", "ColumnData",
+    "ColumnDescriptor", "CompressionCodec", "CorruptFooterError",
+    "CorruptPageError", "Dehydrator",
     "DeviceColumn", "Encoding", "GroupType", "Hydrator", "HydratorSupplier",
-    "LogicalAnnotation", "MessageType", "NestedColumn", "ParquetFileReader",
+    "IoRetryExhaustedError",
+    "LogicalAnnotation", "MessageType", "NestedColumn", "ParquetError",
+    "ParquetFileReader",
     "ParquetFileWriter", "ParquetMetadata", "ParquetReader", "ParquetWriter",
-    "Predicate", "PrimitiveType", "TpuRowGroupReader", "Type",
-    "UnsupportedCodec", "assemble_nested", "batch_to_arrow", "col",
-    "read_sharded_global", "register_codec", "shred_nested", "trace",
-    "types", "ValueWriter", "WriterOptions",
+    "Predicate", "PrimitiveType", "ReaderOptions", "SalvageReport",
+    "SalvageSkip", "TpuRowGroupReader", "TruncatedFileError", "Type",
+    "UnsupportedCodec", "UnsupportedFeatureError",
+    "assemble_nested", "batch_to_arrow", "col",
+    "read_sharded_global", "register_codec", "shred_nested", "testing",
+    "trace", "types", "ValueWriter", "WriterOptions",
 ]
 
 _LAZY = {
     # the TPU engine (and jax with it) loads only on first use, keeping
-    # plain format/API imports light
+    # plain format/API imports light; the fault-injection harness
+    # (parquet_floor_tpu.testing) likewise loads only when asked for
     "TpuRowGroupReader": ("parquet_floor_tpu.tpu.engine", "TpuRowGroupReader"),
     "DeviceColumn": ("parquet_floor_tpu.tpu.engine", "DeviceColumn"),
     "read_sharded_global": (
         "parquet_floor_tpu.parallel.multihost", "read_sharded_global",
     ),
+    "testing": ("parquet_floor_tpu.testing", None),
 }
 
 
@@ -65,7 +87,8 @@ def __getattr__(name):
         raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
     import importlib
 
-    value = getattr(importlib.import_module(target[0]), target[1])
+    module = importlib.import_module(target[0])
+    value = module if target[1] is None else getattr(module, target[1])
     globals()[name] = value
     return value
 

@@ -34,7 +34,7 @@ from typing import Any, Iterator, List, Optional, Sequence, Set
 import numpy as np
 
 from ..batch.columns import ColumnBatch, RowGroupBatch
-from ..format.file_read import ParquetFileReader
+from ..format.file_read import ParquetFileReader, ReaderOptions
 from ..format.metadata import ParquetMetadata
 from ..format.parquet_thrift import Type
 from ..format.schema import ColumnDescriptor
@@ -61,6 +61,59 @@ def _check_dataset_schema(state: dict, schema, file_index: int) -> None:
             f"dataset file {file_index} disagrees with the first file's "
             "schema"
         )
+
+
+def _resolve_engine(engine: str, reader: ParquetFileReader, purpose: str,
+                    columns, options: Optional[ReaderOptions]) -> str:
+    """Resolve host|tpu|auto for one open file, honoring the robustness
+    contract: ``verify_crc``/``salvage`` only exist on the host decode
+    path, so they PIN the engine — ``auto`` routes host (the correctness
+    ask outranks the cost model) and an explicit ``tpu`` raises rather
+    than silently skipping the verification it was asked for."""
+    needs_host = options is not None and (options.verify_crc or options.salvage)
+    if engine == "tpu" and needs_host:
+        from ..errors import UnsupportedFeatureError
+
+        raise UnsupportedFeatureError(
+            "ReaderOptions.verify_crc/salvage are host-engine features; "
+            'use engine="host" or "auto" (which routes them to host)'
+        )
+    if engine == "auto":
+        if needs_host:
+            from ..utils import trace
+
+            trace.decision("engine.auto", {
+                "engine": "host",
+                "why": "verify_crc/salvage pin the host decode path",
+            })
+            return "host"
+        # per-FILE cost-model routing, not per-platform: the footer
+        # (bytes, codecs, encodings, optionality) + a cached link probe
+        # predict which engine wins this file (tpu/cost.py); decision
+        # visible via trace.decisions()
+        from ..tpu.cost import choose_engine
+
+        return choose_engine(
+            reader, purpose=purpose,
+            columns=set(columns) if columns else None,
+        ).engine
+    return engine
+
+
+def _was_quarantined(reader: ParquetFileReader, desc: ColumnDescriptor,
+                     rg_index: int) -> bool:
+    """True iff salvage actually recorded a whole-chunk quarantine for
+    this (column, row group).  A column missing WITHOUT a record is a
+    corrupt-but-parseable footer — substituting nulls for it would be
+    silent unreported data loss, so callers must raise instead."""
+    rep = reader.salvage_report
+    if rep is None:
+        return False
+    col = ".".join(desc.path)
+    return any(
+        s.column == col and s.row_group == rg_index and s.page is None
+        for s in rep.skips
+    )
 
 
 class _ColumnCursor:
@@ -90,6 +143,23 @@ class _ColumnCursor:
         if isinstance(v, np.floating):
             return float(v)
         return v
+
+
+class _NullCursor:
+    """Cursor for a salvage-quarantined column: every cell is None.
+
+    Served only under ``ReaderOptions(salvage=True)`` when the file
+    reader had to drop a column chunk — the row stream keeps flowing,
+    the loss is explicit in ``salvage_report`` (not silent: strict mode
+    raises on the same file)."""
+
+    __slots__ = ("desc",)
+
+    def __init__(self, desc: ColumnDescriptor):
+        self.desc = desc
+
+    def cell(self, i: int):
+        return None
 
 
 _CELL_BLOCK = 1 << 16
@@ -203,29 +273,35 @@ class ParquetReader:
     (``tpu.cost``) routes each file to whichever engine the model says
     wins (memcpy-class files stay host; per-value-decode files go
     device); on any other backend, host.
+
+    ``options`` (a :class:`~parquet_floor_tpu.ReaderOptions`) carries the
+    robustness knobs of the underlying file reader.  The one most callers
+    want: ``ReaderOptions(verify_crc=True)`` CRC32-checks every page
+    payload against the writer's stamp before decode — off by default
+    (parity with parquet-mr), but the only *guaranteed* detection of a
+    bit flip inside a page payload (an UNCOMPRESSED page otherwise
+    decodes silently wrong; a compressed one usually — not always — trips
+    the codec).  ``io_retries`` adds bounded retry-with-backoff for
+    transient ``OSError`` reads.  ``verify_crc``/``salvage`` are
+    host-engine features and PIN the engine: ``"auto"`` routes such
+    reads to host, and an explicit ``engine="tpu"`` raises rather than
+    silently skipping the verification it was asked for.  See
+    ``docs/robustness.md``.
     """
 
     def __init__(self, source, hydrator_supplier, columns: Optional[Sequence[str]] = None,
-                 engine: str = "host", predicate=None):
+                 engine: str = "host", predicate=None,
+                 options: Optional[ReaderOptions] = None):
         if engine not in ("host", "tpu", "auto"):
             raise ValueError(f"bad engine {engine!r}: expected host|tpu|auto")
-        self._reader = ParquetFileReader(source)
-        if engine == "auto":
-            # per-FILE cost-model routing, not per-platform: the footer
-            # (bytes, codecs, encodings, optionality) + a cached link
-            # probe predict which engine wins this file (tpu/cost.py);
-            # decision visible via trace.decisions()
-            from ..tpu.cost import choose_engine
-
-            try:
-                engine = choose_engine(
-                    self._reader,
-                    purpose="rows",
-                    columns=set(columns) if columns else None,
-                ).engine
-            except BaseException:
-                self._reader.close()
-                raise
+        self._reader = ParquetFileReader(source, options=options)
+        try:
+            engine = _resolve_engine(
+                engine, self._reader, "rows", columns, options
+            )
+        except BaseException:
+            self._reader.close()
+            raise
         self.engine = engine
         schema = self._reader.schema
         want = set(columns) if columns else None
@@ -292,6 +368,13 @@ class ParquetReader:
     def metadata(self) -> ParquetMetadata:
         """Open-reader footer access (``metaData()``, :229-231)."""
         return self._reader.metadata
+
+    @property
+    def salvage_report(self):
+        """The underlying reader's :class:`SalvageReport` (None unless
+        ``ReaderOptions(salvage=True)``).  The report object outlives
+        ``close()``, so losses stay accountable after the stream ends."""
+        return self._reader.salvage_report
 
     def estimate_size(self) -> int:
         """Exact total row count from the footer (:219-222); with a
@@ -492,16 +575,24 @@ class ParquetReader:
             if self._keep is not None and self._rg_index not in self._keep:
                 self._rg_index += 1  # predicate-pruned group
                 continue
-            batch = self._reader.read_row_group(self._rg_index, self._filter)
+            gi = self._rg_index
+            batch = self._reader.read_row_group(gi, self._filter)
             self._rg_index += 1
             ordered = []
             by_name = {b.descriptor.path: b for b in batch.columns}
             for desc in self.columns:
                 b = by_name.get(desc.path)
                 if b is None:
+                    if _was_quarantined(self._reader, desc, gi):
+                        # salvage quarantined this chunk (recorded in
+                        # the report): serve None cells for the group
+                        ordered.append(_NullCursor(desc))
+                        continue
                     raise ValueError(f"row group missing column {desc.path}")
                 ordered.append(_ColumnCursor(b))
             for c in ordered:
+                if isinstance(c, _NullCursor):
+                    continue
                 # Flat-only guard, parity with IllegalStateException
                 # ("Unexpected repetition", ParquetReader.java:200-202).
                 if c.batch.rep_levels is not None and np.any(c.batch.rep_levels != 0):
@@ -635,7 +726,8 @@ class ParquetReader:
     @staticmethod
     def stream_batches(source, batch_hydrator=None,
                        columns: Optional[Sequence[str]] = None,
-                       engine: str = "host", predicate=None):
+                       engine: str = "host", predicate=None,
+                       options: Optional[ReaderOptions] = None):
         """The BATCH face of the Hydrator boundary: one plugin call per
         ROW GROUP, columns as arrays in column order (the
         ``HydratorSupplier.java:10-15`` ordering contract lifted to
@@ -663,6 +755,18 @@ class ParquetReader:
         Returns a generator.  The file opens on FIRST iteration (so a
         generator closed before any ``next()`` never opens it) and
         closes when the generator is exhausted or closed.
+
+        With ``options=ReaderOptions(salvage=True)`` a chunk the reader
+        had to quarantine arrives as a PLACEHOLDER ``BatchColumn`` with
+        ``quarantined=True`` and ``values=None`` — column order (the
+        positional contract above) is preserved, and consumers that
+        touch the placeholder's data fail loudly instead of silently
+        reading a shifted column.  The quarantine is recorded in the
+        reader's ``SalvageReport``; the plain generator exposes no
+        report accessor — when you need the report, use
+        ``ParquetReader.spliterator(...)`` (its ``salvage_report``
+        property survives close) or drive ``ParquetFileReader``
+        directly.
         """
         if engine not in ("host", "tpu", "auto"):
             raise ValueError(f"bad engine {engine!r}: expected host|tpu|auto")
@@ -675,17 +779,18 @@ class ParquetReader:
                 for i, src in enumerate(source):
                     yield from ParquetReader._stream_batches_one(
                         src, batch_hydrator, columns, engine, predicate,
-                        state, i,
+                        state, i, options,
                     )
 
             return dgen()
         return ParquetReader._stream_batches_one(
-            source, batch_hydrator, columns, engine, predicate, {}, 0
+            source, batch_hydrator, columns, engine, predicate, {}, 0, options
         )
 
     @staticmethod
     def _stream_batches_one(source, batch_hydrator, columns, engine,
-                            predicate, state: dict, file_index: int):
+                            predicate, state: dict, file_index: int,
+                            options: Optional[ReaderOptions] = None):
         """One file's batch stream; ``state`` carries the dataset-wide
         hydrator and schema key across files."""
         from ..batch.columns import BatchColumn
@@ -693,17 +798,10 @@ class ParquetReader:
         from .hydrate import batch_supplier_of
 
         def gen():
-            reader = ParquetFileReader(source)
+            reader = ParquetFileReader(source, options=options)
             closer = reader  # replaced by the engine once it takes ownership
             try:
-                eng = engine
-                if eng == "auto":
-                    from ..tpu.cost import choose_engine
-
-                    eng = choose_engine(
-                        reader, purpose="batch",
-                        columns=set(columns) if columns else None,
-                    ).engine
+                eng = _resolve_engine(engine, reader, "batch", columns, options)
                 schema = reader.schema
                 _check_dataset_schema(state, schema, file_index)
                 want = set(columns) if columns else None
@@ -755,7 +853,23 @@ class ParquetReader:
                     by_path = {b.descriptor.path: b for b in batch.columns}
                     cols = []
                     for desc in selected:
-                        cb = by_path[desc.path]
+                        cb = by_path.get(desc.path)
+                        if cb is None:
+                            if _was_quarantined(reader, desc, gi):
+                                # salvage quarantined this chunk: a
+                                # quarantined placeholder keeps the
+                                # documented COLUMN ORDER intact, and
+                                # values=None makes positional consumers
+                                # fail loudly rather than silently read
+                                # a shifted column (the skip is in
+                                # reader.salvage_report)
+                                cols.append(BatchColumn(
+                                    desc, None, quarantined=True,
+                                ))
+                                continue
+                            raise ValueError(
+                                f"row group {gi} missing column {desc.path}"
+                            )
                         if cb.rep_levels is not None:
                             cols.append(BatchColumn(
                                 desc, cb.values,
@@ -785,7 +899,8 @@ class ParquetReader:
 
     @staticmethod
     def stream_content(source, hydrator_supplier, columns: Optional[Sequence[str]] = None,
-                       engine: str = "host", predicate=None):
+                       engine: str = "host", predicate=None,
+                       options: Optional[ReaderOptions] = None):
         """Stream hydrated records (``streamContent``, :47-61).
 
         Returns an iterator that owns the file and closes it on exhaustion
@@ -802,18 +917,22 @@ class ParquetReader:
         """
         if isinstance(source, (list, tuple)):
             return _DatasetIterator(
-                list(source), hydrator_supplier, columns, engine, predicate
+                list(source), hydrator_supplier, columns, engine, predicate,
+                options,
             )
         reader = ParquetReader(source, hydrator_supplier, columns,
-                               engine=engine, predicate=predicate)
+                               engine=engine, predicate=predicate,
+                               options=options)
         return _ClosingIterator(reader)
 
     @staticmethod
     def spliterator(source, hydrator_supplier, columns: Optional[Sequence[str]] = None,
-                    engine: str = "host", predicate=None) -> "ParquetReader":
+                    engine: str = "host", predicate=None,
+                    options: Optional[ReaderOptions] = None) -> "ParquetReader":
         """The raw cursor object (``spliterator``, :63-78)."""
         return ParquetReader(source, hydrator_supplier, columns,
-                             engine=engine, predicate=predicate)
+                             engine=engine, predicate=predicate,
+                             options=options)
 
     @staticmethod
     def read_metadata(source) -> ParquetMetadata:
@@ -852,7 +971,8 @@ class _DatasetIterator:
     the file boundary, before any of its rows are yielded).
     """
 
-    def __init__(self, sources, hydrator_supplier, columns, engine, predicate):
+    def __init__(self, sources, hydrator_supplier, columns, engine, predicate,
+                 options: Optional[ReaderOptions] = None):
         if not sources:
             raise ValueError("dataset stream needs at least one source")
         self._sources = sources
@@ -860,6 +980,7 @@ class _DatasetIterator:
         self._columns = columns
         self._engine = engine
         self._predicate = predicate
+        self._options = options
         self._i = 0
         self._schema_state: dict = {}
         self._current: Optional[_ClosingIterator] = None
@@ -873,6 +994,7 @@ class _DatasetIterator:
         reader = ParquetReader(
             self._sources[self._i], self._supplier, self._columns,
             engine=self._engine, predicate=self._predicate,
+            options=self._options,
         )
         try:
             _check_dataset_schema(
@@ -886,8 +1008,15 @@ class _DatasetIterator:
         # matching the single-file iterator (whose footer stays cached)
         self._last_meta = reader.metadata
         self._last_columns = reader.columns
+        self._last_report = reader.salvage_report
         self._i += 1
         return True
+
+    @property
+    def salvage_report(self):
+        """SalvageReport of the file currently (or most recently)
+        streaming — reports are per-file; inspect at file boundaries."""
+        return getattr(self, "_last_report", None)
 
     def __iter__(self):
         return self
@@ -978,6 +1107,12 @@ class _ClosingIterator:
     @property
     def columns(self):
         return self._reader.columns
+
+    @property
+    def salvage_report(self):
+        """SalvageReport of the wrapped reader (kept past exhaustion /
+        close, so callers can account for losses after streaming)."""
+        return self._reader.salvage_report
 
     def __enter__(self):
         return self

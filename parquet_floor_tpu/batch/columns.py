@@ -151,15 +151,33 @@ class BatchColumn:
     # ``to_numpy()``/``to_arrow()`` view them back to float64 on host;
     # on-device consumers get the raw bits via ``values``/DLPack.
     f64_bits: bool = False
+    # salvage mode: True when this row group's chunk was quarantined —
+    # ``values`` is None so positional consumers fail LOUDLY instead of
+    # silently misreading a shifted column; the loss is itemized in the
+    # reader's SalvageReport.
+    quarantined: bool = False
 
     @property
     def is_strings(self) -> bool:
         return self.lengths is not None
 
+    def _require_data(self):
+        """The fail-loudly half of the salvage placeholder contract:
+        touching a quarantined column's data raises, it never yields a
+        None-shaped array that could be stored downstream."""
+        if self.quarantined:
+            raise ValueError(
+                f"column {'.'.join(self.descriptor.path)} was quarantined "
+                "by salvage for this row group (see the reader's "
+                "salvage_report); its data does not exist"
+            )
+
     def __dlpack__(self, **kw):
+        self._require_data()
         return self.values.__dlpack__(**kw)
 
     def __dlpack_device__(self):
+        self._require_data()
         return self.values.__dlpack_device__()
 
     def _host(self, arr):
@@ -167,6 +185,7 @@ class BatchColumn:
 
     def to_numpy(self) -> np.ndarray:
         """``values`` on host as NumPy (bit-form DOUBLE → float64)."""
+        self._require_data()
         v = np.asarray(self.values)
         if self.f64_bits and v.dtype == np.int64:
             v = v.view(np.float64)
@@ -174,6 +193,7 @@ class BatchColumn:
 
     def bytes_list(self) -> list:
         """Strings as a list of ``bytes`` (both engine layouts)."""
+        self._require_data()
         if not self.is_strings:
             raise ValueError("bytes_list() is for string columns")
         if isinstance(self.values, ByteArrayColumn):
@@ -197,6 +217,7 @@ class BatchColumn:
         """
         import pyarrow as pa
 
+        self._require_data()
         if self.rep_levels is not None:
             raise ValueError(
                 "to_arrow() serves flat columns; assemble repeated "

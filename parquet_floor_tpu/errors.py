@@ -1,0 +1,126 @@
+"""Error taxonomy — structured, fail-loudly exceptions for every corruption
+and unsupported-feature path (SURVEY.md §5: the reference *swallows* I/O
+errors, ``FSDataInputStream.java:21-29``; this framework refuses to).
+
+Every error carries structured context — file path, column path, row-group
+index, page ordinal, byte offset — so a failure inside a directory scan of a
+thousand files names exactly which bytes are bad.  The hierarchy keeps
+``ValueError``/``EOFError`` as secondary bases where pre-taxonomy callers
+(and tests) catch those builtins:
+
+    ParquetError (Exception)
+    ├── CorruptFooterError        (also ValueError)   footer/magic/metadata
+    ├── CorruptPageError          (also ValueError)   page header/payload
+    │   └── ChecksumMismatchError                     CRC32 says bytes changed
+    ├── TruncatedFileError        (also EOFError)     read past physical end
+    ├── UnsupportedFeatureError   (also ValueError)   valid file, missing code
+    │   └── format.codecs.UnsupportedCodec            codec not available
+    ├── IoRetryExhaustedError     (also OSError)      transient faults persisted
+    └── format.thrift.ThriftDecodeError (also ValueError)  bad compact thrift
+
+Raise with whatever context is known at the raise site; ``annotate`` lets an
+outer frame fill in fields an inner frame could not know (e.g. the decoder
+knows the page ordinal, the file reader knows the path)::
+
+    raise CorruptPageError("dictionary index out of range",
+                           path=src.name, column="s", row_group=2, page=0)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_CONTEXT_FIELDS = ("path", "column", "row_group", "page", "offset")
+
+
+class ParquetError(Exception):
+    """Base of the taxonomy; carries structured location context.
+
+    ``message`` is the bare defect description; ``str()`` appends whatever
+    context fields are set, so logs stay greppable by file/column.
+    """
+
+    def __init__(
+        self,
+        message: str = "",
+        *,
+        path: Optional[str] = None,
+        column: Optional[str] = None,
+        row_group: Optional[int] = None,
+        page: Optional[int] = None,
+        offset: Optional[int] = None,
+    ):
+        super().__init__(message)
+        self.message = message
+        self.path = path
+        self.column = column
+        self.row_group = row_group
+        self.page = page
+        self.offset = offset
+
+    @property
+    def context(self) -> dict:
+        """The non-None context fields as a dict (stable key order)."""
+        return {
+            k: getattr(self, k)
+            for k in _CONTEXT_FIELDS
+            if getattr(self, k) is not None
+        }
+
+    def __str__(self) -> str:
+        ctx = self.context
+        if not ctx:
+            return self.message
+        suffix = ", ".join(f"{k}={v!r}" for k, v in ctx.items())
+        return f"{self.message} [{suffix}]"
+
+
+def annotate(err: ParquetError, **context) -> ParquetError:
+    """Fill context fields the raise site could not know (outer frames call
+    this before re-raising).  Already-set fields win — the innermost frame
+    had the most precise location."""
+    for key, value in context.items():
+        if key in _CONTEXT_FIELDS and value is not None and getattr(err, key) is None:
+            setattr(err, key, value)
+    return err
+
+
+class CorruptFooterError(ParquetError, ValueError):
+    """The footer (magic, length word, or Thrift metadata) does not parse;
+    nothing in the file can be located without it."""
+
+
+class CorruptPageError(ParquetError, ValueError):
+    """A page header or payload is damaged (bad framing, undecodable
+    payload, value/footer count disagreement)."""
+
+
+class ChecksumMismatchError(CorruptPageError):
+    """The page's CRC32 does not match its payload: the bytes changed
+    between writer and reader."""
+
+    def __init__(self, message: str = "", *, expected_crc: Optional[int] = None,
+                 actual_crc: Optional[int] = None, **context):
+        super().__init__(message, **context)
+        self.expected_crc = expected_crc
+        self.actual_crc = actual_crc
+
+
+class TruncatedFileError(ParquetError, EOFError):
+    """A read reached past the physical end of the file (file shorter than
+    its metadata claims, or cut mid-structure)."""
+
+
+class UnsupportedFeatureError(ParquetError, ValueError):
+    """The file is (as far as we can tell) valid, but uses a format feature
+    this engine does not implement — fail loudly rather than guess."""
+
+
+class IoRetryExhaustedError(ParquetError, OSError):
+    """Transient I/O failures persisted beyond the configured retry budget
+    (``ReaderOptions.io_retries``)."""
+
+    def __init__(self, message: str = "", *, attempts: Optional[int] = None,
+                 **context):
+        super().__init__(message, **context)
+        self.attempts = attempts

@@ -18,6 +18,7 @@ import io
 import zlib
 from typing import Callable, Dict, Optional, Tuple
 
+from ..errors import UnsupportedFeatureError
 from . import snappy as _snappy_py
 from .parquet_thrift import CompressionCodec
 
@@ -33,8 +34,10 @@ except Exception:  # pragma: no cover - native lib is optional
     _native = None
 
 
-class UnsupportedCodec(ValueError):
-    pass
+class UnsupportedCodec(UnsupportedFeatureError):
+    """A codec named by the footer has no implementation in this
+    environment (taxonomy: an :class:`UnsupportedFeatureError`, not
+    corruption — the file may be fine)."""
 
 
 def _snappy_compress(data: bytes) -> bytes:

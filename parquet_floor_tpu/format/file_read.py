@@ -10,18 +10,142 @@ one pass instead of per-cell virtual dispatch.
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
 from typing import Iterator, List, Optional, Set
 
 import numpy as np
 
 from ..batch.columns import ColumnBatch, RowGroupBatch
-from ..io.source import FileSource
+from ..errors import (
+    CorruptFooterError,
+    CorruptPageError,
+    ParquetError,
+    TruncatedFileError,
+    UnsupportedFeatureError,
+)
+from ..io.source import FileSource, RetryingSource
+from ..utils import trace
 from . import pages as pg
 from .encodings.plain import ByteArrayColumn
 from .metadata import ParquetMetadata, read_footer
-from .parquet_thrift import ColumnChunk, ColumnMetaData, PageHeader, PageType, RowGroup
+from .parquet_thrift import ColumnChunk, ColumnMetaData, PageType, RowGroup
 from .schema import ColumnDescriptor
-from .thrift import CompactReader
+from .thrift import ThriftDecodeError
+
+
+@dataclass
+class ReaderOptions:
+    """Read-side configuration — the explicit read twin of
+    ``WriterOptions`` (SURVEY.md §5's explicit-config stance).
+
+    * ``verify_crc`` — CRC32-check every page payload against the header
+      stamp before decode.  Off by default (parity with parquet-mr's
+      default); turn it on for storage you do not trust — it is the only
+      way a bit flip inside a compressed payload is *guaranteed* to be
+      detected rather than surfacing as a downstream decode error (or,
+      for UNCOMPRESSED pages, silent wrong data).
+    * ``salvage`` — quarantine corrupt pages/chunks instead of aborting
+      the whole file; see :class:`SalvageReport`.  Strict (off) is the
+      default and behaves byte-identically to a reader without the flag.
+    * ``io_retries`` — bounded retry-with-backoff for *transient*
+      ``OSError`` reads (flaky NFS/FUSE/object-store mounts).  0 (off) by
+      default; deterministic errors (truncation, parse) never retry.
+    * ``io_retry_backoff_s`` — first backoff sleep; doubles per attempt.
+    """
+
+    verify_crc: bool = False
+    salvage: bool = False
+    io_retries: int = 0
+    io_retry_backoff_s: float = 0.05
+
+    def __post_init__(self):
+        # fail-fast: a bad retry config must error here, not silently
+        # become "no retries"
+        if self.io_retries < 0:
+            raise ValueError(f"io_retries must be >= 0, got {self.io_retries}")
+        if self.io_retry_backoff_s < 0:
+            raise ValueError(
+                f"io_retry_backoff_s must be >= 0, got {self.io_retry_backoff_s}"
+            )
+
+
+@dataclass
+class SalvageSkip:
+    """One quarantined unit (a page, or a whole column chunk when
+    ``page`` is None) recorded by salvage mode."""
+
+    column: str
+    row_group: Optional[int]
+    page: Optional[int]  # ordinal within the chunk; None = whole chunk
+    rows: int            # value slots lost (rows, for flat columns)
+    error: str
+    path: Optional[str] = None
+
+
+@dataclass
+class SalvageReport:
+    """What salvage mode recovered and what it had to give up.
+
+    Counters are in *column-rows* (value slots: one per row per column;
+    equal to rows for flat columns).  A page skip nulls the page's rows
+    in an OPTIONAL flat column (rows survive as nulls, counted
+    quarantined); a chunk quarantine drops that column for the whole row
+    group (other columns still decode).  ``first_errors`` maps each
+    damaged column to the first error seen on it.
+    """
+
+    pages_read: int = 0
+    pages_skipped: int = 0
+    chunks_quarantined: int = 0
+    rows_recovered: int = 0
+    rows_quarantined: int = 0
+    skips: List[SalvageSkip] = field(default_factory=list)
+    # (column, row_group) chunks already accounted — decode is
+    # deterministic, so re-decoding a group (restore(), repeated
+    # read_row_group) must not double-count its losses or recoveries
+    _counted: set = field(default_factory=set, repr=False, compare=False)
+
+    def _first_count(self, column: str, row_group, kind: str) -> bool:
+        """True exactly once per (kind, column, row_group); callers skip
+        accounting on repeats.  ``kind`` separates successful-decode
+        accounting ("ok") from quarantine accounting ("q"): a chunk that
+        decoded fine once but fails on a LATER re-read (flaky storage, a
+        file changing underneath) must still get its quarantine record —
+        every omission has a report entry.  An unknown group (direct
+        ``read_column_chunk`` calls with no index) always counts — keys
+        from different groups would collide at None, and unreported loss
+        is worse than a possible double-count on re-decode."""
+        if row_group is None:
+            return True
+        key = (kind, column, row_group)
+        if key in self._counted:
+            return False
+        self._counted.add(key)
+        return True
+
+    @property
+    def first_errors(self) -> dict:
+        out: dict = {}
+        for s in self.skips:
+            out.setdefault(s.column, s.error)
+        return out
+
+    def summary(self) -> dict:
+        return {
+            "pages_read": self.pages_read,
+            "pages_skipped": self.pages_skipped,
+            "chunks_quarantined": self.chunks_quarantined,
+            "rows_recovered": self.rows_recovered,
+            "rows_quarantined": self.rows_quarantined,
+            "first_errors": self.first_errors,
+        }
+
+
+# What salvage mode may quarantine: damaged pages/chunks and reads past
+# the physical end.  UnsupportedFeatureError is NOT here on purpose — a
+# missing capability is a fact about this engine, not the file, and
+# silently dropping such columns would misreport healthy data as damaged.
+_SALVAGEABLE = (CorruptPageError, TruncatedFileError, ThriftDecodeError)
 
 
 def _chunk_byte_range(meta: ColumnMetaData):
@@ -52,6 +176,20 @@ def _empty_values(desc: ColumnDescriptor):
     return np.zeros((0, width), np.uint8)
 
 
+def _page_num_values(page: "pg.RawPage") -> Optional[int]:
+    """The value count a data page's header declares, or None when the
+    header lacks it (then the page cannot be null-substituted)."""
+    h = page.header
+    if page.page_type == PageType.DATA_PAGE and h.data_page_header is not None:
+        return h.data_page_header.num_values
+    if (
+        page.page_type == PageType.DATA_PAGE_V2
+        and h.data_page_header_v2 is not None
+    ):
+        return h.data_page_header_v2.num_values
+    return None
+
+
 def _concat_values(parts):
     if not parts:
         raise ValueError("no pages decoded")
@@ -69,13 +207,58 @@ def _concat_values(parts):
 
 
 class ParquetFileReader:
-    """Open a parquet file, expose footer + per-row-group columnar decode."""
+    """Open a parquet file, expose footer + per-row-group columnar decode.
 
-    def __init__(self, source, verify_crc: bool = False):
-        self.source = source if isinstance(source, FileSource) else FileSource(source)
-        self.metadata: ParquetMetadata = read_footer(self.source)
+    ``options`` (a :class:`ReaderOptions`) is the full read-side config;
+    ``verify_crc``/``salvage`` remain as positional shorthands, and a
+    truthy shorthand folds into ``options`` when both are given (asking
+    for CRC verification is never silently undone by also passing
+    options).  With ``salvage=True`` the reader
+    quarantines corrupt pages/row-group chunks instead of aborting (see
+    :class:`SalvageReport`, exposed as ``self.salvage_report``); strict
+    mode — the default — fails loudly on the first damaged byte.
+    """
+
+    def __init__(self, source, verify_crc: bool = False,
+                 salvage: bool = False,
+                 options: Optional[ReaderOptions] = None):
+        if options is None:
+            opts = ReaderOptions(verify_crc=verify_crc, salvage=salvage)
+        elif verify_crc or salvage:
+            # fold truthy shorthands into the caller's options instead of
+            # silently dropping them: verify_crc=True must never be
+            # disabled by merely ALSO passing options=ReaderOptions(...)
+            from dataclasses import replace
+
+            opts = replace(
+                options,
+                verify_crc=options.verify_crc or verify_crc,
+                salvage=options.salvage or salvage,
+            )
+        else:
+            opts = options
+        self.options = opts
+        src = source if hasattr(source, "read_at") else FileSource(source)
+        owns_source = src is not source
+        if opts.io_retries > 0 and not isinstance(src, RetryingSource):
+            # isinstance guard: a caller-wrapped RetryingSource must not be
+            # wrapped again (attempts would multiply, backoffs compound)
+            src = RetryingSource(src, opts.io_retries, opts.io_retry_backoff_s)
+        self.source = src
+        try:
+            self.metadata: ParquetMetadata = read_footer(self.source)
+        except BaseException:
+            if owns_source:
+                # corrupt-footer raises are a hot path (directory sniffs,
+                # fuzz): the fd/mmap THIS constructor opened must not leak
+                self.source.close()
+            raise
         self.schema = self.metadata.schema
-        self.verify_crc = verify_crc
+        self.verify_crc = opts.verify_crc
+        self._salvage = opts.salvage
+        self.salvage_report: Optional[SalvageReport] = (
+            SalvageReport() if opts.salvage else None
+        )
         self._closed = False
 
     # -- parity surface ----------------------------------------------------
@@ -92,6 +275,8 @@ class ParquetFileReader:
 
     def close(self) -> None:
         if not self._closed:
+            if self.salvage_report is not None and self.salvage_report.skips:
+                trace.decision("salvage.report", self.salvage_report.summary())
             self.source.close()
             self._closed = True
 
@@ -107,37 +292,143 @@ class ParquetFileReader:
         path = tuple(chunk.meta_data.path_in_schema)
         return self.schema.column(path)
 
-    def read_column_chunk(self, chunk: ColumnChunk) -> ColumnBatch:
+    def _chunk_ctx(self, desc: ColumnDescriptor,
+                   row_group_index: Optional[int]) -> dict:
+        return {
+            "path": getattr(self.source, "name", None),
+            "column": ".".join(desc.path),
+            "row_group": row_group_index,
+        }
+
+    def read_column_chunk(
+        self, chunk: ColumnChunk, row_group_index: Optional[int] = None
+    ) -> ColumnBatch:
+        """Decode one column chunk.  Every failure carries file/column/
+        row-group context; hostile bytes surface as taxonomy
+        (:mod:`parquet_floor_tpu.errors`), never a bare crash from deep
+        inside an encoding.  In salvage mode, damaged pages of flat
+        OPTIONAL columns are substituted with all-null pages (recorded in
+        ``self.salvage_report``); unrecoverable damage still raises, and
+        :meth:`read_row_group` quarantines the whole chunk."""
         meta = chunk.meta_data
+        path = getattr(self.source, "name", None)
         if meta is None:
-            raise ValueError("column chunk without inline metadata")
+            raise CorruptFooterError(
+                "column chunk without inline metadata",
+                path=path, row_group=row_group_index,
+            )
         if chunk.file_path:
-            raise ValueError("external column chunk files are not supported")
-        desc = self._descriptor_for(chunk)
+            raise UnsupportedFeatureError(
+                "external column chunk files are not supported",
+                path=path, row_group=row_group_index,
+            )
+        try:
+            desc = self._descriptor_for(chunk)
+        except Exception as e:
+            raise CorruptFooterError(
+                f"column chunk names a path missing from the schema: "
+                f"{meta.path_in_schema!r}",
+                path=path, row_group=row_group_index,
+            ) from e
+        ctx = self._chunk_ctx(desc, row_group_index)
+        try:
+            batch, skips, pages_decoded = self._decode_chunk(chunk, desc, ctx)
+        except (ParquetError, OSError, MemoryError):
+            # OSError is the TRANSIENT class (flaky mounts) and MemoryError
+            # is host pressure: wrapping either as CorruptPageError would
+            # let salvage quarantine healthy data on an environmental blip
+            raise
+        except Exception as e:
+            # belt-and-braces: a corruption path no decoder anticipated
+            # must still land in the taxonomy
+            raise CorruptPageError(
+                f"column chunk decode failed: {e}", **ctx
+            ) from e
+        if self.salvage_report is not None and self.salvage_report._first_count(
+            ctx["column"], row_group_index, "ok"
+        ):
+            rep = self.salvage_report
+            rep.pages_read += pages_decoded
+            nulled = 0
+            for ordinal, n, err in skips:
+                rep.pages_skipped += 1
+                rep.rows_quarantined += n
+                nulled += n
+                rep.skips.append(SalvageSkip(
+                    column=ctx["column"], row_group=row_group_index,
+                    page=ordinal, rows=n, error=str(err), path=path,
+                ))
+                trace.decision("salvage.skip_page", {
+                    "column": ctx["column"], "row_group": row_group_index,
+                    "page": ordinal, "rows": n, "error": str(err),
+                })
+            rep.rows_recovered += int(meta.num_values or 0) - nulled
+        return batch
+
+    def _decode_chunk(self, chunk: ColumnChunk, desc: ColumnDescriptor,
+                      ctx: dict):
+        """Shared chunk decode.  Returns ``(batch, skips, pages_decoded)``
+        where ``skips`` lists ``(page_ordinal, rows, error)`` for pages
+        salvage replaced with all-null pages (always empty in strict
+        mode).  Skips are committed to the report only by the caller,
+        after the chunk as a whole succeeds — a chunk that fails later
+        anyway is recorded once, as one quarantined chunk."""
+        meta = chunk.meta_data
         start, length = _chunk_byte_range(meta)
         raw = self.source.read_at(start, length)
-        raw_pages = pg.split_pages(raw, meta.num_values)
+        raw_pages = pg.split_pages(raw, meta.num_values, ctx, offset_base=start)
         dictionary = None
         decoded: List[pg.DecodedPage] = []
-        for page in raw_pages:
+        skips: list = []
+        pages_decoded = 0
+        for i, page in enumerate(raw_pages):
+            pctx = {**ctx, "page": i}
             if page.page_type == PageType.DICTIONARY_PAGE:
                 if dictionary is not None:
-                    raise ValueError("multiple dictionary pages in one chunk")
+                    raise CorruptPageError(
+                        "multiple dictionary pages in one chunk", **pctx
+                    )
                 dictionary = pg.decode_dictionary_page(
-                    page, desc, meta.codec, self.verify_crc
+                    page, desc, meta.codec, self.verify_crc, pctx
                 )
+                pages_decoded += 1
             elif page.page_type in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
-                decoded.append(
-                    pg.decode_data_page(page, desc, meta.codec, dictionary, self.verify_crc)
-                )
+                try:
+                    decoded.append(pg.decode_data_page(
+                        page, desc, meta.codec, dictionary, self.verify_crc,
+                        pctx,
+                    ))
+                    pages_decoded += 1
+                except CorruptPageError as e:
+                    n = _page_num_values(page)
+                    # n bounded by the chunk's footer total: a corrupt
+                    # header claiming absurd counts must not allocate
+                    if not (
+                        self._salvage
+                        and desc.max_repetition_level == 0
+                        and desc.max_definition_level > 0
+                        and isinstance(n, int)
+                        and 0 <= n <= int(meta.num_values or 0)
+                    ):
+                        raise
+                    # flat optional column: the page's rows survive as
+                    # nulls (def level 0 < max), so row alignment across
+                    # columns is preserved exactly
+                    decoded.append(pg.DecodedPage(
+                        n, _empty_values(desc), np.zeros(n, np.uint32), None
+                    ))
+                    skips.append((i, n, e))
             elif page.page_type == PageType.INDEX_PAGE:
                 continue
             else:
-                raise ValueError(f"unknown page type {page.page_type}")
+                raise CorruptPageError(
+                    f"unknown page type {page.page_type}", **pctx
+                )
         total = sum(d.num_values for d in decoded)
         if total != meta.num_values:
-            raise ValueError(
-                f"chunk decoded {total} values, footer said {meta.num_values}"
+            raise CorruptPageError(
+                f"chunk decoded {total} values, footer said {meta.num_values}",
+                **ctx,
             )
         if not decoded:  # zero-row row group: valid, just empty
             empty_levels = (
@@ -146,7 +437,7 @@ class ParquetFileReader:
             return ColumnBatch(
                 desc, 0, _empty_values(desc), empty_levels,
                 np.zeros(0, np.uint32) if desc.max_repetition_level > 0 else None,
-            )
+            ), skips, pages_decoded
         values = _concat_values([d.values for d in decoded])
         def_levels = (
             np.concatenate([d.def_levels for d in decoded])
@@ -158,7 +449,8 @@ class ParquetFileReader:
             if decoded and decoded[0].rep_levels is not None
             else None
         )
-        return ColumnBatch(desc, meta.num_values, values, def_levels, rep_levels)
+        batch = ColumnBatch(desc, meta.num_values, values, def_levels, rep_levels)
+        return batch, skips, pages_decoded
 
     def read_row_group_ranges(
         self, index: int, row_ranges, column_filter: Optional[Set[str]] = None
@@ -239,15 +531,14 @@ class ParquetFileReader:
                 return covered
             covered = new
 
-    def _read_raw_page(self, offset: int, max_len: int) -> "pg.RawPage":
-        """Parse one page (header + payload) from a bounded byte range."""
+    def _read_raw_page(self, offset: int, max_len: int,
+                       ctx: Optional[dict] = None) -> "pg.RawPage":
+        """Parse one page (header + payload) from a bounded byte range
+        (framing validation shared with the chunk scan: ``parse_page_at``).
+        """
         raw = self.source.read_at(int(offset), int(max_len))
-        reader = CompactReader(raw)
-        header = PageHeader.read(reader)
-        payload = bytes(raw[reader.pos : reader.pos + header.compressed_page_size])
-        if len(payload) != header.compressed_page_size:
-            raise ValueError("page payload truncated")
-        return pg.RawPage(header, payload)
+        page, _ = pg.parse_page_at(raw, 0, ctx, None, offset_base=int(offset))
+        return page
 
     def read_raw_column_chunk_ranges(self, chunk: ColumnChunk, covered, n: int):
         """Raw pages (dictionary page first, then only the data pages whose
@@ -258,19 +549,23 @@ class ParquetFileReader:
         oi = self.read_offset_index(chunk)
         if oi is None or not oi.page_locations:
             return None
+        ctx = self._chunk_ctx(self._descriptor_for(chunk), None)
         firsts = [int(pl.first_row_index or 0) for pl in oi.page_locations]
         ends = firsts[1:] + [n]
         pages = []
         if meta.dictionary_page_offset is not None and meta.dictionary_page_offset > 0:
             dict_len = int(oi.page_locations[0].offset) - int(meta.dictionary_page_offset)
-            dpage = self._read_raw_page(meta.dictionary_page_offset, dict_len)
+            dpage = self._read_raw_page(meta.dictionary_page_offset, dict_len, ctx)
             if dpage.page_type != PageType.DICTIONARY_PAGE:
-                raise ValueError("expected dictionary page before data pages")
+                raise CorruptPageError(
+                    "expected dictionary page before data pages",
+                    offset=int(meta.dictionary_page_offset), **ctx,
+                )
             pages.append(dpage)
         for pl, a, b in zip(oi.page_locations, firsts, ends):
             if any(a < cb and ca < b for ca, cb in covered):
                 pages.append(
-                    self._read_raw_page(pl.offset, pl.compressed_page_size)
+                    self._read_raw_page(pl.offset, pl.compressed_page_size, ctx)
                 )
         return pages
 
@@ -281,18 +576,21 @@ class ParquetFileReader:
         reused when the caller already fetched them)."""
         meta = chunk.meta_data
         desc = self._descriptor_for(chunk)
+        ctx = self._chunk_ctx(desc, None)
         if raw_pages is None:
             raw_pages = self.read_raw_column_chunk_ranges(chunk, covered, n)
         dictionary = None
         decoded = []
-        for page in raw_pages:
+        for i, page in enumerate(raw_pages):
+            pctx = {**ctx, "page": i}
             if page.page_type == PageType.DICTIONARY_PAGE:
                 dictionary = pg.decode_dictionary_page(
-                    page, desc, meta.codec, self.verify_crc
+                    page, desc, meta.codec, self.verify_crc, pctx
                 )
                 continue
             decoded.append(
-                pg.decode_data_page(page, desc, meta.codec, dictionary, self.verify_crc)
+                pg.decode_data_page(page, desc, meta.codec, dictionary,
+                                    self.verify_crc, pctx)
             )
         total = sum(d.num_values for d in decoded)
         if not decoded:
@@ -326,11 +624,47 @@ class ParquetFileReader:
         rg = self.row_groups[index]
         batches = []
         for chunk in rg.columns or []:
-            path0 = chunk.meta_data.path_in_schema[0]
-            if column_filter and path0 not in column_filter:
+            meta = chunk.meta_data
+            # a nulled/corrupt meta_data falls THROUGH to read_column_chunk,
+            # which diagnoses it (CorruptFooterError, with context) — a
+            # projection must never silently drop an undiagnosable chunk
+            path0 = (
+                meta.path_in_schema[0]
+                if meta is not None and meta.path_in_schema
+                else None
+            )
+            if column_filter and path0 is not None and path0 not in column_filter:
                 continue
-            batches.append(self.read_column_chunk(chunk))
+            if not self._salvage:
+                batches.append(self.read_column_chunk(chunk, index))
+                continue
+            try:
+                batches.append(self.read_column_chunk(chunk, index))
+            except _SALVAGEABLE as e:
+                self._quarantine_chunk(chunk, index, rg, e)
         return RowGroupBatch(batches, rg.num_rows or 0)
+
+    def _quarantine_chunk(self, chunk: ColumnChunk, index: int,
+                          rg: RowGroup, err: Exception) -> None:
+        """Salvage mode: drop one unrecoverable column chunk, keep the
+        row group's other columns.  The batch simply omits the column;
+        the report and a ``trace.decision`` event record exactly what
+        was lost."""
+        rep = self.salvage_report
+        column = ".".join(chunk.meta_data.path_in_schema or ["?"])
+        if not rep._first_count(column, index, "q"):
+            return  # this chunk's loss is already on the books
+        rows = int(chunk.meta_data.num_values or rg.num_rows or 0)
+        rep.chunks_quarantined += 1
+        rep.rows_quarantined += rows
+        rep.skips.append(SalvageSkip(
+            column=column, row_group=index, page=None, rows=rows,
+            error=str(err), path=getattr(self.source, "name", None),
+        ))
+        trace.decision("salvage.quarantine_chunk", {
+            "column": column, "row_group": index, "rows": rows,
+            "error": str(err),
+        })
 
     def iter_row_groups(
         self, column_filter: Optional[Set[str]] = None, predicate=None
@@ -351,7 +685,11 @@ class ParquetFileReader:
         meta = chunk.meta_data
         start, length = _chunk_byte_range(meta)
         raw = self.source.read_at(start, length)
-        return pg.split_pages(raw, meta.num_values)
+        return pg.split_pages(
+            raw, meta.num_values,
+            self._chunk_ctx(self._descriptor_for(chunk), None),
+            offset_base=start,
+        )
 
     # -- page indexes ------------------------------------------------------
 
@@ -412,9 +750,11 @@ class ParquetFileReader:
                 # file may place the filter within the last 64 bytes
                 probe = min(64, self.source.size - int(offset))
                 if probe <= 0:
-                    raise EOFError(
+                    raise TruncatedFileError(
                         f"bloom filter offset {offset} outside file of "
-                        f"{self.source.size} bytes"
+                        f"{self.source.size} bytes",
+                        path=getattr(self.source, "name", None),
+                        offset=int(offset),
                     )
                 head = self.source.read_at(int(offset), probe)
                 reader = CompactReader(head)

@@ -12,10 +12,11 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from ..errors import CorruptFooterError, ParquetError, annotate
 from ..io.source import FileSource
 from .parquet_thrift import FileMetaData, RowGroup
 from .schema import MessageType
-from .thrift import CompactReader, CompactWriter
+from .thrift import CompactReader, CompactWriter, ThriftDecodeError
 
 MAGIC = b"PAR1"
 MAGIC_ENCRYPTED = b"PARE"
@@ -56,21 +57,58 @@ class ParquetMetadata:
 
 
 def read_footer(source: FileSource) -> ParquetMetadata:
+    path = getattr(source, "name", None)
     size = source.size
     if size < len(MAGIC) + FOOTER_TAIL:
-        raise ValueError(f"not a parquet file: only {size} bytes")
+        # CorruptFooterError, not TruncatedFileError: this is the
+        # sniff-a-directory path and stays a ValueError, matching the
+        # pre-taxonomy raise callers may already catch
+        raise CorruptFooterError(
+            f"not a parquet file: only {size} bytes "
+            f"(a valid file is at least {len(MAGIC) + FOOTER_TAIL})",
+            path=path,
+        )
     head = bytes(source.read_at(0, 4))
     tail = bytes(source.read_at(size - FOOTER_TAIL, FOOTER_TAIL))
     if tail[4:] == MAGIC_ENCRYPTED:
-        raise ValueError("encrypted parquet files are not supported")
+        from ..errors import UnsupportedFeatureError
+
+        raise UnsupportedFeatureError(
+            "encrypted parquet files are not supported", path=path
+        )
     if head != MAGIC or tail[4:] != MAGIC:
-        raise ValueError("not a parquet file: bad magic")
+        raise CorruptFooterError("not a parquet file: bad magic", path=path)
     footer_len = int.from_bytes(tail[:4], "little")
     if footer_len + FOOTER_TAIL + len(MAGIC) > size:
-        raise ValueError(f"corrupt footer length {footer_len}")
-    footer_bytes = source.read_at(size - FOOTER_TAIL - footer_len, footer_len)
-    fm = FileMetaData.read(CompactReader(footer_bytes))
-    return ParquetMetadata(fm)
+        raise CorruptFooterError(
+            f"corrupt footer length {footer_len} (file is {size} bytes)",
+            path=path, offset=size - FOOTER_TAIL,
+        )
+    footer_start = size - FOOTER_TAIL - footer_len
+    footer_bytes = source.read_at(footer_start, footer_len)
+    try:
+        fm = FileMetaData.read(CompactReader(footer_bytes))
+        return ParquetMetadata(fm)
+    except ThriftDecodeError as e:
+        # the common corrupt-footer outcome: unparseable compact thrift.
+        # Surface it as the footer taxonomy class (cause preserved), so
+        # `except CorruptFooterError` sniff loops see ONE class
+        raise CorruptFooterError(
+            f"footer metadata does not parse: {e}",
+            path=path, offset=footer_start,
+        ) from e
+    except ParquetError as e:
+        raise annotate(e, path=path, offset=footer_start)
+    except (OSError, MemoryError):
+        raise  # transient I/O or host pressure, not corruption
+    except Exception as e:
+        # hostile footer bytes can trip any decoder invariant (recursion,
+        # index, type errors deep in schema building) — every such path is
+        # the same fact: the footer does not parse
+        raise CorruptFooterError(
+            f"footer metadata does not parse: {e}",
+            path=path, offset=footer_start,
+        ) from e
 
 
 def serialize_footer(file_meta: FileMetaData) -> bytes:

@@ -16,6 +16,13 @@ from typing import List, Optional, Union
 
 import numpy as np
 
+from ..errors import (
+    ChecksumMismatchError,
+    CorruptPageError,
+    ParquetError,
+    UnsupportedFeatureError,
+    annotate,
+)
 from . import codecs
 from .encodings import plain as e_plain
 from .encodings import rle_hybrid as e_rle
@@ -43,11 +50,14 @@ except Exception:  # pragma: no cover - native lib is optional
     _native = None
 
 
-def _split_pages_native(chunk, num_values: int) -> "List[RawPage]":
-    """Build RawPage objects from the native header scan's slot table."""
+def _split_pages_native(chunk, num_values: int):
+    """Build RawPage objects from the native header scan's slot table;
+    returns ``(pages, payload_offsets)`` (offsets chunk-relative, for
+    error context)."""
     tbl = _native.split_pages(chunk, num_values)
     mv = memoryview(chunk)
     pages: List[RawPage] = []
+    offsets: List[int] = []
     for row in tbl:
         ptype = int(row[0])
         header = PageHeader(
@@ -83,7 +93,8 @@ def _split_pages_native(chunk, num_values: int) -> "List[RawPage]":
         # page's reference; staging consumes pages while the source is
         # open, and every consumer takes buffers, not bytes)
         pages.append(RawPage(header, mv[off : off + size]))
-    return pages
+        offsets.append(off)
+    return pages, offsets
 
 _NUMPY_DTYPE = {
     Type.INT32: np.dtype("<i4"),
@@ -108,32 +119,105 @@ class RawPage:
         return self.header.type
 
 
-def split_pages(chunk: bytes, num_values: int) -> List[RawPage]:
+# the format stores page sizes as i32: anything past this ceiling is a
+# corrupt header, and refusing it here keeps a flipped size bit from
+# turning into a multi-GiB allocation attempt downstream
+_PAGE_SIZE_CAP = 1 << 31
+
+
+def _check_page_sizes(header: PageHeader, ctx: Optional[dict],
+                      ordinal: Optional[int],
+                      err_off: Optional[int] = None) -> None:
+    """Reject sizes outside the format's i32 range — shared by the
+    Python parser AND the native fast path (whose C scanner bounds the
+    compressed size against the buffer but never checks the declared
+    uncompressed size, the one that drives decompress allocation)."""
+    size = header.compressed_page_size
+    if size is None or size < 0 or size >= _PAGE_SIZE_CAP:
+        raise CorruptPageError(
+            f"page header declares invalid compressed size {size}",
+            page=ordinal, offset=err_off, **(ctx or {}),
+        )
+    usize = header.uncompressed_page_size
+    if usize is not None and (usize < 0 or usize >= _PAGE_SIZE_CAP):
+        raise CorruptPageError(
+            f"page header declares invalid uncompressed size {usize}",
+            page=ordinal, offset=err_off, **(ctx or {}),
+        )
+
+
+def parse_page_at(buf, pos: int, ctx: Optional[dict] = None,
+                  ordinal: Optional[int] = None,
+                  offset_base: Optional[int] = None):
+    """Parse ONE page (header + still-compressed payload) at ``buf[pos]``;
+    returns ``(RawPage, end_pos)``.  The single framing validator shared
+    by the chunk scan (:func:`split_pages`) and the ranged-read path
+    (``ParquetFileReader._read_raw_page``) — framing rules live here
+    once.  ``offset_base`` is the absolute file offset of ``buf[0]`` for
+    error context."""
+    err_off = pos if offset_base is None else offset_base + pos
+    reader = CompactReader(buf, pos)
+    try:
+        header = PageHeader.read(reader)
+    except ParquetError as e:
+        raise annotate(e, page=ordinal, offset=err_off, **(ctx or {}))
+    _check_page_sizes(header, ctx, ordinal, err_off)
+    size = header.compressed_page_size
+    payload = bytes(buf[reader.pos : reader.pos + size])
+    if len(payload) != size:
+        raise CorruptPageError(
+            f"page payload truncated: header said {size} bytes, "
+            f"buffer holds {len(payload)}",
+            page=ordinal, offset=err_off, **(ctx or {}),
+        )
+    return RawPage(header, payload), reader.pos + size
+
+
+def split_pages(chunk: bytes, num_values: int, ctx: Optional[dict] = None,
+                offset_base: Optional[int] = None) -> List[RawPage]:
     """Scan a column chunk byte range into raw pages (header parse only).
 
     Native single-pass scan when the library is built (the Thrift header
     chain is the staging loop's hottest pure-Python cost); exact Python
-    fallback below."""
+    fallback below.  ``ctx`` (path/column/row_group) contextualizes the
+    :class:`CorruptPageError` raised on bad framing; ``offset_base`` (the
+    chunk's absolute file offset) makes those errors name absolute byte
+    offsets, like every other taxonomy raise site."""
     if _native is not None and _native.available():
+        native = None
         try:
-            return _split_pages_native(chunk, num_values)
+            native = _split_pages_native(chunk, num_values)
         except ValueError:
             pass  # malformed per the native parser: let Python diagnose
+        if native is not None:
+            native_pages, offsets = native
+            for i, (p, off) in enumerate(zip(native_pages, offsets)):
+                _check_page_sizes(
+                    p.header, ctx, i,
+                    off if offset_base is None else offset_base + off,
+                )
+            return native_pages
     pages: List[RawPage] = []
-    reader = CompactReader(chunk)
+    pos = 0
+    end = len(chunk)
     seen_values = 0
-    while seen_values < num_values and reader.pos < reader.end:
-        header = PageHeader.read(reader)
-        size = header.compressed_page_size
-        payload = bytes(chunk[reader.pos : reader.pos + size])
-        if len(payload) != size:
-            raise ValueError("page payload truncated")
-        reader.pos += size
-        pages.append(RawPage(header, payload))
+    while seen_values < num_values and pos < end:
+        page_start = pos if offset_base is None else offset_base + pos
+        page, pos = parse_page_at(chunk, pos, ctx, len(pages), offset_base)
+        pages.append(page)
+        header = page.header
+        sub = None
         if header.type == PageType.DATA_PAGE:
-            seen_values += header.data_page_header.num_values
+            sub = header.data_page_header
         elif header.type == PageType.DATA_PAGE_V2:
-            seen_values += header.data_page_header_v2.num_values
+            sub = header.data_page_header_v2
+        if header.type in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+            if sub is None or sub.num_values is None:
+                raise CorruptPageError(
+                    "data page header is missing its num_values",
+                    page=len(pages) - 1, offset=page_start, **(ctx or {}),
+                )
+            seen_values += sub.num_values
     return pages
 
 
@@ -151,26 +235,51 @@ class DecodedPage:
     rep_levels: Optional[np.ndarray]
 
 
-def _verify_crc(header: PageHeader, payload: bytes, verify: bool) -> None:
+def _verify_crc(header: PageHeader, payload: bytes, verify: bool,
+                ctx: Optional[dict] = None) -> None:
+    """CRC32 the payload against the page header's stamp (when present and
+    verification is on — ``ReaderOptions(verify_crc=True)``)."""
     if verify and header.crc is not None:
         actual = zlib.crc32(payload) & 0xFFFFFFFF
-        if actual != header.crc & 0xFFFFFFFF:
-            raise ValueError(f"page CRC mismatch: {actual:#x} != {header.crc & 0xFFFFFFFF:#x}")
+        expected = header.crc & 0xFFFFFFFF
+        if actual != expected:
+            raise ChecksumMismatchError(
+                f"page CRC mismatch: computed {actual:#010x}, "
+                f"header says {expected:#010x}",
+                expected_crc=expected, actual_crc=actual, **(ctx or {}),
+            )
 
 
 def decode_dictionary_page(
-    page: RawPage, column: ColumnDescriptor, codec: int, verify_crc: bool = False
+    page: RawPage, column: ColumnDescriptor, codec: int, verify_crc: bool = False,
+    ctx: Optional[dict] = None,
 ):
-    dh: DictionaryPageHeader = page.header.dictionary_page_header
-    enc = dh.encoding if dh.encoding is not None else Encoding.PLAIN
-    if enc not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
-        raise ValueError(f"unsupported dictionary page encoding {Encoding.name(enc)}")
-    _verify_crc(page.header, page.payload, verify_crc)
-    data = codecs.decompress(codec, page.payload, page.header.uncompressed_page_size)
-    values, _ = e_plain.decode_plain(
-        data, dh.num_values, column.physical_type, column.type_length
-    )
-    return values
+    try:
+        dh: DictionaryPageHeader = page.header.dictionary_page_header
+        if dh is None:
+            raise CorruptPageError("dictionary page without its header struct")
+        enc = dh.encoding if dh.encoding is not None else Encoding.PLAIN
+        if enc not in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY):
+            raise UnsupportedFeatureError(
+                f"unsupported dictionary page encoding {Encoding.name(enc)}"
+            )
+        _verify_crc(page.header, page.payload, verify_crc)
+        data = codecs.decompress(codec, page.payload, page.header.uncompressed_page_size)
+        values, _ = e_plain.decode_plain(
+            data, dh.num_values, column.physical_type, column.type_length
+        )
+        return values
+    except ParquetError as e:
+        raise annotate(e, **(ctx or {}))
+    except (OSError, MemoryError):
+        raise  # transient I/O or host pressure, not corruption
+    except Exception as e:
+        # hostile payload bytes can trip any decoder invariant; corruption
+        # must always surface as taxonomy, never a raw IndexError deep in
+        # an encoding
+        raise CorruptPageError(
+            f"dictionary page decode failed: {e}", **(ctx or {})
+        ) from e
 
 
 def _decode_values(
@@ -185,10 +294,12 @@ def _decode_values(
     pt = column.physical_type
     if encoding in (Encoding.RLE_DICTIONARY, Encoding.PLAIN_DICTIONARY):
         if dictionary is None:
-            raise ValueError("dictionary-encoded page but no dictionary page seen")
+            raise CorruptPageError(
+                "dictionary-encoded page but no dictionary page seen"
+            )
         indices, _ = decode_dict_indices(data, n, pos)
         if np.any(indices >= _dict_len(dictionary)):
-            raise ValueError("dictionary index out of range")
+            raise CorruptPageError("dictionary index out of range")
         return gather(dictionary, indices)
     if encoding == Encoding.PLAIN:
         values, _ = e_plain.decode_plain(data, n, pt, column.type_length, offset=pos)
@@ -196,7 +307,7 @@ def _decode_values(
     if encoding == Encoding.RLE:
         # RLE-encoded BOOLEAN values (v2 writers); framed with u32 length.
         if pt != Type.BOOLEAN:
-            raise ValueError("RLE value encoding only defined for BOOLEAN")
+            raise CorruptPageError("RLE value encoding only defined for BOOLEAN")
         values, _ = e_rle.decode_length_prefixed(data, n, 1, pos)
         return values.astype(np.bool_)
     if encoding == Encoding.DELTA_BINARY_PACKED:
@@ -205,9 +316,9 @@ def _decode_values(
         elif pt == Type.INT64:
             values, _ = e_delta.decode_delta_binary_packed(data, pos, out_dtype=np.int64)
         else:
-            raise ValueError("DELTA_BINARY_PACKED only valid for INT32/INT64")
+            raise CorruptPageError("DELTA_BINARY_PACKED only valid for INT32/INT64")
         if len(values) < n:
-            raise ValueError("DELTA_BINARY_PACKED produced too few values")
+            raise CorruptPageError("DELTA_BINARY_PACKED produced too few values")
         return values[:n]
     if encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
         values, _ = e_delta.decode_delta_length_byte_array(data, pos)
@@ -218,8 +329,12 @@ def _decode_values(
     if encoding == Encoding.BYTE_STREAM_SPLIT:
         if pt in _NUMPY_DTYPE:
             return e_bss.decode_byte_stream_split(data, n, _NUMPY_DTYPE[pt], pos)
-        raise ValueError("BYTE_STREAM_SPLIT only supported for fixed-width types here")
-    raise ValueError(f"unsupported value encoding {Encoding.name(encoding)}")
+        raise UnsupportedFeatureError(
+            "BYTE_STREAM_SPLIT only supported for fixed-width types here"
+        )
+    raise UnsupportedFeatureError(
+        f"unsupported value encoding {Encoding.name(encoding)}"
+    )
 
 
 def _dict_len(dictionary) -> int:
@@ -232,10 +347,14 @@ def decode_data_page_v1(
     codec: int,
     dictionary,
     verify_crc: bool = False,
+    ctx: Optional[dict] = None,
 ) -> DecodedPage:
     h: DataPageHeader = page.header.data_page_header
+    if h is None:
+        raise CorruptPageError("v1 data page without its header struct",
+                               **(ctx or {}))
     n = h.num_values
-    _verify_crc(page.header, page.payload, verify_crc)
+    _verify_crc(page.header, page.payload, verify_crc, ctx)
     data = codecs.decompress(codec, page.payload, page.header.uncompressed_page_size)
     pos = 0
     rep_levels = None
@@ -248,7 +367,7 @@ def decode_data_page_v1(
         elif enc == Encoding.BIT_PACKED:  # deprecated legacy encoding
             levels, pos = e_rle.decode_bit_packed_legacy(data, n, bw, pos)
         else:
-            raise ValueError(
+            raise UnsupportedFeatureError(
                 f"unsupported {what} level encoding {Encoding.name(enc)}"
             )
         return levels
@@ -276,10 +395,14 @@ def decode_data_page_v2(
     codec: int,
     dictionary,
     verify_crc: bool = False,
+    ctx: Optional[dict] = None,
 ) -> DecodedPage:
     h: DataPageHeaderV2 = page.header.data_page_header_v2
+    if h is None:
+        raise CorruptPageError("v2 data page without its header struct",
+                               **(ctx or {}))
     n = h.num_values
-    _verify_crc(page.header, page.payload, verify_crc)
+    _verify_crc(page.header, page.payload, verify_crc, ctx)
     rl_len = h.repetition_levels_byte_length or 0
     dl_len = h.definition_levels_byte_length or 0
     payload = page.payload
@@ -308,13 +431,33 @@ def decode_data_page_v2(
 
 
 def decode_data_page(
-    page: RawPage, column: ColumnDescriptor, codec: int, dictionary, verify_crc: bool = False
+    page: RawPage, column: ColumnDescriptor, codec: int, dictionary,
+    verify_crc: bool = False, ctx: Optional[dict] = None,
 ) -> DecodedPage:
-    if page.page_type == PageType.DATA_PAGE:
-        return decode_data_page_v1(page, column, codec, dictionary, verify_crc)
-    if page.page_type == PageType.DATA_PAGE_V2:
-        return decode_data_page_v2(page, column, codec, dictionary, verify_crc)
-    raise ValueError(f"not a data page: type {page.page_type}")
+    """Decode one data page (v1 or v2) into a :class:`DecodedPage`.
+
+    Every failure mode surfaces as taxonomy (``ctx`` supplies file/column/
+    row-group/page location): :class:`ChecksumMismatchError` when a CRC
+    disagrees, :class:`UnsupportedFeatureError` for encodings this engine
+    lacks, :class:`CorruptPageError` for everything hostile bytes can trip
+    — including non-ValueError crashes deep inside an encoding decoder.
+    """
+    try:
+        if page.page_type == PageType.DATA_PAGE:
+            return decode_data_page_v1(page, column, codec, dictionary,
+                                       verify_crc, ctx)
+        if page.page_type == PageType.DATA_PAGE_V2:
+            return decode_data_page_v2(page, column, codec, dictionary,
+                                       verify_crc, ctx)
+        raise CorruptPageError(f"not a data page: type {page.page_type}")
+    except ParquetError as e:
+        raise annotate(e, **(ctx or {}))
+    except (OSError, MemoryError):
+        raise  # transient I/O or host pressure, not corruption
+    except Exception as e:
+        raise CorruptPageError(
+            f"data page decode failed: {e}", **(ctx or {})
+        ) from e
 
 
 # ---------------------------------------------------------------------------

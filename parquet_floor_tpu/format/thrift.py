@@ -18,6 +18,8 @@ from __future__ import annotations
 import struct
 from typing import Optional
 
+from ..errors import ParquetError
+
 # Compact-protocol type ids (wire values).
 CT_STOP = 0x00
 CT_BOOLEAN_TRUE = 0x01
@@ -34,8 +36,12 @@ CT_MAP = 0x0B
 CT_STRUCT = 0x0C
 
 
-class ThriftDecodeError(ValueError):
-    """Raised when bytes do not parse as valid compact-protocol Thrift."""
+class ThriftDecodeError(ParquetError, ValueError):
+    """Raised when bytes do not parse as valid compact-protocol Thrift.
+
+    Part of the :mod:`parquet_floor_tpu.errors` taxonomy (and still a
+    ``ValueError`` for pre-taxonomy callers); the footer/page layers wrap
+    or annotate it with file/column context."""
 
 
 def zigzag_encode(n: int) -> int:

@@ -15,7 +15,10 @@ import io
 import mmap
 import os
 import threading
+import time
 from typing import BinaryIO, Optional, Union
+
+from ..errors import IoRetryExhaustedError, TruncatedFileError
 
 PathLike = Union[str, os.PathLike]
 
@@ -56,8 +59,9 @@ class FileSource:
         """Positional read (thread-safe); returns exactly ``length`` bytes or
         raises."""
         if offset < 0 or offset + length > self._size:
-            raise EOFError(
-                f"read [{offset}, {offset + length}) outside file of {self._size} bytes"
+            raise TruncatedFileError(
+                f"read [{offset}, {offset + length}) outside file of {self._size} bytes",
+                path=self.name, offset=offset,
             )
         if self._buf is not None:
             return self._buf[offset : offset + length]
@@ -65,7 +69,10 @@ class FileSource:
             self._fh.seek(offset)
             data = self._fh.read(length)
         if len(data) != length:
-            raise EOFError(f"short read: wanted {length}, got {len(data)}")
+            raise TruncatedFileError(
+                f"short read: wanted {length}, got {len(data)}",
+                path=self.name, offset=offset,
+            )
         return memoryview(data)
 
     def close(self) -> None:
@@ -100,6 +107,69 @@ class FileSource:
         if self._own and self._fh is not None:
             self._fh.close()
             self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RetryingSource:
+    """Bounded retry-with-backoff over any positional source.
+
+    Retries ONLY ``OSError`` — the transient class (flaky NFS/FUSE mounts,
+    interrupted syscalls, object-store hiccups).  ``EOFError``/
+    ``TruncatedFileError`` and parse errors are *deterministic* facts about
+    the bytes and re-raise immediately: retrying them would turn a corrupt
+    file into a hang.  Off by default — enable via
+    ``ReaderOptions(io_retries=N)``.
+
+    After ``retries`` failed re-attempts the last error is wrapped in
+    :class:`~parquet_floor_tpu.errors.IoRetryExhaustedError` (still an
+    ``OSError``) carrying the attempt count and read offset.
+    """
+
+    def __init__(self, inner, retries: int, backoff_s: float = 0.05,
+                 sleep=time.sleep):
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
+        self._inner = inner
+        self._retries = int(retries)
+        self._backoff_s = float(backoff_s)
+        self._sleep = sleep
+        self.retried_reads = 0  # observability: how often retry saved a read
+
+    @property
+    def name(self) -> str:
+        return self._inner.name
+
+    @property
+    def size(self) -> int:
+        return self._inner.size
+
+    def read_at(self, offset: int, length: int) -> memoryview:
+        last: Optional[OSError] = None
+        for attempt in range(self._retries + 1):
+            try:
+                data = self._inner.read_at(offset, length)
+                if attempt:
+                    self.retried_reads += 1
+                return data
+            except (EOFError, TruncatedFileError):
+                raise  # deterministic: the bytes are not there
+            except OSError as e:
+                last = e
+                if attempt < self._retries:
+                    self._sleep(self._backoff_s * (2 ** attempt))
+        raise IoRetryExhaustedError(
+            f"read of {length} bytes failed after {self._retries + 1} "
+            f"attempts: {last}",
+            attempts=self._retries + 1, path=self.name, offset=offset,
+        ) from last
+
+    def close(self) -> None:
+        self._inner.close()
 
     def __enter__(self):
         return self

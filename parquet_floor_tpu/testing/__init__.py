@@ -1,0 +1,165 @@
+"""Fault-injection harness — deterministic hostile-storage simulation.
+
+SURVEY.md §5: the reference *swallows* I/O errors; this framework fails
+loudly — and this package is how that promise is *proved* rather than
+assumed.  :class:`FaultInjectingSource` wraps any positional source (a
+``FileSource``, a path, or raw bytes) and injects, deterministically from a
+seed:
+
+* **bit flips** at explicit ``(offset, mask)`` pairs (or random ones from
+  :meth:`FaultInjectingSource.random_flips`),
+* **truncation** — the file appears to end at ``truncate_at``,
+* **transient OSErrors** — a seeded per-read probability, optionally capped
+  so retries (``ReaderOptions(io_retries=N)``) eventually succeed,
+* **short reads** — a seeded probability that a read returns truncated.
+
+Downstream users can harden their own pipelines the same way the test
+suite does::
+
+    from parquet_floor_tpu.testing import FaultInjectingSource
+    from parquet_floor_tpu import ParquetFileReader, ReaderOptions
+
+    src = FaultInjectingSource("data.parquet", seed=7,
+                               transient_error_rate=0.2,
+                               max_transient_failures=3)
+    with ParquetFileReader(src, options=ReaderOptions(io_retries=4)) as r:
+        batch = r.read_row_group(0)   # survives the injected flakiness
+
+Determinism contract: identical construction arguments + identical sequence
+of ``read_at`` calls ⇒ identical injected faults.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from ..errors import TruncatedFileError
+from ..io.source import FileSource, RetryingSource  # noqa: F401  (re-export)
+
+__all__ = ["FaultInjectingSource", "RetryingSource"]
+
+
+class FaultInjectingSource:
+    """Deterministic, seeded fault-injection wrapper over a source.
+
+    Parameters
+    ----------
+    source:
+        A ``FileSource``-like object (anything with ``read_at``/``size``),
+        or a path / bytes, which are wrapped in a ``FileSource``.
+    seed:
+        Seed for the probability draws (transient errors, short reads).
+    bit_flips:
+        Iterable of ``(offset, xor_mask)`` pairs applied to any read that
+        covers ``offset``.  The underlying bytes are never mutated — reads
+        are copied before flipping.
+    truncate_at:
+        Virtual end-of-file: the source reports ``min(size, truncate_at)``
+        and reads past it raise
+        :class:`~parquet_floor_tpu.errors.TruncatedFileError`.
+    transient_error_rate:
+        Per-``read_at`` probability of raising ``OSError`` (the transient
+        class ``ReaderOptions(io_retries=...)`` retries).
+    max_transient_failures:
+        Cap on total injected OSErrors; None = unlimited.  Set it to let a
+        bounded retry loop eventually win.
+    short_read_rate:
+        Per-``read_at`` probability of simulating a short read (surfaced
+        as ``TruncatedFileError``, exactly what ``FileSource`` raises when
+        the filesystem returns fewer bytes than asked).
+    """
+
+    def __init__(
+        self,
+        source,
+        *,
+        seed: int = 0,
+        bit_flips: Iterable[Tuple[int, int]] = (),
+        truncate_at: Optional[int] = None,
+        transient_error_rate: float = 0.0,
+        max_transient_failures: Optional[int] = None,
+        short_read_rate: float = 0.0,
+    ):
+        self._inner = source if hasattr(source, "read_at") else FileSource(source)
+        self._rng = np.random.default_rng(seed)
+        self._flips: List[Tuple[int, int]] = [
+            (int(o), int(m) & 0xFF) for o, m in bit_flips
+        ]
+        self._truncate_at = truncate_at
+        self._transient_rate = float(transient_error_rate)
+        self._transient_budget = max_transient_failures
+        self._short_read_rate = float(short_read_rate)
+        # observability for assertions in harness tests
+        self.reads = 0
+        self.injected_transients = 0
+        self.injected_short_reads = 0
+        self.injected_flips = 0
+
+    @staticmethod
+    def random_flips(size: int, n: int, seed: int) -> List[Tuple[int, int]]:
+        """``n`` deterministic single-bit flips over a ``size``-byte file:
+        the standard corruption pattern for the fuzz smoke test."""
+        rng = np.random.default_rng(seed)
+        offsets = rng.integers(0, size, n)
+        bits = rng.integers(0, 8, n)
+        return [(int(o), 1 << int(b)) for o, b in zip(offsets, bits)]
+
+    @property
+    def name(self) -> str:
+        return f"fault-injecting({self._inner.name})"
+
+    @property
+    def size(self) -> int:
+        if self._truncate_at is None:
+            return self._inner.size
+        return min(self._inner.size, int(self._truncate_at))
+
+    def _draw(self, rate: float) -> bool:
+        return rate > 0.0 and float(self._rng.random()) < rate
+
+    def read_at(self, offset: int, length: int) -> memoryview:
+        self.reads += 1
+        if offset < 0 or offset + length > self.size:
+            raise TruncatedFileError(
+                f"read [{offset}, {offset + length}) outside "
+                f"(injected-truncation) file of {self.size} bytes",
+                path=self.name, offset=offset,
+            )
+        if self._draw(self._transient_rate) and (
+            self._transient_budget is None or
+            self.injected_transients < self._transient_budget
+        ):
+            self.injected_transients += 1
+            raise OSError(
+                f"injected transient I/O error "
+                f"(#{self.injected_transients} at offset {offset})"
+            )
+        if self._draw(self._short_read_rate):
+            self.injected_short_reads += 1
+            raise TruncatedFileError(
+                f"injected short read: wanted {length}, got {length // 2}",
+                path=self.name, offset=offset,
+            )
+        data = self._inner.read_at(offset, length)
+        hits = [
+            (o - offset, m) for o, m in self._flips
+            if offset <= o < offset + length
+        ]
+        if not hits:
+            return data
+        buf = bytearray(data)
+        for rel, mask in hits:
+            buf[rel] ^= mask
+            self.injected_flips += 1
+        return memoryview(bytes(buf))
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1692,7 +1692,23 @@ class TpuRowGroupReader:
         if dict_form not in ("gather", "index"):
             raise ValueError(f"bad dict_form {dict_form!r}")
         self._dict_form = dict_form
-        self.reader = source if isinstance(source, ParquetFileReader) else ParquetFileReader(source)
+        owns_reader = not isinstance(source, ParquetFileReader)
+        self.reader = source if not owns_reader else ParquetFileReader(source)
+        opts = getattr(self.reader, "options", None)
+        if opts is not None and (opts.verify_crc or opts.salvage):
+            # the robustness contract lives at THIS boundary, not just the
+            # API wrapper above it: the fused device path has no CRC check
+            # and no quarantine, so silently accepting such a reader would
+            # skip the verification it was configured for
+            from ..errors import UnsupportedFeatureError
+
+            if owns_reader:
+                self.reader.close()
+            raise UnsupportedFeatureError(
+                "ReaderOptions.verify_crc/salvage are host-engine "
+                "features; the TPU engine cannot honor them — decode via "
+                "the host engine instead"
+            )
         self.device = device
         if float64_policy not in ("auto", "float64", "float32", "bits"):
             raise ValueError(f"bad float64_policy {float64_policy!r}")

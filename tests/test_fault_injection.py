@@ -1,0 +1,175 @@
+"""Fault-injection harness (parquet_floor_tpu.testing) + bounded I/O
+retries (ReaderOptions.io_retries / io.source.RetryingSource)."""
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    IoRetryExhaustedError,
+    ParquetFileReader,
+    ParquetFileWriter,
+    ReaderOptions,
+    TruncatedFileError,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.io.source import FileSource, RetryingSource
+from parquet_floor_tpu.testing import FaultInjectingSource
+
+
+@pytest.fixture(scope="module")
+def small_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("faults") / "v.parquet"
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("a"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    rng = np.random.default_rng(5)
+    with ParquetFileWriter(path, schema, WriterOptions(data_page_values=400)) as w:
+        w.write_columns({
+            "a": rng.integers(0, 1 << 40, 2000).astype(np.int64),
+            "s": [None if i % 7 == 0 else f"row{i % 97}" for i in range(2000)],
+        })
+    return str(path)
+
+
+def test_bit_flips_are_deterministic_and_nonmutating(small_file):
+    flips = [(100, 0x01), (101, 0x80)]
+    with FaultInjectingSource(small_file, bit_flips=flips) as src:
+        a = bytes(src.read_at(90, 30))
+        b = bytes(src.read_at(90, 30))
+        assert a == b  # same call, same injected bytes
+        assert src.injected_flips == 4
+    clean = open(small_file, "rb").read()[90:120]
+    assert a != clean
+    assert bytes([a[10] ^ 0x01, a[11] ^ 0x80]) == clean[10:12]
+    # partial overlap: only the flip inside the window applies
+    with FaultInjectingSource(small_file, bit_flips=flips) as src:
+        w = bytes(src.read_at(101, 5))
+        assert w[0] == clean[11] ^ 0x80
+    # the file on disk is untouched
+    assert open(small_file, "rb").read()[90:120] == clean
+
+
+def test_random_flips_deterministic():
+    a = FaultInjectingSource.random_flips(10_000, 16, seed=42)
+    b = FaultInjectingSource.random_flips(10_000, 16, seed=42)
+    c = FaultInjectingSource.random_flips(10_000, 16, seed=43)
+    assert a == b
+    assert a != c
+    assert all(0 <= o < 10_000 and m in {1 << k for k in range(8)} for o, m in a)
+
+
+def test_truncation_injection(small_file):
+    real = FileSource(small_file)
+    try:
+        cut = real.size // 2
+        src = FaultInjectingSource(small_file, truncate_at=cut)
+        assert src.size == cut
+        src.read_at(cut - 10, 10)  # inside the virtual file: fine
+        with pytest.raises(TruncatedFileError):
+            src.read_at(cut - 5, 10)
+        # a reader over the truncated source fails loudly (footer gone)
+        with pytest.raises((ValueError, EOFError)):
+            ParquetFileReader(src)
+        src.close()
+    finally:
+        real.close()
+
+
+def test_transient_errors_and_retry_loop(small_file):
+    """Injected transient OSErrors are healed by ReaderOptions.io_retries
+    and the whole file decodes to the exact clean values."""
+    src = FaultInjectingSource(
+        small_file, seed=11, transient_error_rate=0.4,
+        max_transient_failures=8,
+    )
+    opts = ReaderOptions(io_retries=10, io_retry_backoff_s=0.0005)
+    with ParquetFileReader(src, options=opts) as r:
+        got = [b for b in r.iter_row_groups()]
+    with ParquetFileReader(small_file) as r:
+        want = [b for b in r.iter_row_groups()]
+    assert src.injected_transients > 0
+    for gb, wb in zip(got, want):
+        assert np.array_equal(gb.column("a").values, wb.column("a").values)
+
+
+def test_retry_exhaustion_raises_taxonomy(small_file):
+    """Unbounded transient failures exhaust the retry budget and surface
+    as IoRetryExhaustedError (still an OSError) with attempt count."""
+    src = FaultInjectingSource(small_file, seed=1, transient_error_rate=1.0)
+    with pytest.raises(IoRetryExhaustedError) as ei:
+        ParquetFileReader(src, options=ReaderOptions(
+            io_retries=2, io_retry_backoff_s=0.0001
+        ))
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value, OSError)
+    src.close()
+
+
+def test_retries_never_mask_deterministic_errors(small_file):
+    """Truncation is a fact about the bytes: the retry loop must re-raise
+    immediately, not spin on it."""
+    real = FileSource(small_file)
+    retry = RetryingSource(real, retries=5, backoff_s=10.0)  # would hang if slept
+    try:
+        with pytest.raises(TruncatedFileError):
+            retry.read_at(real.size - 4, 100)
+    finally:
+        retry.close()
+
+
+def test_retry_off_by_default(small_file):
+    """io_retries=0 (the default): the first transient error propagates."""
+    src = FaultInjectingSource(small_file, seed=2, transient_error_rate=1.0)
+    with pytest.raises(OSError):
+        ParquetFileReader(src)
+    src.close()
+
+
+def test_transient_error_is_never_salvaged_as_corruption(small_file):
+    """Salvage mode must not quarantine healthy data on an I/O blip: a
+    transient OSError mid-decode propagates (it is retryable, not
+    corruption) and nothing lands in the salvage report."""
+    src = FaultInjectingSource(small_file, seed=21, transient_error_rate=0.0)
+    opts = ReaderOptions(salvage=True)
+    with ParquetFileReader(src, options=opts) as r:
+        src._transient_rate = 1.0  # footer reads done; chunk reads now fail
+        with pytest.raises(OSError):
+            r.read_row_group(0)
+        rep = r.salvage_report
+        assert rep.chunks_quarantined == 0 and rep.skips == []
+
+
+def test_constructor_failure_closes_owned_file(tmp_path, monkeypatch):
+    """A corrupt footer raising out of ParquetFileReader(path) must close
+    the FileSource the constructor itself opened (directory sniffs over
+    damaged corpora must not leak one fd per bad file)."""
+    bad = tmp_path / "garbage.parquet"
+    bad.write_bytes(b"PAR1" + b"\x00" * 64)
+    closed = []
+    orig = FileSource.close
+    monkeypatch.setattr(
+        FileSource, "close",
+        lambda self: (closed.append(1), orig(self))[1],
+    )
+    with pytest.raises(ValueError):
+        ParquetFileReader(str(bad))
+    assert closed, "constructor leaked the FileSource it opened"
+
+
+def test_caller_retrying_source_is_not_double_wrapped(small_file):
+    """A user-supplied RetryingSource + ReaderOptions.io_retries must not
+    nest retry loops (attempts would multiply)."""
+    src = RetryingSource(FileSource(small_file), retries=1)
+    with ParquetFileReader(src, options=ReaderOptions(io_retries=5)) as r:
+        assert r.source is src
+
+
+def test_short_read_injection(small_file):
+    src = FaultInjectingSource(small_file, seed=9, short_read_rate=1.0)
+    with pytest.raises(TruncatedFileError, match="injected short read"):
+        src.read_at(0, 64)
+    assert src.injected_short_reads == 1
+    src.close()

@@ -1,0 +1,146 @@
+"""Seeded corruption fuzz smoke (ISSUE 1 satellite): bit-flip random
+offsets of a valid reference file through FaultInjectingSource and assert
+every outcome is either a clean ParquetError or a byte-exact correct
+decode — never a hang (per-case SIGALRM timeout), never a leaked
+non-taxonomy crash, never silent wrong data (strict mode, CRC on,
+compared against the known-good decode).
+
+A small subset runs in tier-1; the full >=200-case sweep is ``slow``.
+"""
+
+import contextlib
+import signal
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    ParquetError,
+    ParquetFileReader,
+    ParquetFileWriter,
+    ReaderOptions,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+from parquet_floor_tpu.testing import FaultInjectingSource
+
+PER_CASE_TIMEOUT_S = 20.0
+
+
+@pytest.fixture(scope="module")
+def reference_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("fuzz_smoke") / "ref.parquet"
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("a"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.DOUBLE).named("d"),
+    )
+    rng = np.random.default_rng(17)
+    with ParquetFileWriter(path, schema, WriterOptions(data_page_values=300)) as w:
+        for _ in range(2):
+            w.write_columns({
+                "a": rng.integers(0, 1 << 30, 1500).astype(np.int64),
+                "s": [None if i % 13 == 0 else f"value-{i % 211}"
+                      for i in range(1500)],
+                "d": rng.standard_normal(1500),
+            })
+    return str(path)
+
+
+def _canonical(source):
+    """Full strict decode (CRC verified) reduced to comparable bytes."""
+    out = []
+    with ParquetFileReader(source, options=ReaderOptions(verify_crc=True)) as r:
+        for batch in r.iter_row_groups():
+            for c in batch.columns:
+                v = c.values
+                if isinstance(v, ByteArrayColumn):
+                    payload = (v.offsets.tobytes(), v.data.tobytes())
+                else:
+                    payload = np.asarray(v).tobytes()
+                levels = (
+                    None if c.def_levels is None else c.def_levels.tobytes()
+                )
+                out.append((tuple(c.descriptor.path), batch.num_rows,
+                            payload, levels))
+    return out
+
+
+class _CaseTimeout(Exception):
+    pass
+
+
+@contextlib.contextmanager
+def _time_limit(seconds: float):
+    def _handler(signum, frame):
+        raise _CaseTimeout()
+
+    old = signal.signal(signal.SIGALRM, _handler)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _flips_for(seed: int, size: int):
+    """1-4 deterministic single-bit flips; every 5th seed aims at the
+    footer region, where parse complexity (and hang risk) concentrates."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 5))
+    if seed % 5 == 0:
+        lo = max(0, size - 2048)
+        offsets = rng.integers(lo, size, n)
+    else:
+        offsets = rng.integers(0, size, n)
+    bits = rng.integers(0, 8, n)
+    return [(int(o), 1 << int(b)) for o, b in zip(offsets, bits)]
+
+
+def _run_cases(path, good, seeds):
+    size = len(open(path, "rb").read())
+    hangs, leaks, wrong = [], [], []
+    for seed in seeds:
+        src = FaultInjectingSource(path, bit_flips=_flips_for(seed, size))
+        try:
+            with _time_limit(PER_CASE_TIMEOUT_S):
+                got = _canonical(src)
+        except _CaseTimeout:
+            hangs.append(seed)
+        except ParquetError:
+            pass  # clean, typed failure: the contract
+        except Exception as e:  # noqa: BLE001 - the whole point of the fuzz
+            leaks.append((seed, type(e).__name__, str(e)[:120]))
+        else:
+            if got != good:
+                wrong.append(seed)
+        finally:
+            src.close()
+    assert not hangs, f"decode hung (> {PER_CASE_TIMEOUT_S}s) for seeds {hangs}"
+    assert not leaks, (
+        "corruption escaped the ParquetError taxonomy: "
+        + "; ".join(f"seed {s}: {t}: {m}" for s, t, m in leaks)
+    )
+    assert not wrong, f"SILENT WRONG DATA for seeds {wrong}"
+
+
+def test_fuzz_smoke_tier1(reference_file):
+    """Small always-on subset: fast corruption confidence in tier-1."""
+    good = _canonical(reference_file)
+    _run_cases(reference_file, good, range(48))
+
+
+@pytest.mark.slow
+def test_fuzz_smoke_full(reference_file):
+    """The full sweep: >=200 additional seeded corruptions."""
+    good = _canonical(reference_file)
+    _run_cases(reference_file, good, range(48, 320))
+
+
+def test_fuzz_reference_file_is_clean(reference_file):
+    """Sanity: the uncorrupted reference decodes and compares equal to
+    itself through the same canonicalization."""
+    assert _canonical(reference_file) == _canonical(reference_file)

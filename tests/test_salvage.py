@@ -1,0 +1,400 @@
+"""Salvage mode: quarantine damaged pages/chunks, decode the rest, account
+for every lost row (ISSUE 1 tentpole part 3).  Strict mode stays the
+default and fails loudly on the same files."""
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    ChecksumMismatchError,
+    ParquetError,
+    ParquetFileReader,
+    ParquetFileWriter,
+    ReaderOptions,
+    WriterOptions,
+    trace,
+    types,
+)
+from parquet_floor_tpu.format.parquet_thrift import PageHeader, PageType
+from parquet_floor_tpu.format.thrift import CompactReader
+
+ROWS_PER_GROUP = 2500
+PAGE_VALUES = 500
+N_GROUPS = 2
+
+
+@pytest.fixture(scope="module")
+def salvage_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("salvage") / "v.parquet"
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("a"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.DOUBLE).named("d"),
+    )
+    rng = np.random.default_rng(3)
+    with ParquetFileWriter(
+        path, schema, WriterOptions(data_page_values=PAGE_VALUES)
+    ) as w:
+        for _ in range(N_GROUPS):
+            w.write_columns({
+                "a": rng.integers(0, 10_000, ROWS_PER_GROUP).astype(np.int64),
+                "s": [None if i % 11 == 0 else f"val{i % 321}"
+                      for i in range(ROWS_PER_GROUP)],
+                "d": rng.standard_normal(ROWS_PER_GROUP),
+            })
+    return str(path)
+
+
+def _page_spans(reader, rg_idx, col):
+    """(payload_offset, payload_size, is_dict, ordinal) per page of the
+    chunk, by walking the real header chain."""
+    rg = reader.row_groups[rg_idx]
+    chunk = [c for c in rg.columns if c.meta_data.path_in_schema[0] == col][0]
+    m = chunk.meta_data
+    start = m.data_page_offset
+    if m.dictionary_page_offset:
+        start = min(start, m.dictionary_page_offset)
+    raw = bytes(reader.source.read_at(start, m.total_compressed_size))
+    cr = CompactReader(raw)
+    spans, i = [], 0
+    while cr.pos < len(raw):
+        h = PageHeader.read(cr)
+        spans.append((
+            start + cr.pos, h.compressed_page_size,
+            h.type == PageType.DICTIONARY_PAGE, i,
+        ))
+        cr.pos += h.compressed_page_size
+        i += 1
+    return spans
+
+
+def _flip_in_page(path, tmp_path, rg_idx, col, data_page_index, stem):
+    """Flip one payload bit of the chunk's N-th DATA page; returns the
+    corrupted file's path and the page's ordinal within the chunk."""
+    with ParquetFileReader(path) as r:
+        spans = _page_spans(r, rg_idx, col)
+    off, size, _, ordinal = [s for s in spans if not s[2]][data_page_index]
+    data = bytearray(open(path, "rb").read())
+    data[off + size // 2] ^= 0x10
+    out = tmp_path / f"{stem}.parquet"
+    out.write_bytes(bytes(data))
+    return str(out), ordinal
+
+
+def _decode_all(path, **options):
+    opts = ReaderOptions(**options)
+    with ParquetFileReader(path, options=opts) as r:
+        groups = list(r.iter_row_groups())
+        for g in groups:
+            for c in g.columns:
+                _ = c.values
+                _ = c.def_levels
+        return groups, r.salvage_report
+
+
+def test_salvage_demo_required_column(salvage_file, tmp_path):
+    """The acceptance demo: one bit-flipped data page in column ``d``
+    (required — no null substitution possible) decodes all other columns
+    and all row groups in salvage mode, raises ChecksumMismatchError in
+    strict mode, and the report accounts for exactly the quarantined
+    rows."""
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "bad_d")
+
+    # strict mode (the default): fail loudly
+    with pytest.raises(ChecksumMismatchError):
+        _decode_all(bad, verify_crc=True)
+
+    # salvage: everything except (d, rg0) decodes
+    groups, rep = _decode_all(bad, verify_crc=True, salvage=True)
+    assert [g.num_rows for g in groups] == [ROWS_PER_GROUP] * N_GROUPS
+    assert sorted(c.descriptor.path[0] for c in groups[0].columns) == ["a", "s"]
+    assert sorted(c.descriptor.path[0] for c in groups[1].columns) == ["a", "d", "s"]
+
+    # surviving data is byte-identical to the pristine decode
+    pristine, _ = _decode_all(salvage_file)
+    assert np.array_equal(groups[0].column("a").values,
+                          pristine[0].column("a").values)
+    assert np.array_equal(groups[1].column("d").values,
+                          pristine[1].column("d").values)
+    assert np.array_equal(groups[0].column("s").def_levels,
+                          pristine[0].column("s").def_levels)
+
+    # the report accounts for exactly the quarantined rows
+    assert rep.chunks_quarantined == 1
+    assert rep.rows_quarantined == ROWS_PER_GROUP
+    assert rep.pages_skipped == 0
+    assert [s.column for s in rep.skips] == ["d"]
+    assert rep.skips[0].row_group == 0 and rep.skips[0].page is None
+    assert "CRC mismatch" in rep.first_errors["d"]
+
+
+def test_salvage_nulls_optional_column_page(salvage_file, tmp_path):
+    """A damaged page of an OPTIONAL flat column quarantines only that
+    page: its rows survive as nulls, the rest of the column (and every
+    other column) decodes exactly."""
+    bad, ordinal = _flip_in_page(salvage_file, tmp_path, 1, "s", 2, "bad_s")
+
+    groups, rep = _decode_all(bad, verify_crc=True, salvage=True)
+    # every column of every group present; all rows preserved
+    for g in groups:
+        assert sorted(c.descriptor.path[0] for c in g.columns) == ["a", "d", "s"]
+        assert g.num_rows == ROWS_PER_GROUP
+
+    assert rep.pages_skipped == 1 and rep.chunks_quarantined == 0
+    assert rep.rows_quarantined == PAGE_VALUES
+    skip = rep.skips[0]
+    assert skip.column == "s" and skip.row_group == 1
+    assert skip.page == ordinal and skip.rows == PAGE_VALUES
+
+    # nulled page = def levels forced 0 exactly on its row span; all other
+    # spans identical to pristine
+    pristine, _ = _decode_all(salvage_file)
+    dl_bad = groups[1].column("s").def_levels
+    dl_good = pristine[1].column("s").def_levels
+    data_page_index = 2
+    lo, hi = data_page_index * PAGE_VALUES, (data_page_index + 1) * PAGE_VALUES
+    assert np.all(dl_bad[lo:hi] == 0)
+    assert np.array_equal(dl_bad[:lo], dl_good[:lo])
+    assert np.array_equal(dl_bad[hi:], dl_good[hi:])
+    # values outside the quarantined page are the exact pristine bytes
+    sb, sg = groups[1].column("s"), pristine[1].column("s")
+    vals_bad = [sb.cell(i) for i in range(lo)]
+    vals_good = [sg.cell(i) for i in range(lo)]
+    assert vals_bad == vals_good
+
+
+def test_salvage_records_trace_decisions(salvage_file, tmp_path):
+    """Each quarantine lands as a structured trace.decision event."""
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 0, "bad_trace")
+    trace.enable()
+    try:
+        trace.reset()
+        _decode_all(bad, verify_crc=True, salvage=True)
+        kinds = [d["decision"] for d in trace.decisions()]
+        assert "salvage.quarantine_chunk" in kinds
+        assert "salvage.report" in kinds
+        chunk_evt = [d for d in trace.decisions()
+                     if d["decision"] == "salvage.quarantine_chunk"][0]
+        assert chunk_evt["column"] == "d" and chunk_evt["row_group"] == 0
+    finally:
+        trace.disable()
+        trace.reset()
+
+
+def test_salvage_without_crc_catches_framing_damage(salvage_file, tmp_path):
+    """Even without CRC verification, damage that breaks page framing
+    (here: the second page's Thrift header) fails loudly in strict mode
+    and quarantines the chunk in salvage mode."""
+    with ParquetFileReader(salvage_file) as r:
+        spans = _page_spans(r, 0, "a")
+    # header of the second page starts where the first page's payload ends
+    off0, size0, _, _ = spans[0]
+    second_header = off0 + size0
+    data = bytearray(open(salvage_file, "rb").read())
+    data[second_header] = 0xFF  # compact type 0x0F: unskippable garbage
+    bad = tmp_path / "bad_framing.parquet"
+    bad.write_bytes(bytes(data))
+
+    with pytest.raises(ParquetError) as ei:
+        _decode_all(str(bad))
+    # framing errors name the ABSOLUTE byte offset of the bad header
+    assert ei.value.offset == second_header
+
+    groups, rep = _decode_all(str(bad), salvage=True)
+    assert rep.rows_quarantined >= PAGE_VALUES
+    assert any(s.column == "a" for s in rep.skips)
+    # untouched groups/columns still whole
+    assert groups[-1].num_rows == ROWS_PER_GROUP
+
+
+def test_salvage_batch_face_marks_quarantined_column(salvage_file, tmp_path):
+    """stream_batches over a salvaged file: the quarantined chunk stays
+    in POSITION as a quarantined placeholder (positional hydrators never
+    silently read a shifted column), not a KeyError."""
+    from parquet_floor_tpu import ParquetReader
+
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "bad_batch")
+    opts = ReaderOptions(verify_crc=True, salvage=True)
+    groups = list(ParquetReader.stream_batches(bad, options=opts))
+    names = [[c.descriptor.path[0] for c in cols] for cols in groups]
+    assert names == [["a", "s", "d"], ["a", "s", "d"]]  # order intact
+    flags = [[c.quarantined for c in cols] for cols in groups]
+    assert flags == [[False, False, True], [False, False, False]]
+    assert groups[0][2].values is None
+    # touching the placeholder's data fails LOUDLY on every accessor
+    for accessor in ("to_numpy", "to_arrow", "bytes_list"):
+        with pytest.raises(ValueError, match="quarantined"):
+            getattr(groups[0][2], accessor)()
+    assert groups[1][2].values is not None
+    assert groups[1][2].to_numpy().shape[0] == ROWS_PER_GROUP
+
+
+def test_salvage_row_api_serves_none_for_quarantined_column(salvage_file, tmp_path):
+    """The row-streaming API keeps flowing over a chunk quarantine:
+    cells of the quarantined column come back None for that group (and
+    real values elsewhere), instead of an opaque RuntimeError."""
+    from parquet_floor_tpu import ParquetReader
+    from parquet_floor_tpu.api.hydrate import HydratorSupplier, dict_hydrator
+
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "bad_rows")
+    opts = ReaderOptions(verify_crc=True, salvage=True)
+    rows = list(ParquetReader.stream_content(
+        bad, HydratorSupplier.constantly(dict_hydrator()), options=opts))
+    assert len(rows) == N_GROUPS * ROWS_PER_GROUP
+    assert all(r["d"] is None for r in rows[:ROWS_PER_GROUP])
+    assert all(r["d"] is not None for r in rows[ROWS_PER_GROUP:])
+    assert all(r["a"] is not None for r in rows)
+    # strict mode on the same file still fails loudly through the row API
+    with pytest.raises(RuntimeError, match="Failed to read parquet"):
+        list(ParquetReader.stream_content(
+            bad, HydratorSupplier.constantly(dict_hydrator()),
+            options=ReaderOptions(verify_crc=True)))
+
+
+def test_salvage_null_cursor_needs_a_quarantine_record(salvage_file):
+    """A column missing from a row group WITHOUT a recorded quarantine
+    (corrupt-but-parseable footer) must raise, not silently serve nulls
+    — null substitution is only for losses the report accounts for."""
+    from parquet_floor_tpu import ParquetReader
+    from parquet_floor_tpu.api.hydrate import HydratorSupplier, dict_hydrator
+
+    r = ParquetReader.spliterator(
+        salvage_file, HydratorSupplier.constantly(dict_hydrator()),
+        options=ReaderOptions(salvage=True),
+    )
+    try:
+        rg = r._reader.row_groups[0]
+        rg.columns = [
+            c for c in rg.columns if c.meta_data.path_in_schema[0] != "d"
+        ]
+        with pytest.raises(RuntimeError, match="Failed to read parquet"):
+            next(r)
+        assert r._reader.salvage_report.skips == []
+    finally:
+        r.close()
+
+
+def test_robustness_options_pin_host_engine(salvage_file):
+    """verify_crc/salvage are host-only: engine='tpu' refuses loudly,
+    engine='auto' routes to host (recorded as a trace decision)."""
+    from parquet_floor_tpu import ParquetReader, UnsupportedFeatureError, trace
+    from parquet_floor_tpu.api.hydrate import HydratorSupplier, dict_hydrator
+
+    sup = HydratorSupplier.constantly(dict_hydrator())
+    with pytest.raises(UnsupportedFeatureError, match="host-engine"):
+        ParquetReader.spliterator(
+            salvage_file, sup, engine="tpu",
+            options=ReaderOptions(verify_crc=True),
+        )
+    trace.enable()
+    try:
+        trace.reset()
+        r = ParquetReader.spliterator(
+            salvage_file, sup, engine="auto",
+            options=ReaderOptions(salvage=True),
+        )
+        try:
+            assert r.engine == "host"
+            why = [d for d in trace.decisions()
+                   if d["decision"] == "engine.auto"]
+            assert why and "pin the host" in why[0]["why"]
+        finally:
+            r.close()
+    finally:
+        trace.disable()
+        trace.reset()
+
+
+def test_tpu_engine_refuses_robustness_options_directly(salvage_file):
+    """The guard holds at the engine boundary too: constructing
+    TpuRowGroupReader on an options-carrying reader raises instead of
+    silently skipping CRC/salvage."""
+    from parquet_floor_tpu import UnsupportedFeatureError
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+
+    r = ParquetFileReader(salvage_file, options=ReaderOptions(verify_crc=True))
+    try:
+        with pytest.raises(UnsupportedFeatureError, match="host-engine"):
+            TpuRowGroupReader(r)
+    finally:
+        r.close()
+
+
+def test_projection_never_hides_metaless_chunk(salvage_file):
+    """A chunk whose meta_data is gone cannot be silently skipped by a
+    column_filter — it must fail loudly as CorruptFooterError."""
+    from parquet_floor_tpu import CorruptFooterError
+
+    with ParquetFileReader(salvage_file) as r:
+        r.row_groups[0].columns[0].meta_data = None
+        with pytest.raises(CorruptFooterError):
+            r.read_row_group(0, {"a"})
+
+
+def test_salvage_report_is_idempotent_per_chunk(salvage_file, tmp_path):
+    """Re-decoding a row group (restore(), repeated read_row_group) must
+    not double-count its quarantines or recoveries."""
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "bad_twice")
+    opts = ReaderOptions(verify_crc=True, salvage=True)
+    with ParquetFileReader(bad, options=opts) as r:
+        r.read_row_group(0)
+        first = r.salvage_report.summary()
+        r.read_row_group(0)  # deterministic re-decode of the same group
+        assert r.salvage_report.summary() == first
+        assert r.salvage_report.chunks_quarantined == 1
+        assert r.salvage_report.rows_quarantined == ROWS_PER_GROUP
+        assert len(r.salvage_report.skips) == 1
+        # unknown group index never dedupes (None keys would collide
+        # across groups and hide real losses)
+        assert r.salvage_report._first_count("a", None, "q")
+        assert r.salvage_report._first_count("a", None, "q")
+
+
+def test_salvage_report_reachable_from_row_stream(salvage_file, tmp_path):
+    """The public row stream exposes the SalvageReport, and the report
+    survives stream exhaustion (losses stay accountable)."""
+    from parquet_floor_tpu import ParquetReader
+    from parquet_floor_tpu.api.hydrate import HydratorSupplier, dict_hydrator
+
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "bad_report")
+    it = ParquetReader.stream_content(
+        bad, HydratorSupplier.constantly(dict_hydrator()),
+        options=ReaderOptions(verify_crc=True, salvage=True))
+    n = sum(1 for _ in it)  # exhausts and closes the stream
+    assert n == N_GROUPS * ROWS_PER_GROUP
+    rep = it.salvage_report
+    assert rep is not None and rep.chunks_quarantined == 1
+    assert rep.skips[0].column == "d"
+
+
+def test_quarantine_after_earlier_success_still_recorded(salvage_file):
+    """A chunk that decoded fine once but fails on a later re-read (file
+    changed underneath, flaky storage) must STILL get a skip record —
+    every omission has a report entry."""
+    from parquet_floor_tpu.testing import FaultInjectingSource
+
+    src = FaultInjectingSource(salvage_file)
+    with ParquetFileReader(src, options=ReaderOptions(salvage=True)) as r:
+        g0 = r.read_row_group(0)
+        assert len(g0.columns) == 3  # clean decode, all counted as "ok"
+        src._truncate_at = 64  # storage "changes underneath"
+        g0b = r.read_row_group(0)
+        assert len(g0b.columns) == 0  # every chunk now quarantined
+        assert len(r.salvage_report.skips) == 3
+        assert r.salvage_report.chunks_quarantined == 3
+
+
+def test_strict_mode_is_default_and_identical(salvage_file):
+    """salvage defaults off; a clean file decodes identically with and
+    without the flag, and no report is accumulated in strict mode."""
+    strict, rep_strict = _decode_all(salvage_file)
+    salv, rep_salv = _decode_all(salvage_file, salvage=True)
+    assert rep_strict is None
+    assert rep_salv is not None and rep_salv.skips == []
+    assert rep_salv.rows_quarantined == 0
+    for gs, gv in zip(strict, salv):
+        for cs, cv in zip(gs.columns, gv.columns):
+            assert cs.descriptor.path == cv.descriptor.path
+            if isinstance(cs.values, np.ndarray):
+                assert np.array_equal(cs.values, cv.values)

@@ -201,7 +201,8 @@ def test_native_split_pages_matches_python(tmp_path):
                 # copy: read_at may hand back an mmap-backed view, which
                 # must not outlive the reader
                 raw = bytes(r.source.read_at(start, meta.total_compressed_size))
-                nat = pg._split_pages_native(raw, meta.num_values)
+                nat, nat_offsets = pg._split_pages_native(raw, meta.num_values)
+                assert len(nat_offsets) == len(nat)
                 # force the python path
                 import parquet_floor_tpu.format.pages as pgm
                 saved = pgm._native

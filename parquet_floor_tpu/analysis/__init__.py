@@ -1,0 +1,41 @@
+"""floorlint — the project-invariant static analyzer (stdlib-only).
+
+``scripts/lint.py`` checks style; this package checks the *invariants*
+the robustness layer depends on — the bug classes PR 1 fixed by fuzzing
+become unrepresentable at commit time:
+
+========== ==================================================================
+FL-EXC     error-taxonomy guards: no broad except that misclassifies
+           OSError/MemoryError as corruption, ``raise ... from`` discipline,
+           location context on boundary taxonomy raises
+FL-TPU     tracer/host-purity guards: no host I/O or host materialization
+           inside ``jax.jit``/Pallas-traced functions in ``tpu/``
+FL-RES     resource guards: every ``open()``/Source acquisition is
+           context-managed or closed on all exception paths
+FL-ALLOC   allocation guards: sizes parsed off the wire flow through
+           ``errors.checked_alloc_size``
+========== ==================================================================
+
+CLI: ``python -m parquet_floor_tpu.analysis [paths ...]``.
+Docs: ``docs/static_analysis.md``.
+"""
+
+from .core import (  # noqa: F401  (public surface)
+    RunResult,
+    Violation,
+    analyze_file,
+    iter_python_files,
+    load_baseline,
+    run,
+    write_baseline,
+)
+from . import rules_alloc, rules_exc, rules_res, rules_tpu
+
+ALL_RULES = (
+    rules_exc.RULES + rules_tpu.RULES + rules_res.RULES + rules_alloc.RULES
+)
+
+__all__ = [
+    "ALL_RULES", "RunResult", "Violation", "analyze_file",
+    "iter_python_files", "load_baseline", "run", "write_baseline",
+]

@@ -1,0 +1,75 @@
+"""CLI: ``python -m parquet_floor_tpu.analysis [paths ...]``.
+
+Exit status: 0 clean, 1 violations, 2 usage error.  Violations print as
+``file:line: RULE-ID message`` — the same shape scripts/lint.py emits, so
+editors and CI parse both identically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from . import ALL_RULES, load_baseline, run, write_baseline
+
+DEFAULT_TARGETS = ("parquet_floor_tpu", "tests", "scripts")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m parquet_floor_tpu.analysis",
+        description="floorlint: project-invariant static analysis",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: "
+                         + " ".join(DEFAULT_TARGETS) + ", where present)")
+    ap.add_argument("--baseline", type=pathlib.Path,
+                    default=pathlib.Path("floorlint.baseline"),
+                    help="baseline file of accepted fingerprints "
+                         "(default: ./floorlint.baseline when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept every current violation into --baseline "
+                         "and exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, doc in ALL_RULES:
+            print(f"{rule}  {doc}")
+        return 0
+
+    paths = args.paths or [t for t in DEFAULT_TARGETS
+                           if pathlib.Path(t).exists()]
+    if not paths:
+        ap.error("no paths given and no default targets found")
+
+    baseline = None if args.no_baseline else load_baseline(args.baseline)
+    result = run(paths, baseline=baseline)
+
+    if args.write_baseline:
+        write_baseline(args.baseline, result.violations)
+        print(f"floorlint: wrote {len(result.violations)} fingerprint(s) "
+              f"to {args.baseline}")
+        return 0
+
+    for v in result.violations:
+        print(v.render())
+    extras = []
+    if result.suppressed:
+        extras.append(f"{result.suppressed} suppressed")
+    if result.baselined:
+        extras.append(f"{result.baselined} baselined")
+    if result.stale_baseline:
+        extras.append(f"{result.stale_baseline} STALE baseline entr(y/ies) "
+                      "— prune the baseline")
+    suffix = f" ({', '.join(extras)})" if extras else ""
+    print(f"floorlint: {len(result.violations)} problem(s) in "
+          f"{result.files} file(s){suffix}")
+    return 1 if result.violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

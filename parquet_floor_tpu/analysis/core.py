@@ -1,0 +1,271 @@
+"""floorlint core — file walking, suppression directives, scoping, baseline.
+
+The analyzer is stdlib-only (``ast`` + ``pathlib``): the lint gate must run
+in hermetic images with no ruff installed, exactly like ``scripts/lint.py``.
+
+Directives (comments, parsed without executing the file)::
+
+    # floorlint: disable=FL-EXC001,FL-RES     same line or the line above
+    # floorlint: disable-file=FL-TPU          whole file
+    # floorlint: scope=FL-ALLOC               opt the file INTO rule families
+                                              its path would not select
+                                              (how the test fixtures under
+                                              tests/analysis_fixtures/ are
+                                              analyzed)
+
+A token names either a full rule id (``FL-EXC001``) or a family prefix
+(``FL-EXC``); ``all`` matches everything.
+
+Baseline: a text file of ``path:RULE:message`` fingerprints (no line
+numbers, so unrelated edits do not churn it).  Each entry cancels one
+matching violation; the checked-in ``floorlint.baseline`` is empty and
+must stay empty — it exists so a future emergency has a paved road.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from collections import Counter
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_EXCLUDED_DIRS = {"__pycache__", ".git", "data", "analysis_fixtures"}
+
+_DIRECTIVE = re.compile(
+    r"#\s*floorlint:\s*(disable-file|disable|scope)\s*=\s*([A-Za-z0-9_,\-]+)"
+)
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def fingerprint(self) -> str:
+        return f"{self.path}:{self.rule}:{self.message}"
+
+
+class FileContext:
+    """Everything a rule needs about one source file."""
+
+    def __init__(self, path: pathlib.Path, rel: str, src: str, tree: ast.AST):
+        self.path = path
+        self.rel = rel
+        self.src = src
+        self.tree = tree
+        self.lines = src.splitlines()
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.scoped: Set[str] = set()       # families opted in via scope=
+        self.file_disables: Set[str] = set()
+        self.line_disables: Dict[int, Set[str]] = {}
+        self._parse_directives()
+
+    # -- directives --------------------------------------------------------
+
+    def _parse_directives(self) -> None:
+        for i, line in enumerate(self.lines, 1):
+            for kind, value in _DIRECTIVE.findall(line):
+                tokens = {t for t in value.split(",") if t}
+                if kind == "scope":
+                    self.scoped |= tokens
+                elif kind == "disable-file":
+                    self.file_disables |= tokens
+                else:
+                    self.line_disables.setdefault(i, set()).update(tokens)
+                    # a standalone comment line suppresses the next line
+                    if line.lstrip().startswith("#"):
+                        self.line_disables.setdefault(i + 1, set()).update(
+                            tokens
+                        )
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        tokens = self.file_disables | self.line_disables.get(line, set())
+        return any(_matches(rule, t) for t in tokens)
+
+    # -- path scoping ------------------------------------------------------
+
+    @property
+    def rel_parts(self) -> Tuple[str, ...]:
+        return tuple(pathlib.PurePosixPath(self.rel.replace("\\", "/")).parts)
+
+    def under(self, *parts: str) -> bool:
+        """True when ``parts`` appear consecutively in the file's path."""
+        rp = self.rel_parts
+        n = len(parts)
+        return any(rp[i : i + n] == parts for i in range(len(rp) - n + 1))
+
+    def is_module(self, *suffixes: str) -> bool:
+        posix = "/".join(self.rel_parts)
+        return any(posix.endswith(s) for s in suffixes)
+
+    def in_scope(self, family: str, default: bool) -> bool:
+        if any(_matches(family, t) or _matches(t, family) for t in self.scoped):
+            return True
+        return default
+
+
+def _matches(rule: str, token: str) -> bool:
+    return token == "all" or rule == token or rule.startswith(token)
+
+
+# -- AST helpers shared by the rule modules ---------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_part(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def ancestors(ctx: FileContext, node: ast.AST):
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = ctx.parents.get(cur)
+
+
+def enclosing_function(ctx: FileContext, node: ast.AST):
+    for anc in ancestors(ctx, node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def enclosing_class(ctx: FileContext, node: ast.AST):
+    for anc in ancestors(ctx, node):
+        if isinstance(anc, ast.ClassDef):
+            return anc
+    return None
+
+
+# -- runner -----------------------------------------------------------------
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[pathlib.Path]:
+    """Explicit files are always analyzed (that is how the deliberately
+    violating fixtures get checked); directory walks skip ``_EXCLUDED_DIRS``."""
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_file():
+            yield path
+        elif path.is_dir():
+            for f in sorted(path.rglob("*.py")):
+                if not _EXCLUDED_DIRS.intersection(f.parts):
+                    yield f
+
+
+def _display_path(path: pathlib.Path) -> str:
+    try:
+        return path.resolve().relative_to(pathlib.Path.cwd()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def _analyze_one(path: pathlib.Path):
+    """Shared per-file pass: returns ``(kept, suppressed_count)`` with
+    ``# floorlint: disable`` directives already applied (baseline handling
+    stays in :func:`run` — it is a cross-file budget)."""
+    from . import rules_alloc, rules_exc, rules_res, rules_tpu
+
+    rel = _display_path(path)
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [Violation(rel, e.lineno or 1, "FL-SYNTAX",
+                          f"file does not parse: {e.msg}")], 0
+    ctx = FileContext(path, rel, src, tree)
+    kept: List[Violation] = []
+    suppressed = 0
+    for mod in (rules_exc, rules_tpu, rules_res, rules_alloc):
+        for line, rule, message in mod.check(ctx):
+            if ctx.suppressed(rule, line):
+                suppressed += 1
+            else:
+                kept.append(Violation(rel, line, rule, message))
+    return kept, suppressed
+
+
+def analyze_file(path: pathlib.Path) -> List[Violation]:
+    """Analyze one file, honoring its suppression directives (the same
+    verdicts the CLI reports — editor/tooling consumers see no
+    deliberately-suppressed lines)."""
+    return _analyze_one(path)[0]
+
+
+@dataclass
+class RunResult:
+    violations: List[Violation]
+    suppressed: int
+    baselined: int
+    files: int
+    stale_baseline: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def run(paths: Sequence[str],
+        baseline: Optional[Counter] = None) -> RunResult:
+    reported: List[Violation] = []
+    suppressed = 0
+    baselined = 0
+    files = 0
+    remaining = Counter(baseline or ())
+    for path in iter_python_files(paths):
+        files += 1
+        kept, n_suppressed = _analyze_one(path)
+        suppressed += n_suppressed
+        for v in kept:
+            if remaining[v.fingerprint()] > 0:
+                remaining[v.fingerprint()] -= 1
+                baselined += 1
+                continue
+            reported.append(v)
+    stale = sum(remaining.values())
+    reported.sort(key=lambda v: (v.path, v.line, v.rule))
+    return RunResult(reported, suppressed, baselined, files, stale)
+
+
+def load_baseline(path: pathlib.Path) -> Counter:
+    entries: Counter = Counter()
+    if not path.exists():
+        return entries
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            entries[line] += 1
+    return entries
+
+
+def write_baseline(path: pathlib.Path, violations: Iterable[Violation]) -> None:
+    lines = [
+        "# floorlint baseline — one `path:RULE:message` fingerprint per",
+        "# accepted pre-existing violation.  Keep this empty: new code must",
+        "# be clean; entries are an emergency paved road, not a policy.",
+    ]
+    lines += sorted(v.fingerprint() for v in violations)
+    path.write_text("\n".join(lines) + "\n")

@@ -1,0 +1,237 @@
+"""FL-ALLOC — allocation sizes derived from parsed file fields must flow
+through the checked i32 size-cap helper ``errors.checked_alloc_size``.
+
+The PR 1 bug class: a flipped size bit in a header (page size, varint
+count, delta block geometry) drives ``np.empty(n)`` straight into a
+multi-GiB allocation whose ``MemoryError`` is then — correctly! — passed
+through as *host pressure* instead of surfacing as corruption.  The fix
+is a single helper that validates ``0 <= n < 2**31`` and raises
+``CorruptPageError`` with context; this rule makes the helper mandatory.
+
+**FL-ALLOC001** fires on ``np.empty/zeros/ones/full(size, ...)`` —
+and on ``bytes(e)``/``bytearray(e)`` when ``e`` is visibly
+integer-producing (arithmetic, ``int(...)``, ``int.from_bytes``) —
+whenever the size expression is not provably *safe*.  Safe means built
+from:
+
+* integer literals and ``ALL_CAPS`` constants;
+* ``len(...)`` and ``.shape``/``.itemsize``/``.ndim`` (sizes of data
+  already in memory);
+* ``x % c`` / ``x & c`` with a literal ``c`` (bounded);
+* ``min(...)`` with at least one safe operand (clamped);
+* a direct ``checked_alloc_size(...)`` call;
+* names every one of whose assignments is safe (a conservative in-function
+  fixpoint; loop targets, parameters, and nonlocals are never safe —
+  bless them through the helper under a NEW name, e.g.
+  ``nv = checked_alloc_size(num_values, "...")``, so the raw and checked
+  values cannot be confused).
+
+``bytes(buf)``/``bytes(view[a:b])`` conversions are not flagged (their
+size is the size of data already held).  The rule is deliberately
+conservative-by-construction: it cannot prove a guard like
+``if n > cap: raise`` — route the value through the helper instead; that
+is the point (one blessed spelling, greppable, carrying error context).
+
+Scope: files under ``parquet_floor_tpu/format/`` — the layer that parses
+wire bytes.  (The TPU engine allocates from sizes this layer has already
+checked.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import FileContext, enclosing_function, last_part
+
+RULES = [
+    ("FL-ALLOC001",
+     "allocation size derived from parsed data must flow through "
+     "errors.checked_alloc_size"),
+]
+
+_NP_ALLOCS = {"empty", "zeros", "ones", "full"}
+_NP_MODULES = {"np", "numpy"}
+_SAFE_ATTRS = {"shape", "itemsize", "ndim"}
+_BLESS = "checked_alloc_size"
+_TAINT = object()  # marker for never-safe bindings
+
+
+class _Scope:
+    """Flow-insensitive safety of local names in one function (or module).
+
+    ``assignments[name]`` collects every bound value; a name is safe when
+    all of them are safe expressions (greatest fixpoint), and never safe
+    once any binding is a taint marker (loop target, parameter, ...).
+    """
+
+    def __init__(self, fn: ast.AST):
+        self.assignments: Dict[str, List[object]] = {}
+        self._collect(fn)
+        self.safe = self._fixpoint()
+
+    def _bind(self, name: str, value: object) -> None:
+        self.assignments.setdefault(name, []).append(value)
+
+    def _bind_target(self, target: ast.AST, value: object) -> None:
+        if isinstance(target, ast.Name):
+            self._bind(target.id, value)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            if isinstance(value, ast.Attribute) and \
+                    value.attr in _SAFE_ATTRS:
+                for elt in target.elts:
+                    self._bind_target(elt, value)
+            elif isinstance(value, ast.Tuple) and \
+                    len(value.elts) == len(target.elts):
+                for elt, v in zip(target.elts, value.elts):
+                    self._bind_target(elt, v)
+            else:
+                for elt in target.elts:
+                    self._bind_target(elt, _TAINT)
+
+    def _collect(self, fn: ast.AST) -> None:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = fn.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs
+                      + ([args.vararg] if args.vararg else [])
+                      + ([args.kwarg] if args.kwarg else [])):
+                self._bind(a.arg, _TAINT)
+            body = fn.body
+        else:
+            body = getattr(fn, "body", [])
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes analyzed separately
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    self._bind_target(t, node.value)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._bind_target(node.target, node.value)
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name):
+                    # treat `x op= v` as `x = x op v`
+                    self._bind(node.target.id, ast.BinOp(
+                        left=ast.Name(id=node.target.id, ctx=ast.Load()),
+                        op=node.op, right=node.value))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                self._bind_target(node.target, _TAINT)
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        self._bind_target(item.optional_vars, _TAINT)
+            elif isinstance(node, ast.ExceptHandler) and node.name:
+                self._bind(node.name, _TAINT)
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                for n in node.names:
+                    self._bind(n, _TAINT)
+            elif isinstance(node, ast.NamedExpr):
+                self._bind_target(node.target, _TAINT)
+            for child in ast.iter_child_nodes(node):
+                stack.append(child)
+            if isinstance(node, (ast.comprehension,)):
+                self._bind_target(node.target, _TAINT)
+
+    def _fixpoint(self) -> Set[str]:
+        safe = {
+            n for n, vals in self.assignments.items()
+            if all(v is not _TAINT for v in vals)
+        }
+        changed = True
+        while changed:
+            changed = False
+            for n in list(safe):
+                if not all(_safe_expr(v, safe) for v in self.assignments[n]):
+                    safe.discard(n)
+                    changed = True
+        return safe
+
+
+def _safe_expr(e: object, safe: Set[str]) -> bool:
+    if e is _TAINT or not isinstance(e, ast.AST):
+        return False
+    if isinstance(e, ast.Constant):
+        return True
+    if isinstance(e, ast.Name):
+        return e.id in safe or (e.id.upper() == e.id and e.id.lower() != e.id)
+    if isinstance(e, ast.UnaryOp):
+        return _safe_expr(e.operand, safe)
+    if isinstance(e, ast.BinOp):
+        if isinstance(e.op, (ast.Mod, ast.BitAnd)) and \
+                isinstance(e.right, ast.Constant):
+            return True  # bounded by the literal
+        return _safe_expr(e.left, safe) and _safe_expr(e.right, safe)
+    if isinstance(e, ast.BoolOp):
+        return all(_safe_expr(v, safe) for v in e.values)
+    if isinstance(e, ast.IfExp):
+        return _safe_expr(e.body, safe) and _safe_expr(e.orelse, safe)
+    if isinstance(e, (ast.Tuple, ast.List)):
+        if any(isinstance(x, ast.Constant) and x.value == 0 for x in e.elts):
+            return True  # a zero dimension: the allocation is empty
+        return all(_safe_expr(x, safe) for x in e.elts)
+    if isinstance(e, ast.Call):
+        name = last_part(e.func)
+        if name == _BLESS:
+            return True
+        if name == "len":
+            return True
+        if name == "min" and e.args:
+            return any(_safe_expr(a, safe) for a in e.args)
+        if name == "max" and e.args:
+            return all(_safe_expr(a, safe) for a in e.args)
+        return False
+    if isinstance(e, ast.Attribute):
+        return e.attr in _SAFE_ATTRS
+    if isinstance(e, ast.Subscript):
+        return isinstance(e.value, ast.Attribute) and \
+            e.value.attr in _SAFE_ATTRS
+    return False
+
+
+def _int_producing(e: ast.AST) -> bool:
+    """Is `e` visibly an integer (vs a buffer being copied)?  Used to
+    decide whether bytes()/bytearray() get the size check at all."""
+    if isinstance(e, ast.BinOp):
+        return True
+    if isinstance(e, ast.Call):
+        name = last_part(e.func)
+        return name in ("int", "from_bytes", "min", "max")
+    return False
+
+
+def check(ctx: FileContext):
+    in_format = ctx.under("parquet_floor_tpu", "format")
+    if not ctx.in_scope("FL-ALLOC", in_format):
+        return
+    scopes: Dict[Optional[ast.AST], _Scope] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        size: Optional[ast.AST] = None
+        what = None
+        if isinstance(f, ast.Attribute) and f.attr in _NP_ALLOCS and \
+                last_part(f.value) in _NP_MODULES:
+            what = f"np.{f.attr}"
+            if node.args:
+                size = node.args[0]
+            else:
+                size = next((kw.value for kw in node.keywords
+                             if kw.arg == "shape"), None)
+        elif isinstance(f, ast.Name) and f.id in ("bytes", "bytearray") and \
+                len(node.args) == 1 and _int_producing(node.args[0]):
+            what = f.id
+            size = node.args[0]
+        if size is None:
+            continue
+        fn = enclosing_function(ctx, node)
+        if fn not in scopes:
+            scopes[fn] = _Scope(fn if fn is not None else ctx.tree)
+        if not _safe_expr(size, scopes[fn].safe):
+            yield (node.lineno, "FL-ALLOC001",
+                   f"{what} size comes from parsed data without flowing "
+                   "through errors.checked_alloc_size — a corrupt length "
+                   "field becomes a giant allocation instead of "
+                   "CorruptPageError")

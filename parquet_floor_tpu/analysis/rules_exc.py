@@ -1,0 +1,195 @@
+"""FL-EXC — error-taxonomy guards.
+
+The invariants PR 1's robustness layer depends on (docs/robustness.md):
+transient ``OSError``/``MemoryError`` must never be reclassified as
+corruption, wrapped raises must chain their cause, and taxonomy errors
+raised at a boundary must carry location context.
+
+Rules:
+
+* **FL-EXC001** — an ``except Exception``/bare ``except`` handler that
+  wraps-and-raises must be preceded (in the same ``try``) by a handler
+  re-raising ``OSError`` and ``MemoryError``; otherwise a flaky mount or
+  host memory pressure gets misclassified as file corruption.  The one
+  blessed spelling of the full ladder is
+  ``errors.classified_decode_errors()`` — prefer it over hand-rolling.
+* **FL-EXC002** — a ``raise SomeError(...)`` inside ``except ... as e``
+  must use ``from e`` (or ``from None``), or pass ``e`` into the call
+  (the ``annotate(e, ...)``/re-wrap pattern), so the cause chain survives.
+* **FL-EXC003** — in the boundary modules (where path/column/row-group
+  are in hand) a taxonomy raise must carry at least one location-context
+  kwarg.  Exempt: raises inside ``with classified_decode_errors(...)``
+  (the ladder annotates) and private ``_helpers`` (their public caller
+  annotates).
+
+Scope: FL-EXC001/002 apply inside the ``parquet_floor_tpu`` package;
+FL-EXC003 only to the boundary modules listed below.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, ancestors, enclosing_function, last_part
+
+TAXONOMY = {
+    "ParquetError", "CorruptFooterError", "CorruptPageError",
+    "ChecksumMismatchError", "TruncatedFileError", "UnsupportedFeatureError",
+    "IoRetryExhaustedError", "ThriftDecodeError", "UnsupportedCodec",
+}
+CONTEXT_KWARGS = {"path", "column", "row_group", "page", "offset"}
+_TRANSIENT = {"OSError", "IOError", "EnvironmentError", "MemoryError"}
+BOUNDARY_MODULES = (
+    "format/metadata.py", "format/file_read.py", "format/pages.py",
+    "io/source.py",
+)
+
+RULES = [
+    ("FL-EXC001",
+     "except Exception that wraps-and-raises must re-raise "
+     "OSError/MemoryError first (use errors.classified_decode_errors)"),
+    ("FL-EXC002",
+     "raise inside `except ... as e` must chain the cause "
+     "(`from e` / `from None` / pass e into the call)"),
+    ("FL-EXC003",
+     "taxonomy raises at decode boundaries must carry location-context "
+     "kwargs (path/column/row_group/page/offset)"),
+]
+
+
+def _handler_names(handler: ast.ExceptHandler):
+    t = handler.type
+    if t is None:
+        return set()
+    if isinstance(t, ast.Tuple):
+        return {last_part(e) for e in t.elts}
+    return {last_part(t)}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    return _handler_names(handler) & {"Exception", "BaseException"} != set()
+
+
+def _own_raises(handler: ast.ExceptHandler):
+    """Raise nodes belonging to this handler — not to a nested handler
+    (whose bare ``raise`` re-raises the NESTED exception) and not to a
+    nested ``def`` (which does not execute here).  Nested try *bodies*
+    and ``finally`` blocks do belong: a bare ``raise`` there still
+    re-raises this handler's exception."""
+    stack = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.ExceptHandler, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Raise):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """Does this handler re-raise what it caught (bare `raise`, or
+    `raise e` of its own as-name)?"""
+    for r in _own_raises(handler):
+        if r.exc is None:
+            return True
+        if handler.name and isinstance(r.exc, ast.Name) and \
+                r.exc.id == handler.name:
+            return True
+    return False
+
+
+def _check_exc001(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        # transient classes whose re-raise arms have been seen so far —
+        # one `except (OSError, MemoryError): raise` or separate
+        # per-class arms both count
+        reraised: set = set()
+        for handler in node.handlers:
+            names = _handler_names(handler)
+            if _reraises(handler):
+                reraised |= names
+            protected = (
+                {"OSError", "IOError", "EnvironmentError"} & reraised
+                and "MemoryError" in reraised
+            )
+            if not _is_broad(handler):
+                continue
+            wraps = [r for r in _own_raises(handler)
+                     if isinstance(r.exc, ast.Call)]
+            # a bare `raise` alongside the wrap means not every exception
+            # is reclassified (guarded-rewrap shape): that is fine
+            if wraps and not _reraises(handler) and not protected:
+                yield (handler.lineno, "FL-EXC001",
+                       "broad except wraps-and-raises without a preceding "
+                       "`except (OSError, MemoryError): raise` arm — "
+                       "transient I/O or host pressure would be "
+                       "misclassified (use errors.classified_decode_errors)")
+
+
+def _check_exc002(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler) or not node.name:
+            continue
+        for r in _own_raises(node):
+            if not isinstance(r.exc, ast.Call) or r.cause is not None:
+                continue
+            # e passed into the call (annotate/re-wrap) keeps the object
+            carries = any(
+                isinstance(n, ast.Name) and n.id == node.name
+                for n in ast.walk(r.exc)
+            )
+            if not carries:
+                yield (r.lineno, "FL-EXC002",
+                       f"raise inside `except ... as {node.name}` loses the "
+                       f"cause — add `from {node.name}` (or `from None`)")
+
+
+def _in_classified_with(ctx: FileContext, node: ast.AST) -> bool:
+    for anc in ancestors(ctx, node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                call = item.context_expr
+                if isinstance(call, ast.Call) and \
+                        last_part(call.func) == "classified_decode_errors":
+                    return True
+    return False
+
+
+def _check_exc003(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Raise) or not isinstance(node.exc, ast.Call):
+            continue
+        name = last_part(node.exc.func)
+        if name not in TAXONOMY:
+            continue
+        has_ctx = any(
+            kw.arg is None or kw.arg in CONTEXT_KWARGS
+            for kw in node.exc.keywords
+        )
+        if has_ctx:
+            continue
+        fn = enclosing_function(ctx, node)
+        if fn is not None and fn.name.startswith("_"):
+            continue  # private helper: the public boundary annotates
+        if _in_classified_with(ctx, node):
+            continue  # the ladder annotates on the way out
+        yield (node.lineno, "FL-EXC003",
+               f"{name} raised at a decode boundary without location "
+               "context kwargs (path/column/row_group/page/offset) and "
+               "outside `with classified_decode_errors(...)`")
+
+
+def check(ctx: FileContext):
+    in_pkg = ctx.under("parquet_floor_tpu")
+    if ctx.in_scope("FL-EXC001", in_pkg):
+        yield from _check_exc001(ctx)
+    if ctx.in_scope("FL-EXC002", in_pkg):
+        yield from _check_exc002(ctx)
+    boundary = ctx.is_module(*BOUNDARY_MODULES)
+    if ctx.in_scope("FL-EXC003", boundary):
+        yield from _check_exc003(ctx)

@@ -1,0 +1,159 @@
+"""FL-RES — resource acquisition guards (the PR 1 fd-leak shape).
+
+PR 1 fixed an fd/mmap leak where ``ParquetFileReader.__init__`` opened a
+``FileSource`` and a corrupt footer raised before anyone owned the close.
+This rule makes the whole shape unrepresentable: every acquisition of
+``open()`` / ``FileSource()`` / ``FileSink()`` / ``mmap.mmap()`` must be
+managed on **all exception paths**.
+
+**FL-RES001** fires unless the acquisition is one of:
+
+* a ``with`` item (directly or wrapped, e.g. ``closing(open(p))``);
+* an argument to another call (ownership transfer —
+  ``RetryingSource(FileSource(p))``);
+* returned / yielded, directly or via a local that is later returned;
+* stored on ``self`` in a class that defines ``close``/``__exit__``
+  (the owning-wrapper pattern: ``FileSource`` itself);
+* bound to a local whose ``.close()`` is reachable on error — i.e. a
+  ``try`` in the same function closes it in a ``finally`` or an
+  ``except`` handler (the constructor-guard shape PR 1 landed).
+
+Linear ``f = open(p); use(f); f.close()`` is deliberately flagged: any
+exception in ``use`` leaks ``f`` — exactly the bug class this rule
+retires.  ``open(p).read()`` chains are flagged too (fd lives until GC).
+
+Scope: every analyzed file (package, tests, scripts).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (
+    FileContext,
+    ancestors,
+    enclosing_class,
+    enclosing_function,
+    last_part,
+)
+
+RULES = [
+    ("FL-RES001",
+     "open()/FileSource()/FileSink()/mmap.mmap() must be context-managed, "
+     "transferred, or closed on all exception paths"),
+]
+
+_ACQUIRERS = {"FileSource", "FileSink"}
+
+
+def _is_acquisition(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id == "open":
+        return True
+    if last_part(f) in _ACQUIRERS:
+        return True
+    if isinstance(f, ast.Attribute) and f.attr == "mmap" and \
+            last_part(f.value) == "mmap":
+        return True
+    return False
+
+
+def _class_manages(ctx: FileContext, node: ast.AST) -> bool:
+    cls = enclosing_class(ctx, node)
+    if cls is None:
+        return False
+    return any(
+        isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and item.name in ("close", "__exit__", "__del__")
+        for item in cls.body
+    )
+
+
+def _name_in(tree: ast.AST, name: str) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id == name for n in ast.walk(tree)
+    )
+
+
+def _scope_body(ctx: FileContext, node: ast.AST):
+    fn = enclosing_function(ctx, node)
+    return fn if fn is not None else ctx.tree
+
+
+def _local_is_managed(ctx: FileContext, site: ast.AST, name: str) -> bool:
+    scope = _scope_body(ctx, site)
+    for node in ast.walk(scope):
+        # returned / yielded (possibly wrapped in another expression)
+        if isinstance(node, (ast.Return, ast.Yield)) and node.value is not None:
+            if _name_in(node.value, name):
+                return True
+        # ownership transferred onto an object that manages it
+        if isinstance(node, ast.Assign) and _name_in(node.value, name):
+            if any(isinstance(t, ast.Attribute) for t in node.targets) and \
+                    _class_manages(ctx, node):
+                return True
+        # closed on an exception path: name.close() inside a finally
+        # block or an except handler of some try in this function
+        if isinstance(node, ast.Try):
+            regions = list(node.finalbody)
+            for h in node.handlers:
+                regions.extend(h.body)
+            for stmt in regions:
+                for c in ast.walk(stmt):
+                    if isinstance(c, ast.Call) and \
+                            isinstance(c.func, ast.Attribute) and \
+                            c.func.attr == "close" and \
+                            isinstance(c.func.value, ast.Name) and \
+                            c.func.value.id == name:
+                        return True
+    return False
+
+
+def _classify(ctx: FileContext, call: ast.Call):
+    """Walk up from the acquisition; return a violation message or None."""
+    child: ast.AST = call
+    for anc in ancestors(ctx, call):
+        if isinstance(anc, ast.withitem):
+            return None
+        if isinstance(anc, (ast.Return, ast.Yield)):
+            return None
+        if isinstance(anc, ast.Attribute) and anc.value is child:
+            return ("result used via attribute chain without binding "
+                    "(e.g. open(p).read()) — the handle leaks until GC; "
+                    "use `with` or pathlib read_bytes/read_text")
+        if isinstance(anc, ast.Call) and child is not anc.func:
+            return None  # argument to another call: ownership transferred
+        if isinstance(anc, ast.Assign):
+            for t in anc.targets:
+                if isinstance(t, ast.Attribute):
+                    if _class_manages(ctx, anc):
+                        return None
+                    return ("stored on an attribute of a class with no "
+                            "close()/__exit__ — nothing ever releases it")
+                if isinstance(t, ast.Name):
+                    if _local_is_managed(ctx, anc, t.id):
+                        return None
+                    return (f"bound to `{t.id}` but no exception path "
+                            "closes it — use `with`, or close it in a "
+                            "finally/except guard")
+            return None
+        if isinstance(anc, ast.Expr):
+            return "result discarded — the handle leaks immediately"
+        if isinstance(anc, ast.For) and anc.iter is child:
+            return ("iterated directly (for ... in open(p)) — the handle "
+                    "leaks until GC; use `with`")
+        if isinstance(anc, ast.stmt):
+            return None  # some other statement shape: give it the benefit
+        child = anc
+    return None
+
+
+def check(ctx: FileContext):
+    if not ctx.in_scope("FL-RES", True):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_acquisition(node):
+            msg = _classify(ctx, node)
+            if msg is not None:
+                what = last_part(node.func) or "open"
+                yield (node.lineno, "FL-RES001", f"{what}(...) {msg}")

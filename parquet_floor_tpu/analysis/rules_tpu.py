@@ -1,0 +1,143 @@
+"""FL-TPU — tracer/host-purity guards for jitted and Pallas code.
+
+Host side effects inside a traced function either crash at trace time
+(``int(tracer)``), silently bake a value into the compiled program
+(``open()`` reading a config during trace), or force a device→host sync
+in the middle of a compiled region (``.item()``, ``np.asarray`` on a
+device array).  None of those belong in ``tpu/`` kernels or jitted
+decode steps.
+
+Rules:
+
+* **FL-TPU001** — host I/O inside a traced function: ``open(...)`` or
+  ``zlib.crc32(...)`` (CRC verification is a HOST policy —
+  ``ReaderOptions.verify_crc`` pins the host engine; see docs/robustness.md).
+* **FL-TPU002** — host materialization inside a traced function:
+  ``.item()``, ``.block_until_ready()``, ``jax.device_get``,
+  ``int(x)``/``float(x)``/``bool(x)`` on a bare name (a traced value —
+  static shapes read ``int(a.shape[0])``, which is allowed), and
+  ``np.array``/``np.asarray``/``np.ascontiguousarray``/``np.copy``/
+  ``np.frombuffer`` (host numpy applied to traced operands).
+
+A function counts as traced when it is decorated with ``jit``
+(``@jax.jit``, ``@partial(jax.jit, ...)``) or is passed to
+``pl.pallas_call`` — directly, or through a
+``kernel = functools.partial(fn, ...)`` local.  Nested ``def``s inside a
+traced function are traced too.  The check is lexical: helpers *called*
+from a traced function are not followed (keep kernel helpers in ``tpu/``
+so they get their own decorators or stay trivially pure).
+
+Scope: files under ``parquet_floor_tpu/tpu/``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, last_part
+
+RULES = [
+    ("FL-TPU001", "host I/O (open / zlib.crc32) inside a jit/Pallas-traced "
+                  "function"),
+    ("FL-TPU002", "host materialization (.item(), int(tracer), np.asarray, "
+                  "device_get) inside a jit/Pallas-traced function"),
+]
+
+_NP_MATERIALIZE = {"array", "asarray", "ascontiguousarray", "copy",
+                   "frombuffer"}
+_NP_MODULES = {"np", "numpy", "onp"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    return last_part(node) == "jit"
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if _is_jit_expr(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_expr(dec.func):
+            return True  # @jax.jit(static_argnums=...)
+        if last_part(dec.func) == "partial" and dec.args and \
+                _is_jit_expr(dec.args[0]):
+            return True  # @partial(jax.jit, ...)
+    return False
+
+
+def _partial_target(call: ast.Call):
+    if last_part(call.func) == "partial" and call.args:
+        return last_part(call.args[0])
+    return None
+
+
+def _traced_functions(ctx: FileContext):
+    """FunctionDefs that are jit-decorated or used as Pallas kernels."""
+    partial_locals = {}
+    kernel_names = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            target_fn = _partial_target(node.value)
+            if target_fn:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        partial_locals[t.id] = target_fn
+        if isinstance(node, ast.Call) and last_part(node.func) == "pallas_call":
+            if node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Call):
+                    name = _partial_target(arg) or last_part(arg.func)
+                else:
+                    name = last_part(arg)
+                if name:
+                    kernel_names.add(partial_locals.get(name, name))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in kernel_names or \
+                any(_is_jit_decorator(d) for d in node.decorator_list):
+            yield node
+
+
+def _check_traced_body(fn: ast.FunctionDef):
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = last_part(f)
+        if isinstance(f, ast.Name) and f.id == "open":
+            yield (node.lineno, "FL-TPU001",
+                   f"open() inside traced function `{fn.name}` — host file "
+                   "I/O runs at trace time, not per call")
+        elif isinstance(f, ast.Attribute) and name == "crc32" and \
+                last_part(f.value) == "zlib":
+            yield (node.lineno, "FL-TPU001",
+                   f"zlib.crc32 inside traced function `{fn.name}` — CRC "
+                   "verification is host-side policy (ReaderOptions."
+                   "verify_crc pins the host engine)")
+        elif isinstance(f, ast.Attribute) and name in ("item",
+                                                       "block_until_ready"):
+            yield (node.lineno, "FL-TPU002",
+                   f".{name}() inside traced function `{fn.name}` forces a "
+                   "device→host sync / fails under trace")
+        elif name == "device_get":
+            yield (node.lineno, "FL-TPU002",
+                   f"jax.device_get inside traced function `{fn.name}`")
+        elif isinstance(f, ast.Name) and f.id in ("int", "float", "bool") \
+                and len(node.args) == 1 and isinstance(node.args[0], ast.Name):
+            yield (node.lineno, "FL-TPU002",
+                   f"{f.id}({node.args[0].id}) inside traced function "
+                   f"`{fn.name}` — materializing a traced value crashes at "
+                   "trace time (static shapes read int(x.shape[i]) instead)")
+        elif isinstance(f, ast.Attribute) and name in _NP_MATERIALIZE and \
+                last_part(f.value) in _NP_MODULES:
+            yield (node.lineno, "FL-TPU002",
+                   f"np.{name} inside traced function `{fn.name}` — host "
+                   "numpy on traced operands (use jnp)")
+
+
+def check(ctx: FileContext):
+    in_tpu = ctx.under("parquet_floor_tpu", "tpu")
+    if not ctx.in_scope("FL-TPU", in_tpu):
+        return
+    for fn in _traced_functions(ctx):
+        yield from _check_traced_body(fn)

@@ -627,8 +627,11 @@ class ParquetReader:
             return h.finish(record)
         except StopIteration:
             raise
-        except Exception as e:
-            # Parity: wrap iteration failures (ParquetReader.java:209-211).
+        except Exception as e:  # floorlint: disable=FL-EXC001
+            # Parity: the reference wraps EVERY iteration failure —
+            # including IO — as RuntimeError (ParquetReader.java:209-211),
+            # and test_api_parity pins that; the cause chain keeps the
+            # real class reachable.
             raise RuntimeError("Failed to read parquet") from e
 
     def _drain_prefetch(self) -> Optional[Exception]:

@@ -24,10 +24,23 @@ knows the page ordinal, the file reader knows the path)::
 
     raise CorruptPageError("dictionary index out of range",
                            path=src.name, column="s", row_group=2, page=0)
+
+Two shared idioms live here so the classification rules exist in ONE place
+(and so ``floorlint`` — :mod:`parquet_floor_tpu.analysis` — has a single
+blessed spelling to check for):
+
+* :func:`classified_decode_errors` — the transient-vs-corruption except
+  ladder every decode boundary needs (annotate taxonomy, pass through
+  ``OSError``/``MemoryError``, wrap anything else as corruption).
+* :func:`checked_alloc_size` — the i32 size cap every allocation whose
+  length came out of a parsed file field must flow through, so a flipped
+  size bit surfaces as :class:`CorruptPageError` instead of a multi-GiB
+  allocation attempt (or ``MemoryError`` misread as host pressure).
 """
 
 from __future__ import annotations
 
+import contextlib
 from typing import Optional
 
 _CONTEXT_FIELDS = ("path", "column", "row_group", "page", "offset")
@@ -124,3 +137,65 @@ class IoRetryExhaustedError(ParquetError, OSError):
                  **context):
         super().__init__(message, **context)
         self.attempts = attempts
+
+
+@contextlib.contextmanager
+def classified_decode_errors(wrap, what, ctx=None, reclassify=()):
+    """The ONE transient-vs-corruption ladder for decode boundaries.
+
+    Wraps a decode region so every way it can fail lands in the taxonomy
+    with the right class:
+
+    * taxonomy errors pass through, annotated with ``ctx`` (inner frames
+      win on fields they already set);
+    * ``OSError``/``MemoryError`` pass through untouched — the transient
+      I/O class and host memory pressure are environmental facts, and
+      wrapping either as corruption would let salvage quarantine healthy
+      data on a flaky mount;
+    * anything else hostile bytes tripped (IndexError deep in an encoding,
+      RecursionError in schema building, …) is re-raised as ``wrap`` —
+      ``wrap(f"{what}: {err}", **ctx)`` with the cause chained.
+
+    ``reclassify`` lists taxonomy classes that must STILL be wrapped (e.g.
+    ``ThriftDecodeError`` inside footer parsing becomes
+    :class:`CorruptFooterError` so sniff loops see one class).
+
+    Usage::
+
+        with classified_decode_errors(CorruptPageError,
+                                      "data page decode failed", ctx):
+            ... decode ...
+    """
+    try:
+        yield
+    except reclassify as e:
+        raise wrap(f"{what}: {e}", **(ctx or {})) from e
+    except ParquetError as e:
+        raise annotate(e, **(ctx or {}))
+    except (OSError, MemoryError):
+        raise  # transient I/O or host pressure, not corruption
+    except Exception as e:
+        raise wrap(f"{what}: {e}", **(ctx or {})) from e
+
+
+#: The format stores every size as i32; anything at or past this ceiling
+#: coming out of a parsed field is a corrupt header, not a real length.
+ALLOC_CAP = 1 << 31
+
+
+def checked_alloc_size(n, what="allocation", *, cap=ALLOC_CAP, **context) -> int:
+    """Validate an allocation size that was derived from a parsed file
+    field; returns it as a plain ``int``.
+
+    Every ``bytes(n)`` / ``np.empty(n)`` whose ``n`` came off the wire
+    must flow through here (floorlint rule FL-ALLOC001): a flipped size
+    bit then surfaces as :class:`CorruptPageError` with location context
+    instead of a multi-GiB allocation attempt whose ``MemoryError`` would
+    be misread as host pressure."""
+    n = int(n)
+    if n < 0 or n >= cap:
+        raise CorruptPageError(
+            f"implausible {what} size {n} (valid range is [0, {cap}))",
+            **context,
+        )
+    return n

@@ -27,6 +27,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..errors import checked_alloc_size
 from .parquet_thrift import Type
 from .thrift import CompactReader, CompactWriter, T_I32, ThriftStruct
 
@@ -287,7 +288,12 @@ class SplitBlockBloomFilter:
         else:
             if num_bytes % 32 or num_bytes < MIN_BYTES:
                 raise ValueError(f"num_bytes must be a multiple of 32 ≥ 32, got {num_bytes}")
-            self.bitset = np.zeros((num_bytes // 32, 8), dtype=np.uint32)
+            # cap at the format's 128 MiB ceiling before sizing the bitset
+            # (the parsed path — from_bytes — caps its numBytes the same
+            # way before its frombuffer)
+            nb = checked_alloc_size(num_bytes, "bloom filter bitset",
+                                    cap=MAX_BYTES + 1)
+            self.bitset = np.zeros((nb // 32, 8), dtype=np.uint32)
 
     @property
     def num_bytes(self) -> int:
@@ -349,7 +355,11 @@ class SplitBlockBloomFilter:
         if header.hash is not None and header.hash.XXHASH is None:
             raise ValueError("unsupported bloom filter hash")
         start = reader.pos
-        nb = int(header.numBytes)
+        # numBytes is a parsed header field: cap it at the format's
+        # 128 MiB ceiling before it drives the frombuffer count (a corrupt
+        # header must surface as taxonomy, not a bare numpy ValueError)
+        nb = checked_alloc_size(int(header.numBytes), "bloom filter bitset",
+                                cap=MAX_BYTES + 1)
         raw = np.frombuffer(data, np.uint8, count=nb, offset=start)
         bitset = raw.view("<u4").reshape(-1, 8).copy()
         return cls(bitset=bitset)

@@ -23,6 +23,7 @@ from typing import Tuple
 
 import numpy as np
 
+from ...errors import checked_alloc_size
 from .plain import ByteArrayColumn
 from .rle_hybrid import bit_pack, bit_unpack, _read_varint, _write_varint
 
@@ -44,8 +45,10 @@ def decode_delta_binary_packed(data, pos: int = 0, out_dtype=np.int64):
     """Decode one DELTA_BINARY_PACKED stream; returns (values, end_pos)."""
     block_size, pos = _read_varint(data, pos)
     n_mini, pos = _read_varint(data, pos)
-    total, pos = _read_varint(data, pos)
+    raw_total, pos = _read_varint(data, pos)
     first, pos = _read_zigzag(data, pos)
+    # total_count came off the wire: cap it before it drives allocation
+    total = checked_alloc_size(raw_total, "DELTA_BINARY_PACKED total_count")
     if total == 0:
         return np.zeros(0, dtype=out_dtype), pos
     if n_mini == 0 or block_size % n_mini:
@@ -161,7 +164,9 @@ def decode_delta_length_byte_array(data, pos: int = 0) -> Tuple[ByteArrayColumn,
     n = len(lengths)
     offsets = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(lengths, out=offsets[1:])
-    total = int(offsets[-1])
+    # the lengths are parsed data: a corrupt (negative/huge) sum must not
+    # reach np.frombuffer as its count
+    total = checked_alloc_size(int(offsets[-1]), "DELTA_LENGTH_BYTE_ARRAY pool")
     pool = (
         np.frombuffer(data, dtype=np.uint8, count=total, offset=pos).copy()
         if total

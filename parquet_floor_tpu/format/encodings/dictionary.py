@@ -64,7 +64,10 @@ def build_dictionary(values, physical_type: int):
             if col is None:
                 col = ByteArrayColumn.from_list(vals)
             lengths = col.lengths()
-            keys = np.zeros((n, 4 + max_len), dtype=np.uint8)
+            # the branch guard bounds max_len ≤ 64; min() re-states it at
+            # the allocation so the (n, 4+max_len) matrix provably cannot
+            # blow up on one huge outlier
+            keys = np.zeros((n, 4 + min(max_len, 64)), dtype=np.uint8)
             keys[:, :4] = lengths.astype(np.uint32)[:, None].view(np.uint8).reshape(n, 4)
             keys[:, 4:] = col.padded_matrix()
             void = np.ascontiguousarray(keys).view(

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ...errors import checked_alloc_size
 from ..parquet_thrift import Type
 
 try:  # native length-chain scanner (optional fast path)
@@ -72,7 +73,8 @@ class ByteArrayColumn:
         OUTPUT matrix is small; the inputs may not be)."""
         n = len(self)
         lengths = self.lengths()
-        max_len = int(lengths.max()) if n else 0
+        max_len = (checked_alloc_size(int(lengths.max()), "padded matrix width")
+                   if n else 0)
         out = np.zeros((n, max_len), dtype=np.uint8)
         total = int(self.offsets[-1]) if n else 0
         if total:
@@ -133,7 +135,17 @@ def encode_plain(values, physical_type: int, type_length=None) -> bytes:
             lengths = values.lengths().astype("<u4")
             n = len(values)
             total = int(values.offsets[-1]) + 4 * n
-            out = np.empty(total, dtype=np.uint8)
+            # write side: the sizes are the caller's in-memory data, not a
+            # parsed file field, so an unwritable page is API misuse
+            # (ValueError), NOT corruption taxonomy — hence no
+            # checked_alloc_size here, just the same i32 framing bound
+            if total >= 1 << 31:
+                raise ValueError(
+                    f"PLAIN BYTE_ARRAY page would be {total} bytes; "
+                    "pages are i32-framed — split the column into more "
+                    "pages/row groups"
+                )
+            out = np.empty(total, dtype=np.uint8)  # floorlint: disable=FL-ALLOC001
             # interleave 4-byte lengths and payloads
             pos = 0
             data = values.data
@@ -213,21 +225,24 @@ def _decode_plain_byte_array(buf: memoryview, num_values: int):
     then gather payloads with one fancy index — no per-value Python bytes.
     """
     raw = np.frombuffer(buf, dtype=np.uint8)
-    if _native is not None and _native.available() and num_values > 64:
-        starts, lengths = _native.plain_ba_scan(buf, num_values)
-        if len(starts) != num_values:
+    # num_values is a page-header field: cap it before it sizes anything
+    # (nv is the checked value; the raw name stays for error messages)
+    nv = checked_alloc_size(num_values, "PLAIN BYTE_ARRAY num_values")
+    if _native is not None and _native.available() and nv > 64:
+        starts, lengths = _native.plain_ba_scan(buf, nv)
+        if len(starts) != nv:
             raise ValueError(
                 f"PLAIN BYTE_ARRAY stream ended after {len(starts)} of "
                 f"{num_values} values"
             )
-        pos = int(starts[-1] + lengths[-1]) if num_values else 0
+        pos = int(starts[-1] + lengths[-1]) if nv else 0
     else:
-        starts = np.empty(num_values, dtype=np.int64)
-        lengths = np.empty(num_values, dtype=np.int64)
+        starts = np.empty(nv, dtype=np.int64)
+        lengths = np.empty(nv, dtype=np.int64)
         pos = 0
         b = buf
         end = len(buf)
-        for i in range(num_values):
+        for i in range(nv):
             if pos + 4 > end:
                 raise ValueError("PLAIN BYTE_ARRAY stream truncated")
             ln = int.from_bytes(b[pos : pos + 4], "little")
@@ -237,12 +252,12 @@ def _decode_plain_byte_array(buf: memoryview, num_values: int):
             starts[i] = pos
             lengths[i] = ln
             pos += ln
-    offsets = np.zeros(num_values + 1, dtype=np.int64)
+    offsets = np.zeros(nv + 1, dtype=np.int64)
     np.cumsum(lengths, out=offsets[1:])
-    total = int(offsets[-1])
+    total = checked_alloc_size(int(offsets[-1]), "PLAIN BYTE_ARRAY pool")
     pool = np.empty(total, dtype=np.uint8)
     # gather payload spans
-    if num_values:
+    if nv:
         idx = np.repeat(starts - offsets[:-1], lengths) + np.arange(total)
         pool = raw[idx]
     return ByteArrayColumn(offsets, pool), pos

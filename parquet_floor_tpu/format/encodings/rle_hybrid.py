@@ -30,6 +30,8 @@ from typing import Tuple
 
 import numpy as np
 
+from ...errors import checked_alloc_size
+
 try:  # native run-table parser (optional fast path)
     from ...native import binding as _native
 except Exception:  # pragma: no cover
@@ -70,7 +72,9 @@ def bit_unpack(packed: np.ndarray, bit_width: int, count: int) -> np.ndarray:
     bit widths 0..64.
     """
     if bit_width == 0:
-        return np.zeros(count, dtype=np.uint64)
+        # count may be straight off the wire (delta miniblock geometry)
+        return np.zeros(checked_alloc_size(count, "bit-packed run"),
+                        dtype=np.uint64)
     nbits_needed = count * bit_width
     bits = np.unpackbits(packed, bitorder="little", count=None)
     if len(bits) < nbits_needed:
@@ -190,23 +194,28 @@ def count_equal(data, num_values: int, bit_width: int, target: int,
 
 def expand_runs(data, run_table: np.ndarray, num_values: int, bit_width: int) -> np.ndarray:
     """Phase 2: vectorized expansion of a run table to values (uint32)."""
+    # num_values is a page-header field; run counts come from the parsed
+    # table (clamped to remaining values at parse time — the min() below
+    # re-states that bound where the allocation happens)
+    nv = checked_alloc_size(num_values, "RLE expansion")
     if bit_width == 0:
-        return np.zeros(num_values, dtype=np.uint32)
+        return np.zeros(nv, dtype=np.uint32)
     out_parts = []
     buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
     for kind, count, v, _ in run_table:
+        cnt = min(int(count), nv)
         if kind == 0:
-            out_parts.append(np.full(count, v, dtype=np.uint32))
+            out_parts.append(np.full(cnt, v, dtype=np.uint32))
         else:
-            nbytes = ((count + 7) // 8) * bit_width
+            nbytes = ((cnt + 7) // 8) * bit_width
             packed = buf[v : v + nbytes]
-            out_parts.append(bit_unpack(packed, bit_width, int(count)).astype(np.uint32))
+            out_parts.append(bit_unpack(packed, bit_width, cnt).astype(np.uint32))
     if not out_parts:
-        return np.zeros(num_values, dtype=np.uint32)
+        return np.zeros(nv, dtype=np.uint32)
     out = np.concatenate(out_parts)
-    if len(out) < num_values:
+    if len(out) < nv:
         raise ValueError(f"RLE stream ended early: {len(out)} < {num_values}")
-    return out[:num_values]
+    return out[:nv]
 
 
 def decode_rle_hybrid(data, num_values: int, bit_width: int, pos: int = 0):
@@ -234,7 +243,8 @@ def decode_bit_packed_legacy(data, num_values: int, bit_width: int, pos: int = 0
     Returns ``(values: uint32 ndarray, end_pos)``.
     """
     if bit_width == 0:
-        return np.zeros(num_values, dtype=np.uint32), pos
+        return np.zeros(checked_alloc_size(num_values, "BIT_PACKED levels"),
+                        dtype=np.uint32), pos
     nbytes = (num_values * bit_width + 7) // 8
     buf = np.frombuffer(data, np.uint8) if not isinstance(data, np.ndarray) else data
     chunk = np.asarray(buf[pos : pos + nbytes], dtype=np.uint8)
@@ -344,7 +354,13 @@ def encode_rle_hybrid(values: np.ndarray, bit_width: int) -> bytes:
             _write_varint(out, run_len << 1)
             out.extend(int(v[s]).to_bytes(value_bytes, "little"))
         elif run_len:
-            pending.append(np.full(run_len, v[s], dtype=np.uint64))
+            # invariant: run_len < 8 here (>= 8 took the RLE branch above
+            # after the fill top-up) — assert keeps it loud, the size is
+            # in-memory run geometry, not a parsed field
+            assert run_len < 8, run_len
+            pending.append(
+                np.full(run_len, v[s], dtype=np.uint64)  # floorlint: disable=FL-ALLOC001
+            )
             pend_n += run_len
         prev_end = e
     if prev_end < n:

@@ -19,9 +19,10 @@ from ..batch.columns import ColumnBatch, RowGroupBatch
 from ..errors import (
     CorruptFooterError,
     CorruptPageError,
-    ParquetError,
     TruncatedFileError,
     UnsupportedFeatureError,
+    checked_alloc_size,
+    classified_decode_errors,
 )
 from ..io.source import FileSource, RetryingSource
 from ..utils import trace
@@ -324,6 +325,8 @@ class ParquetFileReader:
             )
         try:
             desc = self._descriptor_for(chunk)
+        except (OSError, MemoryError):
+            raise  # environmental, not a schema defect
         except Exception as e:
             raise CorruptFooterError(
                 f"column chunk names a path missing from the schema: "
@@ -331,19 +334,15 @@ class ParquetFileReader:
                 path=path, row_group=row_group_index,
             ) from e
         ctx = self._chunk_ctx(desc, row_group_index)
-        try:
+        # the shared transient-vs-corruption ladder: belt-and-braces so a
+        # corruption path no decoder anticipated still lands in the
+        # taxonomy, while OSError (flaky mounts) and MemoryError (host
+        # pressure) pass through — wrapping either as CorruptPageError
+        # would let salvage quarantine healthy data on an environmental
+        # blip
+        with classified_decode_errors(CorruptPageError,
+                                      "column chunk decode failed", ctx):
             batch, skips, pages_decoded = self._decode_chunk(chunk, desc, ctx)
-        except (ParquetError, OSError, MemoryError):
-            # OSError is the TRANSIENT class (flaky mounts) and MemoryError
-            # is host pressure: wrapping either as CorruptPageError would
-            # let salvage quarantine healthy data on an environmental blip
-            raise
-        except Exception as e:
-            # belt-and-braces: a corruption path no decoder anticipated
-            # must still land in the taxonomy
-            raise CorruptPageError(
-                f"column chunk decode failed: {e}", **ctx
-            ) from e
         if self.salvage_report is not None and self.salvage_report._first_count(
             ctx["column"], row_group_index, "ok"
         ):
@@ -414,8 +413,9 @@ class ParquetFileReader:
                     # flat optional column: the page's rows survive as
                     # nulls (def level 0 < max), so row alignment across
                     # columns is preserved exactly
+                    rows = checked_alloc_size(n, "salvaged null page", **pctx)
                     decoded.append(pg.DecodedPage(
-                        n, _empty_values(desc), np.zeros(n, np.uint32), None
+                        n, _empty_values(desc), np.zeros(rows, np.uint32), None
                     ))
                     skips.append((i, n, e))
             elif page.page_type == PageType.INDEX_PAGE:

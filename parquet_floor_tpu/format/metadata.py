@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from ..errors import CorruptFooterError, ParquetError, annotate
+from ..errors import CorruptFooterError, classified_decode_errors
 from ..io.source import FileSource
 from .parquet_thrift import FileMetaData, RowGroup
 from .schema import MessageType
@@ -86,29 +86,18 @@ def read_footer(source: FileSource) -> ParquetMetadata:
         )
     footer_start = size - FOOTER_TAIL - footer_len
     footer_bytes = source.read_at(footer_start, footer_len)
-    try:
+    # the shared ladder, with two footer-specific twists: hostile footer
+    # bytes can trip ANY decoder invariant (recursion, index, type errors
+    # deep in schema building), and ThriftDecodeError — the common
+    # corrupt-footer outcome — is reclassified so `except
+    # CorruptFooterError` sniff loops see ONE class (cause preserved)
+    with classified_decode_errors(
+        CorruptFooterError, "footer metadata does not parse",
+        {"path": path, "offset": footer_start},
+        reclassify=(ThriftDecodeError,),
+    ):
         fm = FileMetaData.read(CompactReader(footer_bytes))
         return ParquetMetadata(fm)
-    except ThriftDecodeError as e:
-        # the common corrupt-footer outcome: unparseable compact thrift.
-        # Surface it as the footer taxonomy class (cause preserved), so
-        # `except CorruptFooterError` sniff loops see ONE class
-        raise CorruptFooterError(
-            f"footer metadata does not parse: {e}",
-            path=path, offset=footer_start,
-        ) from e
-    except ParquetError as e:
-        raise annotate(e, path=path, offset=footer_start)
-    except (OSError, MemoryError):
-        raise  # transient I/O or host pressure, not corruption
-    except Exception as e:
-        # hostile footer bytes can trip any decoder invariant (recursion,
-        # index, type errors deep in schema building) — every such path is
-        # the same fact: the footer does not parse
-        raise CorruptFooterError(
-            f"footer metadata does not parse: {e}",
-            path=path, offset=footer_start,
-        ) from e
 
 
 def serialize_footer(file_meta: FileMetaData) -> bytes:

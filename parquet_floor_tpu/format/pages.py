@@ -17,11 +17,13 @@ from typing import List, Optional, Union
 import numpy as np
 
 from ..errors import (
+    ALLOC_CAP,
     ChecksumMismatchError,
     CorruptPageError,
     ParquetError,
     UnsupportedFeatureError,
     annotate,
+    classified_decode_errors,
 )
 from . import codecs
 from .encodings import plain as e_plain
@@ -122,7 +124,7 @@ class RawPage:
 # the format stores page sizes as i32: anything past this ceiling is a
 # corrupt header, and refusing it here keeps a flipped size bit from
 # turning into a multi-GiB allocation attempt downstream
-_PAGE_SIZE_CAP = 1 << 31
+_PAGE_SIZE_CAP = ALLOC_CAP
 
 
 def _check_page_sizes(header: PageHeader, ctx: Optional[dict],
@@ -254,7 +256,11 @@ def decode_dictionary_page(
     page: RawPage, column: ColumnDescriptor, codec: int, verify_crc: bool = False,
     ctx: Optional[dict] = None,
 ):
-    try:
+    # hostile payload bytes can trip any decoder invariant; the shared
+    # ladder turns every such path into annotated taxonomy, never a raw
+    # IndexError deep in an encoding
+    with classified_decode_errors(CorruptPageError,
+                                  "dictionary page decode failed", ctx):
         dh: DictionaryPageHeader = page.header.dictionary_page_header
         if dh is None:
             raise CorruptPageError("dictionary page without its header struct")
@@ -269,17 +275,6 @@ def decode_dictionary_page(
             data, dh.num_values, column.physical_type, column.type_length
         )
         return values
-    except ParquetError as e:
-        raise annotate(e, **(ctx or {}))
-    except (OSError, MemoryError):
-        raise  # transient I/O or host pressure, not corruption
-    except Exception as e:
-        # hostile payload bytes can trip any decoder invariant; corruption
-        # must always surface as taxonomy, never a raw IndexError deep in
-        # an encoding
-        raise CorruptPageError(
-            f"dictionary page decode failed: {e}", **(ctx or {})
-        ) from e
 
 
 def _decode_values(
@@ -442,7 +437,8 @@ def decode_data_page(
     lacks, :class:`CorruptPageError` for everything hostile bytes can trip
     — including non-ValueError crashes deep inside an encoding decoder.
     """
-    try:
+    with classified_decode_errors(CorruptPageError,
+                                  "data page decode failed", ctx):
         if page.page_type == PageType.DATA_PAGE:
             return decode_data_page_v1(page, column, codec, dictionary,
                                        verify_crc, ctx)
@@ -450,14 +446,6 @@ def decode_data_page(
             return decode_data_page_v2(page, column, codec, dictionary,
                                        verify_crc, ctx)
         raise CorruptPageError(f"not a data page: type {page.page_type}")
-    except ParquetError as e:
-        raise annotate(e, **(ctx or {}))
-    except (OSError, MemoryError):
-        raise  # transient I/O or host pressure, not corruption
-    except Exception as e:
-        raise CorruptPageError(
-            f"data page decode failed: {e}", **(ctx or {})
-        ) from e
 
 
 # ---------------------------------------------------------------------------

@@ -14,11 +14,13 @@ from __future__ import annotations
 import io
 import mmap
 import os
+import random
 import threading
 import time
 from typing import BinaryIO, Optional, Union
 
 from ..errors import IoRetryExhaustedError, TruncatedFileError
+from ..utils import trace
 
 PathLike = Union[str, os.PathLike]
 
@@ -128,16 +130,27 @@ class RetryingSource:
     After ``retries`` failed re-attempts the last error is wrapped in
     :class:`~parquet_floor_tpu.errors.IoRetryExhaustedError` (still an
     ``OSError``) carrying the attempt count and read offset.
+
+    The exponential backoff carries uniform jitter (``jitter`` is the
+    fraction of each delay added at random, default 10%) so a fleet of
+    readers hitting the same flaky mount does not retry in lockstep.
+    Every read that retry *saved* is surfaced as an ``io.retry`` trace
+    decision (and exhaustion as ``io.retry_exhausted``), so production
+    serving can watch retry rates without new plumbing.
     """
 
     def __init__(self, inner, retries: int, backoff_s: float = 0.05,
-                 sleep=time.sleep):
+                 sleep=time.sleep, jitter: float = 0.1, rng=random.random):
         if retries < 0:
             raise ValueError(f"retries must be >= 0, got {retries}")
+        if jitter < 0:
+            raise ValueError(f"jitter must be >= 0, got {jitter}")
         self._inner = inner
         self._retries = int(retries)
         self._backoff_s = float(backoff_s)
         self._sleep = sleep
+        self._jitter = float(jitter)
+        self._rng = rng
         self.retried_reads = 0  # observability: how often retry saved a read
 
     @property
@@ -155,13 +168,23 @@ class RetryingSource:
                 data = self._inner.read_at(offset, length)
                 if attempt:
                     self.retried_reads += 1
+                    trace.decision("io.retry", {
+                        "path": self.name, "offset": offset,
+                        "attempts": attempt + 1,
+                        "retried_reads": self.retried_reads,
+                    })
                 return data
             except (EOFError, TruncatedFileError):
                 raise  # deterministic: the bytes are not there
             except OSError as e:
                 last = e
                 if attempt < self._retries:
-                    self._sleep(self._backoff_s * (2 ** attempt))
+                    delay = self._backoff_s * (2 ** attempt)
+                    self._sleep(delay * (1.0 + self._jitter * self._rng()))
+        trace.decision("io.retry_exhausted", {
+            "path": self.name, "offset": offset,
+            "attempts": self._retries + 1, "error": str(last),
+        })
         raise IoRetryExhaustedError(
             f"read of {length} bytes failed after {self._retries + 1} "
             f"attempts: {last}",

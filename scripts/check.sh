@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# The whole commit gate in one entry point:
+#   1. style lint + floorlint (scripts/lint.py runs both)
+#   2. tier-1 pytest (the ROADMAP.md verify recipe)
+# Usage: scripts/check.sh [extra pytest args]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint + floorlint =="
+python scripts/lint.py || exit 1
+
+echo "== tier-1 pytest =="
+t1_log="$(mktemp /tmp/_t1.XXXXXX.log)"
+trap 'rm -f "$t1_log"' EXIT
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+  -p no:randomly "$@" 2>&1 | tee "$t1_log"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" | tr -cd . | wc -c)"
+exit "$rc"

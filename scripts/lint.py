@@ -6,6 +6,11 @@ Prefers ruff when installed (config in pyproject.toml).  Otherwise runs a
 built-in subset that needs only the standard library, so the gate works
 in hermetic images: syntax (compile), tabs, trailing whitespace, long
 lines, and AST-level unused-import detection.
+
+Either way it then runs **floorlint** (``python -m parquet_floor_tpu.analysis``)
+— the project-invariant analyzer (error-taxonomy / tracer-purity /
+resource / allocation rules; docs/static_analysis.md).  Style and
+invariants are one gate: ``python scripts/lint.py`` fails if either does.
 """
 
 from __future__ import annotations
@@ -17,7 +22,9 @@ import subprocess
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-TARGETS = ["parquet_floor_tpu", "tests", "benchmarks", "bench.py", "__graft_entry__.py"]
+TARGETS = ["parquet_floor_tpu", "tests", "benchmarks", "scripts",
+           "bench.py", "__graft_entry__.py"]
+FLOORLINT_TARGETS = ["parquet_floor_tpu", "tests", "scripts"]
 MAX_LINE = 100
 
 
@@ -34,6 +41,31 @@ def run_ruff() -> int:
     return subprocess.call(
         ["ruff", "check", *TARGETS], cwd=ROOT
     )
+
+
+def _dunder_all(tree: ast.AST) -> set:
+    """Names re-exported via ``__all__`` (plain or augmented assignment of
+    string-literal lists/tuples) — parsed from the AST, not by grepping the
+    source for quoted strings (which also matched docstrings and error
+    messages, hiding genuinely dead imports)."""
+    names = set()
+    for node in ast.walk(tree):
+        value = None
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "__all__"
+            for t in node.targets
+        ):
+            value = node.value
+        elif isinstance(node, ast.AugAssign) and isinstance(
+            node.target, ast.Name
+        ) and node.target.id == "__all__":
+            value = node.value
+        if isinstance(value, (ast.List, ast.Tuple)):
+            names |= {
+                e.value for e in value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            }
+    return names
 
 
 def _unused_imports(tree: ast.AST, src: str):
@@ -55,14 +87,13 @@ def _unused_imports(tree: ast.AST, src: str):
         n.id for n in ast.walk(tree) if isinstance(n, ast.Name)
     } | {
         n.attr for n in ast.walk(tree) if isinstance(n, ast.Attribute)
-    }
-    # names echoed in __all__ or doctests count as used (cheap heuristic);
+    } | _dunder_all(tree)
     # "# noqa" on the import line suppresses, as ruff would
     src_lines = src.splitlines()
     for name, lineno in sorted(imported.items()):
         if "# noqa" in src_lines[lineno - 1]:
             continue
-        if name not in used and f'"{name}"' not in src and f"'{name}'" not in src:
+        if name not in used:
             yield lineno, f"unused import: {name}"
 
 
@@ -91,7 +122,17 @@ def run_builtin() -> int:
     return 1 if problems else 0
 
 
+def run_floorlint() -> int:
+    """The invariant analyzer rides the same gate (its own CLI for use in
+    editors: ``python -m parquet_floor_tpu.analysis --list-rules``)."""
+    return subprocess.call(
+        [sys.executable, "-m", "parquet_floor_tpu.analysis",
+         *FLOORLINT_TARGETS],
+        cwd=ROOT,
+    )
+
+
 if __name__ == "__main__":
-    if shutil.which("ruff"):
-        sys.exit(run_ruff())
-    sys.exit(run_builtin())
+    style_rc = run_ruff() if shutil.which("ruff") else run_builtin()
+    floorlint_rc = run_floorlint()
+    sys.exit(1 if (style_rc or floorlint_rc) else 0)

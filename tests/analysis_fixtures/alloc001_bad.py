@@ -1,0 +1,12 @@
+# floorlint: scope=FL-ALLOC
+"""Seeded-bad: allocation sized straight from a parsed length field — a
+flipped bit in the header becomes a multi-GiB allocation attempt."""
+
+import numpy as np
+
+
+def decode_block(buf):
+    n = int.from_bytes(buf[:4], "little")
+    values = np.empty(n, dtype=np.uint8)
+    frame = bytes(n * 4)
+    return values, frame

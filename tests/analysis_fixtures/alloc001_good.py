@@ -1,0 +1,19 @@
+# floorlint: scope=FL-ALLOC
+"""Clean: the parsed size flows through the checked i32 size-cap helper
+before it drives any allocation."""
+
+import numpy as np
+
+
+def checked_alloc_size(n, what):  # stand-in for errors.checked_alloc_size
+    n = int(n)
+    if n < 0 or n >= 1 << 31:
+        raise ValueError(f"implausible {what} size {n}")
+    return n
+
+
+def decode_block(buf):
+    n = checked_alloc_size(int.from_bytes(buf[:4], "little"), "block")
+    values = np.empty(n, dtype=np.uint8)
+    frame = bytes(n * 4)
+    return values, frame

@@ -1,0 +1,14 @@
+# floorlint: scope=FL-EXC001
+"""Seeded-bad: broad except wraps EVERYTHING as a decode error — a flaky
+mount's OSError or host-pressure MemoryError becomes 'corruption'."""
+
+
+class BoomDecodeError(ValueError):
+    pass
+
+
+def decode(data):
+    try:
+        return data.decode("utf-8")
+    except Exception as e:
+        raise BoomDecodeError(f"decode failed: {e}") from e

@@ -1,0 +1,16 @@
+# floorlint: scope=FL-EXC001
+"""Clean: the transient classes re-raise before the broad wrap (the
+hand-rolled equivalent of errors.classified_decode_errors)."""
+
+
+class BoomDecodeError(ValueError):
+    pass
+
+
+def decode(data):
+    try:
+        return data.decode("utf-8")
+    except (OSError, MemoryError):
+        raise
+    except Exception as e:
+        raise BoomDecodeError(f"decode failed: {e}") from e

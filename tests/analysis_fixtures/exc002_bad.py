@@ -1,0 +1,10 @@
+# floorlint: scope=FL-EXC002
+"""Seeded-bad: the re-raise drops the cause chain — the original
+traceback (WHICH bytes were bad) is gone from the report."""
+
+
+def parse_count(text):
+    try:
+        return int(text)
+    except ValueError as e:
+        raise KeyError("count field is not an integer")

@@ -1,0 +1,9 @@
+# floorlint: scope=FL-EXC002
+"""Clean: `from e` preserves the cause chain."""
+
+
+def parse_count(text):
+    try:
+        return int(text)
+    except ValueError as e:
+        raise KeyError("count field is not an integer") from e

@@ -1,0 +1,13 @@
+# floorlint: scope=FL-EXC003
+"""Seeded-bad: a taxonomy error raised at a decode boundary with no
+location context — in a thousand-file scan nobody learns WHICH bytes."""
+
+
+class CorruptPageError(ValueError):
+    pass
+
+
+def read_page(buf):
+    if len(buf) < 8:
+        raise CorruptPageError("page shorter than its header")
+    return buf

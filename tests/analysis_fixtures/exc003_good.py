@@ -1,0 +1,16 @@
+# floorlint: scope=FL-EXC003
+"""Clean: the raise carries location-context kwargs."""
+
+
+class CorruptPageError(ValueError):
+    def __init__(self, message, path=None, offset=None):
+        super().__init__(message)
+        self.path = path
+        self.offset = offset
+
+
+def read_page(buf, path):
+    if len(buf) < 8:
+        raise CorruptPageError("page shorter than its header",
+                               path=path, offset=0)
+    return buf

@@ -1,0 +1,14 @@
+"""Seeded-bad: the PR 1 fd-leak shapes — a chained read whose handle
+lives until GC, and a linear open/use/close that leaks when `use`
+raises."""
+
+
+def read_header(path):
+    return open(path, "rb").read()[:16]
+
+
+def read_trailer(path, parse):
+    f = open(path, "rb")
+    data = parse(f)  # any raise here leaks f
+    f.close()
+    return data

@@ -1,0 +1,20 @@
+"""Clean: every acquisition is context-managed or closed on all
+exception paths (the constructor-guard shape)."""
+
+
+class Reader:
+    def __init__(self, path, parse):
+        self._fh = open(path, "rb")
+        try:
+            self.header = parse(self._fh)
+        except BaseException:
+            self._fh.close()
+            raise
+
+    def close(self):
+        self._fh.close()
+
+
+def read_header(path):
+    with open(path, "rb") as f:
+        return f.read()[:16]

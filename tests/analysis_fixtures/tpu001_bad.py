@@ -1,0 +1,19 @@
+# floorlint: scope=FL-TPU
+"""Seeded-bad: host file I/O and host CRC inside a jitted function —
+both run once at trace time, not per call, and crc32 cannot see device
+bytes at all."""
+
+import zlib
+
+
+def jit(fn):  # stand-in so the fixture parses without jax installed
+    return fn
+
+
+@jit
+def decode_step(payload):
+    with open("/tmp/decode.cfg") as f:
+        limit = int(f.read())
+    if zlib.crc32(payload) == 0:
+        return payload
+    return payload[:limit]

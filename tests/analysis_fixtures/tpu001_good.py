@@ -1,0 +1,12 @@
+# floorlint: scope=FL-TPU
+"""Clean: the traced function is pure; CRC policy and config reads live
+on the host, outside the compiled region."""
+
+
+def jit(fn):  # stand-in so the fixture parses without jax installed
+    return fn
+
+
+@jit
+def decode_step(payload, limit):
+    return payload[:limit]

@@ -1,0 +1,14 @@
+# floorlint: scope=FL-TPU
+"""Seeded-bad: host materialization inside a traced function — int() on
+a traced value crashes at trace time; .item() forces a device→host sync
+mid-program."""
+
+
+def jit(fn):  # stand-in so the fixture parses without jax installed
+    return fn
+
+
+@jit
+def reduce_step(acc, x):
+    total = int(x) + acc.item()
+    return total

@@ -1,0 +1,13 @@
+# floorlint: scope=FL-TPU
+"""Clean: static shapes may be read with int(x.shape[i]); everything
+else stays traced."""
+
+
+def jit(fn):  # stand-in so the fixture parses without jax installed
+    return fn
+
+
+@jit
+def reduce_step(acc, x):
+    rows = int(x.shape[0])
+    return acc + x.sum() * rows

@@ -1,6 +1,8 @@
 """Fault-injection harness (parquet_floor_tpu.testing) + bounded I/O
 retries (ReaderOptions.io_retries / io.source.RetryingSource)."""
 
+import pathlib
+
 import numpy as np
 import pytest
 
@@ -41,7 +43,7 @@ def test_bit_flips_are_deterministic_and_nonmutating(small_file):
         b = bytes(src.read_at(90, 30))
         assert a == b  # same call, same injected bytes
         assert src.injected_flips == 4
-    clean = open(small_file, "rb").read()[90:120]
+    clean = pathlib.Path(small_file).read_bytes()[90:120]
     assert a != clean
     assert bytes([a[10] ^ 0x01, a[11] ^ 0x80]) == clean[10:12]
     # partial overlap: only the flip inside the window applies
@@ -49,7 +51,7 @@ def test_bit_flips_are_deterministic_and_nonmutating(small_file):
         w = bytes(src.read_at(101, 5))
         assert w[0] == clean[11] ^ 0x80
     # the file on disk is untouched
-    assert open(small_file, "rb").read()[90:120] == clean
+    assert pathlib.Path(small_file).read_bytes()[90:120] == clean
 
 
 def test_random_flips_deterministic():
@@ -111,13 +113,10 @@ def test_retry_exhaustion_raises_taxonomy(small_file):
 def test_retries_never_mask_deterministic_errors(small_file):
     """Truncation is a fact about the bytes: the retry loop must re-raise
     immediately, not spin on it."""
-    real = FileSource(small_file)
-    retry = RetryingSource(real, retries=5, backoff_s=10.0)  # would hang if slept
-    try:
+    with FileSource(small_file) as real:
+        retry = RetryingSource(real, retries=5, backoff_s=10.0)  # would hang if slept
         with pytest.raises(TruncatedFileError):
             retry.read_at(real.size - 4, 100)
-    finally:
-        retry.close()
 
 
 def test_retry_off_by_default(small_file):
@@ -173,3 +172,59 @@ def test_short_read_injection(small_file):
         src.read_at(0, 64)
     assert src.injected_short_reads == 1
     src.close()
+
+
+def test_retry_backoff_jitter(small_file):
+    """Jitter stretches each backoff by up to `jitter` of its base delay
+    (never shrinks it), driven by the injected rng."""
+    sleeps = []
+    src = FaultInjectingSource(small_file, transient_error_rate=1.0,
+                               seed=3, max_transient_failures=3)
+    retry = RetryingSource(src, retries=3, backoff_s=0.01,
+                           sleep=sleeps.append, jitter=0.5, rng=lambda: 1.0)
+    try:
+        assert bytes(retry.read_at(0, 4)) == b"PAR1"
+    finally:
+        retry.close()
+    # rng pinned at 1.0: every delay is base * (1 + 0.5)
+    assert sleeps == pytest.approx([0.01 * 1.5, 0.02 * 1.5, 0.04 * 1.5])
+
+    with pytest.raises(ValueError, match="jitter"):
+        RetryingSource(src, retries=1, jitter=-0.1)
+
+
+def test_retried_reads_surface_as_trace_decisions(small_file):
+    """ROADMAP 'retry metrics in trace': every read retry saved lands in
+    trace.decisions(), and exhaustion is recorded too."""
+    from parquet_floor_tpu.utils import trace
+
+    trace.reset()
+    trace.enable()
+    try:
+        src = FaultInjectingSource(small_file, transient_error_rate=1.0,
+                                   seed=7, max_transient_failures=2)
+        retry = RetryingSource(src, retries=4, backoff_s=0.0,
+                               sleep=lambda s: None)
+        try:
+            retry.read_at(0, 4)
+        finally:
+            retry.close()
+        saved = [d for d in trace.decisions() if d["decision"] == "io.retry"]
+        assert saved and saved[-1]["retried_reads"] == retry.retried_reads == 1
+        assert saved[-1]["offset"] == 0
+
+        src2 = FaultInjectingSource(small_file, transient_error_rate=1.0,
+                                    seed=7)  # unbounded failures
+        retry2 = RetryingSource(src2, retries=1, backoff_s=0.0,
+                                sleep=lambda s: None)
+        try:
+            with pytest.raises(IoRetryExhaustedError):
+                retry2.read_at(0, 4)
+        finally:
+            retry2.close()
+        exhausted = [d for d in trace.decisions()
+                     if d["decision"] == "io.retry_exhausted"]
+        assert exhausted and exhausted[-1]["attempts"] == 2
+    finally:
+        trace.disable()
+        trace.reset()

@@ -1,0 +1,212 @@
+"""floorlint (parquet_floor_tpu.analysis) self-tests.
+
+Three layers: per-rule seeded fixture pairs (one violating, one clean)
+under ``tests/analysis_fixtures/``, the meta-test that the analyzer runs
+clean on the live tree (the same gate ``scripts/lint.py`` enforces), and
+the CLI/suppression/baseline workflows."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from parquet_floor_tpu.analysis import (
+    ALL_RULES,
+    analyze_file,
+    load_baseline,
+    run,
+    write_baseline,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = pathlib.Path(__file__).resolve().parent / "analysis_fixtures"
+
+CASES = [
+    ("exc001", "FL-EXC001"),
+    ("exc002", "FL-EXC002"),
+    ("exc003", "FL-EXC003"),
+    ("tpu001", "FL-TPU001"),
+    ("tpu002", "FL-TPU002"),
+    ("res001", "FL-RES001"),
+    ("alloc001", "FL-ALLOC001"),
+]
+
+
+@pytest.mark.parametrize("stem,rule", CASES)
+def test_bad_fixture_caught(stem, rule):
+    violations = analyze_file(FIXTURES / f"{stem}_bad.py")
+    assert any(v.rule == rule for v in violations), (
+        f"{stem}_bad.py should trip {rule}; got {violations!r}"
+    )
+
+
+@pytest.mark.parametrize("stem,rule", CASES)
+def test_good_fixture_clean(stem, rule):
+    violations = analyze_file(FIXTURES / f"{stem}_good.py")
+    assert violations == [], (
+        f"{stem}_good.py should be clean; got "
+        f"{[v.render() for v in violations]}"
+    )
+
+
+def test_every_rule_has_a_fixture_pair():
+    covered = {rule for _, rule in CASES}
+    assert covered == {rule for rule, _ in ALL_RULES}
+    for stem, _ in CASES:
+        assert (FIXTURES / f"{stem}_bad.py").exists()
+        assert (FIXTURES / f"{stem}_good.py").exists()
+
+
+def test_live_tree_is_clean():
+    """The acceptance gate: the analyzer exits clean on the real code
+    (suppressions allowed — each carries an in-code justification)."""
+    result = run([str(ROOT / "parquet_floor_tpu"), str(ROOT / "tests"),
+                  str(ROOT / "scripts")])
+    assert result.ok, "\n".join(v.render() for v in result.violations)
+    assert result.files > 50  # the walk really covered the tree
+
+
+def test_fixture_dir_excluded_from_directory_walks():
+    """Walking `tests/` must skip the deliberately-bad fixtures (they are
+    only analyzed when named explicitly)."""
+    result = run([str(FIXTURES.parent)])
+    assert result.ok
+
+
+def test_suppression_same_line_and_preceding_line(tmp_path):
+    bad = ("def f(path):\n"
+           "    return open(path).read()\n")
+    p = tmp_path / "leak.py"
+    p.write_text(bad)
+    assert not run([str(p)]).ok
+
+    p.write_text("def f(path):\n"
+                 "    return open(path).read()  # floorlint: disable=FL-RES001\n")
+    r = run([str(p)])
+    assert r.ok and r.suppressed == 1
+
+    p.write_text("def f(path):\n"
+                 "    # floorlint: disable=FL-RES\n"
+                 "    return open(path).read()\n")
+    r = run([str(p)])
+    assert r.ok and r.suppressed == 1  # family prefix works too
+
+    p.write_text("# floorlint: disable-file=all\n"
+                 "def f(path):\n"
+                 "    return open(path).read()\n")
+    assert run([str(p)]).ok
+
+
+def test_baseline_workflow(tmp_path):
+    p = tmp_path / "leak.py"
+    p.write_text("def f(path):\n    return open(path).read()\n")
+    first = run([str(p)])
+    assert not first.ok
+
+    baseline_file = tmp_path / "floorlint.baseline"
+    write_baseline(baseline_file, first.violations)
+    baseline = load_baseline(baseline_file)
+    again = run([str(p)], baseline=baseline)
+    assert again.ok and again.baselined == len(first.violations)
+
+    # a NEW violation is still reported even with the baseline in place
+    p.write_text("def f(path):\n"
+                 "    return open(path).read()\n"
+                 "def g(path):\n"
+                 "    return open(path).read()\n")
+    third = run([str(p)], baseline=load_baseline(baseline_file))
+    assert len(third.violations) == 1
+
+
+def test_checked_in_baseline_is_empty():
+    assert sum(load_baseline(ROOT / "floorlint.baseline").values()) == 0
+
+
+def test_cli_exit_codes(tmp_path):
+    env_cwd = str(ROOT)
+    bad = FIXTURES / "res001_bad.py"
+    good = FIXTURES / "res001_good.py"
+    rc_bad = subprocess.call(
+        [sys.executable, "-m", "parquet_floor_tpu.analysis",
+         str(bad), "--no-baseline"], cwd=env_cwd,
+        stdout=subprocess.DEVNULL)
+    rc_good = subprocess.call(
+        [sys.executable, "-m", "parquet_floor_tpu.analysis",
+         str(good), "--no-baseline"], cwd=env_cwd,
+        stdout=subprocess.DEVNULL)
+    assert (rc_bad, rc_good) == (1, 0)
+
+
+def test_cli_list_rules():
+    out = subprocess.check_output(
+        [sys.executable, "-m", "parquet_floor_tpu.analysis", "--list-rules"],
+        cwd=str(ROOT), text=True)
+    for rule, _ in ALL_RULES:
+        assert rule in out
+
+
+def test_scope_directive_opts_file_in(tmp_path):
+    """Without scope=, FL-ALLOC only applies under format/; the directive
+    pulls an arbitrary file in (how the fixtures work)."""
+    body = ("import numpy as np\n\n\n"
+            "def f(buf):\n"
+            "    n = int.from_bytes(buf[:4], 'little')\n"
+            "    return np.empty(n, dtype=np.uint8)\n")
+    p = tmp_path / "mod.py"
+    p.write_text(body)
+    assert run([str(p)]).ok  # out of scope: not flagged
+    p.write_text("# floorlint: scope=FL-ALLOC\n" + body)
+    assert not run([str(p)]).ok
+
+
+def test_exc001_split_transient_arms_not_flagged(tmp_path):
+    """`except OSError: raise` + `except MemoryError as e: raise e` as
+    separate arms protect transients just as well as one tuple arm."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "# floorlint: scope=FL-EXC001\n"
+        "def f(data):\n"
+        "    try:\n"
+        "        return data.decode()\n"
+        "    except OSError:\n"
+        "        raise\n"
+        "    except MemoryError as e:\n"
+        "        raise e\n"
+        "    except Exception as e:\n"
+        "        raise ValueError(f'bad: {e}') from e\n"
+    )
+    r = run([str(p)])
+    assert r.ok, [v.render() for v in r.violations]
+
+
+def test_analyze_file_honors_suppressions(tmp_path):
+    """The public analyze_file API reports the same verdicts as the CLI:
+    a suppressed line is not a violation."""
+    p = tmp_path / "leak.py"
+    p.write_text("def f(path):\n"
+                 "    return open(path).read()  # floorlint: disable=FL-RES001\n")
+    assert analyze_file(p) == []
+
+
+def test_exc001_nested_handler_raise_does_not_shadow(tmp_path):
+    """A bare `raise` inside a NESTED except handler re-raises the nested
+    exception, not the outer one — it must not count as the outer broad
+    handler re-raising, nor may nested wrap-raises be attributed out."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "# floorlint: scope=FL-EXC001\n"
+        "def f(data, cleanup):\n"
+        "    try:\n"
+        "        return data.decode()\n"
+        "    except Exception as e:\n"
+        "        try:\n"
+        "            cleanup()\n"
+        "        except KeyError:\n"
+        "            raise\n"
+        "        raise ValueError(f'bad: {e}') from e\n"
+    )
+    r = run([str(p)])
+    assert [v.rule for v in r.violations] == ["FL-EXC001"], (
+        [v.render() for v in r.violations]
+    )

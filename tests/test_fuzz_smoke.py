@@ -9,6 +9,7 @@ A small subset runs in tier-1; the full >=200-case sweep is ``slow``.
 """
 
 import contextlib
+import pathlib
 import signal
 
 import numpy as np
@@ -101,7 +102,7 @@ def _flips_for(seed: int, size: int):
 
 
 def _run_cases(path, good, seeds):
-    size = len(open(path, "rb").read())
+    size = pathlib.Path(path).stat().st_size
     hangs, leaks, wrong = [], [], []
     for seed in seeds:
         src = FaultInjectingSource(path, bit_flips=_flips_for(seed, size))

@@ -4,6 +4,8 @@ silently).  SURVEY.md §5 notes the reference *swallows* I/O errors
 (FSDataInputStream.java:21-45); this framework's stance is fail-loudly.
 """
 
+import pathlib
+
 import numpy as np
 import pytest
 
@@ -50,7 +52,7 @@ def _full_decode(data: bytes, tmp_path):
 
 
 def test_truncations_raise_cleanly(valid_file, tmp_path):
-    data = open(valid_file, "rb").read()
+    data = pathlib.Path(valid_file).read_bytes()
     # truncate at a spread of positions incl. footer, pages, magic
     for cut in [0, 1, 3, 4, 7, len(data) // 4, len(data) // 2,
                 len(data) - 1000, len(data) - 9, len(data) - 4, len(data) - 1]:
@@ -64,7 +66,7 @@ def test_bit_flips_never_hang_or_crash(valid_file, tmp_path):
     """Flip bytes at random positions: decode must either succeed (the
     flip hit slack/unread bytes or undetected payload) or raise a Python
     exception — never deadlock or kill the interpreter."""
-    data = bytearray(open(valid_file, "rb").read())
+    data = bytearray(pathlib.Path(valid_file).read_bytes())
     rng = np.random.default_rng(11)
     for _ in range(60):
         pos = int(rng.integers(0, len(data)))
@@ -83,7 +85,7 @@ def test_footer_truncation_edge_cases(valid_file, tmp_path):
     and zero-byte files must each raise CorruptFooterError or
     TruncatedFileError — the footer taxonomy, with the file path in the
     message."""
-    data = open(valid_file, "rb").read()
+    data = pathlib.Path(valid_file).read_bytes()
     footer_len = int.from_bytes(data[-8:-4], "little")
     # cut mid-thrift: remove bytes from inside the footer body but keep
     # the (now lying) length word + magic tail intact
@@ -108,7 +110,7 @@ def test_footer_truncation_edge_cases(valid_file, tmp_path):
 def test_error_context_names_file_and_column(valid_file, tmp_path):
     """A corrupt page error must say WHICH file and WHICH column — bare
     'page payload truncated' is useless when scanning a directory."""
-    data = bytearray(open(valid_file, "rb").read())
+    data = bytearray(pathlib.Path(valid_file).read_bytes())
     pos = len(data) // 8  # inside an early data page payload
     data[pos] ^= 0x01
     p = tmp_path / "ctx.parquet"
@@ -131,7 +133,7 @@ def test_reader_options_toggles_crc(valid_file, tmp_path):
     same payload flip passes with verification off (the flip lands in
     Snappy-surviving bytes or raises a decode error) and is *guaranteed*
     caught as ChecksumMismatchError with it on."""
-    data = bytearray(open(valid_file, "rb").read())
+    data = bytearray(pathlib.Path(valid_file).read_bytes())
     data[len(data) // 8] ^= 0x01
     p = tmp_path / "crc2.parquet"
     p.write_bytes(bytes(data))
@@ -157,7 +159,7 @@ def test_garbage_thrift_footer_is_corrupt_footer_error(valid_file, tmp_path):
     """Unparseable footer thrift (magic + length intact) surfaces as
     CorruptFooterError — sniff loops need ONE class, not bare
     ThriftDecodeError."""
-    data = bytearray(open(valid_file, "rb").read())
+    data = bytearray(pathlib.Path(valid_file).read_bytes())
     footer_len = int.from_bytes(data[-8:-4], "little")
     start = len(data) - 8 - footer_len
     data[start : start + footer_len] = b"\xff" * footer_len
@@ -205,11 +207,11 @@ def test_verify_crc_shorthand_folds_into_options(valid_file):
 
 def test_footer_length_lies(valid_file, tmp_path):
     """A footer length field pointing outside the file must raise."""
-    data = bytearray(open(valid_file, "rb").read())
+    data = bytearray(pathlib.Path(valid_file).read_bytes())
     data[-8:-4] = (2**31 - 1).to_bytes(4, "little")
     with pytest.raises((ValueError, EOFError)):
         _full_decode(bytes(data), tmp_path)
-    data = bytearray(open(valid_file, "rb").read())
+    data = bytearray(pathlib.Path(valid_file).read_bytes())
     data[-8:-4] = (0).to_bytes(4, "little")
     with pytest.raises((ValueError, EOFError)):
         _full_decode(bytes(data), tmp_path)
@@ -217,7 +219,7 @@ def test_footer_length_lies(valid_file, tmp_path):
 
 def test_crc_verification_catches_payload_flip(valid_file, tmp_path):
     """With verify_crc, a flipped page payload byte is detected."""
-    data = bytearray(open(valid_file, "rb").read())
+    data = bytearray(pathlib.Path(valid_file).read_bytes())
     # find a spot inside the first page payload (after the first header):
     # flip a byte at 1/8 into the file (data pages start near the front)
     pos = len(data) // 8
@@ -369,7 +371,7 @@ def test_golden_corpus_corruption_never_hangs(tmp_path):
     assert paths, "golden corpus missing"
     rng = np.random.default_rng(23)
     for path in paths:
-        data = bytearray(open(path, "rb").read())
+        data = bytearray(pathlib.Path(path).read_bytes())
         for _ in range(15):
             pos = int(rng.integers(0, len(data)))
             old = data[pos]
@@ -380,3 +382,102 @@ def test_golden_corpus_corruption_never_hangs(tmp_path):
                 pass  # clean failure is the acceptable outcome
             finally:
                 data[pos] = old
+
+
+# ---------------------------------------------------------------------------
+# Shared taxonomy helpers (errors.classified_decode_errors /
+# errors.checked_alloc_size) — the blessed idioms floorlint checks for
+# ---------------------------------------------------------------------------
+
+def test_classified_decode_errors_wraps_hostile_crashes():
+    from parquet_floor_tpu.errors import (
+        CorruptPageError, classified_decode_errors,
+    )
+
+    with pytest.raises(CorruptPageError, match=r"page decode failed: .*boom"):
+        with classified_decode_errors(CorruptPageError, "page decode failed",
+                                      {"path": "f.parquet", "page": 3}):
+            raise IndexError("boom")
+    try:
+        with classified_decode_errors(CorruptPageError, "page decode failed",
+                                      {"path": "f.parquet", "page": 3}):
+            raise IndexError("boom")
+    except CorruptPageError as e:
+        assert e.path == "f.parquet" and e.page == 3
+        assert isinstance(e.__cause__, IndexError)
+
+
+def test_classified_decode_errors_passes_transients_through():
+    from parquet_floor_tpu.errors import (
+        CorruptPageError, classified_decode_errors,
+    )
+
+    for transient in (OSError("flaky mount"), MemoryError()):
+        with pytest.raises(type(transient)) as ei:
+            with classified_decode_errors(CorruptPageError, "decode", {}):
+                raise transient
+        assert not isinstance(ei.value, ParquetError)
+
+
+def test_classified_decode_errors_annotates_taxonomy():
+    from parquet_floor_tpu.errors import (
+        CorruptPageError, classified_decode_errors,
+    )
+
+    # inner frames win on fields they already set; missing fields fill in
+    with pytest.raises(CorruptPageError) as ei:
+        with classified_decode_errors(
+            CorruptPageError, "decode", {"path": "outer", "column": "c"}
+        ):
+            raise CorruptPageError("inner defect", path="inner")
+    assert ei.value.path == "inner" and ei.value.column == "c"
+    assert ei.value.message == "inner defect"  # not re-wrapped
+
+
+def test_classified_decode_errors_reclassifies():
+    from parquet_floor_tpu.errors import (
+        CorruptFooterError, classified_decode_errors,
+    )
+    from parquet_floor_tpu.format.thrift import ThriftDecodeError
+
+    with pytest.raises(CorruptFooterError, match="does not parse") as ei:
+        with classified_decode_errors(
+            CorruptFooterError, "footer metadata does not parse",
+            {"path": "f"}, reclassify=(ThriftDecodeError,),
+        ):
+            raise ThriftDecodeError("bad varint")
+    assert isinstance(ei.value.__cause__, ThriftDecodeError)
+
+
+def test_checked_alloc_size_caps_parsed_sizes():
+    from parquet_floor_tpu.errors import CorruptPageError, checked_alloc_size
+
+    assert checked_alloc_size(0) == 0
+    assert checked_alloc_size(2**31 - 1) == 2**31 - 1
+    assert checked_alloc_size(np.int64(17), "x") == 17
+    for bad in (-1, 2**31, 2**40):
+        with pytest.raises(CorruptPageError, match="implausible"):
+            checked_alloc_size(bad, "test size", path="f.parquet")
+    with pytest.raises(CorruptPageError):
+        checked_alloc_size(64, cap=64)
+    assert checked_alloc_size(63, cap=64) == 63
+    # it is a ValueError (taxonomy secondary base): pre-taxonomy callers
+    # catching ValueError still see these
+    with pytest.raises(ValueError):
+        checked_alloc_size(-5)
+
+
+def test_corrupt_delta_total_count_is_corruption_not_memoryerror():
+    """A flipped varint claiming a 2^40-value DELTA stream must surface
+    as CorruptPageError via the size cap, not as a giant allocation."""
+    from parquet_floor_tpu.errors import CorruptPageError
+    from parquet_floor_tpu.format.encodings.delta import (
+        decode_delta_binary_packed,
+    )
+
+    # header: block_size=128, miniblocks=4, total_count=2^40, first=0
+    hostile = bytes([0x80, 0x01, 0x04,
+                     0x80, 0x80, 0x80, 0x80, 0x80, 0x20,
+                     0x00])
+    with pytest.raises(CorruptPageError, match="total_count"):
+        decode_delta_binary_packed(hostile)

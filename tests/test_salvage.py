@@ -2,6 +2,8 @@
 for every lost row (ISSUE 1 tentpole part 3).  Strict mode stays the
 default and fails loudly on the same files."""
 
+import pathlib
+
 import numpy as np
 import pytest
 
@@ -75,7 +77,7 @@ def _flip_in_page(path, tmp_path, rg_idx, col, data_page_index, stem):
     with ParquetFileReader(path) as r:
         spans = _page_spans(r, rg_idx, col)
     off, size, _, ordinal = [s for s in spans if not s[2]][data_page_index]
-    data = bytearray(open(path, "rb").read())
+    data = bytearray(pathlib.Path(path).read_bytes())
     data[off + size // 2] ^= 0x10
     out = tmp_path / f"{stem}.parquet"
     out.write_bytes(bytes(data))
@@ -191,7 +193,7 @@ def test_salvage_without_crc_catches_framing_damage(salvage_file, tmp_path):
     # header of the second page starts where the first page's payload ends
     off0, size0, _, _ = spans[0]
     second_header = off0 + size0
-    data = bytearray(open(salvage_file, "rb").read())
+    data = bytearray(pathlib.Path(salvage_file).read_bytes())
     data[second_header] = 0xFF  # compact type 0x0F: unskippable garbage
     bad = tmp_path / "bad_framing.parquet"
     bad.write_bytes(bytes(data))

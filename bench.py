@@ -123,6 +123,142 @@ def batch_face_leg(path, reps: int, raw_engine_best: float) -> dict:
     }
 
 
+def _scan_paths(n_rows: int, n_files: int = 4):
+    """The scan leg's dataset: ≥4 lineitem files, ≥2 row groups each."""
+    from benchmarks.workloads import write_lineitem
+
+    per = max(n_rows // n_files, 500)
+    paths = []
+    for i in range(n_files):
+        p = os.path.join("/tmp", f"pftpu_bench_scan_{per}_{i}.parquet")
+        if not os.path.exists(p):
+            write_lineitem(p, per, row_group_rows=max(per // 2, 250), seed=i)
+        paths.append(p)
+    return paths
+
+
+def scan_leg(n_rows: int, reps: int) -> dict:
+    """Multi-file scan scheduler vs the sequential per-file loop
+    (docs/scan.md), 4-file dataset, device engine: the per-file
+    ``TpuRowGroupReader`` loop drains its stage‖ship‖decode pipeline at
+    every file boundary; ``scan_device_groups`` rides it across.
+    Reports ``scan_rows_per_sec``, the speedup, planner/executor trace
+    counters, and a bit-identical check of the decoded output.  Runs on
+    the already-initialized jax backend (after the headline legs, before
+    the D2H-heavy chunked leg)."""
+    import jax
+    import numpy as np
+
+    from parquet_floor_tpu.scan import ScanOptions, scan_device_groups
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+    from parquet_floor_tpu.utils import trace
+
+    paths = _scan_paths(n_rows)
+    threads = min(4, os.cpu_count() or 1)
+    sc = ScanOptions(threads=threads)
+
+    def sequential():
+        rows = 0
+        for p in paths:
+            with TpuRowGroupReader(p, float64_policy="bits") as tr:
+                for cols in tr.iter_row_groups():
+                    jax.block_until_ready([c.values for c in cols.values()])
+                    rows += int(next(iter(cols.values())).values.shape[0])
+        return rows
+
+    def scan():
+        rows = 0
+        for _fi, _gi, cols in scan_device_groups(
+            paths, scan=sc, float64_policy="bits"
+        ):
+            jax.block_until_ready([c.values for c in cols.values()])
+            rows += int(next(iter(cols.values())).values.shape[0])
+        return rows
+
+    def check(n):
+        # plain raise, not assert: the timed calls must survive python -O
+        if n != rows:
+            raise RuntimeError(f"scan leg row-count drift: {n} != {rows}")
+
+    rows = sequential()  # warm compiles + page cache
+    check(scan())
+    seq_dt = float("inf")
+    scan_dt = float("inf")
+    for _ in range(max(reps, 2)):
+        t0 = time.perf_counter()
+        check(sequential())
+        seq_dt = min(seq_dt, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        check(scan())
+        scan_dt = min(scan_dt, time.perf_counter() - t0)
+
+    # one counted pass for the planner/executor observability block
+    trace.enable()
+    trace.reset()
+    check(scan())
+    counters = trace.counters()
+    stats = trace.stats()
+    trace.disable()
+    trace.reset()
+
+    # bit-identical decoded output vs the per-file loop (one pass each;
+    # fetches device arrays — keep AFTER every timed section)
+    def fetch_all(groups_iter):
+        out = []
+        for cols in groups_iter:
+            out.append({
+                k: (np.asarray(v.values),
+                    None if v.mask is None else np.asarray(v.mask))
+                for k, v in cols.items()
+            })
+        return out
+
+    def seq_groups():
+        for p in paths:
+            with TpuRowGroupReader(p, float64_policy="bits") as tr:
+                yield from tr.iter_row_groups()
+
+    got = fetch_all(
+        cols for _fi, _gi, cols in scan_device_groups(
+            paths, scan=sc, float64_policy="bits"
+        )
+    )
+    want = fetch_all(seq_groups())
+    bit_exact = len(got) == len(want)
+    for a, b in zip(got, want):
+        for name in b:
+            va, ma = a[name]
+            vb, mb = b[name]
+            if not np.array_equal(va, vb):
+                bit_exact = False
+            if (ma is None) != (mb is None) or (
+                ma is not None and not np.array_equal(ma, mb)
+            ):
+                bit_exact = False
+
+    return {
+        "scan_rows_per_sec": round(rows / scan_dt, 1),
+        "scan_seq_rows_per_sec": round(rows / seq_dt, 1),
+        "scan_vs_sequential_x": round(seq_dt / scan_dt, 3),
+        "scan_bit_exact": bool(bit_exact),
+        "scan_files": len(paths),
+        "scan_threads": threads,
+        "scan_extents_planned": counters.get("scan.extents_planned", 0),
+        "scan_ranges_planned": counters.get("scan.ranges_planned", 0),
+        "scan_overread_bytes": counters.get("scan.overread_bytes", 0),
+        "scan_bytes_read": counters.get("scan.bytes_read", 0),
+        "scan_queue_depth_max": counters.get("scan.queue_depth_max", 0),
+        "scan_inflight_bytes_max": counters.get("scan.inflight_bytes_max", 0),
+        "scan_prefetch_budget": sc.prefetch_bytes,
+        # time the consumer spent waiting on the engine pipeline
+        # (budget admission never blocks — the bound works by refusal —
+        # so consumer stall is the scan's one wait metric)
+        "scan_consumer_stall_ms": round(
+            stats.get("scan.consumer_stall", {}).get("seconds", 0.0) * 1e3, 1
+        ),
+    }
+
+
 def chunked_columns(path) -> list:
     """The chunked leg's column subset: 4 fields (mixed types) keeps
     the forced-chunking proof while compiling 4x fewer fresh shapes
@@ -297,6 +433,10 @@ def main():
     # bit-exact check then fetches arrays — after every timed section,
     # because the first D2H degrades a tunnelled link process-wide
     batch = batch_face_leg(path, reps, best)
+    # multi-file scan scheduler leg (docs/scan.md): timed sections first,
+    # its own bit-exact D2H check last — so it sits after every other
+    # timed leg and before the (already post-D2H) chunked leg
+    scan_detail = scan_leg(n_rows, reps)
     chunk_cols_subset = chunked_columns(path)
     single_cols = reader.read_row_group(0, columns=chunk_cols_subset)
     reader.close()
@@ -333,6 +473,7 @@ def main():
             **latency,
             **batch,
             **chunked,
+            **scan_detail,
         },
     }
     print(json.dumps(result))

@@ -61,10 +61,12 @@ __all__ = [
     "ParquetFileReader",
     "ParquetFileWriter", "ParquetMetadata", "ParquetReader", "ParquetWriter",
     "Predicate", "PrimitiveType", "ReaderOptions", "SalvageReport",
-    "SalvageSkip", "TpuRowGroupReader", "TruncatedFileError", "Type",
+    "SalvageSkip", "ScanOptions", "DatasetScanner",
+    "TpuRowGroupReader", "TruncatedFileError", "Type",
     "UnsupportedCodec", "UnsupportedFeatureError",
     "assemble_nested", "batch_to_arrow", "col",
-    "read_sharded_global", "register_codec", "shred_nested", "testing",
+    "read_sharded_global", "register_codec", "scan", "scan_batches",
+    "shred_nested", "testing",
     "trace", "types", "ValueWriter", "WriterOptions",
 ]
 
@@ -78,6 +80,12 @@ _LAZY = {
         "parquet_floor_tpu.parallel.multihost", "read_sharded_global",
     ),
     "testing": ("parquet_floor_tpu.testing", None),
+    # the scan scheduler (docs/scan.md) — lazy like the engine, so plain
+    # format/API imports stay light
+    "scan": ("parquet_floor_tpu.scan", None),
+    "ScanOptions": ("parquet_floor_tpu.scan", "ScanOptions"),
+    "DatasetScanner": ("parquet_floor_tpu.scan", "DatasetScanner"),
+    "scan_batches": ("parquet_floor_tpu.scan", "scan_batches"),
 }
 
 

@@ -6,6 +6,12 @@ This rule makes the whole shape unrepresentable: every acquisition of
 ``open()`` / ``FileSource()`` / ``FileSink()`` / ``mmap.mmap()`` must be
 managed on **all exception paths**.
 
+The scan scheduler added two THREAD-backed resource shapes with the same
+hazard (a raise between construction and release leaks worker threads,
+not just an fd): ``ThreadPoolExecutor(...)`` and scan handles
+(``DatasetScanner(...)``).  Both are acquisitions here; ``shutdown()``
+counts as their release verb alongside ``close()``.
+
 **FL-RES001** fires unless the acquisition is one of:
 
 * a ``with`` item (directly or wrapped, e.g. ``closing(open(p))``);
@@ -14,9 +20,10 @@ managed on **all exception paths**.
 * returned / yielded, directly or via a local that is later returned;
 * stored on ``self`` in a class that defines ``close``/``__exit__``
   (the owning-wrapper pattern: ``FileSource`` itself);
-* bound to a local whose ``.close()`` is reachable on error — i.e. a
-  ``try`` in the same function closes it in a ``finally`` or an
-  ``except`` handler (the constructor-guard shape PR 1 landed).
+* bound to a local whose ``.close()``/``.shutdown()`` is reachable on
+  error — i.e. a ``try`` in the same function releases it in a
+  ``finally`` or an ``except`` handler (the constructor-guard shape
+  PR 1 landed).
 
 Linear ``f = open(p); use(f); f.close()`` is deliberately flagged: any
 exception in ``use`` leaks ``f`` — exactly the bug class this rule
@@ -39,11 +46,16 @@ from .core import (
 
 RULES = [
     ("FL-RES001",
-     "open()/FileSource()/FileSink()/mmap.mmap() must be context-managed, "
-     "transferred, or closed on all exception paths"),
+     "open()/FileSource()/FileSink()/mmap.mmap()/ThreadPoolExecutor()/"
+     "scan handles must be context-managed, transferred, or "
+     "closed/shut down on all exception paths"),
 ]
 
-_ACQUIRERS = {"FileSource", "FileSink"}
+_ACQUIRERS = {"FileSource", "FileSink", "ThreadPoolExecutor", "DatasetScanner"}
+
+# the verbs that count as releasing an acquisition (executors release
+# with shutdown(), everything else with close())
+_RELEASERS = ("close", "shutdown")
 
 
 def _is_acquisition(node: ast.Call) -> bool:
@@ -92,8 +104,9 @@ def _local_is_managed(ctx: FileContext, site: ast.AST, name: str) -> bool:
             if any(isinstance(t, ast.Attribute) for t in node.targets) and \
                     _class_manages(ctx, node):
                 return True
-        # closed on an exception path: name.close() inside a finally
-        # block or an except handler of some try in this function
+        # released on an exception path: name.close()/name.shutdown()
+        # inside a finally block or an except handler of some try in
+        # this function
         if isinstance(node, ast.Try):
             regions = list(node.finalbody)
             for h in node.handlers:
@@ -102,7 +115,7 @@ def _local_is_managed(ctx: FileContext, site: ast.AST, name: str) -> bool:
                 for c in ast.walk(stmt):
                     if isinstance(c, ast.Call) and \
                             isinstance(c.func, ast.Attribute) and \
-                            c.func.attr == "close" and \
+                            c.func.attr in _RELEASERS and \
                             isinstance(c.func.value, ast.Name) and \
                             c.func.value.id == name:
                         return True
@@ -134,8 +147,8 @@ def _classify(ctx: FileContext, call: ast.Call):
                     if _local_is_managed(ctx, anc, t.id):
                         return None
                     return (f"bound to `{t.id}` but no exception path "
-                            "closes it — use `with`, or close it in a "
-                            "finally/except guard")
+                            "releases it — use `with`, or close()/"
+                            "shutdown() it in a finally/except guard")
             return None
         if isinstance(anc, ast.Expr):
             return "result discarded — the handle leaks immediately"

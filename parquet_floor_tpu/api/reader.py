@@ -116,6 +116,92 @@ def _was_quarantined(reader: ParquetFileReader, desc: ColumnDescriptor,
     )
 
 
+def _device_batch_columns(device_cols):
+    """``DeviceColumn`` → ``BatchColumn`` conversion shared by the
+    sequential and scan-scheduled device batch faces (one definition of
+    the ``f64_bits`` rule: DOUBLE decoded under the engine's 'bits'
+    policy rides as exact int64 bit patterns)."""
+    from ..batch.columns import BatchColumn
+    from ..format.parquet_thrift import Type as _T
+
+    return [
+        BatchColumn(
+            dc.descriptor, dc.values, dc.mask, dc.lengths,
+            dc.def_levels, dc.rep_levels,
+            f64_bits=dc.descriptor.physical_type == _T.DOUBLE,
+        )
+        for dc in device_cols
+    ]
+
+
+def _host_batch_columns(selected, batch, gi: int, quarantined=None):
+    """Ordered ``BatchColumn`` list for one host-decoded row group — THE
+    definition of the batch face's positional contract, shared by the
+    sequential and scan-scheduled streams (so they cannot drift).
+
+    ``quarantined(desc) -> bool`` supplies the salvage placeholder rule
+    (sequential path only; the scan path rejects salvage and passes
+    None): a recorded quarantine keeps column ORDER intact via a
+    ``values=None`` placeholder that fails loudly on data access, while
+    an unrecorded missing column is corrupt-footer loss and raises."""
+    from ..batch.columns import BatchColumn
+
+    by_path = {b.descriptor.path: b for b in batch.columns}
+    cols = []
+    for desc in selected:
+        cb = by_path.get(desc.path)
+        if cb is None:
+            if quarantined is not None and quarantined(desc):
+                cols.append(BatchColumn(desc, None, quarantined=True))
+                continue
+            raise ValueError(f"row group {gi} missing column {desc.path}")
+        if cb.rep_levels is not None:
+            cols.append(BatchColumn(
+                desc, cb.values,
+                lengths=(
+                    cb.values.lengths()
+                    if hasattr(cb.values, "lengths")
+                    else None
+                ),
+                def_levels=cb.def_levels,
+                rep_levels=cb.rep_levels,
+            ))
+            continue
+        dense, mask = cb.dense()
+        lens = dense.lengths() if hasattr(dense, "lengths") else None
+        cols.append(BatchColumn(desc, dense, mask, lens))
+    return cols
+
+
+def _ordered_cursors(selected, batch, quarantined=None):
+    """Ordered cell cursors for one host-decoded row group — the ROW
+    face's positional contract, shared by the sequential and
+    scan-scheduled streams (the batch-face twin is
+    :func:`_host_batch_columns`).
+
+    ``quarantined(desc) -> bool`` supplies the salvage placeholder rule
+    (sequential path only): a recorded quarantine serves ``_NullCursor``
+    cells; an unrecorded missing column raises.  The flat-only guard is
+    reference parity (IllegalStateException "Unexpected repetition",
+    ``ParquetReader.java:200-202``)."""
+    by_name = {b.descriptor.path: b for b in batch.columns}
+    ordered = []
+    for desc in selected:
+        b = by_name.get(desc.path)
+        if b is None:
+            if quarantined is not None and quarantined(desc):
+                ordered.append(_NullCursor(desc))
+                continue
+            raise ValueError(f"row group missing column {desc.path}")
+        if b.rep_levels is not None and np.any(b.rep_levels != 0):
+            raise RuntimeError(
+                "Failed to read parquet",
+                ValueError("Unexpected repetition"),
+            )
+        ordered.append(_ColumnCursor(b))
+    return ordered
+
+
 class _ColumnCursor:
     """Per-column cursor over a decoded batch, serving API-typed cells."""
 
@@ -578,29 +664,10 @@ class ParquetReader:
             gi = self._rg_index
             batch = self._reader.read_row_group(gi, self._filter)
             self._rg_index += 1
-            ordered = []
-            by_name = {b.descriptor.path: b for b in batch.columns}
-            for desc in self.columns:
-                b = by_name.get(desc.path)
-                if b is None:
-                    if _was_quarantined(self._reader, desc, gi):
-                        # salvage quarantined this chunk (recorded in
-                        # the report): serve None cells for the group
-                        ordered.append(_NullCursor(desc))
-                        continue
-                    raise ValueError(f"row group missing column {desc.path}")
-                ordered.append(_ColumnCursor(b))
-            for c in ordered:
-                if isinstance(c, _NullCursor):
-                    continue
-                # Flat-only guard, parity with IllegalStateException
-                # ("Unexpected repetition", ParquetReader.java:200-202).
-                if c.batch.rep_levels is not None and np.any(c.batch.rep_levels != 0):
-                    raise RuntimeError(
-                        "Failed to read parquet",
-                        ValueError("Unexpected repetition"),
-                    )
-            self._cursors = ordered
+            self._cursors = _ordered_cursors(
+                self.columns, batch,
+                quarantined=lambda d: _was_quarantined(self._reader, d, gi),
+            )
             self._rg_rows = batch.num_rows
             self._row = 0
             if self._rg_rows > 0:
@@ -730,7 +797,8 @@ class ParquetReader:
     def stream_batches(source, batch_hydrator=None,
                        columns: Optional[Sequence[str]] = None,
                        engine: str = "host", predicate=None,
-                       options: Optional[ReaderOptions] = None):
+                       options: Optional[ReaderOptions] = None,
+                       scan_options=None):
         """The BATCH face of the Hydrator boundary: one plugin call per
         ROW GROUP, columns as arrays in column order (the
         ``HydratorSupplier.java:10-15`` ordering contract lifted to
@@ -770,9 +838,30 @@ class ParquetReader:
         ``ParquetReader.spliterator(...)`` (its ``salvage_report``
         property survives close) or drive ``ParquetFileReader``
         directly.
+
+        ``scan_options`` (a :class:`~parquet_floor_tpu.scan.ScanOptions`)
+        routes the stream through the scan scheduler (``docs/scan.md``):
+        coalesced vectored reads and bounded cross-file prefetch, with
+        work running ahead of the consumer.  ``engine="host"`` (and
+        ``"auto"``, which the scheduler pins to host) decodes through
+        ``scan.DatasetScanner``; ``engine="tpu"`` through
+        ``scan.scan_device_groups`` — where the engine's
+        stage‖ship‖decode pipeline crosses file boundaries instead of
+        draining at each file's end.  Salvage is rejected under scan
+        (same ``UnsupportedFeatureError`` contract as the TPU engine).
         """
         if engine not in ("host", "tpu", "auto"):
             raise ValueError(f"bad engine {engine!r}: expected host|tpu|auto")
+        if scan_options is not None:
+            sources = (
+                list(source) if isinstance(source, (list, tuple)) else [source]
+            )
+            if not sources:
+                raise ValueError("dataset stream needs at least one source")
+            return ParquetReader._stream_batches_scan(
+                sources, batch_hydrator, columns, engine, predicate,
+                options, scan_options,
+            )
         if isinstance(source, (list, tuple)):
             if not source:
                 raise ValueError("dataset stream needs at least one source")
@@ -796,8 +885,6 @@ class ParquetReader:
                             options: Optional[ReaderOptions] = None):
         """One file's batch stream; ``state`` carries the dataset-wide
         hydrator and schema key across files."""
-        from ..batch.columns import BatchColumn
-        from ..format.parquet_thrift import Type as _T
         from .hydrate import batch_supplier_of
 
         def gen():
@@ -839,62 +926,91 @@ class ParquetReader:
                         columns=names, indices=indices
                     )
                     for gi, group in zip(indices, groups):
-                        cols = []
-                        for desc in selected:
-                            dc = group[".".join(desc.path)]
-                            cols.append(BatchColumn(
-                                desc, dc.values, dc.mask, dc.lengths,
-                                dc.def_levels, dc.rep_levels,
-                                f64_bits=desc.physical_type == _T.DOUBLE,
-                            ))
+                        cols = _device_batch_columns(
+                            group[".".join(desc.path)] for desc in selected
+                        )
                         yield hyd.batch(gi, cols)
                     return
                 for gi in range(len(reader.row_groups)):
                     if keep is not None and gi not in keep:
                         continue
                     batch = reader.read_row_group(gi, flt)
-                    by_path = {b.descriptor.path: b for b in batch.columns}
-                    cols = []
-                    for desc in selected:
-                        cb = by_path.get(desc.path)
-                        if cb is None:
-                            if _was_quarantined(reader, desc, gi):
-                                # salvage quarantined this chunk: a
-                                # quarantined placeholder keeps the
-                                # documented COLUMN ORDER intact, and
-                                # values=None makes positional consumers
-                                # fail loudly rather than silently read
-                                # a shifted column (the skip is in
-                                # reader.salvage_report)
-                                cols.append(BatchColumn(
-                                    desc, None, quarantined=True,
-                                ))
-                                continue
-                            raise ValueError(
-                                f"row group {gi} missing column {desc.path}"
-                            )
-                        if cb.rep_levels is not None:
-                            cols.append(BatchColumn(
-                                desc, cb.values,
-                                lengths=(
-                                    cb.values.lengths()
-                                    if hasattr(cb.values, "lengths")
-                                    else None
-                                ),
-                                def_levels=cb.def_levels,
-                                rep_levels=cb.rep_levels,
-                            ))
-                            continue
-                        dense, mask = cb.dense()
-                        lens = (
-                            dense.lengths()
-                            if hasattr(dense, "lengths")
-                            else None
-                        )
-                        cols.append(BatchColumn(desc, dense, mask, lens))
+                    cols = _host_batch_columns(
+                        selected, batch, gi,
+                        quarantined=lambda d, gi=gi: _was_quarantined(
+                            reader, d, gi
+                        ),
+                    )
                     yield hyd.batch(gi, cols)
             finally:
                 closer.close()
+
+        return gen()
+
+    @staticmethod
+    def _stream_batches_scan(sources, batch_hydrator, columns, engine,
+                             predicate, options, scan_options):
+        """Scan-scheduled dataset batches (docs/scan.md): host decode
+        through ``scan.DatasetScanner``, device decode through
+        ``scan.scan_device_groups`` — either way, reads and decode run
+        across files ahead of the consumer, bounded by the scan byte
+        budget.  The supplier is called once, with the first file's
+        selected columns, and ``group_index`` stays each file's real
+        group index (the sequential dataset contract)."""
+        from ..scan.executor import _reject_salvage
+        from .hydrate import batch_supplier_of
+
+        # fail at CALL time, not first iteration: a misconfigured scan
+        # should not hide until someone consumes the generator
+        _reject_salvage(options)
+
+        if engine == "tpu":
+            def dgen():
+                from ..scan import scan_device_groups
+
+                hyd = None
+                for _fi, gi, group in scan_device_groups(
+                    sources, columns=columns, options=options,
+                    scan=scan_options, predicate=predicate,
+                ):
+                    if hyd is None:
+                        # schema-ordered by scan_device_groups — the
+                        # same positional contract as the sequential face
+                        hyd = batch_supplier_of(batch_hydrator).get(
+                            [dc.descriptor for dc in group.values()]
+                        )
+                    yield hyd.batch(gi, _device_batch_columns(group.values()))
+
+            return dgen()
+
+        def gen():
+            from ..scan import DatasetScanner
+
+            if engine == "auto":
+                from ..utils import trace
+
+                trace.decision("engine.auto", {
+                    "engine": "host",
+                    "why": "the scan scheduler decodes dataset batches "
+                           "on host; pass engine='tpu' for device scan",
+                })
+            scanner = DatasetScanner(
+                sources, columns=columns, options=options,
+                scan=scan_options, predicate=predicate,
+            )
+            try:
+                hyd = None
+                for unit in scanner:
+                    if hyd is None:
+                        hyd = batch_supplier_of(batch_hydrator).get(
+                            scanner.columns
+                        )
+                    cols = _host_batch_columns(
+                        scanner.columns, unit.batch, unit.group_index
+                    )
+                    yield hyd.batch(unit.group_index, cols)
+            finally:
+                scanner.close()
 
         return gen()
 
@@ -903,7 +1019,8 @@ class ParquetReader:
     @staticmethod
     def stream_content(source, hydrator_supplier, columns: Optional[Sequence[str]] = None,
                        engine: str = "host", predicate=None,
-                       options: Optional[ReaderOptions] = None):
+                       options: Optional[ReaderOptions] = None,
+                       scan_options=None):
         """Stream hydrated records (``streamContent``, :47-61).
 
         Returns an iterator that owns the file and closes it on exhaustion
@@ -917,7 +1034,32 @@ class ParquetReader:
         ``source`` may be a LIST/TUPLE of sources (a dataset): rows
         stream file after file in order, with one file open at a time;
         every file must carry the same schema as the first.
+
+        ``scan_options`` (a :class:`~parquet_floor_tpu.scan.ScanOptions`)
+        streams the same rows through the scan scheduler instead
+        (``docs/scan.md``): coalesced vectored reads, and row groups
+        decoded across files ahead of the consumer under a byte budget.
+        Rows under scan decode on the host engine — ``engine="tpu"``
+        raises (use ``stream_batches(engine="tpu", scan_options=...)``
+        for device scan); salvage is rejected by the scheduler.
         """
+        if scan_options is not None:
+            if engine == "tpu":
+                raise ValueError(
+                    "scan-scheduled row streams decode on the host "
+                    'engine; use engine="host"/"auto", or '
+                    'stream_batches(engine="tpu", scan_options=...) for '
+                    "device scan"
+                )
+            sources = (
+                list(source) if isinstance(source, (list, tuple)) else [source]
+            )
+            if not sources:
+                raise ValueError("dataset stream needs at least one source")
+            return _ScanRowIterator(
+                sources, hydrator_supplier, columns, predicate, options,
+                scan_options,
+            )
         if isinstance(source, (list, tuple)):
             return _DatasetIterator(
                 list(source), hydrator_supplier, columns, engine, predicate,
@@ -1066,6 +1208,105 @@ class _DatasetIterator:
         if self._last_columns is not None:
             return self._last_columns
         raise ValueError("dataset stream is closed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _ScanRowIterator:
+    """Row stream over a scan-scheduled dataset (``docs/scan.md``): the
+    same rows, order, null semantics, and error wrapping as
+    ``_DatasetIterator``, but row groups are read (coalesced, vectored)
+    and decoded across files ahead of the consumer by
+    ``scan.DatasetScanner``.  Salvage is rejected by the scanner, so
+    ``salvage_report`` is always None here."""
+
+    salvage_report = None
+
+    def __init__(self, sources, hydrator_supplier, columns, predicate,
+                 options, scan):
+        from ..scan import DatasetScanner
+
+        self._scanner = DatasetScanner(
+            sources, columns=columns, options=options, scan=scan,
+            predicate=predicate,
+        )
+        self._supplier = hydrator_supplier
+        self.hydrator: Optional[Hydrator] = None
+        self._hyd_fi = -1  # file the current hydrator was built for
+        self._cursors: Optional[List[_ColumnCursor]] = None
+        self._rows = 0
+        self._row = 0
+        self._closed = False
+
+    @property
+    def columns(self):
+        """Selected descriptors of the first file (opened on demand —
+        the sequential dataset iterator's surface)."""
+        return self._scanner.columns
+
+    @property
+    def metadata(self) -> ParquetMetadata:
+        """Footer of the most recently streamed file (the first file
+        before any row) — parity with ``_DatasetIterator.metadata``."""
+        return self._scanner.metadata
+
+    def __iter__(self):
+        return self
+
+    def _advance(self) -> None:
+        unit = next(self._scanner)  # StopIteration ends the stream
+        if self._hyd_fi != unit.file_index:
+            # one supplier call PER FILE — the sequential dataset stream
+            # builds a fresh hydrator per file (stateful suppliers
+            # observe the call count), and the scan stream must match
+            self.hydrator = supplier_of(self._supplier).get(
+                self._scanner.columns
+            )
+            self._hyd_fi = unit.file_index
+        self._cursors = _ordered_cursors(self._scanner.columns, unit.batch)
+        self._rows = unit.batch.num_rows
+        self._row = 0
+
+    def __next__(self):
+        try:
+            if self._closed:
+                raise StopIteration
+            while self._cursors is None or self._row >= self._rows:
+                self._advance()  # loops past zero-row groups
+            h = self.hydrator
+            record = h.start()
+            i = self._row
+            for cursor in self._cursors:
+                record = h.add(record, cursor.desc.path[0], cursor.cell(i))
+            self._row += 1
+            return h.finish(record)
+        except StopIteration:
+            self.close()
+            raise
+        except Exception as e:  # floorlint: disable=FL-EXC001
+            # Parity: every iteration failure wraps as RuntimeError (the
+            # single-file iterator's pinned contract) — EXCEPT
+            # file-boundary errors (schema mismatch, a later file's
+            # corrupt footer or missing path), which the sequential
+            # stream raises BARE from its per-file open; the scanner
+            # tags those (pftpu_scan_planning).  Close FIRST so the
+            # scan worker pool never outlives the error.
+            from ..scan.executor import DatasetSchemaError
+
+            self.close()
+            if isinstance(e, DatasetSchemaError) or \
+                    getattr(e, "pftpu_scan_planning", False):
+                raise
+            raise RuntimeError("Failed to read parquet") from e
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._scanner.close()
 
     def __enter__(self):
         return self

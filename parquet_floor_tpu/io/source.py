@@ -7,6 +7,20 @@ returns -1 (``FSDataInputStream.java:21-29``; SURVEY.md §5 says do NOT copy
 that) — errors here propagate loudly.
 
 ``FileSource`` memory-maps when possible so column chunks slice zero-copy.
+
+Concurrency contract (the scan executor reads from worker threads):
+
+* ``read_at``/``read_many`` are **thread-safe** on every source in this
+  module.  The mmap path slices an immutable view; the file path uses
+  positional ``os.pread`` (kernel-level offset, no shared seek cursor);
+  only the rare non-``fileno`` stream fallback serializes behind a lock.
+* ``close()`` is NOT safe to race with in-flight reads — owners must
+  quiesce readers first (the scan executor drains its pool before the
+  per-file source closes).  Views returned by the mmap path stay valid
+  after ``close()`` only until the last view dies (see ``close``).
+* ``RetryingSource`` keeps per-*call* retry budgets: concurrent reads
+  never share or double-count attempts, and the ``retried_reads``
+  observability counter is lock-protected.
 """
 
 from __future__ import annotations
@@ -32,6 +46,7 @@ class FileSource:
         self._own = False
         self._mm: Optional[mmap.mmap] = None
         self._fh: Optional[BinaryIO] = None
+        self._fd: Optional[int] = None  # positional-read descriptor
         self._lock = threading.Lock()
         if isinstance(source, (bytes, bytearray, memoryview)):
             self._buf = memoryview(source)
@@ -51,7 +66,19 @@ class FileSource:
             self._mm = mmap.mmap(self._fh.fileno(), 0, access=mmap.ACCESS_READ)
             self._buf = memoryview(self._mm)
         except (ValueError, OSError, io.UnsupportedOperation, AttributeError):
-            self._buf = None  # fall back to seek/read
+            self._buf = None  # fall back to positional read
+        if self._buf is None:
+            # no mmap (pipes? empty files? exotic streams): prefer
+            # os.pread on a real descriptor — positional reads share no
+            # seek cursor, so executor threads never serialize (or race)
+            # on the file position.  Only descriptor-less streams keep
+            # the seek+read-under-lock fallback.
+            try:
+                fd = self._fh.fileno()
+                os.pread(fd, 0, 0)
+                self._fd = fd
+            except (OSError, io.UnsupportedOperation, AttributeError):
+                self._fd = None
 
     @property
     def size(self) -> int:
@@ -67,15 +94,50 @@ class FileSource:
             )
         if self._buf is not None:
             return self._buf[offset : offset + length]
-        with self._lock:
-            self._fh.seek(offset)
-            data = self._fh.read(length)
+        if self._fd is not None:
+            # pread never touches the shared seek cursor; loop on short
+            # reads (pread may return less than asked near page faults
+            # on network filesystems)
+            parts = []
+            got = 0
+            while got < length:
+                chunk = os.pread(self._fd, length - got, offset + got)
+                if not chunk:
+                    break
+                parts.append(chunk)
+                got += len(chunk)
+            data = parts[0] if len(parts) == 1 else b"".join(parts)
+        else:
+            with self._lock:
+                self._fh.seek(offset)
+                data = self._fh.read(length)
         if len(data) != length:
             raise TruncatedFileError(
                 f"short read: wanted {length}, got {len(data)}",
                 path=self.name, offset=offset,
             )
         return memoryview(data)
+
+    def read_many(self, ranges) -> list:
+        """Vectored positional read: one ``memoryview`` per ``(offset,
+        length)`` in ``ranges``, in the given order (thread-safe, same
+        exactness guarantee as :meth:`read_at`).
+
+        The scan planner hands this COALESCED extents in ascending file
+        order, so the descriptor path degrades to a near-sequential pread
+        train and the mmap path to a handful of zero-copy slices.  Ranges
+        are validated before the first byte is read: a request outside the
+        file raises without issuing any partial I/O.
+        """
+        ranges = list(ranges)  # accept one-shot iterables: two passes below
+        for offset, length in ranges:
+            if offset < 0 or offset + length > self._size:
+                raise TruncatedFileError(
+                    f"vectored read [{offset}, {offset + length}) outside "
+                    f"file of {self._size} bytes",
+                    path=self.name, offset=offset,
+                )
+        return [self.read_at(o, n) for o, n in ranges]
 
     def close(self) -> None:
         if self._mm is not None:
@@ -109,6 +171,10 @@ class FileSource:
         if self._own and self._fh is not None:
             self._fh.close()
             self._fh = None
+            # the descriptor number is recycled by the OS the moment the
+            # fh closes: a pread on it would silently read a DIFFERENT
+            # file — fail loudly like the seek path always did
+            self._fd = None
 
     def __enter__(self):
         return self
@@ -151,6 +217,7 @@ class RetryingSource:
         self._sleep = sleep
         self._jitter = float(jitter)
         self._rng = rng
+        self._stat_lock = threading.Lock()
         self.retried_reads = 0  # observability: how often retry saved a read
 
     @property
@@ -162,16 +229,42 @@ class RetryingSource:
         return self._inner.size
 
     def read_at(self, offset: int, length: int) -> memoryview:
+        return self._with_retry(
+            lambda: self._inner.read_at(offset, length), offset, length
+        )
+
+    def read_many(self, ranges) -> list:
+        """Vectored read with the same bounded-retry semantics, applied
+        per range: each range gets its own full attempt budget (a flaky
+        mount failing range 3 never eats range 7's retries), and ranges
+        already read are not re-read when a later one retries."""
+        ranges = list(ranges)
+        inner_many = getattr(self._inner, "read_many", None)
+        if inner_many is None:
+            return [self.read_at(o, n) for o, n in ranges]
+        out: list = []
+        for o, n in ranges:
+            out.append(self._with_retry(
+                lambda o=o, n=n: inner_many([(o, n)])[0], o, n
+            ))
+        return out
+
+    def _with_retry(self, read_fn, offset: int, length: int) -> memoryview:
+        """One read through the bounded retry loop.  The attempt budget is
+        strictly per call — concurrent reads from executor threads never
+        share or double-count it (see the module concurrency contract)."""
         last: Optional[OSError] = None
         for attempt in range(self._retries + 1):
             try:
-                data = self._inner.read_at(offset, length)
+                data = read_fn()
                 if attempt:
-                    self.retried_reads += 1
+                    with self._stat_lock:
+                        self.retried_reads += 1
+                        saved = self.retried_reads
                     trace.decision("io.retry", {
                         "path": self.name, "offset": offset,
                         "attempts": attempt + 1,
-                        "retried_reads": self.retried_reads,
+                        "retried_reads": saved,
                     })
                 return data
             except (EOFError, TruncatedFileError):

@@ -175,7 +175,7 @@ def read_dataset_sharded(
     import os
     from contextlib import ExitStack
 
-    from ..tpu.engine import TpuRowGroupReader
+    from ..tpu.engine import TpuRowGroupReader, iter_dataset_row_groups
 
     if isinstance(sources, (str, bytes, os.PathLike)):
         raise TypeError(
@@ -242,10 +242,18 @@ def read_dataset_sharded(
             and (keep is None or len(keep) == n_groups)
         )
 
-        decoded: Dict[int, Dict[str, object]] = {
-            g: readers[pairs[g][0]].read_row_group(pairs[g][1], columns)
-            for g in mine
+        # the scan scheduler's device leg (docs/scan.md): this host's
+        # block of groups decodes through the cross-file stage‖ship‖decode
+        # pipeline, so it never drains at a file boundary — group 0 of
+        # file k+1 stages while the last group of file k decodes
+        order = [
+            g for g in mine
             if g < n_groups and (keep is None or g in keep)
+        ]
+        tasks = [(readers[pairs[g][0]], pairs[g][1]) for g in order]
+        decoded: Dict[int, Dict[str, object]] = {
+            g: cols
+            for g, cols in zip(order, iter_dataset_row_groups(tasks, columns))
         }
         # column names must agree across hosts even when a host owns only
         # ghost groups: derive them from the schema, mirroring the engine's

@@ -1,0 +1,50 @@
+"""Dataset scan scheduler: coalesced vectored I/O + bounded cross-file
+prefetch.
+
+Two layers (see ``docs/scan.md``):
+
+* :mod:`~parquet_floor_tpu.scan.plan` — the pure I/O planner: per file,
+  each row group's column-chunk byte ranges (plus footer-adjacent page
+  indexes) merge into coalesced read extents under gap/size thresholds.
+* :mod:`~parquet_floor_tpu.scan.executor` — the scheduler: a small
+  thread pool reads planned extents (``Source.read_many``) and
+  host-stages row groups *across files* ahead of the consumer, bounded
+  by an explicit in-flight byte budget.
+
+Front doors: :class:`DatasetScanner` / :func:`scan_batches` (host
+decode), :func:`scan_device_groups` (feeds ``TpuRowGroupReader`` across
+file boundaries), and the ``scan_options=`` parameter of
+``ParquetReader.stream_content`` / ``stream_batches``.
+"""
+
+from .executor import (
+    DatasetScanner,
+    DatasetSchemaError,
+    PrefetchedSource,
+    ScanUnit,
+    scan_batches,
+    scan_device_groups,
+)
+from .plan import (
+    Extent,
+    FilePlan,
+    GroupPlan,
+    ScanOptions,
+    coalesce,
+    plan_file,
+)
+
+__all__ = [
+    "DatasetScanner",
+    "DatasetSchemaError",
+    "Extent",
+    "FilePlan",
+    "GroupPlan",
+    "PrefetchedSource",
+    "ScanOptions",
+    "ScanUnit",
+    "coalesce",
+    "plan_file",
+    "scan_batches",
+    "scan_device_groups",
+]

@@ -1,0 +1,686 @@
+"""Scan executor — bounded cross-file prefetch over planned extents.
+
+The sequential dataset iterators open one file, decode its groups one by
+one, then open the next: every file boundary drains the pipeline, and
+every column chunk costs one positional read.  The executor here turns a
+list of sources into ONE scheduled stream of decoded row groups:
+
+* a small thread pool (``ScanOptions.threads``) reads each group's
+  coalesced extents (``Source.read_many``) and host-decodes the group;
+* work runs **across files** ahead of the consumer — while the consumer
+  iterates file k, workers are already reading and decoding file k+1;
+* in-flight memory is bounded by ``ScanOptions.prefetch_bytes``: each
+  group charges ``max(extent bytes, footer uncompressed estimate)``
+  against the budget from the moment its read is admitted until the
+  consumer takes the decoded batch.  Budget is admitted strictly in
+  scan order (no out-of-order unit can starve the head of the stream),
+  and one group bigger than the whole budget is admitted only when it
+  is alone in flight.
+
+Concurrency contract: ``DatasetScanner`` is a single-consumer iterator —
+``__next__``/``close`` must come from one thread; all internal I/O and
+decode parallelism stays inside the scanner.  ``close()`` (or abandoning
+via the ``with`` form / generator close in the stream faces) drains the
+pool and closes every file; it is idempotent.
+
+The same planner + budget also feed the device engine:
+:func:`scan_device_groups` prefetches extents under the budget while
+``tpu.engine.iter_dataset_row_groups`` runs its stage‖ship‖decode
+pipeline across file boundaries.
+
+Salvage mode is rejected with the same ``UnsupportedFeatureError``
+contract as ``TpuRowGroupReader``: quarantine bookkeeping is defined by
+sequential per-file reads, and a concurrent scan cannot honor it.
+``verify_crc`` and ``io_retries`` pass straight through (CRC checks ride
+the normal decode path; retries wrap the *real* I/O below the prefetch
+cache, so cache hits never consume retry budget).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, replace
+from typing import List, NamedTuple, Optional, Sequence, Set
+
+from ..errors import UnsupportedFeatureError
+from ..format.file_read import ParquetFileReader, ReaderOptions
+from ..io.source import FileSource, RetryingSource
+from ..utils import trace
+from .plan import Extent, FilePlan, GroupPlan, ScanOptions, plan_file
+
+
+class DatasetSchemaError(ValueError):
+    """A dataset file disagrees with the first file's schema.  Still a
+    ``ValueError`` — the sequential dataset stream's exact contract —
+    but typed, so the scan row face can re-raise it UNWRAPPED (the
+    sequential path raises it at the file boundary, outside the
+    per-row RuntimeError wrap)."""
+
+
+class PrefetchedSource:
+    """Positional source serving reads from prefetched extent buffers.
+
+    Sits between the real source (below: mmap / pread / retries) and the
+    reader (above: footer parse, page decode).  ``load()`` installs the
+    bytes of planned extents; ``read_at`` serves any sub-range of a
+    loaded extent zero-copy and falls back to the inner source on a miss
+    (counted as ``scan.cache_miss_bytes`` — a miss is a correctness
+    non-event, only a lost prefetch).  Thread-safe: loads, drops, and
+    reads may come from any executor thread.
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._lock = threading.Lock()
+        self._starts: List[int] = []          # sorted extent starts
+        self._entries: List[tuple] = []       # (start, end, buffer)
+
+    @property
+    def name(self) -> str:
+        return getattr(self._inner, "name", "<source>")
+
+    @property
+    def size(self) -> int:
+        return self._inner.size
+
+    def load(self, extents: Sequence[Extent]) -> int:
+        """Read ``extents`` through the inner source (vectored when it
+        supports ``read_many``) and install them; returns bytes loaded.
+        Already-loaded extents are not re-read."""
+        with self._lock:
+            want = [
+                e for e in extents
+                if self._locate(e.offset, e.length) is None
+            ]
+        if not want:
+            return 0
+        read_many = getattr(self._inner, "read_many", None)
+        ranges = [(e.offset, e.length) for e in want]
+        if read_many is not None:
+            bufs = read_many(ranges)
+        else:
+            bufs = [self._inner.read_at(o, n) for o, n in ranges]
+        with self._lock:
+            for e, buf in zip(want, bufs):
+                i = bisect.bisect_left(self._starts, e.offset)
+                self._starts.insert(i, e.offset)
+                self._entries.insert(i, (e.offset, e.offset + e.length, buf))
+        return sum(e.length for e in want)
+
+    def drop(self, extents: Sequence[Extent]) -> None:
+        """Forget the given extents (frees their buffers once no decoded
+        view aliases them)."""
+        with self._lock:
+            for e in extents:
+                i = bisect.bisect_left(self._starts, e.offset)
+                while i < len(self._starts) and self._starts[i] == e.offset:
+                    if self._entries[i][1] == e.offset + e.length:
+                        del self._starts[i]
+                        del self._entries[i]
+                        break
+                    i += 1
+
+    def _locate(self, offset: int, length: int):
+        """The cached entry covering ``[offset, offset+length)``, or None.
+        Caller holds the lock."""
+        i = bisect.bisect_right(self._starts, offset) - 1
+        if i >= 0:
+            start, end, buf = self._entries[i]
+            if offset + length <= end:
+                return start, buf
+        return None
+
+    def read_at(self, offset: int, length: int):
+        with self._lock:
+            hit = self._locate(offset, length)
+        if hit is not None:
+            start, buf = hit
+            return memoryview(buf)[offset - start : offset - start + length]
+        trace.count("scan.cache_miss_bytes", length)
+        return self._inner.read_at(offset, length)
+
+    def read_many(self, ranges) -> list:
+        return [self.read_at(o, n) for o, n in ranges]
+
+    def close(self) -> None:
+        with self._lock:
+            self._starts.clear()
+            self._entries.clear()
+        self._inner.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _ByteBudget:
+    """The in-flight byte ceiling.  Admission happens only from the
+    consumer thread, strictly in scan order, and is enforced by REFUSAL,
+    never by waiting: ``try_acquire`` declines a unit that does not fit
+    (the consumer simply retries it after delivering something), and
+    ``admit`` force-admits when nothing is in flight — which is how one
+    group bigger than the whole budget runs alone.  In-order admission
+    is also the no-starvation argument: no later group can hold budget
+    the head of the stream is waiting for."""
+
+    def __init__(self, cap: int):
+        self._cap = int(cap)
+        self._used = 0
+        self._lock = threading.Lock()
+        self.high_water = 0
+
+    def _admit_locked(self, n: int) -> None:
+        self._used += n
+        if self._used > self.high_water:
+            self.high_water = self._used
+            trace.gauge_max("scan.inflight_bytes_max", self._used)
+
+    def try_acquire(self, n: int) -> bool:
+        with self._lock:
+            if self._used and self._used + n > self._cap:
+                return False
+            self._admit_locked(n)
+            return True
+
+    def admit(self, n: int) -> None:
+        """Unconditional admission — callers use this only when nothing
+        is in flight (``_used == 0``), so the bound stays exact for every
+        unit except a single oversized one running alone."""
+        with self._lock:
+            self._admit_locked(n)
+
+    def release(self, n: int) -> None:
+        with self._lock:
+            self._used -= n
+
+
+class ScanUnit(NamedTuple):
+    """One delivered row group: the file's position in the dataset, the
+    group's REAL index within that file, and the decoded batch."""
+
+    file_index: int
+    group_index: int
+    batch: object  # RowGroupBatch
+
+
+@dataclass
+class _FileState:
+    reader: ParquetFileReader
+    cache: PrefetchedSource
+    plan: FilePlan
+    remaining: int  # groups not yet delivered; 0 → file closes
+
+
+class _Work(NamedTuple):
+    file_index: int
+    plan: GroupPlan
+    cost: int
+
+
+def _source_chain(source, options: Optional[ReaderOptions]) -> PrefetchedSource:
+    """FileSource → RetryingSource → PrefetchedSource.  Retries wrap the
+    REAL I/O, below the prefetch cache: a cache hit must never consume
+    retry budget, and the reader above gets ``io_retries=0`` so the
+    double-wrap guard keeps meaning one bounded retry loop per physical
+    read."""
+    src = source if hasattr(source, "read_at") else FileSource(source)
+    try:
+        if options is not None and options.io_retries > 0 and \
+                not isinstance(src, RetryingSource):
+            src = RetryingSource(
+                src, options.io_retries, options.io_retry_backoff_s
+            )
+        return PrefetchedSource(src)
+    except BaseException:
+        src.close()
+        raise
+
+
+def _reject_salvage(options: Optional[ReaderOptions]) -> None:
+    if options is not None and options.salvage:
+        raise UnsupportedFeatureError(
+            "ReaderOptions.salvage is a sequential host-engine feature; "
+            "the scan scheduler cannot honor its quarantine bookkeeping — "
+            "use the sequential dataset stream (no scan options) for "
+            "salvage reads"
+        )
+
+
+class DatasetScanner:
+    """Scheduled scan over a list of sources, yielding :class:`ScanUnit`
+    in (file order, row-group order) — decoded bytes are bit-identical
+    to the sequential per-file loop, delivery order included.
+
+    ``columns`` projects by top-level field name (the reference's
+    projection rule); ``predicate`` prunes row groups per file before
+    any of their bytes are read; ``options`` is the usual
+    :class:`ReaderOptions` (``salvage`` rejected, see module docstring).
+    An empty ``sources`` list yields nothing (an empty dataset directory
+    is a valid no-op scan).
+
+    Use as an iterator, ideally under ``with`` (or call :meth:`close`):
+    abandoning mid-scan drains the worker pool and closes every file.
+    """
+
+    def __init__(self, sources: Sequence, columns: Optional[Sequence[str]] = None,
+                 options: Optional[ReaderOptions] = None,
+                 scan: Optional[ScanOptions] = None,
+                 predicate=None):
+        _reject_salvage(options)
+        self._sources = list(sources)
+        self._filter: Optional[Set[str]] = set(columns) if columns else None
+        self._options = options
+        self._scan = scan or ScanOptions()
+        self._predicate = predicate
+        self._budget = _ByteBudget(self._scan.prefetch_bytes)
+        self._pool = ThreadPoolExecutor(
+            max_workers=self._scan.threads, thread_name_prefix="pftpu-scan"
+        )
+        self._files: dict = {}                 # file_index -> _FileState
+        self._pending: deque = deque()         # (work, future)
+        self._work_iter = self._gen_work()
+        self._lookahead: Optional[_Work] = None
+        self._schema_key = None
+        self._deferred: Optional[BaseException] = None
+        self._closed = False
+        self._columns = None  # selected descriptors (set at first file open)
+        self._meta_by_file: dict = {}  # footer metadata, kept past file close
+        self._delivered_fi = 0
+
+    @property
+    def columns(self):
+        """Selected descriptors of the first file.  Mirrors the
+        sequential dataset iterator: accessing it before iteration opens
+        the first file on demand (which also starts the prefetch), a
+        first-file open failure propagates, and a closed empty scan
+        raises rather than returning None.  An empty DATASET (no
+        sources) is the one None case — there is no schema to report."""
+        if self._columns is None and not self._closed:
+            self._top_up()
+        if self._columns is None:
+            if self._deferred is not None:
+                raise self._deferred  # the first file failed to open/plan
+            if self._closed:
+                raise ValueError("dataset scan is closed")
+        return self._columns
+
+    @property
+    def metadata(self):
+        """Footer of the most recently DELIVERED file (the first file
+        before any delivery) — the sequential dataset iterator's
+        surface.  Raises on a closed or empty scan."""
+        if not self._meta_by_file and not self._closed:
+            self._top_up()
+        meta = self._meta_by_file.get(self._delivered_fi)
+        if meta is None:
+            if self._deferred is not None:
+                raise self._deferred  # the first file failed to open/plan
+            raise ValueError("dataset scan is closed (or empty)")
+        return meta
+
+    # -- file planning (consumer thread) -----------------------------------
+
+    def _open_file(self, fi: int) -> _FileState:
+        opts = self._options
+        cache = _source_chain(self._sources[fi], opts)
+        reader_opts = replace(opts, io_retries=0) if opts is not None else None
+        try:
+            reader = ParquetFileReader(cache, options=reader_opts)
+        except BaseException:
+            cache.close()
+            raise
+        try:
+            from ..format.schema import dataset_schema_key
+
+            key = dataset_schema_key(reader.schema.columns)
+            if self._schema_key is None:
+                self._schema_key = key
+                self._columns = [
+                    c for c in reader.schema.columns
+                    if self._filter is None or c.path[0] in self._filter
+                ]
+            elif key != self._schema_key:
+                raise DatasetSchemaError(
+                    f"dataset file {fi} disagrees with the first file's "
+                    "schema"
+                )
+            keep = (
+                set(self._predicate.row_groups(reader))
+                if self._predicate is not None
+                else None
+            )
+            plan = plan_file(reader, self._filter, keep, self._scan)
+            # page-index extents: tiny, footer-adjacent, shared by every
+            # group (page_cover/predicates) — prefetch once per file
+            if plan.index_extents:
+                cache.load(plan.index_extents)
+        except BaseException:
+            reader.close()
+            raise
+        self._meta_by_file[fi] = reader.metadata
+        state = _FileState(reader, cache, plan, remaining=len(plan.groups))
+        self._files[fi] = state
+        if state.remaining == 0:
+            self._close_file(fi)
+        return state
+
+    def _close_file(self, fi: int) -> None:
+        state = self._files.pop(fi, None)
+        if state is not None:
+            state.reader.close()
+
+    def _gen_work(self):
+        for fi in range(len(self._sources)):
+            state = self._open_file(fi)
+            for gp in state.plan.groups:
+                cost = max(gp.read_bytes, gp.uncompressed_bytes, 1)
+                yield _Work(fi, gp, cost)
+
+    # -- worker task --------------------------------------------------------
+
+    def _run_unit(self, work: _Work):
+        state = self._files[work.file_index]
+        try:
+            loaded = state.cache.load(work.plan.extents)
+            trace.count("scan.bytes_prefetched", loaded)
+            return state.reader.read_row_group(
+                work.plan.group_index, self._filter
+            )
+        finally:
+            state.cache.drop(work.plan.extents)
+
+    # -- scheduling (consumer thread) ---------------------------------------
+
+    def _next_work(self) -> Optional[_Work]:
+        if self._lookahead is not None:
+            w, self._lookahead = self._lookahead, None
+            return w
+        return next(self._work_iter, None)
+
+    def _top_up(self) -> None:
+        if self._deferred is not None:
+            return  # planning already failed: deliver what we have, then raise
+        max_units = max(2, self._scan.threads * 2)
+        while len(self._pending) < max_units:
+            try:
+                work = self._next_work()
+            except BaseException as e:
+                # a planning/open failure (schema mismatch, exhausted
+                # retries on a footer) keeps SEQUENTIAL error order: the
+                # groups already in flight deliver first, the error
+                # surfaces exactly where the one-file-at-a-time loop
+                # would have raised it
+                self._deferred = e
+                return
+            if work is None:
+                return
+            if self._pending:
+                if not self._budget.try_acquire(work.cost):
+                    self._lookahead = work  # budget full: retry later
+                    return
+            else:
+                # nothing in flight: every cost is released, so the
+                # budget is empty — force-admit (oversized groups run
+                # alone; the bound stays exact for everything else)
+                self._budget.admit(work.cost)
+            self._pending.append((work, self._pool.submit(self._run_unit, work)))
+            trace.gauge_max("scan.queue_depth_max", len(self._pending))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ScanUnit:
+        if self._closed:
+            raise StopIteration
+        self._top_up()
+        if not self._pending:
+            err, self._deferred = self._deferred, None
+            self.close()
+            if err is not None:
+                # planning/open errors are FILE-BOUNDARY errors: the
+                # sequential dataset stream raises them bare (outside its
+                # per-row wrap), so consumers can re-raise them unwrapped
+                err.pftpu_scan_planning = True
+                raise err
+            raise StopIteration
+        work, fut = self._pending.popleft()
+        t0 = time.perf_counter()
+        try:
+            batch = fut.result()
+        except BaseException:
+            self._budget.release(work.cost)
+            self.close()
+            raise
+        trace.add("scan.consumer_stall", time.perf_counter() - t0)
+        self._budget.release(work.cost)
+        self._delivered_fi = work.file_index
+        state = self._files.get(work.file_index)
+        if state is not None:
+            state.remaining -= 1
+            if state.remaining == 0:
+                self._close_file(work.file_index)
+        self._top_up()  # refill while the consumer processes the batch
+        return ScanUnit(work.file_index, work.plan.group_index, batch)
+
+    def close(self) -> None:
+        """Drain workers and close every open file; idempotent, safe after
+        errors or mid-scan abandonment."""
+        if self._closed:
+            return
+        self._closed = True
+        for work, fut in self._pending:
+            if not fut.cancel():
+                try:
+                    fut.result()
+                except Exception:
+                    pass  # discarded lookahead must not mask the abandon
+            self._budget.release(work.cost)
+        self._pending.clear()
+        self._pool.shutdown(wait=True)
+        for fi in list(self._files):
+            self._close_file(fi)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def scan_batches(sources: Sequence, columns: Optional[Sequence[str]] = None,
+                 options: Optional[ReaderOptions] = None,
+                 scan: Optional[ScanOptions] = None,
+                 predicate=None):
+    """Generator of :class:`ScanUnit` over a dataset — the functional face
+    of :class:`DatasetScanner` (closes the scanner when the generator is
+    exhausted, closed, or abandoned)."""
+    scanner = DatasetScanner(
+        sources, columns=columns, options=options, scan=scan,
+        predicate=predicate,
+    )
+    try:
+        yield from scanner
+    finally:
+        scanner.close()
+
+
+def scan_device_groups(sources: Sequence,
+                       columns: Optional[Sequence[str]] = None,
+                       options: Optional[ReaderOptions] = None,
+                       scan: Optional[ScanOptions] = None,
+                       predicate=None,
+                       float64_policy: str = "bits",
+                       dict_form: str = "gather"):
+    """Scan-scheduled DEVICE decode of a dataset: yields
+    ``(file_index, group_index, {name: DeviceColumn})`` in order.
+
+    Two schedulers compose here: the byte prefetcher loads each group's
+    coalesced extents under the ``prefetch_bytes`` budget ahead of the
+    engine, and ``tpu.engine.iter_dataset_row_groups`` runs its
+    stage‖ship‖decode pipeline ACROSS file boundaries — the group-i /
+    group-i+1 overlap no longer drains at each file's end.  Footers are
+    opened eagerly and every file stays open until the scan ends (page
+    bytes still move only under the budget) — so the dataset's file
+    count is bounded by the process fd limit here, unlike the host
+    :class:`DatasetScanner`, which closes each file as its last group
+    delivers.  For many-thousand-file datasets, batch the source list.
+    ``options.verify_crc``/``salvage`` are rejected exactly as
+    ``TpuRowGroupReader`` rejects them.
+    """
+    from ..format.schema import dataset_schema_key
+    from ..tpu.engine import TpuRowGroupReader, iter_dataset_row_groups
+
+    _reject_salvage(options)
+    sc = scan or ScanOptions()
+    budget = _ByteBudget(sc.prefetch_bytes)
+    readers: List[TpuRowGroupReader] = []
+    tasks: List[tuple] = []          # (reader, group_index)
+    units: List[tuple] = []          # (file_index, GroupPlan, cache, cost)
+    pool = ThreadPoolExecutor(max_workers=sc.threads,
+                              thread_name_prefix="pftpu-scanio")
+
+    def open_file(source):
+        """Footer open + plan for one file (runs in the pool: footer
+        parses of later files overlap each other and the first decodes).
+        Returns (engine reader, cache, plan); the reader owns the chain."""
+        cache = _source_chain(source, options)
+        reader_opts = (
+            replace(options, io_retries=0) if options is not None else None
+        )
+        try:
+            fr = ParquetFileReader(cache, options=reader_opts)
+        except BaseException:
+            cache.close()
+            raise
+        try:
+            tpu = TpuRowGroupReader(
+                fr, float64_policy=float64_policy, dict_form=dict_form
+            )  # takes ownership of fr (closes it, and the chain with it)
+        except BaseException:
+            # the engine closes only readers it OPENED; a rejection here
+            # (e.g. verify_crc pinned to host) must not leak ours
+            fr.close()
+            raise
+        try:
+            keep = (
+                set(predicate.row_groups(fr)) if predicate is not None else None
+            )
+            fplan = plan_file(fr, set(columns) if columns else None, keep, sc)
+            if fplan.index_extents:
+                cache.load(fplan.index_extents)
+        except BaseException:
+            tpu.close()
+            raise
+        return tpu, cache, fplan
+
+    open_futs = [pool.submit(open_file, s) for s in sources]
+    try:
+        schema_key = None
+        try:
+            for fi, fut in enumerate(open_futs):
+                tpu, cache, fplan = fut.result()
+                readers.append(tpu)
+                key = dataset_schema_key(tpu.reader.schema.columns)
+                if schema_key is None:
+                    schema_key = key
+                elif key != schema_key:
+                    raise DatasetSchemaError(
+                        f"dataset file {fi} disagrees with the first "
+                        "file's schema"
+                    )
+                for gp in fplan.groups:
+                    cost = max(gp.read_bytes, 1)
+                    tasks.append((tpu, gp.group_index))
+                    units.append((fi, gp, cache, cost))
+        except BaseException:
+            # close readers opened by futures not yet collected into
+            # `readers` (the finally below only knows collected ones)
+            for fut in open_futs:
+                if fut.cancel():
+                    continue
+                try:
+                    tpu, _, _ = fut.result()
+                except BaseException:
+                    continue
+                if tpu not in readers:
+                    tpu.close()
+            raise
+
+        # the POSITIONAL contract: every yielded group carries the FIRST
+        # file's selected columns, in schema order — exactly the
+        # sequential TPU batch path's ordering rule.  The engine's dicts
+        # arrive in each file's chunk order, which footer-identical
+        # schemas do not pin; reordering here keeps positional consumers
+        # safe, and a chunk missing from a group raises instead of
+        # silently yielding fewer columns.
+        want = set(columns) if columns else None
+        sel_names = [
+            c.path[0] if len(c.path) == 1 else ".".join(c.path)
+            for r in readers[:1]
+            for c in r.reader.schema.columns
+            if want is None or c.path[0] in want
+        ]
+
+        loads: deque = deque()  # (unit_idx, future) admitted to the budget
+        next_load = 0
+        floor = 0  # first unit the engine has not consumed yet
+
+        def pump():
+            nonlocal next_load
+            if next_load < floor:
+                # budget lag left these behind and the engine already
+                # read them directly — never prefetch a consumed group
+                next_load = floor
+            while next_load < len(units):
+                fi_, gp, cache_, cost = units[next_load]
+                if loads and not budget.try_acquire(cost):
+                    return
+                if not loads:
+                    budget.admit(cost)  # queue empty ⇒ budget empty
+                loads.append((next_load, pool.submit(cache_.load, gp.extents)))
+                trace.gauge_max("scan.queue_depth_max", len(loads))
+                next_load += 1
+
+        pump()
+        groups = iter_dataset_row_groups(tasks, columns=columns)
+        try:
+            for i in range(len(units)):
+                t0 = time.perf_counter()
+                cols = next(groups)
+                trace.add("scan.consumer_stall", time.perf_counter() - t0)
+                fi_, gp, cache_, cost = units[i]
+                ordered = {}
+                for n in sel_names:
+                    if n not in cols:
+                        raise ValueError(
+                            f"row group {gp.group_index} missing column {n}"
+                        )
+                    ordered[n] = cols[n]
+                yield fi_, gp.group_index, ordered
+                floor = i + 1
+                # the engine staged this group before yielding it: its
+                # raw extents are dead weight now — drop and refill
+                if loads and loads[0][0] == i:
+                    _, fut = loads.popleft()
+                    try:
+                        fut.result()
+                    except Exception:
+                        pass  # failed prefetch already fell back to direct reads
+                    budget.release(cost)
+                cache_.drop(gp.extents)
+                pump()
+        finally:
+            # quiesce the engine pipeline FIRST: closing the generator
+            # joins its stage/ship pools, so no in-flight stage read can
+            # race the reader closes below (the io.source close contract)
+            groups.close()
+    finally:
+        pool.shutdown(wait=True)
+        for r in readers:
+            r.close()

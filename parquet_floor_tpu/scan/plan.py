@@ -1,0 +1,234 @@
+"""Scan I/O planner — coalesced read extents for row-group scans.
+
+The dataset iterators used to issue one ``read_at`` per column chunk (and
+the page-index reads one more each), paying one seek/syscall per range
+even when ranges sit a few KB apart on disk.  This module turns the byte
+ranges a row group needs into **coalesced extents**: ranges separated by
+at most ``ScanOptions.max_gap_bytes`` merge into one read (the gap bytes
+are over-read and discarded — the same trade Arrow Datasets makes with
+its read-range coalescing), and extents are capped at
+``ScanOptions.max_extent_bytes`` so one read never monopolizes the
+in-flight byte budget.
+
+Everything here is pure planning over footer metadata — no I/O happens in
+this module.  The executor (:mod:`parquet_floor_tpu.scan.executor`) reads
+the planned extents through ``Source.read_many`` and serves the decode
+path from the prefetched bytes.
+
+Observability: every plan emits ``trace.count`` counters —
+``scan.ranges_planned`` (pre-merge), ``scan.extents_planned``
+(post-merge), ``scan.bytes_used`` (the bytes decode actually wants),
+``scan.bytes_read`` (what the coalesced extents fetch) and
+``scan.overread_bytes`` (their difference).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Set, Tuple
+
+from ..utils import trace
+
+
+@dataclass(frozen=True)
+class ScanOptions:
+    """Knobs of the scan scheduler (planner + executor).
+
+    * ``max_gap_bytes`` — ranges separated by at most this many bytes
+      merge into one read extent.  0 still merges *touching* ranges.
+    * ``max_extent_bytes`` — soft cap on one extent; a single range
+      bigger than the cap stays one extent (it cannot be split without
+      re-splitting the read), but no merge grows past it.
+    * ``prefetch_bytes`` — the executor's in-flight byte budget: the sum
+      of all prefetched-but-unconsumed bytes (raw extents or decoded
+      batches, whichever is larger per group) never exceeds it.  One
+      group larger than the whole budget is admitted only when it is
+      alone in flight.
+    * ``threads`` — worker threads reading extents and decoding groups.
+    """
+
+    max_gap_bytes: int = 64 << 10
+    max_extent_bytes: int = 8 << 20
+    prefetch_bytes: int = 64 << 20
+    threads: int = 4
+
+    def __post_init__(self):
+        if self.max_gap_bytes < 0:
+            raise ValueError(f"max_gap_bytes must be >= 0, got {self.max_gap_bytes}")
+        if self.max_extent_bytes <= 0:
+            raise ValueError(
+                f"max_extent_bytes must be > 0, got {self.max_extent_bytes}"
+            )
+        if self.prefetch_bytes <= 0:
+            raise ValueError(
+                f"prefetch_bytes must be > 0, got {self.prefetch_bytes}"
+            )
+        if self.threads < 1:
+            raise ValueError(f"threads must be >= 1, got {self.threads}")
+
+
+@dataclass(frozen=True)
+class Extent:
+    """One coalesced read: ``[offset, offset + length)`` covering
+    ``used`` bytes of actually-wanted ranges (``length - used`` is the
+    over-read the merge decided to pay)."""
+
+    offset: int
+    length: int
+    used: int
+
+    @property
+    def end(self) -> int:
+        return self.offset + self.length
+
+
+@dataclass
+class GroupPlan:
+    """The I/O plan of one row group: its chunks' byte ranges coalesced
+    into extents, plus footer-derived size facts the executor budgets
+    with."""
+
+    group_index: int
+    extents: List[Extent]
+    read_bytes: int          # sum of extent lengths (what hits the disk)
+    used_bytes: int          # sum of the wanted ranges
+    uncompressed_bytes: int  # footer estimate of the decoded size
+    num_rows: int
+
+
+@dataclass
+class FilePlan:
+    """Per-file plan: one :class:`GroupPlan` per (kept) row group plus
+    the shared index extents (page indexes — read once, cached by the
+    reader for every group)."""
+
+    index_extents: List[Extent] = field(default_factory=list)
+    groups: List[GroupPlan] = field(default_factory=list)
+
+
+def coalesce(ranges: Sequence[Tuple[int, int]], max_gap: int,
+             max_extent: int) -> List[Extent]:
+    """Merge ``(offset, length)`` ranges into ascending coalesced extents.
+
+    Overlapping or duplicate ranges are unioned (``used`` counts each
+    byte once).  Zero-length ranges are dropped.
+    """
+    spans = sorted((int(o), int(o) + int(n)) for o, n in ranges if n > 0)
+    if not spans:
+        return []
+    out: List[Extent] = []
+    cur_s, cur_e = spans[0]
+    used = cur_e - cur_s
+    for s, e in spans[1:]:
+        gap = s - cur_e
+        new_e = max(cur_e, e)
+        if gap <= max_gap and new_e - cur_s <= max_extent:
+            used += max(0, e - max(s, cur_e))  # overlap counts once
+            cur_e = new_e
+            continue
+        out.append(Extent(cur_s, cur_e - cur_s, used))
+        cur_s, cur_e = s, e
+        used = e - s
+    out.append(Extent(cur_s, cur_e - cur_s, used))
+    return out
+
+
+def chunk_ranges(rg, column_filter: Optional[Set[str]] = None
+                 ) -> List[Tuple[int, int]]:
+    """The data byte ranges of one row group's (selected) column chunks —
+    dictionary page through last data page, exactly what
+    ``read_column_chunk`` fetches."""
+    from ..format.file_read import _chunk_byte_range
+
+    ranges = []
+    for chunk in rg.columns or []:
+        meta = chunk.meta_data
+        if meta is None:
+            continue  # diagnosed later by read_column_chunk, with context
+        if column_filter and meta.path_in_schema and \
+                meta.path_in_schema[0] not in column_filter:
+            continue
+        start, length = _chunk_byte_range(meta)
+        ranges.append((int(start), int(length)))
+    return ranges
+
+
+def index_ranges(rg, column_filter: Optional[Set[str]] = None
+                 ) -> List[Tuple[int, int]]:
+    """Page-index (OffsetIndex/ColumnIndex) byte ranges of a row group's
+    selected chunks — tiny, footer-adjacent, and read by ``page_cover``/
+    predicates; prefetching them spares one seek each."""
+    ranges = []
+    for chunk in rg.columns or []:
+        meta = chunk.meta_data
+        if column_filter and meta is not None and meta.path_in_schema and \
+                meta.path_in_schema[0] not in column_filter:
+            continue
+        for off, ln in (
+            (chunk.offset_index_offset, chunk.offset_index_length),
+            (chunk.column_index_offset, chunk.column_index_length),
+        ):
+            if off is not None and ln:
+                ranges.append((int(off), int(ln)))
+    return ranges
+
+
+def plan_file(reader, column_filter: Optional[Set[str]] = None,
+              keep: Optional[Set[int]] = None,
+              options: Optional[ScanOptions] = None) -> FilePlan:
+    """Plan every (kept) row group of an open ``ParquetFileReader``.
+
+    ``keep`` restricts to a predicate's surviving group indices (None =
+    all).  Counters land in ``trace``; per-file totals also surface as a
+    ``scan.plan`` trace decision.
+    """
+    opts = options or ScanOptions()
+    plan = FilePlan()
+    idx_ranges: List[Tuple[int, int]] = []
+    for gi, rg in enumerate(reader.row_groups):
+        if keep is not None and gi not in keep:
+            continue
+        ranges = chunk_ranges(rg, column_filter)
+        extents = coalesce(ranges, opts.max_gap_bytes, opts.max_extent_bytes)
+        gp = GroupPlan(
+            group_index=gi,
+            extents=extents,
+            read_bytes=sum(e.length for e in extents),
+            used_bytes=sum(e.used for e in extents),
+            uncompressed_bytes=sum(
+                int(c.meta_data.total_uncompressed_size or 0)
+                for c in rg.columns or []
+                if c.meta_data is not None and (
+                    not column_filter
+                    or not c.meta_data.path_in_schema
+                    or c.meta_data.path_in_schema[0] in column_filter
+                )
+            ),
+            num_rows=int(rg.num_rows or 0),
+        )
+        plan.groups.append(gp)
+        idx_ranges.extend(index_ranges(rg, column_filter))
+        trace.count("scan.ranges_planned", len(ranges))
+        trace.count("scan.extents_planned", len(extents))
+        trace.count("scan.bytes_read", gp.read_bytes)
+        trace.count("scan.bytes_used", gp.used_bytes)
+        trace.count("scan.overread_bytes", gp.read_bytes - gp.used_bytes)
+    plan.index_extents = coalesce(
+        idx_ranges, opts.max_gap_bytes, opts.max_extent_bytes
+    )
+    trace.count("scan.ranges_planned", len(idx_ranges))
+    trace.count("scan.extents_planned", len(plan.index_extents))
+    idx_read = sum(e.length for e in plan.index_extents)
+    idx_used = sum(e.used for e in plan.index_extents)
+    trace.count("scan.bytes_read", idx_read)
+    trace.count("scan.bytes_used", idx_used)
+    trace.count("scan.overread_bytes", idx_read - idx_used)
+    trace.decision("scan.plan", {
+        "path": getattr(reader.source, "name", None),
+        "groups": len(plan.groups),
+        "extents": sum(len(g.extents) for g in plan.groups)
+        + len(plan.index_extents),
+        "bytes_read": sum(g.read_bytes for g in plan.groups) + idx_read,
+        "bytes_used": sum(g.used_bytes for g in plan.groups) + idx_used,
+    })
+    return plan

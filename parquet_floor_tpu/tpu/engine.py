@@ -2165,78 +2165,9 @@ class TpuRowGroupReader:
             indices = list(indices)
         else:
             indices = list(range(self.num_row_groups))
-        want = set(columns) if columns else None
-        big = {
-            i for i in indices
-            if self._group_byte_estimate(self.reader.row_groups[i], want)
-            > self._arena_cap
-        }
-        if big:
-            # oversized groups decode via the multi-launch chunk path,
-            # outside the pipeline; the normal runs between them keep
-            # the 3-stage pipeline
-            run: List[int] = []
-            for i in indices:
-                if i in big:
-                    if run:
-                        yield from self.iter_row_groups(
-                            columns, prefetch, indices=run
-                        )
-                        run = []
-                    yield self.read_row_group(i, columns)
-                else:
-                    run.append(i)
-            if run:
-                yield from self.iter_row_groups(columns, prefetch, indices=run)
-            return
-        if not prefetch or len(indices) <= 1:
-            for i in indices:
-                yield self.read_row_group(i, columns)
-            return
-
-        def ship_task(stage_fut):
-            sg = stage_fut.result()
-            return sg, self._ship(sg)
-
-        # Two dedicated single-worker pools make a true 3-stage pipeline:
-        # the stage worker runs up to DEPTH groups ahead (bounded: each
-        # staged group pins a host arena), the ship worker transfers each
-        # group as soon as it is staged AND the previous transfer is done
-        # (one in flight — sync_transfers semantics), and the main thread
-        # dispatches the fused decode while the consumer materializes.
-        # Steady-state throughput → max(stage, ship, decode+consume)
-        # instead of their sum.  Each level of depth pins one more host
-        # arena (and its shipped device copy): PFTPU_PREFETCH_DEPTH=1
-        # restores the old single-group lookahead if memory is tight.
-        import os as _os
-
-        DEPTH = max(1, int(_os.environ.get("PFTPU_PREFETCH_DEPTH", "2")))
-        n = len(indices)
-        with ThreadPoolExecutor(max_workers=1,
-                                thread_name_prefix="pftpu-stage") as sp, \
-                ThreadPoolExecutor(max_workers=1,
-                                   thread_name_prefix="pftpu-ship") as shp:
-            # chunked=False: intra-group chunked shipping would issue
-            # transfers from the stage worker concurrently with the ship
-            # worker's — two streams contend on tunnelled links, and a
-            # chunked group 0 would only delay group 1's staging in the
-            # single stage worker; the cross-group pipeline provides the
-            # overlap here (single-group reads take read_row_group's
-            # chunked path instead)
-            ship_q = deque()
-            for j in range(min(DEPTH, n)):
-                f = sp.submit(self._stage_row_group, indices[j], columns,
-                              chunked=False)
-                ship_q.append(shp.submit(ship_task, f))
-            for k in range(n):
-                if DEPTH + k < n:
-                    f = sp.submit(
-                        self._stage_row_group, indices[DEPTH + k], columns,
-                        chunked=False,
-                    )
-                    ship_q.append(shp.submit(ship_task, f))
-                sg, shipped = ship_q.popleft().result()
-                yield self._decode_shipped(sg, shipped)
+        yield from iter_dataset_row_groups(
+            [(self, i) for i in indices], columns, prefetch
+        )
 
     # -- staging ------------------------------------------------------------
 
@@ -2521,3 +2452,107 @@ class TpuRowGroupReader:
 
     def _launch(self, sg: _StagedGroup) -> Dict[str, DeviceColumn]:
         return self._decode_shipped(sg, self._ship(sg))
+
+
+# ---------------------------------------------------------------------------
+# Cross-file pipelining (the scan scheduler's device leg)
+# ---------------------------------------------------------------------------
+
+def iter_dataset_row_groups(tasks, columns: Optional[Sequence[str]] = None,
+                            prefetch: bool = True):
+    """Decode ``(reader, group_index)`` pairs in order, with the 3-stage
+    stage‖ship‖decode pipeline running ACROSS reader (file) boundaries.
+
+    ``TpuRowGroupReader.iter_row_groups`` pipelines within one file; this
+    is its dataset form: while the device decodes the last group of file
+    k, the stage worker is already staging group 0 of file k+1 — the
+    pipeline never drains at a file boundary.  All readers must target
+    the same device; each keeps its own shape buckets and dictionary
+    pools, and files with identical decode shapes share compiled
+    programs through the fused-decode jit cache (it is keyed by the
+    program tuple, not the reader).
+
+    Oversized groups (footer estimate past their reader's arena cap)
+    decode via the multi-launch chunk path outside the pipeline, exactly
+    as in the single-file iterator; the runs of normal groups between
+    them keep the pipeline.
+    """
+    tasks = list(tasks)
+    want = set(columns) if columns else None
+    big = {
+        j for j, (r, i) in enumerate(tasks)
+        if r._group_byte_estimate(r.reader.row_groups[i], want) > r._arena_cap
+    }
+    if big:
+        run: List[tuple] = []
+        for j, (r, i) in enumerate(tasks):
+            if j in big:
+                if run:
+                    yield from _iter_pipeline(run, columns, prefetch)
+                    run = []
+                yield r.read_row_group(i, columns)
+            else:
+                run.append((r, i))
+        if run:
+            yield from _iter_pipeline(run, columns, prefetch)
+        return
+    yield from _iter_pipeline(tasks, columns, prefetch)
+
+
+def _iter_pipeline(tasks, columns, prefetch: bool):
+    """The 3-stage pipeline over normal-sized ``(reader, index)`` tasks."""
+    if not prefetch or len(tasks) <= 1:
+        for r, i in tasks:
+            yield r.read_row_group(i, columns)
+        return
+
+    def ship_task(r, stage_fut):
+        sg = stage_fut.result()
+        return r, sg, r._ship(sg)
+
+    # Two dedicated single-worker pools make a true 3-stage pipeline:
+    # the stage worker runs up to DEPTH groups ahead (bounded: each
+    # staged group pins a host arena), the ship worker transfers each
+    # group as soon as it is staged AND the previous transfer is done
+    # (one in flight — sync_transfers semantics; readers of one dataset
+    # share the single ship worker, so transfers never interleave even
+    # across files), and the consumer's thread dispatches the fused
+    # decode while it materializes.  Steady-state throughput →
+    # max(stage, ship, decode+consume) instead of their sum.  Each level
+    # of depth pins one more host arena (and its shipped device copy):
+    # PFTPU_PREFETCH_DEPTH=1 restores the old single-group lookahead if
+    # memory is tight.  Multi-file task lists default one level deeper:
+    # crossing a boundary costs a footer-warm stage with no decode to
+    # hide under, and the extra staged arena buys that hiding room.
+    import os as _os
+
+    multi_file = len({id(r) for r, _ in tasks}) > 1
+    DEPTH = max(1, int(
+        _os.environ.get("PFTPU_PREFETCH_DEPTH", "3" if multi_file else "2")
+    ))
+    n = len(tasks)
+    with ThreadPoolExecutor(max_workers=1,
+                            thread_name_prefix="pftpu-stage") as sp, \
+            ThreadPoolExecutor(max_workers=1,
+                               thread_name_prefix="pftpu-ship") as shp:
+        # chunked=False: intra-group chunked shipping would issue
+        # transfers from the stage worker concurrently with the ship
+        # worker's — two streams contend on tunnelled links, and a
+        # chunked group 0 would only delay group 1's staging in the
+        # single stage worker; the cross-group pipeline provides the
+        # overlap here (single-group reads take read_row_group's
+        # chunked path instead)
+        ship_q = deque()
+
+        def submit(j):
+            r, i = tasks[j]
+            f = sp.submit(r._stage_row_group, i, columns, chunked=False)
+            ship_q.append(shp.submit(ship_task, r, f))
+
+        for j in range(min(DEPTH, n)):
+            submit(j)
+        for k in range(n):
+            if DEPTH + k < n:
+                submit(DEPTH + k)
+            r, sg, shipped = ship_q.popleft().result()
+            yield r._decode_shipped(sg, shipped)

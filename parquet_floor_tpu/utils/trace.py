@@ -6,7 +6,11 @@ Three layers, all zero-cost when disabled:
 
 * ``span(stage)`` — context manager accumulating wall time + byte counts
   per stage name (read / stage / ship / decode / assemble).
-* ``stats()`` / ``report()`` — snapshot the counters (thread-safe).
+* ``count(name, n)`` / ``gauge_max(name, v)`` — plain integer counters
+  (additive) and high-water gauges, for subsystems whose health is a
+  number rather than a duration (the scan scheduler's extents planned /
+  bytes over-read / prefetch queue depth live here).
+* ``stats()`` / ``counters()`` / ``report()`` — snapshot (thread-safe).
 * ``device_trace(dir)`` — wraps ``jax.profiler.trace`` so the device side
   of a decode shows up in TensorBoard/Perfetto alongside the host spans.
 
@@ -44,6 +48,8 @@ class StageStat:
 
 _stats: Dict[str, StageStat] = {}
 _decisions: list = []  # bounded log of routing/policy decisions
+_counters: Dict[str, int] = {}   # additive integer counters
+_gauges: Dict[str, int] = {}     # high-water gauges (max ever seen)
 
 
 def enable() -> None:
@@ -64,6 +70,38 @@ def reset() -> None:
     with _lock:
         _stats.clear()
         _decisions.clear()
+        _counters.clear()
+        _gauges.clear()
+
+
+def count(name: str, n: int = 1) -> None:
+    """Add ``n`` to the additive counter ``name`` (no-op when disabled)."""
+    if not _enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + int(n)
+
+
+def gauge_max(name: str, value: int) -> None:
+    """Raise the high-water gauge ``name`` to at least ``value`` (no-op
+    when disabled).  Gauges record peaks — e.g. the deepest a prefetch
+    queue ever got — where an additive counter would be meaningless."""
+    if not _enabled:
+        return
+    v = int(value)
+    with _lock:
+        if v > _gauges.get(name, -(1 << 62)):
+            _gauges[name] = v
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of additive counters and high-water gauges (gauges appear
+    under their own name; names are disjoint by convention —
+    ``scan.queue_depth_max`` vs ``scan.extents_planned``)."""
+    with _lock:
+        out = dict(_counters)
+        out.update(_gauges)
+        return out
 
 
 def decision(name: str, detail: dict) -> None:
@@ -122,6 +160,8 @@ def report() -> str:
             f"{name:<12} n={st['count']:<6} {st['seconds']*1e3:9.1f} ms"
             + (f"  {st['MB_per_s']:8.1f} MB/s" if st["bytes"] else "")
         )
+    for name, v in sorted(counters().items()):
+        lines.append(f"{name:<32} {v}")
     for d in decisions():
         kv = " ".join(f"{k}={v}" for k, v in d.items() if k != "decision")
         lines.append(f"[{d['decision']}] {kv}")

@@ -17,4 +17,13 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
   -p no:randomly "$@" 2>&1 | tee "$t1_log"
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" | tr -cd . | wc -c)"
-exit "$rc"
+[ "$rc" -eq 0 ] || exit "$rc"
+
+# Fast bench smoke: every leg of bench.py (headline decode, batch face,
+# chunked, multi-file scan) runs at toy scale on the CPU backend, so a
+# broken decode path fails THIS gate instead of only the nightly bench.
+# The numbers are health indicators, not perf records.
+echo "== bench smoke (PFTPU_BENCH_ROWS=2000) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PFTPU_BENCH_ROWS=2000 \
+  PFTPU_BENCH_REPS=1 python bench.py || exit 1
+exit 0

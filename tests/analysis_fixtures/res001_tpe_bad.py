@@ -1,0 +1,22 @@
+"""Seeded-bad: the executor-leak shapes — a ThreadPoolExecutor whose
+threads outlive an exception between construction and shutdown, and a
+scan handle abandoned without close on the error path."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+from parquet_floor_tpu.scan import DatasetScanner
+
+
+def decode_all(paths, decode):
+    pool = ThreadPoolExecutor(max_workers=4)
+    futs = [pool.submit(decode, p) for p in paths]  # a raise here leaks threads
+    out = [f.result() for f in futs]
+    pool.shutdown()
+    return out
+
+
+def first_batch(paths):
+    scanner = DatasetScanner(paths)
+    unit = next(iter(scanner))  # any raise leaks the scan worker pool
+    scanner.close()
+    return unit

@@ -1,0 +1,28 @@
+"""Clean: pools and scan handles are with-managed or released in a
+finally (shutdown counts as the release verb for executors)."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+from parquet_floor_tpu.scan import DatasetScanner
+
+
+def decode_all(paths, decode):
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        futs = [pool.submit(decode, p) for p in paths]
+        return [f.result() for f in futs]
+
+
+def first_batch(paths):
+    scanner = DatasetScanner(paths)
+    try:
+        return next(iter(scanner))
+    finally:
+        scanner.close()
+
+
+def pooled_loader(paths, decode):
+    pool = ThreadPoolExecutor(max_workers=2)
+    try:
+        return [pool.submit(decode, p).result() for p in paths]
+    finally:
+        pool.shutdown(wait=True)

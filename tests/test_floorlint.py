@@ -29,6 +29,7 @@ CASES = [
     ("tpu001", "FL-TPU001"),
     ("tpu002", "FL-TPU002"),
     ("res001", "FL-RES001"),
+    ("res001_tpe", "FL-RES001"),  # executor/scan-handle shapes of the rule
     ("alloc001", "FL-ALLOC001"),
 ]
 

@@ -1,0 +1,555 @@
+"""Scan scheduler (``parquet_floor_tpu.scan``): planner coalescing,
+vectored reads, bounded cross-file prefetch, sequential-loop equivalence,
+and the edge-case contract (empty dataset, faulted sources, salvage
+rejection, clean shutdown on abandonment)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    IoRetryExhaustedError,
+    ParquetFileReader,
+    ParquetFileWriter,
+    ParquetReader,
+    ReaderOptions,
+    TruncatedFileError,
+    UnsupportedFeatureError,
+    WriterOptions,
+    trace,
+    types,
+)
+from parquet_floor_tpu.format.encodings.plain import ByteArrayColumn
+from parquet_floor_tpu.format.parquet_thrift import CompressionCodec
+from parquet_floor_tpu.io.source import FileSource, RetryingSource
+from parquet_floor_tpu.scan import (
+    DatasetScanner,
+    PrefetchedSource,
+    ScanOptions,
+    coalesce,
+    plan_file,
+    scan_batches,
+    scan_device_groups,
+)
+from parquet_floor_tpu.scan.plan import Extent
+from parquet_floor_tpu.testing import FaultInjectingSource
+
+
+def _write(path, n=3000, groups=2, seed=0):
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.optional(types.DOUBLE).named("d"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    rng = np.random.default_rng(seed)
+    per = (n + groups - 1) // groups
+    data = {
+        "k": np.arange(n, dtype=np.int64) + seed * 1_000_000,
+        "d": [
+            None if i % 11 == 0 else float(v)
+            for i, v in enumerate(rng.standard_normal(n))
+        ],
+        "s": [None if i % 7 == 0 else f"v{(i * 13 + seed) % 37}" for i in range(n)],
+    }
+    opts = WriterOptions(
+        codec=CompressionCodec.SNAPPY, row_group_rows=per,
+        data_page_values=400,
+    )
+    with ParquetFileWriter(path, schema, opts) as w:
+        for lo in range(0, n, per):
+            hi = min(lo + per, n)
+            w.write_columns({k: v[lo:hi] for k, v in data.items()})
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = tmp_path_factory.mktemp("scan_ds")
+    return [_write(str(d / f"f{i}.parquet"), seed=i) for i in range(4)]
+
+
+def _seq_units(paths, column_filter=None):
+    """The sequential per-file loop the scheduler must match bit-for-bit."""
+    out = []
+    for fi, p in enumerate(paths):
+        with ParquetFileReader(p) as r:
+            for gi in range(len(r.row_groups)):
+                out.append((fi, gi, r.read_row_group(gi, column_filter)))
+    return out
+
+
+def _assert_batches_equal(a, b):
+    assert a.num_rows == b.num_rows
+    assert len(a.columns) == len(b.columns)
+    for ca, cb in zip(a.columns, b.columns):
+        assert ca.descriptor.path == cb.descriptor.path
+        assert ca.num_values == cb.num_values
+        if isinstance(ca.values, ByteArrayColumn):
+            assert np.array_equal(ca.values.offsets, cb.values.offsets)
+            assert np.array_equal(ca.values.data, cb.values.data)
+        else:
+            assert np.array_equal(np.asarray(ca.values), np.asarray(cb.values))
+        for la, lb in ((ca.def_levels, cb.def_levels),
+                       (ca.rep_levels, cb.rep_levels)):
+            assert (la is None) == (lb is None)
+            if la is not None:
+                assert np.array_equal(la, lb)
+
+
+# --- planner ---------------------------------------------------------------
+
+def test_coalesce_merges_within_gap():
+    ext = coalesce([(1000, 10), (0, 100), (150, 100)], 64, 1 << 20)
+    assert [(e.offset, e.length, e.used) for e in ext] == [
+        (0, 250, 200), (1000, 10, 10),
+    ]
+
+
+def test_coalesce_zero_gap_merges_touching_only():
+    ext = coalesce([(0, 100), (100, 50), (151, 9)], 0, 1 << 20)
+    assert [(e.offset, e.length) for e in ext] == [(0, 150), (151, 9)]
+
+
+def test_coalesce_respects_extent_cap():
+    assert len(coalesce([(0, 100), (100, 100)], 64, 150)) == 2
+    # a single range larger than the cap stays one extent
+    big = coalesce([(0, 1000)], 0, 10)
+    assert len(big) == 1 and big[0].length == 1000
+
+
+def test_coalesce_unions_overlapping_ranges():
+    (e,) = coalesce([(0, 100), (50, 100)], 0, 1 << 20)
+    assert (e.offset, e.length, e.used) == (0, 150, 150)
+
+
+def test_plan_file_extents_and_counters(dataset):
+    trace.enable()
+    trace.reset()
+    try:
+        with ParquetFileReader(dataset[0]) as r:
+            plan = plan_file(r)
+        assert len(plan.groups) == 2
+        for g in plan.groups:
+            assert g.extents
+            assert g.read_bytes >= g.used_bytes > 0
+            assert g.num_rows > 0
+        c = trace.counters()
+        assert c["scan.extents_planned"] >= len(plan.groups)
+        assert c["scan.bytes_read"] >= c["scan.bytes_used"] > 0
+        assert c["scan.overread_bytes"] == (
+            c["scan.bytes_read"] - c["scan.bytes_used"]
+        )
+    finally:
+        trace.disable()
+        trace.reset()
+
+
+def test_plan_projection_shrinks_reads(dataset):
+    with ParquetFileReader(dataset[0]) as r:
+        full = plan_file(r)
+        proj = plan_file(r, column_filter={"k"})
+    assert sum(g.used_bytes for g in proj.groups) < \
+        sum(g.used_bytes for g in full.groups)
+
+
+# --- vectored source reads -------------------------------------------------
+
+def test_read_many_matches_read_at(dataset, tmp_path):
+    with FileSource(dataset[0]) as src:
+        ranges = [(0, 64), (100, 17), (4, 1)]
+        got = src.read_many(ranges)
+        # one-shot iterables must not be silently exhausted by validation
+        gen_got = src.read_many((o, n) for o, n in ranges)
+        assert [bytes(b) for b in gen_got] == [bytes(b) for b in got]
+        assert [bytes(b) for b in got] == [
+            bytes(src.read_at(o, n)) for o, n in ranges
+        ]
+        with pytest.raises(TruncatedFileError):
+            src.read_many([(0, 8), (src.size - 1, 2)])
+    # stream without mmap/fileno: same results through the locked path
+    import io as _io
+    import pathlib
+
+    data = pathlib.Path(dataset[0]).read_bytes()[:256]
+    with FileSource(_io.BytesIO(data)) as src:
+        assert bytes(src.read_many([(10, 5)])[0]) == data[10:15]
+
+
+def test_retrying_read_many_budget_is_per_range(dataset):
+    class Flaky:
+        """Fails the first attempt of EVERY read; a shared budget would
+        exhaust after the first range retried."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self._seen = set()
+            self.name = inner.name
+            self.size = inner.size
+
+        def read_at(self, offset, length):
+            if (offset, length) not in self._seen:
+                self._seen.add((offset, length))
+                raise OSError("first-attempt flake")
+            return self._inner.read_at(offset, length)
+
+    with FileSource(dataset[0]) as inner:
+        src = RetryingSource(Flaky(inner), retries=1, backoff_s=0.0)
+        got = src.read_many([(0, 16), (16, 16), (64, 8)])
+        assert [len(b) for b in got] == [16, 16, 8]
+        assert src.retried_reads == 3
+
+
+def test_prefetched_source_hit_miss_drop(dataset):
+    with FileSource(dataset[0]) as inner:
+        raw = bytes(inner.read_at(0, 256))
+        cache = PrefetchedSource(inner)
+        ext = [Extent(0, 128, 128)]
+        assert cache.load(ext) == 128
+        assert cache.load(ext) == 0  # idempotent
+        assert bytes(cache.read_at(10, 20)) == raw[10:30]     # hit
+        assert bytes(cache.read_at(100, 100)) == raw[100:200]  # miss → inner
+        cache.drop(ext)
+        assert bytes(cache.read_at(10, 20)) == raw[10:30]     # miss again
+
+
+# --- the scheduler ---------------------------------------------------------
+
+def test_scan_matches_sequential_loop(dataset):
+    seq = _seq_units(dataset)
+    with DatasetScanner(dataset) as scanner:
+        got = list(scanner)
+    assert [(u.file_index, u.group_index) for u in got] == [
+        (fi, gi) for fi, gi, _ in seq
+    ]
+    for u, (_, _, b) in zip(got, seq):
+        _assert_batches_equal(u.batch, b)
+
+
+def test_scan_projection_and_predicate(dataset):
+    from parquet_floor_tpu import col
+
+    pred = col("k") > 1_000_000  # prunes every group of file 0
+    with DatasetScanner(dataset, columns=["k"], predicate=pred) as scanner:
+        got = list(scanner)
+    assert got and all(u.file_index > 0 for u in got)
+    for u in got:
+        assert [c.descriptor.path for c in u.batch.columns] == [("k",)]
+
+
+def test_scan_empty_dataset_yields_nothing():
+    assert list(scan_batches([])) == []
+
+
+def test_scan_single_row_group_file(tmp_path):
+    path = _write(str(tmp_path / "one.parquet"), n=500, groups=1, seed=9)
+    with DatasetScanner([path]) as scanner:
+        units = list(scanner)
+    assert [(u.file_index, u.group_index) for u in units] == [(0, 0)]
+    (_, _, b), = _seq_units([path])
+    _assert_batches_equal(units[0].batch, b)
+
+
+def test_scan_budget_never_exceeded(dataset):
+    costs = []
+    for p in dataset:
+        with ParquetFileReader(p) as r:
+            for g in plan_file(r).groups:
+                costs.append(max(g.read_bytes, g.uncompressed_bytes, 1))
+    budget = max(costs)  # room for ~one group at a time
+    trace.enable()
+    trace.reset()
+    try:
+        with DatasetScanner(
+            dataset, scan=ScanOptions(prefetch_bytes=budget, threads=3)
+        ) as scanner:
+            n = sum(u.batch.num_rows for u in scanner)
+            assert scanner._budget.high_water <= budget
+        assert trace.counters()["scan.inflight_bytes_max"] <= budget
+    finally:
+        trace.disable()
+        trace.reset()
+    assert n == sum(b.num_rows for _, _, b in _seq_units(dataset))
+
+
+def test_scan_oversized_group_admitted_alone(dataset):
+    # a budget smaller than any group still scans (units run one at a time)
+    with DatasetScanner(
+        dataset, scan=ScanOptions(prefetch_bytes=1, threads=2)
+    ) as scanner:
+        units = list(scanner)
+    assert len(units) == 8
+
+
+def test_scan_mid_scan_retry_exhausted(dataset):
+    faulty = FaultInjectingSource(
+        dataset[1], seed=3, transient_error_rate=1.0
+    )
+    sources = [dataset[0], faulty, dataset[2]]
+    got = []
+    with pytest.raises(IoRetryExhaustedError):
+        for u in scan_batches(
+            sources,
+            options=ReaderOptions(io_retries=2, io_retry_backoff_s=0.0),
+            scan=ScanOptions(threads=1),
+        ):
+            got.append(u)
+    # the healthy head of the stream was delivered before the fault, in
+    # sequential error order: every group of file 0, then the raise
+    assert [(u.file_index, u.group_index) for u in got] == [(0, 0), (0, 1)]
+    assert not [
+        t for t in threading.enumerate() if t.name.startswith("pftpu-scan")
+    ]
+
+
+def test_scan_rejects_salvage_like_tpu_engine(dataset):
+    with pytest.raises(UnsupportedFeatureError):
+        # nothing leaks: the rejection fires before the pool exists
+        DatasetScanner(dataset, options=ReaderOptions(salvage=True))  # floorlint: disable=FL-RES001
+    with pytest.raises(UnsupportedFeatureError):
+        list(scan_batches(dataset, options=ReaderOptions(salvage=True)))
+
+
+def test_scan_verify_crc_passes_through(dataset):
+    with DatasetScanner(
+        dataset[:2], options=ReaderOptions(verify_crc=True)
+    ) as scanner:
+        units = list(scanner)
+    assert len(units) == 4
+
+
+def test_scan_abandoned_iterator_shuts_down_cleanly(dataset):
+    gen = scan_batches(dataset, scan=ScanOptions(threads=3))
+    first = next(gen)
+    assert first.batch.num_rows > 0
+    gen.close()  # consumer walks away mid-scan
+    assert not [
+        t for t in threading.enumerate() if t.name.startswith("pftpu-scan")
+    ]
+    # the scanner object form shuts down the same way (unmanaged on
+    # purpose: this test IS the abandonment scenario)
+    scanner = DatasetScanner(dataset, scan=ScanOptions(threads=2))  # floorlint: disable=FL-RES001
+    next(iter(scanner))
+    scanner.close()
+    scanner.close()  # idempotent
+    assert not [
+        t for t in threading.enumerate() if t.name.startswith("pftpu-scan")
+    ]
+
+
+def test_scan_schema_mismatch_raises(dataset, tmp_path):
+    other = str(tmp_path / "other.parquet")
+    schema = types.message("t", types.required(types.INT32).named("x"))
+    with ParquetFileWriter(other, schema) as w:
+        w.write_columns({"x": np.arange(10, dtype=np.int32)})
+    with pytest.raises(ValueError, match="schema"):
+        list(scan_batches([dataset[0], other]))
+    # the ROW stream keeps the sequential contract: a bare ValueError at
+    # the file boundary, NOT the per-row RuntimeError wrap
+    with pytest.raises(ValueError, match="schema") as ei:
+        list(ParquetReader.stream_content(
+            [dataset[0], other], _row_tuples, scan_options=ScanOptions()
+        ))
+    assert not isinstance(ei.value, RuntimeError)
+
+
+# --- stream faces ----------------------------------------------------------
+
+def _row_tuples(columns):
+    class H:
+        def start(self):
+            return []
+
+        def add(self, t, h, v):
+            t.append(v)
+            return t
+
+        def finish(self, t):
+            return tuple(t)
+
+    return H()
+
+
+def test_stream_content_scan_matches_sequential(dataset):
+    seq = list(ParquetReader.stream_content(list(dataset), _row_tuples))
+    scan = list(ParquetReader.stream_content(
+        list(dataset), _row_tuples, scan_options=ScanOptions(threads=3)
+    ))
+    assert scan == seq
+
+
+def test_stream_content_scan_single_source(dataset):
+    seq = list(ParquetReader.stream_content(dataset[0], _row_tuples))
+    scan = list(ParquetReader.stream_content(
+        dataset[0], _row_tuples, scan_options=ScanOptions()
+    ))
+    assert scan == seq
+
+
+def test_stream_content_scan_surface_parity(dataset):
+    seq_it = ParquetReader.stream_content(list(dataset), _row_tuples)
+    scan_it = ParquetReader.stream_content(
+        list(dataset), _row_tuples, scan_options=ScanOptions()
+    )
+    try:
+        # metadata/columns work before iteration, like the sequential face
+        assert scan_it.metadata.num_rows == seq_it.metadata.num_rows
+        assert [c.path for c in scan_it.columns] == [
+            c.path for c in seq_it.columns
+        ]
+        assert scan_it.salvage_report is None
+    finally:
+        seq_it.close()
+        scan_it.close()
+
+
+def test_stream_content_scan_file_boundary_errors_stay_bare(dataset, tmp_path):
+    from parquet_floor_tpu import CorruptFooterError
+
+    bad = tmp_path / "trunc.parquet"
+    bad.write_bytes(b"PAR1 definitely not a footer")
+    # sequential contract: the second file's corrupt footer raises BARE
+    with pytest.raises(CorruptFooterError):
+        list(ParquetReader.stream_content(
+            [dataset[0], str(bad)], _row_tuples,
+            scan_options=ScanOptions(threads=1),
+        ))
+
+
+def test_stream_content_scan_supplier_called_per_file(dataset):
+    calls = {"seq": 0, "scan": 0}
+
+    def make_supplier(key):
+        def supplier(columns):
+            calls[key] += 1
+            return _row_tuples(columns)
+        return supplier
+
+    list(ParquetReader.stream_content(list(dataset[:2]), make_supplier("seq")))
+    list(ParquetReader.stream_content(
+        list(dataset[:2]), make_supplier("scan"), scan_options=ScanOptions()
+    ))
+    assert calls["scan"] == calls["seq"] == 2
+
+
+def test_scanner_columns_after_close_raises(dataset):
+    with DatasetScanner(dataset[:1]) as scanner:
+        pass  # closed by the with-exit without ever iterating
+    with pytest.raises(ValueError, match="closed"):
+        scanner.columns
+    with pytest.raises(ValueError, match="closed"):
+        scanner.metadata
+
+
+def test_stream_content_scan_rejects_tpu_engine(dataset):
+    with pytest.raises(ValueError, match="scan"):
+        ParquetReader.stream_content(
+            list(dataset), _row_tuples, engine="tpu",
+            scan_options=ScanOptions(),
+        )
+
+
+def test_stream_batches_scan_matches_sequential(dataset):
+    seq = list(ParquetReader.stream_batches(list(dataset)))
+    scan = list(ParquetReader.stream_batches(
+        list(dataset), scan_options=ScanOptions(threads=3)
+    ))
+    assert len(scan) == len(seq)
+    for cols_a, cols_b in zip(seq, scan):
+        assert len(cols_a) == len(cols_b)
+        for a, b in zip(cols_a, cols_b):
+            assert a.descriptor.path == b.descriptor.path
+            va, vb = np.asarray(a.values), np.asarray(b.values)
+            assert np.array_equal(va, vb)
+            assert (a.mask is None) == (b.mask is None)
+            if a.mask is not None:
+                assert np.array_equal(np.asarray(a.mask), np.asarray(b.mask))
+
+
+def test_stream_batches_scan_salvage_rejected(dataset):
+    with pytest.raises(UnsupportedFeatureError):
+        list(ParquetReader.stream_batches(
+            list(dataset), options=ReaderOptions(salvage=True),
+            scan_options=ScanOptions(),
+        ))
+
+
+# --- device leg ------------------------------------------------------------
+
+def test_scan_device_groups_rejects_pinned_reader_options(dataset):
+    # salvage: rejected by the scheduler itself; verify_crc: rejected by
+    # TpuRowGroupReader (host-pinned feature) — either way the same
+    # UnsupportedFeatureError contract, and nothing leaks
+    with pytest.raises(UnsupportedFeatureError):
+        list(scan_device_groups(
+            dataset[:2], options=ReaderOptions(salvage=True)
+        ))
+    with pytest.raises(UnsupportedFeatureError):
+        list(scan_device_groups(
+            dataset[:2], options=ReaderOptions(verify_crc=True)
+        ))
+    assert not [
+        t for t in threading.enumerate() if t.name.startswith("pftpu-scanio")
+    ]
+
+def test_scan_device_groups_matches_per_file_engine(dataset):
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+
+    expect = []
+    for fi, p in enumerate(dataset[:2]):
+        with TpuRowGroupReader(p, float64_policy="bits") as tr:
+            for gi, cols in enumerate(tr.iter_row_groups()):
+                expect.append((fi, gi, {
+                    k: (np.asarray(v.values),
+                        None if v.mask is None else np.asarray(v.mask))
+                    for k, v in cols.items()
+                }))
+    got = list(scan_device_groups(
+        dataset[:2], scan=ScanOptions(threads=2), float64_policy="bits"
+    ))
+    assert [(fi, gi) for fi, gi, _ in got] == [
+        (fi, gi) for fi, gi, _ in expect
+    ]
+    for (_, _, cols), (_, _, want) in zip(got, expect):
+        assert set(cols) == set(want)
+        for name, dc in cols.items():
+            wv, wm = want[name]
+            assert np.array_equal(np.asarray(dc.values), wv)
+            assert (dc.mask is None) == (wm is None)
+            if wm is not None:
+                assert np.array_equal(np.asarray(dc.mask), wm)
+
+
+def test_scan_device_groups_abandoned_early_quiesces(dataset):
+    gen = scan_device_groups(dataset[:3], scan=ScanOptions(threads=2))
+    next(gen)
+    gen.close()  # consumer walks away: engine pipeline must drain FIRST,
+    #              then readers close (no stage read races a close)
+    lingering = [
+        t.name for t in threading.enumerate()
+        if t.name.startswith(("pftpu-scanio", "pftpu-stage", "pftpu-ship"))
+    ]
+    assert not lingering
+
+
+def test_iter_dataset_row_groups_crosses_file_boundaries(dataset):
+    from parquet_floor_tpu.tpu.engine import (
+        TpuRowGroupReader,
+        iter_dataset_row_groups,
+    )
+
+    readers = [
+        TpuRowGroupReader(p, float64_policy="bits") for p in dataset[:3]
+    ]
+    try:
+        tasks = [(r, i) for r in readers for i in range(r.num_row_groups)]
+        ks = []
+        for cols in iter_dataset_row_groups(tasks):
+            ks.append(int(np.asarray(cols["k"].values)[0]))
+        # six groups, in (file, group) order: first row of each group
+        assert len(ks) == 6
+        assert ks == sorted(ks)
+    finally:
+        for r in readers:
+            r.close()

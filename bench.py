@@ -192,14 +192,22 @@ def scan_leg(n_rows: int, reps: int) -> dict:
         check(scan())
         scan_dt = min(scan_dt, time.perf_counter() - t0)
 
-    # one counted pass for the planner/executor observability block
-    trace.enable()
-    trace.reset()
-    check(scan())
-    counters = trace.counters()
-    stats = trace.stats()
-    trace.disable()
-    trace.reset()
+    # one counted pass under an isolated tracer scope (docs/observability.md):
+    # merged counters for the flat detail fields, the ScanReport health
+    # summary for the bench JSON, and — with PFTPU_TRACE_EXPORT=path — a
+    # Chrome/Perfetto trace of the scan's read‖stage‖ship‖decode overlap
+    with trace.scope() as t:
+        t0 = time.perf_counter()
+        check(scan())
+        scoped_wall = time.perf_counter() - t0
+    counters = t.metrics()
+    stats = t.stats()
+    scan_report = t.scan_report(
+        wall_seconds=scoped_wall, budget_bytes=sc.prefetch_bytes
+    )
+    export_path = os.environ.get("PFTPU_TRACE_EXPORT")
+    if export_path:
+        t.export_chrome_trace(export_path)
 
     # bit-identical decoded output vs the per-file loop (one pass each;
     # fetches device arrays — keep AFTER every timed section)
@@ -256,6 +264,10 @@ def scan_leg(n_rows: int, reps: int) -> dict:
         "scan_consumer_stall_ms": round(
             stats.get("scan.consumer_stall", {}).get("seconds", 0.0) * 1e3, 1
         ),
+        # the full health summary (per-stage throughput, overlap/stall
+        # fraction, budget utilization, over-read ratio, retries) — the
+        # consumable ScanReport form of the counters above
+        "scan_report": scan_report.as_dict(),
     }
 
 

@@ -174,7 +174,7 @@ def measure_rows_api(path, reps=3, engines=("host", "tpu", "auto")):
             )
             best = min(best, time.perf_counter() - t0)
         routed = [
-            d for d in trace.decisions() if d["decision"] == "engine_auto"
+            d for d in trace.decisions() if d["decision"] == "engine.auto"
         ]
         trace.disable()
         out[engine] = {"rows": n, "s": round(best, 4),

@@ -61,7 +61,7 @@ __all__ = [
     "ParquetFileReader",
     "ParquetFileWriter", "ParquetMetadata", "ParquetReader", "ParquetWriter",
     "Predicate", "PrimitiveType", "ReaderOptions", "SalvageReport",
-    "SalvageSkip", "ScanOptions", "DatasetScanner",
+    "SalvageSkip", "ScanOptions", "ScanReport", "DatasetScanner",
     "TpuRowGroupReader", "TruncatedFileError", "Type",
     "UnsupportedCodec", "UnsupportedFeatureError",
     "assemble_nested", "batch_to_arrow", "col",
@@ -84,6 +84,7 @@ _LAZY = {
     # format/API imports stay light
     "scan": ("parquet_floor_tpu.scan", None),
     "ScanOptions": ("parquet_floor_tpu.scan", "ScanOptions"),
+    "ScanReport": ("parquet_floor_tpu.utils.trace", "ScanReport"),
     "DatasetScanner": ("parquet_floor_tpu.scan", "DatasetScanner"),
     "scan_batches": ("parquet_floor_tpu.scan", "scan_batches"),
 }

@@ -14,6 +14,8 @@ FL-RES     resource guards: every ``open()``/Source acquisition is
            context-managed or closed on all exception paths
 FL-ALLOC   allocation guards: sizes parsed off the wire flow through
            ``errors.checked_alloc_size``
+FL-OBS     observability guards: trace metric/decision/span name literals
+           in package code come from the ``trace.names`` registry
 ========== ==================================================================
 
 CLI: ``python -m parquet_floor_tpu.analysis [paths ...]``.
@@ -29,10 +31,11 @@ from .core import (  # noqa: F401  (public surface)
     run,
     write_baseline,
 )
-from . import rules_alloc, rules_exc, rules_res, rules_tpu
+from . import rules_alloc, rules_exc, rules_obs, rules_res, rules_tpu
 
 ALL_RULES = (
     rules_exc.RULES + rules_tpu.RULES + rules_res.RULES + rules_alloc.RULES
+    + rules_obs.RULES
 )
 
 __all__ = [

@@ -187,7 +187,7 @@ def _analyze_one(path: pathlib.Path):
     """Shared per-file pass: returns ``(kept, suppressed_count)`` with
     ``# floorlint: disable`` directives already applied (baseline handling
     stays in :func:`run` — it is a cross-file budget)."""
-    from . import rules_alloc, rules_exc, rules_res, rules_tpu
+    from . import rules_alloc, rules_exc, rules_obs, rules_res, rules_tpu
 
     rel = _display_path(path)
     src = path.read_text()
@@ -199,7 +199,7 @@ def _analyze_one(path: pathlib.Path):
     ctx = FileContext(path, rel, src, tree)
     kept: List[Violation] = []
     suppressed = 0
-    for mod in (rules_exc, rules_tpu, rules_res, rules_alloc):
+    for mod in (rules_exc, rules_tpu, rules_res, rules_alloc, rules_obs):
         for line, rule, message in mod.check(ctx):
             if ctx.suppressed(rule, line):
                 suppressed += 1

@@ -1,0 +1,79 @@
+"""FL-OBS — metric-name registry guard for the observability layer.
+
+``utils.trace`` keeps the central registry of every metric the package
+emits (:class:`parquet_floor_tpu.utils.trace.names`: counters, gauges,
+decisions, span stages — the table in ``docs/observability.md``).  A
+typo'd name literal (``trace.count("scan.bytes_raed", n)``) would not
+fail anything at runtime: it silently splits one metric into two and
+every dashboard/report built on the real name goes quietly wrong.
+
+**FL-OBS001** fires when a call to ``trace.count`` / ``trace.gauge_max``
+/ ``trace.decision`` / ``trace.span`` / ``trace.add`` (or the same
+methods on a ``Tracer`` object — ``tracer.…`` / ``self._tracer.…``)
+passes a string *literal* name that is not registered for that kind in
+``trace.names``.  Dynamic names (variables, f-strings) are not checked —
+the rule guards the common literal case, not reflection.
+
+Scope: package code (``parquet_floor_tpu/``) except ``utils/trace.py``
+itself (the registry's home, and the one module allowed to manipulate
+internals).  Tests and scripts may emit synthetic names freely; fixtures
+opt in via ``# floorlint: scope=FL-OBS``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Tuple
+
+from ..utils.trace import names as _names
+from .core import FileContext, dotted
+
+RULES = [
+    ("FL-OBS001",
+     "trace metric/decision/span name literals outside utils/trace.py "
+     "must come from the trace.names registry"),
+]
+
+# call attribute → (kind label, registered set).  span/add share the
+# stage namespace: add() is span accumulation without the timer.
+_KINDS = {
+    "count": ("counter", _names.COUNTERS),
+    "gauge_max": ("gauge", _names.GAUGES),
+    "decision": ("decision", _names.DECISIONS),
+    "span": ("span stage", _names.SPANS),
+    "add": ("span stage", _names.SPANS),
+}
+
+# receivers that mean "the trace module or a Tracer object"
+_RECEIVERS = {"trace", "tracer", "_tracer"}
+
+
+def check(ctx: FileContext) -> Iterator[Tuple[int, str, str]]:
+    in_package = (
+        ctx.under("parquet_floor_tpu")
+        and not ctx.is_module("utils/trace.py")
+    )
+    if not ctx.in_scope("FL-OBS", in_package):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        path = dotted(node.func)
+        if path is None:
+            continue
+        parts = path.split(".")
+        if len(parts) < 2 or parts[-1] not in _KINDS:
+            continue
+        if parts[-2] not in _RECEIVERS:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            continue  # dynamic name: out of the rule's reach
+        kind, registered = _KINDS[parts[-1]]
+        if arg.value not in registered:
+            yield (
+                node.lineno,
+                "FL-OBS001",
+                f"unregistered {kind} name {arg.value!r} — register it in "
+                "trace.names (and docs/observability.md) or fix the typo",
+            )

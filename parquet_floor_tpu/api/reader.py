@@ -1303,6 +1303,14 @@ class _ScanRowIterator:
                 raise
             raise RuntimeError("Failed to read parquet") from e
 
+    def report(self):
+        """The scan's health summary
+        (:class:`~parquet_floor_tpu.utils.trace.ScanReport`), from the
+        tracer scope the stream was created under — empty unless that
+        scope (or the global tracer) is enabled; see
+        ``docs/observability.md``."""
+        return self._scanner.report()
+
     def close(self):
         if not self._closed:
             self._closed = True

@@ -357,6 +357,8 @@ class ParquetFileReader:
                     column=ctx["column"], row_group=row_group_index,
                     page=ordinal, rows=n, error=str(err), path=path,
                 ))
+                trace.count("salvage.pages_skipped")
+                trace.count("salvage.rows_quarantined", n)
                 trace.decision("salvage.skip_page", {
                     "column": ctx["column"], "row_group": row_group_index,
                     "page": ordinal, "rows": n, "error": str(err),
@@ -661,6 +663,8 @@ class ParquetFileReader:
             column=column, row_group=index, page=None, rows=rows,
             error=str(err), path=getattr(self.source, "name", None),
         ))
+        trace.count("salvage.chunks_quarantined")
+        trace.count("salvage.rows_quarantined", rows)
         trace.decision("salvage.quarantine_chunk", {
             "column": column, "row_group": index, "rows": rows,
             "error": str(err),

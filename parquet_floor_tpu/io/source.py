@@ -137,7 +137,14 @@ class FileSource:
                     f"file of {self._size} bytes",
                     path=self.name, offset=offset,
                 )
-        return [self.read_at(o, n) for o, n in ranges]
+        if not ranges:
+            return []
+        with trace.span(
+            "io.read", sum(n for _, n in ranges),
+            attrs={"path": self.name, "ranges": len(ranges),
+                   "offset": ranges[0][0]},
+        ):
+            return [self.read_at(o, n) for o, n in ranges]
 
     def close(self) -> None:
         if self._mm is not None:
@@ -261,6 +268,9 @@ class RetryingSource:
                     with self._stat_lock:
                         self.retried_reads += 1
                         saved = self.retried_reads
+                    # the counter is the durable total (decisions ride a
+                    # bounded ring buffer and can evict under load)
+                    trace.count("io.retries", attempt)
                     trace.decision("io.retry", {
                         "path": self.name, "offset": offset,
                         "attempts": attempt + 1,
@@ -274,6 +284,8 @@ class RetryingSource:
                 if attempt < self._retries:
                     delay = self._backoff_s * (2 ** attempt)
                     self._sleep(delay * (1.0 + self._jitter * self._rng()))
+        trace.count("io.retries", self._retries)
+        trace.count("io.retry_exhausted")
         trace.decision("io.retry_exhausted", {
             "path": self.name, "offset": offset,
             "attempts": self._retries + 1, "error": str(last),

@@ -167,19 +167,26 @@ class _ByteBudget:
     ``admit`` force-admits when nothing is in flight — which is how one
     group bigger than the whole budget runs alone.  In-order admission
     is also the no-starvation argument: no later group can hold budget
-    the head of the stream is waiting for."""
+    the head of the stream is waiting for.
 
-    def __init__(self, cap: int):
+    ``tracer`` pins the gauge to the scan's own tracer scope (the scan
+    may be consumed from a context other than the one that created it —
+    metrics must not migrate with the consumer)."""
+
+    def __init__(self, cap: int, tracer: Optional[trace.Tracer] = None):
         self._cap = int(cap)
         self._used = 0
         self._lock = threading.Lock()
+        self._tracer = tracer
         self.high_water = 0
 
     def _admit_locked(self, n: int) -> None:
         self._used += n
         if self._used > self.high_water:
             self.high_water = self._used
-            trace.gauge_max("scan.inflight_bytes_max", self._used)
+            (self._tracer or trace.current()).gauge_max(
+                "scan.inflight_bytes_max", self._used
+            )
 
     def try_acquire(self, n: int) -> bool:
         with self._lock:
@@ -278,7 +285,15 @@ class DatasetScanner:
         self._options = options
         self._scan = scan or ScanOptions()
         self._predicate = predicate
-        self._budget = _ByteBudget(self._scan.prefetch_bytes)
+        # the scan is ATTRIBUTED to the tracer scope active at
+        # construction: worker tasks bind to it (Tracer.run) and the
+        # consumer-side paths re-activate it, so two scanners built
+        # under different trace.scope()s never mix metrics even when
+        # one thread interleaves their iteration
+        self._tracer = trace.current()
+        self._t0: Optional[float] = None     # first __next__ → close
+        self._wall: Optional[float] = None
+        self._budget = _ByteBudget(self._scan.prefetch_bytes, self._tracer)
         self._pool = ThreadPoolExecutor(
             max_workers=self._scan.threads, thread_name_prefix="pftpu-scan"
         )
@@ -302,7 +317,8 @@ class DatasetScanner:
         raises rather than returning None.  An empty DATASET (no
         sources) is the one None case — there is no schema to report."""
         if self._columns is None and not self._closed:
-            self._top_up()
+            with trace.using(self._tracer):
+                self._top_up()
         if self._columns is None:
             if self._deferred is not None:
                 raise self._deferred  # the first file failed to open/plan
@@ -316,7 +332,8 @@ class DatasetScanner:
         before any delivery) — the sequential dataset iterator's
         surface.  Raises on a closed or empty scan."""
         if not self._meta_by_file and not self._closed:
-            self._top_up()
+            with trace.using(self._tracer):
+                self._top_up()
         meta = self._meta_by_file.get(self._delivered_fi)
         if meta is None:
             if self._deferred is not None:
@@ -386,12 +403,22 @@ class DatasetScanner:
 
     def _run_unit(self, work: _Work):
         state = self._files[work.file_index]
+        attrs = {
+            "file": work.file_index,
+            "row_group": work.plan.group_index,
+            "path": state.cache.name,
+        }
         try:
-            loaded = state.cache.load(work.plan.extents)
+            with trace.span("read", attrs=attrs) as sp:
+                loaded = state.cache.load(work.plan.extents)
+                sp.add_bytes(loaded)
             trace.count("scan.bytes_prefetched", loaded)
-            return state.reader.read_row_group(
-                work.plan.group_index, self._filter
-            )
+            with trace.span(
+                "decode", work.plan.uncompressed_bytes, attrs=attrs
+            ):
+                return state.reader.read_row_group(
+                    work.plan.group_index, self._filter
+                )
         finally:
             state.cache.drop(work.plan.extents)
 
@@ -429,15 +456,25 @@ class DatasetScanner:
                 # budget is empty — force-admit (oversized groups run
                 # alone; the bound stays exact for everything else)
                 self._budget.admit(work.cost)
-            self._pending.append((work, self._pool.submit(self._run_unit, work)))
+            # bind the task to the scan's tracer scope: contextvars do
+            # not cross thread-pool submission on their own
+            self._pending.append((
+                work, self._pool.submit(self._tracer.run, self._run_unit, work)
+            ))
             trace.gauge_max("scan.queue_depth_max", len(self._pending))
 
     def __iter__(self):
         return self
 
     def __next__(self) -> ScanUnit:
+        with trace.using(self._tracer):
+            return self._next_unit()
+
+    def _next_unit(self) -> ScanUnit:
         if self._closed:
             raise StopIteration
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
         self._top_up()
         if not self._pending:
             err, self._deferred = self._deferred, None
@@ -468,12 +505,28 @@ class DatasetScanner:
         self._top_up()  # refill while the consumer processes the batch
         return ScanUnit(work.file_index, work.plan.group_index, batch)
 
+    def report(self) -> trace.ScanReport:
+        """The scan's :class:`~parquet_floor_tpu.utils.trace.ScanReport`,
+        built from the tracer scope the scanner was constructed under
+        (wall time runs first ``__next__`` → ``close``; mid-scan calls
+        report the elapsed time so far).  Empty when that tracer is
+        disabled — wrap the scan in ``trace.scope()`` (or enable the
+        global tracer) to collect one."""
+        wall = self._wall
+        if wall is None and self._t0 is not None:
+            wall = time.perf_counter() - self._t0
+        return self._tracer.scan_report(
+            wall_seconds=wall, budget_bytes=self._scan.prefetch_bytes
+        )
+
     def close(self) -> None:
         """Drain workers and close every open file; idempotent, safe after
         errors or mid-scan abandonment."""
         if self._closed:
             return
         self._closed = True
+        if self._t0 is not None and self._wall is None:
+            self._wall = time.perf_counter() - self._t0
         for work, fut in self._pending:
             if not fut.cancel():
                 try:
@@ -516,7 +569,8 @@ def scan_device_groups(sources: Sequence,
                        scan: Optional[ScanOptions] = None,
                        predicate=None,
                        float64_policy: str = "bits",
-                       dict_form: str = "gather"):
+                       dict_form: str = "gather",
+                       on_report=None):
     """Scan-scheduled DEVICE decode of a dataset: yields
     ``(file_index, group_index, {name: DeviceColumn})`` in order.
 
@@ -532,13 +586,24 @@ def scan_device_groups(sources: Sequence,
     delivers.  For many-thousand-file datasets, batch the source list.
     ``options.verify_crc``/``salvage`` are rejected exactly as
     ``TpuRowGroupReader`` rejects them.
+
+    ``on_report`` (a callable taking one
+    :class:`~parquet_floor_tpu.utils.trace.ScanReport`) is invoked once
+    when the scan finishes or is abandoned, with the health summary
+    built from the tracer scope active when the scan started.
     """
     from ..format.schema import dataset_schema_key
     from ..tpu.engine import TpuRowGroupReader, iter_dataset_row_groups
 
     _reject_salvage(options)
     sc = scan or ScanOptions()
-    budget = _ByteBudget(sc.prefetch_bytes)
+    # attribute the whole scan to the tracer active at generator start
+    # (worker tasks bind to it explicitly; a bare contextvar would not
+    # cross the pool's thread spawns, and the consumer may drive the
+    # generator from a different scope than the one that created it)
+    tracer = trace.current()
+    t_start = time.perf_counter()
+    budget = _ByteBudget(sc.prefetch_bytes, tracer)
     readers: List[TpuRowGroupReader] = []
     tasks: List[tuple] = []          # (reader, group_index)
     units: List[tuple] = []          # (file_index, GroupPlan, cache, cost)
@@ -579,7 +644,20 @@ def scan_device_groups(sources: Sequence,
             raise
         return tpu, cache, fplan
 
-    open_futs = [pool.submit(open_file, s) for s in sources]
+    def load_unit(cache_, gp, fi_):
+        """Prefetch one group's extents (worker thread, scope-bound):
+        the read span carries the (file, row group) attribution the
+        timeline needs to show prefetch hiding the I/O."""
+        with trace.span("read", attrs={
+            "file": fi_, "row_group": gp.group_index, "path": cache_.name,
+            "extents": len(gp.extents),
+        }) as sp:
+            n = cache_.load(gp.extents)
+            sp.add_bytes(n)
+        trace.count("scan.bytes_prefetched", n)
+        return n
+
+    open_futs = [pool.submit(tracer.run, open_file, s) for s in sources]
     try:
         schema_key = None
         try:
@@ -643,8 +721,10 @@ def scan_device_groups(sources: Sequence,
                     return
                 if not loads:
                     budget.admit(cost)  # queue empty ⇒ budget empty
-                loads.append((next_load, pool.submit(cache_.load, gp.extents)))
-                trace.gauge_max("scan.queue_depth_max", len(loads))
+                loads.append((next_load, pool.submit(
+                    tracer.run, load_unit, cache_, gp, fi_
+                )))
+                tracer.gauge_max("scan.queue_depth_max", len(loads))
                 next_load += 1
 
         pump()
@@ -653,7 +733,7 @@ def scan_device_groups(sources: Sequence,
             for i in range(len(units)):
                 t0 = time.perf_counter()
                 cols = next(groups)
-                trace.add("scan.consumer_stall", time.perf_counter() - t0)
+                tracer.add("scan.consumer_stall", time.perf_counter() - t0)
                 fi_, gp, cache_, cost = units[i]
                 ordered = {}
                 for n in sel_names:
@@ -684,3 +764,18 @@ def scan_device_groups(sources: Sequence,
         pool.shutdown(wait=True)
         for r in readers:
             r.close()
+        if on_report is not None:
+            import sys as _sys
+
+            # a raising callback must never REPLACE a scan error that is
+            # already unwinding through this finally — the report is
+            # diagnostics, the in-flight error is the diagnosis
+            unwinding = _sys.exc_info()[0] is not None
+            try:
+                on_report(tracer.scan_report(
+                    wall_seconds=time.perf_counter() - t_start,
+                    budget_bytes=sc.prefetch_bytes,
+                ))
+            except Exception:
+                if not unwinding:
+                    raise

@@ -544,5 +544,5 @@ def choose_engine(reader, purpose: str = "rows", columns=None) -> EngineChoice:
                     engine="host",
                     reason=f"cost estimate failed ({e!r}); host fallback",
                 )
-    trace.decision("engine_auto", choice.as_dict())
+    trace.decision("engine.auto", choice.as_dict())
     return choice

@@ -175,11 +175,16 @@ class DeviceColumn:
 
     def assemble(self, schema):
         """Assemble a repeated column into a host ``NestedColumn``."""
+        if self.rep_levels is None:
+            raise ValueError("assemble() requires a repeated column")
+        with trace.span("assemble",
+                        attrs={"column": ".".join(self.descriptor.path)}):
+            return self._assemble(schema)
+
+    def _assemble(self, schema):
         from ..batch.columns import ColumnBatch
         from ..batch.nested import assemble_nested
 
-        if self.rep_levels is None:
-            raise ValueError("assemble() requires a repeated column")
         defs = np.asarray(self.def_levels).astype(np.uint32)
         reps = np.asarray(self.rep_levels).astype(np.uint32)
         nn = int(np.count_nonzero(defs == self.descriptor.max_definition_level))
@@ -489,6 +494,8 @@ class _StagedGroup:
     parts: Optional[tuple] = None      # arena chunks already on device
     host_pools: Optional[dict] = None  # spec name → typed numpy pool
     #                                    (index-form numeric dictionaries)
+    source: Optional[str] = None       # trace attribution: file path …
+    group_index: int = -1              # … and row-group index
 
 
 # ---------------------------------------------------------------------------
@@ -2173,10 +2180,14 @@ class TpuRowGroupReader:
 
     def _stage_row_group(self, index: int, columns, covered=None,
                          group_rows: int = 0, chunked=None) -> _StagedGroup:
-        with trace.span("stage"):
-            return self._stage_row_group_untraced(
+        src = getattr(self.reader.source, "name", None)
+        with trace.span("stage", attrs={"file": src, "row_group": index}):
+            sg = self._stage_row_group_untraced(
                 index, columns, covered, group_rows, chunked=chunked
             )
+        sg.source = src
+        sg.group_index = index
+        return sg
 
     def _stage_row_group_untraced(self, index: int, columns, covered=None,
                                   group_rows: int = 0, chunked=None
@@ -2396,7 +2407,9 @@ class TpuRowGroupReader:
         for _, rows, lens in extras:
             ship.append(rows)
             ship.append(lens)
-        with trace.span("ship", sum(int(a.nbytes) for a in ship)):
+        with trace.span("ship", sum(int(a.nbytes) for a in ship),
+                        attrs={"file": sg.source,
+                               "row_group": sg.group_index}):
             shipped = jax.device_put(ship, self.device)
             if self.sync_transfers:
                 jax.block_until_ready(shipped)
@@ -2424,7 +2437,9 @@ class TpuRowGroupReader:
             rows_d, lens_d = self._sdict_dev[key]
             extra_args.append(rows_d)
             extra_args.append(lens_d)
-        with trace.span("decode"):
+        with trace.span("decode", attrs={"file": sg.source,
+                                         "row_group": sg.group_index,
+                                         "rows": sg.num_rows}):
             outs = _decode_fused(
                 sg.program, len(parts), *parts, slab_dev, *extra_args
             )
@@ -2531,6 +2546,10 @@ def _iter_pipeline(tasks, columns, prefetch: bool):
         _os.environ.get("PFTPU_PREFETCH_DEPTH", "3" if multi_file else "2")
     ))
     n = len(tasks)
+    # stage/ship tasks bind to the caller's tracer scope: concurrent
+    # scans under separate trace.scope()s keep their stage‖ship spans
+    # attributed even though each scan spawns its own worker threads
+    tracer = trace.current()
     with ThreadPoolExecutor(max_workers=1,
                             thread_name_prefix="pftpu-stage") as sp, \
             ThreadPoolExecutor(max_workers=1,
@@ -2546,8 +2565,10 @@ def _iter_pipeline(tasks, columns, prefetch: bool):
 
         def submit(j):
             r, i = tasks[j]
-            f = sp.submit(r._stage_row_group, i, columns, chunked=False)
-            ship_q.append(shp.submit(ship_task, r, f))
+            f = sp.submit(
+                tracer.run, r._stage_row_group, i, columns, chunked=False
+            )
+            ship_q.append(shp.submit(tracer.run, ship_task, r, f))
 
         for j in range(min(DEPTH, n)):
             submit(j)

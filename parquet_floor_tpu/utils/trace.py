@@ -1,33 +1,104 @@
-"""Per-stage tracing/metrics — the observability subsystem SURVEY.md §5
+"""Scoped tracing/metrics — the observability subsystem SURVEY.md §5
 prescribes for the new framework (the reference has none: its only output
 is ``e.printStackTrace()`` in shims, ``FSDataInputStream.java:26,35,43``).
 
-Three layers, all zero-cost when disabled:
+Everything lives on a :class:`Tracer`.  The module-level functions
+(``span``/``count``/``gauge_max``/``decision``/…) delegate to the
+**active** tracer: the process-global one by default (enable with
+``PFTPU_TRACE=1`` or ``trace.enable()`` — every pre-existing call site
+keeps working), or an isolated one inside ``with trace.scope() as t:``.
+The scope rides a ``contextvars.ContextVar``, and the scan executor /
+TPU engine worker pools bind each task to the scope that submitted it
+(``Tracer.run``), so two concurrent ``DatasetScanner``\\ s or device
+scans get correctly attributed, non-interfering metrics.
 
-* ``span(stage)`` — context manager accumulating wall time + byte counts
-  per stage name (read / stage / ship / decode / assemble).
-* ``count(name, n)`` / ``gauge_max(name, v)`` — plain integer counters
-  (additive) and high-water gauges, for subsystems whose health is a
-  number rather than a duration (the scan scheduler's extents planned /
-  bytes over-read / prefetch queue depth live here).
-* ``stats()`` / ``counters()`` / ``report()`` — snapshot (thread-safe).
-* ``device_trace(dir)`` — wraps ``jax.profiler.trace`` so the device side
-  of a decode shows up in TensorBoard/Perfetto alongside the host spans.
+Four layers, all zero-cost when the active tracer is disabled (the no-op
+path allocates nothing and takes no lock):
 
-Enable with ``PFTPU_TRACE=1`` or ``trace.enable()``.
+* ``span(stage, nbytes, attrs)`` — context manager accumulating wall
+  time + byte counts per stage name (read / stage / ship / decode /
+  assemble / io.read / scan.consumer_stall), and appending begin/end
+  events with thread id + structured attrs (file, row group, column,
+  extent offset, retry attempt) to the bounded raw-event timeline.
+* ``count(name, n)`` / ``gauge_max(name, v)`` — additive integer
+  counters and high-water gauges; snapshots are namespaced
+  (``counters()`` / ``gauges()``, merged compat view in ``metrics()``).
+* ``decision(name, detail)`` — bounded log of routing/policy decisions
+  (cap configurable per tracer; evictions bump
+  ``trace.decisions_dropped`` — no silent caps), mirrored as instant
+  events on the timeline.
+* ``export_chrome_trace(path)`` — the timeline as Chrome/Perfetto
+  trace-event JSON, so the host-side read‖stage‖ship‖decode overlap is
+  visible next to ``device_trace``'s XLA capture; ``scan_report()``
+  distills the same snapshot into a :class:`ScanReport` health summary,
+  and ``report()`` renders everything for humans.
+
+Metric names used by the package are registered in :class:`names`;
+floorlint rule FL-OBS001 rejects unregistered literals (typo'd metric
+names fail the lint gate).  Docs: ``docs/observability.md``.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import json
 import os
 import threading
 import time
-from dataclasses import dataclass
-from typing import Dict, Iterator
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
 
-_enabled = os.environ.get("PFTPU_TRACE", "0") == "1"
-_lock = threading.Lock()
+
+class names:
+    """Central metric-name registry: every counter, gauge, decision, and
+    span stage the package emits, in one place (the table in
+    ``docs/observability.md`` documents each).  floorlint FL-OBS001
+    checks ``trace.count/gauge_max/decision/span/add`` string literals in
+    package code against these sets — a typo'd name fails the lint gate
+    instead of silently splitting a metric in two."""
+
+    COUNTERS = frozenset({
+        "scan.ranges_planned",
+        "scan.extents_planned",
+        "scan.bytes_read",
+        "scan.bytes_used",
+        "scan.overread_bytes",
+        "scan.bytes_prefetched",
+        "scan.cache_miss_bytes",
+        "io.retries",
+        "io.retry_exhausted",
+        "salvage.pages_skipped",
+        "salvage.chunks_quarantined",
+        "salvage.rows_quarantined",
+        "trace.decisions_dropped",
+        "trace.events_dropped",
+    })
+    GAUGES = frozenset({
+        "scan.inflight_bytes_max",
+        "scan.queue_depth_max",
+    })
+    DECISIONS = frozenset({
+        "engine.auto",
+        "chunk_fallback",
+        "io.retry",
+        "io.retry_exhausted",
+        "salvage.report",
+        "salvage.skip_page",
+        "salvage.quarantine_chunk",
+        "scan.plan",
+    })
+    SPANS = frozenset({
+        "read",
+        "stage",
+        "ship",
+        "decode",
+        "assemble",
+        "io.read",
+        "scan.consumer_stall",
+    })
+    ALL = COUNTERS | GAUGES | DECISIONS | SPANS
 
 
 @dataclass
@@ -46,126 +117,603 @@ class StageStat:
         }
 
 
-_stats: Dict[str, StageStat] = {}
-_decisions: list = []  # bounded log of routing/policy decisions
-_counters: Dict[str, int] = {}   # additive integer counters
-_gauges: Dict[str, int] = {}     # high-water gauges (max ever seen)
+class _NullSpan:
+    """The disabled-path span: one immortal, attribute-free instance —
+    entering/exiting it allocates nothing and takes no lock."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def add_bytes(self, n: int) -> None:
+        pass
 
 
-def enable() -> None:
-    global _enabled
-    _enabled = True
+_NULL_SPAN = _NullSpan()
 
 
-def disable() -> None:
-    global _enabled
-    _enabled = False
+class _Span:
+    """One live timed span: records a begin event on ``__enter__`` and a
+    matching end event + stage accumulation on ``__exit__`` (same thread
+    by construction — it is a ``with`` block)."""
+
+    __slots__ = ("_tracer", "_stage", "_nbytes", "_attrs", "_t0")
+
+    def __init__(self, tracer: "Tracer", stage: str, nbytes: int,
+                 attrs: Optional[dict]):
+        self._tracer = tracer
+        self._stage = stage
+        self._nbytes = nbytes
+        self._attrs = attrs
+
+    def add_bytes(self, n: int) -> None:
+        """Attribute ``n`` more bytes to this span (for byte counts only
+        known after the work — e.g. how much a prefetch load fetched)."""
+        self._nbytes += int(n)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._tracer._event("B", self._stage, self._t0, self._attrs)
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        self._tracer.add(self._stage, t1 - self._t0, self._nbytes)
+        self._tracer._event("E", self._stage, t1, None)
+        return False
 
 
-def enabled() -> bool:
-    return _enabled
+@dataclass
+class ScanReport:
+    """Consumable health summary of one scan (or any traced region),
+    distilled from a tracer snapshot: per-stage throughput, overlap /
+    stall fraction, budget utilization, over-read ratio, retries, and
+    quarantines.  ``DatasetScanner.report()`` / ``scan_device_groups``'s
+    ``on_report`` build one per scan; ``bench.py`` writes it into the
+    bench JSON; ``render()`` (and ``trace.report()``) print it."""
 
+    wall_seconds: Optional[float]
+    stages: Dict[str, dict]
+    consumer_stall_seconds: float
+    stall_fraction: Optional[float]      # stall / wall (needs wall)
+    overlap_fraction: Optional[float]    # 1 - stall_fraction
+    budget_bytes: Optional[int]
+    budget_utilization: Optional[float]  # inflight high-water / budget
+    bytes_read: int
+    bytes_used: int
+    overread_ratio: float                # (read - used) / read
+    bytes_prefetched: int
+    cache_miss_bytes: int
+    retries: int
+    retry_exhausted: int
+    pages_quarantined: int
+    chunks_quarantined: int
+    decisions_dropped: int
+    events_dropped: int
+    counters: Dict[str, int] = field(default_factory=dict)
+    gauges: Dict[str, int] = field(default_factory=dict)
 
-def reset() -> None:
-    with _lock:
-        _stats.clear()
-        _decisions.clear()
-        _counters.clear()
-        _gauges.clear()
-
-
-def count(name: str, n: int = 1) -> None:
-    """Add ``n`` to the additive counter ``name`` (no-op when disabled)."""
-    if not _enabled:
-        return
-    with _lock:
-        _counters[name] = _counters.get(name, 0) + int(n)
-
-
-def gauge_max(name: str, value: int) -> None:
-    """Raise the high-water gauge ``name`` to at least ``value`` (no-op
-    when disabled).  Gauges record peaks — e.g. the deepest a prefetch
-    queue ever got — where an additive counter would be meaningless."""
-    if not _enabled:
-        return
-    v = int(value)
-    with _lock:
-        if v > _gauges.get(name, -(1 << 62)):
-            _gauges[name] = v
-
-
-def counters() -> Dict[str, int]:
-    """Snapshot of additive counters and high-water gauges (gauges appear
-    under their own name; names are disjoint by convention —
-    ``scan.queue_depth_max`` vs ``scan.extents_planned``)."""
-    with _lock:
-        out = dict(_counters)
-        out.update(_gauges)
+    def as_dict(self) -> dict:
+        out = {
+            "wall_seconds": (
+                round(self.wall_seconds, 6)
+                if self.wall_seconds is not None else None
+            ),
+            "stages": self.stages,
+            "consumer_stall_seconds": round(self.consumer_stall_seconds, 6),
+            "stall_fraction": self.stall_fraction,
+            "overlap_fraction": self.overlap_fraction,
+            "budget_bytes": self.budget_bytes,
+            "budget_utilization": self.budget_utilization,
+            "bytes_read": self.bytes_read,
+            "bytes_used": self.bytes_used,
+            "overread_ratio": self.overread_ratio,
+            "bytes_prefetched": self.bytes_prefetched,
+            "cache_miss_bytes": self.cache_miss_bytes,
+            "retries": self.retries,
+            "retry_exhausted": self.retry_exhausted,
+            "pages_quarantined": self.pages_quarantined,
+            "chunks_quarantined": self.chunks_quarantined,
+            "decisions_dropped": self.decisions_dropped,
+            "events_dropped": self.events_dropped,
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+        }
         return out
 
+    def render(self) -> str:
+        lines = ["scan health:"]
 
-def decision(name: str, detail: dict) -> None:
-    """Record a policy decision (e.g. engine="auto" routing) so consumers
-    can see WHY a path was taken.  No-op when disabled; bounded."""
-    if not _enabled:
-        return
-    with _lock:
-        if len(_decisions) >= 64:
-            _decisions.pop(0)
-        _decisions.append({"decision": name, **detail})
+        def pct(v):
+            return "n/a" if v is None else f"{v * 100.0:.1f}%"
+
+        if self.wall_seconds is not None:
+            lines.append(f"  wall              {self.wall_seconds * 1e3:.1f} ms")
+        lines.append(
+            f"  consumer stall    {self.consumer_stall_seconds * 1e3:.1f} ms"
+            f"  (stall {pct(self.stall_fraction)},"
+            f" overlap {pct(self.overlap_fraction)})"
+        )
+        if self.budget_bytes:
+            lines.append(
+                f"  budget            {self.budget_bytes} B,"
+                f" utilization {pct(self.budget_utilization)}"
+            )
+        lines.append(
+            f"  bytes read/used   {self.bytes_read}/{self.bytes_used}"
+            f"  (over-read {pct(self.overread_ratio)})"
+        )
+        if self.cache_miss_bytes:
+            lines.append(f"  cache misses      {self.cache_miss_bytes} B")
+        lines.append(
+            f"  retries           {self.retries}"
+            f" (exhausted {self.retry_exhausted})"
+        )
+        if self.pages_quarantined or self.chunks_quarantined:
+            lines.append(
+                f"  quarantined       {self.pages_quarantined} page(s),"
+                f" {self.chunks_quarantined} chunk(s)"
+            )
+        if self.decisions_dropped or self.events_dropped:
+            lines.append(
+                f"  trace evictions   {self.decisions_dropped} decision(s),"
+                f" {self.events_dropped} event(s) dropped"
+            )
+        for name, st in sorted(self.stages.items()):
+            lines.append(
+                f"  {name:<16} n={st['count']:<6}"
+                f" {st['seconds'] * 1e3:9.1f} ms"
+                + (f"  {st['MB_per_s']:8.1f} MB/s" if st["bytes"] else "")
+            )
+        return "\n".join(lines)
 
 
-def decisions() -> list:
-    """Snapshot of recorded policy decisions (most recent last)."""
-    with _lock:
-        return list(_decisions)
+class Tracer:
+    """One isolated metrics/timeline store.  Thread-safe; every method is
+    a no-op while disabled.  ``max_decisions``/``max_events`` bound the
+    two append-only stores — evictions are COUNTED
+    (``trace.decisions_dropped`` / ``trace.events_dropped``), never
+    silent."""
+
+    def __init__(self, enabled: bool = False, max_decisions: int = 64,
+                 max_events: int = 1 << 16):
+        if max_decisions < 1:
+            raise ValueError(f"max_decisions must be >= 1, got {max_decisions}")
+        if max_events < 2:
+            raise ValueError(f"max_events must be >= 2, got {max_events}")
+        self._enabled = bool(enabled)
+        self.max_decisions = int(max_decisions)
+        self.max_events = int(max_events)
+        self._lock = threading.Lock()
+        self._stats: Dict[str, StageStat] = {}
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, int] = {}
+        self._decisions: deque = deque()
+        self._events: deque = deque()   # (ph, name, ts, tid, attrs)
+        self._thread_names: Dict[int, str] = {}
+        self._epoch = time.perf_counter()
+
+    # -- switches -----------------------------------------------------------
+
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+            self._counters.clear()
+            self._gauges.clear()
+            self._decisions.clear()
+            self._events.clear()
+            self._thread_names.clear()
+            self._epoch = time.perf_counter()
+
+    # -- scope plumbing -----------------------------------------------------
+
+    def run(self, fn, *args, **kwargs):
+        """Call ``fn(*args, **kwargs)`` with THIS tracer active — how the
+        scan executor / engine pools carry the submitting scope onto
+        their worker threads (contextvars do not cross thread spawns on
+        their own)."""
+        token = _active.set(self)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _active.reset(token)
+
+    # -- counters / gauges --------------------------------------------------
+
+    def count(self, name: str, n: int = 1) -> None:
+        """Add ``n`` to the additive counter ``name`` (no-op when
+        disabled)."""
+        if not self._enabled:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + int(n)
+
+    def gauge_max(self, name: str, value: int) -> None:
+        """Raise the high-water gauge ``name`` to at least ``value``
+        (no-op when disabled).  Gauges record peaks — e.g. the deepest a
+        prefetch queue ever got — where an additive counter would be
+        meaningless."""
+        if not self._enabled:
+            return
+        v = int(value)
+        with self._lock:
+            if v > self._gauges.get(name, -(1 << 62)):
+                self._gauges[name] = v
+
+    def counters(self) -> Dict[str, int]:
+        """Snapshot of the ADDITIVE counters only (gauges live in
+        :meth:`gauges`; :meth:`metrics` is the merged compat view)."""
+        with self._lock:
+            return dict(self._counters)
+
+    def gauges(self) -> Dict[str, int]:
+        """Snapshot of the high-water gauges only."""
+        with self._lock:
+            return dict(self._gauges)
+
+    def metrics(self) -> Dict[str, int]:
+        """Merged counters+gauges snapshot — the pre-scope ``counters()``
+        shape, kept for consumers that want one flat mapping.  Names are
+        disjoint by construction (:class:`names` keeps the two sets
+        apart; FL-OBS001 enforces it)."""
+        with self._lock:
+            out = dict(self._counters)
+            out.update(self._gauges)
+            return out
+
+    # -- decisions ----------------------------------------------------------
+
+    def decision(self, name: str, detail: dict) -> None:
+        """Record a policy decision (e.g. engine="auto" routing) so
+        consumers can see WHY a path was taken.  No-op when disabled.
+        Bounded at ``max_decisions``: evicting the oldest entry bumps
+        ``trace.decisions_dropped`` (the "no silent caps" rule) — totals
+        that must survive eviction belong in counters (e.g.
+        ``io.retries``)."""
+        if not self._enabled:
+            return
+        ts = time.perf_counter()
+        with self._lock:
+            if len(self._decisions) >= self.max_decisions:
+                self._decisions.popleft()
+                self._counters["trace.decisions_dropped"] = (
+                    self._counters.get("trace.decisions_dropped", 0) + 1
+                )
+            self._decisions.append({"decision": name, **detail})
+            self._event_locked("i", name, ts, detail)
+
+    def decisions(self) -> list:
+        """Snapshot of recorded policy decisions (most recent last)."""
+        with self._lock:
+            return list(self._decisions)
+
+    # -- spans / stats ------------------------------------------------------
+
+    def add(self, stage: str, seconds: float, nbytes: int = 0) -> None:
+        if not self._enabled:
+            return
+        with self._lock:
+            st = self._stats.get(stage)
+            if st is None:
+                st = self._stats[stage] = StageStat()
+            st.count += 1
+            st.seconds += seconds
+            st.bytes += nbytes
+
+    def span(self, stage: str, nbytes: int = 0,
+             attrs: Optional[dict] = None):
+        """One timed span under ``stage``: accumulates into
+        :meth:`stats` and appends begin/end events (thread id + ``attrs``)
+        to the timeline.  Returns the shared no-op span when disabled."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return _Span(self, stage, nbytes, attrs)
+
+    def stats(self) -> Dict[str, dict]:
+        """Snapshot of all stage accumulators."""
+        with self._lock:
+            return {k: v.as_dict() for k, v in sorted(self._stats.items())}
+
+    # -- raw-event timeline -------------------------------------------------
+
+    def _event(self, ph: str, name: str, ts: float,
+               attrs: Optional[dict]) -> None:
+        if not self._enabled:
+            return
+        with self._lock:
+            self._event_locked(ph, name, ts, attrs)
+
+    def _event_locked(self, ph: str, name: str, ts: float,
+                      attrs: Optional[dict]) -> None:
+        t = threading.current_thread()
+        tid = t.ident or 0
+        if tid not in self._thread_names:
+            self._thread_names[tid] = t.name
+        if len(self._events) >= self.max_events:
+            self._events.popleft()
+            self._counters["trace.events_dropped"] = (
+                self._counters.get("trace.events_dropped", 0) + 1
+            )
+        self._events.append((ph, name, ts, tid, attrs))
+
+    def events(self) -> list:
+        """Snapshot of the raw timeline: ``(ph, name, ts, tid, attrs)``
+        tuples in record order (``ph``: "B" span begin, "E" span end,
+        "i" instant/decision; ``ts`` in ``time.perf_counter`` seconds)."""
+        with self._lock:
+            return list(self._events)
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the timeline as Chrome/Perfetto trace-event JSON
+        (``chrome://tracing`` / https://ui.perfetto.dev) and return the
+        number of events written.
+
+        Emits duration ("B"/"E") pairs per thread plus instant ("i")
+        events for decisions, with ``ts`` in microseconds since the
+        tracer epoch.  Pairs are balanced per thread on the way out:
+        orphaned ends (their begin was evicted from the bounded buffer)
+        are dropped, and spans still open at export get a synthetic end
+        at the last seen timestamp — a Perfetto load never sees a
+        mismatched stack."""
+        with self._lock:
+            events = list(self._events)
+            tnames = dict(self._thread_names)
+        # record order is lock order, which can lag the timestamps taken
+        # just before the lock on a contended tracer — a stable sort by
+        # ts makes the output monotonic while preserving each thread's
+        # relative order (per-thread timestamps are non-decreasing, so
+        # B/E nesting survives the sort)
+        events.sort(key=lambda e: e[2])
+        pid = os.getpid()
+        out: List[dict] = []
+        for tid, tname in sorted(tnames.items()):
+            out.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": tname},
+            })
+        depth: Dict[int, list] = {}
+        last_ts = self._epoch
+        for ph, name, ts, tid, attrs in events:
+            last_ts = max(last_ts, ts)
+            us = round((ts - self._epoch) * 1e6, 3)
+            if ph == "B":
+                depth.setdefault(tid, []).append(name)
+            elif ph == "E":
+                stack = depth.get(tid)
+                if not stack:
+                    continue  # begin evicted: skip the orphaned end
+                stack.pop()
+            ev = {"name": name, "ph": ph, "ts": us, "pid": pid, "tid": tid}
+            if ph != "E":
+                ev["cat"] = "pftpu"
+                if ph == "i":
+                    ev["s"] = "t"
+                if attrs:
+                    ev["args"] = dict(attrs)
+            out.append(ev)
+        end_us = round((last_ts - self._epoch) * 1e6, 3)
+        for tid, stack in depth.items():
+            for name in reversed(stack):  # still-open spans: close them
+                out.append({
+                    "name": name, "ph": "E", "ts": end_us,
+                    "pid": pid, "tid": tid,
+                })
+        payload = {"traceEvents": out, "displayTimeUnit": "ms"}
+        with open(path, "w") as fh:
+            fh.write(json.dumps(payload))
+        return len(out)
+
+    # -- health summary -----------------------------------------------------
+
+    def scan_report(self, wall_seconds: Optional[float] = None,
+                    budget_bytes: Optional[int] = None) -> ScanReport:
+        """Distill the current snapshot into a :class:`ScanReport`.
+        ``wall_seconds`` (scan start → finish) turns the consumer-stall
+        total into stall/overlap fractions; ``budget_bytes`` (the scan's
+        ``prefetch_bytes``) turns the in-flight high-water into a budget
+        utilization."""
+        stats = self.stats()
+        counters = self.counters()
+        gauges = self.gauges()
+        stall = stats.get("scan.consumer_stall", {}).get("seconds", 0.0)
+        stall_frac = overlap = None
+        if wall_seconds is not None and wall_seconds > 0:
+            stall_frac = round(min(stall / wall_seconds, 1.0), 4)
+            overlap = round(1.0 - stall_frac, 4)
+        util = None
+        if budget_bytes:
+            util = round(
+                gauges.get("scan.inflight_bytes_max", 0) / budget_bytes, 4
+            )
+        read = counters.get("scan.bytes_read", 0)
+        used = counters.get("scan.bytes_used", 0)
+        return ScanReport(
+            wall_seconds=wall_seconds,
+            stages=stats,
+            consumer_stall_seconds=stall,
+            stall_fraction=stall_frac,
+            overlap_fraction=overlap,
+            budget_bytes=budget_bytes,
+            budget_utilization=util,
+            bytes_read=read,
+            bytes_used=used,
+            overread_ratio=round((read - used) / read, 4) if read else 0.0,
+            bytes_prefetched=counters.get("scan.bytes_prefetched", 0),
+            cache_miss_bytes=counters.get("scan.cache_miss_bytes", 0),
+            retries=counters.get("io.retries", 0),
+            retry_exhausted=counters.get("io.retry_exhausted", 0),
+            pages_quarantined=counters.get("salvage.pages_skipped", 0),
+            chunks_quarantined=counters.get("salvage.chunks_quarantined", 0),
+            decisions_dropped=counters.get("trace.decisions_dropped", 0),
+            events_dropped=counters.get("trace.events_dropped", 0),
+            counters=counters,
+            gauges=gauges,
+        )
+
+    def report(self) -> str:
+        """Human-readable report: one line per stage, counters, gauges
+        (labelled ``max=`` — they are peaks, not totals), decisions, and
+        — when scan counters are present — the :class:`ScanReport`
+        health block."""
+        lines = []
+        for name, st in self.stats().items():
+            lines.append(
+                f"{name:<12} n={st['count']:<6} {st['seconds']*1e3:9.1f} ms"
+                + (f"  {st['MB_per_s']:8.1f} MB/s" if st["bytes"] else "")
+            )
+        for name, v in sorted(self.counters().items()):
+            lines.append(f"{name:<32} {v}")
+        for name, v in sorted(self.gauges().items()):
+            lines.append(f"{name:<32} max={v}")
+        for d in self.decisions():
+            kv = " ".join(f"{k}={v}" for k, v in d.items() if k != "decision")
+            lines.append(f"[{d['decision']}] {kv}")
+        if any(k.startswith("scan.") for k in self.metrics()):
+            lines.append(self.scan_report().render())
+        return "\n".join(lines) or "(no spans recorded — is tracing enabled?)"
 
 
-def add(stage: str, seconds: float, nbytes: int = 0) -> None:
-    if not _enabled:
-        return
-    with _lock:
-        st = _stats.get(stage)
-        if st is None:
-            st = _stats[stage] = StageStat()
-        st.count += 1
-        st.seconds += seconds
-        st.bytes += nbytes
+# ---------------------------------------------------------------------------
+# The active-tracer scope
+# ---------------------------------------------------------------------------
+
+_global = Tracer(enabled=os.environ.get("PFTPU_TRACE", "0") == "1")
+_active: contextvars.ContextVar = contextvars.ContextVar(
+    "pftpu_tracer", default=None
+)
+
+
+def current() -> Tracer:
+    """The tracer module-level calls delegate to: the innermost
+    ``scope()`` on this thread's context, else the process-global one."""
+    t = _active.get()
+    return _global if t is None else t
 
 
 @contextlib.contextmanager
-def span(stage: str, nbytes: int = 0) -> Iterator[None]:
-    """Accumulate one timed span under ``stage`` (no-op when disabled)."""
-    if not _enabled:
-        yield
-        return
-    t0 = time.perf_counter()
+def using(tracer: Tracer) -> Iterator[Tracer]:
+    """Activate an existing tracer for the dynamic extent of the block
+    (what :func:`scope` does, minus creating the tracer)."""
+    token = _active.set(tracer)
     try:
-        yield
+        yield tracer
     finally:
-        add(stage, time.perf_counter() - t0, nbytes)
+        _active.reset(token)
+
+
+@contextlib.contextmanager
+def scope(max_decisions: int = 64,
+          max_events: int = 1 << 16) -> Iterator[Tracer]:
+    """Run the block under a fresh, ENABLED, isolated tracer::
+
+        with trace.scope() as t:
+            for unit in DatasetScanner(paths):
+                ...
+        t.export_chrome_trace("scan.json")
+        print(t.report())
+
+    Module-level ``span``/``count``/… inside the block (and inside any
+    worker task the scan executor / engine submit from it) land on ``t``
+    instead of the process-global tracer, so concurrent scans under
+    separate scopes never mix their metrics."""
+    with using(Tracer(enabled=True, max_decisions=max_decisions,
+                      max_events=max_events)) as t:
+        yield t
+
+
+# ---------------------------------------------------------------------------
+# Module-level delegates (the stable call-site surface)
+# ---------------------------------------------------------------------------
+
+def enable() -> None:
+    current().enable()
+
+
+def disable() -> None:
+    current().disable()
+
+
+def enabled() -> bool:
+    return current().enabled()
+
+
+def reset() -> None:
+    current().reset()
+
+
+def count(name: str, n: int = 1) -> None:
+    t = _active.get()
+    (_global if t is None else t).count(name, n)
+
+
+def gauge_max(name: str, value: int) -> None:
+    t = _active.get()
+    (_global if t is None else t).gauge_max(name, value)
+
+
+def counters() -> Dict[str, int]:
+    return current().counters()
+
+
+def gauges() -> Dict[str, int]:
+    return current().gauges()
+
+
+def metrics() -> Dict[str, int]:
+    return current().metrics()
+
+
+def decision(name: str, detail: dict) -> None:
+    t = _active.get()
+    (_global if t is None else t).decision(name, detail)
+
+
+def decisions() -> list:
+    return current().decisions()
+
+
+def add(stage: str, seconds: float, nbytes: int = 0) -> None:
+    t = _active.get()
+    (_global if t is None else t).add(stage, seconds, nbytes)
+
+
+def span(stage: str, nbytes: int = 0, attrs: Optional[dict] = None):
+    t = _active.get()
+    return (_global if t is None else t).span(stage, nbytes, attrs)
 
 
 def stats() -> Dict[str, dict]:
-    """Snapshot of all stage counters."""
-    with _lock:
-        return {k: v.as_dict() for k, v in sorted(_stats.items())}
+    return current().stats()
+
+
+def events() -> list:
+    return current().events()
+
+
+def export_chrome_trace(path: str) -> int:
+    return current().export_chrome_trace(path)
+
+
+def scan_report(wall_seconds: Optional[float] = None,
+                budget_bytes: Optional[int] = None) -> ScanReport:
+    return current().scan_report(wall_seconds, budget_bytes)
 
 
 def report() -> str:
-    """Human-readable one-line-per-stage report (+ recorded decisions)."""
-    lines = []
-    for name, st in stats().items():
-        lines.append(
-            f"{name:<12} n={st['count']:<6} {st['seconds']*1e3:9.1f} ms"
-            + (f"  {st['MB_per_s']:8.1f} MB/s" if st["bytes"] else "")
-        )
-    for name, v in sorted(counters().items()):
-        lines.append(f"{name:<32} {v}")
-    for d in decisions():
-        kv = " ".join(f"{k}={v}" for k, v in d.items() if k != "decision")
-        lines.append(f"[{d['decision']}] {kv}")
-    return "\n".join(lines) or "(no spans recorded — is tracing enabled?)"
+    return current().report()
 
 
 @contextlib.contextmanager

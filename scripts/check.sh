@@ -22,8 +22,17 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" | tr -cd .
 # Fast bench smoke: every leg of bench.py (headline decode, batch face,
 # chunked, multi-file scan) runs at toy scale on the CPU backend, so a
 # broken decode path fails THIS gate instead of only the nightly bench.
-# The numbers are health indicators, not perf records.
-echo "== bench smoke (PFTPU_BENCH_ROWS=2000) =="
-timeout -k 10 600 env JAX_PLATFORMS=cpu PFTPU_BENCH_ROWS=2000 \
-  PFTPU_BENCH_REPS=1 python bench.py || exit 1
+# The numbers are health indicators, not perf records.  Tracing is ON
+# (PFTPU_TRACE=1) and the scan leg exports its ScanReport + Chrome
+# trace, which check_bench_report.py then validates — a broken
+# observability export fails the gate too (docs/observability.md).
+echo "== bench smoke (PFTPU_BENCH_ROWS=2000, PFTPU_TRACE=1) =="
+bench_log="$(mktemp /tmp/_bench.XXXXXX.log)"
+bench_trace="$(mktemp /tmp/_btrace.XXXXXX.json)"
+trap 'rm -f "$t1_log" "$bench_log" "$bench_trace"' EXIT
+timeout -k 10 600 env JAX_PLATFORMS=cpu PFTPU_TRACE=1 PFTPU_BENCH_ROWS=2000 \
+  PFTPU_BENCH_REPS=1 PFTPU_TRACE_EXPORT="$bench_trace" python bench.py \
+  | tee "$bench_log"
+[ "${PIPESTATUS[0]}" -eq 0 ] || exit 1
+python scripts/check_bench_report.py "$bench_log" "$bench_trace" || exit 1
 exit 0

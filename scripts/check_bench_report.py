@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Commit-gate validator for the bench smoke's observability artifacts.
+
+``scripts/check.sh`` runs the bench smoke with ``PFTPU_TRACE=1`` and
+``PFTPU_TRACE_EXPORT=<path>``; this script then asserts the exported
+report actually parses:
+
+1. the bench stdout's JSON line carries a well-formed
+   ``detail.scan_report`` (the :class:`ScanReport` health summary), and
+2. the Chrome-trace export is loadable trace-event JSON with balanced,
+   thread-consistent B/E pairs covering the scan pipeline stages.
+
+Exit 0 when both hold, 1 with a diagnostic otherwise — a broken export
+fails the commit gate, not the nightly bench.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPORT_KEYS = (
+    "stages", "consumer_stall_seconds", "overlap_fraction",
+    "budget_utilization", "bytes_read", "bytes_used", "overread_ratio",
+    "retries", "retry_exhausted", "counters", "gauges",
+)
+SPAN_NAMES = {"read", "stage", "ship", "decode"}
+
+
+def fail(msg: str) -> int:
+    print(f"check_bench_report: {msg}", file=sys.stderr)
+    return 1
+
+
+def check_report(bench_log: pathlib.Path) -> int:
+    lines = [
+        line for line in bench_log.read_text().splitlines()
+        if line.startswith("{")
+    ]
+    if not lines:
+        return fail(f"no JSON line in bench output {bench_log}")
+    try:
+        result = json.loads(lines[-1])
+    except ValueError as e:
+        return fail(f"bench JSON does not parse: {e}")
+    rep = result.get("detail", {}).get("scan_report")
+    if not isinstance(rep, dict):
+        return fail("bench detail carries no scan_report")
+    missing = [k for k in REPORT_KEYS if k not in rep]
+    if missing:
+        return fail(f"scan_report missing keys: {missing}")
+    if not rep["bytes_read"] > 0:
+        return fail("scan_report.bytes_read is not positive")
+    if not rep["stages"]:
+        return fail("scan_report.stages is empty")
+    print(f"check_bench_report: scan_report ok ({len(rep['stages'])} stages, "
+          f"{rep['bytes_read']} bytes read)")
+    return 0
+
+
+def check_chrome_trace(trace_path: pathlib.Path) -> int:
+    try:
+        data = json.loads(trace_path.read_text())
+    except (OSError, ValueError) as e:
+        return fail(f"chrome trace does not parse: {e}")
+    events = data.get("traceEvents")
+    if not events:
+        return fail("chrome trace has no traceEvents")
+    stacks = {}
+    seen = set()
+    last_ts = None
+    for ev in events:
+        if ev["ph"] == "M":
+            continue
+        if last_ts is not None and ev["ts"] < last_ts:
+            return fail("chrome trace timestamps are not monotonic")
+        last_ts = ev["ts"]
+        if ev["ph"] == "B":
+            stacks.setdefault(ev["tid"], []).append(ev["name"])
+            seen.add(ev["name"])
+        elif ev["ph"] == "E":
+            stack = stacks.get(ev["tid"])
+            if not stack:
+                return fail(f"unbalanced E event on tid {ev['tid']}")
+            stack.pop()
+    open_spans = {t: s for t, s in stacks.items() if s}
+    if open_spans:
+        return fail(f"unclosed spans at end of trace: {open_spans}")
+    if not SPAN_NAMES <= seen:
+        return fail(f"trace misses pipeline spans: {sorted(SPAN_NAMES - seen)}")
+    print(f"check_bench_report: chrome trace ok ({len(events)} events)")
+    return 0
+
+
+def main(argv) -> int:
+    if len(argv) != 3:
+        return fail("usage: check_bench_report.py BENCH_LOG CHROME_TRACE")
+    rc = check_report(pathlib.Path(argv[1]))
+    return rc or check_chrome_trace(pathlib.Path(argv[2]))
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

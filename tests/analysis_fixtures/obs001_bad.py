@@ -1,0 +1,12 @@
+# floorlint: scope=FL-OBS
+"""Deliberately violating fixture: FL-OBS001 — a typo'd trace counter
+name (``scan.bytes_raed``) and an unregistered span stage would silently
+split metrics; both must trip the registry check."""
+
+from parquet_floor_tpu.utils import trace
+
+
+def plan_one(extents):
+    trace.count("scan.bytes_raed", sum(e.length for e in extents))  # typo
+    with trace.span("decoed"):  # typo'd stage name
+        return len(extents)

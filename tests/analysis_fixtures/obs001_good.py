@@ -1,0 +1,13 @@
+# floorlint: scope=FL-OBS
+"""Clean counterpart: registered names pass, and dynamic names are out
+of the rule's reach (it guards literals, not reflection)."""
+
+from parquet_floor_tpu.utils import trace
+
+
+def plan_one(extents, metric_name):
+    trace.count("scan.bytes_read", sum(e.length for e in extents))
+    trace.gauge_max("scan.queue_depth_max", len(extents))
+    trace.count(metric_name, 1)  # dynamic: not checked
+    with trace.span("decode", attrs={"extents": len(extents)}):
+        return len(extents)

@@ -118,7 +118,7 @@ def test_choose_engine_platform_gate(tmp_path):
         assert choice.engine == "host"
         assert "not a TPU" in choice.reason
         ds = trace.decisions()
-        assert ds and ds[-1]["decision"] == "engine_auto"
+        assert ds and ds[-1]["decision"] == "engine.auto"
         assert ds[-1]["engine"] == "host"
     finally:
         trace.disable()

@@ -31,6 +31,7 @@ CASES = [
     ("res001", "FL-RES001"),
     ("res001_tpe", "FL-RES001"),  # executor/scan-handle shapes of the rule
     ("alloc001", "FL-ALLOC001"),
+    ("obs001", "FL-OBS001"),
 ]
 
 

@@ -266,7 +266,9 @@ def test_scan_budget_never_exceeded(dataset):
         ) as scanner:
             n = sum(u.batch.num_rows for u in scanner)
             assert scanner._budget.high_water <= budget
-        assert trace.counters()["scan.inflight_bytes_max"] <= budget
+        # gauges are namespaced apart from additive counters now
+        assert trace.gauges()["scan.inflight_bytes_max"] <= budget
+        assert trace.metrics()["scan.inflight_bytes_max"] <= budget
     finally:
         trace.disable()
         trace.reset()

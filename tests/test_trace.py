@@ -1,0 +1,546 @@
+"""Scoped trace contexts, timeline export, and health reports
+(``utils.trace``, docs/observability.md): tracer isolation across
+threads and concurrent scans, Chrome-trace export validity, the
+disabled-mode zero-cost contract, bounded-store eviction counters, the
+counters/gauges namespace split, retry-counter durability, and the
+``ScanReport`` surfaces."""
+
+import gc
+import json
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    IoRetryExhaustedError,
+    ParquetFileWriter,
+    ParquetReader,
+    ReaderOptions,
+    WriterOptions,
+    trace,
+    types,
+)
+from parquet_floor_tpu.format.parquet_thrift import CompressionCodec
+from parquet_floor_tpu.io.source import RetryingSource
+from parquet_floor_tpu.scan import (
+    DatasetScanner,
+    ScanOptions,
+    scan_device_groups,
+)
+from parquet_floor_tpu.utils.trace import ScanReport, Tracer, names
+
+
+def _write(path, n=1500, groups=2, seed=0):
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.optional(types.DOUBLE).named("d"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    rng = np.random.default_rng(seed)
+    per = (n + groups - 1) // groups
+    data = {
+        "k": np.arange(n, dtype=np.int64) + seed * 1_000_000,
+        "d": [
+            None if i % 11 == 0 else float(v)
+            for i, v in enumerate(rng.standard_normal(n))
+        ],
+        "s": [None if i % 7 == 0 else f"v{(i + seed) % 37}" for i in range(n)],
+    }
+    opts = WriterOptions(
+        codec=CompressionCodec.SNAPPY, row_group_rows=per,
+        data_page_values=400,
+    )
+    with ParquetFileWriter(path, schema, opts) as w:
+        for lo in range(0, n, per):
+            hi = min(lo + per, n)
+            w.write_columns({k: v[lo:hi] for k, v in data.items()})
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = tmp_path_factory.mktemp("trace_ds")
+    return [_write(str(d / f"f{i}.parquet"), seed=i) for i in range(4)]
+
+
+@pytest.fixture(scope="module")
+def small_dataset(tmp_path_factory):
+    d = tmp_path_factory.mktemp("trace_ds_small")
+    return [
+        _write(str(d / f"g{i}.parquet"), n=600, seed=10 + i) for i in range(2)
+    ]
+
+
+# --- scoping ----------------------------------------------------------------
+
+def test_scope_isolates_from_global():
+    trace.reset()
+    trace.count("io.retries", 3)  # global tracer is disabled: dropped
+    assert trace.counters() == {}
+    with trace.scope() as t:
+        trace.count("io.retries", 2)
+        assert trace.counters() == {"io.retries": 2}
+        assert t.counters() == {"io.retries": 2}
+    assert trace.counters() == {}  # back on the (disabled) global tracer
+    assert t.counters() == {"io.retries": 2}  # scope snapshot survives
+
+
+def test_nested_scopes_innermost_wins():
+    with trace.scope() as outer:
+        trace.count("io.retries", 1)
+        with trace.scope() as inner:
+            trace.count("io.retries", 10)
+        trace.count("io.retries", 1)
+    assert outer.counters()["io.retries"] == 2
+    assert inner.counters()["io.retries"] == 10
+
+
+def test_tracer_run_carries_scope_to_plain_threads():
+    with trace.scope() as t:
+        def work():
+            trace.count("scan.bytes_read", 7)
+            with trace.span("read"):
+                pass
+        th = threading.Thread(target=t.run, args=(work,))
+        th.start()
+        th.join()
+    assert t.counters()["scan.bytes_read"] == 7
+    assert t.stats()["read"]["count"] == 1
+
+
+def test_two_concurrent_scoped_scans_report_disjoint_counters(
+        dataset, small_dataset):
+    """The acceptance contract: two threads running scoped scans see
+    isolated, correctly attributed counters — identical to what each
+    scan reports when run alone."""
+    def run_scan(paths, out, key):
+        with trace.scope() as t:
+            with DatasetScanner(paths, scan=ScanOptions(threads=2)) as sc:
+                rows = sum(u.batch.num_rows for u in sc)
+            out[key] = (t.metrics(), t.stats(), rows)
+
+    solo: dict = {}
+    run_scan(dataset, solo, "a")
+    run_scan(small_dataset, solo, "b")
+
+    both: dict = {}
+    ta = threading.Thread(target=run_scan, args=(dataset, both, "a"))
+    tb = threading.Thread(target=run_scan, args=(small_dataset, both, "b"))
+    ta.start()
+    tb.start()
+    ta.join()
+    tb.join()
+
+    deterministic = (
+        "scan.ranges_planned", "scan.extents_planned", "scan.bytes_read",
+        "scan.bytes_used", "scan.overread_bytes", "scan.bytes_prefetched",
+    )
+    for key in ("a", "b"):
+        got_m, got_s, got_rows = both[key]
+        want_m, want_s, want_rows = solo[key]
+        assert got_rows == want_rows
+        for name in deterministic:
+            assert got_m[name] == want_m[name], (key, name)
+        # every worker-side span landed on the right tracer too
+        assert got_s["decode"]["count"] == want_s["decode"]["count"]
+    # the two scans really are disjoint (different datasets → different
+    # byte totals), not two copies of a shared store
+    assert both["a"][0]["scan.bytes_read"] != both["b"][0]["scan.bytes_read"]
+    assert trace.counters() == {}  # nothing leaked to the global tracer
+
+
+# --- bounded stores ---------------------------------------------------------
+
+def test_decision_cap_configurable_and_eviction_counted():
+    with trace.scope(max_decisions=3) as t:
+        for i in range(8):
+            trace.decision("scan.plan", {"i": i})
+    kept = t.decisions()
+    assert len(kept) == 3
+    assert [d["i"] for d in kept] == [5, 6, 7]  # oldest evicted first
+    assert t.counters()["trace.decisions_dropped"] == 5
+
+
+def test_default_decision_cap_is_64():
+    with trace.scope() as t:
+        for i in range(70):
+            trace.decision("scan.plan", {"i": i})
+    assert len(t.decisions()) == 64
+    assert t.counters()["trace.decisions_dropped"] == 6
+
+
+def test_event_cap_eviction_counted():
+    with trace.scope(max_events=8) as t:
+        for _ in range(10):
+            with trace.span("read"):
+                pass
+    assert len(t.events()) == 8
+    assert t.counters()["trace.events_dropped"] == 12  # 20 recorded - 8 kept
+
+
+def test_tracer_rejects_degenerate_caps():
+    with pytest.raises(ValueError):
+        Tracer(max_decisions=0)
+    with pytest.raises(ValueError):
+        Tracer(max_events=1)
+
+
+# --- counters/gauges namespace split ----------------------------------------
+
+def test_counters_gauges_split_and_merged_view():
+    with trace.scope() as t:
+        trace.count("scan.bytes_read", 10)
+        trace.gauge_max("scan.queue_depth_max", 4)
+        trace.gauge_max("scan.queue_depth_max", 2)  # below high water
+    assert t.counters() == {"scan.bytes_read": 10}
+    assert t.gauges() == {"scan.queue_depth_max": 4}
+    merged = t.metrics()
+    assert merged == {"scan.bytes_read": 10, "scan.queue_depth_max": 4}
+
+
+def test_report_labels_gauges_as_max():
+    with trace.scope() as t:
+        trace.count("scan.bytes_read", 10)
+        trace.gauge_max("scan.queue_depth_max", 4)
+    rep = t.report()
+    assert "scan.queue_depth_max" in rep and "max=4" in rep
+    assert "max=10" not in rep  # additive counters are NOT labelled max=
+
+
+def test_registry_names_are_disjoint_by_kind():
+    assert not names.COUNTERS & names.GAUGES
+    assert not names.COUNTERS & names.SPANS
+    assert not names.GAUGES & names.SPANS
+    assert names.ALL >= names.COUNTERS | names.GAUGES | names.DECISIONS
+
+
+# --- the zero-cost disabled path --------------------------------------------
+
+class _PoisonedLock:
+    """Fails the test if the no-op path ever takes the tracer lock."""
+
+    def __enter__(self):
+        raise AssertionError("disabled-mode hot path acquired the lock")
+
+    def __exit__(self, *exc):
+        return False
+
+    def acquire(self, *a, **k):
+        raise AssertionError("disabled-mode hot path acquired the lock")
+
+    def release(self):
+        pass
+
+
+def test_disabled_noop_path_no_alloc_no_lock():
+    t = Tracer(enabled=False)
+    t._lock = _PoisonedLock()
+    detail = {"engine": "host"}
+    attrs = {"file": 0}
+
+    def burst():
+        for _ in range(50):
+            trace.count("io.retries")
+            trace.gauge_max("scan.queue_depth_max", 9)
+            trace.decision("engine.auto", detail)
+            trace.add("read", 0.1, 5)
+            with trace.span("read", 5, attrs):
+                pass
+
+    with trace.using(t):
+        # the no-op span is one shared immortal instance
+        assert trace.span("read") is trace.span("decode")
+        burst()  # warm call sites (and prove the poisoned lock is idle)
+        gc.collect()
+        before = sys.getallocatedblocks()
+        burst()
+        gc.collect()
+        # the 250 no-op calls retain nothing; the 2-block slack covers
+        # the measurement itself (`before` and the delta are fresh ints)
+        assert sys.getallocatedblocks() - before <= 2
+    t._lock = threading.Lock()  # snapshots below may take the lock
+    assert t.counters() == {} and t.events() == []
+
+
+# --- timeline + chrome export -----------------------------------------------
+
+def _load_trace(path):
+    data = json.loads(path.read_text())
+    # round-trips through the json module unchanged
+    assert json.loads(json.dumps(data)) == data
+    return data["traceEvents"]
+
+
+def _check_balanced(events):
+    """B/E pairs must balance per thread, with matching names, and
+    timestamps must be monotonic."""
+    stacks: dict = {}
+    last_ts = None
+    for ev in events:
+        if ev["ph"] == "M":
+            continue
+        if last_ts is not None:
+            assert ev["ts"] >= last_ts
+        last_ts = ev["ts"]
+        if ev["ph"] == "B":
+            stacks.setdefault(ev["tid"], []).append(ev["name"])
+        elif ev["ph"] == "E":
+            assert stacks.get(ev["tid"]), "E without a B on its thread"
+            assert stacks[ev["tid"]].pop() == ev["name"]
+    assert not any(s for s in stacks.values()), "unclosed span in export"
+
+
+def test_export_chrome_trace_threads_and_nesting(tmp_path):
+    with trace.scope() as t:
+        with trace.span("stage", attrs={"file": "f", "row_group": 0}):
+            with trace.span("ship", 10):
+                pass
+        th = threading.Thread(target=t.run, args=(
+            lambda: trace.span("read", 5, {"file": "g"}).__enter__().__exit__(
+                None, None, None
+            ),
+        ))
+        th.start()
+        th.join()
+        trace.decision("engine.auto", {"engine": "host"})
+    out = tmp_path / "t.json"
+    n = t.export_chrome_trace(str(out))
+    events = _load_trace(out)
+    assert n == len(events)
+    _check_balanced(events)
+    tids = {e["tid"] for e in events if e["ph"] == "B"}
+    assert len(tids) == 2
+    # thread-name metadata rides along
+    assert any(e["ph"] == "M" and e["name"] == "thread_name" for e in events)
+    # instant events keep their attrs
+    inst = [e for e in events if e["ph"] == "i"]
+    assert inst and inst[0]["args"] == {"engine": "host"}
+
+
+def test_export_balances_evicted_begin_and_open_span(tmp_path):
+    t = Tracer(enabled=True, max_events=2)
+    with trace.using(t):
+        with trace.span("stage"):
+            with trace.span("ship"):
+                pass
+        # buffer now holds ship-E, stage-E: both orphaned ends
+        out = tmp_path / "orphans.json"
+        t.export_chrome_trace(str(out))
+        _check_balanced(_load_trace(out))
+        t.reset()
+        sp = trace.span("decode")
+        sp.__enter__()  # never exited: export must close it
+        out2 = tmp_path / "open.json"
+        t.export_chrome_trace(str(out2))
+        events = _load_trace(out2)
+        _check_balanced(events)
+        assert [e["name"] for e in events if e["ph"] == "E"] == ["decode"]
+        sp.__exit__(None, None, None)
+
+
+def test_device_scan_export_attributed_spans(dataset, tmp_path):
+    """The acceptance gate: a 4-file device scan exports (file,
+    row-group)-attributed read/stage/ship/decode spans on ≥ 2 distinct
+    threads, as valid, loadable trace-event JSON."""
+    with trace.scope() as t:
+        units = list(scan_device_groups(
+            dataset, scan=ScanOptions(threads=2), float64_policy="bits"
+        ))
+    assert len(units) == 8
+    out = tmp_path / "scan.json"
+    t.export_chrome_trace(str(out))
+    events = _load_trace(out)
+    _check_balanced(events)
+    begins = [e for e in events if e["ph"] == "B"]
+    for stage in ("read", "stage", "ship", "decode"):
+        spans = [e for e in begins if e["name"] == stage]
+        assert spans, f"no {stage} spans in the export"
+        attributed = [
+            e for e in spans
+            if "file" in e.get("args", {})
+            and e["args"].get("row_group") is not None
+        ]
+        assert attributed, f"{stage} spans carry no (file, row_group) attrs"
+    pipeline_tids = {
+        e["tid"] for e in begins
+        if e["name"] in ("read", "stage", "ship", "decode")
+    }
+    assert len(pipeline_tids) >= 2
+
+
+# --- retry counters survive the ring buffer ---------------------------------
+
+class _FlakyEveryOther:
+    """Positional source whose every read fails once, then succeeds."""
+
+    name = "<flaky>"
+    size = 1 << 20
+
+    def __init__(self):
+        self.attempts = 0
+
+    def read_at(self, offset, length):
+        self.attempts += 1
+        if self.attempts % 2 == 1:
+            raise OSError("transient")
+        return memoryview(bytes(length))
+
+    def close(self):
+        pass
+
+
+def test_retry_totals_survive_decision_eviction():
+    with trace.scope(max_decisions=2) as t:
+        rs = RetryingSource(_FlakyEveryOther(), retries=3, backoff_s=0,
+                            sleep=lambda s: None)
+        for _ in range(5):
+            rs.read_at(0, 4)
+    # only 2 io.retry decisions survive the ring buffer…
+    assert len([d for d in t.decisions()
+                if d["decision"] == "io.retry"]) == 2
+    assert t.counters()["trace.decisions_dropped"] == 3
+    # …but the counter keeps the full total
+    assert t.counters()["io.retries"] == 5
+    assert "io.retry_exhausted" not in t.counters()
+
+
+class _AlwaysFails:
+    name = "<dead>"
+    size = 1 << 20
+
+    def read_at(self, offset, length):
+        raise OSError("gone")
+
+    def close(self):
+        pass
+
+
+def test_retry_exhaustion_counted():
+    with trace.scope() as t:
+        rs = RetryingSource(_AlwaysFails(), retries=2, backoff_s=0,
+                            sleep=lambda s: None)
+        with pytest.raises(IoRetryExhaustedError):
+            rs.read_at(0, 4)
+    assert t.counters()["io.retries"] == 2
+    assert t.counters()["io.retry_exhausted"] == 1
+
+
+# --- ScanReport surfaces ----------------------------------------------------
+
+def test_dataset_scanner_report(dataset):
+    with trace.scope():
+        with DatasetScanner(dataset, scan=ScanOptions(threads=2)) as sc:
+            rows = sum(u.batch.num_rows for u in sc)
+            rep_mid = sc.report()  # mid-scan: wall is elapsed-so-far
+            assert rep_mid.wall_seconds is not None
+        rep = sc.report()
+    assert rows == 6000
+    assert isinstance(rep, ScanReport)
+    assert rep.wall_seconds > 0
+    assert rep.bytes_read >= rep.bytes_used > 0
+    assert 0.0 <= rep.overread_ratio < 1.0
+    assert rep.budget_bytes == ScanOptions().prefetch_bytes
+    assert rep.budget_utilization is not None
+    assert 0.0 <= rep.stall_fraction <= 1.0
+    assert rep.overlap_fraction == pytest.approx(1.0 - rep.stall_fraction)
+    assert rep.stages["decode"]["count"] == 8
+    d = rep.as_dict()
+    assert json.loads(json.dumps(d)) == d  # bench-JSON-ready
+    assert "scan health:" in rep.render()
+
+
+def test_scan_report_render_in_trace_report(dataset):
+    with trace.scope() as t:
+        with DatasetScanner(dataset[:1]) as sc:
+            for _ in sc:
+                pass
+    assert "scan health:" in t.report()
+
+
+def test_scan_device_groups_on_report(small_dataset):
+    got = []
+    with trace.scope():
+        for _ in scan_device_groups(
+            small_dataset, scan=ScanOptions(threads=2),
+            float64_policy="bits", on_report=got.append,
+        ):
+            pass
+    assert len(got) == 1
+    rep = got[0]
+    assert isinstance(rep, ScanReport)
+    assert rep.wall_seconds > 0
+    assert rep.bytes_read > 0
+    assert rep.stages["stage"]["count"] == 4
+    assert rep.stages["ship"]["count"] >= 4
+
+
+def test_on_report_error_does_not_mask_scan_error(small_dataset, tmp_path):
+    # a raising callback surfaces when the scan itself succeeded…
+    with pytest.raises(RuntimeError, match="callback boom"):
+        with trace.scope():
+            for _ in scan_device_groups(
+                small_dataset, float64_policy="bits",
+                on_report=lambda rep: (_ for _ in ()).throw(
+                    RuntimeError("callback boom")
+                ),
+            ):
+                pass
+    # …but never replaces an in-flight scan error (here: a corrupt
+    # footer among the sources)
+    bad = tmp_path / "bad.parquet"
+    bad.write_bytes(b"PAR1 this is not a parquet file")
+    with pytest.raises(ValueError) as ei:
+        with trace.scope():
+            for _ in scan_device_groups(
+                [small_dataset[0], str(bad)], float64_policy="bits",
+                on_report=lambda rep: (_ for _ in ()).throw(
+                    RuntimeError("callback boom")
+                ),
+            ):
+                pass
+    assert "callback boom" not in str(ei.value)
+
+
+def test_stream_content_scan_report_face(small_dataset):
+    class Hyd:
+        def start(self):
+            return {}
+
+        def add(self, tgt, name, value):
+            tgt[name] = value
+            return tgt
+
+        def finish(self, tgt):
+            return tgt
+
+    with trace.scope():
+        it = ParquetReader.stream_content(
+            small_dataset, lambda cols: Hyd(), scan_options=ScanOptions(),
+        )
+        n = sum(1 for _ in it)
+        rep = it.report()
+    assert n == 1200
+    assert isinstance(rep, ScanReport)
+    assert rep.bytes_read > 0
+
+
+def test_salvage_counters_registered():
+    # the salvage path counters are part of the registry the lint rule
+    # enforces (their behavior is pinned in test_salvage)
+    assert "salvage.pages_skipped" in names.COUNTERS
+    assert "salvage.chunks_quarantined" in names.COUNTERS
+    assert names.DECISIONS >= {"salvage.skip_page", "salvage.quarantine_chunk"}
+
+
+def test_reader_options_still_flow_under_scope(dataset):
+    # scoping must not disturb option plumbing on the scan path
+    with trace.scope() as t:
+        with DatasetScanner(
+            dataset[:1], options=ReaderOptions(io_retries=2),
+        ) as sc:
+            rows = sum(u.batch.num_rows for u in sc)
+    assert rows == 1500
+    assert t.counters().get("io.retry_exhausted", 0) == 0

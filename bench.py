@@ -271,6 +271,110 @@ def scan_leg(n_rows: int, reps: int) -> dict:
     }
 
 
+def _bench_batch(paths) -> int:
+    """The loader leg's batch size: the largest divisor (at or under
+    4096) of the dataset's ACTUAL row-group size, read from the first
+    file's footer — group-ALIGNED, so every steady-state group rides the
+    batcher's static-slice fast path (docs/data.md documents exactly
+    this sizing discipline for training configs), and a change to
+    `_scan_paths`' sizing can never silently knock the leg off it."""
+    from parquet_floor_tpu import ParquetFileReader
+
+    with ParquetFileReader(paths[0]) as r:
+        group = int(r.row_groups[0].num_rows)
+    return next(
+        b for b in range(min(group, 4096), 0, -1) if group % b == 0
+    )
+
+
+def _bench_loader(n_rows: int, shuffled: bool, num_epochs=1):
+    """The loader leg's DataLoader over the scan leg's 4-file dataset:
+    device engine, bit-exact DOUBLE policy, pad-remainder (every row
+    counted); the shuffled form is the timed one, the unshuffled form is
+    the reference stream the multiset check compares against."""
+    from parquet_floor_tpu.data import DataLoader
+
+    paths = _scan_paths(n_rows)
+    batch = _bench_batch(paths)
+    return DataLoader(
+        paths, batch,
+        shuffle_seed=7 if shuffled else None,
+        shuffle_window=4 * batch if shuffled else 0,
+        num_epochs=num_epochs, drop_remainder=False,
+        engine="tpu", float64_policy="bits",
+    )
+
+
+def loader_leg_timed(n_rows: int, reps: int) -> dict:
+    """Training-loader throughput (docs/data.md): seeded-shuffled epochs
+    over the 4-file dataset through ``data.DataLoader`` on the device
+    engine — unit permutation, window shuffle, and fixed-shape
+    re-batching all included in the wall.  The loader PERSISTS across
+    reps (``num_epochs=None``) and each rep times one full epoch, the
+    steady state a training loop actually runs in — construction (a
+    footer-only pass) and the warm-up epoch (compiles + page cache) stay
+    outside the timed region, exactly as the scan leg's warm call does.
+    Timed with NO device→host fetch (``block_until_ready`` only), so it
+    runs before any D2H leg; the multiset-exactness check (which must
+    fetch) runs separately in :func:`loader_leg_exactness`, after every
+    timed section."""
+    import jax
+
+    with _bench_loader(n_rows, shuffled=True, num_epochs=None) as loader:
+        batch = loader.batch_size
+        window = loader.shuffle_window
+        it = iter(loader)
+        n_batches = loader.batches_per_epoch
+
+        def run_epoch():
+            rows = 0
+            for _ in range(n_batches):
+                b = next(it)
+                jax.block_until_ready([c.values for c in b.columns])
+                rows += b.num_valid
+            return rows
+
+        rows = run_epoch()  # warm compiles + page cache
+        best = float("inf")
+        for _ in range(max(reps, 1)):
+            t0 = time.perf_counter()
+            r = run_epoch()
+            best = min(best, time.perf_counter() - t0)
+            if r != rows:
+                raise RuntimeError(f"loader leg row drift: {r} != {rows}")
+    return {
+        "loader_rows_per_sec": round(rows / best, 1),
+        "loader_rows": rows,
+        "loader_batches": n_batches,
+        "loader_batch_size": batch,
+        "loader_shuffle_window": window,
+    }
+
+
+def loader_leg_exactness(n_rows: int) -> dict:
+    """Bit-exactness of the shuffled loader stream vs the unshuffled
+    reference SET: the same key values must come back, bit-identical as
+    a multiset (shuffling reorders, never alters or drops).  Fetches
+    device arrays — runs after every timed section."""
+    import numpy as np
+
+    def keys(shuffled):
+        out = []
+        with _bench_loader(n_rows, shuffled) as loader:
+            for b in loader:
+                out.append(
+                    np.asarray(b.column("l_orderkey").values)[: b.num_valid]
+                )
+        return np.sort(np.concatenate(out)) if out else np.zeros(0, np.int64)
+
+    shuf, ref = keys(True), keys(False)
+    return {
+        "loader_set_exact": bool(
+            shuf.shape == ref.shape and np.array_equal(shuf, ref)
+        ),
+    }
+
+
 def chunked_columns(path) -> list:
     """The chunked leg's column subset: 4 fields (mixed types) keeps
     the forced-chunking proof while compiling 4x fewer fresh shapes
@@ -445,10 +549,22 @@ def main():
     # bit-exact check then fetches arrays — after every timed section,
     # because the first D2H degrades a tunnelled link process-wide
     batch = batch_face_leg(path, reps, best)
+    # training-loader leg, TIMED part (docs/data.md): device batches are
+    # only block_until_ready'd — no D2H — so it runs among the timed legs
+    loader_detail = loader_leg_timed(n_rows, reps)
     # multi-file scan scheduler leg (docs/scan.md): timed sections first,
     # its own bit-exact D2H check last — so it sits after every other
     # timed leg and before the (already post-D2H) chunked leg
     scan_detail = scan_leg(n_rows, reps)
+    # the loader's multiset-exactness check fetches device arrays: after
+    # every timed section (the first D2H degrades tunnelled links
+    # process-wide), alongside the scan leg's own D2H check
+    loader_detail.update(loader_leg_exactness(n_rows))
+    scan_rps = scan_detail.get("scan_rows_per_sec") or 0
+    loader_detail["loader_vs_scan_x"] = (
+        round(loader_detail["loader_rows_per_sec"] / scan_rps, 3)
+        if scan_rps else None
+    )
     chunk_cols_subset = chunked_columns(path)
     single_cols = reader.read_row_group(0, columns=chunk_cols_subset)
     reader.close()
@@ -486,6 +602,7 @@ def main():
             **batch,
             **chunked,
             **scan_detail,
+            **loader_detail,
         },
     }
     print(json.dumps(result))

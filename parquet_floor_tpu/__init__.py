@@ -60,11 +60,12 @@ __all__ = [
     "LogicalAnnotation", "MessageType", "NestedColumn", "ParquetError",
     "ParquetFileReader",
     "ParquetFileWriter", "ParquetMetadata", "ParquetReader", "ParquetWriter",
+    "DataLoader", "LoaderBatch",
     "Predicate", "PrimitiveType", "ReaderOptions", "SalvageReport",
     "SalvageSkip", "ScanOptions", "ScanReport", "DatasetScanner",
     "TpuRowGroupReader", "TruncatedFileError", "Type",
     "UnsupportedCodec", "UnsupportedFeatureError",
-    "assemble_nested", "batch_to_arrow", "col",
+    "assemble_nested", "batch_to_arrow", "col", "data",
     "read_sharded_global", "register_codec", "scan", "scan_batches",
     "shred_nested", "testing",
     "trace", "types", "ValueWriter", "WriterOptions",
@@ -87,6 +88,11 @@ _LAZY = {
     "ScanReport": ("parquet_floor_tpu.utils.trace", "ScanReport"),
     "DatasetScanner": ("parquet_floor_tpu.scan", "DatasetScanner"),
     "scan_batches": ("parquet_floor_tpu.scan", "scan_batches"),
+    # the training input pipeline (docs/data.md) — lazy so that format/API
+    # imports never pay for it (the device face pulls in jax on use only)
+    "data": ("parquet_floor_tpu.data", None),
+    "DataLoader": ("parquet_floor_tpu.data", "DataLoader"),
+    "LoaderBatch": ("parquet_floor_tpu.data", "LoaderBatch"),
 }
 
 

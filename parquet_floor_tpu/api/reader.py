@@ -849,6 +849,11 @@ class ParquetReader:
         stage‖ship‖decode pipeline crosses file boundaries instead of
         draining at each file's end.  Salvage is rejected under scan
         (same ``UnsupportedFeatureError`` contract as the TPU engine).
+
+        For TRAINING consumption — seeded shuffling, exact-size epoch
+        batches, host sharding, and mid-epoch checkpoint/resume — use
+        ``parquet_floor_tpu.data.DataLoader`` (``docs/data.md``) instead
+        of re-batching this stream by hand.
         """
         if engine not in ("host", "tpu", "auto"):
             raise ValueError(f"bad engine {engine!r}: expected host|tpu|auto")

@@ -1,0 +1,35 @@
+"""Deterministic, checkpointable TPU input pipeline (``docs/data.md``).
+
+The training-loop layer over the scan scheduler: ``DataLoader`` turns a
+Parquet dataset into seeded-shuffled, epoch-aware, fixed-shape host or
+device batches, sharded disjointly across hosts, with mid-epoch
+checkpoint/resume that is bit-identical to an uninterrupted run.
+
+* :mod:`~parquet_floor_tpu.data.order` — the pure order-plan math:
+  contiguous unit shards, per-epoch unit permutations, the bounded
+  block (window) shuffle, and the resume arithmetic.  All randomness is
+  counter-based (Philox keyed on seed/epoch/block), so checkpoints carry
+  seeds and cursors, never RNG state.
+* :mod:`~parquet_floor_tpu.data.batcher` — carry-over re-slicing of
+  ragged row groups into exact ``batch_size`` rows with static shapes
+  (drop- or pad-remainder).
+* :mod:`~parquet_floor_tpu.data.loader` — :class:`DataLoader` itself,
+  driving ``scan.DatasetScanner(order=...)`` (host face) or the TPU
+  engine's windowed ``iter_dataset_row_groups`` (device face).
+"""
+
+from .batcher import ColumnSpec, LoaderBatch, RowBuffer, make_batch
+from .loader import DataLoader
+from .order import EpochPlan, Unit, keyed_rng, shard_units
+
+__all__ = [
+    "ColumnSpec",
+    "DataLoader",
+    "EpochPlan",
+    "LoaderBatch",
+    "RowBuffer",
+    "Unit",
+    "keyed_rng",
+    "make_batch",
+    "shard_units",
+]

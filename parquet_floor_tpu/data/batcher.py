@@ -1,0 +1,417 @@
+"""Fixed-shape re-batching — ragged row groups in, exact ``batch_size``
+rows out (``docs/data.md``).
+
+Decoded row groups are ragged (whatever the writer chose); a training
+step wants static shapes.  :class:`RowBuffer` is the carry-over buffer
+that bridges them: decoded groups (already window-shuffled — the TPU
+engine fuses each unit's permutation into its decode via ``out_perm``,
+and the host face applies it with :func:`permute_parts`) push per-column
+segments in, and rows come out either eagerly (``take`` — the host
+face's NumPy path, where slicing is cheap) or as LAZY windows
+(``take_windows`` — the device face's path): ``(segment, start, stop)``
+references that :func:`fused_assemble` turns into finished batches in
+**one** compiled call.  Eager ``jax.numpy`` would pay one dispatch per
+slice/concat/pad per column — ~50 dispatches per batch of a 16-column
+file, which dominates the loader wall on every backend's dispatch path;
+the fused form pays one per *group's worth of ready batches*
+(``split``), not one per array op.
+
+String columns are padded ``(n, W)`` byte rows + lengths.  ``W`` is a
+per-column high-water mark shared across the whole loader run (the
+engine's monotone-bucket discipline applied to batch shapes): widths
+only grow, and the checkpoint carries them, so a resumed run emits
+bit-identical shapes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..batch.columns import BatchColumn
+from ..format.schema import ColumnDescriptor
+
+# one column's rows in transit: (values, mask, lengths) — mask/lengths
+# None when the column is required / not strings
+Part = Tuple[object, Optional[object], Optional[object]]
+# a lazy reference to rows [start, stop) of a buffered Part
+Window = Tuple[Part, int, int]
+
+
+@dataclass(frozen=True)
+class ColumnSpec:
+    """Static per-column facts the batcher needs (fixed at loader
+    construction: the schema is the dataset contract)."""
+
+    name: str
+    descriptor: ColumnDescriptor
+    is_string: bool
+    has_mask: bool
+    f64_bits: bool = False
+
+
+def slice_part(part: Part, a: int, b: int) -> Part:
+    v, m, ln = part
+    return (
+        v[a:b],
+        m[a:b] if m is not None else None,
+        ln[a:b] if ln is not None else None,
+    )
+
+
+def permute_parts(parts: Sequence[Part], idx) -> List[Part]:
+    """Apply one row permutation to every column — the host face's
+    eager window shuffle (the device face fuses the same permutation
+    into the decode executable instead)."""
+    return [
+        (
+            v[idx],
+            m[idx] if m is not None else None,
+            ln[idx] if ln is not None else None,
+        )
+        for v, m, ln in parts
+    ]
+
+
+def grow_widths(specs: Sequence[ColumnSpec], parts: Sequence[Part],
+                widths: Dict[str, int]) -> None:
+    """Fold one group's string widths into the shared high-water marks
+    (every decoded group passes through here, on either emit path)."""
+    for spec, (v, _m, _l) in zip(specs, parts):
+        if spec.is_string:
+            w = int(v.shape[1]) if getattr(v, "ndim", 1) == 2 else 0
+            if w > widths.get(spec.name, 0):
+                widths[spec.name] = w
+
+
+@dataclass
+class RowBuffer:
+    """Multi-column carry-over buffer; all columns advance in lockstep
+    (segments are pushed and split together, so row alignment can never
+    drift between columns).  Splits are bookkeeping only — a segment's
+    arrays are never sliced until consumption."""
+
+    specs: Sequence[ColumnSpec]
+    xp: object
+    widths: Dict[str, int]  # shared string-width HWMs (loader-owned)
+    _segs: deque = field(default_factory=deque)  # (n_rows, [Part], offset)
+    rows: int = 0
+
+    def push(self, parts: Sequence[Part], n: int, skip: int = 0) -> None:
+        if n - skip <= 0:
+            return
+        grow_widths(self.specs, parts, self.widths)
+        self._segs.append((n - skip, list(parts), skip))
+        self.rows += n - skip
+
+    def _consume(self, n: int) -> List[Tuple[List[Part], int, int]]:
+        """Pop ``n`` rows as (segment parts, start, stop) windows."""
+        if n > self.rows:
+            raise ValueError(f"take({n}) from buffer of {self.rows} rows")
+        out = []
+        got = 0
+        while got < n:
+            sn, parts, off = self._segs.popleft()
+            need = n - got
+            used = min(sn, need)
+            out.append((parts, off, off + used))
+            if used < sn:
+                self._segs.appendleft((sn - used, parts, off + used))
+            got += used
+        self.rows -= n
+        return out
+
+    def take_windows(self, n: int) -> List[List[Window]]:
+        """Exactly ``n`` rows per column as LAZY windows — no array op
+        happens here; :func:`fused_assemble` materializes them in one
+        compiled call."""
+        segs = self._consume(n)
+        return [
+            [(parts[ci], a, b) for parts, a, b in segs]
+            for ci in range(len(self.specs))
+        ]
+
+    def take(self, n: int) -> List[Part]:
+        """Exactly ``n`` rows per column, materialized eagerly (the host
+        NumPy path; strings padded to the current width HWM)."""
+        segs = self._consume(n)
+        pieces: List[List[Part]] = [
+            [slice_part(parts[ci], a, b) for parts, a, b in segs]
+            for ci in range(len(self.specs))
+        ]
+        return [
+            self._join(spec, ps) for spec, ps in zip(self.specs, pieces)
+        ]
+
+    def _pad_width(self, v, w: int):
+        if int(v.shape[1]) == w:
+            return v
+        return self.xp.pad(v, ((0, 0), (0, w - int(v.shape[1]))))
+
+    def _join(self, spec: ColumnSpec, ps: List[Part]) -> Part:
+        xp = self.xp
+        if spec.is_string:
+            w = self.widths.get(spec.name, 0)
+            vs = [self._pad_width(p[0], w) for p in ps]
+        else:
+            vs = [p[0] for p in ps]
+        v = vs[0] if len(vs) == 1 else xp.concatenate(vs)
+        m = None
+        if ps[0][1] is not None:
+            ms = [p[1] for p in ps]
+            m = ms[0] if len(ms) == 1 else xp.concatenate(ms)
+        ln = None
+        if ps[0][2] is not None:
+            ls = [p[2] for p in ps]
+            ln = ls[0] if len(ls) == 1 else xp.concatenate(ls)
+        return (v, m, ln)
+
+
+# jit cache for fused_assemble, keyed by the batch's static structure
+# (piece sizes/dtypes, widths, pad, split).  Group-aligned batch sizes
+# keep the signature set tiny; misaligned ones cycle through more
+# shapes, so the cache is bounded like api.reader's _PACK_CACHE.
+_FUSE_CACHE: dict = {}
+_SPLIT_CACHE: dict = {}
+
+
+def aligned_split(specs: Sequence[ColumnSpec], parts: Sequence[Part],
+                  widths: Dict[str, int], k: int) -> List[List[Part]]:
+    """Cut one decoded group straight into ``k`` equal batches in one
+    compiled dispatch — the GROUP-ALIGNED fast path the loader takes
+    when the carry buffer is empty and the group's rows divide evenly
+    by ``batch_size``.
+
+    Unlike :func:`fused_assemble` there are no traced offsets and no
+    concatenation: every cut is a static ``slice_in_dim``, which XLA
+    turns into plain contiguous copies (measured ~2x cheaper than the
+    dynamic-sliced general form).  Pick a batch size that divides the
+    writer's row-group size and every steady-state group rides this
+    path; misaligned groups fall back to the carry buffer seamlessly.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    leaves: list = []
+    sig = []
+    for spec, (v, m, ln) in zip(specs, parts):
+        w = widths.get(spec.name, 0) if spec.is_string else 0
+        leaves.append(v)
+        if m is not None:
+            leaves.append(m)
+        if ln is not None:
+            leaves.append(ln)
+        sig.append((bool(spec.is_string), int(w),
+                    (m is not None, ln is not None)))
+    key = (
+        tuple(sig), int(k),
+        tuple((a.shape, a.dtype) for a in leaves),
+    )
+    fn = _SPLIT_CACHE.get(key)
+    if fn is None:
+        strct = tuple(sig)
+        kk = int(k)
+
+        def split(*arrs):
+            out = []
+            i = 0
+            for is_str, w, (hm, hl) in strct:
+                v = arrs[i]
+                i += 1
+                if is_str and int(v.shape[1]) != w:
+                    v = jnp.pad(v, ((0, 0), (0, w - int(v.shape[1]))))
+                m = arrs[i] if hm else None
+                i += 1 if hm else 0
+                ln = arrs[i] if hl else None
+                i += 1 if hl else 0
+                B = v.shape[0] // kk
+                for j in range(kk):
+                    out.append((
+                        lax.slice_in_dim(v, j * B, (j + 1) * B),
+                        None if m is None
+                        else lax.slice_in_dim(m, j * B, (j + 1) * B),
+                        None if ln is None
+                        else lax.slice_in_dim(ln, j * B, (j + 1) * B),
+                    ))
+            return tuple(out)
+
+        fn = jax.jit(split)
+        if len(_SPLIT_CACHE) > 256:
+            _SPLIT_CACHE.clear()
+        _SPLIT_CACHE[key] = fn
+    flat = fn(*leaves)
+    # flat is column-major: per column, k consecutive batch parts
+    return [
+        [flat[ci * k + j] for ci in range(len(specs))] for j in range(k)
+    ]
+
+
+def fused_assemble(specs: Sequence[ColumnSpec],
+                   windows: List[List[Window]],
+                   widths: Dict[str, int],
+                   pad: int = 0, split: int = 1) -> List[List[Part]]:
+    """Materialize ``split`` consecutive equal-size batches in ONE
+    compiled call; returns ``split`` per-column part lists.
+
+    Per column, the windows slice out of their source segments
+    (``dynamic_slice`` — traced starts, static sizes), strings pad to
+    the width HWM, pieces concatenate, ``pad`` zero rows append (the
+    pad-remainder policy, ``split == 1`` only), and the result cuts into
+    ``split`` equal static slices.  Eagerly that is ~3 dispatches per
+    column per batch; fused it is one dispatch per call — and the call
+    covers every batch a decoded group completed, so the device sees one
+    executable per group, not per batch.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if pad and split != 1:
+        raise ValueError("pad only applies to a single (tail) batch")
+    leaves: list = []
+    starts: List[int] = []
+    sig = []
+    for spec, ws in zip(specs, windows):
+        w = widths.get(spec.name, 0) if spec.is_string else 0
+        flags = []
+        for (v, m, ln), a, b in ws:
+            flags.append((m is not None, ln is not None, b - a))
+            starts.append(a)
+            leaves.append(v)
+            if m is not None:
+                leaves.append(m)
+            if ln is not None:
+                leaves.append(ln)
+        sig.append((bool(spec.is_string), int(w), tuple(flags)))
+    key = (
+        tuple(sig), int(pad), int(split),
+        tuple((a.shape, a.dtype) for a in leaves),
+    )
+    fn = _FUSE_CACHE.get(key)
+    if fn is None:
+        strct = tuple(sig)
+        padn = int(pad)
+        k = int(split)
+
+        def assemble(starts_arr, *arrs):
+            out = []
+            i = 0  # leaf cursor
+            pj = 0  # piece cursor (into starts_arr)
+            for is_str, w, flags in strct:
+                vs, ms, ls = [], [], []
+                for hm, hl, size in flags:
+                    a0 = starts_arr[pj]
+                    pj += 1
+                    v = lax.dynamic_slice_in_dim(arrs[i], a0, size)
+                    i += 1
+                    if is_str and int(v.shape[1]) != w:
+                        v = jnp.pad(v, ((0, 0), (0, w - int(v.shape[1]))))
+                    vs.append(v)
+                    if hm:
+                        ms.append(lax.dynamic_slice_in_dim(arrs[i], a0, size))
+                        i += 1
+                    if hl:
+                        ls.append(lax.dynamic_slice_in_dim(arrs[i], a0, size))
+                        i += 1
+                v = vs[0] if len(vs) == 1 else jnp.concatenate(vs)
+                m = (
+                    (ms[0] if len(ms) == 1 else jnp.concatenate(ms))
+                    if ms else None
+                )
+                ln = (
+                    (ls[0] if len(ls) == 1 else jnp.concatenate(ls))
+                    if ls else None
+                )
+                if padn:
+                    v = jnp.concatenate(
+                        [v, jnp.zeros((padn,) + tuple(v.shape[1:]), v.dtype)]
+                    )
+                    if m is not None:
+                        m = jnp.concatenate([m, jnp.ones((padn,), bool)])
+                    if ln is not None:
+                        ln = jnp.concatenate([ln, jnp.zeros((padn,), ln.dtype)])
+                if k == 1:
+                    out.append((v, m, ln))
+                else:
+                    B = v.shape[0] // k
+                    for j in range(k):
+                        out.append((
+                            lax.slice_in_dim(v, j * B, (j + 1) * B),
+                            None if m is None
+                            else lax.slice_in_dim(m, j * B, (j + 1) * B),
+                            None if ln is None
+                            else lax.slice_in_dim(ln, j * B, (j + 1) * B),
+                        ))
+            return tuple(out)
+
+        fn = jax.jit(assemble)
+        if len(_FUSE_CACHE) > 256:
+            _FUSE_CACHE.clear()
+        _FUSE_CACHE[key] = fn
+    flat = fn(np.asarray(starts, np.int32), *leaves)
+    # flat is column-major: per column, `split` consecutive batch parts
+    k = int(split)
+    return [
+        [flat[ci * k + j] for ci in range(len(specs))] for j in range(k)
+    ]
+
+
+@dataclass
+class LoaderBatch:
+    """One fixed-shape training batch.
+
+    ``columns`` are :class:`~parquet_floor_tpu.batch.columns.BatchColumn`
+    in schema order (the positional contract of every other batch face)
+    — NumPy arrays from the host face, device-resident ``jax.Array`` from
+    the device face.  When the epoch's remainder was padded
+    (``drop_remainder=False``), ``num_valid < batch_size`` and
+    ``row_mask`` marks the real rows (True); padded slots are zeros and,
+    for optional columns, null.
+    """
+
+    epoch: int
+    index: int                   # batch index within the epoch
+    columns: List[BatchColumn]
+    num_valid: int
+    row_mask: Optional[object] = None  # None when every row is real
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.columns[0].values.shape[0]) if self.columns else 0
+
+    def column(self, name: str) -> BatchColumn:
+        for c in self.columns:
+            if ".".join(c.descriptor.path) == name or \
+                    c.descriptor.path[0] == name:
+                return c
+        raise KeyError(f"no column named {name!r}")
+
+
+def make_batch(specs: Sequence[ColumnSpec], parts: Sequence[Part],
+               epoch: int, index: int, batch_size: int, valid: int,
+               xp) -> LoaderBatch:
+    """Assemble one batch, zero-padding (+ null-masking) the tail when a
+    column still falls short of ``batch_size`` (the device face arrives
+    pre-padded by :func:`fused_assemble`; the host face pads here)."""
+    cols = []
+    for spec, (v, m, ln) in zip(specs, parts):
+        pad = batch_size - int(v.shape[0])
+        if pad > 0:
+            v = xp.concatenate(
+                [v, xp.zeros((pad,) + tuple(v.shape[1:]), v.dtype)]
+            )
+            if m is not None:
+                m = xp.concatenate([m, xp.ones((pad,), bool)])
+            if ln is not None:
+                ln = xp.concatenate([ln, xp.zeros((pad,), ln.dtype)])
+        cols.append(BatchColumn(
+            spec.descriptor, v, m, ln, f64_bits=spec.f64_bits,
+        ))
+    row_mask = (
+        None if valid == batch_size else (xp.arange(batch_size) < valid)
+    )
+    return LoaderBatch(epoch, index, cols, valid, row_mask)

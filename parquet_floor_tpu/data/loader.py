@@ -1,0 +1,736 @@
+"""``DataLoader`` — the deterministic, checkpointable training input
+pipeline over the scan scheduler (``docs/data.md``).
+
+What a training loop consumes is not "a fast reader": it is a stream of
+seeded-shuffled, epoch-aware, fixed-shape batches that can be
+checkpointed mid-epoch and resumed bit-identically.  This module is that
+layer, built from pieces the repo already has:
+
+* the **order plan** (``data.order``): contiguous host shards of the
+  ``(file, row_group)`` unit list, per-epoch unit permutations, and the
+  bounded block (window) shuffle — all counter-based, so the checkpoint
+  is seeds + cursors, never RNG state;
+* the **scan scheduler**: the host face drives
+  ``scan.DatasetScanner(order=...)`` (coalesced vectored reads, bounded
+  prefetch, permuted delivery); the device face drives the TPU engine's
+  windowed ``iter_dataset_row_groups`` (files open DEPTH-ahead of the
+  shuffled order and close after their last scheduled group);
+* the **batcher** (``data.batcher``): carry-over re-slicing of ragged
+  row groups into exact ``batch_size`` rows with static shapes.
+
+Observability: the loader emits ``data.*`` counters/spans (registered in
+``trace.names``) into the tracer scope active at construction, and
+builds a per-epoch :class:`~parquet_floor_tpu.utils.trace.ScanReport`
+from snapshot deltas — ``loader.report()`` merges them via
+``ScanReport.merge``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..errors import UnsupportedFeatureError
+from ..format.file_read import ParquetFileReader, ReaderOptions
+from ..format.parquet_thrift import Type
+from ..format.schema import dataset_schema_key
+from ..scan.plan import ScanOptions
+from ..utils import trace
+from .batcher import (
+    ColumnSpec,
+    LoaderBatch,
+    RowBuffer,
+    aligned_split,
+    fused_assemble,
+    grow_widths,
+    make_batch,
+    permute_parts,
+)
+from .order import EpochPlan, Unit, shard_units
+
+_STATE_VERSION = 1
+# the fingerprint: state from one loader configuration must not restore
+# into another (a silently different stream would defeat the checkpoint)
+_FP_FIELDS = (
+    "batch_size", "shuffle_seed", "shuffle_window", "drop_remainder",
+    "num_epochs", "shard", "engine", "units", "rows", "columns",
+)
+
+
+def _resolve_source(src):
+    """A source entry may be path-like, an open positional source, or a
+    zero-arg FACTORY returning one (the shape fault-injection tests and
+    exotic storage want — a factory gives every open a fresh object, so
+    multi-epoch loaders never reuse a closed source)."""
+    if callable(src) and not hasattr(src, "read_at"):
+        return src()
+    return src
+
+
+def _delta_counters(before: Dict[str, int], after: Dict[str, int]
+                    ) -> Dict[str, int]:
+    out = {}
+    for k, v in after.items():
+        d = v - before.get(k, 0)
+        if d:
+            out[k] = d
+    return out
+
+
+def _delta_stats(before: Dict[str, dict], after: Dict[str, dict]
+                 ) -> Dict[str, dict]:
+    out = {}
+    for k, st in after.items():
+        b = before.get(k, {})
+        dc = st["count"] - b.get("count", 0)
+        ds = st["seconds"] - b.get("seconds", 0.0)
+        db = st["bytes"] - b.get("bytes", 0)
+        if dc or ds or db:
+            out[k] = {
+                "count": dc,
+                "seconds": round(ds, 6),
+                "bytes": db,
+                "MB_per_s": round(db / ds / 1e6, 1) if ds > 0 else 0.0,
+            }
+    return out
+
+
+class DataLoader:
+    """Seeded, sharded, checkpointable batch stream over a Parquet
+    dataset.
+
+    ``DataLoader(sources, batch_size, shuffle_seed=7, num_epochs=2,
+    drop_remainder=True, shard=(host_index, host_count),
+    options=ScanOptions(...))`` yields
+    :class:`~parquet_floor_tpu.data.batcher.LoaderBatch` — fixed-shape
+    host batches (``engine="host"``, NumPy) or device batches
+    (``engine="tpu"``, ``jax.Array``) — deterministically: same
+    configuration + same seed ⇒ the same batch stream, on every run.
+
+    * ``shuffle_seed=None`` streams units in (file, row-group) order —
+      the unshuffled reference stream.  With a seed, each epoch permutes
+      the shard's units (keyed on ``(seed, epoch)``); ``shuffle_window=W``
+      additionally mixes rows within consecutive W-row blocks of the
+      stream (bounded memory: at most ~W + batch_size rows buffer).
+    * ``shard=(host_index, host_count)`` takes the host's contiguous
+      block of the unit list (disjoint across hosts — the
+      ``parallel.multihost.host_shard()`` contract).  A host's stream
+      depends only on its shard + seed + epoch, never on the fleet size.
+    * ``state()``/``restore(state)`` checkpoint between batches: epoch,
+      batch cursor, and the string-width high-water marks — a small
+      JSON-serializable dict.  The RNG is counter-based, so no generator
+      state rides the checkpoint; resume is bit-identical to the
+      uninterrupted run.
+    * ``options`` is the scan scheduler's
+      :class:`~parquet_floor_tpu.scan.ScanOptions` (host face: coalesced
+      reads, prefetch budget, threads).  ``reader_options`` is the usual
+      :class:`~parquet_floor_tpu.ReaderOptions` (``io_retries`` for
+      flaky storage; ``salvage`` is rejected like everywhere the
+      concurrent scheduler runs, and ``verify_crc`` pins the host face).
+
+    Repeated (nested) columns are not batchable into fixed shapes and
+    raise at construction; project them away with ``columns=``.
+    """
+
+    def __init__(self, sources: Sequence, batch_size: int, *,
+                 columns: Optional[Sequence[str]] = None,
+                 shuffle_seed: Optional[int] = None,
+                 shuffle_window: int = 0,
+                 num_epochs: Optional[int] = 1,
+                 drop_remainder: bool = True,
+                 shard: Optional[tuple] = None,
+                 engine: str = "host",
+                 options: Optional[ScanOptions] = None,
+                 reader_options: Optional[ReaderOptions] = None,
+                 float64_policy: str = "bits"):
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        if engine not in ("host", "tpu"):
+            raise ValueError(f"bad engine {engine!r}: expected host|tpu")
+        if num_epochs is not None and num_epochs < 1:
+            raise ValueError(
+                f"num_epochs must be >= 1 or None (endless), got {num_epochs}"
+            )
+        if shuffle_window < 0:
+            raise ValueError(
+                f"shuffle_window must be >= 0, got {shuffle_window}"
+            )
+        if shuffle_window > 1 and shuffle_seed is None:
+            raise ValueError(
+                "shuffle_window needs shuffle_seed (window permutations "
+                "are keyed on it)"
+            )
+        if reader_options is not None and reader_options.salvage:
+            raise UnsupportedFeatureError(
+                "ReaderOptions.salvage is a sequential host-engine "
+                "feature; the loader's concurrent scan cannot honor its "
+                "quarantine bookkeeping"
+            )
+        if engine == "tpu" and reader_options is not None and \
+                reader_options.verify_crc:
+            raise UnsupportedFeatureError(
+                "ReaderOptions.verify_crc is a host-engine feature; use "
+                'engine="host" for CRC-checked loading'
+            )
+        self._sources = list(sources)
+        if not self._sources:
+            raise ValueError("DataLoader needs at least one source")
+        self._batch_size = int(batch_size)
+        self._seed = shuffle_seed
+        self._window = int(shuffle_window) if shuffle_window > 1 else 0
+        self._num_epochs = num_epochs
+        self._drop_remainder = bool(drop_remainder)
+        self._shard = (0, 1) if shard is None else (int(shard[0]), int(shard[1]))
+        self._engine = engine
+        self._scan = options or ScanOptions()
+        self._reader_options = reader_options
+        self._f64 = float64_policy
+        # the loader is ATTRIBUTED to the tracer scope active here, like
+        # DatasetScanner: all data.* metrics and the per-epoch reports
+        # land on it no matter which scope later drives iteration
+        self._tracer = trace.current()
+
+        self._units, self._selected = self._scan_footers(columns)
+        self._check_batchable()
+        self._shard_units = shard_units(self._units, *self._shard)
+        self._shard_rows = sum(u.num_rows for u in self._shard_units)
+        if self._drop_remainder:
+            self._n_batches = self._shard_rows // self._batch_size
+        else:
+            self._n_batches = -(-self._shard_rows // self._batch_size)
+
+        self._specs = [
+            ColumnSpec(
+                name=".".join(d.path) if len(d.path) > 1 else d.path[0],
+                descriptor=d,
+                is_string=d.physical_type == Type.BYTE_ARRAY,
+                has_mask=d.max_definition_level > 0,
+                f64_bits=(
+                    engine == "tpu"
+                    and d.physical_type == Type.DOUBLE
+                    and float64_policy == "bits"
+                ),
+            )
+            for d in self._selected
+        ]
+        self._widths: Dict[str, int] = {}  # string-width HWMs (checkpointed)
+        self._epoch = 0
+        self._batch_in_epoch = 0
+        self._gen = None
+        self._closed = False
+        self._epoch_reports: List[trace.ScanReport] = []
+        self._c0: Dict[str, int] = {}
+        self._s0: Dict[str, dict] = {}
+        self._gw: Optional[trace.GaugeWindow] = None
+        self._t_epoch: Optional[float] = None
+
+    # -- construction-time metadata scan ------------------------------------
+
+    def _scan_footers(self, columns):
+        """One footer-only pass over every source: the unit list (row
+        counts included — the resume arithmetic needs them), the selected
+        descriptors, the dataset schema check, and the parsed
+        ``ParquetMetadata`` per file (``self._meta`` — every later open,
+        on either face and in every epoch, reuses it instead of
+        re-parsing the footer), all before the first batch.  Sources
+        open fresh and close again (paths and factories re-open cheaply;
+        an already-open source object is consumed by this pass — pass a
+        factory if you need multi-open semantics)."""
+        want = set(columns) if columns else None
+        units: List[Unit] = []
+        selected = None
+        first_key = None
+        self._meta = []
+        for fi, src in enumerate(self._sources):
+            with ParquetFileReader(
+                _resolve_source(src), options=self._reader_options
+            ) as r:
+                key = dataset_schema_key(r.schema.columns)
+                if first_key is None:
+                    first_key = key
+                    selected = [
+                        c for c in r.schema.columns
+                        if want is None or c.path[0] in want
+                    ]
+                    if not selected:
+                        raise ValueError(
+                            f"columns={sorted(want)} selects nothing"
+                        )
+                elif key != first_key:
+                    raise ValueError(
+                        f"dataset file {fi} disagrees with the first "
+                        "file's schema"
+                    )
+                self._meta.append(r.metadata)
+                for gi, rg in enumerate(r.row_groups):
+                    units.append(Unit(fi, gi, int(rg.num_rows or 0)))
+        return units, selected
+
+    def _check_batchable(self):
+        repeated = [
+            ".".join(d.path) for d in self._selected
+            if d.max_repetition_level > 0
+        ]
+        if repeated:
+            raise UnsupportedFeatureError(
+                f"repeated columns {repeated} cannot batch into fixed "
+                "shapes; project them away with columns=..."
+            )
+
+    # -- iteration ----------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> LoaderBatch:
+        with trace.using(self._tracer):
+            return self._next_batch()
+
+    def _next_batch(self) -> LoaderBatch:
+        if self._closed:
+            raise StopIteration
+        if self._n_batches == 0:
+            raise StopIteration  # an empty shard is a valid no-op loader
+        while True:
+            if self._num_epochs is not None and \
+                    self._epoch >= self._num_epochs:
+                raise StopIteration
+            if self._batch_in_epoch >= self._n_batches:
+                if self._gen is not None:
+                    # the epoch's generator just emitted its last batch:
+                    # close it out (records the epoch report, advances
+                    # the epoch, resets the batch cursor)
+                    self._finish_epoch()
+                else:
+                    # restored exactly at an epoch end: no stream ran
+                    # here, so there is no report to record
+                    self._epoch += 1
+                    self._batch_in_epoch = 0
+                continue
+            if self._gen is None:
+                self._start_epoch()
+            with self._tracer.span("data.next_batch"):
+                try:
+                    batch = next(self._gen)
+                except StopIteration:
+                    self._finish_epoch()
+                    continue
+            self._batch_in_epoch += 1
+            self._tracer.count("data.batches_emitted")
+            self._tracer.count("data.rows_emitted", batch.num_valid)
+            if batch.num_valid < self._batch_size:
+                self._tracer.count(
+                    "data.rows_padded", self._batch_size - batch.num_valid
+                )
+            return batch
+
+    def _start_epoch(self):
+        plan = EpochPlan(
+            self._shard_units, self._seed, self._epoch, self._window
+        )
+        self._c0 = self._tracer.counters()
+        self._s0 = self._tracer.stats()
+        if self._gw is not None:       # restore() mid-epoch: stale window
+            self._gw.close()
+        self._gw = self._tracer.gauge_window()
+        self._t_epoch = time.perf_counter()
+        u0, _off = plan.resume_point(
+            self._batch_in_epoch, self._batch_size
+        )
+        self._tracer.decision("data.epoch_plan", {
+            "epoch": self._epoch,
+            "units": len(plan.units),
+            "rows": plan.total_rows,
+            "seed": self._seed,
+            "window": self._window,
+            "start_batch": self._batch_in_epoch,
+        })
+        self._tracer.count("data.units_scheduled", len(plan.units) - u0)
+        self._gen = self._epoch_batches(plan, self._epoch,
+                                        self._batch_in_epoch)
+
+    def _finish_epoch(self):
+        if self._gen is not None:
+            # the epoch generator may still be SUSPENDED at its last
+            # yield (the consumer stops pulling once n_batches arrived):
+            # close it explicitly so the scan/engine stream's finally
+            # runs NOW (workers drain, files close), not at GC time
+            self._gen.close()
+            self._gen = None
+        if self._drop_remainder:
+            # the remainder policy's loss, accounted centrally: the
+            # generator's own tail never runs in the normal case (it
+            # stays suspended at the last batch's yield), so the count
+            # cannot live there
+            tail = self._shard_rows - self._n_batches * self._batch_size
+            if tail:
+                self._tracer.count("data.rows_dropped", tail)
+        wall = (
+            time.perf_counter() - self._t_epoch
+            if self._t_epoch is not None else None
+        )
+        self._t_epoch = None
+        budget = self._scan.prefetch_bytes if self._engine == "host" else None
+        # gauges come from the epoch's window, not the cumulative tracer
+        # snapshot: a cumulative max cannot be delta'd, so epoch N must
+        # not inherit epoch N-1's high-water marks
+        gauges = self._gw.close() if self._gw is not None else {}
+        self._gw = None
+        self._epoch_reports.append(trace.scan_report_from(
+            _delta_stats(self._s0, self._tracer.stats()),
+            _delta_counters(self._c0, self._tracer.counters()),
+            gauges,
+            wall_seconds=wall, budget_bytes=budget,
+        ))
+        self._tracer.count("data.epochs_completed")
+        self._epoch += 1
+        self._batch_in_epoch = 0
+
+    # -- the per-epoch pipeline ---------------------------------------------
+
+    def _epoch_batches(self, plan: EpochPlan, epoch: int, start_batch: int):
+        """Generator of this epoch's remaining batches: window-shuffled
+        source groups (the permutation fused into each group's decode —
+        device face — or applied eagerly per group — host face) →
+        carry-over batcher → remainder policy.  ``start_batch > 0`` is
+        the resume path: decode restarts at the interrupted unit and the
+        already-emitted head of its (re-derived) permuted output drops
+        before batching."""
+        B = self._batch_size
+        n_batches = plan.n_batches(B, self._drop_remainder)
+        if start_batch >= n_batches:
+            return
+        unit0, off0 = plan.resume_point(start_batch, B)
+        xp = self._xp()
+        fused = self._engine == "tpu"
+        batchbuf = RowBuffer(self._specs, xp, self._widths)
+        emitted = start_batch
+
+        def emit_ready():
+            """Every complete batch the buffer holds — in ONE compiled
+            dispatch on the device face, eager NumPy takes on host."""
+            nonlocal emitted
+            k = min(batchbuf.rows // B, n_batches - emitted)
+            if k <= 0:
+                return
+            if fused:
+                for parts in fused_assemble(
+                    self._specs, batchbuf.take_windows(k * B),
+                    batchbuf.widths, split=k,
+                ):
+                    yield make_batch(
+                        self._specs, parts, epoch, emitted, B, B, xp
+                    )
+                    emitted += 1
+            else:
+                for _ in range(k):
+                    yield make_batch(
+                        self._specs, batchbuf.take(B), epoch, emitted,
+                        B, B, xp,
+                    )
+                    emitted += 1
+
+        stream = (
+            self._host_groups(plan, unit0)
+            if self._engine == "host"
+            else self._device_groups(plan, unit0)
+        )
+        try:
+            first = True
+            for n_rows, parts in stream:
+                skip = off0 if first else 0
+                first = False
+                if (fused and batchbuf.rows == 0 and n_rows
+                        and n_rows % B == 0 and skip % B == 0):
+                    # GROUP-ALIGNED fast path: no carry pending and the
+                    # group cuts into whole batches — one static-slice
+                    # dispatch, no traced offsets, no concatenation
+                    # (docs/data.md: pick batch_size to divide the
+                    # writer's row-group size and stay on this path)
+                    grow_widths(self._specs, parts, self._widths)
+                    k = n_rows // B
+                    drop = skip // B  # resume: already-emitted head
+                    take = min(k - drop, n_batches - emitted)
+                    if take > 0:
+                        batches = aligned_split(
+                            self._specs, parts, self._widths, k
+                        )
+                        for j in range(drop, drop + take):
+                            yield make_batch(
+                                self._specs, batches[j], epoch, emitted,
+                                B, B, xp,
+                            )
+                            emitted += 1
+                    continue
+                batchbuf.push(parts, n_rows, skip)
+                yield from emit_ready()
+                self._tracer.gauge_max("data.carry_rows_max", batchbuf.rows)
+            # pad-remainder tail (drop-remainder's loss is accounted in
+            # _finish_epoch: this generator stays suspended at the last
+            # full batch's yield and never reaches here in that mode)
+            r = batchbuf.rows
+            if r and emitted < n_batches and not self._drop_remainder:
+                parts = fused_assemble(
+                    self._specs, batchbuf.take_windows(r),
+                    batchbuf.widths, pad=B - r,
+                )[0] if fused else batchbuf.take(r)
+                yield make_batch(
+                    self._specs, parts, epoch, emitted, B, r, xp
+                )
+        finally:
+            stream.close()
+
+    def _xp(self):
+        if self._engine == "host":
+            return np
+        import jax.numpy as jnp
+
+        return jnp
+
+    # -- the two decode faces -----------------------------------------------
+
+    def _host_groups(self, plan: EpochPlan, unit0: int):
+        """Group-permuted host decode through the scan scheduler
+        (``DatasetScanner(order=...)``, footers reused from
+        construction): coalesced vectored reads and bounded cross-file
+        prefetch run ahead of the batcher; each group's window
+        permutation applies eagerly (NumPy fancy-indexing) as it
+        arrives."""
+        from ..api.reader import _host_batch_columns
+        from ..scan.executor import DatasetScanner
+
+        order = plan.units[unit0:]
+        scanner = DatasetScanner(
+            self._sources,
+            columns=[d.path[0] for d in self._selected],
+            options=self._reader_options, scan=self._scan,
+            order=[(u.file_index, u.group_index) for u in order],
+            metadata=self._meta,
+        )
+        try:
+            for j, unit in enumerate(scanner):
+                cols = _host_batch_columns(
+                    self._selected, unit.batch, unit.group_index
+                )
+                parts = [self._host_part(c) for c in cols]
+                perm = plan.unit_perm(unit0 + j)
+                if perm is not None:
+                    parts = permute_parts(parts, perm)
+                yield unit.batch.num_rows, parts
+        finally:
+            scanner.close()
+
+    @staticmethod
+    def _host_part(bc):
+        """One host BatchColumn → the batcher's (values, mask, lengths)
+        triple; strings become padded byte rows (group-local width — the
+        buffer's HWM pads further)."""
+        from ..format.encodings.plain import ByteArrayColumn
+
+        if isinstance(bc.values, ByteArrayColumn):
+            return (
+                bc.values.padded_matrix(),
+                bc.mask,
+                np.asarray(bc.lengths, dtype=np.int64),
+            )
+        return np.asarray(bc.values), bc.mask, None
+
+    def _device_groups(self, plan: EpochPlan, unit0: int):
+        """Group-permuted device decode through the engine's WINDOWED
+        dataset pipeline: readers open lazily DEPTH-ahead of the
+        shuffled order (reusing the footers parsed at construction) and
+        close right after their last scheduled group, so fd usage
+        follows the order's locality, not the dataset size.  Each unit's
+        window permutation rides its decode executable (``out_perm``) —
+        the shuffle costs index arithmetic the decode already pays for,
+        not a separate device pass."""
+        from ..format.file_read import ParquetFileReader
+        from ..tpu.engine import TpuRowGroupReader, iter_dataset_row_groups
+
+        order = plan.units[unit0:]
+        last = {}
+        for j, u in enumerate(order):
+            last[u.file_index] = j
+        opened: dict = {}
+
+        def opener(fi):
+            def open_():
+                r = opened.get(fi)
+                if r is None:
+                    r = opened[fi] = TpuRowGroupReader(
+                        ParquetFileReader(
+                            _resolve_source(self._sources[fi]),
+                            options=self._reader_options,
+                            metadata=self._meta[fi],
+                        ),
+                        float64_policy=self._f64, dict_form="gather",
+                    )
+                return r
+            return open_
+
+        def tasks():
+            for j, u in enumerate(order):
+                yield (
+                    opener(u.file_index), u.group_index,
+                    j == last[u.file_index],
+                    plan.unit_perm(unit0 + j),
+                )
+
+        gen = iter_dataset_row_groups(
+            tasks(), columns=[d.path[0] for d in self._selected]
+        )
+        try:
+            for u, cols in zip(order, gen):
+                parts = []
+                for spec in self._specs:
+                    dc = cols.get(spec.name)
+                    if dc is None:
+                        raise ValueError(
+                            f"row group {u.group_index} missing column "
+                            f"{spec.name}"
+                        )
+                    parts.append((dc.values, dc.mask, dc.lengths))
+                yield u.num_rows, parts
+        finally:
+            gen.close()
+
+    # -- checkpoint / restore ------------------------------------------------
+
+    def _fingerprint(self) -> dict:
+        return {
+            "batch_size": self._batch_size,
+            "shuffle_seed": self._seed,
+            "shuffle_window": self._window,
+            "drop_remainder": self._drop_remainder,
+            "num_epochs": self._num_epochs,
+            "shard": list(self._shard),
+            "engine": self._engine,
+            "units": len(self._units),
+            "rows": self._shard_rows,
+            "columns": [s.name for s in self._specs],
+        }
+
+    def state(self) -> dict:
+        """The loader's position as a small JSON-serializable dict —
+        valid between batches.  Captures epoch, the next batch index,
+        and the string-width HWMs (batch shapes must replay), plus the
+        configuration fingerprint :meth:`restore` validates.  Seeds and
+        cursors fully determine the remaining stream (the RNG is
+        counter-based), so no generator state is stored."""
+        return {
+            "version": _STATE_VERSION,
+            "epoch": self._epoch,
+            "batch": self._batch_in_epoch,
+            "str_widths": dict(self._widths),
+            **self._fingerprint(),
+        }
+
+    def restore(self, state: dict) -> "DataLoader":
+        """Position this loader at a previously saved :meth:`state`.
+
+        The loader must be configured identically to the one that saved
+        the state (checked against the embedded fingerprint); the
+        remaining batch stream is then bit-identical to the
+        uninterrupted run's.  Restoring mid-iteration abandons the
+        current epoch stream first.  Returns ``self``::
+
+            loader = DataLoader(paths, 256, shuffle_seed=7).restore(ckpt)
+        """
+        if state.get("version") != _STATE_VERSION:
+            raise ValueError(
+                f"unknown loader state version {state.get('version')!r}"
+            )
+        fp = self._fingerprint()
+        bad = {
+            k: (state.get(k), fp[k]) for k in _FP_FIELDS
+            if state.get(k) != fp[k]
+        }
+        if bad:
+            raise ValueError(
+                "loader state does not match this configuration: "
+                + ", ".join(
+                    f"{k}: saved {s!r} vs here {h!r}"
+                    for k, (s, h) in sorted(bad.items())
+                )
+            )
+        epoch, batch = int(state["epoch"]), int(state["batch"])
+        if batch < 0 or (self._n_batches and batch > self._n_batches):
+            raise ValueError(
+                f"state batch {batch} outside epoch of "
+                f"{self._n_batches} batches"
+            )
+        if self._gen is not None:
+            self._gen.close()
+            self._gen = None
+        if self._gw is not None:       # abandoned epoch's gauge window
+            self._gw.close()
+            self._gw = None
+        self._epoch = epoch
+        self._batch_in_epoch = batch
+        self._widths = {
+            str(k): int(v) for k, v in (state.get("str_widths") or {}).items()
+        }
+        self._tracer.decision("data.resume", {"epoch": epoch, "batch": batch})
+        return self
+
+    # -- health --------------------------------------------------------------
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch_size
+
+    @property
+    def shuffle_window(self) -> int:
+        """The effective window (0 when shuffling is off or degenerate)."""
+        return self._window
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self._n_batches
+
+    @property
+    def rows_per_epoch(self) -> int:
+        """Real rows per epoch in THIS host's shard."""
+        return self._shard_rows
+
+    @property
+    def epoch_reports(self) -> List[trace.ScanReport]:
+        """One :class:`~parquet_floor_tpu.utils.trace.ScanReport` per
+        COMPLETED epoch — counters/stages as delta snapshots of the
+        loader's tracer, gauges from a per-epoch
+        :meth:`~parquet_floor_tpu.utils.trace.Tracer.gauge_window`
+        (empty dicts unless that tracer is enabled)."""
+        return list(self._epoch_reports)
+
+    def report(self) -> trace.ScanReport:
+        """The dataset-level summary: completed epochs' reports folded
+        through ``ScanReport.merge`` (the same merge per-host reports
+        use); before any epoch completes, a whole-run snapshot."""
+        if self._epoch_reports:
+            return trace.ScanReport.merge(self._epoch_reports)
+        return self._tracer.scan_report(
+            budget_bytes=(
+                self._scan.prefetch_bytes if self._engine == "host" else None
+            )
+        )
+
+    def close(self) -> None:
+        """Abandon the current epoch stream (drains scan workers and
+        closes files); idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._gen is not None:
+            self._gen.close()
+            self._gen = None
+        if self._gw is not None:
+            self._gw.close()
+            self._gw = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

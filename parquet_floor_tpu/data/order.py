@@ -1,0 +1,167 @@
+"""Deterministic epoch order plans — the seeded, shardable, resumable
+ordering layer of the training input pipeline (``docs/data.md``).
+
+Everything here is pure metadata math over the unit list the loader read
+from footers: no I/O, no mutable RNG.  Randomness is **counter-based**
+(numpy ``Philox`` keyed by ``(seed, purpose, epoch, position)``), so
+every draw is a pure function of its coordinates — the checkpoint never
+has to serialize generator state, only seeds and cursors, and a resumed
+stream replays the exact permutations of the uninterrupted one.
+
+Three layers:
+
+* **unit shard** — the global ``(file, row_group)`` unit list splits into
+  contiguous per-host blocks (the ``parallel.multihost`` convention), so
+  multihost loaders never overlap.  The shard, not the global list, is
+  the shuffle domain: a host's stream depends only on (its shard's
+  units, seed, epoch) — re-partitioning the fleet changes which units a
+  host owns, but a host whose shard is unchanged replays the same
+  stream.
+* **unit permutation** — per epoch, the shard's units permute under a
+  generator keyed on ``(seed, epoch)``.
+* **window (block) shuffle** — each unit's rows chop into consecutive
+  blocks of ``window`` rows and every block permutes, under a generator
+  keyed on ``(seed, epoch, unit position)``.  Blocks never span units:
+  the TPU engine then fuses each unit's whole-rows permutation into its
+  decode executable (``out_perm``) — the shuffle rides the decode's own
+  index arithmetic instead of paying a separate device pass — and the
+  resume arithmetic needs only (unit index, row offset), never partial
+  block state.  Cross-unit mixing comes from the unit permutation
+  above; the window bounds how far rows move *within* a unit.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+_MASK64 = (1 << 64) - 1
+# fixed odd multipliers (splitmix64 constants) keying the two streams
+_STREAM_UNITS = 1
+_STREAM_BLOCK = 2
+
+
+class Unit(NamedTuple):
+    """One schedulable decode unit: a row group of one dataset file."""
+
+    file_index: int
+    group_index: int
+    num_rows: int
+
+
+def keyed_rng(seed: int, stream: int, epoch: int,
+              index: int = 0) -> np.random.Generator:
+    """A counter-based generator for one (seed, stream, epoch, index)
+    coordinate — same coordinates, same draws, on every run and host."""
+    mix = (
+        stream * 0x9E3779B97F4A7C15
+        + epoch * 0xBF58476D1CE4E5B9
+        + index * 0x94D049BB133111EB
+    ) & _MASK64
+    key = np.array([int(seed) & _MASK64, mix], dtype=np.uint64)
+    return np.random.Generator(np.random.Philox(key=key))
+
+
+def shard_units(units: Sequence[Unit], host_index: int,
+                host_count: int) -> List[Unit]:
+    """The contiguous block of ``units`` host ``host_index`` owns.
+
+    Host ``p`` takes ``units[p*k : (p+1)*k]`` with ``k = ceil(n /
+    host_count)`` — the same contiguous convention as
+    ``parallel.multihost`` (block sharding preserves file locality, so a
+    host's shuffled epoch touches only its own files).  Shards are
+    disjoint and cover every unit; trailing hosts may own fewer (or
+    zero) units when the counts don't divide.
+    """
+    if host_count < 1:
+        raise ValueError(f"host_count must be >= 1, got {host_count}")
+    if not 0 <= host_index < host_count:
+        raise ValueError(
+            f"host_index {host_index} outside [0, {host_count})"
+        )
+    k = -(-len(units) // host_count) if units else 0
+    return list(units[host_index * k : (host_index + 1) * k])
+
+
+class EpochPlan:
+    """The fully-determined order of one (epoch, shard): permuted units,
+    row prefix sums, per-unit window permutations, and the resume
+    arithmetic."""
+
+    def __init__(self, units: Sequence[Unit], seed: Optional[int],
+                 epoch: int, window: int = 0):
+        if window < 0:
+            raise ValueError(f"window must be >= 0, got {window}")
+        if window > 1 and seed is None:
+            raise ValueError(
+                "a shuffle window needs a shuffle_seed (the window "
+                "permutations are keyed on it)"
+            )
+        self.seed = seed
+        self.epoch = int(epoch)
+        # window <= 1 is identity: no row ever moves
+        self.window = int(window) if window > 1 else 0
+        units = list(units)
+        if seed is not None and len(units) > 1:
+            perm = keyed_rng(seed, _STREAM_UNITS, epoch).permutation(
+                len(units)
+            )
+            units = [units[int(i)] for i in perm]
+        self.units: List[Unit] = units
+        starts = np.zeros(len(units) + 1, dtype=np.int64)
+        np.cumsum([u.num_rows for u in units], out=starts[1:])
+        self._starts = starts
+        self.total_rows = int(starts[-1])
+
+    # -- batch / unit arithmetic --------------------------------------------
+
+    def n_batches(self, batch_size: int, drop_remainder: bool) -> int:
+        if drop_remainder:
+            return self.total_rows // batch_size
+        return -(-self.total_rows // batch_size)
+
+    def unit_perm(self, pos: int) -> Optional[np.ndarray]:
+        """The whole-rows output permutation of the unit at (permuted)
+        position ``pos`` — a pure function of (seed, epoch, pos) and the
+        unit's row count, or ``None`` when no window shuffle is active.
+
+        Rows chop into consecutive ``window``-row blocks (the tail block
+        may be short) and each block permutes independently; the
+        concatenation is one int32 permutation the TPU engine fuses into
+        the unit's decode (``out_perm``)."""
+        if not self.window:
+            return None
+        n = self.units[pos].num_rows
+        rng = keyed_rng(self.seed, _STREAM_BLOCK, self.epoch, pos)
+        parts = [
+            off + rng.permutation(min(self.window, n - off))
+            for off in range(0, n, self.window)
+        ]
+        if not parts:
+            return np.zeros(0, dtype=np.int32)
+        return np.concatenate(parts).astype(np.int32, copy=False)
+
+    def locate_row(self, row: int) -> Tuple[int, int]:
+        """(unit index, row offset within it) of output-stream position
+        ``row`` — zero-row units are skipped by construction."""
+        if not 0 <= row < self.total_rows:
+            raise ValueError(
+                f"row {row} outside epoch of {self.total_rows} rows"
+            )
+        i = int(np.searchsorted(self._starts, row, side="right")) - 1
+        return i, row - int(self._starts[i])
+
+    def resume_point(self, batches_done: int, batch_size: int
+                     ) -> Tuple[int, int]:
+        """Where to restart so that batch ``batches_done`` is the next
+        one emitted: ``(unit_index, rows_to_drop)`` — decode restarts at
+        ``unit_index`` (whose permutation re-derives exactly — it is a
+        pure function of its position) and the first ``rows_to_drop``
+        rows of its permuted output were already emitted before the
+        checkpoint.  Because blocks never span units, no partial block
+        state exists to reconstruct."""
+        skip = batches_done * batch_size
+        if skip == 0:
+            return 0, 0
+        return self.locate_row(skip)

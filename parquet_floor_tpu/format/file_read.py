@@ -222,7 +222,13 @@ class ParquetFileReader:
 
     def __init__(self, source, verify_crc: bool = False,
                  salvage: bool = False,
-                 options: Optional[ReaderOptions] = None):
+                 options: Optional[ReaderOptions] = None,
+                 metadata: Optional[ParquetMetadata] = None):
+        """``metadata``: a pre-parsed footer for THIS file, reused
+        instead of re-reading and re-parsing it — how multi-epoch
+        loaders re-open dataset files cheaply (the thrift footer parse
+        dominates a warm re-open).  The caller owns the claim that it
+        matches the source; nothing re-validates it here."""
         if options is None:
             opts = ReaderOptions(verify_crc=verify_crc, salvage=salvage)
         elif verify_crc or salvage:
@@ -247,7 +253,9 @@ class ParquetFileReader:
             src = RetryingSource(src, opts.io_retries, opts.io_retry_backoff_s)
         self.source = src
         try:
-            self.metadata: ParquetMetadata = read_footer(self.source)
+            self.metadata: ParquetMetadata = (
+                metadata if metadata is not None else read_footer(self.source)
+            )
         except BaseException:
             if owns_source:
                 # corrupt-footer raises are a hot path (directory sniffs,

@@ -41,6 +41,22 @@ from .shard import ShardedNestedColumn, _pad_rows
 _pad_np = partial(_pad_rows, xp=np)
 
 
+def host_shard() -> Tuple[int, int]:
+    """This process's ``(host_index, host_count)`` — the value
+    ``data.DataLoader(shard=...)`` wants for multihost training.
+
+    The loader shards the dataset's ``(file, row_group)`` unit list into
+    contiguous per-host blocks (the same convention
+    :func:`read_dataset_sharded` uses for its row-group blocks), so
+    loaders built with ``shard=host_shard()`` on every host read
+    disjoint units and never overlap.  Per-host loader ``ScanReport``\\ s
+    serialize (``as_dict``) and fold into one dataset-level summary with
+    ``trace.ScanReport.merge`` — ``trace.scope()`` is contextvar-based
+    and never crosses process boundaries, so the merge is explicit.
+    """
+    return jax.process_index(), jax.process_count()
+
+
 def _agree_max(matrix: np.ndarray) -> np.ndarray:
     """Global elementwise max of one small per-host integer matrix
     (identity under one process).  A plain read uses exactly one of

@@ -222,6 +222,9 @@ class _FileState:
     cache: PrefetchedSource
     plan: FilePlan
     remaining: int  # groups not yet delivered; 0 → file closes
+    plan_map: Optional[dict] = None    # group_index -> GroupPlan (order mode)
+    keep: Optional[Set[int]] = None    # predicate survivors (order mode)
+    num_groups: int = 0                # footer group count (order mode)
 
 
 class _Work(NamedTuple):
@@ -235,7 +238,11 @@ def _source_chain(source, options: Optional[ReaderOptions]) -> PrefetchedSource:
     REAL I/O, below the prefetch cache: a cache hit must never consume
     retry budget, and the reader above gets ``io_retries=0`` so the
     double-wrap guard keeps meaning one bounded retry loop per physical
-    read."""
+    read.  A zero-arg callable source is a FACTORY (resolved here, at
+    open time — how multi-epoch loaders re-open custom source objects
+    lazily)."""
+    if callable(source) and not hasattr(source, "read_at"):
+        source = source()
     src = source if hasattr(source, "read_at") else FileSource(source)
     try:
         if options is not None and options.io_retries > 0 and \
@@ -271,6 +278,17 @@ class DatasetScanner:
     An empty ``sources`` list yields nothing (an empty dataset directory
     is a valid no-op scan).
 
+    ``order`` generalizes delivery beyond the default (file order, then
+    row-group order): an explicit sequence of ``(file_index,
+    group_index)`` units, each at most once, delivered exactly in that
+    sequence — the shape a seeded-shuffled training epoch wants
+    (``data.DataLoader``, docs/data.md).  Only ordered units are read; a
+    file opens at its FIRST ordered unit and closes right after its last
+    one delivers, so fd usage follows the order's file locality rather
+    than the dataset size.  ``predicate`` composes by intersection:
+    ordered units whose group the predicate pruned are skipped (never
+    read).  An out-of-range or duplicate unit raises ``ValueError``.
+
     Use as an iterator, ideally under ``with`` (or call :meth:`close`):
     abandoning mid-scan drains the worker pool and closes every file.
     """
@@ -278,9 +296,39 @@ class DatasetScanner:
     def __init__(self, sources: Sequence, columns: Optional[Sequence[str]] = None,
                  options: Optional[ReaderOptions] = None,
                  scan: Optional[ScanOptions] = None,
-                 predicate=None):
+                 predicate=None,
+                 order: Optional[Sequence] = None,
+                 metadata: Optional[Sequence] = None):
         _reject_salvage(options)
         self._sources = list(sources)
+        if metadata is not None and len(metadata) != len(self._sources):
+            raise ValueError(
+                f"metadata has {len(metadata)} entries for "
+                f"{len(self._sources)} source(s)"
+            )
+        # pre-parsed footers, one per source (None entries re-parse):
+        # multi-epoch loaders re-open files every epoch, and the thrift
+        # footer parse dominates a warm re-open
+        self._metadata = list(metadata) if metadata is not None else None
+        self._order = None
+        self._occurrences: Optional[dict] = None
+        if order is not None:
+            self._order = [(int(fi), int(gi)) for fi, gi in order]
+            occurrences: dict = {}
+            seen = set()
+            for fi, gi in self._order:
+                if not 0 <= fi < len(self._sources):
+                    raise ValueError(
+                        f"order unit (file {fi}, group {gi}) outside "
+                        f"dataset of {len(self._sources)} file(s)"
+                    )
+                if (fi, gi) in seen:
+                    raise ValueError(
+                        f"order lists unit (file {fi}, group {gi}) twice"
+                    )
+                seen.add((fi, gi))
+                occurrences[fi] = occurrences.get(fi, 0) + 1
+            self._occurrences = occurrences
         self._filter: Optional[Set[str]] = set(columns) if columns else None
         self._options = options
         self._scan = scan or ScanOptions()
@@ -347,8 +395,10 @@ class DatasetScanner:
         opts = self._options
         cache = _source_chain(self._sources[fi], opts)
         reader_opts = replace(opts, io_retries=0) if opts is not None else None
+        meta = self._metadata[fi] if self._metadata is not None else None
         try:
-            reader = ParquetFileReader(cache, options=reader_opts)
+            reader = ParquetFileReader(cache, options=reader_opts,
+                                       metadata=meta)
         except BaseException:
             cache.close()
             raise
@@ -381,7 +431,18 @@ class DatasetScanner:
             reader.close()
             raise
         self._meta_by_file[fi] = reader.metadata
-        state = _FileState(reader, cache, plan, remaining=len(plan.groups))
+        if self._occurrences is not None:
+            # order mode: the file stays open until every one of its
+            # ORDERED units has delivered (or been skipped as pruned) —
+            # the count of order entries, not of planned groups
+            remaining = self._occurrences[fi]
+        else:
+            remaining = len(plan.groups)
+        state = _FileState(
+            reader, cache, plan, remaining=remaining,
+            plan_map={gp.group_index: gp for gp in plan.groups},
+            keep=keep, num_groups=len(reader.row_groups),
+        )
         self._files[fi] = state
         if state.remaining == 0:
             self._close_file(fi)
@@ -393,11 +454,36 @@ class DatasetScanner:
             state.reader.close()
 
     def _gen_work(self):
-        for fi in range(len(self._sources)):
-            state = self._open_file(fi)
-            for gp in state.plan.groups:
-                cost = max(gp.read_bytes, gp.uncompressed_bytes, 1)
-                yield _Work(fi, gp, cost)
+        if self._order is None:
+            for fi in range(len(self._sources)):
+                state = self._open_file(fi)
+                for gp in state.plan.groups:
+                    cost = max(gp.read_bytes, gp.uncompressed_bytes, 1)
+                    yield _Work(fi, gp, cost)
+            return
+        for fi, gi in self._order:
+            state = self._files.get(fi)
+            if state is None:
+                # not-yet-opened (a closed file never reappears: its
+                # remaining counts every order entry, so it closes only
+                # after its last one)
+                state = self._open_file(fi)
+            gp = state.plan_map.get(gi)
+            if gp is None:
+                if not 0 <= gi < state.num_groups:
+                    raise ValueError(
+                        f"order unit (file {fi}, group {gi}) outside file "
+                        f"with {state.num_groups} row group(s)"
+                    )
+                # the unit exists but the predicate pruned it: skip
+                # without reading — and retire its order slot so the
+                # file still closes after its last ordered unit
+                state.remaining -= 1
+                if state.remaining == 0:
+                    self._close_file(fi)
+                continue
+            cost = max(gp.read_bytes, gp.uncompressed_bytes, 1)
+            yield _Work(fi, gp, cost)
 
     # -- worker task --------------------------------------------------------
 
@@ -549,13 +635,14 @@ class DatasetScanner:
 def scan_batches(sources: Sequence, columns: Optional[Sequence[str]] = None,
                  options: Optional[ReaderOptions] = None,
                  scan: Optional[ScanOptions] = None,
-                 predicate=None):
+                 predicate=None, order: Optional[Sequence] = None):
     """Generator of :class:`ScanUnit` over a dataset — the functional face
     of :class:`DatasetScanner` (closes the scanner when the generator is
-    exhausted, closed, or abandoned)."""
+    exhausted, closed, or abandoned).  ``order`` is the scanner's explicit
+    unit order (permuted delivery — see :class:`DatasetScanner`)."""
     scanner = DatasetScanner(
         sources, columns=columns, options=options, scan=scan,
-        predicate=predicate,
+        predicate=predicate, order=order,
     )
     try:
         yield from scanner

@@ -585,7 +585,26 @@ def _levels_i32(arena, slab, off_slot: int, count: int):
     return lax.bitcast_convert_type(l8.reshape(count, 4), jnp.int32).reshape(count)
 
 
-def _decode_col(spec: _ColSpec, arena, slab, extras):
+def _take_opt(a, perm):
+    return None if a is None else jnp.take(a, perm, axis=0)
+
+
+def _decode_col(spec: _ColSpec, arena, slab, extras, perm=None):
+    """``perm`` fuses an output row permutation into THIS column's
+    program.  It pushes down to the cheapest row-aligned point per kind:
+    dictionary kinds permute the (narrow) index stream before the value
+    gather, string kinds permute starts/lengths before the byte gather,
+    byte-stream-split permutes its page coordinates — for all of those
+    the permutation rides index arithmetic the decode already pays for.
+    Kinds with no row-aligned intermediate (plain, bool, delta, host
+    fallbacks, optional columns after dense scatter) gather their
+    outputs instead.  Repeated leaves are not row-aligned at all — the
+    caller rejects them before tracing."""
+    # in-branch pushdown is only valid while the expansion streams are
+    # row-aligned, i.e. for required columns; optional columns permute
+    # after _finish_optional densifies them
+    rp = perm if spec.max_def == 0 and spec.max_rep == 0 else None
+    applied = False
     if spec.kind == "host":
         u8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.n * spec.width,))
         vals = _typed(u8, spec.n, spec.width, spec.vdtype, spec.f64mode)
@@ -593,6 +612,8 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         if spec.max_def > 0:
             m = lax.dynamic_slice(arena, (slab[spec.sc_off + 1],), (spec.n,))
             mask = m != 0
+        if perm is not None:
+            vals, mask = _take_opt(vals, perm), _take_opt(mask, perm)
         return vals, mask, None, None, None
     if spec.kind == "host_rows":
         u8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.n * spec.width,))
@@ -601,6 +622,8 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         if spec.max_def > 0:
             m = lax.dynamic_slice(arena, (slab[spec.sc_off + 1],), (spec.n,))
             mask = m != 0
+        if perm is not None:
+            vals, mask = _take_opt(vals, perm), _take_opt(mask, perm)
         return vals, mask, None, None, None
     if spec.kind == "host_str":
         r8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.n * spec.max_len,))
@@ -611,6 +634,11 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         if spec.max_def > 0:
             m = lax.dynamic_slice(arena, (slab[spec.sc_off + 2],), (spec.n,))
             mask = m != 0
+        if perm is not None:
+            rows, mask, lens = (
+                _take_opt(rows, perm), _take_opt(mask, perm),
+                _take_opt(lens, perm),
+            )
         return rows, mask, lens, None, None
     if spec.kind == "hostr":
         # host-decoded repeated column: dense value stream + level arrays
@@ -636,6 +664,9 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
     # --- expansion-based kinds: dict / dict_str / plain / bool / delta ----
     if spec.kind == "dict":
         idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp, spec.pl_idx)
+        if rp is not None:
+            idx = jnp.take(idx, rp)  # narrow-stream pushdown: ~free
+            applied = True
         # clamped gather, not dynamic_slice: the bucketed capacity may
         # overrun the arena tail (padding rows are garbage, never indexed)
         dpos = slab[spec.sc_off] + jnp.arange(
@@ -649,6 +680,9 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         rows_d = extras[2 * spec.extra_idx]
         lens_d = extras[2 * spec.extra_idx + 1]
         idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp, spec.pl_idx)
+        if rp is not None:
+            idx = jnp.take(idx, rp)  # narrow-stream pushdown: ~free
+            applied = True
         vals = jnp.take(rows_d, idx, axis=0)
         lens = jnp.take(lens_d, idx)
     elif spec.kind in ("dict_idx", "dict_idx_num"):
@@ -657,6 +691,9 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         # fetch n×1..4 bytes instead of gathered values; the pool rides
         # extras (strings) or host memory (numerics) untouched)
         idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp, spec.pl_idx)
+        if rp is not None:
+            idx = jnp.take(idx, rp)  # narrow-stream pushdown: ~free
+            applied = True
         if spec.dict_cap <= (1 << 8):
             vals = idx.astype(jnp.uint8)
         elif spec.dict_cap <= (1 << 16):
@@ -678,6 +715,11 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         # the device gathers each value's bytes into padded rows
         starts = lax.slice(slab, (spec.pg_off,), (spec.pg_off + spec.nexp,))
         lens = lax.slice(slab, (spec.sc_off,), (spec.sc_off + spec.nexp,))
+        if rp is not None:
+            # permute the per-row byte coordinates; the (already
+            # random-access) byte gather then lands rows pre-shuffled
+            starts, lens = jnp.take(starts, rp), jnp.take(lens, rp)
+            applied = True
         lane = jnp.arange(spec.max_len, dtype=jnp.int32)[None, :]
         pos = starts[:, None] + lane
         rows = jnp.take(
@@ -694,6 +736,14 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
         base, pgi, within, cnt = _page_lookup(
             slab, spec.pg_off, spec.p_pad, spec.nexp
         )
+        if rp is not None:
+            # permute the page coordinates (cnt is row-aligned too); the
+            # strided byte gather (already random-access) lands rows
+            # pre-shuffled
+            pgi = jnp.take(pgi, rp)
+            within = jnp.take(within, rp)
+            cnt = jnp.take(cnt, rp)
+            applied = True
         k = jnp.arange(spec.width, dtype=jnp.int32)[None, :]
         bytepos = base[pgi][:, None] + k * cnt[:, None] + within[:, None]
         u8 = jnp.take(
@@ -758,7 +808,18 @@ def _decode_col(spec: _ColSpec, arena, slab, extras):
     if spec.max_def > 0:
         present = _levels_present(arena, slab, spec)
         dense, mask, dlens = _finish_optional(vals, present, lens)
+        if perm is not None:
+            # optional columns are row-aligned only after the dense
+            # scatter — permute the densified outputs
+            dense = jnp.take(dense, perm, axis=0)
+            mask = jnp.take(mask, perm, axis=0)
+            dlens = _take_opt(dlens, perm)
         return dense, mask, dlens, None, None
+    if perm is not None and not applied:
+        # kinds with no row-aligned intermediate (plain / bool / delta):
+        # gather the finished outputs
+        vals = jnp.take(vals, perm, axis=0)
+        lens = _take_opt(lens, perm)
     return vals, None, lens, None, None
 
 
@@ -773,6 +834,57 @@ def _decode_fused(program: tuple, n_parts: int, *arrays):
     parts, slab, extras = arrays[:n_parts], arrays[n_parts], arrays[n_parts + 1:]
     arena = parts[0] if n_parts == 1 else jnp.concatenate(parts)
     return tuple(_decode_col(spec, arena, slab, extras) for spec in program)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _decode_fused_perm(program: tuple, n_parts: int, *arrays):
+    """:func:`_decode_fused` with an output row permutation fused into
+    the SAME executable: the trailing array is ``perm`` (int32, one
+    entry per row) and every column's row-aligned outputs come back as
+    ``x[perm]``.  XLA folds the gather into each column's final output
+    write (for gather-formulated kinds it composes with the existing
+    index arithmetic), so a loader's window shuffle costs a reordered
+    write pattern, not a separate full pass over the decoded bytes.
+    Repeated leaves (dense value stream + levels, not row-aligned)
+    cannot ride this path — the caller guards."""
+    parts, slab = arrays[:n_parts], arrays[n_parts]
+    extras, perm = arrays[n_parts + 1:-1], arrays[-1]
+    arena = parts[0] if n_parts == 1 else jnp.concatenate(parts)
+    return tuple(
+        _decode_col(spec, arena, slab, extras, perm) for spec in program
+    )
+
+
+@jax.jit
+def _take_rows(perm, *arrays):
+    return tuple(jnp.take(a, perm, axis=0) for a in arrays)
+
+
+def _permuted_columns(cols: "Dict[str, DeviceColumn]", perm
+                      ) -> "Dict[str, DeviceColumn]":
+    """Row-permute already-decoded columns in one fused call — the
+    fallback for paths where the permutation could not ride the decode
+    executable itself (oversized multi-launch groups)."""
+    flat, layout = [], []
+    for name, dc in cols.items():
+        if dc.def_levels is not None or dc.rep_levels is not None:
+            from ..errors import UnsupportedFeatureError
+
+            raise UnsupportedFeatureError(
+                "out_perm cannot permute repeated columns (the dense "
+                "value stream is not row-aligned); project them away"
+            )
+        arrs = [dc.values, dc.mask, dc.lengths]
+        layout.append((name, dc, [a is not None for a in arrs]))
+        flat.extend(a for a in arrs if a is not None)
+    taken = iter(_take_rows(perm, *flat))
+    out: Dict[str, DeviceColumn] = {}
+    for name, dc, have in layout:
+        vals, mask, lens = (next(taken) if h else None for h in have)
+        nd = DeviceColumn(dc.descriptor, vals, mask, lens, None, None)
+        nd.dict_ref = dc.dict_ref
+        out[name] = nd
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1899,17 +2011,27 @@ class TpuRowGroupReader:
         )
 
     def read_row_group(
-        self, index: int, columns: Optional[Sequence[str]] = None
+        self, index: int, columns: Optional[Sequence[str]] = None,
+        out_perm=None,
     ) -> Dict[str, DeviceColumn]:
+        """``out_perm`` (int32, one entry per row) fuses an output row
+        permutation into the decode executable — every column returns as
+        ``x[perm]`` at the cost of a reordered output write, not a
+        separate device pass.  Oversized (multi-launch) groups apply it
+        as one follow-up gather per column instead; repeated columns
+        reject it."""
         rg = self.reader.row_groups[index]
         want = set(columns) if columns else None
         if self._group_byte_estimate(rg, want) > self._arena_cap:
             # oversized group: split into multiple launches instead of
             # erroring (the reference streams page-at-a-time with no
             # group-size ceiling at all, ParquetReader.java:182-194)
-            return self._read_row_group_chunked(rg, index, want)
+            out = self._read_row_group_chunked(rg, index, want)
+            if out_perm is not None:
+                out = _permuted_columns(out, out_perm)
+            return out
         sg = self._stage_row_group(index, columns)
-        return self._launch(sg)
+        return self._launch(sg, out_perm=out_perm)
 
     def _launch_pipelined(self, stage_calls):
         """Run several (args, kwargs) ``_stage_row_group`` calls as a
@@ -2426,10 +2548,16 @@ class TpuRowGroupReader:
             pos += 2
         return shipped
 
-    def _decode_shipped(self, sg: _StagedGroup, shipped: list
-                        ) -> Dict[str, DeviceColumn]:
+    def _decode_shipped(self, sg: _StagedGroup, shipped: list,
+                        out_perm=None) -> Dict[str, DeviceColumn]:
         """Dispatch the fused decode over already-shipped device buffers
-        (asynchronous: returned arrays are futures until materialized)."""
+        (asynchronous: returned arrays are futures until materialized).
+
+        ``out_perm`` (int32, one entry per row) fuses an output row
+        permutation into the decode executable itself — every column
+        comes back as ``x[perm]`` for the price of a reordered output
+        write (the loader's window shuffle).  Repeated leaves are not
+        row-aligned and reject it."""
         first, slab_dev = shipped[0], shipped[1]
         parts = first if isinstance(first, tuple) else (first,)
         extra_args = []
@@ -2437,12 +2565,27 @@ class TpuRowGroupReader:
             rows_d, lens_d = self._sdict_dev[key]
             extra_args.append(rows_d)
             extra_args.append(lens_d)
+        if out_perm is not None and any(
+            spec.max_rep > 0 for spec in sg.program
+        ):
+            from ..errors import UnsupportedFeatureError
+
+            raise UnsupportedFeatureError(
+                "out_perm cannot permute repeated columns (the dense "
+                "value stream is not row-aligned); project them away"
+            )
         with trace.span("decode", attrs={"file": sg.source,
                                          "row_group": sg.group_index,
                                          "rows": sg.num_rows}):
-            outs = _decode_fused(
-                sg.program, len(parts), *parts, slab_dev, *extra_args
-            )
+            if out_perm is None:
+                outs = _decode_fused(
+                    sg.program, len(parts), *parts, slab_dev, *extra_args
+                )
+            else:
+                outs = _decode_fused_perm(
+                    sg.program, len(parts), *parts, slab_dev, *extra_args,
+                    out_perm,
+                )
         result: Dict[str, DeviceColumn] = {}
         for spec, desc, (vals, mask, lens, defs, reps) in zip(
             sg.program, sg.descs, outs
@@ -2465,8 +2608,9 @@ class TpuRowGroupReader:
             result[spec.name] = dc
         return result
 
-    def _launch(self, sg: _StagedGroup) -> Dict[str, DeviceColumn]:
-        return self._decode_shipped(sg, self._ship(sg))
+    def _launch(self, sg: _StagedGroup, out_perm=None
+                ) -> Dict[str, DeviceColumn]:
+        return self._decode_shipped(sg, self._ship(sg), out_perm=out_perm)
 
 
 # ---------------------------------------------------------------------------
@@ -2491,89 +2635,181 @@ def iter_dataset_row_groups(tasks, columns: Optional[Sequence[str]] = None,
     decode via the multi-launch chunk path outside the pipeline, exactly
     as in the single-file iterator; the runs of normal groups between
     them keep the pipeline.
+
+    ``tasks`` may also be an ITERATOR (anything that is not a
+    list/tuple) — the windowed form shuffled training epochs over
+    fd-limit-sized datasets need.  Iterator items are ``(reader,
+    group_index)``, ``(reader, group_index, close_after)`` or ``(reader,
+    group_index, close_after, out_perm)``, where ``reader`` may be a
+    zero-argument callable returning a ``TpuRowGroupReader`` (a lazy
+    open: the file's footer is not touched until the pipeline pulls the
+    task, DEPTH ahead of consumption) and ``close_after=True`` marks the
+    reader's LAST scheduled group — the reader closes as soon as that
+    group is consumed, so at most the in-flight window's worth of files
+    is ever open.  ``close_after`` must only be set on a reader's final
+    task (the pipeline runs DEPTH ahead; a later task on a closed reader
+    is a caller bug).  ``out_perm`` (int32, one entry per row) fuses an
+    output row permutation into that group's decode executable — see
+    :meth:`TpuRowGroupReader.read_row_group`.  Readers the pipeline
+    opened via callables are pipeline-owned: any still open when the
+    generator finishes, errors, or is abandoned are closed.  Delivery
+    order and decoded bytes are identical to the eager (list) path over
+    the same task sequence.
     """
-    tasks = list(tasks)
-    want = set(columns) if columns else None
-    big = {
-        j for j, (r, i) in enumerate(tasks)
-        if r._group_byte_estimate(r.reader.row_groups[i], want) > r._arena_cap
-    }
-    if big:
-        run: List[tuple] = []
-        for j, (r, i) in enumerate(tasks):
-            if j in big:
-                if run:
-                    yield from _iter_pipeline(run, columns, prefetch)
-                    run = []
+    if isinstance(tasks, (list, tuple)):
+        tasks = list(tasks)
+        if not prefetch or len(tasks) <= 1:
+            for r, i in tasks:
                 yield r.read_row_group(i, columns)
-            else:
-                run.append((r, i))
-        if run:
-            yield from _iter_pipeline(run, columns, prefetch)
+            return
+        # an eager list knows its reader set up front: single-file runs
+        # default one level shallower (each level of depth pins a host
+        # arena, and there is no file boundary whose footer-warm stage
+        # needs the extra hiding room)
+        multi_file = len({id(r) for r, _ in tasks}) > 1
+        yield from _iter_pipeline_stream(
+            iter(tasks), columns, prefetch,
+            default_depth="3" if multi_file else "2",
+        )
         return
-    yield from _iter_pipeline(tasks, columns, prefetch)
+    yield from _iter_pipeline_stream(iter(tasks), columns, prefetch)
 
 
-def _iter_pipeline(tasks, columns, prefetch: bool):
-    """The 3-stage pipeline over normal-sized ``(reader, index)`` tasks."""
-    if not prefetch or len(tasks) <= 1:
-        for r, i in tasks:
-            yield r.read_row_group(i, columns)
-        return
+def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
+                          default_depth: str = "3"):
+    """The stage‖ship‖decode dataset pipeline, driven by a task
+    iterator — BOTH faces of ``iter_dataset_row_groups`` run through
+    here (the eager list form wraps itself in ``iter``), so there is
+    exactly one copy of the submission loop, the drain-then-chunk
+    big-group handling, and the tracer-scope threading.
 
-    def ship_task(r, stage_fut):
-        sg = stage_fut.result()
-        return r, sg, r._ship(sg)
+    Two dedicated single-worker pools make a true 3-stage pipeline: the
+    stage worker runs up to DEPTH tasks ahead (bounded: each staged
+    group pins a host arena), the ship worker transfers each group as
+    soon as it is staged AND the previous transfer is done (one in
+    flight — sync_transfers semantics; readers of one dataset share the
+    single ship worker, so transfers never interleave even across
+    files), and the consumer's thread dispatches the fused decode while
+    it materializes.  Steady-state throughput → max(stage, ship,
+    decode+consume) instead of their sum.  ``PFTPU_PREFETCH_DEPTH=1``
+    restores single-group lookahead if memory is tight.
 
-    # Two dedicated single-worker pools make a true 3-stage pipeline:
-    # the stage worker runs up to DEPTH groups ahead (bounded: each
-    # staged group pins a host arena), the ship worker transfers each
-    # group as soon as it is staged AND the previous transfer is done
-    # (one in flight — sync_transfers semantics; readers of one dataset
-    # share the single ship worker, so transfers never interleave even
-    # across files), and the consumer's thread dispatches the fused
-    # decode while it materializes.  Steady-state throughput →
-    # max(stage, ship, decode+consume) instead of their sum.  Each level
-    # of depth pins one more host arena (and its shipped device copy):
-    # PFTPU_PREFETCH_DEPTH=1 restores the old single-group lookahead if
-    # memory is tight.  Multi-file task lists default one level deeper:
-    # crossing a boundary costs a footer-warm stage with no decode to
-    # hide under, and the extra staged arena buys that hiding room.
+    Because tasks pull lazily, files open DEPTH-ahead of consumption
+    and close right after their last scheduled group (``close_after``)
+    — the fd-bounded form ``iter_dataset_row_groups`` documents."""
     import os as _os
 
-    multi_file = len({id(r) for r, _ in tasks}) > 1
+    want = set(columns) if columns else None
     DEPTH = max(1, int(
-        _os.environ.get("PFTPU_PREFETCH_DEPTH", "3" if multi_file else "2")
+        _os.environ.get("PFTPU_PREFETCH_DEPTH", default_depth)
     ))
-    n = len(tasks)
     # stage/ship tasks bind to the caller's tracer scope: concurrent
     # scans under separate trace.scope()s keep their stage‖ship spans
     # attributed even though each scan spawns its own worker threads
     tracer = trace.current()
-    with ThreadPoolExecutor(max_workers=1,
-                            thread_name_prefix="pftpu-stage") as sp, \
-            ThreadPoolExecutor(max_workers=1,
-                               thread_name_prefix="pftpu-ship") as shp:
-        # chunked=False: intra-group chunked shipping would issue
-        # transfers from the stage worker concurrently with the ship
-        # worker's — two streams contend on tunnelled links, and a
-        # chunked group 0 would only delay group 1's staging in the
-        # single stage worker; the cross-group pipeline provides the
-        # overlap here (single-group reads take read_row_group's
-        # chunked path instead)
-        ship_q = deque()
+    owned: List[TpuRowGroupReader] = []   # opened via task callables
+    closed: List[TpuRowGroupReader] = []  # already closed (identity)
 
-        def submit(j):
-            r, i = tasks[j]
-            f = sp.submit(
-                tracer.run, r._stage_row_group, i, columns, chunked=False
-            )
-            ship_q.append(shp.submit(tracer.run, ship_task, r, f))
+    def norm(item):
+        """Resolve one task item to (reader, group_index, close_after,
+        out_perm), opening lazy readers (and recording ownership) on the
+        way."""
+        r, gi = item[0], item[1]
+        ca = bool(item[2]) if len(item) > 2 else False
+        perm = item[3] if len(item) > 3 else None
+        if callable(r) and not isinstance(r, TpuRowGroupReader):
+            r = r()
+            if not any(o is r for o in owned):
+                owned.append(r)
+        return r, int(gi), ca, perm
 
-        for j in range(min(DEPTH, n)):
-            submit(j)
-        for k in range(n):
-            if DEPTH + k < n:
-                submit(DEPTH + k)
-            r, sg, shipped = ship_q.popleft().result()
-            yield r._decode_shipped(sg, shipped)
+    def retire(r):
+        """Close a reader whose last scheduled group was just consumed."""
+        if any(c is r for c in closed):
+            return
+        closed.append(r)
+        r.close()
+
+    try:
+        if not prefetch:
+            for item in task_iter:
+                r, gi, ca, perm = norm(item)
+                yield r.read_row_group(gi, columns, out_perm=perm)
+                if ca:
+                    retire(r)
+            return
+
+        def ship_task(r, stage_fut):
+            sg = stage_fut.result()
+            return r, sg, r._ship(sg)
+
+        with ThreadPoolExecutor(max_workers=1,
+                                thread_name_prefix="pftpu-stage") as sp, \
+                ThreadPoolExecutor(max_workers=1,
+                                   thread_name_prefix="pftpu-ship") as shp:
+            # entries: ("pipe", reader, close_after, perm, ship_future)
+            # or ("big", reader, group_index, close_after, perm)
+            q: deque = deque()
+            blocked = False  # a big group is queued: stop submitting
+
+            def submit_one():
+                nonlocal blocked
+                if blocked:
+                    return False
+                item = next(task_iter, None)
+                if item is None:
+                    return False
+                r, gi, ca, perm = norm(item)
+                big = (
+                    r._group_byte_estimate(r.reader.row_groups[gi], want)
+                    > r._arena_cap
+                )
+                if big:
+                    # drain-then-chunk, exactly the eager path's contract:
+                    # everything already queued delivers first, nothing
+                    # new submits, so when this entry is popped both
+                    # workers are idle and the multi-launch chunk path
+                    # owns the link
+                    q.append(("big", r, gi, ca, perm))
+                    blocked = True
+                else:
+                    # chunked=False: intra-group chunked shipping would
+                    # issue transfers from the stage worker concurrently
+                    # with the ship worker's — two streams contend on
+                    # tunnelled links (single-group reads take
+                    # read_row_group's chunked path instead)
+                    f = sp.submit(
+                        tracer.run, r._stage_row_group, gi, columns,
+                        chunked=False,
+                    )
+                    q.append((
+                        "pipe", r, ca, perm,
+                        shp.submit(tracer.run, ship_task, r, f),
+                    ))
+                return True
+
+            for _ in range(DEPTH):
+                if not submit_one():
+                    break
+            while q:
+                entry = q.popleft()
+                if entry[0] == "big":
+                    _, r, gi, ca, perm = entry
+                    yield r.read_row_group(gi, columns, out_perm=perm)
+                    blocked = False
+                else:
+                    _, r, ca, perm, fut = entry
+                    r2, sg, shipped = fut.result()
+                    yield r2._decode_shipped(sg, shipped, out_perm=perm)
+                if ca:
+                    retire(r)
+                while len(q) < DEPTH and submit_one():
+                    pass
+    finally:
+        # pipeline-owned readers left open (error, abandonment, or a
+        # task list that never set close_after) close here — AFTER the
+        # with-block above joined the stage/ship workers, so no in-flight
+        # stage read can race a close
+        for r in owned:
+            if not any(c is r for c in closed):
+                r.close()

@@ -48,7 +48,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Sequence
 
 
 class names:
@@ -74,10 +74,18 @@ class names:
         "salvage.rows_quarantined",
         "trace.decisions_dropped",
         "trace.events_dropped",
+        # the training input pipeline (data.DataLoader, docs/data.md)
+        "data.rows_emitted",
+        "data.batches_emitted",
+        "data.rows_padded",
+        "data.rows_dropped",
+        "data.epochs_completed",
+        "data.units_scheduled",
     })
     GAUGES = frozenset({
         "scan.inflight_bytes_max",
         "scan.queue_depth_max",
+        "data.carry_rows_max",
     })
     DECISIONS = frozenset({
         "engine.auto",
@@ -88,6 +96,8 @@ class names:
         "salvage.skip_page",
         "salvage.quarantine_chunk",
         "scan.plan",
+        "data.epoch_plan",
+        "data.resume",
     })
     SPANS = frozenset({
         "read",
@@ -97,6 +107,7 @@ class names:
         "assemble",
         "io.read",
         "scan.consumer_stall",
+        "data.next_batch",
     })
     ALL = COUNTERS | GAUGES | DECISIONS | SPANS
 
@@ -271,6 +282,188 @@ class ScanReport:
             )
         return "\n".join(lines)
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "ScanReport":
+        """Rebuild a report from its :meth:`as_dict` form — the
+        serialization half of the cross-process contract: per-host
+        loaders/scans ship ``as_dict()`` JSON over whatever transport the
+        deployment has (a collective, files, an RPC), and the coordinator
+        rebuilds and :meth:`merge`\\ s them."""
+        import dataclasses
+
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"not a ScanReport dict: unknown keys {sorted(unknown)}"
+            )
+        kwargs = {name: d.get(name) for name in known}
+        # as_dict() emits every field; tolerate older/partial dicts by
+        # zero-filling the additive fields and None-filling the optional ones
+        for name in ("bytes_read", "bytes_used", "bytes_prefetched",
+                     "cache_miss_bytes", "retries", "retry_exhausted",
+                     "pages_quarantined", "chunks_quarantined",
+                     "decisions_dropped", "events_dropped"):
+            kwargs[name] = int(kwargs[name] or 0)
+        kwargs["consumer_stall_seconds"] = float(
+            kwargs["consumer_stall_seconds"] or 0.0
+        )
+        kwargs["overread_ratio"] = float(kwargs["overread_ratio"] or 0.0)
+        kwargs["stages"] = dict(kwargs["stages"] or {})
+        kwargs["counters"] = dict(kwargs["counters"] or {})
+        kwargs["gauges"] = dict(kwargs["gauges"] or {})
+        return cls(**kwargs)
+
+    @classmethod
+    def merge(cls, reports: Sequence["ScanReport"]) -> "ScanReport":
+        """Fold per-host (or per-epoch) reports into one dataset-level
+        summary — the serializable merge the sharded loader needs
+        (``trace.scope()`` is contextvar-based and never crosses process
+        boundaries, so each host reports into its own tracer; this is
+        where those snapshots meet).
+
+        Aggregation rules: additive fields (bytes, retries, quarantines,
+        stall seconds, stage count/seconds/bytes, counters) SUM; gauges
+        (high-water marks) take the MAX; ``wall_seconds`` takes the max
+        (hosts run concurrently) while the stall/overlap fractions are
+        recomputed from summed stall over summed wall (aggregate
+        utilization, not an average of ratios); ``budget_bytes`` sums
+        and utilization is recomputed from the summed in-flight
+        high-water."""
+        reports = list(reports)
+        if not reports:
+            raise ValueError("ScanReport.merge needs at least one report")
+        stages: Dict[str, dict] = {}
+        for r in reports:
+            for name, st in r.stages.items():
+                acc = stages.setdefault(
+                    name, {"count": 0, "seconds": 0.0, "bytes": 0}
+                )
+                acc["count"] += int(st.get("count", 0))
+                acc["seconds"] += float(st.get("seconds", 0.0))
+                acc["bytes"] += int(st.get("bytes", 0))
+        for st in stages.values():
+            st["seconds"] = round(st["seconds"], 6)
+            st["MB_per_s"] = round(
+                (st["bytes"] / st["seconds"] / 1e6) if st["seconds"] else 0.0,
+                1,
+            )
+        counters: Dict[str, int] = {}
+        gauges: Dict[str, int] = {}
+        for r in reports:
+            for k, v in r.counters.items():
+                counters[k] = counters.get(k, 0) + int(v)
+            for k, v in r.gauges.items():
+                gauges[k] = max(gauges.get(k, -(1 << 62)), int(v))
+        walls = [r.wall_seconds for r in reports if r.wall_seconds is not None]
+        wall = max(walls) if walls else None
+        wall_sum = sum(walls)
+        stall = sum(r.consumer_stall_seconds for r in reports)
+        stall_frac = overlap = None
+        if wall_sum > 0:
+            stall_frac = round(min(stall / wall_sum, 1.0), 4)
+            overlap = round(1.0 - stall_frac, 4)
+        budgets = [r.budget_bytes for r in reports if r.budget_bytes]
+        budget = sum(budgets) if budgets else None
+        hwms = [
+            r.gauges.get("scan.inflight_bytes_max", 0)
+            for r in reports
+            if r.budget_bytes
+        ]
+        util = round(sum(hwms) / budget, 4) if budget else None
+        read = sum(r.bytes_read for r in reports)
+        used = sum(r.bytes_used for r in reports)
+        return cls(
+            wall_seconds=wall,
+            stages=stages,
+            consumer_stall_seconds=round(stall, 6),
+            stall_fraction=stall_frac,
+            overlap_fraction=overlap,
+            budget_bytes=budget,
+            budget_utilization=util,
+            bytes_read=read,
+            bytes_used=used,
+            overread_ratio=round((read - used) / read, 4) if read else 0.0,
+            bytes_prefetched=sum(r.bytes_prefetched for r in reports),
+            cache_miss_bytes=sum(r.cache_miss_bytes for r in reports),
+            retries=sum(r.retries for r in reports),
+            retry_exhausted=sum(r.retry_exhausted for r in reports),
+            pages_quarantined=sum(r.pages_quarantined for r in reports),
+            chunks_quarantined=sum(r.chunks_quarantined for r in reports),
+            decisions_dropped=sum(r.decisions_dropped for r in reports),
+            events_dropped=sum(r.events_dropped for r in reports),
+            counters=counters,
+            gauges=gauges,
+        )
+
+
+def scan_report_from(stats: Dict[str, dict], counters: Dict[str, int],
+                     gauges: Dict[str, int],
+                     wall_seconds: Optional[float] = None,
+                     budget_bytes: Optional[int] = None) -> ScanReport:
+    """Build a :class:`ScanReport` from explicit snapshots — the shared
+    derivation behind :meth:`Tracer.scan_report`, also usable on DELTA
+    snapshots (the loader's per-epoch reports subtract an epoch-start
+    snapshot from an epoch-end one before calling this)."""
+    stall = stats.get("scan.consumer_stall", {}).get("seconds", 0.0)
+    stall_frac = overlap = None
+    if wall_seconds is not None and wall_seconds > 0:
+        stall_frac = round(min(stall / wall_seconds, 1.0), 4)
+        overlap = round(1.0 - stall_frac, 4)
+    util = None
+    if budget_bytes:
+        util = round(
+            gauges.get("scan.inflight_bytes_max", 0) / budget_bytes, 4
+        )
+    read = counters.get("scan.bytes_read", 0)
+    used = counters.get("scan.bytes_used", 0)
+    return ScanReport(
+        wall_seconds=wall_seconds,
+        stages=stats,
+        consumer_stall_seconds=stall,
+        stall_fraction=stall_frac,
+        overlap_fraction=overlap,
+        budget_bytes=budget_bytes,
+        budget_utilization=util,
+        bytes_read=read,
+        bytes_used=used,
+        overread_ratio=round((read - used) / read, 4) if read else 0.0,
+        bytes_prefetched=counters.get("scan.bytes_prefetched", 0),
+        cache_miss_bytes=counters.get("scan.cache_miss_bytes", 0),
+        retries=counters.get("io.retries", 0),
+        retry_exhausted=counters.get("io.retry_exhausted", 0),
+        pages_quarantined=counters.get("salvage.pages_skipped", 0),
+        chunks_quarantined=counters.get("salvage.chunks_quarantined", 0),
+        decisions_dropped=counters.get("trace.decisions_dropped", 0),
+        events_dropped=counters.get("trace.events_dropped", 0),
+        counters=counters,
+        gauges=gauges,
+    )
+
+
+class GaugeWindow:
+    """A per-interval view of a tracer's high-water gauges (see
+    :meth:`Tracer.gauge_window`): records only the ``gauge_max`` writes
+    made while open, under the tracer's own lock, so worker threads
+    carried by :meth:`Tracer.run` land in the window too."""
+
+    def __init__(self, tracer: "Tracer"):
+        self._tracer = tracer
+        self._gauges: Dict[str, int] = {}
+
+    def gauges(self) -> Dict[str, int]:
+        """Snapshot of the maxima recorded while this window was open."""
+        with self._tracer._lock:
+            return dict(self._gauges)
+
+    def close(self) -> Dict[str, int]:
+        """Detach from the tracer and return the window's maxima;
+        idempotent."""
+        with self._tracer._lock:
+            if self in self._tracer._windows:
+                self._tracer._windows.remove(self)
+            return dict(self._gauges)
+
 
 class Tracer:
     """One isolated metrics/timeline store.  Thread-safe; every method is
@@ -292,6 +485,7 @@ class Tracer:
         self._stats: Dict[str, StageStat] = {}
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, int] = {}
+        self._windows: List["GaugeWindow"] = []
         self._decisions: deque = deque()
         self._events: deque = deque()   # (ph, name, ts, tid, attrs)
         self._thread_names: Dict[int, str] = {}
@@ -313,6 +507,8 @@ class Tracer:
             self._stats.clear()
             self._counters.clear()
             self._gauges.clear()
+            for w in self._windows:
+                w._gauges.clear()
             self._decisions.clear()
             self._events.clear()
             self._thread_names.clear()
@@ -352,6 +548,9 @@ class Tracer:
         with self._lock:
             if v > self._gauges.get(name, -(1 << 62)):
                 self._gauges[name] = v
+            for w in self._windows:
+                if v > w._gauges.get(name, -(1 << 62)):
+                    w._gauges[name] = v
 
     def counters(self) -> Dict[str, int]:
         """Snapshot of the ADDITIVE counters only (gauges live in
@@ -363,6 +562,19 @@ class Tracer:
         """Snapshot of the high-water gauges only."""
         with self._lock:
             return dict(self._gauges)
+
+    def gauge_window(self) -> "GaugeWindow":
+        """Open a windowed view of the high-water gauges: the returned
+        :class:`GaugeWindow` records only ``gauge_max`` writes made while
+        it is open.  A cumulative max cannot be delta'd the way counters
+        can (an epoch whose peak is below the run's peak never moves the
+        cumulative gauge), so per-interval reporters — the
+        ``DataLoader``'s per-epoch reports — observe the writes directly
+        instead.  Close it with :meth:`GaugeWindow.close`."""
+        w = GaugeWindow(self)
+        with self._lock:
+            self._windows.append(w)
+        return w
 
     def metrics(self) -> Dict[str, int]:
         """Merged counters+gauges snapshot — the pre-scope ``counters()``
@@ -525,42 +737,9 @@ class Tracer:
         total into stall/overlap fractions; ``budget_bytes`` (the scan's
         ``prefetch_bytes``) turns the in-flight high-water into a budget
         utilization."""
-        stats = self.stats()
-        counters = self.counters()
-        gauges = self.gauges()
-        stall = stats.get("scan.consumer_stall", {}).get("seconds", 0.0)
-        stall_frac = overlap = None
-        if wall_seconds is not None and wall_seconds > 0:
-            stall_frac = round(min(stall / wall_seconds, 1.0), 4)
-            overlap = round(1.0 - stall_frac, 4)
-        util = None
-        if budget_bytes:
-            util = round(
-                gauges.get("scan.inflight_bytes_max", 0) / budget_bytes, 4
-            )
-        read = counters.get("scan.bytes_read", 0)
-        used = counters.get("scan.bytes_used", 0)
-        return ScanReport(
-            wall_seconds=wall_seconds,
-            stages=stats,
-            consumer_stall_seconds=stall,
-            stall_fraction=stall_frac,
-            overlap_fraction=overlap,
-            budget_bytes=budget_bytes,
-            budget_utilization=util,
-            bytes_read=read,
-            bytes_used=used,
-            overread_ratio=round((read - used) / read, 4) if read else 0.0,
-            bytes_prefetched=counters.get("scan.bytes_prefetched", 0),
-            cache_miss_bytes=counters.get("scan.cache_miss_bytes", 0),
-            retries=counters.get("io.retries", 0),
-            retry_exhausted=counters.get("io.retry_exhausted", 0),
-            pages_quarantined=counters.get("salvage.pages_skipped", 0),
-            chunks_quarantined=counters.get("salvage.chunks_quarantined", 0),
-            decisions_dropped=counters.get("trace.decisions_dropped", 0),
-            events_dropped=counters.get("trace.events_dropped", 0),
-            counters=counters,
-            gauges=gauges,
+        return scan_report_from(
+            self.stats(), self.counters(), self.gauges(),
+            wall_seconds=wall_seconds, budget_bytes=budget_bytes,
         )
 
     def report(self) -> str:

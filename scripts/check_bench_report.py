@@ -56,6 +56,26 @@ def check_report(bench_log: pathlib.Path) -> int:
         return fail("scan_report.stages is empty")
     print(f"check_bench_report: scan_report ok ({len(rep['stages'])} stages, "
           f"{rep['bytes_read']} bytes read)")
+    return check_loader_leg(result.get("detail", {}))
+
+
+def check_loader_leg(detail: dict) -> int:
+    """The training-loader leg (docs/data.md): throughput reported, at
+    least one batch emitted, and the shuffled stream's key multiset
+    bit-identical to the unshuffled reference (the exactness bit is
+    deterministic — a False here is a real loader bug, not noise)."""
+    if not detail.get("loader_rows_per_sec", 0) > 0:
+        return fail("loader_rows_per_sec missing or not positive")
+    if not detail.get("loader_batches", 0) > 0:
+        return fail("loader leg emitted no batches")
+    if detail.get("loader_set_exact") is not True:
+        return fail("shuffled loader stream is not set-exact vs unshuffled")
+    print(
+        "check_bench_report: loader leg ok "
+        f"({detail['loader_batches']} batches, "
+        f"{detail['loader_rows_per_sec']} rows/s, "
+        f"vs scan x{detail.get('loader_vs_scan_x')})"
+    )
     return 0
 
 

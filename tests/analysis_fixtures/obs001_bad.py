@@ -10,3 +10,8 @@ def plan_one(extents):
     trace.count("scan.bytes_raed", sum(e.length for e in extents))  # typo
     with trace.span("decoed"):  # typo'd stage name
         return len(extents)
+
+
+def emit_batch(tracer, n):
+    tracer.count("data.rows_emited", n)  # typo'd loader counter
+    return n

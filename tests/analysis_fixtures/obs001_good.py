@@ -11,3 +11,12 @@ def plan_one(extents, metric_name):
     trace.count(metric_name, 1)  # dynamic: not checked
     with trace.span("decode", attrs={"extents": len(extents)}):
         return len(extents)
+
+
+def emit_batch(tracer, n):
+    # the data.* family (docs/data.md) is registered like every other
+    tracer.count("data.rows_emitted", n)
+    tracer.gauge_max("data.carry_rows_max", n)
+    tracer.decision("data.resume", {"epoch": 0, "batch": 0})
+    with tracer.span("data.next_batch"):
+        return n

@@ -154,6 +154,36 @@ def test_ranged_read_respects_cap(tmp_path, monkeypatch):
                 np.testing.assert_array_equal(got, dense, err_msg=nm)
 
 
+def test_out_perm_composes_with_chunking(tmp_path, monkeypatch):
+    """Oversized groups apply ``out_perm`` as a follow-up fused gather
+    (_permuted_columns) instead of riding the decode executable: the
+    permuted chunked read must equal the unpermuted read indexed by the
+    permutation, across required/optional/string columns."""
+    path = _write_mixed(tmp_path / "op.parquet", n=4000, groups=1)
+    monkeypatch.setenv("PFTPU_ARENA_CAP", str(24 << 10))
+    rng = np.random.default_rng(5)
+    perm = rng.permutation(4000).astype(np.int32)
+    with TpuRowGroupReader(path, float64_policy="float64") as tr:
+        est = tr._group_byte_estimate(tr.reader.row_groups[0])
+        assert est > tr._arena_cap  # the chunk path actually runs
+        plain = tr.read_row_group(0)
+        shuffled = tr.read_row_group(0, out_perm=perm)
+    for nm, dc in plain.items():
+        sc = shuffled[nm]
+        np.testing.assert_array_equal(
+            np.asarray(sc.values), np.asarray(dc.values)[perm], err_msg=nm
+        )
+        if dc.mask is not None:
+            np.testing.assert_array_equal(
+                np.asarray(sc.mask), np.asarray(dc.mask)[perm], err_msg=nm
+            )
+        if dc.lengths is not None:
+            np.testing.assert_array_equal(
+                np.asarray(sc.lengths), np.asarray(dc.lengths)[perm],
+                err_msg=nm,
+            )
+
+
 def test_no_offset_index_falls_back(tmp_path, monkeypatch):
     """A single over-cap column in a file WITHOUT an OffsetIndex cannot
     row-split: the device engine host-decodes the whole column in one

@@ -1,0 +1,731 @@
+"""Training input pipeline (``parquet_floor_tpu.data``): deterministic
+seeded order plans, carry-over batching, host sharding, and the
+checkpoint/resume contract (``docs/data.md``).
+
+The load-bearing claims pinned here: same seed ⇒ bit-identical batch
+stream on every run (host and device faces); a loader restored from
+``state()`` at ANY batch index emits exactly the remaining stream of the
+uninterrupted run; host shards are disjoint and depend only on the
+shard's units (not the fleet size); fault-injected transient retries
+never perturb the stream; and the scanner/engine order plumbing the
+loader rides (``DatasetScanner(order=...)``, windowed
+``iter_dataset_row_groups``) delivers permuted units bit-identically to
+the eager per-file loop."""
+
+import json
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    ParquetFileReader,
+    ReaderOptions,
+    UnsupportedFeatureError,
+    trace,
+)
+from parquet_floor_tpu.data import (
+    DataLoader,
+    EpochPlan,
+    Unit,
+    keyed_rng,
+    shard_units,
+)
+from parquet_floor_tpu.data.batcher import ColumnSpec, RowBuffer, make_batch
+from parquet_floor_tpu.scan import DatasetScanner, ScanOptions
+from parquet_floor_tpu.testing import FaultInjectingSource
+
+from tests.test_scan import _write
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = tmp_path_factory.mktemp("data_ds")
+    return [_write(str(d / f"f{i}.parquet"), seed=i) for i in range(4)]
+
+
+def _batch_bytes(b):
+    """One batch's full content as comparable bytes (valid rows only:
+    the pad-width HWM may differ across faces, never the values)."""
+    out = []
+    n = b.num_valid
+    for c in b.columns:
+        v = np.asarray(c.values)
+        if v.ndim == 2 and c.lengths is not None:
+            ln = np.asarray(c.lengths)[:n].astype(np.int64)
+            out.append(ln.tobytes())
+            out.append(b"".join(
+                v[i, : ln[i]].tobytes() for i in range(n)
+            ))
+        elif c.mask is not None:
+            # zero the null slots: their payload is unspecified (the
+            # faces fill them differently), only the mask is contractual
+            m = np.asarray(c.mask)[:n]
+            out.append(np.where(m, np.zeros_like(v[:n]), v[:n]).tobytes())
+        else:
+            out.append(v[:n].tobytes())
+        if c.mask is not None:
+            out.append(np.asarray(c.mask)[:n].tobytes())
+    return b"".join(out)
+
+
+def _stream(paths, engine="host", restore_at=None, loader_kw=None,
+            batch=256, **kw):
+    """The loader's full batch stream as bytes; ``restore_at=k`` runs a
+    first loader to batch ``k``, checkpoints through JSON (the state
+    must survive serialization), and collects the rest from a fresh
+    restored loader."""
+    kw.setdefault("shuffle_seed", 7)
+    kw.setdefault("shuffle_window", 512)
+    kw.setdefault("num_epochs", 2)
+    kw.setdefault("drop_remainder", False)
+    kw.update(loader_kw or {})
+    ld = DataLoader(paths, batch, engine=engine, **kw)
+    out = []
+    if restore_at is not None:
+        it = iter(ld)
+        for _ in range(restore_at):
+            next(it)
+        state = json.loads(json.dumps(ld.state()))
+        ld.close()
+        ld = DataLoader(paths, batch, engine=engine, **kw).restore(state)
+    for b in ld:
+        out.append(_batch_bytes(b))
+    ld.close()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# order plan math
+# ---------------------------------------------------------------------------
+
+
+def test_keyed_rng_is_counter_based():
+    a = keyed_rng(7, 2, 3, 5).permutation(100)
+    b = keyed_rng(7, 2, 3, 5).permutation(100)
+    assert np.array_equal(a, b)
+    assert not np.array_equal(a, keyed_rng(7, 2, 4, 5).permutation(100))
+    assert not np.array_equal(a, keyed_rng(8, 2, 3, 5).permutation(100))
+
+
+def test_shard_units_disjoint_cover_contiguous():
+    units = [Unit(i // 2, i % 2, 100 + i) for i in range(10)]
+    for hc in (1, 2, 3, 4, 10, 11):
+        shards = [shard_units(units, h, hc) for h in range(hc)]
+        flat = [u for s in shards for u in s]
+        assert flat == units  # contiguous blocks, in order, covering all
+    with pytest.raises(ValueError):
+        shard_units(units, 2, 2)
+    with pytest.raises(ValueError):
+        shard_units(units, 0, 0)
+
+
+def test_epoch_plan_permutation_keyed_on_seed_and_epoch():
+    units = [Unit(0, i, 10) for i in range(16)]
+    p0 = EpochPlan(units, 7, 0).units
+    assert EpochPlan(units, 7, 0).units == p0
+    assert EpochPlan(units, 7, 1).units != p0
+    assert EpochPlan(units, 8, 0).units != p0
+    assert sorted(p0) == sorted(units)
+    assert EpochPlan(units, None, 0).units == units  # no seed: file order
+
+
+def test_epoch_plan_window_blocks_never_span_units():
+    units = [Unit(0, 0, 700), Unit(0, 1, 300)]
+    plan = EpochPlan(units, 3, 0, window=256)
+    for pos, u in enumerate(plan.units):
+        perm = plan.unit_perm(pos)
+        assert perm.shape == (u.num_rows,)
+        assert np.array_equal(np.sort(perm), np.arange(u.num_rows))
+        # each 256-row block permutes within itself (the tail is short)
+        for off in range(0, u.num_rows, 256):
+            blk = perm[off : off + 256]
+            lo, hi = off, min(off + 256, u.num_rows)
+            assert blk.min() >= lo and blk.max() < hi
+    assert plan.unit_perm(0) is not None
+    assert EpochPlan(units, 3, 0, window=0).unit_perm(0) is None
+    assert EpochPlan(units, 3, 0, window=1).unit_perm(0) is None
+
+
+def test_epoch_plan_resume_arithmetic():
+    units = [Unit(0, 0, 700), Unit(0, 1, 300), Unit(1, 0, 500)]
+    plan = EpochPlan(units, None, 0)
+    assert plan.total_rows == 1500
+    assert plan.n_batches(256, True) == 5
+    assert plan.n_batches(256, False) == 6
+    assert plan.resume_point(0, 256) == (0, 0)
+    assert plan.resume_point(2, 256) == (0, 512)
+    assert plan.resume_point(3, 256) == (1, 68)   # 768 - 700
+    assert plan.resume_point(5, 256) == (2, 280)  # 1280 - 1000
+    with pytest.raises(ValueError):
+        plan.locate_row(1500)
+    with pytest.raises(ValueError):
+        EpochPlan(units, None, 0, window=256)  # window needs a seed
+
+
+# ---------------------------------------------------------------------------
+# batcher
+# ---------------------------------------------------------------------------
+
+
+def _spec(name="x"):
+    class D:  # minimal stand-in descriptor
+        path = (name,)
+    return ColumnSpec(name=name, descriptor=D(), is_string=False,
+                      has_mask=False)
+
+
+def test_row_buffer_carry_over_and_alignment():
+    spec = _spec()
+    buf = RowBuffer([spec], np, {})
+    buf.push([(np.arange(10), None, None)], 10)
+    buf.push([(np.arange(10, 17), None, None)], 7)
+    (v, m, ln), = buf.take(12)
+    assert np.array_equal(v, np.arange(12)) and m is None and ln is None
+    assert buf.rows == 5
+    (v2, _, _), = buf.take(5)
+    assert np.array_equal(v2, np.arange(12, 17))
+    with pytest.raises(ValueError):
+        buf.take(1)
+
+
+def test_row_buffer_push_skip_drops_head():
+    spec = _spec()
+    buf = RowBuffer([spec], np, {})
+    buf.push([(np.arange(10), None, None)], 10, skip=4)
+    assert buf.rows == 6
+    (v, _, _), = buf.take(6)
+    assert np.array_equal(v, np.arange(4, 10))
+
+
+def test_make_batch_pads_and_masks_tail():
+    spec = _spec()
+    b = make_batch([spec], [(np.arange(3.0), None, None)], epoch=1,
+                   index=9, batch_size=8, valid=3, xp=np)
+    assert b.epoch == 1 and b.index == 9
+    assert b.batch_size == 8 and b.num_valid == 3
+    assert np.array_equal(np.asarray(b.row_mask),
+                          np.arange(8) < 3)
+    v = np.asarray(b.columns[0].values)
+    assert np.array_equal(v[:3], np.arange(3.0)) and not v[3:].any()
+    full = make_batch([spec], [(np.arange(8.0), None, None)], 0, 0, 8, 8, np)
+    assert full.row_mask is None
+
+
+# ---------------------------------------------------------------------------
+# loader: determinism, shuffling, sharding (host face)
+# ---------------------------------------------------------------------------
+
+
+def test_same_seed_same_stream_across_runs(dataset):
+    s1 = _stream(dataset)
+    s2 = _stream(dataset)
+    assert s1 == s2 and len(s1) > 20
+
+
+def test_shuffle_reorders_but_preserves_the_multiset(dataset):
+    ref = _stream(dataset, shuffle_seed=None, shuffle_window=0,
+                  num_epochs=1)
+    shuf = _stream(dataset, num_epochs=1)
+    assert shuf != ref
+    with ParquetFileReader(dataset[0]) as r:
+        pass
+
+    def keys(stream_kw):
+        out = []
+        with DataLoader(dataset, 256, num_epochs=1, drop_remainder=False,
+                        **stream_kw) as ld:
+            for b in ld:
+                out.append(np.asarray(b.column("k").values)[: b.num_valid])
+        return np.sort(np.concatenate(out))
+
+    assert np.array_equal(
+        keys(dict(shuffle_seed=7, shuffle_window=512)),
+        keys(dict(shuffle_seed=None)),
+    )
+
+
+def test_epochs_differ_but_replay(dataset):
+    s = _stream(dataset, num_epochs=2)
+    per_epoch = len(s) // 2
+    assert s[:per_epoch] != s[per_epoch:]  # epoch 1 reshuffles
+    assert _stream(dataset, num_epochs=2) == s
+
+
+def test_shards_are_disjoint_and_cover(dataset):
+    def keys(shard):
+        out = [np.zeros(0, np.int64)]
+        with DataLoader(dataset, 64, shuffle_seed=5, num_epochs=1,
+                        drop_remainder=False, shard=shard) as ld:
+            for b in ld:
+                out.append(np.asarray(b.column("k").values)[: b.num_valid])
+        return np.concatenate(out)
+
+    whole = np.sort(keys((0, 1)))
+    parts = [keys((h, 3)) for h in range(3)]
+    assert sum(len(p) for p in parts) == len(whole)
+    assert np.array_equal(np.sort(np.concatenate(parts)), whole)
+
+
+def test_stream_depends_only_on_the_shard_units(dataset):
+    # 4 files x 2 groups = 8 units: ceil(8/4) == ceil(8/5) == 2, so host 1
+    # owns units[2:4] under BOTH fleet sizes — its stream must not change
+    a = _stream(dataset, loader_kw={"shard": (1, 4)}, batch=64)
+    b = _stream(dataset, loader_kw={"shard": (1, 5)}, batch=64)
+    assert a == b and len(a) > 0
+
+
+def test_empty_shard_is_a_valid_noop_loader(dataset):
+    # 8 units, host_count=11 -> k=1: hosts 8..10 own nothing
+    with DataLoader(dataset, 64, shard=(9, 11), num_epochs=1) as ld:
+        assert ld.batches_per_epoch == 0
+        assert list(ld) == []
+
+
+def test_drop_remainder_and_padding(dataset):
+    with trace.scope() as t:
+        with DataLoader(dataset, 256, num_epochs=1,
+                        drop_remainder=True) as ld:
+            batches = list(ld)
+            rows = ld.rows_per_epoch
+    assert len(batches) == rows // 256
+    assert all(b.num_valid == 256 and b.row_mask is None for b in batches)
+    # the dropped tail is ACCOUNTED, never silent: emitted + dropped
+    # add back up to the epoch's real rows
+    assert rows % 256 > 0  # the fixture must exercise a real remainder
+    assert t.counters().get("data.rows_dropped") == rows % 256
+    assert t.counters()["data.rows_emitted"] + rows % 256 == rows
+    with DataLoader(dataset, 256, num_epochs=1, drop_remainder=False) as ld:
+        padded = list(ld)
+    assert len(padded) == -(-rows // 256)
+    tail = padded[-1]
+    assert tail.num_valid == rows - 256 * (len(padded) - 1)
+    assert np.asarray(tail.row_mask).sum() == tail.num_valid
+
+
+# ---------------------------------------------------------------------------
+# loader: checkpoint / resume
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("at", [1, 7, 23])
+def test_host_resume_is_bit_identical(dataset, at):
+    full = _stream(dataset)
+    assert _stream(dataset, restore_at=at) == full[at:]
+
+
+def test_host_resume_across_the_epoch_boundary(dataset):
+    full = _stream(dataset)
+    per_epoch = len(full) // 2
+    at = per_epoch + 3  # a batch index inside epoch 1
+    assert _stream(dataset, restore_at=at) == full[at:]
+
+
+def test_restore_rejects_mismatched_configuration(dataset):
+    with DataLoader(dataset, 256, shuffle_seed=7, num_epochs=1) as ld:
+        state = ld.state()
+    with DataLoader(dataset, 128, shuffle_seed=7, num_epochs=1) as other:
+        with pytest.raises(ValueError, match="batch_size"):
+            other.restore(state)
+    with DataLoader(dataset, 256, shuffle_seed=8, num_epochs=1) as other:
+        with pytest.raises(ValueError, match="shuffle_seed"):
+            other.restore(state)
+    with DataLoader(dataset, 256, shuffle_seed=7, num_epochs=1) as same:
+        with pytest.raises(ValueError, match="version"):
+            same.restore({**state, "version": 99})
+        with pytest.raises(ValueError, match="outside"):
+            same.restore({**state, "batch": 10_000})
+        same.restore(state)  # the matching configuration restores fine
+
+
+def test_state_is_json_serializable(dataset):
+    with DataLoader(dataset, 256, shuffle_seed=7, shuffle_window=512,
+                    num_epochs=2) as ld:
+        next(iter(ld))
+        state = ld.state()
+    rt = json.loads(json.dumps(state))
+    assert rt == state
+    assert state["epoch"] == 0 and state["batch"] == 1
+
+
+# ---------------------------------------------------------------------------
+# loader: device face
+# ---------------------------------------------------------------------------
+
+
+def test_device_stream_is_deterministic(dataset):
+    s1 = _stream(dataset, engine="tpu", num_epochs=1,
+                 loader_kw={"float64_policy": "float64"})
+    s2 = _stream(dataset, engine="tpu", num_epochs=1,
+                 loader_kw={"float64_policy": "float64"})
+    assert s1 == s2 and len(s1) > 10
+
+
+def test_device_stream_matches_host_values(dataset):
+    host = _stream(dataset, num_epochs=1)
+    dev = _stream(dataset, engine="tpu", num_epochs=1,
+                  loader_kw={"float64_policy": "float64"})
+    assert dev == host
+
+
+@pytest.mark.parametrize("at", [3, 19])
+def test_device_resume_is_bit_identical(dataset, at):
+    kw = dict(engine="tpu", loader_kw={"float64_policy": "float64"})
+    full = _stream(dataset, **kw)
+    assert _stream(dataset, restore_at=at, **kw) == full[at:]
+
+
+def test_device_batches_are_jax_arrays(dataset):
+    import jax
+
+    with DataLoader(dataset, 256, shuffle_seed=7, num_epochs=1,
+                    engine="tpu") as ld:
+        b = next(iter(ld))
+    assert isinstance(b.columns[0].values, jax.Array)
+    assert b.column("k").values.shape == (256,)
+
+
+# ---------------------------------------------------------------------------
+# loader: validation and edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_constructor_validation(dataset):
+    with pytest.raises(ValueError, match="batch_size"):
+        DataLoader(dataset, 0)
+    with pytest.raises(ValueError, match="engine"):
+        DataLoader(dataset, 8, engine="gpu")
+    with pytest.raises(ValueError, match="num_epochs"):
+        DataLoader(dataset, 8, num_epochs=0)
+    with pytest.raises(ValueError, match="shuffle_window"):
+        DataLoader(dataset, 8, shuffle_window=-1)
+    with pytest.raises(ValueError, match="shuffle_seed"):
+        DataLoader(dataset, 8, shuffle_window=64)  # window without seed
+    with pytest.raises(ValueError, match="at least one source"):
+        DataLoader([], 8)
+    with pytest.raises(UnsupportedFeatureError, match="salvage"):
+        DataLoader(dataset, 8, reader_options=ReaderOptions(salvage=True))
+    with pytest.raises(UnsupportedFeatureError, match="verify_crc"):
+        DataLoader(dataset, 8, engine="tpu",
+                   reader_options=ReaderOptions(verify_crc=True))
+    with pytest.raises(ValueError, match="selects nothing"):
+        DataLoader(dataset, 8, columns=["nope"])
+
+
+def test_verify_crc_allowed_on_the_host_face(dataset):
+    ref = _stream(dataset, num_epochs=1)
+    crc = _stream(dataset, num_epochs=1, loader_kw={
+        "reader_options": ReaderOptions(verify_crc=True),
+    })
+    assert crc == ref
+
+
+def test_repeated_columns_rejected(tmp_path):
+    from parquet_floor_tpu import ParquetFileWriter, types
+
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.repeated(types.INT32).named("tags"),
+    )
+    p = str(tmp_path / "rep.parquet")
+    with ParquetFileWriter(p, schema) as w:
+        w.write_columns({"k": [1, 2], "tags": [[1, 2], [3]]})
+    with pytest.raises(UnsupportedFeatureError, match="repeated"):
+        DataLoader([p], 2)
+    # projecting the repeated column away makes the file loadable
+    with DataLoader([p], 2, columns=["k"], num_epochs=1,
+                    drop_remainder=False) as ld:
+        (b,) = list(ld)
+        assert np.array_equal(np.asarray(b.column("k").values)[:2], [1, 2])
+
+
+def test_columns_projection(dataset):
+    with DataLoader(dataset, 128, columns=["k", "s"], shuffle_seed=3,
+                    num_epochs=1) as ld:
+        b = next(iter(ld))
+    assert [c.descriptor.path[0] for c in b.columns] == ["k", "s"]
+
+
+def test_closed_loader_stops(dataset):
+    ld = DataLoader(dataset, 128, num_epochs=1)
+    next(iter(ld))
+    ld.close()
+    ld.close()  # idempotent
+    with pytest.raises(StopIteration):
+        next(iter(ld))
+
+
+def test_factory_sources_reopen_per_epoch(dataset):
+    opens = []
+
+    def factory(path):
+        def make():
+            opens.append(path)
+            from parquet_floor_tpu.io.source import FileSource
+
+            return FileSource(path)
+        return make
+
+    ref = _stream(dataset, num_epochs=2)
+    got = _stream([factory(p) for p in dataset], num_epochs=2)
+    assert got == ref
+    assert len(opens) >= len(dataset)  # footer pass + each epoch's reads
+
+
+# ---------------------------------------------------------------------------
+# fault injection: transient retries never perturb the stream
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["host", "tpu"])
+def test_transient_faults_do_not_perturb_order(dataset, engine):
+    kw = {} if engine == "host" else {"float64_policy": "float64"}
+    ref = _stream(dataset, engine=engine, num_epochs=1, loader_kw=kw)
+
+    def faulty(path, seed):
+        def make():
+            return FaultInjectingSource(
+                path, seed=seed, transient_error_rate=0.05,
+                max_transient_failures=8,
+            )
+        return make
+
+    got = _stream(
+        [faulty(p, i) for i, p in enumerate(dataset)],
+        engine=engine, num_epochs=1,
+        loader_kw={**kw, "reader_options": ReaderOptions(io_retries=16)},
+    )
+    assert got == ref
+
+
+# ---------------------------------------------------------------------------
+# reports
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_reports_and_merged_summary(dataset):
+    with trace.scope() as t:
+        with DataLoader(dataset, 256, shuffle_seed=7, num_epochs=2,
+                        drop_remainder=False) as ld:
+            n = sum(1 for _ in ld)
+            reports = ld.epoch_reports
+            merged = ld.report()
+    assert len(reports) == 2
+    per_epoch = ld.rows_per_epoch
+    for rep in reports:
+        assert rep.counters.get("data.rows_emitted") == per_epoch
+        assert rep.wall_seconds and rep.wall_seconds > 0
+    assert merged.counters["data.rows_emitted"] == 2 * per_epoch
+    assert merged.counters["data.batches_emitted"] == n
+    assert t.counters()["data.epochs_completed"] == 2
+
+
+def test_epoch_report_gauges_are_per_epoch(dataset):
+    """Gauges must come from the epoch's own window, not the cumulative
+    tracer maxima: an epoch whose peak is below the run's never moves
+    the cumulative gauge, so inheriting it would attribute epoch 0's
+    high-water marks to every later epoch (and bleed in any other scan
+    sharing the tracer)."""
+    with trace.scope() as t:
+        # a foreign scan's high-water mark, recorded BEFORE the loader
+        t.gauge_max("scan.inflight_bytes_max", 1 << 40)
+        with DataLoader(dataset, 256, shuffle_seed=7, num_epochs=2,
+                        drop_remainder=False) as ld:
+            for _ in ld:
+                pass
+            reports = ld.epoch_reports
+    assert len(reports) == 2
+    for rep in reports:
+        # the foreign peak stays out of every epoch's report...
+        assert rep.gauges.get("scan.inflight_bytes_max", 0) < (1 << 40)
+    # ...while the cumulative tracer still holds it
+    assert t.gauges()["scan.inflight_bytes_max"] == 1 << 40
+
+
+def test_gauge_window_isolation():
+    """The trace-level contract behind per-epoch gauges: a window sees
+    only writes made while it is open; close() detaches it."""
+    t = trace.Tracer(enabled=True)
+    t.gauge_max("scan.queue_depth_max", 100)
+    w = t.gauge_window()
+    t.gauge_max("scan.queue_depth_max", 7)
+    assert w.gauges() == {"scan.queue_depth_max": 7}   # not the prior 100
+    assert w.close() == {"scan.queue_depth_max": 7}
+    t.gauge_max("scan.queue_depth_max", 500)           # after close: unseen
+    assert w.gauges() == {"scan.queue_depth_max": 7}
+    assert t.gauges()["scan.queue_depth_max"] == 500   # cumulative intact
+    w.close()                                          # idempotent
+
+
+def test_scan_report_merge_round_trips_through_dicts(dataset):
+    """The cross-process contract: per-host reports ship as_dict() JSON
+    and the coordinator rebuilds + merges them."""
+    def host_report(shard):
+        with trace.scope():
+            with DataLoader(dataset, 64, shuffle_seed=1, num_epochs=1,
+                            shard=shard) as ld:
+                for _ in ld:
+                    pass
+                return ld.report()
+
+    reports = [host_report((h, 2)) for h in range(2)]
+    wire = [json.loads(json.dumps(r.as_dict())) for r in reports]
+    rebuilt = [trace.ScanReport.from_dict(d) for d in wire]
+    merged = trace.ScanReport.merge(rebuilt)
+    total = sum(r.counters["data.rows_emitted"] for r in reports)
+    assert merged.counters["data.rows_emitted"] == total
+    # as_dict() rounds wall_seconds for the wire; merge takes the max
+    assert merged.wall_seconds == pytest.approx(
+        max(r.wall_seconds for r in reports), abs=1e-6
+    )
+    with pytest.raises(ValueError):
+        trace.ScanReport.merge([])
+    with pytest.raises(ValueError, match="unknown keys"):
+        trace.ScanReport.from_dict({"bogus": 1})
+
+
+# ---------------------------------------------------------------------------
+# scanner order mode + windowed engine iterator (the loader's plumbing)
+# ---------------------------------------------------------------------------
+
+
+def test_scanner_order_mode_delivers_the_permutation(dataset):
+    seq = {}
+    with DatasetScanner(dataset, columns=["k"]) as sc:
+        for u in sc:
+            seq[(u.file_index, u.group_index)] = np.asarray(
+                u.batch.columns[0].values
+            )
+    order = [(3, 1), (0, 0), (2, 1), (0, 1), (1, 0)]
+    got = []
+    with DatasetScanner(dataset, columns=["k"], order=order) as sc:
+        for u in sc:
+            got.append((u.file_index, u.group_index))
+            assert np.array_equal(
+                np.asarray(u.batch.columns[0].values),
+                seq[(u.file_index, u.group_index)],
+            )
+    assert got == order
+
+
+def test_scanner_order_mode_validation(dataset):
+    with pytest.raises(ValueError, match="twice"):
+        # constructor raises before any file opens: nothing to release
+        DatasetScanner(dataset, order=[(0, 0), (0, 0)])  # floorlint: disable=FL-RES001
+    with pytest.raises(ValueError, match="outside"):
+        DatasetScanner(dataset, order=[(9, 0)])  # floorlint: disable=FL-RES001
+    with DatasetScanner(dataset, order=[(0, 7)]) as sc:
+        with pytest.raises(ValueError, match="outside file"):
+            list(sc)
+
+
+def test_scanner_order_mode_windows_file_lifetimes(dataset):
+    """In order mode a file opens at its first ordered unit and closes
+    after its last one — fd usage follows the order, not the dataset."""
+    from parquet_floor_tpu.io.source import FileSource
+
+    live = set()
+
+    class Tracked(FileSource):
+        def __init__(self, path):
+            super().__init__(path)
+            live.add(self)
+
+        def close(self):
+            live.discard(self)
+            super().close()
+
+    high_water = 0
+    order = [(0, 0), (0, 1), (1, 0), (1, 1), (2, 0), (2, 1)]
+    with DatasetScanner(
+        [lambda p=p: Tracked(p) for p in dataset[:3]],
+        columns=["k"], order=order,
+        scan=ScanOptions(threads=1),
+    ) as sc:
+        for _ in sc:
+            high_water = max(high_water, len(live))
+    assert not live
+    # strictly fewer than all 3 files ever open at once (the scheduler
+    # prefetches ahead, so exactly-one is not guaranteed; all-at-once
+    # would mean windowing is broken)
+    assert high_water < 3
+
+
+def test_windowed_engine_iterator_matches_eager(dataset):
+    from parquet_floor_tpu.tpu.engine import (
+        TpuRowGroupReader,
+        iter_dataset_row_groups,
+    )
+
+    readers = [
+        TpuRowGroupReader(ParquetFileReader(p), float64_policy="float64")
+        for p in dataset[:3]
+    ]
+    tasks = [(readers[0], 0), (readers[1], 1), (readers[0], 1),
+             (readers[2], 0)]
+    eager = [
+        {k: np.asarray(v.values) for k, v in cols.items()}
+        for cols in iter_dataset_row_groups(list(tasks), columns=["k", "d"])
+    ]
+
+    closed = []
+    lazy_readers = {}
+
+    def opener(fi):
+        def open_():
+            r = lazy_readers.get(fi)
+            if r is None:
+                r = lazy_readers[fi] = TpuRowGroupReader(
+                    ParquetFileReader(dataset[fi]),
+                    float64_policy="float64",
+                )
+            return r
+        return open_
+
+    def stream():
+        yield (opener(0), 0, False)
+        yield (opener(1), 1, True)
+        yield (opener(0), 1, True)
+        yield (opener(2), 0, True)
+
+    windowed = []
+    for cols in iter_dataset_row_groups(stream(), columns=["k", "d"]):
+        windowed.append({k: np.asarray(v.values) for k, v in cols.items()})
+    for r in readers:
+        r.close()
+    assert len(windowed) == len(eager)
+    for a, b in zip(eager, windowed):
+        assert a.keys() == b.keys()
+        for k in a:
+            assert np.array_equal(a[k], b[k], equal_nan=True)
+    # close_after really closed the pipeline-owned readers
+    assert all(r.reader._closed for r in lazy_readers.values())
+
+
+def test_windowed_engine_iterator_closes_on_abandonment(dataset):
+    from parquet_floor_tpu.tpu.engine import (
+        TpuRowGroupReader,
+        iter_dataset_row_groups,
+    )
+
+    opened = []
+
+    def opener(fi):
+        def open_():
+            r = TpuRowGroupReader(ParquetFileReader(dataset[fi]))
+            opened.append(r)
+            return r
+        return open_
+
+    def stream():
+        for fi in range(4):
+            yield (opener(fi), 0, False)
+            yield (opener(fi), 1, True)
+
+    gen = iter_dataset_row_groups(stream(), columns=["k"])
+    next(gen)
+    gen.close()  # abandon mid-stream
+    assert opened  # the pipeline really opened ahead
+    assert all(r.reader._closed for r in opened)

@@ -202,8 +202,15 @@ def _int_producing(e: ast.AST) -> bool:
 
 
 def check(ctx: FileContext):
-    in_format = ctx.under("parquet_floor_tpu", "format")
-    if not ctx.in_scope("FL-ALLOC", in_format):
+    # format/ parses wire bytes; tpu/engine.py sizes its staging arenas
+    # and decode buffers from the same footer/page fields (group byte
+    # estimates, padded string widths, chunk row counts), so a flipped
+    # size bit there is the SAME bug class — both are in scope.
+    in_default = (
+        ctx.under("parquet_floor_tpu", "format")
+        or ctx.is_module("tpu/engine.py")
+    )
+    if not ctx.in_scope("FL-ALLOC", in_default):
         return
     scopes: Dict[Optional[ast.AST], _Scope] = {}
     for node in ast.walk(ctx.tree):

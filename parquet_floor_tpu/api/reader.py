@@ -66,17 +66,29 @@ def _check_dataset_schema(state: dict, schema, file_index: int) -> None:
 def _resolve_engine(engine: str, reader: ParquetFileReader, purpose: str,
                     columns, options: Optional[ReaderOptions]) -> str:
     """Resolve host|tpu|auto for one open file, honoring the robustness
-    contract: ``verify_crc``/``salvage`` only exist on the host decode
-    path, so they PIN the engine — ``auto`` routes host (the correctness
-    ask outranks the cost model) and an explicit ``tpu`` raises rather
-    than silently skipping the verification it was asked for."""
+    contract: ``verify_crc`` only exists on the host decode path, so it
+    PINS the engine — ``auto`` routes host (the correctness ask outranks
+    the cost model) and an explicit ``tpu`` raises rather than silently
+    skipping the verification it was asked for.  ``salvage`` routes
+    ``auto`` to host too (salvage decode IS host decode, even on the
+    device face), but an explicit ``tpu`` is honored for the BATCH face
+    (the engine delegates each unit to the host salvage engine and
+    ships the surviving arrays); the ROW cursor face still pins host —
+    its group-row bookkeeping reads footer counts that the row-mask
+    tier can shrink."""
+    verify_only = options is not None and options.verify_crc \
+        and not options.salvage
+    salvaging = options is not None and options.salvage
     needs_host = options is not None and (options.verify_crc or options.salvage)
-    if engine == "tpu" and needs_host:
+    if engine == "tpu" and (
+        verify_only or (salvaging and purpose == "rows")
+    ):
         from ..errors import UnsupportedFeatureError
 
         raise UnsupportedFeatureError(
-            "ReaderOptions.verify_crc/salvage are host-engine features; "
-            'use engine="host" or "auto" (which routes them to host)'
+            "ReaderOptions.verify_crc (and salvage, on the row cursor "
+            'face) are host-engine features; use engine="host" or '
+            '"auto" (which routes them to host)'
         )
     if engine == "auto":
         if needs_host:
@@ -100,6 +112,23 @@ def _resolve_engine(engine: str, reader: ParquetFileReader, purpose: str,
     return engine
 
 
+def _unit_quarantined_rule(unit):
+    """The salvage placeholder rule for one scan-delivered unit: a
+    column missing from the batch is served as a placeholder/None ONLY
+    when the unit's own report recorded its chunk quarantine (an
+    unrecorded missing column is corrupt-footer loss and must raise).
+    None in strict mode — the caller then raises on any missing column."""
+    if unit.salvage is None:
+        return None
+
+    def rule(desc, u=unit):
+        return u.salvage.chunk_quarantined(
+            u.group_index, ".".join(desc.path)
+        )
+
+    return rule
+
+
 def _was_quarantined(reader: ParquetFileReader, desc: ColumnDescriptor,
                      rg_index: int) -> bool:
     """True iff salvage actually recorded a whole-chunk quarantine for
@@ -107,25 +136,22 @@ def _was_quarantined(reader: ParquetFileReader, desc: ColumnDescriptor,
     corrupt-but-parseable footer — substituting nulls for it would be
     silent unreported data loss, so callers must raise instead."""
     rep = reader.salvage_report
-    if rep is None:
-        return False
-    col = ".".join(desc.path)
-    return any(
-        s.column == col and s.row_group == rg_index and s.page is None
-        for s in rep.skips
-    )
+    return rep is not None and \
+        rep.chunk_quarantined(rg_index, ".".join(desc.path))
 
 
 def _device_batch_columns(device_cols):
     """``DeviceColumn`` → ``BatchColumn`` conversion shared by the
     sequential and scan-scheduled device batch faces (one definition of
     the ``f64_bits`` rule: DOUBLE decoded under the engine's 'bits'
-    policy rides as exact int64 bit patterns)."""
+    policy rides as exact int64 bit patterns).  Salvage placeholders
+    (already ``BatchColumn(quarantined=True)``) pass through unchanged —
+    they stay IN POSITION, exactly like the host batch face."""
     from ..batch.columns import BatchColumn
     from ..format.parquet_thrift import Type as _T
 
     return [
-        BatchColumn(
+        dc if isinstance(dc, BatchColumn) else BatchColumn(
             dc.descriptor, dc.values, dc.mask, dc.lengths,
             dc.def_levels, dc.rep_levels,
             f64_bits=dc.descriptor.physical_type == _T.DOUBLE,
@@ -930,9 +956,23 @@ class ParquetReader:
                     groups = tpu.iter_row_groups(
                         columns=names, indices=indices
                     )
+                    from ..batch.columns import BatchColumn
+
+                    def pick(group, desc, gi):
+                        dc = group.get(".".join(desc.path))
+                        if dc is not None:
+                            return dc
+                        if _was_quarantined(reader, desc, gi):
+                            # salvage (device face): the chunk stays IN
+                            # POSITION as a fail-loudly placeholder
+                            return BatchColumn(desc, None, quarantined=True)
+                        raise ValueError(
+                            f"row group {gi} missing column {desc.path}"
+                        )
+
                     for gi, group in zip(indices, groups):
                         cols = _device_batch_columns(
-                            group[".".join(desc.path)] for desc in selected
+                            pick(group, desc, gi) for desc in selected
                         )
                         yield hyd.batch(gi, cols)
                     return
@@ -962,12 +1002,7 @@ class ParquetReader:
         budget.  The supplier is called once, with the first file's
         selected columns, and ``group_index`` stays each file's real
         group index (the sequential dataset contract)."""
-        from ..scan.executor import _reject_salvage
         from .hydrate import batch_supplier_of
-
-        # fail at CALL time, not first iteration: a misconfigured scan
-        # should not hide until someone consumes the generator
-        _reject_salvage(options)
 
         if engine == "tpu":
             def dgen():
@@ -1011,7 +1046,8 @@ class ParquetReader:
                             scanner.columns
                         )
                     cols = _host_batch_columns(
-                        scanner.columns, unit.batch, unit.group_index
+                        scanner.columns, unit.batch, unit.group_index,
+                        quarantined=_unit_quarantined_rule(unit),
                     )
                     yield hyd.batch(unit.group_index, cols)
             finally:
@@ -1046,7 +1082,9 @@ class ParquetReader:
         decoded across files ahead of the consumer under a byte budget.
         Rows under scan decode on the host engine — ``engine="tpu"``
         raises (use ``stream_batches(engine="tpu", scan_options=...)``
-        for device scan); salvage is rejected by the scheduler.
+        for device scan).  ``ReaderOptions(salvage=True)`` is honored:
+        quarantined columns serve ``None`` cells and the iterator's
+        ``salvage_report`` exposes the dataset-level fold.
         """
         if scan_options is not None:
             if engine == "tpu":
@@ -1226,10 +1264,12 @@ class _ScanRowIterator:
     same rows, order, null semantics, and error wrapping as
     ``_DatasetIterator``, but row groups are read (coalesced, vectored)
     and decoded across files ahead of the consumer by
-    ``scan.DatasetScanner``.  Salvage is rejected by the scanner, so
-    ``salvage_report`` is always None here."""
-
-    salvage_report = None
+    ``scan.DatasetScanner``.  Under ``ReaderOptions(salvage=True)`` the
+    scanner's per-unit quarantines serve ``None`` cells for quarantined
+    columns (the sequential row face's contract) and
+    ``salvage_report`` exposes the DATASET-level fold (per-unit reports
+    merged in delivery order — unlike the sequential dataset iterator's
+    per-file reports)."""
 
     def __init__(self, sources, hydrator_supplier, columns, predicate,
                  options, scan):
@@ -1272,7 +1312,10 @@ class _ScanRowIterator:
                 self._scanner.columns
             )
             self._hyd_fi = unit.file_index
-        self._cursors = _ordered_cursors(self._scanner.columns, unit.batch)
+        self._cursors = _ordered_cursors(
+            self._scanner.columns, unit.batch,
+            quarantined=_unit_quarantined_rule(unit),
+        )
         self._rows = unit.batch.num_rows
         self._row = 0
 
@@ -1307,6 +1350,12 @@ class _ScanRowIterator:
                     getattr(e, "pftpu_scan_planning", False):
                 raise
             raise RuntimeError("Failed to read parquet") from e
+
+    @property
+    def salvage_report(self):
+        """Dataset-level :class:`SalvageReport` fold (None unless
+        ``ReaderOptions(salvage=True)``); survives close."""
+        return self._scanner.salvage_report
 
     def report(self):
         """The scan's health summary

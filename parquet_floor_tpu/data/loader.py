@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..errors import UnsupportedFeatureError
-from ..format.file_read import ParquetFileReader, ReaderOptions
+from ..format.file_read import ParquetFileReader, ReaderOptions, SalvageReport
 from ..format.parquet_thrift import Type
 from ..format.schema import dataset_schema_key
 from ..scan.plan import ScanOptions
@@ -127,8 +127,13 @@ class DataLoader:
       :class:`~parquet_floor_tpu.scan.ScanOptions` (host face: coalesced
       reads, prefetch budget, threads).  ``reader_options`` is the usual
       :class:`~parquet_floor_tpu.ReaderOptions` (``io_retries`` for
-      flaky storage; ``salvage`` is rejected like everywhere the
-      concurrent scheduler runs, and ``verify_crc`` pins the host face).
+      flaky storage; ``verify_crc`` alone pins the host face).  With
+      ``salvage=True`` the loader keeps flowing over corrupt units:
+      page-null damage passes through as masked nulls, units with
+      GEOMETRY-changing damage (chunk quarantine, row-mask drops) are
+      dropped whole, recorded in ``state()`` (resume stays
+      bit-identical), counted as ``data.units_quarantined``, and folded
+      into :attr:`salvage_report` (docs/robustness.md).
 
     Repeated (nested) columns are not batchable into fixed shapes and
     raise at construction; project them away with ``columns=``.
@@ -162,14 +167,12 @@ class DataLoader:
                 "shuffle_window needs shuffle_seed (window permutations "
                 "are keyed on it)"
             )
-        if reader_options is not None and reader_options.salvage:
-            raise UnsupportedFeatureError(
-                "ReaderOptions.salvage is a sequential host-engine "
-                "feature; the loader's concurrent scan cannot honor its "
-                "quarantine bookkeeping"
-            )
         if engine == "tpu" and reader_options is not None and \
-                reader_options.verify_crc:
+                reader_options.verify_crc and not reader_options.salvage:
+            # with salvage=True the device face delegates every unit's
+            # decode to the host salvage engine, which DOES run the CRC
+            # check — the combination is honored (TpuRowGroupReader's
+            # contract); verify_crc alone still pins the host face
             raise UnsupportedFeatureError(
                 "ReaderOptions.verify_crc is a host-engine feature; use "
                 'engine="host" for CRC-checked loading'
@@ -216,6 +219,19 @@ class DataLoader:
             for d in self._selected
         ]
         self._widths: Dict[str, int] = {}  # string-width HWMs (checkpointed)
+        # salvage (docs/robustness.md): units whose decode recorded
+        # GEOMETRY-changing damage — a chunk quarantined or rows dropped
+        # by the row-mask tier — are quarantined WHOLE at this layer
+        # (fixed-shape batches cannot absorb a missing column or a
+        # shifted row count) and recorded in checkpoint state, so resume
+        # arithmetic replays the identical stream.  Page-null damage
+        # keeps geometry and flows through as masked nulls.
+        self._salvage = (
+            reader_options is not None and reader_options.salvage
+        )
+        self._quarantined: set = set()       # {(file_index, group_index)}
+        self._salvage_seen: set = set()      # units folded into the report
+        self._salvage_report = SalvageReport() if self._salvage else None
         self._epoch = 0
         self._batch_in_epoch = 0
         self._gen = None
@@ -279,6 +295,56 @@ class DataLoader:
                 "shapes; project them away with columns=..."
             )
 
+    # -- salvage: unit-level quarantine --------------------------------------
+
+    def _effective_shard_units(self):
+        """The shard's units with quarantined ones at ZERO rows — the
+        list every epoch plan and all resume arithmetic runs on, so a
+        quarantined unit before the resume point shifts nothing."""
+        if not self._quarantined:
+            return self._shard_units
+        return [
+            u._replace(num_rows=0)
+            if (u.file_index, u.group_index) in self._quarantined else u
+            for u in self._shard_units
+        ]
+
+    def _effective_counts(self):
+        """(rows, batches) of one epoch under the CURRENT quarantine
+        set."""
+        rows = sum(u.num_rows for u in self._effective_shard_units())
+        if self._drop_remainder:
+            return rows, rows // self._batch_size
+        return rows, -(-rows // self._batch_size)
+
+    def _unit_geometry_damaged(self, rep, group_index) -> bool:
+        return rep is not None and rep.geometry_damaged(group_index)
+
+    def _fold_unit_report(self, key, rep) -> None:
+        """Fold one unit's report into the loader's (once per unit, in
+        first-delivery order — re-decodes across epochs must not double
+        the books)."""
+        if rep is None or key in self._salvage_seen:
+            return
+        self._salvage_seen.add(key)
+        self._salvage_report.merge_in(rep)
+
+    def _record_quarantine(self, unit, rep) -> None:
+        """A unit came back geometry-damaged: drop it WHOLE, remember it
+        (state() carries the set, so resume replays the same stream) and
+        account the loss."""
+        key = (unit.file_index, unit.group_index)
+        self._fold_unit_report(key, rep)
+        if key in self._quarantined:
+            return
+        self._quarantined.add(key)
+        self._tracer.count("data.units_quarantined")
+        self._tracer.decision("data.unit_quarantined", {
+            "file": unit.file_index,
+            "row_group": unit.group_index,
+            "rows": unit.num_rows,
+        })
+
     # -- iteration ----------------------------------------------------------
 
     def __iter__(self):
@@ -291,9 +357,12 @@ class DataLoader:
     def _next_batch(self) -> LoaderBatch:
         if self._closed:
             raise StopIteration
-        if self._n_batches == 0:
-            raise StopIteration  # an empty shard is a valid no-op loader
         while True:
+            # an empty shard — or a shard salvage quarantined down to
+            # zero surviving rows — is a valid no-op loader, including
+            # under num_epochs=None (it must stop, not spin)
+            if self._n_batches == 0:
+                raise StopIteration
             if self._num_epochs is not None and \
                     self._epoch >= self._num_epochs:
                 raise StopIteration
@@ -327,9 +396,16 @@ class DataLoader:
             return batch
 
     def _start_epoch(self):
+        # plans run on the EFFECTIVE unit list (quarantined units at 0
+        # rows): the unit permutation is independent of row counts and
+        # the window perms are keyed per position, so zeroing a unit
+        # perturbs nothing else — resume arithmetic just skips it
         plan = EpochPlan(
-            self._shard_units, self._seed, self._epoch, self._window
+            self._effective_shard_units(), self._seed, self._epoch,
+            self._window,
         )
+        if self._salvage:
+            _, self._n_batches = self._effective_counts()
         self._c0 = self._tracer.counters()
         self._s0 = self._tracer.stats()
         if self._gw is not None:       # restore() mid-epoch: stale window
@@ -359,12 +435,18 @@ class DataLoader:
             # runs NOW (workers drain, files close), not at GC time
             self._gen.close()
             self._gen = None
+        # effective counts: a quarantine discovered mid-epoch shrank the
+        # stream below the epoch-start plan — the books must reflect
+        # what actually flowed (and the NEXT epoch's n_batches with it)
+        rows_eff, n_eff = self._effective_counts()
+        if self._salvage:
+            self._n_batches = n_eff
         if self._drop_remainder:
             # the remainder policy's loss, accounted centrally: the
             # generator's own tail never runs in the normal case (it
             # stays suspended at the last batch's yield), so the count
             # cannot live there
-            tail = self._shard_rows - self._n_batches * self._batch_size
+            tail = rows_eff - n_eff * self._batch_size
             if tail:
                 self._tracer.count("data.rows_dropped", tail)
         wall = (
@@ -501,26 +583,49 @@ class DataLoader:
         from ..api.reader import _host_batch_columns
         from ..scan.executor import DatasetScanner
 
-        order = plan.units[unit0:]
+        sched = self._schedule(plan, unit0)
         scanner = DatasetScanner(
             self._sources,
             columns=[d.path[0] for d in self._selected],
             options=self._reader_options, scan=self._scan,
-            order=[(u.file_index, u.group_index) for u in order],
+            order=[(u.file_index, u.group_index) for _, u in sched],
             metadata=self._meta,
         )
         try:
-            for j, unit in enumerate(scanner):
+            for (pos, u), unit in zip(sched, scanner):
+                if self._salvage:
+                    key = (u.file_index, u.group_index)
+                    if self._unit_geometry_damaged(
+                        unit.salvage, unit.group_index
+                    ):
+                        self._record_quarantine(u, unit.salvage)
+                        continue
+                    self._fold_unit_report(key, unit.salvage)
                 cols = _host_batch_columns(
                     self._selected, unit.batch, unit.group_index
                 )
                 parts = [self._host_part(c) for c in cols]
-                perm = plan.unit_perm(unit0 + j)
+                perm = plan.unit_perm(pos)
                 if perm is not None:
                     parts = permute_parts(parts, perm)
                 yield unit.batch.num_rows, parts
         finally:
             scanner.close()
+
+    def _schedule(self, plan: EpochPlan, unit0: int):
+        """The epoch's decode schedule from ``unit0`` on: (plan
+        position, unit) pairs, with KNOWN-quarantined units excluded —
+        they contribute zero rows, so decoding them again would only
+        re-trip their decode errors (the quarantine map's argument, at
+        the unit level)."""
+        return [
+            (unit0 + j, u)
+            for j, u in enumerate(plan.units[unit0:])
+            if not (
+                self._salvage
+                and (u.file_index, u.group_index) in self._quarantined
+            )
+        ]
 
     @staticmethod
     def _host_part(bc):
@@ -549,10 +654,10 @@ class DataLoader:
         from ..format.file_read import ParquetFileReader
         from ..tpu.engine import TpuRowGroupReader, iter_dataset_row_groups
 
-        order = plan.units[unit0:]
+        sched = self._schedule(plan, unit0)
         last = {}
-        for j, u in enumerate(order):
-            last[u.file_index] = j
+        for k, (_, u) in enumerate(sched):
+            last[u.file_index] = k
         opened: dict = {}
 
         def opener(fi):
@@ -571,18 +676,31 @@ class DataLoader:
             return open_
 
         def tasks():
-            for j, u in enumerate(order):
+            for k, (pos, u) in enumerate(sched):
                 yield (
                     opener(u.file_index), u.group_index,
-                    j == last[u.file_index],
-                    plan.unit_perm(unit0 + j),
+                    k == last[u.file_index],
+                    plan.unit_perm(pos),
                 )
 
         gen = iter_dataset_row_groups(
             tasks(), columns=[d.path[0] for d in self._selected]
         )
         try:
-            for u, cols in zip(order, gen):
+            for (pos, u), cols in zip(sched, gen):
+                if self._salvage:
+                    # the engine stashed this unit's report before
+                    # yielding it (its reader may retire right after)
+                    tpu = opened.get(u.file_index)
+                    rep = (
+                        tpu.take_unit_report(u.group_index)
+                        if tpu is not None else None
+                    )
+                    key = (u.file_index, u.group_index)
+                    if self._unit_geometry_damaged(rep, u.group_index):
+                        self._record_quarantine(u, rep)
+                        continue
+                    self._fold_unit_report(key, rep)
                 parts = []
                 for spec in self._specs:
                     dc = cols.get(spec.name)
@@ -624,6 +742,13 @@ class DataLoader:
             "epoch": self._epoch,
             "batch": self._batch_in_epoch,
             "str_widths": dict(self._widths),
+            # salvage: quarantined units ride the checkpoint, so resume
+            # arithmetic replays the identical (shrunken) stream without
+            # re-decoding the damage — bit-identical resume holds with a
+            # quarantined unit before OR after the resume point
+            "quarantined": sorted(
+                [int(f), int(g)] for f, g in self._quarantined
+            ),
             **self._fingerprint(),
         }
 
@@ -655,6 +780,26 @@ class DataLoader:
                     for k, (s, h) in sorted(bad.items())
                 )
             )
+        quarantined = {
+            (int(f), int(g)) for f, g in (state.get("quarantined") or [])
+        }
+        if quarantined and not self._salvage:
+            raise ValueError(
+                "state records quarantined units but this loader has "
+                "salvage off — restoring it would silently change the "
+                "stream; configure ReaderOptions(salvage=True)"
+            )
+        known = {(u.file_index, u.group_index) for u in self._units}
+        bad_units = quarantined - known
+        if bad_units:
+            raise ValueError(
+                f"state quarantines unknown units {sorted(bad_units)}"
+            )
+        self._quarantined = quarantined
+        if self._salvage:
+            # the batch-bound check below must run against the batch
+            # count the RESTORED quarantine set implies
+            _, self._n_batches = self._effective_counts()
         epoch, batch = int(state["epoch"]), int(state["batch"])
         if batch < 0 or (self._n_batches and batch > self._n_batches):
             raise ValueError(
@@ -688,7 +833,22 @@ class DataLoader:
 
     @property
     def batches_per_epoch(self) -> int:
+        """Batches the NEXT epoch will emit (under salvage this shrinks
+        as quarantined units are discovered)."""
         return self._n_batches
+
+    @property
+    def salvage_report(self) -> Optional[SalvageReport]:
+        """Dataset-level :class:`SalvageReport` fold — per-unit reports
+        merged once each, in first-delivery order (None unless
+        ``ReaderOptions(salvage=True)``)."""
+        return self._salvage_report
+
+    @property
+    def quarantined_units(self):
+        """Sorted ``(file_index, group_index)`` units the loader dropped
+        whole (geometry-changing salvage damage); rides ``state()``."""
+        return sorted(self._quarantined)
 
     @property
     def rows_per_epoch(self) -> int:

@@ -10,6 +10,7 @@ one pass instead of per-cell virtual dispatch.
 
 from __future__ import annotations
 
+import zlib
 from dataclasses import dataclass, field
 from typing import Iterator, List, Optional, Set
 
@@ -52,12 +53,25 @@ class ReaderOptions:
       ``OSError`` reads (flaky NFS/FUSE/object-store mounts).  0 (off) by
       default; deterministic errors (truncation, parse) never retry.
     * ``io_retry_backoff_s`` — first backoff sleep; doubles per attempt.
+    * ``io_retry_deadline_s`` — total wall-clock budget across ALL
+      attempts of one read (None = unbounded): a deep retry ladder on a
+      dead mount gives up when the deadline would be crossed, surfacing
+      ``IoRetryExhaustedError`` (and an ``io.retry_deadline_exceeded``
+      trace decision) instead of sleeping through the full exponential
+      schedule.
+    * ``quarantine_map`` — a
+      :class:`~parquet_floor_tpu.quarantine.QuarantineMap` (salvage mode
+      only): known-bad units recorded by an earlier scan are replayed
+      without re-attempting their decode, and new quarantines are
+      recorded back into the map when the reader closes.
     """
 
     verify_crc: bool = False
     salvage: bool = False
     io_retries: int = 0
     io_retry_backoff_s: float = 0.05
+    io_retry_deadline_s: Optional[float] = None
+    quarantine_map: Optional[object] = None
 
     def __post_init__(self):
         # fail-fast: a bad retry config must error here, not silently
@@ -68,12 +82,36 @@ class ReaderOptions:
             raise ValueError(
                 f"io_retry_backoff_s must be >= 0, got {self.io_retry_backoff_s}"
             )
+        if self.io_retry_deadline_s is not None and self.io_retry_deadline_s <= 0:
+            raise ValueError(
+                "io_retry_deadline_s must be > 0 (or None for unbounded), "
+                f"got {self.io_retry_deadline_s}"
+            )
+        if self.quarantine_map is not None and not self.salvage:
+            raise ValueError(
+                "quarantine_map only makes sense with salvage=True (strict "
+                "mode never quarantines; an ignored map would be a silent "
+                "misconfiguration)"
+            )
 
 
 @dataclass
 class SalvageSkip:
-    """One quarantined unit (a page, or a whole column chunk when
-    ``page`` is None) recorded by salvage mode."""
+    """One quarantined unit recorded by salvage mode.
+
+    ``kind`` names the salvage tier that absorbed the damage
+    (``docs/robustness.md``):
+
+    * ``"page_null"`` — a flat OPTIONAL column's damaged page replaced
+      by an all-null page (row geometry preserved);
+    * ``"row_mask"`` — a flat REQUIRED column's damaged page dropped its
+      row span from the whole row group (``row_span`` is the group-local
+      half-open range removed);
+    * ``"dict"`` — a damaged dictionary page (recovered via another row
+      group's shared dictionary or lost to PLAIN-only decode; the error
+      string records which);
+    * ``"chunk"`` — the whole column chunk dropped for the row group.
+    """
 
     column: str
     row_group: Optional[int]
@@ -81,6 +119,39 @@ class SalvageSkip:
     rows: int            # value slots lost (rows, for flat columns)
     error: str
     path: Optional[str] = None
+    kind: str = "chunk"
+    row_span: Optional[tuple] = None  # group-local [start, stop) for row_mask
+
+    def key(self) -> tuple:
+        """Identity for cross-face/set comparison and map dedup."""
+        return (self.row_group, self.column, self.page, self.kind)
+
+    def as_dict(self) -> dict:
+        return {
+            "column": self.column,
+            "row_group": self.row_group,
+            "page": self.page,
+            "rows": self.rows,
+            "error": self.error,
+            "path": self.path,
+            "kind": self.kind,
+            "row_span": list(self.row_span) if self.row_span else None,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SalvageSkip":
+        return cls(
+            column=d["column"],
+            row_group=d.get("row_group"),
+            page=d.get("page"),
+            rows=int(d.get("rows") or 0),
+            error=str(d.get("error") or ""),
+            path=d.get("path"),
+            kind=str(d.get("kind") or "chunk"),
+            row_span=(
+                tuple(d["row_span"]) if d.get("row_span") else None
+            ),
+        )
 
 
 @dataclass
@@ -100,6 +171,9 @@ class SalvageReport:
     chunks_quarantined: int = 0
     rows_recovered: int = 0
     rows_quarantined: int = 0
+    # group-wide row loss from the row-mask tier: rows REMOVED from every
+    # column of a row group because a REQUIRED page's span was damaged
+    rows_dropped: int = 0
     skips: List[SalvageSkip] = field(default_factory=list)
     # (column, row_group) chunks already accounted — decode is
     # deterministic, so re-decoding a group (restore(), repeated
@@ -138,8 +212,93 @@ class SalvageReport:
             "chunks_quarantined": self.chunks_quarantined,
             "rows_recovered": self.rows_recovered,
             "rows_quarantined": self.rows_quarantined,
+            "rows_dropped": self.rows_dropped,
             "first_errors": self.first_errors,
         }
+
+    # -- the merge protocol (per-unit reports → one report) ----------------
+
+    def merge_in(self, other: "SalvageReport") -> "SalvageReport":
+        """Fold ``other`` into this report IN PLACE (counters sum, skips
+        concatenate in call order, dedup keys union) and return self.
+        The scan faces decode each unit into a fresh per-unit report on
+        a worker thread and merge them here, in DELIVERY order, on the
+        consumer thread — so the folded report is deterministic no
+        matter how the pool scheduled the decodes."""
+        self.pages_read += other.pages_read
+        self.pages_skipped += other.pages_skipped
+        self.chunks_quarantined += other.chunks_quarantined
+        self.rows_recovered += other.rows_recovered
+        self.rows_quarantined += other.rows_quarantined
+        self.rows_dropped += other.rows_dropped
+        self.skips.extend(other.skips)
+        self._counted |= other._counted
+        return self
+
+    @classmethod
+    def merge(cls, reports) -> "SalvageReport":
+        """A new report folding ``reports`` left-to-right.  Associative:
+        grouping does not change the result (counters are sums, skips a
+        concatenation), so worker sub-merges compose."""
+        out = cls()
+        for r in reports:
+            out.merge_in(r)
+        return out
+
+    # -- geometry queries (what the loader needs) ---------------------------
+
+    def geometry_damaged(self, row_group: Optional[int] = None) -> bool:
+        """True when salvage changed the SHAPE of the data — a column
+        chunk dropped or rows removed (row-mask tier) — for the given
+        row group (or any group when None).  Page-null substitution
+        keeps geometry and does NOT count: those rows survive as
+        masked nulls."""
+        return any(
+            s.kind in ("chunk", "row_mask")
+            and (row_group is None or s.row_group == row_group)
+            for s in self.skips
+        )
+
+    def damaged_groups(self) -> set:
+        """Row groups with geometry-changing damage (see
+        :meth:`geometry_damaged`)."""
+        return {
+            s.row_group for s in self.skips
+            if s.kind in ("chunk", "row_mask")
+        }
+
+    def chunk_quarantined(self, row_group, column: str) -> bool:
+        """True iff a whole-chunk quarantine is on record for
+        ``(row_group, column)`` — THE definition every face's
+        missing-column placeholder rule consults (a column missing
+        WITHOUT a record is corrupt-footer loss and must raise).  The
+        snapshot tolerates a concurrent scan worker appending."""
+        return any(
+            s.kind == "chunk" and s.row_group == row_group
+            and s.column == column
+            for s in tuple(self.skips)
+        )
+
+    # -- JSON round-trip (checkpoints, sidecars) ----------------------------
+
+    def as_dict(self) -> dict:
+        d = self.summary()
+        d.pop("first_errors")
+        d["skips"] = [s.as_dict() for s in self.skips]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SalvageReport":
+        out = cls(
+            pages_read=int(d.get("pages_read") or 0),
+            pages_skipped=int(d.get("pages_skipped") or 0),
+            chunks_quarantined=int(d.get("chunks_quarantined") or 0),
+            rows_recovered=int(d.get("rows_recovered") or 0),
+            rows_quarantined=int(d.get("rows_quarantined") or 0),
+            rows_dropped=int(d.get("rows_dropped") or 0),
+            skips=[SalvageSkip.from_dict(s) for s in d.get("skips") or []],
+        )
+        return out
 
 
 # What salvage mode may quarantine: damaged pages/chunks and reads past
@@ -156,25 +315,40 @@ def _chunk_byte_range(meta: ColumnMetaData):
     return start, meta.total_compressed_size
 
 
-def _empty_values(desc: ColumnDescriptor):
-    """Typed empty value container for a zero-value chunk."""
+def _filler_values(desc: ColumnDescriptor, n: int = 0):
+    """Typed all-zero value container holding ``n`` values — the empty
+    container for a zero-value chunk (``n=0``) and the placeholder the
+    row-mask tier substitutes for a damaged REQUIRED page (the rows are
+    dropped group-wide before any consumer can see the zeros)."""
     from .parquet_thrift import Type as _T
 
+    # n reaches here from page-header value counts: bless it once so a
+    # corrupt count cannot size the placeholder (FL-ALLOC001)
+    nv = checked_alloc_size(n, "filler values", column=".".join(desc.path))
     pt = desc.physical_type
     if pt == _T.BYTE_ARRAY:
-        return ByteArrayColumn(np.zeros(1, np.int64), np.zeros(0, np.uint8))
+        return ByteArrayColumn(np.zeros(nv + 1, np.int64), np.zeros(0, np.uint8))
     if pt == _T.BOOLEAN:
-        return np.zeros(0, np.bool_)
+        return np.zeros(nv, np.bool_)
     if pt == _T.INT32:
-        return np.zeros(0, np.int32)
+        return np.zeros(nv, np.int32)
     if pt == _T.INT64:
-        return np.zeros(0, np.int64)
+        return np.zeros(nv, np.int64)
     if pt == _T.FLOAT:
-        return np.zeros(0, np.float32)
+        return np.zeros(nv, np.float32)
     if pt == _T.DOUBLE:
-        return np.zeros(0, np.float64)
-    width = desc.type_length if pt == _T.FIXED_LEN_BYTE_ARRAY else 12
-    return np.zeros((0, width), np.uint8)
+        return np.zeros(nv, np.float64)
+    width = (
+        checked_alloc_size(desc.type_length, "FLBA width",
+                           column=".".join(desc.path))
+        if pt == _T.FIXED_LEN_BYTE_ARRAY else 12
+    )
+    return np.zeros((nv, width), np.uint8)
+
+
+def _empty_values(desc: ColumnDescriptor):
+    """Typed empty value container for a zero-value chunk."""
+    return _filler_values(desc, 0)
 
 
 def _page_num_values(page: "pg.RawPage") -> Optional[int]:
@@ -189,6 +363,53 @@ def _page_num_values(page: "pg.RawPage") -> Optional[int]:
     ):
         return h.data_page_header_v2.num_values
     return None
+
+
+def _take_values(values, keep: np.ndarray):
+    """``values[keep]`` for either value container (NumPy array or
+    ``ByteArrayColumn``)."""
+    if isinstance(values, ByteArrayColumn):
+        starts = values.offsets[:-1][keep]
+        ends = values.offsets[1:][keep]
+        lens = ends - starts
+        offsets = np.zeros(len(lens) + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        if len(starts) and offsets[-1]:
+            # vectorized ragged gather (the row drop re-applies on every
+            # decode of the group, so no per-row Python loop): for each
+            # output byte, its row's source start plus its offset within
+            # the row — empty rows contribute nothing and cost nothing
+            lens64 = lens.astype(np.int64)
+            row_of = np.repeat(np.arange(len(lens64)), lens64)
+            within = np.arange(int(offsets[-1]), dtype=np.int64) \
+                - np.repeat(offsets[:-1], lens64)
+            data = np.asarray(values.data)[
+                starts.astype(np.int64)[row_of] + within
+            ]
+        else:
+            data = np.zeros(0, np.uint8)
+        return ByteArrayColumn(offsets, np.ascontiguousarray(data, np.uint8))
+    return values[keep]
+
+
+def _mask_batch_rows(batch: ColumnBatch, keep: np.ndarray) -> ColumnBatch:
+    """Drop the rows where ``keep`` is False from one FLAT column batch —
+    the group-wide application of the row-mask salvage tier (every
+    column of the row group drops the same union of damaged spans, so
+    row alignment across columns is preserved exactly)."""
+    desc = batch.descriptor
+    if batch.def_levels is None:
+        return ColumnBatch(
+            desc, int(keep.sum()), _take_values(batch.values, keep),
+            None, None,
+        )
+    defs = batch.def_levels
+    present = defs == desc.max_definition_level
+    value_keep = keep[present]  # values hold non-null slots, in row order
+    return ColumnBatch(
+        desc, int(keep.sum()), _take_values(batch.values, value_keep),
+        defs[keep], None,
+    )
 
 
 def _concat_values(parts):
@@ -250,7 +471,10 @@ class ParquetFileReader:
         if opts.io_retries > 0 and not isinstance(src, RetryingSource):
             # isinstance guard: a caller-wrapped RetryingSource must not be
             # wrapped again (attempts would multiply, backoffs compound)
-            src = RetryingSource(src, opts.io_retries, opts.io_retry_backoff_s)
+            src = RetryingSource(
+                src, opts.io_retries, opts.io_retry_backoff_s,
+                deadline_s=opts.io_retry_deadline_s,
+            )
         self.source = src
         try:
             self.metadata: ParquetMetadata = (
@@ -268,6 +492,22 @@ class ParquetFileReader:
         self.salvage_report: Optional[SalvageReport] = (
             SalvageReport() if opts.salvage else None
         )
+        # persistent quarantine map (salvage only): known-bad units of
+        # THIS file (keyed by fingerprint) replay without decode
+        # attempts; close() records what this reader's report learned
+        self._qmap = opts.quarantine_map if opts.salvage else None
+        self._qmap_fp: Optional[str] = None
+        self._known_bad: dict = {}
+        if self._qmap is not None:
+            try:
+                from ..quarantine import fingerprint as _q_fingerprint
+
+                self._qmap_fp = _q_fingerprint(self.source)
+                self._known_bad = self._qmap.known_bad(self._qmap_fp)
+            except BaseException:
+                if owns_source:
+                    self.source.close()
+                raise
         self._closed = False
 
     # -- parity surface ----------------------------------------------------
@@ -286,6 +526,13 @@ class ParquetFileReader:
         if not self._closed:
             if self.salvage_report is not None and self.salvage_report.skips:
                 trace.decision("salvage.report", self.salvage_report.summary())
+                if self._qmap is not None and self._qmap_fp is not None:
+                    # remember this file's losses so the next scan skips
+                    # them without re-tripping the decode errors
+                    self._qmap.record(
+                        self._qmap_fp, self.salvage_report,
+                        path=getattr(self.source, "name", None),
+                    )
             self.source.close()
             self._closed = True
 
@@ -310,15 +557,38 @@ class ParquetFileReader:
         }
 
     def read_column_chunk(
-        self, chunk: ColumnChunk, row_group_index: Optional[int] = None
+        self, chunk: ColumnChunk, row_group_index: Optional[int] = None,
+        *, report: Optional[SalvageReport] = None,
     ) -> ColumnBatch:
         """Decode one column chunk.  Every failure carries file/column/
         row-group context; hostile bytes surface as taxonomy
         (:mod:`parquet_floor_tpu.errors`), never a bare crash from deep
         inside an encoding.  In salvage mode, damaged pages of flat
         OPTIONAL columns are substituted with all-null pages (recorded in
-        ``self.salvage_report``); unrecoverable damage still raises, and
-        :meth:`read_row_group` quarantines the whole chunk."""
+        ``report``, default ``self.salvage_report``); unrecoverable
+        damage still raises, and :meth:`read_row_group` quarantines the
+        whole chunk.  The row-mask tier (REQUIRED pages) only activates
+        under :meth:`read_row_group`, which coordinates the row drop
+        across every column of the group — a lone chunk read cannot, so
+        it keeps the raise-then-quarantine contract.
+
+        ``report`` routes the accounting to a caller-owned per-unit
+        :class:`SalvageReport` — the scan faces decode units on worker
+        threads into fresh reports and merge them in delivery order
+        (``SalvageReport.merge``)."""
+        batch, _spans = self._read_column_chunk_impl(
+            chunk, row_group_index, report=report, row_mask=False
+        )
+        return batch
+
+    def _read_column_chunk_impl(
+        self, chunk: ColumnChunk, row_group_index: Optional[int],
+        *, report: Optional[SalvageReport] = None, row_mask: bool = False,
+    ):
+        """Shared chunk decode + salvage accounting.  Returns
+        ``(batch, drop_spans)`` — ``drop_spans`` lists the group-local
+        row spans the row-mask tier wants removed (empty unless
+        ``row_mask`` and a REQUIRED page was damaged)."""
         meta = chunk.meta_data
         path = getattr(self.source, "name", None)
         if meta is None:
@@ -342,6 +612,10 @@ class ParquetFileReader:
                 path=path, row_group=row_group_index,
             ) from e
         ctx = self._chunk_ctx(desc, row_group_index)
+        known = (
+            self._known_bad.get((row_group_index, ctx["column"]))
+            if self._known_bad else None
+        )
         # the shared transient-vs-corruption ladder: belt-and-braces so a
         # corruption path no decoder anticipated still lands in the
         # taxonomy, while OSError (flaky mounts) and MemoryError (host
@@ -350,58 +624,149 @@ class ParquetFileReader:
         # blip
         with classified_decode_errors(CorruptPageError,
                                       "column chunk decode failed", ctx):
-            batch, skips, pages_decoded = self._decode_chunk(chunk, desc, ctx)
-        if self.salvage_report is not None and self.salvage_report._first_count(
+            batch, skips, pages_decoded = self._decode_chunk(
+                chunk, desc, ctx, row_mask=row_mask, known=known
+            )
+        rep = report if report is not None else self.salvage_report
+        if rep is not None and rep._first_count(
             ctx["column"], row_group_index, "ok"
         ):
-            rep = self.salvage_report
             rep.pages_read += pages_decoded
-            nulled = 0
-            for ordinal, n, err in skips:
-                rep.pages_skipped += 1
+            lost = 0
+            for ordinal, n, err, kind, span in skips:
                 rep.rows_quarantined += n
-                nulled += n
+                lost += n
                 rep.skips.append(SalvageSkip(
                     column=ctx["column"], row_group=row_group_index,
                     page=ordinal, rows=n, error=str(err), path=path,
+                    kind=kind, row_span=span,
                 ))
+                if kind == "dict":
+                    # a dict skip is the recovery EVENT (re-derived or
+                    # demoted to PLAIN), not a substituted data page:
+                    # it lives in `skips` but never in pages_skipped —
+                    # report and trace counter must tell the same story
+                    trace.decision("salvage.dict_recovery", {
+                        "column": ctx["column"],
+                        "row_group": row_group_index,
+                        "page": ordinal, "error": str(err),
+                    })
+                    continue
+                rep.pages_skipped += 1
                 trace.count("salvage.pages_skipped")
                 trace.count("salvage.rows_quarantined", n)
-                trace.decision("salvage.skip_page", {
-                    "column": ctx["column"], "row_group": row_group_index,
-                    "page": ordinal, "rows": n, "error": str(err),
-                })
-            rep.rows_recovered += int(meta.num_values or 0) - nulled
-        return batch
+                trace.decision(
+                    "salvage.row_mask" if kind == "row_mask"
+                    else "salvage.skip_page",
+                    {
+                        "column": ctx["column"],
+                        "row_group": row_group_index,
+                        "page": ordinal, "rows": n, "error": str(err),
+                    },
+                )
+            rep.rows_recovered += int(meta.num_values or 0) - lost
+        # spans return on EVERY decode (re-reads included): the group-wide
+        # row drop is an action, not an accounting entry, and must apply
+        # even when _first_count already suppressed the bookkeeping
+        return batch, [
+            span for _o, _n, _e, kind, span in skips
+            if kind == "row_mask" and span is not None
+        ]
 
     def _decode_chunk(self, chunk: ColumnChunk, desc: ColumnDescriptor,
-                      ctx: dict):
+                      ctx: dict, row_mask: bool = False,
+                      known: Optional[dict] = None):
         """Shared chunk decode.  Returns ``(batch, skips, pages_decoded)``
-        where ``skips`` lists ``(page_ordinal, rows, error)`` for pages
-        salvage replaced with all-null pages (always empty in strict
+        where ``skips`` lists ``(page_ordinal, rows, error, kind,
+        row_span)`` for units salvage absorbed (always empty in strict
         mode).  Skips are committed to the report only by the caller,
         after the chunk as a whole succeeds — a chunk that fails later
-        anyway is recorded once, as one quarantined chunk."""
+        anyway is recorded once, as one quarantined chunk.
+
+        ``row_mask`` enables the REQUIRED-page tier (only
+        :meth:`read_row_group` may set it — the row drop must apply to
+        every column of the group).  ``known`` is the quarantine map's
+        replay index for this chunk: listed data pages substitute their
+        recorded outcome without re-attempting the decode."""
         meta = chunk.meta_data
         start, length = _chunk_byte_range(meta)
         raw = self.source.read_at(start, length)
         raw_pages = pg.split_pages(raw, meta.num_values, ctx, offset_base=start)
         dictionary = None
+        dict_seen = False
         decoded: List[pg.DecodedPage] = []
         skips: list = []
         pages_decoded = 0
+        row_cursor = 0  # values before this page == rows, for flat columns
+        known_pages = (known or {}).get("pages") or {}
+        total_vals = int(meta.num_values or 0)
         for i, page in enumerate(raw_pages):
             pctx = {**ctx, "page": i}
             if page.page_type == PageType.DICTIONARY_PAGE:
-                if dictionary is not None:
+                if dict_seen:
                     raise CorruptPageError(
                         "multiple dictionary pages in one chunk", **pctx
                     )
-                dictionary = pg.decode_dictionary_page(
-                    page, desc, meta.codec, self.verify_crc, pctx
-                )
-                pages_decoded += 1
+                dict_seen = True
+                try:
+                    dictionary = pg.decode_dictionary_page(
+                        page, desc, meta.codec, self.verify_crc, pctx
+                    )
+                    pages_decoded += 1
+                except CorruptPageError as e:
+                    if not self._salvage:
+                        raise
+                    # dictionary tier: try to borrow a shared dictionary
+                    # from another row group's chunk of the same column;
+                    # failing that, fall back to PLAIN-only decode (the
+                    # chunk's PLAIN pages still decode; dict-encoded
+                    # pages land in the page tiers below)
+                    dictionary, action = self._recover_dictionary(
+                        chunk, desc, ctx, page, e
+                    )
+                    skips.append((i, 0, f"{action}: {e}", "dict", None))
             elif page.page_type in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+                n = _page_num_values(page)
+                ok_n = (
+                    isinstance(n, int) and 0 <= n <= total_vals
+                )
+                flat = desc.max_repetition_level == 0
+                kn = known_pages.get(i)
+                if (
+                    kn is not None and self._salvage and ok_n
+                    and int(kn.get("rows") or -1) == n
+                ):
+                    # quarantine-map replay: substitute the recorded
+                    # outcome without re-attempting the decode; the skip
+                    # record (recorded error string included) is
+                    # byte-identical to the one a fresh scan produces
+                    if kn["kind"] == "page_null" and flat and \
+                            desc.max_definition_level > 0:
+                        rows = checked_alloc_size(
+                            n, "salvaged null page", **pctx
+                        )
+                        decoded.append(pg.DecodedPage(
+                            n, _empty_values(desc),
+                            np.zeros(rows, np.uint32), None,
+                        ))
+                        skips.append((i, n, kn["error"], "page_null", None))
+                        row_cursor += n
+                        continue
+                    if kn["kind"] == "row_mask" and flat and row_mask:
+                        rows = checked_alloc_size(
+                            n, "row-masked page", **pctx
+                        )
+                        decoded.append(pg.DecodedPage(
+                            n, _filler_values(desc, rows), None, None
+                        ))
+                        skips.append((
+                            i, n, kn["error"], "row_mask",
+                            (row_cursor, row_cursor + n),
+                        ))
+                        row_cursor += n
+                        continue
+                    # stale or inapplicable entry: fall through and let
+                    # the decode re-establish the truth
                 try:
                     decoded.append(pg.decode_data_page(
                         page, desc, meta.codec, dictionary, self.verify_crc,
@@ -409,25 +774,43 @@ class ParquetFileReader:
                     ))
                     pages_decoded += 1
                 except CorruptPageError as e:
-                    n = _page_num_values(page)
                     # n bounded by the chunk's footer total: a corrupt
                     # header claiming absurd counts must not allocate
-                    if not (
-                        self._salvage
-                        and desc.max_repetition_level == 0
+                    if (
+                        self._salvage and ok_n and flat
                         and desc.max_definition_level > 0
-                        and isinstance(n, int)
-                        and 0 <= n <= int(meta.num_values or 0)
                     ):
+                        # flat optional column: the page's rows survive
+                        # as nulls (def level 0 < max), so row alignment
+                        # across columns is preserved exactly
+                        rows = checked_alloc_size(
+                            n, "salvaged null page", **pctx
+                        )
+                        decoded.append(pg.DecodedPage(
+                            n, _empty_values(desc),
+                            np.zeros(rows, np.uint32), None,
+                        ))
+                        skips.append((i, n, e, "page_null", None))
+                    elif self._salvage and ok_n and flat and row_mask:
+                        # flat REQUIRED column: nulls cannot stand in,
+                        # but the page's ROW SPAN is known (values ==
+                        # rows for flat columns) — substitute a
+                        # placeholder and drop the span from the whole
+                        # group (read_row_group applies the union)
+                        rows = checked_alloc_size(
+                            n, "row-masked page", **pctx
+                        )
+                        decoded.append(pg.DecodedPage(
+                            n, _filler_values(desc, rows), None, None
+                        ))
+                        skips.append((
+                            i, n, e, "row_mask",
+                            (row_cursor, row_cursor + n),
+                        ))
+                    else:
                         raise
-                    # flat optional column: the page's rows survive as
-                    # nulls (def level 0 < max), so row alignment across
-                    # columns is preserved exactly
-                    rows = checked_alloc_size(n, "salvaged null page", **pctx)
-                    decoded.append(pg.DecodedPage(
-                        n, _empty_values(desc), np.zeros(rows, np.uint32), None
-                    ))
-                    skips.append((i, n, e))
+                if isinstance(n, int) and n > 0:
+                    row_cursor += n
             elif page.page_type == PageType.INDEX_PAGE:
                 continue
             else:
@@ -461,6 +844,89 @@ class ParquetFileReader:
         )
         batch = ColumnBatch(desc, meta.num_values, values, def_levels, rep_levels)
         return batch, skips, pages_decoded
+
+    def _recover_dictionary(self, chunk: ColumnChunk, desc: ColumnDescriptor,
+                            ctx: dict, page: "pg.RawPage", err: Exception):
+        """Dictionary-page damage recovery: borrow the dictionary from
+        another row group's chunk of the SAME column when the sibling's
+        payload is PROVABLY the bytes the damaged page used to hold.
+        Returns ``(dictionary_or_None, action)``.
+
+        Writers commonly emit identical per-chunk dictionaries when the
+        value set repeats across row groups.  But "same value count and
+        size" is NOT identity — two chunks over the same value set in
+        different first-occurrence order pass both and would decode
+        indices through the wrong table, which is silent wrong data.
+        The borrow therefore demands a byte proof: the damaged page's
+        header (readable by precondition) carries the CRC32 of its
+        original payload, and a sibling qualifies only when its own
+        payload hashes to exactly that value.  No recorded CRC, no
+        borrow — the dictionary is declared lost and only
+        PLAIN(-fallback) pages survive."""
+        dh = page.header.dictionary_page_header
+        declared = dh.num_values if dh is not None else None
+        declared_usize = page.header.uncompressed_page_size
+        want_crc = page.header.crc
+        rg_idx = ctx.get("row_group")
+        my_path = tuple(chunk.meta_data.path_in_schema or ())
+        if declared is None or declared_usize is None:
+            return None, "dictionary lost (damaged header declares no shape)"
+        if want_crc is None:
+            return None, (
+                "dictionary lost (no page CRC recorded — a borrowed "
+                "dictionary cannot be proven byte-identical); PLAIN "
+                "pages still decode"
+            )
+        for j, rg in enumerate(self.row_groups):
+            if j == rg_idx:
+                continue
+            for other in rg.columns or []:
+                om = other.meta_data
+                if om is None or \
+                        tuple(om.path_in_schema or ()) != my_path:
+                    continue
+                off = om.dictionary_page_offset
+                if off is None or off <= 0:
+                    continue
+                end = om.data_page_offset
+                max_len = (
+                    int(end) - int(off)
+                    if end is not None and end > off
+                    else int(om.total_compressed_size or 0)
+                )
+                if max_len <= 0:
+                    continue
+                try:
+                    opage = self._read_raw_page(
+                        off, max_len, {**ctx, "row_group": j}
+                    )
+                    oh = opage.header.dictionary_page_header
+                    if (
+                        opage.page_type != PageType.DICTIONARY_PAGE
+                        or oh is None
+                        or oh.num_values != declared
+                        or opage.header.uncompressed_page_size
+                        != declared_usize
+                        or (zlib.crc32(bytes(opage.payload)) & 0xFFFFFFFF)
+                        != (want_crc & 0xFFFFFFFF)
+                    ):
+                        continue
+                    foreign = pg.decode_dictionary_page(
+                        opage, desc, om.codec, self.verify_crc,
+                        {**ctx, "row_group": j, "page": 0},
+                    )
+                except (OSError, MemoryError):
+                    raise  # environmental, never part of recovery search
+                except Exception:
+                    continue  # this sibling is damaged too; keep looking
+                return foreign, (
+                    f"dictionary re-derived from row group {j} "
+                    f"({declared} values, payload CRC match)"
+                )
+        return None, (
+            "dictionary lost (no sibling chunk proves the payload "
+            "bytes); PLAIN pages still decode"
+        )
 
     def read_row_group_ranges(
         self, index: int, row_ranges, column_filter: Optional[Set[str]] = None
@@ -623,16 +1089,21 @@ class ParquetFileReader:
         return ColumnBatch(desc, total, values, def_levels, rep_levels)
 
     def read_row_group(
-        self, index: int, column_filter: Optional[Set[str]] = None
+        self, index: int, column_filter: Optional[Set[str]] = None,
+        *, report: Optional[SalvageReport] = None,
     ) -> RowGroupBatch:
         """Decode one row group into columnar batches.
 
         ``column_filter`` projects by **top-level field name** — exactly the
         reference's projection semantics (``ParquetReader.java:126-128``);
         None or empty means all columns (``ParquetReader.java:76``).
+
+        ``report`` (salvage mode) routes accounting to a caller-owned
+        per-unit :class:`SalvageReport` instead of the reader's shared
+        one — the scan faces' merge protocol.
         """
         rg = self.row_groups[index]
-        batches = []
+        selected = []
         for chunk in rg.columns or []:
             meta = chunk.meta_data
             # a nulled/corrupt meta_data falls THROUGH to read_column_chunk,
@@ -645,34 +1116,104 @@ class ParquetFileReader:
             )
             if column_filter and path0 is not None and path0 not in column_filter:
                 continue
-            if not self._salvage:
-                batches.append(self.read_column_chunk(chunk, index))
+            selected.append(chunk)
+        if not self._salvage:
+            return RowGroupBatch(
+                [self.read_column_chunk(c, index) for c in selected],
+                rg.num_rows or 0,
+            )
+        rep = report if report is not None else self.salvage_report
+        # the row-mask tier needs every selected column FLAT: dropping a
+        # row span from a repeated leaf would need record boundaries the
+        # damaged page no longer provides — groups with repeated columns
+        # keep the chunk-quarantine tier for REQUIRED damage
+        allow_mask = True
+        for c in selected:
+            try:
+                d = self._descriptor_for(c)
+            except (OSError, MemoryError):
+                raise
+            except Exception:
+                allow_mask = False
+                break
+            if d.max_repetition_level > 0:
+                allow_mask = False
+                break
+        batches = []
+        drops: list = []
+        for chunk in selected:
+            meta = chunk.meta_data
+            column = ".".join(
+                (meta.path_in_schema if meta is not None else None) or ["?"]
+            )
+            kn = self._known_bad.get((index, column))
+            if kn is not None and kn.get("chunk") is not None:
+                # quarantine-map short-circuit: the chunk is known
+                # unrecoverable — skip its bytes entirely and replay the
+                # recorded quarantine (identical record, zero decode cost)
+                e = kn["chunk"]
+                self._quarantine_chunk(
+                    chunk, index, rg, e["error"], rep, via_map=True,
+                    rows=int(e.get("rows") or 0),
+                )
                 continue
             try:
-                batches.append(self.read_column_chunk(chunk, index))
+                batch, spans = self._read_column_chunk_impl(
+                    chunk, index, report=rep, row_mask=allow_mask
+                )
+                batches.append(batch)
+                drops.extend(spans)
             except _SALVAGEABLE as e:
-                self._quarantine_chunk(chunk, index, rg, e)
-        return RowGroupBatch(batches, rg.num_rows or 0)
+                self._quarantine_chunk(chunk, index, rg, e, rep)
+        n_rows = int(rg.num_rows or 0)
+        if not drops:
+            return RowGroupBatch(batches, n_rows)
+        # group-wide row mask: the union of damaged REQUIRED spans drops
+        # from EVERY column, so cross-column row alignment is exact
+        # (nr is the blessed footer row count — it sizes the mask)
+        nr = checked_alloc_size(n_rows, "row-mask group rows",
+                                row_group=index)
+        keep = np.ones(nr, dtype=bool)
+        for a, b in drops:
+            keep[max(0, int(a)):max(0, min(nr, int(b)))] = False
+        dropped = int(nr - keep.sum())
+        if dropped and rep is not None and rep._first_count("*", index, "rm"):
+            rep.rows_dropped += dropped
+            trace.count("salvage.rows_dropped", dropped)
+        batches = [_mask_batch_rows(b, keep) for b in batches]
+        return RowGroupBatch(batches, int(keep.sum()))
 
     def _quarantine_chunk(self, chunk: ColumnChunk, index: int,
-                          rg: RowGroup, err: Exception) -> None:
+                          rg: RowGroup, err, report=None,
+                          via_map: bool = False,
+                          rows: Optional[int] = None) -> None:
         """Salvage mode: drop one unrecoverable column chunk, keep the
         row group's other columns.  The batch simply omits the column;
         the report and a ``trace.decision`` event record exactly what
-        was lost."""
-        rep = self.salvage_report
+        was lost.  ``via_map`` marks a quarantine replayed from the
+        persistent map (no decode was attempted; the record is
+        identical either way)."""
+        rep = report if report is not None else self.salvage_report
         column = ".".join(chunk.meta_data.path_in_schema or ["?"])
         if not rep._first_count(column, index, "q"):
             return  # this chunk's loss is already on the books
-        rows = int(chunk.meta_data.num_values or rg.num_rows or 0)
+        if not rows:
+            rows = int(chunk.meta_data.num_values or rg.num_rows or 0)
         rep.chunks_quarantined += 1
         rep.rows_quarantined += rows
         rep.skips.append(SalvageSkip(
             column=column, row_group=index, page=None, rows=rows,
             error=str(err), path=getattr(self.source, "name", None),
+            kind="chunk",
         ))
         trace.count("salvage.chunks_quarantined")
         trace.count("salvage.rows_quarantined", rows)
+        if via_map:
+            trace.count("salvage.map_skips")
+            trace.decision("salvage.map_skip", {
+                "column": column, "row_group": index, "rows": rows,
+            })
+            return
         trace.decision("salvage.quarantine_chunk", {
             "column": column, "row_group": index, "rows": rows,
             "error": str(err),

@@ -404,23 +404,64 @@ def decode_data_page_v2(
     rep_levels = None
     def_levels = None
     pos = 0
+    # The v2 header's geometry fields (level byte lengths, num_nulls,
+    # num_rows) live OUTSIDE the payload CRC: a flipped bit there would
+    # silently shift the value region and decode garbage as data.  Every
+    # claim is therefore cross-checked against what actually decodes —
+    # disagreement is corruption, never a judgment call.
     if column.max_repetition_level > 0:
         bw = e_rle.min_bit_width(column.max_repetition_level)
-        rep_levels, _ = e_rle.decode_rle_hybrid(payload, n, bw, pos)
+        rep_levels, rend = e_rle.decode_rle_hybrid(payload, n, bw, pos)
+        if rend - pos > rl_len:
+            raise CorruptPageError(
+                f"v2 repetition levels consumed {rend - pos} bytes but "
+                f"the header declares {rl_len}", **(ctx or {}),
+            )
+    elif column.max_repetition_level == 0 and h.num_rows is not None \
+            and h.num_rows != n:
+        raise CorruptPageError(
+            f"v2 header claims {h.num_rows} rows but {n} values on a "
+            "flat column", **(ctx or {}),
+        )
     pos += rl_len
     if column.max_definition_level > 0:
         bw = e_rle.min_bit_width(column.max_definition_level)
-        def_levels, _ = e_rle.decode_rle_hybrid(payload, n, bw, pos)
+        def_levels, dend = e_rle.decode_rle_hybrid(payload, n, bw, pos)
+        if dend - pos > dl_len:
+            raise CorruptPageError(
+                f"v2 definition levels consumed {dend - pos} bytes but "
+                f"the header declares {dl_len}", **(ctx or {}),
+            )
         n_non_null = int(np.count_nonzero(def_levels == column.max_definition_level))
+        if h.num_nulls is not None and h.num_nulls != n - n_non_null:
+            raise CorruptPageError(
+                f"v2 header claims {h.num_nulls} nulls but the "
+                f"definition levels encode {n - n_non_null}",
+                **(ctx or {}),
+            )
     else:
         n_non_null = n
+        if h.num_nulls:
+            raise CorruptPageError(
+                f"v2 header claims {h.num_nulls} nulls on a REQUIRED "
+                "column", **(ctx or {}),
+            )
     pos += dl_len
     body = payload[pos:]
+    expected = page.header.uncompressed_page_size - rl_len - dl_len
+    if expected < 0:
+        raise CorruptPageError(
+            "v2 level byte lengths exceed the page size", **(ctx or {}),
+        )
     # is_compressed defaults true when the chunk codec is not UNCOMPRESSED
     compressed = h.is_compressed if h.is_compressed is not None else True
     if compressed and codec != CompressionCodec.UNCOMPRESSED:
-        expected = page.header.uncompressed_page_size - rl_len - dl_len
         body = codecs.decompress(codec, body, expected)
+    elif len(body) != expected:
+        raise CorruptPageError(
+            f"v2 value region holds {len(body)} bytes but the header "
+            f"geometry implies {expected}", **(ctx or {}),
+        )
     values = _decode_values(body, 0, h.encoding, n_non_null, column, dictionary)
     return DecodedPage(n, values, def_levels, rep_levels)
 

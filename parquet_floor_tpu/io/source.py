@@ -210,20 +210,36 @@ class RetryingSource:
     Every read that retry *saved* is surfaced as an ``io.retry`` trace
     decision (and exhaustion as ``io.retry_exhausted``), so production
     serving can watch retry rates without new plumbing.
+
+    ``deadline_s`` bounds the TOTAL wall time of one read call across
+    all its attempts and backoff sleeps (None = unbounded): a deep
+    retry ladder against a dead mount stops when the next sleep would
+    cross the deadline, raising :class:`IoRetryExhaustedError` and
+    recording an ``io.retry_deadline_exceeded`` trace decision — serving
+    paths get a latency ceiling instead of the full exponential
+    schedule.  The budget is per *call*, like the attempt budget.
     """
 
     def __init__(self, inner, retries: int, backoff_s: float = 0.05,
-                 sleep=time.sleep, jitter: float = 0.1, rng=random.random):
+                 sleep=time.sleep, jitter: float = 0.1, rng=random.random,
+                 deadline_s: "float | None" = None, clock=time.monotonic):
         if retries < 0:
             raise ValueError(f"retries must be >= 0, got {retries}")
         if jitter < 0:
             raise ValueError(f"jitter must be >= 0, got {jitter}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0 (or None for unbounded), "
+                f"got {deadline_s}"
+            )
         self._inner = inner
         self._retries = int(retries)
         self._backoff_s = float(backoff_s)
         self._sleep = sleep
         self._jitter = float(jitter)
         self._rng = rng
+        self._deadline_s = None if deadline_s is None else float(deadline_s)
+        self._clock = clock
         self._stat_lock = threading.Lock()
         self.retried_reads = 0  # observability: how often retry saved a read
 
@@ -261,7 +277,13 @@ class RetryingSource:
         strictly per call — concurrent reads from executor threads never
         share or double-count it (see the module concurrency contract)."""
         last: Optional[OSError] = None
+        deadline = (
+            None if self._deadline_s is None
+            else self._clock() + self._deadline_s
+        )
+        attempts_made = 0
         for attempt in range(self._retries + 1):
+            attempts_made = attempt + 1
             try:
                 data = read_fn()
                 if attempt:
@@ -283,7 +305,29 @@ class RetryingSource:
                 last = e
                 if attempt < self._retries:
                     delay = self._backoff_s * (2 ** attempt)
-                    self._sleep(delay * (1.0 + self._jitter * self._rng()))
+                    delay *= 1.0 + self._jitter * self._rng()
+                    if deadline is not None and \
+                            self._clock() + delay > deadline:
+                        # the next sleep would cross the total budget:
+                        # stop HERE — a latency ceiling that sleeps past
+                        # itself is no ceiling at all
+                        trace.count("io.retries", attempt)
+                        trace.count("io.retry_exhausted")
+                        trace.decision("io.retry_deadline_exceeded", {
+                            "path": self.name, "offset": offset,
+                            "attempts": attempts_made,
+                            "deadline_s": self._deadline_s,
+                            "error": str(last),
+                        })
+                        raise IoRetryExhaustedError(
+                            f"read of {length} bytes gave up after "
+                            f"{attempts_made} attempt(s): the next retry "
+                            f"would cross the {self._deadline_s}s "
+                            f"deadline: {last}",
+                            attempts=attempts_made, path=self.name,
+                            offset=offset,
+                        ) from last
+                    self._sleep(delay)
         trace.count("io.retries", self._retries)
         trace.count("io.retry_exhausted")
         trace.decision("io.retry_exhausted", {

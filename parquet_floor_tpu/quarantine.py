@@ -1,0 +1,192 @@
+"""Persistent quarantine map — the sidecar that makes corruption a
+*remembered* fact instead of a rediscovered one.
+
+Salvage mode (``docs/robustness.md``) quarantines damaged units as it
+trips over them; on a large corpus every re-scan pays the same decode
+failures again (a corrupt page can cost a full decompress + decode
+attempt before it raises).  A :class:`QuarantineMap` records each file's
+quarantined units in a small JSON sidecar keyed by a **file
+fingerprint**, so a later scan with the same map short-circuits the
+known-bad units: chunk-level quarantines skip the chunk's bytes
+entirely, page-level quarantines substitute the recorded outcome
+(all-null page or row-mask placeholder) without re-attempting the
+decode.  The replayed quarantine records are byte-identical to the ones
+a fresh scan would produce, so the map never changes *what* is lost —
+only how cheaply the loss is re-established.
+
+Usage::
+
+    from parquet_floor_tpu import ReaderOptions
+    from parquet_floor_tpu.quarantine import QuarantineMap
+
+    qmap = QuarantineMap.open("corpus.quarantine.json")
+    opts = ReaderOptions(salvage=True, quarantine_map=qmap)
+    ... scan the corpus through any salvage-capable face ...
+    qmap.save()          # persist what this scan learned
+
+The fingerprint is ``"<size>:<crc32 of the last 4 KiB>"`` — cheap (one
+tail read, no full-file hash), stable for immutable Parquet files (the
+footer lives in the tail, so a rewritten file re-fingerprints), and
+computed through whatever source wrapper the scan reads through, so a
+fault-injected test source fingerprints its *injected* view
+consistently.  The deliberate blind spot: an **in-place repair that
+preserves size and tail bytes** (restoring a mid-file region from a
+replica) keeps the old fingerprint, so stale quarantines replay onto
+the now-healthy file.  The loss is never silent — every replay lands in
+the :class:`~parquet_floor_tpu.format.file_read.SalvageReport` and as a
+``salvage.map_skip`` trace decision — but the remedy after an in-place
+repair is to delete (or rebuild) the sidecar.  Files repaired the
+normal way — rewritten through a writer — re-fingerprint, because the
+footer bytes move.
+
+Thread-safety: ``record``/``lookup``/``save`` may be called from any
+thread (scan workers record concurrently); ``save`` writes atomically
+(temp file + rename) so a crashed scan never leaves a truncated map.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from typing import Dict, List, Optional
+
+_VERSION = 1
+_TAIL_BYTES = 4096
+
+
+def fingerprint(source) -> str:
+    """The map key for one positional source: ``"<size>:<crc32(tail)>"``.
+
+    Reads at most the last 4 KiB through the source itself (so wrappers
+    — retries, fault injection, prefetch caches — fingerprint the bytes
+    the scan actually sees)."""
+    size = int(source.size)
+    n = min(_TAIL_BYTES, size)
+    tail = bytes(source.read_at(size - n, n)) if n else b""
+    return f"{size}:{zlib.crc32(tail) & 0xFFFFFFFF:08x}"
+
+
+class QuarantineMap:
+    """In-memory view of a quarantine sidecar (see module docstring).
+
+    ``entries(fp)`` returns the recorded unit list for one file
+    fingerprint; ``record(fp, skips)`` folds new
+    :class:`~parquet_floor_tpu.format.file_read.SalvageSkip` records in
+    (deduplicated on ``(row_group, column, page, kind)``).
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = os.fspath(path) if path is not None else None
+        self._lock = threading.Lock()
+        self._files: Dict[str, dict] = {}
+
+    # -- persistence --------------------------------------------------------
+
+    @classmethod
+    def open(cls, path) -> "QuarantineMap":
+        """Load the sidecar at ``path``, or start an empty map bound to
+        it when the file does not exist yet.  A sidecar that does not
+        parse raises ``ValueError`` — a corrupt *map* must never
+        silently discard the quarantine history it was supposed to
+        carry."""
+        m = cls(path)
+        p = os.fspath(path)
+        if os.path.exists(p):
+            try:
+                with open(p, "rb") as fh:
+                    data = json.loads(fh.read().decode("utf-8"))
+            except (OSError, MemoryError):
+                raise
+            except Exception as e:
+                raise ValueError(
+                    f"quarantine map {p!r} does not parse: {e}"
+                ) from e
+            if not isinstance(data, dict) or data.get("version") != _VERSION:
+                raise ValueError(
+                    f"quarantine map {p!r} has unknown version "
+                    f"{data.get('version') if isinstance(data, dict) else data!r}"
+                )
+            m._files = data.get("files") or {}
+        return m
+
+    def save(self, path: Optional[str] = None) -> str:
+        """Write the map atomically (temp file + rename).  Returns the
+        path written."""
+        p = os.fspath(path) if path is not None else self.path
+        if p is None:
+            raise ValueError("QuarantineMap has no path; pass one to save()")
+        with self._lock:
+            payload = json.dumps(
+                {"version": _VERSION, "files": self._files},
+                sort_keys=True, indent=1,
+            )
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(payload)
+        os.replace(tmp, p)
+        return p
+
+    # -- queries ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._files)
+
+    def entries(self, fp: str) -> List[dict]:
+        """The recorded quarantine entries for one fingerprint (copies;
+        empty list when the file is unknown)."""
+        with self._lock:
+            rec = self._files.get(fp)
+            return [dict(u) for u in rec["units"]] if rec else []
+
+    def known_bad(self, fp: str) -> dict:
+        """Replay index for one file:
+        ``{(row_group, column): {"chunk": entry|None, "pages": {ordinal: entry}}}``
+        — the shape ``ParquetFileReader`` consults per chunk.  Entries
+        with ``kind == "dict"`` are informational only (dictionary
+        recovery re-runs; see module docstring)."""
+        out: dict = {}
+        for u in self.entries(fp):
+            key = (u.get("row_group"), u.get("column"))
+            slot = out.setdefault(key, {"chunk": None, "pages": {}})
+            if u.get("kind") == "chunk":
+                slot["chunk"] = u
+            elif u.get("kind") in ("page_null", "row_mask"):
+                slot["pages"][int(u["page"])] = u
+        return out
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, fp: str, report, path: Optional[str] = None) -> int:
+        """Fold one salvage report's skips into the map under ``fp``.
+        Returns how many NEW entries were added (re-recording a known
+        quarantine is a no-op, so repeated scans keep the map stable)."""
+        skips = getattr(report, "skips", report)
+        added = 0
+        with self._lock:
+            rec = self._files.setdefault(fp, {"path": path, "units": []})
+            if path and not rec.get("path"):
+                rec["path"] = path
+            seen = {
+                (u.get("row_group"), u.get("column"), u.get("page"),
+                 u.get("kind"))
+                for u in rec["units"]
+            }
+            for s in skips:
+                key = (s.row_group, s.column, s.page, s.kind)
+                if key in seen:
+                    continue
+                seen.add(key)
+                rec["units"].append({
+                    "row_group": s.row_group,
+                    "column": s.column,
+                    "page": s.page,
+                    "kind": s.kind,
+                    "rows": s.rows,
+                    "row_span": list(s.row_span) if s.row_span else None,
+                    "error": s.error,
+                })
+                added += 1
+        return added

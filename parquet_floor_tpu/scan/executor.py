@@ -28,12 +28,17 @@ The same planner + budget also feed the device engine:
 ``tpu.engine.iter_dataset_row_groups`` runs its stage‖ship‖decode
 pipeline across file boundaries.
 
-Salvage mode is rejected with the same ``UnsupportedFeatureError``
-contract as ``TpuRowGroupReader``: quarantine bookkeeping is defined by
-sequential per-file reads, and a concurrent scan cannot honor it.
-``verify_crc`` and ``io_retries`` pass straight through (CRC checks ride
-the normal decode path; retries wrap the *real* I/O below the prefetch
-cache, so cache hits never consume retry budget).
+Salvage mode (``ReaderOptions(salvage=True)``) IS honored on both scan
+faces: each unit decodes on its worker thread into a fresh per-unit
+``SalvageReport`` and the consumer thread folds them — in delivery
+order, so the folded report is deterministic no matter how the pool
+scheduled the decodes — into ``DatasetScanner.salvage_report`` via the
+``SalvageReport.merge`` protocol (``docs/robustness.md``).  Each
+delivered :class:`ScanUnit` also carries its own unit report, which is
+how the ``DataLoader`` decides unit-level quarantine.  ``verify_crc``
+and ``io_retries`` pass straight through (CRC checks ride the normal
+decode path; retries wrap the *real* I/O below the prefetch cache, so
+cache hits never consume retry budget).
 """
 
 from __future__ import annotations
@@ -46,8 +51,11 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, replace
 from typing import List, NamedTuple, Optional, Sequence, Set
 
-from ..errors import UnsupportedFeatureError
-from ..format.file_read import ParquetFileReader, ReaderOptions
+from ..format.file_read import (
+    ParquetFileReader,
+    ReaderOptions,
+    SalvageReport,
+)
 from ..io.source import FileSource, RetryingSource
 from ..utils import trace
 from .plan import Extent, FilePlan, GroupPlan, ScanOptions, plan_file
@@ -209,11 +217,14 @@ class _ByteBudget:
 
 class ScanUnit(NamedTuple):
     """One delivered row group: the file's position in the dataset, the
-    group's REAL index within that file, and the decoded batch."""
+    group's REAL index within that file, the decoded batch, and (salvage
+    mode only) the unit's own :class:`SalvageReport` — what THIS group's
+    decode had to give up, before any merging."""
 
     file_index: int
     group_index: int
     batch: object  # RowGroupBatch
+    salvage: Optional[SalvageReport] = None
 
 
 @dataclass
@@ -248,22 +259,13 @@ def _source_chain(source, options: Optional[ReaderOptions]) -> PrefetchedSource:
         if options is not None and options.io_retries > 0 and \
                 not isinstance(src, RetryingSource):
             src = RetryingSource(
-                src, options.io_retries, options.io_retry_backoff_s
+                src, options.io_retries, options.io_retry_backoff_s,
+                deadline_s=options.io_retry_deadline_s,
             )
         return PrefetchedSource(src)
     except BaseException:
         src.close()
         raise
-
-
-def _reject_salvage(options: Optional[ReaderOptions]) -> None:
-    if options is not None and options.salvage:
-        raise UnsupportedFeatureError(
-            "ReaderOptions.salvage is a sequential host-engine feature; "
-            "the scan scheduler cannot honor its quarantine bookkeeping — "
-            "use the sequential dataset stream (no scan options) for "
-            "salvage reads"
-        )
 
 
 class DatasetScanner:
@@ -274,9 +276,10 @@ class DatasetScanner:
     ``columns`` projects by top-level field name (the reference's
     projection rule); ``predicate`` prunes row groups per file before
     any of their bytes are read; ``options`` is the usual
-    :class:`ReaderOptions` (``salvage`` rejected, see module docstring).
-    An empty ``sources`` list yields nothing (an empty dataset directory
-    is a valid no-op scan).
+    :class:`ReaderOptions` (``salvage`` honored via per-unit reports —
+    see module docstring and ``self.salvage_report``).  An empty
+    ``sources`` list yields nothing (an empty dataset directory is a
+    valid no-op scan).
 
     ``order`` generalizes delivery beyond the default (file order, then
     row-group order): an explicit sequence of ``(file_index,
@@ -299,7 +302,6 @@ class DatasetScanner:
                  predicate=None,
                  order: Optional[Sequence] = None,
                  metadata: Optional[Sequence] = None):
-        _reject_salvage(options)
         self._sources = list(sources)
         if metadata is not None and len(metadata) != len(self._sources):
             raise ValueError(
@@ -333,6 +335,12 @@ class DatasetScanner:
         self._options = options
         self._scan = scan or ScanOptions()
         self._predicate = predicate
+        # salvage: per-unit reports fold here, in DELIVERY order (the
+        # merge protocol); None in strict mode
+        self._salvage = options is not None and options.salvage
+        self.salvage_report: Optional[SalvageReport] = (
+            SalvageReport() if self._salvage else None
+        )
         # the scan is ATTRIBUTED to the tracer scope active at
         # construction: worker tasks bind to it (Tracer.run) and the
         # consumer-side paths re-activate it, so two scanners built
@@ -502,9 +510,17 @@ class DatasetScanner:
             with trace.span(
                 "decode", work.plan.uncompressed_bytes, attrs=attrs
             ):
-                return state.reader.read_row_group(
-                    work.plan.group_index, self._filter
+                if not self._salvage:
+                    return state.reader.read_row_group(
+                        work.plan.group_index, self._filter
+                    ), None
+                # per-unit report: worker threads never touch a shared
+                # report; the consumer folds them in delivery order
+                unit_rep = SalvageReport()
+                batch = state.reader.read_row_group(
+                    work.plan.group_index, self._filter, report=unit_rep
                 )
+                return batch, unit_rep
         finally:
             state.cache.drop(work.plan.extents)
 
@@ -575,7 +591,7 @@ class DatasetScanner:
         work, fut = self._pending.popleft()
         t0 = time.perf_counter()
         try:
-            batch = fut.result()
+            batch, unit_rep = fut.result()
         except BaseException:
             self._budget.release(work.cost)
             self.close()
@@ -584,12 +600,21 @@ class DatasetScanner:
         self._budget.release(work.cost)
         self._delivered_fi = work.file_index
         state = self._files.get(work.file_index)
+        if unit_rep is not None:
+            # delivery-order merge (the deterministic fold), plus a copy
+            # into the per-file reader's report so close() records it
+            # into the quarantine map exactly like a sequential read
+            self.salvage_report.merge_in(unit_rep)
+            if state is not None and state.reader.salvage_report is not None:
+                state.reader.salvage_report.merge_in(unit_rep)
         if state is not None:
             state.remaining -= 1
             if state.remaining == 0:
                 self._close_file(work.file_index)
         self._top_up()  # refill while the consumer processes the batch
-        return ScanUnit(work.file_index, work.plan.group_index, batch)
+        return ScanUnit(
+            work.file_index, work.plan.group_index, batch, unit_rep
+        )
 
     def report(self) -> trace.ScanReport:
         """The scan's :class:`~parquet_floor_tpu.utils.trace.ScanReport`,
@@ -657,7 +682,8 @@ def scan_device_groups(sources: Sequence,
                        predicate=None,
                        float64_policy: str = "bits",
                        dict_form: str = "gather",
-                       on_report=None):
+                       on_report=None,
+                       on_salvage=None):
     """Scan-scheduled DEVICE decode of a dataset: yields
     ``(file_index, group_index, {name: DeviceColumn})`` in order.
 
@@ -665,24 +691,33 @@ def scan_device_groups(sources: Sequence,
     coalesced extents under the ``prefetch_bytes`` budget ahead of the
     engine, and ``tpu.engine.iter_dataset_row_groups`` runs its
     stage‖ship‖decode pipeline ACROSS file boundaries — the group-i /
-    group-i+1 overlap no longer drains at each file's end.  Footers are
-    opened eagerly and every file stays open until the scan ends (page
-    bytes still move only under the budget) — so the dataset's file
-    count is bounded by the process fd limit here, unlike the host
-    :class:`DatasetScanner`, which closes each file as its last group
-    delivers.  For many-thousand-file datasets, batch the source list.
-    ``options.verify_crc``/``salvage`` are rejected exactly as
-    ``TpuRowGroupReader`` rejects them.
+    group-i+1 overlap no longer drains at each file's end.  Files open
+    lazily through the engine's WINDOWED task iterator and close right
+    after their last planned group delivers, so fd usage follows the
+    prefetch window (budget + pipeline depth), not the dataset size —
+    the same fd-bounded lifetime contract as the host
+    :class:`DatasetScanner`.  File-boundary errors (a later file's
+    corrupt footer, schema mismatch) DEFER: groups already planned
+    deliver first, preserving sequential error order.
+
+    ``options.salvage`` is honored: each damaged unit decodes through
+    the host salvage engine (the quarantine decision is face-identical
+    by construction — see ``TpuRowGroupReader``), chunk-quarantined
+    columns arrive as ``BatchColumn(quarantined=True)`` placeholders IN
+    POSITION, and ``on_salvage`` (a callable taking one merged
+    :class:`~parquet_floor_tpu.format.file_read.SalvageReport`) receives
+    the dataset-level fold when the scan ends.  ``verify_crc`` without
+    salvage is rejected exactly as ``TpuRowGroupReader`` rejects it.
 
     ``on_report`` (a callable taking one
     :class:`~parquet_floor_tpu.utils.trace.ScanReport`) is invoked once
     when the scan finishes or is abandoned, with the health summary
     built from the tracer scope active when the scan started.
     """
+    from ..batch.columns import BatchColumn
     from ..format.schema import dataset_schema_key
     from ..tpu.engine import TpuRowGroupReader, iter_dataset_row_groups
 
-    _reject_salvage(options)
     sc = scan or ScanOptions()
     # attribute the whole scan to the tracer active at generator start
     # (worker tasks bind to it explicitly; a bare contextvar would not
@@ -691,17 +726,18 @@ def scan_device_groups(sources: Sequence,
     tracer = trace.current()
     t_start = time.perf_counter()
     budget = _ByteBudget(sc.prefetch_bytes, tracer)
-    readers: List[TpuRowGroupReader] = []
-    tasks: List[tuple] = []          # (reader, group_index)
+    salvage = options is not None and options.salvage
+    readers: List[TpuRowGroupReader] = []   # open order == file order
     units: List[tuple] = []          # (file_index, GroupPlan, cache, cost)
+    files: dict = {}                 # fi -> (tpu, cache, fplan)
+    state = {"schema_key": None, "deferred": None, "opened": -1}
     pool = ThreadPoolExecutor(max_workers=sc.threads,
                               thread_name_prefix="pftpu-scanio")
 
-    def open_file(source):
-        """Footer open + plan for one file (runs in the pool: footer
-        parses of later files overlap each other and the first decodes).
-        Returns (engine reader, cache, plan); the reader owns the chain."""
-        cache = _source_chain(source, options)
+    def open_file(fi):
+        """Footer open + plan for file ``fi`` (consumer thread, lazily,
+        strictly in file order — the windowed lifetime contract)."""
+        cache = _source_chain(sources[fi], options)
         reader_opts = (
             replace(options, io_retries=0) if options is not None else None
         )
@@ -719,17 +755,40 @@ def scan_device_groups(sources: Sequence,
             # (e.g. verify_crc pinned to host) must not leak ours
             fr.close()
             raise
-        try:
-            keep = (
-                set(predicate.row_groups(fr)) if predicate is not None else None
+        readers.append(tpu)
+        key = dataset_schema_key(fr.schema.columns)
+        if state["schema_key"] is None:
+            state["schema_key"] = key
+        elif key != state["schema_key"]:
+            raise DatasetSchemaError(
+                f"dataset file {fi} disagrees with the first file's schema"
             )
-            fplan = plan_file(fr, set(columns) if columns else None, keep, sc)
-            if fplan.index_extents:
-                cache.load(fplan.index_extents)
-        except BaseException:
-            tpu.close()
-            raise
-        return tpu, cache, fplan
+        keep = (
+            set(predicate.row_groups(fr)) if predicate is not None else None
+        )
+        fplan = plan_file(fr, set(columns) if columns else None, keep, sc)
+        if fplan.index_extents:
+            cache.load(fplan.index_extents)
+        files[fi] = (tpu, cache, fplan)
+        for gp in fplan.groups:
+            units.append((fi, gp, cache, max(gp.read_bytes, 1)))
+
+    def ensure_next_file() -> bool:
+        """Open the next not-yet-opened file; False when exhausted or a
+        planning error deferred (sequential error order: groups already
+        planned deliver first, then the error surfaces)."""
+        if state["deferred"] is not None:
+            return False
+        nxt = state["opened"] + 1
+        if nxt >= len(sources):
+            return False
+        try:
+            open_file(nxt)
+        except BaseException as e:
+            state["deferred"] = e
+            return False
+        state["opened"] = nxt
+        return True
 
     def load_unit(cache_, gp, fi_):
         """Prefetch one group's extents (worker thread, scope-bound):
@@ -744,120 +803,146 @@ def scan_device_groups(sources: Sequence,
         trace.count("scan.bytes_prefetched", n)
         return n
 
-    open_futs = [pool.submit(tracer.run, open_file, s) for s in sources]
-    try:
-        schema_key = None
-        try:
-            for fi, fut in enumerate(open_futs):
-                tpu, cache, fplan = fut.result()
-                readers.append(tpu)
-                key = dataset_schema_key(tpu.reader.schema.columns)
-                if schema_key is None:
-                    schema_key = key
-                elif key != schema_key:
-                    raise DatasetSchemaError(
-                        f"dataset file {fi} disagrees with the first "
-                        "file's schema"
-                    )
-                for gp in fplan.groups:
-                    cost = max(gp.read_bytes, 1)
-                    tasks.append((tpu, gp.group_index))
-                    units.append((fi, gp, cache, cost))
-        except BaseException:
-            # close readers opened by futures not yet collected into
-            # `readers` (the finally below only knows collected ones)
-            for fut in open_futs:
-                if fut.cancel():
-                    continue
-                try:
-                    tpu, _, _ = fut.result()
-                except BaseException:
-                    continue
-                if tpu not in readers:
-                    tpu.close()
-            raise
+    loads: deque = deque()  # (unit_idx, cost, future) admitted to budget
+    next_load = 0
+    floor = 0  # first unit the engine has not consumed yet
+    WINDOW = max(2, sc.threads * 2)
 
-        # the POSITIONAL contract: every yielded group carries the FIRST
-        # file's selected columns, in schema order — exactly the
-        # sequential TPU batch path's ordering rule.  The engine's dicts
-        # arrive in each file's chunk order, which footer-identical
-        # schemas do not pin; reordering here keeps positional consumers
-        # safe, and a chunk missing from a group raises instead of
-        # silently yielding fewer columns.
-        want = set(columns) if columns else None
-        sel_names = [
-            c.path[0] if len(c.path) == 1 else ".".join(c.path)
-            for r in readers[:1]
-            for c in r.reader.schema.columns
-            if want is None or c.path[0] in want
-        ]
-
-        loads: deque = deque()  # (unit_idx, future) admitted to the budget
-        next_load = 0
-        floor = 0  # first unit the engine has not consumed yet
-
-        def pump():
-            nonlocal next_load
-            if next_load < floor:
-                # budget lag left these behind and the engine already
-                # read them directly — never prefetch a consumed group
-                next_load = floor
-            while next_load < len(units):
-                fi_, gp, cache_, cost = units[next_load]
-                if loads and not budget.try_acquire(cost):
+    def pump():
+        nonlocal next_load
+        if next_load < floor:
+            # budget lag left these behind and the engine already
+            # read them directly — never prefetch a consumed group
+            next_load = floor
+        while len(loads) < WINDOW:
+            if next_load >= len(units):
+                # discover more units only while the load window has
+                # room: this is what bounds how far ahead files open
+                if not ensure_next_file():
                     return
-                if not loads:
-                    budget.admit(cost)  # queue empty ⇒ budget empty
-                loads.append((next_load, pool.submit(
-                    tracer.run, load_unit, cache_, gp, fi_
-                )))
-                tracer.gauge_max("scan.queue_depth_max", len(loads))
-                next_load += 1
+                continue
+            fi_, gp, cache_, cost = units[next_load]
+            if loads and not budget.try_acquire(cost):
+                return
+            if not loads:
+                budget.admit(cost)  # queue empty ⇒ budget empty
+            loads.append((next_load, cost, pool.submit(
+                tracer.run, load_unit, cache_, gp, fi_
+            )))
+            tracer.gauge_max("scan.queue_depth_max", len(loads))
+            next_load += 1
 
+    def tasks():
+        """The engine's windowed task feed: (lazy reader, group,
+        close_after) per planned unit, pulling file opens DEPTH-ahead.
+        Runs on the consumer thread (the engine's submission loop lives
+        in the generator we drive)."""
+        i = 0
+        while True:
+            while i >= len(units):
+                if not ensure_next_file():
+                    return
+            fi_, gp, _cache, _cost = units[i]
+            tpu = files[fi_][0]
+            # a file's units all append at its open, so the next unit's
+            # file index changing (or the list ending) marks its last one
+            last_of_file = i + 1 >= len(units) or units[i + 1][0] != fi_
+            yield (lambda t=tpu: t), gp.group_index, last_of_file, None
+            i += 1
+
+    groups = None
+    try:
+        # the first file opens up front: its schema defines the
+        # positional contract below (and an empty dataset is a no-op)
+        ensure_next_file()
+        sel_names: List[str] = []
+        desc_by: dict = {}
+        if files:
+            want = set(columns) if columns else None
+            first = files[0][0].reader
+            for c in first.schema.columns:
+                if want is None or c.path[0] in want:
+                    n = c.path[0] if len(c.path) == 1 else ".".join(c.path)
+                    sel_names.append(n)
+                    desc_by[n] = c
         pump()
-        groups = iter_dataset_row_groups(tasks, columns=columns)
-        try:
-            for i in range(len(units)):
-                t0 = time.perf_counter()
+        groups = iter_dataset_row_groups(tasks(), columns=columns)
+        i = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
                 cols = next(groups)
-                tracer.add("scan.consumer_stall", time.perf_counter() - t0)
-                fi_, gp, cache_, cost = units[i]
-                ordered = {}
-                for n in sel_names:
-                    if n not in cols:
-                        raise ValueError(
-                            f"row group {gp.group_index} missing column {n}"
+            except StopIteration:
+                break
+            tracer.add("scan.consumer_stall", time.perf_counter() - t0)
+            fi_, gp, cache_, cost = units[i]
+            # the POSITIONAL contract: every yielded group carries the
+            # FIRST file's selected columns, in schema order — exactly
+            # the sequential TPU batch path's ordering rule.  A chunk
+            # missing from a group raises — UNLESS salvage recorded its
+            # quarantine, in which case it stays IN POSITION as a
+            # fail-loudly placeholder (the host batch face's contract).
+            rep = files[fi_][0].reader.salvage_report
+            ordered = {}
+            for n in sel_names:
+                if n not in cols:
+                    if salvage and rep is not None and \
+                            rep.chunk_quarantined(gp.group_index, n):
+                        ordered[n] = BatchColumn(
+                            desc_by[n], None, quarantined=True
                         )
-                    ordered[n] = cols[n]
-                yield fi_, gp.group_index, ordered
-                floor = i + 1
-                # the engine staged this group before yielding it: its
-                # raw extents are dead weight now — drop and refill
-                if loads and loads[0][0] == i:
-                    _, fut = loads.popleft()
-                    try:
-                        fut.result()
-                    except Exception:
-                        pass  # failed prefetch already fell back to direct reads
-                    budget.release(cost)
-                cache_.drop(gp.extents)
-                pump()
-        finally:
-            # quiesce the engine pipeline FIRST: closing the generator
-            # joins its stage/ship pools, so no in-flight stage read can
-            # race the reader closes below (the io.source close contract)
-            groups.close()
+                        continue
+                    raise ValueError(
+                        f"row group {gp.group_index} missing column {n}"
+                    )
+                ordered[n] = cols[n]
+            yield fi_, gp.group_index, ordered
+            floor = i + 1
+            # the engine staged this group before yielding it: its
+            # raw extents are dead weight now — drop and refill
+            if loads and loads[0][0] == i:
+                _, cost0, fut = loads.popleft()
+                try:
+                    fut.result()
+                except Exception:
+                    pass  # failed prefetch already fell back to direct reads
+                budget.release(cost0)
+            cache_.drop(gp.extents)
+            pump()
+            i += 1
+        if state["deferred"] is not None:
+            # file-boundary error, deferred until every already-planned
+            # group delivered (sequential error order); tagged so row
+            # faces can re-raise it UNWRAPPED at the file boundary
+            err, state["deferred"] = state["deferred"], None
+            err.pftpu_scan_planning = True
+            raise err
     finally:
+        # quiesce the engine pipeline FIRST: closing the generator
+        # joins its stage/ship pools, so no in-flight stage read can
+        # race the reader closes below (the io.source close contract)
+        if groups is not None:
+            groups.close()
         pool.shutdown(wait=True)
         for r in readers:
             r.close()
-        if on_report is not None:
-            import sys as _sys
+        import sys as _sys
 
-            # a raising callback must never REPLACE a scan error that is
-            # already unwinding through this finally — the report is
-            # diagnostics, the in-flight error is the diagnosis
-            unwinding = _sys.exc_info()[0] is not None
+        # a raising callback must never REPLACE a scan error that is
+        # already unwinding through this finally — the report is
+        # diagnostics, the in-flight error is the diagnosis
+        unwinding = _sys.exc_info()[0] is not None
+        if on_salvage is not None and salvage:
+            merged = SalvageReport.merge([
+                r.reader.salvage_report for r in readers
+                if r.reader.salvage_report is not None
+            ])
+            try:
+                on_salvage(merged)
+            except Exception:
+                if not unwinding:
+                    raise
+        if on_report is not None:
             try:
                 on_report(tracer.scan_report(
                     wall_seconds=time.perf_counter() - t_start,

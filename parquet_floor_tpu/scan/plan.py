@@ -148,6 +148,14 @@ def chunk_ranges(rg, column_filter: Optional[Set[str]] = None
         if column_filter and meta.path_in_schema and \
                 meta.path_in_schema[0] not in column_filter:
             continue
+        if meta.data_page_offset is None or \
+                meta.total_compressed_size is None:
+            # corrupt meta (a thrift flip can erase a field and still
+            # parse): planning skips the chunk; read_column_chunk hits
+            # the same hole inside the classified-error ladder and
+            # raises/quarantines WITH context — the planner must not
+            # crash ahead of it with a bare TypeError
+            continue
         start, length = _chunk_byte_range(meta)
         ranges.append((int(start), int(length)))
     return ranges

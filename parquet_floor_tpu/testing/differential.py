@@ -1,0 +1,521 @@
+"""Differential corruption-fuzz harness — the proof that salvage is a
+property of the SYSTEM, not of one code path.
+
+Four faces read the same corpus (``docs/robustness.md``):
+
+* ``sequential`` — per-file ``ParquetFileReader`` loop (the reference
+  detector; every tier's quarantine decision is made here);
+* ``host_scan`` — ``scan.DatasetScanner`` (worker-thread decode,
+  per-unit report merge);
+* ``device_scan`` — ``scan.scan_device_groups`` (TPU engine pipeline,
+  host-delegated salvage decode, placeholder columns);
+* ``loader`` — ``data.DataLoader`` (unit-level quarantine, fixed-shape
+  batches).
+
+:func:`differential_case` seeds deterministic bit flips into a clean
+corpus, replays the damage through the requested faces under a SIGALRM
+time limit, and asserts the contract the fuzz exists to pin:
+
+* no hang, no non-taxonomy crash — damage either salvages or raises
+  ``ParquetError`` (and if ONE face deems a case fatal, every face
+  must);
+* **identical quarantine sets** — every face loses exactly the same
+  units, down to ``(file, row_group, column, page, kind)``;
+* **identical surviving bytes** — the decoded remainder is
+  bit-identical across faces;
+* **no silent divergence on undamaged data** — any (group, column)
+  with no recorded skip must match the CLEAN corpus decode exactly on
+  the group's surviving rows, with ``pyarrow`` as the independent
+  oracle when it is importable (our own clean decode otherwise).
+
+The loader face's contract is unit-level: its quarantined units must be
+exactly the geometry-damaged groups of the sequential face, and its
+batch stream must equal the surviving units' rows re-sliced — nothing
+dropped beyond the quarantine, nothing duplicated.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pathlib
+import signal
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..format.encodings.plain import ByteArrayColumn
+from ..format.file_read import ParquetFileReader, ReaderOptions
+from ..errors import ParquetError
+
+__all__ = [
+    "CaseOutcome",
+    "differential_case",
+    "time_limit",
+    "write_reference_corpus",
+    "materialize_case",
+]
+
+DEFAULT_TIMEOUT_S = 30.0
+
+
+class CaseTimeout(Exception):
+    """A face exceeded the per-case SIGALRM budget (a hang, by fiat)."""
+
+
+@contextlib.contextmanager
+def time_limit(seconds: float):
+    """SIGALRM-backed hard per-case timeout (main thread only)."""
+    def _handler(signum, frame):
+        raise CaseTimeout()
+
+    old = signal.signal(signal.SIGALRM, _handler)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)
+
+
+# ---------------------------------------------------------------------------
+# corpus + corruption
+# ---------------------------------------------------------------------------
+
+def write_reference_corpus(dir_path, n_files: int = 3, *,
+                           rows_per_file: int = 1200, groups: int = 3,
+                           page_values: int = 100, seed: int = 17):
+    """A small multi-file corpus exercising every salvage tier's
+    terrain: REQUIRED ints/doubles (row-mask tier), OPTIONAL strings
+    with repeating values (dictionary pages + page-null tier), OPTIONAL
+    doubles (page-null tier).  CRC on (the writer default), SNAPPY."""
+    from .. import ParquetFileWriter, WriterOptions, types
+
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.optional(types.DOUBLE).named("v"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.DOUBLE).named("d"),
+    )
+    rng = np.random.default_rng(seed)
+    per = rows_per_file // groups
+    pathlib.Path(dir_path).mkdir(parents=True, exist_ok=True)
+    paths = []
+    for fi in range(n_files):
+        p = os.path.join(os.fspath(dir_path), f"ref{fi}.parquet")
+        with ParquetFileWriter(p, schema, WriterOptions(
+            row_group_rows=per, data_page_values=page_values,
+        )) as w:
+            for lo in range(0, rows_per_file, per):
+                n = min(per, rows_per_file - lo)
+                w.write_columns({
+                    "k": np.arange(lo, lo + n, dtype=np.int64)
+                    + fi * 1_000_000,
+                    "v": [
+                        None if i % 9 == 0 else float(x)
+                        for i, x in enumerate(rng.standard_normal(n))
+                    ],
+                    "s": [
+                        None if i % 7 == 0 else f"s{(i * 13 + fi) % 41}"
+                        for i in range(lo, lo + n)
+                    ],
+                    "d": rng.standard_normal(n),
+                })
+        paths.append(p)
+    return paths
+
+
+def case_flips(paths: Sequence[str], case_seed: int,
+               footer_tail: int = 2048) -> Dict[int, List[Tuple[int, int]]]:
+    """Deterministic per-file single-bit flips for one case.  Most
+    seeds aim at page bytes (the region salvage can absorb); every 7th
+    seed may hit anywhere, footer included — those cases pin the
+    all-faces-agree-on-fatal contract."""
+    rng = np.random.default_rng(case_seed)
+    out: Dict[int, List[Tuple[int, int]]] = {}
+    n_flips = int(rng.integers(1, 4))
+    for _ in range(n_flips):
+        fi = int(rng.integers(0, len(paths)))
+        size = pathlib.Path(paths[fi]).stat().st_size
+        if case_seed % 7 == 6:
+            off = int(rng.integers(0, size))
+        else:
+            off = int(rng.integers(0, max(1, size - footer_tail)))
+        bit = 1 << int(rng.integers(0, 8))
+        out.setdefault(fi, []).append((off, bit))
+    return out
+
+
+def materialize_case(paths: Sequence[str], case_seed: int, out_dir):
+    """Byte-flipped copies of ``paths`` for one case (files without
+    flips are shared, not copied — the faces open them read-only)."""
+    flips = case_flips(paths, case_seed)
+    pathlib.Path(out_dir).mkdir(parents=True, exist_ok=True)
+    out = []
+    for fi, p in enumerate(paths):
+        if fi not in flips:
+            out.append(p)
+            continue
+        data = bytearray(pathlib.Path(p).read_bytes())
+        for off, bit in flips[fi]:
+            data[off] ^= bit
+        q = pathlib.Path(out_dir) / f"case{case_seed}_f{fi}.parquet"
+        q.write_bytes(bytes(data))
+        out.append(str(q))
+    return out, flips
+
+
+# ---------------------------------------------------------------------------
+# canonicalization (face-neutral cells)
+# ---------------------------------------------------------------------------
+
+def _cells_host(batch_col) -> tuple:
+    """One host ColumnBatch → a tuple of per-row cells (None at
+    nulls); floats stay exact (same decoded bits on every face)."""
+    dense, mask = batch_col.dense()
+    if isinstance(dense, ByteArrayColumn):
+        offs = np.asarray(dense.offsets)
+        data = np.asarray(dense.data).tobytes()
+        vals = [
+            data[offs[i]:offs[i + 1]] for i in range(len(offs) - 1)
+        ]
+    else:
+        vals = np.asarray(dense).tolist()
+    if mask is None:
+        return tuple(vals)
+    return tuple(
+        None if m else v for v, m in zip(vals, np.asarray(mask).tolist())
+    )
+
+
+def _canon_host_group(batch) -> Dict[str, tuple]:
+    return {
+        ".".join(c.descriptor.path): _cells_host(c) for c in batch.columns
+    }
+
+
+def _cells_device(dc) -> tuple:
+    """One DeviceColumn → per-row cells (device arrays cross to host
+    here; DOUBLE under the 'bits' policy views back to float64 — exact)."""
+    from ..format.parquet_thrift import Type as _T
+
+    mask = None if dc.mask is None else np.asarray(dc.mask)
+    if dc.lengths is not None:
+        rows = np.asarray(dc.values)
+        lens = np.asarray(dc.lengths)
+        vals = [bytes(rows[i, : lens[i]].tobytes()) for i in range(len(lens))]
+    else:
+        v = np.asarray(dc.values)
+        if dc.descriptor.physical_type == _T.DOUBLE and \
+                v.dtype == np.int64:
+            v = v.view(np.float64)
+        vals = v.tolist()
+    if mask is None:
+        return tuple(vals)
+    return tuple(None if m else v for v, m in zip(vals, mask.tolist()))
+
+
+def _quarantine_keys(fi: int, report) -> frozenset:
+    return frozenset((fi,) + s.key() for s in report.skips)
+
+
+# ---------------------------------------------------------------------------
+# the four faces
+# ---------------------------------------------------------------------------
+
+class FaceResult:
+    """One face's outcome: ``fatal`` (the ParquetError string) or the
+    quarantine-key set + canonical surviving groups."""
+
+    def __init__(self, fatal: Optional[str] = None):
+        self.fatal = fatal
+        self.quarantine: frozenset = frozenset()
+        self.groups: Dict[Tuple[int, int], Dict[str, tuple]] = {}
+
+
+def run_sequential(paths, opts: ReaderOptions) -> FaceResult:
+    res = FaceResult()
+    keys = set()
+    try:
+        for fi, p in enumerate(paths):
+            with ParquetFileReader(p, options=opts) as r:
+                for gi in range(len(r.row_groups)):
+                    res.groups[(fi, gi)] = _canon_host_group(
+                        r.read_row_group(gi)
+                    )
+                keys |= set(_quarantine_keys(fi, r.salvage_report))
+    except ParquetError as e:
+        return FaceResult(fatal=type(e).__name__)
+    res.quarantine = frozenset(keys)
+    return res
+
+
+def run_host_scan(paths, opts: ReaderOptions) -> FaceResult:
+    from ..scan import DatasetScanner
+
+    res = FaceResult()
+    keys = set()
+    try:
+        with DatasetScanner(list(paths), options=opts) as scanner:
+            for unit in scanner:
+                res.groups[(unit.file_index, unit.group_index)] = \
+                    _canon_host_group(unit.batch)
+                keys |= set(
+                    _quarantine_keys(unit.file_index, unit.salvage)
+                )
+    except ParquetError as e:
+        return FaceResult(fatal=type(e).__name__)
+    res.quarantine = frozenset(keys)
+    return res
+
+
+def run_device_scan(paths, opts: ReaderOptions) -> FaceResult:
+    from ..batch.columns import BatchColumn
+    from ..scan import scan_device_groups
+
+    res = FaceResult()
+    reports = []
+    by_path = {p: fi for fi, p in enumerate(paths)}
+    try:
+        for fi, gi, cols in scan_device_groups(
+            list(paths), options=opts, on_salvage=reports.append,
+        ):
+            res.groups[(fi, gi)] = {
+                name: _cells_device(dc)
+                for name, dc in cols.items()
+                if not (isinstance(dc, BatchColumn) and dc.quarantined)
+            }
+    except ParquetError as e:
+        return FaceResult(fatal=type(e).__name__)
+    keys = set()
+    for rep in reports:
+        for s in rep.skips:
+            fi = by_path.get(s.path)
+            assert fi is not None, f"skip with unknown path {s.path!r}"
+            keys.add((fi,) + s.key())
+    res.quarantine = frozenset(keys)
+    return res
+
+
+def run_loader(paths, opts: ReaderOptions, batch_size: int = 100):
+    """The loader face: returns ``(FaceResult-without-groups, loader
+    row stream as a list of per-column cell tuples, quarantined
+    units)``.  The stream covers every surviving row
+    (drop_remainder=False)."""
+    from ..data import DataLoader
+
+    try:
+        loader = DataLoader(
+            list(paths), batch_size, drop_remainder=False,
+            num_epochs=1, reader_options=opts,
+        )
+        rows = []
+        names = [s.name for s in loader._specs]
+        for batch in loader:
+            cols = [_cells_device(c) for c in batch.columns]
+            for i in range(batch.num_valid):
+                rows.append(tuple(c[i] for c in cols))
+        q_units = list(loader.quarantined_units)
+        rep = loader.salvage_report
+        loader.close()
+    except ParquetError as e:
+        return FaceResult(fatal=type(e).__name__), None, None, None
+    res = FaceResult()
+    return res, rows, q_units, names
+
+
+# ---------------------------------------------------------------------------
+# the oracle
+# ---------------------------------------------------------------------------
+
+def _pyarrow_clean_groups(paths):
+    """Clean-corpus decode through pyarrow (independent oracle); None
+    when pyarrow is unavailable — the caller falls back to our own
+    clean decode."""
+    try:
+        import pyarrow.parquet as pq
+    except ImportError:
+        return None
+    out = {}
+    for fi, p in enumerate(paths):
+        f = pq.ParquetFile(p)
+        for gi in range(f.metadata.num_row_groups):
+            tbl = f.read_row_group(gi)
+            group = {}
+            for name in tbl.column_names:
+                col = tbl.column(name).to_pylist()
+                group[name] = tuple(
+                    v.encode() if isinstance(v, str) else v for v in col
+                )
+            out[(fi, gi)] = group
+    return out
+
+
+# ---------------------------------------------------------------------------
+# one differential case
+# ---------------------------------------------------------------------------
+
+class CaseOutcome:
+    def __init__(self, seed, fatal, quarantine, n_groups):
+        self.seed = seed
+        self.fatal = fatal          # taxonomy name when all faces raised
+        self.quarantine = quarantine
+        self.n_groups = n_groups
+
+    def __repr__(self):
+        what = self.fatal or f"{len(self.quarantine)} quarantined unit(s)"
+        return f"<case {self.seed}: {what}, {self.n_groups} groups>"
+
+
+def differential_case(ref_paths, case_seed: int, work_dir, *,
+                      faces=("sequential", "host_scan", "loader"),
+                      clean_oracle=None,
+                      timeout_s: float = DEFAULT_TIMEOUT_S,
+                      verify_crc: bool = True) -> CaseOutcome:
+    """Run one seeded corruption case through ``faces`` and assert the
+    differential contract (module docstring).  ``clean_oracle`` is the
+    pyarrow clean decode from :func:`_pyarrow_clean_groups` (computed
+    once per corpus by the caller); None falls back to our sequential
+    clean decode."""
+    assert faces and faces[0] == "sequential", \
+        "the sequential face is the reference detector and must run"
+    paths, _flips = materialize_case(ref_paths, case_seed, work_dir)
+    opts = ReaderOptions(salvage=True, verify_crc=verify_crc)
+
+    with time_limit(timeout_s):
+        ref = run_sequential(paths, opts)
+    results = {"sequential": ref}
+    loader_stream = None
+    for face in faces[1:]:
+        with time_limit(timeout_s):
+            if face == "host_scan":
+                results[face] = run_host_scan(paths, opts)
+            elif face == "device_scan":
+                results[face] = run_device_scan(paths, opts)
+            elif face == "loader":
+                res, rows, q_units, names = run_loader(paths, opts)
+                results[face] = res
+                loader_stream = (rows, q_units, names)
+            else:
+                raise ValueError(f"unknown face {face!r}")
+
+    # fatality must be unanimous: a case one face survives and another
+    # dies on is a divergence, not a judgment call
+    if ref.fatal is not None:
+        for face, r in results.items():
+            assert r.fatal is not None, (
+                f"seed {case_seed}: sequential died ({ref.fatal}) but "
+                f"{face} survived"
+            )
+        return CaseOutcome(case_seed, ref.fatal, frozenset(), 0)
+    for face, r in results.items():
+        assert r.fatal is None, (
+            f"seed {case_seed}: {face} died ({r.fatal}) but sequential "
+            "survived"
+        )
+
+    # identical quarantine sets + identical surviving bytes
+    for face in ("host_scan", "device_scan"):
+        r = results.get(face)
+        if r is None:
+            continue
+        assert r.quarantine == ref.quarantine, (
+            f"seed {case_seed}: {face} quarantine set diverged:\n"
+            f"  only-{face}: {sorted(r.quarantine - ref.quarantine)}\n"
+            f"  only-sequential: {sorted(ref.quarantine - r.quarantine)}"
+        )
+        assert set(r.groups) == set(ref.groups), (
+            f"seed {case_seed}: {face} delivered different groups"
+        )
+        for key in ref.groups:
+            assert r.groups[key] == ref.groups[key], (
+                f"seed {case_seed}: {face} group {key} bytes diverged"
+            )
+
+    # undamaged (group, column) units must equal the CLEAN corpus decode
+    # on the group's surviving rows — silence here is the bug class the
+    # whole harness exists for
+    oracle = clean_oracle
+    if oracle is None:
+        oracle = {}
+        for fi, p in enumerate(ref_paths):
+            with ParquetFileReader(p) as r:
+                for gi in range(len(r.row_groups)):
+                    oracle[(fi, gi)] = _canon_host_group(
+                        r.read_row_group(gi)
+                    )
+    # quarantine keys are (file, row_group, column, page, kind)
+    damaged_cols = {
+        (f, rg, col) for (f, rg, col, _pg, _kind) in ref.quarantine
+    }
+    for (fi, gi), group in ref.groups.items():
+        clean = oracle[(fi, gi)]
+        n_clean = len(next(iter(clean.values())))
+        keep = np.ones(n_clean, dtype=bool)
+        if any(
+            f == fi and rg == gi and kind == "row_mask"
+            for (f, rg, _c, _pg, kind) in ref.quarantine
+        ):
+            # re-derive the surviving rows from a fresh salvage decode's
+            # recorded spans (the spans are not part of the key set);
+            # same verify_crc as the faces — a CRC-only-detectable span
+            # must not enter the oracle mask when the faces kept it
+            keep = _surviving_rows(paths[fi], gi, n_clean,
+                                   verify_crc=verify_crc)
+        for col, cells in group.items():
+            if (fi, gi, col) in damaged_cols:
+                continue  # damaged columns' semantics are tier tests' job
+            want = tuple(
+                v for v, k in zip(clean[col], keep.tolist()) if k
+            )
+            assert cells == want, (
+                f"seed {case_seed}: UNDAMAGED column {col} of group "
+                f"({fi}, {gi}) diverged from the clean decode"
+            )
+
+    # the loader face: quarantined units == geometry-damaged groups,
+    # stream == surviving units' rows re-sliced
+    if loader_stream is not None:
+        rows, q_units, names = loader_stream
+        geo = set()
+        for (fi, rg, _col, _pg, kind) in ref.quarantine:
+            if kind in ("chunk", "row_mask"):
+                geo.add((fi, rg))
+        assert set(map(tuple, q_units)) == geo, (
+            f"seed {case_seed}: loader quarantined {q_units}, expected "
+            f"{sorted(geo)}"
+        )
+        want_rows = []
+        for (fi, gi) in sorted(ref.groups):
+            if (fi, gi) in geo:
+                continue
+            g = ref.groups[(fi, gi)]
+            n = len(next(iter(g.values()))) if g else 0
+            for i in range(n):
+                want_rows.append(tuple(g[name][i] for name in names))
+        assert rows == want_rows, (
+            f"seed {case_seed}: loader stream diverged from surviving "
+            f"units ({len(rows)} vs {len(want_rows)} rows)"
+        )
+
+    return CaseOutcome(
+        case_seed, None, ref.quarantine, len(ref.groups)
+    )
+
+
+def _surviving_rows(path, gi, n_clean, verify_crc: bool = True
+                    ) -> np.ndarray:
+    """Boolean keep-mask of group ``gi``'s rows after the row-mask
+    tier, re-derived from a fresh salvage decode's recorded spans
+    (same ``verify_crc`` the faces decoded under)."""
+    keep = np.ones(n_clean, dtype=bool)
+    with ParquetFileReader(
+        path, options=ReaderOptions(salvage=True, verify_crc=verify_crc)
+    ) as r:
+        r.read_row_group(gi)
+        for s in r.salvage_report.skips:
+            if s.row_group == gi and s.kind == "row_mask" and s.row_span:
+                a, b = s.row_span
+                keep[max(0, int(a)):max(0, min(n_clean, int(b)))] = False
+    return keep

@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..errors import checked_alloc_size
 from ..format import codecs
 from ..format.encodings import rle_hybrid as e_rle
 from ..format.encodings.plain import ByteArrayColumn, decode_plain
@@ -187,7 +188,10 @@ class DeviceColumn:
 
         defs = np.asarray(self.def_levels).astype(np.uint32)
         reps = np.asarray(self.rep_levels).astype(np.uint32)
-        nn = int(np.count_nonzero(defs == self.descriptor.max_definition_level))
+        nn = checked_alloc_size(
+            np.count_nonzero(defs == self.descriptor.max_definition_level),
+            "dense value count", column=".".join(self.descriptor.path),
+        )
         if self.lengths is not None:
             rows = np.asarray(self.values)[:nn]
             lens = np.asarray(self.lengths)[:nn].astype(np.int64)
@@ -417,7 +421,12 @@ class _I32Builder:
         return off
 
     def build(self, pad_to: int) -> np.ndarray:
-        out = np.zeros(max(pad_to, self.n, 1), dtype=np.int32)
+        # slab entries come from parsed page geometry (offsets, counts):
+        # the blessed cap keeps a corrupt field from sizing the plan slab
+        out = np.zeros(
+            checked_alloc_size(max(pad_to, self.n, 1), "int32 plan slab"),
+            dtype=np.int32,
+        )
         pos = 0
         for p in self.parts:
             out[pos : pos + p.size] = p
@@ -1189,6 +1198,9 @@ class _DevStage:
             for p, val_off, nn in zip(self.pages, val_offs, nns):
                 if not nn:
                     continue
+                # nn is the page header's value count — bless it before
+                # it sizes any array (loop targets are never FL-ALLOC safe)
+                nv = checked_alloc_size(nn, "string page value count")
                 if self.kind == "mixed_str" and p.enc in (
                     Encoding.RLE_DICTIONARY, Encoding.PLAIN_DICTIONARY,
                 ):
@@ -1196,7 +1208,7 @@ class _DevStage:
                     if page_bw > 32:
                         raise _ForceHost(self.name)
                     if page_bw == 0:
-                        idx = np.zeros(nn, np.int64)
+                        idx = np.zeros(nv, np.int64)
                     else:
                         idx, _ = e_rle.decode_rle_hybrid(
                             arena, nn, page_bw, pos=val_off + 1
@@ -1225,7 +1237,7 @@ class _DevStage:
                             f"DELTA_LENGTH_BYTE_ARRAY page of {self.name}: "
                             "length stream overruns the page"
                         )
-                    starts = np.zeros(nn, np.int64)
+                    starts = np.zeros(nv, np.int64)
                     np.cumsum(lengths[:-1], out=starts[1:])
                     starts += data_pos
                 else:
@@ -1319,7 +1331,10 @@ class _DevStage:
             )
             if plan is None:
                 raise _ForceHost(self.name)
-            m_pad = eng._hwm(("mb", self.name), len(plan["mb_bw"]), minimum=4)
+            m_pad = checked_alloc_size(
+                eng._hwm(("mb", self.name), len(plan["mb_bw"]), minimum=4),
+                "delta miniblock pad",
+            )
             k = len(plan["mb_bytebase"])
             bytebase = plan["mb_bytebase"] + val_off
             if bytebase.max(initial=0) >= 2**31:
@@ -1388,7 +1403,10 @@ class _DevStage:
             c_bytebase = np.concatenate(mb_bytebase) if mb_bytebase else np.zeros(0, np.int64)
             c_bw = np.concatenate(mb_bw) if mb_bw else np.zeros(0, np.int64)
             c_min = np.concatenate(mb_min) if mb_min else np.zeros(0, np.int64)
-            m_pad = eng._hwm(("mb", self.name), max(len(c_bw), 1), minimum=4)
+            m_pad = checked_alloc_size(
+                eng._hwm(("mb", self.name), max(len(c_bw), 1), minimum=4),
+                "delta miniblock pad",
+            )
             rows = 5 if wide else 4
             mb = np.zeros((rows, m_pad), dtype=np.int64)
             mb[0] = 2**31 - 1  # out-start sentinel for pad miniblocks
@@ -1406,24 +1424,29 @@ class _DevStage:
                 raise _ForceHost(self.name)
             spec["mb_off"] = slabb.add(mb)
             spec["m_pad"] = m_pad
-            p_pad = eng._hwm(("pages", self.name), len(self.pages), minimum=4)
+            # fresh name: `p_pad` is also bound from _page_table unpacks
+            # in this scope, which FL-ALLOC001's fixpoint cannot bless
+            dp_pad = checked_alloc_size(
+                eng._hwm(("pages", self.name), len(self.pages), minimum=4),
+                "delta page-table pad",
+            )
             firsts = np.asarray(pg_first, np.int64)
             if wide:
                 spec["kind"] = "deltaw"
-                pgt = np.zeros((4, p_pad), dtype=np.int64)
+                pgt = np.zeros((4, dp_pad), dtype=np.int64)
                 pgt[0, : len(pg_start)] = pg_start
                 pgt[1, : len(pg_first)] = firsts & 0xFFFFFFFF
                 pgt[2, : len(pg_first)] = firsts >> 32
                 pgt[3] = total_nn
                 pgt[3, : len(live_nns)] = np.cumsum(live_nns)
             else:
-                pgt = np.zeros((3, p_pad), dtype=np.int64)
+                pgt = np.zeros((3, dp_pad), dtype=np.int64)
                 pgt[0, : len(pg_start)] = pg_start
                 pgt[1, : len(pg_first)] = firsts
                 pgt[2] = total_nn
                 pgt[2, : len(live_nns)] = np.cumsum(live_nns)
             spec["pg_off"] = slabb.add(pgt)
-            spec["p_pad"] = p_pad
+            spec["p_pad"] = dp_pad
             spec["vdtype"] = _VDTYPE_NAME[pt]
         return spec
 
@@ -1591,12 +1614,18 @@ def _padded_rows(col: ByteArrayColumn, pad_len: Optional[int] = None,
     (the device-friendly string layout)."""
     lengths = col.lengths().astype(np.int32)
     n = len(col)
-    max_len = max(int(lengths.max()) if n else 1, 1)
+    # lengths derive from parsed offsets: a corrupt offset pair must not
+    # size a (rows, width) matrix — both dimensions flow through the cap
+    max_len = checked_alloc_size(
+        max(int(lengths.max()) if n else 1, 1), "padded string width"
+    )
     if pad_len is not None:
         if pad_len < max_len:
             raise ValueError("pad_len shorter than longest string")
-        max_len = pad_len
-    n_rows = n if pad_rows is None else pad_rows
+        max_len = checked_alloc_size(pad_len, "padded string width")
+    n_rows = checked_alloc_size(
+        n if pad_rows is None else pad_rows, "padded string rows"
+    )
     if n_rows < n:
         raise ValueError("pad_rows smaller than row count")
     out_rows = np.zeros((n_rows, max_len), np.uint8)
@@ -1734,8 +1763,9 @@ def _scan_plain_strings(region: np.ndarray, count: int):
         return _nb.plain_ba_scan(region, count)
     b = region.tobytes()
     end = len(b)
-    starts = np.zeros(count, np.int64)
-    lengths = np.zeros(count, np.int64)
+    cnt = checked_alloc_size(count, "PLAIN string count")
+    starts = np.zeros(cnt, np.int64)
+    lengths = np.zeros(cnt, np.int64)
     pos = 0
     for i in range(count):
         if pos + 4 > end:
@@ -1814,20 +1844,41 @@ class TpuRowGroupReader:
         owns_reader = not isinstance(source, ParquetFileReader)
         self.reader = source if not owns_reader else ParquetFileReader(source)
         opts = getattr(self.reader, "options", None)
-        if opts is not None and (opts.verify_crc or opts.salvage):
+        if opts is not None and opts.verify_crc and not opts.salvage:
             # the robustness contract lives at THIS boundary, not just the
-            # API wrapper above it: the fused device path has no CRC check
-            # and no quarantine, so silently accepting such a reader would
-            # skip the verification it was configured for
+            # API wrapper above it: the fused device path has no CRC
+            # check, so silently accepting such a reader would skip the
+            # verification it was configured for.  With salvage=True the
+            # group decode is DELEGATED to the host engine (below), which
+            # does run the CRC check — so the combination is honored.
             from ..errors import UnsupportedFeatureError
 
             if owns_reader:
                 self.reader.close()
             raise UnsupportedFeatureError(
-                "ReaderOptions.verify_crc/salvage are host-engine "
-                "features; the TPU engine cannot honor them — decode via "
-                "the host engine instead"
+                "ReaderOptions.verify_crc is a host-engine feature; the "
+                "TPU engine cannot honor it — decode via the host engine "
+                "instead"
             )
+        # salvage IS honored — by delegating each group's decode to the
+        # host salvage engine and shipping the surviving arrays (the
+        # quarantine decision must be byte-deterministic and identical
+        # across faces, which only one detector can guarantee); the
+        # fused device decode never runs on a salvage reader
+        self._salvage = bool(opts is not None and opts.salvage)
+        # per-group unit reports (salvage only): each salvage decode
+        # lands its own SalvageReport here, keyed by group index, for
+        # consumers that fold per-unit quarantines (the DataLoader's
+        # merge protocol); the reader's shared report still accumulates
+        # everything for close()-time quarantine-map recording
+        self._unit_salvage: Dict[int, object] = {}
+        self._unit_merged: set = set()
+        if self._salvage:
+            trace.decision("salvage.device_host_decode", {
+                "path": getattr(self.reader.source, "name", None),
+                "why": "salvage pins the quarantine decision to the host "
+                       "decoder; device groups ship host-salvaged arrays",
+            })
         self.device = device
         if float64_policy not in ("auto", "float64", "float32", "bits"):
             raise ValueError(f"bad float64_policy {float64_policy!r}")
@@ -2020,6 +2071,8 @@ class TpuRowGroupReader:
         separate device pass.  Oversized (multi-launch) groups apply it
         as one follow-up gather per column instead; repeated columns
         reject it."""
+        if self._salvage:
+            return self._read_row_group_salvage(index, columns, out_perm)
         rg = self.reader.row_groups[index]
         want = set(columns) if columns else None
         if self._group_byte_estimate(rg, want) > self._arena_cap:
@@ -2032,6 +2085,89 @@ class TpuRowGroupReader:
             return out
         sg = self._stage_row_group(index, columns)
         return self._launch(sg, out_perm=out_perm)
+
+    def _read_row_group_salvage(self, index: int, columns, out_perm=None
+                                ) -> Dict[str, DeviceColumn]:
+        """Salvage decode of one group on the DEVICE face.
+
+        The quarantine decision must be byte-deterministic and identical
+        to the host face's (the differential fuzz contract), which only
+        one detector can guarantee — so the unit decodes through the
+        host salvage engine (all four tiers: page-null, row-mask, chunk,
+        quarantine map; accounting lands in ``reader.salvage_report``)
+        and the SURVIVING arrays ship to device as ``DeviceColumn``s.
+        Chunk-quarantined columns are simply absent from the returned
+        dict, exactly as they are absent from the host
+        ``RowGroupBatch``.  This is a recovery path, not a fast path:
+        it pays host decode per unit by design."""
+        from ..errors import UnsupportedFeatureError
+        from ..format.file_read import SalvageReport
+
+        want = set(columns) if columns else None
+        unit_rep = SalvageReport()
+        with trace.span("stage", attrs={
+            "file": getattr(self.reader.source, "name", None),
+            "row_group": index,
+        }):
+            batch = self.reader.read_row_group(index, want, report=unit_rep)
+        # the shared report still sees everything (close() records it
+        # into the quarantine map); the per-unit copy is what consumers
+        # with a merge protocol take.  The merge is once-per-group:
+        # re-decoding a group is deterministic and must not double its
+        # losses on the shared books (the host reader's idempotency
+        # contract, kept at this boundary too).
+        if self.reader.salvage_report is not None and \
+                index not in self._unit_merged:
+            self.reader.salvage_report.merge_in(unit_rep)
+            self._unit_merged.add(index)
+        self._unit_salvage[index] = unit_rep
+        out: Dict[str, DeviceColumn] = {}
+        for cb in batch.columns:
+            desc = cb.descriptor
+            name = desc.path[0] if len(desc.path) == 1 else ".".join(desc.path)
+            if desc.max_repetition_level > 0:
+                raise UnsupportedFeatureError(
+                    "salvage on the device face supports flat columns "
+                    f"only; project the repeated column {name!r} away or "
+                    "use the host engine"
+                )
+            dense, mask = cb.dense()
+            mask_dev = (
+                jax.device_put(np.asarray(mask), self.device)
+                if mask is not None else None
+            )
+            if isinstance(dense, ByteArrayColumn):
+                rows, lens, _ = _padded_rows(dense)
+                out[name] = DeviceColumn(
+                    desc, jax.device_put(rows, self.device), mask_dev,
+                    jax.device_put(lens, self.device),
+                )
+                continue
+            v = np.asarray(dense)
+            if desc.physical_type == Type.DOUBLE:
+                if self._f64mode == "bits":
+                    v = v.view(np.int64)
+                elif self._f64mode == "f32":
+                    v = v.astype(np.float32)
+            out[name] = DeviceColumn(
+                desc, jax.device_put(v, self.device), mask_dev
+            )
+        if out_perm is not None and not unit_rep.geometry_damaged(index):
+            # a geometry-damaged group has fewer rows (or columns) than
+            # the footer promised: the caller's whole-rows permutation no
+            # longer indexes it.  Consumers with a perm (the DataLoader)
+            # quarantine such units wholesale — returning them unpermuted
+            # is safe, applying a stale perm would be an index error.
+            out = _permuted_columns(out, out_perm)
+        return out
+
+    def take_unit_report(self, index: int):
+        """Pop the per-unit :class:`SalvageReport` the last salvage
+        decode of group ``index`` produced (None in strict mode or when
+        the group has not decoded).  The pipeline decodes ahead on its
+        stage worker, but a group's report is stashed before the group
+        yields, so taking it right after consuming the group is safe."""
+        return self._unit_salvage.pop(index, None)
 
     def _launch_pipelined(self, stage_calls):
         """Run several (args, kwargs) ``_stage_row_group`` calls as a
@@ -2430,7 +2566,10 @@ class TpuRowGroupReader:
                 "ParquetFileReader"
             )
         tail = plk.ARENA_TAIL if self._pl_enabled else 8
-        cap = self._hwm(("arena",), arena_b.size + tail, minimum=1 << 16)
+        cap = checked_alloc_size(
+            self._hwm(("arena",), arena_b.size + tail, minimum=1 << 16),
+            "host staging arena",
+        )
         arena = np.zeros(cap, dtype=np.uint8)
         parts = None
         if chunked is None:
@@ -2747,8 +2886,12 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
                                 thread_name_prefix="pftpu-stage") as sp, \
                 ThreadPoolExecutor(max_workers=1,
                                    thread_name_prefix="pftpu-ship") as shp:
-            # entries: ("pipe", reader, close_after, perm, ship_future)
-            # or ("big", reader, group_index, close_after, perm)
+            # entries: ("pipe", reader, close_after, perm, ship_future),
+            # ("big", reader, group_index, close_after, perm), or
+            # ("salv", reader, close_after, future) — salvage readers
+            # host-decode each group on the stage worker (one-deep
+            # overlap preserved; there is nothing to ship separately,
+            # the salvage path device_puts its surviving arrays itself)
             q: deque = deque()
             blocked = False  # a big group is queued: stop submitting
 
@@ -2760,6 +2903,13 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
                 if item is None:
                     return False
                 r, gi, ca, perm = norm(item)
+                if getattr(r, "_salvage", False):
+                    f = sp.submit(
+                        tracer.run, r._read_row_group_salvage, gi,
+                        columns, perm,
+                    )
+                    q.append(("salv", r, ca, f))
+                    return True
                 big = (
                     r._group_byte_estimate(r.reader.row_groups[gi], want)
                     > r._arena_cap
@@ -2797,6 +2947,9 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
                     _, r, gi, ca, perm = entry
                     yield r.read_row_group(gi, columns, out_perm=perm)
                     blocked = False
+                elif entry[0] == "salv":
+                    _, r, ca, fut = entry
+                    yield fut.result()
                 else:
                     _, r, ca, perm, fut = entry
                     r2, sg, shipped = fut.result()

@@ -72,6 +72,8 @@ class names:
         "salvage.pages_skipped",
         "salvage.chunks_quarantined",
         "salvage.rows_quarantined",
+        "salvage.rows_dropped",
+        "salvage.map_skips",
         "trace.decisions_dropped",
         "trace.events_dropped",
         # the training input pipeline (data.DataLoader, docs/data.md)
@@ -81,6 +83,7 @@ class names:
         "data.rows_dropped",
         "data.epochs_completed",
         "data.units_scheduled",
+        "data.units_quarantined",
     })
     GAUGES = frozenset({
         "scan.inflight_bytes_max",
@@ -92,12 +95,18 @@ class names:
         "chunk_fallback",
         "io.retry",
         "io.retry_exhausted",
+        "io.retry_deadline_exceeded",
         "salvage.report",
         "salvage.skip_page",
         "salvage.quarantine_chunk",
+        "salvage.row_mask",
+        "salvage.dict_recovery",
+        "salvage.map_skip",
+        "salvage.device_host_decode",
         "scan.plan",
         "data.epoch_plan",
         "data.resume",
+        "data.unit_quarantined",
     })
     SPANS = frozenset({
         "read",

@@ -35,4 +35,15 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu PFTPU_TRACE=1 PFTPU_BENCH_ROWS=2000 \
   | tee "$bench_log"
 [ "${PIPESTATUS[0]}" -eq 0 ] || exit 1
 python scripts/check_bench_report.py "$bench_log" "$bench_trace" || exit 1
+
+# Salvage differential smoke: 60 seeded corruption cases through ALL
+# FOUR read faces (sequential host, host scan, device scan, loader),
+# asserting unanimous fatality, identical quarantine sets, identical
+# surviving bytes, and no silent divergence vs the clean decode
+# (docs/robustness.md).  Fixed seeds, SIGALRM per case — a hang fails
+# one case, not the gate's timeout.  The >=300-case sweep is the slow
+# marker in tests/test_salvage_differential.py.
+echo "== salvage differential smoke (60 cases, 4 faces) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python scripts/salvage_differential_smoke.py 60 30 || exit 1
 exit 0

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Seeded salvage-differential smoke — the check.sh gate for ISSUE 6's
+tentpole part (d) at commit-gate scale.
+
+Replays N seeded corruption cases (fixed seeds 0..N-1, so a failure
+reproduces by number) through ALL FOUR read faces — sequential host,
+host scan, device scan, DataLoader — and asserts the differential
+contract from ``parquet_floor_tpu.testing.differential``: unanimous
+fatality, identical quarantine sets, identical surviving bytes, and
+no silent divergence vs the clean decode (pyarrow oracle when
+installed).  Each case runs under its own SIGALRM time limit, so a
+hang is a per-case failure, not a stuck gate.
+
+The >=300-case acceptance sweep lives in
+``tests/test_salvage_differential.py`` (``-m slow``); this is the
+always-on subset.
+
+Usage: salvage_differential_smoke.py [n_cases] [per_case_timeout_s]
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parquet_floor_tpu.testing.differential import (  # noqa: E402
+    CaseTimeout,
+    _pyarrow_clean_groups,
+    differential_case,
+    write_reference_corpus,
+)
+
+FACES = ("sequential", "host_scan", "device_scan", "loader")
+
+
+def main(argv) -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # INT64/DOUBLE columns
+    n_cases = int(argv[1]) if len(argv) > 1 else 60
+    timeout_s = float(argv[2]) if len(argv) > 2 else 30.0
+    t0 = time.monotonic()
+    fatal = survived = 0
+    fails = []
+    with tempfile.TemporaryDirectory(prefix="pftpu_diff_") as d:
+        corpus = write_reference_corpus(f"{d}/ref")
+        oracle = _pyarrow_clean_groups(corpus)
+        print(
+            f"salvage differential smoke: {n_cases} cases, faces="
+            f"{','.join(FACES)}, per-case timeout {timeout_s:.0f}s, "
+            f"oracle={'pyarrow' if oracle else 'self'}",
+            flush=True,
+        )
+        for seed in range(n_cases):
+            try:
+                out = differential_case(
+                    corpus, seed, f"{d}/case{seed}", faces=FACES,
+                    clean_oracle=oracle, timeout_s=timeout_s,
+                )
+            except CaseTimeout:
+                fails.append((seed, "HANG"))
+                print(f"  case {seed}: HANG (> {timeout_s:.0f}s)",
+                      flush=True)
+                continue
+            except AssertionError as e:
+                fails.append((seed, str(e)))
+                print(f"  case {seed}: DIVERGED: {e}", flush=True)
+                continue
+            if out.fatal is not None:
+                fatal += 1
+            else:
+                survived += 1
+    wall = time.monotonic() - t0
+    print(
+        f"salvage differential smoke: {n_cases - len(fails)}/{n_cases} "
+        f"agree ({survived} salvaged, {fatal} unanimously fatal) "
+        f"in {wall:.1f}s",
+        flush=True,
+    )
+    if fails:
+        print(f"FAILED cases: {[s for s, _ in fails]}", flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

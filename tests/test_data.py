@@ -404,11 +404,15 @@ def test_constructor_validation(dataset):
         DataLoader(dataset, 8, shuffle_window=64)  # window without seed
     with pytest.raises(ValueError, match="at least one source"):
         DataLoader([], 8)
-    with pytest.raises(UnsupportedFeatureError, match="salvage"):
-        DataLoader(dataset, 8, reader_options=ReaderOptions(salvage=True))
     with pytest.raises(UnsupportedFeatureError, match="verify_crc"):
         DataLoader(dataset, 8, engine="tpu",
                    reader_options=ReaderOptions(verify_crc=True))
+    # salvage is HONORED on both faces now (tests/test_data salvage
+    # section), including verify_crc+salvage on the device face (the
+    # unit decode is delegated to the host engine, which runs the CRC)
+    DataLoader(dataset, 8, engine="tpu", reader_options=ReaderOptions(
+        verify_crc=True, salvage=True,
+    )).close()
     with pytest.raises(ValueError, match="selects nothing"):
         DataLoader(dataset, 8, columns=["nope"])
 
@@ -729,3 +733,242 @@ def test_windowed_engine_iterator_closes_on_abandonment(dataset):
     gen.close()  # abandon mid-stream
     assert opened  # the pipeline really opened ahead
     assert all(r.reader._closed for r in opened)
+
+
+# ---------------------------------------------------------------------------
+# salvage: unit quarantine, checkpoint semantics, resume bit-identity
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def damaged_dataset(dataset, tmp_path_factory):
+    """The scan fixture's 4-file dataset with file 1 / group 1's
+    REQUIRED ``k`` chunk framing-damaged: geometry-changing loss the
+    loader must quarantine at the unit level."""
+    from tests.test_scan import _break_required_chunk
+
+    d = tmp_path_factory.mktemp("data_salvage")
+    paths = list(dataset)
+    paths[1] = _break_required_chunk(dataset[1], d, 1, "k", "loader_q")
+    return paths
+
+
+_SALV = {"reader_options": ReaderOptions(salvage=True)}
+
+
+def _clean_minus_unit(dataset, damaged_paths, batch=256):
+    """The expected surviving stream: the clean dataset with file 1 /
+    group 1's rows removed — streamed through a salvage loader over a
+    dataset where that unit is ALREADY known-quarantined, which plans it
+    at zero rows from batch one."""
+    ld = DataLoader(dataset, batch, shuffle_seed=7, shuffle_window=512,
+                    num_epochs=2, drop_remainder=False, **_SALV)
+    try:
+        state = ld.state()
+        state["quarantined"] = [[1, 1]]
+        restored = DataLoader(
+            damaged_paths, batch, shuffle_seed=7, shuffle_window=512,
+            num_epochs=2, drop_remainder=False, **_SALV,
+        ).restore(state)
+        out = [_batch_bytes(b) for b in restored]
+        restored.close()
+    finally:
+        ld.close()
+    return out
+
+
+def test_loader_salvage_quarantines_geometry_damaged_unit(damaged_dataset):
+    """The host face drops the damaged unit WHOLE, keeps flowing,
+    records the quarantine (state + report + counters), and the
+    surviving multiset is exactly the clean data minus that unit."""
+    with trace.scope() as t:
+        ld = DataLoader(damaged_dataset, 256, shuffle_seed=7,
+                        shuffle_window=512, num_epochs=1,
+                        drop_remainder=False, **_SALV)
+        ks = []
+        for b in ld:
+            ks.append(np.asarray(b.column("k").values)[: b.num_valid])
+        assert ld.quarantined_units == [(1, 1)]
+        rep = ld.salvage_report
+        assert rep is not None and rep.chunks_quarantined == 1
+        assert [s.key() for s in rep.skips] == [(1, "k", None, "chunk")]
+        assert t.counters().get("data.units_quarantined") == 1
+        state = ld.state()
+        assert state["quarantined"] == [[1, 1]]
+        ld.close()
+
+    got = np.sort(np.concatenate(ks))
+    # clean reference: every unit except (1, 1)
+    want = []
+    from tests.test_scan import _seq_units
+
+    for fi, gi, g in _seq_units(
+        [p for i, p in enumerate(damaged_dataset) if i != 1]
+    ):
+        want.append(np.asarray(
+            [c for c in g.columns
+             if c.descriptor.path[0] == "k"][0].values
+        ))
+    with ParquetFileReader(damaged_dataset[1],
+                           options=ReaderOptions(salvage=True)) as r:
+        g0 = r.read_row_group(0)
+        want.append(np.asarray(
+            [c for c in g0.columns
+             if c.descriptor.path[0] == "k"][0].values
+        ))
+    assert np.array_equal(got, np.sort(np.concatenate(want)))
+
+
+def test_loader_salvage_page_null_damage_flows_through(dataset,
+                                                       tmp_path_factory):
+    """Page-null damage (flat OPTIONAL column) keeps geometry: no unit
+    quarantined, identical row count, the damaged span arrives as
+    masked nulls — only the mask differs from the clean stream."""
+    from tests.test_salvage import _flip_in_page
+
+    d = tmp_path_factory.mktemp("data_pnull")
+    paths = list(dataset)
+    paths[2], _ = _flip_in_page(dataset[2], d, 0, "d", 1, "loader_pn")
+
+    kw = {"reader_options": ReaderOptions(salvage=True, verify_crc=True)}
+    ld = DataLoader(paths, 256, num_epochs=1, drop_remainder=False, **kw)
+    n_rows = 0
+    for b in ld:
+        n_rows += b.num_valid
+    assert ld.quarantined_units == []
+    rep = ld.salvage_report
+    assert rep.pages_skipped == 1 and rep.chunks_quarantined == 0
+    assert [s.kind for s in rep.skips] == ["page_null"]
+    assert n_rows == ld.rows_per_epoch == 4 * 3000
+    ld.close()
+
+
+def _first_quarantine_batch(paths, batch=256):
+    """The 1-indexed batch count after which the damaged unit first
+    shows up in checkpoint state (deterministic for a fixed seed)."""
+    ld = DataLoader(paths, batch, shuffle_seed=7, shuffle_window=512,
+                    num_epochs=2, **_SALV)
+    it = iter(ld)
+    k = 0
+    try:
+        while not ld.state()["quarantined"]:
+            next(it)
+            k += 1
+    finally:
+        ld.close()
+    return k
+
+
+@pytest.mark.parametrize("side", ["before", "after"])
+def test_host_resume_bit_identical_under_quarantine(damaged_dataset, side):
+    """The satellite's acceptance case: a quarantined unit BEFORE the
+    resume point (the restored loader must replay the shrunken plan,
+    not re-discover) and AFTER it (the restored loader must re-discover
+    at the same position) — both resume bit-identically."""
+    full = _stream(damaged_dataset, loader_kw=_SALV)
+    k = _first_quarantine_batch(damaged_dataset)
+    at = k + 2 if side == "before" else max(1, k - 1)
+    assert _stream(damaged_dataset, restore_at=at,
+                   loader_kw=_SALV) == full[at:]
+
+
+def test_host_resume_under_quarantine_across_epoch_boundary(damaged_dataset):
+    full = _stream(damaged_dataset, loader_kw=_SALV)
+    per_epoch = len(full) // 2
+    at = per_epoch + 2
+    assert _stream(damaged_dataset, restore_at=at,
+                   loader_kw=_SALV) == full[at:]
+
+
+def test_quarantine_shrinks_the_stream_to_the_surviving_rows(
+    damaged_dataset, dataset
+):
+    """After the quarantine is discovered, every later epoch plans the
+    unit at zero rows: epoch 1 of the damaged run equals epoch 1 of a
+    run that KNEW the quarantine from batch one (same plan keying)."""
+    full = _stream(damaged_dataset, loader_kw=_SALV)
+    known = _clean_minus_unit(dataset, damaged_dataset)
+    # identical from batch one: skipping the unit at delivery (full) and
+    # planning it at zero rows (known) produce the same stream, because
+    # unit order and per-position window perms are independent of the
+    # quarantined unit's row count
+    assert full == known
+
+
+def test_device_loader_salvage_matches_host(damaged_dataset):
+    """The device face quarantines the same unit and emits the same
+    surviving bytes as the host face (mirrors
+    test_device_stream_matches_host_values)."""
+    kw = {**_SALV, "float64_policy": "float64"}
+    host = _stream(damaged_dataset, engine="host", num_epochs=1,
+                   loader_kw=kw)
+    dev = _stream(damaged_dataset, engine="tpu", num_epochs=1,
+                  loader_kw=kw)
+    assert dev == host
+
+
+def test_device_resume_bit_identical_under_quarantine(damaged_dataset):
+    kw = {**_SALV, "float64_policy": "float64"}
+    full = _stream(damaged_dataset, engine="tpu", loader_kw=kw)
+    k = _first_quarantine_batch(damaged_dataset)
+    at = k + 2
+    assert _stream(damaged_dataset, engine="tpu", restore_at=at,
+                   loader_kw=kw) == full[at:]
+
+
+def test_restore_rejects_quarantine_state_without_salvage(damaged_dataset):
+    ld = DataLoader(damaged_dataset, 256, num_epochs=1, **_SALV)
+    for _ in zip(range(100), ld):
+        pass
+    state = ld.state()
+    ld.close()
+    assert state["quarantined"] == [[1, 1]]
+    with DataLoader(damaged_dataset, 256, num_epochs=1) as strict:
+        with pytest.raises(ValueError, match="salvage"):
+            strict.restore(state)
+    state["quarantined"] = [[9, 9]]
+    with DataLoader(damaged_dataset, 256, num_epochs=1, **_SALV) as other:
+        with pytest.raises(ValueError, match="unknown units"):
+            other.restore(state)
+
+
+def test_salvage_report_merge_is_associative_across_threads(damaged_dataset):
+    """The merge protocol's load-bearing property: per-unit reports
+    produced by CONCURRENT worker decodes fold to the same dataset
+    report no matter how sub-merges group — ((a·b)·c) == (a·(b·c)) ==
+    merge([a, b, c]) — so worker-local pre-folds compose."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from parquet_floor_tpu.format.file_read import SalvageReport
+
+    def unit_reports():
+        with DatasetScanner(
+            damaged_dataset, options=ReaderOptions(salvage=True)
+        ) as sc:
+            return [u.salvage for u in sc]
+
+    with ThreadPoolExecutor(max_workers=3) as pool:
+        reports = list(pool.map(
+            lambda _: unit_reports(), range(3)
+        ))
+
+    for reps in reports:
+        assert any(r.skips for r in reps)
+        flat = SalvageReport.merge(reps)
+        left = SalvageReport.merge(
+            [SalvageReport.merge(reps[:4]), SalvageReport.merge(reps[4:])]
+        )
+        right = SalvageReport.merge(
+            [reps[0], SalvageReport.merge(reps[1:])]
+        )
+        for other in (left, right):
+            assert other.as_dict() == flat.as_dict()
+            assert [s.key() for s in other.skips] == \
+                [s.key() for s in flat.skips]
+    # concurrency never perturbs the fold: every thread's dataset
+    # report is identical
+    assert all(
+        SalvageReport.merge(r).as_dict() ==
+        SalvageReport.merge(reports[0]).as_dict()
+        for r in reports
+    )

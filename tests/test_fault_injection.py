@@ -228,3 +228,84 @@ def test_retried_reads_surface_as_trace_decisions(small_file):
     finally:
         trace.disable()
         trace.reset()
+
+
+def test_retry_deadline_stops_the_ladder(small_file):
+    """ISSUE 6 satellite: ``deadline_s`` bounds one read's TOTAL wall
+    time — the ladder stops when the next sleep would cross it, raising
+    IoRetryExhaustedError well before the attempt budget runs out, and
+    records the ``io.retry_deadline_exceeded`` decision."""
+    from parquet_floor_tpu.utils import trace
+
+    t = [0.0]
+    sleeps = []
+
+    def clock():
+        return t[0]
+
+    def sleep(s):
+        sleeps.append(s)
+        t[0] += s
+
+    src = FaultInjectingSource(small_file, transient_error_rate=1.0,
+                               seed=5)  # never heals
+    # backoff 1, 2, 4, 8, ... with jitter off: the 1+2 sleeps fit a 5s
+    # deadline, the third (4s, landing at t=7) would cross it
+    retry = RetryingSource(src, retries=50, backoff_s=1.0, jitter=0.0,
+                           sleep=sleep, deadline_s=5.0, clock=clock)
+    trace.reset()
+    trace.enable()
+    try:
+        with pytest.raises(IoRetryExhaustedError, match="deadline"):
+            retry.read_at(0, 4)
+        hit = [d for d in trace.decisions()
+               if d["decision"] == "io.retry_deadline_exceeded"]
+        assert len(hit) == 1
+        assert hit[0]["attempts"] == 3 and hit[0]["deadline_s"] == 5.0
+    finally:
+        trace.disable()
+        trace.reset()
+        retry.close()
+    assert sleeps == [1.0, 2.0]  # the 4s sleep never ran
+    assert t[0] == 3.0  # gave up INSIDE the budget, not after it
+
+
+def test_retry_deadline_generous_budget_never_interferes(small_file):
+    """A deadline the ladder fits inside changes nothing: transient
+    faults heal exactly as without one."""
+    src = FaultInjectingSource(small_file, transient_error_rate=1.0,
+                               seed=3, max_transient_failures=3)
+    retry = RetryingSource(src, retries=5, backoff_s=0.0,
+                           sleep=lambda s: None, deadline_s=3600.0)
+    try:
+        assert bytes(retry.read_at(0, 4)) == b"PAR1"
+    finally:
+        retry.close()
+
+
+def test_retry_deadline_rejects_bad_values(small_file):
+    src = FileSource(small_file)
+    try:
+        for bad in (0.0, -1.0):
+            with pytest.raises(ValueError, match="deadline_s"):
+                RetryingSource(src, retries=1, deadline_s=bad)
+            with pytest.raises(ValueError, match="io_retry_deadline_s"):
+                ReaderOptions(io_retries=1, io_retry_deadline_s=bad)
+    finally:
+        src.close()
+
+
+def test_reader_options_thread_the_deadline(small_file):
+    """``ReaderOptions.io_retry_deadline_s`` reaches the RetryingSource
+    on both the sequential open and the scan executor's source chain."""
+    from parquet_floor_tpu.scan.executor import _source_chain
+
+    opts = ReaderOptions(io_retries=2, io_retry_deadline_s=7.5)
+    with ParquetFileReader(small_file, options=opts) as r:
+        assert isinstance(r.source, RetryingSource)
+        assert r.source._deadline_s == 7.5
+    chain = _source_chain(small_file, opts)
+    try:
+        assert chain._inner._deadline_s == 7.5
+    finally:
+        chain.close()

@@ -1,0 +1,290 @@
+"""Persistent quarantine map (ISSUE 6 tentpole part c): a JSON sidecar
+keyed by file fingerprint remembers each file's quarantined units, so a
+re-scan of a known-corrupt corpus replays the identical losses without
+re-tripping the decode errors.  The replay contract: the map never
+changes WHAT is lost — only how cheaply the loss is re-established."""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import ReaderOptions, trace
+from parquet_floor_tpu.format.file_read import SalvageReport, SalvageSkip
+from parquet_floor_tpu.io.source import FileSource
+from parquet_floor_tpu.quarantine import QuarantineMap, fingerprint
+from parquet_floor_tpu.scan import DatasetScanner
+
+from tests.test_salvage import (  # noqa: F401  (fixture re-export)
+    N_GROUPS,
+    PAGE_VALUES,
+    ROWS_PER_GROUP,
+    _break_page_header,
+    _decode_all,
+    _flip_in_page,
+    salvage_file,
+)
+
+
+def _skip_keys(report):
+    return [s.key() for s in report.skips]
+
+
+# ---------------------------------------------------------------------------
+# fingerprint + sidecar mechanics
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_stable_and_tail_sensitive(salvage_file, tmp_path):
+    """Same bytes → same key (twice, through fresh sources); a tail
+    byte change (a rewritten footer) re-fingerprints; same content at a
+    DIFFERENT path fingerprints the same — the key is the bytes, not
+    the name."""
+    with FileSource(salvage_file) as s:
+        fp1 = fingerprint(s)
+    with FileSource(salvage_file) as s:
+        assert fingerprint(s) == fp1
+
+    data = bytearray(pathlib.Path(salvage_file).read_bytes())
+    copy = tmp_path / "copy.parquet"
+    copy.write_bytes(bytes(data))
+    with FileSource(str(copy)) as s:
+        assert fingerprint(s) == fp1  # content-addressed, not path-keyed
+
+    data[-1] ^= 0x01
+    moved = tmp_path / "rewritten.parquet"
+    moved.write_bytes(bytes(data))
+    with FileSource(str(moved)) as s:
+        assert fingerprint(s) != fp1
+
+
+def test_options_reject_map_without_salvage():
+    """Strict mode never quarantines; an ignored map would be a silent
+    misconfiguration, so it fails at options construction."""
+    with pytest.raises(ValueError, match="salvage"):
+        ReaderOptions(quarantine_map=QuarantineMap())
+
+
+def test_record_dedups_and_save_round_trips(tmp_path):
+    rep = SalvageReport(skips=[
+        SalvageSkip(column="d", row_group=0, page=None, rows=500,
+                    error="boom", kind="chunk"),
+        SalvageSkip(column="s", row_group=1, page=2, rows=400,
+                    error="crc", kind="page_null"),
+    ])
+    p = tmp_path / "q.json"
+    m = QuarantineMap(p)
+    assert m.record("123:deadbeef", rep, path="a.parquet") == 2
+    # re-recording the same losses is a no-op: repeated scans keep the
+    # sidecar stable
+    assert m.record("123:deadbeef", rep) == 0
+    m.save()
+
+    m2 = QuarantineMap.open(p)
+    assert len(m2) == 1
+    assert m2.entries("123:deadbeef") == m.entries("123:deadbeef")
+    kb = m2.known_bad("123:deadbeef")
+    assert kb[(0, "d")]["chunk"]["rows"] == 500
+    assert kb[(1, "s")]["pages"][2]["kind"] == "page_null"
+    assert m2.entries("unknown") == [] and m2.known_bad("unknown") == {}
+
+
+def test_open_missing_empty_corrupt_and_versioned(tmp_path):
+    """A missing sidecar starts empty (bound to its path for save); a
+    sidecar that does not parse — or has a version this code does not
+    speak — raises: a corrupt MAP must never silently discard the
+    quarantine history it was supposed to carry."""
+    fresh = QuarantineMap.open(tmp_path / "new.json")
+    assert len(fresh) == 0
+    fresh.save()
+    assert json.loads((tmp_path / "new.json").read_text())["version"] == 1
+
+    bad = tmp_path / "garbage.json"
+    bad.write_text("{not json")
+    with pytest.raises(ValueError, match="does not parse"):
+        QuarantineMap.open(bad)
+
+    versioned = tmp_path / "future.json"
+    versioned.write_text(json.dumps({"version": 99, "files": {}}))
+    with pytest.raises(ValueError, match="version"):
+        QuarantineMap.open(versioned)
+
+
+# ---------------------------------------------------------------------------
+# replay: re-scans skip known-bad units without re-tripping decode errors
+# ---------------------------------------------------------------------------
+
+def test_chunk_quarantine_replays_from_map(salvage_file, tmp_path):
+    """Scan 1 trips the decode error and records the chunk quarantine;
+    scan 2 (same sidecar, reloaded) short-circuits: identical surviving
+    groups, identical report, but the quarantine arrives via
+    ``salvage.map_skip`` with the chunk's bytes never decoded."""
+    bad = _break_page_header(salvage_file, tmp_path, 0, "d", "map_chunk")
+    sidecar = tmp_path / "corpus.quarantine.json"
+
+    qmap = QuarantineMap.open(sidecar)
+    groups1, rep1 = _decode_all(bad, salvage=True, quarantine_map=qmap)
+    assert _skip_keys(rep1) == [(0, "d", None, "chunk")]
+    qmap.save()
+
+    qmap2 = QuarantineMap.open(sidecar)
+    trace.enable()
+    try:
+        trace.reset()
+        groups2, rep2 = _decode_all(bad, salvage=True, quarantine_map=qmap2)
+        kinds = [d["decision"] for d in trace.decisions()]
+        assert "salvage.map_skip" in kinds
+        # the decode error is NOT re-tripped: no fresh quarantine
+        # decision, only the replay
+        assert "salvage.quarantine_chunk" not in kinds
+        assert trace.counters().get("salvage.map_skips") == 1
+    finally:
+        trace.disable()
+        trace.reset()
+
+    # the map never changes WHAT is lost: reports and surviving bytes
+    # are identical either way
+    assert _skip_keys(rep2) == _skip_keys(rep1)
+    assert rep2.summary() == rep1.summary()
+    assert [g.num_rows for g in groups2] == [g.num_rows for g in groups1]
+    for g1, g2 in zip(groups1, groups2):
+        assert [c.descriptor.path for c in g1.columns] == \
+            [c.descriptor.path for c in g2.columns]
+        for c1, c2 in zip(g1.columns, g2.columns):
+            assert np.array_equal(
+                np.asarray(c1.values), np.asarray(c2.values)
+            )
+
+
+def test_row_mask_replays_byte_identical(salvage_file, tmp_path):
+    """The page-tier replay: a row-masked REQUIRED page substitutes its
+    recorded outcome on re-scan — the replayed skip records (error
+    string included) and the surviving rows are byte-identical to the
+    fresh scan's."""
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "map_rm")
+    sidecar = tmp_path / "rm.quarantine.json"
+
+    qmap = QuarantineMap.open(sidecar)
+    groups1, rep1 = _decode_all(
+        bad, verify_crc=True, salvage=True, quarantine_map=qmap
+    )
+    assert [s.kind for s in rep1.skips] == ["row_mask"]
+    qmap.save()
+
+    groups2, rep2 = _decode_all(
+        bad, verify_crc=True, salvage=True,
+        quarantine_map=QuarantineMap.open(sidecar),
+    )
+    assert [s.as_dict() for s in rep2.skips] == \
+        [s.as_dict() for s in rep1.skips]
+    assert [g.num_rows for g in groups1] == \
+        [ROWS_PER_GROUP - PAGE_VALUES, ROWS_PER_GROUP]
+    for g1, g2 in zip(groups1, groups2):
+        assert g1.num_rows == g2.num_rows
+        for c1, c2 in zip(g1.columns, g2.columns):
+            assert np.array_equal(
+                np.asarray(c1.values), np.asarray(c2.values)
+            )
+
+
+def _write_clean_companion(tmp_path, seed=17, rows=1800):
+    """A second clean file with DIFFERENT bytes (size included): the
+    tail fingerprint must not collide with the salvage fixture's."""
+    from parquet_floor_tpu import ParquetFileWriter, WriterOptions, types
+
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("a"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.DOUBLE).named("d"),
+    )
+    rng = np.random.default_rng(seed)
+    path = tmp_path / f"companion{seed}.parquet"
+    with ParquetFileWriter(path, schema,
+                           WriterOptions(data_page_values=600)) as w:
+        w.write_columns({
+            "a": rng.integers(0, 10_000, rows).astype(np.int64),
+            "s": [f"c{i % 57}" for i in range(rows)],
+            "d": rng.standard_normal(rows),
+        })
+    return str(path)
+
+
+def test_scan_face_records_and_replays(salvage_file, tmp_path):
+    """The concurrent host scan face shares one map across the dataset:
+    scan 1 records the damaged file's losses under its fingerprint
+    (clean files add no units); scan 2 replays them — identical fold,
+    identical delivery."""
+    bad = _break_page_header(salvage_file, tmp_path, 0, "d", "map_scan")
+    clean = _write_clean_companion(tmp_path)
+    paths = [clean, bad]
+    sidecar = tmp_path / "scan.quarantine.json"
+
+    qmap = QuarantineMap.open(sidecar)
+    with DatasetScanner(
+        paths, options=ReaderOptions(salvage=True, quarantine_map=qmap)
+    ) as sc:
+        units1 = list(sc)
+        fold1 = sc.salvage_report
+    qmap.save()
+    with FileSource(bad) as s:
+        bad_fp = fingerprint(s)
+    assert [u["kind"] for u in qmap.entries(bad_fp)] == ["chunk"]
+
+    with DatasetScanner(
+        paths,
+        options=ReaderOptions(
+            salvage=True, quarantine_map=QuarantineMap.open(sidecar)
+        ),
+    ) as sc:
+        units2 = list(sc)
+        fold2 = sc.salvage_report
+
+    assert _skip_keys(fold2) == _skip_keys(fold1) == [(0, "d", None, "chunk")]
+    assert [(u.file_index, u.group_index, u.batch.num_rows) for u in units1] \
+        == [(u.file_index, u.group_index, u.batch.num_rows) for u in units2]
+
+
+def test_rewritten_file_misses_the_map(salvage_file, tmp_path):
+    """A file repaired the normal way — rewritten through a writer, so
+    its footer bytes move — re-fingerprints: the old quarantine entries
+    do not apply and the clean decode sees no losses."""
+    bad = _break_page_header(salvage_file, tmp_path, 0, "d", "map_rewrite")
+    sidecar = tmp_path / "rewrite.quarantine.json"
+    qmap = QuarantineMap.open(sidecar)
+    _decode_all(bad, salvage=True, quarantine_map=qmap)
+    qmap.save()
+    assert len(qmap) == 1
+
+    # the compactor repair story: a fresh file replaces the corrupt one
+    repaired = _write_clean_companion(tmp_path, seed=5)
+    pathlib.Path(bad).write_bytes(pathlib.Path(repaired).read_bytes())
+    groups, rep = _decode_all(
+        bad, salvage=True, quarantine_map=QuarantineMap.open(sidecar)
+    )
+    assert rep.skips == []
+    assert sum(g.num_rows for g in groups) == 1800
+
+
+def test_in_place_repair_caveat_is_reported_not_silent(salvage_file,
+                                                       tmp_path):
+    """The fingerprint's documented blind spot (quarantine.py): an
+    in-place restore that preserves size and tail keeps the old
+    fingerprint, so the stale quarantine REPLAYS — but it lands in the
+    report and the ``salvage.map_skip`` decision stream, never as
+    silent loss.  If this test starts failing because the fingerprint
+    got byte-exact, delete it (and the docstring caveat) with joy."""
+    bad = _break_page_header(salvage_file, tmp_path, 0, "d", "map_inplace")
+    sidecar = tmp_path / "inplace.quarantine.json"
+    qmap = QuarantineMap.open(sidecar)
+    _decode_all(bad, salvage=True, quarantine_map=qmap)
+    qmap.save()
+
+    # restore the pristine mid-file bytes: size and tail unchanged
+    pathlib.Path(bad).write_bytes(pathlib.Path(salvage_file).read_bytes())
+    groups, rep = _decode_all(
+        bad, salvage=True, quarantine_map=QuarantineMap.open(sidecar)
+    )
+    assert _skip_keys(rep) == [(0, "d", None, "chunk")]  # replayed, visible
+    assert all(c.descriptor.path != ("d",)
+               for c in groups[0].columns)

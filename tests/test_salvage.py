@@ -84,6 +84,23 @@ def _flip_in_page(path, tmp_path, rg_idx, col, data_page_index, stem):
     return str(out), ordinal
 
 
+def _break_page_header(path, tmp_path, rg_idx, col, stem,
+                       page_index: int = 1):
+    """Overwrite the start of the chunk's N-th page HEADER with compact
+    garbage: framing damage no tier can localize — the whole chunk
+    quarantines (the row-mask tier needs a readable header to know the
+    page's row span)."""
+    with ParquetFileReader(path) as r:
+        spans = _page_spans(r, rg_idx, col)
+    off, size, _, _ = spans[page_index - 1]
+    header_start = off + size  # next page's header follows this payload
+    data = bytearray(pathlib.Path(path).read_bytes())
+    data[header_start] = 0xFF  # compact type 0x0F: unskippable garbage
+    out = tmp_path / f"{stem}.parquet"
+    out.write_bytes(bytes(data))
+    return str(out)
+
+
 def _decode_all(path, **options):
     opts = ReaderOptions(**options)
     with ParquetFileReader(path, options=opts) as r:
@@ -95,40 +112,70 @@ def _decode_all(path, **options):
         return groups, r.salvage_report
 
 
-def test_salvage_demo_required_column(salvage_file, tmp_path):
-    """The acceptance demo: one bit-flipped data page in column ``d``
-    (required — no null substitution possible) decodes all other columns
-    and all row groups in salvage mode, raises ChecksumMismatchError in
-    strict mode, and the report accounts for exactly the quarantined
-    rows."""
-    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "bad_d")
+def test_salvage_demo_required_column_row_mask(salvage_file, tmp_path):
+    """The row-mask tier demo: one bit-flipped data page in column ``d``
+    (required — no null substitution possible) drops exactly that page's
+    row span from EVERY column of the group (alignment preserved), keeps
+    the other 2000 rows AND the whole column, raises
+    ChecksumMismatchError in strict mode, and the report accounts for
+    exactly the dropped rows."""
+    bad, ordinal = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "bad_d")
 
     # strict mode (the default): fail loudly
     with pytest.raises(ChecksumMismatchError):
         _decode_all(bad, verify_crc=True)
 
-    # salvage: everything except (d, rg0) decodes
+    # salvage: group 0 survives minus the damaged page's 500-row span
     groups, rep = _decode_all(bad, verify_crc=True, salvage=True)
-    assert [g.num_rows for g in groups] == [ROWS_PER_GROUP] * N_GROUPS
-    assert sorted(c.descriptor.path[0] for c in groups[0].columns) == ["a", "s"]
-    assert sorted(c.descriptor.path[0] for c in groups[1].columns) == ["a", "d", "s"]
+    assert [g.num_rows for g in groups] == \
+        [ROWS_PER_GROUP - PAGE_VALUES, ROWS_PER_GROUP]
+    for g in groups:
+        assert sorted(c.descriptor.path[0] for c in g.columns) == \
+            ["a", "d", "s"]
 
-    # surviving data is byte-identical to the pristine decode
+    # surviving rows are byte-identical to the pristine decode with the
+    # same span removed — in EVERY column, so alignment is exact
     pristine, _ = _decode_all(salvage_file)
+    lo, hi = PAGE_VALUES, 2 * PAGE_VALUES  # data page 1 of the chunk
+    keep = np.r_[0:lo, hi:ROWS_PER_GROUP]
     assert np.array_equal(groups[0].column("a").values,
-                          pristine[0].column("a").values)
+                          pristine[0].column("a").values[keep])
+    assert np.array_equal(groups[0].column("d").values[:lo],
+                          pristine[0].column("d").values[:lo])
+    assert np.array_equal(groups[0].column("d").values[lo:],
+                          pristine[0].column("d").values[hi:])
+    assert np.array_equal(groups[0].column("s").def_levels,
+                          pristine[0].column("s").def_levels[keep])
     assert np.array_equal(groups[1].column("d").values,
                           pristine[1].column("d").values)
-    assert np.array_equal(groups[0].column("s").def_levels,
-                          pristine[0].column("s").def_levels)
 
-    # the report accounts for exactly the quarantined rows
-    assert rep.chunks_quarantined == 1
-    assert rep.rows_quarantined == ROWS_PER_GROUP
-    assert rep.pages_skipped == 0
-    assert [s.column for s in rep.skips] == ["d"]
-    assert rep.skips[0].row_group == 0 and rep.skips[0].page is None
+    # the report accounts for exactly the dropped rows
+    assert rep.chunks_quarantined == 0
+    assert rep.pages_skipped == 1
+    assert rep.rows_quarantined == PAGE_VALUES
+    assert rep.rows_dropped == PAGE_VALUES
+    s = rep.skips[0]
+    assert s.column == "d" and s.row_group == 0 and s.page == ordinal
+    assert s.kind == "row_mask" and tuple(s.row_span) == (lo, hi)
     assert "CRC mismatch" in rep.first_errors["d"]
+
+
+def test_salvage_required_framing_damage_quarantines_chunk(salvage_file,
+                                                           tmp_path):
+    """When the damage takes the page HEADER (no row span to localize),
+    the chunk tier still owns the loss: the whole ``d`` chunk of group 0
+    is quarantined, every other column keeps all its rows."""
+    bad = _break_page_header(salvage_file, tmp_path, 0, "d", "bad_d_hdr")
+
+    groups, rep = _decode_all(bad, salvage=True)
+    assert [g.num_rows for g in groups] == [ROWS_PER_GROUP] * N_GROUPS
+    assert sorted(c.descriptor.path[0] for c in groups[0].columns) == ["a", "s"]
+    assert sorted(c.descriptor.path[0] for c in groups[1].columns) == \
+        ["a", "d", "s"]
+    assert rep.chunks_quarantined == 1 and rep.rows_dropped == 0
+    assert rep.rows_quarantined == ROWS_PER_GROUP
+    s = rep.skips[0]
+    assert s.column == "d" and s.kind == "chunk" and s.page is None
 
 
 def test_salvage_nulls_optional_column_page(salvage_file, tmp_path):
@@ -167,15 +214,28 @@ def test_salvage_nulls_optional_column_page(salvage_file, tmp_path):
 
 
 def test_salvage_records_trace_decisions(salvage_file, tmp_path):
-    """Each quarantine lands as a structured trace.decision event."""
+    """Each quarantine lands as a structured trace.decision event —
+    row-mask drops under ``salvage.row_mask``, chunk losses under
+    ``salvage.quarantine_chunk``."""
     bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 0, "bad_trace")
     trace.enable()
     try:
         trace.reset()
         _decode_all(bad, verify_crc=True, salvage=True)
         kinds = [d["decision"] for d in trace.decisions()]
-        assert "salvage.quarantine_chunk" in kinds
+        assert "salvage.row_mask" in kinds
         assert "salvage.report" in kinds
+        evt = [d for d in trace.decisions()
+               if d["decision"] == "salvage.row_mask"][0]
+        assert evt["column"] == "d" and evt["row_group"] == 0
+
+        trace.reset()
+        hdr_bad = _break_page_header(
+            salvage_file, tmp_path, 0, "d", "bad_trace_hdr"
+        )
+        _decode_all(hdr_bad, salvage=True)
+        kinds = [d["decision"] for d in trace.decisions()]
+        assert "salvage.quarantine_chunk" in kinds
         chunk_evt = [d for d in trace.decisions()
                      if d["decision"] == "salvage.quarantine_chunk"][0]
         assert chunk_evt["column"] == "d" and chunk_evt["row_group"] == 0
@@ -216,8 +276,8 @@ def test_salvage_batch_face_marks_quarantined_column(salvage_file, tmp_path):
     silently read a shifted column), not a KeyError."""
     from parquet_floor_tpu import ParquetReader
 
-    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "bad_batch")
-    opts = ReaderOptions(verify_crc=True, salvage=True)
+    bad = _break_page_header(salvage_file, tmp_path, 0, "d", "bad_batch")
+    opts = ReaderOptions(salvage=True)
     groups = list(ParquetReader.stream_batches(bad, options=opts))
     names = [[c.descriptor.path[0] for c in cols] for cols in groups]
     assert names == [["a", "s", "d"], ["a", "s", "d"]]  # order intact
@@ -239,8 +299,8 @@ def test_salvage_row_api_serves_none_for_quarantined_column(salvage_file, tmp_pa
     from parquet_floor_tpu import ParquetReader
     from parquet_floor_tpu.api.hydrate import HydratorSupplier, dict_hydrator
 
-    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "bad_rows")
-    opts = ReaderOptions(verify_crc=True, salvage=True)
+    bad = _break_page_header(salvage_file, tmp_path, 0, "d", "bad_rows")
+    opts = ReaderOptions(salvage=True)
     rows = list(ParquetReader.stream_content(
         bad, HydratorSupplier.constantly(dict_hydrator()), options=opts))
     assert len(rows) == N_GROUPS * ROWS_PER_GROUP
@@ -252,6 +312,26 @@ def test_salvage_row_api_serves_none_for_quarantined_column(salvage_file, tmp_pa
         list(ParquetReader.stream_content(
             bad, HydratorSupplier.constantly(dict_hydrator()),
             options=ReaderOptions(verify_crc=True)))
+
+
+def test_salvage_row_mask_row_api_drops_span(salvage_file, tmp_path):
+    """The row API over a row-masked group: the damaged REQUIRED page's
+    span vanishes from the stream (every column advances together), the
+    rest of the stream is the pristine rows."""
+    from parquet_floor_tpu import ParquetReader
+    from parquet_floor_tpu.api.hydrate import HydratorSupplier, dict_hydrator
+
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "bad_rows_rm")
+    rows = list(ParquetReader.stream_content(
+        bad, HydratorSupplier.constantly(dict_hydrator()),
+        options=ReaderOptions(verify_crc=True, salvage=True)))
+    assert len(rows) == N_GROUPS * ROWS_PER_GROUP - PAGE_VALUES
+    good = list(ParquetReader.stream_content(
+        salvage_file, HydratorSupplier.constantly(dict_hydrator())))
+    expected = (
+        good[:PAGE_VALUES] + good[2 * PAGE_VALUES:]
+    )
+    assert rows == expected
 
 
 def test_salvage_null_cursor_needs_a_quarantine_record(salvage_file):
@@ -340,13 +420,17 @@ def test_salvage_report_is_idempotent_per_chunk(salvage_file, tmp_path):
     bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "bad_twice")
     opts = ReaderOptions(verify_crc=True, salvage=True)
     with ParquetFileReader(bad, options=opts) as r:
-        r.read_row_group(0)
+        g0 = r.read_row_group(0)
         first = r.salvage_report.summary()
-        r.read_row_group(0)  # deterministic re-decode of the same group
+        g0b = r.read_row_group(0)  # deterministic re-decode of the group
         assert r.salvage_report.summary() == first
-        assert r.salvage_report.chunks_quarantined == 1
-        assert r.salvage_report.rows_quarantined == ROWS_PER_GROUP
+        assert r.salvage_report.pages_skipped == 1
+        assert r.salvage_report.rows_quarantined == PAGE_VALUES
+        assert r.salvage_report.rows_dropped == PAGE_VALUES
         assert len(r.salvage_report.skips) == 1
+        # ...and the row-mask ACTION (unlike the accounting) applies on
+        # every decode: the re-read drops the same span again
+        assert g0.num_rows == g0b.num_rows == ROWS_PER_GROUP - PAGE_VALUES
         # unknown group index never dedupes (None keys would collide
         # across groups and hide real losses)
         assert r.salvage_report._first_count("a", None, "q")
@@ -359,10 +443,10 @@ def test_salvage_report_reachable_from_row_stream(salvage_file, tmp_path):
     from parquet_floor_tpu import ParquetReader
     from parquet_floor_tpu.api.hydrate import HydratorSupplier, dict_hydrator
 
-    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "bad_report")
+    bad = _break_page_header(salvage_file, tmp_path, 0, "d", "bad_report")
     it = ParquetReader.stream_content(
         bad, HydratorSupplier.constantly(dict_hydrator()),
-        options=ReaderOptions(verify_crc=True, salvage=True))
+        options=ReaderOptions(salvage=True))
     n = sum(1 for _ in it)  # exhausts and closes the stream
     assert n == N_GROUPS * ROWS_PER_GROUP
     rep = it.salvage_report
@@ -400,3 +484,132 @@ def test_strict_mode_is_default_and_identical(salvage_file):
             assert cs.descriptor.path == cv.descriptor.path
             if isinstance(cs.values, np.ndarray):
                 assert np.array_equal(cs.values, cv.values)
+
+
+# ---------------------------------------------------------------------------
+# dictionary recovery (ISSUE 6 tentpole part b): borrowed or demoted
+# ---------------------------------------------------------------------------
+
+
+def _write_dict_file(path, order2=None, write_crc=True):
+    """Two row groups of one OPTIONAL string column whose values cycle a
+    small set — dictionary pages on both chunks.  ``order2`` reorders
+    group 2's first-occurrence sequence (different dictionary bytes)."""
+    vals = [f"word{i}" for i in range(23)]
+    schema = types.message(
+        "t",
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.INT64).named("k"),
+    )
+    with ParquetFileWriter(
+        path, schema,
+        WriterOptions(data_page_values=PAGE_VALUES, write_crc=write_crc),
+    ) as w:
+        for order in (vals, order2 or vals):
+            w.write_columns({
+                "s": [order[i % len(order)] for i in range(ROWS_PER_GROUP)],
+                "k": np.arange(ROWS_PER_GROUP, dtype=np.int64),
+            })
+    return str(path)
+
+
+def _flip_dict_page(path, tmp_path, stem):
+    """Flip one payload bit of row group 0's dictionary page for ``s``."""
+    with ParquetFileReader(path) as r:
+        spans = _page_spans(r, 0, "s")
+    off, size, is_dict, _ = spans[0]
+    assert is_dict, "fixture must emit a dictionary page"
+    data = bytearray(pathlib.Path(path).read_bytes())
+    data[off + size // 2] ^= 0x04
+    out = tmp_path / f"{stem}.parquet"
+    out.write_bytes(bytes(data))
+    return str(out)
+
+
+def test_dictionary_recovered_from_sibling_group(tmp_path):
+    """The borrow: group 1's chunk holds the byte-identical dictionary
+    (payload CRC proves it), so group 0 decodes to the exact clean
+    values — zero rows lost, the recovery on record as a ``dict`` skip,
+    and pages_skipped stays 0 (a recovered dictionary is not a
+    substituted data page: report and trace counter tell one story)."""
+    clean = _write_dict_file(tmp_path / "dict_clean.parquet")
+    bad = _flip_dict_page(clean, tmp_path, "dict_bad")
+
+    with pytest.raises(ChecksumMismatchError):
+        _decode_all(bad, verify_crc=True)
+
+    want, _ = _decode_all(clean)
+    trace.enable()
+    try:
+        trace.reset()
+        got, rep = _decode_all(bad, verify_crc=True, salvage=True)
+        kinds = [d["decision"] for d in trace.decisions()]
+        assert "salvage.dict_recovery" in kinds
+        assert trace.counters().get("salvage.pages_skipped") is None
+    finally:
+        trace.disable()
+        trace.reset()
+
+    assert [s.kind for s in rep.skips] == ["dict"]
+    assert "re-derived from row group 1" in rep.skips[0].error
+    assert rep.pages_skipped == 0 and rep.rows_quarantined == 0
+    assert rep.rows_dropped == 0 and rep.chunks_quarantined == 0
+    assert [g.num_rows for g in got] == [ROWS_PER_GROUP] * 2
+    for gw, gg in zip(want, got):
+        sw = gw.column("s").values
+        sg = gg.column("s").values
+        assert np.array_equal(sw.offsets, sg.offsets)
+        assert np.array_equal(sw.data, sg.data)
+
+
+def test_dictionary_not_borrowed_across_different_order(tmp_path):
+    """The near-miss that MUST not borrow: group 1 holds the same value
+    set in a different first-occurrence order (same count, same size —
+    only the payload CRC tells them apart).  Decoding indices through
+    the wrong table would be silent wrong data, so the dictionary is
+    declared lost and the damage falls through to the page tiers."""
+    vals = [f"word{i}" for i in range(23)]
+    rotated = vals[7:] + vals[:7]
+    clean = _write_dict_file(tmp_path / "dict_rot.parquet", order2=rotated)
+    bad = _flip_dict_page(clean, tmp_path, "dict_rot_bad")
+
+    got, rep = _decode_all(bad, verify_crc=True, salvage=True)
+    dict_skips = [s for s in rep.skips if s.kind == "dict"]
+    assert len(dict_skips) == 1
+    assert "lost" in dict_skips[0].error
+    # every dict-encoded page of the OPTIONAL column nulls out
+    # (page_null tier) — the rows and the other column survive intact
+    assert rep.pages_skipped == ROWS_PER_GROUP // PAGE_VALUES
+    assert [g.num_rows for g in got] == [ROWS_PER_GROUP] * 2
+    g0 = got[0]
+    s0 = g0.column("s")
+    assert int(np.count_nonzero(
+        np.asarray(s0.def_levels) == 1
+    )) == 0  # all nulls
+    assert np.array_equal(
+        g0.column("k").values, np.arange(ROWS_PER_GROUP, dtype=np.int64)
+    )
+    # group 1 (its own dictionary undamaged) is untouched
+    assert not any(s.row_group == 1 for s in rep.skips)
+
+
+def test_dictionary_without_crc_is_never_borrowed(tmp_path):
+    """No recorded page CRC, no byte proof, no borrow — even when the
+    sibling's dictionary IS identical (it cannot be proven so).  The
+    damage is a corrupted entry length prefix: framing the decoder
+    catches without any CRC."""
+    clean = _write_dict_file(tmp_path / "dict_nocrc.parquet",
+                             write_crc=False)
+    with ParquetFileReader(clean) as r:
+        spans = _page_spans(r, 0, "s")
+    off, _, is_dict, _ = spans[0]
+    assert is_dict
+    data = bytearray(pathlib.Path(clean).read_bytes())
+    data[off + 2] ^= 0x10  # first entry's length += 0x100000: overruns
+    bad = tmp_path / "dict_nocrc_bad.parquet"
+    bad.write_bytes(bytes(data))
+
+    _, rep = _decode_all(str(bad), salvage=True)
+    dict_skips = [s for s in rep.skips if s.kind == "dict"]
+    assert len(dict_skips) == 1
+    assert "no page CRC" in dict_skips[0].error

@@ -1,0 +1,122 @@
+"""Differential corruption fuzz (ISSUE 6 tentpole part d): seeded bit
+flips replayed through all four read faces — sequential host, host scan,
+device scan, DataLoader — asserting identical quarantine sets, identical
+surviving bytes, fatality agreement, and no silent divergence vs the
+clean-corpus decode (pyarrow oracle when available).
+
+A small always-on subset runs in tier-1 (host faces every case, device
+face sampled); the >=300-case sweep is ``slow``.
+"""
+
+import pytest
+
+from parquet_floor_tpu import ReaderOptions
+from parquet_floor_tpu.testing.differential import (
+    CaseTimeout,
+    _pyarrow_clean_groups,
+    case_flips,
+    differential_case,
+    materialize_case,
+    run_sequential,
+    time_limit,
+    write_reference_corpus,
+)
+
+PER_CASE_TIMEOUT_S = 30.0
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    d = tmp_path_factory.mktemp("diff_corpus")
+    return write_reference_corpus(str(d))
+
+
+@pytest.fixture(scope="module")
+def oracle(corpus):
+    o = _pyarrow_clean_groups(corpus)
+    assert o is not None, "pyarrow oracle unavailable in this env"
+    return o
+
+
+def _sweep(corpus, oracle, tmp_path, seeds, device_every=None):
+    fails = []
+    for seed in seeds:
+        faces = ("sequential", "host_scan", "loader")
+        if device_every and seed % device_every == 0:
+            faces = ("sequential", "host_scan", "device_scan", "loader")
+        try:
+            differential_case(
+                corpus, seed, str(tmp_path), faces=faces,
+                clean_oracle=oracle, timeout_s=PER_CASE_TIMEOUT_S,
+            )
+        except CaseTimeout:
+            fails.append((seed, "HANG"))
+        except AssertionError as e:
+            fails.append((seed, str(e)[:200]))
+    assert not fails, "differential divergence:\n" + "\n".join(
+        f"  seed {s}: {m}" for s, m in fails
+    )
+
+
+def test_differential_tier1(corpus, oracle, tmp_path):
+    """Always-on subset: host faces on every case, the device face on
+    every 6th (jit compiles dominate its cost)."""
+    _sweep(corpus, oracle, tmp_path, range(24), device_every=6)
+
+
+@pytest.mark.slow
+def test_differential_full(corpus, oracle, tmp_path):
+    """The acceptance sweep: >=300 further seeded corruptions through
+    the host faces, the device face sampled."""
+    _sweep(corpus, oracle, tmp_path, range(24, 330), device_every=25)
+
+
+def test_case_flips_deterministic(corpus):
+    assert case_flips(corpus, 7) == case_flips(corpus, 7)
+    assert case_flips(corpus, 7) != case_flips(corpus, 8)
+
+
+def test_materialized_case_deterministic(corpus, tmp_path):
+    a, _ = materialize_case(corpus, 5, tmp_path / "a")
+    b, _ = materialize_case(corpus, 5, tmp_path / "b")
+    import pathlib
+
+    for pa, pb in zip(a, b):
+        assert pathlib.Path(pa).read_bytes() == pathlib.Path(pb).read_bytes()
+
+
+def test_clean_corpus_is_clean(corpus):
+    """Sanity: the uncorrupted corpus salvages to zero quarantines and
+    survives the time limit (the harness's own plumbing works)."""
+    with time_limit(PER_CASE_TIMEOUT_S):
+        res = run_sequential(
+            corpus, ReaderOptions(salvage=True, verify_crc=True)
+        )
+    assert res.fatal is None and res.quarantine == frozenset()
+    assert len(res.groups) == 9
+    total = sum(
+        len(next(iter(g.values()))) for g in res.groups.values()
+    )
+    assert total == 3 * 1200
+
+
+def test_fatal_cases_agree(corpus, tmp_path):
+    """A footer-destroying flip must be fatal on EVERY face — build one
+    explicitly instead of waiting for a lucky seed."""
+    import pathlib
+
+    data = bytearray(pathlib.Path(corpus[1]).read_bytes())
+    data[-2] ^= 0xFF  # the magic trailer: unreadable everywhere
+    bad = tmp_path / "fatal.parquet"
+    bad.write_bytes(bytes(data))
+    paths = [corpus[0], str(bad), corpus[2]]
+    from parquet_floor_tpu.testing.differential import (
+        run_host_scan,
+        run_loader,
+    )
+
+    opts = ReaderOptions(salvage=True, verify_crc=True)
+    with time_limit(PER_CASE_TIMEOUT_S):
+        assert run_sequential(paths, opts).fatal is not None
+        assert run_host_scan(paths, opts).fatal is not None
+        assert run_loader(paths, opts)[0].fatal is not None

@@ -305,12 +305,80 @@ def test_scan_mid_scan_retry_exhausted(dataset):
     ]
 
 
-def test_scan_rejects_salvage_like_tpu_engine(dataset):
-    with pytest.raises(UnsupportedFeatureError):
-        # nothing leaks: the rejection fires before the pool exists
-        DatasetScanner(dataset, options=ReaderOptions(salvage=True))  # floorlint: disable=FL-RES001
-    with pytest.raises(UnsupportedFeatureError):
-        list(scan_batches(dataset, options=ReaderOptions(salvage=True)))
+def _break_required_chunk(path, tmp_path, rg_idx=0, col="k", stem="bad"):
+    """Corrupt the SECOND page header of one column chunk: framing
+    damage the row-mask tier cannot localize — the chunk quarantines."""
+    import pathlib
+
+    from parquet_floor_tpu.format.parquet_thrift import PageHeader
+    from parquet_floor_tpu.format.thrift import CompactReader
+
+    with ParquetFileReader(path) as r:
+        rg = r.row_groups[rg_idx]
+        chunk = [
+            c for c in rg.columns if c.meta_data.path_in_schema[0] == col
+        ][0]
+        m = chunk.meta_data
+        start = m.data_page_offset
+        if m.dictionary_page_offset:
+            start = min(start, m.dictionary_page_offset)
+        raw = bytes(r.source.read_at(start, m.total_compressed_size))
+    cr = CompactReader(raw)
+    h = PageHeader.read(cr)
+    second = start + cr.pos + h.compressed_page_size
+    data = bytearray(pathlib.Path(path).read_bytes())
+    data[second] = 0xFF  # compact type 0x0F: unskippable garbage
+    out = tmp_path / f"{stem}.parquet"
+    out.write_bytes(bytes(data))
+    return str(out)
+
+
+def test_scan_salvage_merges_unit_reports(dataset, tmp_path):
+    """The host scan face honors salvage: the damaged unit delivers its
+    OWN per-unit report, the scanner folds them in delivery order, and
+    the fold equals the sequential per-file reports' merge — identical
+    skip keys, identical surviving bytes."""
+    from parquet_floor_tpu.format.file_read import SalvageReport
+
+    paths = list(dataset[:3])
+    paths[1] = _break_required_chunk(dataset[1], tmp_path, 1, "k", "scan_q")
+
+    # the sequential salvage face is the reference
+    seq_units, seq_reports = [], []
+    for p in paths:
+        with ParquetFileReader(
+            p, options=ReaderOptions(salvage=True)
+        ) as r:
+            for gi in range(len(r.row_groups)):
+                seq_units.append(r.read_row_group(gi))
+            seq_reports.append(r.salvage_report)
+    seq_fold = SalvageReport.merge(seq_reports)
+
+    with DatasetScanner(
+        paths, options=ReaderOptions(salvage=True)
+    ) as scanner:
+        units = list(scanner)
+        fold = scanner.salvage_report
+
+    assert len(units) == len(seq_units)
+    damaged = [u for u in units if u.file_index == 1 and u.group_index == 1]
+    assert len(damaged) == 1
+    assert damaged[0].salvage is not None
+    assert [s.key() for s in damaged[0].salvage.skips] == \
+        [(1, "k", None, "chunk")]
+    # every clean unit still carries its (empty) per-unit report
+    assert all(
+        u.salvage is not None and
+        (u is damaged[0] or not u.salvage.skips) for u in units
+    )
+    # dataset-level fold == sequential fold, key for key and counter
+    # for counter
+    assert [s.key() for s in fold.skips] == [s.key() for s in seq_fold.skips]
+    assert fold.summary()["chunks_quarantined"] == 1
+    assert fold.summary() == seq_fold.summary()
+    # surviving decoded bytes are bit-identical to the sequential loop
+    for got, want in zip(units, seq_units):
+        _assert_batches_equal(got.batch, want)
 
 
 def test_scan_verify_crc_passes_through(dataset):
@@ -469,28 +537,94 @@ def test_stream_batches_scan_matches_sequential(dataset):
                 assert np.array_equal(np.asarray(a.mask), np.asarray(b.mask))
 
 
-def test_stream_batches_scan_salvage_rejected(dataset):
-    with pytest.raises(UnsupportedFeatureError):
-        list(ParquetReader.stream_batches(
-            list(dataset), options=ReaderOptions(salvage=True),
-            scan_options=ScanOptions(),
+def test_stream_batches_scan_salvage_placeholder(dataset, tmp_path):
+    """The scan-scheduled batch face under salvage matches the
+    sequential batch face: the quarantined chunk stays IN POSITION as a
+    fail-loudly placeholder, every other column is bit-identical."""
+    paths = list(dataset[:2])
+    paths[1] = _break_required_chunk(dataset[1], tmp_path, 0, "k", "sb_q")
+
+    def stream(scan_options):
+        return list(ParquetReader.stream_batches(
+            list(paths), options=ReaderOptions(salvage=True),
+            scan_options=scan_options,
         ))
+
+    seq, scan = stream(None), stream(ScanOptions())
+    assert len(seq) == len(scan) == 4
+    for a, b in zip(seq, scan):
+        assert [c.descriptor.path for c in a] == \
+            [c.descriptor.path for c in b]
+        assert [c.quarantined for c in a] == [c.quarantined for c in b]
+        for ca, cb in zip(a, b):
+            if ca.quarantined:
+                continue
+            if isinstance(ca.values, ByteArrayColumn):
+                assert np.array_equal(ca.values.offsets, cb.values.offsets)
+                assert np.array_equal(ca.values.data, cb.values.data)
+            else:
+                assert np.array_equal(
+                    np.asarray(ca.values), np.asarray(cb.values)
+                )
+            assert (ca.mask is None) == (cb.mask is None)
+            if ca.mask is not None:
+                assert np.array_equal(
+                    np.asarray(ca.mask), np.asarray(cb.mask)
+                )
+    # file 1 group 0's k chunk is the one placeholder, in position 0
+    flags = [[c.quarantined for c in cols] for cols in scan]
+    assert flags == [
+        [False, False, False], [False, False, False],
+        [True, False, False], [False, False, False],
+    ]
 
 
 # --- device leg ------------------------------------------------------------
 
-def test_scan_device_groups_rejects_pinned_reader_options(dataset):
-    # salvage: rejected by the scheduler itself; verify_crc: rejected by
-    # TpuRowGroupReader (host-pinned feature) — either way the same
-    # UnsupportedFeatureError contract, and nothing leaks
-    with pytest.raises(UnsupportedFeatureError):
-        list(scan_device_groups(
-            dataset[:2], options=ReaderOptions(salvage=True)
-        ))
+def test_scan_device_groups_rejects_crc_without_salvage(dataset):
+    # verify_crc alone: rejected by TpuRowGroupReader (host-pinned
+    # feature) — the UnsupportedFeatureError contract, and nothing
+    # leaks.  (salvage=True is HONORED now — see the test below — and
+    # verify_crc+salvage rides the host salvage decode.)
     with pytest.raises(UnsupportedFeatureError):
         list(scan_device_groups(
             dataset[:2], options=ReaderOptions(verify_crc=True)
         ))
+    assert not [
+        t for t in threading.enumerate() if t.name.startswith("pftpu-scanio")
+    ]
+
+
+def test_scan_device_groups_salvage(dataset, tmp_path):
+    """The device scan face honors salvage: the quarantined chunk
+    arrives IN POSITION as a fail-loudly placeholder, surviving columns
+    are the same device arrays a clean scan ships, and ``on_salvage``
+    receives the dataset-level fold."""
+    from parquet_floor_tpu.batch.columns import BatchColumn
+
+    paths = list(dataset[:2])
+    paths[1] = _break_required_chunk(dataset[1], tmp_path, 0, "k", "dev_q")
+    reports = []
+    got = list(scan_device_groups(
+        paths, options=ReaderOptions(salvage=True),
+        on_salvage=reports.append,
+    ))
+    assert [(fi, gi) for fi, gi, _ in got] == \
+        [(0, 0), (0, 1), (1, 0), (1, 1)]
+    # the damaged unit: k is a placeholder IN POSITION, d/s are real
+    cols = got[2][2]
+    assert list(cols) == ["k", "d", "s"]
+    assert isinstance(cols["k"], BatchColumn) and cols["k"].quarantined
+    assert not isinstance(cols["d"], BatchColumn)
+    # surviving device arrays match the sequential device face's
+    clean = list(scan_device_groups(paths[:1]))
+    assert np.array_equal(
+        np.asarray(got[0][2]["k"].values), np.asarray(clean[0][2]["k"].values)
+    )
+    assert len(reports) == 1
+    fold = reports[0]
+    assert [s.key() for s in fold.skips] == [(0, "k", None, "chunk")]
+    assert fold.chunks_quarantined == 1
     assert not [
         t for t in threading.enumerate() if t.name.startswith("pftpu-scanio")
     ]

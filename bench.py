@@ -271,6 +271,181 @@ def scan_leg(n_rows: int, reps: int) -> dict:
     }
 
 
+def _remote_paths(n_rows: int, n_files: int = 4, groups: int = 8):
+    """The cold-storage leg's dataset: more, smaller row groups than the
+    scan leg's (32 units keep the overlap statistics stable at smoke
+    scale), 3 columns so the sequential baseline's per-chunk reads stay
+    affordable at a 20 ms RTT."""
+    import numpy as np
+
+    from parquet_floor_tpu import ParquetFileWriter, WriterOptions, types
+
+    per = max(n_rows // n_files, 320)
+    group = max(per // groups, 40)
+    per = group * groups
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.DOUBLE).named("d"),
+    )
+    paths = []
+    for i in range(n_files):
+        p = os.path.join("/tmp", f"pftpu_bench_remote_{per}_{i}.parquet")
+        if not os.path.exists(p):
+            rng = np.random.default_rng(100 + i)
+            with ParquetFileWriter(p, schema, WriterOptions(
+                row_group_rows=group, data_page_values=group,
+            )) as w:
+                for lo in range(0, per, group):
+                    w.write_columns({
+                        "k": np.arange(lo, lo + group, dtype=np.int64),
+                        "s": [None if j % 13 == 0 else f"s{j % 97}"
+                              for j in range(lo, lo + group)],
+                        "d": rng.standard_normal(group),
+                    })
+        paths.append(p)
+    return paths
+
+
+def _digest_batch(batch) -> tuple:
+    """Bit-level digest of one decoded host row group (values, string
+    pools, null masks) — the remote leg's bit-identical check input."""
+    import zlib
+
+    import numpy as np
+
+    out = []
+    for c in batch.columns:
+        v = c.values
+        if hasattr(v, "offsets"):  # ByteArrayColumn
+            out.append(zlib.crc32(np.ascontiguousarray(v.offsets).tobytes()))
+            out.append(zlib.crc32(np.ascontiguousarray(v.data).tobytes()))
+        else:
+            out.append(zlib.crc32(np.ascontiguousarray(v).tobytes()))
+        if c.def_levels is not None:
+            out.append(zlib.crc32(
+                np.ascontiguousarray(c.def_levels).tobytes()
+            ))
+    return (batch.num_rows, tuple(out))
+
+
+def remote_leg(n_rows: int) -> dict:
+    """Cold-storage truth bench (docs/remote.md): the scan scheduler
+    over a SIMULATED 20 ms-RTT object store, where the overlap win
+    ``docs/scan.md`` admits is invisible on a warm page cache finally
+    shows — and is asserted (``check_bench_report.py``): the scheduled
+    scan's ``overlap_fraction`` must clear 0.5 while the sequential
+    per-file loop stays under 0.1.  A second, fault-heavy pass (drops +
+    throttles + heavy-tail latency + an outage window, fixed seeds)
+    must complete BIT-IDENTICAL to the clean pass with hedge/retry/
+    breaker counters all exercised.
+
+    Per-unit consumer work is a fixed 2.2 ms sleep — a stand-in for a
+    training step sized well under one RTT, so the sequential loop's
+    overlap stays honest while the scheduled scan has real work to
+    overlap I/O against."""
+    import time as _time
+
+    from parquet_floor_tpu import ReaderOptions
+    from parquet_floor_tpu.format.file_read import ParquetFileReader
+    from parquet_floor_tpu.scan import DatasetScanner, ScanOptions
+    from parquet_floor_tpu.testing import RemoteProfile, SimulatedRemoteSource
+    from parquet_floor_tpu.utils import trace
+
+    paths = _remote_paths(n_rows)
+    RTT_S = 0.02
+    WORK_S = 0.0022
+    threads = 12
+    clean = RemoteProfile(base_latency_s=RTT_S, jitter_s=0.002)
+    # outage_s sized so the footer read's retry ladder (0.04 backoff,
+    # doubling) eats 3+ consecutive failures per source before its
+    # first success — the deterministic breaker-trip shape; the
+    # throttle bucket is smaller than one group burst, so back-pressure
+    # fires at scan start and retry_after-aware backoff recovers it
+    hostile = RemoteProfile(
+        base_latency_s=RTT_S, jitter_s=0.002,
+        tail_p=0.15, tail_latency_s=0.08,
+        fault_rate=0.05, outage_s=0.25,
+        throttle_rps=60, throttle_burst=2,
+    )
+
+    def factories(profile, **kw):
+        return [
+            (lambda p=p, i=i: SimulatedRemoteSource(
+                p, profile=profile, seed=1000 + i, fetch_threads=4, **kw
+            ))
+            for i, p in enumerate(paths)
+        ]
+
+    def scan_pass(profile, retries, **kw):
+        sc = ScanOptions(threads=threads, adaptive_prefetch=True)
+        opts = ReaderOptions(io_retries=retries, io_retry_backoff_s=0.04)
+        digests = []
+        with trace.scope() as t:
+            t0 = _time.perf_counter()
+            with DatasetScanner(
+                factories(profile, **kw), options=opts, scan=sc
+            ) as s:
+                for unit in s:
+                    digests.append(_digest_batch(unit.batch))
+                    _time.sleep(WORK_S)  # the modeled consumer step
+            wall = _time.perf_counter() - t0
+        report = t.scan_report(wall_seconds=wall,
+                               budget_bytes=sc.prefetch_bytes)
+        return digests, report, wall
+
+    def sequential_pass(profile):
+        opts = ReaderOptions(io_retries=4, io_retry_backoff_s=0.04)
+        digests = []
+        with trace.scope() as t:
+            t0 = _time.perf_counter()
+            for f in factories(profile):
+                t_open = _time.perf_counter()
+                reader = ParquetFileReader(f(), options=opts)
+                trace.add("scan.consumer_stall",
+                          _time.perf_counter() - t_open)
+                with reader as r:
+                    for gi in range(len(r.row_groups)):
+                        t_read = _time.perf_counter()
+                        batch = r.read_row_group(gi)
+                        # the sequential loop's stall: the consumer is
+                        # blocked for the whole read+decode
+                        trace.add("scan.consumer_stall",
+                                  _time.perf_counter() - t_read)
+                        digests.append(_digest_batch(batch))
+                        _time.sleep(WORK_S)
+            wall = _time.perf_counter() - t0
+        report = t.scan_report(wall_seconds=wall)
+        return digests, report, wall
+
+    clean_digests, clean_rep, clean_wall = scan_pass(clean, retries=4)
+    seq_digests, seq_rep, _seq_wall = sequential_pass(clean)
+    fault_digests, fault_rep, _fault_wall = scan_pass(
+        hostile, retries=6,
+        hedge_delay_s=0.06, breaker_threshold=3, breaker_cooldown_s=0.06,
+    )
+    rows = sum(d[0] for d in clean_digests)
+    fc = fault_rep.counters
+    return {
+        "remote_rtt_ms": RTT_S * 1e3,
+        "remote_files": len(paths),
+        "remote_units": len(clean_digests),
+        "remote_threads": threads,
+        "remote_scan_rows_per_sec": round(rows / clean_wall, 1),
+        "remote_overlap_fraction": clean_rep.overlap_fraction,
+        "remote_seq_overlap_fraction": seq_rep.overlap_fraction,
+        "remote_seq_bit_identical": bool(seq_digests == clean_digests),
+        "remote_fault_bit_identical": bool(fault_digests == clean_digests),
+        "remote_hedges": fc.get("io.remote.hedges", 0),
+        "remote_retries": fc.get("io.retries", 0),
+        "remote_breaker_trips": fc.get("io.remote.breaker_trips", 0),
+        "remote_throttles": fc.get("io.remote.throttles", 0),
+        "remote_scan_report": clean_rep.as_dict(),
+        "remote_fault_scan_report": fault_rep.as_dict(),
+    }
+
+
 def _bench_batch(paths) -> int:
     """The loader leg's batch size: the largest divisor (at or under
     4096) of the dataset's ACTUAL row-group size, read from the first
@@ -556,6 +731,10 @@ def main():
     # its own bit-exact D2H check last — so it sits after every other
     # timed leg and before the (already post-D2H) chunked leg
     scan_detail = scan_leg(n_rows, reps)
+    # cold-storage truth bench (docs/remote.md): host scan over the
+    # simulated 20 ms-RTT store — no device work, no D2H; real sleeps
+    # model the store, so it runs once, not per rep
+    remote_detail = remote_leg(n_rows)
     # the loader's multiset-exactness check fetches device arrays: after
     # every timed section (the first D2H degrades tunnelled links
     # process-wide), alongside the scan leg's own D2H check
@@ -602,6 +781,7 @@ def main():
             **batch,
             **chunked,
             **scan_detail,
+            **remote_detail,
             **loader_detail,
         },
     }

@@ -7,11 +7,15 @@ columnar decode hot path offloaded to TPU kernels.
 """
 
 from .errors import (
+    BreakerOpenError,
     ChecksumMismatchError,
     CorruptFooterError,
     CorruptPageError,
     IoRetryExhaustedError,
     ParquetError,
+    RemoteFatalError,
+    RemoteThrottledError,
+    RemoteTransientError,
     TruncatedFileError,
     UnsupportedFeatureError,
 )
@@ -52,6 +56,7 @@ from ._version import __version__  # noqa: F401  (re-export)
 
 __all__ = [
     "BatchColumn", "BatchHydrator", "BatchHydratorSupplier",
+    "BreakerOpenError",
     "ChecksumMismatchError", "ColumnData",
     "ColumnDescriptor", "CompressionCodec", "CorruptFooterError",
     "CorruptPageError", "Dehydrator",
@@ -61,7 +66,9 @@ __all__ = [
     "ParquetFileReader",
     "ParquetFileWriter", "ParquetMetadata", "ParquetReader", "ParquetWriter",
     "DataLoader", "LoaderBatch",
-    "Predicate", "PrimitiveType", "ReaderOptions", "SalvageReport",
+    "Predicate", "PrimitiveType", "ReaderOptions",
+    "RemoteFatalError", "RemoteThrottledError", "RemoteTransientError",
+    "SalvageReport",
     "SalvageSkip", "ScanOptions", "ScanReport", "DatasetScanner",
     "TpuRowGroupReader", "TruncatedFileError", "Type",
     "UnsupportedCodec", "UnsupportedFeatureError",

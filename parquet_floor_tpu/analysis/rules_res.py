@@ -12,6 +12,17 @@ not just an fd): ``ThreadPoolExecutor(...)`` and scan handles
 (``DatasetScanner(...)``).  Both are acquisitions here; ``shutdown()``
 counts as their release verb alongside ``close()``.
 
+The remote-storage layer (``io/remote.py``, docs/remote.md) added the
+SESSION/POOL shape: ``RemoteSource``, ``SimulatedRemoteSource``, and
+``ParallelRangeReader`` each own a fetch thread pool (and a transport
+connection), so an unreleased handle leaks threads AND a remote session.
+They follow the same contract: with-managed, ownership-transferred
+(e.g. into a reader or a scan chain), or closed-in-finally.  A zero-arg
+**factory lambda** returning one (the scan scheduler's lazy-open
+protocol: ``lambda: RemoteSource(...)``) is ownership transfer too —
+the lambda's body IS its return value, and the executor that calls the
+factory closes what it opened.
+
 **FL-RES001** fires unless the acquisition is one of:
 
 * a ``with`` item (directly or wrapped, e.g. ``closing(open(p))``);
@@ -51,7 +62,12 @@ RULES = [
      "closed/shut down on all exception paths"),
 ]
 
-_ACQUIRERS = {"FileSource", "FileSink", "ThreadPoolExecutor", "DatasetScanner"}
+_ACQUIRERS = {
+    "FileSource", "FileSink", "ThreadPoolExecutor", "DatasetScanner",
+    # remote sessions/pools (io/remote.py): each owns a fetch pool and
+    # a transport connection — same leak shape, same release contract
+    "RemoteSource", "SimulatedRemoteSource", "ParallelRangeReader",
+}
 
 # the verbs that count as releasing an acquisition (executors release
 # with shutdown(), everything else with close())
@@ -129,6 +145,11 @@ def _classify(ctx: FileContext, call: ast.Call):
         if isinstance(anc, ast.withitem):
             return None
         if isinstance(anc, (ast.Return, ast.Yield)):
+            return None
+        if isinstance(anc, ast.Lambda):
+            # a lambda's body IS its return value: factory lambdas
+            # (`lambda: RemoteSource(...)`) transfer ownership to
+            # whoever calls them — the scan scheduler's lazy-open shape
             return None
         if isinstance(anc, ast.Attribute) and anc.value is child:
             return ("result used via attribute chain without binding "

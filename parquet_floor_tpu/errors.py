@@ -16,7 +16,20 @@ thousand files names exactly which bytes are bad.  The hierarchy keeps
     ├── UnsupportedFeatureError   (also ValueError)   valid file, missing code
     │   └── format.codecs.UnsupportedCodec            codec not available
     ├── IoRetryExhaustedError     (also OSError)      transient faults persisted
+    ├── RemoteTransientError      (also OSError)      retryable remote fetch failure
+    │   ├── RemoteThrottledError                      store said slow down (carries retry_after_s)
+    │   └── BreakerOpenError                          circuit breaker failing fast
+    ├── RemoteFatalError          (NOT OSError)       non-retryable remote failure
     └── format.thrift.ThriftDecodeError (also ValueError)  bad compact thrift
+
+The remote classes are the connection-level classification contract of
+``io.remote`` (docs/remote.md): **transient** failures are ``OSError``\\ s so
+the existing ``RetryingSource`` retry/deadline machinery picks them up
+unchanged; **throttled** is transient plus a server-suggested
+``retry_after_s`` that throttle-aware backoff honors; **fatal** is
+deliberately NOT an ``OSError`` — a denied credential or a deleted bucket
+must never burn a retry schedule, and it is not corruption either, so it
+passes through :func:`classified_decode_errors` annotated, un-wrapped.
 
 Raise with whatever context is known at the raise site; ``annotate`` lets an
 outer frame fill in fields an inner frame could not know (e.g. the decoder
@@ -137,6 +150,47 @@ class IoRetryExhaustedError(ParquetError, OSError):
                  **context):
         super().__init__(message, **context)
         self.attempts = attempts
+
+
+class RemoteTransientError(ParquetError, OSError):
+    """A remote range fetch failed in a way a retry may fix (connection
+    reset, 5xx, a fetch that crossed its per-range deadline).  An
+    ``OSError`` on purpose: every retry layer in the package —
+    ``RetryingSource`` above all — already treats ``OSError`` as the
+    transient class, so remote flakiness rides the existing budgets.
+
+    ``retry_after_s``, when set, is the earliest time a retry is worth
+    issuing (seconds from now); throttle-aware backoff never sleeps less.
+    """
+
+    def __init__(self, message: str = "", *,
+                 retry_after_s: Optional[float] = None, **context):
+        super().__init__(message, **context)
+        self.retry_after_s = retry_after_s
+
+
+class RemoteThrottledError(RemoteTransientError):
+    """The store asked for back-pressure (HTTP 429/503-class).  Transient
+    — but distinct, because a throttle must neither trip the circuit
+    breaker (the endpoint is UP, just busy) nor be retried ahead of its
+    ``retry_after_s``."""
+
+
+class BreakerOpenError(RemoteTransientError):
+    """The per-source circuit breaker is open and failing fast: the last
+    ``breaker_threshold`` requests all failed, so new requests are
+    refused without touching the network until the cooldown passes.
+    ``retry_after_s`` carries the remaining cooldown, so a retry layer
+    above sleeps exactly long enough to meet the half-open probe."""
+
+
+class RemoteFatalError(ParquetError):
+    """A remote failure no retry can fix: credentials refused, bucket or
+    object gone, a transport-level invariant broken.  Deliberately NOT an
+    ``OSError`` (retry layers must give up immediately) and not a
+    corruption class either (salvage must not quarantine healthy data
+    over a dead endpoint) — it propagates annotated through
+    :func:`classified_decode_errors`."""
 
 
 @contextlib.contextmanager

@@ -25,7 +25,7 @@ from ..errors import (
     checked_alloc_size,
     classified_decode_errors,
 )
-from ..io.source import FileSource, RetryingSource
+from ..io.source import FileSource
 from ..utils import trace
 from . import pages as pg
 from .encodings.plain import ByteArrayColumn
@@ -62,8 +62,14 @@ class ReaderOptions:
     * ``quarantine_map`` — a
       :class:`~parquet_floor_tpu.quarantine.QuarantineMap` (salvage mode
       only): known-bad units recorded by an earlier scan are replayed
-      without re-attempting their decode, and new quarantines are
-      recorded back into the map when the reader closes.
+      without re-attempting their decode (page-tier entries with
+      recorded byte spans skip the page's BYTES too), and new
+      quarantines are recorded back into the map when the reader
+      closes.  The map carries its own fingerprint mode — pass
+      ``QuarantineMap(path, fingerprint="content")`` here to key on a
+      full-content CRC (closing the size+tail fingerprint's in-place
+      mid-file-repair blind spot at the price of one full read per
+      open).
     """
 
     verify_crc: bool = False
@@ -121,6 +127,10 @@ class SalvageSkip:
     path: Optional[str] = None
     kind: str = "chunk"
     row_span: Optional[tuple] = None  # group-local [start, stop) for row_mask
+    # absolute file byte span [start, stop) of a quarantined PAGE —
+    # recorded so the quarantine map can replay the loss WITHOUT reading
+    # the page's bytes on a later scan (page-tier I/O skip)
+    byte_span: Optional[tuple] = None
 
     def key(self) -> tuple:
         """Identity for cross-face/set comparison and map dedup."""
@@ -136,6 +146,7 @@ class SalvageSkip:
             "path": self.path,
             "kind": self.kind,
             "row_span": list(self.row_span) if self.row_span else None,
+            "byte_span": list(self.byte_span) if self.byte_span else None,
         }
 
     @classmethod
@@ -150,6 +161,9 @@ class SalvageSkip:
             kind=str(d.get("kind") or "chunk"),
             row_span=(
                 tuple(d["row_span"]) if d.get("row_span") else None
+            ),
+            byte_span=(
+                tuple(d["byte_span"]) if d.get("byte_span") else None
             ),
         )
 
@@ -306,6 +320,41 @@ class SalvageReport:
 # missing capability is a fact about this engine, not the file, and
 # silently dropping such columns would misreport healthy data as damaged.
 _SALVAGEABLE = (CorruptPageError, TruncatedFileError, ThriftDecodeError)
+
+
+class _MapGapPage:
+    """Placeholder in a chunk's page list for a known-bad page whose
+    BYTES were never read: the quarantine map recorded the page's byte
+    span, so the sparse chunk read skipped it and the decode loop
+    substitutes the recorded outcome here (``entry`` is the map's
+    replay record)."""
+
+    __slots__ = ("entry",)
+    page_type = None  # never matches a PageType — handled explicitly
+
+    def __init__(self, entry: dict):
+        self.entry = entry
+
+
+def _page_bspan(chunk_start: int, page) -> Optional[tuple]:
+    """Absolute file byte span of one parsed page (None when the parser
+    did not track offsets)."""
+    if getattr(page, "start", None) is None or page.end is None:
+        return None
+    return (chunk_start + int(page.start), chunk_start + int(page.end))
+
+
+def _trace_map_skip(ctx: dict, page: int, rows: int,
+                    bytes_skipped: int) -> None:
+    """The page-tier quarantine-map replay accounting — ONE spelling of
+    the counter + decision pair, shared by the sparse (bytes skipped)
+    and in-buffer (decode skipped) replay paths."""
+    trace.count("salvage.map_skips")
+    trace.decision("salvage.map_skip", {
+        "column": ctx.get("column"),
+        "row_group": ctx.get("row_group"),
+        "page": page, "rows": rows, "bytes_skipped": bytes_skipped,
+    })
 
 
 def _chunk_byte_range(meta: ColumnMetaData):
@@ -468,10 +517,14 @@ class ParquetFileReader:
         self.options = opts
         src = source if hasattr(source, "read_at") else FileSource(source)
         owns_source = src is not source
-        if opts.io_retries > 0 and not isinstance(src, RetryingSource):
-            # isinstance guard: a caller-wrapped RetryingSource must not be
-            # wrapped again (attempts would multiply, backoffs compound)
-            src = RetryingSource(
+        if opts.io_retries > 0:
+            # the shared retry/fan-out composition (docs/remote.md):
+            # RetryingSource below, ParallelRangeReader above for
+            # remote sources; pre-composed chains pass through so
+            # attempts never multiply and the fan-out never serializes
+            from ..io.remote import compose_retrying
+
+            src = compose_retrying(
                 src, opts.io_retries, opts.io_retry_backoff_s,
                 deadline_s=opts.io_retry_deadline_s,
             )
@@ -502,7 +555,10 @@ class ParquetFileReader:
             try:
                 from ..quarantine import fingerprint as _q_fingerprint
 
-                self._qmap_fp = _q_fingerprint(self.source)
+                self._qmap_fp = _q_fingerprint(
+                    self.source,
+                    mode=getattr(self._qmap, "fingerprint", "tail"),
+                )
                 self._known_bad = self._qmap.known_bad(self._qmap_fp)
             except BaseException:
                 if owns_source:
@@ -633,13 +689,13 @@ class ParquetFileReader:
         ):
             rep.pages_read += pages_decoded
             lost = 0
-            for ordinal, n, err, kind, span in skips:
+            for ordinal, n, err, kind, span, bspan in skips:
                 rep.rows_quarantined += n
                 lost += n
                 rep.skips.append(SalvageSkip(
                     column=ctx["column"], row_group=row_group_index,
                     page=ordinal, rows=n, error=str(err), path=path,
-                    kind=kind, row_span=span,
+                    kind=kind, row_span=span, byte_span=bspan,
                 ))
                 if kind == "dict":
                     # a dict skip is the recovery EVENT (re-derived or
@@ -669,9 +725,119 @@ class ParquetFileReader:
         # row drop is an action, not an accounting entry, and must apply
         # even when _first_count already suppressed the bookkeeping
         return batch, [
-            span for _o, _n, _e, kind, span in skips
+            span for _o, _n, _e, kind, span, _b in skips
             if kind == "row_mask" and span is not None
         ]
+
+    def _map_gaps(self, known_pages: dict, start: int, length: int,
+                  desc: ColumnDescriptor, row_mask: bool,
+                  total_vals: int) -> dict:
+        """The quarantine-map entries of this chunk whose bytes can be
+        SKIPPED outright: page-tier records carrying a plausible byte
+        span AND whose substitution tier applies under the current
+        decode (``page_null`` needs a flat OPTIONAL column, ``row_mask``
+        a flat column under a group-coordinated read).  Returns
+        ``{abs_start: (abs_stop, entry)}``; empty means read the whole
+        chunk (entries without spans still replay from the buffer).
+        Overlapping or out-of-range spans disqualify the whole set —
+        a map that mis-tiles the chunk must not corrupt the parse."""
+        if not known_pages or not self._salvage:
+            return {}
+        flat = desc.max_repetition_level == 0
+        spans = []
+        for e in known_pages.values():
+            bs = e.get("byte_span")
+            rows = e.get("rows")
+            if not bs or len(bs) != 2:
+                continue
+            a, b = int(bs[0]), int(bs[1])
+            if not (start <= a < b <= start + length):
+                continue
+            if not isinstance(rows, int) or not 0 <= rows <= total_vals:
+                continue
+            if e.get("kind") == "page_null":
+                if not (flat and desc.max_definition_level > 0):
+                    continue
+            elif e.get("kind") == "row_mask":
+                if not (flat and row_mask):
+                    continue
+            else:
+                continue
+            spans.append((a, b, e))
+        spans.sort(key=lambda s: s[0])
+        for (a1, b1, _), (a2, _b2, _) in zip(spans, spans[1:]):
+            if a2 < b1:
+                return {}  # overlapping records: distrust the whole set
+        return {a: (b, e) for a, b, e in spans}
+
+    def _split_pages_sparse(self, start: int, length: int, total_vals: int,
+                            ctx: dict, gaps: dict) -> list:
+        """Chunk page scan that never reads the known-bad spans in
+        ``gaps``: the complement ranges fetch as one vectored read, each
+        segment parses sequentially, and every gap contributes a
+        :class:`_MapGapPage` in ordinal position.  A map whose spans do
+        not tile page boundaries surfaces as a framing
+        ``CorruptPageError`` (the chunk then quarantines) — stale
+        replay is visible loss, never silent corruption."""
+        end = start + length
+        segments = []  # (abs_offset, byte_length)
+        cur = start
+        for a in sorted(gaps):
+            b, _e = gaps[a]
+            if a > cur:
+                segments.append((cur, a - cur))
+            cur = max(cur, b)
+        if cur < end:
+            segments.append((cur, end - cur))
+        read_many = getattr(self.source, "read_many", None)
+        if read_many is not None:
+            bufs = read_many(segments)
+        else:
+            bufs = [self.source.read_at(o, n) for o, n in segments]
+        seg_by_start = {o: buf for (o, _n), buf in zip(segments, bufs)}
+        pages: list = []
+        pos = start
+        seen = 0
+        seg_off = None
+        seg_buf = None
+        while seen < total_vals and pos < end:
+            hit = gaps.get(pos)
+            if hit is not None:
+                b, e = hit
+                pages.append(_MapGapPage(e))
+                seen += int(e.get("rows") or 0)
+                pos = b
+                seg_off = seg_buf = None
+                continue
+            if seg_buf is None:
+                seg_buf = seg_by_start.get(pos)
+                seg_off = pos
+                if seg_buf is None:
+                    raise CorruptPageError(
+                        "quarantine-map byte spans do not tile the chunk "
+                        "(stale sidecar?)",
+                        offset=pos, **ctx,
+                    )
+            page, rel_end = pg.parse_page_at(
+                seg_buf, pos - seg_off, ctx, len(pages), offset_base=seg_off
+            )
+            # re-anchor the span chunk-relative (the parse was
+            # segment-relative)
+            page.start = pos - start
+            page.end = (seg_off + rel_end) - start
+            pages.append(page)
+            pos = seg_off + rel_end
+            if pos - seg_off >= len(seg_buf):
+                seg_off = seg_buf = None
+            if page.page_type in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+                n = _page_num_values(page)
+                if n is None:
+                    raise CorruptPageError(
+                        "data page header is missing its num_values",
+                        page=len(pages) - 1, offset=pos, **ctx,
+                    )
+                seen += n
+        return pages
 
     def _decode_chunk(self, chunk: ColumnChunk, desc: ColumnDescriptor,
                       ctx: dict, row_mask: bool = False,
@@ -687,11 +853,24 @@ class ParquetFileReader:
         :meth:`read_row_group` may set it — the row drop must apply to
         every column of the group).  ``known`` is the quarantine map's
         replay index for this chunk: listed data pages substitute their
-        recorded outcome without re-attempting the decode."""
+        recorded outcome without re-attempting the decode — and, when
+        the entry recorded the page's byte span, without READING the
+        page's bytes either (the chunk reads as a vectored complement
+        around the known-bad spans)."""
         meta = chunk.meta_data
         start, length = _chunk_byte_range(meta)
-        raw = self.source.read_at(start, length)
-        raw_pages = pg.split_pages(raw, meta.num_values, ctx, offset_base=start)
+        known_pages = (known or {}).get("pages") or {}
+        gaps = self._map_gaps(known_pages, start, length, desc, row_mask,
+                              int(meta.num_values or 0))
+        if gaps:
+            raw_pages = self._split_pages_sparse(
+                start, length, int(meta.num_values or 0), ctx, gaps
+            )
+        else:
+            raw = self.source.read_at(start, length)
+            raw_pages = pg.split_pages(
+                raw, meta.num_values, ctx, offset_base=start
+            )
         dictionary = None
         dict_seen = False
         decoded: List[pg.DecodedPage] = []
@@ -702,6 +881,31 @@ class ParquetFileReader:
         total_vals = int(meta.num_values or 0)
         for i, page in enumerate(raw_pages):
             pctx = {**ctx, "page": i}
+            if isinstance(page, _MapGapPage):
+                # page-tier map replay WITHOUT I/O: the bytes were never
+                # read; substitute the recorded outcome (record fields
+                # identical to a fresh scan's, byte span included)
+                e = page.entry
+                n = int(e.get("rows") or 0)
+                rows = checked_alloc_size(n, "map-replayed page", **pctx)
+                bspan = tuple(e["byte_span"])
+                if e["kind"] == "page_null":
+                    decoded.append(pg.DecodedPage(
+                        n, _empty_values(desc),
+                        np.zeros(rows, np.uint32), None,
+                    ))
+                    skips.append((i, n, e["error"], "page_null", None, bspan))
+                else:  # row_mask (the only other kind _map_gaps admits)
+                    decoded.append(pg.DecodedPage(
+                        n, _filler_values(desc, rows), None, None
+                    ))
+                    skips.append((
+                        i, n, e["error"], "row_mask",
+                        (row_cursor, row_cursor + n), bspan,
+                    ))
+                _trace_map_skip(ctx, i, n, bspan[1] - bspan[0])
+                row_cursor += n
+                continue
             if page.page_type == PageType.DICTIONARY_PAGE:
                 if dict_seen:
                     raise CorruptPageError(
@@ -724,7 +928,7 @@ class ParquetFileReader:
                     dictionary, action = self._recover_dictionary(
                         chunk, desc, ctx, page, e
                     )
-                    skips.append((i, 0, f"{action}: {e}", "dict", None))
+                    skips.append((i, 0, f"{action}: {e}", "dict", None, None))
             elif page.page_type in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
                 n = _page_num_values(page)
                 ok_n = (
@@ -749,7 +953,9 @@ class ParquetFileReader:
                             n, _empty_values(desc),
                             np.zeros(rows, np.uint32), None,
                         ))
-                        skips.append((i, n, kn["error"], "page_null", None))
+                        skips.append((i, n, kn["error"], "page_null", None,
+                                      _page_bspan(start, page)))
+                        _trace_map_skip(ctx, i, n, 0)
                         row_cursor += n
                         continue
                     if kn["kind"] == "row_mask" and flat and row_mask:
@@ -762,7 +968,9 @@ class ParquetFileReader:
                         skips.append((
                             i, n, kn["error"], "row_mask",
                             (row_cursor, row_cursor + n),
+                            _page_bspan(start, page),
                         ))
+                        _trace_map_skip(ctx, i, n, 0)
                         row_cursor += n
                         continue
                     # stale or inapplicable entry: fall through and let
@@ -790,7 +998,8 @@ class ParquetFileReader:
                             n, _empty_values(desc),
                             np.zeros(rows, np.uint32), None,
                         ))
-                        skips.append((i, n, e, "page_null", None))
+                        skips.append((i, n, e, "page_null", None,
+                                      _page_bspan(start, page)))
                     elif self._salvage and ok_n and flat and row_mask:
                         # flat REQUIRED column: nulls cannot stand in,
                         # but the page's ROW SPAN is known (values ==
@@ -806,6 +1015,7 @@ class ParquetFileReader:
                         skips.append((
                             i, n, e, "row_mask",
                             (row_cursor, row_cursor + n),
+                            _page_bspan(start, page),
                         ))
                     else:
                         raise
@@ -929,7 +1139,8 @@ class ParquetFileReader:
         )
 
     def read_row_group_ranges(
-        self, index: int, row_ranges, column_filter: Optional[Set[str]] = None
+        self, index: int, row_ranges, column_filter: Optional[Set[str]] = None,
+        *, report: Optional[SalvageReport] = None,
     ):
         """Selective decode: only pages whose rows intersect ``row_ranges``
         are **read from disk** and decoded, using each chunk's OffsetIndex
@@ -941,11 +1152,30 @@ class ParquetFileReader:
         rows actually correspond to, identical across columns.  Chunks
         without an OffsetIndex decode fully; a whole-group request or a
         zero-range request short-circuits.
+
+        **Salvage mode decodes the whole group.**  Quarantine decisions
+        are GROUP-WIDE facts (the row-mask tier drops a damaged span
+        from every column, chunk quarantines change the column set, map
+        replays must re-establish identical records), and a pruned read
+        cannot see damage outside its requested pages — so ``salvage=
+        True`` routes through :meth:`read_row_group` and reports
+        ``covered=[(0, num_rows)]``, a legal superset of any request.
+        The quarantine set is therefore identical to the whole-chunk
+        read's BY CONSTRUCTION — the same delegation argument the
+        device face uses (docs/robustness.md).  When the row-mask tier
+        dropped rows, ``covered`` still names the PRE-mask group range;
+        the report records what was removed.  ``report`` routes
+        per-unit accounting exactly as in :meth:`read_row_group`.
         """
         from ..batch.predicate import normalize_ranges
 
         rg = self.row_groups[index]
         n = int(rg.num_rows or 0)
+        if self._salvage:
+            return (
+                self.read_row_group(index, column_filter, report=report),
+                [(0, n)] if n else [],
+            )
         if not normalize_ranges(row_ranges, n):
             # predicate excluded every row — report that regardless of
             # what (or whether anything) was projected

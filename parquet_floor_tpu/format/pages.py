@@ -93,8 +93,10 @@ def _split_pages_native(chunk, num_values: int):
         off, size = int(row[1]), int(row[2])
         # zero-copy: a view into the chunk buffer (kept alive by the
         # page's reference; staging consumes pages while the source is
-        # open, and every consumer takes buffers, not bytes)
-        pages.append(RawPage(header, mv[off : off + size]))
+        # open, and every consumer takes buffers, not bytes).  The
+        # page's header starts where the previous payload ended.
+        start = pages[-1].end if pages else 0
+        pages.append(RawPage(header, mv[off : off + size], start, off + size))
         offsets.append(off)
     return pages, offsets
 
@@ -111,10 +113,17 @@ class RawPage:
     """A parsed page header + its (still compressed) payload bytes.
 
     ``payload`` may be a zero-copy memoryview into the column-chunk
-    buffer — consume it while the source is open (mmap-backed)."""
+    buffer — consume it while the source is open (mmap-backed).
+
+    ``start``/``end`` are the page's chunk-relative byte span (header
+    through payload, ``end`` exclusive) when the parser knows it — the
+    quarantine map records it so a later scan can skip a known-bad
+    page's bytes without re-reading them (docs/robustness.md)."""
 
     header: PageHeader
     payload: Union[bytes, memoryview]  # compressed_page_size bytes
+    start: Optional[int] = None        # chunk-relative header offset
+    end: Optional[int] = None          # chunk-relative payload end
 
     @property
     def page_type(self) -> int:
@@ -172,7 +181,7 @@ def parse_page_at(buf, pos: int, ctx: Optional[dict] = None,
             f"buffer holds {len(payload)}",
             page=ordinal, offset=err_off, **(ctx or {}),
         )
-    return RawPage(header, payload), reader.pos + size
+    return RawPage(header, payload, pos, reader.pos + size), reader.pos + size
 
 
 def split_pages(chunk: bytes, num_values: int, ctx: Optional[dict] = None,

@@ -1,1 +1,3 @@
-"""L1: host filesystem sources/sinks."""
+"""L1: host filesystem sources/sinks (``source``) and the
+remote-storage failure domain (``remote`` — ranged GETs with hedging,
+circuit breaking, and classified errors; docs/remote.md)."""

@@ -1,0 +1,666 @@
+"""Remote-storage source layer (L1-remote): ranged GETs as a FAILURE
+DOMAIN, not just a transport.
+
+Production Parquet lives on object stores with real latency, throttling,
+and partial failures — the capability the local ``FileSource`` never has
+to model.  :class:`RemoteSource` adapts any :class:`RemoteTransport`
+(one ranged-GET method) into the package's positional-source protocol
+(``read_at``/``read_many``/``size``/``name``/``close``) and owns the
+tail-latency and failure machinery every remote deployment needs:
+
+* **parallel per-range fetches** — ``read_many`` fans its ranges across
+  an internal pool, so one vectored extent read costs ~one RTT instead
+  of one RTT per range;
+* **hedged reads** — a range fetch that outlives the hedge delay
+  (adaptive: the source's observed p95 latency, clamped to
+  ``[hedge_min_delay_s, hedge_max_delay_s]``; or a fixed
+  ``hedge_delay_s``) gets a duplicate request; the first response wins,
+  the loser is cancelled/abandoned and counted
+  (``io.remote.hedges`` / ``io.remote.hedge_wins`` /
+  ``io.remote.hedges_cancelled``).  When both fail, the PRIMARY's error
+  is raised — error order stays deterministic no matter which request
+  failed first;
+* **a per-source circuit breaker** — ``breaker_threshold`` consecutive
+  non-throttle failures trip it open and requests fail fast
+  (:class:`~parquet_floor_tpu.errors.BreakerOpenError`, carrying the
+  remaining cooldown as ``retry_after_s``) until the cooldown passes;
+  then ONE half-open probe is admitted, and its outcome closes or
+  re-opens the breaker.  Throttles never trip it: a throttling store is
+  up, just busy;
+* **connection-level error classification** folded into the
+  ``ParquetError`` taxonomy (``docs/remote.md``): transport ``OSError``s
+  are the transient class (the existing ``RetryingSource`` budgets
+  retry them unchanged), :class:`RemoteThrottledError` carries the
+  store's ``retry_after_s`` (which throttle-aware backoff honors), and
+  anything else a transport raises is wrapped as
+  :class:`RemoteFatalError` — no retry schedule is ever burned on a
+  denied credential.
+
+Retry composition (the scan executor's chain, built by
+``scan.executor._source_chain``)::
+
+    PrefetchedSource                 # extent cache
+      └─ ParallelRangeReader         # vectored fan-out (per-range tasks)
+           └─ RetryingSource         # per-range retry/deadline budgets
+                └─ RemoteSource      # hedging + breaker + classification
+                     └─ transport    # one ranged GET
+
+``RetryingSource`` retries one RANGE at a time, so wrapping the remote
+source directly would serialize a vectored read; the
+:class:`ParallelRangeReader` adapter re-introduces the fan-out ABOVE the
+retry layer, giving every range its own full retry/deadline budget while
+ranges still fetch concurrently.
+
+Everything observability-facing lands in the registered ``io.*`` trace
+names (``utils.trace.names``; table in ``docs/observability.md``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import List, Optional
+
+from ..errors import (
+    BreakerOpenError,
+    RemoteFatalError,
+    RemoteThrottledError,
+    RemoteTransientError,
+    TruncatedFileError,
+)
+from ..utils import trace
+from .source import RetryingSource
+
+
+class RemoteTransport:
+    """The minimal contract a remote backend implements — ONE ranged GET
+    plus identity.  Documentation-only base (no registration needed):
+
+    * ``get_range(offset, length) -> bytes``: exactly ``length`` bytes at
+      ``offset``, or raise.  Transient failures raise ``OSError`` (or
+      :class:`RemoteTransientError`); back-pressure raises
+      :class:`RemoteThrottledError` (ideally with ``retry_after_s``);
+      anything else is treated as fatal.  Called from multiple threads.
+    * ``size`` (int), ``name`` (str), optional ``close()``.
+
+    The in-tree implementation is the seeded
+    ``testing.SimulatedRemoteSource`` transport; an S3/GCS/HTTP transport
+    is one ranged-GET call behind this surface.
+    """
+
+    size: int = 0
+    name: str = "<remote>"
+
+    def get_range(self, offset: int, length: int) -> bytes:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LatencyStats:
+    """Thread-safe reservoir of recent per-request latencies — the
+    adaptive hedge delay reads its p95.  (The latency-adaptive prefetch
+    controller keeps its OWN per-extent-load EWMA in
+    ``scan.executor._AdaptiveController``; its inputs are whole extent
+    loads, not single requests.)  Bounded (ring of ``cap`` samples) so
+    a long scan tracks the CURRENT tail, not the whole history."""
+
+    def __init__(self, cap: int = 128):
+        self._cap = int(cap)
+        self._lock = threading.Lock()
+        self._ring: List[float] = []
+        self._pos = 0
+        self.count = 0
+
+    def observe(self, seconds: float) -> None:
+        s = float(seconds)
+        with self._lock:
+            self.count += 1
+            if len(self._ring) < self._cap:
+                self._ring.append(s)
+            else:
+                self._ring[self._pos] = s
+                self._pos = (self._pos + 1) % self._cap
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._ring:
+                return None
+            data = sorted(self._ring)
+        i = min(len(data) - 1, max(0, int(q * len(data))))
+        return data[i]
+
+    def p95(self) -> Optional[float]:
+        return self.quantile(0.95)
+
+
+class CircuitBreaker:
+    """Per-source fail-fast guard (module docstring).  Thread-safe; the
+    clock is injectable for tests.  ``check()`` raises
+    :class:`BreakerOpenError` while open; ``on_success``/``on_failure``
+    report request outcomes (throttles must NOT be reported as
+    failures — the caller classifies first)."""
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 1.0,
+                 name: str = "<remote>", clock=time.monotonic):
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1, got {threshold}")
+        if cooldown_s <= 0:
+            raise ValueError(f"breaker cooldown must be > 0, got {cooldown_s}")
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0          # consecutive, since last success
+        self._opened_at: Optional[float] = None
+        self._probing = False       # a half-open probe is in flight
+        self._probe_started: Optional[float] = None
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_at is None:
+                return "closed"
+            if self._probing:
+                return "half_open"
+            return "open"
+
+    def check(self) -> None:
+        """Admission control, called before each request.  While open:
+        fail fast with the remaining cooldown as ``retry_after_s``.
+        After the cooldown: admit exactly ONE half-open probe; everyone
+        else keeps failing fast until the probe resolves.  A probe that
+        never resolves (its future was cancelled before running, its
+        outcome was neither success nor a countable failure and the
+        release was missed) is RECLAIMED after one further cooldown —
+        a lost probe must not wedge the breaker open forever."""
+        with self._lock:
+            if self._opened_at is None:
+                return
+            now = self._clock()
+            remaining = self._opened_at + self.cooldown_s - now
+            if remaining <= 0 and (
+                not self._probing
+                or (self._probe_started is not None
+                    and now - self._probe_started > self.cooldown_s)
+            ):
+                self._probing = True  # this caller is the (new) probe
+                self._probe_started = now
+                return
+            retry_after = max(remaining, 0.0) or self.cooldown_s
+        trace.count("io.remote.breaker_fast_fails")
+        raise BreakerOpenError(
+            f"circuit breaker open for {self.name}: "
+            f"{self.threshold} consecutive failures; "
+            f"retry in {retry_after:.3f}s",
+            retry_after_s=retry_after, path=self.name,
+        )
+
+    def on_success(self) -> None:
+        with self._lock:
+            was_open = self._opened_at is not None
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
+            self._probe_started = None
+        if was_open:
+            trace.decision("io.breaker", {
+                "path": self.name, "state": "closed",
+                "via": "half_open_probe",
+            })
+
+    def on_bypass(self) -> None:
+        """The request resolved without judging the endpoint (e.g. a
+        throttle: the store is up but refused the work).  Releases a
+        half-open probe WITHOUT closing or re-opening, so the next
+        admitted request becomes a fresh probe instead of the breaker
+        wedging on a probe that never got an answer."""
+        with self._lock:
+            self._probing = False
+            self._probe_started = None
+
+    def on_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._probing:
+                # the half-open probe failed: re-open for a fresh cooldown
+                self._opened_at = self._clock()
+                self._probing = False
+                self._probe_started = None
+                reopened = True
+                tripped = False
+            elif self._opened_at is None and self._failures >= self.threshold:
+                self._opened_at = self._clock()
+                tripped = True
+                reopened = False
+            else:
+                return
+        if tripped:
+            trace.count("io.remote.breaker_trips")
+            trace.decision("io.breaker", {
+                "path": self.name, "state": "open",
+                "consecutive_failures": self._failures,
+                "cooldown_s": self.cooldown_s,
+            })
+        elif reopened:
+            trace.decision("io.breaker", {
+                "path": self.name, "state": "open", "via": "probe_failed",
+                "cooldown_s": self.cooldown_s,
+            })
+
+
+class RemoteSource:
+    """Positional source over a :class:`RemoteTransport` (module
+    docstring: parallel ranged GETs, hedging, circuit breaker, error
+    classification).
+
+    Thread-safe like every source in :mod:`parquet_floor_tpu.io`;
+    ``close()`` must not race in-flight reads (the usual quiesce
+    contract).  ``fetch_threads`` bounds concurrent transport requests
+    issued by THIS source (vectored fan-out and hedges share the pool).
+
+    ``hedge_delay_s=None`` (default) is ADAPTIVE: hedge when a request
+    outlives the source's observed p95 latency (clamped to
+    ``[hedge_min_delay_s, hedge_max_delay_s]``); hedging stays off until
+    ``hedge_min_samples`` latencies are on record — there is no tail to
+    estimate from cold.  ``hedge=False`` disables hedging entirely.
+
+    ``range_deadline_s`` bounds ONE range fetch including its hedge:
+    crossing it raises :class:`RemoteTransientError` (retryable above,
+    counted ``io.remote.deadlines``) and abandons the in-flight
+    requests.
+    """
+
+    def __init__(self, transport, *, fetch_threads: int = 8,
+                 hedge: bool = True,
+                 hedge_delay_s: Optional[float] = None,
+                 hedge_min_delay_s: float = 0.01,
+                 hedge_max_delay_s: float = 2.0,
+                 hedge_min_samples: int = 8,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown_s: float = 1.0,
+                 range_deadline_s: Optional[float] = None,
+                 clock=time.monotonic):
+        if fetch_threads < 1:
+            raise ValueError(f"fetch_threads must be >= 1, got {fetch_threads}")
+        if hedge_delay_s is not None and hedge_delay_s <= 0:
+            raise ValueError(
+                f"hedge_delay_s must be > 0 (or None = adaptive), "
+                f"got {hedge_delay_s}"
+            )
+        if range_deadline_s is not None and range_deadline_s <= 0:
+            raise ValueError(
+                f"range_deadline_s must be > 0 (or None), got {range_deadline_s}"
+            )
+        self._transport = transport
+        self._clock = clock
+        self._hedge = bool(hedge)
+        self._hedge_delay_s = hedge_delay_s
+        self._hedge_min = float(hedge_min_delay_s)
+        self._hedge_max = float(hedge_max_delay_s)
+        self._hedge_min_samples = int(hedge_min_samples)
+        self._range_deadline_s = range_deadline_s
+        self.latency = LatencyStats()
+        self.breaker = CircuitBreaker(
+            breaker_threshold, breaker_cooldown_s,
+            name=getattr(transport, "name", "<remote>"), clock=clock,
+        )
+        self._pool = ThreadPoolExecutor(
+            max_workers=int(fetch_threads), thread_name_prefix="pftpu-remote"
+        )
+        self._closed = False
+
+    # a structural marker the scan executor's chain builder keys on —
+    # "my read_many is already parallel; put retries per-range above me"
+    parallel_read_many = True
+
+    @property
+    def name(self) -> str:
+        return getattr(self._transport, "name", "<remote>")
+
+    @property
+    def size(self) -> int:
+        return int(self._transport.size)
+
+    def hedge_delay(self) -> Optional[float]:
+        """The CURRENT hedge delay in seconds: the fixed configuration,
+        or the adaptive p95-based one; None while hedging is off (or the
+        adaptive estimator has too few samples)."""
+        if not self._hedge:
+            return None
+        if self._hedge_delay_s is not None:
+            return self._hedge_delay_s
+        if self.latency.count < self._hedge_min_samples:
+            return None
+        p95 = self.latency.p95()
+        if p95 is None:
+            return None
+        return min(self._hedge_max, max(self._hedge_min, p95))
+
+    # -- one physical request ------------------------------------------------
+
+    def _request(self, offset: int, length: int):
+        """One transport GET, classified + fed to the breaker and the
+        latency reservoir.  Runs on the pool; hedged duplicates run this
+        too, so EVERY physical outcome reaches the breaker — a late
+        loser that finds the endpoint dead still counts."""
+        t0 = self._clock()
+        try:
+            data = self._transport.get_range(offset, length)
+        except BaseException as e:
+            err = self._classified(e, offset, length)
+            if err is e:
+                raise
+            raise err from e
+        if len(data) != length:
+            # a transport that returns a truncated body without raising
+            # (dropped connection mid-stream) is a WIRE fault, not a
+            # fact about the bytes: classify transient so the retry
+            # budgets re-fetch it — mis-framed short bytes reaching the
+            # page parser would read as corruption and let salvage
+            # quarantine healthy data
+            self.breaker.on_failure()
+            trace.count("io.remote.faults")
+            raise RemoteTransientError(
+                f"short remote read: wanted {length} bytes at {offset}, "
+                f"transport returned {len(data)}",
+                path=self.name, offset=offset,
+            )
+        self.breaker.on_success()
+        self.latency.observe(self._clock() - t0)
+        trace.count("io.remote.requests")
+        trace.count("io.remote.bytes", length)
+        return data
+
+    def _classified(self, e: BaseException, offset: int, length: int):
+        """Map one transport failure into the taxonomy (module
+        docstring) and report it to the breaker.  Returns the exception
+        to raise."""
+        if isinstance(e, RemoteThrottledError):
+            trace.count("io.remote.throttles")
+            self.breaker.on_bypass()  # the store answered; release a probe
+            return e  # back-pressure: the store is up — never trips
+        if isinstance(e, (EOFError, TruncatedFileError)):
+            # a deterministic fact about the BYTES, not the wire — and
+            # the endpoint demonstrably responded, which is what a
+            # half-open probe was asking
+            self.breaker.on_success()
+            return e
+        if isinstance(e, RemoteFatalError):
+            self.breaker.on_failure()
+            return e
+        if isinstance(e, (OSError, TimeoutError)):
+            trace.count("io.remote.faults")
+            self.breaker.on_failure()
+            return e  # the transient class; retry layers see OSError
+        if isinstance(e, (KeyboardInterrupt, SystemExit, MemoryError)):
+            return e  # environmental / control flow: never reclassified
+        self.breaker.on_failure()
+        return RemoteFatalError(
+            f"fatal transport error reading [{offset}, {offset + length}): "
+            f"{e!r}",
+            path=self.name, offset=offset,
+        )
+
+    # -- hedged range fetch --------------------------------------------------
+
+    def _fetch(self, offset: int, length: int) -> memoryview:
+        t_start = self._clock()
+        deadline = (
+            None if self._range_deadline_s is None
+            else t_start + self._range_deadline_s
+        )
+        self.breaker.check()  # may fail fast (BreakerOpenError)
+        # requests run on the pool: bind them to the submitting tracer
+        # scope (contextvars do not cross thread-pool submission)
+        tracer = trace.current()
+        with trace.span("io.remote.get", length, attrs={
+            "path": self.name, "offset": offset, "length": length,
+        }):
+            futs = [self._pool.submit(tracer.run, self._request,
+                                      offset, length)]
+            hedged = False
+            errors: List[Optional[BaseException]] = [None, None]
+            while True:
+                # harvested failures drop out of the wait set — a failed
+                # primary must not make wait() return instantly forever
+                # while the hedge is still in flight
+                outstanding = [
+                    f for i, f in enumerate(futs) if errors[i] is None
+                ]
+                if not outstanding:
+                    # every issued request failed: deterministic error
+                    # order — the PRIMARY's failure is the one reported,
+                    # no matter which request failed first
+                    raise errors[0]
+                remaining = (
+                    None if deadline is None else deadline - self._clock()
+                )
+                if remaining is not None and remaining <= 0:
+                    break  # deadline crossed with requests still in flight
+                hd = None if hedged else self.hedge_delay()
+                if hd is None:
+                    timeout = remaining
+                else:
+                    timeout = hd if remaining is None else min(hd, remaining)
+                done, pending = wait(
+                    outstanding, timeout=timeout, return_when=FIRST_COMPLETED
+                )
+                for f in done:
+                    i = futs.index(f)
+                    try:
+                        data = f.result()
+                    except BaseException as e:
+                        errors[i] = e
+                        continue
+                    # first successful response wins; the loser (if any)
+                    # is cancelled — or abandoned mid-flight — and counted
+                    for other in futs:
+                        if other is not f and not other.done():
+                            other.cancel()
+                            trace.count("io.remote.hedges_cancelled")
+                    if hedged and f is futs[1]:
+                        trace.count("io.remote.hedge_wins")
+                    return memoryview(data)
+                if not done and pending and not hedged and hd is not None \
+                        and self._clock() - t_start >= hd:
+                    # the primary REALLY outlived the hedge delay — the
+                    # wait may have timed out on the (shorter) deadline
+                    # remainder instead, and a fetch about to be
+                    # abandoned must not issue a duplicate first
+                    hedged = True
+                    trace.count("io.remote.hedges")
+                    trace.decision("io.hedge", {
+                        "path": self.name, "offset": offset,
+                        "length": length, "delay_s": round(hd, 6),
+                    })
+                    futs.append(self._pool.submit(
+                        tracer.run, self._request, offset, length
+                    ))
+            for i, f in enumerate(futs):
+                if not f.done():
+                    f.cancel()
+                    if i >= 1:
+                        # only an abandoned HEDGE counts as a cancelled
+                        # hedge — a deadline-bound primary with no
+                        # duplicate is not phantom hedge activity
+                        trace.count("io.remote.hedges_cancelled")
+            trace.count("io.remote.deadlines")
+            raise RemoteTransientError(
+                f"range fetch [{offset}, {offset + length}) exceeded its "
+                f"{self._range_deadline_s}s deadline"
+                + (" (hedge in flight)" if hedged else ""),
+                path=self.name, offset=offset,
+            )
+
+    # -- the positional-source surface ---------------------------------------
+
+    def _check_bounds(self, offset: int, length: int) -> None:
+        if offset < 0 or offset + length > self.size:
+            raise TruncatedFileError(
+                f"read [{offset}, {offset + length}) outside remote object "
+                f"of {self.size} bytes",
+                path=self.name, offset=offset,
+            )
+
+    def read_at(self, offset: int, length: int) -> memoryview:
+        self._check_bounds(offset, length)
+        if length == 0:
+            return memoryview(b"")
+        return self._fetch(offset, length)
+
+    def read_many(self, ranges) -> list:
+        """Vectored read: every range fetched in PARALLEL through the
+        pool (each range is its own hedged request), results in request
+        order.  Errors keep range order too: the first-listed failing
+        range's error is raised after all fetches settle."""
+        ranges = list(ranges)
+        for o, n in ranges:
+            self._check_bounds(o, n)
+        if not ranges:
+            return []
+        if len(ranges) == 1:
+            o, n = ranges[0]
+            return [self.read_at(o, n)]
+        with trace.span(
+            "io.read", sum(n for _, n in ranges),
+            attrs={"path": self.name, "ranges": len(ranges),
+                   "offset": ranges[0][0]},
+        ):
+            # each range's _fetch WAITS on pool futures, so the fan-out
+            # must not ride the same pool (waiters occupying every
+            # worker would deadlock the requests they wait for).
+            # Transient threads are fine here: coalescing keeps the
+            # range count per vectored read small, and the transport
+            # requests below still ride the bounded pool.
+            results: list = [None] * len(ranges)
+            errors: list = [None] * len(ranges)
+            tracer = trace.current()
+
+            def one(i, o, n):
+                try:
+                    results[i] = (
+                        tracer.run(self._fetch, o, n) if n
+                        else memoryview(b"")
+                    )
+                except BaseException as e:
+                    errors[i] = e
+
+            threads = [
+                threading.Thread(
+                    target=one, args=(i, o, n), daemon=True,
+                    name=f"pftpu-remote-range-{i}",
+                )
+                for i, (o, n) in enumerate(ranges)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for e in errors:
+                if e is not None:
+                    raise e
+            return results
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._pool.shutdown(wait=True)
+        close = getattr(self._transport, "close", None)
+        if close is not None:
+            close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ParallelRangeReader:
+    """Vectored fan-out ABOVE a per-range retry layer (module docstring's
+    chain).  ``read_many`` maps each range to ``inner.read_at`` on its
+    own worker, so every range keeps its OWN retry/deadline budget
+    (``RetryingSource`` semantics) while ranges fetch concurrently.
+    Error order is deterministic: all ranges settle, the first-listed
+    failure raises.  Single reads pass through untouched."""
+
+    def __init__(self, inner, threads: int = 8):
+        if threads < 1:
+            raise ValueError(f"threads must be >= 1, got {threads}")
+        self._inner = inner
+        self._pool = ThreadPoolExecutor(
+            max_workers=int(threads), thread_name_prefix="pftpu-ranges"
+        )
+
+    @property
+    def name(self) -> str:
+        return self._inner.name
+
+    @property
+    def size(self) -> int:
+        return self._inner.size
+
+    def read_at(self, offset: int, length: int) -> memoryview:
+        return self._inner.read_at(offset, length)
+
+    def read_many(self, ranges) -> list:
+        ranges = list(ranges)
+        if len(ranges) <= 1:
+            return [self._inner.read_at(o, n) for o, n in ranges]
+        # bind workers to the submitting tracer scope, like every other
+        # pool in the package (contextvars do not cross thread spawns)
+        tracer = trace.current()
+        futs = [
+            self._pool.submit(tracer.run, self._inner.read_at, o, n)
+            for o, n in ranges
+        ]
+        out: list = []
+        first_err: Optional[BaseException] = None
+        for f in futs:
+            try:
+                out.append(f.result())
+            except BaseException as e:
+                if first_err is None:
+                    first_err = e
+                out.append(None)
+        if first_err is not None:
+            raise first_err
+        return out
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+        self._inner.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def compose_retrying(src, retries: int, backoff_s: float = 0.05,
+                     deadline_s: Optional[float] = None):
+    """THE one spelling of the retry/fan-out composition (module
+    docstring's chain), shared by ``ParquetFileReader`` and the scan
+    executor's ``_source_chain``: wrap ``src`` in a ``RetryingSource``
+    and — when the source's ``read_many`` is parallel
+    (``parallel_read_many``) — re-parallelize ABOVE it with a
+    :class:`ParallelRangeReader`, each range keeping its own full
+    retry/deadline budget.
+
+    Already-composed sources pass through untouched: a
+    ``RetryingSource`` OR a ``ParallelRangeReader`` at the top of the
+    chain means the caller owns the budgets — wrapping again would
+    multiply attempts, compound backoffs, and serialize the vectored
+    fan-out behind the outer retry loop."""
+    if retries <= 0 or isinstance(src, (RetryingSource,
+                                        ParallelRangeReader)):
+        return src
+    remote = getattr(src, "parallel_read_many", False)
+    src = RetryingSource(src, retries, backoff_s, deadline_s=deadline_s)
+    return ParallelRangeReader(src) if remote else src

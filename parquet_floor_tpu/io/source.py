@@ -207,6 +207,13 @@ class RetryingSource:
     The exponential backoff carries uniform jitter (``jitter`` is the
     fraction of each delay added at random, default 10%) so a fleet of
     readers hitting the same flaky mount does not retry in lockstep.
+    Backoff is **throttle-aware**: when the caught error carries a
+    ``retry_after_s`` (the remote taxonomy's
+    :class:`~parquet_floor_tpu.errors.RemoteThrottledError` /
+    :class:`~parquet_floor_tpu.errors.BreakerOpenError`), the next sleep
+    is at least that long — retrying into a throttle window (or an open
+    circuit breaker) would burn attempts a compliant wait would have
+    saved.
     Every read that retry *saved* is surfaced as an ``io.retry`` trace
     decision (and exhaustion as ``io.retry_exhausted``), so production
     serving can watch retry rates without new plumbing.
@@ -306,6 +313,11 @@ class RetryingSource:
                 if attempt < self._retries:
                     delay = self._backoff_s * (2 ** attempt)
                     delay *= 1.0 + self._jitter * self._rng()
+                    retry_after = getattr(e, "retry_after_s", None)
+                    if retry_after is not None:
+                        # throttle-aware: the server (or the circuit
+                        # breaker) named the earliest useful retry time
+                        delay = max(delay, float(retry_after))
                     if deadline is not None and \
                             self._clock() + delay > deadline:
                         # the next sleep would cross the total budget:

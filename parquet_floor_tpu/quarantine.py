@@ -24,20 +24,34 @@ Usage::
     ... scan the corpus through any salvage-capable face ...
     qmap.save()          # persist what this scan learned
 
-The fingerprint is ``"<size>:<crc32 of the last 4 KiB>"`` — cheap (one
-tail read, no full-file hash), stable for immutable Parquet files (the
-footer lives in the tail, so a rewritten file re-fingerprints), and
-computed through whatever source wrapper the scan reads through, so a
-fault-injected test source fingerprints its *injected* view
-consistently.  The deliberate blind spot: an **in-place repair that
-preserves size and tail bytes** (restoring a mid-file region from a
-replica) keeps the old fingerprint, so stale quarantines replay onto
-the now-healthy file.  The loss is never silent — every replay lands in
-the :class:`~parquet_floor_tpu.format.file_read.SalvageReport` and as a
-``salvage.map_skip`` trace decision — but the remedy after an in-place
-repair is to delete (or rebuild) the sidecar.  Files repaired the
-normal way — rewritten through a writer — re-fingerprint, because the
-footer bytes move.
+Two fingerprint modes, chosen per map (``QuarantineMap(...,
+fingerprint=...)``, persisted in the sidecar so every scan of one map
+keys consistently; select the map itself via
+``ReaderOptions(quarantine_map=...)``):
+
+* ``"tail"`` (default): ``"<size>:<crc32 of the last 4 KiB>"`` — cheap
+  (one tail read, no full-file hash), stable for immutable Parquet
+  files (the footer lives in the tail, so a rewritten file
+  re-fingerprints).  The deliberate blind spot: an **in-place repair
+  that preserves size and tail bytes** (restoring a mid-file region
+  from a replica) keeps the old fingerprint, so stale quarantines
+  replay onto the now-healthy file.  The loss is never silent — every
+  replay lands in the
+  :class:`~parquet_floor_tpu.format.file_read.SalvageReport` and as a
+  ``salvage.map_skip`` trace decision — but the remedy after an
+  in-place repair is to delete (or rebuild) the sidecar.
+* ``"content"``: ``"<size>:c:<crc32 of the whole file>"`` — closes that
+  blind spot exactly: any byte changing anywhere re-fingerprints, so an
+  in-place mid-file repair misses the map and the clean decode
+  re-establishes the truth.  The price is one full sequential read per
+  file open — right for repair-prone local corpora, wrong for remote
+  stores (a full-object GET per open).
+
+Either way the fingerprint is computed through whatever source wrapper
+the scan reads through, so a fault-injected test source fingerprints
+its *injected* view consistently.  Files repaired the normal way —
+rewritten through a writer — re-fingerprint under both modes, because
+the footer bytes move.
 
 Thread-safety: ``record``/``lookup``/``save`` may be called from any
 thread (scan workers record concurrently); ``save`` writes atomically
@@ -54,15 +68,32 @@ from typing import Dict, List, Optional
 
 _VERSION = 1
 _TAIL_BYTES = 4096
+_CONTENT_CHUNK = 1 << 20
+_FINGERPRINT_MODES = ("tail", "content")
 
 
-def fingerprint(source) -> str:
-    """The map key for one positional source: ``"<size>:<crc32(tail)>"``.
+def fingerprint(source, mode: str = "tail") -> str:
+    """The map key for one positional source (module docstring):
+    ``"tail"`` → ``"<size>:<crc32(tail)>"``, ``"content"`` →
+    ``"<size>:c:<crc32(whole file)>"``.
 
-    Reads at most the last 4 KiB through the source itself (so wrappers
-    — retries, fault injection, prefetch caches — fingerprint the bytes
-    the scan actually sees)."""
+    Reads through the source itself (so wrappers — retries, fault
+    injection, prefetch caches — fingerprint the bytes the scan
+    actually sees); content mode streams in 1 MiB chunks, never
+    materializing the file."""
+    if mode not in _FINGERPRINT_MODES:
+        raise ValueError(
+            f"unknown fingerprint mode {mode!r} "
+            f"(choose from {_FINGERPRINT_MODES})"
+        )
     size = int(source.size)
+    if mode == "content":
+        crc = 0
+        for off in range(0, size, _CONTENT_CHUNK):
+            n = min(_CONTENT_CHUNK, size - off)
+            # crc32 takes any buffer: no bytes() copy on top of the read
+            crc = zlib.crc32(source.read_at(off, n), crc)
+        return f"{size}:c:{crc & 0xFFFFFFFF:08x}"
     n = min(_TAIL_BYTES, size)
     tail = bytes(source.read_at(size - n, n)) if n else b""
     return f"{size}:{zlib.crc32(tail) & 0xFFFFFFFF:08x}"
@@ -77,21 +108,31 @@ class QuarantineMap:
     (deduplicated on ``(row_group, column, page, kind)``).
     """
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None,
+                 fingerprint: str = "tail"):
+        if fingerprint not in _FINGERPRINT_MODES:
+            raise ValueError(
+                f"unknown fingerprint mode {fingerprint!r} "
+                f"(choose from {_FINGERPRINT_MODES})"
+            )
         self.path = os.fspath(path) if path is not None else None
+        self.fingerprint = fingerprint
         self._lock = threading.Lock()
         self._files: Dict[str, dict] = {}
 
     # -- persistence --------------------------------------------------------
 
     @classmethod
-    def open(cls, path) -> "QuarantineMap":
+    def open(cls, path, fingerprint: Optional[str] = None) -> "QuarantineMap":
         """Load the sidecar at ``path``, or start an empty map bound to
-        it when the file does not exist yet.  A sidecar that does not
+        it when the file does not exist yet (``fingerprint`` then picks
+        the new map's mode, default ``"tail"``).  An existing sidecar's
+        PERSISTED mode always applies — its keys were computed under it
+        — and an explicit conflicting ``fingerprint`` raises rather
+        than silently mis-keying every lookup.  A sidecar that does not
         parse raises ``ValueError`` — a corrupt *map* must never
         silently discard the quarantine history it was supposed to
         carry."""
-        m = cls(path)
         p = os.fspath(path)
         if os.path.exists(p):
             try:
@@ -108,8 +149,17 @@ class QuarantineMap:
                     f"quarantine map {p!r} has unknown version "
                     f"{data.get('version') if isinstance(data, dict) else data!r}"
                 )
+            stored = data.get("fingerprint") or "tail"
+            if fingerprint is not None and fingerprint != stored:
+                raise ValueError(
+                    f"quarantine map {p!r} was keyed with "
+                    f"fingerprint={stored!r}; reopening it as "
+                    f"{fingerprint!r} would mis-key every lookup"
+                )
+            m = cls(path, fingerprint=stored)
             m._files = data.get("files") or {}
-        return m
+            return m
+        return cls(path, fingerprint=fingerprint or "tail")
 
     def save(self, path: Optional[str] = None) -> str:
         """Write the map atomically (temp file + rename).  Returns the
@@ -119,7 +169,8 @@ class QuarantineMap:
             raise ValueError("QuarantineMap has no path; pass one to save()")
         with self._lock:
             payload = json.dumps(
-                {"version": _VERSION, "files": self._files},
+                {"version": _VERSION, "fingerprint": self.fingerprint,
+                 "files": self._files},
                 sort_keys=True, indent=1,
             )
         tmp = f"{p}.tmp.{os.getpid()}"
@@ -186,6 +237,12 @@ class QuarantineMap:
                     "kind": s.kind,
                     "rows": s.rows,
                     "row_span": list(s.row_span) if s.row_span else None,
+                    # page-tier entries carry their byte span so a replay
+                    # can skip the page's BYTES, not just its decode
+                    "byte_span": (
+                        list(s.byte_span)
+                        if getattr(s, "byte_span", None) else None
+                    ),
                     "error": s.error,
                 })
                 added += 1

@@ -56,7 +56,7 @@ from ..format.file_read import (
     ReaderOptions,
     SalvageReport,
 )
-from ..io.source import FileSource, RetryingSource
+from ..io.source import FileSource
 from ..utils import trace
 from .plan import Extent, FilePlan, GroupPlan, ScanOptions, plan_file
 
@@ -188,6 +188,14 @@ class _ByteBudget:
         self._tracer = tracer
         self.high_water = 0
 
+    def set_cap(self, cap: int) -> None:
+        """Retune the ceiling (the latency-adaptive controller's knob).
+        Already-admitted bytes are never evicted — a cap cut only
+        gates FUTURE admissions, so the bound stays an admission-time
+        invariant."""
+        with self._lock:
+            self._cap = int(cap)
+
     def _admit_locked(self, n: int) -> None:
         self._used += n
         if self._used > self.high_water:
@@ -213,6 +221,107 @@ class _ByteBudget:
     def release(self, n: int) -> None:
         with self._lock:
             self._used -= n
+
+
+class _AdaptiveController:
+    """Latency-adaptive prefetch (``ScanOptions.adaptive_prefetch``,
+    docs/remote.md): sizes the in-flight byte budget — and the device
+    pipeline's depth — from the MEASURED per-extent RTT instead of a
+    static knob.
+
+    Model: keep roughly ``threads * clamp(rtt / 2ms, 2, 16)`` units in
+    flight — enough concurrent rounds to cover the RTT at a ~2 ms/unit
+    consumption pace — so the byte cap is that unit count times the
+    EWMA unit cost, clamped to ``[min_cap, base_cap]`` (the configured
+    ``prefetch_bytes`` is the ceiling).  A warm local SSD (RTT « 2 ms)
+    bottoms out at factor 2 and stays shallow; a 20–50 ms object store
+    saturates toward the ceiling.  Every retune is observable: the
+    chosen cap rides the ``scan.adaptive_budget_bytes`` gauge, and a
+    >1.5x move records a ``scan.adaptive_budget`` decision.
+
+    ``observe`` is called from worker threads (lock-protected EWMAs);
+    ``cap()``/``depth_hint()`` from the consumer thread."""
+
+    RTT_UNIT_S = 0.002           # the "service pace" an RTT is scored against
+    MIN_FACTOR, MAX_FACTOR = 2, 16
+
+    def __init__(self, base_cap: int, threads: int,
+                 tracer: Optional[trace.Tracer] = None,
+                 min_cap: int = 1 << 20):
+        self._base = int(base_cap)
+        self._threads = int(threads)
+        self._tracer = tracer
+        self._min = min(int(min_cap), self._base)
+        self._lock = threading.Lock()
+        self._rtt: Optional[float] = None    # EWMA per-load wall seconds
+        self._cost: Optional[float] = None   # EWMA admitted unit cost
+        self._last_logged: Optional[int] = None
+
+    def observe_load(self, nbytes: int, seconds: float) -> None:
+        """One extent-load measurement (worker thread): the load's wall
+        time is the RTT sample (transfer included — a conservative
+        overestimate that only ever deepens the pipeline)."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._rtt = (
+                seconds if self._rtt is None
+                else 0.7 * self._rtt + 0.3 * seconds
+            )
+
+    def observe_cost(self, cost: int) -> None:
+        """One admitted unit's budget charge (consumer thread)."""
+        with self._lock:
+            self._cost = (
+                float(cost) if self._cost is None
+                else 0.7 * self._cost + 0.3 * float(cost)
+            )
+
+    def rtt_s(self) -> Optional[float]:
+        with self._lock:
+            return self._rtt
+
+    def cap(self) -> int:
+        """The current effective budget cap."""
+        with self._lock:
+            rtt, cost = self._rtt, self._cost
+        if rtt is None or cost is None:
+            # no measurements yet: start shallow — the first loads are
+            # the probe, and ramping up costs one scheduling round
+            cap = max(self._min, self._base // 8)
+        else:
+            factor = min(self.MAX_FACTOR,
+                         max(self.MIN_FACTOR, rtt / self.RTT_UNIT_S))
+            cap = int(min(self._base,
+                          max(self._min, cost * self._threads * factor)))
+        tr = self._tracer or trace.current()
+        tr.gauge_max("scan.adaptive_budget_bytes", cap)
+        last = self._last_logged
+        if last is None or cap > last * 1.5 or cap * 1.5 < last:
+            self._last_logged = cap
+            tr.decision("scan.adaptive_budget", {
+                "cap_bytes": cap,
+                "rtt_ms": None if rtt is None else round(rtt * 1e3, 3),
+                "unit_cost": None if cost is None else int(cost),
+                "threads": self._threads,
+            })
+        return cap
+
+    def depth_hint(self, default: int = 3, floor_s: float = 0.002,
+                   cap: int = 8) -> Optional[int]:
+        """The device pipeline's adaptive depth: one extra stage per
+        ~10 ms of measured RTT over ``default``, capped at ``cap``
+        (each level pins a host arena).  None (= keep the default)
+        until an RTT is measured, or when the store is effectively
+        local."""
+        rtt = self.rtt_s()
+        if rtt is None or rtt < floor_s:
+            return None
+        hint = min(cap, default + int(rtt // 0.01))
+        (self._tracer or trace.current()).decision("scan.adaptive_depth", {
+            "depth": hint, "rtt_ms": round(rtt * 1e3, 3),
+        })
+        return hint
 
 
 class ScanUnit(NamedTuple):
@@ -251,14 +360,22 @@ def _source_chain(source, options: Optional[ReaderOptions]) -> PrefetchedSource:
     double-wrap guard keeps meaning one bounded retry loop per physical
     read.  A zero-arg callable source is a FACTORY (resolved here, at
     open time — how multi-epoch loaders re-open custom source objects
-    lazily)."""
+    lazily).
+
+    Remote sources (``io.remote``, marked ``parallel_read_many``) keep
+    their vectored fan-out ABOVE the retry layer: ``RetryingSource``
+    retries one range at a time, so wrapping a remote source directly
+    would serialize a vectored extent read — the ``ParallelRangeReader``
+    adapter re-parallelizes it while every range keeps its own full
+    retry/deadline budget (docs/remote.md's chain)."""
     if callable(source) and not hasattr(source, "read_at"):
         source = source()
     src = source if hasattr(source, "read_at") else FileSource(source)
     try:
-        if options is not None and options.io_retries > 0 and \
-                not isinstance(src, RetryingSource):
-            src = RetryingSource(
+        if options is not None and options.io_retries > 0:
+            from ..io.remote import compose_retrying
+
+            src = compose_retrying(
                 src, options.io_retries, options.io_retry_backoff_s,
                 deadline_s=options.io_retry_deadline_s,
             )
@@ -350,6 +467,14 @@ class DatasetScanner:
         self._t0: Optional[float] = None     # first __next__ → close
         self._wall: Optional[float] = None
         self._budget = _ByteBudget(self._scan.prefetch_bytes, self._tracer)
+        self._adaptive = (
+            _AdaptiveController(
+                self._scan.prefetch_bytes, self._scan.threads, self._tracer
+            )
+            if self._scan.adaptive_prefetch else None
+        )
+        if self._adaptive is not None:
+            self._budget.set_cap(self._adaptive.cap())
         self._pool = ThreadPoolExecutor(
             max_workers=self._scan.threads, thread_name_prefix="pftpu-scan"
         )
@@ -503,9 +628,14 @@ class DatasetScanner:
             "path": state.cache.name,
         }
         try:
+            t0 = time.perf_counter()
             with trace.span("read", attrs=attrs) as sp:
                 loaded = state.cache.load(work.plan.extents)
                 sp.add_bytes(loaded)
+            if self._adaptive is not None and loaded:
+                self._adaptive.observe_load(
+                    loaded, time.perf_counter() - t0
+                )
             trace.count("scan.bytes_prefetched", loaded)
             with trace.span(
                 "decode", work.plan.uncompressed_bytes, attrs=attrs
@@ -535,6 +665,10 @@ class DatasetScanner:
     def _top_up(self) -> None:
         if self._deferred is not None:
             return  # planning already failed: deliver what we have, then raise
+        if self._adaptive is not None:
+            # consumer-thread retune: admissions below see the cap the
+            # latest RTT/cost measurements justify
+            self._budget.set_cap(self._adaptive.cap())
         max_units = max(2, self._scan.threads * 2)
         while len(self._pending) < max_units:
             try:
@@ -558,6 +692,10 @@ class DatasetScanner:
                 # budget is empty — force-admit (oversized groups run
                 # alone; the bound stays exact for everything else)
                 self._budget.admit(work.cost)
+            if self._adaptive is not None:
+                # admitted exactly once — a budget refusal above must
+                # not double-count this unit's cost in the EWMA
+                self._adaptive.observe_cost(work.cost)
             # bind the task to the scan's tracer scope: contextvars do
             # not cross thread-pool submission on their own
             self._pending.append((
@@ -726,6 +864,12 @@ def scan_device_groups(sources: Sequence,
     tracer = trace.current()
     t_start = time.perf_counter()
     budget = _ByteBudget(sc.prefetch_bytes, tracer)
+    adaptive = (
+        _AdaptiveController(sc.prefetch_bytes, sc.threads, tracer)
+        if sc.adaptive_prefetch else None
+    )
+    if adaptive is not None:
+        budget.set_cap(adaptive.cap())
     salvage = options is not None and options.salvage
     readers: List[TpuRowGroupReader] = []   # open order == file order
     units: List[tuple] = []          # (file_index, GroupPlan, cache, cost)
@@ -768,7 +912,17 @@ def scan_device_groups(sources: Sequence,
         )
         fplan = plan_file(fr, set(columns) if columns else None, keep, sc)
         if fplan.index_extents:
-            cache.load(fplan.index_extents)
+            t0 = time.perf_counter()
+            loaded = cache.load(fplan.index_extents)
+            if adaptive is not None and loaded:
+                adaptive.observe_load(loaded, time.perf_counter() - t0)
+        elif adaptive is not None and adaptive.rtt_s() is None:
+            # no index extents to time: probe the store once with a
+            # tail read (~pure RTT) so the depth hint below has a
+            # measurement to work from
+            t0 = time.perf_counter()
+            cache.read_at(max(0, cache.size - 8), min(8, cache.size))
+            adaptive.observe_load(8, time.perf_counter() - t0)
         files[fi] = (tpu, cache, fplan)
         for gp in fplan.groups:
             units.append((fi, gp, cache, max(gp.read_bytes, 1)))
@@ -794,12 +948,15 @@ def scan_device_groups(sources: Sequence,
         """Prefetch one group's extents (worker thread, scope-bound):
         the read span carries the (file, row group) attribution the
         timeline needs to show prefetch hiding the I/O."""
+        t0 = time.perf_counter()
         with trace.span("read", attrs={
             "file": fi_, "row_group": gp.group_index, "path": cache_.name,
             "extents": len(gp.extents),
         }) as sp:
             n = cache_.load(gp.extents)
             sp.add_bytes(n)
+        if adaptive is not None and n:
+            adaptive.observe_load(n, time.perf_counter() - t0)
         trace.count("scan.bytes_prefetched", n)
         return n
 
@@ -814,6 +971,8 @@ def scan_device_groups(sources: Sequence,
             # budget lag left these behind and the engine already
             # read them directly — never prefetch a consumed group
             next_load = floor
+        if adaptive is not None:
+            budget.set_cap(adaptive.cap())
         while len(loads) < WINDOW:
             if next_load >= len(units):
                 # discover more units only while the load window has
@@ -826,6 +985,10 @@ def scan_device_groups(sources: Sequence,
                 return
             if not loads:
                 budget.admit(cost)  # queue empty ⇒ budget empty
+            if adaptive is not None:
+                # admitted exactly once — a refusal must not
+                # double-count this unit's cost in the EWMA
+                adaptive.observe_cost(cost)
             loads.append((next_load, cost, pool.submit(
                 tracer.run, load_unit, cache_, gp, fi_
             )))
@@ -866,7 +1029,12 @@ def scan_device_groups(sources: Sequence,
                     sel_names.append(n)
                     desc_by[n] = c
         pump()
-        groups = iter_dataset_row_groups(tasks(), columns=columns)
+        depth_hint = (
+            adaptive.depth_hint() if adaptive is not None else None
+        )
+        groups = iter_dataset_row_groups(
+            tasks(), columns=columns, depth_hint=depth_hint
+        )
         i = 0
         while True:
             t0 = time.perf_counter()

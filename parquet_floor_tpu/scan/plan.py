@@ -45,12 +45,21 @@ class ScanOptions:
       group larger than the whole budget is admitted only when it is
       alone in flight.
     * ``threads`` — worker threads reading extents and decoding groups.
+    * ``adaptive_prefetch`` — latency-adaptive budget/depth
+      (docs/remote.md): ``prefetch_bytes`` becomes a CEILING, and the
+      effective in-flight budget is sized from the measured per-extent
+      RTT — a 50 ms object store earns deep pipelining, a warm local
+      SSD stays shallow instead of pinning tens of MB it cannot use.
+      The device scan face additionally derives its pipeline depth
+      (``PFTPU_PREFETCH_DEPTH``'s default) from the same measurements;
+      an explicit env override still wins.
     """
 
     max_gap_bytes: int = 64 << 10
     max_extent_bytes: int = 8 << 20
     prefetch_bytes: int = 64 << 20
     threads: int = 4
+    adaptive_prefetch: bool = False
 
     def __post_init__(self):
         if self.max_gap_bytes < 0:

@@ -37,8 +37,19 @@ import numpy as np
 
 from ..errors import TruncatedFileError
 from ..io.source import FileSource, RetryingSource  # noqa: F401  (re-export)
+from .remote import (  # noqa: F401  (re-export)
+    RemoteProfile,
+    SimulatedRemoteSource,
+    SimulatedRemoteTransport,
+)
 
-__all__ = ["FaultInjectingSource", "RetryingSource"]
+__all__ = [
+    "FaultInjectingSource",
+    "RetryingSource",
+    "RemoteProfile",
+    "SimulatedRemoteSource",
+    "SimulatedRemoteTransport",
+]
 
 
 class FaultInjectingSource:

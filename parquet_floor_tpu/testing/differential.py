@@ -251,6 +251,32 @@ def run_sequential(paths, opts: ReaderOptions) -> FaceResult:
     return res
 
 
+def run_ranged(paths, opts: ReaderOptions,
+               request: Tuple[int, int] = (10, 60)) -> FaceResult:
+    """The RANGED face (``read_row_group_ranges``): every group is
+    requested through a partial row range.  Under salvage the ranged
+    path delegates to the whole-group salvage decode (quarantine
+    decisions are group-wide facts — see ``file_read``), so its
+    quarantine set and surviving bytes must equal the sequential
+    face's EXACTLY; this face pins that delegation contract against
+    regressions."""
+    res = FaceResult()
+    keys = set()
+    try:
+        for fi, p in enumerate(paths):
+            with ParquetFileReader(p, options=opts) as r:
+                for gi in range(len(r.row_groups)):
+                    batch, _covered = r.read_row_group_ranges(
+                        gi, [request]
+                    )
+                    res.groups[(fi, gi)] = _canon_host_group(batch)
+                keys |= set(_quarantine_keys(fi, r.salvage_report))
+    except ParquetError as e:
+        return FaceResult(fatal=type(e).__name__)
+    res.quarantine = frozenset(keys)
+    return res
+
+
 def run_host_scan(paths, opts: ReaderOptions) -> FaceResult:
     from ..scan import DatasetScanner
 

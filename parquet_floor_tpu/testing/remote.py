@@ -1,0 +1,255 @@
+"""Seeded remote-storage simulator — every object-store failure mode,
+deterministically, in CI.
+
+:class:`SimulatedRemoteSource` is a full :class:`io.remote.RemoteSource`
+(hedging, circuit breaker, classification — the real code paths) over a
+:class:`SimulatedRemoteTransport` that models the network:
+
+* **per-request latency**: ``base + uniform(jitter)`` plus, with
+  probability ``tail_p``, a heavy-tail excursion in ``[tail_latency_s,
+  3*tail_latency_s]`` — the straggler distribution hedged reads exist
+  for;
+* **bandwidth cap**: ``length / bandwidth_bytes_per_s`` added per
+  request;
+* **throttling windows**: a token bucket (``throttle_rps`` refill,
+  ``throttle_burst`` capacity) — an over-rate request raises
+  :class:`~parquet_floor_tpu.errors.RemoteThrottledError` with the
+  bucket's real ``retry_after_s``;
+* **injected faults**: a seeded per-request transient ``OSError``
+  probability (``fault_rate``), plus an ``outage_s`` window — every
+  request in the first ``outage_s`` seconds after the transport's first
+  request fails transient, the deterministic way to trip the circuit
+  breaker and prove retry recovery.
+
+Determinism contract (the CI promise): the random draws — latency,
+tail, fault — are KEYED, not sequential: each is derived from ``(seed,
+offset, length, k)`` where ``k`` counts the requests for that exact
+range that REACHED the latency/fault model (0 = first modeled attempt,
+1 = the hedge or first retry, …).  Thread scheduling therefore cannot
+change which ranges are slow or which fail: two runs over the same scan
+see the same tail set and the same fault set, whatever order the pool
+issued requests in.  Only the wall-clock features (the outage window,
+the throttle bucket) depend on real time — their refusals do NOT
+consume ordinals (a throttled attempt re-draws with the same ``k`` on
+retry), so timing can only change when a request is refused, never
+which modeled attempts fault or what bytes come back.
+
+Scripted overrides pin exact scenarios (the hedging/breaker edge-case
+tests): ``latency_overrides[(offset, k)] = seconds`` replaces the drawn
+latency, ``fault_overrides[(offset, k)] = exc_or_message`` raises after
+the latency elapses (a slow THEN failed request, like real timeouts).
+
+Example::
+
+    from parquet_floor_tpu.testing import SimulatedRemoteSource, RemoteProfile
+
+    src = SimulatedRemoteSource(
+        "data.parquet", seed=7,
+        profile=RemoteProfile(base_latency_s=0.02, jitter_s=0.002,
+                              tail_p=0.1, tail_latency_s=0.08,
+                              fault_rate=0.05),
+    )
+    with ParquetFileReader(src, options=ReaderOptions(io_retries=4)) as r:
+        batch = r.read_row_group(0)   # survives the simulated store
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple, Union
+
+import numpy as np
+
+from ..errors import RemoteThrottledError
+from ..io.remote import RemoteSource
+from ..io.source import FileSource
+
+
+@dataclass(frozen=True)
+class RemoteProfile:
+    """One remote store's behavior model (module docstring).  All-zero
+    defaults are a perfect store — add pathologies per test/bench leg."""
+
+    base_latency_s: float = 0.0
+    jitter_s: float = 0.0
+    tail_p: float = 0.0
+    tail_latency_s: float = 0.0
+    bandwidth_bytes_per_s: Optional[float] = None
+    fault_rate: float = 0.0
+    outage_s: float = 0.0
+    throttle_rps: Optional[float] = None
+    throttle_burst: int = 8
+
+    def __post_init__(self):
+        for name in ("base_latency_s", "jitter_s", "tail_p",
+                     "tail_latency_s", "fault_rate", "outage_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0")
+        if self.tail_p > 1 or self.fault_rate > 1:
+            raise ValueError("tail_p / fault_rate are probabilities (<= 1)")
+        if self.bandwidth_bytes_per_s is not None \
+                and self.bandwidth_bytes_per_s <= 0:
+            raise ValueError("bandwidth_bytes_per_s must be > 0 (or None)")
+        if self.throttle_rps is not None and self.throttle_rps <= 0:
+            raise ValueError("throttle_rps must be > 0 (or None)")
+        if self.throttle_burst < 1:
+            raise ValueError("throttle_burst must be >= 1")
+
+
+class SimulatedRemoteTransport:
+    """The transport half of the simulator: one ranged GET over a local
+    source, with the profile's latency/fault model applied (module
+    docstring).  Thread-safe; counters (``requests``, ``faults``,
+    ``throttles``, ``bytes_served``, ``tail_requests``) are for test
+    assertions."""
+
+    def __init__(self, source, profile: RemoteProfile = RemoteProfile(),
+                 seed: int = 0,
+                 latency_overrides: Optional[Dict[Tuple[int, int], float]] = None,
+                 fault_overrides: Optional[
+                     Dict[Tuple[int, int], Union[BaseException, str]]
+                 ] = None,
+                 sleep=time.sleep, clock=time.monotonic):
+        self._inner = (
+            source if hasattr(source, "read_at") else FileSource(source)
+        )
+        self.profile = profile
+        self.seed = int(seed)
+        self._latency_overrides = dict(latency_overrides or {})
+        self._fault_overrides = dict(fault_overrides or {})
+        self._sleep = sleep
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ordinal: Dict[Tuple[int, int], int] = {}  # (offset, length) -> k
+        self._first_request_at: Optional[float] = None
+        self._tokens = float(profile.throttle_burst)
+        self._tokens_at: Optional[float] = None
+        self.requests = 0
+        self.faults = 0
+        self.throttles = 0
+        self.tail_requests = 0
+        self.bytes_served = 0
+
+    @property
+    def name(self) -> str:
+        return f"simulated-remote({self._inner.name})"
+
+    @property
+    def size(self) -> int:
+        return self._inner.size
+
+    def _admit(self, offset: int, length: int):
+        """Book-keeping under the lock: the outage window, the throttle
+        bucket, and — only for requests that reach the latency/fault
+        model — the per-range ordinal.  Returns ``(k, outage,
+        retry_after)``; ``k`` is None when the request was refused.
+        Refused attempts must NOT consume ordinals: whether an attempt
+        hits the outage window or the token bucket is wall-clock
+        dependent, and letting it shift the keyed draws would break the
+        determinism contract (the same range would fault on one run and
+        not the other)."""
+        now = self._clock()
+        with self._lock:
+            self.requests += 1
+            if self._first_request_at is None:
+                self._first_request_at = now
+            if (
+                self.profile.outage_s > 0
+                and now - self._first_request_at < self.profile.outage_s
+            ):
+                return None, True, None
+            rps = self.profile.throttle_rps
+            if rps is not None:
+                if self._tokens_at is not None:
+                    self._tokens = min(
+                        float(self.profile.throttle_burst),
+                        self._tokens + (now - self._tokens_at) * rps,
+                    )
+                self._tokens_at = now
+                if self._tokens < 1.0:
+                    self.throttles += 1
+                    return None, False, (1.0 - self._tokens) / rps
+                self._tokens -= 1.0
+            key = (int(offset), int(length))
+            k = self._ordinal.get(key, 0)
+            self._ordinal[key] = k + 1
+            return k, False, None
+
+    def get_range(self, offset: int, length: int) -> bytes:
+        k, outage, retry_after = self._admit(offset, length)
+        if outage:
+            with self._lock:
+                self.faults += 1
+            raise OSError(
+                f"simulated outage: request for "
+                f"[{offset}, {offset + length}) refused"
+            )
+        if retry_after is not None:
+            raise RemoteThrottledError(
+                f"simulated throttle: over {self.profile.throttle_rps} rps",
+                retry_after_s=retry_after, path=self.name, offset=offset,
+            )
+        p = self.profile
+        # keyed draws: (seed, offset, length, k) — thread scheduling can
+        # never change which ranges are slow or which fail
+        rng = np.random.default_rng(
+            [self.seed, int(offset), int(length), int(k)]
+        )
+        lat = p.base_latency_s + p.jitter_s * float(rng.random())
+        is_tail = p.tail_p > 0 and float(rng.random()) < p.tail_p
+        if is_tail:
+            lat += p.tail_latency_s * (1.0 + 2.0 * float(rng.random()))
+            with self._lock:
+                self.tail_requests += 1
+        if p.bandwidth_bytes_per_s:
+            lat += length / p.bandwidth_bytes_per_s
+        fault: Union[BaseException, str, None] = None
+        if (int(offset), k) in self._fault_overrides:
+            fault = self._fault_overrides[(int(offset), k)]
+        elif p.fault_rate > 0 and float(rng.random()) < p.fault_rate:
+            fault = (
+                f"simulated transient fault (offset={offset}, attempt={k})"
+            )
+        lat = self._latency_overrides.get((int(offset), k), lat)
+        if lat > 0:
+            self._sleep(lat)
+        if fault is not None:
+            with self._lock:
+                self.faults += 1
+            if isinstance(fault, BaseException):
+                raise fault
+            raise OSError(fault)
+        data = bytes(self._inner.read_at(offset, length))
+        with self._lock:
+            self.bytes_served += length
+        return data
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+class SimulatedRemoteSource(RemoteSource):
+    """A :class:`~parquet_floor_tpu.io.remote.RemoteSource` over a
+    :class:`SimulatedRemoteTransport` — the one-liner the tests, the
+    bench's cold-storage leg, and the CI remote smoke construct.  The
+    transport is exposed as ``self.transport`` for fault/latency
+    assertions; every ``RemoteSource`` knob (hedging, breaker, deadline)
+    passes through as keyword arguments."""
+
+    def __init__(self, source, *, profile: RemoteProfile = RemoteProfile(),
+                 seed: int = 0, latency_overrides=None, fault_overrides=None,
+                 sleep=time.sleep, clock=time.monotonic, **remote_kwargs):
+        transport = SimulatedRemoteTransport(
+            source, profile, seed,
+            latency_overrides=latency_overrides,
+            fault_overrides=fault_overrides,
+            sleep=sleep, clock=clock,
+        )
+        try:
+            super().__init__(transport, clock=clock, **remote_kwargs)
+        except BaseException:
+            transport.close()
+            raise
+        self.transport = transport

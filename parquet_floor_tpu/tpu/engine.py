@@ -2757,7 +2757,8 @@ class TpuRowGroupReader:
 # ---------------------------------------------------------------------------
 
 def iter_dataset_row_groups(tasks, columns: Optional[Sequence[str]] = None,
-                            prefetch: bool = True):
+                            prefetch: bool = True,
+                            depth_hint: Optional[int] = None):
     """Decode ``(reader, group_index)`` pairs in order, with the 3-stage
     stage‖ship‖decode pipeline running ACROSS reader (file) boundaries.
 
@@ -2794,6 +2795,13 @@ def iter_dataset_row_groups(tasks, columns: Optional[Sequence[str]] = None,
     generator finishes, errors, or is abandoned are closed.  Delivery
     order and decoded bytes are identical to the eager (list) path over
     the same task sequence.
+
+    ``depth_hint`` (iterator form only) retunes the pipeline's DEFAULT
+    depth — the latency-adaptive scan scheduler passes the depth the
+    measured store RTT justifies (``ScanOptions.adaptive_prefetch``,
+    docs/remote.md).  An explicit ``PFTPU_PREFETCH_DEPTH`` env override
+    still wins; depth never affects delivery order or bytes, only how
+    far ahead the stage worker runs.
     """
     if isinstance(tasks, (list, tuple)):
         tasks = list(tasks)
@@ -2811,7 +2819,10 @@ def iter_dataset_row_groups(tasks, columns: Optional[Sequence[str]] = None,
             default_depth="3" if multi_file else "2",
         )
         return
-    yield from _iter_pipeline_stream(iter(tasks), columns, prefetch)
+    yield from _iter_pipeline_stream(
+        iter(tasks), columns, prefetch,
+        default_depth="3" if depth_hint is None else str(int(depth_hint)),
+    )
 
 
 def _iter_pipeline_stream(task_iter, columns, prefetch: bool,

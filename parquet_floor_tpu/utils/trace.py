@@ -69,6 +69,17 @@ class names:
         "scan.cache_miss_bytes",
         "io.retries",
         "io.retry_exhausted",
+        # the remote-storage failure domain (io/remote.py, docs/remote.md)
+        "io.remote.requests",
+        "io.remote.bytes",
+        "io.remote.faults",
+        "io.remote.throttles",
+        "io.remote.deadlines",
+        "io.remote.hedges",
+        "io.remote.hedge_wins",
+        "io.remote.hedges_cancelled",
+        "io.remote.breaker_trips",
+        "io.remote.breaker_fast_fails",
         "salvage.pages_skipped",
         "salvage.chunks_quarantined",
         "salvage.rows_quarantined",
@@ -88,6 +99,7 @@ class names:
     GAUGES = frozenset({
         "scan.inflight_bytes_max",
         "scan.queue_depth_max",
+        "scan.adaptive_budget_bytes",
         "data.carry_rows_max",
     })
     DECISIONS = frozenset({
@@ -96,6 +108,8 @@ class names:
         "io.retry",
         "io.retry_exhausted",
         "io.retry_deadline_exceeded",
+        "io.hedge",
+        "io.breaker",
         "salvage.report",
         "salvage.skip_page",
         "salvage.quarantine_chunk",
@@ -104,6 +118,8 @@ class names:
         "salvage.map_skip",
         "salvage.device_host_decode",
         "scan.plan",
+        "scan.adaptive_budget",
+        "scan.adaptive_depth",
         "data.epoch_plan",
         "data.resume",
         "data.unit_quarantined",
@@ -115,6 +131,7 @@ class names:
         "decode",
         "assemble",
         "io.read",
+        "io.remote.get",
         "scan.consumer_stall",
         "data.next_batch",
     })

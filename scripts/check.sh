@@ -36,6 +36,15 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu PFTPU_TRACE=1 PFTPU_BENCH_ROWS=2000 \
 [ "${PIPESTATUS[0]}" -eq 0 ] || exit 1
 python scripts/check_bench_report.py "$bench_log" "$bench_trace" || exit 1
 
+# Remote-scan smoke (docs/remote.md): the seeded latency/fault
+# simulator at a 20 ms RTT — asserts the scheduled scan actually
+# overlaps (overlap_fraction floor), then a fault-heavy pass (outage +
+# heavy tail + throttling + seeded drops) completes bit-identical with
+# retry/hedge/breaker counters all exercised and registered.
+echo "== remote scan smoke (simulator, faults on) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/remote_scan_smoke.py || exit 1
+
 # Salvage differential smoke: 60 seeded corruption cases through ALL
 # FOUR read faces (sequential host, host scan, device scan, loader),
 # asserting unanimous fatality, identical quarantine sets, identical

@@ -56,7 +56,57 @@ def check_report(bench_log: pathlib.Path) -> int:
         return fail("scan_report.stages is empty")
     print(f"check_bench_report: scan_report ok ({len(rep['stages'])} stages, "
           f"{rep['bytes_read']} bytes read)")
-    return check_loader_leg(result.get("detail", {}))
+    return (
+        check_remote_leg(result.get("detail", {}))
+        or check_loader_leg(result.get("detail", {}))
+    )
+
+
+def check_remote_leg(detail: dict) -> int:
+    """The cold-storage truth bench (docs/remote.md): on the simulated
+    20 ms-RTT store the scheduled scan's overlap_fraction must clear
+    0.5 while the sequential per-file loop stays under 0.1 — the
+    assertion docs/scan.md promised once real latency made the overlap
+    visible.  The fault-heavy pass must be bit-identical to the clean
+    one with hedge/retry/breaker/throttle counters all exercised, and
+    every counter it emitted must be registered in ``trace.names``."""
+    overlap = detail.get("remote_overlap_fraction")
+    seq = detail.get("remote_seq_overlap_fraction")
+    if overlap is None or seq is None:
+        return fail("remote leg missing overlap fractions")
+    if not overlap >= 0.5:
+        return fail(f"remote scan overlap_fraction {overlap} < 0.5 on the "
+                    f"{detail.get('remote_rtt_ms')} ms-RTT store")
+    if not seq < 0.1:
+        return fail(f"remote sequential overlap_fraction {seq} >= 0.1 — "
+                    "the baseline should be I/O-bound")
+    if detail.get("remote_seq_bit_identical") is not True:
+        return fail("remote scheduled scan is not bit-identical to the "
+                    "sequential loop")
+    if detail.get("remote_fault_bit_identical") is not True:
+        return fail("fault-heavy remote scan diverged from the clean run")
+    for counter in ("remote_hedges", "remote_retries",
+                    "remote_breaker_trips", "remote_throttles"):
+        if not detail.get(counter, 0) >= 1:
+            return fail(f"fault-heavy remote scan never exercised {counter}")
+    fault_rep = detail.get("remote_fault_scan_report") or {}
+    emitted = set(fault_rep.get("counters") or {})
+    emitted |= set(fault_rep.get("gauges") or {})
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from parquet_floor_tpu.utils.trace import names
+
+    unregistered = emitted - names.ALL
+    if unregistered:
+        return fail(f"remote counters not in trace.names: "
+                    f"{sorted(unregistered)}")
+    print(
+        "check_bench_report: remote leg ok "
+        f"(overlap {overlap} vs sequential {seq}; "
+        f"hedges={detail['remote_hedges']} retries={detail['remote_retries']} "
+        f"breaker_trips={detail['remote_breaker_trips']} "
+        f"throttles={detail['remote_throttles']})"
+    )
+    return 0
 
 
 def check_loader_leg(detail: dict) -> int:

@@ -1,0 +1,27 @@
+"""Seeded-bad: the remote session/pool leak shapes — a RemoteSource (or
+simulator) whose fetch pool and transport outlive an exception between
+acquisition and close, and a ParallelRangeReader abandoned mid-read."""
+
+from parquet_floor_tpu.io.remote import ParallelRangeReader, RemoteSource
+from parquet_floor_tpu.testing import SimulatedRemoteSource
+
+
+def fetch_footer(transport):
+    src = RemoteSource(transport)
+    tail = src.read_at(src.size - 8, 8)  # a raise here leaks the pool
+    src.close()
+    return tail
+
+
+def simulate(path, profile):
+    sim = SimulatedRemoteSource(path, profile=profile)
+    data = sim.read_at(0, 16)  # any raise leaks pool + transport
+    sim.close()
+    return data
+
+
+def fan_out(inner, ranges):
+    reader = ParallelRangeReader(inner)
+    out = reader.read_many(ranges)  # a range error leaks the fan-out pool
+    reader.close()
+    return out

@@ -1,0 +1,34 @@
+"""Clean: remote sessions/pools are with-managed, released in a
+finally, transferred into an owner, or returned through a factory
+lambda (the scan scheduler's lazy-open protocol — the caller that
+resolves the factory owns the close)."""
+
+from parquet_floor_tpu.io.remote import ParallelRangeReader, RemoteSource
+from parquet_floor_tpu.testing import SimulatedRemoteSource
+
+
+def fetch_footer(transport):
+    with RemoteSource(transport) as src:
+        return src.read_at(src.size - 8, 8)
+
+
+def simulate(path, profile):
+    sim = SimulatedRemoteSource(path, profile=profile)
+    try:
+        return sim.read_at(0, 16)
+    finally:
+        sim.close()
+
+
+def fan_out(inner, ranges):
+    with ParallelRangeReader(inner) as reader:
+        return reader.read_many(ranges)
+
+
+def dataset_factories(paths, profile):
+    # ownership transfer: each factory's RemoteSource is opened — and
+    # closed — by the scan executor that calls it
+    return [
+        (lambda p=p: SimulatedRemoteSource(p, profile=profile))
+        for p in paths
+    ]

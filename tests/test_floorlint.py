@@ -30,6 +30,7 @@ CASES = [
     ("tpu002", "FL-TPU002"),
     ("res001", "FL-RES001"),
     ("res001_tpe", "FL-RES001"),  # executor/scan-handle shapes of the rule
+    ("res001_remote", "FL-RES001"),  # remote session/pool + factory shapes
     ("alloc001", "FL-ALLOC001"),
     ("obs001", "FL-OBS001"),
 ]

@@ -288,3 +288,198 @@ def test_in_place_repair_caveat_is_reported_not_silent(salvage_file,
     assert _skip_keys(rep) == [(0, "d", None, "chunk")]  # replayed, visible
     assert all(c.descriptor.path != ("d",)
                for c in groups[0].columns)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7 satellites: page-tier replay without I/O + content fingerprint
+# ---------------------------------------------------------------------------
+
+class _RangeRecordingSource:
+    """FileSource wrapper recording every byte range actually read —
+    how the no-I/O replay test proves the known-bad page's bytes were
+    never fetched."""
+
+    def __init__(self, path):
+        self._inner = FileSource(path)
+        self.ranges = []
+
+    @property
+    def name(self):
+        return self._inner.name
+
+    @property
+    def size(self):
+        return self._inner.size
+
+    def read_at(self, offset, length):
+        self.ranges.append((offset, length))
+        return self._inner.read_at(offset, length)
+
+    def read_many(self, ranges):
+        ranges = list(ranges)
+        self.ranges.extend(ranges)
+        return [self._inner.read_at(o, n) for o, n in ranges]
+
+    def close(self):
+        self._inner.close()
+
+
+def test_page_tier_replay_skips_the_bytes(salvage_file, tmp_path):
+    """Page-tier entries skip reading the damaged page's BYTES, like the
+    chunk tier always did: the recorded byte span is excluded from the
+    chunk read (vectored complement), the replayed records — byte span
+    included — are identical to the fresh scan's, and the skip is
+    accounted (``salvage.map_skips`` counter + decision)."""
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "map_noio")
+    sidecar = tmp_path / "noio.quarantine.json"
+
+    qmap = QuarantineMap.open(sidecar)
+    groups1, rep1 = _decode_all(
+        bad, verify_crc=True, salvage=True, quarantine_map=qmap
+    )
+    assert [s.kind for s in rep1.skips] == ["row_mask"]
+    bspan = rep1.skips[0].byte_span
+    assert bspan and bspan[1] > bspan[0]
+    qmap.save()
+    # the span persists in the sidecar (the replay's no-I/O contract)
+    with FileSource(bad) as s:
+        fp = fingerprint(s)
+    entry = QuarantineMap.open(sidecar).entries(fp)[0]
+    assert tuple(entry["byte_span"]) == tuple(bspan)
+
+    src = _RangeRecordingSource(bad)
+    trace.enable()
+    try:
+        trace.reset()
+        opts = ReaderOptions(verify_crc=True, salvage=True,
+                             quarantine_map=QuarantineMap.open(sidecar))
+        from parquet_floor_tpu import ParquetFileReader
+
+        with ParquetFileReader(src, options=opts) as r:
+            groups2 = [
+                r.read_row_group(i) for i in range(len(r.row_groups))
+            ]
+            rep2 = r.salvage_report
+        a, b = bspan
+        overlap = [
+            (o, n) for o, n in src.ranges if o < b and a < o + n
+        ]
+        assert not overlap, \
+            f"known-bad page bytes were read: {overlap} vs span {bspan}"
+        assert trace.counters().get("salvage.map_skips") == 1
+        kinds = [d["decision"] for d in trace.decisions()]
+        assert "salvage.map_skip" in kinds
+    finally:
+        trace.disable()
+        trace.reset()
+
+    assert [s.as_dict() for s in rep2.skips] == \
+        [s.as_dict() for s in rep1.skips]
+    assert [g.num_rows for g in groups2] == [g.num_rows for g in groups1]
+    for g1, g2 in zip(groups1, groups2):
+        for c1, c2 in zip(g1.columns, g2.columns):
+            assert np.array_equal(
+                np.asarray(c1.values), np.asarray(c2.values)
+            )
+
+
+def test_page_null_tier_also_replays_without_io(salvage_file, tmp_path):
+    """The OPTIONAL-column tier (page_null) gets the same no-I/O
+    replay."""
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 1, "s", 2, "map_noio_s")
+    sidecar = tmp_path / "noio_s.quarantine.json"
+    qmap = QuarantineMap.open(sidecar)
+    groups1, rep1 = _decode_all(
+        bad, verify_crc=True, salvage=True, quarantine_map=qmap
+    )
+    assert [s.kind for s in rep1.skips] == ["page_null"]
+    bspan = rep1.skips[0].byte_span
+    assert bspan
+    qmap.save()
+
+    src = _RangeRecordingSource(bad)
+    from parquet_floor_tpu import ParquetFileReader
+
+    opts = ReaderOptions(verify_crc=True, salvage=True,
+                         quarantine_map=QuarantineMap.open(sidecar))
+    with ParquetFileReader(src, options=opts) as r:
+        groups2 = [r.read_row_group(i) for i in range(len(r.row_groups))]
+        rep2 = r.salvage_report
+    a, b = bspan
+    assert not [(o, n) for o, n in src.ranges if o < b and a < o + n]
+    assert [s.as_dict() for s in rep2.skips] == \
+        [s.as_dict() for s in rep1.skips]
+    for g1, g2 in zip(groups1, groups2):
+        for c1, c2 in zip(g1.columns, g2.columns):
+            assert np.array_equal(
+                np.asarray(c1.values), np.asarray(c2.values)
+            )
+            if c1.def_levels is not None:
+                assert np.array_equal(
+                    np.asarray(c1.def_levels), np.asarray(c2.def_levels)
+                )
+
+
+def test_content_fingerprint_round_trip(salvage_file, tmp_path):
+    """QuarantineMap(fingerprint="content"): records replay across
+    save/open (round-trip), and the mode is persisted — reopening under
+    a conflicting mode raises instead of silently mis-keying."""
+    bad = _break_page_header(salvage_file, tmp_path, 0, "d", "map_content")
+    sidecar = tmp_path / "content.quarantine.json"
+
+    qmap = QuarantineMap.open(sidecar, fingerprint="content")
+    assert qmap.fingerprint == "content"
+    groups1, rep1 = _decode_all(bad, salvage=True, quarantine_map=qmap)
+    assert _skip_keys(rep1) == [(0, "d", None, "chunk")]
+    qmap.save()
+    assert json.loads(sidecar.read_text())["fingerprint"] == "content"
+
+    reloaded = QuarantineMap.open(sidecar)
+    assert reloaded.fingerprint == "content"
+    with FileSource(bad) as s:
+        fp = fingerprint(s, "content")
+        assert fp.split(":")[1] == "c"
+        assert reloaded.entries(fp)
+        # tail and content keys never collide
+        assert fingerprint(s) != fp
+
+    trace.enable()
+    try:
+        trace.reset()
+        groups2, rep2 = _decode_all(
+            bad, salvage=True, quarantine_map=reloaded
+        )
+        assert trace.counters().get("salvage.map_skips") == 1
+    finally:
+        trace.disable()
+        trace.reset()
+    assert _skip_keys(rep2) == _skip_keys(rep1)
+    assert [g.num_rows for g in groups2] == [g.num_rows for g in groups1]
+
+    with pytest.raises(ValueError, match="mis-key"):
+        QuarantineMap.open(sidecar, fingerprint="tail")
+    with pytest.raises(ValueError, match="fingerprint mode"):
+        QuarantineMap(fingerprint="sha1000")
+
+
+def test_content_fingerprint_closes_in_place_repair_blind_spot(
+        salvage_file, tmp_path):
+    """The stale-entry contract: an in-place mid-file repair preserves
+    size and tail — the tail fingerprint replays stale quarantines
+    (documented blind spot), but the CONTENT fingerprint re-keys and
+    the clean decode re-establishes the truth with zero skips."""
+    bad = _break_page_header(salvage_file, tmp_path, 0, "d", "map_inplace_c")
+    sidecar = tmp_path / "inplace_c.quarantine.json"
+    qmap = QuarantineMap.open(sidecar, fingerprint="content")
+    _decode_all(bad, salvage=True, quarantine_map=qmap)
+    qmap.save()
+    assert len(qmap) == 1
+
+    # in-place restore: size and tail unchanged, mid-file bytes healed
+    pathlib.Path(bad).write_bytes(pathlib.Path(salvage_file).read_bytes())
+    groups, rep = _decode_all(
+        bad, salvage=True, quarantine_map=QuarantineMap.open(sidecar)
+    )
+    assert rep.skips == []  # stale entries MISSED: blind spot closed
+    assert sum(g.num_rows for g in groups) == N_GROUPS * ROWS_PER_GROUP
+    assert any(c.descriptor.path == ("d",) for c in groups[0].columns)

@@ -1,0 +1,597 @@
+"""Remote-storage failure domain (ISSUE 7 tentpole): the seeded
+latency/fault simulator, hedged reads, the per-source circuit breaker,
+error classification riding the retry budgets, and latency-adaptive
+prefetch — every scenario deterministic under fixed seeds."""
+
+import time
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    ParquetFileReader,
+    ParquetFileWriter,
+    ReaderOptions,
+    WriterOptions,
+    trace,
+    types,
+)
+from parquet_floor_tpu.errors import (
+    BreakerOpenError,
+    RemoteFatalError,
+    RemoteThrottledError,
+    RemoteTransientError,
+    TruncatedFileError,
+)
+from parquet_floor_tpu.io.remote import (
+    CircuitBreaker,
+    LatencyStats,
+    ParallelRangeReader,
+    RemoteSource,
+)
+from parquet_floor_tpu.io.source import FileSource, RetryingSource
+from parquet_floor_tpu.scan import DatasetScanner, ScanOptions
+from parquet_floor_tpu.testing import RemoteProfile, SimulatedRemoteSource
+
+DATA = bytes(np.random.default_rng(0).integers(0, 256, 1 << 16, dtype=np.uint8))
+
+
+def _src(**kw):
+    kw.setdefault("seed", 7)
+    return SimulatedRemoteSource(DATA, **kw)
+
+
+# ---------------------------------------------------------------------------
+# simulator: determinism + failure-mode modeling
+# ---------------------------------------------------------------------------
+
+def test_simulator_serves_exact_bytes_and_counts():
+    with _src(profile=RemoteProfile(base_latency_s=0.001)) as s:
+        assert bytes(s.read_at(100, 64)) == DATA[100:164]
+        out = s.read_many([(0, 16), (4096, 32), (65520, 16)])
+        assert [bytes(b) for b in out] == [
+            DATA[:16], DATA[4096:4128], DATA[65520:],
+        ]
+        assert s.transport.requests == 4
+        assert s.transport.bytes_served == 128
+        with pytest.raises(TruncatedFileError):
+            s.read_at(len(DATA) - 8, 16)
+
+
+def test_simulator_keyed_draws_are_order_independent():
+    """The determinism contract: which requests are slow/faulty is keyed
+    by (seed, offset, length, attempt-ordinal), so issue ORDER cannot
+    change the outcome set."""
+    prof = RemoteProfile(fault_rate=0.3, tail_p=0.3, tail_latency_s=0.0)
+
+    def outcome_map(order):
+        out = {}
+        with _src(profile=prof, seed=11, hedge=False) as s:
+            for off in order:
+                try:
+                    s.read_at(off, 32)
+                    out[off] = "ok"
+                except OSError:
+                    out[off] = "fault"
+        return out
+
+    offsets = [0, 512, 1024, 2048, 4096, 8192, 16384, 32768]
+    assert outcome_map(offsets) == outcome_map(list(reversed(offsets)))
+
+
+def test_simulator_bandwidth_cap_adds_transfer_time():
+    slow = RemoteProfile(bandwidth_bytes_per_s=1e6)  # 1 MB/s
+    with _src(profile=slow, hedge=False) as s:
+        t0 = time.perf_counter()
+        s.read_at(0, 50_000)  # 50 ms of transfer
+        assert time.perf_counter() - t0 >= 0.04
+
+
+# ---------------------------------------------------------------------------
+# hedged reads — the satellite's four edge cases, scripted + seeded
+# ---------------------------------------------------------------------------
+
+def test_hedge_fires_then_primary_wins():
+    with trace.scope() as t:
+        with _src(
+            latency_overrides={(64, 0): 0.06, (64, 1): 0.5},
+            hedge_delay_s=0.02,
+        ) as s:
+            t0 = time.perf_counter()
+            assert bytes(s.read_at(64, 128)) == DATA[64:192]
+            dt = time.perf_counter() - t0
+    c = t.counters()
+    assert c.get("io.remote.hedges") == 1
+    assert c.get("io.remote.hedge_wins", 0) == 0       # primary won
+    assert c.get("io.remote.hedges_cancelled") == 1    # loser counted
+    assert dt < 0.4  # did NOT wait for the 0.5 s loser
+    assert any(d["decision"] == "io.hedge" for d in t.decisions())
+
+
+def test_hedge_wins_over_straggling_primary():
+    with trace.scope() as t:
+        with _src(
+            latency_overrides={(64, 0): 0.5, (64, 1): 0.005},
+            hedge_delay_s=0.02,
+        ) as s:
+            t0 = time.perf_counter()
+            assert bytes(s.read_at(64, 128)) == DATA[64:192]
+            dt = time.perf_counter() - t0
+    c = t.counters()
+    assert c.get("io.remote.hedge_wins") == 1
+    assert c.get("io.remote.hedges_cancelled") == 1
+    assert dt < 0.3  # the 0.5 s primary straggler was hedged around
+
+
+def test_both_fail_raises_primary_error_deterministically():
+    """Whichever request fails FIRST, the reported error is the
+    primary's — error order never depends on thread timing."""
+    for lat0, lat1 in [(0.05, 0.005), (0.005, 0.05)]:
+        with _src(
+            latency_overrides={(64, 0): lat0, (64, 1): lat1},
+            fault_overrides={(64, 0): "primary boom", (64, 1): "hedge boom"},
+            hedge_delay_s=0.002,
+        ) as s:
+            with pytest.raises(OSError, match="primary boom"):
+                s.read_at(64, 128)
+
+
+def test_deadline_crossing_mid_hedge():
+    """Primary AND hedge both in flight when the per-range deadline
+    crosses: the fetch abandons both, raises the retryable transient
+    class, and counts the deadline."""
+    with trace.scope() as t:
+        with _src(
+            latency_overrides={(64, 0): 0.4, (64, 1): 0.4},
+            hedge_delay_s=0.01, range_deadline_s=0.05,
+        ) as s:
+            t0 = time.perf_counter()
+            with pytest.raises(RemoteTransientError, match="deadline"):
+                s.read_at(64, 128)
+            assert time.perf_counter() - t0 < 0.3
+    c = t.counters()
+    assert c.get("io.remote.deadlines") == 1
+    assert c.get("io.remote.hedges") == 1
+
+
+def test_no_hedge_when_deadline_shorter_than_delay():
+    """A wait that times out on the (shorter) deadline remainder must
+    not be mistaken for the hedge delay elapsing: no duplicate request
+    fires, and no phantom hedge activity lands on the counters."""
+    with trace.scope() as t:
+        with _src(
+            latency_overrides={(64, 0): 0.3},
+            hedge_delay_s=0.2, range_deadline_s=0.05,
+        ) as s:
+            with pytest.raises(RemoteTransientError, match="deadline"):
+                s.read_at(64, 128)
+            assert s.transport.requests == 1  # the primary, nothing else
+    c = t.counters()
+    assert c.get("io.remote.hedges", 0) == 0
+    assert c.get("io.remote.hedges_cancelled", 0) == 0
+    assert c.get("io.remote.deadlines") == 1
+
+
+def test_adaptive_hedge_delay_tracks_p95():
+    stats = LatencyStats()
+    for v in [0.01] * 95 + [0.5] * 5:
+        stats.observe(v)
+    assert 0.009 <= stats.p95() <= 0.51
+    with _src(hedge_min_delay_s=0.001, hedge_max_delay_s=0.05) as s:
+        assert s.hedge_delay() is None  # too few samples: no tail estimate
+        for v in [0.02] * 16:
+            s.latency.observe(v)
+        d = s.hedge_delay()
+        assert 0.001 <= d <= 0.05
+    with _src(hedge=False) as s:
+        assert s.hedge_delay() is None
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_trips_fast_fails_and_recovers_half_open():
+    with trace.scope() as t:
+        with _src(
+            hedge=False,
+            fault_overrides={(0, 0): "f", (64, 0): "f", (128, 0): "f"},
+            breaker_threshold=3, breaker_cooldown_s=0.05,
+        ) as s:
+            for off in (0, 64, 128):
+                with pytest.raises(OSError):
+                    s.read_at(off, 16)
+            assert s.breaker.state == "open"
+            # fail-fast without touching the network
+            reqs = s.transport.requests
+            with pytest.raises(BreakerOpenError) as ei:
+                s.read_at(256, 16)
+            assert s.transport.requests == reqs
+            assert 0 < ei.value.retry_after_s <= 0.05
+            # cooldown passes → ONE half-open probe → success closes
+            time.sleep(0.06)
+            assert bytes(s.read_at(256, 16)) == DATA[256:272]
+            assert s.breaker.state == "closed"
+    c = t.counters()
+    assert c.get("io.remote.breaker_trips") == 1
+    assert c.get("io.remote.breaker_fast_fails") == 1
+    states = [d["state"] for d in t.decisions()
+              if d["decision"] == "io.breaker"]
+    assert states == ["open", "closed"]
+
+
+def test_breaker_failed_probe_reopens():
+    with _src(
+        hedge=False,
+        fault_overrides={
+            (0, 0): "f", (64, 0): "f", (128, 0): "f",
+            (256, 0): "probe fails too",
+        },
+        breaker_threshold=3, breaker_cooldown_s=0.04,
+    ) as s:
+        for off in (0, 64, 128):
+            with pytest.raises(OSError):
+                s.read_at(off, 16)
+        time.sleep(0.05)
+        with pytest.raises(OSError, match="probe"):
+            s.read_at(256, 16)  # the half-open probe
+        assert s.breaker.state == "open"  # re-opened for a fresh cooldown
+        with pytest.raises(BreakerOpenError):
+            s.read_at(512, 16)
+        time.sleep(0.05)
+        assert bytes(s.read_at(256, 16)) == DATA[256:272]  # k=1 succeeds
+        assert s.breaker.state == "closed"
+
+
+def test_breaker_probe_released_when_throttled():
+    """A half-open probe that gets THROTTLED judges nothing about the
+    endpoint — it must release the probe slot (not wedge the breaker
+    open forever failing fast): the next request becomes a fresh probe
+    and closes the breaker."""
+    class Transport:
+        size = 1024
+        name = "probe-throttle"
+
+        def __init__(self):
+            self.calls = 0
+
+        def get_range(self, offset, length):
+            self.calls += 1
+            if self.calls <= 3:
+                raise OSError("down")
+            if self.calls == 4:
+                raise RemoteThrottledError("busy", retry_after_s=0.005)
+            return bytes(length)
+
+    with RemoteSource(Transport(), hedge=False, breaker_threshold=3,
+                      breaker_cooldown_s=0.02) as s:
+        for off in (0, 64, 128):
+            with pytest.raises(OSError):
+                s.read_at(off, 8)
+        assert s.breaker.state == "open"
+        time.sleep(0.03)
+        with pytest.raises(RemoteThrottledError):
+            s.read_at(0, 8)  # the admitted probe, throttled away
+        # released, not wedged: this request is a fresh probe
+        assert bytes(s.read_at(0, 8)) == bytes(8)
+        assert s.breaker.state == "closed"
+
+
+def test_throttle_never_trips_breaker():
+    with _src(
+        hedge=False,
+        profile=RemoteProfile(throttle_rps=1000, throttle_burst=1),
+        breaker_threshold=2, breaker_cooldown_s=10.0,
+    ) as s:
+        throttled = 0
+        for i in range(8):
+            try:
+                s.read_at(i * 64, 16)
+            except RemoteThrottledError as e:
+                throttled += 1
+                assert e.retry_after_s > 0
+        assert throttled >= 2
+        assert s.breaker.state == "closed"
+
+
+def test_breaker_validation():
+    with pytest.raises(ValueError, match="threshold"):
+        CircuitBreaker(threshold=0)
+    with pytest.raises(ValueError, match="cooldown"):
+        CircuitBreaker(cooldown_s=0)
+
+
+# ---------------------------------------------------------------------------
+# classification × RetryingSource composition
+# ---------------------------------------------------------------------------
+
+def test_retrying_source_honors_throttle_retry_after():
+    sleeps = []
+    with _src(
+        hedge=False,
+        profile=RemoteProfile(throttle_rps=100, throttle_burst=1),
+    ) as s:
+        r = RetryingSource(s, retries=4, backoff_s=0.0001,
+                           sleep=lambda d: (sleeps.append(d),
+                                            time.sleep(min(d, 0.05))))
+        out = r.read_many([(i * 64, 16) for i in range(4)])
+        assert [bytes(b) for b in out] == [
+            DATA[i * 64: i * 64 + 16] for i in range(4)
+        ]
+    # throttle-aware backoff: at least one sleep stretched to the
+    # bucket's retry_after (way past the 0.1 ms base backoff)
+    assert any(d >= 0.005 for d in sleeps), sleeps
+
+
+def test_fatal_error_is_not_retried():
+    attempts = []
+
+    # a transport that raises a NON-OSError is classified fatal and
+    # never retried
+    class DeniedTransport:
+        size = 1024
+        name = "denied"
+
+        def get_range(self, offset, length):
+            attempts.append(offset)
+            raise ValueError("credentials rejected")
+
+    with RemoteSource(DeniedTransport(), hedge=False) as s:
+        r = RetryingSource(s, retries=5, backoff_s=0.0001)
+        with pytest.raises(RemoteFatalError, match="credentials"):
+            r.read_at(0, 16)
+    assert len(attempts) == 1  # zero retries burned
+
+
+def test_outage_recovery_through_retries():
+    """The bench's fault-heavy shape in miniature: every request inside
+    the outage window fails, retries back off past it, the breaker
+    trips and half-open-recovers, and the BYTES come back identical."""
+    with trace.scope() as t:
+        with _src(
+            hedge=False, seed=5,
+            profile=RemoteProfile(outage_s=0.08),
+            breaker_threshold=3, breaker_cooldown_s=0.03,
+        ) as s:
+            r = RetryingSource(s, retries=6, backoff_s=0.02)
+            out = r.read_many([(i * 100, 50) for i in range(5)])
+            assert all(
+                bytes(b) == DATA[i * 100: i * 100 + 50]
+                for i, b in enumerate(out)
+            )
+    c = t.counters()
+    assert c.get("io.remote.breaker_trips", 0) >= 1
+    assert c.get("io.retries", 0) >= 1
+    assert c.get("io.remote.faults", 0) >= 3
+
+
+def test_compose_retrying_respects_precomposed_chains():
+    """The ONE chain-composition spelling (reader + scan executor both
+    call it): remote sources get RetryingSource below ParallelRangeReader;
+    already-composed chains pass through untouched, so attempts never
+    multiply and the fan-out never serializes behind an outer retry."""
+    from parquet_floor_tpu.io.remote import compose_retrying
+
+    with _src() as s:
+        chain = compose_retrying(s, 3)
+        assert isinstance(chain, ParallelRangeReader)
+        assert compose_retrying(chain, 3) is chain  # no double wrap
+    inner_retry = RetryingSource(FileSource(DATA), 2)
+    assert compose_retrying(inner_retry, 3) is inner_retry
+    inner_retry.close()
+    r = compose_retrying(FileSource(DATA), 2)
+    assert isinstance(r, RetryingSource)  # local source: no fan-out layer
+    r.close()
+    with FileSource(DATA) as plain:
+        assert compose_retrying(plain, 0) is plain  # retries off: untouched
+
+
+def test_parallel_range_reader_orders_results_and_errors():
+    with FileSource(DATA) as inner:
+        with ParallelRangeReader(FileSource(DATA), threads=4) as p:
+            out = p.read_many([(0, 16), (64, 16), (128, 16)])
+            assert [bytes(b) for b in out] == [
+                DATA[:16], DATA[64:80], DATA[128:144],
+            ]
+        assert bytes(inner.read_at(0, 4)) == DATA[:4]
+
+    class Flaky:
+        size = len(DATA)
+        name = "flaky"
+
+        def read_at(self, o, n):
+            if o == 64:
+                raise OSError("boom at 64")
+            if o == 128:
+                raise OSError("boom at 128")
+            return memoryview(DATA)[o:o + n]
+
+        def close(self):
+            pass
+
+    with ParallelRangeReader(Flaky(), threads=4) as p:
+        # first-LISTED failure raises, regardless of completion order
+        with pytest.raises(OSError, match="boom at 64"):
+            p.read_many([(0, 16), (64, 16), (128, 16)])
+
+
+# ---------------------------------------------------------------------------
+# scan faces over the simulator: correctness + adaptive prefetch
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def remote_dataset(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("remote_ds")
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("a"),
+        types.required(types.DOUBLE).named("d"),
+    )
+    rng = np.random.default_rng(9)
+    paths = []
+    for i in range(2):
+        p = tmp / f"f{i}.parquet"
+        with ParquetFileWriter(p, schema,
+                               WriterOptions(data_page_values=200)) as w:
+            for _ in range(3):
+                w.write_columns({
+                    "a": rng.integers(0, 1 << 40, 400).astype(np.int64),
+                    "d": rng.standard_normal(400),
+                })
+        paths.append(str(p))
+    return paths
+
+
+def _digest_units(units):
+    out = []
+    for u in units:
+        cols = tuple(
+            np.asarray(c.values).tobytes() for c in u.batch.columns
+        )
+        out.append((u.file_index, u.group_index, u.batch.num_rows,
+                    tuple(hash(c) for c in cols)))
+    return out
+
+
+def _scan_digest(paths, profile, seed, sc, retries=4, hedge_kw=None):
+    opts = ReaderOptions(io_retries=retries)
+    kw = hedge_kw or {}
+    factories = [
+        (lambda p=p: SimulatedRemoteSource(
+            p, profile=profile, seed=seed, fetch_threads=4, **kw
+        ))
+        for p in paths
+    ]
+    with DatasetScanner(factories, options=opts, scan=sc) as s:
+        return _digest_units(s)
+
+
+def test_remote_scan_bit_identical_under_faults(remote_dataset):
+    """The acceptance shape: a fault-heavy seeded scan (drops + throttle
+    + tail latency) completes BIT-IDENTICAL to the clean run, with
+    retry/hedge counters exercised."""
+    sc = ScanOptions(threads=4, adaptive_prefetch=True)
+    clean = _scan_digest(
+        remote_dataset, RemoteProfile(base_latency_s=0.002), 13, sc,
+    )
+    hostile = RemoteProfile(
+        base_latency_s=0.002, jitter_s=0.001,
+        tail_p=0.25, tail_latency_s=0.03,
+        fault_rate=0.1, outage_s=0.03,
+        throttle_rps=2000, throttle_burst=4,
+    )
+    with trace.scope() as t:
+        faulty = _scan_digest(
+            remote_dataset, hostile, 13, sc,
+            hedge_kw={"hedge_delay_s": 0.02,
+                      "breaker_threshold": 3,
+                      "breaker_cooldown_s": 0.02},
+        )
+    assert faulty == clean
+    c = t.counters()
+    assert c.get("io.retries", 0) >= 1, c
+    assert c.get("io.remote.faults", 0) >= 1, c
+    # every emitted counter name is registered (the trace.names contract)
+    assert set(c) <= trace.names.ALL, c
+
+
+def test_remote_scan_matches_local_scan(remote_dataset):
+    sc = ScanOptions(threads=4)
+    with DatasetScanner(remote_dataset, scan=sc) as s:
+        local = _digest_units(s)
+    remote = _scan_digest(
+        remote_dataset, RemoteProfile(base_latency_s=0.001), 3,
+        ScanOptions(threads=4, adaptive_prefetch=True),
+    )
+    assert remote == local
+
+
+def test_adaptive_budget_scales_with_latency(remote_dataset):
+    """The latency-adaptive controller: a slow store earns a deeper
+    effective budget than a local one, both observable through the
+    gauge/decision, and neither changes the decoded bytes."""
+    base = ScanOptions(threads=4, adaptive_prefetch=True)
+
+    def peak_budget(profile, seed):
+        with trace.scope() as t:
+            _scan_digest(remote_dataset, profile, seed, base)
+        return (t.gauges().get("scan.adaptive_budget_bytes", 0),
+                [d for d in t.decisions()
+                 if d["decision"] == "scan.adaptive_budget"])
+
+    slow_cap, slow_dec = peak_budget(
+        RemoteProfile(base_latency_s=0.03), 21
+    )
+    assert slow_cap > 0 and slow_dec
+
+    with trace.scope() as t:
+        with DatasetScanner(
+            remote_dataset, scan=base
+        ) as s:  # local files: RTT « 2 ms
+            list(s)
+    fast_cap = t.gauges().get("scan.adaptive_budget_bytes", 0)
+    assert fast_cap > 0
+    # the 30 ms store pipelines deeper than the local SSD
+    assert slow_cap >= fast_cap
+
+
+def test_adaptive_depth_hint_on_device_scan(remote_dataset, monkeypatch):
+    jax = pytest.importorskip("jax")
+    jax.config.update("jax_enable_x64", True)
+    monkeypatch.delenv("PFTPU_PREFETCH_DEPTH", raising=False)
+    from parquet_floor_tpu.scan import scan_device_groups
+
+    factories = [
+        (lambda p=p: SimulatedRemoteSource(
+            p, profile=RemoteProfile(base_latency_s=0.025), seed=2,
+            fetch_threads=4,
+        ))
+        for p in remote_dataset
+    ]
+    with trace.scope() as t:
+        rows = 0
+        for _fi, _gi, cols in scan_device_groups(
+            factories, scan=ScanOptions(threads=4, adaptive_prefetch=True),
+            float64_policy="bits",
+        ):
+            rows += int(next(iter(cols.values())).values.shape[0])
+    assert rows == 2400
+    hints = [d for d in t.decisions()
+             if d["decision"] == "scan.adaptive_depth"]
+    assert hints and hints[0]["depth"] > 3, hints
+
+
+def test_sequential_reader_over_remote_source(remote_dataset):
+    """The sequential face composes too: ReaderOptions(io_retries) wraps
+    the remote source, faults recover, bytes match the local read."""
+    with ParquetFileReader(remote_dataset[0]) as r:
+        want = [
+            np.asarray(c.values).tobytes()
+            for c in r.read_row_group(0).columns
+        ]
+    with SimulatedRemoteSource(
+        remote_dataset[0], seed=31, hedge=False,
+        profile=RemoteProfile(fault_rate=0.2),
+    ) as src:
+        with ParquetFileReader(
+            src,
+            options=ReaderOptions(io_retries=6, io_retry_backoff_s=0.001),
+        ) as r:
+            got = [
+                np.asarray(c.values).tobytes()
+                for c in r.read_row_group(0).columns
+            ]
+    assert got == want
+
+
+def test_remote_source_validation():
+    with pytest.raises(ValueError, match="fetch_threads"):
+        _src(fetch_threads=0)
+    with pytest.raises(ValueError, match="hedge_delay_s"):
+        _src(hedge_delay_s=0)
+    with pytest.raises(ValueError, match="range_deadline_s"):
+        _src(range_deadline_s=-1)
+    with pytest.raises(ValueError, match="tail_p"):
+        RemoteProfile(tail_p=1.5)
+    with pytest.raises(ValueError, match="bandwidth"):
+        RemoteProfile(bandwidth_bytes_per_s=0)

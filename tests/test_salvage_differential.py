@@ -17,6 +17,7 @@ from parquet_floor_tpu.testing.differential import (
     case_flips,
     differential_case,
     materialize_case,
+    run_ranged,
     run_sequential,
     time_limit,
     write_reference_corpus,
@@ -120,3 +121,43 @@ def test_fatal_cases_agree(corpus, tmp_path):
         assert run_sequential(paths, opts).fatal is not None
         assert run_host_scan(paths, opts).fatal is not None
         assert run_loader(paths, opts)[0].fatal is not None
+
+
+def test_ranged_reads_match_sequential_salvage(corpus, tmp_path):
+    """ISSUE 7 satellite: salvage under ranged reads.  The ranged face
+    (``read_row_group_ranges`` with a partial request) must produce the
+    SAME quarantine set and the SAME surviving bytes as the sequential
+    whole-group face on every seeded corruption case — the delegation
+    contract (salvage decisions are group-wide; the ranged path routes
+    through the whole-group salvage decode)."""
+    opts = ReaderOptions(salvage=True, verify_crc=True)
+    fails = []
+    for seed in range(400, 412):
+        paths, _flips = materialize_case(corpus, seed, str(tmp_path))
+        with time_limit(PER_CASE_TIMEOUT_S):
+            ref = run_sequential(paths, opts)
+            ranged = run_ranged(paths, opts)
+        if (ref.fatal is None) != (ranged.fatal is None):
+            fails.append((seed, f"fatality diverged: sequential="
+                          f"{ref.fatal} ranged={ranged.fatal}"))
+            continue
+        if ref.fatal is not None:
+            continue
+        if ranged.quarantine != ref.quarantine:
+            fails.append((seed, "quarantine sets diverged"))
+        elif ranged.groups != ref.groups:
+            fails.append((seed, "surviving bytes diverged"))
+    assert not fails, fails
+
+
+def test_ranged_strict_mode_still_prunes(corpus):
+    """The delegation is salvage-only: strict-mode ranged reads keep
+    their I/O-pruned page cover (covered stays a page-aligned subset
+    when the index allows it)."""
+    from parquet_floor_tpu import ParquetFileReader
+
+    with ParquetFileReader(corpus[0]) as r:
+        n = int(r.row_groups[0].num_rows or 0)
+        batch, covered = r.read_row_group_ranges(0, [(10, 60)])
+        assert covered and covered != [(0, n)]
+        assert batch.num_rows == sum(b - a for a, b in covered)

@@ -244,11 +244,34 @@ def scan_leg(n_rows: int, reps: int) -> dict:
             ):
                 bit_exact = False
 
+    # one-launch contract (docs/perf.md): groups whose footer estimate
+    # exceeds the arena cap legitimately take the multi-launch chunked
+    # fallback — count them so check_bench_report only asserts strict
+    # equality when every group is in-cap
+    from parquet_floor_tpu.format.file_read import ParquetFileReader
+    from parquet_floor_tpu.tpu.cost import arena_cap
+
+    overcap = 0
+    for p in paths:
+        with ParquetFileReader(p) as r:
+            for rg in r.row_groups:
+                est = sum(
+                    int(c.meta_data.total_uncompressed_size or 0)
+                    for c in (rg.columns or [])
+                )
+                if est > arena_cap():
+                    overcap += 1
+
     return {
         "scan_rows_per_sec": round(rows / scan_dt, 1),
         "scan_seq_rows_per_sec": round(rows / seq_dt, 1),
         "scan_vs_sequential_x": round(seq_dt / scan_dt, 3),
         "scan_bit_exact": bool(bit_exact),
+        # the counted pass must dispatch exactly ONE fused launch per
+        # in-cap row group
+        "scan_groups": len(got),
+        "scan_overcap_groups": overcap,
+        "scan_launches": counters.get("engine.launches", 0),
         "scan_files": len(paths),
         "scan_threads": threads,
         "scan_extents_planned": counters.get("scan.extents_planned", 0),
@@ -268,6 +291,92 @@ def scan_leg(n_rows: int, reps: int) -> dict:
         # fraction, budget utilization, over-read ratio, retries) — the
         # consumable ScanReport form of the counters above
         "scan_report": scan_report.as_dict(),
+    }
+
+
+def exec_cache_leg(n_rows: int) -> dict:
+    """Cold-vs-warm start on the persistent AOT executable cache
+    (docs/perf.md): two FRESH subprocesses decode the same file's group
+    0 against one shared ``PFTPU_EXEC_CACHE`` dir — the first pays the
+    XLA compile and stores the executable, the second deserializes it
+    and must skip compilation entirely.  ``check_bench_report.py``
+    asserts the shape: the cold run compiles (misses >= 1), the warm
+    run does not (hits >= 1, compile_ms == 0), the warm first-group
+    wall is >= 10x better, the fused path is exactly ONE launch, and
+    the decoded digests are bit-identical.
+
+    The probe file uses small (256-row) groups: compile cost is shape-
+    driven, not data-driven, so small groups put the measurement where
+    the overhead actually is."""
+    import subprocess
+    import tempfile
+
+    from benchmarks.workloads import write_lineitem
+
+    per = max(min(n_rows, 2048), 512)
+    path = os.path.join("/tmp", f"pftpu_bench_execcache_{per}.parquet")
+    if not os.path.exists(path):
+        write_lineitem(path, per, row_group_rows=256, seed=3)
+    probe = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "exec_cache_probe.py",
+    )
+    import shutil
+
+    cache_dir = tempfile.mkdtemp(prefix="pftpu_exec_cache_")
+    env = dict(os.environ)
+    env.pop("PFTPU_EXEC_CACHE", None)  # the probe sets its own
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, probe, path, cache_dir],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"exec-cache probe failed: {out.stderr[-2000:]}"
+            )
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    try:
+        cold = run()
+        # two warm processes, best-of: the warm wall is dominated by
+        # the executable deserialize, which is noisy under CI load —
+        # best-of measures what the cache DOES (skip the compile), not
+        # the host's scheduling jitter.  Both must hit; the report
+        # check asserts it.
+        warms = [run(), run()]
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    warm = min(warms, key=lambda w: w["first_group_wall_ms"])
+    speedup = (
+        cold["first_group_wall_ms"] / warm["first_group_wall_ms"]
+        if warm["first_group_wall_ms"] else None
+    )
+    return {
+        "exec_cache_cold_first_group_wall_ms": cold["first_group_wall_ms"],
+        "exec_cache_warm_first_group_wall_ms": warm["first_group_wall_ms"],
+        "exec_cache_warm_speedup_x": (
+            round(speedup, 2) if speedup is not None else None
+        ),
+        "exec_cache_cold_compile_ms": cold["compile_ms"],
+        "exec_cache_warm_compile_ms": max(
+            w["compile_ms"] for w in warms
+        ),
+        "exec_cache_cold_misses": cold["exec_cache_misses"],
+        "exec_cache_cold_hits": cold["exec_cache_hits"],
+        "exec_cache_warm_hits": min(w["exec_cache_hits"] for w in warms),
+        "exec_cache_warm_misses": max(
+            w["exec_cache_misses"] for w in warms
+        ),
+        "exec_cache_warm_walls_ms": [
+            w["first_group_wall_ms"] for w in warms
+        ],
+        "exec_cache_cold_launches": cold["launches"],
+        "exec_cache_warm_launches": warm["launches"],
+        "exec_cache_bit_identical": bool(
+            all(cold["digest"] == w["digest"] for w in warms)
+        ),
     }
 
 
@@ -492,8 +601,29 @@ def loader_leg_timed(n_rows: int, reps: int) -> dict:
     Timed with NO device→host fetch (``block_until_ready`` only), so it
     runs before any D2H leg; the multiset-exactness check (which must
     fetch) runs separately in :func:`loader_leg_exactness`, after every
-    timed section."""
+    timed section.
+
+    The ``loader[_prefetch]_vs_scan_x`` ratios compare against a RAW
+    device scan of the same dataset timed INSIDE this leg, with the
+    three measurements interleaved rep-by-rep — the numerator and
+    denominator see the same machine conditions, so the ratio measures
+    the loader, not the load-average drift between two distant bench
+    sections (the standalone scan leg still reports its own numbers)."""
     import jax
+
+    from parquet_floor_tpu.scan import ScanOptions, scan_device_groups
+
+    paths = _scan_paths(n_rows)
+    sc = ScanOptions(threads=min(4, os.cpu_count() or 1))
+
+    def run_scan():
+        rows = 0
+        for _fi, _gi, cols in scan_device_groups(
+            paths, scan=sc, float64_policy="bits"
+        ):
+            jax.block_until_ready([c.values for c in cols.values()])
+            rows += int(next(iter(cols.values())).values.shape[0])
+        return rows
 
     with _bench_loader(n_rows, shuffled=True, num_epochs=None) as loader:
         batch = loader.batch_size
@@ -501,24 +631,46 @@ def loader_leg_timed(n_rows: int, reps: int) -> dict:
         it = iter(loader)
         n_batches = loader.batches_per_epoch
 
-        def run_epoch():
+        def run_epoch(source):
             rows = 0
             for _ in range(n_batches):
-                b = next(it)
+                b = next(source)
                 jax.block_until_ready([c.values for c in b.columns])
                 rows += b.num_valid
             return rows
 
-        rows = run_epoch()  # warm compiles + page cache
-        best = float("inf")
-        for _ in range(max(reps, 1)):
+        rows = run_epoch(it)    # warm compiles + page cache
+        pf = loader.prefetch_to_device(2)
+        run_epoch(pf)           # warm the prefetch path
+        scan_rows = run_scan()  # warm the raw-scan comparator
+
+        best = best_pf = best_scan = float("inf")
+        # best-of-4 floor: at smoke scale an epoch is ~100 ms and the
+        # assertion below compares two near-equal quantities — one rep
+        # per side is scheduler noise, four interleaved reps converge
+        # both minima under the same machine conditions
+        for _ in range(max(reps, 4)):
             t0 = time.perf_counter()
-            r = run_epoch()
+            r = run_epoch(it)
             best = min(best, time.perf_counter() - t0)
-            if r != rows:
-                raise RuntimeError(f"loader leg row drift: {r} != {rows}")
+            t0 = time.perf_counter()
+            rp = run_epoch(pf)
+            best_pf = min(best_pf, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            rs = run_scan()
+            best_scan = min(best_scan, time.perf_counter() - t0)
+            if r != rows or rp != rows or rs != scan_rows:
+                raise RuntimeError(
+                    f"loader leg row drift: {r}/{rp} != {rows} "
+                    f"or scan {rs} != {scan_rows}"
+                )
+    scan_rps = scan_rows / best_scan
     return {
         "loader_rows_per_sec": round(rows / best, 1),
+        "loader_prefetch_rows_per_sec": round(rows / best_pf, 1),
+        "loader_scan_rows_per_sec": round(scan_rps, 1),
+        "loader_vs_scan_x": round(rows / best / scan_rps, 3),
+        "loader_prefetch_vs_scan_x": round(rows / best_pf / scan_rps, 3),
         "loader_rows": rows,
         "loader_batches": n_batches,
         "loader_batch_size": batch,
@@ -735,15 +887,15 @@ def main():
     # simulated 20 ms-RTT store — no device work, no D2H; real sleeps
     # model the store, so it runs once, not per rep
     remote_detail = remote_leg(n_rows)
+    # exec-cache cold/warm leg (docs/perf.md): runs in SUBPROCESSES
+    # (fresh jax each), so its placement among the timed legs is free
+    exec_cache_detail = exec_cache_leg(n_rows)
     # the loader's multiset-exactness check fetches device arrays: after
     # every timed section (the first D2H degrades tunnelled links
     # process-wide), alongside the scan leg's own D2H check
     loader_detail.update(loader_leg_exactness(n_rows))
-    scan_rps = scan_detail.get("scan_rows_per_sec") or 0
-    loader_detail["loader_vs_scan_x"] = (
-        round(loader_detail["loader_rows_per_sec"] / scan_rps, 3)
-        if scan_rps else None
-    )
+    # loader_vs_scan_x / loader_prefetch_vs_scan_x come from the loader
+    # leg itself (raw-scan comparator interleaved with the loader reps)
     chunk_cols_subset = chunked_columns(path)
     single_cols = reader.read_row_group(0, columns=chunk_cols_subset)
     reader.close()
@@ -782,6 +934,7 @@ def main():
             **chunked,
             **scan_detail,
             **remote_detail,
+            **exec_cache_detail,
             **loader_detail,
         },
     }

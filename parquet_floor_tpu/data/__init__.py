@@ -19,12 +19,13 @@ checkpoint/resume that is bit-identical to an uninterrupted run.
 """
 
 from .batcher import ColumnSpec, LoaderBatch, RowBuffer, make_batch
-from .loader import DataLoader
+from .loader import DataLoader, DevicePrefetcher
 from .order import EpochPlan, Unit, keyed_rng, shard_units
 
 __all__ = [
     "ColumnSpec",
     "DataLoader",
+    "DevicePrefetcher",
     "EpochPlan",
     "LoaderBatch",
     "RowBuffer",

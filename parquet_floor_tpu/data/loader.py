@@ -28,6 +28,8 @@ from snapshot deltas — ``loader.report()`` merges them via
 from __future__ import annotations
 
 import time
+from collections import deque
+from dataclasses import replace
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -87,14 +89,140 @@ def _delta_stats(before: Dict[str, dict], after: Dict[str, dict]
         dc = st["count"] - b.get("count", 0)
         ds = st["seconds"] - b.get("seconds", 0.0)
         db = st["bytes"] - b.get("bytes", 0)
+        dss = (
+            st.get("self_seconds", st["seconds"])
+            - b.get("self_seconds", b.get("seconds", 0.0))
+        )
         if dc or ds or db:
             out[k] = {
                 "count": dc,
                 "seconds": round(ds, 6),
                 "bytes": db,
                 "MB_per_s": round(db / ds / 1e6, 1) if ds > 0 else 0.0,
+                "self_seconds": round(dss, 6),
             }
     return out
+
+
+class DevicePrefetcher:
+    """Double-buffered iteration over a :class:`DataLoader` —
+    ``loader.prefetch_to_device(n)`` (docs/perf.md).
+
+    Keeps up to ``depth`` batches IN FLIGHT ahead of the consumer: each
+    pull advances the loader (which advances the decode pipeline — on
+    the device face that means the engine's stage worker reads and the
+    ship worker transfers batch k+1's arena/slab while the consumer's
+    step k computes) and ships every batch leaf with one asynchronous
+    ``jax.device_put``, so by the time the training step asks for batch
+    k+1 its arrays are already resident (or their H2D is already in
+    flight) instead of starting the transfer on the critical path.
+    Device-face batches are already device-resident ``jax.Array``\\ s —
+    for them the put is a no-op and the win is the pipeline advance;
+    host-face batches pay their H2D here, off the step's critical path.
+
+    Checkpointing stays EXACT: the prefetcher snapshots
+    ``loader.state()`` right after each pull, and :meth:`state` returns
+    the snapshot of the last batch the CONSUMER received — restoring it
+    replays every batch the consumer has not seen, including the ones
+    that were sitting in the prefetch buffer.  (Calling
+    ``loader.state()`` directly while a prefetcher is active reflects
+    the pulled-ahead position instead — use the prefetcher's.)
+    """
+
+    def __init__(self, loader: "DataLoader", depth: int = 2, device=None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._loader = loader
+        self._depth = int(depth)
+        self._device = device
+        self._buf: deque = deque()      # (shipped batch, state snapshot)
+        self._last_state = loader.state()
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def _ship(self, batch: LoaderBatch) -> LoaderBatch:
+        import jax
+
+        tracer = self._loader._tracer
+        with tracer.span("data.prefetch_to_device"):
+            leaves = []
+            spec = []
+            for c in batch.columns:
+                for a in (c.values, c.mask, c.lengths):
+                    spec.append(a is not None)
+                    if a is not None:
+                        leaves.append(a)
+            has_rm = batch.row_mask is not None
+            if has_rm:
+                leaves.append(batch.row_mask)
+            if self._device is None and all(
+                isinstance(a, jax.Array) for a in leaves
+            ):
+                # device-face batch already resident on the target: the
+                # put would be a no-op — the prefetch win here is the
+                # PULL itself (the decode pipeline advanced a batch
+                # ahead), so skip the dispatch round trip per leaf
+                return batch
+            # ONE asynchronous transfer for the whole batch: arrays come
+            # back as futures, the H2D overlaps the consumer's step
+            shipped = jax.device_put(leaves, self._device)
+        it = iter(shipped)
+        flags = iter(spec)
+        cols = []
+        for c in batch.columns:
+            v, m, ln = (
+                (next(it) if next(flags) else None) for _ in range(3)
+            )
+            cols.append(replace(c, values=v, mask=m, lengths=ln))
+        return LoaderBatch(
+            batch.epoch, batch.index, cols, batch.num_valid,
+            next(it) if has_rm else None,
+        )
+
+    def _pull(self) -> bool:
+        if self._done:
+            return False
+        try:
+            nxt = next(self._loader)
+        except StopIteration:
+            self._done = True
+            return False
+        tracer = self._loader._tracer
+        self._buf.append((self._ship(nxt), self._loader.state()))
+        tracer.count("data.prefetch_to_device_batches")
+        tracer.gauge_max(
+            "data.prefetch_to_device_depth_max", len(self._buf)
+        )
+        return True
+
+    def __next__(self) -> LoaderBatch:
+        while len(self._buf) < self._depth and self._pull():
+            pass
+        if not self._buf:
+            raise StopIteration
+        batch, snap = self._buf.popleft()
+        self._last_state = snap
+        return batch
+
+    def state(self) -> dict:
+        """The loader state as of the last batch the consumer RECEIVED
+        (buffered batches count as not-yet-emitted) — hand it to
+        ``DataLoader.restore`` exactly like ``loader.state()``."""
+        return self._last_state
+
+    def close(self) -> None:
+        """Drop the buffered batches (they were already pulled; the
+        loader itself stays open — close it separately)."""
+        self._buf.clear()
+        self._done = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class DataLoader:
@@ -819,6 +947,26 @@ class DataLoader:
         }
         self._tracer.decision("data.resume", {"epoch": epoch, "batch": batch})
         return self
+
+    # -- device double-buffering ----------------------------------------------
+
+    def prefetch_to_device(self, depth: int = 2, device=None
+                           ) -> DevicePrefetcher:
+        """Iterate this loader with up to ``depth`` batches in flight
+        ahead of the consumer (docs/perf.md): batch k+1's decode
+        pipeline advance and its H2D transfer run under step k's
+        compute, so the training step stops paying transfer latency on
+        its critical path.  ``depth=2`` is classic double buffering.
+        Returns a :class:`DevicePrefetcher`; checkpoint through ITS
+        ``state()`` while it is active (buffered batches count as
+        not yet emitted)::
+
+            pf = loader.prefetch_to_device(2)
+            for batch in pf:
+                step(batch)
+            ckpt = pf.state()
+        """
+        return DevicePrefetcher(self, depth, device)
 
     # -- health --------------------------------------------------------------
 

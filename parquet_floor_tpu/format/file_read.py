@@ -612,6 +612,24 @@ class ParquetFileReader:
             "row_group": row_group_index,
         }
 
+    def _chunk_span(self, chunk: ColumnChunk, row_group_index: int):
+        """Per-chunk decode span on the sequential read path.  A child
+        of whatever span is already open (the scan executor wraps whole
+        groups in "decode"): the tracer's nesting-aware stats charge
+        the chunk's wall to ``decode_chunk`` and subtract it from the
+        parent's exclusive time, so summing self-times never counts one
+        second twice (docs/observability.md)."""
+        meta = chunk.meta_data
+        column = ".".join(
+            (meta.path_in_schema if meta is not None else None) or ["?"]
+        )
+        nbytes = (
+            int(meta.total_uncompressed_size or 0) if meta is not None else 0
+        )
+        return trace.span("decode_chunk", nbytes, attrs={
+            "column": column, "row_group": row_group_index,
+        })
+
     def read_column_chunk(
         self, chunk: ColumnChunk, row_group_index: Optional[int] = None,
         *, report: Optional[SalvageReport] = None,
@@ -1348,10 +1366,15 @@ class ParquetFileReader:
                 continue
             selected.append(chunk)
         if not self._salvage:
-            return RowGroupBatch(
-                [self.read_column_chunk(c, index) for c in selected],
-                rg.num_rows or 0,
-            )
+            batches = []
+            for c in selected:
+                # per-chunk decode attribution on the sequential reader;
+                # stats stay nesting-aware (StageStat.self_seconds), so
+                # under the scan executor's per-group "decode" span these
+                # child spans refine, never double-count, the totals
+                with self._chunk_span(c, index):
+                    batches.append(self.read_column_chunk(c, index))
+            return RowGroupBatch(batches, rg.num_rows or 0)
         rep = report if report is not None else self.salvage_report
         # the row-mask tier needs every selected column FLAT: dropping a
         # row span from a repeated leaf would need record boundaries the
@@ -1388,9 +1411,10 @@ class ParquetFileReader:
                 )
                 continue
             try:
-                batch, spans = self._read_column_chunk_impl(
-                    chunk, index, report=rep, row_mask=allow_mask
-                )
+                with self._chunk_span(chunk, index):
+                    batch, spans = self._read_column_chunk_impl(
+                        chunk, index, report=rep, row_mask=allow_mask
+                    )
                 batches.append(batch)
                 drops.extend(spans)
             except _SALVAGEABLE as e:

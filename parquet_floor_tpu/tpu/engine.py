@@ -869,6 +869,23 @@ def _take_rows(perm, *arrays):
     return tuple(jnp.take(a, perm, axis=0) for a in arrays)
 
 
+def _run_fused(program: tuple, n_parts: int, args: list, has_perm: bool,
+               device=None):
+    """The ONE dispatch of a fused decode launch: every column of the
+    row group (levels, index streams, gathers, null scatters, the
+    optional fused output permutation) executes as a single compiled
+    call — ``engine.launches`` counts exactly 1 per in-cap group.  With
+    a persistent executable cache active (``PFTPU_EXEC_CACHE``,
+    :mod:`.exec_cache`), the compiled executable itself is resolved
+    memory → disk → fresh AOT compile, so a repeated shape signature
+    skips XLA compilation even across processes."""
+    from . import exec_cache
+
+    trace.count("engine.launches")
+    fn = _decode_fused_perm if has_perm else _decode_fused
+    return exec_cache.dispatch(fn, (program, n_parts), args, device=device)
+
+
 def _permuted_columns(cols: "Dict[str, DeviceColumn]", perm
                       ) -> "Dict[str, DeviceColumn]":
     """Row-permute already-decoded columns in one fused call — the
@@ -886,6 +903,7 @@ def _permuted_columns(cols: "Dict[str, DeviceColumn]", perm
         arrs = [dc.values, dc.mask, dc.lengths]
         layout.append((name, dc, [a is not None for a in arrs]))
         flat.extend(a for a in arrs if a is not None)
+    trace.count("engine.launches")  # the one follow-up gather dispatch
     taken = iter(_take_rows(perm, *flat))
     out: Dict[str, DeviceColumn] = {}
     for name, dc, have in layout:
@@ -2121,7 +2139,17 @@ class TpuRowGroupReader:
             self.reader.salvage_report.merge_in(unit_rep)
             self._unit_merged.add(index)
         self._unit_salvage[index] = unit_rep
-        out: Dict[str, DeviceColumn] = {}
+        # stage every surviving column's host arrays first, then ship
+        # them in ONE device_put call — the salvage recovery path keeps
+        # the engine's one-transfer discipline instead of paying a
+        # launch-queue round trip per column array
+        staged: list = []   # (name, desc, v_idx, m_idx, l_idx)
+        host_arrays: list = []
+
+        def _put(a) -> int:
+            host_arrays.append(a)
+            return len(host_arrays) - 1
+
         for cb in batch.columns:
             desc = cb.descriptor
             name = desc.path[0] if len(desc.path) == 1 else ".".join(desc.path)
@@ -2132,16 +2160,10 @@ class TpuRowGroupReader:
                     "use the host engine"
                 )
             dense, mask = cb.dense()
-            mask_dev = (
-                jax.device_put(np.asarray(mask), self.device)
-                if mask is not None else None
-            )
+            m_idx = -1 if mask is None else _put(np.asarray(mask))
             if isinstance(dense, ByteArrayColumn):
                 rows, lens, _ = _padded_rows(dense)
-                out[name] = DeviceColumn(
-                    desc, jax.device_put(rows, self.device), mask_dev,
-                    jax.device_put(lens, self.device),
-                )
+                staged.append((name, desc, _put(rows), m_idx, _put(lens)))
                 continue
             v = np.asarray(dense)
             if desc.physical_type == Type.DOUBLE:
@@ -2149,8 +2171,14 @@ class TpuRowGroupReader:
                     v = v.view(np.int64)
                 elif self._f64mode == "f32":
                     v = v.astype(np.float32)
+            staged.append((name, desc, _put(v), m_idx, -1))
+        shipped = jax.device_put(host_arrays, self.device)
+        out: Dict[str, DeviceColumn] = {}
+        for name, desc, v_idx, m_idx, l_idx in staged:
             out[name] = DeviceColumn(
-                desc, jax.device_put(v, self.device), mask_dev
+                desc, shipped[v_idx],
+                shipped[m_idx] if m_idx >= 0 else None,
+                shipped[l_idx] if l_idx >= 0 else None,
             )
         if out_perm is not None and not unit_rep.geometry_damaged(index):
             # a geometry-damaged group has fewer rows (or columns) than
@@ -2716,15 +2744,22 @@ class TpuRowGroupReader:
         with trace.span("decode", attrs={"file": sg.source,
                                          "row_group": sg.group_index,
                                          "rows": sg.num_rows}):
-            if out_perm is None:
-                outs = _decode_fused(
-                    sg.program, len(parts), *parts, slab_dev, *extra_args
-                )
-            else:
-                outs = _decode_fused_perm(
-                    sg.program, len(parts), *parts, slab_dev, *extra_args,
-                    out_perm,
-                )
+            args = [*parts, slab_dev, *extra_args]
+            if out_perm is not None:
+                perm = out_perm
+                if isinstance(perm, (list, tuple)) or (
+                    getattr(perm, "dtype", None) != np.int32
+                    and isinstance(perm, np.ndarray)
+                ):
+                    # normalize host perms to int32 (the documented
+                    # contract) so one program serves every caller;
+                    # device arrays pass through untouched (no D2H)
+                    perm = np.ascontiguousarray(perm, dtype=np.int32)
+                args.append(perm)
+            outs = _run_fused(
+                sg.program, len(parts), args, out_perm is not None,
+                device=self.device,
+            )
         result: Dict[str, DeviceColumn] = {}
         for spec, desc, (vals, mask, lens, defs, reps) in zip(
             sg.program, sg.descs, outs
@@ -2833,16 +2868,31 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
     exactly one copy of the submission loop, the drain-then-chunk
     big-group handling, and the tracer-scope threading.
 
-    Two dedicated single-worker pools make a true 3-stage pipeline: the
-    stage worker runs up to DEPTH tasks ahead (bounded: each staged
-    group pins a host arena), the ship worker transfers each group as
-    soon as it is staged AND the previous transfer is done (one in
-    flight — sync_transfers semantics; readers of one dataset share the
-    single ship worker, so transfers never interleave even across
-    files), and the consumer's thread dispatches the fused decode while
-    it materializes.  Steady-state throughput → max(stage, ship,
+    Two dedicated pools make a true 3-stage pipeline: the stage pool
+    runs up to DEPTH tasks ahead (bounded: each staged group pins a
+    host arena), the ship worker transfers each group as soon as it is
+    staged AND the previous transfer is done (one in flight —
+    sync_transfers semantics; readers of one dataset share the single
+    ship worker, so transfers never interleave even across files), and
+    the consumer's thread dispatches the fused decode while it
+    materializes.  Steady-state throughput → max(stage, ship,
     decode+consume) instead of their sum.  ``PFTPU_PREFETCH_DEPTH=1``
     restores single-group lookahead if memory is tight.
+
+    ``PFTPU_STAGE_WORKERS=k`` (default 1) sizes the STAGE pool: on
+    multi-file scans, k workers stage k different groups' pages
+    concurrently (read + decompress + plan are CPU/IO work that
+    parallelizes; the engine's shared state — shape-bucket HWMs,
+    dictionary pools, the sticky forced set — is lock-protected or
+    GIL-atomic, audited for exactly this).  The in-order admission
+    argument is unchanged: ship tasks enqueue on the single ship worker
+    in submission order and each blocks on ITS stage future, so
+    transfers and deliveries stay in task order no matter which stage
+    worker finishes first.  Note the shape buckets grow in STAGING
+    order, which k>1 makes nondeterministic — padded widths may differ
+    run to run (decoded values never do); leave k=1 where padding
+    byte-stability across runs matters.  ``engine.stage_queue_depth_max``
+    gauges how deep the submitted-but-undelivered queue actually got.
 
     Because tasks pull lazily, files open DEPTH-ahead of consumption
     and close right after their last scheduled group (``close_after``)
@@ -2893,7 +2943,19 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
             sg = stage_fut.result()
             return r, sg, r._ship(sg)
 
-        with ThreadPoolExecutor(max_workers=1,
+        stage_workers = min(DEPTH, max(1, int(
+            _os.environ.get("PFTPU_STAGE_WORKERS", "1")
+        )))
+        # salvage decodes mutate per-reader report state and must fold
+        # deterministically — they serialize through this lock even
+        # when the stage pool runs several workers
+        salv_lock = threading.Lock()
+
+        def salv_task(r, gi, perm):
+            with salv_lock:
+                return r._read_row_group_salvage(gi, columns, perm)
+
+        with ThreadPoolExecutor(max_workers=stage_workers,
                                 thread_name_prefix="pftpu-stage") as sp, \
                 ThreadPoolExecutor(max_workers=1,
                                    thread_name_prefix="pftpu-ship") as shp:
@@ -2915,11 +2977,9 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
                     return False
                 r, gi, ca, perm = norm(item)
                 if getattr(r, "_salvage", False):
-                    f = sp.submit(
-                        tracer.run, r._read_row_group_salvage, gi,
-                        columns, perm,
-                    )
+                    f = sp.submit(tracer.run, salv_task, r, gi, perm)
                     q.append(("salv", r, ca, f))
+                    trace.gauge_max("engine.stage_queue_depth_max", len(q))
                     return True
                 big = (
                     r._group_byte_estimate(r.reader.row_groups[gi], want)
@@ -2947,6 +3007,7 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
                         "pipe", r, ca, perm,
                         shp.submit(tracer.run, ship_task, r, f),
                     ))
+                trace.gauge_max("engine.stage_queue_depth_max", len(q))
                 return True
 
             for _ in range(DEPTH):

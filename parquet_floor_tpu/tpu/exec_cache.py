@@ -1,0 +1,360 @@
+"""Persistent AOT executable cache for the fused decode programs.
+
+First-touch XLA compilation dominates cold-start decode by orders of
+magnitude (BENCH_r05: steady-state 0.28 ms/group vs ~14 s first-group
+wall).  The programs themselves are deterministic functions of the file
+*shape signature* — schema kinds, encodings, bucketed arena/slab shapes,
+``out_perm`` presence — so a second process decoding a repeated schema
+recompiles executables the first process already built.  This module
+makes that compile a one-time cost per (signature, toolchain) pair:
+
+* **Key**: sha256 over a format version, the jax/jaxlib versions, the
+  backend platform + device kind (+ target device id) + ``jax_enable_x64``,
+  the fused program tuple (``_ColSpec``\\ s are NamedTuples of plain
+  values — their ``repr`` is the full static signature), the arena part
+  count, every input aval ``(shape, dtype)``, and whether the program
+  fuses an output permutation.  Two files differing in ANY of those get
+  distinct keys — sharing an executable across them would be wrong, so
+  the key is the correctness boundary, not a heuristic.
+* **Entries**: one file per key under the cache dir
+  (``PFTPU_EXEC_CACHE``), containing a magic + self-describing JSON
+  header (versions, backend — validated on load as defense in depth
+  beyond the hash) and the pickled
+  ``jax.experimental.serialize_executable.serialize`` payload.  Writes
+  go through a temp file + ``os.replace``, so concurrent processes
+  racing on one key each land a complete entry and readers never see a
+  partial one.
+* **Failure domain**: a corrupt, truncated, version-mismatched, or
+  runtime-incompatible entry falls through to a fresh ``lower().compile()``
+  — never to wrong results (the recompiled executable is the same XLA
+  program; outputs are bit-identical either way).  Backends whose
+  executables cannot serialize simply skip the store and behave like an
+  uncached process.
+
+Observability (all registered in ``trace.names``):
+``engine.exec_cache_hits`` / ``engine.exec_cache_misses`` count key
+RESOLUTIONS (first time a program is needed in this process: a disk
+load is a hit, a compile is a miss — in-memory reuse after that counts
+as neither), ``engine.compile_ms`` accumulates compile wall, and the
+``engine.exec_cache`` decision records each resolution's action.
+
+The cache is OFF unless ``PFTPU_EXEC_CACHE`` names a directory (or a
+:class:`ExecutableCache` is installed via :func:`activate`); when off,
+:func:`dispatch` is exactly the plain jit call.  Docs: ``docs/perf.md``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+import threading
+import time
+from typing import Optional
+
+from ..utils import trace
+
+_FORMAT = 1
+_MAGIC = b"PFEXEC1\n"
+_MAX_MEMORY = 128   # loaded executables kept per process (programs are
+#                     few: shape buckets converge by design)
+
+
+def _env_signature() -> dict:
+    """Everything about the runtime that an executable is compiled
+    against.  Part of the key hash AND the entry header (the header
+    check guards against hash collisions and hand-edited entries)."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    return {
+        "format": _FORMAT,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "x64": bool(jax.config.jax_enable_x64),
+    }
+
+
+_compile_lock = threading.Lock()
+
+
+def _compile_fresh(jitfn, static_args, args):
+    """``lower().compile()`` with jax's OWN persistent compilation
+    cache bypassed.  An executable jax's cache deserialized cannot be
+    re-serialized faithfully on XLA:CPU (the payload loads with
+    "Symbols not found"), so an entry built from one poisons every
+    later process — this cache must only ever serialize executables it
+    freshly compiled.  The flag flip is process-global; the lock keeps
+    concurrent resolutions from restoring it mid-compile (a concurrent
+    unrelated compile merely skips jax's cache once — slower, never
+    wrong)."""
+    import jax
+
+    with _compile_lock:
+        prev = bool(jax.config.jax_enable_compilation_cache)
+        if prev:
+            jax.config.update("jax_enable_compilation_cache", False)
+        try:
+            return jitfn.lower(*static_args, *args).compile()
+        finally:
+            if prev:
+                jax.config.update("jax_enable_compilation_cache", True)
+
+
+class _Entry:
+    """One resolved executable.  ``trusted`` flips after the first
+    successful call — a freshly DESERIALIZED executable gets one guarded
+    invocation, so an entry that loads but cannot run on this runtime
+    (driver/topology drift the header could not see) falls back to a
+    fresh compile instead of poisoning the decode path."""
+
+    __slots__ = ("loaded", "trusted")
+
+    def __init__(self, loaded, trusted: bool):
+        self.loaded = loaded
+        self.trusted = trusted
+
+
+class ExecutableCache:
+    """Disk + memory cache of AOT-compiled fused decode executables."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._mem: dict = {}         # key hex → _Entry
+        self._key_cache: dict = {}   # signature tuple → key hex
+        self._env = None             # computed lazily (needs a backend)
+
+    # -- keying --------------------------------------------------------------
+
+    def _key(self, sig: tuple) -> str:
+        with self._lock:
+            k = self._key_cache.get(sig)
+        if k is not None:
+            return k
+        if self._env is None:
+            self._env = _env_signature()
+        h = hashlib.sha256()
+        h.update(json.dumps(self._env, sort_keys=True).encode())
+        h.update(repr(sig).encode())
+        k = h.hexdigest()
+        with self._lock:
+            if len(self._key_cache) > 4 * _MAX_MEMORY:
+                self._key_cache.clear()
+            self._key_cache[sig] = k
+        return k
+
+    def _entry_path(self, key: str) -> str:
+        return os.path.join(self.path, f"{key}.pfexec")
+
+    # -- disk ----------------------------------------------------------------
+
+    def _load_disk(self, key: str):
+        """Deserialize one entry, or None on miss/corruption/mismatch.
+        Unreadable entries are removed so they cannot re-trip every
+        process (best-effort: a concurrent writer may already have
+        replaced them)."""
+        p = self._entry_path(key)
+        try:
+            with open(p, "rb") as fh:
+                blob = fh.read()
+        except OSError:
+            return None
+        try:
+            if blob[: len(_MAGIC)] != _MAGIC:
+                raise ValueError("bad magic")
+            off = len(_MAGIC)
+            hlen = int.from_bytes(blob[off : off + 4], "little")
+            off += 4
+            header = json.loads(blob[off : off + hlen])
+            off += hlen
+            if self._env is None:
+                self._env = _env_signature()
+            if header != self._env:
+                raise ValueError(
+                    f"header mismatch: entry {header}, runtime {self._env}"
+                )
+            from jax.experimental import serialize_executable as _se
+
+            payload = pickle.loads(blob[off:])
+            return _se.deserialize_and_load(*payload)
+        except (OSError, MemoryError):
+            raise
+        except Exception as e:
+            trace.decision("engine.exec_cache", {
+                "action": "corrupt_entry",
+                "key": key[:12],
+                "error": str(e)[:200],
+            })
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+            return None
+
+    def _store_disk(self, key: str, compiled) -> None:
+        """Serialize + atomically publish one entry (best-effort: an
+        unsupported backend or a full disk degrades to uncached, never
+        to an error on the decode path)."""
+        try:
+            from jax.experimental import serialize_executable as _se
+
+            payload = pickle.dumps(_se.serialize(compiled))
+            if self._env is None:
+                self._env = _env_signature()
+            header = json.dumps(self._env, sort_keys=True).encode()
+            os.makedirs(self.path, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=self.path, prefix=f".{key[:12]}.", suffix=".tmp"
+            )
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    fh.write(_MAGIC)
+                    fh.write(len(header).to_bytes(4, "little"))
+                    fh.write(header)
+                    fh.write(payload)
+                os.replace(tmp, self._entry_path(key))
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+        except MemoryError:
+            raise
+        except Exception as e:
+            # OSError included ON PURPOSE: a full disk or read-only
+            # cache dir degrades to uncached (the compiled executable
+            # still runs this process's decode), it must never fail a
+            # decode that already compiled successfully
+            trace.decision("engine.exec_cache", {
+                "action": "store_failed",
+                "key": key[:12],
+                "error": str(e)[:200],
+            })
+
+    # -- resolution ----------------------------------------------------------
+
+    def _compile(self, jitfn, static_args, args, key: str, why: str):
+        t0 = time.perf_counter()
+        compiled = _compile_fresh(jitfn, static_args, args)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        trace.count("engine.compile_ms", int(round(dt_ms)))
+        trace.decision("engine.exec_cache", {
+            "action": why,
+            "key": key[:12],
+            "compile_ms": round(dt_ms, 1),
+        })
+        self._store_disk(key, compiled)
+        return compiled
+
+    def call(self, jitfn, static_args: tuple, args: list, device=None):
+        """Run ``jitfn(*static_args, *args)`` through the cache: memory,
+        then disk, then a fresh AOT compile (stored for the next
+        process).  ``device`` is the reader's target device (None =
+        default) — part of the key, because an executable is bound to
+        the device its inputs live on: two readers pinned to different
+        devices must never share one.  Outputs are bit-identical on
+        every path — it is the same XLA program either way."""
+        aval_sig = tuple((tuple(a.shape), str(a.dtype)) for a in args)
+        dev_tag = "default" if device is None else (
+            f"{getattr(device, 'platform', '')}:{getattr(device, 'id', '')}"
+        )
+        sig = (static_args, aval_sig, dev_tag)
+        key = self._key(sig)
+        with self._lock:
+            entry = self._mem.get(key)
+        if entry is None:
+            loaded = self._load_disk(key)
+            if loaded is not None:
+                trace.count("engine.exec_cache_hits")
+                trace.decision("engine.exec_cache", {
+                    "action": "hit", "key": key[:12],
+                })
+                entry = _Entry(loaded, trusted=False)
+            else:
+                trace.count("engine.exec_cache_misses")
+                entry = _Entry(
+                    self._compile(jitfn, static_args, args, key, "miss"),
+                    trusted=True,
+                )
+            with self._lock:
+                if len(self._mem) >= _MAX_MEMORY:
+                    self._mem.pop(next(iter(self._mem)))
+                self._mem[key] = entry
+        if entry.trusted:
+            return entry.loaded(*args)
+        # first invocation of a deserialized executable: guarded, so an
+        # entry the header check could not reject (runtime drift) falls
+        # back to a fresh compile — a genuine input error will re-raise
+        # identically from the recompiled executable below
+        try:
+            out = entry.loaded(*args)
+        except (OSError, MemoryError):
+            raise
+        except Exception as e:
+            trace.decision("engine.exec_cache", {
+                "action": "load_unusable",
+                "key": key[:12],
+                "error": str(e)[:200],
+            })
+            try:
+                os.remove(self._entry_path(key))
+            except OSError:
+                pass
+            entry = _Entry(
+                self._compile(
+                    jitfn, static_args, args, key, "recompile"
+                ),
+                trusted=True,
+            )
+            with self._lock:
+                self._mem[key] = entry
+            return entry.loaded(*args)
+        entry.trusted = True
+        return out
+
+
+# ---------------------------------------------------------------------------
+# The active cache (env-configured; tests may install one explicitly)
+# ---------------------------------------------------------------------------
+
+_caches: dict = {}       # dir → ExecutableCache (one per distinct dir)
+_forced: Optional[ExecutableCache] = None
+_lock = threading.Lock()
+
+
+def activate(cache: Optional[ExecutableCache]) -> None:
+    """Install ``cache`` as the process-wide active cache regardless of
+    the environment (None restores env-driven resolution) — the test
+    hook; production configuration is the ``PFTPU_EXEC_CACHE`` dir."""
+    global _forced
+    _forced = cache
+
+
+def active() -> Optional[ExecutableCache]:
+    """The cache :func:`dispatch` will use right now, or None (off)."""
+    if _forced is not None:
+        return _forced
+    path = os.environ.get("PFTPU_EXEC_CACHE")
+    if not path:
+        return None
+    with _lock:
+        c = _caches.get(path)
+        if c is None:
+            c = _caches[path] = ExecutableCache(path)
+        return c
+
+
+def dispatch(jitfn, static_args: tuple, args: list, device=None):
+    """The engine's one fused-launch entry point: the plain jit call
+    when the cache is off, :meth:`ExecutableCache.call` when on."""
+    cache = active()
+    if cache is None:
+        return jitfn(*static_args, *args)
+    return cache.call(jitfn, static_args, args, device=device)

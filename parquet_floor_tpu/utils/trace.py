@@ -69,6 +69,11 @@ class names:
         "scan.cache_miss_bytes",
         "io.retries",
         "io.retry_exhausted",
+        # the device decode launch path (tpu/engine.py, docs/perf.md)
+        "engine.launches",
+        "engine.exec_cache_hits",
+        "engine.exec_cache_misses",
+        "engine.compile_ms",
         # the remote-storage failure domain (io/remote.py, docs/remote.md)
         "io.remote.requests",
         "io.remote.bytes",
@@ -95,15 +100,19 @@ class names:
         "data.epochs_completed",
         "data.units_scheduled",
         "data.units_quarantined",
+        "data.prefetch_to_device_batches",
     })
     GAUGES = frozenset({
         "scan.inflight_bytes_max",
         "scan.queue_depth_max",
         "scan.adaptive_budget_bytes",
+        "engine.stage_queue_depth_max",
         "data.carry_rows_max",
+        "data.prefetch_to_device_depth_max",
     })
     DECISIONS = frozenset({
         "engine.auto",
+        "engine.exec_cache",
         "chunk_fallback",
         "io.retry",
         "io.retry_exhausted",
@@ -129,20 +138,32 @@ class names:
         "stage",
         "ship",
         "decode",
+        "decode_chunk",
         "assemble",
         "io.read",
         "io.remote.get",
         "scan.consumer_stall",
         "data.next_batch",
+        "data.prefetch_to_device",
     })
     ALL = COUNTERS | GAUGES | DECISIONS | SPANS
 
 
 @dataclass
 class StageStat:
+    """Per-stage accumulator.  ``seconds`` is INCLUSIVE wall (what it
+    always was); ``self_seconds`` is the stage's EXCLUSIVE time — the
+    same spans minus any nested span recorded on the same thread of the
+    same tracer.  Nested stages (the host reader's per-chunk
+    ``decode_chunk`` spans under the scan executor's group ``decode``
+    span) therefore never double-count in a sum over ``self_seconds``,
+    while each stage's inclusive total stays directly comparable to the
+    pre-nesting numbers."""
+
     count: int = 0
     seconds: float = 0.0
     bytes: int = 0
+    self_seconds: float = 0.0
 
     def as_dict(self) -> dict:
         mbps = (self.bytes / self.seconds / 1e6) if self.seconds else 0.0
@@ -151,6 +172,7 @@ class StageStat:
             "seconds": round(self.seconds, 6),
             "bytes": self.bytes,
             "MB_per_s": round(mbps, 1),
+            "self_seconds": round(self.self_seconds, 6),
         }
 
 
@@ -193,13 +215,26 @@ class _Span:
         self._nbytes += int(n)
 
     def __enter__(self):
+        # per-thread nesting stack (child-time accumulators): what turns
+        # inclusive span walls into the exclusive ``self_seconds`` stats
+        stack = getattr(self._tracer._tls, "stack", None)
+        if stack is None:
+            stack = self._tracer._tls.stack = []
+        stack.append(0.0)
         self._t0 = time.perf_counter()
         self._tracer._event("B", self._stage, self._t0, self._attrs)
         return self
 
     def __exit__(self, *exc):
         t1 = time.perf_counter()
-        self._tracer.add(self._stage, t1 - self._t0, self._nbytes)
+        dur = t1 - self._t0
+        stack = self._tracer._tls.stack
+        child = stack.pop()
+        if stack:
+            stack[-1] += dur
+        self._tracer.add(
+            self._stage, dur, self._nbytes, self_seconds=dur - child
+        )
         self._tracer._event("E", self._stage, t1, None)
         return False
 
@@ -363,13 +398,19 @@ class ScanReport:
         for r in reports:
             for name, st in r.stages.items():
                 acc = stages.setdefault(
-                    name, {"count": 0, "seconds": 0.0, "bytes": 0}
+                    name,
+                    {"count": 0, "seconds": 0.0, "bytes": 0,
+                     "self_seconds": 0.0},
                 )
                 acc["count"] += int(st.get("count", 0))
                 acc["seconds"] += float(st.get("seconds", 0.0))
                 acc["bytes"] += int(st.get("bytes", 0))
+                acc["self_seconds"] += float(
+                    st.get("self_seconds", st.get("seconds", 0.0))
+                )
         for st in stages.values():
             st["seconds"] = round(st["seconds"], 6)
+            st["self_seconds"] = round(st["self_seconds"], 6)
             st["MB_per_s"] = round(
                 (st["bytes"] / st["seconds"] / 1e6) if st["seconds"] else 0.0,
                 1,
@@ -508,6 +549,7 @@ class Tracer:
         self.max_decisions = int(max_decisions)
         self.max_events = int(max_events)
         self._lock = threading.Lock()
+        self._tls = threading.local()   # per-thread span nesting stack
         self._stats: Dict[str, StageStat] = {}
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, int] = {}
@@ -640,9 +682,26 @@ class Tracer:
 
     # -- spans / stats ------------------------------------------------------
 
-    def add(self, stage: str, seconds: float, nbytes: int = 0) -> None:
+    def add(self, stage: str, seconds: float, nbytes: int = 0,
+            self_seconds: Optional[float] = None) -> None:
+        """Accumulate one span's worth of wall/bytes.
+
+        A BARE ``add`` (``self_seconds`` omitted) records time the
+        caller just spent on this thread — all of it exclusive
+        (``self_seconds = seconds``), and charged to the enclosing open
+        span's child accumulator so the parent's exclusive time
+        excludes it (the scan executor's ``scan.consumer_stall`` under
+        the loader's ``data.next_batch`` span is the motivating case —
+        summing ``self_seconds`` must never count one second twice).
+        Live spans pass ``self_seconds`` explicitly (their wall minus
+        nested child time) and do their own parent charging on exit."""
         if not self._enabled:
             return
+        if self_seconds is None:
+            self_seconds = seconds
+            stack = getattr(self._tls, "stack", None)
+            if stack:
+                stack[-1] += seconds
         with self._lock:
             st = self._stats.get(stage)
             if st is None:
@@ -650,6 +709,7 @@ class Tracer:
             st.count += 1
             st.seconds += seconds
             st.bytes += nbytes
+            st.self_seconds += self_seconds
 
     def span(self, stage: str, nbytes: int = 0,
              attrs: Optional[dict] = None):
@@ -890,9 +950,10 @@ def decisions() -> list:
     return current().decisions()
 
 
-def add(stage: str, seconds: float, nbytes: int = 0) -> None:
+def add(stage: str, seconds: float, nbytes: int = 0,
+        self_seconds: Optional[float] = None) -> None:
     t = _active.get()
-    (_global if t is None else t).add(stage, seconds, nbytes)
+    (_global if t is None else t).add(stage, seconds, nbytes, self_seconds)
 
 
 def span(stage: str, nbytes: int = 0, attrs: Optional[dict] = None):

@@ -20,18 +20,26 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" | tr -cd .
 [ "$rc" -eq 0 ] || exit "$rc"
 
 # Fast bench smoke: every leg of bench.py (headline decode, batch face,
-# chunked, multi-file scan) runs at toy scale on the CPU backend, so a
-# broken decode path fails THIS gate instead of only the nightly bench.
-# The numbers are health indicators, not perf records.  Tracing is ON
-# (PFTPU_TRACE=1) and the scan leg exports its ScanReport + Chrome
-# trace, which check_bench_report.py then validates — a broken
-# observability export fails the gate too (docs/observability.md).
-echo "== bench smoke (PFTPU_BENCH_ROWS=2000, PFTPU_TRACE=1) =="
+# chunked, multi-file scan, exec-cache cold/warm) runs at toy scale on
+# the CPU backend, so a broken decode path fails THIS gate instead of
+# only the nightly bench.  The numbers are health indicators, not perf
+# records.  Tracing is ON (PFTPU_TRACE=1) and the scan leg exports its
+# ScanReport + Chrome trace, which check_bench_report.py then validates
+# — a broken observability export fails the gate too
+# (docs/observability.md).  The bench itself runs with a fresh
+# PFTPU_EXEC_CACHE dir, so EVERY fused decode in the smoke rides the
+# persistent-executable-cache dispatch path (its bit-exact checks then
+# cover it); the exec-cache leg additionally runs one COLD and one WARM
+# subprocess against one shared cache dir and check_bench_report
+# asserts the >=10x warm-start shape (docs/perf.md).
+echo "== bench smoke (PFTPU_BENCH_ROWS=2000, PFTPU_TRACE=1, exec cache on) =="
 bench_log="$(mktemp /tmp/_bench.XXXXXX.log)"
 bench_trace="$(mktemp /tmp/_btrace.XXXXXX.json)"
-trap 'rm -f "$t1_log" "$bench_log" "$bench_trace"' EXIT
+bench_cache="$(mktemp -d /tmp/_bcache.XXXXXX)"
+trap 'rm -rf "$t1_log" "$bench_log" "$bench_trace" "$bench_cache"' EXIT
 timeout -k 10 600 env JAX_PLATFORMS=cpu PFTPU_TRACE=1 PFTPU_BENCH_ROWS=2000 \
-  PFTPU_BENCH_REPS=1 PFTPU_TRACE_EXPORT="$bench_trace" python bench.py \
+  PFTPU_BENCH_REPS=1 PFTPU_TRACE_EXPORT="$bench_trace" \
+  PFTPU_EXEC_CACHE="$bench_cache" python bench.py \
   | tee "$bench_log"
 [ "${PIPESTATUS[0]}" -eq 0 ] || exit 1
 python scripts/check_bench_report.py "$bench_log" "$bench_trace" || exit 1

@@ -58,8 +58,77 @@ def check_report(bench_log: pathlib.Path) -> int:
           f"{rep['bytes_read']} bytes read)")
     return (
         check_remote_leg(result.get("detail", {}))
+        or check_exec_cache_leg(result.get("detail", {}))
+        or check_launches(result.get("detail", {}))
         or check_loader_leg(result.get("detail", {}))
     )
+
+
+def check_exec_cache_leg(detail: dict) -> int:
+    """The persistent-executable-cache leg (docs/perf.md): the cold
+    subprocess must have compiled (misses >= 1) and the warm one must
+    not (hits >= 1, zero compile wall), the warm first-group wall must
+    be >= 10x better, and both runs' decoded digests bit-identical —
+    the cache may only ever change WHEN compilation happens, never what
+    decodes."""
+    cold_wall = detail.get("exec_cache_cold_first_group_wall_ms")
+    warm_wall = detail.get("exec_cache_warm_first_group_wall_ms")
+    if not cold_wall or not warm_wall:
+        return fail("exec-cache leg missing first-group walls")
+    if not detail.get("exec_cache_cold_misses", 0) >= 1:
+        return fail("exec-cache cold run resolved no executable (miss)")
+    if not detail.get("exec_cache_cold_compile_ms", 0) > 0:
+        return fail("exec-cache cold run recorded no compile wall")
+    if not detail.get("exec_cache_warm_hits", 0) >= 1:
+        return fail("exec-cache warm run hit nothing — the persisted "
+                    "entry was not loaded")
+    if detail.get("exec_cache_warm_misses", 0) != 0:
+        return fail("exec-cache warm run recompiled "
+                    f"({detail['exec_cache_warm_misses']} miss(es))")
+    if detail.get("exec_cache_warm_compile_ms", 0) != 0:
+        return fail("exec-cache warm run spent compile wall "
+                    f"({detail['exec_cache_warm_compile_ms']} ms)")
+    if detail.get("exec_cache_bit_identical") is not True:
+        return fail("exec-cache warm decode is not bit-identical to cold")
+    for k in ("exec_cache_cold_launches", "exec_cache_warm_launches"):
+        if detail.get(k) != 1:
+            return fail(f"{k} is {detail.get(k)!r}, expected exactly 1 "
+                        "(one fused launch per in-cap row group)")
+    speedup = cold_wall / warm_wall
+    if not speedup >= 10.0:
+        return fail(f"exec-cache warm start is only {speedup:.1f}x better "
+                    f"than cold ({warm_wall} ms vs {cold_wall} ms) — "
+                    "the persisted cache should eliminate the compile")
+    print(
+        "check_bench_report: exec-cache leg ok "
+        f"(cold {cold_wall} ms -> warm {warm_wall} ms, {speedup:.1f}x; "
+        f"cold compile {detail['exec_cache_cold_compile_ms']} ms)"
+    )
+    return 0
+
+
+def check_launches(detail: dict) -> int:
+    """The one-launch contract on the scan leg's counted pass: exactly
+    one fused dispatch per delivered IN-CAP row group.  Groups past the
+    arena cap legitimately take the multi-launch chunked fallback
+    (docs/perf.md) — with any present, the strict equality relaxes to a
+    floor."""
+    groups = detail.get("scan_groups")
+    launches = detail.get("scan_launches")
+    overcap = detail.get("scan_overcap_groups", 0)
+    if not groups or not groups > 0:
+        return fail("scan leg delivered no groups")
+    if overcap == 0 and launches != groups:
+        return fail(f"scan leg dispatched {launches} launches for "
+                    f"{groups} in-cap row groups — the fused path must "
+                    "be exactly one launch per in-cap group")
+    if overcap > 0 and not launches >= groups:
+        return fail(f"scan leg dispatched {launches} launches for "
+                    f"{groups} groups ({overcap} over-cap) — fewer "
+                    "launches than groups is impossible")
+    print(f"check_bench_report: one-launch ok ({launches} launches / "
+          f"{groups} groups, {overcap} over-cap)")
+    return 0
 
 
 def check_remote_leg(detail: dict) -> int:
@@ -120,11 +189,17 @@ def check_loader_leg(detail: dict) -> int:
         return fail("loader leg emitted no batches")
     if detail.get("loader_set_exact") is not True:
         return fail("shuffled loader stream is not set-exact vs unshuffled")
+    ratio = detail.get("loader_prefetch_vs_scan_x")
+    if ratio is None or not ratio >= 1.0:
+        return fail(f"double-buffered loader leg at {ratio}x raw scan "
+                    "throughput — prefetch_to_device must clear 1.0x "
+                    "(docs/perf.md)")
     print(
         "check_bench_report: loader leg ok "
         f"({detail['loader_batches']} batches, "
         f"{detail['loader_rows_per_sec']} rows/s, "
-        f"vs scan x{detail.get('loader_vs_scan_x')})"
+        f"vs scan x{detail.get('loader_vs_scan_x')}, "
+        f"prefetch x{ratio})"
     )
     return 0
 

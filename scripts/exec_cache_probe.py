@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""One cold- or warm-start measurement for the persistent executable
+cache (docs/perf.md) — the subprocess half of bench.py's exec-cache leg.
+
+Usage: exec_cache_probe.py PARQUET_FILE CACHE_DIR
+
+Decodes row group 0 of ``PARQUET_FILE`` through the TPU engine with
+``PFTPU_EXEC_CACHE=CACHE_DIR`` and prints ONE JSON line::
+
+    {"first_group_wall_ms": ..., "compile_ms": ..., "exec_cache_hits": ...,
+     "exec_cache_misses": ..., "launches": ..., "digest": ...}
+
+Run it twice from fresh processes against the same cache dir and the
+first run is the COLD measurement (compile + store), the second the
+WARM one (deserialize, no compile).  ``digest`` is a CRC of every
+decoded array — the two runs must match bit-for-bit (the cache must
+never change results, only when compilation happens).
+"""
+
+import json
+import os
+import sys
+import time
+import zlib
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv) -> int:
+    if len(argv) != 3:
+        print("usage: exec_cache_probe.py PARQUET_FILE CACHE_DIR",
+              file=sys.stderr)
+        return 2
+    path, cache_dir = argv[1], argv[2]
+    os.environ["PFTPU_EXEC_CACHE"] = cache_dir
+
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+    from parquet_floor_tpu.utils import trace
+
+    with trace.scope() as t:
+        with TpuRowGroupReader(path, float64_policy="bits") as tr:
+            t0 = time.perf_counter()
+            cols = tr.read_row_group(0)
+            jax.block_until_ready([c.values for c in cols.values()])
+            wall = time.perf_counter() - t0
+            digest = 0
+            for name in sorted(cols):
+                c = cols[name]
+                for a in (c.values, c.mask, c.lengths):
+                    if a is not None:
+                        digest = zlib.crc32(
+                            np.ascontiguousarray(np.asarray(a)).tobytes(),
+                            digest,
+                        )
+    counters = t.counters()
+    print(json.dumps({
+        "first_group_wall_ms": round(wall * 1e3, 1),
+        "compile_ms": counters.get("engine.compile_ms", 0),
+        "exec_cache_hits": counters.get("engine.exec_cache_hits", 0),
+        "exec_cache_misses": counters.get("engine.exec_cache_misses", 0),
+        "launches": counters.get("engine.launches", 0),
+        "digest": digest,
+        # the resolution trail (hit / miss / corrupt_entry / …): what a
+        # failing cold/warm assertion needs to be diagnosable from logs
+        "decisions": [
+            d for d in t.decisions()
+            if d.get("decision") == "engine.exec_cache"
+        ],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -972,3 +972,77 @@ def test_salvage_report_merge_is_associative_across_threads(damaged_dataset):
         SalvageReport.merge(reports[0]).as_dict()
         for r in reports
     )
+
+
+# ---------------------------------------------------------------------------
+# device double-buffering: prefetch_to_device (docs/perf.md)
+# ---------------------------------------------------------------------------
+
+
+def _prefetch_stream(paths, engine, depth, **kw):
+    kw.setdefault("shuffle_seed", 7)
+    kw.setdefault("shuffle_window", 512)
+    kw.setdefault("num_epochs", 2)
+    kw.setdefault("drop_remainder", False)
+    ld = DataLoader(paths, 256, engine=engine, **kw)
+    out = [_batch_bytes(b) for b in ld.prefetch_to_device(depth)]
+    ld.close()
+    return out
+
+
+@pytest.mark.parametrize("engine", ["host", "tpu"])
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_prefetch_to_device_stream_is_identical(dataset, engine, depth):
+    """Double-buffering reorders WHEN work happens, never what comes
+    out: the prefetched stream is bit-identical to plain iteration."""
+    assert _prefetch_stream(dataset, engine, depth) == \
+        _stream(dataset, engine=engine)
+
+
+def test_prefetch_to_device_counters(dataset):
+    with trace.scope() as t:
+        ld = DataLoader(dataset, 256, shuffle_seed=7, num_epochs=1,
+                        drop_remainder=False, engine="host")
+        n = sum(1 for _ in ld.prefetch_to_device(3))
+        ld.close()
+    c = t.counters()
+    assert c.get("data.prefetch_to_device_batches") == n
+    assert 1 <= t.gauges().get("data.prefetch_to_device_depth_max", 0) <= 3
+
+
+@pytest.mark.parametrize("at", [0, 1, 3, 7])
+def test_prefetch_state_resumes_at_the_consumed_batch(dataset, at):
+    """The prefetcher's state() reflects the last batch the CONSUMER
+    saw, not the pulled-ahead loader position: restoring it replays the
+    buffered batches too, bit-identical to the uninterrupted run."""
+    ref = _stream(dataset, engine="host")
+    ld = DataLoader(dataset, 256, shuffle_seed=7, shuffle_window=512,
+                    num_epochs=2, drop_remainder=False, engine="host")
+    pf = ld.prefetch_to_device(3)
+    head = [_batch_bytes(next(pf)) for _ in range(at)]
+    state = json.loads(json.dumps(pf.state()))
+    ld.close()
+    ld2 = DataLoader(dataset, 256, shuffle_seed=7, shuffle_window=512,
+                     num_epochs=2, drop_remainder=False,
+                     engine="host").restore(state)
+    tail = [_batch_bytes(b) for b in ld2]
+    ld2.close()
+    assert head + tail == ref
+
+
+def test_prefetch_device_batches_stay_jax_arrays(dataset):
+    import jax
+
+    ld = DataLoader(dataset, 256, shuffle_seed=3, num_epochs=1,
+                    engine="tpu", float64_policy="bits")
+    pf = ld.prefetch_to_device(2)
+    b = next(pf)
+    assert all(isinstance(c.values, jax.Array) for c in b.columns)
+    ld.close()
+
+
+def test_prefetch_depth_validation(dataset):
+    ld = DataLoader(dataset, 256, num_epochs=1)
+    with pytest.raises(ValueError):
+        ld.prefetch_to_device(0)
+    ld.close()

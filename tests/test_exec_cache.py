@@ -1,0 +1,477 @@
+"""Persistent AOT executable cache (docs/perf.md): key correctness, the
+corrupt/stale failure domain, cross-process concurrency, and the
+one-launch contract the cache dispatches under."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from parquet_floor_tpu import (
+    ParquetFileWriter,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.tpu import exec_cache
+from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+from parquet_floor_tpu.utils import trace
+
+rng = np.random.default_rng(77)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_cache(monkeypatch):
+    """Every test starts with the cache OFF and no leaked forced cache."""
+    monkeypatch.delenv("PFTPU_EXEC_CACHE", raising=False)
+    exec_cache.activate(None)
+    yield
+    exec_cache.activate(None)
+
+
+def _write(tmp_path, name="t.parquet", n=600, group=300, options=None):
+    """A 3-column file written GROUP rows per row group (write_columns
+    emits one group per call)."""
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("a"),
+        types.optional(types.INT32).named("b"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    path = tmp_path / name
+    with ParquetFileWriter(
+        path, schema,
+        options or WriterOptions(row_group_rows=group, data_page_values=group),
+    ) as w:
+        for lo in range(0, n, group):
+            m = min(group, n - lo)
+            w.write_columns({
+                "a": rng.integers(0, 50, m).astype(np.int64),
+                "b": [None if i % 5 == 0 else i % 40 for i in range(m)],
+                "s": [f"v{i % 30}" for i in range(m)],
+            })
+    return path
+
+
+def _decode(path, cache_dir=None, out_perm=None, columns=None):
+    """Decode group 0 under a fresh tracer scope; returns (cols-as-
+    numpy, counters).  ``cache_dir`` installs a FRESH ExecutableCache
+    (empty memory — the disk is the only carry-over, exactly like a new
+    process)."""
+    exec_cache.activate(
+        exec_cache.ExecutableCache(str(cache_dir)) if cache_dir else None
+    )
+    try:
+        with trace.scope() as t:
+            with TpuRowGroupReader(path, float64_policy="bits") as tr:
+                cols = tr.read_row_group(0, columns=columns,
+                                         out_perm=out_perm)
+                jax.block_until_ready([c.values for c in cols.values()])
+                out = {
+                    k: (
+                        np.asarray(v.values),
+                        None if v.mask is None else np.asarray(v.mask),
+                        None if v.lengths is None else np.asarray(v.lengths),
+                    )
+                    for k, v in cols.items()
+                }
+        return out, t.counters()
+    finally:
+        exec_cache.activate(None)
+
+
+def _assert_same(a, b):
+    assert a.keys() == b.keys()
+    for k in a:
+        for x, y in zip(a[k], b[k]):
+            if x is None:
+                assert y is None
+            else:
+                np.testing.assert_array_equal(x, y, err_msg=k)
+
+
+def _entries(cache_dir):
+    return sorted(p for p in os.listdir(cache_dir) if p.endswith(".pfexec"))
+
+
+# -- hit/miss + bit-identity --------------------------------------------------
+
+def test_warm_cache_skips_compile_bit_identically(tmp_path):
+    path = _write(tmp_path)
+    d = tmp_path / "cache"
+    ref, _ = _decode(path)                       # uncached reference
+    cold, cc = _decode(path, cache_dir=d)        # cold: compile + store
+    assert cc.get("engine.exec_cache_misses") == 1
+    assert cc.get("engine.exec_cache_hits", 0) == 0
+    assert cc.get("engine.compile_ms", 0) > 0
+    assert len(_entries(d)) == 1
+    warm, wc = _decode(path, cache_dir=d)        # fresh cache object ≙ 2nd process
+    assert wc.get("engine.exec_cache_hits") == 1
+    assert wc.get("engine.exec_cache_misses", 0) == 0
+    assert wc.get("engine.compile_ms", 0) == 0
+    _assert_same(ref, cold)
+    _assert_same(ref, warm)
+
+
+def test_cache_off_without_env_or_activation(tmp_path):
+    path = _write(tmp_path)
+    _, c = _decode(path)
+    assert "engine.exec_cache_misses" not in c
+    assert "engine.exec_cache_hits" not in c
+    assert c.get("engine.launches") == 1
+
+
+# -- key separation -----------------------------------------------------------
+
+def test_keys_distinct_by_encoding_set(tmp_path):
+    """Two files differing ONLY in encoding (dictionary vs PLAIN int
+    columns) must not share an executable."""
+    d = tmp_path / "cache"
+    p1 = _write(tmp_path, "dict.parquet")
+    p2 = _write(tmp_path, "plain.parquet",
+                options=WriterOptions(row_group_rows=300,
+                                      data_page_values=300,
+                                      enable_dictionary=False))
+    _decode(p1, cache_dir=d)
+    one = _entries(d)
+    _, c2 = _decode(p2, cache_dir=d)
+    assert c2.get("engine.exec_cache_misses") == 1  # no false hit
+    assert len(_entries(d)) == len(one) + 1
+
+
+def test_keys_distinct_by_shape_bucket(tmp_path):
+    """Different bucketed group shapes compile different programs —
+    each keys its own entry."""
+    d = tmp_path / "cache"
+    _decode(_write(tmp_path, "n300.parquet", n=300, group=300), cache_dir=d)
+    n1 = len(_entries(d))
+    _, c = _decode(
+        _write(tmp_path, "n900.parquet", n=900, group=900), cache_dir=d
+    )
+    assert c.get("engine.exec_cache_misses") == 1
+    assert len(_entries(d)) == n1 + 1
+
+
+def test_keys_distinct_by_out_perm_presence(tmp_path):
+    path = _write(tmp_path)
+    d = tmp_path / "cache"
+    ref, _ = _decode(path)
+    _decode(path, cache_dir=d)
+    n1 = len(_entries(d))
+    perm = np.arange(300, dtype=np.int32)[::-1].copy()
+    permed, c = _decode(path, cache_dir=d, out_perm=perm)
+    assert c.get("engine.exec_cache_misses") == 1   # separate program
+    assert len(_entries(d)) == n1 + 1
+    for k in ref:
+        vals, mask, lens = permed[k]
+        np.testing.assert_array_equal(vals, ref[k][0][::-1], err_msg=k)
+    # warm hit on the perm-fused program replays bit-identically
+    permed2, c2 = _decode(path, cache_dir=d, out_perm=perm)
+    assert c2.get("engine.exec_cache_hits") == 1
+    _assert_same(permed, permed2)
+
+
+# -- failure domain -----------------------------------------------------------
+
+def test_corrupt_entry_falls_back_to_fresh_compile(tmp_path):
+    path = _write(tmp_path)
+    d = tmp_path / "cache"
+    cold, _ = _decode(path, cache_dir=d)
+    (entry,) = _entries(d)
+    (d / entry).write_bytes(b"garbage" * 100)
+    warm, c = _decode(path, cache_dir=d)
+    assert c.get("engine.exec_cache_misses") == 1   # corrupt ⇒ miss
+    assert c.get("engine.compile_ms", 0) > 0
+    _assert_same(cold, warm)
+    # the fresh compile re-published a loadable entry
+    again, c2 = _decode(path, cache_dir=d)
+    assert c2.get("engine.exec_cache_hits") == 1
+    _assert_same(cold, again)
+
+
+def test_truncated_entry_falls_back(tmp_path):
+    path = _write(tmp_path)
+    d = tmp_path / "cache"
+    cold, _ = _decode(path, cache_dir=d)
+    (entry,) = _entries(d)
+    blob = (d / entry).read_bytes()
+    (d / entry).write_bytes(blob[: len(blob) // 3])
+    warm, c = _decode(path, cache_dir=d)
+    assert c.get("engine.exec_cache_misses") == 1
+    _assert_same(cold, warm)
+
+
+def test_version_mismatched_entry_is_a_miss(tmp_path):
+    """An entry whose header names a different toolchain must be
+    ignored (defense in depth past the key hash) — decode falls back to
+    a fresh compile, bit-identically."""
+    import json as _json
+
+    path = _write(tmp_path)
+    d = tmp_path / "cache"
+    cold, _ = _decode(path, cache_dir=d)
+    (entry,) = _entries(d)
+    blob = (d / entry).read_bytes()
+    off = len(b"PFEXEC1\n")
+    hlen = int.from_bytes(blob[off : off + 4], "little")
+    header = _json.loads(blob[off + 4 : off + 4 + hlen])
+    header["jax"] = "0.0.0-stale"
+    new_header = _json.dumps(header, sort_keys=True).encode()
+    (d / entry).write_bytes(
+        blob[:off]
+        + len(new_header).to_bytes(4, "little")
+        + new_header
+        + blob[off + 4 + hlen :]
+    )
+    warm, c = _decode(path, cache_dir=d)
+    assert c.get("engine.exec_cache_misses") == 1
+    assert c.get("engine.exec_cache_hits", 0) == 0
+    _assert_same(cold, warm)
+
+
+def test_concurrent_processes_racing_one_key(tmp_path):
+    """Two cache objects (≙ two processes) compiling + publishing the
+    same key concurrently: both land complete entries (atomic replace),
+    and a third loader reads a valid one."""
+    path = _write(tmp_path)
+    d = tmp_path / "cache"
+    results = {}
+    errs = []
+
+    def race(tag):
+        try:
+            results[tag] = _decode_with_own_cache(path, d)
+        except BaseException as e:  # pragma: no cover - diagnostic
+            errs.append(e)
+
+    def _decode_with_own_cache(path, d):
+        cache = exec_cache.ExecutableCache(str(d))
+        with trace.scope():
+            with TpuRowGroupReader(path, float64_policy="bits") as tr:
+                sg = tr._stage_row_group(0, None)
+                shipped = tr._ship(sg)
+                parts = (
+                    shipped[0] if isinstance(shipped[0], tuple)
+                    else (shipped[0],)
+                )
+                # the full launch arg list, extras included, exactly as
+                # _decode_shipped builds it
+                extra_args = []
+                for key in sg.extra_keys:
+                    rows_d, lens_d = tr._sdict_dev[key]
+                    extra_args.extend((rows_d, lens_d))
+                args = [*parts, shipped[1], *extra_args]
+                from parquet_floor_tpu.tpu.engine import _decode_fused
+
+                outs = cache.call(
+                    _decode_fused, (sg.program, len(parts)), args
+                )
+                jax.block_until_ready([o[0] for o in outs])
+                return [np.asarray(o[0]) for o in outs]
+
+    t1 = threading.Thread(target=race, args=("a",))
+    t2 = threading.Thread(target=race, args=("b",))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert not errs
+    assert len(_entries(d)) == 1
+    for x, y in zip(results["a"], results["b"]):
+        np.testing.assert_array_equal(x, y)
+    # the published entry is loadable by a fresh "process"
+    _, c = _decode(path, cache_dir=d)
+    assert c.get("engine.exec_cache_hits") == 1
+
+
+# -- one-launch contract ------------------------------------------------------
+
+def test_in_cap_group_is_exactly_one_launch(tmp_path):
+    path = _write(tmp_path)
+    with trace.scope() as t:
+        with TpuRowGroupReader(path, float64_policy="bits") as tr:
+            cols = tr.read_row_group(0)
+            jax.block_until_ready([c.values for c in cols.values()])
+    assert t.counters().get("engine.launches") == 1
+
+
+def test_chunked_fallback_launches_more_but_matches(tmp_path, monkeypatch):
+    from parquet_floor_tpu import ParquetFileReader
+
+    path = _write(tmp_path, n=900, group=900)
+    ref, _ = _decode(path)
+    with ParquetFileReader(path) as r:
+        est = sum(
+            int(c.meta_data.total_uncompressed_size or 0)
+            for c in (r.row_groups[0].columns or [])
+        )
+    cap = max(est // 3, 1 << 9)   # force the multi-launch column bins
+    monkeypatch.setenv("PFTPU_ARENA_CAP", str(cap))
+    with trace.scope() as t:
+        with TpuRowGroupReader(path, float64_policy="bits") as tr:
+            assert tr._arena_cap == cap
+            cols = tr.read_row_group(0)
+            jax.block_until_ready([c.values for c in cols.values()])
+            got = {
+                k: (
+                    np.asarray(v.values),
+                    None if v.mask is None else np.asarray(v.mask),
+                    None if v.lengths is None else np.asarray(v.lengths),
+                )
+                for k, v in cols.items()
+            }
+    assert t.counters().get("engine.launches", 0) > 1
+    # bit-exact across the multi-launch fallback (strings: same bucket
+    # discipline — compare through lengths)
+    for k in ref:
+        rv, rm, rl = ref[k]
+        gv, gm, gl = got[k]
+        if rl is not None:
+            np.testing.assert_array_equal(gl, rl, err_msg=k)
+            w = min(rv.shape[1], gv.shape[1])
+            ix = np.arange(w)[None, :]
+            keep = ix < rl[:, None]
+            np.testing.assert_array_equal(
+                np.where(keep, gv[:, :w], 0), np.where(keep, rv[:, :w], 0),
+                err_msg=k,
+            )
+        else:
+            np.testing.assert_array_equal(gv, rv, err_msg=k)
+        if rm is not None:
+            np.testing.assert_array_equal(gm, rm, err_msg=k)
+
+
+# -- k concurrent stage workers (scan-scheduler carry-over) -------------------
+
+def _write_plain_ints(tmp_path, name, n=800, group=200, seed=0):
+    r = np.random.default_rng(seed)
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("x"),
+        types.optional(types.INT32).named("y"),
+    )
+    path = tmp_path / name
+    with ParquetFileWriter(
+        path, schema,
+        WriterOptions(row_group_rows=group, data_page_values=group),
+    ) as w:
+        for lo in range(0, n, group):
+            m = min(group, n - lo)
+            w.write_columns({
+                "x": r.integers(0, 1 << 40, m).astype(np.int64),
+                "y": [None if i % 3 == 0 else lo + i for i in range(m)],
+            })
+    return path
+
+
+def test_concurrent_stage_workers_preserve_order_and_bytes(
+    tmp_path, monkeypatch
+):
+    """PFTPU_STAGE_WORKERS=2 on a multi-file scan: delivery order and
+    decoded bytes identical to the single-worker pipeline, and the
+    queue-depth gauge records real depth."""
+    from parquet_floor_tpu.tpu.engine import iter_dataset_row_groups
+
+    paths = [
+        _write_plain_ints(tmp_path, f"f{i}.parquet", seed=i)
+        for i in range(3)
+    ]
+
+    def run():
+        out = []
+        readers = [TpuRowGroupReader(p, float64_policy="bits")
+                   for p in paths]
+        try:
+            tasks = [
+                (r, gi)
+                for r in readers
+                for gi in range(r.num_row_groups)
+            ]
+            for cols in iter_dataset_row_groups(tasks):
+                out.append({
+                    k: (
+                        np.asarray(v.values),
+                        None if v.mask is None else np.asarray(v.mask),
+                    )
+                    for k, v in cols.items()
+                })
+        finally:
+            for r in readers:
+                r.close()
+        return out
+
+    monkeypatch.delenv("PFTPU_STAGE_WORKERS", raising=False)
+    want = run()
+    monkeypatch.setenv("PFTPU_STAGE_WORKERS", "2")
+    with trace.scope() as t:
+        got = run()
+    depth = t.gauges().get("engine.stage_queue_depth_max", 0)
+    assert 1 <= depth <= 3
+    assert len(got) == len(want) == 12
+    for a, b in zip(got, want):
+        assert a.keys() == b.keys()
+        for k in a:
+            np.testing.assert_array_equal(a[k][0], b[k][0], err_msg=k)
+            if b[k][1] is not None:
+                np.testing.assert_array_equal(a[k][1], b[k][1], err_msg=k)
+
+
+def test_jax_compilation_cache_flag_survives_resolution(tmp_path):
+    """The cache compiles with jax's own persistent compilation cache
+    BYPASSED (a jax-cache-deserialized executable cannot be
+    re-serialized faithfully on XLA:CPU — storing one poisons every
+    later process); the flag must come back exactly as it was."""
+    import jax
+
+    prev = bool(jax.config.jax_enable_compilation_cache)
+    _decode(_write(tmp_path), cache_dir=tmp_path / "c")
+    assert bool(jax.config.jax_enable_compilation_cache) == prev
+
+
+def test_keys_distinct_by_target_device(tmp_path):
+    """Readers pinned to different devices must not share an
+    executable (it is bound to the device its inputs live on) — and a
+    store failure (read-only dir) must never fail a decode."""
+    path = _write(tmp_path)
+    d = tmp_path / "cache"
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+
+    def decode_on(device):
+        exec_cache.activate(exec_cache.ExecutableCache(str(d)))
+        try:
+            with trace.scope() as t:
+                with TpuRowGroupReader(
+                    path, device=device, float64_policy="bits"
+                ) as tr:
+                    cols = tr.read_row_group(0)
+                    jax.block_until_ready(
+                        [c.values for c in cols.values()]
+                    )
+                    out = {k: np.asarray(v.values) for k, v in cols.items()}
+            return out, t.counters()
+        finally:
+            exec_cache.activate(None)
+
+    a, ca = decode_on(devs[0])
+    b, cb = decode_on(devs[1])
+    assert ca.get("engine.exec_cache_misses") == 1
+    assert cb.get("engine.exec_cache_misses") == 1   # no cross-device hit
+    assert len(_entries(d)) == 2
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_store_failure_degrades_to_uncached(tmp_path):
+    path = _write(tmp_path)
+    d = tmp_path / "cache"
+    d.mkdir()
+    os.chmod(d, 0o500)   # read-only: every store fails
+    try:
+        out, c = _decode(path, cache_dir=d)
+        assert c.get("engine.exec_cache_misses") == 1
+        assert c.get("engine.launches") == 1
+        ref, _ = _decode(path)
+        _assert_same(ref, out)
+    finally:
+        os.chmod(d, 0o700)

@@ -544,3 +544,119 @@ def test_reader_options_still_flow_under_scope(dataset):
             rows = sum(u.batch.num_rows for u in sc)
     assert rows == 1500
     assert t.counters().get("io.retry_exhausted", 0) == 0
+
+
+# --- nesting-aware stats (self_seconds) --------------------------------------
+
+
+def test_nested_spans_split_inclusive_and_self_time():
+    """A child span's wall charges its own stage AND subtracts from the
+    parent's exclusive time: summing self_seconds never counts one
+    second twice."""
+    import time as _time
+
+    with trace.scope() as t:
+        with trace.span("decode"):
+            _time.sleep(0.02)
+            with trace.span("decode_chunk"):
+                _time.sleep(0.03)
+            _time.sleep(0.005)
+    st = t.stats()
+    outer, inner = st["decode"], st["decode_chunk"]
+    assert inner["self_seconds"] == inner["seconds"]   # leaf span
+    assert inner["seconds"] >= 0.03
+    assert outer["seconds"] >= 0.05                    # inclusive
+    # exclusive time excludes the nested chunk's wall
+    assert outer["self_seconds"] == pytest.approx(
+        outer["seconds"] - inner["seconds"], abs=2e-3
+    )
+    assert outer["self_seconds"] < outer["seconds"]
+
+
+def test_sibling_threads_do_not_share_nesting():
+    """The nesting stack is per-thread: a span on a worker thread is
+    not a child of whatever the submitting thread has open."""
+    import time as _time
+
+    with trace.scope() as t:
+        def worker():
+            with t.span("read"):
+                _time.sleep(0.01)
+
+        with t.span("decode"):
+            th = threading.Thread(target=t.run, args=(worker,))
+            th.start()
+            th.join()
+    st = t.stats()
+    assert st["read"]["self_seconds"] == st["read"]["seconds"]
+    assert st["decode"]["self_seconds"] == pytest.approx(
+        st["decode"]["seconds"], abs=1e-3
+    )
+
+
+def test_bare_add_defaults_self_to_inclusive():
+    with trace.scope() as t:
+        t.add("read", 0.5, 10)
+    st = t.stats()["read"]
+    assert st["self_seconds"] == st["seconds"] == 0.5
+
+
+def test_sequential_reader_emits_per_chunk_decode_spans(dataset):
+    """The pure-host sequential reader attributes decode per chunk —
+    and under the scan executor's per-group decode span those chunks
+    nest instead of double-counting (self_seconds discipline)."""
+    from parquet_floor_tpu.format.file_read import ParquetFileReader
+
+    with trace.scope() as t:
+        with ParquetFileReader(dataset[0]) as r:
+            n_chunks = len(r.row_groups[0].columns)
+            r.read_row_group(0)
+    st = t.stats()
+    assert st["decode_chunk"]["count"] == n_chunks
+    # under the scan executor, the group "decode" span contains them
+    with trace.scope() as t2:
+        with DatasetScanner(dataset[:1]) as sc:
+            for _ in sc:
+                pass
+    st2 = t2.stats()
+    assert st2["decode_chunk"]["count"] > 0
+    assert st2["decode"]["count"] > 0
+    # the chunks' wall is inside the groups' wall, and the group span's
+    # exclusive time excludes it
+    assert st2["decode"]["self_seconds"] <= (
+        st2["decode"]["seconds"] - st2["decode_chunk"]["seconds"] + 1e-3
+    )
+
+
+def test_new_engine_and_prefetch_names_registered():
+    assert {
+        "engine.launches", "engine.exec_cache_hits",
+        "engine.exec_cache_misses", "engine.compile_ms",
+        "data.prefetch_to_device_batches",
+    } <= names.COUNTERS
+    assert {
+        "engine.stage_queue_depth_max", "data.prefetch_to_device_depth_max",
+    } <= names.GAUGES
+    assert "engine.exec_cache" in names.DECISIONS
+    assert {"decode_chunk", "data.prefetch_to_device"} <= names.SPANS
+
+
+def test_bare_add_inside_open_span_charges_the_parent():
+    """A bare add() records just-spent wall: it must subtract from the
+    enclosing span's exclusive time exactly like a child span would
+    (the scan executor's consumer-stall under the loader's
+    data.next_batch span)."""
+    import time as _time
+
+    with trace.scope() as t:
+        with trace.span("data.next_batch"):
+            t0 = _time.perf_counter()
+            _time.sleep(0.03)
+            t.add("scan.consumer_stall", _time.perf_counter() - t0)
+            _time.sleep(0.01)
+    st = t.stats()
+    stall, parent = st["scan.consumer_stall"], st["data.next_batch"]
+    assert stall["self_seconds"] == stall["seconds"] >= 0.03
+    assert parent["self_seconds"] == pytest.approx(
+        parent["seconds"] - stall["seconds"], abs=2e-3
+    )

@@ -555,6 +555,213 @@ def remote_leg(n_rows: int) -> dict:
     }
 
 
+def _serving_paths(n_rows: int, n_files: int = 2):
+    """The serving leg's keyed dataset: ascending disjoint int64 keys
+    (EVEN values only, so absent odd keys inside a group's min/max range
+    exercise the bloom rung), several pages per row group, bloom filters
+    on the key — the point-lookup pruning ladder's full input."""
+    import numpy as np
+
+    from parquet_floor_tpu import ParquetFileWriter, WriterOptions, types
+
+    per = max(n_rows // n_files, 512)
+    group = max(per // 4, 128)
+    page = max(group // 4, 32)
+    per = group * 4
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.DOUBLE).named("d"),
+    )
+    paths = []
+    for i in range(n_files):
+        p = os.path.join("/tmp", f"pftpu_bench_serving_{per}_{i}.parquet")
+        if not os.path.exists(p):
+            rng = np.random.default_rng(500 + i)
+            with ParquetFileWriter(p, schema, WriterOptions(
+                row_group_rows=group, data_page_values=page,
+                bloom_filter_columns={"k": True},
+            )) as w:
+                for lo in range(0, per, group):
+                    base = 2 * (i * per + lo)
+                    w.write_columns({
+                        "k": base + 2 * np.arange(group, dtype=np.int64),
+                        "s": [None if j % 11 == 0 else f"s{j % 63}"
+                              for j in range(group)],
+                        "d": rng.standard_normal(group),
+                    })
+        paths.append(p)
+    return paths, per, group, page
+
+
+def serving_leg(n_rows: int) -> dict:
+    """Multi-tenant serving bench (docs/serving.md), asserted by
+    ``check_bench_report.check_serving_leg``:
+
+    * two tenants scan the SAME dataset through one shared buffer cache
+      — the second tenant's pass must be served mostly from memory
+      (hit-rate >= 0.5, measured from ITS OWN report counters);
+    * two tenants scanning concurrently get DISJOINT, correctly
+      attributed reports (each sees exactly one scan's bytes);
+    * a hot ``Dataset.lookup`` (metadata pinned, fresh key) reads at
+      most one data page of file bytes for a one-column probe — the
+      cache's storage-byte counters prove it;
+    * the pruning ladder's stats and bloom rungs both fire;
+    * a tenant over the seeded remote-storage simulator rides the same
+      cache (cold pass populates, warm pass hits).
+    """
+    import threading as _threading
+
+    from parquet_floor_tpu import ReaderOptions
+    from parquet_floor_tpu.serve import Dataset, Serving, SharedBufferCache
+    from parquet_floor_tpu.testing import RemoteProfile, SimulatedRemoteSource
+
+    scan_paths = _scan_paths(n_rows)
+    total_bytes = sum(os.path.getsize(p) for p in scan_paths)
+    cache = SharedBufferCache(data_bytes=max(4 * total_bytes, 64 << 20))
+    srv = Serving(cache=cache, prefetch_bytes=32 << 20)
+
+    def hit_rate(report) -> float:
+        hit = report.counters.get("serve.cache_hit_bytes", 0)
+        miss = report.counters.get("serve.cache_miss_bytes", 0)
+        return hit / (hit + miss) if hit + miss else 0.0
+
+    def scan_rows(tenant):
+        rows = 0
+        with tenant.scan(scan_paths) as s:
+            for unit in s:
+                rows += unit.batch.num_rows
+        return rows
+
+    try:
+        ta = srv.tenant("alpha", weight=2)
+        tb = srv.tenant("beta", weight=1)
+        rows_a = scan_rows(ta)       # cold: populates the shared cache
+        rows_b = scan_rows(tb)       # warm: served from the shared tiers
+        rep_a, rep_b = ta.report(), tb.report()
+
+        # concurrent pass, fresh tenants: attribution must stay disjoint
+        tc = srv.tenant("gamma")
+        td = srv.tenant("delta")
+        results: dict = {}
+
+        def run(name, tenant):
+            results[name] = scan_rows(tenant)
+
+        threads = [
+            _threading.Thread(target=run, args=("c", tc)),
+            _threading.Thread(target=run, args=("d", td)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rep_c, rep_d = tc.report(), td.report()
+        used = rep_a.counters.get("scan.bytes_used", 0)
+        disjoint = (
+            results["c"] == rows_a and results["d"] == rows_a
+            and rep_c.counters.get("scan.bytes_used", 0) == used
+            and rep_d.counters.get("scan.bytes_used", 0) == used
+        )
+        sf_waits = cache.stats()["singleflight_waits"]
+    finally:
+        # the cache was passed in, so the context leaves it open; the
+        # lookup section below closes it once the stats are captured
+        srv.close()
+
+    # -- point-lookup byte-cost proof (its own cache: the scans above
+    # must not have pre-populated the probe pages) -----------------------
+    lk_paths, per, group, page_rows = _serving_paths(n_rows)
+    lk_cache = SharedBufferCache()
+    detail: dict = {}
+    with Dataset(lk_paths, "k", cache=lk_cache) as ds:
+        from parquet_floor_tpu.utils import trace as _trace
+
+        with _trace.scope() as lt:
+            # warm pass, NO limit: every file opens and pins its probe
+            # metadata, so the hot probe below pays pages only
+            ds.lookup(0)
+            page_bound = ds.page_size_bound()
+            s0 = lk_cache.stats()
+            # a key in a DIFFERENT page (second file, last group, last
+            # page): metadata is hot, exactly one cold page per column
+            hot_key = 2 * (2 * per - 1)
+            hot_rows = ds.lookup(hot_key, columns=["k"])
+            s1 = lk_cache.stats()
+            # absent ODD keys inside group ranges: stats keep the group,
+            # the bloom filter must kill it (deterministic for the fixed
+            # seed; scan a few keys so one unlucky false positive cannot
+            # starve the assertion)
+            bloom0 = lt.counters().get("serve.lookup_bloom_skips", 0)
+            probes = 0
+            for off in range(1, 99, 2):
+                probes += 1
+                ds.lookup(off, limit=1)
+                if lt.counters().get(
+                    "serve.lookup_bloom_skips", 0
+                ) > bloom0:
+                    break
+            lc = lt.counters()
+        detail.update({
+            "serving_lookup_rows": len(hot_rows),
+            "serving_lookup_storage_bytes": (
+                s1["miss_bytes"] - s0["miss_bytes"]
+            ),
+            "serving_lookup_page_bound": page_bound,
+            "serving_lookup_bloom_skips": lc.get(
+                "serve.lookup_bloom_skips", 0
+            ),
+            "serving_lookup_groups_pruned": lc.get(
+                "serve.lookup_groups_pruned", 0
+            ),
+            "serving_lookup_pages_read": lc.get("serve.lookup_pages_read", 0),
+            "serving_lookup_bloom_probes": probes,
+        })
+    lk_cache.close()
+    cache.close()
+
+    # -- the remote face: a tenant over the simulator, same cache law ----
+    rm_cache = SharedBufferCache()
+    rm = Serving(cache=rm_cache, prefetch_bytes=8 << 20)
+    try:
+        profile = RemoteProfile(base_latency_s=0.002, jitter_s=0.0005)
+        factories = [
+            (lambda p=p, i=i: SimulatedRemoteSource(
+                p, profile=profile, seed=2000 + i, fetch_threads=4
+            ))
+            for i, p in enumerate(lk_paths)
+        ]
+        tr1 = rm.tenant("remote-cold")
+        tr2 = rm.tenant("remote-warm")
+        opts = ReaderOptions(io_retries=2, io_retry_backoff_s=0.01)
+        rows_cold = 0
+        with tr1.scan(factories, options=opts) as s:
+            for unit in s:
+                rows_cold += unit.batch.num_rows
+        rows_warm = 0
+        with tr2.scan(factories, options=opts) as s:
+            for unit in s:
+                rows_warm += unit.batch.num_rows
+        remote_warm_rate = hit_rate(tr2.report())
+    finally:
+        rm.close()
+        rm_cache.close()
+
+    detail.update({
+        "serving_rows": rows_a,
+        "serving_second_rows": rows_b,
+        "serving_hit_rate_first_pass": round(hit_rate(rep_a), 4),
+        "serving_hit_rate_second_pass": round(hit_rate(rep_b), 4),
+        "serving_tenants_disjoint": bool(disjoint),
+        "serving_singleflight_waits": sf_waits,
+        "serving_remote_rows": rows_warm if rows_warm == rows_cold else -1,
+        "serving_remote_warm_hit_rate": round(remote_warm_rate, 4),
+        "serving_report": rep_b.as_dict(),
+    })
+    return detail
+
+
 def _bench_batch(paths) -> int:
     """The loader leg's batch size: the largest divisor (at or under
     4096) of the dataset's ACTUAL row-group size, read from the first
@@ -887,6 +1094,10 @@ def main():
     # simulated 20 ms-RTT store — no device work, no D2H; real sleeps
     # model the store, so it runs once, not per rep
     remote_detail = remote_leg(n_rows)
+    # multi-tenant serving leg (docs/serving.md): host scans through the
+    # shared buffer cache + the one-page point-lookup proof — no device
+    # work, no D2H, runs once
+    serving_detail = serving_leg(n_rows)
     # exec-cache cold/warm leg (docs/perf.md): runs in SUBPROCESSES
     # (fresh jax each), so its placement among the timed legs is free
     exec_cache_detail = exec_cache_leg(n_rows)
@@ -934,6 +1145,7 @@ def main():
             **chunked,
             **scan_detail,
             **remote_detail,
+            **serving_detail,
             **exec_cache_detail,
             **loader_detail,
         },

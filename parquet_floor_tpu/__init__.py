@@ -74,6 +74,7 @@ __all__ = [
     "UnsupportedCodec", "UnsupportedFeatureError",
     "assemble_nested", "batch_to_arrow", "col", "data",
     "read_sharded_global", "register_codec", "scan", "scan_batches",
+    "serve", "SharedBufferCache", "Serving",
     "shred_nested", "testing",
     "trace", "types", "ValueWriter", "WriterOptions",
 ]
@@ -100,6 +101,10 @@ _LAZY = {
     "data": ("parquet_floor_tpu.data", None),
     "DataLoader": ("parquet_floor_tpu.data", "DataLoader"),
     "LoaderBatch": ("parquet_floor_tpu.data", "LoaderBatch"),
+    # the multi-tenant serving layer (docs/serving.md) — lazy like scan
+    "serve": ("parquet_floor_tpu.serve", None),
+    "SharedBufferCache": ("parquet_floor_tpu.serve", "SharedBufferCache"),
+    "Serving": ("parquet_floor_tpu.serve", "Serving"),
 }
 
 

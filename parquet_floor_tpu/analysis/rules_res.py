@@ -16,6 +16,12 @@ The remote-storage layer (``io/remote.py``, docs/remote.md) added the
 SESSION/POOL shape: ``RemoteSource``, ``SimulatedRemoteSource``, and
 ``ParallelRangeReader`` each own a fetch thread pool (and a transport
 connection), so an unreleased handle leaks threads AND a remote session.
+
+The serving layer (``serve/``, docs/serving.md) added the CACHE/CONTEXT
+shape: ``SharedBufferCache`` pins the process's buffer memory,
+``Serving``/``Tenant`` hold registrations against it, and a lookup
+``Dataset`` keeps its files (fds, mmaps) open by design — all release
+with ``close()`` and follow the same contract.
 They follow the same contract: with-managed, ownership-transferred
 (e.g. into a reader or a scan chain), or closed-in-finally.  A zero-arg
 **factory lambda** returning one (the scan scheduler's lazy-open
@@ -67,6 +73,13 @@ _ACQUIRERS = {
     # remote sessions/pools (io/remote.py): each owns a fetch pool and
     # a transport connection — same leak shape, same release contract
     "RemoteSource", "SimulatedRemoteSource", "ParallelRangeReader",
+    # the serving layer (serve/, docs/serving.md): a SharedBufferCache
+    # holds the process's buffer memory, a Serving context registers
+    # tenants against it, a Tenant holds a fair-share seat, and a
+    # lookup Dataset keeps its files (and their fds) OPEN by design —
+    # all four release with close() and leak exactly like an fd if a
+    # raise lands between acquisition and release
+    "SharedBufferCache", "Serving", "Tenant", "Dataset",
 }
 
 # the verbs that count as releasing an acquisition (executors release

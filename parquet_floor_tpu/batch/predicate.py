@@ -264,6 +264,8 @@ def _find_chunk(rg, name: str):
 def _page_rows(reader, rg, n: int, name: str):
     """(chunk, column_index, per-page (row_start, row_end)) or None when
     the page indexes are unavailable."""
+    from ..format.file_read import page_row_spans
+
     chunk = _find_chunk(rg, name)
     if chunk is None:
         return None
@@ -271,9 +273,7 @@ def _page_rows(reader, rg, n: int, name: str):
     oi = reader.read_offset_index(chunk)
     if ci is None or oi is None or not oi.page_locations:
         return None
-    firsts = [int(pl.first_row_index or 0) for pl in oi.page_locations]
-    ends = firsts[1:] + [n]
-    return chunk, ci, list(zip(firsts, ends))
+    return chunk, ci, [(a, b) for _pl, a, b in page_row_spans(oi, n)]
 
 
 @dataclass(frozen=True)

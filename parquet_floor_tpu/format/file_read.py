@@ -357,6 +357,24 @@ def _trace_map_skip(ctx: dict, page: int, rows: int,
     })
 
 
+def page_row_spans(oi, num_rows: int) -> list:
+    """Per-page ``(page_location, row_start, row_end)`` of one chunk's
+    OffsetIndex (half-open, group-local) — THE one derivation of page
+    row geometry, shared by the ranged reader, the predicate's page
+    pruning, the scan planner, and the lookup face's page accounting
+    (a fix to the span math lands everywhere at once)."""
+    firsts = [int(pl.first_row_index or 0) for pl in oi.page_locations]
+    return list(zip(oi.page_locations, firsts,
+                    firsts[1:] + [int(num_rows)]))
+
+
+def spans_overlap(a: int, b: int, covered) -> bool:
+    """True when ``[a, b)`` intersects any half-open range in
+    ``covered`` (the page-vs-cover test paired with
+    :func:`page_row_spans`)."""
+    return any(a < cb and ca < b for ca, cb in covered)
+
+
 def _chunk_byte_range(meta: ColumnMetaData):
     start = meta.data_page_offset
     if meta.dictionary_page_offset is not None and meta.dictionary_page_offset > 0:
@@ -1241,8 +1259,9 @@ class ParquetFileReader:
             oi = self.read_offset_index(chunk)
             if oi is None or not oi.page_locations:
                 return None
-            firsts = [int(pl.first_row_index or 0) for pl in oi.page_locations]
-            chunk_spans.append(list(zip(firsts, firsts[1:] + [n])))
+            chunk_spans.append(
+                [(a, b) for _pl, a, b in page_row_spans(oi, n)]
+            )
         while True:
             spans = {
                 (a, b)
@@ -1274,8 +1293,6 @@ class ParquetFileReader:
         if oi is None or not oi.page_locations:
             return None
         ctx = self._chunk_ctx(self._descriptor_for(chunk), None)
-        firsts = [int(pl.first_row_index or 0) for pl in oi.page_locations]
-        ends = firsts[1:] + [n]
         pages = []
         if meta.dictionary_page_offset is not None and meta.dictionary_page_offset > 0:
             dict_len = int(oi.page_locations[0].offset) - int(meta.dictionary_page_offset)
@@ -1286,8 +1303,8 @@ class ParquetFileReader:
                     offset=int(meta.dictionary_page_offset), **ctx,
                 )
             pages.append(dpage)
-        for pl, a, b in zip(oi.page_locations, firsts, ends):
-            if any(a < cb and ca < b for ca, cb in covered):
+        for pl, a, b in page_row_spans(oi, n):
+            if spans_overlap(a, b, covered):
                 pages.append(
                     self._read_raw_page(pl.offset, pl.compressed_page_size, ctx)
                 )

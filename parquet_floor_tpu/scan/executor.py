@@ -555,7 +555,9 @@ class DatasetScanner:
                 if self._predicate is not None
                 else None
             )
-            plan = plan_file(reader, self._filter, keep, self._scan)
+            covered_by_group = self._page_covers(reader, keep)
+            plan = plan_file(reader, self._filter, keep, self._scan,
+                             covered_by_group)
             # page-index extents: tiny, footer-adjacent, shared by every
             # group (page_cover/predicates) — prefetch once per file
             if plan.index_extents:
@@ -580,6 +582,64 @@ class DatasetScanner:
         if state.remaining == 0:
             self._close_file(fi)
         return state
+
+    def _page_covers(self, reader, keep: Optional[Set[int]]):
+        """``ScanOptions.page_prune``: narrow each surviving group to the
+        page-aligned cover of the predicate's ``row_ranges``
+        (docs/scan.md).  Mutates ``keep`` — a group whose every page the
+        ColumnIndex ruled out is dropped entirely (no bytes read).
+        Returns the ``covered_by_group`` map for :func:`plan_file`, or
+        None when pruning is off/inapplicable (no predicate; salvage
+        keeps whole-group quarantine semantics)."""
+        if self._predicate is None or not self._scan.page_prune \
+                or self._salvage:
+            return None
+        # prefetch EVERY kept group's page-index ranges in one vectored
+        # load before the cover walk below reads them one by one — on a
+        # remote source the per-chunk ColumnIndex/OffsetIndex reads
+        # would otherwise each pay an RTT, serially, at file open (the
+        # reader parses each index once, so the later plan_file load of
+        # the same extents is a no-op hit)
+        from .plan import coalesce, index_ranges
+
+        idx: list = []
+        for gi in sorted(keep):
+            # ALL columns, not just the projection: the predicate's own
+            # column need not be selected, and row_ranges reads it
+            idx.extend(index_ranges(reader.row_groups[gi]))
+        load = getattr(reader.source, "load", None)
+        if idx and load is not None:
+            load(coalesce(
+                idx, self._scan.max_gap_bytes, self._scan.max_extent_bytes
+            ))
+        covered_by_group: dict = {}
+        for gi in sorted(keep):
+            rg = reader.row_groups[gi]
+            n = int(rg.num_rows or 0)
+            chunks = [
+                c for c in rg.columns or []
+                if not self._filter or (
+                    c.meta_data is not None
+                    and c.meta_data.path_in_schema
+                    and c.meta_data.path_in_schema[0] in self._filter
+                )
+            ]
+            if not chunks:
+                continue
+            rr = self._predicate.row_ranges(reader, gi)
+            cov = reader.page_cover(gi, rr, chunks)
+            if cov == []:
+                # the ColumnIndex proved no page can match: the group
+                # drops like a stats-pruned one (its pages all count)
+                keep.discard(gi)
+                trace.count("scan.pages_pruned", sum(
+                    len(oi.page_locations)
+                    for oi in (reader.read_offset_index(c) for c in chunks)
+                    if oi is not None and oi.page_locations
+                ))
+            elif cov is not None and cov != [(0, n)]:
+                covered_by_group[gi] = cov
+        return covered_by_group
 
     def _close_file(self, fi: int) -> None:
         state = self._files.pop(fi, None)
@@ -641,6 +701,16 @@ class DatasetScanner:
                 "decode", work.plan.uncompressed_bytes, attrs=attrs
             ):
                 if not self._salvage:
+                    if work.plan.covered is not None:
+                        # page-pruned group (ScanOptions.page_prune):
+                        # decode exactly the covered pages — the cover is
+                        # already page-aligned, so read_row_group_ranges
+                        # reproduces it as a fixpoint
+                        batch, _cov = state.reader.read_row_group_ranges(
+                            work.plan.group_index, work.plan.covered,
+                            self._filter,
+                        )
+                        return batch, None
                     return state.reader.read_row_group(
                         work.plan.group_index, self._filter
                     ), None

@@ -53,6 +53,16 @@ class ScanOptions:
       The device scan face additionally derives its pipeline depth
       (``PFTPU_PREFETCH_DEPTH``'s default) from the same measurements;
       an explicit env override still wins.
+    * ``page_prune`` — with a ``predicate``, prune each surviving row
+      group to the OffsetIndex page boundaries of the predicate's
+      ``row_ranges``: only the candidate pages' bytes are planned, read,
+      and decoded (``scan.pages_pruned`` counts the skipped data
+      pages), and delivered units carry only the covered rows.  OPT-IN
+      because it changes the delivered row set from "whole surviving
+      groups" to "covered page spans" — the lookup face's granularity
+      on the scan face (docs/serving.md's pruning ladder, rung 3).
+      Ignored without a predicate, under salvage (quarantine decisions
+      are group-wide), and on the device scan face.
     """
 
     max_gap_bytes: int = 64 << 10
@@ -60,6 +70,7 @@ class ScanOptions:
     prefetch_bytes: int = 64 << 20
     threads: int = 4
     adaptive_prefetch: bool = False
+    page_prune: bool = False
 
     def __post_init__(self):
         if self.max_gap_bytes < 0:
@@ -95,7 +106,9 @@ class Extent:
 class GroupPlan:
     """The I/O plan of one row group: its chunks' byte ranges coalesced
     into extents, plus footer-derived size facts the executor budgets
-    with."""
+    with.  ``covered`` (page-pruned plans only) is the page-aligned row
+    cover the group was narrowed to — the executor decodes it through
+    ``read_row_group_ranges`` instead of the whole group."""
 
     group_index: int
     extents: List[Extent]
@@ -103,6 +116,7 @@ class GroupPlan:
     used_bytes: int          # sum of the wanted ranges
     uncompressed_bytes: int  # footer estimate of the decoded size
     num_rows: int
+    covered: Optional[List[Tuple[int, int]]] = None
 
 
 @dataclass
@@ -170,6 +184,49 @@ def chunk_ranges(rg, column_filter: Optional[Set[str]] = None
     return ranges
 
 
+def pruned_chunk_ranges(reader, rg, covered,
+                        column_filter: Optional[Set[str]] = None):
+    """Byte ranges of exactly what ``read_row_group_ranges`` will read
+    for a page-pruned group: each selected chunk's dictionary page plus
+    the data pages whose rows intersect ``covered`` (OffsetIndex truth).
+    Returns ``(ranges, pages_pruned)``; only called for groups whose
+    every selected chunk HAS an OffsetIndex (``page_cover`` returned a
+    partial cover, which requires one)."""
+    n = int(rg.num_rows or 0)
+    ranges: List[Tuple[int, int]] = []
+    pruned = 0
+    for chunk in rg.columns or []:
+        meta = chunk.meta_data
+        if meta is None:
+            continue
+        if column_filter and meta.path_in_schema and \
+                meta.path_in_schema[0] not in column_filter:
+            continue
+        oi = reader.read_offset_index(chunk)
+        locs = oi.page_locations if oi is not None else None
+        if not locs:
+            # page_cover's contract makes this unreachable for pruned
+            # groups; fall back to the whole chunk rather than dropping it
+            if meta.data_page_offset is not None and \
+                    meta.total_compressed_size is not None:
+                from ..format.file_read import _chunk_byte_range
+
+                start, length = _chunk_byte_range(meta)
+                ranges.append((int(start), int(length)))
+            continue
+        doff = meta.dictionary_page_offset
+        if doff is not None and doff > 0:
+            ranges.append((int(doff), int(locs[0].offset) - int(doff)))
+        from ..format.file_read import page_row_spans, spans_overlap
+
+        for pl, a, b in page_row_spans(oi, n):
+            if spans_overlap(a, b, covered):
+                ranges.append((int(pl.offset), int(pl.compressed_page_size)))
+            else:
+                pruned += 1
+    return ranges, pruned
+
+
 def index_ranges(rg, column_filter: Optional[Set[str]] = None
                  ) -> List[Tuple[int, int]]:
     """Page-index (OffsetIndex/ColumnIndex) byte ranges of a row group's
@@ -192,12 +249,18 @@ def index_ranges(rg, column_filter: Optional[Set[str]] = None
 
 def plan_file(reader, column_filter: Optional[Set[str]] = None,
               keep: Optional[Set[int]] = None,
-              options: Optional[ScanOptions] = None) -> FilePlan:
+              options: Optional[ScanOptions] = None,
+              covered_by_group: Optional[dict] = None) -> FilePlan:
     """Plan every (kept) row group of an open ``ParquetFileReader``.
 
     ``keep`` restricts to a predicate's surviving group indices (None =
-    all).  Counters land in ``trace``; per-file totals also surface as a
-    ``scan.plan`` trace decision.
+    all).  ``covered_by_group`` maps a group index to the page-aligned
+    row cover ``ScanOptions.page_prune`` narrowed it to: those groups
+    plan only their candidate pages' byte ranges (dictionary page
+    included), record the cover on the :class:`GroupPlan`, and count the
+    skipped data pages as ``scan.pages_pruned``.  Counters land in
+    ``trace``; per-file totals also surface as a ``scan.plan`` trace
+    decision.
     """
     opts = options or ScanOptions()
     plan = FilePlan()
@@ -205,7 +268,14 @@ def plan_file(reader, column_filter: Optional[Set[str]] = None,
     for gi, rg in enumerate(reader.row_groups):
         if keep is not None and gi not in keep:
             continue
-        ranges = chunk_ranges(rg, column_filter)
+        covered = (covered_by_group or {}).get(gi)
+        if covered is not None:
+            ranges, pruned = pruned_chunk_ranges(
+                reader, rg, covered, column_filter
+            )
+            trace.count("scan.pages_pruned", pruned)
+        else:
+            ranges = chunk_ranges(rg, column_filter)
         extents = coalesce(ranges, opts.max_gap_bytes, opts.max_extent_bytes)
         gp = GroupPlan(
             group_index=gi,
@@ -222,6 +292,7 @@ def plan_file(reader, column_filter: Optional[Set[str]] = None,
                 )
             ),
             num_rows=int(rg.num_rows or 0),
+            covered=covered,
         )
         plan.groups.append(gp)
         idx_ranges.extend(index_ranges(rg, column_filter))

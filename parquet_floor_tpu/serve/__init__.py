@@ -1,0 +1,31 @@
+"""parquet_floor_tpu.serve — the multi-tenant dataset-serving layer.
+
+Three pieces compose the serving story on top of the scan/remote/data
+stack (``docs/serving.md``):
+
+* :class:`SharedBufferCache` / :class:`CachedSource` — one process-wide
+  two-tier byte cache (pinned metadata, LRU data extents) with
+  single-flight storage reads, dropped into the existing scan source
+  chain (``serve.cache``);
+* :class:`Serving` / :class:`Tenant` — per-tenant budget admission,
+  weighted-fair scheduling of storage reads, and per-tenant tracer
+  scopes so every client gets its own
+  :class:`~parquet_floor_tpu.utils.trace.ScanReport`
+  (``serve.tenancy``);
+* :class:`Dataset` — point/range lookups descending the format's
+  pruning ladder (footer stats → bloom filter → page indexes) to read
+  exactly the candidate page(s) (``serve.lookup``).
+"""
+
+from .cache import CachedSource, SharedBufferCache, source_key
+from .lookup import Dataset
+from .tenancy import Serving, Tenant
+
+__all__ = [
+    "CachedSource",
+    "Dataset",
+    "Serving",
+    "SharedBufferCache",
+    "Tenant",
+    "source_key",
+]

@@ -1,0 +1,495 @@
+"""Process-wide shared buffer cache — the serving layer's memory plane.
+
+Every scan used to own a private :class:`~parquet_floor_tpu.scan.executor.
+PrefetchedSource` extent cache, so N concurrent tenants over the same hot
+files paid N× the storage reads and N× the memory.  The
+:class:`SharedBufferCache` here is ONE process-wide store with the two
+tiers the format itself defines:
+
+* a **metadata tier** (``meta_bytes`` budget) for the byte ranges every
+  request re-reads — footers, page indexes (OffsetIndex/ColumnIndex),
+  bloom filters, dictionary pages — inserted *pinned* so data-tier churn
+  never evicts them (the tier still has its own LRU cap; evictions there
+  are counted, never silent);
+* a **data tier** (``data_bytes`` budget) — a byte-budgeted LRU of read
+  extents (coalesced column-chunk ranges, lookup pages).
+
+:class:`CachedSource` is the drop-in positional-source wrapper that puts
+the cache into the existing scan chain: ``PrefetchedSource`` misses (and
+loads) consult — and populate — the shared tiers before touching
+storage.  Reads are **single-flight**: two tenants requesting the same
+range concurrently issue ONE storage read; the followers wait for the
+leader's bytes (``serve.singleflight_waits``).
+
+Correctness under eviction: cached payloads are immutable ``bytes``
+copies and callers receive ``memoryview``\\ s over them — evicting an
+entry drops the cache's reference, while any in-flight borrower keeps
+the buffer alive through its own view.  Eviction can therefore never
+corrupt a borrowed buffer, only forget it.
+
+Attribution: hit/miss/wait counters land on the AMBIENT tracer — a
+tenant's scan (bound to its own :class:`~parquet_floor_tpu.utils.trace.
+Tracer` scope) sees exactly its own cache traffic, while
+:meth:`SharedBufferCache.stats` keeps the process-global truth for
+benches and dashboards.  Docs: ``docs/serving.md``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils import trace
+
+_FOOTER_OBJECTS_MAX = 1024  # parsed footers kept (small objects, hot)
+
+
+class _Entry:
+    """One cached byte range of one file."""
+
+    __slots__ = ("start", "end", "data", "pinned")
+
+    def __init__(self, start: int, end: int, data: bytes, pinned: bool):
+        self.start = start
+        self.end = end
+        self.data = data
+        self.pinned = pinned
+
+
+class _Flight:
+    """One in-progress storage read (single-flight leader record)."""
+
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result: Optional[bytes] = None
+        self.error: Optional[BaseException] = None
+
+
+class _FileIndex:
+    """Per-file sorted range index (the PrefetchedSource shape: entries
+    sorted by start, containment served by the predecessor check)."""
+
+    __slots__ = ("starts", "entries")
+
+    def __init__(self):
+        self.starts: List[int] = []
+        self.entries: List[_Entry] = []
+
+    def locate(self, offset: int, length: int) -> Optional[_Entry]:
+        i = bisect.bisect_right(self.starts, offset) - 1
+        if i >= 0:
+            e = self.entries[i]
+            if offset + length <= e.end:
+                return e
+        return None
+
+    def insert(self, entry: _Entry) -> None:
+        i = bisect.bisect_right(self.starts, entry.start)
+        self.starts.insert(i, entry.start)
+        self.entries.insert(i, entry)
+
+    def remove(self, entry: _Entry) -> None:
+        i = bisect.bisect_left(self.starts, entry.start)
+        while i < len(self.starts) and self.starts[i] == entry.start:
+            if self.entries[i] is entry:
+                del self.starts[i]
+                del self.entries[i]
+                return
+            i += 1
+
+
+def source_key(source) -> tuple:
+    """The cache identity of a positional source: ``(name, size)``.
+    Two opens of the same path at the same size share entries; a
+    rewritten (resized) file gets a fresh key rather than stale bytes.
+    (The in-place same-size rewrite blind spot is the quarantine map's
+    fingerprint discussion — a serving deployment that rewrites files in
+    place should use new file names, as object stores naturally do.)"""
+    return (getattr(source, "name", "<source>"), int(source.size))
+
+
+class SharedBufferCache:
+    """Two-tier (pinned metadata / LRU data) shared byte cache with
+    single-flight storage reads.  Thread-safe; see module docstring.
+
+    ``data_bytes`` / ``meta_bytes`` are the tier budgets.  The data tier
+    evicts least-recently-used entries when over budget
+    (``serve.cache_evictions``); the pinned tier evicts only when ITS
+    budget overflows (``serve.meta_evictions`` — visible, never silent).
+    """
+
+    def __init__(self, data_bytes: int = 256 << 20,
+                 meta_bytes: int = 64 << 20):
+        if data_bytes <= 0:
+            raise ValueError(f"data_bytes must be > 0, got {data_bytes}")
+        if meta_bytes <= 0:
+            raise ValueError(f"meta_bytes must be > 0, got {meta_bytes}")
+        self.data_bytes = int(data_bytes)
+        self.meta_bytes = int(meta_bytes)
+        self._lock = threading.Lock()
+        self._files: Dict[tuple, _FileIndex] = {}
+        # LRU order per tier: dict preserves insertion order; a touch
+        # deletes + reinserts (O(1) amortized)
+        self._lru_data: Dict[Tuple[tuple, int, int], _Entry] = {}
+        self._lru_meta: Dict[Tuple[tuple, int, int], _Entry] = {}
+        self._used_data = 0
+        self._used_meta = 0
+        self._flights: Dict[Tuple[tuple, int, int], _Flight] = {}
+        self._footers: Dict[tuple, object] = {}  # parsed ParquetMetadata
+        self._closed = False
+        # process-global totals (per-tenant attribution rides the
+        # ambient tracer; these are the cross-tenant truth)
+        self._hits = 0
+        self._misses = 0
+        self._hit_bytes = 0
+        self._miss_bytes = 0
+        self._evictions = 0
+        self._meta_evictions = 0
+        self._singleflight_waits = 0
+
+    # -- bookkeeping (caller holds the lock) --------------------------------
+
+    def _touch(self, key3: Tuple[tuple, int, int], entry: _Entry) -> None:
+        lru = self._lru_meta if entry.pinned else self._lru_data
+        if key3 in lru:
+            del lru[key3]
+            lru[key3] = entry
+
+    def _insert_locked(self, key: tuple, offset: int, data: bytes,
+                       pinned: bool) -> _Entry:
+        idx = self._files.get(key)
+        if idx is None:
+            idx = self._files[key] = _FileIndex()
+        existing = idx.locate(offset, len(data))
+        if existing is not None:
+            if pinned and not existing.pinned:
+                self._promote_locked(key, existing)
+            return existing
+        entry = _Entry(offset, offset + len(data), data, pinned)
+        idx.insert(entry)
+        key3 = (key, entry.start, entry.end)
+        if pinned:
+            self._lru_meta[key3] = entry
+            self._used_meta += len(data)
+            self._evict_locked(meta=True)
+        else:
+            self._lru_data[key3] = entry
+            self._used_data += len(data)
+            self._evict_locked(meta=False)
+        return entry
+
+    def _promote_locked(self, key: tuple, entry: _Entry) -> None:
+        """Move a data-tier entry to the pinned tier (metadata discovered
+        after the bytes were already cached — e.g. the footer tail read
+        before the footer parse could classify it)."""
+        key3 = (key, entry.start, entry.end)
+        if key3 in self._lru_data:
+            del self._lru_data[key3]
+            self._used_data -= len(entry.data)
+        entry.pinned = True
+        self._lru_meta[key3] = entry
+        self._used_meta += len(entry.data)
+        self._evict_locked(meta=True)
+
+    def _evict_locked(self, meta: bool) -> None:
+        lru = self._lru_meta if meta else self._lru_data
+        cap = self.meta_bytes if meta else self.data_bytes
+        used = self._used_meta if meta else self._used_data
+        evicted = 0
+        while used > cap and lru:
+            key3, entry = next(iter(lru.items()))
+            del lru[key3]
+            idx = self._files.get(key3[0])
+            if idx is not None:
+                idx.remove(entry)
+            used -= len(entry.data)
+            evicted += 1
+        if meta:
+            self._used_meta = used
+            self._meta_evictions += evicted
+            if evicted:
+                trace.count("serve.meta_evictions", evicted)
+        else:
+            self._used_data = used
+            self._evictions += evicted
+            if evicted:
+                trace.count("serve.cache_evictions", evicted)
+
+    def _record_hit(self, n: int) -> None:
+        self._hits += 1
+        self._hit_bytes += n
+        trace.count("serve.cache_hits")
+        trace.count("serve.cache_hit_bytes", n)
+
+    def _record_miss(self, n: int) -> None:
+        self._misses += 1
+        self._miss_bytes += n
+        trace.count("serve.cache_misses")
+        trace.count("serve.cache_miss_bytes", n)
+
+    # -- the byte-range face -------------------------------------------------
+
+    def get(self, key: tuple, offset: int, length: int
+            ) -> Optional[memoryview]:
+        """The cached bytes covering ``[offset, offset + length)`` of
+        file ``key``, or None.  A hit touches the entry's LRU slot and
+        counts toward the ambient tracer's hit counters."""
+        with self._lock:
+            idx = self._files.get(key)
+            entry = idx.locate(offset, length) if idx is not None else None
+            if entry is None:
+                return None
+            self._touch((key, entry.start, entry.end), entry)
+            self._record_hit(length)
+            lo = offset - entry.start
+            return memoryview(entry.data)[lo : lo + length]
+
+    def put(self, key: tuple, offset: int, data, pinned: bool = False
+            ) -> None:
+        """Install bytes at ``offset`` of file ``key`` (copied to an
+        immutable buffer; a range already covered is not duplicated —
+        though a ``pinned=True`` put promotes a covering data-tier
+        entry)."""
+        with self._lock:
+            self._insert_locked(key, int(offset), bytes(data), pinned)
+
+    def fetch(self, key: tuple, offset: int, length: int, read_fn,
+              pinned: bool = False) -> memoryview:
+        """``get`` or single-flight read-through: on a miss, exactly one
+        caller (the leader) runs ``read_fn()`` and installs the bytes;
+        concurrent callers for the same range wait for the leader
+        (``serve.singleflight_waits``) instead of issuing duplicate
+        storage reads.  A failed leader read propagates to every waiter
+        and clears the flight, so a retry layer above re-issues cleanly.
+        """
+        return self.fetch_many(
+            key, [(offset, length)],
+            lambda ranges: [read_fn()],
+            pinned=pinned,
+        )[0]
+
+    def fetch_many(self, key: tuple, ranges: Sequence[Tuple[int, int]],
+                   read_many_fn, pinned: bool = False) -> list:
+        """Vectored :meth:`fetch`: classify every range as hit / flight
+        to await / range to lead in ONE lock pass, then issue a single
+        vectored ``read_many_fn(miss_ranges)`` for all led ranges (the
+        inner source keeps its own fan-out, e.g. the remote parallel
+        fetches), install them, and resolve the waiters.  Returns one
+        ``memoryview`` per input range, in input order."""
+        ranges = [(int(o), int(n)) for o, n in ranges]
+        out: list = [None] * len(ranges)
+        leads: List[Tuple[int, int, int]] = []       # (pos, offset, length)
+        waits: List[Tuple[int, _Flight, int]] = []   # (pos, flight, length)
+        with self._lock:
+            if self._closed:
+                raise ValueError("SharedBufferCache is closed")
+            idx = self._files.get(key)
+            led_here: Dict[Tuple[int, int], _Flight] = {}
+            for pos, (o, n) in enumerate(ranges):
+                entry = idx.locate(o, n) if idx is not None else None
+                if entry is not None:
+                    if pinned and not entry.pinned:
+                        self._promote_locked(key, entry)
+                    self._touch((key, entry.start, entry.end), entry)
+                    self._record_hit(n)
+                    lo = o - entry.start
+                    out[pos] = memoryview(entry.data)[lo : lo + n]
+                    continue
+                fkey = (key, o, n)
+                fl = self._flights.get(fkey)
+                if fl is None:
+                    fl = led_here.get((o, n))
+                if fl is not None:
+                    self._singleflight_waits += 1
+                    trace.count("serve.singleflight_waits")
+                    waits.append((pos, fl, n))
+                    continue
+                fl = _Flight()
+                self._flights[fkey] = fl
+                led_here[(o, n)] = fl
+                self._record_miss(n)
+                leads.append((pos, o, n))
+        if leads:
+            lead_ranges = [(o, n) for _, o, n in leads]
+            try:
+                bufs = read_many_fn(lead_ranges)
+            except BaseException as e:
+                with self._lock:
+                    for _, o, n in leads:
+                        fl = self._flights.pop((key, o, n), None)
+                        if fl is not None:
+                            fl.error = e
+                            fl.event.set()
+                raise
+            with self._lock:
+                for (pos, o, n), buf in zip(leads, bufs):
+                    data = bytes(buf)
+                    entry = self._insert_locked(key, o, data, pinned)
+                    fl = self._flights.pop((key, o, n), None)
+                    if fl is not None:
+                        fl.result = data
+                        fl.event.set()
+                    lo = o - entry.start
+                    out[pos] = memoryview(entry.data)[lo : lo + n]
+        for pos, fl, n in waits:
+            fl.event.wait()
+            if fl.error is not None:
+                raise fl.error
+            out[pos] = memoryview(fl.result)[:n]
+        return out
+
+    # -- parsed-footer objects ----------------------------------------------
+
+    def get_footer(self, key: tuple):
+        """The parsed ``ParquetMetadata`` cached for ``key``, or None —
+        the object half of the metadata tier (byte ranges keep the raw
+        tier honest; the parsed object spares the thrift re-parse that
+        dominates a warm re-open)."""
+        with self._lock:
+            meta = self._footers.get(key)
+            if meta is not None:  # touch
+                del self._footers[key]
+                self._footers[key] = meta
+            return meta
+
+    def put_footer(self, key: tuple, metadata) -> None:
+        with self._lock:
+            if key not in self._footers and \
+                    len(self._footers) >= _FOOTER_OBJECTS_MAX:
+                self._footers.pop(next(iter(self._footers)))
+            self._footers[key] = metadata
+
+    # -- maintenance ---------------------------------------------------------
+
+    def invalidate(self, key: tuple) -> None:
+        """Forget every entry (both tiers, parsed footer included) of one
+        file — the hook for an external "this object changed" signal."""
+        with self._lock:
+            idx = self._files.pop(key, None)
+            self._footers.pop(key, None)
+            if idx is None:
+                return
+            for entry in idx.entries:
+                key3 = (key, entry.start, entry.end)
+                if entry.pinned:
+                    if key3 in self._lru_meta:
+                        del self._lru_meta[key3]
+                        self._used_meta -= len(entry.data)
+                else:
+                    if key3 in self._lru_data:
+                        del self._lru_data[key3]
+                        self._used_data -= len(entry.data)
+
+    def stats(self) -> dict:
+        """Process-global snapshot (cross-tenant truth; the per-tenant
+        split rides each tenant's tracer counters)."""
+        with self._lock:
+            return {
+                "hits": self._hits,
+                "misses": self._misses,
+                "hit_bytes": self._hit_bytes,
+                "miss_bytes": self._miss_bytes,
+                "evictions": self._evictions,
+                "meta_evictions": self._meta_evictions,
+                "singleflight_waits": self._singleflight_waits,
+                "data_bytes_used": self._used_data,
+                "meta_bytes_used": self._used_meta,
+                "files": len(self._files),
+                "footers": len(self._footers),
+            }
+
+    def close(self) -> None:
+        """Drop every buffer and refuse further fetches; idempotent.
+        In-flight borrows stay valid (they hold their own views)."""
+        with self._lock:
+            self._closed = True
+            self._files.clear()
+            self._lru_data.clear()
+            self._lru_meta.clear()
+            self._footers.clear()
+            self._used_data = self._used_meta = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class CachedSource:
+    """Positional source serving reads through a :class:`SharedBufferCache`.
+
+    Drops into the existing chain BELOW the per-scan ``PrefetchedSource``
+    and retry layers: a prefetch load (or any reader byte access) that
+    misses the scan's private cache consults the shared tiers first and
+    populates them on the way back from storage, so the NEXT tenant's
+    identical extent is a memory hit.  ``parallel_read_many`` forwards
+    from the inner source, keeping the remote fan-out composition
+    (``io.remote.compose_retrying``) intact above a cached remote store.
+
+    ``gate`` (a tenant's fair-share handle, ``serve.tenancy``) meters
+    actual STORAGE reads — cache hits bypass it entirely, which is the
+    point: fair-share arbitrates the scarce resource (storage bandwidth),
+    not the shared memory."""
+
+    def __init__(self, inner, cache: SharedBufferCache,
+                 key: Optional[tuple] = None, gate=None):
+        self._inner = inner
+        self._cache = cache
+        self.key = key if key is not None else source_key(inner)
+        self._gate = gate
+        self.parallel_read_many = getattr(inner, "parallel_read_many", False)
+
+    @property
+    def name(self) -> str:
+        return getattr(self._inner, "name", "<source>")
+
+    @property
+    def size(self) -> int:
+        return self._inner.size
+
+    def _read_storage(self, ranges) -> list:
+        """The one real-storage read path: fair-share gated (when a gate
+        is bound), vectored through the inner source."""
+        total = sum(n for _, n in ranges)
+        if self._gate is not None:
+            self._gate.acquire(total)
+        try:
+            read_many = getattr(self._inner, "read_many", None)
+            if read_many is not None:
+                return read_many(ranges)
+            return [self._inner.read_at(o, n) for o, n in ranges]
+        finally:
+            if self._gate is not None:
+                self._gate.release(total)
+
+    def read_at(self, offset: int, length: int) -> memoryview:
+        return self._cache.fetch_many(
+            self.key, [(offset, length)], self._read_storage
+        )[0]
+
+    def read_many(self, ranges) -> list:
+        return self._cache.fetch_many(self.key, list(ranges),
+                                      self._read_storage)
+
+    def load(self, ranges, pinned: bool = False) -> int:
+        """Ensure ``ranges`` are cached (single-flight, vectored) and
+        return the byte total; ``pinned=True`` lands them in — or
+        promotes covering entries into — the metadata tier.  The
+        lookup face pins a file's probe metadata through this."""
+        bufs = self._cache.fetch_many(
+            self.key, list(ranges), self._read_storage, pinned=pinned
+        )
+        return sum(len(b) for b in bufs)
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

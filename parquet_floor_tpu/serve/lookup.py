@@ -1,0 +1,374 @@
+"""Low-latency point/range lookups — the one-page read path.
+
+A :class:`Dataset` holds a set of parquet files open behind the shared
+buffer cache and answers ``lookup(key)`` / ``range(lo, hi)`` probes by
+descending the format's own pruning ladder, cheapest rung first:
+
+1. **footer statistics** — row groups whose chunk min/max prove the key
+   absent are skipped without reading a byte
+   (``serve.lookup_groups_pruned``);
+2. **bloom filters** — for equality probes, a group the stats could not
+   rule out is probed against the chunk's split-block Bloom filter (no
+   false negatives): a miss skips the group
+   (``serve.lookup_bloom_skips``);
+3. **page indexes** — ``Predicate.row_ranges`` narrows the surviving
+   group to the page row-spans whose ColumnIndex min/max may match, and
+   ``read_row_group_ranges`` reads exactly those pages' bytes through
+   the OffsetIndex (``serve.lookup_pages_read``);
+4. **exact filter** — the decoded (page-sized) batch is filtered to the
+   exact matching rows.
+
+Every rung's inputs — footer, page indexes, bloom filters, dictionary
+pages — are PINNED in the shared cache's metadata tier at open, so a hot
+probe's storage traffic is the candidate data page(s) and nothing else:
+**≤ one data page of file bytes per selected column** for a point
+lookup with page-sized row groups, which the serving bench asserts from
+the cache's byte counters (``bench.py serving_leg``,
+``scripts/serving_smoke.py``).
+
+Rows come back as plain dicts (column → API-typed value, the row-stream
+conversion rules).  The face is flat-only, like the reference's row
+stream: a repeated (nested) column in the projection raises.
+
+Concurrency: probes are thread-safe (per-file locks serialize decode on
+one file; different files probe concurrently).  Pass ``tenant=`` to
+attribute a probe's counters to a tenant's tracer scope.
+Docs: ``docs/serving.md``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from ..batch.predicate import col
+from ..errors import UnsupportedFeatureError
+from ..format.file_read import ParquetFileReader, ReaderOptions
+from ..io.source import FileSource
+from ..utils import trace
+from .cache import CachedSource, SharedBufferCache
+
+# pinned-metadata coalesce merges TOUCHING ranges only (page indexes and
+# bloom filters sit back-to-back before the footer): any positive gap
+# could swallow data pages between two dictionary pages into the pinned
+# tier, silently voiding the one-page probe byte proof
+_META_GAP = 0
+
+
+class _LookupFile:
+    """One open file of the dataset: shared-cache-backed source, its
+    reader, and the per-file probe lock."""
+
+    __slots__ = ("source", "reader", "lock")
+
+    def __init__(self, source: CachedSource, reader: ParquetFileReader):
+        self.source = source
+        self.reader = reader
+        self.lock = threading.Lock()
+
+
+def _metadata_ranges(reader: ParquetFileReader) -> List[tuple]:
+    """Byte ranges of everything the probe ladder re-reads: page indexes
+    (both kinds), bloom filters, and dictionary pages — the pinned
+    metadata tier's working set for one file."""
+    ranges: List[tuple] = []
+    for rg in reader.row_groups:
+        for chunk in rg.columns or []:
+            for off, ln in (
+                (chunk.offset_index_offset, chunk.offset_index_length),
+                (chunk.column_index_offset, chunk.column_index_length),
+            ):
+                if off is not None and ln:
+                    ranges.append((int(off), int(ln)))
+            md = chunk.meta_data
+            if md is None:
+                continue
+            if md.bloom_filter_offset is not None and md.bloom_filter_length:
+                ranges.append(
+                    (int(md.bloom_filter_offset), int(md.bloom_filter_length))
+                )
+            doff = md.dictionary_page_offset
+            if doff and md.data_page_offset and md.data_page_offset > doff:
+                ranges.append((int(doff), int(md.data_page_offset - doff)))
+    return ranges
+
+
+class Dataset:
+    """Point/range-lookup face over a list of parquet files (module
+    docstring).  ``key_column`` names the probe column (a flat top-level
+    leaf); ``columns`` optionally fixes the projection every probe
+    returns (per-probe ``columns=`` overrides).  ``cache=None`` builds a
+    private :class:`SharedBufferCache`; pass the serving context's cache
+    to share tiers with the scan tenants.  Files open lazily on first
+    probe and stay open (close with :meth:`close` / ``with``).
+
+    ``options`` is the usual :class:`ReaderOptions`; ``salvage`` is
+    rejected — quarantine semantics are group-wide and would void the
+    one-page byte contract (scan the file with a salvage scanner
+    instead)."""
+
+    def __init__(self, sources: Sequence, key_column: str,
+                 columns: Optional[Sequence[str]] = None,
+                 cache: Optional[SharedBufferCache] = None,
+                 options: Optional[ReaderOptions] = None):
+        if not key_column:
+            raise ValueError("key_column must name a column")
+        if options is not None and options.salvage:
+            raise UnsupportedFeatureError(
+                "Dataset lookup does not support salvage mode: quarantine "
+                "decisions are row-group-wide and a one-page probe cannot "
+                "make them (use a salvage DatasetScanner)"
+            )
+        self._sources = list(sources)
+        self.key_column = key_column
+        self._columns = list(columns) if columns else None
+        self._own_cache = cache is None
+        self.cache = cache if cache is not None else SharedBufferCache()
+        self._options = options
+        self._files: Dict[int, _LookupFile] = {}
+        self._open_lock = threading.Lock()
+        self._closed = False
+
+    # -- open / pin ----------------------------------------------------------
+
+    def _resolve(self, src) -> CachedSource:
+        if callable(src) and not hasattr(src, "read_at"):
+            src = src()
+        inner = src if hasattr(src, "read_at") else FileSource(src)
+        try:
+            return CachedSource(inner, self.cache)
+        except BaseException:
+            inner.close()
+            raise
+
+    def _file(self, i: int) -> _LookupFile:
+        with self._open_lock:
+            if self._closed:
+                raise ValueError("Dataset is closed")
+            lf = self._files.get(i)
+            if lf is not None:
+                return lf
+            source = self._resolve(self._sources[i])
+            try:
+                meta = self.cache.get_footer(source.key)
+                reader = ParquetFileReader(
+                    source, options=self._options, metadata=meta
+                )
+                if meta is None:
+                    self.cache.put_footer(source.key, reader.metadata)
+                self._pin_metadata(source, reader)
+            except BaseException:
+                source.close()
+                raise
+            lf = self._files[i] = _LookupFile(source, reader)
+            return lf
+
+    def _pin_metadata(self, source: CachedSource,
+                      reader: ParquetFileReader) -> None:
+        """Load + pin the file's probe metadata into the hot tier: the
+        footer bytes (tail-declared length), page indexes, bloom
+        filters, dictionary pages."""
+        from ..scan.plan import coalesce
+
+        size = source.size
+        if size >= 12:
+            tail = bytes(source.read_at(size - 8, 8))
+            flen = int.from_bytes(tail[:4], "little")
+            if 0 < flen <= size - 12:
+                source.load([(size - 8 - flen, flen + 8)], pinned=True)
+        ranges = _metadata_ranges(reader)
+        if ranges:
+            extents = coalesce(ranges, _META_GAP, 8 << 20)
+            source.load([(e.offset, e.length) for e in extents], pinned=True)
+
+    # -- the probe ladder ----------------------------------------------------
+
+    def _filter_set(self, columns) -> Optional[set]:
+        cols = columns if columns is not None else self._columns
+        if cols is None:
+            return None
+        return set(cols) | {self.key_column.split(".")[0]}
+
+    def _out_columns(self, batch, columns) -> list:
+        """(name, cursor) pairs of the projected output columns, flat
+        only, in schema order."""
+        from ..api.reader import _ColumnCursor
+
+        want = columns if columns is not None else self._columns
+        out = []
+        for b in batch.columns:
+            desc = b.descriptor
+            name = ".".join(desc.path)
+            if want is not None and desc.path[0] not in set(want) \
+                    and name not in set(want):
+                continue
+            if desc.max_repetition_level > 0:
+                raise UnsupportedFeatureError(
+                    f"lookup projection includes repeated column {name!r}; "
+                    "the lookup face is flat-only (use the batch stream "
+                    "with assemble_nested)"
+                )
+            out.append((name, _ColumnCursor(b)))
+        return out
+
+    def _key_cursor(self, batch):
+        from ..api.reader import _ColumnCursor
+
+        for b in batch.columns:
+            if ".".join(b.descriptor.path) == self.key_column:
+                return _ColumnCursor(b)
+        raise ValueError(
+            f"key column {self.key_column!r} missing from the decoded "
+            "probe batch"
+        )
+
+    @staticmethod
+    def _norm_key(key):
+        """Key literal in cell space (cursor cells stringify binary)."""
+        if isinstance(key, bytes):
+            return key.decode("utf-8", "surrogateescape")
+        return key
+
+    def _pages_in(self, reader, rg, covered, filter_set) -> int:
+        """Data pages whose rows intersect ``covered``, summed over the
+        selected chunks (the probe's page cost, OffsetIndex truth)."""
+        from ..format.file_read import page_row_spans, spans_overlap
+
+        n = int(rg.num_rows or 0)
+        pages = 0
+        for chunk in rg.columns or []:
+            md = chunk.meta_data
+            if filter_set and md is not None and md.path_in_schema and \
+                    md.path_in_schema[0] not in filter_set:
+                continue
+            oi = reader.read_offset_index(chunk)
+            if oi is None or not oi.page_locations:
+                pages += 1
+                continue
+            for _pl, a, b in page_row_spans(oi, n):
+                if spans_overlap(a, b, covered):
+                    pages += 1
+        return pages
+
+    def _probe(self, pred, match, columns, tenant, limit):
+        ctx = (
+            trace.using(tenant.tracer)
+            if tenant is not None else contextlib.nullcontext()
+        )
+        out: List[dict] = []
+        done = False
+        with ctx, trace.span("serve.lookup",
+                             attrs={"key_column": self.key_column}):
+            trace.count("serve.lookup_probes")
+            filter_set = self._filter_set(columns)
+            for i in range(len(self._sources)):
+                if done:
+                    break
+                lf = self._file(i)
+                with lf.lock:
+                    reader = lf.reader
+                    for gi, rg in enumerate(reader.row_groups):
+                        if limit is not None and len(out) >= limit:
+                            done = True
+                            break
+                        if not pred.may_match(rg):
+                            trace.count("serve.lookup_groups_pruned")
+                            continue
+                        if not pred.may_match_with(reader, rg):
+                            # stats kept it, the bloom filter killed it
+                            trace.count("serve.lookup_bloom_skips")
+                            continue
+                        rr = pred.row_ranges(reader, gi)
+                        if not rr:
+                            # every page's ColumnIndex ruled it out
+                            trace.count("serve.lookup_groups_pruned")
+                            continue
+                        batch, covered = reader.read_row_group_ranges(
+                            gi, rr, filter_set
+                        )
+                        if not covered:
+                            continue
+                        trace.count(
+                            "serve.lookup_pages_read",
+                            self._pages_in(reader, rg, covered, filter_set),
+                        )
+                        kc = self._key_cursor(batch)
+                        cursors = self._out_columns(batch, columns)
+                        for r in range(batch.num_rows):
+                            if match(kc.cell(r)):
+                                out.append(
+                                    {n: c.cell(r) for n, c in cursors}
+                                )
+                                if limit is not None and len(out) >= limit:
+                                    break
+            if limit is not None:
+                out = out[:limit]
+            # counted HERE, after any limit stop, so the registered rows
+            # counter never under-reports an early-terminated probe
+            trace.count("serve.lookup_rows", len(out))
+        return out
+
+    # -- public --------------------------------------------------------------
+
+    def lookup(self, key, columns: Optional[Sequence[str]] = None,
+               tenant=None, limit: Optional[int] = None) -> List[dict]:
+        """Rows whose ``key_column`` equals ``key``, as dicts.  ``limit``
+        stops the probe early (a unique-key point read passes
+        ``limit=1``)."""
+        pred = col(self.key_column) == key
+        want = self._norm_key(key)
+        return self._probe(
+            pred, lambda v: v == want, columns, tenant, limit
+        )
+
+    def range(self, lo, hi, columns: Optional[Sequence[str]] = None,
+              tenant=None, limit: Optional[int] = None) -> List[dict]:
+        """Rows with ``lo <= key_column <= hi`` (inclusive both ends),
+        as dicts."""
+        pred = (col(self.key_column) >= lo) & (col(self.key_column) <= hi)
+        nlo, nhi = self._norm_key(lo), self._norm_key(hi)
+        return self._probe(
+            pred,
+            lambda v: v is not None and nlo <= v <= nhi,
+            columns, tenant, limit,
+        )
+
+    def page_size_bound(self) -> int:
+        """The largest compressed data-page size across the dataset's
+        OffsetIndexes — the byte ceiling one hot point probe should stay
+        under per selected column (benches assert against this)."""
+        bound = 0
+        for i in range(len(self._sources)):
+            lf = self._file(i)
+            with lf.lock:
+                for rg in lf.reader.row_groups:
+                    for chunk in rg.columns or []:
+                        oi = lf.reader.read_offset_index(chunk)
+                        if oi is None:
+                            continue
+                        for pl in oi.page_locations or []:
+                            bound = max(
+                                bound, int(pl.compressed_page_size or 0)
+                            )
+        return bound
+
+    def close(self) -> None:
+        """Close every open reader (and the cache, when privately
+        owned); idempotent."""
+        with self._open_lock:
+            if self._closed:
+                return
+            self._closed = True
+            files = list(self._files.values())
+            self._files.clear()
+        for lf in files:
+            lf.reader.close()
+        if self._own_cache:
+            self.cache.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,343 @@
+"""Per-tenant admission and fair-share scheduling over the shared cache.
+
+The scan scheduler bounds ONE scan's appetite (``ScanOptions.
+prefetch_bytes``); a serving process runs MANY concurrent scans for
+different clients over one storage system and one shared cache.  This
+module adds the missing layer:
+
+* :class:`Serving` — the per-process serving context: one
+  :class:`~parquet_floor_tpu.serve.cache.SharedBufferCache`, one global
+  prefetch budget, one fair-share gate over storage reads.
+* :class:`Tenant` — a registered client with a **weight**.  Each tenant
+  gets (a) a proportional slice of the global prefetch budget as its
+  scans' ``prefetch_bytes`` (admission: a heavier tenant may keep more
+  bytes in flight), (b) a seat in the **weighted-fair queue** over
+  storage reads (cache misses) — under contention, grants interleave in
+  weight proportion rather than first-come-flood — and (c) its own
+  :class:`~parquet_floor_tpu.utils.trace.Tracer` scope, so the
+  per-tenant :class:`~parquet_floor_tpu.utils.trace.ScanReport` (cache
+  hit rate, stall fraction, bytes from cache vs storage) falls straight
+  out of the PR 4 machinery with no new plumbing.
+
+Fair queueing is classic virtual-time WFQ at extent-fetch granularity:
+each grant advances the tenant's virtual finish time by
+``bytes / weight``; waiters are served in virtual-time order under a
+byte-capacity gate on in-flight storage reads.  Cache hits never touch
+the gate — fairness arbitrates storage bandwidth, not shared memory.
+
+Docs: ``docs/serving.md``.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from dataclasses import replace
+from typing import Dict, Optional, Sequence
+
+from ..io.source import FileSource
+from ..utils import trace
+from .cache import CachedSource, SharedBufferCache
+
+
+class _FairGate:
+    """Weighted-fair byte gate over storage reads.
+
+    ``acquire(state, cost)`` blocks until the caller both (a) is the
+    earliest waiter by virtual finish time and (b) fits under the
+    in-flight byte capacity.  Uncontended acquires (no waiters, fits)
+    are a single lock round-trip."""
+
+    def __init__(self, capacity_bytes: int):
+        if capacity_bytes <= 0:
+            raise ValueError(
+                f"capacity_bytes must be > 0, got {capacity_bytes}"
+            )
+        self.capacity = int(capacity_bytes)
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._vtime = 0.0
+        self._heap: list = []   # (vtag, seq, ticket)
+        self._seq = 0
+
+    def acquire(self, state: "_TenantShare", cost: int) -> None:
+        # one read larger than the whole gate must still pass (alone):
+        # clamp its charge to the capacity, mirroring the scan budget's
+        # oversized-unit rule
+        cost = min(int(cost), self.capacity)
+        if cost <= 0:
+            return
+        with self._cv:
+            # the virtual tag is assigned at ARRIVAL (WFQ start time:
+            # the later of the system's virtual clock and the tenant's
+            # own last finish) and the tenant's finish advances by
+            # cost/weight — which is exactly how a heavy tenant's
+            # backlog interleaves 2:1 against a light one's instead of
+            # queueing FIFO
+            vtag = max(self._vtime, state.vfinish)
+            state.vfinish = vtag + cost / state.weight
+            if not self._heap and self._inflight + cost <= self.capacity:
+                self._grant(vtag, cost)
+                return
+            trace.count("serve.fair_share_waits")
+            ticket = [False]  # granted flag, mutated under the cv
+            self._seq += 1
+            heapq.heappush(self._heap, (vtag, self._seq, ticket, cost))
+            while True:
+                if self._pump():
+                    # a grant may belong to ANOTHER waiter parked in
+                    # wait() — it must be woken to see its ticket
+                    self._cv.notify_all()
+                if ticket[0]:
+                    return
+                self._cv.wait()
+
+    def _grant(self, vtag: float, cost: int) -> None:
+        self._vtime = max(self._vtime, vtag)
+        self._inflight += cost
+        trace.gauge_max("serve.inflight_storage_bytes_max", self._inflight)
+
+    def _pump(self) -> int:
+        """Grant from the head of the virtual-time order while capacity
+        lasts (caller holds the cv); returns how many grants were made."""
+        granted = 0
+        while self._heap:
+            vtag, _seq, ticket, cost = self._heap[0]
+            if self._inflight + cost > self.capacity:
+                break
+            heapq.heappop(self._heap)
+            self._grant(vtag, cost)
+            ticket[0] = True
+            granted += 1
+        return granted
+
+    def release(self, cost: int) -> None:
+        cost = min(int(cost), self.capacity)
+        if cost <= 0:
+            return
+        with self._cv:
+            self._inflight -= cost
+            self._pump()
+            self._cv.notify_all()
+
+
+class _TenantShare:
+    """The gate-side state of one tenant (virtual finish time + weight).
+    Bound into every :class:`CachedSource` the tenant opens."""
+
+    __slots__ = ("weight", "vfinish", "gate")
+
+    def __init__(self, weight: float, gate: _FairGate):
+        self.weight = float(weight)
+        self.vfinish = 0.0
+        self.gate = gate
+
+    def acquire(self, cost: int) -> None:
+        self.gate.acquire(self, cost)
+
+    def release(self, cost: int) -> None:
+        self.gate.release(cost)
+
+
+class Tenant:
+    """One registered serving client — see module docstring.  Created
+    via :meth:`Serving.tenant`, closed via :meth:`close` (deregisters
+    the weight; the tracer and its report survive for post-mortems)."""
+
+    def __init__(self, serving: "Serving", name: str, weight: float):
+        self._serving = serving
+        self.name = name
+        self.weight = float(weight)
+        self.tracer = trace.Tracer(enabled=True)
+        self._share = _TenantShare(self.weight, serving._gate)
+        self._closed = False
+
+    # -- budget admission ---------------------------------------------------
+
+    def prefetch_share(self) -> int:
+        """This tenant's slice of the global prefetch budget:
+        ``total * weight / Σ open-tenant weights`` (floored at 1 MiB so
+        a feather-weight tenant still makes progress)."""
+        return self._serving._share_bytes(self.weight)
+
+    def scan_options(self, base: Optional["object"] = None):
+        """``base`` (a :class:`~parquet_floor_tpu.scan.ScanOptions`, or
+        None for defaults) with ``prefetch_bytes`` replaced by this
+        tenant's fair share — the admission knob every scan face already
+        obeys."""
+        from ..scan import ScanOptions
+
+        sc = base if base is not None else ScanOptions()
+        return replace(sc, prefetch_bytes=self.prefetch_share())
+
+    # -- sources ------------------------------------------------------------
+
+    def source_factories(self, sources: Sequence) -> list:
+        """Zero-arg factories producing shared-cache-backed sources for
+        the scan chain (the scanner resolves factories at file-open time
+        and owns the close).  Accepts paths, zero-arg factories, or open
+        positional sources (ownership transfers to the scan)."""
+        cache = self._serving.cache
+        share = self._share
+
+        def make(src):
+            def factory():
+                inner = src
+                if callable(inner) and not hasattr(inner, "read_at"):
+                    inner = inner()
+                if not hasattr(inner, "read_at"):
+                    inner = FileSource(inner)
+                try:
+                    return CachedSource(inner, cache, gate=share)
+                except BaseException:
+                    inner.close()
+                    raise
+            return factory
+
+        return [make(s) for s in sources]
+
+    # -- the scan face ------------------------------------------------------
+
+    def scan(self, sources: Sequence, columns=None, options=None,
+             scan=None, predicate=None, order=None):
+        """A :class:`~parquet_floor_tpu.scan.DatasetScanner` over
+        ``sources``, attributed to this tenant: shared-cache-backed
+        sources, fair-share-gated storage reads, ``prefetch_bytes``
+        replaced by the tenant's budget share, and the scanner pinned to
+        the tenant's tracer — iterate it from anywhere and the metrics
+        still land here.  Use under ``with`` (or ``close()``) like any
+        scanner."""
+        if self._closed:
+            raise ValueError(f"tenant {self.name!r} is closed")
+        from ..scan import DatasetScanner
+
+        sources = list(sources)
+        sc = self.scan_options(scan)
+        with trace.using(self.tracer):
+            trace.decision("serve.admission", {
+                "tenant": self.name,
+                "weight": self.weight,
+                "prefetch_bytes": sc.prefetch_bytes,
+                "files": len(sources),
+            })
+            return DatasetScanner(
+                self.source_factories(sources), columns=columns,
+                options=options, scan=sc, predicate=predicate, order=order,
+            )
+
+    # -- observability -------------------------------------------------------
+
+    def report(self, wall_seconds: Optional[float] = None):
+        """This tenant's :class:`~parquet_floor_tpu.utils.trace.
+        ScanReport` — disjoint from every other tenant's by construction
+        (each tenant's scans bind their workers to its own tracer)."""
+        return self.tracer.scan_report(
+            wall_seconds=wall_seconds,
+            budget_bytes=self.prefetch_share(),
+        )
+
+    def reset(self) -> None:
+        """Clear the tenant's tracer (per-interval reporting)."""
+        self.tracer.reset()
+
+    def close(self) -> None:
+        """Deregister from the serving context (its weight leaves the
+        budget split); idempotent.  The tracer stays readable."""
+        if not self._closed:
+            self._closed = True
+            self._serving._drop(self.name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Serving:
+    """The per-process serving context: one shared cache, one global
+    prefetch budget split across tenants by weight, one weighted-fair
+    gate over storage reads.
+
+    ``cache=None`` builds a private :class:`SharedBufferCache` (closed
+    with the context); passing one shares it — the caller keeps
+    ownership.  ``prefetch_bytes`` is the GLOBAL in-flight budget the
+    tenants' shares sum to; ``inflight_bytes`` caps concurrently
+    in-flight STORAGE reads for the fair gate (defaults to
+    ``prefetch_bytes``)."""
+
+    def __init__(self, cache: Optional[SharedBufferCache] = None,
+                 prefetch_bytes: int = 64 << 20,
+                 inflight_bytes: Optional[int] = None):
+        if prefetch_bytes <= 0:
+            raise ValueError(
+                f"prefetch_bytes must be > 0, got {prefetch_bytes}"
+            )
+        self._own_cache = cache is None
+        self.cache = cache if cache is not None else SharedBufferCache()
+        self.prefetch_bytes = int(prefetch_bytes)
+        self._gate = _FairGate(
+            inflight_bytes if inflight_bytes is not None else prefetch_bytes
+        )
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, Tenant] = {}
+        self._closed = False
+
+    def tenant(self, name: str, weight: float = 1.0) -> Tenant:
+        """Register (or fetch) the tenant ``name``.  Re-requesting an
+        open tenant returns the existing object — one identity per name;
+        a different weight on a re-request is rejected rather than
+        silently rewriting the share."""
+        if weight <= 0:
+            raise ValueError(f"weight must be > 0, got {weight}")
+        with self._lock:
+            if self._closed:
+                raise ValueError("Serving context is closed")
+            t = self._tenants.get(name)
+            if t is not None:
+                if t.weight != float(weight):
+                    raise ValueError(
+                        f"tenant {name!r} is already registered with "
+                        f"weight {t.weight}, not {weight}"
+                    )
+                return t
+            t = Tenant(self, name, weight)
+            self._tenants[name] = t
+        with trace.using(t.tracer):
+            trace.decision("serve.tenant", {
+                "tenant": name, "weight": float(weight),
+            })
+        return t
+
+    def tenants(self) -> list:
+        with self._lock:
+            return list(self._tenants.values())
+
+    def _share_bytes(self, weight: float) -> int:
+        with self._lock:
+            total_w = sum(t.weight for t in self._tenants.values())
+        total_w = total_w or weight
+        return max(1 << 20, int(self.prefetch_bytes * weight / total_w))
+
+    def _drop(self, name: str) -> None:
+        with self._lock:
+            self._tenants.pop(name, None)
+
+    def close(self) -> None:
+        """Close every tenant and (when owned) the cache; idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            tenants = list(self._tenants.values())
+            self._tenants.clear()
+        for t in tenants:
+            t._closed = True
+        if self._own_cache:
+            self.cache.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

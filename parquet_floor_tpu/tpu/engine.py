@@ -1988,6 +1988,13 @@ class TpuRowGroupReader:
         self._sdict_host: Dict[tuple, tuple] = {}   # key → (rows, lens)
         self._sdict_dev: Dict[tuple, tuple] = {}    # key → (rows_dev, lens_dev)
         self._lock = threading.Lock()
+        # concurrent stage workers grow the shape buckets in whatever
+        # order the pool schedules groups — padded widths would vary run
+        # to run (values never do).  Seeding the footer-derivable
+        # buckets to their file-wide maxima BEFORE any staging makes
+        # every size-driven bucket order-independent (docs/perf.md)
+        if int(_os.environ.get("PFTPU_STAGE_WORKERS", "1") or "1") > 1:
+            self._preseed_buckets()
 
     # -- bucket bookkeeping -------------------------------------------------
 
@@ -2002,6 +2009,83 @@ class TpuRowGroupReader:
             else:
                 self._hwm_state[key] = b
         return b
+
+    def _preseed_buckets(self) -> None:
+        """Seed the footer-derivable shape buckets to their file-wide
+        maxima (``PFTPU_STAGE_WORKERS > 1``; docs/perf.md).
+
+        With one stage worker, buckets grow monotonically in group order
+        — deterministic.  With k>1 the growth order follows pool
+        scheduling, so a group staged before/after a bigger sibling gets
+        different padded widths run to run.  Seeding each SIZE-driven
+        bucket to a footer bound that dominates every group's need makes
+        those widths order-independent:
+
+        * ``nexp`` — the value-expansion count is the chunk's NON-NULL
+          count: exact when the footer statistics carry a
+          ``null_count`` (``num_values - null_count``), else bounded by
+          ``num_values`` (non-nulls ≤ values — null-heavy optional
+          columns without stats over-pad toward the value count);
+        * ``pages`` — page-table rows are at most the OffsetIndex's page
+          count (pages with values ≤ pages);
+        * ``mb`` — DELTA miniblocks are at most ``ceil(n / 32) + 8``
+          (spec: 128-value blocks × 4 miniblocks, plus header slack);
+        * ``arena`` — staged payloads are at most the footer's
+          ``total_uncompressed_size`` total (which includes page-header
+          bytes the arena never stores), plus the Pallas lead/tail.
+
+        CONTENT-driven buckets (string byte lengths, dictionary entry
+        counts, RLE run tables — the latter slab-internal) are not
+        derivable from the footer and still grow by high-water mark;
+        returned column shapes stay byte-stable whenever those widths
+        are uniform across a file's groups (the pinned k=2 test's
+        shape).  Overshoot is bounded: the seeds are the same maxima the
+        buckets converge to after one full pass anyway."""
+        per_nexp: Dict[str, int] = {}
+        per_pages: Dict[str, int] = {}
+        per_mb: Dict[str, int] = {}
+        arena_max = 0
+        for rg in self.reader.row_groups:
+            group_bytes = 0
+            for chunk in rg.columns or []:
+                meta = chunk.meta_data
+                if meta is None or not meta.path_in_schema:
+                    continue
+                path = tuple(meta.path_in_schema)
+                name = path[0] if len(path) == 1 else ".".join(path)
+                nv = int(meta.num_values or 0)
+                nn = nv
+                st = meta.statistics
+                if st is not None and st.null_count is not None and \
+                        0 <= int(st.null_count) <= nv:
+                    nn = nv - int(st.null_count)
+                per_nexp[name] = max(per_nexp.get(name, 0), nn)
+                group_bytes += int(meta.total_uncompressed_size or 0)
+                if Encoding.DELTA_BINARY_PACKED in (meta.encodings or []):
+                    per_mb[name] = max(
+                        per_mb.get(name, 0), -(-nv // 32) + 8
+                    )
+                try:
+                    oi = self.reader.read_offset_index(chunk)
+                except (OSError, MemoryError):
+                    raise
+                except Exception:
+                    oi = None  # unreadable index: that bucket stays HWM
+                if oi is not None and oi.page_locations:
+                    per_pages[name] = max(
+                        per_pages.get(name, 0), len(oi.page_locations)
+                    )
+            arena_max = max(arena_max, group_bytes)
+        for name, nv in per_nexp.items():
+            self._hwm(("nexp", name), nv)
+        for name, np_ in per_pages.items():
+            self._hwm(("pages", name), np_, minimum=4)
+        for name, mb in per_mb.items():
+            self._hwm(("mb", name), mb, minimum=4)
+        if arena_max:
+            lead = plk.ARENA_LEAD if self._pl_enabled else 0
+            tail = plk.ARENA_TAIL if self._pl_enabled else 8
+            self._hwm(("arena",), arena_max + lead + tail, minimum=1 << 16)
 
     def _string_dict_key(self, arena, off, size, name):
         """Content-keyed string dictionary pool: build (or reuse) the padded

@@ -60,6 +60,8 @@ _FORMAT = 1
 _MAGIC = b"PFEXEC1\n"
 _MAX_MEMORY = 128   # loaded executables kept per process (programs are
 #                     few: shape buckets converge by design)
+_TMP_GRACE_S = 3600  # orphaned publish temp files older than this are
+#                      swept by the GC (no live writer holds one that long)
 
 
 def _env_signature() -> dict:
@@ -121,10 +123,25 @@ class _Entry:
 
 
 class ExecutableCache:
-    """Disk + memory cache of AOT-compiled fused decode executables."""
+    """Disk + memory cache of AOT-compiled fused decode executables.
 
-    def __init__(self, path: str):
+    ``max_bytes`` (default from ``PFTPU_EXEC_CACHE_MAX_BYTES``; 0/None =
+    unbounded) bounds the DIRECTORY: after each publish, entries are
+    evicted least-recently-USED first (mtime order — loads touch their
+    entry's mtime) until the total fits.  This is how stale-toolchain
+    entries die: a jax upgrade changes every key, the old entries stop
+    being touched, and the next publishes age them out.  The
+    just-published entry is never evicted, even when it alone exceeds
+    the cap (a cache that evicts its only usable entry would thrash)."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
+        if max_bytes is None:
+            env = os.environ.get("PFTPU_EXEC_CACHE_MAX_BYTES")
+            max_bytes = int(env) if env else 0
+        if max_bytes < 0:
+            raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
+        self.max_bytes = int(max_bytes) or None
         self._lock = threading.Lock()
         self._mem: dict = {}         # key hex → _Entry
         self._key_cache: dict = {}   # signature tuple → key hex
@@ -165,6 +182,12 @@ class ExecutableCache:
                 blob = fh.read()
         except OSError:
             return None
+        try:
+            # touch: the GC evicts by mtime, so a load must refresh its
+            # entry's recency or a hot executable ages out like a cold one
+            os.utime(p, None)
+        except OSError:
+            pass
         try:
             if blob[: len(_MAGIC)] != _MAGIC:
                 raise ValueError("bad magic")
@@ -225,6 +248,7 @@ class ExecutableCache:
                 except OSError:
                     pass
                 raise
+            self._gc(keep=self._entry_path(key))
         except MemoryError:
             raise
         except Exception as e:
@@ -236,6 +260,66 @@ class ExecutableCache:
                 "action": "store_failed",
                 "key": key[:12],
                 "error": str(e)[:200],
+            })
+
+    def _gc(self, keep: str) -> None:
+        """Size-bounded directory GC at publish time (docstring policy:
+        LRU by mtime, ``keep`` immune).  Best-effort everywhere — a
+        racing process replacing or already-removing an entry must never
+        fail THIS process's publish."""
+        if not self.max_bytes:
+            return
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return
+        entries = []
+        total = 0
+        now = time.time()
+        for n in names:
+            p = os.path.join(self.path, n)
+            if n.endswith(".tmp"):
+                # a crashed publish (killed between mkstemp and the
+                # os.replace) orphans its temp file forever: sweep any
+                # old enough that no live writer can still own it —
+                # otherwise the directory's REAL usage exceeds the cap
+                # unboundedly as crashes accumulate
+                try:
+                    if now - os.stat(p).st_mtime > _TMP_GRACE_S:
+                        os.remove(p)
+                except OSError:
+                    pass
+                continue
+            if not n.endswith(".pfexec"):
+                continue
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, p))
+            total += st.st_size
+        if total <= self.max_bytes:
+            return
+        evicted = 0
+        freed = 0
+        for _mtime, size, p in sorted(entries):
+            if total <= self.max_bytes:
+                break
+            if p == keep:
+                continue
+            try:
+                os.remove(p)
+            except OSError:
+                continue
+            total -= size
+            freed += size
+            evicted += 1
+        if evicted:
+            trace.decision("engine.exec_cache", {
+                "action": "gc",
+                "evicted": evicted,
+                "freed_bytes": freed,
+                "max_bytes": self.max_bytes,
             })
 
     # -- resolution ----------------------------------------------------------

@@ -92,6 +92,23 @@ class names:
         "salvage.map_skips",
         "trace.decisions_dropped",
         "trace.events_dropped",
+        # predicate page pruning on the scan face (scan/plan.py,
+        # docs/scan.md): data pages skipped via row_ranges→OffsetIndex
+        "scan.pages_pruned",
+        # the multi-tenant serving layer (serve/, docs/serving.md)
+        "serve.cache_hits",
+        "serve.cache_misses",
+        "serve.cache_hit_bytes",
+        "serve.cache_miss_bytes",
+        "serve.cache_evictions",
+        "serve.meta_evictions",
+        "serve.singleflight_waits",
+        "serve.fair_share_waits",
+        "serve.lookup_probes",
+        "serve.lookup_groups_pruned",
+        "serve.lookup_bloom_skips",
+        "serve.lookup_pages_read",
+        "serve.lookup_rows",
         # the training input pipeline (data.DataLoader, docs/data.md)
         "data.rows_emitted",
         "data.batches_emitted",
@@ -109,6 +126,7 @@ class names:
         "engine.stage_queue_depth_max",
         "data.carry_rows_max",
         "data.prefetch_to_device_depth_max",
+        "serve.inflight_storage_bytes_max",
     })
     DECISIONS = frozenset({
         "engine.auto",
@@ -132,6 +150,8 @@ class names:
         "data.epoch_plan",
         "data.resume",
         "data.unit_quarantined",
+        "serve.tenant",
+        "serve.admission",
     })
     SPANS = frozenset({
         "read",
@@ -145,6 +165,7 @@ class names:
         "scan.consumer_stall",
         "data.next_batch",
         "data.prefetch_to_device",
+        "serve.lookup",
     })
     ALL = COUNTERS | GAUGES | DECISIONS | SPANS
 

@@ -53,6 +53,15 @@ echo "== remote scan smoke (simulator, faults on) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python scripts/remote_scan_smoke.py || exit 1
 
+# Serving smoke (docs/serving.md): one cold tenant populates the shared
+# buffer cache, two concurrent warm tenants must then be served from it
+# (hit-rate floor per tenant, reports disjoint and attributed), and a
+# hot one-column Dataset.lookup must cost at most ONE data page of
+# storage bytes — the point-probe contract, proven by cache counters.
+echo "== serving smoke (shared cache, concurrent tenants, point lookup) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/serving_smoke.py || exit 1
+
 # Salvage differential smoke: 60 seeded corruption cases through ALL
 # FOUR read faces (sequential host, host scan, device scan, loader),
 # asserting unanimous fatality, identical quarantine sets, identical

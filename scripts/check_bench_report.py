@@ -58,6 +58,7 @@ def check_report(bench_log: pathlib.Path) -> int:
           f"{rep['bytes_read']} bytes read)")
     return (
         check_remote_leg(result.get("detail", {}))
+        or check_serving_leg(result.get("detail", {}))
         or check_exec_cache_leg(result.get("detail", {}))
         or check_launches(result.get("detail", {}))
         or check_loader_leg(result.get("detail", {}))
@@ -174,6 +175,64 @@ def check_remote_leg(detail: dict) -> int:
         f"hedges={detail['remote_hedges']} retries={detail['remote_retries']} "
         f"breaker_trips={detail['remote_breaker_trips']} "
         f"throttles={detail['remote_throttles']})"
+    )
+    return 0
+
+
+def check_serving_leg(detail: dict) -> int:
+    """The multi-tenant serving leg (docs/serving.md): with two tenants
+    scanning overlapping data through the shared buffer cache, the
+    second tenant's pass must be served mostly from memory; concurrent
+    tenants' reports must stay disjoint and correctly attributed; a hot
+    one-column ``Dataset.lookup`` must cost at most ONE data page of
+    storage bytes (and more than zero — a free probe means the page was
+    pre-cached and the proof proves nothing); the pruning ladder's
+    stats and bloom rungs must both fire; and every serve.* metric the
+    leg emitted must be registered in ``trace.names``."""
+    rate = detail.get("serving_hit_rate_second_pass")
+    if rate is None:
+        return fail("serving leg missing its second-pass hit rate")
+    if not rate >= 0.5:
+        return fail(f"serving second tenant's cache hit-rate {rate} < 0.5 "
+                    "— the shared cache is not sharing")
+    if not detail.get("serving_rows", 0) > 0 or \
+            detail.get("serving_second_rows") != detail.get("serving_rows"):
+        return fail("serving tenants disagree on the dataset's rows")
+    if detail.get("serving_tenants_disjoint") is not True:
+        return fail("concurrent tenants' reports are not disjoint / "
+                    "correctly attributed")
+    cost = detail.get("serving_lookup_storage_bytes")
+    bound = detail.get("serving_lookup_page_bound")
+    if cost is None or not bound:
+        return fail("serving leg missing the lookup byte-cost proof")
+    if not 0 < cost <= bound:
+        return fail(f"hot one-column lookup read {cost} storage bytes "
+                    f"(one-page bound {bound}) — the point probe must "
+                    "touch one page, not a row group")
+    if not detail.get("serving_lookup_groups_pruned", 0) >= 1:
+        return fail("lookup never pruned a row group by footer stats")
+    if not detail.get("serving_lookup_bloom_skips", 0) >= 1:
+        return fail("lookup never skipped a row group by bloom filter")
+    if detail.get("serving_remote_rows", 0) <= 0:
+        return fail("serving remote tenants disagree (or read no rows)")
+    rrate = detail.get("serving_remote_warm_hit_rate")
+    if rrate is None or not rrate >= 0.5:
+        return fail(f"serving remote warm hit-rate {rrate} < 0.5 — the "
+                    "cache law does not hold over the remote source")
+    rep = detail.get("serving_report") or {}
+    emitted = set(rep.get("counters") or {}) | set(rep.get("gauges") or {})
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from parquet_floor_tpu.utils.trace import names
+
+    unregistered = emitted - names.ALL
+    if unregistered:
+        return fail(f"serving counters not in trace.names: "
+                    f"{sorted(unregistered)}")
+    print(
+        "check_bench_report: serving leg ok "
+        f"(second-pass hit-rate {rate}, lookup {cost} B <= {bound} B page "
+        f"bound, bloom skips {detail['serving_lookup_bloom_skips']}, "
+        f"remote warm hit-rate {rrate})"
     )
     return 0
 

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Commit-gate serving smoke (docs/serving.md).
+
+Seeded, self-contained, CPU-only: builds a small keyed dataset, then
+asserts the serving layer's two load-bearing floors —
+
+1. **shared-cache hit-rate**: after one tenant's cold scan, two MORE
+   tenants scanning the same files CONCURRENTLY are each served almost
+   entirely from the shared buffer cache (hit-rate >= 0.5 per tenant,
+   from each tenant's OWN report), and their reports stay disjoint
+   (each sees exactly one scan's planned bytes);
+2. **probe byte-cost**: a hot one-column ``Dataset.lookup`` (metadata
+   pinned by the warm pass) reads more than zero and at most ONE data
+   page of storage bytes, proven by the cache's miss-byte counters.
+
+Exit 0 on success, 1 with a diagnostic otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+import threading
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from parquet_floor_tpu import (  # noqa: E402
+    ParquetFileWriter,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.serve import (  # noqa: E402
+    Dataset,
+    Serving,
+    SharedBufferCache,
+)
+
+GROUP = 256
+PAGE = 64
+GROUPS = 4
+FILES = 2
+
+
+def build_paths() -> list:
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.DOUBLE).named("d"),
+    )
+    per = GROUP * GROUPS
+    paths = []
+    for i in range(FILES):
+        p = f"/tmp/pftpu_serving_smoke_{per}_{i}.parquet"
+        if not os.path.exists(p):
+            rng = np.random.default_rng(40 + i)
+            with ParquetFileWriter(p, schema, WriterOptions(
+                row_group_rows=GROUP, data_page_values=PAGE,
+                bloom_filter_columns={"k": True},
+            )) as w:
+                for lo in range(0, per, GROUP):
+                    base = 2 * (i * per + lo)
+                    w.write_columns({
+                        "k": base + 2 * np.arange(GROUP, dtype=np.int64),
+                        "s": [None if j % 9 == 0 else f"s{j % 41}"
+                              for j in range(GROUP)],
+                        "d": rng.standard_normal(GROUP),
+                    })
+        paths.append(p)
+    return paths
+
+
+def fail(msg: str) -> int:
+    print(f"serving_smoke: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def hit_rate(report) -> float:
+    hit = report.counters.get("serve.cache_hit_bytes", 0)
+    miss = report.counters.get("serve.cache_miss_bytes", 0)
+    return hit / (hit + miss) if hit + miss else 0.0
+
+
+def main() -> int:
+    paths = build_paths()
+
+    with Serving(prefetch_bytes=16 << 20) as srv:
+        cold = srv.tenant("cold")
+
+        def scan_rows(tenant):
+            rows = 0
+            with tenant.scan(paths) as s:
+                for unit in s:
+                    rows += unit.batch.num_rows
+            return rows
+
+        rows = scan_rows(cold)
+        if rows != FILES * GROUP * GROUPS:
+            return fail(f"cold scan read {rows} rows, expected "
+                        f"{FILES * GROUP * GROUPS}")
+        warm_a = srv.tenant("warm-a", weight=2)
+        warm_b = srv.tenant("warm-b")
+        results: dict = {}
+
+        def run(name, tenant):
+            results[name] = scan_rows(tenant)
+
+        threads = [
+            threading.Thread(target=run, args=("a", warm_a)),
+            threading.Thread(target=run, args=("b", warm_b)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if results["a"] != rows or results["b"] != rows:
+            return fail(f"concurrent warm scans read {results}, "
+                        f"expected {rows} rows each")
+        used = cold.report().counters.get("scan.bytes_used", 0)
+        for name, tenant in (("warm-a", warm_a), ("warm-b", warm_b)):
+            rep = tenant.report()
+            rate = hit_rate(rep)
+            if not rate >= 0.5:
+                return fail(f"{name} hit-rate {rate:.3f} < 0.5 on the "
+                            "warm concurrent pass")
+            if rep.counters.get("scan.bytes_used", 0) != used:
+                return fail(f"{name}'s report is not attributed to one "
+                            "scan (bytes_used "
+                            f"{rep.counters.get('scan.bytes_used')} != "
+                            f"{used})")
+            print(f"serving_smoke: {name} hit-rate {rate:.3f}, "
+                  f"bytes_used {used} (disjoint)")
+
+    # -- probe byte-cost floor (its own cache: nothing pre-populated) ----
+    per = GROUP * GROUPS
+    with SharedBufferCache() as cache:
+        with Dataset(paths, "k", cache=cache) as ds:
+            ds.lookup(0)  # warm: opens every file, pins probe metadata
+            bound = ds.page_size_bound()
+            s0 = cache.stats()
+            hot = ds.lookup(2 * (FILES * per - 1), columns=["k"])
+            s1 = cache.stats()
+            cost = s1["miss_bytes"] - s0["miss_bytes"]
+            if len(hot) != 1:
+                return fail(f"hot lookup returned {len(hot)} rows, "
+                            "expected exactly 1")
+            if not 0 < cost <= bound:
+                return fail(f"hot one-column lookup cost {cost} storage "
+                            f"bytes (one-page bound {bound})")
+            print(f"serving_smoke: hot lookup cost {cost} B <= one-page "
+                  f"bound {bound} B")
+    print("serving_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

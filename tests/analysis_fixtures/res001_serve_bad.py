@@ -1,0 +1,29 @@
+"""Seeded-bad: the serving-layer leak shapes — a SharedBufferCache /
+Serving context / lookup Dataset bound to a local with no exception path
+releasing it (the Dataset keeps file descriptors OPEN by design, so an
+abandoned one is an fd leak, not just memory)."""
+
+from parquet_floor_tpu.serve import Dataset, Serving, SharedBufferCache
+
+
+def build_cache():
+    cache = SharedBufferCache(data_bytes=1 << 20)
+    cache.put(("f", 1), 0, b"xyz")  # a raise here leaks the buffers
+    cache.close()
+    return True
+
+
+def serve_scan(paths):
+    srv = Serving(prefetch_bytes=1 << 20)
+    rows = sum(
+        u.batch.num_rows for u in srv.tenant("a").scan(paths)
+    )  # any scan error leaks the context and its owned cache
+    srv.close()
+    return rows
+
+
+def probe(paths, key):
+    ds = Dataset(paths, "k")
+    rows = ds.lookup(key)  # a corrupt file here leaks every open reader
+    ds.close()
+    return rows

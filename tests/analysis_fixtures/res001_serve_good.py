@@ -1,0 +1,34 @@
+"""Clean: serving-layer acquisitions are with-managed, released in a
+finally, or transferred to an owner that manages them."""
+
+from parquet_floor_tpu.serve import Dataset, Serving, SharedBufferCache
+
+
+def build_cache():
+    with SharedBufferCache(data_bytes=1 << 20) as cache:
+        cache.put(("f", 1), 0, b"xyz")
+        return True
+
+
+def serve_scan(paths):
+    with Serving(prefetch_bytes=1 << 20) as srv:
+        with srv.tenant("a").scan(paths) as scan:
+            return sum(u.batch.num_rows for u in scan)
+
+
+def probe(paths, key):
+    ds = Dataset(paths, "k")
+    try:
+        return ds.lookup(key)
+    finally:
+        ds.close()
+
+
+class _Owner:
+    """Ownership transfer: the owner's close() releases the cache."""
+
+    def __init__(self, nbytes):
+        self.cache = SharedBufferCache(data_bytes=nbytes)
+
+    def close(self):
+        self.cache.close()

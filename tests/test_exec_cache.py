@@ -475,3 +475,147 @@ def test_store_failure_degrades_to_uncached(tmp_path):
         _assert_same(ref, out)
     finally:
         os.chmod(d, 0o700)
+
+
+# -- directory GC (PFTPU_EXEC_CACHE_MAX_BYTES) -------------------------------
+
+def test_gc_collects_old_toolchain_entries_at_publish(tmp_path):
+    """A size-capped cache dir evicts LRU-by-mtime at publish time: the
+    stale-toolchain entries (different header, never touched again — a
+    jax upgrade's leftovers) die first; the fresh publish survives."""
+    path = _write(tmp_path)
+    d = tmp_path / "cache"
+    d.mkdir()
+    stale = []
+    for i in range(3):
+        p = d / (f"{i:064x}.pfexec")
+        p.write_bytes(b"PFEXEC0\n" + b"old-toolchain-entry" * 512)
+        os.utime(p, (1_000_000 + i, 1_000_000 + i))  # ancient mtimes
+        stale.append(p)
+    cache = exec_cache.ExecutableCache(
+        str(d), max_bytes=sum(p.stat().st_size for p in stale) // 2
+    )
+    exec_cache.activate(cache)
+    try:
+        out, c = _decode_active(path)
+        assert c.get("engine.exec_cache_misses") == 1
+    finally:
+        exec_cache.activate(None)
+    left = _entries(d)
+    # the fresh entry survives even if it alone exceeds the cap; every
+    # stale entry old enough to make room is gone
+    assert len(left) >= 1
+    for p in stale:
+        assert not p.exists(), f"stale entry {p.name} survived GC"
+    # the survivor is the just-published one (loadable on a fresh cache)
+    out2, c2 = _decode(path, cache_dir=d)
+    assert c2.get("engine.exec_cache_hits") == 1
+    _assert_same(out, out2)
+
+
+def test_gc_env_default_and_validation(tmp_path, monkeypatch):
+    monkeypatch.setenv("PFTPU_EXEC_CACHE_MAX_BYTES", "12345")
+    assert exec_cache.ExecutableCache(str(tmp_path)).max_bytes == 12345
+    monkeypatch.delenv("PFTPU_EXEC_CACHE_MAX_BYTES")
+    assert exec_cache.ExecutableCache(str(tmp_path)).max_bytes is None
+    with pytest.raises(ValueError):
+        exec_cache.ExecutableCache(str(tmp_path), max_bytes=-1)
+
+
+def test_load_touches_mtime_so_hot_entries_survive(tmp_path):
+    """A disk hit refreshes the entry's mtime — the GC's LRU signal."""
+    path = _write(tmp_path)
+    d = tmp_path / "cache"
+    _decode(path, cache_dir=d)  # publish
+    entry = d / _entries(d)[0]
+    os.utime(entry, (1_000_000, 1_000_000))
+    before = entry.stat().st_mtime
+    _decode(path, cache_dir=d)  # fresh cache object: disk hit
+    assert entry.stat().st_mtime > before
+
+
+def _decode_active(path):
+    """Like _decode but uses the ALREADY-activated cache (GC tests
+    install a configured ExecutableCache first)."""
+    with trace.scope() as t:
+        with TpuRowGroupReader(path, float64_policy="bits") as tr:
+            cols = tr.read_row_group(0)
+            jax.block_until_ready([c.values for c in cols.values()])
+            out = {
+                k: (
+                    np.asarray(v.values),
+                    None if v.mask is None else np.asarray(v.mask),
+                    None if v.lengths is None else np.asarray(v.lengths),
+                )
+                for k, v in cols.items()
+            }
+    return out, t.counters()
+
+
+# -- footer bucket pre-seed (PFTPU_STAGE_WORKERS > 1) ------------------------
+
+def test_stage_workers_k2_padded_widths_byte_stable(tmp_path, monkeypatch):
+    """PR 8's caveat, closed: with k=2 stage workers, two runs over the
+    same multi-file dataset must produce IDENTICAL device-column shapes
+    (padded widths included) — the footer pre-seed pins every
+    size-driven bucket to its file-wide max before staging starts."""
+    from parquet_floor_tpu.tpu.engine import iter_dataset_row_groups
+
+    paths = []
+    for i in range(2):
+        # uneven group sizes: the short last group is exactly what made
+        # k>1 bucket growth order-dependent
+        p = tmp_path / f"ps{i}.parquet"
+        schema = types.message(
+            "t",
+            types.required(types.INT64).named("x"),
+            types.optional(types.INT32).named("y"),
+        )
+        r = np.random.default_rng(i)
+        with ParquetFileWriter(
+            p, schema,
+            WriterOptions(row_group_rows=300, data_page_values=100),
+        ) as w:
+            for m in (300, 300, 140):
+                w.write_columns({
+                    "x": r.integers(0, 1 << 40, m).astype(np.int64),
+                    "y": [None if j % 3 == 0 else j for j in range(m)],
+                })
+        paths.append(p)
+
+    def shapes():
+        out = []
+        readers = [TpuRowGroupReader(p, float64_policy="bits")
+                   for p in paths]
+        try:
+            tasks = [
+                (r, gi) for r in readers for gi in range(r.num_row_groups)
+            ]
+            for cols in iter_dataset_row_groups(tasks):
+                out.append({
+                    k: (
+                        tuple(v.values.shape),
+                        None if v.mask is None else tuple(v.mask.shape),
+                    )
+                    for k, v in cols.items()
+                })
+        finally:
+            for r in readers:
+                r.close()
+        return out
+
+    monkeypatch.setenv("PFTPU_STAGE_WORKERS", "2")
+    first = shapes()
+    second = shapes()
+    assert first == second
+    # and the seed actually fired: footer-derivable buckets pre-set
+    with TpuRowGroupReader(paths[0], float64_policy="bits") as tr:
+        seeded = {k[0] for k in tr._hwm_state}
+        assert {"nexp", "arena"} <= seeded
+
+
+def test_no_preseed_at_single_stage_worker(tmp_path, monkeypatch):
+    monkeypatch.delenv("PFTPU_STAGE_WORKERS", raising=False)
+    path = _write_plain_ints(tmp_path, "np.parquet")
+    with TpuRowGroupReader(path, float64_policy="bits") as tr:
+        assert tr._hwm_state == {}

@@ -31,6 +31,7 @@ CASES = [
     ("res001", "FL-RES001"),
     ("res001_tpe", "FL-RES001"),  # executor/scan-handle shapes of the rule
     ("res001_remote", "FL-RES001"),  # remote session/pool + factory shapes
+    ("res001_serve", "FL-RES001"),  # serving cache/context/dataset shapes
     ("alloc001", "FL-ALLOC001"),
     ("obs001", "FL-OBS001"),
 ]

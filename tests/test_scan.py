@@ -689,3 +689,119 @@ def test_iter_dataset_row_groups_crosses_file_boundaries(dataset):
     finally:
         for r in readers:
             r.close()
+
+
+# -- predicate page pruning (ScanOptions.page_prune, docs/scan.md) -----------
+
+def test_page_prune_delivers_covered_pages_bit_identical(dataset):
+    from parquet_floor_tpu.batch.predicate import col
+
+    # one exact key: stats prune 7 of 8 groups, the ColumnIndex narrows
+    # the survivor to one page span per column
+    pred = col("k") == 2_000_700  # file 2 (seed=2), group 0
+    with trace.scope() as t:
+        with DatasetScanner(dataset, predicate=pred,
+                            scan=ScanOptions(page_prune=True)) as s:
+            units = list(s)
+    assert len(units) == 1
+    fi, gi, batch = units[0].file_index, units[0].group_index, units[0].batch
+    with ParquetFileReader(dataset[fi]) as r:
+        n_group = int(r.row_groups[gi].num_rows)
+        want, covered = r.read_row_group_ranges(gi, pred.row_ranges(r, gi))
+    assert 0 < batch.num_rows < n_group
+    assert batch.num_rows == want.num_rows == sum(b - a for a, b in covered)
+    for a, b in zip(batch.columns, want.columns):
+        va, vb = a.values, b.values
+        if hasattr(va, "offsets"):
+            np.testing.assert_array_equal(np.asarray(va.offsets),
+                                          np.asarray(vb.offsets))
+            np.testing.assert_array_equal(np.asarray(va.data),
+                                          np.asarray(vb.data))
+        else:
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+        if b.def_levels is not None:
+            np.testing.assert_array_equal(a.def_levels, b.def_levels)
+    assert t.counters().get("scan.pages_pruned", 0) >= 1
+    # the covered rows contain every actually-matching row
+    ks = np.asarray(batch.columns[0].values)
+    assert 2_000_700 in ks
+
+
+def test_page_prune_off_by_default_and_ignored_without_predicate(dataset):
+    from parquet_floor_tpu.batch.predicate import col
+
+    pred = col("k") == 2_000_700
+    with trace.scope() as t:
+        with DatasetScanner(dataset, predicate=pred) as s:
+            full = [u.batch.num_rows for u in s]
+    assert t.counters().get("scan.pages_pruned") is None
+    with ParquetFileReader(dataset[2]) as r:
+        assert full == [int(r.row_groups[0].num_rows)]
+    # page_prune without a predicate: a plain full scan
+    with trace.scope() as t:
+        with DatasetScanner(dataset, scan=ScanOptions(page_prune=True)) as s:
+            rows = sum(u.batch.num_rows for u in s)
+    assert rows == 4 * 3000
+    assert t.counters().get("scan.pages_pruned") is None
+
+
+def test_page_prune_projection_composes(dataset):
+    from parquet_floor_tpu.batch.predicate import col
+
+    # predicate column NOT in the projection: covered pages are computed
+    # over the SELECTED chunks, so only d's page spans are read
+    pred = col("k") == 1_000_700
+    with DatasetScanner(dataset, columns=["d"], predicate=pred,
+                        scan=ScanOptions(page_prune=True)) as s:
+        units = list(s)
+    assert len(units) == 1
+    batch = units[0].batch
+    assert [b.descriptor.path[0] for b in batch.columns] == ["d"]
+    with ParquetFileReader(dataset[1]) as r:
+        want, _cov = r.read_row_group_ranges(
+            units[0].group_index, pred.row_ranges(r, units[0].group_index),
+            {"d"},
+        )
+    assert batch.num_rows == want.num_rows
+    np.testing.assert_array_equal(
+        np.asarray(batch.columns[0].values), np.asarray(want.columns[0].values)
+    )
+
+
+def test_page_prune_column_index_can_drop_whole_group(dataset):
+    from parquet_floor_tpu.batch.predicate import col
+
+    # an absent key INSIDE a group's min/max range: footer stats keep
+    # the group, the per-page ColumnIndex kills every page — the group
+    # must drop without reading a data byte
+    with ParquetFileReader(dataset[0]) as r:
+        ks = np.asarray(r.read_row_group(0, {"k"}).columns[0].values)
+    absent = int(ks[0]) + 1
+    while absent in ks:
+        absent += 1
+    pred = col("k") == absent
+    with trace.scope() as t:
+        with DatasetScanner(dataset[:1], predicate=pred,
+                            scan=ScanOptions(page_prune=True)) as s:
+            units = list(s)
+    if units:  # a page whose [min,max] brackets the hole still covers it
+        assert all(u.batch.num_rows < 1500 for u in units)
+    else:
+        assert t.counters().get("scan.pages_pruned", 0) >= 1
+
+
+def test_page_prune_salvage_keeps_whole_groups(dataset):
+    from parquet_floor_tpu.batch.predicate import col
+
+    pred = col("k") == 2_000_700
+    with DatasetScanner(
+        dataset, predicate=pred, options=ReaderOptions(salvage=True),
+        scan=ScanOptions(page_prune=True),
+    ) as s:
+        units = list(s)
+    # salvage voids page pruning (quarantine decisions are group-wide):
+    # the surviving group arrives WHOLE
+    with ParquetFileReader(dataset[2]) as r:
+        assert [u.batch.num_rows for u in units] == [
+            int(r.row_groups[0].num_rows)
+        ]

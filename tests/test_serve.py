@@ -1,0 +1,529 @@
+"""Multi-tenant serving layer (``parquet_floor_tpu.serve``): shared
+buffer cache tiers + single-flight + eviction safety, fair-share
+tenancy and per-tenant report attribution, and the point/range lookup
+face's pruning ladder and byte-cost contract (docs/serving.md)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    ParquetFileReader,
+    ParquetFileWriter,
+    ReaderOptions,
+    UnsupportedFeatureError,
+    WriterOptions,
+    trace,
+    types,
+)
+from parquet_floor_tpu.scan import DatasetScanner, ScanOptions
+from parquet_floor_tpu.serve import (
+    CachedSource,
+    Dataset,
+    Serving,
+    SharedBufferCache,
+    source_key,
+)
+from parquet_floor_tpu.serve.tenancy import _FairGate, _TenantShare
+
+GROUP = 200
+PAGE = 50
+GROUPS = 3
+
+
+def _write_keyed(path, file_index=0, groups=GROUPS, bloom=True):
+    """Ascending EVEN int64 keys (odd keys absent but inside range —
+    the bloom rung's food), several pages per group."""
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.DOUBLE).named("d"),
+    )
+    per = GROUP * groups
+    rng = np.random.default_rng(file_index)
+    with ParquetFileWriter(path, schema, WriterOptions(
+        row_group_rows=GROUP, data_page_values=PAGE,
+        bloom_filter_columns={"k": True} if bloom else None,
+    )) as w:
+        for lo in range(0, per, GROUP):
+            base = 2 * (file_index * per + lo)
+            w.write_columns({
+                "k": base + 2 * np.arange(GROUP, dtype=np.int64),
+                "s": [None if j % 9 == 0 else f"s{j % 23}"
+                      for j in range(GROUP)],
+                "d": rng.standard_normal(GROUP),
+            })
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def keyed(tmp_path_factory):
+    d = tmp_path_factory.mktemp("serve_ds")
+    return [
+        _write_keyed(str(d / f"f{i}.parquet"), file_index=i)
+        for i in range(2)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# SharedBufferCache
+# ---------------------------------------------------------------------------
+
+
+def test_cache_get_put_containment_and_lru_eviction():
+    with SharedBufferCache(data_bytes=100, meta_bytes=100) as c:
+        key = ("f", 1)
+        c.put(key, 0, b"a" * 40)
+        c.put(key, 100, b"b" * 40)
+        assert bytes(c.get(key, 5, 10)) == b"a" * 10   # sub-range containment
+        assert c.get(key, 40, 10) is None               # gap between entries
+        # the get() above touched [0,40): inserting 40 more evicts the
+        # LRU entry [100,140), not the freshly-touched one
+        c.put(key, 200, b"c" * 40)
+        assert c.get(key, 100, 40) is None
+        assert bytes(c.get(key, 0, 40)) == b"a" * 40
+        assert c.stats()["evictions"] == 1
+
+
+def test_eviction_never_corrupts_inflight_borrow():
+    with SharedBufferCache(data_bytes=64, meta_bytes=64) as c:
+        key = ("f", 1)
+        c.put(key, 0, b"x" * 60)
+        view = c.get(key, 0, 60)
+        c.put(key, 1000, b"y" * 60)  # evicts [0, 60)
+        assert c.get(key, 0, 60) is None
+        assert bytes(view) == b"x" * 60  # the borrow is immune to eviction
+
+
+def test_pinned_tier_survives_data_churn_and_has_its_own_lru():
+    with trace.scope() as t:
+        with SharedBufferCache(data_bytes=64, meta_bytes=64) as c:
+            key = ("f", 1)
+            c.put(key, 0, b"m" * 40, pinned=True)
+            for i in range(8):  # data churn far past the data budget
+                c.put(key, 1000 + 100 * i, b"d" * 60)
+            assert bytes(c.get(key, 0, 40)) == b"m" * 40  # still pinned
+            c.put(key, 500, b"n" * 40, pinned=True)  # meta over budget
+            assert c.get(key, 0, 40) is None  # meta LRU evicted, counted
+            assert c.stats()["meta_evictions"] == 1
+    assert t.counters()["serve.meta_evictions"] == 1
+
+
+def test_pinned_put_promotes_existing_entry():
+    with SharedBufferCache(data_bytes=64, meta_bytes=1 << 20) as c:
+        key = ("f", 1)
+        c.put(key, 0, b"m" * 40)            # data tier
+        c.put(key, 0, b"m" * 40, pinned=True)  # promote, don't duplicate
+        c.put(key, 1000, b"d" * 60)         # would evict a data entry
+        assert bytes(c.get(key, 0, 40)) == b"m" * 40
+        st = c.stats()
+        assert st["meta_bytes_used"] == 40 and st["data_bytes_used"] == 60
+
+
+def test_single_flight_dedup_one_storage_read():
+    with SharedBufferCache() as c:
+        key = ("f", 1)
+        reads = []
+        inflight = threading.Event()
+        results = {}
+
+        def leader_read(ranges):
+            reads.append(ranges)
+            inflight.set()
+            # hold the flight open until the waiter is registered
+            deadline = time.monotonic() + 5
+            while c.stats()["singleflight_waits"] < 1:
+                if time.monotonic() > deadline:
+                    raise AssertionError("waiter never arrived")
+                time.sleep(0.001)
+            return [b"z" * n for _, n in ranges]
+
+        def lead():
+            results["lead"] = bytes(
+                c.fetch(key, 0, 8, lambda: leader_read([(0, 8)])[0])
+            )
+
+        def wait():
+            inflight.wait(5)
+            results["wait"] = bytes(c.fetch(
+                key, 0, 8,
+                lambda: (_ for _ in ()).throw(AssertionError("dup read")),
+            ))
+
+        t1 = threading.Thread(target=lead)
+        t2 = threading.Thread(target=wait)
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        assert results["lead"] == results["wait"] == b"z" * 8
+        st = c.stats()
+        assert st["misses"] == 1 and st["singleflight_waits"] == 1
+
+
+def test_single_flight_error_propagates_and_clears():
+    with SharedBufferCache() as c:
+        key = ("f", 1)
+        inflight = threading.Event()
+        errs = []
+
+        def failing_read():
+            inflight.set()
+            deadline = time.monotonic() + 5
+            while c.stats()["singleflight_waits"] < 1:
+                if time.monotonic() > deadline:
+                    raise AssertionError("waiter never arrived")
+                time.sleep(0.001)
+            raise OSError("flaky")
+
+        def lead():
+            try:
+                c.fetch(key, 0, 8, failing_read)
+            except OSError as e:
+                errs.append(("lead", str(e)))
+
+        def wait():
+            inflight.wait(5)
+            try:
+                c.fetch(key, 0, 8, failing_read)
+            except OSError as e:
+                errs.append(("wait", str(e)))
+
+        t1 = threading.Thread(target=lead)
+        t2 = threading.Thread(target=wait)
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        assert sorted(w for w, _ in errs) == ["lead", "wait"]
+        # the flight is cleared: a later fetch re-issues and succeeds
+        assert bytes(c.fetch(key, 0, 8, lambda: b"ok" * 4)) == b"ok" * 4
+
+
+def test_concurrent_mutation_under_load_serves_true_bytes():
+    """Two threads fetching/evicting under a tiny budget: every byte
+    served must match ground truth — eviction churn may forget, never
+    corrupt."""
+    truth = bytes(np.random.default_rng(0).integers(0, 256, 4096,
+                                                    dtype=np.uint8))
+    with SharedBufferCache(data_bytes=512, meta_bytes=512) as c:
+        key = ("f", len(truth))
+        stop = time.monotonic() + 1.0
+        failures = []
+
+        def worker(seed):
+            rng = np.random.default_rng(seed)
+            while time.monotonic() < stop:
+                off = int(rng.integers(0, len(truth) - 64))
+                n = int(rng.integers(1, 64))
+                got = c.fetch(
+                    key, off, n, lambda o=off, m=n: truth[o : o + m]
+                )
+                if bytes(got) != truth[off : off + n]:
+                    failures.append((off, n))
+                    return
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in (1, 2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not failures
+        assert c.stats()["evictions"] > 0  # the churn actually churned
+
+
+def test_cache_close_refuses_and_invalidate_forgets():
+    c = SharedBufferCache()
+    key = ("f", 1)
+    try:
+        c.put(key, 0, b"abc")
+        c.invalidate(key)
+        assert c.get(key, 0, 3) is None
+    finally:
+        c.close()
+    with pytest.raises(ValueError):
+        c.fetch(key, 0, 3, lambda: b"abc")
+    c.close()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# CachedSource in the scan chain
+# ---------------------------------------------------------------------------
+
+
+def test_cached_scan_bit_identical_and_second_scan_hits(keyed):
+    def digest(units):
+        out = []
+        for u in units:
+            for b in u.batch.columns:
+                v = b.values
+                if hasattr(v, "offsets"):
+                    out.append((bytes(np.asarray(v.offsets).data),
+                                bytes(np.asarray(v.data).data)))
+                else:
+                    out.append(bytes(np.ascontiguousarray(v).data))
+        return out
+
+    with DatasetScanner(keyed) as s:
+        want = digest(s)
+    with Serving(prefetch_bytes=8 << 20) as srv:
+        ta = srv.tenant("a")
+        tb = srv.tenant("b")
+        with ta.scan(keyed) as s:
+            got_a = digest(s)
+        with tb.scan(keyed) as s:
+            got_b = digest(s)
+        assert got_a == want and got_b == want
+        rb = tb.report()
+        hit = rb.counters.get("serve.cache_hit_bytes", 0)
+        miss = rb.counters.get("serve.cache_miss_bytes", 0)
+        assert hit / (hit + miss) >= 0.5  # the acceptance floor
+        ra = ta.report()
+        assert ra.counters.get("serve.cache_misses", 0) > 0
+        # attribution is disjoint: A's tracer never saw B's hits
+        assert ra.counters.get("serve.cache_hit_bytes", 0) < hit
+
+
+def test_concurrent_tenant_reports_disjoint(keyed):
+    with Serving(prefetch_bytes=8 << 20) as srv:
+        warm = srv.tenant("warm")
+        with warm.scan(keyed) as s:
+            rows = sum(u.batch.num_rows for u in s)
+        t1 = srv.tenant("one", weight=2)
+        t2 = srv.tenant("two")
+        results = {}
+
+        def run(name, tenant):
+            with tenant.scan(keyed) as s:
+                results[name] = sum(u.batch.num_rows for u in s)
+
+        threads = [threading.Thread(target=run, args=(n, t))
+                   for n, t in (("one", t1), ("two", t2))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == {"one": rows, "two": rows}
+        used = warm.report().counters.get("scan.bytes_used")
+        for t in (t1, t2):
+            rep = t.report()
+            assert rep.counters.get("scan.bytes_used") == used
+            assert rep.counters.get("data.rows_emitted") is None
+
+
+def test_source_key_shared_across_opens(keyed):
+    with SharedBufferCache() as c:
+        with ParquetFileReader(keyed[0]) as r:
+            pass
+        from parquet_floor_tpu.io.source import FileSource
+
+        s1 = FileSource(keyed[0])
+        s2 = FileSource(keyed[0])
+        try:
+            assert source_key(s1) == source_key(s2)
+            cs1 = CachedSource(s1, c)
+            cs2 = CachedSource(s2, c)
+            assert bytes(cs1.read_at(0, 4)) == b"PAR1"
+            assert bytes(cs2.read_at(0, 4)) == b"PAR1"
+            st = c.stats()
+            assert st["misses"] == 1 and st["hits"] == 1
+        finally:
+            s1.close()
+            s2.close()
+
+
+# ---------------------------------------------------------------------------
+# Fair-share gate + budget admission
+# ---------------------------------------------------------------------------
+
+
+def test_fair_gate_grants_in_weighted_virtual_time_order():
+    """Backlogged 1-slot gate, weight-2 vs weight-1 tenants enqueueing
+    alternately: grants must follow WFQ virtual finish tags (heavy tags
+    advance by cost/2, light by cost), not arrival order."""
+    gate = _FairGate(capacity_bytes=100)
+    heavy = _TenantShare(2.0, gate)
+    light = _TenantShare(1.0, gate)
+    gate.acquire(heavy, 100)  # saturate: everything below queues
+    order = []
+    lock = threading.Lock()
+
+    def worker(share, name):
+        gate.acquire(share, 100)
+        with lock:
+            order.append(name)
+        gate.release(100)
+
+    # arrival h1,l1,h2,l2,h3,l3,h4,l4 — tags: h 50,100,150,200;
+    # l 0,100,200,300 (light starts at the current virtual clock, so
+    # its FIRST request rightly jumps the heavy backlog; from then on
+    # heavy interleaves 2:1 by tag, ties broken by arrival)
+    threads = []
+    for name, share in (("h1", heavy), ("l1", light), ("h2", heavy),
+                        ("l2", light), ("h3", heavy), ("l3", light),
+                        ("h4", heavy), ("l4", light)):
+        t = threading.Thread(target=worker, args=(share, name))
+        threads.append(t)
+        t.start()
+        time.sleep(0.05)  # deterministic arrival (and seq) order
+    gate.release(100)  # open: each grant's release cascades the next
+    for t in threads:
+        t.join()
+    assert order == ["l1", "h1", "h2", "l2", "h3", "l3", "h4", "l4"]
+
+
+def test_fair_gate_counts_waits_and_gauges():
+    gate = _FairGate(capacity_bytes=10)
+    share = _TenantShare(1.0, gate)
+    with trace.scope() as t:
+        gate.acquire(share, 10)
+        done = threading.Event()
+
+        def blocked():
+            gate.acquire(share, 10)
+            gate.release(10)
+            done.set()
+
+        # carry the scope onto the worker (contextvars do not cross
+        # thread spawns — the CachedSource gate path rides Tracer.run
+        # the same way via the scan pools)
+        th = threading.Thread(target=t.run, args=(blocked,))
+        th.start()
+        time.sleep(0.05)
+        gate.release(10)
+        th.join()
+        assert done.is_set()
+    assert t.counters()["serve.fair_share_waits"] == 1
+    assert t.gauges()["serve.inflight_storage_bytes_max"] == 10
+
+
+def test_budget_shares_follow_weights():
+    with Serving(prefetch_bytes=30 << 20) as srv:
+        heavy = srv.tenant("heavy", weight=2)
+        light = srv.tenant("light", weight=1)
+        assert heavy.prefetch_share() == 20 << 20
+        assert light.prefetch_share() == 10 << 20
+        sc = light.scan_options(ScanOptions(threads=2))
+        assert sc.prefetch_bytes == 10 << 20 and sc.threads == 2
+        light.close()  # weights rebalance
+        assert heavy.prefetch_share() == 30 << 20
+        with pytest.raises(ValueError):
+            light.scan([])
+        with pytest.raises(ValueError):
+            srv.tenant("heavy", weight=5)  # conflicting re-registration
+        assert srv.tenant("heavy", weight=2) is heavy
+
+
+# ---------------------------------------------------------------------------
+# The lookup face
+# ---------------------------------------------------------------------------
+
+
+def test_lookup_point_and_range_match_brute_force(keyed):
+    with Dataset(keyed, "k") as ds:
+        per = GROUP * GROUPS
+        key = 2 * (per + 123)  # file 1
+        rows = ds.lookup(key)
+        assert [r["k"] for r in rows] == [key]
+        assert set(rows[0]) == {"k", "s", "d"}
+        lo, hi = 2 * (per - 5), 2 * (per + 5)  # spans the file boundary
+        got = sorted(r["k"] for r in ds.range(lo, hi))
+        assert got == list(range(lo, hi + 1, 2))
+        assert ds.lookup(2 * per + 1) == []         # absent odd key
+        assert ds.lookup(10 ** 12) == []            # outside every range
+        one = ds.lookup(key, columns=["k"], limit=1)
+        assert one == [{"k": key}]
+
+
+def test_lookup_prunes_counts_and_bloom_skips(keyed):
+    with trace.scope() as t:
+        with Dataset(keyed, "k") as ds:
+            ds.lookup(0)          # warm: pins metadata everywhere
+            c0 = t.counters()
+            assert c0.get("serve.lookup_groups_pruned", 0) >= 1
+            # absent odd key inside group 0's [min, max]: stats keep the
+            # group, the bloom filter must kill it (no page decoded)
+            for off in range(1, 99, 2):
+                ds.lookup(off, limit=1)
+                if t.counters().get("serve.lookup_bloom_skips", 0):
+                    break
+            c1 = t.counters()
+            assert c1.get("serve.lookup_bloom_skips", 0) >= 1
+            assert c1.get("serve.lookup_probes", 0) >= 2
+            assert c1.get("serve.lookup_rows", 0) >= 1
+
+
+def test_hot_lookup_costs_at_most_one_page(keyed):
+    with SharedBufferCache() as cache:
+        with Dataset(keyed, "k", cache=cache) as ds:
+            ds.lookup(0)  # warm every file's metadata pins
+            bound = ds.page_size_bound()
+            s0 = cache.stats()
+            per = GROUP * GROUPS
+            rows = ds.lookup(2 * (2 * per - 1), columns=["k"])
+            cost = cache.stats()["miss_bytes"] - s0["miss_bytes"]
+            assert len(rows) == 1
+            assert 0 < cost <= bound
+
+
+def test_lookup_reuses_cached_footer_across_datasets(keyed):
+    with SharedBufferCache() as cache:
+        with Dataset(keyed, "k", cache=cache) as ds:
+            ds.lookup(0)
+            assert cache.stats()["footers"] == len(keyed)
+        with Dataset(keyed, "k", cache=cache) as ds2:
+            # parsed footers come back from the object tier; the raw
+            # footer/index/bloom bytes are already pinned, so the only
+            # storage traffic is the probe's data page(s)
+            s0 = cache.stats()
+            ds2.lookup(0)
+            assert cache.stats()["misses"] == s0["misses"]
+
+
+def test_lookup_rejects_salvage_and_closed_use(keyed):
+    with pytest.raises(UnsupportedFeatureError):
+        # the constructor itself rejects salvage — nothing is acquired
+        Dataset(keyed, "k",  # floorlint: disable=FL-RES001
+                options=ReaderOptions(salvage=True))
+    ds = Dataset(keyed, "k")
+    try:
+        assert ds.lookup(0)
+    finally:
+        ds.close()
+    with pytest.raises(ValueError):
+        ds.lookup(0)
+    ds.close()  # idempotent
+
+
+def test_lookup_concurrent_probes_with_tenant_attribution(keyed):
+    with Serving(prefetch_bytes=8 << 20) as srv:
+        with Dataset(keyed, "k", cache=srv.cache) as ds:
+            ds.lookup(0)  # open + pin
+            ta = srv.tenant("ap")
+            tb = srv.tenant("bp")
+            per = GROUP * GROUPS
+            out = {}
+
+            def probe(name, tenant, base):
+                got = []
+                for j in range(20):
+                    got.extend(
+                        r["k"] for r in
+                        ds.lookup(2 * (base + j), tenant=tenant)
+                    )
+                out[name] = got
+
+            t1 = threading.Thread(target=probe, args=("a", ta, 10))
+            t2 = threading.Thread(target=probe, args=("b", tb, per + 10))
+            t1.start()
+            t2.start()
+            t1.join()
+            t2.join()
+            assert out["a"] == [2 * (10 + j) for j in range(20)]
+            assert out["b"] == [2 * (per + 10 + j) for j in range(20)]
+            assert ta.tracer.counters()["serve.lookup_probes"] == 20
+            assert tb.tracer.counters()["serve.lookup_probes"] == 20

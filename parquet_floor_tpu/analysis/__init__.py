@@ -9,16 +9,27 @@ FL-EXC     error-taxonomy guards: no broad except that misclassifies
            OSError/MemoryError as corruption, ``raise ... from`` discipline,
            location context on boundary taxonomy raises
 FL-TPU     tracer/host-purity guards: no host I/O or host materialization
-           inside ``jax.jit``/Pallas-traced functions in ``tpu/``
+           inside ``jax.jit``/Pallas-traced functions in ``tpu/`` —
+           followed through the project call graph (helpers called from
+           jitted functions, ``functools.partial`` hops, cross-module)
 FL-RES     resource guards: every ``open()``/Source acquisition is
            context-managed or closed on all exception paths
 FL-ALLOC   allocation guards: sizes parsed off the wire flow through
            ``errors.checked_alloc_size``
 FL-OBS     observability guards: trace metric/decision/span name literals
            in package code come from the ``trace.names`` registry
+FL-LOCK    concurrency-discipline guards: with-managed acquires, no
+           blocking under a lock (call-graph-computed), while-predicate
+           Condition waits, consistent project-wide lock ordering
 ========== ==================================================================
 
-CLI: ``python -m parquet_floor_tpu.analysis [paths ...]``.
+The engine runs ONE project-wide pass (``analysis.project``): every file
+parses once, a symbol table + call graph + lock registry is built over
+the whole package, and each rule checks its files against the shared
+indexes.
+
+CLI: ``python -m parquet_floor_tpu.analysis [paths ...]``
+(``--format=json`` for machine consumers).
 Docs: ``docs/static_analysis.md``.
 """
 
@@ -26,19 +37,23 @@ from .core import (  # noqa: F401  (public surface)
     RunResult,
     Violation,
     analyze_file,
+    build_project,
     iter_python_files,
     load_baseline,
     run,
     write_baseline,
 )
-from . import rules_alloc, rules_exc, rules_obs, rules_res, rules_tpu
+from .project import CALL_DEPTH, Project  # noqa: F401
+from . import (rules_alloc, rules_exc, rules_lock, rules_obs, rules_res,
+               rules_tpu)
 
 ALL_RULES = (
     rules_exc.RULES + rules_tpu.RULES + rules_res.RULES + rules_alloc.RULES
-    + rules_obs.RULES
+    + rules_obs.RULES + rules_lock.RULES
 )
 
 __all__ = [
-    "ALL_RULES", "RunResult", "Violation", "analyze_file",
-    "iter_python_files", "load_baseline", "run", "write_baseline",
+    "ALL_RULES", "CALL_DEPTH", "Project", "RunResult", "Violation",
+    "analyze_file", "build_project", "iter_python_files", "load_baseline",
+    "run", "write_baseline",
 ]

@@ -1,13 +1,18 @@
 """CLI: ``python -m parquet_floor_tpu.analysis [paths ...]``.
 
-Exit status: 0 clean, 1 violations, 2 usage error.  Violations print as
-``file:line: RULE-ID message`` — the same shape scripts/lint.py emits, so
-editors and CI parse both identically.
+Exit status: 0 clean, 1 violations, 2 usage error.  ``--format=text``
+(default) prints ``file:line: RULE-ID message`` — the same shape
+scripts/lint.py emits, so editors and CI parse both identically.
+``--format=json`` emits one JSON document (rule id, path, line,
+message, call chain per violation, plus run totals) for CI dashboards
+and editor integrations; ``scripts/check.sh`` keeps gating on the text
+form.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import pathlib
 import sys
 
@@ -33,6 +38,15 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="accept every current violation into --baseline "
                          "and exit 0")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="regenerate --baseline in the current "
+                         "(path:RULE:span) fingerprint format: violations "
+                         "the OLD baseline accepted — legacy message-keyed "
+                         "entries included — are rewritten as span "
+                         "fingerprints; everything else still reports")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="output format (json: machine-readable findings "
+                         "with call chains)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -54,6 +68,25 @@ def main(argv=None) -> int:
         print(f"floorlint: wrote {len(result.violations)} fingerprint(s) "
               f"to {args.baseline}")
         return 0
+    if args.update_baseline:
+        # keep exactly what the old baseline accepted (now re-keyed to
+        # span fingerprints), drop stale entries, leave new violations
+        # reporting — regeneration must not silently bless them
+        accepted = [v for v in result.all_kept if v not in result.violations]
+        write_baseline(args.baseline, accepted)
+        print(f"floorlint: rewrote {len(accepted)} fingerprint(s) to "
+              f"{args.baseline} (span format)")
+
+    if args.format == "json":
+        print(json.dumps({
+            "violations": [v.to_dict() for v in result.violations],
+            "files": result.files,
+            "suppressed": result.suppressed,
+            "baselined": result.baselined,
+            "stale_baseline": result.stale_baseline,
+            "ok": result.ok,
+        }, indent=1))
+        return 1 if result.violations else 0
 
     for v in result.violations:
         print(v.render())

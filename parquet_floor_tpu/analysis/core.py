@@ -1,7 +1,18 @@
-"""floorlint core — file walking, suppression directives, scoping, baseline.
+"""floorlint core — project pass, suppression directives, baseline.
 
-The analyzer is stdlib-only (``ast`` + ``pathlib``): the lint gate must run
-in hermetic images with no ruff installed, exactly like ``scripts/lint.py``.
+The analyzer is stdlib-only (``ast`` + ``pathlib``): the lint gate must
+run in hermetic images with no ruff installed, exactly like
+``scripts/lint.py``.
+
+Since the FL-LOCK/call-graph rework the engine runs ONE project-wide
+pass: every requested file is parsed once into a :class:`FileContext`,
+a :class:`~parquet_floor_tpu.analysis.project.Project` (symbol table +
+call graph + lock registry) is built over all of them together, and
+each rule module's ``check(ctx, project)`` runs per file against the
+shared indexes.  Per-file verdicts — including every suppression
+directive — are identical to the old per-file pass for rules that never
+consult the graph; graph-aware rules (FL-TPU chain mode, FL-LOCK002/004)
+additionally see across file boundaries.
 
 Directives (comments, parsed without executing the file)::
 
@@ -16,10 +27,14 @@ Directives (comments, parsed without executing the file)::
 A token names either a full rule id (``FL-EXC001``) or a family prefix
 (``FL-EXC``); ``all`` matches everything.
 
-Baseline: a text file of ``path:RULE:message`` fingerprints (no line
-numbers, so unrelated edits do not churn it).  Each entry cancels one
-matching violation; the checked-in ``floorlint.baseline`` is empty and
-must stay empty — it exists so a future emergency has a paved road.
+Baseline: a text file of fingerprints, one per accepted violation.  The
+CURRENT format is ``path:RULE:span`` where ``span`` is the violation's
+source line with whitespace collapsed — stable under message rewording
+AND under line-number drift from unrelated edits.  Legacy
+``path:RULE:message`` entries (the PR 2 format) still match during the
+transition; ``--update-baseline`` rewrites everything to the new
+format.  The checked-in ``floorlint.baseline`` is empty and must stay
+empty — it exists so a future emergency has a paved road.
 """
 
 from __future__ import annotations
@@ -28,7 +43,7 @@ import ast
 import pathlib
 import re
 from collections import Counter
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 _EXCLUDED_DIRS = {"__pycache__", ".git", "data", "analysis_fixtures"}
@@ -37,6 +52,8 @@ _DIRECTIVE = re.compile(
     r"#\s*floorlint:\s*(disable-file|disable|scope)\s*=\s*([A-Za-z0-9_,\-]+)"
 )
 
+_WS = re.compile(r"\s+")
+
 
 @dataclass(frozen=True)
 class Violation:
@@ -44,12 +61,37 @@ class Violation:
     line: int
     rule: str
     message: str
+    #: resolved call chain for graph-aware findings (root → sink), empty
+    #: for lexical ones
+    chain: Tuple[str, ...] = ()
+    #: the violation's source line, whitespace-collapsed — the stable
+    #: half of the fingerprint
+    span: str = ""
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: {self.rule} {self.message}"
 
     def fingerprint(self) -> str:
+        """Stable fingerprint: ``path:rule:normalized-span``.  No line
+        number (unrelated edits must not churn the baseline) and no
+        message text (rewording a message must not orphan entries —
+        the PR 2 scheme's bug)."""
+        return f"{self.path}:{self.rule}:{self.span}"
+
+    def legacy_fingerprint(self) -> str:
+        """The PR 2 ``path:RULE:message`` shape — still honored when
+        reading a baseline, never written anymore."""
         return f"{self.path}:{self.rule}:{self.message}"
+
+    def to_dict(self) -> dict:
+        """The ``--format=json`` shape (CI / editor consumers)."""
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "call_chain": list(self.chain),
+        }
 
 
 class FileContext:
@@ -62,9 +104,15 @@ class FileContext:
         self.tree = tree
         self.lines = src.splitlines()
         self.parents: Dict[ast.AST, ast.AST] = {}
-        for node in ast.walk(tree):
+        #: every node in walk order — the one tree traversal; rules
+        #: iterate this instead of re-running ``ast.walk`` per rule
+        self.nodes: List[ast.AST] = list(ast.walk(tree))
+        for node in self.nodes:
             for child in ast.iter_child_nodes(node):
                 self.parents[child] = node
+        self.calls: List[ast.Call] = [
+            n for n in self.nodes if isinstance(n, ast.Call)
+        ]
         self.scoped: Set[str] = set()       # families opted in via scope=
         self.file_disables: Set[str] = set()
         self.line_disables: Dict[int, Set[str]] = {}
@@ -91,6 +139,13 @@ class FileContext:
     def suppressed(self, rule: str, line: int) -> bool:
         tokens = self.file_disables | self.line_disables.get(line, set())
         return any(_matches(rule, t) for t in tokens)
+
+    def span_at(self, line: int) -> str:
+        """The whitespace-collapsed source line — the violation's
+        stable fingerprint span."""
+        if 1 <= line <= len(self.lines):
+            return _WS.sub(" ", self.lines[line - 1].strip())
+        return ""
 
     # -- path scoping ------------------------------------------------------
 
@@ -183,36 +238,73 @@ def _display_path(path: pathlib.Path) -> str:
         return path.as_posix()
 
 
-def _analyze_one(path: pathlib.Path):
-    """Shared per-file pass: returns ``(kept, suppressed_count)`` with
-    ``# floorlint: disable`` directives already applied (baseline handling
-    stays in :func:`run` — it is a cross-file budget)."""
-    from . import rules_alloc, rules_exc, rules_obs, rules_res, rules_tpu
+def _rule_modules():
+    from . import (rules_alloc, rules_exc, rules_lock, rules_obs,
+                   rules_res, rules_tpu)
 
-    rel = _display_path(path)
-    src = path.read_text()
-    try:
-        tree = ast.parse(src, filename=rel)
-    except SyntaxError as e:
-        return [Violation(rel, e.lineno or 1, "FL-SYNTAX",
-                          f"file does not parse: {e.msg}")], 0
-    ctx = FileContext(path, rel, src, tree)
+    return (rules_exc, rules_tpu, rules_res, rules_alloc, rules_obs,
+            rules_lock)
+
+
+def _parse_contexts(paths: Sequence[str]):
+    """Parse every requested file ONCE (the project AST cache).  Returns
+    ``(contexts, syntax_violations)`` — unparsable files are reported as
+    FL-SYNTAX and excluded from the project pass."""
+    contexts: List[FileContext] = []
+    broken: List[Violation] = []
+    for path in iter_python_files(paths):
+        rel = _display_path(path)
+        src = path.read_text()
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:
+            broken.append(Violation(rel, e.lineno or 1, "FL-SYNTAX",
+                                    f"file does not parse: {e.msg}"))
+            continue
+        contexts.append(FileContext(path, rel, src, tree))
+    return contexts, broken
+
+
+def _check_context(ctx: FileContext, project):
+    """All rules over one file against the shared project; returns
+    ``(kept, suppressed_count)`` with directives applied."""
     kept: List[Violation] = []
     suppressed = 0
-    for mod in (rules_exc, rules_tpu, rules_res, rules_alloc, rules_obs):
-        for line, rule, message in mod.check(ctx):
+    seen = set()
+    for mod in _rule_modules():
+        for found in mod.check(ctx, project):
+            line, rule, message = found[0], found[1], found[2]
+            chain = tuple(found[3]) if len(found) > 3 and found[3] else ()
+            key = (line, rule, message)
+            if key in seen:
+                continue
+            seen.add(key)
             if ctx.suppressed(rule, line):
                 suppressed += 1
             else:
-                kept.append(Violation(rel, line, rule, message))
+                kept.append(Violation(ctx.rel, line, rule, message,
+                                      chain=chain,
+                                      span=ctx.span_at(line)))
     return kept, suppressed
+
+
+def build_project(contexts):
+    from .project import Project
+
+    return Project(contexts)
 
 
 def analyze_file(path: pathlib.Path) -> List[Violation]:
     """Analyze one file, honoring its suppression directives (the same
     verdicts the CLI reports — editor/tooling consumers see no
-    deliberately-suppressed lines)."""
-    return _analyze_one(path)[0]
+    deliberately-suppressed lines).  Cross-file edges obviously cannot
+    resolve from a single file; use :func:`run` over several paths for
+    project-wide verdicts."""
+    contexts, broken = _parse_contexts([str(path)])
+    if broken:
+        return broken
+    project = build_project(contexts)
+    return _check_context(contexts[0], project)[0]
 
 
 @dataclass
@@ -222,6 +314,10 @@ class RunResult:
     baselined: int
     files: int
     stale_baseline: int
+    #: every pre-suppression/pre-baseline violation — what
+    #: ``--update-baseline`` snapshots (suppressed lines excluded: they
+    #: are already accepted in-code)
+    all_kept: List[Violation] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -230,24 +326,34 @@ class RunResult:
 
 def run(paths: Sequence[str],
         baseline: Optional[Counter] = None) -> RunResult:
+    contexts, broken = _parse_contexts(paths)
+    project = build_project(contexts)
     reported: List[Violation] = []
+    all_kept: List[Violation] = list(broken)
     suppressed = 0
     baselined = 0
-    files = 0
     remaining = Counter(baseline or ())
-    for path in iter_python_files(paths):
-        files += 1
-        kept, n_suppressed = _analyze_one(path)
+    for ctx in contexts:
+        kept, n_suppressed = _check_context(ctx, project)
         suppressed += n_suppressed
-        for v in kept:
-            if remaining[v.fingerprint()] > 0:
-                remaining[v.fingerprint()] -= 1
-                baselined += 1
-                continue
+        all_kept.extend(kept)
+    for v in broken + sorted(
+        all_kept[len(broken):], key=lambda v: (v.path, v.line, v.rule)
+    ):
+        fp = v.fingerprint()
+        legacy = v.legacy_fingerprint()
+        if remaining[fp] > 0:
+            remaining[fp] -= 1
+            baselined += 1
+        elif remaining[legacy] > 0:
+            remaining[legacy] -= 1
+            baselined += 1
+        else:
             reported.append(v)
     stale = sum(remaining.values())
     reported.sort(key=lambda v: (v.path, v.line, v.rule))
-    return RunResult(reported, suppressed, baselined, files, stale)
+    return RunResult(reported, suppressed, baselined,
+                     len(contexts) + len(broken), stale, all_kept)
 
 
 def load_baseline(path: pathlib.Path) -> Counter:
@@ -263,9 +369,9 @@ def load_baseline(path: pathlib.Path) -> Counter:
 
 def write_baseline(path: pathlib.Path, violations: Iterable[Violation]) -> None:
     lines = [
-        "# floorlint baseline — one `path:RULE:message` fingerprint per",
-        "# accepted pre-existing violation.  Keep this empty: new code must",
-        "# be clean; entries are an emergency paved road, not a policy.",
+        "# floorlint baseline — one `path:RULE:normalized-span` fingerprint",
+        "# per accepted pre-existing violation.  Keep this empty: new code",
+        "# must be clean; entries are an emergency paved road, not a policy.",
     ]
     lines += sorted(v.fingerprint() for v in violations)
     path.write_text("\n".join(lines) + "\n")

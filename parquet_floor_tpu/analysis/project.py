@@ -1,0 +1,534 @@
+"""floorlint project pass — whole-package symbol table + call graph.
+
+PR 2's analyzer was strictly per-file: every rule saw one ``ast`` tree
+and nothing else, so a helper *called from* a jitted function (FL-TPU)
+or a blocking call buried one frame below a lock (FL-LOCK) was
+invisible.  This module parses the project ONCE and builds the three
+indexes the cross-file rules traverse:
+
+* a **symbol table** — module-level functions (``pkg.mod.fn``), classes
+  (``pkg.mod.Cls``) and their methods (``pkg.mod.Cls.fn``), plus each
+  file's import-alias map (``from ..io.source import FileSource`` makes
+  the local name ``FileSource`` resolve to ``parquet_floor_tpu.io.
+  source.FileSource``);
+* a **call graph** — for every function body, the calls that resolve to
+  a known project function, via the same shapes FL-TPU already
+  recognizes: bare names (local import aliases and same-module
+  functions), ``self.method()`` (self-type from the enclosing class,
+  single-level base lookup in-package), ``self.attr.method()`` when the
+  attribute's type was inferred from a ``self.attr = KnownClass(...)``
+  assignment, ``mod.fn()`` through module aliases, ``KnownClass(...)``
+  (an edge into ``__init__``), and ``functools.partial`` targets (both
+  ``h = partial(fn, ...); h()`` locals and direct
+  ``partial(fn, ...)()`` calls);
+* a **lock registry** — every attribute or module global bound to
+  ``threading.Lock/RLock/Condition/Semaphore/BoundedSemaphore`` (the
+  FL-LOCK rules' notion of "statically-known lock").
+
+Known blind spots (deliberate — documented in
+``docs/static_analysis.md``): dynamic dispatch (a receiver whose type
+the two inference shapes above cannot pin), callables passed as
+arguments, monkey-patching, and ``getattr`` strings.  Rules built on
+the graph are therefore *under*-approximate: a resolved edge is real,
+an unresolved call is silently not followed.
+
+Traversal is bounded: :meth:`Project.walk_calls` follows edges to
+``depth`` hops (default :data:`CALL_DEPTH`) and yields each reached
+function once with the call chain that got there — the bound keeps the
+whole-project pass linear and the messages readable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+#: default bound on cross-function traversal (hops below the root body)
+CALL_DEPTH = 3
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+
+_PKG = "parquet_floor_tpu"
+
+
+def _module_name(rel_parts: Tuple[str, ...]) -> str:
+    """Dotted module name for one analyzed file.  Files under the
+    package get their real import path; everything else (tests,
+    scripts, fixtures) gets a path-derived pseudo-module so same-run
+    cross-file resolution still works between explicit files."""
+    parts = list(rel_parts)
+    if _PKG in parts:
+        parts = parts[parts.index(_PKG):]
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or "<root>"
+
+
+def _last(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FunctionInfo:
+    """One project function: its AST, home file, and resolution scope."""
+
+    __slots__ = ("qual", "node", "ctx", "cls", "module")
+
+    def __init__(self, qual: str, node: ast.AST, ctx, module: str,
+                 cls: Optional["ClassInfo"]):
+        self.qual = qual
+        self.node = node
+        self.ctx = ctx          # the FileContext the function lives in
+        self.module = module
+        self.cls = cls
+
+
+class ClassInfo:
+    """One project class: methods, bases, inferred attribute types, and
+    the lock attributes its methods bind."""
+
+    __slots__ = ("qual", "node", "module", "methods", "bases",
+                 "attr_types", "lock_attrs")
+
+    def __init__(self, qual: str, node: ast.ClassDef, module: str):
+        self.qual = qual
+        self.node = node
+        self.module = module
+        self.methods: Dict[str, FunctionInfo] = {}
+        self.bases: List[str] = [b for b in map(_last, node.bases) if b]
+        self.attr_types: Dict[str, str] = {}   # attr -> class qual
+        self.lock_attrs: Dict[str, str] = {}   # attr -> ctor name
+
+
+class LockId(Tuple[str, str, str]):
+    """Identity of one statically-known lock: ``(kind, owner, name)``
+    with kind ``attr`` (owner = class qual), ``global`` (owner =
+    module), or ``attrname`` (owner = "?" — an attribute whose receiver
+    could not be typed but whose NAME is bound to a lock constructor
+    somewhere in the project; good enough to *detect* a lock, too weak
+    to pair lock IDENTITIES for ordering)."""
+
+    def render(self) -> str:
+        kind, owner, name = self
+        if kind == "attr":
+            return f"{owner.rsplit('.', 1)[-1]}.{name}"
+        if kind == "global":
+            return f"{owner}.{name}"
+        return name
+
+
+class Project:
+    """The shared whole-project pass (module docstring).  Built once per
+    :func:`analysis.core.run`; every rule module receives it."""
+
+    def __init__(self, contexts):
+        self.contexts = list(contexts)
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.module_of: Dict[object, str] = {}     # FileContext -> module
+        self.by_module: Dict[str, object] = {}     # module -> FileContext
+        #: per-file import alias map: FileContext -> {local: qual}
+        self.aliases: Dict[object, Dict[str, str]] = {}
+        #: module globals bound to lock constructors: (module, name) -> ctor
+        self.global_locks: Dict[Tuple[str, str], str] = {}
+        #: every attribute NAME bound to a lock ctor anywhere: name -> ctor
+        self.lock_attr_names: Dict[str, str] = {}
+        #: resolved call edges: caller qual -> [(callee qual, lineno)]
+        self._edges: Dict[str, List[Tuple[str, int]]] = {}
+        for ctx in self.contexts:
+            self._index_file(ctx)
+        for ctx in self.contexts:
+            self._resolve_imports(ctx)
+        for ctx in self.contexts:
+            self._infer_attr_types(ctx)
+        for info in list(self.functions.values()):
+            self._edges[info.qual] = list(self._resolve_calls(info))
+
+    # -- pass 1: symbols -----------------------------------------------------
+
+    def _index_file(self, ctx) -> None:
+        mod = _module_name(ctx.rel_parts)
+        self.module_of[ctx] = mod
+        self.by_module.setdefault(mod, ctx)
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{mod}.{node.name}"
+                self.functions[qual] = FunctionInfo(qual, node, ctx, mod,
+                                                    None)
+            elif isinstance(node, ast.ClassDef):
+                cqual = f"{mod}.{node.name}"
+                cls = ClassInfo(cqual, node, mod)
+                self.classes[cqual] = cls
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        fqual = f"{cqual}.{item.name}"
+                        fi = FunctionInfo(fqual, item, ctx, mod, cls)
+                        cls.methods[item.name] = fi
+                        self.functions[fqual] = fi
+            elif isinstance(node, ast.Assign):
+                self._index_global_assign(mod, node)
+
+    def _index_global_assign(self, mod: str, node: ast.Assign) -> None:
+        if not isinstance(node.value, ast.Call):
+            return
+        ctor = _last(node.value.func)
+        if ctor not in _LOCK_CTORS:
+            return
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self.global_locks[(mod, t.id)] = ctor
+
+    # -- pass 2: imports -----------------------------------------------------
+
+    def _resolve_imports(self, ctx) -> None:
+        mod = self.module_of[ctx]
+        table: Dict[str, str] = {}
+        # the containing package for relative imports: a leaf module's
+        # parent — but an __init__.py's module name IS its package
+        # (_module_name strips the '__init__' segment), so level-1
+        # imports there resolve into the package itself, not above it
+        if ctx.rel_parts and ctx.rel_parts[-1] == "__init__.py":
+            pkg_parts = mod.split(".")
+        else:
+            pkg_parts = mod.split(".")[:-1]
+        for node in ctx.nodes:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    table[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module != \
+                    "__future__":
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    src = ".".join(base + ([node.module]
+                                           if node.module else []))
+                else:
+                    src = node.module or ""
+                for a in node.names:
+                    if a.name != "*":
+                        table[a.asname or a.name] = f"{src}.{a.name}"
+        self.aliases[ctx] = table
+
+    # -- pass 3: attribute types --------------------------------------------
+
+    def _infer_attr_types(self, ctx) -> None:
+        """``self.attr = KnownClass(...)`` (or ``= threading.Lock()``)
+        inside any method types the attribute for the whole class —
+        flow-insensitive; a reassignment to an unknown type leaves the
+        earlier inference in place (documented blind spot)."""
+        mod = self.module_of[ctx]
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = self.classes[f"{mod}.{node.name}"]
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign) or \
+                        not isinstance(sub.value, ast.Call):
+                    continue
+                ctor = _last(sub.value.func)
+                for t in sub.targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    if ctor in _LOCK_CTORS:
+                        cls.lock_attrs[t.attr] = ctor
+                        self.lock_attr_names.setdefault(t.attr, ctor)
+                        continue
+                    cq = self._class_qual(ctx, ctor) if ctor else None
+                    if cq is not None:
+                        cls.attr_types.setdefault(t.attr, cq)
+
+    # -- name resolution -----------------------------------------------------
+
+    def _class_qual(self, ctx, name: Optional[str]) -> Optional[str]:
+        if not name:
+            return None
+        mod = self.module_of[ctx]
+        if f"{mod}.{name}" in self.classes:
+            return f"{mod}.{name}"
+        target = self.aliases.get(ctx, {}).get(name)
+        if target in self.classes:
+            return target
+        return None
+
+    def class_of(self, ctx, node: ast.AST) -> Optional[ClassInfo]:
+        """The ClassInfo whose body lexically contains ``node``."""
+        for anc in _ancestors(ctx, node):
+            if isinstance(anc, ast.ClassDef):
+                return self.classes.get(
+                    f"{self.module_of[ctx]}.{anc.name}"
+                )
+        return None
+
+    def function_at(self, ctx, fn_node: ast.AST) -> Optional[FunctionInfo]:
+        """The FunctionInfo for a def node (module-level or method)."""
+        mod = self.module_of.get(ctx)
+        if mod is None:
+            return None
+        cls = self.class_of(ctx, fn_node)
+        name = getattr(fn_node, "name", None)
+        qual = (f"{cls.qual}.{name}" if cls is not None
+                else f"{mod}.{name}")
+        info = self.functions.get(qual)
+        if info is not None and info.node is fn_node:
+            return info
+        return None
+
+    def _method_in(self, cqual: str, name: str,
+                   _seen: Optional[Set[str]] = None) -> Optional[str]:
+        """Resolve a method by name in a class or (single-level,
+        in-package) its bases."""
+        seen = _seen or set()
+        if cqual in seen:
+            return None
+        seen.add(cqual)
+        cls = self.classes.get(cqual)
+        if cls is None:
+            return None
+        if name in cls.methods:
+            return cls.methods[name].qual
+        for b in cls.bases:
+            bq = self._class_qual(cls_ctx(self, cls), b)
+            if bq is not None:
+                hit = self._method_in(bq, name, seen)
+                if hit is not None:
+                    return hit
+        return None
+
+    def resolve_call(self, info: FunctionInfo, call: ast.Call,
+                     partials: Dict[str, ast.AST]) -> Optional[str]:
+        """Qualified name of the project function ``call`` invokes, or
+        None when the receiver cannot be pinned (blind spot)."""
+        f = call.func
+        # partial(fn, ...)(...) applied directly
+        if isinstance(f, ast.Call) and _last(f.func) == "partial" \
+                and f.args:
+            return self._resolve_ref(info, f.args[0], partials)
+        return self._resolve_ref(info, f, partials, as_call=True)
+
+    def _resolve_ref(self, info: FunctionInfo, ref: ast.AST,
+                     partials: Dict[str, ast.AST],
+                     as_call: bool = False) -> Optional[str]:
+        ctx, mod = info.ctx, info.module
+        if isinstance(ref, ast.Name):
+            name = ref.id
+            if name in partials:
+                return self._resolve_ref(info, partials[name], partials)
+            cq = self._class_qual(ctx, name)
+            if cq is not None:
+                return self._method_in(cq, "__init__")
+            if f"{mod}.{name}" in self.functions:
+                return f"{mod}.{name}"
+            target = self.aliases.get(ctx, {}).get(name)
+            if target in self.functions:
+                return target
+            if target in self.classes:
+                return self._method_in(target, "__init__")
+            return None
+        if not isinstance(ref, ast.Attribute):
+            return None
+        recv, attr = ref.value, ref.attr
+        # self.method(...)
+        if isinstance(recv, ast.Name) and recv.id == "self" and \
+                info.cls is not None:
+            return self._method_in(info.cls.qual, attr)
+        # self.attr.method(...) through an inferred attribute type
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id == "self" and info.cls is not None:
+            tq = info.cls.attr_types.get(recv.attr)
+            if tq is not None:
+                return self._method_in(tq, attr)
+            return None
+        # mod.fn(...) through a module alias
+        if isinstance(recv, ast.Name):
+            target = self.aliases.get(ctx, {}).get(recv.id)
+            if target is not None:
+                if f"{target}.{attr}" in self.functions:
+                    return f"{target}.{attr}"
+                if f"{target}.{attr}" in self.classes:
+                    return self._method_in(f"{target}.{attr}", "__init__")
+        return None
+
+    # -- call-graph construction --------------------------------------------
+
+    @staticmethod
+    def partial_locals(fn_node: ast.AST) -> Dict[str, ast.AST]:
+        """``h = functools.partial(target, ...)`` locals in one body:
+        name -> the target reference expression."""
+        out: Dict[str, ast.AST] = {}
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _last(node.value.func) == "partial" and \
+                    node.value.args:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = node.value.args[0]
+        return out
+
+    def partials_of(self, info: FunctionInfo) -> Dict[str, ast.AST]:
+        """Memoized :meth:`partial_locals` for an indexed function."""
+        cache = self.__dict__.setdefault("_partials_cache", {})
+        hit = cache.get(info.qual)
+        if hit is None:
+            hit = cache[info.qual] = self.partial_locals(info.node)
+        return hit
+
+    def _resolve_calls(self, info: FunctionInfo
+                       ) -> Iterator[Tuple[str, int]]:
+        partials = self.partials_of(info)
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call):
+                qual = self.resolve_call(info, node, partials)
+                if qual is not None and qual != info.qual:
+                    yield qual, node.lineno
+
+    def callees(self, qual: str) -> List[Tuple[str, int]]:
+        return self._edges.get(qual, [])
+
+    def walk_calls(self, root: FunctionInfo, depth: int = CALL_DEPTH
+                   ) -> List[Tuple[FunctionInfo, Tuple[str, ...], int]]:
+        """BFS over resolved call edges from ``root``'s body, bounded to
+        ``depth`` hops.  Returns ``(callee info, chain, first_line)``
+        tuples where ``chain`` is the function-name path from the root
+        to the callee and ``first_line`` is the line IN THE ROOT'S FILE
+        of the first hop — where a violation found down the chain is
+        reported.  Each function is visited once (shortest chain wins);
+        results are memoized per ``(root, depth)`` — several rules
+        traverse from the same roots."""
+        cache = self.__dict__.setdefault("_walk_cache", {})
+        key = (root.qual, depth)
+        hit = cache.get(key)
+        if hit is None:
+            hit = cache[key] = list(self._walk_calls(root, depth))
+        return hit
+
+    def _walk_calls(self, root: FunctionInfo, depth: int
+                    ) -> Iterator[Tuple[FunctionInfo, Tuple[str, ...], int]]:
+        seen: Set[str] = {root.qual}
+        frontier: List[Tuple[FunctionInfo, Tuple[str, ...], int]] = []
+        for qual, line in self.callees(root.qual):
+            if qual not in seen:
+                seen.add(qual)
+                frontier.append(
+                    (self.functions[qual],
+                     (short(root.qual), short(qual)), line)
+                )
+        hops = 1
+        while frontier and hops <= depth:
+            yield from frontier
+            nxt: List[Tuple[FunctionInfo, Tuple[str, ...], int]] = []
+            if hops == depth:
+                break
+            for info, chain, line0 in frontier:
+                for qual, _line in self.callees(info.qual):
+                    if qual not in seen:
+                        seen.add(qual)
+                        nxt.append((self.functions[qual],
+                                    chain + (short(qual),), line0))
+            frontier = nxt
+            hops += 1
+
+    # -- lock identity -------------------------------------------------------
+
+    def lock_id(self, info: Optional[FunctionInfo], ctx,
+                expr: ast.AST) -> Optional[LockId]:
+        """Resolve an expression used as a lock (a ``with`` item or an
+        ``.acquire()`` receiver) to a :class:`LockId`, or None when it
+        is not a statically-known lock."""
+        mod = self.module_of.get(ctx)
+        if isinstance(expr, ast.Name):
+            ctor = self.global_locks.get((mod, expr.id))
+            if ctor is not None:
+                return LockId(("global", mod, expr.id))
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        recv, attr = expr.value, expr.attr
+        if isinstance(recv, ast.Name) and recv.id == "self" and \
+                info is not None and info.cls is not None:
+            if attr in info.cls.lock_attrs or \
+                    self._inherited_lock(info.cls, attr):
+                return LockId(("attr", info.cls.qual, attr))
+            return None
+        # typed receiver: self.attr.lock / obj.lock where obj's class is
+        # known through attribute inference
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id == "self" and info is not None and \
+                info.cls is not None:
+            tq = info.cls.attr_types.get(recv.attr)
+            cls = self.classes.get(tq) if tq else None
+            if cls is not None and attr in cls.lock_attrs:
+                return LockId(("attr", tq, attr))
+        # untyped receiver: fall back to the project-wide attribute NAME
+        # registry (detects a lock; too weak to pair identities)
+        if attr in self.lock_attr_names:
+            return LockId(("attrname", "?", attr))
+        return None
+
+    def _inherited_lock(self, cls: ClassInfo, attr: str,
+                        _seen: Optional[Set[str]] = None) -> bool:
+        seen = _seen if _seen is not None else set()
+        if cls.qual in seen:  # cyclic bases parse fine statically
+            return False
+        seen.add(cls.qual)
+        for b in cls.bases:
+            bq = self._class_qual(self.by_module.get(cls.module), b)
+            bcls = self.classes.get(bq) if bq else None
+            if bcls is not None and (
+                attr in bcls.lock_attrs
+                or self._inherited_lock(bcls, attr, seen)
+            ):
+                return True
+        return False
+
+    def lock_ctor(self, lock: LockId) -> Optional[str]:
+        kind, owner, name = lock
+        if kind == "global":
+            return self.global_locks.get((owner, name))
+        if kind == "attr":
+            cls = self.classes.get(owner)
+            return cls.lock_attrs.get(name) if cls else None
+        return self.lock_attr_names.get(name)
+
+
+def short(qual: str) -> str:
+    """Readable chain element: drop the package prefix, keep
+    ``module.Class.fn`` / ``module.fn``."""
+    parts = qual.split(".")
+    if parts and parts[0] == _PKG:
+        parts = parts[1:]
+    return ".".join(parts[-3:]) if len(parts) > 3 else ".".join(parts)
+
+
+def cls_ctx(project: Project, cls: ClassInfo):
+    return project.by_module.get(cls.module)
+
+
+def _ancestors(ctx, node: ast.AST):
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = ctx.parents.get(cur)

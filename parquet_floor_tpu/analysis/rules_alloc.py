@@ -8,8 +8,11 @@ through as *host pressure* instead of surfacing as corruption.  The fix
 is a single helper that validates ``0 <= n < 2**31`` and raises
 ``CorruptPageError`` with context; this rule makes the helper mandatory.
 
-**FL-ALLOC001** fires on ``np.empty/zeros/ones/full(size, ...)`` —
-and on ``bytes(e)``/``bytearray(e)`` when ``e`` is visibly
+**FL-ALLOC001** fires on ``np.empty/zeros/ones/full(size, ...)``, on
+``ctypes.create_string_buffer(size)`` (the native binding's output
+buffers — the ctypes boundary re-raw-ifies sizes the format layer
+already blessed, so the discipline repeats there), and on
+``bytes(e)``/``bytearray(e)`` when ``e`` is visibly
 integer-producing (arithmetic, ``int(...)``, ``int.from_bytes``) —
 whenever the size expression is not provably *safe*.  Safe means built
 from:
@@ -33,8 +36,12 @@ conservative-by-construction: it cannot prove a guard like
 is the point (one blessed spelling, greppable, carrying error context).
 
 Scope: files under ``parquet_floor_tpu/format/`` — the layer that parses
-wire bytes.  (The TPU engine allocates from sizes this layer has already
-checked.)
+wire bytes — plus ``tpu/engine.py`` (footer-derived staging sizes) and
+``native/binding.py`` (the ctypes boundary: output buffers for the C
+decompressors/scanners, where an unchecked size becomes a raw
+``create_string_buffer``/``np.empty`` of attacker-controlled bytes).
+The C scanners themselves are allocation-free by design — the audit in
+docs/static_analysis.md records why.
 """
 
 from __future__ import annotations
@@ -179,7 +186,13 @@ def _safe_expr(e: object, safe: Set[str]) -> bool:
             return True
         if name == "min" and e.args:
             return any(_safe_expr(a, safe) for a in e.args)
-        if name == "max" and e.args:
+        if name in ("max", "int") and e.args:
+            return all(_safe_expr(a, safe) for a in e.args)
+        if name and e.args and name.lower().replace("_", "").endswith(
+                "maxcompressedsize"):
+            # a codec's worst-case bound (pftpu_*_max_compressed_size,
+            # BrotliEncoderMaxCompressedSize): an affine function of an
+            # in-memory length — safe whenever its input is
             return all(_safe_expr(a, safe) for a in e.args)
         return False
     if isinstance(e, ast.Attribute):
@@ -201,19 +214,21 @@ def _int_producing(e: ast.AST) -> bool:
     return False
 
 
-def check(ctx: FileContext):
+def check(ctx: FileContext, project=None):
     # format/ parses wire bytes; tpu/engine.py sizes its staging arenas
     # and decode buffers from the same footer/page fields (group byte
-    # estimates, padded string widths, chunk row counts), so a flipped
-    # size bit there is the SAME bug class — both are in scope.
+    # estimates, padded string widths, chunk row counts); and
+    # native/binding.py is the ctypes boundary where those sizes become
+    # raw output buffers for the C decompressors — all three are the
+    # SAME bug class and all three are in scope.
     in_default = (
         ctx.under("parquet_floor_tpu", "format")
-        or ctx.is_module("tpu/engine.py")
+        or ctx.is_module("tpu/engine.py", "native/binding.py")
     )
     if not ctx.in_scope("FL-ALLOC", in_default):
         return
     scopes: Dict[Optional[ast.AST], _Scope] = {}
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.Call):
             continue
         f = node.func
@@ -227,6 +242,9 @@ def check(ctx: FileContext):
             else:
                 size = next((kw.value for kw in node.keywords
                              if kw.arg == "shape"), None)
+        elif last_part(f) == "create_string_buffer" and node.args:
+            what = "ctypes.create_string_buffer"
+            size = node.args[0]
         elif isinstance(f, ast.Name) and f.id in ("bytes", "bytearray") and \
                 len(node.args) == 1 and _int_producing(node.args[0]):
             what = f.id

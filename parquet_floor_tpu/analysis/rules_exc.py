@@ -102,7 +102,7 @@ def _reraises(handler: ast.ExceptHandler) -> bool:
 
 
 def _check_exc001(ctx: FileContext):
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.Try):
             continue
         # transient classes whose re-raise arms have been seen so far —
@@ -132,7 +132,7 @@ def _check_exc001(ctx: FileContext):
 
 
 def _check_exc002(ctx: FileContext):
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.ExceptHandler) or not node.name:
             continue
         for r in _own_raises(node):
@@ -161,7 +161,7 @@ def _in_classified_with(ctx: FileContext, node: ast.AST) -> bool:
 
 
 def _check_exc003(ctx: FileContext):
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.Raise) or not isinstance(node.exc, ast.Call):
             continue
         name = last_part(node.exc.func)
@@ -184,7 +184,7 @@ def _check_exc003(ctx: FileContext):
                "outside `with classified_decode_errors(...)`")
 
 
-def check(ctx: FileContext):
+def check(ctx: FileContext, project=None):
     in_pkg = ctx.under("parquet_floor_tpu")
     if ctx.in_scope("FL-EXC001", in_pkg):
         yield from _check_exc001(ctx)

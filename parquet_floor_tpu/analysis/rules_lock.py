@@ -1,0 +1,481 @@
+"""FL-LOCK — concurrency-discipline guards for the threaded runtime.
+
+PRs 3–9 made the package deeply concurrent: the scan executor's worker
+pools, the device engine's stage‖ship‖decode pipeline, the shared
+buffer cache, the weighted-fair tenancy gate — 20+ ``threading.Lock``/
+``Condition`` sites with exactly the hazard profile of a serving
+system: a wedged buffer-cache lock stalls every tenant.  These rules
+make the discipline that keeps them safe *checkable*:
+
+* **FL-LOCK001** — a bare ``lock.acquire()`` must be ``with``-managed
+  or released in a ``finally`` block of the same function.  An acquire
+  whose release an exception can skip wedges the lock forever.
+* **FL-LOCK002** — no blocking calls while a lock is held: host I/O
+  (``open``, ``os.pread``, socket/transport verbs, ``Source.read_at/
+  read_many/load``, ``.get_range``), ``time.sleep``, ``subprocess``,
+  ``.result()`` on futures, ``.wait()``/``.shutdown()``, and
+  user-supplied callbacks (``on_report``/``on_salvage``/``read_fn``/
+  ``read_many_fn``).  Computed over the call graph to
+  :data:`~parquet_floor_tpu.analysis.project.CALL_DEPTH` hops — a
+  blocking call buried in a helper is reported at the lock site with
+  the chain.  The **blessed escape** is the single-flight
+  release-before-wait spelling ``serve/cache.py`` uses: do the blocking
+  work OUTSIDE the ``with`` block (leaders read after releasing;
+  followers wait on an Event they were handed under the lock).
+  ``cond.wait()`` on the very condition the ``with`` block holds is
+  allowed — ``Condition.wait`` releases the lock while it blocks.
+* **FL-LOCK003** — ``Condition.wait()`` must sit inside a ``while``
+  predicate loop, never a bare ``if``: wakeups are spurious and the
+  predicate may be re-falsified between ``notify`` and wakeup (the
+  ``serve/tenancy.py`` WFQ gate is the live exemplar).
+* **FL-LOCK004** — two statically-known locks nested in the same
+  function chain must nest in ONE consistent order project-wide;
+  observing both ``A→B`` and ``B→A`` is a deadlock hazard (reported at
+  every site of both orders, with the opposing site named).
+
+"Statically known" means the lock resolves through the project pass:
+``self.X`` where some method assigns ``self.X = threading.Lock()``
+(Condition/RLock/Semaphore too), a module global so assigned, or an
+attribute whose NAME is so assigned anywhere in the project (detection
+only — identity pairing for FL-LOCK004 uses fully-resolved locks).
+Blind spots (documented in docs/static_analysis.md): locks passed as
+parameters, ``getattr`` strings, and ``.join()`` (str.join noise).
+
+Scope: package code (``parquet_floor_tpu/``).  Tests and scripts spawn
+threads for harness reasons and opt in via ``# floorlint:
+scope=FL-LOCK`` when they want the discipline checked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .core import FileContext, ancestors, dotted, last_part
+from .project import CALL_DEPTH, Project
+
+RULES = [
+    ("FL-LOCK001",
+     "Lock/RLock/Condition.acquire() must be with-managed or released "
+     "in finally"),
+    ("FL-LOCK002",
+     "no blocking calls (I/O, sleep, subprocess, future.result, waits, "
+     "user callbacks) while a lock is held — computed over the call "
+     "graph; single-flight does its blocking AFTER release"),
+    ("FL-LOCK003",
+     "Condition.wait() must sit inside a while-predicate loop, not an "
+     "if (spurious wakeups re-falsify predicates)"),
+    ("FL-LOCK004",
+     "statically-known lock pairs must nest in one consistent order "
+     "project-wide (A→B and B→A is a deadlock hazard)"),
+]
+
+# -- FL-LOCK002 blocking-shape tables ---------------------------------------
+
+_BLOCKING_MODULE_CALLS = {
+    # dotted-prefix → label
+    "time.sleep": "time.sleep",
+    "subprocess": "subprocess",
+    "socket": "socket I/O",
+    "urllib.request.urlopen": "urlopen",
+}
+_BLOCKING_OS = {"pread", "read", "write", "fsync", "sendfile"}
+# attribute verbs that block regardless of receiver type: storage reads
+# (the Source protocol + remote transports), futures, events, pools
+_BLOCKING_ATTRS = {
+    "read_at": "storage read",
+    "read_many": "storage read",
+    "load": "storage read",
+    "get_range": "remote storage read",
+    "result": "future .result()",
+    "shutdown": "pool shutdown",
+    "recv": "socket recv",
+    "recv_into": "socket recv",
+    "sendall": "socket send",
+    "connect": "socket connect",
+    "accept": "socket accept",
+}
+# zero-trust callback parameter names: calling user code under a lock
+# hands the lock's critical section to the user
+_CALLBACK_NAMES = {"on_report", "on_salvage", "read_fn", "read_many_fn",
+                   "callback", "hydrator", "dehydrator"}
+
+
+def _blocking_shape(node: ast.Call, held_exprs: List[str]
+                    ) -> Optional[str]:
+    """Label of the blocking operation ``node`` performs, or None.
+    ``held_exprs`` are the dotted spellings of locks held around this
+    call — a ``.wait()`` on one of them is the blessed Condition.wait
+    (it RELEASES that lock while blocking); the caller decides whether
+    some OTHER lock stays held."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        if f.id == "open":
+            return "open()"
+        if f.id == "sleep":
+            return "time.sleep"
+        if f.id in _CALLBACK_NAMES:
+            return f"user callback {f.id}()"
+        return None
+    path = dotted(f)
+    if path is not None:
+        for prefix, label in _BLOCKING_MODULE_CALLS.items():
+            if path == prefix or path.startswith(prefix + "."):
+                return label
+        root, _, rest = path.partition(".")
+        if root == "os" and rest in _BLOCKING_OS:
+            return f"os.{rest}"
+    attr = last_part(f)
+    if attr == "wait":
+        recv = dotted(f.value) if isinstance(f, ast.Attribute) else None
+        if recv is not None and recv in held_exprs:
+            return None  # Condition.wait on a held cv: releases it
+        return ".wait()"
+    if attr in _CALLBACK_NAMES:
+        return f"user callback .{attr}()"
+    if attr in _BLOCKING_ATTRS:
+        return f"{_BLOCKING_ATTRS[attr]} .{attr}()"
+    return None
+
+
+# -- with-region discovery ---------------------------------------------------
+
+
+class _Region:
+    """One ``with <lock>:`` region: the statement, the resolved lock,
+    and the lock expression's dotted spelling."""
+
+    __slots__ = ("stmt", "lock", "expr")
+
+    def __init__(self, stmt: ast.With, lock, expr: str):
+        self.stmt = stmt
+        self.lock = lock
+        self.expr = expr
+
+
+def _lock_regions(project: Project, ctx: FileContext, info,
+                  fn_node: ast.AST) -> List[_Region]:
+    cache = project.__dict__.setdefault("_regions_cache", {})
+    hit = cache.get(id(fn_node))
+    if hit is not None:
+        return hit
+    out: List[_Region] = []
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            expr = item.context_expr
+            lock = project.lock_id(info, ctx, expr)
+            if lock is not None:
+                out.append(_Region(node, lock, dotted(expr) or ""))
+    cache[id(fn_node)] = out
+    return out
+
+
+def _body_calls(region_stmt: ast.With):
+    """Calls lexically inside the region body — nested defs/lambdas are
+    skipped (they do not run under the lock at definition time)."""
+    stack = list(region_stmt.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# -- FL-LOCK001 --------------------------------------------------------------
+
+
+def _check_lock001(project: Project, ctx: FileContext):
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call) or \
+                last_part(node.func) != "acquire" or \
+                not isinstance(node.func, ast.Attribute):
+            continue
+        recv = node.func.value
+        info = _info_at(project, ctx, node)
+        lock = project.lock_id(info, ctx, recv)
+        if lock is None:
+            continue
+        recv_str = dotted(recv)
+        if recv_str is not None and _released_in_finally(
+            ctx, node, recv_str
+        ):
+            continue
+        yield (node.lineno, "FL-LOCK001",
+               f"{lock.render()}.acquire() without `with` or a finally "
+               "release in this function — an exception between acquire "
+               "and release wedges the lock (use `with "
+               f"{recv_str or lock.render()}:`)")
+
+
+def _released_in_finally(ctx: FileContext, call: ast.Call,
+                         recv_str: str) -> bool:
+    fn = _enclosing_fn(ctx, call)
+    scope = fn if fn is not None else ctx.tree
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Try) or not node.finalbody:
+            continue
+        for stmt in node.finalbody:
+            for c in ast.walk(stmt):
+                if isinstance(c, ast.Call) and \
+                        last_part(c.func) == "release" and \
+                        isinstance(c.func, ast.Attribute) and \
+                        dotted(c.func.value) == recv_str:
+                    return True
+    return False
+
+
+# -- FL-LOCK002 --------------------------------------------------------------
+
+
+def _scan_blocking(project: Project, fn_node: ast.AST,
+                   ctx: FileContext) -> List[tuple]:
+    """Blocking shapes in one CALLEE body, for the chained pass.  No
+    held-cv allowance applies here: the caller's lock stays held while
+    the callee blocks, and ``Condition.wait`` only releases the cv it
+    waits on — so even the callee's own ``with cv: cv.wait()`` pattern
+    blocks the caller's distinct lock (moving a violation into a helper
+    must not silence it).  Returns ``(lineno, label)`` pairs (memoized
+    per function — chained scans revisit hot helpers)."""
+    cache = project.__dict__.setdefault("_blocking_cache", {})
+    hit = cache.get(id(fn_node))
+    if hit is not None:
+        return hit
+    out = []
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        label = _blocking_shape(node, [])
+        if label is not None:
+            out.append((node.lineno, label))
+    cache[id(fn_node)] = out
+    return out
+
+
+def _check_lock002(project: Project, ctx: FileContext):
+    for fn_node, info in _functions(project, ctx):
+        regions = _lock_regions(project, ctx, info, fn_node)
+        if not regions:
+            continue
+        for region in regions:
+            # direct shapes under this region.  Blessing is PER LOCK:
+            # `cv.wait()` is evaluated against each held region
+            # separately, so the wait is fine for the cv it releases
+            # but still flags any OTHER lock the caller keeps held.
+            for call in _body_calls(region.stmt):
+                label = _blocking_shape(call, [region.expr])
+                if label is not None:
+                    yield (call.lineno, "FL-LOCK002",
+                           f"{label} while holding "
+                           f"{region.lock.render()} — blocking under a "
+                           "lock stalls every waiter (single-flight: "
+                           "release first, block after)")
+            # call-graph hops: a resolvable call under the lock whose
+            # callee (to depth) blocks
+            if info is None:
+                continue
+            yield from _chained_blocking(project, ctx, info, region)
+
+
+def _chained_blocking(project: Project, ctx: FileContext, info,
+                      region: _Region):
+    partials = project.partials_of(info)
+    reported = set()
+    for call in _body_calls(region.stmt):
+        qual = project.resolve_call(info, call, partials)
+        if qual is None:
+            continue
+        root = project.functions[qual]
+        targets = [(root, (region.expr or region.lock.render(),
+                           _short(qual)), call.lineno)]
+        targets.extend(
+            (fi, (region.expr or region.lock.render(), _short(qual))
+             + chain[1:], call.lineno)
+            for fi, chain, _line in project.walk_calls(
+                root, depth=CALL_DEPTH - 1
+            )
+        )
+        for callee, chain, line0 in targets:
+            for bl_line, label in _scan_blocking(
+                project, callee.node, callee.ctx
+            ):
+                key = (line0, label, chain[-1])
+                if key in reported:
+                    continue
+                reported.add(key)
+                yield (line0, "FL-LOCK002",
+                       f"{label} reachable while holding "
+                       f"{region.lock.render()} via "
+                       f"{' -> '.join(chain)} "
+                       f"({callee.ctx.rel}:{bl_line}) — blocking under "
+                       "a lock stalls every waiter (single-flight: "
+                       "release first, block after)")
+
+
+# -- FL-LOCK003 --------------------------------------------------------------
+
+
+def _check_lock003(project: Project, ctx: FileContext):
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call) or \
+                last_part(node.func) != "wait" or \
+                not isinstance(node.func, ast.Attribute):
+            continue
+        info = _info_at(project, ctx, node)
+        lock = project.lock_id(info, ctx, node.func.value)
+        if lock is None or project.lock_ctor(lock) != "Condition":
+            continue
+        if any(isinstance(a, ast.While) for a in ancestors(ctx, node)):
+            continue
+        yield (node.lineno, "FL-LOCK003",
+               f"{lock.render()}.wait() outside a while-predicate loop — "
+               "wakeups are spurious and the predicate can re-falsify "
+               "between notify and wakeup; spell it `while not pred: "
+               "cv.wait()`")
+
+
+# -- FL-LOCK004 --------------------------------------------------------------
+
+
+def _nesting_pairs(project: Project):
+    """Project-wide ordered lock pairs: ``{(A, B): [(ctx, line,
+    chain)]}`` where A was held when B was acquired — lexically nested
+    ``with`` blocks, and ``with A:`` bodies calling (to depth) into
+    functions that take B.  Only fully-resolved identities pair (the
+    ``attrname`` fallback would merge every ``_lock`` in the project
+    into one)."""
+    pairs: Dict[Tuple[tuple, tuple], List[tuple]] = {}
+
+    def record(a, b, ctx, line, chain):
+        if a[0] == "attrname" or b[0] == "attrname" or a == b:
+            return
+        pairs.setdefault((tuple(a), tuple(b)), []).append(
+            (ctx, line, chain)
+        )
+
+    for ctx in project.contexts:
+        for fn_node, info in _functions(project, ctx):
+            regions = _lock_regions(project, ctx, info, fn_node)
+            if not regions:
+                continue
+            region_by_stmt: Dict[ast.AST, List] = {}
+            for r in regions:
+                region_by_stmt.setdefault(r.stmt, []).append(r)
+            # lexical nesting
+            for r in regions:
+                for anc in ancestors(ctx, r.stmt):
+                    for outer in region_by_stmt.get(anc, ()):
+                        record(outer.lock, r.lock, ctx,
+                               r.stmt.lineno, ())
+            # multi-item `with a, b:` IS nesting (Python defines it as
+            # the nested form), but both items share one With node, so
+            # the ancestor walk above never sees the pair — record the
+            # items' left-to-right acquisition order here
+            for stmt_regions in region_by_stmt.values():
+                for i, outer in enumerate(stmt_regions):
+                    for inner_r in stmt_regions[i + 1:]:
+                        record(outer.lock, inner_r.lock, ctx,
+                               outer.stmt.lineno, ())
+            # chained nesting
+            if info is None:
+                continue
+            partials = project.partials_of(info)
+            for r in regions:
+                for call in _body_calls(r.stmt):
+                    qual = project.resolve_call(info, call, partials)
+                    if qual is None:
+                        continue
+                    root = project.functions[qual]
+                    for callee, chain, _l in [
+                        (root, (_short(info.qual), _short(qual)), 0)
+                    ] + list(project.walk_calls(root,
+                                                depth=CALL_DEPTH - 1)):
+                        inner = _lock_regions(project, callee.ctx,
+                                              callee, callee.node)
+                        for ir in inner:
+                            record(r.lock, ir.lock, ctx, call.lineno,
+                                   chain)
+    return pairs
+
+
+def check_project_lock004(project: Project):
+    """Whole-project FL-LOCK004 verdicts, grouped per file: ``{ctx:
+    [(line, rule, message)]}``.  Computed once per project (cached on
+    the Project object) and handed out per file by :func:`check`."""
+    cached = getattr(project, "_lock004_cache", None)
+    if cached is not None:
+        return cached
+    pairs = _nesting_pairs(project)
+    out: Dict[object, List[tuple]] = {}
+    from .project import LockId
+
+    for (a, b), sites in pairs.items():
+        if (b, a) not in pairs or a > b:
+            continue  # report each unordered pair once, from one side
+        ra, rb = LockId(a).render(), LockId(b).render()
+        other = pairs[(b, a)]
+        for ctx, line, chain in sites:
+            via = f" via {' -> '.join(chain)}" if chain else ""
+            o_ctx, o_line, _ = other[0]
+            out.setdefault(ctx, []).append((
+                line, "FL-LOCK004",
+                f"lock order {ra} -> {rb}{via} conflicts with "
+                f"{rb} -> {ra} at {o_ctx.rel}:{o_line} — inconsistent "
+                "nesting order is a deadlock hazard; pick one order "
+                "project-wide",
+            ))
+        for ctx, line, chain in other:
+            via = f" via {' -> '.join(chain)}" if chain else ""
+            s_ctx, s_line, _ = sites[0]
+            out.setdefault(ctx, []).append((
+                line, "FL-LOCK004",
+                f"lock order {rb} -> {ra}{via} conflicts with "
+                f"{ra} -> {rb} at {s_ctx.rel}:{s_line} — inconsistent "
+                "nesting order is a deadlock hazard; pick one order "
+                "project-wide",
+            ))
+    project._lock004_cache = out
+    return out
+
+
+# -- shared helpers ----------------------------------------------------------
+
+
+def _functions(project: Project, ctx: FileContext):
+    """Every def in the file, paired with its FunctionInfo when the
+    project indexed it (module-level / method), else None (nested)."""
+    for node in ctx.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, project.function_at(ctx, node)
+
+
+def _enclosing_fn(ctx: FileContext, node: ast.AST):
+    for anc in ancestors(ctx, node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _info_at(project: Project, ctx: FileContext, node: ast.AST):
+    fn = _enclosing_fn(ctx, node)
+    return project.function_at(ctx, fn) if fn is not None else None
+
+
+def _short(qual: str) -> str:
+    from .project import short
+
+    return short(qual)
+
+
+def check(ctx: FileContext, project: Project):
+    in_pkg = ctx.under("parquet_floor_tpu")
+    if not ctx.in_scope("FL-LOCK", in_pkg):
+        return
+    yield from _check_lock001(project, ctx)
+    yield from _check_lock002(project, ctx)
+    yield from _check_lock003(project, ctx)
+    yield from check_project_lock004(project).get(ctx, [])

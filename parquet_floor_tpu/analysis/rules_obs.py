@@ -48,14 +48,15 @@ _KINDS = {
 _RECEIVERS = {"trace", "tracer", "_tracer"}
 
 
-def check(ctx: FileContext) -> Iterator[Tuple[int, str, str]]:
+def check(ctx: FileContext,
+          project=None) -> Iterator[Tuple[int, str, str]]:
     in_package = (
         ctx.under("parquet_floor_tpu")
         and not ctx.is_module("utils/trace.py")
     )
     if not ctx.in_scope("FL-OBS", in_package):
         return
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.Call) or not node.args:
             continue
         path = dotted(node.func)

@@ -195,10 +195,10 @@ def _classify(ctx: FileContext, call: ast.Call):
     return None
 
 
-def check(ctx: FileContext):
+def check(ctx: FileContext, project=None):
     if not ctx.in_scope("FL-RES", True):
         return
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if isinstance(node, ast.Call) and _is_acquisition(node):
             msg = _classify(ctx, node)
             if msg is not None:

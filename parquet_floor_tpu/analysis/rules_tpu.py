@@ -23,11 +23,21 @@ A function counts as traced when it is decorated with ``jit``
 (``@jax.jit``, ``@partial(jax.jit, ...)``) or is passed to
 ``pl.pallas_call`` — directly, or through a
 ``kernel = functools.partial(fn, ...)`` local.  Nested ``def``s inside a
-traced function are traced too.  The check is lexical: helpers *called*
-from a traced function are not followed (keep kernel helpers in ``tpu/``
-so they get their own decorators or stay trivially pure).
+traced function are traced too.
 
-Scope: files under ``parquet_floor_tpu/tpu/``.
+Since the project-pass rework the check is no longer lexical: helpers
+*called* from a traced function are followed through the project call
+graph to :data:`~parquet_floor_tpu.analysis.project.CALL_DEPTH` hops —
+module-level functions, ``self`` methods, ``functools.partial`` targets,
+and cross-module imports alike.  A violation found down the chain is
+reported **at the call site inside the traced function** with the full
+chain in the message, so the jit boundary (where the fix belongs:
+hoist the host work out of the traced region) is what the finding
+points at.  Unresolvable receivers (dynamic dispatch) are the
+documented blind spot.
+
+Scope: files under ``parquet_floor_tpu/tpu/`` (the traced function's
+home decides; its helpers may live anywhere in the project).
 """
 
 from __future__ import annotations
@@ -35,12 +45,14 @@ from __future__ import annotations
 import ast
 
 from .core import FileContext, last_part
+from .project import CALL_DEPTH, Project, short
 
 RULES = [
     ("FL-TPU001", "host I/O (open / zlib.crc32) inside a jit/Pallas-traced "
-                  "function"),
+                  "function (call-graph aware)"),
     ("FL-TPU002", "host materialization (.item(), int(tracer), np.asarray, "
-                  "device_get) inside a jit/Pallas-traced function"),
+                  "device_get) inside a jit/Pallas-traced function "
+                  "(call-graph aware)"),
 ]
 
 _NP_MATERIALIZE = {"array", "asarray", "ascontiguousarray", "copy",
@@ -74,7 +86,7 @@ def _traced_functions(ctx: FileContext):
     """FunctionDefs that are jit-decorated or used as Pallas kernels."""
     partial_locals = {}
     kernel_names = set()
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
             target_fn = _partial_target(node.value)
             if target_fn:
@@ -90,7 +102,7 @@ def _traced_functions(ctx: FileContext):
                     name = last_part(arg)
                 if name:
                     kernel_names.add(partial_locals.get(name, name))
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if node.name in kernel_names or \
@@ -98,7 +110,7 @@ def _traced_functions(ctx: FileContext):
             yield node
 
 
-def _check_traced_body(fn: ast.FunctionDef):
+def _check_traced_body(fn: ast.FunctionDef, fn_label: str):
     for node in ast.walk(fn):
         if not isinstance(node, ast.Call):
             continue
@@ -106,38 +118,66 @@ def _check_traced_body(fn: ast.FunctionDef):
         name = last_part(f)
         if isinstance(f, ast.Name) and f.id == "open":
             yield (node.lineno, "FL-TPU001",
-                   f"open() inside traced function `{fn.name}` — host file "
+                   f"open() inside traced function `{fn_label}` — host file "
                    "I/O runs at trace time, not per call")
         elif isinstance(f, ast.Attribute) and name == "crc32" and \
                 last_part(f.value) == "zlib":
             yield (node.lineno, "FL-TPU001",
-                   f"zlib.crc32 inside traced function `{fn.name}` — CRC "
+                   f"zlib.crc32 inside traced function `{fn_label}` — CRC "
                    "verification is host-side policy (ReaderOptions."
                    "verify_crc pins the host engine)")
         elif isinstance(f, ast.Attribute) and name in ("item",
                                                        "block_until_ready"):
             yield (node.lineno, "FL-TPU002",
-                   f".{name}() inside traced function `{fn.name}` forces a "
+                   f".{name}() inside traced function `{fn_label}` forces a "
                    "device→host sync / fails under trace")
         elif name == "device_get":
             yield (node.lineno, "FL-TPU002",
-                   f"jax.device_get inside traced function `{fn.name}`")
+                   f"jax.device_get inside traced function `{fn_label}`")
         elif isinstance(f, ast.Name) and f.id in ("int", "float", "bool") \
                 and len(node.args) == 1 and isinstance(node.args[0], ast.Name):
             yield (node.lineno, "FL-TPU002",
                    f"{f.id}({node.args[0].id}) inside traced function "
-                   f"`{fn.name}` — materializing a traced value crashes at "
+                   f"`{fn_label}` — materializing a traced value crashes at "
                    "trace time (static shapes read int(x.shape[i]) instead)")
         elif isinstance(f, ast.Attribute) and name in _NP_MATERIALIZE and \
                 last_part(f.value) in _NP_MODULES:
             yield (node.lineno, "FL-TPU002",
-                   f"np.{name} inside traced function `{fn.name}` — host "
+                   f"np.{name} inside traced function `{fn_label}` — host "
                    "numpy on traced operands (use jnp)")
 
 
-def check(ctx: FileContext):
+def _check_chain(project: Project, ctx: FileContext,
+                 fn: ast.FunctionDef):
+    """Follow the traced function's resolvable calls through the project
+    graph; a host-purity violation in any reached helper is reported at
+    the first hop's call site with the chain."""
+    info = project.function_at(ctx, fn)
+    if info is None:
+        return
+    seen = set()
+    for callee, chain, line0 in project.walk_calls(info,
+                                                   depth=CALL_DEPTH):
+        label = " -> ".join(chain)
+        for _line, rule, message in _check_traced_body(
+            callee.node, short(callee.qual)
+        ):
+            head = message.split(" inside traced function")[0]
+            key = (line0, rule, callee.qual, head)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield (line0, rule,
+                   f"{head} in helper `{short(callee.qual)}` reached from "
+                   f"traced function `{fn.name}` via {label} "
+                   f"({callee.ctx.rel}:{_line}) — hoist the host work out "
+                   "of the traced region", chain)
+
+
+def check(ctx: FileContext, project: Project):
     in_tpu = ctx.under("parquet_floor_tpu", "tpu")
     if not ctx.in_scope("FL-TPU", in_tpu):
         return
     for fn in _traced_functions(ctx):
-        yield from _check_traced_body(fn)
+        yield from _check_traced_body(fn, fn.name)
+        yield from _check_chain(project, ctx, fn)

@@ -20,6 +20,8 @@ import ctypes.util
 import threading
 from typing import Optional
 
+from ..errors import checked_alloc_size
+
 _dec = None
 _enc = None
 _tried = False
@@ -122,7 +124,9 @@ def decompress(data: bytes, uncompressed_size: Optional[int] = None,
         raise RuntimeError("libbrotlidec not found")
     data = bytes(data)
     cap = (
-        uncompressed_size
+        # a caller-held header field: cap it to the format's i32 range
+        # before it becomes a buffer (FL-ALLOC001 at the ctypes boundary)
+        checked_alloc_size(uncompressed_size, "brotli uncompressed")
         if uncompressed_size
         # the cap bounds the FIRST allocation too: a huge hostile input
         # must not force 4*len(data) bytes before the ladder even starts
@@ -150,7 +154,8 @@ def compress(data: bytes, quality: int = 5, lgwin: int = 22) -> bytes:
     if _enc is None:
         raise RuntimeError("libbrotlienc not found")
     data = bytes(data)
-    cap = int(_enc.BrotliEncoderMaxCompressedSize(len(data))) or len(data) + 1024
+    cap = int(_enc.BrotliEncoderMaxCompressedSize(len(data))) or \
+        len(data) + 1024
     out = ctypes.create_string_buffer(cap)
     n = ctypes.c_size_t(cap)
     rc = _enc.BrotliEncoderCompress(

@@ -23,6 +23,8 @@ import ctypes
 import ctypes.util
 from typing import Callable, Optional
 
+from ..errors import checked_alloc_size
+
 _lzo = None
 _loaded = False
 
@@ -72,8 +74,9 @@ def _block_decompress(data: bytes, cap: int) -> bytes:
     _load()
     if _lzo is None:
         raise RuntimeError("liblzo2 not found")
-    out = ctypes.create_string_buffer(max(cap, 1))
-    n = ctypes.c_size_t(cap)
+    bcap = checked_alloc_size(cap, "LZO block output cap")
+    out = ctypes.create_string_buffer(max(bcap, 1))
+    n = ctypes.c_size_t(bcap)
     rc = _lzo.lzo1x_decompress_safe(
         bytes(data), len(data), out, ctypes.byref(n), None
     )

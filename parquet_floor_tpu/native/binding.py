@@ -14,6 +14,8 @@ import os
 import threading
 from typing import Optional
 
+from ..errors import checked_alloc_size
+
 _LIB_NAME = "libpftpu_native.so"
 _lib = None
 _load_attempted = False
@@ -49,7 +51,14 @@ def _load():
     if _load_attempted:
         return _lib
     with _load_lock:
-        return _load_locked()
+        # justified FL-LOCK002 suppression: this is ONE-TIME lazy init.
+        # The build (a bounded g++ subprocess) must run exactly once per
+        # process and every caller needs its result before proceeding —
+        # followers waiting on the lock IS the wanted semantics, and the
+        # _load_attempted fast path above means the lock is never taken
+        # again once init resolves.  A release-before-wait rewrite would
+        # add an Event for zero steady-state benefit.
+        return _load_locked()  # floorlint: disable=FL-LOCK002
 
 
 def _load_locked():
@@ -202,8 +211,12 @@ def snappy_decompress(data: bytes, uncompressed_size: Optional[int] = None) -> b
         uncompressed_size = lib.pftpu_snappy_uncompressed_size(data, len(data))
         if uncompressed_size < 0:
             raise ValueError("native snappy: bad stream header")
-    out = ctypes.create_string_buffer(max(uncompressed_size, 1))
-    n = lib.pftpu_snappy_decompress(data, len(data), out, uncompressed_size)
+    # the size is a 64-bit varint PARSED OFF THE WIRE (or a caller-held
+    # header field): cap it to the format's i32 range before it becomes
+    # a buffer — the audit's one real gap at the ctypes boundary
+    usize = checked_alloc_size(uncompressed_size, "snappy uncompressed")
+    out = ctypes.create_string_buffer(max(usize, 1))
+    n = lib.pftpu_snappy_decompress(data, len(data), out, usize)
     if n < 0:
         raise ValueError("native snappy decompression failed")
     return out.raw[:n]
@@ -237,8 +250,9 @@ def zstd_decompress_into(data, out_arr, offset: int, out_size: int) -> None:
 def zstd_decompress(data: bytes, uncompressed_size: int) -> bytes:
     """First-party RFC 8878 decoder (see src/pftpu_zstd.cc)."""
     lib = _load()
-    out = ctypes.create_string_buffer(max(uncompressed_size, 1))
-    n = lib.pftpu_zstd_decompress(data, len(data), out, uncompressed_size)
+    usize = checked_alloc_size(uncompressed_size, "zstd uncompressed")
+    out = ctypes.create_string_buffer(max(usize, 1))
+    n = lib.pftpu_zstd_decompress(data, len(data), out, usize)
     if n == -2:
         raise ValueError("native zstd: output exceeds the declared size")
     if n < 0:
@@ -254,8 +268,12 @@ def zstd_decompress_unsized(data: bytes, cap: int) -> bytes:
     """Decode without a known output size into a ``cap``-byte buffer; raises
     ``ValueError('... grow ...')`` when the buffer is too small."""
     lib = _load()
-    out = ctypes.create_string_buffer(max(cap, 1))
-    n = lib.pftpu_zstd_decompress(data, len(data), out, cap)
+    # clamp to the i32 ceiling BEFORE blessing: the grow loop above this
+    # face doubles past 2**31 as its own exit condition, and the last
+    # probe must still run (at the ceiling) rather than raise corruption
+    bcap = checked_alloc_size(min(cap, (1 << 31) - 1), "zstd grow cap")
+    out = ctypes.create_string_buffer(max(bcap, 1))
+    n = lib.pftpu_zstd_decompress(data, len(data), out, bcap)
     if n == -2:
         raise ValueError("native zstd: output buffer too small, grow and retry")
     if n < 0:
@@ -283,8 +301,9 @@ def plain_ba_scan(data, max_values: int):
     import numpy as np
 
     lib = _load()
-    starts = np.empty(max_values, dtype=np.int64)
-    lengths = np.empty(max_values, dtype=np.int64)
+    nv = checked_alloc_size(max_values, "PLAIN BYTE_ARRAY value count")
+    starts = np.empty(nv, dtype=np.int64)
+    lengths = np.empty(nv, dtype=np.int64)
     arr = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
     n = lib.pftpu_plain_ba_scan(
         ctypes.c_char_p(arr.ctypes.data), len(arr), max_values,
@@ -301,8 +320,9 @@ def lz4_decompress_capped(data: bytes, max_size: int) -> bytes:
     (Hadoop-framed records hold codec-buffer-sized inner blocks whose
     exact decoded length is unknown until decoded)."""
     lib = _load()
-    out = ctypes.create_string_buffer(max_size)
-    n = lib.pftpu_lz4_decompress(data, len(data), out, max_size)
+    cap = checked_alloc_size(max_size, "LZ4 output cap")
+    out = ctypes.create_string_buffer(cap)
+    n = lib.pftpu_lz4_decompress(data, len(data), out, cap)
     if n == -2:
         raise ValueError("LZ4 output larger than cap")
     if n < 0:
@@ -313,8 +333,9 @@ def lz4_decompress_capped(data: bytes, max_size: int) -> bytes:
 def lz4_decompress(data: bytes, uncompressed_size: int) -> bytes:
     """Decode one LZ4 raw block natively (exact output size required)."""
     lib = _load()
-    out = ctypes.create_string_buffer(uncompressed_size)
-    n = lib.pftpu_lz4_decompress(data, len(data), out, uncompressed_size)
+    usize = checked_alloc_size(uncompressed_size, "LZ4 uncompressed")
+    out = ctypes.create_string_buffer(usize)
+    n = lib.pftpu_lz4_decompress(data, len(data), out, usize)
     if n == -2:
         raise ValueError("LZ4 output larger than expected size")
     if n < 0:
@@ -405,7 +426,9 @@ def rle_parse_runs(data: bytes, num_values: int, bit_width: int, pos: int = 0):
         raise ValueError(f"parse position {pos} outside buffer of {len(arr)} bytes")
     base_ptr = arr.ctypes.data + pos
     avail = len(arr) - pos
-    cap = max(16, num_values)  # worst case: one run per 1 value? bounded below
+    # worst case one run per value; the count is a parsed page-header
+    # field, so it flows through the i32 cap before sizing the table
+    cap = max(16, checked_alloc_size(num_values, "RLE run table rows"))
     while True:
         table = np.empty((cap, 4), dtype=np.int64)
         end = ctypes.c_longlong(0)
@@ -451,7 +474,9 @@ def rle_parse_runs_batch(data, pos, counts, bws):
         raise ValueError("pos/counts/bws length mismatch")
     runs = np.zeros(ns, dtype=np.int64)
     ll = ctypes.POINTER(ctypes.c_longlong)
-    cap = max(64, int(counts.sum()) // 4 + 2 * ns)
+    cap = max(64, checked_alloc_size(
+        int(counts.sum()) // 4 + 2 * ns, "RLE batch run table rows"
+    ))
     while True:
         table = np.empty((cap, 4), dtype=np.int64)
         n = lib.pftpu_rle_parse_runs_batch(
@@ -498,7 +523,8 @@ def rle_plan5_batch(data, pos, counts, bws, total: int, pad_runs: int):
     counts = np.ascontiguousarray(counts, dtype=np.int64)
     bws = np.ascontiguousarray(bws, dtype=np.int64)
     ll = ctypes.POINTER(ctypes.c_longlong)
-    plan = np.empty(5 * pad_runs, dtype=np.int32)
+    pad = checked_alloc_size(pad_runs, "RLE plan pad rows")
+    plan = np.empty(5 * pad, dtype=np.int32)
     needed = ctypes.c_longlong(0)
     n = lib.pftpu_rle_plan5_batch(
         arr.ctypes.data, len(arr), len(pos),

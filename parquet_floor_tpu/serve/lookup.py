@@ -149,20 +149,38 @@ class Dataset:
             lf = self._files.get(i)
             if lf is not None:
                 return lf
-            source = self._resolve(self._sources[i])
-            try:
-                meta = self.cache.get_footer(source.key)
-                reader = ParquetFileReader(
-                    source, options=self._options, metadata=meta
-                )
-                if meta is None:
-                    self.cache.put_footer(source.key, reader.metadata)
-                self._pin_metadata(source, reader)
-            except BaseException:
-                source.close()
-                raise
-            lf = self._files[i] = _LookupFile(source, reader)
-            return lf
+        # the open runs OUTSIDE the dataset-wide lock (FL-LOCK002): it
+        # is real storage I/O — footer read, page-index/bloom/dict-page
+        # pinning — and holding _open_lock through it would stall every
+        # OTHER file's first probe behind this file's cold open.  Racing
+        # opens of the same index are tolerated instead: both pay the
+        # open (the shared cache de-duplicates the storage reads), the
+        # loser closes its duplicate below.
+        source = self._resolve(self._sources[i])
+        try:
+            meta = self.cache.get_footer(source.key)
+            reader = ParquetFileReader(
+                source, options=self._options, metadata=meta
+            )
+            if meta is None:
+                self.cache.put_footer(source.key, reader.metadata)
+            self._pin_metadata(source, reader)
+        except BaseException:
+            source.close()
+            raise
+        lf = _LookupFile(source, reader)
+        with self._open_lock:
+            if not self._closed and self._files.get(i) is None:
+                self._files[i] = lf
+                return lf
+            existing = self._files.get(i)
+            closed = self._closed
+        # lost the race, or the dataset closed underneath the open:
+        # release our duplicate (reader.close() closes the source chain)
+        reader.close()
+        if closed:
+            raise ValueError("Dataset is closed")
+        return existing
 
     def _pin_metadata(self, source: CachedSource,
                       reader: ParquetFileReader) -> None:

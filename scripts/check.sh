@@ -1,12 +1,16 @@
 #!/usr/bin/env bash
 # The whole commit gate in one entry point:
-#   1. style lint + floorlint (scripts/lint.py runs both)
+#   1. style lint + floorlint (scripts/lint.py runs both; floorlint's
+#      project pass prints its wall time and FAILS over its budget —
+#      PFTPU_FLOORLINT_BUDGET_S, default 30 s — so a quadratic
+#      regression in the call-graph engine breaks this gate, not the
+#      commit loop's patience)
 #   2. tier-1 pytest (the ROADMAP.md verify recipe)
 # Usage: scripts/check.sh [extra pytest args]
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== lint + floorlint =="
+echo "== lint + floorlint (timed) =="
 python scripts/lint.py || exit 1
 
 echo "== tier-1 pytest =="

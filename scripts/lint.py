@@ -16,16 +16,24 @@ invariants are one gate: ``python scripts/lint.py`` fails if either does.
 from __future__ import annotations
 
 import ast
+import os
 import pathlib
 import shutil
 import subprocess
 import sys
+import time
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 TARGETS = ["parquet_floor_tpu", "tests", "benchmarks", "scripts",
            "bench.py", "__graft_entry__.py"]
 FLOORLINT_TARGETS = ["parquet_floor_tpu", "tests", "scripts"]
 MAX_LINE = 100
+# wall-clock ceiling for the floorlint project pass (override:
+# PFTPU_FLOORLINT_BUDGET_S).  The whole-package symbol-table + call
+# graph build is linear by construction; this gate catches a quadratic
+# regression (an uncached per-rule re-walk, an unbounded traversal)
+# before it rots the commit loop.  ~5 s on the dev container today.
+FLOORLINT_BUDGET_S = float(os.environ.get("PFTPU_FLOORLINT_BUDGET_S", "30"))
 
 
 def python_files():
@@ -124,12 +132,24 @@ def run_builtin() -> int:
 
 def run_floorlint() -> int:
     """The invariant analyzer rides the same gate (its own CLI for use in
-    editors: ``python -m parquet_floor_tpu.analysis --list-rules``)."""
-    return subprocess.call(
+    editors: ``python -m parquet_floor_tpu.analysis --list-rules``).
+    Prints the pass's wall time and fails when it blows the budget —
+    findings and runtime are both part of the contract."""
+    t0 = time.perf_counter()
+    rc = subprocess.call(
         [sys.executable, "-m", "parquet_floor_tpu.analysis",
          *FLOORLINT_TARGETS],
         cwd=ROOT,
     )
+    wall = time.perf_counter() - t0
+    print(f"floorlint wall time: {wall:.2f}s "
+          f"(budget {FLOORLINT_BUDGET_S:.0f}s)")
+    if wall > FLOORLINT_BUDGET_S:
+        print("floorlint EXCEEDED its time budget — the project pass has "
+              "regressed (uncached re-walk? unbounded traversal?); "
+              "profile before raising PFTPU_FLOORLINT_BUDGET_S")
+        return 1
+    return rc
 
 
 if __name__ == "__main__":

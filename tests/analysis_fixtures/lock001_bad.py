@@ -1,0 +1,25 @@
+# floorlint: scope=FL-LOCK
+"""Seeded-bad: bare acquires whose release an exception can skip — the
+raise between acquire() and release() wedges the lock for every later
+caller (the serving hazard: one wedged cache lock stalls all tenants)."""
+
+import threading
+
+_lock = threading.Lock()
+
+
+def update(registry, key, value):
+    _lock.acquire()
+    registry[key] = value  # a raise here wedges _lock forever
+    _lock.release()
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self, amount):
+        self._lock.acquire()
+        self.value += amount  # same shape on an attribute lock
+        self._lock.release()

@@ -1,0 +1,30 @@
+# floorlint: scope=FL-LOCK
+"""Clean: with-managed acquires, plus the acquire/finally-release
+spelling for code that cannot use `with` (conditional hold-over)."""
+
+import threading
+
+_lock = threading.Lock()
+
+
+def update(registry, key, value):
+    with _lock:
+        registry[key] = value
+
+
+def update_guarded(registry, key, value):
+    _lock.acquire()
+    try:
+        registry[key] = value
+    finally:
+        _lock.release()
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self, amount):
+        with self._lock:
+            self.value += amount

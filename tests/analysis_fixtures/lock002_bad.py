@@ -1,0 +1,27 @@
+# floorlint: scope=FL-LOCK
+"""Seeded-bad: blocking while a lock is held — directly (file I/O in
+the critical section) and through a helper the project call graph
+resolves (the sleep+storage-read two frames down still stalls every
+waiter of the lock)."""
+
+import threading
+import time
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data = {}
+
+    def refill_direct(self, key, path):
+        with self._lock:
+            with open(path, "rb") as fh:  # host I/O under the lock
+                self._data[key] = fh.read()
+
+    def refill_chained(self, key, source):
+        with self._lock:
+            self._data[key] = self._fetch(source)  # blocks via the chain
+
+    def _fetch(self, source):
+        time.sleep(0.05)  # backoff: every waiter of _lock pays it
+        return source.read_at(0, 16)

@@ -1,0 +1,33 @@
+# floorlint: scope=FL-LOCK
+"""Clean: the blessed single-flight spelling (serve/cache.py's shape) —
+classify under the lock, do the blocking work AFTER releasing it.  The
+leader reads outside the critical section; followers wait on the Event
+they were handed under the lock, not on the lock itself."""
+
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data = {}
+        self._flights = {}
+
+    def fetch(self, key, read_fn):
+        with self._lock:
+            if key in self._data:
+                return self._data[key]
+            flight = self._flights.get(key)
+            leader = flight is None
+            if leader:
+                flight = self._flights[key] = threading.Event()
+        if not leader:
+            flight.wait()  # outside the lock: followers block on the
+            with self._lock:  # flight, never on the cache lock
+                return self._data[key]
+        data = read_fn()  # the blocking read, after release
+        with self._lock:
+            self._data[key] = data
+            self._flights.pop(key, None)
+        flight.set()
+        return data

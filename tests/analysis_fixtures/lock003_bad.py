@@ -1,0 +1,23 @@
+# floorlint: scope=FL-LOCK
+"""Seeded-bad: Condition.wait() guarded by `if` — a spurious wakeup (or
+a predicate re-falsified between notify and wakeup) sails straight
+through the gate with the predicate still false."""
+
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            if not self._ready:  # one wakeup == one check: unsound
+                self._cv.wait()
+            return self._ready
+
+    def set_ready(self):
+        with self._cv:
+            self._ready = True
+            self._cv.notify_all()

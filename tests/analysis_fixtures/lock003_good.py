@@ -1,0 +1,22 @@
+# floorlint: scope=FL-LOCK
+"""Clean: the while-predicate loop (the serve/tenancy.py WFQ gate's
+shape) — every wakeup re-checks the predicate before proceeding."""
+
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait()
+            return self._ready
+
+    def set_ready(self):
+        with self._cv:
+            self._ready = True
+            self._cv.notify_all()

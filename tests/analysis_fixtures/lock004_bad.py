@@ -1,0 +1,30 @@
+# floorlint: scope=FL-LOCK
+"""Seeded-bad: inconsistent lock-acquisition order — `debit` nests
+accounts→audit lexically while `credit` reaches audit→accounts through
+a helper call.  Two threads running one of each deadlock: each holds
+the lock the other needs."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._audit = threading.Lock()
+        self.balance = {}
+        self.log = []
+
+    def debit(self, key, n):
+        with self._accounts:
+            with self._audit:  # order: accounts -> audit
+                self.log.append((key, -n))
+                self.balance[key] = self.balance.get(key, 0) - n
+
+    def credit(self, key, n):
+        with self._audit:  # order: audit -> accounts, via the helper
+            self._locked_credit(key, n)
+
+    def _locked_credit(self, key, n):
+        with self._accounts:
+            self.log.append((key, n))
+            self.balance[key] = self.balance.get(key, 0) + n

@@ -1,0 +1,29 @@
+# floorlint: scope=FL-LOCK
+"""Clean: both paths acquire in the same accounts→audit order (one
+project-wide order is the whole discipline — which order is chosen
+does not matter, only that every chain agrees)."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._audit = threading.Lock()
+        self.balance = {}
+        self.log = []
+
+    def debit(self, key, n):
+        with self._accounts:
+            with self._audit:
+                self.log.append((key, -n))
+                self.balance[key] = self.balance.get(key, 0) - n
+
+    def credit(self, key, n):
+        with self._accounts:  # same order as debit, helper included
+            self._locked_credit(key, n)
+
+    def _locked_credit(self, key, n):
+        with self._audit:
+            self.log.append((key, n))
+            self.balance[key] = self.balance.get(key, 0) + n

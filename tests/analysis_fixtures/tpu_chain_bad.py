@@ -1,0 +1,27 @@
+# floorlint: scope=FL-TPU
+"""Seeded-bad: host work hidden in helpers the project call graph
+resolves from a jitted function — one through a plain call, one through
+a ``functools.partial`` hop two levels down.  The violation is reported
+AT THE JIT SITE (the call inside the traced function) with the chain."""
+
+from functools import partial
+
+
+def jit(fn):  # stand-in so the fixture parses without jax installed
+    return fn
+
+
+def _read_config(path):
+    with open(path) as fh:  # host I/O: runs once at trace time
+        return int(fh.read())
+
+
+def _limit_for(path):
+    loader = partial(_read_config, path)
+    return loader()
+
+
+@jit
+def decode_step(payload, path):
+    limit = _limit_for(path)  # depth 2, through the partial
+    return payload[:limit]

@@ -1,0 +1,34 @@
+# floorlint: scope=FL-TPU
+"""Clean: helpers reached from the traced function are pure, and host
+work FOUR hops down sits past the bounded traversal (CALL_DEPTH) — the
+depth limit is pinned by this fixture staying clean."""
+
+
+def jit(fn):  # stand-in so the fixture parses without jax installed
+    return fn
+
+
+def _h1(path):
+    return _h2(path)
+
+
+def _h2(path):
+    return _h3(path)
+
+
+def _h3(path):
+    return _h4(path)
+
+
+def _h4(path):
+    with open(path) as fh:  # 4 hops from decode_step: beyond the bound
+        return len(fh.read())
+
+
+def _pure(x):
+    return x + 1
+
+
+@jit
+def decode_step(payload, path):
+    return _pure(payload) + _h1(path)

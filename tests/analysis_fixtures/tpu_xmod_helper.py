@@ -1,0 +1,9 @@
+# floorlint: scope=FL-TPU
+"""Cross-module half B: the helper module.  Clean on its own — nothing
+here is traced; the host I/O only matters when tpu_xmod_jit.py's traced
+function reaches it through the import edge."""
+
+
+def read_limit(path):
+    with open(path) as fh:  # host I/O — fine on the host
+        return int(fh.read())

@@ -1,0 +1,18 @@
+# floorlint: scope=FL-TPU
+"""Cross-module half A: a jitted function calling a helper imported
+from tpu_xmod_helper.py.  Analyzed TOGETHER (one project), the chain
+resolves and FL-TPU001 fires here at the call site; analyzed alone the
+import edge dangles and the file is clean — pinning that chain findings
+need the project pass, not guesswork."""
+
+from .tpu_xmod_helper import read_limit
+
+
+def jit(fn):  # stand-in so the fixture parses without jax installed
+    return fn
+
+
+@jit
+def decode_step(payload, path):
+    limit = read_limit(path)  # cross-module hop
+    return payload[:limit]

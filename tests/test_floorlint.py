@@ -14,6 +14,7 @@ import pytest
 from parquet_floor_tpu.analysis import (
     ALL_RULES,
     analyze_file,
+    iter_python_files,
     load_baseline,
     run,
     write_baseline,
@@ -28,12 +29,18 @@ CASES = [
     ("exc003", "FL-EXC003"),
     ("tpu001", "FL-TPU001"),
     ("tpu002", "FL-TPU002"),
+    ("tpu_chain", "FL-TPU001"),  # call-graph: helper reached from a jit,
+    #                              partial hop; good pins the depth bound
     ("res001", "FL-RES001"),
     ("res001_tpe", "FL-RES001"),  # executor/scan-handle shapes of the rule
     ("res001_remote", "FL-RES001"),  # remote session/pool + factory shapes
     ("res001_serve", "FL-RES001"),  # serving cache/context/dataset shapes
     ("alloc001", "FL-ALLOC001"),
     ("obs001", "FL-OBS001"),
+    ("lock001", "FL-LOCK001"),
+    ("lock002", "FL-LOCK002"),
+    ("lock003", "FL-LOCK003"),
+    ("lock004", "FL-LOCK004"),
 ]
 
 
@@ -76,6 +83,18 @@ def test_fixture_dir_excluded_from_directory_walks():
     only analyzed when named explicitly)."""
     result = run([str(FIXTURES.parent)])
     assert result.ok
+
+
+def test_lint_gate_floorlints_tests_but_skips_fixture_dir():
+    """scripts/lint.py's floorlint stage covers tests/ — and the walk it
+    triggers must skip the deliberately-bad fixture dir (explicit paths
+    only), or the gate would fail on its own seed corpus."""
+    src = (ROOT / "scripts" / "lint.py").read_text()
+    targets = src.split("FLOORLINT_TARGETS")[1].split("]")[0]
+    assert '"tests"' in targets
+    walked = list(iter_python_files([str(ROOT / "tests")]))
+    assert walked, "the tests/ walk found files"
+    assert not any("analysis_fixtures" in str(p) for p in walked)
 
 
 def test_suppression_same_line_and_preceding_line(tmp_path):
@@ -121,6 +140,66 @@ def test_baseline_workflow(tmp_path):
                  "    return open(path).read()\n")
     third = run([str(p)], baseline=load_baseline(baseline_file))
     assert len(third.violations) == 1
+
+
+def test_baseline_span_fingerprint_survives_line_moves_and_rewording(
+        tmp_path):
+    """Fingerprints are ``path:RULE:normalized-span`` — keyed on the
+    violating SOURCE LINE, not the message (rewording a rule's message
+    must not orphan entries: the PR 2 bug) and not the line number
+    (unrelated edits above must not churn the file).  Legacy
+    message-keyed entries still match during the transition."""
+    p = tmp_path / "leak.py"
+    p.write_text("def f(path):\n    return open(path).read()\n")
+    first = run([str(p)])
+    assert not first.ok
+    baseline_file = tmp_path / "fl.baseline"
+    write_baseline(baseline_file, first.violations)
+    text = baseline_file.read_text()
+    assert "return open(path).read()" in text     # span-keyed
+    assert first.violations[0].message not in text  # NOT message-keyed
+
+    # unrelated edit shifts the line: still baselined
+    p.write_text("# unrelated comment\n\n"
+                 "def f(path):\n    return open(path).read()\n")
+    again = run([str(p)], baseline=load_baseline(baseline_file))
+    assert again.ok and again.baselined == 1
+
+    # legacy (message-keyed) entries keep matching
+    legacy = tmp_path / "legacy.baseline"
+    legacy.write_text(first.violations[0].legacy_fingerprint() + "\n")
+    r = run([str(p)], baseline=load_baseline(legacy))
+    assert r.ok and r.baselined == 1
+
+
+def test_cli_update_baseline_rekeys_legacy_entries(tmp_path):
+    """--update-baseline regenerates the file in the span format:
+    violations the old (legacy message-keyed) baseline accepted come
+    back span-keyed; nothing new is silently blessed."""
+    p = tmp_path / "leak.py"
+    p.write_text("def f(path):\n    return open(path).read()\n")
+    first = run([str(p)])
+    bl = tmp_path / "fl.baseline"
+    bl.write_text(first.violations[0].legacy_fingerprint() + "\n")
+    rc = subprocess.call(
+        [sys.executable, "-m", "parquet_floor_tpu.analysis", str(p),
+         "--baseline", str(bl), "--update-baseline"],
+        cwd=str(ROOT), stdout=subprocess.DEVNULL)
+    assert rc == 0  # everything was accepted, nothing new
+    text = bl.read_text()
+    assert "return open(path).read()" in text
+    assert first.violations[0].message not in text
+    r = run([str(p)], baseline=load_baseline(bl))
+    assert r.ok and r.baselined == 1
+
+    # a NEW violation is not blessed by the regeneration: it reports
+    p.write_text("def f(path):\n    return open(path).read()\n"
+                 "def g(path):\n    return open(path).read()\n")
+    rc2 = subprocess.call(
+        [sys.executable, "-m", "parquet_floor_tpu.analysis", str(p),
+         "--baseline", str(bl), "--update-baseline"],
+        cwd=str(ROOT), stdout=subprocess.DEVNULL)
+    assert rc2 == 1
 
 
 def test_checked_in_baseline_is_empty():
@@ -191,6 +270,232 @@ def test_analyze_file_honors_suppressions(tmp_path):
     p.write_text("def f(path):\n"
                  "    return open(path).read()  # floorlint: disable=FL-RES001\n")
     assert analyze_file(p) == []
+
+
+def test_tpu_chain_reports_at_jit_site_with_chain():
+    """The call-graph FL-TPU finding lands at the call site inside the
+    traced function, names the sink helper, and carries the chain —
+    including the functools.partial hop (depth 2)."""
+    vs = analyze_file(FIXTURES / "tpu_chain_bad.py")
+    assert [v.rule for v in vs] == ["FL-TPU001"]
+    v = vs[0]
+    assert "_limit_for(path)" in (FIXTURES / "tpu_chain_bad.py").read_text(
+    ).splitlines()[v.line - 1]
+    assert "_read_config" in v.message and "->" in v.message
+    assert len(v.chain) == 3  # decode_step -> _limit_for -> _read_config
+
+
+def test_tpu_cross_module_needs_the_project_pass():
+    """Analyzed together, the import edge resolves and the jit file is
+    flagged (the helper file stays clean — nothing there is traced);
+    analyzed alone, the edge dangles and the file is clean.  Pins that
+    chain findings come from resolved edges, never guesses."""
+    jit_f = FIXTURES / "tpu_xmod_jit.py"
+    helper = FIXTURES / "tpu_xmod_helper.py"
+    together = run([str(jit_f), str(helper)])
+    assert [v.rule for v in together.violations] == ["FL-TPU001"]
+    v = together.violations[0]
+    assert v.path.endswith("tpu_xmod_jit.py")
+    assert "read_limit" in v.message and "->" in v.message
+    assert analyze_file(jit_f) == []
+    assert analyze_file(helper) == []
+
+
+def test_lock002_chain_reported_at_lock_site():
+    """The chained FL-LOCK002 finding points at the call under the lock
+    and names both the chain and the blocking sink's location."""
+    vs = [v for v in analyze_file(FIXTURES / "lock002_bad.py")
+          if "via" in v.message]
+    assert vs, "expected chained findings"
+    assert any("time.sleep" in v.message for v in vs)
+    assert any(".read_at()" in v.message for v in vs)
+    for v in vs:
+        assert "_fetch" in v.message and "->" in v.message
+
+
+def test_lock003_blessed_wait_is_not_lock002():
+    """Condition.wait on the condition the `with` block holds releases
+    it — the good LOCK003 fixture must not trip FL-LOCK002 either."""
+    assert analyze_file(FIXTURES / "lock003_good.py") == []
+
+
+def test_lock004_both_orders_reported():
+    vs = analyze_file(FIXTURES / "lock004_bad.py")
+    assert [v.rule for v in vs] == ["FL-LOCK004", "FL-LOCK004"]
+    msgs = " | ".join(v.message for v in vs)
+    assert "_accounts" in msgs and "_audit" in msgs
+    assert any("via" in v.message for v in vs)  # the chained direction
+
+
+def test_scope_directive_parity_under_project_pass(tmp_path):
+    """The project pass honors per-file `# floorlint: scope=` and
+    `disable=` directives exactly like the old per-file pass: the same
+    file analyzed alone and inside a multi-file run gets identical
+    verdicts, and a scoped file never leaks its opt-in to siblings."""
+    scoped = tmp_path / "scoped.py"
+    scoped.write_text(
+        "# floorlint: scope=FL-LOCK\n"
+        "import threading\n"
+        "_lock = threading.Lock()\n\n\n"
+        "def f(registry):\n"
+        "    _lock.acquire()\n"
+        "    registry.clear()\n"
+        "    _lock.release()\n"
+    )
+    sibling = tmp_path / "sibling.py"
+    sibling.write_text(  # same shape, NO scope=: out of FL-LOCK scope
+        "import threading\n"
+        "_lock = threading.Lock()\n\n\n"
+        "def f(registry):\n"
+        "    _lock.acquire()\n"
+        "    registry.clear()\n"
+        "    _lock.release()\n"
+    )
+    alone = analyze_file(scoped)
+    project_run = run([str(scoped), str(sibling)])
+    assert [v.rule for v in alone] == ["FL-LOCK001"]
+    assert [v.rule for v in project_run.violations] == ["FL-LOCK001"]
+    assert all("sibling" not in v.path for v in project_run.violations)
+
+    # a line disable suppresses the project-pass verdict identically
+    scoped.write_text(
+        "# floorlint: scope=FL-LOCK\n"
+        "import threading\n"
+        "_lock = threading.Lock()\n\n\n"
+        "def f(registry):\n"
+        "    _lock.acquire()  # floorlint: disable=FL-LOCK001\n"
+        "    registry.clear()\n"
+        "    _lock.release()\n"
+    )
+    assert analyze_file(scoped) == []
+    again = run([str(scoped), str(sibling)])
+    assert again.ok and again.suppressed == 1
+
+
+def test_init_relative_imports_resolve_into_the_package(tmp_path):
+    """An __init__.py's module name IS its package, so `from .core
+    import helper` there must resolve into the package — a chain
+    through an init re-export stays visible to the graph rules."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "core.py").write_text(
+        "def helper(path):\n"
+        "    with open(path) as fh:\n"
+        "        return len(fh.read())\n"
+    )
+    (pkg / "__init__.py").write_text(
+        "# floorlint: scope=FL-TPU\n"
+        "from .core import helper\n\n\n"
+        "def jit(fn):\n"
+        "    return fn\n\n\n"
+        "@jit\n"
+        "def step(payload, path):\n"
+        "    return payload + helper(path)\n"
+    )
+    r = run([str(pkg / "__init__.py"), str(pkg / "core.py")])
+    assert [v.rule for v in r.violations] == ["FL-TPU001"], (
+        [v.render() for v in r.violations]
+    )
+    assert "helper" in r.violations[0].message
+
+
+def test_cyclic_class_bases_do_not_crash(tmp_path):
+    """`class A(B)` / `class B(A)` parses fine (the analyzer is static);
+    lock-attribute inheritance lookup must terminate, not recurse."""
+    p = tmp_path / "cyc.py"
+    p.write_text(
+        "# floorlint: scope=FL-LOCK\n"
+        "import threading\n\n\n"
+        "class A(B):\n"
+        "    def run(self):\n"
+        "        with self._lock:\n"
+        "            pass\n\n\n"
+        "class B(A):\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+    )
+    assert run([str(p)]).ok  # and, crucially, no RecursionError
+
+
+def test_lock002_chained_wait_keeps_callers_lock_flagged(tmp_path):
+    """Moving a cv-wait into a helper must not silence FL-LOCK002: the
+    helper's Condition.wait releases only ITS cv — the caller's
+    distinct lock stays held while the wait blocks."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "# floorlint: scope=FL-LOCK\n"
+        "import threading\n\n\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition()\n"
+        "        self.ready = False\n\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self.helper()\n\n"
+        "    def helper(self):\n"
+        "        with self._cv:\n"
+        "            while not self.ready:\n"
+        "                self._cv.wait()\n"
+    )
+    r = run([str(p)])
+    waits = [v for v in r.violations
+             if v.rule == "FL-LOCK002" and ".wait()" in v.message]
+    assert waits, [v.render() for v in r.violations]
+    assert "_lock" in waits[0].message and "helper" in waits[0].message
+
+
+def test_lock004_multi_item_with_counts_as_nesting(tmp_path):
+    """`with a, b:` is Python-defined as the nested form — its
+    left-to-right order must pair against an explicit b→a nesting."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "# floorlint: scope=FL-LOCK\n"
+        "import threading\n\n\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n\n"
+        "    def one(self):\n"
+        "        with self._a, self._b:\n"
+        "            pass\n\n"
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    r = run([str(p)])
+    assert [v.rule for v in r.violations] == ["FL-LOCK004", "FL-LOCK004"], (
+        [v.render() for v in r.violations]
+    )
+
+
+def test_cli_json_format():
+    """--format=json: one machine-readable document with rule id, path,
+    line, message, and the call chain; exit code matches the text form."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "parquet_floor_tpu.analysis",
+         str(FIXTURES / "tpu_chain_bad.py"), "--no-baseline",
+         "--format=json"],
+        cwd=str(ROOT), text=True, capture_output=True)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is False and doc["files"] == 1
+    (v,) = doc["violations"]
+    assert v["rule"] == "FL-TPU001"
+    assert v["path"].endswith("tpu_chain_bad.py")
+    assert isinstance(v["line"], int) and v["line"] > 0
+    assert len(v["call_chain"]) == 3
+
+    clean = subprocess.run(
+        [sys.executable, "-m", "parquet_floor_tpu.analysis",
+         str(FIXTURES / "lock001_good.py"), "--no-baseline",
+         "--format=json"],
+        cwd=str(ROOT), text=True, capture_output=True)
+    assert clean.returncode == 0
+    assert json.loads(clean.stdout)["ok"] is True
 
 
 def test_exc001_nested_handler_raise_does_not_shadow(tmp_path):

@@ -294,6 +294,161 @@ def scan_leg(n_rows: int, reps: int) -> dict:
     }
 
 
+def _pushdown_paths(n_rows: int, n_files: int = 4):
+    """The pushdown leg's dataset: 4 pyarrow-written files (a FOREIGN
+    writer — the differential claim is against pyarrow end to end), 2
+    row groups each; ``k`` uniform in [0, 1e6) so ``k < 10_000`` is a
+    ~1% filter, ``cat`` dictionary-encoded (8 keys) for the group-by."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    per = max(n_rows // n_files, 2000)
+    cats = ["alpha", "beta", "gamma", "delta", "eps", "zeta", "eta", "theta"]
+    paths = []
+    for i in range(n_files):
+        p = os.path.join("/tmp", f"pftpu_bench_push_{per}_{i}.parquet")
+        if not os.path.exists(p):
+            rng = np.random.default_rng(100 + i)
+            t = pa.table({
+                "k": rng.integers(0, 1_000_000, per).astype(np.int64),
+                "v": rng.integers(0, 1_000, per).astype(np.int64),
+                "cat": [cats[j] for j in rng.integers(0, len(cats), per)],
+            })
+            pq.write_table(
+                t, p, row_group_size=per // 2, use_dictionary=["cat"],
+                compression="NONE", data_page_size=1 << 20,
+            )
+        paths.append(p)
+    return paths
+
+
+def pushdown_leg(n_rows: int) -> dict:
+    """Device pushdown compute (docs/pushdown.md), asserted by
+    ``check_bench_report.check_pushdown_leg``:
+
+    * a SELECTIVE (~1%) filter scan over the 4-file dataset ships
+      device-COMPACTED rows — D2H bytes must be ≤ 0.1x the same scan's
+      ship-columns baseline, with the one-launch contract intact
+      (``engine.launches == groups``, zero capacity overflows) and the
+      surviving rows bit-identical to ``pyarrow.compute``'s filter;
+    * a group-by aggregate ships tiny per-group partial states
+      (O(dictionary) D2H) whose combined result is bit-equal to
+      pyarrow's ``group_by().aggregate``.
+    """
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    import pyarrow.parquet as pq
+
+    from parquet_floor_tpu.batch.aggregate import Aggregate
+    from parquet_floor_tpu.batch.predicate import col
+    from parquet_floor_tpu.scan import (
+        ScanOptions,
+        scan_aggregate,
+        scan_device_groups,
+    )
+    from parquet_floor_tpu.utils import trace
+
+    paths = _pushdown_paths(n_rows)
+    threads = min(4, os.cpu_count() or 1)
+    pred = col("k") < 10_000
+    columns = ["k", "v"]
+
+    # --- ship-columns baseline: full decode, full D2H ---------------------
+    baseline_bytes = 0
+    base_groups = 0
+    base_rows = 0
+    for _fi, _gi, cols in scan_device_groups(
+        paths, columns=columns, scan=ScanOptions(threads=threads),
+        float64_policy="bits",
+    ):
+        for c in cols.values():
+            baseline_bytes += np.asarray(c.values).nbytes
+            if c.mask is not None:
+                baseline_bytes += np.asarray(c.mask).nbytes
+        base_groups += 1
+        base_rows += int(next(iter(cols.values())).values.shape[0])
+
+    # --- pushdown filter scan: compacted D2H ------------------------------
+    sc = ScanOptions(threads=threads, pushdown=True)
+    got_k = []
+    got_v = []
+    push_bytes = 0
+    with trace.scope() as t:
+        groups = 0
+        for _fi, _gi, cols in scan_device_groups(
+            paths, columns=columns, scan=sc, predicate=pred,
+            float64_policy="bits",
+        ):
+            ka = np.asarray(cols["k"].values)
+            va = np.asarray(cols["v"].values)
+            push_bytes += ka.nbytes + va.nbytes
+            got_k.append(ka)
+            got_v.append(va)
+            groups += 1
+    counters = t.counters()
+    # the engine fetches one int64 selected-count per group (that small
+    # sync IS part of the pushdown D2H story — charge it)
+    push_bytes += 8 * counters.get("engine.pushdown_groups", groups)
+    got_k = np.concatenate(got_k) if got_k else np.zeros(0, np.int64)
+    got_v = np.concatenate(got_v) if got_v else np.zeros(0, np.int64)
+
+    table = pa.concat_tables([pq.read_table(p) for p in paths])
+    want = table.filter(pc.less(table["k"], 10_000))
+    filter_exact = bool(
+        np.array_equal(got_k, want["k"].to_numpy())
+        and np.array_equal(got_v, want["v"].to_numpy())
+    )
+
+    # --- group-by aggregate: O(groups) D2H --------------------------------
+    agg = Aggregate(
+        (("v", "sum"), ("v", "min"), ("v", "max"), ("v", "count")),
+        group_by="cat",
+    )
+    with trace.scope() as ta:
+        part = scan_aggregate(
+            paths, agg, predicate=pred,
+            scan=ScanOptions(threads=threads), engine="tpu",
+        )
+    fin = part.finalize()
+    gb = want.group_by("cat").aggregate(
+        [("v", "sum"), ("v", "min"), ("v", "max"), ("v", "count")]
+    ).to_pydict()
+    agg_exact = len(fin) == len(gb["cat"])
+    for i, key in enumerate(gb["cat"]):
+        ours = fin.get(key.encode())
+        if ours is None or ours["v_sum"] != gb["v_sum"][i] or \
+                ours["v_min"] != gb["v_min"][i] or \
+                ours["v_max"] != gb["v_max"][i] or \
+                ours["v_count"] != gb["v_count"][i]:
+            agg_exact = False
+    # partial states: (1 rows + 4 nv + 3 value arrays) x (gcap+1) slots
+    # of 8-byte lanes per group, plus the count scalar — the worst-case
+    # D2H charge of the aggregate scan
+    gcap = 16 + 1  # 8 keys bucket to 16; +1 null slot
+    agg_groups = ta.counters().get("engine.pushdown_groups", base_groups)
+    agg_bytes = agg_groups * (8 * gcap * 8 + 8)
+
+    return {
+        "pushdown_groups": groups,
+        "pushdown_rows_in": base_rows,
+        "pushdown_rows_selected": int(got_k.size),
+        "pushdown_launches": counters.get("engine.launches", 0),
+        "pushdown_overflows": counters.get("engine.pushdown_overflows", 0),
+        "pushdown_rows_filtered_device": counters.get(
+            "scan.rows_filtered_device", 0
+        ),
+        "pushdown_d2h_bytes": int(push_bytes),
+        "pushdown_baseline_d2h_bytes": int(baseline_bytes),
+        "pushdown_d2h_ratio": round(push_bytes / max(baseline_bytes, 1), 4),
+        "pushdown_filter_exact": filter_exact,
+        "pushdown_agg_exact": bool(agg_exact),
+        "pushdown_agg_d2h_bytes": int(agg_bytes),
+        "pushdown_agg_groups": len(fin),
+    }
+
+
 def exec_cache_leg(n_rows: int) -> dict:
     """Cold-vs-warm start on the persistent AOT executable cache
     (docs/perf.md): two FRESH subprocesses decode the same file's group
@@ -1101,6 +1256,10 @@ def main():
     # exec-cache cold/warm leg (docs/perf.md): runs in SUBPROCESSES
     # (fresh jax each), so its placement among the timed legs is free
     exec_cache_detail = exec_cache_leg(n_rows)
+    # device pushdown leg (docs/pushdown.md): D2H-heavy by design (the
+    # whole point is measuring shipped bytes), so it runs with the
+    # post-timing D2H checks
+    pushdown_detail = pushdown_leg(n_rows)
     # the loader's multiset-exactness check fetches device arrays: after
     # every timed section (the first D2H degrades tunnelled links
     # process-wide), alongside the scan leg's own D2H check
@@ -1147,6 +1306,7 @@ def main():
             **remote_detail,
             **serving_detail,
             **exec_cache_detail,
+            **pushdown_detail,
             **loader_detail,
         },
     }

@@ -49,12 +49,14 @@ from .api.reader import ParquetReader
 from .api.writer import ParquetWriter
 from .batch.columns import BatchColumn, batch_to_arrow
 from .batch.nested import NestedColumn, assemble_nested, shred_nested
+from .batch.aggregate import Aggregate
 from .batch.predicate import Predicate, col
 from .utils import trace
 
 from ._version import __version__  # noqa: F401  (re-export)
 
 __all__ = [
+    "Aggregate",
     "BatchColumn", "BatchHydrator", "BatchHydratorSupplier",
     "BreakerOpenError",
     "ChecksumMismatchError", "ColumnData",

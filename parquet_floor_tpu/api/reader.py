@@ -876,6 +876,16 @@ class ParquetReader:
         draining at each file's end.  Salvage is rejected under scan
         (same ``UnsupportedFeatureError`` contract as the TPU engine).
 
+        With ``scan_options=ScanOptions(pushdown=True)`` and a
+        ``predicate`` on ``engine="tpu"``, the predicate additionally
+        evaluates INSIDE each group's fused decode executable and the
+        yielded device batches carry only the surviving rows —
+        device-compacted, so D2H (when the plugin takes one) ships
+        results, not columns (``docs/pushdown.md``).  Batch row counts
+        then vary per group.  ``ScanOptions.aggregate`` does not stream
+        batches at all — use ``scan.scan_aggregate`` for aggregate
+        queries.
+
         For TRAINING consumption — seeded shuffling, exact-size epoch
         batches, host sharding, and mid-epoch checkpoint/resume — use
         ``parquet_floor_tpu.data.DataLoader`` (``docs/data.md``) instead
@@ -884,6 +894,21 @@ class ParquetReader:
         if engine not in ("host", "tpu", "auto"):
             raise ValueError(f"bad engine {engine!r}: expected host|tpu|auto")
         if scan_options is not None:
+            if getattr(scan_options, "aggregate", None) is not None:
+                raise ValueError(
+                    "ScanOptions.aggregate yields partial states, not "
+                    "batches — use scan.scan_aggregate for aggregate "
+                    "queries"
+                )
+            if getattr(scan_options, "pushdown", False) and \
+                    predicate is not None and engine != "tpu":
+                from ..errors import UnsupportedFeatureError
+
+                raise UnsupportedFeatureError(
+                    "ScanOptions.pushdown is the DEVICE scan leg's "
+                    "feature (docs/pushdown.md): pass engine='tpu', or "
+                    "drop pushdown= for a host scan"
+                )
             sources = (
                 list(source) if isinstance(source, (list, tuple)) else [source]
             )

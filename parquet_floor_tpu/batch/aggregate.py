@@ -1,0 +1,267 @@
+"""Partial aggregates — the host half of aggregate pushdown.
+
+An :class:`Aggregate` names what to compute — ``count``/``sum``/``min``/
+``max`` per column, optionally grouped by one (dictionary-encoded)
+column.  Each row group produces one :class:`AggPartial` — a tiny
+per-group state (O(groups) values, not O(rows)) — and
+:meth:`AggPartial.combine` folds partials across row groups and files
+into the final answer.  The partials are face-agnostic: the device
+compute tail (``tpu.compute``), the host scan leg, and the serving
+lookup face all emit the same state, so a scan can mix device-computed
+and host-fallback groups freely.
+
+Semantics match ``pyarrow.compute`` (pinned by the differential suite):
+
+* ``count`` counts non-null values (NaN counts);
+* ``sum`` accumulates int32→int64, int64→int64 (wraparound), floats in
+  float64 (float32 sums return double, exactly as pyarrow's ``sum``);
+  NaN propagates;
+* ``min``/``max`` skip NaN; a group with values but only NaN yields
+  ``inf``/``-inf`` (pyarrow's ``min_max``); a group with zero non-null
+  values yields None;
+* with ``group_by``, rows whose group key is null fold into a ``None``
+  key group (pyarrow's ``group_by`` null group), and keys that appear
+  only in filtered-out rows do not appear at all.
+
+Float sums are order-sensitive in IEEE arithmetic; partials accumulate
+in float64 in row order per group, so host and device agree bit-exactly
+whenever the data's sums are exactly representable (integers-as-floats
+— the differential suite's shape) and to rounding otherwise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_OPS = ("count", "sum", "min", "max")
+
+# the single-bucket key of an ungrouped aggregate (never a real group
+# key: dictionary keys are bytes/numbers/None)
+ALL = "__all__"
+
+_ACC_DTYPE = {
+    "int32": np.int64,
+    "int64": np.int64,
+    "float32": np.float64,
+    "float64": np.float64,
+}
+
+
+def neutral_min(dtype) -> object:
+    dt = np.dtype(dtype)
+    return np.inf if dt.kind == "f" else np.iinfo(dt).max
+
+
+def neutral_max(dtype) -> object:
+    dt = np.dtype(dtype)
+    return -np.inf if dt.kind == "f" else np.iinfo(dt).min
+
+
+@dataclass(frozen=True)
+class Aggregate:
+    """An aggregate request: ``aggs`` is a tuple of ``(column, op)``
+    pairs (op in ``count``/``sum``/``min``/``max``), ``group_by``
+    optionally names the grouping column.  Hashable, so it can ride jit
+    static arguments (part of the fused executable's cache key)."""
+
+    aggs: Tuple[Tuple[str, str], ...]
+    group_by: Optional[str] = None
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "aggs", tuple((str(c), str(o)) for c, o in self.aggs)
+        )
+        if not self.aggs:
+            raise ValueError("Aggregate needs at least one (column, op)")
+        for c, o in self.aggs:
+            if o not in _OPS:
+                raise ValueError(
+                    f"unknown aggregate op {o!r} on {c!r} (use one of "
+                    f"{', '.join(_OPS)})"
+                )
+
+    def columns(self) -> set:
+        out = {c for c, _ in self.aggs}
+        if self.group_by is not None:
+            out.add(self.group_by)
+        return out
+
+
+class AggPartial:
+    """Partial aggregate state of one row group — or a fold of several.
+
+    ``groups`` maps a group key (bytes / number / None for the null
+    group; :data:`ALL` when ungrouped) to ``[rows, states]`` where
+    ``rows`` counts selected rows and ``states`` holds one
+    ``[n_valid, value]`` pair per ``Aggregate.aggs`` entry (``value`` is
+    the running sum / min / max in the op's accumulator dtype; neutral
+    until a valid value lands)."""
+
+    __slots__ = ("spec", "groups")
+
+    def __init__(self, spec: Aggregate):
+        self.spec = spec
+        self.groups: Dict[object, list] = {}
+
+    # -- accumulation --------------------------------------------------------
+
+    def _bucket(self, key) -> list:
+        b = self.groups.get(key)
+        if b is None:
+            b = [0, [[0, None] for _ in self.spec.aggs]]
+            self.groups[key] = b
+        return b
+
+    def add_rows(self, key, rows: int) -> None:
+        self._bucket(key)[0] += int(rows)
+
+    def add_state(self, key, agg_index: int, n_valid: int, value) -> None:
+        """Fold one op's ``(n_valid, value)`` into the bucket (value in
+        accumulator dtype; None when the op is ``count`` or when no
+        valid value contributed)."""
+        st = self._bucket(key)[1][agg_index]
+        st[0] += int(n_valid)
+        if value is None:
+            return
+        op = self.spec.aggs[agg_index][1]
+        if st[1] is None:
+            st[1] = value
+        elif op == "sum":
+            st[1] = st[1] + value  # numpy scalar add: wraparound for ints
+        elif op == "min":
+            st[1] = min(st[1], value)
+        elif op == "max":
+            st[1] = max(st[1], value)
+
+    # -- the combine protocol ------------------------------------------------
+
+    def combine(self, other: "AggPartial") -> "AggPartial":
+        """Fold ``other`` into self (associative; group keys union)."""
+        if other.spec != self.spec:
+            raise ValueError("cannot combine partials of different specs")
+        for key, (rows, states) in other.groups.items():
+            self.add_rows(key, rows)
+            for i, (nv, val) in enumerate(states):
+                self.add_state(key, i, nv, val)
+        return self
+
+    @classmethod
+    def merge(cls, spec: Aggregate, partials) -> "AggPartial":
+        out = cls(spec)
+        for p in partials:
+            out.combine(p)
+        return out
+
+    # -- results -------------------------------------------------------------
+
+    def finalize(self) -> dict:
+        """The answer: ungrouped → ``{"col_op": value}``; grouped →
+        ``{key: {"col_op": value}}`` (key None = the null group).  Ops
+        with zero valid values yield None (count yields 0); sums and
+        min/max convert to plain Python scalars."""
+        def fin(states) -> dict:
+            out = {}
+            for (c, o), (nv, val) in zip(self.spec.aggs, states):
+                name = f"{c}_{o}"
+                if o == "count":
+                    out[name] = int(nv)
+                elif nv == 0:
+                    out[name] = None
+                else:
+                    out[name] = None if val is None else np.asarray(val).item()
+            return out
+
+        if self.spec.group_by is None:
+            _, states = self.groups.get(ALL, [0, [[0, None] for _ in self.spec.aggs]])
+            return fin(states)
+        return {
+            key: fin(states)
+            for key, (rows, states) in self.groups.items()
+            if rows > 0
+        }
+
+    @property
+    def rows(self) -> int:
+        """Selected rows folded into this partial (all groups)."""
+        return sum(rows for rows, _ in self.groups.values())
+
+
+def _valid_state(op: str, vals: np.ndarray, present: np.ndarray):
+    """One op's ``(n_valid, value)`` over the present values."""
+    pv = vals[present]
+    nv = int(pv.size)
+    if op == "count":
+        return nv, None
+    dt = vals.dtype
+    if op == "sum":
+        acc = _ACC_DTYPE[dt.name]
+        return nv, (None if nv == 0 else np.sum(pv.astype(acc), dtype=acc))
+    # min/max skip NaN (pyarrow min_max); all-NaN yields the neutral
+    if dt.kind == "f":
+        pv = pv[~np.isnan(pv)]
+    if nv == 0:
+        return 0, None
+    if pv.size == 0:
+        return nv, np.asarray(neutral_min(dt) if op == "min" else neutral_max(dt), dt)
+    return nv, (np.min(pv) if op == "min" else np.max(pv))
+
+
+def host_partial(spec: Aggregate, resolve, n: int,
+                 sel: Optional[np.ndarray] = None) -> AggPartial:
+    """Compute one row group's :class:`AggPartial` on host.
+
+    ``resolve(name)`` returns ``(values, null_mask)`` — numeric NumPy
+    arrays, or object arrays of ``bytes`` for string group keys;
+    ``sel`` restricts to the selected rows (a predicate's mask)."""
+    out = AggPartial(spec)
+    idx = np.arange(n) if sel is None else np.flatnonzero(np.asarray(sel, bool))
+    cols = {}
+    for c in spec.columns():
+        vals, mask = resolve(c)
+        vals = np.asarray(vals)
+        present = (
+            np.ones(n, bool) if mask is None else ~np.asarray(mask, bool)
+        )
+        cols[c] = (vals[idx], present[idx])
+    if spec.group_by is None:
+        out.add_rows(ALL, idx.size)
+        for i, (c, o) in enumerate(spec.aggs):
+            vals, present = cols[c]
+            nv, val = _valid_state(o, vals, present)
+            out.add_state(ALL, i, nv, val)
+        return out
+    gvals, gpresent = cols[spec.group_by]
+    # one bucket per distinct present key, plus the null group
+    for key_rows in _group_rows(gvals, gpresent):
+        key, rows = key_rows
+        out.add_rows(key, rows.size)
+        for i, (c, o) in enumerate(spec.aggs):
+            vals, present = cols[c]
+            nv, val = _valid_state(o, vals[rows], present[rows])
+            out.add_state(key, i, nv, val)
+    return out
+
+
+def _group_rows(gvals: np.ndarray, gpresent: np.ndarray):
+    """Yield ``(key, row_indices)`` per distinct group key (None = the
+    null group), in first-appearance order."""
+    null_rows = np.flatnonzero(~gpresent)
+    if null_rows.size:
+        yield None, null_rows
+    live = np.flatnonzero(gpresent)
+    if not live.size:
+        return
+    pv = gvals[live]
+    if pv.dtype == object:
+        seen: Dict[object, list] = {}
+        for i, v in zip(live, pv):
+            seen.setdefault(v, []).append(i)
+        for key, rows in seen.items():
+            yield key, np.asarray(rows)
+        return
+    uniq, inv = np.unique(pv, return_inverse=True)
+    for j, u in enumerate(uniq):
+        yield u.item(), live[inv == j]

@@ -82,9 +82,11 @@ def _chunk_stats(rg, name: str) -> Optional[_ChunkStats]:
 
 
 def _coerce(value, other):
-    """Make a user literal comparable with a decoded stat (str → bytes)."""
+    """Make a user literal comparable with a decoded stat (str → bytes;
+    surrogateescape so a key round-tripped from a non-UTF8 row cell
+    compares against its original bytes instead of raising)."""
     if isinstance(value, str) and isinstance(other, bytes):
-        return value.encode("utf-8")
+        return value.encode("utf-8", "surrogateescape")
     return value
 
 
@@ -389,6 +391,116 @@ class _IsNull(Predicate):
             if keep:
                 out.append((a, b))
         return out
+
+
+# ---------------------------------------------------------------------------
+# Predicate export + vectorized evaluation (the pushdown compilers' input)
+# ---------------------------------------------------------------------------
+
+def tree(p: Predicate) -> tuple:
+    """Export a predicate as a static nested tuple — the ONE structural
+    form both pushdown compilers consume (the device compute tail in
+    ``tpu.compute`` and the host :func:`eval_mask` below), so filter
+    semantics cannot fork between faces:
+
+    * ``("and", a, b)`` / ``("or", a, b)``
+    * ``("cmp", name, op, value)`` — ``op`` in ``== != < <= > >=``;
+      string literals normalize to UTF-8 bytes
+    * ``("isnull", name, want_null)``
+
+    The tuple is hashable (literals are numbers/bytes), so it can ride a
+    jit static argument — which is how a predicate becomes part of a
+    fused executable's cache key.  Raises ``TypeError`` on predicates
+    that cannot export (unhashable literals, foreign subclasses)."""
+    if isinstance(p, _And):
+        return ("and", tree(p.a), tree(p.b))
+    if isinstance(p, _Or):
+        return ("or", tree(p.a), tree(p.b))
+    if isinstance(p, _Cmp):
+        v = p.value
+        if isinstance(v, str):
+            # surrogateescape: a key round-tripped from a row cell (the
+            # cursor stringifies non-UTF8 binary that way) must compare
+            # against its original bytes, not raise
+            v = v.encode("utf-8", "surrogateescape")
+        if not isinstance(v, (bool, int, float, bytes)):
+            raise TypeError(
+                f"predicate literal {v!r} on {p.name!r} is not a "
+                "number/bool/string/bytes — cannot export for pushdown"
+            )
+        return ("cmp", p.name, p.op, v)
+    if isinstance(p, _IsNull):
+        return ("isnull", p.name, p.want_null)
+    raise TypeError(
+        f"cannot export predicate node {type(p).__name__} for pushdown"
+    )
+
+
+def tree_columns(t: tuple):
+    """The set of column names a :func:`tree` references."""
+    if t[0] in ("and", "or"):
+        return tree_columns(t[1]) | tree_columns(t[2])
+    return {t[1]}
+
+
+def _cmp_arrays(vals, op: str, v):
+    if op == "==":
+        return vals == v
+    if op == "!=":
+        return vals != v
+    if op == "<":
+        return vals < v
+    if op == "<=":
+        return vals <= v
+    if op == ">":
+        return vals > v
+    return vals >= v
+
+
+def eval_mask(p: Predicate, resolve, n: int) -> np.ndarray:
+    """Row-exact vectorized evaluation of ``p`` over decoded columns.
+
+    ``resolve(name)`` returns ``(values, null_mask)`` — ``values`` a
+    NumPy array (numerics/bools) or an object array of ``bytes``
+    (strings); ``null_mask`` is a bool array (True = null) or None for
+    required columns.  Semantics are SQL-ish three-valued collapsed to
+    selection: any comparison against a null cell is False (pyarrow's
+    ``filter`` drop behavior), NaN follows IEEE (every ordered
+    comparison False, ``!=`` True), ``is_null``/``is_not_null`` read
+    the mask directly.  This is the host twin of the device compute
+    tail — the lookup face's exact-match filter and the differential
+    tests both ride it."""
+    return _eval_tree(tree(p), resolve, n)
+
+
+def _eval_tree(t: tuple, resolve, n: int) -> np.ndarray:
+    kind = t[0]
+    if kind == "and":
+        return _eval_tree(t[1], resolve, n) & _eval_tree(t[2], resolve, n)
+    if kind == "or":
+        return _eval_tree(t[1], resolve, n) | _eval_tree(t[2], resolve, n)
+    if kind == "isnull":
+        _vals, mask = resolve(t[1])
+        m = (
+            np.zeros(n, bool) if mask is None
+            else np.asarray(mask, dtype=bool)
+        )
+        return m if t[2] else ~m
+    _, name, op, v = t
+    vals, mask = resolve(name)
+    vals = np.asarray(vals)
+    if vals.dtype == object and isinstance(v, str):
+        v = v.encode("utf-8", "surrogateescape")
+    try:
+        out = np.asarray(_cmp_arrays(vals, op, v), dtype=bool)
+    except TypeError:
+        # incomparable literal/column pairing: nothing matches
+        out = np.zeros(n, bool)
+    if out.shape != (n,):  # a scalar False from an object-array compare
+        out = np.broadcast_to(out, (n,)).copy()
+    if mask is not None:
+        out &= ~np.asarray(mask, dtype=bool)
+    return out
 
 
 class Col:

@@ -170,12 +170,69 @@ class RowBuffer:
         return (v, m, ln)
 
 
-# jit cache for fused_assemble, keyed by the batch's static structure
-# (piece sizes/dtypes, widths, pad, split).  Group-aligned batch sizes
-# keep the signature set tiny; misaligned ones cycle through more
-# shapes, so the cache is bounded like api.reader's _PACK_CACHE.
-_FUSE_CACHE: dict = {}
-_SPLIT_CACHE: dict = {}
+# The batch-shaping jits dispatch through tpu.exec_cache: their static
+# structure (piece layout, widths, pad, split) plus input avals key the
+# PERSISTENT executable cache, so a warm process stops recompiling its
+# batch shapes (docs/perf.md — the PR 8 follow-on).  Group-aligned
+# batch sizes keep the signature set tiny; misaligned ones cycle
+# through more shapes (each a one-time compile per toolchain, exactly
+# like the fused decode programs).
+
+
+def _jit_split(strct: tuple, kk: int, *arrs):
+    import jax.numpy as jnp
+    from jax import lax
+
+    out = []
+    i = 0
+    for is_str, w, (hm, hl) in strct:
+        v = arrs[i]
+        i += 1
+        if is_str and int(v.shape[1]) != w:
+            v = jnp.pad(v, ((0, 0), (0, w - int(v.shape[1]))))
+        m = arrs[i] if hm else None
+        i += 1 if hm else 0
+        ln = arrs[i] if hl else None
+        i += 1 if hl else 0
+        B = v.shape[0] // kk
+        for j in range(kk):
+            out.append((
+                lax.slice_in_dim(v, j * B, (j + 1) * B),
+                None if m is None
+                else lax.slice_in_dim(m, j * B, (j + 1) * B),
+                None if ln is None
+                else lax.slice_in_dim(ln, j * B, (j + 1) * B),
+            ))
+    return tuple(out)
+
+
+_SPLIT_JIT = None
+_FUSE_JIT = None
+# bound on RETAINED compiled batch shapes (the old per-key dict's 256
+# cap, kept): misaligned batch sizes cycle through many signatures, and
+# jax's own per-function jit cache never evicts — past the cap both
+# functions' traces clear so dead executables can be collected (the
+# persistent exec cache, when active, makes the re-compile a disk load)
+_SEEN_SIGS: set = set()
+_MAX_SIGS = 256
+
+
+def _note_sig(key) -> None:
+    _SEEN_SIGS.add(key)
+    if len(_SEEN_SIGS) > _MAX_SIGS:
+        _SEEN_SIGS.clear()
+        for fn in (_SPLIT_JIT, _FUSE_JIT):
+            if fn is not None:
+                fn.clear_cache()
+
+
+def _split_jit():
+    global _SPLIT_JIT
+    if _SPLIT_JIT is None:
+        import jax
+
+        _SPLIT_JIT = jax.jit(_jit_split, static_argnums=(0, 1))
+    return _SPLIT_JIT
 
 
 def aligned_split(specs: Sequence[ColumnSpec], parts: Sequence[Part],
@@ -192,9 +249,7 @@ def aligned_split(specs: Sequence[ColumnSpec], parts: Sequence[Part],
     writer's row-group size and every steady-state group rides this
     path; misaligned groups fall back to the carry buffer seamlessly.
     """
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
+    from ..tpu import exec_cache
 
     leaves: list = []
     sig = []
@@ -207,43 +262,13 @@ def aligned_split(specs: Sequence[ColumnSpec], parts: Sequence[Part],
             leaves.append(ln)
         sig.append((bool(spec.is_string), int(w),
                     (m is not None, ln is not None)))
-    key = (
-        tuple(sig), int(k),
-        tuple((a.shape, a.dtype) for a in leaves),
+    _note_sig((
+        "split", tuple(sig), int(k),
+        tuple((a.shape, str(a.dtype)) for a in leaves),
+    ))
+    flat = exec_cache.dispatch(
+        _split_jit(), (tuple(sig), int(k)), leaves
     )
-    fn = _SPLIT_CACHE.get(key)
-    if fn is None:
-        strct = tuple(sig)
-        kk = int(k)
-
-        def split(*arrs):
-            out = []
-            i = 0
-            for is_str, w, (hm, hl) in strct:
-                v = arrs[i]
-                i += 1
-                if is_str and int(v.shape[1]) != w:
-                    v = jnp.pad(v, ((0, 0), (0, w - int(v.shape[1]))))
-                m = arrs[i] if hm else None
-                i += 1 if hm else 0
-                ln = arrs[i] if hl else None
-                i += 1 if hl else 0
-                B = v.shape[0] // kk
-                for j in range(kk):
-                    out.append((
-                        lax.slice_in_dim(v, j * B, (j + 1) * B),
-                        None if m is None
-                        else lax.slice_in_dim(m, j * B, (j + 1) * B),
-                        None if ln is None
-                        else lax.slice_in_dim(ln, j * B, (j + 1) * B),
-                    ))
-            return tuple(out)
-
-        fn = jax.jit(split)
-        if len(_SPLIT_CACHE) > 256:
-            _SPLIT_CACHE.clear()
-        _SPLIT_CACHE[key] = fn
-    flat = fn(*leaves)
     # flat is column-major: per column, k consecutive batch parts
     return [
         [flat[ci * k + j] for ci in range(len(specs))] for j in range(k)
@@ -266,9 +291,7 @@ def fused_assemble(specs: Sequence[ColumnSpec],
     covers every batch a decoded group completed, so the device sees one
     executable per group, not per batch.
     """
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
+    from ..tpu import exec_cache
 
     if pad and split != 1:
         raise ValueError("pad only applies to a single (tail) batch")
@@ -287,77 +310,83 @@ def fused_assemble(specs: Sequence[ColumnSpec],
             if ln is not None:
                 leaves.append(ln)
         sig.append((bool(spec.is_string), int(w), tuple(flags)))
-    key = (
-        tuple(sig), int(pad), int(split),
-        tuple((a.shape, a.dtype) for a in leaves),
+    _note_sig((
+        "fuse", tuple(sig), int(pad), int(split),
+        tuple((a.shape, str(a.dtype)) for a in leaves),
+    ))
+    flat = exec_cache.dispatch(
+        _fuse_jit(), (tuple(sig), int(pad), int(split)),
+        [np.asarray(starts, np.int32), *leaves],
     )
-    fn = _FUSE_CACHE.get(key)
-    if fn is None:
-        strct = tuple(sig)
-        padn = int(pad)
-        k = int(split)
-
-        def assemble(starts_arr, *arrs):
-            out = []
-            i = 0  # leaf cursor
-            pj = 0  # piece cursor (into starts_arr)
-            for is_str, w, flags in strct:
-                vs, ms, ls = [], [], []
-                for hm, hl, size in flags:
-                    a0 = starts_arr[pj]
-                    pj += 1
-                    v = lax.dynamic_slice_in_dim(arrs[i], a0, size)
-                    i += 1
-                    if is_str and int(v.shape[1]) != w:
-                        v = jnp.pad(v, ((0, 0), (0, w - int(v.shape[1]))))
-                    vs.append(v)
-                    if hm:
-                        ms.append(lax.dynamic_slice_in_dim(arrs[i], a0, size))
-                        i += 1
-                    if hl:
-                        ls.append(lax.dynamic_slice_in_dim(arrs[i], a0, size))
-                        i += 1
-                v = vs[0] if len(vs) == 1 else jnp.concatenate(vs)
-                m = (
-                    (ms[0] if len(ms) == 1 else jnp.concatenate(ms))
-                    if ms else None
-                )
-                ln = (
-                    (ls[0] if len(ls) == 1 else jnp.concatenate(ls))
-                    if ls else None
-                )
-                if padn:
-                    v = jnp.concatenate(
-                        [v, jnp.zeros((padn,) + tuple(v.shape[1:]), v.dtype)]
-                    )
-                    if m is not None:
-                        m = jnp.concatenate([m, jnp.ones((padn,), bool)])
-                    if ln is not None:
-                        ln = jnp.concatenate([ln, jnp.zeros((padn,), ln.dtype)])
-                if k == 1:
-                    out.append((v, m, ln))
-                else:
-                    B = v.shape[0] // k
-                    for j in range(k):
-                        out.append((
-                            lax.slice_in_dim(v, j * B, (j + 1) * B),
-                            None if m is None
-                            else lax.slice_in_dim(m, j * B, (j + 1) * B),
-                            None if ln is None
-                            else lax.slice_in_dim(ln, j * B, (j + 1) * B),
-                        ))
-            return tuple(out)
-
-        fn = jax.jit(assemble)
-        if len(_FUSE_CACHE) > 256:
-            _FUSE_CACHE.clear()
-        _FUSE_CACHE[key] = fn
-    flat = fn(np.asarray(starts, np.int32), *leaves)
     # flat is column-major: per column, `split` consecutive batch parts
     k = int(split)
     return [
         [flat[ci * k + j] for ci in range(len(specs))] for j in range(k)
     ]
+
+
+def _jit_assemble(strct: tuple, padn: int, k: int, starts_arr, *arrs):
+    import jax.numpy as jnp
+    from jax import lax
+
+    out = []
+    i = 0  # leaf cursor
+    pj = 0  # piece cursor (into starts_arr)
+    for is_str, w, flags in strct:
+        vs, ms, ls = [], [], []
+        for hm, hl, size in flags:
+            a0 = starts_arr[pj]
+            pj += 1
+            v = lax.dynamic_slice_in_dim(arrs[i], a0, size)
+            i += 1
+            if is_str and int(v.shape[1]) != w:
+                v = jnp.pad(v, ((0, 0), (0, w - int(v.shape[1]))))
+            vs.append(v)
+            if hm:
+                ms.append(lax.dynamic_slice_in_dim(arrs[i], a0, size))
+                i += 1
+            if hl:
+                ls.append(lax.dynamic_slice_in_dim(arrs[i], a0, size))
+                i += 1
+        v = vs[0] if len(vs) == 1 else jnp.concatenate(vs)
+        m = (
+            (ms[0] if len(ms) == 1 else jnp.concatenate(ms))
+            if ms else None
+        )
+        ln = (
+            (ls[0] if len(ls) == 1 else jnp.concatenate(ls))
+            if ls else None
+        )
+        if padn:
+            v = jnp.concatenate(
+                [v, jnp.zeros((padn,) + tuple(v.shape[1:]), v.dtype)]
+            )
+            if m is not None:
+                m = jnp.concatenate([m, jnp.ones((padn,), bool)])
+            if ln is not None:
+                ln = jnp.concatenate([ln, jnp.zeros((padn,), ln.dtype)])
+        if k == 1:
+            out.append((v, m, ln))
+        else:
+            B = v.shape[0] // k
+            for j in range(k):
+                out.append((
+                    lax.slice_in_dim(v, j * B, (j + 1) * B),
+                    None if m is None
+                    else lax.slice_in_dim(m, j * B, (j + 1) * B),
+                    None if ln is None
+                    else lax.slice_in_dim(ln, j * B, (j + 1) * B),
+                ))
+    return tuple(out)
+
+
+def _fuse_jit():
+    global _FUSE_JIT
+    if _FUSE_JIT is None:
+        import jax
+
+        _FUSE_JIT = jax.jit(_jit_assemble, static_argnums=(0, 1, 2))
+    return _FUSE_JIT
 
 
 @dataclass

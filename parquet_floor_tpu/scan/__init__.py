@@ -13,7 +13,8 @@ Two layers (see ``docs/scan.md``):
 
 Front doors: :class:`DatasetScanner` / :func:`scan_batches` (host
 decode), :func:`scan_device_groups` (feeds ``TpuRowGroupReader`` across
-file boundaries), and the ``scan_options=`` parameter of
+file boundaries), :func:`scan_aggregate` (aggregate queries via device
+pushdown — docs/pushdown.md), and the ``scan_options=`` parameter of
 ``ParquetReader.stream_content`` / ``stream_batches``.
 """
 
@@ -22,6 +23,7 @@ from .executor import (
     DatasetSchemaError,
     PrefetchedSource,
     ScanUnit,
+    scan_aggregate,
     scan_batches,
     scan_device_groups,
 )
@@ -45,6 +47,7 @@ __all__ = [
     "ScanUnit",
     "coalesce",
     "plan_file",
+    "scan_aggregate",
     "scan_batches",
     "scan_device_groups",
 ]

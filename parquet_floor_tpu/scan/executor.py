@@ -385,6 +385,60 @@ def _source_chain(source, options: Optional[ReaderOptions]) -> PrefetchedSource:
         raise
 
 
+def compute_page_covers(reader, predicate, keep: Optional[Set[int]],
+                        filter_set: Optional[Set[str]], sc: ScanOptions):
+    """``ScanOptions.page_prune``'s cover pass, shared by BOTH scan
+    faces: narrow each surviving group to the page-aligned cover of the
+    predicate's ``row_ranges`` (docs/scan.md).  Mutates ``keep`` — a
+    group whose every page the ColumnIndex ruled out is dropped
+    entirely (no bytes read).  Returns the ``covered_by_group`` map for
+    :func:`plan_file`."""
+    # prefetch EVERY kept group's page-index ranges in one vectored
+    # load before the cover walk below reads them one by one — on a
+    # remote source the per-chunk ColumnIndex/OffsetIndex reads
+    # would otherwise each pay an RTT, serially, at file open (the
+    # reader parses each index once, so the later plan_file load of
+    # the same extents is a no-op hit)
+    from .plan import coalesce, index_ranges
+
+    idx: list = []
+    for gi in sorted(keep):
+        # ALL columns, not just the projection: the predicate's own
+        # column need not be selected, and row_ranges reads it
+        idx.extend(index_ranges(reader.row_groups[gi]))
+    load = getattr(reader.source, "load", None)
+    if idx and load is not None:
+        load(coalesce(idx, sc.max_gap_bytes, sc.max_extent_bytes))
+    covered_by_group: dict = {}
+    for gi in sorted(keep):
+        rg = reader.row_groups[gi]
+        n = int(rg.num_rows or 0)
+        chunks = [
+            c for c in rg.columns or []
+            if not filter_set or (
+                c.meta_data is not None
+                and c.meta_data.path_in_schema
+                and c.meta_data.path_in_schema[0] in filter_set
+            )
+        ]
+        if not chunks:
+            continue
+        rr = predicate.row_ranges(reader, gi)
+        cov = reader.page_cover(gi, rr, chunks)
+        if cov == []:
+            # the ColumnIndex proved no page can match: the group
+            # drops like a stats-pruned one (its pages all count)
+            keep.discard(gi)
+            trace.count("scan.pages_pruned", sum(
+                len(oi.page_locations)
+                for oi in (reader.read_offset_index(c) for c in chunks)
+                if oi is not None and oi.page_locations
+            ))
+        elif cov is not None and cov != [(0, n)]:
+            covered_by_group[gi] = cov
+    return covered_by_group
+
+
 class DatasetScanner:
     """Scheduled scan over a list of sources, yielding :class:`ScanUnit`
     in (file order, row-group order) — decoded bytes are bit-identical
@@ -584,62 +638,12 @@ class DatasetScanner:
         return state
 
     def _page_covers(self, reader, keep: Optional[Set[int]]):
-        """``ScanOptions.page_prune``: narrow each surviving group to the
-        page-aligned cover of the predicate's ``row_ranges``
-        (docs/scan.md).  Mutates ``keep`` — a group whose every page the
-        ColumnIndex ruled out is dropped entirely (no bytes read).
-        Returns the ``covered_by_group`` map for :func:`plan_file`, or
-        None when pruning is off/inapplicable (no predicate; salvage
-        keeps whole-group quarantine semantics)."""
         if self._predicate is None or not self._scan.page_prune \
                 or self._salvage:
             return None
-        # prefetch EVERY kept group's page-index ranges in one vectored
-        # load before the cover walk below reads them one by one — on a
-        # remote source the per-chunk ColumnIndex/OffsetIndex reads
-        # would otherwise each pay an RTT, serially, at file open (the
-        # reader parses each index once, so the later plan_file load of
-        # the same extents is a no-op hit)
-        from .plan import coalesce, index_ranges
-
-        idx: list = []
-        for gi in sorted(keep):
-            # ALL columns, not just the projection: the predicate's own
-            # column need not be selected, and row_ranges reads it
-            idx.extend(index_ranges(reader.row_groups[gi]))
-        load = getattr(reader.source, "load", None)
-        if idx and load is not None:
-            load(coalesce(
-                idx, self._scan.max_gap_bytes, self._scan.max_extent_bytes
-            ))
-        covered_by_group: dict = {}
-        for gi in sorted(keep):
-            rg = reader.row_groups[gi]
-            n = int(rg.num_rows or 0)
-            chunks = [
-                c for c in rg.columns or []
-                if not self._filter or (
-                    c.meta_data is not None
-                    and c.meta_data.path_in_schema
-                    and c.meta_data.path_in_schema[0] in self._filter
-                )
-            ]
-            if not chunks:
-                continue
-            rr = self._predicate.row_ranges(reader, gi)
-            cov = reader.page_cover(gi, rr, chunks)
-            if cov == []:
-                # the ColumnIndex proved no page can match: the group
-                # drops like a stats-pruned one (its pages all count)
-                keep.discard(gi)
-                trace.count("scan.pages_pruned", sum(
-                    len(oi.page_locations)
-                    for oi in (reader.read_offset_index(c) for c in chunks)
-                    if oi is not None and oi.page_locations
-                ))
-            elif cov is not None and cov != [(0, n)]:
-                covered_by_group[gi] = cov
-        return covered_by_group
+        return compute_page_covers(
+            reader, self._predicate, keep, self._filter, self._scan
+        )
 
     def _close_file(self, fi: int) -> None:
         state = self._files.pop(fi, None)
@@ -921,12 +925,38 @@ def scan_device_groups(sources: Sequence,
     :class:`~parquet_floor_tpu.utils.trace.ScanReport`) is invoked once
     when the scan finishes or is abandoned, with the health summary
     built from the tracer scope active when the scan started.
+
+    **Pushdown** (docs/pushdown.md): ``ScanOptions(page_prune=True)``
+    narrows each surviving group to the predicate's page cover before a
+    data byte is read (the storage rung — delivered groups then carry
+    only the covered rows, exactly like the host leg);
+    ``ScanOptions(pushdown=True)`` additionally evaluates the predicate
+    INSIDE each group's fused decode executable and delivers only the
+    surviving rows, device-compacted (``scan.rows_filtered_device``).
+    ``ScanOptions(aggregate=...)`` switches the yield to ``(file_index,
+    group_index, AggPartial)`` — tiny per-group partial aggregate
+    states; fold them with :func:`scan_aggregate`.  Neither composes
+    with salvage (quarantine decisions are group-wide).
     """
     from ..batch.columns import BatchColumn
     from ..format.schema import dataset_schema_key
+    from ..tpu.compute import ComputeRequest, PushdownResult
     from ..tpu.engine import TpuRowGroupReader, iter_dataset_row_groups
 
     sc = scan or ScanOptions()
+    compute_req = None
+    if sc.aggregate is not None or (sc.pushdown and predicate is not None):
+        from ..errors import UnsupportedFeatureError
+
+        if options is not None and options.salvage:
+            raise UnsupportedFeatureError(
+                "pushdown/aggregate do not compose with salvage "
+                "(quarantine decisions are group-wide); scan with "
+                "salvage and filter on host"
+            )
+        compute_req = ComputeRequest(
+            predicate=predicate, aggregate=sc.aggregate,
+        )
     # attribute the whole scan to the tracer active at generator start
     # (worker tasks bind to it explicitly; a bare contextvar would not
     # cross the pool's thread spawns, and the consumer may drive the
@@ -980,7 +1010,15 @@ def scan_device_groups(sources: Sequence,
         keep = (
             set(predicate.row_groups(fr)) if predicate is not None else None
         )
-        fplan = plan_file(fr, set(columns) if columns else None, keep, sc)
+        covered_by_group = None
+        if predicate is not None and sc.page_prune and not salvage:
+            # the device leg's page-prune rung (docs/scan.md): same
+            # cover pass as the host DatasetScanner, bit-parity pinned
+            covered_by_group = compute_page_covers(
+                fr, predicate, keep, set(columns) if columns else None, sc
+            )
+        fplan = plan_file(fr, set(columns) if columns else None, keep, sc,
+                          covered_by_group)
         if fplan.index_extents:
             t0 = time.perf_counter()
             loaded = cache.load(fplan.index_extents)
@@ -1080,7 +1118,10 @@ def scan_device_groups(sources: Sequence,
             # a file's units all append at its open, so the next unit's
             # file index changing (or the list ending) marks its last one
             last_of_file = i + 1 >= len(units) or units[i + 1][0] != fi_
-            yield (lambda t=tpu: t), gp.group_index, last_of_file, None
+            yield (
+                (lambda t=tpu: t), gp.group_index, last_of_file, None,
+                compute_req, gp.covered,
+            )
             i += 1
 
     groups = None
@@ -1114,27 +1155,40 @@ def scan_device_groups(sources: Sequence,
                 break
             tracer.add("scan.consumer_stall", time.perf_counter() - t0)
             fi_, gp, cache_, cost = units[i]
-            # the POSITIONAL contract: every yielded group carries the
-            # FIRST file's selected columns, in schema order — exactly
-            # the sequential TPU batch path's ordering rule.  A chunk
-            # missing from a group raises — UNLESS salvage recorded its
-            # quarantine, in which case it stays IN POSITION as a
-            # fail-loudly placeholder (the host batch face's contract).
-            rep = files[fi_][0].reader.salvage_report
-            ordered = {}
-            for n in sel_names:
-                if n not in cols:
-                    if salvage and rep is not None and \
-                            rep.chunk_quarantined(gp.group_index, n):
-                        ordered[n] = BatchColumn(
-                            desc_by[n], None, quarantined=True
-                        )
-                        continue
-                    raise ValueError(
-                        f"row group {gp.group_index} missing column {n}"
+            if isinstance(cols, PushdownResult):
+                res = cols
+                if sc.aggregate is not None:
+                    yield fi_, gp.group_index, res.agg
+                    cols = None
+                else:
+                    tracer.count(
+                        "scan.rows_filtered_device",
+                        res.num_rows - res.num_selected,
                     )
-                ordered[n] = cols[n]
-            yield fi_, gp.group_index, ordered
+                    cols = res.columns
+            if cols is not None:
+                # the POSITIONAL contract: every yielded group carries
+                # the FIRST file's selected columns, in schema order —
+                # exactly the sequential TPU batch path's ordering rule.
+                # A chunk missing from a group raises — UNLESS salvage
+                # recorded its quarantine, in which case it stays IN
+                # POSITION as a fail-loudly placeholder (the host batch
+                # face's contract).
+                rep = files[fi_][0].reader.salvage_report
+                ordered = {}
+                for n in sel_names:
+                    if n not in cols:
+                        if salvage and rep is not None and \
+                                rep.chunk_quarantined(gp.group_index, n):
+                            ordered[n] = BatchColumn(
+                                desc_by[n], None, quarantined=True
+                            )
+                            continue
+                        raise ValueError(
+                            f"row group {gp.group_index} missing column {n}"
+                        )
+                    ordered[n] = cols[n]
+                yield fi_, gp.group_index, ordered
             floor = i + 1
             # the engine staged this group before yielding it: its
             # raw extents are dead weight now — drop and refill
@@ -1189,3 +1243,124 @@ def scan_device_groups(sources: Sequence,
             except Exception:
                 if not unwinding:
                     raise
+
+
+def _batch_resolver(batch):
+    """``(values, null_mask)`` resolver over a decoded host
+    ``RowGroupBatch`` — the shape ``batch.predicate.eval_mask`` and
+    ``batch.aggregate.host_partial`` consume.  String columns resolve
+    to object arrays of ``bytes`` (distinct-value comparisons happen on
+    host anyway)."""
+    import numpy as np
+
+    from ..format.encodings.plain import ByteArrayColumn
+
+    by_name = {}
+    for cb in batch.columns:
+        by_name[".".join(cb.descriptor.path)] = cb
+    cache: dict = {}
+
+    def resolve(name: str):
+        if name in cache:
+            return cache[name]
+        cb = by_name.get(name)
+        if cb is None:
+            raise ValueError(f"column {name!r} missing from the batch")
+        dense, mask = cb.dense()
+        if isinstance(dense, ByteArrayColumn):
+            data = dense.data.tobytes()
+            offs = dense.offsets
+            vals = np.empty(len(dense), dtype=object)
+            for i in range(len(dense)):
+                vals[i] = data[offs[i] : offs[i + 1]]
+        else:
+            vals = np.asarray(dense)
+        cache[name] = (vals, mask)
+        return cache[name]
+
+    return resolve
+
+
+def scan_aggregate(sources: Sequence, aggregate,
+                   predicate=None,
+                   options: Optional[ReaderOptions] = None,
+                   scan: Optional[ScanOptions] = None,
+                   engine: str = "tpu",
+                   float64_policy: str = "float64",
+                   dict_form: str = "gather"):
+    """Answer an aggregate query over a dataset: returns the combined
+    :class:`~parquet_floor_tpu.batch.aggregate.AggPartial` (call
+    ``.finalize()`` for plain values).
+
+    ``engine="tpu"`` ships tiny per-group partial states off the device
+    (O(groups) bytes of D2H — docs/pushdown.md); shapes the device tail
+    cannot evaluate (repeated columns, non-dictionary group keys,
+    DOUBLE under a lossy float policy) fall back to the host leg —
+    results identical by construction, recorded as an
+    ``engine.pushdown`` decision.  ``engine="host"`` decodes on host
+    and computes the same partials with NumPy.  ``predicate`` filters
+    rows (and prunes groups/pages exactly like any other scan —
+    statistics first, ``ScanOptions.page_prune`` optionally)."""
+    from dataclasses import replace as _replace
+
+    from ..batch.aggregate import Aggregate, AggPartial, host_partial
+    from ..batch.predicate import eval_mask
+    from ..errors import UnsupportedFeatureError
+
+    if not isinstance(aggregate, Aggregate):
+        raise ValueError("aggregate must be a batch.aggregate.Aggregate")
+    sc = scan or ScanOptions()
+    if engine not in ("tpu", "host"):
+        raise ValueError(f"bad engine {engine!r}")
+    if options is not None and options.salvage:
+        # rejected HERE, before the device attempt: the device leg's own
+        # salvage rejection must not be swallowed by the host fallback
+        # below into an aggregate that silently drops quarantined rows
+        raise UnsupportedFeatureError(
+            "aggregate queries do not compose with salvage (quarantine "
+            "decisions are group-wide); scan with salvage and aggregate "
+            "the surviving batches yourself"
+        )
+    need_dev = set(aggregate.columns())
+    if predicate is not None:
+        from ..batch.predicate import tree as _tree
+        from ..batch.predicate import tree_columns as _tree_columns
+
+        need_dev |= _tree_columns(_tree(predicate))
+    proj = sorted({c.split(".")[0] for c in need_dev})
+    if engine == "tpu":
+        dev_sc = _replace(sc, aggregate=aggregate)
+        try:
+            out = AggPartial(aggregate)
+            for _fi, _gi, part in scan_device_groups(
+                sources, columns=proj, options=options, scan=dev_sc,
+                predicate=predicate, float64_policy=float64_policy,
+                dict_form=dict_form,
+            ):
+                out.combine(part)
+            return out
+        except UnsupportedFeatureError as e:
+            trace.decision("engine.pushdown", {
+                "action": "host_fallback",
+                "why": str(e)[:200],
+            })
+    # host leg: decode the needed columns, evaluate the same predicate
+    # mask, compute the same partials — bit-identical combine protocol
+    out = AggPartial(aggregate)
+    scanner = DatasetScanner(
+        sources, columns=proj, options=options, scan=_replace(
+            sc, pushdown=False, aggregate=None
+        ), predicate=predicate,
+    )
+    try:
+        for unit in scanner:
+            resolve = _batch_resolver(unit.batch)
+            n = int(unit.batch.num_rows)
+            sel = (
+                eval_mask(predicate, resolve, n)
+                if predicate is not None else None
+            )
+            out.combine(host_partial(aggregate, resolve, n, sel))
+    finally:
+        scanner.close()
+    return out

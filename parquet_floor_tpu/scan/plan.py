@@ -61,8 +61,20 @@ class ScanOptions:
       because it changes the delivered row set from "whole surviving
       groups" to "covered page spans" — the lookup face's granularity
       on the scan face (docs/serving.md's pruning ladder, rung 3).
-      Ignored without a predicate, under salvage (quarantine decisions
-      are group-wide), and on the device scan face.
+      Honored on BOTH scan faces (host ``DatasetScanner`` and the
+      device leg); ignored without a predicate and under salvage
+      (quarantine decisions are group-wide).
+    * ``pushdown`` — device scan leg only (docs/pushdown.md): evaluate
+      the scan's ``predicate`` INSIDE each group's fused decode
+      executable and deliver only the surviving rows, device-compacted
+      (``scan.rows_filtered_device`` counts what never crossed D2H).
+      Composes with ``page_prune`` (the storage-side rung narrows what
+      decodes; the device rung filters what ships).  Ignored without a
+      predicate and on the host leg.
+    * ``aggregate`` — a :class:`~parquet_floor_tpu.batch.aggregate.Aggregate`:
+      the device leg ships per-group PARTIAL aggregate states
+      (O(groups) bytes of D2H) instead of columns; fold them with
+      ``scan.scan_aggregate`` (docs/pushdown.md).
     """
 
     max_gap_bytes: int = 64 << 10
@@ -71,8 +83,18 @@ class ScanOptions:
     threads: int = 4
     adaptive_prefetch: bool = False
     page_prune: bool = False
+    pushdown: bool = False
+    aggregate: Optional[object] = None
 
     def __post_init__(self):
+        if self.aggregate is not None:
+            from ..batch.aggregate import Aggregate
+
+            if not isinstance(self.aggregate, Aggregate):
+                raise ValueError(
+                    "ScanOptions.aggregate must be a "
+                    "batch.aggregate.Aggregate"
+                )
         if self.max_gap_bytes < 0:
             raise ValueError(f"max_gap_bytes must be >= 0, got {self.max_gap_bytes}")
         if self.max_extent_bytes <= 0:

@@ -230,24 +230,6 @@ class Dataset:
             out.append((name, _ColumnCursor(b)))
         return out
 
-    def _key_cursor(self, batch):
-        from ..api.reader import _ColumnCursor
-
-        for b in batch.columns:
-            if ".".join(b.descriptor.path) == self.key_column:
-                return _ColumnCursor(b)
-        raise ValueError(
-            f"key column {self.key_column!r} missing from the decoded "
-            "probe batch"
-        )
-
-    @staticmethod
-    def _norm_key(key):
-        """Key literal in cell space (cursor cells stringify binary)."""
-        if isinstance(key, bytes):
-            return key.decode("utf-8", "surrogateescape")
-        return key
-
     def _pages_in(self, reader, rg, covered, filter_set) -> int:
         """Data pages whose rows intersect ``covered``, summed over the
         selected chunks (the probe's page cost, OffsetIndex truth)."""
@@ -269,7 +251,12 @@ class Dataset:
                     pages += 1
         return pages
 
-    def _probe(self, pred, match, columns, tenant, limit):
+    def _probe(self, pred, columns, tenant, limit):
+        import numpy as np
+
+        from ..batch.predicate import eval_mask
+        from ..scan.executor import _batch_resolver
+
         ctx = (
             trace.using(tenant.tracer)
             if tenant is not None else contextlib.nullcontext()
@@ -311,15 +298,24 @@ class Dataset:
                             "serve.lookup_pages_read",
                             self._pages_in(reader, rg, covered, filter_set),
                         )
-                        kc = self._key_cursor(batch)
+                        # rung 4 — the exact filter rides the SAME
+                        # predicate-mask compiler as the pushdown
+                        # compute tail (one filter semantics, vectorized
+                        # over the page batch; only matching rows pay
+                        # cell conversion)
+                        sel = eval_mask(
+                            pred, _batch_resolver(batch), batch.num_rows
+                        )
+                        hits = np.flatnonzero(sel)
+                        if not hits.size:
+                            continue
                         cursors = self._out_columns(batch, columns)
-                        for r in range(batch.num_rows):
-                            if match(kc.cell(r)):
-                                out.append(
-                                    {n: c.cell(r) for n, c in cursors}
-                                )
-                                if limit is not None and len(out) >= limit:
-                                    break
+                        for r in hits:
+                            out.append(
+                                {n: c.cell(int(r)) for n, c in cursors}
+                            )
+                            if limit is not None and len(out) >= limit:
+                                break
             if limit is not None:
                 out = out[:limit]
             # counted HERE, after any limit stop, so the registered rows
@@ -334,10 +330,8 @@ class Dataset:
         """Rows whose ``key_column`` equals ``key``, as dicts.  ``limit``
         stops the probe early (a unique-key point read passes
         ``limit=1``)."""
-        pred = col(self.key_column) == key
-        want = self._norm_key(key)
         return self._probe(
-            pred, lambda v: v == want, columns, tenant, limit
+            col(self.key_column) == key, columns, tenant, limit
         )
 
     def range(self, lo, hi, columns: Optional[Sequence[str]] = None,
@@ -345,12 +339,67 @@ class Dataset:
         """Rows with ``lo <= key_column <= hi`` (inclusive both ends),
         as dicts."""
         pred = (col(self.key_column) >= lo) & (col(self.key_column) <= hi)
-        nlo, nhi = self._norm_key(lo), self._norm_key(hi)
-        return self._probe(
-            pred,
-            lambda v: v is not None and nlo <= v <= nhi,
-            columns, tenant, limit,
+        return self._probe(pred, columns, tenant, limit)
+
+    def aggregate(self, aggregate, predicate=None, tenant=None):
+        """Answer an aggregate query over the dataset's files without
+        shipping rows anywhere: descends the same pruning ladder a probe
+        uses (footer stats, then bloom for equality predicates), decodes
+        only the surviving groups' needed columns, and folds per-group
+        :class:`~parquet_floor_tpu.batch.aggregate.AggPartial` states —
+        the host mirror of the device scan leg's aggregate pushdown
+        (docs/pushdown.md).  Returns the combined partial (call
+        ``.finalize()``)."""
+        from ..batch.aggregate import Aggregate, AggPartial, host_partial
+        from ..batch.predicate import eval_mask, tree, tree_columns
+        from ..scan.executor import _batch_resolver
+
+        if not isinstance(aggregate, Aggregate):
+            raise ValueError(
+                "aggregate must be a batch.aggregate.Aggregate"
+            )
+        need = set(aggregate.columns())
+        if predicate is not None:
+            need |= tree_columns(tree(predicate))
+        filter_set = {c.split(".")[0] for c in need}
+        ctx = (
+            trace.using(tenant.tracer)
+            if tenant is not None else contextlib.nullcontext()
         )
+        out = AggPartial(aggregate)
+        with ctx, trace.span("serve.aggregate",
+                             attrs={"aggs": len(aggregate.aggs)}):
+            trace.count("serve.aggregate_probes")
+            for i in range(len(self._sources)):
+                lf = self._file(i)
+                reader = lf.reader
+                # the per-file lock is taken PER GROUP, not across the
+                # whole query: an aggregate decodes full groups (the
+                # longest-running storage work this face does), and
+                # holding the lock throughout would head-of-line-block
+                # every concurrent probe of the file for seconds —
+                # exactly the serving layer's fairness hazard
+                for gi in range(len(reader.row_groups)):
+                    with lf.lock:
+                        rg = reader.row_groups[gi]
+                        if predicate is not None:
+                            if not predicate.may_match(rg):
+                                trace.count("serve.lookup_groups_pruned")
+                                continue
+                            if not predicate.may_match_with(reader, rg):
+                                trace.count("serve.lookup_bloom_skips")
+                                continue
+                        batch = reader.read_row_group(gi, filter_set)
+                    resolve = _batch_resolver(batch)
+                    n = int(batch.num_rows)
+                    sel = (
+                        eval_mask(predicate, resolve, n)
+                        if predicate is not None else None
+                    )
+                    out.combine(
+                        host_partial(aggregate, resolve, n, sel)
+                    )
+        return out
 
     def page_size_bound(self) -> int:
         """The largest compressed data-page size across the dataset's

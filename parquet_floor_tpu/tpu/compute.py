@@ -1,0 +1,713 @@
+"""Device pushdown compute — the fused decode executable's compute tail.
+
+The engine decodes a row group into device columns in ONE fused launch
+(``tpu.engine``).  This module extends that launch with a compute tail,
+so a selective or aggregating scan ships **results, not columns**:
+
+* **Fused predicate evaluation** — a ``batch.predicate`` tree compiles
+  (via its :func:`~parquet_floor_tpu.batch.predicate.tree` export) into
+  device ops over the decoded columns.  Dictionary-encoded columns are
+  evaluated on their *index streams* against a host-precomputed
+  per-group dictionary-match mask (one bool per dictionary entry — this
+  is also how string order comparisons work on device: the comparison
+  runs on host, over distinct values, once per group); plain / BSS /
+  delta / host-fallback columns compare post-decode.  Null cells never
+  match (pyarrow ``filter`` drop semantics); the host twin is
+  ``batch.predicate.eval_mask`` and the two are pinned identical by the
+  differential suite.
+* **Fused compaction** — ``mode="compact"`` gathers only the surviving
+  rows into capacity-bounded outputs inside the same launch, so D2H
+  ships ~selected rows instead of the whole group.  The capacity is a
+  static shape chosen from a selection high-water mark shared across
+  the scan (:class:`ComputeRequest`); a group whose survivors exceed it
+  re-dispatches once with a grown capacity
+  (``engine.pushdown_overflows``) — never a wrong result.
+* **Partial aggregates** — count/sum/min/max over the selected rows,
+  optionally grouped by a dictionary column's index stream, emitted as
+  tiny per-group states (O(dictionary) values) that
+  ``batch.aggregate.AggPartial.combine`` folds across row groups and
+  files.  Semantics are pinned to ``pyarrow.compute``
+  (``batch/aggregate.py`` docstring).
+
+Everything static about the tail — the predicate tree, mode, capacity,
+aggregate list, group capacity — rides the fused program's jit static
+arguments, so it is part of the persistent executable-cache key
+(``tpu.exec_cache``): same file + different predicate = different cache
+entry, and a repeated pushdown program skips XLA compilation across
+processes exactly like a plain decode.  Docs: ``docs/pushdown.md``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..batch import predicate as _pred
+from ..batch.aggregate import (
+    ALL,
+    Aggregate,
+    AggPartial,
+    neutral_max,
+    neutral_min,
+)
+from ..errors import UnsupportedFeatureError
+
+_NUM_VDTYPES = ("int32", "int64", "float32", "float64", "bool")
+
+
+class ComputeRequest:
+    """One pushdown request, shared by every row group of a scan.
+
+    ``predicate`` filters rows (None = select all); ``aggregate`` (a
+    :class:`~parquet_floor_tpu.batch.aggregate.Aggregate`) switches the
+    launch to partial-aggregate outputs; without it ``mode`` picks the
+    filter output shape — ``"compact"`` (ship surviving rows only) or
+    ``"mask"`` (ship full columns plus the selection mask).
+
+    The request carries the scan-wide selection high-water mark the
+    compact capacity is sized from: group 0 runs at
+    ``initial_capacity`` (default ``max(n // 8, 256)`` — a filter
+    passing under ~12% of rows never overflows it; a less selective
+    one pays one counted re-dispatch on the first group and the HWM
+    remembers), later groups at the bucketed max observed count.
+    Share ONE request across a scan's readers so the HWM crosses file
+    boundaries."""
+
+    def __init__(self, predicate=None, aggregate: Optional[Aggregate] = None,
+                 mode: str = "compact",
+                 initial_capacity: Optional[int] = None):
+        if predicate is None and aggregate is None:
+            raise ValueError("ComputeRequest needs a predicate, an "
+                             "aggregate, or both")
+        if mode not in ("compact", "mask"):
+            raise ValueError(f"bad pushdown mode {mode!r}")
+        if aggregate is not None and not isinstance(aggregate, Aggregate):
+            raise TypeError("aggregate must be a batch.aggregate.Aggregate")
+        self.tree = _pred.tree(predicate) if predicate is not None else None
+        self.aggregate = aggregate
+        self.mode = mode
+        if initial_capacity is not None and initial_capacity < 1:
+            raise ValueError("initial_capacity must be >= 1")
+        self.initial_capacity = initial_capacity
+        self._lock = threading.Lock()
+        self._max_seen = 0
+
+    def columns_needed(self) -> set:
+        out = set()
+        if self.tree is not None:
+            out |= _pred.tree_columns(self.tree)
+        if self.aggregate is not None:
+            out |= self.aggregate.columns()
+        return out
+
+    def capacity_for(self, n: int) -> int:
+        from .engine import _bucket15
+
+        with self._lock:
+            seen = self._max_seen
+        if seen:
+            return max(1, min(n, _bucket15(seen)))
+        init = self.initial_capacity
+        if init is None:
+            init = max(n // 8, 256)
+        return max(1, min(n, _bucket15(init)))
+
+    def observe(self, count: int) -> None:
+        with self._lock:
+            if count > self._max_seen:
+                self._max_seen = count
+
+
+class _CPlan(NamedTuple):
+    """The STATIC compute tail — every field hashable, part of the jit
+    static signature and therefore of the exec-cache key."""
+
+    tree: tuple            # rewritten static tree (("true",) = select all)
+    mode: str              # compact | mask | agg
+    capacity: int          # compact output rows (0 otherwise)
+    ship: tuple            # column names emitted (compact/mask modes)
+    aggs: tuple            # ((col, op), ...) — empty without aggregate
+    group: Optional[str]   # group-by column name
+    gcap: int              # group scatter capacity (dict_cap)
+    n_masks: int           # dictionary-match mask input arrays
+    n: int                 # rows in the group
+
+
+@dataclass
+class BuiltCompute:
+    """One staged group's compute tail: the static plan plus the
+    per-group host data it references — dictionary-match masks (shipped
+    as extra device inputs) and the group-by column's dictionary values
+    (stay on host; ``partial_from_device`` maps slots back to keys)."""
+
+    request: ComputeRequest
+    cplan: _CPlan
+    masks: List[np.ndarray] = field(default_factory=list)
+    group_keys: Optional[list] = None     # slot -> key value (len num_dict)
+
+    def with_capacity(self, capacity: int) -> "BuiltCompute":
+        out = BuiltCompute(self.request, self.cplan._replace(
+            capacity=int(capacity)), self.masks, self.group_keys)
+        return out
+
+
+@dataclass
+class PushdownResult:
+    """What a pushdown launch returns: compacted (or full) device
+    columns for filter modes, a partial aggregate state for aggregate
+    mode, and the selection accounting either way."""
+
+    columns: dict
+    num_rows: int
+    num_selected: int
+    mask: Optional[jax.Array] = None          # mode="mask" only
+    agg: Optional[AggPartial] = None
+
+
+# ---------------------------------------------------------------------------
+# Host plan building (stage time)
+# ---------------------------------------------------------------------------
+
+_DICT_KINDS = ("dict", "dict_str", "dict_idx", "dict_idx_num")
+
+
+def _cmp_host(vals, op: str, v):
+    """Host comparison used for dictionary-match masks (full semantics,
+    including string order — it runs over distinct values on host)."""
+    if isinstance(vals, list):  # bytes dictionary
+        vals = np.array(vals, dtype=object)
+        if isinstance(v, str):
+            v = v.encode("utf-8", "surrogateescape")
+    try:
+        return np.asarray(_pred._cmp_arrays(vals, op, v), dtype=bool)
+    except TypeError:
+        return np.zeros(len(vals), bool)
+
+
+def _dict_values(spec, stage, arena):
+    """The column's dictionary VALUES on host (numeric np array in the
+    exact physical dtype, or a list of bytes for strings)."""
+    from ..format.encodings.plain import decode_plain
+    from ..format.parquet_thrift import Type
+    from .engine import _NP_DTYPE
+
+    off, size = stage.dict_off, stage.dict_size
+    pt = stage.desc.physical_type
+    if spec.kind in ("dict", "dict_idx_num"):
+        dt = np.dtype(_NP_DTYPE[pt])
+        num = size // dt.itemsize
+        return np.frombuffer(
+            bytes(arena[off : off + size]), dtype=dt, count=num
+        )
+    content = bytes(arena[off : off + size])
+    count = int(getattr(stage, "dict_count", 0) or 0)
+    col, _ = decode_plain(content, count, Type.BYTE_ARRAY)
+    data = col.data.tobytes()
+    offs = col.offsets
+    return [data[offs[i] : offs[i + 1]] for i in range(len(col))]
+
+
+def _spec_by_name(specs, name: str):
+    for s in specs:
+        if s.name == name:
+            return s
+    raise ValueError(f"pushdown references column {name!r}, which is not "
+                     "in the staged program (is it in the file?)")
+
+
+def _reject_lossy_double(spec) -> None:
+    if spec.vdtype == "float64" and spec.f64mode in ("f32", "bits"):
+        raise UnsupportedFeatureError(
+            f"pushdown on DOUBLE column {spec.name!r} needs exact device "
+            "float64 — use float64_policy='float64' (dictionary-encoded "
+            "DOUBLE columns work under any policy: their comparisons run "
+            "on the host dictionary)"
+        )
+
+
+def build_for_program(request: ComputeRequest, specs, stages_by_name: dict,
+                      arena, num_rows: int) -> BuiltCompute:
+    """Compile a :class:`ComputeRequest` against one staged program.
+
+    Raises ``UnsupportedFeatureError`` for shapes the device tail cannot
+    evaluate (repeated columns anywhere in the program; order
+    comparisons on non-dictionary strings; DOUBLE under a lossy float
+    policy; group-by on a non-dictionary column) — callers fall back to
+    host evaluation per group, results identical by construction."""
+    for s in specs:
+        if s.max_rep > 0:
+            raise UnsupportedFeatureError(
+                "pushdown cannot run over repeated (nested) columns; "
+                f"project {s.name!r} away"
+            )
+    built = BuiltCompute(request, _CPlan(
+        ("true",), "agg" if request.aggregate is not None else request.mode,
+        0, (), (), None, 0, 0, int(num_rows),
+    ))
+
+    def rewrite(t: tuple) -> tuple:
+        kind = t[0]
+        if kind in ("and", "or"):
+            return (kind, rewrite(t[1]), rewrite(t[2]))
+        if kind == "isnull":
+            spec = _spec_by_name(specs, t[1])
+            if spec.max_def == 0:
+                return ("const", not t[2])
+            return ("isnull", t[1], t[2])
+        _, name, op, v = t
+        spec = _spec_by_name(specs, name)
+        if spec.kind in _DICT_KINDS and name in stages_by_name and \
+                getattr(stages_by_name[name], "dict_off", -1) >= 0:
+            dvals = _dict_values(spec, stages_by_name[name], arena)
+            dmask = np.zeros(max(spec.dict_cap, 1), bool)
+            m = _cmp_host(dvals, op, v)
+            dmask[: len(m)] = m
+            built.masks.append(dmask)
+            return ("dmask", name, op, len(built.masks) - 1)
+        if spec.vdtype in _NUM_VDTYPES and spec.max_len == 0:
+            _reject_lossy_double(spec)
+            lit = v
+            if isinstance(lit, bytes):
+                raise UnsupportedFeatureError(
+                    f"string literal compared against numeric column "
+                    f"{name!r}"
+                )
+            return ("num", name, op, lit)
+        if spec.max_len > 0:  # device byte rows (plain_str / host_str)
+            if op not in ("==", "!="):
+                raise UnsupportedFeatureError(
+                    f"order comparison {op!r} on non-dictionary string "
+                    f"column {name!r} is host-only (dictionary-encoded "
+                    "strings support it via the host dictionary mask)"
+                )
+            lit = (
+                v.encode("utf-8", "surrogateescape")
+                if isinstance(v, str) else bytes(v)
+            )
+            return ("str", name, op, lit)
+        raise UnsupportedFeatureError(
+            f"pushdown cannot evaluate column {name!r} "
+            f"(kind {spec.kind!r}, vdtype {spec.vdtype!r})"
+        )
+
+    tree = rewrite(request.tree) if request.tree is not None else ("true",)
+    ship: tuple = ()
+    aggs: tuple = ()
+    group = None
+    gcap = 0
+    capacity = 0
+    agg = request.aggregate
+    if agg is not None:
+        for c, op in agg.aggs:
+            spec = _spec_by_name(specs, c)
+            if op != "count":
+                if spec.vdtype not in ("int32", "int64", "float32",
+                                       "float64") or spec.max_len > 0:
+                    raise UnsupportedFeatureError(
+                        f"aggregate {op!r} needs a numeric column, got "
+                        f"{c!r} (vdtype {spec.vdtype!r})"
+                    )
+                if spec.kind in ("dict_idx", "dict_idx_num"):
+                    # index-form output IS the index stream — summing it
+                    # would aggregate dictionary slots, not values
+                    raise UnsupportedFeatureError(
+                        f"aggregate {op!r} over index-form dictionary "
+                        f"column {c!r} — use dict_form='gather'"
+                    )
+                _reject_lossy_double(spec)
+        aggs = agg.aggs
+        if agg.group_by is not None:
+            gspec = _spec_by_name(specs, agg.group_by)
+            stage = stages_by_name.get(agg.group_by)
+            if gspec.kind not in _DICT_KINDS or stage is None or \
+                    getattr(stage, "dict_off", -1) < 0:
+                raise UnsupportedFeatureError(
+                    f"group_by column {agg.group_by!r} is not "
+                    "dictionary-encoded in this row group — device "
+                    "group-by runs over dictionary indices"
+                )
+            group = agg.group_by
+            gcap = max(int(gspec.dict_cap), 1)
+            dvals = _dict_values(gspec, stage, arena)
+            built.group_keys = (
+                [v.item() for v in dvals]
+                if isinstance(dvals, np.ndarray) else list(dvals)
+            )
+        mode = "agg"
+    else:
+        mode = request.mode
+        ship = tuple(s.name for s in specs)
+        if mode == "compact":
+            capacity = request.capacity_for(int(num_rows))
+    built.cplan = _CPlan(
+        tree, mode, capacity, ship, aggs, group, gcap,
+        len(built.masks), int(num_rows),
+    )
+    return built
+
+
+# ---------------------------------------------------------------------------
+# Device evaluation (traced inside the fused executable)
+# ---------------------------------------------------------------------------
+#
+# ``ctx`` maps column name -> (vals, mask, lens, idx): the column's
+# row-aligned decoded outputs plus, for dictionary kinds, the
+# row-aligned dictionary index stream.  Everything here is pure jnp —
+# it traces into the one fused launch.
+
+def _present(ctx_entry, n: int):
+    mask = ctx_entry[1]
+    return jnp.ones((n,), bool) if mask is None else ~mask
+
+
+def eval_selection(tree: tuple, ctx: dict, masks, n: int):
+    kind = tree[0]
+    if kind == "true":
+        return jnp.ones((n,), bool)
+    if kind == "const":
+        return jnp.full((n,), bool(tree[1]))
+    if kind == "and":
+        return eval_selection(tree[1], ctx, masks, n) & \
+            eval_selection(tree[2], ctx, masks, n)
+    if kind == "or":
+        return eval_selection(tree[1], ctx, masks, n) | \
+            eval_selection(tree[2], ctx, masks, n)
+    if kind == "isnull":
+        entry = ctx[tree[1]]
+        mask = entry[1]
+        if mask is None:
+            return jnp.full((n,), not tree[2])
+        return mask if tree[2] else ~mask
+    if kind == "dmask":
+        _, name, _op, slot = tree
+        vals, mask, lens, idx = ctx[name]
+        return masks[slot][idx] & _present(ctx[name], n)
+    if kind == "num":
+        _, name, op, v = tree
+        vals, mask, lens, idx = ctx[name]
+        # _cmp_arrays is polymorphic over numpy AND jnp arrays — the ONE
+        # operator dispatch shared with the host eval_mask twin
+        out = _pred._cmp_arrays(vals, op, v)
+        return out & _present(ctx[name], n)
+    if kind == "str":
+        _, name, op, lit = tree
+        vals, mask, lens, idx = ctx[name]
+        k = len(lit)
+        if k > int(vals.shape[1]):
+            eq = jnp.zeros((n,), bool)
+        elif k == 0:
+            eq = lens == 0
+        else:
+            # static literal → device constant (tuple(): trace-time only)
+            litv = jnp.asarray(tuple(lit), dtype=jnp.uint8)
+            eq = (lens == k) & jnp.all(
+                vals[:, :k] == litv[None, :], axis=1
+            )
+        out = eq if op == "==" else ~eq
+        return out & _present(ctx[name], n)
+    raise ValueError(f"unknown pushdown leaf {kind!r}")  # pragma: no cover
+
+
+def compact_indices(sel, capacity: int, n: int):
+    """Indices of the selected rows, padded past the true count — the
+    fused compaction gather's map (pad entries clip to the last row and
+    are trimmed by ``num_selected`` on host)."""
+    idx = jnp.nonzero(sel, size=capacity, fill_value=n)[0]
+    return jnp.clip(idx, 0, max(n - 1, 0)).astype(jnp.int32)
+
+
+def take_rows(a, sel_idx):
+    return None if a is None else jnp.take(a, sel_idx, axis=0)
+
+
+def _acc_dtype(dtype):
+    return jnp.float64 if np.dtype(dtype).kind == "f" else jnp.int64
+
+
+def eval_aggregates(cplan: _CPlan, ctx: dict, sel):
+    """The aggregate tail: a flat tuple of tiny arrays —
+    ``(rows, *per-agg states)`` — scalars ungrouped, ``gcap + 1`` slots
+    grouped (slot ``gcap`` = the null-key group; unselected rows scatter
+    out of bounds and drop).  ``partial_from_device`` unpacks."""
+    n = cplan.n
+    outs = []
+    if cplan.group is not None:
+        gentry = ctx[cplan.group]
+        gidx = gentry[3].astype(jnp.int32)
+        gpresent = _present(gentry, n)
+        gcap = cplan.gcap
+        base = jnp.where(
+            sel & gpresent, gidx,
+            jnp.where(sel, gcap, gcap + 1),  # null key | dropped
+        )
+        rows = jnp.zeros(gcap + 1, jnp.int64).at[base].add(1, mode="drop")
+        outs.append(rows)
+        for c, op in cplan.aggs:
+            entry = ctx[c]
+            vals = entry[0]
+            present = sel & _present(entry, n)
+            nv = jnp.zeros(gcap + 1, jnp.int64).at[base].add(
+                jnp.where(present, 1, 0), mode="drop"
+            )
+            outs.append(nv)
+            if op == "count":
+                continue
+            if op == "sum":
+                acc = _acc_dtype(vals.dtype)
+                outs.append(
+                    jnp.zeros(gcap + 1, acc).at[base].add(
+                        jnp.where(present, vals.astype(acc), 0),
+                        mode="drop",
+                    )
+                )
+                continue
+            ok = present
+            if jnp.issubdtype(vals.dtype, jnp.floating):
+                ok = ok & ~jnp.isnan(vals)  # pyarrow min_max skips NaN
+            if op == "min":
+                neut = neutral_min(np.dtype(str(vals.dtype)))
+                outs.append(
+                    jnp.full(gcap + 1, neut, vals.dtype).at[base].min(
+                        jnp.where(ok, vals, neut), mode="drop"
+                    )
+                )
+            else:
+                neut = neutral_max(np.dtype(str(vals.dtype)))
+                outs.append(
+                    jnp.full(gcap + 1, neut, vals.dtype).at[base].max(
+                        jnp.where(ok, vals, neut), mode="drop"
+                    )
+                )
+        return tuple(outs)
+    outs.append(jnp.sum(sel).astype(jnp.int64))
+    for c, op in cplan.aggs:
+        entry = ctx[c]
+        vals = entry[0]
+        present = sel & _present(entry, n)
+        outs.append(jnp.sum(present).astype(jnp.int64))
+        if op == "count":
+            continue
+        if op == "sum":
+            acc = _acc_dtype(vals.dtype)
+            outs.append(jnp.sum(jnp.where(present, vals.astype(acc), 0)))
+            continue
+        ok = present
+        if jnp.issubdtype(vals.dtype, jnp.floating):
+            ok = ok & ~jnp.isnan(vals)
+        if op == "min":
+            neut = neutral_min(np.dtype(str(vals.dtype)))
+            outs.append(jnp.min(jnp.where(ok, vals, neut)))
+        else:
+            neut = neutral_max(np.dtype(str(vals.dtype)))
+            outs.append(jnp.max(jnp.where(ok, vals, neut)))
+    return tuple(outs)
+
+
+def partial_from_device(built: BuiltCompute, fetched: list) -> AggPartial:
+    """Build the host :class:`AggPartial` from one launch's fetched
+    aggregate arrays (O(groups) bytes of D2H — this is the whole point)."""
+    spec = built.request.aggregate
+    cplan = built.cplan
+    out = AggPartial(spec)
+    it = iter(fetched)
+    if cplan.group is None:
+        rows = int(next(it))
+        out.add_rows(ALL, rows)
+        for i, (c, op) in enumerate(cplan.aggs):
+            nv = int(next(it))
+            val = None if op == "count" else next(it)
+            out.add_state(ALL, i, nv, None if nv == 0 else val)
+        return out
+    rows_g = np.asarray(next(it))
+    states = []
+    for c, op in cplan.aggs:
+        nv = np.asarray(next(it))
+        val = None if op == "count" else np.asarray(next(it))
+        states.append((nv, val))
+    keys = built.group_keys or []
+    for slot in range(cplan.gcap + 1):
+        rows = int(rows_g[slot])
+        if rows == 0:
+            continue
+        key = None if slot >= len(keys) else keys[slot]
+        out.add_rows(key, rows)
+        for i, (nv, val) in enumerate(states):
+            nvs = int(nv[slot])
+            out.add_state(
+                key, i, nvs,
+                None if (val is None or nvs == 0) else val[slot],
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fallback evaluation over already-decoded DeviceColumns (multi-launch
+# chunked groups — the fused tail needs the one-launch program)
+# ---------------------------------------------------------------------------
+
+def _columns_ctx(cols: dict, request: ComputeRequest, n: int):
+    """(ctx, masks) over decoded ``DeviceColumn``s: index-form
+    dictionary columns evaluate via their pools exactly like the fused
+    path; gather-form values compare directly."""
+    masks: List[object] = []
+    ctx: Dict[str, tuple] = {}
+    pools: Dict[str, object] = {}
+    for name, dc in cols.items():
+        if dc.def_levels is not None or dc.rep_levels is not None:
+            raise UnsupportedFeatureError(
+                "pushdown cannot run over repeated (nested) columns; "
+                f"project {name!r} away"
+            )
+        idx = None
+        if dc.dict_ref is not None:
+            idx = dc.values.astype(jnp.int32)
+            pools[name] = dc.dict_ref
+        ctx[name] = (dc.values, dc.mask, dc.lengths, idx)
+    return ctx, masks, pools
+
+
+def _pool_values(dict_ref):
+    """Host values of a DeviceColumn.dict_ref pool."""
+    kind = dict_ref[0]
+    if kind == "host":
+        return np.asarray(dict_ref[2])
+    rows = np.asarray(dict_ref[2])
+    lens = np.asarray(dict_ref[3])
+    return [bytes(rows[i, : int(lens[i])]) for i in range(len(lens))]
+
+
+def _reject_lossy_double_col(name: str, dc, arr) -> None:
+    """Same exactness rule as the fused path's ``_reject_lossy_double``:
+    a DOUBLE column whose comparable representation is not float64
+    (f32-converted values, or int64 bit patterns under 'bits') must
+    reject, never silently compare/accumulate rounded numbers."""
+    from ..format.parquet_thrift import Type
+
+    if dc.descriptor.physical_type == Type.DOUBLE and \
+            str(getattr(arr, "dtype", "")) != "float64":
+        raise UnsupportedFeatureError(
+            f"pushdown on DOUBLE column {name!r} needs exact device "
+            "float64 — use float64_policy='float64'"
+        )
+
+
+def eval_on_columns(cols: dict, request: ComputeRequest, num_rows: int):
+    """Evaluate a request over ALREADY-DECODED device columns — the
+    multi-launch (over-cap chunked) groups' path.  Same results as the
+    fused tail, computed by follow-up device ops instead of inside the
+    decode executable."""
+    n = int(num_rows)
+    ctx, masks, pools = _columns_ctx(cols, request, n)
+
+    def rewrite(t: tuple) -> tuple:
+        kind = t[0]
+        if kind in ("and", "or"):
+            return (kind, rewrite(t[1]), rewrite(t[2]))
+        if kind == "isnull":
+            if t[1] not in ctx:
+                raise ValueError(f"pushdown references column {t[1]!r}, "
+                                 "which was not decoded")
+            return t
+        _, name, op, v = t
+        if name not in ctx:
+            raise ValueError(f"pushdown references column {name!r}, "
+                             "which was not decoded")
+        vals, mask, lens, idx = ctx[name]
+        if idx is not None:
+            dvals = _pool_values(pools[name])
+            if isinstance(dvals, np.ndarray):
+                _reject_lossy_double_col(name, cols[name], dvals)
+            cap = len(dvals) if isinstance(dvals, list) else dvals.shape[0]
+            dmask = np.zeros(max(cap, 1), bool)
+            m = _cmp_host(dvals, op, v)
+            dmask[: len(m)] = m
+            masks.append(jnp.asarray(dmask))
+            return ("dmask", name, op, len(masks) - 1)
+        if lens is not None:
+            if op not in ("==", "!="):
+                raise UnsupportedFeatureError(
+                    f"order comparison {op!r} on gather-form string "
+                    f"column {name!r} in a multi-launch group — use "
+                    "dict_form='index' or the host engine"
+                )
+            lit = (
+                v.encode("utf-8", "surrogateescape")
+                if isinstance(v, str) else bytes(v)
+            )
+            return ("str", name, op, lit)
+        if str(vals.dtype) not in _NUM_VDTYPES:
+            raise UnsupportedFeatureError(
+                f"pushdown cannot evaluate column {name!r} "
+                f"(dtype {vals.dtype})"
+            )
+        if isinstance(v, bytes):
+            raise UnsupportedFeatureError(
+                f"string literal compared against numeric column {name!r}"
+            )
+        _reject_lossy_double_col(name, cols[name], vals)
+        return ("num", name, op, v)
+
+    tree = rewrite(request.tree) if request.tree is not None else ("true",)
+    sel = eval_selection(tree, ctx, masks, n)
+    agg = request.aggregate
+    if agg is not None:
+        for c, op in agg.aggs:
+            if op != "count" and c in cols:
+                if ctx[c][3] is not None:
+                    # index-form values ARE dictionary slots — summing
+                    # them would be silently wrong
+                    raise UnsupportedFeatureError(
+                        f"aggregate {op!r} over index-form dictionary "
+                        f"column {c!r} — use dict_form='gather'"
+                    )
+                _reject_lossy_double_col(c, cols[c], ctx[c][0])
+        group = None
+        gcap = 0
+        group_keys = None
+        if agg.group_by is not None:
+            gname = agg.group_by
+            if gname not in ctx or ctx[gname][3] is None:
+                raise UnsupportedFeatureError(
+                    f"group_by column {gname!r} is not index-form "
+                    "dictionary-encoded in this (multi-launch) group"
+                )
+            dvals = _pool_values(pools[gname])
+            group_keys = (
+                [v.item() for v in dvals]
+                if isinstance(dvals, np.ndarray) else list(dvals)
+            )
+            group = gname
+            gcap = max(len(group_keys), 1)
+        cplan = _CPlan(tree, "agg", 0, (), agg.aggs, group, gcap,
+                       len(masks), n)
+        built = BuiltCompute(request, cplan, [], group_keys)
+        fetched = [np.asarray(a) for a in eval_aggregates(cplan, ctx, sel)]
+        return PushdownResult(
+            {}, n, int(fetched[0].sum() if group else fetched[0]),
+            agg=partial_from_device(built, fetched),
+        )
+    count = int(jnp.sum(sel))
+    request.observe(count)
+    if request.mode == "mask":
+        return PushdownResult(dict(cols), n, count, mask=sel)
+    sel_idx = compact_indices(sel, max(count, 1), n)
+    out = {}
+    for name, dc in cols.items():
+        from .engine import DeviceColumn
+
+        nd = DeviceColumn(
+            dc.descriptor,
+            take_rows(dc.values, sel_idx)[:count],
+            None if dc.mask is None else take_rows(dc.mask, sel_idx)[:count],
+            None if dc.lengths is None
+            else take_rows(dc.lengths, sel_idx)[:count],
+        )
+        nd.dict_ref = dc.dict_ref
+        out[name] = nd
+    return PushdownResult(out, n, count)

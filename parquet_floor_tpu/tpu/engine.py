@@ -505,6 +505,7 @@ class _StagedGroup:
     #                                    (index-form numeric dictionaries)
     source: Optional[str] = None       # trace attribution: file path …
     group_index: int = -1              # … and row-group index
+    compute: Optional[object] = None   # compute.BuiltCompute (pushdown)
 
 
 # ---------------------------------------------------------------------------
@@ -608,12 +609,19 @@ def _decode_col(spec: _ColSpec, arena, slab, extras, perm=None):
     Kinds with no row-aligned intermediate (plain, bool, delta, host
     fallbacks, optional columns after dense scatter) gather their
     outputs instead.  Repeated leaves are not row-aligned at all — the
-    caller rejects them before tracing."""
+    caller rejects them before tracing.
+
+    Returns ``(vals, mask, lens, defs, reps, idx)`` — ``idx`` is the
+    ROW-ALIGNED dictionary index stream of dictionary kinds (None
+    elsewhere), which the pushdown compute tail evaluates against a
+    host-precomputed dictionary-match mask.  Programs without a compute
+    tail never emit it, so XLA dead-code-eliminates it for free."""
     # in-branch pushdown is only valid while the expansion streams are
     # row-aligned, i.e. for required columns; optional columns permute
     # after _finish_optional densifies them
     rp = perm if spec.max_def == 0 and spec.max_rep == 0 else None
     applied = False
+    idx_out = None
     if spec.kind == "host":
         u8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.n * spec.width,))
         vals = _typed(u8, spec.n, spec.width, spec.vdtype, spec.f64mode)
@@ -623,7 +631,7 @@ def _decode_col(spec: _ColSpec, arena, slab, extras, perm=None):
             mask = m != 0
         if perm is not None:
             vals, mask = _take_opt(vals, perm), _take_opt(mask, perm)
-        return vals, mask, None, None, None
+        return vals, mask, None, None, None, None
     if spec.kind == "host_rows":
         u8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.n * spec.width,))
         vals = u8.reshape(spec.n, spec.width)
@@ -633,7 +641,7 @@ def _decode_col(spec: _ColSpec, arena, slab, extras, perm=None):
             mask = m != 0
         if perm is not None:
             vals, mask = _take_opt(vals, perm), _take_opt(mask, perm)
-        return vals, mask, None, None, None
+        return vals, mask, None, None, None, None
     if spec.kind == "host_str":
         r8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.n * spec.max_len,))
         rows = r8.reshape(spec.n, spec.max_len)
@@ -648,28 +656,28 @@ def _decode_col(spec: _ColSpec, arena, slab, extras, perm=None):
                 _take_opt(rows, perm), _take_opt(mask, perm),
                 _take_opt(lens, perm),
             )
-        return rows, mask, lens, None, None
+        return rows, mask, lens, None, None, None
     if spec.kind == "hostr":
         # host-decoded repeated column: dense value stream + level arrays
         u8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.nexp * spec.width,))
         vals = _typed(u8, spec.nexp, spec.width, spec.vdtype, spec.f64mode)
         defs = _levels_i32(arena, slab, spec.sc_off + 1, spec.n)
         reps = _levels_i32(arena, slab, spec.sc_off + 2, spec.n)
-        return vals, None, None, defs, reps
+        return vals, None, None, defs, reps, None
     if spec.kind == "hostr_str":
         r8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.nexp * spec.max_len,))
         rows = r8.reshape(spec.nexp, spec.max_len)
         lens = _levels_i32(arena, slab, spec.sc_off + 1, spec.nexp)
         defs = _levels_i32(arena, slab, spec.sc_off + 2, spec.n)
         reps = _levels_i32(arena, slab, spec.sc_off + 3, spec.n)
-        return rows, None, lens, defs, reps
+        return rows, None, lens, defs, reps, None
     if spec.kind == "hostr_rows":
         # host-decoded repeated FLBA/INT96: dense 2-D byte rows + levels
         u8 = lax.dynamic_slice(arena, (slab[spec.sc_off],), (spec.nexp * spec.width,))
         rows = u8.reshape(spec.nexp, spec.width)
         defs = _levels_i32(arena, slab, spec.sc_off + 1, spec.n)
         reps = _levels_i32(arena, slab, spec.sc_off + 2, spec.n)
-        return rows, None, None, defs, reps
+        return rows, None, None, defs, reps, None
     # --- expansion-based kinds: dict / dict_str / plain / bool / delta ----
     if spec.kind == "dict":
         idx = _expand(arena, slab, spec.idx_off, spec.r_idx, spec.nexp, spec.pl_idx)
@@ -685,6 +693,7 @@ def _decode_col(spec: _ColSpec, arena, slab, extras, perm=None):
         dvals = _typed(du8, spec.dict_cap, spec.width, spec.vdtype, spec.f64mode)
         vals = jnp.take(dvals, idx, axis=0)
         lens = None
+        idx_out = idx
     elif spec.kind == "dict_str":
         rows_d = extras[2 * spec.extra_idx]
         lens_d = extras[2 * spec.extra_idx + 1]
@@ -694,6 +703,7 @@ def _decode_col(spec: _ColSpec, arena, slab, extras, perm=None):
             applied = True
         vals = jnp.take(rows_d, idx, axis=0)
         lens = jnp.take(lens_d, idx)
+        idx_out = idx
     elif spec.kind in ("dict_idx", "dict_idx_num"):
         # index-form dictionary column: the index stream IS the output,
         # packed to the narrowest dtype the pool size allows (consumers
@@ -710,6 +720,7 @@ def _decode_col(spec: _ColSpec, arena, slab, extras, perm=None):
         else:
             vals = idx
         lens = None
+        idx_out = idx
     elif spec.kind == "plain":
         if spec.p_pad == 1:
             u8 = lax.dynamic_slice(
@@ -813,23 +824,28 @@ def _decode_col(spec: _ColSpec, arena, slab, extras, perm=None):
         # (DeviceColumn.assemble) — return the dense value stream + levels
         defs = _expand(arena, slab, spec.lvl_off, spec.r_lvl, spec.n, spec.pl_lvl)
         reps = _expand(arena, slab, spec.rep_off, spec.r_rep, spec.n, spec.pl_rep)
-        return vals, None, lens, defs, reps
+        return vals, None, lens, defs, reps, None
     if spec.max_def > 0:
         present = _levels_present(arena, slab, spec)
         dense, mask, dlens = _finish_optional(vals, present, lens)
+        if idx_out is not None:
+            # row-aligned index stream for the compute tail (null rows
+            # scatter 0; selection leaves AND the presence mask back in)
+            idx_out = bitops.dense_scatter(idx_out, present)
         if perm is not None:
             # optional columns are row-aligned only after the dense
             # scatter — permute the densified outputs
             dense = jnp.take(dense, perm, axis=0)
             mask = jnp.take(mask, perm, axis=0)
             dlens = _take_opt(dlens, perm)
-        return dense, mask, dlens, None, None
+            idx_out = _take_opt(idx_out, perm)
+        return dense, mask, dlens, None, None, idx_out
     if perm is not None and not applied:
         # kinds with no row-aligned intermediate (plain / bool / delta):
         # gather the finished outputs
         vals = jnp.take(vals, perm, axis=0)
         lens = _take_opt(lens, perm)
-    return vals, None, lens, None, None
+    return vals, None, lens, None, None, idx_out
 
 
 @partial(jax.jit, static_argnums=(0, 1))
@@ -842,7 +858,9 @@ def _decode_fused(program: tuple, n_parts: int, *arrays):
     copy — negligible next to the host→device transfer it overlaps)."""
     parts, slab, extras = arrays[:n_parts], arrays[n_parts], arrays[n_parts + 1:]
     arena = parts[0] if n_parts == 1 else jnp.concatenate(parts)
-    return tuple(_decode_col(spec, arena, slab, extras) for spec in program)
+    return tuple(
+        _decode_col(spec, arena, slab, extras)[:5] for spec in program
+    )
 
 
 @partial(jax.jit, static_argnums=(0, 1))
@@ -860,7 +878,53 @@ def _decode_fused_perm(program: tuple, n_parts: int, *arrays):
     extras, perm = arrays[n_parts + 1:-1], arrays[-1]
     arena = parts[0] if n_parts == 1 else jnp.concatenate(parts)
     return tuple(
-        _decode_col(spec, arena, slab, extras, perm) for spec in program
+        _decode_col(spec, arena, slab, extras, perm)[:5] for spec in program
+    )
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _decode_fused_compute(program: tuple, n_parts: int, cplan, *arrays):
+    """:func:`_decode_fused` with the pushdown COMPUTE TAIL fused into
+    the SAME executable (``tpu.compute``, docs/pushdown.md): after the
+    per-column decode, the predicate tree evaluates into a selection
+    mask and — per ``cplan.mode`` — the launch emits compacted
+    surviving rows (``compact``), full columns plus the mask
+    (``mask``), or tiny partial-aggregate states (``agg``).  The
+    trailing ``cplan.n_masks`` arrays are the host-precomputed
+    dictionary-match masks; ``cplan`` itself is static, so every
+    distinct predicate/aggregate/capacity is its own executable — and
+    its own persistent exec-cache entry."""
+    from . import compute as _compute
+
+    parts, slab = arrays[:n_parts], arrays[n_parts]
+    rest = arrays[n_parts + 1:]
+    nm = cplan.n_masks
+    extras = rest[: len(rest) - nm] if nm else rest
+    masks = rest[len(rest) - nm:] if nm else ()
+    arena = parts[0] if n_parts == 1 else jnp.concatenate(parts)
+    full = [_decode_col(spec, arena, slab, extras) for spec in program]
+    ctx = {
+        spec.name: (f[0], f[1], f[2], f[5])
+        for spec, f in zip(program, full)
+    }
+    sel = _compute.eval_selection(cplan.tree, ctx, masks, cplan.n)
+    count = jnp.sum(sel).astype(jnp.int64)
+    if cplan.mode == "agg":
+        return count, _compute.eval_aggregates(cplan, ctx, sel)
+    keep = [
+        (spec, f) for spec, f in zip(program, full)
+        if spec.name in cplan.ship
+    ]
+    if cplan.mode == "mask":
+        return count, sel, tuple((f[0], f[1], f[2]) for _s, f in keep)
+    sel_idx = _compute.compact_indices(sel, cplan.capacity, cplan.n)
+    return count, tuple(
+        (
+            _compute.take_rows(f[0], sel_idx),
+            _compute.take_rows(f[1], sel_idx),
+            _compute.take_rows(f[2], sel_idx),
+        )
+        for _s, f in keep
     )
 
 
@@ -870,18 +934,26 @@ def _take_rows(perm, *arrays):
 
 
 def _run_fused(program: tuple, n_parts: int, args: list, has_perm: bool,
-               device=None):
+               device=None, cplan=None):
     """The ONE dispatch of a fused decode launch: every column of the
     row group (levels, index streams, gathers, null scatters, the
-    optional fused output permutation) executes as a single compiled
-    call — ``engine.launches`` counts exactly 1 per in-cap group.  With
-    a persistent executable cache active (``PFTPU_EXEC_CACHE``,
+    optional fused output permutation, and — with ``cplan`` — the
+    pushdown compute tail) executes as a single compiled call —
+    ``engine.launches`` counts exactly 1 per in-cap group.  With a
+    persistent executable cache active (``PFTPU_EXEC_CACHE``,
     :mod:`.exec_cache`), the compiled executable itself is resolved
     memory → disk → fresh AOT compile, so a repeated shape signature
-    skips XLA compilation even across processes."""
+    skips XLA compilation even across processes.  ``cplan`` is part of
+    the static signature, so pushdown programs cache separately per
+    predicate/aggregate/capacity."""
     from . import exec_cache
 
     trace.count("engine.launches")
+    if cplan is not None:
+        return exec_cache.dispatch(
+            _decode_fused_compute, (program, n_parts, cplan), args,
+            device=device,
+        )
     fn = _decode_fused_perm if has_perm else _decode_fused
     return exec_cache.dispatch(fn, (program, n_parts), args, device=device)
 
@@ -1995,6 +2067,12 @@ class TpuRowGroupReader:
         # every size-driven bucket order-independent (docs/perf.md)
         if int(_os.environ.get("PFTPU_STAGE_WORKERS", "1") or "1") > 1:
             self._preseed_buckets()
+        # eager exec-cache preload (docs/perf.md): deserialize persisted
+        # executables on a daemon thread NOW, so the per-entry wall hides
+        # behind the file opens/staging ahead of the first dispatch
+        from . import exec_cache as _ec
+
+        _ec.preload_async()
 
     # -- bucket bookkeeping -------------------------------------------------
 
@@ -2549,21 +2627,30 @@ class TpuRowGroupReader:
     # -- staging ------------------------------------------------------------
 
     def _stage_row_group(self, index: int, columns, covered=None,
-                         group_rows: int = 0, chunked=None) -> _StagedGroup:
+                         group_rows: int = 0, chunked=None,
+                         compute=None) -> _StagedGroup:
         src = getattr(self.reader.source, "name", None)
         with trace.span("stage", attrs={"file": src, "row_group": index}):
             sg = self._stage_row_group_untraced(
-                index, columns, covered, group_rows, chunked=chunked
+                index, columns, covered, group_rows, chunked=chunked,
+                compute=compute,
             )
         sg.source = src
         sg.group_index = index
         return sg
 
     def _stage_row_group_untraced(self, index: int, columns, covered=None,
-                                  group_rows: int = 0, chunked=None
-                                  ) -> _StagedGroup:
+                                  group_rows: int = 0, chunked=None,
+                                  compute=None) -> _StagedGroup:
         rg = self.reader.row_groups[index]
         want = set(columns) if columns else None
+        if compute is not None and want is not None:
+            # predicate/aggregate columns must stage (and decode) even
+            # when outside the projection; the cplan's ship set still
+            # honors the projection
+            want = want | {
+                c.split(".")[0] for c in compute[0].columns_needed()
+            }
         work = []
         for chunk in rg.columns or []:
             path = tuple(chunk.meta_data.path_in_schema)
@@ -2580,6 +2667,7 @@ class TpuRowGroupReader:
                 return self._try_stage(
                     rg, work, self._forced,
                     covered=covered, group_rows=group_rows, chunked=chunked,
+                    compute=compute,
                 )
             except _ForceHost as e:
                 # sticky per file: a column that needed the host path once
@@ -2636,7 +2724,8 @@ class TpuRowGroupReader:
         return (bw, span_off, len(tl), self._pl_interp, hbm_plan)
 
     def _try_stage(self, rg, work, forced, covered=None,
-                   group_rows: int = 0, chunked=None) -> _StagedGroup:
+                   group_rows: int = 0, chunked=None,
+                   compute=None) -> _StagedGroup:
         arena_b = _ArenaBuilder(plk.ARENA_LEAD if self._pl_enabled else 0)
         stages = []
         for name, chunk, desc in work:
@@ -2748,6 +2837,28 @@ class TpuRowGroupReader:
                 rs["extra_idx"] = extra_keys.index(key)
             specs.append(_ColSpec(**rs))
         slab = slabb.build(self._hwm(("slab",), slabb.n, minimum=256))
+        num_rows = (
+            sum(b - a for a, b in covered)
+            if covered is not None
+            else rg.num_rows or 0
+        )
+        built = None
+        if compute is not None:
+            # compile the pushdown compute tail against THIS staged
+            # program (the dictionary-match masks and group keys read
+            # the group's dictionaries straight out of the arena)
+            from . import compute as _compute
+
+            request, ship = compute
+            stages_by_name = {st.name: st for st in stages}
+            built = _compute.build_for_program(
+                request, tuple(specs), stages_by_name, arena, num_rows
+            )
+            if ship is not None:
+                built.cplan = built.cplan._replace(ship=tuple(
+                    s.name for s in specs
+                    if s.name in ship or s.name.split(".")[0] in ship
+                ))
         return _StagedGroup(
             program=tuple(specs),
             arena=arena,
@@ -2755,13 +2866,10 @@ class TpuRowGroupReader:
             descs=[d for _, _, d in work],
             extra_keys=extra_keys,
             new_extras=new_extras,
-            num_rows=(
-                sum(b - a for a, b in covered)
-                if covered is not None
-                else rg.num_rows or 0
-            ),
+            num_rows=num_rows,
             parts=parts,
             host_pools=host_pools or None,
+            compute=built,
         )
 
     # -- launch -------------------------------------------------------------
@@ -2780,6 +2888,11 @@ class TpuRowGroupReader:
         for _, rows, lens in extras:
             ship.append(rows)
             ship.append(lens)
+        if sg.compute is not None:
+            # dictionary-match masks of the compute tail: per-group
+            # device inputs, always LAST in the ship list (the decode
+            # path slices them off the tail)
+            ship.extend(sg.compute.masks)
         with trace.span("ship", sum(int(a.nbytes) for a in ship),
                         attrs={"file": sg.source,
                                "row_group": sg.group_index}):
@@ -2808,7 +2921,21 @@ class TpuRowGroupReader:
         permutation into the decode executable itself — every column
         comes back as ``x[perm]`` for the price of a reordered output
         write (the loader's window shuffle).  Repeated leaves are not
-        row-aligned and reject it."""
+        row-aligned and reject it.
+
+        Groups staged WITH a compute tail (``sg.compute``) dispatch the
+        pushdown executable instead and return a
+        :class:`~parquet_floor_tpu.tpu.compute.PushdownResult`."""
+        if sg.compute is not None:
+            if out_perm is not None:
+                from ..errors import UnsupportedFeatureError
+
+                raise UnsupportedFeatureError(
+                    "out_perm and pushdown compute cannot fuse into one "
+                    "launch (a compacted output has no stable row order "
+                    "to permute)"
+                )
+            return self._decode_shipped_compute(sg, shipped)
         first, slab_dev = shipped[0], shipped[1]
         parts = first if isinstance(first, tuple) else (first,)
         extra_args = []
@@ -2849,22 +2976,189 @@ class TpuRowGroupReader:
             sg.program, sg.descs, outs
         ):
             dc = DeviceColumn(desc, vals, mask, lens, defs, reps)
-            if spec.kind == "dict_idx":
-                # the engine's content key (digest, cap, max_len) rides
-                # along as the STABLE cache identity — consumers must not
-                # key pool caches by id() (ids are reused after GC)
-                key = sg.extra_keys[spec.extra_idx]
-                with self._lock:
-                    host_pool = self._sdict_host.get(key)
-                dc.dict_ref = (
-                    ("host_str", key, *host_pool)
-                    if host_pool is not None
-                    else ("dev", key, *self._sdict_dev[key])
-                )
-            elif spec.kind == "dict_idx_num":
-                dc.dict_ref = ("host", None, sg.host_pools[spec.name])
+            dc.dict_ref = self._dict_ref_for(spec, sg)
             result[spec.name] = dc
         return result
+
+    def _dict_ref_for(self, spec: _ColSpec, sg: _StagedGroup):
+        """The stable pool reference of an index-form dictionary column
+        (None for every other kind).  The engine's content key (digest,
+        cap, max_len) rides along as the STABLE cache identity —
+        consumers must not key pool caches by id() (ids are reused
+        after GC)."""
+        if spec.kind == "dict_idx":
+            key = sg.extra_keys[spec.extra_idx]
+            with self._lock:
+                host_pool = self._sdict_host.get(key)
+            return (
+                ("host_str", key, *host_pool)
+                if host_pool is not None
+                else ("dev", key, *self._sdict_dev[key])
+            )
+        if spec.kind == "dict_idx_num":
+            return ("host", None, sg.host_pools[spec.name])
+        return None
+
+    def _decode_shipped_compute(self, sg: _StagedGroup, shipped: list):
+        """Dispatch the fused decode+compute executable over shipped
+        buffers and shape the :class:`~.compute.PushdownResult`
+        (docs/pushdown.md).  Compact mode fetches the (tiny) selected
+        count; a count past the static capacity re-dispatches ONCE with
+        a grown capacity (``engine.pushdown_overflows``) — a wrong
+        (clipped) result can never escape."""
+        from . import compute as _compute
+
+        built = sg.compute
+        first, slab_dev = shipped[0], shipped[1]
+        parts = first if isinstance(first, tuple) else (first,)
+        extra_args = []
+        for key in sg.extra_keys:
+            rows_d, lens_d = self._sdict_dev[key]
+            extra_args.append(rows_d)
+            extra_args.append(lens_d)
+        nm = len(built.masks)
+        mask_devs = list(shipped[len(shipped) - nm:]) if nm else []
+        args = [*parts, slab_dev, *extra_args, *mask_devs]
+
+        def dispatch(cplan):
+            with trace.span("decode", attrs={"file": sg.source,
+                                             "row_group": sg.group_index,
+                                             "rows": sg.num_rows}):
+                return _run_fused(
+                    sg.program, len(parts), args, False,
+                    device=self.device, cplan=cplan,
+                )
+
+        cp = built.cplan
+        outs = dispatch(cp)
+        trace.count("engine.pushdown_groups")
+        trace.count("engine.pushdown_rows_in", int(cp.n))
+        if cp.mode == "agg":
+            count_dev, agg_outs = outs
+            fetched = [np.asarray(a) for a in agg_outs]
+            partial = _compute.partial_from_device(built, fetched)
+            count = int(count_dev)
+            trace.count("engine.pushdown_rows_selected", count)
+            return _compute.PushdownResult({}, cp.n, count, agg=partial)
+        desc_by = {s.name: d for s, d in zip(sg.program, sg.descs)}
+        spec_by = {s.name: s for s in sg.program}
+        if cp.mode == "mask":
+            count_dev, sel, col_outs = outs
+            count = int(count_dev)
+            built.request.observe(count)
+            trace.count("engine.pushdown_rows_selected", count)
+            cols = self._compute_columns(
+                cp.ship, col_outs, desc_by, spec_by, sg, trim=None
+            )
+            return _compute.PushdownResult(cols, cp.n, count, mask=sel)
+        count = int(outs[0])
+        if count > cp.capacity:
+            trace.count("engine.pushdown_overflows")
+            built.request.observe(count)
+            built.cplan = cp = cp._replace(
+                capacity=max(1, min(cp.n, _bucket15(count)))
+            )
+            outs = dispatch(cp)
+            count = int(outs[0])
+        built.request.observe(count)
+        trace.count("engine.pushdown_rows_selected", count)
+        cols = self._compute_columns(
+            cp.ship, outs[1], desc_by, spec_by, sg, trim=count
+        )
+        return _compute.PushdownResult(cols, cp.n, count)
+
+    def _compute_columns(self, ship, col_outs, desc_by, spec_by, sg,
+                         trim):
+        """DeviceColumns from a compute launch's column outputs
+        (``trim`` slices capacity-padded compact outputs to the true
+        selected count)."""
+        cols: Dict[str, DeviceColumn] = {}
+        for name, (vals, mask, lens) in zip(ship, col_outs):
+            if trim is not None:
+                vals = vals[:trim]
+                mask = None if mask is None else mask[:trim]
+                lens = None if lens is None else lens[:trim]
+            dc = DeviceColumn(desc_by[name], vals, mask, lens)
+            dc.dict_ref = self._dict_ref_for(spec_by[name], sg)
+            cols[name] = dc
+        return cols
+
+    def read_row_group_compute(self, index: int, request,
+                               columns: Optional[Sequence[str]] = None,
+                               covered=None):
+        """Decode one row group WITH the pushdown compute tail — filter
+        (compacted or masked) or partial aggregates — in one fused
+        launch (docs/pushdown.md).  ``request`` is a
+        :class:`~parquet_floor_tpu.tpu.compute.ComputeRequest`;
+        ``columns`` restricts what ships (predicate/aggregate columns
+        are staged regardless); ``covered`` optionally narrows the
+        decode to page-aligned row ranges (the page-prune rung —
+        filtering the cover equals filtering the group, since the cover
+        is a superset of every matching row).  Over-cap groups decode
+        via the multi-launch chunked path and evaluate the request as
+        follow-up device ops — same results, counted by the usual
+        chunked-fallback accounting."""
+        from . import compute as _compute
+        from ..errors import UnsupportedFeatureError
+
+        if self._salvage:
+            raise UnsupportedFeatureError(
+                "pushdown compute does not run under salvage (quarantine "
+                "decisions are group-wide; scan with salvage and filter "
+                "on host)"
+            )
+        rg = self.reader.row_groups[index]
+        need = request.columns_needed()
+        want = (
+            None if columns is None
+            else sorted(set(columns) | {c.split(".")[0] for c in need})
+        )
+        ship = set(columns) if columns is not None else None
+        n = int(rg.num_rows or 0)
+        est = self._group_byte_estimate(rg, set(want) if want else None)
+        if covered is not None:
+            cov_rows = sum(b - a for a, b in covered)
+            if cov_rows == 0:
+                return _compute.PushdownResult(
+                    {}, 0, 0,
+                    agg=(None if request.aggregate is None
+                         else _compute.AggPartial(request.aggregate)),
+                )
+            if cov_rows * (est / max(n, 1)) > self._arena_cap:
+                cols, _cov = self.read_row_group_ranges(
+                    index, covered, want
+                )
+                return self._compute_fallback(cols, request, ship)
+            sg = self._stage_row_group(
+                index, want, covered=covered, group_rows=n,
+                compute=(request, ship),
+            )
+            return self._decode_shipped_compute(sg, self._ship(sg))
+        if est > self._arena_cap:
+            cols = self._read_row_group_chunked(rg, index,
+                                                set(want) if want else None)
+            return self._compute_fallback(cols, request, ship)
+        sg = self._stage_row_group(index, want, compute=(request, ship))
+        return self._decode_shipped_compute(sg, self._ship(sg))
+
+    def _compute_fallback(self, cols, request, ship):
+        """Evaluate a request over already-decoded columns (multi-launch
+        groups) and restrict the shipped projection."""
+        from . import compute as _compute
+
+        n = (
+            int(next(iter(cols.values())).values.shape[0]) if cols else 0
+        )
+        res = _compute.eval_on_columns(cols, request, n)
+        trace.count("engine.pushdown_groups")
+        trace.count("engine.pushdown_rows_in", n)
+        trace.count("engine.pushdown_rows_selected", res.num_selected)
+        if ship is not None:
+            res.columns = {
+                k: v for k, v in res.columns.items()
+                if k in ship or k.split(".")[0] in ship
+            }
+        return res
 
     def _launch(self, sg: _StagedGroup, out_perm=None
                 ) -> Dict[str, DeviceColumn]:
@@ -2898,8 +3192,12 @@ def iter_dataset_row_groups(tasks, columns: Optional[Sequence[str]] = None,
     ``tasks`` may also be an ITERATOR (anything that is not a
     list/tuple) — the windowed form shuffled training epochs over
     fd-limit-sized datasets need.  Iterator items are ``(reader,
-    group_index)``, ``(reader, group_index, close_after)`` or ``(reader,
-    group_index, close_after, out_perm)``, where ``reader`` may be a
+    group_index)`` optionally extended positionally with
+    ``close_after``, ``out_perm``, ``compute`` (a
+    :class:`~.compute.ComputeRequest` — the group decodes WITH the
+    pushdown tail and yields a ``PushdownResult``, docs/pushdown.md)
+    and ``covered`` (a page-aligned row cover — the group stages only
+    those rows, the device page-prune rung), where ``reader`` may be a
     zero-argument callable returning a ``TpuRowGroupReader`` (a lazy
     open: the file's footer is not touched until the pipeline pulls the
     task, DEPTH ahead of consumption) and ``close_after=True`` marks the
@@ -2996,16 +3294,21 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
 
     def norm(item):
         """Resolve one task item to (reader, group_index, close_after,
-        out_perm), opening lazy readers (and recording ownership) on the
-        way."""
+        out_perm, compute, covered), opening lazy readers (and recording
+        ownership) on the way.  ``compute`` is a
+        :class:`~.compute.ComputeRequest` (pushdown — docs/pushdown.md);
+        ``covered`` a page-aligned row cover (the device scan leg's
+        page-prune rung)."""
         r, gi = item[0], item[1]
         ca = bool(item[2]) if len(item) > 2 else False
         perm = item[3] if len(item) > 3 else None
+        comp = item[4] if len(item) > 4 else None
+        cov = item[5] if len(item) > 5 else None
         if callable(r) and not isinstance(r, TpuRowGroupReader):
             r = r()
             if not any(o is r for o in owned):
                 owned.append(r)
-        return r, int(gi), ca, perm
+        return r, int(gi), ca, perm, comp, cov
 
     def retire(r):
         """Close a reader whose last scheduled group was just consumed."""
@@ -3014,11 +3317,25 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
         closed.append(r)
         r.close()
 
+    def read_direct(r, gi, perm, comp, cov):
+        """One unpipelined read honoring every task flavor (the
+        no-prefetch path and the drain-then-chunk big-group path)."""
+        if comp is not None:
+            return r.read_row_group_compute(
+                gi, comp, columns=columns, covered=cov
+            )
+        if cov is not None:
+            cols, _covered = r.read_row_group_ranges(gi, cov, columns)
+            if perm is not None:
+                cols = _permuted_columns(cols, perm)
+            return cols
+        return r.read_row_group(gi, columns, out_perm=perm)
+
     try:
         if not prefetch:
             for item in task_iter:
-                r, gi, ca, perm = norm(item)
-                yield r.read_row_group(gi, columns, out_perm=perm)
+                r, gi, ca, perm, comp, cov = norm(item)
+                yield read_direct(r, gi, perm, comp, cov)
                 if ca:
                     retire(r)
             return
@@ -3059,23 +3376,29 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
                 item = next(task_iter, None)
                 if item is None:
                     return False
-                r, gi, ca, perm = norm(item)
+                r, gi, ca, perm, comp, cov = norm(item)
                 if getattr(r, "_salvage", False):
                     f = sp.submit(tracer.run, salv_task, r, gi, perm)
                     q.append(("salv", r, ca, f))
                     trace.gauge_max("engine.stage_queue_depth_max", len(q))
                     return True
-                big = (
-                    r._group_byte_estimate(r.reader.row_groups[gi], want)
-                    > r._arena_cap
-                )
+                rg = r.reader.row_groups[gi]
+                est = r._group_byte_estimate(rg, want)
+                if cov is not None:
+                    # a page-pruned group stages only its covered rows:
+                    # scale the footer estimate by the cover fraction
+                    n_all = max(int(rg.num_rows or 0), 1)
+                    est = int(est * min(
+                        sum(b - a for a, b in cov) / n_all, 1.0
+                    ))
+                big = est > r._arena_cap
                 if big:
                     # drain-then-chunk, exactly the eager path's contract:
                     # everything already queued delivers first, nothing
                     # new submits, so when this entry is popped both
                     # workers are idle and the multi-launch chunk path
                     # owns the link
-                    q.append(("big", r, gi, ca, perm))
+                    q.append(("big", r, gi, ca, perm, comp, cov))
                     blocked = True
                 else:
                     # chunked=False: intra-group chunked shipping would
@@ -3083,9 +3406,19 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
                     # with the ship worker's — two streams contend on
                     # tunnelled links (single-group reads take
                     # read_row_group's chunked path instead)
+                    kwargs = dict(chunked=False)
+                    if cov is not None:
+                        kwargs.update(
+                            covered=cov, group_rows=int(rg.num_rows or 0)
+                        )
+                    if comp is not None:
+                        kwargs.update(compute=(
+                            comp, set(columns) if columns else None
+                        ))
                     f = sp.submit(
-                        tracer.run, r._stage_row_group, gi, columns,
-                        chunked=False,
+                        tracer.run, partial(
+                            r._stage_row_group, gi, columns, **kwargs
+                        ),
                     )
                     q.append((
                         "pipe", r, ca, perm,
@@ -3100,8 +3433,8 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
             while q:
                 entry = q.popleft()
                 if entry[0] == "big":
-                    _, r, gi, ca, perm = entry
-                    yield r.read_row_group(gi, columns, out_perm=perm)
+                    _, r, gi, ca, perm, comp, cov = entry
+                    yield read_direct(r, gi, perm, comp, cov)
                     blocked = False
                 elif entry[0] == "salv":
                     _, r, ca, fut = entry

@@ -45,6 +45,7 @@ The cache is OFF unless ``PFTPU_EXEC_CACHE`` names a directory (or a
 
 from __future__ import annotations
 
+import atexit
 import hashlib
 import json
 import os
@@ -84,6 +85,41 @@ def _env_signature() -> dict:
 
 _compile_lock = threading.Lock()
 
+# Interpreter-exit protocol for in-flight preloads: a DAEMON thread
+# reaped mid-XLA-deserialize aborts the whole process ("terminate
+# called without an active exception"), and a plain non-daemon thread
+# would stall exit through every remaining entry (threading joins
+# non-daemon threads BEFORE atexit handlers run, so an atexit stop flag
+# fires too late).  Instead: daemon threads + a stop event raised from
+# ``threading._register_atexit`` — those callbacks run at the START of
+# threading's shutdown, before any join and before teardown reaps
+# daemons — then an explicit join, so exit waits at most ONE entry's
+# deserialize.  (Fallback for interpreters without the private hook:
+# plain atexit, which for daemon threads still runs before teardown.)
+_preload_stop = threading.Event()
+_preload_threads: list = []
+_preload_reg_lock = threading.Lock()
+_preload_registered = False
+
+
+def _stop_preloads() -> None:
+    _preload_stop.set()
+    for t in _preload_threads:
+        t.join()
+
+
+def _register_preload_shutdown() -> None:
+    global _preload_registered
+    with _preload_reg_lock:
+        if _preload_registered:
+            return
+        _preload_registered = True
+    reg = getattr(threading, "_register_atexit", None)
+    if reg is not None:
+        reg(_stop_preloads)
+    else:  # pragma: no cover - older interpreters
+        atexit.register(_stop_preloads)
+
 
 def _compile_fresh(jitfn, static_args, args):
     """``lower().compile()`` with jax's OWN persistent compilation
@@ -113,13 +149,17 @@ class _Entry:
     successful call — a freshly DESERIALIZED executable gets one guarded
     invocation, so an entry that loads but cannot run on this runtime
     (driver/topology drift the header could not see) falls back to a
-    fresh compile instead of poisoning the decode path."""
+    fresh compile instead of poisoning the decode path.  ``preloaded``
+    marks entries the eager PRELOAD deserialized ahead of use — their
+    first resolution still counts as a cache hit (the accounting must
+    not depend on who paid the deserialize wall)."""
 
-    __slots__ = ("loaded", "trusted")
+    __slots__ = ("loaded", "trusted", "preloaded")
 
-    def __init__(self, loaded, trusted: bool):
+    def __init__(self, loaded, trusted: bool, preloaded: bool = False):
         self.loaded = loaded
         self.trusted = trusted
+        self.preloaded = preloaded
 
 
 class ExecutableCache:
@@ -146,6 +186,7 @@ class ExecutableCache:
         self._mem: dict = {}         # key hex → _Entry
         self._key_cache: dict = {}   # signature tuple → key hex
         self._env = None             # computed lazily (needs a backend)
+        self._preload_done = False
 
     # -- keying --------------------------------------------------------------
 
@@ -322,6 +363,60 @@ class ExecutableCache:
                 "max_bytes": self.max_bytes,
             })
 
+    # -- preload -------------------------------------------------------------
+
+    def preload(self, limit: int = _MAX_MEMORY) -> int:
+        """Eagerly deserialize up to ``limit`` disk entries into memory
+        (most recently used first — mtime order), so the ~0.2-0.3 s/entry
+        deserialize wall is paid BEFORE the first decode needs the
+        executable.  The engine calls this on a background thread at
+        reader construction (``preload_async``), hiding the wall behind
+        file opens; the first dispatch that finds a preloaded entry
+        still counts an ``engine.exec_cache_hits`` resolution, so
+        cold/warm accounting is preload-agnostic.  Idempotent per cache
+        object; returns the number of entries loaded this call."""
+        with self._lock:
+            if self._preload_done:
+                return 0
+            self._preload_done = True
+        t0 = time.perf_counter()
+        try:
+            names = [
+                n for n in os.listdir(self.path) if n.endswith(".pfexec")
+            ]
+        except OSError:
+            return 0
+
+        def mtime(n: str) -> float:
+            try:
+                return os.stat(os.path.join(self.path, n)).st_mtime
+            except OSError:
+                return 0.0
+
+        names.sort(key=mtime, reverse=True)
+        loaded = 0
+        for n in names[: max(int(limit), 0)]:
+            if _preload_stop.is_set():
+                break  # interpreter exiting: stop at an entry boundary
+            key = n[: -len(".pfexec")]
+            with self._lock:
+                if key in self._mem or len(self._mem) >= _MAX_MEMORY:
+                    continue
+            exe = self._load_disk(key)
+            if exe is None:
+                continue
+            with self._lock:
+                if key not in self._mem and len(self._mem) < _MAX_MEMORY:
+                    self._mem[key] = _Entry(exe, trusted=False,
+                                            preloaded=True)
+                    loaded += 1
+        trace.decision("engine.exec_cache", {
+            "action": "preload",
+            "entries": loaded,
+            "wall_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        })
+        return loaded
+
     # -- resolution ----------------------------------------------------------
 
     def _compile(self, jitfn, static_args, args, key: str, why: str):
@@ -353,6 +448,17 @@ class ExecutableCache:
         key = self._key(sig)
         with self._lock:
             entry = self._mem.get(key)
+            preload_hit = entry is not None and entry.preloaded
+            if preload_hit:
+                entry.preloaded = False
+        if preload_hit:
+            # first resolution of a PRELOADED entry: same accounting as
+            # a direct disk hit — preload only moved the deserialize
+            # wall, never the hit/miss truth
+            trace.count("engine.exec_cache_hits")
+            trace.decision("engine.exec_cache", {
+                "action": "hit", "key": key[:12], "via": "preload",
+            })
         if entry is None:
             loaded = self._load_disk(key)
             if loaded is not None:
@@ -442,3 +548,30 @@ def dispatch(jitfn, static_args: tuple, args: list, device=None):
     if cache is None:
         return jitfn(*static_args, *args)
     return cache.call(jitfn, static_args, args, device=device)
+
+
+def preload_async() -> Optional[threading.Thread]:
+    """Kick the active ENV-configured cache's :meth:`preload` onto a
+    daemon thread (the engine calls this at reader construction, so the
+    deserialize wall hides behind footer opens).  A test-forced cache
+    (:func:`activate`) is never auto-preloaded — tests call
+    ``preload()`` synchronously to stay deterministic.  Disable with
+    ``PFTPU_EXEC_CACHE_PRELOAD=0``.  Returns the thread, or None when
+    there is nothing to do."""
+    if _forced is not None:
+        return None
+    if os.environ.get("PFTPU_EXEC_CACHE_PRELOAD", "1") == "0":
+        return None
+    cache = active()
+    if cache is None:
+        return None
+    with cache._lock:
+        if cache._preload_done:
+            return None
+    _register_preload_shutdown()
+    t = threading.Thread(
+        target=cache.preload, name="pftpu-exec-preload", daemon=True
+    )
+    _preload_threads.append(t)
+    t.start()
+    return t

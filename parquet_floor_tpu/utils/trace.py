@@ -95,6 +95,13 @@ class names:
         # predicate page pruning on the scan face (scan/plan.py,
         # docs/scan.md): data pages skipped via row_ranges→OffsetIndex
         "scan.pages_pruned",
+        # device pushdown compute (tpu/compute.py, docs/pushdown.md)
+        "engine.pushdown_groups",
+        "engine.pushdown_rows_in",
+        "engine.pushdown_rows_selected",
+        "engine.pushdown_overflows",
+        "scan.rows_filtered_device",
+        "serve.aggregate_probes",
         # the multi-tenant serving layer (serve/, docs/serving.md)
         "serve.cache_hits",
         "serve.cache_misses",
@@ -152,6 +159,7 @@ class names:
         "data.unit_quarantined",
         "serve.tenant",
         "serve.admission",
+        "engine.pushdown",
     })
     SPANS = frozenset({
         "read",
@@ -166,6 +174,7 @@ class names:
         "data.next_batch",
         "data.prefetch_to_device",
         "serve.lookup",
+        "serve.aggregate",
     })
     ALL = COUNTERS | GAUGES | DECISIONS | SPANS
 

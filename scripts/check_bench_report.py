@@ -62,6 +62,7 @@ def check_report(bench_log: pathlib.Path) -> int:
         or check_exec_cache_leg(result.get("detail", {}))
         or check_launches(result.get("detail", {}))
         or check_loader_leg(result.get("detail", {}))
+        or check_pushdown_leg(result.get("detail", {}))
     )
 
 
@@ -129,6 +130,53 @@ def check_launches(detail: dict) -> int:
                     "launches than groups is impossible")
     print(f"check_bench_report: one-launch ok ({launches} launches / "
           f"{groups} groups, {overcap} over-cap)")
+    return 0
+
+
+def check_pushdown_leg(detail: dict) -> int:
+    """Device pushdown compute (docs/pushdown.md): the selective filter
+    scan must ship ≤ 0.1x the ship-columns baseline's D2H bytes with
+    results bit-identical to pyarrow.compute, the one-launch contract
+    must hold WITH the compute tail fused (launches == groups + counted
+    capacity overflows; the ~1% bench filter must see zero overflows),
+    and the group-by aggregate must be bit-equal to pyarrow's
+    group_by().aggregate with O(groups) D2H."""
+    groups = detail.get("pushdown_groups")
+    if not groups or not groups > 0:
+        return fail("pushdown leg delivered no groups")
+    launches = detail.get("pushdown_launches")
+    overflows = detail.get("pushdown_overflows", 0)
+    if overflows != 0:
+        return fail(f"pushdown leg hit {overflows} capacity overflow(s) "
+                    "on a ~1% filter — the initial-capacity policy "
+                    "regressed")
+    if launches != groups:
+        return fail(f"pushdown leg dispatched {launches} launches for "
+                    f"{groups} groups — the compute tail must fuse into "
+                    "the ONE decode launch")
+    if not detail.get("pushdown_filter_exact"):
+        return fail("pushdown filter results are not bit-identical to "
+                    "pyarrow.compute")
+    if not detail.get("pushdown_agg_exact"):
+        return fail("pushdown group-by aggregate is not bit-equal to "
+                    "pyarrow group_by().aggregate")
+    ratio = detail.get("pushdown_d2h_ratio")
+    if ratio is None or ratio > 0.1:
+        return fail(f"pushdown filter scan shipped {ratio}x the "
+                    "ship-columns baseline's D2H bytes (must be <= 0.1x)")
+    agg_bytes = detail.get("pushdown_agg_d2h_bytes", 0)
+    base = detail.get("pushdown_baseline_d2h_bytes", 0)
+    if not agg_bytes or agg_bytes > 0.1 * base:
+        return fail(f"aggregate D2H {agg_bytes} B is not O(groups) "
+                    f"(baseline {base} B)")
+    print(
+        "check_bench_report: pushdown leg ok "
+        f"({detail.get('pushdown_rows_selected')}/"
+        f"{detail.get('pushdown_rows_in')} rows shipped, "
+        f"D2H {ratio}x baseline, {launches} launches / {groups} groups, "
+        f"agg {detail.get('pushdown_agg_groups')} keys "
+        f"{agg_bytes} B)"
+    )
     return 0
 
 

@@ -33,6 +33,12 @@ def main(argv) -> int:
         return 2
     path, cache_dir = argv[1], argv[2]
     os.environ["PFTPU_EXEC_CACHE"] = cache_dir
+    # the probe measures the DISPATCH-path resolution (memory → disk →
+    # compile); the eager background preload would deserialize the same
+    # entry on a second thread concurrently, contending with the timed
+    # wall without changing what is measured — keep the measurement
+    # clean (preload has its own tests and accounting)
+    os.environ.setdefault("PFTPU_EXEC_CACHE_PRELOAD", "0")
 
     import numpy as np
 

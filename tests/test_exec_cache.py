@@ -619,3 +619,128 @@ def test_no_preseed_at_single_stage_worker(tmp_path, monkeypatch):
     path = _write_plain_ints(tmp_path, "np.parquet")
     with TpuRowGroupReader(path, float64_policy="bits") as tr:
         assert tr._hwm_state == {}
+
+
+# ---------------------------------------------------------------------------
+# eager preload (docs/perf.md)
+# ---------------------------------------------------------------------------
+
+def test_preload_populates_memory_then_hits(tmp_path):
+    """preload() deserializes disk entries ahead of use; the first
+    dispatch that finds one still counts a cache HIT with zero compile
+    wall (accounting is preload-agnostic)."""
+    path = _write(tmp_path)
+    cache_dir = tmp_path / "cache"
+    want, c1 = _decode(path, cache_dir)
+    assert c1.get("engine.exec_cache_misses", 0) >= 1
+
+    fresh = exec_cache.ExecutableCache(str(cache_dir))
+    with trace.scope() as t:
+        n = fresh.preload()
+    assert n >= 1
+    assert len(fresh._mem) >= 1
+    acts = [d for d in t.decisions()
+            if d.get("decision") == "engine.exec_cache"
+            and d.get("action") == "preload"]
+    assert acts and acts[0]["entries"] == n
+    # second preload is a no-op (idempotent per cache object)
+    assert fresh.preload() == 0
+
+    exec_cache.activate(fresh)
+    try:
+        with trace.scope() as t2:
+            with TpuRowGroupReader(path, float64_policy="bits") as tr:
+                cols = tr.read_row_group(0)
+                jax.block_until_ready([c.values for c in cols.values()])
+                got = {
+                    k: np.asarray(v.values) for k, v in cols.items()
+                }
+        c2 = t2.counters()
+        assert c2.get("engine.exec_cache_hits", 0) >= 1
+        assert c2.get("engine.exec_cache_misses", 0) == 0
+        assert c2.get("engine.compile_ms", 0) == 0
+        for k in want:
+            assert np.array_equal(got[k], want[k][0])
+    finally:
+        exec_cache.activate(None)
+
+
+def test_preload_async_env_trigger(tmp_path, monkeypatch):
+    """Reader construction kicks the env-configured cache's preload on
+    a background thread; a test-forced cache is never auto-preloaded."""
+    path = _write(tmp_path)
+    cache_dir = tmp_path / "cache"
+    _decode(path, cache_dir)  # seed one entry on disk
+
+    monkeypatch.setenv("PFTPU_EXEC_CACHE", str(cache_dir))
+    exec_cache.activate(None)
+    t = exec_cache.preload_async()
+    assert t is not None
+    t.join(30)
+    cache = exec_cache.active()
+    assert len(cache._mem) >= 1
+    # idempotent: the engine's constructor hook finds it already done
+    assert exec_cache.preload_async() is None
+    # gate: PFTPU_EXEC_CACHE_PRELOAD=0 disables
+    monkeypatch.setenv("PFTPU_EXEC_CACHE_PRELOAD", "0")
+    exec_cache._caches.pop(str(cache_dir), None)
+    assert exec_cache.preload_async() is None
+    # forced caches (the test hook) never auto-preload
+    monkeypatch.delenv("PFTPU_EXEC_CACHE_PRELOAD", raising=False)
+    exec_cache.activate(exec_cache.ExecutableCache(str(cache_dir)))
+    assert exec_cache.preload_async() is None
+
+
+# ---------------------------------------------------------------------------
+# loader batch shapes ride the exec cache (docs/perf.md, PR 8 follow-on)
+# ---------------------------------------------------------------------------
+
+def _batch_parts(n=64):
+    import jax.numpy as jnp
+
+    from parquet_floor_tpu.data.batcher import ColumnSpec
+
+    specs = [
+        ColumnSpec("a", None, is_string=False, has_mask=False),
+        ColumnSpec("b", None, is_string=False, has_mask=True),
+    ]
+    parts = [
+        (jnp.arange(n, dtype=jnp.int64), None, None),
+        (jnp.arange(n, dtype=jnp.int32), jnp.zeros(n, bool), None),
+    ]
+    return specs, parts
+
+
+def test_batcher_split_and_assemble_ride_exec_cache(tmp_path):
+    """fused_assemble/aligned_split dispatch through exec_cache: a cold
+    'process' compiles+stores, a fresh cache object over the same dir
+    (a new process's shape) hits with zero compile wall."""
+    from parquet_floor_tpu.data.batcher import aligned_split, fused_assemble
+
+    cache_dir = tmp_path / "cache"
+    specs, parts = _batch_parts()
+
+    def run():
+        with trace.scope() as t:
+            out = aligned_split(specs, parts, {}, 2)
+            windows = [[(p, 0, 32)] for p in parts]
+            out2 = fused_assemble(specs, windows, {}, pad=0, split=1)
+        return out, out2, t.counters()
+
+    exec_cache.activate(exec_cache.ExecutableCache(str(cache_dir)))
+    try:
+        cold_split, cold_asm, c_cold = run()
+        assert c_cold.get("engine.exec_cache_misses", 0) >= 2
+        exec_cache.activate(exec_cache.ExecutableCache(str(cache_dir)))
+        warm_split, warm_asm, c_warm = run()
+        assert c_warm.get("engine.exec_cache_hits", 0) >= 2
+        assert c_warm.get("engine.exec_cache_misses", 0) == 0
+        assert c_warm.get("engine.compile_ms", 0) == 0
+    finally:
+        exec_cache.activate(None)
+    for cb, wb in zip(cold_split, warm_split):
+        for (cv, cm, _), (wv, wm, _) in zip(cb, wb):
+            assert np.array_equal(np.asarray(cv), np.asarray(wv))
+            assert (cm is None) == (wm is None)
+    for (cv, _cm, _), (wv, _wm, _) in zip(cold_asm[0], warm_asm[0]):
+        assert np.array_equal(np.asarray(cv), np.asarray(wv))

@@ -1,0 +1,781 @@
+"""Device pushdown compute (docs/pushdown.md): differential filter and
+aggregate tests against pyarrow.compute oracles, the one-launch /
+capacity-overflow contract, exec-cache key separation, the chunked
+over-cap fallback, the device page-prune rung, and the host twins
+(eval_mask / host_partial / scan_aggregate / serve.Dataset.aggregate)."""
+
+import os
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+pc = pytest.importorskip("pyarrow.compute")
+pq = pytest.importorskip("pyarrow.parquet")
+
+from parquet_floor_tpu import (  # noqa: E402
+    Aggregate,
+    ParquetFileWriter,
+    WriterOptions,
+    col,
+    types,
+)
+from parquet_floor_tpu.batch.aggregate import AggPartial, host_partial  # noqa: E402
+from parquet_floor_tpu.batch.predicate import eval_mask, tree, tree_columns  # noqa: E402
+from parquet_floor_tpu.errors import UnsupportedFeatureError  # noqa: E402
+from parquet_floor_tpu.format.file_read import ReaderOptions  # noqa: E402
+from parquet_floor_tpu.scan import (  # noqa: E402
+    DatasetScanner,
+    ScanOptions,
+    scan_aggregate,
+    scan_device_groups,
+)
+from parquet_floor_tpu.tpu import exec_cache  # noqa: E402
+from parquet_floor_tpu.tpu.compute import ComputeRequest  # noqa: E402
+from parquet_floor_tpu.tpu.engine import TpuRowGroupReader  # noqa: E402
+from parquet_floor_tpu.utils import trace  # noqa: E402
+
+rng = np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _no_cache(monkeypatch):
+    monkeypatch.delenv("PFTPU_EXEC_CACHE", raising=False)
+    exec_cache.activate(None)
+    yield
+    exec_cache.activate(None)
+
+
+def _write_mixed(tmp_path, name="mixed.parquet", n=900, group=300,
+                 with_nan=False):
+    """Our writer: flat ints, optional int32, float32, DOUBLE, dict
+    strings — 3 row groups."""
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.optional(types.INT32).named("v"),
+        types.required(types.FLOAT).named("f"),
+        types.required(types.DOUBLE).named("d"),
+        types.required(types.BYTE_ARRAY).as_(types.string()).named("cat"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("tag"),
+    )
+    path = tmp_path / name
+    cats = ["apple", "pear", "plum", "fig", "quince"]
+    with ParquetFileWriter(
+        path, schema,
+        WriterOptions(row_group_rows=group, data_page_values=group // 2),
+    ) as w:
+        for lo in range(0, n, group):
+            m = min(group, n - lo)
+            f = rng.integers(0, 1000, m).astype(np.float32)
+            if with_nan:
+                f[:: 7] = np.nan
+            w.write_columns({
+                "k": rng.integers(0, 1000, m).astype(np.int64),
+                "v": [
+                    None if i % 5 == 0 else int(rng.integers(0, 100))
+                    for i in range(m)
+                ],
+                "f": f,
+                "d": rng.integers(0, 1000, m).astype(np.float64),
+                "cat": [cats[i] for i in rng.integers(0, len(cats), m)],
+                "tag": [
+                    None if i % 4 == 0 else ("hot" if i % 2 else "cold")
+                    for i in range(m)
+                ],
+            })
+    return path
+
+
+def _oracle_filter(path, pa_mask_fn, columns):
+    t = pq.read_table(str(path))
+    keep = pa_mask_fn(t)
+    # pyarrow filter drops null-mask rows — the pushdown contract
+    got = t.filter(keep)
+    return {c: got[c] for c in columns}
+
+
+def _fetch(res, name):
+    dc = res.columns[name]
+    vals = np.asarray(dc.values)
+    mask = None if dc.mask is None else np.asarray(dc.mask)
+    return vals, mask
+
+
+def _device_filter(path, pred, columns=None, policy="float64", **req_kw):
+    with TpuRowGroupReader(str(path), float64_policy=policy) as tr:
+        req = ComputeRequest(predicate=pred, **req_kw)
+        parts = [
+            tr.read_row_group_compute(i, req, columns=columns)
+            for i in range(tr.num_row_groups)
+        ]
+    return parts
+
+
+def _concat_col(parts, name):
+    vals = np.concatenate([np.asarray(p.columns[name].values)
+                           for p in parts])
+    masks = [p.columns[name].mask for p in parts]
+    if any(m is not None for m in masks):
+        mask = np.concatenate([np.asarray(m) for m in masks])
+    else:
+        mask = None
+    return vals, mask
+
+
+# ---------------------------------------------------------------------------
+# differential filters vs pyarrow.compute
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("op,lit,pafn", [
+    ("<", 300, lambda t: pc.less(t["k"], 300)),
+    ("<=", 300, lambda t: pc.less_equal(t["k"], 300)),
+    ("==", 7, lambda t: pc.equal(t["k"], 7)),
+    ("!=", 7, lambda t: pc.not_equal(t["k"], 7)),
+    (">", 700, lambda t: pc.greater(t["k"], 700)),
+    (">=", 700, lambda t: pc.greater_equal(t["k"], 700)),
+])
+def test_filter_int_ops_differential(tmp_path, op, lit, pafn):
+    path = _write_mixed(tmp_path)
+    pred = {
+        "<": col("k") < lit, "<=": col("k") <= lit,
+        "==": col("k") == lit, "!=": col("k") != lit,
+        ">": col("k") > lit, ">=": col("k") >= lit,
+    }[op]
+    parts = _device_filter(path, pred)
+    want = _oracle_filter(path, pafn, ["k", "v"])
+    got_k, _ = _concat_col(parts, "k")
+    assert np.array_equal(got_k, want["k"].to_numpy())
+    got_v, got_m = _concat_col(parts, "v")
+    w = want["v"]
+    wm = np.asarray([x is None for x in w.to_pylist()])
+    assert np.array_equal(got_m, wm)
+    wv = w.to_numpy(zero_copy_only=False)
+    assert np.array_equal(got_v[~got_m], wv[~wm].astype(np.int32))
+
+
+def test_filter_optional_null_semantics(tmp_path):
+    """Comparisons on an optional column never select null cells —
+    pyarrow's filter-drop behavior, bit-for-bit."""
+    path = _write_mixed(tmp_path)
+    parts = _device_filter(path, col("v") >= 0)  # all non-null rows
+    want = _oracle_filter(
+        path, lambda t: pc.greater_equal(t["v"], 0), ["k"]
+    )
+    got_k, _ = _concat_col(parts, "k")
+    assert np.array_equal(got_k, want["k"].to_numpy())
+
+
+def test_filter_dict_string_order_compare(tmp_path):
+    """Order comparisons on dictionary strings run on the HOST
+    dictionary (the per-group match mask) — full semantics on device."""
+    path = _write_mixed(tmp_path)
+    parts = _device_filter(path, col("cat") < "pear")
+    want = _oracle_filter(
+        path, lambda t: pc.less(t["cat"], "pear"), ["k", "cat"]
+    )
+    got_k, _ = _concat_col(parts, "k")
+    assert np.array_equal(got_k, want["k"].to_numpy())
+
+
+def test_filter_optional_string_and_isnull(tmp_path):
+    path = _write_mixed(tmp_path)
+    pred = (col("tag") == "hot") | col("tag").is_null()
+    parts = _device_filter(path, pred)
+    want = _oracle_filter(
+        path,
+        lambda t: pc.or_(
+            pc.fill_null(pc.equal(t["tag"], "hot"), False),
+            pc.is_null(t["tag"]),
+        ),
+        ["k"],
+    )
+    got_k, _ = _concat_col(parts, "k")
+    assert np.array_equal(got_k, want["k"].to_numpy())
+
+
+def test_filter_and_or_tree_differential(tmp_path):
+    path = _write_mixed(tmp_path)
+    pred = ((col("k") < 500) & (col("f") >= 100.0)) | (col("cat") == "fig")
+    parts = _device_filter(path, pred)
+    want = _oracle_filter(
+        path,
+        lambda t: pc.or_(
+            pc.and_(pc.less(t["k"], 500),
+                    pc.greater_equal(t["f"], np.float32(100.0))),
+            pc.equal(t["cat"], "fig"),
+        ),
+        ["k", "f"],
+    )
+    got_k, _ = _concat_col(parts, "k")
+    assert np.array_equal(got_k, want["k"].to_numpy())
+    got_f, _ = _concat_col(parts, "f")
+    assert np.array_equal(got_f, want["f"].to_numpy())
+
+
+def test_filter_double_exact_policy(tmp_path):
+    """DOUBLE comparisons need float64_policy='float64' (exact) —
+    lossy policies reject instead of approximating."""
+    path = _write_mixed(tmp_path)
+    parts = _device_filter(path, col("d") < 500.0, policy="float64")
+    want = _oracle_filter(path, lambda t: pc.less(t["d"], 500.0), ["d"])
+    got_d, _ = _concat_col(parts, "d")
+    assert np.array_equal(got_d, want["d"].to_numpy())
+    with TpuRowGroupReader(str(path), float64_policy="bits") as tr:
+        with pytest.raises(UnsupportedFeatureError, match="float64"):
+            tr.read_row_group_compute(
+                0, ComputeRequest(predicate=col("d") < 500.0)
+            )
+
+
+def test_empty_and_allpass_selections(tmp_path):
+    path = _write_mixed(tmp_path)
+    empty = _device_filter(path, col("k") < -1)
+    assert all(p.num_selected == 0 for p in empty)
+    assert all(p.columns["k"].values.shape[0] == 0 for p in empty)
+    allp = _device_filter(path, col("k") >= 0)
+    got_k, _ = _concat_col(allp, "k")
+    want = pq.read_table(str(path))["k"].to_numpy()
+    assert np.array_equal(got_k, want)
+
+
+def test_mask_mode_matches_compact(tmp_path):
+    path = _write_mixed(tmp_path)
+    pred = col("k") < 250
+    compact = _device_filter(path, pred)
+    masked = _device_filter(path, pred, mode="mask")
+    for cp, mp in zip(compact, masked):
+        sel = np.asarray(mp.mask)
+        assert mp.num_selected == cp.num_selected == int(sel.sum())
+        assert np.array_equal(
+            np.asarray(cp.columns["k"].values),
+            np.asarray(mp.columns["k"].values)[sel],
+        )
+
+
+def test_projection_excludes_predicate_column(tmp_path):
+    """A predicate column outside the projection is decoded for the
+    filter but never shipped."""
+    path = _write_mixed(tmp_path)
+    parts = _device_filter(path, col("k") < 300, columns=["v"])
+    assert all(set(p.columns) == {"v"} for p in parts)
+    want = _oracle_filter(path, lambda t: pc.less(t["k"], 300), ["v"])
+    got_v, got_m = _concat_col(parts, "v")
+    wm = np.asarray([x is None for x in want["v"].to_pylist()])
+    assert np.array_equal(got_m, wm)
+
+
+def test_capacity_overflow_retry(tmp_path):
+    """Survivors past the static capacity re-dispatch once with a grown
+    capacity — counted, never wrong."""
+    path = _write_mixed(tmp_path)
+    pred = col("k") >= 0  # selects everything: guaranteed overflow
+    with trace.scope() as t:
+        parts = _device_filter(path, pred, initial_capacity=4)
+    got_k, _ = _concat_col(parts, "k")
+    want = pq.read_table(str(path))["k"].to_numpy()
+    assert np.array_equal(got_k, want)
+    c = t.counters()
+    assert c.get("engine.pushdown_overflows", 0) >= 1
+    # the HWM remembered: groups after the first never overflow again
+    assert c["engine.pushdown_overflows"] < c["engine.pushdown_groups"]
+
+
+def test_chunked_overcap_parity(tmp_path, monkeypatch):
+    """An over-cap (multi-launch chunked) group evaluates the same
+    request as follow-up device ops — results identical to the fused
+    tail."""
+    path = _write_mixed(tmp_path)
+    pred = (col("k") < 400) & (col("cat") == "plum")
+    want = _device_filter(path, pred)
+    monkeypatch.setenv("PFTPU_ARENA_CAP", "4096")
+    got = _device_filter(path, pred)
+    for a, b in zip(got, want):
+        assert a.num_selected == b.num_selected
+        assert np.array_equal(
+            np.asarray(a.columns["k"].values),
+            np.asarray(b.columns["k"].values),
+        )
+
+
+def test_eval_mask_host_twin_identical(tmp_path):
+    """The host eval_mask and the device tail select the SAME rows for
+    the same predicate (one filter semantics across faces)."""
+    path = _write_mixed(tmp_path)
+    pred = ((col("k") < 600) | (col("tag") == "cold")) & (col("v") != 13)
+    parts = _device_filter(path, pred, mode="mask")
+    from parquet_floor_tpu.scan.executor import _batch_resolver
+
+    host_masks = []
+    with DatasetScanner([str(path)]) as scanner:
+        for unit in scanner:
+            host_masks.append(eval_mask(
+                pred, _batch_resolver(unit.batch), unit.batch.num_rows
+            ))
+    for p, hm in zip(parts, host_masks):
+        assert np.array_equal(np.asarray(p.mask), hm)
+
+
+# ---------------------------------------------------------------------------
+# aggregates
+# ---------------------------------------------------------------------------
+
+def _device_agg(path, agg, pred=None, policy="float64"):
+    with TpuRowGroupReader(str(path), float64_policy=policy) as tr:
+        req = ComputeRequest(predicate=pred, aggregate=agg)
+        out = AggPartial(agg)
+        for i in range(tr.num_row_groups):
+            out.combine(tr.read_row_group_compute(i, req).agg)
+    return out
+
+
+def test_scalar_aggregates_differential(tmp_path):
+    path = _write_mixed(tmp_path)
+    agg = Aggregate((
+        ("k", "sum"), ("k", "min"), ("k", "max"), ("v", "count"),
+        ("v", "sum"), ("f", "sum"), ("f", "min"),
+    ))
+    fin = _device_agg(path, agg, pred=col("k") < 500).finalize()
+    t = pq.read_table(str(path))
+    w = t.filter(pc.less(t["k"], 500))
+    assert fin["k_sum"] == pc.sum(w["k"]).as_py()
+    assert fin["k_min"] == pc.min_max(w["k"])["min"].as_py()
+    assert fin["k_max"] == pc.min_max(w["k"])["max"].as_py()
+    assert fin["v_count"] == pc.count(w["v"]).as_py()
+    assert fin["v_sum"] == pc.sum(w["v"]).as_py()
+    # float32 sums accumulate in float64 exactly like pyarrow; the data
+    # is integer-valued so the sum is order-independent and bit-equal
+    assert fin["f_sum"] == pc.sum(w["f"]).as_py()
+    assert fin["f_min"] == pc.min_max(w["f"])["min"].as_py()
+
+
+def test_groupby_differential_with_null_keys(tmp_path):
+    path = _write_mixed(tmp_path)
+    agg = Aggregate(
+        (("v", "sum"), ("v", "min"), ("v", "max"), ("v", "count")),
+        group_by="tag",
+    )
+    fin = _device_agg(path, agg, pred=col("k") < 800).finalize()
+    t = pq.read_table(str(path))
+    w = t.filter(pc.less(t["k"], 800))
+    gb = w.group_by("tag").aggregate(
+        [("v", "sum"), ("v", "min"), ("v", "max"), ("v", "count")]
+    ).to_pydict()
+    assert len(fin) == len(gb["tag"])
+    for i, key in enumerate(gb["tag"]):
+        ours = fin[None if key is None else key.encode()]
+        assert ours["v_sum"] == gb["v_sum"][i]
+        assert ours["v_min"] == gb["v_min"][i]
+        assert ours["v_max"] == gb["v_max"][i]
+        assert ours["v_count"] == gb["v_count"][i]
+
+
+def test_nan_sum_and_minmax_semantics(tmp_path):
+    """Pinned to pyarrow: sum propagates NaN, min/max skip NaN."""
+    path = _write_mixed(tmp_path, with_nan=True)
+    agg = Aggregate((("f", "sum"), ("f", "min"), ("f", "max"),
+                     ("f", "count")))
+    fin = _device_agg(path, agg).finalize()
+    t = pq.read_table(str(path))
+    assert np.isnan(fin["f_sum"]) and np.isnan(pc.sum(t["f"]).as_py())
+    mm = pc.min_max(t["f"])
+    assert fin["f_min"] == mm["min"].as_py()
+    assert fin["f_max"] == mm["max"].as_py()
+    assert fin["f_count"] == pc.count(t["f"]).as_py()
+
+
+def test_int64_overflow_sum_wraps(tmp_path):
+    schema = types.message(
+        "t", types.required(types.INT64).named("x"),
+    )
+    path = tmp_path / "wrap.parquet"
+    big = np.full(8, 2**62, dtype=np.int64)
+    with ParquetFileWriter(path, schema, WriterOptions()) as w:
+        w.write_columns({"x": big})
+    fin = _device_agg(path, Aggregate((("x", "sum"),))).finalize()
+    t = pq.read_table(str(path))
+    assert fin["x_sum"] == pc.sum(t["x"]).as_py()  # wrapped, both sides
+
+
+def test_empty_selection_aggregate(tmp_path):
+    path = _write_mixed(tmp_path)
+    agg = Aggregate((("v", "sum"), ("v", "min"), ("v", "count")))
+    fin = _device_agg(path, agg, pred=col("k") < -5).finalize()
+    assert fin == {"v_sum": None, "v_min": None, "v_count": 0}
+
+
+def test_combine_associativity(tmp_path):
+    path = _write_mixed(tmp_path)
+    agg = Aggregate((("v", "sum"), ("v", "max")), group_by="cat")
+    with TpuRowGroupReader(str(path), float64_policy="float64") as tr:
+        req = ComputeRequest(aggregate=agg)
+        parts = [
+            tr.read_row_group_compute(i, req).agg
+            for i in range(tr.num_row_groups)
+        ]
+    left = AggPartial.merge(agg, parts)
+    right = AggPartial(agg)
+    for p in reversed(parts):
+        right.combine(p)
+    assert left.finalize() == right.finalize()
+
+
+def test_host_partial_matches_device(tmp_path):
+    """The NumPy host partial and the device tail agree bucket for
+    bucket (the mixed device/host-fallback combine contract)."""
+    path = _write_mixed(tmp_path)
+    agg = Aggregate(
+        (("v", "sum"), ("v", "min"), ("f", "sum")), group_by="cat"
+    )
+    pred = col("k") < 700
+    dev = _device_agg(path, agg, pred=pred).finalize()
+    host = scan_aggregate([str(path)], agg, predicate=pred,
+                          engine="host").finalize()
+    assert dev == host
+
+
+def test_scan_aggregate_tpu_vs_host_multifile(tmp_path):
+    paths = [
+        str(_write_mixed(tmp_path, name=f"m{i}.parquet", n=600))
+        for i in range(3)
+    ]
+    agg = Aggregate(
+        (("v", "sum"), ("v", "count"), ("k", "max")), group_by="cat"
+    )
+    pred = col("k") < 650
+    a = scan_aggregate(paths, agg, predicate=pred, engine="tpu").finalize()
+    b = scan_aggregate(paths, agg, predicate=pred, engine="host").finalize()
+    assert a == b
+
+
+def test_scan_aggregate_host_fallback_on_plain_group_key(tmp_path):
+    """A non-dictionary group key cannot group on device — the scan
+    falls back to the host leg with identical results."""
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("g"),
+        types.required(types.INT64).named("x"),
+    )
+    path = tmp_path / "plain.parquet"
+    with ParquetFileWriter(
+        path, schema, WriterOptions(enable_dictionary=False),
+    ) as w:
+        w.write_columns({
+            "g": (np.arange(100) % 3).astype(np.int64),
+            "x": np.arange(100).astype(np.int64),
+        })
+    agg = Aggregate((("x", "sum"),), group_by="g")
+    with trace.scope() as t:
+        got = scan_aggregate([str(path)], agg, engine="tpu").finalize()
+    want = scan_aggregate([str(path)], agg, engine="host").finalize()
+    assert got == want
+    acts = [d.get("action") for d in t.decisions()
+            if d.get("decision") == "engine.pushdown"]
+    assert "host_fallback" in acts
+
+
+# ---------------------------------------------------------------------------
+# scan-face plumbing
+# ---------------------------------------------------------------------------
+
+def test_scan_pushdown_rows_and_counters(tmp_path):
+    paths = [
+        str(_write_mixed(tmp_path, name=f"s{i}.parquet", n=600))
+        for i in range(2)
+    ]
+    pred = col("k") < 100
+    with trace.scope() as t:
+        rows = 0
+        for _fi, _gi, cols in scan_device_groups(
+            paths, columns=["k", "v"],
+            scan=ScanOptions(pushdown=True), predicate=pred,
+            float64_policy="bits",
+        ):
+            k = np.asarray(cols["k"].values)
+            assert bool(np.all(k < 100))
+            rows += k.size
+    c = t.counters()
+    assert c["engine.pushdown_groups"] > 0
+    assert c["scan.rows_filtered_device"] == \
+        c["engine.pushdown_rows_in"] - c["engine.pushdown_rows_selected"]
+    assert rows == c["engine.pushdown_rows_selected"]
+    # one-launch with the compute tail fused (no overflow at 10%)
+    assert c["engine.launches"] == c["engine.pushdown_groups"] + \
+        c.get("engine.pushdown_overflows", 0)
+    # parity vs the host scan + host mask
+    from parquet_floor_tpu.scan.executor import _batch_resolver
+
+    want = 0
+    with DatasetScanner(paths) as sc:
+        for unit in sc:
+            want += int(eval_mask(
+                pred, _batch_resolver(unit.batch), unit.batch.num_rows
+            ).sum())
+    assert rows == want
+
+
+def test_scan_pushdown_predicate_outside_projection(tmp_path):
+    """A predicate column outside the scan projection still stages and
+    filters; only the projection ships."""
+    path = _write_mixed(tmp_path)
+    pred = col("k") < 200
+    got = []
+    for _fi, _gi, cols in scan_device_groups(
+        [str(path)], columns=["v"],
+        scan=ScanOptions(pushdown=True), predicate=pred,
+        float64_policy="bits",
+    ):
+        assert set(cols) == {"v"}
+        got.append(np.asarray(cols["v"].values))
+    got = np.concatenate(got)
+    t = pq.read_table(str(path))
+    w = t.filter(pc.less(t["k"], 200))["v"]
+    wm = np.asarray([x is None for x in w.to_pylist()])
+    wv = w.to_numpy(zero_copy_only=False)
+    assert got.size == len(w)
+    assert np.array_equal(
+        got[~wm], wv[~wm].astype(np.int32)
+    )
+
+
+def test_scan_pushdown_salvage_rejected(tmp_path):
+    path = _write_mixed(tmp_path)
+    with pytest.raises(UnsupportedFeatureError, match="salvage"):
+        list(scan_device_groups(
+            [str(path)], scan=ScanOptions(pushdown=True),
+            predicate=col("k") < 5,
+            options=ReaderOptions(salvage=True),
+        ))
+
+
+def test_scan_aggregate_salvage_rejected_not_swallowed(tmp_path):
+    """The device leg's salvage rejection must surface, NOT fall back to
+    a host scan that silently aggregates around quarantined rows."""
+    path = _write_mixed(tmp_path)
+    agg = Aggregate((("v", "sum"),))
+    with pytest.raises(UnsupportedFeatureError, match="salvage"):
+        scan_aggregate([str(path)], agg,
+                       options=ReaderOptions(salvage=True), engine="tpu")
+    with pytest.raises(UnsupportedFeatureError, match="salvage"):
+        scan_aggregate([str(path)], agg,
+                       options=ReaderOptions(salvage=True), engine="host")
+
+
+def test_chunked_overcap_lossy_double_rejected(tmp_path, monkeypatch):
+    """The multi-launch fallback enforces the same DOUBLE-exactness rule
+    as the fused tail: float64_policy='bits'/'f32' must reject, never
+    compare or accumulate rounded values."""
+    path = _write_mixed(tmp_path)
+    monkeypatch.setenv("PFTPU_ARENA_CAP", "4096")
+    with TpuRowGroupReader(str(path), float64_policy="bits") as tr:
+        with pytest.raises(UnsupportedFeatureError, match="float64"):
+            tr.read_row_group_compute(
+                0, ComputeRequest(predicate=col("d") < 500.0)
+            )
+        with pytest.raises(UnsupportedFeatureError, match="float64"):
+            tr.read_row_group_compute(
+                0, ComputeRequest(aggregate=Aggregate((("d", "sum"),)))
+            )
+    # exact policy still works on the same over-cap group
+    parts = _device_filter(path, col("d") < 500.0, policy="float64")
+    want = _oracle_filter(path, lambda t: pc.less(t["d"], 500.0), ["d"])
+    got_d, _ = _concat_col(parts, "d")
+    assert np.array_equal(got_d, want["d"].to_numpy())
+
+
+def test_index_form_aggregate_rejected(tmp_path):
+    """Aggregating an index-form dictionary column would sum dictionary
+    SLOTS — both paths reject it (count still works: it reads masks)."""
+    path = _write_mixed(tmp_path)
+    with TpuRowGroupReader(
+        str(path), float64_policy="float64", dict_form="index"
+    ) as tr:
+        # "v" stages as dict_idx_num under dict_form="index"
+        with pytest.raises(UnsupportedFeatureError, match="index-form"):
+            tr.read_row_group_compute(
+                0, ComputeRequest(aggregate=Aggregate((("v", "sum"),)))
+            )
+        out = tr.read_row_group_compute(
+            0, ComputeRequest(aggregate=Aggregate((("v", "count"),)))
+        )
+    t = pq.read_table(str(path))
+    want = sum(x is not None for x in t["v"].to_pylist()[:300])
+    assert out.agg.finalize()["v_count"] == want
+
+
+def test_surrogate_escape_string_key():
+    """Predicate trees round-trip surrogate-escaped strings (a key
+    copied from a row cell of a non-UTF8 BINARY column) instead of
+    raising UnicodeEncodeError."""
+    raw = b"\xff\xfekey"
+    cell = raw.decode("utf-8", "surrogateescape")
+    t = tree(col("s") == cell)
+    assert t == ("cmp", "s", "==", raw)
+    vals = np.array([raw, b"other"], dtype=object)
+    m = eval_mask(col("s") == cell, lambda n: (vals, None), 2)
+    assert list(m) == [True, False]
+
+
+def test_device_page_prune_parity(tmp_path):
+    """ScanOptions(page_prune=True) on the DEVICE leg: bit-parity with
+    the host leg's covered rows (the storage rung composing under the
+    device rung)."""
+    # sorted key column → selective predicate prunes whole pages
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.required(types.INT64).named("x"),
+    )
+    path = tmp_path / "sorted.parquet"
+    n, group = 1200, 400
+    ks = np.arange(n, dtype=np.int64)
+    xs = rng.integers(0, 10**6, n).astype(np.int64)
+    with ParquetFileWriter(
+        path, schema,
+        WriterOptions(row_group_rows=group, data_page_values=100),
+    ) as w:
+        for lo in range(0, n, group):
+            w.write_columns({
+                "k": ks[lo:lo + group], "x": xs[lo:lo + group],
+            })
+    pred = (col("k") >= 150) & (col("k") < 250)
+    sc = ScanOptions(page_prune=True)
+    with trace.scope() as t:
+        dev = []
+        for _fi, _gi, cols in scan_device_groups(
+            [str(path)], scan=sc, predicate=pred, float64_policy="bits",
+        ):
+            dev.append((np.asarray(cols["k"].values),
+                        np.asarray(cols["x"].values)))
+    assert t.counters().get("scan.pages_pruned", 0) > 0
+    host = []
+    with DatasetScanner([str(path)], scan=sc, predicate=pred) as s:
+        for unit in s:
+            res = {}
+            for cb in unit.batch.columns:
+                dense, _m = cb.dense()
+                res[cb.descriptor.path[0]] = np.asarray(dense)
+            host.append((res["k"], res["x"]))
+    assert len(dev) == len(host)
+    for (dk, dx), (hk, hx) in zip(dev, host):
+        assert np.array_equal(dk, hk)
+        assert np.array_equal(dx, hx)
+
+
+def test_page_prune_composes_with_pushdown(tmp_path):
+    """Storage rung + device rung: covered pages decode, the fused tail
+    filters them — final rows identical to filtering the whole file."""
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.required(types.INT64).named("x"),
+    )
+    path = tmp_path / "sorted2.parquet"
+    n, group = 1200, 400
+    ks = np.arange(n, dtype=np.int64)
+    xs = rng.integers(0, 10**6, n).astype(np.int64)
+    with ParquetFileWriter(
+        path, schema,
+        WriterOptions(row_group_rows=group, data_page_values=100),
+    ) as w:
+        for lo in range(0, n, group):
+            w.write_columns({
+                "k": ks[lo:lo + group], "x": xs[lo:lo + group],
+            })
+    pred = (col("k") >= 190) & (col("k") < 210)
+    got_k = []
+    got_x = []
+    for _fi, _gi, cols in scan_device_groups(
+        [str(path)], scan=ScanOptions(page_prune=True, pushdown=True),
+        predicate=pred, float64_policy="bits",
+    ):
+        got_k.append(np.asarray(cols["k"].values))
+        got_x.append(np.asarray(cols["x"].values))
+    got_k = np.concatenate(got_k)
+    got_x = np.concatenate(got_x)
+    sel = (ks >= 190) & (ks < 210)
+    assert np.array_equal(got_k, ks[sel])
+    assert np.array_equal(got_x, xs[sel])
+
+
+# ---------------------------------------------------------------------------
+# exec-cache interaction
+# ---------------------------------------------------------------------------
+
+def test_exec_cache_key_separation_per_predicate(tmp_path):
+    """Same file, different predicate → different persistent entry;
+    repeating a predicate in a fresh 'process' hits with zero compile."""
+    path = _write_mixed(tmp_path, n=300, group=300)
+    cache_dir = tmp_path / "cache"
+
+    def run(pred):
+        exec_cache.activate(exec_cache.ExecutableCache(str(cache_dir)))
+        try:
+            with trace.scope() as t:
+                with TpuRowGroupReader(
+                    str(path), float64_policy="float64"
+                ) as tr:
+                    res = tr.read_row_group_compute(
+                        0, ComputeRequest(predicate=pred)
+                    )
+                    k = np.asarray(res.columns["k"].values)
+            return k, t.counters()
+        finally:
+            exec_cache.activate(None)
+
+    k1, c1 = run(col("k") < 100)
+    assert c1.get("engine.exec_cache_misses", 0) >= 1
+    n_entries = len([
+        f for f in os.listdir(cache_dir) if f.endswith(".pfexec")
+    ])
+    _k2, c2 = run(col("k") < 200)  # different literal → different entry
+    n_entries2 = len([
+        f for f in os.listdir(cache_dir) if f.endswith(".pfexec")
+    ])
+    assert n_entries2 > n_entries
+    assert c2.get("engine.exec_cache_misses", 0) >= 1
+    k3, c3 = run(col("k") < 100)  # warm: same predicate reloads
+    assert np.array_equal(k1, k3)
+    assert c3.get("engine.exec_cache_hits", 0) >= 1
+    assert c3.get("engine.exec_cache_misses", 0) == 0
+    assert c3.get("engine.compile_ms", 0) == 0
+
+
+def test_serve_dataset_aggregate(tmp_path):
+    from parquet_floor_tpu.serve import Dataset
+
+    path = _write_mixed(tmp_path)
+    agg = Aggregate((("v", "sum"), ("v", "count")), group_by="cat")
+    pred = col("k") < 400
+    with Dataset([str(path)], key_column="k") as ds:
+        with trace.scope() as t:
+            fin = ds.aggregate(agg, predicate=pred).finalize()
+    assert t.counters().get("serve.aggregate_probes") == 1
+    want = scan_aggregate([str(path)], agg, predicate=pred,
+                          engine="host").finalize()
+    assert fin == want
+
+
+def test_tree_export_and_columns():
+    p = ((col("a") < 5) & (col("b") == "x")) | col("c").is_null()
+    t = tree(p)
+    assert t[0] == "or"
+    assert tree_columns(t) == {"a", "b", "c"}
+    with pytest.raises(TypeError):
+        tree((col("a") == object()))
+
+
+def test_host_partial_direct():
+    """host_partial over raw arrays: the no-file unit contract."""
+    agg = Aggregate((("x", "sum"), ("x", "min")), group_by="g")
+    vals = {
+        "x": (np.array([1, 2, 3, 4], np.int64), None),
+        "g": (np.array([b"a", b"a", b"b", b"b"], object),
+              np.array([False, False, False, True])),
+    }
+    part = host_partial(agg, lambda n: vals[n], 4,
+                        sel=np.array([True, True, True, True]))
+    fin = part.finalize()
+    assert fin[b"a"] == {"x_sum": 3, "x_min": 1}
+    assert fin[b"b"] == {"x_sum": 3, "x_min": 3}
+    assert fin[None] == {"x_sum": 4, "x_min": 4}

@@ -917,6 +917,208 @@ def serving_leg(n_rows: int) -> dict:
     return detail
 
 
+def write_leg(n_rows: int, reps: int) -> dict:
+    """Device write path (docs/write.md), gated by
+    ``check_bench_report.check_write_leg``: the fused encode engine
+    writes the lineitem workload — dictionary build + index pack on
+    device, host compression pipelined behind — and the recorded
+    ``write_rows_per_sec`` must hold a floor of 0.25x the decode leg's
+    ``scan_rows_per_sec`` (the acceptance ratio rides the bench JSON as
+    ``write_vs_scan_x``).  A counted pass pins the two-launch-per-group
+    shape and a read-back pass pins value exactness."""
+    import numpy as np
+
+    from benchmarks.workloads import lineitem_columns, lineitem_schema
+    from parquet_floor_tpu.format.file_read import ParquetFileReader
+    from parquet_floor_tpu.format.file_write import WriterOptions
+    from parquet_floor_tpu.format.parquet_thrift import CompressionCodec
+    from parquet_floor_tpu.utils import trace
+    from parquet_floor_tpu.write import DeviceFileWriter
+
+    schema = lineitem_schema()
+    groups = 4
+    per = max(n_rows // groups, 500)
+    cols = lineitem_columns(per, seed=11)
+    opts = WriterOptions(
+        codec=CompressionCodec.SNAPPY, page_version=2,
+        data_page_values=50_000, engine="tpu",
+    )
+
+    def run(idx) -> str:
+        p = os.path.join("/tmp", f"pftpu_bench_write_{idx}.parquet")
+        with DeviceFileWriter(p, schema, opts) as w:
+            for _ in range(groups):
+                w.write_columns(cols)
+        return p
+
+    path = run("warm")  # compiles the encode executables
+    best = float("inf")
+    for r in range(max(reps, 3)):
+        t0 = time.perf_counter()
+        run(r)
+        best = min(best, time.perf_counter() - t0)
+    rows = groups * per
+
+    with trace.scope() as t:
+        run("counted")
+    counters = t.metrics()
+
+    # value exactness: the written file reads back equal to the source
+    # columns through our own reader (the pyarrow differential is the
+    # test suite's job — tests/test_write.py)
+    exact = True
+    with ParquetFileReader(path) as r:
+        for gi in range(groups):
+            batch = r.read_row_group(gi)
+            by = {c.descriptor.path[0]: c for c in batch.columns}
+            for name, want in cols.items():
+                got = by[name].values
+                if hasattr(got, "to_list"):
+                    from parquet_floor_tpu.format.encodings.plain import (
+                        ByteArrayColumn,
+                    )
+
+                    if isinstance(want, ByteArrayColumn):
+                        ok = got == want
+                    else:
+                        enc = [
+                            v.encode() if isinstance(v, str) else v
+                            for v in want
+                            if v is not None
+                        ]
+                        ok = got.to_list() == enc
+                else:
+                    w_arr = np.asarray(
+                        [v for v in want if v is not None]
+                        if isinstance(want, list) else want
+                    )
+                    g_arr = np.asarray(got)
+                    if g_arr.dtype.kind == "f":
+                        ok = np.array_equal(
+                            g_arr.view(np.uint64 if g_arr.itemsize == 8
+                                       else np.uint32),
+                            w_arr.astype(g_arr.dtype).view(
+                                np.uint64 if g_arr.itemsize == 8
+                                else np.uint32
+                            ),
+                        )
+                    else:
+                        ok = np.array_equal(g_arr, w_arr.astype(g_arr.dtype))
+                if not ok:
+                    exact = False
+
+    return {
+        "write_rows_per_sec": round(rows / best, 1),
+        "write_rows": rows,
+        "write_groups": counters.get("write.groups", 0),
+        "write_launches": counters.get("write.launches", 0),
+        "write_device_columns": counters.get("write.device_columns", 0),
+        "write_host_columns": counters.get("write.host_columns", 0),
+        "write_bytes_written": counters.get("write.bytes_written", 0),
+        "write_exact": bool(exact),
+    }
+
+
+def compact_leg(n_rows: int, reps: int) -> dict:
+    """Dataset compaction (docs/write.md), gated by
+    ``check_bench_report.check_compact_leg``: re-shard the scan leg's
+    4-file dataset into consolidated row groups at the configured
+    target.  The floor — compaction ≥ 0.5x scan speed — compares
+    against a device-scan pass over the SAME corpus timed INTERLEAVED
+    rep-by-rep (one machine condition, the loader leg's comparator
+    discipline), and the output group sizes must sit exactly in the
+    target band."""
+    import shutil
+
+    import jax
+    import numpy as np
+
+    from parquet_floor_tpu.format.file_read import ParquetFileReader
+    from parquet_floor_tpu.format.file_write import WriterOptions
+    from parquet_floor_tpu.scan import ScanOptions, scan_device_groups
+    from parquet_floor_tpu.utils import trace
+    from parquet_floor_tpu.write import CompactOptions, DatasetCompactor
+
+    paths = _scan_paths(n_rows)
+    total = 0
+    for p in paths:
+        with ParquetFileReader(p) as r:
+            total += r.record_count
+    target = max(total // 2, 500)
+    copts = CompactOptions(
+        target_row_group_rows=target,
+        read_leg="host",
+        scan=ScanOptions(threads=8),
+        # engine="auto": the fused encode launches on a real
+        # accelerator, the pooled pipelined host encoder on the CPU
+        # backend (resolve_writer's cost-model routing)
+        writer=WriterOptions(
+            engine="auto", compress_threads=8, write_pipeline_depth=3,
+        ),
+    )
+
+    def compact(idx):
+        out = os.path.join("/tmp", f"pftpu_bench_compact_{idx}")
+        shutil.rmtree(out, ignore_errors=True)
+        return DatasetCompactor(paths, out, copts).run()
+
+    def scan_pass():
+        rows = 0
+        for _fi, _gi, cols in scan_device_groups(
+            paths, scan=ScanOptions(threads=min(4, os.cpu_count() or 1)),
+            float64_policy="bits",
+        ):
+            jax.block_until_ready([c.values for c in cols.values()])
+            rows += int(next(iter(cols.values())).values.shape[0])
+        return rows
+
+    rep0 = compact("warm")
+    scan_pass()
+    best_c = float("inf")
+    best_s = float("inf")
+    for r in range(max(reps, 4)):
+        t0 = time.perf_counter()
+        scan_pass()
+        best_s = min(best_s, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        compact(r)
+        best_c = min(best_c, time.perf_counter() - t0)
+
+    with trace.scope() as t:
+        compact("counted")
+    counters = t.metrics()
+
+    # value exactness: output equals input in delivery order through
+    # our own reader (no D2H — host read both sides)
+    def read_rows(ps, name="l_quantity"):
+        out = []
+        for p in ps:
+            with ParquetFileReader(p) as r:
+                for gi in range(len(r.row_groups)):
+                    cb = r.read_row_group(gi, {name})
+                    out.append(np.asarray(cb.columns[0].values))
+        return np.concatenate(out)
+
+    exact = bool(np.array_equal(
+        read_rows(paths), read_rows(rep0.paths)
+    ))
+
+    c_rps = rep0.rows_in / best_c
+    s_rps = rep0.rows_in / best_s
+    return {
+        "compact_rows_per_sec": round(c_rps, 1),
+        "compact_scan_rows_per_sec": round(s_rps, 1),
+        "compact_vs_scan_x": round(c_rps / s_rps, 3),
+        "compact_rows": rep0.rows_in,
+        "compact_target_group_rows": target,
+        "compact_group_rows": list(rep0.group_rows),
+        "compact_files_out": len(rep0.paths),
+        "compact_units_in": counters.get("compact.units_in", 0),
+        "compact_groups_out": counters.get("compact.groups_out", 0),
+        "compact_exact": exact,
+    }
+
+
 def _bench_batch(paths) -> int:
     """The loader leg's batch size: the largest divisor (at or under
     4096) of the dataset's ACTUAL row-group size, read from the first
@@ -1260,6 +1462,15 @@ def main():
     # whole point is measuring shipped bytes), so it runs with the
     # post-timing D2H checks
     pushdown_detail = pushdown_leg(n_rows)
+    # write path + compaction legs (docs/write.md): the encode engine
+    # D2H-fetches its packed streams by design, so both run with the
+    # post-timing group (their scan comparator is interleaved inside)
+    write_detail = write_leg(n_rows, reps)
+    compact_detail = compact_leg(n_rows, reps)
+    write_detail["write_vs_scan_x"] = round(
+        write_detail["write_rows_per_sec"]
+        / scan_detail["scan_rows_per_sec"], 3
+    )
     # the loader's multiset-exactness check fetches device arrays: after
     # every timed section (the first D2H degrades tunnelled links
     # process-wide), alongside the scan leg's own D2H check
@@ -1307,6 +1518,8 @@ def main():
             **serving_detail,
             **exec_cache_detail,
             **pushdown_detail,
+            **write_detail,
+            **compact_detail,
             **loader_detail,
         },
     }

@@ -86,10 +86,41 @@ def _dotted(node: ast.AST) -> Optional[str]:
     return None
 
 
+def _ann_name(node) -> Optional[str]:
+    """The class NAME an annotation expression pins, or None: handles
+    ``Foo``, ``mod.Foo``, ``"Foo"`` (string annotations, including the
+    ``from __future__ import annotations`` form every package module
+    uses), ``Optional[Foo]`` / ``Final[Foo]`` / ``Annotated[Foo, ...]``
+    and ``Foo | None`` unions.  Anything more exotic (real unions of two
+    classes, generics over type vars) stays a documented blind spot —
+    a wrong pin would fabricate call edges."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            return _ann_name(ast.parse(node.value, mode="eval").body)
+        except SyntaxError:
+            return None
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return _last(node)
+    if isinstance(node, ast.Subscript):
+        if _last(node.value) in ("Optional", "Final", "Annotated"):
+            sl = node.slice
+            if isinstance(sl, ast.Tuple) and sl.elts:
+                sl = sl.elts[0]
+            return _ann_name(sl)
+        return None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        names = [_ann_name(node.left), _ann_name(node.right)]
+        real = [n for n in names if n and n != "None"]
+        return real[0] if len(real) == 1 else None
+    return None
+
+
 class FunctionInfo:
     """One project function: its AST, home file, and resolution scope."""
 
-    __slots__ = ("qual", "node", "ctx", "cls", "module")
+    __slots__ = ("qual", "node", "ctx", "cls", "module", "_ann")
 
     def __init__(self, qual: str, node: ast.AST, ctx, module: str,
                  cls: Optional["ClassInfo"]):
@@ -98,6 +129,28 @@ class FunctionInfo:
         self.ctx = ctx          # the FileContext the function lives in
         self.module = module
         self.cls = cls
+        self._ann = None
+
+    def ann_types(self) -> Dict[str, ast.AST]:
+        """Annotated locals of this function: parameter annotations plus
+        ``x: Foo`` annotated assignments — name → annotation node.  This
+        is how the call graph sees dynamic dispatch through annotated
+        receivers (``def f(s: DatasetScanner): s.close()``)."""
+        if self._ann is None:
+            out: Dict[str, ast.AST] = {}
+            a = getattr(self.node, "args", None)
+            if a is not None:
+                for arg in (
+                    list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                ):
+                    if arg.annotation is not None and arg.arg != "self":
+                        out[arg.arg] = arg.annotation
+            for sub in ast.walk(self.node):
+                if isinstance(sub, ast.AnnAssign) and \
+                        isinstance(sub.target, ast.Name):
+                    out.setdefault(sub.target.id, sub.annotation)
+            self._ann = out
+        return self._ann
 
 
 class ClassInfo:
@@ -234,13 +287,35 @@ class Project:
         """``self.attr = KnownClass(...)`` (or ``= threading.Lock()``)
         inside any method types the attribute for the whole class —
         flow-insensitive; a reassignment to an unknown type leaves the
-        earlier inference in place (documented blind spot)."""
+        earlier inference in place (documented blind spot).  ANNOTATIONS
+        type attributes too: ``self.attr: KnownClass`` in a method and
+        ``attr: KnownClass`` in the class body both pin the attribute,
+        covering receivers whose constructor call the two inference
+        shapes above cannot see (factory returns, injected
+        collaborators)."""
         mod = self.module_of[ctx]
         for node in ctx.tree.body:
             if not isinstance(node, ast.ClassDef):
                 continue
             cls = self.classes[f"{mod}.{node.name}"]
+            for item in node.body:
+                # class-body annotation: ``attr: KnownClass [= ...]``
+                if isinstance(item, ast.AnnAssign) and \
+                        isinstance(item.target, ast.Name):
+                    self._record_attr(
+                        ctx, cls, item.target.id,
+                        _ann_name(item.annotation),
+                    )
             for sub in ast.walk(node):
+                if isinstance(sub, ast.AnnAssign):
+                    t = sub.target
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        self._record_attr(
+                            ctx, cls, t.attr, _ann_name(sub.annotation)
+                        )
+                    continue
                 if not isinstance(sub, ast.Assign) or \
                         not isinstance(sub.value, ast.Call):
                     continue
@@ -250,13 +325,19 @@ class Project:
                             and isinstance(t.value, ast.Name)
                             and t.value.id == "self"):
                         continue
-                    if ctor in _LOCK_CTORS:
-                        cls.lock_attrs[t.attr] = ctor
-                        self.lock_attr_names.setdefault(t.attr, ctor)
-                        continue
-                    cq = self._class_qual(ctx, ctor) if ctor else None
-                    if cq is not None:
-                        cls.attr_types.setdefault(t.attr, cq)
+                    self._record_attr(ctx, cls, t.attr, ctor)
+
+    def _record_attr(self, ctx, cls: ClassInfo, attr: str,
+                     type_name: Optional[str]) -> None:
+        if not type_name:
+            return
+        if type_name in _LOCK_CTORS:
+            cls.lock_attrs[attr] = type_name
+            self.lock_attr_names.setdefault(attr, type_name)
+            return
+        cq = self._class_qual(ctx, type_name)
+        if cq is not None:
+            cls.attr_types.setdefault(attr, cq)
 
     # -- name resolution -----------------------------------------------------
 
@@ -360,8 +441,17 @@ class Project:
             if tq is not None:
                 return self._method_in(tq, attr)
             return None
-        # mod.fn(...) through a module alias
+        # mod.fn(...) through a module alias, or method dispatch through
+        # an ANNOTATED local/parameter (params shadow file-level
+        # imports, so the annotation is consulted first)
         if isinstance(recv, ast.Name):
+            ann = info.ann_types().get(recv.id)
+            if ann is not None:
+                cq = self._class_qual(ctx, _ann_name(ann))
+                if cq is not None:
+                    hit = self._method_in(cq, attr)
+                    if hit is not None:
+                        return hit
             target = self.aliases.get(ctx, {}).get(recv.id)
             if target is not None:
                 if f"{target}.{attr}" in self.functions:

@@ -217,13 +217,17 @@ def _int_producing(e: ast.AST) -> bool:
 def check(ctx: FileContext, project=None):
     # format/ parses wire bytes; tpu/engine.py sizes its staging arenas
     # and decode buffers from the same footer/page fields (group byte
-    # estimates, padded string widths, chunk row counts); and
+    # estimates, padded string widths, chunk row counts);
     # native/binding.py is the ctypes boundary where those sizes become
-    # raw output buffers for the C decompressors — all three are the
-    # SAME bug class and all three are in scope.
+    # raw output buffers for the C decompressors; and write/ sizes its
+    # compaction carry buffers and device encode inputs from footer row
+    # counts of FOREIGN files (the compactor reads corpora it did not
+    # write) — all the SAME bug class, all in scope.
     in_default = (
         ctx.under("parquet_floor_tpu", "format")
-        or ctx.is_module("tpu/engine.py", "native/binding.py")
+        or ctx.under("parquet_floor_tpu", "write")
+        or ctx.is_module("tpu/engine.py", "native/binding.py",
+                         "tpu/encode_kernels.py")
     )
     if not ctx.in_scope("FL-ALLOC", in_default):
         return

@@ -80,11 +80,18 @@ _ACQUIRERS = {
     # all four release with close() and leak exactly like an fd if a
     # raise lands between acquisition and release
     "SharedBufferCache", "Serving", "Tenant", "Dataset",
+    # the write path (write/, docs/write.md): a DeviceFileWriter owns a
+    # sink fd AND a compression pool (close() finalizes the footer,
+    # abort() releases without one — both are releases), and the
+    # resolve_writer factory returns one; plain ParquetFileWriter owns
+    # its sink the same way
+    "DeviceFileWriter", "ParquetFileWriter", "resolve_writer",
 }
 
 # the verbs that count as releasing an acquisition (executors release
-# with shutdown(), everything else with close())
-_RELEASERS = ("close", "shutdown")
+# with shutdown(), writers also with abort() — the no-footer release —
+# everything else with close())
+_RELEASERS = ("close", "shutdown", "abort")
 
 
 def _is_acquisition(node: ast.Call) -> bool:

@@ -82,7 +82,15 @@ class ParquetWriter:
         )
         self.schema = schema
         self.dehydrator = dehydrator
-        self._writer = ParquetFileWriter(dest, schema, self.options)
+        if self.options.engine != "host":
+            # the facade rides the device encode engine
+            # (docs/write.md): row groups flush through the fused
+            # encode launches + the encode‖compress‖write pipeline
+            from ..write.encode import resolve_writer
+
+            self._writer = resolve_writer(dest, schema, self.options)
+        else:
+            self._writer = ParquetFileWriter(dest, schema, self.options)
         self._vw = _RowValueWriter(schema)
         self._buffer: List[list] = []
         self._buffer_bytes = 0

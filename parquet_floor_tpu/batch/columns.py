@@ -267,6 +267,32 @@ class BatchColumn:
         return pa.array(vals, mask=mask)
 
 
+def take_rows(values, def_levels, max_definition_level: int,
+              row_idx: np.ndarray):
+    """Gather whole ROWS of one flat column by row index: returns
+    ``(new_values, new_def_levels)``.  ``values`` holds non-null values
+    only (the ColumnBatch/ColumnData layout) — present rows map through
+    the definition levels to value positions.  The one definition of
+    the null-aware row gather shared by the host pushdown compaction
+    (``scan/executor.py``) and the compactor's within-group sort
+    (``write/compactor.py``)."""
+    if def_levels is not None:
+        new_dl = def_levels[row_idx]
+        present = def_levels == max_definition_level
+        vidx = np.cumsum(present) - 1
+        sel = row_idx[present[row_idx]]
+        take = vidx[sel]
+    else:
+        new_dl = None
+        take = row_idx
+    vals = (
+        values.take(take)
+        if isinstance(values, ByteArrayColumn)
+        else np.asarray(values)[take]
+    )
+    return vals, new_dl
+
+
 def batch_to_arrow(columns: List["BatchColumn"]):
     """A list of flat ``BatchColumn``s (one row group) as a
     ``pyarrow.RecordBatch`` in the given column order."""

@@ -101,6 +101,33 @@ class ByteArrayColumn:
         np.cumsum(lengths, out=offsets[1:])
         return cls(offsets, pool)
 
+    def take(self, idx: np.ndarray) -> "ByteArrayColumn":
+        """Gather value rows by index — vectorized (the CPU shape of the
+        TPU dictionary-gather kernel): one ragged source-index build over
+        only the selected bytes."""
+        idx = np.asarray(idx, dtype=np.int64)
+        out_lengths = self.lengths()[idx]
+        offsets = np.zeros(len(idx) + 1, dtype=np.int64)
+        np.cumsum(out_lengths, out=offsets[1:])
+        total = int(offsets[-1])
+        if total == 0:
+            return ByteArrayColumn(offsets, np.zeros(0, np.uint8))
+        starts = self.offsets[:-1][idx]
+        src = np.repeat(starts - offsets[:-1], out_lengths) + np.arange(total)
+        return ByteArrayColumn(offsets, self.data[src])
+
+    @classmethod
+    def concat(cls, cols: "list[ByteArrayColumn]") -> "ByteArrayColumn":
+        """Concatenate columns into one pool (the compactor's carry
+        buffer flush)."""
+        if not cols:
+            return cls(np.zeros(1, np.int64), np.zeros(0, np.uint8))
+        lengths = np.concatenate([c.lengths() for c in cols])
+        pool = np.concatenate([
+            c.data[c.offsets[0] : c.offsets[-1]] for c in cols
+        ]) if lengths.sum() else np.zeros(0, np.uint8)
+        return cls.from_pool(lengths, pool)
+
     def __eq__(self, other):
         if isinstance(other, ByteArrayColumn):
             return (

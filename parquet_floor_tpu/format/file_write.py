@@ -115,6 +115,19 @@ class WriterOptions:
     # sort; the caller asserts the order).  Entries are a column name
     # or (name, descending, nulls_first).
     sorting_columns: Optional[List[object]] = None
+    # Encode engine (docs/write.md): "host" keeps the numpy encoders;
+    # "tpu" routes flat numeric columns through the fused device encode
+    # programs (``write.DeviceFileWriter``), host-encoding the rest;
+    # "auto" picks tpu when a usable jax backend is up.  The engine
+    # selection lives in ``parquet_floor_tpu.write`` — this dataclass
+    # only carries the knob so the api facade and the compactor share
+    # one options surface.
+    engine: str = "host"
+    # DeviceFileWriter pipeline: how many row groups may be in flight
+    # (device-encoded, compressing) before write_row_group blocks, and
+    # the compression pool width (None = min(4, cpu)).
+    write_pipeline_depth: int = 2
+    compress_threads: Optional[int] = None
 
 
 @dataclass
@@ -316,8 +329,54 @@ def _truncate_min_max(desc, mm, limit: Optional[int]):
     return mn, mx
 
 
+@dataclass
+class PrecomputedPages:
+    """A device-encoded column's handoff into
+    :meth:`_ColumnChunkWriter.prepare` (built by ``write/encode.py``):
+    the chosen value encoding, the level-position page boundaries the
+    payloads were cut at, one encoded value stream per page, and — for
+    the dictionary path — the host-side dictionary values the PLAIN
+    dictionary page is encoded from.  Statistics, levels, page headers,
+    compression, CRCs, and the page indexes all still run through the
+    one host pagination path, so device-encoded chunks share every
+    metadata behavior with host-encoded ones."""
+
+    value_encoding: int
+    positions: List[tuple]
+    page_payloads: List[bytes]
+    dictionary: object = None
+
+
+@dataclass
+class _PreparedChunk:
+    """One column chunk, fully encoded and compressed but not yet
+    written: :meth:`_ColumnChunkWriter.emit` turns it into sink bytes +
+    a ``ColumnChunk`` once the row group's position is known.  Page
+    payloads (``EncodedPage``) are offset-free by construction, which is
+    what lets preparation run concurrently while emission stays
+    strictly ordered."""
+
+    desc: ColumnDescriptor
+    value_encoding: int
+    num_values: int
+    dict_page: Optional[object]            # EncodedPage | None
+    pages: List[object]                    # EncodedPage per data page
+    page_rows: List[int]                   # num_rows per data page
+    total_uncompressed: int
+    total_compressed: int
+    statistics: Optional[Statistics]
+    # (null_pages, mins, maxs, null_counts, index_ok) or None
+    index: Optional[tuple]
+    data: Optional[ColumnData] = None      # kept for the bloom pass
+
+
 class _ColumnChunkWriter:
-    """Encodes one column's pages for one row group and tracks metadata."""
+    """Encodes one column's pages for one row group and tracks metadata.
+
+    Split into :meth:`prepare` (encode + paginate + compress — no sink,
+    safe to run on a worker thread) and :meth:`emit` (sequential sink
+    writes + offset bookkeeping); :meth:`write` composes them for the
+    plain synchronous path."""
 
     def __init__(self, options: WriterOptions, descriptor: ColumnDescriptor):
         self.options = options
@@ -371,6 +430,10 @@ class _ColumnChunkWriter:
         return values[lo:hi]
 
     def write(self, sink: FileSink, data: ColumnData) -> ColumnChunk:
+        return self.emit(sink, self.prepare(data))
+
+    def prepare(self, data: ColumnData,
+                pre: Optional[PrecomputedPages] = None) -> _PreparedChunk:
         opt = self.options
         desc = self.desc
         values = data.values
@@ -381,64 +444,62 @@ class _ColumnChunkWriter:
         # --- choose encoding: try dictionary first -------------------------
         dictionary = None
         indices = None
-        dict_enable = opt.enable_dictionary
-        if opt.column_dictionary is not None:
-            dict_enable = opt.column_dictionary.get(desc.path[0], dict_enable)
-        if opt.column_encodings and desc.path[0] in opt.column_encodings:
-            # an explicit per-column encoding bypasses the dictionary
-            # attempt entirely (pyarrow column_encoding semantics)
-            dict_enable = False
-        use_dict = (
-            dict_enable
-            and desc.physical_type != Type.BOOLEAN
-            and n_leaf > 0
-        )
-        if use_dict:
-            dictionary, indices = build_dictionary(values, desc.physical_type)
-            dict_len = len(dictionary)
-            dict_bytes = (
-                int(dictionary.offsets[-1]) + 4 * dict_len
-                if isinstance(dictionary, ByteArrayColumn)
-                else dictionary.nbytes
+        if pre is None:
+            dict_enable = opt.enable_dictionary
+            if opt.column_dictionary is not None:
+                dict_enable = opt.column_dictionary.get(
+                    desc.path[0], dict_enable
+                )
+            if opt.column_encodings and desc.path[0] in opt.column_encodings:
+                # an explicit per-column encoding bypasses the dictionary
+                # attempt entirely (pyarrow column_encoding semantics)
+                dict_enable = False
+            use_dict = (
+                dict_enable
+                and desc.physical_type != Type.BOOLEAN
+                and n_leaf > 0
             )
-            if dict_len > max(1, int(n_leaf * opt.dictionary_max_fraction)) or (
-                dict_bytes > opt.dictionary_max_bytes
-            ):
-                dictionary, indices = None, None
-        value_encoding = (
-            Encoding.RLE_DICTIONARY if dictionary is not None
-            else self._choose_value_encoding(values)
-        )
+            if use_dict:
+                dictionary, indices = build_dictionary(
+                    values, desc.physical_type
+                )
+                dict_len = len(dictionary)
+                dict_bytes = (
+                    int(dictionary.offsets[-1]) + 4 * dict_len
+                    if isinstance(dictionary, ByteArrayColumn)
+                    else dictionary.nbytes
+                )
+                if dict_len > max(
+                    1, int(n_leaf * opt.dictionary_max_fraction)
+                ) or (dict_bytes > opt.dictionary_max_bytes):
+                    dictionary, indices = None, None
+            value_encoding = (
+                Encoding.RLE_DICTIONARY if dictionary is not None
+                else self._choose_value_encoding(values)
+            )
+        else:
+            dictionary = pre.dictionary
+            value_encoding = pre.value_encoding
 
-        first_offset = sink.pos
-        dict_page_offset = None
-        encoding_stats: List[PageEncodingStats] = []
+        dict_page = None
         total_uncompressed = 0
         total_compressed = 0
 
         if dictionary is not None:
-            ep = pg.encode_dictionary_page(
+            dict_page = pg.encode_dictionary_page(
                 dictionary, desc, codec, opt.write_crc, opt.codec_level
             )
-            dict_page_offset = sink.pos
-            hdr = ep.header.to_bytes()
-            sink.write(hdr)
-            sink.write(ep.body)
-            total_uncompressed += len(hdr) + ep.header.uncompressed_page_size
-            total_compressed += len(hdr) + len(ep.body)
-            encoding_stats.append(
-                PageEncodingStats(
-                    page_type=PageType.DICTIONARY_PAGE, encoding=Encoding.PLAIN, count=1
-                )
+            hlen = len(dict_page.header_bytes())
+            total_uncompressed += (
+                hlen + dict_page.header.uncompressed_page_size
             )
+            total_compressed += hlen + len(dict_page.body)
 
         # --- paginate ------------------------------------------------------
-        data_page_offset = None
         null_count_total = 0
         # Chunk-level min/max computed over the whole value array (encoded
         # bytes are little-endian and must not be compared lexicographically).
         chunk_mm = _min_max_bytes(desc, values) if opt.write_statistics else None
-        n_pages = 0
         per_page = max(1, opt.data_page_values)
         if opt.data_page_bytes:
             # compose the byte bound with the count bound: estimate this
@@ -466,23 +527,30 @@ class _ColumnChunkWriter:
 
         # Page boundaries are in *level* positions; for rep>0 keep whole rows
         # together by splitting only where rep_level == 0.
-        positions = self._page_boundaries(data, per_page)
+        positions = (
+            pre.positions if pre is not None
+            else self._page_boundaries(data, per_page)
+        )
         vi = 0  # running non-null value index
-        row_cursor = 0
         index_ok = True
-        idx_loc: List[PageLocation] = []
+        pages: List[pg.EncodedPage] = []
+        page_rows: List[int] = []
         idx_null_pages: List[bool] = []
         idx_mins: List[bytes] = []
         idx_maxs: List[bytes] = []
         idx_nulls: List[int] = []
-        for (lo, hi) in positions:
+        for pi, (lo, hi) in enumerate(positions):
             dl = data.def_levels[lo:hi] if data.def_levels is not None else None
             rl = data.rep_levels[lo:hi] if data.rep_levels is not None else None
             if dl is not None:
                 present = int(np.count_nonzero(dl == max_def))
             else:
                 present = hi - lo
-            page_vals = self._slice_values(values, vi, vi + present)
+            page_vals = (
+                self._slice_values(values, vi, vi + present)
+                if pre is None or opt.write_statistics
+                else None
+            )
             idx_vals = indices[vi : vi + present] if indices is not None else None
             vi += present
             if rl is not None:
@@ -490,12 +558,15 @@ class _ColumnChunkWriter:
             else:
                 num_rows = hi - lo
 
-            if dictionary is not None:
+            if pre is not None:
+                encoded = pre.page_payloads[pi]
+            elif dictionary is not None:
                 encoded = encode_dict_indices(idx_vals, len(dictionary))
             else:
                 encoded = self._encode_values(page_vals, value_encoding)
 
             stats = None
+            mm = None
             if opt.write_statistics:
                 nulls = (hi - lo) - present
                 null_count_total += nulls
@@ -518,21 +589,12 @@ class _ColumnChunkWriter:
                     opt.write_crc, num_values=hi - lo,
                     codec_level=opt.codec_level,
                 )
-            if data_page_offset is None:
-                data_page_offset = sink.pos
-            page_off = sink.pos
-            hdr = ep.header.to_bytes()
-            sink.write(hdr)
-            sink.write(ep.body)
-            total_uncompressed += len(hdr) + ep.header.uncompressed_page_size
-            total_compressed += len(hdr) + len(ep.body)
-            n_pages += 1
+            hlen = len(ep.header_bytes())
+            total_uncompressed += hlen + ep.header.uncompressed_page_size
+            total_compressed += hlen + len(ep.body)
+            pages.append(ep)
+            page_rows.append(num_rows)
             if opt.write_statistics:
-                idx_loc.append(PageLocation(
-                    offset=page_off,
-                    compressed_page_size=len(hdr) + len(ep.body),
-                    first_row_index=row_cursor,
-                ))
                 idx_null_pages.append(present == 0)
                 if present > 0 and mm is None:
                     # e.g. an all-NaN float page: the spec requires valid
@@ -545,45 +607,115 @@ class _ColumnChunkWriter:
                 idx_mins.append(idx_mm[0] if idx_mm is not None else b"")
                 idx_maxs.append(idx_mm[1] if idx_mm is not None else b"")
                 idx_nulls.append((hi - lo) - present)
-            row_cursor += num_rows
 
-        page_type = PageType.DATA_PAGE_V2 if opt.page_version == 2 else PageType.DATA_PAGE
-        encoding_stats.append(
-            PageEncodingStats(page_type=page_type, encoding=value_encoding, count=n_pages)
+        statistics = None
+        if opt.write_statistics:
+            statistics = Statistics(null_count=null_count_total)
+            chunk_mm_t = _truncate_min_max(
+                desc, chunk_mm, opt.statistics_truncate_length
+            )
+            if chunk_mm_t is not None:
+                statistics.min_value, statistics.max_value = chunk_mm_t
+        return _PreparedChunk(
+            desc=desc,
+            value_encoding=value_encoding,
+            num_values=num_values,
+            dict_page=dict_page,
+            pages=pages,
+            page_rows=page_rows,
+            total_uncompressed=total_uncompressed,
+            total_compressed=total_compressed,
+            statistics=statistics,
+            index=(
+                (idx_null_pages, idx_mins, idx_maxs, idx_nulls, index_ok)
+                if opt.write_statistics and pages
+                else None
+            ),
+            # the decoded values are only needed past prepare() when a
+            # bloom filter hashes them at emit time — dropping them
+            # otherwise frees each in-flight group's dominant buffer as
+            # soon as encoding finishes (the pipeline holds
+            # write_pipeline_depth groups)
+            data=(
+                data
+                if (opt.bloom_filter_columns or {}).get(desc.path[0])
+                else None
+            ),
         )
 
+    def emit(self, sink: FileSink, prepared: _PreparedChunk) -> ColumnChunk:
+        opt = self.options
+        desc = self.desc
+        first_offset = sink.pos
+        dict_page_offset = None
+        encoding_stats: List[PageEncodingStats] = []
+        if prepared.dict_page is not None:
+            dict_page_offset = sink.pos
+            sink.write(prepared.dict_page.header_bytes())
+            sink.write(prepared.dict_page.body)
+            encoding_stats.append(
+                PageEncodingStats(
+                    page_type=PageType.DICTIONARY_PAGE, encoding=Encoding.PLAIN, count=1
+                )
+            )
+        data_page_offset = None
+        row_cursor = 0
+        idx_loc: List[PageLocation] = []
+        for ep, num_rows in zip(prepared.pages, prepared.page_rows):
+            if data_page_offset is None:
+                data_page_offset = sink.pos
+            page_off = sink.pos
+            hdr = ep.header_bytes()
+            sink.write(hdr)
+            sink.write(ep.body)
+            if prepared.index is not None:
+                idx_loc.append(PageLocation(
+                    offset=page_off,
+                    compressed_page_size=len(hdr) + len(ep.body),
+                    first_row_index=row_cursor,
+                ))
+            row_cursor += num_rows
+        page_type = (
+            PageType.DATA_PAGE_V2 if opt.page_version == 2
+            else PageType.DATA_PAGE
+        )
+        encoding_stats.append(
+            PageEncodingStats(
+                page_type=page_type, encoding=prepared.value_encoding,
+                count=len(prepared.pages),
+            )
+        )
+
+        max_def, max_rep = desc.max_definition_level, desc.max_repetition_level
         encodings = sorted(
-            {value_encoding}
+            {prepared.value_encoding}
             | ({Encoding.RLE} if (max_def or max_rep or opt.page_version == 2) else set())
-            | ({Encoding.PLAIN} if dictionary is not None else set())
+            | ({Encoding.PLAIN} if prepared.dict_page is not None else set())
         )
         meta = ColumnMetaData(
             type=desc.physical_type,
             encodings=list(encodings),
             path_in_schema=list(desc.path),
-            codec=codec,
-            num_values=num_values,
-            total_uncompressed_size=total_uncompressed,
-            total_compressed_size=total_compressed,
+            codec=opt.codec,
+            num_values=prepared.num_values,
+            total_uncompressed_size=prepared.total_uncompressed,
+            total_compressed_size=prepared.total_compressed,
             data_page_offset=data_page_offset,
             dictionary_page_offset=dict_page_offset,
             encoding_stats=encoding_stats,
         )
-        if opt.write_statistics:
-            st = Statistics(null_count=null_count_total)
-            chunk_mm_t = _truncate_min_max(
-                desc, chunk_mm, opt.statistics_truncate_length
-            )
-            if chunk_mm_t is not None:
-                st.min_value, st.max_value = chunk_mm_t
-            meta.statistics = st
+        if prepared.statistics is not None:
+            meta.statistics = prepared.statistics
         chunk = ColumnChunk(file_offset=first_offset, meta_data=meta)
-        if opt.write_statistics and idx_loc:
+        if prepared.index is not None and idx_loc:
             # stashed for ParquetFileWriter.close(), which serializes the
             # page indexes between the last row group and the footer and
             # patches the offsets into this chunk (parquet-mr layout).
             # ColumnIndex is dropped when some non-null page has no valid
             # bounds (all-NaN pages); the OffsetIndex alone remains valid.
+            idx_null_pages, idx_mins, idx_maxs, idx_nulls, index_ok = (
+                prepared.index
+            )
             ci = (
                 ColumnIndex(
                     null_pages=idx_null_pages,
@@ -623,6 +755,17 @@ class ParquetFileWriter:
     def __init__(self, dest, schema: MessageType, options: Optional[WriterOptions] = None,
                  key_value_metadata: Optional[Dict[str, str]] = None):
         self.sink = dest if isinstance(dest, FileSink) else FileSink(dest)
+        try:
+            self._init_validated(schema, options, key_value_metadata)
+        except BaseException:
+            # a failed construction must not leak the sink fd (the
+            # option validation below raises BEFORE any byte is owned)
+            self.sink.close()
+            raise
+
+    def _init_validated(self, schema: MessageType,
+                        options: Optional[WriterOptions],
+                        key_value_metadata: Optional[Dict[str, str]]):
         self.schema = schema
         self.options = options or WriterOptions()
         # Validate Bloom selections up front: _maybe_build_bloom runs after
@@ -745,6 +888,50 @@ class ParquetFileWriter:
             )
         )
         self._num_rows += num_rows or 0
+
+    def write_prepared_group(self, prepared: Sequence[_PreparedChunk],
+                             num_rows: int) -> None:
+        """Emit one row group from already-prepared chunks (the device
+        write engine's entry point — ``write/encode.py`` validates the
+        columns and runs :meth:`_ColumnChunkWriter.prepare` off-thread;
+        this method only does the strictly-ordered sink writes +
+        metadata bookkeeping that :meth:`write_row_group` would)."""
+        if self._closed:
+            raise ValueError("writer is closed")
+        expected = self.schema.columns
+        if len(prepared) != len(expected):
+            raise ValueError(
+                f"row group has {len(prepared)} columns, schema has "
+                f"{len(expected)}"
+            )
+        rg_start = self.sink.pos
+        chunks: List[ColumnChunk] = []
+        total_bytes = 0
+        total_comp = 0
+        for pc, desc in zip(prepared, expected):
+            if pc.desc.path != desc.path:
+                raise ValueError(
+                    f"column order mismatch: got {pc.desc.path}, "
+                    f"want {desc.path}"
+                )
+            chunk = _ColumnChunkWriter(self.options, desc).emit(self.sink, pc)
+            if pc.data is not None:
+                self._maybe_build_bloom(chunk, desc, pc.data)
+            total_bytes += chunk.meta_data.total_uncompressed_size
+            total_comp += chunk.meta_data.total_compressed_size
+            chunks.append(chunk)
+        self._row_groups.append(
+            RowGroup(
+                columns=chunks,
+                total_byte_size=total_bytes,
+                num_rows=num_rows,
+                sorting_columns=self._sorting,
+                file_offset=rg_start,
+                total_compressed_size=total_comp,
+                ordinal=len(self._row_groups),
+            )
+        )
+        self._num_rows += num_rows
 
     def write_columns(self, columns: Dict[str, object]) -> None:
         """Convenience: dict of top-level-name → array/list (None = null).

@@ -506,10 +506,20 @@ def decode_data_page(
 class EncodedPage:
     header: PageHeader
     body: bytes  # compressed payload as it will land in the file
+    _header_bytes: "bytes | None" = None
+
+    def header_bytes(self) -> bytes:
+        """The serialized header, thrift-encoded ONCE (headers are
+        immutable after encoding — offsets live in the footer/indexes,
+        never in page headers — so the write path's size accounting and
+        the ordered sink emission share one serialization)."""
+        if self._header_bytes is None:
+            self._header_bytes = self.header.to_bytes()
+        return self._header_bytes
 
     @property
     def total_size(self) -> int:
-        return len(self.header.to_bytes()) + len(self.body)
+        return len(self.header_bytes()) + len(self.body)
 
 
 def encode_dictionary_page(

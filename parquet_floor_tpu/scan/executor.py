@@ -44,6 +44,7 @@ cache hits never consume retry budget).
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 import time
 from collections import deque
@@ -506,6 +507,29 @@ class DatasetScanner:
         self._options = options
         self._scan = scan or ScanOptions()
         self._predicate = predicate
+        # host-leg pushdown (docs/pushdown.md): with
+        # ``ScanOptions(pushdown=True)`` each decoded batch mask-compacts
+        # to the predicate's surviving rows, so both scan legs deliver
+        # the SAME row sets (the device leg compacts inside the fused
+        # launch).  Salvage keeps whole groups (quarantine decisions are
+        # group-wide); aggregate stays a device-leg shape.
+        self._mask_compact = bool(
+            self._scan.pushdown
+            and predicate is not None
+            and not (options is not None and options.salvage)
+            and self._scan.aggregate is None
+        )
+        # the device-leg contract: predicate columns OUTSIDE the
+        # projection decode (they must — the mask needs their values)
+        # but are dropped from delivered batches; the decode filter
+        # widens, the delivery filter stays the caller's projection
+        self._decode_filter = self._filter
+        if self._mask_compact and self._filter is not None:
+            from ..batch.predicate import tree, tree_columns
+
+            self._decode_filter = self._filter | {
+                c.split(".")[0] for c in tree_columns(tree(predicate))
+            }
         # salvage: per-unit reports fold here, in DELIVERY order (the
         # merge protocol); None in strict mode
         self._salvage = options is not None and options.salvage
@@ -599,6 +623,20 @@ class DatasetScanner:
                     c for c in reader.schema.columns
                     if self._filter is None or c.path[0] in self._filter
                 ]
+                if self._mask_compact and any(
+                    c.max_repetition_level > 0
+                    for c in reader.schema.columns
+                    if self._decode_filter is None
+                    or c.path[0] in self._decode_filter
+                ):
+                    from ..errors import UnsupportedFeatureError
+
+                    raise UnsupportedFeatureError(
+                        "pushdown row compaction supports flat columns "
+                        "only (the device leg rejects repeated leaves "
+                        "too); scan without pushdown and filter rows "
+                        "downstream"
+                    )
             elif key != self._schema_key:
                 raise DatasetSchemaError(
                     f"dataset file {fi} disagrees with the first file's "
@@ -610,8 +648,12 @@ class DatasetScanner:
                 else None
             )
             covered_by_group = self._page_covers(reader, keep)
-            plan = plan_file(reader, self._filter, keep, self._scan,
-                             covered_by_group)
+            plan = plan_file(
+                reader,
+                self._decode_filter if self._mask_compact
+                else self._filter,
+                keep, self._scan, covered_by_group,
+            )
             # page-index extents: tiny, footer-adjacent, shared by every
             # group (page_cover/predicates) — prefetch once per file
             if plan.index_extents:
@@ -705,6 +747,10 @@ class DatasetScanner:
                 "decode", work.plan.uncompressed_bytes, attrs=attrs
             ):
                 if not self._salvage:
+                    read_filter = (
+                        self._decode_filter if self._mask_compact
+                        else self._filter
+                    )
                     if work.plan.covered is not None:
                         # page-pruned group (ScanOptions.page_prune):
                         # decode exactly the covered pages — the cover is
@@ -712,12 +758,17 @@ class DatasetScanner:
                         # reproduces it as a fixpoint
                         batch, _cov = state.reader.read_row_group_ranges(
                             work.plan.group_index, work.plan.covered,
-                            self._filter,
+                            read_filter,
                         )
-                        return batch, None
-                    return state.reader.read_row_group(
-                        work.plan.group_index, self._filter
-                    ), None
+                    else:
+                        batch = state.reader.read_row_group(
+                            work.plan.group_index, read_filter
+                        )
+                    if self._mask_compact:
+                        batch = _pushdown_compact(
+                            batch, self._predicate, self._filter
+                        )
+                    return batch, None
                 # per-unit report: worker threads never touch a shared
                 # report; the consumer folds them in delivery order
                 unit_rep = SalvageReport()
@@ -954,8 +1005,18 @@ def scan_device_groups(sources: Sequence,
                 "(quarantine decisions are group-wide); scan with "
                 "salvage and filter on host"
             )
+        scope = None
+        if sources:
+            s0 = sources[0]
+            scope = (
+                os.fspath(s0) if isinstance(s0, (str, os.PathLike))
+                else getattr(s0, "name", None)
+            )
         compute_req = ComputeRequest(
             predicate=predicate, aggregate=sc.aggregate,
+            # dataset identity for the persisted capacity HWM —
+            # selectivity is a property of (predicate, data)
+            cache_scope=scope,
         )
     # attribute the whole scan to the tracer active at generator start
     # (worker tasks bind to it explicitly; a bare contextvar would not
@@ -1243,6 +1304,47 @@ def scan_device_groups(sources: Sequence,
             except Exception:
                 if not unwinding:
                     raise
+
+
+def _pushdown_compact(batch, predicate, projection=None):
+    """Host-leg pushdown row compaction (docs/pushdown.md): evaluate the
+    predicate over one decoded ``RowGroupBatch`` and keep only the
+    surviving rows — the host twin of the device leg's fused compact
+    output, so both legs deliver the same row sets under
+    ``ScanOptions(pushdown=True)``.  Null cells never match
+    (``eval_mask`` semantics, identical on both legs).  ``projection``
+    (a top-level name set, or None = all) trims predicate-only columns
+    the widened decode filter pulled in — they shaped the mask, they do
+    not ship, exactly like the device leg.  Runs on the decode worker
+    thread; ``scan.rows_filtered_host`` counts what was dropped."""
+    import numpy as np
+
+    from ..batch.columns import ColumnBatch, RowGroupBatch, take_rows
+    from ..batch.predicate import eval_mask
+
+    n = batch.num_rows
+    mask = eval_mask(predicate, _batch_resolver(batch), n)
+    k = int(np.count_nonzero(mask))
+    trace.count("scan.rows_filtered_host", n - k)
+    deliver = [
+        cb for cb in batch.columns
+        if projection is None or cb.descriptor.path[0] in projection
+    ]
+    if k == n:
+        if len(deliver) == len(batch.columns):
+            return batch
+        return RowGroupBatch(columns=deliver, num_rows=n)
+    keep = np.flatnonzero(mask)
+    cols = []
+    for cb in deliver:
+        values, new_dl = take_rows(
+            cb.values, cb.def_levels,
+            cb.descriptor.max_definition_level, keep,
+        )
+        cols.append(ColumnBatch(
+            cb.descriptor, k, values, def_levels=new_dl,
+        ))
+    return RowGroupBatch(columns=cols, num_rows=k)
 
 
 def _batch_resolver(batch):

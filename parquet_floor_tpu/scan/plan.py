@@ -64,13 +64,17 @@ class ScanOptions:
       Honored on BOTH scan faces (host ``DatasetScanner`` and the
       device leg); ignored without a predicate and under salvage
       (quarantine decisions are group-wide).
-    * ``pushdown`` — device scan leg only (docs/pushdown.md): evaluate
-      the scan's ``predicate`` INSIDE each group's fused decode
-      executable and deliver only the surviving rows, device-compacted
-      (``scan.rows_filtered_device`` counts what never crossed D2H).
-      Composes with ``page_prune`` (the storage-side rung narrows what
-      decodes; the device rung filters what ships).  Ignored without a
-      predicate and on the host leg.
+    * ``pushdown`` — row filtering below the delivery surface
+      (docs/pushdown.md): the device leg evaluates the scan's
+      ``predicate`` INSIDE each group's fused decode executable and
+      delivers only the surviving rows, device-compacted
+      (``scan.rows_filtered_device`` counts what never crossed D2H);
+      the host ``DatasetScanner`` mask-compacts each decoded batch to
+      the same surviving rows (``scan.rows_filtered_host``), so BOTH
+      legs deliver identical row sets.  Composes with ``page_prune``
+      (the storage-side rung narrows what decodes; the pushdown rung
+      filters what ships).  Ignored without a predicate and under
+      salvage; flat columns only (repeated leaves reject, both legs).
     * ``aggregate`` — a :class:`~parquet_floor_tpu.batch.aggregate.Aggregate`:
       the device leg ships per-group PARTIAL aggregate states
       (O(groups) bytes of D2H) instead of columns; fold them with

@@ -57,6 +57,7 @@ from ..batch.aggregate import (
     neutral_min,
 )
 from ..errors import UnsupportedFeatureError
+from ..utils import trace
 
 _NUM_VDTYPES = ("int32", "int64", "float32", "float64", "bool")
 
@@ -81,7 +82,8 @@ class ComputeRequest:
 
     def __init__(self, predicate=None, aggregate: Optional[Aggregate] = None,
                  mode: str = "compact",
-                 initial_capacity: Optional[int] = None):
+                 initial_capacity: Optional[int] = None,
+                 cache_scope: Optional[str] = None):
         if predicate is None and aggregate is None:
             raise ValueError("ComputeRequest needs a predicate, an "
                              "aggregate, or both")
@@ -95,8 +97,62 @@ class ComputeRequest:
         if initial_capacity is not None and initial_capacity < 1:
             raise ValueError("initial_capacity must be >= 1")
         self.initial_capacity = initial_capacity
+        # dataset identity for the persisted HWM (docs/pushdown.md):
+        # selectivity is a property of (predicate, DATA) — without a
+        # scope, one unselective dataset would inflate every other
+        # dataset's compact capacity forever.  None = no persistence.
+        self.cache_scope = cache_scope
         self._lock = threading.Lock()
         self._max_seen = 0
+        self._hwm_key: Optional[str] = None
+        self._hwm_checked = False
+        self._hwm_stored = 0
+
+    def _hwm_cache_key(self) -> Optional[str]:
+        """Stable sidecar key of this request's selection shape: the
+        predicate tree + mode + DATASET scope (docs/pushdown.md — the
+        persisted capacity HWM next to the exec cache).  Aggregate-only
+        requests carry no compact capacity; scope-less requests don't
+        persist (selectivity without a dataset identity is
+        meaningless)."""
+        if self.tree is None or self.mode != "compact" or \
+                not self.cache_scope:
+            return None
+        if self._hwm_key is None:
+            import hashlib
+
+            self._hwm_key = hashlib.sha256(
+                repr((self.tree, self.mode, self.cache_scope)).encode()
+            ).hexdigest()[:32]
+        return self._hwm_key
+
+    def _restore_hwm(self) -> None:
+        """One-time warm-start: adopt the HWM a previous process
+        persisted next to the exec cache, so the first group skips the
+        initial-capacity guess (and its possible re-dispatch).  An
+        EXPLICIT ``initial_capacity`` wins — a caller override must
+        never be silently replaced by a cached hint."""
+        from . import exec_cache
+
+        with self._lock:
+            if self._hwm_checked:
+                return
+            self._hwm_checked = True
+        if self.initial_capacity is not None:
+            return
+        key = self._hwm_cache_key()
+        cache = exec_cache.active()
+        if key is None or cache is None:
+            return
+        v = cache.load_hwm(key)
+        if v:
+            with self._lock:
+                if v > self._max_seen:
+                    self._max_seen = v
+                    self._hwm_stored = v
+            trace.decision("engine.pushdown", {
+                "action": "hwm_restore", "rows": int(v),
+            })
 
     def columns_needed(self) -> set:
         out = set()
@@ -109,6 +165,7 @@ class ComputeRequest:
     def capacity_for(self, n: int) -> int:
         from .engine import _bucket15
 
+        self._restore_hwm()
         with self._lock:
             seen = self._max_seen
         if seen:
@@ -119,9 +176,28 @@ class ComputeRequest:
         return max(1, min(n, _bucket15(init)))
 
     def observe(self, count: int) -> None:
+        from .engine import _bucket15
+
         with self._lock:
             if count > self._max_seen:
                 self._max_seen = count
+            # persist only when the BUCKETED capacity grows: capacity
+            # is bucket-granular, so finer maxima change nothing a warm
+            # start could use — this bounds the sidecar's synchronous
+            # read-merge-rewrite to O(log) publishes per scan even on
+            # data whose per-group selectivity rises monotonically
+            publish = self._hwm_stored == 0 or (
+                _bucket15(count) > _bucket15(self._hwm_stored)
+            )
+            if publish:
+                self._hwm_stored = max(count, self._hwm_stored)
+        if publish:
+            from . import exec_cache
+
+            key = self._hwm_cache_key()
+            cache = exec_cache.active()
+            if key is not None and cache is not None:
+                cache.store_hwm(key, int(count))
 
 
 class _CPlan(NamedTuple):

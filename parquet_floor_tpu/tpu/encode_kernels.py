@@ -1,0 +1,219 @@
+"""Fused device ENCODE programs — the write-path mirror of the fused
+decode launch (docs/write.md).
+
+One row group encodes in (at most) two fused launches, both dispatched
+through the persistent executable cache (:mod:`.exec_cache`):
+
+* **analyze** — everything whose output shape is data-independent:
+  dictionary build (bit-pattern sort → unique flags → cumsum ranks →
+  scatter, yielding the per-value index stream, the distinct count, and
+  the first-sorted-occurrence positions the host gathers dictionary
+  VALUES from), DELTA_BINARY_PACKED preparation (wrapped deltas, the
+  signed global ``min_delta``, offset stream, max offset), and
+  BYTE_STREAM_SPLIT (per-page byte transposition — no dynamic inputs,
+  so it finishes in this launch).
+* **pack** — bit-packing of index/offset streams at a STATIC width the
+  host chose from the analyze scalars (dict count → index width, max
+  offset → delta width).  Widths are restricted to divisors of 32 so a
+  32-bit word holds a whole number of values: the pack is a reshape +
+  shift + OR fold, no scatter.  Any width the spec allows (1..32) is
+  legal on the wire — padding up to a divisor of 32 costs bytes the
+  downstream page compression largely reclaims, and buys a fused
+  word-parallel pack.
+
+Everything here is XLA-level (``jnp``) like the decode engine's fusion
+wrapper — sort/cumsum/scatter/shift lower to single fused executables;
+the per-column loop is unrolled at trace time exactly like
+``_decode_fused``.  Bit order matches the parquet RLE/bit-packed hybrid
+(LSB-first, value *j* of a word at bits ``[j*w, (j+1)*w)``, words
+little-endian) — pinned against ``rle_hybrid.bit_pack`` by test.
+
+The program tuple (:class:`EncSpec` per column) is the static jit
+signature and therefore the exec-cache key; column names deliberately
+stay OUT of the spec so two files with the same shape signature share
+one executable.
+"""
+
+from __future__ import annotations
+
+from functools import partial, reduce
+from typing import List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import trace
+from . import exec_cache
+
+#: pack widths a 32-bit word divides evenly into (module docstring)
+PACK_WIDTHS = (1, 2, 4, 8, 16, 32)
+
+
+def pack_width_for(min_width: int) -> int:
+    """Smallest legal pack width >= ``min_width`` (>=1), or 0 when the
+    stream needs no bits at all (single-value dictionaries, all-equal
+    deltas)."""
+    if min_width <= 0:
+        return 0
+    for w in PACK_WIDTHS:
+        if w >= min_width:
+            return w
+    raise ValueError(f"bit width {min_width} exceeds 32")
+
+
+class EncSpec(NamedTuple):
+    """Static per-column signature of one fused encode launch.
+
+    ``kind``: ``dict`` | ``delta`` | ``bss`` (analyze) or ``pack``
+    (pack launch).  ``dtype`` is the UNSIGNED bit-view dtype of the
+    value stream the host ships (floats arrive bit-viewed — sort order
+    is irrelevant for dictionary identity, only equal-bits adjacency).
+    ``n`` is the exact element count of the input array (the write path
+    ships exact host arrays; shape buckets are a decode-side concern).
+    ``page_rows`` (bss only) is the static page cut the per-page
+    transposition honors; ``width`` (pack only) is the static bit
+    width."""
+
+    kind: str
+    dtype: str
+    n: int
+    page_rows: int = 0
+    width: int = 0
+
+
+_SIGNED = {"uint32": jnp.int32, "uint64": jnp.int64}
+
+
+def _dict_build(keys, n: int):
+    """Sorted-unique dictionary build: returns (indices uint32, count
+    int32 scalar, uniq_pos int32 — original position of each distinct
+    value, in dictionary order)."""
+    order = jnp.argsort(keys)  # stable: equal bits keep input order
+    sk = keys[order]
+    new = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sk[1:] != sk[:-1]]
+    )
+    ranks = jnp.cumsum(new.astype(jnp.int32)) - 1
+    count = ranks[-1] + 1
+    indices = (
+        jnp.zeros(n, jnp.uint32).at[order].set(ranks.astype(jnp.uint32))
+    )
+    # representative original position per distinct value: min() makes
+    # the pick deterministic under duplicate scatter indices (the first
+    # occurrence in sorted order — every candidate holds equal bits, so
+    # ANY pick yields the same dictionary bytes; determinism is for
+    # bit-identical re-runs)
+    uniq_pos = (
+        jnp.full(n, n, jnp.int32).at[ranks].min(order.astype(jnp.int32))
+    )
+    return indices, count.astype(jnp.int32), uniq_pos
+
+
+def _delta_analyze(vu, spec: EncSpec):
+    """Wrapped deltas → (offsets unsigned, min_delta signed scalar,
+    max_offset unsigned scalar).  Offsets are ``delta - min_delta`` at
+    the column's physical width (wrapping, spec semantics) with ONE
+    global min shared by every block — each block header re-declares
+    it, which is legal and keeps the packed stream contiguous."""
+    signed = _SIGNED[spec.dtype]
+    if spec.n <= 1:
+        z = jnp.zeros((), vu.dtype)
+        return (
+            jnp.zeros((0,), vu.dtype),
+            jnp.zeros((), signed),
+            z,
+        )
+    d = vu[1:] - vu[:-1]
+    sd = jax.lax.bitcast_convert_type(d, signed)
+    min_d = jnp.min(sd)
+    offs = d - jax.lax.bitcast_convert_type(min_d, vu.dtype)
+    return offs, min_d, jnp.max(offs)
+
+
+def _byte_split(v):
+    """(n,) unsigned → (n, itemsize) little-endian bytes."""
+    isz = v.dtype.itemsize
+    return jnp.stack(
+        [(v >> jnp.asarray(8 * k, v.dtype)).astype(jnp.uint8)
+         for k in range(isz)],
+        axis=1,
+    )
+
+
+def _bss_split(v, spec: EncSpec):
+    """Per-page BYTE_STREAM_SPLIT: full pages transpose as one block,
+    the partial tail page transposes on its own (a short page's stream
+    is NOT a slice of the full-page transpose)."""
+    b = _byte_split(v)
+    isz = v.dtype.itemsize
+    p = spec.page_rows
+    k_full = spec.n // p
+    full = (
+        b[: k_full * p].reshape(k_full, p, isz)
+        .transpose(0, 2, 1).reshape(-1)
+    )
+    tail = b[k_full * p:].T.reshape(-1)
+    return full, tail
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _encode_analyze(program: Tuple[EncSpec, ...], *arrays):
+    """The fused per-row-group ANALYZE launch (module docstring): one
+    input array per spec, outputs concatenated in spec order — dict →
+    (indices, count, uniq_pos), delta → (offsets, min_delta, max_off),
+    bss → (full_pages_bytes, tail_bytes)."""
+    outs: list = []
+    for i, spec in enumerate(program):
+        v = arrays[i]
+        if spec.kind == "dict":
+            outs.extend(_dict_build(v, spec.n))
+        elif spec.kind == "delta":
+            outs.extend(_delta_analyze(v, spec))
+        elif spec.kind == "bss":
+            outs.extend(_bss_split(v, spec))
+        else:  # pragma: no cover - specs are engine-built
+            raise ValueError(f"bad analyze kind {spec.kind!r}")
+    return tuple(outs)
+
+
+def _pack_stream(arr, spec: EncSpec):
+    """Bit-pack ``spec.n`` values at static width ``spec.width`` into
+    LSB-first bytes (parquet hybrid bit-packed layout)."""
+    w = spec.width
+    v = arr.astype(jnp.uint32)
+    per = 32 // w
+    m = -(-spec.n // per)
+    v = jnp.pad(v, (0, m * per - spec.n)).reshape(m, per)
+    words = reduce(
+        jnp.bitwise_or,
+        [v[:, j] << jnp.uint32(j * w) for j in range(per)],
+    )
+    return jnp.stack(
+        [(words >> jnp.uint32(8 * k)).astype(jnp.uint8) for k in range(4)],
+        axis=1,
+    ).reshape(-1)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _encode_pack(program: Tuple[EncSpec, ...], *arrays):
+    """The fused PACK launch: every index/offset stream of the row
+    group bit-packs in one executable (one output per spec)."""
+    return tuple(
+        _pack_stream(arr, spec) for spec, arr in zip(program, arrays)
+    )
+
+
+def run_analyze(program: Tuple[EncSpec, ...], arrays: List, device=None):
+    """Dispatch one fused analyze launch through the exec cache."""
+    trace.count("write.launches")
+    return exec_cache.dispatch(
+        _encode_analyze, (program,), arrays, device=device
+    )
+
+
+def run_pack(program: Tuple[EncSpec, ...], arrays: List, device=None):
+    """Dispatch one fused pack launch through the exec cache."""
+    trace.count("write.launches")
+    return exec_cache.dispatch(
+        _encode_pack, (program,), arrays, device=device
+    )

@@ -187,6 +187,114 @@ class ExecutableCache:
         self._key_cache: dict = {}   # signature tuple → key hex
         self._env = None             # computed lazily (needs a backend)
         self._preload_done = False
+        self._hwm: Optional[dict] = None  # pushdown HWM sidecar (lazy)
+
+    # -- pushdown capacity HWM sidecar ---------------------------------------
+    #
+    # ComputeRequest sizes its compact output from a scan-wide selection
+    # high-water mark; the FIRST group of every process otherwise runs
+    # at an initial-capacity guess and may pay a counted re-dispatch.
+    # Persisting the HWM next to the executables (same lifetime, same
+    # toolchain-agnostic keying by request signature) lets a warm
+    # process skip the guess entirely (docs/pushdown.md).  Everything is
+    # best-effort: a missing/corrupt/read-only sidecar degrades to the
+    # in-process guess, never to an error on the scan path.
+
+    _HWM_FILE = "pushdown_hwm.json"
+    _HWM_MAX_ENTRIES = 512
+
+    def _read_hwm_file(self) -> dict:
+        """Parse the sidecar off disk (no lock held — file I/O must not
+        stall other resolutions, the FL-LOCK002 contract).  The entry
+        cap applies HERE too, so an oversized file left by an older
+        build cannot grow unbounded through the merge-and-rewrite."""
+        try:
+            with open(os.path.join(self.path, self._HWM_FILE),
+                      "rb") as fh:
+                data = json.loads(fh.read())
+            out = {
+                str(k): int(v) for k, v in data.items()
+                if isinstance(v, int) and v >= 0
+            } if isinstance(data, dict) else {}
+        except (OSError, ValueError):
+            return {}
+        if len(out) > self._HWM_MAX_ENTRIES:
+            for k in list(out)[: len(out) - self._HWM_MAX_ENTRIES]:
+                del out[k]
+        return out
+
+    def _hwm_map(self) -> dict:
+        with self._lock:
+            if self._hwm is not None:
+                return self._hwm
+        data = self._read_hwm_file()  # outside the lock (I/O)
+        with self._lock:
+            if self._hwm is None:
+                self._hwm = data
+            return self._hwm
+
+    def load_hwm(self, key: str) -> Optional[int]:
+        """Persisted selection HWM for one pushdown-request key, or
+        None (first sight of this predicate on this cache dir)."""
+        hwm = self._hwm_map()
+        with self._lock:
+            return hwm.get(key)
+
+    def store_hwm(self, key: str, count: int) -> None:
+        """Raise the persisted HWM for ``key`` (monotone — a smaller
+        observation never shrinks it) and publish atomically."""
+        hwm = self._hwm_map()
+        with self._lock:
+            if hwm.get(key, -1) >= count:
+                return
+            hwm[key] = int(count)
+            if len(hwm) > self._HWM_MAX_ENTRIES:
+                # drop arbitrary overflow (dict order = insertion): the
+                # sidecar is a warm-start hint, not a database
+                for k in list(hwm)[: len(hwm) - self._HWM_MAX_ENTRIES]:
+                    del hwm[k]
+            payload = dict(hwm)
+        try:
+            os.makedirs(self.path, exist_ok=True)
+            # merge-with-disk under max(): concurrent processes each
+            # publish their own maxima; last writer keeps both
+            try:
+                with open(os.path.join(self.path, self._HWM_FILE),
+                          "rb") as fh:
+                    disk = json.loads(fh.read())
+                if isinstance(disk, dict):
+                    for k, v in disk.items():
+                        if isinstance(v, int) and \
+                                v > payload.get(str(k), -1):
+                            payload[str(k)] = v
+            except (OSError, ValueError):
+                pass
+            if len(payload) > self._HWM_MAX_ENTRIES:
+                # the cap must survive the merge: without re-trimming,
+                # disk entries resurrect every pruned key and the file
+                # grows forever (the just-stored key is kept)
+                for k in list(payload):
+                    if len(payload) <= self._HWM_MAX_ENTRIES:
+                        break
+                    if k != key:
+                        del payload[k]
+            fd, tmp = tempfile.mkstemp(
+                dir=self.path, prefix=".hwm.", suffix=".tmp"
+            )
+            try:
+                with os.fdopen(fd, "w") as fh:
+                    json.dump(payload, fh)
+                os.replace(tmp, os.path.join(self.path, self._HWM_FILE))
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+        except MemoryError:
+            raise
+        except Exception:
+            pass  # best-effort by contract (docstring above)
 
     # -- keying --------------------------------------------------------------
 

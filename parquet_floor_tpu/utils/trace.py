@@ -125,6 +125,22 @@ class names:
         "data.units_scheduled",
         "data.units_quarantined",
         "data.prefetch_to_device_batches",
+        # host-leg pushdown row compaction (scan/executor.py,
+        # docs/pushdown.md): rows the predicate dropped on the host leg
+        "scan.rows_filtered_host",
+        # the device write path (write/, tpu/encode_kernels.py,
+        # docs/write.md)
+        "write.launches",
+        "write.groups",
+        "write.rows",
+        "write.device_columns",
+        "write.host_columns",
+        "write.bytes_written",
+        # the dataset compactor (write/compactor.py, docs/write.md)
+        "compact.units_in",
+        "compact.rows_in",
+        "compact.rows_dropped",
+        "compact.groups_out",
     })
     GAUGES = frozenset({
         "scan.inflight_bytes_max",
@@ -134,6 +150,7 @@ class names:
         "data.carry_rows_max",
         "data.prefetch_to_device_depth_max",
         "serve.inflight_storage_bytes_max",
+        "write.inflight_groups_max",
     })
     DECISIONS = frozenset({
         "engine.auto",
@@ -160,6 +177,9 @@ class names:
         "serve.tenant",
         "serve.admission",
         "engine.pushdown",
+        "write.engine",
+        "compact.plan",
+        "compact.unit_dropped",
     })
     SPANS = frozenset({
         "read",
@@ -175,6 +195,8 @@ class names:
         "data.prefetch_to_device",
         "serve.lookup",
         "serve.aggregate",
+        "write.encode",
+        "write.emit",
     })
     ALL = COUNTERS | GAUGES | DECISIONS | SPANS
 

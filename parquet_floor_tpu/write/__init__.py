@@ -1,0 +1,27 @@
+"""Device-speed write path (docs/write.md): the encode mirror of the
+decode engine plus the dataset compaction service.
+
+* :class:`~parquet_floor_tpu.write.encode.EncodeEngine` /
+  :class:`~parquet_floor_tpu.write.encode.DeviceFileWriter` — fused
+  per-row-group device encode (dictionary build, index/delta
+  bit-packing, byte-stream-split) with host page
+  assembly + compression pipelined behind the launches.
+* :func:`~parquet_floor_tpu.write.encode.resolve_writer` — the
+  ``WriterOptions.engine`` switch ("host" | "tpu" | "auto").
+* :class:`~parquet_floor_tpu.write.compactor.DatasetCompactor` — stream
+  a corpus through the scan scheduler and re-shard / re-sort /
+  re-encode / re-compress it at scan speed (salvage honored on the
+  read leg, so a quarantined corpus compacts into a clean one).
+"""
+
+from .encode import DeviceFileWriter, EncodeEngine, resolve_writer
+from .compactor import CompactOptions, CompactReport, DatasetCompactor
+
+__all__ = [
+    "DeviceFileWriter",
+    "EncodeEngine",
+    "resolve_writer",
+    "CompactOptions",
+    "CompactReport",
+    "DatasetCompactor",
+]

@@ -1,0 +1,646 @@
+"""Dataset compaction / re-writing service (docs/write.md).
+
+Production stores churn data: small-file sprawl from incremental
+ingestion, row groups sized for the writer's memory instead of the
+scanner's schedule, encodings chosen before the data's shape was known,
+and — after an incident — corpora that only read under ``salvage=True``.
+:class:`DatasetCompactor` streams a corpus through the scan scheduler
+(:class:`~parquet_floor_tpu.scan.executor.DatasetScanner`) and re-writes
+it at scan speed through the device write engine:
+
+* **re-shard** — output row groups cut at ``target_row_group_rows``
+  (every group exact except each file's last), files rotated at
+  ``target_file_rows``; boundaries are PLANNED up front from the
+  corpus's unit-row prefix sums (the order plan's arithmetic —
+  ``data.order.EpochPlan``), so output geometry is deterministic before
+  a row is read.
+* **re-sort** — ``unit_order`` replays units in an explicit order
+  (the scanner's permuted-delivery face), and ``sort_by`` sorts rows
+  WITHIN each output row group (recorded as ``sorting_columns`` in the
+  output metadata).
+* **re-encode / re-compress** — output codec/encodings come from the
+  ``WriterOptions`` handed in; the writer is resolved through
+  ``write.resolve_writer``, so the fused device encode path carries the
+  compaction by default.
+* **salvage retirement** — with ``salvage=True`` the read leg decodes
+  through the salvage engine: page-null quarantines flow through as
+  ordinary nulls (legal data now), and any unit with GEOMETRY damage
+  (row-mask or chunk tier — its surviving columns no longer agree on a
+  row set the output schema could represent) is dropped WHOLE and
+  counted.  The output corpus needs no salvage to read and a fresh
+  :class:`~parquet_floor_tpu.quarantine.QuarantineMap` over it stays
+  empty — the map retires with the corrupt bytes (pinned by test).
+
+Flat schemas only (the row-slicing carry buffer does not re-shard
+repeated columns; compact those with the host writer per file).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..data.order import EpochPlan, Unit
+from ..errors import UnsupportedFeatureError, checked_alloc_size
+from ..format.encodings.plain import ByteArrayColumn
+from ..format.file_read import ParquetFileReader, SalvageReport
+from ..format.file_write import ColumnData, WriterOptions
+from ..format.schema import MessageType
+from ..io.source import FileSource
+from ..scan.executor import DatasetScanner
+from ..scan.plan import ScanOptions
+from ..utils import trace
+from .encode import resolve_writer
+
+
+@dataclass
+class CompactOptions:
+    """Knobs of one compaction run (module docstring)."""
+
+    target_row_group_rows: int = 1 << 20
+    target_file_rows: Optional[int] = None   # None = one output file
+    writer: Optional[WriterOptions] = None   # output codec/encodings/engine
+    columns: Optional[Sequence[str]] = None  # top-level projection
+    sort_by: Optional[Sequence[str]] = None  # within-group row sort
+    unit_order: Optional[Sequence] = None    # explicit (file, group) order
+    salvage: bool = False
+    reader: Optional[object] = None          # ReaderOptions overrides
+    scan: Optional[ScanOptions] = None
+    # Read leg: "tpu" streams the corpus through scan_device_groups
+    # (decode at device-scan speed, the compact_leg bench shape),
+    # "host" through DatasetScanner, "auto" picks tpu whenever it can —
+    # salvage and unit_order pin host (per-unit salvage reports and
+    # explicit unit order are host-scanner faces).
+    read_leg: str = "auto"
+
+    def __post_init__(self):
+        if self.target_row_group_rows < 1:
+            raise ValueError(
+                f"target_row_group_rows must be >= 1, got "
+                f"{self.target_row_group_rows}"
+            )
+        if self.target_file_rows is not None and \
+                self.target_file_rows < self.target_row_group_rows:
+            raise ValueError(
+                "target_file_rows must be >= target_row_group_rows"
+            )
+        if self.read_leg not in ("auto", "host", "tpu"):
+            raise ValueError(f"bad read_leg {self.read_leg!r}")
+        if self.read_leg == "tpu" and (
+            self.salvage or self.unit_order is not None
+        ):
+            raise ValueError(
+                "read_leg='tpu' does not compose with salvage or "
+                "unit_order (both are host-scanner faces); use "
+                "read_leg='auto' or 'host'"
+            )
+
+
+@dataclass
+class CompactReport:
+    """What one compaction run read, dropped, and wrote."""
+
+    paths: List[str] = field(default_factory=list)
+    rows_in: int = 0
+    rows_out: int = 0
+    rows_dropped: int = 0           # geometry-damaged units (salvage)
+    units_in: int = 0
+    units_dropped: int = 0
+    groups_out: int = 0
+    group_rows: List[int] = field(default_factory=list)
+    wall_seconds: float = 0.0
+    salvage: Optional[SalvageReport] = None
+
+    @property
+    def rows_per_sec(self) -> float:
+        return self.rows_in / self.wall_seconds if self.wall_seconds else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "paths": list(self.paths),
+            "rows_in": self.rows_in,
+            "rows_out": self.rows_out,
+            "rows_dropped": self.rows_dropped,
+            "units_in": self.units_in,
+            "units_dropped": self.units_dropped,
+            "groups_out": self.groups_out,
+            "group_rows": list(self.group_rows),
+            "wall_seconds": round(self.wall_seconds, 6),
+            "rows_per_sec": round(self.rows_per_sec, 1),
+        }
+
+
+class _ColumnBuffer:
+    """Carry buffer of one flat column across unit boundaries: decoded
+    chunks append; :meth:`cut` slices exactly ``k`` rows off the front
+    (re-slicing across chunk boundaries, the batcher's carry shape)."""
+
+    __slots__ = ("desc", "values", "defs", "rows")
+
+    def __init__(self, desc):
+        self.desc = desc
+        self.values: list = []   # per-chunk values (non-null only)
+        self.defs: list = []     # per-chunk def_levels (or None)
+        self.rows = 0
+
+    def append(self, values, def_levels) -> None:
+        n = (
+            len(def_levels) if def_levels is not None else len(values)
+        )
+        self.values.append(values)
+        self.defs.append(def_levels)
+        self.rows += n
+
+    def _merged(self):
+        """Collapse the chunk lists into one (values, defs) pair."""
+        if len(self.values) > 1:
+            if isinstance(self.values[0], ByteArrayColumn):
+                values = ByteArrayColumn.concat(self.values)
+            else:
+                values = np.concatenate(self.values)
+            if self.desc.max_definition_level > 0:
+                defs = np.concatenate([
+                    d if d is not None else np.full(
+                        checked_alloc_size(len(v), "compactor carry"),
+                        self.desc.max_definition_level,
+                        dtype=np.uint32,
+                    )
+                    for d, v in zip(self.defs, self.values)
+                ])
+            else:
+                defs = None
+            self.values = [values]
+            self.defs = [defs]
+        return (
+            (self.values[0], self.defs[0]) if self.values else (None, None)
+        )
+
+    def cut(self, k: int) -> ColumnData:
+        """Remove and return the first ``k`` rows as ColumnData."""
+        values, defs = self._merged()
+        md = self.desc.max_definition_level
+        if defs is not None:
+            head_defs, tail_defs = defs[:k], defs[k:]
+            vk = int(np.count_nonzero(head_defs == md))
+            head_vals = self._slice_values(values, 0, vk)
+            self.values = [self._slice_values(values, vk, None)]
+            self.defs = [tail_defs]
+            self.rows -= k
+            return ColumnData(self.desc, head_vals, def_levels=head_defs)
+        head = self._slice_values(values, 0, k)
+        self.values = [self._slice_values(values, k, None)]
+        self.defs = [None]
+        self.rows -= k
+        return ColumnData(
+            self.desc, head,
+            def_levels=(
+                np.full(
+                    checked_alloc_size(k, "compactor group rows"),
+                    md, dtype=np.uint32,
+                ) if md > 0 else None
+            ),
+        )
+
+    @staticmethod
+    def _slice_values(values, lo, hi):
+        if isinstance(values, ByteArrayColumn):
+            n = len(values)
+            hi = n if hi is None else min(hi, n)
+            off = values.offsets
+            return ByteArrayColumn(
+                off[lo : hi + 1] - off[lo],
+                values.data[off[lo] : off[hi]],
+            )
+        return values[lo:hi]
+
+
+def _host_column(bc):
+    """Device ``BatchColumn`` → host ``ColumnBatch`` (non-null values +
+    def levels — the carry buffer's input shape).  Strings re-pool from
+    the device's padded-row layout with one vectorized ragged gather;
+    bit-form DOUBLE views back to float64."""
+    from ..batch.columns import ColumnBatch
+
+    desc = bc.descriptor
+    md = desc.max_definition_level
+    mask = np.asarray(bc.mask) if bc.mask is not None else None
+    if bc.is_strings:
+        rows = np.asarray(bc.values)
+        lens = np.asarray(bc.lengths).astype(np.int64)
+        n = len(lens)
+        ml = rows.shape[1] if rows.ndim == 2 else 0
+        keep = np.flatnonzero(~mask) if mask is not None else np.arange(n)
+        lens_k = lens[keep]
+        offsets = np.zeros(len(keep) + 1, np.int64)
+        np.cumsum(lens_k, out=offsets[1:])
+        total = int(offsets[-1])
+        if total:
+            flat = rows.reshape(-1)
+            src = np.repeat(keep * ml - offsets[:-1], lens_k) + \
+                np.arange(total)
+            pool = flat[src]
+        else:
+            pool = np.zeros(0, np.uint8)
+        values = ByteArrayColumn(offsets, pool)
+    else:
+        vals = np.asarray(bc.values)
+        if bc.f64_bits and vals.dtype == np.int64:
+            vals = vals.view(np.float64)
+        n = len(vals)
+        values = vals if mask is None else vals[~mask]
+    def_levels = None
+    if mask is not None:
+        def_levels = np.where(mask, md - 1, md).astype(np.uint32)
+    return ColumnBatch(desc, n, values, def_levels=def_levels)
+
+
+def _sort_group(columns: List[ColumnData], sort_by: Sequence[str]):
+    """Stable multi-key within-group row sort, nulls last per key."""
+    by_name = {cd.descriptor.path[0]: cd for cd in columns}
+    n = columns[0].num_values
+    order = np.arange(n)
+    for name in reversed(list(sort_by)):
+        cd = by_name.get(name)
+        if cd is None:
+            raise ValueError(f"sort_by: no column named {name!r}")
+        md = cd.descriptor.max_definition_level
+        nn = checked_alloc_size(n, "sort group rows")
+        if cd.def_levels is not None:
+            null = cd.def_levels != md
+            vidx = np.cumsum(~null) - 1
+        else:
+            null = np.zeros(nn, dtype=bool)
+            vidx = np.arange(n)
+        values = cd.values
+        if isinstance(values, ByteArrayColumn):
+            dense = np.empty(nn, dtype=object)
+            data, off = values.data.tobytes(), values.offsets
+            for i in np.flatnonzero(~null):
+                j = vidx[i]
+                dense[i] = data[off[j] : off[j + 1]]
+            for i in np.flatnonzero(null):
+                dense[i] = b""
+        else:
+            dense = np.zeros(nn, dtype=np.asarray(values).dtype)
+            dense[~null] = np.asarray(values)[vidx[~null]]
+        order = order[np.argsort(dense[order], kind="stable")]
+        order = order[np.argsort(null[order], kind="stable")]
+    return _apply_order(columns, order)
+
+
+def _apply_order(columns: List[ColumnData], order: np.ndarray):
+    from ..batch.columns import take_rows
+
+    out = []
+    for cd in columns:
+        values, new_defs = take_rows(
+            cd.values, cd.def_levels,
+            cd.descriptor.max_definition_level, order,
+        )
+        out.append(ColumnData(cd.descriptor, values, def_levels=new_defs))
+    return out
+
+
+class DatasetCompactor:
+    """Stream ``sources`` through the scan scheduler and re-write them
+    into ``dest`` (a directory — output files are
+    ``part-{i:05d}.parquet`` — or a callable ``index -> dest``).  See
+    the module docstring for the full contract; :meth:`run` executes
+    one compaction and returns a :class:`CompactReport`."""
+
+    def __init__(self, sources: Sequence, dest,
+                 options: Optional[CompactOptions] = None):
+        self.sources = list(sources)
+        self.dest = dest
+        self.options = options or CompactOptions()
+
+    # -- planning ------------------------------------------------------------
+
+    def _plan(self):
+        """Open every footer once: (metadata list, units, EpochPlan,
+        first file's schema).  The plan's row prefix sums fix the
+        output boundaries before any data byte is read.  Sources must
+        be paths or zero-arg factories (the planning pass and the scan
+        each need their own open — a shared live Source object cannot
+        be closed twice)."""
+        metas = []
+        units: List[Unit] = []
+        schema = None
+        for fi, src in enumerate(self.sources):
+            if hasattr(src, "read_at"):
+                raise ValueError(
+                    "DatasetCompactor sources must be paths or zero-arg "
+                    "source factories (an open Source cannot serve both "
+                    "the planning pass and the scan)"
+                )
+            if callable(src):
+                reader = ParquetFileReader(src())
+            else:
+                reader = ParquetFileReader(FileSource(src))
+            try:
+                metas.append(reader.metadata)
+                if schema is None:
+                    schema = reader.schema
+                for gi, rg in enumerate(reader.row_groups):
+                    units.append(Unit(fi, gi, int(rg.num_rows or 0)))
+            finally:
+                reader.close()
+        if self.options.unit_order is not None:
+            by_key = {(u.file_index, u.group_index): u for u in units}
+            ordered = []
+            for fi, gi in self.options.unit_order:
+                u = by_key.pop((int(fi), int(gi)), None)
+                if u is None:
+                    raise ValueError(
+                        f"unit_order names unknown or duplicate unit "
+                        f"({fi}, {gi})"
+                    )
+                ordered.append(u)
+            units = ordered
+        plan = EpochPlan(units, seed=None, epoch=0)
+        return metas, units, plan, schema
+
+    def _dest_path(self, index: int) -> str:
+        if callable(self.dest):
+            return self.dest(index)
+        os.makedirs(self.dest, exist_ok=True)
+        return os.path.join(self.dest, f"part-{index:05d}.parquet")
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self) -> CompactReport:
+        opt = self.options
+        t0 = time.perf_counter()
+        metas, units, plan, schema = self._plan()
+        report = CompactReport()
+        if not units:
+            report.wall_seconds = time.perf_counter() - t0
+            return report
+
+        reader_opts = self._reader_options()
+        sel = set(opt.columns) if opt.columns else None
+        out_schema = MessageType(schema.name, [
+            f for f in schema.fields if sel is None or f.name in sel
+        ])
+        for desc in out_schema.columns:
+            if desc.max_repetition_level > 0:
+                raise UnsupportedFeatureError(
+                    "DatasetCompactor re-shards flat columns only "
+                    f"(repeated column {'.'.join(desc.path)})"
+                )
+        leg = self._resolve_leg(opt, out_schema)
+        scanner = None
+        if leg == "host":
+            scanner = DatasetScanner(
+                self.sources,
+                columns=list(opt.columns) if opt.columns else None,
+                options=reader_opts,
+                scan=opt.scan,
+                order=[(u.file_index, u.group_index) for u in units],
+                metadata=metas,
+            )
+            stream = iter(scanner)
+        else:
+            stream = self._device_units(opt, reader_opts)
+        wopts = opt.writer or WriterOptions(engine="auto")
+        if opt.sort_by:
+            from dataclasses import replace as _rep
+
+            wopts = _rep(
+                wopts,
+                sorting_columns=[
+                    (name, False, False) for name in opt.sort_by
+                ],
+            )
+        G = opt.target_row_group_rows
+        F = opt.target_file_rows
+        buffers = [_ColumnBuffer(d) for d in out_schema.columns]
+        trace.decision("compact.plan", {
+            "units": len(units),
+            "rows": plan.total_rows,
+            "target_group_rows": G,
+            "target_file_rows": F,
+            "sort_by": list(opt.sort_by) if opt.sort_by else None,
+            "read_leg": leg,
+        })
+
+        # The write leg runs on its OWN thread behind a bounded queue,
+        # so the read leg's decode overlaps the re-encode — compaction
+        # wall approaches max(read, write) instead of their sum.  One
+        # writer thread keeps emission strictly ordered; the queue bound
+        # is the carry-memory backpressure.
+        import queue as _queue
+
+        work_q: _queue.Queue = _queue.Queue(maxsize=4)
+        werr: list = []  # writer-thread error, raised after join
+        tracer = trace.current()
+
+        def writer_loop():
+            # the loop consumes until the SENTINEL no matter what: an
+            # error is recorded and later items drain, so the producer's
+            # bounded put() can never block against a dead consumer (a
+            # write failure must surface as a raise, not a hang)
+            writer = None
+            file_idx = 0
+            file_rows = 0
+            while True:
+                item = work_q.get()
+                if item is None:
+                    break
+                if werr:
+                    continue  # drain: the error already recorded
+                k, columns = item
+                try:
+                    if writer is None or (
+                        F is not None and file_rows >= F
+                    ):
+                        if writer is not None:
+                            writer.close()
+                            writer = None
+                        path = self._dest_path(file_idx)
+                        report.paths.append(path)
+                        writer = resolve_writer(path, out_schema, wopts)
+                        file_idx += 1
+                        file_rows = 0
+                    if opt.sort_by:
+                        columns = _sort_group(columns, opt.sort_by)
+                    writer.write_row_group(columns)
+                except BaseException as e:  # noqa: BLE001 - raised after join
+                    werr.append(e)
+                    if writer is not None:
+                        writer.abort()
+                        writer = None
+                    continue
+                file_rows += k
+                report.rows_out += k
+                report.groups_out += 1
+                report.group_rows.append(k)
+                trace.count("compact.groups_out")
+            try:
+                if not werr and writer is not None:
+                    writer.close()
+                    writer = None
+            except BaseException as e:  # noqa: BLE001 - raised after join
+                werr.append(e)
+            finally:
+                if writer is not None:
+                    writer.abort()
+
+        import threading
+
+        wthread = threading.Thread(
+            target=tracer.run, args=(writer_loop,),
+            name="pftpu-compact-write",
+        )
+        wthread.start()
+
+        def flush_group(k: int):
+            columns = [b.cut(k) for b in buffers]
+            work_q.put((k, columns))
+            if werr:
+                # raise WITHOUT clearing the flag: writer_loop must keep
+                # seeing the error so already-queued groups drain instead
+                # of being written into a fresh, wrong-looking part file
+                raise werr[0]
+
+        try:
+            for unit in stream:
+                report.units_in += 1
+                trace.count("compact.units_in")
+                batch = unit.batch
+                n = batch.num_rows
+                report.rows_in += n
+                trace.count("compact.rows_in", n)
+                if opt.salvage and self._unit_damaged(unit, out_schema):
+                    report.units_dropped += 1
+                    report.rows_dropped += n
+                    trace.count("compact.rows_dropped", n)
+                    trace.decision("compact.unit_dropped", {
+                        "file": unit.file_index,
+                        "row_group": unit.group_index,
+                        "rows": n,
+                    })
+                    continue
+                by_name = {
+                    cb.descriptor.path: cb for cb in batch.columns
+                }
+                for buf in buffers:
+                    cb = by_name.get(buf.desc.path)
+                    if cb is None:
+                        raise ValueError(
+                            f"unit (file {unit.file_index}, group "
+                            f"{unit.group_index}) missing column "
+                            f"{'.'.join(buf.desc.path)}"
+                        )
+                    buf.append(cb.values, cb.def_levels)
+                while buffers[0].rows >= G:
+                    flush_group(G)
+            if buffers[0].rows:
+                flush_group(buffers[0].rows)
+        except BaseException:
+            werr.insert(0, None)  # poison: writer drains + aborts
+            raise
+        finally:
+            work_q.put(None)
+            wthread.join()
+            # quiesce whichever read leg drove the run: closing the
+            # device generator joins the engine pipeline; closing the
+            # scanner drains its worker pool and file handles
+            if scanner is not None:
+                scanner.close()
+            else:
+                stream.close()
+        if werr and werr[0] is not None:
+            raise werr[0]
+        report.salvage = (
+            scanner.salvage_report if scanner is not None else None
+        )
+        report.wall_seconds = time.perf_counter() - t0
+        return report
+
+    def _resolve_leg(self, opt: CompactOptions, out_schema) -> str:
+        if opt.read_leg != "auto" and any(
+            c.max_definition_level > 1 for c in out_schema.columns
+        ) and opt.read_leg == "tpu":
+            raise UnsupportedFeatureError(
+                "read_leg='tpu' cannot compact multi-level optional "
+                "columns (the device face ships a row null-mask, not "
+                "the full definition levels); use read_leg='host'"
+            )
+        if opt.read_leg != "auto":
+            return opt.read_leg
+        if opt.salvage or opt.unit_order is not None:
+            return "host"
+        if any(
+            c.max_definition_level > 1 for c in out_schema.columns
+        ):
+            # nested-optional structure (outer null vs inner null) only
+            # survives through real definition levels — the host leg's
+            # shape; the device face ships a single row null-mask
+            return "host"
+        try:
+            import jax
+
+            jax.devices()
+            if not jax.config.jax_enable_x64:
+                raise RuntimeError("x64 disabled")
+            return "tpu"
+        except Exception:
+            return "host"
+
+    def _device_units(self, opt: CompactOptions, reader_opts):
+        """The device read leg: stream the corpus through
+        ``scan_device_groups`` (decode at device-scan speed) and convert
+        each delivered group to the carry buffer's host shape."""
+        from ..api.reader import _device_batch_columns
+        from ..batch.columns import RowGroupBatch
+        from ..scan.executor import ScanUnit, scan_device_groups
+
+        for fi, gi, cols in scan_device_groups(
+            self.sources,
+            columns=list(opt.columns) if opt.columns else None,
+            options=reader_opts,
+            scan=opt.scan,
+            float64_policy="float64",
+        ):
+            columns = [
+                _host_column(bc)
+                for bc in _device_batch_columns(list(cols.values()))
+            ]
+            n = columns[0].num_values if columns else 0
+            yield ScanUnit(fi, gi, RowGroupBatch(
+                columns=columns, num_rows=n,
+            ))
+
+    # -- helpers -------------------------------------------------------------
+
+    def _reader_options(self):
+        from dataclasses import replace as _rep
+
+        from ..api.reader import ReaderOptions
+
+        base = self.options.reader
+        if base is None:
+            return ReaderOptions(salvage=True) if self.options.salvage \
+                else None
+        return _rep(base, salvage=base.salvage or self.options.salvage)
+
+    @staticmethod
+    def _unit_damaged(unit, out_schema) -> bool:
+        """True when this unit's salvage report shows GEOMETRY damage —
+        row-mask/chunk tiers, whose surviving columns cannot be
+        re-written under the output schema (page-null tiers flow
+        through as ordinary nulls)."""
+        rep = unit.salvage
+        if rep is None:
+            return False
+        if rep.geometry_damaged(unit.group_index):
+            return True
+        return any(
+            rep.chunk_quarantined(unit.group_index, d.path[0])
+            for d in out_schema.columns
+        )

@@ -1,0 +1,605 @@
+"""Device encode engine + pipelined file writer (docs/write.md).
+
+The decode side stages compressed bytes to the device and fuses a whole
+row group's decode into one launch; this module is its mirror image.
+Per row group:
+
+1. **analyze launch** (``tpu.encode_kernels``): dictionary build for
+   every dict-candidate numeric column, DELTA offset preparation, and
+   BYTE_STREAM_SPLIT transposition — one fused executable through the
+   persistent exec cache.
+2. The host reads the launch's tiny scalars (distinct counts, max
+   offsets), applies the SAME dictionary acceptance rule as the host
+   encoder (``dictionary_max_fraction`` / ``dictionary_max_bytes``),
+   and picks static pack widths.
+3. **pack launch**: every accepted index/offset stream bit-packs in a
+   second fused executable.
+4. Host page assembly: hybrid run headers, delta block headers, page
+   statistics, levels, page headers, CRCs — all through the ONE
+   pagination path in ``format/file_write.py``
+   (:class:`~parquet_floor_tpu.format.file_write.PrecomputedPages`), so
+   a device-encoded chunk is metadata-identical in kind to a
+   host-encoded one.
+5. Compression runs on a thread pool BEHIND the device encode of the
+   next group (the inverse of the measured decode boundary in
+   docs/DESIGN_DECOMPRESSION.md), and :class:`DeviceFileWriter` emits
+   finished groups to the sink strictly in order.
+
+Routing is per COLUMN: flat INT32/INT64/FLOAT/DOUBLE columns ride the
+device; strings, booleans, fixed-width, repeated columns, empty chunks,
+and data-dependent fallbacks (dictionary rejected, delta offsets wider
+than 32 bits) encode on host inside the same pool — one writer, mixed
+chunks, identical file shape either way.
+
+Like the decode engine, the device path requires ``jax_enable_x64``
+(INT64/DOUBLE encode exactness).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..errors import checked_alloc_size
+from ..format.encodings.delta import _write_varint, _write_zigzag
+from ..format.encodings.dictionary import encode_dict_indices
+from ..format.file_write import (
+    ColumnData,
+    ParquetFileWriter,
+    PrecomputedPages,
+    WriterOptions,
+    _ColumnChunkWriter,
+    _NUMPY_DTYPE,
+)
+from ..format.parquet_thrift import Encoding, Type
+from ..utils import trace
+
+#: device page boundaries align to the DELTA block geometry (128) so
+#: every page's packed payload is a byte-aligned slice of the fused
+#: contiguous stream (module docstring of tpu/encode_kernels.py)
+_PAGE_ALIGN = 128
+
+_VIEW_DTYPE = {
+    Type.INT32: np.dtype("<u4"),
+    Type.INT64: np.dtype("<u8"),
+    Type.FLOAT: np.dtype("<u4"),
+    Type.DOUBLE: np.dtype("<u8"),
+}
+
+
+def _varint_bytes(n: int) -> bytes:
+    out = bytearray()
+    _write_varint(out, n)
+    return bytes(out)
+
+
+def _zigzag_bytes(n: int) -> bytes:
+    out = bytearray()
+    _write_zigzag(out, int(n))
+    return bytes(out)
+
+
+class _ColRoute:
+    """Per-column device-encode plan for one row group."""
+
+    __slots__ = ("kind", "positions", "per_page", "present", "vlo",
+                 "spec", "view", "width", "dictionary", "encoding",
+                 "min_delta", "packed", "full", "tail")
+
+    def __init__(self, kind: str):
+        self.kind = kind          # dict | delta | bss | host
+        self.positions = None     # page boundaries (level positions)
+        self.per_page = 0
+        self.present = None       # per-page non-null counts
+        self.vlo = None           # per-page starting value index
+        self.spec = None          # EncSpec of the analyze launch
+        self.view = None          # unsigned bit view of the values
+        self.width = 0            # chosen pack width
+        self.dictionary = None    # host dictionary values (dict path)
+        self.encoding = Encoding.PLAIN
+        self.min_delta = 0        # delta: signed global min
+        self.packed = b""         # fused pack launch output bytes
+        self.full = b""           # bss: full-page transposed bytes
+        self.tail = b""           # bss: partial tail page bytes
+
+
+class EncodeEngine:
+    """Fused device encode of row groups for one schema/options pair.
+
+    :meth:`device_precompute` returns one
+    :class:`~parquet_floor_tpu.format.file_write.PrecomputedPages` (or
+    None = host fallback) per column; callers hand them to
+    ``_ColumnChunkWriter.prepare`` — typically on a worker pool, which
+    is exactly what :class:`DeviceFileWriter` does."""
+
+    def __init__(self, schema, options: WriterOptions, device=None):
+        from ..tpu.engine import _require_x64
+
+        _require_x64()
+        self.schema = schema
+        self.options = options
+        self.device = device
+
+    # -- routing -------------------------------------------------------------
+
+    def _dict_enabled(self, desc) -> bool:
+        opt = self.options
+        enable = opt.enable_dictionary
+        if opt.column_dictionary is not None:
+            enable = opt.column_dictionary.get(desc.path[0], enable)
+        if opt.column_encodings and desc.path[0] in opt.column_encodings:
+            enable = False
+        return enable
+
+    def _page_positions(self, cd: ColumnData) -> Tuple[int, list]:
+        """Aligned page boundaries for a flat device column: the host
+        per-page target rounded DOWN to the 128-value grid (never below
+        128) so dict/delta payload slices stay byte-aligned."""
+        per = max(1, self.options.data_page_values)
+        if self.options.data_page_bytes:
+            # byte-bound composition, numeric flat columns only: the
+            # host estimate simplifies to itemsize per slot
+            isz = _NUMPY_DTYPE[cd.descriptor.physical_type].itemsize
+            per = max(
+                1, min(per, int(self.options.data_page_bytes / isz))
+            )
+        per = max(_PAGE_ALIGN, per - (per % _PAGE_ALIGN))
+        n = cd.num_values
+        positions = [
+            (i, min(i + per, n)) for i in range(0, n, per)
+        ] or [(0, 0)]
+        return per, positions
+
+    def _route(self, cd: ColumnData) -> _ColRoute:
+        from ..tpu.encode_kernels import EncSpec
+
+        desc = cd.descriptor
+        opt = self.options
+        pt = desc.physical_type
+        values = cd.values
+        if (
+            desc.max_repetition_level > 0
+            or pt not in _VIEW_DTYPE
+            or len(values) == 0
+        ):
+            return _ColRoute("host")
+        optional = cd.def_levels is not None
+        view = np.ascontiguousarray(
+            np.asarray(values, dtype=_NUMPY_DTYPE[pt])
+        ).view(_VIEW_DTYPE[pt])
+        n = len(view)
+        dtype = str(view.dtype)
+        route = None
+        if self._dict_enabled(desc):
+            route = _ColRoute("dict")
+            route.spec = EncSpec("dict", dtype, n)
+            route.encoding = Encoding.RLE_DICTIONARY
+        else:
+            enc = _ColumnChunkWriter(opt, desc)._choose_value_encoding(
+                values
+            )
+            if enc == Encoding.DELTA_BINARY_PACKED and not optional:
+                route = _ColRoute("delta")
+                route.spec = EncSpec("delta", dtype, n)
+                route.encoding = enc
+            elif enc == Encoding.BYTE_STREAM_SPLIT and not optional:
+                route = _ColRoute("bss")
+                route.encoding = enc
+            else:
+                # PLAIN is an identity copy (no device leverage) and
+                # optional delta/bss pages have data-dependent value
+                # counts — the host pagination handles both
+                return _ColRoute("host")
+        route.view = view
+        per, positions = self._page_positions(cd)
+        route.per_page, route.positions = per, positions
+        if cd.def_levels is not None:
+            dl = np.asarray(cd.def_levels)
+            md = desc.max_definition_level
+            route.present = [
+                int(np.count_nonzero(dl[lo:hi] == md))
+                for lo, hi in positions
+            ]
+        else:
+            route.present = [hi - lo for lo, hi in positions]
+        route.vlo = np.concatenate(
+            [[0], np.cumsum(route.present[:-1])]
+        ).astype(np.int64) if len(route.present) > 1 else np.zeros(
+            1, np.int64
+        )
+        if route.kind == "bss":
+            route.spec = EncSpec("bss", dtype, n, page_rows=per)
+        return route
+
+    # -- the fused launches --------------------------------------------------
+
+    def device_precompute(
+        self, columns: Sequence[ColumnData]
+    ) -> List[Optional[PrecomputedPages]]:
+        from ..tpu import encode_kernels as ek
+
+        routes = [self._route(cd) for cd in columns]
+        dev = [
+            (r, cd) for r, cd in zip(routes, columns) if r.kind != "host"
+        ]
+        if not dev:
+            trace.count("write.host_columns", len(routes))
+            return [None] * len(routes)
+        program = tuple(r.spec for r, _ in dev)
+        arrays = [r.view for r, _ in dev]
+        outs = ek.run_analyze(program, arrays, device=self.device)
+
+        # walk the flat outputs; fetch scalars (blocks on the launch)
+        oi = 0
+        pack_specs: list = []
+        pack_arrays: list = []
+        pack_routes: list = []
+        bss_fetch: list = []  # (route, full, tail) device arrays
+        for r, cd in dev:
+            if r.kind == "dict":
+                indices, count, uniq_pos = outs[oi : oi + 3]
+                oi += 3
+                n_leaf = len(r.view)
+                cnt = int(count)
+                isz = r.view.dtype.itemsize
+                opt = self.options
+                if cnt > max(
+                    1, int(n_leaf * opt.dictionary_max_fraction)
+                ) or cnt * isz > opt.dictionary_max_bytes:
+                    trace.decision("write.engine", {
+                        "action": "dict_reject",
+                        "column": cd.descriptor.path[0],
+                        "distinct": cnt,
+                    })
+                    r.kind = "host"
+                    continue
+                upos = np.asarray(uniq_pos)[:cnt]
+                r.dictionary = np.asarray(
+                    cd.values, dtype=_NUMPY_DTYPE[
+                        cd.descriptor.physical_type
+                    ]
+                )[upos]
+                r.width = ek.pack_width_for(
+                    max((cnt - 1).bit_length(), 1)
+                )
+                pack_specs.append(ek.EncSpec(
+                    "pack", "uint32", n_leaf, width=r.width
+                ))
+                pack_arrays.append(indices)
+                pack_routes.append(r)
+            elif r.kind == "delta":
+                offs, min_d, max_off = outs[oi : oi + 3]
+                oi += 3
+                w_min = int(max_off).bit_length()
+                if w_min > 32:
+                    trace.decision("write.engine", {
+                        "action": "delta_wide",
+                        "column": cd.descriptor.path[0],
+                        "width": w_min,
+                    })
+                    r.kind = "host"
+                    continue
+                r.width = ek.pack_width_for(w_min)
+                r.min_delta = int(min_d)
+                if r.width:
+                    pack_specs.append(ek.EncSpec(
+                        "pack", "uint32", max(len(r.view) - 1, 0),
+                        width=r.width,
+                    ))
+                    pack_arrays.append(offs)
+                    pack_routes.append(r)
+            else:  # bss
+                bss_fetch.append((r,) + tuple(outs[oi : oi + 2]))
+                oi += 2
+
+        if pack_specs:
+            packed = ek.run_pack(
+                tuple(pack_specs), pack_arrays, device=self.device
+            )
+            for r, arr in zip(pack_routes, packed):
+                r.packed = np.asarray(arr).tobytes()
+        for r, full, tail in bss_fetch:
+            r.full = np.asarray(full).tobytes()
+            r.tail = np.asarray(tail).tobytes()
+
+        out: List[Optional[PrecomputedPages]] = []
+        n_dev = 0
+        for r, cd in zip(routes, columns):
+            if r.kind == "host":
+                out.append(None)
+                continue
+            n_dev += 1
+            out.append(self._assemble(r, cd))
+        trace.count("write.device_columns", n_dev)
+        trace.count("write.host_columns", len(routes) - n_dev)
+        return out
+
+    # -- host page assembly --------------------------------------------------
+
+    def _assemble(self, r: _ColRoute, cd: ColumnData) -> PrecomputedPages:
+        if r.kind == "dict":
+            payloads = self._dict_payloads(r)
+        elif r.kind == "delta":
+            payloads = self._delta_payloads(r, cd)
+        else:
+            payloads = self._bss_payloads(r)
+        return PrecomputedPages(
+            value_encoding=r.encoding,
+            positions=r.positions,
+            page_payloads=payloads,
+            dictionary=r.dictionary,
+        )
+
+    def _dict_payloads(self, r: _ColRoute) -> List[bytes]:
+        """Per-page RLE_DICTIONARY streams: width byte + one bit-packed
+        run sliced out of the fused contiguous pack.  Aligned (required
+        columns) pages slice bytes zero-copy; ragged (optional) pages
+        realign through one C-level unpack/pack."""
+        w = r.width
+        payloads = []
+        aligned = all(v * w % 8 == 0 for v in r.vlo)
+        bits = None
+        for pi in range(len(r.positions)):
+            present = r.present[pi]
+            if present == 0:
+                payloads.append(
+                    encode_dict_indices(
+                        np.zeros(0, np.uint32), max(1 << w, 2)
+                    )
+                )
+                continue
+            vlo = int(r.vlo[pi])
+            groups8 = -(-present // 8)
+            head = bytes([w]) + _varint_bytes((groups8 << 1) | 1)
+            nbytes = groups8 * w
+            if aligned:
+                start = vlo * w // 8
+                body = r.packed[start : start + nbytes]
+                if len(body) < nbytes:
+                    body = body + b"\x00" * (nbytes - len(body))
+            else:
+                if bits is None:
+                    bits = np.unpackbits(
+                        np.frombuffer(r.packed, np.uint8),
+                        bitorder="little",
+                    )
+                sel = bits[vlo * w : (vlo + present) * w]
+                pad = nbytes * 8 - len(sel)
+                if pad:
+                    sel = np.concatenate([
+                        sel,
+                        np.zeros(
+                            checked_alloc_size(pad, "dict page pad"),
+                            np.uint8,
+                        ),
+                    ])
+                body = np.packbits(sel, bitorder="little").tobytes()
+            payloads.append(head + body)
+        return payloads
+
+    def _delta_payloads(self, r: _ColRoute, cd: ColumnData) -> List[bytes]:
+        """Per-page DELTA_BINARY_PACKED streams: standard 128/4
+        geometry, one global ``min_delta`` re-declared per block, all
+        four miniblock widths equal to the fused pack width — each
+        block's payload is a byte-aligned 16*w-byte slice of the
+        contiguous device pack (page starts sit on the 128 grid)."""
+        w = r.width
+        values = np.asarray(cd.values)
+        mind = _zigzag_bytes(getattr(r, "min_delta", 0))
+        widths = bytes([w, w, w, w])
+        payloads = []
+        for pi, (lo, hi) in enumerate(r.positions):
+            page_n = hi - lo
+            out = bytearray()
+            _write_varint(out, 128)
+            _write_varint(out, 4)
+            _write_varint(out, page_n)
+            _write_zigzag(out, int(values[lo]) if page_n else 0)
+            n_deltas = max(page_n - 1, 0)
+            for b in range(-(-n_deltas // 128) if n_deltas else 0):
+                out += mind
+                out += widths
+                if w:
+                    start = (lo + b * 128) * w // 8
+                    blk = r.packed[start : start + 16 * w]
+                    if len(blk) < 16 * w:
+                        blk = blk + b"\x00" * (16 * w - len(blk))
+                    out += blk
+            payloads.append(bytes(out))
+        return payloads
+
+    def _bss_payloads(self, r: _ColRoute) -> List[bytes]:
+        isz = r.view.dtype.itemsize
+        per = r.per_page
+        payloads = []
+        k_full = len(r.view) // per
+        for pi, (lo, hi) in enumerate(r.positions):
+            if pi < k_full:
+                payloads.append(
+                    r.full[pi * per * isz : (pi + 1) * per * isz]
+                )
+            else:
+                payloads.append(r.tail)
+        return payloads
+
+
+class DeviceFileWriter(ParquetFileWriter):
+    """:class:`ParquetFileWriter` with the fused device encode engine
+    and the encode ‖ compress ‖ write pipeline (module docstring).
+
+    ``write_row_group`` runs the group's device launches synchronously
+    (they are the cheap part and keep the device busy), hands every
+    column's pagination + compression to the pool, and emits FINISHED
+    groups to the sink strictly in submission order — at most
+    ``WriterOptions.write_pipeline_depth`` groups ride in flight, so
+    memory stays bounded while group *k*'s compression overlaps group
+    *k+1*'s encode."""
+
+    def __init__(self, dest, schema, options: Optional[WriterOptions] = None,
+                 key_value_metadata: Optional[Dict[str, str]] = None,
+                 device=None, use_device: bool = True):
+        """``use_device=False`` keeps the full pipeline (pooled
+        per-column prepare + ordered emit) but skips the fused launches
+        — every column host-encodes on the pool.  That is the
+        ``engine="pipelined"`` writer: the parallel host encoder for
+        environments without a usable jax backend (and the fair host
+        comparator for the write bench)."""
+        if options is None:
+            options = WriterOptions(engine="tpu")
+        super().__init__(dest, schema, options, key_value_metadata)
+        try:
+            # the engine check can raise (no jax backend / x64 off) —
+            # the sink the base ctor just opened must not leak (the
+            # same ctor-guard contract ParquetFileWriter itself holds)
+            self._engine = (
+                EncodeEngine(schema, self.options, device=device)
+                if use_device else None
+            )
+            self._tracer = trace.current()
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.options.compress_threads
+                or min(4, os.cpu_count() or 1),
+                thread_name_prefix="pftpu-write",
+            )
+        except BaseException:
+            self.sink.close()
+            raise
+        self._inflight: deque = deque()  # (futures, num_rows)
+        self._depth = max(1, self.options.write_pipeline_depth)
+
+    def write_row_group(self, columns: Sequence[ColumnData]) -> None:
+        if self._closed:
+            raise ValueError("writer is closed")
+        expected = self.schema.columns
+        if len(columns) != len(expected):
+            raise ValueError(
+                f"row group has {len(columns)} columns, schema has "
+                f"{len(expected)}"
+            )
+        num_rows = None
+        for cd, desc in zip(columns, expected):
+            if cd.descriptor.path != desc.path:
+                raise ValueError(
+                    f"column order mismatch: got {cd.descriptor.path}, "
+                    f"want {desc.path}"
+                )
+            rows = (
+                int(np.count_nonzero(np.asarray(cd.rep_levels) == 0))
+                if cd.rep_levels is not None
+                else cd.num_values
+            )
+            if num_rows is None:
+                num_rows = rows
+            elif rows != num_rows:
+                raise ValueError(
+                    f"column {desc.path}: {rows} rows != {num_rows}"
+                )
+        if self._engine is not None:
+            with trace.span("write.encode", attrs={
+                "row_group": len(self._row_groups) + len(self._inflight),
+                "rows": num_rows or 0,
+            }):
+                pres = self._engine.device_precompute(columns)
+        else:
+            pres = [None] * len(columns)
+            trace.count("write.host_columns", len(columns))
+        futs = [
+            self._pool.submit(
+                self._tracer.run,
+                _ColumnChunkWriter(self.options, desc).prepare, cd, pre,
+            )
+            for cd, desc, pre in zip(columns, expected, pres)
+        ]
+        self._inflight.append((futs, num_rows or 0))
+        trace.count("write.groups")
+        trace.count("write.rows", num_rows or 0)
+        trace.gauge_max("write.inflight_groups_max", len(self._inflight))
+        # opportunistic in-order drain, then enforce the depth bound
+        while self._inflight and all(
+            f.done() for f in self._inflight[0][0]
+        ):
+            self._emit_head()
+        while len(self._inflight) > self._depth:
+            self._emit_head()
+
+    def _emit_head(self) -> None:
+        futs, num_rows = self._inflight.popleft()
+        try:
+            prepared = [f.result() for f in futs]
+        except BaseException:
+            for f in futs:
+                f.cancel()
+            raise
+        with trace.span("write.emit", attrs={"rows": num_rows}):
+            pos0 = self.sink.pos
+            self.write_prepared_group(prepared, num_rows)
+            trace.count("write.bytes_written", self.sink.pos - pos0)
+
+    def close(self):
+        if self._closed:
+            return self._file_meta
+        try:
+            while self._inflight:
+                self._emit_head()
+        except BaseException:
+            self.abort()
+            raise
+        self._pool.shutdown(wait=True)
+        return super().close()
+
+    def abort(self) -> None:
+        for futs, _ in self._inflight:
+            for f in futs:
+                f.cancel()
+        self._inflight.clear()
+        self._pool.shutdown(wait=False)
+        super().abort()
+
+
+def resolve_writer(dest, schema, options: Optional[WriterOptions] = None,
+                   key_value_metadata: Optional[Dict[str, str]] = None,
+                   device=None) -> ParquetFileWriter:
+    """The ``WriterOptions.engine`` switch: "host" → the numpy
+    :class:`ParquetFileWriter`, "tpu" → :class:`DeviceFileWriter`
+    (raises without a usable x64 jax backend, mirroring
+    ``TpuRowGroupReader``), "pipelined" → the same pipeline with every
+    column host-encoded on the pool (no jax needed), "auto" → tpu when
+    the backend is up, host otherwise (``write.engine`` decision
+    records the pick)."""
+    opts = options or WriterOptions()
+    engine = opts.engine
+    if engine not in ("host", "tpu", "auto", "pipelined"):
+        raise ValueError(f"bad WriterOptions.engine {engine!r}")
+    if engine == "auto":
+        # the cost-model shape of the decode side's engine.auto: the
+        # fused encode launches win on a real accelerator, but on the
+        # CPU backend their per-launch fixed cost loses to the pooled
+        # host encoders — auto picks the faster pipeline either way
+        try:
+            import jax
+
+            dev = jax.devices()[0]
+            if not jax.config.jax_enable_x64:
+                raise RuntimeError("x64 disabled")
+            engine = "tpu" if dev.platform != "cpu" else "pipelined"
+            trace.decision("write.engine", {
+                "action": f"auto_{engine}", "platform": dev.platform,
+            })
+        except Exception as e:
+            trace.decision("write.engine", {
+                "action": "auto_host", "reason": str(e)[:120],
+            })
+            engine = "host"
+    if engine == "tpu":
+        return DeviceFileWriter(
+            dest, schema, opts, key_value_metadata, device=device
+        )
+    if engine == "pipelined":
+        return DeviceFileWriter(
+            dest, schema, opts, key_value_metadata, use_device=False
+        )
+    return ParquetFileWriter(dest, schema, opts, key_value_metadata)

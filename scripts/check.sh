@@ -24,9 +24,13 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" | tr -cd .
 [ "$rc" -eq 0 ] || exit "$rc"
 
 # Fast bench smoke: every leg of bench.py (headline decode, batch face,
-# chunked, multi-file scan, exec-cache cold/warm) runs at toy scale on
-# the CPU backend, so a broken decode path fails THIS gate instead of
-# only the nightly bench.  The numbers are health indicators, not perf
+# chunked, multi-file scan, exec-cache cold/warm, device write,
+# compaction) runs at toy scale on the CPU backend, so a broken decode
+# OR encode path fails THIS gate instead of only the nightly bench.
+# check_bench_report gates the write leg (device-encode rows/s >= 0.25x
+# the decode leg, value-exact read-back, the analyze+pack launch shape)
+# and the compact leg (>= 0.5x an interleaved scan comparator, output
+# group sizes exactly in the target band) — docs/write.md.  The numbers are health indicators, not perf
 # records.  Tracing is ON (PFTPU_TRACE=1) and the scan leg exports its
 # ScanReport + Chrome trace, which check_bench_report.py then validates
 # — a broken observability export fails the gate too

@@ -63,7 +63,91 @@ def check_report(bench_log: pathlib.Path) -> int:
         or check_launches(result.get("detail", {}))
         or check_loader_leg(result.get("detail", {}))
         or check_pushdown_leg(result.get("detail", {}))
+        or check_write_leg(result.get("detail", {}))
+        or check_compact_leg(result.get("detail", {}))
     )
+
+
+def check_write_leg(detail: dict) -> int:
+    """The device write path (docs/write.md): device-encode rows/s must
+    hold >= 0.25x the decode leg's scan rate, the read-back must be
+    value-exact, device columns must actually have ridden the fused
+    launches (exactly analyze+pack per row group), and every group must
+    have landed."""
+    for key in ("write_rows_per_sec", "write_vs_scan_x", "write_groups",
+                "write_launches", "write_device_columns", "write_exact"):
+        if key not in detail:
+            return fail(f"write leg missing {key}")
+    if not detail["write_exact"]:
+        return fail("write leg read-back is not value-exact")
+    if detail["write_vs_scan_x"] < 0.25:
+        return fail(
+            f"device-encode rows/s floor broken: write_vs_scan_x="
+            f"{detail['write_vs_scan_x']} < 0.25"
+        )
+    groups = detail["write_groups"]
+    if groups < 1:
+        return fail("write leg wrote no groups")
+    if detail["write_launches"] != 2 * groups:
+        return fail(
+            f"write launch shape broken: {detail['write_launches']} "
+            f"launches for {groups} groups (want analyze+pack = "
+            f"{2 * groups})"
+        )
+    if detail["write_device_columns"] < 1:
+        return fail("no column rode the device encode path")
+    print(
+        "check_bench_report: write leg ok "
+        f"({detail['write_rows_per_sec']} rows/s, "
+        f"{detail['write_vs_scan_x']}x scan, "
+        f"{detail['write_device_columns']} device columns)"
+    )
+    return 0
+
+
+def check_compact_leg(detail: dict) -> int:
+    """The compaction service (docs/write.md): compaction must run at
+    >= 0.5x the interleaved device-scan comparator over the same
+    corpus, preserve every row value-exactly, and land output row
+    groups exactly in the target band (== target, except each file's
+    last group)."""
+    for key in ("compact_vs_scan_x", "compact_rows_per_sec",
+                "compact_group_rows", "compact_target_group_rows",
+                "compact_files_out", "compact_exact"):
+        if key not in detail:
+            return fail(f"compact leg missing {key}")
+    if not detail["compact_exact"]:
+        return fail("compacted output is not value-exact vs its input")
+    if detail["compact_vs_scan_x"] < 0.5:
+        return fail(
+            f"compaction speed floor broken: compact_vs_scan_x="
+            f"{detail['compact_vs_scan_x']} < 0.5"
+        )
+    target = detail["compact_target_group_rows"]
+    sizes = detail["compact_group_rows"]
+    if not sizes:
+        return fail("compact leg wrote no groups")
+    # with one output file, every group but the last must be EXACTLY
+    # the target; the last may be a short tail
+    files = detail["compact_files_out"]
+    if files == 1:
+        bad = [s for s in sizes[:-1] if s != target]
+        if bad or not 0 < sizes[-1] <= target:
+            return fail(
+                f"output group sizes {sizes} outside the target band "
+                f"(target {target})"
+            )
+    else:
+        if any(s > target for s in sizes):
+            return fail(
+                f"output group sizes {sizes} exceed target {target}"
+            )
+    print(
+        "check_bench_report: compact leg ok "
+        f"({detail['compact_rows_per_sec']} rows/s, "
+        f"{detail['compact_vs_scan_x']}x scan, groups {sizes})"
+    )
+    return 0
 
 
 def check_exec_cache_leg(detail: dict) -> int:

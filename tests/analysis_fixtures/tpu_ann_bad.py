@@ -1,0 +1,45 @@
+# floorlint: scope=FL-TPU
+"""Seeded-bad: dynamic dispatch through ANNOTATED receivers (the PR 10
+blind spot).  No constructor call is visible anywhere — the receiver
+types come only from annotations: a parameter annotation (string form
+included), an annotated local, and a class-body attribute annotation.
+The call graph must still follow ``.load()`` into host I/O from the
+jitted functions."""
+
+
+def jit(fn):  # stand-in so the fixture parses without jax installed
+    return fn
+
+
+class ConfigStore:
+    def load(self, path):
+        with open(path) as fh:  # host I/O: runs once at trace time
+            return int(fh.read())
+
+
+def make_store():
+    return ConfigStore()
+
+
+@jit
+def decode_param(payload, store: "ConfigStore", path):
+    limit = store.load(path)  # receiver typed ONLY by the annotation
+    return payload[:limit]
+
+
+@jit
+def decode_local(payload, path):
+    s: ConfigStore = make_store()  # factory return, annotation pins it
+    return payload[: s.load(path)]
+
+
+class Decoder:
+    store: ConfigStore  # class-body annotation; __init__ assigns untyped
+
+    def __init__(self, store):
+        self.store = store
+
+    @jit
+    def decode(self, payload, path):
+        limit = self.store.load(path)  # attr typed by the annotation
+        return payload[:limit]

@@ -1,0 +1,39 @@
+# floorlint: scope=FL-TPU
+"""Seeded-good twin of ``tpu_ann_bad``: the same annotated-receiver
+dispatch shapes, but the resolved methods are pure compute — the
+annotation-driven edges must not fabricate host-I/O findings."""
+
+
+def jit(fn):  # stand-in so the fixture parses without jax installed
+    return fn
+
+
+class ConfigStore:
+    def load_pure(self, x):
+        return x + 1
+
+
+def make_store():
+    return ConfigStore()
+
+
+@jit
+def decode_param(payload, store: "ConfigStore"):
+    return payload[: store.load_pure(1)]
+
+
+@jit
+def decode_local(payload):
+    s: ConfigStore = make_store()
+    return payload[: s.load_pure(2)]
+
+
+class Decoder:
+    store: ConfigStore
+
+    def __init__(self, store):
+        self.store = store
+
+    @jit
+    def decode(self, payload):
+        return payload[: self.store.load_pure(3)]

@@ -1,0 +1,479 @@
+"""DatasetCompactor — re-shard / re-sort / re-encode at scan speed,
+salvage retirement, and the serving ladder over compacted output
+(docs/write.md)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import pyarrow as pa  # noqa: E402
+import pyarrow.parquet as pq  # noqa: E402
+
+from parquet_floor_tpu import (  # noqa: E402
+    ParquetFileReader,
+    ParquetFileWriter,
+    ReaderOptions,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.errors import UnsupportedFeatureError  # noqa: E402
+from parquet_floor_tpu.format.parquet_thrift import (  # noqa: E402
+    CompressionCodec,
+)
+from parquet_floor_tpu.utils import trace  # noqa: E402
+from parquet_floor_tpu.write import (  # noqa: E402
+    CompactOptions,
+    DatasetCompactor,
+)
+
+from tests.test_salvage import (  # noqa: F401  (fixture re-export)
+    PAGE_VALUES,
+    ROWS_PER_GROUP,
+    _flip_in_page,
+    salvage_file,
+)
+
+
+def corpus_schema():
+    t = types
+    return t.message(
+        "c",
+        t.required(t.INT64).named("k"),
+        t.optional(t.DOUBLE).named("v"),
+        t.required(t.BYTE_ARRAY).as_(t.string()).named("s"),
+    )
+
+
+def write_corpus(tmp_path, n_files=3, rows=1100, group_rows=400):
+    """Ragged small-file corpus; ``k`` is a unique EVEN key per row (odd
+    probes are bloom-skippable absences)."""
+    paths = []
+    base = 0
+    for fi in range(n_files):
+        n = rows + fi * 137
+        r = np.random.default_rng(fi)
+        path = tmp_path / f"in_{fi}.parquet"
+        with ParquetFileWriter(
+            str(path), corpus_schema(),
+            WriterOptions(data_page_values=200,
+                          row_group_rows=group_rows),
+        ) as w:
+            done = 0
+            while done < n:
+                take = min(group_rows, n - done)
+                ks = (np.arange(base, base + take) * 2).astype(np.int64)
+                r.shuffle(ks)  # unsorted input: compaction re-sorts
+                w.write_columns({
+                    "k": ks,
+                    "v": [
+                        None if i % 9 == 0 else float(i % 31) / 4
+                        for i in range(take)
+                    ],
+                    "s": [f"s{int(k) % 97}" for k in ks],
+                })
+                base += take
+                done += take
+        paths.append(str(path))
+    return paths
+
+
+def read_all(paths):
+    return pa.concat_tables([pq.read_table(p) for p in paths])
+
+
+def test_reshard_band_and_values(tmp_path):
+    """Output row groups sit exactly at the target (last of each file
+    excepted), files rotate at target_file_rows, and every value
+    survives in delivery order."""
+    paths = write_corpus(tmp_path)
+    out = tmp_path / "out"
+    rep = DatasetCompactor(paths, str(out), CompactOptions(
+        target_row_group_rows=1000, target_file_rows=2000,
+        writer=WriterOptions(codec=CompressionCodec.ZSTD, engine="tpu"),
+    )).run()
+    tin, tout = read_all(paths), read_all(rep.paths)
+    assert tout.num_rows == tin.num_rows == rep.rows_out == rep.rows_in
+    for name in tin.column_names:
+        assert tout[name].to_pylist() == tin[name].to_pylist(), name
+    # group-size band: every group == target except each file's last
+    for p in rep.paths:
+        md = pq.ParquetFile(p).metadata
+        sizes = [
+            md.row_group(i).num_rows for i in range(md.num_row_groups)
+        ]
+        assert all(s == 1000 for s in sizes[:-1])
+        assert 0 < sizes[-1] <= 1000
+        assert sum(sizes) <= 2000
+    assert rep.groups_out == len(rep.group_rows)
+    assert rep.units_in == 11  # 3 files × 3-4 ragged groups
+
+
+def test_sort_by_and_unit_order(tmp_path):
+    """``sort_by`` orders rows within each output group (recorded as
+    sorting_columns); ``unit_order`` replays units in an explicit
+    permutation."""
+    paths = write_corpus(tmp_path, n_files=2)
+    out = tmp_path / "out"
+    rep = DatasetCompactor(paths, str(out), CompactOptions(
+        target_row_group_rows=1500, sort_by=["k"],
+        writer=WriterOptions(engine="tpu"),
+    )).run()
+    md = pq.ParquetFile(rep.paths[0]).metadata
+    assert md.row_group(0).sorting_columns[0].column_index == 0
+    tout = read_all(rep.paths)
+    ks = tout["k"].to_pylist()
+    off = 0
+    for p in rep.paths:
+        m = pq.ParquetFile(p).metadata
+        for i in range(m.num_row_groups):
+            nr = m.row_group(i).num_rows
+            seg = ks[off : off + nr]
+            assert seg == sorted(seg)
+            off += nr
+    # multiset preserved
+    assert sorted(ks) == sorted(read_all(paths)["k"].to_pylist())
+
+    # explicit unit order: reversed units deliver reversed
+    units = []
+    for fi, p in enumerate(paths):
+        with ParquetFileReader(p) as r:
+            units.extend((fi, gi) for gi in range(len(r.row_groups)))
+    out2 = tmp_path / "out2"
+    rep2 = DatasetCompactor(paths, str(out2), CompactOptions(
+        target_row_group_rows=10 ** 6, unit_order=list(reversed(units)),
+        writer=WriterOptions(engine="host"),
+    )).run()
+    got = read_all(rep2.paths)["k"].to_pylist()
+    want = []
+    for fi, gi in reversed(units):
+        with ParquetFileReader(paths[fi]) as r:
+            b = r.read_row_group(gi)
+            want.extend(np.asarray(b.column("k").values).tolist())
+    assert got == want
+
+
+def test_projection_and_nulls(tmp_path):
+    """Column projection drops fields from the output schema; optional
+    columns keep their null pattern through the carry buffer."""
+    paths = write_corpus(tmp_path, n_files=2)
+    out = tmp_path / "out"
+    rep = DatasetCompactor(paths, str(out), CompactOptions(
+        target_row_group_rows=700, columns=["k", "v"],
+        writer=WriterOptions(engine="tpu"),
+    )).run()
+    tout = read_all(rep.paths)
+    assert tout.column_names == ["k", "v"]
+    tin = read_all(paths)
+    assert tout["v"].to_pylist() == tin["v"].to_pylist()
+    assert tout["v"].null_count == tin["v"].null_count > 0
+
+
+def test_repeated_columns_rejected(tmp_path):
+    t = types
+    schema = t.message(
+        "r",
+        t.required(t.INT64).named("a"),
+        t.repeated(t.INT64).named("xs"),
+    )
+    p = tmp_path / "rep.parquet"
+    with ParquetFileWriter(str(p), schema) as w:
+        w.write_columns({"a": np.arange(4, dtype=np.int64),
+                         "xs": [[1], [2, 3], [], [4]]})
+    with pytest.raises(UnsupportedFeatureError, match="flat"):
+        DatasetCompactor([str(p)], str(tmp_path / "o"),
+                         CompactOptions()).run()
+
+
+def test_compact_report_counters(tmp_path):
+    paths = write_corpus(tmp_path, n_files=2)
+    with trace.scope() as tr:
+        rep = DatasetCompactor(paths, str(tmp_path / "o"), CompactOptions(
+            target_row_group_rows=800,
+            writer=WriterOptions(engine="tpu"),
+        )).run()
+    c = tr.counters()
+    for name in c:
+        assert name in trace.names.ALL, name
+    assert c["compact.units_in"] == rep.units_in
+    assert c["compact.rows_in"] == rep.rows_in
+    assert c["compact.groups_out"] == rep.groups_out
+    assert rep.rows_per_sec > 0
+    d = rep.as_dict()
+    assert d["rows_out"] == rep.rows_out
+
+
+# ---------------------------------------------------------------------------
+# salvage → compact → clean corpus (the QuarantineMap retirement loop)
+# ---------------------------------------------------------------------------
+
+def test_salvage_compact_retires_quarantine(salvage_file, tmp_path):
+    """The acceptance pin: compacting a corpus with quarantined units
+    under ``salvage=True`` produces files that (a) re-read with NO
+    salvage, (b) keep a fresh QuarantineMap EMPTY, and (c) contain
+    exactly the undamaged units' rows."""
+    from parquet_floor_tpu.quarantine import QuarantineMap
+    from parquet_floor_tpu.scan import DatasetScanner
+
+    # damage a REQUIRED column's page in group 0: row-mask tier →
+    # geometry damage → the compactor must drop the whole unit
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "cmp_bad")
+    out = tmp_path / "clean"
+    rep = DatasetCompactor([bad], str(out), CompactOptions(
+        salvage=True, reader=ReaderOptions(verify_crc=True),
+        target_row_group_rows=ROWS_PER_GROUP,
+        writer=WriterOptions(engine="tpu"),
+    )).run()
+    assert rep.units_dropped == 1
+    # the row-mask tier already removed the damaged page's rows at read
+    # time; the compactor then discards the unit's DELIVERED remainder
+    assert rep.rows_dropped == ROWS_PER_GROUP - PAGE_VALUES
+    assert rep.rows_out == ROWS_PER_GROUP  # group 1 survived whole
+    assert rep.salvage is not None and rep.salvage.skips
+
+    # (a) strict re-read, no salvage, bit-compare against the pristine
+    # file's group 1
+    with ParquetFileReader(salvage_file) as r:
+        want = r.read_row_group(1)
+    with ParquetFileReader(rep.paths[0]) as r:
+        got = r.read_row_group(0)
+        assert got.num_rows == ROWS_PER_GROUP
+        for name in ("a", "d"):
+            assert np.array_equal(
+                np.asarray(got.column(name).values),
+                np.asarray(want.column(name).values),
+            )
+        assert got.column("s").values.to_list() == \
+            want.column("s").values.to_list()
+
+    # (b) a fresh QuarantineMap over the compacted corpus stays empty
+    qm_path = tmp_path / "clean_map.json"
+    qmap = QuarantineMap(str(qm_path))
+    with DatasetScanner(
+        rep.paths,
+        options=ReaderOptions(salvage=True, verify_crc=True,
+                              quarantine_map=qmap),
+    ) as s:
+        n = sum(u.batch.num_rows for u in s)
+        assert n == ROWS_PER_GROUP
+        assert not s.salvage_report.skips
+    qmap.save()
+    assert not qmap._files  # no file earned an entry: the map retired
+
+
+def test_salvage_page_null_flows_through(salvage_file, tmp_path):
+    """Page-null tier (optional column): the unit is KEPT — the lost
+    page's rows become legal nulls in the compacted output."""
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "s", 1, "cmp_opt")
+    out = tmp_path / "cleaned2"
+    rep = DatasetCompactor([bad], str(out), CompactOptions(
+        salvage=True, reader=ReaderOptions(verify_crc=True),
+        writer=WriterOptions(engine="tpu"),
+    )).run()
+    assert rep.units_dropped == 0
+    assert rep.rows_out == 2 * ROWS_PER_GROUP
+    tab = pq.read_table(rep.paths[0])
+    with ParquetFileReader(salvage_file) as r:
+        pristine = r.read_row_group(0)
+    base_nulls = int(np.count_nonzero(pristine.column("s").null_mask))
+    # the damaged page's PAGE_VALUES slots turned null (minus any that
+    # already were)
+    assert tab.slice(0, ROWS_PER_GROUP)["s"].null_count > base_nulls
+    # strict re-read needs no salvage
+    with ParquetFileReader(rep.paths[0], verify_crc=True) as r:
+        r.read_row_group(0)
+
+
+# ---------------------------------------------------------------------------
+# the serving ladder over compacted output
+# ---------------------------------------------------------------------------
+
+def test_compacted_output_feeds_serving_ladder(tmp_path):
+    """Acceptance pin: a ``serve.Dataset.lookup`` against compactor
+    output fires all three rungs — footer-stats pruning, bloom skip,
+    and page-index page reads."""
+    from parquet_floor_tpu.serve.lookup import Dataset
+
+    paths = write_corpus(tmp_path, n_files=3)
+    out = tmp_path / "served"
+    rep = DatasetCompactor(paths, str(out), CompactOptions(
+        target_row_group_rows=600, target_file_rows=1800,
+        sort_by=["k"], unit_order=None,
+        writer=WriterOptions(
+            engine="tpu",
+            bloom_filter_columns={"k": True},
+        ),
+    )).run()
+    assert len(rep.paths) >= 2
+    # NOTE: sort_by is per-GROUP; the corpus delivery order is already
+    # globally near-sorted (keys ascend across units), so group stats
+    # are disjoint enough for the stats rung to prune.
+    with trace.scope() as tr:
+        with Dataset(rep.paths, "k") as ds:
+            present = ds.lookup(2 * 100)      # an even key that exists
+            assert present and present[0]["k"] == 200
+            absent = ds.lookup(2 * 100 + 1)   # odd: bloom-skippable
+            assert absent == []
+            assert ds.lookup(10 ** 15) == []  # stats-prunable
+    c = tr.counters()
+    assert c.get("serve.lookup_groups_pruned", 0) > 0   # stats rung
+    assert c.get("serve.lookup_bloom_skips", 0) > 0     # bloom rung
+    assert c.get("serve.lookup_pages_read", 0) > 0      # page rung
+
+
+def test_pyarrow_written_corpus_compacts_bit_exact(tmp_path):
+    """Acceptance pin (foreign writer end to end): a corpus written by
+    PYARROW — its own encodings, its own page layout — compacts through
+    our engine and reads back under pyarrow bit-identical, across
+    snappy/zstd/uncompressed inputs."""
+    rng2 = np.random.default_rng(5)
+    paths = []
+    for fi, comp in enumerate(["snappy", "zstd", "none"]):
+        n = 900 + fi * 113
+        tab = pa.table({
+            "k": pa.array(
+                rng2.integers(0, 10 ** 6, n), type=pa.int64()
+            ),
+            "x": pa.array(rng2.standard_normal(n), type=pa.float64()),
+            "o": pa.array(
+                [None if i % 6 == 0 else i % 19 for i in range(n)],
+                type=pa.int32(),
+            ),
+            "s": pa.array(
+                [f"v{int(i) % 41}" for i in range(n)], type=pa.string()
+            ),
+        })
+        p = str(tmp_path / f"pa_{fi}.parquet")
+        pq.write_table(
+            tab, p, compression=comp, row_group_size=400,
+            use_dictionary=True, data_page_version="2.0",
+        )
+        paths.append(p)
+    out = tmp_path / "pa_out"
+    rep = DatasetCompactor(paths, str(out), CompactOptions(
+        target_row_group_rows=1000,
+        writer=WriterOptions(engine="tpu"),
+    )).run()
+    tin = read_all(paths)
+    tout = read_all(rep.paths)
+    assert tout.num_rows == tin.num_rows
+    for name in tin.column_names:
+        if name == "x":
+            a = np.asarray(tin["x"].to_numpy()).view(np.uint64)
+            b = np.asarray(tout["x"].to_numpy()).view(np.uint64)
+            assert np.array_equal(a, b)  # float bit patterns exact
+        else:
+            assert tout[name].to_pylist() == tin[name].to_pylist(), name
+
+
+def test_writer_failure_raises_not_hangs(tmp_path):
+    """A write-leg failure under queue backpressure must surface as a
+    raise from run(), never a hang: the writer thread records the error
+    and KEEPS DRAINING the bounded queue until the sentinel (the
+    deadlock shape a dead consumer would cause)."""
+    import signal
+
+    paths = write_corpus(tmp_path, n_files=2)
+
+    calls = {"n": 0}
+
+    def bad_dest(index: int) -> str:
+        calls["n"] += 1
+        if index >= 1:
+            raise OSError("simulated destination failure")
+        return str(tmp_path / f"bd-{index:05d}.parquet")
+
+    def on_alarm(*_):  # pragma: no cover - only fires on regression
+        raise AssertionError("compactor hung on writer failure")
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(60)
+    try:
+        with pytest.raises(OSError, match="simulated destination"):
+            DatasetCompactor(paths, bad_dest, CompactOptions(
+                # many tiny groups + a 1-group file cap: the rotation to
+                # file 1 fails while the read leg is still producing
+                target_row_group_rows=100, target_file_rows=100,
+                writer=WriterOptions(engine="pipelined"),
+            )).run()
+    finally:
+        signal.alarm(0)
+    assert calls["n"] >= 2
+
+
+def test_nested_optional_structure_survives(tmp_path):
+    """Multi-level definition levels (outer null vs inner null of
+    ``optional group g { optional int64 x }``): the auto read leg must
+    pin HOST — the device face ships only a row null-mask and would
+    collapse outer nulls into inner nulls."""
+    t = types
+    schema = t.message(
+        "n",
+        t.required(t.INT64).named("id"),
+        t.optional_group(t.optional(t.INT64).named("x")).named("g"),
+    )
+    p = str(tmp_path / "nested.parquet")
+    # def 0 = g null, 1 = g present / x null, 2 = value: the two null
+    # tiers only exist through explicit definition levels
+    from parquet_floor_tpu.format.file_write import ColumnData
+
+    pattern = [0, 1, 2, 0, 2] * 60
+    defs = np.array(pattern, dtype=np.uint32)
+    vals = np.array(
+        [7 + i for i, d in enumerate(pattern) if d == 2],
+        dtype=np.int64,
+    )
+    gx = [c for c in schema.columns if c.path[-1] == "x"][0]
+    with ParquetFileWriter(p, schema) as w:
+        w.write_columns({
+            "id": np.arange(300, dtype=np.int64),
+            "g.x": ColumnData(gx, vals, def_levels=defs),
+        })
+    out = tmp_path / "nout"
+    rep = DatasetCompactor([p], str(out), CompactOptions(
+        target_row_group_rows=100,
+        writer=WriterOptions(engine="host"),
+    )).run()
+    assert rep.rows_out == 300
+    tin = pq.read_table(p).to_pylist()
+    tout = read_all(rep.paths).to_pylist()
+    assert tout == tin  # outer None vs {"x": None} both preserved
+    # and the explicit device leg refuses rather than corrupting
+    with pytest.raises(UnsupportedFeatureError, match="definition"):
+        DatasetCompactor([p], str(tmp_path / "n2"), CompactOptions(
+            read_leg="tpu",
+        )).run()
+
+
+def test_device_writer_ctor_failure_closes_sink(tmp_path, monkeypatch):
+    """A DeviceFileWriter whose engine cannot construct (no x64 jax)
+    must close the sink the base ctor opened — the same ctor-guard
+    contract ParquetFileWriter holds (FL-RES001's leak class)."""
+    from parquet_floor_tpu.io.source import FileSink
+    from parquet_floor_tpu.write import DeviceFileWriter
+    from parquet_floor_tpu.write import encode as _enc
+
+    closed = []
+    orig = FileSink.close
+
+    def tracking_close(self):
+        closed.append(self)
+        return orig(self)
+
+    monkeypatch.setattr(FileSink, "close", tracking_close)
+
+    def boom(*a, **k):
+        raise RuntimeError("no backend")
+
+    monkeypatch.setattr(_enc, "EncodeEngine", boom)
+    t = types
+    schema = t.message("m", t.required(t.INT64).named("a"))
+    with pytest.raises(RuntimeError, match="no backend"):
+        # ctor self-closes on engine failure (pinned below)
+        DeviceFileWriter(  # floorlint: disable=FL-RES001
+            str(tmp_path / "leak.parquet"), schema,
+            WriterOptions(engine="tpu"),
+        )
+    assert len(closed) == 1

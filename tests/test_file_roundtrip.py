@@ -134,9 +134,10 @@ def test_metadata_surface(tmp_path):
 def test_key_value_metadata(tmp_path):
     path = tmp_path / "kv.parquet"
     schema = types.message("m", types.required(types.INT32).named("x"))
-    w = ParquetFileWriter(path, schema, key_value_metadata={"origin": "unit-test"})
-    w.write_columns({"x": np.array([1, 2, 3], dtype=np.int32)})
-    w.close()
+    with ParquetFileWriter(
+        path, schema, key_value_metadata={"origin": "unit-test"}
+    ) as w:
+        w.write_columns({"x": np.array([1, 2, 3], dtype=np.int32)})
     with ParquetFileReader(path) as r:
         assert r.metadata.key_value_metadata["origin"] == "unit-test"
 
@@ -331,7 +332,9 @@ def test_boundary_order_and_sorting_columns(tmp_path):
     assert [s.descending for s in srt] == [False, True]
     # unknown sort column fails fast
     with pytest.raises(ValueError, match="no column named"):
-        ParquetFileWriter(
+        # ctor raises pre-ownership and closes its own sink (pinned by
+        # test_ctor_failure_closes_sink)
+        ParquetFileWriter(  # floorlint: disable=FL-RES001
             str(tmp_path / "bad.parquet"), schema,
             WriterOptions(sorting_columns=["zz"]),
         )
@@ -550,17 +553,17 @@ def test_per_column_encoding_overrides(tmp_path):
         assert Encoding.RLE_DICTIONARY in by["s"].encodings  # others keep it
     # validation fails fast, before any bytes hit the sink
     with pytest.raises(ValueError, match="no column named"):
-        ParquetFileWriter(
+        ParquetFileWriter(  # floorlint: disable=FL-RES001 — ctor self-closes
             str(tmp_path / "x1.parquet"), schema,
             WriterOptions(column_encodings={"zz": "PLAIN"}),
         )
     with pytest.raises(ValueError, match="does not apply"):
-        ParquetFileWriter(
+        ParquetFileWriter(  # floorlint: disable=FL-RES001 — ctor self-closes
             str(tmp_path / "x2.parquet"), schema,
             WriterOptions(column_encodings={"s": "DELTA_BINARY_PACKED"}),
         )
     with pytest.raises(ValueError, match="unknown encoding"):
-        ParquetFileWriter(
+        ParquetFileWriter(  # floorlint: disable=FL-RES001 — ctor self-closes
             str(tmp_path / "x3.parquet"), schema,
             WriterOptions(column_encodings={"a": "RLE_HYBRID"}),
         )

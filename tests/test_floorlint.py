@@ -31,6 +31,8 @@ CASES = [
     ("tpu002", "FL-TPU002"),
     ("tpu_chain", "FL-TPU001"),  # call-graph: helper reached from a jit,
     #                              partial hop; good pins the depth bound
+    ("tpu_ann", "FL-TPU001"),   # annotated receivers: param / local /
+    #                             class-body attr annotations pin types
     ("res001", "FL-RES001"),
     ("res001_tpe", "FL-RES001"),  # executor/scan-handle shapes of the rule
     ("res001_remote", "FL-RES001"),  # remote session/pool + factory shapes

@@ -1,0 +1,540 @@
+"""Device write engine — round-trip differential suite (docs/write.md).
+
+The engine's correctness claim is DIFFERENTIAL: every file the fused
+device encode path writes must read back bit-identical under pyarrow (a
+foreign reader, end to end) AND under our own read faces, across every
+encoding the engine emits (RLE_DICTIONARY, DELTA_BINARY_PACKED,
+BYTE_STREAM_SPLIT, PLAIN + host-fallback strings/bools) × codecs
+(snappy / zstd / uncompressed) × page versions (v1 / v2).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import pyarrow.parquet as pq  # noqa: E402
+
+from parquet_floor_tpu import (  # noqa: E402
+    CompressionCodec,
+    ParquetFileReader,
+    ParquetFileWriter,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.utils import trace  # noqa: E402
+from parquet_floor_tpu.write import DeviceFileWriter  # noqa: E402
+from parquet_floor_tpu.write.encode import resolve_writer  # noqa: E402
+
+rng = np.random.default_rng(7)
+
+N = 2000  # > one aligned device page (multiple-of-128 grid) per group
+
+
+def mixed_schema():
+    t = types
+    return t.message(
+        "m",
+        t.required(t.INT64).named("di64"),        # dictionary
+        t.required(t.INT32).named("di32"),        # dictionary
+        t.optional(t.INT64).named("opt"),         # optional dictionary
+        t.required(t.DOUBLE).named("dd"),         # dictionary double
+        t.required(t.INT64).named("delta64"),     # DELTA_BINARY_PACKED
+        t.required(t.INT32).named("delta32"),     # DELTA_BINARY_PACKED
+        t.required(t.DOUBLE).named("bss64"),      # BYTE_STREAM_SPLIT
+        t.required(t.FLOAT).named("bss32"),       # BYTE_STREAM_SPLIT
+        t.required(t.INT64).named("plain"),       # PLAIN (host identity)
+        t.required(t.BYTE_ARRAY).as_(t.string()).named("s"),  # host
+        t.required(t.BOOLEAN).named("b"),         # host
+    )
+
+
+def mixed_columns(n=N, seed=7):
+    r = np.random.default_rng(seed)
+    return {
+        "di64": r.integers(0, 50, n).astype(np.int64),
+        "di32": r.integers(-40, 0, n).astype(np.int32),
+        "opt": [None if i % 7 == 0 else i % 13 for i in range(n)],
+        "dd": np.round(r.standard_normal(n), 1),
+        "delta64": np.cumsum(r.integers(-5, 1000, n)).astype(np.int64),
+        "delta32": np.cumsum(r.integers(-3, 7, n)).astype(np.int32),
+        "bss64": r.standard_normal(n),
+        "bss32": r.standard_normal(n).astype(np.float32),
+        "plain": r.integers(-(2 ** 62), 2 ** 62, n).astype(np.int64),
+        "s": [f"tag_{i % 23}" for i in range(n)],
+        "b": (np.arange(n) % 3 == 0),
+    }
+
+
+def device_options(codec, page_version, **kw):
+    return WriterOptions(
+        codec=codec, page_version=page_version, engine="tpu",
+        data_page_values=512,  # several pages per group
+        column_encodings={
+            "delta64": "DELTA_BINARY_PACKED",
+            "delta32": "DELTA_BINARY_PACKED",
+            "bss64": "BYTE_STREAM_SPLIT",
+            "bss32": "BYTE_STREAM_SPLIT",
+            "plain": "PLAIN",
+        },
+        **kw,
+    )
+
+
+def write_device(path, opts, n=N, groups=2):
+    with DeviceFileWriter(str(path), mixed_schema(), opts) as w:
+        for g in range(groups):
+            w.write_columns(mixed_columns(n, seed=7 + g))
+
+
+def assert_pyarrow_equal(path, n=N, groups=2):
+    tab = pq.read_table(str(path))
+    assert tab.num_rows == n * groups
+    for g in range(groups):
+        cols = mixed_columns(n, seed=7 + g)
+        sl = tab.slice(g * n, n)
+        for name, want in cols.items():
+            got = sl[name].to_pylist()
+            if isinstance(want, np.ndarray):
+                if want.dtype.kind == "f":
+                    # bit-exact, not approx: compare raw bit patterns
+                    got_arr = np.asarray(
+                        sl[name].to_numpy(zero_copy_only=False),
+                        dtype=want.dtype,
+                    )
+                    assert np.array_equal(
+                        got_arr.view(np.uint64 if want.itemsize == 8
+                                     else np.uint32),
+                        want.view(np.uint64 if want.itemsize == 8
+                                  else np.uint32),
+                    ), name
+                else:
+                    assert got == want.tolist(), name
+            else:
+                assert got == want, name
+
+
+@pytest.mark.parametrize("codec", [
+    CompressionCodec.UNCOMPRESSED,
+    CompressionCodec.SNAPPY,
+    CompressionCodec.ZSTD,
+])
+@pytest.mark.parametrize("page_version", [1, 2])
+def test_device_writer_pyarrow_differential(tmp_path, codec, page_version):
+    """Acceptance matrix: dict/delta/BSS/plain (+host strings/bools) ×
+    snappy/zstd/uncompressed × v1/v2 — bit-identical under pyarrow."""
+    path = tmp_path / "d.parquet"
+    write_device(path, device_options(codec, page_version))
+    assert_pyarrow_equal(path)
+    # the device encodings actually landed (not silently host-PLAIN)
+    md = pq.ParquetFile(str(path)).metadata
+    enc = {
+        md.schema.column(i).name: set(md.row_group(0).column(i).encodings)
+        for i in range(md.num_columns)
+    }
+    assert "RLE_DICTIONARY" in enc["di64"]
+    assert "RLE_DICTIONARY" in enc["opt"]
+    assert "DELTA_BINARY_PACKED" in enc["delta64"]
+    assert "BYTE_STREAM_SPLIT" in enc["bss64"]
+
+
+def test_device_writer_our_read_faces(tmp_path):
+    """A device-written file reads identically through the sequential
+    host reader, the host scan scheduler, the device scan leg, and the
+    DataLoader."""
+    from parquet_floor_tpu.data import DataLoader
+    from parquet_floor_tpu.scan import DatasetScanner, scan_device_groups
+
+    path = tmp_path / "faces.parquet"
+    write_device(path, device_options(CompressionCodec.SNAPPY, 2))
+    cols0 = mixed_columns(N, seed=7)
+
+    def check_batch(by_name, sl=slice(None)):
+        assert np.array_equal(
+            np.asarray(by_name["di64"]), cols0["di64"][sl]
+        )
+        assert np.array_equal(
+            np.asarray(by_name["delta64"]), cols0["delta64"][sl]
+        )
+        assert np.array_equal(
+            np.asarray(by_name["bss64"]).view(np.uint64),
+            cols0["bss64"][sl].view(np.uint64),
+        )
+
+    with ParquetFileReader(str(path)) as r:
+        b = r.read_row_group(0)
+        check_batch({
+            cb.descriptor.path[0]: cb.values for cb in b.columns
+        })
+    with DatasetScanner([str(path)]) as s:
+        u = next(iter(s))
+        check_batch({
+            cb.descriptor.path[0]: cb.values for cb in u.batch.columns
+        })
+    got = next(iter(
+        scan_device_groups([str(path)], float64_policy="float64")
+    ))[2]
+    check_batch({k: np.asarray(v.values) for k, v in got.items()})
+    with DataLoader([str(path)], batch_size=N, engine="host") as dl:
+        lb = next(iter(dl))
+        by = {c.descriptor.path[0]: c for c in lb.columns}
+        assert np.array_equal(np.asarray(by["di64"].values),
+                              cols0["di64"])
+
+
+def test_device_vs_host_writer_value_identical(tmp_path):
+    """Same columns through engine=host and engine=tpu: the files need
+    not be byte-identical (dictionary ORDER differs by design), but
+    every decoded value must match."""
+    opts_t = device_options(CompressionCodec.SNAPPY, 2)
+    opts_h = WriterOptions(
+        codec=CompressionCodec.SNAPPY, page_version=2,
+        data_page_values=512,
+        column_encodings=opts_t.column_encodings,
+    )
+    pt, ph = tmp_path / "t.parquet", tmp_path / "h.parquet"
+    write_device(pt, opts_t, groups=1)
+    with ParquetFileWriter(str(ph), mixed_schema(), opts_h) as w:
+        w.write_columns(mixed_columns(N, seed=7))
+    ta, tb = pq.read_table(str(pt)), pq.read_table(str(ph))
+    assert ta.equals(tb)
+
+
+def test_resolve_writer_engines(tmp_path):
+    schema = types.message(
+        "m", types.required(types.INT64).named("x")
+    )
+    w = resolve_writer(str(tmp_path / "h.parquet"), schema,
+                       WriterOptions(engine="host"))
+    try:
+        assert type(w) is ParquetFileWriter
+    finally:
+        w.abort()
+    w = resolve_writer(str(tmp_path / "t.parquet"), schema,
+                       WriterOptions(engine="tpu"))
+    try:
+        assert isinstance(w, DeviceFileWriter)
+    finally:
+        w.abort()
+    w = resolve_writer(str(tmp_path / "a.parquet"), schema,
+                       WriterOptions(engine="auto"))
+    try:
+        # the CPU backend is up: auto picks the PIPELINED writer (the
+        # fused launches only win on a real accelerator)
+        assert isinstance(w, DeviceFileWriter)
+        assert w._engine is None
+    finally:
+        w.abort()
+    w = resolve_writer(str(tmp_path / "p.parquet"), schema,
+                       WriterOptions(engine="pipelined"))
+    try:
+        assert isinstance(w, DeviceFileWriter) and w._engine is None
+    finally:
+        w.abort()
+    with pytest.raises(ValueError, match="engine"):
+        # validation raises before any sink is constructed (no leak)
+        resolve_writer(  # floorlint: disable=FL-RES001
+            str(tmp_path / "b.parquet"), schema,
+            WriterOptions(engine="gpu"),
+        )
+
+
+def test_api_facade_rides_engine(tmp_path):
+    """ParquetWriter (the row-at-a-time reference facade) flushes
+    through the device engine when options.engine says so."""
+    from parquet_floor_tpu import Dehydrator, ParquetWriter
+
+    t = types
+    schema = t.message(
+        "m",
+        t.required(t.INT64).named("a"),
+        t.required(t.DOUBLE).named("d"),
+    )
+
+    class D(Dehydrator):
+        def dehydrate(self, record, vw):
+            vw.write("a", record[0])
+            vw.write("d", record[1])
+
+    path = tmp_path / "api.parquet"
+    opts = WriterOptions(engine="tpu", row_group_rows=600)
+    records = [(i % 9, float(i % 5)) for i in range(1500)]
+    ParquetWriter.write_file(schema, str(path), D(), records, opts)
+    tab = pq.read_table(str(path))
+    assert tab["a"].to_pylist() == [r[0] for r in records]
+    assert tab["d"].to_pylist() == [r[1] for r in records]
+    md = pq.ParquetFile(str(path)).metadata
+    assert md.num_row_groups == 3  # 600/600/300: facade flush rode through
+
+
+def test_dict_reject_falls_back_to_host(tmp_path):
+    """A high-cardinality column fails the dictionary cutoff AFTER the
+    analyze launch: the column must re-encode on host, values intact."""
+    t = types
+    schema = t.message("m", t.required(t.INT64).named("u"))
+    vals = np.arange(4000, dtype=np.int64) * 7  # all distinct
+    path = tmp_path / "rej.parquet"
+    with trace.scope() as tr:
+        with DeviceFileWriter(
+            str(path), schema,
+            WriterOptions(engine="tpu", dictionary_max_fraction=0.5),
+        ) as w:
+            w.write_columns({"u": vals})
+    assert any(
+        d.get("decision") == "write.engine"
+        and d.get("action") == "dict_reject"
+        for d in tr.decisions()
+    )
+    assert pq.read_table(str(path))["u"].to_pylist() == vals.tolist()
+    md = pq.ParquetFile(str(path)).metadata
+    assert "RLE_DICTIONARY" not in md.row_group(0).column(0).encodings
+
+
+def test_delta_wide_offsets_fall_back_to_host(tmp_path):
+    """INT64 deltas spanning more than 32 bits cannot pack on device:
+    the column host-encodes, and the file still reads back exactly."""
+    t = types
+    schema = t.message("m", t.required(t.INT64).named("w"))
+    vals = np.array(
+        [0, 2 ** 40, -(2 ** 50), 2 ** 60, 1, -1] * 300, dtype=np.int64
+    )
+    path = tmp_path / "wide.parquet"
+    with trace.scope() as tr:
+        with DeviceFileWriter(
+            str(path), schema,
+            WriterOptions(
+                engine="tpu", enable_dictionary=False,
+                delta_integers=True,
+            ),
+        ) as w:
+            w.write_columns({"w": vals})
+    assert any(
+        d.get("decision") == "write.engine"
+        and d.get("action") == "delta_wide"
+        for d in tr.decisions()
+    )
+    assert pq.read_table(str(path))["w"].to_pylist() == vals.tolist()
+
+
+@pytest.mark.parametrize("n", [1, 127, 128, 129, 512, 513])
+def test_page_grid_edges(tmp_path, n):
+    """Row counts straddling the 128-value device page grid: first/last
+    page slicing of the fused packed stream must stay exact."""
+    t = types
+    schema = t.message(
+        "m",
+        t.required(t.INT64).named("k"),
+        t.required(t.INT64).named("dl"),
+        t.required(t.DOUBLE).named("bs"),
+    )
+    r = np.random.default_rng(n)
+    cols = {
+        "k": r.integers(0, 9, n).astype(np.int64),
+        "dl": np.cumsum(r.integers(0, 5, n)).astype(np.int64),
+        "bs": r.standard_normal(n),
+    }
+    path = tmp_path / f"edge{n}.parquet"
+    with DeviceFileWriter(
+        str(path), schema,
+        WriterOptions(
+            engine="tpu", data_page_values=128,
+            column_encodings={
+                "dl": "DELTA_BINARY_PACKED", "bs": "BYTE_STREAM_SPLIT",
+            },
+        ),
+    ) as w:
+        w.write_columns(cols)
+    tab = pq.read_table(str(path))
+    assert tab["k"].to_pylist() == cols["k"].tolist()
+    assert tab["dl"].to_pylist() == cols["dl"].tolist()
+    assert np.array_equal(
+        np.asarray(tab["bs"].to_numpy()).view(np.uint64),
+        cols["bs"].view(np.uint64),
+    )
+
+
+def test_float_bit_patterns_survive(tmp_path):
+    """-0.0, NaN payloads, and infinities are dictionary-distinct by
+    BIT PATTERN and must round-trip bit-exactly."""
+    t = types
+    schema = t.message("m", t.required(t.DOUBLE).named("f"))
+    vals = np.array(
+        [0.0, -0.0, np.nan, np.inf, -np.inf, 1.5] * 100
+    )
+    path = tmp_path / "bits.parquet"
+    with DeviceFileWriter(str(path), schema,
+                          WriterOptions(engine="tpu")) as w:
+        w.write_columns({"f": vals})
+    got = pq.read_table(str(path))["f"].to_numpy(zero_copy_only=False)
+    assert np.array_equal(
+        np.asarray(got, dtype=np.float64).view(np.uint64),
+        vals.view(np.uint64),
+    )
+
+
+def test_pipeline_depth_orders_groups(tmp_path):
+    """Many small groups through a depth-2 pipeline: emission must stay
+    in submission order and all groups must land."""
+    t = types
+    schema = t.message("m", t.required(t.INT64).named("g"))
+    path = tmp_path / "pipe.parquet"
+    with trace.scope() as tr:
+        with DeviceFileWriter(
+            str(path), schema,
+            WriterOptions(engine="tpu", write_pipeline_depth=2),
+        ) as w:
+            for g in range(7):
+                w.write_columns({
+                    "g": np.full(300, g, dtype=np.int64)
+                })
+    tab = pq.read_table(str(path))
+    assert tab["g"].to_pylist() == [
+        g for g in range(7) for _ in range(300)
+    ]
+    c = tr.counters()
+    assert c["write.groups"] == 7
+    assert c["write.rows"] == 2100
+    assert tr.gauges()["write.inflight_groups_max"] >= 2
+
+
+def test_writer_error_aborts_cleanly(tmp_path):
+    """A mid-stream error must abort (no footer) and release the pool;
+    the partial file must not parse."""
+    t = types
+    schema = t.message("m", t.required(t.INT64).named("a"))
+    path = tmp_path / "abort.parquet"
+    with pytest.raises(ValueError):
+        with DeviceFileWriter(str(path), schema,
+                              WriterOptions(engine="tpu")) as w:
+            w.write_columns({"a": np.arange(256, dtype=np.int64)})
+            raise ValueError("boom")
+    with pytest.raises(Exception):
+        ParquetFileReader(str(path))
+
+
+def test_prepared_chunk_stats_and_index_parity(tmp_path):
+    """Device-encoded chunks carry the same statistics/ColumnIndex/
+    OffsetIndex metadata machinery as host chunks (the shared
+    pagination path): stats exist, bounds are right, pages counted."""
+    t = types
+    schema = t.message("m", t.required(t.INT64).named("k"))
+    vals = np.arange(1000, dtype=np.int64) % 37
+    path = tmp_path / "stats.parquet"
+    with DeviceFileWriter(
+        str(path), schema,
+        WriterOptions(engine="tpu", data_page_values=256),
+    ) as w:
+        w.write_columns({"k": vals})
+    md = pq.ParquetFile(str(path)).metadata
+    col = md.row_group(0).column(0)
+    assert col.statistics.min == 0 and col.statistics.max == 36
+    # the page index exists and pyarrow can use it
+    pr = pq.ParquetReader()
+    pr.open(str(path))
+    ci = pr.metadata.row_group(0).column(0)
+    assert ci.total_compressed_size > 0
+    tab = pq.read_table(str(path), filters=[("k", "=", 36)])
+    assert set(tab["k"].to_pylist()) == {36}
+
+
+def test_empty_and_all_null_groups(tmp_path):
+    """Zero-row groups and all-null optional columns take the host path
+    and still write valid files under engine=tpu."""
+    t = types
+    schema = t.message(
+        "m",
+        t.required(t.INT64).named("a"),
+        t.optional(t.INT64).named("o"),
+    )
+    path = tmp_path / "empty.parquet"
+    with DeviceFileWriter(str(path), schema,
+                          WriterOptions(engine="tpu")) as w:
+        w.write_columns({
+            "a": np.array([], dtype=np.int64), "o": [],
+        })
+        w.write_columns({
+            "a": np.arange(300, dtype=np.int64), "o": [None] * 300,
+        })
+    tab = pq.read_table(str(path))
+    assert tab.num_rows == 300
+    assert tab["o"].null_count == 300
+
+
+def test_write_trace_counters_registered(tmp_path):
+    """Every counter/span the write path emits is a registered name
+    (FL-OBS001's runtime twin) and the launch counter reflects the
+    two-launch shape."""
+    t = types
+    schema = t.message(
+        "m",
+        t.required(t.INT64).named("k"),
+        t.required(t.DOUBLE).named("bs"),
+    )
+    with trace.scope() as tr:
+        with DeviceFileWriter(
+            str(tmp_path / "tr.parquet"), schema,
+            WriterOptions(engine="tpu", column_encodings={
+                "bs": "BYTE_STREAM_SPLIT",
+            }),
+        ) as w:
+            w.write_columns({
+                "k": np.arange(500, dtype=np.int64) % 5,
+                "bs": rng.standard_normal(500),
+            })
+    c = tr.counters()
+    for name in c:
+        assert name in trace.names.ALL, name
+    # dict column needs analyze+pack; bss finishes in analyze: 2 launches
+    assert c["write.launches"] == 2
+    assert c["write.device_columns"] == 2
+    st = tr.stats()
+    assert "write.encode" in st and "write.emit" in st
+
+
+def test_persisted_pushdown_hwm(tmp_path):
+    """Satellite (docs/pushdown.md): the pushdown capacity HWM persists
+    next to the exec cache — a fresh ComputeRequest with the same
+    predicate skips the initial-capacity guess."""
+    from benchmarks.workloads import write_lineitem
+    from parquet_floor_tpu.batch.predicate import col
+    from parquet_floor_tpu.scan import ScanOptions, scan_device_groups
+    from parquet_floor_tpu.tpu import exec_cache
+    from parquet_floor_tpu.tpu.compute import ComputeRequest
+
+    p = str(tmp_path / "hwm.parquet")
+    write_lineitem(p, 800, row_group_rows=400, seed=3)
+    pred = col("l_quantity") > 1.0  # nearly all rows survive
+    cache = exec_cache.ExecutableCache(str(tmp_path / "cache"))
+    exec_cache.activate(cache)
+    try:
+        for _ in scan_device_groups(
+            [p], predicate=pred, scan=ScanOptions(pushdown=True),
+            float64_policy="float64",
+        ):
+            pass
+        warm = ComputeRequest(predicate=pred, cache_scope=p)
+        key = warm._hwm_cache_key()
+        assert cache.load_hwm(key) is not None
+        assert warm.capacity_for(400) >= 384  # bucketed observed HWM
+        # a different predicate stays cold (keys don't collide)
+        cold = ComputeRequest(predicate=col("l_quantity") > 2.0,
+                              cache_scope=p)
+        assert cold.capacity_for(400) == 256  # the n//8-floor guess
+        # a different DATASET stays cold too: selectivity is a property
+        # of (predicate, data) — one corpus must not inflate another
+        other = ComputeRequest(predicate=pred, cache_scope="/elsewhere")
+        assert other.capacity_for(400) == 256
+        # an EXPLICIT initial_capacity wins over the cached hint
+        pinned = ComputeRequest(predicate=pred, cache_scope=p,
+                                initial_capacity=32)
+        assert pinned.capacity_for(400) <= 48  # bucketed 32, not 395
+        # corrupt sidecar degrades to the guess, never raises
+        (tmp_path / "cache" / "pushdown_hwm.json").write_text("{nope")
+        fresh = exec_cache.ExecutableCache(str(tmp_path / "cache"))
+        exec_cache.activate(fresh)
+        again = ComputeRequest(predicate=pred, cache_scope=p)
+        assert again.capacity_for(400) == 256
+    finally:
+        exec_cache.activate(None)

@@ -34,6 +34,13 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/pftpu_jax_cache")
 
 
+def _hist_p_ms(hist, p: float):
+    """One rounding/None convention for every leg's histogram-quantile
+    field: ``hist`` is a LogHistogram or None, result is ms or None."""
+    v = None if hist is None else hist.percentile(p)
+    return None if v is None else round(v * 1e3, 3)
+
+
 def _decoded_bytes(reader) -> int:
     """Total decompressed bytes in the file (footer metadata: the sum of
     every column chunk's total_uncompressed_size — pages + headers)."""
@@ -691,7 +698,23 @@ def remote_leg(n_rows: int) -> dict:
     )
     rows = sum(d[0] for d in clean_digests)
     fc = fault_rep.counters
+
+    def p_ms(rep, name, p):
+        return _hist_p_ms(rep.histogram(name), p)
+
     return {
+        # tail-latency truth from the new histograms (docs/
+        # observability.md): storage-read latency under the clean and
+        # fault-heavy profiles, split by hedge outcome on the latter
+        "remote_read_p50_ms": p_ms(
+            clean_rep, "io.remote.get_seconds.primary", 50
+        ),
+        "remote_read_p99_ms": p_ms(
+            clean_rep, "io.remote.get_seconds.primary", 99
+        ),
+        "remote_fault_read_p99_ms": p_ms(
+            fault_rep, "io.remote.get_seconds.primary", 99
+        ),
         "remote_rtt_ms": RTT_S * 1e3,
         "remote_files": len(paths),
         "remote_units": len(clean_digests),
@@ -858,7 +881,21 @@ def serving_leg(n_rows: int) -> dict:
                 ) > bloom0:
                     break
             lc = lt.counters()
+            lh = lt.histograms()
+        lk_hist = lh.get("serve.lookup_seconds")
+        rd_hist = lh.get("io.read_seconds.file")
         detail.update({
+            # the probe-latency distribution (every lookup above lands
+            # in the scope's histogram), plus the storage-read split —
+            # check_bench_report asserts the well-formedness law
+            "serving_lookup_hist": (
+                lk_hist.as_dict() if lk_hist is not None else None
+            ),
+            "serving_lookup_p50_ms": _hist_p_ms(lk_hist, 50),
+            "serving_lookup_p99_ms": _hist_p_ms(lk_hist, 99),
+            "serving_storage_read_hist": (
+                rd_hist.as_dict() if rd_hist is not None else None
+            ),
             "serving_lookup_rows": len(hot_rows),
             "serving_lookup_storage_bytes": (
                 s1["miss_bytes"] - s0["miss_bytes"]

@@ -429,35 +429,76 @@ class Project:
         if not isinstance(ref, ast.Attribute):
             return None
         recv, attr = ref.value, ref.attr
-        # self.method(...)
-        if isinstance(recv, ast.Name) and recv.id == "self" and \
-                info.cls is not None:
-            return self._method_in(info.cls.qual, attr)
-        # self.attr.method(...) through an inferred attribute type
-        if isinstance(recv, ast.Attribute) and \
-                isinstance(recv.value, ast.Name) and \
-                recv.value.id == "self" and info.cls is not None:
-            tq = info.cls.attr_types.get(recv.attr)
-            if tq is not None:
-                return self._method_in(tq, attr)
-            return None
-        # mod.fn(...) through a module alias, or method dispatch through
-        # an ANNOTATED local/parameter (params shadow file-level
-        # imports, so the annotation is consulted first)
+        # typed receiver: ``self``, an annotated local/parameter, a
+        # typed ``self.attr`` — or any CHAIN of typed attribute hops
+        # (``param.attr.method()``, ``self.a.b.method()``): the closed
+        # PR 12 blind spot.  receiver_type walks the chain through the
+        # per-class attr_types maps.
+        tq = self.receiver_type(info, recv)
+        if tq is not None:
+            hit = self._method_in(tq, attr)
+            if hit is not None:
+                return hit
+            if not isinstance(recv, ast.Name) or recv.id == "self":
+                return None
+            # an annotated NAME that resolved to a class without the
+            # method still falls through to the module-alias shape
+            # below (an alias shadowing would be exotic, a silently
+            # dropped mod.fn edge is not)
+        # mod.fn(...) through a module alias
         if isinstance(recv, ast.Name):
-            ann = info.ann_types().get(recv.id)
-            if ann is not None:
-                cq = self._class_qual(ctx, _ann_name(ann))
-                if cq is not None:
-                    hit = self._method_in(cq, attr)
-                    if hit is not None:
-                        return hit
             target = self.aliases.get(ctx, {}).get(recv.id)
             if target is not None:
                 if f"{target}.{attr}" in self.functions:
                     return f"{target}.{attr}"
                 if f"{target}.{attr}" in self.classes:
                     return self._method_in(f"{target}.{attr}", "__init__")
+        return None
+
+    def receiver_type(self, info: FunctionInfo,
+                      expr: ast.AST) -> Optional[str]:
+        """Class qual of a receiver expression, walking attribute
+        chains through every typing shape the graph knows: ``self``
+        (the enclosing class), annotated parameters/locals, and typed
+        attributes (``self.attr = KnownClass(...)`` assignments or
+        annotations) — applied RECURSIVELY, so
+        ``param.attr.sub.method()`` resolves as long as every hop is
+        typed.  None on the first untyped hop (under-approximate, like
+        the rest of the graph)."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self":
+                return info.cls.qual if info.cls is not None else None
+            ann = info.ann_types().get(expr.id)
+            if ann is not None:
+                return self._class_qual(info.ctx, _ann_name(ann))
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = self.receiver_type(info, expr.value)
+            if base is None:
+                return None
+            return self._attr_type_in(base, expr.attr)
+        return None
+
+    def _attr_type_in(self, cqual: str, attr: str,
+                      _seen: Optional[Set[str]] = None) -> Optional[str]:
+        """``attr``'s inferred class on ``cqual`` or (single-level,
+        in-package) its bases — the attr_types mirror of
+        :meth:`_method_in`."""
+        seen = _seen or set()
+        if cqual in seen:
+            return None
+        seen.add(cqual)
+        cls = self.classes.get(cqual)
+        if cls is None:
+            return None
+        if attr in cls.attr_types:
+            return cls.attr_types[attr]
+        for b in cls.bases:
+            bq = self._class_qual(cls_ctx(self, cls), b)
+            if bq is not None:
+                hit = self._attr_type_in(bq, attr, seen)
+                if hit is not None:
+                    return hit
         return None
 
     # -- call-graph construction --------------------------------------------

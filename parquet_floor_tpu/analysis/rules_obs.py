@@ -8,11 +8,12 @@ fail anything at runtime: it silently splits one metric into two and
 every dashboard/report built on the real name goes quietly wrong.
 
 **FL-OBS001** fires when a call to ``trace.count`` / ``trace.gauge_max``
-/ ``trace.decision`` / ``trace.span`` / ``trace.add`` (or the same
-methods on a ``Tracer`` object — ``tracer.…`` / ``self._tracer.…``)
-passes a string *literal* name that is not registered for that kind in
-``trace.names``.  Dynamic names (variables, f-strings) are not checked —
-the rule guards the common literal case, not reflection.
+/ ``trace.decision`` / ``trace.span`` / ``trace.add`` /
+``trace.observe`` (or the same methods on a ``Tracer`` object —
+``tracer.…`` / ``self._tracer.…``) passes a string *literal* name
+that is not registered for that kind in ``trace.names``.  Dynamic
+names (variables, f-strings) are not checked — the rule guards the
+common literal case, not reflection.
 
 Scope: package code (``parquet_floor_tpu/``) except ``utils/trace.py``
 itself (the registry's home, and the one module allowed to manipulate
@@ -35,13 +36,15 @@ RULES = [
 ]
 
 # call attribute → (kind label, registered set).  span/add share the
-# stage namespace: add() is span accumulation without the timer.
+# stage namespace: add() is span accumulation without the timer;
+# observe() feeds the log-bucketed latency histograms (PR 14).
 _KINDS = {
     "count": ("counter", _names.COUNTERS),
     "gauge_max": ("gauge", _names.GAUGES),
     "decision": ("decision", _names.DECISIONS),
     "span": ("span stage", _names.SPANS),
     "add": ("span stage", _names.SPANS),
+    "observe": ("histogram", _names.HISTOGRAMS),
 }
 
 # receivers that mean "the trace module or a Tracer object"
@@ -67,14 +70,24 @@ def check(ctx: FileContext,
             continue
         if parts[-2] not in _RECEIVERS:
             continue
-        arg = node.args[0]
-        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
-            continue  # dynamic name: out of the rule's reach
-        kind, registered = _KINDS[parts[-1]]
-        if arg.value not in registered:
-            yield (
-                node.lineno,
-                "FL-OBS001",
-                f"unregistered {kind} name {arg.value!r} — register it in "
-                "trace.names (and docs/observability.md) or fix the typo",
-            )
+        checks = [(node.args[0], _KINDS[parts[-1]])]
+        if parts[-1] == "span":
+            # span(..., observe="name") records into a histogram on
+            # exit: that literal obeys the registry like any other
+            for kw in node.keywords:
+                if kw.arg == "observe":
+                    checks.append(
+                        (kw.value, ("histogram", _names.HISTOGRAMS))
+                    )
+        for arg, (kind, registered) in checks:
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue  # dynamic name: out of the rule's reach
+            if arg.value not in registered:
+                yield (
+                    node.lineno,
+                    "FL-OBS001",
+                    f"unregistered {kind} name {arg.value!r} — register "
+                    "it in trace.names (and docs/observability.md) or "
+                    "fix the typo",
+                )

@@ -368,6 +368,7 @@ class DataLoader:
         self._c0: Dict[str, int] = {}
         self._s0: Dict[str, dict] = {}
         self._gw: Optional[trace.GaugeWindow] = None
+        self._hw: Optional[trace.HistogramWindow] = None
         self._t_epoch: Optional[float] = None
 
     # -- construction-time metadata scan ------------------------------------
@@ -508,7 +509,8 @@ class DataLoader:
                 continue
             if self._gen is None:
                 self._start_epoch()
-            with self._tracer.span("data.next_batch"):
+            with self._tracer.span("data.next_batch",
+                                   observe="data.next_batch_seconds"):
                 try:
                     batch = next(self._gen)
                 except StopIteration:
@@ -538,7 +540,12 @@ class DataLoader:
         self._s0 = self._tracer.stats()
         if self._gw is not None:       # restore() mid-epoch: stale window
             self._gw.close()
+        if self._hw is not None:
+            self._hw.close()
         self._gw = self._tracer.gauge_window()
+        # latency distributions delta the same way gauges do: per-epoch
+        # windows observe the writes directly (docs/observability.md)
+        self._hw = self._tracer.histogram_window()
         self._t_epoch = time.perf_counter()
         u0, _off = plan.resume_point(
             self._batch_in_epoch, self._batch_size
@@ -588,11 +595,14 @@ class DataLoader:
         # not inherit epoch N-1's high-water marks
         gauges = self._gw.close() if self._gw is not None else {}
         self._gw = None
+        hists = self._hw.close() if self._hw is not None else {}
+        self._hw = None
         self._epoch_reports.append(trace.scan_report_from(
             _delta_stats(self._s0, self._tracer.stats()),
             _delta_counters(self._c0, self._tracer.counters()),
             gauges,
             wall_seconds=wall, budget_bytes=budget,
+            histograms={k: h.as_dict() for k, h in hists.items()},
         ))
         self._tracer.count("data.epochs_completed")
         self._epoch += 1
@@ -937,9 +947,12 @@ class DataLoader:
         if self._gen is not None:
             self._gen.close()
             self._gen = None
-        if self._gw is not None:       # abandoned epoch's gauge window
+        if self._gw is not None:       # abandoned epoch's windows
             self._gw.close()
             self._gw = None
+        if self._hw is not None:
+            self._hw.close()
+            self._hw = None
         self._epoch = epoch
         self._batch_in_epoch = batch
         self._widths = {
@@ -1036,6 +1049,9 @@ class DataLoader:
         if self._gw is not None:
             self._gw.close()
             self._gw = None
+        if self._hw is not None:
+            self._hw.close()
+            self._hw = None
 
     def __enter__(self):
         return self

@@ -462,8 +462,19 @@ class RemoteSource:
                         if other is not f and not other.done():
                             other.cancel()
                             trace.count("io.remote.hedges_cancelled")
+                    # range-fetch wall split by OUTCOME: the hedge-won
+                    # distribution shows what the duplicate bought
                     if hedged and f is futs[1]:
                         trace.count("io.remote.hedge_wins")
+                        trace.observe(
+                            "io.remote.get_seconds.hedge",
+                            self._clock() - t_start,
+                        )
+                    else:
+                        trace.observe(
+                            "io.remote.get_seconds.primary",
+                            self._clock() - t_start,
+                        )
                     return memoryview(data)
                 if not done and pending and not hedged and hd is not None \
                         and self._clock() - t_start >= hd:

@@ -139,10 +139,13 @@ class FileSource:
                 )
         if not ranges:
             return []
+        # storage-read latency split by source kind: this is the local
+        # file leg (io/remote.py observes the remote legs per outcome)
         with trace.span(
             "io.read", sum(n for _, n in ranges),
             attrs={"path": self.name, "ranges": len(ranges),
                    "offset": ranges[0][0]},
+            observe="io.read_seconds.file",
         ):
             return [self.read_at(o, n) for o, n in ranges]
 

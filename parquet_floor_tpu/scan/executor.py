@@ -743,41 +743,45 @@ class DatasetScanner:
                     loaded, time.perf_counter() - t0
                 )
             trace.count("scan.bytes_prefetched", loaded)
-            with trace.span(
-                "decode", work.plan.uncompressed_bytes, attrs=attrs
-            ):
-                if not self._salvage:
-                    read_filter = (
-                        self._decode_filter if self._mask_compact
-                        else self._filter
-                    )
-                    if work.plan.covered is not None:
-                        # page-pruned group (ScanOptions.page_prune):
-                        # decode exactly the covered pages — the cover is
-                        # already page-aligned, so read_row_group_ranges
-                        # reproduces it as a fixpoint
-                        batch, _cov = state.reader.read_row_group_ranges(
-                            work.plan.group_index, work.plan.covered,
-                            read_filter,
-                        )
-                    else:
-                        batch = state.reader.read_row_group(
-                            work.plan.group_index, read_filter
-                        )
-                    if self._mask_compact:
-                        batch = _pushdown_compact(
-                            batch, self._predicate, self._filter
-                        )
-                    return batch, None
-                # per-unit report: worker threads never touch a shared
-                # report; the consumer folds them in delivery order
-                unit_rep = SalvageReport()
-                batch = state.reader.read_row_group(
-                    work.plan.group_index, self._filter, report=unit_rep
-                )
-                return batch, unit_rep
+            return self._decode_unit(work, state, attrs)
         finally:
             state.cache.drop(work.plan.extents)
+
+    def _decode_unit(self, work: _Work, state, attrs):
+        with trace.span(
+            "decode", work.plan.uncompressed_bytes, attrs=attrs,
+            observe="scan.unit_decode_seconds",
+        ):
+            if not self._salvage:
+                read_filter = (
+                    self._decode_filter if self._mask_compact
+                    else self._filter
+                )
+                if work.plan.covered is not None:
+                    # page-pruned group (ScanOptions.page_prune):
+                    # decode exactly the covered pages — the cover is
+                    # already page-aligned, so read_row_group_ranges
+                    # reproduces it as a fixpoint
+                    batch, _cov = state.reader.read_row_group_ranges(
+                        work.plan.group_index, work.plan.covered,
+                        read_filter,
+                    )
+                else:
+                    batch = state.reader.read_row_group(
+                        work.plan.group_index, read_filter
+                    )
+                if self._mask_compact:
+                    batch = _pushdown_compact(
+                        batch, self._predicate, self._filter
+                    )
+                return batch, None
+            # per-unit report: worker threads never touch a shared
+            # report; the consumer folds them in delivery order
+            unit_rep = SalvageReport()
+            batch = state.reader.read_row_group(
+                work.plan.group_index, self._filter, report=unit_rep
+            )
+            return batch, unit_rep
 
     # -- scheduling (consumer thread) ---------------------------------------
 

@@ -19,6 +19,7 @@ stack (``docs/serving.md``):
 
 from .cache import CachedSource, SharedBufferCache, source_key
 from .lookup import Dataset
+from .slo import SloMonitor, SloStatus, SloTarget
 from .tenancy import Serving, Tenant
 
 __all__ = [
@@ -26,6 +27,9 @@ __all__ = [
     "Dataset",
     "Serving",
     "SharedBufferCache",
+    "SloMonitor",
+    "SloStatus",
+    "SloTarget",
     "Tenant",
     "source_key",
 ]

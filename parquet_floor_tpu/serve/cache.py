@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..utils import trace
@@ -335,7 +336,12 @@ class SharedBufferCache:
                     lo = o - entry.start
                     out[pos] = memoryview(entry.data)[lo : lo + n]
         for pos, fl, n in waits:
+            t0 = time.perf_counter()
             fl.event.wait()
+            trace.observe(
+                "serve.singleflight_wait_seconds",
+                time.perf_counter() - t0,
+            )
             if fl.error is not None:
                 raise fl.error
             out[pos] = memoryview(fl.result)[:n]

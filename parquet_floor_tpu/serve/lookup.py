@@ -263,8 +263,13 @@ class Dataset:
         )
         out: List[dict] = []
         done = False
+        # the span's wall IS the user-visible probe latency: observe=
+        # lands it in the tenant's histogram (inside ``ctx``, so a
+        # tenant= probe attributes to the tenant's tracer — the SLO
+        # monitor's input)
         with ctx, trace.span("serve.lookup",
-                             attrs={"key_column": self.key_column}):
+                             attrs={"key_column": self.key_column},
+                             observe="serve.lookup_seconds"):
             trace.count("serve.lookup_probes")
             filter_set = self._filter_set(columns)
             for i in range(len(self._sources)):
@@ -368,7 +373,8 @@ class Dataset:
         )
         out = AggPartial(aggregate)
         with ctx, trace.span("serve.aggregate",
-                             attrs={"aggs": len(aggregate.aggs)}):
+                             attrs={"aggs": len(aggregate.aggs)},
+                             observe="serve.aggregate_seconds"):
             trace.count("serve.aggregate_probes")
             for i in range(len(self._sources)):
                 lf = self._file(i)

@@ -1,0 +1,230 @@
+"""Per-tenant SLO monitoring: latency/error objectives over sliding
+windows with multi-window burn-rate alerting.
+
+A serving deployment does not page on "p99 was high for one second" —
+it pages when the **error budget** is burning fast enough that the
+monthly objective is in danger (the multi-window multi-burn-rate
+pattern; *The Tail at Scale* is why the objective is a tail quantile in
+the first place).  The pieces:
+
+* :class:`SloTarget` — the objective: a latency bound that at least
+  ``1 - latency_budget`` of requests must beat (``p99_seconds`` with
+  the default 1% budget), and an error-rate bound.
+* :class:`SloMonitor` — a ring of **cumulative** histogram snapshots
+  (the :class:`~parquet_floor_tpu.utils.histogram.LogHistogram` the
+  tenant tracers already record via ``Tracer.observe``).  A window's
+  traffic is the newest snapshot minus the one at the window's far
+  edge (``LogHistogram.subtract`` — the same increase() derivation a
+  Prometheus burn-rate query does), so feeding it is one cheap
+  ``observe_tenant`` call per tick, no per-request work.
+* Burn rate = (fraction of the window's requests over the bound) /
+  ``latency_budget``.  An alert needs BOTH the fast window (minutes —
+  is it happening now?) and the slow window (the hour — is it real,
+  not a blip?) burning past their thresholds, which is what keeps a
+  single slow request from paging and a sustained regression from
+  hiding.
+
+:meth:`Serving.check_slos <parquet_floor_tpu.serve.tenancy.Serving.
+check_slos>` drives monitors from the live tenant tracers and emits a
+registered ``serve.slo_breach`` decision ON THE BREACHING TENANT'S
+tracer; ``Serving.health()`` renders the one-page summary.  Clocks are
+injectable (``now=``) so the window math is deterministically testable.
+Docs: ``docs/serving.md`` / ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, Optional, Tuple
+
+from ..utils.histogram import LogHistogram
+
+
+@dataclass(frozen=True)
+class SloTarget:
+    """One tenant's objective.  ``p99_seconds`` is the latency bound
+    the ``1 - latency_budget`` quantile must beat (budget 0.01 = a p99
+    objective); ``error_rate`` bounds errors/requests over the same
+    windows.  The default burn thresholds and windows are the classic
+    page-worthy pair (14.4x over 5 min AND 6x over 1 h); tests and
+    smokes shrink the windows, not the math."""
+
+    p99_seconds: float
+    latency_budget: float = 0.01
+    error_rate: float = 0.01
+    fast_window_s: float = 300.0
+    slow_window_s: float = 3600.0
+    fast_burn: float = 14.4
+    slow_burn: float = 6.0
+
+    def __post_init__(self):
+        if self.p99_seconds <= 0:
+            raise ValueError(
+                f"p99_seconds must be > 0, got {self.p99_seconds}"
+            )
+        if not 0 < self.latency_budget < 1:
+            raise ValueError(
+                f"latency_budget must be in (0, 1), got "
+                f"{self.latency_budget}"
+            )
+        if self.fast_window_s <= 0 or self.slow_window_s < \
+                self.fast_window_s:
+            raise ValueError(
+                "windows must satisfy 0 < fast_window_s <= slow_window_s"
+            )
+
+
+@dataclass
+class SloStatus:
+    """One evaluation: burn rates per window, the fast window's
+    quantiles, and the breach verdicts."""
+
+    tenant: str
+    breach: bool
+    latency_breach: bool
+    error_breach: bool
+    fast_burn: float
+    slow_burn: float
+    fast_error_burn: float
+    slow_error_burn: float
+    p50_seconds: Optional[float]
+    p99_seconds: Optional[float]
+    samples: int                     # requests in the fast window
+    target: Optional[SloTarget] = field(repr=False, default=None)
+
+    def as_dict(self) -> dict:
+        return {
+            "tenant": self.tenant,
+            "breach": self.breach,
+            "latency_breach": self.latency_breach,
+            "error_breach": self.error_breach,
+            "fast_burn": round(self.fast_burn, 4),
+            "slow_burn": round(self.slow_burn, 4),
+            "fast_error_burn": round(self.fast_error_burn, 4),
+            "slow_error_burn": round(self.slow_error_burn, 4),
+            "p50_seconds": self.p50_seconds,
+            "p99_seconds": self.p99_seconds,
+            "samples": self.samples,
+        }
+
+    def render(self) -> str:
+        def ms(v):
+            return "n/a" if v is None else f"{v * 1e3:.2f} ms"
+
+        state = "BREACH" if self.breach else "ok"
+        return (
+            f"{state:<6} p50={ms(self.p50_seconds)} "
+            f"p99={ms(self.p99_seconds)} "
+            f"burn fast={self.fast_burn:.1f}x slow={self.slow_burn:.1f}x "
+            f"(n={self.samples})"
+        )
+
+
+class SloMonitor:
+    """Sliding-window burn-rate evaluator for ONE tenant (module
+    docstring).  Feed it cumulative latency histograms + cumulative
+    error/request counts via :meth:`observe`; read :meth:`evaluate`.
+    Thread-safe; snapshots older than the slow window (plus one edge
+    sample) are pruned."""
+
+    def __init__(self, tenant: str, target: SloTarget,
+                 histogram_name: str = "serve.lookup_seconds"):
+        self.tenant = tenant
+        self.target = target
+        self.histogram_name = histogram_name
+        self._lock = threading.Lock()
+        # (ts, cumulative latency hist, cumulative errors)
+        self._snaps: Deque[Tuple[float, LogHistogram, int]] = deque()
+
+    # -- feeding -------------------------------------------------------------
+
+    def observe(self, hist: Optional[LogHistogram], errors: int = 0,
+                now: Optional[float] = None) -> None:
+        """Record one CUMULATIVE snapshot (``hist`` may be None when the
+        tenant has no traffic yet — recorded as empty so windows still
+        advance)."""
+        ts = time.monotonic() if now is None else float(now)
+        h = hist.copy() if hist is not None else LogHistogram()
+        with self._lock:
+            self._snaps.append((ts, h, int(errors)))
+            horizon = ts - self.target.slow_window_s
+            # keep ONE sample at/past the horizon: it is the far edge
+            # the slow window subtracts against
+            while len(self._snaps) >= 2 and self._snaps[1][0] <= horizon:
+                self._snaps.popleft()
+
+    # -- the window math -----------------------------------------------------
+
+    def _window(self, window_s: float, now: float
+                ) -> Tuple[LogHistogram, int]:
+        """(latency increase, error increase) over the trailing
+        ``window_s`` — newest snapshot minus the newest snapshot at or
+        before the window's start (caller holds the lock)."""
+        newest_ts, newest_h, newest_e = self._snaps[-1]
+        edge = now - window_s
+        base_h, base_e = None, 0
+        for ts, h, e in self._snaps:
+            if ts <= edge:
+                base_h, base_e = h, e
+            else:
+                break
+        if base_h is None:
+            # whole history is inside the window: everything counts
+            return newest_h.copy(), newest_e
+        return newest_h.subtract(base_h), max(0, newest_e - base_e)
+
+    def evaluate(self, now: Optional[float] = None) -> SloStatus:
+        """Current :class:`SloStatus`.  With no snapshots (or an empty
+        window) the burn rates are 0 — absence of traffic is not a
+        breach."""
+        t = self.target
+        ts = time.monotonic() if now is None else float(now)
+        with self._lock:
+            if not self._snaps:
+                fast_h, fast_e = LogHistogram(), 0
+                slow_h, slow_e = LogHistogram(), 0
+            else:
+                fast_h, fast_e = self._window(t.fast_window_s, ts)
+                slow_h, slow_e = self._window(t.slow_window_s, ts)
+
+        def latency_burn(h: LogHistogram) -> float:
+            if not h.count:
+                return 0.0
+            frac = h.count_above(t.p99_seconds) / h.count
+            return frac / t.latency_budget
+
+        def error_burn(errors: int, h: LogHistogram) -> float:
+            requests = h.count + errors
+            if not requests or t.error_rate <= 0:
+                return 0.0
+            return (errors / requests) / t.error_rate
+
+        fb, sb = latency_burn(fast_h), latency_burn(slow_h)
+        feb, seb = error_burn(fast_e, fast_h), error_burn(slow_e, slow_h)
+        latency_breach = fb >= t.fast_burn and sb >= t.slow_burn
+        error_breach = feb >= t.fast_burn and seb >= t.slow_burn
+        return SloStatus(
+            tenant=self.tenant,
+            breach=latency_breach or error_breach,
+            latency_breach=latency_breach,
+            error_breach=error_breach,
+            fast_burn=fb, slow_burn=sb,
+            fast_error_burn=feb, slow_error_burn=seb,
+            p50_seconds=fast_h.percentile(50),
+            p99_seconds=fast_h.percentile(99),
+            samples=fast_h.count,
+            target=t,
+        )
+
+
+#: counters whose increase a tenant's monitor treats as request errors
+#: (storage gave up / the breaker refused) when deriving the error rate
+ERROR_COUNTERS = ("io.retry_exhausted", "io.remote.breaker_fast_fails")
+
+
+def tenant_errors(counters: Dict[str, int]) -> int:
+    """The cumulative error count a tenant's tracer counters imply."""
+    return sum(int(counters.get(k, 0)) for k in ERROR_COUNTERS)

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 from dataclasses import replace
 from typing import Dict, Optional, Sequence
 
@@ -80,6 +81,7 @@ class _FairGate:
                 self._grant(vtag, cost)
                 return
             trace.count("serve.fair_share_waits")
+            t_wait = time.perf_counter()
             ticket = [False]  # granted flag, mutated under the cv
             self._seq += 1
             heapq.heappush(self._heap, (vtag, self._seq, ticket, cost))
@@ -89,6 +91,13 @@ class _FairGate:
                     # wait() — it must be woken to see its ticket
                     self._cv.notify_all()
                 if ticket[0]:
+                    # grant-wait latency of the CONTENDED path (the
+                    # uncontended grant above is one lock round-trip and
+                    # would only bury the tail in zeros)
+                    trace.observe(
+                        "serve.fair_wait_seconds",
+                        time.perf_counter() - t_wait,
+                    )
                     return
                 self._cv.wait()
 
@@ -119,6 +128,18 @@ class _FairGate:
             self._inflight -= cost
             self._pump()
             self._cv.notify_all()
+
+    def stats(self) -> dict:
+        """One consistent snapshot of the gate — taken under the cv and
+        returned as plain data, so render paths (``Serving.health``)
+        never format while holding the gate lock (FL-LOCK002)."""
+        with self._cv:
+            return {
+                "capacity_bytes": self.capacity,
+                "inflight_bytes": self._inflight,
+                "waiters": len(self._heap),
+                "virtual_time": self._vtime,
+            }
 
 
 class _TenantShare:
@@ -281,6 +302,10 @@ class Serving:
         )
         self._lock = threading.Lock()
         self._tenants: Dict[str, Tenant] = {}
+        self._slos: Dict[str, "object"] = {}   # tenant name -> SloMonitor
+        # attach-time cumulative (histogram, errors) baselines: what
+        # check_slos subtracts so pre-monitoring traffic never breaches
+        self._slo_base: Dict[str, tuple] = {}
         self._closed = False
 
     def tenant(self, name: str, weight: float = 1.0) -> Tenant:
@@ -316,12 +341,171 @@ class Serving:
     def _share_bytes(self, weight: float) -> int:
         with self._lock:
             total_w = sum(t.weight for t in self._tenants.values())
+        return self._share_from_total(weight, total_w)
+
+    def _share_from_total(self, weight: float, total_w: float) -> int:
+        """The granted share given a pre-summed weight total — ONE
+        formula (1 MiB floor included) for admission and every render
+        path, so the health page can never disagree with the grant."""
         total_w = total_w or weight
         return max(1 << 20, int(self.prefetch_bytes * weight / total_w))
+
+    # -- SLO monitoring ------------------------------------------------------
+
+    def set_slo(self, name: str, target,
+                histogram_name: str = "serve.lookup_seconds"):
+        """Attach an :class:`~parquet_floor_tpu.serve.slo.SloTarget` to
+        tenant ``name`` (which must be registered); returns the
+        :class:`~parquet_floor_tpu.serve.slo.SloMonitor`.  Re-setting
+        replaces the monitor (fresh windows).  The tenant's CURRENT
+        cumulative histogram/error counters become the monitor's
+        baseline — only traffic AFTER the attach can breach (historic
+        slow probes from before monitoring was wanted must not fire a
+        page on the first tick)."""
+        from .slo import SloMonitor, tenant_errors
+
+        with self._lock:
+            tenant = self._tenants.get(name)
+            if tenant is None:
+                raise ValueError(f"tenant {name!r} is not registered")
+        # baseline snapshots come off the tenant tracer OUTSIDE the
+        # serving lock (its own lock suffices); captured BEFORE the
+        # monitor registers, so any racing traffic lands on the "new"
+        # side of the subtraction
+        base = (
+            tenant.tracer.histograms().get(histogram_name),
+            tenant_errors(tenant.tracer.counters()),
+        )
+        mon = SloMonitor(name, target, histogram_name=histogram_name)
+        with self._lock:
+            if name not in self._tenants:
+                raise ValueError(f"tenant {name!r} is not registered")
+            self._slos[name] = mon
+            self._slo_base[name] = base
+        return mon
+
+    def check_slos(self, now: Optional[float] = None) -> Dict[str, "object"]:
+        """One monitoring tick: snapshot every monitored tenant's
+        latency histogram + error counters into its monitor, evaluate,
+        and emit a registered ``serve.slo_breach`` decision ON THE
+        BREACHING TENANT'S tracer (so the alert is attributed exactly
+        like the metrics that caused it).  Returns tenant name →
+        :class:`~parquet_floor_tpu.serve.slo.SloStatus`."""
+        from .slo import tenant_errors
+
+        with self._lock:
+            monitored = [
+                (self._tenants[n], m, self._slo_base.get(n, (None, 0)))
+                for n, m in self._slos.items()
+                if n in self._tenants
+            ]
+        out: Dict[str, "object"] = {}
+        for tenant, mon, (base_hist, base_errors) in monitored:
+            hist = tenant.tracer.histograms().get(mon.histogram_name)
+            errors = tenant_errors(tenant.tracer.counters())
+            if base_hist is not None:
+                hist = (
+                    hist.subtract(base_hist) if hist is not None
+                    else None
+                )
+            errors = max(0, errors - base_errors)
+            mon.observe(hist, errors=errors, now=now)
+            status = mon.evaluate(now=now)
+            out[tenant.name] = status
+            if status.breach:
+                with trace.using(tenant.tracer):
+                    trace.decision("serve.slo_breach", {
+                        "tenant": tenant.name,
+                        "p99_ms": (
+                            None if status.p99_seconds is None
+                            else round(status.p99_seconds * 1e3, 3)
+                        ),
+                        "bound_ms": round(
+                            mon.target.p99_seconds * 1e3, 3
+                        ),
+                        "fast_burn": round(status.fast_burn, 2),
+                        "slow_burn": round(status.slow_burn, 2),
+                        "error_breach": status.error_breach,
+                    })
+        return out
+
+    def health(self, now: Optional[float] = None) -> str:
+        """The one-page serving summary: cache tiers, fair-gate
+        pressure, and per-tenant traffic / latency quantiles / SLO
+        state.  Runs a :meth:`check_slos` tick first, then renders.
+
+        Lock discipline (FL-LOCK002, pinned by test): every shared
+        structure is SNAPSHOTTED under its own lock into plain data —
+        tenant list under ``Serving._lock``, gate pressure via
+        ``_FairGate.stats()`` under the gate cv, tracer state under
+        each tracer's lock — and ALL formatting happens outside, so a
+        slow render can never stall admission or storage grants."""
+        statuses = self.check_slos(now=now)
+        with self._lock:
+            tenants = list(self._tenants.values())
+            total_w = sum(t.weight for t in tenants)
+        gate = self._gate.stats()            # snapshot under the cv
+        cache = self.cache.stats()           # snapshot under its lock
+        rows = []
+        for t in sorted(tenants, key=lambda t: t.name):
+            counters = t.tracer.counters()
+            hists = t.tracer.histograms()
+            hit = counters.get("serve.cache_hit_bytes", 0)
+            miss = counters.get("serve.cache_miss_bytes", 0)
+            rows.append({
+                "name": t.name,
+                "weight": t.weight,
+                # the REAL granted share (the admission formula, 1 MiB
+                # floor included) off the one weight total snapshotted
+                # above — no per-row lock round-trips
+                "share": self._share_from_total(t.weight, total_w),
+                "probes": counters.get("serve.lookup_probes", 0),
+                "hit_rate": (hit / (hit + miss)) if hit + miss else None,
+                "lookup": hists.get("serve.lookup_seconds"),
+                "fair_wait": hists.get("serve.fair_wait_seconds"),
+                "status": statuses.get(t.name),
+            })
+        # -- snapshots complete: pure formatting from here on --------------
+        lines = [
+            "serving health:",
+            (
+                f"  cache             {cache['hit_bytes']} B hit /"
+                f" {cache['miss_bytes']} B miss,"
+                f" {cache['data_bytes_used']} B data"
+                f" + {cache['meta_bytes_used']} B pinned,"
+                f" {cache['files']} file(s)"
+            ),
+            (
+                f"  fair gate         {gate['inflight_bytes']}/"
+                f"{gate['capacity_bytes']} B in flight,"
+                f" {gate['waiters']} waiter(s)"
+            ),
+        ]
+        if not rows:
+            lines.append("  (no tenants registered)")
+        for r in rows:
+            hr = ("n/a" if r["hit_rate"] is None
+                  else f"{r['hit_rate'] * 100:.1f}%")
+            lines.append(
+                f"  tenant {r['name']:<12} weight={r['weight']:g}"
+                f" share={int(r['share'])} B"
+                f" probes={r['probes']} hit-rate={hr}"
+            )
+            if r["lookup"] is not None:
+                lines.append(f"    lookup          {r['lookup'].render()}")
+            if r["fair_wait"] is not None:
+                lines.append(
+                    f"    fair wait       {r['fair_wait'].render()}"
+                )
+            if r["status"] is not None:
+                lines.append(f"    slo             {r['status'].render()}")
+        return "\n".join(lines)
 
     def _drop(self, name: str) -> None:
         with self._lock:
             self._tenants.pop(name, None)
+            self._slos.pop(name, None)
+            self._slo_base.pop(name, None)
 
     def close(self) -> None:
         """Close every tenant and (when owned) the cache; idempotent."""

@@ -2630,7 +2630,8 @@ class TpuRowGroupReader:
                          group_rows: int = 0, chunked=None,
                          compute=None) -> _StagedGroup:
         src = getattr(self.reader.source, "name", None)
-        with trace.span("stage", attrs={"file": src, "row_group": index}):
+        with trace.span("stage", attrs={"file": src, "row_group": index},
+                        observe="engine.stage_seconds"):
             sg = self._stage_row_group_untraced(
                 index, columns, covered, group_rows, chunked=chunked,
                 compute=compute,
@@ -2783,7 +2784,7 @@ class TpuRowGroupReader:
             # keeping the fused-program shape cache warm.  (If a finish()
             # below raises _ForceHost the shipped chunks are wasted — a
             # one-time cost per file, since forcing is sticky per column.)
-            with trace.span("ship", cap):
+            with trace.span("ship", cap, observe="engine.ship_seconds"):
                 plist = []
                 for s, e in arena_b.fill_chunks(
                     arena, _SHIP_CHUNK, self._fill_pool
@@ -2895,7 +2896,8 @@ class TpuRowGroupReader:
             ship.extend(sg.compute.masks)
         with trace.span("ship", sum(int(a.nbytes) for a in ship),
                         attrs={"file": sg.source,
-                               "row_group": sg.group_index}):
+                               "row_group": sg.group_index},
+                        observe="engine.ship_seconds"):
             shipped = jax.device_put(ship, self.device)
             if self.sync_transfers:
                 jax.block_until_ready(shipped)
@@ -2954,7 +2956,8 @@ class TpuRowGroupReader:
             )
         with trace.span("decode", attrs={"file": sg.source,
                                          "row_group": sg.group_index,
-                                         "rows": sg.num_rows}):
+                                         "rows": sg.num_rows},
+                        observe="engine.launch_seconds"):
             args = [*parts, slab_dev, *extra_args]
             if out_perm is not None:
                 perm = out_perm
@@ -3023,7 +3026,8 @@ class TpuRowGroupReader:
         def dispatch(cplan):
             with trace.span("decode", attrs={"file": sg.source,
                                              "row_group": sg.group_index,
-                                             "rows": sg.num_rows}):
+                                             "rows": sg.num_rows},
+                            observe="engine.launch_seconds"):
                 return _run_fused(
                     sg.program, len(parts), args, False,
                     device=self.device, cplan=cplan,

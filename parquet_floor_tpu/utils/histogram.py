@@ -1,0 +1,271 @@
+"""Mergeable log-bucketed histograms — the distribution half of the
+observability layer (``docs/observability.md``).
+
+The counter/gauge/span ``Tracer`` (PR 4) answers "how much" and "how
+long in total"; a serving tier living by tail-latency SLOs (*The Tail
+at Scale*, Dean & Barroso 2013) needs "what is the p99 **right now**"
+— a question only a distribution can answer.  :class:`LogHistogram`
+records values into exponentially-growing buckets whose boundaries are
+a pure function of the ``growth`` factor, so two histograms recorded
+anywhere (threads, tenants, processes, epochs) merge **associatively**
+by adding per-bucket counts — the same serialize/merge law
+:class:`~parquet_floor_tpu.utils.trace.ScanReport` established
+(``as_dict``/``from_dict``/``merge``), reused verbatim by the SLO
+monitor (``serve/slo.py``), the Prometheus exporter
+(``utils/metrics_export.py``), and the bench JSON.
+
+Accuracy: a value lands in the bucket ``(growth^(i-1), growth^i]``;
+:meth:`percentile` interpolates linearly inside the straddled bucket
+and clamps to the exact recorded min/max, so the relative error of any
+quantile is bounded by the bucket width (``growth - 1``, ~9% at the
+default ``2**(1/8)``) — pinned against numpy in
+``tests/test_histogram.py``.  Values ``<= 0`` (a clock that did not
+advance) go to a dedicated zero bucket and never touch ``log``.
+
+Instances are NOT thread-safe on their own: the
+:class:`~parquet_floor_tpu.utils.trace.Tracer` records into them under
+its lock (``Tracer.observe``), which is where concurrent writers meet.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+#: default bucket growth factor: 2**(1/8) ~= +9.05% per bucket, 8
+#: buckets per octave — sub-decibel quantile error at ~100 buckets
+#: across the ns..minutes latency range
+GROWTH = 2.0 ** 0.125
+
+
+class LogHistogram:
+    """One mergeable log-bucketed distribution (module docstring).
+
+    ``record`` is O(1); ``merge``/``percentile`` are O(buckets).  The
+    exact ``count``/``total``/``min``/``max`` ride along, so means and
+    extreme quantiles stay exact even though the interior is bucketed.
+    """
+
+    __slots__ = ("growth", "_lng", "count", "total", "min", "max",
+                 "zeros", "buckets")
+
+    def __init__(self, growth: float = GROWTH):
+        if growth <= 1.0:
+            raise ValueError(f"growth must be > 1, got {growth}")
+        self.growth = float(growth)
+        self._lng = math.log(self.growth)
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.zeros = 0                       # values <= 0
+        self.buckets: Dict[int, int] = {}    # bucket index -> count
+
+    # -- recording -----------------------------------------------------------
+
+    def record(self, value: float, n: int = 1) -> None:
+        """Add ``n`` observations of ``value``."""
+        v = float(value)
+        n = int(n)
+        if n <= 0:
+            return
+        self.count += n
+        self.total += v * n
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+        if v <= 0.0:
+            self.zeros += n
+            return
+        # bucket i holds (growth^(i-1), growth^i]: ceil of the log puts
+        # exact boundaries in the LOWER bucket, so bucket_hi(i) is an
+        # inclusive upper bound
+        i = math.ceil(math.log(v) / self._lng - 1e-9)
+        self.buckets[i] = self.buckets.get(i, 0) + n
+
+    # -- bucket geometry -----------------------------------------------------
+
+    def bucket_hi(self, i: int) -> float:
+        """Inclusive upper bound of bucket ``i`` (``growth ** i``)."""
+        return self.growth ** i
+
+    def bucket_lo(self, i: int) -> float:
+        return self.growth ** (i - 1)
+
+    # -- quantiles -----------------------------------------------------------
+
+    def percentile(self, p: float) -> Optional[float]:
+        """The ``p``-th percentile (0..100), or None when empty.
+        Linear interpolation inside the straddled bucket, clamped to
+        the exact recorded min/max."""
+        if self.count == 0:
+            return None
+        if not 0.0 <= p <= 100.0:
+            raise ValueError(f"percentile wants 0..100, got {p}")
+        target = (p / 100.0) * self.count
+        seen = float(self.zeros)
+        if self.zeros and target <= seen:
+            # the rank falls inside the zero bucket (values <= 0)
+            return min(0.0, self.min)
+        for i in sorted(self.buckets):
+            c = self.buckets[i]
+            if seen + c >= target:
+                lo, hi = self.bucket_lo(i), self.bucket_hi(i)
+                frac = (target - seen) / c
+                v = lo + (hi - lo) * frac
+                if self.min is not None:
+                    v = max(v, self.min)
+                if self.max is not None:
+                    v = min(v, self.max)
+                return v
+            seen += c
+        return self.max
+
+    def count_above(self, threshold: float) -> int:
+        """How many recorded values exceed ``threshold`` — the SLO
+        monitor's violation count.  Values inside the straddled bucket
+        are apportioned linearly (consistent with :meth:`percentile`)."""
+        t = float(threshold)
+        if self.count == 0:
+            return 0
+        if t < 0.0 or (self.max is not None and t >= self.max):
+            # above-the-max is exact; below zero everything qualifies
+            return self.count if t < 0.0 else 0
+        above = 0.0
+        for i, c in self.buckets.items():
+            lo, hi = self.bucket_lo(i), self.bucket_hi(i)
+            if t < lo:
+                above += c
+            elif t < hi:
+                above += c * (hi - t) / (hi - lo)
+        return min(self.count, int(round(above)))
+
+    @property
+    def mean(self) -> Optional[float]:
+        return (self.total / self.count) if self.count else None
+
+    # -- serialize / merge (the ScanReport law) ------------------------------
+
+    def as_dict(self) -> dict:
+        """JSON-ready form; ``from_dict`` round-trips it exactly."""
+        return {
+            "growth": self.growth,
+            "count": self.count,
+            "sum": self.total,
+            "min": self.min,
+            "max": self.max,
+            "zeros": self.zeros,
+            # JSON objects key by string; indexes may be negative
+            "buckets": {str(i): c for i, c in sorted(self.buckets.items())},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LogHistogram":
+        h = cls(growth=float(d.get("growth", GROWTH)))
+        h.count = int(d.get("count", 0))
+        h.total = float(d.get("sum", 0.0))
+        h.min = None if d.get("min") is None else float(d["min"])
+        h.max = None if d.get("max") is None else float(d["max"])
+        h.zeros = int(d.get("zeros", 0))
+        h.buckets = {int(i): int(c)
+                     for i, c in (d.get("buckets") or {}).items()}
+        return h
+
+    def copy(self) -> "LogHistogram":
+        h = LogHistogram(growth=self.growth)
+        h.count, h.total = self.count, self.total
+        h.min, h.max, h.zeros = self.min, self.max, self.zeros
+        h.buckets = dict(self.buckets)
+        return h
+
+    def merge_in(self, other: "LogHistogram") -> "LogHistogram":
+        """Fold ``other`` into self (additive, associative,
+        commutative).  Mismatched growth factors cannot share buckets
+        and are rejected rather than silently skewed."""
+        if abs(other.growth - self.growth) > 1e-12:
+            raise ValueError(
+                f"cannot merge histograms with growth {other.growth} "
+                f"into {self.growth}"
+            )
+        self.count += other.count
+        self.total += other.total
+        self.zeros += other.zeros
+        if other.min is not None and (self.min is None
+                                      or other.min < self.min):
+            self.min = other.min
+        if other.max is not None and (self.max is None
+                                      or other.max > self.max):
+            self.max = other.max
+        for i, c in other.buckets.items():
+            self.buckets[i] = self.buckets.get(i, 0) + c
+        return self
+
+    @classmethod
+    def merge(cls, hists: Sequence["LogHistogram"]) -> "LogHistogram":
+        """Fold many histograms into one — the cross-process /
+        cross-tenant aggregation face, associative like
+        ``ScanReport.merge``."""
+        hists = list(hists)
+        if not hists:
+            raise ValueError("LogHistogram.merge needs at least one")
+        out = hists[0].copy()
+        for h in hists[1:]:
+            out.merge_in(h)
+        return out
+
+    @classmethod
+    def fold_dicts(cls, into: Dict[str, "LogHistogram"],
+                   items: Dict[str, dict]) -> Dict[str, "LogHistogram"]:
+        """Fold a name→``as_dict`` mapping into live histograms — THE
+        one implementation of the serialized-merge law, shared by
+        ``ScanReport.merge`` and ``metrics_export.merge_snapshots`` so
+        the two aggregation paths can never diverge."""
+        for k, d in (items or {}).items():
+            h = cls.from_dict(d)
+            if k in into:
+                into[k].merge_in(h)
+            else:
+                into[k] = h
+        return into
+
+    def subtract(self, earlier: "LogHistogram") -> "LogHistogram":
+        """The increase since ``earlier`` (an older snapshot of the SAME
+        cumulative histogram) — the windowed-delta face the SLO monitor
+        evaluates over.  A tracer reset between snapshots (total count
+        went DOWN) degrades to "everything is new" — the whole current
+        histogram — never to a blind window of clamped zeros."""
+        if self.count < earlier.count:
+            return self.copy()
+        out = LogHistogram(growth=self.growth)
+        out.count = max(0, self.count - earlier.count)
+        out.total = max(0.0, self.total - earlier.total)
+        out.zeros = max(0, self.zeros - earlier.zeros)
+        for i, c in self.buckets.items():
+            d = c - earlier.buckets.get(i, 0)
+            if d > 0:
+                out.buckets[i] = d
+        if out.count:
+            # a delta cannot recover the window's exact extremes; the
+            # cumulative ones are conservative bounds
+            out.min, out.max = self.min, self.max
+        return out
+
+    def render(self, unit: str = "s") -> str:
+        """One compact human line: count, mean, p50/p90/p99, max."""
+        if not self.count:
+            return "(empty)"
+
+        def fmt(v):
+            return "n/a" if v is None else (
+                f"{v * 1e3:.2f} ms" if unit == "s" else f"{v:.4g}{unit}"
+            )
+
+        return (
+            f"n={self.count} mean={fmt(self.mean)} "
+            f"p50={fmt(self.percentile(50))} p90={fmt(self.percentile(90))} "
+            f"p99={fmt(self.percentile(99))} max={fmt(self.max)}"
+        )
+
+    def __repr__(self) -> str:
+        return f"LogHistogram({self.render()})"

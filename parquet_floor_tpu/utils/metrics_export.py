@@ -1,0 +1,299 @@
+"""Live metrics export: Prometheus text exposition + JSON snapshots.
+
+The :class:`~parquet_floor_tpu.utils.trace.Tracer` keeps everything a
+deployment wants to scrape — additive counters, high-water gauges,
+per-stage walls, and the log-bucketed latency histograms — but until
+now the only ways out were in-process snapshots and one-shot file
+exports.  This module is the always-on face (*Dapper*'s "observability
+must not require redeploying" rule):
+
+* :func:`render_prometheus` — the text exposition format (version
+  0.0.4) scrapers speak: counters as ``counter``, gauges as ``gauge``,
+  stage stats as labelled counters, and each
+  :class:`~parquet_floor_tpu.utils.histogram.LogHistogram` as a native
+  Prometheus histogram (cumulative ``_bucket{le=…}`` series + ``_sum``
+  + ``_count``) using the log-bucket upper bounds as ``le`` edges.
+* :func:`snapshot` / :func:`merge_snapshots` — the JSON form and its
+  cross-process fold, the same additive/max/bucket-wise law
+  ``ScanReport.merge`` established (per-worker processes emit
+  snapshots; an aggregator merges and re-renders).
+* :class:`MetricsServer` — a stdlib ``ThreadingHTTPServer`` behind
+  ``trace.serve_metrics(port)``: ``/metrics`` (Prometheus) and
+  ``/metrics.json``.
+* :class:`FileMetricsEmitter` — a periodic file writer (atomic rename)
+  for scrape-less runs: batch jobs land their final metrics on disk
+  even when nothing ever polls them.
+
+Everything is stdlib-only.  Docs: ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Sequence
+
+from .histogram import LogHistogram
+
+#: every exported series name is prefixed, so a shared Prometheus has
+#: one obvious namespace to query
+PREFIX = "pftpu_"
+
+_SAN = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    """Registry name → Prometheus metric name (dots become
+    underscores; the kind suffixes survive as plain segments)."""
+    return PREFIX + _SAN.sub("_", name)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers render bare, floats as
+    repr-round-trippable decimals."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int) or float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+# ---------------------------------------------------------------------------
+# snapshots (the serializable form everything else derives from)
+# ---------------------------------------------------------------------------
+
+def snapshot(tracer) -> dict:
+    """One JSON-ready snapshot of a tracer: counters, gauges, stage
+    stats, histograms (``LogHistogram.as_dict`` form)."""
+    return {
+        "counters": tracer.counters(),
+        "gauges": tracer.gauges(),
+        "stages": tracer.stats(),
+        "histograms": tracer.histograms_dict(),
+    }
+
+
+def merge_snapshots(snaps: Sequence[dict]) -> dict:
+    """Fold per-process :func:`snapshot` dicts into one — counters and
+    stage stats sum, gauges take the max, histograms merge bucket-wise
+    (the ``ScanReport.merge`` aggregation law, reused)."""
+    snaps = list(snaps)
+    if not snaps:
+        raise ValueError("merge_snapshots needs at least one snapshot")
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, int] = {}
+    stages: Dict[str, dict] = {}
+    hists: Dict[str, LogHistogram] = {}
+    for s in snaps:
+        for k, v in (s.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + int(v)
+        for k, v in (s.get("gauges") or {}).items():
+            gauges[k] = max(gauges.get(k, -(1 << 62)), int(v))
+        for k, st in (s.get("stages") or {}).items():
+            acc = stages.setdefault(
+                k, {"count": 0, "seconds": 0.0, "bytes": 0,
+                    "self_seconds": 0.0},
+            )
+            acc["count"] += int(st.get("count", 0))
+            acc["seconds"] += float(st.get("seconds", 0.0))
+            acc["bytes"] += int(st.get("bytes", 0))
+            acc["self_seconds"] += float(
+                st.get("self_seconds", st.get("seconds", 0.0))
+            )
+        LogHistogram.fold_dicts(hists, s.get("histograms") or {})
+    return {
+        "counters": counters,
+        "gauges": gauges,
+        "stages": stages,
+        "histograms": {k: h.as_dict() for k, h in hists.items()},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+def render_prometheus_snapshot(snap: dict) -> str:
+    """Render one :func:`snapshot`-shaped dict as text exposition."""
+    lines = []
+    for name, v in sorted((snap.get("counters") or {}).items()):
+        m = sanitize(name)
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_fmt(v)}")
+    for name, v in sorted((snap.get("gauges") or {}).items()):
+        m = sanitize(name)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {_fmt(v)}")
+    stages = snap.get("stages") or {}
+    if stages:
+        for series, key in (
+            ("stage_count", "count"),
+            ("stage_seconds_total", "seconds"),
+            ("stage_bytes_total", "bytes"),
+        ):
+            m = PREFIX + series
+            lines.append(f"# TYPE {m} counter")
+            for stage, st in sorted(stages.items()):
+                lines.append(
+                    f'{m}{{stage="{stage}"}} {_fmt(st.get(key, 0))}'
+                )
+    for name, d in sorted((snap.get("histograms") or {}).items()):
+        h = LogHistogram.from_dict(d)
+        m = sanitize(name)
+        lines.append(f"# TYPE {m} histogram")
+        cum = h.zeros
+        if h.zeros:
+            lines.append(f'{m}_bucket{{le="0"}} {h.zeros}')
+        for i in sorted(h.buckets):
+            cum += h.buckets[i]
+            lines.append(
+                f'{m}_bucket{{le="{h.bucket_hi(i):.9g}"}} {cum}'
+            )
+        lines.append(f'{m}_bucket{{le="+Inf"}} {h.count}')
+        lines.append(f"{m}_sum {_fmt(h.total)}")
+        lines.append(f"{m}_count {h.count}")
+    return "\n".join(lines) + "\n"
+
+
+def render_prometheus(tracer) -> str:
+    """Text exposition (version 0.0.4) of one tracer's live state."""
+    return render_prometheus_snapshot(snapshot(tracer))
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Tiny stdlib parser of the exposition format: sample name (with
+    its ``{labels}`` verbatim) → value.  Enough for round-trip tests
+    and the CI scrape validation — not a general client."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name, value = line.rsplit(None, 1)
+        except ValueError as e:
+            raise ValueError(f"bad exposition line {line!r}") from e
+        out[name] = float(value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the live endpoint
+# ---------------------------------------------------------------------------
+
+class MetricsServer:
+    """``ThreadingHTTPServer`` over one tracer — created via
+    ``trace.serve_metrics(port)``.  Binds at construction (``port=0``
+    picks an ephemeral one, read it back from ``.port``), serves on a
+    daemon thread, stops on :meth:`close` (idempotent; also a context
+    manager)."""
+
+    def __init__(self, tracer, port: int = 0, host: str = "127.0.0.1"):
+        self.tracer = tracer
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):       # noqa: N802 (http.server contract)
+                if self.path.split("?")[0] == "/metrics":
+                    body = render_prometheus(outer.tracer).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?")[0] == "/metrics.json":
+                    body = json.dumps(snapshot(outer.tracer)).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # scrapes are not stdout news
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"pftpu-metrics:{self.port}", daemon=True,
+        )
+        self._thread.start()
+        self._closed = False
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class FileMetricsEmitter:
+    """Periodic exposition-to-file writer for scrape-less runs: every
+    ``interval_s`` (and once on :meth:`close`) the tracer's Prometheus
+    text lands at ``path`` via write-to-temp + atomic rename, so a
+    reader never sees a torn file.  Daemon thread; context manager."""
+
+    def __init__(self, tracer, path: str, interval_s: float = 15.0):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.tracer = tracer
+        self.path = str(path)
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="pftpu-metrics-emitter", daemon=True,
+        )
+        self._thread.start()
+
+    def emit(self) -> None:
+        """Write one snapshot now (atomic rename).  The temp name is
+        unique PER CALL (mkstemp), so even a close() racing a stalled
+        loop-thread emit can never interleave writes into one file —
+        the never-torn guarantee holds unconditionally."""
+        import tempfile
+
+        d, base = os.path.split(self.path)
+        fd, tmp = tempfile.mkstemp(dir=d or ".", prefix=base + ".tmp.")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                fh.write(render_prometheus(self.tracer))
+            os.replace(tmp, self.path)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            raise
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.emit()
+
+    def close(self) -> None:
+        """Stop the thread and write the final snapshot; idempotent."""
+        if not self._stop.is_set():
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self.emit()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

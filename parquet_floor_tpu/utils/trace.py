@@ -12,7 +12,7 @@ TPU engine worker pools bind each task to the scope that submitted it
 (``Tracer.run``), so two concurrent ``DatasetScanner``\\ s or device
 scans get correctly attributed, non-interfering metrics.
 
-Four layers, all zero-cost when the active tracer is disabled (the no-op
+Five layers, all zero-cost when the active tracer is disabled (the no-op
 path allocates nothing and takes no lock):
 
 * ``span(stage, nbytes, attrs)`` — context manager accumulating wall
@@ -23,15 +23,23 @@ path allocates nothing and takes no lock):
 * ``count(name, n)`` / ``gauge_max(name, v)`` — additive integer
   counters and high-water gauges; snapshots are namespaced
   (``counters()`` / ``gauges()``, merged compat view in ``metrics()``).
+* ``observe(name, seconds)`` — log-bucketed latency/size distributions
+  (:class:`~parquet_floor_tpu.utils.histogram.LogHistogram`):
+  mergeable across threads/tenants/processes, the substrate under
+  per-tenant p99s, the SLO monitor (``serve/slo.py``), and the
+  Prometheus exporter (``utils/metrics_export.py`` /
+  :func:`serve_metrics`).
 * ``decision(name, detail)`` — bounded log of routing/policy decisions
   (cap configurable per tracer; evictions bump
   ``trace.decisions_dropped`` — no silent caps), mirrored as instant
   events on the timeline.
 * ``export_chrome_trace(path)`` — the timeline as Chrome/Perfetto
   trace-event JSON, so the host-side read‖stage‖ship‖decode overlap is
-  visible next to ``device_trace``'s XLA capture; ``scan_report()``
-  distills the same snapshot into a :class:`ScanReport` health summary,
-  and ``report()`` renders everything for humans.
+  visible next to ``device_trace``'s XLA capture — and
+  :func:`unified_trace` merges BOTH captures onto one rebased clock in
+  a single Perfetto file; ``scan_report()`` distills the same snapshot
+  into a :class:`ScanReport` health summary, and ``report()`` renders
+  everything for humans.
 
 Metric names used by the package are registered in :class:`names`;
 floorlint rule FL-OBS001 rejects unregistered literals (typo'd metric
@@ -49,6 +57,8 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence
+
+from .histogram import LogHistogram
 
 
 class names:
@@ -180,6 +190,8 @@ class names:
         "write.engine",
         "compact.plan",
         "compact.unit_dropped",
+        # the per-tenant SLO monitor (serve/slo.py, docs/serving.md)
+        "serve.slo_breach",
     })
     SPANS = frozenset({
         "read",
@@ -198,7 +210,30 @@ class names:
         "write.encode",
         "write.emit",
     })
-    ALL = COUNTERS | GAUGES | DECISIONS | SPANS
+    # latency/size distributions (Tracer.observe -> LogHistogram;
+    # docs/observability.md).  Values are SECONDS unless the name says
+    # otherwise; the ``.kind`` suffixes split one metric by a static
+    # outcome (source kind, hedge outcome) without dynamic names.
+    HISTOGRAMS = frozenset({
+        # the serving face, per-tenant through the scoped tracers
+        "serve.lookup_seconds",          # one lookup()/range() probe wall
+        "serve.aggregate_seconds",       # one aggregate() query wall
+        "serve.fair_wait_seconds",       # WFQ gate grant wait (contended)
+        "serve.singleflight_wait_seconds",  # wait on another's in-flight read
+        # storage read latency, split by source kind and hedge outcome
+        "io.read_seconds.file",          # FileSource vectored read wall
+        "io.remote.get_seconds.primary",    # remote fetch, primary won
+        "io.remote.get_seconds.hedge",      # remote fetch, hedge won
+        # the decode pipeline's stage walls
+        "scan.unit_decode_seconds",      # one scan unit's host decode wall
+        "engine.stage_seconds",          # one group's host staging wall
+        "engine.ship_seconds",           # one H2D transfer wall
+        "engine.launch_seconds",         # one fused decode dispatch wall
+        # the training loader and the write path
+        "data.next_batch_seconds",       # one loader next() wall
+        "write.emit_seconds",            # one group's ordered sink emission
+    })
+    ALL = COUNTERS | GAUGES | DECISIONS | SPANS | HISTOGRAMS
 
 
 @dataclass
@@ -250,16 +285,21 @@ _NULL_SPAN = _NullSpan()
 class _Span:
     """One live timed span: records a begin event on ``__enter__`` and a
     matching end event + stage accumulation on ``__exit__`` (same thread
-    by construction — it is a ``with`` block)."""
+    by construction — it is a ``with`` block).  With ``observe`` set,
+    the exit also records the span's wall into that histogram — ONE
+    clock read serves both, so stage seconds and histogram samples are
+    definitionally identical."""
 
-    __slots__ = ("_tracer", "_stage", "_nbytes", "_attrs", "_t0")
+    __slots__ = ("_tracer", "_stage", "_nbytes", "_attrs", "_t0",
+                 "_observe")
 
     def __init__(self, tracer: "Tracer", stage: str, nbytes: int,
-                 attrs: Optional[dict]):
+                 attrs: Optional[dict], observe: Optional[str] = None):
         self._tracer = tracer
         self._stage = stage
         self._nbytes = nbytes
         self._attrs = attrs
+        self._observe = observe
 
     def add_bytes(self, n: int) -> None:
         """Attribute ``n`` more bytes to this span (for byte counts only
@@ -287,6 +327,8 @@ class _Span:
         self._tracer.add(
             self._stage, dur, self._nbytes, self_seconds=dur - child
         )
+        if self._observe is not None:
+            self._tracer.observe(self._observe, dur)
         self._tracer._event("E", self._stage, t1, None)
         return False
 
@@ -320,6 +362,9 @@ class ScanReport:
     events_dropped: int
     counters: Dict[str, int] = field(default_factory=dict)
     gauges: Dict[str, int] = field(default_factory=dict)
+    #: latency/size distributions in ``LogHistogram.as_dict`` form —
+    #: serializable like everything else here, merged bucket-wise
+    histograms: Dict[str, dict] = field(default_factory=dict)
 
     def as_dict(self) -> dict:
         out = {
@@ -346,8 +391,16 @@ class ScanReport:
             "events_dropped": self.events_dropped,
             "counters": dict(self.counters),
             "gauges": dict(self.gauges),
+            "histograms": {k: dict(v) for k, v in self.histograms.items()},
         }
         return out
+
+    def histogram(self, name: str) -> Optional[LogHistogram]:
+        """The named distribution as a live :class:`LogHistogram`, or
+        None — the convenient face over the serialized field
+        (``report.histogram("serve.lookup_seconds").percentile(99)``)."""
+        d = self.histograms.get(name)
+        return None if d is None else LogHistogram.from_dict(d)
 
     def render(self) -> str:
         lines = ["scan health:"]
@@ -425,6 +478,7 @@ class ScanReport:
         kwargs["stages"] = dict(kwargs["stages"] or {})
         kwargs["counters"] = dict(kwargs["counters"] or {})
         kwargs["gauges"] = dict(kwargs["gauges"] or {})
+        kwargs["histograms"] = dict(kwargs["histograms"] or {})
         return cls(**kwargs)
 
     @classmethod
@@ -469,11 +523,13 @@ class ScanReport:
             )
         counters: Dict[str, int] = {}
         gauges: Dict[str, int] = {}
+        hists: Dict[str, LogHistogram] = {}
         for r in reports:
             for k, v in r.counters.items():
                 counters[k] = counters.get(k, 0) + int(v)
             for k, v in r.gauges.items():
                 gauges[k] = max(gauges.get(k, -(1 << 62)), int(v))
+            LogHistogram.fold_dicts(hists, r.histograms)
         walls = [r.wall_seconds for r in reports if r.wall_seconds is not None]
         wall = max(walls) if walls else None
         wall_sum = sum(walls)
@@ -513,13 +569,16 @@ class ScanReport:
             events_dropped=sum(r.events_dropped for r in reports),
             counters=counters,
             gauges=gauges,
+            histograms={k: h.as_dict() for k, h in hists.items()},
         )
 
 
 def scan_report_from(stats: Dict[str, dict], counters: Dict[str, int],
                      gauges: Dict[str, int],
                      wall_seconds: Optional[float] = None,
-                     budget_bytes: Optional[int] = None) -> ScanReport:
+                     budget_bytes: Optional[int] = None,
+                     histograms: Optional[Dict[str, dict]] = None
+                     ) -> ScanReport:
     """Build a :class:`ScanReport` from explicit snapshots — the shared
     derivation behind :meth:`Tracer.scan_report`, also usable on DELTA
     snapshots (the loader's per-epoch reports subtract an epoch-start
@@ -557,6 +616,7 @@ def scan_report_from(stats: Dict[str, dict], counters: Dict[str, int],
         events_dropped=counters.get("trace.events_dropped", 0),
         counters=counters,
         gauges=gauges,
+        histograms=dict(histograms or {}),
     )
 
 
@@ -584,6 +644,33 @@ class GaugeWindow:
             return dict(self._gauges)
 
 
+class HistogramWindow:
+    """A per-interval view of a tracer's histograms (see
+    :meth:`Tracer.histogram_window`), the :class:`GaugeWindow` shape
+    applied to distributions: records only the ``observe()`` writes made
+    while open, under the tracer's own lock, so worker threads carried
+    by :meth:`Tracer.run` land in the window too.  Per-epoch/per-scan
+    latency deltas fall out without subtracting cumulative snapshots."""
+
+    def __init__(self, tracer: "Tracer"):
+        self._tracer = tracer
+        self._hists: Dict[str, LogHistogram] = {}
+
+    def histograms(self) -> Dict[str, LogHistogram]:
+        """Snapshot (copies) of the distributions recorded while this
+        window was open."""
+        with self._tracer._lock:
+            return {k: h.copy() for k, h in self._hists.items()}
+
+    def close(self) -> Dict[str, LogHistogram]:
+        """Detach from the tracer and return the window's histograms;
+        idempotent."""
+        with self._tracer._lock:
+            if self in self._tracer._hwindows:
+                self._tracer._hwindows.remove(self)
+            return {k: h.copy() for k, h in self._hists.items()}
+
+
 class Tracer:
     """One isolated metrics/timeline store.  Thread-safe; every method is
     a no-op while disabled.  ``max_decisions``/``max_events`` bound the
@@ -605,7 +692,9 @@ class Tracer:
         self._stats: Dict[str, StageStat] = {}
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, int] = {}
+        self._hists: Dict[str, LogHistogram] = {}
         self._windows: List["GaugeWindow"] = []
+        self._hwindows: List["HistogramWindow"] = []
         self._decisions: deque = deque()
         self._events: deque = deque()   # (ph, name, ts, tid, attrs)
         self._thread_names: Dict[int, str] = {}
@@ -627,8 +716,11 @@ class Tracer:
             self._stats.clear()
             self._counters.clear()
             self._gauges.clear()
+            self._hists.clear()
             for w in self._windows:
                 w._gauges.clear()
+            for hw in self._hwindows:
+                hw._hists.clear()
             self._decisions.clear()
             self._events.clear()
             self._thread_names.clear()
@@ -694,6 +786,51 @@ class Tracer:
         w = GaugeWindow(self)
         with self._lock:
             self._windows.append(w)
+        return w
+
+    # -- histograms ---------------------------------------------------------
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample into the log-bucketed distribution
+        ``name`` (seconds for the latency histograms in
+        :class:`names`.HISTOGRAMS).  No-op when disabled — the hot path
+        allocates nothing and takes no lock, same discipline as
+        :meth:`count`."""
+        if not self._enabled:
+            return
+        v = float(value)
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = LogHistogram()
+            h.record(v)
+            for w in self._hwindows:
+                wh = w._hists.get(name)
+                if wh is None:
+                    wh = w._hists[name] = LogHistogram()
+                wh.record(v)
+
+    def histograms(self) -> Dict[str, LogHistogram]:
+        """Snapshot (copies) of every recorded distribution."""
+        with self._lock:
+            return {k: h.copy() for k, h in self._hists.items()}
+
+    def histograms_dict(self) -> Dict[str, dict]:
+        """The histograms in their serializable ``as_dict`` form — what
+        :class:`ScanReport` carries and the exporters merge."""
+        with self._lock:
+            return {k: h.as_dict() for k, h in self._hists.items()}
+
+    def histogram_window(self) -> "HistogramWindow":
+        """Open a windowed view of the distributions: the returned
+        :class:`HistogramWindow` records only ``observe`` writes made
+        while it is open (the :meth:`gauge_window` shape — cumulative
+        distributions delta awkwardly; per-interval reporters observe
+        the writes directly).  Close with
+        :meth:`HistogramWindow.close`."""
+        w = HistogramWindow(self)
+        with self._lock:
+            self._hwindows.append(w)
         return w
 
     def metrics(self) -> Dict[str, int]:
@@ -764,13 +901,17 @@ class Tracer:
             st.self_seconds += self_seconds
 
     def span(self, stage: str, nbytes: int = 0,
-             attrs: Optional[dict] = None):
+             attrs: Optional[dict] = None,
+             observe: Optional[str] = None):
         """One timed span under ``stage``: accumulates into
         :meth:`stats` and appends begin/end events (thread id + ``attrs``)
-        to the timeline.  Returns the shared no-op span when disabled."""
+        to the timeline.  ``observe`` additionally records the span's
+        wall into the named histogram on exit (FL-OBS001 checks the
+        name against :class:`names`.HISTOGRAMS like any other literal).
+        Returns the shared no-op span when disabled."""
         if not self._enabled:
             return _NULL_SPAN
-        return _Span(self, stage, nbytes, attrs)
+        return _Span(self, stage, nbytes, attrs, observe)
 
     def stats(self) -> Dict[str, dict]:
         """Snapshot of all stage accumulators."""
@@ -818,6 +959,17 @@ class Tracer:
         are dropped, and spans still open at export get a synthetic end
         at the last seen timestamp — a Perfetto load never sees a
         mismatched stack."""
+        out = self.chrome_events()
+        payload = {"traceEvents": out, "displayTimeUnit": "ms"}
+        with open(path, "w") as fh:
+            fh.write(json.dumps(payload))
+        return len(out)
+
+    def chrome_events(self) -> List[dict]:
+        """The balanced, ts-sorted Chrome trace-event dicts of the
+        host timeline (``ts`` in µs since the tracer epoch) — the
+        shared derivation behind :meth:`export_chrome_trace` and the
+        merged host+device export (:func:`unified_trace`)."""
         with self._lock:
             events = list(self._events)
             tnames = dict(self._thread_names)
@@ -861,10 +1013,7 @@ class Tracer:
                     "name": name, "ph": "E", "ts": end_us,
                     "pid": pid, "tid": tid,
                 })
-        payload = {"traceEvents": out, "displayTimeUnit": "ms"}
-        with open(path, "w") as fh:
-            fh.write(json.dumps(payload))
-        return len(out)
+        return out
 
     # -- health summary -----------------------------------------------------
 
@@ -878,6 +1027,7 @@ class Tracer:
         return scan_report_from(
             self.stats(), self.counters(), self.gauges(),
             wall_seconds=wall_seconds, budget_bytes=budget_bytes,
+            histograms=self.histograms_dict(),
         )
 
     def report(self) -> str:
@@ -895,6 +1045,8 @@ class Tracer:
             lines.append(f"{name:<32} {v}")
         for name, v in sorted(self.gauges().items()):
             lines.append(f"{name:<32} max={v}")
+        for name, h in sorted(self.histograms().items()):
+            lines.append(f"{name:<32} {h.render()}")
         for d in self.decisions():
             kv = " ".join(f"{k}={v}" for k, v in d.items() if k != "decision")
             lines.append(f"[{d['decision']}] {kv}")
@@ -981,6 +1133,15 @@ def gauge_max(name: str, value: int) -> None:
     (_global if t is None else t).gauge_max(name, value)
 
 
+def observe(name: str, value: float) -> None:
+    t = _active.get()
+    (_global if t is None else t).observe(name, value)
+
+
+def histograms() -> Dict[str, LogHistogram]:
+    return current().histograms()
+
+
 def counters() -> Dict[str, int]:
     return current().counters()
 
@@ -1008,9 +1169,10 @@ def add(stage: str, seconds: float, nbytes: int = 0,
     (_global if t is None else t).add(stage, seconds, nbytes, self_seconds)
 
 
-def span(stage: str, nbytes: int = 0, attrs: Optional[dict] = None):
+def span(stage: str, nbytes: int = 0, attrs: Optional[dict] = None,
+         observe: Optional[str] = None):
     t = _active.get()
-    return (_global if t is None else t).span(stage, nbytes, attrs)
+    return (_global if t is None else t).span(stage, nbytes, attrs, observe)
 
 
 def stats() -> Dict[str, dict]:
@@ -1034,6 +1196,21 @@ def report() -> str:
     return current().report()
 
 
+def serve_metrics(port: int = 0, tracer: Optional[Tracer] = None,
+                  host: str = "127.0.0.1"):
+    """Start a metrics HTTP endpoint over ``tracer`` (default: the
+    tracer active HERE, at call time) and return the running
+    :class:`~parquet_floor_tpu.utils.metrics_export.MetricsServer`
+    (``.port`` holds the bound port — pass 0 for an ephemeral one;
+    ``.close()`` stops it).  ``GET /metrics`` answers Prometheus text
+    exposition, ``GET /metrics.json`` the JSON snapshot
+    (docs/observability.md)."""
+    from .metrics_export import MetricsServer
+
+    return MetricsServer(tracer if tracer is not None else current(),
+                         port=port, host=host)
+
+
 @contextlib.contextmanager
 def device_trace(log_dir: str) -> Iterator[None]:
     """Wrap a region in ``jax.profiler.trace`` so XLA device activity lands
@@ -1042,3 +1219,76 @@ def device_trace(log_dir: str) -> Iterator[None]:
 
     with jax.profiler.trace(log_dir):
         yield
+
+
+#: the clock-sync annotation unified_trace plants inside the XLA
+#: capture: its profiler timestamp + the host perf_counter taken at the
+#: same instant are the shared epoch marker the rebase solves against
+CLOCK_SYNC_MARKER = "pftpu_clock_sync"
+
+
+class UnifiedTrace:
+    """Handle yielded by :func:`unified_trace`: ``path`` is where the
+    merged file lands on exit; ``events``/``device_events`` are filled
+    in after the block closes."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.events = 0
+        self.device_events = 0
+
+
+@contextlib.contextmanager
+def unified_trace(log_dir: str, path: str) -> Iterator[UnifiedTrace]:
+    """Run the block under BOTH the host tracer's timeline and the XLA
+    profiler, then merge the two captures onto ONE clock and write a
+    single Perfetto-loadable trace-event JSON to ``path`` — XLA kernels
+    render next to the host ``ship``/``decode``/``emit`` spans in one
+    view (the ROADMAP observability follow-on; docs/observability.md).
+
+    The clock bridge: the profiler's event timestamps live on its own
+    session clock, the host tracer's on ``time.perf_counter`` since the
+    tracer epoch.  On entry a :data:`CLOCK_SYNC_MARKER` annotation is
+    planted INSIDE the XLA capture with the host ``perf_counter`` taken
+    at the same instant; on exit the marker is located in the captured
+    ``.xplane.pb`` (``utils/xplane.py``) and every device event is
+    rebased by the one offset that aligns the pair.  Host spans must be
+    recorded by the CURRENT tracer (enable it, or run inside
+    ``trace.scope()``)."""
+    import glob as _glob
+
+    import jax
+
+    tracer = current()
+    handle = UnifiedTrace(path)
+    with jax.profiler.trace(log_dir):
+        sync_perf = time.perf_counter()
+        with jax.profiler.TraceAnnotation(CLOCK_SYNC_MARKER):
+            pass
+        yield handle
+    from .xplane import device_trace_events
+
+    runs = sorted(_glob.glob(
+        os.path.join(log_dir, "plugins", "profile", "*", "*.xplane.pb")
+    ))
+    host_events = tracer.chrome_events()
+    dev_events: List[dict] = []
+    if runs:
+        host_sync_us = (sync_perf - tracer._epoch) * 1e6
+        dev_events = device_trace_events(
+            runs[-1], sync_marker=CLOCK_SYNC_MARKER,
+            host_sync_us=host_sync_us,
+        )
+    merged = host_events + dev_events
+    # one monotonic stream for the whole file: metadata first, then
+    # everything by rebased timestamp (stable — per-pid B/E order and
+    # nesting survive)
+    merged.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
+                               e.get("ts", 0.0)))
+    payload = {"traceEvents": merged, "displayTimeUnit": "ms"}
+    with open(path, "w") as fh:
+        fh.write(json.dumps(payload))
+    handle.events = len(merged)
+    handle.device_events = sum(
+        1 for e in dev_events if e.get("ph") != "M"
+    )

@@ -1,0 +1,261 @@
+"""Minimal XSpace (``.xplane.pb``) reader for the one-clock timeline.
+
+``jax.profiler.trace`` always writes the raw profiler capture as an
+``XSpace`` protobuf (``plugins/profile/<run>/<host>.xplane.pb``) —
+planes (one per device / host component) → lines (one per thread or
+hardware queue) → events with picosecond offsets.  Converting it to a
+viewable trace normally requires the TensorFlow profiler toolchain;
+this module reads the few fields the unified export needs with a
+hand-rolled varint walker instead (the package already speaks thrift
+compact, snappy, and RLE by hand — one more wire format keeps the
+no-new-dependencies rule).
+
+Field numbers follow ``tsl/profiler/protobuf/xplane.proto``:
+
+* ``XSpace.planes = 1``
+* ``XPlane``: ``id=1 name=2 lines=3 event_metadata=4`` (map entries:
+  ``key=1 value=2``)
+* ``XLine``: ``id=1 name=2 timestamp_ns=3 events=4 display_name=11``
+* ``XEvent``: ``metadata_id=1 offset_ps=2 duration_ps=3``
+* ``XEventMetadata``: ``id=1 name=2 display_name=4``
+
+Unknown fields are skipped by wire type, so schema growth upstream
+cannot break the walk.  Docs: ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# trace-event pids for device-origin processes: past Linux's maximum
+# kernel.pid_max (2**22) so the host process row can never collide
+_DEVICE_PID_BASE = 1 << 22
+
+
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield ``(field_number, wire_type, value)`` triples of one
+    message.  Varints come back as ints, length-delimited fields as
+    ``bytes`` slices; 32/64-bit fields are skipped over but yielded raw
+    so callers may ignore them."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wt}")
+        yield fn, wt, v
+
+
+class XEvent:
+    __slots__ = ("name", "start_ns", "duration_ns")
+
+    def __init__(self, name: str, start_ns: float, duration_ns: float):
+        self.name = name
+        self.start_ns = start_ns
+        self.duration_ns = duration_ns
+
+
+class XLine:
+    __slots__ = ("line_id", "name", "timestamp_ns", "events")
+
+    def __init__(self, line_id: int, name: str, timestamp_ns: int,
+                 events: List[XEvent]):
+        self.line_id = line_id
+        self.name = name
+        self.timestamp_ns = timestamp_ns
+        self.events = events
+
+
+class XPlane:
+    __slots__ = ("name", "lines")
+
+    def __init__(self, name: str, lines: List[XLine]):
+        self.name = name
+        self.lines = lines
+
+
+def _parse_line(buf: bytes, meta: Dict[int, str]) -> XLine:
+    line_id = 0
+    name = ""
+    ts_ns = 0
+    raw_events: List[bytes] = []
+    display = None
+    for fn, wt, v in _fields(buf):
+        if fn == 1 and wt == 0:
+            line_id = v
+        elif fn == 2 and wt == 2:
+            name = v.decode("utf-8", "replace")
+        elif fn == 11 and wt == 2:
+            display = v.decode("utf-8", "replace")
+        elif fn == 3 and wt == 0:
+            ts_ns = v
+        elif fn == 4 and wt == 2:
+            raw_events.append(v)
+    events: List[XEvent] = []
+    for ev in raw_events:
+        mid = 0
+        off_ps = 0
+        dur_ps = 0
+        for fn, wt, v in _fields(ev):
+            if fn == 1 and wt == 0:
+                mid = v
+            elif fn == 2 and wt == 0:
+                off_ps = v
+            elif fn == 3 and wt == 0:
+                dur_ps = v
+        events.append(XEvent(
+            meta.get(mid, f"event#{mid}"),
+            ts_ns + off_ps / 1e3,
+            dur_ps / 1e3,
+        ))
+    return XLine(line_id, display or name, ts_ns, events)
+
+
+def _parse_plane(buf: bytes) -> XPlane:
+    name = ""
+    meta: Dict[int, str] = {}
+    raw_lines: List[bytes] = []
+    for fn, wt, v in _fields(buf):
+        if fn == 2 and wt == 2:
+            name = v.decode("utf-8", "replace")
+        elif fn == 4 and wt == 2:
+            # map<int64, XEventMetadata> entry: key=1, value=2
+            k = None
+            md = None
+            for fn2, wt2, v2 in _fields(v):
+                if fn2 == 1 and wt2 == 0:
+                    k = v2
+                elif fn2 == 2 and wt2 == 2:
+                    md = v2
+            if k is None or md is None:
+                continue
+            mname = None
+            for fn3, wt3, v3 in _fields(md):
+                if fn3 == 2 and wt3 == 2:
+                    mname = v3.decode("utf-8", "replace")
+            if mname:
+                meta[k] = mname
+        elif fn == 3 and wt == 2:
+            raw_lines.append(v)
+    return XPlane(name, [_parse_line(b, meta) for b in raw_lines])
+
+
+def parse_xplane(path: str) -> List[XPlane]:
+    """Every plane of one ``.xplane.pb`` capture."""
+    with open(path, "rb") as fh:
+        buf = fh.read()
+    return [_parse_plane(v) for fn, wt, v in _fields(buf)
+            if fn == 1 and wt == 2]
+
+
+def find_sync_event(planes: List[XPlane],
+                    marker: str) -> Optional[float]:
+    """Profiler-clock start time (µs) of the planted clock-sync
+    annotation, or None when the capture does not carry it."""
+    for plane in planes:
+        for line in plane.lines:
+            for ev in line.events:
+                if ev.name == marker:
+                    return ev.start_ns / 1e3
+    return None
+
+
+def device_trace_events(xplane_path: str, sync_marker: str,
+                        host_sync_us: float,
+                        skip_python: bool = True) -> List[dict]:
+    """The capture as Chrome trace-event dicts REBASED onto the host
+    tracer clock: ``offset = host_sync_us - marker's profiler-clock
+    time``, applied to every event.  Without the marker (dropped
+    annotation) the earliest captured event is pinned to the host sync
+    point instead — degraded alignment beats a second clock.
+
+    Every event is a complete ("X") event tagged ``cat="xla"`` /
+    ``args.origin="device"``, so consumers (and the CI smoke) can tell
+    XLA-capture events from the host tracer's ``cat="pftpu"`` spans;
+    plane/line names ride along as process/thread metadata.
+
+    ``skip_python`` (default) drops the host python-tracer's
+    per-source-line events (names like ``$module.py:42 fn`` — tens of
+    thousands per capture, and the host side of the story is already
+    told by the tracer's own spans); XLA runtime/kernel events have no
+    ``$`` prefix and always survive."""
+    planes = parse_xplane(xplane_path)
+    sync_us = find_sync_event(planes, sync_marker)
+    if sync_us is None:
+        starts = [ev.start_ns / 1e3
+                  for p in planes for ln in p.lines for ev in ln.events]
+        if not starts:
+            return []
+        sync_us = min(starts)
+    offset_us = host_sync_us - sync_us
+    out: List[dict] = []
+    for pi, plane in enumerate(planes):
+        # the planted sync marker is rebase INPUT, not capture output:
+        # emitting it would let "the file contains device-origin
+        # events" be satisfied by an event the exporter itself wrote
+        # (a broken capture must fail that check, not ship green)
+        pid = _DEVICE_PID_BASE + pi
+        plane_meta_done = False
+        # fallback tids must never collide with a REAL line id in the
+        # same plane (two queues merged onto one trace row); allocate
+        # around the taken ids
+        taken = {ln.line_id for ln in plane.lines if ln.line_id}
+        next_tid = 1
+        for li, line in enumerate(plane.lines):
+            events = [ev for ev in line.events if ev.name != sync_marker]
+            if skip_python:
+                events = [ev for ev in events
+                          if not ev.name.startswith("$")]
+            if not events:
+                continue
+            if not plane_meta_done:
+                plane_meta_done = True
+                out.append({
+                    "name": "process_name", "ph": "M", "pid": pid,
+                    "args": {"name": plane.name or f"plane#{pi}"},
+                })
+            if line.line_id:
+                tid = line.line_id
+            else:
+                while next_tid in taken:
+                    next_tid += 1
+                tid = next_tid
+                taken.add(tid)
+            out.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": line.name or f"line#{li}"},
+            })
+            for ev in events:
+                out.append({
+                    "name": ev.name, "ph": "X", "cat": "xla",
+                    "pid": pid, "tid": tid,
+                    "ts": round(ev.start_ns / 1e3 + offset_us, 3),
+                    "dur": round(ev.duration_ns / 1e3, 3),
+                    "args": {"origin": "device"},
+                })
+    return out

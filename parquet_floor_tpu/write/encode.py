@@ -534,7 +534,8 @@ class DeviceFileWriter(ParquetFileWriter):
             for f in futs:
                 f.cancel()
             raise
-        with trace.span("write.emit", attrs={"rows": num_rows}):
+        with trace.span("write.emit", attrs={"rows": num_rows},
+                        observe="write.emit_seconds"):
             pos0 = self.sink.pos
             self.write_prepared_group(prepared, num_rows)
             trace.count("write.bytes_written", self.sink.pos - pos0)

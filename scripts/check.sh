@@ -61,12 +61,21 @@ echo "== remote scan smoke (simulator, faults on) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python scripts/remote_scan_smoke.py || exit 1
 
-# Serving smoke (docs/serving.md): one cold tenant populates the shared
-# buffer cache, two concurrent warm tenants must then be served from it
-# (hit-rate floor per tenant, reports disjoint and attributed), and a
-# hot one-column Dataset.lookup must cost at most ONE data page of
-# storage bytes — the point-probe contract, proven by cache counters.
-echo "== serving smoke (shared cache, concurrent tenants, point lookup) =="
+# Serving smoke (docs/serving.md, docs/observability.md): one cold
+# tenant populates the shared buffer cache, two concurrent warm tenants
+# must then be served from it (hit-rate floor per tenant, reports
+# disjoint and attributed), and a hot one-column Dataset.lookup must
+# cost at most ONE data page of storage bytes — the point-probe
+# contract, proven by cache counters.  The telemetry floors ride the
+# same gate: trace.serve_metrics on an ephemeral port is scraped
+# MID-RUN and the body must validate as Prometheus text exposition with
+# counter values matching cache.stats()/tracer truth; an injected slow
+# tenant must trip serve.slo_breach from its per-tenant p99 histogram
+# while a healthy tenant stays clean; and one trace.unified_trace
+# export around a device scan must load as balanced/monotonic
+# trace-event JSON whose XLA-capture events and host ship/decode spans
+# overlap on ONE rebased clock.
+echo "== serving smoke (shared cache, lookups, metrics, SLO, unified trace) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python scripts/serving_smoke.py || exit 1
 

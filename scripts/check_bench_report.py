@@ -33,6 +33,88 @@ def fail(msg: str) -> int:
     return 1
 
 
+def _hist_problem(d: dict, require_samples: bool = True):
+    """Well-formedness of one serialized LogHistogram: bucket counts
+    (plus the zero bucket) must sum to the total count, quantiles must
+    be ordered (p50 <= p99), and — on exercised legs — the sample count
+    must be nonzero.  Returns a diagnostic string or None."""
+    if not isinstance(d, dict):
+        return f"not a histogram dict: {d!r}"
+    root = str(pathlib.Path(__file__).resolve().parent.parent)
+    if root not in sys.path:   # called once per histogram: keep sys.path flat
+        sys.path.insert(0, root)
+    from parquet_floor_tpu.utils.histogram import LogHistogram
+
+    try:
+        h = LogHistogram.from_dict(d)
+    except (TypeError, ValueError) as e:
+        return f"histogram does not parse: {e}"
+    if require_samples and h.count <= 0:
+        return "histogram has zero samples on an exercised leg"
+    if sum(h.buckets.values()) + h.zeros != h.count:
+        return (
+            f"bucket counts {sum(h.buckets.values())} + zeros {h.zeros} "
+            f"!= count {h.count}"
+        )
+    if h.count:
+        p50, p99 = h.percentile(50), h.percentile(99)
+        if not p50 <= p99:
+            return f"p50 {p50} > p99 {p99}"
+        if h.min is None or h.max is None or h.min > h.max:
+            return f"min/max malformed ({h.min}, {h.max})"
+    return None
+
+
+def check_histograms(detail: dict) -> int:
+    """The latency-distribution gate (docs/observability.md): every
+    histogram the exercised legs exported must be well-formed, and the
+    legs that definitionally produced traffic must carry samples — the
+    serving leg's lookup + storage-read distributions, the remote fault
+    pass's primary-read distribution, and the device scan leg's
+    stage/ship/launch walls."""
+    required = [
+        ("serving_lookup_hist", detail.get("serving_lookup_hist")),
+        ("serving_storage_read_hist",
+         detail.get("serving_storage_read_hist")),
+    ]
+    fault_hists = (
+        (detail.get("remote_fault_scan_report") or {}).get("histograms")
+        or {}
+    )
+    required.append((
+        "remote_fault io.remote.get_seconds.primary",
+        fault_hists.get("io.remote.get_seconds.primary"),
+    ))
+    scan_hists = (detail.get("scan_report") or {}).get("histograms") or {}
+    for name in ("engine.stage_seconds", "engine.ship_seconds",
+                 "engine.launch_seconds"):
+        required.append((f"scan_report {name}", scan_hists.get(name)))
+    for label, d in required:
+        if d is None:
+            return fail(f"exercised leg exported no histogram: {label}")
+        problem = _hist_problem(d)
+        if problem:
+            return fail(f"histogram {label}: {problem}")
+    # every OTHER exported histogram must still be well-formed (empty ok)
+    for rep_key in ("scan_report", "remote_scan_report",
+                    "remote_fault_scan_report", "serving_report"):
+        for name, d in ((detail.get(rep_key) or {}).get("histograms")
+                        or {}).items():
+            problem = _hist_problem(d, require_samples=False)
+            if problem:
+                return fail(f"histogram {rep_key}/{name}: {problem}")
+    p50 = detail.get("serving_lookup_p50_ms")
+    p99 = detail.get("serving_lookup_p99_ms")
+    if p50 is None or p99 is None or not p50 <= p99:
+        return fail(f"serving lookup p50/p99 malformed ({p50}, {p99})")
+    print(
+        "check_bench_report: histograms ok "
+        f"(serving lookup p50 {p50} ms / p99 {p99} ms, "
+        f"{len(scan_hists)} scan-leg distributions)"
+    )
+    return 0
+
+
 def check_report(bench_log: pathlib.Path) -> int:
     lines = [
         line for line in bench_log.read_text().splitlines()
@@ -59,6 +141,7 @@ def check_report(bench_log: pathlib.Path) -> int:
     return (
         check_remote_leg(result.get("detail", {}))
         or check_serving_leg(result.get("detail", {}))
+        or check_histograms(result.get("detail", {}))
         or check_exec_cache_leg(result.get("detail", {}))
         or check_launches(result.get("detail", {}))
         or check_loader_leg(result.get("detail", {}))

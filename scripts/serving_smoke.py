@@ -1,8 +1,8 @@
 #!/usr/bin/env python
-"""Commit-gate serving smoke (docs/serving.md).
+"""Commit-gate serving smoke (docs/serving.md, docs/observability.md).
 
 Seeded, self-contained, CPU-only: builds a small keyed dataset, then
-asserts the serving layer's two load-bearing floors —
+asserts the serving layer's load-bearing floors —
 
 1. **shared-cache hit-rate**: after one tenant's cold scan, two MORE
    tenants scanning the same files CONCURRENTLY are each served almost
@@ -11,17 +11,32 @@ asserts the serving layer's two load-bearing floors —
    (each sees exactly one scan's planned bytes);
 2. **probe byte-cost**: a hot one-column ``Dataset.lookup`` (metadata
    pinned by the warm pass) reads more than zero and at most ONE data
-   page of storage bytes, proven by the cache's miss-byte counters.
+   page of storage bytes, proven by the cache's miss-byte counters;
+3. **live metrics**: ``trace.serve_metrics`` on an ephemeral port,
+   scraped MID-RUN — the body must parse as Prometheus text exposition
+   (small stdlib parser) and its counter values must match
+   ``cache.stats()`` / the tracer's own truth;
+4. **per-tenant SLO**: an injected slow tenant (storage reads behind a
+   latency shim) must trip a registered ``serve.slo_breach`` decision
+   on ITS tracer while a healthy tenant probing the same dataset does
+   not — per-tenant p99 from the new histograms, end to end;
+5. **one-clock timeline**: ``trace.unified_trace`` around a device
+   scan emits a single Perfetto-loadable file whose XLA-capture events
+   and host ``ship``/``decode`` spans sit on one rebased clock
+   (balanced, monotonic, overlapping time ranges).
 
 Exit 0 on success, 1 with a diagnostic otherwise.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import pathlib
 import sys
 import threading
+import time
+import urllib.request
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
@@ -152,7 +167,289 @@ def main() -> int:
                             f"bytes (one-page bound {bound})")
             print(f"serving_smoke: hot lookup cost {cost} B <= one-page "
                   f"bound {bound} B")
+
+    rc = check_metrics_endpoint(paths)
+    if rc:
+        return rc
+    rc = check_slo_breach(paths)
+    if rc:
+        return rc
+    rc = check_unified_trace(paths)
+    if rc:
+        return rc
     print("serving_smoke: PASS")
+    return 0
+
+
+# -- live metrics endpoint (docs/observability.md) -----------------------
+
+def validate_prometheus_text(text: str) -> dict:
+    """Validate one scrape: sample extraction rides the library's own
+    ``parse_prometheus`` (one grammar, one implementation —
+    docs/observability.md); this layers the structural checks a scrape
+    consumer cares about — every sample family carries a TYPE
+    declaration, and histogram families are internally consistent
+    (the ``+Inf`` bucket equals ``_count``).  Returns {sample name ->
+    value}; raises on violation."""
+    import re
+
+    from parquet_floor_tpu.utils.metrics_export import parse_prometheus
+
+    samples = parse_prometheus(text)   # raises on malformed lines
+    typed = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            fam, _, kind = line[len("# TYPE "):].partition(" ")
+            if kind not in ("counter", "gauge", "histogram", "summary",
+                            "untyped"):
+                raise ValueError(f"bad TYPE line: {line!r}")
+            typed[fam] = kind
+    for sample in samples:
+        name = sample.split("{")[0]
+        fam = re.sub(r"_(bucket|sum|count)$", "", name)
+        if name not in typed and fam not in typed:
+            raise ValueError(f"sample {name!r} has no TYPE declaration")
+    # histogram families: _count present and equal to the +Inf bucket
+    for fam, kind in typed.items():
+        if kind != "histogram":
+            continue
+        count = samples.get(f"{fam}_count")
+        inf = samples.get(f'{fam}_bucket{{le="+Inf"}}')
+        if count is None or inf is None or count != inf:
+            raise ValueError(
+                f"histogram {fam}: _count {count} != +Inf bucket {inf}"
+            )
+    return samples
+
+
+def check_metrics_endpoint(paths) -> int:
+    """Floor 3: scrape ``trace.serve_metrics`` mid-run; the text must
+    validate and its counters must equal cache/tracer truth."""
+    from parquet_floor_tpu.utils import trace
+
+    with SharedBufferCache() as cache, trace.scope() as t:
+        with Dataset(paths, "k", cache=cache) as ds:
+            server = trace.serve_metrics(0)   # ephemeral port, tracer t
+            try:
+                ds.lookup(0)
+                # mid-run scrape: the endpoint serves while probes run
+                mid = urllib.request.urlopen(
+                    server.url(), timeout=10
+                ).read().decode()
+                validate_prometheus_text(mid)
+                ds.lookup(2 * (GROUP * GROUPS), columns=["k"])
+                ds.lookup(4, columns=["k"])
+                # quiesced scrape: values must MATCH the other truths
+                text = urllib.request.urlopen(
+                    server.url(), timeout=10
+                ).read().decode()
+                samples = validate_prometheus_text(text)
+                js = json.loads(urllib.request.urlopen(
+                    server.url("/metrics.json"), timeout=10
+                ).read().decode())
+            finally:
+                server.close()
+            st = cache.stats()
+            counters = t.counters()
+    for prom, truth, src in (
+        ("pftpu_serve_cache_misses", st["misses"], "cache.stats"),
+        ("pftpu_serve_cache_miss_bytes", st["miss_bytes"], "cache.stats"),
+        ("pftpu_serve_cache_hits", st["hits"], "cache.stats"),
+        ("pftpu_serve_lookup_probes",
+         counters.get("serve.lookup_probes", 0), "tracer"),
+    ):
+        got = samples.get(prom)
+        if got != truth:
+            return fail(f"scrape {prom}={got} != {src} truth {truth}")
+    if js.get("counters") != counters:
+        return fail("JSON snapshot counters diverge from tracer truth")
+    hist_count = samples.get("pftpu_serve_lookup_seconds_count")
+    if not hist_count or hist_count != counters.get(
+        "serve.lookup_probes", 0
+    ):
+        return fail(
+            f"lookup histogram count {hist_count} != probe counter "
+            f"{counters.get('serve.lookup_probes', 0)}"
+        )
+    print(f"serving_smoke: metrics scrape ok ({len(samples)} samples, "
+          f"counters match cache.stats)")
+    return 0
+
+
+# -- per-tenant SLO breach (docs/serving.md) ------------------------------
+
+class _SlowSource:
+    """A FileSource behind an injected per-read storage latency — the
+    smoke's 'slow tenant' lives behind this shim."""
+
+    def __init__(self, path: str, delay_s: float):
+        from parquet_floor_tpu.io.source import FileSource
+
+        self._src = FileSource(path)
+        self._delay = float(delay_s)
+        self.size = self._src.size
+        self.name = self._src.name
+
+    def read_at(self, offset: int, length: int):
+        time.sleep(self._delay)
+        return self._src.read_at(offset, length)
+
+    def read_many(self, ranges):
+        time.sleep(self._delay)
+        return self._src.read_many(ranges)
+
+    def close(self) -> None:
+        self._src.close()
+
+
+def check_slo_breach(paths) -> int:
+    """Floor 4: the injected-slow tenant trips ``serve.slo_breach``;
+    the healthy tenant probing the same keys does not."""
+    from parquet_floor_tpu.serve import Serving, SloTarget
+
+    per = GROUP * GROUPS
+    # margins sized for noisy CI hosts: the 20 ms storage shim puts
+    # EVERY slow probe 4x past the 5 ms bound, while a healthy local
+    # probe (sub-ms typical) breaches only if >= 14.4% of them spend
+    # 5 ms+ — a real defect, not scheduler jitter
+    SHIM_S = 0.020
+    target = SloTarget(
+        p99_seconds=0.005,
+        fast_window_s=60.0,
+        slow_window_s=300.0,
+    )
+    with Serving(prefetch_bytes=8 << 20) as srv:
+        slow = srv.tenant("slow")
+        healthy = srv.tenant("healthy")
+        srv.set_slo("slow", target)
+        srv.set_slo("healthy", target)
+        now = 1000.0
+        st0 = srv.check_slos(now=now)
+        if st0["slow"].breach or st0["healthy"].breach:
+            return fail("SLO breached before any traffic")
+        with Dataset(
+            [(lambda p=p: _SlowSource(p, SHIM_S)) for p in paths], "k",
+            cache=srv.cache,
+        ) as slow_ds, Dataset(paths, "k", cache=srv.cache) as fast_ds:
+            # warm both (opens files, pins metadata — not measured)
+            slow_ds.lookup(0)
+            fast_ds.lookup(0)
+            # 24 probes each, distinct keys -> distinct DATA pages, so
+            # every slow probe pays >= one shimmed storage read
+            for i in range(24):
+                key = 2 * (i * PAGE + (PAGE // 2))
+                slow_ds.lookup(key, columns=["k"], tenant=slow)
+            for i in range(24):
+                key = 2 * (per + i * PAGE + (PAGE // 2))
+                fast_ds.lookup(key, columns=["k"], tenant=healthy)
+            statuses = srv.check_slos(now=now + 30.0)
+        s_slow, s_fast = statuses["slow"], statuses["healthy"]
+        if not s_slow.breach:
+            return fail(f"slow tenant did not breach: {s_slow.render()}")
+        if s_fast.breach:
+            return fail(f"healthy tenant breached: {s_fast.render()}")
+        breaches = [d for d in slow.tracer.decisions()
+                    if d.get("decision") == "serve.slo_breach"]
+        if not breaches:
+            return fail("no serve.slo_breach decision on the slow "
+                        "tenant's tracer")
+        if any(d.get("decision") == "serve.slo_breach"
+               for d in healthy.tracer.decisions()):
+            return fail("spurious serve.slo_breach on the healthy tenant")
+        print(f"serving_smoke: slo ok (slow {s_slow.render()} | "
+              f"healthy {s_fast.render()})")
+        print(srv.health(now=now + 31.0))
+    return 0
+
+
+# -- the one-clock host+device timeline (docs/observability.md) -----------
+
+def check_unified_trace(paths) -> int:
+    """Floor 5: one ``unified_trace`` file, balanced + monotonic, with
+    host ``ship``/``decode`` spans AND XLA-capture events on one
+    rebased clock (overlapping time ranges)."""
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # INT64/DOUBLE columns
+    fd, out_path = tempfile.mkstemp(prefix="pftpu_unified_",
+                                    suffix=".json")
+    os.close(fd)
+    log_dir = tempfile.mkdtemp(prefix="pftpu_xprof_")
+    try:
+        return _check_unified_trace(paths, out_path, log_dir)
+    finally:
+        # failure paths must not litter /tmp on every smoke run
+        import shutil
+
+        shutil.rmtree(log_dir, ignore_errors=True)
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+
+
+def _check_unified_trace(paths, out_path, log_dir) -> int:
+    from parquet_floor_tpu.scan import scan_device_groups
+    from parquet_floor_tpu.utils import trace
+
+    with trace.scope():
+        with trace.unified_trace(log_dir, out_path) as handle:
+            rows = 0
+            for _fi, _gi, cols in scan_device_groups(paths):
+                col = next(iter(cols.values()))
+                rows += int(col.values.shape[0])
+    if rows != FILES * GROUP * GROUPS:
+        return fail(f"device scan under unified_trace read {rows} rows")
+    data = json.loads(pathlib.Path(out_path).read_text())
+    events = data.get("traceEvents") or []
+    stacks: dict = {}
+    last_ts = None
+    host_spans = set()
+    xla_events = 0
+    host_range = [None, None]
+    dev_range = [None, None]
+    for ev in events:
+        if ev.get("ph") == "M":
+            continue
+        ts = ev["ts"]
+        if last_ts is not None and ts < last_ts:
+            return fail("unified trace timestamps are not monotonic")
+        last_ts = ts
+        if ev.get("cat") == "xla":
+            xla_events += 1
+            dev_range[0] = ts if dev_range[0] is None else dev_range[0]
+            dev_range[1] = ts + ev.get("dur", 0.0)
+            continue
+        key = (ev.get("pid"), ev.get("tid"))
+        if ev["ph"] == "B":
+            stacks.setdefault(key, []).append(ev["name"])
+            host_spans.add(ev["name"])
+            host_range[0] = ts if host_range[0] is None else host_range[0]
+            host_range[1] = ts
+        elif ev["ph"] == "E":
+            if not stacks.get(key):
+                return fail(f"unbalanced E event on {key}")
+            stacks[key].pop()
+            host_range[1] = ts
+    if any(s for s in stacks.values()):
+        return fail(f"unclosed host spans: {stacks}")
+    if handle.device_events == 0 or xla_events == 0:
+        return fail("unified trace carries no device-origin events")
+    if not {"ship", "decode"} <= host_spans:
+        return fail(f"unified trace misses host pipeline spans "
+                    f"(saw {sorted(host_spans)})")
+    if None in host_range or None in dev_range:
+        return fail("unified trace missing a time range")
+    if not (dev_range[0] < host_range[1]
+            and host_range[0] < dev_range[1]):
+        return fail(
+            f"host {host_range} and device {dev_range} ranges do not "
+            "overlap — the clock rebase is wrong"
+        )
+    print(f"serving_smoke: unified trace ok ({len(events)} events, "
+          f"{xla_events} device-origin, host+device ranges overlap)")
     return 0
 
 

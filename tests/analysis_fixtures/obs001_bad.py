@@ -15,3 +15,13 @@ def plan_one(extents):
 def emit_batch(tracer, n):
     tracer.count("data.rows_emited", n)  # typo'd loader counter
     return n
+
+
+def probe_wall(tracer, dt):
+    tracer.observe("serve.lookup_secs", dt)  # typo'd histogram name
+    trace.observe("sevre.fair_wait_seconds", dt)  # transposed prefix
+
+
+def decode_timed(extents):
+    with trace.span("decode", observe="engine.lanch_seconds"):  # typo
+        return len(extents)

@@ -20,3 +20,15 @@ def emit_batch(tracer, n):
     tracer.decision("data.resume", {"epoch": 0, "batch": 0})
     with tracer.span("data.next_batch"):
         return n
+
+
+def probe_wall(tracer, dt, hist_name):
+    # the histogram family (PR 14) is registered like every other kind
+    tracer.observe("serve.lookup_seconds", dt)
+    trace.observe("serve.fair_wait_seconds", dt)
+    trace.observe(hist_name, dt)  # dynamic: not checked
+
+
+def decode_timed(extents):
+    with trace.span("decode", observe="engine.launch_seconds"):
+        return len(extents)

@@ -1,0 +1,49 @@
+# floorlint: scope=FL-TPU
+"""Seeded-bad: CHAINED annotated attribute receivers — the PR 12 blind
+spot closed in PR 14.  The host I/O hides behind ``param.attr.method()``
+(and a deeper ``self.attr.sub.method()``): the receiver's class comes
+from a parameter annotation, the ATTRIBUTE's class from that class's
+own annotation, and only then does the method resolve."""
+
+
+def jit(fn):  # stand-in so the fixture parses without jax installed
+    return fn
+
+
+class ConfigStore:
+    def load(self, path):
+        with open(path) as fh:  # host I/O: runs once at trace time
+            return int(fh.read())
+
+
+class Session:
+    store: ConfigStore  # the chain's middle hop, typed by annotation
+
+    def __init__(self, store):
+        self.store = store
+
+
+class Runtime:
+    session: Session
+
+    def __init__(self, session):
+        self.session = session
+
+
+@jit
+def decode_chained(payload, sess: "Session", path):
+    limit = sess.store.load(path)  # param.attr.method(): two typed hops
+    return payload[:limit]
+
+
+class Decoder:
+    runtime: Runtime
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+
+    @jit
+    def decode(self, payload, path):
+        # self.attr.attr.method(): three typed hops through two classes
+        limit = self.runtime.session.store.load(path)
+        return payload[:limit]

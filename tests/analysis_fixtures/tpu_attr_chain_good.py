@@ -1,0 +1,37 @@
+# floorlint: scope=FL-TPU
+"""Seeded-good twin of ``tpu_attr_chain_bad``: the same chained
+annotated-attribute dispatch, but the resolved methods are pure — the
+chain walk must not fabricate host-I/O findings, and a chain broken by
+one UNtyped hop must stay silent (under-approximation)."""
+
+
+def jit(fn):  # stand-in so the fixture parses without jax installed
+    return fn
+
+
+class ConfigStore:
+    def load_pure(self, x):
+        return x + 1
+
+    def load(self, path):
+        with open(path) as fh:  # host I/O — but only reachable through
+            return int(fh.read())  # an untyped hop below
+
+
+class Session:
+    store: ConfigStore
+
+    def __init__(self, store):
+        self.store = store
+
+
+@jit
+def decode_chained(payload, sess: "Session"):
+    return payload[: sess.store.load_pure(1)]  # pure through the chain
+
+
+@jit
+def decode_untyped_hop(payload, sess, path):
+    # ``sess`` carries NO annotation: the first hop is untyped, the
+    # chain does not resolve, and no edge (hence no finding) is made
+    return payload[: len(str(sess.store))]

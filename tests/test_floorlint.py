@@ -33,6 +33,10 @@ CASES = [
     #                              partial hop; good pins the depth bound
     ("tpu_ann", "FL-TPU001"),   # annotated receivers: param / local /
     #                             class-body attr annotations pin types
+    ("tpu_attr_chain", "FL-TPU001"),  # chained annotated attribute
+    #                             receivers (param.attr.method()) — the
+    #                             PR 12 blind spot; good pins the
+    #                             untyped-hop under-approximation
     ("res001", "FL-RES001"),
     ("res001_tpe", "FL-RES001"),  # executor/scan-handle shapes of the rule
     ("res001_remote", "FL-RES001"),  # remote session/pool + factory shapes

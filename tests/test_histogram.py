@@ -1,0 +1,335 @@
+"""LogHistogram + Tracer.observe: accuracy vs numpy, the merge law,
+windows, the disabled-mode zero-cost pin, and the xplane clock-rebase
+math (docs/observability.md)."""
+
+import gc
+import json
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu.utils import trace
+from parquet_floor_tpu.utils.histogram import GROWTH, LogHistogram
+from parquet_floor_tpu.utils.trace import ScanReport, Tracer
+
+
+# --- percentile accuracy vs numpy -------------------------------------------
+
+@pytest.mark.parametrize("seed,dist", [
+    (7, lambda rng, n: rng.lognormal(-6, 1.2, n)),     # latency-shaped
+    (11, lambda rng, n: rng.exponential(0.01, n)),
+    (13, lambda rng, n: rng.uniform(1e-5, 2.0, n)),
+])
+def test_percentile_tracks_numpy(seed, dist):
+    rng = np.random.default_rng(seed)
+    xs = dist(rng, 20_000)
+    h = LogHistogram()
+    for x in xs:
+        h.record(float(x))
+    # relative quantile error is bounded by the bucket width
+    tol = h.growth - 1.0
+    for p in (1, 10, 50, 90, 99, 99.9):
+        want = float(np.percentile(xs, p))
+        got = h.percentile(p)
+        assert abs(got - want) / want <= tol, (p, got, want)
+    # the extremes are exact (min/max ride along)
+    assert h.percentile(0) == pytest.approx(xs.min())
+    assert h.percentile(100) == pytest.approx(xs.max())
+    assert h.mean == pytest.approx(xs.mean(), rel=1e-9)
+
+
+def test_count_above_matches_numpy():
+    rng = np.random.default_rng(3)
+    xs = rng.lognormal(-6, 1.0, 10_000)
+    h = LogHistogram()
+    for x in xs:
+        h.record(float(x))
+    for q in (50, 90, 99):
+        t = float(np.percentile(xs, q))
+        want = int((xs > t).sum())
+        got = h.count_above(t)
+        assert abs(got - want) <= 0.3 * want + 30, (q, got, want)
+    assert h.count_above(xs.max()) == 0
+    assert h.count_above(-1.0) == len(xs)
+
+
+def test_zero_and_negative_values_take_the_zero_bucket():
+    h = LogHistogram()
+    h.record(0.0)
+    h.record(-2.5)
+    h.record(1.0)
+    assert h.count == 3 and h.zeros == 2
+    assert h.min == -2.5 and h.max == 1.0
+    assert sum(h.buckets.values()) == 1
+    assert h.percentile(10) <= 0.0
+
+
+# --- the serialize/merge law ------------------------------------------------
+
+def test_merge_is_associative_and_matches_single_recorder():
+    rng = np.random.default_rng(17)
+    xs = rng.lognormal(-5, 1.0, 9_000)
+    whole = LogHistogram()
+    parts = [LogHistogram() for _ in range(3)]
+    for i, x in enumerate(xs):
+        whole.record(float(x))
+        parts[i % 3].record(float(x))
+    m_left = LogHistogram.merge([LogHistogram.merge(parts[:2]), parts[2]])
+    m_right = LogHistogram.merge([parts[0], LogHistogram.merge(parts[1:])])
+
+    def strip_sum(d):
+        return {k: v for k, v in d.items() if k != "sum"}
+
+    # bucket-exact associativity; the float sum only to rounding
+    assert strip_sum(m_left.as_dict()) == strip_sum(m_right.as_dict())
+    assert strip_sum(m_left.as_dict()) == strip_sum(whole.as_dict())
+    assert m_left.total == pytest.approx(whole.total, rel=1e-9)
+
+
+def test_merge_under_concurrent_worker_observes():
+    """N worker threads observe into one enabled tracer (the
+    Tracer.run carry); the tracer's histogram must equal the
+    single-threaded merge of the per-worker sample sets — no lost or
+    double-counted samples under contention."""
+    t = Tracer(enabled=True)
+    per_worker = 2_000
+    workers = 6
+    rngs = [np.random.default_rng(100 + i) for i in range(workers)]
+    samples = [r.lognormal(-6, 1.0, per_worker) for r in rngs]
+
+    def work(i):
+        for x in samples[i]:
+            trace.observe("serve.lookup_seconds", float(x))
+
+    threads = [
+        threading.Thread(target=t.run, args=(work, i))
+        for i in range(workers)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    expect = LogHistogram()
+    for s in samples:
+        for x in s:
+            expect.record(float(x))
+    got = t.histograms()["serve.lookup_seconds"]
+    assert got.count == workers * per_worker
+    assert got.buckets == expect.buckets
+    assert got.total == pytest.approx(expect.total, rel=1e-6)
+
+
+def test_as_dict_round_trip_and_growth_mismatch():
+    h = LogHistogram()
+    for v in (0.001, 0.5, 3.0, 0.0):
+        h.record(v)
+    rt = LogHistogram.from_dict(json.loads(json.dumps(h.as_dict())))
+    assert rt.as_dict() == h.as_dict()
+    other = LogHistogram(growth=2.0)
+    with pytest.raises(ValueError, match="growth"):
+        h.merge_in(other)
+
+
+def test_subtract_is_the_window_delta():
+    h = LogHistogram()
+    for v in (0.001, 0.002):
+        h.record(v)
+    base = h.copy()
+    for v in (0.5, 0.6, 0.7):
+        h.record(v)
+    d = h.subtract(base)
+    assert d.count == 3
+    assert d.total == pytest.approx(1.8)
+    assert sum(d.buckets.values()) == 3
+    # a reset between snapshots (count went DOWN) degrades to "all
+    # new" — the whole current histogram, never a blind zero window
+    fresh = LogHistogram()
+    fresh.record(0.1)
+    d2 = fresh.subtract(h)
+    assert d2.count == 1 and d2.max == pytest.approx(0.1)
+    assert not any(c < 0 for c in d2.buckets.values())
+
+
+def test_scan_report_carries_and_merges_histograms():
+    def tracer_with(values):
+        t = Tracer(enabled=True)
+        for v in values:
+            t.observe("serve.lookup_seconds", v)
+        return t
+
+    r1 = tracer_with([0.001, 0.002]).scan_report()
+    r2 = tracer_with([0.100, 0.200]).scan_report()
+    rt = ScanReport.from_dict(json.loads(json.dumps(r1.as_dict())))
+    assert rt.histogram("serve.lookup_seconds").count == 2
+    merged = ScanReport.merge([r1, r2])
+    h = merged.histogram("serve.lookup_seconds")
+    assert h.count == 4
+    assert h.max == pytest.approx(0.2)
+    # pre-histogram dicts (older snapshots) still load
+    legacy = r1.as_dict()
+    del legacy["histograms"]
+    assert ScanReport.from_dict(legacy).histograms == {}
+
+
+# --- windows ----------------------------------------------------------------
+
+def test_histogram_window_records_only_while_open():
+    t = Tracer(enabled=True)
+    t.observe("serve.lookup_seconds", 0.5)       # before: not in window
+    w = t.histogram_window()
+    t.observe("serve.lookup_seconds", 0.001)
+    t.observe("serve.fair_wait_seconds", 0.002)
+    got = w.close()
+    t.observe("serve.lookup_seconds", 0.9)       # after close: ignored
+    assert got["serve.lookup_seconds"].count == 1
+    assert got["serve.fair_wait_seconds"].count == 1
+    assert t.histograms()["serve.lookup_seconds"].count == 3
+    assert w.close()["serve.lookup_seconds"].count == 1  # idempotent
+
+
+# --- the zero-cost disabled path (the PR 4 discipline) ----------------------
+
+class _PoisonedLock:
+    def __enter__(self):
+        raise AssertionError("disabled observe() acquired the lock")
+
+    def __exit__(self, *exc):
+        return False
+
+
+def test_disabled_observe_no_alloc_no_lock():
+    t = Tracer(enabled=False)
+    t._lock = _PoisonedLock()
+
+    def burst():
+        for _ in range(100):
+            trace.observe("serve.lookup_seconds", 0.001)
+
+    with trace.using(t):
+        burst()  # warm the call sites (and prove the lock stays idle)
+        gc.collect()
+        before = sys.getallocatedblocks()
+        burst()
+        gc.collect()
+        assert sys.getallocatedblocks() - before <= 2
+    t._lock = threading.Lock()
+    assert t.histograms() == {}
+
+
+# --- the xplane reader + clock rebase ---------------------------------------
+
+def _pb_varint(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _pb_field(fn, wt, payload):
+    tag = _pb_varint((fn << 3) | wt)
+    if wt == 2:
+        return tag + _pb_varint(len(payload)) + payload
+    return tag + payload
+
+
+def _tiny_xspace(marker_name, marker_off_ps, kernel_off_ps,
+                 line_ts_ns=1000):
+    """Hand-encode an XSpace: one plane, event metadata {1: marker,
+    2: 'fusion.1'}, one line with both events."""
+    def event(mid, off_ps, dur_ps):
+        return (_pb_field(1, 0, _pb_varint(mid))
+                + _pb_field(2, 0, _pb_varint(off_ps))
+                + _pb_field(3, 0, _pb_varint(dur_ps)))
+
+    def emeta(mid, name):
+        md = (_pb_field(1, 0, _pb_varint(mid))
+              + _pb_field(2, 2, name.encode()))
+        entry = _pb_field(1, 0, _pb_varint(mid)) + _pb_field(2, 2, md)
+        return _pb_field(4, 2, entry)
+
+    line = (_pb_field(1, 0, _pb_varint(7))
+            + _pb_field(2, 2, b"stream#0")
+            + _pb_field(3, 0, _pb_varint(line_ts_ns))
+            + _pb_field(4, 2, event(1, marker_off_ps, 500_000))
+            + _pb_field(4, 2, event(2, kernel_off_ps, 2_000_000)))
+    plane = (_pb_field(2, 2, b"/device:TPU:0")
+             + emeta(1, marker_name)
+             + emeta(2, "fusion.1")
+             + _pb_field(3, 2, line))
+    return _pb_field(1, 2, plane)
+
+
+def test_xplane_parse_and_clock_rebase(tmp_path):
+    from parquet_floor_tpu.utils.xplane import (
+        device_trace_events,
+        find_sync_event,
+        parse_xplane,
+    )
+
+    p = tmp_path / "host.xplane.pb"
+    # marker at line_ts 1000 ns + 3_000_000 ps = 4000 ns = 4 µs on the
+    # profiler clock; kernel 2 µs later
+    p.write_bytes(_tiny_xspace("pftpu_clock_sync", 3_000_000, 5_000_000))
+    planes = parse_xplane(str(p))
+    assert [pl.name for pl in planes] == ["/device:TPU:0"]
+    assert planes[0].lines[0].name == "stream#0"
+    assert find_sync_event(planes, "pftpu_clock_sync") == pytest.approx(4.0)
+    # host clock says the sync instant was at 10_000 µs since epoch:
+    # the kernel (profiler 6 µs) must land at 10_002 µs
+    evs = device_trace_events(
+        str(p), sync_marker="pftpu_clock_sync", host_sync_us=10_000.0
+    )
+    kernels = [e for e in evs if e.get("name") == "fusion.1"]
+    assert len(kernels) == 1
+    assert kernels[0]["ts"] == pytest.approx(10_002.0)
+    assert kernels[0]["dur"] == pytest.approx(2.0)
+    assert kernels[0]["cat"] == "xla"
+    assert kernels[0]["args"]["origin"] == "device"
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta} == {"/device:TPU:0",
+                                                "stream#0"}
+
+
+def test_xplane_rebase_without_marker_pins_earliest_event(tmp_path):
+    from parquet_floor_tpu.utils.xplane import device_trace_events
+
+    p = tmp_path / "host.xplane.pb"
+    p.write_bytes(_tiny_xspace("not_the_marker", 3_000_000, 5_000_000))
+    evs = device_trace_events(
+        str(p), sync_marker="pftpu_clock_sync", host_sync_us=500.0
+    )
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert min(e["ts"] for e in xs) == pytest.approx(500.0)
+
+
+def test_default_growth_is_sane():
+    assert 1.05 < GROWTH < 1.2
+
+
+def test_span_observe_records_the_span_wall():
+    """span(..., observe=name) records the SAME wall the stage stat
+    gets — one clock read, no drift between stats and histogram."""
+    t = Tracer(enabled=True)
+    with trace.using(t):
+        with trace.span("serve.lookup", observe="serve.lookup_seconds"):
+            pass
+        with trace.span("serve.lookup"):   # no observe=: no sample
+            pass
+    h = t.histograms()["serve.lookup_seconds"]
+    st = t.stats()["serve.lookup"]
+    assert h.count == 1 and st["count"] == 2
+    assert 0 <= h.total <= st["seconds"]
+    # disabled: the observing span is still the shared no-op instance
+    off = Tracer(enabled=False)
+    with trace.using(off):
+        assert trace.span("serve.lookup",
+                          observe="serve.lookup_seconds") is \
+            trace.span("decode")
+    assert off.histograms() == {}

@@ -1,0 +1,178 @@
+"""Prometheus/JSON metrics export: golden-text round-trip, the
+cross-process merge law, the live endpoint, and the file emitter
+(docs/observability.md)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from parquet_floor_tpu.utils import trace
+from parquet_floor_tpu.utils.metrics_export import (
+    FileMetricsEmitter,
+    MetricsServer,
+    merge_snapshots,
+    parse_prometheus,
+    render_prometheus,
+    sanitize,
+    snapshot,
+)
+from parquet_floor_tpu.utils.trace import Tracer
+
+
+def _fixed_tracer() -> Tracer:
+    t = Tracer(enabled=True)
+    t.count("serve.cache_hits", 7)
+    t.count("serve.cache_miss_bytes", 4096)
+    t.gauge_max("scan.queue_depth_max", 3)
+    t.add("decode", 0.25, 1000)
+    for v in (0.001, 0.001, 0.004):
+        t.observe("serve.lookup_seconds", v)
+    return t
+
+
+GOLDEN = """\
+# TYPE pftpu_serve_cache_hits counter
+pftpu_serve_cache_hits 7
+# TYPE pftpu_serve_cache_miss_bytes counter
+pftpu_serve_cache_miss_bytes 4096
+# TYPE pftpu_scan_queue_depth_max gauge
+pftpu_scan_queue_depth_max 3
+# TYPE pftpu_stage_count counter
+pftpu_stage_count{stage="decode"} 1
+# TYPE pftpu_stage_seconds_total counter
+pftpu_stage_seconds_total{stage="decode"} 0.25
+# TYPE pftpu_stage_bytes_total counter
+pftpu_stage_bytes_total{stage="decode"} 1000
+# TYPE pftpu_serve_lookup_seconds histogram
+pftpu_serve_lookup_seconds_bucket{le="0.00106494896"} 2
+pftpu_serve_lookup_seconds_bucket{le="0.00425979583"} 3
+pftpu_serve_lookup_seconds_bucket{le="+Inf"} 3
+pftpu_serve_lookup_seconds_sum 0.006
+pftpu_serve_lookup_seconds_count 3
+"""
+
+
+def test_golden_text_round_trip():
+    """The exposition text is pinned byte-for-byte, and the stdlib
+    parser reads every value back — format drift breaks HERE, not in a
+    scrape dashboard."""
+    text = render_prometheus(_fixed_tracer())
+    assert text == GOLDEN
+    parsed = parse_prometheus(text)
+    assert parsed["pftpu_serve_cache_hits"] == 7
+    assert parsed["pftpu_scan_queue_depth_max"] == 3
+    assert parsed['pftpu_stage_seconds_total{stage="decode"}'] == 0.25
+    assert parsed['pftpu_serve_lookup_seconds_bucket{le="+Inf"}'] == 3
+    assert parsed["pftpu_serve_lookup_seconds_count"] == 3
+    assert parsed["pftpu_serve_lookup_seconds_sum"] == pytest.approx(0.006)
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_prometheus("this is not exposition format\n")
+
+
+def test_sanitize_names():
+    assert sanitize("serve.lookup_seconds") == "pftpu_serve_lookup_seconds"
+    assert sanitize("io.remote.get_seconds.primary") == \
+        "pftpu_io_remote_get_seconds_primary"
+
+
+def test_histogram_buckets_are_cumulative_and_consistent():
+    text = render_prometheus(_fixed_tracer())
+    parsed = parse_prometheus(text)
+    buckets = sorted(
+        (float(k.split('le="')[1].rstrip('"}')), v)
+        for k, v in parsed.items()
+        if k.startswith("pftpu_serve_lookup_seconds_bucket")
+        and "+Inf" not in k
+    )
+    values = [v for _, v in buckets]
+    assert values == sorted(values)          # cumulative, never decreasing
+    assert values[-1] <= parsed["pftpu_serve_lookup_seconds_count"]
+
+
+def test_merge_snapshots_law():
+    a, b = snapshot(_fixed_tracer()), snapshot(_fixed_tracer())
+    m = merge_snapshots([a, b])
+    assert m["counters"]["serve.cache_hits"] == 14          # sums
+    assert m["gauges"]["scan.queue_depth_max"] == 3         # max
+    assert m["stages"]["decode"]["count"] == 2              # sums
+    assert m["histograms"]["serve.lookup_seconds"]["count"] == 6
+    # associative like ScanReport.merge
+    c = snapshot(_fixed_tracer())
+    left = merge_snapshots([merge_snapshots([a, b]), c])
+    right = merge_snapshots([a, merge_snapshots([b, c])])
+    assert left["counters"] == right["counters"]
+    assert left["histograms"] == right["histograms"]
+    with pytest.raises(ValueError):
+        merge_snapshots([])
+
+
+def test_metrics_server_serves_both_faces_and_404():
+    t = _fixed_tracer()
+    with MetricsServer(t, port=0) as srv:
+        text = urllib.request.urlopen(srv.url(), timeout=5).read().decode()
+        assert parse_prometheus(text)["pftpu_serve_cache_hits"] == 7
+        js = json.loads(urllib.request.urlopen(
+            srv.url("/metrics.json"), timeout=5
+        ).read().decode())
+        assert js["counters"]["serve.cache_hits"] == 7
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(srv.url("/nope"), timeout=5)
+        # live: a scrape after new traffic sees it
+        t.count("serve.cache_hits", 1)
+        text2 = urllib.request.urlopen(srv.url(), timeout=5).read().decode()
+        assert parse_prometheus(text2)["pftpu_serve_cache_hits"] == 8
+    srv.close()  # idempotent
+
+
+def test_serve_metrics_rides_the_active_tracer():
+    with trace.scope() as t:
+        trace.count("serve.cache_hits", 5)
+        with trace.serve_metrics(0) as srv:
+            text = urllib.request.urlopen(
+                srv.url(), timeout=5
+            ).read().decode()
+    assert parse_prometheus(text)["pftpu_serve_cache_hits"] == 5
+    assert t.counters()["serve.cache_hits"] == 5
+
+
+def test_concurrent_scrapes(tmp_path):
+    t = _fixed_tracer()
+    errors = []
+    with MetricsServer(t, port=0) as srv:
+        def scrape():
+            try:
+                for _ in range(5):
+                    body = urllib.request.urlopen(
+                        srv.url(), timeout=5
+                    ).read().decode()
+                    parse_prometheus(body)
+            except Exception as e:           # noqa: BLE001 (test harness)
+                errors.append(e)
+
+        threads = [threading.Thread(target=scrape) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    assert errors == []
+
+
+def test_file_emitter_writes_atomically(tmp_path):
+    t = _fixed_tracer()
+    path = tmp_path / "metrics.prom"
+    with FileMetricsEmitter(t, str(path), interval_s=30.0) as em:
+        em.emit()
+        parsed = parse_prometheus(path.read_text())
+        assert parsed["pftpu_serve_cache_hits"] == 7
+        t.count("serve.cache_hits", 3)
+    # close() wrote the final snapshot
+    assert parse_prometheus(path.read_text())["pftpu_serve_cache_hits"] == 10
+    assert not list(tmp_path.glob("*.tmp.*"))    # rename left no turds
+    with pytest.raises(ValueError, match="interval_s"):
+        FileMetricsEmitter(t, str(path), interval_s=0)

@@ -527,3 +527,42 @@ def test_lookup_concurrent_probes_with_tenant_attribution(keyed):
             assert out["b"] == [2 * (per + 10 + j) for j in range(20)]
             assert ta.tracer.counters()["serve.lookup_probes"] == 20
             assert tb.tracer.counters()["serve.lookup_probes"] == 20
+
+
+def test_per_tenant_histograms_disjoint_across_concurrent_scans(keyed):
+    """Two tenants probing CONCURRENTLY through their own scoped
+    tracers: each tenant's latency histogram must hold exactly its own
+    probes (count attribution), nothing leaked across scopes — the
+    distribution mirror of test_concurrent_tenant_reports_disjoint."""
+    probes = {"one": 9, "two": 17}
+    with Serving(prefetch_bytes=8 << 20) as srv:
+        t1 = srv.tenant("one", weight=2)
+        t2 = srv.tenant("two")
+        with Dataset(keyed, "k", cache=srv.cache) as ds:
+            ds.lookup(0)  # warm: opens files outside the timed scans
+
+            def run(tenant, n):
+                for i in range(n):
+                    ds.lookup(2 * i, columns=["k"], tenant=tenant)
+
+            threads = [
+                threading.Thread(target=run, args=(t1, probes["one"])),
+                threading.Thread(target=run, args=(t2, probes["two"])),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        for tenant, name in ((t1, "one"), (t2, "two")):
+            rep = tenant.report()
+            h = rep.histogram("serve.lookup_seconds")
+            assert h is not None and h.count == probes[name], name
+            assert rep.counters.get("serve.lookup_probes") == probes[name]
+        # and a scan's histograms stay inside the scanning tenant too
+        t3 = srv.tenant("three")
+        with t3.scan(keyed) as s:
+            for _ in s:
+                pass
+        assert "serve.lookup_seconds" not in t3.tracer.histograms()
+        assert t1.tracer.histograms()["serve.lookup_seconds"].count == \
+            probes["one"]

@@ -954,6 +954,300 @@ def serving_leg(n_rows: int) -> dict:
     return detail
 
 
+def _traffic_worker_pass(paths, shards, profile_kwargs, seed0: int) -> dict:
+    """One multi-worker scaling pass: a fresh ShmCacheTier, one
+    ``scripts/serve_worker.py`` subprocess per shard over the seeded
+    remote simulator, file-barrier start, per-worker walls from inside
+    the timed probe loops."""
+    import json as _json
+    import pathlib
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    from parquet_floor_tpu.serve import ShmCacheTier
+
+    worker_script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts",
+        "serve_worker.py",
+    )
+    tmp = tempfile.mkdtemp(prefix="pftpu_traffic_")
+    try:
+        with ShmCacheTier.create(data_bytes=64 << 20,
+                                 meta_bytes=16 << 20) as tier:
+            go = os.path.join(tmp, "go")
+            procs = []
+            for wi, shard in enumerate(shards):
+                cfg = {
+                    "mode": "scale",
+                    "shm": tier.name,
+                    "paths": paths,
+                    "warm_keys": shard[:1],
+                    "keys": shard[1:],
+                    "columns": ["k"],
+                    "tenant": f"scale-{wi}",
+                    "seed": seed0 + 100 * wi,
+                    "remote": profile_kwargs,
+                    "ready_file": os.path.join(tmp, f"ready-{wi}"),
+                    "go_file": go,
+                }
+                cfg_path = os.path.join(tmp, f"cfg-{wi}.json")
+                pathlib.Path(cfg_path).write_text(_json.dumps(cfg))
+                procs.append(subprocess.Popen(
+                    [_sys.executable, worker_script, cfg_path],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                ))
+            deadline = time.monotonic() + 300.0
+            while not all(
+                os.path.exists(os.path.join(tmp, f"ready-{wi}"))
+                for wi in range(len(shards))
+            ):
+                if time.monotonic() > deadline:
+                    for p in procs:
+                        p.kill()
+                    raise TimeoutError("traffic workers never all readied")
+                time.sleep(0.01)
+            pathlib.Path(go).touch()
+            results = []
+            for wi, p in enumerate(procs):
+                out, err = p.communicate(timeout=300)
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"traffic worker {wi} failed rc={p.returncode}:\n"
+                        f"{err.decode()[-2000:]}"
+                    )
+                results.append(_json.loads(out.decode().splitlines()[-1]))
+            shm = tier.stats()
+        probes = sum(r["probes"] for r in results)
+        wall = max(r["wall"] for r in results)
+        return {
+            "workers": len(shards),
+            "probes": probes,
+            "wall": wall,
+            "rps": probes / wall if wall > 0 else 0.0,
+            "rows": sum(r["rows"] for r in results),
+            "shm_singleflight_waits": shm["singleflight_waits"],
+            "shm_hits": shm["hits"],
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def traffic_leg(n_rows: int) -> dict:
+    """The production-traffic truth bench (docs/serving.md), gated by
+    ``check_bench_report.check_traffic_leg`` — the tail-latency metric
+    a millions-of-users tier actually lives by, in three seeded passes:
+
+    * **multi-worker scaling** — 1 vs 4 worker PROCESSES over one
+      shared ``ShmCacheTier`` and the seeded remote simulator
+      (latency-bound storage, the production regime): aggregate lookup
+      throughput at 4 workers must reach >= 2.5x one worker;
+    * **zipf open-loop** — Poisson arrivals at a fixed rate, zipf key
+      popularity, weight-skewed tenants, over the
+      ``SimulatedRemoteSource`` fault domain (transient faults +
+      retries live): per-request latency measured from SCHEDULED
+      arrival (queueing included — open-loop truth, not closed-loop
+      flattery), p99 must hold the recorded SLO target;
+    * **device-time fairness** — a 100%-cache-hit tenant offering 3x a
+      light tenant's load through a 1-lane device WFQ gate must be held
+      to its WEIGHT share of engine time (equal weights here: 0.5
+      each), within the recorded band — storage bytes it never touches
+      cannot buy it the decode engine.
+    """
+    import threading as _threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from parquet_floor_tpu import ReaderOptions
+    from parquet_floor_tpu.serve import Dataset, Serving
+    from parquet_floor_tpu.testing import RemoteProfile, SimulatedRemoteSource
+    from parquet_floor_tpu.utils.histogram import LogHistogram
+
+    paths, per, group, page = _serving_paths(n_rows)
+    n_files = len(paths)
+    # one key per data page, spread across files and groups
+    keys = [
+        2 * (f * per + g * group + off)
+        for f in range(n_files)
+        for g in range(per // group)
+        for off in range(page // 2, group, page)
+    ]
+
+    # -- pass 1: multi-worker scaling over the shm tier ---------------------
+    profile_kwargs = {"base_latency_s": 0.015, "jitter_s": 0.002}
+    one = _traffic_worker_pass(paths, [keys], profile_kwargs, seed0=9000)
+    n_workers = 4
+    shards = [keys[i::n_workers] for i in range(n_workers)]
+    many = _traffic_worker_pass(paths, shards, profile_kwargs, seed0=9500)
+    scaling_x = many["rps"] / one["rps"] if one["rps"] else 0.0
+
+    # -- pass 2: zipf open-loop Poisson over the fault domain ---------------
+    rate_rps = float(os.environ.get("PFTPU_BENCH_TRAFFIC_RPS", 120.0))
+    duration_s = float(os.environ.get("PFTPU_BENCH_TRAFFIC_S", 3.0))
+    slo_p99_s = float(os.environ.get("PFTPU_BENCH_TRAFFIC_SLO_S", 0.25))
+    zipf_a = 1.4
+    rng = np.random.default_rng(424242)
+    profile = RemoteProfile(base_latency_s=0.006, jitter_s=0.002,
+                            tail_p=0.02, tail_latency_s=0.02,
+                            fault_rate=0.01)
+    factories = [
+        (lambda p=p, i=i: SimulatedRemoteSource(
+            p, profile=profile, seed=7700 + i, fetch_threads=4
+        ))
+        for i, p in enumerate(paths)
+    ]
+    tenant_weights = {"gold": 2.0, "silver": 1.0, "bronze": 1.0}
+    w_total = sum(tenant_weights.values())
+    tnames = sorted(tenant_weights)
+    tprobs = np.array([tenant_weights[t] for t in tnames]) / w_total
+    n_req = max(int(rate_rps * duration_s), 50)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, size=n_req))
+    req_tenants = rng.choice(len(tnames), size=n_req, p=tprobs)
+    ranks = rng.zipf(zipf_a, size=n_req)
+    req_keys = [keys[int(r) % len(keys)] for r in ranks]
+    hists = {t: LogHistogram() for t in tnames}
+    agg_hist = LogHistogram()
+    hist_lock = _threading.Lock()
+    with Serving(prefetch_bytes=32 << 20, device_lanes=2) as srv:
+        tenants = {t: srv.tenant(t, w) for t, w in tenant_weights.items()}
+        with Dataset(
+            factories, "k",
+            options=ReaderOptions(io_retries=3, io_retry_backoff_s=0.005),
+        ) as ds:
+            ds.lookup(keys[0])   # open files, pin metadata (untimed)
+
+            def fire(t_sched, tenant_name, key):
+                ds.lookup(key, columns=["k"],
+                          tenant=tenants[tenant_name])
+                lat = time.perf_counter() - t_sched
+                with hist_lock:
+                    hists[tenant_name].record(lat)
+                    agg_hist.record(lat)
+
+            with ThreadPoolExecutor(max_workers=24) as pool:
+                t0 = time.perf_counter()
+                futs = []
+                for i in range(n_req):
+                    t_sched = t0 + float(arrivals[i])
+                    delay = t_sched - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    # open loop: submitted at the SCHEDULED time, never
+                    # held back by completions; latency counts from the
+                    # schedule, so queueing is in the number
+                    futs.append(pool.submit(
+                        fire, t_sched, tnames[int(req_tenants[i])],
+                        req_keys[i],
+                    ))
+                for f in futs:
+                    f.result()
+        retries = sum(
+            t.tracer.counters().get("io.retries", 0)
+            for t in tenants.values()
+        )
+    p99_s = agg_hist.percentile(99)
+    open_loop = {
+        "requests": n_req,
+        "rate_rps": rate_rps,
+        "zipf_a": zipf_a,
+        "p50_ms": round(agg_hist.percentile(50) * 1e3, 3),
+        "p99_ms": round(p99_s * 1e3, 3),
+        "slo_p99_ms": slo_p99_s * 1e3,
+        "slo_ok": bool(p99_s <= slo_p99_s),
+        "retries": retries,
+        "tenant_p99_ms": {
+            t: round(hists[t].percentile(99) * 1e3, 3) for t in tnames
+        },
+        "hist": agg_hist.as_dict(),
+    }
+
+    # -- pass 3: device-time fairness under a cache-hot aggressor -----------
+    # same workload twice: once effectively UNGATED (8 lanes — more
+    # than the threads can fill, sessions only measure) and once
+    # through the 1-lane WFQ gate.  The aggressor (3x the light
+    # tenant's threads, equal weights, everything cache-hot) must
+    # exceed its weight share without the gate and be held to it with.
+    fair_s = float(os.environ.get("PFTPU_BENCH_FAIR_S", 2.0))
+    fair_band = 0.12
+
+    def fair_pass(lanes: int) -> dict:
+        with Serving(prefetch_bytes=32 << 20, device_lanes=lanes) as srv:
+            hot = srv.tenant("hot", weight=1.0)
+            light = srv.tenant("light", weight=1.0)
+            with Dataset(paths, "k", cache=srv.cache) as ds:
+                for k in keys:   # warm the EXACT probe shape: cache-hot
+                    ds.range(k, k + 2 * page, columns=["k"])
+                t_end = time.perf_counter() + fair_s
+
+                def hammer(tenant):
+                    i = 0
+                    while time.perf_counter() < t_end:
+                        # a 2-page range per probe: device work heavy
+                        # enough that both tenants stay backlogged at
+                        # the gate (the WFQ guarantee's precondition)
+                        k = keys[i % len(keys)]
+                        ds.range(k, k + 2 * page, columns=["k"],
+                                 tenant=tenant)
+                        i += 1
+
+                threads = [
+                    _threading.Thread(target=hammer, args=(hot,))
+                    for _ in range(6)
+                ] + [
+                    _threading.Thread(target=hammer, args=(light,))
+                    for _ in range(2)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            hot_s = hot.tracer.histograms()["serve.device_seconds"].total
+            light_s = (
+                light.tracer.histograms()["serve.device_seconds"].total
+            )
+            hc = hot.tracer.counters()
+            hb = hc.get("serve.cache_hit_bytes", 0)
+            mb = hc.get("serve.cache_miss_bytes", 0)
+            return {
+                "share": hot_s / (hot_s + light_s),
+                "waits": (
+                    hc.get("serve.device_waits", 0)
+                    + light.tracer.counters().get("serve.device_waits", 0)
+                ),
+                "hit_rate_hot": hb / (hb + mb) if hb + mb else 0.0,
+            }
+
+    ungated = fair_pass(lanes=8)
+    gated = fair_pass(lanes=1)
+
+    return {
+        "traffic_worker1_rps": round(one["rps"], 1),
+        "traffic_workers": many["workers"],
+        "traffic_workers_rps": round(many["rps"], 1),
+        "traffic_scaling_x": round(scaling_x, 3),
+        "traffic_shm_singleflight_waits": many["shm_singleflight_waits"],
+        "traffic_requests": open_loop["requests"],
+        "traffic_rate_rps": open_loop["rate_rps"],
+        "traffic_zipf_a": open_loop["zipf_a"],
+        "traffic_p50_ms": open_loop["p50_ms"],
+        "traffic_p99_ms": open_loop["p99_ms"],
+        "traffic_slo_p99_ms": open_loop["slo_p99_ms"],
+        "traffic_slo_ok": open_loop["slo_ok"],
+        "traffic_retries": open_loop["retries"],
+        "traffic_tenant_p99_ms": open_loop["tenant_p99_ms"],
+        "traffic_hist": open_loop["hist"],
+        "traffic_fair_share_hot": round(gated["share"], 4),
+        "traffic_fair_share_hot_ungated": round(ungated["share"], 4),
+        "traffic_fair_ideal": 0.5,
+        "traffic_fairness_err": round(abs(gated["share"] - 0.5), 4),
+        "traffic_fair_band": fair_band,
+        "traffic_fair_device_waits": gated["waits"],
+        "traffic_fair_hot_hit_rate": round(gated["hit_rate_hot"], 4),
+    }
+
+
 def write_leg(n_rows: int, reps: int) -> dict:
     """Device write path (docs/write.md), gated by
     ``check_bench_report.check_write_leg``: the fused encode engine
@@ -1492,6 +1786,10 @@ def main():
     # shared buffer cache + the one-page point-lookup proof — no device
     # work, no D2H, runs once
     serving_detail = serving_leg(n_rows)
+    # process-scale traffic truth bench (docs/serving.md): subprocess
+    # workers + modeled remote latency — real sleeps, no device work,
+    # runs once like the remote leg
+    traffic_detail = traffic_leg(n_rows)
     # exec-cache cold/warm leg (docs/perf.md): runs in SUBPROCESSES
     # (fresh jax each), so its placement among the timed legs is free
     exec_cache_detail = exec_cache_leg(n_rows)
@@ -1553,6 +1851,7 @@ def main():
             **scan_detail,
             **remote_detail,
             **serving_detail,
+            **traffic_detail,
             **exec_cache_detail,
             **pushdown_detail,
             **write_detail,

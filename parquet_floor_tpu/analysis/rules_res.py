@@ -80,6 +80,14 @@ _ACQUIRERS = {
     # all four release with close() and leak exactly like an fd if a
     # raise lands between acquisition and release
     "SharedBufferCache", "Serving", "Tenant", "Dataset",
+    # process-scale serving (serve/shm_cache.py, serve/daemon.py): a
+    # ShmCacheTier maps a SHARED MEMORY segment (+ a lock-file fd; the
+    # creator's close() is also the segment's unlink — leaking one
+    # leaks host-wide memory, not just a process resource), a
+    # ServeDaemon owns a listening socket + an event-loop thread + a
+    # worker pool, and a DaemonClient holds a live connection a server
+    # drain then has to wait out
+    "ShmCacheTier", "ServeDaemon", "DaemonClient",
     # the write path (write/, docs/write.md): a DeviceFileWriter owns a
     # sink fd AND a compression pool (close() finalizes the footer,
     # abort() releases without one — both are releases), and the
@@ -93,12 +101,21 @@ _ACQUIRERS = {
 # everything else with close())
 _RELEASERS = ("close", "shutdown", "abort")
 
+# classmethod constructors on an acquirer are acquisitions too:
+# ``ShmCacheTier.create(...)`` maps the segment and
+# ``ShmCacheTier.attach(...)`` opens the lock-file fd just as surely
+# as the bare constructor would
+_FACTORY_VERBS = ("create", "attach")
+
 
 def _is_acquisition(node: ast.Call) -> bool:
     f = node.func
     if isinstance(f, ast.Name) and f.id == "open":
         return True
     if last_part(f) in _ACQUIRERS:
+        return True
+    if isinstance(f, ast.Attribute) and f.attr in _FACTORY_VERBS and \
+            last_part(f.value) in _ACQUIRERS:
         return True
     if isinstance(f, ast.Attribute) and f.attr == "mmap" and \
             last_part(f.value) == "mmap":

@@ -1,32 +1,48 @@
 """parquet_floor_tpu.serve — the multi-tenant dataset-serving layer.
 
-Three pieces compose the serving story on top of the scan/remote/data
+The pieces compose the serving story on top of the scan/remote/data
 stack (``docs/serving.md``):
 
 * :class:`SharedBufferCache` / :class:`CachedSource` — one process-wide
   two-tier byte cache (pinned metadata, LRU data extents) with
   single-flight storage reads, dropped into the existing scan source
   chain (``serve.cache``);
+* :class:`ShmCacheTier` — the CROSS-PROCESS tier below it: one
+  shared-memory segment per host with lease-based cross-process
+  single-flight, so N worker processes issue one storage read per
+  unique range between them (``serve.shm_cache``);
 * :class:`Serving` / :class:`Tenant` — per-tenant budget admission,
-  weighted-fair scheduling of storage reads, and per-tenant tracer
-  scopes so every client gets its own
+  weighted-fair scheduling of BOTH storage reads and decode-engine
+  time (the device-WFQ gate), and per-tenant tracer scopes so every
+  client gets its own
   :class:`~parquet_floor_tpu.utils.trace.ScanReport`
   (``serve.tenancy``);
-* :class:`Dataset` — point/range lookups descending the format's
-  pruning ladder (footer stats → bloom filter → page indexes) to read
-  exactly the candidate page(s) (``serve.lookup``).
+* :class:`Dataset` / :class:`RangeCursor` — point/range lookups
+  descending the format's pruning ladder (footer stats → bloom filter
+  → page indexes) to read exactly the candidate page(s), with a
+  bounded-memory resumable cursor face and per-file negative-lookup
+  caching (``serve.lookup``);
+* :class:`ServeDaemon` / :class:`DaemonClient` — the socket front
+  door: per-connection tenant attribution, admission control,
+  graceful drain, multi-worker metrics fold (``serve.daemon``).
 """
 
 from .cache import CachedSource, SharedBufferCache, source_key
-from .lookup import Dataset
+from .daemon import DaemonClient, ServeDaemon
+from .lookup import Dataset, RangeCursor
+from .shm_cache import ShmCacheTier
 from .slo import SloMonitor, SloStatus, SloTarget
 from .tenancy import Serving, Tenant
 
 __all__ = [
     "CachedSource",
+    "DaemonClient",
     "Dataset",
+    "RangeCursor",
+    "ServeDaemon",
     "Serving",
     "SharedBufferCache",
+    "ShmCacheTier",
     "SloMonitor",
     "SloStatus",
     "SloTarget",

@@ -120,16 +120,24 @@ class SharedBufferCache:
     evicts least-recently-used entries when over budget
     (``serve.cache_evictions``); the pinned tier evicts only when ITS
     budget overflows (``serve.meta_evictions`` — visible, never silent).
+
+    ``shm`` optionally mounts a cross-process
+    :class:`~parquet_floor_tpu.serve.shm_cache.ShmCacheTier` BELOW this
+    cache: a lead that misses here consults (and populates) the shared
+    segment before touching storage, so the single-flight law holds
+    across worker processes, not just threads (docs/serving.md).  The
+    caller keeps ownership of the tier (close order: cache, then tier).
     """
 
     def __init__(self, data_bytes: int = 256 << 20,
-                 meta_bytes: int = 64 << 20):
+                 meta_bytes: int = 64 << 20, shm=None):
         if data_bytes <= 0:
             raise ValueError(f"data_bytes must be > 0, got {data_bytes}")
         if meta_bytes <= 0:
             raise ValueError(f"meta_bytes must be > 0, got {meta_bytes}")
         self.data_bytes = int(data_bytes)
         self.meta_bytes = int(meta_bytes)
+        self.shm = shm
         self._lock = threading.Lock()
         self._files: Dict[tuple, _FileIndex] = {}
         # LRU order per tier: dict preserves insertion order; a touch
@@ -316,7 +324,15 @@ class SharedBufferCache:
         if leads:
             lead_ranges = [(o, n) for _, o, n in leads]
             try:
-                bufs = read_many_fn(lead_ranges)
+                if self.shm is not None:
+                    # the cross-process tier sits between this cache
+                    # and storage: shm hits (and waits on another
+                    # worker's in-flight read) never reach read_many_fn
+                    bufs = self.shm.read_through(
+                        key, lead_ranges, read_many_fn, pinned=pinned
+                    )
+                else:
+                    bufs = read_many_fn(lead_ranges)
             except BaseException as e:
                 with self._lock:
                     for _, o, n in leads:

@@ -58,14 +58,16 @@ _META_GAP = 0
 
 class _LookupFile:
     """One open file of the dataset: shared-cache-backed source, its
-    reader, and the per-file probe lock."""
+    reader, the per-file probe lock, and the per-file negative cache
+    (keys this file PROVABLY lacks — insertion-ordered dict as LRU)."""
 
-    __slots__ = ("source", "reader", "lock")
+    __slots__ = ("source", "reader", "lock", "neg")
 
     def __init__(self, source: CachedSource, reader: ParquetFileReader):
         self.source = source
         self.reader = reader
         self.lock = threading.Lock()
+        self.neg: Dict[object, bool] = {}
 
 
 def _metadata_ranges(reader: ParquetFileReader) -> List[tuple]:
@@ -111,9 +113,14 @@ class Dataset:
     def __init__(self, sources: Sequence, key_column: str,
                  columns: Optional[Sequence[str]] = None,
                  cache: Optional[SharedBufferCache] = None,
-                 options: Optional[ReaderOptions] = None):
+                 options: Optional[ReaderOptions] = None,
+                 negative_keys: int = 1024):
         if not key_column:
             raise ValueError("key_column must name a column")
+        if negative_keys < 0:
+            raise ValueError(
+                f"negative_keys must be >= 0, got {negative_keys}"
+            )
         if options is not None and options.salvage:
             raise UnsupportedFeatureError(
                 "Dataset lookup does not support salvage mode: quarantine "
@@ -126,6 +133,7 @@ class Dataset:
         self._own_cache = cache is None
         self.cache = cache if cache is not None else SharedBufferCache()
         self._options = options
+        self._negative_keys = int(negative_keys)
         self._files: Dict[int, _LookupFile] = {}
         self._open_lock = threading.Lock()
         self._closed = False
@@ -251,12 +259,96 @@ class Dataset:
                     pages += 1
         return pages
 
-    def _probe(self, pred, columns, tenant, limit):
+    def _device(self, tenant):
+        """The device-time WFQ slice for one group's decode: a tenant-
+        attributed probe queues for a decode lane in weighted virtual-
+        time order (``Tenant.device_session``), so a cache-hot tenant's
+        probes cannot monopolize the decode engine.  Tenant-less probes
+        run ungated (no serving context to arbitrate)."""
+        if tenant is not None and hasattr(tenant, "device_session"):
+            return tenant.device_session()
+        return contextlib.nullcontext()
+
+    def _neg_check(self, lf: _LookupFile, neg_key) -> bool:
+        """True when the per-file negative cache proves ``neg_key``
+        absent from this file (an earlier probe descended the ladder
+        and found nothing) — the stats/bloom rungs short-circuit."""
+        if neg_key is None or not self._negative_keys:
+            return False
+        with lf.lock:
+            if neg_key in lf.neg:
+                # touch (dict order is the LRU order)
+                del lf.neg[neg_key]
+                lf.neg[neg_key] = True
+                return True
+        return False
+
+    def _neg_record(self, lf: _LookupFile, neg_key) -> None:
+        if neg_key is None or not self._negative_keys:
+            return
+        with lf.lock:
+            if neg_key not in lf.neg and \
+                    len(lf.neg) >= self._negative_keys:
+                lf.neg.pop(next(iter(lf.neg)))
+            lf.neg[neg_key] = True
+
+    def _group_rows(self, lf: _LookupFile, gi: int, pred, filter_set,
+                    tenant, columns) -> list:
+        """ONE row group's descent of the pruning ladder — the shared
+        engine behind the probe and cursor faces: footer stats → bloom
+        → page-index rungs under the file lock, then the ranged decode
+        + exact filter inside a device-time slice (per-group locks so
+        a lane wait never head-of-line-blocks other probes of the
+        file).  Returns ``[(row_index, row_dict), ...]`` for the
+        matching rows (empty when any rung killed the group); the
+        batch is probe-local, so the mask/convert tail runs unlocked.
+        """
         import numpy as np
 
         from ..batch.predicate import eval_mask
         from ..scan.executor import _batch_resolver
 
+        reader = lf.reader
+        with lf.lock:
+            rg = reader.row_groups[gi]
+            if not pred.may_match(rg):
+                trace.count("serve.lookup_groups_pruned")
+                return []
+            if not pred.may_match_with(reader, rg):
+                # stats kept it, the bloom filter killed it
+                trace.count("serve.lookup_bloom_skips")
+                return []
+            rr = pred.row_ranges(reader, gi)
+        if not rr:
+            # every page's ColumnIndex ruled it out
+            trace.count("serve.lookup_groups_pruned")
+            return []
+        with self._device(tenant):
+            with lf.lock:
+                batch, covered = reader.read_row_group_ranges(
+                    gi, rr, filter_set
+                )
+                if not covered:
+                    return []
+                trace.count(
+                    "serve.lookup_pages_read",
+                    self._pages_in(reader, rg, covered, filter_set),
+                )
+            # the exact-filter rung rides the SAME predicate-mask
+            # compiler as the pushdown compute tail (one filter
+            # semantics); only matching rows pay cell conversion
+            sel = eval_mask(pred, _batch_resolver(batch),
+                            batch.num_rows)
+            hits = np.flatnonzero(sel)
+            if not hits.size:
+                return []
+            cursors = self._out_columns(batch, columns)
+            return [
+                (int(r), {n: c.cell(int(r)) for n, c in cursors})
+                for r in hits
+            ]
+
+    def _probe(self, pred, columns, tenant, limit, neg_key=None):
         ctx = (
             trace.using(tenant.tracer)
             if tenant is not None else contextlib.nullcontext()
@@ -276,51 +368,25 @@ class Dataset:
                 if done:
                     break
                 lf = self._file(i)
-                with lf.lock:
-                    reader = lf.reader
-                    for gi, rg in enumerate(reader.row_groups):
+                if self._neg_check(lf, neg_key):
+                    trace.count("serve.negative_hits")
+                    continue
+                file_rows0 = len(out)
+                for gi in range(len(lf.reader.row_groups)):
+                    if limit is not None and len(out) >= limit:
+                        done = True
+                        break
+                    for _r, row in self._group_rows(
+                        lf, gi, pred, filter_set, tenant, columns
+                    ):
+                        out.append(row)
                         if limit is not None and len(out) >= limit:
-                            done = True
                             break
-                        if not pred.may_match(rg):
-                            trace.count("serve.lookup_groups_pruned")
-                            continue
-                        if not pred.may_match_with(reader, rg):
-                            # stats kept it, the bloom filter killed it
-                            trace.count("serve.lookup_bloom_skips")
-                            continue
-                        rr = pred.row_ranges(reader, gi)
-                        if not rr:
-                            # every page's ColumnIndex ruled it out
-                            trace.count("serve.lookup_groups_pruned")
-                            continue
-                        batch, covered = reader.read_row_group_ranges(
-                            gi, rr, filter_set
-                        )
-                        if not covered:
-                            continue
-                        trace.count(
-                            "serve.lookup_pages_read",
-                            self._pages_in(reader, rg, covered, filter_set),
-                        )
-                        # rung 4 — the exact filter rides the SAME
-                        # predicate-mask compiler as the pushdown
-                        # compute tail (one filter semantics, vectorized
-                        # over the page batch; only matching rows pay
-                        # cell conversion)
-                        sel = eval_mask(
-                            pred, _batch_resolver(batch), batch.num_rows
-                        )
-                        hits = np.flatnonzero(sel)
-                        if not hits.size:
-                            continue
-                        cursors = self._out_columns(batch, columns)
-                        for r in hits:
-                            out.append(
-                                {n: c.cell(int(r)) for n, c in cursors}
-                            )
-                            if limit is not None and len(out) >= limit:
-                                break
+                if not done and len(out) == file_rows0:
+                    # the whole file was descended and yielded nothing:
+                    # for an immutable corpus that PROVES the key
+                    # absent here — the next probe short-circuits
+                    self._neg_record(lf, neg_key)
             if limit is not None:
                 out = out[:limit]
             # counted HERE, after any limit stop, so the registered rows
@@ -334,9 +400,13 @@ class Dataset:
                tenant=None, limit: Optional[int] = None) -> List[dict]:
         """Rows whose ``key_column`` equals ``key``, as dicts.  ``limit``
         stops the probe early (a unique-key point read passes
-        ``limit=1``)."""
+        ``limit=1``).  Repeatedly-probed ABSENT keys short-circuit at
+        the stats/bloom rung via the per-file negative cache
+        (``serve.negative_hits``) — sized by ``negative_keys``, sound
+        for the immutable corpora this face serves."""
         return self._probe(
-            col(self.key_column) == key, columns, tenant, limit
+            col(self.key_column) == key, columns, tenant, limit,
+            neg_key=key,
         )
 
     def range(self, lo, hi, columns: Optional[Sequence[str]] = None,
@@ -345,6 +415,50 @@ class Dataset:
         as dicts."""
         pred = (col(self.key_column) >= lo) & (col(self.key_column) <= hi)
         return self._probe(pred, columns, tenant, limit)
+
+    def range_cursor(self, lo, hi,
+                     columns: Optional[Sequence[str]] = None,
+                     tenant=None, page_rows: int = 256,
+                     cursor: Optional[dict] = None) -> "RangeCursor":
+        """A bounded-memory streaming face over a (possibly huge)
+        ``range()`` result: rows come out in ladder order, at most one
+        row group decoded and held at a time, paged ``page_rows`` at a
+        time.  ``cursor`` resumes from a previous cursor's
+        :attr:`RangeCursor.token` — the token is a plain position dict
+        (file, group, row), so it survives JSON and process boundaries
+        (the serving daemon's paging protocol rides it)."""
+        return RangeCursor(self, lo, hi, columns, tenant, page_rows,
+                           cursor)
+
+    def _range_rows(self, pred, columns, tenant, start):
+        """Generator behind :class:`RangeCursor`: ``(file_index,
+        group_index, row_in_group, row_dict)`` for every matching row
+        at or after ``start`` (exclusive of the already-delivered
+        ``start['r']``), descending the same pruning ladder as
+        :meth:`_probe` one group at a time (`_group_rows` — ONE
+        ladder implementation for both faces).  The device slice is
+        released before any row is yielded: a paused consumer must
+        never park a decode lane."""
+        filter_set = self._filter_set(columns)
+        f0 = int(start["f"]) if start else 0
+        for i in range(f0, len(self._sources)):
+            lf = self._file(i)
+            g0 = int(start["g"]) if start and i == f0 else 0
+            for gi in range(g0, len(lf.reader.row_groups)):
+                r0 = (
+                    int(start["r"]) + 1
+                    if start and i == f0 and gi == g0 else 0
+                )
+                ctx = (
+                    trace.using(tenant.tracer)
+                    if tenant is not None else contextlib.nullcontext()
+                )
+                with ctx:
+                    ready = self._group_rows(lf, gi, pred, filter_set,
+                                             tenant, columns)
+                for r, row in ready:
+                    if r >= r0:
+                        yield i, gi, r, row
 
     def aggregate(self, aggregate, predicate=None, tenant=None):
         """Answer an aggregate query over the dataset's files without
@@ -395,16 +509,22 @@ class Dataset:
                             if not predicate.may_match_with(reader, rg):
                                 trace.count("serve.lookup_bloom_skips")
                                 continue
-                        batch = reader.read_row_group(gi, filter_set)
-                    resolve = _batch_resolver(batch)
-                    n = int(batch.num_rows)
-                    sel = (
-                        eval_mask(predicate, resolve, n)
-                        if predicate is not None else None
-                    )
-                    out.combine(
-                        host_partial(aggregate, resolve, n, sel)
-                    )
+                    # one device-time slice per group decode, same as
+                    # the probe face: a full-group aggregate is the
+                    # HEAVIEST engine work this face does, exactly what
+                    # the WFQ device gate exists to interleave
+                    with self._device(tenant):
+                        with lf.lock:
+                            batch = reader.read_row_group(gi, filter_set)
+                        resolve = _batch_resolver(batch)
+                        n = int(batch.num_rows)
+                        sel = (
+                            eval_mask(predicate, resolve, n)
+                            if predicate is not None else None
+                        )
+                        out.combine(
+                            host_partial(aggregate, resolve, n, sel)
+                        )
         return out
 
     def page_size_bound(self) -> int:
@@ -445,3 +565,69 @@ class Dataset:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class RangeCursor:
+    """Streaming, resumable view of one ``Dataset.range`` result
+    (created via :meth:`Dataset.range_cursor`; module docstring).
+
+    Memory is bounded by ONE row group's matching rows regardless of
+    the range's total size.  :meth:`next_page` returns up to
+    ``page_rows`` row dicts (``[]`` once exhausted); :attr:`token` is
+    the JSON-safe resume position AFTER the rows delivered so far —
+    feed it to ``range_cursor(..., cursor=token)`` (any process, any
+    time) to continue exactly where this cursor stopped, each row
+    delivered exactly once.  Iterating the cursor pages internally."""
+
+    def __init__(self, ds: Dataset, lo, hi, columns, tenant,
+                 page_rows: int, token: Optional[dict]):
+        if page_rows <= 0:
+            raise ValueError(f"page_rows must be > 0, got {page_rows}")
+        if token is not None and not {"f", "g", "r"} <= set(token):
+            raise ValueError(f"malformed cursor token: {token!r}")
+        self.page_rows = int(page_rows)
+        self._tenant = tenant
+        pred = (col(ds.key_column) >= lo) & (col(ds.key_column) <= hi)
+        self._gen = ds._range_rows(pred, columns, tenant, token)
+        self._token = dict(token) if token is not None else None
+        self._exhausted = False
+
+    @property
+    def token(self) -> Optional[dict]:
+        """The resume position (``None`` once the range is exhausted —
+        nothing left to resume)."""
+        if self._exhausted:
+            return None
+        return dict(self._token) if self._token is not None else {
+            "f": 0, "g": 0, "r": -1,
+        }
+
+    @property
+    def exhausted(self) -> bool:
+        return self._exhausted
+
+    def next_page(self) -> List[dict]:
+        """Up to ``page_rows`` more rows (``[]`` when done)."""
+        rows: List[dict] = []
+        for f, g, r, row in self._gen:
+            rows.append(row)
+            self._token = {"f": f, "g": g, "r": r}
+            if len(rows) >= self.page_rows:
+                break
+        else:
+            self._exhausted = True
+        ctx = (
+            trace.using(self._tenant.tracer)
+            if self._tenant is not None else contextlib.nullcontext()
+        )
+        with ctx:
+            trace.count("serve.cursor_pages")
+            trace.count("serve.lookup_rows", len(rows))
+        return rows
+
+    def __iter__(self):
+        while True:
+            page = self.next_page()
+            if not page:
+                return
+            yield from page

@@ -1,0 +1,588 @@
+"""Cross-process cache tier over ``multiprocessing.shared_memory``.
+
+PR 9's :class:`~parquet_floor_tpu.serve.cache.SharedBufferCache` is
+process-wide; production serving is N worker processes per host, and
+each of them duplicating the cache multiplies both the memory AND the
+storage reads N-fold — the single-flight law stopped at the process
+boundary.  :class:`ShmCacheTier` is the shared tier below every
+worker's in-process cache:
+
+* **one segment, two rings** — a ``data`` ring and a ``meta`` ring
+  (the pinned-metadata law: footer/page-index/bloom/dictionary bytes
+  have their own budget, so data churn never evicts them) carved out of
+  one ``SharedMemory`` segment, each a log-structured ring heap whose
+  eviction is counted, never silent;
+* **exact-range keying** — entries are keyed by a 128-bit digest of
+  ``(file key, offset, length)``.  Every worker runs the same planner,
+  so identical requests dedupe across processes; *containment* lookups
+  (a sub-range of a cached extent) are the in-process L1's job —
+  :class:`~parquet_floor_tpu.serve.cache.SharedBufferCache` sits above
+  this tier and keeps that law;
+* **cross-process single-flight** — a fixed flight table in the
+  segment: the first process to miss a range registers a *lease* and
+  leads the storage read; concurrent processes (and threads) requesting
+  the same range poll for the leader's bytes instead of re-issuing the
+  read (``serve.shm_singleflight_waits``).  A leader that dies or
+  stalls past its lease is *taken over* (``serve.shm_takeovers``): a
+  waiter claims the flight and re-issues — the cross-process analogue
+  of "a failed leader clears the flight so retries re-issue cleanly"
+  (an exception cannot propagate across processes, so re-leading IS the
+  propagation);
+* **eviction-safe borrows** — readers copy payload bytes OUT of the
+  segment under the lock, so eviction (which may overwrite ring bytes)
+  can never corrupt a borrowed buffer, only forget the entry.  This is
+  the same law as the in-process tier, met by copy-out instead of
+  immutable views (a view into a mutable shared ring would be exactly
+  the corruption the law forbids).
+
+Mutual exclusion is ``fcntl.flock`` on a sidecar lock file (works
+between unrelated processes — workers need not be fork children) under
+a per-process ``threading.Lock`` (flock is per-open-file-description,
+so threads of one process must serialize around it themselves).  All
+storage I/O and all polling sleeps happen OUTSIDE the lock.
+
+Attach with :meth:`ShmCacheTier.attach` from worker processes; the
+creating process owns the segment and unlinks it on close.  Stats live
+in the segment header, so :meth:`stats` is the cross-process truth the
+multi-process smoke asserts.  Docs: ``docs/serving.md``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import hashlib
+import os
+import struct
+import tempfile
+import threading
+import time
+from multiprocessing import shared_memory
+from typing import List, Optional, Sequence, Tuple
+
+from ..utils import trace
+
+_MAGIC = b"PFTPUSH1"
+_VERSION = 1
+
+# header field layout (struct offsets into the segment)
+_H_MAGIC = 0           # 8s
+_H_VERSION = 8         # <I
+_H_SLOTS = 12          # <I
+_H_FLIGHTS = 16        # <I
+_H_DATA_CAP = 24       # <Q
+_H_META_CAP = 32       # <Q
+_H_RING = 40           # 4 x <Q: data_head, data_tail, meta_head, meta_tail
+_H_STATS = 72          # _N_STATS x <Q
+_STAT_NAMES = (
+    "hits", "misses", "hit_bytes", "miss_bytes",
+    "evictions", "meta_evictions", "singleflight_waits", "takeovers",
+)
+_N_STATS = len(_STAT_NAMES)
+_HEADER_BYTES = 256
+
+_FLIGHT_REC = 32       # d0 u64 | d1 u64 | deadline f64 | state u32 | pad
+_SLOT_REC = 40         # d0 u64 | d1 u64 | ring u32 | pad | off u64 | len u64
+
+_RING_DATA = 1
+_RING_META = 2
+_SKIP_SLOT = 0xFFFFFFFF
+
+# waiter poll cadence: start fine (a page-sized local read completes in
+# well under a millisecond), back off toward 5 ms so a long remote read
+# does not spin a waiting worker
+_POLL_MIN_S = 0.0005
+_POLL_MAX_S = 0.005
+
+
+def _digest(key: tuple, offset: int, length: int) -> Tuple[int, int]:
+    """128-bit identity of one exact range of one file.  The key tuple
+    is the in-process cache's ``source_key`` — ``(name, size)`` — so
+    two workers opening the same path at the same size share entries."""
+    canon = "\x1f".join(
+        [str(part) for part in key] + [str(int(offset)), str(int(length))]
+    ).encode("utf-8", "surrogateescape")
+    d = hashlib.blake2b(canon, digest_size=16).digest()
+    # bias away from the all-zero digest: (0, 0) marks a free slot
+    d0 = int.from_bytes(d[:8], "little") | 1
+    return d0, int.from_bytes(d[8:], "little")
+
+
+def _ceil8(n: int) -> int:
+    return (int(n) + 7) & ~7
+
+
+class ShmCacheTier:
+    """The cross-process byte tier (module docstring).  Create once per
+    host (``ShmCacheTier.create``), attach from every worker
+    (``ShmCacheTier.attach(name)``), drop into each worker's in-process
+    cache via ``SharedBufferCache(shm=tier)``."""
+
+    def __init__(self, *, data_bytes: int = 64 << 20,
+                 meta_bytes: int = 16 << 20, slots: int = 4096,
+                 flights: int = 256, lease_s: float = 10.0,
+                 _attach_name: Optional[str] = None):
+        if lease_s <= 0:
+            raise ValueError(f"lease_s must be > 0, got {lease_s}")
+        self.lease_s = float(lease_s)
+        self._tlock = threading.Lock()
+        self._closed = False
+        self._created = _attach_name is None
+        if _attach_name is None:
+            data_bytes = _ceil8(data_bytes)
+            meta_bytes = _ceil8(meta_bytes)
+            if data_bytes <= 0 or meta_bytes <= 0:
+                raise ValueError("tier budgets must be > 0")
+            if slots <= 0 or flights <= 0:
+                raise ValueError("slots and flights must be > 0")
+            total = (_HEADER_BYTES + flights * _FLIGHT_REC
+                     + slots * _SLOT_REC + data_bytes + meta_bytes)
+            self._shm = shared_memory.SharedMemory(create=True, size=total)
+            buf = self._shm.buf
+            buf[:_HEADER_BYTES] = b"\x00" * _HEADER_BYTES
+            struct.pack_into("8s", buf, _H_MAGIC, _MAGIC)
+            struct.pack_into("<I", buf, _H_VERSION, _VERSION)
+            struct.pack_into("<I", buf, _H_SLOTS, int(slots))
+            struct.pack_into("<I", buf, _H_FLIGHTS, int(flights))
+            struct.pack_into("<Q", buf, _H_DATA_CAP, data_bytes)
+            struct.pack_into("<Q", buf, _H_META_CAP, meta_bytes)
+            zero_span = flights * _FLIGHT_REC + slots * _SLOT_REC
+            buf[_HEADER_BYTES:_HEADER_BYTES + zero_span] = b"\x00" * zero_span
+        else:
+            self._shm = shared_memory.SharedMemory(name=_attach_name)
+            # Python <3.13 registers every ATTACH with the resource
+            # tracker, which unlinks the segment when the attaching
+            # process exits — destroying it under the creator.  The
+            # creator keeps its registration (it owns the unlink).
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(self._shm._name,
+                                            "shared_memory")
+            except Exception:   # pragma: no cover - platform-dependent
+                pass
+            buf = self._shm.buf
+            magic, = struct.unpack_from("8s", buf, _H_MAGIC)
+            version, = struct.unpack_from("<I", buf, _H_VERSION)
+            if magic != _MAGIC or version != _VERSION:
+                self._shm.close()
+                raise ValueError(
+                    f"segment {_attach_name!r} is not a ShmCacheTier "
+                    f"(magic {magic!r}, version {version})"
+                )
+        buf = self._shm.buf
+        self.slot_count, = struct.unpack_from("<I", buf, _H_SLOTS)
+        self.flight_count, = struct.unpack_from("<I", buf, _H_FLIGHTS)
+        self.data_bytes, = struct.unpack_from("<Q", buf, _H_DATA_CAP)
+        self.meta_bytes, = struct.unpack_from("<Q", buf, _H_META_CAP)
+        self._flights_off = _HEADER_BYTES
+        self._slots_off = self._flights_off + self.flight_count * _FLIGHT_REC
+        self._data_off = self._slots_off + self.slot_count * _SLOT_REC
+        self._meta_off = self._data_off + self.data_bytes
+        import numpy as np
+
+        slot_dt = np.dtype([
+            ("d0", "<u8"), ("d1", "<u8"), ("ring", "<u4"), ("pad", "<u4"),
+            ("off", "<u8"), ("len", "<u8"),
+        ])
+        flight_dt = np.dtype([
+            ("d0", "<u8"), ("d1", "<u8"), ("deadline", "<f8"),
+            ("state", "<u4"), ("pad", "<u4"),
+        ])
+        self._slots = np.frombuffer(
+            buf, dtype=slot_dt, count=self.slot_count,
+            offset=self._slots_off,
+        )
+        self._flights = np.frombuffer(
+            buf, dtype=flight_dt, count=self.flight_count,
+            offset=self._flights_off,
+        )
+        # the sidecar lock file: flock works between unrelated processes
+        self._lock_path = os.path.join(
+            tempfile.gettempdir(), f"pftpu-shm-{self._shm.name}.lock"
+        )
+        self._lock_fd = os.open(self._lock_path,
+                                os.O_CREAT | os.O_RDWR, 0o600)
+
+    # -- construction faces --------------------------------------------------
+
+    @classmethod
+    def create(cls, data_bytes: int = 64 << 20, meta_bytes: int = 16 << 20,
+               slots: int = 4096, flights: int = 256,
+               lease_s: float = 10.0) -> "ShmCacheTier":
+        """A fresh segment, owned (and unlinked at close) by the caller."""
+        return cls(data_bytes=data_bytes, meta_bytes=meta_bytes,
+                   slots=slots, flights=flights, lease_s=lease_s)
+
+    @classmethod
+    def attach(cls, name: str, lease_s: float = 10.0) -> "ShmCacheTier":
+        """Attach a worker process to an existing segment by name."""
+        return cls(lease_s=lease_s, _attach_name=name)
+
+    @property
+    def name(self) -> str:
+        """The segment name workers pass to :meth:`attach`."""
+        return self._shm.name
+
+    # -- locking -------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def _locked(self):
+        """tlock (threads of this process) then flock (other
+        processes); storage I/O and polling sleeps stay OUTSIDE."""
+        with self._tlock:
+            if self._closed:
+                raise ValueError("ShmCacheTier is closed")
+            fcntl.flock(self._lock_fd, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(self._lock_fd, fcntl.LOCK_UN)
+
+    # -- header state (caller holds the lock) --------------------------------
+
+    def _ring_state(self) -> list:
+        return list(struct.unpack_from("<4Q", self._shm.buf, _H_RING))
+
+    def _set_ring_state(self, st: Sequence[int]) -> None:
+        struct.pack_into("<4Q", self._shm.buf, _H_RING, *st)
+
+    def _bump(self, stat: str, n: int = 1) -> None:
+        i = _STAT_NAMES.index(stat)
+        off = _H_STATS + 8 * i
+        v, = struct.unpack_from("<Q", self._shm.buf, off)
+        struct.pack_into("<Q", self._shm.buf, off, v + n)
+
+    # -- the ring heaps (caller holds the lock) ------------------------------
+
+    def _heap_span(self, ring: int) -> Tuple[int, int]:
+        if ring == _RING_META:
+            return self._meta_off, self.meta_bytes
+        return self._data_off, self.data_bytes
+
+    def _evict_tail(self, ring: int, st: list) -> None:
+        """Retire the record at the ring's tail (and its slot)."""
+        base, cap = self._heap_span(ring)
+        hi, ti = (0, 1) if ring == _RING_DATA else (2, 3)
+        tail = st[ti]
+        pos = base + (tail % cap)
+        rec_len, slot_idx = struct.unpack_from("<II", self._shm.buf, pos)
+        if rec_len < 8 or tail + rec_len > st[hi]:
+            # a torn ring (should be unreachable under the lock) —
+            # resynchronize by dropping the whole ring, slots included
+            # (a leaked slot over reclaimed ring bytes would serve
+            # WRONG bytes; forgetting everything is always safe)
+            import numpy as np
+
+            stale = np.flatnonzero(self._slots["ring"] == ring)
+            for i in stale:
+                self._slots[int(i)]["ring"] = 0
+            st[ti] = st[hi]
+            return
+        if slot_idx != _SKIP_SLOT and slot_idx < self.slot_count:
+            s = self._slots[slot_idx]
+            if int(s["ring"]) == ring and int(s["off"]) == tail + 8:
+                self._slots[slot_idx]["ring"] = 0
+                if ring == _RING_META:
+                    self._bump("meta_evictions")
+                    trace.count("serve.shm_meta_evictions")
+                else:
+                    self._bump("evictions")
+                    trace.count("serve.shm_evictions")
+        st[ti] = tail + rec_len
+
+    def _free_slot(self, st: list) -> Optional[int]:
+        import numpy as np
+
+        free = np.flatnonzero(self._slots["ring"] == 0)
+        if free.size:
+            return int(free[0])
+        # the slot table is the entry count bound: evicting one ring
+        # record frees exactly one slot
+        for ring in (_RING_DATA, _RING_META):
+            hi, ti = (0, 1) if ring == _RING_DATA else (2, 3)
+            while st[ti] < st[hi]:
+                self._evict_tail(ring, st)
+                free = np.flatnonzero(self._slots["ring"] == 0)
+                if free.size:
+                    return int(free[0])
+        return None
+
+    def _insert_locked(self, d0: int, d1: int, data: bytes,
+                       pinned: bool) -> None:
+        ring = _RING_META if pinned else _RING_DATA
+        base, cap = self._heap_span(ring)
+        need = 8 + _ceil8(len(data))
+        if need > cap:
+            return   # larger than the whole ring: serve-through, uncached
+        st = self._ring_state()
+        slot = self._free_slot(st)
+        if slot is None:   # pragma: no cover - slots >= 1 frees above
+            self._set_ring_state(st)
+            return
+        hi, ti = (0, 1) if ring == _RING_DATA else (2, 3)
+        # contiguity: a record never wraps — skip-pad to the boundary
+        rem = cap - (st[hi] % cap)
+        if rem < need:
+            while (st[hi] + rem) - st[ti] > cap:
+                self._evict_tail(ring, st)
+            pos = base + (st[hi] % cap)
+            struct.pack_into("<II", self._shm.buf, pos, rem, _SKIP_SLOT)
+            st[hi] += rem
+        while (st[hi] + need) - st[ti] > cap:
+            self._evict_tail(ring, st)
+        pos = base + (st[hi] % cap)
+        struct.pack_into("<II", self._shm.buf, pos, need, slot)
+        self._shm.buf[pos + 8:pos + 8 + len(data)] = data
+        rec = self._slots[slot]
+        rec["d0"] = d0
+        rec["d1"] = d1
+        rec["ring"] = ring
+        rec["off"] = st[hi] + 8
+        rec["len"] = len(data)
+        st[hi] += need
+        self._set_ring_state(st)
+
+    def _lookup_locked(self, d0: int, d1: int) -> Optional[bytes]:
+        import numpy as np
+
+        hit = np.flatnonzero(
+            (self._slots["d0"] == d0) & (self._slots["d1"] == d1)
+            & (self._slots["ring"] != 0)
+        )
+        if not hit.size:
+            return None
+        rec = self._slots[int(hit[0])]
+        base, cap = self._heap_span(int(rec["ring"]))
+        pos = base + (int(rec["off"]) % cap)
+        # copy-out under the lock: the borrow law (module docstring)
+        return bytes(self._shm.buf[pos:pos + int(rec["len"])])
+
+    # -- flights (caller holds the lock) -------------------------------------
+
+    def _flight_check(self, d0: int, d1: int, claim: bool) -> bool:
+        """True when another process/thread is already leading this
+        range.  With ``claim``, an absent/expired flight is claimed for
+        the caller (who must then lead the read and :meth:`_flight_done`
+        it)."""
+        import numpy as np
+
+        now = time.monotonic()
+        live = np.flatnonzero(
+            (self._flights["state"] == 1)
+            & (self._flights["d0"] == d0) & (self._flights["d1"] == d1)
+        )
+        for i in live:
+            f = self._flights[int(i)]
+            if float(f["deadline"]) > now:
+                return True
+            self._flights[int(i)]["state"] = 0   # expired lease
+        if claim:
+            free = np.flatnonzero(self._flights["state"] == 0)
+            if free.size:
+                f = self._flights[int(free[0])]
+                f["d0"] = d0
+                f["d1"] = d1
+                f["deadline"] = now + self.lease_s
+                f["state"] = 1
+            # a full flight table degrades to an unrecorded lead — a
+            # duplicate read is possible then, never a wrong result
+        return False
+
+    def _flight_done(self, d0: int, d1: int) -> None:
+        import numpy as np
+
+        mine = np.flatnonzero(
+            (self._flights["state"] == 1)
+            & (self._flights["d0"] == d0) & (self._flights["d1"] == d1)
+        )
+        for i in mine:
+            self._flights[int(i)]["state"] = 0
+
+    # -- public faces --------------------------------------------------------
+
+    def get(self, key: tuple, offset: int, length: int) -> Optional[bytes]:
+        """The cached bytes of exactly ``(offset, length)`` of file
+        ``key``, or None.  (Exact-range: containment is the L1's job.)"""
+        d0, d1 = _digest(key, offset, length)
+        with self._locked():
+            data = self._lookup_locked(d0, d1)
+            if data is not None:
+                self._bump("hits")
+                self._bump("hit_bytes", len(data))
+            return data
+
+    def put(self, key: tuple, offset: int, data, pinned: bool = False
+            ) -> None:
+        """Install bytes for exactly ``(offset, len(data))``; a range
+        already present is not duplicated."""
+        data = bytes(data)
+        d0, d1 = _digest(key, offset, len(data))
+        with self._locked():
+            if self._lookup_locked(d0, d1) is None:
+                self._insert_locked(d0, d1, data, pinned)
+
+    def read_through(self, key: tuple, ranges: Sequence[Tuple[int, int]],
+                     read_many_fn, pinned: bool = False) -> List[bytes]:
+        """The tier's single-flight read path, called by the in-process
+        cache below its OWN single-flight layer: classify every range as
+        shm hit / flight to await / range to lead in one lock pass,
+        issue ONE vectored ``read_many_fn`` for the led ranges, install
+        them, then poll out the awaited ones (taking over expired
+        leases).  Returns one ``bytes`` per input range, in order."""
+        ranges = [(int(o), int(n)) for o, n in ranges]
+        out: List[Optional[bytes]] = [None] * len(ranges)
+        leads: List[int] = []
+        waits: List[int] = []
+        digests = [_digest(key, o, n) for o, n in ranges]
+        with self._locked():
+            led_here = set()
+            for pos, (d0, d1) in enumerate(digests):
+                data = self._lookup_locked(d0, d1)
+                if data is not None:
+                    self._bump("hits")
+                    self._bump("hit_bytes", len(data))
+                    trace.count("serve.shm_hits")
+                    trace.count("serve.shm_hit_bytes", len(data))
+                    out[pos] = data
+                    continue
+                if (d0, d1) in led_here:
+                    # a duplicate range within this very call: our own
+                    # lead below installs it; the await loop then finds
+                    # it on the first poll
+                    waits.append(pos)
+                    continue
+                if self._flight_check(d0, d1, claim=True):
+                    self._bump("singleflight_waits")
+                    trace.count("serve.shm_singleflight_waits")
+                    waits.append(pos)
+                    continue
+                led_here.add((d0, d1))
+                self._bump("misses")
+                self._bump("miss_bytes", ranges[pos][1])
+                trace.count("serve.shm_misses")
+                trace.count("serve.shm_miss_bytes", ranges[pos][1])
+                leads.append(pos)
+        if leads:
+            try:
+                bufs = read_many_fn([ranges[p] for p in leads])
+            except BaseException:
+                with self._locked():
+                    for p in leads:
+                        self._flight_done(*digests[p])
+                raise
+            with self._locked():
+                for p, buf in zip(leads, bufs):
+                    data = bytes(buf)
+                    out[p] = data
+                    if self._lookup_locked(*digests[p]) is None:
+                        self._insert_locked(*digests[p], data, pinned)
+                    self._flight_done(*digests[p])
+        for p in waits:
+            out[p] = self._await_range(key, ranges[p], digests[p],
+                                       read_many_fn, pinned)
+        return out   # type: ignore[return-value]
+
+    def _await_range(self, key: tuple, rng: Tuple[int, int],
+                     dig: Tuple[int, int], read_many_fn,
+                     pinned: bool) -> bytes:
+        """Poll for another process's in-flight read of one range; on an
+        expired lease, take the flight over and lead it ourselves."""
+        t0 = time.perf_counter()
+        poll = _POLL_MIN_S
+        first = True
+        while True:
+            if first:
+                # check before any sleep: a duplicate range in one
+                # call (installed by our own lead) and a cross-process
+                # wait that resolved during the lead read are both
+                # already present — the hot path must not stall
+                first = False
+            else:
+                time.sleep(poll)
+                poll = min(poll * 2, _POLL_MAX_S)
+            with self._locked():
+                data = self._lookup_locked(*dig)
+                if data is not None:
+                    self._bump("hits")
+                    self._bump("hit_bytes", len(data))
+                    trace.observe("serve.shm_wait_seconds",
+                                  time.perf_counter() - t0)
+                    return data
+                if not self._flight_check(*dig, claim=True):
+                    # the leader's lease expired (or it failed and
+                    # cleared the flight): we are the leader now
+                    self._bump("takeovers")
+                    trace.count("serve.shm_takeovers")
+                    self._bump("misses")
+                    self._bump("miss_bytes", rng[1])
+                    trace.count("serve.shm_misses")
+                    trace.count("serve.shm_miss_bytes", rng[1])
+                    break
+        try:
+            buf = read_many_fn([rng])[0]
+        except BaseException:
+            with self._locked():
+                self._flight_done(*dig)
+            raise
+        data = bytes(buf)
+        with self._locked():
+            if self._lookup_locked(*dig) is None:
+                self._insert_locked(*dig, data, pinned)
+            self._flight_done(*dig)
+        trace.observe("serve.shm_wait_seconds", time.perf_counter() - t0)
+        return data
+
+    # -- observability / lifecycle -------------------------------------------
+
+    def stats(self) -> dict:
+        """The segment header's cross-process truth (all workers'
+        traffic folded), plus live occupancy."""
+        with self._locked():
+            vals = struct.unpack_from(f"<{_N_STATS}Q", self._shm.buf,
+                                      _H_STATS)
+            st = self._ring_state()
+            import numpy as np
+
+            live = int(np.count_nonzero(self._slots["ring"]))
+            inflight = int(np.count_nonzero(self._flights["state"]))
+        out = dict(zip(_STAT_NAMES, (int(v) for v in vals)))
+        out.update({
+            "data_bytes_used": st[0] - st[1],
+            "meta_bytes_used": st[2] - st[3],
+            "entries": live,
+            "flights_inflight": inflight,
+            "data_bytes": self.data_bytes,
+            "meta_bytes": self.meta_bytes,
+            "name": self._shm.name,
+        })
+        return out
+
+    def close(self) -> None:
+        """Detach; the creating process also unlinks the segment (and
+        its lock file).  Idempotent."""
+        with self._tlock:
+            if self._closed:
+                return
+            self._closed = True
+            # release the numpy views before closing: SharedMemory
+            # refuses to close while buffer exports are alive
+            self._slots = None
+            self._flights = None
+            self._shm.close()
+            if self._created:
+                try:
+                    self._shm.unlink()
+                except OSError:   # pragma: no cover - double unlink race
+                    pass
+                try:
+                    os.unlink(self._lock_path)
+                except OSError:
+                    pass
+            os.close(self._lock_fd)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

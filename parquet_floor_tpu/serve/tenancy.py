@@ -30,6 +30,7 @@ Docs: ``docs/serving.md``.
 
 from __future__ import annotations
 
+import contextlib
 import heapq
 import threading
 import time
@@ -142,16 +143,130 @@ class _FairGate:
             }
 
 
+class _DeviceGate:
+    """Virtual-time WFQ over DECODE time — the second metered resource.
+
+    Storage bytes are not the only thing tenants contend for: a tenant
+    whose working set is 100% cache-hot never touches the byte gate,
+    yet every probe it issues burns decode-engine time (host decode on
+    the serving faces, fused launches on the device leg).  This gate
+    arbitrates ``lanes`` concurrent decode slots in weighted virtual-
+    time order, where a tenant's virtual finish advances by
+    ``seconds / weight`` — so under contention, engine time interleaves
+    in weight proportion exactly like storage bytes do, and the
+    cache-hot tenant queues like everyone else.
+
+    A slot is acquired with an ESTIMATE (the tenant's EWMA of its own
+    recent decode walls — nobody knows a decode's cost before running
+    it) and the tenant's clock is corrected to the ACTUAL seconds at
+    release, so estimation error never accumulates into unfairness.
+    ``serve.device_waits`` counts contended acquires;
+    ``serve.device_wait_seconds`` is the grant-wait histogram;
+    ``serve.device_seconds`` (per-tenant, on the ambient tracer) is the
+    fairness ledger benches compare against WFQ-ideal shares."""
+
+    def __init__(self, lanes: int = 1):
+        if lanes <= 0:
+            raise ValueError(f"lanes must be > 0, got {lanes}")
+        self.lanes = int(lanes)
+        self._cv = threading.Condition()
+        self._busy = 0
+        self._vtime = 0.0
+        self._heap: list = []   # (vtag, seq, ticket)
+        self._seq = 0
+
+    def acquire(self, state: "_TenantShare") -> tuple:
+        """Block until granted a lane in virtual-time order; returns the
+        lease ``(state, vtag, estimate_s)`` to pass to :meth:`release`.
+        """
+        with self._cv:
+            est = max(state.device_estimate_s, 1e-6)
+            vtag = max(self._vtime, state.dfinish)
+            state.dfinish = vtag + est / state.weight
+            if not self._heap and self._busy < self.lanes:
+                self._busy += 1
+                self._vtime = max(self._vtime, vtag)
+                return (state, vtag, est)
+            trace.count("serve.device_waits")
+            t_wait = time.perf_counter()
+            ticket = [False]
+            self._seq += 1
+            heapq.heappush(self._heap, (vtag, self._seq, ticket))
+            while True:
+                if self._pump():
+                    self._cv.notify_all()
+                if ticket[0]:
+                    trace.observe(
+                        "serve.device_wait_seconds",
+                        time.perf_counter() - t_wait,
+                    )
+                    return (state, vtag, est)
+                self._cv.wait()
+
+    def _pump(self) -> int:
+        granted = 0
+        while self._heap and self._busy < self.lanes:
+            vtag, _seq, ticket = heapq.heappop(self._heap)
+            self._busy += 1
+            self._vtime = max(self._vtime, vtag)
+            ticket[0] = True
+            granted += 1
+        return granted
+
+    def release(self, lease: tuple, actual_s: float) -> None:
+        state, vtag, est = lease
+        with self._cv:
+            self._busy -= 1
+            # charge truth, not the guess: the tenant's clock moves by
+            # actual/weight (the estimate only ordered the arrival)
+            state.dfinish += (float(actual_s) - est) / state.weight
+            if state.dfinish < vtag:
+                state.dfinish = vtag
+            # fold the actual into the tenant's estimator (EWMA)
+            state.device_estimate_s = (
+                0.75 * state.device_estimate_s + 0.25 * float(actual_s)
+            )
+            self._pump()
+            self._cv.notify_all()
+
+    def charge(self, state: "_TenantShare", seconds: float) -> None:
+        """Post-hoc charge (no lane held): advance the tenant's
+        virtual clock by ``seconds / weight`` from the later of the
+        gate's clock and its own finish — the SAME clock law acquire
+        uses, kept here so the WFQ arithmetic has one home."""
+        with self._cv:
+            state.dfinish = (
+                max(self._vtime, state.dfinish)
+                + float(seconds) / state.weight
+            )
+
+    def stats(self) -> dict:
+        """Snapshot under the cv, formatted outside (FL-LOCK002)."""
+        with self._cv:
+            return {
+                "lanes": self.lanes,
+                "busy": self._busy,
+                "waiters": len(self._heap),
+                "virtual_time": self._vtime,
+            }
+
+
 class _TenantShare:
-    """The gate-side state of one tenant (virtual finish time + weight).
-    Bound into every :class:`CachedSource` the tenant opens."""
+    """The gate-side state of one tenant: virtual finish times for BOTH
+    metered resources (storage bytes, device seconds) + weight.  Bound
+    into every :class:`CachedSource` the tenant opens."""
 
-    __slots__ = ("weight", "vfinish", "gate")
+    __slots__ = ("weight", "vfinish", "gate", "dfinish",
+                 "device_estimate_s", "device_gate")
 
-    def __init__(self, weight: float, gate: _FairGate):
+    def __init__(self, weight: float, gate: _FairGate,
+                 device_gate: Optional[_DeviceGate] = None):
         self.weight = float(weight)
         self.vfinish = 0.0
         self.gate = gate
+        self.dfinish = 0.0
+        self.device_estimate_s = 0.002   # until the EWMA learns better
+        self.device_gate = device_gate
 
     def acquire(self, cost: int) -> None:
         self.gate.acquire(self, cost)
@@ -170,7 +285,8 @@ class Tenant:
         self.name = name
         self.weight = float(weight)
         self.tracer = trace.Tracer(enabled=True)
-        self._share = _TenantShare(self.weight, serving._gate)
+        self._share = _TenantShare(self.weight, serving._gate,
+                                   serving._device_gate)
         self._closed = False
 
     # -- budget admission ---------------------------------------------------
@@ -246,6 +362,44 @@ class Tenant:
                 options=options, scan=sc, predicate=predicate, order=order,
             )
 
+    # -- device-time metering ------------------------------------------------
+
+    @contextlib.contextmanager
+    def device_session(self):
+        """One metered slice of decode-engine time: acquires a lane
+        from the serving context's device WFQ gate (queueing in
+        weighted virtual-time order under contention), measures the
+        enclosed wall, charges it to this tenant's virtual clock at
+        release, and records it in the tenant-attributed
+        ``serve.device_seconds`` histogram — the ledger fairness
+        benches compare against ideal WFQ shares.  The serving faces
+        (lookup/range/aggregate probes, the daemon) wrap each row
+        group's decode in one of these."""
+        # attribution is pinned to THIS tenant's tracer (idempotent
+        # when the probe faces already activated it), so the fairness
+        # ledger and the wait counters land on the right tenant even
+        # from a bare device_session() call
+        with trace.using(self.tracer):
+            lease = self._share.device_gate.acquire(self._share)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            actual = time.perf_counter() - t0
+            self._share.device_gate.release(lease, actual)
+            with trace.using(self.tracer):
+                trace.observe("serve.device_seconds", actual)
+
+    def charge_device(self, seconds: float) -> None:
+        """Post-hoc device-time charge (no lane held): advance this
+        tenant's device virtual clock by ``seconds / weight``.  The
+        hook for externally-timed engine work — e.g. a device scan
+        leg's fused-launch walls — so that work still pushes the
+        tenant back in the WFQ order its next probe queues under."""
+        self._share.device_gate.charge(self._share, seconds)
+        with trace.using(self.tracer):
+            trace.observe("serve.device_seconds", float(seconds))
+
     # -- observability -------------------------------------------------------
 
     def report(self, wall_seconds: Optional[float] = None):
@@ -289,7 +443,8 @@ class Serving:
 
     def __init__(self, cache: Optional[SharedBufferCache] = None,
                  prefetch_bytes: int = 64 << 20,
-                 inflight_bytes: Optional[int] = None):
+                 inflight_bytes: Optional[int] = None,
+                 device_lanes: int = 2):
         if prefetch_bytes <= 0:
             raise ValueError(
                 f"prefetch_bytes must be > 0, got {prefetch_bytes}"
@@ -300,6 +455,10 @@ class Serving:
         self._gate = _FairGate(
             inflight_bytes if inflight_bytes is not None else prefetch_bytes
         )
+        # decode-engine WFQ (docs/serving.md): ``device_lanes``
+        # concurrent decode slots, granted in weighted virtual-time
+        # order — the resource a cache-hot tenant still consumes
+        self._device_gate = _DeviceGate(device_lanes)
         self._lock = threading.Lock()
         self._tenants: Dict[str, Tenant] = {}
         self._slos: Dict[str, "object"] = {}   # tenant name -> SloMonitor
@@ -445,6 +604,7 @@ class Serving:
             tenants = list(self._tenants.values())
             total_w = sum(t.weight for t in tenants)
         gate = self._gate.stats()            # snapshot under the cv
+        dgate = self._device_gate.stats()    # snapshot under its cv
         cache = self.cache.stats()           # snapshot under its lock
         rows = []
         for t in sorted(tenants, key=lambda t: t.name):
@@ -452,7 +612,11 @@ class Serving:
             hists = t.tracer.histograms()
             hit = counters.get("serve.cache_hit_bytes", 0)
             miss = counters.get("serve.cache_miss_bytes", 0)
+            dev = hists.get("serve.device_seconds")
             rows.append({
+                "device_seconds": (
+                    round(dev.total, 4) if dev is not None else None
+                ),
                 "name": t.name,
                 "weight": t.weight,
                 # the REAL granted share (the admission formula, 1 MiB
@@ -480,16 +644,22 @@ class Serving:
                 f"{gate['capacity_bytes']} B in flight,"
                 f" {gate['waiters']} waiter(s)"
             ),
+            (
+                f"  device gate       {dgate['busy']}/{dgate['lanes']}"
+                f" lane(s) busy, {dgate['waiters']} waiter(s)"
+            ),
         ]
         if not rows:
             lines.append("  (no tenants registered)")
         for r in rows:
             hr = ("n/a" if r["hit_rate"] is None
                   else f"{r['hit_rate'] * 100:.1f}%")
+            dv = ("" if r["device_seconds"] is None
+                  else f" device={r['device_seconds']:g}s")
             lines.append(
                 f"  tenant {r['name']:<12} weight={r['weight']:g}"
                 f" share={int(r['share'])} B"
-                f" probes={r['probes']} hit-rate={hr}"
+                f" probes={r['probes']} hit-rate={hr}{dv}"
             )
             if r["lookup"] is not None:
                 lines.append(f"    lookup          {r['lookup'].render()}")

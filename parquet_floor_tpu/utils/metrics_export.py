@@ -32,10 +32,11 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import pathlib
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Sequence
+from typing import Dict, Optional, Sequence
 
 from .histogram import LogHistogram
 
@@ -117,6 +118,54 @@ def merge_snapshots(snaps: Sequence[dict]) -> dict:
 # Prometheus text exposition
 # ---------------------------------------------------------------------------
 
+def write_snapshot(snap: dict, path: str) -> None:
+    """Persist one :func:`snapshot`-shaped dict as JSON via
+    write-to-temp + atomic rename — the per-worker half of the
+    multi-process fold: each serving worker lands its snapshot in a
+    shared directory, and any aggregator (:func:`merge_snapshot_dir`,
+    the daemon's metrics op, ``MetricsServer(snapshot_dir=)``) folds
+    the directory through :func:`merge_snapshots`."""
+    import tempfile
+
+    d, base = os.path.split(str(path))
+    fd, tmp = tempfile.mkstemp(dir=d or ".", prefix=base + ".tmp.")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            json.dump(snap, fh)
+        os.replace(tmp, str(path))
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def merge_snapshot_dir(dir_path: str, extra: Sequence[dict] = (),
+                       exclude: Sequence[str] = ()) -> dict:
+    """Fold every ``*.json`` worker snapshot under ``dir_path`` (plus
+    any ``extra`` in-memory snapshots — e.g. the aggregator's own live
+    state; minus ``exclude``\\ d file names — e.g. the aggregator's own
+    stale push) through :func:`merge_snapshots`.  A torn or
+    non-snapshot file fails loudly (ValueError): a silent skip would
+    under-report a worker, which is exactly the lie a fleet dashboard
+    must not tell — :func:`write_snapshot`'s atomic rename is what
+    makes "every file parses" a fair requirement."""
+    snaps = list(extra)
+    root = pathlib.Path(dir_path)
+    skip = set(exclude)
+    for p in sorted(root.glob("*.json")):
+        if p.name in skip:
+            continue
+        try:
+            snaps.append(json.loads(p.read_text()))
+        except ValueError as e:
+            raise ValueError(
+                f"worker snapshot {p} does not parse: {e}"
+            ) from e
+    if not snaps:
+        raise ValueError(f"no worker snapshots under {dir_path}")
+    return merge_snapshots(snaps)
+
+
 def render_prometheus_snapshot(snap: dict) -> str:
     """Render one :func:`snapshot`-shaped dict as text exposition."""
     lines = []
@@ -190,19 +239,34 @@ class MetricsServer:
     ``trace.serve_metrics(port)``.  Binds at construction (``port=0``
     picks an ephemeral one, read it back from ``.port``), serves on a
     daemon thread, stops on :meth:`close` (idempotent; also a context
-    manager)."""
+    manager).
 
-    def __init__(self, tracer, port: int = 0, host: str = "127.0.0.1"):
+    ``snapshot_dir`` turns the endpoint into a multi-worker
+    aggregator: every scrape folds the directory's per-worker
+    :func:`write_snapshot` files together with this process's own live
+    tracer state (:func:`merge_snapshot_dir`), so one scrape sees the
+    whole worker fleet — the push-gateway story for N serving
+    processes per host."""
+
+    def __init__(self, tracer, port: int = 0, host: str = "127.0.0.1",
+                 snapshot_dir: Optional[str] = None):
         self.tracer = tracer
+        self.snapshot_dir = snapshot_dir
         outer = self
+
+        def _snap() -> dict:
+            own = snapshot(outer.tracer)
+            if outer.snapshot_dir is None:
+                return own
+            return merge_snapshot_dir(outer.snapshot_dir, extra=[own])
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):       # noqa: N802 (http.server contract)
                 if self.path.split("?")[0] == "/metrics":
-                    body = render_prometheus(outer.tracer).encode()
+                    body = render_prometheus_snapshot(_snap()).encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path.split("?")[0] == "/metrics.json":
-                    body = json.dumps(snapshot(outer.tracer)).encode()
+                    body = json.dumps(_snap()).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)

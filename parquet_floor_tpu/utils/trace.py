@@ -126,6 +126,23 @@ class names:
         "serve.lookup_bloom_skips",
         "serve.lookup_pages_read",
         "serve.lookup_rows",
+        # process-scale serving (serve/shm_cache.py, serve/daemon.py,
+        # docs/serving.md): the cross-process cache tier, the negative
+        # cache, the streaming cursor, device-time WFQ, and the daemon
+        "serve.shm_hits",
+        "serve.shm_misses",
+        "serve.shm_hit_bytes",
+        "serve.shm_miss_bytes",
+        "serve.shm_evictions",
+        "serve.shm_meta_evictions",
+        "serve.shm_singleflight_waits",
+        "serve.shm_takeovers",
+        "serve.negative_hits",
+        "serve.cursor_pages",
+        "serve.device_waits",
+        "serve.daemon_requests",
+        "serve.daemon_rejected",
+        "serve.daemon_connections",
         # the training input pipeline (data.DataLoader, docs/data.md)
         "data.rows_emitted",
         "data.batches_emitted",
@@ -160,6 +177,7 @@ class names:
         "data.carry_rows_max",
         "data.prefetch_to_device_depth_max",
         "serve.inflight_storage_bytes_max",
+        "serve.daemon_inflight_max",
         "write.inflight_groups_max",
     })
     DECISIONS = frozenset({
@@ -192,6 +210,9 @@ class names:
         "compact.unit_dropped",
         # the per-tenant SLO monitor (serve/slo.py, docs/serving.md)
         "serve.slo_breach",
+        # the serving daemon's lifecycle (serve/daemon.py):
+        # start / drain / overload events
+        "serve.daemon",
     })
     SPANS = frozenset({
         "read",
@@ -220,6 +241,10 @@ class names:
         "serve.aggregate_seconds",       # one aggregate() query wall
         "serve.fair_wait_seconds",       # WFQ gate grant wait (contended)
         "serve.singleflight_wait_seconds",  # wait on another's in-flight read
+        "serve.device_seconds",          # one metered decode-engine slice
+        "serve.device_wait_seconds",     # device WFQ lane wait (contended)
+        "serve.shm_wait_seconds",        # wait on another WORKER's read
+        "serve.daemon_request_seconds",  # one daemon request, arrival→reply
         # storage read latency, split by source kind and hedge outcome
         "io.read_seconds.file",          # FileSource vectored read wall
         "io.remote.get_seconds.primary",    # remote fetch, primary won
@@ -1197,18 +1222,22 @@ def report() -> str:
 
 
 def serve_metrics(port: int = 0, tracer: Optional[Tracer] = None,
-                  host: str = "127.0.0.1"):
+                  host: str = "127.0.0.1",
+                  snapshot_dir: Optional[str] = None):
     """Start a metrics HTTP endpoint over ``tracer`` (default: the
     tracer active HERE, at call time) and return the running
     :class:`~parquet_floor_tpu.utils.metrics_export.MetricsServer`
     (``.port`` holds the bound port — pass 0 for an ephemeral one;
     ``.close()`` stops it).  ``GET /metrics`` answers Prometheus text
     exposition, ``GET /metrics.json`` the JSON snapshot
-    (docs/observability.md)."""
+    (docs/observability.md).  ``snapshot_dir`` folds per-worker
+    ``write_snapshot`` files into every scrape (the multi-process
+    aggregation story — docs/serving.md)."""
     from .metrics_export import MetricsServer
 
     return MetricsServer(tracer if tracer is not None else current(),
-                         port=port, host=host)
+                         port=port, host=host,
+                         snapshot_dir=snapshot_dir)
 
 
 @contextlib.contextmanager

@@ -79,6 +79,20 @@ echo "== serving smoke (shared cache, lookups, metrics, SLO, unified trace) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python scripts/serving_smoke.py || exit 1
 
+# Multi-PROCESS serving smoke (docs/serving.md): k=3 worker processes
+# over one shared ShmCacheTier segment probing the same keys
+# CONCURRENTLY (file-barrier start, modeled storage latency so reads
+# really overlap) — every unique storage range read exactly ONCE
+# across all workers (cross-process single-flight, with >= 1 real
+# cross-process wait), a warm 4th worker served with ZERO storage
+# reads, per-worker metrics snapshots disjoint and folding exactly
+# through merge_snapshot_dir (file + HTTP aggregator), and the
+# ServeDaemon contract: per-connection tenant attribution, stateless
+# cursor paging, the metrics fold op, graceful drain.
+echo "== process serving smoke (shm tier, workers, daemon, drain) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/process_serving_smoke.py || exit 1
+
 # Salvage differential smoke: 60 seeded corruption cases through ALL
 # FOUR read faces (sequential host, host scan, device scan, loader),
 # asserting unanimous fatality, identical quarantine sets, identical

@@ -141,6 +141,7 @@ def check_report(bench_log: pathlib.Path) -> int:
     return (
         check_remote_leg(result.get("detail", {}))
         or check_serving_leg(result.get("detail", {}))
+        or check_traffic_leg(result.get("detail", {}))
         or check_histograms(result.get("detail", {}))
         or check_exec_cache_leg(result.get("detail", {}))
         or check_launches(result.get("detail", {}))
@@ -448,6 +449,83 @@ def check_serving_leg(detail: dict) -> int:
         f"(second-pass hit-rate {rate}, lookup {cost} B <= {bound} B page "
         f"bound, bloom skips {detail['serving_lookup_bloom_skips']}, "
         f"remote warm hit-rate {rrate})"
+    )
+    return 0
+
+
+def check_traffic_leg(detail: dict) -> int:
+    """The process-scale traffic truth bench (docs/serving.md):
+
+    * 4 worker processes over one shared ShmCacheTier must reach >=
+      2.5x one worker's aggregate lookup throughput (latency-bound
+      storage — the scaling a per-process cache can never show), with
+      the cross-process single-flight path actually exercised;
+    * the zipf open-loop pass must hold p99 (measured from SCHEDULED
+      arrival, queueing included) within its recorded SLO target, with
+      a well-formed latency histogram;
+    * the cache-hot aggressor (3x the light tenant's offered load)
+      must EXCEED its weight share of device time ungated and be held
+      within the recorded band of the WFQ-ideal share by the 1-lane
+      device gate — storage bytes it never touches cannot buy it the
+      decode engine."""
+    for key in ("traffic_worker1_rps", "traffic_workers_rps",
+                "traffic_scaling_x", "traffic_workers",
+                "traffic_p50_ms", "traffic_p99_ms", "traffic_slo_p99_ms",
+                "traffic_slo_ok", "traffic_hist",
+                "traffic_fair_share_hot", "traffic_fair_share_hot_ungated",
+                "traffic_fairness_err", "traffic_fair_band",
+                "traffic_shm_singleflight_waits",
+                "traffic_fair_hot_hit_rate"):
+        if key not in detail:
+            return fail(f"traffic leg missing {key}")
+    if detail["traffic_workers"] < 4:
+        return fail(f"traffic leg ran {detail['traffic_workers']} workers, "
+                    "expected >= 4")
+    x = detail["traffic_scaling_x"]
+    if not x >= 2.5:
+        return fail(
+            f"4-worker aggregate throughput only {x}x one worker "
+            "(floor 2.5x) — the cross-process tier is not scaling"
+        )
+    if not detail["traffic_shm_singleflight_waits"] >= 1:
+        return fail("the scaling pass never took a cross-process "
+                    "single-flight wait — the shared tier went unexercised")
+    p50, p99 = detail["traffic_p50_ms"], detail["traffic_p99_ms"]
+    slo = detail["traffic_slo_p99_ms"]
+    if not 0 < p50 <= p99:
+        return fail(f"open-loop p50/p99 malformed ({p50}, {p99})")
+    if not detail["traffic_slo_ok"] or not p99 <= slo:
+        return fail(f"open-loop p99 {p99} ms violates the {slo} ms SLO "
+                    "target under zipf Poisson load")
+    problem = _hist_problem(detail["traffic_hist"])
+    if problem:
+        return fail(f"traffic latency histogram: {problem}")
+    hot_hit = detail["traffic_fair_hot_hit_rate"]
+    if not hot_hit >= 0.9:
+        return fail(f"fairness aggressor's hit-rate {hot_hit} < 0.9 — "
+                    "the pass needs a CACHE-HOT aggressor to prove "
+                    "anything about device-time fairness")
+    ungated = detail["traffic_fair_share_hot_ungated"]
+    if not ungated >= 0.6:
+        return fail(
+            f"ungated aggressor share {ungated} < 0.6 — the comparator "
+            "never exceeded its weight share, so the gated pass proves "
+            "nothing"
+        )
+    err, band = detail["traffic_fairness_err"], detail["traffic_fair_band"]
+    if not err <= band:
+        return fail(
+            f"device-time fairness error {err} exceeds the {band} band "
+            f"(gated share {detail['traffic_fair_share_hot']} vs ideal "
+            f"{detail.get('traffic_fair_ideal')}) — the cache-hot tenant "
+            "still buys extra engine time"
+        )
+    print(
+        "check_bench_report: traffic leg ok "
+        f"(scaling {x}x at {detail['traffic_workers']} workers, "
+        f"open-loop p99 {p99} ms <= {slo} ms SLO, "
+        f"hot share {detail['traffic_fair_share_hot']} vs ungated "
+        f"{ungated}, err {err} <= {band})"
     )
     return 0
 

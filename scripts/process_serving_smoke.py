@@ -1,0 +1,329 @@
+#!/usr/bin/env python
+"""Commit-gate MULTI-PROCESS serving smoke (docs/serving.md).
+
+The cross-process laws, proven with real OS processes — k=3 worker
+processes, one shared ``ShmCacheTier`` segment, one keyed dataset:
+
+1. **cross-process single-flight**: the workers probe the SAME key list
+   concurrently (file-barrier start); every real storage read is
+   recorded inside each worker, and across ALL workers each unique
+   ``(file, offset, length)`` range must have been read from storage
+   EXACTLY once — the single-flight law crossing the process boundary;
+2. **warm-worker hit-rate floor**: a fourth worker started after the
+   segment is warm must complete every probe with ZERO storage reads
+   (hit-rate 1.0 — stronger than any floor);
+3. **per-tenant report disjointness across processes**: each worker
+   runs under its own tenant scope and pushes a metrics snapshot; each
+   snapshot must carry exactly ITS probe count, and the
+   ``merge_snapshot_dir`` fold (also scraped over HTTP through
+   ``MetricsServer(snapshot_dir=...)``) must equal the sum;
+4. **daemon contract**: a ``ServeDaemon`` over the same files answers
+   two tenant connections, attributes their probes to the right tenant
+   tracers, folds the worker snapshots into its ``metrics`` op, and
+   drains clean.
+
+Exit 0 on success, 1 with a diagnostic otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from parquet_floor_tpu import (  # noqa: E402
+    ParquetFileWriter,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.serve import (  # noqa: E402
+    DaemonClient,
+    Dataset,
+    ServeDaemon,
+    Serving,
+    ShmCacheTier,
+)
+
+GROUP = 256
+PAGE = 64
+GROUPS = 4
+FILES = 2
+WORKERS = 3
+WORKER_SCRIPT = str(
+    pathlib.Path(__file__).resolve().parent / "serve_worker.py"
+)
+
+
+def fail(msg: str) -> int:
+    print(f"process_serving_smoke: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def build_paths() -> list:
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+        types.required(types.DOUBLE).named("d"),
+    )
+    per = GROUP * GROUPS
+    paths = []
+    for i in range(FILES):
+        p = f"/tmp/pftpu_proc_smoke_{per}_{i}.parquet"
+        if not os.path.exists(p):
+            rng = np.random.default_rng(70 + i)
+            with ParquetFileWriter(p, schema, WriterOptions(
+                row_group_rows=GROUP, data_page_values=PAGE,
+                bloom_filter_columns={"k": True},
+            )) as w:
+                for lo in range(0, per, GROUP):
+                    base = 2 * (i * per + lo)
+                    w.write_columns({
+                        "k": base + 2 * np.arange(GROUP, dtype=np.int64),
+                        "s": [None if j % 9 == 0 else f"s{j % 41}"
+                              for j in range(GROUP)],
+                        "d": rng.standard_normal(GROUP),
+                    })
+        paths.append(p)
+    return paths
+
+
+def run_workers(tier: ShmCacheTier, paths: list, keys: list,
+                names: list, metrics_dir: str, tmp: str,
+                concurrent: bool) -> list:
+    """Spawn one worker process per name, release the start barrier
+    once all are ready, and return their parsed result JSONs."""
+    go = os.path.join(tmp, f"go-{'-'.join(names)}")
+    procs = []
+    for name in names:
+        cfg = {
+            "mode": "flight",
+            "shm": tier.name,
+            "paths": paths,
+            "keys": keys,
+            "columns": ["k"],
+            "tenant": name,
+            "metrics_dir": metrics_dir,
+            "ready_file": os.path.join(tmp, f"ready-{name}"),
+            "go_file": go if concurrent else None,
+            # 20 ms modeled storage latency: concurrent workers' reads
+            # OVERLAP, so the cross-process flight table is exercised
+            # for real (local reads finish too fast to collide)
+            "read_delay_s": 0.02 if concurrent else 0.0,
+        }
+        cfg_path = os.path.join(tmp, f"cfg-{name}.json")
+        pathlib.Path(cfg_path).write_text(json.dumps(cfg))
+        procs.append((name, subprocess.Popen(
+            [sys.executable, WORKER_SCRIPT, cfg_path],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )))
+    if concurrent:
+        import time
+
+        deadline = time.monotonic() + 120.0
+        while not all(
+            os.path.exists(os.path.join(tmp, f"ready-{n}"))
+            for n in names
+        ):
+            if time.monotonic() > deadline:
+                for _, p in procs:
+                    p.kill()
+                raise TimeoutError("workers never reached the barrier")
+            time.sleep(0.01)
+        pathlib.Path(go).touch()
+    results = []
+    for name, p in procs:
+        out, err = p.communicate(timeout=180)
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"worker {name} failed rc={p.returncode}:\n"
+                f"{err.decode()[-2000:]}"
+            )
+        results.append(json.loads(out.decode().splitlines()[-1]))
+    return results
+
+
+def main() -> int:
+    paths = build_paths()
+    per = GROUP * GROUPS
+    # probe keys spread over pages and files (all present, even keys)
+    keys = [2 * (f * per + g * GROUP + off)
+            for f in range(FILES) for g in range(GROUPS)
+            for off in (PAGE // 2, 3 * PAGE)]
+    tmp = tempfile.mkdtemp(prefix="pftpu_proc_smoke_")
+    metrics_dir = os.path.join(tmp, "metrics")
+    os.makedirs(metrics_dir)
+    try:
+        with ShmCacheTier.create(data_bytes=32 << 20,
+                                 meta_bytes=8 << 20) as tier:
+            names = [f"w{i}" for i in range(WORKERS)]
+            results = run_workers(tier, paths, keys, names, metrics_dir,
+                                  tmp, concurrent=True)
+
+            # -- 1: cross-process single-flight ------------------------------
+            all_ranges = []
+            for r in results:
+                if r["rows"] != len(keys):
+                    return fail(f"worker {r['tenant']} read {r['rows']} "
+                                f"rows, expected {len(keys)}")
+                all_ranges.extend(map(tuple, r["ranges"]))
+            if len(all_ranges) != len(set(all_ranges)):
+                dupes = len(all_ranges) - len(set(all_ranges))
+                return fail(
+                    f"{dupes} storage range(s) read MORE THAN ONCE across "
+                    f"{WORKERS} workers — cross-process single-flight broken"
+                )
+            waits = tier.stats()["singleflight_waits"]
+            if not waits >= 1:
+                return fail(
+                    "no cross-process single-flight wait was ever taken — "
+                    "the workers never contended, the law went unexercised"
+                )
+            print(f"process_serving_smoke: single-flight ok — "
+                  f"{len(set(all_ranges))} unique ranges, each read once "
+                  f"across {WORKERS} workers ({waits} cross-process waits)")
+
+            # -- 2: warm worker, zero storage reads --------------------------
+            warm = run_workers(tier, paths, keys, ["warm"], metrics_dir,
+                               tmp, concurrent=False)[0]
+            if warm["rows"] != len(keys):
+                return fail(f"warm worker read {warm['rows']} rows")
+            if warm["ranges"]:
+                return fail(
+                    f"warm worker touched storage {len(warm['ranges'])} "
+                    "time(s); a warm segment must serve every byte"
+                )
+            hits = warm["counters"].get("serve.shm_hits", 0)
+            if not hits > 0:
+                return fail("warm worker recorded no shm hits")
+            print(f"process_serving_smoke: warm worker ok — 0 storage "
+                  f"reads, {hits} shm hits (hit-rate 1.0)")
+
+            # -- 3: per-tenant disjointness + the metrics fold ---------------
+            from parquet_floor_tpu.utils.metrics_export import (
+                merge_snapshot_dir,
+                parse_prometheus,
+            )
+
+            per_worker = {}
+            for name in names + ["warm"]:
+                snap = json.loads(pathlib.Path(
+                    os.path.join(metrics_dir, f"worker-{name}.json")
+                ).read_text())
+                probes = snap["counters"].get("serve.lookup_probes", 0)
+                if probes != len(keys):
+                    return fail(
+                        f"worker {name} snapshot carries {probes} probes, "
+                        f"expected exactly its own {len(keys)} — "
+                        "per-process attribution leaked"
+                    )
+                per_worker[name] = snap
+            merged = merge_snapshot_dir(metrics_dir)
+            want = len(keys) * (WORKERS + 1)
+            got = merged["counters"].get("serve.lookup_probes", 0)
+            if got != want:
+                return fail(f"merged fold carries {got} probes, "
+                            f"expected {want}")
+            # the same fold over HTTP, through the aggregator endpoint
+            from parquet_floor_tpu.utils import trace
+
+            with trace.scope() as t, trace.serve_metrics(
+                0, tracer=t, snapshot_dir=metrics_dir
+            ) as server:
+                text = urllib.request.urlopen(
+                    server.url(), timeout=10
+                ).read().decode()
+                samples = parse_prometheus(text)
+            if samples.get("pftpu_serve_lookup_probes") != want:
+                return fail(
+                    f"HTTP aggregator scrape says "
+                    f"{samples.get('pftpu_serve_lookup_probes')} probes, "
+                    f"expected {want}"
+                )
+            print(f"process_serving_smoke: metrics fold ok — "
+                  f"{WORKERS + 1} worker snapshots, merged probes {got}, "
+                  "HTTP aggregate matches")
+
+            # -- 4: the daemon contract --------------------------------------
+            rc = check_daemon(paths, metrics_dir, want)
+            if rc:
+                return rc
+        print("process_serving_smoke: PASS")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def check_daemon(paths: list, metrics_dir: str, worker_probes: int) -> int:
+    per = GROUP * GROUPS
+    with Serving(prefetch_bytes=16 << 20, device_lanes=2) as srv:
+        with Dataset(paths, "k", cache=srv.cache) as ds:
+            with ServeDaemon(srv, {"smoke": ds},
+                             metrics_dir=metrics_dir) as daemon:
+                with DaemonClient("127.0.0.1", daemon.port, "cli-a",
+                                  weight=2.0) as ca, \
+                        DaemonClient("127.0.0.1", daemon.port,
+                                     "cli-b") as cb:
+                    for i in range(6):
+                        rows = ca.lookup("smoke", 2 * i * PAGE,
+                                         columns=["k"])
+                        if len(rows) != 1:
+                            return fail(f"daemon lookup returned {rows}")
+                    got, cur = [], None
+                    while True:
+                        page, cur = cb.range_page(
+                            "smoke", 0, 4 * PAGE, page_rows=23,
+                            cursor=cur,
+                        )
+                        got.extend(page)
+                        if cur is None:
+                            break
+                    want_rows = ds.range(0, 4 * PAGE)
+                    if got != want_rows:
+                        return fail(
+                            f"daemon paged range returned {len(got)} rows, "
+                            f"expected {len(want_rows)}"
+                        )
+                    # per-connection tenant attribution
+                    ta = srv.tenant("cli-a", 2.0)
+                    tb = srv.tenant("cli-b")
+                    pa = ta.tracer.counters().get("serve.lookup_probes", 0)
+                    if pa != 6:
+                        return fail(f"tenant cli-a carries {pa} probes, "
+                                    "expected its own 6")
+                    pages = tb.tracer.counters().get("serve.cursor_pages", 0)
+                    if not pages >= 2:
+                        return fail("tenant cli-b's cursor pages were not "
+                                    "attributed to it")
+                    # the daemon's metrics op folds the WORKER snapshots
+                    m = ca.metrics()
+                    folded = m["counters"].get("serve.lookup_probes", 0)
+                    if folded < worker_probes + 6:
+                        return fail(
+                            f"daemon metrics op folded {folded} probes, "
+                            f"expected >= {worker_probes + 6} "
+                            "(workers + its own tenants)"
+                        )
+                    if not daemon.drain(10.0):
+                        return fail("daemon drain did not complete clean")
+                    r = ca.request("lookup", dataset="smoke", key=0)
+                    if r.get("code") != "draining":
+                        return fail(f"post-drain probe answered {r!r}, "
+                                    "expected a draining rejection")
+    print(f"process_serving_smoke: daemon ok — attribution, paging "
+          f"({per} row corpus), metrics fold, clean drain")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""One serving worker PROCESS for the multi-worker bench/smoke drivers.
+
+Spawned by ``bench.py``'s traffic leg and
+``scripts/process_serving_smoke.py``: attaches the shared
+:class:`~parquet_floor_tpu.serve.shm_cache.ShmCacheTier` by name,
+mounts it under a private in-process ``SharedBufferCache`` (the L1/L2
+shape every real worker runs), opens the keyed dataset behind it, and
+probes a configured key list — after a file-based start barrier so
+concurrent workers really contend.
+
+Config (JSON file, argv[1]):
+
+* ``mode`` — ``"scale"`` (timed throughput: warm the file opens first,
+  then time the probe loop) or ``"flight"`` (correctness: everything
+  after the barrier, every real storage read RECORDED so the driver
+  can assert the cross-process single-flight law).
+* ``shm`` — segment name to attach; ``paths`` — the dataset files;
+  ``keys`` — the probe keys (``warm_keys`` probed before the barrier
+  in scale mode); ``tenant`` — this worker's tenant name.
+* ``remote`` — optional ``RemoteProfile`` kwargs: sources become
+  seeded ``SimulatedRemoteSource``\\ s (latency-bound storage, the
+  scaling phase's truth regime); otherwise local ``FileSource``.
+* ``ready_file`` / ``go_file`` — the start barrier; ``metrics_dir`` —
+  optional ``write_snapshot`` push directory (the multi-worker
+  metrics fold the smoke validates).
+
+Prints one JSON result line: probes, rows, wall seconds, the worker's
+tracer counters, recorded storage ranges (flight mode), and the shm
+tier's header stats.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from parquet_floor_tpu.io.source import FileSource  # noqa: E402
+from parquet_floor_tpu.serve import (  # noqa: E402
+    Dataset,
+    SharedBufferCache,
+    ShmCacheTier,
+)
+from parquet_floor_tpu.utils import trace  # noqa: E402
+
+
+class RecordingSource:
+    """FileSource wrapper recording every REAL storage range read —
+    what reaches here got through both cache tiers, so the driver's
+    exactly-once-per-unique-range assertion reads this ledger.
+    ``delay_s`` models storage latency, widening each read's in-flight
+    window so concurrent workers actually collide on the flight table
+    (local reads are too fast to overlap otherwise)."""
+
+    def __init__(self, path: str, ledger: list, index: int,
+                 delay_s: float = 0.0):
+        self._src = FileSource(path)
+        self._ledger = ledger
+        self._index = index
+        self._delay = float(delay_s)
+        self.size = self._src.size
+        self.name = self._src.name
+
+    def read_at(self, offset: int, length: int):
+        self._ledger.append((self._index, int(offset), int(length)))
+        if self._delay:
+            time.sleep(self._delay)
+        return self._src.read_at(offset, length)
+
+    def read_many(self, ranges):
+        ranges = list(ranges)
+        for o, n in ranges:
+            self._ledger.append((self._index, int(o), int(n)))
+        if self._delay:
+            time.sleep(self._delay)
+        return self._src.read_many(ranges)
+
+    def close(self) -> None:
+        self._src.close()
+
+
+def make_factories(cfg: dict, ledger: list) -> list:
+    remote = cfg.get("remote")
+    if remote:
+        from parquet_floor_tpu.testing import (
+            RemoteProfile,
+            SimulatedRemoteSource,
+        )
+
+        profile = RemoteProfile(**remote)
+        seed = int(cfg.get("seed", 0))
+        return [
+            (lambda p=p, i=i: SimulatedRemoteSource(
+                p, profile=profile, seed=seed + i, fetch_threads=4
+            ))
+            for i, p in enumerate(cfg["paths"])
+        ]
+    delay = float(cfg.get("read_delay_s", 0.0))
+    return [
+        (lambda p=p, i=i: RecordingSource(p, ledger, i, delay))
+        for i, p in enumerate(cfg["paths"])
+    ]
+
+
+def barrier(cfg: dict) -> None:
+    ready = cfg.get("ready_file")
+    go = cfg.get("go_file")
+    if ready:
+        pathlib.Path(ready).touch()
+    if go:
+        deadline = time.monotonic() + 120.0
+        while not os.path.exists(go):
+            if time.monotonic() > deadline:
+                raise TimeoutError("start barrier never opened")
+            time.sleep(0.005)
+
+
+def main() -> int:
+    cfg = json.loads(pathlib.Path(sys.argv[1]).read_text())
+    ledger: list = []
+    tier = ShmCacheTier.attach(cfg["shm"])
+    try:
+        with SharedBufferCache(
+            data_bytes=int(cfg.get("l1_bytes", 32 << 20)), shm=tier,
+        ) as cache, trace.scope() as tracer:
+            with Dataset(
+                make_factories(cfg, ledger), cfg.get("key_column", "k"),
+                cache=cache,
+            ) as ds:
+                columns = cfg.get("columns")
+                rows = 0
+                for k in cfg.get("warm_keys", []):
+                    rows += len(ds.lookup(k, columns=columns))
+                barrier(cfg)
+                t0 = time.perf_counter()
+                for k in cfg["keys"]:
+                    rows += len(ds.lookup(k, columns=columns))
+                wall = time.perf_counter() - t0
+            shm_stats = tier.stats()
+            counters = tracer.counters()
+            if cfg.get("metrics_dir"):
+                from parquet_floor_tpu.utils.metrics_export import (
+                    snapshot,
+                    write_snapshot,
+                )
+
+                write_snapshot(snapshot(tracer), os.path.join(
+                    cfg["metrics_dir"],
+                    f"worker-{cfg.get('tenant', os.getpid())}.json",
+                ))
+    finally:
+        tier.close()
+    shm_stats.pop("name", None)
+    print(json.dumps({
+        "tenant": cfg.get("tenant"),
+        "probes": len(cfg["keys"]),
+        "rows": rows,
+        "wall": wall,
+        "counters": counters,
+        "ranges": ledger,
+        "shm_stats": shm_stats,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""Seeded-bad: the process-scale serving leak shapes — a ShmCacheTier
+(shared-memory SEGMENT + lock-file fd: the creator's abandoned handle
+leaks host-wide memory, not just a process resource), a ServeDaemon
+(listening socket + event-loop thread + worker pool), and a
+DaemonClient (a live connection some drain must then wait out) bound to
+locals with no exception path releasing them."""
+
+from parquet_floor_tpu.serve import DaemonClient, ServeDaemon, ShmCacheTier
+
+
+def build_tier():
+    tier = ShmCacheTier.create(data_bytes=1 << 20)
+    tier.put(("f", 1), 0, b"xyz")  # a raise here leaks the segment
+    tier.close()
+    return True
+
+
+def attach_tier(name):
+    tier = ShmCacheTier.attach(name)
+    data = tier.get(("f", 1), 0, 3)  # a raise here leaks the lock fd
+    tier.close()
+    return data
+
+
+def run_daemon(serving, datasets):
+    daemon = ServeDaemon(serving, datasets)
+    daemon.start()  # a bind failure leaks the pool and the loop thread
+    daemon.close()
+    return True
+
+
+def probe_daemon(port):
+    client = DaemonClient("127.0.0.1", port, "t")
+    rows = client.lookup("ds", 7)  # any error leaks the connection
+    client.close()
+    return rows

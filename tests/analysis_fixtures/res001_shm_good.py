@@ -1,0 +1,38 @@
+"""Seeded-good: the process-scale serving shapes, properly managed —
+with-managed, transferred, or closed in a finally."""
+
+from parquet_floor_tpu.serve import (
+    DaemonClient,
+    ServeDaemon,
+    SharedBufferCache,
+    ShmCacheTier,
+)
+
+
+def build_tier():
+    with ShmCacheTier.create(data_bytes=1 << 20) as tier:
+        tier.put(("f", 1), 0, b"xyz")
+    return True
+
+
+def attach_tier(name):
+    tier = ShmCacheTier.attach(name)
+    try:
+        return tier.get(("f", 1), 0, 3)
+    finally:
+        tier.close()
+
+
+def mount_tier(name):
+    # ownership transfer: the cache's caller owns the tier's close
+    return SharedBufferCache(shm=ShmCacheTier.attach(name))
+
+
+def run_daemon(serving, datasets):
+    with ServeDaemon(serving, datasets) as daemon:  # __enter__ starts
+        return daemon.port
+
+
+def probe_daemon(port):
+    with DaemonClient("127.0.0.1", port, "t") as client:
+        return client.lookup("ds", 7)

@@ -1,0 +1,325 @@
+"""Serving daemon (serve/daemon.py, docs/serving.md): the protocol,
+per-connection tenant attribution, admission control, graceful drain,
+and the multi-worker metrics fold."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import ParquetFileWriter, WriterOptions, types
+from parquet_floor_tpu.serve import (
+    DaemonClient,
+    Dataset,
+    ServeDaemon,
+    Serving,
+)
+
+GROUP = 128
+PAGE = 32
+GROUPS = 3
+FILES = 2
+PER = GROUP * GROUPS
+
+
+@pytest.fixture(scope="module")
+def paths(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("daemon")
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    out = []
+    for i in range(FILES):
+        p = str(tmp / f"f{i}.parquet")
+        rng = np.random.default_rng(i)
+        with ParquetFileWriter(p, schema, WriterOptions(
+            row_group_rows=GROUP, data_page_values=PAGE,
+            bloom_filter_columns={"k": True},
+        )) as w:
+            for lo in range(0, PER, GROUP):
+                base = 2 * (i * PER + lo)
+                w.write_columns({
+                    "k": base + 2 * np.arange(GROUP, dtype=np.int64),
+                    "s": [f"s{j % 17}" for j in range(GROUP)],
+                })
+        out.append(p)
+    return out
+
+
+def serving_daemon(paths, **daemon_kw):
+    """(serving, dataset, daemon) context helper — the caller closes
+    via the returned daemon context."""
+    srv = Serving(prefetch_bytes=8 << 20, device_lanes=2)
+    ds = Dataset(paths, "k", cache=srv.cache)
+    daemon = ServeDaemon(srv, {"t": ds}, **daemon_kw)
+    return srv, ds, daemon
+
+
+def test_lookup_range_and_errors(paths):
+    srv, ds, daemon = serving_daemon(paths)
+    with srv, ds, daemon:
+        with DaemonClient("127.0.0.1", daemon.port, "alice") as c:
+            assert c.ping()
+            assert c.lookup("t", 0, columns=["k"]) == [{"k": 0}]
+            assert c.lookup("t", 3) == []      # absent key
+            rows = c.range("t", 0, 40)
+            assert [r["k"] for r in rows] == list(range(0, 41, 2))
+            assert c.range("t", 0, 40, limit=5) == rows[:5]
+            # unknown dataset / op / malformed line keep the
+            # connection usable
+            r = c.request("lookup", dataset="nope", key=1)
+            assert r["ok"] is False and r["code"] == "bad_request"
+            r = c.request("frobnicate")
+            assert r["ok"] is False and r["code"] == "bad_request"
+            c._sock.sendall(b"this is not json\n")
+            r = json.loads(c._rfile.readline())
+            assert r["ok"] is False and r["code"] == "bad_request"
+            assert c.lookup("t", 0, columns=["k"]) == [{"k": 0}]
+
+
+def test_hello_required_and_weight_conflict(paths):
+    srv, ds, daemon = serving_daemon(paths)
+    with srv, ds, daemon:
+        import socket as _socket
+
+        s = _socket.create_connection(("127.0.0.1", daemon.port), 10)
+        try:
+            s.sendall(b'{"op": "lookup", "dataset": "t", "key": 0}\n')
+            r = json.loads(s.makefile("rb").readline())
+            assert r["code"] == "hello_required"
+        finally:
+            s.close()
+        with DaemonClient("127.0.0.1", daemon.port, "w", weight=2.0):
+            # re-registering the same tenant at a DIFFERENT weight is
+            # the serving layer's rejection, surfaced at hello
+            with pytest.raises(RuntimeError, match="already registered"):
+                with DaemonClient("127.0.0.1", daemon.port, "w",
+                                  weight=3.0):
+                    pass
+
+
+def test_per_connection_tenant_attribution(paths):
+    srv, ds, daemon = serving_daemon(paths)
+    with srv, ds, daemon:
+        with DaemonClient("127.0.0.1", daemon.port, "ta") as ca, \
+                DaemonClient("127.0.0.1", daemon.port, "tb") as cb:
+            for i in range(4):
+                ca.lookup("t", 2 * i, columns=["k"])
+            cb.lookup("t", 0, columns=["k"])
+            ta = srv.tenant("ta")
+            tb = srv.tenant("tb")
+            assert ta.tracer.counters().get("serve.lookup_probes") == 4
+            assert tb.tracer.counters().get("serve.lookup_probes") == 1
+            # the device WFQ gate metered every daemon probe
+            assert "serve.device_seconds" in ta.tracer.histograms()
+            assert ta.tracer.histograms()[
+                "serve.daemon_request_seconds"
+            ].count == 4
+
+
+def test_range_page_stateless_paging(paths):
+    srv, ds, daemon = serving_daemon(paths)
+    with srv, ds, daemon:
+        brute = ds.range(0, 2 * PER)
+        with DaemonClient("127.0.0.1", daemon.port, "pager") as c:
+            got, cur, pages = [], None, 0
+            while True:
+                rows, cur = c.range_page("t", 0, 2 * PER, page_rows=29,
+                                         cursor=cur)
+                got.extend(rows)
+                pages += 1
+                if cur is None:
+                    break
+            assert got == brute
+            assert pages >= 2
+            # resume an abandoned cursor mid-stream, fresh connection
+            rows1, cur1 = c.range_page("t", 0, 2 * PER, page_rows=13)
+        with DaemonClient("127.0.0.1", daemon.port, "pager2") as c2:
+            rest, cur2 = [], cur1
+            while cur2 is not None:
+                rows, cur2 = c2.range_page("t", 0, 2 * PER, page_rows=50,
+                                           cursor=cur2)
+                rest.extend(rows)
+            assert rows1 + rest == brute
+
+
+def test_admission_control_rejects_over_cap(paths):
+    """Flood a 1-wide, 2-pending daemon through a slow dataset: some
+    requests must be rejected with the overloaded code + retry hint,
+    and every accepted one completes correctly."""
+
+    class SlowDataset:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def lookup(self, key, columns=None, tenant=None, limit=None):
+            time.sleep(0.05)
+            return self._inner.lookup(key, columns=columns,
+                                      tenant=tenant, limit=limit)
+
+    import contextlib
+
+    with Serving(prefetch_bytes=8 << 20) as srv, \
+            Dataset(paths, "k", cache=srv.cache) as ds:
+        with ServeDaemon(srv, {"t": SlowDataset(ds)},
+                         max_inflight=1, max_pending=2) as daemon:
+            with contextlib.ExitStack() as stack:
+                clients = [
+                    stack.enter_context(
+                        DaemonClient("127.0.0.1", daemon.port, f"c{i}")
+                    )
+                    for i in range(6)
+                ]
+                outs = {}
+
+                def fire(i):
+                    outs[i] = clients[i].request(
+                        "lookup", dataset="t", key=0, columns=["k"],
+                    )
+
+                threads = [threading.Thread(target=fire, args=(i,))
+                           for i in range(6)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                rejected = [o for o in outs.values()
+                            if not o.get("ok")]
+                accepted = [o for o in outs.values() if o.get("ok")]
+                assert rejected, "nothing was rejected at 6x overload"
+                for o in rejected:
+                    assert o["code"] == "overloaded"
+                    assert o["retry_after_ms"] > 0
+                for o in accepted:
+                    assert o["rows"] == [{"k": 0}]
+                snap = daemon.worker_snapshot()
+                assert snap["counters"]["serve.daemon_rejected"] == \
+                    len(rejected)
+                assert snap["counters"]["serve.daemon_requests"] == \
+                    len(accepted)
+
+
+def test_graceful_drain_finishes_inflight(paths):
+    """A request in flight when drain starts must complete and be
+    delivered; post-drain requests get the draining rejection."""
+
+    class GateDataset:
+        def __init__(self, inner, release):
+            self._inner = inner
+            self._release = release
+            self.entered = threading.Event()
+
+        def lookup(self, key, columns=None, tenant=None, limit=None):
+            self.entered.set()
+            assert self._release.wait(10)
+            return self._inner.lookup(key, columns=columns,
+                                      tenant=tenant, limit=limit)
+
+    release = threading.Event()
+    with Serving(prefetch_bytes=8 << 20) as srv, \
+            Dataset(paths, "k", cache=srv.cache) as ds:
+        gate = GateDataset(ds, release)
+        with ServeDaemon(srv, {"t": gate}) as daemon:
+            with DaemonClient("127.0.0.1", daemon.port, "d") as c:
+                out = {}
+
+                def fire():
+                    out["r"] = c.request("lookup", dataset="t", key=0,
+                                         columns=["k"])
+
+                t = threading.Thread(target=fire)
+                t.start()
+                assert gate.entered.wait(10)
+                drained = {}
+
+                def do_drain():
+                    drained["clean"] = daemon.drain(10.0)
+
+                dt = threading.Thread(target=do_drain)
+                dt.start()
+                time.sleep(0.05)       # drain is now waiting on us
+                release.set()
+                t.join(10)
+                dt.join(10)
+                assert drained["clean"] is True
+                assert out["r"]["ok"] and out["r"]["rows"] == [{"k": 0}]
+                r = c.request("lookup", dataset="t", key=0)
+                assert r["code"] == "draining"
+
+
+def test_metrics_fold_across_workers(paths, tmp_path):
+    """The daemon's metrics op folds OTHER workers' pushed snapshots
+    with its own live tenants — and the push/merge round-trips."""
+    from parquet_floor_tpu.utils.metrics_export import write_snapshot
+
+    mdir = str(tmp_path / "metrics")
+    os.makedirs(mdir)
+    write_snapshot(
+        {"counters": {"serve.lookup_probes": 7},
+         "gauges": {}, "stages": {}, "histograms": {}},
+        os.path.join(mdir, "worker-else.json"),
+    )
+    with Serving(prefetch_bytes=8 << 20) as srv, \
+            Dataset(paths, "k", cache=srv.cache) as ds:
+        with ServeDaemon(srv, {"t": ds}, metrics_dir=mdir) as daemon:
+            with DaemonClient("127.0.0.1", daemon.port, "m") as c:
+                for i in range(3):
+                    c.lookup("t", 2 * i, columns=["k"])
+                merged = c.metrics()
+                assert merged["counters"]["serve.lookup_probes"] == 10
+                assert "serving health:" in c.health()
+            daemon.drain(5.0)
+            # drain pushed OUR snapshot; a fresh dir fold now carries it
+            from parquet_floor_tpu.utils.metrics_export import (
+                merge_snapshot_dir,
+            )
+
+            folded = merge_snapshot_dir(mdir)
+            assert folded["counters"]["serve.lookup_probes"] == 10
+
+
+def test_daemon_rejects_bad_config(paths):
+    with Serving(prefetch_bytes=8 << 20) as srv, \
+            Dataset(paths, "k", cache=srv.cache) as ds:
+        with pytest.raises(ValueError, match="max_inflight"):
+            with ServeDaemon(srv, {"t": ds}, max_inflight=0):
+                pass
+        with pytest.raises(ValueError, match="max_pending"):
+            with ServeDaemon(srv, {"t": ds}, max_inflight=4,
+                             max_pending=2):
+                pass
+
+
+def test_malformed_hello_weight_keeps_connection_usable(paths):
+    """A non-numeric hello weight answers bad_request — it must not
+    kill the connection (the documented error contract)."""
+    import socket as _socket
+
+    srv, ds, daemon = serving_daemon(paths)
+    with srv, ds, daemon:
+        s = _socket.create_connection(("127.0.0.1", daemon.port), 10)
+        try:
+            rf = s.makefile("rb")
+            s.sendall(b'{"op": "hello", "tenant": "t", '
+                      b'"weight": "heavy"}\n')
+            r = json.loads(rf.readline())
+            assert r["ok"] is False and r["code"] == "bad_request"
+            s.sendall(b'{"op": "hello", "tenant": "t", '
+                      b'"weight": null}\n')
+            r = json.loads(rf.readline())
+            assert r["ok"] is False and r["code"] == "bad_request"
+            # the same socket registers cleanly afterwards
+            s.sendall(b'{"op": "hello", "tenant": "t"}\n')
+            r = json.loads(rf.readline())
+            assert r["ok"] is True and r["weight"] == 1.0
+            s.sendall(b'{"op": "lookup", "dataset": "t", "key": 0, '
+                      b'"columns": ["k"]}\n')
+            r = json.loads(rf.readline())
+            assert r["ok"] is True and r["rows"] == [{"k": 0}]
+        finally:
+            s.close()

@@ -41,6 +41,9 @@ CASES = [
     ("res001_tpe", "FL-RES001"),  # executor/scan-handle shapes of the rule
     ("res001_remote", "FL-RES001"),  # remote session/pool + factory shapes
     ("res001_serve", "FL-RES001"),  # serving cache/context/dataset shapes
+    ("res001_shm", "FL-RES001"),  # shm segment / daemon / client shapes
+    #                               (classmethod factories create/attach
+    #                               are acquisitions too)
     ("alloc001", "FL-ALLOC001"),
     ("obs001", "FL-OBS001"),
     ("lock001", "FL-LOCK001"),

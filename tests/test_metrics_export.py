@@ -176,3 +176,59 @@ def test_file_emitter_writes_atomically(tmp_path):
     assert not list(tmp_path.glob("*.tmp.*"))    # rename left no turds
     with pytest.raises(ValueError, match="interval_s"):
         FileMetricsEmitter(t, str(path), interval_s=0)
+
+
+def test_write_snapshot_and_merge_dir(tmp_path):
+    """The multi-worker fold: per-worker write_snapshot files merge
+    through the one aggregation law, extras included, and a torn file
+    fails LOUDLY (a silent skip would under-report a worker)."""
+    from parquet_floor_tpu.utils.metrics_export import (
+        merge_snapshot_dir,
+        write_snapshot,
+    )
+
+    for i in range(3):
+        write_snapshot(
+            {"counters": {"serve.lookup_probes": 10 + i},
+             "gauges": {"serve.daemon_inflight_max": i},
+             "stages": {}, "histograms": {}},
+            str(tmp_path / f"worker-{i}.json"),
+        )
+    merged = merge_snapshot_dir(str(tmp_path))
+    assert merged["counters"]["serve.lookup_probes"] == 33
+    assert merged["gauges"]["serve.daemon_inflight_max"] == 2
+    extra = {"counters": {"serve.lookup_probes": 7}, "gauges": {},
+             "stages": {}, "histograms": {}}
+    assert merge_snapshot_dir(
+        str(tmp_path), extra=[extra]
+    )["counters"]["serve.lookup_probes"] == 40
+    (tmp_path / "worker-torn.json").write_text("{not json")
+    with pytest.raises(ValueError, match="does not parse"):
+        merge_snapshot_dir(str(tmp_path))
+    (tmp_path / "worker-torn.json").unlink()
+    with pytest.raises(ValueError, match="no worker snapshots"):
+        merge_snapshot_dir(str(tmp_path / "empty-nowhere"))
+
+
+def test_metrics_server_snapshot_dir_folds_workers(tmp_path):
+    """MetricsServer(snapshot_dir=): one scrape sees the worker fleet
+    folded with the server's own live tracer."""
+    from parquet_floor_tpu.utils.metrics_export import write_snapshot
+
+    write_snapshot(
+        {"counters": {"serve.lookup_probes": 5}, "gauges": {},
+         "stages": {}, "histograms": {}},
+        str(tmp_path / "worker-a.json"),
+    )
+    t = Tracer(enabled=True)
+    with trace.using(t):
+        trace.count("serve.lookup_probes", 2)
+    with MetricsServer(t, snapshot_dir=str(tmp_path)) as server:
+        text = urllib.request.urlopen(
+            server.url(), timeout=10
+        ).read().decode()
+        js = json.loads(urllib.request.urlopen(
+            server.url("/metrics.json"), timeout=10
+        ).read().decode())
+    assert parse_prometheus(text)["pftpu_serve_lookup_probes"] == 7
+    assert js["counters"]["serve.lookup_probes"] == 7

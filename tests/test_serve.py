@@ -566,3 +566,336 @@ def test_per_tenant_histograms_disjoint_across_concurrent_scans(keyed):
         assert "serve.lookup_seconds" not in t3.tracer.histograms()
         assert t1.tracer.histograms()["serve.lookup_seconds"].count == \
             probes["one"]
+
+
+# ---------------------------------------------------------------------------
+# device-time WFQ (the second metered resource — docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+def test_device_gate_orders_by_weighted_virtual_time():
+    """Deterministic grant order: with one lane held, queued sessions
+    from a weight-2 and a weight-1 tenant interleave 2:1 by virtual
+    finish time, not FIFO."""
+    from parquet_floor_tpu.serve.tenancy import _DeviceGate
+
+    gate = _DeviceGate(lanes=1)
+    heavy = _TenantShare(2.0, _FairGate(1 << 20), gate)
+    light = _TenantShare(1.0, _FairGate(1 << 20), gate)
+    # occupy the lane so every queued acquire must wait
+    blocker = _TenantShare(1.0, _FairGate(1 << 20), gate)
+    hold = gate.acquire(blocker)
+    order = []
+    lock = threading.Lock()
+
+    def session(share, name):
+        lease = gate.acquire(share)
+        with lock:
+            order.append(name)
+        gate.release(lease, 0.001)
+
+    def park(share, name, expect_waiters):
+        """Start one session and WAIT until it is parked in the heap,
+        so arrival order — and therefore the vtag/seq assignment — is
+        fully deterministic."""
+        t = threading.Thread(target=session, args=(share, name))
+        t.start()
+        deadline = time.monotonic() + 5
+        while gate.stats()["waiters"] < expect_waiters:
+            if time.monotonic() > deadline:
+                raise AssertionError(f"{name} never parked")
+            time.sleep(0.001)
+        return t
+
+    # arrival order H0, H1, L0.  vtags at the default estimate e:
+    # H0 = v, H1 = v + e/2 (heavy's finish advanced by e/weight=e/2),
+    # L0 = v with a later seq.  Weighted virtual-time order is
+    # therefore H0, L0, H1 — a FIFO gate would grant H0, H1, L0.
+    threads = [
+        park(heavy, "H0", 1),
+        park(heavy, "H1", 2),
+        park(light, "L0", 3),
+    ]
+    gate.release(hold, 0.001)
+    for t in threads:
+        t.join()
+    assert order == ["H0", "L0", "H1"], order
+    stats = gate.stats()
+    assert stats["busy"] == 0 and stats["waiters"] == 0
+
+
+def test_device_gate_backlogged_shares_follow_weights():
+    """The fairness law end to end: two continuously-backlogged
+    tenants with 2:1 weights through a 1-lane gate split measured
+    device seconds ~2:1 — equal offered load (2 threads each), the
+    WEIGHT decides the split."""
+    with Serving(prefetch_bytes=8 << 20, device_lanes=1) as srv:
+        heavy = srv.tenant("heavy", weight=2.0)
+        light = srv.tenant("light", weight=1.0)
+        t_end = time.perf_counter() + 0.8
+
+        def hammer(tenant):
+            while time.perf_counter() < t_end:
+                with tenant.device_session():
+                    time.sleep(0.002)
+
+        threads = (
+            [threading.Thread(target=hammer, args=(heavy,))
+             for _ in range(2)]
+            + [threading.Thread(target=hammer, args=(light,))
+               for _ in range(2)]
+        )
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        hs = heavy.tracer.histograms()["serve.device_seconds"].total
+        ls = light.tracer.histograms()["serve.device_seconds"].total
+        share = hs / (hs + ls)
+        assert abs(share - 2 / 3) < 0.15, share
+        assert light.tracer.counters().get("serve.device_waits", 0) > 0
+
+
+def test_cache_hot_tenant_held_to_weight_share(keyed):
+    """The acceptance pin: a 100%-cache-hit tenant offering 3x the
+    light tenant's load through a 1-lane device gate is held to its
+    weight share of engine time (equal weights: one half), where
+    ungated it exceeds it."""
+
+    def run(lanes):
+        with Serving(prefetch_bytes=8 << 20, device_lanes=lanes) as srv:
+            hot = srv.tenant("hot")
+            light = srv.tenant("light")
+            with Dataset(keyed, "k", cache=srv.cache) as ds:
+                keys = [2 * (g * GROUP + off)
+                        for g in range(GROUPS)
+                        for off in range(PAGE // 2, GROUP, PAGE)]
+                for k in keys:   # warm with the EXACT probe shape
+                    ds.range(k, k + 2 * PAGE, columns=["k"])
+                t_end = time.perf_counter() + 0.8
+
+                def hammer(tenant):
+                    i = 0
+                    while time.perf_counter() < t_end:
+                        k = keys[i % len(keys)]
+                        ds.range(k, k + 2 * PAGE, columns=["k"],
+                                 tenant=tenant)
+                        i += 1
+
+                threads = (
+                    [threading.Thread(target=hammer, args=(hot,))
+                     for _ in range(6)]
+                    + [threading.Thread(target=hammer, args=(light,))
+                       for _ in range(2)]
+                )
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            hs = hot.tracer.histograms()["serve.device_seconds"].total
+            ls = light.tracer.histograms()["serve.device_seconds"].total
+            hc = hot.tracer.counters()
+            hit = hc.get("serve.cache_hit_bytes", 0)
+            miss = hc.get("serve.cache_miss_bytes", 0)
+            assert hit > 0 and miss == 0   # genuinely cache-hot
+            return hs / (hs + ls)
+
+    gated = run(lanes=1)
+    ungated = run(lanes=8)
+    assert ungated > 0.58, ungated     # the aggressor CAN exceed
+    assert abs(gated - 0.5) < 0.13, (gated, ungated)
+
+
+def test_charge_device_pushes_tenant_back_in_queue():
+    """A post-hoc charge_device advances the tenant's virtual clock:
+    its next contended acquire queues behind a fresh tenant."""
+    from parquet_floor_tpu.serve.tenancy import _DeviceGate
+
+    gate = _DeviceGate(lanes=1)
+    with Serving(prefetch_bytes=8 << 20, device_lanes=1) as srv:
+        charged = srv.tenant("charged")
+        fresh = srv.tenant("fresh")
+        charged.charge_device(5.0)
+        assert charged._share.dfinish > fresh._share.dfinish
+        h = charged.tracer.histograms()["serve.device_seconds"]
+        assert h.total == pytest.approx(5.0)
+    assert gate.stats()["waiters"] == 0
+
+
+def test_health_shows_device_gate_and_tenant_device_seconds(keyed):
+    with Serving(prefetch_bytes=8 << 20, device_lanes=3) as srv:
+        t = srv.tenant("h")
+        with t.device_session():
+            pass
+        page = srv.health()
+        assert "device gate" in page and "0/3 lane(s)" in page
+        assert "device=" in page
+
+
+def test_serving_device_lanes_validation():
+    with pytest.raises(ValueError, match="lanes"):
+        with Serving(device_lanes=0):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# negative-lookup cache (PR 9 follow-on — docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+def test_negative_cache_short_circuits_repeat_absent_probes(keyed):
+    with SharedBufferCache() as cache, trace.scope() as t:
+        with Dataset(keyed, "k", cache=cache) as ds:
+            assert ds.lookup(3) == []        # odd key: provably absent
+            c0 = t.counters()
+            assert c0.get("serve.negative_hits", 0) == 0
+            pruned0 = c0.get("serve.lookup_groups_pruned", 0)
+            bloom0 = c0.get("serve.lookup_bloom_skips", 0)
+            assert ds.lookup(3) == []        # second probe, same key
+            c1 = t.counters()
+            assert c1.get("serve.negative_hits") == len(keyed)
+            # the ladder never ran: no new prunes, no new bloom skips
+            assert c1.get("serve.lookup_groups_pruned") == pruned0
+            assert c1.get("serve.lookup_bloom_skips") == bloom0
+            # present keys are never poisoned
+            assert ds.lookup(0, columns=["k"]) == [{"k": 0}]
+            assert ds.lookup(0, columns=["k"]) == [{"k": 0}]
+
+
+def test_negative_cache_capped_lru(keyed):
+    with SharedBufferCache() as cache:
+        with Dataset(keyed, "k", cache=cache, negative_keys=4) as ds:
+            for key in (1, 3, 5, 7, 9):     # 5 absent keys, cap 4
+                ds.lookup(key)
+            lf = ds._file(0)
+            assert len(lf.neg) == 4
+            assert 1 not in lf.neg          # oldest evicted
+            with trace.scope() as t:
+                ds.lookup(1)                # re-probe pays the ladder
+                assert t.counters().get("serve.negative_hits", 0) == 0
+                ds.lookup(9)                # cached absent: short-circuit
+                assert t.counters().get("serve.negative_hits") == \
+                    len(keyed)
+
+
+def test_negative_cache_disabled_and_range_not_cached(keyed):
+    with SharedBufferCache() as cache:
+        with Dataset(keyed, "k", cache=cache, negative_keys=0) as ds:
+            with trace.scope() as t:
+                ds.lookup(3)
+                ds.lookup(3)
+                assert t.counters().get("serve.negative_hits", 0) == 0
+        with Dataset(keyed, "k", cache=cache) as ds:
+            with trace.scope() as t:
+                # a range probe over an empty span records nothing
+                assert ds.range(3, 3) == []
+                ds.lookup(3)
+                # ...so this lookup still descended the ladder fresh
+                assert t.counters().get("serve.negative_hits", 0) == 0
+    with pytest.raises(ValueError, match="negative_keys"):
+        with Dataset(keyed, "k", negative_keys=-1):
+            pass
+
+
+def test_limit_stop_records_only_fully_descended_files(keyed):
+    """A limit= early stop records absence ONLY for files that were
+    fully descended and empty: file 0 (the key provably isn't there)
+    yes, the file that SERVED the row never, and the row keeps being
+    served on the short-circuited re-probe."""
+    with SharedBufferCache() as cache:
+        with Dataset(keyed, "k", cache=cache) as ds:
+            per = GROUP * GROUPS
+            key = 2 * per                   # lives in file 1 only
+            assert ds.lookup(key, columns=["k"], limit=1) == [{"k": key}]
+            assert key in ds._file(0).neg       # proven absent there
+            assert key not in ds._file(1).neg   # it served the row
+            with trace.scope() as t:
+                assert ds.lookup(key, columns=["k"], limit=1) == \
+                    [{"k": key}]
+                assert t.counters().get("serve.negative_hits") == 1
+
+
+# ---------------------------------------------------------------------------
+# streaming range cursor (PR 9 follow-on — docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+def test_range_cursor_matches_range_and_pages_bounded(keyed):
+    with SharedBufferCache() as cache, trace.scope() as t:
+        with Dataset(keyed, "k", cache=cache) as ds:
+            per = GROUP * GROUPS
+            lo, hi = 10, 2 * per + 600
+            brute = ds.range(lo, hi)
+            cur = ds.range_cursor(lo, hi, page_rows=64)
+            pages = []
+            while True:
+                page = cur.next_page()
+                if not page:
+                    break
+                assert len(page) <= 64
+                pages.append(page)
+            assert [r for p in pages for r in p] == brute
+            assert cur.exhausted and cur.token is None
+            assert len(pages) >= 2
+            assert t.counters().get("serve.cursor_pages") == \
+                len(pages) + 1      # + the final empty page
+
+
+def test_range_cursor_resume_token_json_safe(keyed):
+    import json as _json
+
+    with SharedBufferCache() as cache:
+        with Dataset(keyed, "k", cache=cache) as ds:
+            brute = ds.range(0, 900)
+            cur = ds.range_cursor(0, 900, page_rows=37)
+            first = cur.next_page()
+            token = _json.loads(_json.dumps(cur.token))
+            rest = list(ds.range_cursor(0, 900, page_rows=64,
+                                        cursor=token))
+            assert first + rest == brute
+
+
+def test_range_cursor_resume_at_every_page_boundary(keyed):
+    """Exactly-once delivery across a resume at ANY page boundary —
+    including mid-group and across the file boundary."""
+    with SharedBufferCache() as cache:
+        with Dataset(keyed, "k", cache=cache) as ds:
+            per = GROUP * GROUPS
+            lo, hi = 2 * (per - 80), 2 * (per + 80)   # spans both files
+            brute = ds.range(lo, hi)
+            cur = ds.range_cursor(lo, hi, page_rows=16)
+            seen = []
+            while True:
+                page = cur.next_page()
+                if not page:
+                    break
+                seen.extend(page)
+                tok = cur.token
+                if tok is not None:
+                    remainder = list(ds.range_cursor(
+                        lo, hi, page_rows=200, cursor=dict(tok)
+                    ))
+                    assert seen + remainder == brute
+            assert seen == brute
+
+
+def test_range_cursor_iteration_and_validation(keyed):
+    with SharedBufferCache() as cache:
+        with Dataset(keyed, "k", cache=cache) as ds:
+            assert list(ds.range_cursor(0, 100)) == ds.range(0, 100)
+            assert list(ds.range_cursor(5, 3)) == []
+            with pytest.raises(ValueError, match="page_rows"):
+                ds.range_cursor(0, 10, page_rows=0)
+            with pytest.raises(ValueError, match="cursor token"):
+                ds.range_cursor(0, 10, cursor={"bogus": 1})
+
+
+def test_range_cursor_tenant_attribution(keyed):
+    with Serving(prefetch_bytes=8 << 20) as srv:
+        t = srv.tenant("cur")
+        with Dataset(keyed, "k", cache=srv.cache) as ds:
+            list(ds.range_cursor(0, 400, tenant=t, page_rows=32))
+            c = t.tracer.counters()
+            assert c.get("serve.cursor_pages", 0) >= 2
+            assert c.get("serve.lookup_rows", 0) == len(ds.range(0, 400))
+            assert "serve.device_seconds" in t.tracer.histograms()

@@ -1,0 +1,320 @@
+"""Cross-process cache tier (serve/shm_cache.py, docs/serving.md).
+
+The laws under test: exact-range keyed hits, two-ring (pinned/data)
+eviction accounting, cross-process single-flight (exactly one storage
+read per unique range across attached caches), expired-lease takeover,
+the copy-out borrow guarantee under churn, and the real-subprocess
+attach/stats path."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from parquet_floor_tpu.serve import SharedBufferCache, ShmCacheTier
+from parquet_floor_tpu.serve.shm_cache import _digest
+
+
+def small_tier(**kw):
+    kw.setdefault("data_bytes", 1 << 16)
+    kw.setdefault("meta_bytes", 1 << 14)
+    kw.setdefault("slots", 64)
+    kw.setdefault("flights", 16)
+    return ShmCacheTier.create(**kw)
+
+
+def test_put_get_exact_range():
+    with small_tier() as tier:
+        key = ("f", 100)
+        tier.put(key, 0, b"hello world")
+        assert tier.get(key, 0, 11) == b"hello world"
+        # exact-range keying: containment is the L1's job, not this
+        # tier's — a sub-range is a miss here
+        assert tier.get(key, 0, 5) is None
+        assert tier.get(key, 1, 10) is None
+        # a different file key never aliases
+        assert tier.get(("g", 100), 0, 11) is None
+
+
+def test_get_returns_an_independent_copy():
+    """The borrow law, met by copy-out: churning the ring after a get
+    must never mutate the returned bytes."""
+    with small_tier() as tier:
+        tier.put(("f", 1), 0, b"A" * 600)
+        borrowed = tier.get(("f", 1), 0, 600)
+        for i in range(400):   # churn far past the ring budget
+            tier.put(("e", i), 0, bytes([i % 251]) * 500)
+        assert borrowed == b"A" * 600
+
+
+def test_ring_eviction_counted_and_bounded():
+    with small_tier() as tier:
+        for i in range(300):
+            tier.put(("e", i), 0, bytes(500))
+        st = tier.stats()
+        assert st["evictions"] > 0
+        assert st["data_bytes_used"] <= st["data_bytes"]
+        # oldest entries evicted, newest still present
+        assert tier.get(("e", 0), 0, 500) is None
+        assert tier.get(("e", 299), 0, 500) == bytes(500)
+
+
+def test_pinned_ring_is_separate():
+    """Data churn must never evict pinned metadata (the pinned law)."""
+    with small_tier() as tier:
+        tier.put(("meta", 1), 0, b"M" * 256, pinned=True)
+        for i in range(300):
+            tier.put(("e", i), 0, bytes(500))
+        assert tier.get(("meta", 1), 0, 256) == b"M" * 256
+        st = tier.stats()
+        assert st["meta_evictions"] == 0
+        # the meta ring has its OWN budget: overflow it and evictions
+        # are counted there, not silently
+        for i in range(40):
+            tier.put(("m", i), 0, bytes(600), pinned=True)
+        assert tier.stats()["meta_evictions"] > 0
+
+
+def test_oversized_entry_serves_through_uncached():
+    with small_tier() as tier:
+        big = bytes(tier.data_bytes + 64)
+        tier.put(("f", 1), 0, big)
+        assert tier.get(("f", 1), 0, len(big)) is None
+
+
+def test_read_through_miss_then_hit():
+    with small_tier() as tier:
+        calls = []
+
+        def rm(ranges):
+            calls.append(list(ranges))
+            return [bytes([n % 251]) * n for _, n in ranges]
+
+        out = tier.read_through(("f", 9), [(0, 64), (100, 32)], rm)
+        assert [len(b) for b in out] == [64, 32]
+        assert calls == [[(0, 64), (100, 32)]]
+        out2 = tier.read_through(("f", 9), [(0, 64), (100, 32)], rm)
+        assert out2 == out
+        assert len(calls) == 1        # second pass fully from the tier
+        st = tier.stats()
+        assert st["hits"] == 2 and st["misses"] == 2
+
+
+def test_single_flight_across_attached_caches():
+    """Two SharedBufferCaches over one tier model two worker
+    processes: a concurrent identical range issues ONE storage read;
+    the other side waits and gets the leader's bytes."""
+    with small_tier() as tier:
+        reads = []
+        ev = threading.Event()
+
+        def slow_rm(ranges):
+            reads.append(list(ranges))
+            ev.set()
+            time.sleep(0.05)
+            return [bytes(n) for _, n in ranges]
+
+        with SharedBufferCache(data_bytes=1 << 20, shm=tier) as ca, \
+                SharedBufferCache(data_bytes=1 << 20, shm=tier) as cb:
+            res = {}
+
+            def go(name, c):
+                res[name] = bytes(
+                    c.fetch_many(("h", 9), [(0, 64)], slow_rm)[0]
+                )
+
+            ta = threading.Thread(target=go, args=("a", ca))
+            tb = threading.Thread(target=go, args=("b", cb))
+            ta.start()
+            ev.wait(5)          # the leader is mid-read when b arrives
+            tb.start()
+            ta.join()
+            tb.join()
+            assert res["a"] == res["b"] == bytes(64)
+            assert len(reads) == 1
+            assert tier.stats()["singleflight_waits"] >= 1
+
+
+def test_failed_leader_lets_a_waiter_relead():
+    """A leader whose storage read raises clears its flight; the waiter
+    takes over and re-issues (the cross-process analogue of error
+    propagation)."""
+    with small_tier() as tier:
+        state = {"calls": 0}
+        started = threading.Event()
+
+        def flaky_rm(ranges):
+            state["calls"] += 1
+            started.set()
+            if state["calls"] == 1:
+                time.sleep(0.02)
+                raise OSError("transient storage failure")
+            return [bytes(n) for _, n in ranges]
+
+        results = {}
+
+        def lead():
+            try:
+                tier.read_through(("f", 5), [(0, 32)], flaky_rm)
+            except OSError as e:
+                results["lead"] = str(e)
+
+        def wait():
+            results["wait"] = tier.read_through(("f", 5), [(0, 32)],
+                                                flaky_rm)[0]
+
+        tl = threading.Thread(target=lead)
+        tw = threading.Thread(target=wait)
+        tl.start()
+        started.wait(5)
+        tw.start()
+        tl.join()
+        tw.join()
+        assert results["lead"] == "transient storage failure"
+        assert results["wait"] == bytes(32)
+        assert state["calls"] == 2
+        assert tier.stats()["takeovers"] >= 1
+
+
+def test_expired_lease_takeover():
+    """A dead leader (lease expiry, nothing ever installed) must not
+    wedge waiters: they claim the flight and lead themselves."""
+    with small_tier(lease_s=0.05) as tier:
+        d = _digest(("f", 7), 0, 16)
+        with tier._locked():
+            assert tier._flight_check(*d, claim=True) is False  # claimed
+
+        def rm(ranges):
+            return [bytes(n) for _, n in ranges]
+
+        t0 = time.perf_counter()
+        out = tier.read_through(("f", 7), [(0, 16)], rm)
+        assert out[0] == bytes(16)
+        assert time.perf_counter() - t0 < 5.0
+        assert tier.stats()["takeovers"] == 1
+
+
+def test_duplicate_ranges_one_call_single_read():
+    with small_tier() as tier:
+        calls = []
+
+        def rm(ranges):
+            calls.append(list(ranges))
+            return [bytes(n) for _, n in ranges]
+
+        out = tier.read_through(("f", 2), [(0, 8), (0, 8), (0, 8)], rm)
+        assert [bytes(b) for b in out] == [bytes(8)] * 3
+        assert calls == [[(0, 8)]]
+
+
+def test_l1_pinned_put_lands_in_meta_ring():
+    with small_tier() as tier:
+        with SharedBufferCache(data_bytes=1 << 20, shm=tier) as cache:
+            cache.fetch_many(
+                ("f", 3), [(0, 128)],
+                lambda rs: [bytes(n) for _, n in rs], pinned=True,
+            )
+        st = tier.stats()
+        assert st["meta_bytes_used"] > 0
+        assert st["data_bytes_used"] == 0
+
+
+def test_attach_validates_magic():
+    from multiprocessing import shared_memory
+
+    seg = shared_memory.SharedMemory(create=True, size=4096)
+    try:
+        seg.buf[:8] = b"notatier"
+        with pytest.raises(ValueError, match="not a ShmCacheTier"):
+            with ShmCacheTier.attach(seg.name):
+                pass
+    finally:
+        seg.close()
+        seg.unlink()
+
+
+def test_closed_tier_refuses():
+    tier = small_tier()
+    tier.close()
+    tier.close()     # idempotent
+    with pytest.raises(ValueError, match="closed"):
+        tier.get(("f", 1), 0, 4)
+
+
+def test_real_subprocess_shares_the_segment():
+    """An actual second OS process attaches by name, reads what we
+    wrote, writes back, and its traffic lands in the shared header
+    stats."""
+    with small_tier() as tier:
+        tier.put(("x", 1), 0, b"parent-bytes")
+        code = (
+            "import sys, json\n"
+            "sys.path.insert(0, %r)\n"
+            "from parquet_floor_tpu.serve import ShmCacheTier\n"
+            "tier = ShmCacheTier.attach(%r)\n"
+            "try:\n"
+            "    got = tier.get(('x', 1), 0, 12)\n"
+            "    assert got == b'parent-bytes', got\n"
+            "    tier.put(('x', 2), 0, b'child-bytes!')\n"
+            "finally:\n"
+            "    tier.close()\n"
+            "print('CHILD_OK')\n"
+        ) % (str(__import__("pathlib").Path(__file__).parent.parent),
+             tier.name)
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            timeout=60,
+        )
+        assert out.returncode == 0, out.stderr.decode()
+        assert b"CHILD_OK" in out.stdout
+        # the child's detach did NOT unlink the segment under us
+        assert tier.get(("x", 2), 0, 12) == b"child-bytes!"
+        st = tier.stats()
+        assert st["hits"] >= 2    # child's hit + ours, one shared ledger
+
+
+def test_worker_json_result_shape():
+    """The serve_worker result contract the smoke/bench drivers parse
+    (probes/rows/wall/ranges/counters/shm_stats)."""
+    import os
+    import pathlib
+    import tempfile
+
+    import numpy as np
+
+    from parquet_floor_tpu import ParquetFileWriter, WriterOptions, types
+
+    schema = types.message(
+        "t", types.required(types.INT64).named("k"),
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "f.parquet")
+        with ParquetFileWriter(path, schema, WriterOptions(
+            row_group_rows=64, data_page_values=16,
+            bloom_filter_columns={"k": True},
+        )) as w:
+            w.write_columns({"k": 2 * np.arange(128, dtype=np.int64)})
+        with small_tier(data_bytes=1 << 20) as tier:
+            cfg = {
+                "mode": "flight", "shm": tier.name, "paths": [path],
+                "keys": [0, 64, 128], "columns": ["k"], "tenant": "t0",
+            }
+            cfg_path = os.path.join(tmp, "cfg.json")
+            pathlib.Path(cfg_path).write_text(json.dumps(cfg))
+            script = str(
+                pathlib.Path(__file__).parent.parent / "scripts"
+                / "serve_worker.py"
+            )
+            out = subprocess.run(
+                [sys.executable, script, cfg_path],
+                capture_output=True, timeout=120,
+            )
+            assert out.returncode == 0, out.stderr.decode()
+            res = json.loads(out.stdout.decode().splitlines()[-1])
+            assert res["probes"] == 3 and res["rows"] == 3
+            assert res["ranges"], "worker recorded no storage reads"
+            assert res["counters"].get("serve.lookup_probes") == 3
+            assert res["shm_stats"]["misses"] > 0

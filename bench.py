@@ -1248,6 +1248,200 @@ def traffic_leg(n_rows: int) -> dict:
     }
 
 
+def fleet_leg(n_rows: int) -> dict:
+    """The fleet-survivability truth bench (docs/serving.md), gated by
+    ``check_bench_report.check_fleet_leg`` — k serving daemons as ONE
+    cache tier, driven over a COUNTED origin in two passes:
+
+    * **exactly-once** — every node reads every unique range through
+      its :class:`FleetCache`; fleet-wide origin reads must stay
+      within 1.25x the unique-range count (non-primaries peer-fetch
+      the owner instead of re-reading origin), with the peer leg and
+      hot-range replication actually exercised;
+    * **host-loss chaos** — one daemon dies MID-LOAD with the old
+      membership still installed: every request must still answer
+      byte-correct (a dead owner degrades to origin fallback, never an
+      error), a stale-epoch asker must be FENCED (and itself degrade
+      to origin, correctly), and p99 measured across the whole ordeal
+      — failover, fence window, epoch-bumped reinstall — must hold the
+      recorded SLO.
+    """
+    import threading as _threading
+
+    from parquet_floor_tpu.serve import (
+        FleetCache,
+        FleetMembership,
+        PeerClient,
+        ServeDaemon,
+        Serving,
+    )
+    from parquet_floor_tpu.utils import trace as _trace
+    from parquet_floor_tpu.utils.histogram import LogHistogram
+
+    slo_p99_s = float(os.environ.get("PFTPU_BENCH_FLEET_SLO_S", 0.25))
+    origin_latency_s = 0.004
+    origin_lock = _threading.Lock()
+    origin_counts: dict = {}
+
+    def content(offset: int, length: int) -> bytes:
+        pat = f"fleet:{offset}:{length}:".encode("ascii")
+        return (pat * (length // len(pat) + 1))[:length]
+
+    def origin_read(key, ranges):
+        with origin_lock:
+            for (o, n) in ranges:
+                origin_counts[(o, n)] = origin_counts.get((o, n), 0) + 1
+        time.sleep(origin_latency_s)  # the modeled storage RTT
+        return [content(o, n) for (o, n) in ranges]
+
+    node_ids = ["n0", "n1", "n2"]
+    membership = FleetMembership.create(node_ids)
+    key = ("bench-fleet", 1 << 20)
+    servings, fleets, daemons = [], [], []
+    client_tracers = {
+        nid: _trace.Tracer(enabled=True) for nid in node_ids
+    }
+    try:
+        for nid in node_ids:
+            srv = Serving(prefetch_bytes=8 << 20)
+            fc = FleetCache(
+                nid, membership, origin=origin_read,
+                peer_timeout_s=1.0, breaker_threshold=2,
+                breaker_cooldown_s=0.2,
+            )
+            d = ServeDaemon(
+                srv, {}, fleet=fc, max_inflight=4, max_pending=64,
+                drain_timeout_s=2.0,
+            ).start()
+            servings.append(srv)
+            fleets.append(fc)
+            daemons.append(d)
+        peers = {
+            nid: ("127.0.0.1", d.port)
+            for nid, d in zip(node_ids, daemons)
+        }
+        for fc in fleets:
+            fc.install_membership(membership, peers)
+
+        def fold(counter: str) -> int:
+            return sum(
+                tr.counters().get(counter, 0)
+                for tr in list(client_tracers.values())
+                + [d.tracer for d in daemons]
+            )
+
+        # -- pass A: fleet-wide exactly-once origin reads -------------------
+        ranges_a = [(i * 8192, 1536) for i in range(48)]
+        wrong = 0
+        for nid, fc in zip(node_ids, fleets):
+            with _trace.using(client_tracers[nid]):
+                got = fc.read_through(
+                    key, ranges_a, lambda rs: origin_read(key, rs))
+            for (o, n), data in zip(ranges_a, got):
+                if data != content(o, n):
+                    wrong += 1
+        with origin_lock:
+            a_reads = sum(origin_counts.values())
+        ratio = a_reads / len(ranges_a)
+
+        # -- pass B: host-loss chaos ----------------------------------------
+        base_b = 1 << 22
+        ranges_b = [(base_b + i * 8192, 1536) for i in range(48)]
+        survivors = [(node_ids[i], fleets[i]) for i in (0, 1)]
+        hist = LogHistogram()
+        chaos_requests = 0
+        chaos_errors = 0
+        killed = _threading.Event()
+
+        def kill_victim():
+            # mid-load host loss: drain answers in-flight peers, then
+            # the port goes dead — askers see refusals, then
+            # connection errors, and must degrade to origin
+            daemons[2].close()
+            fleets[2].close()
+            killed.set()
+
+        def chaos_read(nid, fc, o, n):
+            nonlocal chaos_requests, chaos_errors
+            chaos_requests += 1
+            t0 = time.perf_counter()
+            try:
+                with _trace.using(client_tracers[nid]):
+                    data = fc.read_through(
+                        key, [(o, n)], lambda rs: origin_read(key, rs))[0]
+            except Exception:
+                chaos_errors += 1
+                hist.record(time.perf_counter() - t0)
+                return 1
+            hist.record(time.perf_counter() - t0)
+            return 0 if data == content(o, n) else 1
+
+        killer = None
+        for i, (o, n) in enumerate(ranges_b):
+            if i == len(ranges_b) // 3 and killer is None:
+                killer = _threading.Thread(target=kill_victim)
+                killer.start()
+            nid, fc = survivors[i % 2]
+            wrong += chaos_read(nid, fc, o, n)
+        killer.join()
+        # the victim is gone but epoch 1 is still installed: a full
+        # re-read must survive dead-owner fetches via origin fallback
+        for i, (o, n) in enumerate(ranges_b):
+            nid, fc = survivors[(i + 1) % 2]
+            wrong += chaos_read(nid, fc, o, n)
+        # explicit fence probe: a stale-epoch asker must be refused
+        with PeerClient("127.0.0.1", daemons[0].port) as probe:
+            reply = probe.fetch(key, ranges_b[0][0], ranges_b[0][1],
+                                epoch=999)
+        fence_refused = (not reply.get("ok")
+                         and reply.get("code") == "stale_epoch")
+        # epoch-bumped reinstall, one survivor at a time: in the
+        # window where n0 is on epoch 2 and n1 still on 1, n0's peer
+        # fetches are FENCED and must degrade to origin — correctly
+        new_membership = membership.without("n2")
+        new_peers = {nid: peers[nid] for nid in new_membership.members}
+        fleets[0].install_membership(new_membership, new_peers)
+        base_c = 1 << 24
+        ranges_c = [(base_c + i * 8192, 1536) for i in range(12)]
+        for (o, n) in ranges_c[:6]:
+            wrong += chaos_read("n0", fleets[0], o, n)
+        fleets[1].install_membership(new_membership, new_peers)
+        for i, (o, n) in enumerate(ranges_c):
+            nid, fc = survivors[i % 2]
+            wrong += chaos_read(nid, fc, o, n)
+        p99_s = hist.percentile(99)
+
+        return {
+            "fleet_nodes": len(node_ids),
+            "fleet_unique_ranges": len(ranges_a),
+            "fleet_requests": len(node_ids) * len(ranges_a),
+            "fleet_origin_reads": a_reads,
+            "fleet_origin_ratio": round(ratio, 3),
+            "fleet_origin_ratio_max": 1.25,
+            "fleet_exactly_once_ok": bool(ratio <= 1.25),
+            "fleet_peer_hits": fold("serve.fleet_peer_hits"),
+            "fleet_replications": fold("serve.fleet_replications"),
+            "fleet_peer_fallbacks": fold("serve.fleet_peer_fallbacks"),
+            "fleet_fenced": fold("serve.fleet_epoch_fenced"),
+            "fleet_fence_refused": fence_refused,
+            "fleet_breaker_trips": fold("io.remote.breaker_trips"),
+            "fleet_wrong": wrong,
+            "fleet_chaos_requests": chaos_requests,
+            "fleet_chaos_errors": chaos_errors,
+            "fleet_chaos_p99_ms": round(p99_s * 1e3, 3),
+            "fleet_chaos_slo_ms": slo_p99_s * 1e3,
+            "fleet_chaos_slo_ok": bool(p99_s <= slo_p99_s),
+            "fleet_chaos_hist": hist.as_dict(),
+        }
+    finally:
+        for d in daemons:
+            d.close()  # idempotent — the chaos victim is already down
+        for fc in fleets:
+            fc.close()
+        for srv in servings:
+            srv.close()
+
+
 def write_leg(n_rows: int, reps: int) -> dict:
     """Device write path (docs/write.md), gated by
     ``check_bench_report.check_write_leg``: the fused encode engine
@@ -1790,6 +1984,10 @@ def main():
     # workers + modeled remote latency — real sleeps, no device work,
     # runs once like the remote leg
     traffic_detail = traffic_leg(n_rows)
+    # fleet-survivability truth bench (docs/serving.md): in-process
+    # daemons over a counted origin — real sockets, real sleeps, no
+    # device work, runs once
+    fleet_detail = fleet_leg(n_rows)
     # exec-cache cold/warm leg (docs/perf.md): runs in SUBPROCESSES
     # (fresh jax each), so its placement among the timed legs is free
     exec_cache_detail = exec_cache_leg(n_rows)
@@ -1852,6 +2050,7 @@ def main():
             **remote_detail,
             **serving_detail,
             **traffic_detail,
+            **fleet_detail,
             **exec_cache_detail,
             **pushdown_detail,
             **write_detail,

@@ -88,6 +88,12 @@ _ACQUIRERS = {
     # worker pool, and a DaemonClient holds a live connection a server
     # drain then has to wait out
     "ShmCacheTier", "ServeDaemon", "DaemonClient",
+    # the fleet fabric (serve/fleet.py, docs/serving.md): a FleetCache
+    # owns every PeerClient connection it was installed with plus its
+    # local byte store, and a bare PeerClient holds a live socket a
+    # peer daemon's drain then has to wait out — both release with
+    # close() and leak sockets exactly like an fd
+    "FleetCache", "PeerClient",
     # the write path (write/, docs/write.md): a DeviceFileWriter owns a
     # sink fd AND a compression pool (close() finalizes the footer,
     # abort() releases without one — both are releases), and the

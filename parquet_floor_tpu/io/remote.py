@@ -111,17 +111,21 @@ class LatencyStats:
         self._cap = int(cap)
         self._lock = threading.Lock()
         self._ring: List[float] = []
+        self._sizes: List[int] = []   # bytes per sample (0 = unsized)
         self._pos = 0
         self.count = 0
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float, nbytes: int = 0) -> None:
         s = float(seconds)
+        b = int(nbytes)
         with self._lock:
             self.count += 1
             if len(self._ring) < self._cap:
                 self._ring.append(s)
+                self._sizes.append(b)
             else:
                 self._ring[self._pos] = s
+                self._sizes[self._pos] = b
                 self._pos = (self._pos + 1) % self._cap
 
     def quantile(self, q: float) -> Optional[float]:
@@ -134,6 +138,29 @@ class LatencyStats:
 
     def p95(self) -> Optional[float]:
         return self.quantile(0.95)
+
+    def mean_size(self) -> Optional[float]:
+        """Mean bytes of the SIZED samples in the window, or None."""
+        with self._lock:
+            sized = [b for b in self._sizes if b > 0]
+        if not sized:
+            return None
+        return sum(sized) / len(sized)
+
+    def bandwidth_Bps(self) -> Optional[float]:
+        """Observed transfer rate over the sized samples (total bytes /
+        total seconds), or None.  Includes per-request overhead, so it
+        UNDER-estimates the raw link — which over-estimates the extra
+        transfer time a larger request implies: the conservative
+        direction for widening a hedge delay."""
+        with self._lock:
+            pairs = [(s, b) for s, b in zip(self._ring, self._sizes)
+                     if b > 0]
+        tot_s = sum(s for s, _ in pairs)
+        tot_b = sum(b for _, b in pairs)
+        if tot_b <= 0 or tot_s <= 0:
+            return None
+        return tot_b / tot_s
 
 
 class CircuitBreaker:
@@ -264,9 +291,12 @@ class RemoteSource:
 
     ``hedge_delay_s=None`` (default) is ADAPTIVE: hedge when a request
     outlives the source's observed p95 latency (clamped to
-    ``[hedge_min_delay_s, hedge_max_delay_s]``); hedging stays off until
-    ``hedge_min_samples`` latencies are on record — there is no tail to
-    estimate from cold.  ``hedge=False`` disables hedging entirely.
+    ``[hedge_min_delay_s, hedge_max_delay_s]``), widened per request by
+    the extra transfer time its byte size implies over the sampled mean
+    (:meth:`hedge_delay`) — a large fetch is not "slow" just for being
+    big; hedging stays off until ``hedge_min_samples`` latencies are on
+    record — there is no tail to estimate from cold.  ``hedge=False``
+    disables hedging entirely.
 
     ``range_deadline_s`` bounds ONE range fetch including its hedge:
     crossing it raises :class:`RemoteTransientError` (retryable above,
@@ -325,10 +355,18 @@ class RemoteSource:
     def size(self) -> int:
         return int(self._transport.size)
 
-    def hedge_delay(self) -> Optional[float]:
+    def hedge_delay(self, length: Optional[int] = None) -> Optional[float]:
         """The CURRENT hedge delay in seconds: the fixed configuration,
         or the adaptive p95-based one; None while hedging is off (or the
-        adaptive estimator has too few samples)."""
+        adaptive estimator has too few samples).
+
+        With ``length``, the adaptive delay is BYTE-SIZE-INFORMED: the
+        p95 is widened by the extra transfer time the requested size
+        implies beyond the sampled mean (at the window's observed
+        bytes/s), so a 16 MiB fetch does not hedge on a p95 learned
+        from 64 KiB footer reads — a large read that is merely *big* is
+        not slow, and duplicating it doubles the most expensive
+        requests exactly when they are healthy."""
         if not self._hedge:
             return None
         if self._hedge_delay_s is not None:
@@ -338,7 +376,13 @@ class RemoteSource:
         p95 = self.latency.p95()
         if p95 is None:
             return None
-        return min(self._hedge_max, max(self._hedge_min, p95))
+        extra = 0.0
+        if length is not None:
+            mean_size = self.latency.mean_size()
+            bw = self.latency.bandwidth_Bps()
+            if mean_size is not None and bw is not None and bw > 0:
+                extra = max(0.0, float(length) - mean_size) / bw
+        return min(self._hedge_max, max(self._hedge_min, p95 + extra))
 
     # -- one physical request ------------------------------------------------
 
@@ -370,7 +414,7 @@ class RemoteSource:
                 path=self.name, offset=offset,
             )
         self.breaker.on_success()
-        self.latency.observe(self._clock() - t0)
+        self.latency.observe(self._clock() - t0, length)
         trace.count("io.remote.requests")
         trace.count("io.remote.bytes", length)
         return data
@@ -441,7 +485,7 @@ class RemoteSource:
                 )
                 if remaining is not None and remaining <= 0:
                     break  # deadline crossed with requests still in flight
-                hd = None if hedged else self.hedge_delay()
+                hd = None if hedged else self.hedge_delay(length)
                 if hd is None:
                     timeout = remaining
                 else:

@@ -59,7 +59,14 @@ from ..format.file_read import (
 )
 from ..io.source import FileSource
 from ..utils import trace
-from .plan import Extent, FilePlan, GroupPlan, ScanOptions, plan_file
+from .plan import (
+    DEFAULT_MAX_GAP_BYTES,
+    Extent,
+    FilePlan,
+    GroupPlan,
+    ScanOptions,
+    plan_file,
+)
 
 
 class DatasetSchemaError(ValueError):
@@ -256,12 +263,16 @@ class _AdaptiveController:
         self._lock = threading.Lock()
         self._rtt: Optional[float] = None    # EWMA per-load wall seconds
         self._cost: Optional[float] = None   # EWMA admitted unit cost
+        self._bw: Optional[float] = None     # EWMA load bytes/second
         self._last_logged: Optional[int] = None
 
     def observe_load(self, nbytes: int, seconds: float) -> None:
         """One extent-load measurement (worker thread): the load's wall
         time is the RTT sample (transfer included — a conservative
-        overestimate that only ever deepens the pipeline)."""
+        overestimate that only ever deepens the pipeline), and
+        bytes/wall is the bandwidth sample (RTT included — an
+        UNDER-estimate of the raw link, which only ever narrows the
+        auto-tuned coalescing gap)."""
         if seconds <= 0:
             return
         with self._lock:
@@ -269,6 +280,12 @@ class _AdaptiveController:
                 seconds if self._rtt is None
                 else 0.7 * self._rtt + 0.3 * seconds
             )
+            if nbytes > 0:
+                bw = nbytes / seconds
+                self._bw = (
+                    bw if self._bw is None
+                    else 0.7 * self._bw + 0.3 * bw
+                )
 
     def observe_cost(self, cost: int) -> None:
         """One admitted unit's budget charge (consumer thread)."""
@@ -281,6 +298,14 @@ class _AdaptiveController:
     def rtt_s(self) -> Optional[float]:
         with self._lock:
             return self._rtt
+
+    def bandwidth_Bps(self) -> Optional[float]:
+        """EWMA load bandwidth (bytes/second), None before the first
+        sized load.  Pairs with :meth:`rtt_s` to price a request:
+        ``rtt * bandwidth`` is the bytes one round trip is worth — the
+        ``max_gap_bytes`` auto-tune's input."""
+        with self._lock:
+            return self._bw
 
     def cap(self) -> int:
         """The current effective budget cap."""
@@ -409,7 +434,11 @@ def compute_page_covers(reader, predicate, keep: Optional[Set[int]],
         idx.extend(index_ranges(reader.row_groups[gi]))
     load = getattr(reader.source, "load", None)
     if idx and load is not None:
-        load(coalesce(idx, sc.max_gap_bytes, sc.max_extent_bytes))
+        gap = (
+            sc.max_gap_bytes if sc.max_gap_bytes is not None
+            else DEFAULT_MAX_GAP_BYTES
+        )
+        load(coalesce(idx, gap, sc.max_extent_bytes))
     covered_by_group: dict = {}
     for gi in sorted(keep):
         rg = reader.row_groups[gi]
@@ -553,6 +582,7 @@ class DatasetScanner:
         )
         if self._adaptive is not None:
             self._budget.set_cap(self._adaptive.cap())
+        self._gap_logged: Optional[int] = None  # last auto-tuned gap
         self._pool = ThreadPoolExecutor(
             max_workers=self._scan.threads, thread_name_prefix="pftpu-scan"
         )
@@ -602,6 +632,38 @@ class DatasetScanner:
 
     # -- file planning (consumer thread) -----------------------------------
 
+    def _effective_scan(self) -> ScanOptions:
+        """The ScanOptions this file open plans under.  With
+        ``max_gap_bytes=None`` the coalescing gap auto-tunes to the
+        measured RTT x bandwidth — the bytes one round trip is worth,
+        so merging across any cheaper gap always wins — clamped to
+        ``[DEFAULT_MAX_GAP_BYTES, max_extent_bytes]``.  Before the
+        adaptive controller has measurements (first file of a scan, or
+        ``adaptive_prefetch`` off) the default applies; a local chain's
+        tiny RTT x bandwidth clamps to the same floor, so only a
+        genuinely slow store widens the gap.  Each NEW resolved value
+        records a ``scan.max_gap_autotuned`` decision."""
+        sc = self._scan
+        if sc.max_gap_bytes is not None:
+            return sc
+        gap = DEFAULT_MAX_GAP_BYTES
+        rtt = bw = None
+        if self._adaptive is not None:
+            rtt = self._adaptive.rtt_s()
+            bw = self._adaptive.bandwidth_Bps()
+            if rtt is not None and bw is not None:
+                gap = int(min(sc.max_extent_bytes,
+                              max(DEFAULT_MAX_GAP_BYTES, rtt * bw)))
+        if gap != self._gap_logged:
+            self._gap_logged = gap
+            trace.decision("scan.max_gap_autotuned", {
+                "gap_bytes": gap,
+                "rtt_ms": None if rtt is None else round(rtt * 1e3, 3),
+                "bandwidth_MBps": None if bw is None
+                else round(bw / 1e6, 2),
+            })
+        return replace(sc, max_gap_bytes=gap)
+
     def _open_file(self, fi: int) -> _FileState:
         opts = self._options
         cache = _source_chain(self._sources[fi], opts)
@@ -647,12 +709,13 @@ class DatasetScanner:
                 if self._predicate is not None
                 else None
             )
-            covered_by_group = self._page_covers(reader, keep)
+            sc = self._effective_scan()
+            covered_by_group = self._page_covers(reader, keep, sc)
             plan = plan_file(
                 reader,
                 self._decode_filter if self._mask_compact
                 else self._filter,
-                keep, self._scan, covered_by_group,
+                keep, sc, covered_by_group,
             )
             # page-index extents: tiny, footer-adjacent, shared by every
             # group (page_cover/predicates) — prefetch once per file
@@ -679,12 +742,14 @@ class DatasetScanner:
             self._close_file(fi)
         return state
 
-    def _page_covers(self, reader, keep: Optional[Set[int]]):
+    def _page_covers(self, reader, keep: Optional[Set[int]],
+                     sc: Optional[ScanOptions] = None):
         if self._predicate is None or not self._scan.page_prune \
                 or self._salvage:
             return None
         return compute_page_covers(
-            reader, self._predicate, keep, self._filter, self._scan
+            reader, self._predicate, keep, self._filter,
+            sc if sc is not None else self._scan,
         )
 
     def _close_file(self, fi: int) -> None:

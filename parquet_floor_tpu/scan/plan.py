@@ -29,6 +29,11 @@ from typing import List, Optional, Sequence, Set, Tuple
 
 from ..utils import trace
 
+#: What ``max_gap_bytes=None`` resolves to wherever no RTT/bandwidth
+#: measurement is available (local chains, the planner called directly,
+#: the first loads of a remote scan before the controller warms up).
+DEFAULT_MAX_GAP_BYTES = 64 << 10
+
 
 @dataclass(frozen=True)
 class ScanOptions:
@@ -36,6 +41,13 @@ class ScanOptions:
 
     * ``max_gap_bytes`` — ranges separated by at most this many bytes
       merge into one read extent.  0 still merges *touching* ranges.
+      ``None`` = auto-tune: under ``adaptive_prefetch`` the executor
+      derives the gap from the measured RTT x bandwidth (the bytes one
+      round trip is worth — reading them as filler is free compared to
+      paying another request), recorded as a
+      ``scan.max_gap_autotuned`` decision; until measurements exist
+      (and anywhere the executor is not involved) ``None`` behaves as
+      :data:`DEFAULT_MAX_GAP_BYTES`.
     * ``max_extent_bytes`` — soft cap on one extent; a single range
       bigger than the cap stays one extent (it cannot be split without
       re-splitting the read), but no merge grows past it.
@@ -81,7 +93,7 @@ class ScanOptions:
       ``scan.scan_aggregate`` (docs/pushdown.md).
     """
 
-    max_gap_bytes: int = 64 << 10
+    max_gap_bytes: Optional[int] = DEFAULT_MAX_GAP_BYTES
     max_extent_bytes: int = 8 << 20
     prefetch_bytes: int = 64 << 20
     threads: int = 4
@@ -99,7 +111,7 @@ class ScanOptions:
                     "ScanOptions.aggregate must be a "
                     "batch.aggregate.Aggregate"
                 )
-        if self.max_gap_bytes < 0:
+        if self.max_gap_bytes is not None and self.max_gap_bytes < 0:
             raise ValueError(f"max_gap_bytes must be >= 0, got {self.max_gap_bytes}")
         if self.max_extent_bytes <= 0:
             raise ValueError(
@@ -289,6 +301,13 @@ def plan_file(reader, column_filter: Optional[Set[str]] = None,
     decision.
     """
     opts = options or ScanOptions()
+    # None = auto-tune, which the EXECUTOR resolves (it owns the RTT
+    # measurements) by handing plan_file an already-resolved options
+    # object; a direct caller just gets the default
+    gap = (
+        opts.max_gap_bytes if opts.max_gap_bytes is not None
+        else DEFAULT_MAX_GAP_BYTES
+    )
     plan = FilePlan()
     idx_ranges: List[Tuple[int, int]] = []
     for gi, rg in enumerate(reader.row_groups):
@@ -302,7 +321,7 @@ def plan_file(reader, column_filter: Optional[Set[str]] = None,
             trace.count("scan.pages_pruned", pruned)
         else:
             ranges = chunk_ranges(rg, column_filter)
-        extents = coalesce(ranges, opts.max_gap_bytes, opts.max_extent_bytes)
+        extents = coalesce(ranges, gap, opts.max_extent_bytes)
         gp = GroupPlan(
             group_index=gi,
             extents=extents,
@@ -328,7 +347,7 @@ def plan_file(reader, column_filter: Optional[Set[str]] = None,
         trace.count("scan.bytes_used", gp.used_bytes)
         trace.count("scan.overread_bytes", gp.read_bytes - gp.used_bytes)
     plan.index_extents = coalesce(
-        idx_ranges, opts.max_gap_bytes, opts.max_extent_bytes
+        idx_ranges, gap, opts.max_extent_bytes
     )
     trace.count("scan.ranges_planned", len(idx_ranges))
     trace.count("scan.extents_planned", len(plan.index_extents))

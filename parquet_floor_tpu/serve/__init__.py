@@ -24,11 +24,24 @@ stack (``docs/serving.md``):
   caching (``serve.lookup``);
 * :class:`ServeDaemon` / :class:`DaemonClient` — the socket front
   door: per-connection tenant attribution, admission control,
-  graceful drain, multi-worker metrics fold (``serve.daemon``).
+  graceful drain, multi-worker metrics fold (``serve.daemon``);
+* :class:`FleetCache` / :class:`FleetMembership` / :class:`PeerClient`
+  / :class:`TenantRateLimiter` — the CROSS-HOST tier: consistent-hash
+  range ownership over an epoch-numbered membership, peer-to-peer
+  range fetch with per-peer breakers and origin fallback, hot-range
+  replication, epoch fencing, and token-bucket admission limiting
+  (``serve.fleet``).
 """
 
 from .cache import CachedSource, SharedBufferCache, source_key
 from .daemon import DaemonClient, ServeDaemon
+from .fleet import (
+    FleetCache,
+    FleetMembership,
+    PeerClient,
+    TenantRateLimiter,
+    TokenBucket,
+)
 from .lookup import Dataset, RangeCursor
 from .shm_cache import ShmCacheTier
 from .slo import SloMonitor, SloStatus, SloTarget
@@ -38,6 +51,9 @@ __all__ = [
     "CachedSource",
     "DaemonClient",
     "Dataset",
+    "FleetCache",
+    "FleetMembership",
+    "PeerClient",
     "RangeCursor",
     "ServeDaemon",
     "Serving",
@@ -47,5 +63,7 @@ __all__ = [
     "SloStatus",
     "SloTarget",
     "Tenant",
+    "TenantRateLimiter",
+    "TokenBucket",
     "source_key",
 ]

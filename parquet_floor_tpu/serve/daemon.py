@@ -44,17 +44,32 @@ op              request fields → reply fields (all replies carry ``ok``)
 ``metrics``     → ``metrics`` (the folded multi-worker snapshot)
 ``health``      → ``health`` (the one-page ``Serving.health`` text)
 ``ping``        → (empty)
+``fleet_epoch`` → ``epoch``, ``node`` (fleet-mounted daemons only)
+``fleet_fetch`` ``key``, ``offset``, ``length``, ``epoch`` →
+                ``data`` (base64) — a peer's range fetch; refused with
+                ``stale_epoch`` when the membership epochs disagree
+``fleet_put``   ``key``, ``offset``, ``data`` (base64), ``epoch``,
+                ``pinned?`` → (empty) — a peer's replication push
 ==============  ========================================================
 
+Fleet ops are protocol-plane like ``ping`` — no ``hello`` required
+(the peer is a daemon, not a tenant) — but their EXECUTION runs on the
+same bounded pool and counts against ``max_pending``, so a drain waits
+out in-flight peer fetches and overload pushback applies to peers too.
+
 Errors come back as ``{"ok": false, "error": ..., "code": ...}`` with
-``code`` one of ``overloaded`` / ``draining`` / ``hello_required`` /
-``bad_request``; the connection stays usable after any of them.
+``code`` one of ``overloaded`` / ``rate_limited`` / ``draining`` /
+``hello_required`` / ``bad_request`` / ``stale_epoch``; the connection
+stays usable after any of them.  ``rate_limited`` (per-tenant token
+bucket, ``rate_limiter=``) carries ``retry_after_ms`` and is checked
+BEFORE admission, so an over-rate tenant never occupies a pending slot.
 Docs: ``docs/serving.md``.
 """
 
 from __future__ import annotations
 
 import asyncio
+import base64
 import json
 import os
 import socket
@@ -66,6 +81,12 @@ from typing import Dict, Optional
 from ..utils import trace
 from .lookup import Dataset
 from .tenancy import Serving
+
+
+# one request/reply line may carry a base64 range payload (a peer's
+# fleet_put replication push) — asyncio's default 64 KiB readline
+# limit would sever the connection for any extent past ~48 KiB
+_WIRE_LINE_LIMIT = 32 << 20
 
 
 def _encode(obj: dict) -> bytes:
@@ -94,7 +115,8 @@ class ServeDaemon:
                  host: str = "127.0.0.1", port: int = 0,
                  max_inflight: int = 4, max_pending: int = 64,
                  metrics_dir: Optional[str] = None,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0,
+                 fleet=None, rate_limiter=None):
         if max_inflight <= 0:
             raise ValueError(f"max_inflight must be > 0, got {max_inflight}")
         if max_pending < max_inflight:
@@ -110,6 +132,11 @@ class ServeDaemon:
         self.max_pending = int(max_pending)
         self.metrics_dir = metrics_dir
         self.drain_timeout_s = float(drain_timeout_s)
+        #: optional FleetCache (serve/fleet.py) — enables the
+        #: fleet_epoch / fleet_fetch / fleet_put peer ops
+        self.fleet = fleet
+        #: optional TenantRateLimiter — consulted before admission
+        self.rate_limiter = rate_limiter
         #: daemon-plane counters (connections, rejections, request
         #: totals) — tenant-attributed metrics ride the tenants' own
         #: tracers like everywhere else in serve/
@@ -158,7 +185,8 @@ class ServeDaemon:
         self._loop = loop
         try:
             self._server = loop.run_until_complete(
-                asyncio.start_server(self._handle, self.host, self.port)
+                asyncio.start_server(self._handle, self.host, self.port,
+                                     limit=_WIRE_LINE_LIMIT)
             )
             self.port = self._server.sockets[0].getsockname()[1]
         except BaseException as e:
@@ -306,6 +334,11 @@ class ServeDaemon:
                     line = await reader.readline()
                 except (ConnectionError, asyncio.IncompleteReadError):
                     break
+                except ValueError:
+                    # a line past _WIRE_LINE_LIMIT: sever rather than
+                    # buffer without bound (asyncio LimitOverrunError
+                    # surfaces as ValueError from readline)
+                    break
                 if not line:
                     break
                 try:
@@ -322,6 +355,11 @@ class ServeDaemon:
                     tenant, reply = self._hello(req)
                 elif op == "ping":
                     reply = {"ok": True}
+                elif op in ("fleet_epoch", "fleet_fetch", "fleet_put"):
+                    # peer-plane: a fleet peer is a daemon, not a
+                    # tenant — no hello, but execution is bounded and
+                    # drain-visible (see _fleet_dispatch)
+                    reply = await self._fleet_dispatch(req, op)
                 elif tenant is None:
                     reply = {
                         "ok": False, "code": "hello_required",
@@ -365,6 +403,66 @@ class ServeDaemon:
             }
         return tenant, {"ok": True, "tenant": name, "weight": weight}
 
+    async def _fleet_dispatch(self, req: dict, op: str) -> dict:
+        """A peer's fleet op.  ``fleet_epoch`` is a liveness probe and
+        always answers; fetch/put run on the worker pool COUNTED in
+        ``_pending`` — so ``drain()`` waits out an in-flight peer
+        fetch, and ``max_pending`` pushback tells an overloaded
+        neighbor to go to origin instead of queueing here."""
+        if self.fleet is None:
+            return {"ok": False, "code": "bad_request",
+                    "error": "daemon has no fleet mount"}
+        if op == "fleet_epoch":
+            return {"ok": True, "epoch": self.fleet.epoch,
+                    "node": self.fleet.node_id}
+        if self._draining:
+            return {"ok": False, "code": "draining",
+                    "error": "daemon is draining"}
+        if self._pending >= self.max_pending:
+            with trace.using(self.tracer):
+                trace.count("serve.daemon_rejected")
+            return {
+                "ok": False, "code": "overloaded",
+                "error": "daemon at max_pending",
+                "retry_after_ms": 20 * self.max_pending,
+            }
+        self._pending += 1
+        with trace.using(self.tracer):
+            trace.count("serve.daemon_requests")
+            trace.gauge_max("serve.daemon_inflight_max", self._pending)
+        try:
+            return await self._loop.run_in_executor(
+                self._pool, self._fleet_execute, req, op
+            )
+        except Exception as e:
+            return {"ok": False, "code": "bad_request",
+                    "error": f"{type(e).__name__}: {e}"}
+        finally:
+            self._pending -= 1
+
+    def _fleet_execute(self, req: dict, op: str) -> dict:
+        with trace.using(self.tracer):
+            key = tuple(req["key"])
+            epoch = int(req.get("epoch", -1))
+            if op == "fleet_fetch":
+                status, data = self.fleet.serve_range(
+                    key, int(req["offset"]), int(req["length"]), epoch)
+                if status != "ok":
+                    return {"ok": False, "code": status,
+                            "error": f"fleet fetch: {status}",
+                            "epoch": self.fleet.epoch}
+                return {"ok": True, "data": base64.b64encode(
+                    data).decode("ascii")}
+            status = self.fleet.put_remote(
+                key, int(req["offset"]),
+                base64.b64decode(req["data"]), epoch,
+                pinned=bool(req.get("pinned", False)))
+            if status != "ok":
+                return {"ok": False, "code": status,
+                        "error": f"fleet put: {status}",
+                        "epoch": self.fleet.epoch}
+            return {"ok": True}
+
     async def _dispatch(self, tenant, req: dict, op: str) -> dict:
         if op in ("metrics", "health"):
             # protocol-plane ops: cheap, never queued behind probes
@@ -378,6 +476,19 @@ class ServeDaemon:
         if op not in ("lookup", "range", "range_page"):
             return {"ok": False, "code": "bad_request",
                     "error": f"unknown op {op!r}"}
+        # per-tenant rate limit, BEFORE admission: an over-rate tenant
+        # is told when to come back without ever occupying a pending
+        # slot (or burning a downstream breaker's failure budget)
+        if self.rate_limiter is not None:
+            retry_s = self.rate_limiter.admit(tenant.name)
+            if retry_s is not None:
+                with trace.using(tenant.tracer):
+                    trace.count("serve.ratelimit_rejected")
+                return {
+                    "ok": False, "code": "rate_limited",
+                    "error": f"tenant {tenant.name!r} over rate",
+                    "retry_after_ms": max(1, int(retry_s * 1000)),
+                }
         # admission: pending (queued + in-flight) is bounded — beyond
         # it the daemon pushes back NOW instead of queueing into a
         # latency cliff.  _pending mutates only on the loop thread.

@@ -1,0 +1,660 @@
+"""Cross-host fleet cache fabric: k serving daemons as ONE survivable
+cache tier.
+
+One host's :class:`~parquet_floor_tpu.serve.shm_cache.ShmCacheTier`
+stops at the host boundary: a fleet of k hosts issues k origin reads
+per unique range and has no story for a host dying mid-request.  This
+module adds the cross-host layer (docs/serving.md):
+
+* **Ownership** — :class:`FleetMembership` assigns every unique range
+  an owner by rendezvous (highest-random-weight) hashing over an
+  explicit, epoch-numbered member list.  Rendezvous hashing keeps
+  reassignment minimal on membership change (only the lost member's
+  ranges move) with no ring state to persist.
+* **Peer leg** — :class:`FleetCache` presents the exact read-through
+  face ``SharedBufferCache`` mounts via ``shm=``; a non-owner fetches a
+  missed range from its owner over :class:`PeerClient` instead of
+  re-reading origin, so the fleet issues ~one origin read per unique
+  range.
+* **Failure domain** — every peer gets its own
+  :class:`~parquet_floor_tpu.io.remote.CircuitBreaker`; a peer fetch
+  has a hard timeout and ONE retry, then the next candidate (the
+  replica), then *origin*.  A dead or slow owner therefore degrades to
+  a cache miss — latency, never an error.
+* **Replication** — ranges an owner serves repeatedly are pushed to
+  the next-on-ring member, so losing a host loses capacity, not data.
+* **Fencing** — every peer request carries the requester's membership
+  epoch; a responder on a different epoch refuses with
+  ``stale_epoch`` instead of answering from a stale ownership map.
+* **Admission** — :class:`TenantRateLimiter` (token buckets) rejects
+  over-rate tenants at the daemon door with ``retry_after_ms`` BEFORE
+  they queue into the ``max_pending`` cliff or burn a breaker budget.
+
+``scripts/fleet_smoke.py`` and bench.py's fleet leg drive a 3-daemon
+topology through a mid-load host loss and assert exactly-once origin
+reads and zero wrong answers (``check_bench_report.check_fleet_leg``).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socket
+import struct
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..errors import BreakerOpenError
+from ..io.remote import CircuitBreaker
+from ..utils import trace
+from .shm_cache import _digest
+
+
+@dataclass(frozen=True)
+class FleetMembership:
+    """An explicit, epoch-numbered fleet member list.  Immutable: every
+    change is a NEW membership with a higher epoch, and the epoch rides
+    every peer request so two hosts can never trade bytes across
+    disagreeing ownership maps (the fencing rule)."""
+
+    epoch: int
+    members: Tuple[str, ...]
+
+    @classmethod
+    def create(cls, members: Sequence[str],
+               epoch: int = 1) -> "FleetMembership":
+        members = tuple(sorted(set(members)))
+        if not members:
+            raise ValueError("fleet membership needs at least one member")
+        return cls(epoch=int(epoch), members=members)
+
+    def owners(self, d0: int, d1: int, replicas: int = 2) -> List[str]:
+        """The range's owner chain — rendezvous-hash scores, best
+        first.  ``[0]`` is the owner, ``[1]`` the replica target; a
+        membership change moves only the ranges whose winner left."""
+        packed = struct.pack("<QQ", d0 & _U64, d1 & _U64)
+        scored = sorted(
+            self.members,
+            key=lambda m: hashlib.blake2b(
+                m.encode("utf-8") + packed, digest_size=8).digest(),
+            reverse=True,
+        )
+        return scored[:max(1, int(replicas))]
+
+    def without(self, member: str) -> "FleetMembership":
+        remaining = tuple(m for m in self.members if m != member)
+        if not remaining:
+            raise ValueError("cannot remove the last fleet member")
+        return FleetMembership(epoch=self.epoch + 1, members=remaining)
+
+    def with_member(self, member: str) -> "FleetMembership":
+        return FleetMembership(
+            epoch=self.epoch + 1,
+            members=tuple(sorted(set(self.members) | {member})),
+        )
+
+
+_U64 = (1 << 64) - 1
+
+
+class TokenBucket:
+    """One token bucket: ``rate_per_s`` sustained, ``burst`` capacity.
+    ``try_acquire`` never sleeps — it admits, or returns how long the
+    caller should wait (the reject-don't-queue admission contract)."""
+
+    def __init__(self, rate_per_s: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        if rate_per_s <= 0 or burst <= 0:
+            raise ValueError("rate_per_s and burst must be positive")
+        self.rate = float(rate_per_s)
+        self.burst = float(burst)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = float(burst)
+        self._stamp = clock()
+
+    def try_acquire(self, n: float = 1.0) -> Optional[float]:
+        """None = admitted (n tokens taken); else seconds until n
+        tokens will have refilled (the ``retry_after`` hint)."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._stamp) * self.rate)
+            self._stamp = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return None
+            return (n - self._tokens) / self.rate
+
+
+class TenantRateLimiter:
+    """Per-tenant token buckets, lazily created at first sight of a
+    tenant.  The daemon consults this at ADMISSION — before the
+    request counts against ``max_pending`` — so an over-rate tenant is
+    told to come back later instead of queueing into the overload
+    cliff or burning a peer breaker's failure budget."""
+
+    def __init__(self, rate_per_s: float, burst: Optional[float] = None,
+                 overrides: Optional[Dict[str, float]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate_per_s)
+        self.burst = float(burst if burst is not None else 2 * rate_per_s)
+        self._overrides = dict(overrides or {})
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, TokenBucket] = {}
+
+    def admit(self, tenant: str, n: float = 1.0) -> Optional[float]:
+        """None = admitted; else the tenant's ``retry_after`` seconds."""
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                rate = self._overrides.get(tenant, self.rate)
+                bucket = TokenBucket(rate, max(self.burst, rate),
+                                     clock=self._clock)
+                self._buckets[tenant] = bucket
+        return bucket.try_acquire(n)
+
+
+def _close_conn(sock, rfile) -> None:
+    if rfile is not None:
+        try:
+            rfile.close()
+        except OSError:
+            pass
+    if sock is not None:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+class PeerClient:
+    """One fleet peer's wire client: newline-delimited JSON over a
+    lazily-(re)connected socket, hello-free (fleet ops are
+    protocol-plane, admitted before tenant attribution).  Thread-safe
+    via connection CHECKOUT — the lock only guards the one-slot cached
+    connection, never the round trip itself (FL-LOCK002), so a slow
+    peer stalls only its own caller; a concurrent request just dials a
+    fresh socket and the surplus one closes on return.  Any transport
+    error drops the connection so the next request reconnects fresh.
+    A live client holds a socket — close it, or the owning
+    :class:`FleetCache`'s ``close()`` does (FL-RES001)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 2.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self._closed = False
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+
+    def _drop_locked(self) -> None:
+        _close_conn(self._sock, self._rfile)
+        self._sock = None
+        self._rfile = None
+
+    def request(self, op: str, **fields) -> dict:
+        """One round-trip; returns the raw reply dict (callers inspect
+        ``ok``/``code`` — a refusal is an answer, not an exception)."""
+        payload = (json.dumps({"op": op, **fields}) + "\n").encode("utf-8")
+        with self._lock:
+            sock, rfile = self._sock, self._rfile
+            self._sock = self._rfile = None  # checked out
+        try:
+            if sock is None:
+                sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.timeout_s)
+                sock.settimeout(self.timeout_s)
+                rfile = sock.makefile("rb")
+            sock.sendall(payload)
+            line = rfile.readline()
+        except (OSError, ValueError):
+            _close_conn(sock, rfile)
+            raise
+        if not line:
+            _close_conn(sock, rfile)
+            raise ConnectionError(
+                f"peer {self.host}:{self.port} closed the connection")
+        with self._lock:
+            if self._closed or self._sock is not None:
+                _close_conn(sock, rfile)  # late or surplus: don't cache
+            else:
+                self._sock, self._rfile = sock, rfile
+        return json.loads(line)
+
+    def epoch(self) -> dict:
+        return self.request("fleet_epoch")
+
+    def fetch(self, key: tuple, offset: int, length: int,
+              epoch: int) -> dict:
+        reply = self.request("fleet_fetch", key=list(key),
+                             offset=int(offset), length=int(length),
+                             epoch=int(epoch))
+        if reply.get("ok") and "data" in reply:
+            reply["data"] = base64.b64decode(reply["data"])
+        return reply
+
+    def put(self, key: tuple, offset: int, data: bytes, epoch: int,
+            pinned: bool = False) -> dict:
+        return self.request(
+            "fleet_put", key=list(key), offset=int(offset),
+            data=base64.b64encode(bytes(data)).decode("ascii"),
+            epoch=int(epoch), pinned=bool(pinned))
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True  # an in-flight checkout closes on return
+            self._drop_locked()
+
+    def __enter__(self) -> "PeerClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _LocalStore:
+    """FleetCache's built-in local range store when no ShmCacheTier is
+    mounted: a byte-budget LRU of exact ranges keyed by digest."""
+
+    def __init__(self, capacity_bytes: int = 64 << 20):
+        self.capacity = int(capacity_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, bytes]" = OrderedDict()
+        self._bytes = 0
+
+    def get(self, dk: tuple) -> Optional[bytes]:
+        with self._lock:
+            data = self._entries.get(dk)
+            if data is not None:
+                self._entries.move_to_end(dk)
+            return data
+
+    def put(self, dk: tuple, data: bytes) -> None:
+        with self._lock:
+            if dk in self._entries:
+                return
+            self._entries[dk] = data
+            self._bytes += len(data)
+            while self._bytes > self.capacity and len(self._entries) > 1:
+                _, old = self._entries.popitem(last=False)
+                self._bytes -= len(old)
+
+
+class FleetCache:
+    """The fleet tier one daemon mounts: local ranges first, then the
+    owning PEER, then origin — behind the exact ``read_through(key,
+    ranges, read_many_fn, pinned)`` face ``SharedBufferCache`` mounts
+    via ``shm=``, so the whole fabric is invisible above L1.
+
+    The peer leg is where the robustness lives: per-peer circuit
+    breakers (reusing io/remote's :class:`CircuitBreaker`), a hard
+    per-fetch timeout with ONE retry, candidate order [owner, replica],
+    and an unconditional origin fallback — no peer failure mode
+    surfaces as an error, only as origin latency.  ``serve_range`` /
+    ``put_remote`` are the daemon-side faces of the same store, fenced
+    by membership epoch.
+
+    Owns its :class:`PeerClient` sockets (``close()`` releases them —
+    FL-RES001); a mounted ``inner`` ShmCacheTier stays caller-owned,
+    matching the ``SharedBufferCache(shm=tier)`` transfer shape.
+    """
+
+    def __init__(self, node_id: str, membership: FleetMembership, *,
+                 peers: Optional[dict] = None, inner=None,
+                 origin: Optional[Callable] = None,
+                 peer_timeout_s: float = 2.0,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 1.0,
+                 replicas: int = 2, replicate_after: int = 2,
+                 local_bytes: int = 64 << 20,
+                 clock: Callable[[], float] = time.monotonic):
+        if node_id not in membership.members:
+            raise ValueError(f"node {node_id!r} not in membership")
+        self.node_id = node_id
+        self.peer_timeout_s = float(peer_timeout_s)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.replicas = max(1, int(replicas))
+        self.replicate_after = int(replicate_after)
+        self._origin = origin
+        self._inner = inner
+        self._store = _LocalStore(local_bytes) if inner is None else None
+        self._clock = clock
+        self._admin_lock = threading.Lock()
+        self._membership = membership
+        self._peers: Dict[str, PeerClient] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._flight_lock = threading.Lock()
+        self._flights: Dict[tuple, threading.Event] = {}
+        self._heat: Dict[tuple, int] = {}
+        self._closed = False
+        self.install_membership(membership, peers or {})
+
+    # -- membership / admin -------------------------------------------------
+
+    @property
+    def membership(self) -> FleetMembership:
+        return self._membership
+
+    @property
+    def epoch(self) -> int:
+        return self._membership.epoch
+
+    def install_membership(self, membership: FleetMembership,
+                           peers: Optional[dict] = None) -> None:
+        """Install a NEW (higher-epoch) ownership map, atomically with
+        its peer endpoints.  ``peers`` maps member id to a PeerClient
+        or a ``(host, port)`` pair; entries for members not in the new
+        membership — and replaced clients — are closed here."""
+        if membership.epoch < self._membership.epoch:
+            raise ValueError(
+                f"membership epoch moved backwards: "
+                f"{membership.epoch} < {self._membership.epoch}")
+        with self._admin_lock:
+            old = self._peers
+            if peers is not None:
+                fresh: Dict[str, PeerClient] = {}
+                for member, endpoint in peers.items():
+                    if member == self.node_id:
+                        continue
+                    if isinstance(endpoint, PeerClient):
+                        fresh[member] = endpoint
+                    else:
+                        host, port = endpoint
+                        fresh[member] = PeerClient(
+                            host, port, timeout_s=self.peer_timeout_s)
+                self._peers = fresh
+                for member, client in old.items():
+                    if self._peers.get(member) is not client:
+                        client.close()
+            self._membership = membership
+        trace.decision("serve.fleet", {
+            "action": "membership", "node": self.node_id,
+            "epoch": membership.epoch,
+            "members": list(membership.members),
+        })
+
+    def _breaker(self, member: str) -> CircuitBreaker:
+        with self._admin_lock:
+            breaker = self._breakers.get(member)
+            if breaker is None:
+                breaker = CircuitBreaker(
+                    threshold=self.breaker_threshold,
+                    cooldown_s=self.breaker_cooldown_s,
+                    name=f"peer:{member}", clock=self._clock)
+                self._breakers[member] = breaker
+            return breaker
+
+    # -- local store --------------------------------------------------------
+
+    def _local_get(self, key: tuple, offset: int, length: int
+                   ) -> Optional[bytes]:
+        if self._inner is not None:
+            data = self._inner.get(key, offset, length)
+            return None if data is None else bytes(data)
+        return self._store.get(_digest(key, offset, length))
+
+    def _local_put(self, key: tuple, offset: int, data: bytes,
+                   pinned: bool = False) -> None:
+        if self._inner is not None:
+            self._inner.put(key, offset, data, pinned=pinned)
+        else:
+            self._store.put(_digest(key, offset, len(data)), bytes(data))
+
+    def _origin_read(self, key: tuple, ranges: List[Tuple[int, int]],
+                     read_many_fn, pinned: bool) -> List[bytes]:
+        """Read ``ranges`` through the local single-flight layer to the
+        origin leg — the path of last resort every failure mode above
+        degrades into."""
+        trace.count("serve.fleet_origin_reads", len(ranges))
+        if self._inner is not None:
+            return [bytes(b) for b in self._inner.read_through(
+                key, ranges, read_many_fn, pinned=pinned)]
+        return self._store_read_through(key, ranges, read_many_fn)
+
+    def _store_read_through(self, key: tuple,
+                            ranges: List[Tuple[int, int]],
+                            read_many_fn) -> List[bytes]:
+        out: List[Optional[bytes]] = [None] * len(ranges)
+        leads, waits = [], []
+        with self._flight_lock:
+            for i, (o, n) in enumerate(ranges):
+                dk = _digest(key, o, n)
+                data = self._store.get(dk)
+                if data is not None:
+                    out[i] = data
+                    continue
+                ev = self._flights.get(dk)
+                if ev is None:
+                    ev = threading.Event()
+                    self._flights[dk] = ev
+                    leads.append((i, o, n, dk, ev))
+                else:
+                    waits.append((i, o, n, dk, ev))
+        if leads:
+            try:
+                bufs = read_many_fn([(o, n) for (_, o, n, _, _) in leads])
+            except BaseException:
+                # wake the waiters; they re-read for themselves below
+                with self._flight_lock:
+                    for (_, _, _, dk, ev) in leads:
+                        self._flights.pop(dk, None)
+                        ev.set()
+                raise
+            for (i, o, n, dk, ev), data in zip(leads, bufs):
+                data = bytes(data)
+                self._store.put(dk, data)
+                out[i] = data
+                with self._flight_lock:
+                    self._flights.pop(dk, None)
+                ev.set()
+        for (i, o, n, dk, ev) in waits:
+            ev.wait(timeout=30.0)
+            data = self._store.get(dk)
+            if data is None:
+                data = bytes(read_many_fn([(o, n)])[0])
+                self._store.put(dk, data)
+            out[i] = data
+        return out  # type: ignore[return-value]
+
+    # -- the read-through face (mounted under SharedBufferCache) ------------
+
+    def read_through(self, key: tuple, ranges: Sequence[Tuple[int, int]],
+                     read_many_fn, pinned: bool = False) -> List[bytes]:
+        """The ``shm=`` mount face: local hits, then the owning peer
+        for non-primary misses (timeout + one retry + breaker, replica
+        next, origin last), then one vectored origin read for
+        primary-owned misses and every fallback.  Only the PRIMARY
+        reads origin for a miss here — a replica peer-fetches the
+        primary like any non-owner, which is what keeps the fleet at
+        ~one origin read per unique range (its local copy arrives via
+        the fetch, or the primary's replication push).  Every range is
+        answered; no peer state can make this raise for a reachable
+        origin."""
+        ranges = [(int(o), int(n)) for (o, n) in ranges]
+        out: List[Optional[bytes]] = [None] * len(ranges)
+        membership = self._membership
+        owned, remote = [], []
+        for i, (o, n) in enumerate(ranges):
+            data = self._local_get(key, o, n)
+            if data is not None:
+                out[i] = data
+                continue
+            dk = _digest(key, o, n)
+            owners = membership.owners(dk[0], dk[1], self.replicas)
+            if owners[0] == self.node_id:
+                owned.append((i, o, n, dk, owners))
+            else:
+                remote.append((i, o, n, owners))
+        fallback = []
+        for (i, o, n, owners) in remote:
+            data = self._peer_fetch(key, o, n, owners, membership.epoch)
+            if data is None:
+                trace.count("serve.fleet_peer_fallbacks")
+                fallback.append((i, o, n))
+            else:
+                out[i] = data
+                self._local_put(key, o, data, pinned)
+        need = [(i, o, n) for (i, o, n, _, _) in owned] + fallback
+        if need:
+            bufs = self._origin_read(
+                key, [(o, n) for (_, o, n) in need], read_many_fn, pinned)
+            for (i, o, n), data in zip(need, bufs):
+                out[i] = data
+        for (i, o, n, dk, owners) in owned:
+            self._maybe_replicate(key, o, out[i], dk, owners,
+                                  membership.epoch)
+        trace.count("serve.fleet_served", len(ranges))
+        return out  # type: ignore[return-value]
+
+    # -- the peer leg -------------------------------------------------------
+
+    def _peer_fetch(self, key: tuple, offset: int, length: int,
+                    owners: List[str], epoch: int) -> Optional[bytes]:
+        """Bytes from the owner (or its replica), or None → the caller
+        falls back to origin.  Per candidate: breaker admission, one
+        attempt, ONE retry on a transport failure, then the next
+        candidate.  A refusal (miss / draining / overload / stale
+        epoch) is an answer — it bypasses the breaker's failure count
+        and moves on without a retry."""
+        for member in owners:
+            if member == self.node_id:
+                continue
+            with self._admin_lock:
+                peer = self._peers.get(member)
+            if peer is None:
+                continue
+            breaker = self._breaker(member)
+            try:
+                breaker.check()
+            except BreakerOpenError:
+                continue
+            t0 = self._clock()
+            reply = None
+            for attempt in (0, 1):
+                trace.count("serve.fleet_peer_fetches")
+                try:
+                    reply = peer.fetch(key, offset, length, epoch)
+                    break
+                except (OSError, ValueError):
+                    trace.count("serve.fleet_peer_errors")
+                    breaker.on_failure()
+                    reply = None
+            if reply is None:
+                trace.decision("serve.fleet", {
+                    "action": "peer_failed", "node": self.node_id,
+                    "peer": member, "offset": offset, "length": length,
+                })
+                continue
+            if reply.get("ok") and reply.get("data") is not None:
+                breaker.on_success()
+                data = reply["data"]
+                trace.count("serve.fleet_peer_hits")
+                trace.count("serve.fleet_peer_hit_bytes", len(data))
+                trace.observe("serve.fleet_peer_wait_seconds",
+                              self._clock() - t0)
+                return data
+            code = reply.get("code")
+            if code == "stale_epoch":
+                trace.count("serve.fleet_epoch_fenced")
+                trace.decision("serve.fleet", {
+                    "action": "fence", "node": self.node_id,
+                    "peer": member, "ours": epoch,
+                    "theirs": reply.get("epoch"),
+                })
+            breaker.on_bypass()
+        return None
+
+    def _maybe_replicate(self, key: tuple, offset: int,
+                         data: Optional[bytes], dk: tuple,
+                         owners: List[str], epoch: int) -> None:
+        """Push a range this PRIMARY keeps serving to the next-on-ring
+        member (best-effort: breaker-guarded, never retried, never an
+        error) so losing this host loses capacity, not the range."""
+        if data is None or len(owners) < 2 or owners[0] != self.node_id:
+            return
+        with self._admin_lock:
+            heat = self._heat.get(dk, 0) + 1
+            self._heat[dk] = heat
+            if len(self._heat) > 65536:
+                self._heat.clear()  # bounded memory; heat re-learns
+            peer = self._peers.get(owners[1])
+        if heat != self.replicate_after or peer is None:
+            return
+        breaker = self._breaker(owners[1])
+        try:
+            breaker.check()
+        except BreakerOpenError:
+            return
+        try:
+            reply = peer.put(key, offset, data, epoch)
+        except (OSError, ValueError):
+            breaker.on_failure()
+            return
+        breaker.on_bypass()
+        if reply.get("ok"):
+            trace.count("serve.fleet_replications")
+
+    # -- the daemon-side faces (fleet_fetch / fleet_put ops) ----------------
+
+    def serve_range(self, key: tuple, offset: int, length: int,
+                    epoch: int) -> Tuple[str, Optional[bytes]]:
+        """Answer a peer's fetch: ``("ok", bytes)``, ``("miss", None)``
+        (not here and no origin configured — the asker falls back), or
+        ``("stale_epoch", None)`` when the epochs disagree (NEITHER a
+        stale owner nor a stale asker may trade bytes).  Unlike
+        :meth:`read_through`, a REPLICA reads origin here too: when the
+        primary is gone the asker's second candidate still costs the
+        fleet one origin read, not one per surviving host."""
+        key = tuple(key)
+        membership = self._membership
+        if int(epoch) != membership.epoch:
+            trace.count("serve.fleet_epoch_fenced")
+            return "stale_epoch", None
+        data = self._local_get(key, offset, length)
+        dk = _digest(key, offset, length)
+        owners = membership.owners(dk[0], dk[1], self.replicas)
+        if data is None and self.node_id in owners and \
+                self._origin is not None:
+            origin = self._origin
+            data = self._origin_read(
+                key, [(int(offset), int(length))],
+                lambda rs: origin(key, rs), False)[0]
+        if data is None:
+            return "miss", None
+        self._maybe_replicate(key, offset, data, dk, owners,
+                              membership.epoch)
+        return "ok", data
+
+    def put_remote(self, key: tuple, offset: int, data: bytes,
+                   epoch: int, pinned: bool = False) -> str:
+        """A peer's replication push; fenced like every fleet op."""
+        if int(epoch) != self._membership.epoch:
+            trace.count("serve.fleet_epoch_fenced")
+            return "stale_epoch"
+        self._local_put(tuple(key), int(offset), bytes(data), pinned)
+        return "ok"
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with self._admin_lock:
+            peers, self._peers = self._peers, {}
+        for client in peers.values():
+            client.close()
+
+    def __enter__(self) -> "FleetCache":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -11,7 +11,11 @@ worker's in-process cache:
   (the pinned-metadata law: footer/page-index/bloom/dictionary bytes
   have their own budget, so data churn never evicts them) carved out of
   one ``SharedMemory`` segment, each a log-structured ring heap whose
-  eviction is counted, never silent;
+  eviction is counted, never silent.  Eviction is SECOND-CHANCE
+  (LRU-grade): lookups stamp the slot, and the eviction pass rescues a
+  stamped tail record to the ring's head (stamp cleared,
+  ``serve.shm_rescues``) instead of dropping it, so a hot range
+  survives a churn of cold inserts;
 * **exact-range keying** — entries are keyed by a 128-bit digest of
   ``(file key, offset, length)``.  Every worker runs the same planner,
   so identical requests dedupe across processes; *containment* lookups
@@ -63,7 +67,7 @@ from typing import List, Optional, Sequence, Tuple
 from ..utils import trace
 
 _MAGIC = b"PFTPUSH1"
-_VERSION = 1
+_VERSION = 2   # v2: slot access stamps + second-chance eviction
 
 # header field layout (struct offsets into the segment)
 _H_MAGIC = 0           # 8s
@@ -77,6 +81,7 @@ _H_STATS = 72          # _N_STATS x <Q
 _STAT_NAMES = (
     "hits", "misses", "hit_bytes", "miss_bytes",
     "evictions", "meta_evictions", "singleflight_waits", "takeovers",
+    "rescues",
 )
 _N_STATS = len(_STAT_NAMES)
 _HEADER_BYTES = 256
@@ -260,8 +265,16 @@ class ShmCacheTier:
             return self._meta_off, self.meta_bytes
         return self._data_off, self.data_bytes
 
-    def _evict_tail(self, ring: int, st: list) -> None:
-        """Retire the record at the ring's tail (and its slot)."""
+    def _evict_tail(self, ring: int, st: list, rescue: bool = True) -> None:
+        """Retire the record at the ring's tail (and its slot) — with a
+        SECOND CHANCE: a tail record whose slot carries an access stamp
+        (``pad`` set by :meth:`_lookup_locked` since insertion) is
+        rescued to the ring's head with the stamp cleared instead of
+        evicted, so a hot range survives a churn of cold inserts
+        (LRU-grade behavior on a log-structured ring).  Termination:
+        the stamp is cleared on rescue under the held lock, so each
+        live record is rescued at most once per eviction pass before
+        the eviction is real."""
         base, cap = self._heap_span(ring)
         hi, ti = (0, 1) if ring == _RING_DATA else (2, 3)
         tail = st[ti]
@@ -282,6 +295,16 @@ class ShmCacheTier:
         if slot_idx != _SKIP_SLOT and slot_idx < self.slot_count:
             s = self._slots[slot_idx]
             if int(s["ring"]) == ring and int(s["off"]) == tail + 8:
+                if rescue and int(s["pad"]) != 0:
+                    data = bytes(self._shm.buf[pos + 8:pos + 8
+                                               + int(s["len"])])
+                    d0, d1 = int(s["d0"]), int(s["d1"])
+                    self._slots[slot_idx]["ring"] = 0
+                    st[ti] = tail + rec_len
+                    self._reinsert_head(ring, st, slot_idx, d0, d1, data)
+                    self._bump("rescues")
+                    trace.count("serve.shm_rescues")
+                    return
                 self._slots[slot_idx]["ring"] = 0
                 if ring == _RING_META:
                     self._bump("meta_evictions")
@@ -290,6 +313,36 @@ class ShmCacheTier:
                     self._bump("evictions")
                     trace.count("serve.shm_evictions")
         st[ti] = tail + rec_len
+
+    def _reinsert_head(self, ring: int, st: list, slot: int, d0: int,
+                       d1: int, data: bytes) -> None:
+        """Re-install a rescued record at the ring's head, stamp
+        cleared, reusing the slot its rescue just freed.  Space is made
+        with NO further rescues (``rescue=False``), so a rescue can
+        never recurse into another rescue."""
+        base, cap = self._heap_span(ring)
+        hi, ti = (0, 1) if ring == _RING_DATA else (2, 3)
+        need = 8 + _ceil8(len(data))
+        rem = cap - (st[hi] % cap)
+        if rem < need:
+            while (st[hi] + rem) - st[ti] > cap:
+                self._evict_tail(ring, st, rescue=False)
+            pos = base + (st[hi] % cap)
+            struct.pack_into("<II", self._shm.buf, pos, rem, _SKIP_SLOT)
+            st[hi] += rem
+        while (st[hi] + need) - st[ti] > cap:
+            self._evict_tail(ring, st, rescue=False)
+        pos = base + (st[hi] % cap)
+        struct.pack_into("<II", self._shm.buf, pos, need, slot)
+        self._shm.buf[pos + 8:pos + 8 + len(data)] = data
+        rec = self._slots[slot]
+        rec["d0"] = d0
+        rec["d1"] = d1
+        rec["ring"] = ring
+        rec["pad"] = 0
+        rec["off"] = st[hi] + 8
+        rec["len"] = len(data)
+        st[hi] += need
 
     def _free_slot(self, st: list) -> Optional[int]:
         import numpy as np
@@ -338,6 +391,8 @@ class ShmCacheTier:
         rec["d0"] = d0
         rec["d1"] = d1
         rec["ring"] = ring
+        rec["pad"] = 0   # fresh entries start unstamped (one full lap
+        #                  of cold churn evicts an entry never re-read)
         rec["off"] = st[hi] + 8
         rec["len"] = len(data)
         st[hi] += need
@@ -353,6 +408,10 @@ class ShmCacheTier:
         if not hit.size:
             return None
         rec = self._slots[int(hit[0])]
+        # access stamp: the eviction pass gives stamped records a
+        # second chance (rescue to head) — cross-process LRU-grade
+        # behavior for the price of one u32 write under the lock
+        rec["pad"] = 1
         base, cap = self._heap_span(int(rec["ring"]))
         pos = base + (int(rec["off"]) % cap)
         # copy-out under the lock: the borrow law (module docstring)

@@ -143,6 +143,21 @@ class names:
         "serve.daemon_requests",
         "serve.daemon_rejected",
         "serve.daemon_connections",
+        # the cross-host fleet cache fabric (serve/fleet.py,
+        # docs/serving.md): consistent-hash ownership, the peer leg's
+        # failure domain, replication, fencing, and admission limiting
+        "serve.fleet_served",
+        "serve.fleet_origin_reads",
+        "serve.fleet_peer_fetches",
+        "serve.fleet_peer_hits",
+        "serve.fleet_peer_hit_bytes",
+        "serve.fleet_peer_errors",
+        "serve.fleet_peer_fallbacks",
+        "serve.fleet_epoch_fenced",
+        "serve.fleet_replications",
+        "serve.ratelimit_rejected",
+        # second-chance rescues in the shm tier's rings (shm_cache.py)
+        "serve.shm_rescues",
         # the training input pipeline (data.DataLoader, docs/data.md)
         "data.rows_emitted",
         "data.batches_emitted",
@@ -213,6 +228,11 @@ class names:
         # the serving daemon's lifecycle (serve/daemon.py):
         # start / drain / overload events
         "serve.daemon",
+        # the fleet cache fabric (serve/fleet.py): membership installs,
+        # breaker-guarded peer failover, origin fallbacks
+        "serve.fleet",
+        # remote-chain coalescing-gap auto-tune (scan/executor.py)
+        "scan.max_gap_autotuned",
     })
     SPANS = frozenset({
         "read",
@@ -245,6 +265,7 @@ class names:
         "serve.device_wait_seconds",     # device WFQ lane wait (contended)
         "serve.shm_wait_seconds",        # wait on another WORKER's read
         "serve.daemon_request_seconds",  # one daemon request, arrival→reply
+        "serve.fleet_peer_wait_seconds",  # one peer range fetch, send→bytes
         # storage read latency, split by source kind and hedge outcome
         "io.read_seconds.file",          # FileSource vectored read wall
         "io.remote.get_seconds.primary",    # remote fetch, primary won

@@ -93,6 +93,17 @@ echo "== process serving smoke (shm tier, workers, daemon, drain) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python scripts/process_serving_smoke.py || exit 1
 
+# Fleet-cache smoke (docs/serving.md): three in-process daemons each
+# mounting a FleetCache over one COUNTED origin — fleet-wide
+# exactly-once origin reads (non-primaries peer-fetch the owner), a
+# host loss that degrades to origin fallback with every answer still
+# byte-correct, a stale-epoch asker fenced, token-bucket admission
+# rejecting an over-rate tenant with retry_after_ms before it queues,
+# and the fleet-wide metrics fold carrying every daemon's counters.
+echo "== fleet smoke (ownership, host loss, fencing, admission, fold) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/fleet_smoke.py || exit 1
+
 # Salvage differential smoke: 60 seeded corruption cases through ALL
 # FOUR read faces (sequential host, host scan, device scan, loader),
 # asserting unanimous fatality, identical quarantine sets, identical

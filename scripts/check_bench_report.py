@@ -142,6 +142,7 @@ def check_report(bench_log: pathlib.Path) -> int:
         check_remote_leg(result.get("detail", {}))
         or check_serving_leg(result.get("detail", {}))
         or check_traffic_leg(result.get("detail", {}))
+        or check_fleet_leg(result.get("detail", {}))
         or check_histograms(result.get("detail", {}))
         or check_exec_cache_leg(result.get("detail", {}))
         or check_launches(result.get("detail", {}))
@@ -526,6 +527,74 @@ def check_traffic_leg(detail: dict) -> int:
         f"open-loop p99 {p99} ms <= {slo} ms SLO, "
         f"hot share {detail['traffic_fair_share_hot']} vs ungated "
         f"{ungated}, err {err} <= {band})"
+    )
+    return 0
+
+
+def check_fleet_leg(detail: dict) -> int:
+    """The fleet-survivability leg (docs/serving.md):
+
+    * fleet-wide origin reads must stay ~exactly-once per unique range
+      (<= the recorded 1.25x ceiling), with the peer-fetch leg and
+      hot-range replication both actually exercised;
+    * the host-loss chaos pass must answer EVERY request byte-correct
+      with zero errors — a dead or fenced owner degrades to an origin
+      fallback, never to a wrong answer or an exception;
+    * the stale-epoch fence must have refused at least one asker, and
+      the explicit stale probe must have come back ``stale_epoch``;
+    * chaos-pass p99 (failover + fence window + reinstall included)
+      must hold the recorded SLO, over a well-formed histogram."""
+    for k in ("fleet_nodes", "fleet_unique_ranges", "fleet_origin_reads",
+              "fleet_origin_ratio", "fleet_origin_ratio_max",
+              "fleet_exactly_once_ok", "fleet_peer_hits",
+              "fleet_replications", "fleet_peer_fallbacks",
+              "fleet_fenced", "fleet_fence_refused", "fleet_wrong",
+              "fleet_chaos_requests", "fleet_chaos_errors",
+              "fleet_chaos_p99_ms", "fleet_chaos_slo_ms",
+              "fleet_chaos_slo_ok", "fleet_chaos_hist"):
+        if k not in detail:
+            return fail(f"fleet leg missing {k}")
+    ratio = detail["fleet_origin_ratio"]
+    ceiling = detail["fleet_origin_ratio_max"]
+    if not detail["fleet_exactly_once_ok"] or not ratio <= ceiling:
+        return fail(
+            f"fleet origin reads {detail['fleet_origin_reads']} for "
+            f"{detail['fleet_unique_ranges']} unique ranges "
+            f"({ratio}x > {ceiling}x) — the fabric is re-reading origin"
+        )
+    if not detail["fleet_peer_hits"] >= 1:
+        return fail("fleet leg never took a peer hit — the peer leg "
+                    "went unexercised")
+    if not detail["fleet_replications"] >= 1:
+        return fail("fleet leg never replicated a hot range")
+    if not detail["fleet_peer_fallbacks"] >= 1:
+        return fail("chaos pass never fell back to origin — the host "
+                    "loss went unexercised")
+    if detail["fleet_wrong"] != 0:
+        return fail(f"fleet leg answered {detail['fleet_wrong']} "
+                    "request(s) with WRONG bytes")
+    if detail["fleet_chaos_errors"] != 0:
+        return fail(f"chaos pass raised {detail['fleet_chaos_errors']} "
+                    "error(s) — peer failure must degrade, not raise")
+    if not detail["fleet_chaos_requests"] >= 1:
+        return fail("chaos pass issued no requests")
+    if not detail["fleet_fenced"] >= 1 or not detail["fleet_fence_refused"]:
+        return fail("the stale-epoch fence never refused an asker")
+    p99, slo = detail["fleet_chaos_p99_ms"], detail["fleet_chaos_slo_ms"]
+    if not detail["fleet_chaos_slo_ok"] or not p99 <= slo:
+        return fail(f"chaos-pass p99 {p99} ms violates the {slo} ms SLO "
+                    "through the host loss")
+    problem = _hist_problem(detail["fleet_chaos_hist"])
+    if problem:
+        return fail(f"fleet chaos histogram: {problem}")
+    print(
+        "check_bench_report: fleet leg ok "
+        f"({detail['fleet_origin_reads']} origin reads / "
+        f"{detail['fleet_unique_ranges']} ranges = {ratio}x, "
+        f"peer hits {detail['fleet_peer_hits']}, "
+        f"replications {detail['fleet_replications']}, "
+        f"fenced {detail['fleet_fenced']}, "
+        f"chaos p99 {p99} ms <= {slo} ms)"
     )
     return 0
 

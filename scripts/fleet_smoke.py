@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Commit-gate fleet-cache smoke (docs/serving.md).
+
+The cross-host laws, proven over real sockets — three in-process
+``ServeDaemon``\\ s, each mounting a :class:`FleetCache` over one
+COUNTED origin:
+
+1. **fleet-wide exactly-once**: every node reads every unique range
+   through its fleet tier; across the whole fabric each unique range
+   must have been read from origin EXACTLY once (non-primaries
+   peer-fetch the owner), with the peer leg actually exercised;
+2. **host loss degrades, never errors**: one daemon dies and the OLD
+   membership stays installed — a full re-read from the survivors must
+   answer every range byte-correct (dead-owner fetches fall back to
+   origin); an explicit stale-epoch probe must be FENCED; after the
+   epoch-bumped reinstall the fabric must serve correctly again;
+3. **token-bucket admission**: a daemon built with a
+   :class:`TenantRateLimiter` must reject an over-rate tenant with
+   ``rate_limited`` + ``retry_after_ms`` (never queue it), admit
+   within-burst requests, and keep the connection usable after;
+4. **fleet-wide metrics fold**: every daemon pushes its snapshot into
+   one shared ``metrics_dir``; the ``merge_snapshot_dir`` fold must
+   carry the fabric's fleet counters from ALL daemons.
+
+Exit 0 on success, 1 with a diagnostic otherwise.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from parquet_floor_tpu.serve import (  # noqa: E402
+    DaemonClient,
+    FleetCache,
+    FleetMembership,
+    PeerClient,
+    ServeDaemon,
+    Serving,
+    TenantRateLimiter,
+)
+from parquet_floor_tpu.utils import trace  # noqa: E402
+
+NODES = ["n0", "n1", "n2"]
+RANGES = [(i * 4096, 768) for i in range(24)]
+KEY = ("fleet-smoke", 1 << 20)
+
+
+def fail(msg: str) -> int:
+    print(f"fleet_smoke: FAIL {msg}", file=sys.stderr)
+    return 1
+
+
+def content(offset: int, length: int) -> bytes:
+    pat = f"smoke:{offset}:{length}:".encode("ascii")
+    return (pat * (length // len(pat) + 1))[:length]
+
+
+def main() -> int:
+    origin_lock = threading.Lock()
+    origin_counts: dict = {}
+
+    def origin_read(key, ranges):
+        with origin_lock:
+            for (o, n) in ranges:
+                origin_counts[(o, n)] = origin_counts.get((o, n), 0) + 1
+        time.sleep(0.002)
+        return [content(o, n) for (o, n) in ranges]
+
+    membership = FleetMembership.create(NODES)
+    tracer = trace.Tracer(enabled=True)
+    with tempfile.TemporaryDirectory() as metrics_dir:
+        servings, fleets, daemons = [], [], []
+        try:
+            for nid in NODES:
+                srv = Serving(prefetch_bytes=4 << 20)
+                fc = FleetCache(
+                    nid, membership, origin=origin_read,
+                    peer_timeout_s=1.0, breaker_threshold=2,
+                    breaker_cooldown_s=0.2,
+                )
+                d = ServeDaemon(
+                    srv, {}, fleet=fc, max_inflight=4, max_pending=32,
+                    metrics_dir=metrics_dir, drain_timeout_s=2.0,
+                    rate_limiter=TenantRateLimiter(
+                        rate_per_s=2.0, burst=2.0),
+                )
+                d.start()
+                servings.append(srv)
+                fleets.append(fc)
+                daemons.append(d)
+            peers = {nid: ("127.0.0.1", d.port)
+                     for nid, d in zip(NODES, daemons)}
+            for fc in fleets:
+                fc.install_membership(membership, peers)
+
+            # -- law 1: fleet-wide exactly-once -------------------------
+            for fc in fleets:
+                with trace.using(tracer):
+                    got = fc.read_through(
+                        KEY, RANGES, lambda rs: origin_read(KEY, rs))
+                for (o, n), data in zip(RANGES, got):
+                    if data != content(o, n):
+                        return fail(f"wrong bytes for range {(o, n)}")
+            with origin_lock:
+                over = {r: c for r, c in origin_counts.items() if c != 1}
+                total = sum(origin_counts.values())
+            if over:
+                return fail(
+                    f"origin reads not exactly-once: {over} "
+                    f"({total} reads for {len(RANGES)} ranges)")
+            hits = tracer.counters().get("serve.fleet_peer_hits", 0)
+            if hits < 1:
+                return fail("peer leg unexercised (no peer hits)")
+            print(f"fleet_smoke: exactly-once ok ({total} origin reads "
+                  f"for {len(RANGES)} ranges, {hits} peer hits)")
+
+            # -- law 2: host loss degrades, never errors ----------------
+            daemons[2].close()
+            fleets[2].close()
+            for fc in fleets[:2]:
+                with trace.using(tracer):
+                    got = fc.read_through(
+                        KEY, RANGES, lambda rs: origin_read(KEY, rs))
+                for (o, n), data in zip(RANGES, got):
+                    if data != content(o, n):
+                        return fail(
+                            f"wrong bytes after host loss for {(o, n)}")
+            with PeerClient("127.0.0.1", daemons[0].port) as probe:
+                reply = probe.fetch(KEY, RANGES[0][0], RANGES[0][1],
+                                    epoch=999)
+            if reply.get("ok") or reply.get("code") != "stale_epoch":
+                return fail(f"stale-epoch probe not fenced: {reply}")
+            survivors = membership.without("n2")
+            new_peers = {nid: peers[nid] for nid in survivors.members}
+            for fc in fleets[:2]:
+                fc.install_membership(survivors, new_peers)
+            fresh = [(1 << 22) + o for (o, _) in RANGES[:8]]
+            for fc in fleets[:2]:
+                with trace.using(tracer):
+                    got = fc.read_through(
+                        KEY, [(o, 768) for o in fresh],
+                        lambda rs: origin_read(KEY, rs))
+                for o, data in zip(fresh, got):
+                    if data != content(o, 768):
+                        return fail(f"wrong bytes after reinstall at {o}")
+            print(f"fleet_smoke: host-loss ok (epoch "
+                  f"{fleets[0].epoch}, fence refused)")
+
+            # -- law 3: token-bucket admission --------------------------
+            with DaemonClient("127.0.0.1", daemons[0].port,
+                              tenant="greedy") as client:
+                codes: dict = {}
+                retry_ms = 0
+                for _ in range(6):
+                    r = client.request("lookup", dataset="none", key=1)
+                    codes[r.get("code")] = codes.get(r.get("code"), 0) + 1
+                    if r.get("code") == "rate_limited":
+                        retry_ms = max(retry_ms,
+                                       r.get("retry_after_ms", 0))
+                if codes.get("rate_limited", 0) < 1:
+                    return fail(f"over-rate tenant never rejected: {codes}")
+                if codes.get("bad_request", 0) < 1:
+                    return fail(
+                        f"within-burst requests not admitted: {codes}")
+                if retry_ms < 1:
+                    return fail("rate_limited reply carries no "
+                                "retry_after_ms")
+                if not client.ping():
+                    return fail("connection unusable after rate_limited")
+            print(f"fleet_smoke: admission ok ({codes}, "
+                  f"retry_after {retry_ms} ms)")
+
+            # -- law 4: fleet-wide metrics fold -------------------------
+            # in-process daemons share a pid, so push_metrics would
+            # overwrite one file; write one snapshot per daemon (the
+            # closed chaos victim's tracer still folds) and run the
+            # real directory fold
+            from parquet_floor_tpu.utils.metrics_export import (
+                merge_snapshot_dir,
+                write_snapshot,
+            )
+            for i, d in enumerate(daemons):
+                write_snapshot(
+                    d.worker_snapshot(),
+                    str(pathlib.Path(metrics_dir) / f"daemon-{i}.json"))
+            merged = merge_snapshot_dir(metrics_dir)
+            counters = merged.get("counters", {})
+            if counters.get("serve.fleet_origin_reads", 0) < 1:
+                return fail(
+                    "fold carries no fleet origin reads: "
+                    f"{sorted(k for k in counters if 'fleet' in k)}")
+            if counters.get("serve.ratelimit_rejected", 0) < 1:
+                return fail("fold carries no rate-limit rejections")
+            print("fleet_smoke: metrics fold ok "
+                  f"(origin_reads={counters['serve.fleet_origin_reads']}, "
+                  f"ratelimit_rejected="
+                  f"{counters['serve.ratelimit_rejected']})")
+            print("fleet_smoke: PASS")
+            return 0
+        finally:
+            for d in daemons:
+                d.close()
+            for fc in fleets:
+                fc.close()
+            for srv in servings:
+                srv.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

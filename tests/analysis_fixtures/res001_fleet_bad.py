@@ -1,0 +1,20 @@
+"""Seeded-bad: the fleet-fabric leak shapes — a FleetCache (owns every
+installed PeerClient socket plus its local byte store) and a bare
+PeerClient (a live connection a peer daemon's drain must then wait out)
+bound to locals with no exception path releasing them."""
+
+from parquet_floor_tpu.serve import FleetCache, PeerClient
+
+
+def mount_fleet(membership, origin):
+    fc = FleetCache("n0", membership, origin=origin)
+    fc.read_through(("f", 1), [(0, 64)], origin)  # a raise leaks peers
+    fc.close()
+    return True
+
+
+def probe_peer(port, membership):
+    peer = PeerClient("127.0.0.1", port)
+    reply = peer.fetch(("f", 1), 0, 64, epoch=membership.epoch)
+    peer.close()  # any error above leaks the socket
+    return reply

@@ -1,0 +1,28 @@
+"""Seeded-good: the fleet-fabric shapes, properly managed —
+with-managed, transferred, or closed in a finally."""
+
+from parquet_floor_tpu.serve import FleetCache, PeerClient, ServeDaemon
+
+
+def mount_fleet(membership, origin):
+    with FleetCache("n0", membership, origin=origin) as fc:
+        return fc.read_through(("f", 1), [(0, 64)], origin)
+
+
+def mount_daemon(serving, membership):
+    # ownership transfer: the returned daemon's owner closes both
+    return ServeDaemon(serving, {},
+                       fleet=FleetCache("n0", membership))
+
+
+def probe_peer(port, membership):
+    with PeerClient("127.0.0.1", port) as peer:
+        return peer.fetch(("f", 1), 0, 64, epoch=membership.epoch)
+
+
+def probe_fenced(port, membership):
+    peer = PeerClient("127.0.0.1", port)
+    try:
+        return peer.epoch()
+    finally:
+        peer.close()

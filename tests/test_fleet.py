@@ -1,0 +1,553 @@
+"""Fleet cache fabric (serve/fleet.py, docs/serving.md): rendezvous
+ownership, the peer-fetch failure domain, epoch fencing, replication,
+token-bucket admission, and the daemon-side fleet ops — including the
+failure COMPOSITIONS (drain with an in-flight peer fetch, limiter +
+admission under overload, a stale owner fenced mid-fleet)."""
+
+import threading
+import time
+
+import pytest
+
+from parquet_floor_tpu.serve import (
+    DaemonClient,
+    FleetCache,
+    FleetMembership,
+    PeerClient,
+    ServeDaemon,
+    Serving,
+    TenantRateLimiter,
+    TokenBucket,
+)
+from parquet_floor_tpu.serve.shm_cache import _digest
+from parquet_floor_tpu.utils import trace
+
+KEY = ("fleet-test", 4 << 20)
+
+
+def content(offset: int, length: int) -> bytes:
+    pat = f"t:{offset}:{length}:".encode("ascii")
+    return (pat * (length // len(pat) + 1))[:length]
+
+
+class CountedOrigin:
+    """A thread-safe counted origin: deterministic bytes per range,
+    every read recorded, optional per-call latency."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+        self.lock = threading.Lock()
+        self.counts: dict = {}
+
+    def __call__(self, key, ranges):
+        with self.lock:
+            for (o, n) in ranges:
+                self.counts[(o, n)] = self.counts.get((o, n), 0) + 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [content(o, n) for (o, n) in ranges]
+
+    def total(self) -> int:
+        with self.lock:
+            return sum(self.counts.values())
+
+
+# ---------------------------------------------------------------------------
+# membership / ownership
+
+
+def test_membership_create_sorts_and_dedups():
+    m = FleetMembership.create(["b", "a", "b"], epoch=3)
+    assert m.members == ("a", "b")
+    assert m.epoch == 3
+
+
+def test_membership_needs_a_member():
+    with pytest.raises(ValueError):
+        FleetMembership.create([])
+
+
+def test_owners_deterministic_and_spread():
+    m = FleetMembership.create(["a", "b", "c"])
+    seen = {n: 0 for n in m.members}
+    for i in range(300):
+        dk = _digest(KEY, i * 4096, 1024)
+        owners = m.owners(dk[0], dk[1])
+        assert owners == m.owners(dk[0], dk[1])  # deterministic
+        assert len(owners) == 2 and owners[0] != owners[1]
+        seen[owners[0]] += 1
+    # rendezvous hashing spreads primaries roughly evenly
+    assert all(40 <= c <= 160 for c in seen.values()), seen
+
+
+def test_membership_change_moves_only_lost_ranges():
+    m = FleetMembership.create(["a", "b", "c"])
+    m2 = m.without("c")
+    assert m2.epoch == m.epoch + 1
+    assert m2.members == ("a", "b")
+    for i in range(200):
+        dk = _digest(KEY, i * 4096, 1024)
+        before = m.owners(dk[0], dk[1])[0]
+        after = m2.owners(dk[0], dk[1])[0]
+        if before != "c":
+            # the minimal-reassignment law: a surviving primary keeps
+            # every range it owned
+            assert after == before
+    with pytest.raises(ValueError):
+        m2.without("a").without("b")
+    assert m2.with_member("c").members == ("a", "b", "c")
+    assert m2.with_member("c").epoch == m2.epoch + 1
+
+
+# ---------------------------------------------------------------------------
+# token buckets
+
+
+def test_token_bucket_admits_burst_then_meters():
+    t = [0.0]
+    bucket = TokenBucket(rate_per_s=2.0, burst=2.0, clock=lambda: t[0])
+    assert bucket.try_acquire() is None
+    assert bucket.try_acquire() is None
+    retry = bucket.try_acquire()
+    assert retry == pytest.approx(0.5)
+    t[0] += 0.5  # one token refilled
+    assert bucket.try_acquire() is None
+    assert bucket.try_acquire() is not None
+
+
+def test_token_bucket_caps_at_burst():
+    t = [0.0]
+    bucket = TokenBucket(rate_per_s=10.0, burst=2.0, clock=lambda: t[0])
+    t[0] += 100.0  # a long idle must not bank more than the burst
+    assert bucket.try_acquire() is None
+    assert bucket.try_acquire() is None
+    assert bucket.try_acquire() is not None
+
+
+def test_rate_limiter_per_tenant_and_overrides():
+    t = [0.0]
+    lim = TenantRateLimiter(rate_per_s=1.0, burst=1.0,
+                            overrides={"vip": 100.0},
+                            clock=lambda: t[0])
+    assert lim.admit("a") is None
+    assert lim.admit("a") is not None   # a's bucket is dry
+    assert lim.admit("b") is None       # b has its own bucket
+    for _ in range(50):                 # vip's override rate holds
+        assert lim.admit("vip") is None
+
+
+# ---------------------------------------------------------------------------
+# FleetCache, single node (no sockets)
+
+
+def test_single_node_reads_origin_once():
+    origin = CountedOrigin()
+    m = FleetMembership.create(["solo"])
+    with FleetCache("solo", m, origin=origin) as fc:
+        ranges = [(i * 4096, 512) for i in range(8)]
+        got = fc.read_through(KEY, ranges, lambda rs: origin(KEY, rs))
+        assert [bytes(b) for b in got] == [content(o, n)
+                                           for (o, n) in ranges]
+        again = fc.read_through(KEY, ranges, lambda rs: origin(KEY, rs))
+        assert [bytes(b) for b in again] == [bytes(b) for b in got]
+    assert origin.total() == len(ranges)  # second pass was all local
+
+
+def test_absent_peer_falls_back_to_origin():
+    # a non-primary with NO reachable peer must still answer — the
+    # fallback path is the read's availability floor
+    origin = CountedOrigin()
+    m = FleetMembership.create(["me", "ghost1", "ghost2"])
+    tracer = trace.Tracer(enabled=True)
+    with FleetCache("me", m, origin=origin) as fc:
+        ranges = [(i * 4096, 512) for i in range(24)]
+        with trace.using(tracer):
+            got = fc.read_through(KEY, ranges, lambda rs: origin(KEY, rs))
+        assert [bytes(b) for b in got] == [content(o, n)
+                                           for (o, n) in ranges]
+    c = tracer.counters()
+    assert c.get("serve.fleet_peer_fallbacks", 0) >= 1
+    assert c.get("serve.fleet_served") == len(ranges)
+
+
+def test_node_must_be_member():
+    with pytest.raises(ValueError):
+        FleetCache(  # floorlint: disable=FL-RES001 — ctor raises
+            "stranger", FleetMembership.create(["a", "b"]))
+
+
+def test_membership_epoch_cannot_regress():
+    m = FleetMembership.create(["a", "b"], epoch=5)
+    with FleetCache("a", m) as fc:
+        with pytest.raises(ValueError):
+            fc.install_membership(
+                FleetMembership.create(["a", "b"], epoch=4))
+
+
+def test_serve_range_fences_stale_epoch():
+    origin = CountedOrigin()
+    m = FleetMembership.create(["a"], epoch=7)
+    tracer = trace.Tracer(enabled=True)
+    with FleetCache("a", m, origin=origin) as fc:
+        with trace.using(tracer):
+            status, data = fc.serve_range(KEY, 0, 512, epoch=6)
+            assert (status, data) == ("stale_epoch", None)
+            assert fc.put_remote(KEY, 0, b"x" * 512, epoch=6) \
+                == "stale_epoch"
+            status, data = fc.serve_range(KEY, 0, 512, epoch=7)
+        assert status == "ok" and data == content(0, 512)
+    assert tracer.counters().get("serve.fleet_epoch_fenced") == 2
+    assert origin.total() == 1
+
+
+# ---------------------------------------------------------------------------
+# the wire: daemons as peers
+
+
+@pytest.fixture()
+def fleet3():
+    """Three daemons over one counted origin, membership installed."""
+    origin = CountedOrigin()
+    node_ids = ["n0", "n1", "n2"]
+    membership = FleetMembership.create(node_ids)
+    servings, fleets, daemons = [], [], []
+    try:
+        for nid in node_ids:
+            srv = Serving(prefetch_bytes=4 << 20)
+            fc = FleetCache(nid, membership, origin=origin,
+                            peer_timeout_s=1.0, breaker_threshold=2,
+                            breaker_cooldown_s=0.15)
+            d = ServeDaemon(srv, {}, fleet=fc, max_inflight=4,
+                            max_pending=32, drain_timeout_s=3.0)
+            d.start()
+            servings.append(srv)
+            fleets.append(fc)
+            daemons.append(d)
+        peers = {nid: ("127.0.0.1", d.port)
+                 for nid, d in zip(node_ids, daemons)}
+        for fc in fleets:
+            fc.install_membership(membership, peers)
+        yield origin, fleets, daemons, peers
+    finally:
+        for d in daemons:
+            d.close()
+        for fc in fleets:
+            fc.close()
+        for srv in servings:
+            srv.close()
+
+
+def test_fleet_exactly_once_and_peer_hits(fleet3):
+    origin, fleets, daemons, _ = fleet3
+    ranges = [(i * 4096, 768) for i in range(24)]
+    tracer = trace.Tracer(enabled=True)
+    for fc in fleets:
+        with trace.using(tracer):
+            got = fc.read_through(KEY, ranges, lambda rs: origin(KEY, rs))
+        assert [bytes(b) for b in got] == [content(o, n)
+                                           for (o, n) in ranges]
+    with origin.lock:
+        assert all(c == 1 for c in origin.counts.values()), origin.counts
+    assert tracer.counters().get("serve.fleet_peer_hits", 0) >= 1
+
+
+def test_dead_owner_degrades_to_origin(fleet3):
+    origin, fleets, daemons, _ = fleet3
+    ranges = [(i * 4096, 768) for i in range(24)]
+    # kill n2 BEFORE any traffic: every n2-primary range must be
+    # answered via origin fallback, correctly, with no exception
+    daemons[2].close()
+    fleets[2].close()
+    tracer = trace.Tracer(enabled=True)
+    with trace.using(tracer):
+        got = fleets[0].read_through(KEY, ranges,
+                                     lambda rs: origin(KEY, rs))
+    assert [bytes(b) for b in got] == [content(o, n)
+                                       for (o, n) in ranges]
+    c = tracer.counters()
+    assert c.get("serve.fleet_peer_fallbacks", 0) >= 1
+    assert c.get("serve.fleet_peer_errors", 0) >= 1
+
+
+def test_breaker_trips_then_recovers(fleet3):
+    origin, fleets, daemons, peers = fleet3
+    # pick a range whose PRIMARY is n1, asked from n0
+    target = None
+    for i in range(200):
+        o = (1 << 20) + i * 4096
+        dk = _digest(KEY, o, 768)
+        if fleets[0].membership.owners(dk[0], dk[1])[0] == "n1":
+            target = (o, 768)
+            break
+    assert target is not None
+    daemons[1].close()
+    fleets[1].close()
+    tracer = trace.Tracer(enabled=True)
+    with trace.using(tracer):
+        # threshold=2 and two attempts per fetch: the FIRST read trips
+        # the breaker; the second must not even dial (fast-fail)
+        fleets[0].read_through(KEY, [target], lambda rs: origin(KEY, rs))
+        errors_after_first = tracer.counters().get(
+            "serve.fleet_peer_errors", 0)
+        assert errors_after_first >= 1
+        o2 = (target[0] + 4096, 768)
+        dk2 = _digest(KEY, o2[0], o2[1])
+        if fleets[0].membership.owners(dk2[0], dk2[1])[0] == "n1":
+            fleets[0].read_through(KEY, [o2],
+                                   lambda rs: origin(KEY, rs))
+    assert tracer.counters().get("io.remote.breaker_trips", 0) >= 1
+    # half-open recovery: bring a NEW daemon up on n1's slot and wait
+    # out the cooldown — the breaker must admit the probe and close
+    srv = Serving(prefetch_bytes=4 << 20)
+    fc1 = FleetCache("n1", fleets[0].membership, origin=origin,
+                     peer_timeout_s=1.0)
+    d1 = ServeDaemon(srv, {}, fleet=fc1, max_inflight=2,
+                     max_pending=8)
+    d1.start()
+    try:
+        fc1.install_membership(
+            fleets[0].membership,
+            {**peers, "n1": ("127.0.0.1", d1.port)})
+        fleets[0].install_membership(
+            fleets[0].membership,
+            {**peers, "n1": ("127.0.0.1", d1.port)})
+        time.sleep(0.2)  # past breaker_cooldown_s=0.15
+        tracer2 = trace.Tracer(enabled=True)
+        with trace.using(tracer2):
+            got = fleets[0].read_through(
+                KEY, [target], lambda rs: origin(KEY, rs))
+        # target is cached on n0 from the fallback read — use a fresh
+        # n1-primary range to force the peer leg
+        fresh = None
+        for i in range(200):
+            o = (1 << 24) + i * 4096
+            dk = _digest(KEY, o, 768)
+            if fleets[0].membership.owners(dk[0], dk[1])[0] == "n1":
+                fresh = (o, 768)
+                break
+        with trace.using(tracer2):
+            got = fleets[0].read_through(
+                KEY, [fresh], lambda rs: origin(KEY, rs))
+        assert bytes(got[0]) == content(*fresh)
+        assert tracer2.counters().get("serve.fleet_peer_hits", 0) >= 1
+    finally:
+        d1.close()
+        fc1.close()
+        srv.close()
+
+
+def test_stale_owner_is_fenced_over_the_wire(fleet3):
+    origin, fleets, daemons, peers = fleet3
+    # n0 and n1 move to epoch 2; n2 stays stale.  A STALE OWNER must
+    # be refused (fenced) — and the fresh asker must degrade to
+    # origin, correctly.
+    survivors = fleets[0].membership.without("n2")
+    new_peers = dict(peers)
+    for fc in fleets[:2]:
+        fc.install_membership(survivors, new_peers)
+    # the stale node asks a fresh one: fenced
+    with PeerClient("127.0.0.1", daemons[0].port) as probe:
+        reply = probe.fetch(KEY, 0, 512, epoch=1)
+    assert not reply.get("ok") and reply.get("code") == "stale_epoch"
+    assert reply.get("epoch") == survivors.epoch
+    # a fresh node asking the stale one is ALSO fenced — and falls
+    # back to origin with the right bytes
+    tracer = trace.Tracer(enabled=True)
+    target = None
+    for i in range(300):
+        o = (1 << 21) + i * 4096
+        dk = _digest(KEY, o, 512)
+        if survivors.owners(dk[0], dk[1])[0] == "n2":
+            target = (o, 512)
+            break
+    if target is not None:
+        # n2 left the membership, so no peer entry — exercised via the
+        # absent-peer fallback; the fence law over the wire is the
+        # probe above
+        with trace.using(tracer):
+            got = fleets[0].read_through(
+                KEY, [target], lambda rs: origin(KEY, rs))
+        assert bytes(got[0]) == content(*target)
+
+
+def test_drain_waits_for_inflight_peer_fetch():
+    # composition: drain() with a peer fetch mid-flight on the pool
+    # must wait it out and report a CLEAN drain — the fetch completes
+    # with the right bytes, not an error
+    origin = CountedOrigin(delay_s=0.3)
+    m = FleetMembership.create(["a"])
+    srv = Serving(prefetch_bytes=4 << 20)
+    fc = FleetCache("a", m, origin=origin)
+    d = ServeDaemon(srv, {}, fleet=fc, max_inflight=2, max_pending=8,
+                    drain_timeout_s=5.0)
+    d.start()
+    try:
+        result = {}
+
+        def fetchit():
+            with PeerClient("127.0.0.1", d.port, timeout_s=5.0) as pc:
+                result["reply"] = pc.fetch(KEY, 0, 512, epoch=m.epoch)
+
+        t = threading.Thread(target=fetchit)
+        t.start()
+        time.sleep(0.1)  # let the fetch land on the pool
+        clean = d.drain()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert clean is True
+        assert result["reply"].get("ok")
+        assert result["reply"]["data"] == content(0, 512)
+        # post-drain fetches are refused with "draining"
+        with PeerClient("127.0.0.1", d.port) as pc2:
+            with pytest.raises(OSError):
+                # the listener is closed — new connections fail
+                pc2.fetch(KEY, 4096, 512, epoch=m.epoch)
+    finally:
+        d.close()
+        fc.close()
+        srv.close()
+
+
+def test_overload_pushback_composes_with_peer_fallback():
+    # composition: a daemon at max_pending refuses a peer with
+    # `overloaded` (+retry_after_ms), and the ASKER degrades that
+    # refusal to an origin fallback — never an error, never a queue
+    origin = CountedOrigin(delay_s=0.25)
+    m = FleetMembership.create(["busy", "asker"])
+    srv = Serving(prefetch_bytes=4 << 20)
+    fc = FleetCache("busy", m, origin=origin)
+    d = ServeDaemon(srv, {}, fleet=fc, max_inflight=1, max_pending=1,
+                    drain_timeout_s=3.0)
+    d.start()
+    try:
+        # find two busy-primary ranges
+        targets = []
+        for i in range(400):
+            o = i * 4096
+            dk = _digest(KEY, o, 512)
+            if m.owners(dk[0], dk[1])[0] == "busy" and len(targets) < 2:
+                targets.append((o, 512))
+        assert len(targets) == 2
+        # occupy the single pending slot with a slow direct fetch
+        blocker_reply = {}
+
+        def blocker():
+            with PeerClient("127.0.0.1", d.port, timeout_s=5.0) as pc:
+                blocker_reply["r"] = pc.fetch(
+                    KEY, targets[0][0], targets[0][1], epoch=m.epoch)
+
+        t = threading.Thread(target=blocker)
+        t.start()
+        time.sleep(0.08)
+        with PeerClient("127.0.0.1", d.port) as pc2:
+            reply = pc2.fetch(KEY, targets[1][0], targets[1][1],
+                              epoch=m.epoch)
+        assert not reply.get("ok")
+        assert reply.get("code") == "overloaded"
+        assert reply.get("retry_after_ms", 0) >= 1
+        t.join(timeout=5.0)
+        assert blocker_reply["r"].get("ok")
+        # the asker-side composition: same overload, through the
+        # FleetCache face — answers from origin, no exception
+        asker = FleetCache("asker", m,
+                           peers={"busy": ("127.0.0.1", d.port)})
+        tracer = trace.Tracer(enabled=True)
+        try:
+            t2 = threading.Thread(target=lambda: origin(KEY, [(0, 1)]))
+            blocker2 = threading.Thread(target=blocker)
+            blocker2.start()
+            time.sleep(0.08)
+            with trace.using(tracer):
+                got = asker.read_through(
+                    KEY, [targets[1]], lambda rs: origin(KEY, rs))
+            assert bytes(got[0]) == content(*targets[1])
+            blocker2.join(timeout=5.0)
+            del t2
+        finally:
+            asker.close()
+    finally:
+        d.close()
+        fc.close()
+        srv.close()
+
+
+def test_rate_limiter_rejects_before_admission():
+    # composition: an over-rate tenant is rejected at the DOOR — no
+    # pending slot consumed, daemon_requests untouched, fleet ops and
+    # the connection unaffected
+    srv = Serving(prefetch_bytes=4 << 20)
+    lim = TenantRateLimiter(rate_per_s=1.0, burst=1.0)
+    d = ServeDaemon(srv, {}, max_inflight=2, max_pending=8,
+                    rate_limiter=lim)
+    d.start()
+    try:
+        with DaemonClient("127.0.0.1", d.port, tenant="greedy") as c:
+            first = c.request("lookup", dataset="none", key=1)
+            assert first.get("code") == "bad_request"  # admitted
+            requests_after_first = d.tracer.counters().get(
+                "serve.daemon_requests", 0)
+            second = c.request("lookup", dataset="none", key=1)
+            assert second.get("code") == "rate_limited"
+            assert second.get("retry_after_ms", 0) >= 1
+            # the rejection consumed NO admission budget
+            assert d.tracer.counters().get(
+                "serve.daemon_requests", 0) == requests_after_first
+            assert c.ping()
+        # the rejection was attributed to the tenant's tracer
+        greedy = srv.tenant("greedy")
+        assert greedy.tracer.counters().get(
+            "serve.ratelimit_rejected", 0) >= 1
+    finally:
+        d.close()
+        srv.close()
+
+
+def test_replication_pushes_hot_range_to_replica(fleet3):
+    origin, fleets, daemons, _ = fleet3
+    # find an n0-primary range with n1 as replica
+    target = None
+    for i in range(400):
+        o = (1 << 23) + i * 4096
+        dk = _digest(KEY, o, 640)
+        owners = fleets[0].membership.owners(dk[0], dk[1])
+        if owners == ["n0", "n1"]:
+            target = (o, 640)
+            break
+    assert target is not None
+    tracer = trace.Tracer(enabled=True)
+    with trace.using(tracer):
+        # replicate_after=2: two primary serves push to the replica
+        fleets[0].read_through(KEY, [target], lambda rs: origin(KEY, rs))
+        fleets[0]._local_get(KEY, *target)  # warm check only
+        # second HEAT must come from a serve that reaches the heat
+        # counter: peer fetch via n2
+        fleets[2].read_through(KEY, [target], lambda rs: origin(KEY, rs))
+    deadline = time.time() + 2.0
+    while time.time() < deadline:
+        if fleets[1]._local_get(KEY, *target) is not None:
+            break
+        time.sleep(0.02)
+    assert fleets[1]._local_get(KEY, *target) == content(*target), \
+        "hot range never replicated to the next-on-ring member"
+    assert origin.total() == 1  # replication moved bytes, not origin
+
+
+def test_wire_carries_extent_sized_payloads(fleet3):
+    # regression: the peer plane is a JSON line protocol, and a
+    # replication push (fleet_put) carries the range payload base64
+    # inline — asyncio's DEFAULT 64 KiB readline limit severed the
+    # connection for any extent past ~48 KiB.  A 256 KiB payload must
+    # round-trip both directions: put lands at the peer, and a fetch
+    # answers with the same bytes on one origin read.
+    origin, fleets, daemons, peers = fleet3
+    big = (1 << 20, 256 << 10)  # offset, length: 4x the old limit
+    payload = content(*big)
+    epoch = fleets[0].membership.epoch
+    with PeerClient("127.0.0.1", daemons[1].port) as probe:
+        reply = probe.put(KEY, big[0], payload, epoch)
+        assert reply.get("ok"), reply
+        reply = probe.fetch(KEY, big[0], big[1], epoch)
+    assert reply.get("ok"), reply
+    assert reply["data"] == payload
+    assert fleets[1]._local_get(KEY, *big) == payload
+    assert origin.total() == 0  # the push seeded it; fetch was a hit

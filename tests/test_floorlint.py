@@ -44,6 +44,8 @@ CASES = [
     ("res001_shm", "FL-RES001"),  # shm segment / daemon / client shapes
     #                               (classmethod factories create/attach
     #                               are acquisitions too)
+    ("res001_fleet", "FL-RES001"),  # fleet fabric: FleetCache owns its
+    #                               peer sockets, PeerClient one socket
     ("alloc001", "FL-ALLOC001"),
     ("obs001", "FL-OBS001"),
     ("lock001", "FL-LOCK001"),

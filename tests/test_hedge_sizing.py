@@ -1,0 +1,131 @@
+"""Byte-size-informed hedging (io/remote.py): the adaptive hedge delay
+is the latency p95 WIDENED by the extra transfer time the request's
+size implies over the sampled mean — a 16 MiB fetch must not hedge on
+a p95 learned from footer-sized reads."""
+
+from parquet_floor_tpu.io.remote import LatencyStats, RemoteSource
+
+
+class _NullTransport:
+    name = "null://"
+    size = 1 << 30
+
+    def get_range(self, offset, length):  # pragma: no cover - unused
+        return b"\x00" * length
+
+
+def make_store(**kw):
+    kw.setdefault("fetch_threads", 1)
+    return RemoteSource(_NullTransport(), **kw)
+
+
+def feed(store, n=32, seconds=0.010, nbytes=64 << 10):
+    for _ in range(n):
+        store.latency.observe(seconds, nbytes)
+
+
+def test_latency_stats_sizes_ring():
+    st = LatencyStats(cap=4)
+    for i in range(8):  # wraps: only the last 4 sized samples remain
+        st.observe(0.01, (i + 1) * 1000)
+    assert st.mean_size() == (5 + 6 + 7 + 8) * 1000 / 4
+    bw = st.bandwidth_Bps()
+    assert bw == (5 + 6 + 7 + 8) * 1000 / 0.04
+
+
+def test_unsized_samples_are_excluded():
+    st = LatencyStats()
+    st.observe(0.01)          # unsized — a ping, not a transfer
+    assert st.mean_size() is None and st.bandwidth_Bps() is None
+    st.observe(0.01, 1000)
+    assert st.mean_size() == 1000
+
+
+def test_cold_store_does_not_hedge():
+    store = make_store(hedge_min_samples=8)
+    try:
+        assert store.hedge_delay() is None
+        assert store.hedge_delay(16 << 20) is None
+    finally:
+        store.close()
+
+
+def test_big_read_widens_delay_beyond_p95():
+    store = make_store(hedge_min_delay_s=0.001, hedge_max_delay_s=60.0)
+    try:
+        # 64 KiB reads at 10 ms → p95 0.01 s, bandwidth 6.55 MB/s
+        feed(store, n=32, seconds=0.010, nbytes=64 << 10)
+        base = store.hedge_delay()
+        assert base == 0.010
+        small = store.hedge_delay(64 << 10)
+        big = store.hedge_delay(16 << 20)
+        # at/below the mean size: no widening
+        assert small == base
+        # 16 MiB at ~6.55 MB/s implies seconds of legitimate transfer
+        assert big > base + 1.0
+        # and the widening is exactly (length - mean)/bandwidth
+        bw = store.latency.bandwidth_Bps()
+        mean = store.latency.mean_size()
+        assert big == base + ((16 << 20) - mean) / bw
+    finally:
+        store.close()
+
+
+def test_widened_delay_clamps_to_hedge_max():
+    store = make_store(hedge_min_delay_s=0.001, hedge_max_delay_s=0.5)
+    try:
+        feed(store, n=32, seconds=0.010, nbytes=64 << 10)
+        assert store.hedge_delay(1 << 30) == 0.5
+    finally:
+        store.close()
+
+
+def test_fixed_delay_ignores_size():
+    store = make_store(hedge_delay_s=0.123)
+    try:
+        feed(store, n=32, seconds=0.010, nbytes=64 << 10)
+        assert store.hedge_delay() == 0.123
+        assert store.hedge_delay(16 << 20) == 0.123
+    finally:
+        store.close()
+
+
+def test_no_size_data_falls_back_to_p95():
+    store = make_store(hedge_min_delay_s=0.001)
+    try:
+        for _ in range(32):
+            store.latency.observe(0.010)  # all unsized
+        assert store.hedge_delay(16 << 20) == store.hedge_delay()
+    finally:
+        store.close()
+
+
+def test_simulator_big_read_does_not_spuriously_hedge():
+    # fixed-seed end to end: warm the p95 on small reads against a
+    # bandwidth-bound store, then issue one read 64x the mean — its
+    # transfer time alone dwarfs the small-read p95, and the widened
+    # delay must keep the hedge holstered for a HEALTHY big read
+    import numpy as np
+
+    from parquet_floor_tpu.testing import (
+        RemoteProfile,
+        SimulatedRemoteSource,
+    )
+    from parquet_floor_tpu.utils import trace
+
+    data = bytes(np.random.default_rng(3).integers(
+        0, 256, 1 << 21, dtype=np.uint8))
+    profile = RemoteProfile(base_latency_s=0.001,
+                            bandwidth_bytes_per_s=50e6)
+    tracer = trace.Tracer(enabled=True)
+    with SimulatedRemoteSource(data, profile=profile, seed=11,
+                               hedge_min_samples=8,
+                               hedge_min_delay_s=0.001) as src:
+        with trace.using(tracer):
+            for i in range(16):  # 16 KiB reads: ~1.3 ms each
+                src.read_at(i << 14, 1 << 14)
+            big = src.read_at(0, 1 << 20)  # ~21 ms of honest transfer
+        assert bytes(big) == data[:1 << 20]
+        assert tracer.counters().get("io.remote.hedges", 0) == 0
+        # the widened delay really is wider than the small-read p95
+        assert src.hedge_delay(1 << 20) > src.hedge_delay(1 << 14)

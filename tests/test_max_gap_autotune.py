@@ -1,0 +1,140 @@
+"""max_gap_bytes auto-tune (scan/plan.py, scan/executor.py):
+``ScanOptions(max_gap_bytes=None)`` lets the executor derive the
+coalescing gap from the adaptive controller's measured RTT x bandwidth
+— a slow store widens the gap (fewer round trips buy more than the
+wasted bytes cost), a local chain clamps to the static default."""
+
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import (
+    ParquetFileWriter,
+    WriterOptions,
+    trace,
+    types,
+)
+from parquet_floor_tpu.scan import DatasetScanner, ScanOptions
+from parquet_floor_tpu.scan.executor import _AdaptiveController
+from parquet_floor_tpu.scan.plan import DEFAULT_MAX_GAP_BYTES
+
+
+@pytest.fixture(scope="module")
+def path(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("autotune") / "t.parquet")
+    schema = types.message(
+        "t",
+        types.required(types.INT64).named("k"),
+        types.optional(types.BYTE_ARRAY).as_(types.string()).named("s"),
+    )
+    n = 2000
+    data = {
+        "k": np.arange(n, dtype=np.int64),
+        "s": [None if i % 7 == 0 else f"v{i % 37}" for i in range(n)],
+    }
+    opts = WriterOptions(row_group_rows=500, data_page_values=200)
+    with ParquetFileWriter(p, schema, opts) as w:
+        for lo in range(0, n, 500):
+            w.write_columns({k: v[lo:lo + 500]
+                             for k, v in data.items()})
+    return p
+
+
+def test_options_accept_none_gap():
+    sc = ScanOptions(max_gap_bytes=None)
+    assert sc.max_gap_bytes is None
+    with pytest.raises(ValueError):
+        ScanOptions(max_gap_bytes=-1)
+
+
+def test_default_gap_unchanged():
+    assert ScanOptions().max_gap_bytes == DEFAULT_MAX_GAP_BYTES
+
+
+def test_controller_learns_bandwidth():
+    ctl = _AdaptiveController(base_cap=8 << 20, threads=2)
+    assert ctl.bandwidth_Bps() is None
+    ctl.observe_load(10_000_000, 0.1)  # 100 MB/s
+    assert ctl.bandwidth_Bps() == pytest.approx(1e8)
+    ctl.observe_load(5_000_000, 0.1)   # 50 MB/s → EWMA pulls down
+    bw = ctl.bandwidth_Bps()
+    assert bw == pytest.approx(0.7 * 1e8 + 0.3 * 5e7)
+    ctl.observe_load(0, 0.1)           # zero-byte loads are ignored
+    assert ctl.bandwidth_Bps() == pytest.approx(bw)
+
+
+def _scanner(path, sc):
+    return DatasetScanner([path], scan=sc)
+
+
+def test_effective_scan_defaults_without_measurements(path):
+    # auto mode with no RTT/bandwidth on record resolves to the
+    # static default — never a crash, never a zero gap
+    with _scanner(path, ScanOptions(max_gap_bytes=None,
+                                    adaptive_prefetch=True)) as s:
+        eff = s._effective_scan()
+        assert eff.max_gap_bytes == DEFAULT_MAX_GAP_BYTES
+
+
+def test_effective_scan_widens_for_slow_store(path):
+    with _scanner(path, ScanOptions(max_gap_bytes=None,
+                                    adaptive_prefetch=True)) as s:
+        # a 20 ms RTT at 100 MB/s: gap ≈ rtt x bw = 2 MB
+        for _ in range(8):
+            s._adaptive.observe_load(2_000_000, 0.02)
+        eff = s._effective_scan()
+        rtt = s._adaptive.rtt_s()
+        bw = s._adaptive.bandwidth_Bps()
+        expect = int(min(s._scan.max_extent_bytes,
+                         max(DEFAULT_MAX_GAP_BYTES, rtt * bw)))
+        assert eff.max_gap_bytes == expect
+        assert eff.max_gap_bytes > DEFAULT_MAX_GAP_BYTES
+
+
+def test_effective_scan_clamps_to_max_extent(path):
+    with _scanner(path, ScanOptions(max_gap_bytes=None,
+                                    adaptive_prefetch=True,
+                                    max_extent_bytes=1 << 20)) as s:
+        # absurd rtt x bw must clamp at max_extent_bytes — an extent
+        # can never be wider than the extent ceiling itself
+        for _ in range(8):
+            s._adaptive.observe_load(100_000_000, 1.0)  # 100 MB/s, 1 s RTT
+        assert s._effective_scan().max_gap_bytes == 1 << 20
+
+
+def test_fast_local_chain_keeps_default(path):
+    with _scanner(path, ScanOptions(max_gap_bytes=None,
+                                    adaptive_prefetch=True)) as s:
+        # 0.5 ms loads at disk speed: rtt x bw « 64 KiB → floor holds
+        for _ in range(8):
+            s._adaptive.observe_load(64 << 10, 0.0005)
+        assert s._effective_scan().max_gap_bytes == DEFAULT_MAX_GAP_BYTES
+
+
+def test_autotune_decision_emitted_once(path):
+    tracer = trace.Tracer(enabled=True)
+    with _scanner(path, ScanOptions(max_gap_bytes=None,
+                                    adaptive_prefetch=True)) as s:
+        with trace.using(tracer):
+            s._effective_scan()
+            s._effective_scan()  # same gap → deduped
+        hits = [d for d in tracer.decisions()
+                if d["decision"] == "scan.max_gap_autotuned"]
+        assert len(hits) == 1
+        assert hits[0]["gap_bytes"] == DEFAULT_MAX_GAP_BYTES
+        # a gap CHANGE re-emits
+        for _ in range(8):
+            s._adaptive.observe_load(2_000_000, 0.02)
+        with trace.using(tracer):
+            s._effective_scan()
+        hits = [d for d in tracer.decisions()
+                if d["decision"] == "scan.max_gap_autotuned"]
+        assert len(hits) == 2
+
+
+def test_scan_with_auto_gap_matches_explicit(path):
+    # end to end: auto mode decodes the same rows as the static default
+    with _scanner(path, ScanOptions(max_gap_bytes=None)) as s:
+        auto = [u.batch.num_rows for u in s]
+    with _scanner(path, ScanOptions()) as s:
+        fixed = [u.batch.num_rows for u in s]
+    assert auto == fixed and sum(auto) == 2000

@@ -21,6 +21,12 @@ FL-OBS     observability guards: trace metric/decision/span name literals
 FL-LOCK    concurrency-discipline guards: with-managed acquires, no
            blocking under a lock (call-graph-computed), while-predicate
            Condition waits, consistent project-wide lock ordering
+FL-RACE    lockset race detection: per-field guard locks inferred from
+           write sites + thread-entry reachability; accesses outside the
+           inferred guard and non-atomic check-then-act flagged
+FL-ASYNC   event-loop protection: no blocking sinks in coroutine context
+           (call-graph-computed; run_in_executor is the escape), no await
+           under a threading lock, no dropped (un-awaited) coroutines
 ========== ==================================================================
 
 The engine runs ONE project-wide pass (``analysis.project``): every file
@@ -44,12 +50,13 @@ from .core import (  # noqa: F401  (public surface)
     write_baseline,
 )
 from .project import CALL_DEPTH, Project  # noqa: F401
-from . import (rules_alloc, rules_exc, rules_lock, rules_obs, rules_res,
-               rules_tpu)
+from . import (rules_alloc, rules_async, rules_exc, rules_lock, rules_obs,
+               rules_race, rules_res, rules_tpu)
 
 ALL_RULES = (
     rules_exc.RULES + rules_tpu.RULES + rules_res.RULES + rules_alloc.RULES
-    + rules_obs.RULES + rules_lock.RULES
+    + rules_obs.RULES + rules_lock.RULES + rules_race.RULES
+    + rules_async.RULES
 )
 
 __all__ = [

@@ -44,9 +44,16 @@ def main(argv=None) -> int:
                          "the OLD baseline accepted — legacy message-keyed "
                          "entries included — are rewritten as span "
                          "fingerprints; everything else still reports")
-    ap.add_argument("--format", choices=("text", "json"), default="text",
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text",
                     help="output format (json: machine-readable findings "
-                         "with call chains)")
+                         "with call chains; sarif: SARIF 2.1.0 for CI "
+                         "inline annotation)")
+    ap.add_argument("--cache", nargs="?", const=".floorlint_cache",
+                    default=None, metavar="DIR",
+                    help="incremental cache dir (default when the flag is "
+                         "given bare: .floorlint_cache); warm runs "
+                         "re-analyze only changed files")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -61,7 +68,12 @@ def main(argv=None) -> int:
         ap.error("no paths given and no default targets found")
 
     baseline = None if args.no_baseline else load_baseline(args.baseline)
-    result = run(paths, baseline=baseline)
+    cache = None
+    if args.cache is not None:
+        from .cache import LintCache
+
+        cache = LintCache(args.cache)
+    result = run(paths, baseline=baseline, cache=cache)
 
     if args.write_baseline:
         write_baseline(args.baseline, result.violations)
@@ -76,6 +88,14 @@ def main(argv=None) -> int:
         write_baseline(args.baseline, accepted)
         print(f"floorlint: rewrote {len(accepted)} fingerprint(s) to "
               f"{args.baseline} (span format)")
+
+    if args.format == "sarif":
+        from .sarif import to_sarif
+
+        syntax_rule = ("FL-SYNTAX", "file does not parse")
+        print(json.dumps(to_sarif(result, list(ALL_RULES) + [syntax_rule]),
+                         indent=1))
+        return 1 if result.violations else 0
 
     if args.format == "json":
         print(json.dumps({

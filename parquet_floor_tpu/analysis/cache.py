@@ -1,0 +1,138 @@
+"""floorlint incremental cache — warm runs re-analyze only what changed.
+
+The engine is a PROJECT-wide pass (call graph, inherited locks, thread
+reachability), so per-file verdicts are only reusable when the whole
+project is unchanged — a one-file edit can shift a cross-file chain.
+The cache is therefore two honest tiers:
+
+* **context tier** — each file's parsed :class:`FileContext` (AST,
+  parent map, directives) pickled under ``<root>/ctx/``, keyed by
+  ``(path, mtime_ns, size)`` plus the analyzer stamp.  A warm run
+  re-parses ONLY changed files; rules still run project-wide, so
+  graph-aware verdicts stay sound after any edit.
+* **run tier** — the full :class:`RunResult` pickled under
+  ``<root>/run/``, keyed by a signature over EVERY file key, the
+  analyzer stamp and the baseline.  The no-change warm run (the common
+  CI case) reduces to a directory stat walk plus one unpickle.
+
+The **analyzer stamp** folds in ``analysis/*.py`` (mtime/size) and the
+interpreter version, so editing any rule — or upgrading Python —
+invalidates everything.
+
+Every read is wrapped: a missing, truncated, or corrupted artifact is
+treated as a miss and the engine falls back to a full pass (pinned by
+``test_floorlint.py::test_cache_corruption_falls_back``).  Writes are
+atomic (tmp + ``os.replace``) and best-effort — a read-only cache dir
+degrades to uncached, never to an error.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pathlib
+import pickle
+import sys
+import tempfile
+from typing import Optional, Sequence
+
+#: bump to orphan every artifact written by an incompatible layout
+_LAYOUT = 1
+
+
+def _sha1(text: str) -> str:
+    return hashlib.sha1(text.encode()).hexdigest()
+
+
+class LintCache:
+    """Artifact store rooted at ``.floorlint_cache/`` (or any dir)."""
+
+    def __init__(self, root) -> None:
+        self.root = pathlib.Path(root)
+        self._stamp: Optional[str] = None
+
+    # -- keys ----------------------------------------------------------------
+
+    def stamp(self) -> str:
+        """Fingerprint of the analyzer itself (lazy, computed once)."""
+        if self._stamp is None:
+            pkg = pathlib.Path(__file__).parent
+            parts = [f"layout={_LAYOUT}", f"py={sys.version_info[:3]}"]
+            for f in sorted(pkg.glob("*.py")):
+                st = f.stat()
+                parts.append(f"{f.name}:{st.st_mtime_ns}:{st.st_size}")
+            self._stamp = _sha1("|".join(parts))
+        return self._stamp
+
+    @staticmethod
+    def file_key(path: pathlib.Path) -> tuple:
+        st = path.stat()
+        return (str(path), st.st_mtime_ns, st.st_size)
+
+    def run_signature(self, files: Sequence[pathlib.Path],
+                      baseline=None) -> str:
+        """One hash over the whole input: every file key, the analyzer
+        stamp, and the baseline entries."""
+        parts = [self.stamp()]
+        parts.extend(repr(self.file_key(f)) for f in files)
+        if baseline:
+            parts.append(repr(sorted(baseline.items())))
+        return _sha1("|".join(parts))
+
+    # -- raw artifact I/O ----------------------------------------------------
+
+    def _load(self, rel: str):
+        try:
+            with open(self.root / rel, "rb") as fh:
+                return pickle.load(fh)
+        except Exception:
+            return None  # missing/corrupt/incompatible: a miss, never an error
+
+    def _store(self, rel: str, payload) -> None:
+        try:
+            target = self.root / rel
+            target.parent.mkdir(parents=True, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=str(target.parent), suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
+                os.replace(tmp, target)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except Exception:
+            pass  # best-effort: a read-only cache degrades to uncached
+
+    # -- context tier --------------------------------------------------------
+
+    def load_context(self, path: pathlib.Path):
+        """The file's cached FileContext, or None when the file (or the
+        analyzer) changed since it was stored."""
+        payload = self._load(f"ctx/{_sha1(str(path))}.pkl")
+        if not isinstance(payload, dict):
+            return None
+        try:
+            fresh = payload["key"] == self.file_key(path) \
+                and payload["stamp"] == self.stamp()
+        except Exception:
+            return None
+        return payload["ctx"] if fresh else None
+
+    def store_context(self, path: pathlib.Path, ctx) -> None:
+        self._store(f"ctx/{_sha1(str(path))}.pkl", {
+            "key": self.file_key(path), "stamp": self.stamp(), "ctx": ctx,
+        })
+
+    # -- run tier ------------------------------------------------------------
+
+    def load_run(self, signature: str):
+        payload = self._load(f"run/{signature}.pkl")
+        if not isinstance(payload, dict):
+            return None
+        return payload.get("result")
+
+    def store_run(self, signature: str, result) -> None:
+        self._store(f"run/{signature}.pkl", {"result": result})

@@ -239,20 +239,28 @@ def _display_path(path: pathlib.Path) -> str:
 
 
 def _rule_modules():
-    from . import (rules_alloc, rules_exc, rules_lock, rules_obs,
-                   rules_res, rules_tpu)
+    from . import (rules_alloc, rules_async, rules_exc, rules_lock,
+                   rules_obs, rules_race, rules_res, rules_tpu)
 
     return (rules_exc, rules_tpu, rules_res, rules_alloc, rules_obs,
-            rules_lock)
+            rules_lock, rules_race, rules_async)
 
 
-def _parse_contexts(paths: Sequence[str]):
+def _parse_contexts(paths: Sequence[str], cache=None):
     """Parse every requested file ONCE (the project AST cache).  Returns
     ``(contexts, syntax_violations)`` — unparsable files are reported as
-    FL-SYNTAX and excluded from the project pass."""
+    FL-SYNTAX and excluded from the project pass.  With a
+    :class:`~parquet_floor_tpu.analysis.cache.LintCache`, unchanged
+    files load their pickled FileContext instead of re-parsing (the
+    incremental context tier — rules still run project-wide)."""
     contexts: List[FileContext] = []
     broken: List[Violation] = []
     for path in iter_python_files(paths):
+        if cache is not None:
+            hit = cache.load_context(path)
+            if hit is not None:
+                contexts.append(hit)
+                continue
         rel = _display_path(path)
         src = path.read_text()
         try:
@@ -261,15 +269,18 @@ def _parse_contexts(paths: Sequence[str]):
             broken.append(Violation(rel, e.lineno or 1, "FL-SYNTAX",
                                     f"file does not parse: {e.msg}"))
             continue
-        contexts.append(FileContext(path, rel, src, tree))
+        ctx = FileContext(path, rel, src, tree)
+        contexts.append(ctx)
+        if cache is not None:
+            cache.store_context(path, ctx)
     return contexts, broken
 
 
 def _check_context(ctx: FileContext, project):
     """All rules over one file against the shared project; returns
-    ``(kept, suppressed_count)`` with directives applied."""
+    ``(kept, suppressed_rule_ids)`` with directives applied."""
     kept: List[Violation] = []
-    suppressed = 0
+    suppressed: List[str] = []
     seen = set()
     for mod in _rule_modules():
         for found in mod.check(ctx, project):
@@ -280,7 +291,7 @@ def _check_context(ctx: FileContext, project):
                 continue
             seen.add(key)
             if ctx.suppressed(rule, line):
-                suppressed += 1
+                suppressed.append(rule)
             else:
                 kept.append(Violation(ctx.rel, line, rule, message,
                                       chain=chain,
@@ -318,6 +329,12 @@ class RunResult:
     #: ``--update-baseline`` snapshots (suppressed lines excluded: they
     #: are already accepted in-code)
     all_kept: List[Violation] = field(default_factory=list)
+    #: True when this verdict came whole from the incremental cache's
+    #: run tier (no file changed since it was stored)
+    from_cache: bool = False
+    #: rule ids of directive-suppressed findings (len == ``suppressed``)
+    #: — per-family accounting for ``scripts/lint.py``
+    suppressed_rules: List[str] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -325,17 +342,26 @@ class RunResult:
 
 
 def run(paths: Sequence[str],
-        baseline: Optional[Counter] = None) -> RunResult:
-    contexts, broken = _parse_contexts(paths)
+        baseline: Optional[Counter] = None,
+        cache=None) -> RunResult:
+    files = list(iter_python_files(paths))
+    signature = None
+    if cache is not None:
+        signature = cache.run_signature(files, baseline)
+        hit = cache.load_run(signature)
+        if isinstance(hit, RunResult):
+            hit.from_cache = True
+            return hit
+    contexts, broken = _parse_contexts(files, cache)
     project = build_project(contexts)
     reported: List[Violation] = []
     all_kept: List[Violation] = list(broken)
-    suppressed = 0
+    suppressed_rules: List[str] = []
     baselined = 0
     remaining = Counter(baseline or ())
     for ctx in contexts:
-        kept, n_suppressed = _check_context(ctx, project)
-        suppressed += n_suppressed
+        kept, ctx_suppressed = _check_context(ctx, project)
+        suppressed_rules.extend(ctx_suppressed)
         all_kept.extend(kept)
     for v in broken + sorted(
         all_kept[len(broken):], key=lambda v: (v.path, v.line, v.rule)
@@ -352,8 +378,12 @@ def run(paths: Sequence[str],
             reported.append(v)
     stale = sum(remaining.values())
     reported.sort(key=lambda v: (v.path, v.line, v.rule))
-    return RunResult(reported, suppressed, baselined,
-                     len(contexts) + len(broken), stale, all_kept)
+    result = RunResult(reported, len(suppressed_rules), baselined,
+                       len(contexts) + len(broken), stale, all_kept,
+                       suppressed_rules=suppressed_rules)
+    if cache is not None and signature is not None:
+        cache.store_run(signature, result)
+    return result
 
 
 def load_baseline(path: pathlib.Path) -> Counter:

@@ -1,0 +1,293 @@
+"""FL-ASYNC — event-loop protection for the asyncio serving fabric.
+
+The serve daemon (PR 15) and the fleet fabric (PR 16) put an asyncio
+event loop at the front of every request: one coroutine that blocks
+the loop stalls EVERY connection on that host, which is why the daemon
+offloads all real work through ``loop.run_in_executor(pool, fn, ...)``
+— the exemplar good shape these rules enforce:
+
+* **FL-ASYNC001** — no blocking sinks in coroutine context:
+  ``time.sleep``, ``open()``/file I/O, socket verbs, ``fcntl.flock``,
+  storage reads (``Source.read_at/read_many/load``, ``.get_range``),
+  ``.result()`` on futures, ``.acquire()``/``.wait()`` on threading
+  primitives and thread ``.join()`` — direct, or buried in a *sync*
+  helper the coroutine calls (followed through the bounded-BFS call
+  graph, reported at the first-hop call with the chain).  Work handed
+  to ``run_in_executor``/``to_thread`` is the blessed escape: the
+  callable is a reference there, not a call, so the graph naturally
+  never follows it into the coroutine's execution context.
+* **FL-ASYNC002** — no ``await`` while holding a *threading* lock (the
+  dual of FL-LOCK002): the coroutine parks at the await with the lock
+  held, and every pool worker contending on that lock now waits on the
+  loop's scheduling — the loop starves its own executor.  ``async
+  with`` on asyncio locks is fine and never matches (the registry only
+  knows ``threading`` constructors).
+* **FL-ASYNC003** — a call that resolves to an ``async def`` used as a
+  bare statement never runs: a coroutine object is created and
+  dropped (the silent-no-op bug class).  ``await``, ``create_task``/
+  ``gather``/any wrapping call, and assignment for a later await all
+  pass.
+
+Awaited calls are never sinks (``await loop.sock_connect(...)``,
+``await ev.wait()`` on an asyncio Event are the loop-friendly
+spellings).  Blind spots (documented): blocking calls behind
+unresolved edges (dynamic dispatch), thread ``.join()`` on receivers
+whose name does not look thread-like, and coroutine objects stored
+then never awaited.
+
+Scope: package code (``parquet_floor_tpu/``) — async defs only exist
+in the serving fabric today, but the rules key on ``async def``
+syntax, not paths, so new loops are covered the day they land.
+Fixtures opt in via ``# floorlint: scope=FL-ASYNC``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from .core import FileContext, dotted, last_part
+from .project import CALL_DEPTH, Project, short
+
+RULES = [
+    ("FL-ASYNC001",
+     "no blocking calls (sleep, file/socket I/O, flock, storage reads, "
+     "future.result, threading acquire/wait/join) in coroutine context — "
+     "computed over the call graph; offload through run_in_executor/"
+     "to_thread like the serve daemon"),
+    ("FL-ASYNC002",
+     "no await while holding a threading lock — the parked coroutine "
+     "keeps the lock and the loop starves every worker contending on it"),
+    ("FL-ASYNC003",
+     "a coroutine called as a bare statement never runs — await it or "
+     "schedule it with create_task/gather"),
+]
+
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "subprocess": "subprocess",
+    "socket": "socket I/O",
+    "urllib.request.urlopen": "urlopen",
+    "fcntl.flock": "fcntl.flock",
+    "fcntl.lockf": "fcntl.lockf",
+}
+_BLOCKING_OS = {"pread", "read", "write", "fsync", "sendfile"}
+_BLOCKING_ATTRS = {
+    "read_at": "storage read",
+    "read_many": "storage read",
+    "load": "storage read",
+    "get_range": "remote storage read",
+    "result": "future .result()",
+    "shutdown": "pool shutdown",
+    "recv": "socket recv",
+    "recv_into": "socket recv",
+    "sendall": "socket send",
+    "connect": "socket connect",
+    "accept": "socket accept",
+}
+_THREADLIKE = re.compile(r"thread|worker|proc", re.IGNORECASE)
+
+
+def _blocking_shape(project: Project, info, ctx: FileContext,
+                    call: ast.Call) -> Optional[str]:
+    """Label of the blocking operation ``call`` performs in coroutine
+    context, or None.  ``info``/``ctx`` belong to the function whose
+    body the call sits in (aliases and lock identity are per-file)."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        target = project.aliases.get(ctx, {}).get(f.id, f.id)
+        if f.id == "open" or target == "io.open":
+            return "open()"
+        if target == "time.sleep":
+            return "time.sleep"
+        return None
+    path = dotted(f)
+    if path is not None:
+        for prefix, label in _BLOCKING_DOTTED.items():
+            if path == prefix or path.startswith(prefix + "."):
+                return label
+        root, _, rest = path.partition(".")
+        if root == "os" and rest in _BLOCKING_OS:
+            return f"os.{rest}"
+    attr = last_part(f)
+    if attr in ("acquire", "wait") and isinstance(f, ast.Attribute):
+        lk = project.lock_id(info, ctx, f.value)
+        if lk is not None:
+            return f"threading {lk.render()}.{attr}()"
+        return None
+    if attr == "join" and isinstance(f, ast.Attribute):
+        recv = dotted(f.value)
+        if recv is not None and _THREADLIKE.search(recv):
+            return f"thread {recv}.join()"
+        return None
+    if attr in _BLOCKING_ATTRS:
+        return f"{_BLOCKING_ATTRS[attr]} .{attr}()"
+    return None
+
+
+def _walk_own(root: ast.AST):
+    """Walk a function body without descending into nested defs or
+    lambdas (they run on their own schedule — often exactly the
+    executor-offload escape)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scan_blocking(project: Project, callee) -> List[Tuple[int, str]]:
+    """Blocking shapes in one SYNC callee body, for the chained pass
+    (memoized — chained scans revisit hot helpers)."""
+    cache = project.__dict__.setdefault("_async_blocking_cache", {})
+    hit = cache.get(id(callee.node))
+    if hit is not None:
+        return hit
+    out: List[Tuple[int, str]] = []
+    for node in _walk_own(callee.node):
+        if isinstance(node, ast.Call):
+            label = _blocking_shape(project, callee, callee.ctx, node)
+            if label is not None:
+                out.append((node.lineno, label))
+    cache[id(callee.node)] = out
+    return out
+
+
+def _async_defs(project: Project, ctx: FileContext):
+    for node in ctx.nodes:
+        if isinstance(node, ast.AsyncFunctionDef):
+            yield node, project.function_at(ctx, node)
+
+
+def _is_awaited(ctx: FileContext, call: ast.Call) -> bool:
+    return isinstance(ctx.parents.get(call), ast.Await)
+
+
+# -- FL-ASYNC001 --------------------------------------------------------------
+
+
+def _check_async001(project: Project, ctx: FileContext):
+    for fn_node, info in _async_defs(project, ctx):
+        reported = set()
+        for node in _walk_own(fn_node):
+            if not isinstance(node, ast.Call) or _is_awaited(ctx, node):
+                continue
+            label = _blocking_shape(project, info, ctx, node)
+            if label is not None:
+                yield (node.lineno, "FL-ASYNC001",
+                       f"{label} in coroutine `{fn_node.name}` blocks "
+                       "the event loop — every connection on this host "
+                       "stalls; offload through run_in_executor/"
+                       "to_thread")
+                continue
+            if info is None:
+                continue
+            qual = project.resolve_call(
+                info, node, project.partials_of(info)
+            )
+            if qual is None:
+                continue
+            root = project.functions[qual]
+            if isinstance(root.node, ast.AsyncFunctionDef):
+                continue  # a coroutine call is FL-ASYNC003's domain
+            targets = [(root, (fn_node.name, short(qual)))]
+            targets.extend(
+                (fi, (fn_node.name, short(qual)) + chain[1:])
+                for fi, chain, _l in project.walk_calls(
+                    root, depth=CALL_DEPTH - 1
+                )
+                if not isinstance(fi.node, ast.AsyncFunctionDef)
+            )
+            for callee, chain in targets:
+                for bl_line, label in _scan_blocking(project, callee):
+                    key = (node.lineno, label, chain[-1])
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    yield (node.lineno, "FL-ASYNC001",
+                           f"{label} reachable from coroutine "
+                           f"`{fn_node.name}` via {' -> '.join(chain)} "
+                           f"({callee.ctx.rel}:{bl_line}) — a sync "
+                           "helper that blocks stalls the loop exactly "
+                           "like inline blocking; offload the call "
+                           "through run_in_executor/to_thread",
+                           chain)
+
+
+# -- FL-ASYNC002 --------------------------------------------------------------
+
+
+def _check_async002(project: Project, ctx: FileContext):
+    for fn_node, info in _async_defs(project, ctx):
+        for node in _walk_own(fn_node):
+            if not isinstance(node, ast.With):
+                continue
+            locks = [
+                project.lock_id(info, ctx, item.context_expr)
+                for item in node.items
+            ]
+            locks = [lk for lk in locks if lk is not None]
+            if not locks:
+                continue
+            for stmt in node.body:
+                for sub in _walk_stmts_own(stmt):
+                    if isinstance(sub, ast.Await):
+                        yield (sub.lineno, "FL-ASYNC002",
+                               f"await while holding threading lock "
+                               f"{locks[0].render()} — the coroutine "
+                               "parks with the lock held and every "
+                               "pool worker contending on it now waits "
+                               "on the loop; release before awaiting, "
+                               "or use an asyncio.Lock")
+
+
+def _walk_stmts_own(root: ast.AST):
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# -- FL-ASYNC003 --------------------------------------------------------------
+
+
+def _check_async003(project: Project, ctx: FileContext):
+    for node in ctx.nodes:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info = project.function_at(ctx, node)
+        if info is None:
+            continue
+        partials = project.partials_of(info)
+        for sub in _walk_own(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if not isinstance(ctx.parents.get(sub), ast.Expr):
+                continue  # awaited / wrapped / assigned for later
+            qual = project.resolve_call(info, sub, partials)
+            if qual is None:
+                continue
+            callee = project.functions[qual]
+            if isinstance(callee.node, ast.AsyncFunctionDef):
+                yield (sub.lineno, "FL-ASYNC003",
+                       f"coroutine `{short(qual)}` called as a bare "
+                       "statement never runs — the coroutine object is "
+                       "created and dropped; await it or schedule it "
+                       "with create_task/gather")
+
+
+def check(ctx: FileContext, project: Project):
+    in_pkg = ctx.under("parquet_floor_tpu")
+    if not ctx.in_scope("FL-ASYNC", in_pkg):
+        return
+    yield from _check_async001(project, ctx)
+    yield from _check_async002(project, ctx)
+    yield from _check_async003(project, ctx)

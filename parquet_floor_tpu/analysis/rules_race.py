@@ -1,0 +1,533 @@
+"""FL-RACE — RacerD-style lockset race detection for the serving fabric.
+
+The FL-LOCK family (PR 10) checks lock *hygiene*: with-managed
+acquires, no blocking under a lock, consistent ordering.  It never
+answers the question that actually bites a fleet under load: *is this
+shared field ever touched without its guard?*  These rules infer a
+per-field guard from how the code itself uses its locks, then flag the
+accesses that escape it:
+
+* **FL-RACE001** — a class field whose writes are guarded by one
+  ``self``-attached lock (``with self._lock: self.field = ...`` on >= 2
+  distinct sites, or on one site inside a method reachable from a
+  thread entry point) acquires that lock as its **inferred guard**;
+  any read or write of the field outside an acquisition of the guard
+  is flagged, with the thread-entry call chain in the message when the
+  accessing method is thread-reachable.
+* **FL-RACE002** — check-then-act: an ``if`` whose test *reads* a
+  guarded field and whose branch *writes* it, without the guard held
+  across the whole statement.  Taking the lock only around the write
+  (or only around the read) leaves the classic lost-update window —
+  the sequence must be atomic, not its halves.
+
+**Thread entries** are inferred from the spawn shapes the package
+uses: ``Thread(target=fn)``, ``pool.submit(fn, ...)``,
+``loop.run_in_executor(pool, fn, ...)``, ``asyncio.to_thread(fn)``,
+``start_server(handler)`` and ``call_soon_threadsafe(fn)`` — each
+resolved through the project call graph, then closed over
+:data:`~parquet_floor_tpu.analysis.project.CALL_DEPTH` hops.
+
+**Lock context is inter-procedural** in the suppressing direction: a
+helper whose every *resolved* call site sits inside ``with
+self._lock`` is analyzed as holding that lock (the ``_locked``-helper
+idiom), so moving guarded code into a private method does not
+fabricate findings.
+
+**Blessed escapes** (all pinned by fixtures):
+
+* ``__init__``-only writes — construction happens before publication;
+* assign-once-after-init — a field with at most ONE post-init write
+  site is an immutable-after-publish value (the epoch-fenced
+  membership-snapshot pattern): the publish is atomic in CPython and
+  readers see either the old or the new snapshot, never a torn one;
+* ``# floorlint: unguarded=<why>`` on the field's write (or the line
+  above) — a justified opt-out, e.g. a field owned by one event-loop
+  thread; every live-tree use gets a rationale row in
+  ``docs/static_analysis.md``'s suppression table.
+
+Blind spots (documented, deliberate): accesses through receivers other
+than ``self`` (``other._field``), fields of nested functions, guard
+locks held via bare ``acquire()`` (FL-LOCK001 forces ``with`` anyway),
+module-global guards, and call sites the graph cannot resolve (a
+helper with one unresolved caller loses its inherited lock context —
+under-approximate both ways).
+
+Scope: the concurrency-bearing subtrees — ``serve/``, ``io/``,
+``scan/``, ``tpu/`` and ``utils/trace.py``.  Fixtures opt in via
+``# floorlint: scope=FL-RACE``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import FileContext, ancestors, last_part
+from .project import CALL_DEPTH, LockId, Project, short
+
+RULES = [
+    ("FL-RACE001",
+     "a lock-guarded class field (written under `with self._lock` on >=2 "
+     "sites, or once in a thread-reachable method) must never be read or "
+     "written outside an acquisition of its inferred guard"),
+    ("FL-RACE002",
+     "check-then-act on a guarded field must hold the guard across the "
+     "whole read-branch-write sequence, not drop it between the check "
+     "and the act"),
+]
+
+_UNGUARDED = re.compile(r"#\s*floorlint:\s*unguarded=\s*(\S[^#]*)")
+
+#: spawn-shape attribute calls whose N-th positional argument is the
+#: callable that runs on another thread / the event loop
+_SPAWN_ARG_INDEX = {
+    "submit": 0,
+    "run_in_executor": 1,
+    "to_thread": 0,
+    "start_server": 0,
+    "call_soon_threadsafe": 0,
+}
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    default = (
+        ctx.under("parquet_floor_tpu", "serve")
+        or ctx.under("parquet_floor_tpu", "io")
+        or ctx.under("parquet_floor_tpu", "scan")
+        or ctx.under("parquet_floor_tpu", "tpu")
+        or ctx.is_module("utils/trace.py")
+    )
+    return ctx.in_scope("FL-RACE", default)
+
+
+def _walk_own(root: ast.AST):
+    """Walk a function body WITHOUT descending into nested defs or
+    lambdas — their bodies run on their own schedule, not inline."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _lexical_locks(project: Project, ctx: FileContext, info,
+                   node: ast.AST, fn_node: ast.AST) -> Set[tuple]:
+    """Statically-known locks held around ``node`` inside ``fn_node``
+    (enclosing ``with`` regions, resolved through the lock registry)."""
+    held: Set[tuple] = set()
+    for anc in ancestors(ctx, node):
+        if anc is fn_node:
+            break
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                lk = project.lock_id(info, ctx, item.context_expr)
+                if lk is not None:
+                    held.add(tuple(lk))
+    return held
+
+
+# -- thread-entry inference ---------------------------------------------------
+
+
+def thread_roots(project: Project) -> Dict[str, str]:
+    """Functions handed to a spawn shape anywhere in the project:
+    ``qual -> spawn label`` (memoized on the project)."""
+    cached = getattr(project, "_thread_roots_cache", None)
+    if cached is not None:
+        return cached
+    roots: Dict[str, str] = {}
+    for info in project.functions.values():
+        partials = project.partials_of(info)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            ref = None
+            how = None
+            name = last_part(node.func)
+            if name == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        ref, how = kw.value, "Thread(target=)"
+            elif name in _SPAWN_ARG_INDEX:
+                i = _SPAWN_ARG_INDEX[name]
+                if len(node.args) > i:
+                    ref, how = node.args[i], f".{name}()"
+            if ref is None:
+                continue
+            qual = project._resolve_ref(info, ref, partials)
+            if qual is not None and qual in project.functions:
+                roots.setdefault(qual, how)
+    project._thread_roots_cache = roots
+    return roots
+
+
+def thread_reach(project: Project) -> Dict[str, Tuple[str, Tuple[str, ...]]]:
+    """Every function reachable from a thread entry:
+    ``qual -> (spawn label, chain from the entry)``."""
+    cached = getattr(project, "_thread_reach_cache", None)
+    if cached is not None:
+        return cached
+    reach: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
+    for qual, how in thread_roots(project).items():
+        info = project.functions[qual]
+        reach.setdefault(qual, (how, (short(qual),)))
+        for callee, chain, _line in project.walk_calls(info, CALL_DEPTH):
+            reach.setdefault(callee.qual, (how, chain))
+    project._thread_reach_cache = reach
+    return reach
+
+
+# -- inter-procedural lock context -------------------------------------------
+
+
+def _inherited_locks(project: Project) -> Dict[str, frozenset]:
+    """Locks provably held on EVERY resolved call path into each
+    function (intersection over call sites, two bounded rounds).  Used
+    only to SUPPRESS findings — the ``_locked``-helper idiom; a single
+    lock-free call site clears the context."""
+    cached = getattr(project, "_inherited_locks_cache", None)
+    if cached is not None:
+        return cached
+    sites: Dict[str, List[Tuple[str, frozenset]]] = {}
+    for info in project.functions.values():
+        partials = project.partials_of(info)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = project.resolve_call(info, node, partials)
+            if qual is None or qual == info.qual:
+                continue
+            held = frozenset(_lexical_locks(
+                project, info.ctx, info, node, info.node
+            ))
+            sites.setdefault(qual, []).append((info.qual, held))
+    inherited: Dict[str, frozenset] = {
+        q: frozenset() for q in project.functions
+    }
+    # Jacobi iteration to a fixpoint: one round propagates the context
+    # one call-hop deeper, so helper chains (`put -> _insert_locked ->
+    # _promote_locked -> _evict_locked`) need as many rounds as they
+    # are deep.  Locked-helper chains are short; the bound is a
+    # terminator for pathological (cyclic) shapes, not a budget.
+    for _round in range(8):
+        nxt: Dict[str, frozenset] = {}
+        for qual, callers in sites.items():
+            acc: Optional[frozenset] = None
+            for caller_qual, held in callers:
+                eff = held | inherited.get(caller_qual, frozenset())
+                acc = eff if acc is None else (acc & eff)
+            nxt[qual] = acc or frozenset()
+        if all(inherited.get(q) == v for q, v in nxt.items()):
+            break
+        inherited.update(nxt)
+    project._inherited_locks_cache = inherited
+    return inherited
+
+
+# -- per-class access model ---------------------------------------------------
+
+
+class _Access:
+    __slots__ = ("ctx", "line", "write", "locks", "method_qual",
+                 "method_name", "in_init", "node")
+
+    def __init__(self, ctx, line, write, locks, method_qual,
+                 method_name, node):
+        self.ctx = ctx
+        self.line = line
+        self.write = write
+        self.locks = locks
+        self.method_qual = method_qual
+        self.method_name = method_name
+        self.in_init = method_name == "__init__"
+        self.node = node
+
+
+#: method names that mutate their receiver in place — a
+#: ``self.field.add(x)`` is a WRITE of the field's state, exactly like
+#: ``self.field[k] = x`` (dicts/sets/lists are the dominant shared-state
+#: shape in the serving fabric)
+_MUTATORS = {
+    "append", "appendleft", "add", "clear", "discard", "extend",
+    "insert", "pop", "popitem", "popleft", "remove", "setdefault",
+    "update", "sort",
+}
+
+
+def _access_kind(ctx: FileContext, node: ast.Attribute) -> Optional[bool]:
+    """True = write, False = read, None = not a data access (a method
+    invocation).  Writes include direct stores/deletes, container-slot
+    stores (``self.f[k] = v``, ``del self.f[k]``) and in-place mutator
+    calls (``self.f.add(x)``)."""
+    parent = ctx.parents.get(node)
+    if isinstance(parent, ast.Call) and parent.func is node:
+        return None  # a method/callable invocation, not data
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return True
+    if isinstance(parent, ast.Subscript) and parent.value is node and \
+            isinstance(parent.ctx, (ast.Store, ast.Del)):
+        return True
+    if isinstance(parent, ast.Attribute) and parent.value is node and \
+            parent.attr in _MUTATORS:
+        gp = ctx.parents.get(parent)
+        if isinstance(gp, ast.Call) and gp.func is parent:
+            return True
+    return False
+
+
+def _class_accesses(project: Project, ctx: FileContext, cls,
+                    inherited) -> Dict[str, List[_Access]]:
+    """Every ``self.<field>`` data access in the class's own methods,
+    with the effective lockset (lexical + inherited) at each site."""
+    fields: Dict[str, List[_Access]] = {}
+    for mname, info in cls.methods.items():
+        inh = inherited.get(info.qual, frozenset())
+        for node in _walk_own(info.node):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if not (isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                continue
+            attr = node.attr
+            if attr in cls.methods or attr in cls.lock_attrs:
+                continue
+            write = _access_kind(ctx, node)
+            if write is None:
+                continue
+            locks = frozenset(_lexical_locks(
+                project, ctx, info, node, info.node
+            )) | inh
+            fields.setdefault(attr, []).append(_Access(
+                ctx, node.lineno, write, locks, info.qual, mname, node
+            ))
+    return fields
+
+
+def _blessed_fields(ctx: FileContext, cls_node: ast.ClassDef
+                    ) -> Dict[str, str]:
+    """Fields opted out via ``# floorlint: unguarded=<why>`` on (or the
+    line above) a line naming the field inside the class body."""
+    blessed: Dict[str, str] = {}
+    end = min(cls_node.end_lineno or cls_node.lineno, len(ctx.lines))
+    for i in range(cls_node.lineno, end + 1):
+        line = ctx.lines[i - 1]
+        m = _UNGUARDED.search(line)
+        if not m:
+            continue
+        code = line.split("#", 1)[0]
+        if not code.strip() and i < len(ctx.lines):
+            code = ctx.lines[i]  # standalone comment blesses next line
+        fm = (re.search(r"self\.(\w+)", code)
+              or re.match(r"\s*(\w+)\s*[:=]", code))
+        if fm:
+            blessed[fm.group(1)] = m.group(1).strip()
+    return blessed
+
+
+def _infer_guard(accesses: List[_Access], reach
+                 ) -> Optional[Tuple[tuple, int, int]]:
+    """The inferred guard for one field: ``(lock, locked_write_sites,
+    total_write_sites)`` or None (unguarded / blessed-by-shape)."""
+    writes = [a for a in accesses if a.write and not a.in_init]
+    if not writes:
+        return None  # never mutated after construction
+    write_sites = {(a.ctx.rel, a.line) for a in writes}
+    if len(write_sites) <= 1:
+        return None  # assign-once-after-init: immutable-after-publish
+    counts: Dict[tuple, Set[tuple]] = {}
+    for a in writes:
+        for lk in a.locks:
+            if lk[0] == "attr":
+                counts.setdefault(lk, set()).add((a.ctx.rel, a.line))
+    if not counts:
+        return None
+    guard = max(counts, key=lambda lk: len(counts[lk]))
+    n_sites = len(counts[guard])
+    if n_sites >= 2:
+        return guard, n_sites, len(write_sites)
+    for a in writes:
+        if guard in a.locks and a.method_qual in reach:
+            return guard, n_sites, len(write_sites)
+    return None
+
+
+# -- the project-wide pass ----------------------------------------------------
+
+
+def race_model(project: Project):
+    """Findings per file plus the inferred-guard map (for tests):
+    ``(findings: {ctx: [(line, rule, msg, chain)]},
+    guards: {cls_qual: {field: LockId}})``.  Computed once per project."""
+    cached = getattr(project, "_race_cache", None)
+    if cached is not None:
+        return cached
+    findings: Dict[object, List[tuple]] = {}
+    guards_out: Dict[str, Dict[str, LockId]] = {}
+    reach = thread_reach(project)
+    inherited = _inherited_locks(project)
+    for cls in project.classes.values():
+        ctx = project.by_module.get(cls.module)
+        if ctx is None or not _in_scope(ctx):
+            continue
+        fields = _class_accesses(project, ctx, cls, inherited)
+        blessed = _blessed_fields(ctx, cls.node)
+        guards: Dict[str, tuple] = {}
+        for field, accs in fields.items():
+            if field in blessed:
+                continue
+            g = _infer_guard(accs, reach)
+            if g is not None:
+                guards[field] = g
+                guards_out.setdefault(cls.qual, {})[field] = LockId(g[0])
+        out = findings.setdefault(ctx, [])
+        _emit_race001(project, cls, fields, guards, reach, out)
+        _emit_race002(project, ctx, cls, guards, inherited, out)
+        _emit_race002_writer(ctx, cls, fields, guards, blessed, out)
+    project._race_cache = (findings, guards_out)
+    return project._race_cache
+
+
+def _emit_race001(project, cls, fields, guards, reach, out) -> None:
+    cname = cls.qual.rsplit(".", 1)[-1]
+    for field, (guard, n_locked, n_writes) in guards.items():
+        render = LockId(guard).render()
+        seen_lines: Set[int] = set()
+        for a in fields[field]:
+            if a.in_init or guard in a.locks or a.line in seen_lines:
+                continue
+            seen_lines.add(a.line)
+            verb = "write to" if a.write else "read of"
+            msg = (f"{verb} {cname}.{field} without its inferred guard "
+                   f"{render} (the field is written under {render} at "
+                   f"{n_locked} of {n_writes} sites)")
+            chain: Tuple[str, ...] = ()
+            hit = reach.get(a.method_qual)
+            if hit is not None:
+                how, chain = hit
+                msg += (f" — reachable from thread entry {how} via "
+                        f"{' -> '.join(chain)}")
+            msg += ("; hold the guard, or bless the field with "
+                    "`# floorlint: unguarded=<why>`")
+            out.append((a.line, "FL-RACE001", msg, chain))
+
+
+def _emit_race002(project, ctx, cls, guards, inherited, out) -> None:
+    cname = cls.qual.rsplit(".", 1)[-1]
+    for mname, info in cls.methods.items():
+        inh = inherited.get(info.qual, frozenset())
+        for node in _walk_own(info.node):
+            if not isinstance(node, ast.If):
+                continue
+            test_reads = {
+                sub.attr for sub in ast.walk(node.test)
+                if isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+                and isinstance(sub.ctx, ast.Load)
+                and sub.attr in guards
+            }
+            if not test_reads:
+                continue
+            held = frozenset(_lexical_locks(
+                project, ctx, info, node, info.node
+            )) | inh
+            for field in sorted(test_reads):
+                guard = guards[field][0]
+                if guard in held:
+                    continue  # the whole check-then-act is atomic
+                wrote = any(
+                    isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"
+                    and sub.attr == field
+                    and _access_kind(ctx, sub) is True
+                    for stmt in node.body + node.orelse
+                    for sub in ast.walk(stmt)
+                )
+                if not wrote:
+                    continue
+                render = LockId(guard).render()
+                out.append((
+                    node.lineno, "FL-RACE002",
+                    f"check-then-act on {cname}.{field}: the test reads "
+                    f"it and the branch writes it, but {render} is not "
+                    "held across the whole sequence — the window between "
+                    "check and act loses updates; take the guard around "
+                    "the if, not just the write", (),
+                ))
+
+
+def _under_test(ctx: FileContext, node: ast.AST) -> bool:
+    """Is ``node`` inside the condition of an ``if``/``while``/ternary
+    — i.e. does this read DECIDE something?"""
+    child, p = node, ctx.parents.get(node)
+    while p is not None and not isinstance(
+            p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        if isinstance(p, (ast.If, ast.While, ast.IfExp)) \
+                and child is p.test:
+            return True
+        child, p = p, ctx.parents.get(p)
+    return False
+
+
+def _emit_race002_writer(ctx, cls, fields, guards, blessed, out) -> None:
+    """The writer-side check-then-act arm: a function that WRITES field
+    F under lock L, but whose decision to write rests on a read of F
+    taken OUTSIDE L — and the guarded region never re-checks.  Applies
+    precisely to the fields the assign-once escape blesses (guarded
+    fields' unlocked reads are FL-RACE001's domain): the snapshot
+    pattern makes *readers* safe, but the writer's own monotonicity /
+    existence check must still be atomic with the write.  A re-check of
+    F under L (double-checked locking) makes the sequence atomic and is
+    never flagged."""
+    cname = cls.qual.rsplit(".", 1)[-1]
+    for field, accs in fields.items():
+        if field in blessed or field in guards:
+            continue
+        by_method: Dict[str, List[_Access]] = {}
+        for a in accs:
+            if not a.in_init:
+                by_method.setdefault(a.method_qual, []).append(a)
+        for m_accs in by_method.values():
+            locks = {
+                lk for a in m_accs if a.write
+                for lk in a.locks if lk[0] == "attr"
+            }
+            for guard in sorted(locks):
+                w_lines = [a.line for a in m_accs
+                           if a.write and guard in a.locks]
+                rechecks = [a.line for a in m_accs
+                            if not a.write and guard in a.locks]
+                for a in m_accs:
+                    if a.write or guard in a.locks:
+                        continue
+                    if not _under_test(ctx, a.node):
+                        continue
+                    later = [w for w in w_lines if w > a.line]
+                    if not later:
+                        continue
+                    if any(a.line < r <= max(later) for r in rechecks):
+                        continue  # double-checked: re-validated under L
+                    render = LockId(guard).render()
+                    out.append((
+                        a.line, "FL-RACE002",
+                        f"check-then-act on {cname}.{field}: this read "
+                        f"decides a write performed under {render} at "
+                        f"line {later[0]}, but the check runs outside "
+                        "the lock and the guarded region never "
+                        "re-checks — two concurrent callers can both "
+                        "pass and commit in either order; take the "
+                        "guard around the check, or re-validate under "
+                        "it", (),
+                    ))
+
+
+def check(ctx: FileContext, project: Project):
+    if not _in_scope(ctx):
+        return
+    findings, _guards = race_model(project)
+    yield from findings.get(ctx, [])

@@ -1,0 +1,79 @@
+"""SARIF 2.1.0 output for floorlint (``--format=sarif``).
+
+One run, one driver (``floorlint``), every registered rule in
+``tool.driver.rules`` (CI annotates findings by ``ruleIndex``), one
+``result`` per violation.  The resolved call chain of graph-aware
+findings (FL-TPU chain mode, FL-LOCK, FL-RACE, FL-ASYNC) rides in
+``relatedLocations`` — one entry per hop, in root→sink order, the hop's
+function name as the location message.  floorlint chains carry hop
+*names* (the chain is a call-graph path, not a token stream), so each
+hop anchors to the violation's own artifact; the message text is the
+round-trippable payload.
+
+Schema shape is pinned by ``test_floorlint.py::test_cli_sarif_format``:
+version string, driver rules, result/location/region nesting, and the
+chain round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def _location(path: str, line: int, message: str = "") -> dict:
+    loc = {
+        "physicalLocation": {
+            "artifactLocation": {"uri": path},
+            "region": {"startLine": line},
+        },
+    }
+    if message:
+        loc["message"] = {"text": message}
+    return loc
+
+
+def to_sarif(result, all_rules) -> dict:
+    """The SARIF document for one :class:`RunResult` — ``all_rules`` is
+    the ``(id, doc)`` registry (``analysis.ALL_RULES`` plus the
+    synthetic FL-SYNTAX arm for unparsable files)."""
+    rules: List[dict] = [
+        {
+            "id": rule,
+            "shortDescription": {"text": doc},
+            "defaultConfiguration": {"level": "error"},
+        }
+        for rule, doc in all_rules
+    ]
+    index = {r["id"]: i for i, r in enumerate(rules)}
+    results: List[dict] = []
+    for v in result.violations:
+        entry = {
+            "ruleId": v.rule,
+            "level": "error",
+            "message": {"text": v.message},
+            "locations": [_location(v.path, v.line)],
+        }
+        if v.rule in index:
+            entry["ruleIndex"] = index[v.rule]
+        if v.chain:
+            entry["relatedLocations"] = [
+                _location(v.path, v.line, hop) for hop in v.chain
+            ]
+        results.append(entry)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "floorlint",
+                    "informationUri": "docs/static_analysis.md",
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
+    }

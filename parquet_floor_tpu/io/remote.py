@@ -252,6 +252,7 @@ class CircuitBreaker:
     def on_failure(self) -> None:
         with self._lock:
             self._failures += 1
+            failures = self._failures  # snapshot for the unlocked trace
             if self._probing:
                 # the half-open probe failed: re-open for a fresh cooldown
                 self._opened_at = self._clock()
@@ -269,7 +270,7 @@ class CircuitBreaker:
             trace.count("io.remote.breaker_trips")
             trace.decision("io.breaker", {
                 "path": self.name, "state": "open",
-                "consecutive_failures": self._failures,
+                "consecutive_failures": failures,
                 "cooldown_s": self.cooldown_s,
             })
         elif reopened:

@@ -353,11 +353,14 @@ class FleetCache:
         its peer endpoints.  ``peers`` maps member id to a PeerClient
         or a ``(host, port)`` pair; entries for members not in the new
         membership — and replaced clients — are closed here."""
-        if membership.epoch < self._membership.epoch:
-            raise ValueError(
-                f"membership epoch moved backwards: "
-                f"{membership.epoch} < {self._membership.epoch}")
         with self._admin_lock:
+            # the monotonicity check must be atomic with the install:
+            # two concurrent installs that both pass an unlocked check
+            # can commit in either order and move the epoch backwards
+            if membership.epoch < self._membership.epoch:
+                raise ValueError(
+                    f"membership epoch moved backwards: "
+                    f"{membership.epoch} < {self._membership.epoch}")
             old = self._peers
             if peers is not None:
                 fresh: Dict[str, PeerClient] = {}

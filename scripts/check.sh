@@ -1,10 +1,14 @@
 #!/usr/bin/env bash
 # The whole commit gate in one entry point:
 #   1. style lint + floorlint (scripts/lint.py runs both; floorlint's
-#      project pass prints its wall time and FAILS over its budget —
-#      PFTPU_FLOORLINT_BUDGET_S, default 30 s — so a quadratic
-#      regression in the call-graph engine breaks this gate, not the
-#      commit loop's patience)
+#      project pass — FL-RACE/FL-ASYNC concurrency rules included —
+#      runs twice against .floorlint_cache/, prints per-family counts
+#      plus first/warm wall times, and FAILS over its budgets:
+#      PFTPU_FLOORLINT_BUDGET_S (default 30 s) for the analyzing run,
+#      PFTPU_FLOORLINT_WARM_S (default 5 s) for the warm incremental
+#      run — so a quadratic regression in the call-graph engine OR a
+#      broken cache keying breaks this gate, not the commit loop's
+#      patience)
 #   2. tier-1 pytest (the ROADMAP.md verify recipe)
 # Usage: scripts/check.sh [extra pytest args]
 set -uo pipefail

@@ -34,6 +34,9 @@ MAX_LINE = 100
 # regression (an uncached per-rule re-walk, an unbounded traversal)
 # before it rots the commit loop.  ~5 s on the dev container today.
 FLOORLINT_BUDGET_S = float(os.environ.get("PFTPU_FLOORLINT_BUDGET_S", "30"))
+# the warm incremental run must be a cache hit: a stat walk plus one
+# unpickle.  5 s is ~20x headroom on the dev container (~0.3 s today).
+FLOORLINT_WARM_BUDGET_S = float(os.environ.get("PFTPU_FLOORLINT_WARM_S", "5"))
 
 
 def python_files():
@@ -130,26 +133,66 @@ def run_builtin() -> int:
     return 1 if problems else 0
 
 
+def _family(rule: str) -> str:
+    return rule.rstrip("0123456789")
+
+
 def run_floorlint() -> int:
     """The invariant analyzer rides the same gate (its own CLI for use in
     editors: ``python -m parquet_floor_tpu.analysis --list-rules``).
-    Prints the pass's wall time and fails when it blows the budget —
-    findings and runtime are both part of the contract."""
+
+    Runs in-process, TWICE against the ``.floorlint_cache/`` incremental
+    cache: the first pass re-analyzes whatever changed (cold = everything
+    on a fresh checkout), the second must be a run-tier cache hit.  Both
+    walls print; the first is gated by ``PFTPU_FLOORLINT_BUDGET_S``, the
+    warm one by the 5 s incremental ceiling (``PFTPU_FLOORLINT_WARM_S``)
+    — findings, per-family counts, and runtime are all part of the
+    contract."""
+    sys.path.insert(0, str(ROOT))
+    from parquet_floor_tpu.analysis import ALL_RULES, load_baseline
+    from parquet_floor_tpu.analysis import run as floorlint_run
+    from parquet_floor_tpu.analysis.cache import LintCache
+
+    targets = [str(ROOT / t) for t in FLOORLINT_TARGETS]
+    baseline = load_baseline(ROOT / "floorlint.baseline")
+    cache = LintCache(ROOT / ".floorlint_cache")
+
     t0 = time.perf_counter()
-    rc = subprocess.call(
-        [sys.executable, "-m", "parquet_floor_tpu.analysis",
-         *FLOORLINT_TARGETS],
-        cwd=ROOT,
-    )
-    wall = time.perf_counter() - t0
-    print(f"floorlint wall time: {wall:.2f}s "
-          f"(budget {FLOORLINT_BUDGET_S:.0f}s)")
-    if wall > FLOORLINT_BUDGET_S:
+    result = floorlint_run(targets, baseline=baseline, cache=cache)
+    first_wall = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    warm = floorlint_run(targets, baseline=baseline, cache=cache)
+    warm_wall = time.perf_counter() - t1
+
+    for v in result.violations:
+        print(v.render())
+    found = {}
+    for v in result.violations:
+        found[_family(v.rule)] = found.get(_family(v.rule), 0) + 1
+    supp = {}
+    for rule in result.suppressed_rules:
+        supp[_family(rule)] = supp.get(_family(rule), 0) + 1
+    families = sorted({_family(rule) for rule, _ in ALL_RULES})
+    print("floorlint families: " + "  ".join(
+        f"{fam}={found.get(fam, 0)}"
+        + (f"(+{supp[fam]} suppressed)" if fam in supp else "")
+        for fam in families))
+    label = "cached" if result.from_cache else "analyzed"
+    print(f"floorlint: {len(result.violations)} problem(s) in "
+          f"{result.files} file(s); first run {first_wall:.2f}s "
+          f"({label}, budget {FLOORLINT_BUDGET_S:.0f}s), warm run "
+          f"{warm_wall:.2f}s (budget {FLOORLINT_WARM_BUDGET_S:.0f}s)")
+    if first_wall > FLOORLINT_BUDGET_S:
         print("floorlint EXCEEDED its time budget — the project pass has "
               "regressed (uncached re-walk? unbounded traversal?); "
               "profile before raising PFTPU_FLOORLINT_BUDGET_S")
         return 1
-    return rc
+    if not warm.from_cache or warm_wall > FLOORLINT_WARM_BUDGET_S:
+        print("floorlint warm run was not an incremental cache hit within "
+              f"{FLOORLINT_WARM_BUDGET_S:.0f}s — the cache keying has "
+              "regressed (unstable signature? artifact store failing?)")
+        return 1
+    return 0 if result.ok else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,19 @@
+# floorlint: scope=FL-ASYNC
+"""Seeded-bad: blocking sinks in coroutine context — a direct
+``time.sleep`` in the handler, and a storage read buried in the sync
+helper the coroutine calls (reported at the call site with the
+chain)."""
+import time
+
+
+class Daemon:
+    def __init__(self, pool, source):
+        self._pool = pool
+        self._source = source
+
+    async def handle(self, req):
+        time.sleep(0.01)  # direct blocking sink on the loop
+        return self._execute(req)  # the helper blocks two frames down
+
+    def _execute(self, req):
+        return self._source.read_at(req.offset, req.length)

@@ -1,0 +1,20 @@
+# floorlint: scope=FL-ASYNC
+"""Seeded-good twin / FP pin: the serve daemon's executor-offload shape
+— the loop awaits ``asyncio.sleep`` and hands the blocking helper to
+``run_in_executor`` as a REFERENCE (never calling it in coroutine
+context), so the storage read inside it runs on a pool thread."""
+import asyncio
+
+
+class Daemon:
+    def __init__(self, pool, source):
+        self._pool = pool
+        self._source = source
+
+    async def handle(self, req):
+        await asyncio.sleep(0.01)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._pool, self._execute, req)
+
+    def _execute(self, req):
+        return self._source.read_at(req.offset, req.length)

@@ -1,0 +1,17 @@
+# floorlint: scope=FL-ASYNC
+"""Seeded-bad: ``await`` while holding a *threading* lock — the
+coroutine parks at the await with the lock held; every pool worker
+contending on it now waits on the event loop's scheduling."""
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []
+
+    async def flush(self, sink):
+        with self._lock:
+            batch = list(self._buf)
+            del self._buf[:]
+            await sink.send(batch)  # parked with the thread lock held

@@ -1,0 +1,16 @@
+# floorlint: scope=FL-ASYNC
+"""Seeded-good twin: snapshot under the threading lock, RELEASE, then
+await — the lock is never held across a suspension point."""
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []
+
+    async def flush(self, sink):
+        with self._lock:
+            batch = list(self._buf)
+            del self._buf[:]
+        await sink.send(batch)  # the lock was released before the await

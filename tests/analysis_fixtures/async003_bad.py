@@ -1,0 +1,13 @@
+# floorlint: scope=FL-ASYNC
+"""Seeded-bad: a coroutine invoked as a bare statement — the coroutine
+object is created and dropped, the body NEVER runs (the silent-no-op
+bug class)."""
+
+
+class Notifier:
+    async def _notify(self, peer, payload):
+        await peer.send(payload)
+
+    async def broadcast(self, peers, payload):
+        for peer in peers:
+            self._notify(peer, payload)  # never awaited: a silent no-op

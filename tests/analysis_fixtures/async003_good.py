@@ -1,0 +1,19 @@
+# floorlint: scope=FL-ASYNC
+"""Seeded-good twin: every coroutine invocation is awaited or scheduled
+— direct await, and fan-out through ``asyncio.gather`` (a wrapping call
+consumes the coroutine object)."""
+import asyncio
+
+
+class Notifier:
+    async def _notify(self, peer, payload):
+        await peer.send(payload)
+
+    async def broadcast(self, peers, payload):
+        for peer in peers:
+            await self._notify(peer, payload)
+
+    async def broadcast_parallel(self, peers, payload):
+        await asyncio.gather(
+            *(self._notify(peer, payload) for peer in peers)
+        )

@@ -1,0 +1,44 @@
+# floorlint: scope=FL-RACE
+"""Seeded-bad: a guarded field touched outside its inferred guard —
+the multi-site arm (written under the lock at two sites) and the
+thread-reachable arm (one locked write site inside a method handed to
+``Thread(target=)``)."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def add(self, n):
+        with self._lock:
+            self._count += n
+
+    def reset(self):
+        with self._lock:
+            self._count = 0
+
+    def bump_unlocked(self):
+        self._count += 1  # write outside the guard
+
+    def peek(self):
+        return self._count  # read outside the guard
+
+
+class Widget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "idle"
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        with self._lock:
+            self._state = "running"
+
+    def state(self):
+        return self._state  # read outside the thread-inferred guard

@@ -1,0 +1,41 @@
+# floorlint: scope=FL-RACE
+"""Seeded-good twin of race001: every access of the guarded fields —
+multi-site and thread-reachable alike — holds the inferred guard."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def add(self, n):
+        with self._lock:
+            self._count += n
+
+    def reset(self):
+        with self._lock:
+            self._count = 0
+
+    def peek(self):
+        with self._lock:
+            return self._count
+
+
+class Widget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "idle"
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        with self._lock:
+            self._state = "running"
+
+    def state(self):
+        with self._lock:
+            return self._state

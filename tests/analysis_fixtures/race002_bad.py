@@ -1,0 +1,37 @@
+# floorlint: scope=FL-RACE
+"""Seeded-bad: check-then-act with the guard dropped, both arms — the
+classic shape (an ``if`` reads a guarded field and its branch writes it,
+lock not held across the statement) and the writer-side shape (an
+unlocked read decides a write performed under the lock, with no
+re-check inside the guarded region)."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = {}
+
+    def add(self, key, item):
+        with self._lock:
+            self._slots.setdefault(key, []).append(item)
+
+    def drop(self, key):
+        with self._lock:
+            self._slots.pop(key, None)
+
+    def ensure(self, key):
+        if key not in self._slots:  # check runs unlocked...
+            self._slots[key] = []   # ...act writes: the lost-update window
+
+
+class Versioned:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snap = None
+
+    def install(self, snap):
+        if self._snap is not None and snap.epoch <= self._snap.epoch:
+            raise ValueError("stale epoch")
+        with self._lock:
+            self._snap = snap  # the check above never ran under this lock

@@ -1,0 +1,38 @@
+# floorlint: scope=FL-RACE
+"""Seeded-good twin of race002: the whole check-then-act sequence is
+atomic — the classic arm holds the guard around the ``if``, and the
+writer-side arm re-validates under the lock (double-checked locking:
+the unlocked read is an advisory fast path, the guarded region
+re-checks before acting)."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = {}
+
+    def add(self, key, item):
+        with self._lock:
+            self._slots.setdefault(key, []).append(item)
+
+    def drop(self, key):
+        with self._lock:
+            self._slots.pop(key, None)
+
+    def ensure(self, key):
+        with self._lock:
+            if key not in self._slots:
+                self._slots[key] = []
+
+
+class Versioned:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snap = None
+
+    def install(self, snap, build):
+        if self._snap is None:  # advisory fast path, re-checked below
+            with self._lock:
+                if self._snap is None:
+                    self._snap = build(snap)

@@ -1,0 +1,22 @@
+# floorlint: scope=FL-RACE
+"""Seeded-bad: the same loop-thread-owned-field shape as the good twin
+but WITHOUT the ``# floorlint: unguarded=`` annotation — the unlocked
+touches of the guarded field report."""
+import threading
+
+
+class Daemon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = 0
+
+    def enqueue(self):
+        with self._lock:
+            self._pending += 1
+
+    def done(self):
+        with self._lock:
+            self._pending -= 1
+
+    def backlog(self):
+        return self._pending  # unlocked read, no blessing

@@ -1,0 +1,24 @@
+# floorlint: scope=FL-RACE
+"""Seeded-good: the ``# floorlint: unguarded=<why>`` escape — a field
+the analysis would otherwise guard, blessed class-wide with an in-code
+justification (the rationale also gets a row in
+``docs/static_analysis.md``'s suppression table when used live)."""
+import threading
+
+
+class Daemon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # floorlint: unguarded=observability-only approximation, exact
+        self._pending = 0
+
+    def enqueue(self):
+        with self._lock:
+            self._pending += 1
+
+    def done(self):
+        with self._lock:
+            self._pending -= 1
+
+    def backlog(self):
+        return self._pending  # blessed: a stale read is acceptable here

@@ -1,0 +1,30 @@
+# floorlint: scope=FL-RACE
+"""Seeded-bad: the connection-checkout shape gone wrong — ``request``
+uses the pooled socket field DIRECTLY, outside the pool lock, so two
+threads can interleave sends on one connection and corrupt the
+framing."""
+import threading
+
+
+class PeerClient:
+    def __init__(self, host, port):
+        self._lock = threading.Lock()
+        self._sock = None
+        self._host = host
+        self._port = port
+
+    def _checkout(self):
+        with self._lock:
+            sock, self._sock = self._sock, None
+        return sock
+
+    def _checkin(self, sock):
+        with self._lock:
+            if self._sock is None:
+                self._sock = sock
+                return
+        sock.close()
+
+    def request(self, payload):
+        self._sock.sendall(payload)  # guarded field used outside the lock
+        return self._sock.recv(65536)

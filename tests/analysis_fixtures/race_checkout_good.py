@@ -1,0 +1,35 @@
+# floorlint: scope=FL-RACE
+"""Seeded-good FP pin: the PeerClient connection-checkout shape — the
+pooled socket field is only ever touched under the pool lock; a request
+checks the connection OUT (swap-to-None under the lock), uses the
+now-private local outside it, and checks it back in.  The analysis must
+not flag the unlocked use of the checked-out LOCAL."""
+import threading
+
+
+class PeerClient:
+    def __init__(self, host, port):
+        self._lock = threading.Lock()
+        self._sock = None
+        self._host = host
+        self._port = port
+
+    def _checkout(self):
+        with self._lock:
+            sock, self._sock = self._sock, None
+        return sock
+
+    def _checkin(self, sock):
+        with self._lock:
+            if self._sock is None:
+                self._sock = sock
+                return
+        sock.close()
+
+    def request(self, payload):
+        sock = self._checkout()  # the connection leaves the pool...
+        try:
+            sock.sendall(payload)  # ...and is used as a LOCAL, unlocked
+            return sock.recv(65536)
+        finally:
+            self._checkin(sock)

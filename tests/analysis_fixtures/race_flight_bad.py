@@ -1,0 +1,33 @@
+# floorlint: scope=FL-RACE
+"""Seeded-bad: the single-flight shape gone wrong — the lead's cleanup
+pops the flight entry OUTSIDE the flight lock, so a racing caller can
+observe a dead Event and wait forever on a flight nobody owns."""
+import threading
+
+
+class SingleFlight:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flights = {}
+
+    def reset(self):
+        with self._lock:
+            self._flights.clear()
+
+    def fetch(self, key, load):
+        lead = False
+        with self._lock:
+            ev = self._flights.get(key)
+            if ev is None:
+                ev = threading.Event()
+                self._flights[key] = ev
+                lead = True
+        if lead:
+            try:
+                value = load(key)
+            finally:
+                self._flights.pop(key, None)  # outside the guard
+                ev.set()
+            return value
+        ev.wait(timeout=30.0)
+        return load(key)

@@ -1,0 +1,37 @@
+# floorlint: scope=FL-RACE
+"""Seeded-good FP pin: the single-flight release-before-wait shape from
+the serving cache — every touch of the flights dict holds the flight
+lock, while waiters block on the checked-out Event OUTSIDE it (waiting
+under the lock would serialize the flight it exists to share).  The
+Event is a local once checked out; the analysis must not confuse
+waiting on it with touching the guarded dict."""
+import threading
+
+
+class SingleFlight:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flights = {}
+
+    def reset(self):
+        with self._lock:
+            self._flights.clear()
+
+    def fetch(self, key, load):
+        lead = False
+        with self._lock:
+            ev = self._flights.get(key)
+            if ev is None:
+                ev = threading.Event()
+                self._flights[key] = ev
+                lead = True
+        if lead:
+            try:
+                value = load(key)
+            finally:
+                with self._lock:
+                    self._flights.pop(key, None)
+                ev.set()
+            return value
+        ev.wait(timeout=30.0)  # release-before-wait: the pinned escape
+        return load(key)

@@ -1,0 +1,24 @@
+# floorlint: scope=FL-RACE
+"""Seeded-bad: NOT assign-once — the snapshot field is republished from
+two sites, so the immutable-after-publish escape does not apply and the
+unlocked read of the guarded field reports."""
+import threading
+
+
+class Config:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = None
+
+    def publish(self, table):
+        with self._lock:
+            self._table = table
+
+    def clear(self):
+        with self._lock:
+            self._table = None
+
+    def lookup(self, key):
+        if self._table is None:  # unlocked read of a guarded field
+            return None
+        return self._table.get(key)

@@ -1,0 +1,23 @@
+# floorlint: scope=FL-RACE
+"""Seeded-good: the assign-once / immutable-after-publish escape — one
+post-init publish site (under the lock), readers take the reference
+unlocked: CPython's atomic attribute store means they see the old or
+the new snapshot, never a torn one (the epoch-fenced membership
+pattern)."""
+import threading
+
+
+class Config:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = None
+
+    def publish(self, table):
+        with self._lock:
+            self._table = table
+
+    def lookup(self, key):
+        table = self._table  # snapshot read: assign-once blessed
+        if table is None:
+            return None
+        return table.get(key)

@@ -542,6 +542,80 @@ def exec_cache_leg(n_rows: int) -> dict:
     }
 
 
+def multichip_leg(n_rows: int) -> dict:
+    """The multi-chip scan scheduler (docs/multichip.md): one
+    subprocess (scripts/multichip_probe.py) runs a serial baseline, a
+    single-device pipelined pass, and a mesh pass over the same file
+    and reports walls, digests, scheduler counters, and the
+    inflate-overlap fraction.  ``check_bench_report.py`` asserts
+    bit-identical delivery, launches == groups == mesh-placed groups,
+    overlap >= 0.5 (vs the ~0 serial baseline), and — only when
+    ``multichip_gate_expected`` (a real accelerator mesh; the CPU
+    forced devices share one socket) — mesh throughput >= 0.7*k the
+    single-chip pass."""
+    import subprocess
+
+    import jax
+
+    from benchmarks.workloads import write_lineitem
+
+    per = max(min(n_rows, 20_000), 4_000)
+    group = max(per // 8, 256)
+    path = os.path.join("/tmp", f"pftpu_bench_multichip_{per}.parquet")
+    if not os.path.exists(path):
+        write_lineitem(path, per, row_group_rows=group, seed=5)
+    probe = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "multichip_probe.py",
+    )
+    env = dict(os.environ)
+    env.pop("PFTPU_MESH_DEVICES", None)   # the probe drives the knob
+    env.pop("PFTPU_EXEC_CACHE", None)     # walls must include compiles
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+    out = subprocess.run(
+        [sys.executable, probe, path],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"multichip probe failed: {out.stderr[-2000:]}"
+        )
+    r = json.loads(out.stdout.strip().splitlines()[-1])
+    k = r["devices"]
+    speedup = (
+        r["wall_single_ms"] / r["wall_mesh_ms"]
+        if r["wall_mesh_ms"] else None
+    )
+    return {
+        "multichip_platform": r["platform"],
+        "multichip_devices": k,
+        "multichip_groups": r["groups"],
+        "multichip_mesh_groups": r["mesh_groups"],
+        "multichip_launches": r["launches"],
+        "multichip_wall_serial_ms": r["wall_serial_ms"],
+        "multichip_wall_single_ms": r["wall_single_ms"],
+        "multichip_wall_mesh_ms": r["wall_mesh_ms"],
+        "multichip_speedup_x": (
+            round(speedup, 3) if speedup is not None else None
+        ),
+        "multichip_bit_identical": bool(r["bit_identical"]),
+        "multichip_overlap_fraction": r["overlap_fraction"],
+        "multichip_overlap_serial": r["overlap_serial"],
+        "multichip_events_dropped": r["events_dropped"],
+        # the >= 0.7*k throughput gate only means something on a real
+        # accelerator mesh — forced host devices share one socket
+        "multichip_gate_expected": bool(
+            r["platform"] != "cpu" and k > 1
+        ),
+    }
+
+
 def _remote_paths(n_rows: int, n_files: int = 4, groups: int = 8):
     """The cold-storage leg's dataset: more, smaller row groups than the
     scan leg's (32 units keep the overlap statistics stable at smoke
@@ -1991,6 +2065,9 @@ def main():
     # exec-cache cold/warm leg (docs/perf.md): runs in SUBPROCESSES
     # (fresh jax each), so its placement among the timed legs is free
     exec_cache_detail = exec_cache_leg(n_rows)
+    # multi-chip scheduler leg (docs/multichip.md): also a subprocess
+    # (it forces its own device count on CPU)
+    multichip_detail = multichip_leg(n_rows)
     # device pushdown leg (docs/pushdown.md): D2H-heavy by design (the
     # whole point is measuring shipped bytes), so it runs with the
     # post-timing D2H checks
@@ -2052,6 +2129,7 @@ def main():
             **traffic_detail,
             **fleet_detail,
             **exec_cache_detail,
+            **multichip_detail,
             **pushdown_detail,
             **write_detail,
             **compact_detail,

@@ -100,6 +100,10 @@ _ACQUIRERS = {
     # resolve_writer factory returns one; plain ParquetFileWriter owns
     # its sink the same way
     "DeviceFileWriter", "ParquetFileWriter", "resolve_writer",
+    # the multi-chip mesh (parallel/mesh.py, docs/multichip.md): a
+    # DevicePools owns one ThreadPoolExecutor PER mesh device — leaking
+    # it leaks k worker threads at once; releases with shutdown()
+    "DevicePools",
 }
 
 # the verbs that count as releasing an acquisition (executors release
@@ -178,7 +182,30 @@ def _local_is_managed(ctx: FileContext, site: ast.AST, name: str) -> bool:
                             isinstance(c.func.value, ast.Name) and \
                             c.func.value.id == name:
                         return True
+                    # the per-device pool shape (DevicePools.shutdown,
+                    # docs/multichip.md): acquisitions collected into a
+                    # local container, every member released by
+                    # ITERATING it — `for p in pools.values():
+                    # p.shutdown()` in a finally/except guard
+                    if isinstance(c, ast.For) and \
+                            isinstance(c.target, ast.Name) and \
+                            _name_in(c.iter, name) and \
+                            _releases_loop_var(c):
+                        return True
     return False
+
+
+def _releases_loop_var(loop: ast.For) -> bool:
+    """True when the loop body calls a release verb on the loop var."""
+    tgt = loop.target.id
+    return any(
+        isinstance(c, ast.Call)
+        and isinstance(c.func, ast.Attribute)
+        and c.func.attr in _RELEASERS
+        and isinstance(c.func.value, ast.Name)
+        and c.func.value.id == tgt
+        for stmt in loop.body for c in ast.walk(stmt)
+    )
 
 
 def _classify(ctx: FileContext, call: ast.Call):
@@ -213,6 +240,24 @@ def _classify(ctx: FileContext, call: ast.Call):
                     return (f"bound to `{t.id}` but no exception path "
                             "releases it — use `with`, or close()/"
                             "shutdown() it in a finally/except guard")
+                # the per-device pool shape: acquisition stored INTO a
+                # container (`pools[dev] = ThreadPoolExecutor(...)`) —
+                # the container must be managed like the handle itself
+                if isinstance(t, ast.Subscript):
+                    base = t.value
+                    if isinstance(base, ast.Name):
+                        if _local_is_managed(ctx, anc, base.id):
+                            return None
+                        return (f"stored into container `{base.id}` but "
+                                "no exception path releases its members "
+                                "— iterate it and close()/shutdown() "
+                                "each in a finally/except guard")
+                    if isinstance(base, ast.Attribute):
+                        if _class_manages(ctx, anc):
+                            return None
+                        return ("stored into a container attribute of a "
+                                "class with no close()/__exit__ — "
+                                "nothing ever releases its members")
             return None
         if isinstance(anc, ast.Expr):
             return "result discarded — the handle leaks immediately"

@@ -226,6 +226,30 @@ def _note_sig(key) -> None:
                 fn.clear_cache()
 
 
+def _converge_leaves(leaves: list) -> list:
+    """Pin every jax-array leaf to the default device before a fused
+    dispatch.  Under the multi-chip scan mesh (docs/multichip.md) one
+    assemble call can cover windows from groups decoded on DIFFERENT
+    devices — jit rejects mixed-device arguments, and a cached
+    executable must always meet its inputs on one stable device — so
+    the batcher is the convergence point.  No-op (no copies, same list)
+    when everything already sits on the default device, i.e. whenever
+    the mesh is off."""
+    import jax
+
+    tgt = jax.local_devices()[0]
+    seen: set = set()
+    for a in leaves:
+        if isinstance(a, jax.Array):
+            seen.update(a.devices())
+    if not seen or seen == {tgt}:
+        return leaves
+    return [
+        jax.device_put(a, tgt) if isinstance(a, jax.Array) else a
+        for a in leaves
+    ]
+
+
 def _split_jit():
     global _SPLIT_JIT
     if _SPLIT_JIT is None:
@@ -267,7 +291,7 @@ def aligned_split(specs: Sequence[ColumnSpec], parts: Sequence[Part],
         tuple((a.shape, str(a.dtype)) for a in leaves),
     ))
     flat = exec_cache.dispatch(
-        _split_jit(), (tuple(sig), int(k)), leaves
+        _split_jit(), (tuple(sig), int(k)), _converge_leaves(leaves)
     )
     # flat is column-major: per column, k consecutive batch parts
     return [
@@ -316,7 +340,7 @@ def fused_assemble(specs: Sequence[ColumnSpec],
     ))
     flat = exec_cache.dispatch(
         _fuse_jit(), (tuple(sig), int(pad), int(split)),
-        [np.asarray(starts, np.int32), *leaves],
+        _converge_leaves([np.asarray(starts, np.int32), *leaves]),
     )
     # flat is column-major: per column, `split` consecutive batch parts
     k = int(split)

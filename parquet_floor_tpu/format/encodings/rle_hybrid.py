@@ -192,8 +192,23 @@ def count_equal(data, num_values: int, bit_width: int, target: int,
     return total
 
 
+# host-expansion odometer: how many times expand_runs actually ran in
+# this process.  The device scan path decodes v2 uncompressed-levels
+# pages' def-level and dictionary-index runs ON DEVICE (tpu/bitops.py
+# plan5), so tests pin "zero host expansions on that path" against this
+# counter rather than inferring it from timings (docs/multichip.md).
+_expand_calls = 0
+
+
+def expand_calls() -> int:
+    """Process-wide count of :func:`expand_runs` invocations."""
+    return _expand_calls
+
+
 def expand_runs(data, run_table: np.ndarray, num_values: int, bit_width: int) -> np.ndarray:
     """Phase 2: vectorized expansion of a run table to values (uint32)."""
+    global _expand_calls
+    _expand_calls += 1
     # num_values is a page-header field; run counts come from the parsed
     # table (clamped to remaining values at parse time — the min() below
     # re-states that bound where the allocation happens)

@@ -1189,28 +1189,27 @@ class ParquetFileReader:
         without an OffsetIndex decode fully; a whole-group request or a
         zero-range request short-circuits.
 
-        **Salvage mode decodes the whole group.**  Quarantine decisions
-        are GROUP-WIDE facts (the row-mask tier drops a damaged span
-        from every column, chunk quarantines change the column set, map
-        replays must re-establish identical records), and a pruned read
-        cannot see damage outside its requested pages — so ``salvage=
-        True`` routes through :meth:`read_row_group` and reports
-        ``covered=[(0, num_rows)]``, a legal superset of any request.
-        The quarantine set is therefore identical to the whole-chunk
-        read's BY CONSTRUCTION — the same delegation argument the
-        device face uses (docs/robustness.md).  When the row-mask tier
-        dropped rows, ``covered`` still names the PRE-mask group range;
-        the report records what was removed.  ``report`` routes
-        per-unit accounting exactly as in :meth:`read_row_group`.
+        **Salvage mode keeps the I/O pruning for CLEAN chunks.**  Each
+        selected chunk first decodes only its covered pages; a chunk
+        whose pruned decode trips a salvageable error WIDENS to the
+        whole-chunk salvage ladder (page-null, row-mask, quarantine —
+        the exact tiers :meth:`read_row_group` runs), so the quarantine
+        record for damage INSIDE the cover is identical to the
+        whole-group path's by construction.  Damage entirely OUTSIDE
+        the cover is never decoded and therefore never discovered —
+        the same contract the non-salvage pruned read has always had
+        (docs/robustness.md).  Chunks lacking an OffsetIndex, or a
+        cover that is the whole group, fall back to the group-wide
+        delegation.  ``report`` routes per-unit accounting exactly as
+        in :meth:`read_row_group`.
         """
         from ..batch.predicate import normalize_ranges
 
         rg = self.row_groups[index]
         n = int(rg.num_rows or 0)
         if self._salvage:
-            return (
-                self.read_row_group(index, column_filter, report=report),
-                [(0, n)] if n else [],
+            return self._read_row_group_ranges_salvage(
+                index, row_ranges, column_filter, report=report,
             )
         if not normalize_ranges(row_ranges, n):
             # predicate excluded every row — report that regardless of
@@ -1453,6 +1452,149 @@ class ParquetFileReader:
             trace.count("salvage.rows_dropped", dropped)
         batches = [_mask_batch_rows(b, keep) for b in batches]
         return RowGroupBatch(batches, int(keep.sum()))
+
+    def _read_row_group_ranges_salvage(
+        self, index: int, row_ranges,
+        column_filter: Optional[Set[str]] = None,
+        *, report: Optional[SalvageReport] = None,
+    ):
+        """Ranged read under salvage: clean chunks keep the I/O pruning
+        (only covered pages are read and decoded); a chunk whose pruned
+        decode trips a salvageable error WIDENS to the whole-chunk
+        salvage ladder — ``_read_column_chunk_impl`` with the row-mask
+        tier, then chunk quarantine — so quarantine records for damage
+        inside the cover match the whole-group path's exactly
+        (``SalvageReport._first_count`` dedupes across the retry).
+        Widened chunks decode the full group and are sliced back to the
+        covered rows; when the group holds REPEATED columns that slice
+        is not expressible (``_mask_batch_rows`` is flat-only), so the
+        first widen there restarts through :meth:`read_row_group` —
+        correctness over pruning.  ``rows_dropped`` counts only rows
+        dropped INSIDE the cover (rows outside it were never decoded).
+        """
+        from ..batch.predicate import normalize_ranges
+
+        rg = self.row_groups[index]
+        n = int(rg.num_rows or 0)
+        if not normalize_ranges(row_ranges, n):
+            return RowGroupBatch([], 0), []
+        selected = []
+        for chunk in rg.columns or []:
+            meta = chunk.meta_data
+            # nulled/corrupt meta falls THROUGH (read_row_group's rule):
+            # the chunk ladder diagnoses it, projection never hides it
+            path0 = (
+                meta.path_in_schema[0]
+                if meta is not None and meta.path_in_schema
+                else None
+            )
+            if column_filter and path0 is not None \
+                    and path0 not in column_filter:
+                continue
+            selected.append(chunk)
+        if not selected:
+            return RowGroupBatch([], n), [(0, n)] if n else []
+        whole = ([(0, n)] if n else [])
+        try:
+            covered = self.page_cover(index, row_ranges, selected)
+        except (OSError, MemoryError):
+            raise
+        except Exception:
+            # a damaged OffsetIndex must not fail the read — the
+            # group-wide ladder still decodes; the cover just falls away
+            covered = None
+        if covered == []:
+            return RowGroupBatch([], 0), []
+        if covered is None or covered == [(0, n)]:
+            return (
+                self.read_row_group(index, column_filter, report=report),
+                whole,
+            )
+        rep = report if report is not None else self.salvage_report
+        # same flat-columns gate as read_row_group: it bounds BOTH the
+        # row-mask tier and our ability to slice a widened full-chunk
+        # batch back down to the covered rows
+        allow_mask = True
+        for c in selected:
+            try:
+                d = self._descriptor_for(c)
+            except (OSError, MemoryError):
+                raise
+            except Exception:
+                allow_mask = False
+                break
+            if d.max_repetition_level > 0:
+                allow_mask = False
+                break
+        nr = checked_alloc_size(n, "ranged row-mask group rows",
+                                row_group=index)
+        cov_mask = np.zeros(nr, dtype=bool)
+        for a, b in covered:
+            cov_mask[max(0, int(a)):max(0, min(nr, int(b)))] = True
+        cov_rows = int(cov_mask.sum())
+        batches: list = []   # (ColumnBatch, pruned: bool)
+        drops: list = []
+        for chunk in selected:
+            meta = chunk.meta_data
+            column = ".".join(
+                (meta.path_in_schema if meta is not None else None) or ["?"]
+            )
+            kn = self._known_bad.get((index, column))
+            if kn is not None and kn.get("chunk") is not None:
+                e = kn["chunk"]
+                self._quarantine_chunk(
+                    chunk, index, rg, e["error"], rep, via_map=True,
+                    rows=int(e.get("rows") or 0),
+                )
+                continue
+            try:
+                with self._chunk_span(chunk, index):
+                    pruned_batch = self._read_chunk_ranges(
+                        chunk, covered, n
+                    )
+                batches.append((pruned_batch, True))
+                continue
+            except (OSError, MemoryError):
+                raise
+            except _SALVAGEABLE:
+                pass  # widen: the chunk ladder below owns the diagnosis
+            trace.count("salvage.ranged_widens")
+            if not allow_mask:
+                # a repeated (or undiagnosable) column cannot be sliced
+                # back to the cover — restart group-wide; _first_count
+                # keeps the report's records identical across the retry
+                return (
+                    self.read_row_group(index, column_filter,
+                                        report=report),
+                    whole,
+                )
+            try:
+                with self._chunk_span(chunk, index):
+                    batch, spans = self._read_column_chunk_impl(
+                        chunk, index, report=rep, row_mask=True
+                    )
+                batches.append((batch, False))
+                drops.extend(spans)
+            except _SALVAGEABLE as e:
+                self._quarantine_chunk(chunk, index, rg, e, rep)
+        keep = np.ones(nr, dtype=bool)
+        for a, b in drops:
+            keep[max(0, int(a)):max(0, min(nr, int(b)))] = False
+        keep_cov = keep & cov_mask
+        dropped = int(cov_rows - keep_cov.sum())
+        if dropped and rep is not None and rep._first_count("*", index, "rm"):
+            rep.rows_dropped += dropped
+            trace.count("salvage.rows_dropped", dropped)
+        out = []
+        for batch, pruned in batches:
+            if pruned:
+                if dropped:
+                    out.append(_mask_batch_rows(batch, keep[cov_mask]))
+                else:
+                    out.append(batch)
+            else:
+                out.append(_mask_batch_rows(batch, keep_cov))
+        return RowGroupBatch(out, int(keep_cov.sum())), covered
 
     def _quarantine_chunk(self, chunk: ColumnChunk, index: int,
                           rg: RowGroup, err, report=None,

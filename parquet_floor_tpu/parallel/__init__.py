@@ -1,1 +1,3 @@
-"""Device-mesh sharding: row-group/column parallel decode via jax.sharding."""
+"""Device-mesh sharding: row-group/column parallel decode via
+jax.sharding, plus the multi-chip scan scheduler's (row group → device)
+placement layer (:mod:`.mesh`, docs/multichip.md)."""

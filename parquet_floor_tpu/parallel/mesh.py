@@ -1,0 +1,130 @@
+"""Multi-chip scan placement: the (row group → device) layer.
+
+One process with k local devices decodes a dataset k-wide by
+round-robining STAGED row groups across the chips: each group stages on
+the host (read + inflate + plan), ships to ITS device on that device's
+own ship worker (transfers overlap across chips, stay serialized per
+chip), and dispatches its fused decode against that device's persistent
+exec-cache entry (the cache key carries ``platform:id``, so k devices
+warm k entries).  Delivery to the consumer stays strictly in submission
+order — the single-device admission argument, now across devices — so
+every read face (``scan_device_groups``, the ``DataLoader``, pushdown,
+the compactor's read leg) inherits the fan-out with decoded values
+bit-identical to the single-device path (padded widths follow the
+existing ``PFTPU_STAGE_WORKERS>1`` contract; docs/multichip.md).
+
+Placement policy (``mesh_devices``):
+
+* on an accelerator backend (platform != "cpu") with more than one
+  local device, the mesh is ON by default over all of them;
+* on CPU the forced host "devices" share one machine — no speedup, so
+  the mesh is opt-in there (tests, parity smokes);
+* ``PFTPU_MESH_DEVICES`` overrides either way: ``0``/``1`` disables,
+  ``k`` caps the mesh at the first k local devices, ``all`` uses every
+  local device regardless of platform.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+__all__ = ["mesh_devices", "mesh_enabled", "DevicePools"]
+
+
+def mesh_devices() -> List[object]:
+    """The scan scheduler's target devices, in placement (round-robin)
+    order — ``[]`` when the mesh is off (single-device scheduling).
+    See the module docstring for the policy; this never initializes a
+    backend beyond what ``jax.local_devices()`` already does."""
+    import jax
+
+    env = os.environ.get("PFTPU_MESH_DEVICES", "").strip().lower()
+    try:
+        devs = list(jax.local_devices())
+    except RuntimeError:
+        return []
+    if env == "all":
+        pass
+    elif env:
+        try:
+            k = int(env)
+        except ValueError:
+            raise ValueError(
+                f"PFTPU_MESH_DEVICES must be an integer or 'all', "
+                f"got {env!r}"
+            ) from None
+        if k <= 1:
+            return []
+        devs = devs[:k]
+    elif not devs or devs[0].platform == "cpu":
+        # forced host devices share the one CPU: mesh scheduling buys
+        # contention, not throughput — opt-in only
+        return []
+    return devs if len(devs) > 1 else []
+
+
+def mesh_enabled() -> bool:
+    """True when ``mesh_devices()`` would schedule across >1 device."""
+    return len(mesh_devices()) > 1
+
+
+class DevicePools:
+    """Per-device single-worker ship pools: one ``ThreadPoolExecutor``
+    per mesh device, so H2D transfers OVERLAP across chips while each
+    chip's transfers stay serialized (the single-device
+    ``sync_transfers`` discipline, per device).  Owns its worker
+    threads — with-manage it or ``shutdown()`` in a ``finally``
+    (FL-RES001 knows this shape)."""
+
+    def __init__(self, devices, thread_name_prefix: str = "pftpu-devship"):
+        self._pools = {}
+        self._lock = threading.Lock()
+        self._prefix = thread_name_prefix
+        self._shut = False
+        try:
+            for i, d in enumerate(devices or []):
+                self._pools[d] = ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix=f"{thread_name_prefix}-{i}",
+                )
+        except BaseException:
+            self.shutdown(wait=False)
+            raise
+
+    def __len__(self) -> int:
+        return len(self._pools)
+
+    def submit(self, device, fn, *args, **kwargs):
+        """Submit onto ``device``'s worker (created on first use for a
+        device outside the construction set — the big-group and salvage
+        stragglers stay schedulable)."""
+        with self._lock:
+            if self._shut:
+                raise RuntimeError("DevicePools is shut down")
+            pool = self._pools.get(device)
+            if pool is None:
+                pool = self._pools[device] = ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix=f"{self._prefix}-{len(self._pools)}",
+                )
+        return pool.submit(fn, *args, **kwargs)
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Join (``wait=True``) or abandon every per-device worker.
+        Idempotent; safe on a partially-constructed set."""
+        with self._lock:
+            pools = list(self._pools.values())
+            self._pools.clear()
+            self._shut = True
+        for p in pools:
+            p.shutdown(wait=wait)
+
+    def __enter__(self) -> "DevicePools":
+        return self
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        self.shutdown(wait=True)
+        return None

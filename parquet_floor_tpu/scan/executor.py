@@ -744,13 +744,22 @@ class DatasetScanner:
 
     def _page_covers(self, reader, keep: Optional[Set[int]],
                      sc: Optional[ScanOptions] = None):
-        if self._predicate is None or not self._scan.page_prune \
-                or self._salvage:
+        if self._predicate is None or not self._scan.page_prune:
             return None
-        return compute_page_covers(
-            reader, self._predicate, keep, self._filter,
-            sc if sc is not None else self._scan,
-        )
+        try:
+            return compute_page_covers(
+                reader, self._predicate, keep, self._filter,
+                sc if sc is not None else self._scan,
+            )
+        except (OSError, MemoryError):
+            raise
+        except Exception:
+            if not self._salvage:
+                raise
+            # salvage scans prune too (ranged salvage widens only the
+            # damaged chunks) — but a damaged page INDEX must not fail
+            # the plan; the cover just falls away for this file
+            return None
 
     def _close_file(self, fi: int) -> None:
         state = self._files.pop(fi, None)
@@ -843,9 +852,18 @@ class DatasetScanner:
             # per-unit report: worker threads never touch a shared
             # report; the consumer folds them in delivery order
             unit_rep = SalvageReport()
-            batch = state.reader.read_row_group(
-                work.plan.group_index, self._filter, report=unit_rep
-            )
+            if work.plan.covered is not None:
+                # ranged salvage: clean chunks keep the I/O pruning,
+                # a damaged one widens to the whole-chunk ladder
+                # (file_read._read_row_group_ranges_salvage)
+                batch, _cov = state.reader.read_row_group_ranges(
+                    work.plan.group_index, work.plan.covered,
+                    self._filter, report=unit_rep,
+                )
+            else:
+                batch = state.reader.read_row_group(
+                    work.plan.group_index, self._filter, report=unit_rep
+                )
             return batch, unit_rep
 
     # -- scheduling (consumer thread) ---------------------------------------
@@ -1141,12 +1159,23 @@ def scan_device_groups(sources: Sequence,
             set(predicate.row_groups(fr)) if predicate is not None else None
         )
         covered_by_group = None
-        if predicate is not None and sc.page_prune and not salvage:
+        if predicate is not None and sc.page_prune:
             # the device leg's page-prune rung (docs/scan.md): same
-            # cover pass as the host DatasetScanner, bit-parity pinned
-            covered_by_group = compute_page_covers(
-                fr, predicate, keep, set(columns) if columns else None, sc
-            )
+            # cover pass as the host DatasetScanner, bit-parity pinned.
+            # Salvage scans keep the pruning (the engine's ranged
+            # salvage widens only damaged chunks), but a damaged page
+            # INDEX must not fail the plan there — the cover falls away
+            try:
+                covered_by_group = compute_page_covers(
+                    fr, predicate, keep, set(columns) if columns else None,
+                    sc
+                )
+            except (OSError, MemoryError):
+                raise
+            except Exception:
+                if not salvage:
+                    raise
+                covered_by_group = None
         fplan = plan_file(fr, set(columns) if columns else None, keep, sc,
                           covered_by_group)
         if fplan.index_extents:

@@ -285,6 +285,11 @@ class Tenant:
         self.name = name
         self.weight = float(weight)
         self.tracer = trace.Tracer(enabled=True)
+        # every engine ship/launch span recorded under this tenant's
+        # scope bills the device-time WFQ ledger automatically — a
+        # sharded/multi-chip scan run via tenant.scanner() needs no
+        # explicit metering calls (trace._Span wires the hook through)
+        self.tracer.device_charge = self.charge_device
         self._share = _TenantShare(self.weight, serving._gate,
                                    serving._device_gate)
         self._closed = False
@@ -374,18 +379,26 @@ class Tenant:
         ``serve.device_seconds`` histogram — the ledger fairness
         benches compare against ideal WFQ shares.  The serving faces
         (lookup/range/aggregate probes, the daemon) wrap each row
-        group's decode in one of these."""
+        group's decode in one of these.
+
+        The tracer's automatic span-level ``device_charge`` hook is
+        SUSPENDED for the session's duration: the lane release charges
+        the whole measured wall, so letting the enclosed ship/launch
+        spans also bill would double-count them."""
         # attribution is pinned to THIS tenant's tracer (idempotent
         # when the probe faces already activated it), so the fairness
         # ledger and the wait counters land on the right tenant even
         # from a bare device_session() call
         with trace.using(self.tracer):
             lease = self._share.device_gate.acquire(self._share)
+        prev_hook = self.tracer.device_charge
+        self.tracer.device_charge = None
         t0 = time.perf_counter()
         try:
             yield
         finally:
             actual = time.perf_counter() - t0
+            self.tracer.device_charge = prev_hook
             self._share.device_gate.release(lease, actual)
             with trace.using(self.tracer):
                 trace.observe("serve.device_seconds", actual)

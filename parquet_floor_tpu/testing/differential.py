@@ -252,23 +252,28 @@ def run_sequential(paths, opts: ReaderOptions) -> FaceResult:
 
 
 def run_ranged(paths, opts: ReaderOptions,
-               request: Tuple[int, int] = (10, 60)) -> FaceResult:
-    """The RANGED face (``read_row_group_ranges``): every group is
-    requested through a partial row range.  Under salvage the ranged
-    path delegates to the whole-group salvage decode (quarantine
-    decisions are group-wide facts — see ``file_read``), so its
-    quarantine set and surviving bytes must equal the sequential
-    face's EXACTLY; this face pins that delegation contract against
-    regressions."""
+               request: Optional[Tuple[int, int]] = (10, 60)) -> FaceResult:
+    """The RANGED face (``read_row_group_ranges``).  ``request=None``
+    asks for every group's FULL row range: the cover equals the group,
+    so under salvage the quarantine set and surviving bytes must equal
+    the sequential face's EXACTLY.  A partial ``request`` keeps its
+    I/O-pruned page cover even under salvage (docs/scan.md): only a
+    quarantined chunk's spans widen, damage outside the cover is never
+    probed — so its quarantine is a SUBSET of the sequential face's,
+    never a superset, and never a different verdict on a probed chunk
+    (the precise partial-cover laws are pinned in test_salvage.py)."""
     res = FaceResult()
     keys = set()
     try:
         for fi, p in enumerate(paths):
             with ParquetFileReader(p, options=opts) as r:
                 for gi in range(len(r.row_groups)):
-                    batch, _covered = r.read_row_group_ranges(
-                        gi, [request]
-                    )
+                    if request is None:
+                        nr = int(r.row_groups[gi].num_rows or 0)
+                        req = [(0, nr)]
+                    else:
+                        req = [request]
+                    batch, _covered = r.read_row_group_ranges(gi, req)
                     res.groups[(fi, gi)] = _canon_host_group(batch)
                 keys |= set(_quarantine_keys(fi, r.salvage_report))
     except ParquetError as e:

@@ -333,7 +333,8 @@ class _ArenaBuilder:
 
     def __init__(self, lead: int = 0):
         self.size = lead
-        self.jobs: List[tuple] = []  # ("d", codec, payload, off, size) | ("c", data, off)
+        self.jobs: List[tuple] = []  # ("d", codec, payload, off, size) | ("c", data, off, size)
+        self.inflate_bytes = 0  # decompressed output bytes ("d" jobs only)
 
     def reserve(self, size: int) -> int:
         off = self.size
@@ -343,6 +344,7 @@ class _ArenaBuilder:
     def add_decompress(self, codec: int, payload, size: int) -> int:
         off = self.reserve(size)
         self.jobs.append(("d", codec, payload, off, size))
+        self.inflate_bytes += int(size)
         return off
 
     def add_copy(self, data, size: int) -> int:
@@ -506,6 +508,8 @@ class _StagedGroup:
     source: Optional[str] = None       # trace attribution: file path …
     group_index: int = -1              # … and row-group index
     compute: Optional[object] = None   # compute.BuiltCompute (pushdown)
+    device: Optional[object] = None    # mesh placement target (None =
+    #                                    the reader's default device)
 
 
 # ---------------------------------------------------------------------------
@@ -2059,6 +2063,11 @@ class TpuRowGroupReader:
         self._sdict_meta: Dict[bytes, tuple] = {}   # digest → (num, max_len)
         self._sdict_host: Dict[tuple, tuple] = {}   # key → (rows, lens)
         self._sdict_dev: Dict[tuple, tuple] = {}    # key → (rows_dev, lens_dev)
+        # mesh placement ships dictionary pools per TARGET device: the
+        # default-device dict above stays authoritative for every
+        # single-device path; explicitly-placed groups resolve through
+        # their device's own dict (docs/multichip.md)
+        self._sdict_dev_mesh: Dict[object, Dict[tuple, tuple]] = {}
         self._lock = threading.Lock()
         # concurrent stage workers grow the shape buckets in whatever
         # order the pool schedules groups — padded widths would vary run
@@ -2067,6 +2076,14 @@ class TpuRowGroupReader:
         # every size-driven bucket order-independent (docs/perf.md)
         if int(_os.environ.get("PFTPU_STAGE_WORKERS", "1") or "1") > 1:
             self._preseed_buckets()
+        else:
+            # the mesh scheduler stages k groups concurrently (stage
+            # pool sized to devices) — same order-nondeterminism, same
+            # preseed remedy (docs/multichip.md)
+            from ..parallel import mesh as _mesh
+
+            if _mesh.mesh_enabled():
+                self._preseed_buckets()
         # eager exec-cache preload (docs/perf.md): deserialize persisted
         # executables on a daemon thread NOW, so the per-entry wall hides
         # behind the file opens/staging ahead of the first dispatch
@@ -2087,6 +2104,36 @@ class TpuRowGroupReader:
             else:
                 self._hwm_state[key] = b
         return b
+
+    def _sdict_dev_for(self, device=None) -> Dict[tuple, tuple]:
+        """The device-resident dictionary-pool dict for ``device``
+        (None = the reader's default device).  Mesh-placed groups must
+        resolve extras against THEIR chip: a pool shipped to device 0
+        does not exist on device 1 (docs/multichip.md)."""
+        if device is None:
+            return self._sdict_dev
+        with self._lock:
+            d = self._sdict_dev_mesh.get(device)
+            if d is None:
+                d = self._sdict_dev_mesh[device] = {}
+            return d
+
+    def _host_extra(self, key: tuple):
+        """The host (rows, lens) matrices for dictionary key ``key``,
+        reconstructing from any device copy when the host copy was
+        already dropped (a reader that shipped single-device first and
+        mesh-places later — one D2H fetch, then cached again)."""
+        with self._lock:
+            pair = self._sdict_host.get(key)
+            if pair is not None:
+                return pair
+            for d in (self._sdict_dev, *self._sdict_dev_mesh.values()):
+                dev_pair = d.get(key)
+                if dev_pair is not None:
+                    pair = (np.asarray(dev_pair[0]), np.asarray(dev_pair[1]))
+                    self._sdict_host[key] = pair
+                    return pair
+        raise KeyError(key)
 
     def _preseed_buckets(self) -> None:
         """Seed the footer-derivable shape buckets to their file-wide
@@ -2193,9 +2240,12 @@ class TpuRowGroupReader:
             # reuse the smallest already-built pool that dominates the
             # requested buckets (same content at a grown bucket otherwise
             # duplicates the pool on device)
+            pool_keys = list(self._sdict_dev) + list(self._sdict_host)
+            for d in self._sdict_dev_mesh.values():
+                pool_keys.extend(d)
             candidates = [
                 k
-                for k in list(self._sdict_dev) + list(self._sdict_host)
+                for k in pool_keys
                 if k[0] == digest and k[1] >= cap and k[2] >= max_len
             ]
         if candidates:
@@ -2266,8 +2316,8 @@ class TpuRowGroupReader:
         sg = self._stage_row_group(index, columns)
         return self._launch(sg, out_perm=out_perm)
 
-    def _read_row_group_salvage(self, index: int, columns, out_perm=None
-                                ) -> Dict[str, DeviceColumn]:
+    def _read_row_group_salvage(self, index: int, columns, out_perm=None,
+                                row_ranges=None):
         """Salvage decode of one group on the DEVICE face.
 
         The quarantine decision must be byte-deterministic and identical
@@ -2279,17 +2329,36 @@ class TpuRowGroupReader:
         Chunk-quarantined columns are simply absent from the returned
         dict, exactly as they are absent from the host
         ``RowGroupBatch``.  This is a recovery path, not a fast path:
-        it pays host decode per unit by design."""
+        it pays host decode per unit by design.
+
+        With ``row_ranges`` the host read is the RANGED salvage path
+        (clean chunks keep their I/O pruning; see
+        ``_read_row_group_ranges_salvage``) and the return value is
+        ``(columns_dict, covered)`` instead of the bare dict; a row
+        permutation cannot combine with a partial cover."""
         from ..errors import UnsupportedFeatureError
         from ..format.file_read import SalvageReport
 
+        if row_ranges is not None and out_perm is not None:
+            raise UnsupportedFeatureError(
+                "a row permutation cannot combine with a ranged salvage "
+                "read (the perm indexes whole-group rows)"
+            )
         want = set(columns) if columns else None
         unit_rep = SalvageReport()
+        covered = None
         with trace.span("stage", attrs={
             "file": getattr(self.reader.source, "name", None),
             "row_group": index,
         }):
-            batch = self.reader.read_row_group(index, want, report=unit_rep)
+            if row_ranges is None:
+                batch = self.reader.read_row_group(
+                    index, want, report=unit_rep
+                )
+            else:
+                batch, covered = self.reader.read_row_group_ranges(
+                    index, row_ranges, want, report=unit_rep
+                )
         # the shared report still sees everything (close() records it
         # into the quarantine map); the per-unit copy is what consumers
         # with a merge protocol take.  The merge is once-per-group:
@@ -2349,6 +2418,8 @@ class TpuRowGroupReader:
             # quarantine such units wholesale — returning them unpermuted
             # is safe, applying a stale perm would be an index error.
             out = _permuted_columns(out, out_perm)
+        if row_ranges is not None:
+            return out, covered
         return out
 
     def take_unit_report(self, index: int):
@@ -2523,6 +2594,15 @@ class TpuRowGroupReader:
         ]
         if not chunks:
             return self.read_row_group(index, columns), [(0, n)] if n else []
+        if self._salvage:
+            # ranged salvage: the HOST engine computes the cover itself
+            # (defensively — a damaged OffsetIndex falls back to the
+            # whole group), keeps I/O pruning for clean chunks and
+            # widens only damaged ones; the survivors ship exactly like
+            # the whole-group salvage face
+            return self._read_row_group_salvage(
+                index, columns, row_ranges=row_ranges
+            )
         covered = self.reader.page_cover(index, row_ranges, chunks)
         if covered == []:
             return {}, []
@@ -2628,13 +2708,13 @@ class TpuRowGroupReader:
 
     def _stage_row_group(self, index: int, columns, covered=None,
                          group_rows: int = 0, chunked=None,
-                         compute=None) -> _StagedGroup:
+                         compute=None, device=None) -> _StagedGroup:
         src = getattr(self.reader.source, "name", None)
         with trace.span("stage", attrs={"file": src, "row_group": index},
                         observe="engine.stage_seconds"):
             sg = self._stage_row_group_untraced(
                 index, columns, covered, group_rows, chunked=chunked,
-                compute=compute,
+                compute=compute, device=device,
             )
         sg.source = src
         sg.group_index = index
@@ -2642,7 +2722,7 @@ class TpuRowGroupReader:
 
     def _stage_row_group_untraced(self, index: int, columns, covered=None,
                                   group_rows: int = 0, chunked=None,
-                                  compute=None) -> _StagedGroup:
+                                  compute=None, device=None) -> _StagedGroup:
         rg = self.reader.row_groups[index]
         want = set(columns) if columns else None
         if compute is not None and want is not None:
@@ -2668,7 +2748,7 @@ class TpuRowGroupReader:
                 return self._try_stage(
                     rg, work, self._forced,
                     covered=covered, group_rows=group_rows, chunked=chunked,
-                    compute=compute,
+                    compute=compute, device=device,
                 )
             except _ForceHost as e:
                 # sticky per file: a column that needed the host path once
@@ -2726,7 +2806,7 @@ class TpuRowGroupReader:
 
     def _try_stage(self, rg, work, forced, covered=None,
                    group_rows: int = 0, chunked=None,
-                   compute=None) -> _StagedGroup:
+                   compute=None, device=None) -> _StagedGroup:
         arena_b = _ArenaBuilder(plk.ARENA_LEAD if self._pl_enabled else 0)
         stages = []
         for name, chunk, desc in work:
@@ -2795,12 +2875,29 @@ class TpuRowGroupReader:
                         # the previous one, and a deeper async queue
                         # trips the tunnel's burst throttle
                         jax.block_until_ready(plist[-1])
-                    plist.append(jax.device_put(arena[s:e], self.device))
+                    plist.append(jax.device_put(
+                        arena[s:e],
+                        device if device is not None else self.device,
+                    ))
                 if self.sync_transfers:
                     jax.block_until_ready(plist)
                 parts = tuple(plist)
         else:
-            arena_b.fill(arena, self._fill_pool)
+            if arena_b.inflate_bytes:
+                # host inflate as its own timed span: the pipeline's
+                # per-group stage task runs this concurrently with other
+                # groups' transfers and decode dispatches — the timeline
+                # intervals are what the overlap measurement intersects
+                # (docs/multichip.md; the chunked-ship path interleaves
+                # fill with its own transfer and stays inside "ship")
+                with trace.span(
+                    "inflate", arena_b.inflate_bytes,
+                    observe="scan.inflate_seconds",
+                ):
+                    arena_b.fill(arena, self._fill_pool)
+                trace.count("scan.inflate_bytes", arena_b.inflate_bytes)
+            else:
+                arena_b.fill(arena, self._fill_pool)
         slabb = _I32Builder()
         raw_specs = []
         force_keys = []
@@ -2831,10 +2928,12 @@ class TpuRowGroupReader:
             if key is not None:
                 if key not in extra_keys:
                     extra_keys.append(key)
+                    sdict_dev = self._sdict_dev_for(device)
                     with self._lock:
-                        if key not in self._sdict_dev:
-                            rows, lens = self._sdict_host[key]
-                            new_extras.append((key, rows, lens))
+                        missing = key not in sdict_dev
+                    if missing:
+                        rows, lens = self._host_extra(key)
+                        new_extras.append((key, rows, lens))
                 rs["extra_idx"] = extra_keys.index(key)
             specs.append(_ColSpec(**rs))
         slab = slabb.build(self._hwm(("slab",), slabb.n, minimum=256))
@@ -2871,6 +2970,7 @@ class TpuRowGroupReader:
             parts=parts,
             host_pools=host_pools or None,
             compute=built,
+            device=device,
         )
 
     # -- launch -------------------------------------------------------------
@@ -2881,9 +2981,11 @@ class TpuRowGroupReader:
         already shipped during staging (``sg.parts``) are not re-sent."""
         # several prefetched groups can stage the same dictionary before
         # the first of them ships it — re-check at ship time (ships are
-        # serialized) so it crosses the link once
+        # serialized per device) so it crosses each link once
+        target = sg.device if sg.device is not None else self.device
+        sdict_dev = self._sdict_dev_for(sg.device)
         with self._lock:
-            extras = [e for e in sg.new_extras if e[0] not in self._sdict_dev]
+            extras = [e for e in sg.new_extras if e[0] not in sdict_dev]
         ship = [] if sg.parts is not None else [sg.arena]
         ship.append(sg.slab)
         for _, rows, lens in extras:
@@ -2898,7 +3000,7 @@ class TpuRowGroupReader:
                         attrs={"file": sg.source,
                                "row_group": sg.group_index},
                         observe="engine.ship_seconds"):
-            shipped = jax.device_put(ship, self.device)
+            shipped = jax.device_put(ship, target)
             if self.sync_transfers:
                 jax.block_until_ready(shipped)
         if sg.parts is not None:
@@ -2906,10 +3008,12 @@ class TpuRowGroupReader:
         pos = 2
         for key, _, _ in extras:
             with self._lock:
-                self._sdict_dev[key] = (shipped[pos], shipped[pos + 1])
-                if self._dict_form != "index":
+                sdict_dev[key] = (shipped[pos], shipped[pos + 1])
+                if self._dict_form != "index" and sg.device is None:
                     # device copy is authoritative; index-form keeps the
-                    # host copy so consumers read pools without a D2H trip
+                    # host copy so consumers read pools without a D2H
+                    # trip, and mesh-placed groups keep it so OTHER
+                    # devices can still ship the same pool
                     self._sdict_host.pop(key, None)
             pos += 2
         return shipped
@@ -2940,9 +3044,10 @@ class TpuRowGroupReader:
             return self._decode_shipped_compute(sg, shipped)
         first, slab_dev = shipped[0], shipped[1]
         parts = first if isinstance(first, tuple) else (first,)
+        sdict_dev = self._sdict_dev_for(sg.device)
         extra_args = []
         for key in sg.extra_keys:
-            rows_d, lens_d = self._sdict_dev[key]
+            rows_d, lens_d = sdict_dev[key]
             extra_args.append(rows_d)
             extra_args.append(lens_d)
         if out_perm is not None and any(
@@ -2972,7 +3077,7 @@ class TpuRowGroupReader:
                 args.append(perm)
             outs = _run_fused(
                 sg.program, len(parts), args, out_perm is not None,
-                device=self.device,
+                device=sg.device if sg.device is not None else self.device,
             )
         result: Dict[str, DeviceColumn] = {}
         for spec, desc, (vals, mask, lens, defs, reps) in zip(
@@ -2996,7 +3101,7 @@ class TpuRowGroupReader:
             return (
                 ("host_str", key, *host_pool)
                 if host_pool is not None
-                else ("dev", key, *self._sdict_dev[key])
+                else ("dev", key, *self._sdict_dev_for(sg.device)[key])
             )
         if spec.kind == "dict_idx_num":
             return ("host", None, sg.host_pools[spec.name])
@@ -3014,9 +3119,10 @@ class TpuRowGroupReader:
         built = sg.compute
         first, slab_dev = shipped[0], shipped[1]
         parts = first if isinstance(first, tuple) else (first,)
+        sdict_dev = self._sdict_dev_for(sg.device)
         extra_args = []
         for key in sg.extra_keys:
-            rows_d, lens_d = self._sdict_dev[key]
+            rows_d, lens_d = sdict_dev[key]
             extra_args.append(rows_d)
             extra_args.append(lens_d)
         nm = len(built.masks)
@@ -3030,7 +3136,10 @@ class TpuRowGroupReader:
                             observe="engine.launch_seconds"):
                 return _run_fused(
                     sg.program, len(parts), args, False,
-                    device=self.device, cplan=cplan,
+                    device=(
+                        sg.device if sg.device is not None else self.device
+                    ),
+                    cplan=cplan,
                 )
 
         cp = built.cplan
@@ -3280,15 +3389,38 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
     byte-stability across runs matters.  ``engine.stage_queue_depth_max``
     gauges how deep the submitted-but-undelivered queue actually got.
 
+    With a device MESH active (``parallel.mesh.mesh_devices()`` — on
+    by default on a multi-device accelerator backend, opt-in via
+    ``PFTPU_MESH_DEVICES`` elsewhere), staged groups round-robin across
+    the k local devices: each device gets its OWN single-worker ship
+    pool (H2D transfers overlap across chips, stay serialized per
+    chip), its own dictionary pool, and its own persistent exec-cache
+    entry (the cache key carries ``platform:id``), and the fused decode
+    dispatches ON the device's worker.  Delivery order is still strict
+    submission order — the queue pops in the order groups were
+    submitted and each entry's future completes on its own device — so
+    every read face inherits the fan-out bit-identically (padded
+    string widths follow the ``PFTPU_STAGE_WORKERS>1`` contract;
+    docs/multichip.md).  The stage pool defaults to k workers and the
+    prefetch depth to 2k so every chip has work; big groups and
+    salvage units keep the single-device path.
+
     Because tasks pull lazily, files open DEPTH-ahead of consumption
     and close right after their last scheduled group (``close_after``)
     — the fd-bounded form ``iter_dataset_row_groups`` documents."""
     import os as _os
 
+    from ..parallel import mesh as _mesh
+
     want = set(columns) if columns else None
+    mesh_devs = _mesh.mesh_devices() if prefetch else []
+    mesh_on = len(mesh_devs) > 1
     DEPTH = max(1, int(
         _os.environ.get("PFTPU_PREFETCH_DEPTH", default_depth)
     ))
+    if mesh_on and "PFTPU_PREFETCH_DEPTH" not in _os.environ:
+        # keep every chip fed: k groups decoding + k staging ahead
+        DEPTH = max(DEPTH, 2 * len(mesh_devs))
     # stage/ship tasks bind to the caller's tracer scope: concurrent
     # scans under separate trace.scope()s keep their stage‖ship spans
     # attributed even though each scan spawns its own worker threads
@@ -3348,23 +3480,49 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
             sg = stage_fut.result()
             return r, sg, r._ship(sg)
 
+        def mesh_ship_task(r, stage_fut, perm):
+            # runs on the group's DEVICE worker: ship + decode dispatch
+            # both happen chip-locally, so k chips transfer and warm
+            # their exec-cache entries concurrently; the consumer only
+            # collects the (already in-flight) result, in order
+            sg = stage_fut.result()
+            shipped = r._ship(sg)
+            return r._decode_shipped(sg, shipped, out_perm=perm)
+
         stage_workers = min(DEPTH, max(1, int(
-            _os.environ.get("PFTPU_STAGE_WORKERS", "1")
+            _os.environ.get(
+                "PFTPU_STAGE_WORKERS",
+                str(len(mesh_devs)) if mesh_on else "1",
+            )
         )))
         # salvage decodes mutate per-reader report state and must fold
         # deterministically — they serialize through this lock even
         # when the stage pool runs several workers
         salv_lock = threading.Lock()
 
-        def salv_task(r, gi, perm):
+        def salv_task(r, gi, perm, cov):
             with salv_lock:
-                return r._read_row_group_salvage(gi, columns, perm)
+                out = r._read_row_group_salvage(
+                    gi, columns, perm, row_ranges=cov
+                )
+                return out[0] if cov is not None else out
+
+        if mesh_on:
+            trace.decision("engine.mesh", {
+                "devices": len(mesh_devs),
+                "platform": getattr(mesh_devs[0], "platform", "?"),
+            })
+            trace.gauge_max("engine.mesh_devices", len(mesh_devs))
+        rr = 0  # round-robin cursor over mesh_devs
 
         with ThreadPoolExecutor(max_workers=stage_workers,
                                 thread_name_prefix="pftpu-stage") as sp, \
                 ThreadPoolExecutor(max_workers=1,
-                                   thread_name_prefix="pftpu-ship") as shp:
+                                   thread_name_prefix="pftpu-ship") as shp, \
+                _mesh.DevicePools(mesh_devs if mesh_on else []) as dpools:
             # entries: ("pipe", reader, close_after, perm, ship_future),
+            # ("pipem", reader, close_after, decode_future) — the mesh
+            # placement: ship AND decode ride the group's device worker,
             # ("big", reader, group_index, close_after, perm), or
             # ("salv", reader, close_after, future) — salvage readers
             # host-decode each group on the stage worker (one-deep
@@ -3374,7 +3532,7 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
             blocked = False  # a big group is queued: stop submitting
 
             def submit_one():
-                nonlocal blocked
+                nonlocal blocked, rr
                 if blocked:
                     return False
                 item = next(task_iter, None)
@@ -3382,7 +3540,7 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
                     return False
                 r, gi, ca, perm, comp, cov = norm(item)
                 if getattr(r, "_salvage", False):
-                    f = sp.submit(tracer.run, salv_task, r, gi, perm)
+                    f = sp.submit(tracer.run, salv_task, r, gi, perm, cov)
                     q.append(("salv", r, ca, f))
                     trace.gauge_max("engine.stage_queue_depth_max", len(q))
                     return True
@@ -3419,15 +3577,32 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
                         kwargs.update(compute=(
                             comp, set(columns) if columns else None
                         ))
-                    f = sp.submit(
-                        tracer.run, partial(
-                            r._stage_row_group, gi, columns, **kwargs
-                        ),
-                    )
-                    q.append((
-                        "pipe", r, ca, perm,
-                        shp.submit(tracer.run, ship_task, r, f),
-                    ))
+                    if mesh_on:
+                        dev = mesh_devs[rr % len(mesh_devs)]
+                        rr += 1
+                        kwargs.update(device=dev)
+                        f = sp.submit(
+                            tracer.run, partial(
+                                r._stage_row_group, gi, columns, **kwargs
+                            ),
+                        )
+                        trace.count("engine.mesh_groups")
+                        q.append((
+                            "pipem", r, ca,
+                            dpools.submit(
+                                dev, tracer.run, mesh_ship_task, r, f, perm
+                            ),
+                        ))
+                    else:
+                        f = sp.submit(
+                            tracer.run, partial(
+                                r._stage_row_group, gi, columns, **kwargs
+                            ),
+                        )
+                        q.append((
+                            "pipe", r, ca, perm,
+                            shp.submit(tracer.run, ship_task, r, f),
+                        ))
                 trace.gauge_max("engine.stage_queue_depth_max", len(q))
                 return True
 
@@ -3441,6 +3616,9 @@ def _iter_pipeline_stream(task_iter, columns, prefetch: bool,
                     yield read_direct(r, gi, perm, comp, cov)
                     blocked = False
                 elif entry[0] == "salv":
+                    _, r, ca, fut = entry
+                    yield fut.result()
+                elif entry[0] == "pipem":
                     _, r, ca, fut = entry
                     yield fut.result()
                 else:

@@ -83,7 +83,32 @@ def _env_signature() -> dict:
     }
 
 
-_compile_lock = threading.Lock()
+_compile_lock = threading.Lock()      # guards the per-key lock table
+_compile_locks: dict = {}             # key -> threading.Lock (capped)
+_MAX_KEY_LOCKS = 256                  # programs are few; this never trims
+#                                       a lock someone still holds (locks
+#                                       are only dropped when un-held)
+_flag_lock = threading.Lock()         # guards the refcounted flag flip
+_flag_depth = 0
+_flag_prev = False
+
+
+def _key_compile_lock(key: str) -> threading.Lock:
+    """One lock PER CACHE KEY, so k mesh devices compiling k distinct
+    entries proceed concurrently while two threads racing the SAME
+    program still serialize (exactly one compiles; the loser finds the
+    published entry)."""
+    with _compile_lock:
+        lk = _compile_locks.get(key)
+        if lk is None:
+            if len(_compile_locks) >= _MAX_KEY_LOCKS:
+                for k in list(_compile_locks):
+                    if not _compile_locks[k].locked():
+                        del _compile_locks[k]
+                        if len(_compile_locks) < _MAX_KEY_LOCKS:
+                            break
+            lk = _compile_locks[key] = threading.Lock()
+        return lk
 
 # Interpreter-exit protocol for in-flight preloads: a DAEMON thread
 # reaped mid-XLA-deserialize aborts the whole process ("terminate
@@ -121,27 +146,41 @@ def _register_preload_shutdown() -> None:
         atexit.register(_stop_preloads)
 
 
-def _compile_fresh(jitfn, static_args, args):
+def _compile_fresh(jitfn, static_args, args, key: str = ""):
     """``lower().compile()`` with jax's OWN persistent compilation
     cache bypassed.  An executable jax's cache deserialized cannot be
     re-serialized faithfully on XLA:CPU (the payload loads with
     "Symbols not found"), so an entry built from one poisons every
     later process — this cache must only ever serialize executables it
-    freshly compiled.  The flag flip is process-global; the lock keeps
-    concurrent resolutions from restoring it mid-compile (a concurrent
-    unrelated compile merely skips jax's cache once — slower, never
-    wrong)."""
+    freshly compiled.
+
+    The flag flip is process-global, but compiles must NOT serialize
+    process-wide: a k-device mesh warms k per-device entries
+    concurrently (docs/multichip.md).  So the suspension is
+    REFCOUNTED — the first compile in flight flips the flag off, the
+    last one restores it — and mutual exclusion is per cache KEY
+    (``_key_compile_lock``), so distinct programs (or one program's
+    distinct per-device entries) compile in parallel while a same-key
+    race still resolves to one compile.  A concurrent unrelated
+    jax compile merely skips jax's cache while any of ours is in
+    flight — slower, never wrong."""
     import jax
 
-    with _compile_lock:
-        prev = bool(jax.config.jax_enable_compilation_cache)
-        if prev:
-            jax.config.update("jax_enable_compilation_cache", False)
+    global _flag_depth, _flag_prev
+    with _key_compile_lock(key):
+        with _flag_lock:
+            if _flag_depth == 0:
+                _flag_prev = bool(jax.config.jax_enable_compilation_cache)
+                if _flag_prev:
+                    jax.config.update("jax_enable_compilation_cache", False)
+            _flag_depth += 1
         try:
             return jitfn.lower(*static_args, *args).compile()
         finally:
-            if prev:
-                jax.config.update("jax_enable_compilation_cache", True)
+            with _flag_lock:
+                _flag_depth -= 1
+                if _flag_depth == 0 and _flag_prev:
+                    jax.config.update("jax_enable_compilation_cache", True)
 
 
 class _Entry:
@@ -529,7 +568,7 @@ class ExecutableCache:
 
     def _compile(self, jitfn, static_args, args, key: str, why: str):
         t0 = time.perf_counter()
-        compiled = _compile_fresh(jitfn, static_args, args)
+        compiled = _compile_fresh(jitfn, static_args, args, key)
         dt_ms = (time.perf_counter() - t0) * 1e3
         trace.count("engine.compile_ms", int(round(dt_ms)))
         trace.decision("engine.exec_cache", {

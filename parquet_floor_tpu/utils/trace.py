@@ -183,6 +183,15 @@ class names:
         "compact.rows_in",
         "compact.rows_dropped",
         "compact.groups_out",
+        # the multi-chip scan mesh (parallel/mesh.py, tpu/engine.py,
+        # docs/multichip.md): groups placed on a mesh device
+        "engine.mesh_groups",
+        # host inflate moved into the stage task (decompressed output
+        # bytes of the arena's codec jobs, docs/multichip.md)
+        "scan.inflate_bytes",
+        # ranged salvage reads: chunks whose pruned decode tripped a
+        # salvageable error and widened to the whole-chunk ladder
+        "salvage.ranged_widens",
     })
     GAUGES = frozenset({
         "scan.inflight_bytes_max",
@@ -194,6 +203,8 @@ class names:
         "serve.inflight_storage_bytes_max",
         "serve.daemon_inflight_max",
         "write.inflight_groups_max",
+        # mesh width the pipeline actually scheduled across
+        "engine.mesh_devices",
     })
     DECISIONS = frozenset({
         "engine.auto",
@@ -233,6 +244,9 @@ class names:
         "serve.fleet",
         # remote-chain coalescing-gap auto-tune (scan/executor.py)
         "scan.max_gap_autotuned",
+        # the multi-chip scan mesh: one event per pipeline that went
+        # multi-device (device count + platform)
+        "engine.mesh",
     })
     SPANS = frozenset({
         "read",
@@ -250,6 +264,9 @@ class names:
         "serve.aggregate",
         "write.encode",
         "write.emit",
+        # host codec decompression inside the stage task (the overlap
+        # the multichip bench leg measures, docs/multichip.md)
+        "inflate",
     })
     # latency/size distributions (Tracer.observe -> LogHistogram;
     # docs/observability.md).  Values are SECONDS unless the name says
@@ -275,6 +292,7 @@ class names:
         "engine.stage_seconds",          # one group's host staging wall
         "engine.ship_seconds",           # one H2D transfer wall
         "engine.launch_seconds",         # one fused decode dispatch wall
+        "scan.inflate_seconds",          # one group's host inflate wall
         # the training loader and the write path
         "data.next_batch_seconds",       # one loader next() wall
         "write.emit_seconds",            # one group's ordered sink emission
@@ -375,6 +393,13 @@ class _Span:
         )
         if self._observe is not None:
             self._tracer.observe(self._observe, dur)
+            charge = self._tracer.device_charge
+            if charge is not None and self._observe in (
+                "engine.ship_seconds", "engine.launch_seconds",
+            ):
+                # device-time spans bill the owning tenant's WFQ ledger
+                # (serve/tenancy.py wires the hook; no-op otherwise)
+                charge(dur)
         self._tracer._event("E", self._stage, t1, None)
         return False
 
@@ -745,6 +770,13 @@ class Tracer:
         self._events: deque = deque()   # (ph, name, ts, tid, attrs)
         self._thread_names: Dict[int, str] = {}
         self._epoch = time.perf_counter()
+        # fairness-ledger hook (serve/tenancy.py): when a Tenant owns
+        # this tracer it sets device_charge = tenant.charge_device, and
+        # every ship/launch span recorded under the scope bills its
+        # wall to the WFQ ledger automatically — the engine needs no
+        # tenancy import, and a mesh's per-device workers charge from
+        # whatever thread they run on (docs/serving.md)
+        self.device_charge = None
 
     # -- switches -----------------------------------------------------------
 

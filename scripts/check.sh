@@ -108,6 +108,15 @@ echo "== fleet smoke (ownership, host loss, fencing, admission, fold) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python scripts/fleet_smoke.py || exit 1
 
+# Multi-chip mesh smoke (docs/multichip.md): a forced 4-device CPU
+# mesh scan must deliver bit-identically to the single-device pass,
+# place every group (engine.mesh_groups == groups == engine.launches),
+# and spread them round-robin across all 4 devices (per-device floor).
+echo "== multi-chip mesh smoke (forced 4 CPU devices) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+  python scripts/mesh_smoke.py || exit 1
+
 # Salvage differential smoke: 60 seeded corruption cases through ALL
 # FOUR read faces (sequential host, host scan, device scan, loader),
 # asserting unanimous fatality, identical quarantine sets, identical

@@ -145,6 +145,7 @@ def check_report(bench_log: pathlib.Path) -> int:
         or check_fleet_leg(result.get("detail", {}))
         or check_histograms(result.get("detail", {}))
         or check_exec_cache_leg(result.get("detail", {}))
+        or check_multichip_leg(result.get("detail", {}))
         or check_launches(result.get("detail", {}))
         or check_loader_leg(result.get("detail", {}))
         or check_pushdown_leg(result.get("detail", {}))
@@ -274,6 +275,58 @@ def check_exec_cache_leg(detail: dict) -> int:
         "check_bench_report: exec-cache leg ok "
         f"(cold {cold_wall} ms -> warm {warm_wall} ms, {speedup:.1f}x; "
         f"cold compile {detail['exec_cache_cold_compile_ms']} ms)"
+    )
+    return 0
+
+
+def check_multichip_leg(detail: dict) -> int:
+    """The multi-chip scheduler leg (docs/multichip.md): delivery must
+    be bit-identical across the serial / single-device / mesh passes,
+    every group must have been mesh-placed and fused-dispatched exactly
+    once, the inflate-overlap fraction must be >= 0.5 (the serial
+    baseline shows what unoverlapped looks like), and on a real
+    accelerator mesh (``multichip_gate_expected``) the mesh pass must
+    deliver >= 0.7*k the single-chip throughput."""
+    groups = detail.get("multichip_groups")
+    if not groups or not groups > 0:
+        return fail("multichip leg delivered no groups")
+    if detail.get("multichip_bit_identical") is not True:
+        return fail("multichip delivery is not bit-identical across the "
+                    "serial / single / mesh passes")
+    if detail.get("multichip_mesh_groups") != groups:
+        return fail(f"multichip scheduler placed "
+                    f"{detail.get('multichip_mesh_groups')!r} groups on "
+                    f"the mesh, expected all {groups}")
+    if detail.get("multichip_launches") != groups:
+        return fail(f"multichip mesh pass dispatched "
+                    f"{detail.get('multichip_launches')!r} launches for "
+                    f"{groups} groups — the mesh moves launches, it "
+                    "must never multiply them")
+    if detail.get("multichip_events_dropped", 0) != 0:
+        return fail("multichip mesh pass dropped timeline events — the "
+                    "overlap fraction below is not trustworthy")
+    overlap = detail.get("multichip_overlap_fraction")
+    if overlap is None:
+        return fail("multichip leg measured no inflate overlap (no "
+                    "inflate span closed — wrong codec?)")
+    if not overlap >= 0.5:
+        return fail(f"multichip inflate overlap is {overlap:.2f} "
+                    f"(serial baseline "
+                    f"{detail.get('multichip_overlap_serial', 0):.2f}) — "
+                    "host inflate must hide under pipeline work")
+    k = detail.get("multichip_devices", 0)
+    speedup = detail.get("multichip_speedup_x")
+    if detail.get("multichip_gate_expected"):
+        if speedup is None or not speedup >= 0.7 * k:
+            return fail(f"multichip mesh speedup is {speedup!r}x on a "
+                        f"{k}-device accelerator mesh, gate is "
+                        f">= {0.7 * k:.1f}x")
+    print(
+        "check_bench_report: multichip leg ok "
+        f"({groups} groups over {k} devices on "
+        f"{detail.get('multichip_platform')}, overlap {overlap:.2f}, "
+        f"speedup {speedup!r}x, gate "
+        f"{'ENFORCED' if detail.get('multichip_gate_expected') else 'parity-only'})"
     )
     return 0
 

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""One multi-chip scan measurement (docs/multichip.md) — the subprocess
+half of bench.py's multichip leg.
+
+Usage: multichip_probe.py PARQUET_FILE
+
+Runs THREE passes over every row group of ``PARQUET_FILE`` through the
+device engine and prints ONE JSON line:
+
+* **serial** — the sequential per-group reader loop (no pipeline): the
+  overlap baseline, its inflate wall runs on the one consumer thread;
+* **single** — the pipelined scan with the mesh OFF
+  (``PFTPU_MESH_DEVICES=0``): the single-chip throughput reference;
+* **mesh** — the pipelined scan round-robined across the local devices
+  (``PFTPU_MESH_DEVICES=<k>``).
+
+The digest is a CRC over every delivered group's CANONICAL content
+(strings trimmed to their lengths — pad widths follow staging order and
+are not contractual) so the three passes must match bit-for-bit.  The
+overlap fraction is the share of total ``inflate`` span wall that ran
+concurrently with pipeline spans (stage/inflate/ship/decode) on OTHER
+threads — what the stage pool actually hid under device work.
+
+The caller owns device-count forcing: on CPU it sets
+``XLA_FLAGS=--xla_force_host_platform_device_count=4`` before this
+process imports jax.
+"""
+
+import json
+import os
+import sys
+import time
+import zlib
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_PIPE_SPANS = ("stage", "inflate", "ship", "decode")
+
+
+def _intervals(events):
+    """Closed ``(name, tid, t0, t1)`` spans off the raw timeline."""
+    open_, out = {}, []
+    for ph, name, ts, tid, _attrs in events:
+        if ph == "B":
+            open_.setdefault((tid, name), []).append(ts)
+        elif ph == "E":
+            stack = open_.get((tid, name))
+            if stack:
+                out.append((name, tid, stack.pop(), ts))
+    return out
+
+
+def _overlap_fraction(events):
+    """Share of total inflate wall covered by other-thread pipeline
+    spans; None when no inflate span closed (nothing to measure)."""
+    iv = _intervals(events)
+    inflate = [(t0, t1, tid) for n, tid, t0, t1 in iv if n == "inflate"]
+    others = [(t0, t1, tid) for n, tid, t0, t1 in iv if n in _PIPE_SPANS]
+    total = sum(t1 - t0 for t0, t1, _ in inflate)
+    if total <= 0:
+        return None
+    covered = 0.0
+    for t0, t1, tid in inflate:
+        segs = sorted(
+            (max(t0, a), min(t1, b))
+            for a, b, otid in others
+            if otid != tid and b > t0 and a < t1
+        )
+        hi = t0
+        for a, b in segs:
+            a = max(a, hi)
+            if b > a:
+                covered += b - a
+                hi = b
+    return covered / total
+
+
+def _digest(cols, digest):
+    import numpy as np
+
+    for name in sorted(cols):
+        c = cols[name]
+        v = np.asarray(c.values)
+        ln = None if c.lengths is None else np.asarray(c.lengths)
+        m = getattr(c, "mask", None)
+        if ln is not None and v.ndim == 2:
+            digest = zlib.crc32(np.ascontiguousarray(ln).tobytes(), digest)
+            digest = zlib.crc32(
+                b"".join(v[i, : int(ln[i])].tobytes()
+                         for i in range(v.shape[0])),
+                digest,
+            )
+        else:
+            if m is not None:
+                mm = np.asarray(m)
+                v = np.where(mm, np.zeros_like(v), v)
+            digest = zlib.crc32(np.ascontiguousarray(v).tobytes(), digest)
+        if m is not None:
+            digest = zlib.crc32(
+                np.ascontiguousarray(np.asarray(m)).tobytes(), digest
+            )
+    return digest
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print("usage: multichip_probe.py PARQUET_FILE", file=sys.stderr)
+        return 2
+    path = argv[1]
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from parquet_floor_tpu import ParquetFileReader
+    from parquet_floor_tpu.scan import scan_device_groups
+    from parquet_floor_tpu.tpu.engine import TpuRowGroupReader
+    from parquet_floor_tpu.utils import trace
+
+    devs = jax.local_devices()
+    k = min(4, len(devs))
+    platform = devs[0].platform if devs else "none"
+
+    def serial_pass():
+        os.environ["PFTPU_MESH_DEVICES"] = "0"
+        with trace.scope() as t:
+            t0 = time.perf_counter()
+            digest, groups = 0, 0
+            with TpuRowGroupReader(ParquetFileReader(path)) as r:
+                for gi in range(len(r.reader.row_groups)):
+                    digest = _digest(r.read_row_group(gi), digest)
+                    groups += 1
+            wall = time.perf_counter() - t0
+        return wall, digest, groups, t
+
+    def scan_pass(mesh_k):
+        os.environ["PFTPU_MESH_DEVICES"] = str(mesh_k)
+        with trace.scope() as t:
+            t0 = time.perf_counter()
+            digest, groups = 0, 0
+            for _fi, _gi, cols in scan_device_groups([path]):
+                digest = _digest(cols, digest)
+                groups += 1
+            wall = time.perf_counter() - t0
+        return wall, digest, groups, t
+
+    wall_serial, dig_serial, groups, t_serial = serial_pass()
+    wall_single, dig_single, g_single, _ = scan_pass(0)
+    wall_mesh, dig_mesh, g_mesh, t_mesh = scan_pass(k)
+    c = t_mesh.counters()
+
+    print(json.dumps({
+        "platform": platform,
+        "devices": k,
+        "groups": groups,
+        "wall_serial_ms": round(wall_serial * 1e3, 1),
+        "wall_single_ms": round(wall_single * 1e3, 1),
+        "wall_mesh_ms": round(wall_mesh * 1e3, 1),
+        "bit_identical": dig_serial == dig_single == dig_mesh
+        and groups == g_single == g_mesh,
+        "mesh_groups": c.get("engine.mesh_groups", 0),
+        "launches": c.get("engine.launches", 0),
+        "overlap_fraction": _overlap_fraction(t_mesh.events()),
+        "overlap_serial": _overlap_fraction(t_serial.events()) or 0.0,
+        "events_dropped": c.get("trace.events_dropped", 0),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

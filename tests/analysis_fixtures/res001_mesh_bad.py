@@ -1,0 +1,25 @@
+"""Seeded-bad: the per-device pool leak shapes (docs/multichip.md) — a
+DevicePools (owns one worker thread per mesh device) bound with no
+exception path releasing it, and acquisitions collected INTO a local
+container whose members nothing ever shuts down."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+from parquet_floor_tpu.parallel.mesh import DevicePools
+
+
+def ship_all(devices, groups, ship):
+    dpools = DevicePools(devices)
+    futs = [dpools.submit(d, ship, g)  # a raise here leaks k workers
+            for d, g in zip(devices, groups)]
+    out = [f.result() for f in futs]
+    dpools.shutdown()
+    return out
+
+
+def ship_handrolled(devices, groups, ship):
+    pools = {}
+    for d in devices:
+        pools[d] = ThreadPoolExecutor(max_workers=1)  # members never shut
+    return [pools[d].submit(ship, g).result()
+            for d, g in zip(devices, groups)]

@@ -1,0 +1,27 @@
+"""Seeded-good: the per-device pool shapes released correctly — a
+with-managed DevicePools, and a hand-rolled per-device container whose
+members are shut down by ITERATING it in a finally guard (the
+DevicePools.shutdown shape FL-RES001 must recognize)."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+from parquet_floor_tpu.parallel.mesh import DevicePools
+
+
+def ship_all(devices, groups, ship):
+    with DevicePools(devices) as dpools:
+        futs = [dpools.submit(d, ship, g)
+                for d, g in zip(devices, groups)]
+        return [f.result() for f in futs]
+
+
+def ship_handrolled(devices, groups, ship):
+    pools = {}
+    try:
+        for d in devices:
+            pools[d] = ThreadPoolExecutor(max_workers=1)
+        return [pools[d].submit(ship, g).result()
+                for d, g in zip(devices, groups)]
+    finally:
+        for p in pools.values():
+            p.shutdown(wait=False)

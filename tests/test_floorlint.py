@@ -46,6 +46,10 @@ CASES = [
     #                               are acquisitions too)
     ("res001_fleet", "FL-RES001"),  # fleet fabric: FleetCache owns its
     #                               peer sockets, PeerClient one socket
+    ("res001_mesh", "FL-RES001"),  # per-device pools: DevicePools + the
+    #                               container-of-acquisitions shape
+    #                               (good pins iterate-release in
+    #                               finally)
     ("alloc001", "FL-ALLOC001"),
     ("obs001", "FL-OBS001"),
     ("lock001", "FL-LOCK001"),

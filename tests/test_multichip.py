@@ -1,0 +1,301 @@
+"""Multi-chip scan scheduler (``parallel/mesh.py`` + the engine's
+(row group → device) placement; docs/multichip.md).
+
+The load-bearing claims pinned here, all on the conftest's forced
+8-device CPU mesh (``--xla_force_host_platform_device_count=8``):
+
+* placement policy: CPU defaults OFF, ``PFTPU_MESH_DEVICES`` opts in /
+  caps / disables, read at CALL time so env changes take effect;
+* delivery is strictly in submission order and the decoded values are
+  bit-identical to the single-device path (the whole speedup argument
+  rests on this — every read face inherits it for free);
+* per-device exec-cache entries: the key carries ``platform:id`` so k
+  devices warm k DISTINCT persistent entries, and compilation locking
+  is per-key (two devices' first compiles proceed concurrently);
+* the DataLoader's mid-epoch checkpoint/resume stays bit-identical
+  with the mesh on;
+* abandoning a mesh scan drains every per-device ship worker;
+* a tenant-bound sharded scan's device seconds land in that tenant's
+  ledger (``Tenant.charge_device`` via the tracer hook).
+"""
+
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from parquet_floor_tpu import ReaderOptions, trace
+from parquet_floor_tpu.parallel import mesh
+from parquet_floor_tpu.scan import scan_device_groups
+from parquet_floor_tpu.serve.tenancy import Serving
+
+from tests.test_scan import _write
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mesh_ds")
+    return [_write(str(d / f"f{i}.parquet"), seed=i) for i in range(4)]
+
+
+def _canon(cols):
+    """Comparable content of one delivered group: raw values, strings
+    trimmed to their lengths (pad widths follow staging order and are
+    NOT contractual — the values are)."""
+    out = {}
+    for name, dc in sorted(cols.items()):
+        v = np.asarray(dc.values)
+        if getattr(dc, "lengths", None) is not None:
+            ls = np.asarray(dc.lengths)
+            out[name] = [bytes(row[:l]) for row, l in zip(v, ls)]
+        else:
+            out[name] = v.tobytes()
+        if getattr(dc, "mask", None) is not None:
+            out[name + "#mask"] = np.asarray(dc.mask).tobytes()
+    return out
+
+
+def _scan(paths, **kw):
+    got = []
+    for fi, gi, cols in scan_device_groups(paths, columns=["k", "d", "s"],
+                                           **kw):
+        got.append((fi, gi, _canon(cols)))
+    return got
+
+
+# ---------------------------------------------------------------------------
+# placement policy
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_policy_cpu_defaults_off(monkeypatch):
+    monkeypatch.delenv("PFTPU_MESH_DEVICES", raising=False)
+    assert mesh.mesh_devices() == []
+    assert not mesh.mesh_enabled()
+
+
+def test_mesh_policy_env_read_at_call_time(monkeypatch):
+    monkeypatch.setenv("PFTPU_MESH_DEVICES", "4")
+    devs = mesh.mesh_devices()
+    assert len(devs) == 4
+    assert devs == jax.local_devices()[:4]
+    monkeypatch.setenv("PFTPU_MESH_DEVICES", "all")
+    assert mesh.mesh_devices() == jax.local_devices()
+    for off in ("0", "1"):
+        monkeypatch.setenv("PFTPU_MESH_DEVICES", off)
+        assert mesh.mesh_devices() == []
+    monkeypatch.setenv("PFTPU_MESH_DEVICES", "many")
+    with pytest.raises(ValueError, match="PFTPU_MESH_DEVICES"):
+        mesh.mesh_devices()
+
+
+def test_device_pools_contract():
+    devs = jax.local_devices()[:3]
+    with mesh.DevicePools(devs) as dp:
+        assert len(dp) == 3
+        names = [
+            dp.submit(d, lambda: threading.current_thread().name).result()
+            for d in devs
+        ]
+        assert all(n.startswith("pftpu-devship") for n in names)
+        assert len(set(names)) == 3          # one worker PER device
+        # per-device serialization: two tasks on one device run in
+        # submission order on the same thread
+        order = []
+        f1 = dp.submit(devs[0], lambda: order.append(1))
+        f2 = dp.submit(devs[0], lambda: order.append(2))
+        f2.result(), f1.result()
+        assert order == [1, 2]
+    dp.shutdown()  # idempotent after __exit__
+    with pytest.raises(RuntimeError):
+        dp.submit(devs[0], lambda: None)
+
+
+# ---------------------------------------------------------------------------
+# delivery bit-identity + scheduler accounting
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_scan_delivery_bit_identical(dataset, monkeypatch):
+    monkeypatch.delenv("PFTPU_MESH_DEVICES", raising=False)
+    single = _scan(dataset)
+    n_groups = len(single)
+    assert n_groups == 8  # 4 files x 2 groups
+
+    monkeypatch.setenv("PFTPU_MESH_DEVICES", "4")
+    with trace.scope() as t:
+        meshed = _scan(dataset)
+    assert [(fi, gi) for fi, gi, _ in meshed] == \
+        [(fi, gi) for fi, gi, _ in single]            # strict order
+    assert meshed == single                           # bit-identical
+    c = t.counters()
+    assert c.get("engine.mesh_groups") == n_groups    # all groups placed
+    assert c.get("engine.launches") == n_groups       # one launch each
+    assert t.gauges().get("engine.mesh_devices") == 4
+    assert any(d.get("decision") == "engine.mesh" for d in t.decisions())
+
+
+def test_mesh_scan_salvage_face_unchanged(dataset, tmp_path, monkeypatch):
+    """Salvage units keep the single-device path under the mesh — the
+    damaged-unit quarantine face is identical with the mesh on."""
+    from tests.test_scan import _break_required_chunk
+
+    paths = list(dataset)
+    paths[1] = _break_required_chunk(dataset[1], tmp_path, 1, "k", "meshq")
+    opts = ReaderOptions(salvage=True)
+    monkeypatch.delenv("PFTPU_MESH_DEVICES", raising=False)
+    single = _scan(paths, options=opts)
+    monkeypatch.setenv("PFTPU_MESH_DEVICES", "4")
+    assert _scan(paths, options=opts) == single
+
+
+# ---------------------------------------------------------------------------
+# per-device exec-cache entries, per-key compile locking
+# ---------------------------------------------------------------------------
+
+
+def test_exec_cache_per_device_entries(tmp_path):
+    from parquet_floor_tpu.tpu.exec_cache import ExecutableCache
+
+    cache = ExecutableCache(str(tmp_path))
+    fn = jax.jit(lambda x: x * 2 + 1)
+    args = [np.arange(16, dtype=np.int64)]
+    devs = jax.local_devices()[:2]
+    outs = [np.asarray(cache.call(fn, (), args, device=d)) for d in devs]
+    entries = [n for n in os.listdir(tmp_path) if n.endswith(".pfexec")]
+    assert len(set(entries)) == 2   # same program, one entry PER device
+    assert np.array_equal(outs[0], outs[1])
+    # a repeat on either device hits its own entry, no new file
+    np.asarray(cache.call(fn, (), args, device=devs[0]))
+    assert sorted(
+        n for n in os.listdir(tmp_path) if n.endswith(".pfexec")
+    ) == sorted(entries)
+
+
+def test_compile_locks_are_per_key():
+    """Two devices' first compiles must not contend on one global lock:
+    the barrier below only releases if both keys' critical sections are
+    held CONCURRENTLY (a shared lock would break the barrier)."""
+    from parquet_floor_tpu.tpu import exec_cache as ec
+
+    ka = ec._key_compile_lock("meshlock-a")
+    assert ka is ec._key_compile_lock("meshlock-a")      # stable per key
+    assert ka is not ec._key_compile_lock("meshlock-b")  # distinct keys
+
+    bar = threading.Barrier(2)
+    errs = []
+
+    def hold(key):
+        try:
+            with ec._key_compile_lock(key):
+                bar.wait(timeout=10)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=hold, args=(k,))
+          for k in ("meshlock-a", "meshlock-b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=20)
+    assert errs == []
+
+
+def test_concurrent_compiles_restore_compilation_cache_flag():
+    from parquet_floor_tpu.tpu import exec_cache as ec
+
+    prev = bool(jax.config.jax_enable_compilation_cache)
+    fns = [jax.jit(lambda x: x + 1), jax.jit(lambda x: x - 1)]
+    args = [np.arange(8, dtype=np.int64)]
+    errs = []
+
+    def compile_one(i):
+        try:
+            ec._compile_fresh(fns[i], (), args, key=f"meshflag-{i}")
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=compile_one, args=(i,)) for i in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert errs == []
+    assert ec._flag_depth == 0  # refcount fully unwound
+    assert bool(jax.config.jax_enable_compilation_cache) == prev
+
+
+# ---------------------------------------------------------------------------
+# loader resume, abandonment, tenancy
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_loader_resume_bit_identical(dataset, monkeypatch):
+    from tests.test_data import _stream
+
+    kw = dict(engine="tpu", loader_kw={"float64_policy": "float64"},
+              num_epochs=1)
+    monkeypatch.delenv("PFTPU_MESH_DEVICES", raising=False)
+    single = _stream(dataset, **kw)
+    monkeypatch.setenv("PFTPU_MESH_DEVICES", "4")
+    assert _stream(dataset, **kw) == single
+    assert _stream(dataset, restore_at=3, **kw) == single[3:]
+
+
+def _devship_threads():
+    return [t for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith("pftpu-devship")]
+
+
+def test_mesh_abandonment_drains_device_workers(dataset, monkeypatch):
+    from parquet_floor_tpu import ParquetFileReader
+    from parquet_floor_tpu.tpu.engine import (
+        TpuRowGroupReader,
+        iter_dataset_row_groups,
+    )
+
+    monkeypatch.setenv("PFTPU_MESH_DEVICES", "4")
+    opened = []
+
+    def opener(fi):
+        def open_():
+            r = TpuRowGroupReader(ParquetFileReader(dataset[fi]))
+            opened.append(r)
+            return r
+        return open_
+
+    def stream():
+        for fi in range(4):
+            yield (opener(fi), 0, False)
+            yield (opener(fi), 1, True)
+
+    gen = iter_dataset_row_groups(stream(), columns=["k"])
+    next(gen)
+    assert _devship_threads()  # the mesh really span up per-device workers
+    gen.close()                # abandon mid-stream
+    assert all(r.reader._closed for r in opened)
+    deadline = time.monotonic() + 10
+    while _devship_threads() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert _devship_threads() == []
+
+
+def test_tenant_charged_for_mesh_device_seconds(dataset, monkeypatch):
+    monkeypatch.setenv("PFTPU_MESH_DEVICES", "4")
+    with Serving(prefetch_bytes=8 << 20) as srv:
+        with srv.tenant("mesh-a") as ta:
+            with trace.using(ta.tracer):
+                n = len(_scan(dataset))
+            assert n == 8
+            hist = ta.tracer.histograms().get("serve.device_seconds")
+            assert hist is not None and hist.count > 0
+            rep = ta.report(wall_seconds=1.0)
+            assert "serve.device_seconds" in rep.histograms
+        # another tenant that never scanned has no device ledger
+        with srv.tenant("mesh-b") as tb:
+            assert tb.tracer.histograms().get("serve.device_seconds") is None

@@ -613,3 +613,113 @@ def test_dictionary_without_crc_is_never_borrowed(tmp_path):
     dict_skips = [s for s in rep.skips if s.kind == "dict"]
     assert len(dict_skips) == 1
     assert "no page CRC" in dict_skips[0].error
+
+
+# ---------------------------------------------------------------------------
+# ranged reads under salvage: I/O pruning kept for clean chunks
+# ---------------------------------------------------------------------------
+
+
+def _skip_records(rep):
+    """Comparable identity of a report's skip records."""
+    return [
+        (s.column, s.row_group, s.page, s.rows, s.kind,
+         tuple(s.row_span) if s.row_span else None)
+        for s in rep.skips
+    ]
+
+
+def _rowwise(col):
+    """Per-row python values, None in null slots (packed values are
+    expanded through def_levels so row selections line up)."""
+    vals = col.values
+    packed = vals.to_list() if hasattr(vals, "to_list") else list(
+        np.asarray(vals))
+    if col.def_levels is None:
+        return packed
+    out, it = [], iter(packed)
+    for d in np.asarray(col.def_levels):
+        out.append(next(it) if d else None)
+    return out
+
+
+def _assert_columns_equal(got, want, sel=None):
+    for a, b in zip(got.columns, want.columns):
+        assert a.descriptor.path == b.descriptor.path
+        rows_b = _rowwise(b)
+        if sel is not None:
+            rows_b = [r for r, k in zip(rows_b, sel) if k]
+        assert _rowwise(a) == rows_b
+
+
+def test_ranged_salvage_clean_chunks_keep_pruning(salvage_file):
+    """A clean file's ranged salvage read stays PRUNED: same cover and
+    bytes as the strict ranged read, nothing widened, nothing lost."""
+    ranges = [(0, 400)]
+    with ParquetFileReader(salvage_file) as strict:
+        want, cov = strict.read_row_group_ranges(0, ranges)
+    with trace.scope() as t:
+        with ParquetFileReader(
+            salvage_file, options=ReaderOptions(salvage=True)
+        ) as r:
+            got, cov2 = r.read_row_group_ranges(0, ranges)
+            rep = r.salvage_report
+    assert cov2 == cov
+    assert got.num_rows == want.num_rows == sum(b - a for a, b in cov)
+    assert got.num_rows < ROWS_PER_GROUP
+    _assert_columns_equal(got, want)
+    assert rep.skips == [] and rep.rows_dropped == 0
+    assert t.counters().get("salvage.ranged_widens", 0) == 0
+
+
+def test_ranged_salvage_quarantine_identity_inside_cover(salvage_file,
+                                                         tmp_path):
+    """Damage INSIDE the cover: the damaged chunk widens to the
+    whole-chunk ladder, so the quarantine records are identical to the
+    whole-group path's; the clean chunks stay pruned (exactly one
+    widen); survivors are byte-identical to the whole-group batch
+    restricted to the cover."""
+    bad, ordinal = _flip_in_page(salvage_file, tmp_path, 0, "d", 1, "rwq")
+    opts = dict(verify_crc=True, salvage=True)
+    with ParquetFileReader(bad, options=ReaderOptions(**opts)) as r:
+        whole = r.read_row_group(0)
+        skips_whole = _skip_records(r.salvage_report)
+        dropped_whole = r.salvage_report.rows_dropped
+    with trace.scope() as t:
+        with ParquetFileReader(bad, options=ReaderOptions(**opts)) as r:
+            got, cov = r.read_row_group_ranges(0, [(450, 1100)])
+            rep = r.salvage_report
+    assert _skip_records(rep) == skips_whole
+    assert rep.rows_dropped == dropped_whole == PAGE_VALUES
+    assert t.counters().get("salvage.ranged_widens", 0) == 1
+    cov_rows = sum(b - a for a, b in cov)
+    assert cov_rows < ROWS_PER_GROUP  # the cover really pruned
+    assert got.num_rows == cov_rows - PAGE_VALUES
+    # whole's surviving rows are group rows minus the damaged span;
+    # got's are the covered subset of exactly those
+    keep_w = np.r_[0:PAGE_VALUES, 2 * PAGE_VALUES:ROWS_PER_GROUP]
+    cov_mask = np.zeros(ROWS_PER_GROUP, bool)
+    for a, b in cov:
+        cov_mask[a:b] = True
+    _assert_columns_equal(got, whole, sel=cov_mask[keep_w])
+
+
+def test_ranged_salvage_damage_outside_cover_stays_pruned(salvage_file,
+                                                          tmp_path):
+    """Damage entirely OUTSIDE the cover is never decoded — the read
+    stays pruned and clean (the non-salvage pruned read's contract),
+    bit-identical to the pristine strict ranged read."""
+    bad, _ = _flip_in_page(salvage_file, tmp_path, 0, "d", 3, "outq")
+    with ParquetFileReader(salvage_file) as strict:
+        want, cov_w = strict.read_row_group_ranges(0, [(0, 400)])
+    with trace.scope() as t:
+        with ParquetFileReader(
+            bad, options=ReaderOptions(verify_crc=True, salvage=True)
+        ) as r:
+            got, cov = r.read_row_group_ranges(0, [(0, 400)])
+            rep = r.salvage_report
+    assert cov == cov_w
+    assert rep.skips == [] and rep.rows_dropped == 0
+    assert t.counters().get("salvage.ranged_widens", 0) == 0
+    assert got.num_rows == want.num_rows
+    _assert_columns_equal(got, want)

@@ -124,29 +124,40 @@ def test_fatal_cases_agree(corpus, tmp_path):
 
 
 def test_ranged_reads_match_sequential_salvage(corpus, tmp_path):
-    """ISSUE 7 satellite: salvage under ranged reads.  The ranged face
-    (``read_row_group_ranges`` with a partial request) must produce the
-    SAME quarantine set and the SAME surviving bytes as the sequential
-    whole-group face on every seeded corruption case — the delegation
-    contract (salvage decisions are group-wide; the ranged path routes
-    through the whole-group salvage decode)."""
+    """Salvage under ranged reads, both covers.  A FULL-cover ranged
+    request (cover == the group) must produce the SAME quarantine set
+    and the SAME surviving bytes as the sequential whole-group face on
+    every seeded corruption case.  A PARTIAL request keeps its
+    I/O-pruned page cover even under salvage (docs/scan.md): it must
+    never go fatal where the sequential face did not, and its
+    quarantine set must be a SUBSET of the sequential face's — pruned
+    damage stays undiscovered, but nothing is ever invented.  (The
+    deterministic partial-cover laws — clean chunks keep pruning,
+    in-cover damage quarantines identically, out-of-cover damage stays
+    pruned bit-identically — are pinned in test_salvage.py.)"""
     opts = ReaderOptions(salvage=True, verify_crc=True)
     fails = []
     for seed in range(400, 412):
         paths, _flips = materialize_case(corpus, seed, str(tmp_path))
         with time_limit(PER_CASE_TIMEOUT_S):
             ref = run_sequential(paths, opts)
-            ranged = run_ranged(paths, opts)
-        if (ref.fatal is None) != (ranged.fatal is None):
+            full = run_ranged(paths, opts, request=None)
+            part = run_ranged(paths, opts)
+        if (ref.fatal is None) != (full.fatal is None):
             fails.append((seed, f"fatality diverged: sequential="
-                          f"{ref.fatal} ranged={ranged.fatal}"))
+                          f"{ref.fatal} full-cover={full.fatal}"))
             continue
         if ref.fatal is not None:
             continue
-        if ranged.quarantine != ref.quarantine:
-            fails.append((seed, "quarantine sets diverged"))
-        elif ranged.groups != ref.groups:
-            fails.append((seed, "surviving bytes diverged"))
+        if full.quarantine != ref.quarantine:
+            fails.append((seed, "quarantine sets diverged (full cover)"))
+        elif full.groups != ref.groups:
+            fails.append((seed, "surviving bytes diverged (full cover)"))
+        if part.fatal is not None:
+            fails.append((seed, f"partial cover went fatal: {part.fatal}"))
+        elif not (part.quarantine <= ref.quarantine):
+            fails.append((seed, "partial cover invented quarantines: "
+                          f"{sorted(part.quarantine - ref.quarantine)}"))
     assert not fails, fails
 
 

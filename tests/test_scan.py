@@ -790,7 +790,7 @@ def test_page_prune_column_index_can_drop_whole_group(dataset):
         assert t.counters().get("scan.pages_pruned", 0) >= 1
 
 
-def test_page_prune_salvage_keeps_whole_groups(dataset):
+def test_page_prune_salvage_keeps_pruning_on_clean_files(dataset):
     from parquet_floor_tpu.batch.predicate import col
 
     pred = col("k") == 2_000_700
@@ -799,9 +799,24 @@ def test_page_prune_salvage_keeps_whole_groups(dataset):
         scan=ScanOptions(page_prune=True),
     ) as s:
         units = list(s)
-    # salvage voids page pruning (quarantine decisions are group-wide):
-    # the surviving group arrives WHOLE
+    # ranged salvage keeps the I/O pruning on clean chunks: the
+    # surviving group arrives narrowed to its page cover, bit-identical
+    # to the strict pruned read (only a DAMAGED chunk's spans widen)
+    assert len(units) == 1
+    batch = units[0].batch
     with ParquetFileReader(dataset[2]) as r:
-        assert [u.batch.num_rows for u in units] == [
-            int(r.row_groups[0].num_rows)
-        ]
+        n_group = int(r.row_groups[0].num_rows)
+        want, covered = r.read_row_group_ranges(0, pred.row_ranges(r, 0))
+    assert 0 < batch.num_rows < n_group
+    assert batch.num_rows == want.num_rows == sum(b - a for a, b in covered)
+    for a, b in zip(batch.columns, want.columns):
+        va, vb = a.values, b.values
+        if hasattr(va, "offsets"):
+            np.testing.assert_array_equal(np.asarray(va.offsets),
+                                          np.asarray(vb.offsets))
+            np.testing.assert_array_equal(np.asarray(va.data),
+                                          np.asarray(vb.data))
+        else:
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+    # clean file: nothing quarantined, nothing widened
+    assert units[0].salvage is None or units[0].salvage.skips == []

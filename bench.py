@@ -1339,7 +1339,17 @@ def fleet_leg(n_rows: int) -> dict:
       to origin, correctly), and p99 measured across the whole ordeal
       — failover, fence window, epoch-bumped reinstall — must hold the
       recorded SLO.
+
+    Every request runs under a distributed trace
+    (docs/observability.md), and the chaos pass doubles as the flight
+    recorder's truth test, gated by ``check_fleet_trace``: the breaker
+    trips / epoch fences it provokes must auto-produce an incident
+    bundle whose merged timeline holds at least one request crossing
+    two daemons with closed parent links and time-ordered tracks.
     """
+    import pathlib as _pathlib
+    import shutil as _shutil
+    import tempfile as _tempfile
     import threading as _threading
 
     from parquet_floor_tpu.serve import (
@@ -1375,6 +1385,8 @@ def fleet_leg(n_rows: int) -> dict:
     client_tracers = {
         nid: _trace.Tracer(enabled=True) for nid in node_ids
     }
+    metrics_dir = _tempfile.mkdtemp(prefix="pftpu-bench-fleet-metrics-")
+    flight_dir = _tempfile.mkdtemp(prefix="pftpu-bench-fleet-flight-")
     try:
         for nid in node_ids:
             srv = Serving(prefetch_bytes=8 << 20)
@@ -1386,10 +1398,12 @@ def fleet_leg(n_rows: int) -> dict:
             d = ServeDaemon(
                 srv, {}, fleet=fc, max_inflight=4, max_pending=64,
                 drain_timeout_s=2.0,
+                metrics_dir=metrics_dir, flight_dir=flight_dir,
             ).start()
             servings.append(srv)
             fleets.append(fc)
             daemons.append(d)
+        daemon_by = dict(zip(node_ids, daemons))
         peers = {
             nid: ("127.0.0.1", d.port)
             for nid, d in zip(node_ids, daemons)
@@ -1408,7 +1422,14 @@ def fleet_leg(n_rows: int) -> dict:
         ranges_a = [(i * 8192, 1536) for i in range(48)]
         wrong = 0
         for nid, fc in zip(node_ids, fleets):
-            with _trace.using(client_tracers[nid]):
+            # the whole pass is one distributed request: its peer hops
+            # land daemon-side spans in the owners' flight rings, so
+            # the chaos pass's incident bundle has a cross-daemon
+            # chain to show
+            with _trace.using(client_tracers[nid]), \
+                    _trace.use_flight_recorder(daemon_by[nid]._flight), \
+                    _trace.start_trace("fleet_bench",
+                                       attrs={"node": nid, "leg": "a"}):
                 got = fc.read_through(
                     key, ranges_a, lambda rs: origin_read(key, rs))
             for (o, n), data in zip(ranges_a, got):
@@ -1440,7 +1461,11 @@ def fleet_leg(n_rows: int) -> dict:
             chaos_requests += 1
             t0 = time.perf_counter()
             try:
-                with _trace.using(client_tracers[nid]):
+                with _trace.using(client_tracers[nid]), \
+                        _trace.use_flight_recorder(
+                            daemon_by[nid]._flight), \
+                        _trace.start_trace("fleet_chaos",
+                                           attrs={"node": nid}):
                     data = fc.read_through(
                         key, [(o, n)], lambda rs: origin_read(key, rs))[0]
             except Exception:
@@ -1485,6 +1510,37 @@ def fleet_leg(n_rows: int) -> dict:
             wrong += chaos_read(nid, fc, o, n)
         p99_s = hist.percentile(99)
 
+        # -- the flight-recorder truth check --------------------------------
+        # chaos MUST have fired the recorder (breaker trips on the dead
+        # host, fences in the reinstall window); the best bundle's
+        # merged timeline is the one check_fleet_trace gates on
+        bundles = sorted(_pathlib.Path(flight_dir).glob("incident-*"))
+        ft = {
+            "span_events": 0, "cross_node_traces": [],
+            "trace_nodes": {}, "parent_links_ok": False,
+            "monotonic_ok": False, "balanced_ok": False, "ok": False,
+        }
+        ft_offsets: dict = {}
+        for b in bundles:
+            try:
+                tl = json.loads((b / "timeline.json").read_text())
+            except (OSError, ValueError):
+                continue
+            v = _trace.verify_fleet_timeline(tl)
+            better = (
+                (len(v["cross_node_traces"]) > 0, v["ok"],
+                 v["span_events"])
+                > (len(ft["cross_node_traces"]) > 0, ft["ok"],
+                   ft["span_events"])
+            )
+            if better:
+                ft = v
+                ft_offsets = tl.get("clock_offsets_s") or {}
+        cross_max_nodes = max(
+            (len(ft["trace_nodes"][t]) for t in ft["cross_node_traces"]),
+            default=0,
+        )
+
         return {
             "fleet_nodes": len(node_ids),
             "fleet_unique_ranges": len(ranges_a),
@@ -1506,6 +1562,17 @@ def fleet_leg(n_rows: int) -> dict:
             "fleet_chaos_slo_ms": slo_p99_s * 1e3,
             "fleet_chaos_slo_ok": bool(p99_s <= slo_p99_s),
             "fleet_chaos_hist": hist.as_dict(),
+            "fleet_flight_bundles": len(bundles),
+            "fleet_trace_span_events": ft["span_events"],
+            "fleet_trace_cross_traces": len(ft["cross_node_traces"]),
+            "fleet_trace_cross_max_nodes": cross_max_nodes,
+            "fleet_trace_parent_links_ok": bool(ft["parent_links_ok"]),
+            "fleet_trace_monotonic_ok": bool(ft["monotonic_ok"]),
+            "fleet_trace_balanced_ok": bool(ft["balanced_ok"]),
+            "fleet_trace_clock_offsets": ft_offsets,
+            "fleet_trace_ok": bool(
+                bundles and ft["ok"] and ft["cross_node_traces"]
+            ),
         }
     finally:
         for d in daemons:
@@ -1514,6 +1581,8 @@ def fleet_leg(n_rows: int) -> dict:
             fc.close()
         for srv in servings:
             srv.close()
+        _shutil.rmtree(metrics_dir, ignore_errors=True)
+        _shutil.rmtree(flight_dir, ignore_errors=True)
 
 
 def write_leg(n_rows: int, reps: int) -> dict:

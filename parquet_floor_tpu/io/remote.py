@@ -273,6 +273,11 @@ class CircuitBreaker:
                 "consecutive_failures": failures,
                 "cooldown_s": self.cooldown_s,
             })
+            trace.flight_fire("breaker_trip", {
+                "path": self.name,
+                "consecutive_failures": failures,
+                "cooldown_s": self.cooldown_s,
+            })
         elif reopened:
             trace.decision("io.breaker", {
                 "path": self.name, "state": "open", "via": "probe_failed",
@@ -460,12 +465,17 @@ class RemoteSource:
         )
         self.breaker.check()  # may fail fast (BreakerOpenError)
         # requests run on the pool: bind them to the submitting tracer
-        # scope (contextvars do not cross thread-pool submission)
+        # scope (contextvars do not cross thread-pool submission); an
+        # active trace context rides along the same way so origin
+        # fetches land in the distributed timeline
         tracer = trace.current()
+        request = self._request
+        if trace.current_context() is not None:
+            request = trace.carry_context(request)
         with trace.span("io.remote.get", length, attrs={
             "path": self.name, "offset": offset, "length": length,
         }):
-            futs = [self._pool.submit(tracer.run, self._request,
+            futs = [self._pool.submit(tracer.run, request,
                                       offset, length)]
             hedged = False
             errors: List[Optional[BaseException]] = [None, None]
@@ -534,7 +544,7 @@ class RemoteSource:
                         "length": length, "delay_s": round(hd, 6),
                     })
                     futs.append(self._pool.submit(
-                        tracer.run, self._request, offset, length
+                        tracer.run, request, offset, length
                     ))
             for i, f in enumerate(futs):
                 if not f.done():
@@ -595,11 +605,14 @@ class RemoteSource:
             results: list = [None] * len(ranges)
             errors: list = [None] * len(ranges)
             tracer = trace.current()
+            fetch = self._fetch
+            if trace.current_context() is not None:
+                fetch = trace.carry_context(fetch)
 
             def one(i, o, n):
                 try:
                     results[i] = (
-                        tracer.run(self._fetch, o, n) if n
+                        tracer.run(fetch, o, n) if n
                         else memoryview(b"")
                     )
                 except BaseException as e:
@@ -669,10 +682,14 @@ class ParallelRangeReader:
         if len(ranges) <= 1:
             return [self._inner.read_at(o, n) for o, n in ranges]
         # bind workers to the submitting tracer scope, like every other
-        # pool in the package (contextvars do not cross thread spawns)
+        # pool in the package (contextvars do not cross thread spawns);
+        # the active trace context rides along too
         tracer = trace.current()
+        read = self._inner.read_at
+        if trace.current_context() is not None:
+            read = trace.carry_context(read)
         futs = [
-            self._pool.submit(tracer.run, self._inner.read_at, o, n)
+            self._pool.submit(tracer.run, read, o, n)
             for o, n in ranges
         ]
         out: list = []

@@ -109,14 +109,17 @@ class ServeDaemon:
     daemon first, then datasets, then the serving context).  ``port=0``
     binds an ephemeral port — read it back from :attr:`port` after
     :meth:`start`.  ``metrics_dir`` enables the multi-worker metrics
-    push (one ``worker-<pid>.json`` per daemon)."""
+    push (one ``worker-<pid>-<port>.json`` per daemon)."""
 
     def __init__(self, serving: Serving, datasets: Dict[str, Dataset],
                  host: str = "127.0.0.1", port: int = 0,
                  max_inflight: int = 4, max_pending: int = 64,
                  metrics_dir: Optional[str] = None,
                  drain_timeout_s: float = 30.0,
-                 fleet=None, rate_limiter=None):
+                 fleet=None, rate_limiter=None,
+                 flight_dir: Optional[str] = None,
+                 flight_window_s: float = 30.0,
+                 flight_debounce_s: float = 5.0):
         if max_inflight <= 0:
             raise ValueError(f"max_inflight must be > 0, got {max_inflight}")
         if max_pending < max_inflight:
@@ -141,6 +144,22 @@ class ServeDaemon:
         #: totals) — tenant-attributed metrics ride the tenants' own
         #: tracers like everywhere else in serve/
         self.tracer = trace.Tracer(enabled=True)
+        #: incident-bundle settings (docs/observability.md): with a
+        #: ``flight_dir``, any flight_fire (SLO burn, breaker trip,
+        #: epoch fence) dumps the last ``flight_window_s`` of request
+        #: traces + merged metrics + health() there, debounced to at
+        #: most one bundle per ``flight_debounce_s``
+        self.flight_dir = flight_dir
+        self.flight_window_s = float(flight_window_s)
+        self.flight_debounce_s = float(flight_debounce_s)
+        self._flight_last = 0.0
+        self._flight_unsub: list = []
+        #: this daemon's OWN flight ring — per-daemon instances keep an
+        #: in-process fleet's trace fragments attributed to the right
+        #: node (the executor activates it per request)
+        self._flight = trace.FlightRecorder(
+            host=(fleet.node_id if fleet is not None else None)
+        )
         self._pool = ThreadPoolExecutor(
             max_workers=self.max_inflight,
             thread_name_prefix="pftpu-daemon",
@@ -177,6 +196,20 @@ class ServeDaemon:
                 "max_inflight": self.max_inflight,
                 "max_pending": self.max_pending,
             })
+        if self.fleet is None:
+            # no fleet node id to borrow: label flight-recorder records
+            # by the bound address so an in-process pair stays distinct
+            self._flight.host = f"pid{os.getpid()}:{self.port}"
+        # flight-trigger subscriptions: phase 0 pushes this worker's
+        # snapshot (so every dumper's merge sees it), phase 1 dumps the
+        # incident bundle — see utils/trace.py's trigger bus
+        self._flight_unsub.append(
+            trace.install_flight_trigger(self._flight_push, phase=0)
+        )
+        if self.flight_dir is not None:
+            self._flight_unsub.append(
+                trace.install_flight_trigger(self._flight_dump, phase=1)
+            )
         return self
 
     def _run_loop(self) -> None:
@@ -245,6 +278,9 @@ class ServeDaemon:
         if self._closed:
             return
         self._closed = True
+        for unsub in self._flight_unsub:
+            unsub()
+        self._flight_unsub.clear()
         if self._loop is not None and self._loop.is_running():
             try:
                 self.drain()
@@ -261,6 +297,14 @@ class ServeDaemon:
         if self._thread is not None:
             self._thread.join(timeout=10)
         self._pool.shutdown(wait=True)
+        try:
+            # last gasp, after the drain settled every in-flight probe:
+            # a dying daemon's sealed traces must reach ``metrics_dir``
+            # or every later incident bundle has dangling parent links
+            # for requests that hopped through it
+            self.push_metrics()
+        except Exception:
+            pass
 
     async def _close_writers(self) -> None:
         for w in list(self._writers):
@@ -284,23 +328,48 @@ class ServeDaemon:
     def worker_snapshot(self) -> dict:
         """This worker's foldable snapshot: every tenant tracer plus
         the daemon-plane tracer, merged (the per-worker half of the
-        multi-process metrics story)."""
+        multi-process metrics story).  Distributed-tracing extras ride
+        along — ``node`` (this daemon's host label), ``traces`` (the
+        flight recorder's sealed ring), and ``clock_offsets`` (the
+        fleet client's midpoint estimates) — which is what makes the
+        per-worker snapshot files mergeable into ONE fleet timeline
+        (``trace.merge_fleet_trace``)."""
         from ..utils.metrics_export import merge_snapshots, snapshot
 
         snaps = [snapshot(self.tracer)]
         snaps.extend(
             snapshot(t.tracer) for t in self.serving.tenants()
         )
-        return merge_snapshots(snaps)
+        snap = merge_snapshots(snaps)
+        fst = self._flight.stats()
+        if fst["dropped_traces"] or fst["dropped_spans"]:
+            # ring evictions are counted, never silent — mirror the
+            # recorder's cumulative drop counts into the fold
+            c = snap["counters"]
+            c["trace.flight_traces_dropped"] = fst["dropped_traces"]
+            c["trace.flight_spans_dropped"] = fst["dropped_spans"]
+        snap["node"] = self._flight.host
+        snap["traces"] = self._flight.traces()
+        if self.fleet is not None:
+            offs = self.fleet.clock_offsets()
+            if offs:
+                snap["clock_offsets"] = offs
+        return snap
+
+    def _push_name(self) -> str:
+        # pid AND port: several in-process daemons (the fleet bench,
+        # the trace smoke) share a pid but must not clobber each
+        # other's pushed snapshots
+        return f"worker-{os.getpid()}-{self.port}.json"
 
     def push_metrics(self) -> Optional[str]:
         """Write this worker's snapshot into ``metrics_dir`` (atomic;
-        one file per pid).  No-op without a ``metrics_dir``."""
+        one file per daemon).  No-op without a ``metrics_dir``."""
         if self.metrics_dir is None:
             return None
         from ..utils.metrics_export import write_snapshot
 
-        path = os.path.join(self.metrics_dir, f"worker-{os.getpid()}.json")
+        path = os.path.join(self.metrics_dir, self._push_name())
         write_snapshot(self.worker_snapshot(), path)
         return path
 
@@ -317,8 +386,71 @@ class ServeDaemon:
         # our own stale push is excluded: the live snapshot supersedes
         return merge_snapshot_dir(
             self.metrics_dir, extra=[own],
-            exclude=[f"worker-{os.getpid()}.json"],
+            exclude=[self._push_name()],
         )
+
+    # -- the flight recorder (docs/observability.md) -------------------------
+
+    def _worker_snaps(self) -> list:
+        """Every worker snapshot INDIVIDUALLY (this daemon's live one
+        plus each file under ``metrics_dir``) — the fleet-timeline
+        merge needs per-node identity, so this is NOT the metrics fold.
+        A torn file is skipped here (an incident dump is best-effort
+        forensics, not the metrics contract)."""
+        snaps = [self.worker_snapshot()]
+        if self.metrics_dir is not None:
+            import pathlib
+
+            own = self._flight.host
+            for p in sorted(pathlib.Path(self.metrics_dir).glob("*.json")):
+                try:
+                    s = json.loads(p.read_text())
+                except (OSError, ValueError):
+                    continue
+                if isinstance(s, dict) and s.get("node") != own:
+                    snaps.append(s)
+        return snaps
+
+    def _flight_push(self, reason: str, detail: dict) -> None:
+        """Phase-0 trigger subscriber: land this worker's snapshot in
+        ``metrics_dir`` so every phase-1 dumper's merge sees it."""
+        try:
+            self.push_metrics()
+        except Exception:
+            pass
+
+    def _flight_dump(self, reason: str, detail: dict) -> Optional[str]:
+        """Phase-1 trigger subscriber: write one incident bundle (the
+        last ``flight_window_s`` of traces, the merged metrics
+        snapshot, ``health()``, and the fleet timeline), debounced to
+        one bundle per ``flight_debounce_s``.  Returns the bundle path
+        (None when debounced)."""
+        now = time.perf_counter()
+        if now - self._flight_last < self.flight_debounce_s:
+            return None
+        self._flight_last = now
+        try:
+            health = self.serving.health()
+        except Exception as e:
+            health = f"health() failed: {type(e).__name__}: {e}"
+        try:
+            metrics = self.merged_metrics()
+        except Exception:
+            metrics = None
+        path = trace.write_incident_bundle(
+            self.flight_dir, reason,
+            traces=self._flight.traces(last_s=self.flight_window_s),
+            snaps=self._worker_snaps(),
+            metrics=metrics,
+            health_text=health,
+            detail={**detail, "node": self._flight.host},
+        )
+        with trace.using(self.tracer):
+            trace.count("serve.flight_dumps")
+            trace.decision("serve.flight", {
+                "reason": reason, "path": path,
+            })
+        return path
 
     # -- the protocol --------------------------------------------------------
 
@@ -360,6 +492,11 @@ class ServeDaemon:
                     # tenant — no hello, but execution is bounded and
                     # drain-visible (see _fleet_dispatch)
                     reply = await self._fleet_dispatch(req, op)
+                elif op in ("metrics", "health"):
+                    # protocol-plane like ping: a scraper (e.g. a
+                    # cross-host MetricsServer peers= fold) is not a
+                    # tenant — no hello required
+                    reply = await self._dispatch(tenant, req, op)
                 elif tenant is None:
                     reply = {
                         "ok": False, "code": "hello_required",
@@ -373,6 +510,13 @@ class ServeDaemon:
                 else:
                     reply = await self._dispatch(tenant, req, op)
                 try:
+                    # every reply carries the server's wall clock at
+                    # send time — inside the client's [t0, t1] RTT
+                    # window by construction, which is exactly what the
+                    # midpoint clock-offset estimate needs
+                    reply["server_ts"] = trace.perf_to_unix(
+                        time.perf_counter()
+                    )
                     writer.write(_encode(reply))
                     await writer.drain()
                 except (ConnectionError, RuntimeError):
@@ -430,9 +574,10 @@ class ServeDaemon:
         with trace.using(self.tracer):
             trace.count("serve.daemon_requests")
             trace.gauge_max("serve.daemon_inflight_max", self._pending)
+            ctx = trace.TraceContext.from_wire(req.get("trace"))
         try:
             return await self._loop.run_in_executor(
-                self._pool, self._fleet_execute, req, op
+                self._pool, self._fleet_execute, req, op, ctx
             )
         except Exception as e:
             return {"ok": False, "code": "bad_request",
@@ -440,28 +585,34 @@ class ServeDaemon:
         finally:
             self._pending -= 1
 
-    def _fleet_execute(self, req: dict, op: str) -> dict:
-        with trace.using(self.tracer):
-            key = tuple(req["key"])
-            epoch = int(req.get("epoch", -1))
-            if op == "fleet_fetch":
-                status, data = self.fleet.serve_range(
-                    key, int(req["offset"]), int(req["length"]), epoch)
+    def _fleet_execute(self, req: dict, op: str, ctx=None) -> dict:
+        # ctx + recorder are activated EXPLICITLY: run_in_executor does
+        # not propagate contextvars, and each daemon's flight ring must
+        # receive only its own node's span fragments
+        with trace.using(self.tracer), \
+                trace.use_flight_recorder(self._flight), \
+                trace.use_context(ctx):
+            with trace.span("serve.fleet_serve", attrs={"op": op}):
+                key = tuple(req["key"])
+                epoch = int(req.get("epoch", -1))
+                if op == "fleet_fetch":
+                    status, data = self.fleet.serve_range(
+                        key, int(req["offset"]), int(req["length"]), epoch)
+                    if status != "ok":
+                        return {"ok": False, "code": status,
+                                "error": f"fleet fetch: {status}",
+                                "epoch": self.fleet.epoch}
+                    return {"ok": True, "data": base64.b64encode(
+                        data).decode("ascii")}
+                status = self.fleet.put_remote(
+                    key, int(req["offset"]),
+                    base64.b64decode(req["data"]), epoch,
+                    pinned=bool(req.get("pinned", False)))
                 if status != "ok":
                     return {"ok": False, "code": status,
-                            "error": f"fleet fetch: {status}",
+                            "error": f"fleet put: {status}",
                             "epoch": self.fleet.epoch}
-                return {"ok": True, "data": base64.b64encode(
-                    data).decode("ascii")}
-            status = self.fleet.put_remote(
-                key, int(req["offset"]),
-                base64.b64decode(req["data"]), epoch,
-                pinned=bool(req.get("pinned", False)))
-            if status != "ok":
-                return {"ok": False, "code": status,
-                        "error": f"fleet put: {status}",
-                        "epoch": self.fleet.epoch}
-            return {"ok": True}
+                return {"ok": True}
 
     async def _dispatch(self, tenant, req: dict, op: str) -> dict:
         if op in ("metrics", "health"):
@@ -504,10 +655,12 @@ class ServeDaemon:
         with trace.using(self.tracer):
             trace.count("serve.daemon_requests")
             trace.gauge_max("serve.daemon_inflight_max", self._pending)
+        with trace.using(tenant.tracer):
+            ctx = trace.TraceContext.from_wire(req.get("trace"))
         t0 = time.perf_counter()
         try:
             return await self._loop.run_in_executor(
-                self._pool, self._execute, tenant, req, op
+                self._pool, self._execute, tenant, req, op, ctx
             )
         except Exception as e:
             return {"ok": False, "code": "bad_request",
@@ -518,9 +671,27 @@ class ServeDaemon:
                 trace.observe("serve.daemon_request_seconds",
                               time.perf_counter() - t0)
 
-    def _execute(self, tenant, req: dict, op: str) -> dict:
+    def _execute(self, tenant, req: dict, op: str, ctx=None) -> dict:
         """One probe, on a pool thread, attributed to the connection's
-        tenant (tracer + byte gate + device WFQ all ride ``tenant=``)."""
+        tenant (tracer + byte gate + device WFQ all ride ``tenant=``).
+        The wire :class:`~parquet_floor_tpu.utils.trace.TraceContext`
+        (when the client sent one) and this daemon's flight ring are
+        activated explicitly — run_in_executor does not propagate
+        contextvars — so every span below joins the client's trace with
+        a correct parent link."""
+        if ctx is not None and ctx.tenant is None:
+            # the hello names the tenant even when the asker's trace
+            # began before it knew one: stamp the connection's truth so
+            # every daemon-side span attributes correctly
+            ctx.tenant = tenant.name
+        with trace.using(tenant.tracer), \
+                trace.use_flight_recorder(self._flight), \
+                trace.use_context(ctx):
+            with trace.span("serve.daemon_request",
+                            attrs={"op": op, "tenant": tenant.name}):
+                return self._execute_op(tenant, req, op)
+
+    def _execute_op(self, tenant, req: dict, op: str) -> dict:
         ds = self.datasets.get(req.get("dataset"))
         if ds is None:
             return {
@@ -572,12 +743,23 @@ class DaemonClient:
         self.tenant = tenant
 
     def request(self, op: str, **fields) -> dict:
-        """Send one op, return the raw reply envelope (``ok`` etc.)."""
-        self._sock.sendall(_encode({"op": op, **fields}))
-        line = self._rfile.readline()
-        if not line:
-            raise ConnectionError("daemon closed the connection")
-        return _decode(line)
+        """Send one op, return the raw reply envelope (``ok`` etc.).
+
+        Under an active trace (``trace.start_trace``), the round trip
+        is a ``serve.client_request`` span and its context rides the
+        request line's ``trace`` field, so the daemon's spans — and any
+        peer hops IT makes — join this request's causal chain with the
+        client span as parent (docs/observability.md)."""
+        with trace.span("serve.client_request", attrs={"op": op}):
+            payload = {"op": op, **fields}
+            ctx = trace.current_context()
+            if ctx is not None:
+                payload["trace"] = ctx.to_wire()
+            self._sock.sendall(_encode(payload))
+            line = self._rfile.readline()
+            if not line:
+                raise ConnectionError("daemon closed the connection")
+            return _decode(line)
 
     def _checked(self, reply: dict) -> dict:
         if not reply.get("ok"):

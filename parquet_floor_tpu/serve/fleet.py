@@ -44,7 +44,7 @@ import socket
 import struct
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -193,6 +193,11 @@ class PeerClient:
         self._closed = False
         self._sock: Optional[socket.socket] = None
         self._rfile = None
+        # midpoint clock-offset samples (peer_clock − our_clock,
+        # seconds): every reply's server_ts against our send/receive
+        # wall pair — the raw material of the fleet timeline's
+        # cross-host alignment (trace.merge_fleet_trace)
+        self._offsets: deque = deque(maxlen=64)
 
     def _drop_locked(self) -> None:
         _close_conn(self._sock, self._rfile)
@@ -201,8 +206,16 @@ class PeerClient:
 
     def request(self, op: str, **fields) -> dict:
         """One round-trip; returns the raw reply dict (callers inspect
-        ``ok``/``code`` — a refusal is an answer, not an exception)."""
-        payload = (json.dumps({"op": op, **fields}) + "\n").encode("utf-8")
+        ``ok``/``code`` — a refusal is an answer, not an exception).
+        Under an active trace the request line carries the
+        :class:`~parquet_floor_tpu.utils.trace.TraceContext`, and every
+        reply's ``server_ts`` yields one midpoint clock-offset sample
+        for the fleet-timeline merge."""
+        msg = {"op": op, **fields}
+        ctx = trace.current_context()
+        if ctx is not None:
+            msg["trace"] = ctx.to_wire()
+        payload = (json.dumps(msg) + "\n").encode("utf-8")
         with self._lock:
             sock, rfile = self._sock, self._rfile
             self._sock = self._rfile = None  # checked out
@@ -212,8 +225,10 @@ class PeerClient:
                     (self.host, self.port), timeout=self.timeout_s)
                 sock.settimeout(self.timeout_s)
                 rfile = sock.makefile("rb")
+            t0 = trace.perf_to_unix(time.perf_counter())
             sock.sendall(payload)
             line = rfile.readline()
+            t1 = trace.perf_to_unix(time.perf_counter())
         except (OSError, ValueError):
             _close_conn(sock, rfile)
             raise
@@ -226,7 +241,31 @@ class PeerClient:
                 _close_conn(sock, rfile)  # late or surplus: don't cache
             else:
                 self._sock, self._rfile = sock, rfile
-        return json.loads(line)
+        reply = json.loads(line)
+        sts = reply.get("server_ts") if isinstance(reply, dict) else None
+        if isinstance(sts, (int, float)) and not isinstance(sts, bool):
+            # midpoint method: the server stamped inside [t0, t1], so
+            # its clock minus our RTT midpoint estimates the skew with
+            # error bounded by RTT/2 (docs/observability.md)
+            off = float(sts) - 0.5 * (t0 + t1)
+            with self._lock:
+                self._offsets.append(off)
+            trace.gauge_max("trace.clock_offset_us", int(abs(off) * 1e6))
+        return reply
+
+    def clock_offset(self) -> Optional[float]:
+        """Median of the recent midpoint samples (``peer_clock −
+        our_clock``, seconds), or None before any reply arrived —
+        the median rides out the asymmetric-RTT outliers a loaded
+        event loop produces."""
+        with self._lock:
+            samples = sorted(self._offsets)
+        if not samples:
+            return None
+        m = len(samples) // 2
+        if len(samples) % 2:
+            return samples[m]
+        return 0.5 * (samples[m - 1] + samples[m])
 
     def epoch(self) -> dict:
         return self.request("fleet_epoch")
@@ -384,6 +423,20 @@ class FleetCache:
             "members": list(membership.members),
         })
 
+    def clock_offsets(self) -> Dict[str, float]:
+        """Median midpoint clock offset per peer (``peer_clock −
+        our_clock``, seconds) for every peer that has answered at
+        least once — the per-host alignment input of
+        :func:`~parquet_floor_tpu.utils.trace.merge_fleet_trace`."""
+        with self._admin_lock:
+            peers = dict(self._peers)
+        out: Dict[str, float] = {}
+        for member, client in peers.items():
+            off = client.clock_offset()
+            if off is not None:
+                out[member] = off
+        return out
+
     def _breaker(self, member: str) -> CircuitBreaker:
         with self._admin_lock:
             breaker = self._breakers.get(member)
@@ -416,11 +469,14 @@ class FleetCache:
         """Read ``ranges`` through the local single-flight layer to the
         origin leg — the path of last resort every failure mode above
         degrades into."""
-        trace.count("serve.fleet_origin_reads", len(ranges))
-        if self._inner is not None:
-            return [bytes(b) for b in self._inner.read_through(
-                key, ranges, read_many_fn, pinned=pinned)]
-        return self._store_read_through(key, ranges, read_many_fn)
+        with trace.span("serve.fleet_origin_read",
+                        attrs={"node": self.node_id,
+                               "ranges": len(ranges)}):
+            trace.count("serve.fleet_origin_reads", len(ranges))
+            if self._inner is not None:
+                return [bytes(b) for b in self._inner.read_through(
+                    key, ranges, read_many_fn, pinned=pinned)]
+            return self._store_read_through(key, ranges, read_many_fn)
 
     def _store_read_through(self, key: tuple,
                             ranges: List[Tuple[int, int]],
@@ -539,40 +595,48 @@ class FleetCache:
                 breaker.check()
             except BreakerOpenError:
                 continue
-            t0 = self._clock()
-            reply = None
-            for attempt in (0, 1):
-                trace.count("serve.fleet_peer_fetches")
-                try:
-                    reply = peer.fetch(key, offset, length, epoch)
-                    break
-                except (OSError, ValueError):
-                    trace.count("serve.fleet_peer_errors")
-                    breaker.on_failure()
-                    reply = None
-            if reply is None:
-                trace.decision("serve.fleet", {
-                    "action": "peer_failed", "node": self.node_id,
-                    "peer": member, "offset": offset, "length": length,
-                })
-                continue
-            if reply.get("ok") and reply.get("data") is not None:
-                breaker.on_success()
-                data = reply["data"]
-                trace.count("serve.fleet_peer_hits")
-                trace.count("serve.fleet_peer_hit_bytes", len(data))
-                trace.observe("serve.fleet_peer_wait_seconds",
-                              self._clock() - t0)
-                return data
-            code = reply.get("code")
-            if code == "stale_epoch":
-                trace.count("serve.fleet_epoch_fenced")
-                trace.decision("serve.fleet", {
-                    "action": "fence", "node": self.node_id,
-                    "peer": member, "ours": epoch,
-                    "theirs": reply.get("epoch"),
-                })
-            breaker.on_bypass()
+            with trace.span("serve.fleet_peer_fetch",
+                            attrs={"node": self.node_id, "peer": member,
+                                   "length": length}):
+                t0 = self._clock()
+                reply = None
+                for attempt in (0, 1):
+                    trace.count("serve.fleet_peer_fetches")
+                    try:
+                        reply = peer.fetch(key, offset, length, epoch)
+                        break
+                    except (OSError, ValueError):
+                        trace.count("serve.fleet_peer_errors")
+                        breaker.on_failure()
+                        reply = None
+                if reply is None:
+                    trace.decision("serve.fleet", {
+                        "action": "peer_failed", "node": self.node_id,
+                        "peer": member, "offset": offset,
+                        "length": length,
+                    })
+                    continue
+                if reply.get("ok") and reply.get("data") is not None:
+                    breaker.on_success()
+                    data = reply["data"]
+                    trace.count("serve.fleet_peer_hits")
+                    trace.count("serve.fleet_peer_hit_bytes", len(data))
+                    trace.observe("serve.fleet_peer_wait_seconds",
+                                  self._clock() - t0)
+                    return data
+                code = reply.get("code")
+                if code == "stale_epoch":
+                    trace.count("serve.fleet_epoch_fenced")
+                    trace.decision("serve.fleet", {
+                        "action": "fence", "node": self.node_id,
+                        "peer": member, "ours": epoch,
+                        "theirs": reply.get("epoch"),
+                    })
+                    trace.flight_fire("epoch_fence", {
+                        "node": self.node_id, "peer": member,
+                        "ours": epoch, "theirs": reply.get("epoch"),
+                    })
+                breaker.on_bypass()
         return None
 
     def _maybe_replicate(self, key: tuple, offset: int,
@@ -620,6 +684,10 @@ class FleetCache:
         membership = self._membership
         if int(epoch) != membership.epoch:
             trace.count("serve.fleet_epoch_fenced")
+            trace.flight_fire("epoch_fence", {
+                "node": self.node_id, "op": "fleet_fetch",
+                "ours": membership.epoch, "theirs": int(epoch),
+            })
             return "stale_epoch", None
         data = self._local_get(key, offset, length)
         dk = _digest(key, offset, length)
@@ -641,6 +709,10 @@ class FleetCache:
         """A peer's replication push; fenced like every fleet op."""
         if int(epoch) != self._membership.epoch:
             trace.count("serve.fleet_epoch_fenced")
+            trace.flight_fire("epoch_fence", {
+                "node": self.node_id, "op": "fleet_put",
+                "ours": self._membership.epoch, "theirs": int(epoch),
+            })
             return "stale_epoch"
         self._local_put(tuple(key), int(offset), bytes(data), pinned)
         return "ok"

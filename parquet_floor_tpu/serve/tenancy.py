@@ -599,6 +599,12 @@ class Serving:
                         "slow_burn": round(status.slow_burn, 2),
                         "error_breach": status.error_breach,
                     })
+                trace.flight_fire("slo_breach", {
+                    "tenant": tenant.name,
+                    "fast_burn": round(status.fast_burn, 2),
+                    "slow_burn": round(status.slow_burn, 2),
+                    "error_breach": status.error_breach,
+                })
         return out
 
     def health(self, now: Optional[float] = None) -> str:

@@ -30,12 +30,24 @@ its lock (``Tracer.observe``), which is where concurrent writers meet.
 from __future__ import annotations
 
 import math
-from typing import Dict, Optional, Sequence
+import random
+from typing import Dict, Optional, Sequence, Tuple
 
 #: default bucket growth factor: 2**(1/8) ~= +9.05% per bucket, 8
 #: buckets per octave — sub-decibel quantile error at ~100 buckets
 #: across the ns..minutes latency range
 GROWTH = 2.0 ** 0.125
+
+#: the exemplar reservoir's coin — module-level and seedable
+#: (:func:`seed_exemplar_rng`) so reservoir replacement is
+#: deterministic under test while staying uniform in production
+_EXEMPLAR_RNG = random.Random()
+
+
+def seed_exemplar_rng(seed: int) -> None:
+    """Re-seed the shared exemplar-reservoir rng (tests pin it so the
+    surviving exemplars are reproducible)."""
+    _EXEMPLAR_RNG.seed(seed)
 
 
 class LogHistogram:
@@ -47,7 +59,7 @@ class LogHistogram:
     """
 
     __slots__ = ("growth", "_lng", "count", "total", "min", "max",
-                 "zeros", "buckets")
+                 "zeros", "buckets", "exemplars")
 
     def __init__(self, growth: float = GROWTH):
         if growth <= 1.0:
@@ -60,15 +72,28 @@ class LogHistogram:
         self.max: Optional[float] = None
         self.zeros = 0                       # values <= 0
         self.buckets: Dict[int, int] = {}    # bucket index -> count
+        # per-bucket exemplar slot: bucket index -> (trace_id, value) —
+        # a size-1 reservoir linking a (tail) bucket to one request
+        # trace that landed there (docs/observability.md).  Empty until
+        # a recorder OFFERS exemplars (Tracer.observe under an active
+        # TraceContext); plain record() calls never touch it, so the
+        # tracing-disabled path costs nothing here.
+        self.exemplars: Dict[int, Tuple[str, float]] = {}
 
     # -- recording -----------------------------------------------------------
 
-    def record(self, value: float, n: int = 1) -> None:
-        """Add ``n`` observations of ``value``."""
+    def record(self, value: float, n: int = 1,
+               exemplar: Optional[str] = None) -> bool:
+        """Add ``n`` observations of ``value``.  ``exemplar`` (a
+        trace_id) additionally offers the sample to the bucket's
+        reservoir slot; returns True iff the slot stored it (an empty
+        slot always accepts; an occupied one is replaced with
+        probability 1/bucket_count — a size-1 uniform reservoir over
+        the bucket's samples)."""
         v = float(value)
         n = int(n)
         if n <= 0:
-            return
+            return False
         self.count += n
         self.total += v * n
         if self.min is None or v < self.min:
@@ -77,12 +102,19 @@ class LogHistogram:
             self.max = v
         if v <= 0.0:
             self.zeros += n
-            return
+            return False
         # bucket i holds (growth^(i-1), growth^i]: ceil of the log puts
         # exact boundaries in the LOWER bucket, so bucket_hi(i) is an
         # inclusive upper bound
         i = math.ceil(math.log(v) / self._lng - 1e-9)
-        self.buckets[i] = self.buckets.get(i, 0) + n
+        c = self.buckets.get(i, 0) + n
+        self.buckets[i] = c
+        if exemplar is None:
+            return False
+        if i not in self.exemplars or _EXEMPLAR_RNG.random() * c < 1.0:
+            self.exemplars[i] = (str(exemplar), v)
+            return True
+        return False
 
     # -- bucket geometry -----------------------------------------------------
 
@@ -148,8 +180,11 @@ class LogHistogram:
     # -- serialize / merge (the ScanReport law) ------------------------------
 
     def as_dict(self) -> dict:
-        """JSON-ready form; ``from_dict`` round-trips it exactly."""
-        return {
+        """JSON-ready form; ``from_dict`` round-trips it exactly.  The
+        ``exemplars`` key appears only when slots are occupied, so
+        pre-exemplar consumers of the serialized shape see the exact
+        dict they always did."""
+        d = {
             "growth": self.growth,
             "count": self.count,
             "sum": self.total,
@@ -159,6 +194,10 @@ class LogHistogram:
             # JSON objects key by string; indexes may be negative
             "buckets": {str(i): c for i, c in sorted(self.buckets.items())},
         }
+        if self.exemplars:
+            d["exemplars"] = {str(i): [t, v]
+                              for i, (t, v) in sorted(self.exemplars.items())}
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "LogHistogram":
@@ -170,6 +209,8 @@ class LogHistogram:
         h.zeros = int(d.get("zeros", 0))
         h.buckets = {int(i): int(c)
                      for i, c in (d.get("buckets") or {}).items()}
+        h.exemplars = {int(i): (str(e[0]), float(e[1]))
+                       for i, e in (d.get("exemplars") or {}).items()}
         return h
 
     def copy(self) -> "LogHistogram":
@@ -177,6 +218,7 @@ class LogHistogram:
         h.count, h.total = self.count, self.total
         h.min, h.max, h.zeros = self.min, self.max, self.zeros
         h.buckets = dict(self.buckets)
+        h.exemplars = dict(self.exemplars)
         return h
 
     def merge_in(self, other: "LogHistogram") -> "LogHistogram":
@@ -199,6 +241,12 @@ class LogHistogram:
             self.max = other.max
         for i, c in other.buckets.items():
             self.buckets[i] = self.buckets.get(i, 0) + c
+        # exemplar slots: a size-1 reservoir cannot be merged exactly;
+        # keep a present slot, and when BOTH sides hold one prefer the
+        # incoming ``other`` (newer by convention in the snapshot fold)
+        # — a deterministic rule, so the cross-process merge is stable
+        for i, ex in other.exemplars.items():
+            self.exemplars[i] = ex
         return self
 
     @classmethod
@@ -245,6 +293,10 @@ class LogHistogram:
             d = c - earlier.buckets.get(i, 0)
             if d > 0:
                 out.buckets[i] = d
+                # the slot's exemplar MAY predate the window; it is a
+                # pointer, not a count, so carrying it is conservative
+                if i in self.exemplars:
+                    out.exemplars[i] = self.exemplars[i]
         if out.count:
             # a delta cannot recover the window's exact extremes; the
             # cumulative ones are conservative bounds

@@ -199,9 +199,13 @@ def render_prometheus_snapshot(snap: dict) -> str:
             lines.append(f'{m}_bucket{{le="0"}} {h.zeros}')
         for i in sorted(h.buckets):
             cum += h.buckets[i]
-            lines.append(
-                f'{m}_bucket{{le="{h.bucket_hi(i):.9g}"}} {cum}'
-            )
+            line = f'{m}_bucket{{le="{h.bucket_hi(i):.9g}"}} {cum}'
+            ex = h.exemplars.get(i)
+            if ex is not None:
+                # OpenMetrics exemplar syntax: the bucket's reservoir
+                # slot links the series straight to one request trace
+                line += f' # {{trace_id="{ex[0]}"}} {_fmt(ex[1])}'
+            lines.append(line)
         lines.append(f'{m}_bucket{{le="+Inf"}} {h.count}')
         lines.append(f"{m}_sum {_fmt(h.total)}")
         lines.append(f"{m}_count {h.count}")
@@ -222,6 +226,9 @@ def parse_prometheus(text: str) -> Dict[str, float]:
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        # an OpenMetrics exemplar suffix (` # {trace_id="…"} v`) is
+        # annotation, not the sample — strip it before splitting
+        line = line.split(" # ", 1)[0].rstrip()
         try:
             name, value = line.rsplit(None, 1)
         except ValueError as e:
@@ -233,6 +240,36 @@ def parse_prometheus(text: str) -> Dict[str, float]:
 # ---------------------------------------------------------------------------
 # the live endpoint
 # ---------------------------------------------------------------------------
+
+def fetch_peer_metrics(host: str, port: int,
+                       timeout_s: float = 2.0) -> Optional[dict]:
+    """One hello-free ``metrics`` op against a ServeDaemon peer (its
+    line protocol answers ``metrics``/``health`` on the protocol plane,
+    no tenant registration needed).  Returns the peer's folded snapshot
+    dict, or None when the peer is unreachable or answers garbage — the
+    cross-host scrape DEGRADES (counted upstream), it never fails."""
+    import socket
+
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout_s) as sock:
+            sock.settimeout(timeout_s)
+            sock.sendall(json.dumps({"op": "metrics"}).encode(
+                "utf-8", "surrogateescape") + b"\n")
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = sock.recv(1 << 20)
+                if not chunk:
+                    return None
+                buf += chunk
+        reply = json.loads(buf.decode("utf-8", "surrogateescape"))
+    except (OSError, ValueError):
+        return None
+    if not isinstance(reply, dict) or not reply.get("ok"):
+        return None
+    snap = reply.get("metrics")
+    return snap if isinstance(snap, dict) else None
+
 
 class MetricsServer:
     """``ThreadingHTTPServer`` over one tracer — created via
@@ -246,19 +283,41 @@ class MetricsServer:
     :func:`write_snapshot` files together with this process's own live
     tracer state (:func:`merge_snapshot_dir`), so one scrape sees the
     whole worker fleet — the push-gateway story for N serving
-    processes per host."""
+    processes per host.
+
+    ``peers`` extends the fold ACROSS hosts: each ``(host, port)`` is a
+    ServeDaemon whose ``metrics`` op is queried on every scrape
+    (:func:`fetch_peer_metrics`) and merged in.  A dead peer degrades
+    to a counted ``serve.metrics_peer_unreachable`` on this server's
+    tracer — never a failed scrape (docs/observability.md)."""
 
     def __init__(self, tracer, port: int = 0, host: str = "127.0.0.1",
-                 snapshot_dir: Optional[str] = None):
+                 snapshot_dir: Optional[str] = None,
+                 peers: Optional[Sequence] = None,
+                 peer_timeout_s: float = 2.0):
         self.tracer = tracer
         self.snapshot_dir = snapshot_dir
+        self.peers = [(str(h), int(p)) for h, p in (peers or [])]
+        self.peer_timeout_s = float(peer_timeout_s)
         outer = self
 
         def _snap() -> dict:
-            own = snapshot(outer.tracer)
+            extra = [snapshot(outer.tracer)]
+            for ph, pp in outer.peers:
+                peer_snap = fetch_peer_metrics(
+                    ph, pp, timeout_s=outer.peer_timeout_s
+                )
+                if peer_snap is None:
+                    outer.tracer.count("serve.metrics_peer_unreachable")
+                    # re-snapshot so the count just taken is visible in
+                    # THIS scrape, not only the next one
+                    extra[0] = snapshot(outer.tracer)
+                else:
+                    extra.append(peer_snap)
             if outer.snapshot_dir is None:
-                return own
-            return merge_snapshot_dir(outer.snapshot_dir, extra=[own])
+                return (extra[0] if len(extra) == 1
+                        else merge_snapshots(extra))
+            return merge_snapshot_dir(outer.snapshot_dir, extra=extra)
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):       # noqa: N802 (http.server contract)

@@ -192,6 +192,17 @@ class names:
         # ranged salvage reads: chunks whose pruned decode tripped a
         # salvageable error and widened to the whole-chunk ladder
         "salvage.ranged_widens",
+        # fleet-wide distributed tracing (docs/observability.md
+        # "Distributed tracing"): contexts deserialized off wire hops,
+        # exemplars stored into histogram tail buckets, flight-recorder
+        # ring evictions, incident bundles written, and peers a metrics
+        # scrape could not reach (degraded, never failed)
+        "trace.ctx_propagated",
+        "trace.exemplars_recorded",
+        "trace.flight_spans_dropped",
+        "trace.flight_traces_dropped",
+        "serve.flight_dumps",
+        "serve.metrics_peer_unreachable",
     })
     GAUGES = frozenset({
         "scan.inflight_bytes_max",
@@ -205,6 +216,10 @@ class names:
         "write.inflight_groups_max",
         # mesh width the pipeline actually scheduled across
         "engine.mesh_devices",
+        # largest ABSOLUTE per-peer clock offset (microseconds) the
+        # fleet client has estimated via the midpoint method — a
+        # high-water alarm on fleet clock skew (docs/observability.md)
+        "trace.clock_offset_us",
     })
     DECISIONS = frozenset({
         "engine.auto",
@@ -247,6 +262,9 @@ class names:
         # the multi-chip scan mesh: one event per pipeline that went
         # multi-device (device count + platform)
         "engine.mesh",
+        # flight-recorder incident dumps: one event per bundle written
+        # (trigger reason + bundle path)
+        "serve.flight",
     })
     SPANS = frozenset({
         "read",
@@ -267,6 +285,14 @@ class names:
         # host codec decompression inside the stage task (the overlap
         # the multichip bench leg measures, docs/multichip.md)
         "inflate",
+        # the distributed-tracing wire hops (docs/observability.md):
+        # client send→reply, daemon dispatch→reply, the fleet peer leg
+        # (asker and server side), and the origin fallback
+        "serve.client_request",
+        "serve.daemon_request",
+        "serve.fleet_peer_fetch",
+        "serve.fleet_serve",
+        "serve.fleet_origin_read",
     })
     # latency/size distributions (Tracer.observe -> LogHistogram;
     # docs/observability.md).  Values are SECONDS unless the name says
@@ -346,6 +372,263 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+# ---------------------------------------------------------------------------
+# Distributed tracing: request contexts + the flight recorder
+# (docs/observability.md "Distributed tracing")
+# ---------------------------------------------------------------------------
+
+#: perf_counter ↔ wall-clock bridge, captured ONCE per process at
+#: import: ``_UNIX_EPOCH + (t - _PERF_EPOCH)`` maps any perf_counter
+#: reading onto a unix timeline that is monotonic within the process
+#: (``time.time()`` alone can step under NTP).  Cross-process alignment
+#: is NOT assumed — that is what the measured peer clock offsets and
+#: :func:`merge_fleet_trace` are for.
+_PERF_EPOCH = time.perf_counter()
+_UNIX_EPOCH = time.time()
+
+
+def perf_to_unix(t: float) -> float:
+    """Map a ``time.perf_counter`` reading onto this process's unix
+    timeline (see ``_PERF_EPOCH`` — monotonic within the process)."""
+    return _UNIX_EPOCH + (t - _PERF_EPOCH)
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+class TraceContext:
+    """One request's identity at one point in its causal chain:
+    ``trace_id`` names the whole fleet-wide request, ``span_id`` this
+    hop, ``parent_id`` the hop that caused it (None at the root), and
+    ``tenant`` rides along for attribution.  Serialized into every wire
+    hop (``to_wire``/``from_wire`` — short keys; the daemon line
+    protocol carries it under the ``"trace"`` field)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "tenant")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str] = None,
+                 tenant: Optional[str] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.tenant = tenant
+
+    @classmethod
+    def root(cls, tenant: Optional[str] = None) -> "TraceContext":
+        return cls(_new_id(), _new_id(), None, tenant)
+
+    def child(self) -> "TraceContext":
+        """A context one causal step below this one (fresh span_id,
+        parent = this hop) — what entering a span or serializing an
+        outgoing wire request does."""
+        return TraceContext(self.trace_id, _new_id(), self.span_id,
+                            self.tenant)
+
+    def to_wire(self) -> dict:
+        d = {"t": self.trace_id, "s": self.span_id}
+        if self.parent_id is not None:
+            d["p"] = self.parent_id
+        if self.tenant is not None:
+            d["u"] = self.tenant
+        return d
+
+    @classmethod
+    def from_wire(cls, d) -> Optional["TraceContext"]:
+        """Rebuild a context from its wire form; None for anything that
+        is not one (an old client, a missing field) — receivers need no
+        version branching.  Every successful deserialization counts
+        ``trace.ctx_propagated`` on the ambient tracer, so cross-hop
+        propagation is itself observable."""
+        if not isinstance(d, dict):
+            return None
+        t, s = d.get("t"), d.get("s")
+        if not isinstance(t, str) or not isinstance(s, str):
+            return None
+        count("trace.ctx_propagated")
+        return cls(t, s, d.get("p"), d.get("u"))
+
+    def __repr__(self) -> str:
+        return (f"TraceContext({self.trace_id}/{self.span_id}"
+                f" parent={self.parent_id} tenant={self.tenant})")
+
+
+_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "pftpu_trace_ctx", default=None
+)
+
+
+class FlightRecorder:
+    """Always-on bounded ring of recently COMPLETED request traces.
+    Every span closed under an active :class:`TraceContext` lands here
+    as a record grouped by trace_id; when the last open span of a trace
+    exits locally, the fragment seals into the completed ring (each
+    daemon seals its OWN fragment of a cross-host trace — the fleet
+    merge joins fragments by trace_id).  Bounded both ways, and the
+    evictions are counted (``dropped_traces``/``dropped_spans``,
+    surfaced by :meth:`stats` and mirrored onto tracer counters by the
+    daemon's snapshot export) — never silent.  ``host`` labels every
+    record so the merge keeps per-node identity even for an in-process
+    fleet."""
+
+    def __init__(self, host: Optional[str] = None, max_traces: int = 64,
+                 max_spans_per_trace: int = 256):
+        self.host = host or f"pid{os.getpid()}"
+        self.max_traces = int(max_traces)
+        self.max_spans = int(max_spans_per_trace)
+        self._lock = threading.Lock()
+        self._depth: Dict[str, int] = {}
+        self._open: Dict[str, list] = {}
+        self._sealed: deque = deque()  # (trace_id, [records], sealed_ts)
+        self.dropped_traces = 0
+        self.dropped_spans = 0
+
+    def begin(self, trace_id: str) -> None:
+        with self._lock:
+            self._depth[trace_id] = self._depth.get(trace_id, 0) + 1
+
+    def end(self, record: dict) -> None:
+        tid = record.get("trace_id")
+        if tid is None:
+            return
+        record.setdefault("node", self.host)
+        with self._lock:
+            buf = self._open.setdefault(tid, [])
+            if len(buf) >= self.max_spans:
+                self.dropped_spans += 1
+            else:
+                buf.append(record)
+            d = self._depth.get(tid, 1) - 1
+            if d <= 0:
+                self._depth.pop(tid, None)
+                spans = self._open.pop(tid, [])
+                if spans:
+                    self._seal_locked(tid, spans)
+            else:
+                self._depth[tid] = d
+
+    def _seal_locked(self, trace_id: str, spans: list) -> None:
+        self._sealed.append(
+            (trace_id, spans, perf_to_unix(time.perf_counter()))
+        )
+        while len(self._sealed) > self.max_traces:
+            self._sealed.popleft()
+            self.dropped_traces += 1
+
+    def traces(self, last_s: Optional[float] = None,
+               now: Optional[float] = None) -> List[dict]:
+        """The sealed ring, oldest first: ``{"trace_id", "sealed_ts",
+        "spans": [...]}`` dicts.  ``last_s`` keeps only fragments sealed
+        within the trailing window — the incident bundle's "last N
+        seconds of traces"."""
+        with self._lock:
+            items = list(self._sealed)
+        if last_s is not None:
+            cut = (now if now is not None
+                   else perf_to_unix(time.perf_counter())) - last_s
+            items = [it for it in items if it[2] >= cut]
+        return [{"trace_id": t, "sealed_ts": ts, "spans": list(sp)}
+                for t, sp, ts in items]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "host": self.host,
+                "sealed": len(self._sealed),
+                "open": len(self._open),
+                "dropped_traces": self.dropped_traces,
+                "dropped_spans": self.dropped_spans,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._depth.clear()
+            self._open.clear()
+            self._sealed.clear()
+
+
+_flight = FlightRecorder()
+_recorder: contextvars.ContextVar = contextvars.ContextVar(
+    "pftpu_flight_recorder", default=None
+)
+
+
+def flight_recorder() -> FlightRecorder:
+    """The recorder span records land in: the innermost
+    :func:`use_flight_recorder` scope, else the process-global ring
+    (daemons install their own, so an in-process fleet keeps per-node
+    fragments apart)."""
+    r = _recorder.get()
+    return _flight if r is None else r
+
+
+@contextlib.contextmanager
+def use_flight_recorder(rec: FlightRecorder) -> Iterator[FlightRecorder]:
+    """Route span records to ``rec`` for the dynamic extent of the
+    block (the :func:`using` shape, for the flight ring)."""
+    token = _recorder.set(rec)
+    try:
+        yield rec
+    finally:
+        _recorder.reset(token)
+
+
+class _NullTraceHandle:
+    """Disabled-path ``start_trace`` result: one immortal no-op context
+    manager (the ``_NULL_SPAN`` discipline — no allocation, no lock)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_TRACE = _NullTraceHandle()
+
+
+class _TraceHandle:
+    """Live ``start_trace`` scope: installs a fresh root context, and
+    on exit records the root span into the flight recorder (the local
+    fragment seals once every nested span has closed)."""
+
+    __slots__ = ("_name", "_attrs", "ctx", "_token", "_rec", "_t0")
+
+    def __init__(self, name: str, tenant: Optional[str],
+                 attrs: Optional[dict]):
+        self._name = name
+        self._attrs = attrs
+        self.ctx = TraceContext.root(tenant)
+
+    def __enter__(self) -> TraceContext:
+        self._token = _ctx.set(self.ctx)
+        self._rec = flight_recorder()
+        self._rec.begin(self.ctx.trace_id)
+        self._t0 = time.perf_counter()
+        return self.ctx
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        rec = {
+            "trace_id": self.ctx.trace_id,
+            "span_id": self.ctx.span_id,
+            "parent_id": None,
+            "name": self._name,
+            "ts": perf_to_unix(self._t0),
+            "dur": t1 - self._t0,
+            "tenant": self.ctx.tenant,
+            "tid": threading.get_ident(),
+        }
+        if self._attrs:
+            rec["attrs"] = dict(self._attrs)
+        self._rec.end(rec)
+        _ctx.reset(self._token)
+        return False
+
+
 class _Span:
     """One live timed span: records a begin event on ``__enter__`` and a
     matching end event + stage accumulation on ``__exit__`` (same thread
@@ -355,7 +638,7 @@ class _Span:
     definitionally identical."""
 
     __slots__ = ("_tracer", "_stage", "_nbytes", "_attrs", "_t0",
-                 "_observe")
+                 "_observe", "_ctx", "_token", "_rec")
 
     def __init__(self, tracer: "Tracer", stage: str, nbytes: int,
                  attrs: Optional[dict], observe: Optional[str] = None):
@@ -377,6 +660,21 @@ class _Span:
         if stack is None:
             stack = self._tracer._tls.stack = []
         stack.append(0.0)
+        # distributed-tracing hook: under an active TraceContext the
+        # span becomes a child hop (fresh span_id, parent link) and its
+        # close will land in the flight recorder — outside any trace
+        # this is one ContextVar read (enabled path only; the disabled
+        # path returned _NULL_SPAN long before here)
+        ctx = _ctx.get()
+        if ctx is not None:
+            self._ctx = ctx.child()
+            self._token = _ctx.set(self._ctx)
+            self._rec = flight_recorder()
+            self._rec.begin(ctx.trace_id)
+        else:
+            self._ctx = None
+            self._token = None
+            self._rec = None
         self._t0 = time.perf_counter()
         self._tracer._event("B", self._stage, self._t0, self._attrs)
         return self
@@ -400,6 +698,23 @@ class _Span:
                 # device-time spans bill the owning tenant's WFQ ledger
                 # (serve/tenancy.py wires the hook; no-op otherwise)
                 charge(dur)
+        if self._token is not None:
+            rec = {
+                "trace_id": self._ctx.trace_id,
+                "span_id": self._ctx.span_id,
+                "parent_id": self._ctx.parent_id,
+                "name": self._stage,
+                "ts": perf_to_unix(self._t0),
+                "dur": dur,
+                "tenant": self._ctx.tenant,
+                "tid": threading.get_ident(),
+            }
+            if self._attrs:
+                rec["attrs"] = dict(self._attrs)
+            if self._nbytes:
+                rec["bytes"] = self._nbytes
+            self._rec.end(rec)
+            _ctx.reset(self._token)
         self._tracer._event("E", self._stage, t1, None)
         return False
 
@@ -877,11 +1192,21 @@ class Tracer:
         if not self._enabled:
             return
         v = float(value)
+        # exemplar: under an active TraceContext the sample also offers
+        # its trace_id to the bucket's reservoir slot, linking a tail
+        # bucket straight to a replayable trace (docs/observability.md).
+        # One ContextVar read on the enabled path; the disabled path
+        # returned above, allocation-free as ever.
+        ctx = _ctx.get()
+        ex = None if ctx is None else ctx.trace_id
         with self._lock:
             h = self._hists.get(name)
             if h is None:
                 h = self._hists[name] = LogHistogram()
-            h.record(v)
+            if h.record(v, exemplar=ex):
+                self._counters["trace.exemplars_recorded"] = (
+                    self._counters.get("trace.exemplars_recorded", 0) + 1
+                )
             for w in self._hwindows:
                 wh = w._hists.get(name)
                 if wh is None:
@@ -1253,6 +1578,81 @@ def span(stage: str, nbytes: int = 0, attrs: Optional[dict] = None,
     return (_global if t is None else t).span(stage, nbytes, attrs, observe)
 
 
+def start_trace(name: str = "request", tenant: Optional[str] = None,
+                attrs: Optional[dict] = None):
+    """Begin a new fleet-wide request trace for the ``with`` block:
+    installs a fresh root :class:`TraceContext`, so every span recorded
+    under it — on this thread, on carried worker threads, and on every
+    daemon the request touches over the wire — shares one trace_id with
+    correct parent links, and every closed span lands in the active
+    :class:`FlightRecorder`.  Yields the root context (``ctx.trace_id``
+    is the handle to grep a fleet timeline for).  Returns the shared
+    no-op handle when the active tracer is disabled — the disabled hot
+    path allocates nothing and takes no lock."""
+    t = _active.get()
+    if not (_global if t is None else t)._enabled:
+        return _NULL_TRACE
+    return _TraceHandle(name, tenant, attrs)
+
+
+def current_context() -> Optional[TraceContext]:
+    """The innermost active :class:`TraceContext`, or None outside any
+    trace (one ContextVar read — no allocation)."""
+    return _ctx.get()
+
+
+def child_context() -> Optional[TraceContext]:
+    """A wire-ready child of the current context (fresh span_id, parent
+    = the current hop), or None outside any trace — what every client
+    serializes into an outgoing request line."""
+    ctx = _ctx.get()
+    return None if ctx is None else ctx.child()
+
+
+@contextlib.contextmanager
+def use_context(ctx: Optional[TraceContext]) -> Iterator[
+        Optional[TraceContext]]:
+    """Activate ``ctx`` (e.g. one deserialized off a wire hop) for the
+    dynamic extent of the block; ``None`` is a no-op, so receivers need
+    no branching on whether the caller sent a context."""
+    if ctx is None:
+        yield None
+        return
+    token = _ctx.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _ctx.reset(token)
+
+
+def carry_context(fn):
+    """Bind ``fn`` to the CALLER's active tracer, trace context, and
+    flight recorder for submission to a worker pool — contextvars do
+    not cross thread spawns on their own, and :meth:`Tracer.run`
+    carries only the tracer.  Used by the hedged remote reader and the
+    daemon's executor so off-thread work stays inside the request's
+    causal chain."""
+    tracer = _active.get()
+    ctx = _ctx.get()
+    rec = _recorder.get()
+
+    def _carried(*args, **kwargs):
+        tok_t = _active.set(tracer) if tracer is not None else None
+        tok_c = _ctx.set(ctx) if ctx is not None else None
+        tok_r = _recorder.set(rec) if rec is not None else None
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            if tok_r is not None:
+                _recorder.reset(tok_r)
+            if tok_c is not None:
+                _ctx.reset(tok_c)
+            if tok_t is not None:
+                _active.reset(tok_t)
+
+    return _carried
+
+
 def stats() -> Dict[str, dict]:
     return current().stats()
 
@@ -1276,7 +1676,9 @@ def report() -> str:
 
 def serve_metrics(port: int = 0, tracer: Optional[Tracer] = None,
                   host: str = "127.0.0.1",
-                  snapshot_dir: Optional[str] = None):
+                  snapshot_dir: Optional[str] = None,
+                  peers: Optional[Sequence] = None,
+                  peer_timeout_s: float = 2.0):
     """Start a metrics HTTP endpoint over ``tracer`` (default: the
     tracer active HERE, at call time) and return the running
     :class:`~parquet_floor_tpu.utils.metrics_export.MetricsServer`
@@ -1285,12 +1687,17 @@ def serve_metrics(port: int = 0, tracer: Optional[Tracer] = None,
     exposition, ``GET /metrics.json`` the JSON snapshot
     (docs/observability.md).  ``snapshot_dir`` folds per-worker
     ``write_snapshot`` files into every scrape (the multi-process
-    aggregation story — docs/serving.md)."""
+    aggregation story — docs/serving.md); ``peers`` — a list of
+    ``(host, port)`` ServeDaemon addresses — extends the fold across
+    hosts via each peer's ``metrics`` op, with a dead peer degrading to
+    a counted ``serve.metrics_peer_unreachable``, never a failed
+    scrape."""
     from .metrics_export import MetricsServer
 
     return MetricsServer(tracer if tracer is not None else current(),
                          port=port, host=host,
-                         snapshot_dir=snapshot_dir)
+                         snapshot_dir=snapshot_dir, peers=peers,
+                         peer_timeout_s=peer_timeout_s)
 
 
 @contextlib.contextmanager
@@ -1374,3 +1781,291 @@ def unified_trace(log_dir: str, path: str) -> Iterator[UnifiedTrace]:
     handle.device_events = sum(
         1 for e in dev_events if e.get("ph") != "M"
     )
+
+
+# ---------------------------------------------------------------------------
+# The fleet timeline merge + incident bundles
+# (docs/observability.md "Distributed tracing")
+# ---------------------------------------------------------------------------
+
+def _compose_offsets(nodes: Sequence[str],
+                     measured: Dict[str, Dict[str, float]]
+                     ) -> Dict[str, float]:
+    """Per-node clock offset to the REFERENCE node (first in sorted
+    order), composed over the measured peer-offset graph by BFS.
+    ``measured[c][s]`` is c's midpoint estimate of ``s_clock −
+    c_clock`` (seconds); rebasing subtracts the composed offset from a
+    node's timestamps.  A direct measurement beats a reversed edge;
+    nodes unreachable in the graph fall back to offset 0 — recorded as
+    such in the merge output, never a silent guess."""
+    ordered = sorted(nodes)
+    if not ordered:
+        return {}
+    adj: Dict[str, Dict[str, float]] = {n: {} for n in ordered}
+    for c, peers in measured.items():
+        for s, off in (peers or {}).items():
+            if c in adj and s in adj:
+                adj[c][s] = float(off)
+                adj[s].setdefault(c, -float(off))
+    ref = ordered[0]
+    out = {ref: 0.0}
+    queue = deque([ref])
+    while queue:
+        n = queue.popleft()
+        for m, off in adj[n].items():
+            if m not in out:
+                out[m] = out[n] + off
+                queue.append(m)
+    for n in ordered:
+        out.setdefault(n, 0.0)
+    return out
+
+
+def merge_fleet_trace(snaps: Sequence[dict], path: Optional[str] = None,
+                      extra_events: Optional[Sequence[dict]] = None) -> dict:
+    """Merge per-node worker snapshots into ONE Perfetto timeline with
+    a track per host.  Each snapshot dict carries ``node`` (its host
+    label), ``traces`` (a :meth:`FlightRecorder.traces` export), and
+    optionally ``clock_offsets`` — that node's midpoint estimates of
+    each peer's clock minus its own (seconds), taken from the fleet
+    protocol's request/response RTT pairs.  Offsets are composed to the
+    reference node (BFS over the measurement graph) and every span is
+    rebased onto the reference clock before emission, so one request's
+    cross-host causal chain lines up on one time axis.
+
+    Emits complete ("X") events — one Perfetto process per node
+    (``process_name`` metadata), threads preserved as sub-tracks, and
+    ``args`` carrying trace_id/span_id/parent_id/tenant for the parent
+    links.  ``extra_events`` (e.g. the rebased device sub-track of a
+    :func:`unified_trace` capture) are appended verbatim.  Returns the
+    payload dict — ``clock_offsets_s`` records the applied per-node
+    offsets, ``trace_ids`` the distinct traces present — and writes it
+    as JSON to ``path`` when given."""
+    by_node: Dict[str, list] = {}
+    measured: Dict[str, Dict[str, float]] = {}
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        node = str(snap.get("node") or f"node{len(by_node)}")
+        by_node.setdefault(node, [])
+        for tr in snap.get("traces") or []:
+            by_node[node].extend(tr.get("spans") or [])
+        co = snap.get("clock_offsets")
+        if co:
+            measured.setdefault(node, {}).update(
+                {str(k): float(v) for k, v in co.items()}
+            )
+    nodes = sorted(by_node)
+    offsets = _compose_offsets(nodes, measured)
+    rebased: Dict[str, list] = {}
+    base = None
+    for node in nodes:
+        off = offsets.get(node, 0.0)
+        recs = []
+        for rec in by_node[node]:
+            ts = float(rec.get("ts", 0.0)) - off
+            recs.append((ts, rec))
+            if base is None or ts < base:
+                base = ts
+        recs.sort(key=lambda p: p[0])
+        rebased[node] = recs
+    base = base if base is not None else 0.0
+    events: List[dict] = []
+    trace_ids = set()
+    for pid, node in enumerate(nodes, start=1):
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": node},
+        })
+        for ts, rec in rebased[node]:
+            args = {
+                k: rec[k]
+                for k in ("trace_id", "span_id", "parent_id", "tenant")
+                if rec.get(k) is not None
+            }
+            if rec.get("attrs"):
+                args.update(rec["attrs"])
+            events.append({
+                "name": rec.get("name", "span"), "ph": "X",
+                "cat": "pftpu",
+                "ts": round((ts - base) * 1e6, 3),
+                "dur": round(float(rec.get("dur", 0.0)) * 1e6, 3),
+                "pid": pid, "tid": int(rec.get("tid", 0)),
+                "args": args,
+            })
+            if rec.get("trace_id"):
+                trace_ids.add(rec["trace_id"])
+    if extra_events:
+        events.extend(extra_events)
+    events.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
+                               e.get("ts", 0.0)))
+    out = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "clock_offsets_s": {n: round(offsets.get(n, 0.0), 9)
+                            for n in nodes},
+        "trace_ids": sorted(trace_ids),
+        "events": len(events),
+    }
+    if path is not None:
+        with open(path, "w") as fh:
+            fh.write(json.dumps(out))
+    return out
+
+
+def verify_fleet_timeline(merged: dict) -> dict:
+    """Structural validation of a :func:`merge_fleet_trace` payload —
+    the shared truth check behind the fleet-trace smoke, the chaos
+    bench, and ``check_bench_report.check_fleet_trace``.  Verifies the
+    three properties an incident bundle's timeline must hold: every
+    span's parent resolves WITHIN its trace (the cross-host causal
+    chain is closed), every (process, thread) track is balanced
+    (non-negative ts/dur complete events) and time-ordered, and
+    reports which traces span >= 2 nodes (the distributed ones)."""
+    events = merged.get("traceEvents") or []
+    node_of: Dict[object, str] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            node_of[e.get("pid")] = str((e.get("args") or {}).get("name"))
+    spans = [e for e in events if e.get("ph") == "X"]
+    by_trace: Dict[str, list] = {}
+    ids_by_trace: Dict[str, set] = {}
+    for e in spans:
+        a = e.get("args") or {}
+        t = a.get("trace_id")
+        if not t:
+            continue
+        by_trace.setdefault(t, []).append(e)
+        if a.get("span_id"):
+            ids_by_trace.setdefault(t, set()).add(a["span_id"])
+    trace_nodes: Dict[str, list] = {}
+    cross: List[str] = []
+    for t, evs in sorted(by_trace.items()):
+        nodes = sorted({
+            node_of.get(e.get("pid"), str(e.get("pid"))) for e in evs
+        })
+        trace_nodes[t] = nodes
+        if len(nodes) >= 2:
+            cross.append(t)
+    dangling = 0
+    for t, evs in by_trace.items():
+        ids = ids_by_trace.get(t, set())
+        for e in evs:
+            p = (e.get("args") or {}).get("parent_id")
+            if p is not None and p not in ids:
+                dangling += 1
+    balanced_ok = True
+    monotonic_ok = True
+    last_ts: Dict[tuple, float] = {}
+    for e in spans:
+        ts = float(e.get("ts", 0.0))
+        dur = float(e.get("dur", 0.0))
+        if ts < 0.0 or dur < 0.0:
+            balanced_ok = False
+        track = (e.get("pid"), e.get("tid"))
+        prev = last_ts.get(track)
+        if prev is not None and ts < prev:
+            monotonic_ok = False
+        last_ts[track] = ts
+    return {
+        "span_events": len(spans),
+        "tracks": len(last_ts),
+        "trace_nodes": trace_nodes,
+        "cross_node_traces": cross,
+        "parent_links_ok": dangling == 0,
+        "dangling_parents": dangling,
+        "balanced_ok": balanced_ok,
+        "monotonic_ok": monotonic_ok,
+        "ok": bool(spans) and dangling == 0
+              and balanced_ok and monotonic_ok,
+    }
+
+
+def _slug(s: str) -> str:
+    return "".join(
+        c if c.isalnum() or c in "-_" else "-" for c in str(s)
+    )[:48] or "incident"
+
+
+def write_incident_bundle(out_dir: str, reason: str, *,
+                          traces: Sequence[dict],
+                          snaps: Sequence[dict] = (),
+                          metrics: Optional[dict] = None,
+                          health_text: str = "",
+                          detail: Optional[dict] = None) -> str:
+    """Write one incident bundle directory under ``out_dir`` and return
+    its path.  Layout (docs/observability.md):
+
+    * ``meta.json``     — trigger reason, unix timestamp, free detail
+    * ``traces.json``   — the flight-recorder window that fired
+    * ``metrics.json``  — the merged metrics snapshot at dump time
+    * ``health.txt``    — the serving layer's ``health()`` rendering
+    * ``timeline.json`` — :func:`merge_fleet_trace` over ``snaps``
+      (every worker snapshot individually — per-node identity is what
+      makes the cross-host chain visible)
+    """
+    ts = perf_to_unix(time.perf_counter())
+    name = f"incident-{int(ts * 1000):013d}-{_slug(reason)}"
+    bdir = os.path.join(out_dir, name)
+    os.makedirs(bdir, exist_ok=True)
+    with open(os.path.join(bdir, "meta.json"), "w") as fh:
+        fh.write(json.dumps(
+            {"reason": reason, "ts": ts, "detail": detail or {}}
+        ))
+    with open(os.path.join(bdir, "traces.json"), "w") as fh:
+        fh.write(json.dumps(list(traces)))
+    if metrics is not None:
+        with open(os.path.join(bdir, "metrics.json"), "w") as fh:
+            fh.write(json.dumps(metrics))
+    with open(os.path.join(bdir, "health.txt"), "w") as fh:
+        fh.write(health_text or "")
+    merge_fleet_trace(list(snaps), os.path.join(bdir, "timeline.json"))
+    return bdir
+
+
+# ---------------------------------------------------------------------------
+# The flight-recorder trigger bus: SLO breaches (serve/slo.py), breaker
+# trips (io/remote.py), and fleet epoch fences (serve/fleet.py) fire it;
+# daemons subscribe their snapshot push (phase 0) and bundle dump
+# (phase 1), so an in-process fleet's dump sees every node's freshly
+# pushed snapshot.
+# ---------------------------------------------------------------------------
+
+_flight_subs: List[tuple] = []
+_flight_subs_lock = threading.Lock()
+
+
+def install_flight_trigger(fn, phase: int = 1):
+    """Register ``fn(reason, detail)`` to run on every
+    :func:`flight_fire`.  Phase-0 subscribers (snapshot pushers) all
+    run before any phase-1 subscriber (bundle dumpers).  Returns a
+    ``remove()`` callable — daemons deregister on close."""
+    entry = (int(phase), fn)
+    with _flight_subs_lock:
+        _flight_subs.append(entry)
+
+    def remove() -> None:
+        with _flight_subs_lock:
+            try:
+                _flight_subs.remove(entry)
+            except ValueError:
+                pass
+
+    return remove
+
+
+def flight_fire(reason: str, detail: Optional[dict] = None) -> int:
+    """Fire the flight-recorder trigger bus (an SLO burn, a breaker
+    trip, an epoch fence).  Subscriber exceptions are swallowed — an
+    incident dump must never take the serving path down with it.
+    Returns the number of subscribers invoked."""
+    with _flight_subs_lock:
+        subs = sorted(_flight_subs, key=lambda e: e[0])
+    n = 0
+    for _, fn in subs:
+        try:
+            fn(reason, dict(detail or {}))
+        except Exception:
+            pass
+        n += 1
+    return n

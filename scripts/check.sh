@@ -108,6 +108,17 @@ echo "== fleet smoke (ownership, host loss, fencing, admission, fold) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python scripts/fleet_smoke.py || exit 1
 
+# Distributed-tracing smoke (docs/observability.md): three in-process
+# daemons, every request under an ambient trace — the TraceContext must
+# cross the fleet wire (peer hops land spans carrying the asker's
+# trace_id, the DaemonClient front door yields a correct parent link +
+# tenant), the per-daemon flight rings must merge into ONE balanced,
+# per-track-monotonic Perfetto timeline with a cross-host parent edge,
+# and one flight_fire must dump a verifiable incident bundle.
+echo "== fleet trace smoke (context propagation, timeline merge, flight dump) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/fleet_trace_smoke.py || exit 1
+
 # Multi-chip mesh smoke (docs/multichip.md): a forced 4-device CPU
 # mesh scan must deliver bit-identically to the single-device pass,
 # place every group (engine.mesh_groups == groups == engine.launches),

@@ -143,6 +143,7 @@ def check_report(bench_log: pathlib.Path) -> int:
         or check_serving_leg(result.get("detail", {}))
         or check_traffic_leg(result.get("detail", {}))
         or check_fleet_leg(result.get("detail", {}))
+        or check_fleet_trace(result.get("detail", {}))
         or check_histograms(result.get("detail", {}))
         or check_exec_cache_leg(result.get("detail", {}))
         or check_multichip_leg(result.get("detail", {}))
@@ -648,6 +649,52 @@ def check_fleet_leg(detail: dict) -> int:
         f"replications {detail['fleet_replications']}, "
         f"fenced {detail['fleet_fenced']}, "
         f"chaos p99 {p99} ms <= {slo} ms)"
+    )
+    return 0
+
+
+def check_fleet_trace(detail: dict) -> int:
+    """The flight-recorder truth check on the chaos pass
+    (docs/observability.md): the breaker trips / epoch fences the
+    host-loss pass provokes must have AUTO-produced at least one
+    incident bundle, and its merged fleet timeline must hold at least
+    one request whose spans cross two or more daemons, with every
+    parent link resolving inside its trace and every per-host track's
+    complete events balanced and time-ordered."""
+    for k in ("fleet_flight_bundles", "fleet_trace_span_events",
+              "fleet_trace_cross_traces", "fleet_trace_cross_max_nodes",
+              "fleet_trace_parent_links_ok", "fleet_trace_monotonic_ok",
+              "fleet_trace_balanced_ok", "fleet_trace_clock_offsets",
+              "fleet_trace_ok"):
+        if k not in detail:
+            return fail(f"fleet trace missing {k}")
+    if not detail["fleet_flight_bundles"] >= 1:
+        return fail("chaos pass produced no incident bundle — breaker "
+                    "trips / fences never fired the flight recorder")
+    if not detail["fleet_trace_span_events"] >= 1:
+        return fail("incident bundle's merged timeline holds no spans")
+    if not detail["fleet_trace_cross_traces"] >= 1 or \
+            not detail["fleet_trace_cross_max_nodes"] >= 2:
+        return fail("no request in the incident bundle crossed two "
+                    "daemons — the distributed chain went unrecorded")
+    if not detail["fleet_trace_parent_links_ok"]:
+        return fail("incident bundle has dangling parent links — a "
+                    "hop's span never reached the merge")
+    if not detail["fleet_trace_monotonic_ok"]:
+        return fail("merged fleet timeline has a non-monotonic track "
+                    "after clock-offset rebasing")
+    if not detail["fleet_trace_balanced_ok"]:
+        return fail("merged fleet timeline has an unbalanced event "
+                    "(negative ts or dur)")
+    if not detail["fleet_trace_ok"]:
+        return fail("fleet trace verdict is not ok")
+    print(
+        "check_bench_report: fleet trace ok "
+        f"({detail['fleet_flight_bundles']} bundle(s), "
+        f"{detail['fleet_trace_cross_traces']} cross-daemon trace(s) "
+        f"over up to {detail['fleet_trace_cross_max_nodes']} nodes, "
+        f"{detail['fleet_trace_span_events']} spans, offsets "
+        f"{detail['fleet_trace_clock_offsets']})"
     )
     return 0
 

@@ -177,10 +177,9 @@ def main() -> int:
                   f"retry_after {retry_ms} ms)")
 
             # -- law 4: fleet-wide metrics fold -------------------------
-            # in-process daemons share a pid, so push_metrics would
-            # overwrite one file; write one snapshot per daemon (the
-            # closed chaos victim's tracer still folds) and run the
-            # real directory fold
+            # one snapshot per daemon, explicitly named (the closed
+            # chaos victim's tracer still folds), through the real
+            # directory fold
             from parquet_floor_tpu.utils.metrics_export import (
                 merge_snapshot_dir,
                 write_snapshot,

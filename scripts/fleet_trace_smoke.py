@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Commit-gate distributed-tracing smoke (docs/observability.md).
+
+The fleet-trace laws, proven over real sockets — three in-process
+``ServeDaemon``\\ s, each mounting a :class:`FleetCache`, every request
+under an ambient :func:`trace.start_trace`:
+
+1. **context crosses the wire**: a traced ``read_through`` whose range
+   is owned by a PEER must land a ``serve.fleet_serve`` span in the
+   owner daemon's flight ring carrying the asker's trace_id, and a
+   traced ``DaemonClient`` request must land a ``serve.daemon_request``
+   span whose parent is the client-side span;
+2. **the merged timeline is one causal chain**: folding every daemon's
+   flight ring through :func:`trace.merge_fleet_trace` must yield a
+   Perfetto timeline where at least one trace spans two or more hosts,
+   every parent link resolves inside its trace, every per-(host,
+   thread) track is balanced and time-ordered, AND at least one span's
+   parent lives on a DIFFERENT host (the cross-host edge itself);
+3. **the flight recorder dumps on demand**: one ``trace.flight_fire``
+   must produce an incident bundle whose ``timeline.json`` passes the
+   same verification — the bundle a real SLO burn / breaker trip /
+   epoch fence would leave behind.
+
+Exit 0 on success, 1 with a diagnostic otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from parquet_floor_tpu.serve import (  # noqa: E402
+    DaemonClient,
+    FleetCache,
+    FleetMembership,
+    ServeDaemon,
+    Serving,
+)
+from parquet_floor_tpu.utils import trace  # noqa: E402
+
+NODES = ["n0", "n1", "n2"]
+RANGES = [(i * 4096, 768) for i in range(24)]
+KEY = ("fleet-trace-smoke", 1 << 20)
+
+
+def fail(msg: str) -> int:
+    print(f"fleet_trace_smoke: FAIL {msg}", file=sys.stderr)
+    return 1
+
+
+def content(offset: int, length: int) -> bytes:
+    pat = f"smoke:{offset}:{length}:".encode("ascii")
+    return (pat * (length // len(pat) + 1))[:length]
+
+
+def cross_host_edge(merged: dict):
+    """A (child_node, parent_node) pair where a span's parent lives on
+    a different host — the wire hop itself — or None."""
+    node_of = {}
+    for e in merged.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            node_of[e.get("pid")] = (e.get("args") or {}).get("name")
+    span_node = {}
+    for e in merged.get("traceEvents", []):
+        a = e.get("args") or {}
+        if e.get("ph") == "X" and a.get("span_id"):
+            span_node[a["span_id"]] = node_of.get(e.get("pid"))
+    for e in merged.get("traceEvents", []):
+        a = e.get("args") or {}
+        p = a.get("parent_id")
+        if e.get("ph") == "X" and p in span_node:
+            child = node_of.get(e.get("pid"))
+            if span_node[p] != child:
+                return (child, span_node[p])
+    return None
+
+
+def main() -> int:
+    origin_lock = threading.Lock()
+
+    def origin_read(key, ranges):
+        with origin_lock:
+            time.sleep(0.001)
+        return [content(o, n) for (o, n) in ranges]
+
+    membership = FleetMembership.create(NODES)
+    tracer = trace.Tracer(enabled=True)
+    with tempfile.TemporaryDirectory() as metrics_dir, \
+            tempfile.TemporaryDirectory() as flight_dir:
+        servings, fleets, daemons = [], [], []
+        try:
+            for nid in NODES:
+                srv = Serving(prefetch_bytes=4 << 20)
+                fc = FleetCache(
+                    nid, membership, origin=origin_read,
+                    peer_timeout_s=1.0, breaker_threshold=2,
+                    breaker_cooldown_s=0.2,
+                )
+                d = ServeDaemon(
+                    srv, {}, fleet=fc, max_inflight=4, max_pending=32,
+                    metrics_dir=metrics_dir, flight_dir=flight_dir,
+                    flight_debounce_s=0.0, drain_timeout_s=2.0,
+                )
+                d.start()
+                servings.append(srv)
+                fleets.append(fc)
+                daemons.append(d)
+            peers = {nid: ("127.0.0.1", d.port)
+                     for nid, d in zip(NODES, daemons)}
+            for fc in fleets:
+                fc.install_membership(membership, peers)
+            daemon_by = dict(zip(NODES, daemons))
+
+            # -- law 1: context crosses the wire ------------------------
+            # every node reads every range: non-owned ranges force the
+            # peer hop, each under one ambient trace whose client-side
+            # spans land in the ASKER's flight ring
+            trace_ids = []
+            for nid, fc in zip(NODES, fleets):
+                with trace.using(tracer), \
+                        trace.use_flight_recorder(daemon_by[nid]._flight), \
+                        trace.start_trace("smoke_read",
+                                          attrs={"node": nid}):
+                    trace_ids.append(trace.current_context().trace_id)
+                    got = fc.read_through(
+                        KEY, RANGES, lambda rs: origin_read(KEY, rs))
+                for (o, n), data in zip(RANGES, got):
+                    if data != content(o, n):
+                        return fail(f"wrong bytes for range {(o, n)}")
+            hop_nodes = set()
+            for nid, d in zip(NODES, daemons):
+                for tr in d._flight.traces():
+                    for sp in tr["spans"]:
+                        if sp["name"] == "serve.fleet_serve" and \
+                                sp["trace_id"] in trace_ids:
+                            hop_nodes.add(nid)
+            if not hop_nodes:
+                return fail("no peer hop carried a trace_id into any "
+                            "owner daemon's flight ring")
+            # socket propagation through the DaemonClient front door
+            with DaemonClient("127.0.0.1", daemons[0].port,
+                              tenant="smoke") as client, \
+                    trace.using(tracer), \
+                    trace.use_flight_recorder(daemons[0]._flight), \
+                    trace.start_trace("smoke_lookup") as h:
+                tid = trace.current_context().trace_id
+                client.request("lookup", dataset="none", key=1)
+            daemon_spans = [
+                sp
+                for tr in daemons[0]._flight.traces()
+                if tr["trace_id"] == tid
+                for sp in tr["spans"]
+            ]
+            srv_span = next(
+                (s for s in daemon_spans
+                 if s["name"] == "serve.daemon_request"), None)
+            cli_span = next(
+                (s for s in daemon_spans
+                 if s["name"] == "serve.client_request"), None)
+            if srv_span is None or cli_span is None:
+                return fail(
+                    "DaemonClient round trip left no client+daemon "
+                    f"span pair: {[s['name'] for s in daemon_spans]}")
+            if srv_span["parent_id"] != cli_span["span_id"]:
+                return fail("daemon_request's parent is not the "
+                            "client_request span")
+            if srv_span.get("tenant") != "smoke":
+                return fail("tenant attribution lost across the socket: "
+                            f"{srv_span.get('tenant')!r}")
+            print(f"fleet_trace_smoke: propagation ok (peer hops into "
+                  f"{sorted(hop_nodes)}, socket parent link + tenant)")
+
+            # -- law 2: one causal chain on one time axis ---------------
+            snaps = [d.worker_snapshot() for d in daemons]
+            merged = trace.merge_fleet_trace(snaps)
+            v = trace.verify_fleet_timeline(merged)
+            if not v["span_events"]:
+                return fail("merged timeline holds no spans")
+            if not v["cross_node_traces"]:
+                return fail("no trace spans two hosts in the merge")
+            if not v["parent_links_ok"]:
+                return fail(f"{v['dangling_parents']} dangling parent "
+                            "link(s) in the merged timeline")
+            if not v["balanced_ok"]:
+                return fail("merged timeline has an unbalanced event")
+            if not v["monotonic_ok"]:
+                return fail("a (host, thread) track is not time-ordered "
+                            "after clock-offset rebasing")
+            edge = cross_host_edge(merged)
+            if edge is None:
+                return fail("no span's parent lives on another host — "
+                            "the cross-host edge is missing")
+            print(f"fleet_trace_smoke: timeline ok "
+                  f"({v['span_events']} spans, {v['tracks']} tracks, "
+                  f"{len(v['cross_node_traces'])} cross-host trace(s), "
+                  f"edge {edge[1]} -> {edge[0]})")
+
+            # -- law 3: the flight recorder dumps -----------------------
+            fired = trace.flight_fire("smoke_test", {"by": "smoke"})
+            if fired < len(daemons) * 2:
+                return fail(f"flight_fire reached {fired} subscribers, "
+                            f"expected >= {len(daemons) * 2}")
+            bundles = sorted(pathlib.Path(flight_dir).glob("incident-*"))
+            if not bundles:
+                return fail("flight_fire produced no incident bundle")
+            bundle = bundles[-1]
+            for name in ("meta.json", "traces.json", "timeline.json",
+                         "metrics.json", "health.txt"):
+                if not (bundle / name).exists():
+                    return fail(f"bundle misses {name}: {bundle}")
+            tl = json.loads((bundle / "timeline.json").read_text())
+            bv = trace.verify_fleet_timeline(tl)
+            if not bv["ok"] or not bv["cross_node_traces"]:
+                return fail(f"bundle timeline fails verification: {bv}")
+            meta = json.loads((bundle / "meta.json").read_text())
+            if meta.get("reason") != "smoke_test":
+                return fail(f"bundle meta carries wrong reason: {meta}")
+            print(f"fleet_trace_smoke: flight dump ok "
+                  f"({len(bundles)} bundle(s), "
+                  f"{bv['span_events']} spans in {bundle.name})")
+            print("fleet_trace_smoke: PASS")
+            return 0
+        finally:
+            for d in daemons:
+                d.close()
+            for fc in fleets:
+                fc.close()
+            for srv in servings:
+                srv.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,3 +25,10 @@ def probe_wall(tracer, dt):
 def decode_timed(extents):
     with trace.span("decode", observe="engine.lanch_seconds"):  # typo
         return len(extents)
+
+
+def hop_traced(peer):
+    trace.count("trace.ctx_propagatd")  # typo'd propagation counter
+    trace.gauge_max("trace.clock_offset_uss", 12)  # typo'd offset gauge
+    with trace.span("serve.fleet_sreve", attrs={"peer": peer}):  # typo
+        return peer

@@ -32,3 +32,12 @@ def probe_wall(tracer, dt, hist_name):
 def decode_timed(extents):
     with trace.span("decode", observe="engine.launch_seconds"):
         return len(extents)
+
+
+def hop_traced(peer):
+    # the distributed-tracing family (docs/observability.md) is
+    # registered like every other
+    trace.count("trace.ctx_propagated")
+    trace.gauge_max("trace.clock_offset_us", 12)
+    with trace.span("serve.fleet_serve", attrs={"peer": peer}):
+        return peer

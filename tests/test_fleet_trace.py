@@ -1,0 +1,361 @@
+"""Fleet-wide distributed tracing (docs/observability.md): request
+contexts crossing real sockets with correct parent links and tenant
+attribution, the clock-aligned timeline merge, the SLO/breaker/fence
+flight recorder, histogram exemplars, and the cross-host metrics
+scrape's dead-peer degradation."""
+
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from parquet_floor_tpu.serve import (
+    DaemonClient,
+    FleetCache,
+    FleetMembership,
+    ServeDaemon,
+    Serving,
+    SloTarget,
+)
+from parquet_floor_tpu.utils import trace
+from parquet_floor_tpu.utils.histogram import LogHistogram, seed_exemplar_rng
+from parquet_floor_tpu.utils.metrics_export import (
+    MetricsServer,
+    parse_prometheus,
+    render_prometheus_snapshot,
+)
+
+KEY = ("fleet-trace", 1 << 20)
+
+
+def content(offset: int, length: int) -> bytes:
+    pat = f"ft:{offset}:{length}:".encode("ascii")
+    return (pat * (length // len(pat) + 1))[:length]
+
+
+def origin_read(key, ranges):
+    return [content(o, n) for (o, n) in ranges]
+
+
+@pytest.fixture()
+def fleet2(tmp_path):
+    """Two daemons over one origin, flight recording into tmp_path."""
+    node_ids = ["a", "b"]
+    membership = FleetMembership.create(node_ids)
+    servings, fleets, daemons = [], [], []
+    mdir = str(tmp_path / "metrics")
+    fdir = str(tmp_path / "flight")
+    import os
+
+    os.makedirs(mdir)
+    os.makedirs(fdir)
+    try:
+        for nid in node_ids:
+            srv = Serving(prefetch_bytes=4 << 20)
+            fc = FleetCache(nid, membership, origin=origin_read,
+                            peer_timeout_s=1.0, breaker_threshold=2,
+                            breaker_cooldown_s=0.15)
+            d = ServeDaemon(srv, {}, fleet=fc, max_inflight=4,
+                            max_pending=32, drain_timeout_s=3.0,
+                            metrics_dir=mdir, flight_dir=fdir,
+                            flight_debounce_s=0.0)
+            d.start()
+            servings.append(srv)
+            fleets.append(fc)
+            daemons.append(d)
+        peers = {nid: ("127.0.0.1", d.port)
+                 for nid, d in zip(node_ids, daemons)}
+        for fc in fleets:
+            fc.install_membership(membership, peers)
+        yield fleets, daemons, fdir
+    finally:
+        for d in daemons:
+            d.close()
+        for fc in fleets:
+            fc.close()
+        for srv in servings:
+            srv.close()
+
+
+# --- context propagation over real sockets ----------------------------------
+
+def test_daemon_client_socket_propagation(tmp_path):
+    """DaemonClient -> ServeDaemon: the daemon-side span joins the
+    client's trace, parented on the client-side request span, with the
+    connection's tenant stamped on."""
+    tracer = trace.Tracer(enabled=True)
+    with Serving(prefetch_bytes=4 << 20) as srv, \
+            ServeDaemon(srv, {}) as daemon:
+        with DaemonClient("127.0.0.1", daemon.port, "acme") as c, \
+                trace.using(tracer), \
+                trace.use_flight_recorder(daemon._flight), \
+                trace.start_trace("req") as h:
+            tid = trace.current_context().trace_id
+            c.request("lookup", dataset="none", key=1)
+        frags = [t for t in daemon._flight.traces()
+                 if t["trace_id"] == tid]
+        assert frags, "request trace never sealed into the flight ring"
+        spans = {s["name"]: s for s in frags[0]["spans"]}
+        cli = spans["serve.client_request"]
+        srvspan = spans["serve.daemon_request"]
+        root = spans["req"]
+        assert cli["parent_id"] == root["span_id"]
+        assert srvspan["parent_id"] == cli["span_id"]
+        assert srvspan["tenant"] == "acme"
+        assert tracer.counters().get("trace.ctx_propagated", 0) == 0
+        assert daemon.tracer.counters().get("trace.ctx_propagated", 0) \
+            + sum(t.counters().get("trace.ctx_propagated", 0)
+                  for t in [daemon.serving.tenant("acme").tracer]) >= 1
+
+
+def test_fleet_peer_hop_joins_the_trace(fleet2):
+    """A peer fetch lands a serve.fleet_serve span in the OWNER's
+    flight ring, carrying the asker's trace_id and parented on the
+    asker's serve.fleet_peer_fetch span."""
+    fleets, daemons, _ = fleet2
+    tracer = trace.Tracer(enabled=True)
+    ranges = [(i * 4096, 512) for i in range(16)]
+    tids = []
+    for fc, d in zip(fleets, daemons):
+        with trace.using(tracer), \
+                trace.use_flight_recorder(d._flight), \
+                trace.start_trace("fleet_req"):
+            tids.append(trace.current_context().trace_id)
+            got = fc.read_through(KEY, ranges,
+                                  lambda rs: origin_read(KEY, rs))
+        assert [bytes(b) for b in got] == [content(o, n)
+                                           for (o, n) in ranges]
+    # find a hop: owner-side serve.fleet_serve span in one ring whose
+    # parent is an asker-side serve.fleet_peer_fetch span in the other
+    frags = {}
+    for d in daemons:
+        for t in d._flight.traces():
+            frags.setdefault(t["trace_id"], []).extend(
+                (d._flight.host, s) for s in t["spans"])
+    hops = 0
+    for tid in tids:
+        spans = frags.get(tid, [])
+        by_id = {s["span_id"]: (host, s) for host, s in spans}
+        for host, s in spans:
+            if s["name"] != "serve.fleet_serve":
+                continue
+            parent = by_id.get(s["parent_id"])
+            assert parent is not None, "hop's parent never recorded"
+            phost, pspan = parent
+            # a first-level hop parents on the asker's peer_fetch; a
+            # replication push parents on the OWNER's own fleet_serve
+            assert pspan["name"] in ("serve.fleet_peer_fetch",
+                                     "serve.fleet_serve")
+            assert phost != host, "hop did not cross hosts"
+            if pspan["name"] == "serve.fleet_peer_fetch":
+                hops += 1
+    assert hops >= 1, "no traced request took a peer hop"
+
+
+def test_peer_clock_offsets_sampled(fleet2):
+    fleets, daemons, _ = fleet2
+    tracer = trace.Tracer(enabled=True)
+    with trace.using(tracer):
+        fleets[0].read_through(KEY, [(0, 512), (1 << 20, 512)],
+                               lambda rs: origin_read(KEY, rs))
+    offs = fleets[0].clock_offsets()
+    # same host, so the estimate is near zero but PRESENT for any peer
+    # that answered
+    for member, off in offs.items():
+        assert abs(off) < 1.0, (member, off)
+
+
+# --- the clock-aligned merge -------------------------------------------------
+
+def test_merge_rebases_injected_skew():
+    """Two nodes, node b's clock 5 s ahead; a's midpoint measurement
+    says so; the merge must pull b's spans back onto a's axis."""
+    t0 = trace.perf_to_unix(0.0) + 1000.0
+    snap_a = {
+        "node": "a",
+        "clock_offsets": {"b": 5.0},
+        "traces": [{
+            "trace_id": "t1", "sealed_ts": t0 + 1,
+            "spans": [{"trace_id": "t1", "span_id": "s1",
+                       "parent_id": None, "name": "root",
+                       "ts": t0, "dur": 0.2, "tid": 1}],
+        }],
+    }
+    snap_b = {
+        "node": "b",
+        "traces": [{
+            "trace_id": "t1", "sealed_ts": t0 + 6,
+            "spans": [{"trace_id": "t1", "span_id": "s2",
+                       "parent_id": "s1", "name": "hop",
+                       "ts": t0 + 5.05, "dur": 0.1, "tid": 7}],
+        }],
+    }
+    merged = trace.merge_fleet_trace([snap_a, snap_b])
+    assert merged["clock_offsets_s"] == {"a": 0.0, "b": 5.0}
+    xs = {e["args"]["span_id"]: e for e in merged["traceEvents"]
+          if e.get("ph") == "X"}
+    # b's span lands 50 ms after a's root, not 5.05 s
+    assert xs["s2"]["ts"] - xs["s1"]["ts"] == pytest.approx(50_000, abs=1)
+    v = trace.verify_fleet_timeline(merged)
+    assert v["ok"] and v["cross_node_traces"] == ["t1"]
+
+
+def test_compose_offsets_chains_through_reference():
+    # a measured b at +2, b measured c at +3: c is +5 vs a
+    out = trace._compose_offsets(
+        ["a", "b", "c"], {"a": {"b": 2.0}, "b": {"c": 3.0}})
+    assert out == {"a": 0.0, "b": 2.0, "c": 5.0}
+    # unreachable nodes fall back to 0 rather than vanishing
+    out = trace._compose_offsets(["a", "z"], {})
+    assert out == {"a": 0.0, "z": 0.0}
+
+
+# --- the flight recorder -----------------------------------------------------
+
+def test_slo_burn_dumps_incident_bundle(tmp_path):
+    """A breaching tenant's check_slos tick fires the flight trigger
+    and the daemon dumps a bundle named for the reason."""
+    fdir = str(tmp_path / "flight")
+    import os
+
+    os.makedirs(fdir)
+    with Serving(prefetch_bytes=4 << 20) as srv, \
+            ServeDaemon(srv, {}, flight_dir=fdir,
+                        flight_debounce_s=0.0) as daemon:
+        tn = srv.tenant("burny")
+        srv.set_slo("burny", SloTarget(p99_seconds=0.002))
+        for _ in range(100):
+            tn.tracer.observe("serve.lookup_seconds", 0.05)
+        statuses = srv.check_slos(now=30.0)
+        assert statuses["burny"].breach
+        bundles = sorted(p for p in os.listdir(fdir)
+                         if p.startswith("incident-"))
+        assert bundles, "SLO burn produced no incident bundle"
+        with open(os.path.join(fdir, bundles[-1], "meta.json")) as f:
+            meta = json.load(f)
+        assert meta["reason"] == "slo_breach"
+        assert meta["detail"]["tenant"] == "burny"
+        for name in ("traces.json", "timeline.json", "health.txt"):
+            assert os.path.exists(os.path.join(fdir, bundles[-1], name))
+
+
+def test_flight_dump_debounce(tmp_path, monkeypatch):
+    fdir = str(tmp_path / "f")
+    import os
+
+    os.makedirs(fdir)
+    with Serving(prefetch_bytes=4 << 20) as srv, \
+            ServeDaemon(srv, {}, flight_dir=fdir,
+                        flight_debounce_s=3600.0) as daemon:
+        assert trace.flight_fire("breaker_trip", {}) >= 1
+        assert trace.flight_fire("breaker_trip", {}) >= 1
+        bundles = [p for p in os.listdir(fdir)
+                   if p.startswith("incident-")]
+        assert len(bundles) == 1, "debounce did not hold"
+
+
+def test_flight_recorder_ring_bounds():
+    rec = trace.FlightRecorder(host="h", max_traces=2,
+                               max_spans_per_trace=2)
+    for i in range(4):
+        tid = f"t{i}"
+        # three nested spans enter, then exit innermost-first; the
+        # trace seals when the outermost closes — one span over cap
+        for _ in range(3):
+            rec.begin(tid)
+        for j in range(3):
+            rec.end({"trace_id": tid, "span_id": f"s{i}.{j}",
+                     "parent_id": None, "name": "x", "ts": float(i),
+                     "dur": 0.0, "tid": 1})
+    out = rec.traces()
+    assert len(out) == 2  # ring kept the 2 newest
+    assert [t["trace_id"] for t in out] == ["t2", "t3"]
+    assert all(len(t["spans"]) == 2 for t in out)  # span cap held
+    st = rec.stats()
+    assert st["dropped_traces"] == 2
+    assert st["dropped_spans"] == 4  # one per trace
+
+
+# --- exemplars ---------------------------------------------------------------
+
+def test_exemplar_reservoir_deterministic_under_seed():
+    def build():
+        seed_exemplar_rng(42)
+        h = LogHistogram()
+        for i in range(200):
+            h.record(0.001 * (i + 1), exemplar=f"trace{i}")
+        return h.exemplars
+
+    a, b = build(), build()
+    assert a == b and a  # same slots, and some were filled
+
+
+def test_exemplar_round_trip_and_render():
+    h = LogHistogram()
+    assert h.record(0.5, exemplar="deadbeef") is True
+    d = h.as_dict()
+    assert "exemplars" in d
+    h2 = LogHistogram.from_dict(d)
+    assert h2.exemplars == h.exemplars
+    # absent exemplars key stays absent (back-compat)
+    assert "exemplars" not in LogHistogram().as_dict()
+    snap = {"counters": {}, "gauges": {}, "histograms": {"x": d}}
+    text = render_prometheus_snapshot(snap)
+    assert '# {trace_id="deadbeef"}' in text
+    samples = parse_prometheus(text)
+    # the exemplar suffix did not break the scrape parse
+    assert samples['pftpu_x_bucket{le="0.5"}'] == 1.0
+    assert samples["pftpu_x_count"] == 1.0
+
+
+def test_no_exemplar_without_active_trace():
+    t = trace.Tracer(enabled=True)
+    with trace.using(t):
+        trace.observe("io.remote.get_seconds.primary", 0.01)
+        assert all(not h.exemplars
+                   for h in t.histograms().values())
+        with trace.start_trace("r"):
+            trace.observe("io.remote.get_seconds.primary", 0.01)
+        assert any(h.exemplars for h in t.histograms().values())
+        assert t.counters().get("trace.exemplars_recorded", 0) >= 1
+
+
+# --- cross-host metrics scrape ----------------------------------------------
+
+def test_metrics_server_folds_live_peer_and_counts_dead_one():
+    # a port that refuses: bind-then-close
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    tracer = trace.Tracer(enabled=True)
+    with Serving(prefetch_bytes=4 << 20) as srv, \
+            ServeDaemon(srv, {}) as daemon:
+        with trace.using(daemon.tracer):
+            trace.count("serve.daemon_requests", 7)
+        with MetricsServer(tracer, port=0,
+                           peers=[("127.0.0.1", daemon.port),
+                                  ("127.0.0.1", dead_port)],
+                           peer_timeout_s=0.5) as ms:
+            js = json.loads(urllib.request.urlopen(
+                ms.url("/metrics.json"), timeout=5).read().decode())
+    # the live peer's counters folded in; the dead one became a count,
+    # visible in THIS scrape — never a failed scrape
+    assert js["counters"].get("serve.daemon_requests", 0) >= 7
+    assert js["counters"]["serve.metrics_peer_unreachable"] == 1
+
+
+def test_new_names_are_registered():
+    from parquet_floor_tpu.utils.trace import names
+
+    for n in ("trace.ctx_propagated", "trace.exemplars_recorded",
+              "trace.flight_spans_dropped", "trace.flight_traces_dropped",
+              "serve.flight_dumps", "serve.metrics_peer_unreachable"):
+        assert n in names.COUNTERS
+    assert "trace.clock_offset_us" in names.GAUGES
+    for n in ("serve.client_request", "serve.daemon_request",
+              "serve.fleet_peer_fetch", "serve.fleet_serve",
+              "serve.fleet_origin_read"):
+        assert n in names.SPANS
+    assert "serve.flight" in names.DECISIONS

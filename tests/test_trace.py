@@ -249,10 +249,20 @@ def test_disabled_noop_path_no_alloc_no_lock():
             trace.add("read", 0.1, 5)
             with trace.span("read", 5, attrs):
                 pass
+            # the distributed-tracing sites must stay free too: a
+            # disabled tracer starts no trace (shared immortal handle),
+            # leaves no ambient context, and observe takes the early
+            # return before the exemplar offer
+            with trace.start_trace("request"):
+                pass
+            trace.current_context()
+            trace.observe("io.remote.get_seconds.primary", 0.01)
 
     with trace.using(t):
         # the no-op span is one shared immortal instance
         assert trace.span("read") is trace.span("decode")
+        assert trace.start_trace("a") is trace.start_trace("b")
+        assert trace.current_context() is None
         burst()  # warm call sites (and prove the poisoned lock is idle)
         gc.collect()
         before = sys.getallocatedblocks()

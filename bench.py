@@ -1787,6 +1787,191 @@ def compact_leg(n_rows: int, reps: int) -> dict:
     }
 
 
+def query_leg(n_rows: int, reps: int) -> dict:
+    """The query subsystem (docs/query.md), gated by
+    ``check_bench_report.check_query_leg``: three floors on one pair of
+    sort-compacted corpora.  (1) A full sorted-merge join must run at
+    >= 0.5x the two-scan lower bound — reading BOTH corpora through the
+    same row-materializing face the join uses, timed INTERLEAVED
+    rep-by-rep (one machine condition).  (2) A point probe on a
+    NON-sort column through an installed secondary index must cost at
+    most ONE data page of cold storage bytes (``page_size_bound``),
+    and an absent key must cost ZERO.  (3) An expression projection
+    through the fused device scan must be BIT-equal to
+    ``pyarrow.compute`` over the same arrays at <= 1 launch per row
+    group."""
+    import shutil
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    from parquet_floor_tpu import (
+        ParquetFileWriter, ParquetReader, WriterOptions, types,
+    )
+    from parquet_floor_tpu.api.hydrate import (
+        HydratorSupplier, dict_hydrator,
+    )
+    from parquet_floor_tpu.query import qcol, sorted_merge_join
+    from parquet_floor_tpu.query.index import SecondaryIndex
+    from parquet_floor_tpu.scan import ScanOptions
+    from parquet_floor_tpu.serve import Dataset, SharedBufferCache
+    from parquet_floor_tpu.utils import trace
+    from parquet_floor_tpu.write import CompactOptions, DatasetCompactor
+
+    # corpora sized as a slice of the bench scale: the join is a
+    # host-row face, the floors below are RATIOS against the same face
+    n_q = max(2000, min(n_rows // 10, 100_000))
+    root = os.path.join("/tmp", f"pftpu_bench_query_{n_q}")
+    shutil.rmtree(root, ignore_errors=True)
+    for sub in ("lsrc", "rsrc", "lout", "rout"):
+        os.makedirs(os.path.join(root, sub))
+
+    t = types
+    lschema = t.message(
+        "l", t.required(t.INT64).named("k"),
+        t.required(t.DOUBLE).named("lv"),
+        t.required(t.INT64).named("tag"),
+    )
+    rschema = t.message(
+        "r", t.required(t.INT64).named("k"),
+        t.required(t.DOUBLE).named("rv"),
+    )
+    rng = np.random.default_rng(1234)
+    n_r = 3 * n_q // 4
+    lk = np.sort(rng.integers(0, n_q // 2, n_q))
+    rk = np.sort(rng.integers(n_q // 4, 3 * n_q // 4, n_r))
+    lv = rng.random(n_q)
+    rv = rng.random(n_r)
+    tag = rng.permutation(n_q)   # unique per row: 1-span index probes
+    lsrc = os.path.join(root, "lsrc", "a.parquet")
+    rsrc = os.path.join(root, "rsrc", "a.parquet")
+    with ParquetFileWriter(
+        lsrc, lschema, WriterOptions(row_group_rows=512)
+    ) as w:
+        w.write_columns({"k": lk, "lv": lv, "tag": tag})
+    with ParquetFileWriter(
+        rsrc, rschema, WriterOptions(row_group_rows=512)
+    ) as w:
+        w.write_columns({"k": rk, "rv": rv})
+    lrep = DatasetCompactor([lsrc], os.path.join(root, "lout"),
+                            CompactOptions(
+                                sort_by=["k"], target_row_group_rows=512,
+                                target_file_rows=max(n_q // 2, 512),
+                                index_columns=["tag"])).run()
+    rrep = DatasetCompactor([rsrc], os.path.join(root, "rout"),
+                            CompactOptions(
+                                sort_by=["k"], target_row_group_rows=512,
+                                target_file_rows=max(n_r // 2, 512))).run()
+
+    # -- (1) join vs the two-scan lower bound ---------------------------
+    def two_scan():
+        rows = 0
+        for paths in (lrep.paths, rrep.paths):
+            for p in paths:
+                r = ParquetReader(
+                    p, HydratorSupplier.constantly(dict_hydrator())
+                )
+                for _row in r:
+                    rows += 1
+                r.close()
+        return rows
+
+    def join_pass():
+        L = Dataset(lrep.paths, key_column="k")
+        R = Dataset(rrep.paths, key_column="k")
+        try:
+            return sum(1 for _ in sorted_merge_join(L, R, on=["k"]))
+        finally:
+            L.close()
+            R.close()
+
+    in_rows = two_scan()          # warm page cache + the input count
+    out_rows = join_pass()        # warm
+    best_j = best_s = float("inf")
+    for _ in range(max(reps, 3)):
+        t0 = time.perf_counter()
+        two_scan()
+        best_s = min(best_s, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        join_pass()
+        best_j = min(best_j, time.perf_counter() - t0)
+    with trace.scope() as jt:
+        join_pass()
+    jc = jt.counters()
+
+    # -- (2) indexed point probe on the NON-sort column -----------------
+    idx = SecondaryIndex.open(lrep.index_paths[0])
+    q_cache = SharedBufferCache()
+    with Dataset(lrep.paths, "tag", cache=q_cache) as ds:
+        ds.install_index(idx)
+        with trace.scope() as it:
+            ds.lookup(int(tag[0]))          # warm: pins metadata
+            page_bound = ds.page_size_bound()
+            s0 = q_cache.stats()
+            # a MID-file row: the last rows' pages sit next to the
+            # footer and ride into cache on coalesced metadata reads
+            probe_rows = ds.lookup(
+                int(tag[n_q // 2 + 37]), columns=["tag"]
+            )
+            s1 = q_cache.stats()
+            absent = ds.lookup(n_q + 7)     # beyond the permutation
+            s2 = q_cache.stats()
+        ic = it.counters()
+    probe_bytes = s1["miss_bytes"] - s0["miss_bytes"]
+    absent_bytes = s2["miss_bytes"] - s1["miss_bytes"]
+
+    # -- (3) expression projection, fused leg vs pyarrow.compute --------
+    # INT64 inputs only: a plain-encoded DOUBLE input under the scan
+    # face's bit-exact float64_policy='bits' refuses device compute by
+    # contract (host fallback) — the launch-shape floor needs the
+    # device leg
+    expr = (qcol("k").cast("float64") / 8.0) + qcol("tag").cast("float64")
+    sopts = ScanOptions(project_exprs=(("x", expr),))
+    got, groups = [], 0
+    with trace.scope() as et:
+        for cols in ParquetReader.stream_batches(
+            list(lrep.paths), engine="tpu", scan_options=sopts,
+        ):
+            by = {c.descriptor.path[0]: c for c in cols}
+            got.append(np.asarray(by["x"].values))
+            groups += 1
+    ec = et.counters()
+    got_x = np.concatenate(got)
+    # lk was written globally sorted, so the compactor's stable
+    # per-group sort preserved input row order exactly
+    want = pc.add(
+        pc.divide(pc.cast(pa.array(lk), pa.float64()), 8.0),
+        pc.cast(pa.array(tag), pa.float64()),
+    ).to_numpy()
+    expr_exact = bool(
+        got_x.dtype == np.float64
+        and np.array_equal(got_x, want)
+    )
+
+    j_rps = in_rows / best_j
+    s_rps = in_rows / best_s
+    return {
+        "query_join_rows_per_sec": round(j_rps, 1),
+        "query_join_vs_twoscan_x": round(j_rps / s_rps, 3),
+        "query_join_in_rows": in_rows,
+        "query_join_out_rows": out_rows,
+        "query_join_pages": jc.get("query.join_pages", 0),
+        "query_join_counted_rows": jc.get("query.join_rows", 0),
+        "query_index_probe_bytes": probe_bytes,
+        "query_index_absent_bytes": absent_bytes,
+        "query_index_page_bound": page_bound,
+        "query_index_probe_rows": len(probe_rows),
+        "query_index_absent_rows": len(absent),
+        "query_index_hits": ic.get("serve.index_hits", 0),
+        "query_index_skips": ic.get("serve.index_skips", 0),
+        "query_expr_exact": expr_exact,
+        "query_expr_groups": groups,
+        "query_expr_launches": ec.get("engine.launches", 0),
+        "query_expr_rows": ec.get("query.expr_rows", 0),
+    }
+
+
 def _bench_batch(paths) -> int:
     """The loader leg's batch size: the largest divisor (at or under
     4096) of the dataset's ACTUAL row-group size, read from the first
@@ -2146,6 +2331,8 @@ def main():
     # post-timing group (their scan comparator is interleaved inside)
     write_detail = write_leg(n_rows, reps)
     compact_detail = compact_leg(n_rows, reps)
+    # query subsystem leg (docs/query.md): join / index / expressions
+    query_detail = query_leg(n_rows, reps)
     write_detail["write_vs_scan_x"] = round(
         write_detail["write_rows_per_sec"]
         / scan_detail["scan_rows_per_sec"], 3
@@ -2202,6 +2389,7 @@ def main():
             **pushdown_detail,
             **write_detail,
             **compact_detail,
+            **query_detail,
             **loader_detail,
         },
     }

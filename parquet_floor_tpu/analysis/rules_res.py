@@ -104,6 +104,11 @@ _ACQUIRERS = {
     # DevicePools owns one ThreadPoolExecutor PER mesh device — leaking
     # it leaks k worker threads at once; releases with shutdown()
     "DevicePools",
+    # the query subsystem (query/join.py, docs/query.md): a JoinCursor
+    # holds TWO live corpus iterators, each pinning open readers of its
+    # side's files mid-scan — abandoning one without close() leaks
+    # every fd of both corpora for the cursor's lifetime
+    "JoinCursor",
 }
 
 # the verbs that count as releasing an acquisition (executors release

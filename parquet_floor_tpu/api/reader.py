@@ -149,15 +149,22 @@ def _device_batch_columns(device_cols):
     they stay IN POSITION, exactly like the host batch face."""
     from ..batch.columns import BatchColumn
     from ..format.parquet_thrift import Type as _T
+    from ..query.expr import ComputedColumn
 
-    return [
-        dc if isinstance(dc, BatchColumn) else BatchColumn(
+    def conv(dc):
+        if isinstance(dc, BatchColumn):
+            return dc
+        if isinstance(dc, ComputedColumn):
+            # computed outputs are exact by construction (lossy-DOUBLE
+            # inputs reject at plan time) — never bit-form
+            return BatchColumn(dc.descriptor, dc.values, dc.mask)
+        return BatchColumn(
             dc.descriptor, dc.values, dc.mask, dc.lengths,
             dc.def_levels, dc.rep_levels,
             f64_bits=dc.descriptor.physical_type == _T.DOUBLE,
         )
-        for dc in device_cols
-    ]
+
+    return [conv(dc) for dc in device_cols]
 
 
 def _host_batch_columns(selected, batch, gi: int, quarantined=None):
@@ -196,6 +203,26 @@ def _host_batch_columns(selected, batch, gi: int, quarantined=None):
         dense, mask = cb.dense()
         lens = dense.lengths() if hasattr(dense, "lengths") else None
         cols.append(BatchColumn(desc, dense, mask, lens))
+    return cols
+
+
+def _host_expr_columns(exprs, batch):
+    """Host-leg expression outputs for one decoded row group: the device
+    leg's bit-equal twin (docs/query.md).  Evaluates over the same
+    canonical null-zeroed lanes the fused executable sees, so the two
+    legs cannot drift."""
+    from ..batch.columns import BatchColumn
+    from ..query.expr import computed_descriptor, eval_expr_host
+    from ..scan.executor import _batch_resolver
+
+    resolve = _batch_resolver(batch)
+    n = batch.num_rows
+    cols = []
+    for en, et in exprs:
+        vals, mask = eval_expr_host(et, resolve, n)
+        cols.append(
+            BatchColumn(computed_descriptor(en, vals.dtype), vals, mask)
+        )
     return cols
 
 
@@ -1029,28 +1056,103 @@ class ParquetReader:
         group index (the sequential dataset contract)."""
         from .hydrate import batch_supplier_of
 
+        exprs = tuple(getattr(scan_options, "project_exprs", ()) or ())
+        if exprs and options is not None and getattr(options, "salvage", False):
+            from ..errors import UnsupportedFeatureError
+
+            raise UnsupportedFeatureError(
+                "ScanOptions.project_exprs does not compose with salvage: "
+                "a quarantined input column has no values to evaluate "
+                "over — scan without salvage=True, or drop project_exprs"
+            )
+
+        def host_gen():
+            from ..scan import DatasetScanner
+
+            scan_cols = columns
+            if exprs and columns is not None:
+                # widen the scan to cover expression inputs; the caller's
+                # projection is restored at delivery below
+                from ..query.expr import expr_columns
+
+                need = set(columns)
+                for _en, et in exprs:
+                    need |= {c.split(".")[0] for c in expr_columns(et)}
+                scan_cols = sorted(need)
+            scanner = DatasetScanner(
+                sources, columns=scan_cols, options=options,
+                scan=scan_options, predicate=predicate,
+            )
+            try:
+                hyd = None
+                want = set(columns) if columns is not None else None
+                deliver = None
+                for unit in scanner:
+                    if deliver is None:
+                        deliver = [
+                            c for c in scanner.columns
+                            if want is None or c.path[0] in want
+                        ]
+                    cols = _host_batch_columns(
+                        deliver, unit.batch, unit.group_index,
+                        quarantined=_unit_quarantined_rule(unit),
+                    )
+                    if exprs:
+                        cols = cols + _host_expr_columns(exprs, unit.batch)
+                    if hyd is None:
+                        hyd = batch_supplier_of(batch_hydrator).get(
+                            [bc.descriptor for bc in cols]
+                        )
+                    yield hyd.batch(unit.group_index, cols)
+            finally:
+                scanner.close()
+
         if engine == "tpu":
             def dgen():
+                from ..errors import UnsupportedFeatureError
                 from ..scan import scan_device_groups
 
                 hyd = None
-                for _fi, gi, group in scan_device_groups(
+                it = scan_device_groups(
                     sources, columns=columns, options=options,
                     scan=scan_options, predicate=predicate,
-                ):
-                    if hyd is None:
-                        # schema-ordered by scan_device_groups — the
-                        # same positional contract as the sequential face
-                        hyd = batch_supplier_of(batch_hydrator).get(
-                            [dc.descriptor for dc in group.values()]
+                )
+                try:
+                    while True:
+                        try:
+                            _fi, gi, group = next(it)
+                        except StopIteration:
+                            return
+                        except UnsupportedFeatureError as e:
+                            if hyd is not None:
+                                # mid-stream: batches already escaped —
+                                # a silent restart would replay rows
+                                raise
+                            from ..utils import trace
+
+                            trace.decision("engine.pushdown", {
+                                "action": "host_fallback",
+                                "why": str(e)[:200],
+                            })
+                            yield from host_gen()
+                            return
+                        if hyd is None:
+                            # schema-ordered by scan_device_groups (with
+                            # computed outputs after the schema columns) —
+                            # the same positional contract as the
+                            # sequential face
+                            hyd = batch_supplier_of(batch_hydrator).get(
+                                [dc.descriptor for dc in group.values()]
+                            )
+                        yield hyd.batch(
+                            gi, _device_batch_columns(group.values())
                         )
-                    yield hyd.batch(gi, _device_batch_columns(group.values()))
+                finally:
+                    it.close()
 
             return dgen()
 
         def gen():
-            from ..scan import DatasetScanner
-
             if engine == "auto":
                 from ..utils import trace
 
@@ -1059,24 +1161,7 @@ class ParquetReader:
                     "why": "the scan scheduler decodes dataset batches "
                            "on host; pass engine='tpu' for device scan",
                 })
-            scanner = DatasetScanner(
-                sources, columns=columns, options=options,
-                scan=scan_options, predicate=predicate,
-            )
-            try:
-                hyd = None
-                for unit in scanner:
-                    if hyd is None:
-                        hyd = batch_supplier_of(batch_hydrator).get(
-                            scanner.columns
-                        )
-                    cols = _host_batch_columns(
-                        scanner.columns, unit.batch, unit.group_index,
-                        quarantined=_unit_quarantined_rule(unit),
-                    )
-                    yield hyd.batch(unit.group_index, cols)
-            finally:
-                scanner.close()
+            yield from host_gen()
 
         return gen()
 

@@ -1,0 +1,51 @@
+"""Query subsystem (docs/query.md): projection expressions over the
+fused decode tail, sorted-merge joins over ``sort_by``-compacted
+corpora, and persistent secondary indexes built at compaction time.
+
+Three pillars, each a serving-daemon op with per-tenant attribution and
+a bench gate (``bench.py query_leg``):
+
+* :mod:`.expr` — ``Expr`` trees compiled into the one-launch decode
+  executable as computed output columns (host twin bit-equal).
+* :mod:`.join` — memory-bounded streaming merge join of two corpora
+  compacted with ``sort_by`` on the join key, resumable via stateless
+  fingerprinted tokens.
+* :mod:`.index` — key → (file, group, row-span) sidecars emitted by
+  ``DatasetCompactor(index_columns=...)``; ``serve.Dataset.lookup``
+  consults an installed index before the stats/bloom rungs.
+"""
+
+from .expr import (  # noqa: F401
+    ComputedColumn,
+    Expr,
+    as_expr_tree,
+    computed_descriptor,
+    eval_expr,
+    eval_expr_host,
+    expr_columns,
+    exprs_signature,
+    qcol,
+    qlit,
+    tree_from_json,
+    validate_expr,
+)
+from .index import SecondaryIndex  # noqa: F401
+from .join import JoinCursor, sorted_merge_join  # noqa: F401
+
+__all__ = [
+    "ComputedColumn",
+    "Expr",
+    "JoinCursor",
+    "SecondaryIndex",
+    "as_expr_tree",
+    "computed_descriptor",
+    "eval_expr",
+    "eval_expr_host",
+    "expr_columns",
+    "exprs_signature",
+    "qcol",
+    "qlit",
+    "sorted_merge_join",
+    "tree_from_json",
+    "validate_expr",
+]

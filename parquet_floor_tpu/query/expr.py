@@ -1,0 +1,452 @@
+"""Projection expressions — computed output columns (docs/query.md).
+
+An :class:`Expr` is a small arithmetic / comparison / boolean / cast
+tree over column references and literals, built with operators::
+
+    from parquet_floor_tpu.query import qcol, qlit
+
+    e = (qcol("price") * qcol("qty")).cast("float64") / qlit(100.0)
+
+Like ``batch.predicate``, the builder is sugar over a STATIC nested
+tuple (:meth:`Expr.tree`) — the one structural form every evaluator
+consumes, hashable so it can ride a jit static argument (which is how
+an expression becomes part of a fused decode executable's persistent
+exec-cache key, ``docs/pushdown.md``).  Node forms:
+
+* ``("col", name)`` / ``("lit", value)`` — value is bool/int/float
+* ``("bin", op, a, b)`` — op in ``+ - * / == != < <= > >= & |``
+* ``("not", a)`` / ``("isnull", a)`` / ``("cast", dtype, a)``
+
+Semantics (pinned to ``pyarrow.compute`` by the differential suite):
+
+* **nulls**: the result of any arithmetic/comparison/boolean node is
+  null where ANY input is null (pyarrow's non-Kleene kernels);
+  ``isnull`` is never null.  Null lanes carry a canonical zero in the
+  values buffer so host and device legs stay BIT-equal lane for lane.
+* **dtypes**: operands promote via NumPy's ``promote_types`` (applied
+  explicitly on both legs, so JAX's weaker promotion lattice can never
+  fork the result); integer add/sub/mul wrap at the promoted width
+  exactly like ``pyarrow.compute``'s unchecked kernels.
+* **division**: ``/`` is ALWAYS true division in float64 — never
+  pyarrow's integer division and never its divide-by-zero raise; the
+  pyarrow equivalent of ``a / b`` is
+  ``pc.divide(pc.cast(a, 'float64'), pc.cast(b, 'float64'))``.
+* **NaN** follows IEEE through every op on both legs.
+
+The SAME evaluator body (:func:`eval_expr`) runs over NumPy on host
+and ``jax.numpy`` inside the fused device launch — bit-equality is by
+construction, not by parallel reimplementation.  :meth:`Expr.eval_host`
+is the host twin; device shapes the compute tail cannot stage
+(strings, index-form dictionaries, lossy DOUBLE) raise
+``UnsupportedFeatureError`` at plan time and whole-scan consumers fall
+back to this host leg.
+"""
+
+from __future__ import annotations
+
+from typing import Set, Tuple
+
+import numpy as np
+
+_ARITH_OPS = ("+", "-", "*", "/")
+_CMP_OPS = ("==", "!=", "<", "<=", ">", ">=")
+_BOOL_OPS = ("&", "|")
+_BIN_OPS = _ARITH_OPS + _CMP_OPS + _BOOL_OPS
+_CAST_DTYPES = ("bool", "int32", "int64", "float32", "float64")
+
+
+class Expr:
+    """One expression node (module docstring).  Build leaves with
+    :func:`qcol` / :func:`qlit`, combine with operators, export the
+    static tree with :meth:`tree`."""
+
+    __slots__ = ("_t",)
+
+    def __init__(self, t: tuple):
+        self._t = t
+
+    def tree(self) -> tuple:
+        """The static nested-tuple export (hashable — the module
+        docstring's node grammar)."""
+        return self._t
+
+    # -- arithmetic ---------------------------------------------------------
+
+    def _bin(self, op: str, other) -> "Expr":
+        return Expr(("bin", op, self._t, _as_operand(other)))
+
+    def _rbin(self, op: str, other) -> "Expr":
+        return Expr(("bin", op, _as_operand(other), self._t))
+
+    def __add__(self, o) -> "Expr":
+        return self._bin("+", o)
+
+    def __radd__(self, o) -> "Expr":
+        return self._rbin("+", o)
+
+    def __sub__(self, o) -> "Expr":
+        return self._bin("-", o)
+
+    def __rsub__(self, o) -> "Expr":
+        return self._rbin("-", o)
+
+    def __mul__(self, o) -> "Expr":
+        return self._bin("*", o)
+
+    def __rmul__(self, o) -> "Expr":
+        return self._rbin("*", o)
+
+    def __truediv__(self, o) -> "Expr":
+        return self._bin("/", o)
+
+    def __rtruediv__(self, o) -> "Expr":
+        return self._rbin("/", o)
+
+    # -- comparison / boolean ----------------------------------------------
+
+    def __eq__(self, o) -> "Expr":  # type: ignore[override]
+        return self._bin("==", o)
+
+    def __ne__(self, o) -> "Expr":  # type: ignore[override]
+        return self._bin("!=", o)
+
+    def __lt__(self, o) -> "Expr":
+        return self._bin("<", o)
+
+    def __le__(self, o) -> "Expr":
+        return self._bin("<=", o)
+
+    def __gt__(self, o) -> "Expr":
+        return self._bin(">", o)
+
+    def __ge__(self, o) -> "Expr":
+        return self._bin(">=", o)
+
+    def __and__(self, o) -> "Expr":
+        return self._bin("&", o)
+
+    def __or__(self, o) -> "Expr":
+        return self._bin("|", o)
+
+    def __invert__(self) -> "Expr":
+        return Expr(("not", self._t))
+
+    def cast(self, dtype: str) -> "Expr":
+        if dtype not in _CAST_DTYPES:
+            raise ValueError(
+                f"cast dtype {dtype!r} not in {_CAST_DTYPES}"
+            )
+        return Expr(("cast", dtype, self._t))
+
+    def is_null(self) -> "Expr":
+        return Expr(("isnull", self._t))
+
+    __hash__ = None  # type: ignore[assignment] - builders are not trees
+
+    def __repr__(self):
+        return f"Expr({self._t!r})"
+
+    # -- evaluation ---------------------------------------------------------
+
+    def eval_host(self, resolve, n: int):
+        """Evaluate on host NumPy: ``resolve(name) -> (values,
+        null_mask|None)``, returns ``(values, null_mask|None)`` — the
+        bit-equal twin of the fused device tail (module docstring)."""
+        return eval_expr_host(self._t, resolve, n)
+
+
+def qcol(name: str) -> Expr:
+    """Column-reference leaf."""
+    return Expr(("col", str(name)))
+
+
+def qlit(value) -> Expr:
+    """Literal leaf (bool / int / float)."""
+    return Expr(("lit", _check_literal(value)))
+
+
+def _check_literal(value):
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, int):
+        if not -(1 << 63) <= value < (1 << 63):
+            raise ValueError(f"integer literal {value} exceeds int64")
+        return value
+    if isinstance(value, float):
+        return value
+    raise TypeError(
+        f"expression literal {value!r} is not a bool/int/float "
+        "(string expressions are not supported)"
+    )
+
+
+def _as_operand(o) -> tuple:
+    if isinstance(o, Expr):
+        return o._t
+    return ("lit", _check_literal(o))
+
+
+def as_expr_tree(e) -> tuple:
+    """Normalize an :class:`Expr` or an exported tree to a VALIDATED
+    static tree (the one form the compilers consume)."""
+    t = e.tree() if isinstance(e, Expr) else e
+    validate_expr(t)
+    return t
+
+
+def validate_expr(t) -> None:
+    """Structural check of one exported tree; raises ``ValueError`` on
+    anything outside the module-docstring grammar (a resume token or
+    daemon request carrying a malformed tree must fail loudly here, not
+    deep inside a jit trace)."""
+    if not isinstance(t, tuple) or not t:
+        raise ValueError(f"expression node must be a tuple, got {t!r}")
+    kind = t[0]
+    if kind == "col":
+        if len(t) != 2 or not isinstance(t[1], str) or not t[1]:
+            raise ValueError(f"bad column node {t!r}")
+        return
+    if kind == "lit":
+        if len(t) != 2:
+            raise ValueError(f"bad literal node {t!r}")
+        _check_literal(t[1])
+        return
+    if kind == "bin":
+        if len(t) != 4 or t[1] not in _BIN_OPS:
+            raise ValueError(f"bad binary node {t!r}")
+        validate_expr(t[2])
+        validate_expr(t[3])
+        return
+    if kind in ("not", "isnull"):
+        if len(t) != 2:
+            raise ValueError(f"bad {kind} node {t!r}")
+        validate_expr(t[1])
+        return
+    if kind == "cast":
+        if len(t) != 3 or t[1] not in _CAST_DTYPES:
+            raise ValueError(f"bad cast node {t!r}")
+        validate_expr(t[2])
+        return
+    raise ValueError(f"unknown expression node kind {kind!r}")
+
+
+def expr_columns(t: tuple) -> Set[str]:
+    """The set of column names one tree references."""
+    kind = t[0]
+    if kind == "col":
+        return {t[1]}
+    if kind == "lit":
+        return set()
+    if kind == "bin":
+        return expr_columns(t[2]) | expr_columns(t[3])
+    return expr_columns(t[-1])
+
+
+def tree_from_json(obj) -> tuple:
+    """Rebuild a validated tree from its JSON round-trip (lists back to
+    tuples) — the daemon ``select`` op's wire shape."""
+    def conv(x):
+        if isinstance(x, list):
+            return tuple(conv(i) for i in x)
+        return x
+
+    t = conv(obj)
+    validate_expr(t)
+    return t
+
+
+# ---------------------------------------------------------------------------
+# The ONE evaluator — polymorphic over NumPy and jax.numpy
+# ---------------------------------------------------------------------------
+
+def _zero(xp, dtype):
+    return xp.zeros((), dtype=dtype)
+
+
+def _promote(a, b):
+    """Explicit NumPy-lattice promotion (module docstring): applied on
+    BOTH legs so JAX's weaker promotion can never fork a result."""
+    return np.promote_types(
+        np.dtype(str(a.dtype)), np.dtype(str(b.dtype))
+    )
+
+
+def _require_numeric(arr, op: str):
+    kind = np.dtype(str(arr.dtype)).kind
+    if kind not in "iuf":
+        raise ValueError(
+            f"operator {op!r} needs numeric operands, got dtype "
+            f"{arr.dtype} (cast('int64') booleans first)"
+        )
+
+
+def eval_expr(t: tuple, resolve, n: int, xp):
+    """Evaluate one tree over ``xp`` (NumPy or jax.numpy):
+    ``resolve(name) -> (values, null_mask|None)``; returns ``(values,
+    null_mask|None)`` with null lanes zeroed in the values buffer (the
+    canonical-lanes rule that keeps both legs bit-equal)."""
+    kind = t[0]
+    if kind == "col":
+        vals, mask = resolve(t[1])
+        vals = xp.asarray(vals)
+        if mask is not None:
+            mask = xp.asarray(mask, dtype=bool)
+            vals = xp.where(mask, _zero(xp, vals.dtype), vals)
+        return vals, mask
+    if kind == "lit":
+        v = t[1]
+        dt = (
+            np.dtype(bool) if isinstance(v, bool)
+            else np.dtype(np.int64) if isinstance(v, int)
+            else np.dtype(np.float64)
+        )
+        return xp.full((n,), v, dtype=dt), None
+    if kind == "cast":
+        vals, mask = eval_expr(t[2], resolve, n, xp)
+        out = vals.astype(np.dtype(t[1]))
+        if mask is not None:
+            out = xp.where(mask, _zero(xp, out.dtype), out)
+        return out, mask
+    if kind == "isnull":
+        _vals, mask = eval_expr(t[1], resolve, n, xp)
+        if mask is None:
+            return xp.zeros((n,), dtype=bool), None
+        return mask, None
+    if kind == "not":
+        vals, mask = eval_expr(t[1], resolve, n, xp)
+        if np.dtype(str(vals.dtype)).kind != "b":
+            raise ValueError(
+                f"operator '~' needs a boolean operand, got {vals.dtype}"
+            )
+        out = ~vals
+        if mask is not None:
+            out = xp.where(mask, False, out)
+        return out, mask
+    # binary
+    _, op, ta, tb = t
+    a, ma = eval_expr(ta, resolve, n, xp)
+    b, mb = eval_expr(tb, resolve, n, xp)
+    if ma is None:
+        mask = mb
+    elif mb is None:
+        mask = ma
+    else:
+        mask = ma | mb
+    if op in _BOOL_OPS:
+        if np.dtype(str(a.dtype)).kind != "b" or \
+                np.dtype(str(b.dtype)).kind != "b":
+            raise ValueError(
+                f"operator {op!r} needs boolean operands, got "
+                f"{a.dtype} and {b.dtype}"
+            )
+        out = (a & b) if op == "&" else (a | b)
+    elif op == "/":
+        _require_numeric(a, op)
+        _require_numeric(b, op)
+        a = a.astype(np.float64)
+        b = b.astype(np.float64)
+        if xp is not np:
+            # XLA rewrites division by a compile-time constant into a
+            # multiply by its reciprocal — one ulp off for any
+            # non-power-of-two literal divisor, forking the host twin.
+            # The barrier hides the divisor's constness so the device
+            # emits a true IEEE divide.
+            from jax import lax
+
+            b = lax.optimization_barrier(b)
+        out = a / b
+    elif op in _ARITH_OPS:
+        _require_numeric(a, op)
+        _require_numeric(b, op)
+        dt = _promote(a, b)
+        a = a.astype(dt)
+        b = b.astype(dt)
+        out = a + b if op == "+" else a - b if op == "-" else a * b
+    else:  # comparison
+        dt = _promote(a, b)
+        from ..batch import predicate as _pred
+
+        out = _pred._cmp_arrays(a.astype(dt), "==", b.astype(dt)) \
+            if op == "==" else _pred._cmp_arrays(
+                a.astype(dt), op, b.astype(dt))
+    if mask is not None:
+        out = xp.where(mask, _zero(xp, out.dtype), out)
+    return out, mask
+
+
+def eval_expr_host(t: tuple, resolve, n: int):
+    """Host-NumPy evaluation (errstate-quiet: a zero divisor in a null
+    lane must produce the same IEEE inf/nan the device leg does, not a
+    RuntimeWarning)."""
+    with np.errstate(all="ignore"):
+        return eval_expr(t, resolve, n, np)
+
+
+def computed_descriptor(name: str, dtype):
+    """A synthetic optional flat :class:`ColumnDescriptor` for one
+    computed output column — what the batch faces hand their hydrator
+    for expression outputs (``docs/query.md``)."""
+    from ..format.parquet_thrift import Type
+    from ..format.schema import OPTIONAL, ColumnDescriptor, PrimitiveType
+
+    kind = np.dtype(str(dtype))
+    phys = {
+        "bool": Type.BOOLEAN,
+        "int32": Type.INT32,
+        "int64": Type.INT64,
+        "float32": Type.FLOAT,
+        "float64": Type.DOUBLE,
+    }.get(kind.name)
+    if phys is None:
+        raise ValueError(f"no parquet physical type for dtype {kind}")
+    return ColumnDescriptor(
+        (name,), PrimitiveType(name, phys, repetition=OPTIONAL), 1, 0
+    )
+
+
+class ComputedColumn:
+    """One computed output column as the device scan face delivers it
+    (``scan_device_groups`` with ``ScanOptions(project_exprs=)``):
+    ``values`` / ``mask`` are row-aligned with the group's delivered
+    columns (compact-trimmed under pushdown).  ``mask`` is True at
+    nulls, None when the expression can never be null."""
+
+    __slots__ = ("name", "values", "mask")
+
+    def __init__(self, name: str, values, mask=None):
+        self.name = name
+        self.values = values
+        self.mask = mask
+
+    @property
+    def descriptor(self):
+        """A synthetic optional flat descriptor (the batch faces'
+        positional contract needs one per delivered column)."""
+        return computed_descriptor(self.name, self.values.dtype)
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.values)
+
+    def __repr__(self):
+        return (
+            f"ComputedColumn({self.name!r}, dtype={self.values.dtype}, "
+            f"n={int(self.values.shape[0])})"
+        )
+
+
+def exprs_signature(exprs) -> Tuple[Tuple[str, tuple], ...]:
+    """Normalize a ``(name, Expr|tree)`` sequence into the validated
+    static form every face shares — rejects duplicate output names."""
+    out = []
+    seen = set()
+    for name, e in exprs:
+        name = str(name)
+        if not name:
+            raise ValueError("expression output needs a non-empty name")
+        if name in seen:
+            raise ValueError(
+                f"duplicate expression output name {name!r}"
+            )
+        seen.add(name)
+        out.append((name, as_expr_tree(e)))
+    return tuple(out)

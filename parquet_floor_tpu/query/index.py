@@ -1,0 +1,228 @@
+"""Persistent secondary indexes — point probes on NON-sort columns.
+
+A compacted corpus answers point probes on its ``sort_by`` column in
+one page through the stats/bloom/page-index ladder, because the sort
+clusters each key.  Any OTHER column's values are scattered, so every
+row group survives the stats rung and a probe decodes the whole corpus.
+A :class:`SecondaryIndex` closes that gap: at compaction time
+(``CompactOptions(index_columns=...)``) the compactor records, for one
+named column, every key's exact ``(file, group, row_start, row_end)``
+row spans into a small JSON sidecar (``<column>.index.json`` next to
+the output files).  A serving
+:class:`~parquet_floor_tpu.serve.lookup.Dataset` keyed on that column
+:meth:`~parquet_floor_tpu.serve.lookup.Dataset.install_index`\\ s the
+sidecar and consults it BEFORE the stats/bloom rungs:
+
+* a key the index does not list is **proven absent** — the probe skips
+  the corpus without reading a data byte (``serve.index_skips``);
+* a listed key decodes exactly its recorded row spans through
+  ``read_row_group_ranges`` (``serve.index_hits``) — ≤ one data page of
+  storage bytes per span for page-sized row groups, which ``bench.py
+  query_leg`` asserts from the cache byte counters.
+
+Soundness is fingerprint-gated exactly like the quarantine sidecar
+(same ``quarantine.fingerprint`` keying): the sidecar records each
+output file's fingerprint at build time, and ``install_index`` refuses
+an index whose fingerprints do not match the dataset's actual files —
+a stale index must fail loudly, never silently serve wrong spans.
+
+Keys are typed on the wire (JSON object keys are strings): ints,
+floats (hex-exact), strings, bytes, bools, each under a distinct tag,
+so ``1`` and ``"1"`` index separately, exactly as they compare in a
+predicate probe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+from ..quarantine import fingerprint as file_fingerprint
+
+_VERSION = 1
+_FINGERPRINT_MODES = ("tail", "content")
+
+
+def encode_key(v) -> str:
+    """Typed string encoding of one index key (module docstring).
+    Floats encode via ``float.hex`` so the round-trip is bit-exact;
+    bytes as hex.  ``None`` is not indexable (nulls are not keys)."""
+    if v is None:
+        raise ValueError("null is not an indexable key")
+    if isinstance(v, bool):
+        return f"?:{int(v)}"
+    if isinstance(v, int):
+        return f"i:{v}"
+    if isinstance(v, float):
+        return f"d:{float(v).hex()}"
+    if isinstance(v, bytes):
+        return f"b:{v.hex()}"
+    if isinstance(v, str):
+        return f"s:{v}"
+    raise ValueError(
+        f"unsupported index key type {type(v).__name__} "
+        "(int/float/str/bytes/bool)"
+    )
+
+
+class SecondaryIndex:
+    """key → row-span sidecar for ONE column of one compacted corpus
+    (module docstring).  ``files`` lists the corpus's file basenames in
+    corpus order; ``fps[i]`` is ``files[i]``'s fingerprint.  Spans are
+    ``[file_index, group_index, row_start, row_end)`` half-open row
+    ranges, stored per encoded key in corpus order."""
+
+    def __init__(self, column: str, path: Optional[str] = None,
+                 fingerprint: str = "tail"):
+        if not column:
+            raise ValueError("index column must be named")
+        if fingerprint not in _FINGERPRINT_MODES:
+            raise ValueError(
+                f"unknown fingerprint mode {fingerprint!r} "
+                f"(choose from {_FINGERPRINT_MODES})"
+            )
+        self.column = column
+        self.path = os.fspath(path) if path is not None else None
+        self.fingerprint = fingerprint
+        self._lock = threading.Lock()
+        self._files: List[str] = []
+        self._fps: List[str] = []
+        self._entries: Dict[str, List[list]] = {}
+
+    # -- building ------------------------------------------------------------
+
+    def add_file(self, name: str, fp: str) -> int:
+        """Register one corpus file (basename + fingerprint); returns
+        its file index for :meth:`add_span`."""
+        with self._lock:
+            self._files.append(str(name))
+            self._fps.append(str(fp))
+            return len(self._files) - 1
+
+    def add_span(self, key, file_index: int, group_index: int,
+                 row_start: int, row_end: int) -> None:
+        """Record that ``key`` occupies rows ``[row_start, row_end)``
+        of one row group.  Adjacent spans of the same key merge."""
+        if row_end <= row_start:
+            raise ValueError(
+                f"empty span [{row_start}, {row_end}) for key {key!r}"
+            )
+        ek = encode_key(key)
+        span = [int(file_index), int(group_index),
+                int(row_start), int(row_end)]
+        with self._lock:
+            spans = self._entries.setdefault(ek, [])
+            if spans and spans[-1][:2] == span[:2] and \
+                    spans[-1][3] == span[2]:
+                spans[-1][3] = span[3]
+            else:
+                spans.append(span)
+
+    # -- persistence ---------------------------------------------------------
+
+    def save(self, path: Optional[str] = None) -> str:
+        """Write the sidecar atomically (temp file + rename); returns
+        the path written."""
+        p = os.fspath(path) if path is not None else self.path
+        if p is None:
+            raise ValueError("SecondaryIndex has no path; pass one to save()")
+        with self._lock:
+            payload = json.dumps(
+                {"version": _VERSION, "column": self.column,
+                 "fingerprint": self.fingerprint,
+                 "files": self._files, "fps": self._fps,
+                 "entries": self._entries},
+                sort_keys=True,
+            )
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(payload)
+        os.replace(tmp, p)
+        self.path = p
+        return p
+
+    @classmethod
+    def open(cls, path) -> "SecondaryIndex":
+        """Load a sidecar; a file that does not parse, carries an
+        unknown version, or is structurally malformed raises
+        ``ValueError`` loudly — a corrupt index must never quietly
+        serve empty (= wrong) probe answers."""
+        p = os.fspath(path)
+        try:
+            with open(p, "rb") as fh:
+                data = json.loads(fh.read().decode("utf-8"))
+        except (OSError, MemoryError):
+            raise
+        except Exception as e:
+            raise ValueError(f"secondary index {p!r} does not parse: {e}") \
+                from e
+        if not isinstance(data, dict) or data.get("version") != _VERSION:
+            raise ValueError(
+                f"secondary index {p!r} has unknown version "
+                f"{data.get('version') if isinstance(data, dict) else data!r}"
+            )
+        column = data.get("column")
+        if not column or not isinstance(column, str):
+            raise ValueError(f"secondary index {p!r} names no column")
+        idx = cls(column, path=p,
+                  fingerprint=data.get("fingerprint") or "tail")
+        files, fps = data.get("files") or [], data.get("fps") or []
+        if len(files) != len(fps):
+            raise ValueError(
+                f"secondary index {p!r}: {len(files)} files but "
+                f"{len(fps)} fingerprints"
+            )
+        idx._files = [str(f) for f in files]
+        idx._fps = [str(f) for f in fps]
+        entries = data.get("entries") or {}
+        if not isinstance(entries, dict):
+            raise ValueError(f"secondary index {p!r}: malformed entries")
+        for ek, spans in entries.items():
+            for s in spans:
+                if len(s) != 4 or not 0 <= int(s[0]) < len(files) or \
+                        int(s[3]) <= int(s[2]):
+                    raise ValueError(
+                        f"secondary index {p!r}: malformed span {s!r} "
+                        f"for key {ek!r}"
+                    )
+        idx._entries = {
+            str(ek): [[int(x) for x in s] for s in spans]
+            for ek, spans in entries.items()
+        }
+        return idx
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def files(self) -> List[str]:
+        with self._lock:
+            return list(self._files)
+
+    @property
+    def file_fingerprints(self) -> List[str]:
+        with self._lock:
+            return list(self._fps)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def spans_for(self, key) -> List[tuple]:
+        """``(file_index, group_index, row_start, row_end)`` spans for
+        one key, corpus order; ``[]`` PROVES the key absent from the
+        indexed corpus (the index is exhaustive by construction)."""
+        try:
+            ek = encode_key(key)
+        except ValueError:
+            return []
+        with self._lock:
+            return [tuple(s) for s in self._entries.get(ek, [])]
+
+    def verify_file(self, file_index: int, source) -> bool:
+        """True when ``source``'s bytes still match the fingerprint
+        recorded for ``file_index`` at build time."""
+        with self._lock:
+            fp = self._fps[file_index]
+        return file_fingerprint(source, self.fingerprint) == fp

@@ -1,0 +1,424 @@
+"""Sorted-merge join over ``sort_by``-compacted corpora (docs/query.md).
+
+Two serving :class:`~parquet_floor_tpu.serve.lookup.Dataset`\\ s whose
+files were produced by ``DatasetCompactor(sort_by=<join key>)`` stream
+through a memory-bounded merge: at any moment the join holds ONE decoded
+row group per side plus ONE equal-key run of the right stream — never a
+hash table, never a spill file.  The merge trusts the corpora's
+RECORDED order and verifies it twice:
+
+* **plan time** — every file's row groups must record
+  ``sorting_columns`` with the join key as an ascending, nulls-last
+  prefix (what the compactor writes for ``sort_by``); anything else is
+  a typed refusal (:class:`UnsupportedFeatureError`), never a silently
+  wrong join;
+* **run time** — each side's key stream is checked monotone as it is
+  consumed (the compactor orders rows *within* its output; a corpus
+  assembled from files in the wrong order would otherwise merge
+  quietly and drop matches).
+
+Semantics are SQL's: ``how="inner"`` emits one output row per matching
+(left, right) pair; ``how="left"`` additionally emits unmatched left
+rows with the right columns ``None``.  Null join keys never match
+(nulls-last ordering puts them at the tail).  Multi-key joins compare
+the key tuples element-wise.  A right-side column whose name collides
+with a non-key left column is delivered as ``right.<name>``.
+
+:class:`JoinCursor` pages the merge ``page_rows`` at a time and exposes
+a stateless JSON resume token (fingerprinted like the range cursor's —
+replay against a different dataset pair/key/projection is refused
+loudly); the serving daemon's ``join_page`` op rides it, one bounded
+page per request.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional, Sequence
+
+from ..errors import UnsupportedFeatureError
+from ..utils import trace
+
+_TOKEN_KEYS = frozenset(("lf", "lg", "lr", "rf", "rg", "rr", "ri", "fp"))
+
+
+def _has_null(key: tuple) -> bool:
+    return any(k is None for k in key)
+
+
+def _key_lt(a: tuple, b: tuple) -> bool:
+    """Strict ``a < b`` under the compactor's order: element-wise,
+    nulls LAST per element."""
+    for x, y in zip(a, b):
+        if x is None and y is None:
+            continue
+        if x is None:
+            return False
+        if y is None:
+            return True
+        if x == y:
+            continue
+        try:
+            return bool(x < y)
+        except TypeError as e:
+            raise UnsupportedFeatureError(
+                f"join keys are not mutually ordered: "
+                f"{type(x).__name__} vs {type(y).__name__}"
+            ) from e
+    return False
+
+
+def _check_sorted(ds, on: Sequence[str], side: str) -> None:
+    """Refuse a corpus whose files do not RECORD the join key as an
+    ascending nulls-last ``sorting_columns`` prefix — the compactor's
+    ``sort_by`` contract the merge depends on."""
+    for i in range(len(ds._sources)):
+        lf = ds._file(i)
+        with lf.lock:
+            groups = list(lf.reader.row_groups)
+        for gi, rg in enumerate(groups):
+            names = []
+            for s in rg.sorting_columns or []:
+                idx = int(s.column_idx or 0)
+                chunks = rg.columns or []
+                md = chunks[idx].meta_data if idx < len(chunks) else None
+                if md is None or not md.path_in_schema:
+                    raise UnsupportedFeatureError(
+                        f"{side} corpus file {i} row group {gi}: "
+                        f"sorting_columns references column {idx} with no "
+                        "metadata — cannot prove sort order"
+                    )
+                if s.descending or s.nulls_first:
+                    raise UnsupportedFeatureError(
+                        f"{side} corpus file {i} row group {gi}: join "
+                        "requires ascending nulls-last sort order, but "
+                        f"column {'.'.join(md.path_in_schema)!r} records "
+                        f"descending={bool(s.descending)} "
+                        f"nulls_first={bool(s.nulls_first)}"
+                    )
+                names.append(".".join(md.path_in_schema))
+            if tuple(names[:len(on)]) != tuple(on):
+                raise UnsupportedFeatureError(
+                    f"{side} corpus file {i} row group {gi} is not "
+                    f"recorded as sorted by {list(on)}: sorting_columns="
+                    f"{names or None}.  sorted-merge join refuses "
+                    "unsorted corpora — recompact with "
+                    f"DatasetCompactor(..., sort_by={list(on)})"
+                )
+
+
+def _key_cursors(batch, on: Sequence[str]) -> list:
+    from ..api.reader import _ColumnCursor
+
+    by_name = {".".join(b.descriptor.path): b for b in batch.columns}
+    cursors = []
+    for name in on:
+        b = by_name.get(name)
+        if b is None:
+            raise ValueError(f"join key column {name!r} missing from batch")
+        if b.descriptor.max_repetition_level > 0:
+            raise UnsupportedFeatureError(
+                f"join key column {name!r} is repeated; join keys are "
+                "flat-only"
+            )
+        cursors.append(_ColumnCursor(b))
+    return cursors
+
+
+def _corpus_rows(ds, on: Sequence[str], columns, tenant, start):
+    """``(file, group, row, key_tuple, row_dict)`` for every row of the
+    dataset at or after ``start`` (inclusive), in corpus order — one
+    decoded row group held at a time, decode inside the dataset's
+    device-time slice exactly like the probe ladder."""
+    filter_set = ds._filter_set(columns)
+    if filter_set is not None:
+        filter_set = filter_set | {c.split(".")[0] for c in on}
+    f0, g0, r0 = start if start else (0, 0, 0)
+    for i in range(f0, len(ds._sources)):
+        lf = ds._file(i)
+        gstart = g0 if i == f0 else 0
+        for gi in range(gstart, len(lf.reader.row_groups)):
+            rstart = r0 if (i == f0 and gi == gstart) else 0
+            with ds._device(tenant):
+                with lf.lock:
+                    batch = lf.reader.read_row_group(gi, filter_set)
+            kcur = _key_cursors(batch, on)
+            out = ds._out_columns(batch, columns)
+            for r in range(rstart, int(batch.num_rows)):
+                key = tuple(c.cell(r) for c in kcur)
+                yield i, gi, r, key, {nm: c.cell(r) for nm, c in out}
+
+
+def _schema_names(ds, columns) -> List[str]:
+    """Projected FLAT column names straight from the schema — what an
+    unmatched-left output row nulls out when the right stream never
+    produced a batch to learn names from."""
+    lf = ds._file(0)
+    with lf.lock:
+        descs = list(lf.reader.schema.columns)
+    want = columns if columns is not None else ds._columns
+    names = []
+    for d in descs:
+        name = ".".join(d.path)
+        if want is not None and d.path[0] not in set(want) \
+                and name not in set(want):
+            continue
+        if d.max_repetition_level > 0:
+            raise UnsupportedFeatureError(
+                f"join projection includes repeated column {name!r}; "
+                "the join face is flat-only"
+            )
+        names.append(name)
+    return names
+
+
+class JoinCursor:
+    """Paged, resumable sorted-merge join of two datasets (module
+    docstring).  Acquire-and-close (or ``with``): :meth:`close`
+    releases the merge state (and the datasets themselves when
+    constructed with ``own_datasets=True``).
+
+    ``cursor`` resumes from a previous cursor's :attr:`token`; the
+    token carries a fingerprint of (both corpora's identities, ``on``,
+    ``how``, both projections) and a token minted for ANY other
+    configuration is rejected with :class:`ValueError` — a resume
+    must never silently merge the wrong corpora.
+    """
+
+    def __init__(self, left, right, on: Sequence[str], how: str = "inner",
+                 left_columns: Optional[Sequence[str]] = None,
+                 right_columns: Optional[Sequence[str]] = None,
+                 tenant=None, page_rows: int = 256,
+                 cursor: Optional[dict] = None,
+                 own_datasets: bool = False):
+        from ..serve.lookup import config_fingerprint
+
+        if how not in ("inner", "left"):
+            raise ValueError(f"how must be 'inner' or 'left', got {how!r}")
+        if page_rows <= 0:
+            raise ValueError(f"page_rows must be > 0, got {page_rows}")
+        on = tuple(on)
+        if not on:
+            raise ValueError("join needs at least one key column in on=")
+        for ds, side in ((left, "left"), (right, "right")):
+            if ds.key_column != on[0]:
+                raise ValueError(
+                    f"{side} dataset's key_column "
+                    f"({ds.key_column!r}) must equal on[0] ({on[0]!r}) — "
+                    "the join streams each corpus in its recorded key "
+                    "order"
+                )
+        _check_sorted(left, on, "left")
+        _check_sorted(right, on, "right")
+        self._left = left
+        self._right = right
+        self._on = on
+        self._how = how
+        self._lcols = list(left_columns) if left_columns else None
+        self._rcols = list(right_columns) if right_columns else None
+        self._tenant = tenant
+        self.page_rows = int(page_rows)
+        self._own = bool(own_datasets)
+        self._fp = config_fingerprint([
+            left._identity(), right._identity(), list(on), how,
+            self._lcols, self._rcols,
+        ])
+        if cursor is not None:
+            if not isinstance(cursor, dict) or \
+                    not _TOKEN_KEYS <= set(cursor):
+                raise ValueError(f"malformed join cursor token: {cursor!r}")
+            if cursor["fp"] != self._fp:
+                raise ValueError(
+                    "join cursor token was minted for a different "
+                    "corpus pair / key / projection (token fp="
+                    f"{cursor['fp']!r}, this join fp={self._fp!r}) — "
+                    "refusing to resume"
+                )
+        self._token = dict(cursor) if cursor is not None else None
+        self._exhausted = False
+        self._closed = False
+        self._gen = self._merge(cursor)
+
+    # -- the merge -----------------------------------------------------------
+
+    def _merge(self, tok):
+        skip = int(tok["ri"]) if tok else 0
+        lstart = (int(tok["lf"]), int(tok["lg"]), int(tok["lr"])) \
+            if tok else None
+        rstart = (int(tok["rf"]), int(tok["rg"]), int(tok["rr"])) \
+            if tok else None
+        lrows = _corpus_rows(self._left, self._on, self._lcols,
+                             self._tenant, lstart)
+        rit = _corpus_rows(self._right, self._on, self._rcols,
+                           self._tenant, rstart)
+        state = {
+            "pending": next(rit, None),  # lookahead (pos..., key, row)
+            "run_key": None,             # current right equal-key run
+            "run": [],
+            "run_pos": rstart or (0, 0, 0),
+            "prev": None,                # right monotonicity watermark
+        }
+        rnames = None                    # right names, learned lazily
+
+        def check_mono(prev, key, side):
+            if prev is not None and _key_lt(key, prev):
+                raise UnsupportedFeatureError(
+                    f"{side} corpus is not globally sorted by "
+                    f"{list(self._on)}: key {key!r} follows {prev!r}.  "
+                    "The compactor orders rows within its output — the "
+                    "corpus's files must be listed in key order"
+                )
+
+        def load_next_run():
+            p = state["pending"]
+            if p is None:
+                state["run_key"], state["run"] = None, []
+                return False
+            f, g, r, k, row = p
+            check_mono(state["prev"], k, "right")
+            state["prev"] = k
+            state["run_key"], state["run"] = k, [row]
+            state["run_pos"] = (f, g, r)
+            p = next(rit, None)
+            while p is not None and p[3] == k:
+                state["run"].append(p[4])
+                p = next(rit, None)
+            state["pending"] = p
+            return True
+
+        def right_names():
+            nonlocal rnames
+            if rnames is None:
+                rnames = (
+                    list(state["run"][0])
+                    if state["run"]
+                    else _schema_names(self._right, self._rcols)
+                )
+            return rnames
+
+        def outrow(lrow, rrow):
+            out = dict(lrow)
+            for nm in right_names():
+                if nm in self._on:
+                    continue
+                val = rrow.get(nm) if rrow is not None else None
+                out[f"right.{nm}" if nm in lrow else nm] = val
+            return out
+
+        prev_l = None
+        for fl, gl, rl, lkey, lrow in lrows:
+            check_mono(prev_l, lkey, "left")
+            prev_l = lkey
+            matched = False
+            if not _has_null(lkey):
+                while True:
+                    if state["run_key"] is None:
+                        if not load_next_run():
+                            break
+                    if _key_lt(state["run_key"], lkey):
+                        state["run_key"] = None
+                        continue
+                    break
+                if state["run_key"] == lkey and not _has_null(lkey):
+                    matched = True
+                    for ri, rrow in enumerate(state["run"]):
+                        if skip:
+                            skip -= 1
+                            continue
+                        yield ((fl, gl, rl), state["run_pos"], ri,
+                               outrow(lrow, rrow))
+            if not matched and self._how == "left":
+                if skip:
+                    skip -= 1
+                    continue
+                yield ((fl, gl, rl), state["run_pos"], 0,
+                       outrow(lrow, None))
+
+    # -- paging --------------------------------------------------------------
+
+    @property
+    def token(self) -> Optional[dict]:
+        """JSON-safe resume position after the rows delivered so far
+        (``None`` once exhausted)."""
+        if self._exhausted:
+            return None
+        if self._token is not None:
+            return dict(self._token)
+        return {"lf": 0, "lg": 0, "lr": 0, "rf": 0, "rg": 0, "rr": 0,
+                "ri": 0, "fp": self._fp}
+
+    @property
+    def exhausted(self) -> bool:
+        return self._exhausted
+
+    def next_page(self) -> List[dict]:
+        """Up to ``page_rows`` more joined rows (``[]`` when done)."""
+        if self._closed:
+            raise ValueError("JoinCursor is closed")
+        rows: List[dict] = []
+        ctx = (
+            trace.using(self._tenant.tracer)
+            if self._tenant is not None else contextlib.nullcontext()
+        )
+        with ctx, trace.span("query.join",
+                             attrs={"how": self._how,
+                                    "on": ",".join(self._on)},
+                             observe="query.join_seconds"):
+            for lpos, rpos, ri, row in self._gen:
+                rows.append(row)
+                self._token = {
+                    "lf": lpos[0], "lg": lpos[1], "lr": lpos[2],
+                    "rf": rpos[0], "rg": rpos[1], "rr": rpos[2],
+                    "ri": ri + 1, "fp": self._fp,
+                }
+                if len(rows) >= self.page_rows:
+                    break
+            else:
+                self._exhausted = True
+            trace.count("query.join_pages")
+            trace.count("query.join_rows", len(rows))
+        return rows
+
+    def __iter__(self):
+        while True:
+            page = self.next_page()
+            if not page:
+                return
+            yield from page
+
+    def close(self) -> None:
+        """Release the merge (and the datasets when owned);
+        idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._gen.close()
+        if self._own:
+            self._left.close()
+            self._right.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def sorted_merge_join(left, right, on: Sequence[str], how: str = "inner",
+                      left_columns: Optional[Sequence[str]] = None,
+                      right_columns: Optional[Sequence[str]] = None,
+                      tenant=None, page_rows: int = 1024):
+    """Generator of joined row dicts — the one-shot face over
+    :class:`JoinCursor` (which see, for paging/resume)."""
+    cur = JoinCursor(left, right, on, how=how,
+                     left_columns=left_columns,
+                     right_columns=right_columns,
+                     tenant=tenant, page_rows=page_rows)
+    try:
+        while True:
+            page = cur.next_page()
+            if not page:
+                return
+            yield from page
+    finally:
+        cur.close()

@@ -1083,14 +1083,17 @@ def scan_device_groups(sources: Sequence,
 
     sc = scan or ScanOptions()
     compute_req = None
-    if sc.aggregate is not None or (sc.pushdown and predicate is not None):
+    use_pred = predicate is not None and (
+        sc.pushdown or sc.aggregate is not None
+    )
+    if sc.aggregate is not None or use_pred or sc.project_exprs:
         from ..errors import UnsupportedFeatureError
 
         if options is not None and options.salvage:
             raise UnsupportedFeatureError(
-                "pushdown/aggregate do not compose with salvage "
-                "(quarantine decisions are group-wide); scan with "
-                "salvage and filter on host"
+                "pushdown/aggregate/project_exprs do not compose with "
+                "salvage (quarantine decisions are group-wide); scan "
+                "with salvage and filter on host"
             )
         scope = None
         if sources:
@@ -1100,10 +1103,15 @@ def scan_device_groups(sources: Sequence,
                 else getattr(s0, "name", None)
             )
         compute_req = ComputeRequest(
-            predicate=predicate, aggregate=sc.aggregate,
+            predicate=predicate if use_pred else None,
+            aggregate=sc.aggregate,
+            # an expr-only request ships full columns plus the computed
+            # outputs — mask mode, nothing filtered
+            mode="compact" if use_pred else "mask",
             # dataset identity for the persisted capacity HWM —
             # selectivity is a property of (predicate, data)
             cache_scope=scope,
+            exprs=sc.project_exprs or None,
         )
     # attribute the whole scan to the tracer active at generator start
     # (worker tasks bind to it explicitly; a bare contextvar would not
@@ -1314,6 +1322,7 @@ def scan_device_groups(sources: Sequence,
                 break
             tracer.add("scan.consumer_stall", time.perf_counter() - t0)
             fi_, gp, cache_, cost = units[i]
+            res_exprs = None
             if isinstance(cols, PushdownResult):
                 res = cols
                 if sc.aggregate is not None:
@@ -1325,6 +1334,7 @@ def scan_device_groups(sources: Sequence,
                         res.num_rows - res.num_selected,
                     )
                     cols = res.columns
+                    res_exprs = res.exprs
             if cols is not None:
                 # the POSITIONAL contract: every yielded group carries
                 # the FIRST file's selected columns, in schema order —
@@ -1347,6 +1357,17 @@ def scan_device_groups(sources: Sequence,
                             f"row group {gp.group_index} missing column {n}"
                         )
                     ordered[n] = cols[n]
+                if res_exprs:
+                    # computed outputs ride AFTER the schema columns, in
+                    # plan order (docs/query.md's delivery contract)
+                    from ..query.expr import ComputedColumn
+
+                    for en, (vals, emask) in res_exprs.items():
+                        ordered[en] = ComputedColumn(en, vals, emask)
+                    tracer.count(
+                        "query.expr_rows",
+                        len(res_exprs) * int(res.num_selected),
+                    )
                 yield fi_, gp.group_index, ordered
             floor = i + 1
             # the engine staged this group before yielding it: its

@@ -91,6 +91,14 @@ class ScanOptions:
       the device leg ships per-group PARTIAL aggregate states
       (O(groups) bytes of D2H) instead of columns; fold them with
       ``scan.scan_aggregate`` (docs/pushdown.md).
+    * ``project_exprs`` — ``((name, Expr-or-tree), ...)`` computed
+      output columns (``docs/query.md``): the device leg evaluates each
+      expression INSIDE the fused decode executable and delivers the
+      results alongside the projected columns (the expression is part
+      of the executable's persistent exec-cache key); the host leg
+      computes the bit-equal twin with
+      :func:`~parquet_floor_tpu.query.expr.eval_expr_host`.  Does not
+      compose with ``aggregate`` or salvage.
     """
 
     max_gap_bytes: Optional[int] = DEFAULT_MAX_GAP_BYTES
@@ -101,6 +109,7 @@ class ScanOptions:
     page_prune: bool = False
     pushdown: bool = False
     aggregate: Optional[object] = None
+    project_exprs: tuple = ()
 
     def __post_init__(self):
         if self.aggregate is not None:
@@ -111,6 +120,20 @@ class ScanOptions:
                     "ScanOptions.aggregate must be a "
                     "batch.aggregate.Aggregate"
                 )
+        if self.project_exprs:
+            from ..query.expr import exprs_signature
+
+            if self.aggregate is not None:
+                raise ValueError(
+                    "ScanOptions.project_exprs does not compose with "
+                    "aggregate (an aggregate scan ships states, not "
+                    "columns)"
+                )
+            # normalize eagerly: a malformed tree fails HERE, loudly,
+            # not inside a jit trace (frozen dataclass — go around)
+            object.__setattr__(
+                self, "project_exprs", exprs_signature(self.project_exprs)
+            )
         if self.max_gap_bytes is not None and self.max_gap_bytes < 0:
             raise ValueError(f"max_gap_bytes must be >= 0, got {self.max_gap_bytes}")
         if self.max_extent_bytes <= 0:

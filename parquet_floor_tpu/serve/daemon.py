@@ -41,6 +41,14 @@ op              request fields → reply fields (all replies carry ``ok``)
                 ``page_rows?``, ``cursor?`` → ``rows``, ``cursor``
                 (pass the returned cursor back for the next page;
                 ``null`` when exhausted)
+``select``      ``dataset``, ``exprs`` (list of ``[name, tree]`` —
+                the JSON shape of ``Expr.tree()``), ``lo?``/``hi?``
+                (key range filter), ``columns?``, ``limit?`` → ``rows``
+``join_page``   ``left``, ``right`` (dataset names), ``on`` (key
+                columns), ``how?``, ``left_columns?``,
+                ``right_columns?``, ``page_rows?``, ``cursor?`` →
+                ``rows``, ``cursor`` (stateless resume, as
+                ``range_page``)
 ``metrics``     → ``metrics`` (the folded multi-worker snapshot)
 ``health``      → ``health`` (the one-page ``Serving.health`` text)
 ``ping``        → (empty)
@@ -624,7 +632,8 @@ class ServeDaemon:
             except Exception as e:
                 return {"ok": False, "code": "bad_request",
                         "error": f"{type(e).__name__}: {e}"}
-        if op not in ("lookup", "range", "range_page"):
+        if op not in ("lookup", "range", "range_page", "select",
+                      "join_page"):
             return {"ok": False, "code": "bad_request",
                     "error": f"unknown op {op!r}"}
         # per-tenant rate limit, BEFORE admission: an over-rate tenant
@@ -692,6 +701,8 @@ class ServeDaemon:
                 return self._execute_op(tenant, req, op)
 
     def _execute_op(self, tenant, req: dict, op: str) -> dict:
+        if op == "join_page":
+            return self._join_page(tenant, req)
         ds = self.datasets.get(req.get("dataset"))
         if ds is None:
             return {
@@ -700,6 +711,30 @@ class ServeDaemon:
                          f"(have {sorted(self.datasets)})",
             }
         columns = req.get("columns")
+        if op == "select":
+            from ..query.expr import tree_from_json
+
+            raw = req.get("exprs")
+            if not isinstance(raw, list) or not raw:
+                return {"ok": False, "code": "bad_request",
+                        "error": "select requires exprs: a non-empty "
+                                 "list of [name, tree] pairs"}
+            try:
+                exprs = tuple(
+                    (name, tree_from_json(t)) for name, t in raw
+                )
+            except (TypeError, ValueError) as e:
+                return {"ok": False, "code": "bad_request",
+                        "error": f"malformed expression: {e}"}
+            from ..batch.predicate import col as _col
+
+            pred = None
+            if "lo" in req or "hi" in req:
+                pred = (_col(ds.key_column) >= req["lo"]) & \
+                    (_col(ds.key_column) <= req["hi"])
+            rows = ds.select(exprs, predicate=pred, columns=columns,
+                             tenant=tenant, limit=req.get("limit"))
+            return {"ok": True, "rows": rows}
         if op == "lookup":
             rows = ds.lookup(req["key"], columns=columns, tenant=tenant,
                              limit=req.get("limit"))
@@ -717,6 +752,41 @@ class ServeDaemon:
         )
         rows = cur.next_page()
         return {"ok": True, "rows": rows, "cursor": cur.token}
+
+    def _join_page(self, tenant, req: dict) -> dict:
+        """One bounded page of a sorted-merge join (docs/query.md) —
+        stateless across requests exactly like ``range_page``: the
+        fingerprinted cursor token IS the state, so any worker serving
+        the same datasets can answer the next page."""
+        from ..query.join import JoinCursor
+
+        sides = {}
+        for field in ("left", "right"):
+            ds = self.datasets.get(req.get(field))
+            if ds is None:
+                return {
+                    "ok": False, "code": "bad_request",
+                    "error": f"unknown {field} dataset "
+                             f"{req.get(field)!r} "
+                             f"(have {sorted(self.datasets)})",
+                }
+            sides[field] = ds
+        on = req.get("on")
+        if not isinstance(on, list) or not on:
+            return {"ok": False, "code": "bad_request",
+                    "error": "join_page requires on: a non-empty list "
+                             "of key columns"}
+        with JoinCursor(
+            sides["left"], sides["right"], on,
+            how=req.get("how", "inner"),
+            left_columns=req.get("left_columns"),
+            right_columns=req.get("right_columns"),
+            tenant=tenant,
+            page_rows=int(req.get("page_rows", 256)),
+            cursor=req.get("cursor"),
+        ) as cur:
+            rows = cur.next_page()
+            return {"ok": True, "rows": rows, "cursor": cur.token}
 
 
 class DaemonClient:
@@ -788,6 +858,33 @@ class DaemonClient:
         r = self._checked(self.request(
             "range_page", dataset=dataset, lo=lo, hi=hi,
             columns=columns, page_rows=page_rows, cursor=cursor,
+        ))
+        return r["rows"], r.get("cursor")
+
+    def select(self, dataset: str, exprs, lo=None, hi=None,
+               columns=None, limit=None) -> list:
+        """Projection-expression query: ``exprs`` is a list of
+        ``(name, expr_or_tree)`` pairs (``Expr`` objects are exported
+        via ``.tree()`` for the wire)."""
+        wire = []
+        for name, e in exprs:
+            t = e.tree() if hasattr(e, "tree") else e
+            wire.append([name, t])
+        fields = {"dataset": dataset, "exprs": wire, "columns": columns,
+                  "limit": limit}
+        if lo is not None or hi is not None:
+            fields["lo"], fields["hi"] = lo, hi
+        return self._checked(self.request("select", **fields))["rows"]
+
+    def join_page(self, left: str, right: str, on, how: str = "inner",
+                  left_columns=None, right_columns=None,
+                  page_rows: int = 256, cursor=None):
+        """One page of a sorted-merge join: ``(rows, next_cursor)`` —
+        pass ``next_cursor`` back in until it comes back None."""
+        r = self._checked(self.request(
+            "join_page", left=left, right=right, on=list(on), how=how,
+            left_columns=left_columns, right_columns=right_columns,
+            page_rows=page_rows, cursor=cursor,
         ))
         return r["rows"], r.get("cursor")
 

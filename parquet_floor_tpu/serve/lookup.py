@@ -39,6 +39,9 @@ Docs: ``docs/serving.md``.
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import json
+import os
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -54,6 +57,28 @@ from .cache import CachedSource, SharedBufferCache
 # could swallow data pages between two dictionary pages into the pinned
 # tier, silently voiding the one-page probe byte proof
 _META_GAP = 0
+
+
+def _source_id(s) -> str:
+    """A process-stable identity for one dataset source — what the
+    cursor-token fingerprint keys on.  Paths ARE the identity; exotic
+    source objects degrade to class name (+ any path/name attribute),
+    which still distinguishes datasets built over different files."""
+    if isinstance(s, (str, bytes, os.PathLike)):
+        return os.fspath(s) if not isinstance(s, bytes) else s.decode(
+            "utf-8", "surrogateescape"
+        )
+    p = getattr(s, "path", None) or getattr(s, "name", None)
+    return f"{type(s).__name__}:{p}" if p else type(s).__name__
+
+
+def config_fingerprint(parts) -> str:
+    """12-hex-char digest of a JSON-able config description — stamped
+    into resume tokens so a token replayed against a DIFFERENT
+    dataset/projection/predicate is refused loudly instead of silently
+    paging the wrong data."""
+    blob = json.dumps(parts, default=repr, sort_keys=True)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:12]
 
 
 class _LookupFile:
@@ -137,6 +162,18 @@ class Dataset:
         self._files: Dict[int, _LookupFile] = {}
         self._open_lock = threading.Lock()
         self._closed = False
+        #: installed SecondaryIndex (query/index.py) — consulted by
+        #: point lookups BEFORE the stats/bloom rungs
+        self._index = None
+
+    def _identity(self) -> list:
+        """Process-stable identity of this dataset's configuration —
+        the cursor-token fingerprint's input."""
+        return [
+            [_source_id(s) for s in self._sources],
+            self.key_column,
+            self._columns,
+        ]
 
     # -- open / pin ----------------------------------------------------------
 
@@ -303,11 +340,6 @@ class Dataset:
         matching rows (empty when any rung killed the group); the
         batch is probe-local, so the mask/convert tail runs unlocked.
         """
-        import numpy as np
-
-        from ..batch.predicate import eval_mask
-        from ..scan.executor import _batch_resolver
-
         reader = lf.reader
         with lf.lock:
             rg = reader.row_groups[gi]
@@ -323,8 +355,24 @@ class Dataset:
             # every page's ColumnIndex ruled it out
             trace.count("serve.lookup_groups_pruned")
             return []
+        return self._ranged_decode(lf, gi, rr, pred, filter_set, tenant,
+                                   columns)
+
+    def _ranged_decode(self, lf: _LookupFile, gi: int, rr, pred,
+                       filter_set, tenant, columns) -> list:
+        """The decode + exact-filter tail shared by the ladder and the
+        secondary-index rung: ranged page read inside a device-time
+        slice, then the predicate-mask exact filter (only matching
+        rows pay cell conversion)."""
+        import numpy as np
+
+        from ..batch.predicate import eval_mask
+        from ..scan.executor import _batch_resolver
+
+        reader = lf.reader
         with self._device(tenant):
             with lf.lock:
+                rg = reader.row_groups[gi]
                 batch, covered = reader.read_row_group_ranges(
                     gi, rr, filter_set
                 )
@@ -336,7 +384,7 @@ class Dataset:
                 )
             # the exact-filter rung rides the SAME predicate-mask
             # compiler as the pushdown compute tail (one filter
-            # semantics); only matching rows pay cell conversion
+            # semantics)
             sel = eval_mask(pred, _batch_resolver(batch),
                             batch.num_rows)
             hits = np.flatnonzero(sel)
@@ -348,7 +396,23 @@ class Dataset:
                 for r in hits
             ]
 
-    def _probe(self, pred, columns, tenant, limit, neg_key=None):
+    def _index_plan(self, key) -> Optional[dict]:
+        """The secondary-index rung's plan for one point probe:
+        ``{file_index: {group_index: [(r0, r1), ...]}}`` covering every
+        row span the key occupies — or None when no index is installed
+        (descend the ladder as usual).  An empty dict PROVES the key
+        absent everywhere."""
+        if self._index is None:
+            return None
+        plan: dict = {}
+        for fi, gi, r0, r1 in self._index.spans_for(key):
+            plan.setdefault(int(fi), {}).setdefault(int(gi), []).append(
+                (int(r0), int(r1))
+            )
+        return plan
+
+    def _probe(self, pred, columns, tenant, limit, neg_key=None,
+               index_plan=None):
         ctx = (
             trace.using(tenant.tracer)
             if tenant is not None else contextlib.nullcontext()
@@ -367,11 +431,32 @@ class Dataset:
             for i in range(len(self._sources)):
                 if done:
                     break
+                if index_plan is not None and i not in index_plan:
+                    # the index PROVES the key absent from this file:
+                    # skip it without opening a byte
+                    trace.count("serve.index_skips")
+                    continue
                 lf = self._file(i)
-                if self._neg_check(lf, neg_key):
+                if index_plan is None and self._neg_check(lf, neg_key):
                     trace.count("serve.negative_hits")
                     continue
                 file_rows0 = len(out)
+                if index_plan is not None:
+                    # the index rung replaces the stats/bloom/page-index
+                    # descent: decode exactly the recorded row spans
+                    for gi in sorted(index_plan[i]):
+                        if limit is not None and len(out) >= limit:
+                            done = True
+                            break
+                        trace.count("serve.index_hits")
+                        for _r, row in self._ranged_decode(
+                            lf, gi, index_plan[i][gi], pred, filter_set,
+                            tenant, columns,
+                        ):
+                            out.append(row)
+                            if limit is not None and len(out) >= limit:
+                                break
+                    continue
                 for gi in range(len(lf.reader.row_groups)):
                     if limit is not None and len(out) >= limit:
                         done = True
@@ -403,11 +488,66 @@ class Dataset:
         ``limit=1``).  Repeatedly-probed ABSENT keys short-circuit at
         the stats/bloom rung via the per-file negative cache
         (``serve.negative_hits``) — sized by ``negative_keys``, sound
-        for the immutable corpora this face serves."""
+        for the immutable corpora this face serves.
+
+        With an installed secondary index (:meth:`install_index`) the
+        probe consults the index BEFORE the stats/bloom rungs: an
+        unlisted key skips every file unread (``serve.index_skips``),
+        a listed key decodes exactly its recorded row spans
+        (``serve.index_hits``) — ≤ one data page of storage bytes for
+        a point probe on a non-sorted column."""
         return self._probe(
             col(self.key_column) == key, columns, tenant, limit,
-            neg_key=key,
+            neg_key=key, index_plan=self._index_plan(key),
         )
+
+    def install_index(self, index) -> None:
+        """Install a :class:`~parquet_floor_tpu.query.index.SecondaryIndex`
+        for this dataset's ``key_column``.  Validates loudly: the index
+        must name this key column, cover exactly this dataset's files
+        IN ORDER, and every recorded file fingerprint must still match
+        the file's bytes — a stale or mismatched index must never
+        silently serve wrong spans.  Installing (or refreshing) an
+        index invalidates every file's negative-lookup cache: entries
+        proven absent by the OLD descent must not answer for the new
+        index's truth."""
+        if index.column != self.key_column:
+            raise ValueError(
+                f"index is for column {index.column!r}, but this "
+                f"dataset's key_column is {self.key_column!r}"
+            )
+        n_files = len(index.files)
+        if n_files != len(self._sources):
+            raise ValueError(
+                f"index covers {n_files} files, dataset has "
+                f"{len(self._sources)} — the index must be built from "
+                "exactly this corpus"
+            )
+        for i in range(n_files):
+            lf = self._file(i)
+            with lf.lock:
+                ok = index.verify_file(i, lf.source)
+            if not ok:
+                raise ValueError(
+                    f"index fingerprint mismatch for file {i} "
+                    f"({index.files[i]!r}): the corpus changed since the "
+                    "index was built — rebuild the index"
+                )
+        with self._open_lock:
+            if self._closed:
+                raise ValueError("Dataset is closed")
+            self._index = index
+            files = list(self._files.values())
+        # negative-cache invalidation rides OUTSIDE _open_lock (per-file
+        # locks only): an installed index changes what "proven absent"
+        # means, so every cached negative is suspect
+        for lf in files:
+            with lf.lock:
+                lf.neg.clear()
+        trace.decision("serve.index", {
+            "action": "install", "column": index.column,
+            "keys": len(index), "files": n_files,
+        })
 
     def range(self, lo, hi, columns: Optional[Sequence[str]] = None,
               tenant=None, limit: Optional[int] = None) -> List[dict]:
@@ -415,6 +555,97 @@ class Dataset:
         as dicts."""
         pred = (col(self.key_column) >= lo) & (col(self.key_column) <= hi)
         return self._probe(pred, columns, tenant, limit)
+
+    def select(self, exprs, predicate=None,
+               columns: Optional[Sequence[str]] = None,
+               tenant=None, limit: Optional[int] = None) -> List[dict]:
+        """Projection-expression query (docs/query.md): every output
+        row carries the projected columns PLUS one computed value per
+        ``(name, tree)`` in ``exprs`` (the same validated tree shape
+        ``ScanOptions.project_exprs`` takes — build with ``qcol`` /
+        ``qlit`` and ``as_expr_tree``).  ``predicate`` prunes row
+        groups through the stats/bloom rungs and exact-filters rows;
+        expressions evaluate on the host leg (``eval_expr_host``),
+        bit-equal to the device scan's fused evaluation by the
+        canonical-lanes contract.  Computed nulls come back as None."""
+        import numpy as np
+
+        from ..batch.predicate import eval_mask, tree, tree_columns
+        from ..query.expr import eval_expr_host, expr_columns, \
+            exprs_signature
+        from ..scan.executor import _batch_resolver
+
+        sig = exprs_signature(exprs)
+        need = set()
+        for _en, et in sig:
+            need |= {c.split(".")[0] for c in expr_columns(et)}
+        if predicate is not None:
+            need |= {c.split(".")[0]
+                     for c in tree_columns(tree(predicate))}
+        want = columns if columns is not None else self._columns
+        filter_set = None if want is None else set(want) | need
+        ctx = (
+            trace.using(tenant.tracer)
+            if tenant is not None else contextlib.nullcontext()
+        )
+        out: List[dict] = []
+        with ctx, trace.span("serve.select",
+                             attrs={"exprs": len(sig)},
+                             observe="serve.select_seconds"):
+            trace.count("serve.select_probes")
+            done = False
+            for i in range(len(self._sources)):
+                if done:
+                    break
+                lf = self._file(i)
+                reader = lf.reader
+                for gi in range(len(reader.row_groups)):
+                    if limit is not None and len(out) >= limit:
+                        done = True
+                        break
+                    with lf.lock:
+                        rg = reader.row_groups[gi]
+                        if predicate is not None:
+                            if not predicate.may_match(rg):
+                                trace.count("serve.lookup_groups_pruned")
+                                continue
+                            if not predicate.may_match_with(reader, rg):
+                                trace.count("serve.lookup_bloom_skips")
+                                continue
+                    with self._device(tenant):
+                        with lf.lock:
+                            batch = reader.read_row_group(gi, filter_set)
+                        resolve = _batch_resolver(batch)
+                        n = int(batch.num_rows)
+                        if predicate is not None:
+                            hits = np.flatnonzero(
+                                eval_mask(predicate, resolve, n)
+                            )
+                        else:
+                            hits = np.arange(n)
+                        if not hits.size:
+                            continue
+                        cursors = self._out_columns(batch, columns)
+                        computed = [
+                            (en, eval_expr_host(et, resolve, n))
+                            for en, et in sig
+                        ]
+                        for r in hits:
+                            r = int(r)
+                            row = {nm: c.cell(r) for nm, c in cursors}
+                            for en, (vals, mask) in computed:
+                                row[en] = (
+                                    None
+                                    if mask is not None and bool(mask[r])
+                                    else vals[r].item()
+                                )
+                            out.append(row)
+                            if limit is not None and len(out) >= limit:
+                                break
+            if limit is not None:
+                out = out[:limit]
+            trace.count("serve.select_rows", len(out))
+        return out
 
     def range_cursor(self, lo, hi,
                      columns: Optional[Sequence[str]] = None,
@@ -583,8 +814,24 @@ class RangeCursor:
                  page_rows: int, token: Optional[dict]):
         if page_rows <= 0:
             raise ValueError(f"page_rows must be > 0, got {page_rows}")
-        if token is not None and not {"f", "g", "r"} <= set(token):
-            raise ValueError(f"malformed cursor token: {token!r}")
+        # the fingerprint pins the token to THIS dataset + projection +
+        # range: a token replayed against anything else is refused
+        # loudly instead of silently paging the wrong rows
+        self._fp = config_fingerprint([
+            ds._identity(),
+            list(columns) if columns is not None else None,
+            repr(lo), repr(hi),
+        ])
+        if token is not None:
+            if not isinstance(token, dict) or \
+                    not {"f", "g", "r", "fp"} <= set(token):
+                raise ValueError(f"malformed cursor token: {token!r}")
+            if token["fp"] != self._fp:
+                raise ValueError(
+                    "cursor token was minted for a different dataset/"
+                    f"projection/range (token fp={token['fp']!r}, this "
+                    f"cursor fp={self._fp!r}) — refusing to resume"
+                )
         self.page_rows = int(page_rows)
         self._tenant = tenant
         pred = (col(ds.key_column) >= lo) & (col(ds.key_column) <= hi)
@@ -599,7 +846,7 @@ class RangeCursor:
         if self._exhausted:
             return None
         return dict(self._token) if self._token is not None else {
-            "f": 0, "g": 0, "r": -1,
+            "f": 0, "g": 0, "r": -1, "fp": self._fp,
         }
 
     @property
@@ -611,7 +858,7 @@ class RangeCursor:
         rows: List[dict] = []
         for f, g, r, row in self._gen:
             rows.append(row)
-            self._token = {"f": f, "g": g, "r": r}
+            self._token = {"f": f, "g": g, "r": r, "fp": self._fp}
             if len(rows) >= self.page_rows:
                 break
         else:

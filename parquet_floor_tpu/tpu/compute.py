@@ -83,14 +83,26 @@ class ComputeRequest:
     def __init__(self, predicate=None, aggregate: Optional[Aggregate] = None,
                  mode: str = "compact",
                  initial_capacity: Optional[int] = None,
-                 cache_scope: Optional[str] = None):
-        if predicate is None and aggregate is None:
+                 cache_scope: Optional[str] = None,
+                 exprs=None):
+        if predicate is None and aggregate is None and not exprs:
             raise ValueError("ComputeRequest needs a predicate, an "
-                             "aggregate, or both")
+                             "aggregate, or projection exprs")
         if mode not in ("compact", "mask"):
             raise ValueError(f"bad pushdown mode {mode!r}")
         if aggregate is not None and not isinstance(aggregate, Aggregate):
             raise TypeError("aggregate must be a batch.aggregate.Aggregate")
+        if exprs and aggregate is not None:
+            raise ValueError(
+                "projection exprs do not compose with aggregate pushdown "
+                "(an aggregate launch ships states, not columns)"
+            )
+        if exprs:
+            from ..query.expr import exprs_signature
+
+            self.exprs = exprs_signature(exprs)
+        else:
+            self.exprs = ()
         self.tree = _pred.tree(predicate) if predicate is not None else None
         self.aggregate = aggregate
         self.mode = mode
@@ -160,6 +172,11 @@ class ComputeRequest:
             out |= _pred.tree_columns(self.tree)
         if self.aggregate is not None:
             out |= self.aggregate.columns()
+        if self.exprs:
+            from ..query.expr import expr_columns
+
+            for _name, et in self.exprs:
+                out |= expr_columns(et)
         return out
 
     def capacity_for(self, n: int) -> int:
@@ -213,6 +230,10 @@ class _CPlan(NamedTuple):
     gcap: int              # group scatter capacity (dict_cap)
     n_masks: int           # dictionary-match mask input arrays
     n: int                 # rows in the group
+    # ((name, static expr tree), ...) — computed output columns
+    # (docs/query.md); appended with a default so existing positional
+    # constructions (and pickled plans) keep working
+    exprs: tuple = ()
 
 
 @dataclass
@@ -244,6 +265,10 @@ class PushdownResult:
     num_selected: int
     mask: Optional[jax.Array] = None          # mode="mask" only
     agg: Optional[AggPartial] = None
+    # computed output columns (docs/query.md): name -> (values, null
+    # mask|None), row-aligned with ``columns`` (compact-trimmed in
+    # compact mode, full-length in mask mode)
+    exprs: Optional[dict] = None
 
 
 # ---------------------------------------------------------------------------
@@ -421,11 +446,46 @@ def build_for_program(request: ComputeRequest, specs, stages_by_name: dict,
         ship = tuple(s.name for s in specs)
         if mode == "compact":
             capacity = request.capacity_for(int(num_rows))
+    exprs = getattr(request, "exprs", ())
+    if exprs:
+        _check_expr_specs(exprs, specs)
     built.cplan = _CPlan(
         tree, mode, capacity, ship, aggs, group, gcap,
-        len(built.masks), int(num_rows),
+        len(built.masks), int(num_rows), exprs,
     )
     return built
+
+
+def _check_expr_specs(exprs, specs) -> None:
+    """Plan-time validation of projection exprs against one staged
+    program: inputs must be numeric non-string gather-form columns the
+    device tail can evaluate EXACTLY — everything else raises
+    ``UnsupportedFeatureError`` (the whole-scan host-fallback
+    trigger)."""
+    from ..query.expr import expr_columns
+
+    spec_names = {s.name for s in specs}
+    for out_name, et in exprs:
+        if out_name in spec_names:
+            raise ValueError(
+                f"expression output {out_name!r} collides with a "
+                "projected source column — name it something else"
+            )
+        for cname in sorted(expr_columns(et)):
+            spec = _spec_by_name(specs, cname)
+            if spec.kind in ("dict_idx", "dict_idx_num"):
+                raise UnsupportedFeatureError(
+                    f"expression input {cname!r} is an index-form "
+                    "dictionary column (values are dictionary slots) — "
+                    "use dict_form='gather'"
+                )
+            if spec.vdtype not in _NUM_VDTYPES or spec.max_len > 0:
+                raise UnsupportedFeatureError(
+                    f"expression input {cname!r} is not numeric "
+                    f"(kind {spec.kind!r}, vdtype {spec.vdtype!r}) — "
+                    "device expressions run over numeric columns"
+                )
+            _reject_lossy_double(spec)
 
 
 # ---------------------------------------------------------------------------
@@ -500,6 +560,22 @@ def compact_indices(sel, capacity: int, n: int):
 
 def take_rows(a, sel_idx):
     return None if a is None else jnp.take(a, sel_idx, axis=0)
+
+
+def eval_exprs(exprs: tuple, ctx: dict, n: int, xp=jnp):
+    """Evaluate the plan's projection exprs over the decoded ``ctx``
+    (docs/query.md) — pure ``xp`` ops, so inside the fused launch this
+    traces into the SAME executable as the decode.  Returns one
+    ``(values, null_mask|None)`` pair per expr, in plan order."""
+    from ..query.expr import eval_expr
+
+    def resolve(name):
+        vals, mask, _lens, _idx = ctx[name]
+        return vals, mask
+
+    return tuple(
+        eval_expr(et, resolve, n, xp) for _name, et in exprs
+    )
 
 
 def _acc_dtype(dtype):
@@ -770,8 +846,41 @@ def eval_on_columns(cols: dict, request: ComputeRequest, num_rows: int):
         )
     count = int(jnp.sum(sel))
     request.observe(count)
+    exprs = getattr(request, "exprs", ())
+    ex_pairs = None
+    if exprs:
+        for _name, et in exprs:
+            from ..query.expr import expr_columns
+
+            for cname in sorted(expr_columns(et)):
+                if cname not in ctx:
+                    raise ValueError(
+                        f"expression references column {cname!r}, "
+                        "which was not decoded"
+                    )
+                vals, _mask, lens, idx = ctx[cname]
+                if idx is not None:
+                    raise UnsupportedFeatureError(
+                        f"expression input {cname!r} is an index-form "
+                        "dictionary column in this (multi-launch) "
+                        "group — use dict_form='gather'"
+                    )
+                if lens is not None or \
+                        str(vals.dtype) not in _NUM_VDTYPES:
+                    raise UnsupportedFeatureError(
+                        f"expression input {cname!r} is not numeric "
+                        f"(dtype {getattr(vals, 'dtype', None)})"
+                    )
+                _reject_lossy_double_col(cname, cols[cname], vals)
+        ex_pairs = eval_exprs(exprs, ctx, n)
     if request.mode == "mask":
-        return PushdownResult(dict(cols), n, count, mask=sel)
+        ex_dict = None
+        if ex_pairs is not None:
+            ex_dict = {
+                name: pair for (name, _et), pair in zip(exprs, ex_pairs)
+            }
+        return PushdownResult(dict(cols), n, count, mask=sel,
+                              exprs=ex_dict)
     sel_idx = compact_indices(sel, max(count, 1), n)
     out = {}
     for name, dc in cols.items():
@@ -786,4 +895,14 @@ def eval_on_columns(cols: dict, request: ComputeRequest, num_rows: int):
         )
         nd.dict_ref = dc.dict_ref
         out[name] = nd
-    return PushdownResult(out, n, count)
+    ex_dict = None
+    if ex_pairs is not None:
+        ex_dict = {
+            name: (
+                take_rows(vals, sel_idx)[:count],
+                None if mask is None
+                else take_rows(mask, sel_idx)[:count],
+            )
+            for (name, _et), (vals, mask) in zip(exprs, ex_pairs)
+        }
+    return PushdownResult(out, n, count, exprs=ex_dict)

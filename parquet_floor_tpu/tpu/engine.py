@@ -919,16 +919,32 @@ def _decode_fused_compute(program: tuple, n_parts: int, cplan, *arrays):
         (spec, f) for spec, f in zip(program, full)
         if spec.name in cplan.ship
     ]
+    # projection exprs (docs/query.md) trace into this SAME executable
+    # — cplan.exprs is static, so a new expression is a new exec-cache
+    # entry exactly like a new predicate
+    exprs = getattr(cplan, "exprs", ())
     if cplan.mode == "mask":
-        return count, sel, tuple((f[0], f[1], f[2]) for _s, f in keep)
+        cols = tuple((f[0], f[1], f[2]) for _s, f in keep)
+        if not exprs:
+            return count, sel, cols
+        return count, sel, cols, _compute.eval_exprs(exprs, ctx, cplan.n)
     sel_idx = _compute.compact_indices(sel, cplan.capacity, cplan.n)
-    return count, tuple(
+    cols = tuple(
         (
             _compute.take_rows(f[0], sel_idx),
             _compute.take_rows(f[1], sel_idx),
             _compute.take_rows(f[2], sel_idx),
         )
         for _s, f in keep
+    )
+    if not exprs:
+        return count, cols
+    return count, cols, tuple(
+        (
+            _compute.take_rows(vals, sel_idx),
+            _compute.take_rows(mask, sel_idx),
+        )
+        for vals, mask in _compute.eval_exprs(exprs, ctx, cplan.n)
     )
 
 
@@ -3155,15 +3171,35 @@ class TpuRowGroupReader:
             return _compute.PushdownResult({}, cp.n, count, agg=partial)
         desc_by = {s.name: d for s, d in zip(sg.program, sg.descs)}
         spec_by = {s.name: s for s in sg.program}
+
+        def expr_dict(ex_outs, trim):
+            return {
+                name: (
+                    vals if trim is None else vals[:trim],
+                    mask if mask is None or trim is None
+                    else mask[:trim],
+                )
+                for (name, _et), (vals, mask)
+                in zip(cp.exprs, ex_outs)
+            }
+
         if cp.mode == "mask":
-            count_dev, sel, col_outs = outs
+            if cp.exprs:
+                count_dev, sel, col_outs, ex_outs = outs
+            else:
+                count_dev, sel, col_outs = outs
+                ex_outs = None
             count = int(count_dev)
             built.request.observe(count)
             trace.count("engine.pushdown_rows_selected", count)
             cols = self._compute_columns(
                 cp.ship, col_outs, desc_by, spec_by, sg, trim=None
             )
-            return _compute.PushdownResult(cols, cp.n, count, mask=sel)
+            return _compute.PushdownResult(
+                cols, cp.n, count, mask=sel,
+                exprs=None if ex_outs is None
+                else expr_dict(ex_outs, None),
+            )
         count = int(outs[0])
         if count > cp.capacity:
             trace.count("engine.pushdown_overflows")
@@ -3178,7 +3214,10 @@ class TpuRowGroupReader:
         cols = self._compute_columns(
             cp.ship, outs[1], desc_by, spec_by, sg, trim=count
         )
-        return _compute.PushdownResult(cols, cp.n, count)
+        return _compute.PushdownResult(
+            cols, cp.n, count,
+            exprs=expr_dict(outs[2], count) if cp.exprs else None,
+        )
 
     def _compute_columns(self, ship, col_outs, desc_by, spec_by, sg,
                          trim):

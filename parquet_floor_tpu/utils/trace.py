@@ -203,6 +203,19 @@ class names:
         "trace.flight_traces_dropped",
         "serve.flight_dumps",
         "serve.metrics_peer_unreachable",
+        # the query subsystem (query/, docs/query.md): computed
+        # expression rows on the scan face, sorted-merge join pages and
+        # rows, serving-side expression probes, and the secondary-index
+        # rung of the point-probe ladder
+        "query.expr_rows",
+        "query.join_pages",
+        "query.join_rows",
+        "serve.select_probes",
+        "serve.select_rows",
+        "serve.index_hits",
+        "serve.index_skips",
+        # sidecar keys emitted per index at compaction time
+        "compact.index_keys",
     })
     GAUGES = frozenset({
         "scan.inflight_bytes_max",
@@ -265,6 +278,9 @@ class names:
         # flight-recorder incident dumps: one event per bundle written
         # (trigger reason + bundle path)
         "serve.flight",
+        # secondary-index lifecycle on the serving face (query/index.py,
+        # serve/lookup.py): install events with key/file counts
+        "serve.index",
     })
     SPANS = frozenset({
         "read",
@@ -293,6 +309,9 @@ class names:
         "serve.fleet_peer_fetch",
         "serve.fleet_serve",
         "serve.fleet_origin_read",
+        # the query subsystem (query/join.py, serve/lookup.py)
+        "query.join",
+        "serve.select",
     })
     # latency/size distributions (Tracer.observe -> LogHistogram;
     # docs/observability.md).  Values are SECONDS unless the name says
@@ -322,6 +341,9 @@ class names:
         # the training loader and the write path
         "data.next_batch_seconds",       # one loader next() wall
         "write.emit_seconds",            # one group's ordered sink emission
+        # the query subsystem (docs/query.md)
+        "query.join_seconds",            # one join next_page() wall
+        "serve.select_seconds",          # one select() expression scan wall
     })
     ALL = COUNTERS | GAUGES | DECISIONS | SPANS | HISTOGRAMS
 

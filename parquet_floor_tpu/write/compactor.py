@@ -67,6 +67,10 @@ class CompactOptions:
     columns: Optional[Sequence[str]] = None  # top-level projection
     sort_by: Optional[Sequence[str]] = None  # within-group row sort
     unit_order: Optional[Sequence] = None    # explicit (file, group) order
+    # secondary-index sidecars (query/index.py): one key → row-span
+    # index emitted per named column, fingerprinted against the output
+    # files — the point-probe rung for NON-sort columns
+    index_columns: Optional[Sequence[str]] = None
     salvage: bool = False
     reader: Optional[object] = None          # ReaderOptions overrides
     scan: Optional[ScanOptions] = None
@@ -112,6 +116,7 @@ class CompactReport:
     units_dropped: int = 0
     groups_out: int = 0
     group_rows: List[int] = field(default_factory=list)
+    index_paths: List[str] = field(default_factory=list)
     wall_seconds: float = 0.0
     salvage: Optional[SalvageReport] = None
 
@@ -129,6 +134,7 @@ class CompactReport:
             "units_dropped": self.units_dropped,
             "groups_out": self.groups_out,
             "group_rows": list(self.group_rows),
+            "index_paths": list(self.index_paths),
             "wall_seconds": round(self.wall_seconds, 6),
             "rows_per_sec": round(self.rows_per_sec, 1),
         }
@@ -292,6 +298,74 @@ def _sort_group(columns: List[ColumnData], sort_by: Sequence[str]):
     return _apply_order(columns, order)
 
 
+def _index_runs(columns: List[ColumnData], names: Sequence[str]) -> dict:
+    """Equal-key row runs of one OUTPUT row group, per indexed column:
+    ``{name: [(api_key, row_start, row_end), ...]}`` in row order,
+    null rows skipped (nulls are not keys).  Keys are API-typed the
+    way a probe supplies them (BINARY stringified via the descriptor,
+    exactly like the lookup face's cell conversion), so index probes
+    and predicate probes agree on key identity."""
+    from ..format.parquet_thrift import Type as _T
+
+    by_name = {cd.descriptor.path[0]: cd for cd in columns}
+    out: dict = {}
+    for name in names:
+        cd = by_name[name]
+        desc = cd.descriptor
+        md = desc.max_definition_level
+        n = int(cd.num_values)
+        if cd.def_levels is not None:
+            null = cd.def_levels != md
+            vidx = np.cumsum(~null) - 1
+        else:
+            null = np.zeros(
+                checked_alloc_size(n, "index runs"), dtype=bool
+            )
+            vidx = np.arange(n)
+        stringify = desc.physical_type in (
+            _T.BYTE_ARRAY, _T.FIXED_LEN_BYTE_ARRAY, _T.INT96
+        )
+        if isinstance(cd.values, ByteArrayColumn):
+            data, off = cd.values.data.tobytes(), cd.values.offsets
+            dense = np.empty(
+                checked_alloc_size(n, "index runs"), dtype=object
+            )
+            for i in np.flatnonzero(~null):
+                j = int(vidx[i])
+                dense[i] = data[off[j]:off[j + 1]]
+            for i in np.flatnonzero(null):
+                dense[i] = b""
+
+            def conv(v, desc=desc):
+                return desc.primitive.stringify(v)
+        else:
+            vals = np.asarray(cd.values)
+            dense = np.zeros(
+                checked_alloc_size(n, "index runs"), dtype=vals.dtype
+            )
+            dense[~null] = vals[vidx[~null]]
+
+            def conv(v, stringify=stringify, desc=desc):
+                if stringify:
+                    v = v.tobytes() if isinstance(v, np.ndarray) else v
+                    return desc.primitive.stringify(v)
+                return v.item() if hasattr(v, "item") else v
+        if n == 0:
+            out[name] = []
+            continue
+        change = np.flatnonzero(
+            (dense[1:] != dense[:-1]) | (null[1:] != null[:-1])
+        ) + 1
+        bounds = [0, *change.tolist(), n]
+        runs = []
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            if null[a]:
+                continue
+            runs.append((conv(dense[a]), int(a), int(b)))
+        out[name] = runs
+    return out
+
+
 def _apply_order(columns: List[ColumnData], order: np.ndarray):
     from ..batch.columns import take_rows
 
@@ -392,6 +466,22 @@ class DatasetCompactor:
                     "DatasetCompactor re-shards flat columns only "
                     f"(repeated column {'.'.join(desc.path)})"
                 )
+        idx_names = list(opt.index_columns or [])
+        if idx_names and opt.salvage:
+            # a quarantined chunk of the indexed column has no values —
+            # an index built over it would silently prove rows absent
+            raise UnsupportedFeatureError(
+                "index_columns does not compose with salvage: a "
+                "quarantined chunk of an indexed column has no keys to "
+                "record — compact without salvage, or drop index_columns"
+            )
+        out_names = {d.path[0] for d in out_schema.columns}
+        for name in idx_names:
+            if name not in out_names:
+                raise ValueError(
+                    f"index_columns names {name!r}, which is not in the "
+                    "output schema"
+                )
         leg = self._resolve_leg(opt, out_schema)
         scanner = None
         if leg == "host":
@@ -437,6 +527,10 @@ class DatasetCompactor:
 
         work_q: _queue.Queue = _queue.Queue(maxsize=4)
         werr: list = []  # writer-thread error, raised after join
+        # (file_ordinal, group_in_file, {col: [(key, r0, r1), ...]}) per
+        # written group — writer-thread-only until join, then the
+        # sidecar build reads it
+        index_acc: list = []
         tracer = trace.current()
 
         def writer_loop():
@@ -447,6 +541,7 @@ class DatasetCompactor:
             writer = None
             file_idx = 0
             file_rows = 0
+            file_groups = 0
             while True:
                 item = work_q.get()
                 if item is None:
@@ -466,9 +561,18 @@ class DatasetCompactor:
                         writer = resolve_writer(path, out_schema, wopts)
                         file_idx += 1
                         file_rows = 0
+                        file_groups = 0
                     if opt.sort_by:
                         columns = _sort_group(columns, opt.sort_by)
+                    if idx_names:
+                        # runs are cut AFTER the sort: the sidecar's
+                        # spans must be the written rows' truth
+                        index_acc.append((
+                            file_idx - 1, file_groups,
+                            _index_runs(columns, idx_names),
+                        ))
                     writer.write_row_group(columns)
+                    file_groups += 1
                 except BaseException as e:  # noqa: BLE001 - raised after join
                     werr.append(e)
                     if writer is not None:
@@ -559,8 +663,39 @@ class DatasetCompactor:
         report.salvage = (
             scanner.salvage_report if scanner is not None else None
         )
+        if idx_names and report.paths:
+            self._emit_indexes(report, idx_names, index_acc)
         report.wall_seconds = time.perf_counter() - t0
         return report
+
+    def _emit_indexes(self, report: CompactReport, idx_names,
+                      index_acc) -> None:
+        """Build + save one ``SecondaryIndex`` sidecar per indexed
+        column (``<column>.index.json`` beside the output files),
+        fingerprinting the just-written parts — the install-time
+        soundness gate ``serve.Dataset.install_index`` checks."""
+        from ..quarantine import fingerprint as file_fingerprint
+        from ..query.index import SecondaryIndex
+
+        fps = []
+        for path in report.paths:
+            src = FileSource(path)
+            try:
+                fps.append(file_fingerprint(src))
+            finally:
+                src.close()
+        for name in idx_names:
+            idx = SecondaryIndex(name)
+            for path, fp in zip(report.paths, fps):
+                idx.add_file(os.path.basename(path), fp)
+            for fi, gi, runs in index_acc:
+                for key, r0, r1 in runs.get(name, []):
+                    idx.add_span(key, fi, gi, r0, r1)
+            side = os.path.join(
+                os.path.dirname(report.paths[0]), f"{name}.index.json"
+            )
+            report.index_paths.append(idx.save(side))
+            trace.count("compact.index_keys", len(idx))
 
     def _resolve_leg(self, opt: CompactOptions, out_schema) -> str:
         if opt.read_leg != "auto" and any(

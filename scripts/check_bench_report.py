@@ -152,6 +152,7 @@ def check_report(bench_log: pathlib.Path) -> int:
         or check_pushdown_leg(result.get("detail", {}))
         or check_write_leg(result.get("detail", {}))
         or check_compact_leg(result.get("detail", {}))
+        or check_query_leg(result.get("detail", {}))
     )
 
 
@@ -233,6 +234,64 @@ def check_compact_leg(detail: dict) -> int:
         "check_bench_report: compact leg ok "
         f"({detail['compact_rows_per_sec']} rows/s, "
         f"{detail['compact_vs_scan_x']}x scan, groups {sizes})"
+    )
+    return 0
+
+
+def check_query_leg(detail: dict) -> int:
+    """The query subsystem (docs/query.md): the sorted-merge join must
+    hold >= 0.5x the two-scan lower bound over the same corpora, an
+    indexed point probe on a NON-sort column must cost at most one
+    data page of cold storage bytes (and an absent key exactly zero),
+    and the fused expression projection must be BIT-equal to
+    pyarrow.compute at <= 1 launch per row group."""
+    for key in ("query_join_vs_twoscan_x", "query_join_out_rows",
+                "query_join_pages", "query_index_probe_bytes",
+                "query_index_absent_bytes", "query_index_page_bound",
+                "query_index_hits", "query_expr_exact",
+                "query_expr_groups", "query_expr_launches"):
+        if key not in detail:
+            return fail(f"query leg missing {key}")
+    if detail["query_join_vs_twoscan_x"] < 0.5:
+        return fail(
+            f"join speed floor broken: query_join_vs_twoscan_x="
+            f"{detail['query_join_vs_twoscan_x']} < 0.5"
+        )
+    if detail["query_join_out_rows"] < 1:
+        return fail("join produced no rows")
+    if detail["query_join_pages"] < 1:
+        return fail("join counted no pages (query.join_pages)")
+    if detail["query_index_hits"] < 1:
+        return fail("indexed probe never hit the index rung")
+    bound = detail["query_index_page_bound"]
+    cost = detail["query_index_probe_bytes"]
+    if not 0 < cost <= bound:
+        return fail(
+            f"indexed probe cost {cost} outside (0, one data page "
+            f"{bound}]"
+        )
+    if detail["query_index_absent_bytes"] != 0:
+        return fail(
+            f"absent-key probe read {detail['query_index_absent_bytes']}"
+            " bytes — the index must prove absence for free"
+        )
+    if not detail["query_expr_exact"]:
+        return fail("expression projection is not bit-equal to "
+                    "pyarrow.compute")
+    groups = detail["query_expr_groups"]
+    if groups < 1:
+        return fail("expression scan decoded no groups")
+    if detail["query_expr_launches"] > groups:
+        return fail(
+            f"expression launch shape broken: "
+            f"{detail['query_expr_launches']} launches for {groups} "
+            f"groups (want <= 1/group)"
+        )
+    print(
+        "check_bench_report: query leg ok "
+        f"({detail['query_join_vs_twoscan_x']}x two-scan, probe "
+        f"{cost}B <= {bound}B, {detail['query_expr_launches']} "
+        f"launches/{groups} groups)"
     )
     return 0
 

@@ -1,0 +1,23 @@
+"""Seeded-bad: the query-subsystem leak shapes (docs/query.md) — a
+JoinCursor (pins open readers of BOTH corpora's files mid-scan) bound
+with no exception path releasing it, and one abandoned entirely after a
+partial page drain."""
+
+from parquet_floor_tpu.query.join import JoinCursor
+
+
+def drain_join(left, right):
+    cur = JoinCursor(left, right, on=["k"])
+    rows = []
+    while True:
+        page = cur.next_page()  # a raise here leaks both corpora's fds
+        if not page:
+            break
+        rows.extend(page)
+    cur.close()
+    return rows
+
+
+def first_page(left, right):
+    cur = JoinCursor(left, right, on=["k"], page_rows=64)
+    return cur.next_page()  # never closed: iterators pin readers forever

@@ -1,0 +1,24 @@
+"""Seeded-good: the query-subsystem shapes released correctly — a
+with-managed JoinCursor, and an explicit try/finally close around a
+partial drain (the release shapes FL-RES001 must recognize)."""
+
+from parquet_floor_tpu.query.join import JoinCursor
+
+
+def drain_join(left, right):
+    with JoinCursor(left, right, on=["k"]) as cur:
+        rows = []
+        while True:
+            page = cur.next_page()
+            if not page:
+                break
+            rows.extend(page)
+        return rows
+
+
+def first_page(left, right):
+    cur = JoinCursor(left, right, on=["k"], page_rows=64)
+    try:
+        return cur.next_page()
+    finally:
+        cur.close()

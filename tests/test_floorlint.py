@@ -50,6 +50,8 @@ CASES = [
     #                               container-of-acquisitions shape
     #                               (good pins iterate-release in
     #                               finally)
+    ("res001_query", "FL-RES001"),  # query subsystem: a JoinCursor pins
+    #                               both corpora's readers until close()
     ("alloc001", "FL-ALLOC001"),
     ("obs001", "FL-OBS001"),
     ("lock001", "FL-LOCK001"),

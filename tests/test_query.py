@@ -1,0 +1,741 @@
+"""The query subsystem (parquet_floor_tpu/query/, docs/query.md):
+projection expressions (device/host bit-equality, the pyarrow.compute
+differential, salvage/string refusals and the host fallback), the
+sorted-merge join (oracle parity, resume at every page boundary,
+fingerprint-stamped tokens, sortedness refusals), and persistent
+secondary indexes (brute-force differential, staleness refusal,
+negative-cache invalidation), on both the library and daemon faces."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.compute as pc  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+from parquet_floor_tpu import (  # noqa: E402
+    ParquetFileWriter,
+    ParquetReader,
+    ReaderOptions,
+    WriterOptions,
+    types,
+)
+from parquet_floor_tpu.api.hydrate import (  # noqa: E402
+    HydratorSupplier,
+    dict_hydrator,
+)
+from parquet_floor_tpu.errors import UnsupportedFeatureError  # noqa: E402
+from parquet_floor_tpu.query import (  # noqa: E402
+    JoinCursor,
+    SecondaryIndex,
+    qcol,
+    sorted_merge_join,
+)
+from parquet_floor_tpu.scan import ScanOptions  # noqa: E402
+from parquet_floor_tpu.serve import (  # noqa: E402
+    DaemonClient,
+    Dataset,
+    ServeDaemon,
+    Serving,
+)
+from parquet_floor_tpu.utils import trace  # noqa: E402
+from parquet_floor_tpu.write import (  # noqa: E402
+    CompactOptions,
+    DatasetCompactor,
+)
+
+N_L = 600
+N_R = 450
+
+
+@pytest.fixture(autouse=True)
+def _no_cache(monkeypatch):
+    from parquet_floor_tpu.tpu import exec_cache
+
+    monkeypatch.delenv("PFTPU_EXEC_CACHE", raising=False)
+    exec_cache.activate(None)
+    yield
+    exec_cache.activate(None)
+
+
+def _read_rows(paths):
+    out = []
+    for p in paths:
+        r = ParquetReader(p, HydratorSupplier.constantly(dict_hydrator()))
+        out.extend(dict(x) for x in r)
+        r.close()
+    return out
+
+
+@pytest.fixture(scope="module")
+def corpora(tmp_path_factory):
+    """Two sort-compacted corpora (globally sorted int64 ``k`` with
+    duplicates both sides, overlapping ranges) + a secondary index on
+    the scattered ``tag`` column of the left corpus."""
+    tmp = tmp_path_factory.mktemp("query")
+    t = types
+    lschema = t.message(
+        "l", t.required(t.INT64).named("k"),
+        t.required(t.DOUBLE).named("lv"),
+        t.optional(t.INT64).named("tag"),
+        t.required(t.BYTE_ARRAY).as_(t.string()).named("name"),
+    )
+    rschema = t.message(
+        "r", t.required(t.INT64).named("k"),
+        t.required(t.DOUBLE).named("rv"),
+        t.optional(t.INT64).named("tag"),
+    )
+    rng = np.random.default_rng(42)
+    lk = np.sort(rng.integers(0, N_L // 3, N_L))
+    rk = np.sort(rng.integers(N_L // 6, N_L // 2, N_R))
+    lsrc, rsrc = str(tmp / "lsrc.parquet"), str(tmp / "rsrc.parquet")
+    with ParquetFileWriter(
+        lsrc, lschema, WriterOptions(row_group_rows=97)
+    ) as w:
+        w.write_columns({
+            "k": lk, "lv": rng.random(N_L),
+            "tag": [None if i % 11 == 0 else int(i % 37)
+                    for i in range(N_L)],
+            "name": [f"n{i % 23}" for i in range(N_L)],
+        })
+    with ParquetFileWriter(
+        rsrc, rschema, WriterOptions(row_group_rows=83)
+    ) as w:
+        w.write_columns({
+            "k": rk, "rv": rng.random(N_R),
+            "tag": [int(i % 29) for i in range(N_R)],
+        })
+    lout, rout = str(tmp / "lout"), str(tmp / "rout")
+    lrep = DatasetCompactor([lsrc], lout, CompactOptions(
+        sort_by=["k"], target_row_group_rows=64,
+        target_file_rows=256, index_columns=["tag", "name"],
+    )).run()
+    rrep = DatasetCompactor([rsrc], rout, CompactOptions(
+        sort_by=["k"], target_row_group_rows=64, target_file_rows=256,
+    )).run()
+    return {
+        "lsrc": lsrc, "rsrc": rsrc,
+        "lpaths": list(lrep.paths), "rpaths": list(rrep.paths),
+        "index_paths": list(lrep.index_paths),
+        "lrows": _read_rows(lrep.paths), "rrows": _read_rows(rrep.paths),
+    }
+
+
+def _join_oracle(lrows, rrows, on, how, lcols=None, rcols=None):
+    """Brute-force nested-loop join with the documented semantics:
+    null keys never match, left-order output, right runs in corpus
+    order, collision renaming, unmatched-left nulls."""
+    out = []
+    keyless = set(on)
+    for lrow in lrows:
+        lkey = tuple(lrow[c] for c in on)
+        matched = False
+        for rrow in rrows:
+            if any(v is None for v in lkey):
+                break
+            if tuple(rrow[c] for c in on) != lkey:
+                continue
+            matched = True
+            row = {k: v for k, v in lrow.items()
+                   if lcols is None or k in lcols}
+            for k, v in rrow.items():
+                if k in keyless:
+                    continue
+                if rcols is not None and k not in rcols:
+                    continue
+                row[f"right.{k}" if k in row else k] = v
+            out.append(row)
+        if not matched and how == "left":
+            row = {k: v for k, v in lrow.items()
+                   if lcols is None or k in lcols}
+            for k in rrows[0].keys():
+                if k in keyless:
+                    continue
+                if rcols is not None and k not in rcols:
+                    continue
+                row[f"right.{k}" if k in row else k] = None
+            out.append(row)
+    return out
+
+
+# -- expressions ----------------------------------------------------------
+
+
+def _expr_corpus(tmp_path, with_nulls=True):
+    t = types
+    schema = t.message(
+        "e", t.required(t.INT64).named("a"),
+        t.optional(t.INT32).named("b"),
+        t.required(t.DOUBLE).named("x"),
+    )
+    rng = np.random.default_rng(7)
+    n = 300
+    p = str(tmp_path / "expr.parquet")
+    x = rng.random(n) * 100 - 50
+    x[5] = np.nan                      # NaN flows through arithmetic
+    x[6] = np.inf
+    a = rng.integers(-(2 ** 62), 2 ** 62, n)   # overflow territory
+    b = [None if with_nulls and i % 7 == 0 else int(i % 1000 - 500)
+         for i in range(n)]
+    with ParquetFileWriter(
+        p, schema, WriterOptions(row_group_rows=64)
+    ) as w:
+        w.write_columns({"a": a, "b": b, "x": x})
+    return p, a, b, x
+
+
+def _scan_expr(paths, exprs, engine):
+    got = {}
+    masks = {}
+    names = {en for en, _ in exprs}
+    for cols in ParquetReader.stream_batches(
+        paths, engine=engine,
+        scan_options=ScanOptions(project_exprs=tuple(exprs)),
+    ):
+        for c in cols:
+            nm = c.descriptor.path[0]
+            if nm in names:
+                got.setdefault(nm, []).append(np.asarray(c.values))
+                masks.setdefault(nm, []).append(
+                    None if c.mask is None else np.asarray(c.mask)
+                )
+    vals = {nm: np.concatenate(vs) for nm, vs in got.items()}
+    mk = {}
+    for nm, ms in masks.items():
+        if all(m is None for m in ms):
+            mk[nm] = None
+        else:
+            mk[nm] = np.concatenate([
+                m if m is not None else np.zeros(len(v), bool)
+                for m, v in zip(ms, got[nm])
+            ])
+    return vals, mk
+
+
+def test_expr_device_host_bit_equal(tmp_path):
+    """The device leg's computed columns are BIT-equal to the host twin
+    — values and null masks — for int arithmetic, casts, float64
+    division, comparisons, and null propagation."""
+    p, _a, _b, _x = _expr_corpus(tmp_path)
+    exprs = [
+        ("s", qcol("a") + qcol("b")),              # int + nullable int
+        ("r", qcol("b").cast("float64") / 3.0),    # f64 true division
+        ("c", (qcol("b") > 0) & ~qcol("b").is_null()),
+        ("m", qcol("a") * 2 - 1),
+    ]
+    hv, hm = _scan_expr([p], exprs, "host")
+    dv, dm = _scan_expr([p], exprs, "tpu")
+    for nm in ("s", "r", "c", "m"):
+        assert hv[nm].dtype == dv[nm].dtype, nm
+        assert np.array_equal(hv[nm], dv[nm]), nm
+        if hm[nm] is None:
+            assert dm[nm] is None or not dm[nm].any(), nm
+        else:
+            assert dm[nm] is not None and \
+                np.array_equal(hm[nm], dm[nm]), nm
+
+
+def test_expr_differential_vs_pyarrow(tmp_path):
+    """Null / NaN / overflow semantics pinned to ``pyarrow.compute``:
+    nulls propagate, NaN flows IEEE-style, int64 arithmetic wraps the
+    same lanes pyarrow computes (checked on the non-null lanes), and
+    ``/`` is always float64 true division."""
+    p, a, b, x = _expr_corpus(tmp_path)
+    pb = pa.array(b, type=pa.int64())
+    hv, hm = _scan_expr(
+        [p],
+        [("d", qcol("x") / qcol("b")),
+         ("t", qcol("x") * 2.0 + 1.0),
+         ("g", qcol("b") >= 0)],
+        "host",
+    )
+    want_d = pc.divide(
+        pa.array(x, type=pa.float64()), pc.cast(pb, pa.float64())
+    )
+    lanes = ~np.asarray(pc.is_null(want_d).to_numpy(
+        zero_copy_only=False))
+    got_lanes = ~hm["d"] if hm["d"] is not None else np.ones(len(x), bool)
+    assert np.array_equal(lanes, got_lanes)
+    wd = want_d.to_numpy(zero_copy_only=False)
+    assert np.array_equal(
+        hv["d"][lanes], wd[lanes].astype(np.float64), equal_nan=True
+    )
+    want_t = pc.add(pc.multiply(
+        pa.array(x, type=pa.float64()), 2.0), 1.0
+    ).to_numpy()
+    assert hm["t"] is None or not hm["t"].any()
+    assert np.array_equal(hv["t"], want_t, equal_nan=True)
+    want_g = pc.greater_equal(pb, 0)
+    g_lanes = ~np.asarray(pc.is_null(want_g).to_numpy(
+        zero_copy_only=False))
+    got_g_lanes = ~hm["g"] if hm["g"] is not None else np.ones(
+        len(x), bool)
+    assert np.array_equal(g_lanes, got_g_lanes)
+    assert np.array_equal(
+        hv["g"][g_lanes],
+        want_g.to_numpy(zero_copy_only=False)[g_lanes].astype(bool),
+    )
+
+
+def test_expr_salvage_refused(tmp_path):
+    p, *_ = _expr_corpus(tmp_path)
+    with pytest.raises(UnsupportedFeatureError, match="salvage"):
+        for _ in ParquetReader.stream_batches(
+            [p], engine="host",
+            options=ReaderOptions(salvage=True),
+            scan_options=ScanOptions(
+                project_exprs=(("y", qcol("a") + 1),)),
+        ):
+            pass
+
+
+def test_expr_double_bits_host_fallback(corpora):
+    """An expression over a plain DOUBLE input under the default
+    ``float64_policy='bits'`` refuses the device leg at plan time (a
+    lossy bit-form input would change the numbers) and the WHOLE scan
+    falls back to the host leg — full exact results, with the
+    ``engine.pushdown host_fallback`` decision recorded."""
+    exprs = [("y", qcol("lv") * 2.0)]
+    with trace.scope() as t:
+        dv, dm = _scan_expr(corpora["lpaths"], exprs, "tpu")
+    acts = [d for d in t.decisions()
+            if d.get("decision") == "engine.pushdown"
+            and d.get("action") == "host_fallback"]
+    assert acts, "device refusal did not record the fallback decision"
+    want = np.array([r["lv"] * 2.0 for r in corpora["lrows"]])
+    assert np.array_equal(dv["y"], want)
+    assert dm["y"] is None or not dm["y"].any()
+
+
+def test_expr_exec_cache_signature(tmp_path):
+    """Two different expressions over the same corpus produce different
+    computed columns on the device leg — the expression signature is in
+    the executable-cache key, so a changed expression can never be
+    served a stale program."""
+    p, a, _b, _x = _expr_corpus(tmp_path, with_nulls=False)
+    v1, _ = _scan_expr([p], [("y", qcol("a") + 1)], "tpu")
+    v2, _ = _scan_expr([p], [("y", qcol("a") + 2)], "tpu")
+    assert np.array_equal(v2["y"] - v1["y"], np.ones(len(a), np.int64))
+
+
+# -- sorted-merge join ----------------------------------------------------
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_join_vs_oracle(corpora, how):
+    with Dataset(corpora["lpaths"], key_column="k") as L, \
+            Dataset(corpora["rpaths"], key_column="k") as R:
+        got = list(sorted_merge_join(L, R, on=["k"], how=how))
+    want = _join_oracle(corpora["lrows"], corpora["rrows"], ["k"], how)
+    assert len(got) == len(want)
+    assert got == want
+    if how == "inner":
+        assert any("right.tag" in r for r in got)   # collision renamed
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_join_multi_key_null_keys_never_match(tmp_path, how):
+    """Multi-key join over corpora compacted with a two-column
+    ``sort_by`` prefix; a null in ANY key component never matches (SQL
+    semantics) — left rows with null ``tag`` only survive as
+    null-filled rows under ``how='left'``."""
+    t = types
+    schema = t.message(
+        "m", t.required(t.INT64).named("k"),
+        t.optional(t.INT64).named("tag"),
+        t.required(t.INT64).named("v"),
+    )
+    # input pre-sorted by (k, tag-nulls-last): the compactor's stable
+    # per-group sort preserves the global order, so runs crossing group
+    # boundaries stay merge-legal
+    ltags = [0, 1, 1, 2, None, None]
+    rtags = [1, 2, 2, None]
+    lsrc, rsrc = str(tmp_path / "l.parquet"), str(tmp_path / "r.parquet")
+    with ParquetFileWriter(lsrc, schema, WriterOptions(
+            row_group_rows=30)) as w:
+        w.write_columns({
+            "k": np.repeat(np.arange(20), 6),
+            "tag": ltags * 20,
+            "v": np.arange(120),
+        })
+    with ParquetFileWriter(rsrc, schema, WriterOptions(
+            row_group_rows=30)) as w:
+        w.write_columns({
+            "k": np.repeat(np.arange(5, 25), 4),
+            "tag": rtags * 20,
+            "v": np.arange(80) + 1000,
+        })
+    lrep = DatasetCompactor([lsrc], str(tmp_path / "lo"), CompactOptions(
+        sort_by=["k", "tag"], target_row_group_rows=16,
+    )).run()
+    rrep = DatasetCompactor([rsrc], str(tmp_path / "ro"), CompactOptions(
+        sort_by=["k", "tag"], target_row_group_rows=16,
+    )).run()
+    lrows, rrows = _read_rows(lrep.paths), _read_rows(rrep.paths)
+    with Dataset(lrep.paths, key_column="k") as L, \
+            Dataset(rrep.paths, key_column="k") as R:
+        got = list(sorted_merge_join(L, R, on=["k", "tag"], how=how))
+    want = _join_oracle(lrows, rrows, ["k", "tag"], how)
+    assert got == want
+    if how == "inner":
+        # (k, 1) matches twice per key in 5..19, (k, 2) twice
+        assert all(r["tag"] is not None for r in got)
+    else:
+        nulls = [r for r in got if r["tag"] is None]
+        assert nulls and all(r["v"] < 1000 and r["right.v"] is None
+                             for r in nulls)
+
+
+def test_join_projection(corpora):
+    """Column projections narrow both sides to exactly the named
+    columns (keys still drive the merge but only appear when asked
+    for); key columns are never duplicated from the right side."""
+    with Dataset(corpora["lpaths"], key_column="k") as L, \
+            Dataset(corpora["rpaths"], key_column="k") as R:
+        got = list(sorted_merge_join(
+            L, R, on=["k"], left_columns=["lv"], right_columns=["rv"],
+        ))
+        keyed = list(sorted_merge_join(
+            L, R, on=["k"], left_columns=["k", "lv"],
+            right_columns=["rv"],
+        ))
+    want = _join_oracle(
+        corpora["lrows"], corpora["rrows"], ["k"], "inner",
+        lcols={"lv"}, rcols={"rv"},
+    )
+    assert got == want
+    assert set(got[0].keys()) == {"lv", "rv"}
+    assert keyed == _join_oracle(
+        corpora["lrows"], corpora["rrows"], ["k"], "inner",
+        lcols={"k", "lv"}, rcols={"rv"},
+    )
+    assert set(keyed[0].keys()) == {"k", "lv", "rv"}
+
+
+def test_join_resume_every_page_boundary(corpora):
+    """Exactly-once delivery resuming from EVERY page boundary,
+    including boundaries inside an equal-key run (the ``ri`` skip)."""
+    with Dataset(corpora["lpaths"], key_column="k") as L, \
+            Dataset(corpora["rpaths"], key_column="k") as R:
+        with JoinCursor(L, R, on=["k"], page_rows=13) as cur:
+            full, toks, offs = [], [cur.token], [0]
+            while True:
+                page = cur.next_page()
+                if not page:
+                    break
+                full.extend(page)
+                offs.append(offs[-1] + len(page))
+                toks.append(cur.token)
+        assert toks[-1] is None        # exhausted
+        for bi, tok in enumerate(toks[:-1]):
+            tok = json.loads(json.dumps(tok))   # wire round-trip
+            rest = []
+            with JoinCursor(L, R, on=["k"], page_rows=50,
+                            cursor=tok) as cur:
+                while True:
+                    page = cur.next_page()
+                    if not page:
+                        break
+                    rest.extend(page)
+            assert rest == full[offs[bi]:], f"boundary {bi}"
+
+
+def test_join_token_fingerprint_rejection(corpora):
+    with Dataset(corpora["lpaths"], key_column="k") as L, \
+            Dataset(corpora["rpaths"], key_column="k") as R:
+        with JoinCursor(L, R, on=["k"], page_rows=20) as cur:
+            cur.next_page()
+            tok = cur.token
+        # different join kind
+        with pytest.raises(ValueError, match="different"):
+            JoinCursor(  # floorlint: disable=FL-RES001 — ctor raises
+                L, R, on=["k"], how="left", cursor=tok)
+        # different projection
+        with pytest.raises(ValueError, match="different"):
+            JoinCursor(  # floorlint: disable=FL-RES001 — ctor raises
+                L, R, on=["k"], left_columns=["lv"], cursor=tok)
+        # different dataset pair (right joined to itself)
+        with Dataset(corpora["rpaths"], key_column="k") as L2:
+            with pytest.raises(ValueError, match="different"):
+                JoinCursor(  # floorlint: disable=FL-RES001 — ctor raises
+                    L2, R, on=["k"], cursor=tok)
+        # malformed
+        with pytest.raises(ValueError, match="token"):
+            JoinCursor(  # floorlint: disable=FL-RES001 — ctor raises
+                L, R, on=["k"], cursor={"bogus": 1})
+
+
+def test_join_refuses_unsorted_and_bad_args(corpora):
+    # U: the raw pre-compaction file — no recorded sorting_columns
+    with Dataset([corpora["lsrc"]], key_column="k") as U, \
+            Dataset(corpora["rpaths"], key_column="k") as R:
+        with pytest.raises(UnsupportedFeatureError, match="sort"):
+            JoinCursor(  # floorlint: disable=FL-RES001 — ctor raises
+                U, R, on=["k"])
+        with pytest.raises(ValueError, match="how"):
+            JoinCursor(  # floorlint: disable=FL-RES001 — ctor raises
+                U, R, on=["k"], how="outer")
+        with pytest.raises(ValueError, match="on"):
+            JoinCursor(  # floorlint: disable=FL-RES001 — ctor raises
+                U, R, on=[])
+        with pytest.raises(ValueError, match="page_rows"):
+            JoinCursor(  # floorlint: disable=FL-RES001 — ctor raises
+                U, R, on=["k"], page_rows=0)
+
+
+def test_join_dataset_salvage_refused(corpora):
+    """The serving Dataset (the join's corpus face) refuses salvage
+    typed — so a salvage-read corpus can never reach the merge."""
+    with pytest.raises(UnsupportedFeatureError, match="salvage"):
+        Dataset(  # floorlint: disable=FL-RES001 — ctor raises
+            corpora["lpaths"], key_column="k",
+            options=ReaderOptions(salvage=True))
+
+
+# -- secondary indexes ----------------------------------------------------
+
+
+def test_index_vs_brute_force(corpora):
+    idx = SecondaryIndex.open(corpora["index_paths"][0])
+    assert idx.column == "tag"
+    with Dataset(corpora["lpaths"], key_column="tag") as ds:
+        ds.install_index(idx)
+        for key in (0, 3, 17, 36, 999):
+            want = [r for r in corpora["lrows"] if r["tag"] == key]
+            with trace.scope() as t:
+                got = ds.lookup(key)
+            assert got == want, key
+            c = t.counters()
+            if not want:
+                assert c.get("serve.index_hits", 0) == 0
+                assert c.get("serve.index_skips", 0) == \
+                    len(corpora["lpaths"])
+
+
+def test_index_string_column(corpora):
+    idx = SecondaryIndex.open(corpora["index_paths"][1])
+    assert idx.column == "name"
+    with Dataset(corpora["lpaths"], key_column="name") as ds:
+        ds.install_index(idx)
+        want = [r for r in corpora["lrows"] if r["name"] == "n7"]
+        assert ds.lookup("n7") == want
+
+
+def test_index_install_refusals(corpora, tmp_path):
+    idx = SecondaryIndex.open(corpora["index_paths"][0])
+    # wrong column
+    with Dataset(corpora["lpaths"], key_column="k") as ds:
+        with pytest.raises(ValueError, match="key_column"):
+            ds.install_index(idx)
+    # wrong file count
+    with Dataset(corpora["lpaths"][:1], key_column="tag") as ds:
+        with pytest.raises(ValueError, match="files"):
+            ds.install_index(idx)
+    # stale: same file names, different bytes (a recompacted corpus)
+    alt = str(tmp_path / "alt")
+    DatasetCompactor([corpora["lsrc"]], alt, CompactOptions(
+        sort_by=["k"], target_row_group_rows=96, target_file_rows=256,
+    )).run()
+    altp = sorted(glob.glob(os.path.join(alt, "*.parquet")))
+    if len(altp) == len(idx.files):
+        with Dataset(altp, key_column="tag") as ds:
+            with pytest.raises(ValueError, match="rebuild"):
+                ds.install_index(idx)
+
+
+def test_index_sidecar_corruption_loud(tmp_path, corpora):
+    src = corpora["index_paths"][0]
+    bad = str(tmp_path / "bad.index.json")
+    with open(src, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    data["version"] = 99
+    with open(bad, "w", encoding="utf-8") as fh:
+        json.dump(data, fh)
+    with pytest.raises(ValueError, match="version"):
+        SecondaryIndex.open(bad)
+    with open(bad, "w", encoding="utf-8") as fh:
+        fh.write("{not json")
+    with pytest.raises(ValueError, match="parse"):
+        SecondaryIndex.open(bad)
+
+
+def test_index_salvage_refused(corpora, tmp_path):
+    with pytest.raises(UnsupportedFeatureError, match="salvage"):
+        DatasetCompactor(
+            [corpora["lsrc"]], str(tmp_path / "o"),
+            CompactOptions(salvage=True, index_columns=["tag"]),
+        ).run()
+
+
+def test_install_index_invalidates_negative_cache(corpora):
+    """A key the bloom/stats ladder proved ABSENT before the index was
+    installed must be re-probed through the index afterwards — the
+    per-file negative caches flush on install."""
+    idx = SecondaryIndex.open(corpora["index_paths"][0])
+    with Dataset(corpora["lpaths"], key_column="tag") as ds:
+        key = 3
+        want = [r for r in corpora["lrows"] if r["tag"] == key]
+        assert want, "fixture key must exist"
+        assert ds.lookup(key) == want    # populates per-file neg entries
+        # poison the negative caches directly: without invalidation the
+        # installed index's answer would be masked for absent files and
+        # the ladder's neg short-circuit would skip real probes
+        for i in range(len(corpora["lpaths"])):
+            lf = ds._file(i)
+            lf.neg[key] = True
+        ds.install_index(idx)
+        for i in range(len(corpora["lpaths"])):
+            assert not ds._file(i).neg   # flushed on install
+        assert ds.lookup(key) == want
+
+
+# -- fp-stamped range cursor ----------------------------------------------
+
+
+def test_range_cursor_token_fingerprint(corpora):
+    with Dataset(corpora["lpaths"], key_column="k") as ds:
+        cur = ds.range_cursor(0, 100, page_rows=16)
+        cur.next_page()
+        tok = cur.token
+        assert "fp" in tok
+        # same window resumes fine (page_rows may differ)
+        rest = list(ds.range_cursor(0, 100, page_rows=64,
+                                    cursor=dict(tok)))
+        assert rest
+        # different window refuses
+        with pytest.raises(ValueError, match="refusing to resume"):
+            ds.range_cursor(0, 200, cursor=dict(tok))
+        # different projection refuses
+        with pytest.raises(ValueError, match="refusing to resume"):
+            ds.range_cursor(0, 100, columns=["k"], cursor=dict(tok))
+        # legacy fp-less token refuses
+        legacy = {k: v for k, v in tok.items() if k != "fp"}
+        with pytest.raises(ValueError, match="cursor token"):
+            ds.range_cursor(0, 100, cursor=legacy)
+    # different dataset refuses
+    with Dataset(corpora["rpaths"], key_column="k") as ds2:
+        with pytest.raises(ValueError, match="refusing to resume"):
+            ds2.range_cursor(0, 100, cursor=dict(tok))
+
+
+# -- the daemon faces -----------------------------------------------------
+
+
+def _daemon(corpora, **kw):
+    srv = Serving(prefetch_bytes=8 << 20, device_lanes=2)
+    cache = srv.cache
+    L = Dataset(corpora["lpaths"], "k", cache=cache)
+    R = Dataset(corpora["rpaths"], "k", cache=cache)
+    daemon = ServeDaemon(srv, {"left": L, "right": R}, **kw)
+    return srv, L, R, daemon
+
+
+def test_daemon_select(corpora):
+    srv, L, R, daemon = _daemon(corpora)
+    with srv, L, R, daemon:
+        with DaemonClient("127.0.0.1", daemon.port, "sel") as c:
+            rows = c.select(
+                "left", [("y", qcol("lv") * 2.0)], lo=0, hi=10,
+                columns=["k", "lv"],
+            )
+            want = [
+                {"k": r["k"], "lv": r["lv"], "y": r["lv"] * 2.0}
+                for r in corpora["lrows"] if 0 <= r["k"] <= 10
+            ]
+            assert rows == want
+            # malformed expression tree is a bad_request, not a hangup
+            r = c.request("select", dataset="left",
+                          exprs=[["y", ["frob", 1]]])
+            assert r["ok"] is False and r["code"] == "bad_request"
+            r = c.request("select", dataset="left", exprs=[])
+            assert r["ok"] is False and r["code"] == "bad_request"
+
+
+def test_daemon_join_page_resume_and_fp(corpora):
+    srv, L, R, daemon = _daemon(corpora)
+    with srv, L, R, daemon:
+        with DaemonClient("127.0.0.1", daemon.port, "jn") as c:
+            full, cur = [], None
+            pages = 0
+            while True:
+                rows, cur = c.join_page(
+                    "left", "right", on=["k"], page_rows=101,
+                    cursor=cur,
+                )
+                full.extend(rows)
+                pages += 1
+                if pages == 1:
+                    first_tok = cur
+                if cur is None:    # exhausted — the token IS the state
+                    break
+            want = _join_oracle(
+                corpora["lrows"], corpora["rrows"], ["k"], "inner"
+            )
+            assert full == want
+            assert pages >= 2
+            assert first_tok is not None
+            # resume from the first boundary, different page size
+            rest, cur2 = [], first_tok
+            while cur2 is not None:
+                rows, cur2 = c.join_page(
+                    "left", "right", on=["k"], page_rows=400,
+                    cursor=cur2,
+                )
+                rest.extend(rows)
+            assert rest == full[101:]
+            # token replayed against a different projection refuses
+            r = c.request("join_page", left="left", right="right",
+                          on=["k"], how="left", cursor=first_tok)
+            assert r["ok"] is False and r["code"] == "bad_request"
+            # unknown dataset names the registry
+            r = c.request("join_page", left="nope", right="right",
+                          on=["k"])
+            assert r["ok"] is False and r["code"] == "bad_request"
+            # unsorted corpus refusal arrives typed over the wire
+            r = c.request("join_page", left="left", right="right",
+                          on=["lv"])
+            assert r["ok"] is False and r["code"] in (
+                "unsupported", "bad_request"
+            )
+
+
+def test_daemon_query_tenant_attribution(corpora):
+    """select and join_page land on the CONNECTION's tenant tracer —
+    two tenants' reports stay disjoint."""
+    srv, L, R, daemon = _daemon(corpora)
+    with srv, L, R, daemon:
+        with DaemonClient("127.0.0.1", daemon.port, "qa") as ca, \
+                DaemonClient("127.0.0.1", daemon.port, "qb") as cb:
+            for _ in range(3):
+                ca.select("left", [("y", qcol("lv") + 1.0)],
+                          lo=0, hi=5)
+            cb.join_page("left", "right", on=["k"], page_rows=50)
+            ta = srv.tenant("qa").tracer.counters()
+            tb = srv.tenant("qb").tracer.counters()
+            assert ta.get("serve.select_probes") == 3
+            assert "query.join_pages" not in ta
+            assert tb.get("query.join_pages") == 1
+            assert "serve.select_probes" not in tb
+
+
+def test_dataset_select_library_face(corpora):
+    with Dataset(corpora["lpaths"], key_column="k") as ds:
+        with trace.scope() as t:
+            rows = ds.select([("half", qcol("lv") / 2.0)],
+                             columns=["k"], limit=7)
+        assert len(rows) == 7
+        want = corpora["lrows"][:7]
+        assert rows == [
+            {"k": r["k"], "half": r["lv"] / 2.0} for r in want
+        ]
+        c = t.counters()
+        assert c.get("serve.select_probes") == 1
+        assert c.get("serve.select_rows") == 7
+        assert "serve.select_seconds" in t.histograms()
